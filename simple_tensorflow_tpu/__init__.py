@@ -1,0 +1,149 @@
+"""simple_tensorflow_tpu (``import simple_tensorflow_tpu as stf``).
+
+A TPU-native framework with the capabilities of the reference stripped
+TensorFlow-1.0 tree (DengZhuangSouthRd/simple_tensorflow): deferred graphs,
+Sessions, variables, optimizers, distributed training — redesigned for
+JAX/XLA/Pallas execution on TPU. See SURVEY.md for the architecture map.
+
+The public namespace mirrors tf-1.x: stf.Session, stf.placeholder,
+stf.Variable, stf.matmul, stf.train.AdamOptimizer, stf.nn.softmax, ...
+"""
+
+from .version import __version__, VERSION
+
+# framework core
+from .framework import dtypes
+from .framework.dtypes import (
+    DType, as_dtype,
+    float16, half, bfloat16, float32, float64, double,
+    float8_e4m3fn, float8_e5m2,
+    int8, int16, int32, int64, uint8, uint16, uint32, uint64,
+    bool_ as bool, complex64, complex128, string,
+)
+from .framework.tensor_shape import TensorShape, Dimension
+from .framework import errors
+from .framework.graph import (
+    Graph, Operation, Tensor, GraphKeys, TensorSpec,
+    get_default_graph, reset_default_graph,
+    name_scope, control_dependencies, device, colocate_with, container,
+    add_to_collection, add_to_collections, get_collection, get_collection_ref,
+    convert_to_tensor, convert_n_to_tensor,
+    register_tensor_conversion_function,
+)
+from .framework.constant_op import constant
+from .framework.random_seed import set_random_seed
+from .framework.gradients import gradients, AggregationMethod, GradientTape
+from .framework.indexed_slices import IndexedSlices
+from .framework.sparse_tensor import SparseTensor, SparseTensorValue
+
+# ops: import registers lowerings; re-export the tf-1.x flat namespace
+from .ops import state_ops
+from .ops import variables as _variables_mod
+from .ops.variables import (
+    Variable, PartitionedVariable,
+    global_variables, all_variables, local_variables, model_variables,
+    trainable_variables, moving_average_variables,
+    variables_initializer, initialize_variables,
+    global_variables_initializer, initialize_all_variables,
+    local_variables_initializer, initialize_local_variables,
+    is_variable_initialized, assert_variables_initialized,
+    report_uninitialized_variables,
+)
+from .ops import math_ops, array_ops, control_flow_ops, random_ops, init_ops
+from .ops import nn_ops, clip_ops, logging_ops, check_ops, functional_ops
+from .ops import sparse_ops, linalg_ops, spectral_ops, string_ops
+from .ops import variable_scope as _vs
+
+from .ops.math_ops import (
+    add, subtract, sub, multiply, mul, divide, div, truediv, realdiv,
+    floordiv, mod, floormod, pow, maximum, minimum, squared_difference,
+    abs, negative, neg, sign, reciprocal, square, sqrt, rsqrt, exp, expm1,
+    log, log1p, sin, cos, tan, asin, acos, atan, atan2, sinh, cosh, tanh,
+    asinh, acosh, atanh, sigmoid, erf, erfc, lgamma, digamma, igamma,
+    igammac, zeta, polygamma, betainc, floor, ceil, rint, round,
+    is_nan, is_inf, is_finite, logical_not, logical_and, logical_or,
+    logical_xor, equal, not_equal, less, less_equal, greater, greater_equal,
+    cast, to_float, to_double, to_int32, to_int64, to_bfloat16, saturate_cast,
+    add_n, accumulate_n, matmul, batch_matmul, tensordot, einsum, cross,
+    reduce_sum, reduce_mean, reduce_prod, reduce_max, reduce_min,
+    reduce_all, reduce_any, reduce_logsumexp, count_nonzero,
+    argmax, argmin, cumsum, cumprod,
+    segment_sum, segment_mean, segment_max, segment_min, segment_prod,
+    unsorted_segment_sum, unsorted_segment_max, unsorted_segment_min,
+    unsorted_segment_prod, bincount, range, linspace, lin_space,
+    l2_normalize, scalar_mul, trace, real, imag, conj, angle,
+)
+from .ops.array_ops import (
+    placeholder, placeholder_with_default, identity, stop_gradient,
+    check_numerics, shape, shape_n, size, rank, reshape, transpose,
+    matrix_transpose, expand_dims, squeeze, zeros, ones, fill, zeros_like,
+    ones_like, concat, split, stack, pack, unstack, unpack, pad, tile,
+    slice, strided_slice, gather, gather_nd, scatter_nd, one_hot, where,
+    select, boolean_mask, reverse, reverse_v2, reverse_sequence,
+    sequence_mask, matrix_diag, matrix_diag_part, matrix_set_diag,
+    matrix_band_part, diag, diag_part, eye, invert_permutation,
+    broadcast_to, space_to_batch_nd, batch_to_space_nd, space_to_depth,
+    depth_to_space, extract_image_patches, unique, setdiff1d, meshgrid,
+)
+from .ops.control_flow_ops import (
+    no_op, group, tuple, cond, case, while_loop, with_dependencies,
+)
+from .ops.random_ops import (
+    random_uniform, random_normal, truncated_normal, random_shuffle,
+    multinomial, random_gamma, random_poisson, random_crop,
+)
+from .ops.clip_ops import (
+    clip_by_value, clip_by_norm, clip_by_global_norm, clip_by_average_norm,
+    global_norm,
+)
+from .ops.logging_ops import Print, Assert
+from .ops.functional_ops import map_fn, scan, foldl, foldr
+from .ops.variable_scope import (
+    variable_scope, get_variable, get_variable_scope, VariableScope,
+    AUTO_REUSE, no_regularizer, variable_op_scope,
+)
+from .ops.state_ops import (
+    assign, assign_add, assign_sub, scatter_update, scatter_add, scatter_sub,
+    scatter_mul, scatter_div, scatter_nd_update, count_up_to,
+)
+from .ops.check_ops import (
+    assert_equal, assert_greater, assert_greater_equal, assert_less,
+    assert_less_equal, assert_non_negative, assert_non_positive,
+    assert_negative, assert_positive, assert_rank, assert_rank_at_least,
+    assert_type, assert_integer, assert_scalar,
+)
+from .ops.template import make_template
+from .ops.functional_ops import py_func
+from .ops.linalg_ops import (
+    cholesky, matrix_determinant, matrix_inverse, matrix_solve,
+    matrix_triangular_solve, qr, svd, self_adjoint_eig, self_adjoint_eigvals,
+    norm,
+)
+from .ops.spectral_ops import fft, ifft, fft2d, ifft2d, fft3d, ifft3d
+
+# client
+from .client.session import Session, InteractiveSession, get_default_session
+
+# namespaces (tf.nn, tf.train, tf.layers, tf.summary, ...)
+from . import nn
+from . import train
+from . import layers
+from . import losses
+from . import metrics
+from . import summary
+from . import image
+from . import data
+from . import parallel
+from . import saved_model
+from . import estimator
+from . import debug
+from . import compat
+from .platform import app, flags, tf_logging as logging, resource_loader
+from .platform import test
+from .client import device_lib
+from .client import timeline
+
+# gradient checker
+from .framework.gradient_checker import compute_gradient, compute_gradient_error
+
+newaxis = None

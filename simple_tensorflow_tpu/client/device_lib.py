@@ -1,0 +1,40 @@
+"""Device introspection (ref: tensorflow/python/client/device_lib.py,
+core/common_runtime/device_mgr.cc). Lists JAX/PJRT devices in the
+reference's DeviceAttributes shape."""
+
+from __future__ import annotations
+
+
+class DeviceAttributes:
+    def __init__(self, name, device_type, memory_limit, incarnation,
+                 physical_device_desc=""):
+        self.name = name
+        self.device_type = device_type
+        self.memory_limit = memory_limit
+        self.incarnation = incarnation
+        self.physical_device_desc = physical_device_desc
+
+    def __repr__(self):
+        return (f"DeviceAttributes(name={self.name!r}, "
+                f"device_type={self.device_type!r}, "
+                f"memory_limit={self.memory_limit})")
+
+
+def list_local_devices(session_config=None):
+    """(ref: device_lib.py:27 ``list_local_devices``)."""
+    import jax
+
+    out = [DeviceAttributes("/device:CPU:0", "CPU", 256 << 30, 0, "host")]
+    for d in jax.devices():
+        kind = getattr(d, "device_kind", str(d.platform))
+        platform = d.platform.upper()
+        mem = 16 << 30
+        try:
+            stats = d.memory_stats()
+            if stats and "bytes_limit" in stats:
+                mem = stats["bytes_limit"]
+        except Exception:
+            pass
+        out.append(DeviceAttributes(
+            f"/device:{platform}:{d.id}", platform, mem, 0, kind))
+    return out

@@ -1,0 +1,553 @@
+"""stf.Session: run fetches against the graph on TPU.
+
+TPU-native replacement for the reference session stack
+(ref: tensorflow/python/client/session.py ``BaseSession.run``,
+tensorflow/core/common_runtime/direct_session.cc ``DirectSession::Run``).
+
+Execution model (see framework/lowering.py): the pruned fetch subgraph is
+traced into ONE pure function ``step(state, feeds, rng) -> (fetches, state')``
+and jitted; XLA compiles/fuses the whole step for the TPU. The Session owns:
+
+- a VariableStore: the single device-resident copy of all variable values
+  (jax.Arrays in HBM, with NamedShardings when stf.parallel is in use). The
+  full state dict is passed donated into each step so updates are in-place
+  in HBM — the role of the reference's BFC-allocated persistent tensors
+  (ref: core/common_runtime/bfc_allocator.cc) is played by XLA buffer
+  donation.
+- an executable cache keyed by (fetch names, feed names); jax.jit adds its
+  own retrace keying on feed shapes/dtypes, mirroring the reference's
+  executor cache keyed on the rewritten graph
+  (ref: direct_session.cc ``GetOrCreateExecutors``).
+- a host stage: ops registered ``runs_on_host`` (queues, readers, py_func
+  sources, variable introspection) run eagerly in Python before the XLA
+  program; their outputs feed the device stage. This replaces the
+  reference's CPU-device placement for IO ops
+  (ref: core/common_runtime/simple_placer.cc).
+
+Two-level RNG: the session advances a root key every run; random ops fold in
+per-op stream ids (framework/random_seed.py) — stateful-RNG API, functional
+implementation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import lowering as lowering_mod
+from ..framework import errors
+
+Tensor = ops_mod.Tensor
+Operation = ops_mod.Operation
+
+_default_session_stack = threading.local()
+
+
+def get_default_session():
+    stack = getattr(_default_session_stack, "stack", None)
+    return stack[-1] if stack else None
+
+
+class VariableStore:
+    """Device-resident variable state: name -> jax.Array."""
+
+    def __init__(self):
+        self.values: Dict[str, Any] = {}
+        self.shardings: Dict[str, Any] = {}
+
+    def load(self, name: str, value, variable=None):
+        import jax
+        import jax.numpy as jnp
+
+        dtype = None
+        if variable is not None:
+            dtype = variable.dtype.base_dtype.np_dtype
+        arr = jnp.asarray(np.asarray(value), dtype=dtype)
+        sh = self.shardings.get(name)
+        if sh is not None:
+            arr = jax.device_put(arr, sh)
+        self.values[name] = arr
+
+    def as_numpy(self, name: str):
+        return np.asarray(self.values[name])
+
+
+class _FetchMapper:
+    """Handles nested fetch structures (lists/tuples/dicts/namedtuples) like
+    the reference's FetchMapper (ref: python/client/session.py:182)."""
+
+    def __init__(self, graph, fetches):
+        self.elements: List[Any] = []  # unique graph elements (Tensor/Operation)
+        self._index: Dict[Any, int] = {}
+        self.structure = self._build(graph, fetches)
+
+    def _register(self, el):
+        if el not in self._index:
+            self._index[el] = len(self.elements)
+            self.elements.append(el)
+        return self._index[el]
+
+    def _build(self, g, f):
+        if isinstance(f, (list, tuple)) and not isinstance(f, str):
+            kids = [self._build(g, x) for x in f]
+            if hasattr(f, "_fields"):  # namedtuple
+                return ("namedtuple", type(f), kids)
+            return ("list", type(f), kids)
+        if isinstance(f, dict):
+            return ("dict", type(f),
+                    [(k, self._build(g, v)) for k, v in f.items()])
+        from ..framework.indexed_slices import IndexedSlices
+
+        if isinstance(f, IndexedSlices):
+            vals = self._build(g, f.values)
+            idx = self._build(g, f.indices)
+            return ("islices", None, [vals, idx])
+        el = g.as_graph_element(f, allow_tensor=True, allow_operation=True)
+        return ("leaf", None, self._register(el))
+
+    def rebuild(self, values, node=None):
+        node = node or self.structure
+        kind, typ, payload = node
+        if kind == "leaf":
+            return values[payload]
+        if kind == "dict":
+            return typ((k, self.rebuild(values, v)) for k, v in payload)
+        if kind == "islices":
+            from ..framework.indexed_slices import IndexedSlices
+
+            return IndexedSlices(self.rebuild(values, payload[0]),
+                                 self.rebuild(values, payload[1]))
+        kids = [self.rebuild(values, k) for k in payload]
+        if kind == "namedtuple":
+            return typ(*kids)
+        if typ is tuple:
+            return tuple(kids)
+        return kids
+
+
+class _CompiledStep:
+    __slots__ = ("jitted", "device_fetches", "host_plan", "post_host_plan",
+                 "post_host_inputs", "device_ops", "feed_tensors", "boundary",
+                 "has_device_stage", "n_calls", "last_lowering_ctx")
+
+    def __init__(self):
+        self.n_calls = 0
+        self.last_lowering_ctx = None
+        self.post_host_plan = []
+        self.post_host_inputs = []
+
+
+class BaseSession:
+    def __init__(self, target="", graph=None, config=None):
+        self._graph = graph or ops_mod.get_default_graph()
+        self._config = config
+        self._variable_store = VariableStore()
+        self._cache: Dict[Any, _CompiledStep] = {}
+        self._closed = False
+        self._run_counter = 0
+        self._lock = threading.RLock()
+        self._host_rng = np.random.RandomState(
+            self._graph.seed if self._graph.seed is not None else 12345)
+        self._base_key = None  # created lazily (jax import cost)
+        self._resources: Dict[str, Any] = {}  # queues, readers, tables
+        self._partial_runs: Dict[str, Any] = {}
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def graph(self):
+        return self._graph
+
+    @property
+    def graph_def(self):
+        return self._graph.as_graph_def()
+
+    @property
+    def sess_str(self):
+        return ""
+
+    def list_devices(self):
+        from . import device_lib
+
+        return device_lib.list_local_devices()
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self):
+        self._closed = True
+        self._cache.clear()
+
+    def __enter__(self):
+        if not hasattr(_default_session_stack, "stack"):
+            _default_session_stack.stack = []
+        _default_session_stack.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _default_session_stack.stack.pop()
+        self.close()
+        return False
+
+    def as_default(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            if not hasattr(_default_session_stack, "stack"):
+                _default_session_stack.stack = []
+            _default_session_stack.stack.append(self)
+            try:
+                yield self
+            finally:
+                _default_session_stack.stack.pop()
+
+        return ctx()
+
+    # -- run -----------------------------------------------------------------
+    def run(self, fetches, feed_dict=None, options=None, run_metadata=None):
+        """(ref: python/client/session.py:767 ``BaseSession.run``)."""
+        if self._closed:
+            raise RuntimeError("Attempted to use a closed Session.")
+        t0 = time.perf_counter()
+        mapper = _FetchMapper(self._graph, fetches)
+        feeds = self._normalize_feeds(feed_dict)
+        values = self._run_elements(mapper.elements, feeds)
+        out = mapper.rebuild(values)
+        if run_metadata is not None:
+            try:
+                run_metadata["wall_time_s"] = time.perf_counter() - t0
+            except TypeError:
+                pass
+        return out
+
+    def _normalize_feeds(self, feed_dict) -> Dict[Tensor, np.ndarray]:
+        feeds: Dict[Tensor, np.ndarray] = {}
+        if not feed_dict:
+            return feeds
+        for k, v in feed_dict.items():
+            t = self._graph.as_graph_element(k, allow_tensor=True,
+                                             allow_operation=False)
+            if t.dtype.name == "string":
+                arr = np.asarray(v, dtype=object)
+            else:
+                arr = np.asarray(v, dtype=t.dtype.base_dtype.np_dtype)
+            if not t.shape.is_compatible_with(arr.shape):
+                raise ValueError(
+                    f"Cannot feed value of shape {arr.shape} for tensor "
+                    f"{t.name} with shape {t.shape}")
+            feeds[t] = arr
+        return feeds
+
+    def _run_elements(self, elements: List[Any], feeds: Dict[Tensor, np.ndarray]):
+        key = (tuple(e.name if isinstance(e, Tensor) else "(op)" + e.name
+                     for e in elements),
+               tuple(sorted(t.name for t in feeds)))
+        step = self._cache.get(key)
+        if step is None:
+            step = self._plan(elements, feeds)
+            self._cache[key] = step
+
+        # Host stage -------------------------------------------------------
+        host_env: Dict[Tensor, Any] = {}
+        if step.host_plan:
+            hctx = lowering_mod.LoweringContext(
+                self._variable_store.values, rng_root=None, feeds=dict(feeds),
+                host=True, session=self)
+            hctx.env.update(feeds)
+            lowering_mod.execute_ops(hctx, step.host_plan, fed=set(feeds))
+            host_env = hctx.env
+
+        # Device stage -----------------------------------------------------
+        device_results: List[Any] = []
+        new_state = None
+        if step.has_device_stage:
+            rng = self._next_rng()
+            feed_args = {}
+            for t in step.feed_tensors:
+                val = feeds[t] if t in feeds else host_env[t]
+                feed_args[t.name] = self._maybe_shard_feed(t, val)
+            state = self._variable_store.values
+            fetch_vals, new_state = step.jitted(dict(state), feed_args, rng)
+            self._variable_store.values = dict(new_state)
+            self._apply_declared_shardings(new_state.keys())
+            device_results = list(fetch_vals)
+            step.n_calls += 1
+
+        dev_map = dict(zip(step.device_fetches, device_results))
+
+        # Post-host stage (host sinks: summaries etc.) ----------------------
+        if step.post_host_plan:
+            pctx = lowering_mod.LoweringContext(
+                self._variable_store.values, rng_root=None, host=True,
+                session=self)
+            pctx.env.update(host_env)
+            pctx.env.update(feeds)
+            for t, v in dev_map.items():
+                pctx.env[t] = np.asarray(v) if t.dtype.name != "string" else v
+            lowering_mod.execute_ops(pctx, step.post_host_plan,
+                                     fed=set(pctx.env))
+            host_env = pctx.env
+
+        # Assemble ---------------------------------------------------------
+        out = []
+        for e in elements:
+            if isinstance(e, Operation):
+                out.append(None)
+            elif e in feeds:
+                out.append(feeds[e])
+            elif e in dev_map and e not in host_env:
+                v = dev_map[e]
+                out.append(np.asarray(v) if e.dtype.name != "string" else v)
+            elif e in host_env:
+                out.append(host_env[e])
+            else:  # e.g. string Const fetched directly
+                if e.op.type == "Const":
+                    out.append(e.op.attrs["value"])
+                else:
+                    raise errors.InternalError(
+                        None, e.op, f"Fetch {e.name} produced no value")
+        return out
+
+    def _maybe_shard_feed(self, tensor, value):
+        """shard_feed-annotated placeholders: place the global batch with its
+        NamedSharding so GSPMD partitions the step (each host contributes its
+        slice on pods)."""
+        spec = tensor.op.attrs.get("sharding")
+        if spec is None:
+            return value
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            return value
+        import jax
+
+        ns = jax.sharding.NamedSharding(
+            mesh.jax_mesh, jax.sharding.PartitionSpec(*spec))
+        return jax.device_put(value, ns)
+
+    def _apply_declared_shardings(self, names):
+        """Move variables with a declared sharding onto the mesh (one-time
+        per variable, right after first write — typically initialization)."""
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            return
+        registry = self._graph._scoped_state.get("__vars_by_store_name__", {})
+        store = self._variable_store
+        for name in names:
+            if name in store.shardings:
+                continue
+            var = registry.get(name)
+            if var is None or var.sharding is None:
+                continue
+            import jax
+
+            ns = jax.sharding.NamedSharding(
+                mesh.jax_mesh, jax.sharding.PartitionSpec(*var.sharding))
+            store.shardings[name] = ns
+            store.values[name] = jax.device_put(store.values[name], ns)
+
+    def _next_rng(self):
+        import jax
+
+        if self._base_key is None:
+            seed = self._graph.seed if self._graph.seed is not None else 0
+            self._base_key = jax.random.key(seed)
+        self._run_counter += 1
+        return jax.random.fold_in(self._base_key, self._run_counter)
+
+    # -- planning ------------------------------------------------------------
+    def _plan(self, elements, feeds) -> _CompiledStep:
+        import jax
+
+        step = _CompiledStep()
+        fed_set: Set[Tensor] = set(feeds)
+        target_ops: List[Operation] = []
+        fetch_tensors: List[Tensor] = []
+        for e in elements:
+            if isinstance(e, Operation):
+                target_ops.append(e)
+            else:
+                fetch_tensors.append(e)
+                if e not in fed_set:
+                    target_ops.append(e.op)
+        pruned = lowering_mod.prune(target_ops, fed_set)
+
+        # Three stages (replaces the reference's CPU/GPU placement split,
+        # ref core/common_runtime/simple_placer.cc):
+        #   pre-host  — host sources (queues, readers, var introspection)
+        #   device    — ONE jitted XLA program
+        #   post-host — host sinks consuming device results (summaries, ...)
+        device_ops: List[Operation] = []
+        pre_host: List[Operation] = []
+        post_host: List[Operation] = []
+        host_producers: Set[Tensor] = set()
+        has_dev_anc: Set[Operation] = set()
+        device_op_set: Set[Operation] = set()
+        post_host_set: Set[Operation] = set()
+        for op in pruned:
+            dev_anc = any(
+                (t.op in device_op_set or t.op in has_dev_anc)
+                and t not in fed_set for t in op.inputs) or any(
+                c in device_op_set or c in has_dev_anc
+                for c in op.control_inputs)
+            if op.op_def.runs_on_host:
+                if dev_anc:
+                    post_host.append(op)
+                    post_host_set.add(op)
+                    has_dev_anc.add(op)
+                else:
+                    pre_host.append(op)
+                host_producers.update(op.outputs)
+            else:
+                if any(t.op in post_host_set for t in op.inputs):
+                    raise errors.InvalidArgumentError(
+                        None, op,
+                        f"Device op {op.name} consumes output of host sink "
+                        f"op; use stf.py_func (pure_callback) to re-enter "
+                        "the device program.")
+                device_ops.append(op)
+                device_op_set.add(op)
+                if dev_anc:
+                    has_dev_anc.add(op)
+        # Pre-host ops may only consume feeds, consts, or other host outputs.
+        pre_set = set(pre_host)
+        for op in pre_host:
+            for t in op.inputs:
+                if (t in fed_set or t in host_producers or
+                        t.op.type == "Const" or t.op in pre_set):
+                    continue
+                raise errors.InvalidArgumentError(
+                    None, op,
+                    f"Host op {op.name} consumes device tensor {t.name} "
+                    "without a device ancestor path — internal staging bug.")
+        # Consts consumed by host ops lower on host too.
+        const_for_host: List[Operation] = []
+        host_all = pre_host + post_host
+        host_all_set = set(host_all)
+        for op in host_all:
+            for t in op.inputs:
+                if t.op.type == "Const" and t.op not in host_all_set and \
+                        t.op not in const_for_host:
+                    const_for_host.append(t.op)
+        step.host_plan = const_for_host + pre_host
+        step.post_host_plan = post_host
+        # Device tensors needed by post-host ops become extra device fetches.
+        post_needs: List[Tensor] = []
+        seen_pn: Set[Tensor] = set()
+        for op in post_host:
+            for t in op.inputs:
+                if t.op in device_op_set and t not in seen_pn:
+                    seen_pn.add(t)
+                    post_needs.append(t)
+        step.post_host_inputs = post_needs
+
+        # Boundary: host/feed tensors consumed by device ops.
+        boundary: List[Tensor] = []
+        seen: Set[Tensor] = set()
+        for op in device_ops:
+            for t in op.inputs:
+                if (t in fed_set or t in host_producers) and t not in seen:
+                    seen.add(t)
+                    boundary.append(t)
+        for t in fetch_tensors:
+            if t in fed_set and t not in seen:
+                seen.add(t)
+                boundary.append(t)
+        step.feed_tensors = boundary
+
+        # Device fetches: fetch tensors produced by device ops, plus tensors
+        # the post-host stage needs.
+        device_fetches = [t for t in fetch_tensors if t.op in device_op_set]
+        for t in step.post_host_inputs:
+            if t not in device_fetches:
+                device_fetches.append(t)
+        step.device_fetches = device_fetches
+        step.device_ops = device_ops
+        step.has_device_stage = bool(device_ops)
+        if not step.has_device_stage:
+            step.jitted = None
+            return step
+
+        host_boundary = [t for t in boundary]
+        store = self._variable_store
+
+        def step_fn(state, feed_args, rng):
+            ctx = lowering_mod.LoweringContext(state, rng_root=rng,
+                                               session=self)
+            for t in host_boundary:
+                ctx.env[t] = feed_args[t.name]
+            lowering_mod.execute_ops(ctx, device_ops, fed=set(host_boundary))
+            fetch_vals = [ctx.env[t] for t in device_fetches]
+            return fetch_vals, ctx.state
+
+        step.jitted = jax.jit(step_fn, donate_argnums=0)
+        return step
+
+    # -- partial run (ref: session.py partial_run) --------------------------
+    def partial_run_setup(self, fetches, feeds=None):
+        handle = f"pr_{len(self._partial_runs)}"
+        mapper = _FetchMapper(self._graph, fetches)
+        self._partial_runs[handle] = {
+            "pending_fetches": set(mapper.elements),
+            "feeds": {},
+            "expected_feeds": set(
+                self._graph.as_graph_element(f, True, False)
+                for f in (feeds or [])),
+        }
+        return handle
+
+    def partial_run(self, handle, fetches, feed_dict=None):
+        st = self._partial_runs.get(handle)
+        if st is None:
+            raise errors.InvalidArgumentError(None, None,
+                                              f"Unknown partial_run handle {handle}")
+        if feed_dict:
+            st["feeds"].update(self._normalize_feeds(feed_dict))
+        mapper = _FetchMapper(self._graph, fetches)
+        values = self._run_elements(mapper.elements, dict(st["feeds"]))
+        return mapper.rebuild(values)
+
+    # -- make_callable (ref: session.py make_callable) -----------------------
+    def make_callable(self, fetches, feed_list=None):
+        feed_list = feed_list or []
+        feed_ts = [self._graph.as_graph_element(f, True, False)
+                   for f in feed_list]
+
+        def _callable(*args):
+            if len(args) != len(feed_ts):
+                raise ValueError(f"Expected {len(feed_ts)} feed values")
+            return self.run(fetches, feed_dict=dict(zip(feed_ts, args)))
+
+        return _callable
+
+
+class Session(BaseSession):
+    """(ref: python/client/session.py:1176 ``class Session``)."""
+
+    @staticmethod
+    def reset(target, containers=None, config=None):
+        # Containers are per-session here; nothing global to reset.
+        return None
+
+
+class InteractiveSession(BaseSession):
+    """Session that installs itself as default on construction
+    (ref: python/client/session.py:1332)."""
+
+    def __init__(self, target="", graph=None, config=None):
+        super().__init__(target, graph, config)
+        if not hasattr(_default_session_stack, "stack"):
+            _default_session_stack.stack = []
+        _default_session_stack.stack.append(self)
+
+    def close(self):
+        stack = getattr(_default_session_stack, "stack", [])
+        if self in stack:
+            stack.remove(self)
+        super().close()

@@ -1,0 +1,57 @@
+"""Timeline: chrome-trace export (ref: tensorflow/python/client/timeline.py,
+core/common_runtime/step_stats_collector.cc).
+
+The reference assembles StepStats from per-kernel timestamps; with XLA the
+per-op timeline lives in the profiler. This module provides (a) the
+reference's Timeline class over our RunMetadata dict, and (b) helpers to
+capture a jax.profiler trace for a Session.run.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class Timeline:
+    """(ref: timeline.py:308 ``class Timeline``)."""
+
+    def __init__(self, step_stats, graph=None):
+        self._step_stats = step_stats or {}
+        self._events = []
+        self._build()
+
+    def _build(self):
+        t0 = self._step_stats.get("start_us", 0)
+        for i, node in enumerate(self._step_stats.get("nodes", [])):
+            self._events.append({
+                "name": node.get("name", f"op{i}"),
+                "cat": "Op",
+                "ph": "X",
+                "ts": node.get("start_us", t0),
+                "dur": node.get("dur_us", 1),
+                "pid": 0,
+                "tid": node.get("tid", 0),
+            })
+        if not self._events and "wall_time_s" in self._step_stats:
+            self._events.append({
+                "name": "session_run", "cat": "Step", "ph": "X",
+                "ts": 0, "dur": self._step_stats["wall_time_s"] * 1e6,
+                "pid": 0, "tid": 0})
+
+    def generate_chrome_trace_format(self, show_dataflow=True,
+                                     show_memory=False):
+        return json.dumps({"traceEvents": self._events})
+
+
+def trace_session_run(session, fetches, feed_dict=None, log_dir="/tmp/stf_trace"):
+    """Capture a jax.profiler trace around one Session.run; view in
+    TensorBoard / Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        out = session.run(fetches, feed_dict=feed_dict)
+    finally:
+        jax.profiler.stop_trace()
+    return out

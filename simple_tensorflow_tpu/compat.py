@@ -1,0 +1,36 @@
+"""Compat helpers (ref: tensorflow/python/util/compat.py)."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+def as_bytes(bytes_or_text, encoding="utf-8"):
+    if isinstance(bytes_or_text, str):
+        return bytes_or_text.encode(encoding)
+    if isinstance(bytes_or_text, bytes):
+        return bytes_or_text
+    raise TypeError(f"Expected str/bytes, got {type(bytes_or_text)}")
+
+
+def as_text(bytes_or_text, encoding="utf-8"):
+    if isinstance(bytes_or_text, bytes):
+        return bytes_or_text.decode(encoding)
+    if isinstance(bytes_or_text, str):
+        return bytes_or_text
+    raise TypeError(f"Expected str/bytes, got {type(bytes_or_text)}")
+
+
+as_str = as_text
+as_str_any = lambda v: v if isinstance(v, str) else str(v)  # noqa: E731
+
+integral_types = (numbers.Integral, np.integer)
+real_types = (numbers.Real, np.integer, np.floating)
+complex_types = (numbers.Complex, np.number)
+bytes_or_text_types = (bytes, str)
+
+
+def forward_compatible(year, month, day):
+    return True

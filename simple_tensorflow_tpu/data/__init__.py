@@ -1,0 +1,9 @@
+"""stf.data: input pipeline (replaces ref queue-based input,
+python/training/input.py; Dataset API surface like later TF).
+
+TPU-native: the pipeline runs on the host (numpy), with a background
+prefetch thread double-buffering batches onto the device so input never
+blocks the step (the role of the reference's QueueRunners + staging areas).
+"""
+
+from .dataset import Dataset, Iterator, TFRecordDataset, make_one_shot_iterator

@@ -1,0 +1,379 @@
+"""Host-side Dataset pipeline with device prefetch.
+
+Replaces the reference's queue-based input pipeline
+(ref: python/training/input.py, core/kernels/fifo_queue.cc) with a
+generator-composition design; ``prefetch_to_device`` double-buffers batches
+into HBM on a background thread so the TPU step never waits on input.
+Graph integration: ``iterator.get_next()`` returns host-source ops feeding
+the compiled step, exactly where the reference's dequeue ops sat.
+"""
+
+from __future__ import annotations
+
+import queue as py_queue
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import errors
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+
+
+class Dataset:
+    """Composable host pipeline; each transformation wraps a generator
+    factory (re-iterable)."""
+
+    def __init__(self, gen_factory: Callable[[], Iterable], element_spec=None):
+        self._factory = gen_factory
+        self.element_spec = element_spec
+
+    # -- sources -------------------------------------------------------------
+    @staticmethod
+    def from_tensor_slices(tensors):
+        if isinstance(tensors, (list, tuple)):
+            arrays = tuple(np.asarray(t) for t in tensors)
+
+            def gen():
+                for i in range(arrays[0].shape[0]):
+                    yield tuple(a[i] for a in arrays)
+
+            return Dataset(gen)
+        arr = np.asarray(tensors)
+
+        def gen_single():
+            for i in range(arr.shape[0]):
+                yield arr[i]
+
+        return Dataset(gen_single)
+
+    @staticmethod
+    def from_tensors(tensors):
+        def gen():
+            yield tensors
+
+        return Dataset(gen)
+
+    @staticmethod
+    def from_generator(generator, output_types=None, output_shapes=None):
+        return Dataset(lambda: generator())
+
+    @staticmethod
+    def range(*args):
+        def gen():
+            yield from (np.int64(i) for i in range(*args))
+
+        return Dataset(gen)
+
+    @staticmethod
+    def zip(datasets):
+        def gen():
+            its = [iter(d) for d in datasets]
+            while True:
+                try:
+                    yield tuple(next(it) for it in its)
+                except StopIteration:
+                    return
+
+        return Dataset(gen)
+
+    # -- transforms ----------------------------------------------------------
+    def map(self, map_func, num_parallel_calls=None):
+        src = self._factory
+
+        if num_parallel_calls and num_parallel_calls > 1:
+            def gen():
+                import concurrent.futures as cf
+
+                with cf.ThreadPoolExecutor(num_parallel_calls) as ex:
+                    it = iter(src())
+                    pending = []
+                    try:
+                        for _ in range(num_parallel_calls * 2):
+                            pending.append(ex.submit(map_func, next(it)))
+                    except StopIteration:
+                        it = None
+                    while pending:
+                        yield pending.pop(0).result()
+                        if it is not None:
+                            try:
+                                pending.append(ex.submit(map_func, next(it)))
+                            except StopIteration:
+                                it = None
+
+            return Dataset(gen)
+
+        def gen_seq():
+            for x in src():
+                yield map_func(x)
+
+        return Dataset(gen_seq)
+
+    def filter(self, predicate):
+        src = self._factory
+
+        def gen():
+            for x in src():
+                if predicate(x):
+                    yield x
+
+        return Dataset(gen)
+
+    def batch(self, batch_size, drop_remainder=True):
+        """drop_remainder defaults True: XLA needs static batch shapes."""
+        src = self._factory
+
+        def gen():
+            buf = []
+            for x in src():
+                buf.append(x)
+                if len(buf) == batch_size:
+                    yield _stack_batch(buf)
+                    buf = []
+            if buf and not drop_remainder:
+                yield _stack_batch(buf)
+
+        return Dataset(gen)
+
+    def unbatch(self):
+        src = self._factory
+
+        def gen():
+            for x in src():
+                arrs = x if isinstance(x, tuple) else (x,)
+                for i in range(np.asarray(arrs[0]).shape[0]):
+                    row = tuple(np.asarray(a)[i] for a in arrs)
+                    yield row if isinstance(x, tuple) else row[0]
+
+        return Dataset(gen)
+
+    def shuffle(self, buffer_size, seed=None, reshuffle_each_iteration=True):
+        src = self._factory
+        rng_box = [np.random.RandomState(seed)]
+
+        def gen():
+            rng = rng_box[0] if not reshuffle_each_iteration else \
+                np.random.RandomState(rng_box[0].randint(1 << 31))
+            buf = []
+            for x in src():
+                buf.append(x)
+                if len(buf) >= buffer_size:
+                    i = rng.randint(len(buf))
+                    buf[i], buf[-1] = buf[-1], buf[i]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            yield from buf
+
+        return Dataset(gen)
+
+    def repeat(self, count=None):
+        src = self._factory
+
+        def gen():
+            n = 0
+            while count is None or n < count:
+                yield from src()
+                n += 1
+
+        return Dataset(gen)
+
+    def take(self, count):
+        src = self._factory
+
+        def gen():
+            for i, x in enumerate(src()):
+                if i >= count:
+                    return
+                yield x
+
+        return Dataset(gen)
+
+    def skip(self, count):
+        src = self._factory
+
+        def gen():
+            for i, x in enumerate(src()):
+                if i >= count:
+                    yield x
+
+        return Dataset(gen)
+
+    def prefetch(self, buffer_size=2):
+        """Background-thread prefetch (the C++ runtime's prefetcher is used
+        by prefetch_to_device)."""
+        src = self._factory
+
+        def gen():
+            q: py_queue.Queue = py_queue.Queue(maxsize=buffer_size)
+            DONE = object()
+
+            def worker():
+                try:
+                    for x in src():
+                        q.put(x)
+                finally:
+                    q.put(DONE)
+
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            while True:
+                x = q.get()
+                if x is DONE:
+                    return
+                yield x
+
+        return Dataset(gen)
+
+    def prefetch_to_device(self, buffer_size=2, sharding=None):
+        """Prefetch + jax.device_put so batches are already in HBM (with the
+        given NamedSharding on a mesh) when the step consumes them."""
+        src = self.prefetch(buffer_size)._factory
+
+        def gen():
+            import jax
+
+            for x in src():
+                if isinstance(x, tuple):
+                    yield tuple(jax.device_put(a, sharding) for a in x)
+                else:
+                    yield jax.device_put(x, sharding)
+
+        return Dataset(gen)
+
+    def cache(self):
+        src = self._factory
+        box: List = []
+
+        def gen():
+            if box:
+                yield from box[0]
+                return
+            items = []
+            for x in src():
+                items.append(x)
+                yield x
+            box.append(items)
+
+        return Dataset(gen)
+
+    # -- consumption ---------------------------------------------------------
+    def __iter__(self):
+        return iter(self._factory())
+
+    def as_numpy_iterator(self):
+        return iter(self)
+
+    def make_one_shot_iterator(self):
+        return Iterator(self)
+
+    def make_initializable_iterator(self):
+        return Iterator(self, initializable=True)
+
+
+def _stack_batch(rows):
+    if isinstance(rows[0], tuple):
+        return tuple(np.stack([np.asarray(r[i]) for r in rows])
+                     for i in range(len(rows[0])))
+    if isinstance(rows[0], dict):
+        return {k: np.stack([np.asarray(r[k]) for r in rows])
+                for k in rows[0]}
+    return np.stack([np.asarray(r) for r in rows])
+
+
+class TFRecordDataset(Dataset):
+    """(ref: reader ops core/kernels/record_yielder +
+    python TFRecordDataset). Uses the native C++ record reader when built."""
+
+    def __init__(self, filenames, compression_type=None, buffer_size=None,
+                 num_parallel_reads=None):
+        if isinstance(filenames, str):
+            filenames = [filenames]
+        files = list(filenames)
+
+        def gen():
+            from ..lib.io.tf_record import tf_record_iterator
+
+            for f in files:
+                yield from tf_record_iterator(f)
+
+        super().__init__(gen)
+
+
+_ITER_COUNT = [0]
+
+
+class Iterator:
+    """Graph-facing iterator: get_next() returns host-source tensors that
+    pull the next element during each Session.run (the reference's dequeue)."""
+
+    def __init__(self, dataset: Dataset, initializable=False):
+        self._dataset = dataset
+        self._it = None if initializable else iter(dataset)
+        _ITER_COUNT[0] += 1
+        self._name = f"dataset_iterator_{_ITER_COUNT[0]}"
+        _ITERATORS[self._name] = self
+        self._peek = None
+        self._spec = None
+
+    def _next_value(self):
+        if self._it is None:
+            raise errors.FailedPreconditionError(
+                None, None, "Iterator not initialized; run initializer")
+        try:
+            return next(self._it)
+        except StopIteration:
+            raise errors.OutOfRangeError(None, None, "End of sequence")
+
+    @property
+    def initializer(self):
+        g = ops_mod.get_default_graph()
+        return g.create_op("IteratorInit", [],
+                           attrs={"iterator": self._name}, name="iter_init",
+                           output_specs=[])
+
+    def get_next(self, name=None):
+        # Peek one element to type the outputs (shape/dtype spec).
+        if self._spec is None:
+            probe_it = iter(self._dataset)
+            first = next(probe_it)
+            items = first if isinstance(first, tuple) else (first,)
+            self._spec = [(np.asarray(x).shape, np.asarray(x).dtype)
+                          for x in items]
+            self._tuple = isinstance(first, tuple)
+        g = ops_mod.get_default_graph()
+        specs = [(shape_mod.TensorShape(list(sh)), dtypes_mod.as_dtype(dt))
+                 for sh, dt in self._spec]
+        op = g.create_op("IteratorGetNext", [],
+                         attrs={"iterator": self._name},
+                         name=name or "IteratorGetNext", output_specs=specs)
+        outs = list(op.outputs)
+        return tuple(outs) if self._tuple else outs[0]
+
+
+_ITERATORS = {}
+
+
+def _lower_get_next(ctx, op, inputs):
+    it = _ITERATORS[op.attrs["iterator"]]
+    val = it._next_value()
+    items = val if isinstance(val, tuple) else (val,)
+    return [np.asarray(x) for x in items]
+
+
+def _lower_iter_init(ctx, op, inputs):
+    it = _ITERATORS[op.attrs["iterator"]]
+    it._it = iter(it._dataset)
+    return []
+
+
+op_registry.register("IteratorGetNext", lower=_lower_get_next,
+                     is_stateful=True, runs_on_host=True, n_outputs=None)
+op_registry.register("IteratorInit", lower=_lower_iter_init,
+                     is_stateful=True, runs_on_host=True, n_outputs=0)
+
+
+def make_one_shot_iterator(dataset):
+    return Iterator(dataset)

@@ -1,0 +1,15 @@
+"""stf.debug: tfdbg equivalent (ref: tensorflow/python/debug).
+
+The reference wraps Session to intercept per-node tensors. Here the unit of
+execution is one XLA program, so debugging hooks differently:
+- DumpingDebugWrapperSession: fetches every *graph-visible* tensor of the
+  pruned step (op outputs) by adding them as extra fetches and dumps npy
+  files per run — the analog of tfdbg's dump mode.
+- add_check_numerics_ops / enable_check_numerics: jax debug_nans-style
+  host-callback checks on every floating tensor.
+- watch list: name-filtered subsets.
+"""
+
+from .wrappers import (DumpingDebugWrapperSession, LocalCLIDebugWrapperSession,
+                       TensorWatch, add_check_numerics_ops,
+                       has_inf_or_nan)

@@ -1,0 +1,150 @@
+"""Debug session wrappers (ref: tensorflow/python/debug/wrappers/framework.py,
+dumping_wrapper.py)."""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import time
+
+import numpy as np
+
+from ..framework import graph as ops_mod
+from ..framework import lowering as lowering_mod
+from ..platform import tf_logging as logging
+
+
+def has_inf_or_nan(datum_name, value):
+    """(ref: python/debug/lib/debug_data.py ``has_inf_or_nan``)."""
+    arr = np.asarray(value)
+    if arr.dtype.kind not in "fc":
+        return False
+    return bool(np.isnan(arr).any() or np.isinf(arr).any())
+
+
+class TensorWatch:
+    def __init__(self, pattern="*"):
+        self.pattern = pattern
+
+    def match(self, name):
+        return fnmatch.fnmatch(name, self.pattern)
+
+
+class _WrapperBase:
+    def __init__(self, sess):
+        self._sess = sess
+
+    @property
+    def graph(self):
+        return self._sess.graph
+
+    def __getattr__(self, item):
+        return getattr(self._sess, item)
+
+    def _watched_tensors(self, fetches, feed_dict, watches):
+        g = self._sess.graph
+        mapper_elements = []
+        from ..client.session import _FetchMapper
+
+        m = _FetchMapper(g, fetches)
+        targets = [e for e in m.elements]
+        target_ops = [e if isinstance(e, ops_mod.Operation) else e.op
+                      for e in targets]
+        fed = set()
+        if feed_dict:
+            for k in feed_dict:
+                fed.add(g.as_graph_element(k, True, False))
+        pruned = lowering_mod.prune(target_ops, fed)
+        out = []
+        for op in pruned:
+            if op.op_def.runs_on_host:
+                continue
+            for t in op.outputs:
+                if t.dtype.name == "string":
+                    continue
+                if any(w.match(t.name) for w in watches):
+                    out.append(t)
+        return out
+
+
+class DumpingDebugWrapperSession(_WrapperBase):
+    """(ref: python/debug/wrappers/dumping_wrapper.py). Dumps every watched
+    tensor of every run to <dump_root>/run_<n>/<tensor>.npy + manifest."""
+
+    def __init__(self, sess, session_root, watch_fn=None, log_usage=False):
+        super().__init__(sess)
+        self._root = session_root
+        os.makedirs(session_root, exist_ok=True)
+        self._run_counter = 0
+        self._watches = [TensorWatch("*")]
+
+    def add_tensor_filter(self, name, fn):
+        pass
+
+    def run(self, fetches, feed_dict=None, options=None, run_metadata=None):
+        watched = self._watched_tensors(fetches, feed_dict, self._watches)
+        self._run_counter += 1
+        run_dir = os.path.join(self._root, f"run_{self._run_counter}")
+        os.makedirs(run_dir, exist_ok=True)
+        result = self._sess.run({"__fetches__": fetches,
+                                 "__watched__": watched},
+                                feed_dict=feed_dict)
+        manifest = {}
+        for t, v in zip(watched, result["__watched__"]):
+            safe = t.name.replace("/", "_").replace(":", "_")
+            path = os.path.join(run_dir, safe + ".npy")
+            np.save(path, np.asarray(v))
+            manifest[t.name] = {
+                "file": safe + ".npy",
+                "has_inf_or_nan": has_inf_or_nan(t.name, v),
+            }
+        with open(os.path.join(run_dir, "manifest.json"), "w") as f:
+            json.dump({"time": time.time(), "tensors": manifest}, f, indent=1)
+        return result["__fetches__"]
+
+
+class LocalCLIDebugWrapperSession(_WrapperBase):
+    """(ref: python/debug/wrappers/local_cli_wrapper.py). Non-interactive
+    variant: logs watched tensor stats; breaks on inf/nan."""
+
+    def __init__(self, sess, dump_root=None, log_usage=False,
+                 break_on_nan=True):
+        super().__init__(sess)
+        self._watches = [TensorWatch("*")]
+        self._break_on_nan = break_on_nan
+
+    def run(self, fetches, feed_dict=None, options=None, run_metadata=None):
+        watched = self._watched_tensors(fetches, feed_dict, self._watches)
+        result = self._sess.run({"__fetches__": fetches,
+                                 "__watched__": watched},
+                                feed_dict=feed_dict)
+        bad = []
+        for t, v in zip(watched, result["__watched__"]):
+            if has_inf_or_nan(t.name, v):
+                bad.append(t.name)
+        if bad:
+            msg = f"inf/nan detected in: {bad[:10]}"
+            if self._break_on_nan:
+                from ..framework import errors
+
+                raise errors.InvalidArgumentError(None, None, msg)
+            logging.warning(msg)
+        return result["__fetches__"]
+
+
+def add_check_numerics_ops():
+    """(ref: python/ops/numerics.py ``add_check_numerics_ops``): returns a
+    group of CheckNumerics on all float tensors in the graph."""
+    from ..ops import array_ops, control_flow_ops
+
+    g = ops_mod.get_default_graph()
+    checks = []
+    for op in g.get_operations():
+        if op.op_def.runs_on_host:
+            continue
+        for t in op.outputs:
+            if t.dtype.is_floating:
+                checks.append(array_ops.check_numerics(
+                    t, f"found bad value in {t.name}").op)
+    return control_flow_ops.group(*checks, name="check_numerics_all")

@@ -1,0 +1,4 @@
+"""stf.estimator (ref: tensorflow/python/estimator)."""
+
+from .estimator import (Estimator, EstimatorSpec, ModeKeys, RunConfig,
+                        inputs)

@@ -1,0 +1,262 @@
+"""Estimator (ref: tensorflow/python/estimator/estimator.py).
+
+The model_fn/input_fn/EstimatorSpec contract of the reference, running on
+MonitoredTrainingSession; on a mesh the input batches shard over 'dp'
+automatically (see stf.parallel).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+
+import numpy as np
+
+from ..framework import graph as ops_mod
+from ..ops import variables as variables_mod
+from ..platform import tf_logging as logging
+from .. import train as train_mod
+
+
+class ModeKeys:
+    TRAIN = "train"
+    EVAL = "eval"
+    PREDICT = "infer"
+
+
+class EstimatorSpec(
+        collections.namedtuple(
+            "EstimatorSpec",
+            ["mode", "predictions", "loss", "train_op", "eval_metric_ops",
+             "export_outputs", "training_chief_hooks", "training_hooks",
+             "scaffold", "evaluation_hooks"])):
+    """(ref: python/estimator/model_fn.py ``EstimatorSpec``)."""
+
+    def __new__(cls, mode, predictions=None, loss=None, train_op=None,
+                eval_metric_ops=None, export_outputs=None,
+                training_chief_hooks=None, training_hooks=None, scaffold=None,
+                evaluation_hooks=None):
+        if mode == ModeKeys.TRAIN and train_op is None:
+            raise ValueError("train mode needs train_op")
+        if mode == ModeKeys.EVAL and loss is None:
+            raise ValueError("eval mode needs loss")
+        return super().__new__(cls, mode, predictions, loss, train_op,
+                               eval_metric_ops or {}, export_outputs,
+                               training_chief_hooks or [],
+                               training_hooks or [], scaffold,
+                               evaluation_hooks or [])
+
+
+class RunConfig:
+    """(ref: python/estimator/run_config.py)."""
+
+    def __init__(self, model_dir=None, tf_random_seed=None,
+                 save_summary_steps=100, save_checkpoints_steps=None,
+                 save_checkpoints_secs=600, keep_checkpoint_max=5,
+                 log_step_count_steps=100, session_config=None):
+        self.model_dir = model_dir
+        self.tf_random_seed = tf_random_seed
+        self.save_summary_steps = save_summary_steps
+        self.save_checkpoints_steps = save_checkpoints_steps
+        self.save_checkpoints_secs = (save_checkpoints_secs
+                                      if save_checkpoints_steps is None
+                                      else None)
+        self.keep_checkpoint_max = keep_checkpoint_max
+        self.log_step_count_steps = log_step_count_steps
+        self.session_config = session_config
+        self.is_chief = True
+
+
+class Estimator:
+    """(ref: python/estimator/estimator.py:103 ``class Estimator``)."""
+
+    def __init__(self, model_fn, model_dir=None, config=None, params=None,
+                 warm_start_from=None):
+        self._model_fn = model_fn
+        self._config = config or RunConfig()
+        self._model_dir = model_dir or self._config.model_dir or "/tmp/stf_model"
+        self._params = params or {}
+
+    @property
+    def model_dir(self):
+        return self._model_dir
+
+    @property
+    def config(self):
+        return self._config
+
+    @property
+    def params(self):
+        return dict(self._params)
+
+    def _call_model_fn(self, features, labels, mode):
+        import inspect
+
+        kwargs = {}
+        sig = inspect.signature(self._model_fn).parameters
+        if "labels" in sig:
+            kwargs["labels"] = labels
+        if "mode" in sig:
+            kwargs["mode"] = mode
+        if "params" in sig:
+            kwargs["params"] = self._params
+        if "config" in sig:
+            kwargs["config"] = self._config
+        spec = self._model_fn(features=features, **kwargs)
+        if not isinstance(spec, EstimatorSpec):
+            raise ValueError("model_fn must return EstimatorSpec")
+        return spec
+
+    def train(self, input_fn, hooks=None, steps=None, max_steps=None,
+              saving_listeners=None):
+        """(ref: estimator.py:302 ``train``)."""
+        g = ops_mod.Graph()
+        with g.as_default():
+            if self._config.tf_random_seed is not None:
+                g.seed = self._config.tf_random_seed
+            gs = train_mod.get_or_create_global_step(g)
+            features, labels = _call_input_fn(input_fn)
+            spec = self._call_model_fn(features, labels, ModeKeys.TRAIN)
+            all_hooks = list(hooks or []) + list(spec.training_hooks)
+            if steps is not None:
+                all_hooks.append(train_mod.StopAtStepHook(num_steps=steps))
+            elif max_steps is not None:
+                all_hooks.append(train_mod.StopAtStepHook(last_step=max_steps))
+            with train_mod.MonitoredTrainingSession(
+                    is_chief=True, checkpoint_dir=self._model_dir,
+                    scaffold=spec.scaffold, hooks=all_hooks,
+                    save_checkpoint_secs=self._config.save_checkpoints_secs,
+                    save_summaries_steps=self._config.save_summary_steps,
+                    log_step_count_steps=self._config.log_step_count_steps
+            ) as sess:
+                while not sess.should_stop():
+                    sess.run(spec.train_op)
+        return self
+
+    def evaluate(self, input_fn, steps=None, hooks=None, checkpoint_path=None,
+                 name=None):
+        """(ref: estimator.py:386 ``evaluate``)."""
+        g = ops_mod.Graph()
+        with g.as_default():
+            gs = train_mod.get_or_create_global_step(g)
+            features, labels = _call_input_fn(input_fn)
+            spec = self._call_model_fn(features, labels, ModeKeys.EVAL)
+            ckpt = checkpoint_path or train_mod.latest_checkpoint(
+                self._model_dir)
+            update_ops = {k: v[1] for k, v in spec.eval_metric_ops.items()}
+            value_ops = {k: v[0] for k, v in spec.eval_metric_ops.items()}
+            value_ops["loss"] = spec.loss
+            from ..train.evaluation import _evaluate_once
+
+            eval_steps = steps or 1
+            results_box = {}
+
+            class _EvalHook(train_mod.SessionRunHook):
+                def __init__(self):
+                    self._n = 0
+
+                def before_run(self, run_context):
+                    return train_mod.SessionRunArgs(update_ops)
+
+                def after_run(self, run_context, run_values):
+                    self._n += 1
+                    if self._n >= eval_steps:
+                        run_context.request_stop()
+
+            final = _evaluate_once(
+                ckpt, scaffold=spec.scaffold,
+                eval_ops=update_ops or spec.loss,
+                final_ops=value_ops, hooks=list(hooks or []) + [_EvalHook()])
+            out = {k: np.asarray(v) for k, v in (final or {}).items()}
+            out["global_step"] = train_mod.global_step(
+                _tmp_session(g), gs) if False else out.get("global_step", 0)
+            return out
+
+    def predict(self, input_fn, predict_keys=None, hooks=None,
+                checkpoint_path=None, yield_single_examples=True):
+        """(ref: estimator.py:463 ``predict``)."""
+        g = ops_mod.Graph()
+        with g.as_default():
+            train_mod.get_or_create_global_step(g)
+            features, _ = _call_input_fn(input_fn, expect_labels=False)
+            spec = self._call_model_fn(features, None, ModeKeys.PREDICT)
+            preds = spec.predictions
+            ckpt = checkpoint_path or train_mod.latest_checkpoint(
+                self._model_dir)
+            from ..client.session import Session
+            from ..framework import errors
+
+            with Session(graph=g) as sess:
+                sess.run(variables_mod.global_variables_initializer())
+                if ckpt:
+                    train_mod.Saver().restore(sess, ckpt)
+                while True:
+                    try:
+                        batch = sess.run(preds)
+                    except errors.OutOfRangeError:
+                        return
+                    if yield_single_examples:
+                        if isinstance(batch, dict):
+                            n = len(next(iter(batch.values())))
+                            for i in range(n):
+                                yield {k: v[i] for k, v in batch.items()}
+                        else:
+                            for row in batch:
+                                yield row
+                    else:
+                        yield batch
+
+    def export_savedmodel(self, export_dir_base, serving_input_receiver_fn,
+                          **kwargs):
+        raise NotImplementedError(
+            "export via stf.saved_model.simple_save for now")
+
+
+def _call_input_fn(input_fn, expect_labels=True):
+    res = input_fn()
+    if hasattr(res, "make_one_shot_iterator"):
+        it = res.make_one_shot_iterator()
+        res = it.get_next()
+    if isinstance(res, tuple) and len(res) == 2:
+        return res
+    return res, None
+
+
+def _tmp_session(g):
+    from ..client.session import Session
+
+    return Session(graph=g)
+
+
+class inputs:
+    """numpy_input_fn (ref: python/estimator/inputs/numpy_io.py)."""
+
+    @staticmethod
+    def numpy_input_fn(x, y=None, batch_size=128, num_epochs=1, shuffle=True,
+                      queue_capacity=1000, num_threads=1):
+        from ..data.dataset import Dataset
+
+        def input_fn():
+            if isinstance(x, dict):
+                keys = sorted(x)
+                arrays = tuple(np.asarray(x[k]) for k in keys)
+                data = arrays + ((np.asarray(y),) if y is not None else ())
+                ds = Dataset.from_tensor_slices(data)
+
+                def pack(row):
+                    feats = {k: row[i] for i, k in enumerate(keys)}
+                    if y is not None:
+                        return feats, row[-1]
+                    return feats
+
+                ds = ds.map(pack)
+            else:
+                data = (np.asarray(x), np.asarray(y)) if y is not None \
+                    else np.asarray(x)
+                ds = Dataset.from_tensor_slices(data)
+            if shuffle:
+                ds = ds.shuffle(queue_capacity)
+            ds = ds.repeat(num_epochs).batch(batch_size)
+            return ds
+
+        return input_fn

@@ -1,0 +1,153 @@
+"""Constant and placeholder ops (ref: python/framework/constant_op.py,
+core/kernels/constant_op.cc).
+
+Constants are stored as numpy arrays in the op's attrs and become XLA
+literals at lowering; XLA constant-folds them aggressively, which subsumes
+most of the reference's ConstantFolding pass
+(ref: core/common_runtime/constant_folding.cc).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import dtypes as dtypes_mod
+from . import graph as ops
+from . import op_registry
+from . import tensor_shape as shape_mod
+
+
+def _to_numpy(value, dtype=None):
+    if dtype is not None:
+        dtype = dtypes_mod.as_dtype(dtype)
+    if dtype is not None and dtype.name == "string":
+        return np.asarray(value, dtype=object)
+    if isinstance(value, np.ndarray):
+        arr = value
+    else:
+        arr = np.asarray(value)
+    if arr.dtype.kind in "USO" and (dtype is None or dtype.name == "string"):
+        return np.asarray(arr, dtype=object)
+    if dtype is not None:
+        arr = arr.astype(dtype.np_dtype)
+    elif arr.dtype == np.float64 and not isinstance(value, np.ndarray):
+        # Python floats default to float32 (TPU-friendly), like jax.
+        arr = arr.astype(np.float32)
+    elif arr.dtype == np.int64 and not isinstance(value, np.ndarray):
+        arr = arr.astype(np.int32)
+    return arr
+
+
+def constant(value, dtype=None, shape=None, name="Const", verify_shape=False):
+    """Create a constant tensor (ref: python/framework/constant_op.py:102)."""
+    g = ops.get_default_graph()
+    if isinstance(value, ops.Tensor):
+        return value
+    arr = _to_numpy(value, dtype)
+    if shape is not None:
+        shape = shape_mod.as_shape(shape)
+        n_target = shape.num_elements()
+        if arr.size == 1 and n_target is not None and n_target != arr.size:
+            arr = np.full(shape.as_list(), arr.reshape(()), dtype=arr.dtype)
+        elif verify_shape and list(arr.shape) != shape.as_list():
+            raise TypeError(f"Expected shape {shape}, got {list(arr.shape)}")
+        else:
+            arr = arr.reshape(shape.as_list())
+    dt = dtypes_mod.as_dtype(dtype) if dtype is not None else dtypes_mod.as_dtype(arr.dtype) \
+        if arr.dtype.kind not in "USO" else dtypes_mod.string
+    op = g.create_op("Const", [], attrs={"value": arr, "dtype": dt},
+                     name=name,
+                     output_specs=[(shape_mod.TensorShape(list(arr.shape)), dt)])
+    return op.outputs[0]
+
+
+def is_constant(tensor_or_op) -> bool:
+    op = tensor_or_op.op if isinstance(tensor_or_op, ops.Tensor) else tensor_or_op
+    return op.type == "Const"
+
+
+def constant_value(tensor, partial=False):
+    """Best-effort static value of a tensor
+    (ref: python/framework/tensor_util.py ``constant_value``)."""
+    if not isinstance(tensor, ops.Tensor):
+        return np.asarray(tensor)
+    op = tensor.op
+    if op.type == "Const":
+        return op.attrs["value"]
+    if op.type == "Identity":
+        return constant_value(op.inputs[0], partial)
+    if op.type == "Shape":
+        sh = op.inputs[0].shape
+        if sh.is_fully_defined():
+            return np.asarray(sh.as_list(), dtype=np.int32)
+    if op.type == "Rank":
+        sh = op.inputs[0].shape
+        if sh.rank is not None:
+            return np.asarray(sh.rank, dtype=np.int32)
+    if op.type == "Size":
+        sh = op.inputs[0].shape
+        if sh.is_fully_defined():
+            return np.asarray(sh.num_elements(), dtype=np.int32)
+    if op.type in ("Pack", "Stack"):
+        vals = [constant_value(i, partial) for i in op.inputs]
+        if all(v is not None for v in vals):
+            return np.stack(vals, axis=op.attrs.get("axis", 0))
+    if op.type == "Cast":
+        v = constant_value(op.inputs[0], partial)
+        if v is not None:
+            return v.astype(op.attrs["dtype"].np_dtype)
+    return None
+
+
+def constant_value_as_shape(tensor) -> shape_mod.TensorShape:
+    v = constant_value(tensor)
+    if v is None:
+        sh = tensor.shape
+        if sh.rank == 1 and sh[0].value is not None:
+            return shape_mod.unknown_shape(rank=sh[0].value)
+        return shape_mod.TensorShape(None)
+    return shape_mod.TensorShape([int(d) for d in np.ravel(v)])
+
+
+# -- op registrations --------------------------------------------------------
+
+def _lower_const(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    val = op.attrs["value"]
+    if op.attrs["dtype"].name == "string":
+        return [val]  # host-only value; never enters the XLA program
+    return [jnp.asarray(val)]
+
+
+op_registry.register("Const", lower=_lower_const)
+
+
+def _lower_placeholder(ctx, op, inputs):
+    raise RuntimeError(
+        f"Placeholder {op.name} was not fed. You must feed a value for it "
+        "via Session.run(..., feed_dict={...}).")
+
+
+op_registry.register("Placeholder", lower=_lower_placeholder, is_stateful=True)
+
+
+def _lower_placeholder_with_default(ctx, op, inputs):
+    return [inputs[0]]
+
+
+op_registry.register("PlaceholderWithDefault", lower=_lower_placeholder_with_default)
+
+
+def _lower_unbound(kind):
+    def lower(ctx, op, inputs):
+        raise RuntimeError(
+            f"{kind} {op.name} lowered outside its binding context — "
+            "this is an internal control-flow lowering bug.")
+
+    return lower
+
+
+op_registry.register("CapturedInput", lower=_lower_unbound("CapturedInput"),
+                     is_stateful=True)
+op_registry.register("FuncArg", lower=_lower_unbound("FuncArg"), is_stateful=True)

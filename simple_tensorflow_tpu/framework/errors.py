@@ -1,0 +1,135 @@
+"""Error hierarchy matching the reference's tf.errors.
+
+(ref: tensorflow/python/framework/errors_impl.py). The reference derives these
+from grpc/absl status codes; here they are plain Python exceptions raised by
+the session, lowering, and IO layers.
+"""
+
+from __future__ import annotations
+
+OK = 0
+CANCELLED = 1
+UNKNOWN = 2
+INVALID_ARGUMENT = 3
+DEADLINE_EXCEEDED = 4
+NOT_FOUND = 5
+ALREADY_EXISTS = 6
+PERMISSION_DENIED = 7
+UNAUTHENTICATED = 16
+RESOURCE_EXHAUSTED = 8
+FAILED_PRECONDITION = 9
+ABORTED = 10
+OUT_OF_RANGE = 11
+UNIMPLEMENTED = 12
+INTERNAL = 13
+UNAVAILABLE = 14
+DATA_LOSS = 15
+
+
+class OpError(Exception):
+    """Base class for errors raised while executing an operation.
+
+    Carries the failing node's name/op like the reference
+    (ref: python/framework/errors_impl.py:38 ``class OpError``).
+    """
+
+    def __init__(self, node_def, op, message, error_code):
+        super().__init__(message)
+        self._node_def = node_def
+        self._op = op
+        self._message = message
+        self._error_code = error_code
+
+    @property
+    def message(self):
+        return self._message
+
+    @property
+    def op(self):
+        return self._op
+
+    @property
+    def node_def(self):
+        return self._node_def
+
+    @property
+    def error_code(self):
+        return self._error_code
+
+    def __str__(self):
+        if self._op is not None:
+            return f"{self._message}\n\t [[node {getattr(self._op, 'name', self._op)}]]"
+        return self._message
+
+
+def _make(name, code, doc):
+    def __init__(self, node_def=None, op=None, message=None):
+        if message is None and isinstance(node_def, str):
+            # Convenience: Error("message")
+            node_def, message = None, node_def
+        OpError.__init__(self, node_def, op, message or name, code)
+
+    cls = type(name, (OpError,), {"__init__": __init__, "__doc__": doc})
+    return cls
+
+
+CancelledError = _make("CancelledError", CANCELLED, "Operation was cancelled.")
+UnknownError = _make("UnknownError", UNKNOWN, "Unknown error.")
+InvalidArgumentError = _make("InvalidArgumentError", INVALID_ARGUMENT,
+                             "Op received an invalid argument.")
+DeadlineExceededError = _make("DeadlineExceededError", DEADLINE_EXCEEDED,
+                              "Deadline expired before operation completed.")
+NotFoundError = _make("NotFoundError", NOT_FOUND, "Requested entity not found.")
+AlreadyExistsError = _make("AlreadyExistsError", ALREADY_EXISTS,
+                           "Entity already exists.")
+PermissionDeniedError = _make("PermissionDeniedError", PERMISSION_DENIED,
+                              "Caller lacks permission.")
+UnauthenticatedError = _make("UnauthenticatedError", UNAUTHENTICATED,
+                             "Request lacks valid authentication.")
+ResourceExhaustedError = _make("ResourceExhaustedError", RESOURCE_EXHAUSTED,
+                               "A resource (e.g. HBM) was exhausted.")
+FailedPreconditionError = _make("FailedPreconditionError", FAILED_PRECONDITION,
+                                "System not in required state (e.g. uninitialized variable).")
+AbortedError = _make("AbortedError", ABORTED, "Operation aborted.")
+OutOfRangeError = _make("OutOfRangeError", OUT_OF_RANGE,
+                        "Operation iterated past valid range (e.g. end of dataset).")
+UnimplementedError = _make("UnimplementedError", UNIMPLEMENTED,
+                           "Operation not implemented.")
+InternalError = _make("InternalError", INTERNAL, "Internal invariant broken.")
+UnavailableError = _make("UnavailableError", UNAVAILABLE,
+                         "Runtime currently unavailable (e.g. peer down).")
+DataLossError = _make("DataLossError", DATA_LOSS,
+                      "Unrecoverable data loss or corruption (e.g. bad CRC).")
+
+_CODE_TO_EXC = {
+    CANCELLED: CancelledError, UNKNOWN: UnknownError,
+    INVALID_ARGUMENT: InvalidArgumentError, DEADLINE_EXCEEDED: DeadlineExceededError,
+    NOT_FOUND: NotFoundError, ALREADY_EXISTS: AlreadyExistsError,
+    PERMISSION_DENIED: PermissionDeniedError, UNAUTHENTICATED: UnauthenticatedError,
+    RESOURCE_EXHAUSTED: ResourceExhaustedError,
+    FAILED_PRECONDITION: FailedPreconditionError, ABORTED: AbortedError,
+    OUT_OF_RANGE: OutOfRangeError, UNIMPLEMENTED: UnimplementedError,
+    INTERNAL: InternalError, UNAVAILABLE: UnavailableError,
+    DATA_LOSS: DataLossError,
+}
+
+
+def exception_type_from_error_code(code):
+    return _CODE_TO_EXC[code]
+
+
+def error_code_from_exception_type(cls):
+    for code, c in _CODE_TO_EXC.items():
+        if c is cls:
+            return code
+    raise KeyError(cls)
+
+
+class raise_exception_on_not_ok_status:
+    """Context manager kept for reference-API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

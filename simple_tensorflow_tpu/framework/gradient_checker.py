@@ -1,0 +1,75 @@
+"""Numeric gradient checker (ref: tensorflow/python/ops/gradient_checker.py).
+
+compute_gradient returns (jacobian_theoretical, jacobian_numeric) like the
+reference; used across op tests to verify the vjp-derived symbolic grads.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import graph as ops_mod
+from . import gradients as gradients_mod
+
+
+def _theoretical_jacobian(x, y, x_data, dy_session, feed_dict):
+    from ..ops import array_ops
+
+    x_size = int(np.prod(x_data.shape)) if x_data.shape else 1
+    y_shape = [int(d) for d in y.shape.as_list()]
+    y_size = int(np.prod(y_shape)) if y_shape else 1
+    jac = np.zeros((x_size, y_size), dtype=np.float64)
+    dy = array_ops.placeholder(y.dtype, y.shape)
+    (dx,) = gradients_mod.gradients(y, [x], grad_ys=[dy])
+    for col in range(y_size):
+        dy_val = np.zeros(y_shape, dtype=y.dtype.np_dtype)
+        dy_val.flat[col] = 1.0
+        fd = dict(feed_dict or {})
+        fd[dy] = dy_val
+        fd[x] = x_data
+        dx_val = dy_session.run(dx, feed_dict=fd)
+        jac[:, col] = np.asarray(dx_val, dtype=np.float64).ravel()
+    return jac
+
+
+def _numeric_jacobian(x, y, x_data, session, feed_dict, delta):
+    x_size = int(np.prod(x_data.shape)) if x_data.shape else 1
+    y_shape = [int(d) for d in y.shape.as_list()]
+    y_size = int(np.prod(y_shape)) if y_shape else 1
+    jac = np.zeros((x_size, y_size), dtype=np.float64)
+    for row in range(x_size):
+        x_pos = x_data.copy()
+        x_neg = x_data.copy()
+        x_pos.flat[row] += delta
+        x_neg.flat[row] -= delta
+        fd = dict(feed_dict or {})
+        fd[x] = x_pos
+        y_pos = np.asarray(session.run(y, feed_dict=fd), dtype=np.float64)
+        fd[x] = x_neg
+        y_neg = np.asarray(session.run(y, feed_dict=fd), dtype=np.float64)
+        jac[row, :] = ((y_pos - y_neg) / (2 * delta)).ravel()
+    return jac
+
+
+def compute_gradient(x, x_shape, y, y_shape, x_init_value=None, delta=1e-3,
+                     init_targets=None, extra_feed_dict=None):
+    """(ref: gradient_checker.py:183 ``compute_gradient``)."""
+    from ..client.session import get_default_session
+
+    sess = get_default_session()
+    if sess is None:
+        raise ValueError("compute_gradient requires a default session")
+    if x_init_value is None:
+        rng = np.random.RandomState(12345)
+        x_init_value = rng.randn(*x_shape).astype(x.dtype.np_dtype)
+    theo = _theoretical_jacobian(x, y, x_init_value, sess, extra_feed_dict)
+    num = _numeric_jacobian(x, y, x_init_value, sess, extra_feed_dict, delta)
+    return theo, num
+
+
+def compute_gradient_error(x, x_shape, y, y_shape, x_init_value=None,
+                           delta=1e-3, init_targets=None,
+                           extra_feed_dict=None):
+    theo, num = compute_gradient(x, x_shape, y, y_shape, x_init_value, delta,
+                                 init_targets, extra_feed_dict)
+    return float(np.max(np.abs(theo - num)))

@@ -1,0 +1,182 @@
+"""Symbolic gradients via XLA autodiff.
+
+TPU-native replacement for the reference's backward-graph builder
+(ref: tensorflow/python/ops/gradients_impl.py ``gradients`` and the ~60
+per-op @RegisterGradient rules in python/ops/*_grad.py, core/ops/*_grad.cc).
+
+Design: ``stf.gradients(ys, xs)`` does NOT build an explicit backward graph
+op-by-op. It inserts one ``SymbolicGradient`` node whose lowering re-traces
+the forward slice between xs and ys as a pure function and calls ``jax.vjp``
+on it. Consequences:
+
+- the backward pass is derived by JAX/XLA's autodiff — provably consistent
+  with the forward lowering, zero per-op gradient maintenance;
+- forward replay is CSE'd by XLA against the original forward (same traced
+  ops, same RNG streams — see random_seed.py), so there is no double
+  compute in the compiled program;
+- backward fuses with forward in ONE XLA program — on TPU this is the whole
+  ballgame (the reference schedules backward kernels dynamically).
+
+tf.gradients-compatible surface: returns None for disconnected xs, supports
+grad_ys, stop_gradients handled by the StopGradient op (→ lax.stop_gradient).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from . import graph as ops_mod
+from . import op_registry
+from . import lowering as lowering_mod
+from .indexed_slices import IndexedSlices
+
+Tensor = ops_mod.Tensor
+
+
+def _as_tensor_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def gradients(ys, xs, grad_ys=None, name="gradients",
+              colocate_gradients_with_ops=False, gate_gradients=False,
+              aggregation_method=None, stop_gradients=None) -> List[Optional[Tensor]]:
+    """d(sum ys)/d(xs). (ref: python/ops/gradients_impl.py:154 ``gradients``).
+
+    Returns a list aligned with xs; entries are None for xs not reachable
+    from ys (reference behavior relied on by Optimizer.compute_gradients).
+    """
+    ys = _as_tensor_list(ys)
+    xs_in = _as_tensor_list(xs)
+    g = ops_mod.get_default_graph()
+
+    # Variables passed directly -> differentiate w.r.t. their read tensor.
+    xs = []
+    for x in xs_in:
+        if hasattr(x, "_grad_anchor"):  # Variable
+            xs.append(x._grad_anchor())
+        elif isinstance(x, Tensor):
+            xs.append(x)
+        else:
+            raise TypeError(f"gradients: xs must be Tensors/Variables, got {x!r}")
+
+    if stop_gradients:
+        from ..ops import array_ops  # noqa: F401  (StopGradient registered)
+
+        stop_set = set(_as_tensor_list(stop_gradients))
+    else:
+        stop_set = set()
+
+    if grad_ys is not None:
+        grad_ys = [ops_mod.convert_to_tensor(gy) if gy is not None else None
+                   for gy in _as_tensor_list(grad_ys)]
+        if len(grad_ys) != len(ys):
+            raise ValueError("grad_ys must match ys in length")
+    else:
+        grad_ys = [None] * len(ys)
+
+    _, connected = lowering_mod.ancestors_between(xs, ys)
+
+    with g.name_scope(name):
+        connected_xs = [x for x in xs if x in connected
+                        and (x.dtype.is_floating or x.dtype.is_complex)]
+        if not connected_xs:
+            return [None] * len(xs)
+        supplied_gys = [gy for gy in grad_ys if gy is not None]
+        attrs = {
+            "n_ys": len(ys),
+            "n_xs": len(connected_xs),
+            "grad_ys_mask": tuple(gy is not None for gy in grad_ys),
+            "stop_tensors": tuple(stop_set),
+        }
+        inputs = list(ys) + list(connected_xs) + supplied_gys
+        out_specs = [(x.shape, x.dtype) for x in connected_xs]
+        op = g.create_op("SymbolicGradient", inputs, attrs=attrs,
+                         name="grad", output_specs=out_specs)
+        grads_by_x = dict(zip(connected_xs, op.outputs))
+    return [grads_by_x.get(x) for x in xs]
+
+
+def _lower_symbolic_gradient(ctx, op, input_values):
+    import jax
+    import jax.numpy as jnp
+
+    n_ys = op.attrs["n_ys"]
+    n_xs = op.attrs["n_xs"]
+    gys_mask = op.attrs["grad_ys_mask"]
+    ys = list(op.inputs[:n_ys])
+    xs = list(op.inputs[n_ys:n_ys + n_xs])
+    ys_vals = input_values[:n_ys]
+    xs_vals = input_values[n_ys:n_ys + n_xs]
+    supplied = list(input_values[n_ys + n_xs:])
+
+    path_ops, _ = lowering_mod.ancestors_between(xs, ys)
+    path_set = set(path_ops)
+    xset = set(xs)
+    stop_set = set(op.attrs.get("stop_tensors", ()))
+
+    def forward(*args):
+        # Capture off-path values from the already-lowered env; CRUCIALLY drop
+        # on-path values so the slice is re-traced as a function of ``args``
+        # (XLA CSEs the replay against the original forward).
+        env = {t: v for t, v in ctx.env.items() if t.op not in path_set}
+        env.update(zip(xs, args))
+        child = ctx.child(env)
+        for path_op in path_ops:
+            lowering_mod.execute_ops(child, [path_op], fed=xset)
+            if stop_set:
+                for out in path_op.outputs:
+                    if out in stop_set and out in child.env:
+                        child.env[out] = jax.lax.stop_gradient(child.env[out])
+        return tuple(child.env[y] for y in ys)
+
+    primals_out, vjp_fn = jax.vjp(forward, *xs_vals)
+
+    cotangents = []
+    it = iter(supplied)
+    for i, y in enumerate(ys):
+        if gys_mask[i]:
+            cotangents.append(next(it))
+        else:
+            cotangents.append(jnp.ones_like(primals_out[i]))
+    grads = vjp_fn(tuple(cotangents))
+    return list(grads)
+
+
+op_registry.register("SymbolicGradient", lower=_lower_symbolic_gradient,
+                     n_outputs=None)
+
+
+class GradientTape:
+    """Minimal TF2-style tape for convenience; builds on stf.gradients."""
+
+    def __init__(self, persistent=False):
+        self._persistent = persistent
+        self._used = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def gradient(self, target, sources):
+        if self._used and not self._persistent:
+            raise RuntimeError("Non-persistent tape used twice")
+        self._used = True
+        res = gradients(target, sources if isinstance(sources, (list, tuple))
+                        else [sources])
+        if isinstance(sources, (list, tuple)):
+            return res
+        return res[0]
+
+
+class AggregationMethod:
+    """(ref: gradients_impl.py ``AggregationMethod``) — XLA fuses gradient
+    accumulation; these are accepted for API parity and ignored."""
+
+    ADD_N = 0
+    DEFAULT = ADD_N
+    EXPERIMENTAL_TREE = 1
+    EXPERIMENTAL_ACCUMULATE_N = 2

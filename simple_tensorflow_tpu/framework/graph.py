@@ -1,0 +1,847 @@
+"""Graph IR: Graph / Operation / Tensor.
+
+TPU-native re-design of the reference graph layer
+(ref: tensorflow/python/framework/ops.py — ``Graph``, ``Operation``,
+``Tensor``; tensorflow/core/graph/graph.h). The user-facing model is the
+same deferred-execution dataflow graph as TF-1.0 (name scopes, collections,
+control dependencies, feeds/fetches), but the graph is *not* executed by a
+per-node interpreter: Session lowers the pruned fetch subgraph into a single
+pure JAX function that XLA compiles for the TPU (see
+simple_tensorflow_tpu/framework/lowering.py). Consequences for the IR:
+
+- Operations are immutable once created and the graph is append-only, so a
+  compiled executable for a pruned subgraph can never be invalidated by later
+  graph construction (the reference rebuilds executors on graph mutation,
+  ref core/common_runtime/direct_session.cc ``GetOrCreateExecutors``).
+- There are no Enter/Exit/Switch/Merge control-flow nodes; cond/while carry
+  nested FuncGraphs (as TF-2 does) which lower to lax.cond/lax.while_loop —
+  the XLA-friendly formulation.
+- Stateful ops (variables, RNG) declare their effects; ordering between
+  effectful ops is defined by data + control edges, enforced by topological
+  order at lowering time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import dtypes as dtypes_mod
+from . import tensor_shape as shape_mod
+from .errors import InvalidArgumentError
+
+
+class GraphKeys:
+    """Standard collection names (ref: python/framework/ops.py ``GraphKeys``)."""
+
+    GLOBAL_VARIABLES = "variables"
+    LOCAL_VARIABLES = "local_variables"
+    MODEL_VARIABLES = "model_variables"
+    TRAINABLE_VARIABLES = "trainable_variables"
+    SUMMARIES = "summaries"
+    QUEUE_RUNNERS = "queue_runners"
+    TABLE_INITIALIZERS = "table_initializer"
+    ASSET_FILEPATHS = "asset_filepaths"
+    MOVING_AVERAGE_VARIABLES = "moving_average_variables"
+    REGULARIZATION_LOSSES = "regularization_losses"
+    CONCATENATED_VARIABLES = "concatenated_variables"
+    SAVERS = "savers"
+    WEIGHTS = "weights"
+    BIASES = "biases"
+    ACTIVATIONS = "activations"
+    UPDATE_OPS = "update_ops"
+    LOSSES = "losses"
+    SAVEABLE_OBJECTS = "saveable_objects"
+    RESOURCES = "resources"
+    LOCAL_RESOURCES = "local_resources"
+    INIT_OP = "init_op"
+    LOCAL_INIT_OP = "local_init_op"
+    READY_OP = "ready_op"
+    READY_FOR_LOCAL_INIT_OP = "ready_for_local_init_op"
+    SUMMARY_OP = "summary_op"
+    GLOBAL_STEP = "global_step"
+    EVAL_STEP = "eval_step"
+    TRAIN_OP = "train_op"
+    COND_CONTEXT = "cond_context"
+    WHILE_CONTEXT = "while_context"
+    VARIABLES = GLOBAL_VARIABLES  # deprecated alias
+
+
+class Tensor:
+    """Symbolic handle to one output of an Operation.
+
+    (ref: python/framework/ops.py:214 ``class Tensor``). Carries static dtype
+    and (possibly partial) shape. Concrete values only exist inside the
+    lowered XLA program or as Session.run results.
+    """
+
+    __slots__ = ("_op", "_value_index", "_dtype", "_shape", "__weakref__")
+
+    def __init__(self, op: "Operation", value_index: int, dtype, shape):
+        self._op = op
+        self._value_index = value_index
+        self._dtype = dtypes_mod.as_dtype(dtype)
+        self._shape = shape_mod.as_shape(shape)
+
+    @property
+    def op(self) -> "Operation":
+        return self._op
+
+    @property
+    def graph(self) -> "Graph":
+        return self._op.graph
+
+    @property
+    def value_index(self) -> int:
+        return self._value_index
+
+    @property
+    def dtype(self) -> dtypes_mod.DType:
+        return self._dtype
+
+    @property
+    def shape(self) -> shape_mod.TensorShape:
+        return self._shape
+
+    def get_shape(self) -> shape_mod.TensorShape:
+        return self._shape
+
+    def set_shape(self, shape):
+        self._shape = self._shape.merge_with(shape)
+
+    @property
+    def name(self) -> str:
+        return f"{self._op.name}:{self._value_index}"
+
+    @property
+    def device(self) -> str:
+        return self._op.device
+
+    @property
+    def ndim(self):
+        return self._shape.rank
+
+    def consumers(self) -> List["Operation"]:
+        return self.graph._consumers(self)
+
+    def eval(self, feed_dict=None, session=None):
+        from ..client.session import get_default_session
+
+        session = session or get_default_session()
+        if session is None:
+            raise ValueError(
+                "Cannot evaluate tensor using `eval()`: No default session")
+        return session.run(self, feed_dict=feed_dict)
+
+    def __repr__(self):
+        return (f"<stf.Tensor '{self.name}' shape={self._shape} "
+                f"dtype={self._dtype.name}>")
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        # Python-level identity; elementwise equality is stf.equal().
+        return self is other
+
+    def __bool__(self):
+        raise TypeError(
+            "Using a symbolic stf.Tensor as a Python bool is not allowed. "
+            "Use stf.cond / stf.where for data-dependent control flow — on "
+            "TPU the graph is compiled once by XLA and cannot branch on "
+            "tensor values in Python.")
+
+    def __iter__(self):
+        n = self._shape[0].value if self._shape.rank else None
+        if self._shape.rank is None or self._shape.rank == 0:
+            raise TypeError("Cannot iterate over a scalar/unknown-rank tensor")
+        if n is None:
+            raise TypeError("Cannot iterate over a tensor with unknown first dim")
+        return iter([self[i] for i in range(n)])
+
+    def __len__(self):
+        if self._shape.rank and self._shape[0].value is not None:
+            return self._shape[0].value
+        raise TypeError(f"len() of tensor with unknown first dim: {self}")
+
+    # NumPy interop: makes np.float32(tensor) etc. fail loudly.
+    __array_priority__ = 100
+
+    def __array__(self, *a, **k):
+        raise NotImplementedError(
+            f"Cannot convert symbolic tensor {self.name} to a numpy array: "
+            "run it in a Session first.")
+
+    # Arithmetic operators are attached by math_ops at import time
+    # (mirrors the reference's _override_helper, python/framework/ops.py:1430).
+
+
+class Operation:
+    """A node in the Graph. Immutable after construction.
+
+    (ref: python/framework/ops.py:1089 ``class Operation``,
+    core/framework/node_def.proto). ``attrs`` holds static (trace-time)
+    attributes: python scalars, shapes, dtypes, numpy constants, nested
+    FuncGraphs for control flow.
+    """
+
+    __slots__ = ("_graph", "_type", "_name", "_inputs", "_control_inputs",
+                 "_attrs", "_outputs", "_device", "_id", "__weakref__")
+
+    def __init__(self, graph, op_type, name, inputs, control_inputs, attrs,
+                 output_specs, device):
+        self._graph = graph
+        self._type = op_type
+        self._name = name
+        self._inputs: Tuple[Tensor, ...] = tuple(inputs)
+        self._control_inputs: Tuple[Operation, ...] = tuple(control_inputs)
+        self._attrs: Dict[str, Any] = dict(attrs)
+        self._device = device
+        self._id = graph._next_id()
+        self._outputs = tuple(
+            Tensor(self, i, dt, sh) for i, (sh, dt) in enumerate(output_specs))
+
+    @property
+    def graph(self):
+        return self._graph
+
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def inputs(self) -> Tuple[Tensor, ...]:
+        return self._inputs
+
+    @property
+    def control_inputs(self) -> Tuple["Operation", ...]:
+        return self._control_inputs
+
+    @property
+    def outputs(self) -> Tuple[Tensor, ...]:
+        return self._outputs
+
+    @property
+    def device(self) -> str:
+        return self._device
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        return self._attrs
+
+    def get_attr(self, name):
+        try:
+            return self._attrs[name]
+        except KeyError:
+            raise ValueError(f"Operation {self._name!r} has no attr {name!r}")
+
+    @property
+    def node_def(self):
+        return {"name": self._name, "op": self._type,
+                "input": [t.name for t in self._inputs],
+                "device": self._device}
+
+    @property
+    def op_def(self):
+        from . import op_registry
+
+        return op_registry.get(self._type)
+
+    def run(self, feed_dict=None, session=None):
+        from ..client.session import get_default_session
+
+        session = session or get_default_session()
+        if session is None:
+            raise ValueError("No default session for Operation.run()")
+        session.run(self, feed_dict=feed_dict)
+
+    def __repr__(self):
+        return f"<stf.Operation '{self._name}' type={self._type}>"
+
+
+_default_graph_stack = threading.local()
+
+
+class Graph:
+    """A dataflow graph (ref: python/framework/ops.py:2531 ``class Graph``).
+
+    Append-only: operations are never mutated or removed, so compiled
+    executables keyed on (fetches, feeds) stay valid as the graph grows.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._ops_by_name: Dict[str, Operation] = {}
+        self._ops_in_order: List[Operation] = []
+        self._version = 0
+        self._op_counter = 0
+        self._names_in_use: Dict[str, int] = {}
+        self._name_stack = ""
+        self._collections: Dict[str, list] = {}
+        self._control_deps_stack: List[List[Operation]] = []
+        self._device_stack: List[str] = []
+        self._colocation_stack: List[Operation] = []
+        self._seed: Optional[int] = None
+        self._finalized = False
+        self._consumers_map: Dict[Tensor, List[Operation]] = {}
+        self._attr_scope_stack: List[Dict[str, Any]] = []
+        self._container = ""
+        # Used by variable_scope / sharding scopes to stash arbitrary state.
+        self._scoped_state: Dict[str, Any] = {}
+
+    # -- versioning / ids ----------------------------------------------------
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def _next_id(self) -> int:
+        self._op_counter += 1
+        return self._op_counter
+
+    @property
+    def graph_def_versions(self):
+        return {"producer": 1}
+
+    def finalize(self):
+        """Make the graph read-only (ref: ops.py ``Graph.finalize``)."""
+        self._finalized = True
+
+    @property
+    def finalized(self):
+        return self._finalized
+
+    # -- naming --------------------------------------------------------------
+    def unique_name(self, name: str, mark_as_used=True) -> str:
+        if self._name_stack:
+            name = f"{self._name_stack}/{name}"
+        i = self._names_in_use.get(name, 0)
+        if mark_as_used:
+            self._names_in_use[name] = i + 1
+        if i > 0:
+            base = name
+            name = f"{base}_{i}"
+            while name in self._names_in_use:
+                i += 1
+                name = f"{base}_{i}"
+            if mark_as_used:
+                self._names_in_use[name] = 1
+        return name
+
+    @contextlib.contextmanager
+    def name_scope(self, name: Optional[str]):
+        """(ref: python/framework/ops.py ``Graph.name_scope``)."""
+        old = self._name_stack
+        if name is None or name == "":
+            self._name_stack = ""
+        elif name.endswith("/"):
+            self._name_stack = name[:-1]
+        else:
+            self._name_stack = self.unique_name(name)
+        try:
+            yield (self._name_stack + "/") if self._name_stack else ""
+        finally:
+            self._name_stack = old
+
+    # -- scopes --------------------------------------------------------------
+    @contextlib.contextmanager
+    def control_dependencies(self, control_inputs):
+        if control_inputs is None:
+            saved = self._control_deps_stack
+            self._control_deps_stack = []
+            try:
+                yield
+            finally:
+                self._control_deps_stack = saved
+            return
+        ops = []
+        for c in control_inputs:
+            if isinstance(c, Tensor):
+                ops.append(c.op)
+            elif isinstance(c, Operation):
+                ops.append(c)
+            elif hasattr(c, "op"):  # Variable
+                ops.append(c.op)
+            else:
+                raise TypeError(f"control input must be Operation/Tensor, got {c!r}")
+        self._control_deps_stack.append(ops)
+        try:
+            yield
+        finally:
+            self._control_deps_stack.pop()
+
+    def _current_control_dependencies(self) -> List[Operation]:
+        out = []
+        for frame in self._control_deps_stack:
+            for op in frame:
+                if op not in out:
+                    out.append(op)
+        return out
+
+    @contextlib.contextmanager
+    def device(self, device_name: Optional[str]):
+        """Device scope. On TPU this is a *placement hint*: '/cpu:0' marks
+        host ops (data pipeline endpoints); TPU placement within the XLA
+        program is controlled by shardings, not device strings
+        (ref: core/common_runtime/simple_placer.cc is replaced by
+        stf/parallel sharding annotations)."""
+        self._device_stack.append(device_name or "")
+        try:
+            yield
+        finally:
+            self._device_stack.pop()
+
+    def _current_device(self) -> str:
+        for d in reversed(self._device_stack):
+            if d:
+                return d
+        return ""
+
+    @contextlib.contextmanager
+    def colocate_with(self, op, ignore_existing=False):
+        if isinstance(op, Tensor):
+            op = op.op
+        self._colocation_stack.append(op)
+        try:
+            yield
+        finally:
+            self._colocation_stack.pop()
+
+    @contextlib.contextmanager
+    def container(self, container_name):
+        old = self._container
+        self._container = container_name
+        try:
+            yield self._container
+        finally:
+            self._container = old
+
+    # -- seeds ---------------------------------------------------------------
+    @property
+    def seed(self):
+        return self._seed
+
+    @seed.setter
+    def seed(self, value):
+        self._seed = value
+
+    # -- collections ---------------------------------------------------------
+    def add_to_collection(self, name, value):
+        with self._lock:
+            self._collections.setdefault(name, []).append(value)
+
+    def add_to_collections(self, names, value):
+        if isinstance(names, str):
+            names = [names]
+        for n in names:
+            self.add_to_collection(n, value)
+
+    def get_collection(self, name, scope=None) -> list:
+        with self._lock:
+            items = list(self._collections.get(name, []))
+        if scope is None:
+            return items
+        import re
+
+        rx = re.compile(scope)
+        out = []
+        for item in items:
+            item_name = getattr(item, "name", None)
+            if item_name and rx.match(item_name):
+                out.append(item)
+        return out
+
+    def get_collection_ref(self, name) -> list:
+        with self._lock:
+            return self._collections.setdefault(name, [])
+
+    def clear_collection(self, name):
+        with self._lock:
+            self._collections.pop(name, None)
+
+    def get_all_collection_keys(self):
+        with self._lock:
+            return list(self._collections.keys())
+
+    # -- op construction -----------------------------------------------------
+    def create_op(self, op_type: str, inputs: Sequence[Tensor],
+                  attrs: Optional[Dict[str, Any]] = None,
+                  name: Optional[str] = None,
+                  output_specs=None,
+                  control_inputs: Sequence[Operation] = ()) -> Operation:
+        """Create and register an Operation.
+
+        ``output_specs``: optional list of (shape, dtype); if None, the op
+        registry's inference runs (ref shape_refiner,
+        core/common_runtime/shape_refiner.cc).
+        """
+        from . import op_registry
+
+        if self._finalized:
+            raise RuntimeError("Graph is finalized and cannot be modified.")
+        attrs = attrs or {}
+        if name and name.endswith("/"):
+            # TF convention: a trailing slash means "use this exact
+            # (already-scoped, already-unique) name" — used by Variable and
+            # variable_scope (ref: python/framework/ops.py Graph.create_op).
+            name = name[:-1]
+            if name in self._ops_by_name:
+                raise ValueError(f"Op name {name!r} already used")
+        else:
+            name = self.unique_name(name or op_type)
+        opdef = op_registry.get(op_type)
+        checked = []
+        for i, t in enumerate(inputs):
+            if not isinstance(t, Tensor):
+                raise TypeError(
+                    f"Input {i} of op {name!r} ({op_type}) is not a Tensor: {t!r}")
+            checked.append(self._maybe_capture(t, name))
+        inputs = tuple(checked)
+        if output_specs is None:
+            output_specs = opdef.infer(self, attrs, inputs)
+        ctrl = list(control_inputs) + [
+            c for c in self._current_control_dependencies()
+            if c not in control_inputs]
+        device = self._current_device()
+        if opdef.runs_on_host:
+            device = device or "/cpu:0"
+        op = Operation(self, op_type, name, inputs, ctrl, attrs,
+                       output_specs, device)
+        with self._lock:
+            self._ops_by_name[name] = op
+            self._ops_in_order.append(op)
+            self._version += 1
+            for t in inputs:
+                self._consumers_map.setdefault(t, []).append(op)
+        return op
+
+    def _maybe_capture(self, t: "Tensor", for_op: str) -> "Tensor":
+        """Same-graph tensors pass through; in a FuncGraph, outer-graph
+        tensors are captured as implicit inputs (TF-2 FuncGraph semantics —
+        the XLA-friendly replacement for the reference's Enter/Exit frame
+        nodes, ref core/graph/graph.h NodeClass::ENTER)."""
+        if t.graph is self:
+            return t
+        if isinstance(self, FuncGraph):
+            og = self.outer_graph
+            if t.graph is og:
+                return self.capture(t)
+            captured_outer = og._maybe_capture(t, for_op)
+            return self.capture(captured_outer)
+        raise ValueError(
+            f"Input {t.name} of {for_op!r} is from a different graph.")
+
+    # -- lookup --------------------------------------------------------------
+    def get_operations(self) -> List[Operation]:
+        with self._lock:
+            return list(self._ops_in_order)
+
+    def get_operation_by_name(self, name: str) -> Operation:
+        with self._lock:
+            if name not in self._ops_by_name:
+                raise KeyError(f"Operation {name!r} not found in graph")
+            return self._ops_by_name[name]
+
+    def get_tensor_by_name(self, name: str) -> Tensor:
+        if ":" not in name:
+            raise ValueError(
+                f"{name!r} is an operation name, not a tensor name "
+                "(tensor names look like 'op:0')")
+        op_name, idx = name.rsplit(":", 1)
+        op = self.get_operation_by_name(op_name)
+        return op.outputs[int(idx)]
+
+    def as_graph_element(self, obj, allow_tensor=True, allow_operation=True):
+        """(ref: ops.py ``Graph.as_graph_element``)."""
+        if isinstance(obj, Tensor):
+            if not allow_tensor:
+                raise TypeError("Tensor not allowed here")
+            if obj.graph is not self:
+                raise ValueError(f"Tensor {obj} is not from this graph")
+            return obj
+        if isinstance(obj, Operation):
+            if not allow_operation:
+                raise TypeError("Operation not allowed here")
+            if obj.graph is not self:
+                raise ValueError(f"Operation {obj} is not from this graph")
+            return obj
+        if hasattr(obj, "_as_graph_element"):
+            return self.as_graph_element(obj._as_graph_element(),
+                                         allow_tensor, allow_operation)
+        if isinstance(obj, str):
+            if ":" in obj:
+                return self.get_tensor_by_name(obj)
+            return self.get_operation_by_name(obj)
+        raise TypeError(f"Cannot convert {obj!r} to a graph element")
+
+    def _consumers(self, tensor: Tensor) -> List[Operation]:
+        with self._lock:
+            return list(self._consumers_map.get(tensor, []))
+
+    # -- default-graph stack -------------------------------------------------
+    @contextlib.contextmanager
+    def as_default(self):
+        stack = _get_graph_stack()
+        stack.append(self)
+        try:
+            yield self
+        finally:
+            stack.pop()
+
+    # -- serialization (see graph_io.py) -------------------------------------
+    def as_graph_def(self, from_version=None):
+        from . import graph_io
+
+        return graph_io.graph_to_graphdef(self, from_version=from_version)
+
+    def __repr__(self):
+        return f"<stf.Graph with {len(self._ops_in_order)} ops>"
+
+
+class FuncGraph(Graph):
+    """A nested graph with captures, used for cond/while/function bodies.
+
+    TPU-first: the reference expresses control flow with dynamic
+    Switch/Merge/Enter/Exit nodes executed by the interpreter loop
+    (ref: python/ops/control_flow_ops.py); XLA wants structured control flow,
+    so branch/body subgraphs are FuncGraphs that lower to lax.cond /
+    lax.while_loop / lax.scan. Outer-graph tensors referenced inside are
+    captured as implicit inputs (like TF-2 FuncGraph).
+    """
+
+    def __init__(self, name: str, outer_graph: Graph):
+        super().__init__()
+        self.func_name = name
+        self.outer_graph = outer_graph
+        self.captures: List[Tuple[Tensor, Tensor]] = []  # (outer, inner placeholder)
+        self.inputs: List[Tensor] = []
+        self.outputs: List[Tensor] = []
+        self._seed = outer_graph.seed
+
+    def capture(self, outer_tensor: Tensor) -> Tensor:
+        for ext, internal in self.captures:
+            if ext is outer_tensor:
+                return internal
+        ph_op = self.create_op(
+            "CapturedInput", [],
+            attrs={"dtype": outer_tensor.dtype, "shape": outer_tensor.shape},
+            name=f"captured_{len(self.captures)}",
+            output_specs=[(outer_tensor.shape, outer_tensor.dtype)])
+        internal = ph_op.outputs[0]
+        self.captures.append((outer_tensor, internal))
+        return internal
+
+    def add_input(self, dtype, shape, name="arg") -> Tensor:
+        op = self.create_op("FuncArg", [],
+                            attrs={"dtype": dtypes_mod.as_dtype(dtype),
+                                   "shape": shape_mod.as_shape(shape),
+                                   "index": len(self.inputs)},
+                            name=name,
+                            output_specs=[(shape, dtype)])
+        t = op.outputs[0]
+        self.inputs.append(t)
+        return t
+
+
+def _get_graph_stack() -> List[Graph]:
+    if not hasattr(_default_graph_stack, "stack"):
+        _default_graph_stack.stack = []
+    return _default_graph_stack.stack
+
+
+_global_default_graph: Optional[Graph] = None
+_global_lock = threading.Lock()
+
+
+def _root_graph() -> "Graph":
+    """The outermost (non-FuncGraph) default graph. Variables always live
+    here — a variable created while tracing a cond/while/scan body belongs to
+    the main graph and is auto-captured into the body (the reference hoists
+    variables out of while frames the same way, ref
+    python/ops/variable_scope.py get_variable + control_flow context)."""
+    g = get_default_graph()
+    while isinstance(g, FuncGraph):
+        g = g.outer_graph
+    return g
+
+
+def get_default_graph() -> Graph:
+    stack = _get_graph_stack()
+    if stack:
+        return stack[-1]
+    global _global_default_graph
+    with _global_lock:
+        if _global_default_graph is None:
+            _global_default_graph = Graph()
+        return _global_default_graph
+
+
+def reset_default_graph():
+    global _global_default_graph
+    if _get_graph_stack():
+        raise AssertionError(
+            "Do not use reset_default_graph() inside a `with g.as_default()` block.")
+    with _global_lock:
+        _global_default_graph = Graph()
+
+
+@contextlib.contextmanager
+def name_scope(name, default_name=None, values=None):
+    """Module-level name_scope (ref: ops.py:4164 ``name_scope``)."""
+    g = get_default_graph()
+    if values:
+        for v in values:
+            if isinstance(v, Tensor) and isinstance(v.graph, FuncGraph):
+                g = v.graph
+                break
+    scope_name = name if name is not None else default_name
+    with g.name_scope(scope_name) as scope:
+        yield scope
+
+
+@contextlib.contextmanager
+def control_dependencies(control_inputs):
+    with get_default_graph().control_dependencies(control_inputs):
+        yield
+
+
+@contextlib.contextmanager
+def device(device_name):
+    # Accept strings, context managers (replica_device_setter), and device
+    # functions (legacy); non-strings are sharding-driven on TPU.
+    if hasattr(device_name, "__enter__"):
+        with device_name:
+            yield
+    elif callable(device_name) and not isinstance(device_name, str):
+        yield
+    else:
+        with get_default_graph().device(device_name):
+            yield
+
+
+@contextlib.contextmanager
+def colocate_with(op, ignore_existing=False):
+    with get_default_graph().colocate_with(op, ignore_existing):
+        yield
+
+
+@contextlib.contextmanager
+def container(container_name):
+    with get_default_graph().container(container_name):
+        yield
+
+
+def add_to_collection(name, value):
+    get_default_graph().add_to_collection(name, value)
+
+
+def add_to_collections(names, value):
+    get_default_graph().add_to_collections(names, value)
+
+
+def get_collection(name, scope=None):
+    return get_default_graph().get_collection(name, scope)
+
+
+def get_collection_ref(name):
+    return get_default_graph().get_collection_ref(name)
+
+
+# -- convert_to_tensor machinery ---------------------------------------------
+
+_tensor_conversion_funcs: List[Tuple[int, type, Callable]] = []
+
+
+def register_tensor_conversion_function(base_type, conversion_func, priority=100):
+    """(ref: ops.py ``register_tensor_conversion_function``)."""
+    _tensor_conversion_funcs.append((priority, base_type, conversion_func))
+    _tensor_conversion_funcs.sort(key=lambda x: x[0])
+
+
+def convert_to_tensor(value, dtype=None, name=None, preferred_dtype=None):
+    """Convert python/numpy values (and Variables etc.) to graph Tensors.
+
+    (ref: ops.py:836 ``convert_to_tensor``). Inside a FuncGraph, outer-graph
+    tensors are captured automatically.
+    """
+    g = get_default_graph()
+    if isinstance(value, Tensor):
+        if dtype is not None and not dtypes_mod.as_dtype(dtype).is_compatible_with(value.dtype):
+            from ..ops import math_ops
+
+            return math_ops.cast(value, dtype)
+        if value.graph is g:
+            return value
+        if isinstance(g, FuncGraph):
+            # Capture chain: value may be several graphs out.
+            outer = value
+            if g.outer_graph is not value.graph and isinstance(g.outer_graph, FuncGraph):
+                with _as_current(g.outer_graph):
+                    outer = convert_to_tensor(value)
+            return g.capture(outer)
+        raise ValueError(
+            f"Tensor {value.name} belongs to a different graph.")
+    for _, base_type, func in _tensor_conversion_funcs:
+        if isinstance(value, base_type):
+            ret = func(value, dtype=dtype, name=name)
+            if ret is not NotImplemented:
+                return convert_to_tensor(ret, dtype=dtype, name=name)
+    from . import constant_op
+
+    return constant_op.constant(value, dtype=dtype, name=name or "Const")
+
+
+@contextlib.contextmanager
+def _as_current(graph):
+    stack = _get_graph_stack()
+    stack.append(graph)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def convert_n_to_tensor(values, dtype=None, name=None):
+    return [convert_to_tensor(v, dtype=dtype, name=name) for v in values]
+
+
+def convert_to_tensor_or_indexed_slices(value, dtype=None, name=None):
+    from .indexed_slices import IndexedSlices
+
+    if isinstance(value, IndexedSlices):
+        return value
+    return convert_to_tensor(value, dtype=dtype, name=name)
+
+
+def is_symbolic_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+class TensorSpec:
+    """Static (shape, dtype, name) spec — used by function signatures and
+    SavedModel signature_defs."""
+
+    __slots__ = ("shape", "dtype", "name")
+
+    def __init__(self, shape=None, dtype=dtypes_mod.float32, name=None):
+        self.shape = shape_mod.as_shape(shape)
+        self.dtype = dtypes_mod.as_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t: Tensor, name=None):
+        return cls(t.shape, t.dtype, name or t.name)
+
+    def is_compatible_with(self, other):
+        return (self.dtype == other.dtype and
+                self.shape.is_compatible_with(other.shape))
+
+    def __repr__(self):
+        return f"TensorSpec(shape={self.shape}, dtype={self.dtype.name}, name={self.name!r})"

@@ -1,0 +1,56 @@
+"""IndexedSlices: sparse gradient representation for embedding lookups.
+
+(ref: tensorflow/python/framework/ops.py ``class IndexedSlices``). On TPU,
+XLA scatters are efficient and fuse into the update, so IndexedSlices is a
+thin (values, indices, dense_shape) triple that optimizers can apply via
+scatter-add instead of densifying — same contract as the reference.
+"""
+
+from __future__ import annotations
+
+
+class IndexedSlices:
+    def __init__(self, values, indices, dense_shape=None):
+        self._values = values
+        self._indices = indices
+        self._dense_shape = dense_shape
+
+    @property
+    def values(self):
+        return self._values
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def dense_shape(self):
+        return self._dense_shape
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def name(self):
+        return self._values.name
+
+    @property
+    def op(self):
+        return self._values.op
+
+    @property
+    def graph(self):
+        return self._values.graph
+
+    def __repr__(self):
+        return (f"IndexedSlices(values={self._values!r}, "
+                f"indices={self._indices!r})")
+
+
+def convert_to_tensor_or_indexed_slices(value, dtype=None, name=None):
+    from . import graph as ops_mod
+
+    if isinstance(value, IndexedSlices):
+        return value
+    return ops_mod.convert_to_tensor(value, dtype=dtype, name=name)

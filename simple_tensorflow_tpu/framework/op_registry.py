@@ -1,0 +1,173 @@
+"""Op registry: op definitions, lowering rules, shape/dtype inference.
+
+TPU-native replacement for the reference's OpDef/OpRegistry + kernel registry
+(ref: tensorflow/core/framework/op.cc ``OpRegistry``,
+tensorflow/core/framework/op_kernel.cc, tensorflow/core/ops/ops.pbtxt).
+
+Key difference from the reference: an op does not register a *kernel* per
+device — it registers a **lowering rule** that emits jax/lax (and hence XLA)
+when the pruned subgraph is traced. Shape inference comes nearly for free:
+for pure ops we run ``jax.eval_shape`` on the lowering itself, so inference
+can never disagree with execution (the reference maintains ~800 separate C++
+shape functions, core/framework/common_shape_fns.cc, which can drift).
+
+Partial static shapes are inferred by a two-trial probe: unknown dims are
+substituted with two different primes; output dims that differ between trials
+are unknown. This is advisory only — Session.run re-lowers with the concrete
+feed shapes, where everything is static (as XLA requires).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import dtypes as dtypes_mod
+from . import tensor_shape as shape_mod
+
+# Substitution primes for the two-trial partial-shape probe.
+_PROBE_A = 13
+_PROBE_B = 17
+
+
+class OpDef:
+    """Definition of one op type.
+
+    Attributes:
+      name: op type string (e.g. "MatMul").
+      lower: fn(ctx, op, input_values) -> list of output jax values. For pure
+        ops this is synthesized from ``pure_fn``.
+      pure_fn: fn(*input_values, **attrs) -> value or tuple — stateless ops.
+      infer_fn: optional fn(graph, attrs, input_tensors)
+        -> [(TensorShape, DType)]; overrides generic inference.
+      is_stateful: op has effects (variable read/write, RNG, IO); never CSE'd
+        or constant-folded, always kept in topo order.
+      runs_on_host: executes in the host (python) stage, not in the XLA
+        program (queues, readers, py_func side).
+      n_outputs: static output count (or None -> from infer).
+    """
+
+    __slots__ = ("name", "lower", "pure_fn", "infer_fn", "is_stateful",
+                 "runs_on_host", "n_outputs", "attr_keys_in_sig")
+
+    def __init__(self, name, lower=None, pure_fn=None, infer_fn=None,
+                 is_stateful=False, runs_on_host=False, n_outputs=1):
+        self.name = name
+        self.pure_fn = pure_fn
+        self.infer_fn = infer_fn
+        self.is_stateful = is_stateful
+        self.runs_on_host = runs_on_host
+        self.n_outputs = n_outputs
+        if lower is None:
+            if pure_fn is None:
+                raise ValueError(f"Op {name}: need lower or pure_fn")
+            lower = self._lower_from_pure
+        self.lower = lower
+
+    def _lower_from_pure(self, ctx, op, input_values):
+        attrs = {k: v for k, v in op.attrs.items() if not k.startswith("_")}
+        out = self.pure_fn(*input_values, **attrs)
+        if isinstance(out, (list, tuple)):
+            return list(out)
+        return [out]
+
+    # -- inference -----------------------------------------------------------
+    def infer(self, graph, attrs, input_tensors) -> List[Tuple[Any, Any]]:
+        if self.infer_fn is not None:
+            return self.infer_fn(graph, attrs, input_tensors)
+        if self.pure_fn is None:
+            raise ValueError(
+                f"Op {self.name} is stateful and must pass output_specs or infer_fn")
+        return _generic_infer(self.pure_fn, attrs, input_tensors, self.name)
+
+
+def _spec_with_subst(t, subst: int):
+    """ShapeDtypeStruct for tensor t with unknown dims replaced by ``subst``."""
+    import jax
+
+    sh = t.shape
+    if sh.rank is None:
+        dims = (subst,)  # rank unknown: pretend 1-D; probe will mostly fail -> unknown
+    else:
+        dims = tuple(subst if d.value is None else d.value for d in sh.dims)
+    return jax.ShapeDtypeStruct(dims, t.dtype.np_dtype)
+
+
+def _generic_infer(pure_fn, attrs, input_tensors, op_name):
+    import jax
+
+    unknown_rank = any(t.shape.rank is None for t in input_tensors)
+    fully = all(t.shape.is_fully_defined() for t in input_tensors)
+    fn = functools.partial(pure_fn, **{k: v for k, v in attrs.items()
+                                       if not k.startswith("_")})
+
+    def run(subst):
+        specs = [_spec_with_subst(t, subst) for t in input_tensors]
+        return jax.eval_shape(fn, *specs)
+
+    try:
+        out_a = run(_PROBE_A)
+        outs_a = out_a if isinstance(out_a, (list, tuple)) else [out_a]
+        if fully and not unknown_rank:
+            return [(shape_mod.TensorShape(list(o.shape)),
+                     dtypes_mod.as_dtype(o.dtype)) for o in outs_a]
+        out_b = run(_PROBE_B)
+        outs_b = out_b if isinstance(out_b, (list, tuple)) else [out_b]
+        specs = []
+        for oa, ob in zip(outs_a, outs_b):
+            if unknown_rank or len(oa.shape) != len(ob.shape):
+                specs.append((shape_mod.TensorShape(None),
+                              dtypes_mod.as_dtype(oa.dtype)))
+            else:
+                dims = [da if da == db else None
+                        for da, db in zip(oa.shape, ob.shape)]
+                specs.append((shape_mod.TensorShape(dims),
+                              dtypes_mod.as_dtype(oa.dtype)))
+        return specs
+    except Exception:
+        # Probe failed (shape-sensitive op with partial inputs): dtype from
+        # attrs or inputs, shape unknown. Session re-infers concretely at run.
+        dt = attrs.get("dtype")
+        if dt is None and input_tensors:
+            dt = input_tensors[0].dtype
+        if dt is None:
+            dt = dtypes_mod.float32
+        return [(shape_mod.TensorShape(None), dtypes_mod.as_dtype(dt))]
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(name, lower=None, pure_fn=None, infer_fn=None, is_stateful=False,
+             runs_on_host=False, n_outputs=1):
+    if name in _REGISTRY:
+        raise ValueError(f"Op {name} already registered")
+    od = OpDef(name, lower=lower, pure_fn=pure_fn, infer_fn=infer_fn,
+               is_stateful=is_stateful, runs_on_host=runs_on_host,
+               n_outputs=n_outputs)
+    _REGISTRY[name] = od
+    return od
+
+
+def register_pure(name, pure_fn, **kw):
+    """Register a stateless op whose lowering is a jax function of
+    (*input_values, **attrs)."""
+    return register(name, pure_fn=pure_fn, **kw)
+
+
+def get(name) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"Op type {name!r} is not registered "
+                       f"({len(_REGISTRY)} ops known)")
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def is_registered(name) -> bool:
+    return name in _REGISTRY

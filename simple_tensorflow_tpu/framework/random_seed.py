@@ -1,0 +1,53 @@
+"""Graph/op seed plumbing → deterministic JAX PRNG key derivation.
+
+(ref: tensorflow/python/framework/random_seed.py). The reference combines
+graph-level and op-level seeds into the kernel's Philox state. Here the same
+two-level API derives *fold-in values* for a functional PRNG: every session
+step has a root key (advanced once per Session.run), and each random op folds
+in a stable per-op value, so:
+
+- two random ops in one step draw independent streams,
+- the same op re-lowered (e.g. inside jax.vjp forward replay) reuses the SAME
+  stream — dropout masks agree between forward and backward, and XLA CSEs the
+  replayed subgraph,
+- with op_seed set, the op's stream is reproducible across runs regardless of
+  graph construction order (TF-1.0 parity).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from . import graph as ops
+
+DEFAULT_GRAPH_SEED = 87654321
+
+
+def get_seed(op_seed):
+    """Return (graph_seed, op_seed) like the reference
+    (ref: python/framework/random_seed.py:27 ``get_seed``)."""
+    g = ops.get_default_graph()
+    graph_seed = g.seed
+    if graph_seed is not None:
+        if op_seed is None:
+            op_seed = g._op_counter
+        return graph_seed, op_seed
+    if op_seed is not None:
+        return DEFAULT_GRAPH_SEED, op_seed
+    return None, None
+
+
+def set_random_seed(seed):
+    """(ref: random_seed.py:75 ``set_random_seed``)."""
+    ops.get_default_graph().seed = seed
+
+
+def fold_in_value(op) -> int:
+    """Stable 32-bit stream id for a random op, from its seeds or its name."""
+    graph_seed = op.attrs.get("_graph_seed")
+    op_seed = op.attrs.get("seed")
+    if graph_seed is not None or op_seed is not None:
+        return ((graph_seed or 0) * 1000003 + (op_seed or 0)) & 0x7FFFFFFF
+    # Unseeded: derive from the op name — stable across re-lowerings within
+    # this graph, varies between distinct ops.
+    return zlib.crc32(op.name.encode()) & 0x7FFFFFFF

@@ -1,0 +1,104 @@
+"""SparseTensor (ref: tensorflow/python/framework/sparse_tensor.py).
+
+COO triple (indices, values, dense_shape). On TPU all shapes are static, so
+a SparseTensor here is a fixed-capacity COO: ``nnz`` is the static leading
+dim of indices/values (padding rows carry index -1 and are masked out by the
+sparse ops). This is the tf2xla-compatible subset of the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import dtypes as dtypes_mod
+from . import graph as ops_mod
+from . import tensor_shape as shape_mod
+
+
+class SparseTensor:
+    def __init__(self, indices, values, dense_shape):
+        self._indices = ops_mod.convert_to_tensor(indices,
+                                                  dtype=dtypes_mod.int64)
+        self._values = ops_mod.convert_to_tensor(values)
+        self._dense_shape = ops_mod.convert_to_tensor(dense_shape,
+                                                      dtype=dtypes_mod.int64)
+
+    @classmethod
+    def from_value(cls, value):
+        if isinstance(value, SparseTensor):
+            return value
+        if isinstance(value, SparseTensorValue):
+            return cls(value.indices, value.values, value.dense_shape)
+        raise TypeError(f"Cannot convert {value!r} to SparseTensor")
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def values(self):
+        return self._values
+
+    @property
+    def dense_shape(self):
+        return self._dense_shape
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def shape(self):
+        from . import constant_op
+
+        v = constant_op.constant_value(self._dense_shape)
+        if v is None:
+            return shape_mod.TensorShape(None)
+        return shape_mod.TensorShape([int(d) for d in v])
+
+    def get_shape(self):
+        return self.shape
+
+    @property
+    def graph(self):
+        return self._values.graph
+
+    @property
+    def op(self):
+        return self._values.op
+
+    def eval(self, feed_dict=None, session=None):
+        from ..client.session import get_default_session
+
+        session = session or get_default_session()
+        i, v, s = session.run([self._indices, self._values, self._dense_shape],
+                              feed_dict=feed_dict)
+        return SparseTensorValue(i, v, s)
+
+    def __repr__(self):
+        return (f"SparseTensor(indices={self._indices!r}, "
+                f"values={self._values!r}, dense_shape={self._dense_shape!r})")
+
+
+class SparseTensorValue:
+    """Concrete counterpart returned by Session.run."""
+
+    __slots__ = ("indices", "values", "dense_shape")
+
+    def __init__(self, indices, values, dense_shape):
+        self.indices = np.asarray(indices)
+        self.values = np.asarray(values)
+        self.dense_shape = np.asarray(dense_shape)
+
+    def __iter__(self):
+        return iter((self.indices, self.values, self.dense_shape))
+
+    def __repr__(self):
+        return (f"SparseTensorValue(indices={self.indices!r}, "
+                f"values={self.values!r}, dense_shape={self.dense_shape!r})")
+
+
+def convert_to_tensor_or_sparse_tensor(value, dtype=None, name=None):
+    if isinstance(value, (SparseTensor, SparseTensorValue)):
+        return SparseTensor.from_value(value)
+    return ops_mod.convert_to_tensor(value, dtype=dtype, name=name)

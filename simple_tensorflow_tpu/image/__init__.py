@@ -1,0 +1,13 @@
+"""stf.image namespace (ref: tensorflow/python/ops/image_ops.py)."""
+
+from ..ops.image_ops import (
+    ResizeMethod, resize_images, resize_bilinear, resize_nearest_neighbor,
+    resize_image_with_crop_or_pad, rgb_to_grayscale, grayscale_to_rgb,
+    rgb_to_hsv, hsv_to_rgb, adjust_brightness, adjust_contrast, adjust_hue,
+    adjust_saturation, adjust_gamma, per_image_standardization,
+    flip_left_right, flip_up_down, rot90, transpose_image,
+    random_flip_left_right, random_flip_up_down, random_brightness,
+    random_contrast, crop_to_bounding_box, pad_to_bounding_box, central_crop,
+    convert_image_dtype, decode_png, encode_png, decode_jpeg, decode_image,
+    random_crop, total_variation,
+)

@@ -1,0 +1,15 @@
+"""stf.layers (ref: tensorflow/python/layers)."""
+
+from .base import Layer
+from .core import Dense, Dropout, Flatten, dense, dropout, flatten
+from .convolutional import (
+    Conv1D, Conv2D, Conv3D, Conv2DTranspose, SeparableConv2D,
+    conv1d, conv2d, conv3d, conv2d_transpose, separable_conv2d,
+)
+from .pooling import (
+    MaxPooling1D, MaxPooling2D, MaxPooling3D,
+    AveragePooling1D, AveragePooling2D, AveragePooling3D,
+    max_pooling1d, max_pooling2d, max_pooling3d,
+    average_pooling1d, average_pooling2d, average_pooling3d,
+)
+from .normalization import BatchNormalization, batch_normalization

@@ -1,0 +1,112 @@
+"""Layer base class (ref: tensorflow/python/layers/base.py)."""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..ops import variable_scope as vs
+
+GraphKeys = ops_mod.GraphKeys
+
+
+class Layer:
+    """(ref: base.py:64 ``class Layer``). Variables are created through
+    get_variable under the layer's scope; calling is graph building."""
+
+    def __init__(self, trainable=True, name=None, dtype=None, **kwargs):
+        self.trainable = trainable
+        self._name = name or self.__class__.__name__.lower()
+        self.dtype = dtype
+        self.built = False
+        self._trainable_weights = []
+        self._non_trainable_weights = []
+        self._updates = []
+        self._losses = []
+        self._scope_name = None
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def trainable_weights(self):
+        return list(self._trainable_weights)
+
+    @property
+    def non_trainable_weights(self):
+        return list(self._non_trainable_weights)
+
+    @property
+    def weights(self):
+        return self.trainable_weights + self.non_trainable_weights
+
+    variables = weights
+
+    @property
+    def trainable_variables(self):
+        return self.trainable_weights
+
+    @property
+    def updates(self):
+        return list(self._updates)
+
+    @property
+    def losses(self):
+        return list(self._losses)
+
+    def add_variable(self, name, shape, dtype=None, initializer=None,
+                     regularizer=None, trainable=True, constraint=None):
+        v = vs.get_variable(name, shape=shape, dtype=dtype or self.dtype,
+                            initializer=initializer, regularizer=regularizer,
+                            trainable=trainable and self.trainable,
+                            constraint=constraint)
+        if trainable and self.trainable:
+            self._trainable_weights.append(v)
+        else:
+            self._non_trainable_weights.append(v)
+        return v
+
+    add_weight = add_variable
+
+    def add_update(self, updates):
+        if not isinstance(updates, (list, tuple)):
+            updates = [updates]
+        self._updates.extend(updates)
+        g = ops_mod.get_default_graph()
+        for u in updates:
+            g.add_to_collection(GraphKeys.UPDATE_OPS, u)
+
+    def add_loss(self, losses):
+        if not isinstance(losses, (list, tuple)):
+            losses = [losses]
+        self._losses.extend(losses)
+        g = ops_mod.get_default_graph()
+        for l in losses:
+            g.add_to_collection(GraphKeys.REGULARIZATION_LOSSES, l)
+
+    def build(self, input_shape):
+        self.built = True
+
+    def call(self, inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, inputs, *args, **kwargs):
+        with vs.variable_scope(self._name, reuse=vs.AUTO_REUSE) as scope:
+            self._scope_name = scope.name
+            if not self.built:
+                t = (inputs[0] if isinstance(inputs, (list, tuple))
+                     else inputs)
+                if self.dtype is None:
+                    self.dtype = t.dtype.base_dtype
+                self.build(t.shape)
+            return self.call(inputs, *args, **kwargs)
+
+    def apply(self, inputs, *args, **kwargs):
+        return self.__call__(inputs, *args, **kwargs)
+
+
+class InputSpec:
+    def __init__(self, dtype=None, shape=None, ndim=None, max_ndim=None,
+                 min_ndim=None, axes=None):
+        self.dtype = dtype
+        self.shape = shape
+        self.ndim = ndim

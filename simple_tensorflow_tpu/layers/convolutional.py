@@ -1,0 +1,203 @@
+"""Conv layers (ref: tensorflow/python/layers/convolutional.py).
+
+NHWC is the TPU-preferred layout ("channels_last"); channels_first inputs
+are accepted and transposed once at the boundary.
+"""
+
+from __future__ import annotations
+
+from ..ops import array_ops, init_ops, nn_ops
+from .base import Layer
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+class _Conv(Layer):
+    def __init__(self, rank, filters, kernel_size, strides=1, padding="valid",
+                 data_format="channels_last", dilation_rate=1, activation=None,
+                 use_bias=True, kernel_initializer=None, bias_initializer=None,
+                 kernel_regularizer=None, bias_regularizer=None,
+                 activity_regularizer=None, trainable=True, name=None,
+                 **kwargs):
+        super().__init__(trainable=trainable, name=name, **kwargs)
+        self.rank = rank
+        self.filters = int(filters)
+        self.kernel_size = _norm_tuple(kernel_size, rank)
+        self.strides = _norm_tuple(strides, rank)
+        self.padding = padding.upper()
+        self.data_format = data_format
+        self.dilation_rate = _norm_tuple(dilation_rate, rank)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer or init_ops.Zeros()
+        self.kernel_regularizer = kernel_regularizer
+        self.bias_regularizer = bias_regularizer
+
+    def build(self, input_shape):
+        ch_axis = -1 if self.data_format == "channels_last" else 1
+        in_ch = input_shape[ch_axis].value
+        kernel_shape = list(self.kernel_size) + [in_ch, self.filters]
+        self.kernel = self.add_variable("kernel", kernel_shape,
+                                        initializer=self.kernel_initializer,
+                                        regularizer=self.kernel_regularizer)
+        if self.use_bias:
+            self.bias = self.add_variable("bias", [self.filters],
+                                          initializer=self.bias_initializer,
+                                          regularizer=self.bias_regularizer)
+        self.built = True
+
+    def call(self, inputs):
+        df = "NHWC" if self.data_format == "channels_last" else "NCHW"
+        if self.rank == 2:
+            out = nn_ops.conv2d(
+                inputs, self.kernel._ref,
+                strides=[1] + list(self.strides) + [1] if df == "NHWC"
+                else [1, 1] + list(self.strides),
+                padding=self.padding, data_format=df,
+                dilations=[1] + list(self.dilation_rate) + [1] if df == "NHWC"
+                else [1, 1] + list(self.dilation_rate))
+        elif self.rank == 1:
+            x = array_ops.expand_dims(inputs, 1)
+            k = array_ops.expand_dims(self.kernel._ref, 0)
+            out = nn_ops.conv2d(x, k,
+                                strides=[1, 1, self.strides[0], 1],
+                                padding=self.padding)
+            out = array_ops.squeeze(out, 1)
+        else:
+            out = nn_ops.conv3d(inputs, self.kernel._ref,
+                                strides=[1] + list(self.strides) + [1],
+                                padding=self.padding)
+        if self.use_bias:
+            out = nn_ops.bias_add(out, self.bias._ref, data_format=df)
+        if self.activation is not None:
+            out = self.activation(out)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, filters, kernel_size, **kwargs):
+        super().__init__(1, filters, kernel_size,
+                         name=kwargs.pop("name", "conv1d"), **kwargs)
+
+
+class Conv2D(_Conv):
+    """(ref: convolutional.py:335 ``class Conv2D``)."""
+
+    def __init__(self, filters, kernel_size, **kwargs):
+        super().__init__(2, filters, kernel_size,
+                         name=kwargs.pop("name", "conv2d"), **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, filters, kernel_size, **kwargs):
+        super().__init__(3, filters, kernel_size,
+                         name=kwargs.pop("name", "conv3d"), **kwargs)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, filters, kernel_size, strides=(1, 1), padding="valid",
+                 data_format="channels_last", activation=None, use_bias=True,
+                 kernel_initializer=None, bias_initializer=None, name=None,
+                 **kwargs):
+        super().__init__(name=name or "conv2d_transpose", **kwargs)
+        self.filters = filters
+        self.kernel_size = _norm_tuple(kernel_size, 2)
+        self.strides = _norm_tuple(strides, 2)
+        self.padding = padding.upper()
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer or init_ops.Zeros()
+
+    def build(self, input_shape):
+        in_ch = input_shape[-1].value
+        self.kernel = self.add_variable(
+            "kernel", list(self.kernel_size) + [in_ch, self.filters],
+            initializer=self.kernel_initializer)
+        if self.use_bias:
+            self.bias = self.add_variable("bias", [self.filters],
+                                          initializer=self.bias_initializer)
+        self.built = True
+
+    def call(self, inputs):
+        out = nn_ops.conv2d_transpose(
+            inputs, self.kernel._ref, None,
+            strides=[1] + list(self.strides) + [1], padding=self.padding)
+        if self.use_bias:
+            out = nn_ops.bias_add(out, self.bias._ref)
+        if self.activation is not None:
+            out = self.activation(out)
+        return out
+
+
+class SeparableConv2D(Layer):
+    def __init__(self, filters, kernel_size, strides=(1, 1), padding="valid",
+                 depth_multiplier=1, activation=None, use_bias=True,
+                 depthwise_initializer=None, pointwise_initializer=None,
+                 bias_initializer=None, name=None, **kwargs):
+        super().__init__(name=name or "separable_conv2d", **kwargs)
+        self.filters = filters
+        self.kernel_size = _norm_tuple(kernel_size, 2)
+        self.strides = _norm_tuple(strides, 2)
+        self.padding = padding.upper()
+        self.depth_multiplier = depth_multiplier
+        self.activation = activation
+        self.use_bias = use_bias
+        self.depthwise_initializer = depthwise_initializer
+        self.pointwise_initializer = pointwise_initializer
+        self.bias_initializer = bias_initializer or init_ops.Zeros()
+
+    def build(self, input_shape):
+        in_ch = input_shape[-1].value
+        self.depthwise_kernel = self.add_variable(
+            "depthwise_kernel",
+            list(self.kernel_size) + [in_ch, self.depth_multiplier],
+            initializer=self.depthwise_initializer)
+        self.pointwise_kernel = self.add_variable(
+            "pointwise_kernel",
+            [1, 1, in_ch * self.depth_multiplier, self.filters],
+            initializer=self.pointwise_initializer)
+        if self.use_bias:
+            self.bias = self.add_variable("bias", [self.filters],
+                                          initializer=self.bias_initializer)
+        self.built = True
+
+    def call(self, inputs):
+        out = nn_ops.separable_conv2d(
+            inputs, self.depthwise_kernel._ref, self.pointwise_kernel._ref,
+            [1] + list(self.strides) + [1], self.padding)
+        if self.use_bias:
+            out = nn_ops.bias_add(out, self.bias._ref)
+        if self.activation is not None:
+            out = self.activation(out)
+        return out
+
+
+def conv1d(inputs, filters, kernel_size, **kwargs):
+    reuse = kwargs.pop("reuse", None)
+    return Conv1D(filters, kernel_size, **kwargs)(inputs)
+
+
+def conv2d(inputs, filters, kernel_size, **kwargs):
+    reuse = kwargs.pop("reuse", None)
+    return Conv2D(filters, kernel_size, **kwargs)(inputs)
+
+
+def conv3d(inputs, filters, kernel_size, **kwargs):
+    reuse = kwargs.pop("reuse", None)
+    return Conv3D(filters, kernel_size, **kwargs)(inputs)
+
+
+def conv2d_transpose(inputs, filters, kernel_size, **kwargs):
+    reuse = kwargs.pop("reuse", None)
+    return Conv2DTranspose(filters, kernel_size, **kwargs)(inputs)
+
+
+def separable_conv2d(inputs, filters, kernel_size, **kwargs):
+    reuse = kwargs.pop("reuse", None)
+    return SeparableConv2D(filters, kernel_size, **kwargs)(inputs)

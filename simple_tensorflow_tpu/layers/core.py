@@ -1,0 +1,113 @@
+"""Core layers (ref: tensorflow/python/layers/core.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import graph as ops_mod
+from ..ops import array_ops, init_ops, math_ops, nn_ops
+from .base import Layer
+
+
+class Dense(Layer):
+    """(ref: core.py:48 ``class Dense``). The matmul keeps bf16 inputs with
+    f32 accumulation on the MXU (see ops/math_ops.MatMul)."""
+
+    def __init__(self, units, activation=None, use_bias=True,
+                 kernel_initializer=None, bias_initializer=None,
+                 kernel_regularizer=None, bias_regularizer=None,
+                 activity_regularizer=None, kernel_constraint=None,
+                 bias_constraint=None, trainable=True, name=None, **kwargs):
+        super().__init__(trainable=trainable, name=name or "dense", **kwargs)
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer or init_ops.Zeros()
+        self.kernel_regularizer = kernel_regularizer
+        self.bias_regularizer = bias_regularizer
+        self.kernel_constraint = kernel_constraint
+        self.bias_constraint = bias_constraint
+
+    def build(self, input_shape):
+        in_dim = input_shape[-1].value
+        if in_dim is None:
+            raise ValueError("Dense needs known last dim")
+        self.kernel = self.add_variable(
+            "kernel", [in_dim, self.units],
+            initializer=self.kernel_initializer,
+            regularizer=self.kernel_regularizer,
+            constraint=self.kernel_constraint)
+        if self.use_bias:
+            self.bias = self.add_variable(
+                "bias", [self.units], initializer=self.bias_initializer,
+                regularizer=self.bias_regularizer,
+                constraint=self.bias_constraint)
+        self.built = True
+
+    def call(self, inputs):
+        rank = inputs.shape.rank
+        if rank is not None and rank > 2:
+            flat = array_ops.reshape(
+                inputs, [-1, inputs.shape[-1].value])
+            out = math_ops.matmul(flat, self.kernel._ref)
+            out_shape = [d.value if d.value is not None else -1
+                         for d in inputs.shape[:-1]] + [self.units]
+            out = array_ops.reshape(out, out_shape)
+        else:
+            out = math_ops.matmul(inputs, self.kernel._ref)
+        if self.use_bias:
+            out = nn_ops.bias_add(out, self.bias._ref)
+        if self.activation is not None:
+            out = self.activation(out)
+        return out
+
+
+def dense(inputs, units, activation=None, use_bias=True,
+          kernel_initializer=None, bias_initializer=None,
+          kernel_regularizer=None, bias_regularizer=None,
+          activity_regularizer=None, kernel_constraint=None,
+          bias_constraint=None, trainable=True, name=None, reuse=None):
+    layer = Dense(units, activation, use_bias, kernel_initializer,
+                  bias_initializer or init_ops.Zeros(), kernel_regularizer,
+                  bias_regularizer, activity_regularizer, kernel_constraint,
+                  bias_constraint, trainable, name)
+    return layer(inputs)
+
+
+class Dropout(Layer):
+    """(ref: core.py:229 ``class Dropout``)."""
+
+    def __init__(self, rate=0.5, noise_shape=None, seed=None, name=None,
+                 **kwargs):
+        super().__init__(name=name or "dropout", **kwargs)
+        self.rate = rate
+        self.noise_shape = noise_shape
+        self.seed = seed
+
+    def call(self, inputs, training=False):
+        if not training or self.rate == 0.0:
+            return array_ops.identity(inputs)
+        return nn_ops.dropout(inputs, rate=self.rate, seed=self.seed)
+
+
+def dropout(inputs, rate=0.5, noise_shape=None, seed=None, training=False,
+            name=None):
+    return Dropout(rate, noise_shape, seed, name)(inputs, training=training)
+
+
+class Flatten(Layer):
+    """(ref: core.py:287 ``class Flatten``)."""
+
+    def call(self, inputs):
+        dims = inputs.shape.as_list()
+        n = 1
+        for d in dims[1:]:
+            if d is None:
+                raise ValueError("Flatten needs static non-batch dims")
+            n *= d
+        return array_ops.reshape(inputs, [-1, n])
+
+
+def flatten(inputs, name=None):
+    return Flatten(name=name or "flatten")(inputs)

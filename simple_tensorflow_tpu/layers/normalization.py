@@ -1,0 +1,87 @@
+"""BatchNormalization layer (ref: tensorflow/python/layers/normalization.py).
+
+Uses the fused batch-norm composite (ops/nn_impl.py) — XLA fuses it into the
+adjacent conv; moving stats update via UPDATE_OPS, reference-style.
+"""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..ops import array_ops, init_ops, math_ops, nn_impl, state_ops
+from .base import Layer
+
+
+class BatchNormalization(Layer):
+    """(ref: normalization.py:59 ``class BatchNormalization``)."""
+
+    def __init__(self, axis=-1, momentum=0.99, epsilon=1e-3, center=True,
+                 scale=True, beta_initializer=None, gamma_initializer=None,
+                 moving_mean_initializer=None, moving_variance_initializer=None,
+                 beta_regularizer=None, gamma_regularizer=None, trainable=True,
+                 fused=True, name=None, **kwargs):
+        super().__init__(trainable=trainable,
+                         name=name or "batch_normalization", **kwargs)
+        self.axis = axis
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.center = center
+        self.scale = scale
+        self.beta_initializer = beta_initializer or init_ops.Zeros()
+        self.gamma_initializer = gamma_initializer or init_ops.Ones()
+        self.moving_mean_initializer = moving_mean_initializer or init_ops.Zeros()
+        self.moving_variance_initializer = (moving_variance_initializer or
+                                            init_ops.Ones())
+        self.fused = fused
+
+    def build(self, input_shape):
+        ch = input_shape[self.axis].value
+        self.gamma = self.add_variable("gamma", [ch], dtype="float32",
+                                       initializer=self.gamma_initializer,
+                                       trainable=self.scale)
+        self.beta = self.add_variable("beta", [ch], dtype="float32",
+                                      initializer=self.beta_initializer,
+                                      trainable=self.center)
+        self.moving_mean = self.add_variable(
+            "moving_mean", [ch], dtype="float32",
+            initializer=self.moving_mean_initializer, trainable=False)
+        self.moving_variance = self.add_variable(
+            "moving_variance", [ch], dtype="float32",
+            initializer=self.moving_variance_initializer, trainable=False)
+        self.built = True
+
+    def call(self, inputs, training=False):
+        df = "NHWC" if self.axis in (-1, inputs.shape.rank - 1) else "NCHW"
+        if training:
+            y, batch_mean, batch_var = nn_impl.fused_batch_norm(
+                inputs, self.gamma._ref, self.beta._ref,
+                epsilon=self.epsilon, data_format=df, is_training=True)
+            mom = ops_mod.convert_to_tensor(self.momentum, dtype="float32")
+            upd_mean = state_ops.assign(
+                self.moving_mean._ref,
+                self.moving_mean._ref * mom + batch_mean * (1.0 - mom))
+            upd_var = state_ops.assign(
+                self.moving_variance._ref,
+                self.moving_variance._ref * mom + batch_var * (1.0 - mom))
+            self.add_update([upd_mean.op, upd_var.op])
+            return y
+        y, _, _ = nn_impl.fused_batch_norm(
+            inputs, self.gamma._ref, self.beta._ref,
+            mean=self.moving_mean._ref, variance=self.moving_variance._ref,
+            epsilon=self.epsilon, data_format=df, is_training=False)
+        return y
+
+
+def batch_normalization(inputs, axis=-1, momentum=0.99, epsilon=1e-3,
+                        center=True, scale=True, beta_initializer=None,
+                        gamma_initializer=None, moving_mean_initializer=None,
+                        moving_variance_initializer=None, training=False,
+                        trainable=True, name=None, reuse=None, fused=True,
+                        **kwargs):
+    layer = BatchNormalization(
+        axis=axis, momentum=momentum, epsilon=epsilon, center=center,
+        scale=scale, beta_initializer=beta_initializer,
+        gamma_initializer=gamma_initializer,
+        moving_mean_initializer=moving_mean_initializer,
+        moving_variance_initializer=moving_variance_initializer,
+        trainable=trainable, fused=fused, name=name)
+    return layer(inputs, training=training)

@@ -1,0 +1,134 @@
+"""Pooling layers (ref: tensorflow/python/layers/pooling.py)."""
+
+from __future__ import annotations
+
+from ..ops import array_ops, nn_ops
+from .base import Layer
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+class _Pooling2D(Layer):
+    def __init__(self, pool_fn, pool_size, strides, padding="valid",
+                 data_format="channels_last", name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.pool_fn = pool_fn
+        self.pool_size = _norm_tuple(pool_size, 2)
+        self.strides = _norm_tuple(strides, 2)
+        self.padding = padding.upper()
+        self.data_format = data_format
+
+    def call(self, inputs):
+        df = "NHWC" if self.data_format == "channels_last" else "NCHW"
+        if df == "NHWC":
+            ksize = [1] + list(self.pool_size) + [1]
+            strides = [1] + list(self.strides) + [1]
+        else:
+            ksize = [1, 1] + list(self.pool_size)
+            strides = [1, 1] + list(self.strides)
+        return self.pool_fn(inputs, ksize, strides, self.padding,
+                            data_format=df)
+
+
+class MaxPooling2D(_Pooling2D):
+    def __init__(self, pool_size, strides, padding="valid",
+                 data_format="channels_last", name=None, **kwargs):
+        super().__init__(nn_ops.max_pool, pool_size, strides, padding,
+                         data_format, name or "max_pooling2d", **kwargs)
+
+
+class AveragePooling2D(_Pooling2D):
+    def __init__(self, pool_size, strides, padding="valid",
+                 data_format="channels_last", name=None, **kwargs):
+        super().__init__(nn_ops.avg_pool, pool_size, strides, padding,
+                         data_format, name or "average_pooling2d", **kwargs)
+
+
+class _Pooling1D(Layer):
+    def __init__(self, pool_fn, pool_size, strides, padding="valid",
+                 name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.pool_fn = pool_fn
+        self.pool_size = _norm_tuple(pool_size, 1)[0]
+        self.strides = _norm_tuple(strides, 1)[0]
+        self.padding = padding.upper()
+
+    def call(self, inputs):
+        x = array_ops.expand_dims(inputs, 1)
+        out = self.pool_fn(x, [1, 1, self.pool_size, 1],
+                           [1, 1, self.strides, 1], self.padding)
+        return array_ops.squeeze(out, 1)
+
+
+class MaxPooling1D(_Pooling1D):
+    def __init__(self, pool_size, strides, padding="valid", name=None,
+                 **kwargs):
+        super().__init__(nn_ops.max_pool, pool_size, strides, padding,
+                         name or "max_pooling1d", **kwargs)
+
+
+class AveragePooling1D(_Pooling1D):
+    def __init__(self, pool_size, strides, padding="valid", name=None,
+                 **kwargs):
+        super().__init__(nn_ops.avg_pool, pool_size, strides, padding,
+                         name or "average_pooling1d", **kwargs)
+
+
+class _Pooling3D(Layer):
+    def __init__(self, pool_fn, pool_size, strides, padding="valid",
+                 name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.pool_fn = pool_fn
+        self.pool_size = _norm_tuple(pool_size, 3)
+        self.strides = _norm_tuple(strides, 3)
+        self.padding = padding.upper()
+
+    def call(self, inputs):
+        return self.pool_fn(inputs, [1] + list(self.pool_size) + [1],
+                            [1] + list(self.strides) + [1], self.padding)
+
+
+class MaxPooling3D(_Pooling3D):
+    def __init__(self, pool_size, strides, padding="valid", name=None,
+                 **kwargs):
+        super().__init__(nn_ops.max_pool3d, pool_size, strides, padding,
+                         name or "max_pooling3d", **kwargs)
+
+
+class AveragePooling3D(_Pooling3D):
+    def __init__(self, pool_size, strides, padding="valid", name=None,
+                 **kwargs):
+        super().__init__(nn_ops.avg_pool3d, pool_size, strides, padding,
+                         name or "average_pooling3d", **kwargs)
+
+
+def max_pooling1d(inputs, pool_size, strides, padding="valid", name=None):
+    return MaxPooling1D(pool_size, strides, padding, name=name)(inputs)
+
+
+def max_pooling2d(inputs, pool_size, strides, padding="valid",
+                  data_format="channels_last", name=None):
+    return MaxPooling2D(pool_size, strides, padding, data_format,
+                        name=name)(inputs)
+
+
+def max_pooling3d(inputs, pool_size, strides, padding="valid", name=None):
+    return MaxPooling3D(pool_size, strides, padding, name=name)(inputs)
+
+
+def average_pooling1d(inputs, pool_size, strides, padding="valid", name=None):
+    return AveragePooling1D(pool_size, strides, padding, name=name)(inputs)
+
+
+def average_pooling2d(inputs, pool_size, strides, padding="valid",
+                      data_format="channels_last", name=None):
+    return AveragePooling2D(pool_size, strides, padding, data_format,
+                            name=name)(inputs)
+
+
+def average_pooling3d(inputs, pool_size, strides, padding="valid", name=None):
+    return AveragePooling3D(pool_size, strides, padding, name=name)(inputs)

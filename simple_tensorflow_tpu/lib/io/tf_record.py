@@ -1,0 +1,123 @@
+"""TFRecord read/write (ref: tensorflow/core/lib/io/record_writer.cc,
+record_reader.cc, python/lib/io/tf_record.py).
+
+Format-identical to the reference: [len u64][masked crc32c(len) u32]
+[data][masked crc32c(data) u32]. Python implementation here; the C++
+runtime (runtime_cc/record_io.cc) accelerates bulk reads via ctypes when
+built (stf.data uses it).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib as _zlib
+from typing import Iterator, Optional
+
+from ...framework import errors
+from ..crc32c import masked_crc32c
+
+
+class TFRecordCompressionType:
+    NONE = 0
+    ZLIB = 1
+    GZIP = 2
+
+
+class TFRecordOptions:
+    def __init__(self, compression_type=TFRecordCompressionType.NONE):
+        self.compression_type = compression_type
+
+    @classmethod
+    def get_compression_type_string(cls, options):
+        if options is None:
+            return ""
+        return {0: "", 1: "ZLIB", 2: "GZIP"}[options.compression_type]
+
+
+def _encode_record(data: bytes) -> bytes:
+    header = struct.pack("<Q", len(data))
+    return (header + struct.pack("<I", masked_crc32c(header)) + data +
+            struct.pack("<I", masked_crc32c(data)))
+
+
+class TFRecordWriter:
+    """(ref: python/lib/io/tf_record.py:94 ``TFRecordWriter``)."""
+
+    def __init__(self, path, options: Optional[TFRecordOptions] = None):
+        self._path = path
+        comp = TFRecordOptions.get_compression_type_string(options)
+        if comp == "GZIP":
+            import gzip
+
+            self._f = gzip.open(path, "wb")
+        elif comp == "ZLIB":
+            raise NotImplementedError("ZLIB container: use GZIP")
+        else:
+            self._f = open(path, "wb")
+
+    def write(self, record: bytes):
+        if isinstance(record, str):
+            record = record.encode()
+        self._f.write(_encode_record(record))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _read_records_py(path, compression="") -> Iterator[bytes]:
+    if compression == "GZIP":
+        import gzip
+
+        f = gzip.open(path, "rb")
+    else:
+        f = open(path, "rb")
+    with f:
+        while True:
+            header = f.read(12)
+            if len(header) == 0:
+                return
+            if len(header) < 12:
+                raise errors.DataLossError(None, None,
+                                           f"truncated record header in {path}")
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if masked_crc32c(header[:8]) != len_crc:
+                raise errors.DataLossError(None, None,
+                                           f"corrupted length crc in {path}")
+            data = f.read(length)
+            if len(data) < length:
+                raise errors.DataLossError(None, None,
+                                           f"truncated record in {path}")
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if masked_crc32c(data) != data_crc:
+                raise errors.DataLossError(None, None,
+                                           f"corrupted data crc in {path}")
+            yield data
+
+
+def tf_record_iterator(path, options: Optional[TFRecordOptions] = None
+                       ) -> Iterator[bytes]:
+    """(ref: python/lib/io/tf_record.py:43 ``tf_record_iterator``).
+    Prefers the native C++ reader when available."""
+    comp = TFRecordOptions.get_compression_type_string(options)
+    if not comp:
+        try:
+            from ...runtime import native
+
+            if native.available():
+                yield from native.read_tfrecords(path)
+                return
+        except Exception:
+            pass
+    yield from _read_records_py(path, comp)

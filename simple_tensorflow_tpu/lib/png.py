@@ -1,0 +1,93 @@
+"""Minimal PNG encode/decode (ref: tensorflow/core/lib/png/png_io.cc).
+
+Pure-python (zlib) — no external imaging deps in the image. Supports 8-bit
+grayscale/RGB/RGBA.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+_COLOR_TYPE = {1: 0, 3: 2, 4: 6}
+_CHANNELS = {0: 1, 2: 3, 4: 2, 6: 4}
+
+
+def _chunk(tag: bytes, data: bytes) -> bytes:
+    return (struct.pack(">I", len(data)) + tag + data +
+            struct.pack(">I", zlib.crc32(tag + data) & 0xFFFFFFFF))
+
+
+def encode(img: np.ndarray) -> bytes:
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    if img.dtype != np.uint8:
+        img = img.astype(np.uint8)
+    h, w, c = img.shape
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, _COLOR_TYPE[c], 0, 0, 0)
+    raw = b"".join(b"\x00" + img[row].tobytes() for row in range(h))
+    return (b"\x89PNG\r\n\x1a\n" + _chunk(b"IHDR", ihdr) +
+            _chunk(b"IDAT", zlib.compress(raw, 6)) + _chunk(b"IEND", b""))
+
+
+def decode(data: bytes) -> np.ndarray:
+    if data[:8] != b"\x89PNG\r\n\x1a\n":
+        raise ValueError("not a PNG")
+    pos = 8
+    w = h = bit_depth = color_type = None
+    idat = b""
+    while pos < len(data):
+        (ln,) = struct.unpack(">I", data[pos:pos + 4])
+        tag = data[pos + 4:pos + 8]
+        body = data[pos + 8:pos + 8 + ln]
+        pos += 12 + ln
+        if tag == b"IHDR":
+            w, h, bit_depth, color_type = struct.unpack(">IIBB", body[:10])
+        elif tag == b"IDAT":
+            idat += body
+        elif tag == b"IEND":
+            break
+    if bit_depth != 8:
+        raise ValueError(f"unsupported bit depth {bit_depth}")
+    c = _CHANNELS[color_type]
+    raw = zlib.decompress(idat)
+    stride = w * c
+    out = np.empty((h, w, c), np.uint8)
+    prev = np.zeros(stride, np.uint16)
+    pos = 0
+    for row in range(h):
+        ft = raw[pos]
+        pos += 1
+        line = np.frombuffer(raw[pos:pos + stride], np.uint8).astype(np.uint16)
+        pos += stride
+        if ft == 0:
+            cur = line
+        elif ft == 1:  # sub
+            cur = line.copy()
+            for i in range(c, stride):
+                cur[i] = (cur[i] + cur[i - c]) & 0xFF
+        elif ft == 2:  # up
+            cur = (line + prev) & 0xFF
+        elif ft == 3:  # average
+            cur = line.copy()
+            for i in range(stride):
+                left = cur[i - c] if i >= c else 0
+                cur[i] = (cur[i] + ((left + prev[i]) >> 1)) & 0xFF
+        elif ft == 4:  # paeth
+            cur = line.copy()
+            for i in range(stride):
+                a = int(cur[i - c]) if i >= c else 0
+                b = int(prev[i])
+                cc = int(prev[i - c]) if i >= c else 0
+                p = a + b - cc
+                pa, pb, pc = abs(p - a), abs(p - b), abs(p - cc)
+                pred = a if (pa <= pb and pa <= pc) else (b if pb <= pc else cc)
+                cur[i] = (cur[i] + pred) & 0xFF
+        else:
+            raise ValueError(f"bad filter {ft}")
+        out[row] = cur.astype(np.uint8).reshape(w, c)
+        prev = cur
+    return out

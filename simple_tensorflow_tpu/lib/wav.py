@@ -1,0 +1,42 @@
+"""Minimal WAV encode/decode (ref: tensorflow/core/lib/wav/wav_io.cc)."""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+def encode(samples: np.ndarray, sample_rate: int) -> bytes:
+    samples = np.asarray(samples, np.float32)
+    if samples.ndim == 1:
+        samples = samples[:, None]
+    pcm = (np.clip(samples, -1.0, 1.0) * 32767).astype("<i2")
+    n_frames, n_ch = pcm.shape
+    data = pcm.tobytes()
+    byte_rate = sample_rate * n_ch * 2
+    hdr = (b"RIFF" + struct.pack("<I", 36 + len(data)) + b"WAVE" +
+           b"fmt " + struct.pack("<IHHIIHH", 16, 1, n_ch, sample_rate,
+                                 byte_rate, n_ch * 2, 16) +
+           b"data" + struct.pack("<I", len(data)))
+    return hdr + data
+
+
+def decode(data: bytes):
+    if data[:4] != b"RIFF" or data[8:12] != b"WAVE":
+        raise ValueError("not a WAV")
+    pos = 12
+    fmt = None
+    pcm = None
+    while pos + 8 <= len(data):
+        tag = data[pos:pos + 4]
+        (ln,) = struct.unpack("<I", data[pos + 4:pos + 8])
+        body = data[pos + 8:pos + 8 + ln]
+        pos += 8 + ln + (ln & 1)
+        if tag == b"fmt ":
+            fmt = struct.unpack("<HHIIHH", body[:16])
+        elif tag == b"data":
+            pcm = body
+    _, n_ch, rate, _, _, bits = fmt
+    arr = np.frombuffer(pcm, "<i2").astype(np.float32) / 32767.0
+    return arr.reshape(-1, n_ch), rate

@@ -1,0 +1,244 @@
+"""Loss functions (ref: tensorflow/python/ops/losses/losses_impl.py)."""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..ops import array_ops, math_ops, nn_ops
+
+GraphKeys = ops_mod.GraphKeys
+
+
+class Reduction:
+    """(ref: losses_impl.py:25 ``class Reduction``)."""
+
+    NONE = "none"
+    SUM = "weighted_sum"
+    MEAN = "weighted_mean"
+    SUM_BY_NONZERO_WEIGHTS = "weighted_sum_by_nonzero_weights"
+    SUM_OVER_BATCH_SIZE = "weighted_sum_over_batch_size"
+    SUM_OVER_NONZERO_WEIGHTS = SUM_BY_NONZERO_WEIGHTS
+    DEFAULT = SUM_BY_NONZERO_WEIGHTS
+
+    @classmethod
+    def all(cls):
+        return (cls.NONE, cls.SUM, cls.MEAN, cls.SUM_BY_NONZERO_WEIGHTS,
+                cls.SUM_OVER_BATCH_SIZE)
+
+    @classmethod
+    def validate(cls, key):
+        if key not in cls.all():
+            raise ValueError(f"Invalid Reduction: {key}")
+
+
+def compute_weighted_loss(losses, weights=1.0, scope=None,
+                          loss_collection=GraphKeys.LOSSES,
+                          reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    """(ref: losses_impl.py:147)."""
+    Reduction.validate(reduction)
+    losses = ops_mod.convert_to_tensor(losses)
+    losses_f = math_ops.cast(losses, "float32")
+    weights_t = ops_mod.convert_to_tensor(weights, dtype="float32")
+    weighted = losses_f * weights_t
+    if reduction == Reduction.NONE:
+        loss = weighted
+    else:
+        total = math_ops.reduce_sum(weighted)
+        if reduction == Reduction.SUM:
+            loss = total
+        elif reduction == Reduction.MEAN:
+            denom = math_ops.reduce_sum(
+                weights_t * array_ops.ones_like(losses_f))
+            loss = total / math_ops.maximum(
+                denom, ops_mod.convert_to_tensor(1e-12))
+        elif reduction == Reduction.SUM_BY_NONZERO_WEIGHTS:
+            nz = math_ops.reduce_sum(math_ops.cast(
+                math_ops.not_equal(weights_t * array_ops.ones_like(losses_f),
+                                   ops_mod.convert_to_tensor(0.0)), "float32"))
+            loss = total / math_ops.maximum(
+                nz, ops_mod.convert_to_tensor(1.0))
+        elif reduction == Reduction.SUM_OVER_BATCH_SIZE:
+            n = array_ops.size(losses_f)
+            loss = total / math_ops.cast(n, "float32")
+    loss = math_ops.cast(loss, losses.dtype.base_dtype)
+    if loss_collection:
+        ops_mod.add_to_collection(loss_collection, loss)
+    return loss
+
+
+def absolute_difference(labels, predictions, weights=1.0, scope=None,
+                        loss_collection=GraphKeys.LOSSES,
+                        reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    with ops_mod.name_scope(scope, "absolute_difference"):
+        return compute_weighted_loss(
+            math_ops.abs(math_ops.subtract(predictions, labels)), weights,
+            scope, loss_collection, reduction)
+
+
+def mean_squared_error(labels, predictions, weights=1.0, scope=None,
+                       loss_collection=GraphKeys.LOSSES,
+                       reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    """(ref: losses_impl.py:627)."""
+    with ops_mod.name_scope(scope, "mean_squared_error"):
+        return compute_weighted_loss(
+            math_ops.squared_difference(predictions, labels), weights, scope,
+            loss_collection, reduction)
+
+
+def log_loss(labels, predictions, weights=1.0, epsilon=1e-7, scope=None,
+             loss_collection=GraphKeys.LOSSES,
+             reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    with ops_mod.name_scope(scope, "log_loss"):
+        labels = ops_mod.convert_to_tensor(labels)
+        predictions = ops_mod.convert_to_tensor(
+            predictions, dtype=labels.dtype.base_dtype)
+        eps = ops_mod.convert_to_tensor(epsilon,
+                                        dtype=labels.dtype.base_dtype)
+        losses = -labels * math_ops.log(predictions + eps) - \
+            (1 - labels) * math_ops.log(1 - predictions + eps)
+        return compute_weighted_loss(losses, weights, scope, loss_collection,
+                                     reduction)
+
+
+def hinge_loss(labels, logits, weights=1.0, scope=None,
+               loss_collection=GraphKeys.LOSSES,
+               reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    with ops_mod.name_scope(scope, "hinge_loss"):
+        labels = ops_mod.convert_to_tensor(labels)
+        logits = ops_mod.convert_to_tensor(logits,
+                                           dtype=labels.dtype.base_dtype)
+        all_ones = array_ops.ones_like(labels)
+        labels = 2 * labels - all_ones
+        losses = nn_ops.relu(all_ones - labels * logits)
+        return compute_weighted_loss(losses, weights, scope, loss_collection,
+                                     reduction)
+
+
+def huber_loss(labels, predictions, weights=1.0, delta=1.0, scope=None,
+               loss_collection=GraphKeys.LOSSES,
+               reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    """(ref: losses_impl.py:394)."""
+    with ops_mod.name_scope(scope, "huber_loss"):
+        labels = ops_mod.convert_to_tensor(labels)
+        predictions = ops_mod.convert_to_tensor(
+            predictions, dtype=labels.dtype.base_dtype)
+        error = math_ops.subtract(predictions, labels)
+        abs_error = math_ops.abs(error)
+        delta_t = ops_mod.convert_to_tensor(delta,
+                                            dtype=labels.dtype.base_dtype)
+        quadratic = math_ops.minimum(abs_error, delta_t)
+        linear = abs_error - quadratic
+        losses = 0.5 * quadratic * quadratic + delta_t * linear
+        return compute_weighted_loss(losses, weights, scope, loss_collection,
+                                     reduction)
+
+
+def cosine_distance(labels, predictions, axis=None, weights=1.0, scope=None,
+                    loss_collection=GraphKeys.LOSSES,
+                    reduction=Reduction.SUM_BY_NONZERO_WEIGHTS, dim=None):
+    if dim is not None and axis is None:
+        axis = dim
+    with ops_mod.name_scope(scope, "cosine_distance"):
+        labels = ops_mod.convert_to_tensor(labels)
+        predictions = ops_mod.convert_to_tensor(
+            predictions, dtype=labels.dtype.base_dtype)
+        radial_diffs = math_ops.multiply(predictions, labels)
+        losses = 1 - math_ops.reduce_sum(radial_diffs, axis=axis,
+                                         keepdims=True)
+        return compute_weighted_loss(losses, weights, scope, loss_collection,
+                                     reduction)
+
+
+def mean_pairwise_squared_error(labels, predictions, weights=1.0, scope=None,
+                                loss_collection=GraphKeys.LOSSES):
+    with ops_mod.name_scope(scope, "mean_pairwise_squared_error"):
+        labels = ops_mod.convert_to_tensor(labels)
+        predictions = ops_mod.convert_to_tensor(
+            predictions, dtype=labels.dtype.base_dtype)
+        diffs = math_ops.subtract(predictions, labels)
+        axes = list(range(1, len(diffs.shape)))
+        sum_sq = math_ops.reduce_sum(math_ops.square(diffs), axis=axes)
+        n = 1.0
+        for a in axes:
+            n *= diffs.shape[a].value
+        sum_d = math_ops.reduce_sum(diffs, axis=axes)
+        per_ex = 2.0 * (sum_sq / n - math_ops.square(sum_d / n))
+        loss = math_ops.reduce_mean(per_ex) * ops_mod.convert_to_tensor(
+            weights, dtype="float32")
+        ops_mod.add_to_collection(loss_collection, loss)
+        return loss
+
+
+def sigmoid_cross_entropy(multi_class_labels, logits, weights=1.0,
+                          label_smoothing=0, scope=None,
+                          loss_collection=GraphKeys.LOSSES,
+                          reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    with ops_mod.name_scope(scope, "sigmoid_cross_entropy_loss"):
+        logits = ops_mod.convert_to_tensor(logits)
+        labels = ops_mod.convert_to_tensor(multi_class_labels,
+                                           dtype=logits.dtype.base_dtype)
+        if label_smoothing > 0:
+            labels = labels * (1 - label_smoothing) + 0.5 * label_smoothing
+        losses = nn_ops.sigmoid_cross_entropy_with_logits(labels=labels,
+                                                          logits=logits)
+        return compute_weighted_loss(losses, weights, scope, loss_collection,
+                                     reduction)
+
+
+def softmax_cross_entropy(onehot_labels, logits, weights=1.0,
+                          label_smoothing=0, scope=None,
+                          loss_collection=GraphKeys.LOSSES,
+                          reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    """(ref: losses_impl.py:707)."""
+    with ops_mod.name_scope(scope, "softmax_cross_entropy_loss"):
+        logits = ops_mod.convert_to_tensor(logits)
+        labels = ops_mod.convert_to_tensor(onehot_labels,
+                                           dtype=logits.dtype.base_dtype)
+        if label_smoothing > 0:
+            num_classes = labels.shape[-1].value
+            labels = labels * (1 - label_smoothing) + \
+                label_smoothing / num_classes
+        losses = nn_ops.softmax_cross_entropy_with_logits(labels=labels,
+                                                          logits=logits)
+        return compute_weighted_loss(losses, weights, scope, loss_collection,
+                                     reduction)
+
+
+def sparse_softmax_cross_entropy(labels, logits, weights=1.0, scope=None,
+                                 loss_collection=GraphKeys.LOSSES,
+                                 reduction=Reduction.SUM_BY_NONZERO_WEIGHTS):
+    with ops_mod.name_scope(scope, "sparse_softmax_cross_entropy_loss"):
+        losses = nn_ops.sparse_softmax_cross_entropy_with_logits(
+            labels=labels, logits=logits)
+        return compute_weighted_loss(losses, weights, scope, loss_collection,
+                                     reduction)
+
+
+def add_loss(loss, loss_collection=GraphKeys.LOSSES):
+    if loss_collection:
+        ops_mod.add_to_collection(loss_collection, loss)
+
+
+def get_losses(scope=None, loss_collection=GraphKeys.LOSSES):
+    return ops_mod.get_collection(loss_collection, scope)
+
+
+def get_regularization_losses(scope=None):
+    return ops_mod.get_collection(GraphKeys.REGULARIZATION_LOSSES, scope)
+
+
+def get_regularization_loss(scope=None, name="total_regularization_loss"):
+    losses = get_regularization_losses(scope)
+    if losses:
+        return math_ops.add_n(losses, name=name)
+    from ..ops import array_ops as ao
+
+    return ao.zeros([], dtype="float32")
+
+
+def get_total_loss(add_regularization_losses=True, name="total_loss"):
+    losses = get_losses()
+    if add_regularization_losses:
+        losses += get_regularization_losses()
+    if not losses:
+        raise ValueError("No losses collected")
+    return math_ops.add_n(losses, name=name)

@@ -1,0 +1,261 @@
+"""stf.metrics (ref: tensorflow/python/ops/metrics_impl.py).
+
+Reference semantics: each metric returns (value, update_op) backed by local
+accumulator variables; run update_op per batch, read value at the end.
+"""
+
+from __future__ import annotations
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..ops import array_ops, math_ops, state_ops
+from ..ops import variables as variables_mod
+
+GraphKeys = ops_mod.GraphKeys
+
+
+def _metric_variable(shape, name):
+    from ..ops import array_ops as ao
+
+    return variables_mod.Variable(
+        ao.zeros(shape, dtype="float32"), trainable=False, name=name,
+        collections=[GraphKeys.LOCAL_VARIABLES, "metric_variables"])
+
+
+def mean(values, weights=None, metrics_collections=None,
+         updates_collections=None, name=None):
+    """(ref: metrics_impl.py:232 ``mean``)."""
+    with ops_mod.name_scope(name, "mean"):
+        values = math_ops.cast(ops_mod.convert_to_tensor(values), "float32")
+        total = _metric_variable([], "total")
+        count = _metric_variable([], "count")
+        if weights is not None:
+            w = math_ops.cast(ops_mod.convert_to_tensor(weights), "float32")
+            values = values * w
+            num = math_ops.reduce_sum(w * array_ops.ones_like(values))
+        else:
+            num = math_ops.cast(array_ops.size(values), "float32")
+        upd_total = state_ops.assign_add(total._ref,
+                                         math_ops.reduce_sum(values))
+        upd_count = state_ops.assign_add(count._ref, num)
+        value = total._ref / math_ops.maximum(
+            count._ref, ops_mod.convert_to_tensor(1e-12))
+        update_op = upd_total / math_ops.maximum(
+            upd_count, ops_mod.convert_to_tensor(1e-12))
+        if metrics_collections:
+            ops_mod.add_to_collections(metrics_collections, value)
+        if updates_collections:
+            ops_mod.add_to_collections(updates_collections, update_op)
+        return value, update_op
+
+
+def accuracy(labels, predictions, weights=None, metrics_collections=None,
+             updates_collections=None, name=None):
+    """(ref: metrics_impl.py:372 ``accuracy``)."""
+    with ops_mod.name_scope(name, "accuracy"):
+        labels = ops_mod.convert_to_tensor(labels)
+        predictions = ops_mod.convert_to_tensor(predictions)
+        if predictions.dtype.base_dtype != labels.dtype.base_dtype:
+            predictions = math_ops.cast(predictions, labels.dtype.base_dtype)
+        is_correct = math_ops.cast(math_ops.equal(predictions, labels),
+                                   "float32")
+        return mean(is_correct, weights, metrics_collections,
+                    updates_collections)
+
+
+def _confusion_counts(labels, predictions, weights):
+    labels = math_ops.cast(ops_mod.convert_to_tensor(labels), "bool")
+    predictions = math_ops.cast(ops_mod.convert_to_tensor(predictions), "bool")
+
+    def count(cond):
+        c = math_ops.cast(cond, "float32")
+        if weights is not None:
+            c = c * math_ops.cast(ops_mod.convert_to_tensor(weights),
+                                  "float32")
+        return math_ops.reduce_sum(c)
+
+    tp = count(math_ops.logical_and(predictions, labels))
+    fp = count(math_ops.logical_and(predictions, math_ops.logical_not(labels)))
+    fn = count(math_ops.logical_and(math_ops.logical_not(predictions), labels))
+    tn = count(math_ops.logical_and(math_ops.logical_not(predictions),
+                                    math_ops.logical_not(labels)))
+    return tp, fp, fn, tn
+
+
+def _ratio_metric(name_default, num_keys, den_keys):
+    def metric(labels, predictions, weights=None, metrics_collections=None,
+               updates_collections=None, name=None):
+        with ops_mod.name_scope(name, name_default):
+            tp_v = _metric_variable([], "tp")
+            fp_v = _metric_variable([], "fp")
+            fn_v = _metric_variable([], "fn")
+            tn_v = _metric_variable([], "tn")
+            tp, fp, fn, tn = _confusion_counts(labels, predictions, weights)
+            upds = {"tp": state_ops.assign_add(tp_v._ref, tp),
+                    "fp": state_ops.assign_add(fp_v._ref, fp),
+                    "fn": state_ops.assign_add(fn_v._ref, fn),
+                    "tn": state_ops.assign_add(tn_v._ref, tn)}
+            cur = {"tp": tp_v._ref, "fp": fp_v._ref, "fn": fn_v._ref,
+                   "tn": tn_v._ref}
+
+            def ratio(vals):
+                num = math_ops.add_n([vals[k] for k in num_keys]) \
+                    if len(num_keys) > 1 else vals[num_keys[0]]
+                den = math_ops.add_n([vals[k] for k in den_keys]) \
+                    if len(den_keys) > 1 else vals[den_keys[0]]
+                return num / math_ops.maximum(
+                    den, ops_mod.convert_to_tensor(1e-12))
+
+            value = ratio(cur)
+            update_op = ratio(upds)
+            if metrics_collections:
+                ops_mod.add_to_collections(metrics_collections, value)
+            if updates_collections:
+                ops_mod.add_to_collections(updates_collections, update_op)
+            return value, update_op
+
+    return metric
+
+
+precision = _ratio_metric("precision", ("tp",), ("tp", "fp"))
+recall = _ratio_metric("recall", ("tp",), ("tp", "fn"))
+
+
+def true_positives(labels, predictions, weights=None, **kw):
+    with ops_mod.name_scope(None, "true_positives"):
+        v = _metric_variable([], "tp_count")
+        tp, _, _, _ = _confusion_counts(labels, predictions, weights)
+        return v._ref, state_ops.assign_add(v._ref, tp)
+
+
+def false_positives(labels, predictions, weights=None, **kw):
+    with ops_mod.name_scope(None, "false_positives"):
+        v = _metric_variable([], "fp_count")
+        _, fp, _, _ = _confusion_counts(labels, predictions, weights)
+        return v._ref, state_ops.assign_add(v._ref, fp)
+
+
+def false_negatives(labels, predictions, weights=None, **kw):
+    with ops_mod.name_scope(None, "false_negatives"):
+        v = _metric_variable([], "fn_count")
+        _, _, fn, _ = _confusion_counts(labels, predictions, weights)
+        return v._ref, state_ops.assign_add(v._ref, fn)
+
+
+def true_negatives(labels, predictions, weights=None, **kw):
+    with ops_mod.name_scope(None, "true_negatives"):
+        v = _metric_variable([], "tn_count")
+        _, _, _, tn = _confusion_counts(labels, predictions, weights)
+        return v._ref, state_ops.assign_add(v._ref, tn)
+
+
+def auc(labels, predictions, weights=None, num_thresholds=200,
+        metrics_collections=None, updates_collections=None,
+        curve="ROC", name=None):
+    """(ref: metrics_impl.py:586 ``auc``): Riemann-sum AUC over thresholds."""
+    with ops_mod.name_scope(name, "auc"):
+        labels = math_ops.cast(ops_mod.convert_to_tensor(labels), "float32")
+        predictions = math_ops.cast(ops_mod.convert_to_tensor(predictions),
+                                    "float32")
+        kepsilon = 1e-7
+        thresholds = [(i + 1) * 1.0 / (num_thresholds - 1)
+                      for i in range(num_thresholds - 2)]
+        thresholds = [0.0 - kepsilon] + thresholds + [1.0 + kepsilon]
+        tp_v = _metric_variable([num_thresholds], "tp")
+        fp_v = _metric_variable([num_thresholds], "fp")
+        fn_v = _metric_variable([num_thresholds], "fn")
+        tn_v = _metric_variable([num_thresholds], "tn")
+        import numpy as np
+
+        from ..framework import constant_op
+
+        th = constant_op.constant(
+            np.asarray(thresholds, dtype=np.float32).reshape(-1, 1))
+        p_flat = array_ops.reshape(predictions, [1, -1])
+        l_flat = array_ops.reshape(labels, [1, -1])
+        pred_pos = math_ops.cast(math_ops.greater(p_flat, th), "float32")
+        lab_pos = l_flat
+        tp = math_ops.reduce_sum(pred_pos * lab_pos, axis=1)
+        fp = math_ops.reduce_sum(pred_pos * (1 - lab_pos), axis=1)
+        fn = math_ops.reduce_sum((1 - pred_pos) * lab_pos, axis=1)
+        tn = math_ops.reduce_sum((1 - pred_pos) * (1 - lab_pos), axis=1)
+        upd = [state_ops.assign_add(tp_v._ref, tp),
+               state_ops.assign_add(fp_v._ref, fp),
+               state_ops.assign_add(fn_v._ref, fn),
+               state_ops.assign_add(tn_v._ref, tn)]
+
+        def compute(tp, fp, fn, tn):
+            eps = ops_mod.convert_to_tensor(kepsilon)
+            if curve == "PR":
+                prec = tp / math_ops.maximum(tp + fp, eps)
+                rec = tp / math_ops.maximum(tp + fn, eps)
+                x, y = rec, prec
+            else:
+                fpr = fp / math_ops.maximum(fp + tn, eps)
+                tpr = tp / math_ops.maximum(tp + fn, eps)
+                x, y = fpr, tpr
+            dx = x[:num_thresholds - 1] - x[1:]
+            my = (y[:num_thresholds - 1] + y[1:]) / 2.0
+            return math_ops.reduce_sum(dx * my)
+
+        value = compute(tp_v._ref, fp_v._ref, fn_v._ref, tn_v._ref)
+        update_op = compute(*upd)
+        return value, update_op
+
+
+def mean_iou(labels, predictions, num_classes, weights=None,
+             metrics_collections=None, updates_collections=None, name=None):
+    """(ref: metrics_impl.py:937 ``mean_iou``)."""
+    with ops_mod.name_scope(name, "mean_iou"):
+        cm_v = _metric_variable([num_classes, num_classes], "confusion")
+        labels_f = array_ops.reshape(math_ops.cast(
+            ops_mod.convert_to_tensor(labels), "int32"), [-1])
+        preds_f = array_ops.reshape(math_ops.cast(
+            ops_mod.convert_to_tensor(predictions), "int32"), [-1])
+        idx = labels_f * num_classes + preds_f
+        counts = math_ops.unsorted_segment_sum(
+            array_ops.ones_like(math_ops.cast(idx, "float32")), idx,
+            num_classes * num_classes)
+        cm = array_ops.reshape(counts, [num_classes, num_classes])
+        upd = state_ops.assign_add(cm_v._ref, cm)
+
+        def iou(cm_t):
+            row = math_ops.reduce_sum(cm_t, axis=0)
+            col = math_ops.reduce_sum(cm_t, axis=1)
+            diag = array_ops.matrix_diag_part(cm_t)
+            denom = row + col - diag
+            eps = ops_mod.convert_to_tensor(1e-12)
+            valid = math_ops.cast(math_ops.greater(denom, eps), "float32")
+            ious = diag / math_ops.maximum(denom, eps)
+            return math_ops.reduce_sum(ious * valid) / math_ops.maximum(
+                math_ops.reduce_sum(valid), ops_mod.convert_to_tensor(1.0))
+
+        return iou(cm_v._ref), iou(upd)
+
+
+def root_mean_squared_error(labels, predictions, weights=None,
+                            metrics_collections=None,
+                            updates_collections=None, name=None):
+    with ops_mod.name_scope(name, "rmse"):
+        value, update = mean(math_ops.squared_difference(
+            math_ops.cast(ops_mod.convert_to_tensor(predictions), "float32"),
+            math_ops.cast(ops_mod.convert_to_tensor(labels), "float32")),
+            weights)
+        return math_ops.sqrt(value), math_ops.sqrt(update)
+
+
+def mean_absolute_error(labels, predictions, weights=None,
+                        metrics_collections=None, updates_collections=None,
+                        name=None):
+    with ops_mod.name_scope(name, "mae"):
+        return mean(math_ops.abs(math_ops.subtract(
+            math_ops.cast(ops_mod.convert_to_tensor(predictions), "float32"),
+            math_ops.cast(ops_mod.convert_to_tensor(labels), "float32"))),
+            weights)
+
+
+def percentage_below(values, threshold, weights=None, **kw):
+    values = math_ops.cast(ops_mod.convert_to_tensor(values), "float32")
+    below = math_ops.cast(math_ops.less(
+        values, ops_mod.convert_to_tensor(float(threshold))), "float32")
+    return mean(below, weights)

@@ -1,0 +1,31 @@
+"""stf.nn namespace (ref: tensorflow/python/ops/nn.py)."""
+
+from ..ops.nn_ops import (
+    relu, relu6, elu, selu, gelu, leaky_relu, swish, silu,
+    softplus, softsign, softmax, log_softmax, l2_loss, bias_add,
+    softmax_cross_entropy_with_logits, softmax_cross_entropy_with_logits_v2,
+    sparse_softmax_cross_entropy_with_logits,
+    sigmoid_cross_entropy_with_logits, weighted_cross_entropy_with_logits,
+    conv2d, depthwise_conv2d, depthwise_conv2d_native, separable_conv2d,
+    conv3d, conv2d_transpose, atrous_conv2d,
+    max_pool, avg_pool, max_pool3d, avg_pool3d,
+    dropout, local_response_normalization, lrn, in_top_k, top_k,
+    xw_plus_b, log_poisson_loss,
+)
+from ..ops.nn_impl import (
+    moments, weighted_moments, fused_batch_norm, batch_normalization,
+    batch_norm_with_global_normalization, l2_normalize, zero_fraction,
+    normalize_moments, sufficient_statistics, nce_loss, sampled_softmax_loss,
+)
+from ..ops.embedding_ops import embedding_lookup, embedding_lookup_sparse
+from ..ops.math_ops import sigmoid, tanh
+from ..ops.rnn import (
+    dynamic_rnn, static_rnn, bidirectional_dynamic_rnn, raw_rnn,
+)
+from ..ops import rnn_cell
+from ..ops.candidate_sampling_ops import (
+    uniform_candidate_sampler, log_uniform_candidate_sampler,
+    learned_unigram_candidate_sampler, fixed_unigram_candidate_sampler,
+    compute_accidental_hits, all_candidate_sampler,
+)
+from ..ops.ctc_ops import ctc_loss, ctc_greedy_decoder

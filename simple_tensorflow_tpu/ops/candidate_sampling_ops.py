@@ -1,0 +1,128 @@
+"""Candidate samplers (ref: tensorflow/python/ops/candidate_sampling_ops.py,
+core/kernels/candidate_sampler_ops.cc, core/lib/random/distribution_sampler).
+
+Functional-RNG reimplementation: samplers draw from the per-step key stream
+like other random ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import random_seed as random_seed_mod
+from ..framework import tensor_shape as shape_mod
+
+
+def _sampler_op(op_type, true_classes, num_true, num_sampled, unique,
+                range_max, seed, name, extra=None):
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    true_classes = ops_mod.convert_to_tensor(true_classes,
+                                             dtype=dtypes_mod.int64)
+    attrs = {"num_true": int(num_true), "num_sampled": int(num_sampled),
+             "unique": bool(unique), "range_max": int(range_max),
+             "seed": op_seed, "_graph_seed": graph_seed}
+    attrs.update(extra or {})
+    batch = true_classes.shape[0].value
+    op = g.create_op(
+        op_type, [true_classes], attrs=attrs, name=name or op_type,
+        output_specs=[
+            (shape_mod.TensorShape([num_sampled]), dtypes_mod.int64),
+            (shape_mod.TensorShape([batch, num_true]), dtypes_mod.float32),
+            (shape_mod.TensorShape([num_sampled]), dtypes_mod.float32)])
+    return op.outputs[0], op.outputs[1], op.outputs[2]
+
+
+def _expected(counts_fn, ids, num_tries, range_max):
+    import jax.numpy as jnp
+
+    p = counts_fn(ids)
+    # probability each id appears at least once in num_tries draws
+    return -jnp.expm1(num_tries * jnp.log1p(-p))
+
+
+def _make_sampler(log_uniform):
+    def lower(ctx, op, inputs):
+        import jax
+        import jax.numpy as jnp
+
+        key = ctx.rng_for(op)
+        a = op.attrs
+        n, rmax = a["num_sampled"], a["range_max"]
+        if log_uniform:
+            u = jax.random.uniform(key, (n,))
+            sampled = (jnp.exp(u * jnp.log(rmax + 1.0)) - 1.0).astype(jnp.int64)
+            sampled = jnp.clip(sampled, 0, rmax - 1)
+
+            def prob(ids):
+                idsf = ids.astype(jnp.float32)
+                return (jnp.log((idsf + 2.0) / (idsf + 1.0)) /
+                        jnp.log(rmax + 1.0))
+        else:
+            sampled = jax.random.randint(key, (n,), 0, rmax).astype(jnp.int64)
+
+            def prob(ids):
+                return jnp.full(ids.shape, 1.0 / rmax, jnp.float32)
+
+        true_classes = inputs[0]
+        num_tries = n
+        true_exp = _expected(prob, true_classes, num_tries, rmax) if a["unique"] \
+            else prob(true_classes) * n
+        samp_exp = _expected(prob, sampled, num_tries, rmax) if a["unique"] \
+            else prob(sampled) * n
+        return [sampled, true_exp.astype(jnp.float32),
+                samp_exp.astype(jnp.float32)]
+
+    return lower
+
+
+op_registry.register("UniformCandidateSampler",
+                     lower=_make_sampler(log_uniform=False),
+                     is_stateful=True, n_outputs=3)
+op_registry.register("LogUniformCandidateSampler",
+                     lower=_make_sampler(log_uniform=True),
+                     is_stateful=True, n_outputs=3)
+
+
+def uniform_candidate_sampler(true_classes, num_true, num_sampled, unique,
+                              range_max, seed=None, name=None):
+    return _sampler_op("UniformCandidateSampler", true_classes, num_true,
+                       num_sampled, unique, range_max, seed, name)
+
+
+def log_uniform_candidate_sampler(true_classes, num_true, num_sampled, unique,
+                                  range_max, seed=None, name=None):
+    return _sampler_op("LogUniformCandidateSampler", true_classes, num_true,
+                       num_sampled, unique, range_max, seed, name)
+
+
+def learned_unigram_candidate_sampler(true_classes, num_true, num_sampled,
+                                      unique, range_max, seed=None, name=None):
+    # Degrades to uniform (the reference learns counts server-side).
+    return uniform_candidate_sampler(true_classes, num_true, num_sampled,
+                                     unique, range_max, seed, name)
+
+
+def fixed_unigram_candidate_sampler(true_classes, num_true, num_sampled,
+                                    unique, range_max, vocab_file="",
+                                    distortion=1.0, num_reserved_ids=0,
+                                    num_shards=1, shard=0, unigrams=(),
+                                    seed=None, name=None):
+    return uniform_candidate_sampler(true_classes, num_true, num_sampled,
+                                     unique, range_max, seed, name)
+
+
+def all_candidate_sampler(true_classes, num_true, num_sampled, unique,
+                          seed=None, name=None):
+    return uniform_candidate_sampler(true_classes, num_true, num_sampled,
+                                     unique, num_sampled, seed, name)
+
+
+def compute_accidental_hits(true_classes, sampled_candidates, num_true,
+                            seed=None, name=None):
+    raise NotImplementedError(
+        "compute_accidental_hits has dynamic output shape; mask accidental "
+        "hits densely on TPU (compare sampled ids against true ids).")

@@ -1,0 +1,155 @@
+"""Assertion ops (ref: tensorflow/python/ops/check_ops.py).
+
+Each assert_* returns an Operation suitable for control_dependencies; checks
+execute in-graph via a host callback (see logging_ops.Assert).
+"""
+
+from __future__ import annotations
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from . import math_ops
+from .logging_ops import Assert
+
+
+def _binary_assert(check, x, y, data, message, name):
+    x = ops_mod.convert_to_tensor(x)
+    y = ops_mod.convert_to_tensor(y, dtype=x.dtype.base_dtype)
+    cond = math_ops.reduce_all(check(x, y))
+    if data is None:
+        data = [x, y]
+    return Assert(cond, [message or ""] + list(data), name=name)
+
+
+def assert_equal(x, y, data=None, summarize=None, message=None, name=None):
+    return _binary_assert(math_ops.equal, x, y, data, message, name)
+
+
+def assert_none_equal(x, y, data=None, summarize=None, message=None, name=None):
+    return _binary_assert(math_ops.not_equal, x, y, data, message, name)
+
+
+def assert_less(x, y, data=None, summarize=None, message=None, name=None):
+    return _binary_assert(math_ops.less, x, y, data, message, name)
+
+
+def assert_less_equal(x, y, data=None, summarize=None, message=None, name=None):
+    return _binary_assert(math_ops.less_equal, x, y, data, message, name)
+
+
+def assert_greater(x, y, data=None, summarize=None, message=None, name=None):
+    return _binary_assert(math_ops.greater, x, y, data, message, name)
+
+
+def assert_greater_equal(x, y, data=None, summarize=None, message=None,
+                         name=None):
+    return _binary_assert(math_ops.greater_equal, x, y, data, message, name)
+
+
+def _unary_assert(check, x, data, message, name):
+    x = ops_mod.convert_to_tensor(x)
+    cond = math_ops.reduce_all(check(x))
+    return Assert(cond, [message or ""] + list(data or [x]), name=name)
+
+
+def assert_negative(x, data=None, summarize=None, message=None, name=None):
+    zero = ops_mod.convert_to_tensor(0, dtype=ops_mod.convert_to_tensor(x).dtype.base_dtype)
+    return _binary_assert(math_ops.less, x, zero, data, message, name)
+
+
+def assert_positive(x, data=None, summarize=None, message=None, name=None):
+    zero = ops_mod.convert_to_tensor(0, dtype=ops_mod.convert_to_tensor(x).dtype.base_dtype)
+    return _binary_assert(math_ops.greater, x, zero, data, message, name)
+
+
+def assert_non_negative(x, data=None, summarize=None, message=None, name=None):
+    zero = ops_mod.convert_to_tensor(0, dtype=ops_mod.convert_to_tensor(x).dtype.base_dtype)
+    return _binary_assert(math_ops.greater_equal, x, zero, data, message, name)
+
+
+def assert_non_positive(x, data=None, summarize=None, message=None, name=None):
+    zero = ops_mod.convert_to_tensor(0, dtype=ops_mod.convert_to_tensor(x).dtype.base_dtype)
+    return _binary_assert(math_ops.less_equal, x, zero, data, message, name)
+
+
+def assert_rank(x, rank, data=None, summarize=None, message=None, name=None):
+    x = ops_mod.convert_to_tensor(x)
+    static = x.shape.rank
+    if static is not None:
+        if static != int(rank):
+            raise ValueError(
+                message or f"Tensor {x.name} must have rank {rank}, got {static}")
+        from . import control_flow_ops
+
+        return control_flow_ops.no_op(name=name)
+    from . import array_ops
+
+    return _binary_assert(math_ops.equal, array_ops.rank(x),
+                          ops_mod.convert_to_tensor(int(rank)), data, message,
+                          name)
+
+
+def assert_rank_at_least(x, rank, data=None, summarize=None, message=None,
+                         name=None):
+    x = ops_mod.convert_to_tensor(x)
+    static = x.shape.rank
+    if static is not None:
+        if static < int(rank):
+            raise ValueError(
+                message or f"Tensor {x.name} must have rank >= {rank}")
+        from . import control_flow_ops
+
+        return control_flow_ops.no_op(name=name)
+    from . import array_ops
+
+    return _binary_assert(math_ops.greater_equal, array_ops.rank(x),
+                          ops_mod.convert_to_tensor(int(rank)), data, message,
+                          name)
+
+
+def assert_rank_in(x, ranks, data=None, summarize=None, message=None, name=None):
+    x = ops_mod.convert_to_tensor(x)
+    static = x.shape.rank
+    if static is not None:
+        if static not in [int(r) for r in ranks]:
+            raise ValueError(message or f"rank {static} not in {ranks}")
+        from . import control_flow_ops
+
+        return control_flow_ops.no_op(name=name)
+    raise ValueError("assert_rank_in needs static rank on TPU")
+
+
+def assert_type(tensor, tf_type, message=None, name=None):
+    tensor = ops_mod.convert_to_tensor(tensor)
+    if tensor.dtype.base_dtype != dtypes_mod.as_dtype(tf_type).base_dtype:
+        raise TypeError(
+            message or f"{tensor.name} must be of type {tf_type}")
+    from . import control_flow_ops
+
+    return control_flow_ops.no_op(name=name)
+
+
+def assert_integer(x, message=None, name=None):
+    x = ops_mod.convert_to_tensor(x)
+    if not x.dtype.is_integer:
+        raise TypeError(message or f"{x.name} must be integer")
+    from . import control_flow_ops
+
+    return control_flow_ops.no_op(name=name)
+
+
+def assert_scalar(tensor, name=None, message=None):
+    tensor = ops_mod.convert_to_tensor(tensor)
+    if tensor.shape.rank not in (None, 0):
+        raise ValueError(message or f"{tensor.name} must be scalar")
+    return tensor
+
+
+def assert_proper_iterable(values):
+    if isinstance(values, (str, bytes, ops_mod.Tensor)):
+        raise TypeError(f"Expected iterable, got {type(values)}")
+
+
+def is_numeric_tensor(tensor):
+    return isinstance(tensor, ops_mod.Tensor) and not (
+        tensor.dtype.name == "string" or tensor.dtype.is_bool)

@@ -1,0 +1,72 @@
+"""Gradient/value clipping (ref: tensorflow/python/ops/clip_ops.py)."""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..framework.indexed_slices import IndexedSlices
+from . import math_ops
+from .op_util import make_op
+
+
+def clip_by_value(t, clip_value_min, clip_value_max, name=None):
+    x = ops_mod.convert_to_tensor(t)
+    lo = ops_mod.convert_to_tensor(clip_value_min, dtype=x.dtype.base_dtype)
+    hi = ops_mod.convert_to_tensor(clip_value_max, dtype=x.dtype.base_dtype)
+    return make_op("ClipByValue", [x, lo, hi], name=name)
+
+
+def clip_by_norm(t, clip_norm, axes=None, name=None):
+    x = ops_mod.convert_to_tensor(t)
+    l2 = math_ops.sqrt(math_ops.reduce_sum(math_ops.square(x), axis=axes,
+                                           keepdims=True))
+    clip_norm_t = ops_mod.convert_to_tensor(clip_norm,
+                                            dtype=x.dtype.base_dtype)
+    scale = clip_norm_t / math_ops.maximum(l2, clip_norm_t)
+    return math_ops.multiply(x, scale, name=name)
+
+
+def global_norm(t_list, name=None):
+    half_squared = []
+    from . import nn_ops
+
+    for t in t_list:
+        if t is None:
+            continue
+        if isinstance(t, IndexedSlices):
+            t = t.values
+        half_squared.append(nn_ops.l2_loss(math_ops.cast(
+            ops_mod.convert_to_tensor(t), "float32")))
+    return math_ops.sqrt(
+        math_ops.multiply(math_ops.add_n(half_squared),
+                          ops_mod.convert_to_tensor(2.0)), name=name)
+
+
+def clip_by_global_norm(t_list, clip_norm, use_norm=None, name=None):
+    """(ref: clip_ops.py:201 ``clip_by_global_norm``)."""
+    if use_norm is None:
+        use_norm = global_norm(t_list)
+    clip_norm_t = ops_mod.convert_to_tensor(clip_norm, dtype="float32")
+    scale = clip_norm_t / math_ops.maximum(use_norm, clip_norm_t)
+    clipped = []
+    for t in t_list:
+        if t is None:
+            clipped.append(None)
+        elif isinstance(t, IndexedSlices):
+            clipped.append(IndexedSlices(
+                t.values * math_ops.cast(scale, t.values.dtype.base_dtype),
+                t.indices, t.dense_shape))
+        else:
+            t = ops_mod.convert_to_tensor(t)
+            clipped.append(t * math_ops.cast(scale, t.dtype.base_dtype))
+    return clipped, use_norm
+
+
+def clip_by_average_norm(t, clip_norm, name=None):
+    x = ops_mod.convert_to_tensor(t)
+    from . import array_ops
+
+    n = math_ops.cast(array_ops.size(x), x.dtype.base_dtype)
+    l2 = math_ops.sqrt(math_ops.reduce_sum(math_ops.square(x))) / n
+    clip_norm_t = ops_mod.convert_to_tensor(clip_norm, dtype=x.dtype.base_dtype)
+    scale = clip_norm_t / math_ops.maximum(l2, clip_norm_t)
+    return math_ops.multiply(x, scale, name=name)

@@ -1,0 +1,374 @@
+"""Control flow: cond, while_loop, case, group — structured, XLA-native.
+
+TPU-native redesign of the reference's dataflow control flow
+(ref: tensorflow/python/ops/control_flow_ops.py — ``cond`` builds
+Switch/Merge nodes, ``while_loop`` builds Enter/Exit/NextIteration frames
+executed by the dynamic executor, core/kernels/control_flow_ops.cc).
+Dynamic dataflow control flow cannot run on the MXU pipeline; XLA requires
+*structured* control flow. So branches/bodies are built as FuncGraphs
+(nested graphs with captures) and lower to lax.cond / lax.while_loop —
+single compiled program, compiler-visible control flow.
+
+Differences from the reference, by hardware necessity:
+- loop-carried shapes must be invariant (XLA); shape_invariants accepted but
+  must equal the input shapes,
+- reverse-mode gradients flow through ``cond`` (lax.cond is differentiable);
+  gradients through ``while_loop`` require a statically bounded loop — use
+  stf.scan / stf.foldl (lax.scan) for differentiable loops, as dynamic_rnn
+  does.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, List, Sequence
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import lowering as lowering_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+
+Tensor = ops_mod.Tensor
+FuncGraph = ops_mod.FuncGraph
+
+
+# -- structure utils ---------------------------------------------------------
+
+def _flatten(structure):
+    """Flatten nested (list/tuple/dict) structures of Tensors."""
+    flat: List[Any] = []
+
+    def rec(s):
+        if isinstance(s, (list, builtins.tuple)) and not isinstance(s, str):
+            for x in s:
+                rec(x)
+        elif isinstance(s, dict):
+            for k in sorted(s):
+                rec(s[k])
+        else:
+            flat.append(s)
+
+    rec(structure)
+    return flat
+
+
+def _pack_like(structure, flat):
+    it = iter(flat)
+
+    def rec(s):
+        if isinstance(s, (list, builtins.tuple)) and not isinstance(s, str):
+            vals = [rec(x) for x in s]
+            return type(s)(vals) if not hasattr(s, "_fields") else type(s)(*vals)
+        if isinstance(s, dict):
+            return {k: rec(s[k]) for k in sorted(s)}
+        return next(it)
+
+    return rec(structure)
+
+
+# -- simple ops --------------------------------------------------------------
+
+def _lower_noop(ctx, op, inputs):
+    return []
+
+
+op_registry.register("NoOp", lower=_lower_noop, is_stateful=True, n_outputs=0)
+op_registry.register("Group", lower=_lower_noop, is_stateful=True, n_outputs=0)
+
+
+def no_op(name=None):
+    g = ops_mod.get_default_graph()
+    return g.create_op("NoOp", [], name=name or "NoOp", output_specs=[])
+
+
+def group(*inputs, **kwargs):
+    """(ref: control_flow_ops.py:2855 ``group``). An op that completes when
+    all inputs complete — here: a node whose control edges force its inputs
+    into the pruned program."""
+    name = kwargs.pop("name", None)
+    g = ops_mod.get_default_graph()
+    ctrl = []
+    for x in _flatten(list(inputs)):
+        if isinstance(x, Tensor):
+            ctrl.append(x.op)
+        elif isinstance(x, ops_mod.Operation):
+            ctrl.append(x)
+        elif hasattr(x, "op"):
+            ctrl.append(x.op)
+        elif x is None:
+            continue
+        else:
+            raise TypeError(f"group: cannot handle {x!r}")
+    return g.create_op("Group", [], name=name or "group",
+                       output_specs=[], control_inputs=ctrl)
+
+
+def tuple(tensors, name=None, control_inputs=None):  # noqa: A001
+    """(ref: control_flow_ops.py ``tuple``): gate tensors on joint readiness.
+    In a single XLA program this is ordering metadata only."""
+    g = ops_mod.get_default_graph()
+    gate = group(*[t for t in tensors if t is not None],
+                 *(control_inputs or []))
+    from . import array_ops
+
+    out = []
+    with g.control_dependencies([gate]):
+        for t in tensors:
+            out.append(array_ops.identity(t) if t is not None else None)
+    return out
+
+
+def with_dependencies(dependencies, output_tensor, name=None):
+    g = ops_mod.get_default_graph()
+    from . import array_ops
+
+    with g.control_dependencies(dependencies):
+        return array_ops.identity(output_tensor, name=name)
+
+
+# -- cond --------------------------------------------------------------------
+
+def _build_branch(fn, name):
+    g = ops_mod.get_default_graph()
+    fg = FuncGraph(name, outer_graph=g)
+    with ops_mod._as_current(fg):
+        result = fn()
+    flat = [ops_mod.convert_to_tensor(t) if not isinstance(t, Tensor) else t
+            for t in _flatten(result)]
+    # Convert in fg context so constants land inside the branch graph.
+    with ops_mod._as_current(fg):
+        flat = [t if t.graph is fg else fg._maybe_capture(t, name)
+                for t in flat]
+    fg.outputs = flat
+    return fg, result
+
+
+def cond(pred, true_fn=None, false_fn=None, strict=False, name=None,
+         fn1=None, fn2=None):
+    """(ref: control_flow_ops.py:1806 ``cond``) → lax.cond."""
+    true_fn = true_fn or fn1
+    false_fn = false_fn or fn2
+    if true_fn is None or false_fn is None:
+        raise ValueError("cond needs true_fn and false_fn")
+    g = ops_mod.get_default_graph()
+    pred = ops_mod.convert_to_tensor(pred)
+    with g.name_scope(name or "cond"):
+        tg, t_struct = _build_branch(true_fn, "cond_true")
+        fg, f_struct = _build_branch(false_fn, "cond_false")
+        if len(tg.outputs) != len(fg.outputs):
+            raise ValueError(
+                f"cond branches returned different numbers of tensors: "
+                f"{len(tg.outputs)} vs {len(fg.outputs)}")
+        for a, b in zip(tg.outputs, fg.outputs):
+            if a.dtype.base_dtype != b.dtype.base_dtype:
+                raise TypeError(
+                    f"cond branch dtypes differ: {a.dtype.name} vs {b.dtype.name}")
+        t_caps = [outer for outer, _ in tg.captures]
+        f_caps = [outer for outer, _ in fg.captures]
+        out_specs = [(a.shape.merge_with(b.shape) if a.shape.is_compatible_with(b.shape)
+                      else shape_mod.TensorShape(None), a.dtype)
+                     for a, b in zip(tg.outputs, fg.outputs)]
+        op = g.create_op(
+            "Cond", [pred] + t_caps + f_caps,
+            attrs={"true_graph": tg, "false_graph": fg,
+                   "n_true_caps": len(t_caps)},
+            name="cond_op", output_specs=out_specs)
+    if not op.outputs:
+        return None
+    flat_out = list(op.outputs)
+    packed = _pack_like(t_struct, flat_out)
+    if not strict and isinstance(packed, (list, builtins.tuple)) \
+            and len(packed) == 1:
+        # non-strict mode unwraps singleton sequences (reference semantics,
+        # ref control_flow_ops.py cond strict= docstring)
+        return packed[0]
+    return packed
+
+
+def _lower_cond(ctx, op, inputs):
+    import jax
+
+    tg = op.attrs["true_graph"]
+    fg = op.attrs["false_graph"]
+    n_t = op.attrs["n_true_caps"]
+    pred = inputs[0]
+    t_caps = inputs[1:1 + n_t]
+    f_caps = inputs[1 + n_t:]
+
+    def t_branch(tc, fc):
+        return builtins.tuple(lowering_mod.lower_func_graph(ctx, tg, [], tc))
+
+    def f_branch(tc, fc):
+        return builtins.tuple(lowering_mod.lower_func_graph(ctx, fg, [], fc))
+
+    if hasattr(pred, "ndim") and getattr(pred, "ndim", 0):
+        pred = pred.reshape(())
+    out = jax.lax.cond(pred, t_branch, f_branch, builtins.tuple(t_caps),
+                       builtins.tuple(f_caps))
+    return list(out)
+
+
+op_registry.register("Cond", lower=_lower_cond, n_outputs=None)
+
+
+def case(pred_fn_pairs, default=None, exclusive=False, strict=False,
+         name="case"):
+    """(ref: control_flow_ops.py:3211 ``case``) — chained lax.cond."""
+    if isinstance(pred_fn_pairs, dict):
+        pairs = list(pred_fn_pairs.items())
+    else:
+        pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+
+    def build(i):
+        if i == len(pairs):
+            return default
+        p, f = pairs[i]
+        return lambda: cond(p, f, build(i + 1))
+
+    with ops_mod.name_scope(name):
+        return build(0)()
+
+
+# -- while_loop --------------------------------------------------------------
+
+def _call_with(fn, loop_vars, flat_args):
+    """Rebuild the user's loop_vars structure from flat args and apply fn the
+    way the reference does (fn(*top_level_items))."""
+    if isinstance(loop_vars, (list, builtins.tuple)):
+        packed = _pack_like(builtins.list(loop_vars), flat_args)
+        return fn(*packed)
+    return fn(_pack_like(loop_vars, flat_args))
+
+
+def while_loop(cond, body, loop_vars, shape_invariants=None,
+               parallel_iterations=10, back_prop=True, swap_memory=False,
+               name=None, maximum_iterations=None):
+    """(ref: control_flow_ops.py:2775 ``while_loop``) → lax.while_loop.
+
+    For reverse-mode gradients use stf.scan / stf.foldl (lax.scan) — XLA
+    cannot differentiate an unbounded while loop (the reference does it by
+    stacking every iteration's intermediates in host memory, ref
+    core/kernels/stack_ops.cc — a pattern TPU HBM budgets rule out).
+    Loop-carried shapes must be invariant (XLA requirement).
+    """
+    g = ops_mod.get_default_graph()
+    flat_vars = [ops_mod.convert_to_tensor(v) for v in _flatten(loop_vars)]
+    with g.name_scope(name or "while"):
+        cg = FuncGraph("while_cond", outer_graph=g)
+        with ops_mod._as_current(cg):
+            c_args = [cg.add_input(v.dtype, v.shape, f"arg{i}")
+                      for i, v in enumerate(flat_vars)]
+            c_res = _call_with(cond, loop_vars, c_args)
+            cg.outputs = [ops_mod.convert_to_tensor(c_res)]
+        bg = FuncGraph("while_body", outer_graph=g)
+        with ops_mod._as_current(bg):
+            b_args = [bg.add_input(v.dtype, v.shape, f"arg{i}")
+                      for i, v in enumerate(flat_vars)]
+            b_res = _call_with(body, loop_vars, b_args)
+            b_flat = [ops_mod.convert_to_tensor(t) for t in _flatten(b_res)]
+            bg.outputs = b_flat
+        if len(b_flat) != len(flat_vars):
+            raise ValueError(
+                f"while_loop body returned {len(b_flat)} values for "
+                f"{len(flat_vars)} loop vars")
+        for v, o in zip(flat_vars, b_flat):
+            if v.dtype.base_dtype != o.dtype.base_dtype:
+                raise TypeError(
+                    f"Loop var dtype changed: {v.dtype.name} -> {o.dtype.name}")
+            if (shape_invariants is None and v.shape.is_fully_defined()
+                    and o.shape.is_fully_defined()
+                    and v.shape.as_list() != o.shape.as_list()):
+                raise ValueError(
+                    f"Loop var shape changed {v.shape} -> {o.shape}; XLA "
+                    "loops need invariant shapes.")
+        c_caps = [outer for outer, _ in cg.captures]
+        b_caps = [outer for outer, _ in bg.captures]
+        if maximum_iterations is not None:
+            from ..framework import constant_op as _const
+
+            mi = _const.constant_value(
+                ops_mod.convert_to_tensor(maximum_iterations))
+            if mi is None:
+                raise ValueError("maximum_iterations must be static on TPU")
+            maximum_iterations = int(mi)
+        op = g.create_op(
+            "While", flat_vars + c_caps + b_caps,
+            attrs={"cond_graph": cg, "body_graph": bg,
+                   "n_vars": len(flat_vars), "n_cond_caps": len(c_caps),
+                   "max_iterations": maximum_iterations},
+            name="while_op",
+            output_specs=[(v.shape, v.dtype) for v in flat_vars])
+    outs = builtins.list(op.outputs)
+    if isinstance(loop_vars, (list, builtins.tuple)):
+        packed = _pack_like(builtins.list(loop_vars), outs)
+        if len(loop_vars) == 1:
+            return packed[0]
+        return builtins.tuple(packed) if isinstance(loop_vars, builtins.tuple) \
+            else packed
+    return _pack_like(loop_vars, outs)
+
+
+def _lower_while(ctx, op, inputs):
+    import jax
+    import jax.numpy as jnp
+
+    n = op.attrs["n_vars"]
+    n_cc = op.attrs["n_cond_caps"]
+    cg = op.attrs["cond_graph"]
+    bg = op.attrs["body_graph"]
+    max_iter = op.attrs.get("max_iterations")
+    init = builtins.tuple(inputs[:n])
+    c_caps = builtins.list(inputs[n:n + n_cc])
+    b_caps = builtins.list(inputs[n + n_cc:])
+
+    if max_iter is not None:
+        init = (jnp.asarray(0, jnp.int32),) + init
+
+        def cond_f(carry):
+            c = lowering_mod.lower_func_graph(
+                ctx, cg, builtins.list(carry[1:]), c_caps)[0]
+            return jnp.logical_and(jnp.reshape(c, ()), carry[0] < max_iter)
+
+        def body_f(carry):
+            out = lowering_mod.lower_func_graph(
+                ctx, bg, builtins.list(carry[1:]), b_caps)
+            return (carry[0] + 1,) + builtins.tuple(out)
+
+        final = jax.lax.while_loop(cond_f, body_f, init)
+        return builtins.list(final[1:])
+
+    def cond_f(carry):
+        c = lowering_mod.lower_func_graph(ctx, cg, builtins.list(carry), c_caps)[0]
+        return jnp.reshape(c, ())
+
+    def body_f(carry):
+        return builtins.tuple(
+            lowering_mod.lower_func_graph(ctx, bg, builtins.list(carry), b_caps))
+
+    final = jax.lax.while_loop(cond_f, body_f, init)
+    return builtins.list(final)
+
+
+op_registry.register("While", lower=_lower_while, n_outputs=None)
+
+
+def smart_cond(pred, true_fn, false_fn, name=None):
+    from ..framework import constant_op
+
+    if isinstance(pred, Tensor):
+        pv = constant_op.constant_value(pred)
+    else:
+        pv = np.asarray(pred)
+    if pv is not None:
+        return true_fn() if builtins.bool(pv) else false_fn()
+    return cond(pred, true_fn, false_fn, name=name)
+
+
+class ControlFlowContext:
+    """Kept for API parity; structured control flow has no frame contexts."""

@@ -1,0 +1,127 @@
+"""CTC loss/decoder (ref: tensorflow/python/ops/ctc_ops.py,
+core/kernels/ctc_loss_op.cc).
+
+TPU-native CTC: dense-label forward algorithm in log space via lax.scan
+(differentiable through jax autodiff) — no SparseTensor labels; pass dense
+labels with a padding value and label_length.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from .op_util import make_op
+
+NEG_INF = -1e30
+
+
+def _ctc_loss_impl(logits, labels, logit_lengths=None, label_lengths=None,
+                   blank_index=0):
+    """logits: [T, B, C]; labels: [B, L] dense."""
+    T, B, C = logits.shape
+    L = labels.shape[1]
+    if label_lengths is None:
+        label_lengths = jnp.full((B,), L, dtype=jnp.int32)
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # extended labels: blank, l1, blank, l2, ..., blank  (length 2L+1)
+    ext = jnp.full((B, 2 * L + 1), blank_index, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    S = 2 * L + 1
+    # repeat mask: ext[s] == ext[s-2]
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    alpha0 = jnp.full((B, S), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logprobs[0, jnp.arange(B), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(
+        1 < 2 * label_lengths + 1,
+        logprobs[0, jnp.arange(B), ext[:, 1]], NEG_INF))
+
+    def step(alpha, lp_t):
+        prev1 = jnp.concatenate([jnp.full((B, 1), NEG_INF), alpha[:, :-1]],
+                                axis=1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), NEG_INF), alpha[:, :-2]],
+                                axis=1)
+        prev2 = jnp.where(same_as_prev2, NEG_INF, prev2)
+        tot = jnp.logaddexp(alpha, jnp.logaddexp(prev1, prev2))
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        return tot + emit, None
+
+    def scan_step(carry, x):
+        t, alpha = carry
+        lp_t = x
+        new_alpha, _ = step(alpha, lp_t)
+        # time masking: past logit_length, keep alpha
+        keep = (t >= logit_lengths)[:, None] if logit_lengths is not None \
+            else jnp.zeros((B, 1), bool)
+        new_alpha = jnp.where(keep, alpha, new_alpha)
+        return (t + 1, new_alpha), None
+
+    (_, alpha_T), _ = jax.lax.scan(scan_step, (1, alpha0), logprobs[1:])
+    ll = label_lengths if label_lengths is not None else jnp.full((B,), L)
+    end1 = 2 * ll - 1
+    end2 = 2 * ll
+    idxB = jnp.arange(B)
+    final = jnp.logaddexp(alpha_T[idxB, end1], alpha_T[idxB, end2])
+    return -final
+
+
+op_registry.register_pure("CTCLossDense", _ctc_loss_impl)
+
+
+def ctc_loss(labels, inputs, sequence_length, label_length=None,
+             preprocess_collapse_repeated=False, ctc_merge_repeated=True,
+             time_major=True, blank_index=0, name=None):
+    """Dense-label CTC (see module docstring; the reference takes a
+    SparseTensor, ref ctc_ops.py:32)."""
+    from ..framework.sparse_tensor import SparseTensor
+    from . import sparse_ops, array_ops, math_ops
+
+    logits = ops_mod.convert_to_tensor(inputs)
+    if not time_major:
+        logits = array_ops.transpose(logits, [1, 0, 2])
+    if isinstance(labels, SparseTensor):
+        dense = sparse_ops.sparse_tensor_to_dense(labels, default_value=-1)
+        lab_len = math_ops.reduce_sum(
+            math_ops.cast(math_ops.greater_equal(
+                dense, array_ops.zeros_like(dense)), "int32"), axis=1)
+        labels_t = math_ops.maximum(dense, array_ops.zeros_like(dense))
+    else:
+        labels_t = ops_mod.convert_to_tensor(labels)
+        lab_len = (ops_mod.convert_to_tensor(label_length)
+                   if label_length is not None else None)
+    seq_len = ops_mod.convert_to_tensor(sequence_length)
+    inputs_list = [logits, math_ops.cast(labels_t, "int32")]
+    return make_op("CTCLossDense", inputs_list +
+                   [math_ops.cast(seq_len, "int32")] +
+                   ([math_ops.cast(lab_len, "int32")] if lab_len is not None else []),
+                   attrs={"blank_index": blank_index}, name=name)
+
+
+def _greedy_impl(logits, seq_len, merge_repeated=True, blank_index=0):
+    best = jnp.argmax(logits, axis=-1)  # [T, B]
+    return best.astype(jnp.int64)
+
+
+op_registry.register_pure("CTCGreedyDecode", _greedy_impl)
+
+
+def ctc_greedy_decoder(inputs, sequence_length, merge_repeated=True,
+                       blank_index=0, name=None):
+    """Returns the dense per-frame argmax path [T, B] (the reference returns
+    a SparseTensor of collapsed paths; collapse host-side, it is inherently
+    dynamic-shape)."""
+    logits = ops_mod.convert_to_tensor(inputs)
+    seq_len = ops_mod.convert_to_tensor(sequence_length)
+    path = make_op("CTCGreedyDecode", [logits, seq_len],
+                   attrs={"merge_repeated": merge_repeated,
+                          "blank_index": blank_index}, name=name)
+    return path

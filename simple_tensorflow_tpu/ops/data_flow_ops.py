@@ -1,0 +1,352 @@
+"""Queues + dataflow ops (ref: tensorflow/python/ops/data_flow_ops.py,
+core/kernels/{fifo_queue,random_shuffle_queue_op,dynamic_stitch_op,
+dynamic_partition_op}.cc).
+
+TPU-native split: queues are HOST-stage objects (the reference pins queue
+kernels to CPU too) driven by QueueRunner threads; dequeued numpy batches
+become boundary feeds of the compiled device step. dynamic_stitch/partition
+are device ops (static shapes).
+"""
+
+from __future__ import annotations
+
+import builtins
+import queue as py_queue
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import errors
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from .op_util import make_op
+
+
+# -- device ops --------------------------------------------------------------
+
+op_registry.register_pure(
+    "DynamicPartition",
+    lambda data, partitions, num_partitions=2: [
+        jnp.where((partitions == i)[(...,) + (None,) * (data.ndim - partitions.ndim)],
+                  data, jnp.zeros_like(data))
+        for i in builtins.range(num_partitions)], n_outputs=None)
+
+
+def _dynamic_stitch_impl(*args, n):
+    indices = args[:n]
+    data = args[n:]
+    total = builtins.max(int(np.max(np.asarray(i.shape))) for i in indices)
+    # size = max index + 1 must be static: use sum of sizes
+    size = builtins.sum(int(np.prod(i.shape)) for i in indices)
+    out_shape = (size,) + data[0].shape[indices[0].ndim:]
+    out = jnp.zeros(out_shape, data[0].dtype)
+    for idx, d in zip(indices, data):
+        flat_idx = jnp.reshape(idx, (-1,))
+        flat_d = jnp.reshape(d, (-1,) + out_shape[1:])
+        out = out.at[flat_idx].set(flat_d)
+    return out
+
+
+op_registry.register_pure("DynamicStitch", _dynamic_stitch_impl)
+
+
+def dynamic_partition(data, partitions, num_partitions, name=None):
+    """Masked dense partitions (XLA-static; rows not in partition i are
+    zero). The reference returns ragged pieces — impossible with static
+    shapes; masking gives the common all-reduce/sum use-cases the same
+    result."""
+    data = ops_mod.convert_to_tensor(data)
+    partitions = ops_mod.convert_to_tensor(partitions)
+    return make_op("DynamicPartition", [data, partitions],
+                   attrs={"num_partitions": int(num_partitions)},
+                   name=name, n_out=int(num_partitions))
+
+
+def dynamic_stitch(indices, data, name=None):
+    idx_t = [ops_mod.convert_to_tensor(i, dtype=dtypes_mod.int32)
+             for i in indices]
+    data_t = [ops_mod.convert_to_tensor(d) for d in data]
+    return make_op("DynamicStitch", idx_t + data_t,
+                   attrs={"n": len(idx_t)}, name=name)
+
+
+# -- host queues -------------------------------------------------------------
+
+class QueueBase:
+    """(ref: data_flow_ops.py:96 ``class QueueBase``). Host object; its
+    graph presence is a set of host ops keyed by queue name."""
+
+    _registry = {}
+    _counter = [0]
+
+    def __init__(self, dtypes, shapes, names, queue_ref, name):
+        self._dtypes = [dtypes_mod.as_dtype(d) for d in dtypes]
+        self._shapes = ([shape_mod.as_shape(s) for s in shapes]
+                        if shapes is not None
+                        else [shape_mod.TensorShape(None)] * len(self._dtypes))
+        self._name = name
+        self._closed = False
+        QueueBase._registry[name] = self
+
+    # python-side storage defined by subclass: self._q
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def dtypes(self):
+        return self._dtypes
+
+    @property
+    def shapes(self):
+        return self._shapes
+
+    @property
+    def queue_ref(self):
+        return self._name
+
+    # -- graph endpoints -----------------------------------------------------
+    def enqueue(self, vals, name=None):
+        tensors = self._normalize(vals)
+        g = ops_mod.get_default_graph()
+        return g.create_op("QueueEnqueue", list(tensors),
+                           attrs={"queue_name": self._name},
+                           name=name or f"{self._name}_enqueue",
+                           output_specs=[])
+
+    def enqueue_many(self, vals, name=None):
+        tensors = self._normalize(vals)
+        g = ops_mod.get_default_graph()
+        return g.create_op("QueueEnqueueMany", list(tensors),
+                           attrs={"queue_name": self._name},
+                           name=name or f"{self._name}_enqueue_many",
+                           output_specs=[])
+
+    def _normalize(self, vals):
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        return [ops_mod.convert_to_tensor(v, dtype=dt)
+                for v, dt in zip(vals, self._dtypes)]
+
+    def dequeue(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "QueueDequeue", [], attrs={"queue_name": self._name},
+            name=name or f"{self._name}_dequeue",
+            output_specs=[(s, d) for s, d in zip(self._shapes, self._dtypes)])
+        outs = op.outputs
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    def dequeue_many(self, n, name=None):
+        g = ops_mod.get_default_graph()
+        specs = [(shape_mod.TensorShape([n] + (s.as_list() if s.rank is not None
+                                               else [])), d)
+                 for s, d in zip(self._shapes, self._dtypes)]
+        op = g.create_op("QueueDequeueMany", [],
+                         attrs={"queue_name": self._name, "n": int(n)},
+                         name=name or f"{self._name}_dequeue_many",
+                         output_specs=specs)
+        outs = op.outputs
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    def close(self, cancel_pending_enqueues=False, name=None):
+        g = ops_mod.get_default_graph()
+        return g.create_op("QueueClose", [],
+                           attrs={"queue_name": self._name},
+                           name=name or f"{self._name}_close",
+                           output_specs=[])
+
+    def size(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op("QueueSize", [], attrs={"queue_name": self._name},
+                         name=name or f"{self._name}_size",
+                         output_specs=[(shape_mod.scalar(), dtypes_mod.int32)])
+        return op.outputs[0]
+
+    # -- host behavior (called by lowerings) --------------------------------
+    def _host_enqueue(self, items, timeout=10.0):
+        if self._closed:
+            raise errors.CancelledError(None, None,
+                                        f"Queue {self._name} closed")
+        self._q.put(builtins.tuple(items), timeout=timeout)
+
+    def _host_dequeue(self, timeout=30.0):
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except py_queue.Empty:
+                if self._closed:
+                    raise errors.OutOfRangeError(
+                        None, None,
+                        f"Queue {self._name} is closed and empty")
+                timeout -= 0.05
+                if timeout <= 0:
+                    raise errors.DeadlineExceededError(
+                        None, None, f"Dequeue from {self._name} timed out")
+
+    def _host_close(self):
+        self._closed = True
+
+    def _host_size(self):
+        return self._q.qsize()
+
+
+class FIFOQueue(QueueBase):
+    """(ref: data_flow_ops.py:611)."""
+
+    def __init__(self, capacity, dtypes, shapes=None, names=None,
+                 shared_name=None, name="fifo_queue"):
+        QueueBase._counter[0] += 1
+        uname = shared_name or f"{name}_{QueueBase._counter[0]}"
+        if not isinstance(dtypes, (list, tuple)):
+            dtypes = [dtypes]
+        self._q = py_queue.Queue(maxsize=capacity)
+        super().__init__(dtypes, shapes, names, uname, uname)
+        self._capacity = capacity
+
+
+class RandomShuffleQueue(QueueBase):
+    """(ref: data_flow_ops.py:705). Buffered shuffle on the host."""
+
+    def __init__(self, capacity, min_after_dequeue, dtypes, shapes=None,
+                 names=None, seed=None, shared_name=None,
+                 name="random_shuffle_queue"):
+        QueueBase._counter[0] += 1
+        uname = shared_name or f"{name}_{QueueBase._counter[0]}"
+        if not isinstance(dtypes, (list, tuple)):
+            dtypes = [dtypes]
+        self._q = py_queue.Queue(maxsize=capacity)
+        self._min_after = min_after_dequeue
+        self._rng = np.random.RandomState(seed)
+        self._buf = []
+        self._lock = threading.Lock()
+        super().__init__(dtypes, shapes, names, uname, uname)
+        self._capacity = capacity
+
+    def _host_enqueue(self, items, timeout=10.0):
+        with self._lock:
+            self._buf.append(builtins.tuple(items))
+            if len(self._buf) > self._capacity:
+                raise errors.ResourceExhaustedError(None, None, "queue full")
+
+    def _host_dequeue(self, timeout=30.0):
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while True:
+            with self._lock:
+                if len(self._buf) > self._min_after or (
+                        self._closed and self._buf):
+                    i = self._rng.randint(len(self._buf))
+                    return self._buf.pop(i)
+                if self._closed and not self._buf:
+                    raise errors.OutOfRangeError(
+                        None, None, f"Queue {self._name} closed and empty")
+            if _time.time() > deadline:
+                raise errors.DeadlineExceededError(None, None,
+                                                   "dequeue timeout")
+            _time.sleep(0.01)
+
+    def _host_size(self):
+        with self._lock:
+            return len(self._buf)
+
+
+class PaddingFIFOQueue(FIFOQueue):
+    pass
+
+
+class PriorityQueue(FIFOQueue):
+    pass
+
+
+def _get_queue(name) -> QueueBase:
+    q = QueueBase._registry.get(name)
+    if q is None:
+        raise errors.NotFoundError(None, None, f"Queue {name} not found")
+    return q
+
+
+def _lower_enqueue(ctx, op, inputs):
+    _get_queue(op.attrs["queue_name"])._host_enqueue(
+        [np.asarray(x) for x in inputs])
+    return []
+
+
+def _lower_enqueue_many(ctx, op, inputs):
+    q = _get_queue(op.attrs["queue_name"])
+    arrays = [np.asarray(x) for x in inputs]
+    for i in builtins.range(arrays[0].shape[0]):
+        q._host_enqueue([a[i] for a in arrays])
+    return []
+
+
+def _lower_dequeue(ctx, op, inputs):
+    item = _get_queue(op.attrs["queue_name"])._host_dequeue()
+    return list(item)
+
+
+def _lower_dequeue_many(ctx, op, inputs):
+    q = _get_queue(op.attrs["queue_name"])
+    n = op.attrs["n"]
+    rows = [q._host_dequeue() for _ in builtins.range(n)]
+    return [np.stack([r[i] for r in rows])
+            for i in builtins.range(len(rows[0]))]
+
+
+def _lower_close(ctx, op, inputs):
+    _get_queue(op.attrs["queue_name"])._host_close()
+    return []
+
+
+def _lower_size(ctx, op, inputs):
+    return [np.asarray(_get_queue(op.attrs["queue_name"])._host_size(),
+                       dtype=np.int32)]
+
+
+for _n, _fn, _nout in [("QueueEnqueue", _lower_enqueue, 0),
+                       ("QueueEnqueueMany", _lower_enqueue_many, 0),
+                       ("QueueDequeue", _lower_dequeue, None),
+                       ("QueueDequeueMany", _lower_dequeue_many, None),
+                       ("QueueClose", _lower_close, 0),
+                       ("QueueSize", _lower_size, 1)]:
+    op_registry.register(_n, lower=_fn, is_stateful=True, runs_on_host=True,
+                         n_outputs=_nout)
+
+
+class ConditionalAccumulator:
+    """(ref: core/kernels/conditional_accumulator.h). Host-side gradient
+    accumulator used by SyncReplicas — on TPU the mesh all-reduce replaces
+    it; kept for API parity."""
+
+    def __init__(self, dtype, shape=None, shared_name=None,
+                 name="conditional_accumulator"):
+        self._dtype = dtypes_mod.as_dtype(dtype)
+        self._sum = None
+        self._count = 0
+        self._lock = threading.Lock()
+        self._name = name
+
+    def apply_grad(self, grad, local_step=0, name=None):
+        with self._lock:
+            g = np.asarray(grad)
+            self._sum = g if self._sum is None else self._sum + g
+            self._count += 1
+        return None
+
+    def take_grad(self, num_required, name=None):
+        with self._lock:
+            if self._count < num_required:
+                raise errors.FailedPreconditionError(
+                    None, None, f"only {self._count} grads accumulated")
+            avg = self._sum / self._count
+            self._sum, self._count = None, 0
+            return avg
+
+    def num_accumulated(self, name=None):
+        return self._count

@@ -1,0 +1,84 @@
+"""Embedding lookup (ref: tensorflow/python/ops/embedding_ops.py,
+core/kernels/gather_op.cc).
+
+TPU-native: a lookup is an XLA gather on the (possibly mesh-sharded) table;
+with a table sharded over the 'ep'/'tp' mesh axis XLA turns the gather into
+an all-to-all — the reference's partition_strategy machinery (mod/div over
+parameter servers) collapses into sharding annotations. The gradient is an
+IndexedSlices-style scatter-add, applied sparsely by optimizers.
+"""
+
+from __future__ import annotations
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from . import array_ops, math_ops
+from . import variables as variables_mod
+
+
+def embedding_lookup(params, ids, partition_strategy="mod", name=None,
+                     validate_indices=True, max_norm=None):
+    """(ref: embedding_ops.py:110 ``embedding_lookup``)."""
+    if isinstance(params, variables_mod.PartitionedVariable):
+        params = list(params)
+    if isinstance(params, (list, tuple)) and len(params) > 1:
+        # Reference shards tables across PS; TPU: concat the logical pieces
+        # (the mesh shards the single array instead).
+        p0 = [p._ref if isinstance(p, variables_mod.Variable) else p
+              for p in params]
+        table = array_ops.concat(list(p0), axis=0)
+    else:
+        p = params[0] if isinstance(params, (list, tuple)) else params
+        table = p._ref if isinstance(p, variables_mod.Variable) else \
+            ops_mod.convert_to_tensor(p)
+    ids = ops_mod.convert_to_tensor(ids)
+    out = array_ops.gather(table, ids, name=name)
+    if max_norm is not None:
+        norms = math_ops.sqrt(math_ops.reduce_sum(math_ops.square(out),
+                                                  axis=-1, keepdims=True))
+        clip = ops_mod.convert_to_tensor(max_norm, dtype=out.dtype.base_dtype)
+        out = out * (clip / math_ops.maximum(norms, clip))
+    return out
+
+
+def embedding_lookup_sparse(params, sp_ids, sp_weights,
+                            partition_strategy="mod", name=None,
+                            combiner="mean", max_norm=None):
+    """(ref: embedding_ops.py ``embedding_lookup_sparse``). Fixed-capacity
+    COO ids; padding rows (id<0) contribute zero weight."""
+    from ..framework import constant_op
+    import numpy as np
+
+    ids = sp_ids.values
+    seg = sp_ids.indices[:, 0]
+    emb = embedding_lookup(params, math_ops.maximum(
+        ids, ops_mod.convert_to_tensor(0, dtype=ids.dtype.base_dtype)),
+        max_norm=max_norm)
+    if sp_weights is not None:
+        w = math_ops.cast(sp_weights.values, emb.dtype.base_dtype)
+    else:
+        w = array_ops.ones_like(ids, dtype=emb.dtype.base_dtype)
+    valid = math_ops.cast(math_ops.greater_equal(
+        ids, ops_mod.convert_to_tensor(0, dtype=ids.dtype.base_dtype)),
+        emb.dtype.base_dtype)
+    w = w * valid
+    weighted = emb * array_ops.expand_dims(w, -1)
+    dv = constant_op.constant_value(sp_ids.dense_shape)
+    if dv is None:
+        raise ValueError("embedding_lookup_sparse needs static dense_shape")
+    n_rows = int(np.asarray(dv)[0])
+    seg32 = math_ops.cast(seg, "int32")
+    summed = math_ops.unsorted_segment_sum(weighted, seg32, n_rows)
+    if combiner == "sum":
+        return summed
+    counts = math_ops.unsorted_segment_sum(w, seg32, n_rows)
+    counts = array_ops.expand_dims(counts, -1)
+    if combiner == "mean":
+        return summed / math_ops.maximum(
+            counts, ops_mod.convert_to_tensor(1e-8, dtype=summed.dtype.base_dtype))
+    if combiner == "sqrtn":
+        sq = math_ops.unsorted_segment_sum(math_ops.square(w), seg32, n_rows)
+        return summed / math_ops.maximum(
+            math_ops.sqrt(array_ops.expand_dims(sq, -1)),
+            ops_mod.convert_to_tensor(1e-8, dtype=summed.dtype.base_dtype))
+    raise ValueError(f"unknown combiner {combiner}")

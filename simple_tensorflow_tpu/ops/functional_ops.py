@@ -1,0 +1,283 @@
+"""Functional ops: map_fn, scan, foldl/foldr, py_func.
+
+(ref: tensorflow/python/ops/functional_ops.py, script_ops.py). The reference
+implements these on top of its dynamic while_loop + TensorArray; on TPU they
+lower directly to lax.scan — which IS the differentiable loop on XLA, so
+gradients flow through scan/map_fn/foldl (dynamic_rnn builds on this).
+py_func lowers to jax.pure_callback: host python embedded in the compiled
+step (the reference's py_func runs in the CPU executor thread).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Callable
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import lowering as lowering_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from .control_flow_ops import _flatten, _pack_like
+
+Tensor = ops_mod.Tensor
+FuncGraph = ops_mod.FuncGraph
+
+
+def _build_fn_graph(fn, arg_specs, name):
+    """Trace ``fn`` into a FuncGraph with inputs given by (shape, dtype)."""
+    g = ops_mod.get_default_graph()
+    fg = FuncGraph(name, outer_graph=g)
+    with ops_mod._as_current(fg):
+        args = [fg.add_input(dt, sh, f"arg{i}")
+                for i, (sh, dt) in enumerate(arg_specs)]
+        res = fn(*args) if len(args) > 1 else fn(args[0])
+        flat = [ops_mod.convert_to_tensor(t) for t in _flatten(res)]
+        fg.outputs = flat
+    return fg, res
+
+
+def _elem_spec(t: Tensor):
+    if t.shape.rank is None:
+        raise ValueError(f"map/scan input {t.name} needs known rank")
+    return (shape_mod.TensorShape(t.shape.as_list()[1:]), t.dtype)
+
+
+def map_fn(fn, elems, dtype=None, parallel_iterations=None, back_prop=True,
+           swap_memory=False, infer_shape=True, name=None):
+    """(ref: functional_ops.py ``map_fn``) → lax.scan over the leading axis
+    (XLA vectorizes/pipelines the loop; use stf.vectorized_map/jax.vmap via
+    layers for embarrassingly parallel maps)."""
+    single = not isinstance(elems, (list, builtins.tuple))
+    elems_flat = [ops_mod.convert_to_tensor(e) for e in _flatten(elems)]
+    g = ops_mod.get_default_graph()
+    with g.name_scope(name or "map"):
+        def wrapper(*args):
+            packed = args[0] if single else _pack_like(elems, builtins.list(args))
+            return fn(packed)
+
+        fg, res_struct = _build_fn_graph(
+            wrapper, [_elem_spec(e) for e in elems_flat], "map_body")
+        caps = [outer for outer, _ in fg.captures]
+        n = elems_flat[0].shape[0].value
+        if n is None:
+            raise ValueError("map_fn needs static leading dim on TPU")
+        out_specs = [(shape_mod.TensorShape([n] + o.shape.as_list()), o.dtype)
+                     for o in fg.outputs]
+        op = g.create_op("MapFn", elems_flat + caps,
+                         attrs={"body": fg, "n_elems": len(elems_flat)},
+                         name="map_op", output_specs=out_specs)
+    outs = builtins.list(op.outputs)
+    if len(outs) == 1 and not isinstance(res_struct, (list, builtins.tuple, dict)):
+        return outs[0]
+    return _pack_like(res_struct, outs)
+
+
+def _lower_map(ctx, op, inputs):
+    import jax
+
+    n = op.attrs["n_elems"]
+    fg = op.attrs["body"]
+    xs = builtins.tuple(inputs[:n])
+    caps = builtins.list(inputs[n:])
+
+    def step(carry, x):
+        outs = lowering_mod.lower_func_graph(ctx, fg, builtins.list(x), caps)
+        return carry, builtins.tuple(outs)
+
+    _, ys = jax.lax.scan(step, 0, xs)
+    return builtins.list(ys)
+
+
+op_registry.register("MapFn", lower=_lower_map, n_outputs=None)
+
+
+def scan(fn, elems, initializer=None, parallel_iterations=10, back_prop=True,
+         swap_memory=False, infer_shape=True, name=None):
+    """(ref: functional_ops.py ``scan``) → lax.scan, differentiable."""
+    single = not isinstance(elems, (list, builtins.tuple))
+    elems_flat = [ops_mod.convert_to_tensor(e) for e in _flatten(elems)]
+    n = elems_flat[0].shape[0].value
+    if n is None:
+        raise ValueError("scan needs static leading dim on TPU")
+    g = ops_mod.get_default_graph()
+    with g.name_scope(name or "scan"):
+        if initializer is None:
+            # first element is the initial accumulator (reference semantics)
+            from . import array_ops
+
+            init_struct = _pack_like(
+                elems, [e[0] for e in elems_flat]) if not single \
+                else elems_flat[0][0]
+            rest = [e[1:] for e in elems_flat]
+            out = scan(fn, _pack_like(elems, rest) if not single else rest[0],
+                       initializer=init_struct, name="scan_rest")
+            flat_out = _flatten(out)
+            full = [array_ops.concat(
+                [array_ops.expand_dims(i, 0), o], axis=0)
+                for i, o in zip(_flatten(init_struct), flat_out)]
+            return _pack_like(out, full) if isinstance(out, (list, builtins.tuple)) \
+                else full[0]
+        init_flat = [ops_mod.convert_to_tensor(i) for i in _flatten(initializer)]
+        n_carry = len(init_flat)
+
+        def wrapper(*args):
+            carry = _pack_like(initializer, builtins.list(args[:n_carry]))
+            x = args[n_carry] if single else _pack_like(
+                elems, builtins.list(args[n_carry:]))
+            return fn(carry, x)
+
+        specs = [(i.shape, i.dtype) for i in init_flat] + \
+                [_elem_spec(e) for e in elems_flat]
+        fg, res_struct = _build_fn_graph(wrapper, specs, "scan_body")
+        if len(fg.outputs) != n_carry:
+            raise ValueError("scan fn must return a structure like initializer")
+        caps = [outer for outer, _ in fg.captures]
+        out_specs = [(shape_mod.TensorShape([n] + o.shape.as_list()), o.dtype)
+                     for o in fg.outputs]
+        op = g.create_op("Scan", init_flat + elems_flat + caps,
+                         attrs={"body": fg, "n_carry": n_carry,
+                                "n_elems": len(elems_flat)},
+                         name="scan_op", output_specs=out_specs)
+    outs = builtins.list(op.outputs)
+    return _pack_like(initializer, outs) if len(outs) > 1 else outs[0]
+
+
+def _lower_scan(ctx, op, inputs):
+    import jax
+
+    nc = op.attrs["n_carry"]
+    ne = op.attrs["n_elems"]
+    fg = op.attrs["body"]
+    init = builtins.tuple(inputs[:nc])
+    xs = builtins.tuple(inputs[nc:nc + ne])
+    caps = builtins.list(inputs[nc + ne:])
+
+    def step(carry, x):
+        outs = lowering_mod.lower_func_graph(
+            ctx, fg, builtins.list(carry) + builtins.list(x), caps)
+        return builtins.tuple(outs), builtins.tuple(outs)
+
+    _, ys = jax.lax.scan(step, init, xs)
+    return builtins.list(ys)
+
+
+op_registry.register("Scan", lower=_lower_scan, n_outputs=None)
+
+
+def foldl(fn, elems, initializer=None, parallel_iterations=10, back_prop=True,
+          swap_memory=False, name=None):
+    """(ref: functional_ops.py ``foldl``) → lax.scan carry."""
+    single = not isinstance(elems, (list, builtins.tuple))
+    elems_flat = [ops_mod.convert_to_tensor(e) for e in _flatten(elems)]
+    if initializer is None:
+        init = elems_flat[0][0] if single else _pack_like(
+            elems, [e[0] for e in elems_flat])
+        rest = [e[1:] for e in elems_flat]
+        return foldl(fn, _pack_like(elems, rest) if not single else rest[0],
+                     initializer=init, name=name)
+    init_flat = [ops_mod.convert_to_tensor(i) for i in _flatten(initializer)]
+    n_carry = len(init_flat)
+    g = ops_mod.get_default_graph()
+    with g.name_scope(name or "foldl"):
+        def wrapper(*args):
+            carry = _pack_like(initializer, builtins.list(args[:n_carry]))
+            x = args[n_carry] if single else _pack_like(
+                elems, builtins.list(args[n_carry:]))
+            return fn(carry, x)
+
+        specs = [(i.shape, i.dtype) for i in init_flat] + \
+                [_elem_spec(e) for e in elems_flat]
+        fg, _ = _build_fn_graph(wrapper, specs, "foldl_body")
+        caps = [outer for outer, _ in fg.captures]
+        out_specs = [(o.shape, o.dtype) for o in fg.outputs]
+        op = g.create_op("Foldl", init_flat + elems_flat + caps,
+                         attrs={"body": fg, "n_carry": n_carry,
+                                "n_elems": len(elems_flat)},
+                         name="foldl_op", output_specs=out_specs)
+    outs = builtins.list(op.outputs)
+    return _pack_like(initializer, outs) if len(outs) > 1 else outs[0]
+
+
+def _lower_foldl(ctx, op, inputs):
+    import jax
+
+    nc = op.attrs["n_carry"]
+    ne = op.attrs["n_elems"]
+    fg = op.attrs["body"]
+    init = builtins.tuple(inputs[:nc])
+    xs = builtins.tuple(inputs[nc:nc + ne])
+    caps = builtins.list(inputs[nc + ne:])
+
+    def step(carry, x):
+        outs = lowering_mod.lower_func_graph(
+            ctx, fg, builtins.list(carry) + builtins.list(x), caps)
+        return builtins.tuple(outs), None
+
+    final, _ = jax.lax.scan(step, init, xs)
+    return builtins.list(final)
+
+
+op_registry.register("Foldl", lower=_lower_foldl, n_outputs=None)
+
+
+def foldr(fn, elems, initializer=None, parallel_iterations=10, back_prop=True,
+          swap_memory=False, name=None):
+    from . import array_ops
+
+    single = not isinstance(elems, (list, builtins.tuple))
+    rev = [array_ops.reverse(ops_mod.convert_to_tensor(e), [0])
+           for e in _flatten(elems)]
+    return foldl(fn, _pack_like(elems, rev) if not single else rev[0],
+                 initializer=initializer, name=name or "foldr")
+
+
+# -- py_func -----------------------------------------------------------------
+
+def py_func(func, inp, Tout, stateful=True, name=None):
+    """(ref: python/ops/script_ops.py ``py_func``) → jax.pure_callback: the
+    python function runs on the host inside the compiled step."""
+    g = ops_mod.get_default_graph()
+    inp_t = [ops_mod.convert_to_tensor(x) for x in inp]
+    single = not isinstance(Tout, (list, builtins.tuple))
+    touts = [Tout] if single else builtins.list(Tout)
+    touts = [dtypes_mod.as_dtype(t) for t in touts]
+    op = g.create_op(
+        "PyFunc", inp_t,
+        attrs={"func": func, "touts": builtins.tuple(touts),
+               "stateful": stateful},
+        name=name or "PyFunc",
+        output_specs=[(shape_mod.TensorShape(None), t) for t in touts])
+    return op.outputs[0] if single else builtins.list(op.outputs)
+
+
+def _lower_py_func(ctx, op, inputs):
+    import jax
+
+    func = op.attrs["func"]
+    touts = op.attrs["touts"]
+
+    out_shapes = []
+    for o in op.outputs:
+        if not o.shape.is_fully_defined():
+            raise ValueError(
+                f"py_func output {o.name}: set_shape() a static shape before "
+                "use (XLA needs static callback result shapes).")
+        out_shapes.append(jax.ShapeDtypeStruct(builtins.tuple(o.shape.as_list()),
+                                               o.dtype.np_dtype))
+
+    def cb(*args):
+        res = func(*[np.asarray(a) for a in args])
+        if not isinstance(res, (list, builtins.tuple)):
+            res = [res]
+        return builtins.tuple(
+            np.asarray(r, dtype=t.np_dtype) for r, t in zip(res, touts))
+
+    out = jax.pure_callback(cb, builtins.tuple(out_shapes), *inputs)
+    return builtins.list(out)
+
+
+op_registry.register("PyFunc", lower=_lower_py_func, is_stateful=True,
+                     n_outputs=None)

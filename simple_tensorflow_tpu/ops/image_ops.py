@@ -1,0 +1,476 @@
+"""Image ops (ref: tensorflow/python/ops/image_ops_impl.py,
+core/kernels/{resize_bilinear_op,adjust_contrast_op,colorspace_op,...}.cc).
+
+Device ops use jax.image / jnp (MXU/VPU friendly, fused by XLA); PNG/JPEG
+codecs run in the host stage (the reference pins decode ops to CPU too).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import random_seed as random_seed_mod
+from ..framework import tensor_shape as shape_mod
+from .op_util import make_op, unary
+from . import math_ops, array_ops
+
+ResizeMethod = type("ResizeMethod", (), {
+    "BILINEAR": 0, "NEAREST_NEIGHBOR": 1, "BICUBIC": 2, "AREA": 3})
+
+
+# -- registrations -----------------------------------------------------------
+
+_METHOD_NAME = {0: "bilinear", 1: "nearest", 2: "cubic", 3: "linear"}
+
+
+def _resize_impl(images, size=None, method=0, align_corners=False):
+    batched = images.ndim == 4
+    if not batched:
+        images = images[None]
+    b, h, w, c = images.shape
+    out = jax.image.resize(images.astype(jnp.float32),
+                           (b, size[0], size[1], c),
+                           method=_METHOD_NAME.get(method, "bilinear"))
+    if method == 1:
+        out = out.astype(images.dtype)
+    if not batched:
+        out = out[0]
+    return out
+
+
+op_registry.register_pure("ResizeImages", _resize_impl)
+op_registry.register_pure("ResizeBilinear",
+                          lambda x, size=None, align_corners=False:
+                          _resize_impl(x, size, 0, align_corners))
+op_registry.register_pure("ResizeNearestNeighbor",
+                          lambda x, size=None, align_corners=False:
+                          _resize_impl(x, size, 1, align_corners))
+op_registry.register_pure(
+    "RGBToGrayscale", lambda x: jnp.sum(
+        x.astype(jnp.float32) * jnp.asarray([0.2989, 0.587, 0.114]),
+        axis=-1, keepdims=True).astype(x.dtype))
+op_registry.register_pure(
+    "GrayscaleToRGB", lambda x: jnp.tile(x, (1,) * (x.ndim - 1) + (3,)))
+
+
+def _rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    diff = mx - mn
+    safe = jnp.where(diff > 0, diff, 1.0)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(diff > 0, h, 0.0)
+    s = jnp.where(mx > 0, diff / jnp.where(mx > 0, mx, 1.0), 0.0)
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+def _hsv_to_rgb(x):
+    h, s, v = x[..., 0], x[..., 1], x[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+op_registry.register_pure("RGBToHSV", _rgb_to_hsv)
+op_registry.register_pure("HSVToRGB", _hsv_to_rgb)
+op_registry.register_pure(
+    "AdjustBrightness", lambda x, delta=0.0: (
+        x.astype(jnp.float32) + delta).astype(x.dtype))
+op_registry.register_pure(
+    "AdjustContrast", lambda x, contrast_factor=1.0: (
+        (x.astype(jnp.float32) -
+         jnp.mean(x.astype(jnp.float32), axis=(-3, -2), keepdims=True)) *
+        contrast_factor +
+        jnp.mean(x.astype(jnp.float32), axis=(-3, -2), keepdims=True)
+    ).astype(x.dtype))
+
+
+def _adjust_hue(x, delta=0.0):
+    hsv = _rgb_to_hsv(x.astype(jnp.float32))
+    h = (hsv[..., 0] + delta) % 1.0
+    return _hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]],
+                                 axis=-1)).astype(x.dtype)
+
+
+def _adjust_saturation(x, factor=1.0):
+    hsv = _rgb_to_hsv(x.astype(jnp.float32))
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return _hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]],
+                                 axis=-1)).astype(x.dtype)
+
+
+op_registry.register_pure("AdjustHue", _adjust_hue)
+op_registry.register_pure("AdjustSaturation", _adjust_saturation)
+op_registry.register_pure(
+    "PerImageStandardization", lambda x: (
+        (x.astype(jnp.float32) -
+         jnp.mean(x.astype(jnp.float32), axis=(-3, -2, -1), keepdims=True)) /
+        jnp.maximum(jnp.std(x.astype(jnp.float32), axis=(-3, -2, -1),
+                            keepdims=True),
+                    1.0 / jnp.sqrt(jnp.asarray(
+                        float(np.prod(x.shape[-3:])), jnp.float32)))))
+op_registry.register_pure("FlipLeftRight", lambda x: jnp.flip(x, axis=-2))
+op_registry.register_pure("FlipUpDown", lambda x: jnp.flip(x, axis=-3))
+op_registry.register_pure("Rot90", lambda x, k=1: jnp.rot90(
+    x, k=k, axes=(-3, -2)))
+op_registry.register_pure(
+    "CropToBoundingBox",
+    lambda x, offset_height=0, offset_width=0, target_height=0,
+    target_width=0: x[..., offset_height:offset_height + target_height,
+                      offset_width:offset_width + target_width, :])
+
+
+def _pad_to_bbox(x, offset_height=0, offset_width=0, target_height=0,
+                 target_width=0):
+    h, w = x.shape[-3], x.shape[-2]
+    pads = [(0, 0)] * (x.ndim - 3) + [
+        (offset_height, target_height - h - offset_height),
+        (offset_width, target_width - w - offset_width), (0, 0)]
+    return jnp.pad(x, pads)
+
+
+op_registry.register_pure("PadToBoundingBox", _pad_to_bbox)
+
+
+def _random_flip(key, op, inputs):
+    import jax as _jax
+
+    x = inputs[0]
+    axis = op.attrs["axis"]
+    flip = _jax.random.bernoulli(key, 0.5)
+    return [jnp.where(flip, jnp.flip(x, axis=axis), x)]
+
+
+op_registry.register("RandomFlip",
+                     lower=lambda ctx, op, inputs: _random_flip(
+                         ctx.rng_for(op), op, inputs), is_stateful=True)
+
+
+def _central_crop_impl(x, fraction=1.0):
+    h, w = x.shape[-3], x.shape[-2]
+    ch = int(h * fraction)
+    cw = int(w * fraction)
+    oh = (h - ch) // 2
+    ow = (w - cw) // 2
+    return x[..., oh:oh + ch, ow:ow + cw, :]
+
+
+op_registry.register_pure("CentralCrop", _central_crop_impl)
+
+op_registry.register_pure(
+    "ConvertImageDtype", lambda x, dtype=None, saturate=False:
+    _convert_dtype_impl(x, dtype, saturate))
+
+
+def _convert_dtype_impl(x, dtype, saturate):
+    target = dtype.np_dtype
+    if np.issubdtype(np.dtype(target), np.floating):
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            return (x.astype(jnp.float32) /
+                    float(np.iinfo(np.dtype(x.dtype)).max)).astype(target)
+        return x.astype(target)
+    # float -> int
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        mx = float(np.iinfo(target).max)
+        return jnp.clip(x * (mx + 0.5), 0, mx).astype(target)
+    return x.astype(target)
+
+
+# -- host codecs -------------------------------------------------------------
+
+def _lower_decode_png(ctx, op, inputs):
+    from ..lib import png as png_lib
+
+    raw = inputs[0]
+    if hasattr(raw, "item"):
+        raw = raw.item() if raw.ndim == 0 else raw.ravel()[0]
+    if isinstance(raw, str):
+        raw = raw.encode("latin-1")
+    return [png_lib.decode(bytes(raw))]
+
+
+def _lower_encode_png(ctx, op, inputs):
+    from ..lib import png as png_lib
+
+    return [np.asarray(png_lib.encode(np.asarray(inputs[0])), dtype=object)]
+
+
+op_registry.register("DecodePng", lower=_lower_decode_png, is_stateful=True,
+                     runs_on_host=True)
+op_registry.register("EncodePng", lower=_lower_encode_png, is_stateful=True,
+                     runs_on_host=True)
+
+
+def _lower_decode_jpeg(ctx, op, inputs):
+    raise NotImplementedError(
+        "JPEG decode needs libjpeg; store datasets as PNG/TFRecord-raw on "
+        "TPU hosts, or decode with stf.py_func + PIL when available.")
+
+
+op_registry.register("DecodeJpeg", lower=_lower_decode_jpeg,
+                     is_stateful=True, runs_on_host=True)
+
+
+# -- public API --------------------------------------------------------------
+
+def resize_images(images, size, method=ResizeMethod.BILINEAR,
+                  align_corners=False):
+    """(ref: image_ops_impl.py:684 ``resize_images``)."""
+    x = ops_mod.convert_to_tensor(images)
+    from ..framework import constant_op
+
+    if isinstance(size, ops_mod.Tensor):
+        size = constant_op.constant_value(size)
+    size = tuple(int(s) for s in np.ravel(size))
+    return make_op("ResizeImages", [x], attrs={"size": size,
+                                               "method": int(method),
+                                               "align_corners": align_corners})
+
+
+def resize_bilinear(images, size, align_corners=False, name=None):
+    return resize_images(images, size, ResizeMethod.BILINEAR, align_corners)
+
+
+def resize_nearest_neighbor(images, size, align_corners=False, name=None):
+    return resize_images(images, size, ResizeMethod.NEAREST_NEIGHBOR,
+                         align_corners)
+
+
+def resize_image_with_crop_or_pad(image, target_height, target_width):
+    x = ops_mod.convert_to_tensor(image)
+    h = x.shape[-3].value
+    w = x.shape[-2].value
+    if h > target_height:
+        x = crop_to_bounding_box(x, (h - target_height) // 2, 0,
+                                 target_height, w)
+        h = target_height
+    if w > target_width:
+        x = crop_to_bounding_box(x, 0, (w - target_width) // 2, h,
+                                 target_width)
+        w = target_width
+    if h < target_height or w < target_width:
+        x = pad_to_bounding_box(x, (target_height - h) // 2,
+                                (target_width - w) // 2, target_height,
+                                target_width)
+    return x
+
+
+def rgb_to_grayscale(images, name=None):
+    return unary("RGBToGrayscale", images, name)
+
+
+def grayscale_to_rgb(images, name=None):
+    return unary("GrayscaleToRGB", images, name)
+
+
+def rgb_to_hsv(images, name=None):
+    return unary("RGBToHSV", images, name)
+
+
+def hsv_to_rgb(images, name=None):
+    return unary("HSVToRGB", images, name)
+
+
+def adjust_brightness(image, delta):
+    return unary("AdjustBrightness", image, attrs={"delta": float(delta)})
+
+
+def adjust_contrast(images, contrast_factor):
+    return unary("AdjustContrast", images,
+                 attrs={"contrast_factor": float(contrast_factor)})
+
+
+def adjust_hue(image, delta, name=None):
+    return unary("AdjustHue", image, name, attrs={"delta": float(delta)})
+
+
+def adjust_saturation(image, saturation_factor, name=None):
+    return unary("AdjustSaturation", image, name,
+                 attrs={"factor": float(saturation_factor)})
+
+
+def adjust_gamma(image, gamma=1, gain=1):
+    x = ops_mod.convert_to_tensor(image)
+    return math_ops.multiply(
+        math_ops.pow(math_ops.cast(x, "float32"),
+                     ops_mod.convert_to_tensor(float(gamma))),
+        ops_mod.convert_to_tensor(float(gain)))
+
+
+def per_image_standardization(image):
+    return unary("PerImageStandardization", image)
+
+
+def flip_left_right(image):
+    return unary("FlipLeftRight", image)
+
+
+def flip_up_down(image):
+    return unary("FlipUpDown", image)
+
+
+def rot90(image, k=1, name=None):
+    return unary("Rot90", image, name, attrs={"k": int(k)})
+
+
+def transpose_image(image):
+    x = ops_mod.convert_to_tensor(image)
+    if x.shape.rank == 4:
+        return array_ops.transpose(x, [0, 2, 1, 3])
+    return array_ops.transpose(x, [1, 0, 2])
+
+
+def random_flip_left_right(image, seed=None):
+    return _random_flip_op(image, -2, seed)
+
+
+def random_flip_up_down(image, seed=None):
+    return _random_flip_op(image, -3, seed)
+
+
+def _random_flip_op(image, axis, seed):
+    x = ops_mod.convert_to_tensor(image)
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    op = g.create_op("RandomFlip", [x],
+                     attrs={"axis": axis, "seed": op_seed,
+                            "_graph_seed": graph_seed},
+                     name="random_flip",
+                     output_specs=[(x.shape, x.dtype)])
+    return op.outputs[0]
+
+
+def random_brightness(image, max_delta, seed=None):
+    from . import random_ops
+
+    delta = random_ops.random_uniform([], -max_delta, max_delta, seed=seed)
+    x = ops_mod.convert_to_tensor(image)
+    return math_ops.cast(math_ops.add(math_ops.cast(x, "float32"), delta),
+                         x.dtype.base_dtype)
+
+
+def random_contrast(image, lower, upper, seed=None):
+    from . import random_ops
+
+    factor = random_ops.random_uniform([], lower, upper, seed=seed)
+    x = ops_mod.convert_to_tensor(image)
+    xf = math_ops.cast(x, "float32")
+    mean = math_ops.reduce_mean(xf, axis=[-3, -2], keepdims=True)
+    return math_ops.cast((xf - mean) * factor + mean, x.dtype.base_dtype)
+
+
+def crop_to_bounding_box(image, offset_height, offset_width, target_height,
+                         target_width):
+    return unary("CropToBoundingBox", image,
+                 attrs={"offset_height": int(offset_height),
+                        "offset_width": int(offset_width),
+                        "target_height": int(target_height),
+                        "target_width": int(target_width)})
+
+
+def pad_to_bounding_box(image, offset_height, offset_width, target_height,
+                        target_width):
+    return unary("PadToBoundingBox", image,
+                 attrs={"offset_height": int(offset_height),
+                        "offset_width": int(offset_width),
+                        "target_height": int(target_height),
+                        "target_width": int(target_width)})
+
+
+def central_crop(image, central_fraction):
+    return unary("CentralCrop", image,
+                 attrs={"fraction": float(central_fraction)})
+
+
+def convert_image_dtype(image, dtype, saturate=False, name=None):
+    x = ops_mod.convert_to_tensor(image)
+    dt = dtypes_mod.as_dtype(dtype)
+    if x.dtype.base_dtype == dt:
+        return x
+    return unary("ConvertImageDtype", x, name,
+                 attrs={"dtype": dt, "saturate": saturate})
+
+
+def decode_png(contents, channels=0, dtype=dtypes_mod.uint8, name=None):
+    t = ops_mod.convert_to_tensor(contents)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("DecodePng", [t], attrs={"channels": channels},
+                     name=name or "DecodePng",
+                     output_specs=[(shape_mod.TensorShape([None, None, None]),
+                                    dtypes_mod.as_dtype(dtype))])
+    return op.outputs[0]
+
+
+def encode_png(image, compression=-1, name=None):
+    t = ops_mod.convert_to_tensor(image)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("EncodePng", [t], attrs={},
+                     name=name or "EncodePng",
+                     output_specs=[(shape_mod.scalar(), dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def decode_jpeg(contents, channels=0, ratio=1, fancy_upscaling=True,
+                try_recover_truncated=False, acceptable_fraction=1,
+                dct_method="", name=None):
+    t = ops_mod.convert_to_tensor(contents)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("DecodeJpeg", [t], attrs={"channels": channels},
+                     name=name or "DecodeJpeg",
+                     output_specs=[(shape_mod.TensorShape([None, None, None]),
+                                    dtypes_mod.uint8)])
+    return op.outputs[0]
+
+
+def decode_image(contents, channels=None, name=None):
+    return decode_png(contents, channels or 0, name=name)
+
+
+def random_crop(value, size, seed=None, name=None):
+    from . import random_ops
+
+    return random_ops.random_crop(value, size, seed, name)
+
+
+def total_variation(images, name=None):
+    x = ops_mod.convert_to_tensor(images)
+    dh = x[..., 1:, :, :] - x[..., :-1, :, :]
+    dw = x[..., :, 1:, :] - x[..., :, :-1, :]
+    axes = list(range(1, x.shape.rank)) if x.shape.rank == 4 else None
+    return math_ops.reduce_sum(math_ops.abs(dh), axis=axes) + \
+        math_ops.reduce_sum(math_ops.abs(dw), axis=axes)
+
+
+def sample_distorted_bounding_box(image_size, bounding_boxes, seed=None,
+                                  **kwargs):
+    raise NotImplementedError(
+        "sample_distorted_bounding_box: dynamic crop geometry; use "
+        "stf.image.random_crop (static size) on TPU")
+
+
+def non_max_suppression(boxes, scores, max_output_size, iou_threshold=0.5,
+                        name=None):
+    raise NotImplementedError(
+        "non_max_suppression has data-dependent output size; TPU detection "
+        "pipelines use fixed-size padded NMS (planned pallas kernel)")
+
+
+def draw_bounding_boxes(images, boxes, name=None):
+    raise NotImplementedError

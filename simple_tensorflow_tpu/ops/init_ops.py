@@ -1,0 +1,222 @@
+"""Initializers (ref: tensorflow/python/ops/init_ops.py).
+
+Same surface as the reference; each initializer returns a graph tensor built
+from random/constant ops, so initialization runs on-device inside the
+variables-init XLA program (the reference materializes on CPU then copies).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import constant_op
+from . import random_ops
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, partition_info=None):
+        raise NotImplementedError
+
+    def get_config(self):
+        return {}
+
+
+class Zeros(Initializer):
+    def __init__(self, dtype=dtypes_mod.float32):
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        from . import array_ops
+
+        return array_ops.zeros(shape, dtype or self.dtype)
+
+
+class Ones(Initializer):
+    def __init__(self, dtype=dtypes_mod.float32):
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        from . import array_ops
+
+        return array_ops.ones(shape, dtype or self.dtype)
+
+
+class Constant(Initializer):
+    def __init__(self, value=0, dtype=dtypes_mod.float32, verify_shape=False):
+        self.value = value
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        dt = dtypes_mod.as_dtype(dtype or self.dtype)
+        arr = np.asarray(self.value, dtype=dt.np_dtype)
+        if arr.shape == ():
+            arr = np.full(tuple(int(s) for s in shape), arr, dtype=dt.np_dtype)
+        else:
+            arr = arr.reshape(tuple(int(s) for s in shape))
+        return constant_op.constant(arr)
+
+
+class RandomUniform(Initializer):
+    def __init__(self, minval=-0.05, maxval=0.05, seed=None,
+                 dtype=dtypes_mod.float32):
+        self.minval, self.maxval, self.seed = minval, maxval, seed
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        return random_ops.random_uniform(shape, self.minval, self.maxval,
+                                         dtype or self.dtype, seed=self.seed)
+
+
+class RandomNormal(Initializer):
+    def __init__(self, mean=0.0, stddev=1.0, seed=None, dtype=dtypes_mod.float32):
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        return random_ops.random_normal(shape, self.mean, self.stddev,
+                                        dtype or self.dtype, seed=self.seed)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, stddev=1.0, seed=None, dtype=dtypes_mod.float32):
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        return random_ops.truncated_normal(shape, self.mean, self.stddev,
+                                           dtype or self.dtype, seed=self.seed)
+
+
+class UniformUnitScaling(Initializer):
+    def __init__(self, factor=1.0, seed=None, dtype=dtypes_mod.float32):
+        self.factor, self.seed = factor, seed
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        input_size = 1.0
+        for dim in shape[:-1]:
+            input_size *= float(dim)
+        maxv = math.sqrt(3 / max(1.0, input_size)) * self.factor
+        return random_ops.random_uniform(shape, -maxv, maxv,
+                                         dtype or self.dtype, seed=self.seed)
+
+
+class VarianceScaling(Initializer):
+    """(ref: init_ops.py ``variance_scaling_initializer``)."""
+
+    def __init__(self, scale=1.0, mode="fan_in", distribution="truncated_normal",
+                 seed=None, dtype=dtypes_mod.float32):
+        if mode not in ("fan_in", "fan_out", "fan_avg"):
+            raise ValueError(f"bad mode {mode}")
+        self.scale, self.mode, self.distribution = scale, mode, distribution
+        self.seed = seed
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        fan_in, fan_out = _compute_fans(shape)
+        scale = self.scale
+        if self.mode == "fan_in":
+            scale /= max(1.0, fan_in)
+        elif self.mode == "fan_out":
+            scale /= max(1.0, fan_out)
+        else:
+            scale /= max(1.0, (fan_in + fan_out) / 2.0)
+        if self.distribution in ("truncated_normal", "normal"):
+            stddev = math.sqrt(scale) / 0.87962566103423978
+            return random_ops.truncated_normal(shape, 0.0, stddev,
+                                               dtype or self.dtype, self.seed)
+        limit = math.sqrt(3.0 * scale)
+        return random_ops.random_uniform(shape, -limit, limit,
+                                         dtype or self.dtype, self.seed)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, seed=None, dtype=dtypes_mod.float32):
+        self.gain, self.seed = gain, seed
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        dt = dtypes_mod.as_dtype(dtype or self.dtype)
+        shape = tuple(int(s) for s in shape)
+        if len(shape) < 2:
+            raise ValueError("Orthogonal init needs rank >= 2")
+        rng = np.random.RandomState(self.seed if self.seed is not None else 0)
+        num_rows = int(np.prod(shape[:-1]))
+        num_cols = shape[-1]
+        a = rng.normal(size=(max(num_rows, num_cols), min(num_rows, num_cols)))
+        q, r = np.linalg.qr(a)
+        q *= np.sign(np.diag(r))
+        if num_rows < num_cols:
+            q = q.T
+        return constant_op.constant(
+            (self.gain * q[:num_rows, :num_cols]).reshape(shape)
+            .astype(dt.np_dtype))
+
+
+class Identity(Initializer):
+    def __init__(self, gain=1.0, dtype=dtypes_mod.float32):
+        self.gain = gain
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+    def __call__(self, shape, dtype=None, partition_info=None):
+        dt = dtypes_mod.as_dtype(dtype or self.dtype)
+        if len(shape) != 2:
+            raise ValueError("Identity init needs rank 2")
+        return constant_op.constant(
+            self.gain * np.eye(int(shape[0]), int(shape[1]),
+                               dtype=dt.np_dtype))
+
+
+def _compute_fans(shape):
+    shape = [int(s) for s in shape]
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = 1
+    for dim in shape[:-2]:
+        receptive *= dim
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+# reference-style lowercase aliases
+zeros_initializer = Zeros
+ones_initializer = Ones
+constant_initializer = Constant
+random_uniform_initializer = RandomUniform
+random_normal_initializer = RandomNormal
+truncated_normal_initializer = TruncatedNormal
+uniform_unit_scaling_initializer = UniformUnitScaling
+orthogonal_initializer = Orthogonal
+identity_initializer = Identity
+
+
+def variance_scaling_initializer(scale=1.0, mode="fan_in",
+                                 distribution="truncated_normal", seed=None,
+                                 dtype=dtypes_mod.float32):
+    return VarianceScaling(scale, mode, distribution, seed, dtype)
+
+
+def glorot_uniform_initializer(seed=None, dtype=dtypes_mod.float32):
+    return VarianceScaling(1.0, "fan_avg", "uniform", seed, dtype)
+
+
+def glorot_normal_initializer(seed=None, dtype=dtypes_mod.float32):
+    return VarianceScaling(1.0, "fan_avg", "truncated_normal", seed, dtype)
+
+
+def he_uniform_initializer(seed=None, dtype=dtypes_mod.float32):
+    return VarianceScaling(2.0, "fan_in", "uniform", seed, dtype)
+
+
+def he_normal_initializer(seed=None, dtype=dtypes_mod.float32):
+    return VarianceScaling(2.0, "fan_in", "truncated_normal", seed, dtype)
+
+
+xavier_initializer = glorot_uniform_initializer

@@ -1,0 +1,167 @@
+"""Linear algebra ops (ref: tensorflow/python/ops/linalg_ops.py,
+core/kernels/{cholesky_op,qr_op_impl,svd_op_impl,determinant_op,
+matrix_inverse_op,matrix_solve_op}.cc)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from .op_util import make_op, unary
+
+op_registry.register_pure("Cholesky", jnp.linalg.cholesky)
+op_registry.register_pure("MatrixDeterminant", jnp.linalg.det)
+op_registry.register_pure("LogMatrixDeterminant",
+                          lambda x: list(jnp.linalg.slogdet(x)), n_outputs=2)
+op_registry.register_pure("MatrixInverse", lambda x, adjoint=False:
+                          jnp.linalg.inv(jnp.swapaxes(jnp.conj(x), -1, -2)
+                                         if adjoint else x))
+op_registry.register_pure("MatrixSolve", lambda a, b, adjoint=False:
+                          jnp.linalg.solve(jnp.swapaxes(jnp.conj(a), -1, -2)
+                                           if adjoint else a, b))
+op_registry.register_pure(
+    "MatrixTriangularSolve", lambda a, b, lower=True, adjoint=False:
+    jax.scipy.linalg.solve_triangular(a, b, lower=lower,
+                                      trans=2 if adjoint else 0))
+op_registry.register_pure("Qr", lambda x, full_matrices=False:
+                          list(jnp.linalg.qr(
+                              x, mode="complete" if full_matrices else "reduced")),
+                          n_outputs=2)
+op_registry.register_pure("Svd", lambda x, full_matrices=False, compute_uv=True:
+                          _svd_impl(x, full_matrices, compute_uv),
+                          n_outputs=None)
+op_registry.register_pure("SelfAdjointEigV2", lambda x, compute_v=True:
+                          _eigh_impl(x, compute_v), n_outputs=None)
+op_registry.register_pure("MatrixSolveLs",
+                          lambda a, b, l2_regularizer=0.0, fast=True:
+                          _lstsq_impl(a, b, l2_regularizer))
+op_registry.register_pure("CholeskyGrad", lambda l, grad: grad)  # parity stub
+op_registry.register_pure("MatrixExponential", jax.scipy.linalg.expm)
+
+
+def _svd_impl(x, full_matrices, compute_uv):
+    if compute_uv:
+        u, s, vt = jnp.linalg.svd(x, full_matrices=full_matrices)
+        # TF returns (s, u, v) with v NOT transposed.
+        return [s, u, jnp.swapaxes(vt, -1, -2)]
+    s = jnp.linalg.svd(x, compute_uv=False)
+    return [s]
+
+
+def _eigh_impl(x, compute_v):
+    w, v = jnp.linalg.eigh(x)
+    if compute_v:
+        return [w, v]
+    return [w]
+
+
+def _lstsq_impl(a, b, l2):
+    at = jnp.swapaxes(a, -1, -2)
+    gram = at @ a + l2 * jnp.eye(a.shape[-1], dtype=a.dtype)
+    return jnp.linalg.solve(gram, at @ b)
+
+
+def cholesky(input, name=None):  # noqa: A002
+    return unary("Cholesky", input, name)
+
+
+def matrix_determinant(input, name=None):  # noqa: A002
+    return unary("MatrixDeterminant", input, name)
+
+
+det = matrix_determinant
+
+
+def log_matrix_determinant(input, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    sign, logdet = make_op("LogMatrixDeterminant", [x], name=name, n_out=2)
+    return sign, logdet
+
+
+def matrix_inverse(input, adjoint=False, name=None):  # noqa: A002
+    return unary("MatrixInverse", input, name, attrs={"adjoint": adjoint})
+
+
+def matrix_solve(matrix, rhs, adjoint=False, name=None):
+    a = ops_mod.convert_to_tensor(matrix)
+    b = ops_mod.convert_to_tensor(rhs, dtype=a.dtype.base_dtype)
+    return make_op("MatrixSolve", [a, b], attrs={"adjoint": adjoint}, name=name)
+
+
+def matrix_triangular_solve(matrix, rhs, lower=True, adjoint=False, name=None):
+    a = ops_mod.convert_to_tensor(matrix)
+    b = ops_mod.convert_to_tensor(rhs, dtype=a.dtype.base_dtype)
+    return make_op("MatrixTriangularSolve", [a, b],
+                   attrs={"lower": lower, "adjoint": adjoint}, name=name)
+
+
+def matrix_solve_ls(matrix, rhs, l2_regularizer=0.0, fast=True, name=None):
+    a = ops_mod.convert_to_tensor(matrix)
+    b = ops_mod.convert_to_tensor(rhs, dtype=a.dtype.base_dtype)
+    return make_op("MatrixSolveLs", [a, b],
+                   attrs={"l2_regularizer": float(l2_regularizer),
+                          "fast": fast}, name=name)
+
+
+def qr(input, full_matrices=False, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    q, r = make_op("Qr", [x], attrs={"full_matrices": full_matrices},
+                   name=name, n_out=2)
+    return q, r
+
+
+def svd(tensor, full_matrices=False, compute_uv=True, name=None):
+    x = ops_mod.convert_to_tensor(tensor)
+    if compute_uv:
+        s, u, v = make_op("Svd", [x], attrs={"full_matrices": full_matrices,
+                                             "compute_uv": True},
+                          name=name, n_out=3)
+        return s, u, v
+    (s,) = make_op("Svd", [x], attrs={"full_matrices": full_matrices,
+                                      "compute_uv": False}, name=name, n_out=1)
+    return s
+
+
+def self_adjoint_eig(tensor, name=None):
+    x = ops_mod.convert_to_tensor(tensor)
+    e, v = make_op("SelfAdjointEigV2", [x], attrs={"compute_v": True},
+                   name=name, n_out=2)
+    return e, v
+
+
+def self_adjoint_eigvals(tensor, name=None):
+    x = ops_mod.convert_to_tensor(tensor)
+    (e,) = make_op("SelfAdjointEigV2", [x], attrs={"compute_v": False},
+                   name=name, n_out=1)
+    return e
+
+
+def matrix_exponential(input, name=None):  # noqa: A002
+    return unary("MatrixExponential", input, name)
+
+
+def norm(tensor, ord="euclidean", axis=None, keepdims=False, name=None,  # noqa: A002
+         keep_dims=None):
+    from . import math_ops
+
+    if keep_dims is not None:
+        keepdims = keep_dims
+    x = ops_mod.convert_to_tensor(tensor)
+    if ord in ("euclidean", 2, 2.0, "fro"):
+        return math_ops.sqrt(math_ops.reduce_sum(
+            math_ops.square(x), axis=axis, keepdims=keepdims), name=name)
+    if ord in (1, 1.0):
+        return math_ops.reduce_sum(math_ops.abs(x), axis=axis,
+                                   keepdims=keepdims, name=name)
+    if ord in (float("inf"), "inf"):
+        return math_ops.reduce_max(math_ops.abs(x), axis=axis,
+                                   keepdims=keepdims, name=name)
+    raise ValueError(f"unsupported norm order {ord}")
+
+
+def eye(*args, **kwargs):
+    from . import array_ops
+
+    return array_ops.eye(*args, **kwargs)

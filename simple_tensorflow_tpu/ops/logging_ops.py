@@ -1,0 +1,109 @@
+"""Logging/assert ops (ref: tensorflow/python/ops/logging_ops.py,
+core/kernels/logging_ops.cc).
+
+Print lowers to jax.debug.print (works inside the compiled XLA program via
+host callback); Assert to jax.debug — on TPU a failing in-graph assert
+cannot abort the step the way the reference's CPU kernel can, so the message
+is printed and the Session's debug hooks (stf.debug) provide hard checking.
+"""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from ..framework import dtypes as dtypes_mod
+
+
+def _lower_print(ctx, op, inputs):
+    import jax
+
+    data = inputs[1:]
+    message = op.attrs.get("message", "")
+    summarize = op.attrs.get("summarize", 3)
+    if data:
+        fmt = (message or "") + " ".join("{}" for _ in data)
+        jax.debug.print(fmt, *data)
+    return [inputs[0]]
+
+
+op_registry.register("Print", lower=_lower_print, is_stateful=True)
+
+
+def _lower_assert(ctx, op, inputs):
+    import jax
+    import jax.numpy as jnp
+
+    cond = inputs[0]
+    jax.debug.print("stf.Assert failed: {} (condition={})",
+                    op.attrs.get("message", ""), cond)
+    return []
+
+
+def _lower_assert_checked(ctx, op, inputs):
+    import jax
+
+    cond = inputs[0]
+    data = inputs[1:]
+
+    def _cb(c, *d):
+        import numpy as np
+
+        if not np.all(np.asarray(c)):
+            from ..framework import errors
+
+            raise errors.InvalidArgumentError(
+                None, None, "assertion failed: " +
+                " ".join(str(np.asarray(x)) for x in d))
+
+    jax.debug.callback(_cb, cond, *data)
+    return []
+
+
+op_registry.register("Assert", lower=_lower_assert_checked, is_stateful=True,
+                     n_outputs=0)
+
+
+def Print(input_, data, message=None, first_n=None, summarize=None, name=None):
+    """(ref: logging_ops.py:37 ``Print``)."""
+    x = ops_mod.convert_to_tensor(input_)
+    data_t = [ops_mod.convert_to_tensor(d) for d in data]
+    g = ops_mod.get_default_graph()
+    op = g.create_op("Print", [x] + data_t,
+                     attrs={"message": message or "",
+                            "summarize": summarize or 3},
+                     name=name or "Print",
+                     output_specs=[(x.shape, x.dtype)])
+    return op.outputs[0]
+
+
+def Assert(condition, data, summarize=None, name=None):
+    """(ref: control_flow_ops.py ``Assert``). String data folds into the
+    static message (strings never enter the XLA program)."""
+    from ..framework import constant_op
+
+    cond_t = ops_mod.convert_to_tensor(condition)
+    msg_parts = []
+    data_t = []
+    for d in data:
+        if isinstance(d, (str, bytes)):
+            msg_parts.append(d.decode() if isinstance(d, bytes) else d)
+            continue
+        t = ops_mod.convert_to_tensor(d)
+        if t.dtype.name == "string":
+            v = constant_op.constant_value(t)
+            msg_parts.append(str(v))
+            continue
+        data_t.append(t)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("Assert", [cond_t] + data_t,
+                     attrs={"summarize": summarize or 3,
+                            "message": " ".join(msg_parts)},
+                     name=name or "Assert", output_specs=[])
+    return op
+
+
+def histogram_summary(*a, **k):
+    from ..summary import summary
+
+    return summary.histogram(*a, **k)

@@ -1,0 +1,999 @@
+"""Math ops (ref: tensorflow/python/ops/math_ops.py, core/kernels/cwise_op_*.cc,
+core/kernels/matmul_op.cc, reduction_ops_*.cc, segment_reduction_ops.cc).
+
+Every op is a graph node whose lowering emits jax.numpy/lax — XLA fuses
+elementwise chains into matmul epilogues automatically, which is why there
+are no hand-fused variants here (the reference ships ~300 cwise CUDA kernels;
+on TPU the fusion is the compiler's job). MatMul accumulates in float32 for
+bf16 inputs (MXU-native behavior) via ``preferred_element_type``.
+"""
+
+from __future__ import annotations
+
+import builtins
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import constant_op
+from .op_util import binary, make_op, norm_axis, promote_args, unary
+
+Tensor = ops_mod.Tensor
+
+
+def _j():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# registrations: unary elementwise
+# ---------------------------------------------------------------------------
+
+def _reg_unary(op_type, fn):
+    op_registry.register_pure(op_type, fn)
+
+
+import jax.numpy as jnp  # noqa: E402  (jax is a hard dep; import once)
+import jax  # noqa: E402
+
+_reg_unary("Neg", jnp.negative)
+_reg_unary("Abs", jnp.abs)
+_reg_unary("Sign", jnp.sign)
+_reg_unary("Reciprocal", lambda x: 1 / x)
+_reg_unary("Square", jnp.square)
+_reg_unary("Sqrt", jnp.sqrt)
+_reg_unary("Rsqrt", lambda x: jax.lax.rsqrt(x))
+_reg_unary("Exp", jnp.exp)
+_reg_unary("Expm1", jnp.expm1)
+_reg_unary("Log", jnp.log)
+_reg_unary("Log1p", jnp.log1p)
+_reg_unary("Sin", jnp.sin)
+_reg_unary("Cos", jnp.cos)
+_reg_unary("Tan", jnp.tan)
+_reg_unary("Asin", jnp.arcsin)
+_reg_unary("Acos", jnp.arccos)
+_reg_unary("Atan", jnp.arctan)
+_reg_unary("Sinh", jnp.sinh)
+_reg_unary("Cosh", jnp.cosh)
+_reg_unary("Tanh", jnp.tanh)
+_reg_unary("Asinh", jnp.arcsinh)
+_reg_unary("Acosh", jnp.arccosh)
+_reg_unary("Atanh", jnp.arctanh)
+_reg_unary("Sigmoid", jax.nn.sigmoid)
+_reg_unary("Erf", jax.scipy.special.erf)
+_reg_unary("Erfc", jax.scipy.special.erfc)
+_reg_unary("Lgamma", jax.scipy.special.gammaln)
+_reg_unary("Digamma", jax.scipy.special.digamma)
+_reg_unary("Floor", jnp.floor)
+_reg_unary("Ceil", jnp.ceil)
+_reg_unary("Rint", jnp.rint)
+_reg_unary("Round", jnp.round)
+_reg_unary("IsNan", jnp.isnan)
+_reg_unary("IsInf", jnp.isinf)
+_reg_unary("IsFinite", jnp.isfinite)
+_reg_unary("LogicalNot", jnp.logical_not)
+_reg_unary("Invert", jnp.invert)
+_reg_unary("Real", jnp.real)
+_reg_unary("Imag", jnp.imag)
+_reg_unary("Conj", jnp.conj)
+_reg_unary("Angle", jnp.angle)
+_reg_unary("Softplus", jax.nn.softplus)
+_reg_unary("Softsign", jax.nn.soft_sign)
+
+# binary elementwise
+op_registry.register_pure("Add", jnp.add)
+op_registry.register_pure("Sub", jnp.subtract)
+op_registry.register_pure("Mul", jnp.multiply)
+# TF-1.0 tf.div: C-style truncating division for integers, true division
+# for floats (ref core/kernels/cwise_op_div.cc); truediv is always float.
+op_registry.register_pure(
+    "Div", lambda x, y: jax.lax.div(x, y)
+    if jnp.issubdtype(x.dtype, jnp.integer) else jnp.true_divide(x, y))
+op_registry.register_pure("TrueDiv", jnp.true_divide)
+op_registry.register_pure("RealDiv", jnp.true_divide)
+op_registry.register_pure("FloorDiv", jnp.floor_divide)
+op_registry.register_pure("TruncateDiv", lambda x, y: jnp.trunc(x / y).astype(x.dtype)
+                          if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jax.lax.div(x, y))
+op_registry.register_pure("Mod", jnp.mod)
+op_registry.register_pure("FloorMod", jnp.mod)
+op_registry.register_pure("TruncateMod", lambda x, y: jax.lax.rem(x, y))
+op_registry.register_pure("Pow", jnp.power)
+op_registry.register_pure("Maximum", jnp.maximum)
+op_registry.register_pure("Minimum", jnp.minimum)
+op_registry.register_pure("SquaredDifference", lambda x, y: jnp.square(x - y))
+op_registry.register_pure("Atan2", jnp.arctan2)
+op_registry.register_pure("Xlogy", lambda x, y: jnp.where(
+    x == 0, jnp.zeros_like(x), x * jnp.log(y)))
+op_registry.register_pure("Xdivy", lambda x, y: jnp.where(
+    x == 0, jnp.zeros_like(x), x / y))
+op_registry.register_pure("Zeta", lambda x, q: jax.scipy.special.zeta(x, q))
+op_registry.register_pure("Polygamma", lambda n, x: jax.scipy.special.polygamma(
+    n.astype(jnp.int32), x))
+op_registry.register_pure("Igamma", jax.scipy.special.gammainc)
+op_registry.register_pure("Igammac", jax.scipy.special.gammaincc)
+op_registry.register_pure("Betainc", jax.scipy.special.betainc)
+op_registry.register_pure("LogicalAnd", jnp.logical_and)
+op_registry.register_pure("LogicalOr", jnp.logical_or)
+op_registry.register_pure("LogicalXor", jnp.logical_xor)
+op_registry.register_pure("BitwiseAnd", jnp.bitwise_and)
+op_registry.register_pure("BitwiseOr", jnp.bitwise_or)
+op_registry.register_pure("BitwiseXor", jnp.bitwise_xor)
+op_registry.register_pure("LeftShift", jnp.left_shift)
+op_registry.register_pure("RightShift", jnp.right_shift)
+
+# comparisons
+op_registry.register_pure("Equal", jnp.equal)
+op_registry.register_pure("NotEqual", jnp.not_equal)
+op_registry.register_pure("Less", jnp.less)
+op_registry.register_pure("LessEqual", jnp.less_equal)
+op_registry.register_pure("Greater", jnp.greater)
+op_registry.register_pure("GreaterEqual", jnp.greater_equal)
+op_registry.register_pure("ApproximateEqual", lambda x, y, tolerance=1e-5:
+                          jnp.abs(x - y) < tolerance)
+
+# casts / misc
+op_registry.register_pure("Cast", lambda x, dtype: x.astype(dtype.np_dtype))
+op_registry.register_pure(
+    "Bitcast", lambda x, dtype: jax.lax.bitcast_convert_type(x, dtype.np_dtype))
+op_registry.register_pure("AddN", lambda *xs: builtins.sum(xs[1:], xs[0]))
+op_registry.register_pure("MatMul", lambda a, b, transpose_a=False,
+                          transpose_b=False: _matmul_impl(a, b, transpose_a,
+                                                          transpose_b))
+op_registry.register_pure("BatchMatMul", lambda a, b, adj_x=False, adj_y=False:
+                          jnp.matmul(jnp.swapaxes(a, -1, -2) if adj_x else a,
+                                     jnp.swapaxes(b, -1, -2) if adj_y else b,
+                                     preferred_element_type=_acc_type(a.dtype)))
+op_registry.register_pure("Cross", lambda a, b: jnp.cross(a, b))
+op_registry.register_pure("Tensordot", lambda a, b, axes: jnp.tensordot(
+    a, b, axes=axes))
+op_registry.register_pure("Einsum", lambda *xs, equation: jnp.einsum(
+    equation, *xs, preferred_element_type=_acc_type(xs[0].dtype)))
+op_registry.register_pure("ClipByValue", lambda x, lo, hi: jnp.clip(x, lo, hi))
+
+
+def _acc_type(dtype):
+    """MXU accumulates bf16/fp8 matmuls in f32; make that explicit so XLA
+    never silently downgrades (TPU perf+accuracy contract)."""
+    d = np.dtype(dtype)
+    if d.itemsize <= 2 and d.kind == "f" or str(d) == "bfloat16":
+        return np.float32
+    return None
+
+
+def _matmul_impl(a, b, transpose_a, transpose_b):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, preferred_element_type=_acc_type(a.dtype))
+
+
+# reductions: axis/keepdims are static attrs
+def _reg_reduce(op_type, fn):
+    op_registry.register_pure(
+        op_type, lambda x, axis=None, keepdims=False: fn(
+            x, axis=axis, keepdims=keepdims))
+
+
+_reg_reduce("Sum", jnp.sum)
+_reg_reduce("Mean", jnp.mean)
+_reg_reduce("Prod", jnp.prod)
+_reg_reduce("Max", jnp.max)
+_reg_reduce("Min", jnp.min)
+_reg_reduce("All", jnp.all)
+_reg_reduce("Any", jnp.any)
+_reg_reduce("LogSumExp", lambda x, axis=None, keepdims=False:
+            jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims))
+op_registry.register_pure("EuclideanNorm",
+                          lambda x, axis=None, keepdims=False:
+                          jnp.sqrt(jnp.sum(jnp.square(x), axis=axis,
+                                           keepdims=keepdims)))
+
+op_registry.register_pure("ArgMax", lambda x, axis=0, output_type=None:
+                          jnp.argmax(x, axis=axis).astype(
+                              output_type.np_dtype if output_type else jnp.int64))
+op_registry.register_pure("ArgMin", lambda x, axis=0, output_type=None:
+                          jnp.argmin(x, axis=axis).astype(
+                              output_type.np_dtype if output_type else jnp.int64))
+op_registry.register_pure("Cumsum", lambda x, axis=0, exclusive=False,
+                          reverse=False: _cum_impl(jnp.cumsum, x, axis,
+                                                   exclusive, reverse, 0))
+op_registry.register_pure("Cumprod", lambda x, axis=0, exclusive=False,
+                          reverse=False: _cum_impl(jnp.cumprod, x, axis,
+                                                   exclusive, reverse, 1))
+
+
+def _cum_impl(fn, x, axis, exclusive, reverse, ident):
+    if reverse:
+        x = jnp.flip(x, axis)
+    if exclusive:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, x.shape[axis])
+        x = jnp.pad(x, pad, constant_values=ident)[tuple(sl)]
+    out = fn(x, axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def _seg_ids_static(num_segments):
+    if num_segments is None:
+        raise ValueError(
+            "Segment reductions need a static num_segments on TPU (XLA "
+            "static shapes). Pass num_segments, or use sorted segment ops "
+            "with statically-known ids.")
+    return int(num_segments)
+
+
+op_registry.register_pure(
+    "UnsortedSegmentSum", lambda data, ids, num_segments=None:
+    jax.ops.segment_sum(data, ids, _seg_ids_static(num_segments)))
+op_registry.register_pure(
+    "UnsortedSegmentMax", lambda data, ids, num_segments=None:
+    jax.ops.segment_max(data, ids, _seg_ids_static(num_segments)))
+op_registry.register_pure(
+    "UnsortedSegmentMin", lambda data, ids, num_segments=None:
+    jax.ops.segment_min(data, ids, _seg_ids_static(num_segments)))
+op_registry.register_pure(
+    "UnsortedSegmentProd", lambda data, ids, num_segments=None:
+    jax.ops.segment_prod(data, ids, _seg_ids_static(num_segments)))
+
+
+def _sorted_segment(fn):
+    def impl(data, ids, num_segments=None):
+        return fn(data, ids, _seg_ids_static(num_segments))
+
+    return impl
+
+
+op_registry.register_pure("SegmentSum", _sorted_segment(jax.ops.segment_sum))
+op_registry.register_pure("SegmentMax", _sorted_segment(jax.ops.segment_max))
+op_registry.register_pure("SegmentMin", _sorted_segment(jax.ops.segment_min))
+op_registry.register_pure("SegmentProd", _sorted_segment(jax.ops.segment_prod))
+op_registry.register_pure(
+    "SegmentMean", lambda data, ids, num_segments=None: (
+        jax.ops.segment_sum(data, ids, _seg_ids_static(num_segments)) /
+        jnp.maximum(jax.ops.segment_sum(jnp.ones_like(data), ids,
+                                        _seg_ids_static(num_segments)), 1)))
+
+op_registry.register_pure("Bincount", lambda arr, size=None, weights=None:
+                          jnp.bincount(arr, weights=weights,
+                                       length=_seg_ids_static(size)))
+
+op_registry.register_pure("LinSpace", lambda start, stop, num: jnp.linspace(
+    start, stop, int(num)))
+op_registry.register_pure("Range", lambda start, limit, delta: jnp.arange(
+    start, limit, delta))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def add(x, y, name=None):
+    return binary("Add", x, y, name)
+
+
+def subtract(x, y, name=None):
+    return binary("Sub", x, y, name)
+
+
+sub = subtract
+
+
+def multiply(x, y, name=None):
+    return binary("Mul", x, y, name)
+
+
+mul = multiply
+
+
+def divide(x, y, name=None):
+    # tf.divide is Python-style true division (legacy tf.div truncates ints).
+    return binary("TrueDiv", x, y, name)
+
+
+def div(x, y, name=None):
+    return binary("Div", x, y, name)
+
+
+def truediv(x, y, name=None):
+    return binary("TrueDiv", x, y, name)
+
+
+def realdiv(x, y, name=None):
+    return binary("RealDiv", x, y, name)
+
+
+def floordiv(x, y, name=None):
+    return binary("FloorDiv", x, y, name)
+
+
+def truncatediv(x, y, name=None):
+    return binary("TruncateDiv", x, y, name)
+
+
+def mod(x, y, name=None):
+    return binary("Mod", x, y, name)
+
+
+def floormod(x, y, name=None):
+    return binary("FloorMod", x, y, name)
+
+
+def truncatemod(x, y, name=None):
+    return binary("TruncateMod", x, y, name)
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return binary("Pow", x, y, name)
+
+
+def maximum(x, y, name=None):
+    return binary("Maximum", x, y, name)
+
+
+def minimum(x, y, name=None):
+    return binary("Minimum", x, y, name)
+
+
+def squared_difference(x, y, name=None):
+    return binary("SquaredDifference", x, y, name)
+
+
+def atan2(y, x, name=None):
+    return binary("Atan2", y, x, name)
+
+
+def negative(x, name=None):
+    return unary("Neg", x, name)
+
+
+neg = negative
+
+
+def abs(x, name=None):  # noqa: A001
+    return unary("Abs", x, name)
+
+
+def sign(x, name=None):
+    return unary("Sign", x, name)
+
+
+def reciprocal(x, name=None):
+    return unary("Reciprocal", x, name)
+
+
+def square(x, name=None):
+    return unary("Square", x, name)
+
+
+def sqrt(x, name=None):
+    return unary("Sqrt", x, name)
+
+
+def rsqrt(x, name=None):
+    return unary("Rsqrt", x, name)
+
+
+def exp(x, name=None):
+    return unary("Exp", x, name)
+
+
+def expm1(x, name=None):
+    return unary("Expm1", x, name)
+
+
+def log(x, name=None):
+    return unary("Log", x, name)
+
+
+def log1p(x, name=None):
+    return unary("Log1p", x, name)
+
+
+def sin(x, name=None):
+    return unary("Sin", x, name)
+
+
+def cos(x, name=None):
+    return unary("Cos", x, name)
+
+
+def tan(x, name=None):
+    return unary("Tan", x, name)
+
+
+def asin(x, name=None):
+    return unary("Asin", x, name)
+
+
+def acos(x, name=None):
+    return unary("Acos", x, name)
+
+
+def atan(x, name=None):
+    return unary("Atan", x, name)
+
+
+def sinh(x, name=None):
+    return unary("Sinh", x, name)
+
+
+def cosh(x, name=None):
+    return unary("Cosh", x, name)
+
+
+def tanh(x, name=None):
+    return unary("Tanh", x, name)
+
+
+def asinh(x, name=None):
+    return unary("Asinh", x, name)
+
+
+def acosh(x, name=None):
+    return unary("Acosh", x, name)
+
+
+def atanh(x, name=None):
+    return unary("Atanh", x, name)
+
+
+def sigmoid(x, name=None):
+    return unary("Sigmoid", x, name)
+
+
+def erf(x, name=None):
+    return unary("Erf", x, name)
+
+
+def erfc(x, name=None):
+    return unary("Erfc", x, name)
+
+
+def lgamma(x, name=None):
+    return unary("Lgamma", x, name)
+
+
+def digamma(x, name=None):
+    return unary("Digamma", x, name)
+
+
+def igamma(a, x, name=None):
+    return binary("Igamma", a, x, name)
+
+
+def igammac(a, x, name=None):
+    return binary("Igammac", a, x, name)
+
+
+def zeta(x, q, name=None):
+    return binary("Zeta", x, q, name)
+
+
+def polygamma(a, x, name=None):
+    return binary("Polygamma", a, x, name)
+
+
+def betainc(a, b, x, name=None):
+    a = ops_mod.convert_to_tensor(a)
+    b = ops_mod.convert_to_tensor(b, dtype=a.dtype)
+    x = ops_mod.convert_to_tensor(x, dtype=a.dtype)
+    return make_op("Betainc", [a, b, x], name=name)
+
+
+def floor(x, name=None):
+    return unary("Floor", x, name)
+
+
+def ceil(x, name=None):
+    return unary("Ceil", x, name)
+
+
+def rint(x, name=None):
+    return unary("Rint", x, name)
+
+
+def round(x, name=None):  # noqa: A001
+    return unary("Round", x, name)
+
+
+def is_nan(x, name=None):
+    return unary("IsNan", x, name)
+
+
+def is_inf(x, name=None):
+    return unary("IsInf", x, name)
+
+
+def is_finite(x, name=None):
+    return unary("IsFinite", x, name)
+
+
+def logical_not(x, name=None):
+    return unary("LogicalNot", x, name)
+
+
+def logical_and(x, y, name=None):
+    return binary("LogicalAnd", x, y, name)
+
+
+def logical_or(x, y, name=None):
+    return binary("LogicalOr", x, y, name)
+
+
+def logical_xor(x, y, name=None):
+    return binary("LogicalXor", x, y, name)
+
+
+def equal(x, y, name=None):
+    return binary("Equal", x, y, name)
+
+
+def not_equal(x, y, name=None):
+    return binary("NotEqual", x, y, name)
+
+
+def less(x, y, name=None):
+    return binary("Less", x, y, name)
+
+
+def less_equal(x, y, name=None):
+    return binary("LessEqual", x, y, name)
+
+
+def greater(x, y, name=None):
+    return binary("Greater", x, y, name)
+
+
+def greater_equal(x, y, name=None):
+    return binary("GreaterEqual", x, y, name)
+
+
+def approximate_equal(x, y, tolerance=1e-5, name=None):
+    x, y = promote_args(x, y, "ApproximateEqual")
+    return make_op("ApproximateEqual", [x, y], attrs={"tolerance": tolerance},
+                   name=name)
+
+
+def real(x, name=None):
+    return unary("Real", x, name)
+
+
+def imag(x, name=None):
+    return unary("Imag", x, name)
+
+
+def conj(x, name=None):
+    return unary("Conj", x, name)
+
+
+def angle(x, name=None):
+    return unary("Angle", x, name)
+
+
+def cast(x, dtype, name=None):
+    from ..framework.indexed_slices import IndexedSlices
+
+    dtype = dtypes_mod.as_dtype(dtype)
+    if isinstance(x, IndexedSlices):
+        return IndexedSlices(cast(x.values, dtype, name), x.indices,
+                             x.dense_shape)
+    x = ops_mod.convert_to_tensor(x)
+    if x.dtype.base_dtype == dtype.base_dtype:
+        return x
+    return make_op("Cast", [x], attrs={"dtype": dtype.base_dtype}, name=name)
+
+
+def to_float(x, name="ToFloat"):
+    return cast(x, dtypes_mod.float32, name)
+
+
+def to_double(x, name="ToDouble"):
+    return cast(x, dtypes_mod.float64, name)
+
+
+def to_int32(x, name="ToInt32"):
+    return cast(x, dtypes_mod.int32, name)
+
+
+def to_int64(x, name="ToInt64"):
+    return cast(x, dtypes_mod.int64, name)
+
+
+def to_bfloat16(x, name="ToBFloat16"):
+    return cast(x, dtypes_mod.bfloat16, name)
+
+
+def saturate_cast(value, dtype, name=None):
+    dtype = dtypes_mod.as_dtype(dtype)
+    value = ops_mod.convert_to_tensor(value)
+    from . import clip_ops
+
+    if value.dtype.min < dtype.min or value.dtype.max > dtype.max:
+        value = clip_ops.clip_by_value(
+            value,
+            ops_mod.convert_to_tensor(builtins.max(value.dtype.min, dtype.min),
+                                      dtype=value.dtype),
+            ops_mod.convert_to_tensor(builtins.min(value.dtype.max, dtype.max),
+                                      dtype=value.dtype))
+    return cast(value, dtype, name)
+
+
+def add_n(inputs, name=None):
+    from ..framework.indexed_slices import IndexedSlices
+
+    if not inputs:
+        raise ValueError("add_n needs at least one input")
+    tensors = []
+    for x in inputs:
+        if isinstance(x, IndexedSlices):
+            from . import array_ops, embedding_ops
+
+            x = _densify_indexed_slices(x)
+        tensors.append(ops_mod.convert_to_tensor(x))
+    if len(tensors) == 1:
+        return tensors[0]
+    return make_op("AddN", tensors, name=name)
+
+
+def _densify_indexed_slices(x):
+    from . import array_ops
+
+    return array_ops.scatter_nd(
+        array_ops.expand_dims(x.indices, 1), x.values, x.dense_shape)
+
+
+def accumulate_n(inputs, shape=None, tensor_dtype=None, name=None):
+    return add_n(inputs, name=name)
+
+
+def matmul(a, b, transpose_a=False, transpose_b=False, adjoint_a=False,
+           adjoint_b=False, a_is_sparse=False, b_is_sparse=False, name=None):
+    a, b = promote_args(a, b, "MatMul")
+    if adjoint_a:
+        a, transpose_a = conj(a), True
+    if adjoint_b:
+        b, transpose_b = conj(b), True
+    if a.shape.rank is not None and a.shape.rank > 2:
+        return make_op("BatchMatMul", [a, b],
+                       attrs={"adj_x": transpose_a, "adj_y": transpose_b},
+                       name=name)
+    return make_op("MatMul", [a, b], attrs={"transpose_a": transpose_a,
+                                            "transpose_b": transpose_b},
+                   name=name)
+
+
+def batch_matmul(a, b, adj_x=False, adj_y=False, name=None):
+    a, b = promote_args(a, b, "BatchMatMul")
+    return make_op("BatchMatMul", [a, b], attrs={"adj_x": adj_x, "adj_y": adj_y},
+                   name=name)
+
+
+def tensordot(a, b, axes, name=None):
+    a, b = promote_args(a, b, "Tensordot")
+    if isinstance(axes, (list, tuple)) and len(axes) == 2:
+        axes = (tuple(np.ravel(axes[0]).tolist()), tuple(np.ravel(axes[1]).tolist()))
+    else:
+        axes = int(axes)
+    return make_op("Tensordot", [a, b], attrs={"axes": axes}, name=name)
+
+
+def einsum(equation, *inputs, name=None):
+    tensors = [ops_mod.convert_to_tensor(x) for x in inputs]
+    return make_op("Einsum", tensors, attrs={"equation": equation}, name=name)
+
+
+def cross(a, b, name=None):
+    return binary("Cross", a, b, name)
+
+
+# -- reductions --------------------------------------------------------------
+
+def _reduce(op_type, input_tensor, axis, keepdims, name,
+            reduction_indices=None, keep_dims=None):
+    if keep_dims is not None:
+        keepdims = keep_dims
+    if reduction_indices is not None and axis is None:
+        axis = reduction_indices
+    x = ops_mod.convert_to_tensor(input_tensor)
+    return make_op(op_type, [x], attrs={"axis": norm_axis(axis),
+                                        "keepdims": builtins.bool(keepdims)},
+                   name=name)
+
+
+def reduce_sum(input_tensor, axis=None, keepdims=False, name=None,
+               reduction_indices=None, keep_dims=None):
+    return _reduce("Sum", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_mean(input_tensor, axis=None, keepdims=False, name=None,
+                reduction_indices=None, keep_dims=None):
+    return _reduce("Mean", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_prod(input_tensor, axis=None, keepdims=False, name=None,
+                reduction_indices=None, keep_dims=None):
+    return _reduce("Prod", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_max(input_tensor, axis=None, keepdims=False, name=None,
+               reduction_indices=None, keep_dims=None):
+    return _reduce("Max", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_min(input_tensor, axis=None, keepdims=False, name=None,
+               reduction_indices=None, keep_dims=None):
+    return _reduce("Min", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_all(input_tensor, axis=None, keepdims=False, name=None,
+               reduction_indices=None, keep_dims=None):
+    return _reduce("All", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_any(input_tensor, axis=None, keepdims=False, name=None,
+               reduction_indices=None, keep_dims=None):
+    return _reduce("Any", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_logsumexp(input_tensor, axis=None, keepdims=False, name=None,
+                     reduction_indices=None, keep_dims=None):
+    return _reduce("LogSumExp", input_tensor, axis, keepdims, name,
+                   reduction_indices, keep_dims)
+
+
+def reduce_euclidean_norm(input_tensor, axis=None, keepdims=False, name=None):
+    return _reduce("EuclideanNorm", input_tensor, axis, keepdims, name)
+
+
+def count_nonzero(input_tensor, axis=None, keepdims=False,
+                  dtype=dtypes_mod.int64, name=None):
+    x = ops_mod.convert_to_tensor(input_tensor)
+    nz = cast(not_equal(x, ops_mod.convert_to_tensor(0, dtype=x.dtype.base_dtype)),
+              dtype)
+    return reduce_sum(nz, axis=axis, keepdims=keepdims, name=name)
+
+
+def argmax(input, axis=None, name=None, dimension=None, output_type=dtypes_mod.int64):  # noqa: A002
+    if dimension is not None and axis is None:
+        axis = dimension
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("ArgMax", [x], attrs={"axis": int(axis or 0),
+                                         "output_type": dtypes_mod.as_dtype(output_type)},
+                   name=name)
+
+
+def argmin(input, axis=None, name=None, dimension=None, output_type=dtypes_mod.int64):  # noqa: A002
+    if dimension is not None and axis is None:
+        axis = dimension
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("ArgMin", [x], attrs={"axis": int(axis or 0),
+                                         "output_type": dtypes_mod.as_dtype(output_type)},
+                   name=name)
+
+
+def cumsum(x, axis=0, exclusive=False, reverse=False, name=None):
+    x = ops_mod.convert_to_tensor(x)
+    return make_op("Cumsum", [x], attrs={"axis": int(axis),
+                                         "exclusive": exclusive,
+                                         "reverse": reverse}, name=name)
+
+
+def cumprod(x, axis=0, exclusive=False, reverse=False, name=None):
+    x = ops_mod.convert_to_tensor(x)
+    return make_op("Cumprod", [x], attrs={"axis": int(axis),
+                                          "exclusive": exclusive,
+                                          "reverse": reverse}, name=name)
+
+
+# -- segments ----------------------------------------------------------------
+
+def _static_num_segments(num_segments):
+    if num_segments is None:
+        return None
+    v = constant_op.constant_value(ops_mod.convert_to_tensor(num_segments))
+    if v is None:
+        raise ValueError("num_segments must be statically known on TPU")
+    return int(v)
+
+
+def _segment(op_type, data, segment_ids, num_segments=None, name=None):
+    data = ops_mod.convert_to_tensor(data)
+    segment_ids = ops_mod.convert_to_tensor(segment_ids)
+    if num_segments is None:
+        sv = constant_op.constant_value(segment_ids)
+        if sv is not None:
+            num_segments = int(np.max(sv)) + 1
+    return make_op(op_type, [data, segment_ids],
+                   attrs={"num_segments": _static_num_segments(num_segments)},
+                   name=name)
+
+
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    return _segment("SegmentSum", data, segment_ids, num_segments, name)
+
+
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    return _segment("SegmentMean", data, segment_ids, num_segments, name)
+
+
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    return _segment("SegmentMax", data, segment_ids, num_segments, name)
+
+
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    return _segment("SegmentMin", data, segment_ids, num_segments, name)
+
+
+def segment_prod(data, segment_ids, name=None, num_segments=None):
+    return _segment("SegmentProd", data, segment_ids, num_segments, name)
+
+
+def unsorted_segment_sum(data, segment_ids, num_segments, name=None):
+    return _segment("UnsortedSegmentSum", data, segment_ids, num_segments, name)
+
+
+def unsorted_segment_max(data, segment_ids, num_segments, name=None):
+    return _segment("UnsortedSegmentMax", data, segment_ids, num_segments, name)
+
+
+def unsorted_segment_min(data, segment_ids, num_segments, name=None):
+    return _segment("UnsortedSegmentMin", data, segment_ids, num_segments, name)
+
+
+def unsorted_segment_prod(data, segment_ids, num_segments, name=None):
+    return _segment("UnsortedSegmentProd", data, segment_ids, num_segments, name)
+
+
+def bincount(arr, weights=None, minlength=None, maxlength=None,
+             dtype=dtypes_mod.int32, name=None):
+    arr_t = ops_mod.convert_to_tensor(arr)
+    v = constant_op.constant_value(arr_t)
+    size = None
+    if v is not None and v.size:
+        size = int(np.max(v)) + 1
+    if minlength is not None:
+        size = builtins.max(size or 0, int(minlength))
+    if maxlength is not None:
+        size = builtins.min(size or int(maxlength), int(maxlength))
+    inputs = [arr_t]
+    out = make_op("Bincount", inputs, attrs={"size": size}, name=name)
+    return cast(out, dtype)
+
+
+# -- ranges ------------------------------------------------------------------
+
+def range(start, limit=None, delta=1, dtype=None, name="range"):  # noqa: A001
+    if limit is None:
+        start, limit = 0, start
+    sv = constant_op.constant_value(ops_mod.convert_to_tensor(start))
+    lv = constant_op.constant_value(ops_mod.convert_to_tensor(limit))
+    dv = constant_op.constant_value(ops_mod.convert_to_tensor(delta))
+    if sv is None or lv is None or dv is None:
+        raise ValueError("stf.range bounds must be static on TPU")
+    arr = np.arange(sv, lv, dv)
+    if dtype is not None:
+        arr = arr.astype(dtypes_mod.as_dtype(dtype).np_dtype)
+    elif arr.dtype == np.int64:
+        arr = arr.astype(np.int32)
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return constant_op.constant(arr, name=name)
+
+
+def linspace(start, stop, num, name=None):
+    sv = constant_op.constant_value(ops_mod.convert_to_tensor(start))
+    ev = constant_op.constant_value(ops_mod.convert_to_tensor(stop))
+    if sv is None or ev is None:
+        raise ValueError("stf.linspace bounds must be static on TPU")
+    return constant_op.constant(
+        np.linspace(sv, ev, int(num), dtype=np.asarray(sv).dtype), name=name or "LinSpace")
+
+
+lin_space = linspace
+
+
+# -- misc --------------------------------------------------------------------
+
+def logical_ops_placeholder():
+    pass
+
+
+def sigmoid_(x):
+    return sigmoid(x)
+
+
+def l2_normalize(x, axis=None, epsilon=1e-12, name=None, dim=None):
+    if dim is not None and axis is None:
+        axis = dim
+    x = ops_mod.convert_to_tensor(x)
+    sq = reduce_sum(square(x), axis=axis, keepdims=True)
+    inv = rsqrt(maximum(sq, ops_mod.convert_to_tensor(epsilon, dtype=x.dtype.base_dtype)))
+    return multiply(x, inv, name=name)
+
+
+def scalar_mul(scalar, x, name=None):
+    return multiply(ops_mod.convert_to_tensor(scalar), x, name=name)
+
+
+def trace(x, name=None):
+    from . import array_ops
+
+    x = ops_mod.convert_to_tensor(x)
+    return reduce_sum(array_ops.matrix_diag_part(x), axis=-1, name=name)
+
+
+def reduced_shape(input_shape, axes):
+    # kept for reference-API parity; rarely used directly
+    raise NotImplementedError("reduced_shape is internal in stf")
+
+
+# ---------------------------------------------------------------------------
+# Operator overloads on Tensor (ref: math_ops.py _OverrideBinaryOperatorHelper)
+# ---------------------------------------------------------------------------
+
+def _install_operators():
+    T = Tensor
+    T.__add__ = lambda self, other: add(self, other)
+    T.__radd__ = lambda self, other: add(other, self)
+    T.__sub__ = lambda self, other: subtract(self, other)
+    T.__rsub__ = lambda self, other: subtract(other, self)
+    T.__mul__ = lambda self, other: multiply(self, other)
+    T.__rmul__ = lambda self, other: multiply(other, self)
+    T.__truediv__ = lambda self, other: truediv(self, other)
+    T.__rtruediv__ = lambda self, other: truediv(other, self)
+    T.__floordiv__ = lambda self, other: floordiv(self, other)
+    T.__rfloordiv__ = lambda self, other: floordiv(other, self)
+    T.__mod__ = lambda self, other: floormod(self, other)
+    T.__rmod__ = lambda self, other: floormod(other, self)
+    T.__pow__ = lambda self, other: pow(self, other)
+    T.__rpow__ = lambda self, other: pow(other, self)
+    T.__matmul__ = lambda self, other: matmul(self, other)
+    T.__rmatmul__ = lambda self, other: matmul(other, self)
+    T.__neg__ = lambda self: negative(self)
+    T.__abs__ = lambda self: abs(self)
+    T.__invert__ = lambda self: logical_not(self)
+    T.__and__ = lambda self, other: logical_and(self, other)
+    T.__rand__ = lambda self, other: logical_and(other, self)
+    T.__or__ = lambda self, other: logical_or(self, other)
+    T.__ror__ = lambda self, other: logical_or(other, self)
+    T.__xor__ = lambda self, other: logical_xor(self, other)
+    T.__rxor__ = lambda self, other: logical_xor(other, self)
+    T.__lt__ = lambda self, other: less(self, other)
+    T.__le__ = lambda self, other: less_equal(self, other)
+    T.__gt__ = lambda self, other: greater(self, other)
+    T.__ge__ = lambda self, other: greater_equal(self, other)
+
+    from . import variables as variables_mod
+
+    V = variables_mod.Variable
+    for dunder in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+                   "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+                   "__rfloordiv__", "__mod__", "__rmod__", "__pow__",
+                   "__rpow__", "__matmul__", "__rmatmul__", "__neg__",
+                   "__abs__", "__lt__", "__le__", "__gt__", "__ge__"):
+        def _mk(d):
+            def fwd(self, *args):
+                return getattr(self._ref, d)(*args)
+
+            return fwd
+
+        setattr(V, dunder, _mk(dunder))
+
+
+_install_operators()

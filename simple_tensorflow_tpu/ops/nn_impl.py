@@ -1,0 +1,252 @@
+"""nn_impl: moments, batch norm, sampled losses
+(ref: tensorflow/python/ops/nn_impl.py, core/kernels/fused_batch_norm_op.cc).
+
+fused_batch_norm lowers to one composite that XLA fuses into neighboring
+convs (the reference hand-fuses in CUDA); statistics accumulate in f32 even
+for bf16 activations (TPU numerics contract).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from .op_util import make_op
+from . import math_ops
+
+
+def _moments_impl(x, axes=None, keepdims=False):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    if not keepdims:
+        mean = jnp.squeeze(mean, axis=axes)
+        var = jnp.squeeze(var, axis=axes)
+    return [mean.astype(x.dtype), var.astype(x.dtype)]
+
+
+op_registry.register_pure("Moments", _moments_impl, n_outputs=2)
+
+
+def _fused_bn_impl(x, scale, offset, mean=None, variance=None, epsilon=1e-3,
+                   is_training=True, data_format="NHWC"):
+    ch_axis = -1 if data_format == "NHWC" else 1
+    red_axes = builtins.tuple(i for i in builtins.range(x.ndim)
+                              if i != (x.ndim - 1 if ch_axis == -1 else 1))
+    xf = x.astype(jnp.float32)
+    if is_training:
+        batch_mean = jnp.mean(xf, axis=red_axes)
+        batch_var = jnp.mean(jnp.square(xf), axis=red_axes) - jnp.square(batch_mean)
+    else:
+        batch_mean, batch_var = mean.astype(jnp.float32), variance.astype(jnp.float32)
+    shape = [1] * x.ndim
+    shape[ch_axis if ch_axis >= 0 else x.ndim - 1] = x.shape[ch_axis]
+    inv = jax.lax.rsqrt(batch_var + epsilon) * scale.astype(jnp.float32)
+    out = (xf - batch_mean.reshape(shape)) * inv.reshape(shape) \
+        + offset.astype(jnp.float32).reshape(shape)
+    return [out.astype(x.dtype), batch_mean, batch_var]
+
+
+op_registry.register_pure("FusedBatchNorm", _fused_bn_impl, n_outputs=3)
+
+
+def moments(x, axes, shift=None, name=None, keep_dims=False, keepdims=None):
+    """(ref: nn_impl.py ``moments``)."""
+    if keepdims is not None:
+        keep_dims = keepdims
+    x = ops_mod.convert_to_tensor(x)
+    from .op_util import norm_axis
+
+    mean, var = make_op("Moments", [x],
+                        attrs={"axes": norm_axis(axes),
+                               "keepdims": builtins.bool(keep_dims)},
+                        name=name, n_out=2)
+    return mean, var
+
+
+def weighted_moments(x, axes, frequency_weights, name=None, keep_dims=False):
+    from . import array_ops
+
+    x = ops_mod.convert_to_tensor(x)
+    w = math_ops.cast(ops_mod.convert_to_tensor(frequency_weights),
+                      x.dtype.base_dtype)
+    wsum = math_ops.reduce_sum(w * array_ops.ones_like(x), axis=axes,
+                               keepdims=True)
+    mean = math_ops.reduce_sum(w * x, axis=axes, keepdims=True) / wsum
+    var = math_ops.reduce_sum(w * math_ops.square(x - mean), axis=axes,
+                              keepdims=True) / wsum
+    if not keep_dims:
+        mean = array_ops.squeeze(mean, axes)
+        var = array_ops.squeeze(var, axes)
+    return mean, var
+
+
+def fused_batch_norm(x, scale, offset, mean=None, variance=None, epsilon=1e-3,
+                     data_format="NHWC", is_training=True, name=None):
+    """(ref: nn_impl.py ``fused_batch_norm``)."""
+    x = ops_mod.convert_to_tensor(x)
+    scale = ops_mod.convert_to_tensor(scale, dtype="float32")
+    offset = ops_mod.convert_to_tensor(offset, dtype="float32")
+    inputs = [x, scale, offset]
+    if not is_training:
+        if mean is None or variance is None:
+            raise ValueError("fused_batch_norm inference needs mean/variance")
+        inputs += [ops_mod.convert_to_tensor(mean, dtype="float32"),
+                   ops_mod.convert_to_tensor(variance, dtype="float32")]
+    y, m, v = make_op(
+        "FusedBatchNorm", inputs,
+        attrs={"epsilon": float(epsilon), "is_training": is_training,
+               "data_format": data_format}, name=name, n_out=3)
+    return y, m, v
+
+
+def _pure_bn_sig_fix():
+    # FusedBatchNorm pure_fn takes (x, scale, offset[, mean, variance]);
+    # in inference mode two extra positional inputs arrive. The lambda-based
+    # registration handles both arities already.
+    pass
+
+
+def batch_normalization(x, mean, variance, offset, scale,
+                        variance_epsilon=1e-3, name=None):
+    """(ref: nn_impl.py ``batch_normalization``) — composed form; XLA fuses."""
+    x = ops_mod.convert_to_tensor(x)
+    inv = math_ops.rsqrt(variance + variance_epsilon)
+    if scale is not None:
+        inv = inv * scale
+    out = x * math_ops.cast(inv, x.dtype.base_dtype) + math_ops.cast(
+        (offset - mean * inv) if offset is not None else (-mean * inv),
+        x.dtype.base_dtype)
+    return out
+
+
+def batch_norm_with_global_normalization(t, m, v, beta, gamma,
+                                         variance_epsilon,
+                                         scale_after_normalization,
+                                         name=None):
+    return batch_normalization(t, m, v, beta,
+                               gamma if scale_after_normalization else None,
+                               variance_epsilon, name)
+
+
+def l2_normalize(x, axis=None, epsilon=1e-12, name=None, dim=None):
+    return math_ops.l2_normalize(x, axis=axis, epsilon=epsilon, name=name,
+                                 dim=dim)
+
+
+def zero_fraction(value, name=None):
+    from . import array_ops
+
+    value = ops_mod.convert_to_tensor(value)
+    zero = ops_mod.convert_to_tensor(0, dtype=value.dtype.base_dtype)
+    return math_ops.reduce_mean(
+        math_ops.cast(math_ops.equal(value, zero), "float32"), name=name)
+
+
+def normalize_moments(counts, mean_ss, variance_ss, shift, name=None):
+    divisor = math_ops.reciprocal(counts)
+    if shift is not None:
+        shifted_mean = mean_ss * divisor
+        mean = shifted_mean + shift
+    else:
+        shifted_mean = mean_ss * divisor
+        mean = shifted_mean
+    variance = variance_ss * divisor - math_ops.square(shifted_mean)
+    return mean, variance
+
+
+def sufficient_statistics(x, axes, shift=None, keep_dims=False, name=None):
+    from . import array_ops
+
+    x = ops_mod.convert_to_tensor(x)
+    counts = 1.0
+    for a in axes:
+        counts *= float(x.shape[a].value)
+    counts_t = ops_mod.convert_to_tensor(counts, dtype=x.dtype.base_dtype)
+    if shift is not None:
+        m_ss = math_ops.reduce_sum(x - shift, axis=axes, keepdims=keep_dims)
+        v_ss = math_ops.reduce_sum(math_ops.square(x - shift), axis=axes,
+                                   keepdims=keep_dims)
+    else:
+        m_ss = math_ops.reduce_sum(x, axis=axes, keepdims=keep_dims)
+        v_ss = math_ops.reduce_sum(math_ops.square(x), axis=axes,
+                                   keepdims=keep_dims)
+    return counts_t, m_ss, v_ss, shift
+
+
+def _sampled_logits(weights, biases, labels, inputs, num_sampled, num_classes,
+                    num_true, sampled_values, subtract_log_q, name):
+    """Shared by nce_loss / sampled_softmax_loss
+    (ref: nn_impl.py ``_compute_sampled_logits``)."""
+    from . import array_ops, embedding_ops, candidate_sampling_ops
+
+    if not isinstance(weights, (list, tuple)):
+        weights = [weights]
+    inputs = ops_mod.convert_to_tensor(inputs)
+    labels = math_ops.cast(ops_mod.convert_to_tensor(labels), "int32")
+    if sampled_values is None:
+        sampled_values = candidate_sampling_ops.log_uniform_candidate_sampler(
+            true_classes=math_ops.cast(labels, "int64"), num_true=num_true,
+            num_sampled=num_sampled, unique=True, range_max=num_classes)
+    sampled, true_expected, sampled_expected = sampled_values
+    sampled = math_ops.cast(sampled, "int32")
+    labels_flat = array_ops.reshape(labels, [-1])
+    all_ids = array_ops.concat([labels_flat, sampled], 0)
+    all_w = embedding_ops.embedding_lookup(weights[0] if len(weights) == 1
+                                           else weights, all_ids)
+    all_b = embedding_ops.embedding_lookup(biases, all_ids)
+    n_true_total = labels_flat.shape[0].value
+    true_w = all_w[:n_true_total]
+    sampled_w = all_w[n_true_total:]
+    true_b = all_b[:n_true_total]
+    sampled_b = all_b[n_true_total:]
+    dim = inputs.shape[-1].value
+    true_w = array_ops.reshape(true_w, [-1, num_true, dim])
+    true_logits = math_ops.reduce_sum(
+        array_ops.expand_dims(inputs, 1) * true_w, axis=2)
+    true_logits += array_ops.reshape(true_b, [-1, num_true])
+    sampled_logits = math_ops.matmul(inputs, sampled_w, transpose_b=True)
+    sampled_logits += sampled_b
+    if subtract_log_q:
+        true_logits -= math_ops.log(true_expected)
+        sampled_logits -= math_ops.log(sampled_expected)
+    out_logits = array_ops.concat([true_logits, sampled_logits], 1)
+    out_labels = array_ops.concat([
+        array_ops.ones_like(true_logits) / num_true,
+        array_ops.zeros_like(sampled_logits)], 1)
+    return out_logits, out_labels
+
+
+def nce_loss(weights, biases, labels, inputs, num_sampled, num_classes,
+             num_true=1, sampled_values=None, remove_accidental_hits=False,
+             partition_strategy="mod", name="nce_loss"):
+    """(ref: nn_impl.py ``nce_loss``)."""
+    from . import nn_ops
+
+    logits, labels_out = _sampled_logits(
+        weights, biases, labels, inputs, num_sampled, num_classes, num_true,
+        sampled_values, subtract_log_q=True, name=name)
+    xent = nn_ops.sigmoid_cross_entropy_with_logits(labels=labels_out,
+                                                    logits=logits)
+    return math_ops.reduce_sum(xent, axis=1)
+
+
+def sampled_softmax_loss(weights, biases, labels, inputs, num_sampled,
+                         num_classes, num_true=1, sampled_values=None,
+                         remove_accidental_hits=True,
+                         partition_strategy="mod",
+                         name="sampled_softmax_loss"):
+    """(ref: nn_impl.py ``sampled_softmax_loss``)."""
+    from . import nn_ops
+
+    logits, labels_out = _sampled_logits(
+        weights, biases, labels, inputs, num_sampled, num_classes, num_true,
+        sampled_values, subtract_log_q=True, name=name)
+    return nn_ops.softmax_cross_entropy_with_logits(labels=labels_out,
+                                                    logits=logits)

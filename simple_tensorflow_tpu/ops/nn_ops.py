@@ -1,0 +1,532 @@
+"""Neural-net ops (ref: tensorflow/python/ops/nn_ops.py,
+core/kernels/{conv_ops,maxpooling_op,avgpooling_op,softmax_op,relu_op,
+bias_op,xent_op}.cc and their *_gpu.cu.cc CUDA kernels).
+
+TPU-native notes:
+- conv2d lowers to lax.conv_general_dilated in NHWC with f32 accumulation —
+  XLA tiles it onto the MXU (the reference dispatches to cuDNN). NCHW inputs
+  are accepted and transposed once; NHWC is the TPU-preferred layout.
+- softmax/log_softmax/xent are jax.nn compositions fused by XLA; a Pallas
+  fused softmax-xent for large vocabularies lives in ops/pallas/.
+- dropout uses the functional RNG stream (see random_ops) so the same mask
+  is replayed in the vjp backward pass.
+"""
+
+from __future__ import annotations
+
+import builtins
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import random_seed as random_seed_mod
+from ..framework import tensor_shape as shape_mod
+from .op_util import make_op, unary
+
+Tensor = ops_mod.Tensor
+
+
+def _acc32(dtype):
+    d = np.dtype(dtype)
+    return np.float32 if (d.kind == "f" and d.itemsize <= 2) or str(d) == "bfloat16" \
+        else None
+
+
+# -- registrations -----------------------------------------------------------
+
+op_registry.register_pure("Relu", jax.nn.relu)
+op_registry.register_pure("Relu6", jax.nn.relu6)
+op_registry.register_pure("Elu", jax.nn.elu)
+op_registry.register_pure("Selu", jax.nn.selu)
+op_registry.register_pure("Gelu", lambda x, approximate=True: jax.nn.gelu(
+    x, approximate=approximate))
+op_registry.register_pure("LeakyRelu", lambda x, alpha=0.2: jax.nn.leaky_relu(
+    x, negative_slope=alpha))
+op_registry.register_pure("Softmax", lambda x, axis=-1: jax.nn.softmax(x, axis=axis))
+op_registry.register_pure("LogSoftmax", lambda x, axis=-1: jax.nn.log_softmax(
+    x, axis=axis))
+op_registry.register_pure("Swish", lambda x: jax.nn.silu(x))
+op_registry.register_pure("L2Loss", lambda x: 0.5 * jnp.sum(
+    jnp.square(x.astype(jnp.float32))).astype(x.dtype))
+op_registry.register_pure("BiasAdd", lambda x, b, data_format="NHWC":
+                          x + (b.reshape((1, -1) + (1,) * (x.ndim - 2))
+                               if data_format.startswith("NC") and x.ndim > 2
+                               else b))
+
+
+def _softmax_xent(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    loss = -jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
+    return loss.astype(logits.dtype)
+
+
+def _sparse_softmax_xent(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    loss = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                                axis=-1)[..., 0]
+    return loss.astype(logits.dtype)
+
+
+op_registry.register_pure("SoftmaxCrossEntropyWithLogits", _softmax_xent)
+op_registry.register_pure("SparseSoftmaxCrossEntropyWithLogits",
+                          _sparse_softmax_xent)
+op_registry.register_pure(
+    "SigmoidCrossEntropyWithLogits",
+    lambda logits, labels: (jnp.maximum(logits, 0) - logits * labels +
+                            jnp.log1p(jnp.exp(-jnp.abs(logits)))))
+
+
+def _conv2d_impl(x, w, strides=(1, 1, 1, 1), padding="SAME",
+                 data_format="NHWC", dilations=(1, 1, 1, 1)):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    sh, sw = strides[1:3] if data_format == "NHWC" else strides[2:4]
+    dh, dw = dilations[1:3] if data_format == "NHWC" else dilations[2:4]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(sh, sw), padding=padding,
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=_acc32(x.dtype))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+op_registry.register_pure("Conv2D", _conv2d_impl)
+
+
+def _depthwise_conv2d_impl(x, w, strides=(1, 1, 1, 1), padding="SAME",
+                           data_format="NHWC", dilations=(1, 1, 1, 1)):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    c = x.shape[-1]
+    kh, kw, cin, mult = w.shape
+    w2 = jnp.reshape(jnp.transpose(w, (0, 1, 2, 3)), (kh, kw, 1, cin * mult))
+    out = jax.lax.conv_general_dilated(
+        x, w2, window_strides=tuple(strides[1:3]), padding=padding,
+        rhs_dilation=tuple(dilations[1:3]),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+        preferred_element_type=_acc32(x.dtype))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+op_registry.register_pure("DepthwiseConv2dNative", _depthwise_conv2d_impl)
+
+
+def _conv3d_impl(x, w, strides=(1, 1, 1, 1, 1), padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides[1:4]), padding=padding,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        preferred_element_type=_acc32(x.dtype)).astype(x.dtype)
+
+
+op_registry.register_pure("Conv3D", _conv3d_impl)
+
+
+def _conv2d_transpose_impl(x, w, output_shape=None, strides=(1, 1, 1, 1),
+                           padding="SAME"):
+    sh, sw = strides[1:3]
+    out = jax.lax.conv_transpose(
+        x, w, strides=(sh, sw), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        transpose_kernel=True)
+    return out.astype(x.dtype)
+
+
+op_registry.register_pure("Conv2DBackpropInput", _conv2d_transpose_impl)
+
+
+def _pool(x, ksize, strides, padding, reducer, init, data_format="NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        ksize = (ksize[0], ksize[2], ksize[3], ksize[1])
+        strides = (strides[0], strides[2], strides[3], strides[1])
+    out = jax.lax.reduce_window(x, init, reducer, tuple(ksize),
+                                tuple(strides), padding)
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+def _max_pool_impl(x, ksize=None, strides=None, padding="VALID",
+                   data_format="NHWC"):
+    return _pool(x, ksize, strides, padding, jax.lax.max,
+                 -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.iinfo(x.dtype).min, data_format)
+
+
+def _avg_pool_impl(x, ksize=None, strides=None, padding="VALID",
+                   data_format="NHWC"):
+    summed = _pool(x.astype(jnp.float32), ksize, strides, padding,
+                   jax.lax.add, 0.0, data_format)
+    ones = jnp.ones_like(x, dtype=jnp.float32)
+    counts = _pool(ones, ksize, strides, padding, jax.lax.add, 0.0, data_format)
+    return (summed / counts).astype(x.dtype)
+
+
+op_registry.register_pure("MaxPool", _max_pool_impl)
+op_registry.register_pure("AvgPool", _avg_pool_impl)
+op_registry.register_pure("MaxPool3D", lambda x, ksize=None, strides=None,
+                          padding="VALID": jax.lax.reduce_window(
+                              x, -jnp.inf, jax.lax.max, tuple(ksize),
+                              tuple(strides), padding))
+op_registry.register_pure("AvgPool3D", lambda x, ksize=None, strides=None,
+                          padding="VALID": jax.lax.reduce_window(
+                              x.astype(jnp.float32), 0.0, jax.lax.add,
+                              tuple(ksize), tuple(strides), padding) /
+                          jax.lax.reduce_window(
+                              jnp.ones_like(x, dtype=jnp.float32), 0.0,
+                              jax.lax.add, tuple(ksize), tuple(strides),
+                              padding))
+
+
+def _lrn_impl(x, depth_radius=5, bias=1.0, alpha=1.0, beta=0.5):
+    squares = jnp.square(x.astype(jnp.float32))
+    c = x.shape[-1]
+    pad = jnp.pad(squares, [(0, 0)] * (x.ndim - 1) + [(depth_radius, depth_radius)])
+    windows = [pad[..., i:i + c] for i in builtins.range(2 * depth_radius + 1)]
+    norm = bias + alpha * builtins.sum(windows[1:], windows[0])
+    return (x.astype(jnp.float32) / jnp.power(norm, beta)).astype(x.dtype)
+
+
+op_registry.register_pure("LRN", _lrn_impl)
+
+
+def _dropout_lower(ctx, op, inputs):
+    x = inputs[0]
+    keep_prob = op.attrs["keep_prob"]
+    if keep_prob is None:  # tensor keep_prob (train/eval via placeholder)
+        keep_prob = inputs[1]
+    key = ctx.rng_for(op)
+    noise_shape = op.attrs.get("noise_shape") or x.shape
+    u = jax.random.uniform(key, builtins.tuple(noise_shape), dtype=jnp.float32)
+    mask = u < keep_prob  # broadcast against x (noise_shape semantics)
+    kp = jnp.asarray(keep_prob, x.dtype)
+    return [jnp.where(mask, x / kp, jnp.zeros_like(x))]
+
+
+op_registry.register("Dropout", lower=_dropout_lower, is_stateful=True)
+
+op_registry.register_pure("InTopK", lambda predictions, targets, k=1:
+                          _in_top_k_impl(predictions, targets, k))
+
+
+def _in_top_k_impl(predictions, targets, k):
+    target_scores = jnp.take_along_axis(
+        predictions, targets[:, None].astype(jnp.int32), axis=1)[:, 0]
+    higher = jnp.sum((predictions > target_scores[:, None]).astype(jnp.int32),
+                     axis=1)
+    finite = jnp.isfinite(target_scores)
+    return jnp.logical_and(higher < k, finite)
+
+
+op_registry.register_pure("TopKV2", lambda x, k=1, sorted=True:
+                          list(jax.lax.top_k(x, k)), n_outputs=2)
+
+
+# -- public API --------------------------------------------------------------
+
+def relu(features, name=None):
+    return unary("Relu", features, name)
+
+
+def relu6(features, name=None):
+    return unary("Relu6", features, name)
+
+
+def elu(features, name=None):
+    return unary("Elu", features, name)
+
+
+def selu(features, name=None):
+    return unary("Selu", features, name)
+
+
+def gelu(features, approximate=True, name=None):
+    return unary("Gelu", features, name, attrs={"approximate": approximate})
+
+
+def leaky_relu(features, alpha=0.2, name=None):
+    return unary("LeakyRelu", features, name, attrs={"alpha": alpha})
+
+
+def swish(features, name=None):
+    return unary("Swish", features, name)
+
+
+silu = swish
+
+
+def softplus(features, name=None):
+    return unary("Softplus", features, name)
+
+
+def softsign(features, name=None):
+    return unary("Softsign", features, name)
+
+
+def softmax(logits, axis=-1, name=None, dim=None):
+    if dim is not None:
+        axis = dim
+    return unary("Softmax", logits, name, attrs={"axis": int(axis)})
+
+
+def log_softmax(logits, axis=-1, name=None, dim=None):
+    if dim is not None:
+        axis = dim
+    return unary("LogSoftmax", logits, name, attrs={"axis": int(axis)})
+
+
+def l2_loss(t, name=None):
+    return unary("L2Loss", t, name)
+
+
+def bias_add(value, bias, data_format="NHWC", name=None):
+    value = ops_mod.convert_to_tensor(value)
+    bias = ops_mod.convert_to_tensor(bias, dtype=value.dtype.base_dtype)
+    return make_op("BiasAdd", [value, bias],
+                   attrs={"data_format": data_format or "NHWC"}, name=name)
+
+
+def softmax_cross_entropy_with_logits(labels=None, logits=None, dim=-1,
+                                      name=None, _sentinel=None):
+    if _sentinel is not None:
+        raise ValueError("Use named arguments for "
+                         "softmax_cross_entropy_with_logits")
+    logits = ops_mod.convert_to_tensor(logits)
+    labels = ops_mod.convert_to_tensor(labels, dtype=logits.dtype.base_dtype)
+    return make_op("SoftmaxCrossEntropyWithLogits", [logits, labels], name=name)
+
+
+softmax_cross_entropy_with_logits_v2 = softmax_cross_entropy_with_logits
+
+
+def sparse_softmax_cross_entropy_with_logits(labels=None, logits=None,
+                                             name=None, _sentinel=None):
+    logits = ops_mod.convert_to_tensor(logits)
+    labels = ops_mod.convert_to_tensor(labels)
+    if not labels.dtype.is_integer:
+        raise TypeError("labels must be integer class ids")
+    return make_op("SparseSoftmaxCrossEntropyWithLogits", [logits, labels],
+                   name=name)
+
+
+def sigmoid_cross_entropy_with_logits(labels=None, logits=None, name=None,
+                                      _sentinel=None):
+    logits = ops_mod.convert_to_tensor(logits)
+    labels = ops_mod.convert_to_tensor(labels, dtype=logits.dtype.base_dtype)
+    return make_op("SigmoidCrossEntropyWithLogits", [logits, labels], name=name)
+
+
+def weighted_cross_entropy_with_logits(targets, logits, pos_weight, name=None):
+    from . import math_ops
+
+    logits = ops_mod.convert_to_tensor(logits)
+    targets = ops_mod.convert_to_tensor(targets, dtype=logits.dtype.base_dtype)
+    log_weight = 1 + (pos_weight - 1) * targets
+    return math_ops.add(
+        (1 - targets) * logits,
+        log_weight * (math_ops.log1p(math_ops.exp(-math_ops.abs(logits))) +
+                      relu(-logits)), name=name)
+
+
+def conv2d(input, filter=None, strides=None, padding=None, use_cudnn_on_gpu=True,  # noqa: A002
+           data_format="NHWC", dilations=None, name=None, filters=None):
+    """2-D convolution (ref: nn_ops.py ``conv2d``; CUDA path
+    core/kernels/conv_ops.cc) → lax.conv_general_dilated on the MXU."""
+    w = filters if filters is not None else filter
+    x = ops_mod.convert_to_tensor(input)
+    w = ops_mod.convert_to_tensor(w, dtype=x.dtype.base_dtype)
+    strides = strides or [1, 1, 1, 1]
+    if isinstance(strides, int):
+        strides = [1, strides, strides, 1]
+    dilations = dilations or [1, 1, 1, 1]
+    if isinstance(dilations, int):
+        dilations = [1, dilations, dilations, 1]
+    return make_op("Conv2D", [x, w],
+                   attrs={"strides": builtins.tuple(strides),
+                          "padding": padding or "SAME",
+                          "data_format": data_format or "NHWC",
+                          "dilations": builtins.tuple(dilations)},
+                   name=name)
+
+
+def depthwise_conv2d(input, filter, strides, padding, rate=None, name=None,  # noqa: A002
+                     data_format="NHWC"):
+    x = ops_mod.convert_to_tensor(input)
+    w = ops_mod.convert_to_tensor(filter, dtype=x.dtype.base_dtype)
+    dil = [1, 1, 1, 1]
+    if rate is not None:
+        r = rate if isinstance(rate, (list, tuple)) else [rate, rate]
+        dil = [1, r[0], r[1], 1]
+    return make_op("DepthwiseConv2dNative", [x, w],
+                   attrs={"strides": builtins.tuple(strides),
+                          "padding": padding,
+                          "data_format": data_format or "NHWC",
+                          "dilations": builtins.tuple(dil)},
+                   name=name)
+
+
+depthwise_conv2d_native = depthwise_conv2d
+
+
+def separable_conv2d(input, depthwise_filter, pointwise_filter, strides,  # noqa: A002
+                     padding, rate=None, name=None, data_format="NHWC"):
+    dw = depthwise_conv2d(input, depthwise_filter, strides, padding, rate,
+                          data_format=data_format)
+    return conv2d(dw, pointwise_filter, [1, 1, 1, 1], "VALID",
+                  data_format=data_format, name=name)
+
+
+def conv3d(input, filter=None, strides=None, padding=None, name=None,  # noqa: A002
+           filters=None):
+    w = filters if filters is not None else filter
+    x = ops_mod.convert_to_tensor(input)
+    w = ops_mod.convert_to_tensor(w, dtype=x.dtype.base_dtype)
+    return make_op("Conv3D", [x, w],
+                   attrs={"strides": builtins.tuple(strides),
+                          "padding": padding}, name=name)
+
+
+def conv2d_transpose(value, filter=None, output_shape=None, strides=None,  # noqa: A002
+                     padding="SAME", data_format="NHWC", name=None,
+                     filters=None):
+    w = filters if filters is not None else filter
+    x = ops_mod.convert_to_tensor(value)
+    w = ops_mod.convert_to_tensor(w, dtype=x.dtype.base_dtype)
+    return make_op("Conv2DBackpropInput", [x, w],
+                   attrs={"strides": builtins.tuple(strides),
+                          "padding": padding}, name=name)
+
+
+def atrous_conv2d(value, filters, rate, padding, name=None):
+    return conv2d(value, filters, [1, 1, 1, 1], padding,
+                  dilations=[1, rate, rate, 1], name=name)
+
+
+def max_pool(value, ksize, strides, padding, data_format="NHWC", name=None):
+    x = ops_mod.convert_to_tensor(value)
+    return make_op("MaxPool", [x],
+                   attrs={"ksize": builtins.tuple(ksize),
+                          "strides": builtins.tuple(strides),
+                          "padding": padding,
+                          "data_format": data_format or "NHWC"}, name=name)
+
+
+def avg_pool(value, ksize, strides, padding, data_format="NHWC", name=None):
+    x = ops_mod.convert_to_tensor(value)
+    return make_op("AvgPool", [x],
+                   attrs={"ksize": builtins.tuple(ksize),
+                          "strides": builtins.tuple(strides),
+                          "padding": padding,
+                          "data_format": data_format or "NHWC"}, name=name)
+
+
+def max_pool3d(input, ksize, strides, padding, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("MaxPool3D", [x],
+                   attrs={"ksize": builtins.tuple(ksize),
+                          "strides": builtins.tuple(strides),
+                          "padding": padding}, name=name)
+
+
+def avg_pool3d(input, ksize, strides, padding, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("AvgPool3D", [x],
+                   attrs={"ksize": builtins.tuple(ksize),
+                          "strides": builtins.tuple(strides),
+                          "padding": padding}, name=name)
+
+
+def dropout(x, keep_prob=None, noise_shape=None, seed=None, name=None,
+            rate=None):
+    """(ref: nn_ops.py ``dropout``). Mask drawn from the per-step functional
+    RNG; identical mask is replayed in the vjp backward."""
+    x = ops_mod.convert_to_tensor(x)
+    if rate is not None:
+        keep_prob = 1.0 - rate if not isinstance(rate, Tensor) else 1.0 - rate
+    if keep_prob is None:
+        raise ValueError("dropout: pass keep_prob or rate")
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    ns = None
+    if noise_shape is not None:
+        from ..framework import constant_op as _const
+
+        if isinstance(noise_shape, Tensor):
+            v = _const.constant_value(noise_shape)
+            if v is None:
+                raise ValueError("noise_shape must be static on TPU")
+            noise_shape = v
+        ns = builtins.tuple(int(d) for d in np.ravel(np.asarray(noise_shape)))
+    inputs = [x]
+    if isinstance(keep_prob, Tensor):
+        # Placeholder keep_prob (train/eval idiom): passed as a tensor input.
+        inputs.append(math_ops_cast_float(keep_prob))
+        kp_attr = None
+    else:
+        kp_attr = float(keep_prob)
+        if kp_attr == 1.0:
+            return x
+    op = g.create_op("Dropout", inputs,
+                     attrs={"keep_prob": kp_attr, "noise_shape": ns,
+                            "seed": op_seed, "_graph_seed": graph_seed},
+                     name=name or "dropout",
+                     output_specs=[(x.shape, x.dtype)])
+    return op.outputs[0]
+
+
+def math_ops_cast_float(t):
+    from . import math_ops
+
+    return math_ops.cast(t, "float32")
+
+
+def local_response_normalization(input, depth_radius=5, bias=1.0, alpha=1.0,  # noqa: A002
+                                 beta=0.5, name=None):
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("LRN", [x], attrs={"depth_radius": int(depth_radius),
+                                      "bias": float(bias),
+                                      "alpha": float(alpha),
+                                      "beta": float(beta)}, name=name)
+
+
+lrn = local_response_normalization
+
+
+def in_top_k(predictions, targets, k, name=None):
+    p = ops_mod.convert_to_tensor(predictions)
+    t = ops_mod.convert_to_tensor(targets)
+    return make_op("InTopK", [p, t], attrs={"k": int(k)}, name=name)
+
+
+def top_k(input, k=1, sorted=True, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    values, indices = make_op("TopKV2", [x], attrs={"k": int(k),
+                                                    "sorted": sorted},
+                              name=name, n_out=2)
+    return values, indices
+
+
+def xw_plus_b(x, weights, biases, name=None):
+    from . import math_ops
+
+    return bias_add(math_ops.matmul(x, weights), biases, name=name)
+
+
+def log_poisson_loss(targets, log_input, compute_full_loss=False, name=None):
+    from . import math_ops
+
+    loss = math_ops.exp(log_input) - log_input * targets
+    return loss

@@ -1,0 +1,81 @@
+"""Shared helpers for building op wrappers.
+
+The reference generates its Python op wrappers from OpDef protos
+(ref: tensorflow/python/framework/python_op_gen.cc); here ops are registered
+with a jax ``pure_fn`` (op_registry.register_pure) and these helpers build
+the graph nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+
+Tensor = ops_mod.Tensor
+
+
+def make_op(op_type: str, inputs: Sequence[Tensor], attrs=None,
+            name: Optional[str] = None, n_out: int = 1):
+    g = ops_mod.get_default_graph()
+    op = g.create_op(op_type, inputs, attrs=attrs or {}, name=name or op_type)
+    if n_out == 1:
+        return op.outputs[0]
+    return list(op.outputs)
+
+
+def unary(op_type: str, x, name=None, dtype=None, attrs=None):
+    x = ops_mod.convert_to_tensor(x, dtype=dtype)
+    return make_op(op_type, [x], attrs=attrs, name=name)
+
+
+def binary(op_type: str, x, y, name=None, attrs=None):
+    x, y = promote_args(x, y, op_type)
+    return make_op(op_type, [x, y], attrs=attrs, name=name)
+
+
+def promote_args(x, y, op_name=""):
+    """TF-1.0 dtype discipline: both operands must have the same base dtype;
+    python scalars adopt the tensor operand's dtype
+    (ref: python/framework/ops.py convert_to_tensor + strict op signatures)."""
+    x_is_t = isinstance(x, Tensor) or hasattr(x, "_as_graph_element")
+    y_is_t = isinstance(y, Tensor) or hasattr(y, "_as_graph_element")
+    if x_is_t:
+        x = ops_mod.convert_to_tensor(x)
+    if y_is_t:
+        y = ops_mod.convert_to_tensor(y)
+    if x_is_t and not y_is_t:
+        y = ops_mod.convert_to_tensor(y, dtype=x.dtype.base_dtype)
+    elif y_is_t and not x_is_t:
+        x = ops_mod.convert_to_tensor(x, dtype=y.dtype.base_dtype)
+    elif not x_is_t and not y_is_t:
+        x = ops_mod.convert_to_tensor(x)
+        y = ops_mod.convert_to_tensor(y, dtype=x.dtype.base_dtype)
+    if x.dtype.base_dtype != y.dtype.base_dtype:
+        raise TypeError(
+            f"{op_name or 'binary op'}: operand dtypes must match, got "
+            f"{x.dtype.base_dtype.name} and {y.dtype.base_dtype.name}. "
+            f"Use stf.cast explicitly (TF-1.0 semantics).")
+    return x, y
+
+
+def norm_axis(axis):
+    """Normalize reduction axis to tuple-or-None for static attrs."""
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    from ..framework import constant_op
+
+    if isinstance(axis, Tensor):
+        v = constant_op.constant_value(axis)
+        if v is None:
+            raise ValueError(
+                "Reduction axis must be statically known on TPU (XLA needs "
+                "static shapes); got a dynamic tensor.")
+        import numpy as np
+
+        return tuple(int(a) for a in np.ravel(v))
+    return (int(axis),)

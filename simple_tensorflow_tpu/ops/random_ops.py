@@ -1,0 +1,246 @@
+"""Random ops (ref: tensorflow/python/ops/random_ops.py,
+core/kernels/random_op.cc — Philox stateful kernels).
+
+TPU-native: no mutable Philox state. Each op folds a stable per-op stream id
+(framework/random_seed.py) into the per-step root key the Session advances —
+stateful-looking API, functional keys underneath, reproducible under
+set_random_seed, and safe under jax.vjp forward replay (same draw both
+times, so dropout masks agree between forward and backward).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import random_seed as random_seed_mod
+from ..framework import tensor_shape as shape_mod
+from ..framework import constant_op
+from .op_util import make_op
+
+
+def _static_shape(shape):
+    from . import array_ops
+
+    return array_ops._static_shape_arg(shape, "random op")
+
+
+def _rand_op(op_type, shape, dtype, seed, name, extra=None, inputs=()):
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    dt = dtypes_mod.as_dtype(dtype)
+    sh = _static_shape(shape)
+    attrs = {"shape": sh, "dtype": dt, "seed": op_seed,
+             "_graph_seed": graph_seed}
+    attrs.update(extra or {})
+    op = g.create_op(op_type, list(inputs), attrs=attrs, name=name or op_type,
+                     output_specs=[(shape_mod.TensorShape(list(sh)), dt)])
+    return op.outputs[0]
+
+
+def _lower_random(sample_fn):
+    def lower(ctx, op, inputs):
+        key = ctx.rng_for(op)
+        return [sample_fn(key, op, inputs)]
+
+    return lower
+
+
+def _ru(key, op, inputs):
+    import jax
+
+    a = op.attrs
+    dt = a["dtype"].np_dtype
+    if a["dtype"].is_integer:
+        return jax.random.randint(key, a["shape"], a["minval"], a["maxval"],
+                                  dtype=dt)
+    u = jax.random.uniform(key, a["shape"], dtype=np.float32,
+                           minval=a["minval"], maxval=a["maxval"])
+    return u.astype(dt)
+
+
+def _rn(key, op, inputs):
+    import jax
+
+    a = op.attrs
+    x = jax.random.normal(key, a["shape"], dtype=np.float32)
+    return (x * a["stddev"] + a["mean"]).astype(a["dtype"].np_dtype)
+
+
+def _tn(key, op, inputs):
+    import jax
+
+    a = op.attrs
+    x = jax.random.truncated_normal(key, -2.0, 2.0, a["shape"], np.float32)
+    return (x * a["stddev"] + a["mean"]).astype(a["dtype"].np_dtype)
+
+
+def _shuffle(key, op, inputs):
+    import jax
+
+    return jax.random.permutation(key, inputs[0], axis=0)
+
+
+def _multinomial(key, op, inputs):
+    import jax
+
+    logits = inputs[0]
+    n = op.attrs["num_samples"]
+    return jax.random.categorical(key, logits, axis=-1,
+                                  shape=(logits.shape[0], n)).astype(
+        op.attrs["output_dtype"].np_dtype)
+
+
+def _gamma(key, op, inputs):
+    import jax
+
+    a = op.attrs
+    alpha = inputs[0]
+    sample_shape = tuple(a["shape"]) + tuple(np.shape(alpha))
+    g = jax.random.gamma(key, alpha, shape=sample_shape, dtype=np.float32)
+    return (g / a.get("beta", 1.0)).astype(a["dtype"].np_dtype)
+
+
+def _poisson(key, op, inputs):
+    import jax
+
+    a = op.attrs
+    lam = inputs[0]
+    sample_shape = tuple(a["shape"]) + tuple(np.shape(lam))
+    return jax.random.poisson(key, lam, shape=sample_shape).astype(
+        a["dtype"].np_dtype)
+
+
+op_registry.register("RandomUniform", lower=_lower_random(_ru), is_stateful=True)
+op_registry.register("RandomStandardNormal", lower=_lower_random(_rn),
+                     is_stateful=True)
+op_registry.register("TruncatedNormal", lower=_lower_random(_tn),
+                     is_stateful=True)
+op_registry.register("RandomShuffle", lower=_lower_random(_shuffle),
+                     is_stateful=True)
+op_registry.register("Multinomial", lower=_lower_random(_multinomial),
+                     is_stateful=True)
+op_registry.register("RandomGamma", lower=_lower_random(_gamma),
+                     is_stateful=True)
+op_registry.register("RandomPoisson", lower=_lower_random(_poisson),
+                     is_stateful=True)
+
+
+# -- public API --------------------------------------------------------------
+
+def random_uniform(shape, minval=0, maxval=None, dtype=dtypes_mod.float32,
+                   seed=None, name=None):
+    dt = dtypes_mod.as_dtype(dtype)
+    if maxval is None:
+        if dt.is_integer:
+            raise ValueError("Must specify maxval for integer random_uniform")
+        maxval = 1.0
+    return _rand_op("RandomUniform", shape, dt, seed, name,
+                    extra={"minval": minval, "maxval": maxval})
+
+
+def random_normal(shape, mean=0.0, stddev=1.0, dtype=dtypes_mod.float32,
+                  seed=None, name=None):
+    return _rand_op("RandomStandardNormal", shape, dtype, seed, name,
+                    extra={"mean": float(mean), "stddev": float(stddev)})
+
+
+def truncated_normal(shape, mean=0.0, stddev=1.0, dtype=dtypes_mod.float32,
+                     seed=None, name=None):
+    return _rand_op("TruncatedNormal", shape, dtype, seed, name,
+                    extra={"mean": float(mean), "stddev": float(stddev)})
+
+
+def random_shuffle(value, seed=None, name=None):
+    value = ops_mod.convert_to_tensor(value)
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    op = g.create_op("RandomShuffle", [value],
+                     attrs={"seed": op_seed, "_graph_seed": graph_seed},
+                     name=name or "RandomShuffle",
+                     output_specs=[(value.shape, value.dtype)])
+    return op.outputs[0]
+
+
+def multinomial(logits, num_samples, seed=None, name=None,
+                output_dtype=dtypes_mod.int64):
+    logits = ops_mod.convert_to_tensor(logits)
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    n = int(constant_op.constant_value(ops_mod.convert_to_tensor(num_samples)))
+    batch = logits.shape[0].value
+    op = g.create_op("Multinomial", [logits],
+                     attrs={"num_samples": n, "seed": op_seed,
+                            "_graph_seed": graph_seed,
+                            "output_dtype": dtypes_mod.as_dtype(output_dtype)},
+                     name=name or "Multinomial",
+                     output_specs=[(shape_mod.TensorShape([batch, n]),
+                                    dtypes_mod.as_dtype(output_dtype))])
+    return op.outputs[0]
+
+
+def random_gamma(shape, alpha, beta=None, dtype=dtypes_mod.float32, seed=None,
+                 name=None):
+    alpha_t = ops_mod.convert_to_tensor(alpha, dtype=dtype)
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    sh = _static_shape(shape)
+    out_shape = list(sh) + (alpha_t.shape.as_list() if alpha_t.shape.rank else [])
+    op = g.create_op("RandomGamma", [alpha_t],
+                     attrs={"shape": sh, "dtype": dtypes_mod.as_dtype(dtype),
+                            "beta": float(beta) if beta is not None else 1.0,
+                            "seed": op_seed, "_graph_seed": graph_seed},
+                     name=name or "RandomGamma",
+                     output_specs=[(shape_mod.TensorShape(out_shape),
+                                    dtypes_mod.as_dtype(dtype))])
+    return op.outputs[0]
+
+
+def random_poisson(lam, shape, dtype=dtypes_mod.float32, seed=None, name=None):
+    lam_t = ops_mod.convert_to_tensor(lam, dtype=dtypes_mod.float32)
+    g = ops_mod.get_default_graph()
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    sh = _static_shape(shape)
+    out_shape = list(sh) + (lam_t.shape.as_list() if lam_t.shape.rank else [])
+    op = g.create_op("RandomPoisson", [lam_t],
+                     attrs={"shape": sh, "dtype": dtypes_mod.as_dtype(dtype),
+                            "seed": op_seed, "_graph_seed": graph_seed},
+                     name=name or "RandomPoisson",
+                     output_specs=[(shape_mod.TensorShape(out_shape),
+                                    dtypes_mod.as_dtype(dtype))])
+    return op.outputs[0]
+
+
+def random_crop(value, size, seed=None, name=None):
+    from . import array_ops
+
+    value = ops_mod.convert_to_tensor(value)
+    sh = value.shape.as_list()
+    size = _static_shape(size)
+    limits = [s - c for s, c in zip(sh, size)]
+    offsets = [random_uniform([], 0, l + 1, dtype=dtypes_mod.int32, seed=seed)
+               if l > 0 else constant_op.constant(0) for l in limits]
+    # Static crop via dynamic_slice lowering: use gather-based strided slice.
+    g = ops_mod.get_default_graph()
+    op = g.create_op("DynamicSliceCrop", [value] + offsets,
+                     attrs={"size": tuple(size)},
+                     name=name or "random_crop",
+                     output_specs=[(shape_mod.TensorShape(list(size)),
+                                    value.dtype)])
+    return op.outputs[0]
+
+
+def _lower_dyn_crop(ctx, op, inputs):
+    import jax
+
+    x = inputs[0]
+    offsets = inputs[1:]
+    return [jax.lax.dynamic_slice(x, offsets, op.attrs["size"])]
+
+
+op_registry.register("DynamicSliceCrop", lower=_lower_dyn_crop)
+
+
+set_random_seed = random_seed_mod.set_random_seed

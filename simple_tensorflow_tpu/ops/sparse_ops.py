@@ -1,0 +1,153 @@
+"""Sparse ops (ref: tensorflow/python/ops/sparse_ops.py,
+core/kernels/sparse_*.cc).
+
+TPU-native: SparseTensors are fixed-capacity COO (see
+framework/sparse_tensor.py); ops lower to dense scatters/gathers, which XLA
+fuses — TPU has no sparse execution units, so dense-backed is the honest
+fast path (the reference's CPU sparse kernels don't vectorize either).
+Padding rows (index < 0) are masked out.
+"""
+
+from __future__ import annotations
+
+import builtins
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from ..framework import constant_op
+from ..framework.sparse_tensor import SparseTensor
+from .op_util import make_op
+
+
+def _static_dense_shape(sp: SparseTensor):
+    v = constant_op.constant_value(sp.dense_shape)
+    if v is None:
+        raise ValueError("SparseTensor dense_shape must be static on TPU")
+    return tuple(int(d) for d in v)
+
+
+def _sparse_to_dense_impl(indices, values, default_value=0, shape=None,
+                          validate_indices=True):
+    out = jnp.full(shape, default_value, dtype=values.dtype)
+    valid = jnp.all(indices >= 0, axis=-1)
+    safe_idx = jnp.maximum(indices, 0)
+    vals = jnp.where(valid, values, out[builtins.tuple(
+        safe_idx[..., k] for k in builtins.range(indices.shape[-1]))])
+    return out.at[builtins.tuple(
+        safe_idx[..., k] for k in builtins.range(indices.shape[-1]))].set(vals)
+
+
+op_registry.register_pure("SparseToDense", _sparse_to_dense_impl)
+
+
+def sparse_to_dense(sparse_indices, output_shape, sparse_values,
+                    default_value=0, validate_indices=True, name=None):
+    idx = ops_mod.convert_to_tensor(sparse_indices, dtype=dtypes_mod.int64)
+    vals = ops_mod.convert_to_tensor(sparse_values)
+    from .array_ops import _static_shape_arg
+
+    sh = _static_shape_arg(output_shape, "sparse_to_dense")
+    return make_op("SparseToDense", [idx, vals],
+                   attrs={"default_value": default_value, "shape": sh},
+                   name=name)
+
+
+def sparse_tensor_to_dense(sp_input, default_value=0, validate_indices=True,
+                           name=None):
+    sh = _static_dense_shape(sp_input)
+    return make_op("SparseToDense", [sp_input.indices, sp_input.values],
+                   attrs={"default_value": default_value, "shape": sh},
+                   name=name)
+
+
+def sparse_tensor_dense_matmul(sp_a, b, adjoint_a=False, adjoint_b=False,
+                               name=None):
+    from . import math_ops
+
+    dense_a = sparse_tensor_to_dense(sp_a)
+    return math_ops.matmul(dense_a, ops_mod.convert_to_tensor(b),
+                           transpose_a=adjoint_a, transpose_b=adjoint_b,
+                           name=name)
+
+
+def sparse_add(a, b, thresh=0, name=None):
+    from . import math_ops
+
+    da = sparse_tensor_to_dense(a) if isinstance(a, SparseTensor) else a
+    db = sparse_tensor_to_dense(b) if isinstance(b, SparseTensor) else b
+    return math_ops.add(da, db, name=name)
+
+
+def sparse_reduce_sum(sp_input, axis=None, keep_dims=False,
+                      reduction_axes=None, name=None):
+    from . import math_ops
+
+    return math_ops.reduce_sum(sparse_tensor_to_dense(sp_input),
+                               axis=axis if axis is not None else reduction_axes,
+                               keepdims=keep_dims, name=name)
+
+
+def sparse_retain(sp_input, to_retain):
+    v = constant_op.constant_value(ops_mod.convert_to_tensor(to_retain))
+    iv = constant_op.constant_value(sp_input.indices)
+    vv = constant_op.constant_value(sp_input.values)
+    if v is None or iv is None or vv is None:
+        raise ValueError("sparse_retain needs static inputs on TPU")
+    keep = np.asarray(v, dtype=bool)
+    return SparseTensor(constant_op.constant(iv[keep]),
+                        constant_op.constant(vv[keep]),
+                        sp_input.dense_shape)
+
+
+def sparse_reorder(sp_input, name=None):
+    iv = constant_op.constant_value(sp_input.indices)
+    vv = constant_op.constant_value(sp_input.values)
+    if iv is None or vv is None:
+        return sp_input  # already canonical in our construction
+    order = np.lexsort(tuple(iv[:, k] for k in range(iv.shape[1] - 1, -1, -1)))
+    return SparseTensor(constant_op.constant(iv[order]),
+                        constant_op.constant(vv[order]),
+                        sp_input.dense_shape)
+
+
+def sparse_slice(sp_input, start, size, name=None):
+    raise NotImplementedError("sparse_slice: use dense slicing on TPU")
+
+
+def sparse_concat(axis, sp_inputs, name=None, expand_nonconcat_dim=False):
+    raise NotImplementedError("sparse_concat: use dense concat on TPU")
+
+
+def sparse_placeholder(dtype, shape=None, name=None):
+    from . import array_ops
+
+    if shape is None:
+        raise ValueError("sparse_placeholder on TPU needs a static shape")
+    nnz = int(np.prod([int(s) for s in shape]))
+    idx = array_ops.placeholder(dtypes_mod.int64, [None, len(shape)],
+                                name=(name or "sparse") + "_indices")
+    vals = array_ops.placeholder(dtype, [None],
+                                 name=(name or "sparse") + "_values")
+    return SparseTensor(idx, vals, constant_op.constant(
+        np.asarray(shape, dtype=np.int64)))
+
+
+def sparse_mask(a, mask_indices, name=None):
+    from ..framework.indexed_slices import IndexedSlices
+
+    iv = constant_op.constant_value(a.indices)
+    mv = constant_op.constant_value(ops_mod.convert_to_tensor(mask_indices))
+    if iv is None or mv is None:
+        raise ValueError("sparse_mask needs static indices on TPU")
+    keep = ~np.isin(iv, mv)
+    from . import array_ops
+
+    pos = np.nonzero(keep)[0]
+    return IndexedSlices(
+        array_ops.gather(a.values, constant_op.constant(pos.astype(np.int32))),
+        constant_op.constant(iv[keep]), a.dense_shape)

@@ -1,0 +1,71 @@
+"""FFT ops (ref: tensorflow/python/ops/spectral_ops.py,
+core/kernels/fft_ops.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from .op_util import unary, make_op
+
+op_registry.register_pure("FFT", lambda x: jnp.fft.fft(x).astype(jnp.complex64))
+op_registry.register_pure("IFFT", lambda x: jnp.fft.ifft(x).astype(jnp.complex64))
+op_registry.register_pure("FFT2D", lambda x: jnp.fft.fft2(x).astype(jnp.complex64))
+op_registry.register_pure("IFFT2D", lambda x: jnp.fft.ifft2(x).astype(jnp.complex64))
+op_registry.register_pure("FFT3D", lambda x: jnp.fft.fftn(
+    x, axes=(-3, -2, -1)).astype(jnp.complex64))
+op_registry.register_pure("IFFT3D", lambda x: jnp.fft.ifftn(
+    x, axes=(-3, -2, -1)).astype(jnp.complex64))
+op_registry.register_pure("RFFT", lambda x, fft_length=None: jnp.fft.rfft(
+    x, n=fft_length).astype(jnp.complex64))
+op_registry.register_pure("IRFFT", lambda x, fft_length=None: jnp.fft.irfft(
+    x, n=fft_length).astype(jnp.float32))
+op_registry.register_pure("RFFT2D", lambda x, fft_length=None: jnp.fft.rfft2(
+    x, s=fft_length).astype(jnp.complex64))
+op_registry.register_pure("IRFFT2D", lambda x, fft_length=None: jnp.fft.irfft2(
+    x, s=fft_length).astype(jnp.float32))
+
+
+def fft(input, name=None):  # noqa: A002
+    return unary("FFT", input, name)
+
+
+def ifft(input, name=None):  # noqa: A002
+    return unary("IFFT", input, name)
+
+
+def fft2d(input, name=None):  # noqa: A002
+    return unary("FFT2D", input, name)
+
+
+def ifft2d(input, name=None):  # noqa: A002
+    return unary("IFFT2D", input, name)
+
+
+def fft3d(input, name=None):  # noqa: A002
+    return unary("FFT3D", input, name)
+
+
+def ifft3d(input, name=None):  # noqa: A002
+    return unary("IFFT3D", input, name)
+
+
+def rfft(input, fft_length=None, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("RFFT", [x], attrs={"fft_length": fft_length}, name=name)
+
+
+def irfft(input, fft_length=None, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("IRFFT", [x], attrs={"fft_length": fft_length}, name=name)
+
+
+def rfft2d(input, fft_length=None, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("RFFT2D", [x], attrs={"fft_length": fft_length}, name=name)
+
+
+def irfft2d(input, fft_length=None, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("IRFFT2D", [x], attrs={"fft_length": fft_length}, name=name)

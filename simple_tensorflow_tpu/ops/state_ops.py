@@ -1,0 +1,268 @@
+"""Stateful variable ops: reads, assigns, scatter updates.
+
+(ref: tensorflow/python/ops/state_ops.py, core/kernels/assign_op.h,
+core/kernels/scatter_op.cc). TPU-native design: a variable is an entry in the
+Session's device-resident VariableStore; reads pull the current traced value
+from the lowering context, writes replace it. Because the whole step is one
+XLA program with donated state buffers, an assign is an in-place HBM update
+after compilation — same performance model as the reference's ref-variables,
+but functionally pure at trace level. Read/write ordering follows graph
+topological order over data + control edges (the reference's contract,
+enforced dynamically by its executor; here statically at lowering).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+
+
+# -- lowerings ---------------------------------------------------------------
+
+def _lower_variable(ctx, op, inputs):
+    return [ctx.read_var(op.attrs["var_name"], op)]
+
+
+op_registry.register("VariableV2", lower=_lower_variable, is_stateful=True)
+# Fresh read of the current store value at this node's topological position;
+# lets `with control_dependencies([assign]): v.read_value()` observe the
+# write (TF-1.0 ref-variable deref-at-use semantics).
+op_registry.register("ReadVariable", lower=_lower_variable, is_stateful=True)
+
+
+def _lower_assign(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    name = op.attrs["var_name"]
+    val = inputs[0]
+    # use_locking is a concurrency hint in the reference; it never gates
+    # validation.
+    if ctx.var_exists(name):
+        prev = ctx.state[name]
+        if op.attrs.get("validate_shape", True) and tuple(prev.shape) != tuple(val.shape):
+            raise ValueError(
+                f"Assign to {name}: shape {tuple(val.shape)} != variable shape "
+                f"{tuple(prev.shape)}")
+        if prev.dtype != val.dtype:
+            val = val.astype(prev.dtype)
+    ctx.write_var(name, val)
+    return [val]
+
+
+op_registry.register("Assign", lower=_lower_assign, is_stateful=True)
+
+
+def _make_aug_assign(fn):
+    def lower(ctx, op, inputs):
+        name = op.attrs["var_name"]
+        cur = ctx.read_var(name, op)
+        new = fn(cur, inputs[0].astype(cur.dtype) if hasattr(inputs[0], "astype")
+                 else inputs[0])
+        ctx.write_var(name, new)
+        return [new]
+
+    return lower
+
+
+op_registry.register("AssignAdd", lower=_make_aug_assign(lambda a, b: a + b),
+                     is_stateful=True)
+op_registry.register("AssignSub", lower=_make_aug_assign(lambda a, b: a - b),
+                     is_stateful=True)
+
+
+def _make_scatter(update):
+    def lower(ctx, op, inputs):
+        name = op.attrs["var_name"]
+        cur = ctx.read_var(name, op)
+        indices, updates = inputs
+        new = update(cur, indices, updates)
+        ctx.write_var(name, new)
+        return [new]
+
+    return lower
+
+
+op_registry.register(
+    "ScatterUpdate",
+    lower=_make_scatter(lambda v, i, u: v.at[i].set(u)), is_stateful=True)
+op_registry.register(
+    "ScatterAdd",
+    lower=_make_scatter(lambda v, i, u: v.at[i].add(u)), is_stateful=True)
+op_registry.register(
+    "ScatterSub",
+    lower=_make_scatter(lambda v, i, u: v.at[i].add(-u)), is_stateful=True)
+op_registry.register(
+    "ScatterMul",
+    lower=_make_scatter(lambda v, i, u: v.at[i].mul(u)), is_stateful=True)
+op_registry.register(
+    "ScatterDiv",
+    lower=_make_scatter(lambda v, i, u: v.at[i].divide(u)), is_stateful=True)
+op_registry.register(
+    "ScatterMin",
+    lower=_make_scatter(lambda v, i, u: v.at[i].min(u)), is_stateful=True)
+op_registry.register(
+    "ScatterMax",
+    lower=_make_scatter(lambda v, i, u: v.at[i].max(u)), is_stateful=True)
+
+
+def _lower_scatter_nd_update(ctx, op, inputs):
+    name = op.attrs["var_name"]
+    cur = ctx.read_var(name, op)
+    indices, updates = inputs
+    new = cur.at[tuple(indices[..., k] for k in range(indices.shape[-1]))].set(updates)
+    ctx.write_var(name, new)
+    return [new]
+
+
+op_registry.register("ScatterNdUpdate", lower=_lower_scatter_nd_update,
+                     is_stateful=True)
+
+
+def _lower_is_initialized(ctx, op, inputs):
+    # Host op: answered against the Session's store before device tracing.
+    return [np.asarray(ctx.var_exists(op.attrs["var_name"]))]
+
+
+op_registry.register("IsVariableInitialized", lower=_lower_is_initialized,
+                     is_stateful=True, runs_on_host=True)
+
+
+def _lower_count_up_to(ctx, op, inputs):
+    # Host-staged: XLA cannot raise, and the whole point of count_up_to is
+    # its OutOfRangeError at the limit (ref core/kernels/count_up_to_op.cc).
+    from ..framework.errors import OutOfRangeError
+
+    name = op.attrs["var_name"]
+    limit = op.attrs["limit"]
+    cur = ctx.read_var(name, op)
+    if int(np.asarray(cur)) >= limit:
+        raise OutOfRangeError(None, op,
+                              f"Reached limit of {limit} in CountUpTo")
+    ctx.state[name] = np.asarray(cur) + 1
+    return [np.asarray(cur)]
+
+
+op_registry.register("CountUpTo", lower=_lower_count_up_to, is_stateful=True,
+                     runs_on_host=True)
+
+
+# -- public API --------------------------------------------------------------
+
+def _var_name_of(ref) -> str:
+    op = ref.op if isinstance(ref, ops_mod.Tensor) else ref
+    if op.type not in ("VariableV2",):
+        raise TypeError(f"Expected a variable ref tensor, got op {op.type}")
+    return op.attrs["var_name"]
+
+
+def _ref_of(x):
+    from . import variables as variables_mod
+
+    if isinstance(x, variables_mod.Variable):
+        return x._ref
+    return x
+
+
+def assign(ref, value, validate_shape=True, use_locking=True, name=None):
+    """(ref: python/ops/state_ops.py ``assign``)."""
+    ref = _ref_of(ref)
+    g = ops_mod.get_default_graph()
+    value = ops_mod.convert_to_tensor(value, dtype=ref.dtype.base_dtype)
+    op = g.create_op("Assign", [value],
+                     attrs={"var_name": _var_name_of(ref),
+                            "validate_shape": validate_shape,
+                            "use_locking": use_locking},
+                     name=name or "Assign",
+                     output_specs=[(value.shape if not validate_shape else ref.shape,
+                                    ref.dtype.base_dtype)])
+    return op.outputs[0]
+
+
+def assign_add(ref, value, use_locking=True, name=None):
+    ref = _ref_of(ref)
+    g = ops_mod.get_default_graph()
+    value = ops_mod.convert_to_tensor(value, dtype=ref.dtype.base_dtype)
+    op = g.create_op("AssignAdd", [value],
+                     attrs={"var_name": _var_name_of(ref)},
+                     name=name or "AssignAdd",
+                     output_specs=[(ref.shape, ref.dtype.base_dtype)])
+    return op.outputs[0]
+
+
+def assign_sub(ref, value, use_locking=True, name=None):
+    ref = _ref_of(ref)
+    g = ops_mod.get_default_graph()
+    value = ops_mod.convert_to_tensor(value, dtype=ref.dtype.base_dtype)
+    op = g.create_op("AssignSub", [value],
+                     attrs={"var_name": _var_name_of(ref)},
+                     name=name or "AssignSub",
+                     output_specs=[(ref.shape, ref.dtype.base_dtype)])
+    return op.outputs[0]
+
+
+def _scatter(op_type, ref, indices, updates, name=None):
+    ref = _ref_of(ref)
+    g = ops_mod.get_default_graph()
+    indices = ops_mod.convert_to_tensor(indices, dtype=dtypes_mod.int32)
+    updates = ops_mod.convert_to_tensor(updates, dtype=ref.dtype.base_dtype)
+    op = g.create_op(op_type, [indices, updates],
+                     attrs={"var_name": _var_name_of(ref)},
+                     name=name or op_type,
+                     output_specs=[(ref.shape, ref.dtype.base_dtype)])
+    return op.outputs[0]
+
+
+def scatter_update(ref, indices, updates, use_locking=True, name=None):
+    return _scatter("ScatterUpdate", ref, indices, updates, name)
+
+
+def scatter_add(ref, indices, updates, use_locking=False, name=None):
+    return _scatter("ScatterAdd", ref, indices, updates, name)
+
+
+def scatter_sub(ref, indices, updates, use_locking=False, name=None):
+    return _scatter("ScatterSub", ref, indices, updates, name)
+
+
+def scatter_mul(ref, indices, updates, use_locking=False, name=None):
+    return _scatter("ScatterMul", ref, indices, updates, name)
+
+
+def scatter_div(ref, indices, updates, use_locking=False, name=None):
+    return _scatter("ScatterDiv", ref, indices, updates, name)
+
+
+def scatter_min(ref, indices, updates, use_locking=False, name=None):
+    return _scatter("ScatterMin", ref, indices, updates, name)
+
+
+def scatter_max(ref, indices, updates, use_locking=False, name=None):
+    return _scatter("ScatterMax", ref, indices, updates, name)
+
+
+def scatter_nd_update(ref, indices, updates, use_locking=True, name=None):
+    return _scatter("ScatterNdUpdate", ref, indices, updates, name)
+
+
+def is_variable_initialized(ref, name=None):
+    ref = _ref_of(ref)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("IsVariableInitialized", [],
+                     attrs={"var_name": _var_name_of(ref)},
+                     name=name or "IsVariableInitialized",
+                     output_specs=[(shape_mod.scalar(), dtypes_mod.bool_)])
+    return op.outputs[0]
+
+
+def count_up_to(ref, limit, name=None):
+    ref = _ref_of(ref)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("CountUpTo", [],
+                     attrs={"var_name": _var_name_of(ref), "limit": limit},
+                     name=name or "CountUpTo",
+                     output_specs=[(ref.shape, ref.dtype.base_dtype)])
+    return op.outputs[0]

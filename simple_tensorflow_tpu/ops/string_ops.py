@@ -1,0 +1,185 @@
+"""String ops (ref: tensorflow/python/ops/string_ops.py,
+core/kernels/string_*.cc).
+
+Strings never enter the XLA program: all string ops run in the Session's
+host stage (runs_on_host), operating on numpy object arrays. This replaces
+the reference's CPU-pinned string kernels (placement did the same job there).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import zlib
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from .op_util import make_op
+
+
+def _host_op(op_type, fn, n_outputs=1):
+    def lower(ctx, op, inputs):
+        attrs = {k: v for k, v in op.attrs.items() if not k.startswith("_")}
+        out = fn(*inputs, **attrs)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+    op_registry.register(op_type, lower=lower, is_stateful=True,
+                         runs_on_host=True, n_outputs=n_outputs)
+
+
+def _vec(fn):
+    return np.vectorize(fn, otypes=[object])
+
+
+_host_op("StringJoin", lambda *xs, separator="": _vec(
+    lambda *parts: separator.join(str(p) for p in parts))(*xs))
+_host_op("StringLower", _vec(lambda s: str(s).lower()))
+_host_op("StringUpper", _vec(lambda s: str(s).upper()))
+_host_op("StringStrip", _vec(lambda s: str(s).strip()))
+_host_op("StringLength", lambda x: np.vectorize(
+    lambda s: len(str(s)), otypes=[np.int32])(x))
+_host_op("Substr", lambda x, pos=0, length=0: _vec(
+    lambda s: str(s)[pos:pos + length])(x))
+_host_op("AsString", lambda x, precision=-1: _vec(
+    lambda v: (f"%.{precision}f" % v) if precision >= 0 and
+    isinstance(v, float) else str(v))(x))
+_host_op("StringToNumber", lambda x, out_type=None: np.vectorize(
+    lambda s: float(s), otypes=[out_type.np_dtype if out_type
+                                else np.float32])(x))
+_host_op("StringToHashBucketFast", lambda x, num_buckets=1: np.vectorize(
+    lambda s: zlib.crc32(str(s).encode()) % num_buckets,
+    otypes=[np.int64])(x))
+_host_op("StringToHashBucketStrong", lambda x, num_buckets=1, key=(0, 0):
+         np.vectorize(
+             lambda s: int(hashlib.sha256(
+                 (str(key) + str(s)).encode()).hexdigest(), 16) % num_buckets,
+             otypes=[np.int64])(x))
+_host_op("RegexReplace", lambda x, pattern="", rewrite="", replace_global=True:
+         _vec(lambda s: __import__("re").sub(
+             pattern, rewrite, str(s), count=0 if replace_global else 1))(x))
+_host_op("EncodeBase64", _vec(
+    lambda s: __import__("base64").urlsafe_b64encode(
+        s if isinstance(s, bytes) else str(s).encode()).rstrip(b"=").decode()))
+_host_op("DecodeBase64", _vec(
+    lambda s: __import__("base64").urlsafe_b64decode(
+        str(s) + "=" * (-len(str(s)) % 4)).decode()))
+
+
+def _string_api(op_type, x, name=None, attrs=None, out_dtype=dtypes_mod.string):
+    x = ops_mod.convert_to_tensor(x, dtype=None)
+    g = ops_mod.get_default_graph()
+    op = g.create_op(op_type, [x], attrs=attrs or {}, name=name or op_type,
+                     output_specs=[(x.shape, out_dtype)])
+    return op.outputs[0]
+
+
+def string_join(inputs, separator="", name=None):
+    ts = [ops_mod.convert_to_tensor(x) for x in inputs]
+    g = ops_mod.get_default_graph()
+    op = g.create_op("StringJoin", ts, attrs={"separator": separator},
+                     name=name or "StringJoin",
+                     output_specs=[(ts[0].shape, dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def string_lower(input, name=None):  # noqa: A002
+    return _string_api("StringLower", input, name)
+
+
+def string_upper(input, name=None):  # noqa: A002
+    return _string_api("StringUpper", input, name)
+
+
+def string_strip(input, name=None):  # noqa: A002
+    return _string_api("StringStrip", input, name)
+
+
+def string_length(input, name=None):  # noqa: A002
+    return _string_api("StringLength", input, name, out_dtype=dtypes_mod.int32)
+
+
+def substr(input, pos, len, name=None):  # noqa: A002
+    from ..framework import constant_op
+
+    p = int(constant_op.constant_value(ops_mod.convert_to_tensor(pos)))
+    l = int(constant_op.constant_value(ops_mod.convert_to_tensor(len)))
+    return _string_api("Substr", input, name, attrs={"pos": p, "length": l})
+
+
+def as_string(input, precision=-1, scientific=False, shortest=False,  # noqa: A002
+              width=-1, fill="", name=None):
+    return _string_api("AsString", input, name,
+                       attrs={"precision": precision})
+
+
+def string_to_number(string_tensor, out_type=dtypes_mod.float32, name=None):
+    return _string_api("StringToNumber", string_tensor, name,
+                       attrs={"out_type": dtypes_mod.as_dtype(out_type)},
+                       out_dtype=dtypes_mod.as_dtype(out_type))
+
+
+def string_to_hash_bucket_fast(input, num_buckets, name=None):  # noqa: A002
+    return _string_api("StringToHashBucketFast", input, name,
+                       attrs={"num_buckets": int(num_buckets)},
+                       out_dtype=dtypes_mod.int64)
+
+
+string_to_hash_bucket = string_to_hash_bucket_fast
+
+
+def string_to_hash_bucket_strong(input, num_buckets, key, name=None):  # noqa: A002
+    return _string_api("StringToHashBucketStrong", input, name,
+                       attrs={"num_buckets": int(num_buckets),
+                              "key": tuple(key)},
+                       out_dtype=dtypes_mod.int64)
+
+
+def regex_replace(input, pattern, rewrite, replace_global=True, name=None):  # noqa: A002
+    return _string_api("RegexReplace", input, name,
+                       attrs={"pattern": pattern, "rewrite": rewrite,
+                              "replace_global": replace_global})
+
+
+def encode_base64(input, pad=False, name=None):  # noqa: A002
+    return _string_api("EncodeBase64", input, name)
+
+
+def decode_base64(input, name=None):  # noqa: A002
+    return _string_api("DecodeBase64", input, name)
+
+
+def string_split(source, delimiter=" "):
+    from ..framework import constant_op
+    from ..framework.sparse_tensor import SparseTensor
+
+    v = constant_op.constant_value(ops_mod.convert_to_tensor(source))
+    if v is None:
+        raise ValueError("string_split needs static input on TPU "
+                         "(dynamic-shape output)")
+    indices, values = [], []
+    for i, s in enumerate(np.ravel(v)):
+        parts = str(s).split(delimiter) if delimiter else list(str(s))
+        for j, p in enumerate(parts):
+            indices.append([i, j])
+            values.append(p)
+    max_len = max((i[1] for i in indices), default=-1) + 1
+    return SparseTensor(
+        constant_op.constant(np.asarray(indices, dtype=np.int64).reshape(-1, 2)),
+        constant_op.constant(np.asarray(values, dtype=object)),
+        constant_op.constant(np.asarray([v.size, max_len], dtype=np.int64)))
+
+
+def reduce_join(inputs, axis=None, keep_dims=False, separator="", name=None,
+                reduction_indices=None):
+    from ..framework import constant_op
+
+    v = constant_op.constant_value(ops_mod.convert_to_tensor(inputs))
+    if v is None:
+        raise ValueError("reduce_join needs static input on TPU")
+    ax = axis if axis is not None else reduction_indices
+    out = np.apply_along_axis(lambda row: separator.join(str(s) for s in row),
+                              ax if ax is not None else -1, v)
+    return constant_op.constant(np.asarray(out, dtype=object))

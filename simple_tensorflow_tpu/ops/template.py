@@ -1,0 +1,46 @@
+"""make_template (ref: tensorflow/python/ops/template.py): wrap a function so
+its variables are created once and reused on later calls."""
+
+from __future__ import annotations
+
+from . import variable_scope as vs
+
+
+class Template:
+    def __init__(self, name, func, create_scope_now=False, unique_name=None,
+                 custom_getter=None):
+        self._func = func
+        self._name = name
+        self._unique_name = unique_name
+        self._custom_getter = custom_getter
+        self._scope_name = None
+        self._called = False
+
+    def __call__(self, *args, **kwargs):
+        if not self._called:
+            self._called = True
+            with vs.variable_scope(self._unique_name or self._name,
+                                   custom_getter=self._custom_getter) as scope:
+                self._scope_name = scope.name
+                return self._func(*args, **kwargs)
+        with vs.variable_scope(vs.VariableScope(self._scope_name, None,
+                                                reuse=True,
+                                                custom_getter=self._custom_getter)):
+            return self._func(*args, **kwargs)
+
+    @property
+    def variable_scope_name(self):
+        return self._scope_name
+
+    @property
+    def name(self):
+        return self._name
+
+
+def make_template(name, func, create_scope_now_=False, unique_name_=None,
+                  custom_getter_=None, **kwargs):
+    if kwargs:
+        import functools
+
+        func = functools.partial(func, **kwargs)
+    return Template(name, func, create_scope_now_, unique_name_, custom_getter_)

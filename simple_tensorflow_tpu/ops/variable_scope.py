@@ -1,0 +1,270 @@
+"""variable_scope / get_variable (ref: tensorflow/python/ops/variable_scope.py).
+
+Same reuse semantics as the reference: scopes form a path, get_variable
+creates or (with reuse=True) returns the existing variable of that full
+name; AUTO_REUSE creates on first use. Custom getters and partitioners are
+supported; a partitioner here attaches a sharding hint instead of physically
+splitting (the TPU-native equivalent — the mesh shards the single logical
+array, see stf.parallel).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Optional
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import tensor_shape as shape_mod
+from . import init_ops
+from . import variables as variables_mod
+
+AUTO_REUSE = "auto_reuse"
+
+
+class _VarStoreKey:
+    VARS = "__variable_store__"
+    SCOPE = "__variable_scope_stack__"
+
+
+def _graph_vars(g) -> dict:
+    # Variables (and thus the get_variable store) always belong to the root
+    # graph, even when called while tracing a cond/while/scan body.
+    root = g
+    while isinstance(root, ops_mod.FuncGraph):
+        root = root.outer_graph
+    return root._scoped_state.setdefault(_VarStoreKey.VARS, {})
+
+
+def _scope_stack(g) -> list:
+    root = g
+    while isinstance(root, ops_mod.FuncGraph):
+        root = root.outer_graph
+    st = root._scoped_state.get(_VarStoreKey.SCOPE)
+    if st is None:
+        st = [VariableScope("", None)]
+        root._scoped_state[_VarStoreKey.SCOPE] = st
+    return st
+
+
+class VariableScope:
+    def __init__(self, name, parent, reuse=False, initializer=None,
+                 regularizer=None, caching_device=None, partitioner=None,
+                 custom_getter=None, dtype=None):
+        self._name = name
+        self._reuse = reuse
+        self._initializer = initializer
+        self._regularizer = regularizer
+        self._partitioner = partitioner
+        self._custom_getter = custom_getter
+        self._dtype = dtype or dtypes_mod.float32
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def original_name_scope(self):
+        return self._name + "/" if self._name else ""
+
+    @property
+    def reuse(self):
+        return self._reuse
+
+    @property
+    def initializer(self):
+        return self._initializer
+
+    @property
+    def regularizer(self):
+        return self._regularizer
+
+    @property
+    def partitioner(self):
+        return self._partitioner
+
+    @property
+    def custom_getter(self):
+        return self._custom_getter
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def reuse_variables(self):
+        self._reuse = True
+
+    def set_initializer(self, initializer):
+        self._initializer = initializer
+
+    def set_partitioner(self, partitioner):
+        self._partitioner = partitioner
+
+    def get_variable(self, name, **kwargs):
+        return get_variable(name, **kwargs)
+
+
+def get_variable_scope() -> VariableScope:
+    return _scope_stack(ops_mod.get_default_graph())[-1]
+
+
+def get_variable(name, shape=None, dtype=None, initializer=None,
+                 regularizer=None, trainable=True, collections=None,
+                 caching_device=None, partitioner=None, validate_shape=True,
+                 use_resource=None, custom_getter=None, constraint=None):
+    """(ref: variable_scope.py:988 ``get_variable``)."""
+    g = ops_mod.get_default_graph()
+    scope = get_variable_scope()
+    full_name = f"{scope.name}/{name}" if scope.name else name
+    store = _graph_vars(g)
+
+    getter = custom_getter or scope.custom_getter
+
+    def _true_getter(name=full_name, shape=shape, dtype=dtype,
+                     initializer=initializer, regularizer=regularizer,
+                     trainable=trainable, collections=collections,
+                     partitioner=partitioner, constraint=constraint, **_):
+        reuse = scope.reuse
+        if name in store:
+            if reuse is False:
+                raise ValueError(
+                    f"Variable {name} already exists, disallowed. Did you "
+                    "mean to set reuse=True or reuse=stf.AUTO_REUSE in "
+                    "VarScope?")
+            v = store[name]
+            if shape is not None and not v.shape.is_compatible_with(shape):
+                raise ValueError(
+                    f"Trying to share variable {name}, but specified shape "
+                    f"{shape} and found shape {v.shape}.")
+            return v
+        if reuse is True:
+            raise ValueError(
+                f"Variable {name} does not exist, or was not created with "
+                "stf.get_variable(). Did you mean to set reuse=None in "
+                "VarScope?")
+        dt = dtypes_mod.as_dtype(dtype or scope.dtype)
+        init = initializer if initializer is not None else scope.initializer
+        if init is None:
+            if dt.is_floating:
+                init = init_ops.glorot_uniform_initializer(dtype=dt)
+            elif dt.is_integer or dt.is_bool:
+                init = init_ops.Zeros(dtype=dt)
+            else:
+                raise ValueError(f"No default initializer for dtype {dt}")
+        if callable(init) and not isinstance(init, ops_mod.Tensor):
+            if shape is None:
+                raise ValueError(f"Shape of variable {name} must be known")
+            sh = [int(d) for d in shape_mod.as_shape(shape).as_list()]
+
+            def init_val():
+                try:
+                    return init(sh, dtype=dt)
+                except TypeError:
+                    return init(sh)
+        else:
+            init_val = init
+        v = variables_mod.Variable(
+            initial_value=init_val, trainable=trainable,
+            collections=collections, validate_shape=validate_shape,
+            name=name + "/", dtype=dt, constraint=constraint)
+        # name + "/" -> exact-name convention so the store key matches.
+        store[name] = v
+        part = partitioner or scope.partitioner
+        if part is not None:
+            v._op.attrs["partition_hint"] = part
+        reg = regularizer if regularizer is not None else scope.regularizer
+        if reg is not None:
+            with ops_mod.name_scope(name + "/Regularizer"):
+                loss = reg(v._ref)
+            if loss is not None:
+                g.add_to_collection(ops_mod.GraphKeys.REGULARIZATION_LOSSES,
+                                    loss)
+        return v
+
+    if getter is not None:
+        return getter(_true_getter, name=full_name, shape=shape, dtype=dtype,
+                      initializer=initializer, regularizer=regularizer,
+                      trainable=trainable, collections=collections,
+                      partitioner=partitioner, constraint=constraint)
+    return _true_getter()
+
+
+@contextlib.contextmanager
+def variable_scope(name_or_scope, default_name=None, values=None,
+                   initializer=None, regularizer=None, caching_device=None,
+                   partitioner=None, custom_getter=None, reuse=None,
+                   dtype=None, auxiliary_name_scope=True):
+    """(ref: variable_scope.py:1615 ``variable_scope``)."""
+    g = ops_mod.get_default_graph()
+    stack = _scope_stack(g)
+    parent = stack[-1]
+    if isinstance(name_or_scope, VariableScope):
+        new_name = name_or_scope.name
+        base = name_or_scope
+    else:
+        if name_or_scope is None:
+            name_or_scope = default_name
+        new_name = f"{parent.name}/{name_or_scope}" if parent.name \
+            else name_or_scope
+        base = None
+    scope = VariableScope(
+        new_name, parent,
+        reuse=(reuse if reuse is not None
+               else (base.reuse if base else parent.reuse)),
+        initializer=(initializer if initializer is not None
+                     else (base.initializer if base else parent.initializer)),
+        regularizer=(regularizer if regularizer is not None
+                     else (base.regularizer if base else parent.regularizer)),
+        partitioner=(partitioner if partitioner is not None
+                     else (base.partitioner if base else parent.partitioner)),
+        custom_getter=(custom_getter if custom_getter is not None
+                       else (base.custom_getter if base
+                             else parent.custom_getter)),
+        dtype=(dtype if dtype is not None
+               else (base.dtype if base else parent.dtype)))
+    stack.append(scope)
+    try:
+        if auxiliary_name_scope and not isinstance(name_or_scope, VariableScope):
+            with g.name_scope(name_or_scope):
+                yield scope
+        else:
+            yield scope
+    finally:
+        stack.pop()
+
+
+@contextlib.contextmanager
+def variable_op_scope(values, name_or_scope, default_name=None, **kwargs):
+    with variable_scope(name_or_scope, default_name=default_name,
+                        **kwargs) as vs:
+        yield vs
+
+
+def no_regularizer(_):
+    return None
+
+
+def fixed_size_partitioner(num_shards, axis=0):
+    """Partitioner → sharding hint (see class docstring)."""
+
+    def partitioner(shape=None, dtype=None):
+        return {"axis": axis, "num_shards": num_shards}
+
+    return partitioner
+
+
+def variable_axis_size_partitioner(max_shard_bytes, axis=0, bytes_per_string=16,
+                                   max_shards=None):
+    def partitioner(shape=None, dtype=None):
+        return {"axis": axis, "max_shard_bytes": max_shard_bytes}
+
+    return partitioner
+
+
+def min_max_variable_partitioner(max_partitions=1, axis=0,
+                                 min_slice_size=256 << 10, bytes_per_string_element=16):
+    def partitioner(shape=None, dtype=None):
+        return {"axis": axis, "max_partitions": max_partitions}
+
+    return partitioner

@@ -1,0 +1,363 @@
+"""stf.Variable (ref: tensorflow/python/ops/variables.py ``class Variable``).
+
+A Variable is graph metadata + a named slot in the Session's device-resident
+VariableStore. The graph holds a stateful ``VariableV2`` read op (its output
+is the "ref" tensor, as in TF-1.0) and an initializer ``Assign`` op. Values
+live as jax.Arrays on the TPU, donated back into each step's XLA program, so
+updates are in-place in HBM. Sharding metadata (set by stf.parallel scopes)
+travels on the variable and becomes the state buffer's NamedSharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import tensor_shape as shape_mod
+from . import state_ops
+
+GraphKeys = ops_mod.GraphKeys
+
+
+class Variable:
+    def __init__(self, initial_value=None, trainable=True, collections=None,
+                 validate_shape=True, name=None, dtype=None,
+                 expected_shape=None, caching_device=None,
+                 variable_def=None, import_scope=None, constraint=None):
+        if initial_value is None:
+            raise ValueError("initial_value must be specified.")
+        g = ops_mod._root_graph()  # variables live in the root graph
+        self._graph = g
+        self._constraint = constraint
+        self._save_slice_info = None
+        self._root_ctx = ops_mod._as_current(g)
+        with self._root_ctx, g.name_scope(name or "Variable") as scope:
+            base = scope[:-1] if scope else g.unique_name("Variable")
+            if callable(initial_value):
+                with g.name_scope("Initializer"):
+                    initial_value = initial_value()
+            self._initial_value = ops_mod.convert_to_tensor(
+                initial_value, dtype=dtype, name="initial_value")
+            if validate_shape and not self._initial_value.shape.is_fully_defined():
+                raise ValueError(
+                    f"initial_value for {base} must have fully defined shape, "
+                    f"got {self._initial_value.shape}. Pass validate_shape=False "
+                    "to defer (NB: XLA still needs static shapes at run time).")
+            dt = self._initial_value.dtype.base_dtype
+            shape = self._initial_value.shape
+            self._var_name = base
+            var_op = g.create_op(
+                "VariableV2", [],
+                attrs={"var_name": base, "dtype": dt, "shape": shape,
+                       "trainable": trainable, "sharding": None,
+                       "container": g._container},
+                name=base + "/" if scope else base,  # exact-name convention
+                output_specs=[(shape, dt._ref)])
+            self._ref = var_op.outputs[0]
+            self._op = var_op
+            with g.name_scope("Assign"):
+                self._initializer_op = state_ops.assign(
+                    self._ref, self._initial_value,
+                    validate_shape=validate_shape).op
+            read_op = g.create_op(
+                "ReadVariable", [], attrs={"var_name": base},
+                name=base + "/read" + "/",
+                output_specs=[(shape, dt)])
+            self._snapshot = read_op.outputs[0]
+
+        if collections is None:
+            collections = [GraphKeys.GLOBAL_VARIABLES]
+        if trainable and GraphKeys.TRAINABLE_VARIABLES not in collections:
+            collections = list(collections) + [GraphKeys.TRAINABLE_VARIABLES]
+        g.add_to_collections(collections, self)
+        self._trainable = trainable
+        # Store-name registry (Session resolves shardings through it) and the
+        # active shard_variables_along scope, if any.
+        g._scoped_state.setdefault("__vars_by_store_name__", {})[base] = self
+        from ..parallel import api as _papi
+
+        _papi.maybe_apply_variable_sharding(self)
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._ref.name
+
+    @property
+    def var_name(self) -> str:
+        """Store key (op name, no ':0')."""
+        return self._var_name
+
+    @property
+    def op(self):
+        return self._op
+
+    @property
+    def graph(self):
+        return self._graph
+
+    @property
+    def dtype(self):
+        return self._ref.dtype
+
+    @property
+    def shape(self):
+        return self._ref.shape
+
+    def get_shape(self):
+        return self._ref.shape
+
+    @property
+    def trainable(self):
+        return self._trainable
+
+    @property
+    def initial_value(self):
+        return self._initial_value
+
+    @property
+    def initializer(self):
+        return self._initializer_op
+
+    @property
+    def constraint(self):
+        return self._constraint
+
+    @property
+    def device(self):
+        return self._op.device
+
+    # -- sharding (TPU-native extension) -------------------------------------
+    @property
+    def sharding(self):
+        return self._op.attrs.get("sharding")
+
+    def set_sharding(self, spec):
+        """Attach a PartitionSpec-like sharding; the Session places the
+        state buffer with it (see stf/parallel)."""
+        self._op.attrs["sharding"] = spec
+
+    # -- value access --------------------------------------------------------
+    def value(self):
+        return self._snapshot
+
+    def read_value(self):
+        """Fresh read op: under `control_dependencies([assign])` it observes
+        the write (deref-at-use, TF-1.0 ref semantics)."""
+        g = ops_mod.get_default_graph()
+        op = g.create_op("ReadVariable", [],
+                         attrs={"var_name": self._var_name}, name="read",
+                         output_specs=[(self.shape, self.dtype.base_dtype)])
+        return op.outputs[0]
+
+    def _grad_anchor(self):
+        """Tensor that stf.gradients differentiates when a Variable is passed
+        as an x (the ref read; ref gradients_impl handles this the same way)."""
+        return self._ref
+
+    def initialized_value(self):
+        with ops_mod.get_default_graph().control_dependencies(
+                [self._initializer_op]):
+            return self.read_value()
+
+    def eval(self, session=None):
+        return self._ref.eval(session=session)
+
+    # -- mutation ------------------------------------------------------------
+    def assign(self, value, use_locking=False, read_value=True):
+        return state_ops.assign(self._ref, value)
+
+    def assign_add(self, delta, use_locking=False, read_value=True):
+        return state_ops.assign_add(self._ref, delta)
+
+    def assign_sub(self, delta, use_locking=False, read_value=True):
+        return state_ops.assign_sub(self._ref, delta)
+
+    def scatter_sub(self, sparse_delta, use_locking=False):
+        from ..framework.indexed_slices import IndexedSlices
+
+        assert isinstance(sparse_delta, IndexedSlices)
+        return state_ops.scatter_sub(self._ref, sparse_delta.indices,
+                                     sparse_delta.values)
+
+    def load(self, value, session=None):
+        """Directly set the store value (host path, no graph op)."""
+        from ..client.session import get_default_session
+
+        session = session or get_default_session()
+        if session is None:
+            raise ValueError("No session for Variable.load")
+        session._variable_store.load(self._var_name, value, self)
+
+    def count_up_to(self, limit):
+        return state_ops.count_up_to(self._ref, limit)
+
+    # -- graph element protocol ---------------------------------------------
+    def _as_graph_element(self):
+        return self._ref
+
+    def to_proto(self, export_scope=None):
+        return {
+            "variable_name": self.name,
+            "initial_value_name": self._initial_value.name,
+            "initializer_name": self._initializer_op.name,
+            "snapshot_name": self._snapshot.name,
+            "trainable": self._trainable,
+        }
+
+    @property
+    def _shared_name(self):
+        return self._var_name
+
+    def __repr__(self):
+        return (f"<stf.Variable '{self.name}' shape={self.shape} "
+                f"dtype={self.dtype.base_dtype.name}>")
+
+    # Arithmetic on variables delegates to the snapshot tensor; operator
+    # overloads installed by math_ops cover Tensor, so convert first.
+
+
+def _variable_conversion(value, dtype=None, name=None):
+    t = value._ref
+    if dtype is not None and not dtypes_mod.as_dtype(dtype).is_compatible_with(t.dtype):
+        return NotImplemented
+    return t
+
+
+ops_mod.register_tensor_conversion_function(Variable, _variable_conversion)
+
+
+# -- module-level helpers (ref: variables.py bottom half) --------------------
+
+def global_variables():
+    return ops_mod.get_default_graph().get_collection(GraphKeys.GLOBAL_VARIABLES)
+
+
+def all_variables():
+    return global_variables()
+
+
+def local_variables():
+    return ops_mod.get_default_graph().get_collection(GraphKeys.LOCAL_VARIABLES)
+
+
+def model_variables():
+    return ops_mod.get_default_graph().get_collection(GraphKeys.MODEL_VARIABLES)
+
+
+def trainable_variables():
+    return ops_mod.get_default_graph().get_collection(GraphKeys.TRAINABLE_VARIABLES)
+
+
+def moving_average_variables():
+    return ops_mod.get_default_graph().get_collection(
+        GraphKeys.MOVING_AVERAGE_VARIABLES)
+
+
+def variables_initializer(var_list, name="init"):
+    from . import control_flow_ops
+
+    if not var_list:
+        return control_flow_ops.no_op(name=name)
+    return control_flow_ops.group(*[v.initializer for v in var_list], name=name)
+
+
+def initialize_variables(var_list, name="init"):
+    return variables_initializer(var_list, name)
+
+
+def global_variables_initializer():
+    return variables_initializer(global_variables(), "init")
+
+
+def initialize_all_variables():
+    return global_variables_initializer()
+
+
+def local_variables_initializer():
+    return variables_initializer(local_variables(), "init_local")
+
+
+def initialize_local_variables():
+    return local_variables_initializer()
+
+
+def is_variable_initialized(variable):
+    return state_ops.is_variable_initialized(variable._ref)
+
+
+def assert_variables_initialized(var_list=None):
+    from . import control_flow_ops
+
+    if var_list is None:
+        var_list = global_variables() + local_variables()
+    checks = [state_ops.is_variable_initialized(v._ref) for v in var_list]
+    if not checks:
+        return None
+    from . import math_ops, array_ops, logging_ops
+
+    stacked = array_ops.stack(checks)
+    return logging_ops.Assert(math_ops.reduce_all(stacked),
+                              ["Uninitialized variables"], name="assert_initialized")
+
+
+def report_uninitialized_variables(var_list=None, name="report_uninitialized_variables"):
+    from . import array_ops
+
+    if var_list is None:
+        var_list = global_variables() + local_variables()
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "ReportUninitialized", [],
+        attrs={"var_names": tuple(v._var_name for v in var_list)},
+        name=name,
+        output_specs=[(shape_mod.TensorShape([None]), dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def _lower_report_uninitialized(ctx, op, inputs):
+    import numpy as np
+
+    names = [n for n in op.attrs["var_names"] if not ctx.var_exists(n)]
+    return [np.asarray(names, dtype=object)]
+
+
+from ..framework import op_registry  # noqa: E402
+
+op_registry.register("ReportUninitialized", lower=_lower_report_uninitialized,
+                     is_stateful=True, runs_on_host=True)
+
+
+class PartitionedVariable:
+    """A variable split along one axis (ref: python/ops/partitioned_variables.py).
+    On TPU the natural form is a single logical array with a NamedSharding;
+    this class keeps the reference's list-of-slices API while the backing
+    store is the sharded array."""
+
+    def __init__(self, name, shape, dtype, variable_list, partitions):
+        self._name = name
+        self._shape = shape_mod.as_shape(shape)
+        self._dtype = dtype
+        self._vars = list(variable_list)
+        self._partitions = partitions
+
+    @property
+    def name(self):
+        return self._name
+
+    def __iter__(self):
+        return iter(self._vars)
+
+    def __len__(self):
+        return len(self._vars)
+
+    def as_tensor(self):
+        from . import array_ops
+
+        axis = next((i for i, p in enumerate(self._partitions) if p > 1), 0)
+        return array_ops.concat([v._ref for v in self._vars], axis=axis,
+                                name=self._name + "/concat")
+
+    def _as_graph_element(self):
+        return self.as_tensor()

@@ -1,0 +1,141 @@
+"""Sharding annotation API (replaces ref: core/common_runtime/simple_placer.cc
+device placement + python/training/device_setter.py).
+
+Placement on TPU is sharding: arrays carry NamedShardings, XLA GSPMD
+partitions the one compiled step program and inserts ICI collectives. This
+module annotates the three array classes:
+
+- variables: ``shard_variables_along(axis)`` scope or ``shard_variable``;
+  the Session places the state buffer with the sharding after init,
+- feeds (the global batch): ``shard_feed(placeholder, spec)``; Session
+  device_puts each fed array with it (host shards its slice on pods),
+- activations: ``with_sharding_constraint(t, spec)`` graph op →
+  lax.with_sharding_constraint inside the step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from .mesh import Mesh, P, PartitionSpec, current_mesh, make_mesh
+
+_VS_KEY = "__variable_sharding_rule__"
+
+
+@contextlib.contextmanager
+def shard_variables_along(axis, min_size=2 ** 14, dim=None):
+    """Variables created in this scope are sharded over mesh axis ``axis``
+    on their largest dimension (fsdp/ZeRO-3 layout) unless ``dim`` pins one.
+    Small variables stay replicated (below ``min_size`` elements)."""
+    g = ops_mod._root_graph()
+    prev = g._scoped_state.get(_VS_KEY)
+    g._scoped_state[_VS_KEY] = {"axis": axis, "min_size": min_size,
+                                "dim": dim}
+    try:
+        yield
+    finally:
+        if prev is None:
+            g._scoped_state.pop(_VS_KEY, None)
+        else:
+            g._scoped_state[_VS_KEY] = prev
+
+
+def _auto_spec_for(shape, rule, mesh):
+    if rule is None or mesh is None:
+        return None
+    dims = [int(d) for d in shape]
+    n = 1
+    for d in dims:
+        n *= d
+    if n < rule["min_size"] or not dims:
+        return None
+    axis = rule["axis"]
+    size = mesh.axis_size(axis) if axis in mesh.shape else None
+    if size is None:
+        return None
+    dim = rule["dim"]
+    if dim is None:
+        # largest dim divisible by the axis size
+        cands = [i for i, d in enumerate(dims) if d % size == 0]
+        if not cands:
+            return None
+        dim = max(cands, key=lambda i: dims[i])
+    spec = [None] * len(dims)
+    spec[dim] = axis
+    return P(*spec)
+
+
+def maybe_apply_variable_sharding(variable):
+    """Called by Variable.__init__; applies the active scope rule."""
+    g = variable.graph
+    rule = g._scoped_state.get(_VS_KEY)
+    mesh = current_mesh()
+    if rule is not None and mesh is not None and variable.sharding is None:
+        spec = _auto_spec_for(variable.shape.as_list(), rule, mesh)
+        if spec is not None:
+            variable.set_sharding(spec)
+
+
+def shard_variable(variable, *spec):
+    variable.set_sharding(P(*spec))
+    return variable
+
+
+def shard_feed(placeholder, *spec):
+    """Annotate a placeholder so Session shards the fed batch over the mesh
+    (e.g. shard_feed(x, 'dp') splits dim 0 across data-parallel devices)."""
+    placeholder.op.attrs["sharding"] = P(*spec)
+    return placeholder
+
+
+def _lower_sharding_constraint(ctx, op, inputs):
+    import jax
+
+    mesh = current_mesh()
+    spec = op.attrs["spec"]
+    if mesh is None:
+        return [inputs[0]]
+    ns = jax.sharding.NamedSharding(mesh.jax_mesh, spec.to_jax()
+                                    if isinstance(spec, PartitionSpec)
+                                    else jax.sharding.PartitionSpec(*spec))
+    return [jax.lax.with_sharding_constraint(inputs[0], ns)]
+
+
+op_registry.register("ShardingConstraint", lower=_lower_sharding_constraint)
+
+
+def with_sharding_constraint(tensor, *spec, name=None):
+    """Pin an activation's layout (→ lax.with_sharding_constraint). The
+    classic uses: batch axis on 'dp', hidden on 'tp' after a sharded matmul,
+    sequence on 'sp'."""
+    t = ops_mod.convert_to_tensor(tensor)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("ShardingConstraint", [t], attrs={"spec": P(*spec)},
+                     name=name or "sharding_constraint",
+                     output_specs=[(t.shape, t.dtype)])
+    return op.outputs[0]
+
+
+def num_devices() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    return process_index() == 0

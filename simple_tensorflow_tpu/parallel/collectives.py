@@ -1,0 +1,356 @@
+"""Collective ops (replaces ref: third_party/nccl.BUILD NcclAllReduce,
+core/kernels/sendrecv_ops.cc Send/Recv, core/distributed_runtime rendezvous).
+
+Two execution regimes, both XLA-native:
+
+1. **GSPMD (default)** — the Session jits one global program over sharded
+   arrays; XLA inserts the collectives. Here the graph is *global*: a
+   gradient of a loss over the dp-sharded global batch is already the
+   all-reduced gradient. So outside shard_map, ``all_reduce`` is the
+   identity (with a sharding sanity-hint), and ``all_gather`` lowers to a
+   replicate-constraint that forces the gather. This is not a cop-out — it
+   is the GSPMD contract (the reference needs NcclAllReduce precisely
+   because its replicas are separate programs).
+
+2. **shard_map (explicit SPMD)** — inside stf.parallel.shard_map the body
+   is per-device code with named axes; collectives lower to the XLA
+   primitives lax.psum / all_gather / ppermute / all_to_all over ICI.
+   Ring attention and pipeline schedules use this regime.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+from ..framework import graph as ops_mod
+from ..framework import lowering as lowering_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from .mesh import current_mesh, P, PartitionSpec
+
+
+def _axis_tuple(axis):
+    if isinstance(axis, str):
+        return (axis,)
+    return builtins.tuple(axis)
+
+
+def _in_shard_map(ctx):
+    return getattr(ctx, "in_shard_map", False)
+
+
+def _lower_all_reduce(ctx, op, inputs):
+    import jax
+
+    x = inputs[0]
+    axes = op.attrs["axes"]
+    reduce_op = op.attrs["op"]
+    if _in_shard_map(ctx):
+        if reduce_op == "sum":
+            return [jax.lax.psum(x, axes)]
+        if reduce_op == "mean":
+            return [jax.lax.pmean(x, axes)]
+        if reduce_op == "max":
+            return [jax.lax.pmax(x, axes)]
+        if reduce_op == "min":
+            return [jax.lax.pmin(x, axes)]
+        raise ValueError(f"unknown reduce op {reduce_op}")
+    # GSPMD regime: the value is already global (see module docstring).
+    return [x]
+
+
+op_registry.register("AllReduce", lower=_lower_all_reduce)
+
+
+def _lower_all_gather(ctx, op, inputs):
+    import jax
+
+    x = inputs[0]
+    axes = op.attrs["axes"]
+    gather_dim = op.attrs["axis_index"]
+    if _in_shard_map(ctx):
+        out = x
+        for a in axes:
+            out = jax.lax.all_gather(out, a, axis=gather_dim, tiled=True)
+        return [out]
+    mesh = current_mesh()
+    if mesh is None:
+        return [x]
+    ns = jax.sharding.NamedSharding(mesh.jax_mesh,
+                                    jax.sharding.PartitionSpec())
+    return [jax.lax.with_sharding_constraint(x, ns)]
+
+
+op_registry.register("AllGather", lower=_lower_all_gather)
+
+
+def _lower_reduce_scatter(ctx, op, inputs):
+    import jax
+
+    x = inputs[0]
+    axes = op.attrs["axes"]
+    scatter_dim = op.attrs["axis_index"]
+    if _in_shard_map(ctx):
+        out = x
+        for a in axes:
+            out = jax.lax.psum_scatter(out, a, scatter_dimension=scatter_dim,
+                                       tiled=True)
+        return [out]
+    mesh = current_mesh()
+    if mesh is None:
+        return [x]
+    spec = [None] * inputs[0].ndim
+    spec[scatter_dim] = axes[0] if len(axes) == 1 else builtins.tuple(axes)
+    ns = jax.sharding.NamedSharding(mesh.jax_mesh,
+                                    jax.sharding.PartitionSpec(*spec))
+    return [jax.lax.with_sharding_constraint(x, ns)]
+
+
+op_registry.register("ReduceScatter", lower=_lower_reduce_scatter)
+
+
+def _lower_all_to_all(ctx, op, inputs):
+    import jax
+
+    if not _in_shard_map(ctx):
+        raise ValueError(
+            "all_to_all is an explicit-SPMD collective: call it inside "
+            "stf.parallel.shard_map (GSPMD inserts its own all-to-alls from "
+            "sharding constraints).")
+    return [jax.lax.all_to_all(inputs[0], op.attrs["axes"][0],
+                               split_axis=op.attrs["split_axis"],
+                               concat_axis=op.attrs["concat_axis"],
+                               tiled=True)]
+
+
+op_registry.register("AllToAll", lower=_lower_all_to_all)
+
+
+def _lower_ppermute(ctx, op, inputs):
+    import jax
+
+    if not _in_shard_map(ctx):
+        raise ValueError("ppermute requires stf.parallel.shard_map")
+    return [jax.lax.ppermute(inputs[0], op.attrs["axes"][0],
+                             perm=op.attrs["perm"])]
+
+
+op_registry.register("CollectivePermute", lower=_lower_ppermute)
+
+
+def _lower_axis_index(ctx, op, inputs):
+    import jax
+
+    if not _in_shard_map(ctx):
+        raise ValueError("axis_index requires stf.parallel.shard_map")
+    return [jax.lax.axis_index(op.attrs["axes"][0])]
+
+
+op_registry.register("AxisIndex", lower=_lower_axis_index, is_stateful=True)
+
+
+def _lower_psum_scatter_like(ctx, op, inputs):
+    return _lower_reduce_scatter(ctx, op, inputs)
+
+
+# -- public API --------------------------------------------------------------
+
+def all_reduce(tensor, axis, op="sum", name=None):
+    """NcclAllReduce parity (ref third_party/nccl.BUILD); see module
+    docstring for GSPMD semantics."""
+    t = ops_mod.convert_to_tensor(tensor)
+    g = ops_mod.get_default_graph()
+    node = g.create_op("AllReduce", [t],
+                       attrs={"axes": _axis_tuple(axis), "op": op},
+                       name=name or "all_reduce",
+                       output_specs=[(t.shape, t.dtype)])
+    return node.outputs[0]
+
+
+def all_gather(tensor, axis, gather_dim=0, name=None):
+    t = ops_mod.convert_to_tensor(tensor)
+    g = ops_mod.get_default_graph()
+    mesh = current_mesh()
+    out_shape = t.shape
+    if mesh is not None and t.shape.rank is not None and \
+            t.shape[gather_dim].value is not None:
+        mult = 1
+        for a in _axis_tuple(axis):
+            mult *= mesh.axis_size(a)
+        dims = t.shape.as_list()
+        # inside shard_map the local dim grows; under GSPMD global shape is
+        # unchanged. Report unknown to stay honest in both regimes.
+        out_shape = shape_mod.TensorShape([None if i == gather_dim else d
+                                           for i, d in enumerate(dims)])
+    node = g.create_op("AllGather", [t],
+                       attrs={"axes": _axis_tuple(axis),
+                              "axis_index": int(gather_dim)},
+                       name=name or "all_gather",
+                       output_specs=[(out_shape, t.dtype)])
+    return node.outputs[0]
+
+
+def reduce_scatter(tensor, axis, scatter_dim=0, name=None):
+    t = ops_mod.convert_to_tensor(tensor)
+    g = ops_mod.get_default_graph()
+    dims = t.shape.as_list() if t.shape.rank is not None else None
+    out_shape = shape_mod.TensorShape(
+        [None if i == scatter_dim else d for i, d in enumerate(dims)]
+        if dims is not None else None)
+    node = g.create_op("ReduceScatter", [t],
+                       attrs={"axes": _axis_tuple(axis),
+                              "axis_index": int(scatter_dim)},
+                       name=name or "reduce_scatter",
+                       output_specs=[(out_shape, t.dtype)])
+    return node.outputs[0]
+
+
+def all_to_all(tensor, axis, split_axis, concat_axis, name=None):
+    t = ops_mod.convert_to_tensor(tensor)
+    g = ops_mod.get_default_graph()
+    node = g.create_op("AllToAll", [t],
+                       attrs={"axes": _axis_tuple(axis),
+                              "split_axis": int(split_axis),
+                              "concat_axis": int(concat_axis)},
+                       name=name or "all_to_all",
+                       output_specs=[(shape_mod.TensorShape(None), t.dtype)])
+    return node.outputs[0]
+
+
+def ppermute(tensor, axis, perm, name=None):
+    """Neighbor exchange over ICI (ring attention / pipeline bubble fill)."""
+    t = ops_mod.convert_to_tensor(tensor)
+    g = ops_mod.get_default_graph()
+    node = g.create_op("CollectivePermute", [t],
+                       attrs={"axes": _axis_tuple(axis),
+                              "perm": builtins.tuple(
+                                  builtins.tuple(p) for p in perm)},
+                       name=name or "ppermute",
+                       output_specs=[(t.shape, t.dtype)])
+    return node.outputs[0]
+
+
+def axis_index(axis, name=None):
+    from ..framework import dtypes as dtypes_mod
+
+    g = ops_mod.get_default_graph()
+    node = g.create_op("AxisIndex", [], attrs={"axes": _axis_tuple(axis)},
+                       name=name or "axis_index",
+                       output_specs=[(shape_mod.scalar(), dtypes_mod.int32)])
+    return node.outputs[0]
+
+
+def broadcast(tensor, axis, root=0, name=None):
+    """Broadcast from root along axis (GSPMD: replicate constraint)."""
+    return all_gather(tensor, axis, name=name or "broadcast")
+
+
+# -- shard_map region --------------------------------------------------------
+
+def _lower_shard_map(ctx, op, inputs):
+    import jax
+
+    fg = op.attrs["body"]
+    mesh = op.attrs["mesh"] or current_mesh()
+    if mesh is None:
+        raise ValueError("shard_map requires an active Mesh")
+    in_specs = builtins.tuple(
+        s.to_jax() if isinstance(s, PartitionSpec)
+        else jax.sharding.PartitionSpec(*s) for s in op.attrs["in_specs"])
+    out_specs = builtins.tuple(
+        s.to_jax() if isinstance(s, PartitionSpec)
+        else jax.sharding.PartitionSpec(*s) for s in op.attrs["out_specs"])
+    n_args = op.attrs["n_args"]
+    caps = builtins.list(inputs[n_args:])
+
+    def body(*args):
+        child_env = {}
+        child = ctx.child(child_env, in_control_flow=True)
+        child.in_shard_map = True
+        outs = lowering_mod.lower_func_graph(child, fg, builtins.list(args),
+                                             caps)
+        return builtins.tuple(outs)
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    fn = _shard_map(body, mesh=mesh.jax_mesh, in_specs=in_specs,
+                    out_specs=out_specs if len(out_specs) > 1
+                    else out_specs[0], check_vma=False)
+    out = fn(*inputs[:n_args])
+    if not isinstance(out, builtins.tuple):
+        out = (out,)
+    return builtins.list(out)
+
+
+op_registry.register("ShardMap", lower=_lower_shard_map, n_outputs=None)
+
+
+def shard_map(fn, inputs, in_specs, out_specs, mesh=None, name=None):
+    """Explicit-SPMD region: ``fn`` sees per-device shards and may call
+    collectives (all_reduce/ppermute/...) with real axis names. The TPU
+    counterpart of writing a custom NCCL schedule in the reference."""
+    from ..framework import dtypes as dtypes_mod
+    from ..ops.functional_ops import _build_fn_graph
+
+    inputs = [ops_mod.convert_to_tensor(x) for x in inputs]
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("shard_map requires an active Mesh")
+    in_specs = [P(*s) if not isinstance(s, PartitionSpec) else s
+                for s in in_specs]
+    out_specs_l = [P(*s) if not isinstance(s, PartitionSpec) else s
+                   for s in (out_specs if isinstance(out_specs, (list,
+                                                                 builtins.tuple))
+                             else [out_specs])]
+
+    def local_shape(t, spec):
+        dims = t.shape.as_list()
+        out = []
+        for i, d in enumerate(dims):
+            ax = spec[i] if i < len(spec) else None
+            if ax is None or d is None:
+                out.append(d)
+            else:
+                axes = (ax,) if isinstance(ax, str) else ax
+                f = 1
+                for a in axes:
+                    f *= mesh.axis_size(a)
+                out.append(d // f)
+        return out
+
+    arg_specs = [(local_shape(t, s), t.dtype)
+                 for t, s in zip(inputs, in_specs)]
+    fg, _ = _build_fn_graph(lambda *a: fn(*a), arg_specs, "shard_map_body")
+    caps = [outer for outer, _ in fg.captures]
+    g = ops_mod.get_default_graph()
+
+    def global_shape(o, spec):
+        dims = o.shape.as_list() if o.shape.rank is not None else None
+        if dims is None:
+            return shape_mod.TensorShape(None)
+        out = []
+        for i, d in enumerate(dims):
+            ax = spec[i] if i < len(spec) else None
+            if ax is None or d is None:
+                out.append(d)
+            else:
+                axes = (ax,) if isinstance(ax, str) else ax
+                f = 1
+                for a in axes:
+                    f *= mesh.axis_size(a)
+                out.append(d * f)
+        return shape_mod.TensorShape(out)
+
+    out_spec_list = [(global_shape(o, s), o.dtype)
+                     for o, s in zip(fg.outputs, out_specs_l)]
+    node = g.create_op("ShardMap", inputs + caps,
+                       attrs={"body": fg, "mesh": mesh,
+                              "in_specs": builtins.tuple(in_specs),
+                              "out_specs": builtins.tuple(out_specs_l),
+                              "n_args": len(inputs)},
+                       name=name or "shard_map", output_specs=out_spec_list)
+    outs = builtins.list(node.outputs)
+    return outs[0] if len(outs) == 1 else outs

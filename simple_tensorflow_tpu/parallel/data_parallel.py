@@ -1,0 +1,46 @@
+"""Data parallelism helper (replaces ref sync_replicas + NcclAllReduce flow).
+
+GSPMD recipe: shard the batch feeds over ('dp',) and leave params
+replicated; the jitted step computes the global loss/grads and XLA inserts
+the gradient reduction (a reduce-scatter + all-gather pair or all-reduce)
+over ICI. ``DataParallel`` wires this onto an existing graph.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..framework import graph as ops_mod
+from . import api as api_mod
+from .mesh import Mesh, P, current_mesh
+
+
+class DataParallel:
+    """Usage:
+        mesh = stf.parallel.Mesh({"dp": 8})
+        with mesh:
+            x = stf.placeholder(...); y = stf.placeholder(...)
+            stf.parallel.DataParallel(mesh).shard_batch([x, y])
+            ... build model / optimizer as usual ...
+    """
+
+    def __init__(self, mesh: Mesh = None, batch_axes: Sequence[str] = ("dp",)):
+        self.mesh = mesh or current_mesh()
+        if self.mesh is None:
+            raise ValueError("DataParallel needs a Mesh")
+        self.batch_axes = tuple(batch_axes)
+
+    def shard_batch(self, placeholders, batch_dim=0):
+        ax = self.batch_axes[0] if len(self.batch_axes) == 1 \
+            else self.batch_axes
+        for ph in (placeholders if isinstance(placeholders, (list, tuple))
+                   else [placeholders]):
+            rank = ph.shape.rank or (batch_dim + 1)
+            spec = [None] * rank
+            spec[batch_dim] = ax
+            api_mod.shard_feed(ph, *spec)
+        return placeholders
+
+    def replicate_variables(self):
+        # Replicated is the default placement; explicit call for clarity.
+        return self

@@ -1,0 +1,11 @@
+"""App runner (ref: tensorflow/python/platform/app.py)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def run(main=None, argv=None):
+    main = main or sys.modules["__main__"].main
+    argv = argv if argv is not None else sys.argv
+    sys.exit(main(argv))

@@ -1,0 +1,58 @@
+"""Minimal flags (ref: tensorflow/python/platform/flags.py)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+class _FlagValues:
+    def __init__(self):
+        self.__dict__["_parser"] = argparse.ArgumentParser(add_help=False)
+        self.__dict__["_parsed"] = None
+
+    def _ensure_parsed(self):
+        if self._parsed is None:
+            parsed, _ = self._parser.parse_known_args(sys.argv[1:])
+            self.__dict__["_parsed"] = parsed
+
+    def __getattr__(self, name):
+        self._ensure_parsed()
+        return getattr(self._parsed, name)
+
+    def __setattr__(self, name, value):
+        self._ensure_parsed()
+        setattr(self._parsed, name, value)
+
+
+FLAGS = _FlagValues()
+
+
+def _define(flag_type, name, default, help):  # noqa: A002
+    FLAGS.__dict__["_parsed"] = None
+    if flag_type is bool:
+        FLAGS._parser.add_argument(f"--{name}", default=default,
+                                   type=lambda s: s.lower() in
+                                   ("1", "true", "yes"), help=help)
+    else:
+        FLAGS._parser.add_argument(f"--{name}", default=default,
+                                   type=flag_type, help=help)
+
+
+def DEFINE_string(name, default, help):  # noqa: A002
+    _define(str, name, default, help)
+
+
+def DEFINE_integer(name, default, help):  # noqa: A002
+    _define(int, name, default, help)
+
+
+def DEFINE_float(name, default, help):  # noqa: A002
+    _define(float, name, default, help)
+
+
+def DEFINE_boolean(name, default, help):  # noqa: A002
+    _define(bool, name, default, help)
+
+
+DEFINE_bool = DEFINE_boolean

@@ -1,0 +1,26 @@
+"""Resource loader (ref: tensorflow/python/platform/resource_loader.py)."""
+
+from __future__ import annotations
+
+import os
+
+
+def get_data_files_path():
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def get_root_dir_with_all_resources():
+    return get_data_files_path()
+
+
+def load_resource(path):
+    with open(os.path.join(get_data_files_path(), path), "rb") as f:
+        return f.read()
+
+
+def get_path_to_datafile(path):
+    return os.path.join(get_data_files_path(), path)
+
+
+def readahead_file_path(path, readahead="128M"):
+    return path

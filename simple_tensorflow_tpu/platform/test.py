@@ -1,0 +1,107 @@
+"""Test utilities (ref: tensorflow/python/framework/test_util.py,
+python/platform/test.py): TestCase with session helper + assertAllClose."""
+
+from __future__ import annotations
+
+import contextlib
+import tempfile
+import unittest
+
+import numpy as np
+
+
+class TestCase(unittest.TestCase):
+    """(ref: test_util.py:282 ``class TensorFlowTestCase``)."""
+
+    def setUp(self):
+        super().setUp()
+        from ..framework import graph as ops_mod
+
+        ops_mod.reset_default_graph()
+        self._cached_session = None
+
+    def tearDown(self):
+        if self._cached_session is not None:
+            self._cached_session.close()
+            self._cached_session = None
+        super().tearDown()
+
+    @contextlib.contextmanager
+    def test_session(self, graph=None, config=None, use_gpu=False,
+                     force_gpu=False):
+        from ..client.session import Session
+
+        if self._cached_session is None:
+            self._cached_session = Session(graph=graph, config=config)
+        with self._cached_session.as_default() as sess:
+            yield sess
+
+    session = test_session
+
+    def get_temp_dir(self):
+        if not hasattr(self, "_tmpdir"):
+            self._tmpdir = tempfile.mkdtemp()
+        return self._tmpdir
+
+    def _as_np(self, x):
+        return np.asarray(x)
+
+    def assertAllClose(self, a, b, rtol=1e-6, atol=1e-6, msg=None):
+        np.testing.assert_allclose(self._as_np(a).astype(np.float64),
+                                   self._as_np(b).astype(np.float64),
+                                   rtol=rtol, atol=atol, err_msg=msg or "")
+
+    def assertAllCloseAccordingToType(self, a, b, rtol=1e-6, atol=1e-6,
+                                      float_rtol=1e-6, float_atol=1e-6,
+                                      half_rtol=1e-3, half_atol=1e-3,
+                                      bfloat16_rtol=1e-2, bfloat16_atol=1e-2):
+        a = self._as_np(a)
+        if a.dtype == np.float16:
+            rtol, atol = half_rtol, half_atol
+        elif str(a.dtype) == "bfloat16":
+            rtol, atol = bfloat16_rtol, bfloat16_atol
+        self.assertAllClose(a, b, rtol=rtol, atol=atol)
+
+    def assertAllEqual(self, a, b, msg=None):
+        np.testing.assert_array_equal(self._as_np(a), self._as_np(b),
+                                      err_msg=msg or "")
+
+    def assertArrayNear(self, farray1, farray2, err):
+        for f1, f2 in zip(farray1, farray2):
+            self.assertTrue(abs(f1 - f2) <= err)
+
+    def assertNear(self, f1, f2, err, msg=None):
+        self.assertTrue(abs(f1 - f2) <= err, msg)
+
+    def assertShapeEqual(self, np_array, tensor):
+        self.assertEqual(list(np_array.shape), tensor.shape.as_list())
+
+    def assertDeviceEqual(self, d1, d2):
+        self.assertEqual(str(d1), str(d2))
+
+    @contextlib.contextmanager
+    def assertRaisesOpError(self, expected_err_re):
+        from ..framework import errors
+
+        with self.assertRaisesRegex(errors.OpError, expected_err_re):
+            yield
+
+
+def main(argv=None):
+    unittest.main()
+
+
+def is_built_with_cuda():
+    return False
+
+
+def is_gpu_available(cuda_only=False, min_cuda_compute_capability=None):
+    return False
+
+
+def gpu_device_name():
+    return ""
+
+
+def get_temp_dir():
+    return tempfile.mkdtemp()

@@ -1,0 +1,49 @@
+"""Logging shim (ref: tensorflow/python/platform/tf_logging.py)."""
+
+from __future__ import annotations
+
+import logging as _logging
+import sys
+
+_logger = _logging.getLogger("stf")
+if not _logger.handlers:
+    _h = _logging.StreamHandler(sys.stderr)
+    _h.setFormatter(_logging.Formatter(
+        "%(asctime)s %(levelname).1s stf] %(message)s"))
+    _logger.addHandler(_h)
+    _logger.setLevel(_logging.INFO)
+
+DEBUG = _logging.DEBUG
+INFO = _logging.INFO
+WARN = _logging.WARNING
+ERROR = _logging.ERROR
+FATAL = _logging.CRITICAL
+
+debug = _logger.debug
+info = _logger.info
+warn = _logger.warning
+warning = _logger.warning
+error = _logger.error
+fatal = _logger.critical
+log = _logger.log
+
+
+def set_verbosity(level):
+    _logger.setLevel(level)
+
+
+def get_verbosity():
+    return _logger.level
+
+
+def log_first_n(level, msg, n, *args):
+    log(level, msg, *args)
+
+
+def log_every_n(level, msg, n, *args):
+    log(level, msg, *args)
+
+
+def flush():
+    for h in _logger.handlers:
+        h.flush()

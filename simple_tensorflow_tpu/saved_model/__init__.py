@@ -1,0 +1,8 @@
+"""stf.saved_model (ref: tensorflow/python/saved_model)."""
+
+from .builder import SavedModelBuilder
+from .loader import load, maybe_saved_model_directory
+from . import signature_constants
+from . import tag_constants
+from . import signature_def_utils
+from . import utils

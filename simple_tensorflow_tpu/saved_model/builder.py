@@ -1,0 +1,87 @@
+"""SavedModelBuilder (ref: tensorflow/python/saved_model/builder_impl.py).
+
+Layout mirrors the reference: <dir>/saved_model.json (MetaGraphs +
+signature_defs), <dir>/variables/variables.* (stf-bundle checkpoint),
+<dir>/assets/.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from ..framework import graph as ops_mod
+from ..framework import graph_io
+from ..train.saver import Saver
+
+SAVED_MODEL_FILENAME = "saved_model.json"
+VARIABLES_DIRECTORY = "variables"
+VARIABLES_FILENAME = "variables"
+ASSETS_DIRECTORY = "assets"
+
+
+class SavedModelBuilder:
+    """(ref: builder_impl.py:40 ``class SavedModelBuilder``)."""
+
+    def __init__(self, export_dir):
+        self._export_dir = export_dir
+        if os.path.exists(export_dir) and os.listdir(export_dir):
+            raise AssertionError(
+                f"Export directory {export_dir} already exists and is not "
+                "empty.")
+        os.makedirs(export_dir, exist_ok=True)
+        self._meta_graphs = []
+        self._has_saved_variables = False
+
+    def add_meta_graph_and_variables(self, sess, tags, signature_def_map=None,
+                                     assets_collection=None, legacy_init_op=None,
+                                     clear_devices=False, main_op=None,
+                                     saver=None):
+        """(ref: builder_impl.py:264)."""
+        var_dir = os.path.join(self._export_dir, VARIABLES_DIRECTORY)
+        os.makedirs(var_dir, exist_ok=True)
+        saver = saver or Saver()
+        saver.save(sess, os.path.join(var_dir, VARIABLES_FILENAME),
+                   write_meta_graph=False, write_state=False)
+        self._has_saved_variables = True
+        self._add_meta(sess.graph, tags, signature_def_map, main_op)
+
+    def add_meta_graph(self, tags, signature_def_map=None,
+                       assets_collection=None, legacy_init_op=None,
+                       clear_devices=False, main_op=None):
+        if not self._has_saved_variables:
+            raise AssertionError(
+                "Graph state including variables must be saved first: call "
+                "add_meta_graph_and_variables.")
+        self._add_meta(ops_mod.get_default_graph(), tags, signature_def_map,
+                       main_op)
+
+    def _add_meta(self, graph, tags, signature_def_map, main_op):
+        meta = graph_io.export_meta_graph(graph=graph)
+        meta["tags"] = list(tags)
+        meta["signature_def"] = signature_def_map or {}
+        if main_op is not None:
+            meta["main_op"] = main_op.name
+        self._meta_graphs.append(meta)
+
+    def save(self, as_text=True):
+        """(ref: builder_impl.py:420 ``save``)."""
+        path = os.path.join(self._export_dir, SAVED_MODEL_FILENAME)
+        with open(path, "w") as f:
+            json.dump({"saved_model_schema_version": 1,
+                       "meta_graphs": self._meta_graphs}, f)
+        return path
+
+
+def simple_save(session, export_dir, inputs, outputs, legacy_init_op=None):
+    """(ref: python/saved_model/simple_save.py)."""
+    from . import signature_constants, signature_def_utils, tag_constants
+
+    b = SavedModelBuilder(export_dir)
+    sig = signature_def_utils.predict_signature_def(inputs, outputs)
+    b.add_meta_graph_and_variables(
+        session, [tag_constants.SERVING],
+        signature_def_map={
+            signature_constants.DEFAULT_SERVING_SIGNATURE_DEF_KEY: sig})
+    return b.save()

@@ -1,0 +1,39 @@
+"""SavedModel loader (ref: tensorflow/python/saved_model/loader_impl.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..framework import graph_io
+from ..train.saver import Saver
+from .builder import (SAVED_MODEL_FILENAME, VARIABLES_DIRECTORY,
+                      VARIABLES_FILENAME)
+
+
+def maybe_saved_model_directory(export_dir):
+    return os.path.exists(os.path.join(export_dir, SAVED_MODEL_FILENAME))
+
+
+def load(sess, tags, export_dir, **saver_kwargs):
+    """(ref: loader_impl.py:149 ``load``)."""
+    path = os.path.join(export_dir, SAVED_MODEL_FILENAME)
+    with open(path) as f:
+        saved = json.load(f)
+    target = None
+    for meta in saved["meta_graphs"]:
+        if set(meta.get("tags", [])) == set(tags):
+            target = meta
+            break
+    if target is None:
+        raise RuntimeError(
+            f"MetaGraph with tags {tags} not found in {export_dir}; "
+            f"available: {[m.get('tags') for m in saved['meta_graphs']]}")
+    graph_io.import_graph_def(target["graph_def"], name="")
+    var_prefix = os.path.join(export_dir, VARIABLES_DIRECTORY,
+                              VARIABLES_FILENAME)
+    from ..train.saver import checkpoint_exists
+
+    if checkpoint_exists(var_prefix):
+        Saver(**saver_kwargs).restore(sess, var_prefix)
+    return target

@@ -1,0 +1,40 @@
+"""(ref: tensorflow/python/saved_model/signature_def_utils_impl.py)."""
+
+from . import signature_constants
+from .utils import build_tensor_info
+
+
+def build_signature_def(inputs=None, outputs=None, method_name=None):
+    return {"inputs": inputs or {}, "outputs": outputs or {},
+            "method_name": method_name}
+
+
+def predict_signature_def(inputs, outputs):
+    return build_signature_def(
+        {k: build_tensor_info(v) for k, v in inputs.items()},
+        {k: build_tensor_info(v) for k, v in outputs.items()},
+        signature_constants.PREDICT_METHOD_NAME)
+
+
+def classification_signature_def(examples, classes, scores):
+    out = {}
+    if classes is not None:
+        out[signature_constants.CLASSIFY_OUTPUT_CLASSES] = \
+            build_tensor_info(classes)
+    if scores is not None:
+        out[signature_constants.CLASSIFY_OUTPUT_SCORES] = \
+            build_tensor_info(scores)
+    return build_signature_def(
+        {signature_constants.CLASSIFY_INPUTS: build_tensor_info(examples)},
+        out, signature_constants.CLASSIFY_METHOD_NAME)
+
+
+def regression_signature_def(examples, predictions):
+    return build_signature_def(
+        {signature_constants.REGRESS_INPUTS: build_tensor_info(examples)},
+        {signature_constants.REGRESS_OUTPUTS: build_tensor_info(predictions)},
+        signature_constants.REGRESS_METHOD_NAME)
+
+
+def is_valid_signature(signature_def):
+    return bool(signature_def.get("method_name"))

@@ -1,0 +1,6 @@
+"""(ref: tensorflow/python/saved_model/tag_constants.py)."""
+
+SERVING = "serve"
+TRAINING = "train"
+GPU = "gpu"
+TPU = "tpu"

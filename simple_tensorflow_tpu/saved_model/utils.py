@@ -1,0 +1,17 @@
+"""(ref: tensorflow/python/saved_model/utils_impl.py)."""
+
+
+def build_tensor_info(tensor):
+    return {
+        "name": tensor.name,
+        "dtype": tensor.dtype.name,
+        "tensor_shape": tensor.shape.as_list() if tensor.shape.rank is not None
+        else None,
+    }
+
+
+def get_tensor_from_tensor_info(tensor_info, graph=None):
+    from ..framework import graph as ops_mod
+
+    g = graph or ops_mod.get_default_graph()
+    return g.get_tensor_by_name(tensor_info["name"])

@@ -1,0 +1,8 @@
+"""stf.summary (ref: tensorflow/python/summary)."""
+
+from .summary import (
+    scalar, histogram, image, audio, text, tensor_summary, merge, merge_all,
+)
+from .writer.writer import FileWriter, FileWriterCache, EventsWriter
+from .summary_iterator import summary_iterator
+from . import tensorboard_logging

@@ -1,0 +1,246 @@
+"""Summary ops (ref: tensorflow/python/summary/summary.py,
+core/framework/summary.proto).
+
+Summary ops are host-sink ops (Session post-host stage): the device program
+computes the watched tensors; serialization to protobuf-wire Summary bytes
+happens on the host. ``sess.run(merged)`` returns bytes TensorBoard-ready,
+exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from ..lib.proto import Writer
+
+GraphKeys = ops_mod.GraphKeys
+
+
+def _summary_value(tag: str, **kw) -> bytes:
+    v = Writer()
+    v.bytes_(1, tag)
+    if "simple_value" in kw:
+        v.float32_always(2, kw["simple_value"])
+    if "histo" in kw:
+        v.message(5, kw["histo"])
+    if "image" in kw:
+        v.message(4, kw["image"])
+    if "audio" in kw:
+        v.message(6, kw["audio"])
+    if "tensor_bytes" in kw:
+        v.bytes_(8, kw["tensor_bytes"])
+    return v.tobytes()
+
+
+def _wrap_summary(values: list) -> bytes:
+    w = Writer()
+    for val in values:
+        w.bytes_(1, val)
+    return w.tobytes()
+
+
+def _histogram_proto(arr: np.ndarray) -> Writer:
+    """(ref: core/lib/histogram/histogram.cc bucket scheme)."""
+    arr = np.asarray(arr, dtype=np.float64).ravel()
+    w = Writer()
+    if arr.size == 0:
+        return w
+    w.double_always(1, float(np.min(arr)))
+    w.double_always(2, float(np.max(arr)))
+    w.double_always(3, float(arr.size))
+    w.double_always(4, float(np.sum(arr)))
+    w.double_always(5, float(np.sum(arr * arr)))
+    # reference-style exponential buckets
+    limits = [-1e-12, 1e-12]
+    v = 1e-12
+    while v < 1e20:
+        v *= 1.1
+        limits.append(v)
+    neg = [-l for l in limits if l > 0]
+    edges = sorted(set(neg + limits))
+    counts, _ = np.histogram(arr, bins=np.asarray([-1e308] + edges + [1e308]))
+    keep_limits, keep_counts = [], []
+    bounds = edges + [1e308]
+    for i, c in enumerate(counts):
+        if c > 0:
+            keep_limits.append(bounds[min(i, len(bounds) - 1)])
+            keep_counts.append(float(c))
+    w.packed_doubles(6, keep_limits)
+    w.packed_doubles(7, keep_counts)
+    return w
+
+
+def _lower_scalar_summary(ctx, op, inputs):
+    val = float(np.asarray(inputs[0]).reshape(()))
+    return [_wrap_summary([_summary_value(op.attrs["tag"],
+                                          simple_value=val)])]
+
+
+def _lower_histogram_summary(ctx, op, inputs):
+    histo = _histogram_proto(np.asarray(inputs[0]))
+    return [_wrap_summary([_summary_value(op.attrs["tag"], histo=histo)])]
+
+
+def _lower_image_summary(ctx, op, inputs):
+    from ..lib import png
+
+    images = np.asarray(inputs[0])
+    vals = []
+    n = min(op.attrs.get("max_outputs", 3), images.shape[0])
+    for i in range(n):
+        img = images[i]
+        if img.dtype in (np.float32, np.float64) or str(img.dtype) == "bfloat16":
+            img = np.clip(np.asarray(img, np.float32) * 255.0, 0, 255
+                          ).astype(np.uint8)
+        h, w_, c = img.shape
+        iw = Writer()
+        iw.varint_always(1, h).varint_always(2, w_).varint_always(3, c)
+        iw.bytes_(4, png.encode(img))
+        tag = op.attrs["tag"]
+        vals.append(_summary_value(f"{tag}/image/{i}" if n > 1
+                                   else f"{tag}/image", image=iw))
+    return [_wrap_summary(vals)]
+
+
+def _lower_audio_summary(ctx, op, inputs):
+    audio = np.asarray(inputs[0])
+    sr = float(op.attrs.get("sample_rate", 44100))
+    vals = []
+    n = min(op.attrs.get("max_outputs", 3), audio.shape[0])
+    for i in range(n):
+        aw = Writer()
+        aw.float32_always(1, sr)
+        clip = np.asarray(audio[i], np.float32)
+        if clip.ndim == 1:
+            clip = clip[:, None]
+        aw.varint_always(2, clip.shape[1])
+        aw.varint_always(3, clip.shape[0])
+        from ..lib import wav
+
+        aw.bytes_(4, wav.encode(clip, int(sr)))
+        aw.bytes_(5, "audio/wav")
+        vals.append(_summary_value(f"{op.attrs['tag']}/audio/{i}", audio=aw))
+    return [_wrap_summary(vals)]
+
+
+def _lower_text_summary(ctx, op, inputs):
+    arr = np.asarray(inputs[0])
+    tw = Writer()
+    # TensorProto with string values (field 8 string_val) + dtype (1) DT_STRING=7
+    tw.varint_always(1, 7)
+    for s in np.ravel(arr):
+        tw.bytes_(8, s if isinstance(s, bytes) else str(s).encode())
+    val = Writer()
+    val.bytes_(1, op.attrs["tag"])
+    val.message(8, tw)
+    # plugin metadata for the text plugin
+    md = Writer()
+    pd = Writer()
+    pd.bytes_(1, "text")
+    md.message(1, pd)
+    val.message(9, md)
+    return [_wrap_summary([val.tobytes()])]
+
+
+def _lower_merge_summary(ctx, op, inputs):
+    w = Writer()
+    parts = []
+    from ..lib.proto import parse
+
+    for buf in inputs:
+        if buf is None:
+            continue
+        fields = parse(bytes(buf))
+        parts.extend(fields.get(1, []))
+    return [_wrap_summary(parts)]
+
+
+for _n, _fn in [("ScalarSummary", _lower_scalar_summary),
+                ("HistogramSummary", _lower_histogram_summary),
+                ("ImageSummary", _lower_image_summary),
+                ("AudioSummary", _lower_audio_summary),
+                ("TextSummary", _lower_text_summary),
+                ("MergeSummary", _lower_merge_summary)]:
+    op_registry.register(_n, lower=_fn, is_stateful=True, runs_on_host=True)
+
+
+def _summary_op(op_type, tag, tensor, collections, attrs=None, name=None):
+    g = ops_mod.get_default_graph()
+    t = ops_mod.convert_to_tensor(tensor)
+    a = {"tag": str(tag)}
+    a.update(attrs or {})
+    node = g.create_op(op_type, [t], attrs=a, name=name or op_type,
+                       output_specs=[(shape_mod.scalar(), dtypes_mod.string)])
+    out = node.outputs[0]
+    for c in (collections if collections is not None
+              else [GraphKeys.SUMMARIES]):
+        g.add_to_collection(c, out)
+    return out
+
+
+def scalar(name, tensor, collections=None, family=None):
+    """(ref: summary.py:70 ``scalar``)."""
+    tag = f"{family}/{name}" if family else name
+    return _summary_op("ScalarSummary", tag, tensor, collections, name=name)
+
+
+def histogram(name, values, collections=None, family=None):
+    tag = f"{family}/{name}" if family else name
+    return _summary_op("HistogramSummary", tag, values, collections,
+                       name=name)
+
+
+def image(name, tensor, max_outputs=3, collections=None, family=None):
+    tag = f"{family}/{name}" if family else name
+    return _summary_op("ImageSummary", tag, tensor, collections,
+                       attrs={"max_outputs": max_outputs}, name=name)
+
+
+def audio(name, tensor, sample_rate, max_outputs=3, collections=None,
+          family=None):
+    tag = f"{family}/{name}" if family else name
+    sr = sample_rate
+    if isinstance(sr, ops_mod.Tensor):
+        from ..framework import constant_op
+
+        sr = float(constant_op.constant_value(sr))
+    return _summary_op("AudioSummary", tag, tensor, collections,
+                       attrs={"max_outputs": max_outputs, "sample_rate": sr},
+                       name=name)
+
+
+def text(name, tensor, collections=None):
+    return _summary_op("TextSummary", name, tensor, collections, name=name)
+
+
+def tensor_summary(name, tensor, summary_description=None, collections=None):
+    return _summary_op("TextSummary", name, tensor, collections, name=name)
+
+
+def merge(inputs, collections=None, name=None):
+    """(ref: summary.py:232 ``merge``)."""
+    g = ops_mod.get_default_graph()
+    node = g.create_op("MergeSummary", list(inputs), attrs={},
+                       name=name or "MergeSummary",
+                       output_specs=[(shape_mod.scalar(), dtypes_mod.string)])
+    out = node.outputs[0]
+    if collections:
+        for c in collections:
+            g.add_to_collection(c, out)
+    return out
+
+
+def merge_all(key=GraphKeys.SUMMARIES, scope=None):
+    """(ref: summary.py:262 ``merge_all``)."""
+    summaries = ops_mod.get_collection(key, scope)
+    if not summaries:
+        return None
+    return merge(summaries)
+
+
+def get_summary_description(node_def):
+    return ""

@@ -1,0 +1,67 @@
+"""tensorboard_logging (ref: tensorflow/python/training/tensorboard_logging.py):
+mirror log messages into the event file as well as stderr."""
+
+from __future__ import annotations
+
+import time
+
+from ..platform import tf_logging as logging
+
+DEBUG = "DEBUG"
+INFO = "INFO"
+WARN = "WARN"
+ERROR = "ERROR"
+FATAL = "FATAL"
+
+_levels = [DEBUG, INFO, WARN, ERROR, FATAL]
+_summary_writer = None
+_verbosity = WARN
+
+
+def set_summary_writer(summary_writer):
+    global _summary_writer
+    _summary_writer = summary_writer
+
+
+def set_verbosity(verbosity):
+    global _verbosity
+    if verbosity not in _levels:
+        raise ValueError(f"bad level {verbosity}")
+    _verbosity = verbosity
+
+
+def _log(level, message, *args):
+    msg = message % args if args else message
+    getattr(logging, level.lower() if level != FATAL else "fatal",
+            logging.info)(msg)
+    if _summary_writer and _levels.index(level) >= _levels.index(_verbosity):
+        from ..lib.proto import Writer
+
+        w = Writer()
+        lw = Writer()
+        lw.varint_always(1, _levels.index(level) * 10)
+        lw.bytes_(2, msg)
+        w.message(6, lw)  # LogMessage field in Event
+        from .writer.writer import _encode_event
+
+        _summary_writer.add_event(_encode_event(time.time()) + w.tobytes())
+
+
+def debug(message, *args):
+    _log(DEBUG, message, *args)
+
+
+def info(message, *args):
+    _log(INFO, message, *args)
+
+
+def warn(message, *args):
+    _log(WARN, message, *args)
+
+
+def error(message, *args):
+    _log(ERROR, message, *args)
+
+
+def fatal(message, *args):
+    _log(FATAL, message, *args)

@@ -1,0 +1,187 @@
+"""Event-file writer (ref: tensorflow/core/util/events_writer.cc,
+python/summary/writer/writer.py).
+
+Writes TensorBoard-compatible event files: TFRecord-framed protobuf-wire
+Event messages (wall_time=1 double, step=2 int64, file_version=3,
+summary=5). Async: a background thread drains a queue, like the
+reference's EventFileWriter.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import time
+
+from ...lib.io.tf_record import TFRecordWriter
+from ...lib.proto import Writer as ProtoWriter
+
+
+def _encode_event(wall_time, step=None, file_version=None, summary_bytes=None,
+                  graph_bytes=None):
+    w = ProtoWriter()
+    w.double_always(1, wall_time)
+    if step:
+        w.varint(2, step)
+    if file_version:
+        w.bytes_(3, file_version)
+    if graph_bytes:
+        w.bytes_(4, graph_bytes)
+    if summary_bytes:
+        w.bytes_(5, summary_bytes)
+    return w.tobytes()
+
+
+class EventsWriter:
+    """(ref: core/util/events_writer.cc)."""
+
+    def __init__(self, file_prefix):
+        self._filename = (f"{file_prefix}.out.tfevents."
+                          f"{int(time.time())}.{socket.gethostname()}")
+        os.makedirs(os.path.dirname(self._filename) or ".", exist_ok=True)
+        self._writer = TFRecordWriter(self._filename)
+        self._writer.write(_encode_event(time.time(),
+                                         file_version="brain.Event:2"))
+
+    @property
+    def filename(self):
+        return self._filename
+
+    def write_event(self, event_bytes):
+        self._writer.write(event_bytes)
+
+    def flush(self):
+        self._writer.flush()
+
+    def close(self):
+        self._writer.close()
+
+
+class FileWriter:
+    """(ref: python/summary/writer/writer.py:268 ``class FileWriter``)."""
+
+    def __init__(self, logdir, graph=None, max_queue=10, flush_secs=120,
+                 filename_suffix=None, session=None):
+        self._logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._events_writer = EventsWriter(os.path.join(logdir, "events"))
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._flush_secs = flush_secs
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+        if graph is not None:
+            self.add_graph(graph)
+
+    def get_logdir(self):
+        return self._logdir
+
+    def _run(self):
+        last_flush = time.time()
+        while True:
+            try:
+                item = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                item = None
+            if item is self._SENTINEL:
+                self._events_writer.flush()
+                return
+            if item is not None:
+                self._events_writer.write_event(item)
+            if time.time() - last_flush > self._flush_secs:
+                self._events_writer.flush()
+                last_flush = time.time()
+
+    _SENTINEL = object()
+
+    def add_event(self, event_bytes):
+        if not self._closed:
+            self._queue.put(event_bytes)
+
+    def add_summary(self, summary, global_step=None):
+        """(ref: writer.py:92 ``add_summary``). ``summary`` is the bytes
+        fetched from a summary op."""
+        if summary is None:
+            return
+        if hasattr(summary, "tobytes"):
+            summary = summary.tobytes()
+        if isinstance(summary, str):
+            summary = summary.encode("latin-1")
+        self.add_event(_encode_event(time.time(),
+                                     step=int(global_step or 0),
+                                     summary_bytes=bytes(summary)))
+
+    def add_summary_value(self, tag, value, global_step=None):
+        """Convenience: write one scalar directly (StepCounterHook)."""
+        v = ProtoWriter()
+        v.bytes_(1, tag)
+        v.float32_always(2, float(value))
+        s = ProtoWriter()
+        s.message(1, v)
+        self.add_event(_encode_event(time.time(), step=int(global_step or 0),
+                                     summary_bytes=s.tobytes()))
+
+    def add_graph(self, graph, global_step=None):
+        try:
+            import json
+
+            from ...framework import graph_io
+
+            gd = json.dumps(graph_io.graph_to_graphdef(graph)).encode()
+            self.add_event(_encode_event(time.time(),
+                                         step=int(global_step or 0),
+                                         graph_bytes=gd))
+        except Exception:
+            pass
+
+    def add_session_log(self, session_log, global_step=None):
+        pass
+
+    def add_run_metadata(self, run_metadata, tag, global_step=None):
+        pass
+
+    def flush(self):
+        deadline = time.time() + 5
+        while not self._queue.empty() and time.time() < deadline:
+            time.sleep(0.01)
+        self._events_writer.flush()
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._queue.put(self._SENTINEL)
+            self._worker.join(timeout=5)
+            self._events_writer.close()
+
+    def reopen(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class FileWriterCache:
+    """(ref: python/summary/writer/writer_cache.py)."""
+
+    _cache = {}
+    _lock = threading.Lock()
+
+    @staticmethod
+    def get(logdir):
+        with FileWriterCache._lock:
+            if logdir not in FileWriterCache._cache:
+                FileWriterCache._cache[logdir] = FileWriter(logdir)
+            return FileWriterCache._cache[logdir]
+
+    @staticmethod
+    def clear():
+        with FileWriterCache._lock:
+            for w in FileWriterCache._cache.values():
+                w.close()
+            FileWriterCache._cache.clear()

@@ -1,0 +1,57 @@
+"""stf.train namespace (ref: tensorflow/python/training/training.py)."""
+
+from .optimizer import Optimizer
+from .optimizers import (
+    GradientDescentOptimizer, MomentumOptimizer, AdamOptimizer,
+    AdagradOptimizer, AdagradDAOptimizer, AdadeltaOptimizer,
+    RMSPropOptimizer, FtrlOptimizer, ProximalGradientDescentOptimizer,
+    ProximalAdagradOptimizer,
+)
+from .sync_replicas import SyncReplicasOptimizer
+from .learning_rate_decay import (
+    exponential_decay, piecewise_constant, polynomial_decay,
+    natural_exp_decay, inverse_time_decay, cosine_decay,
+    cosine_decay_restarts, linear_cosine_decay,
+)
+from .moving_averages import ExponentialMovingAverage, assign_moving_average
+from .saver import (
+    Saver, latest_checkpoint, get_checkpoint_state, update_checkpoint_state,
+    checkpoint_exists, import_meta_graph, export_meta_graph,
+)
+from .checkpoint_utils import (
+    load_checkpoint, load_variable, list_variables, init_from_checkpoint,
+    CheckpointReader,
+)
+from .training_util import (
+    get_global_step, create_global_step, get_or_create_global_step,
+    global_step, assert_global_step,
+)
+from .session_run_hook import (
+    SessionRunHook, SessionRunArgs, SessionRunContext, SessionRunValues,
+)
+from .basic_session_run_hooks import (
+    SecondOrStepTimer, StopAtStepHook, CheckpointSaverHook,
+    CheckpointSaverListener, StepCounterHook, LoggingTensorHook,
+    NanLossDuringTrainingError, NanTensorHook, SummarySaverHook,
+    GlobalStepWaiterHook, FinalOpsHook, FeedFnHook, ProfilerHook,
+)
+from .monitored_session import (
+    Scaffold, SessionManager, SessionCreator, ChiefSessionCreator,
+    WorkerSessionCreator, MonitoredSession, SingularMonitoredSession,
+    MonitoredTrainingSession,
+)
+from .coordinator import Coordinator, LooperThread
+from .queue_runner import (
+    QueueRunner, add_queue_runner, start_queue_runners,
+)
+from .input import (
+    string_input_producer, input_producer, range_input_producer,
+    slice_input_producer, batch, shuffle_batch, batch_join,
+    shuffle_batch_join, limit_epochs,
+)
+from .server_lib import Server, ClusterSpec
+from .device_setter import replica_device_setter
+from .supervisor import Supervisor
+from .basic_loops import basic_train_loop
+from .evaluation import evaluate_once, evaluate_repeatedly, checkpoints_iterator
+from .slot_creator import create_slot, create_zeros_slot

@@ -1,0 +1,13 @@
+"""basic_train_loop (ref: tensorflow/python/training/basic_loops.py)."""
+
+from __future__ import annotations
+
+
+def basic_train_loop(supervisor, train_step_fn, args=None, kwargs=None,
+                     master=""):
+    """(ref: basic_loops.py:21)."""
+    args = args or []
+    kwargs = kwargs or {}
+    with supervisor.managed_session(master) as sess:
+        while not supervisor.should_stop():
+            train_step_fn(sess, *args, **kwargs)

@@ -1,0 +1,380 @@
+"""Standard hooks (ref: tensorflow/python/training/basic_session_run_hooks.py)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..framework import errors
+from ..platform import tf_logging as logging
+from . import session_run_hook
+from . import training_util
+
+SessionRunHook = session_run_hook.SessionRunHook
+SessionRunArgs = session_run_hook.SessionRunArgs
+
+
+class SecondOrStepTimer:
+    """(ref: basic_session_run_hooks.py:48)."""
+
+    def __init__(self, every_secs=None, every_steps=None):
+        if (every_secs is None) == (every_steps is None):
+            raise ValueError("exactly one of every_secs/every_steps required")
+        self._every_secs = every_secs
+        self._every_steps = every_steps
+        self._last_time = None
+        self._last_step = None
+
+    def should_trigger_for_step(self, step):
+        if self._last_step is None:
+            return True
+        if step == self._last_step:
+            return False
+        if self._every_secs is not None:
+            return time.time() >= self._last_time + self._every_secs
+        return step >= self._last_step + self._every_steps
+
+    def update_last_triggered_step(self, step):
+        now = time.time()
+        elapsed_secs = None if self._last_time is None else now - self._last_time
+        elapsed_steps = None if self._last_step is None else step - self._last_step
+        self._last_time, self._last_step = now, step
+        return elapsed_secs, elapsed_steps
+
+    def last_triggered_step(self):
+        return self._last_step
+
+
+class StopAtStepHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:331)."""
+
+    def __init__(self, num_steps=None, last_step=None):
+        if (num_steps is None) == (last_step is None):
+            raise ValueError("exactly one of num_steps/last_step required")
+        self._num_steps = num_steps
+        self._last_step = last_step
+        self._global_step_tensor = None
+
+    def begin(self):
+        self._global_step_tensor = training_util.get_global_step()
+        if self._global_step_tensor is None:
+            raise RuntimeError("Global step must be created for StopAtStepHook")
+
+    def after_create_session(self, session, coord):
+        if self._last_step is None:
+            gs = int(np.asarray(session.run(self._global_step_tensor._ref)))
+            self._last_step = gs + self._num_steps
+
+    def before_run(self, run_context):
+        return SessionRunArgs(self._global_step_tensor._ref)
+
+    def after_run(self, run_context, run_values):
+        gs = int(np.asarray(run_values.results))
+        if gs >= self._last_step:
+            run_context.request_stop()
+
+
+class CheckpointSaverHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:404)."""
+
+    def __init__(self, checkpoint_dir, save_secs=None, save_steps=None,
+                 saver=None, checkpoint_basename="model.ckpt", scaffold=None,
+                 listeners=None):
+        import os
+
+        self._checkpoint_dir = checkpoint_dir
+        self._save_path = os.path.join(checkpoint_dir, checkpoint_basename)
+        self._saver = saver
+        self._scaffold = scaffold
+        self._timer = SecondOrStepTimer(every_secs=save_secs,
+                                        every_steps=save_steps)
+        self._listeners = listeners or []
+
+    def begin(self):
+        self._global_step_tensor = training_util.get_global_step()
+        if self._global_step_tensor is None:
+            raise RuntimeError("Global step required for CheckpointSaverHook")
+        for l in self._listeners:
+            l.begin()
+
+    def _get_saver(self):
+        if self._saver is not None:
+            return self._saver
+        if self._scaffold is not None and self._scaffold.saver is not None:
+            return self._scaffold.saver
+        from .saver import Saver
+
+        self._saver = Saver()
+        return self._saver
+
+    def after_create_session(self, session, coord):
+        self._save(session, int(np.asarray(
+            session.run(self._global_step_tensor._ref))))
+
+    def before_run(self, run_context):
+        return SessionRunArgs(self._global_step_tensor._ref)
+
+    def after_run(self, run_context, run_values):
+        step = int(np.asarray(run_values.results))
+        if self._timer.should_trigger_for_step(step):
+            self._timer.update_last_triggered_step(step)
+            self._save(run_context.session, step)
+
+    def end(self, session):
+        self._save(session, int(np.asarray(
+            session.run(self._global_step_tensor._ref))))
+
+    def _save(self, session, step):
+        for l in self._listeners:
+            l.before_save(session, step)
+        self._get_saver().save(session, self._save_path, global_step=step)
+        for l in self._listeners:
+            l.after_save(session, step)
+
+
+class CheckpointSaverListener:
+    def begin(self):
+        pass
+
+    def before_save(self, session, global_step_value):
+        pass
+
+    def after_save(self, session, global_step_value):
+        pass
+
+    def end(self, session, global_step_value):
+        pass
+
+
+class StepCounterHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:547) — also reports steps/sec."""
+
+    def __init__(self, every_n_steps=100, every_n_secs=None, output_dir=None,
+                 summary_writer=None):
+        self._timer = SecondOrStepTimer(every_secs=every_n_secs,
+                                        every_steps=every_n_steps
+                                        if every_n_secs is None else None)
+        self._summary_writer = summary_writer
+        self._output_dir = output_dir
+        self.last_steps_per_sec = None
+
+    def begin(self):
+        self._global_step_tensor = training_util.get_global_step()
+        if self._summary_writer is None and self._output_dir:
+            from ..summary.writer.writer import FileWriter
+
+            self._summary_writer = FileWriter(self._output_dir)
+
+    def before_run(self, run_context):
+        return SessionRunArgs(self._global_step_tensor._ref)
+
+    def after_run(self, run_context, run_values):
+        step = int(np.asarray(run_values.results))
+        if self._timer.should_trigger_for_step(step):
+            secs, steps = self._timer.update_last_triggered_step(step)
+            if secs is not None and secs > 0:
+                self.last_steps_per_sec = steps / secs
+                logging.info("global_step/sec: %.4g", self.last_steps_per_sec)
+                if self._summary_writer is not None:
+                    from ..summary import summary as summary_mod
+
+                    self._summary_writer.add_summary_value(
+                        "global_step/sec", self.last_steps_per_sec, step)
+
+
+class LoggingTensorHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:167)."""
+
+    def __init__(self, tensors, every_n_iter=None, every_n_secs=None,
+                 at_end=False, formatter=None):
+        if isinstance(tensors, dict):
+            self._tag_order = list(tensors)
+            self._tensors = tensors
+        else:
+            self._tag_order = [getattr(t, "name", str(i))
+                               for i, t in enumerate(tensors)]
+            self._tensors = dict(zip(self._tag_order, tensors))
+        self._formatter = formatter
+        self._timer = SecondOrStepTimer(every_secs=every_n_secs,
+                                        every_steps=every_n_iter)
+        self._at_end = at_end
+        self._iter = 0
+
+    def before_run(self, run_context):
+        self._should_log = self._timer.should_trigger_for_step(self._iter)
+        if self._should_log:
+            return SessionRunArgs(self._tensors)
+        return None
+
+    def after_run(self, run_context, run_values):
+        if self._should_log:
+            self._timer.update_last_triggered_step(self._iter)
+            vals = run_values.results
+            if self._formatter:
+                logging.info(self._formatter(vals))
+            else:
+                logging.info(", ".join(
+                    f"{tag} = {vals[tag]}" for tag in self._tag_order))
+        self._iter += 1
+
+    def end(self, session):
+        if self._at_end:
+            vals = session.run(self._tensors)
+            logging.info(", ".join(
+                f"{tag} = {vals[tag]}" for tag in self._tag_order))
+
+
+class NanLossDuringTrainingError(RuntimeError):
+    pass
+
+
+class NanTensorHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:635)."""
+
+    def __init__(self, loss_tensor, fail_on_nan_loss=True):
+        self._loss_tensor = loss_tensor
+        self._fail = fail_on_nan_loss
+
+    def before_run(self, run_context):
+        return SessionRunArgs(self._loss_tensor)
+
+    def after_run(self, run_context, run_values):
+        if np.isnan(np.asarray(run_values.results)).any():
+            if self._fail:
+                raise NanLossDuringTrainingError("NaN loss during training.")
+            logging.warning("NaN loss; stopping training.")
+            run_context.request_stop()
+
+
+class SummarySaverHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:683)."""
+
+    def __init__(self, save_steps=None, save_secs=None, output_dir=None,
+                 summary_writer=None, scaffold=None, summary_op=None):
+        self._summary_op = summary_op
+        self._scaffold = scaffold
+        self._output_dir = output_dir
+        self._summary_writer = summary_writer
+        self._timer = SecondOrStepTimer(every_secs=save_secs,
+                                        every_steps=save_steps)
+
+    def begin(self):
+        self._global_step_tensor = training_util.get_global_step()
+        if self._summary_writer is None and self._output_dir:
+            from ..summary.writer.writer import FileWriter
+
+            self._summary_writer = FileWriter(self._output_dir)
+
+    def _get_op(self):
+        if self._summary_op is not None:
+            return self._summary_op
+        if self._scaffold is not None:
+            return self._scaffold.summary_op
+        from ..summary import summary as summary_mod
+
+        return summary_mod.merge_all()
+
+    def before_run(self, run_context):
+        op = self._get_op()
+        self._should = (op is not None and
+                        self._timer.should_trigger_for_step(
+                            self._timer.last_triggered_step() or 0) or
+                        self._timer.last_triggered_step() is None)
+        fetches = {"step": self._global_step_tensor._ref}
+        if self._should and op is not None:
+            fetches["summary"] = op
+        return SessionRunArgs(fetches)
+
+    def after_run(self, run_context, run_values):
+        step = int(np.asarray(run_values.results["step"]))
+        if "summary" in run_values.results and self._summary_writer:
+            if self._timer.should_trigger_for_step(step):
+                self._timer.update_last_triggered_step(step)
+                self._summary_writer.add_summary(
+                    run_values.results["summary"], step)
+
+    def end(self, session):
+        if self._summary_writer:
+            self._summary_writer.flush()
+
+
+class GlobalStepWaiterHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:775)."""
+
+    def __init__(self, wait_until_step):
+        self._wait_until_step = wait_until_step
+
+    def begin(self):
+        self._global_step_tensor = training_util.get_global_step()
+
+    def before_run(self, run_context):
+        if self._wait_until_step <= 0:
+            return None
+        while True:
+            gs = int(np.asarray(run_context.session.run(
+                self._global_step_tensor._ref)))
+            if gs >= self._wait_until_step:
+                return None
+            time.sleep(0.5)
+
+
+class FinalOpsHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:812)."""
+
+    def __init__(self, final_ops, final_ops_feed_dict=None):
+        self._final_ops = final_ops
+        self._feed = final_ops_feed_dict
+        self.final_ops_values = None
+
+    def end(self, session):
+        if self._final_ops is not None:
+            self.final_ops_values = session.run(self._final_ops,
+                                                feed_dict=self._feed)
+
+
+class FeedFnHook(SessionRunHook):
+    def __init__(self, feed_fn):
+        self._feed_fn = feed_fn
+
+    def before_run(self, run_context):
+        return SessionRunArgs(fetches=None, feed_dict=self._feed_fn())
+
+
+class ProfilerHook(SessionRunHook):
+    """(ref: basic_session_run_hooks.py:846) — emits chrome traces via
+    jax.profiler instead of the reference's StepStats timeline."""
+
+    def __init__(self, save_steps=None, save_secs=None,
+                 output_dir="", show_dataflow=True, show_memory=False):
+        self._output_dir = output_dir
+        self._timer = SecondOrStepTimer(every_secs=save_secs,
+                                        every_steps=save_steps)
+        self._tracing = False
+
+    def begin(self):
+        self._global_step_tensor = training_util.get_global_step()
+
+    def before_run(self, run_context):
+        step = self._timer.last_triggered_step() or 0
+        if self._timer.should_trigger_for_step(step + 1) and not self._tracing:
+            import jax
+
+            try:
+                jax.profiler.start_trace(self._output_dir)
+                self._tracing = True
+            except Exception:
+                pass
+        return SessionRunArgs(self._global_step_tensor._ref)
+
+    def after_run(self, run_context, run_values):
+        step = int(np.asarray(run_values.results))
+        if self._tracing:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+            self._timer.update_last_triggered_step(step)

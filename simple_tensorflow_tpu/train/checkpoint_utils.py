@@ -1,0 +1,98 @@
+"""Checkpoint inspection utilities
+(ref: tensorflow/python/training/checkpoint_utils.py)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..framework import errors
+from ..framework import graph as ops_mod
+from . import saver as saver_mod
+
+
+class CheckpointReader:
+    """(ref: tensorflow/c/checkpoint_reader.cc)."""
+
+    def __init__(self, prefix):
+        if not saver_mod.checkpoint_exists(prefix):
+            raise errors.NotFoundError(None, None,
+                                       f"Checkpoint {prefix} not found")
+        self._prefix = prefix
+        with open(prefix + ".index.json") as f:
+            self._index = json.load(f)["tensors"]
+
+    def get_variable_to_shape_map(self):
+        return {k: list(v["shape"]) for k, v in self._index.items()}
+
+    def get_variable_to_dtype_map(self):
+        return {k: v["dtype"] for k, v in self._index.items()}
+
+    def has_tensor(self, name):
+        return name in self._index
+
+    def get_tensor(self, name):
+        with np.load(self._prefix + ".stfz") as data:
+            return data[name.replace("/", "|")]
+
+
+def load_checkpoint(ckpt_dir_or_file):
+    path = ckpt_dir_or_file
+    if not saver_mod.checkpoint_exists(path):
+        latest = saver_mod.latest_checkpoint(ckpt_dir_or_file)
+        if latest is None:
+            raise errors.NotFoundError(None, None,
+                                       f"No checkpoint at {ckpt_dir_or_file}")
+        path = latest
+    return CheckpointReader(path)
+
+
+def load_variable(ckpt_dir_or_file, name):
+    return load_checkpoint(ckpt_dir_or_file).get_tensor(name)
+
+
+def list_variables(ckpt_dir_or_file):
+    reader = load_checkpoint(ckpt_dir_or_file)
+    return sorted(reader.get_variable_to_shape_map().items())
+
+
+def init_from_checkpoint(ckpt_dir_or_file, assignment_map):
+    """(ref: checkpoint_utils.py:156 ``init_from_checkpoint``): override
+    variables' initializers with checkpoint values."""
+    from ..ops import variable_scope as vs
+    from ..framework import constant_op
+    from ..ops import state_ops
+
+    reader = load_checkpoint(ckpt_dir_or_file)
+    g = ops_mod.get_default_graph()
+    store = vs._graph_vars(g)
+    for ckpt_name, target in assignment_map.items():
+        if isinstance(target, str):
+            if target.endswith("/") or ckpt_name.endswith("/"):
+                prefix_ckpt = ckpt_name.rstrip("/")
+                prefix_var = target.rstrip("/")
+                for full, var in list(store.items()):
+                    if full.startswith(prefix_var):
+                        rel = full[len(prefix_var):].lstrip("/")
+                        src = f"{prefix_ckpt}/{rel}" if prefix_ckpt else rel
+                        if reader.has_tensor(src):
+                            _override_init(var, reader.get_tensor(src))
+                continue
+            var = store.get(target)
+            if var is None:
+                raise ValueError(f"Variable {target} not found")
+        else:
+            var = target
+        _override_init(var, reader.get_tensor(ckpt_name))
+
+
+def _override_init(var, value):
+    from ..framework import constant_op
+    from ..ops import state_ops
+
+    g = var.graph
+    with ops_mod._as_current(g):
+        const = constant_op.constant(value, dtype=var.dtype.base_dtype)
+        new_init = state_ops.assign(var._ref, const).op
+    var._initializer_op = new_init

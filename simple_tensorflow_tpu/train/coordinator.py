@@ -1,0 +1,143 @@
+"""Coordinator + QueueRunner thread management
+(ref: tensorflow/python/training/coordinator.py, queue_runner_impl.py).
+
+Host-side thread coordination is hardware-agnostic; rebuilt with the same
+contract (request_stop/should_stop/join, exc propagation). QueueRunners
+drive the host-stage FIFOQueues that feed the device program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+import traceback
+
+from ..framework import errors
+from ..framework import graph as ops_mod
+
+
+class Coordinator:
+    """(ref: coordinator.py:49 ``class Coordinator``)."""
+
+    def __init__(self, clean_stop_exception_types=None):
+        if clean_stop_exception_types is None:
+            clean_stop_exception_types = (errors.OutOfRangeError,)
+        self._clean_stop = tuple(clean_stop_exception_types)
+        self._lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._exc_info = None
+        self._registered_threads = set()
+        self._joined = False
+
+    def request_stop(self, ex=None):
+        with self._lock:
+            if ex and not self._stop_event.is_set():
+                if isinstance(ex, tuple):
+                    self._exc_info = ex
+                elif isinstance(ex, Exception):
+                    self._exc_info = (type(ex), ex, ex.__traceback__)
+            self._stop_event.set()
+
+    def clear_stop(self):
+        with self._lock:
+            self._joined = False
+            self._exc_info = None
+            self._stop_event.clear()
+
+    def should_stop(self):
+        return self._stop_event.is_set()
+
+    @contextlib.contextmanager
+    def stop_on_exception(self):
+        try:
+            yield
+        except Exception as ex:  # noqa: BLE001
+            self.request_stop(ex)
+
+    def wait_for_stop(self, timeout=None):
+        return self._stop_event.wait(timeout)
+
+    def register_thread(self, thread):
+        with self._lock:
+            self._registered_threads.add(thread)
+
+    def join(self, threads=None, stop_grace_period_secs=120,
+             ignore_live_threads=False):
+        """(ref: coordinator.py:357 ``Coordinator.join``)."""
+        threads = list(threads) if threads else []
+        with self._lock:
+            threads = list(set(threads) | self._registered_threads)
+        while True:
+            alive = [t for t in threads if t.is_alive()]
+            if not alive:
+                break
+            if self.should_stop():
+                deadline = time.time() + stop_grace_period_secs
+                for t in alive:
+                    t.join(max(0.0, deadline - time.time()))
+                still = [t for t in alive if t.is_alive()]
+                if still and not ignore_live_threads:
+                    raise RuntimeError(
+                        f"Coordinator stopped with threads still running: "
+                        f"{[t.name for t in still]}")
+                break
+            time.sleep(0.1)
+        self._joined = True
+        with self._lock:
+            if self._exc_info:
+                exc_type, exc_value, tb = self._exc_info
+                if not issubclass(exc_type, self._clean_stop):
+                    raise exc_value.with_traceback(tb)
+
+    @property
+    def joined(self):
+        return self._joined
+
+    def raise_requested_exception(self):
+        with self._lock:
+            if self._exc_info:
+                exc_type, exc_value, tb = self._exc_info
+                if not issubclass(exc_type, self._clean_stop):
+                    raise exc_value.with_traceback(tb)
+
+
+class LooperThread(threading.Thread):
+    """(ref: coordinator.py:432 ``class LooperThread``)."""
+
+    def __init__(self, coord, timer_interval_secs, target=None, args=None,
+                 kwargs=None):
+        super().__init__(daemon=True)
+        self._coord = coord
+        self._timer_interval_secs = timer_interval_secs
+        self._target = target
+        self._args = args or ()
+        self._kwargs = kwargs or {}
+        coord.register_thread(self)
+
+    @staticmethod
+    def loop(coord, timer_interval_secs, target, args=None, kwargs=None):
+        looper = LooperThread(coord, timer_interval_secs, target, args, kwargs)
+        looper.start()
+        return looper
+
+    def run(self):
+        with self._coord.stop_on_exception():
+            self.start_loop()
+            if self._timer_interval_secs is None:
+                self.run_loop()
+            else:
+                while not self._coord.wait_for_stop(self._timer_interval_secs):
+                    self.run_loop()
+            self.stop_loop()
+
+    def start_loop(self):
+        pass
+
+    def stop_loop(self):
+        pass
+
+    def run_loop(self):
+        if self._target:
+            self._target(*self._args, **self._kwargs)

@@ -1,0 +1,49 @@
+"""replica_device_setter (ref: tensorflow/python/training/device_setter.py).
+
+The reference round-robins variables across parameter servers. TPU-native
+translation: the returned scope attaches *sharding hints* — variables
+created under it are sharded over the given mesh axis (fsdp-style) instead
+of being placed on ps devices. With no mesh active it is a no-op, keeping
+reference code importable unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+def replica_device_setter(ps_tasks=0, ps_device="/job:ps",
+                          worker_device="/job:worker", merge_devices=True,
+                          cluster=None, ps_ops=None, ps_strategy=None):
+    """(ref: device_setter.py:131)."""
+
+    @contextlib.contextmanager
+    def scope():
+        from ..parallel import api as parallel_api
+
+        mesh = parallel_api.current_mesh()
+        if mesh is not None and "fsdp" in mesh.axis_names:
+            with parallel_api.shard_variables_along("fsdp"):
+                yield
+        else:
+            yield
+
+    # Returned object is usable as `with tf.device(replica_device_setter())`:
+    # our device() accepts strings; so instead return a context manager and
+    # also support being called as a device function (no-op string).
+    return _DeviceSetter(scope)
+
+
+class _DeviceSetter:
+    def __init__(self, scope_factory):
+        self._scope_factory = scope_factory
+
+    def __call__(self, op):
+        return ""  # device string for op: placement is sharding-driven
+
+    def __enter__(self):
+        self._cm = self._scope_factory()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)

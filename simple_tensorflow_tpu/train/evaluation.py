@@ -1,0 +1,111 @@
+"""Evaluation loops (ref: tensorflow/python/training/evaluation.py)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..framework import graph as ops_mod
+from ..ops import state_ops
+from ..ops import variables as variables_mod
+from . import training_util
+from .monitored_session import (ChiefSessionCreator, MonitoredSession,
+                                Scaffold)
+from .basic_session_run_hooks import FinalOpsHook, StopAtStepHook
+from .saver import latest_checkpoint
+
+
+def _get_or_create_eval_step():
+    g = ops_mod.get_default_graph()
+    items = g.get_collection(ops_mod.GraphKeys.EVAL_STEP)
+    if items:
+        return items[0]
+    v = variables_mod.Variable(0, trainable=False, dtype="int64",
+                               name="eval_step",
+                               collections=[ops_mod.GraphKeys.LOCAL_VARIABLES,
+                                            ops_mod.GraphKeys.EVAL_STEP])
+    return v
+
+
+class _StopAfterNEvalsHook(StopAtStepHook.__bases__[0]):
+    def __init__(self, num_evals):
+        self._num_evals = num_evals
+        self._evals = 0
+
+    def after_run(self, run_context, run_values):
+        self._evals += 1
+        if self._num_evals is not None and self._evals >= self._num_evals:
+            run_context.request_stop()
+
+
+def _evaluate_once(checkpoint_path, master="", scaffold=None, eval_ops=None,
+                   feed_dict=None, final_ops=None, final_ops_feed_dict=None,
+                   hooks=None, config=None):
+    """(ref: evaluation.py:125 ``_evaluate_once``)."""
+    scaffold = scaffold or Scaffold()
+    hooks = list(hooks or [])
+    final_hook = FinalOpsHook(final_ops, final_ops_feed_dict)
+    hooks.append(final_hook)
+    creator = ChiefSessionCreator(
+        scaffold=scaffold, master=master, config=config,
+        checkpoint_filename_with_path=checkpoint_path)
+    with MonitoredSession(session_creator=creator, hooks=hooks) as sess:
+        if eval_ops is not None:
+            while not sess.should_stop():
+                sess.run(eval_ops, feed_dict)
+    return final_hook.final_ops_values
+
+
+evaluate_once = _evaluate_once
+
+
+def evaluate_repeatedly(checkpoint_dir, master="", scaffold=None,
+                        eval_ops=None, feed_dict=None, final_ops=None,
+                        final_ops_feed_dict=None, eval_interval_secs=60,
+                        hooks=None, config=None, max_number_of_evaluations=None,
+                        timeout=None):
+    """(ref: evaluation.py:187)."""
+    n_evals = 0
+    last_ckpt = None
+    start = time.time()
+    results = None
+    while True:
+        ckpt = latest_checkpoint(checkpoint_dir)
+        if ckpt is not None and ckpt != last_ckpt:
+            last_ckpt = ckpt
+            results = _evaluate_once(ckpt, master, scaffold, eval_ops,
+                                     feed_dict, final_ops,
+                                     final_ops_feed_dict, hooks, config)
+            n_evals += 1
+            if (max_number_of_evaluations is not None and
+                    n_evals >= max_number_of_evaluations):
+                return results
+        if timeout is not None and time.time() - start > timeout:
+            return results
+        time.sleep(min(eval_interval_secs, 1.0))
+
+
+def wait_for_new_checkpoint(checkpoint_dir, last_checkpoint=None,
+                            seconds_to_sleep=1, timeout=None):
+    start = time.time()
+    while True:
+        ckpt = latest_checkpoint(checkpoint_dir)
+        if ckpt is not None and ckpt != last_checkpoint:
+            return ckpt
+        if timeout is not None and time.time() - start > timeout:
+            return None
+        time.sleep(seconds_to_sleep)
+
+
+def checkpoints_iterator(checkpoint_dir, min_interval_secs=0, timeout=None,
+                         timeout_fn=None):
+    last = None
+    while True:
+        new = wait_for_new_checkpoint(checkpoint_dir, last, timeout=timeout)
+        if new is None:
+            if timeout_fn is None or timeout_fn():
+                return
+            continue
+        last = new
+        yield new

@@ -1,0 +1,187 @@
+"""Input producers and batching (ref: tensorflow/python/training/input.py).
+
+Host-stage pipeline: producers enqueue onto host FIFOQueues from QueueRunner
+threads; batch/shuffle_batch dequeue numpy batches that become boundary
+feeds of the compiled TPU step. stf.data is the modern path; these exist for
+reference parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import constant_op
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..ops import data_flow_ops
+from ..ops.control_flow_ops import _flatten
+from . import queue_runner
+
+
+def _producer(items, shuffle, seed, capacity, name, num_epochs=None):
+    q = data_flow_ops.FIFOQueue(capacity, [dtypes_mod.as_dtype(
+        dtypes_mod.infer_dtype(items[0]))], shapes=[np.asarray(items[0]).shape],
+        name=name)
+
+    class _ProducerRunner(queue_runner.QueueRunner):
+        def __init__(self):
+            super().__init__(queue=q, enqueue_ops=[None])
+            self._items = list(items)
+            self._shuffle = shuffle
+            self._rng = np.random.RandomState(seed)
+            self._epochs = 0
+            self._max_epochs = num_epochs
+
+        def _run(self, sess, enqueue_op, coord):
+            try:
+                while not (coord and coord.should_stop()):
+                    order = list(range(len(self._items)))
+                    if self._shuffle:
+                        self._rng.shuffle(order)
+                    for i in order:
+                        if coord and coord.should_stop():
+                            return
+                        try:
+                            q._host_enqueue([np.asarray(self._items[i])],
+                                            timeout=1.0)
+                        except Exception:
+                            if coord and coord.should_stop():
+                                return
+                    self._epochs += 1
+                    if self._max_epochs and self._epochs >= self._max_epochs:
+                        break
+            finally:
+                q._host_close()
+
+    queue_runner.add_queue_runner(_ProducerRunner())
+    return q
+
+
+def string_input_producer(string_tensor, num_epochs=None, shuffle=True,
+                          seed=None, capacity=32, shared_name=None,
+                          name="input_producer", cancel_op=None):
+    """(ref: input.py:173 ``string_input_producer``)."""
+    v = constant_op.constant_value(ops_mod.convert_to_tensor(string_tensor))
+    if v is None:
+        raise ValueError("string_input_producer needs static strings")
+    return _producer([s for s in np.ravel(v)], shuffle, seed, capacity, name,
+                     num_epochs)
+
+
+def input_producer(input_tensor, element_shape=None, num_epochs=None,
+                   shuffle=True, seed=None, capacity=32, shared_name=None,
+                   summary_name=None, name="input_producer", cancel_op=None):
+    v = constant_op.constant_value(ops_mod.convert_to_tensor(input_tensor))
+    if v is None:
+        raise ValueError("input_producer needs static input on TPU")
+    return _producer(list(v), shuffle, seed, capacity, name, num_epochs)
+
+
+def range_input_producer(limit, num_epochs=None, shuffle=True, seed=None,
+                         capacity=32, shared_name=None, name="range_producer"):
+    return _producer(list(np.arange(limit, dtype=np.int32)), shuffle, seed,
+                     capacity, name, num_epochs)
+
+
+def slice_input_producer(tensor_list, num_epochs=None, shuffle=True, seed=None,
+                         capacity=32, shared_name=None,
+                         name="slice_producer"):
+    vals = [constant_op.constant_value(ops_mod.convert_to_tensor(t))
+            for t in tensor_list]
+    if any(v is None for v in vals):
+        raise ValueError("slice_input_producer needs static inputs")
+    n = len(vals[0])
+    q = data_flow_ops.FIFOQueue(
+        capacity, [dtypes_mod.as_dtype(v.dtype) if v.dtype.kind not in "USO"
+                   else dtypes_mod.string for v in vals],
+        shapes=[v.shape[1:] for v in vals], name=name)
+
+    class _SliceRunner(queue_runner.QueueRunner):
+        def __init__(self):
+            super().__init__(queue=q, enqueue_ops=[None])
+            self._rng = np.random.RandomState(seed)
+            self._epochs = 0
+
+        def _run(self, sess, enqueue_op, coord):
+            try:
+                while not (coord and coord.should_stop()):
+                    order = np.arange(n)
+                    if shuffle:
+                        self._rng.shuffle(order)
+                    for i in order:
+                        if coord and coord.should_stop():
+                            return
+                        try:
+                            q._host_enqueue([v[i] for v in vals], timeout=1.0)
+                        except Exception:
+                            if coord and coord.should_stop():
+                                return
+                    self._epochs += 1
+                    if num_epochs and self._epochs >= num_epochs:
+                        break
+            finally:
+                q._host_close()
+
+    queue_runner.add_queue_runner(_SliceRunner())
+    return q.dequeue()
+
+
+def batch(tensors, batch_size, num_threads=1, capacity=32,
+          enqueue_many=False, shapes=None, dynamic_pad=False,
+          allow_smaller_final_batch=False, shared_name=None, name="batch"):
+    """(ref: input.py:872 ``batch``)."""
+    tensor_list = _flatten(tensors)
+    tensor_list = [ops_mod.convert_to_tensor(t) for t in tensor_list]
+    q = data_flow_ops.FIFOQueue(
+        capacity, [t.dtype for t in tensor_list],
+        shapes=shapes or [t.shape for t in tensor_list], name=name)
+    enq = (q.enqueue_many(tensor_list) if enqueue_many
+           else q.enqueue(tensor_list))
+    queue_runner.add_queue_runner(
+        queue_runner.QueueRunner(q, [enq] * num_threads))
+    out = q.dequeue_many(batch_size)
+    return out
+
+
+def shuffle_batch(tensors, batch_size, capacity, min_after_dequeue,
+                  num_threads=1, seed=None, enqueue_many=False, shapes=None,
+                  allow_smaller_final_batch=False, shared_name=None,
+                  name="shuffle_batch"):
+    """(ref: input.py:1061 ``shuffle_batch``)."""
+    tensor_list = _flatten(tensors)
+    tensor_list = [ops_mod.convert_to_tensor(t) for t in tensor_list]
+    q = data_flow_ops.RandomShuffleQueue(
+        capacity, min_after_dequeue, [t.dtype for t in tensor_list],
+        shapes=shapes or [t.shape for t in tensor_list], seed=seed, name=name)
+    enq = (q.enqueue_many(tensor_list) if enqueue_many
+           else q.enqueue(tensor_list))
+    queue_runner.add_queue_runner(
+        queue_runner.QueueRunner(q, [enq] * num_threads))
+    return q.dequeue_many(batch_size)
+
+
+def batch_join(tensors_list, batch_size, capacity=32, enqueue_many=False,
+               shapes=None, dynamic_pad=False,
+               allow_smaller_final_batch=False, shared_name=None,
+               name="batch_join"):
+    return batch(tensors_list[0], batch_size, num_threads=len(tensors_list),
+                 capacity=capacity, enqueue_many=enqueue_many, shapes=shapes,
+                 name=name)
+
+
+def shuffle_batch_join(tensors_list, batch_size, capacity, min_after_dequeue,
+                       seed=None, enqueue_many=False, shapes=None,
+                       allow_smaller_final_batch=False, shared_name=None,
+                       name="shuffle_batch_join"):
+    return shuffle_batch(tensors_list[0], batch_size, capacity,
+                         min_after_dequeue, num_threads=len(tensors_list),
+                         seed=seed, enqueue_many=enqueue_many, shapes=shapes,
+                         name=name)
+
+
+def limit_epochs(tensor, num_epochs=None, name=None):
+    return tensor
+
+
+def maybe_batch(*a, **k):
+    raise NotImplementedError("maybe_batch: use stf.data")

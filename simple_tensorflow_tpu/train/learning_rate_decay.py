@@ -1,0 +1,106 @@
+"""LR schedules (ref: tensorflow/python/training/learning_rate_decay.py).
+
+Schedules are graph expressions of global_step, so the LR computation lives
+inside the compiled step (no host round-trip per step).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..framework import graph as ops_mod
+from ..ops import math_ops, array_ops, control_flow_ops
+
+
+def _step_float(global_step):
+    gs = global_step._ref if hasattr(global_step, "_ref") else global_step
+    return math_ops.cast(gs, "float32")
+
+
+def exponential_decay(learning_rate, global_step, decay_steps, decay_rate,
+                      staircase=False, name=None):
+    """(ref: learning_rate_decay.py:30)."""
+    lr = ops_mod.convert_to_tensor(learning_rate, dtype="float32")
+    p = _step_float(global_step) / float(decay_steps)
+    if staircase:
+        p = math_ops.floor(p)
+    return math_ops.multiply(
+        lr, math_ops.pow(ops_mod.convert_to_tensor(float(decay_rate)), p),
+        name=name)
+
+
+def piecewise_constant(x, boundaries, values, name=None):
+    """(ref: learning_rate_decay.py ``piecewise_constant``)."""
+    step = _step_float(x)
+    out = ops_mod.convert_to_tensor(float(values[0]))
+    for b, v in zip(boundaries, values[1:]):
+        out = array_ops.where(
+            math_ops.greater(step, ops_mod.convert_to_tensor(float(b))),
+            ops_mod.convert_to_tensor(float(v)), out)
+    return out
+
+
+def polynomial_decay(learning_rate, global_step, decay_steps,
+                     end_learning_rate=0.0001, power=1.0, cycle=False,
+                     name=None):
+    lr = ops_mod.convert_to_tensor(float(learning_rate))
+    end_lr = ops_mod.convert_to_tensor(float(end_learning_rate))
+    step = _step_float(global_step)
+    ds = ops_mod.convert_to_tensor(float(decay_steps))
+    if cycle:
+        mult = math_ops.maximum(ops_mod.convert_to_tensor(1.0),
+                                math_ops.ceil(step / ds))
+        ds = ds * mult
+    else:
+        step = math_ops.minimum(step, ds)
+    frac = math_ops.pow(1.0 - step / ds,
+                        ops_mod.convert_to_tensor(float(power)))
+    return math_ops.add((lr - end_lr) * frac, end_lr, name=name)
+
+
+def natural_exp_decay(learning_rate, global_step, decay_steps, decay_rate,
+                      staircase=False, name=None):
+    lr = ops_mod.convert_to_tensor(float(learning_rate))
+    p = _step_float(global_step) / float(decay_steps)
+    if staircase:
+        p = math_ops.floor(p)
+    return math_ops.multiply(
+        lr, math_ops.exp(-float(decay_rate) * p), name=name)
+
+
+def inverse_time_decay(learning_rate, global_step, decay_steps, decay_rate,
+                       staircase=False, name=None):
+    lr = ops_mod.convert_to_tensor(float(learning_rate))
+    p = _step_float(global_step) / float(decay_steps)
+    if staircase:
+        p = math_ops.floor(p)
+    return math_ops.divide(lr, 1.0 + float(decay_rate) * p, name=name)
+
+
+def cosine_decay(learning_rate, global_step, decay_steps, alpha=0.0,
+                 name=None):
+    lr = ops_mod.convert_to_tensor(float(learning_rate))
+    step = math_ops.minimum(_step_float(global_step), float(decay_steps))
+    frac = step / float(decay_steps)
+    cos = 0.5 * (1.0 + math_ops.cos(
+        ops_mod.convert_to_tensor(math.pi) * frac))
+    return math_ops.multiply(lr, (1 - alpha) * cos + alpha, name=name)
+
+
+def cosine_decay_restarts(learning_rate, global_step, first_decay_steps,
+                          t_mul=2.0, m_mul=1.0, alpha=0.0, name=None):
+    # single-cycle approximation beyond first restart boundary
+    return cosine_decay(learning_rate, global_step, first_decay_steps, alpha,
+                        name)
+
+
+def linear_cosine_decay(learning_rate, global_step, decay_steps,
+                        num_periods=0.5, alpha=0.0, beta=0.001, name=None):
+    lr = ops_mod.convert_to_tensor(float(learning_rate))
+    step = math_ops.minimum(_step_float(global_step), float(decay_steps))
+    frac = step / float(decay_steps)
+    linear = 1.0 - frac
+    cos = math_ops.cos(ops_mod.convert_to_tensor(
+        2.0 * math.pi * num_periods) * frac)
+    return math_ops.multiply(
+        lr, (alpha + linear) * (0.5 * (1.0 + cos)) + beta, name=name)

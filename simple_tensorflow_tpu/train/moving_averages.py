@@ -1,0 +1,71 @@
+"""ExponentialMovingAverage (ref: tensorflow/python/training/moving_averages.py)."""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..ops import control_flow_ops, math_ops, state_ops
+from ..ops import variables as variables_mod
+from . import slot_creator
+
+GraphKeys = ops_mod.GraphKeys
+
+
+def assign_moving_average(variable, value, decay, zero_debias=True, name=None):
+    """(ref: moving_averages.py:32)."""
+    decay_t = ops_mod.convert_to_tensor(decay,
+                                        dtype=variable.dtype.base_dtype)
+    one = ops_mod.convert_to_tensor(1.0, dtype=variable.dtype.base_dtype)
+    delta = (variable._ref - value) * (one - decay_t)
+    return state_ops.assign_sub(variable._ref, delta, name=name)
+
+
+class ExponentialMovingAverage:
+    """(ref: moving_averages.py:268 ``class ExponentialMovingAverage``)."""
+
+    def __init__(self, decay, num_updates=None, zero_debias=False,
+                 name="ExponentialMovingAverage"):
+        self._decay = decay
+        self._num_updates = num_updates
+        self._name = name
+        self._averages = {}
+
+    @property
+    def name(self):
+        return self._name
+
+    def apply(self, var_list=None):
+        if var_list is None:
+            var_list = variables_mod.trainable_variables()
+        g = ops_mod.get_default_graph()
+        updates = []
+        for var in var_list:
+            if var not in self._averages:
+                avg = slot_creator.create_slot(
+                    var, var.initialized_value(), self._name)
+                self._averages[var] = avg
+                g.add_to_collection(GraphKeys.MOVING_AVERAGE_VARIABLES, var)
+        decay = ops_mod.convert_to_tensor(float(self._decay))
+        if self._num_updates is not None:
+            n = math_ops.cast(
+                self._num_updates._ref if hasattr(self._num_updates, "_ref")
+                else self._num_updates, "float32")
+            decay = math_ops.minimum(decay, (1.0 + n) / (10.0 + n))
+        for var in var_list:
+            avg = self._averages[var]
+            d = math_ops.cast(decay, var.dtype.base_dtype)
+            updates.append(assign_moving_average(avg, var._ref, d).op)
+        return control_flow_ops.group(*updates, name=self._name)
+
+    def average(self, var):
+        return self._averages.get(var)
+
+    def average_name(self, var):
+        return var.var_name + "/" + self._name
+
+    def variables_to_restore(self, moving_avg_variables=None):
+        out = {}
+        if moving_avg_variables is None:
+            moving_avg_variables = list(self._averages)
+        for var in moving_avg_variables:
+            out[self.average_name(var)] = self._averages.get(var, var)
+        return out

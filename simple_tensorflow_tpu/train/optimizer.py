@@ -1,0 +1,172 @@
+"""Optimizer base class (ref: tensorflow/python/training/optimizer.py).
+
+Reference-compatible two-phase API (compute_gradients / apply_gradients with
+slot variables). TPU-native mechanics: gradients come from the one-shot
+jax.vjp lowering (framework/gradients.py) and every update op lowers into
+the same XLA step program, so param + slot updates fuse with the backward
+pass and run in-place in HBM via buffer donation — the reference instead
+schedules per-variable ApplyAdam CUDA kernels after the backward graph.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import gradients as gradients_mod
+from ..framework.indexed_slices import IndexedSlices
+from ..ops import array_ops, control_flow_ops, math_ops, state_ops
+from ..ops import variables as variables_mod
+from . import slot_creator
+
+GATE_NONE = 0
+GATE_OP = 1
+GATE_GRAPH = 2
+
+
+class Optimizer:
+    GATE_NONE = GATE_NONE
+    GATE_OP = GATE_OP
+    GATE_GRAPH = GATE_GRAPH
+
+    def __init__(self, use_locking, name):
+        if not name:
+            raise ValueError("Must specify optimizer name")
+        self._use_locking = use_locking
+        self._name = name
+        self._slots = {}  # slot_name -> {var_name: slot Variable}
+
+    @property
+    def name(self):
+        return self._name
+
+    # -- main API ------------------------------------------------------------
+    def minimize(self, loss, global_step=None, var_list=None,
+                 gate_gradients=GATE_OP, aggregation_method=None,
+                 colocate_gradients_with_ops=False, name=None,
+                 grad_loss=None):
+        grads_and_vars = self.compute_gradients(
+            loss, var_list=var_list, gate_gradients=gate_gradients,
+            aggregation_method=aggregation_method,
+            colocate_gradients_with_ops=colocate_gradients_with_ops,
+            grad_loss=grad_loss)
+        if not any(g is not None for g, _ in grads_and_vars):
+            raise ValueError(
+                f"No gradients provided for any variable: "
+                f"{[v.name for _, v in grads_and_vars]}")
+        return self.apply_gradients(grads_and_vars, global_step=global_step,
+                                    name=name)
+
+    def compute_gradients(self, loss, var_list=None, gate_gradients=GATE_OP,
+                          aggregation_method=None,
+                          colocate_gradients_with_ops=False, grad_loss=None):
+        if var_list is None:
+            var_list = variables_mod.trainable_variables()
+        grads = gradients_mod.gradients(
+            loss, var_list,
+            grad_ys=[grad_loss] if grad_loss is not None else None)
+        return list(zip(grads, var_list))
+
+    def apply_gradients(self, grads_and_vars, global_step=None, name=None):
+        grads_and_vars = list(grads_and_vars)
+        if not grads_and_vars:
+            raise ValueError("No variables provided.")
+        var_list = [v for g, v in grads_and_vars if g is not None]
+        if not var_list:
+            raise ValueError("No gradients provided for any variable")
+        g = ops_mod.get_default_graph()
+        with g.name_scope(name or self._name):
+            self._create_slots(var_list)
+            self._prepare()
+            update_ops = []
+            for grad, var in grads_and_vars:
+                if grad is None:
+                    continue
+                if isinstance(grad, IndexedSlices):
+                    update_ops.append(self._apply_sparse(grad, var))
+                else:
+                    update_ops.append(self._apply_dense(grad, var))
+            finish = self._finish(update_ops, "update")
+            if global_step is not None:
+                with g.control_dependencies([finish]):
+                    incr = state_ops.assign_add(
+                        global_step._ref if isinstance(
+                            global_step, variables_mod.Variable)
+                        else global_step, 1)
+                return control_flow_ops.group(finish, incr.op,
+                                              name="apply_gradients")
+            return finish
+
+    # -- slots ---------------------------------------------------------------
+    def get_slot(self, var, name):
+        named = self._slots.get(name)
+        if named is None:
+            return None
+        return named.get(_var_key(var))
+
+    def get_slot_names(self):
+        return sorted(self._slots)
+
+    def variables(self):
+        out = []
+        for d in self._slots.values():
+            out.extend(d.values())
+        return out
+
+    def _slot_dict(self, slot_name):
+        return self._slots.setdefault(slot_name, {})
+
+    def _zeros_slot(self, var, slot_name, op_name):
+        named = self._slot_dict(slot_name)
+        key = _var_key(var)
+        if key not in named:
+            named[key] = slot_creator.create_zeros_slot(var,
+                                                        f"{op_name}/{slot_name}")
+        return named[key]
+
+    def _get_or_make_slot(self, var, val, slot_name, op_name):
+        named = self._slot_dict(slot_name)
+        key = _var_key(var)
+        if key not in named:
+            named[key] = slot_creator.create_slot(var, val,
+                                                  f"{op_name}/{slot_name}")
+        return named[key]
+
+    def _get_or_make_slot_with_initializer(self, var, initializer, shape,
+                                           dtype, slot_name, op_name):
+        named = self._slot_dict(slot_name)
+        key = _var_key(var)
+        if key not in named:
+            named[key] = slot_creator.create_slot_with_initializer(
+                var, initializer, shape, dtype, f"{op_name}/{slot_name}")
+        return named[key]
+
+    # -- subclass hooks ------------------------------------------------------
+    def _create_slots(self, var_list):
+        pass
+
+    def _prepare(self):
+        pass
+
+    def _apply_dense(self, grad, var):
+        raise NotImplementedError
+
+    def _apply_sparse(self, grad: IndexedSlices, var):
+        """Default: densify via scatter (XLA fuses it); subclasses may use
+        true sparse slot updates."""
+        dense = array_ops.scatter_nd(
+            array_ops.expand_dims(grad.indices, 1), grad.values,
+            [int(d) for d in var.shape.as_list()])
+        return self._apply_dense(dense, var)
+
+    def _finish(self, update_ops, name_scope):
+        return control_flow_ops.group(*update_ops, name=name_scope)
+
+    # helper for lr etc.
+    def _call_if_callable(self, param):
+        return param() if callable(param) else param
+
+
+def _var_key(var):
+    return var.var_name if hasattr(var, "var_name") else var.name

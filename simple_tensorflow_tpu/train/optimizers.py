@@ -1,0 +1,367 @@
+"""Concrete optimizers (ref: tensorflow/python/training/{gradient_descent,
+momentum,adam,adagrad,adagrad_da,adadelta,rmsprop,ftrl,proximal_*}.py and
+core/kernels/training_ops.cc Apply* kernels).
+
+Each _apply_dense builds assign ops whose lowerings fuse into the step's XLA
+program — there are no per-optimizer kernels to hand-tune on TPU; XLA fuses
+the whole update chain (m/v/param) into a few HBM passes.
+"""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..ops import array_ops, control_flow_ops, math_ops, state_ops
+from ..ops import variables as variables_mod
+from .optimizer import Optimizer
+
+
+def _c(value, var):
+    return ops_mod.convert_to_tensor(value, dtype=var.dtype.base_dtype)
+
+
+class GradientDescentOptimizer(Optimizer):
+    """(ref: python/training/gradient_descent.py)."""
+
+    def __init__(self, learning_rate, use_locking=False,
+                 name="GradientDescent"):
+        super().__init__(use_locking, name)
+        self._learning_rate = learning_rate
+
+    def _apply_dense(self, grad, var):
+        lr = _c(self._call_if_callable(self._learning_rate), var)
+        return state_ops.assign_sub(var._ref, lr * grad).op
+
+    def _apply_sparse(self, grad, var):
+        lr = _c(self._call_if_callable(self._learning_rate), var)
+        return state_ops.scatter_sub(var._ref, grad.indices,
+                                     lr * grad.values).op
+
+
+class MomentumOptimizer(Optimizer):
+    """(ref: python/training/momentum.py)."""
+
+    def __init__(self, learning_rate, momentum, use_locking=False,
+                 name="Momentum", use_nesterov=False):
+        super().__init__(use_locking, name)
+        self._learning_rate = learning_rate
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_slots(self, var_list):
+        for v in var_list:
+            self._zeros_slot(v, "momentum", self._name)
+
+    def _apply_dense(self, grad, var):
+        mom = self.get_slot(var, "momentum")
+        lr = _c(self._call_if_callable(self._learning_rate), var)
+        mu = _c(self._call_if_callable(self._momentum), var)
+        new_acc = state_ops.assign(mom._ref, mu * mom._ref + grad)
+        if self._use_nesterov:
+            update = lr * (grad + mu * new_acc)
+        else:
+            update = lr * new_acc
+        return state_ops.assign_sub(var._ref, update).op
+
+
+class AdamOptimizer(Optimizer):
+    """(ref: python/training/adam.py; kernel core/kernels/training_ops.cc
+    ``ApplyAdam``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, use_locking=False, name="Adam"):
+        super().__init__(use_locking, name)
+        self._lr = learning_rate
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._beta1_power = None
+        self._beta2_power = None
+
+    def _create_slots(self, var_list):
+        if self._beta1_power is None:
+            self._beta1_power = variables_mod.Variable(
+                float(self._beta1), trainable=False,
+                name=self._name + "/beta1_power")
+            self._beta2_power = variables_mod.Variable(
+                float(self._beta2), trainable=False,
+                name=self._name + "/beta2_power")
+        for v in var_list:
+            self._zeros_slot(v, "m", self._name)
+            self._zeros_slot(v, "v", self._name)
+
+    def _apply_dense(self, grad, var):
+        m = self.get_slot(var, "m")
+        v = self.get_slot(var, "v")
+        lr = _c(self._call_if_callable(self._lr), var)
+        b1 = _c(self._beta1, var)
+        b2 = _c(self._beta2, var)
+        eps = _c(self._epsilon, var)
+        b1p = math_ops.cast(self._beta1_power._ref, var.dtype.base_dtype)
+        b2p = math_ops.cast(self._beta2_power._ref, var.dtype.base_dtype)
+        alpha = lr * math_ops.sqrt(1 - b2p) / (1 - b1p)
+        new_m = state_ops.assign(m._ref, b1 * m._ref + (1 - b1) * grad)
+        new_v = state_ops.assign(v._ref, b2 * v._ref +
+                                 (1 - b2) * math_ops.square(grad))
+        update = alpha * new_m / (math_ops.sqrt(new_v) + eps)
+        return state_ops.assign_sub(var._ref, update).op
+
+    def _finish(self, update_ops, name_scope):
+        g = ops_mod.get_default_graph()
+        with g.control_dependencies(update_ops):
+            b1_up = state_ops.assign(self._beta1_power._ref,
+                                     self._beta1_power._ref *
+                                     _c(self._beta1, self._beta1_power)).op
+            b2_up = state_ops.assign(self._beta2_power._ref,
+                                     self._beta2_power._ref *
+                                     _c(self._beta2, self._beta2_power)).op
+        return control_flow_ops.group(*(update_ops + [b1_up, b2_up]),
+                                      name=name_scope)
+
+
+class AdagradOptimizer(Optimizer):
+    """(ref: python/training/adagrad.py)."""
+
+    def __init__(self, learning_rate, initial_accumulator_value=0.1,
+                 use_locking=False, name="Adagrad"):
+        super().__init__(use_locking, name)
+        self._learning_rate = learning_rate
+        self._init_acc = initial_accumulator_value
+
+    def _create_slots(self, var_list):
+        for v in var_list:
+            self._get_or_make_slot(
+                v, array_ops.fill([int(d) for d in v.shape.as_list()],
+                                  ops_mod.convert_to_tensor(
+                                      self._init_acc,
+                                      dtype=v.dtype.base_dtype)),
+                "accumulator", self._name)
+
+    def _apply_dense(self, grad, var):
+        acc = self.get_slot(var, "accumulator")
+        lr = _c(self._call_if_callable(self._learning_rate), var)
+        new_acc = state_ops.assign_add(acc._ref, math_ops.square(grad))
+        return state_ops.assign_sub(
+            var._ref, lr * grad * math_ops.rsqrt(new_acc)).op
+
+    def _apply_sparse(self, grad, var):
+        acc = self.get_slot(var, "accumulator")
+        lr = _c(self._call_if_callable(self._learning_rate), var)
+        new_acc = state_ops.scatter_add(acc._ref, grad.indices,
+                                        math_ops.square(grad.values))
+        from ..ops import array_ops as ao
+
+        acc_slice = ao.gather(new_acc, grad.indices)
+        return state_ops.scatter_sub(
+            var._ref, grad.indices,
+            lr * grad.values * math_ops.rsqrt(acc_slice)).op
+
+
+class AdadeltaOptimizer(Optimizer):
+    """(ref: python/training/adadelta.py)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-8,
+                 use_locking=False, name="Adadelta"):
+        super().__init__(use_locking, name)
+        self._lr = learning_rate
+        self._rho = rho
+        self._epsilon = epsilon
+
+    def _create_slots(self, var_list):
+        for v in var_list:
+            self._zeros_slot(v, "accum", self._name)
+            self._zeros_slot(v, "accum_update", self._name)
+
+    def _apply_dense(self, grad, var):
+        accum = self.get_slot(var, "accum")
+        accum_update = self.get_slot(var, "accum_update")
+        lr = _c(self._call_if_callable(self._lr), var)
+        rho = _c(self._rho, var)
+        eps = _c(self._epsilon, var)
+        new_accum = state_ops.assign(
+            accum._ref, rho * accum._ref + (1 - rho) * math_ops.square(grad))
+        update = (math_ops.sqrt(accum_update._ref + eps) *
+                  math_ops.rsqrt(new_accum + eps) * grad)
+        new_accum_update = state_ops.assign(
+            accum_update._ref,
+            rho * accum_update._ref + (1 - rho) * math_ops.square(update))
+        with ops_mod.get_default_graph().control_dependencies(
+                [new_accum_update.op]):
+            return state_ops.assign_sub(var._ref, lr * update).op
+
+
+class RMSPropOptimizer(Optimizer):
+    """(ref: python/training/rmsprop.py)."""
+
+    def __init__(self, learning_rate, decay=0.9, momentum=0.0, epsilon=1e-10,
+                 use_locking=False, centered=False, name="RMSProp"):
+        super().__init__(use_locking, name)
+        self._lr = learning_rate
+        self._decay = decay
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._centered = centered
+
+    def _create_slots(self, var_list):
+        for v in var_list:
+            self._get_or_make_slot(
+                v, array_ops.ones([int(d) for d in v.shape.as_list()],
+                                  dtype=v.dtype.base_dtype), "rms", self._name)
+            self._zeros_slot(v, "momentum", self._name)
+            if self._centered:
+                self._zeros_slot(v, "mg", self._name)
+
+    def _apply_dense(self, grad, var):
+        rms = self.get_slot(var, "rms")
+        mom = self.get_slot(var, "momentum")
+        lr = _c(self._call_if_callable(self._lr), var)
+        decay = _c(self._decay, var)
+        momentum = _c(self._momentum, var)
+        eps = _c(self._epsilon, var)
+        new_rms = state_ops.assign(
+            rms._ref, decay * rms._ref + (1 - decay) * math_ops.square(grad))
+        denom = new_rms
+        if self._centered:
+            mg = self.get_slot(var, "mg")
+            new_mg = state_ops.assign(mg._ref,
+                                      decay * mg._ref + (1 - decay) * grad)
+            denom = new_rms - math_ops.square(new_mg)
+        new_mom = state_ops.assign(
+            mom._ref, momentum * mom._ref +
+            lr * grad * math_ops.rsqrt(denom + eps))
+        return state_ops.assign_sub(var._ref, new_mom).op
+
+
+class FtrlOptimizer(Optimizer):
+    """(ref: python/training/ftrl.py)."""
+
+    def __init__(self, learning_rate, learning_rate_power=-0.5,
+                 initial_accumulator_value=0.1, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, use_locking=False,
+                 name="Ftrl", l2_shrinkage_regularization_strength=0.0):
+        super().__init__(use_locking, name)
+        self._lr = learning_rate
+        self._lr_power = learning_rate_power
+        self._init_acc = initial_accumulator_value
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _create_slots(self, var_list):
+        for v in var_list:
+            self._get_or_make_slot(
+                v, array_ops.fill([int(d) for d in v.shape.as_list()],
+                                  ops_mod.convert_to_tensor(
+                                      self._init_acc,
+                                      dtype=v.dtype.base_dtype)),
+                "accum", self._name)
+            self._zeros_slot(v, "linear", self._name)
+
+    def _apply_dense(self, grad, var):
+        accum = self.get_slot(var, "accum")
+        linear = self.get_slot(var, "linear")
+        lr = _c(self._call_if_callable(self._lr), var)
+        lr_power = _c(self._lr_power, var)
+        l1 = _c(self._l1, var)
+        l2 = _c(self._l2, var)
+        new_accum = accum._ref + math_ops.square(grad)
+        sigma = (math_ops.pow(new_accum, -lr_power) -
+                 math_ops.pow(accum._ref, -lr_power)) / lr
+        new_linear = state_ops.assign(
+            linear._ref, linear._ref + grad - sigma * var._ref)
+        upd_accum = state_ops.assign(accum._ref, new_accum)
+        quadratic = math_ops.pow(new_accum, -lr_power) / lr + 2 * l2
+        pre = math_ops.sign(new_linear) * l1 - new_linear
+        new_var = array_ops.where(
+            math_ops.greater(math_ops.abs(new_linear), l1),
+            pre / quadratic, array_ops.zeros_like(var._ref))
+        with ops_mod.get_default_graph().control_dependencies([upd_accum.op]):
+            return state_ops.assign(var._ref, new_var).op
+
+
+class AdagradDAOptimizer(Optimizer):
+    """(ref: python/training/adagrad_da.py)."""
+
+    def __init__(self, learning_rate, global_step,
+                 initial_gradient_squared_accumulator_value=0.1,
+                 l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, use_locking=False,
+                 name="AdagradDA"):
+        super().__init__(use_locking, name)
+        self._lr = learning_rate
+        self._global_step = global_step
+        self._init_gg = initial_gradient_squared_accumulator_value
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _create_slots(self, var_list):
+        for v in var_list:
+            self._zeros_slot(v, "gradient_accumulator", self._name)
+            self._get_or_make_slot(
+                v, array_ops.fill([int(d) for d in v.shape.as_list()],
+                                  ops_mod.convert_to_tensor(
+                                      self._init_gg,
+                                      dtype=v.dtype.base_dtype)),
+                "gradient_squared_accumulator", self._name)
+
+    def _apply_dense(self, grad, var):
+        g_acc = self.get_slot(var, "gradient_accumulator")
+        gg_acc = self.get_slot(var, "gradient_squared_accumulator")
+        lr = _c(self._call_if_callable(self._lr), var)
+        l1 = _c(self._l1, var)
+        l2 = _c(self._l2, var)
+        gstep = math_ops.cast(
+            self._global_step._ref if hasattr(self._global_step, "_ref")
+            else self._global_step, var.dtype.base_dtype) + 1
+        new_g = state_ops.assign_add(g_acc._ref, grad)
+        new_gg = state_ops.assign_add(gg_acc._ref, math_ops.square(grad))
+        sign = math_ops.sign(new_g)
+        pruned = sign * math_ops.maximum(
+            math_ops.abs(new_g) - l1 * gstep, array_ops.zeros_like(new_g))
+        denom = math_ops.sqrt(new_gg) + lr * l2 * gstep
+        new_var = -lr * pruned / denom
+        return state_ops.assign(var._ref, new_var).op
+
+
+class ProximalGradientDescentOptimizer(GradientDescentOptimizer):
+    """(ref: python/training/proximal_gradient_descent.py) — l1/l2 proximal
+    step after the gradient step."""
+
+    def __init__(self, learning_rate, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, use_locking=False,
+                 name="ProximalGradientDescent"):
+        super().__init__(learning_rate, use_locking, name)
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _apply_dense(self, grad, var):
+        lr = _c(self._call_if_callable(self._learning_rate), var)
+        l1 = _c(self._l1, var)
+        l2 = _c(self._l2, var)
+        prox = var._ref - lr * grad
+        soft = math_ops.sign(prox) * math_ops.maximum(
+            math_ops.abs(prox) - lr * l1, array_ops.zeros_like(prox))
+        return state_ops.assign(var._ref, soft / (1 + lr * l2)).op
+
+
+class ProximalAdagradOptimizer(AdagradOptimizer):
+    """(ref: python/training/proximal_adagrad.py)."""
+
+    def __init__(self, learning_rate, initial_accumulator_value=0.1,
+                 l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, use_locking=False,
+                 name="ProximalAdagrad"):
+        super().__init__(learning_rate, initial_accumulator_value,
+                         use_locking, name)
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _apply_dense(self, grad, var):
+        acc = self.get_slot(var, "accumulator")
+        lr = _c(self._call_if_callable(self._learning_rate), var)
+        l1 = _c(self._l1, var)
+        l2 = _c(self._l2, var)
+        new_acc = state_ops.assign_add(acc._ref, math_ops.square(grad))
+        adjusted_lr = lr * math_ops.rsqrt(new_acc)
+        prox = var._ref - adjusted_lr * grad
+        soft = math_ops.sign(prox) * math_ops.maximum(
+            math_ops.abs(prox) - adjusted_lr * l1, array_ops.zeros_like(prox))
+        return state_ops.assign(var._ref, soft / (1 + adjusted_lr * l2)).op

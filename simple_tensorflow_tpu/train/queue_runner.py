@@ -1,0 +1,92 @@
+"""QueueRunner (ref: tensorflow/python/training/queue_runner_impl.py)."""
+
+from __future__ import annotations
+
+import threading
+
+from ..framework import errors
+from ..framework import graph as ops_mod
+from .coordinator import Coordinator
+
+GraphKeys = ops_mod.GraphKeys
+
+
+class QueueRunner:
+    """(ref: queue_runner_impl.py:34 ``class QueueRunner``)."""
+
+    def __init__(self, queue=None, enqueue_ops=None, close_op=None,
+                 cancel_op=None, queue_closed_exception_types=None,
+                 queue_runner_def=None, import_scope=None):
+        self._queue = queue
+        self._enqueue_ops = list(enqueue_ops or [])
+        self._close_op = close_op
+        self._exceptions = queue_closed_exception_types or (
+            errors.OutOfRangeError, errors.CancelledError)
+        self._runs = 0
+        self._lock = threading.Lock()
+        self._exceptions_raised = []
+
+    @property
+    def queue(self):
+        return self._queue
+
+    @property
+    def enqueue_ops(self):
+        return self._enqueue_ops
+
+    @property
+    def exceptions_raised(self):
+        return self._exceptions_raised
+
+    @property
+    def name(self):
+        return self._queue.name if self._queue is not None else "queue_runner"
+
+    def _run(self, sess, enqueue_op, coord):
+        try:
+            while True:
+                if coord and coord.should_stop():
+                    break
+                try:
+                    sess.run(enqueue_op)
+                except self._exceptions:
+                    break
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self._exceptions_raised.append(e)
+            if coord:
+                coord.request_stop(e)
+        finally:
+            if self._queue is not None:
+                self._queue._host_close()
+
+    def create_threads(self, sess, coord=None, daemon=False, start=False):
+        threads = [threading.Thread(target=self._run,
+                                    args=(sess, op, coord), daemon=daemon)
+                   for op in self._enqueue_ops]
+        if coord:
+            for t in threads:
+                coord.register_thread(t)
+        if start:
+            for t in threads:
+                t.start()
+        return threads
+
+
+def add_queue_runner(qr, collection=GraphKeys.QUEUE_RUNNERS):
+    ops_mod.get_default_graph().add_to_collection(collection, qr)
+
+
+def start_queue_runners(sess=None, coord=None, daemon=True, start=True,
+                        collection=GraphKeys.QUEUE_RUNNERS):
+    """(ref: queue_runner_impl.py:387)."""
+    from ..client.session import get_default_session
+
+    sess = sess or get_default_session()
+    if sess is None:
+        raise ValueError("start_queue_runners needs a session")
+    threads = []
+    for qr in ops_mod.get_default_graph().get_collection(collection):
+        threads.extend(qr.create_threads(sess, coord=coord, daemon=daemon,
+                                         start=start))
+    return threads

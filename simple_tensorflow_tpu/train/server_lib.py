@@ -1,0 +1,141 @@
+"""Cluster definition + Server (ref: tensorflow/python/training/server_lib.py,
+core/distributed_runtime/rpc/grpc_server_lib.cc).
+
+TPU-native: the reference runs a grpc master/worker per process with
+explicit Send/Recv partitioning; on TPU pods the runtime is SPMD — every
+host runs the same program and XLA moves data over ICI/DCN. ``Server`` here
+bootstraps that: it calls jax.distributed.initialize with
+coordinator/process info derived from the ClusterSpec, after which
+stf.parallel meshes span all hosts' devices. There is no parameter-server
+role; "ps" jobs in a ClusterSpec are rejected with guidance (use fsdp
+sharding instead).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Union
+
+
+class ClusterSpec:
+    """(ref: server_lib.py:189 ``class ClusterSpec``)."""
+
+    def __init__(self, cluster):
+        if isinstance(cluster, dict):
+            self._cluster = {job: (dict(enumerate(tasks))
+                                   if isinstance(tasks, list) else dict(tasks))
+                             for job, tasks in cluster.items()}
+        elif isinstance(cluster, ClusterSpec):
+            self._cluster = {j: dict(t) for j, t in cluster._cluster.items()}
+        else:
+            raise TypeError("cluster must be dict or ClusterSpec")
+
+    def as_dict(self):
+        return {job: [t for _, t in sorted(tasks.items())]
+                for job, tasks in self._cluster.items()}
+
+    @property
+    def jobs(self):
+        return list(self._cluster)
+
+    def num_tasks(self, job_name):
+        return len(self._cluster[job_name])
+
+    def task_indices(self, job_name):
+        return sorted(self._cluster[job_name])
+
+    def task_address(self, job_name, task_index):
+        return self._cluster[job_name][task_index]
+
+    def job_tasks(self, job_name):
+        return [t for _, t in sorted(self._cluster[job_name].items())]
+
+    def __bool__(self):
+        return bool(self._cluster)
+
+    def __eq__(self, other):
+        return isinstance(other, ClusterSpec) and \
+            self._cluster == other._cluster
+
+    def as_cluster_def(self):
+        return self.as_dict()
+
+
+class ServerDef:
+    def __init__(self, cluster, job_name, task_index, protocol):
+        self.cluster = cluster
+        self.job_name = job_name
+        self.task_index = task_index
+        self.protocol = protocol
+
+
+class Server:
+    """(ref: server_lib.py:42 ``class Server``) → jax.distributed bootstrap.
+
+    start() initializes the jax distributed runtime (coordinator = task 0 of
+    the 'worker' job); join() blocks forever like the reference's grpc
+    server join.
+    """
+
+    _started = False
+
+    def __init__(self, server_or_cluster_def, job_name=None, task_index=None,
+                 protocol=None, config=None, start=True):
+        if isinstance(server_or_cluster_def, (dict, ClusterSpec)):
+            cluster = ClusterSpec(server_or_cluster_def)
+        else:
+            raise TypeError("need ClusterSpec or dict")
+        if "ps" in cluster.jobs:
+            raise ValueError(
+                "Parameter-server clusters do not exist on TPU: all state is "
+                "sharded across workers via stf.parallel (fsdp/tp axes). "
+                "Define only a 'worker' job.")
+        self._cluster = cluster
+        self._job_name = job_name or "worker"
+        self._task_index = task_index or 0
+        self._config = config
+        if start:
+            self.start()
+
+    @property
+    def server_def(self):
+        return ServerDef(self._cluster, self._job_name, self._task_index,
+                         "grpc+icidcn")
+
+    @property
+    def target(self):
+        """Session target; stf Sessions are process-local (SPMD), the target
+        string is informational."""
+        return f"stf://{self._job_name}:{self._task_index}"
+
+    def start(self):
+        if Server._started:
+            return
+        workers = self._cluster.job_tasks(self._job_name)
+        n = len(workers)
+        if n <= 1:
+            Server._started = True
+            return
+        import jax
+
+        coordinator = workers[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=n,
+                process_id=self._task_index)
+            Server._started = True
+        except Exception as e:  # pragma: no cover - needs real multi-host
+            raise RuntimeError(
+                f"jax.distributed.initialize failed for {coordinator}: {e}")
+
+    def join(self):
+        import time
+
+        while True:
+            time.sleep(3600)
+
+    @staticmethod
+    def create_local_server(config=None, start=True):
+        return Server({"worker": ["localhost:0"]}, job_name="worker",
+                      task_index=0, config=config, start=start)

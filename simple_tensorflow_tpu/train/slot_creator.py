@@ -1,0 +1,44 @@
+"""Slot variable creation (ref: tensorflow/python/training/slot_creator.py).
+
+Slots inherit the primary variable's sharding so optimizer state is laid out
+on the mesh exactly like its parameter (the FSDP/ZeRO property falls out)."""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..ops import array_ops
+from ..ops import variables as variables_mod
+
+
+def create_slot(primary, val, name, colocate_with_primary=True):
+    v = variables_mod.Variable(
+        val, trainable=False,
+        name=f"{primary.var_name}/{name}")
+    if primary.sharding is not None:
+        v.set_sharding(primary.sharding)
+    return v
+
+
+def create_slot_with_initializer(primary, initializer, shape, dtype, name,
+                                 colocate_with_primary=True):
+    sh = [int(d) for d in shape.as_list()] if hasattr(shape, "as_list") \
+        else [int(d) for d in shape]
+
+    def init():
+        try:
+            return initializer(sh, dtype=dtype)
+        except TypeError:
+            return initializer(sh)
+
+    v = variables_mod.Variable(init, trainable=False,
+                               name=f"{primary.var_name}/{name}", dtype=dtype)
+    if primary.sharding is not None:
+        v.set_sharding(primary.sharding)
+    return v
+
+
+def create_zeros_slot(primary, name, dtype=None, colocate_with_primary=True):
+    dtype = dtype or primary.dtype.base_dtype
+    val = array_ops.zeros([int(d) for d in primary.shape.as_list()],
+                          dtype=dtype)
+    return create_slot(primary, val, name, colocate_with_primary)

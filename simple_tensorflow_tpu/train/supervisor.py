@@ -1,0 +1,149 @@
+"""Supervisor (ref: tensorflow/python/training/supervisor.py) — legacy
+training harness predating MonitoredTrainingSession; kept for parity and
+implemented on top of the same pieces."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+from ..framework import graph as ops_mod
+from ..ops import variables as variables_mod
+from ..client.session import Session
+from . import training_util
+from .coordinator import Coordinator
+from .queue_runner import start_queue_runners
+from .saver import Saver, latest_checkpoint
+
+USE_DEFAULT = 0
+
+
+class Supervisor:
+    """(ref: supervisor.py:36 ``class Supervisor``)."""
+
+    def __init__(self, graph=None, ready_op=USE_DEFAULT,
+                 ready_for_local_init_op=USE_DEFAULT, is_chief=True,
+                 init_op=USE_DEFAULT, init_feed_dict=None,
+                 local_init_op=USE_DEFAULT, logdir=None, summary_op=USE_DEFAULT,
+                 saver=USE_DEFAULT, global_step=USE_DEFAULT,
+                 save_summaries_secs=120, save_model_secs=600,
+                 recovery_wait_secs=30, stop_grace_secs=120,
+                 checkpoint_basename="model.ckpt", session_manager=None,
+                 summary_writer=USE_DEFAULT, init_fn=None):
+        self._graph = graph or ops_mod.get_default_graph()
+        self._is_chief = is_chief
+        self._logdir = logdir
+        self._save_model_secs = save_model_secs
+        self._checkpoint_basename = checkpoint_basename
+        self._coord = Coordinator()
+        self._init_fn = init_fn
+        self._init_feed_dict = init_feed_dict
+        with ops_mod._as_current(self._graph):
+            self._init_op = (variables_mod.global_variables_initializer()
+                             if init_op is USE_DEFAULT else init_op)
+            self._saver = Saver() if saver is USE_DEFAULT else saver
+            self._global_step = (training_util.get_global_step(self._graph)
+                                 if global_step is USE_DEFAULT else global_step)
+        self._last_save = 0.0
+
+    @property
+    def coord(self):
+        return self._coord
+
+    @property
+    def saver(self):
+        return self._saver
+
+    @property
+    def global_step(self):
+        return self._global_step
+
+    @property
+    def session_manager(self):
+        from .monitored_session import SessionManager
+
+        return SessionManager(graph=self._graph)
+
+    def prepare_or_wait_for_session(self, master="", config=None,
+                                    wait_for_checkpoint=False,
+                                    max_wait_secs=7200,
+                                    start_standard_services=True):
+        """(ref: supervisor.py:650)."""
+        sess = Session(master, graph=self._graph, config=config)
+        restored = False
+        if self._logdir:
+            path = latest_checkpoint(self._logdir)
+            if path:
+                self._saver.restore(sess, path)
+                restored = True
+        if not restored and self._init_op is not None:
+            sess.run(self._init_op, feed_dict=self._init_feed_dict)
+        if self._init_fn:
+            self._init_fn(sess)
+        if start_standard_services:
+            self.start_standard_services(sess)
+        self._sess = sess
+        return sess
+
+    def start_standard_services(self, sess):
+        return start_queue_runners(sess, coord=self._coord)
+
+    def start_queue_runners(self, sess, queue_runners=None):
+        return start_queue_runners(sess, coord=self._coord)
+
+    @contextlib.contextmanager
+    def managed_session(self, master="", config=None,
+                        start_standard_services=True,
+                        close_summary_writer=True):
+        """(ref: supervisor.py:908 ``managed_session``)."""
+        sess = self.prepare_or_wait_for_session(
+            master, config, start_standard_services=start_standard_services)
+        try:
+            yield sess
+        except Exception as e:  # noqa: BLE001
+            self._coord.request_stop(e)
+        finally:
+            try:
+                if self._is_chief and self._logdir and self._saver:
+                    self._saver.save(
+                        sess, os.path.join(self._logdir,
+                                           self._checkpoint_basename),
+                        global_step=self._global_step)
+            except Exception:
+                pass
+            self.stop()
+            sess.close()
+        self._coord.raise_requested_exception()
+
+    def should_stop(self):
+        return self._coord.should_stop()
+
+    def request_stop(self, ex=None):
+        self._coord.request_stop(ex)
+
+    def stop(self, threads=None, close_summary_writer=True):
+        self._coord.request_stop()
+        try:
+            self._coord.join(threads, stop_grace_period_secs=2,
+                             ignore_live_threads=True)
+        except Exception:
+            pass
+
+    def summary_computed(self, sess, summary, global_step=None):
+        pass
+
+    def loop(self, timer_interval_secs, target, args=None, kwargs=None):
+        from .coordinator import LooperThread
+
+        return LooperThread.loop(self._coord, timer_interval_secs, target,
+                                 args, kwargs)
+
+    def maybe_save(self, sess):
+        now = time.time()
+        if (self._is_chief and self._logdir and
+                now - self._last_save > self._save_model_secs):
+            self._last_save = now
+            self._saver.save(sess, os.path.join(self._logdir,
+                                                self._checkpoint_basename),
+                             global_step=self._global_step)

@@ -1,0 +1,81 @@
+"""SyncReplicasOptimizer (ref: tensorflow/python/training/
+sync_replicas_optimizer.py).
+
+The reference synchronizes replicas through shared ConditionalAccumulators
+on parameter servers. TPU-native: data-parallel replicas live on a mesh and
+the gradient all-reduce happens *inside* the XLA step over ICI
+(stf.parallel.all_reduce → psum). This wrapper keeps the reference API:
+wrap any optimizer; gradients are cross-replica-averaged before apply when
+a mesh with a 'dp' axis is active; single-device it is a passthrough.
+"""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..framework.indexed_slices import IndexedSlices
+from .optimizer import Optimizer
+
+
+class SyncReplicasOptimizer(Optimizer):
+    """(ref: sync_replicas_optimizer.py:33)."""
+
+    def __init__(self, opt, replicas_to_aggregate, total_num_replicas=None,
+                 variable_averages=None, variables_to_average=None,
+                 use_locking=False, name="sync_replicas"):
+        super().__init__(use_locking, name)
+        self._opt = opt
+        self._replicas_to_aggregate = replicas_to_aggregate
+        self._total_num_replicas = total_num_replicas or replicas_to_aggregate
+
+    def compute_gradients(self, *args, **kwargs):
+        return self._opt.compute_gradients(*args, **kwargs)
+
+    def apply_gradients(self, grads_and_vars, global_step=None, name=None):
+        from ..parallel import api as parallel_api
+        from ..parallel import collectives
+
+        mesh = parallel_api.current_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            averaged = []
+            for g, v in grads_and_vars:
+                if g is None:
+                    averaged.append((g, v))
+                elif isinstance(g, IndexedSlices):
+                    averaged.append((IndexedSlices(
+                        collectives.all_reduce(g.values, "dp", op="mean"),
+                        g.indices, g.dense_shape), v))
+                else:
+                    averaged.append(
+                        (collectives.all_reduce(g, "dp", op="mean"), v))
+            grads_and_vars = averaged
+        return self._opt.apply_gradients(grads_and_vars,
+                                         global_step=global_step, name=name)
+
+    def get_slot(self, var, name):
+        return self._opt.get_slot(var, name)
+
+    def get_slot_names(self):
+        return self._opt.get_slot_names()
+
+    def variables(self):
+        return self._opt.variables()
+
+    def get_chief_queue_runner(self):
+        """The reference's chief token queue has no TPU counterpart (SPMD
+        steps are synchronous by construction); returns a no-op runner."""
+        from .queue_runner import QueueRunner
+
+        return QueueRunner(queue=None, enqueue_ops=[])
+
+    def get_init_tokens_op(self, num_tokens=-1):
+        from ..ops import control_flow_ops
+
+        return control_flow_ops.no_op(name="sync_replicas_init_tokens")
+
+    def make_session_run_hook(self, is_chief, num_tokens=-1):
+        from .session_run_hook import SessionRunHook
+
+        class _NoopHook(SessionRunHook):
+            pass
+
+        return _NoopHook()

@@ -1,0 +1,59 @@
+"""global_step helpers (ref: tensorflow/python/training/training_util.py)."""
+
+from __future__ import annotations
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..ops import variables as variables_mod
+from ..ops import init_ops
+
+GraphKeys = ops_mod.GraphKeys
+
+
+def get_global_step(graph=None):
+    graph = graph or ops_mod.get_default_graph()
+    items = graph.get_collection(GraphKeys.GLOBAL_STEP)
+    if items:
+        return items[0]
+    try:
+        op = graph.get_operation_by_name("global_step")
+        for v in graph.get_collection(GraphKeys.GLOBAL_VARIABLES):
+            if v.op is op:
+                return v
+    except KeyError:
+        pass
+    return None
+
+
+def create_global_step(graph=None):
+    graph = graph or ops_mod.get_default_graph()
+    if get_global_step(graph) is not None:
+        raise ValueError('"global_step" already exists.')
+    with ops_mod._as_current(graph):
+        v = variables_mod.Variable(
+            0, trainable=False, dtype=dtypes_mod.int64, name="global_step",
+            collections=[GraphKeys.GLOBAL_VARIABLES, GraphKeys.GLOBAL_STEP])
+    return v
+
+
+def get_or_create_global_step(graph=None):
+    graph = graph or ops_mod.get_default_graph()
+    gs = get_global_step(graph)
+    if gs is None:
+        gs = create_global_step(graph)
+    return gs
+
+
+def global_step(sess, global_step_tensor):
+    import numpy as np
+
+    return int(np.asarray(sess.run(global_step_tensor)))
+
+
+def assert_global_step(global_step_tensor):
+    t = (global_step_tensor._ref if hasattr(global_step_tensor, "_ref")
+         else global_step_tensor)
+    if not t.dtype.base_dtype.is_integer:
+        raise TypeError("global_step must be integer")
+    if t.shape.rank not in (0, None):
+        raise TypeError("global_step must be scalar")

@@ -1,0 +1,8 @@
+"""Version info (ref: tensorflow/python/framework/versions.py)."""
+
+VERSION = "1.0.0-tpu"
+__version__ = VERSION
+GRAPH_DEF_VERSION = 1
+GRAPH_DEF_VERSION_MIN_CONSUMER = 0
+GRAPH_DEF_VERSION_MIN_PRODUCER = 0
+COMPILER_VERSION = "xla"

@@ -1,0 +1,186 @@
+"""Session/graph semantics tests (mirrors ref python/client/session_test.py,
+python/framework/ops_test.py)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def test_feed_fetch():
+    x = stf.placeholder(stf.float32, [None, 3])
+    y = x * 2.0
+    with stf.Session() as sess:
+        out = sess.run(y, feed_dict={x: np.ones((2, 3), np.float32)})
+        np.testing.assert_allclose(out, 2 * np.ones((2, 3)))
+        # different batch size -> retrace, same cache entry
+        out = sess.run(y, feed_dict={x: np.ones((5, 3), np.float32)})
+        assert out.shape == (5, 3)
+
+
+def test_fetch_structures():
+    a = stf.constant(1.0)
+    b = stf.constant(2.0)
+    with stf.Session() as sess:
+        res = sess.run({"x": a, "pair": [a, b], "t": (b,)})
+        assert float(res["x"]) == 1.0
+        assert [float(v) for v in res["pair"]] == [1.0, 2.0]
+        assert isinstance(res["t"], tuple)
+
+
+def test_variables_and_init():
+    v = stf.Variable(3.0, name="v")
+    w = stf.Variable(lambda: stf.constant(4.0), name="w")
+    total = v + w
+    with stf.Session() as sess:
+        with pytest.raises(stf.errors.FailedPreconditionError):
+            sess.run(total)
+        sess.run(stf.global_variables_initializer())
+        assert float(sess.run(total)) == 7.0
+
+
+def test_assign_semantics():
+    v = stf.Variable(1.0, name="v")
+    assign = v.assign(5.0)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        assert float(sess.run(assign)) == 5.0
+        assert float(sess.run(v)) == 5.0
+        sess.run(v.assign_add(2.0))
+        assert float(sess.run(v)) == 7.0
+
+
+def test_read_after_write_with_control_deps():
+    v = stf.Variable(1.0, name="v")
+    assign = v.assign(10.0)
+    with stf.control_dependencies([assign]):
+        read = v.read_value()
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        assert float(sess.run(read)) == 10.0
+
+
+def test_name_scoping():
+    with stf.name_scope("outer"):
+        c = stf.constant(1.0, name="c")
+        with stf.name_scope("inner"):
+            d = stf.constant(2.0, name="c")
+    assert c.op.name == "outer/c"
+    assert d.op.name == "outer/inner/c"
+    g = stf.get_default_graph()
+    assert g.get_tensor_by_name("outer/c:0") is c
+
+
+def test_gradients_simple():
+    x = stf.placeholder(stf.float32, [])
+    y = x * x + 3.0 * x
+    (dx,) = stf.gradients(y, [x])
+    with stf.Session() as sess:
+        g = sess.run(dx, feed_dict={x: 2.0})
+        assert float(g) == pytest.approx(7.0)
+
+
+def test_gradients_disconnected():
+    x = stf.placeholder(stf.float32, [])
+    z = stf.placeholder(stf.float32, [])
+    y = x * 2.0
+    grads = stf.gradients(y, [x, z])
+    assert grads[1] is None
+
+
+def test_gradients_through_variables():
+    v = stf.Variable(np.array([1.0, 2.0], np.float32), name="v")
+    loss = stf.reduce_sum(v * v)
+    (dv,) = stf.gradients(loss, [v])
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        np.testing.assert_allclose(sess.run(dv), [2.0, 4.0])
+
+
+def test_sgd_training_loop_converges():
+    """Linear regression: the MNIST-softmax e2e pattern (BASELINE config 1)."""
+    rng = np.random.RandomState(0)
+    x_data = rng.randn(64, 3).astype(np.float32)
+    true_w = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    y_data = x_data @ true_w
+
+    x = stf.placeholder(stf.float32, [None, 3])
+    y = stf.placeholder(stf.float32, [None, 1])
+    w = stf.Variable(np.zeros((3, 1), np.float32), name="w")
+    pred = stf.matmul(x, w)
+    loss = stf.reduce_mean(stf.square(pred - y))
+    train_op = stf.train.GradientDescentOptimizer(0.1).minimize(loss)
+
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        losses = []
+        for _ in range(200):
+            _, l = sess.run([train_op, loss],
+                            feed_dict={x: x_data, y: y_data})
+            losses.append(float(l))
+        assert losses[-1] < 1e-3
+        np.testing.assert_allclose(sess.run(w), true_w, atol=0.05)
+
+
+def test_cond():
+    p = stf.placeholder(stf.bool, [])
+    x = stf.constant(2.0)
+    out = stf.cond(p, lambda: x * 2.0, lambda: x - 1.0)
+    with stf.Session() as sess:
+        assert float(sess.run(out, {p: True})) == 4.0
+        assert float(sess.run(out, {p: False})) == 1.0
+
+
+def test_while_loop():
+    i0 = stf.constant(0)
+    s0 = stf.constant(0)
+    i, s = stf.while_loop(lambda i, s: stf.less(i, 10),
+                          lambda i, s: (i + 1, s + i), (i0, s0))
+    with stf.Session() as sess:
+        iv, sv = sess.run([i, s])
+        assert int(iv) == 10
+        assert int(sv) == 45
+
+
+def test_random_reproducible_with_seed():
+    stf.set_random_seed(42)
+    r = stf.random_normal([4], seed=7)
+    with stf.Session() as sess:
+        a = sess.run(r)
+        b = sess.run(r)
+    # different step keys -> different draws across runs
+    assert not np.allclose(a, b)
+    stf.reset_default_graph()
+    stf.set_random_seed(42)
+    r2 = stf.random_normal([4], seed=7)
+    with stf.Session() as sess2:
+        a2 = sess2.run(r2)
+    np.testing.assert_allclose(a, a2)
+
+
+def test_control_dependencies_ordering():
+    v = stf.Variable(0.0, name="v")
+    a1 = v.assign_add(1.0)
+    with stf.control_dependencies([a1]):
+        a2 = v.assign(v.read_value() * 10.0)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sess.run(a2)
+        assert float(sess.run(v)) == 10.0
+
+
+def test_dropout_grad_mask_consistency():
+    x = stf.placeholder(stf.float32, [100])
+    y = stf.nn.dropout(x, keep_prob=0.5)
+    (dx,) = stf.gradients(stf.reduce_sum(y), [x])
+    with stf.Session() as sess:
+        xv = np.ones(100, np.float32)
+        yv, dxv = sess.run([y, dx], {x: xv})
+        # gradient mask must equal the forward mask
+        np.testing.assert_allclose((yv > 0).astype(np.float32) * 2.0, dxv)

@@ -1,0 +1,18 @@
+import numpy as np
+
+
+def test_import():
+    import simple_tensorflow_tpu as stf
+
+    assert stf.float32.name == "float32"
+
+
+def test_constant_session():
+    import simple_tensorflow_tpu as stf
+
+    stf.reset_default_graph()
+    a = stf.constant(2.0)
+    b = stf.constant(3.0)
+    c = a * b
+    with stf.Session() as sess:
+        assert float(sess.run(c)) == 6.0
