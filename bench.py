@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet training throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = images/sec/chip ÷ 210 (TF-1.0's published ResNet-50 P100
+throughput — the reference's own hardware-era headline, BASELINE.json).
+Also reports MFU against the chip's bf16 peak.
+"""
+
+import json
+import os
+import sys
+import time
+
+# Real chip when available (do NOT clobber PYTHONPATH/JAX_PLATFORMS).
+import numpy as np
+
+
+def detect_peak_flops():
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    # bf16 peak per chip
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v3" in kind:
+        return 123e12
+    if d.platform == "cpu":
+        return 1e12  # placeholder for CI runs
+    return 197e12
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    image_size = int(os.environ.get("BENCH_IMAGE", "224"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        # CI / no-TPU fallback: shrink so the bench still completes.
+        batch = min(batch, 16)
+        image_size = min(image_size, 64)
+        steps = min(steps, 5)
+        warmup = 2
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.models import resnet
+
+    stf.reset_default_graph()
+    m = resnet.resnet50_train_model(batch_size=batch, image_size=image_size,
+                                    dtype=stf.bfloat16, learning_rate=0.1)
+    import jax.numpy as jnp
+
+    images, labels = resnet.synthetic_imagenet(batch, image_size,
+                                               dtype=np.float32)
+    # Stage the batch in HBM once: the bench measures the training step, not
+    # host->device tunnel bandwidth (real input pipelines double-buffer via
+    # stf.data.prefetch_to_device).
+    images_dev = jnp.asarray(images, dtype=stf.bfloat16.np_dtype)
+    labels_dev = jnp.asarray(labels)
+    feed = {m["images"]: images_dev, m["labels"]: labels_dev}
+
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+
+    t_compile0 = time.perf_counter()
+    for _ in range(warmup):
+        sess.run(m["train_op"], feed_dict=feed)
+    _ = sess.run(m["loss"], feed_dict=feed)  # sync
+    compile_s = time.perf_counter() - t_compile0
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        sess.run(m["train_op"], feed_dict=feed)
+    loss = sess.run(m["loss"], feed_dict=feed)  # blocks on final state
+    dt = time.perf_counter() - t0
+
+    sec_per_step = dt / (steps + 1)
+    images_per_sec = batch / sec_per_step
+    train_flops_per_image = 3.0 * resnet.resnet_flops_per_image(
+        50, image_size)
+    achieved = images_per_sec * train_flops_per_image
+    peak = detect_peak_flops()
+    mfu = achieved / peak
+
+    result = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(float(images_per_sec), 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(float(images_per_sec) / 210.0, 3),
+        "mfu": round(float(mfu), 4),
+        "batch": batch,
+        "image_size": image_size,
+        "sec_per_step": round(sec_per_step, 5),
+        "warmup_plus_compile_s": round(compile_s, 1),
+        "loss": round(float(np.asarray(loss)), 4),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

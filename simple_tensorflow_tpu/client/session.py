@@ -227,10 +227,18 @@ class BaseSession:
         feeds: Dict[Tensor, np.ndarray] = {}
         if not feed_dict:
             return feeds
+        import jax
+
         for k, v in feed_dict.items():
             t = self._graph.as_graph_element(k, allow_tensor=True,
                                              allow_operation=False)
-            if t.dtype.name == "string":
+            if isinstance(v, jax.Array):
+                # Device-resident feed: no host round-trip (input pipelines
+                # stage batches into HBM via data.prefetch_to_device).
+                arr = v if str(v.dtype) == t.dtype.base_dtype.np_dtype.name \
+                    or v.dtype == t.dtype.base_dtype.np_dtype else \
+                    v.astype(t.dtype.base_dtype.np_dtype)
+            elif t.dtype.name == "string":
                 arr = np.asarray(v, dtype=object)
             else:
                 arr = np.asarray(v, dtype=t.dtype.base_dtype.np_dtype)

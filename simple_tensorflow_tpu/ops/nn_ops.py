@@ -89,10 +89,10 @@ def _conv2d_impl(x, w, strides=(1, 1, 1, 1), padding="SAME",
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=(sh, sw), padding=padding,
         rhs_dilation=(dh, dw),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=_acc32(x.dtype))
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # NOTE: no preferred_element_type here — the MXU accumulates bf16 convs
+    # in f32 natively, and an explicit f32 output breaks the vjp transpose
+    # (f32 cotangent vs bf16 weights in lax.conv_general_dilated).
     if data_format == "NCHW":
         out = jnp.transpose(out, (0, 3, 1, 2))
     return out
@@ -112,10 +112,7 @@ def _depthwise_conv2d_impl(x, w, strides=(1, 1, 1, 1), padding="SAME",
         x, w2, window_strides=tuple(strides[1:3]), padding=padding,
         rhs_dilation=tuple(dilations[1:3]),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        feature_group_count=c,
-        preferred_element_type=_acc32(x.dtype))
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        feature_group_count=c)
     if data_format == "NCHW":
         out = jnp.transpose(out, (0, 3, 1, 2))
     return out
@@ -127,8 +124,7 @@ op_registry.register_pure("DepthwiseConv2dNative", _depthwise_conv2d_impl)
 def _conv3d_impl(x, w, strides=(1, 1, 1, 1, 1), padding="SAME"):
     return jax.lax.conv_general_dilated(
         x, w, window_strides=tuple(strides[1:4]), padding=padding,
-        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-        preferred_element_type=_acc32(x.dtype)).astype(x.dtype)
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
 
 
 op_registry.register_pure("Conv3D", _conv3d_impl)

@@ -1,10 +1,18 @@
-"""Test config: force an 8-device virtual CPU mesh (multi-chip sharding
-tests run without TPU hardware; see SURVEY.md §4)."""
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding
+tests run without TPU hardware (SURVEY.md §4), and keep tests off the real
+chip. The axon TPU plugin (sitecustomize in /root/.axon_site) overrides
+JAX_PLATFORMS via jax.config, so we must override the config back, not just
+the env var."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()

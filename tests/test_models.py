@@ -1,0 +1,74 @@
+"""Model zoo smoke tests (tiny configs; mirrors ref model tutorials)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def test_mnist_softmax_trains():
+    from simple_tensorflow_tpu.models import mnist
+
+    m = mnist.softmax_model(learning_rate=0.01)
+    rng = np.random.RandomState(0)
+    images = rng.rand(256, 784).astype(np.float32)
+    w_true = rng.randn(784, 10).astype(np.float32)
+    labels = np.argmax(images @ w_true, axis=1)
+    onehot = np.zeros((256, 10), np.float32)
+    onehot[np.arange(256), labels] = 1.0
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        first = None
+        for _ in range(50):
+            _, l = sess.run([m["train_op"], m["loss"]],
+                            feed_dict={m["x"]: images, m["y_"]: onehot})
+            if first is None:
+                first = l
+        assert l < first * 0.7
+
+
+def test_mnist_convnet_trains():
+    from simple_tensorflow_tpu.models import mnist
+
+    m = mnist.convnet_model(batch_size=16)
+    rng = np.random.RandomState(0)
+    images = rng.rand(16, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, 16).astype(np.int32)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        losses = []
+        for _ in range(10):
+            _, l = sess.run([m["train_op"], m["loss"]],
+                            feed_dict={m["x"]: images, m["y_"]: labels,
+                                       m["keep_prob"]: 0.9})
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+        assert int(np.asarray(sess.run(m["global_step"]))) == 10
+
+
+def test_resnet_tiny_forward_and_step():
+    from simple_tensorflow_tpu.models import resnet
+
+    # batch 4 / 64px keeps late-stage BN statistics sane (batch 2 at 1x1
+    # spatial degenerates BN variance and legitimately explodes gradients)
+    m = resnet.resnet50_train_model(batch_size=4, image_size=64,
+                                    num_classes=10, dtype=stf.float32,
+                                    learning_rate=1e-2)
+    images, labels = resnet.synthetic_imagenet(4, 64)
+    labels = labels % 10
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        _, l1 = sess.run([m["train_op"], m["loss"]],
+                         feed_dict={m["images"]: images,
+                                    m["labels"]: labels})
+        _, l2 = sess.run([m["train_op"], m["loss"]],
+                         feed_dict={m["images"]: images,
+                                    m["labels"]: labels})
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l2 < l1 * 10  # sanity: not exploding
