@@ -23,6 +23,10 @@ from ..ops.rnn import (
     dynamic_rnn, static_rnn, bidirectional_dynamic_rnn, raw_rnn,
 )
 from ..ops import rnn_cell
+from ..ops.fused_ops import (
+    fused_attention, fused_layer_norm, fused_softmax_cross_entropy,
+    quantized_matmul,
+)
 from ..ops.candidate_sampling_ops import (
     uniform_candidate_sampler, log_uniform_candidate_sampler,
     learned_unigram_candidate_sampler, fixed_unigram_candidate_sampler,

@@ -1,0 +1,68 @@
+"""Graph-level entry points for the Pallas fused kernels (stf.nn.fused_*).
+
+Importing this module registers the Pallas-backed op types
+(FlashAttention, FusedLayerNorm, FusedSoftmaxXent, QuantMatMul) with the
+op registry, so Session lowering picks up the fused TPU kernels. It is
+imported from stf.nn, i.e. `import simple_tensorflow_tpu` is enough.
+"""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from ..framework import tensor_shape as shape_mod
+from . import pallas as _pallas  # noqa: F401  (registers the op types)
+
+
+def fused_attention(q, k, v, *, causal=False, sm_scale=None, name=None):
+    """Flash attention over (batch, heads, seq, head_dim) tensors."""
+    g = ops_mod.get_default_graph()
+    q = ops_mod.convert_to_tensor(q)
+    k = ops_mod.convert_to_tensor(k)
+    v = ops_mod.convert_to_tensor(v)
+    op = g.create_op("FlashAttention", [q, k, v],
+                     attrs={"causal": bool(causal), "sm_scale": sm_scale},
+                     name=name or "flash_attention",
+                     output_specs=[(q.shape, q.dtype)])
+    return op.outputs[0]
+
+
+def fused_layer_norm(x, gamma, beta, *, eps=1e-6, name=None):
+    """Fused layer norm over the last axis; gamma/beta: (features,)."""
+    g = ops_mod.get_default_graph()
+    x = ops_mod.convert_to_tensor(x)
+    gamma = ops_mod.convert_to_tensor(gamma)
+    beta = ops_mod.convert_to_tensor(beta)
+    op = g.create_op("FusedLayerNorm", [x, gamma, beta],
+                     attrs={"eps": float(eps)},
+                     name=name or "fused_layer_norm",
+                     output_specs=[(x.shape, x.dtype)])
+    return op.outputs[0]
+
+
+def fused_softmax_cross_entropy(logits, labels, *, name=None):
+    """Fused sparse softmax xent; logits (..., vocab), labels (...,) int."""
+    from ..framework import dtypes as dtypes_mod
+
+    g = ops_mod.get_default_graph()
+    logits = ops_mod.convert_to_tensor(logits)
+    labels = ops_mod.convert_to_tensor(labels)
+    out_shape = (logits.shape[:-1] if logits.shape.rank is not None
+                 else shape_mod.TensorShape(None))
+    op = g.create_op("FusedSoftmaxXent", [logits, labels],
+                     name=name or "fused_softmax_xent",
+                     output_specs=[(out_shape, dtypes_mod.float32)])
+    return op.outputs[0]
+
+
+def quantized_matmul(x, wq, w_scale, *, name=None):
+    """x @ dequant(wq): x (m,k) float, wq (k,n) int8, w_scale (n,) f32."""
+    g = ops_mod.get_default_graph()
+    x = ops_mod.convert_to_tensor(x)
+    wq = ops_mod.convert_to_tensor(wq)
+    w_scale = ops_mod.convert_to_tensor(w_scale)
+    m = x.shape[0] if x.shape.rank is not None else None
+    n = wq.shape[1] if wq.shape.rank is not None else None
+    op = g.create_op("QuantMatMul", [x, wq, w_scale],
+                     name=name or "quant_matmul",
+                     output_specs=[(shape_mod.TensorShape([m, n]), x.dtype)])
+    return op.outputs[0]

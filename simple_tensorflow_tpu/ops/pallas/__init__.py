@@ -1,0 +1,33 @@
+"""Pallas TPU kernels (replaces ref CUDA kernels, core/kernels/*_gpu.cu.cc).
+
+Each kernel is exposed two ways:
+- as a jax-level function (used directly by jax-native model code), and
+- as a registered graph op, so stf graph programs pick up the fused kernel
+  through the normal Session lowering path (`stf.nn.fused_*`).
+
+All kernels auto-switch to interpret mode off-TPU so the CPU test mesh
+exercises identical code paths.
+"""
+
+from ...framework import op_registry
+from .flash_attention import flash_attention, mha_reference
+from .layer_norm import layer_norm, layer_norm_reference
+from .quant_matmul import (quant_matmul, quant_matmul_reference,
+                           quant_matmul_ste, quantize_colwise,
+                           quantize_rowwise)
+from .softmax_xent import (softmax_cross_entropy,
+                           softmax_cross_entropy_reference)
+
+op_registry.register_pure(
+    "FlashAttention",
+    lambda q, k, v, causal=False, sm_scale=None:
+        flash_attention(q, k, v, causal=causal, sm_scale=sm_scale))
+op_registry.register_pure(
+    "FusedLayerNorm",
+    lambda x, gamma, beta, eps=1e-6: layer_norm(x, gamma, beta, eps=eps))
+op_registry.register_pure(
+    "FusedSoftmaxXent",
+    lambda logits, labels: softmax_cross_entropy(logits, labels))
+op_registry.register_pure(
+    "QuantMatMul",
+    lambda x, wq, w_scale: quant_matmul_ste(x, wq, w_scale))

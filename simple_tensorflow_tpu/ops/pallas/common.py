@@ -1,0 +1,41 @@
+"""Shared helpers for the Pallas TPU kernels.
+
+These kernels replace the reference's hand-written CUDA kernels
+(ref: tensorflow/core/kernels/*_gpu.cu.cc) with Mosaic/Pallas programs tiled
+for the MXU/VPU. On non-TPU backends (the CPU test mesh) every kernel runs
+in interpret mode, so numerics tests are backend-independent.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(None)
+def use_interpret() -> bool:
+    """Pallas compiles natively only on TPU; interpret elsewhere."""
+    return jax.default_backend() != "tpu"
+
+
+def round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def pad_dim(x, dim: int, target: int, value=0.0):
+    """Zero-pad dimension ``dim`` of x up to ``target`` (no-op if equal)."""
+    cur = x.shape[dim]
+    if cur == target:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[dim] = (0, target - cur)
+    return jnp.pad(x, pads, constant_values=value)
+
+
+NEG_INF = -1e30  # finite "minus infinity" — avoids NaN from (-inf) - (-inf)

@@ -1,0 +1,350 @@
+"""Flash attention for TPU (Pallas), forward + custom-VJP backward.
+
+Replaces the reference's attention-as-composed-matmuls path (the reference
+has no fused attention; BERT-style models there materialise the [B,H,S,S]
+score matrix through batch_matmul + softmax kernels,
+ref: tensorflow/core/kernels/{batch_matmul_op,softmax_op}.cc). On TPU the
+materialised scores blow HBM bandwidth at long sequence, so we compute
+attention with the FlashAttention-2 online-softmax recurrence, tiled to the
+MXU.
+
+K/V genuinely stream: the grid's innermost dimension walks K/V blocks (TPU
+grids execute sequentially per core), the online-softmax state (m, l, acc)
+lives in VMEM scratch across those iterations, and the output block flushes
+on the last one. VMEM per program is O(block_q*d + block_k*d) independent of
+sequence length. Causally-dead blocks are predicated off with pl.when.
+
+Matmul policy: operands stay in the input dtype (bf16 runs the MXU at
+native rate), accumulation is f32 via preferred_element_type, and
+Precision.HIGHEST stops XLA from demoting f32 operands to bf16 passes.
+The probability matrix is cast back to the input dtype for the P·V and
+dS-type matmuls (standard FlashAttention practice).
+
+Layout: (batch, heads, seq, head_dim), bf16/f32 in, f32 accumulation.
+The wrapper pads seq to the block size and head_dim to the 128-lane width;
+padded keys are masked in-kernel against the true KV length (static), so
+softmax stays NaN-free. Per-row stats (m, l, lse, delta) are kept as
+(rows, 1) tiles — Mosaic requires sublane×lane-legal block shapes.
+
+Backward follows FlashAttention-2: recompute P block-wise from (Q,K,lse),
+dV = P^T dO, dP = dO V^T, dS = P * (dP - delta), dQ = dS K, dK = dS^T Q,
+with delta = rowsum(dO * O) precomputed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import NEG_INF, cdiv, pad_dim, round_up, use_interpret
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+LANE = 128
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _dot(a, b, contract):
+    """dot_general with f32 accumulation. contract=((a_dims),(b_dims)).
+    f32 operands get Precision.HIGHEST (stops XLA demoting them to bf16
+    MXU passes); bf16 operands run the MXU natively — Mosaic rejects an
+    fp32 contract precision on bf16 inputs."""
+    precision = _HI if a.dtype == jnp.float32 else None
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=(contract, ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+
+
+def _score_mask(s, qi, kb, block_q, block_k, kv_true, causal):
+    """Apply KV-length and causal masking to a (block_q, block_k) score
+    tile for Q block qi / K block kb. Single source of truth for fwd+bwd."""
+    shape = (s.shape[0], s.shape[1])
+    span_q = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    span_k = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = span_k < kv_true
+    if causal:
+        mask = mask & (span_q >= span_k)
+    return jnp.where(mask, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel: grid (bh, q_blocks, k_blocks), innermost streams K/V
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, kv_true, num_kb):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # A block contributes unless it is wholly above the causal diagonal.
+    live = ((qi + 1) * block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = _dot(q, k, ((1,), (1,))) * sm_scale        # (block_q, block_k)
+        s = _score_mask(s, qi, kb, block_q, block_k, kv_true, causal)
+
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)                # (block_q, 1)
+        m_scr[:] = m_new
+        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + _dot(
+            p.astype(v.dtype), v, ((1,), (0,)))
+
+    @pl.when(kb == num_kb - 1)
+    def _():
+        l_safe = jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[:] = m_scr[:] + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
+    bh, q_len, d = q.shape
+    kv_pad_len = k.shape[1]
+    num_kb = cdiv(kv_pad_len, block_k)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               kv_true=kv_true, num_kb=num_kb)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, cdiv(q_len, block_q), num_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * bh * q_len * kv_true * d * (0.5 if causal else 1.0)),
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=bh * q_len * kv_true),
+        interpret=use_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr, *,
+                     sm_scale, causal, block_q, block_k, kv_true, num_qb):
+    # grid (bh, k_blocks, q_blocks): one K/V block, streaming Q/dO blocks.
+    ki = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = ((qb + 1) * block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _():
+        k = k_ref[:]
+        v = v_ref[:]
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:]                               # (bq, 1)
+        delta = delta_ref[:]
+        s = _dot(q, k, ((1,), (1,))) * sm_scale
+        s = _score_mask(s, qb, ki, block_q, block_k, kv_true, causal)
+        p = jnp.exp(s - lse)                           # (bq, bk) f32
+        pc = p.astype(do.dtype)
+        dv_scr[:] += _dot(pc, do, ((0,), (0,)))        # (bk, d)
+        dp = _dot(do, v, ((1,), (1,)))                 # (bq, bk)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dk_scr[:] += _dot(ds, q, ((0,), (0,)))         # (bk, d)
+
+    @pl.when(qb == num_qb - 1)
+    def _():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k,
+                   kv_true, num_kb):
+    # grid (bh, q_blocks, k_blocks): one Q block, streaming K/V blocks.
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = ((qi + 1) * block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:]
+        delta = delta_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = _dot(q, k, ((1,), (1,))) * sm_scale
+        s = _score_mask(s, qi, kb, block_q, block_k, kv_true, causal)
+        p = jnp.exp(s - lse)
+        dp = _dot(do, v, ((1,), (1,)))
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+        dq_scr[:] += _dot(ds, k, ((1,), (0,)))
+
+    @pl.when(kb == num_kb - 1)
+    def _():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, kv_true, res, g):
+    q, k, v, o, lse = res
+    bh, q_len, d = q.shape
+    kv_pad_len = k.shape[1]
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)                          # (bh, q_len, 1)
+    num_qb = cdiv(q_len, block_q)
+    num_kb = cdiv(kv_pad_len, block_k)
+
+    dkdv = functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale,
+                             causal=causal, block_q=block_q, block_k=block_k,
+                             kv_true=kv_true, num_qb=num_qb)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, kv_pad_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, kv_pad_len, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(q, k, v, g, lse, delta)
+
+    dqk = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            kv_true=kv_true, num_kb=num_kb)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=use_interpret(),
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Fused attention. q,k,v: (batch, heads, seq, head_dim) (kv seq may
+    differ for cross-attention; causal requires equal lengths). Returns
+    (batch, heads, q_seq, head_dim) in q.dtype."""
+    b, h, q_len, d = q.shape
+    kv_len = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if causal and q_len != kv_len:
+        raise ValueError("causal flash attention needs q_len == kv_len")
+
+    align = 8 if use_interpret() else 128
+    block_q = min(block_q, round_up(q_len, align))
+    block_k = min(block_k, round_up(kv_len, align))
+    qp_len = round_up(q_len, block_q)
+    kp_len = round_up(kv_len, block_k)
+    dp = d if use_interpret() else round_up(d, LANE)
+
+    qq = pad_dim(pad_dim(q.reshape(b * h, q_len, d), 1, qp_len), 2, dp)
+    kk = pad_dim(pad_dim(k.reshape(b * h, kv_len, d), 1, kp_len), 2, dp)
+    vv = pad_dim(pad_dim(v.reshape(b * h, kv_len, d), 1, kp_len), 2, dp)
+
+    o = _flash_bhsd(qq, kk, vv, float(sm_scale), bool(causal),
+                    int(block_q), int(block_k), int(kv_len))
+    o = o[:, :q_len, :d].reshape(b, h, q_len, d)
+    return o
+
+
+def mha_reference(q, k, v, *, causal=False, sm_scale=None):
+    """Naive attention in jnp — the numeric reference for tests."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32) * sm_scale,
+                   precision=_HI)
+    if causal:
+        q_len, k_len = s.shape[-2:]
+        mask = jnp.tril(jnp.ones((q_len, k_len), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                      precision=_HI).astype(q.dtype)

@@ -1,0 +1,207 @@
+"""Pallas kernels vs jnp references (interpret mode on the CPU test mesh).
+
+Mirrors the reference's per-kernel numeric tests
+(ref: tensorflow/python/kernel_tests/softmax_op_test.py etc.): forward
+against a naive implementation, backward against jax.grad of the naive one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simple_tensorflow_tpu.ops.pallas import (
+    flash_attention, layer_norm, quant_matmul, softmax_cross_entropy)
+from simple_tensorflow_tpu.ops.pallas.flash_attention import mha_reference
+from simple_tensorflow_tpu.ops.pallas.layer_norm import layer_norm_reference
+from simple_tensorflow_tpu.ops.pallas.quant_matmul import (
+    quant_matmul_reference, quantize_colwise)
+from simple_tensorflow_tpu.ops.pallas.softmax_xent import (
+    softmax_cross_entropy_reference)
+
+
+def rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.key(key), shape, dtype=dtype)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, causal):
+        b, h, s, d = 2, 3, 64, 16
+        q, k, v = (rand(i, (b, h, s, d)) for i in range(3))
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_unaligned_seq_padding(self):
+        b, h, s, d = 1, 2, 50, 16   # 50 not a multiple of block 32
+        q, k, v = (rand(i, (b, h, s, d)) for i in range(3))
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = mha_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_lengths(self):
+        b, h, sq, sk, d = 1, 2, 32, 96, 16
+        q = rand(0, (b, h, sq, d))
+        k = rand(1, (b, h, sk, d))
+        v = rand(2, (b, h, sk, d))
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = mha_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("d", [8, 16])
+    def test_gradients_match_reference(self, causal, d):
+        b, h, s = 1, 2, 32
+        q, k, v = (rand(i, (b, h, s, d)) for i in range(3))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=16, block_k=16)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(mha_reference(q, k, v, causal=causal)))
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4)
+
+    def test_bf16(self):
+        b, h, s, d = 1, 2, 64, 32
+        q, k, v = (rand(i, (b, h, s, d), jnp.bfloat16) for i in range(3))
+        out = flash_attention(q, k, v, causal=True)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out.astype(np.float32),
+                                   ref.astype(np.float32), atol=3e-2)
+
+
+class TestLayerNorm:
+    def test_forward(self):
+        x = rand(0, (4, 6, 128))
+        gamma = rand(1, (128,)) * 0.1 + 1.0
+        beta = rand(2, (128,)) * 0.1
+        out = layer_norm(x, gamma, beta, block_rows=8)
+        ref = layer_norm_reference(x, gamma, beta)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_backward(self):
+        x = rand(0, (16, 64))
+        gamma = rand(1, (64,)) * 0.1 + 1.0
+        beta = rand(2, (64,)) * 0.1
+
+        def f(impl):
+            def loss(x, g, b):
+                return jnp.sum(jnp.tanh(impl(x, g, b)))
+            return jax.grad(loss, argnums=(0, 1, 2))(x, gamma, beta)
+
+        g1 = f(lambda x, g, b: layer_norm(x, g, b, block_rows=8))
+        g2 = f(layer_norm_reference)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(a, b_, atol=1e-5, rtol=1e-4)
+
+    def test_unaligned_rows(self):
+        x = rand(0, (13, 32))   # 13 rows not a multiple of block 8
+        gamma = jnp.ones((32,))
+        beta = jnp.zeros((32,))
+        out = layer_norm(x, gamma, beta, block_rows=8)
+        ref = layer_norm_reference(x, gamma, beta)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_mixed_param_dtypes_backward(self):
+        # cotangent dtypes must match each primal's dtype
+        x = rand(0, (16, 64), jnp.bfloat16)
+        gamma = jnp.ones((64,), jnp.bfloat16)
+        beta = jnp.zeros((64,), jnp.float32)
+        dx, dg, db = jax.grad(
+            lambda x, g, b: jnp.sum(
+                layer_norm(x, g, b, block_rows=8).astype(jnp.float32)),
+            argnums=(0, 1, 2))(x, gamma, beta)
+        assert dx.dtype == jnp.bfloat16
+        assert dg.dtype == jnp.bfloat16
+        assert db.dtype == jnp.float32
+
+
+class TestSoftmaxXent:
+    def test_forward(self):
+        logits = rand(0, (24, 512)) * 3
+        labels = jax.random.randint(jax.random.key(1), (24,), 0, 512)
+        out = softmax_cross_entropy(logits, labels, block_rows=8)
+        ref = softmax_cross_entropy_reference(logits, labels)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_backward(self):
+        logits = rand(0, (8, 128))
+        labels = jax.random.randint(jax.random.key(1), (8,), 0, 128)
+
+        g1 = jax.grad(lambda l: jnp.sum(
+            softmax_cross_entropy(l, labels, block_rows=8)))(logits)
+        g2 = jax.grad(lambda l: jnp.sum(
+            softmax_cross_entropy_reference(l, labels)))(logits)
+        np.testing.assert_allclose(g1, g2, atol=1e-5, rtol=1e-4)
+
+    def test_batch_dims(self):
+        logits = rand(0, (2, 5, 64))
+        labels = jax.random.randint(jax.random.key(1), (2, 5), 0, 64)
+        out = softmax_cross_entropy(logits, labels)
+        assert out.shape == (2, 5)
+        ref = softmax_cross_entropy_reference(logits, labels)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+class TestQuantMatmul:
+    def test_matches_reference_quantization(self):
+        x = rand(0, (48, 64))
+        w = rand(1, (64, 96))
+        wq, ws = quantize_colwise(w)
+        out = quant_matmul(x, wq, ws)
+        ref = quant_matmul_reference(x, wq, ws)
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+    def test_straight_through_gradient(self):
+        from simple_tensorflow_tpu.ops.pallas import quant_matmul_ste
+
+        x = rand(0, (16, 32))
+        w = rand(1, (32, 24))
+        wq, ws = quantize_colwise(w)
+        c = rand(2, (16, 24))   # fixed cotangent weighting (linear loss)
+        dx = jax.grad(lambda x: jnp.sum(
+            quant_matmul_ste(x, wq, ws) * c))(x)
+        # STE: dx must equal the dense-matmul gradient under the same
+        # cotangent (quantization rounding contributes no derivative)
+        wd = wq.astype(jnp.float32) * ws[None, :]
+        dx_ref = jax.grad(lambda x: jnp.sum((x @ wd) * c))(x)
+        np.testing.assert_allclose(dx, dx_ref, atol=1e-5, rtol=1e-5)
+
+    def test_close_to_float_matmul(self):
+        x = rand(0, (32, 128))
+        w = rand(1, (128, 64))
+        wq, ws = quantize_colwise(w)
+        out = quant_matmul(x, wq, ws)
+        ref = x @ w
+        # int8 dynamic quantization error budget
+        err = jnp.abs(out - ref).max() / (jnp.abs(ref).max() + 1e-9)
+        assert err < 0.05, float(err)
+
+
+class TestGraphOps:
+    def test_flash_attention_graph_op(self):
+        import simple_tensorflow_tpu as stf
+
+        stf.reset_default_graph()
+        arrays = [np.asarray(rand(i, (1, 2, 32, 16))) for i in range(3)]
+        out_t = stf.nn.fused_attention(*(stf.constant(a) for a in arrays),
+                                       causal=True)
+        sess = stf.Session()
+        out = sess.run(out_t)
+        ref = mha_reference(*arrays, causal=True)
+        np.testing.assert_allclose(out, np.asarray(ref), atol=2e-5)
+
+    def test_fused_ops_registered_on_package_import(self):
+        import simple_tensorflow_tpu  # noqa: F401
+        from simple_tensorflow_tpu.framework import op_registry
+
+        for op_type in ("FlashAttention", "FusedLayerNorm",
+                        "FusedSoftmaxXent", "QuantMatMul"):
+            assert op_registry.is_registered(op_type), op_type
