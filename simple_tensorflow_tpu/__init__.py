@@ -97,6 +97,13 @@ from .ops.clip_ops import (
     global_norm,
 )
 from .ops.logging_ops import Print, Assert
+from .ops.init_ops import (
+    zeros_initializer, ones_initializer, constant_initializer,
+    random_uniform_initializer, random_normal_initializer,
+    truncated_normal_initializer, uniform_unit_scaling_initializer,
+    orthogonal_initializer, variance_scaling_initializer,
+    glorot_uniform_initializer, glorot_normal_initializer,
+)
 from .ops.functional_ops import map_fn, scan, foldl, foldr
 from .ops.variable_scope import (
     variable_scope, get_variable, get_variable_scope, VariableScope,

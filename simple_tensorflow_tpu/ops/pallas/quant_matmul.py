@@ -106,10 +106,17 @@ def _qmm_ste_fwd(x, wq, w_scale):
 
 def _qmm_ste_bwd(res, g):
     x, wq, w_scale = res
+    gf = g.astype(jnp.float32)
     wd = wq.astype(jnp.float32) * w_scale[None, :].astype(jnp.float32)
-    dx = (g.astype(jnp.float32) @ wd.T).astype(x.dtype)
+    dx = (gf @ wd.T).astype(x.dtype)
     d_wq = np.zeros(wq.shape, dtype=jax.dtypes.float0)  # int8: no tangent
-    d_scale = jnp.zeros_like(w_scale)
+    # y[m,n] = acc[m,n] * x_scale[m] * w_scale[n]  (acc = xq @ wq, int32)
+    # => d w_scale[n] = sum_m g[m,n] * acc[m,n] * x_scale[m]
+    xq, x_scale = quantize_rowwise(x)
+    acc = jnp.dot(xq.astype(jnp.int32), wq.astype(jnp.int32))
+    d_scale = jnp.sum(gf * acc.astype(jnp.float32)
+                      * x_scale[:, None].astype(jnp.float32), axis=0
+                      ).astype(w_scale.dtype)
     return dx, d_wq, d_scale
 
 

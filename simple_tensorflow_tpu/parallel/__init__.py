@@ -12,3 +12,13 @@ from .collectives import (
     axis_index, broadcast, shard_map,
 )
 from .data_parallel import DataParallel
+from .tensor_parallel import (
+    TensorParallel, column_parallel_dense, row_parallel_dense,
+)
+from .fsdp import FSDP
+from .pipeline import pipeline, pipeline_p
+from .ring_attention import ring_attention, ring_attention_p
+from .sequence_parallel import (
+    sequence_parallel_attention, ulysses_attention_p,
+)
+from .failure_detection import Heartbeat, StepWatchdog, barrier

@@ -68,6 +68,20 @@ def _auto_spec_for(shape, rule, mesh):
     return P(*spec)
 
 
+def auto_shard_variable(variable, axis, min_size=2 ** 14, dim=None,
+                        mesh=None):
+    """Shard ``variable`` over ``axis`` on its largest divisible dim (ZeRO
+    layout); no-op for small/indivisible shapes. Public entry used by
+    FSDP.shard_existing and the scope rule."""
+    mesh = mesh or current_mesh()
+    spec = _auto_spec_for(variable.shape.as_list(),
+                          {"axis": axis, "min_size": min_size, "dim": dim},
+                          mesh)
+    if spec is not None:
+        variable.set_sharding(spec)
+    return variable
+
+
 def maybe_apply_variable_sharding(variable):
     """Called by Variable.__init__; applies the active scope rule."""
     g = variable.graph

@@ -26,7 +26,7 @@ from ..framework import graph as ops_mod
 from ..framework import lowering as lowering_mod
 from ..framework import op_registry
 from ..framework import tensor_shape as shape_mod
-from .mesh import current_mesh, P, PartitionSpec
+from .mesh import current_mesh, get_shard_map, P, PartitionSpec
 
 
 def _axis_tuple(axis):
@@ -271,11 +271,7 @@ def _lower_shard_map(ctx, op, inputs):
                                              caps)
         return builtins.tuple(outs)
 
-    try:
-        from jax import shard_map as _shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _shard_map
-
+    _shard_map = get_shard_map()
     fn = _shard_map(body, mesh=mesh.jax_mesh, in_specs=in_specs,
                     out_specs=out_specs if len(out_specs) > 1
                     else out_specs[0], check_vma=False)

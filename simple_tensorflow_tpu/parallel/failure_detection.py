@@ -1,0 +1,146 @@
+"""Failure detection: heartbeats + step-barrier timeout.
+
+The reference detects worker failure through grpc channel state and session
+management (ref: core/distributed_runtime/{session_mgr,worker_session}.cc,
+master keeps per-worker leases); a dead worker surfaces as
+``UnavailableError`` on the next Send/Recv. A TPU SPMD program has no
+per-op RPCs to time out — a lost host simply hangs the next collective. So
+failure detection is a *host-side* concern: a heartbeat thread stamps
+progress, a watchdog raises ``UnavailableError`` / ``DeadlineExceededError``
+when a step (one jitted program, collectives included) exceeds its
+deadline, and a cross-host barrier with timeout verifies all processes are
+alive at checkpoints/startup.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..framework.errors import DeadlineExceededError, UnavailableError
+
+
+class Heartbeat:
+    """Background thread stamping liveness; ``check(peer_ts, max_age)``
+    classifies a peer's last-seen stamp (multi-host: exchange stamps through
+    the coordination service / shared filesystem). Stamps use ``time.time()``
+    — monotonic clocks have per-boot epochs and cannot be compared across
+    hosts."""
+
+    def __init__(self, interval_secs: float = 10.0):
+        self.interval_secs = interval_secs
+        self._last = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        def run():
+            while not self._stop.wait(self.interval_secs):
+                self._last = time.time()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="stf-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=1.0)
+
+    @property
+    def last_beat(self) -> float:
+        return self._last
+
+    def beat(self):
+        self._last = time.time()
+
+    def check(self, peer_last_beat: float, max_age_secs: float):
+        age = time.time() - peer_last_beat
+        if age > max_age_secs:
+            raise UnavailableError(
+                None, None,
+                f"peer heartbeat is {age:.1f}s old (limit {max_age_secs}s) "
+                "— worker presumed dead")
+
+
+class StepWatchdog:
+    """Raises in the main thread's stead if a training step wall-clock
+    exceeds ``deadline_secs`` (hung collective = lost peer). Usage::
+
+        wd = StepWatchdog(deadline_secs=300).start()
+        for _ in range(steps):
+            sess.run(train_op); wd.step_done()
+        wd.stop()
+    """
+
+    def __init__(self, deadline_secs: float = 300.0,
+                 on_timeout: Optional[Callable[[float], None]] = None,
+                 poll_secs: float = 1.0):
+        self.deadline_secs = deadline_secs
+        self.poll_secs = poll_secs
+        self.on_timeout = on_timeout
+        self._last_step = time.monotonic()
+        self._stop = threading.Event()
+        self._timed_out = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        def run():
+            while not self._stop.wait(self.poll_secs):
+                stalled = time.monotonic() - self._last_step
+                if stalled > self.deadline_secs:
+                    self._timed_out.set()
+                    if self.on_timeout is not None:
+                        self.on_timeout(stalled)
+                    return
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="stf-step-watchdog")
+        self._thread.start()
+        return self
+
+    def step_done(self):
+        """Call after every completed step; raises if the watchdog fired."""
+        self._last_step = time.monotonic()
+        if self._timed_out.is_set():
+            raise DeadlineExceededError(
+                None, None,
+                f"training step exceeded {self.deadline_secs}s deadline — "
+                "a peer host is presumed unavailable (hung collective)")
+
+    @property
+    def timed_out(self) -> bool:
+        return self._timed_out.is_set()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=1.0)
+
+
+def barrier(name: str = "barrier", timeout_secs: float = 600.0):
+    """Cross-host barrier: all processes must arrive within the timeout.
+    Single-process: no-op. Multi-host: a psum of 1 over all devices (the
+    cheapest all-participant collective), bounded by a watchdog."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    def run():
+        import jax.numpy as jnp
+
+        # All-participant psum: returns only once every host has joined.
+        jax.device_get(
+            jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+                jnp.ones((jax.local_device_count(),))))
+
+    t = threading.Thread(target=run, daemon=True, name=f"stf-{name}")
+    t.start()
+    t.join(timeout=timeout_secs)
+    if t.is_alive():
+        raise DeadlineExceededError(
+            None, None,
+            f"barrier {name!r} timed out after {timeout_secs}s — "
+            "not all hosts arrived (worker presumed unavailable)")

@@ -105,6 +105,16 @@ def current_mesh() -> Optional[Mesh]:
     return st[-1] if st else None
 
 
+def get_shard_map():
+    """jax.shard_map across the supported JAX versions (renamed from
+    jax.experimental.shard_map; check_rep became check_vma)."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
 def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     return Mesh(axes, devices=devices)
 

@@ -1,0 +1,152 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+The reference pipelines by placing layer subsets on different workers with
+``tf.device`` and letting grpc Send/Recv stream activations
+(ref: core/distributed_runtime partition + core/kernels/sendrecv_ops.cc);
+there is no microbatch schedule, so utilisation collapses with depth. The
+TPU version runs the schedule *inside one SPMD program*: every chip along
+the 'pp' axis executes the same scan; at step t chip s processes microbatch
+t-s (a skew of the GPipe schedule), and ``lax.ppermute`` hands activations
+to the next stage over ICI. Bubble fraction is (n_stages-1)/(n_micro +
+n_stages-1); XLA overlaps the permute with the next microbatch's compute.
+
+Constraint (round 1): every stage maps activations of one shape to the same
+shape (equal-width pipeline), the standard transformer-block case.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from ..framework import lowering as lowering_mod
+from .mesh import current_mesh, get_shard_map
+
+
+def pipeline_p(fn, stage_params, microbatches, axis_name):
+    """Per-shard GPipe schedule, for use inside ``shard_map``.
+
+    fn(stage_params, x) -> y with y.shape == x.shape.
+    stage_params: this stage's param pytree (stage dim already sliced off).
+    microbatches: (n_micro, mb, ...) — replicated across the pp axis.
+    Returns (n_micro, mb, ...), identical on every chip (psum broadcast of
+    the last stage's outputs).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def step(carry, t):
+        state, outputs = carry
+        inject = microbatches[jnp.minimum(t, n_micro - 1)]
+        state = jnp.where(stage == 0, inject, state)
+        y = fn(stage_params, state)
+        out_idx = t - (n_stages - 1)
+        is_out = (stage == n_stages - 1) & (out_idx >= 0)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(is_out, y, jax.lax.dynamic_index_in_dim(
+                outputs, jnp.maximum(out_idx, 0), 0, keepdims=False)),
+            jnp.maximum(out_idx, 0), 0)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+    (state, outputs), _ = jax.lax.scan(
+        step, (state0, out0), jnp.arange(n_micro + n_stages - 1))
+    # Only the last stage holds real outputs; broadcast them to all chips.
+    outputs = jax.lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs
+
+
+# ---------------------------------------------------------------------------
+# Graph op
+# ---------------------------------------------------------------------------
+
+def _lower_pipeline(ctx, op, inputs):
+    mesh = current_mesh()
+    axis = op.attrs["axis"]
+    n_micro = op.attrs["n_microbatches"]
+    fg = op.attrs["body"]
+    n_params = op.attrs["n_params"]
+    params = inputs[:n_params]
+    x = inputs[n_params]
+    caps = list(inputs[n_params + 1:])
+
+    if mesh is None or axis not in mesh.shape:
+        raise ValueError(f"pipeline requires a Mesh with axis {axis!r}")
+    n_stages = mesh.axis_size(axis)
+
+    batch = x.shape[0]
+    if batch % n_micro != 0:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"n_microbatches {n_micro}")
+    mb = batch // n_micro
+    x_micro = x.reshape((n_micro, mb) + x.shape[1:])
+
+    def body_fn(stage_params, state):
+        outs = lowering_mod.lower_func_graph(
+            ctx, fg, list(stage_params) + [state], caps)
+        return outs[0]
+
+    def shard_fn(*args):
+        ps = [jnp.squeeze(p, 0) for p in args[:n_params]]
+        return pipeline_p(lambda sp, s: body_fn(sp, s), ps, args[n_params],
+                          axis)
+
+    from jax.sharding import PartitionSpec as JP
+
+    _shard_map = get_shard_map()
+    in_specs = tuple(JP(axis) for _ in range(n_params)) + (JP(),)
+    fn = _shard_map(shard_fn, mesh=mesh.jax_mesh, in_specs=in_specs,
+                    out_specs=JP(), check_vma=False)
+    out = fn(*params, x_micro)
+    return [out.reshape((batch,) + out.shape[2:])]
+
+
+op_registry.register("Pipeline", lower=_lower_pipeline)
+
+
+def pipeline(stage_fn, params, x, *, n_microbatches, axis="pp", name=None):
+    """Graph op: run ``stage_fn`` as an n_stage pipeline over mesh axis
+    ``axis`` with the GPipe microbatch schedule.
+
+    stage_fn(*stage_params, x) -> y builds the per-stage computation as
+    graph ops (y.shape == x.shape). ``params`` are tensors/variables whose
+    leading dim is n_stages (stacked per-stage weights, sharded over the
+    axis). ``x``: (batch, ...) with batch divisible by n_microbatches.
+    """
+    from ..ops.functional_ops import _build_fn_graph
+
+    mesh = current_mesh()
+    if mesh is None or axis not in mesh.shape:
+        raise ValueError(f"pipeline requires a Mesh with axis {axis!r}")
+
+    params = [ops_mod.convert_to_tensor(p) for p in params]
+    x = ops_mod.convert_to_tensor(x)
+    for p in params:
+        if p.shape.rank is None or p.shape[0].value != mesh.axis_size(axis):
+            raise ValueError(
+                f"stacked param {p} must have leading dim == n_stages "
+                f"({mesh.axis_size(axis)})")
+
+    arg_specs = ([(p.shape.as_list()[1:], p.dtype) for p in params]
+                 + [([x.shape[0].value // n_microbatches]
+                     + x.shape.as_list()[1:], x.dtype)])
+    fg, _ = _build_fn_graph(lambda *a: stage_fn(*a), arg_specs,
+                            "pipeline_stage")
+    caps = [outer for outer, _ in fg.captures]
+    g = ops_mod.get_default_graph()
+    node = g.create_op(
+        "Pipeline", params + [x] + caps,
+        attrs={"body": fg, "axis": axis, "n_microbatches": int(n_microbatches),
+               "n_params": len(params)},
+        name=name or "pipeline", output_specs=[(x.shape, x.dtype)])
+    return node.outputs[0]

@@ -1,0 +1,94 @@
+"""All-to-all ("Ulysses"-style) sequence parallelism.
+
+Complement to ring attention (ring_attention.py): instead of rotating K/V
+around the ICI ring, a single ``lax.all_to_all`` reshards activations from
+sequence-sharded to head-sharded, full attention runs locally on each
+chip's head group (flash kernel), and a second all-to-all reshards back.
+Two collectives per attention instead of n ppermute hops — wins when
+heads % axis_size == 0 and sequence is long but fits per-head.
+
+The reference's only analogue is the grpc all-to-all implied by its graph
+partitioning (ref: core/distributed_runtime); there is no sequence-parallel
+attention in TF-1.0 — this is capability the TPU rebuild adds to hit the
+long-context requirement.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..ops.pallas.flash_attention import flash_attention, mha_reference
+from .mesh import current_mesh, get_shard_map
+
+
+def ulysses_attention_p(q, k, v, axis_name, *, causal=False, sm_scale=None,
+                        use_flash=True):
+    """Per-shard all-to-all attention, for use inside ``shard_map``.
+
+    q, k, v: (B, H, S_local, D) with the sequence dim sharded over
+    ``axis_name`` and H divisible by the axis size. Returns the local
+    (B, H, S_local, D) output shard.
+    """
+    h = q.shape[1]
+    n = jax.lax.psum(1, axis_name)
+    if h % n != 0:
+        raise ValueError(f"heads ({h}) must divide by axis size ({n})")
+
+    def to_heads(x):   # (B, H, S/n, D) -> (B, H/n, S, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def to_seq(x):     # (B, H/n, S, D) -> (B, H, S/n, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    attn = flash_attention if use_flash else mha_reference
+    oh = attn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return to_seq(oh)
+
+
+def _lower_ulysses(ctx, op, inputs):
+    mesh = current_mesh()
+    axis = op.attrs["axis"]
+    causal = op.attrs["causal"]
+    sm_scale = op.attrs["sm_scale"]
+    q, k, v = inputs
+    if ctx.in_shard_map:
+        return [ulysses_attention_p(q, k, v, axis, causal=causal,
+                                    sm_scale=sm_scale)]
+    if mesh is None or axis not in mesh.shape or mesh.axis_size(axis) == 1:
+        return [flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)]
+
+    from jax.sharding import PartitionSpec as JP
+
+    _shard_map = get_shard_map()
+    spec = JP(None, None, axis, None)
+    fn = _shard_map(
+        functools.partial(ulysses_attention_p, axis_name=axis, causal=causal,
+                          sm_scale=sm_scale),
+        mesh=mesh.jax_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return [fn(q, k, v)]
+
+
+op_registry.register("UlyssesAttention", lower=_lower_ulysses)
+
+
+def sequence_parallel_attention(q, k, v, *, axis="sp", causal=False,
+                                sm_scale=None, name=None):
+    """Graph op: all-to-all sequence-parallel attention over ``axis``."""
+    q = ops_mod.convert_to_tensor(q)
+    k = ops_mod.convert_to_tensor(k)
+    v = ops_mod.convert_to_tensor(v)
+    g = ops_mod.get_default_graph()
+    node = g.create_op(
+        "UlyssesAttention", [q, k, v],
+        attrs={"axis": axis, "causal": bool(causal),
+               "sm_scale": None if sm_scale is None else float(sm_scale)},
+        name=name or "ulysses_attention", output_specs=[(q.shape, q.dtype)])
+    return node.outputs[0]

@@ -1,0 +1,102 @@
+"""Megatron-style tensor parallelism as sharding annotations.
+
+The reference's model parallelism is manual graph partitioning with
+``tf.device`` per layer plus Send/Recv at the cut edges
+(ref: core/distributed_runtime graph partitioning,
+core/common_runtime/simple_placer.cc). On TPU the same layout is a pair of
+sharding annotations and XLA GSPMD inserts the (reduce-scatter/all-gather)
+collectives over ICI:
+
+  column-parallel dense: W sharded (in, tp) — output hidden dim sharded;
+  row-parallel dense:    W sharded (tp, out) — contracting dim sharded,
+                         XLA emits the psum that Megatron calls g/f.
+
+``column_parallel_dense`` / ``row_parallel_dense`` build the classic pair;
+``TensorParallel.shard_dense_pair`` retrofits existing Variables.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..framework import graph as ops_mod
+from . import api as api_mod
+from .mesh import Mesh, P, current_mesh
+
+
+def column_parallel_dense(x, units, *, axis="tp", activation=None,
+                          use_bias=True, kernel_initializer=None, name=None):
+    """y = act(x @ W + b) with W sharded (None, axis): hidden-sharded out."""
+    from ..ops import init_ops, math_ops, variables as vars_mod
+
+    in_dim = int(x.shape[-1])
+    init = kernel_initializer or init_ops.glorot_uniform_initializer()
+    with ops_mod.name_scope(name or "column_parallel_dense"):
+        w = vars_mod.Variable(init([in_dim, units], dtype=x.dtype),
+                              name="kernel")
+        api_mod.shard_variable(w, None, axis)
+        y = math_ops.matmul(x, w)
+        if use_bias:
+            b = vars_mod.Variable(init_ops.zeros_initializer()(
+                [units], dtype=x.dtype), name="bias")
+            api_mod.shard_variable(b, axis)
+            y = y + b
+        rank = y.shape.rank or 2
+        y = api_mod.with_sharding_constraint(
+            y, *([None] * (rank - 1) + [axis]))
+        if activation is not None:
+            y = activation(y)
+    return y
+
+
+def row_parallel_dense(x, units, *, axis="tp", activation=None,
+                       use_bias=True, kernel_initializer=None, name=None):
+    """y = act(x @ W + b) with W sharded (axis, None): contracting dim
+    sharded — GSPMD inserts the all-reduce of partial sums."""
+    from ..ops import init_ops, math_ops, variables as vars_mod
+
+    in_dim = int(x.shape[-1])
+    init = kernel_initializer or init_ops.glorot_uniform_initializer()
+    with ops_mod.name_scope(name or "row_parallel_dense"):
+        w = vars_mod.Variable(init([in_dim, units], dtype=x.dtype),
+                              name="kernel")
+        api_mod.shard_variable(w, axis, None)
+        y = math_ops.matmul(x, w)
+        rank = y.shape.rank or 2
+        y = api_mod.with_sharding_constraint(y, *([None] * rank))
+        if use_bias:
+            b = vars_mod.Variable(init_ops.zeros_initializer()(
+                [units], dtype=x.dtype), name="bias")
+            y = y + b
+        if activation is not None:
+            y = activation(y)
+    return y
+
+
+class TensorParallel:
+    """Annotation helper over an existing graph's variables.
+
+    ``shard_dense_pair(w1, w2)`` applies the Megatron column+row layout so
+    the intervening activation never needs a collective; ``shard_heads``
+    shards an attention projection on the head dimension.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, axis: str = "tp"):
+        self.mesh = mesh or current_mesh()
+        if self.mesh is None:
+            raise ValueError("TensorParallel needs a Mesh")
+        self.axis = axis
+
+    def shard_dense_pair(self, up_kernel, down_kernel, up_bias=None):
+        api_mod.shard_variable(up_kernel, None, self.axis)
+        api_mod.shard_variable(down_kernel, self.axis, None)
+        if up_bias is not None:
+            api_mod.shard_variable(up_bias, self.axis)
+        return self
+
+    def shard_heads(self, qkv_kernel, out_kernel):
+        """(d_model, n_heads*d_head) proj sharded on heads; output proj on
+        its contracting dim."""
+        api_mod.shard_variable(qkv_kernel, None, self.axis)
+        api_mod.shard_variable(out_kernel, self.axis, None)
+        return self

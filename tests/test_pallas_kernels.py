@@ -174,6 +174,24 @@ class TestQuantMatmul:
         dx_ref = jax.grad(lambda x: jnp.sum((x @ wd) * c))(x)
         np.testing.assert_allclose(dx, dx_ref, atol=1e-5, rtol=1e-5)
 
+    def test_scale_gradient(self):
+        from simple_tensorflow_tpu.ops.pallas import quant_matmul_ste
+        from simple_tensorflow_tpu.ops.pallas.quant_matmul import (
+            quantize_rowwise)
+
+        x = rand(0, (16, 32))
+        w = rand(1, (32, 24))
+        wq, ws = quantize_colwise(w)
+        c = rand(2, (16, 24))
+        d_ws = jax.grad(lambda s: jnp.sum(
+            quant_matmul_ste(x, wq, s) * c))(ws)
+        # y = (xq@wq) * x_scale ⊗ w_scale — analytic d/dw_scale
+        xq, x_scale = quantize_rowwise(x)
+        acc = (xq.astype(jnp.int32) @ wq.astype(jnp.int32)).astype(
+            jnp.float32)
+        ref = jnp.sum(c * acc * x_scale[:, None], axis=0)
+        np.testing.assert_allclose(d_ws, ref, atol=1e-4, rtol=1e-4)
+
     def test_close_to_float_matmul(self):
         x = rand(0, (32, 128))
         w = rand(1, (128, 64))
