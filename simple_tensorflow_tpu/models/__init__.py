@@ -1,1 +1,16 @@
+"""Model zoo: the reference's baseline configs rebuilt TPU-first.
 
+- mnist: softmax + convnet tutorials (BASELINE config 1)
+- resnet: ResNet-50 v1.5 bf16/NHWC (configs 2-3)
+- bert: BERT-base MLM+NSP pretraining, flash attention (config 4)
+- transformer: Transformer-big WMT en-de seq2seq + beam search (config 5)
+- word2vec: skip-gram NCE tutorial (ref models.BUILD)
+- long_context: ring-attention long-sequence LM (sequence parallel flagship)
+"""
+
+from . import mnist
+from . import resnet
+from . import bert
+from . import transformer
+from . import word2vec
+from . import long_context

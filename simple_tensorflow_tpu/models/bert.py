@@ -1,0 +1,284 @@
+"""BERT-base pretraining: MLM + NSP (BASELINE.json config 4).
+
+(ref: the reference targets "BERT-base pretraining (MonitoredTrainingSession,
+grpc distributed_runtime on pod)".)
+
+TPU-first choices:
+- Flash attention (Pallas, stf.nn.fused_attention) when there is no padding
+  mask; padded batches use an additive-bias attention that XLA fuses. Fixed
+  sequence length (the BERT pretraining setup) keeps every matmul static
+  for the MXU.
+- Fused Pallas LayerNorm, bf16 activations with f32 parameters/statistics.
+- MLM gathers only the masked positions before the vocab projection, so the
+  (positions, vocab) matmul is 20x smaller than a full-sequence projection.
+- Data-parallel out of the box: shard the batch dim over 'dp' (see
+  stf.parallel); tensor-parallel layouts live in stf.parallel.tensor_parallel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu.models import common
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        """For tests: 2 layers, hidden 32."""
+        return BertConfig(vocab_size=99, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64, max_position=64,
+                          hidden_dropout=0.0, attention_dropout=0.0)
+
+
+def _init(cfg):
+    return stf.truncated_normal_initializer(stddev=cfg.initializer_range)
+
+
+def _layer_norm(x, cfg, name):
+    return common.layer_norm(x, name, eps=cfg.layer_norm_eps)
+
+
+def _dense(x, units, cfg, name, activation=None):
+    return common.dense(x, units, _init(cfg), name, activation=activation)
+
+
+def attention_layer(h, attn_bias, cfg, training, compute_dtype, name="attention"):
+    """Multi-head self-attention. attn_bias: additive (B,1,1,S) or None.
+
+    The Pallas flash-attention kernel runs when there is neither a padding
+    bias nor attention dropout to apply (the kernel has no dropout hook);
+    otherwise the standard softmax form (additive bias, f32 softmax,
+    dropout on probs) runs and XLA fuses it.
+    """
+    b = int(h.shape[0])
+    s = int(h.shape[1])
+    hidden = int(h.shape[2])
+    heads = cfg.num_heads
+    hd = hidden // heads
+    use_flash = attn_bias is None and not (training and
+                                           cfg.attention_dropout > 0)
+    with stf.variable_scope(name):
+        q = _dense(h, hidden, cfg, "query")
+        k = _dense(h, hidden, cfg, "key")
+        v = _dense(h, hidden, cfg, "value")
+        q = common.split_heads(q, b, s, heads, hd)
+        k = common.split_heads(k, b, s, heads, hd)
+        v = common.split_heads(v, b, s, heads, hd)
+        if use_flash:
+            ctx = stf.nn.fused_attention(q, k, v, causal=False)
+        else:
+            scores = stf.matmul(q, k, transpose_b=True)
+            scores = stf.cast(scores, stf.float32) / math.sqrt(hd)
+            if attn_bias is not None:
+                scores = scores + attn_bias
+            probs = stf.nn.softmax(scores, axis=-1)
+            if training and cfg.attention_dropout > 0:
+                probs = stf.nn.dropout(probs,
+                                       keep_prob=1.0 - cfg.attention_dropout)
+            ctx = stf.matmul(stf.cast(probs, compute_dtype), v)
+        ctx = common.merge_heads(ctx, b, s, hidden)
+        out = _dense(ctx, hidden, cfg, "output")
+        if training and cfg.hidden_dropout > 0:
+            out = stf.nn.dropout(out, keep_prob=1.0 - cfg.hidden_dropout)
+    return out
+
+
+def transformer_block(h, attn_bias, cfg, training, compute_dtype, name):
+    with stf.variable_scope(name):
+        attn = attention_layer(h, attn_bias, cfg, training, compute_dtype)
+        h = _layer_norm(h + attn, cfg, "ln_attn")
+        ffn = _dense(h, cfg.intermediate_size, cfg, "ffn_in",
+                     activation=stf.nn.gelu)
+        ffn = _dense(ffn, cfg.hidden_size, cfg, "ffn_out")
+        if training and cfg.hidden_dropout > 0:
+            ffn = stf.nn.dropout(ffn, keep_prob=1.0 - cfg.hidden_dropout)
+        h = _layer_norm(h + ffn, cfg, "ln_ffn")
+    return h
+
+
+def bert_encoder(input_ids, token_type_ids, input_mask, cfg,
+                 training=True, compute_dtype=stf.bfloat16,
+                 scope="bert"):
+    """Returns (sequence_output [B,S,H], pooled_output [B,H],
+    word_embeddings [V,H] — for MLM weight tying)."""
+    b = int(input_ids.shape[0])
+    s = int(input_ids.shape[1])
+    with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
+        with stf.variable_scope("embeddings"):
+            word_emb = stf.get_variable(
+                "word_embeddings", [cfg.vocab_size, cfg.hidden_size],
+                initializer=_init(cfg))
+            pos_emb = stf.get_variable(
+                "position_embeddings", [cfg.max_position, cfg.hidden_size],
+                initializer=_init(cfg))
+            type_emb = stf.get_variable(
+                "token_type_embeddings", [cfg.type_vocab_size, cfg.hidden_size],
+                initializer=_init(cfg))
+            h = stf.nn.embedding_lookup(word_emb, input_ids)
+            h = h + stf.nn.embedding_lookup(type_emb, token_type_ids)
+            h = h + stf.reshape(
+                stf.slice(pos_emb, [0, 0], [s, cfg.hidden_size]),
+                [1, s, cfg.hidden_size])
+            h = _layer_norm(h, cfg, "ln")
+            if training and cfg.hidden_dropout > 0:
+                h = stf.nn.dropout(h, keep_prob=1.0 - cfg.hidden_dropout)
+        h = stf.cast(h, compute_dtype)
+
+        if input_mask is not None:
+            # additive bias: 0 where attendable, -1e9 where padded
+            bias = (1.0 - stf.cast(stf.reshape(input_mask, [b, 1, 1, s]),
+                                   stf.float32)) * -1e9
+        else:
+            bias = None
+        with stf.variable_scope("encoder"):
+            for i in range(cfg.num_layers):
+                h = transformer_block(h, bias, cfg, training, compute_dtype,
+                                      name=f"layer_{i}")
+        sequence_output = stf.cast(h, stf.float32)
+        with stf.variable_scope("pooler"):
+            first = stf.squeeze(
+                stf.slice(sequence_output, [0, 0, 0], [-1, 1, cfg.hidden_size]),
+                axis=[1])
+            pooled = _dense(first, cfg.hidden_size, cfg, "dense",
+                            activation=stf.tanh)
+    return sequence_output, pooled, word_emb
+
+
+def _gather_positions(seq_out, positions):
+    """seq_out (B,S,H), positions (B,P) -> (B*P, H)."""
+    b = int(seq_out.shape[0])
+    s = int(seq_out.shape[1])
+    hidden = int(seq_out.shape[2])
+    flat_offsets = stf.reshape(stf.range(0, b) * s, [-1, 1])
+    flat_pos = stf.reshape(positions + flat_offsets, [-1])
+    flat_seq = stf.reshape(seq_out, [-1, hidden])
+    return stf.gather(flat_seq, flat_pos)
+
+
+def mlm_logits(seq_out, positions, word_emb, cfg, scope="cls/predictions"):
+    """Masked-LM logits at ``positions``, vocab matrix tied to word_emb."""
+    with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
+        x = _gather_positions(seq_out, positions)
+        x = _dense(x, cfg.hidden_size, cfg, "transform",
+                   activation=stf.nn.gelu)
+        with stf.variable_scope("transform_ln"):
+            gamma = stf.get_variable("gamma", [cfg.hidden_size],
+                                     initializer=stf.ones_initializer())
+            beta = stf.get_variable("beta", [cfg.hidden_size],
+                                    initializer=stf.zeros_initializer())
+            x = stf.nn.fused_layer_norm(x, gamma, beta, eps=cfg.layer_norm_eps)
+        bias = stf.get_variable("output_bias", [cfg.vocab_size],
+                                initializer=stf.zeros_initializer())
+        logits = stf.matmul(x, word_emb, transpose_b=True) + bias
+    return logits
+
+
+def bert_pretrain_model(batch_size=32, seq_len=128, max_predictions=20,
+                        cfg: BertConfig | None = None, learning_rate=1e-4,
+                        compute_dtype=stf.bfloat16, use_input_mask=False,
+                        data_parallel=False):
+    """Full MLM+NSP pretraining graph (ref BERT pretraining recipe)."""
+    cfg = cfg or BertConfig.base()
+    input_ids = stf.placeholder(stf.int32, [batch_size, seq_len], "input_ids")
+    token_type = stf.placeholder(stf.int32, [batch_size, seq_len],
+                                 "token_type_ids")
+    mlm_positions = stf.placeholder(stf.int32, [batch_size, max_predictions],
+                                    "mlm_positions")
+    mlm_ids = stf.placeholder(stf.int32, [batch_size, max_predictions],
+                              "mlm_ids")
+    mlm_weights = stf.placeholder(stf.float32, [batch_size, max_predictions],
+                                  "mlm_weights")
+    nsp_labels = stf.placeholder(stf.int32, [batch_size], "nsp_labels")
+    feeds = dict(input_ids=input_ids, token_type_ids=token_type,
+                 mlm_positions=mlm_positions, mlm_ids=mlm_ids,
+                 mlm_weights=mlm_weights, nsp_labels=nsp_labels)
+    input_mask = None
+    if use_input_mask:
+        input_mask = stf.placeholder(stf.int32, [batch_size, seq_len],
+                                     "input_mask")
+        feeds["input_mask"] = input_mask
+    if data_parallel:
+        from simple_tensorflow_tpu import parallel
+        mesh = parallel.current_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            for t in feeds.values():
+                parallel.shard_feed(t, "dp")
+
+    seq_out, pooled, word_emb = bert_encoder(
+        input_ids, token_type, input_mask, cfg, training=True,
+        compute_dtype=compute_dtype)
+
+    # MLM loss over masked positions only, weight-normalized
+    logits = mlm_logits(seq_out, mlm_positions, word_emb, cfg)
+    per_ex = stf.nn.fused_softmax_cross_entropy(
+        logits, stf.reshape(mlm_ids, [-1]))
+    w = stf.reshape(mlm_weights, [-1])
+    mlm_loss = stf.reduce_sum(per_ex * w) / (stf.reduce_sum(w) + 1e-5)
+
+    # NSP
+    with stf.variable_scope("cls/seq_relationship", reuse=stf.AUTO_REUSE):
+        nsp_logits = stf.layers.dense(pooled, 2, kernel_initializer=_init(cfg),
+                                      name="dense")
+    nsp_loss = stf.reduce_mean(stf.nn.sparse_softmax_cross_entropy_with_logits(
+        labels=nsp_labels, logits=nsp_logits))
+
+    loss = mlm_loss + nsp_loss
+    gs = stf.train.get_or_create_global_step()
+    opt = stf.train.AdamOptimizer(learning_rate)
+    train_op = opt.minimize(loss, global_step=gs)
+
+    mlm_acc = stf.reduce_sum(stf.cast(stf.equal(
+        stf.cast(stf.argmax(logits, 1, output_type=stf.int32), stf.int32),
+        stf.reshape(mlm_ids, [-1])), stf.float32) * w) / (
+            stf.reduce_sum(w) + 1e-5)
+    return dict(feeds, loss=loss, mlm_loss=mlm_loss, nsp_loss=nsp_loss,
+                train_op=train_op, mlm_accuracy=mlm_acc, global_step=gs)
+
+
+def bert_flops_per_token(cfg: BertConfig, seq_len: int) -> float:
+    """Analytic fwd FLOPs/token (6*params-ish + attention)."""
+    h, L, ffn = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
+    per_layer = 2 * (4 * h * h + 2 * h * ffn)  # qkvo + ffn matmul MACs*2
+    attn = 2 * 2 * seq_len * h  # scores + context per token
+    emb = 2 * h * cfg.vocab_size / seq_len  # amortized mlm head
+    return L * (per_layer + attn) + emb
+
+
+def synthetic_pretrain_batch(batch_size, seq_len, max_predictions,
+                             vocab_size=30522, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "input_ids": rng.randint(0, vocab_size,
+                                 (batch_size, seq_len)).astype(np.int32),
+        "token_type_ids": rng.randint(0, 2,
+                                      (batch_size, seq_len)).astype(np.int32),
+        "mlm_positions": rng.randint(0, seq_len,
+                                     (batch_size, max_predictions)
+                                     ).astype(np.int32),
+        "mlm_ids": rng.randint(0, vocab_size,
+                               (batch_size, max_predictions)).astype(np.int32),
+        "mlm_weights": np.ones((batch_size, max_predictions), np.float32),
+        "nsp_labels": rng.randint(0, 2, batch_size).astype(np.int32),
+    }

@@ -1,0 +1,35 @@
+"""Shared building blocks for the transformer-family models.
+
+One implementation of the fused-LayerNorm wrapper, initializer-threading
+dense, and attention head split/merge used by bert.py, transformer.py and
+long_context.py, so policy changes (e.g. LN param dtype) happen once.
+"""
+
+from __future__ import annotations
+
+import simple_tensorflow_tpu as stf
+
+
+def layer_norm(x, name, eps=1e-6):
+    """gamma/beta (f32) + Pallas fused layer norm over the last axis."""
+    with stf.variable_scope(name):
+        d = int(x.shape[-1])
+        g = stf.get_variable("gamma", [d], initializer=stf.ones_initializer())
+        b = stf.get_variable("beta", [d], initializer=stf.zeros_initializer())
+        return stf.nn.fused_layer_norm(x, g, b, eps=eps)
+
+
+def dense(x, units, initializer, name, activation=None):
+    return stf.layers.dense(x, units, activation=activation,
+                            kernel_initializer=initializer, name=name)
+
+
+def split_heads(x, b, s, heads, head_dim):
+    """(B,S,H*D) -> (B,H,S,D)."""
+    return stf.transpose(stf.reshape(x, [b, s, heads, head_dim]),
+                         [0, 2, 1, 3])
+
+
+def merge_heads(x, b, s, hidden):
+    """(B,H,S,D) -> (B,S,H*D)."""
+    return stf.reshape(stf.transpose(x, [0, 2, 1, 3]), [b, s, hidden])

@@ -72,3 +72,119 @@ def test_resnet_tiny_forward_and_step():
                                     m["labels"]: labels})
         assert np.isfinite(l1) and np.isfinite(l2)
         assert l2 < l1 * 10  # sanity: not exploding
+
+
+def test_bert_tiny_trains():
+    from simple_tensorflow_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    m = bert.bert_pretrain_model(batch_size=4, seq_len=16, max_predictions=4,
+                                 cfg=cfg, compute_dtype=stf.float32,
+                                 learning_rate=1e-3)
+    batch = bert.synthetic_pretrain_batch(4, 16, 4, vocab_size=cfg.vocab_size)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        feed = {m[k]: v for k, v in batch.items()}
+        l0 = sess.run(m["loss"], feed)
+        for _ in range(10):
+            _, l = sess.run([m["train_op"], m["loss"]], feed)
+        assert np.isfinite(l) and l < l0
+
+
+def test_bert_with_input_mask():
+    from simple_tensorflow_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    m = bert.bert_pretrain_model(batch_size=2, seq_len=16, max_predictions=4,
+                                 cfg=cfg, compute_dtype=stf.float32,
+                                 use_input_mask=True)
+    batch = bert.synthetic_pretrain_batch(2, 16, 4, vocab_size=cfg.vocab_size)
+    batch["input_mask"] = np.concatenate(
+        [np.ones((2, 12), np.int32), np.zeros((2, 4), np.int32)], axis=1)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        l = sess.run(m["loss"], {m[k]: v for k, v in batch.items()})
+        assert np.isfinite(l)
+
+
+def test_transformer_tiny_trains():
+    from simple_tensorflow_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig.tiny()
+    m = tr.transformer_train_model(batch_size=4, src_len=8, tgt_len=8,
+                                   cfg=cfg, compute_dtype=stf.float32)
+    batch = tr.synthetic_wmt_batch(4, 8, 8, vocab_size=cfg.vocab_size)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        feed = {m[k]: v for k, v in batch.items() if k in m}
+        l0 = sess.run(m["loss"], feed)
+        for _ in range(15):
+            _, l = sess.run([m["train_op"], m["loss"]], feed)
+        assert np.isfinite(l) and l < l0
+
+
+def test_transformer_beam_search():
+    from simple_tensorflow_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig.tiny()
+    src = stf.placeholder(stf.int32, [2, 8], "src")
+    ids, scores = tr.beam_search_decode(src, cfg, beam_size=3, decode_len=8,
+                                        compute_dtype=stf.float32)
+    batch = tr.synthetic_wmt_batch(2, 8, 8, vocab_size=cfg.vocab_size)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        out_ids, out_scores = sess.run([ids, scores],
+                                       {src: batch["src_ids"]})
+    assert out_ids.shape == (2, 3, 8)
+    assert out_scores.shape == (2, 3)
+    assert (out_ids[:, :, 0] == cfg.eos_id).all()
+    # beams sorted by score
+    assert (np.diff(out_scores, axis=1) <= 1e-5).all()
+
+
+def test_word2vec_trains():
+    from simple_tensorflow_tpu.models import word2vec as w2v
+
+    m = w2v.skipgram_model(vocab_size=100, embedding_size=16, batch_size=8,
+                           num_sampled=4, learning_rate=0.5)
+    xi, yi = w2v.synthetic_skipgram_batch(8, 100)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        feed = {m["train_inputs"]: xi, m["train_labels"]: yi}
+        l0 = sess.run(m["loss"], feed)
+        for _ in range(20):
+            _, l = sess.run([m["train_op"], m["loss"]], feed)
+        assert l < l0
+        sim = w2v.similarity(m["normalized_embeddings"], [1, 2, 3])
+        assert sess.run(sim).shape == (3, 100)
+
+
+def test_long_context_lm_on_sp_mesh():
+    from simple_tensorflow_tpu import parallel
+    from simple_tensorflow_tpu.models import long_context as lc
+
+    cfg = lc.LongContextConfig.tiny()
+    with parallel.Mesh({"dp": 2, "sp": 4}):
+        m = lc.lm_train_model(batch_size=2, seq_len=32, cfg=cfg,
+                              compute_dtype=stf.float32)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            feed_ids, feed_tg = lc.synthetic_lm_batch(2, 32, cfg.vocab_size)
+            feed = {m["input_ids"]: feed_ids, m["targets"]: feed_tg}
+            l0 = sess.run(m["loss"], feed)
+            for _ in range(5):
+                _, l = sess.run([m["train_op"], m["loss"]], feed)
+            assert np.isfinite(l) and l < l0
+
+
+def test_long_context_single_device_fallback():
+    from simple_tensorflow_tpu.models import long_context as lc
+
+    cfg = lc.LongContextConfig.tiny()
+    m = lc.lm_train_model(batch_size=1, seq_len=16, cfg=cfg,
+                          compute_dtype=stf.float32)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        ids, tg = lc.synthetic_lm_batch(1, 16, cfg.vocab_size)
+        l = sess.run(m["loss"], {m["input_ids"]: ids, m["targets"]: tg})
+        assert np.isfinite(l)
