@@ -1,0 +1,89 @@
+// Host arena allocator for staging buffers (ref role:
+// tensorflow/core/common_runtime/bfc_allocator.cc — on TPU, device memory
+// belongs to PJRT/XLA, so the native allocator's job shrinks to host-side
+// staging: pinned-ish aligned buffers the input pipeline fills and JAX
+// device_put consumes; arena reset per batch instead of free-list churn).
+
+#include <cstdint>
+#include <cstdlib>
+#include <vector>
+
+#include "stf_c.h"
+
+namespace {
+constexpr size_t kAlign = 64;  // cacheline; also good for dma staging
+
+size_t RoundUp(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+struct Block {
+  uint8_t* base;
+  size_t size;
+  size_t used;
+};
+}  // namespace
+
+struct StfArena {
+  std::vector<Block> blocks;
+  size_t block_bytes;
+  size_t in_use = 0;
+
+  explicit StfArena(size_t bb) : block_bytes(bb < 4096 ? 4096 : bb) {}
+
+  ~StfArena() {
+    for (auto& b : blocks) free(b.base);
+  }
+
+  void* Alloc(size_t n) {
+    n = RoundUp(n);
+    for (auto& b : blocks) {
+      if (b.size - b.used >= n) {
+        void* p = b.base + b.used;
+        b.used += n;
+        in_use += n;
+        return p;
+      }
+    }
+    // geometric growth so many small allocs don't fragment
+    size_t want = n > block_bytes ? n : block_bytes;
+    if (!blocks.empty()) {
+      size_t doubled = blocks.back().size * 2;
+      if (doubled > want && doubled <= (size_t)1 << 34) want = doubled;
+    }
+    void* base = nullptr;
+    if (posix_memalign(&base, kAlign, want) != 0) return nullptr;
+    blocks.push_back({(uint8_t*)base, want, n});
+    in_use += n;
+    return base;
+  }
+
+  void Reset() {
+    for (auto& b : blocks) b.used = 0;
+    in_use = 0;
+  }
+
+  size_t Reserved() const {
+    size_t r = 0;
+    for (auto& b : blocks) r += b.size;
+    return r;
+  }
+};
+
+extern "C" {
+
+StfArena* StfArenaNew(size_t block_bytes) { return new StfArena(block_bytes); }
+
+void* StfArenaAlloc(StfArena* a, size_t n) { return a ? a->Alloc(n) : nullptr; }
+
+void StfArenaReset(StfArena* a) {
+  if (a) a->Reset();
+}
+
+size_t StfArenaBytesInUse(const StfArena* a) { return a ? a->in_use : 0; }
+
+size_t StfArenaBytesReserved(const StfArena* a) {
+  return a ? a->Reserved() : 0;
+}
+
+void StfArenaDelete(StfArena* a) { delete a; }
+
+}  // extern "C"

@@ -1,0 +1,197 @@
+// C API implementation (ref: tensorflow/c/c_api.cc) — graph
+// construction + GraphDef-JSON serialization, status, version. See
+// stf_c.h for the TPU-native API split rationale.
+
+#include <cstdint>
+#include <cstdio>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "stf_c.h"
+#include "status_internal.h"
+
+
+struct StfNode {
+  std::string op_type;
+  std::string name;
+  std::string device;
+  std::vector<std::pair<StfNode*, int>> inputs;
+  std::vector<StfNode*> control_inputs;
+  // attrs serialized as JSON fragments keyed by name
+  std::vector<std::pair<std::string, std::string>> attrs;
+  // output specs: (dtype name, dims or empty for unknown rank)
+  struct Out {
+    std::string dtype;
+    int rank;
+    std::vector<int64_t> dims;
+  };
+  std::vector<Out> outputs;
+};
+
+struct StfGraph {
+  std::vector<std::unique_ptr<StfNode>> nodes;
+  std::string json;  // serialization buffer
+};
+
+namespace {
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// tensor name "node:i", with ":0" kept explicit (importer accepts both)
+std::string TensorName(StfNode* n, int idx) {
+  return n->name + ":" + std::to_string(idx);
+}
+
+}  // namespace
+
+extern "C" {
+
+StfGraph* StfGraphNew() { return new StfGraph(); }
+
+void StfGraphDelete(StfGraph* g) { delete g; }
+
+StfNode* StfGraphAddNode(StfGraph* g, const char* op_type, const char* name,
+                         StfStatus* status) {
+  for (auto& n : g->nodes) {
+    if (n->name == name) {
+      stf_internal::Set(status, STF_ALREADY_EXISTS,
+                        std::string("duplicate node name ") + name);
+      return nullptr;
+    }
+  }
+  auto node = std::make_unique<StfNode>();
+  node->op_type = op_type;
+  node->name = name;
+  g->nodes.push_back(std::move(node));
+  return g->nodes.back().get();
+}
+
+void StfNodeAddInput(StfNode* n, StfNode* src, int out_index) {
+  n->inputs.emplace_back(src, out_index);
+}
+
+void StfNodeAddControlInput(StfNode* n, StfNode* src) {
+  n->control_inputs.push_back(src);
+}
+
+void StfNodeSetDevice(StfNode* n, const char* device) { n->device = device; }
+
+void StfNodeSetAttrInt(StfNode* n, const char* key, int64_t v) {
+  n->attrs.emplace_back(key, std::to_string(v));
+}
+
+void StfNodeSetAttrFloat(StfNode* n, const char* key, double v) {
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%.17g", v);
+  n->attrs.emplace_back(key, buf);
+}
+
+void StfNodeSetAttrBool(StfNode* n, const char* key, int v) {
+  n->attrs.emplace_back(key, v ? "true" : "false");
+}
+
+void StfNodeSetAttrString(StfNode* n, const char* key, const char* v) {
+  n->attrs.emplace_back(key, "\"" + JsonEscape(v) + "\"");
+}
+
+void StfNodeAddOutput(StfNode* n, const char* dtype, int rank,
+                      const int64_t* dims) {
+  StfNode::Out o;
+  o.dtype = dtype;
+  o.rank = rank;
+  for (int i = 0; i < rank; i++) o.dims.push_back(dims[i]);
+  n->outputs.push_back(std::move(o));
+}
+
+const char* StfNodeName(const StfNode* n) { return n->name.c_str(); }
+
+int64_t StfGraphNumNodes(const StfGraph* g) {
+  return (int64_t)g->nodes.size();
+}
+
+const char* StfGraphToJson(StfGraph* g, size_t* n, StfStatus* status) {
+  (void)status;
+  std::string& out = g->json;
+  out.clear();
+  out += "{\"versions\": {\"producer\": 1}, \"node\": [";
+  bool first_node = true;
+  for (auto& node : g->nodes) {
+    if (!first_node) out += ", ";
+    first_node = false;
+    out += "{\"name\": \"" + JsonEscape(node->name) + "\", \"op\": \"" +
+           JsonEscape(node->op_type) + "\", \"input\": [";
+    for (size_t i = 0; i < node->inputs.size(); i++) {
+      if (i) out += ", ";
+      out += "\"" +
+             JsonEscape(TensorName(node->inputs[i].first,
+                                   node->inputs[i].second)) +
+             "\"";
+    }
+    out += "], \"control_input\": [";
+    for (size_t i = 0; i < node->control_inputs.size(); i++) {
+      if (i) out += ", ";
+      out += "\"" + JsonEscape(node->control_inputs[i]->name) + "\"";
+    }
+    out += "], \"device\": \"" + JsonEscape(node->device) + "\", \"attr\": {";
+    for (size_t i = 0; i < node->attrs.size(); i++) {
+      if (i) out += ", ";
+      out += "\"" + JsonEscape(node->attrs[i].first) +
+             "\": " + node->attrs[i].second;
+    }
+    out += "}, \"output_specs\": [";
+    for (size_t i = 0; i < node->outputs.size(); i++) {
+      if (i) out += ", ";
+      auto& o = node->outputs[i];
+      if (o.rank < 0) {
+        out += "[null, \"" + o.dtype + "\"]";
+      } else {
+        out += "[[";
+        for (int d = 0; d < o.rank; d++) {
+          if (d) out += ", ";
+          out += o.dims[d] < 0 ? "null" : std::to_string(o.dims[d]);
+        }
+        out += "], \"" + o.dtype + "\"]";
+      }
+    }
+    out += "]}";
+  }
+  out += "]}";
+  if (n) *n = out.size();
+  return out.c_str();
+}
+
+const char* StfVersion() { return "stf-runtime 1.0.0"; }
+
+StfStatus* StfNewStatus() { return new StfStatus(); }
+
+void StfDeleteStatus(StfStatus* s) { delete s; }
+
+StfCode StfGetCode(const StfStatus* s) { return s ? s->code : STF_OK; }
+
+const char* StfMessage(const StfStatus* s) {
+  return s ? s->msg.c_str() : "";
+}
+
+}  // extern "C"

@@ -1,0 +1,67 @@
+// Graph pruning + topological sort, and the C-API graph builder.
+// (ref: tensorflow/core/graph/{algorithm,subgraph}.cc — RewriteGraphForExecution
+// prunes to fetch ancestors; here the pruned order feeds one XLA lowering
+// instead of a per-node executor.)
+
+#include <cstdint>
+#include <cstdio>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "stf_c.h"
+#include "status_internal.h"
+
+// ---- flat prune/topo-sort (hot path: called per Session signature) ----
+
+extern "C" int64_t StfPruneToposort(int64_t n_nodes, const int32_t* edges,
+                                    int64_t n_edges, const int32_t* targets,
+                                    int64_t n_targets, int32_t* out_order) {
+  // CSR adjacency: deps[dst] = list of srcs
+  std::vector<int32_t> head(n_nodes, -1), next(n_edges), dst_src(n_edges);
+  for (int64_t e = 0; e < n_edges; e++) {
+    int32_t src = edges[2 * e], dst = edges[2 * e + 1];
+    if (src < 0 || src >= n_nodes || dst < 0 || dst >= n_nodes) return -2;
+    dst_src[e] = src;
+    next[e] = head[dst];
+    head[dst] = (int32_t)e;
+  }
+  // iterative DFS postorder = topo order of dependencies-first
+  std::vector<uint8_t> state(n_nodes, 0);  // 0 unseen, 1 visiting, 2 done
+  std::vector<int32_t> stack_node;
+  std::vector<int32_t> stack_edge;  // current edge cursor per frame
+  int64_t count = 0;
+  for (int64_t t = 0; t < n_targets; t++) {
+    int32_t root = targets[t];
+    if (root < 0 || root >= n_nodes) return -2;
+    if (state[root] == 2) continue;
+    stack_node.push_back(root);
+    stack_edge.push_back(head[root]);
+    state[root] = 1;
+    while (!stack_node.empty()) {
+      int32_t node = stack_node.back();
+      int32_t e = stack_edge.back();
+      bool advanced = false;
+      while (e != -1) {
+        int32_t dep = dst_src[e];
+        e = next[e];
+        if (state[dep] == 0) {
+          stack_edge.back() = e;
+          stack_node.push_back(dep);
+          stack_edge.push_back(head[dep]);
+          state[dep] = 1;
+          advanced = true;
+          break;
+        }
+        if (state[dep] == 1) return -1;  // cycle
+      }
+      if (!advanced) {
+        state[node] = 2;
+        out_order[count++] = node;
+        stack_node.pop_back();
+        stack_edge.pop_back();
+      }
+    }
+  }
+  return count;
+}

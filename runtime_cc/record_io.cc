@@ -1,0 +1,236 @@
+// TFRecord framing + CRC32C (ref: tensorflow/core/lib/io/record_writer.cc,
+// record_reader.cc, core/lib/hash/crc32c.cc).
+//
+// Format per record: [length u64le][masked_crc32c(length) u32le]
+//                    [data][masked_crc32c(data) u32le]
+// CRC32C is slice-by-8 in software (portable across the TPU-host CPUs we
+// run on); gzip containers are handled transparently via zlib's gzFile,
+// which also reads uncompressed files, so one reader serves both.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+#include "stf_c.h"
+#include "status_internal.h"
+
+namespace {
+
+// ---- crc32c (Castagnoli, polynomial 0x82f63b78), slice-by-8 ----------
+
+uint32_t g_tbl[8][256];
+bool g_tbl_init = false;
+
+void InitTables() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0x82f63b78u ^ (c >> 1) : c >> 1;
+    g_tbl[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = g_tbl[0][i];
+    for (int s = 1; s < 8; s++) {
+      c = g_tbl[0][c & 0xff] ^ (c >> 8);
+      g_tbl[s][i] = c;
+    }
+  }
+  g_tbl_init = true;
+}
+
+uint32_t Crc32c(const uint8_t* p, size_t n) {
+  if (!g_tbl_init) InitTables();
+  uint32_t crc = 0xffffffffu;
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    w ^= crc;
+    crc = g_tbl[7][w & 0xff] ^ g_tbl[6][(w >> 8) & 0xff] ^
+          g_tbl[5][(w >> 16) & 0xff] ^ g_tbl[4][(w >> 24) & 0xff] ^
+          g_tbl[3][(w >> 32) & 0xff] ^ g_tbl[2][(w >> 40) & 0xff] ^
+          g_tbl[1][(w >> 48) & 0xff] ^ g_tbl[0][(w >> 56) & 0xff];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = g_tbl[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+  return crc ^ 0xffffffffu;
+}
+
+constexpr uint32_t kMaskDelta = 0xa282ead8u;
+
+uint32_t Mask(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + kMaskDelta;
+}
+
+void PutU64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = (v >> (8 * i)) & 0xff;
+}
+void PutU32(uint8_t* p, uint32_t v) {
+  for (int i = 0; i < 4; i++) p[i] = (v >> (8 * i)) & 0xff;
+}
+uint64_t GetU64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+uint32_t GetU32(const uint8_t* p) {
+  uint32_t v = 0;
+  for (int i = 3; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t StfCrc32c(const uint8_t* data, size_t n) { return Crc32c(data, n); }
+
+uint32_t StfMaskedCrc32c(const uint8_t* data, size_t n) {
+  return Mask(Crc32c(data, n));
+}
+
+// ---- writer ----------------------------------------------------------
+
+struct StfRecordWriter {
+  FILE* f = nullptr;
+  gzFile gz = nullptr;
+};
+
+StfRecordWriter* StfRecordWriterOpen(const char* path, int compression,
+                                     StfStatus* status) {
+  auto* w = new StfRecordWriter();
+  if (compression == 2) {
+    w->gz = gzopen(path, "wb");
+    if (!w->gz) {
+      stf_internal::Set(status, STF_NOT_FOUND,
+                        std::string("cannot open ") + path);
+      delete w;
+      return nullptr;
+    }
+  } else {
+    w->f = fopen(path, "wb");
+    if (!w->f) {
+      stf_internal::Set(status, STF_NOT_FOUND,
+                        std::string("cannot open ") + path);
+      delete w;
+      return nullptr;
+    }
+  }
+  return w;
+}
+
+void StfRecordWriterWrite(StfRecordWriter* w, const uint8_t* data, size_t n,
+                          StfStatus* status) {
+  uint8_t header[12], footer[4];
+  PutU64(header, n);
+  PutU32(header + 8, Mask(Crc32c(header, 8)));
+  PutU32(footer, Mask(Crc32c(data, n)));
+  bool ok;
+  if (w->gz) {
+    ok = gzwrite(w->gz, header, 12) == 12 &&
+         (n == 0 || gzwrite(w->gz, data, (unsigned)n) == (int)n) &&
+         gzwrite(w->gz, footer, 4) == 4;
+  } else {
+    ok = fwrite(header, 1, 12, w->f) == 12 &&
+         fwrite(data, 1, n, w->f) == n && fwrite(footer, 1, 4, w->f) == 4;
+  }
+  if (!ok) stf_internal::Set(status, STF_INTERNAL, "short write");
+}
+
+void StfRecordWriterClose(StfRecordWriter* w) {
+  if (!w) return;
+  if (w->gz) gzclose(w->gz);
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+// ---- reader ----------------------------------------------------------
+
+struct StfRecordReader {
+  gzFile gz = nullptr;  // reads plain files transparently
+  std::vector<uint8_t> buf;
+  std::vector<uint8_t> batch;
+  std::vector<uint64_t> offsets;
+  std::string path;
+};
+
+StfRecordReader* StfRecordReaderOpen(const char* path, StfStatus* status) {
+  auto* r = new StfRecordReader();
+  r->gz = gzopen(path, "rb");
+  r->path = path;
+  if (!r->gz) {
+    stf_internal::Set(status, STF_NOT_FOUND,
+                      std::string("cannot open ") + path);
+    delete r;
+    return nullptr;
+  }
+  gzbuffer(r->gz, 1 << 20);
+  return r;
+}
+
+int StfRecordReaderNext(StfRecordReader* r, const uint8_t** data, size_t* n,
+                        StfStatus* status) {
+  uint8_t header[12];
+  int got = gzread(r->gz, header, 12);
+  if (got == 0) return 0;  // clean EOF
+  if (got != 12) {
+    stf_internal::Set(status, STF_DATA_LOSS,
+                      "truncated record header in " + r->path);
+    return 0;
+  }
+  if (Mask(Crc32c(header, 8)) != GetU32(header + 8)) {
+    stf_internal::Set(status, STF_DATA_LOSS,
+                      "corrupted length crc in " + r->path);
+    return 0;
+  }
+  uint64_t len = GetU64(header);
+  r->buf.resize(len);
+  if (len > 0 &&
+      gzread(r->gz, r->buf.data(), (unsigned)len) != (int)len) {
+    stf_internal::Set(status, STF_DATA_LOSS,
+                      "truncated record in " + r->path);
+    return 0;
+  }
+  uint8_t footer[4];
+  if (gzread(r->gz, footer, 4) != 4 ||
+      Mask(Crc32c(r->buf.data(), len)) != GetU32(footer)) {
+    stf_internal::Set(status, STF_DATA_LOSS,
+                      "corrupted data crc in " + r->path);
+    return 0;
+  }
+  *data = r->buf.data();
+  *n = len;
+  return 1;
+}
+
+int64_t StfRecordReaderNextBatch(StfRecordReader* r, int64_t max_records,
+                                 const uint8_t** buf,
+                                 const uint64_t** offsets,
+                                 StfStatus* status) {
+  r->batch.clear();
+  r->offsets.clear();
+  r->offsets.push_back(0);
+  int64_t count = 0;
+  while (count < max_records) {
+    const uint8_t* data;
+    size_t n;
+    int ok = StfRecordReaderNext(r, &data, &n, status);
+    if (!ok) break;
+    r->batch.insert(r->batch.end(), data, data + n);
+    r->offsets.push_back(r->batch.size());
+    count++;
+  }
+  *buf = r->batch.data();
+  *offsets = r->offsets.data();
+  return count;
+}
+
+void StfRecordReaderClose(StfRecordReader* r) {
+  if (!r) return;
+  if (r->gz) gzclose(r->gz);
+  delete r;
+}
+
+}  // extern "C"

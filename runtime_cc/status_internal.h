@@ -1,0 +1,23 @@
+// Shared StfStatus representation across the runtime translation units.
+#ifndef STF_STATUS_INTERNAL_H_
+#define STF_STATUS_INTERNAL_H_
+
+#include <string>
+
+#include "stf_c.h"
+
+struct StfStatus {
+  StfCode code = STF_OK;
+  std::string msg;
+};
+
+namespace stf_internal {
+inline void Set(StfStatus* s, StfCode code, std::string msg) {
+  if (s) {
+    s->code = code;
+    s->msg = std::move(msg);
+  }
+}
+}  // namespace stf_internal
+
+#endif  // STF_STATUS_INTERNAL_H_

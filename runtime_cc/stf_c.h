@@ -1,0 +1,135 @@
+/* stf_c.h — C API for the simple_tensorflow_tpu native runtime.
+ *
+ * (ref: tensorflow/c/c_api.h — graph construction, status, buffers.)
+ * TPU-native split: graph *construction/serialization* and host-side IO
+ * (TFRecord, arena staging buffers, prune/topo-sort) are native C++; the
+ * compute path is XLA via the Python Session (one jitted program per
+ * pruned subgraph), so there is no per-node C executor to drive from C.
+ * A graph built through this API serializes to the GraphDef-JSON that
+ * stf.import_graph_def loads and Session.run executes on TPU.
+ */
+
+#ifndef STF_C_H_
+#define STF_C_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define STF_EXPORT __attribute__((visibility("default")))
+
+/* ---- version / status ---------------------------------------------- */
+
+STF_EXPORT const char* StfVersion(void);
+
+typedef enum {
+  STF_OK = 0,
+  STF_CANCELLED = 1,
+  STF_INVALID_ARGUMENT = 3,
+  STF_NOT_FOUND = 5,
+  STF_ALREADY_EXISTS = 6,
+  STF_FAILED_PRECONDITION = 9,
+  STF_OUT_OF_RANGE = 11,
+  STF_INTERNAL = 13,
+  STF_DATA_LOSS = 15,
+} StfCode;
+
+typedef struct StfStatus StfStatus;
+STF_EXPORT StfStatus* StfNewStatus(void);
+STF_EXPORT void StfDeleteStatus(StfStatus*);
+STF_EXPORT StfCode StfGetCode(const StfStatus*);
+STF_EXPORT const char* StfMessage(const StfStatus*);
+
+/* ---- crc32c --------------------------------------------------------- */
+
+STF_EXPORT uint32_t StfCrc32c(const uint8_t* data, size_t n);
+STF_EXPORT uint32_t StfMaskedCrc32c(const uint8_t* data, size_t n);
+
+/* ---- TFRecord IO ---------------------------------------------------- */
+
+typedef struct StfRecordWriter StfRecordWriter;
+/* compression: 0 = none, 2 = gzip */
+STF_EXPORT StfRecordWriter* StfRecordWriterOpen(const char* path,
+                                                int compression,
+                                                StfStatus* status);
+STF_EXPORT void StfRecordWriterWrite(StfRecordWriter*, const uint8_t* data,
+                                     size_t n, StfStatus* status);
+STF_EXPORT void StfRecordWriterClose(StfRecordWriter*);
+
+typedef struct StfRecordReader StfRecordReader;
+STF_EXPORT StfRecordReader* StfRecordReaderOpen(const char* path,
+                                                StfStatus* status);
+/* Returns 1 and sets *data/*n on success (data valid until next call or
+ * close), 0 on clean EOF; corruption -> 0 with status DATA_LOSS. */
+STF_EXPORT int StfRecordReaderNext(StfRecordReader*, const uint8_t** data,
+                                   size_t* n, StfStatus* status);
+STF_EXPORT void StfRecordReaderClose(StfRecordReader*);
+
+/* Bulk read: up to max_records into one packed buffer (records
+ * back-to-back; offsets[i]..offsets[i+1] delimit record i). Returns the
+ * number of records read (0 = EOF or error -> check status). Buffer is
+ * owned by the reader, valid until the next call or close. Cuts
+ * Python<->C crossings to one per batch. */
+STF_EXPORT int64_t StfRecordReaderNextBatch(StfRecordReader*,
+                                            int64_t max_records,
+                                            const uint8_t** buf,
+                                            const uint64_t** offsets,
+                                            StfStatus* status);
+
+/* ---- arena allocator (host staging buffers) -------------------------- */
+
+typedef struct StfArena StfArena;
+STF_EXPORT StfArena* StfArenaNew(size_t block_bytes);
+/* 64-byte aligned; blocks grow geometrically (ref BFC allocator role). */
+STF_EXPORT void* StfArenaAlloc(StfArena*, size_t n);
+STF_EXPORT void StfArenaReset(StfArena*);
+STF_EXPORT size_t StfArenaBytesInUse(const StfArena*);
+STF_EXPORT size_t StfArenaBytesReserved(const StfArena*);
+STF_EXPORT void StfArenaDelete(StfArena*);
+
+/* ---- graph prune / topo-sort (flat form, used by Session lowering) -- */
+
+/* edges: 2*n_edges ints, (src, dst) pairs meaning "dst depends on src".
+ * Writes a topological order of the nodes reachable (as dependencies)
+ * from targets into out_order, returns count; -1 on cycle. */
+STF_EXPORT int64_t StfPruneToposort(int64_t n_nodes, const int32_t* edges,
+                                    int64_t n_edges, const int32_t* targets,
+                                    int64_t n_targets, int32_t* out_order);
+
+/* ---- graph construction (ref TF_Graph / TF_OperationDescription) ---- */
+
+typedef struct StfGraph StfGraph;
+typedef struct StfNode StfNode;
+
+STF_EXPORT StfGraph* StfGraphNew(void);
+STF_EXPORT void StfGraphDelete(StfGraph*);
+STF_EXPORT StfNode* StfGraphAddNode(StfGraph*, const char* op_type,
+                                    const char* name, StfStatus* status);
+/* input: producer node + output index */
+STF_EXPORT void StfNodeAddInput(StfNode*, StfNode* src, int out_index);
+STF_EXPORT void StfNodeAddControlInput(StfNode*, StfNode* src);
+STF_EXPORT void StfNodeSetDevice(StfNode*, const char* device);
+STF_EXPORT void StfNodeSetAttrInt(StfNode*, const char* key, int64_t v);
+STF_EXPORT void StfNodeSetAttrFloat(StfNode*, const char* key, double v);
+STF_EXPORT void StfNodeSetAttrBool(StfNode*, const char* key, int v);
+STF_EXPORT void StfNodeSetAttrString(StfNode*, const char* key,
+                                     const char* v);
+/* dtype name + shape (rank, dims; -1 dims unknown) for output i */
+STF_EXPORT void StfNodeAddOutput(StfNode*, const char* dtype, int rank,
+                                 const int64_t* dims);
+STF_EXPORT const char* StfNodeName(const StfNode*);
+STF_EXPORT int64_t StfGraphNumNodes(const StfGraph*);
+
+/* Serialize to GraphDef-JSON (stf.import_graph_def loads it). Returned
+ * buffer is owned by the graph, valid until next call / delete. */
+STF_EXPORT const char* StfGraphToJson(StfGraph*, size_t* n,
+                                      StfStatus* status);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* STF_C_H_ */

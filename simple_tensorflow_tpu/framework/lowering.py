@@ -40,12 +40,42 @@ Tensor = ops_mod.Tensor
 # Pruning / ordering
 # ---------------------------------------------------------------------------
 
+_NATIVE_PRUNE_MIN_NODES = 512  # below this, ctypes marshalling beats C DFS
+
+
+def _ancestor_set(target_ops, fed_tensors):
+    """Unordered dependency closure of targets (cheap BFS; O(|ancestors|),
+    independent of total graph size)."""
+    seen = set()
+    work = list(target_ops)
+    while work:
+        op = work.pop()
+        if op in seen:
+            continue
+        seen.add(op)
+        for t in op.inputs:
+            if t not in fed_tensors and t.op not in seen:
+                work.append(t.op)
+        for c in op.control_inputs:
+            if c not in seen:
+                work.append(c)
+    return seen
+
+
 def prune(target_ops: Sequence[Operation],
           fed_tensors: Set[Tensor]) -> List[Operation]:
     """Ops needed to compute ``target_ops`` given ``fed_tensors`` are
     supplied externally. Returns a deterministic topological order
-    (data + control edges). Python fallback for the C++ pruner in
-    runtime_cc/graph.cc."""
+    (data + control edges). Large fetch subgraphs go through the native
+    C++ pruner (runtime_cc/graph.cc); this Python DFS is the fallback and
+    the cycle-error path. Gating keys on the *ancestor* count, not total
+    graph size, so a small fetch in a huge graph stays O(|ancestors|)."""
+    if target_ops:
+        anc = _ancestor_set(target_ops, fed_tensors)
+        if len(anc) >= _NATIVE_PRUNE_MIN_NODES:
+            native_order = _prune_native(anc, target_ops, fed_tensors)
+            if native_order is not None:
+                return native_order
     order: List[Operation] = []
     state: Dict[Operation, int] = {}  # 0=visiting, 1=done
 
@@ -87,6 +117,38 @@ def prune(target_ops: Sequence[Operation],
                 order.append(op)
                 stack.pop()
     return order
+
+
+def _prune_native(ancestors, target_ops, fed_tensors):
+    """Flat-array edge list over the ancestor region -> runtime_cc
+    StfPruneToposort. Returns None (falling back to the Python DFS) when
+    the native library is absent or reports a cycle — the Python path
+    raises the contextful error."""
+    try:
+        from ..runtime import native
+    except Exception:
+        return None
+    if not native.available():
+        return None
+    import numpy as np
+
+    # deterministic node order: graph insertion order via op id
+    region = sorted(ancestors, key=lambda op: op._id)
+    ids = {op: i for i, op in enumerate(region)}
+    edges = []
+    for op, i in ids.items():
+        for t in op.inputs:
+            if t not in fed_tensors:
+                edges.append((ids[t.op], i))
+        for c in op.control_inputs:
+            edges.append((ids[c], i))
+    edge_arr = (np.asarray(edges, dtype=np.int32)
+                if edges else np.empty((0, 2), np.int32))
+    order = native.prune_toposort(
+        len(region), edge_arr, [ids[op] for op in target_ops])
+    if order is None:
+        return None
+    return [region[i] for i in order]
 
 
 def ancestors_between(xs: Sequence[Tensor], ys: Sequence[Tensor]

@@ -16,7 +16,22 @@ for _i in range(256):
 _MASK_DELTA = 0xA282EAD8
 
 
+def _native_crc():
+    try:
+        from ..runtime import native
+
+        if native.available():
+            return native
+    except Exception:
+        pass
+    return None
+
+
 def crc32c(data: bytes, crc: int = 0) -> int:
+    if crc == 0 and len(data) >= 64:
+        native = _native_crc()
+        if native is not None:
+            return native.crc32c(bytes(data))
     crc = crc ^ 0xFFFFFFFF
     for b in data:
         crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)

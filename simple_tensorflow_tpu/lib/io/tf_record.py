@@ -111,13 +111,18 @@ def tf_record_iterator(path, options: Optional[TFRecordOptions] = None
     """(ref: python/lib/io/tf_record.py:43 ``tf_record_iterator``).
     Prefers the native C++ reader when available."""
     comp = TFRecordOptions.get_compression_type_string(options)
+    use_native = False
     if not comp:
+        # only the probe is guarded: once the native reader is chosen, its
+        # errors (DataLossError etc.) propagate — falling back mid-stream
+        # would re-deliver records from the start of the file
         try:
             from ...runtime import native
 
-            if native.available():
-                yield from native.read_tfrecords(path)
-                return
+            use_native = native.available()
         except Exception:
-            pass
-    yield from _read_records_py(path, comp)
+            use_native = False
+    if use_native:
+        yield from native.read_tfrecords(path)
+    else:
+        yield from _read_records_py(path, comp)

@@ -1,0 +1,8 @@
+"""Native C++ runtime bindings (runtime_cc/, via ctypes).
+
+Every entry point has a pure-Python fallback; ``native.available()`` gates
+use. The library is built lazily with ``make`` on first import when a
+toolchain is present.
+"""
+
+from . import native  # noqa: F401

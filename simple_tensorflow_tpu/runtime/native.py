@@ -1,0 +1,377 @@
+"""ctypes bindings for libstf_runtime.so (runtime_cc/).
+
+(ref: the reference loads its C++ core via swig pybind
+tensorflow/python/pywrap_tensorflow; we bind the native runtime with
+ctypes — no build-time Python binding dependency.)
+
+Provides: crc32c, TFRecord reader/writer, arena allocator, flat graph
+prune/topo-sort, and the C-API graph builder used by tests. All callers
+must handle ``available() == False`` (no toolchain, build failure).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CC_DIR = os.path.join(_REPO_ROOT, "runtime_cc")
+_LIB_NAMES = ("libstf_runtime.so",)
+
+
+def _find_or_build() -> Optional[str]:
+    candidates = [os.path.join(_CC_DIR, n) for n in _LIB_NAMES]
+    candidates += [os.path.join(os.path.dirname(__file__), n)
+                   for n in _LIB_NAMES]
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    if os.path.isdir(_CC_DIR):
+        try:
+            subprocess.run(["make", "-C", _CC_DIR, "-j4"], check=True,
+                           capture_output=True, timeout=240)
+        except Exception:
+            return None
+        p = os.path.join(_CC_DIR, _LIB_NAMES[0])
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _bind(lib):
+    c = ctypes
+    u8p = c.POINTER(c.c_uint8)
+    u64p = c.POINTER(c.c_uint64)
+    lib.StfVersion.restype = c.c_char_p
+    lib.StfCrc32c.argtypes = [u8p, c.c_size_t]
+    lib.StfCrc32c.restype = c.c_uint32
+    lib.StfMaskedCrc32c.argtypes = [u8p, c.c_size_t]
+    lib.StfMaskedCrc32c.restype = c.c_uint32
+
+    lib.StfNewStatus.restype = c.c_void_p
+    lib.StfDeleteStatus.argtypes = [c.c_void_p]
+    lib.StfGetCode.argtypes = [c.c_void_p]
+    lib.StfGetCode.restype = c.c_int
+    lib.StfMessage.argtypes = [c.c_void_p]
+    lib.StfMessage.restype = c.c_char_p
+
+    lib.StfRecordWriterOpen.argtypes = [c.c_char_p, c.c_int, c.c_void_p]
+    lib.StfRecordWriterOpen.restype = c.c_void_p
+    lib.StfRecordWriterWrite.argtypes = [c.c_void_p, u8p, c.c_size_t,
+                                         c.c_void_p]
+    lib.StfRecordWriterClose.argtypes = [c.c_void_p]
+
+    lib.StfRecordReaderOpen.argtypes = [c.c_char_p, c.c_void_p]
+    lib.StfRecordReaderOpen.restype = c.c_void_p
+    lib.StfRecordReaderNext.argtypes = [c.c_void_p, c.POINTER(u8p),
+                                        c.POINTER(c.c_size_t), c.c_void_p]
+    lib.StfRecordReaderNext.restype = c.c_int
+    lib.StfRecordReaderNextBatch.argtypes = [
+        c.c_void_p, c.c_int64, c.POINTER(u8p), c.POINTER(u64p), c.c_void_p]
+    lib.StfRecordReaderNextBatch.restype = c.c_int64
+    lib.StfRecordReaderClose.argtypes = [c.c_void_p]
+
+    lib.StfArenaNew.argtypes = [c.c_size_t]
+    lib.StfArenaNew.restype = c.c_void_p
+    lib.StfArenaAlloc.argtypes = [c.c_void_p, c.c_size_t]
+    lib.StfArenaAlloc.restype = c.c_void_p
+    lib.StfArenaReset.argtypes = [c.c_void_p]
+    lib.StfArenaBytesInUse.argtypes = [c.c_void_p]
+    lib.StfArenaBytesInUse.restype = c.c_size_t
+    lib.StfArenaBytesReserved.argtypes = [c.c_void_p]
+    lib.StfArenaBytesReserved.restype = c.c_size_t
+    lib.StfArenaDelete.argtypes = [c.c_void_p]
+
+    i32p = c.POINTER(c.c_int32)
+    lib.StfPruneToposort.argtypes = [c.c_int64, i32p, c.c_int64, i32p,
+                                     c.c_int64, i32p]
+    lib.StfPruneToposort.restype = c.c_int64
+
+    lib.StfGraphNew.restype = c.c_void_p
+    lib.StfGraphDelete.argtypes = [c.c_void_p]
+    lib.StfGraphAddNode.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                    c.c_void_p]
+    lib.StfGraphAddNode.restype = c.c_void_p
+    lib.StfNodeAddInput.argtypes = [c.c_void_p, c.c_void_p, c.c_int]
+    lib.StfNodeAddControlInput.argtypes = [c.c_void_p, c.c_void_p]
+    lib.StfNodeSetDevice.argtypes = [c.c_void_p, c.c_char_p]
+    lib.StfNodeSetAttrInt.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.StfNodeSetAttrFloat.argtypes = [c.c_void_p, c.c_char_p, c.c_double]
+    lib.StfNodeSetAttrBool.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.StfNodeSetAttrString.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+    lib.StfNodeAddOutput.argtypes = [c.c_void_p, c.c_char_p, c.c_int,
+                                     c.POINTER(c.c_int64)]
+    lib.StfGraphNumNodes.argtypes = [c.c_void_p]
+    lib.StfGraphNumNodes.restype = c.c_int64
+    lib.StfGraphToJson.argtypes = [c.c_void_p, c.POINTER(c.c_size_t),
+                                   c.c_void_p]
+    lib.StfGraphToJson.restype = c.c_void_p  # read via string_at with length
+    return lib
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("STF_DISABLE_NATIVE"):
+            return None
+        path = _find_or_build()
+        if path is None:
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(path))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def version() -> str:
+    lib = _load()
+    return lib.StfVersion().decode() if lib else "unavailable"
+
+
+class _Status:
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.StfNewStatus()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._lib.StfDeleteStatus(self._h)
+        return False
+
+    @property
+    def handle(self):
+        return self._h
+
+    def check(self):
+        code = self._lib.StfGetCode(self._h)
+        if code == 0:
+            return
+        from ..framework import errors
+
+        msg = self._lib.StfMessage(self._h).decode()
+        if code == 15:
+            raise errors.DataLossError(None, None, msg)
+        if code == 5:
+            raise errors.NotFoundError(None, None, msg)
+        raise errors.InternalError(None, None, f"[native:{code}] {msg}")
+
+
+def crc32c(data: bytes) -> int:
+    lib = _load()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return lib.StfCrc32c(buf, len(data))
+
+
+def masked_crc32c(data: bytes) -> int:
+    lib = _load()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return lib.StfMaskedCrc32c(buf, len(data))
+
+
+def read_tfrecords(path: str, batch: int = 256) -> Iterator[bytes]:
+    """Iterate records via the native reader (batched crossings).
+
+    Records read before a mid-batch corruption are yielded first, then the
+    error raises — matching the pure-Python reader's behavior.
+    """
+    lib = _load()
+    with _Status(lib) as st:
+        h = lib.StfRecordReaderOpen(path.encode(), st.handle)
+        st.check()
+    try:
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        while True:
+            buf = u8p()
+            offs = u64p()
+            # copy records + error out of the status BEFORE yielding, so
+            # generator suspension cannot outlive the status/buffers
+            err = None
+            with _Status(lib) as st:
+                n = lib.StfRecordReaderNextBatch(
+                    h, batch, ctypes.byref(buf), ctypes.byref(offs),
+                    st.handle)
+                try:
+                    st.check()
+                except Exception as e:  # yield the good prefix, then raise
+                    err = e
+                records = []
+                if n > 0:
+                    raw = ctypes.string_at(buf, offs[n])
+                    records = [raw[offs[i]:offs[i + 1]] for i in range(n)]
+            yield from records
+            if err is not None:
+                raise err
+            if n == 0:
+                return
+    finally:
+        lib.StfRecordReaderClose(h)
+
+
+def write_tfrecords(path: str, records: Sequence[bytes],
+                    compression: int = 0) -> None:
+    lib = _load()
+    with _Status(lib) as st:
+        h = lib.StfRecordWriterOpen(path.encode(), compression, st.handle)
+        st.check()
+    try:
+        for rec in records:
+            buf = (ctypes.c_uint8 * len(rec)).from_buffer_copy(rec)
+            with _Status(lib) as st:
+                lib.StfRecordWriterWrite(h, buf, len(rec), st.handle)
+                st.check()
+    finally:
+        lib.StfRecordWriterClose(h)
+
+
+class Arena:
+    """Aligned host staging arena (ref BFC allocator role, see arena.cc)."""
+
+    def __init__(self, block_bytes: int = 1 << 20):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._h = self._lib.StfArenaNew(block_bytes)
+
+    def alloc_ndarray(self, shape, dtype=np.uint8) -> np.ndarray:
+        """Arena-backed ndarray. The array keeps the arena alive; but
+        ``reset()`` recycles the memory — arrays from before a reset must
+        not be used after it."""
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        ptr = self._lib.StfArenaAlloc(self._h, max(nbytes, 1))
+        if not ptr:
+            raise MemoryError("arena allocation failed")
+        buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        buf._arena = self  # keep-alive: ndarray.base -> ctypes buf -> arena
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def reset(self):
+        self._lib.StfArenaReset(self._h)
+
+    @property
+    def bytes_in_use(self) -> int:
+        return self._lib.StfArenaBytesInUse(self._h)
+
+    @property
+    def bytes_reserved(self) -> int:
+        return self._lib.StfArenaBytesReserved(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.StfArenaDelete(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prune_toposort(n_nodes: int, edges: np.ndarray,
+                   targets: Sequence[int]) -> Optional[List[int]]:
+    """Topo order of dependency-ancestors of ``targets``.
+
+    edges: int32 array (n_edges, 2) of (src, dst) = dst depends on src.
+    Returns None on cycle (caller raises with graph context).
+    """
+    lib = _load()
+    edges = np.ascontiguousarray(edges, dtype=np.int32)
+    tg = np.ascontiguousarray(targets, dtype=np.int32)
+    out = np.empty(n_nodes, dtype=np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    n = lib.StfPruneToposort(
+        n_nodes, edges.ctypes.data_as(i32p), len(edges),
+        tg.ctypes.data_as(i32p), len(tg), out.ctypes.data_as(i32p))
+    if n < 0:
+        return None
+    return out[:n].tolist()
+
+
+class CGraph:
+    """Graph construction through the C API (ref TF_Graph); serializes to
+    GraphDef-JSON consumable by stf.import_graph_def."""
+
+    def __init__(self):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._h = self._lib.StfGraphNew()
+
+    def add_node(self, op_type: str, name: str):
+        with _Status(self._lib) as st:
+            node = self._lib.StfGraphAddNode(self._h, op_type.encode(),
+                                             name.encode(), st.handle)
+            st.check()
+        return node
+
+    def add_input(self, node, src, out_index=0):
+        self._lib.StfNodeAddInput(node, src, out_index)
+
+    def add_control_input(self, node, src):
+        self._lib.StfNodeAddControlInput(node, src)
+
+    def set_attr(self, node, key, value):
+        k = key.encode()
+        if isinstance(value, bool):
+            self._lib.StfNodeSetAttrBool(node, k, int(value))
+        elif isinstance(value, int):
+            self._lib.StfNodeSetAttrInt(node, k, value)
+        elif isinstance(value, float):
+            self._lib.StfNodeSetAttrFloat(node, k, value)
+        elif isinstance(value, str):
+            self._lib.StfNodeSetAttrString(node, k, value.encode())
+        else:
+            raise TypeError(f"unsupported C attr type {type(value)}")
+
+    def add_output(self, node, dtype_name: str, shape=None):
+        if shape is None:
+            self._lib.StfNodeAddOutput(node, dtype_name.encode(), -1, None)
+        else:
+            dims = (ctypes.c_int64 * len(shape))(
+                *[-1 if d is None else d for d in shape])
+            self._lib.StfNodeAddOutput(node, dtype_name.encode(),
+                                       len(shape), dims)
+
+    @property
+    def num_nodes(self) -> int:
+        return self._lib.StfGraphNumNodes(self._h)
+
+    def to_json(self) -> str:
+        n = ctypes.c_size_t()
+        with _Status(self._lib) as st:
+            p = self._lib.StfGraphToJson(self._h, ctypes.byref(n), st.handle)
+            st.check()
+        return ctypes.string_at(p, n.value).decode()
+
+    def close(self):
+        if self._h:
+            self._lib.StfGraphDelete(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
