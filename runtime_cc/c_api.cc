@@ -2,10 +2,12 @@
 // construction + GraphDef-JSON serialization, status, version. See
 // stf_c.h for the TPU-native API split rationale.
 
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <memory>
 #include <string>
+#include <unordered_set>
 #include <vector>
 
 #include "stf_c.h"
@@ -31,6 +33,7 @@ struct StfNode {
 
 struct StfGraph {
   std::vector<std::unique_ptr<StfNode>> nodes;
+  std::unordered_set<std::string> names;  // O(1) duplicate detection
   std::string json;  // serialization buffer
 };
 
@@ -74,12 +77,10 @@ void StfGraphDelete(StfGraph* g) { delete g; }
 
 StfNode* StfGraphAddNode(StfGraph* g, const char* op_type, const char* name,
                          StfStatus* status) {
-  for (auto& n : g->nodes) {
-    if (n->name == name) {
-      stf_internal::Set(status, STF_ALREADY_EXISTS,
-                        std::string("duplicate node name ") + name);
-      return nullptr;
-    }
+  if (!g->names.insert(name).second) {
+    stf_internal::Set(status, STF_ALREADY_EXISTS,
+                      std::string("duplicate node name ") + name);
+    return nullptr;
   }
   auto node = std::make_unique<StfNode>();
   node->op_type = op_type;
@@ -104,7 +105,13 @@ void StfNodeSetAttrInt(StfNode* n, const char* key, int64_t v) {
 
 void StfNodeSetAttrFloat(StfNode* n, const char* key, double v) {
   char buf[64];
-  snprintf(buf, sizeof(buf), "%.17g", v);
+  if (std::isnan(v)) {
+    snprintf(buf, sizeof(buf), "NaN");  // python json accepts these
+  } else if (std::isinf(v)) {
+    snprintf(buf, sizeof(buf), v > 0 ? "Infinity" : "-Infinity");
+  } else {
+    snprintf(buf, sizeof(buf), "%.17g", v);
+  }
   n->attrs.emplace_back(key, buf);
 }
 

@@ -9,6 +9,8 @@
 
 #include <cstdio>
 #include <cstring>
+#include <mutex>
+#include <new>
 #include <string>
 #include <vector>
 
@@ -22,7 +24,7 @@ namespace {
 // ---- crc32c (Castagnoli, polynomial 0x82f63b78), slice-by-8 ----------
 
 uint32_t g_tbl[8][256];
-bool g_tbl_init = false;
+std::once_flag g_tbl_once;  // ctypes drops the GIL: init must be thread-safe
 
 void InitTables() {
   for (uint32_t i = 0; i < 256; i++) {
@@ -37,11 +39,10 @@ void InitTables() {
       g_tbl[s][i] = c;
     }
   }
-  g_tbl_init = true;
 }
 
 uint32_t Crc32c(const uint8_t* p, size_t n) {
-  if (!g_tbl_init) InitTables();
+  std::call_once(g_tbl_once, InitTables);
   uint32_t crc = 0xffffffffu;
   while (n >= 8) {
     uint64_t w;
@@ -186,12 +187,33 @@ int StfRecordReaderNext(StfRecordReader* r, const uint8_t** data, size_t* n,
     return 0;
   }
   uint64_t len = GetU64(header);
-  r->buf.resize(len);
-  if (len > 0 &&
-      gzread(r->gz, r->buf.data(), (unsigned)len) != (int)len) {
+  // A corrupted-but-crc-valid (re-masked) length could be absurd; cap at
+  // 16 GiB and catch bad_alloc so a bad file raises DataLossError in
+  // Python instead of std::terminate crossing the extern "C" boundary.
+  if (len > (uint64_t)16 << 30) {
     stf_internal::Set(status, STF_DATA_LOSS,
-                      "truncated record in " + r->path);
+                      "unreasonable record length in " + r->path);
     return 0;
+  }
+  try {
+    r->buf.resize(len);
+  } catch (const std::bad_alloc&) {
+    stf_internal::Set(status, STF_DATA_LOSS,
+                      "record length exceeds memory in " + r->path);
+    return 0;
+  }
+  // chunked reads: gzread takes unsigned, records may exceed 2 GiB
+  uint64_t done = 0;
+  while (done < len) {
+    unsigned chunk = (unsigned)((len - done > (1u << 30)) ? (1u << 30)
+                                                          : (len - done));
+    int got_n = gzread(r->gz, r->buf.data() + done, chunk);
+    if (got_n <= 0) {
+      stf_internal::Set(status, STF_DATA_LOSS,
+                        "truncated record in " + r->path);
+      return 0;
+    }
+    done += (uint64_t)got_n;
   }
   uint8_t footer[4];
   if (gzread(r->gz, footer, 4) != 4 ||

@@ -121,6 +121,20 @@ from .ops.check_ops import (
 )
 from .ops.template import make_template
 from .ops.functional_ops import py_func
+from .ops.tensor_array_ops import TensorArray
+from .ops import parsing_ops
+from .ops.parsing_ops import (
+    FixedLenFeature, VarLenFeature, parse_example, parse_single_example,
+    decode_raw,
+)
+from .ops import misc_ops
+from .ops.misc_ops import (
+    confusion_matrix, histogram_fixed_width, bitcast, lbeta,
+)
+from .ops.numerics import verify_tensor_all_finite, add_check_numerics_ops
+from .framework.function import Defun
+from .framework import function
+from .framework import optimizer as graph_optimizer
 from .ops.linalg_ops import (
     cholesky, matrix_determinant, matrix_inverse, matrix_solve,
     matrix_triangular_solve, qr, svd, self_adjoint_eig, self_adjoint_eigvals,
@@ -132,6 +146,7 @@ from .ops.spectral_ops import fft, ifft, fft2d, ifft2d, fft3d, ifft3d
 from .client.session import Session, InteractiveSession, get_default_session
 
 # namespaces (tf.nn, tf.train, tf.layers, tf.summary, ...)
+from . import compiler
 from . import nn
 from . import train
 from . import layers
@@ -145,6 +160,8 @@ from . import saved_model
 from . import estimator
 from . import debug
 from . import compat
+from . import sets
+from . import utils
 from .platform import app, flags, tf_logging as logging, resource_loader
 from .platform import test
 from .client import device_lib

@@ -102,11 +102,16 @@ class _FetchMapper:
             return ("dict", type(f),
                     [(k, self._build(g, v)) for k, v in f.items()])
         from ..framework.indexed_slices import IndexedSlices
+        from ..framework.sparse_tensor import SparseTensor
 
         if isinstance(f, IndexedSlices):
             vals = self._build(g, f.values)
             idx = self._build(g, f.indices)
             return ("islices", None, [vals, idx])
+        if isinstance(f, SparseTensor):
+            return ("sparse", None, [self._build(g, f.indices),
+                                     self._build(g, f.values),
+                                     self._build(g, f.dense_shape)])
         el = g.as_graph_element(f, allow_tensor=True, allow_operation=True)
         return ("leaf", None, self._register(el))
 
@@ -122,6 +127,12 @@ class _FetchMapper:
 
             return IndexedSlices(self.rebuild(values, payload[0]),
                                  self.rebuild(values, payload[1]))
+        if kind == "sparse":
+            from ..framework.sparse_tensor import SparseTensorValue
+
+            return SparseTensorValue(self.rebuild(values, payload[0]),
+                                     self.rebuild(values, payload[1]),
+                                     self.rebuild(values, payload[2]))
         kids = [self.rebuild(values, k) for k in payload]
         if kind == "namedtuple":
             return typ(*kids)
@@ -133,7 +144,8 @@ class _FetchMapper:
 class _CompiledStep:
     __slots__ = ("jitted", "device_fetches", "host_plan", "post_host_plan",
                  "post_host_inputs", "device_ops", "feed_tensors", "boundary",
-                 "has_device_stage", "n_calls", "last_lowering_ctx")
+                 "has_device_stage", "n_calls", "last_lowering_ctx",
+                 "check_msgs")
 
     def __init__(self):
         self.n_calls = 0
@@ -278,7 +290,21 @@ class BaseSession:
                 val = feeds[t] if t in feeds else host_env[t]
                 feed_args[t.name] = self._maybe_shard_feed(t, val)
             state = self._variable_store.values
-            fetch_vals, new_state = step.jitted(dict(state), feed_args, rng)
+            fetch_vals, new_state, check_flags = step.jitted(
+                dict(state), feed_args, rng)
+            if check_flags:
+                # inspect BEFORE committing state: a failed check must not
+                # apply NaN-contaminated updates (ref semantics: ops
+                # downstream of a failed CheckNumerics never run)
+                import jax
+
+                flags_np = np.asarray(jax.device_get(check_flags))
+                if flags_np.any():
+                    bad = [m for m, f in zip(step.check_msgs, flags_np) if f]
+                    raise errors.InvalidArgumentError(
+                        None, None,
+                        "CheckNumerics failed — tensor had NaN/Inf values: "
+                        + "; ".join(bad))
             self._variable_store.values = dict(new_state)
             self._apply_declared_shardings(new_state.keys())
             device_results = list(fetch_vals)
@@ -404,7 +430,13 @@ class BaseSession:
                 and t not in fed_set for t in op.inputs) or any(
                 c in device_op_set or c in has_dev_anc
                 for c in op.control_inputs)
-            if op.op_def.runs_on_host:
+            # string tensors never enter XLA: a Const producing strings is
+            # a host source, not a device op (mirrors ref CPU pinning of
+            # string kernels in simple_placer.cc)
+            is_string_const = (op.type == "Const" and any(
+                o.dtype.base_dtype == dtypes_mod.string
+                for o in op.outputs))
+            if op.op_def.runs_on_host or is_string_const:
                 if dev_anc:
                     post_host.append(op)
                     post_host_set.add(op)
@@ -485,6 +517,8 @@ class BaseSession:
         host_boundary = [t for t in boundary]
         store = self._variable_store
 
+        check_msgs: List[str] = []  # filled at trace time, index-aligned
+
         def step_fn(state, feed_args, rng):
             ctx = lowering_mod.LoweringContext(state, rng_root=rng,
                                                session=self)
@@ -492,9 +526,13 @@ class BaseSession:
                 ctx.env[t] = feed_args[t.name]
             lowering_mod.execute_ops(ctx, device_ops, fed=set(host_boundary))
             fetch_vals = [ctx.env[t] for t in device_fetches]
-            return fetch_vals, ctx.state
+            check_msgs.clear()  # jit may trace more than once
+            check_msgs.extend(m for m, _ in ctx.numeric_checks)
+            flags = [f for _, f in ctx.numeric_checks]
+            return fetch_vals, ctx.state, flags
 
         step.jitted = jax.jit(step_fn, donate_argnums=0)
+        step.check_msgs = check_msgs
         return step
 
     # -- partial run (ref: session.py partial_run) --------------------------

@@ -1,0 +1,3 @@
+"""Compiler utilities: AOT compile + executable cache (ref compiler/aot)."""
+
+from . import aot  # noqa: F401

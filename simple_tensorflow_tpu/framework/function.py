@@ -1,0 +1,116 @@
+"""Graph functions: @Defun (ref: tensorflow/python/framework/function.py).
+
+The reference registers a FunctionDef and calls it through a Call kernel in
+the dynamic executor. TPU-native, a defined function is a FuncGraph (the
+same machinery as cond/while bodies): the call node lowers by tracing the
+body inline into the enclosing XLA program — so XLA inlines, fuses, and
+differentiates through it (jax.vjp); there is no call-frame overhead at
+runtime. Bodies are traced per input-signature (shape specialization is
+what the MXU wants) and cached.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import dtypes as dtypes_mod
+from . import graph as ops_mod
+from . import op_registry
+from . import lowering as lowering_mod
+from . import tensor_shape as shape_mod
+
+
+def _lower_function_call(ctx, op, inputs):
+    fg = op.attrs["func_graph"]
+    n_args = op.attrs["n_args"]
+    return lowering_mod.lower_func_graph(ctx, fg, inputs[:n_args],
+                                         inputs[n_args:])
+
+
+op_registry.register("GraphFunctionCall", lower=_lower_function_call,
+                     n_outputs=None)
+
+
+class _DefinedFunction:
+    """A callable graph function (ref function.py:255 ``_DefinedFunction``).
+
+    The body re-traces per (shape, dtype) signature; each call site becomes
+    one GraphFunctionCall node whose lowering inlines the traced body.
+    """
+
+    def __init__(self, func, input_types: Sequence[Any], func_name=None,
+                 grad_func=None, python_grad_func=None, out_names=None):
+        self._func = func
+        self._input_types = [dtypes_mod.as_dtype(t) for t in input_types]
+        self._name = func_name or getattr(func, "__name__", "function")
+        self._grad_func = grad_func
+        self._python_grad_func = python_grad_func
+        self._out_names = out_names
+        self._cache: Dict[Tuple, ops_mod.FuncGraph] = {}
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def declared_input_types(self):
+        return list(self._input_types)
+
+    def _trace(self, arg_specs) -> ops_mod.FuncGraph:
+        key = tuple(arg_specs)
+        if key in self._cache:
+            return self._cache[key]
+        g = ops_mod.get_default_graph()
+        fg = ops_mod.FuncGraph(self._name, outer_graph=g)
+        with ops_mod._as_current(fg):
+            args = [fg.add_input(dtype, shape, f"arg{i}")
+                    for i, (shape, dtype) in enumerate(arg_specs)]
+            res = self._func(*args)
+            if res is None:
+                raise ValueError(
+                    f"@Defun function {self._name} returned None")
+            flat = list(res) if isinstance(res, (list, tuple)) else [res]
+            fg.outputs = [ops_mod.convert_to_tensor(t) for t in flat]
+        self._cache[key] = fg
+        return fg
+
+    def __call__(self, *args, name=None):
+        if len(args) != len(self._input_types):
+            raise ValueError(
+                f"{self._name} takes {len(self._input_types)} arguments, "
+                f"got {len(args)}")
+        g = ops_mod.get_default_graph()
+        tensors = [ops_mod.convert_to_tensor(a, dtype=t)
+                   for a, t in zip(args, self._input_types)]
+        specs = [(t.shape, t.dtype) for t in tensors]
+        fg = self._trace(specs)
+        captures = [outer for outer, _ in fg.captures]
+        op = g.create_op(
+            "GraphFunctionCall", tensors + captures,
+            attrs={"func_graph": fg, "n_args": len(tensors),
+                   "func_name": self._name},
+            name=name or self._name,
+            output_specs=[(t.shape, t.dtype) for t in fg.outputs])
+        outs = list(op.outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+
+class Defun:
+    """Decorator: @Defun(stf.float32, stf.float32) (ref function.py:41).
+
+    TPU note: the body lowers inline into the caller's XLA program — the
+    decorator is an API-compat and graph-organization tool, not a runtime
+    boundary.
+    """
+
+    def __init__(self, *input_types, **kwargs):
+        self._input_types = input_types
+        self._kwargs = kwargs
+
+    def __call__(self, func):
+        return _DefinedFunction(
+            func, self._input_types,
+            func_name=self._kwargs.get("func_name"),
+            grad_func=self._kwargs.get("grad_func"),
+            python_grad_func=self._kwargs.get("python_grad_func"),
+            out_names=self._kwargs.get("out_names"))

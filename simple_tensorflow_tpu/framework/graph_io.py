@@ -125,7 +125,8 @@ def import_graph_def(graph_def, input_map=None, return_elements=None,
     if isinstance(graph_def, (str, bytes)):
         graph_def = json.loads(graph_def)
     g = ops_mod.get_default_graph()
-    prefix = (name or "import")
+    # TF semantics: default prefix "import"; explicit "" means no prefix
+    prefix = "import" if name is None else name
     input_map = {k: v for k, v in (input_map or {}).items()}
     tensors = {}
 

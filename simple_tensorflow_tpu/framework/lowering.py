@@ -206,6 +206,9 @@ class LoweringContext:
         self.in_control_flow = False
         self.in_shard_map = False
         self._rng_cache: Dict[int, Any] = {}
+        # CheckNumerics flags gathered during trace: [(message, bool value)];
+        # the Session fetches them with the step and raises host-side
+        self.numeric_checks: List[Tuple[str, Any]] = []
 
     def child(self, env: Dict[Tensor, Any],
               in_control_flow: Optional[bool] = None) -> "LoweringContext":
@@ -222,6 +225,7 @@ class LoweringContext:
                              else in_control_flow)
         c.in_shard_map = self.in_shard_map
         c._rng_cache = self._rng_cache
+        c.numeric_checks = self.numeric_checks
         return c
 
     # -- state ---------------------------------------------------------------

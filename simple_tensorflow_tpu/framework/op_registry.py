@@ -157,6 +157,10 @@ def register_pure(name, pure_fn, **kw):
     return register(name, pure_fn=pure_fn, **kw)
 
 
+def exists(name) -> bool:
+    return name in _REGISTRY
+
+
 def get(name) -> OpDef:
     try:
         return _REGISTRY[name]

@@ -81,7 +81,16 @@ def _read_records_py(path, compression="") -> Iterator[bytes]:
 
         f = gzip.open(path, "rb")
     else:
-        f = open(path, "rb")
+        # sniff gzip magic so the fallback matches the native reader, whose
+        # gzFile transparently decompresses regardless of options
+        with open(path, "rb") as probe:
+            magic = probe.read(2)
+        if magic == b"\x1f\x8b":
+            import gzip
+
+            f = gzip.open(path, "rb")
+        else:
+            f = open(path, "rb")
     with f:
         while True:
             header = f.read(12)

@@ -102,6 +102,22 @@ class Writer:
             self._parts.append(data)
         return self
 
+    def packed_floats(self, field: int, values) -> "Writer":
+        if len(values):
+            data = b"".join(struct.pack("<f", float(v)) for v in values)
+            self._parts.append(_key(field, 2))
+            self._parts.append(encode_varint(len(data)))
+            self._parts.append(data)
+        return self
+
+    def packed_varints(self, field: int, values) -> "Writer":
+        if len(values):
+            data = b"".join(encode_varint(int(v)) for v in values)
+            self._parts.append(_key(field, 2))
+            self._parts.append(encode_varint(len(data)))
+            self._parts.append(data)
+        return self
+
     def tobytes(self) -> bytes:
         return b"".join(self._parts)
 

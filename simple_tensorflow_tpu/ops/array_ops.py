@@ -94,8 +94,11 @@ op_registry.register_pure("InvertPermutation",
                               jnp.arange(x.shape[0], dtype=x.dtype)))
 op_registry.register_pure("StopGradient", jax.lax.stop_gradient)
 op_registry.register_pure("PreventGradient", jax.lax.stop_gradient)
-op_registry.register_pure("CheckNumerics", lambda x, message="":
-                          _check_numerics_impl(x, message))
+op_registry.register("CheckNumerics",
+                     lower=lambda ctx, op, inputs:
+                     [_check_numerics_impl(ctx, op, inputs[0])],
+                     infer_fn=lambda g, attrs, ins: [(ins[0].shape,
+                                                      ins[0].dtype)])
 op_registry.register_pure("StridedSlice", lambda x, *dyn, spec: _strided_impl(
     x, dyn, spec))
 op_registry.register_pure("BroadcastTo", lambda x, shape: jnp.broadcast_to(x, shape))
@@ -185,12 +188,28 @@ def _tensor_diag_part(x):
     return jnp.reshape(jnp.diagonal(flat), lead)
 
 
-def _check_numerics_impl(x, message):
-    from jax.experimental import checkify  # noqa: F401
+def _check_numerics_impl(ctx, op, x):
+    # In-graph numeric check (ref core/kernels/check_numerics_op.cc).
+    # TPU-native: a hard device assert would stall the pipeline, so the
+    # non-finite flag is computed in the compiled step (fuses with the
+    # producer) and fetched with the results; the Session raises
+    # InvalidArgumentError host-side when a flag is set. Inside lax control
+    # flow / shard_map the flag cannot escape the trace — the check is a
+    # pass-through there (matches XLA's structured-control-flow limits).
+    message = op.attrs.get("message", "")
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return x
+    if ctx.host:
+        if not np.all(np.isfinite(np.asarray(x, np.float64))):
+            from ..framework import errors
 
-    # In-graph numeric check: replaces NaN/Inf detection kernel
-    # (ref core/kernels/check_numerics_op.cc). Uses debug_check to avoid
-    # breaking fusion; stf.debug installs stricter hooks.
+            raise errors.InvalidArgumentError(
+                None, op, f"{message} : Tensor had NaN/Inf values")
+        return x
+    if ctx.in_control_flow or ctx.in_shard_map:
+        return x
+    flag = jnp.logical_not(jnp.all(jnp.isfinite(x)))
+    ctx.numeric_checks.append((f"{op.name}: {message}", flag))
     return x
 
 

@@ -1,0 +1,261 @@
+"""Misc ops: confusion_matrix, histogram, bitcast, sets, special math
+(ref: tensorflow/python/ops/{confusion_matrix,histogram_ops,sets_impl,
+special_math_ops}.py, core/kernels/bitcast_op.cc).
+
+Set ops: the reference returns SparseTensors from variable-length set
+results; XLA needs static shapes, so set ops here are dense-membership
+formulations — results come back as fixed-size masks/padded values, the
+TPU-native shape discipline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from .op_util import make_op
+
+# -- confusion matrix --------------------------------------------------------
+
+op_registry.register_pure(
+    "ConfusionMatrix",
+    lambda labels, predictions, weights=None, num_classes=0:
+        jnp.zeros((num_classes, num_classes),
+                  jnp.float64 if weights is not None else jnp.int32
+                  ).at[labels, predictions].add(
+                      1 if weights is None else weights))
+
+
+def confusion_matrix(labels, predictions, num_classes=None, dtype=None,
+                     name=None, weights=None):
+    """(ref: confusion_matrix.py:105 ``confusion_matrix``). num_classes must
+    be static on TPU (output shape)."""
+    from . import math_ops
+
+    labels = ops_mod.convert_to_tensor(labels)
+    predictions = ops_mod.convert_to_tensor(predictions)
+    if num_classes is None:
+        raise ValueError(
+            "confusion_matrix on TPU needs static num_classes (dynamic "
+            "max(labels)+1 would make the output shape data-dependent)")
+    n = int(num_classes)
+    inputs = [labels, predictions]
+    if weights is not None:
+        inputs.append(ops_mod.convert_to_tensor(weights))
+    out_dtype = dtypes_mod.as_dtype(dtype) if dtype else (
+        dtypes_mod.float64 if weights is not None else dtypes_mod.int32)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("ConfusionMatrix", inputs,
+                     attrs={"num_classes": n},
+                     name=name or "confusion_matrix",
+                     output_specs=[(shape_mod.TensorShape([n, n]),
+                                    out_dtype)])
+    result = op.outputs[0]
+    if dtype is not None:
+        result = math_ops.cast(result, dtypes_mod.as_dtype(dtype))
+    return result
+
+
+# -- histogram ---------------------------------------------------------------
+
+def _histogram_fixed_width(values, lo, hi, nbins=100):
+    values = values.reshape(-1).astype(jnp.float32)
+    width = (hi - lo) / nbins
+    idx = jnp.clip(((values - lo) / width).astype(jnp.int32), 0, nbins - 1)
+    return jnp.zeros((nbins,), jnp.int32).at[idx].add(1)
+
+
+op_registry.register_pure(
+    "HistogramFixedWidth",
+    lambda values, lo, hi, nbins=100: _histogram_fixed_width(
+        values, lo, hi, nbins))
+
+
+def histogram_fixed_width(values, value_range, nbins=100, dtype=None,
+                          name=None):
+    """(ref: histogram_ops.py:30)."""
+    values = ops_mod.convert_to_tensor(values)
+    lo = ops_mod.convert_to_tensor(value_range[0],
+                                   dtype=dtypes_mod.float32)
+    hi = ops_mod.convert_to_tensor(value_range[1],
+                                   dtype=dtypes_mod.float32)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("HistogramFixedWidth", [values, lo, hi],
+                     attrs={"nbins": int(nbins)},
+                     name=name or "histogram_fixed_width",
+                     output_specs=[(shape_mod.TensorShape([int(nbins)]),
+                                    dtypes_mod.int32)])
+    return op.outputs[0]
+
+
+# -- bitcast -----------------------------------------------------------------
+
+def bitcast(input, type, name=None):  # noqa: A002
+    """(ref: bitcast_op.cc): reinterpret bytes. Same-size dtypes keep the
+    shape; smaller target dtypes append an axis (XLA semantics, which the
+    reference matches). Lowers through the math_ops "Bitcast" pure op
+    (jax.lax.bitcast_convert_type)."""
+    x = ops_mod.convert_to_tensor(input)
+    dst = dtypes_mod.as_dtype(type)
+    in_shape = x.shape.as_list() if x.shape.rank is not None else None
+    if in_shape is not None:
+        src_b = np.dtype(x.dtype.as_numpy_dtype).itemsize
+        dst_b = np.dtype(dst.as_numpy_dtype).itemsize
+        if src_b == dst_b:
+            out_shape = in_shape
+        elif src_b > dst_b:
+            out_shape = in_shape + [src_b // dst_b]
+        else:
+            out_shape = in_shape[:-1]
+        out_shape = shape_mod.TensorShape(out_shape)
+    else:
+        out_shape = shape_mod.TensorShape(None)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("Bitcast", [x],
+                     attrs={"dtype": dst},
+                     name=name or "bitcast",
+                     output_specs=[(out_shape, dst)])
+    return op.outputs[0]
+
+
+# -- sets (dense-membership formulations) ------------------------------------
+
+def _pad_val(dtype):
+    return jnp.iinfo(dtype).min if jnp.issubdtype(dtype, jnp.integer) \
+        else -jnp.inf
+
+
+def _set_size(a):
+    """Count distinct non-pad values per row; a: (..., n) sorted-agnostic."""
+    s = jnp.sort(a, axis=-1)
+    first = jnp.ones(s.shape[:-1] + (1,), bool)
+    new = jnp.concatenate([first, s[..., 1:] != s[..., :-1]], axis=-1)
+    valid = s != _pad_val(s.dtype)
+    return jnp.sum(new & valid, axis=-1).astype(jnp.int32)
+
+
+op_registry.register_pure("SetSize", lambda a: _set_size(a))
+
+
+def _membership(a, b):
+    """mask over a's last axis: a[i] in b (rowwise)."""
+    return (a[..., :, None] == b[..., None, :]).any(axis=-1)
+
+
+def _set_intersection(a, b):
+    pad = _pad_val(a.dtype)
+    keep = _membership(a, b) & (a != pad)
+    vals = jnp.where(keep, a, pad)
+    s = jnp.sort(vals, axis=-1)  # pad (min) sorts first; dedupe
+    dup = jnp.concatenate(
+        [jnp.zeros(s.shape[:-1] + (1,), bool), s[..., 1:] == s[..., :-1]],
+        axis=-1)
+    return jnp.where(dup, pad, s)
+
+
+def _set_difference(a, b, aminusb=True):
+    if not aminusb:
+        a, b = b, a
+    pad = _pad_val(a.dtype)
+    keep = (~_membership(a, b)) & (a != pad)
+    vals = jnp.where(keep, a, pad)
+    s = jnp.sort(vals, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros(s.shape[:-1] + (1,), bool), s[..., 1:] == s[..., :-1]],
+        axis=-1)
+    return jnp.where(dup, pad, s)
+
+
+def _set_union(a, b):
+    pad = _pad_val(a.dtype)
+    both = jnp.concatenate([a, b], axis=-1)
+    s = jnp.sort(both, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros(s.shape[:-1] + (1,), bool), s[..., 1:] == s[..., :-1]],
+        axis=-1)
+    return jnp.where(dup, pad, s)
+
+
+op_registry.register_pure("SetIntersection",
+                          lambda a, b: _set_intersection(a, b))
+op_registry.register_pure("SetDifference",
+                          lambda a, b, aminusb=True: _set_difference(
+                              a, b, aminusb))
+op_registry.register_pure("SetUnion", lambda a, b: _set_union(a, b))
+
+
+def _set_binary(op_type, a, b, extra_attrs=None, width=None, name=None):
+    a = ops_mod.convert_to_tensor(a)
+    b = ops_mod.convert_to_tensor(b)
+    g = ops_mod.get_default_graph()
+    if width is None:
+        ash = a.shape.as_list() if a.shape.rank is not None else None
+        out_shape = shape_mod.TensorShape(ash)
+    else:
+        out_shape = shape_mod.TensorShape(
+            (a.shape.as_list()[:-1] if a.shape.rank else [None]) + [width])
+    op = g.create_op(op_type, [a, b], attrs=extra_attrs or {},
+                     name=name or op_type,
+                     output_specs=[(out_shape, a.dtype)])
+    return op.outputs[0]
+
+
+def set_intersection(a, b, name=None):
+    """Padded-dense set intersection (pad = dtype min; ref sets_impl.py
+    returns a SparseTensor — see module docstring for the TPU shape rule)."""
+    return _set_binary("SetIntersection", a, b, name=name)
+
+
+def set_difference(a, b, aminusb=True, name=None):
+    return _set_binary("SetDifference", a, b,
+                       extra_attrs={"aminusb": bool(aminusb)}, name=name)
+
+
+def set_union(a, b, name=None):
+    a_t = ops_mod.convert_to_tensor(a)
+    b_t = ops_mod.convert_to_tensor(b)
+    w = None
+    if a_t.shape.rank is not None and b_t.shape.rank is not None:
+        an, bn = a_t.shape.as_list()[-1], b_t.shape.as_list()[-1]
+        if an is not None and bn is not None:
+            w = an + bn
+    return _set_binary("SetUnion", a_t, b_t, width=w, name=name)
+
+
+def set_size(a, validate_indices=True, name=None):
+    a = ops_mod.convert_to_tensor(a)
+    g = ops_mod.get_default_graph()
+    out_shape = shape_mod.TensorShape(
+        a.shape.as_list()[:-1] if a.shape.rank is not None else None)
+    op = g.create_op("SetSize", [a], name=name or "set_size",
+                     output_specs=[(out_shape, dtypes_mod.int32)])
+    return op.outputs[0]
+
+
+SET_PAD = _pad_val  # exposed for tests/users to identify padding
+
+
+# -- special math ------------------------------------------------------------
+
+def lbeta(x, name=None):
+    """(ref: special_math_ops.py:34 ``lbeta``): log(|Beta(x)|) reduced over
+    the last axis."""
+    from . import math_ops
+
+    x = ops_mod.convert_to_tensor(x)
+    with ops_mod.name_scope(name or "lbeta"):
+        log_gamma = math_ops.lgamma(x)
+        sum_log_gamma = math_ops.reduce_sum(log_gamma, axis=-1)
+        log_gamma_sum = math_ops.lgamma(math_ops.reduce_sum(x, axis=-1))
+        return sum_log_gamma - log_gamma_sum
+
+
+def einsum(equation, *inputs, name=None):
+    from . import math_ops
+
+    return math_ops.einsum(equation, *inputs)

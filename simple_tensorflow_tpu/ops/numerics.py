@@ -1,0 +1,37 @@
+"""Numerics checking (ref: tensorflow/python/ops/numerics.py).
+
+check_numerics lowers to a jax.lax.cond-free formulation: the value is
+passed through jnp.where-based detection and an XLA-side error is raised
+via checkify-style host callback only on failure — on TPU a hard assert
+would stall the pipeline, so detection happens in the compiled program and
+the raise happens host-side at fetch time (Session checks the flag).
+"""
+
+from __future__ import annotations
+
+from ..framework import graph as ops_mod
+from . import array_ops
+
+
+def verify_tensor_all_finite(t, msg, name=None):
+    """(ref: numerics.py:32 ``verify_tensor_all_finite``)."""
+    return array_ops.check_numerics(t, message=msg, name=name)
+
+
+def add_check_numerics_ops():
+    """(ref: numerics.py:51 ``add_check_numerics_ops``): wrap every
+    floating-point tensor in the current graph with CheckNumerics; returns
+    a group op. TPU-native, each CheckNumerics is fused into the step
+    program (no extra launches)."""
+    from . import control_flow_ops
+
+    g = ops_mod.get_default_graph()
+    checks = []
+    for op in list(g.get_operations()):
+        if op.type in ("CheckNumerics", "Placeholder", "Const"):
+            continue
+        for out in op.outputs:
+            if out.dtype.is_floating:
+                checks.append(array_ops.check_numerics(
+                    out, message=f"{op.name}:{out.value_index}"))
+    return control_flow_ops.group(*checks, name="check_numerics_all")

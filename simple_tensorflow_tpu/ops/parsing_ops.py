@@ -1,0 +1,215 @@
+"""Example-proto parsing ops (ref: tensorflow/python/ops/parsing_ops.py,
+core/kernels/example_parsing_ops.cc).
+
+Parsing runs in the Session's host stage (strings never enter XLA — the
+reference pins these kernels to CPU for the same reason); the parsed dense
+tensors are then fed into the compiled step like any other feed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import sparse_tensor as sparse_mod
+from ..framework import tensor_shape as shape_mod
+from ..lib import example as example_mod
+from .op_util import make_op
+
+
+class FixedLenFeature:
+    """(ref: parsing_ops.py ``FixedLenFeature``)."""
+
+    def __init__(self, shape, dtype, default_value=None):
+        self.shape = list(shape)
+        self.dtype = dtypes_mod.as_dtype(dtype)
+        self.default_value = default_value
+
+
+class VarLenFeature:
+    """(ref: parsing_ops.py ``VarLenFeature``). Parses to a dense padded
+    tensor + length vector on TPU (COO SparseTensor needs dynamic shapes
+    XLA can't compile); `parse_example` returns a SparseTensorValue-like
+    triple via host stage."""
+
+    def __init__(self, dtype):
+        self.dtype = dtypes_mod.as_dtype(dtype)
+
+
+def _feature_values(feature, dtype):
+    if dtype == dtypes_mod.string:
+        return (np.asarray(feature.bytes_list.value, dtype=object)
+                if feature.bytes_list else np.asarray([], dtype=object))
+    if dtype.is_floating:
+        return (np.asarray(feature.float_list.value, np.float32)
+                if feature.float_list else np.zeros((0,), np.float32))
+    return (np.asarray(feature.int64_list.value, np.int64)
+            if feature.int64_list else np.zeros((0,), np.int64))
+
+
+def parse_example_py(serialized, features):
+    """Host parser: list[bytes] -> {name: ndarray or (indices,values,shape)}.
+
+    FixedLenFeature -> dense [batch] + shape; VarLenFeature -> COO triple.
+    """
+    batch = [example_mod.Example.FromString(bytes(s)) for s in serialized]
+    out = {}
+    for name, spec in features.items():
+        if isinstance(spec, FixedLenFeature):
+            n = int(np.prod(spec.shape)) if spec.shape else 1
+            rows = []
+            for ex in batch:
+                f = ex.features.feature.get(name)
+                vals = (_feature_values(f, spec.dtype) if f is not None
+                        else np.zeros((0,),))
+                if len(vals) == 0:
+                    if spec.default_value is None:
+                        raise ValueError(
+                            f"feature {name!r} missing and no default")
+                    vals = np.ravel(np.asarray(spec.default_value))
+                    if vals.shape[0] == 1 and n > 1:
+                        vals = np.repeat(vals, n)
+                if len(vals) != n:
+                    raise ValueError(
+                        f"feature {name!r}: got {len(vals)} values, "
+                        f"expected {n}")
+                rows.append(np.reshape(vals, spec.shape))
+            arr = np.stack(rows) if rows else np.zeros([0] + spec.shape)
+            if spec.dtype != dtypes_mod.string:
+                arr = arr.astype(spec.dtype.as_numpy_dtype)
+            out[name] = arr
+        elif isinstance(spec, VarLenFeature):
+            indices, values = [], []
+            max_len = 0
+            for i, ex in enumerate(batch):
+                f = ex.features.feature.get(name)
+                vals = (_feature_values(f, spec.dtype) if f is not None
+                        else np.zeros((0,)))
+                max_len = max(max_len, len(vals))
+                for j, v in enumerate(vals):
+                    indices.append((i, j))
+                    values.append(v)
+            idx = (np.asarray(indices, np.int64) if indices
+                   else np.zeros((0, 2), np.int64))
+            if spec.dtype == dtypes_mod.string:
+                val = np.asarray(values, dtype=object)
+            else:
+                val = np.asarray(values,
+                                 dtype=spec.dtype.as_numpy_dtype)
+            out[name] = (idx, val,
+                         np.asarray([len(batch), max_len], np.int64))
+        else:
+            raise TypeError(f"unsupported feature spec {type(spec)}")
+    return out
+
+
+# -- graph ops (host stage) -------------------------------------------------
+
+def _register_parse_op():
+    def lower(ctx, op, inputs):
+        (serialized,) = inputs
+        feats = op.attrs["_features"]
+        single = op.attrs.get("_single", False)
+        parsed = parse_example_py(np.ravel(np.asarray(serialized, object)),
+                                  feats)
+        flat = []
+        for name in sorted(feats):
+            v = parsed[name]
+            if isinstance(v, tuple):
+                flat.extend(v)
+            elif single:  # strip the synthetic batch dim on host
+                flat.append(v[0])
+            else:
+                flat.append(v)
+        return flat
+
+    op_registry.register("ParseExample", lower=lower, is_stateful=True,
+                         runs_on_host=True, n_outputs=None)
+
+
+_register_parse_op()
+
+
+def _parse_example_graph(serialized, features, name, single):
+    serialized = ops_mod.convert_to_tensor(serialized)
+    g = ops_mod.get_default_graph()
+    batch = serialized.shape[0] if serialized.shape.rank else None
+    specs = []
+    names = sorted(features)
+    for n in names:
+        spec = features[n]
+        if isinstance(spec, FixedLenFeature):
+            lead = [] if single else [batch]
+            specs.append((shape_mod.TensorShape(lead + spec.shape),
+                          spec.dtype))
+        else:  # VarLen -> indices, values, dense_shape
+            specs.append((shape_mod.TensorShape([None, 2]), dtypes_mod.int64))
+            specs.append((shape_mod.TensorShape([None]), spec.dtype))
+            specs.append((shape_mod.TensorShape([2]), dtypes_mod.int64))
+    op = g.create_op("ParseExample", [serialized],
+                     attrs={"_features": features, "_single": single},
+                     name=name or "ParseExample", output_specs=specs)
+    out = {}
+    i = 0
+    for n in names:
+        spec = features[n]
+        if isinstance(spec, FixedLenFeature):
+            out[n] = op.outputs[i]
+            i += 1
+        else:
+            out[n] = sparse_mod.SparseTensor(op.outputs[i], op.outputs[i + 1],
+                                             op.outputs[i + 2])
+            i += 3
+    return out
+
+
+def parse_example(serialized, features, name=None, example_names=None):
+    """(ref: parsing_ops.py:358 ``parse_example``). serialized: 1-D string
+    tensor. Returns {name: Tensor} for FixedLen and {name: SparseTensor}
+    for VarLen features."""
+    return _parse_example_graph(serialized, features, name, single=False)
+
+
+def parse_single_example(serialized, features, name=None):
+    """(ref: parsing_ops.py ``parse_single_example``): scalar serialized.
+    The batch-dim handling happens inside the host parse op (np.ravel makes
+    the scalar a batch of one; host-op outputs cannot feed device ops in
+    the two-stage execution model)."""
+    serialized = ops_mod.convert_to_tensor(serialized)
+    return _parse_example_graph(serialized, features, name, single=True)
+
+
+def decode_raw(bytes_tensor, out_type, little_endian=True, name=None):
+    """(ref: parsing_ops.py ``decode_raw``): bytes -> numeric vector."""
+    out_type = dtypes_mod.as_dtype(out_type)
+
+    def host_fn(vals, _dtype=out_type):
+        flat = np.ravel(np.asarray(vals, dtype=object))
+        rows = [np.frombuffer(
+            v if isinstance(v, bytes) else str(v).encode(),
+            dtype=_dtype.as_numpy_dtype) for v in flat]
+        n = {len(r) for r in rows}
+        if len(n) > 1:
+            raise ValueError("decode_raw: records have unequal lengths")
+        arr = (np.stack(rows) if rows
+               else np.zeros((0, 0), _dtype.as_numpy_dtype))
+        return arr.reshape(np.asarray(vals, dtype=object).shape + (-1,))
+
+    op_type = f"DecodeRaw_{out_type.name}_{little_endian}"
+    if not op_registry.exists(op_type):
+        def lower(ctx, op, inputs, fn=host_fn):
+            return [fn(inputs[0])]
+
+        op_registry.register(op_type, lower=lower, is_stateful=True,
+                             runs_on_host=True)
+    bytes_tensor = ops_mod.convert_to_tensor(bytes_tensor)
+    g = ops_mod.get_default_graph()
+    in_shape = (bytes_tensor.shape.as_list()
+                if bytes_tensor.shape.rank is not None else None)
+    out_shape = shape_mod.TensorShape(
+        (in_shape + [None]) if in_shape is not None else None)
+    op = g.create_op(op_type, [bytes_tensor], name=name or "DecodeRaw",
+                     output_specs=[(out_shape, out_type)])
+    return op.outputs[0]

@@ -1,0 +1,155 @@
+"""TensorArray (ref: tensorflow/python/ops/tensor_array_ops.py,
+core/kernels/tensor_array.cc).
+
+The reference's TensorArray is a per-step resource of independently-sized
+buffers driven by the dynamic executor. On TPU that representation can't
+exist: XLA needs static shapes. The TPU-native TensorArray is a *stacked
+dense buffer* (size, *element_shape) threaded functionally — write lowers
+to lax.dynamic_update_index_in_dim, read to dynamic_index_in_dim; both are
+O(1) in-place updates under XLA (the buffer is donated along the chain).
+``size`` must be static; element shapes must agree — the same constraints
+lax.scan imposes, because that is what the hardware supports.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from .op_util import make_op
+
+op_registry.register_pure(
+    "TensorArrayWrite",
+    lambda buf, index, value: jax.lax.dynamic_update_index_in_dim(
+        buf, value.astype(buf.dtype), index, axis=0))
+op_registry.register_pure(
+    "TensorArrayRead",
+    lambda buf, index: jax.lax.dynamic_index_in_dim(buf, index, axis=0,
+                                                    keepdims=False))
+op_registry.register_pure(
+    "TensorArrayScatter",
+    lambda buf, indices, values: buf.at[indices].set(
+        values.astype(buf.dtype)))
+
+
+class TensorArray:
+    """Functional TensorArray; every mutator returns a new TensorArray
+    sharing the graph (the reference mutates a resource and returns a flow
+    token — ref tensor_array_ops.py:120 — our buffer IS the flow)."""
+
+    def __init__(self, dtype, size=None, element_shape=None,
+                 dynamic_size=False, clear_after_read=True,
+                 tensor_array_name=None, infer_shape=True, name=None,
+                 _buffer=None):
+        if dynamic_size:
+            raise NotImplementedError(
+                "dynamic_size=True needs dynamic shapes; XLA/TPU requires "
+                "a static size (use a python list at graph-build time)")
+        self._dtype = dtypes_mod.as_dtype(dtype)
+        self._name = name or "TensorArray"
+        if _buffer is not None:
+            self._buffer = _buffer
+            self._size = int(_buffer.shape[0])
+            return
+        if size is None:
+            raise ValueError("TensorArray needs a static size")
+        self._size = int(size) if not isinstance(size, ops_mod.Tensor) \
+            else int(size.op.attrs.get("value"))
+        if element_shape is None:
+            raise ValueError(
+                "TPU TensorArray needs element_shape up front (static "
+                "shapes); pass element_shape= or use ta.unstack")
+        es = shape_mod.TensorShape(element_shape).as_list()
+        from . import array_ops
+
+        self._buffer = array_ops.zeros([self._size] + es, dtype=self._dtype,
+                                       name=f"{self._name}_buf")
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def flow(self):
+        """The buffer doubles as the flow token (ref flow_out)."""
+        return self._buffer
+
+    def size(self, name=None):
+        from ..framework import constant_op
+
+        return constant_op.constant(self._size, dtype=dtypes_mod.int32)
+
+    def _with(self, buffer):
+        return TensorArray(self._dtype, name=self._name, _buffer=buffer)
+
+    def write(self, index, value, name=None):
+        index = ops_mod.convert_to_tensor(index, dtype=dtypes_mod.int32)
+        value = ops_mod.convert_to_tensor(value, dtype=self._dtype)
+        buf = make_op("TensorArrayWrite", [self._buffer, index, value],
+                      name=name or f"{self._name}_write")
+        return self._with(buf)
+
+    def read(self, index, name=None):
+        index = ops_mod.convert_to_tensor(index, dtype=dtypes_mod.int32)
+        return make_op("TensorArrayRead", [self._buffer, index],
+                       name=name or f"{self._name}_read")
+
+    def stack(self, name=None):
+        from . import array_ops
+
+        return array_ops.identity(self._buffer,
+                                  name=name or f"{self._name}_stack")
+
+    def unstack(self, value, name=None):
+        value = ops_mod.convert_to_tensor(value, dtype=self._dtype)
+        return self._with(value)
+
+    def gather(self, indices, name=None):
+        from . import array_ops
+
+        return array_ops.gather(self._buffer, indices,
+                                name=name or f"{self._name}_gather")
+
+    def scatter(self, indices, value, name=None):
+        indices = ops_mod.convert_to_tensor(indices, dtype=dtypes_mod.int32)
+        value = ops_mod.convert_to_tensor(value, dtype=self._dtype)
+        buf = make_op("TensorArrayScatter", [self._buffer, indices, value],
+                      name=name or f"{self._name}_scatter")
+        return self._with(buf)
+
+    def concat(self, name=None):
+        from . import array_ops
+
+        shp = self._buffer.shape.as_list()
+        return array_ops.reshape(
+            self._buffer, [-1] + shp[2:],
+            name=name or f"{self._name}_concat")
+
+    def split(self, value, lengths, name=None):
+        """Equal-length split only (static shapes)."""
+        from . import array_ops
+
+        value = ops_mod.convert_to_tensor(value, dtype=self._dtype)
+        n = self._size
+        shp = value.shape.as_list()
+        if shp[0] is None or shp[0] % n != 0:
+            raise ValueError("TPU TensorArray.split needs equal static "
+                             f"lengths; got leading dim {shp[0]} over {n}")
+        return self._with(array_ops.reshape(
+            value, [n, shp[0] // n] + shp[1:],
+            name=name or f"{self._name}_split"))
+
+    def grad(self, source, flow=None, name=None):
+        return self  # gradients flow through the buffer (jax.vjp)
+
+    def identity(self):
+        return self
+
+    def close(self, name=None):
+        from . import control_flow_ops
+
+        return control_flow_ops.no_op(name=name or f"{self._name}_close")

@@ -1,0 +1,12 @@
+"""tf.sets namespace (ref: tensorflow/python/ops/sets_impl.py).
+Dense-membership formulations — see ops/misc_ops.py for the TPU shape
+rationale."""
+
+from .ops.misc_ops import (  # noqa: F401
+    set_intersection, set_difference, set_union, set_size,
+)
+
+intersection = set_intersection
+difference = set_difference
+union = set_union
+size = set_size

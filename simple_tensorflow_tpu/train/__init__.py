@@ -55,3 +55,9 @@ from .supervisor import Supervisor
 from .basic_loops import basic_train_loop
 from .evaluation import evaluate_once, evaluate_repeatedly, checkpoints_iterator
 from .slot_creator import create_slot, create_zeros_slot
+
+# Example protos (ref: tf.train.Example family, core/example/example.proto)
+from ..lib.example import (
+    Example, Features, Feature, BytesList, FloatList, Int64List,
+    bytes_feature, float_feature, int64_feature, make_example,
+)

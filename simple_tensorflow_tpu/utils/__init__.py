@@ -1,1 +1,3 @@
+"""Utility subsystems: perf tracing/MFU/roofline (stf.utils.perf)."""
 
+from . import perf  # noqa: F401

@@ -1,0 +1,164 @@
+"""Performance tracing: step timing, MFU, roofline estimates
+(ref role: tensorflow/core/common_runtime/step_stats_collector.cc + the
+timeline tooling; TPU-native it reads XLA cost analysis + jax.profiler).
+
+- StepTimer: wall-per-step ring buffer with percentile summary.
+- mfu(): achieved FLOP/s over the chip's bf16 peak from the compiled
+  executable's XLA cost analysis (flops) + measured step time.
+- roofline(): bytes-accessed/flops arithmetic intensity vs the chip's
+  HBM bandwidth — says whether a step is compute- or bandwidth-bound.
+- trace(): context manager around jax.profiler for chrome://tracing dumps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# per-chip peaks (bf16 FLOP/s, HBM bytes/s)
+_CHIP_SPECS = {
+    "v5e": (197e12, 819e9),
+    "v5 lite": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v3": (123e12, 900e9),
+    "v2": (46e12, 700e9),
+}
+_DEFAULT_SPEC = (197e12, 819e9)
+
+
+def chip_spec(device=None):
+    """(peak_flops, peak_hbm_bw) for the attached device."""
+    import jax
+
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    for key, spec in _CHIP_SPECS.items():
+        if key in kind:
+            return spec
+    if d.platform == "cpu":
+        return (1e12, 100e9)  # nominal, for CI math
+    return _DEFAULT_SPEC
+
+
+class StepTimer:
+    """Wall-clock per-step stats; call mark() after each synced step."""
+
+    def __init__(self, window=200):
+        self._times: List[float] = []
+        self._window = window
+        self._last: Optional[float] = None
+
+    def start(self):
+        self._last = time.perf_counter()
+
+    def mark(self) -> float:
+        now = time.perf_counter()
+        dt = now - (self._last if self._last is not None else now)
+        self._last = now
+        self._times.append(dt)
+        if len(self._times) > self._window:
+            self._times.pop(0)
+        return dt
+
+    @property
+    def steps(self) -> int:
+        return len(self._times)
+
+    def summary(self) -> Dict[str, float]:
+        if not self._times:
+            return {}
+        a = np.asarray(self._times)
+        return {"mean_s": float(a.mean()),
+                "p50_s": float(np.percentile(a, 50)),
+                "p90_s": float(np.percentile(a, 90)),
+                "steps_per_sec": float(1.0 / a.mean())}
+
+
+def cost_of(compiled) -> Dict[str, float]:
+    """Normalize jax cost analysis across versions: {flops, bytes}."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not ca:
+        return {}
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed", 0.0))}
+
+
+def mfu(step_flops: float, step_seconds: float, device=None) -> float:
+    """Model FLOPs Utilization: achieved/peak."""
+    peak, _ = chip_spec(device)
+    if step_seconds <= 0 or peak <= 0:
+        return 0.0
+    return step_flops / step_seconds / peak
+
+
+def roofline(step_flops: float, step_bytes: float, device=None
+             ) -> Dict[str, float]:
+    """Arithmetic intensity vs the machine ridge point: intensity >
+    ridge -> compute-bound (good: MXU busy); below -> HBM-bound (fuse
+    more / recompute instead of re-reading)."""
+    peak_flops, peak_bw = chip_spec(device)
+    intensity = step_flops / step_bytes if step_bytes else float("inf")
+    ridge = peak_flops / peak_bw
+    attainable = min(peak_flops, intensity * peak_bw)
+    return {"intensity_flops_per_byte": intensity,
+            "ridge_point": ridge,
+            "compute_bound": intensity >= ridge,
+            "attainable_flops": attainable,
+            "roofline_fraction_of_peak": attainable / peak_flops}
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace -> TensorBoard / chrome://tracing (perfetto)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (ref: tracing annotations)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class PerfReport:
+    """Combines a compiled step's cost analysis with measured wall time."""
+
+    def __init__(self, compiled=None, flops_per_step: Optional[float] = None,
+                 device=None):
+        self._cost = cost_of(compiled) if compiled is not None else {}
+        if flops_per_step is not None:
+            self._cost["flops"] = flops_per_step
+        self._device = device
+        self.timer = StepTimer()
+
+    def step_done(self):
+        return self.timer.mark()
+
+    def report(self) -> Dict[str, Any]:
+        s = self.timer.summary()
+        if not s:
+            return {}
+        out = dict(s)
+        flops = self._cost.get("flops")
+        if flops:
+            out["mfu"] = mfu(flops, s["mean_s"], self._device)
+            out["achieved_tflops"] = flops / s["mean_s"] / 1e12
+        if self._cost.get("bytes"):
+            out.update(roofline(self._cost.get("flops", 0.0),
+                                self._cost["bytes"], self._device))
+        return out

@@ -1,0 +1,333 @@
+"""Tests: TensorArray, Defun, Example/parsing, misc ops, graph optimizer
+passes, AOT compile, perf utils (SURVEY §2.1/§2.3/§2.10/§5)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+class TestTensorArray:
+    def test_write_read_stack(self):
+        ta = stf.TensorArray(stf.float32, size=3, element_shape=[2])
+        ta = ta.write(0, [1., 2.]).write(1, [3., 4.]).write(2, [5., 6.])
+        with stf.Session() as sess:
+            r, s = sess.run([ta.read(1), ta.stack()])
+        assert r.tolist() == [3., 4.]
+        assert s.tolist() == [[1., 2.], [3., 4.], [5., 6.]]
+
+    def test_unstack_gather_concat(self):
+        x = stf.constant(np.arange(12, dtype=np.float32).reshape(3, 2, 2))
+        ta = stf.TensorArray(stf.float32, size=3,
+                             element_shape=[2, 2]).unstack(x)
+        with stf.Session() as sess:
+            g = sess.run(ta.gather([2, 0]))
+            c = sess.run(ta.concat())
+        assert g.shape == (2, 2, 2) and g[0, 0, 0] == 8.0
+        assert c.shape == (6, 2)
+
+    def test_scatter_and_size(self):
+        ta = stf.TensorArray(stf.int32, size=4, element_shape=[])
+        ta = ta.scatter([1, 3], [10, 30])
+        with stf.Session() as sess:
+            assert sess.run(ta.stack()).tolist() == [0, 10, 0, 30]
+            assert int(sess.run(ta.size())) == 4
+
+    def test_gradient_through_tensor_array(self):
+        x = stf.constant([1.0, 2.0])
+        ta = stf.TensorArray(stf.float32, size=2, element_shape=[2])
+        ta = ta.write(0, x * 2.0).write(1, x * 3.0)
+        loss = stf.reduce_sum(ta.stack())
+        (gx,) = stf.gradients(loss, [x])
+        with stf.Session() as sess:
+            assert sess.run(gx).tolist() == [5.0, 5.0]
+
+    def test_dynamic_size_rejected(self):
+        with pytest.raises(NotImplementedError):
+            stf.TensorArray(stf.float32, size=2, element_shape=[1],
+                            dynamic_size=True)
+
+
+class TestDefun:
+    def test_call_and_shape_specialization(self):
+        calls = []
+
+        @stf.Defun(stf.float32, stf.float32)
+        def f(a, b):
+            calls.append(1)
+            return a * b + 1.0
+
+        y1 = f(stf.constant([1., 2.]), stf.constant([3., 4.]))
+        y2 = f(stf.constant([5., 6.]), stf.constant([7., 8.]))  # cache hit
+        y3 = f(stf.constant(2.0), stf.constant(3.0))  # new signature
+        with stf.Session() as sess:
+            assert sess.run(y1).tolist() == [4., 9.]
+            assert sess.run(y2).tolist() == [36., 49.]
+            assert float(sess.run(y3)) == 7.0
+        assert len(calls) == 2  # traced once per shape signature
+
+    def test_capture_and_gradient(self):
+        c = stf.constant(3.0)
+
+        @stf.Defun(stf.float32)
+        def g(x):
+            return x * x * c  # captures c
+
+        x = stf.constant(2.0)
+        y = g(x)
+        (dx,) = stf.gradients(y, [x])
+        with stf.Session() as sess:
+            assert float(sess.run(y)) == 12.0
+            assert float(sess.run(dx)) == 12.0  # 2*x*c
+
+    def test_multi_output(self):
+        @stf.Defun(stf.float32)
+        def h(x):
+            return x + 1.0, x * 2.0
+
+        a, b = h(stf.constant(4.0))
+        with stf.Session() as sess:
+            assert sess.run([a, b]) == [5.0, 8.0]
+
+
+class TestExampleProto:
+    def test_roundtrip(self):
+        ex = stf.train.Example(features=stf.train.Features(feature={
+            "label": stf.train.int64_feature(5),
+            "w": stf.train.float_feature([0.5, 2.5]),
+            "s": stf.train.bytes_feature([b"ab", b""]),
+        }))
+        data = ex.SerializeToString()
+        back = stf.train.Example.FromString(data)
+        assert back.features.feature["label"].int64_list.value == [5]
+        assert back.features.feature["w"].float_list.value == [0.5, 2.5]
+        assert back.features.feature["s"].bytes_list.value == [b"ab", b""]
+
+    def test_negative_int64(self):
+        ex = stf.train.make_example(v=[-3, 7])
+        back = stf.train.Example.FromString(ex.SerializeToString())
+        assert back.features.feature["v"].int64_list.value == [-3, 7]
+
+    def test_parse_example_graph(self):
+        exs = [stf.train.make_example(label=i, w=[float(i), 1.0],
+                                      tags=list(range(i)))
+               for i in range(3)]
+        sers = np.array([e.SerializeToString() for e in exs], dtype=object)
+        s = stf.placeholder(stf.string, [3])
+        feats = stf.parse_example(s, {
+            "label": stf.FixedLenFeature([], stf.int64),
+            "w": stf.FixedLenFeature([2], stf.float32),
+            "tags": stf.VarLenFeature(stf.int64),
+        })
+        with stf.Session() as sess:
+            out = sess.run(feats, {s: sers})
+        assert out["label"].tolist() == [0, 1, 2]
+        assert out["w"][2].tolist() == [2.0, 1.0]
+        assert out["tags"].values.tolist() == [0, 0, 1]
+        assert out["tags"].dense_shape.tolist() == [3, 2]
+
+    def test_parse_single_example(self):
+        data = stf.train.make_example(x=[1.5]).SerializeToString()
+        feats = stf.parse_single_example(
+            stf.constant(np.asarray(data, dtype=object)),
+            {"x": stf.FixedLenFeature([1], stf.float32)})
+        with stf.Session() as sess:
+            assert sess.run(feats["x"]).tolist() == [1.5]
+
+    def test_fixed_len_default(self):
+        data = stf.train.make_example(a=1).SerializeToString()
+        s = stf.placeholder(stf.string, [1])
+        feats = stf.parse_example(s, {
+            "missing": stf.FixedLenFeature([], stf.int64, default_value=9)})
+        with stf.Session() as sess:
+            out = sess.run(feats, {s: np.array([data], dtype=object)})
+        assert out["missing"].tolist() == [9]
+
+    def test_decode_raw(self):
+        s = stf.placeholder(stf.string, [2])
+        d = stf.decode_raw(s, stf.int16)
+        with stf.Session() as sess:
+            out = sess.run(d, {s: np.array(
+                [np.int16([1, 2]).tobytes(), np.int16([3, 4]).tobytes()],
+                dtype=object)})
+        assert out.tolist() == [[1, 2], [3, 4]]
+
+
+class TestMiscOps:
+    def test_confusion_matrix(self):
+        cm = stf.confusion_matrix(stf.constant([1, 2, 4]),
+                                  stf.constant([2, 2, 4]), num_classes=5)
+        with stf.Session() as sess:
+            m = sess.run(cm)
+        assert m[1, 2] == 1 and m[2, 2] == 1 and m[4, 4] == 1
+        assert m.sum() == 3
+
+    def test_confusion_matrix_weights(self):
+        cm = stf.confusion_matrix(stf.constant([0, 1]), stf.constant([0, 1]),
+                                  num_classes=2,
+                                  weights=stf.constant([0.5, 2.0]))
+        with stf.Session() as sess:
+            m = sess.run(cm)
+        assert m[0, 0] == 0.5 and m[1, 1] == 2.0
+
+    def test_histogram(self):
+        h = stf.histogram_fixed_width(
+            stf.constant([-1.0, 0.1, 0.49, 0.5, 2.0]), [0.0, 1.0], nbins=2)
+        with stf.Session() as sess:
+            # out-of-range clamps into edge bins (ref histogram_ops)
+            assert sess.run(h).tolist() == [3, 2]
+
+    def test_bitcast(self):
+        b = stf.bitcast(stf.constant([1.0], stf.float32), stf.uint32)
+        with stf.Session() as sess:
+            assert sess.run(b).tolist() == [0x3F800000]
+
+    def test_sets(self):
+        pad = np.iinfo(np.int32).min
+        a = stf.constant([[1, 2, 3], [4, 5, 6]])
+        b = stf.constant([[2, 3, 9], [7, 8, 9]])
+        with stf.Session() as sess:
+            inter = sess.run(stf.sets.intersection(a, b))
+            diff = sess.run(stf.sets.difference(a, b))
+            union = sess.run(stf.sets.union(a, b))
+            size = sess.run(stf.sets.size(a))
+        assert sorted(v for v in inter[0] if v != pad) == [2, 3]
+        assert [v for v in inter[1] if v != pad] == []
+        assert sorted(v for v in diff[0] if v != pad) == [1]
+        assert sorted(v for v in union[1] if v != pad) == [4, 5, 6, 7, 8, 9]
+        assert size.tolist() == [3, 3]
+
+    def test_lbeta(self):
+        # Beta(1,1) = 1 -> log 0 ; Beta(2,2) = 1/6
+        lb = stf.lbeta(stf.constant([[1.0, 1.0], [2.0, 2.0]]))
+        with stf.Session() as sess:
+            v = sess.run(lb)
+        np.testing.assert_allclose(v, [0.0, np.log(1 / 6)], atol=1e-5)
+
+    def test_verify_tensor_all_finite(self):
+        x = stf.placeholder(stf.float32, [2])
+        y = stf.verify_tensor_all_finite(x, "bad x") * 2.0
+        with stf.Session() as sess:
+            assert sess.run(y, {x: np.ones(2, np.float32)}).tolist() == [2., 2.]
+            with pytest.raises(stf.errors.InvalidArgumentError):
+                sess.run(y, {x: np.array([1.0, np.nan], np.float32)})
+
+
+class TestGraphOptimizer:
+    def _graphdef(self):
+        a = stf.constant(2.0, name="a")
+        b = stf.constant(3.0, name="b")
+        c = stf.add(a, b, name="c")  # foldable
+        x = stf.placeholder(stf.float32, [], name="x")
+        y1 = stf.multiply(x, c, name="y1")
+        y2 = stf.multiply(x, c, name="y2")  # CSE twin of y1
+        dead = stf.square(x, name="dead")
+        out = stf.add(y1, y2, name="out")
+        from simple_tensorflow_tpu.framework import graph_io
+
+        return graph_io.graph_to_graphdef(stf.get_default_graph()), out
+
+    def test_constant_folding(self):
+        gd, _ = self._graphdef()
+        folded = stf.graph_optimizer.constant_folding(gd)
+        c = [n for n in folded["node"] if n["name"] == "c"][0]
+        assert c["op"] == "Const"
+
+    def test_cse(self):
+        gd, _ = self._graphdef()
+        opt = stf.graph_optimizer.common_subexpression_elimination(gd)
+        names = [n["name"] for n in opt["node"]]
+        assert ("y1" in names) != ("y2" in names)  # one of the twins merged
+        out = [n for n in opt["node"] if n["name"] == "out"][0]
+        assert out["input"][0] == out["input"][1]
+
+    def test_dce(self):
+        gd, _ = self._graphdef()
+        pruned = stf.graph_optimizer.dead_code_elimination(gd, ["out"])
+        names = [n["name"] for n in pruned["node"]]
+        assert "dead" not in names and "out" in names
+
+    def test_full_pipeline_preserves_semantics(self):
+        gd, out = self._graphdef()
+        opt = stf.graph_optimizer.optimize(gd, keep=["out"])
+        # import the optimized graph and run both
+        with stf.Session() as sess:
+            ref = sess.run(out, {"x:0": np.float32(4.0)})
+        g2 = stf.Graph()
+        with g2.as_default():
+            from simple_tensorflow_tpu.framework import graph_io
+
+            graph_io.import_graph_def(opt, name="")
+            with stf.Session() as sess:
+                got = sess.run("out:0", {"x:0": np.float32(4.0)})
+        assert float(ref) == float(got) == 40.0
+
+
+class TestAot:
+    def test_compile_and_run(self):
+        from simple_tensorflow_tpu.compiler import aot
+
+        x = stf.placeholder(stf.float32, [4], name="x")
+        y = stf.reduce_sum(x * x)
+        exe = aot.compile_fetches(y, [x])
+        (out,) = exe(np.ones(4, np.float32) * 2.0)
+        assert float(out) == 16.0
+        assert "HloModule" in exe.hlo_text or "module" in exe.hlo_text
+        assert exe.cache_key
+
+    def test_stateful_rejected(self):
+        from simple_tensorflow_tpu.compiler import aot
+
+        v = stf.Variable(stf.ones([2]), name="v")
+        with pytest.raises(ValueError):
+            aot.compile_fetches(v.value() * 2.0, [])
+
+    def test_dynamic_shape_rejected(self):
+        from simple_tensorflow_tpu.compiler import aot
+
+        x = stf.placeholder(stf.float32, [None, 2], name="x")
+        with pytest.raises(ValueError):
+            aot.compile_fetches(stf.reduce_sum(x), [x])
+
+
+class TestPerf:
+    def test_mfu_and_roofline(self):
+        from simple_tensorflow_tpu.utils import perf
+
+        assert 0 < perf.mfu(1e12, 1.0) <= 1.0
+        r = perf.roofline(step_flops=1e12, step_bytes=1e9)
+        assert r["compute_bound"] == (r["intensity_flops_per_byte"]
+                                      >= r["ridge_point"])
+
+    def test_step_timer(self):
+        from simple_tensorflow_tpu.utils import perf
+
+        t = perf.StepTimer()
+        t.start()
+        for _ in range(3):
+            t.mark()
+        s = t.summary()
+        assert s["mean_s"] >= 0 and t.steps == 3
+
+    def test_perf_report_with_compiled(self):
+        import jax
+
+        from simple_tensorflow_tpu.utils import perf
+
+        f = jax.jit(lambda a, b: a @ b)
+        x = np.ones((64, 64), np.float32)
+        compiled = f.lower(x, x).compile()
+        rep = perf.PerfReport(compiled)
+        rep.timer.start()
+        f(x, x)
+        rep.step_done()
+        out = rep.report()
+        assert out.get("achieved_tflops", 0) >= 0
