@@ -34,6 +34,23 @@ class Dataset:
     # -- sources -------------------------------------------------------------
     @staticmethod
     def from_tensor_slices(tensors):
+        if isinstance(tensors, dict):
+            if not tensors:
+                raise ValueError("from_tensor_slices: empty dict")
+            arrays = {k: np.asarray(v) for k, v in tensors.items()}
+            lengths = {k: a.shape[0] if a.ndim else None
+                       for k, a in arrays.items()}
+            if None in lengths.values() or len(set(lengths.values())) > 1:
+                raise ValueError(
+                    f"from_tensor_slices: incompatible leading dimensions "
+                    f"{lengths}")
+            n = next(iter(lengths.values()))
+
+            def gen_dict():
+                for i in range(n):
+                    yield {k: a[i] for k, a in arrays.items()}
+
+            return Dataset(gen_dict)
         if isinstance(tensors, (list, tuple)):
             arrays = tuple(np.asarray(t) for t in tensors)
 
@@ -143,6 +160,12 @@ class Dataset:
 
         def gen():
             for x in src():
+                if isinstance(x, dict):
+                    arrays = {k: np.asarray(v) for k, v in x.items()}
+                    n = next(iter(arrays.values())).shape[0]
+                    for i in range(n):
+                        yield {k: a[i] for k, a in arrays.items()}
+                    continue
                 arrs = x if isinstance(x, tuple) else (x,)
                 for i in range(np.asarray(arrs[0]).shape[0]):
                     row = tuple(np.asarray(a)[i] for a in arrs)
@@ -317,6 +340,8 @@ class Iterator:
         _ITERATORS[self._name] = self
         self._peek = None
         self._spec = None
+        self._keys = None
+        self._structure = "single"
 
     def _next_value(self):
         if self._it is None:
@@ -339,10 +364,20 @@ class Iterator:
         if self._spec is None:
             probe_it = iter(self._dataset)
             first = next(probe_it)
-            items = first if isinstance(first, tuple) else (first,)
+            if isinstance(first, dict):
+                self._keys = sorted(first)
+                items = [first[k] for k in self._keys]
+                self._structure = "dict"
+            elif isinstance(first, tuple):
+                self._keys = None
+                items = list(first)
+                self._structure = "tuple"
+            else:
+                self._keys = None
+                items = [first]
+                self._structure = "single"
             self._spec = [(np.asarray(x).shape, np.asarray(x).dtype)
                           for x in items]
-            self._tuple = isinstance(first, tuple)
         g = ops_mod.get_default_graph()
         specs = [(shape_mod.TensorShape(list(sh)), dtypes_mod.as_dtype(dt))
                  for sh, dt in self._spec]
@@ -350,7 +385,11 @@ class Iterator:
                          attrs={"iterator": self._name},
                          name=name or "IteratorGetNext", output_specs=specs)
         outs = list(op.outputs)
-        return tuple(outs) if self._tuple else outs[0]
+        if self._structure == "dict":
+            return dict(zip(self._keys, outs))
+        if self._structure == "tuple":
+            return tuple(outs)
+        return outs[0]
 
 
 _ITERATORS = {}
@@ -359,7 +398,12 @@ _ITERATORS = {}
 def _lower_get_next(ctx, op, inputs):
     it = _ITERATORS[op.attrs["iterator"]]
     val = it._next_value()
-    items = val if isinstance(val, tuple) else (val,)
+    if isinstance(val, dict):
+        items = [val[k] for k in it._keys]
+    elif isinstance(val, tuple):
+        items = list(val)
+    else:
+        items = [val]
     return [np.asarray(x) for x in items]
 
 

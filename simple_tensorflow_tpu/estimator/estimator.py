@@ -126,6 +126,7 @@ class Estimator:
                     is_chief=True, checkpoint_dir=self._model_dir,
                     scaffold=spec.scaffold, hooks=all_hooks,
                     save_checkpoint_secs=self._config.save_checkpoints_secs,
+                    save_checkpoint_steps=self._config.save_checkpoints_steps,
                     save_summaries_steps=self._config.save_summary_steps,
                     log_step_count_steps=self._config.log_step_count_steps
             ) as sess:

@@ -284,7 +284,11 @@ def execute_ops(ctx: LoweringContext, op_list: Sequence[Operation],
     fed = fed or set()
     for op in op_list:
         already = all(o in ctx.env for o in op.outputs) and op.outputs
-        if already and not op.op_def.is_stateful:
+        # CapturedInput/FuncArg are bound values, not effects: when a branch
+        # returns a capture directly, its op is a prune target but its value
+        # is already in env — skip despite the stateful registration.
+        if already and (not op.op_def.is_stateful
+                        or op.type in ("CapturedInput", "FuncArg")):
             continue
         input_vals = []
         for t in op.inputs:

@@ -1,7 +1,7 @@
 """stf.nn namespace (ref: tensorflow/python/ops/nn.py)."""
 
 from ..ops.nn_ops import (
-    relu, relu6, elu, selu, gelu, leaky_relu, swish, silu,
+    relu, relu6, elu, selu, gelu, leaky_relu, swish, silu, crelu,
     softplus, softsign, softmax, log_softmax, l2_loss, bias_add,
     softmax_cross_entropy_with_logits, softmax_cross_entropy_with_logits_v2,
     sparse_softmax_cross_entropy_with_logits,

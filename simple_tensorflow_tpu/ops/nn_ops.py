@@ -253,6 +253,17 @@ def gelu(features, approximate=True, name=None):
     return unary("Gelu", features, name, attrs={"approximate": approximate})
 
 
+def crelu(features, axis=-1, name=None):
+    """(ref: nn_ops.py ``crelu``): concat(relu(x), relu(-x))."""
+    from . import array_ops
+    from . import math_ops
+
+    x = ops_mod.convert_to_tensor(features)
+    with ops_mod.name_scope(name or "CRelu"):
+        return array_ops.concat([relu(x), relu(math_ops.negative(x))],
+                                axis=axis)
+
+
 def leaky_relu(features, alpha=0.2, name=None):
     return unary("LeakyRelu", features, name, attrs={"alpha": alpha})
 

@@ -302,7 +302,9 @@ class SingularMonitoredSession(_MonitoredSession):
 
 def MonitoredTrainingSession(master="", is_chief=True, checkpoint_dir=None,
                              scaffold=None, hooks=None, chief_only_hooks=None,
-                             save_checkpoint_secs=600, save_summaries_steps=100,
+                             save_checkpoint_secs=600,
+                             save_checkpoint_steps=None,
+                             save_summaries_steps=100,
                              save_summaries_secs=None, config=None,
                              stop_grace_period_secs=120, log_step_count_steps=100,
                              max_wait_secs=7200):
@@ -315,7 +317,11 @@ def MonitoredTrainingSession(master="", is_chief=True, checkpoint_dir=None,
         if chief_only_hooks:
             all_hooks.extend(chief_only_hooks)
         if checkpoint_dir:
-            if save_checkpoint_secs and save_checkpoint_secs > 0:
+            if save_checkpoint_steps and save_checkpoint_steps > 0:
+                all_hooks.append(basic_session_run_hooks.CheckpointSaverHook(
+                    checkpoint_dir, save_steps=save_checkpoint_steps,
+                    scaffold=scaffold))
+            elif save_checkpoint_secs and save_checkpoint_secs > 0:
                 all_hooks.append(basic_session_run_hooks.CheckpointSaverHook(
                     checkpoint_dir, save_secs=save_checkpoint_secs,
                     scaffold=scaffold))

@@ -1,0 +1,220 @@
+"""Array op tests vs numpy (mirrors ref kernel_tests/*array*, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def _run(t, feed=None):
+    with stf.Session() as sess:
+        return sess.run(t, feed)
+
+
+RNG = np.random.RandomState(11)
+
+
+class TestShapes:
+    def test_reshape_transpose_expand_squeeze(self):
+        a = RNG.rand(2, 3, 4).astype(np.float32)
+        t = stf.constant(a)
+        out = _run({
+            "r": stf.reshape(t, [6, 4]),
+            "rm1": stf.reshape(t, [2, -1]),
+            "tr": stf.transpose(t, [2, 0, 1]),
+            "tr_def": stf.transpose(stf.constant(a[0])),
+            "ex": stf.expand_dims(t, 1),
+            "sq": stf.squeeze(stf.constant(a[:, :1, :]), axis=[1]),
+        })
+        assert out["r"].shape == (6, 4)
+        assert out["rm1"].shape == (2, 12)
+        np.testing.assert_allclose(out["tr"], a.transpose(2, 0, 1))
+        np.testing.assert_allclose(out["tr_def"], a[0].T)
+        assert out["ex"].shape == (2, 1, 3, 4)
+        assert out["sq"].shape == (2, 4)
+
+    def test_shape_size_rank(self):
+        t = stf.placeholder(stf.float32, [2, 3])
+        out = _run({"s": stf.shape(t), "n": stf.size(t), "rk": stf.rank(t)},
+                   {t: np.zeros((2, 3), np.float32)})
+        assert out["s"].tolist() == [2, 3]
+        assert out["n"] == 6 and out["rk"] == 2
+        # static shape inference
+        assert stf.reshape(t, [3, 2]).shape.as_list() == [3, 2]
+
+    def test_concat_split_stack_unstack(self):
+        a = RNG.rand(2, 3).astype(np.float32)
+        b = RNG.rand(2, 3).astype(np.float32)
+        ta, tb = stf.constant(a), stf.constant(b)
+        out = _run({
+            "c0": stf.concat([ta, tb], 0), "c1": stf.concat([ta, tb], 1),
+            "st": stf.stack([ta, tb], axis=1),
+        })
+        np.testing.assert_allclose(out["c0"], np.concatenate([a, b], 0))
+        np.testing.assert_allclose(out["c1"], np.concatenate([a, b], 1))
+        assert out["st"].shape == (2, 2, 3)
+        parts = stf.split(stf.constant(a), 3, axis=1)
+        assert len(parts) == 3
+        np.testing.assert_allclose(_run(parts[1]), a[:, 1:2])
+        us = stf.unstack(stf.constant(a), axis=0)
+        assert len(us) == 2
+        np.testing.assert_allclose(_run(us[1]), a[1])
+
+    def test_pad_tile_reverse(self):
+        a = np.array([[1, 2], [3, 4]], np.float32)
+        t = stf.constant(a)
+        out = _run({
+            "pad": stf.pad(t, [[1, 0], [0, 2]]),
+            "pad_refl": stf.pad(t, [[1, 1], [0, 0]], mode="REFLECT"),
+            "tile": stf.tile(t, [2, 1]),
+            "rev": stf.reverse(t, axis=[1]),
+        })
+        assert out["pad"].shape == (3, 4) and out["pad"][0, 0] == 0
+        np.testing.assert_allclose(out["pad_refl"],
+                                   np.pad(a, [[1, 1], [0, 0]], "reflect"))
+        assert out["tile"].shape == (4, 2)
+        np.testing.assert_allclose(out["rev"], a[:, ::-1])
+
+
+class TestSlicing:
+    def test_slice_strided_slice(self):
+        a = RNG.rand(4, 5, 6).astype(np.float32)
+        t = stf.constant(a)
+        out = _run({
+            "sl": stf.slice(t, [1, 0, 2], [2, 3, -1]),
+            "ss": stf.strided_slice(t, [0, 1, 0], [4, 5, 6], [2, 2, 3]),
+            "idx": t[1, :, 2:4],
+            "neg": t[:, -1],
+        })
+        np.testing.assert_allclose(out["sl"], a[1:3, 0:3, 2:])
+        np.testing.assert_allclose(out["ss"], a[::2, 1::2, ::3])
+        np.testing.assert_allclose(out["idx"], a[1, :, 2:4])
+        np.testing.assert_allclose(out["neg"], a[:, -1])
+
+    def test_gather_gather_nd_scatter_nd(self):
+        a = RNG.rand(5, 3).astype(np.float32)
+        t = stf.constant(a)
+        out = _run({
+            "g": stf.gather(t, [3, 1]),
+            "ga1": stf.gather(t, [0, 2], axis=1),
+            "gnd": stf.gather_nd(t, [[0, 1], [4, 2]]),
+            "snd": stf.scatter_nd([[1], [3]], [[1., 1., 1.], [2., 2., 2.]],
+                                  [5, 3]),
+        })
+        np.testing.assert_allclose(out["g"], a[[3, 1]])
+        np.testing.assert_allclose(out["ga1"], a[:, [0, 2]])
+        np.testing.assert_allclose(out["gnd"], [a[0, 1], a[4, 2]])
+        assert out["snd"][1].tolist() == [1., 1., 1.]
+        assert out["snd"][0].tolist() == [0., 0., 0.]
+
+    def test_boolean_mask_where(self):
+        a = np.array([1., 2., 3., 4.], np.float32)
+        mask = np.array([True, False, True, False])
+        out = _run({
+            "bm": stf.boolean_mask(stf.constant(a), stf.constant(mask)),
+            "wc": stf.where(stf.constant(mask), stf.constant(a),
+                            stf.constant(-a)),
+        })
+        assert out["bm"].tolist() == [1., 3.]
+        assert out["wc"].tolist() == [1., -2., 3., -4.]
+
+
+class TestConstruction:
+    def test_zeros_ones_fill_eye(self):
+        out = _run({
+            "z": stf.zeros([2, 3]), "o": stf.ones([3], stf.int32),
+            "f": stf.fill([2, 2], 7.0), "e": stf.eye(3),
+            "zl": stf.zeros_like(stf.constant([[1., 2.]])),
+            "ol": stf.ones_like(stf.constant([1, 2, 3])),
+        })
+        assert out["z"].sum() == 0 and out["z"].shape == (2, 3)
+        assert out["o"].tolist() == [1, 1, 1]
+        assert out["f"].tolist() == [[7., 7.], [7., 7.]]
+        np.testing.assert_allclose(out["e"], np.eye(3))
+        assert out["zl"].shape == (1, 2)
+        assert out["ol"].tolist() == [1, 1, 1]
+
+    def test_one_hot(self):
+        out = _run(stf.one_hot([1, 0, 2], 3, on_value=5.0, off_value=-1.0))
+        assert out[0].tolist() == [-1., 5., -1.]
+        assert out[2].tolist() == [-1., -1., 5.]
+
+    def test_sequence_mask(self):
+        out = _run(stf.sequence_mask([1, 3], maxlen=4))
+        assert out.tolist() == [[True, False, False, False],
+                                [True, True, True, False]]
+
+    def test_matrix_diag_band(self):
+        a = RNG.rand(3, 3).astype(np.float32)
+        out = _run({
+            "d": stf.matrix_diag(stf.constant([1., 2.])),
+            "dp": stf.matrix_diag_part(stf.constant(a)),
+            "band": stf.matrix_band_part(stf.constant(a), 0, 0),
+        })
+        assert out["d"].tolist() == [[1., 0.], [0., 2.]]
+        np.testing.assert_allclose(out["dp"], np.diag(a))
+        np.testing.assert_allclose(out["band"], np.diag(np.diag(a)))
+
+    def test_unique_invert_permutation(self):
+        u, idx = stf.unique(stf.constant([1, 2, 1, 3, 2]))
+        out = _run({"u": u, "idx": idx,
+                    "inv": stf.invert_permutation(stf.constant([2, 0, 1]))})
+        assert out["u"].tolist() == [1, 2, 3]
+        assert out["idx"].tolist() == [0, 1, 0, 2, 1]
+        assert out["inv"].tolist() == [1, 2, 0]
+
+
+class TestSpaceBatch:
+    def test_space_depth_roundtrip(self):
+        a = RNG.rand(1, 4, 4, 3).astype(np.float32)
+        t = stf.constant(a)
+        s2d = stf.space_to_depth(t, 2)
+        back = stf.depth_to_space(s2d, 2)
+        out = _run({"s2d": s2d, "back": back})
+        assert out["s2d"].shape == (1, 2, 2, 12)
+        np.testing.assert_allclose(out["back"], a)
+
+    def test_space_to_batch_roundtrip(self):
+        a = RNG.rand(1, 4, 4, 1).astype(np.float32)
+        t = stf.constant(a)
+        sb = stf.space_to_batch_nd(t, [2, 2], [[0, 0], [0, 0]])
+        back = stf.batch_to_space_nd(sb, [2, 2], [[0, 0], [0, 0]])
+        out = _run({"sb": sb, "back": back})
+        assert out["sb"].shape == (4, 2, 2, 1)
+        np.testing.assert_allclose(out["back"], a)
+
+
+class TestGradients:
+    def test_gather_grad_is_indexed(self):
+        x = stf.constant(RNG.rand(5, 2).astype(np.float32))
+        y = stf.reduce_sum(stf.gather(x, [1, 1, 3]))
+        (g,) = stf.gradients(y, [x])
+        out = _run(g)
+        if hasattr(out, "values"):  # IndexedSlices
+            dense = np.zeros((5, 2), np.float32)
+            np.add.at(dense, np.asarray(out.indices), np.asarray(out.values))
+            out = dense
+        assert out[1].tolist() == [2., 2.]
+        assert out[3].tolist() == [1., 1.]
+        assert out[0].tolist() == [0., 0.]
+
+    def test_concat_slice_grad(self):
+        a = stf.constant(RNG.rand(2, 2).astype(np.float32))
+        b = stf.constant(RNG.rand(2, 2).astype(np.float32))
+        y = stf.reduce_sum(stf.concat([a, b], 0)[1:3])
+        ga, gb = stf.gradients(y, [a, b])
+        out = _run({"ga": ga, "gb": gb})
+        assert out["ga"].tolist() == [[0., 0.], [1., 1.]]
+        assert out["gb"].tolist() == [[1., 1.], [0., 0.]]
+
+    def test_stop_gradient(self):
+        x = stf.constant([2.0])
+        y = stf.reduce_sum(x * stf.stop_gradient(x))
+        (g,) = stf.gradients(y, [x])
+        assert _run(g).tolist() == [2.0]  # only the differentiable path

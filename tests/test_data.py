@@ -1,0 +1,154 @@
+"""stf.data pipeline tests (SURVEY §2.8)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import data as stf_data
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+class TestDataset:
+    def test_from_tensor_slices_batch(self):
+        ds = stf_data.Dataset.from_tensor_slices(
+            np.arange(10, dtype=np.int32)).batch(4, drop_remainder=False)
+        batches = list(ds)
+        assert batches[0].tolist() == [0, 1, 2, 3]
+        assert batches[-1].tolist() == [8, 9]
+
+    def test_dict_structure(self):
+        ds = stf_data.Dataset.from_tensor_slices(
+            {"x": np.arange(4), "y": np.arange(4) * 2}).batch(2)
+        b = next(iter(ds))
+        assert b["x"].tolist() == [0, 1]
+        assert b["y"].tolist() == [0, 2]
+
+    def test_map_filter_like_chain(self):
+        ds = (stf_data.Dataset.from_tensor_slices(np.arange(6))
+              .map(lambda x: x * 10).batch(3))
+        assert next(iter(ds)).tolist() == [0, 10, 20]
+
+    def test_shuffle_deterministic_seed(self):
+        mk = lambda: [int(x) for x in stf_data.Dataset.from_tensor_slices(
+            np.arange(20)).shuffle(10, seed=3)]
+        a, b = mk(), mk()
+        assert a == b
+        assert sorted(a) == list(range(20))
+        assert a != list(range(20))
+
+    def test_repeat_epochs(self):
+        ds = stf_data.Dataset.from_tensor_slices(np.arange(3)).repeat(2)
+        assert [int(x) for x in ds] == [0, 1, 2, 0, 1, 2]
+
+    def test_prefetch_preserves_order(self):
+        ds = stf_data.Dataset.from_tensor_slices(
+            np.arange(50)).prefetch(4)
+        assert [int(x) for x in ds] == list(range(50))
+
+    def test_make_one_shot_iterator_get_next(self):
+        ds = stf_data.Dataset.from_tensor_slices(
+            np.float32([1, 2, 3])).batch(1)
+        it = ds.make_one_shot_iterator()
+        nxt = it.get_next()
+        with stf.Session() as sess:
+            assert sess.run(nxt).tolist() == [1.0]
+            assert sess.run(nxt).tolist() == [2.0]
+            assert sess.run(nxt).tolist() == [3.0]
+            with pytest.raises(stf.errors.OutOfRangeError):
+                sess.run(nxt)
+
+    def test_tfrecord_dataset(self, tmp_path):
+        from simple_tensorflow_tpu.lib.io import tf_record
+
+        path = str(tmp_path / "d.tfrecord")
+        with tf_record.TFRecordWriter(path) as w:
+            for i in range(5):
+                w.write(np.int32([i]).tobytes())
+        ds = stf_data.TFRecordDataset(path).map(
+            lambda b: int(np.frombuffer(b, np.int32)[0]))
+        assert list(ds) == [0, 1, 2, 3, 4]
+
+    def test_feed_into_training(self):
+        """The canonical input pipeline -> feed_dict -> train loop."""
+        rng = np.random.RandomState(0)
+        X = rng.rand(32, 3).astype(np.float32)
+        Y = (X @ rng.rand(3, 1)).astype(np.float32)
+        ds = (stf_data.Dataset.from_tensor_slices({"x": X, "y": Y})
+              .repeat().batch(8))
+        x = stf.placeholder(stf.float32, [8, 3])
+        y = stf.placeholder(stf.float32, [8, 1])
+        w = stf.Variable(stf.zeros([3, 1]), name="w")
+        loss = stf.reduce_mean(stf.square(stf.matmul(x, w) - y))
+        train = stf.train.GradientDescentOptimizer(0.5).minimize(loss)
+        it = iter(ds)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            losses = []
+            for _ in range(40):
+                b = next(it)
+                _, l = sess.run([train, loss], {x: b["x"], y: b["y"]})
+                losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.2
+
+
+class TestDatasetDictStructures:
+    def test_get_next_dict(self):
+        from simple_tensorflow_tpu import data as stf_data
+
+        ds = stf_data.Dataset.from_tensor_slices(
+            {"x": np.float32([[1, 2], [3, 4]]),
+             "y": np.int32([0, 1])}).batch(1)
+        nxt = ds.make_one_shot_iterator().get_next()
+        assert set(nxt.keys()) == {"x", "y"}
+        with stf.Session() as sess:
+            b = sess.run(nxt)
+        assert b["x"].tolist() == [[1.0, 2.0]]
+        assert b["y"].tolist() == [0]
+
+    def test_unbatch_dict(self):
+        from simple_tensorflow_tpu import data as stf_data
+
+        ds = stf_data.Dataset.from_tensor_slices(
+            {"x": np.arange(4)}).batch(2).unbatch()
+        assert [int(e["x"]) for e in ds] == [0, 1, 2, 3]
+
+    def test_from_tensor_slices_validation(self):
+        from simple_tensorflow_tpu import data as stf_data
+
+        with pytest.raises(ValueError):
+            stf_data.Dataset.from_tensor_slices({})
+        with pytest.raises(ValueError):
+            stf_data.Dataset.from_tensor_slices(
+                {"x": np.zeros(10), "y": np.zeros(5)})
+
+    def test_estimator_checkpoints_by_steps(self, tmp_path):
+        from simple_tensorflow_tpu import estimator as est
+
+        def input_fn():
+            X = np.random.RandomState(0).rand(16, 2).astype(np.float32)
+            Y = X.sum(1, keepdims=True).astype(np.float32)
+            ds = stf.data.Dataset.from_tensor_slices(
+                {"x": X, "y": Y}).repeat().batch(8)
+            f = ds.make_one_shot_iterator().get_next()
+            return {"x": f["x"]}, f["y"]
+
+        def model_fn(features, labels, mode, params=None, config=None):
+            w = stf.get_variable("w", [2, 1],
+                                 initializer=stf.zeros_initializer())
+            pred = stf.matmul(features["x"], w)
+            loss = stf.reduce_mean(stf.square(pred - labels))
+            gs = stf.train.get_or_create_global_step()
+            train_op = stf.train.GradientDescentOptimizer(0.1).minimize(
+                loss, global_step=gs)
+            return est.EstimatorSpec(mode, loss=loss, train_op=train_op,
+                                     predictions=pred)
+
+        e = est.Estimator(model_fn, model_dir=str(tmp_path),
+                          config=est.RunConfig(save_checkpoints_steps=2))
+        e.train(input_fn, steps=5)
+        assert stf.train.latest_checkpoint(str(tmp_path)) is not None

@@ -1,0 +1,181 @@
+"""Graph construction semantics (mirrors ref framework/ops_test.py)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+class TestGraphConstruction:
+    def test_default_graph_and_reset(self):
+        g = stf.get_default_graph()
+        c = stf.constant(1.0)
+        assert c.graph is g
+        stf.reset_default_graph()
+        assert stf.get_default_graph() is not g
+
+    def test_as_default_nesting(self):
+        g1, g2 = stf.Graph(), stf.Graph()
+        with g1.as_default():
+            a = stf.constant(1.0, name="a")
+            with g2.as_default():
+                b = stf.constant(2.0, name="b")
+            c = stf.constant(3.0, name="c")
+        assert a.graph is g1 and c.graph is g1 and b.graph is g2
+
+    def test_unique_names(self):
+        a = stf.constant(1.0, name="x")
+        b = stf.constant(2.0, name="x")
+        assert a.op.name == "x" and b.op.name == "x_1"
+
+    def test_name_scope(self):
+        with stf.name_scope("outer"):
+            a = stf.constant(1.0, name="a")
+            with stf.name_scope("inner"):
+                b = stf.constant(2.0, name="b")
+        assert a.op.name == "outer/a"
+        assert b.op.name == "outer/inner/b"
+
+    def test_get_operation_and_tensor_by_name(self):
+        c = stf.constant(5.0, name="five")
+        g = stf.get_default_graph()
+        assert g.get_operation_by_name("five") is c.op
+        assert g.get_tensor_by_name("five:0") is c
+        with pytest.raises(KeyError):
+            g.get_operation_by_name("nonexistent")
+
+    def test_graph_finalize(self):
+        g = stf.get_default_graph()
+        stf.constant(1.0)
+        g.finalize()
+        with pytest.raises(RuntimeError):
+            stf.constant(2.0)
+
+    def test_collections(self):
+        c = stf.constant(1.0)
+        stf.add_to_collection("my_coll", c)
+        stf.add_to_collections(["a", "b"], c)
+        assert stf.get_collection("my_coll") == [c]
+        assert stf.get_collection("a") == [c]
+        assert stf.get_collection("nope") == []
+        ref = stf.get_collection_ref("my_coll")
+        ref.append("extra")
+        assert len(stf.get_collection("my_coll")) == 2
+
+    def test_operations_listing(self):
+        stf.constant(1.0, name="c1")
+        stf.constant(2.0, name="c2")
+        names = [op.name for op in stf.get_default_graph().get_operations()]
+        assert names == ["c1", "c2"]
+
+
+class TestControlDependencies:
+    def test_assign_ordering(self):
+        v = stf.Variable(stf.zeros([]), name="cd_v")
+        a1 = stf.assign(v, stf.constant(1.0))
+        with stf.control_dependencies([a1]):
+            a2 = stf.assign_add(v, stf.constant(10.0))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(a2)
+            assert float(sess.run(v.value())) == 11.0
+
+    def test_with_dependencies(self):
+        v = stf.Variable(stf.zeros([]), name="wd_v")
+        a = stf.assign(v, stf.constant(3.0))
+        out = stf.control_flow_ops.with_dependencies([a], stf.constant(7.0))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert float(sess.run(out)) == 7.0
+            assert float(sess.run(v.value())) == 3.0
+
+    def test_group_runs_all(self):
+        v1 = stf.Variable(stf.zeros([]), name="g_v1")
+        v2 = stf.Variable(stf.zeros([]), name="g_v2")
+        g = stf.group(stf.assign(v1, stf.constant(1.0)),
+                      stf.assign(v2, stf.constant(2.0)))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(g)
+            assert float(sess.run(v1.value())) == 1.0
+            assert float(sess.run(v2.value())) == 2.0
+
+
+class TestDeviceScopes:
+    def test_device_recorded(self):
+        with stf.device("/job:worker/task:0"):
+            c = stf.constant(1.0)
+        assert "worker" in c.op.device
+
+    def test_colocate_with(self):
+        a = stf.constant(1.0)
+        with stf.colocate_with(a.op):
+            b = stf.constant(2.0)
+        assert b.op.device == a.op.device
+
+
+class TestGraphIO:
+    def test_graphdef_roundtrip_executes(self):
+        x = stf.placeholder(stf.float32, [2], name="x")
+        y = stf.add(stf.multiply(x, stf.constant(2.0)), stf.constant(1.0),
+                    name="y")
+        from simple_tensorflow_tpu.framework import graph_io
+
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+        g2 = stf.Graph()
+        with g2.as_default():
+            graph_io.import_graph_def(gd, name="imp")
+            with stf.Session() as sess:
+                out = sess.run("imp/y:0",
+                               {"imp/x:0": np.float32([1.0, 2.0])})
+        assert out.tolist() == [3.0, 5.0]
+
+    def test_write_graph(self, tmp_path):
+        stf.constant(1.0, name="c")
+        from simple_tensorflow_tpu.framework import graph_io
+
+        path = graph_io.write_graph(stf.get_default_graph(), str(tmp_path),
+                                    "g.pbtxt")
+        import json
+
+        gd = json.load(open(path))
+        assert gd["node"][0]["name"] == "c"
+
+    def test_control_flow_survives_roundtrip(self):
+        x = stf.placeholder(stf.float32, [], name="x")
+        y = stf.cond(stf.less(x, stf.constant(0.0)),
+                     lambda: stf.negative(x), lambda: x, name="absy")
+        with stf.Session() as sess:
+            assert float(sess.run(y, {x: np.float32(-4.0)})) == 4.0
+
+
+class TestTensorProperties:
+    def test_shape_dtype_name(self):
+        t = stf.placeholder(stf.float32, [None, 3], name="p")
+        assert t.dtype == stf.float32
+        assert t.shape.as_list() == [None, 3]
+        assert t.name == "p:0"
+        assert t.op.type == "Placeholder"
+
+    def test_operator_overloads(self):
+        a = stf.constant([2.0])
+        with stf.Session() as sess:
+            assert sess.run(a + 1.0).tolist() == [3.0]
+            assert sess.run(1.0 + a).tolist() == [3.0]
+            assert sess.run(a * 3.0).tolist() == [6.0]
+            assert sess.run(-a).tolist() == [-2.0]
+            assert sess.run(a / 2.0).tolist() == [1.0]
+            assert sess.run(a ** 2.0).tolist() == [4.0]
+            assert sess.run(a > 1.0).tolist() == [True]
+
+    def test_convert_to_tensor(self):
+        t = stf.convert_to_tensor(np.float32([1, 2]))
+        assert isinstance(t, stf.Tensor)
+        t2 = stf.convert_to_tensor(t)
+        assert t2 is t

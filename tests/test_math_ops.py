@@ -1,0 +1,232 @@
+"""Math op numeric tests vs numpy + gradient checks
+(mirrors ref python/kernel_tests/cwise_ops_test.py etc., SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def _run(t, feed=None):
+    with stf.Session() as sess:
+        return sess.run(t, feed)
+
+
+RNG = np.random.RandomState(7)
+
+
+class TestElementwise:
+    def test_binary_ops_vs_numpy(self):
+        a = RNG.rand(3, 4).astype(np.float32) + 0.5
+        b = RNG.rand(3, 4).astype(np.float32) + 0.5
+        ta, tb = stf.constant(a), stf.constant(b)
+        cases = {
+            "add": (stf.add(ta, tb), a + b),
+            "sub": (stf.subtract(ta, tb), a - b),
+            "mul": (stf.multiply(ta, tb), a * b),
+            "div": (stf.divide(ta, tb), a / b),
+            "floordiv": (stf.floordiv(ta, tb), a // b),
+            "mod": (stf.mod(ta, tb), np.mod(a, b)),
+            "pow": (stf.pow(ta, tb), a ** b),
+            "max": (stf.maximum(ta, tb), np.maximum(a, b)),
+            "min": (stf.minimum(ta, tb), np.minimum(a, b)),
+            "sqdiff": (stf.squared_difference(ta, tb), (a - b) ** 2),
+            "atan2": (stf.atan2(ta, tb), np.arctan2(a, b)),
+        }
+        out = _run({k: v[0] for k, v in cases.items()})
+        for k, (_, expect) in cases.items():
+            np.testing.assert_allclose(out[k], expect, rtol=1e-5, atol=1e-5,
+                                       err_msg=k)
+
+    def test_unary_ops_vs_numpy(self):
+        a = RNG.rand(2, 5).astype(np.float32) * 0.8 + 0.1
+        ta = stf.constant(a)
+        cases = {
+            "neg": (stf.negative(ta), -a),
+            "abs": (stf.abs(ta), np.abs(a)),
+            "square": (stf.square(ta), a * a),
+            "sqrt": (stf.sqrt(ta), np.sqrt(a)),
+            "rsqrt": (stf.rsqrt(ta), 1 / np.sqrt(a)),
+            "exp": (stf.exp(ta), np.exp(a)),
+            "expm1": (stf.expm1(ta), np.expm1(a)),
+            "log": (stf.log(ta), np.log(a)),
+            "log1p": (stf.log1p(ta), np.log1p(a)),
+            "sin": (stf.sin(ta), np.sin(a)),
+            "cos": (stf.cos(ta), np.cos(a)),
+            "tanh": (stf.tanh(ta), np.tanh(a)),
+            "sigmoid": (stf.sigmoid(ta), 1 / (1 + np.exp(-a))),
+            "erf": (stf.erf(ta), None),  # checked for finiteness below
+            "floor": (stf.floor(ta), np.floor(a)),
+            "ceil": (stf.ceil(ta), np.ceil(a)),
+            "sign": (stf.sign(ta), np.sign(a)),
+            "reciprocal": (stf.reciprocal(ta), 1 / a),
+        }
+        out = _run({k: v[0] for k, v in cases.items()})
+        for k, (_, expect) in cases.items():
+            if expect is not None:
+                np.testing.assert_allclose(out[k], expect, rtol=1e-5,
+                                           atol=1e-5, err_msg=k)
+        assert np.isfinite(out["erf"]).all()
+
+    def test_comparisons_and_logical(self):
+        a = np.array([1, 2, 3], np.int32)
+        b = np.array([2, 2, 2], np.int32)
+        ta, tb = stf.constant(a), stf.constant(b)
+        out = _run({
+            "eq": stf.equal(ta, tb), "ne": stf.not_equal(ta, tb),
+            "lt": stf.less(ta, tb), "le": stf.less_equal(ta, tb),
+            "gt": stf.greater(ta, tb), "ge": stf.greater_equal(ta, tb),
+        })
+        assert out["eq"].tolist() == [False, True, False]
+        assert out["lt"].tolist() == [True, False, False]
+        assert out["ge"].tolist() == [False, True, True]
+        x = stf.constant([True, False])
+        y = stf.constant([True, True])
+        out2 = _run({"and": stf.logical_and(x, y),
+                     "or": stf.logical_or(x, y),
+                     "xor": stf.logical_xor(x, y),
+                     "not": stf.logical_not(x)})
+        assert out2["and"].tolist() == [True, False]
+        assert out2["xor"].tolist() == [False, True]
+
+    def test_mixed_dtype_rejected(self):
+        with pytest.raises(TypeError):
+            stf.add(stf.constant(1.0), stf.constant(1))
+
+
+class TestReductions:
+    def test_reduce_vs_numpy(self):
+        a = RNG.rand(3, 4, 5).astype(np.float32)
+        t = stf.constant(a)
+        out = _run({
+            "sum": stf.reduce_sum(t), "sum0": stf.reduce_sum(t, axis=0),
+            "sum_keep": stf.reduce_sum(t, axis=[1], keepdims=True),
+            "mean": stf.reduce_mean(t, axis=[0, 2]),
+            "prod": stf.reduce_prod(t, axis=2),
+            "max": stf.reduce_max(t, axis=1),
+            "min": stf.reduce_min(t),
+            "lse": stf.reduce_logsumexp(t, axis=-1),
+        })
+        np.testing.assert_allclose(out["sum"], a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out["sum0"], a.sum(0), rtol=1e-5)
+        assert out["sum_keep"].shape == (3, 1, 5)
+        np.testing.assert_allclose(out["mean"], a.mean((0, 2)), rtol=1e-5)
+        np.testing.assert_allclose(out["lse"],
+                                   np.log(np.exp(a).sum(-1)), rtol=1e-5)
+
+    def test_bool_reductions(self):
+        m = stf.constant([[True, False], [True, True]])
+        out = _run({"all": stf.reduce_all(m, axis=1),
+                    "any": stf.reduce_any(m, axis=0)})
+        assert out["all"].tolist() == [False, True]
+        assert out["any"].tolist() == [True, True]
+
+    def test_argminmax_cumsum(self):
+        a = np.array([[3., 1., 2.], [0., 5., 4.]], np.float32)
+        t = stf.constant(a)
+        out = _run({
+            "argmax": stf.argmax(t, 1), "argmin": stf.argmin(t, 0),
+            "cumsum": stf.cumsum(t, axis=1),
+            "cumsum_ex": stf.cumsum(t, axis=1, exclusive=True),
+            "cumsum_rev": stf.cumsum(t, axis=1, reverse=True),
+            "cumprod": stf.cumprod(t, axis=0),
+        })
+        assert out["argmax"].tolist() == [0, 1]
+        np.testing.assert_allclose(out["cumsum"], np.cumsum(a, 1))
+        np.testing.assert_allclose(out["cumsum_ex"],
+                                   [[0, 3, 4], [0, 0, 5]])
+        np.testing.assert_allclose(out["cumsum_rev"][:, 0], a.sum(1))
+
+    def test_segment_ops(self):
+        data = stf.constant([1., 2., 3., 4.])
+        seg = stf.constant([0, 0, 1, 1])
+        out = _run({
+            "sum": stf.segment_sum(data, seg),
+            "mean": stf.segment_mean(data, seg),
+            "max": stf.segment_max(data, seg),
+            "unsorted": stf.unsorted_segment_sum(data, stf.constant(
+                [1, 0, 1, 0]), 2),
+        })
+        assert out["sum"].tolist() == [3., 7.]
+        assert out["mean"].tolist() == [1.5, 3.5]
+        assert out["unsorted"].tolist() == [6., 4.]
+
+    def test_bincount(self):
+        v = stf.constant([0, 1, 1, 3])
+        assert _run(stf.bincount(v)).tolist() == [1, 2, 0, 1]
+
+
+class TestMatmul:
+    def test_matmul_variants(self):
+        a = RNG.rand(3, 4).astype(np.float32)
+        b = RNG.rand(4, 5).astype(np.float32)
+        out = _run({
+            "mm": stf.matmul(stf.constant(a), stf.constant(b)),
+            "mm_ta": stf.matmul(stf.constant(a.T), stf.constant(b),
+                                transpose_a=True),
+            "mm_tb": stf.matmul(stf.constant(a), stf.constant(b.T),
+                                transpose_b=True),
+        })
+        np.testing.assert_allclose(out["mm"], a @ b, rtol=1e-5)
+        np.testing.assert_allclose(out["mm_ta"], a @ b, rtol=1e-5)
+        np.testing.assert_allclose(out["mm_tb"], a @ b, rtol=1e-5)
+
+    def test_batch_matmul_einsum_tensordot(self):
+        a = RNG.rand(2, 3, 4).astype(np.float32)
+        b = RNG.rand(2, 4, 5).astype(np.float32)
+        out = _run({
+            "bmm": stf.matmul(stf.constant(a), stf.constant(b)),
+            "ein": stf.einsum("bij,bjk->bik", stf.constant(a),
+                              stf.constant(b)),
+            "td": stf.tensordot(stf.constant(a[0]), stf.constant(b[0]),
+                                axes=1),
+        })
+        np.testing.assert_allclose(out["bmm"], a @ b, rtol=1e-5)
+        np.testing.assert_allclose(out["ein"], a @ b, rtol=1e-5)
+        np.testing.assert_allclose(out["td"], a[0] @ b[0], rtol=1e-5)
+
+    def test_matmul_gradient(self):
+        a = stf.constant(RNG.rand(3, 4).astype(np.float32))
+        b = stf.constant(RNG.rand(4, 2).astype(np.float32))
+        y = stf.reduce_sum(stf.matmul(a, b))
+        ga, gb = stf.gradients(y, [a, b])
+        out = _run({"ga": ga, "gb": gb, "b": b, "a": a})
+        np.testing.assert_allclose(out["ga"],
+                                   np.tile(out["b"].sum(1), (3, 1)),
+                                   rtol=1e-5)
+
+    def test_gradient_checker(self):
+        x = stf.placeholder(stf.float32, [2, 3], name="gx")
+        y = stf.reduce_sum(stf.tanh(x) * stf.constant(
+            RNG.rand(2, 3).astype(np.float32)))
+        with stf.Session():
+            err = stf.compute_gradient_error(x, [2, 3], y, [])
+        assert err < 2e-2
+
+
+class TestCasting:
+    def test_cast_chain(self):
+        x = stf.constant([1.7, -2.3], stf.float32)
+        out = _run({
+            "i": stf.cast(x, stf.int32),
+            "b16": stf.cast(x, stf.bfloat16),
+            "back": stf.cast(stf.cast(x, stf.float64), stf.float32),
+        })
+        assert out["i"].tolist() == [1, -2]
+        assert out["back"].tolist() == list(np.float32([1.7, -2.3]))
+
+    def test_saturate_cast(self):
+        x = stf.constant([300.0, -300.0])
+        assert _run(stf.saturate_cast(x, stf.int8)).tolist() == [127, -128]
+
+    def test_range_linspace(self):
+        out = _run({"r": stf.range(2, 10, 3),
+                    "l": stf.linspace(0.0, 1.0, 5)})
+        assert out["r"].tolist() == [2, 5, 8]
+        np.testing.assert_allclose(out["l"], np.linspace(0, 1, 5))

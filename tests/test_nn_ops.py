@@ -1,0 +1,229 @@
+"""NN op tests: conv/pool/softmax/xent/norm vs numpy references
+(mirrors ref kernel_tests/conv_ops_test.py etc., SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def _run(t, feed=None):
+    with stf.Session() as sess:
+        return sess.run(t, feed)
+
+
+RNG = np.random.RandomState(3)
+
+
+def _np_conv2d_valid(x, w):
+    n, h, ww, cin = x.shape
+    kh, kw, _, cout = w.shape
+    oh, ow = h - kh + 1, ww - kw + 1
+    out = np.zeros((n, oh, ow, cout), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i:i + kh, j:j + kw, :].reshape(n, -1)
+            out[:, i, j, :] = patch @ w.reshape(-1, cout)
+    return out
+
+
+class TestConv:
+    def test_conv2d_valid_vs_numpy(self):
+        x = RNG.rand(2, 5, 5, 3).astype(np.float32)
+        w = RNG.rand(3, 3, 3, 4).astype(np.float32)
+        y = stf.nn.conv2d(stf.constant(x), stf.constant(w),
+                          strides=[1, 1, 1, 1], padding="VALID")
+        np.testing.assert_allclose(_run(y), _np_conv2d_valid(x, w),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_same_shape(self):
+        x = stf.constant(RNG.rand(1, 8, 8, 2).astype(np.float32))
+        w = stf.constant(RNG.rand(3, 3, 2, 5).astype(np.float32))
+        y = stf.nn.conv2d(x, w, strides=[1, 2, 2, 1], padding="SAME")
+        assert _run(y).shape == (1, 4, 4, 5)
+
+    def test_conv2d_gradient(self):
+        x = stf.constant(RNG.rand(1, 4, 4, 1).astype(np.float32))
+        w = stf.constant(RNG.rand(2, 2, 1, 1).astype(np.float32))
+        y = stf.reduce_sum(stf.nn.conv2d(x, w, [1, 1, 1, 1], "VALID"))
+        gx, gw = stf.gradients(y, [x, w])
+        out = _run({"gx": gx, "gw": gw})
+        # d(sum)/dw[i,j] = sum of x patches
+        assert np.isfinite(out["gx"]).all()
+        np.testing.assert_allclose(out["gw"].ravel()[0],
+                                   _run(stf.reduce_sum(x[:, :3, :3, :])),
+                                   rtol=1e-4)
+
+    def test_depthwise_conv(self):
+        x = stf.constant(RNG.rand(1, 5, 5, 2).astype(np.float32))
+        w = stf.constant(RNG.rand(3, 3, 2, 2).astype(np.float32))
+        y = stf.nn.depthwise_conv2d(x, w, [1, 1, 1, 1], "VALID")
+        assert _run(y).shape == (1, 3, 3, 4)
+
+    def test_conv2d_transpose_shape(self):
+        x = stf.constant(RNG.rand(1, 4, 4, 3).astype(np.float32))
+        w = stf.constant(RNG.rand(3, 3, 2, 3).astype(np.float32))
+        y = stf.nn.conv2d_transpose(x, w, [1, 8, 8, 2], [1, 2, 2, 1],
+                                    "SAME")
+        assert _run(y).shape == (1, 8, 8, 2)
+
+
+class TestPooling:
+    def test_max_avg_pool(self):
+        x = RNG.rand(1, 4, 4, 1).astype(np.float32)
+        t = stf.constant(x)
+        out = _run({
+            "mx": stf.nn.max_pool(t, [1, 2, 2, 1], [1, 2, 2, 1], "VALID"),
+            "av": stf.nn.avg_pool(t, [1, 2, 2, 1], [1, 2, 2, 1], "VALID"),
+        })
+        expect_mx = x.reshape(1, 2, 2, 2, 2, 1).max((2, 4))
+        expect_av = x.reshape(1, 2, 2, 2, 2, 1).mean((2, 4))
+        np.testing.assert_allclose(out["mx"], expect_mx, rtol=1e-6)
+        np.testing.assert_allclose(out["av"], expect_av, rtol=1e-6)
+
+    def test_max_pool_grad_routes_to_max(self):
+        x = stf.constant(np.array(
+            [[[[1.], [5.]], [[2.], [0.]]]], np.float32))
+        y = stf.reduce_sum(stf.nn.max_pool(x, [1, 2, 2, 1], [1, 2, 2, 1],
+                                           "VALID"))
+        (g,) = stf.gradients(y, [x])
+        assert _run(g).ravel().tolist() == [0., 1., 0., 0.]
+
+
+class TestActivations:
+    def test_relu_family(self):
+        a = np.array([-2., -0.5, 0., 1.5], np.float32)
+        t = stf.constant(a)
+        out = _run({
+            "relu": stf.nn.relu(t), "relu6": stf.nn.relu6(t * 5.0),
+            "elu": stf.nn.elu(t), "softplus": stf.nn.softplus(t),
+            "softsign": stf.nn.softsign(t), "crelu": stf.nn.crelu(t),
+        })
+        assert out["relu"].tolist() == [0., 0., 0., 1.5]
+        assert out["relu6"].tolist() == [0., 0., 0., 6.]
+        np.testing.assert_allclose(out["elu"][0], np.expm1(-2.0), rtol=1e-5)
+        np.testing.assert_allclose(out["softplus"], np.log1p(np.exp(a)),
+                                   rtol=1e-5)
+        assert out["crelu"].shape == (8,)
+
+    def test_softmax_logsoftmax(self):
+        a = RNG.rand(3, 5).astype(np.float32) * 4
+        t = stf.constant(a)
+        out = _run({"sm": stf.nn.softmax(t), "lsm": stf.nn.log_softmax(t)})
+        e = np.exp(a - a.max(1, keepdims=True))
+        np.testing.assert_allclose(out["sm"], e / e.sum(1, keepdims=True),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out["lsm"], np.log(out["sm"]), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(out["sm"].sum(1), np.ones(3), rtol=1e-5)
+
+
+class TestXent:
+    def test_sparse_softmax_xent_vs_manual(self):
+        logits = RNG.rand(4, 7).astype(np.float32) * 3
+        labels = np.array([0, 3, 6, 2], np.int32)
+        t = stf.nn.sparse_softmax_cross_entropy_with_logits(
+            labels=stf.constant(labels), logits=stf.constant(logits))
+        lsm = logits - logits.max(1, keepdims=True)
+        lsm = lsm - np.log(np.exp(lsm).sum(1, keepdims=True))
+        np.testing.assert_allclose(_run(t), -lsm[np.arange(4), labels],
+                                   rtol=1e-5)
+
+    def test_softmax_xent_gradient_is_p_minus_y(self):
+        logits = stf.constant(RNG.rand(2, 3).astype(np.float32))
+        labels_np = np.array([[1., 0., 0.], [0., 1., 0.]], np.float32)
+        loss = stf.reduce_sum(stf.nn.softmax_cross_entropy_with_logits(
+            labels=stf.constant(labels_np), logits=logits))
+        (g,) = stf.gradients(loss, [logits])
+        out = _run({"g": g, "p": stf.nn.softmax(logits)})
+        np.testing.assert_allclose(out["g"], out["p"] - labels_np,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sigmoid_xent(self):
+        logits = RNG.randn(6).astype(np.float32)
+        labels = (RNG.rand(6) > 0.5).astype(np.float32)
+        t = stf.nn.sigmoid_cross_entropy_with_logits(
+            labels=stf.constant(labels), logits=stf.constant(logits))
+        expect = np.maximum(logits, 0) - logits * labels + np.log1p(
+            np.exp(-np.abs(logits)))
+        np.testing.assert_allclose(_run(t), expect, rtol=1e-5, atol=1e-6)
+
+
+class TestNorm:
+    def test_moments(self):
+        x = RNG.rand(4, 6).astype(np.float32)
+        m, v = stf.nn.moments(stf.constant(x), axes=[0])
+        out = _run({"m": m, "v": v})
+        np.testing.assert_allclose(out["m"], x.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(out["v"], x.var(0), rtol=1e-4)
+
+    def test_batch_normalization(self):
+        x = RNG.rand(8, 3).astype(np.float32)
+        mean, var = x.mean(0), x.var(0)
+        y = stf.nn.batch_normalization(
+            stf.constant(x), stf.constant(mean), stf.constant(var),
+            offset=stf.constant(np.ones(3, np.float32)),
+            scale=stf.constant(np.full(3, 2.0, np.float32)),
+            variance_epsilon=1e-5)
+        expect = (x - mean) / np.sqrt(var + 1e-5) * 2.0 + 1.0
+        np.testing.assert_allclose(_run(y), expect, rtol=1e-4, atol=1e-5)
+
+    def test_fused_batch_norm_training_stats(self):
+        x = RNG.rand(16, 4, 4, 3).astype(np.float32)
+        y, m, v = stf.nn.fused_batch_norm(
+            stf.constant(x), scale=stf.constant(np.ones(3, np.float32)),
+            offset=stf.constant(np.zeros(3, np.float32)), is_training=True)
+        out = _run({"y": y, "m": m, "v": v})
+        np.testing.assert_allclose(out["m"], x.mean((0, 1, 2)), rtol=1e-4)
+        np.testing.assert_allclose(out["y"].mean((0, 1, 2)), np.zeros(3),
+                                   atol=1e-4)
+
+    def test_l2_normalize_l2_loss(self):
+        x = np.array([3., 4.], np.float32)
+        out = _run({"n": stf.nn.l2_normalize(stf.constant(x), 0),
+                    "l": stf.nn.l2_loss(stf.constant(x))})
+        np.testing.assert_allclose(out["n"], [0.6, 0.8], rtol=1e-5)
+        assert float(out["l"]) == 12.5
+
+    def test_lrn_finite(self):
+        x = stf.constant(RNG.rand(1, 3, 3, 8).astype(np.float32))
+        assert np.isfinite(_run(stf.nn.lrn(x))).all()
+
+
+class TestEmbeddingDropout:
+    def test_embedding_lookup(self):
+        table = RNG.rand(10, 4).astype(np.float32)
+        e = stf.nn.embedding_lookup(stf.constant(table),
+                                    stf.constant([[1, 3], [5, 1]]))
+        np.testing.assert_allclose(_run(e), table[[[1, 3], [5, 1]]])
+
+    def test_dropout_scaling_and_determinism_within_step(self):
+        x = stf.constant(np.ones((1000,), np.float32))
+        y = stf.nn.dropout(x, keep_prob=0.5)
+        out = _run(y)
+        kept = out[out > 0]
+        assert np.allclose(kept, 2.0)  # inverted dropout scales by 1/p
+        assert 350 < len(kept) < 650
+
+    def test_in_top_k(self):
+        pred = stf.constant(np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]],
+                                     np.float32))
+        t = stf.nn.in_top_k(pred, stf.constant([1, 2]), 1)
+        assert _run(t).tolist() == [True, False]
+
+    def test_top_k_sorted(self):
+        v, i = stf.nn.top_k(stf.constant([3., 1., 4., 1., 5.]), k=3)
+        out = _run({"v": v, "i": i})
+        assert out["v"].tolist() == [5., 4., 3.]
+        assert out["i"].tolist() == [4, 2, 0]
+
+    def test_bias_add(self):
+        x = RNG.rand(2, 3).astype(np.float32)
+        y = stf.nn.bias_add(stf.constant(x), stf.constant([1., 2., 3.]))
+        np.testing.assert_allclose(_run(y), x + [1., 2., 3.], rtol=1e-6)

@@ -1,0 +1,209 @@
+"""Optimizer update math vs hand-computed values
+(mirrors ref adam_test.py / momentum_test.py / etc., SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def _one_var_step(opt, n_steps=1, x0=(1.0, 2.0), grad=(0.1, 0.1)):
+    """Minimize loss = g·x (constant gradient g) and return x after steps."""
+    v = stf.Variable(stf.constant(np.float32(x0)), name="x")
+    loss = stf.reduce_sum(stf.constant(np.float32(grad)) * v._ref)
+    train = opt.minimize(loss)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        for _ in range(n_steps):
+            sess.run(train)
+        return sess.run(v.value())
+
+
+class TestSGDFamily:
+    def test_gradient_descent(self):
+        x = _one_var_step(stf.train.GradientDescentOptimizer(3.0))
+        np.testing.assert_allclose(x, [1.0 - 0.3, 2.0 - 0.3], rtol=1e-6)
+
+    def test_momentum(self):
+        lr, m, g = 2.0, 0.9, 0.1
+        x = _one_var_step(stf.train.MomentumOptimizer(lr, m), n_steps=2)
+        # v1 = g; x1 = x0 - lr*v1 ; v2 = m*v1 + g; x2 = x1 - lr*v2
+        v1 = g
+        v2 = m * v1 + g
+        expect = 1.0 - lr * v1 - lr * v2
+        np.testing.assert_allclose(x[0], expect, rtol=1e-5)
+
+    def test_nesterov_momentum_differs(self):
+        a = _one_var_step(stf.train.MomentumOptimizer(1.0, 0.9), 2)
+        b = _one_var_step(stf.train.MomentumOptimizer(1.0, 0.9,
+                                                      use_nesterov=True), 2)
+        assert not np.allclose(a, b)
+
+    def test_proximal_gd_matches_gd_without_regularization(self):
+        a = _one_var_step(stf.train.GradientDescentOptimizer(1.0))
+        b = _one_var_step(stf.train.ProximalGradientDescentOptimizer(1.0))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestAdamFamily:
+    def test_adam_first_step(self):
+        lr, b1, b2, eps = 0.5, 0.9, 0.999, 1e-8
+        g = 0.1
+        x = _one_var_step(stf.train.AdamOptimizer(lr, b1, b2, eps))
+        # step 1: mhat = g, vhat = g^2  => x -= lr * g/(|g| + eps')
+        m = (1 - b1) * g
+        v = (1 - b2) * g * g
+        mhat = m / (1 - b1)
+        vhat = v / (1 - b2)
+        expect = 1.0 - lr * mhat / (np.sqrt(vhat) + eps)
+        np.testing.assert_allclose(x[0], expect, rtol=1e-5)
+
+    def test_adam_slots_created(self):
+        v = stf.Variable(stf.zeros([2]), name="w")
+        opt = stf.train.AdamOptimizer(0.1)
+        opt.minimize(stf.reduce_sum(v._ref * 2.0))
+        names = opt.get_slot_names()
+        assert "m" in names and "v" in names
+        assert opt.get_slot(v, "m") is not None
+
+    def test_adagrad(self):
+        lr, g, acc0 = 1.0, 0.1, 0.1
+        x = _one_var_step(stf.train.AdagradOptimizer(
+            lr, initial_accumulator_value=acc0))
+        expect = 1.0 - lr * g / np.sqrt(acc0 + g * g)
+        np.testing.assert_allclose(x[0], expect, rtol=1e-5)
+
+    def test_rmsprop(self):
+        lr, decay, eps, g = 1.0, 0.9, 1e-10, 0.1
+        x = _one_var_step(stf.train.RMSPropOptimizer(lr, decay,
+                                                     epsilon=eps))
+        # TF semantics: the mean-square accumulator initializes to ONES
+        ms = decay * 1.0 + (1 - decay) * g * g
+        expect = 1.0 - lr * g / np.sqrt(ms + eps)
+        np.testing.assert_allclose(x[0], expect, rtol=1e-4)
+
+    def test_adadelta_moves(self):
+        x = _one_var_step(stf.train.AdadeltaOptimizer(1.0, rho=0.95), 3)
+        assert x[0] < 1.0
+
+    def test_ftrl_moves(self):
+        x = _one_var_step(stf.train.FtrlOptimizer(1.0), 3)
+        assert x[0] < 1.0
+
+    def test_adagrad_da_moves(self):
+        gs = stf.train.get_or_create_global_step()
+        x = _one_var_step(stf.train.AdagradDAOptimizer(
+            1.0, global_step=gs), 2)
+        assert x[0] < 1.0
+
+
+class TestOptimizerAPI:
+    def test_compute_then_apply(self):
+        v = stf.Variable(stf.constant([1.0]), name="cv")
+        loss = stf.reduce_sum(stf.square(v._ref))
+        opt = stf.train.GradientDescentOptimizer(0.5)
+        gvs = opt.compute_gradients(loss)
+        gvs = [(stf.clip_by_value(g, -0.1, 0.1), var) for g, var in gvs
+               if g is not None]
+        train = opt.apply_gradients(gvs)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(train)
+            # raw grad 2.0 clipped to 0.1 -> x = 1 - 0.05
+            np.testing.assert_allclose(sess.run(v.value()), [0.95],
+                                       rtol=1e-6)
+
+    def test_global_step_increment(self):
+        v = stf.Variable(stf.constant([1.0]), name="gv")
+        gs = stf.train.get_or_create_global_step()
+        train = stf.train.GradientDescentOptimizer(0.1).minimize(
+            stf.reduce_sum(v._ref), global_step=gs)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            for _ in range(3):
+                sess.run(train)
+            assert int(np.asarray(sess.run(gs))) == 3
+
+    def test_gradient_clipping_by_global_norm(self):
+        t1 = stf.constant([3.0, 4.0])
+        t2 = stf.constant([0.0])
+        clipped, norm = stf.clip_by_global_norm([t1, t2], 2.5)
+        with stf.Session() as sess:
+            c1, n = sess.run([clipped[0], norm])
+        assert abs(float(n) - 5.0) < 1e-5
+        np.testing.assert_allclose(c1, [1.5, 2.0], rtol=1e-5)
+
+    def test_sparse_gradient_updates_only_rows(self):
+        table = stf.Variable(stf.ones([4, 2]), name="emb")
+        e = stf.nn.embedding_lookup(table, stf.constant([1, 1]))
+        loss = stf.reduce_sum(e)
+        train = stf.train.GradientDescentOptimizer(0.5).minimize(loss)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(train)
+            vals = sess.run(table.value())
+        assert vals[0].tolist() == [1.0, 1.0]
+        assert vals[1].tolist() == [0.0, 0.0]  # two lookups x lr 0.5
+
+
+class TestLRDecay:
+    def _eval_at_step(self, lr_fn, step):
+        gs = stf.train.get_or_create_global_step()
+        lr = lr_fn(gs)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(stf.assign(gs, stf.constant(step, stf.int64)))
+            return float(sess.run(lr))
+
+    def test_exponential_decay(self):
+        v = self._eval_at_step(
+            lambda gs: stf.train.exponential_decay(1.0, gs, 10, 0.5,
+                                                   staircase=True), 25)
+        assert abs(v - 0.25) < 1e-6
+
+    def test_piecewise_constant(self):
+        v = self._eval_at_step(
+            lambda gs: stf.train.piecewise_constant(
+                gs, [10, 20], [1.0, 0.5, 0.1]), 15)
+        assert abs(v - 0.5) < 1e-6
+
+    def test_polynomial_decay(self):
+        v = self._eval_at_step(
+            lambda gs: stf.train.polynomial_decay(1.0, gs, 100,
+                                                  end_learning_rate=0.0,
+                                                  power=1.0), 50)
+        assert abs(v - 0.5) < 1e-6
+
+    def test_cosine_decay(self):
+        v = self._eval_at_step(
+            lambda gs: stf.train.cosine_decay(1.0, gs, 100), 100)
+        assert v < 1e-6
+
+    def test_inverse_time_natural_exp(self):
+        v1 = self._eval_at_step(
+            lambda gs: stf.train.inverse_time_decay(1.0, gs, 10, 1.0), 10)
+        assert abs(v1 - 0.5) < 1e-6
+        v2 = self._eval_at_step(
+            lambda gs: stf.train.natural_exp_decay(1.0, gs, 10, 1.0), 10)
+        assert abs(v2 - np.exp(-1.0)) < 1e-5
+
+
+class TestEMA:
+    def test_moving_average_math(self):
+        v = stf.Variable(stf.constant(10.0), name="ema_v")
+        ema = stf.train.ExponentialMovingAverage(decay=0.9)
+        update = ema.apply([v])
+        avg = ema.average(v)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(update)  # avg = 10 (initialized to var value)
+            sess.run(stf.assign(v, stf.constant(20.0)))
+            sess.run(update)  # avg = 0.9*10 + 0.1*20 = 11
+            np.testing.assert_allclose(float(sess.run(avg)), 11.0,
+                                       rtol=1e-5)

@@ -1,0 +1,204 @@
+"""Training stack: MonitoredTrainingSession, hooks, coordinator
+(mirrors ref monitored_session_test.py / basic_session_run_hooks_test.py)."""
+
+import glob
+import os
+import time
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def _linear_problem():
+    gs = stf.train.get_or_create_global_step()
+    v = stf.Variable(stf.constant([2.0]), name="w")
+    loss = stf.reduce_sum(stf.square(v._ref))
+    train = stf.train.GradientDescentOptimizer(0.1).minimize(
+        loss, global_step=gs)
+    return train, loss, gs
+
+
+class TestMonitoredTrainingSession:
+    def test_basic_loop_with_stop_hook(self):
+        train, loss, gs = _linear_problem()
+        hook = stf.train.StopAtStepHook(num_steps=5)
+        with stf.train.MonitoredTrainingSession(hooks=[hook]) as sess:
+            n = 0
+            while not sess.should_stop():
+                sess.run(train)
+                n += 1
+        assert n == 5
+
+    def test_checkpoint_saver_hook(self, tmp_path):
+        train, loss, gs = _linear_problem()
+        ckdir = str(tmp_path)
+        with stf.train.MonitoredTrainingSession(
+                checkpoint_dir=ckdir, save_checkpoint_steps=2,
+                hooks=[stf.train.StopAtStepHook(num_steps=5)]) as sess:
+            while not sess.should_stop():
+                sess.run(train)
+        assert stf.train.latest_checkpoint(ckdir) is not None
+
+    def test_resume_from_checkpoint(self, tmp_path):
+        ckdir = str(tmp_path)
+        train, loss, gs = _linear_problem()
+        with stf.train.MonitoredTrainingSession(
+                checkpoint_dir=ckdir, save_checkpoint_steps=1,
+                hooks=[stf.train.StopAtStepHook(num_steps=3)]) as sess:
+            while not sess.should_stop():
+                sess.run(train)
+        # new graph, same checkpoint dir -> resumes at step 3
+        stf.reset_default_graph()
+        train, loss, gs = _linear_problem()
+        with stf.train.MonitoredTrainingSession(
+                checkpoint_dir=ckdir,
+                hooks=[stf.train.StopAtStepHook(last_step=5)]) as sess:
+            steps = 0
+            while not sess.should_stop():
+                sess.run(train)
+                steps += 1
+        assert steps == 2  # resumed from 3, ran to 5
+
+    def test_nan_tensor_hook(self):
+        gs = stf.train.get_or_create_global_step()
+        v = stf.Variable(stf.constant([1.0]), name="nv")
+        loss = stf.reduce_sum(stf.log(v._ref - 1.0))  # log(0) = -inf
+        train = stf.train.GradientDescentOptimizer(1.0).minimize(
+            loss, global_step=gs)
+        hook = stf.train.NanTensorHook(loss, fail_on_nan_loss=True)
+        from simple_tensorflow_tpu.train.basic_session_run_hooks import \
+            NanLossDuringTrainingError
+
+        with pytest.raises(NanLossDuringTrainingError):
+            with stf.train.MonitoredTrainingSession(hooks=[hook]) as sess:
+                for _ in range(3):
+                    sess.run(train)
+
+    def test_logging_and_step_counter_hooks_run(self, tmp_path):
+        train, loss, gs = _linear_problem()
+        hooks = [
+            stf.train.LoggingTensorHook({"loss": loss}, every_n_iter=2),
+            stf.train.StepCounterHook(every_n_steps=2,
+                                      output_dir=str(tmp_path)),
+            stf.train.StopAtStepHook(num_steps=4),
+        ]
+        with stf.train.MonitoredTrainingSession(hooks=hooks) as sess:
+            while not sess.should_stop():
+                sess.run(train)
+
+    def test_summary_saver_hook(self, tmp_path):
+        train, loss, gs = _linear_problem()
+        s = stf.summary.scalar("loss_s", loss)
+        hook = stf.train.SummarySaverHook(save_steps=1, summary_op=s,
+                                          output_dir=str(tmp_path))
+        with stf.train.MonitoredTrainingSession(
+                hooks=[hook, stf.train.StopAtStepHook(num_steps=3)]) as sess:
+            while not sess.should_stop():
+                sess.run(train)
+        files = glob.glob(os.path.join(str(tmp_path),
+                                       "events.out.tfevents.*"))
+        assert files
+
+    def test_final_ops_hook(self):
+        train, loss, gs = _linear_problem()
+        hook = stf.train.FinalOpsHook(loss)
+        with stf.train.MonitoredTrainingSession(
+                hooks=[hook, stf.train.StopAtStepHook(num_steps=2)]) as sess:
+            while not sess.should_stop():
+                sess.run(train)
+        assert np.isfinite(hook.final_ops_values)
+
+
+class TestScaffold:
+    def test_custom_init_op(self):
+        v = stf.Variable(stf.zeros([1]), name="sv")
+        init = stf.group(stf.variables_initializer([v]),
+                         stf.assign(v, stf.constant([42.0])).op)
+        scaffold = stf.train.Scaffold(init_op=init)
+        with stf.train.MonitoredTrainingSession(scaffold=scaffold) as sess:
+            assert sess.run(v.value()).tolist() == [42.0]
+
+
+class TestCoordinator:
+    def test_coordinator_stop_join(self):
+        import threading
+
+        coord = stf.train.Coordinator()
+        counter = {"n": 0}
+
+        def worker():
+            while not coord.should_stop():
+                counter["n"] += 1
+                if counter["n"] >= 10:
+                    coord.request_stop()
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        coord.join(threads)
+        assert counter["n"] >= 10
+
+    def test_coordinator_exception_reraised(self):
+        import threading
+
+        coord = stf.train.Coordinator()
+
+        def worker():
+            try:
+                raise ValueError("boom")
+            except Exception as e:
+                coord.request_stop(e)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        with pytest.raises(ValueError):
+            coord.join([t])
+
+
+class TestSupervisorAndLoops:
+    def test_basic_train_loop(self):
+        train, loss, gs = _linear_problem()
+
+        def train_step_fn(sess, *args):
+            _, l = sess.run([train, loss])
+            if int(np.asarray(sess.run(gs))) >= 3:
+                raise stf.errors.OutOfRangeError(None, None, "done")
+            return l
+
+        sv = stf.train.Supervisor(is_chief=True)
+        stf.train.basic_train_loop(sv, train_step_fn)
+
+    def test_evaluation_evaluate_once(self, tmp_path):
+        v = stf.Variable(stf.constant([6.0]), name="ev")
+        saver = stf.train.Saver()
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            path = saver.save(sess, str(tmp_path / "m"))
+        from simple_tensorflow_tpu.train import evaluation
+
+        out = evaluation.evaluate_once(
+            checkpoint_path=path, eval_ops=None,
+            final_ops={"val": v.value()})
+        assert out["val"].tolist() == [6.0]
+
+
+class TestSyncReplicas:
+    def test_sync_replicas_wrapper_runs(self):
+        gs = stf.train.get_or_create_global_step()
+        v = stf.Variable(stf.constant([1.0]), name="sr_v")
+        loss = stf.reduce_sum(stf.square(v._ref))
+        base = stf.train.GradientDescentOptimizer(0.1)
+        opt = stf.train.SyncReplicasOptimizer(base, replicas_to_aggregate=1)
+        train = opt.minimize(loss, global_step=gs)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(train)
+            assert float(sess.run(v.value())[0]) < 1.0

@@ -1,0 +1,202 @@
+"""Variable / variable_scope semantics (mirrors ref variables_test.py,
+variable_scope_test.py)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+class TestVariable:
+    def test_init_read_assign(self):
+        v = stf.Variable(stf.constant([1.0, 2.0]), name="v")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert sess.run(v.value()).tolist() == [1.0, 2.0]
+            sess.run(stf.assign(v, stf.constant([5.0, 6.0])))
+            assert sess.run(v.value()).tolist() == [5.0, 6.0]
+            sess.run(stf.assign_add(v, stf.constant([1.0, 1.0])))
+            assert sess.run(v.value()).tolist() == [6.0, 7.0]
+            sess.run(stf.assign_sub(v, stf.constant([2.0, 2.0])))
+            assert sess.run(v.value()).tolist() == [4.0, 5.0]
+
+    def test_uninitialized_raises(self):
+        v = stf.Variable(stf.ones([2]), name="u")
+        with stf.Session() as sess:
+            with pytest.raises(stf.errors.FailedPreconditionError):
+                sess.run(v.value())
+
+    def test_initialized_value_chain(self):
+        v = stf.Variable(stf.constant(3.0), name="a")
+        w = stf.Variable(v.initialized_value() * 2.0, name="b")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert float(sess.run(w.value())) == 6.0
+
+    def test_trainable_collections(self):
+        a = stf.Variable(stf.zeros([1]), name="t1")
+        b = stf.Variable(stf.zeros([1]), trainable=False, name="t2")
+        tv = stf.trainable_variables()
+        gv = stf.global_variables()
+        assert a in tv and b not in tv
+        assert a in gv and b in gv
+
+    def test_scatter_update(self):
+        v = stf.Variable(stf.zeros([4]), name="sc")
+        up = stf.scatter_update(v, stf.constant([1, 3]),
+                                stf.constant([9.0, 8.0]))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(up)
+            assert sess.run(v.value()).tolist() == [0.0, 9.0, 0.0, 8.0]
+
+    def test_scatter_add(self):
+        v = stf.Variable(stf.ones([3]), name="sa")
+        up = stf.scatter_add(v, stf.constant([0, 0, 2]),
+                             stf.constant([1.0, 1.0, 5.0]))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(up)
+            assert sess.run(v.value()).tolist() == [3.0, 1.0, 6.0]
+
+    def test_report_uninitialized(self):
+        v1 = stf.Variable(stf.zeros([1]), name="r1")
+        v2 = stf.Variable(stf.zeros([1]), name="r2")
+        with stf.Session() as sess:
+            sess.run(stf.variables_initializer([v1]))
+            names = [str(n) for n in
+                     np.ravel(sess.run(stf.report_uninitialized_variables()))]
+            assert any("r2" in n for n in names)
+            assert not any("r1" in n for n in names)
+
+    def test_is_variable_initialized(self):
+        v = stf.Variable(stf.zeros([1]), name="iv")
+        with stf.Session() as sess:
+            assert not bool(sess.run(stf.is_variable_initialized(v)))
+            sess.run(v.initializer)
+            assert bool(sess.run(stf.is_variable_initialized(v)))
+
+    def test_assign_in_multiple_steps_is_isolated(self):
+        """Two Sessions own independent variable state (ref: per-session
+        resource manager)."""
+        v = stf.Variable(stf.zeros([]), name="iso")
+        s1, s2 = stf.Session(), stf.Session()
+        s1.run(stf.global_variables_initializer())
+        s2.run(stf.global_variables_initializer())
+        s1.run(stf.assign(v, stf.constant(5.0)))
+        assert float(s1.run(v.value())) == 5.0
+        assert float(s2.run(v.value())) == 0.0
+        s1.close(), s2.close()
+
+
+class TestVariableScope:
+    def test_get_variable_creates_and_reuses(self):
+        with stf.variable_scope("layer"):
+            w1 = stf.get_variable("w", [2, 2],
+                                  initializer=stf.ones_initializer())
+        with stf.variable_scope("layer", reuse=True):
+            w2 = stf.get_variable("w")
+        assert w1 is w2
+        assert w1.var_name.startswith("layer/w")
+
+    def test_reuse_false_conflict_raises(self):
+        with stf.variable_scope("s1"):
+            stf.get_variable("x", [1])
+        with pytest.raises(ValueError):
+            with stf.variable_scope("s1"):
+                stf.get_variable("x", [1])
+
+    def test_reuse_missing_raises(self):
+        with pytest.raises(ValueError):
+            with stf.variable_scope("empty", reuse=True):
+                stf.get_variable("nope", [1])
+
+    def test_auto_reuse(self):
+        for _ in range(2):
+            with stf.variable_scope("ar", reuse=stf.AUTO_REUSE):
+                v = stf.get_variable("w", [3])
+        assert len([x for x in stf.global_variables()
+                    if "ar/w" in x.var_name]) == 1
+
+    def test_nested_scopes_and_initializer_inheritance(self):
+        with stf.variable_scope("a", initializer=stf.constant_initializer(
+                7.0)):
+            with stf.variable_scope("b"):
+                v = stf.get_variable("w", [2])
+        assert v.var_name.startswith("a/b/w")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert sess.run(v.value()).tolist() == [7.0, 7.0]
+
+    def test_custom_getter(self):
+        calls = []
+
+        def getter(orig, name, *args, **kwargs):
+            calls.append(name)
+            return orig(name, *args, **kwargs)
+
+        with stf.variable_scope("cg", custom_getter=getter):
+            stf.get_variable("w", [1])
+        assert calls and "cg/w" in calls[0]
+
+    def test_partitioned_variable(self):
+        with stf.variable_scope("pv"):
+            v = stf.get_variable(
+                "big", [8, 2],
+                partitioner=stf.ops.variable_scope.fixed_size_partitioner(2))
+        from simple_tensorflow_tpu.ops.variables import PartitionedVariable
+
+        if isinstance(v, PartitionedVariable):
+            assert len(list(v)) == 2
+
+
+class TestInitializers:
+    def _init_val(self, init, shape=(64, 64)):
+        v = stf.get_variable(f"iv_{init.__class__.__name__}_{np.random.randint(1e9)}",
+                             shape, initializer=init)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            return sess.run(v.value())
+
+    def test_constant_zeros_ones(self):
+        assert (self._init_val(stf.zeros_initializer()) == 0).all()
+        assert (self._init_val(stf.ones_initializer()) == 1).all()
+        assert (self._init_val(stf.constant_initializer(3.5)) == 3.5).all()
+
+    def test_random_uniform_range(self):
+        vals = self._init_val(stf.random_uniform_initializer(-2.0, 2.0))
+        assert vals.min() >= -2.0 and vals.max() <= 2.0
+        assert vals.std() > 0.5
+
+    def test_truncated_normal_bounds(self):
+        vals = self._init_val(stf.truncated_normal_initializer(stddev=1.0))
+        assert np.abs(vals).max() <= 2.0 + 1e-5
+
+    def test_glorot_scale(self):
+        vals = self._init_val(stf.glorot_uniform_initializer())
+        limit = np.sqrt(6.0 / (64 + 64))
+        assert np.abs(vals).max() <= limit + 1e-6
+
+    def test_orthogonal(self):
+        vals = self._init_val(stf.orthogonal_initializer(), (32, 32))
+        np.testing.assert_allclose(vals @ vals.T, np.eye(32), atol=1e-4)
+
+    def test_variables_reproducible_with_seed(self):
+        stf.set_random_seed(42)
+        v1 = stf.Variable(stf.random_normal([4]), name="seed_v1")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            a = sess.run(v1.value())
+        stf.reset_default_graph()
+        stf.set_random_seed(42)
+        v2 = stf.Variable(stf.random_normal([4]), name="seed_v1")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            b = sess.run(v2.value())
+        np.testing.assert_allclose(a, b)
