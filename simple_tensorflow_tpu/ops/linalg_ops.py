@@ -133,8 +133,8 @@ def self_adjoint_eig(tensor, name=None):
 
 def self_adjoint_eigvals(tensor, name=None):
     x = ops_mod.convert_to_tensor(tensor)
-    (e,) = make_op("SelfAdjointEigV2", [x], attrs={"compute_v": False},
-                   name=name, n_out=1)
+    e = make_op("SelfAdjointEigV2", [x], attrs={"compute_v": False},
+                name=name, n_out=1)
     return e
 
 

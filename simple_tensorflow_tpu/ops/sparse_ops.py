@@ -76,8 +76,31 @@ def sparse_tensor_dense_matmul(sp_a, b, adjoint_a=False, adjoint_b=False,
 
 
 def sparse_add(a, b, thresh=0, name=None):
+    """(ref: sparse_ops.py ``sparse_add``). sparse+sparse with build-time
+    constant indices returns a SparseTensor over the index union (static
+    nnz — the TPU shape rule); otherwise falls back to the dense sum."""
     from . import math_ops
 
+    if isinstance(a, SparseTensor) and isinstance(b, SparseTensor):
+        ia = constant_op.constant_value(a.indices)
+        va = constant_op.constant_value(a.values)
+        ib = constant_op.constant_value(b.indices)
+        vb = constant_op.constant_value(b.values)
+        sa = _static_dense_shape(a)
+        if all(x is not None for x in (ia, va, ib, vb, sa)):
+            acc = {}
+            for idx, v in zip(np.asarray(ia), np.asarray(va)):
+                acc[tuple(int(i) for i in idx)] = acc.get(
+                    tuple(int(i) for i in idx), 0) + v
+            for idx, v in zip(np.asarray(ib), np.asarray(vb)):
+                acc[tuple(int(i) for i in idx)] = acc.get(
+                    tuple(int(i) for i in idx), 0) + v
+            items = sorted((k, v) for k, v in acc.items()
+                           if abs(v) > thresh)
+            new_idx = np.asarray([k for k, _ in items], np.int64).reshape(
+                len(items), len(sa))
+            new_val = np.asarray([v for _, v in items])
+            return SparseTensor(new_idx, new_val, list(sa))
     da = sparse_tensor_to_dense(a) if isinstance(a, SparseTensor) else a
     db = sparse_tensor_to_dense(b) if isinstance(b, SparseTensor) else b
     return math_ops.add(da, db, name=name)

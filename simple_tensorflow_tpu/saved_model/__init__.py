@@ -1,6 +1,8 @@
 """stf.saved_model (ref: tensorflow/python/saved_model)."""
 
-from .builder import SavedModelBuilder
+from . import builder
+from . import loader
+from .builder import SavedModelBuilder, simple_save
 from .loader import load, maybe_saved_model_directory
 from . import signature_constants
 from . import tag_constants

@@ -1,0 +1,238 @@
+"""Image / linalg / spectral / sparse / string / clip op tests
+(mirrors ref kernel_tests for those families, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def _run(t, feed=None):
+    with stf.Session() as sess:
+        return sess.run(t, feed)
+
+
+RNG = np.random.RandomState(5)
+
+
+class TestImageOps:
+    def test_resize_bilinear_and_nearest(self):
+        img = RNG.rand(1, 4, 4, 3).astype(np.float32)
+        t = stf.constant(img)
+        out = _run({
+            "b": stf.image.resize_bilinear(t, [8, 8]),
+            "n": stf.image.resize_nearest_neighbor(t, [8, 8]),
+            "down": stf.image.resize_images(t, [2, 2]),
+        })
+        assert out["b"].shape == (1, 8, 8, 3)
+        assert out["n"].shape == (1, 8, 8, 3)
+        np.testing.assert_allclose(out["n"][0, ::2, ::2], img[0], rtol=1e-6)
+        assert out["down"].shape == (1, 2, 2, 3)
+
+    def test_crop_and_flip(self):
+        img = RNG.rand(4, 6, 3).astype(np.float32)
+        t = stf.constant(img)
+        out = _run({
+            "cc": stf.image.central_crop(t, 0.5),
+            "cp": stf.image.resize_image_with_crop_or_pad(t, 2, 2),
+            "fl": stf.image.flip_left_right(t),
+            "fu": stf.image.flip_up_down(t),
+            "crop": stf.image.crop_to_bounding_box(t, 1, 2, 2, 3),
+        })
+        np.testing.assert_allclose(out["fl"], img[:, ::-1])
+        np.testing.assert_allclose(out["fu"], img[::-1])
+        np.testing.assert_allclose(out["crop"], img[1:3, 2:5])
+        assert out["cp"].shape == (2, 2, 3)
+
+    def test_adjust_brightness_contrast(self):
+        img = np.full((2, 2, 3), 0.5, np.float32)
+        t = stf.constant(img)
+        out = _run({
+            "br": stf.image.adjust_brightness(t, 0.2),
+            "ct": stf.image.adjust_contrast(t, 2.0),
+            "std": stf.image.per_image_standardization(
+                stf.constant(RNG.rand(4, 4, 3).astype(np.float32))),
+        })
+        np.testing.assert_allclose(out["br"], img + 0.2, rtol=1e-5)
+        np.testing.assert_allclose(out["ct"], img, rtol=1e-5)  # uniform img
+        assert abs(out["std"].mean()) < 1e-5
+
+    def test_rgb_hsv_roundtrip(self):
+        img = RNG.rand(3, 3, 3).astype(np.float32)
+        t = stf.constant(img)
+        back = stf.image.hsv_to_rgb(stf.image.rgb_to_hsv(t))
+        np.testing.assert_allclose(_run(back), img, atol=1e-4)
+
+    def test_png_roundtrip(self):
+        img = (RNG.rand(5, 7, 3) * 255).astype(np.uint8)
+        encoded = stf.image.encode_png(stf.constant(img))
+        decoded = stf.image.decode_png(encoded)
+        out = _run(decoded)
+        np.testing.assert_array_equal(out, img)
+
+
+class TestLinalg:
+    def test_cholesky_solve_det_inverse(self):
+        a = RNG.rand(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        t = stf.constant(spd)
+        out = _run({
+            "chol": stf.cholesky(t),
+            "det": stf.matrix_determinant(t),
+            "inv": stf.matrix_inverse(t),
+            "solve": stf.matrix_solve(t, stf.constant(
+                np.eye(4, dtype=np.float32))),
+        })
+        np.testing.assert_allclose(out["chol"] @ out["chol"].T, spd,
+                                   rtol=1e-3)
+        np.testing.assert_allclose(out["det"], np.linalg.det(spd), rtol=1e-3)
+        np.testing.assert_allclose(out["inv"], np.linalg.inv(spd),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(out["solve"], np.linalg.inv(spd),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_qr_svd_eig(self):
+        a = RNG.rand(5, 3).astype(np.float32)
+        q, r = stf.qr(stf.constant(a))
+        s, u, v = stf.svd(stf.constant(a))
+        sym = a.T @ a
+        e = stf.self_adjoint_eigvals(stf.constant(sym))
+        out = _run({"q": q, "r": r, "s": s, "e": e})
+        np.testing.assert_allclose(out["q"] @ out["r"], a, atol=1e-4)
+        np.testing.assert_allclose(sorted(out["s"].tolist(), reverse=True),
+                                   np.linalg.svd(a, compute_uv=False),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(sorted(out["e"].tolist()),
+                                   sorted(np.linalg.eigvalsh(sym)),
+                                   rtol=1e-3)
+
+    def test_triangular_solve_norm(self):
+        L = np.tril(RNG.rand(3, 3).astype(np.float32) + 1)
+        b = RNG.rand(3, 1).astype(np.float32)
+        x = stf.matrix_triangular_solve(stf.constant(L), stf.constant(b),
+                                        lower=True)
+        out = _run({"x": x, "n2": stf.norm(stf.constant(b)),
+                    "n1": stf.norm(stf.constant(b), ord=1)})
+        np.testing.assert_allclose(L @ out["x"], b, atol=1e-4)
+        np.testing.assert_allclose(out["n2"], np.linalg.norm(b), rtol=1e-5)
+
+
+class TestSpectral:
+    def test_fft_roundtrip(self):
+        x = (RNG.rand(8) + 1j * RNG.rand(8)).astype(np.complex64)
+        t = stf.constant(x)
+        back = stf.ifft(stf.fft(t))
+        np.testing.assert_allclose(_run(back), x, atol=1e-5)
+
+    def test_fft2d(self):
+        x = RNG.rand(4, 4).astype(np.float32).astype(np.complex64)
+        f = stf.fft2d(stf.constant(x))
+        np.testing.assert_allclose(_run(f), np.fft.fft2(x), atol=1e-3)
+
+
+class TestSparse:
+    def test_sparse_to_dense_and_matmul(self):
+        sp = stf.SparseTensor(indices=[[0, 0], [1, 2]], values=[1.0, 2.0],
+                              dense_shape=[2, 3])
+        from simple_tensorflow_tpu.ops import sparse_ops
+
+        dense = sparse_ops.sparse_tensor_to_dense(sp)
+        w = stf.constant(RNG.rand(3, 2).astype(np.float32))
+        prod = sparse_ops.sparse_tensor_dense_matmul(sp, w)
+        out = _run({"d": dense, "p": prod, "w": w})
+        assert out["d"].tolist() == [[1., 0., 0.], [0., 0., 2.]]
+        np.testing.assert_allclose(out["p"], out["d"] @ out["w"], rtol=1e-5)
+
+    def test_sparse_add_retain(self):
+        from simple_tensorflow_tpu.ops import sparse_ops
+
+        a = stf.SparseTensor([[0, 0]], [1.0], [2, 2])
+        b = stf.SparseTensor([[1, 1]], [2.0], [2, 2])
+        s = sparse_ops.sparse_add(a, b)
+        with stf.Session() as sess:
+            out = sess.run(sparse_ops.sparse_tensor_to_dense(s))
+        assert out.tolist() == [[1., 0.], [0., 2.]]
+
+
+class TestStrings:
+    def test_string_ops_host_stage(self):
+        s = stf.placeholder(stf.string, [3], name="s")
+        from simple_tensorflow_tpu.ops import string_ops
+
+        joined = string_ops.string_join([s, s], separator="-")
+        upper = string_ops.string_upper(s)
+        length = string_ops.string_length(s)
+        with stf.Session() as sess:
+            vals = np.array(["ab", "c", "def"], dtype=object)
+            out = sess.run({"j": joined, "u": upper, "l": length}, {s: vals})
+        assert list(out["j"]) == ["ab-ab", "c-c", "def-def"]
+        assert list(out["u"]) == ["AB", "C", "DEF"]
+        assert out["l"].tolist() == [2, 1, 3]
+
+    def test_as_string_and_number(self):
+        from simple_tensorflow_tpu.ops import string_ops
+
+        x = stf.constant([1, 22])
+        s = string_ops.as_string(x)
+        with stf.Session() as sess:
+            out = sess.run(s)
+        assert list(out) == ["1", "22"]
+
+
+class TestClip:
+    def test_clip_by_value_norm(self):
+        x = np.float32([3.0, 4.0])
+        out = _run({
+            "v": stf.clip_by_value(stf.constant(x), 0.0, 3.5),
+            "n": stf.clip_by_norm(stf.constant(x), 2.5),
+            "gn": stf.global_norm([stf.constant(x)]),
+        })
+        assert out["v"].tolist() == [3.0, 3.5]
+        np.testing.assert_allclose(out["n"], [1.5, 2.0], rtol=1e-5)
+        assert abs(float(out["gn"]) - 5.0) < 1e-5
+
+    def test_clip_by_average_norm(self):
+        x = stf.constant(np.float32([3.0, 4.0]))
+        out = _run(stf.clip_by_average_norm(x, 1.0))
+        # avg norm = 5/2 = 2.5 -> scale by 1/2.5
+        np.testing.assert_allclose(out, [1.2, 1.6], rtol=1e-5)
+
+
+class TestRandomOps:
+    def test_random_deterministic_per_seed(self):
+        stf.set_random_seed(7)
+        r = stf.random_normal([100], seed=3)
+        with stf.Session() as sess:
+            a = sess.run(r)
+        stf.reset_default_graph()
+        stf.set_random_seed(7)
+        r = stf.random_normal([100], seed=3)
+        with stf.Session() as sess:
+            b = sess.run(r)
+        np.testing.assert_allclose(a, b)
+
+    def test_distribution_stats(self):
+        out = _run({
+            "u": stf.random_uniform([20000], 2.0, 4.0, seed=1),
+            "n": stf.random_normal([20000], mean=1.0, stddev=2.0, seed=2),
+            "t": stf.truncated_normal([20000], seed=3),
+        })
+        assert 2.0 <= out["u"].min() and out["u"].max() < 4.0
+        assert abs(out["u"].mean() - 3.0) < 0.05
+        assert abs(out["n"].mean() - 1.0) < 0.1
+        assert abs(out["n"].std() - 2.0) < 0.1
+        assert np.abs(out["t"]).max() <= 2.0 + 1e-5
+
+    def test_multinomial_and_shuffle(self):
+        logits = stf.constant(np.float32([[0.0, 10.0]]))
+        m = stf.multinomial(logits, 50, seed=5)
+        sh = stf.random_shuffle(stf.constant(np.arange(10)), seed=6)
+        out = _run({"m": m, "sh": sh})
+        assert (out["m"] == 1).mean() > 0.9
+        assert sorted(out["sh"].tolist()) == list(range(10))

@@ -1,0 +1,158 @@
+"""SavedModel round-trips, Estimator train/evaluate/predict, debug
+wrappers, timeline, device_lib (SURVEY §2.9-§2.11)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+class TestSavedModel:
+    def test_simple_save_and_load(self, tmp_path):
+        from simple_tensorflow_tpu import saved_model as sm
+
+        x = stf.placeholder(stf.float32, [None, 2], name="x")
+        w = stf.Variable(stf.constant([[1.0], [2.0]]), name="w")
+        y = stf.matmul(x, w, name="y")
+        export_dir = str(tmp_path / "model")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sm.simple_save(sess, export_dir, inputs={"x": x},
+                           outputs={"y": y})
+        assert os.path.exists(export_dir)
+
+        stf.reset_default_graph()
+        with stf.Session() as sess2:
+            meta = sm.loader.load(sess2, [sm.tag_constants.SERVING],
+                                  export_dir)
+            sig = meta["signature_def"]["serving_default"]
+            x_name = sig["inputs"]["x"]["name"]
+            y_name = sig["outputs"]["y"]["name"]
+            out = sess2.run(y_name, {x_name: np.float32([[3.0, 4.0]])})
+        assert out.tolist() == [[11.0]]
+
+    def test_builder_with_signature(self, tmp_path):
+        from simple_tensorflow_tpu import saved_model as sm
+
+        x = stf.placeholder(stf.float32, [None], name="inp")
+        v = stf.Variable(stf.constant(2.0), name="scale")
+        y = stf.multiply(x, v.value(), name="out")
+        b = sm.builder.SavedModelBuilder(str(tmp_path / "m"))
+        sig = sm.signature_def_utils.predict_signature_def(
+            inputs={"x": x}, outputs={"y": y})
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            b.add_meta_graph_and_variables(
+                sess, [sm.tag_constants.SERVING],
+                signature_def_map={"predict": sig})
+        b.save()
+        stf.reset_default_graph()
+        with stf.Session() as sess:
+            meta = sm.loader.load(sess, [sm.tag_constants.SERVING],
+                                  str(tmp_path / "m"))
+            out = sess.run("out:0", {"inp:0": np.float32([1.0, 3.0])})
+        assert out.tolist() == [2.0, 6.0]
+
+
+class TestEstimator:
+    def _model_fn(self, features, labels, mode, params=None, config=None):
+        from simple_tensorflow_tpu import estimator as est
+
+        w = stf.get_variable("w", [2, 1], initializer=stf.zeros_initializer())
+        pred = stf.matmul(features["x"], w)
+        if mode == est.ModeKeys.PREDICT:
+            return est.EstimatorSpec(mode, predictions={"pred": pred})
+        loss = stf.reduce_mean(stf.square(pred - labels))
+        if mode == est.ModeKeys.EVAL:
+            return est.EstimatorSpec(mode, loss=loss)
+        gs = stf.train.get_or_create_global_step()
+        train_op = stf.train.GradientDescentOptimizer(0.2).minimize(
+            loss, global_step=gs)
+        return est.EstimatorSpec(mode, loss=loss, train_op=train_op,
+                                 predictions={"pred": pred})
+
+    def _input_fn(self):
+        rng = np.random.RandomState(0)
+        X = rng.rand(32, 2).astype(np.float32)
+        Y = (X @ np.float32([[1.0], [2.0]]))
+        from simple_tensorflow_tpu import data as stf_data
+
+        ds = stf_data.Dataset.from_tensor_slices(
+            {"x": X, "y": Y}).repeat().batch(8)
+        f = ds.make_one_shot_iterator().get_next()
+        return {"x": f["x"]}, f["y"]
+
+    def test_train_evaluate_predict(self, tmp_path):
+        from simple_tensorflow_tpu import estimator as est
+
+        e = est.Estimator(self._model_fn, model_dir=str(tmp_path))
+        e.train(self._input_fn, steps=40)
+        metrics = e.evaluate(self._input_fn, steps=4)
+        assert metrics["loss"] < 0.2
+        import itertools
+
+        # input_fn repeats forever; predict streams until input exhaustion,
+        # so take a bounded prefix
+        preds = list(itertools.islice(e.predict(self._input_fn), 3))
+        assert len(preds) == 3 and "pred" in preds[0]
+
+
+class TestDebug:
+    def test_dumping_wrapper_captures_tensors(self, tmp_path):
+        from simple_tensorflow_tpu import debug as stf_debug
+
+        x = stf.placeholder(stf.float32, [2], name="x")
+        y = stf.square(x, name="sq")
+        sess = stf.Session()
+        wrapped = stf_debug.DumpingDebugWrapperSession(
+            sess, session_root=str(tmp_path))
+        out = wrapped.run(y, {x: np.float32([2.0, 3.0])})
+        assert out.tolist() == [4.0, 9.0]
+        dumps = os.listdir(str(tmp_path))
+        assert dumps  # a dump directory per run
+
+    def test_has_inf_or_nan_filter(self):
+        from simple_tensorflow_tpu.debug import has_inf_or_nan
+
+        assert has_inf_or_nan("t", np.array([1.0, np.inf]))
+        assert not has_inf_or_nan("t", np.array([1.0, 2.0]))
+
+
+class TestTimelineAndDevices:
+    def test_run_metadata_timeline(self, tmp_path):
+        x = stf.placeholder(stf.float32, [4], name="x")
+        y = stf.reduce_sum(stf.square(x))
+        run_metadata = stf.train.SessionRunValues if False else None
+        from simple_tensorflow_tpu.client.session import RunMetadata, RunOptions
+
+        meta = RunMetadata()
+        with stf.Session() as sess:
+            sess.run(y, {x: np.ones(4, np.float32)},
+                     options=RunOptions(trace_level=RunOptions.FULL_TRACE),
+                     run_metadata=meta)
+        tl = stf.timeline.Timeline(meta.step_stats)
+        trace = tl.generate_chrome_trace_format()
+        data = json.loads(trace)
+        assert "traceEvents" in data and data["traceEvents"]
+
+    def test_list_local_devices(self):
+        devs = stf.device_lib.list_local_devices()
+        assert devs and devs[0].device_type in ("CPU", "TPU")
+
+    def test_metrics_namespace(self):
+        labels = stf.constant([1, 0, 1, 1])
+        preds = stf.constant([1, 0, 0, 1])
+        acc, update = stf.metrics.accuracy(labels, preds)
+        with stf.Session() as sess:
+            sess.run(stf.local_variables_initializer())
+            sess.run(update)
+            assert abs(float(sess.run(acc)) - 0.75) < 1e-6
