@@ -130,9 +130,18 @@ void StfRecordWriterWrite(StfRecordWriter* w, const uint8_t* data, size_t n,
   PutU32(footer, Mask(Crc32c(data, n)));
   bool ok;
   if (w->gz) {
-    ok = gzwrite(w->gz, header, 12) == 12 &&
-         (n == 0 || gzwrite(w->gz, data, (unsigned)n) == (int)n) &&
-         gzwrite(w->gz, footer, 4) == 4;
+    // gzwrite takes unsigned len and returns int: chunk to <=1 GiB so
+    // records >=2 GiB neither truncate nor overflow the comparison
+    // (mirrors the reader's chunked gzread).
+    ok = gzwrite(w->gz, header, 12) == 12;
+    size_t off = 0;
+    const size_t kChunk = 1u << 30;
+    while (ok && off < n) {
+      unsigned len = (unsigned)(n - off < kChunk ? n - off : kChunk);
+      ok = gzwrite(w->gz, data + off, len) == (int)len;
+      off += len;
+    }
+    ok = ok && gzwrite(w->gz, footer, 4) == 4;
   } else {
     ok = fwrite(header, 1, 12, w->f) == 12 &&
          fwrite(data, 1, n, w->f) == n && fwrite(footer, 1, 4, w->f) == 4;

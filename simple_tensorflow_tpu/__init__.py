@@ -143,7 +143,8 @@ from .ops.linalg_ops import (
 from .ops.spectral_ops import fft, ifft, fft2d, ifft2d, fft3d, ifft3d
 
 # client
-from .client.session import Session, InteractiveSession, get_default_session
+from .client.session import (Session, InteractiveSession,
+                             get_default_session, RunOptions, RunMetadata)
 
 # namespaces (tf.nn, tf.train, tf.layers, tf.summary, ...)
 from . import compiler

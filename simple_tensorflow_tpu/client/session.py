@@ -67,6 +67,14 @@ class VariableStore:
         dtype = None
         if variable is not None:
             dtype = variable.dtype.base_dtype.np_dtype
+            if not jax.config.jax_enable_x64:
+                # x64 is off: jnp would silently truncate 64-bit dtypes with
+                # a warning. Narrow explicitly so the stored array (and the
+                # dtype recorded in checkpoints) is the truth.
+                narrow = {np.dtype(np.int64): np.int32,
+                          np.dtype(np.uint64): np.uint32,
+                          np.dtype(np.float64): np.float32}
+                dtype = narrow.get(np.dtype(dtype), dtype)
         arr = jnp.asarray(np.asarray(value), dtype=dtype)
         sh = self.shardings.get(name)
         if sh is not None:
@@ -75,6 +83,38 @@ class VariableStore:
 
     def as_numpy(self, name: str):
         return np.asarray(self.values[name])
+
+
+class RunOptions:
+    """(ref: config.proto ``RunOptions``). trace_level >= SOFTWARE_TRACE
+    makes Session.run block on device results and record per-stage step
+    stats into the provided RunMetadata."""
+
+    NO_TRACE = 0
+    SOFTWARE_TRACE = 1
+    HARDWARE_TRACE = 2
+    FULL_TRACE = 3
+
+    def __init__(self, trace_level=NO_TRACE, timeout_in_ms=0,
+                 inter_op_thread_pool=0, output_partition_graphs=False,
+                 debug_options=None):
+        self.trace_level = trace_level
+        self.timeout_in_ms = timeout_in_ms
+        self.inter_op_thread_pool = inter_op_thread_pool
+        self.output_partition_graphs = output_partition_graphs
+        self.debug_options = debug_options
+
+
+class RunMetadata:
+    """(ref: config.proto ``RunMetadata``, core/common_runtime/
+    step_stats_collector.cc). ``step_stats`` is the dict client/timeline.py
+    renders: {"start_us", "wall_time_s", "nodes": [{name, start_us, dur_us,
+    tid}], ...}."""
+
+    def __init__(self):
+        self.step_stats: Dict[str, Any] = {}
+        self.partition_graphs: List[Any] = []
+        self.cost_graph: Dict[str, Any] = {}
 
 
 class _FetchMapper:
@@ -224,15 +264,44 @@ class BaseSession:
         if self._closed:
             raise RuntimeError("Attempted to use a closed Session.")
         t0 = time.perf_counter()
+        trace = (options is not None and
+                 getattr(options, "trace_level", 0) > 0 and
+                 run_metadata is not None)
+        collector: Optional[Dict[str, Any]] = (
+            {"events": [], "start_s": t0} if trace else None)
         mapper = _FetchMapper(self._graph, fetches)
         feeds = self._normalize_feeds(feed_dict)
-        values = self._run_elements(mapper.elements, feeds)
+        values = self._run_elements(mapper.elements, feeds,
+                                    collector=collector)
         out = mapper.rebuild(values)
         if run_metadata is not None:
-            try:
-                run_metadata["wall_time_s"] = time.perf_counter() - t0
-            except TypeError:
-                pass
+            wall = time.perf_counter() - t0
+            stats = {
+                "start_us": 0,
+                "wall_time_s": wall,
+                "nodes": [],
+            }
+            if collector is not None:
+                base = collector["start_s"]
+                for name, start_s, dur_s, tid in collector["events"]:
+                    stats["nodes"].append({
+                        "name": name,
+                        "start_us": (start_s - base) * 1e6,
+                        "dur_us": max(dur_s * 1e6, 1.0),
+                        "tid": tid,
+                    })
+                for k in ("compile_time_s", "fetch_bytes", "n_device_ops",
+                          "n_host_ops", "flop_estimate"):
+                    if k in collector:
+                        stats[k] = collector[k]
+            if isinstance(run_metadata, RunMetadata):
+                run_metadata.step_stats = stats
+            else:
+                try:
+                    run_metadata["wall_time_s"] = wall
+                    run_metadata["step_stats"] = stats
+                except TypeError:
+                    pass
         return out
 
     def _normalize_feeds(self, feed_dict) -> Dict[Tensor, np.ndarray]:
@@ -261,24 +330,35 @@ class BaseSession:
             feeds[t] = arr
         return feeds
 
-    def _run_elements(self, elements: List[Any], feeds: Dict[Tensor, np.ndarray]):
+    def _run_elements(self, elements: List[Any],
+                      feeds: Dict[Tensor, np.ndarray], collector=None):
         key = (tuple(e.name if isinstance(e, Tensor) else "(op)" + e.name
                      for e in elements),
                tuple(sorted(t.name for t in feeds)))
         step = self._cache.get(key)
+        plan_t0 = time.perf_counter()
+        first_call = step is None
         if step is None:
             step = self._plan(elements, feeds)
             self._cache[key] = step
+        if collector is not None and first_call:
+            collector["events"].append(
+                ("plan", plan_t0, time.perf_counter() - plan_t0, 0))
 
         # Host stage -------------------------------------------------------
         host_env: Dict[Tensor, Any] = {}
         if step.host_plan:
+            h_t0 = time.perf_counter()
             hctx = lowering_mod.LoweringContext(
                 self._variable_store.values, rng_root=None, feeds=dict(feeds),
                 host=True, session=self)
             hctx.env.update(feeds)
             lowering_mod.execute_ops(hctx, step.host_plan, fed=set(feeds))
             host_env = hctx.env
+            if collector is not None:
+                collector["events"].append(
+                    ("host_stage", h_t0, time.perf_counter() - h_t0, 1))
+                collector["n_host_ops"] = len(step.host_plan)
 
         # Device stage -----------------------------------------------------
         device_results: List[Any] = []
@@ -290,8 +370,24 @@ class BaseSession:
                 val = feeds[t] if t in feeds else host_env[t]
                 feed_args[t.name] = self._maybe_shard_feed(t, val)
             state = self._variable_store.values
+            d_t0 = time.perf_counter()
             fetch_vals, new_state, check_flags = step.jitted(
                 dict(state), feed_args, rng)
+            if collector is not None:
+                import jax
+
+                # block so the recorded duration covers device execution,
+                # not just async dispatch
+                jax.block_until_ready(fetch_vals)
+                d_dur = time.perf_counter() - d_t0
+                name = ("device_program_compile+run" if step.n_calls == 0
+                        else "device_program")
+                collector["events"].append((name, d_t0, d_dur, 2))
+                if step.n_calls == 0:
+                    collector["compile_time_s"] = d_dur
+                collector["n_device_ops"] = len(step.device_ops)
+                collector["fetch_bytes"] = int(sum(
+                    getattr(v, "nbytes", 0) for v in fetch_vals))
             if check_flags:
                 # inspect BEFORE committing state: a failed check must not
                 # apply NaN-contaminated updates (ref semantics: ops
@@ -314,6 +410,7 @@ class BaseSession:
 
         # Post-host stage (host sinks: summaries etc.) ----------------------
         if step.post_host_plan:
+            p_t0 = time.perf_counter()
             pctx = lowering_mod.LoweringContext(
                 self._variable_store.values, rng_root=None, host=True,
                 session=self)
@@ -324,6 +421,9 @@ class BaseSession:
             lowering_mod.execute_ops(pctx, step.post_host_plan,
                                      fed=set(pctx.env))
             host_env = pctx.env
+            if collector is not None:
+                collector["events"].append(
+                    ("post_host_stage", p_t0, time.perf_counter() - p_t0, 1))
 
         # Assemble ---------------------------------------------------------
         out = []
@@ -531,7 +631,14 @@ class BaseSession:
             flags = [f for _, f in ctx.numeric_checks]
             return fetch_vals, ctx.state, flags
 
-        step.jitted = jax.jit(step_fn, donate_argnums=0)
+        # Donation deletes the pre-step variable buffers. When the step
+        # contains CheckNumerics, a failed check must leave the OLD state
+        # intact (ref semantics: downstream ops never run), so donation is
+        # disabled for those steps — otherwise a check failure would brick
+        # the session with deleted arrays.
+        has_checks = any(op.type == "CheckNumerics" for op in device_ops)
+        step.jitted = jax.jit(step_fn,
+                              donate_argnums=() if has_checks else (0,))
         step.check_msgs = check_msgs
         return step
 

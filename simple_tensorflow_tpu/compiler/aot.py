@@ -99,14 +99,17 @@ def compile_fetches(fetches, feeds: Sequence[ops_mod.Tensor],
         lowering_mod.execute_ops(ctx, pruned, fed=fed_set)
         return tuple(ctx.env[t] for t in fetch_list)
 
-    args = [jax.ShapeDtypeStruct(
-        tuple(t.shape.as_list()), t.dtype.as_numpy_dtype)
-        for t in feed_list]
-    for t, a in zip(feed_list, args):
-        if any(d is None for d in t.shape.as_list() or [None]):
+    for t in feed_list:
+        # Validate BEFORE building ShapeDtypeStructs: unknown-rank shapes
+        # would crash in as_list() with an unfriendly error, and a static
+        # scalar (as_list() == []) is perfectly valid.
+        if t.shape.rank is None or any(d is None for d in t.shape.as_list()):
             raise ValueError(
                 f"AOT feed {t.name} has unknown shape {t.shape}; XLA AOT "
                 "needs fully static shapes")
+    args = [jax.ShapeDtypeStruct(
+        tuple(t.shape.as_list()), t.dtype.as_numpy_dtype)
+        for t in feed_list]
     lowered = jax.jit(fn).lower(*args)
     key = hashlib.sha256(lowered.as_text().encode()).hexdigest()[:16]
     compiled = lowered.compile()

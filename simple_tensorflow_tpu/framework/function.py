@@ -46,7 +46,6 @@ class _DefinedFunction:
         self._grad_func = grad_func
         self._python_grad_func = python_grad_func
         self._out_names = out_names
-        self._cache: Dict[Tuple, ops_mod.FuncGraph] = {}
 
     @property
     def name(self):
@@ -57,10 +56,20 @@ class _DefinedFunction:
         return list(self._input_types)
 
     def _trace(self, arg_specs) -> ops_mod.FuncGraph:
-        key = tuple(arg_specs)
-        if key in self._cache:
-            return self._cache[key]
+        # Traced FuncGraphs capture tensors from the graph current at trace
+        # time, so the cache lives ON that graph (a module-level @Defun
+        # outlives reset_default_graph(); reusing a FuncGraph across graphs
+        # would splice cross-graph tensors into the call op, and caching on
+        # the Defun would keep dead graphs alive).
+        import weakref
+
         g = ops_mod.get_default_graph()
+        by_defun = g._scoped_state.setdefault(
+            "__defun_cache__", weakref.WeakKeyDictionary())
+        per_graph = by_defun.setdefault(self, {})
+        key = tuple(arg_specs)
+        if key in per_graph:
+            return per_graph[key]
         fg = ops_mod.FuncGraph(self._name, outer_graph=g)
         with ops_mod._as_current(fg):
             args = [fg.add_input(dtype, shape, f"arg{i}")
@@ -71,7 +80,7 @@ class _DefinedFunction:
                     f"@Defun function {self._name} returned None")
             flat = list(res) if isinstance(res, (list, tuple)) else [res]
             fg.outputs = [ops_mod.convert_to_tensor(t) for t in flat]
-        self._cache[key] = fg
+        per_graph[key] = fg
         return fg
 
     def __call__(self, *args, name=None):

@@ -133,6 +133,15 @@ def import_graph_def(graph_def, input_map=None, return_elements=None,
     def build_into(target_graph, nodes, tensor_env, scope_prefix):
         for node in nodes:
             attrs = {k: _decode_attr(v) for k, v in node["attr"].items()}
+            # Scoped imports get their own VariableStore namespace: rewrite
+            # var_name attrs so an imported 'w' cannot alias an existing
+            # variable 'w' in this graph (store keys come from these attrs).
+            if scope_prefix:
+                if isinstance(attrs.get("var_name"), str):
+                    attrs["var_name"] = f"{scope_prefix}/{attrs['var_name']}"
+                if isinstance(attrs.get("var_names"), tuple):
+                    attrs["var_names"] = tuple(
+                        f"{scope_prefix}/{n}" for n in attrs["var_names"])
             # rebuild nested funcgraphs
             for k, v in list(attrs.items()):
                 if isinstance(v, dict) and v.get("__kind__") == "funcgraph":
@@ -219,4 +228,53 @@ def import_meta_graph(meta_graph_or_file, clear_devices=False,
     else:
         meta = meta_graph_or_file
     import_graph_def(meta["graph_def"], name=import_scope or "")
+    _rebuild_collections(meta, import_scope)
     return meta
+
+
+def _rebuild_collections(meta, import_scope=None):
+    """Restore graph collections from a MetaGraph, reconstructing Variable
+    wrappers from their serialized protos (ref: python/framework/
+    meta_graph.py ``import_scoped_meta_graph`` — without this, Saver finds
+    no variables after import and restore is a silent no-op)."""
+    g = ops_mod.get_default_graph()
+    rebuilt_vars = {}  # variable_name -> Variable (shared across collections)
+
+    def _scoped(name):
+        return f"{import_scope}/{name}" if import_scope else name
+
+    for key, items in meta.get("collections", {}).items():
+        for it in items:
+            if "tensor" in it or "op" in it:
+                ref, as_tensor = ((it["tensor"], True) if "tensor" in it
+                                  else (it["op"], False))
+                try:
+                    g.add_to_collection(key, g.as_graph_element(
+                        _scoped(ref), allow_tensor=as_tensor,
+                        allow_operation=not as_tensor))
+                except (KeyError, ValueError):
+                    continue  # item not present in the imported subgraph
+            elif "proto" in it:
+                proto = it["proto"]
+                if isinstance(proto, dict) and "variable_name" in proto:
+                    vname = proto["variable_name"]
+                    if vname not in rebuilt_vars:
+                        from ..ops.variables import Variable
+
+                        try:
+                            rebuilt_vars[vname] = Variable.from_proto(
+                                proto, import_scope=import_scope, graph=g)
+                        except (KeyError, ValueError) as e:
+                            # a dropped variable means Saver.restore would
+                            # silently skip it — that must be loud
+                            from ..platform import tf_logging as logging
+
+                            logging.warning(
+                                "import_meta_graph: could not rebuild "
+                                "variable %s from collection %s (%s); it "
+                                "will NOT be restored by Saver.", vname,
+                                key, e)
+                            continue
+                    g.add_to_collection(key, rebuilt_vars[vname])
+                # other proto kinds (e.g. SaverDef) are advisory: the
+                # caller constructs a fresh Saver over the rebuilt vars

@@ -60,9 +60,12 @@ def dead_code_elimination(graph_def: Dict, keep: List[str]) -> Dict:
     return out
 
 
-def common_subexpression_elimination(graph_def: Dict) -> Dict:
+def common_subexpression_elimination(graph_def: Dict,
+                                     keep: Optional[List[str]] = None) -> Dict:
     """Merge pure nodes with identical (op, inputs, attrs)
-    (ref: core/graph/optimizer_cse.cc)."""
+    (ref: core/graph/optimizer_cse.cc). Nodes named in ``keep`` are never
+    merged away — callers fetch them by name after import."""
+    keep_names: Set[str] = {_tensor_ref(k)[0] for k in (keep or [])}
     out = copy.deepcopy(graph_def)
     replace: Dict[str, str] = {}  # old node name -> canonical node name
     seen: Dict[str, str] = {}  # signature -> canonical name
@@ -78,10 +81,11 @@ def common_subexpression_elimination(graph_def: Dict) -> Dict:
         sig = repr((n["op"], n["input"],
                     sorted((k, repr(v)) for k, v in
                            n.get("attr", {}).items())))
-        if sig in seen:
+        if sig in seen and n["name"] not in keep_names:
             replace[n["name"]] = seen[sig]
         else:
-            seen[sig] = n["name"]
+            if sig not in seen:
+                seen[sig] = n["name"]
             kept.append(n)
     out["node"] = kept
     return out
@@ -156,7 +160,7 @@ def constant_folding(graph_def: Dict) -> Dict:
 def optimize(graph_def: Dict, keep: Optional[List[str]] = None) -> Dict:
     """grappler-equivalent pipeline: fold -> CSE -> DCE."""
     gd = constant_folding(graph_def)
-    gd = common_subexpression_elimination(gd)
+    gd = common_subexpression_elimination(gd, keep=keep)
     if keep:
         gd = dead_code_elimination(gd, keep)
     return gd

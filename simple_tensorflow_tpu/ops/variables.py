@@ -206,6 +206,44 @@ class Variable:
             "trainable": self._trainable,
         }
 
+    @classmethod
+    def from_proto(cls, proto, import_scope=None, graph=None):
+        """Rebind a Variable wrapper to ALREADY-IMPORTED graph ops
+        (ref: variables.py ``Variable.from_proto``). Used by
+        import_meta_graph / SavedModel load so Saver.restore finds the
+        variables again; creates NO new ops."""
+        g = graph or ops_mod.get_default_graph()
+
+        def _scoped(name):
+            return f"{import_scope}/{name}" if import_scope else name
+
+        self = cls.__new__(cls)
+        ref = g.as_graph_element(_scoped(proto["variable_name"]),
+                                 allow_tensor=True, allow_operation=False)
+        self._graph = g
+        self._ref = ref
+        self._op = ref.op
+        self._var_name = ref.op.attrs.get(
+            "var_name", _scoped(proto["variable_name"]).split(":")[0])
+        self._trainable = bool(proto.get("trainable", True))
+        self._constraint = None
+        self._save_slice_info = None
+        self._initializer_op = g.as_graph_element(
+            _scoped(proto["initializer_name"]),
+            allow_tensor=False, allow_operation=True)
+        self._snapshot = g.as_graph_element(
+            _scoped(proto["snapshot_name"]),
+            allow_tensor=True, allow_operation=False)
+        try:
+            self._initial_value = g.as_graph_element(
+                _scoped(proto["initial_value_name"]),
+                allow_tensor=True, allow_operation=False)
+        except (KeyError, ValueError):
+            self._initial_value = None
+        g._scoped_state.setdefault("__vars_by_store_name__",
+                                   {})[self._var_name] = self
+        return self
+
     @property
     def _shared_name(self):
         return self._var_name

@@ -29,7 +29,10 @@ def load(sess, tags, export_dir, **saver_kwargs):
         raise RuntimeError(
             f"MetaGraph with tags {tags} not found in {export_dir}; "
             f"available: {[m.get('tags') for m in saved['meta_graphs']]}")
-    graph_io.import_graph_def(target["graph_def"], name="")
+    # import_meta_graph (not bare import_graph_def): rebuilds collections +
+    # Variable wrappers so the Saver below finds and restores them
+    # (ref: loader_impl.py:192 restores via the MetaGraph's saver_def).
+    graph_io.import_meta_graph(target)
     var_prefix = os.path.join(export_dir, VARIABLES_DIRECTORY,
                               VARIABLES_FILENAME)
     from ..train.saver import checkpoint_exists

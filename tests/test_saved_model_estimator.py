@@ -63,6 +63,54 @@ class TestSavedModel:
         assert out.tolist() == [2.0, 6.0]
 
 
+class TestMetaGraphVariables:
+    def test_import_meta_graph_rebuilds_variables(self, tmp_path):
+        """Collections + Variable wrappers must survive export/import so
+        Saver.restore finds them (round-2 fix)."""
+        v = stf.Variable(stf.constant([1.5, 2.5]), name="mv")
+        path = str(tmp_path / "g.meta")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            saver = stf.train.Saver()
+            ckpt = saver.save(sess, str(tmp_path / "ck"),
+                              write_meta_graph=False)
+            from simple_tensorflow_tpu.framework import graph_io
+
+            graph_io.export_meta_graph(path)
+
+        stf.reset_default_graph()
+        from simple_tensorflow_tpu.framework import graph_io
+
+        graph_io.import_meta_graph(path)
+        gvars = stf.global_variables()
+        assert len(gvars) == 1 and gvars[0].var_name == "mv"
+        with stf.Session() as sess2:
+            stf.train.Saver().restore(sess2, ckpt)
+            out = sess2.run(gvars[0].value())
+        assert out.tolist() == [1.5, 2.5]
+
+    def test_scoped_import_does_not_alias_existing_variable(self, tmp_path):
+        """An imported 'w' under a scope must get its own store slot, not
+        clobber this graph's 'w'."""
+        from simple_tensorflow_tpu.framework import graph_io
+
+        stf.Variable(stf.constant([9.0]), name="w")
+        path = str(tmp_path / "g.meta")
+        graph_io.export_meta_graph(path)
+
+        stf.reset_default_graph()
+        mine = stf.Variable(stf.constant([1.0]), name="w")
+        graph_io.import_meta_graph(path, import_scope="loaded")
+        gvars = stf.global_variables()
+        names = sorted(v.var_name for v in gvars)
+        assert names == ["loaded/w", "w"], names
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert sess.run(mine.value()).tolist() == [1.0]
+            imported = [v for v in gvars if v.var_name == "loaded/w"][0]
+            assert sess.run(imported.value()).tolist() == [9.0]
+
+
 class TestEstimator:
     def _model_fn(self, features, labels, mode, params=None, config=None):
         from simple_tensorflow_tpu import estimator as est
