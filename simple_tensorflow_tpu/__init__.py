@@ -132,6 +132,12 @@ from .ops.misc_ops import (
     confusion_matrix, histogram_fixed_width, bitcast, lbeta,
 )
 from .ops.numerics import verify_tensor_all_finite, add_check_numerics_ops
+from .ops import io_ops
+from .ops.io_ops import (
+    ReaderBase, WholeFileReader, IdentityReader, TextLineReader,
+    TFRecordReader, FixedLengthRecordReader, read_file, write_file,
+    matching_files,
+)
 from .framework.function import Defun
 from .framework import function
 from .framework import optimizer as graph_optimizer

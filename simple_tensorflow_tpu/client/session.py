@@ -85,6 +85,17 @@ class VariableStore:
         return np.asarray(self.values[name])
 
 
+def _is_host_device(device_str) -> bool:
+    """``with stf.device('/cpu:0')`` pins an op to the host stage (the
+    reference's simple_placer CPU assignment,
+    core/common_runtime/simple_placer.cc). TPU/GPU/empty scopes keep the op
+    in the compiled XLA step; task/job parts are placement-neutral on a
+    single host."""
+    if not device_str:
+        return False
+    return "cpu" in str(device_str).lower()
+
+
 class RunOptions:
     """(ref: config.proto ``RunOptions``). trace_level >= SOFTWARE_TRACE
     makes Session.run block on device results and record per-stage step
@@ -185,13 +196,15 @@ class _CompiledStep:
     __slots__ = ("jitted", "device_fetches", "host_plan", "post_host_plan",
                  "post_host_inputs", "device_ops", "feed_tensors", "boundary",
                  "has_device_stage", "n_calls", "last_lowering_ctx",
-                 "check_msgs")
+                 "check_msgs", "const_env", "alias")
 
     def __init__(self):
         self.n_calls = 0
         self.last_lowering_ctx = None
         self.post_host_plan = []
         self.post_host_inputs = []
+        self.const_env = {}
+        self.alias = {}
 
 
 class BaseSession:
@@ -352,6 +365,8 @@ class BaseSession:
             hctx = lowering_mod.LoweringContext(
                 self._variable_store.values, rng_root=None, feeds=dict(feeds),
                 host=True, session=self)
+            hctx.alias = step.alias
+            hctx.env.update(step.const_env)
             hctx.env.update(feeds)
             lowering_mod.execute_ops(hctx, step.host_plan, fed=set(feeds))
             host_env = hctx.env
@@ -414,6 +429,8 @@ class BaseSession:
             pctx = lowering_mod.LoweringContext(
                 self._variable_store.values, rng_root=None, host=True,
                 session=self)
+            pctx.alias = step.alias
+            pctx.env.update(step.const_env)
             pctx.env.update(host_env)
             pctx.env.update(feeds)
             for t, v in dev_map.items():
@@ -430,16 +447,20 @@ class BaseSession:
         for e in elements:
             if isinstance(e, Operation):
                 out.append(None)
-            elif e in feeds:
+                continue
+            r = step.alias.get(e, e)  # CSE'd fetch -> canonical value
+            if e in feeds:
                 out.append(feeds[e])
-            elif e in dev_map and e not in host_env:
-                v = dev_map[e]
+            elif r in dev_map and r not in host_env:
+                v = dev_map[r]
                 out.append(np.asarray(v) if e.dtype.name != "string" else v)
-            elif e in host_env:
-                out.append(host_env[e])
+            elif r in host_env:
+                out.append(host_env[r])
+            elif r in step.const_env:  # folded at plan time
+                out.append(step.const_env[r])
             else:  # e.g. string Const fetched directly
-                if e.op.type == "Const":
-                    out.append(e.op.attrs["value"])
+                if r.op.type == "Const":
+                    out.append(r.op.attrs["value"])
                 else:
                     raise errors.InternalError(
                         None, e.op, f"Fetch {e.name} produced no value")
@@ -512,6 +533,20 @@ class BaseSession:
                     target_ops.append(e.op)
         pruned = lowering_mod.prune(target_ops, fed_set)
 
+        # Plan-time graph optimizer: fold/CSE/DCE before lowering (the
+        # grappler slot, ref core/common_runtime/constant_folding.cc +
+        # core/graph/optimizer_cse.cc). Folded outputs seed the lowering
+        # env; CSE'd tensors resolve through the alias map.
+        from ..framework import optimizer as graph_opt
+
+        pruned, const_env, alias = graph_opt.optimize_pruned(
+            pruned, fed_set, fetch_tensors)
+        step.const_env = const_env
+        step.alias = alias
+
+        def _rsv(t):  # resolve through CSE aliases
+            return alias.get(t, t)
+
         # Three stages (replaces the reference's CPU/GPU placement split,
         # ref core/common_runtime/simple_placer.cc):
         #   pre-host  — host sources (queues, readers, var introspection)
@@ -526,8 +561,9 @@ class BaseSession:
         post_host_set: Set[Operation] = set()
         for op in pruned:
             dev_anc = any(
-                (t.op in device_op_set or t.op in has_dev_anc)
-                and t not in fed_set for t in op.inputs) or any(
+                (_rsv(t).op in device_op_set or _rsv(t).op in has_dev_anc)
+                and _rsv(t) not in fed_set and _rsv(t) not in const_env
+                for t in op.inputs) or any(
                 c in device_op_set or c in has_dev_anc
                 for c in op.control_inputs)
             # string tensors never enter XLA: a Const producing strings is
@@ -536,7 +572,8 @@ class BaseSession:
             is_string_const = (op.type == "Const" and any(
                 o.dtype.base_dtype == dtypes_mod.string
                 for o in op.outputs))
-            if op.op_def.runs_on_host or is_string_const:
+            if (op.op_def.runs_on_host or is_string_const or
+                    _is_host_device(op.device)):
                 if dev_anc:
                     post_host.append(op)
                     post_host_set.add(op)
@@ -545,7 +582,7 @@ class BaseSession:
                     pre_host.append(op)
                 host_producers.update(op.outputs)
             else:
-                if any(t.op in post_host_set for t in op.inputs):
+                if any(_rsv(t).op in post_host_set for t in op.inputs):
                     raise errors.InvalidArgumentError(
                         None, op,
                         f"Device op {op.name} consumes output of host sink "
@@ -559,7 +596,8 @@ class BaseSession:
         pre_set = set(pre_host)
         for op in pre_host:
             for t in op.inputs:
-                if (t in fed_set or t in host_producers or
+                t = _rsv(t)
+                if (t in fed_set or t in host_producers or t in const_env or
                         t.op.type == "Const" or t.op in pre_set):
                     continue
                 raise errors.InvalidArgumentError(
@@ -572,6 +610,9 @@ class BaseSession:
         host_all_set = set(host_all)
         for op in host_all:
             for t in op.inputs:
+                t = _rsv(t)
+                if t in const_env:
+                    continue  # seeded straight into the host env
                 if t.op.type == "Const" and t.op not in host_all_set and \
                         t.op not in const_for_host:
                     const_for_host.append(t.op)
@@ -582,6 +623,7 @@ class BaseSession:
         seen_pn: Set[Tensor] = set()
         for op in post_host:
             for t in op.inputs:
+                t = _rsv(t)
                 if t.op in device_op_set and t not in seen_pn:
                     seen_pn.add(t)
                     post_needs.append(t)
@@ -592,6 +634,7 @@ class BaseSession:
         seen: Set[Tensor] = set()
         for op in device_ops:
             for t in op.inputs:
+                t = _rsv(t)
                 if (t in fed_set or t in host_producers) and t not in seen:
                     seen.add(t)
                     boundary.append(t)
@@ -602,8 +645,9 @@ class BaseSession:
         step.feed_tensors = boundary
 
         # Device fetches: fetch tensors produced by device ops, plus tensors
-        # the post-host stage needs.
-        device_fetches = [t for t in fetch_tensors if t.op in device_op_set]
+        # the post-host stage needs (all alias-resolved).
+        device_fetches = [_rsv(t) for t in fetch_tensors
+                          if _rsv(t).op in device_op_set]
         for t in step.post_host_inputs:
             if t not in device_fetches:
                 device_fetches.append(t)
@@ -619,9 +663,18 @@ class BaseSession:
 
         check_msgs: List[str] = []  # filled at trace time, index-aligned
 
+        plan_alias = step.alias
+        plan_consts = step.const_env
+
         def step_fn(state, feed_args, rng):
+            import jax.numpy as jnp
+
             ctx = lowering_mod.LoweringContext(state, rng_root=rng,
                                                session=self)
+            ctx.alias = plan_alias
+            for t, v in plan_consts.items():
+                if t.dtype.name != "string":
+                    ctx.env[t] = jnp.asarray(v)  # folded at plan time
             for t in host_boundary:
                 ctx.env[t] = feed_args[t.name]
             lowering_mod.execute_ops(ctx, device_ops, fed=set(host_boundary))
@@ -642,29 +695,64 @@ class BaseSession:
         step.check_msgs = check_msgs
         return step
 
-    # -- partial run (ref: session.py partial_run) --------------------------
+    # -- partial run (ref: session.py partial_run; execute-once semantics
+    # per handle like DirectSession's partial-run support in
+    # core/common_runtime/direct_session.cc) ---------------------------------
     def partial_run_setup(self, fetches, feeds=None):
         handle = f"pr_{len(self._partial_runs)}"
         mapper = _FetchMapper(self._graph, fetches)
         self._partial_runs[handle] = {
             "pending_fetches": set(mapper.elements),
-            "feeds": {},
+            "env": {},          # Tensor -> computed value, shared across calls
+            "executed": set(),  # ops already run under this handle
             "expected_feeds": set(
                 self._graph.as_graph_element(f, True, False)
                 for f in (feeds or [])),
+            "rng": self._next_rng(),
         }
         return handle
 
     def partial_run(self, handle, fetches, feed_dict=None):
+        """Each graph op executes at most ONCE per handle: intermediate
+        values persist in the handle's env, so a stateful op (assign_add,
+        dequeue) fetched or depended on by two partial_run calls runs only
+        the first time. Execution is op-at-a-time eager (the reference's
+        executor model) — partial_run is a debugging/streaming API, not the
+        jitted hot path."""
         st = self._partial_runs.get(handle)
         if st is None:
-            raise errors.InvalidArgumentError(None, None,
-                                              f"Unknown partial_run handle {handle}")
+            raise errors.InvalidArgumentError(
+                None, None, f"Unknown partial_run handle {handle}")
         if feed_dict:
-            st["feeds"].update(self._normalize_feeds(feed_dict))
+            st["env"].update(self._normalize_feeds(feed_dict))
         mapper = _FetchMapper(self._graph, fetches)
-        values = self._run_elements(mapper.elements, dict(st["feeds"]))
+        target_ops: List[Operation] = []
+        for e in mapper.elements:
+            target_ops.append(e if isinstance(e, Operation) else e.op)
+        fed = st["expected_feeds"] | set(
+            t for t in st["env"] if isinstance(t, Tensor))
+        pruned = lowering_mod.prune(target_ops, fed)
+        ctx = lowering_mod.LoweringContext(
+            self._variable_store.values, rng_root=st["rng"], session=self)
+        ctx.env = st["env"]  # shared: results persist across calls
+        to_run = [op for op in pruned if op not in st["executed"]]
+        lowering_mod.execute_ops(ctx, to_run, fed=fed)
+        st["executed"].update(to_run)
+        # eager writes commit straight into the store
+        self._variable_store.values = ctx.state
+
+        values = []
+        for e in mapper.elements:
+            if isinstance(e, Operation):
+                values.append(None)
+            else:
+                v = ctx.env[e] if e in ctx.env else ctx.value_of(e)
+                values.append(np.asarray(v) if e.dtype.name != "string"
+                              else v)
         return mapper.rebuild(values)
+
+    def partial_run_release(self, handle):
+        self._partial_runs.pop(handle, None)
 
     # -- make_callable (ref: session.py make_callable) -----------------------
     def make_callable(self, fetches, feed_list=None):

@@ -342,15 +342,40 @@ class Iterator:
         self._spec = None
         self._keys = None
         self._structure = "single"
+        self._position = 0  # elements yielded; checkpointed by Saver
 
     def _next_value(self):
         if self._it is None:
             raise errors.FailedPreconditionError(
                 None, None, "Iterator not initialized; run initializer")
         try:
-            return next(self._it)
+            val = next(self._it)
+            self._position += 1
+            return val
         except StopIteration:
             raise errors.OutOfRangeError(None, None, "End of sequence")
+
+    # -- checkpointable position (SURVEY §5 data-pipeline resume) ------------
+    @property
+    def name(self):
+        return self._name
+
+    def save_state(self):
+        return {"position": self._position}
+
+    def restore_state(self, state):
+        """Re-create the underlying generator and skip forward to the saved
+        position. Deterministic pipelines (the stf.data design: pure
+        generator composition, seeded shuffles) reproduce the exact element
+        stream, so skip-forward == resume."""
+        pos = int(state.get("position", 0))
+        self._it = iter(self._dataset)
+        for _ in range(pos):
+            try:
+                next(self._it)
+            except StopIteration:
+                break
+        self._position = pos
 
     @property
     def initializer(self):

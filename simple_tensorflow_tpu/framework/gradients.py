@@ -121,8 +121,19 @@ def _lower_symbolic_gradient(ctx, op, input_values):
         # on-path values so the slice is re-traced as a function of ``args``
         # (XLA CSEs the replay against the original forward).
         env = {t: v for t, v in ctx.env.items() if t.op not in path_set}
+        # Plan-time CSE aliases are valid only under the PLAN's topo order;
+        # this replay re-executes path ops in the RAW graph's order, where a
+        # dup's canonical may come later than the dup's consumer. So: on-path
+        # ops re-execute and self-provide (alias disabled below); off-path
+        # dup keys are seeded from their canonical's captured value. A dup
+        # whose canonical is on-path shares its inputs, so it is either
+        # re-executed itself or unused by the slice.
+        for dup, canon in ctx.alias.items():
+            if dup.op not in path_set and canon in env:
+                env.setdefault(dup, env[canon])
         env.update(zip(xs, args))
         child = ctx.child(env)
+        child.alias = {}
         for path_op in path_ops:
             lowering_mod.execute_ops(child, [path_op], fed=xset)
             if stop_set:

@@ -205,6 +205,9 @@ class LoweringContext:
         self.sharding_env = None  # set by parallel lowering
         self.in_control_flow = False
         self.in_shard_map = False
+        # CSE alias map from the plan-time optimizer: duplicate tensor ->
+        # canonical tensor; consulted on every input lookup
+        self.alias: Dict[Tensor, Tensor] = {}
         self._rng_cache: Dict[int, Any] = {}
         # CheckNumerics flags gathered during trace: [(message, bool value)];
         # the Session fetches them with the step and raises host-side
@@ -224,6 +227,7 @@ class LoweringContext:
         c.in_control_flow = (self.in_control_flow if in_control_flow is None
                              else in_control_flow)
         c.in_shard_map = self.in_shard_map
+        c.alias = self.alias
         c._rng_cache = self._rng_cache
         c.numeric_checks = self.numeric_checks
         return c
@@ -267,6 +271,7 @@ class LoweringContext:
 
     # -- values --------------------------------------------------------------
     def value_of(self, tensor: Tensor):
+        tensor = self.alias.get(tensor, tensor)
         if tensor in self.env:
             return self.env[tensor]
         raise InternalLoweringError(
@@ -292,6 +297,7 @@ def execute_ops(ctx: LoweringContext, op_list: Sequence[Operation],
             continue
         input_vals = []
         for t in op.inputs:
+            t = ctx.alias.get(t, t)
             input_vals.append(ctx.env[t] if t in ctx.env else ctx.value_of(t))
         outputs = op.op_def.lower(ctx, op, input_vals)
         if len(outputs) != len(op.outputs):

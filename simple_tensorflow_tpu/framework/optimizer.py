@@ -164,3 +164,103 @@ def optimize(graph_def: Dict, keep: Optional[List[str]] = None) -> Dict:
     if keep:
         gd = dead_code_elimination(gd, keep)
     return gd
+
+
+# ---------------------------------------------------------------------------
+# IR-level passes (the Session's hot path)
+# ---------------------------------------------------------------------------
+
+_FOLD_MAX_BYTES = 1 << 20  # don't materialize folded constants above 1 MiB
+
+
+def optimize_pruned(op_list, fed_tensors, keep_tensors):
+    """Fold/CSE/DCE over a pruned, topo-ordered Operation list — the pass
+    Session._plan runs before lowering (ref grappler's role ahead of the
+    executor; core/common_runtime/constant_folding.cc).
+
+    Works WITHOUT mutating the graph (the IR is immutable-append):
+    returns ``(new_op_list, const_env, alias)`` where
+      const_env: Tensor -> np.ndarray — outputs computed at plan time;
+        the Session seeds them into the lowering env, so the ops that
+        produced them never trace,
+      alias: Tensor -> Tensor — CSE-duplicate output -> canonical output;
+        consulted at every input lookup during lowering.
+
+    Ops are foldable/CSE-able only via ``pure_fn`` (stateless by
+    construction: RNG, variables, placeholders, host IO all register with
+    ``lower=`` and/or ``is_stateful`` and are excluded)."""
+    import jax
+
+    const_env: Dict[Any, Any] = {}
+    alias: Dict[Any, Any] = {}
+    sigs: Dict[str, Any] = {}  # signature -> canonical op
+    new_list = []
+    for op in op_list:
+        od = op.op_def
+        if op.type == "Const":
+            v = op.attrs.get("value")
+            if v is not None and op.outputs:
+                const_env[op.outputs[0]] = np.asarray(v)
+            new_list.append(op)  # kept for host-stage consumers; DCE'd below
+            continue
+        pure = (od.pure_fn is not None and not od.is_stateful
+                and not od.runs_on_host and not op.control_inputs
+                and op.type not in _FOLDABLE_BLOCKLIST)
+        resolved_ins = [alias.get(t, t) for t in op.inputs]
+        if pure and resolved_ins and all(t in const_env
+                                         for t in resolved_ins):
+            attrs = {k: v for k, v in op.attrs.items()
+                     if not k.startswith("_")}
+            try:
+                with jax.default_device(jax.devices("cpu")[0]):
+                    out = od.pure_fn(
+                        *[const_env[t] for t in resolved_ins], **attrs)
+            except Exception:
+                out = None  # fold failure leaves the op alone
+            if out is not None:
+                outs = (list(out) if isinstance(out, (list, tuple))
+                        else [out])
+                outs = [np.asarray(o) for o in outs]
+                if (len(outs) == len(op.outputs) and
+                        sum(o.nbytes for o in outs) <= _FOLD_MAX_BYTES):
+                    for t, v in zip(op.outputs, outs):
+                        const_env[t] = v
+                    continue  # folded: op never lowers
+        if pure:
+            sig = repr((op.type,
+                        tuple(id(t) for t in resolved_ins),
+                        sorted((k, repr(v)) for k, v in op.attrs.items()
+                               if not k.startswith("_"))))
+            canon = sigs.get(sig)
+            if canon is not None:
+                for dup_out, canon_out in zip(op.outputs, canon.outputs):
+                    alias[dup_out] = alias.get(canon_out, canon_out)
+                continue  # CSE'd: op never lowers
+            sigs[sig] = op
+        new_list.append(op)
+
+    # DCE (reverse walk): effects stay; pure ops stay only if some kept op
+    # or fetch consumes an output (through aliases), and folded consumers
+    # are gone already.
+    needed = set()
+    for t in keep_tensors:
+        t = alias.get(t, t)
+        if t not in const_env:
+            needed.add(t)
+    kept_rev = []
+    for op in reversed(new_list):
+        od = op.op_def
+        effectful = od.is_stateful or od.runs_on_host or not op.outputs
+        wanted = effectful or any(o in needed for o in op.outputs)
+        if not wanted:
+            continue
+        kept_rev.append(op)
+        for t in op.inputs:
+            t = alias.get(t, t)
+            if t not in const_env and t not in fed_tensors:
+                needed.add(t)
+        for c in op.control_inputs:
+            # output-less control deps are effectful and kept by the rule
+            # above; tensor-producing ones are kept via their outputs
+            needed.update(c.outputs)
+    return list(reversed(kept_rev)), const_env, alias

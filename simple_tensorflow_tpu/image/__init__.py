@@ -8,6 +8,6 @@ from ..ops.image_ops import (
     flip_left_right, flip_up_down, rot90, transpose_image,
     random_flip_left_right, random_flip_up_down, random_brightness,
     random_contrast, crop_to_bounding_box, pad_to_bounding_box, central_crop,
-    convert_image_dtype, decode_png, encode_png, decode_jpeg, decode_image,
-    random_crop, total_variation,
+    convert_image_dtype, decode_png, encode_png, decode_jpeg, encode_jpeg,
+    decode_image, random_crop, total_variation,
 )

@@ -121,8 +121,51 @@ def all_candidate_sampler(true_classes, num_true, num_sampled, unique,
                                      unique, num_sampled, seed, name)
 
 
+def _lower_accidental_hits(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    true_classes, sampled = inputs
+    batch = true_classes.shape[0]
+    n = sampled.shape[0]
+    # [batch, n]: sampled candidate j collides with a true label of row i
+    hit = jnp.any(sampled[None, :, None] == true_classes[:, None, :], axis=2)
+    indices = jnp.repeat(jnp.arange(batch, dtype=jnp.int32), n)
+    ids = jnp.tile(jnp.arange(n, dtype=jnp.int64), batch)
+    weights = jnp.where(jnp.reshape(hit, (-1,)),
+                        jnp.float32(-1e37), jnp.float32(0.0))
+    return [indices, ids, weights]
+
+
+op_registry.register("ComputeAccidentalHits", lower=_lower_accidental_hits,
+                     n_outputs=3)
+
+
 def compute_accidental_hits(true_classes, sampled_candidates, num_true,
                             seed=None, name=None):
-    raise NotImplementedError(
-        "compute_accidental_hits has dynamic output shape; mask accidental "
-        "hits densely on TPU (compare sampled ids against true ids).")
+    """(ref: candidate_sampling_ops.py:343, core/kernels/
+    candidate_sampler_ops.cc ComputeAccidentalHits).
+
+    TPU-native STATIC-shape variant: the reference emits only the colliding
+    (row, sampled-position) pairs — a dynamic count XLA cannot shape. We
+    emit EVERY (row, position) pair (batch*num_sampled of them) with weight
+    -1e37 on collisions and 0.0 elsewhere. Downstream use is
+    scatter-add of weights into sampled logits, where the extra zero
+    entries are no-ops — same math, static shape.
+    """
+    g = ops_mod.get_default_graph()
+    true_classes = ops_mod.convert_to_tensor(true_classes,
+                                             dtype=dtypes_mod.int64)
+    sampled_candidates = ops_mod.convert_to_tensor(sampled_candidates,
+                                                   dtype=dtypes_mod.int64)
+    batch = true_classes.shape[0].value
+    n = sampled_candidates.shape[0].value
+    total = None if batch is None or n is None else batch * n
+    op = g.create_op(
+        "ComputeAccidentalHits", [true_classes, sampled_candidates],
+        attrs={"num_true": int(num_true)},
+        name=name or "ComputeAccidentalHits",
+        output_specs=[
+            (shape_mod.TensorShape([total]), dtypes_mod.int32),
+            (shape_mod.TensorShape([total]), dtypes_mod.int64),
+            (shape_mod.TensorShape([total]), dtypes_mod.float32)])
+    return op.outputs[0], op.outputs[1], op.outputs[2]

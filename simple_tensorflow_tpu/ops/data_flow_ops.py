@@ -119,6 +119,17 @@ class QueueBase:
                            name=name or f"{self._name}_enqueue",
                            output_specs=[])
 
+    def enqueue_maybe(self, keep_input, vals, name=None):
+        """Conditional enqueue (backs train.input.maybe_batch)."""
+        tensors = self._normalize(vals)
+        keep = ops_mod.convert_to_tensor(keep_input,
+                                         dtype=dtypes_mod.bool_)
+        g = ops_mod.get_default_graph()
+        return g.create_op("QueueEnqueueMaybe", [keep] + list(tensors),
+                           attrs={"queue_name": self._name},
+                           name=name or f"{self._name}_enqueue_maybe",
+                           output_specs=[])
+
     def enqueue_many(self, vals, name=None):
         tensors = self._normalize(vals)
         g = ops_mod.get_default_graph()
@@ -286,6 +297,17 @@ def _lower_enqueue_many(ctx, op, inputs):
     return []
 
 
+def _lower_enqueue_maybe(ctx, op, inputs):
+    """Conditional enqueue: first input is keep_input (bool); the rest are
+    the element. Backs train.input.maybe_batch (ref: input.py
+    ``maybe_batch`` — rows with keep_input False never enter the queue)."""
+    keep = np.asarray(inputs[0])
+    if bool(np.all(keep)):
+        _get_queue(op.attrs["queue_name"])._host_enqueue(
+            [np.asarray(x) for x in inputs[1:]])
+    return []
+
+
 def _lower_dequeue(ctx, op, inputs):
     item = _get_queue(op.attrs["queue_name"])._host_dequeue()
     return list(item)
@@ -310,6 +332,7 @@ def _lower_size(ctx, op, inputs):
 
 
 for _n, _fn, _nout in [("QueueEnqueue", _lower_enqueue, 0),
+                       ("QueueEnqueueMaybe", _lower_enqueue_maybe, 0),
                        ("QueueEnqueueMany", _lower_enqueue_many, 0),
                        ("QueueDequeue", _lower_dequeue, None),
                        ("QueueDequeueMany", _lower_dequeue_many, None),

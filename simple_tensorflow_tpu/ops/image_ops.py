@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtypes as dtypes_mod
+from ..framework import errors as errors_mod
 from ..framework import graph as ops_mod
 from ..framework import op_registry
 from ..framework import random_seed as random_seed_mod
@@ -218,13 +219,56 @@ op_registry.register("EncodePng", lower=_lower_encode_png, is_stateful=True,
                      runs_on_host=True)
 
 
+def _jpeg_bytes(x) -> bytes:
+    v = x.item() if hasattr(x, "item") else x
+    return v if isinstance(v, bytes) else bytes(v, "latin-1")
+
+
 def _lower_decode_jpeg(ctx, op, inputs):
-    raise NotImplementedError(
-        "JPEG decode needs libjpeg; store datasets as PNG/TFRecord-raw on "
-        "TPU hosts, or decode with stf.py_func + PIL when available.")
+    """Host-stage JPEG decode via PIL (the reference uses libjpeg,
+    core/kernels/decode_jpeg_op.cc; ImageNet-style pipelines are JPEG)."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise NotImplementedError(
+            "decode_jpeg needs Pillow on the host (pip install pillow); "
+            "alternatively store datasets as PNG/TFRecord-raw, or decode "
+            "with stf.py_func + your own codec.") from e
+    import io as _io
+
+    img = Image.open(_io.BytesIO(_jpeg_bytes(inputs[0])))
+    channels = op.attrs.get("channels", 0) or 0
+    if channels == 1:
+        img = img.convert("L")
+    elif channels == 3:
+        img = img.convert("RGB")
+    elif img.mode not in ("L", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return [arr]
+
+
+def _lower_encode_jpeg(ctx, op, inputs):
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise NotImplementedError(
+            "encode_jpeg needs Pillow on the host (pip install pillow).") \
+            from e
+    import io as _io
+
+    arr = np.asarray(inputs[0], dtype=np.uint8)
+    img = Image.fromarray(arr[:, :, 0] if arr.shape[-1] == 1 else arr)
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG", quality=int(op.attrs.get("quality", 95)))
+    return [np.asarray(buf.getvalue(), dtype=object)]
 
 
 op_registry.register("DecodeJpeg", lower=_lower_decode_jpeg,
+                     is_stateful=True, runs_on_host=True)
+op_registry.register("EncodeJpeg", lower=_lower_encode_jpeg,
                      is_stateful=True, runs_on_host=True)
 
 
@@ -439,8 +483,47 @@ def decode_jpeg(contents, channels=0, ratio=1, fancy_upscaling=True,
     return op.outputs[0]
 
 
+def encode_jpeg(image, format="", quality=95, progressive=False,
+                optimize_size=False, chroma_downsampling=True,
+                density_unit="in", x_density=300, y_density=300,
+                xmp_metadata="", name=None):
+    """(ref: python/ops/image_ops_impl.py ``encode_jpeg``,
+    core/kernels/encode_jpeg_op.cc)."""
+    t = ops_mod.convert_to_tensor(image)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("EncodeJpeg", [t], attrs={"quality": int(quality)},
+                     name=name or "EncodeJpeg",
+                     output_specs=[(shape_mod.scalar(), dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def _lower_decode_image(ctx, op, inputs):
+    """Sniff the container by magic bytes and route to the right decoder
+    (ref: core/kernels/decode_image_op.cc does the same)."""
+    data = _jpeg_bytes(inputs[0])
+    if data[:3] == b"\xff\xd8\xff":
+        return _lower_decode_jpeg(ctx, op, inputs)
+    if data[:8] == b"\x89PNG\r\n\x1a\n":
+        return _lower_decode_png(ctx, op, inputs)
+    if data[:3] == b"GIF" or data[:2] == b"BM":
+        return _lower_decode_jpeg(ctx, op, inputs)  # PIL handles both
+    raise errors_mod.InvalidArgumentError(
+        None, op, "decode_image: unrecognized image container (expected "
+        "JPEG/PNG/GIF/BMP magic bytes)")
+
+
+op_registry.register("DecodeImage", lower=_lower_decode_image,
+                     is_stateful=True, runs_on_host=True)
+
+
 def decode_image(contents, channels=None, name=None):
-    return decode_png(contents, channels or 0, name=name)
+    t = ops_mod.convert_to_tensor(contents)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("DecodeImage", [t], attrs={"channels": channels or 0},
+                     name=name or "DecodeImage",
+                     output_specs=[(shape_mod.TensorShape([None, None, None]),
+                                    dtypes_mod.uint8)])
+    return op.outputs[0]
 
 
 def random_crop(value, size, seed=None, name=None):

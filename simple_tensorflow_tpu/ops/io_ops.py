@@ -1,0 +1,339 @@
+"""Reader ops: WholeFileReader, TextLineReader, TFRecordReader, etc.
+(ref: tensorflow/python/ops/io_ops.py:189-399,
+core/kernels/{whole_file_read_ops,text_line_reader_op,
+tf_record_reader_op,fixed_length_record_reader_op,identity_reader_op}.cc).
+
+TPU-native split: readers are HOST-stage resources (the reference pins all
+reader kernels to CPU too). ``reader.read(queue)`` dequeues filenames from a
+host queue as work units and yields (key, value) string tensors; the values
+feed parsing ops (parse_example / decode_raw / decode_image), whose dense
+outputs cross into the compiled device step. State (records produced, work
+units completed) lives on the Python resource, mirroring the reference's
+ReaderBase mutex-guarded state (core/framework/reader_op_kernel.h).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import errors
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+
+
+# -- file-level ops ----------------------------------------------------------
+
+def _lower_read_file(ctx, op, inputs):
+    fname = _to_str(inputs[0])
+    with open(fname, "rb") as f:
+        return [np.asarray(f.read(), dtype=object)]
+
+
+def _lower_write_file(ctx, op, inputs):
+    import os
+
+    fname = _to_str(inputs[0])
+    d = os.path.dirname(fname)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    contents = inputs[1]
+    data = contents.item() if hasattr(contents, "item") else contents
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(fname, mode) as f:
+        f.write(data)
+    return []
+
+
+def _lower_matching_files(ctx, op, inputs):
+    from ..lib.io import file_io
+
+    pattern = _to_str(inputs[0])
+    return [np.asarray(sorted(file_io.get_matching_files(pattern)),
+                       dtype=object)]
+
+
+def _to_str(x) -> str:
+    v = x.item() if hasattr(x, "item") else x
+    return v.decode() if isinstance(v, bytes) else builtins.str(v)
+
+
+op_registry.register("ReadFile", lower=_lower_read_file, runs_on_host=True,
+                     n_outputs=1)
+op_registry.register("WriteFile", lower=_lower_write_file, runs_on_host=True,
+                     is_stateful=True, n_outputs=0)
+op_registry.register("MatchingFiles", lower=_lower_matching_files,
+                     runs_on_host=True, n_outputs=1)
+
+
+def read_file(filename, name=None):
+    """(ref: python/ops/io_ops.py ``read_file``)."""
+    filename = ops_mod.convert_to_tensor(filename, dtype=dtypes_mod.string)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("ReadFile", [filename], attrs={}, name=name or "ReadFile",
+                     output_specs=[(shape_mod.scalar(), dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def write_file(filename, contents, name=None):
+    filename = ops_mod.convert_to_tensor(filename, dtype=dtypes_mod.string)
+    contents = ops_mod.convert_to_tensor(contents, dtype=dtypes_mod.string)
+    g = ops_mod.get_default_graph()
+    return g.create_op("WriteFile", [filename, contents], attrs={},
+                       name=name or "WriteFile", output_specs=[])
+
+
+def matching_files(pattern, name=None):
+    pattern = ops_mod.convert_to_tensor(pattern, dtype=dtypes_mod.string)
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "MatchingFiles", [pattern], attrs={}, name=name or "MatchingFiles",
+        output_specs=[(shape_mod.TensorShape([None]), dtypes_mod.string)])
+    return op.outputs[0]
+
+
+# -- reader resources --------------------------------------------------------
+
+_READERS: Dict[str, "ReaderBase"] = {}
+_READER_COUNT = [0]
+
+
+class ReaderBase:
+    """(ref: python/ops/io_ops.py:189 ``class ReaderBase``).
+
+    Subclasses implement ``_records(work_item)`` -> iterator of
+    (key, value) pairs for one work unit (a filename dequeued from the
+    queue).
+    """
+
+    def __init__(self, name: str):
+        _READER_COUNT[0] += 1
+        self._name = f"{name}_{_READER_COUNT[0]}"
+        _READERS[self._name] = self
+        self._current: Optional[Any] = None  # active record iterator
+        self._records_produced = 0
+        self._work_done = 0
+
+    # -- subclass hook -------------------------------------------------------
+    def _records(self, work_item: str):
+        raise NotImplementedError
+
+    # -- host-side behavior --------------------------------------------------
+    def _host_read(self, queue):
+        while True:
+            if self._current is None:
+                item = queue._host_dequeue()
+                work = _to_str(item[0] if isinstance(item, tuple) else item)
+                self._current = self._records(work)
+            try:
+                key, value = next(self._current)
+                self._records_produced += 1
+                return key, value
+            except StopIteration:
+                self._current = None
+                self._work_done += 1
+
+    def _host_read_up_to(self, queue, n):
+        keys, values = [], []
+        for _ in builtins.range(n):
+            try:
+                k, v = self._host_read(queue)
+            except errors.OutOfRangeError:
+                if keys:
+                    break  # partial batch at end of input
+                raise
+            keys.append(k)
+            values.append(v)
+        return keys, values
+
+    def _host_reset(self):
+        self._current = None
+        self._records_produced = 0
+        self._work_done = 0
+
+    # -- graph endpoints -----------------------------------------------------
+    @property
+    def reader_ref(self):
+        return self._name
+
+    def read(self, queue, name=None):
+        """Returns (key, value) string tensors; dequeues filenames from
+        ``queue`` as needed (ref io_ops.py:211 ``ReaderBase.read``)."""
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "ReaderRead", [],
+            attrs={"reader_name": self._name,
+                   "queue_name": _queue_name(queue)},
+            name=name or f"{self._name}_read",
+            output_specs=[(shape_mod.scalar(), dtypes_mod.string),
+                          (shape_mod.scalar(), dtypes_mod.string)])
+        return op.outputs[0], op.outputs[1]
+
+    def read_up_to(self, queue, num_records, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "ReaderReadUpTo", [],
+            attrs={"reader_name": self._name,
+                   "queue_name": _queue_name(queue),
+                   "num_records": int(num_records)},
+            name=name or f"{self._name}_read_up_to",
+            output_specs=[(shape_mod.TensorShape([None]), dtypes_mod.string),
+                          (shape_mod.TensorShape([None]), dtypes_mod.string)])
+        return op.outputs[0], op.outputs[1]
+
+    def num_records_produced(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "ReaderNumRecordsProduced", [],
+            attrs={"reader_name": self._name},
+            name=name or f"{self._name}_records_produced",
+            output_specs=[(shape_mod.scalar(), dtypes_mod.int64)])
+        return op.outputs[0]
+
+    def num_work_units_completed(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "ReaderNumWorkUnitsCompleted", [],
+            attrs={"reader_name": self._name},
+            name=name or f"{self._name}_work_units",
+            output_specs=[(shape_mod.scalar(), dtypes_mod.int64)])
+        return op.outputs[0]
+
+    def reset(self, name=None):
+        g = ops_mod.get_default_graph()
+        return g.create_op("ReaderReset", [],
+                           attrs={"reader_name": self._name},
+                           name=name or f"{self._name}_reset",
+                           output_specs=[])
+
+
+def _queue_name(queue) -> str:
+    if isinstance(queue, str):
+        return queue
+    if hasattr(queue, "queue_ref"):
+        return queue.queue_ref
+    # a dequeue-able tensor was passed (ref accepts queue or its ref)
+    raise TypeError(f"Expected a queue, got {type(queue)}")
+
+
+class WholeFileReader(ReaderBase):
+    """One record per file: key=filename, value=contents
+    (ref: io_ops.py:326, core/kernels/whole_file_read_ops.cc)."""
+
+    def __init__(self, name="WholeFileReader"):
+        super().__init__(name)
+
+    def _records(self, work_item):
+        with open(work_item, "rb") as f:
+            data = f.read()
+        yield work_item, data
+
+
+class IdentityReader(ReaderBase):
+    """key == value == work item (ref: io_ops.py:399)."""
+
+    def __init__(self, name="IdentityReader"):
+        super().__init__(name)
+
+    def _records(self, work_item):
+        yield work_item, work_item
+
+
+class TextLineReader(ReaderBase):
+    """One record per newline-delimited line (ref: io_ops.py:340,
+    core/kernels/text_line_reader_op.cc)."""
+
+    def __init__(self, skip_header_lines=0, name="TextLineReader"):
+        super().__init__(name)
+        self._skip = int(skip_header_lines or 0)
+
+    def _records(self, work_item):
+        with open(work_item, "r") as f:
+            for i, line in enumerate(f):
+                if i < self._skip:
+                    continue
+                yield f"{work_item}:{i + 1}", line.rstrip("\n")
+
+
+class TFRecordReader(ReaderBase):
+    """One record per TFRecord entry, via the native C++ reader when
+    available (ref: io_ops.py:368, core/kernels/tf_record_reader_op.cc)."""
+
+    def __init__(self, name="TFRecordReader", options=None):
+        super().__init__(name)
+        self._options = options
+
+    def _records(self, work_item):
+        from ..lib.io import tf_record
+
+        for i, rec in enumerate(
+                tf_record.tf_record_iterator(work_item, self._options)):
+            yield f"{work_item}:{i}", rec
+
+
+class FixedLengthRecordReader(ReaderBase):
+    """Fixed-size binary records (ref: io_ops.py:354,
+    core/kernels/fixed_length_record_reader_op.cc)."""
+
+    def __init__(self, record_bytes, header_bytes=None, footer_bytes=None,
+                 name="FixedLengthRecordReader"):
+        super().__init__(name)
+        self._record_bytes = int(record_bytes)
+        self._header = int(header_bytes or 0)
+        self._footer = int(footer_bytes or 0)
+
+    def _records(self, work_item):
+        import os
+
+        size = os.path.getsize(work_item)
+        body = size - self._header - self._footer
+        n = body // self._record_bytes
+        with open(work_item, "rb") as f:
+            f.seek(self._header)
+            for i in builtins.range(n):
+                yield f"{work_item}:{i}", f.read(self._record_bytes)
+
+
+# -- lowerings ---------------------------------------------------------------
+
+def _get_reader(op) -> ReaderBase:
+    return _READERS[op.attrs["reader_name"]]
+
+
+def _get_queue(op):
+    from .data_flow_ops import QueueBase
+
+    return QueueBase._registry[op.attrs["queue_name"]]
+
+
+def _lower_reader_read(ctx, op, inputs):
+    key, value = _get_reader(op)._host_read(_get_queue(op))
+    return [np.asarray(key, dtype=object), np.asarray(value, dtype=object)]
+
+
+def _lower_reader_read_up_to(ctx, op, inputs):
+    keys, values = _get_reader(op)._host_read_up_to(
+        _get_queue(op), op.attrs["num_records"])
+    return [np.asarray(keys, dtype=object), np.asarray(values, dtype=object)]
+
+
+op_registry.register("ReaderRead", lower=_lower_reader_read,
+                     is_stateful=True, runs_on_host=True, n_outputs=2)
+op_registry.register("ReaderReadUpTo", lower=_lower_reader_read_up_to,
+                     is_stateful=True, runs_on_host=True, n_outputs=2)
+op_registry.register(
+    "ReaderNumRecordsProduced",
+    lower=lambda ctx, op, inputs: [np.int64(_get_reader(op)._records_produced)],
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register(
+    "ReaderNumWorkUnitsCompleted",
+    lower=lambda ctx, op, inputs: [np.int64(_get_reader(op)._work_done)],
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register(
+    "ReaderReset",
+    lower=lambda ctx, op, inputs: (_get_reader(op)._host_reset(), [])[1],
+    is_stateful=True, runs_on_host=True, n_outputs=0)

@@ -139,11 +139,70 @@ def sparse_reorder(sp_input, name=None):
 
 
 def sparse_slice(sp_input, start, size, name=None):
-    raise NotImplementedError("sparse_slice: use dense slicing on TPU")
+    """(ref: python/ops/sparse_ops.py ``sparse_slice``,
+    core/kernels/sparse_slice_op.cc). Construction-time COO transform (the
+    TPU-safe regime used by retain/reorder above): keeps entries inside the
+    [start, start+size) window and rebases their indices."""
+    iv = constant_op.constant_value(sp_input.indices)
+    vv = constant_op.constant_value(sp_input.values)
+    shp = constant_op.constant_value(sp_input.dense_shape)
+    if iv is None or vv is None or shp is None:
+        raise NotImplementedError(
+            "sparse_slice on runtime-valued SparseTensors: convert with "
+            "sparse_tensor_to_dense and slice densely on TPU")
+    start_a = np.asarray(start, dtype=np.int64)
+    size_a = np.asarray(size, dtype=np.int64)
+    out_shape = np.minimum(np.asarray(shp, np.int64) - start_a, size_a)
+    out_shape = np.maximum(out_shape, 0)
+    keep = np.all((iv >= start_a) & (iv < start_a + size_a), axis=1)
+    return SparseTensor(constant_op.constant(iv[keep] - start_a),
+                        constant_op.constant(vv[keep]),
+                        constant_op.constant(out_shape))
 
 
 def sparse_concat(axis, sp_inputs, name=None, expand_nonconcat_dim=False):
-    raise NotImplementedError("sparse_concat: use dense concat on TPU")
+    """(ref: python/ops/sparse_ops.py ``sparse_concat``,
+    core/kernels/sparse_concat_op.cc). COO concat along ``axis`` with index
+    offsetting; non-concat dims must match unless expand_nonconcat_dim."""
+    ivs, vvs, shps = [], [], []
+    for sp in sp_inputs:
+        iv = constant_op.constant_value(sp.indices)
+        vv = constant_op.constant_value(sp.values)
+        shp = constant_op.constant_value(sp.dense_shape)
+        if iv is None or vv is None or shp is None:
+            raise NotImplementedError(
+                "sparse_concat on runtime-valued SparseTensors: convert "
+                "with sparse_tensor_to_dense and concat densely on TPU")
+        ivs.append(np.asarray(iv, np.int64).reshape(-1, len(shp)))
+        vvs.append(np.asarray(vv))
+        shps.append(np.asarray(shp, np.int64))
+    rank = len(shps[0])
+    axis = axis if axis >= 0 else axis + rank
+    others = [d for d in range(rank) if d != axis]
+    for shp in shps[1:]:
+        if not expand_nonconcat_dim and any(shp[d] != shps[0][d]
+                                            for d in others):
+            raise ValueError(
+                f"sparse_concat: non-concat dims differ {shps[0]} vs {shp}; "
+                "pass expand_nonconcat_dim=True")
+    out_shape = np.array(shps[0])
+    out_shape[axis] = sum(int(s[axis]) for s in shps)
+    for d in others:
+        out_shape[d] = max(int(s[d]) for s in shps)
+    offset = 0
+    out_iv, out_vv = [], []
+    for iv, vv, shp in zip(ivs, vvs, shps):
+        shifted = iv.copy()
+        shifted[:, axis] += offset
+        offset += int(shp[axis])
+        out_iv.append(shifted)
+        out_vv.append(vv)
+    iv_all = np.concatenate(out_iv, axis=0)
+    vv_all = np.concatenate(out_vv, axis=0)
+    order = np.lexsort(tuple(iv_all[:, k] for k in range(rank - 1, -1, -1)))
+    return SparseTensor(constant_op.constant(iv_all[order]),
+                        constant_op.constant(vv_all[order]),
+                        constant_op.constant(out_shape))
 
 
 def sparse_placeholder(dtype, shape=None, name=None):

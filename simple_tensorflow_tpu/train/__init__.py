@@ -47,7 +47,7 @@ from .queue_runner import (
 from .input import (
     string_input_producer, input_producer, range_input_producer,
     slice_input_producer, batch, shuffle_batch, batch_join,
-    shuffle_batch_join, limit_epochs,
+    shuffle_batch_join, limit_epochs, maybe_batch, maybe_shuffle_batch,
 )
 from .server_lib import Server, ClusterSpec
 from .device_setter import replica_device_setter

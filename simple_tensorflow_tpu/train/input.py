@@ -183,5 +183,43 @@ def limit_epochs(tensor, num_epochs=None, name=None):
     return tensor
 
 
-def maybe_batch(*a, **k):
-    raise NotImplementedError("maybe_batch: use stf.data")
+def maybe_batch(tensors, keep_input, batch_size, num_threads=1, capacity=32,
+                enqueue_many=False, shapes=None, dynamic_pad=False,
+                allow_smaller_final_batch=False, shared_name=None,
+                name="maybe_batch"):
+    """(ref: input.py:934 ``maybe_batch``): like batch(), but an element is
+    only enqueued when ``keep_input`` evaluates true that run."""
+    if enqueue_many:
+        raise NotImplementedError(
+            "maybe_batch(enqueue_many=True): filter per-row before "
+            "batching with stf.data.Dataset.filter instead")
+    tensor_list = _flatten(tensors)
+    tensor_list = [ops_mod.convert_to_tensor(t) for t in tensor_list]
+    q = data_flow_ops.FIFOQueue(
+        capacity, [t.dtype for t in tensor_list],
+        shapes=shapes or [t.shape for t in tensor_list], name=name)
+    enq = q.enqueue_maybe(keep_input, tensor_list)
+    queue_runner.add_queue_runner(
+        queue_runner.QueueRunner(q, [enq] * num_threads))
+    return q.dequeue_many(batch_size)
+
+
+def maybe_shuffle_batch(tensors, batch_size, capacity, min_after_dequeue,
+                        keep_input, num_threads=1, seed=None,
+                        enqueue_many=False, shapes=None,
+                        allow_smaller_final_batch=False, shared_name=None,
+                        name="maybe_shuffle_batch"):
+    """(ref: input.py:1126 ``maybe_shuffle_batch``)."""
+    if enqueue_many:
+        raise NotImplementedError(
+            "maybe_shuffle_batch(enqueue_many=True): filter per-row with "
+            "stf.data.Dataset.filter instead")
+    tensor_list = _flatten(tensors)
+    tensor_list = [ops_mod.convert_to_tensor(t) for t in tensor_list]
+    q = data_flow_ops.RandomShuffleQueue(
+        capacity, min_after_dequeue, [t.dtype for t in tensor_list],
+        shapes=shapes or [t.shape for t in tensor_list], seed=seed, name=name)
+    enq = q.enqueue_maybe(keep_input, tensor_list)
+    queue_runner.add_queue_runner(
+        queue_runner.QueueRunner(q, [enq] * num_threads))
+    return q.dequeue_many(batch_size)

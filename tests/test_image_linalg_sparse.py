@@ -76,6 +76,26 @@ class TestImageOps:
         out = _run(decoded)
         np.testing.assert_array_equal(out, img)
 
+    def test_jpeg_roundtrip(self):
+        img = np.tile((np.arange(16, dtype=np.uint8) * 16)[:, None, None],
+                      (1, 16, 3))
+        encoded = stf.image.encode_jpeg(stf.constant(img), quality=95)
+        decoded = stf.image.decode_jpeg(encoded, channels=3)
+        out = _run(decoded)
+        assert out.shape == (16, 16, 3) and out.dtype == np.uint8
+        assert np.mean(np.abs(out.astype(int) - img.astype(int))) < 8  # lossy
+
+    def test_decode_image_sniffs_container(self):
+        img = (RNG.rand(4, 4, 3) * 255).astype(np.uint8)
+        png = stf.image.decode_image(stf.image.encode_png(stf.constant(img)))
+        jpg = stf.image.decode_image(
+            stf.image.encode_jpeg(stf.constant(img)))
+        p, j = _run(png), _run(jpg)
+        np.testing.assert_array_equal(p, img)  # png is lossless
+        assert j.shape == (4, 4, 3)
+        with pytest.raises(stf.errors.InvalidArgumentError):
+            _run(stf.image.decode_image(stf.constant(b"not an image")))
+
 
 class TestLinalg:
     def test_cholesky_solve_det_inverse(self):
@@ -236,3 +256,68 @@ class TestRandomOps:
         out = _run({"m": m, "sh": sh})
         assert (out["m"] == 1).mean() > 0.9
         assert sorted(out["sh"].tolist()) == list(range(10))
+
+
+class TestSparseSliceConcat:
+    def _coo(self, dense):
+        idx = np.argwhere(dense != 0)
+        vals = dense[dense != 0]
+        return stf.SparseTensor(indices=idx.tolist(),
+                                values=vals.tolist(),
+                                dense_shape=list(dense.shape))
+
+    def test_sparse_slice_matches_dense_slice(self):
+        from simple_tensorflow_tpu.ops import sparse_ops
+
+        dense = np.zeros((4, 5), np.float32)
+        dense[0, 1] = 1.0
+        dense[2, 3] = 2.0
+        dense[3, 4] = 3.0
+        sp = self._coo(dense)
+        sliced = sparse_ops.sparse_slice(sp, [1, 1], [3, 3])
+        out = _run(sparse_ops.sparse_tensor_to_dense(sliced))
+        np.testing.assert_array_equal(out, dense[1:4, 1:4])
+
+    def test_sparse_concat_matches_dense_concat(self):
+        from simple_tensorflow_tpu.ops import sparse_ops
+
+        a = np.zeros((2, 3), np.float32)
+        a[0, 0] = 1.0
+        b = np.zeros((2, 3), np.float32)
+        b[1, 2] = 5.0
+        for axis in (0, 1):
+            sp = sparse_ops.sparse_concat(axis,
+                                          [self._coo(a), self._coo(b)])
+            out = _run(sparse_ops.sparse_tensor_to_dense(sp))
+            np.testing.assert_array_equal(
+                out, np.concatenate([a, b], axis=axis))
+
+    def test_sparse_concat_shape_mismatch_rejected(self):
+        from simple_tensorflow_tpu.ops import sparse_ops
+
+        a = np.eye(2, dtype=np.float32)
+        b = np.eye(3, dtype=np.float32)
+        with pytest.raises(ValueError):
+            sparse_ops.sparse_concat(0, [self._coo(a), self._coo(b)])
+
+
+class TestAccidentalHits:
+    def test_dense_mask_semantics(self):
+        from simple_tensorflow_tpu.ops import candidate_sampling_ops as cs
+
+        true_classes = np.int64([[1, 7], [3, 4]])
+        sampled = np.int64([7, 0, 3])
+        idx_t, ids_t, w_t = cs.compute_accidental_hits(
+            stf.constant(true_classes), stf.constant(sampled), num_true=2)
+        idx, ids, w = _run([idx_t, ids_t, w_t])
+        # static shape: batch * num_sampled entries
+        assert idx.shape == (6,) and ids.shape == (6,) and w.shape == (6,)
+        # numpy reference: collision where sampled id is in the row's labels
+        expect_hits = {(i, j) for i in range(2) for j in range(3)
+                       if sampled[j] in true_classes[i]}
+        got_hits = {(int(i), int(j)) for i, j, wt in zip(idx, ids, w)
+                    if wt < -1e30}
+        assert got_hits == expect_hits == {(0, 0), (1, 2)}
+        # non-hits carry weight exactly 0 (scatter-add no-op)
+        assert all(wt == 0.0 for i, j, wt in zip(idx, ids, w)
+                   if (int(i), int(j)) not in expect_hits)

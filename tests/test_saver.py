@@ -144,3 +144,101 @@ class TestSaverWithOptimizerState:
         # deterministic replay incl. Adam m/v slots
         np.testing.assert_allclose(val5, val5_replay, rtol=1e-6)
         assert not np.allclose(val3, val5)
+
+
+class TestOrbaxBackend:
+    def test_sharded_roundtrip_preserves_sharding(self, tmp_path):
+        """8-device mesh: save sharded variables via orbax, restore into a
+        fresh session with the shardings intact — no host gather."""
+        from simple_tensorflow_tpu import parallel
+
+        mesh = parallel.Mesh({"tp": 8})
+        with mesh:
+            w = stf.Variable(stf.random_normal([16, 8], seed=3), name="ow")
+            parallel.shard_variable(w, "tp", None)
+            b = stf.Variable(stf.zeros([8]), name="ob")
+            saver = stf.train.Saver(backend="orbax")
+            with stf.Session() as sess:
+                sess.run(stf.global_variables_initializer())
+                w0 = np.asarray(sess.run(w.value()))
+                arr = sess._variable_store.values["ow"]
+                assert len(arr.sharding.device_set) == 8
+                path = saver.save(sess, str(tmp_path / "om"))
+            assert os.path.isdir(path + ".orbax")
+            assert not os.path.exists(path + ".stfz")  # no npz host bundle
+            with stf.Session() as sess2:
+                saver.restore(sess2, path)
+                arr2 = sess2._variable_store.values["ow"]
+                # restored straight into the mesh sharding, not replicated
+                assert len(arr2.sharding.device_set) == 8
+                assert np.allclose(np.asarray(sess2.run(w.value())), w0)
+        assert stf.train.latest_checkpoint(str(tmp_path)) == path
+
+    def test_bad_backend_rejected(self):
+        with pytest.raises(ValueError):
+            stf.train.Saver(backend="protobuf")
+
+
+class TestHostStateResume:
+    def test_rng_stream_resumes_identically(self, tmp_path):
+        """Dropout masks after restore must equal the masks the original
+        run would have produced (SURVEY §5 RNG-key resume)."""
+        x = stf.constant(np.ones((4, 64), np.float32))
+        y = stf.nn.dropout(x, keep_prob=0.5)
+        v = stf.Variable(stf.constant(1.0), name="hv")
+        saver = stf.train.Saver()
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(y)  # advance the RNG stream
+            path = saver.save(sess, str(tmp_path / "h"))
+            expected = [np.asarray(sess.run(y)) for _ in range(3)]
+        with stf.Session() as sess2:
+            saver.restore(sess2, path)
+            resumed = [np.asarray(sess2.run(y)) for _ in range(3)]
+        for a, b in zip(expected, resumed):
+            assert np.array_equal(a, b)
+
+    def test_iterator_position_resumes(self, tmp_path):
+        from simple_tensorflow_tpu import data as stf_data
+
+        ds = stf_data.Dataset.from_tensor_slices(
+            np.arange(10, dtype=np.int32)).repeat()
+        it = ds.make_one_shot_iterator()
+        nxt = it.get_next()
+        v = stf.Variable(stf.constant(0.0), name="iv")
+        saver = stf.train.Saver()
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            seen = [int(sess.run(nxt)) for _ in range(4)]
+            assert seen == [0, 1, 2, 3]
+            path = saver.save(sess, str(tmp_path / "it"))
+            assert int(sess.run(nxt)) == 4
+        with stf.Session() as sess2:
+            saver.restore(sess2, path)
+            assert int(sess2.run(nxt)) == 4  # resumes where save happened
+
+
+class TestKeepEveryNHours:
+    def test_keep_forever_based_on_checkpoint_time(self, tmp_path, monkeypatch):
+        """ref semantics: a checkpoint whose save time crosses the keep
+        interval is kept forever when evicted; others are deleted."""
+        import simple_tensorflow_tpu.train.saver as saver_mod
+
+        t = [1000.0]
+        monkeypatch.setattr(saver_mod.time, "time", lambda: t[0])
+        v = stf.Variable(stf.constant(1.0), name="kv")
+        saver = stf.train.Saver(max_to_keep=1,
+                                keep_checkpoint_every_n_hours=1.0)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            p1 = saver.save(sess, str(tmp_path / "ck"), global_step=1)
+            t[0] += 1800.0  # p1 evicted next save: 1000 < 4600 -> delete
+            p2 = saver.save(sess, str(tmp_path / "ck"), global_step=2)
+            t[0] += 3600.0  # p2 evicted next save: 2800 < 4600 -> delete
+            p3 = saver.save(sess, str(tmp_path / "ck"), global_step=3)
+            t[0] += 600.0   # p3 evicted next save: 6400 > 4600 -> keep
+            p4 = saver.save(sess, str(tmp_path / "ck"), global_step=4)
+        assert not stf.train.checkpoint_exists(p1)  # deleted
+        assert not stf.train.checkpoint_exists(p2)  # deleted
+        assert stf.train.checkpoint_exists(p3)      # kept forever
+        assert stf.train.checkpoint_exists(p4)      # newest
