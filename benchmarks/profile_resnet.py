@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Diagnose the ResNet-50 step: where do the 97 ms go?
+
+Round-3 perf work (VERDICT r2 item 1). Produces:
+  - compiled cost analysis (FLOPs, bytes) of the session's jitted step
+  - a scan of the optimized HLO for f32 convolutions (MXU rate killers)
+  - timing: session.run loop vs direct jitted-call loop (isolates Python
+    dispatch) vs a hand-written pure-JAX ResNet step (isolates lowering)
+  - optionally a jax.profiler trace under artifacts/
+
+Usage: python benchmarks/profile_resnet.py [--trace] [--batch N]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_session_step(batch, image_size):
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.models import resnet
+    import jax.numpy as jnp
+
+    stf.reset_default_graph()
+    m = resnet.resnet50_train_model(batch_size=batch, image_size=image_size,
+                                    dtype=stf.bfloat16, learning_rate=0.1)
+    images, labels = resnet.synthetic_imagenet(batch, image_size)
+    images_dev = jnp.asarray(images, dtype=stf.bfloat16.np_dtype)
+    labels_dev = jnp.asarray(labels)
+    feed = {m["images"]: images_dev, m["labels"]: labels_dev}
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+    sess.run(m["train_op"], feed_dict=feed)  # compile + cache
+    return sess, m, feed
+
+
+def analyze_hlo(sess, m, feed):
+    """Lower the cached step and scan optimized HLO."""
+    import jax
+
+    step = max((v for v in sess._cache.values() if v.has_device_stage),
+               key=lambda s: len(s.device_ops))
+    feeds = sess._normalize_feeds(feed)
+    feed_args = {t.name: feeds[t] for t in step.feed_tensors}
+    state = dict(sess._variable_store.values)
+    rng = jax.random.fold_in(sess._base_key, 999)
+    lowered = step.jitted.lower(state, feed_args, rng)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    hlo = compiled.as_text()
+
+    convs = re.findall(r"(\w+)\[[\d,]+\]\{[\d,]+\} convolution", hlo)
+    conv_dtypes = {}
+    for d in convs:
+        conv_dtypes[d] = conv_dtypes.get(d, 0) + 1
+    dots = re.findall(r"(\w+)\[[\d,]+\]\{[\d,]+\} dot", hlo)
+    dot_dtypes = {}
+    for d in dots:
+        dot_dtypes[d] = dot_dtypes.get(d, 0) + 1
+    n_fusions = hlo.count(" fusion(")
+    n_convert = len(re.findall(r"convert\(", hlo))
+    return {
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes accessed"),
+        "transcendentals": cost.get("transcendentals"),
+        "conv_dtypes": conv_dtypes,
+        "dot_dtypes": dot_dtypes,
+        "n_fusions": n_fusions,
+        "n_converts": n_convert,
+        "hlo_lines": hlo.count("\n"),
+    }, hlo
+
+
+def time_session_loop(sess, m, feed, steps):
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        sess.run(m["train_op"], feed_dict=feed)
+    sess.run(m["loss"], feed_dict=feed)
+    return (time.perf_counter() - t0) / (steps + 1)
+
+
+def time_direct_loop(sess, m, feed, steps):
+    """Call the cached jitted fn directly — no Session dispatch at all."""
+    import jax
+
+    step = max((v for v in sess._cache.values() if v.has_device_stage),
+               key=lambda s: len(s.device_ops))
+    feeds = sess._normalize_feeds(feed)
+    feed_args = {t.name: feeds[t] for t in step.feed_tensors}
+    state = dict(sess._variable_store.values)
+    rng = jax.random.fold_in(sess._base_key, 12345)
+    # warm
+    _, state, _ = step.jitted(dict(state), feed_args, rng)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        _, state, _ = step.jitted(dict(state), feed_args, rng)
+    jax.block_until_ready(state)
+    dt = (time.perf_counter() - t0) / steps
+    # restore store (we donated copies; the session's own arrays were donated
+    # away on the very first call, so re-commit the final state)
+    sess._variable_store.values = dict(state)
+    return dt
+
+
+def time_pure_jax(batch, image_size, steps):
+    """Hand-written minimal ResNet-50 fwd+bwd+SGD in raw JAX: the XLA
+    ceiling for this model shape, independent of the stf lowering."""
+    import jax
+    import jax.numpy as jnp
+
+    BLOCKS = (3, 4, 6, 3)
+    rng = np.random.RandomState(0)
+
+    params = {}
+
+    def mk_conv(name, kh, kw, cin, cout):
+        params[name + "/w"] = jnp.asarray(
+            rng.randn(kh, kw, cin, cout).astype(np.float32) * 0.05,
+            dtype=jnp.bfloat16)
+
+    def mk_bn(name, c):
+        params[name + "/g"] = jnp.ones((c,), jnp.float32)
+        params[name + "/b"] = jnp.zeros((c,), jnp.float32)
+
+    def conv(p, name, x, stride):
+        return jax.lax.conv_general_dilated(
+            x, p[name + "/w"], window_strides=(stride, stride),
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def bn(p, name, x):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        y = (xf - mean) * jax.lax.rsqrt(var + 1e-5)
+        return (y * p[name + "/g"] + p[name + "/b"]).astype(x.dtype)
+
+    # build params
+    mk_conv("c0", 7, 7, 3, 64)
+    mk_bn("bn0", 64)
+    cin = 64
+    for s, n in enumerate(BLOCKS):
+        f = 64 * 2 ** s
+        for i in range(n):
+            pre = f"s{s}b{i}"
+            if i == 0:
+                mk_conv(pre + "p", 1, 1, cin, 4 * f)
+                mk_bn(pre + "pbn", 4 * f)
+            mk_conv(pre + "c1", 1, 1, cin, f)
+            mk_bn(pre + "bn1", f)
+            mk_conv(pre + "c2", 3, 3, f, f)
+            mk_bn(pre + "bn2", f)
+            mk_conv(pre + "c3", 1, 1, f, 4 * f)
+            mk_bn(pre + "bn3", 4 * f)
+            cin = 4 * f
+    params["fc/w"] = jnp.asarray(
+        rng.randn(2048, 1000).astype(np.float32) * 0.01)
+    params["fc/b"] = jnp.zeros((1000,), jnp.float32)
+
+    def forward(p, x):
+        h = conv(p, "c0", x, 2)
+        h = jax.nn.relu(bn(p, "bn0", h))
+        h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                                  (1, 2, 2, 1), "SAME")
+        for s, n in enumerate(BLOCKS):
+            for i in range(n):
+                pre = f"s{s}b{i}"
+                stride = 2 if (s > 0 and i == 0) else 1
+                sc = h
+                if i == 0:
+                    sc = bn(p, pre + "pbn", conv(p, pre + "p", h, stride))
+                y = jax.nn.relu(bn(p, pre + "bn1", conv(p, pre + "c1", h, 1)))
+                y = jax.nn.relu(bn(p, pre + "bn2",
+                                   conv(p, pre + "c2", y, stride)))
+                y = bn(p, pre + "bn3", conv(p, pre + "c3", y, 1))
+                h = jax.nn.relu(y + sc)
+        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+        return h @ p["fc/w"] + p["fc/b"]
+
+    def loss_fn(p, x, y):
+        logits = forward(p, x)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, y[:, None], axis=-1))
+
+    @jax.jit
+    def train_step(p, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        new_p = jax.tree.map(
+            lambda w, gw: (w - 0.1 * gw.astype(w.dtype)), p, g)
+        return loss, new_p
+
+    x = jnp.asarray(rng.rand(batch, image_size, image_size, 3),
+                    dtype=jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, size=batch).astype(np.int32))
+    loss, params = train_step(params, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params = train_step(params, x, y)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--trace", action="store_true")
+    ap.add_argument("--skip-pure", action="store_true")
+    ap.add_argument("--dump-hlo", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    peak = 197e12 if "v5 lite" in getattr(dev, "device_kind", "") else 197e12
+    out = {"device": str(dev), "batch": args.batch}
+
+    print("== building session step ==", file=sys.stderr)
+    sess, m, feed = build_session_step(args.batch, args.image)
+
+    print("== HLO analysis ==", file=sys.stderr)
+    stats, hlo = analyze_hlo(sess, m, feed)
+    out["hlo"] = stats
+    if args.dump_hlo:
+        with open(args.dump_hlo, "w") as f:
+            f.write(hlo)
+
+    print("== session.run loop ==", file=sys.stderr)
+    out["session_sec_per_step"] = time_session_loop(sess, m, feed, args.steps)
+
+    print("== direct jitted loop ==", file=sys.stderr)
+    out["direct_sec_per_step"] = time_direct_loop(sess, m, feed, args.steps)
+
+    if args.trace:
+        import jax.profiler
+
+        with jax.profiler.trace("/root/repo/artifacts/resnet_trace"):
+            for _ in range(3):
+                sess.run(m["train_op"], feed_dict=feed)
+            sess.run(m["loss"], feed_dict=feed)
+        out["trace_dir"] = "/root/repo/artifacts/resnet_trace"
+
+    if not args.skip_pure:
+        print("== pure-JAX reference step ==", file=sys.stderr)
+        out["pure_jax_sec_per_step"] = time_pure_jax(
+            args.batch, args.image, args.steps)
+
+    flops = 3.0 * 4.089e9 * (args.image / 224.0) ** 2 * args.batch
+    for k in ("session_sec_per_step", "direct_sec_per_step",
+              "pure_jax_sec_per_step"):
+        if k in out:
+            out[k.replace("sec_per_step", "mfu")] = round(
+                flops / out[k] / peak, 4)
+            out[k] = round(out[k], 5)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()
