@@ -4,10 +4,10 @@
 grpc distributed_runtime on pod)".)
 
 TPU-first choices:
-- Flash attention (Pallas, stf.nn.fused_attention) when there is no padding
-  mask; padded batches use an additive-bias attention that XLA fuses. Fixed
-  sequence length (the BERT pretraining setup) keeps every matmul static
-  for the MXU.
+- Every attention layer runs the Pallas flash-attention kernel: the padding
+  mask rides the kernel's additive key-bias input and attention dropout is
+  generated in-kernel (counter-based, replayed in the vjp). Fixed sequence
+  length (the BERT pretraining setup) keeps every matmul static for the MXU.
 - Fused Pallas LayerNorm, bf16 activations with f32 parameters/statistics.
 - MLM gathers only the masked positions before the vocab projection, so the
   (positions, vocab) matmul is 20x smaller than a full-sequence projection.
@@ -18,7 +18,6 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
-import math
 
 import numpy as np
 
@@ -67,18 +66,17 @@ def _dense(x, units, cfg, name, activation=None):
 def attention_layer(h, attn_bias, cfg, training, compute_dtype, name="attention"):
     """Multi-head self-attention. attn_bias: additive (B,1,1,S) or None.
 
-    The Pallas flash-attention kernel runs when there is neither a padding
-    bias nor attention dropout to apply (the kernel has no dropout hook);
-    otherwise the standard softmax form (additive bias, f32 softmax,
-    dropout on probs) runs and XLA fuses it.
+    Always runs the Pallas flash-attention kernel: the padding bias passes
+    through the kernel's additive key-bias input and attention dropout is
+    applied inside the kernel (counter-based mask, replayed in the vjp) —
+    the pretraining config (padded batches + attention_dropout 0.1) is the
+    flash path, not a fallback.
     """
     b = int(h.shape[0])
     s = int(h.shape[1])
     hidden = int(h.shape[2])
     heads = cfg.num_heads
     hd = hidden // heads
-    use_flash = attn_bias is None and not (training and
-                                           cfg.attention_dropout > 0)
     with stf.variable_scope(name):
         q = _dense(h, hidden, cfg, "query")
         k = _dense(h, hidden, cfg, "key")
@@ -86,18 +84,11 @@ def attention_layer(h, attn_bias, cfg, training, compute_dtype, name="attention"
         q = common.split_heads(q, b, s, heads, hd)
         k = common.split_heads(k, b, s, heads, hd)
         v = common.split_heads(v, b, s, heads, hd)
-        if use_flash:
-            ctx = stf.nn.fused_attention(q, k, v, causal=False)
-        else:
-            scores = stf.matmul(q, k, transpose_b=True)
-            scores = stf.cast(scores, stf.float32) / math.sqrt(hd)
-            if attn_bias is not None:
-                scores = scores + attn_bias
-            probs = stf.nn.softmax(scores, axis=-1)
-            if training and cfg.attention_dropout > 0:
-                probs = stf.nn.dropout(probs,
-                                       keep_prob=1.0 - cfg.attention_dropout)
-            ctx = stf.matmul(stf.cast(probs, compute_dtype), v)
+        key_bias = (stf.reshape(attn_bias, [b, s])
+                    if attn_bias is not None else None)
+        ctx = stf.nn.fused_attention(
+            q, k, v, bias=key_bias, causal=False,
+            dropout_rate=cfg.attention_dropout if training else 0.0)
         ctx = common.merge_heads(ctx, b, s, hidden)
         out = _dense(ctx, hidden, cfg, "output")
         if training and cfg.hidden_dropout > 0:

@@ -4,9 +4,10 @@
 across TPU slice sub-meshes)".)
 
 TPU-first choices:
-- Causal decoder self-attention runs the Pallas flash-attention kernel;
-  encoder/cross attention with padding uses additive-bias softmax that XLA
-  fuses. All shapes static (fixed src/tgt lengths) for MXU tiling.
+- Every attention (encoder self, cross, causal decoder self) runs the
+  Pallas flash-attention kernel; padding masks ride the kernel's additive
+  key-bias input and attention dropout is generated in-kernel. All shapes
+  static (fixed src/tgt lengths) for MXU tiling.
 - bf16 activations, f32 parameters, fused Pallas LayerNorm, label-smoothed
   xent in f32.
 - Beam search re-scores the full prefix each step — O(L^2) FLOPs but every
@@ -85,14 +86,14 @@ def _attention(q_in, kv_in, bias, cfg, training, compute_dtype, name,
                causal=False):
     """q_in (B,Sq,D) attends over kv_in (B,Sk,D). bias additive or None.
 
-    Flash attention (Pallas) when there is no bias and no dropout to apply
-    to the attention probs; otherwise additive-bias f32 softmax + dropout.
+    Always the Pallas flash-attention kernel: padding bias rides the
+    kernel's additive key-bias input, causal masking and attention-prob
+    dropout happen in-kernel (counter-based mask replayed in the vjp).
     """
     b = int(q_in.shape[0])
     sq, sk = int(q_in.shape[1]), int(kv_in.shape[1])
     d, heads = cfg.d_model, cfg.num_heads
     hd = d // heads
-    use_flash = bias is None and not (training and cfg.dropout > 0)
     with stf.variable_scope(name):
         q = _dense(q_in, d, cfg, "q")
         k = _dense(kv_in, d, cfg, "k")
@@ -100,20 +101,10 @@ def _attention(q_in, kv_in, bias, cfg, training, compute_dtype, name,
         q = common.split_heads(q, b, sq, heads, hd)
         k = common.split_heads(k, b, sk, heads, hd)
         v = common.split_heads(v, b, sk, heads, hd)
-        if use_flash:
-            ctx = stf.nn.fused_attention(q, k, v, causal=causal)
-        else:
-            scores = stf.cast(stf.matmul(q, k, transpose_b=True),
-                              stf.float32) / math.sqrt(hd)
-            if causal:
-                cm = np.triu(np.full((sq, sk), -1e9, np.float32), k=1)
-                scores = scores + stf.constant(cm.reshape(1, 1, sq, sk))
-            if bias is not None:
-                scores = scores + bias
-            probs = stf.nn.softmax(scores, axis=-1)
-            if training and cfg.dropout > 0:
-                probs = stf.nn.dropout(probs, keep_prob=1.0 - cfg.dropout)
-            ctx = stf.matmul(stf.cast(probs, compute_dtype), v)
+        key_bias = stf.reshape(bias, [b, sk]) if bias is not None else None
+        ctx = stf.nn.fused_attention(
+            q, k, v, bias=key_bias, causal=causal,
+            dropout_rate=cfg.dropout if training else 0.0)
         out = _dense(common.merge_heads(ctx, b, sq, d), d, cfg, "out")
         if training and cfg.dropout > 0:
             out = stf.nn.dropout(out, keep_prob=1.0 - cfg.dropout)

@@ -13,14 +13,29 @@ from ..framework import tensor_shape as shape_mod
 from . import pallas as _pallas  # noqa: F401  (registers the op types)
 
 
-def fused_attention(q, k, v, *, causal=False, sm_scale=None, name=None):
-    """Flash attention over (batch, heads, seq, head_dim) tensors."""
+def fused_attention(q, k, v, *, bias=None, dropout_rate=0.0, causal=False,
+                    sm_scale=None, name=None):
+    """Flash attention over (batch, heads, seq, head_dim) tensors.
+
+    bias: optional additive score bias broadcast over heads/queries —
+    (batch, kv_seq) or (batch, 1, 1, kv_seq), the padding-mask shape;
+    constant under differentiation. dropout_rate > 0 applies attention-
+    probability dropout inside the kernel (drawn from the op's RNG
+    stream, replayed exactly in the backward pass).
+    """
     g = ops_mod.get_default_graph()
     q = ops_mod.convert_to_tensor(q)
     k = ops_mod.convert_to_tensor(k)
     v = ops_mod.convert_to_tensor(v)
-    op = g.create_op("FlashAttention", [q, k, v],
-                     attrs={"causal": bool(causal), "sm_scale": sm_scale},
+    inputs = [q, k, v]
+    if bias is not None:
+        inputs.append(ops_mod.convert_to_tensor(bias))
+    attrs = {"causal": bool(causal), "sm_scale": sm_scale}
+    op_type = "FlashAttention"
+    if dropout_rate and float(dropout_rate) > 0.0:
+        op_type = "FlashAttentionDropout"
+        attrs["dropout_rate"] = float(dropout_rate)
+    op = g.create_op(op_type, inputs, attrs=attrs,
                      name=name or "flash_attention",
                      output_specs=[(q.shape, q.dtype)])
     return op.outputs[0]

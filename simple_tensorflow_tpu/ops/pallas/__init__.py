@@ -18,10 +18,33 @@ from .quant_matmul import (quant_matmul, quant_matmul_reference,
 from .softmax_xent import (softmax_cross_entropy,
                            softmax_cross_entropy_reference)
 
-op_registry.register_pure(
-    "FlashAttention",
-    lambda q, k, v, causal=False, sm_scale=None:
-        flash_attention(q, k, v, causal=causal, sm_scale=sm_scale))
+def _flash_pure(q, k, v, bias=None, causal=False, sm_scale=None):
+    return flash_attention(q, k, v, bias=bias, causal=causal,
+                           sm_scale=sm_scale)
+
+
+def _flash_dropout_lower(ctx, op, input_values):
+    """FlashAttention with probability dropout: stateful (never CSE'd —
+    two dropout sites must draw different masks), seeded from the op's
+    per-step RNG stream so fwd and vjp replay the same mask."""
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v = input_values[:3]
+    bias = input_values[3] if len(input_values) > 3 else None
+    key = ctx.rng_for(op)
+    seed = jax.random.randint(key, (1,), 0, jnp.iinfo(jnp.int32).max,
+                              dtype=jnp.int32)
+    out = flash_attention(
+        q, k, v, bias=bias, causal=op.attrs.get("causal", False),
+        sm_scale=op.attrs.get("sm_scale"),
+        dropout_rate=float(op.attrs["dropout_rate"]), dropout_seed=seed)
+    return [out]
+
+
+op_registry.register_pure("FlashAttention", _flash_pure)
+op_registry.register("FlashAttentionDropout", lower=_flash_dropout_lower,
+                     is_stateful=True)
 op_registry.register_pure(
     "FusedLayerNorm",
     lambda x, gamma, beta, eps=1e-6: layer_norm(x, gamma, beta, eps=eps))

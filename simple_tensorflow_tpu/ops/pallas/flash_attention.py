@@ -37,6 +37,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -71,12 +72,52 @@ def _score_mask(s, qi, kb, block_q, block_k, kv_true, causal):
     return jnp.where(mask, s, NEG_INF)
 
 
+def _mix32(h):
+    """murmur3 finalizer: avalanche a uint32 value (vectorized)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _keep_mask(seed, bh, qi, kb, block_q, block_k, keep_prob):
+    """Deterministic dropout keep-mask for score tile (qi, kb) of head bh.
+
+    Counter-based: hash(seed, bh, global_row, global_col) — regenerated
+    bit-identically in the backward kernels regardless of grid order, so
+    no mask tensor is ever materialized in HBM. Plain uint32 arithmetic
+    (not pltpu.prng_*) so interpret mode (the CPU test mesh) runs the
+    same code path as the Mosaic compile."""
+    shape = (block_q, block_k)
+    # every term stays uint32 explicitly: mixing in an int32 scalar would
+    # silently promote-then-clamp the whole chain back to int32 (x64 off),
+    # and an int32 < uint32 compare wraps the threshold negative.
+    rows = (qi.astype(jnp.uint32) * jnp.uint32(block_q) +
+            jax.lax.broadcasted_iota(jnp.uint32, shape, 0))
+    cols = (kb.astype(jnp.uint32) * jnp.uint32(block_k) +
+            jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
+    h0 = _mix32(seed.astype(jnp.uint32) ^
+                (bh.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)))
+    h = _mix32(h0 ^ rows)
+    h = _mix32(h ^ cols)
+    threshold = jnp.uint32(min(int(keep_prob * 4294967296.0), 4294967295))
+    return h.astype(jnp.uint32) < threshold
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel: grid (bh, q_blocks, k_blocks), innermost streams K/V
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, kv_true, num_kb):
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, kv_true, num_kb,
+                has_bias, dropout_rate):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = it
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -95,6 +136,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         k = k_ref[:]
         v = v_ref[:]
         s = _dot(q, k, ((1,), (1,))) * sm_scale        # (block_q, block_k)
+        if has_bias:
+            s = s + bias_ref[:]                        # (1, block_k) f32
         s = _score_mask(s, qi, kb, block_q, block_k, kv_true, causal)
 
         m_prev, l_prev = m_scr[:], l_scr[:]
@@ -102,7 +145,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)                # (block_q, 1)
         m_scr[:] = m_new
+        # denominator accumulates the UN-dropped sum: dropout scales
+        # normalized probs, and elementwise 0/(1/keep) commutes with the
+        # final per-row division by l.
         l_scr[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep_prob = 1.0 - dropout_rate
+            keep = _keep_mask(seed_ref[0], bh, qi, kb, block_q, block_k,
+                              keep_prob)
+            p = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
         acc_scr[:] = acc_scr[:] * alpha + _dot(
             p.astype(v.dtype), v, ((1,), (0,)))
 
@@ -113,21 +164,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[:] = m_scr[:] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
+def _fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k, kv_true,
+         dropout_rate, num_heads):
     bh, q_len, d = q.shape
     kv_pad_len = k.shape[1]
     num_kb = cdiv(kv_pad_len, block_k)
+    has_bias = bias is not None
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               kv_true=kv_true, num_kb=num_kb)
+                               kv_true=kv_true, num_kb=num_kb,
+                               has_bias=has_bias, dropout_rate=dropout_rate)
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec(
+            (None, 1, block_k),
+            lambda b, i, j, nh=num_heads: (b // nh, 0, j)))
+        operands.append(bias)
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(seed)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, cdiv(q_len, block_q), num_kb),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -146,7 +210,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
             transcendentals=bh * q_len * kv_true),
         interpret=use_interpret(),
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -154,10 +218,16 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
 # Backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                     sm_scale, causal, block_q, block_k, kv_true, num_qb):
+def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, kv_true,
+                     num_qb, has_bias, dropout_rate):
     # grid (bh, k_blocks, q_blocks): one K/V block, streaming Q/dO blocks.
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+        next(it), next(it), next(it), next(it), next(it), next(it))
+    bias_ref = next(it) if has_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    dk_ref, dv_ref, dk_scr, dv_scr = it
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
     qb = pl.program_id(2)
 
@@ -177,11 +247,22 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[:]                               # (bq, 1)
         delta = delta_ref[:]
         s = _dot(q, k, ((1,), (1,))) * sm_scale
+        if has_bias:
+            s = s + bias_ref[:]
         s = _score_mask(s, qb, ki, block_q, block_k, kv_true, causal)
         p = jnp.exp(s - lse)                           # (bq, bk) f32
-        pc = p.astype(do.dtype)
-        dv_scr[:] += _dot(pc, do, ((0,), (0,)))        # (bk, d)
         dp = _dot(do, v, ((1,), (1,)))                 # (bq, bk)
+        if dropout_rate > 0.0:
+            keep_prob = 1.0 - dropout_rate
+            # NOTE (qb, ki) order: the mask is keyed on (q-block, k-block)
+            # exactly as in the forward, though this grid iterates k outer.
+            keep = _keep_mask(seed_ref[0], bh, qb, ki, block_q, block_k,
+                              keep_prob)
+            pc = jnp.where(keep, p * (1.0 / keep_prob), 0.0).astype(do.dtype)
+            dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
+        else:
+            pc = p.astype(do.dtype)
+        dv_scr[:] += _dot(pc, do, ((0,), (0,)))        # (bk, d)
         ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_scr[:] += _dot(ds, q, ((0,), (0,)))         # (bk, d)
 
@@ -191,10 +272,16 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k,
-                   kv_true, num_kb):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, kv_true,
+                   num_kb, has_bias, dropout_rate):
     # grid (bh, q_blocks, k_blocks): one Q block, streaming K/V blocks.
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+        next(it), next(it), next(it), next(it), next(it), next(it))
+    bias_ref = next(it) if has_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    dq_ref, dq_scr = it
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -213,9 +300,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[:]
         v = v_ref[:]
         s = _dot(q, k, ((1,), (1,))) * sm_scale
+        if has_bias:
+            s = s + bias_ref[:]
         s = _score_mask(s, qi, kb, block_q, block_k, kv_true, causal)
         p = jnp.exp(s - lse)
         dp = _dot(do, v, ((1,), (1,)))
+        if dropout_rate > 0.0:
+            keep_prob = 1.0 - dropout_rate
+            keep = _keep_mask(seed_ref[0], bh, qi, kb, block_q, block_k,
+                              keep_prob)
+            dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_scr[:] += _dot(ds, k, ((1,), (0,)))
 
@@ -224,19 +318,38 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, kv_true, res, g):
-    q, k, v, o, lse = res
+def _bwd(sm_scale, causal, block_q, block_k, kv_true, dropout_rate,
+         num_heads, res, g):
+    q, k, v, bias, seed, o, lse = res
     bh, q_len, d = q.shape
     kv_pad_len = k.shape[1]
+    has_bias = bias is not None
     do = g.astype(jnp.float32)
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1,
                     keepdims=True)                          # (bh, q_len, 1)
     num_qb = cdiv(q_len, block_q)
     num_kb = cdiv(kv_pad_len, block_k)
 
+    def aux(kb_index_map):
+        """Optional bias/seed specs+operands; kb_index_map maps grid ids to
+        the k-block index (differs between the two bwd grids)."""
+        specs, ops = [], []
+        if has_bias:
+            specs.append(pl.BlockSpec(
+                (None, 1, block_k),
+                lambda b, i, j, nh=num_heads: (b // nh, 0,
+                                               kb_index_map(i, j))))
+            ops.append(bias)
+        if dropout_rate > 0.0:
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            ops.append(seed)
+        return specs, ops
+
     dkdv = functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale,
                              causal=causal, block_q=block_q, block_k=block_k,
-                             kv_true=kv_true, num_qb=num_qb)
+                             kv_true=kv_true, num_qb=num_qb,
+                             has_bias=has_bias, dropout_rate=dropout_rate)
+    aux_specs, aux_ops = aux(lambda i, j: i)  # grid (bh, kb, qb)
     dk, dv = pl.pallas_call(
         dkdv,
         grid=(bh, num_kb, num_qb),
@@ -247,7 +360,7 @@ def _bwd(sm_scale, causal, block_q, block_k, kv_true, res, g):
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
-        ],
+        ] + aux_specs,
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -261,11 +374,13 @@ def _bwd(sm_scale, causal, block_q, block_k, kv_true, res, g):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=use_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta, *aux_ops)
 
     dqk = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                             block_q=block_q, block_k=block_k,
-                            kv_true=kv_true, num_kb=num_kb)
+                            kv_true=kv_true, num_kb=num_kb,
+                            has_bias=has_bias, dropout_rate=dropout_rate)
+    aux_specs, aux_ops = aux(lambda i, j: j)  # grid (bh, qb, kb)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, num_qb, num_kb),
@@ -276,45 +391,78 @@ def _bwd(sm_scale, causal, block_q, block_k, kv_true, res, g):
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        ] + aux_specs,
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=use_interpret(),
-    )(q, k, v, g, lse, delta)
-    return dq, dk, dv
+    )(q, k, v, g, lse, delta, *aux_ops)
+    grads = [dq, dk, dv]
+    # bias is a constant mask under differentiation (stop_gradient'd in the
+    # wrapper); seed is integer-typed. Both get symbolic-zero cotangents.
+    if has_bias:
+        grads.append(jnp.zeros_like(bias))
+    else:
+        grads.append(None)
+    if seed is not None:
+        grads.append(np.zeros(seed.shape, dtype=jax.dtypes.float0))
+    else:
+        grads.append(None)
+    return tuple(grads)
 
 
 # ---------------------------------------------------------------------------
 # Public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
-    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_bhsd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                kv_true, dropout_rate, num_heads):
+    o, _ = _fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                kv_true, dropout_rate, num_heads)
     return o
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, kv_true):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_true)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                    kv_true, dropout_rate, num_heads):
+    o, lse = _fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                  kv_true, dropout_rate, num_heads)
+    return o, (q, k, v, bias, seed, o, lse)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
-def flash_attention(q, k, v, *, causal=False, sm_scale=None,
+def flash_attention(q, k, v, *, causal=False, sm_scale=None, bias=None,
+                    dropout_rate=0.0, dropout_seed=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Fused attention. q,k,v: (batch, heads, seq, head_dim) (kv seq may
     differ for cross-attention; causal requires equal lengths). Returns
-    (batch, heads, q_seq, head_dim) in q.dtype."""
+    (batch, heads, q_seq, head_dim) in q.dtype.
+
+    bias: optional additive score bias, broadcast over heads and query
+    positions — shape (batch, kv_seq) or any (batch, 1, 1, kv_seq)-style
+    squeezable form. This is the padding-mask shape (0 attendable / -1e9
+    padded); it is treated as a CONSTANT under differentiation
+    (stop_gradient) — per-head trainable biases must use the XLA
+    composed-attention path.
+
+    dropout_rate: attention-probability dropout (applied after softmax
+    normalization, inverted scaling). Requires dropout_seed, an int32
+    scalar/array; the mask is counter-based on (head, row, col) so the
+    backward pass regenerates it exactly — nothing is materialized.
+    """
     b, h, q_len, d = q.shape
     kv_len = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     if causal and q_len != kv_len:
         raise ValueError("causal flash attention needs q_len == kv_len")
+    if dropout_rate < 0.0 or dropout_rate >= 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1): {dropout_rate}")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("flash attention dropout needs dropout_seed")
 
     align = 8 if use_interpret() else 128
     block_q = min(block_q, round_up(q_len, align))
@@ -327,20 +475,52 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     kk = pad_dim(pad_dim(k.reshape(b * h, kv_len, d), 1, kp_len), 2, dp)
     vv = pad_dim(pad_dim(v.reshape(b * h, kv_len, d), 1, kp_len), 2, dp)
 
-    o = _flash_bhsd(qq, kk, vv, float(sm_scale), bool(causal),
-                    int(block_q), int(block_k), int(kv_len))
+    bb = None
+    if bias is not None:
+        bb = jnp.asarray(bias, jnp.float32)
+        # squeeze broadcast dims down to (batch, kv_seq)
+        while bb.ndim > 2:
+            sq = next((i for i in range(1, bb.ndim - 1) if bb.shape[i] == 1),
+                      None)
+            if sq is None:
+                raise NotImplementedError(
+                    "flash attention bias must broadcast over heads and "
+                    f"query positions (got shape {bias.shape}); use the "
+                    "XLA composed-attention path for per-head/per-query "
+                    "biases")
+            bb = jnp.squeeze(bb, axis=sq)
+        if bb.shape != (b, kv_len):
+            raise ValueError(
+                f"flash attention bias: expected (batch, kv_seq)="
+                f"({b}, {kv_len}) after squeezing, got {bb.shape}")
+        bb = jax.lax.stop_gradient(pad_dim(bb, 1, kp_len))
+        bb = bb.reshape(b, 1, kp_len)
+
+    ss = None
+    if dropout_rate > 0.0:
+        ss = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+
+    o = _flash_bhsd(qq, kk, vv, bb, ss, float(sm_scale), bool(causal),
+                    int(block_q), int(block_k), int(kv_len),
+                    float(dropout_rate), int(h))
     o = o[:, :q_len, :d].reshape(b, h, q_len, d)
     return o
 
 
-def mha_reference(q, k, v, *, causal=False, sm_scale=None):
-    """Naive attention in jnp — the numeric reference for tests."""
+def mha_reference(q, k, v, *, causal=False, sm_scale=None, bias=None):
+    """Naive attention in jnp — the numeric reference for tests.
+    bias: additive (batch, kv_seq) or (batch, 1, 1, kv_seq) score bias."""
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32) * sm_scale,
                    precision=_HI)
+    if bias is not None:
+        bias = jnp.asarray(bias, jnp.float32)
+        if bias.ndim == 2:
+            bias = bias[:, None, None, :]
+        s = s + bias
     if causal:
         q_len, k_len = s.shape[-2:]
         mask = jnp.tril(jnp.ones((q_len, k_len), bool))
