@@ -16,7 +16,8 @@ from .tensor_parallel import (
     TensorParallel, column_parallel_dense, row_parallel_dense,
 )
 from .fsdp import FSDP
-from .pipeline import pipeline, pipeline_p
+from .pipeline import (pipeline, pipeline_1f1b_p, pipeline_p,
+                       pipeline_train)
 from .ring_attention import ring_attention, ring_attention_p
 from .sequence_parallel import (
     sequence_parallel_attention, ulysses_attention_p,
