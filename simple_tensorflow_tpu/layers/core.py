@@ -10,8 +10,9 @@ from .base import Layer
 
 
 class Dense(Layer):
-    """(ref: core.py:48 ``class Dense``). The matmul keeps bf16 inputs with
-    f32 accumulation on the MXU (see ops/math_ops.MatMul)."""
+    """(ref: core.py:48 ``class Dense``). bf16 inputs run the MXU natively
+    (f32 accumulation inside the unit, bf16 activations out — see
+    ops/math_ops.MatMul)."""
 
     def __init__(self, units, activation=None, use_bias=True,
                  kernel_initializer=None, bias_initializer=None,

@@ -4,8 +4,11 @@ core/kernels/matmul_op.cc, reduction_ops_*.cc, segment_reduction_ops.cc).
 Every op is a graph node whose lowering emits jax.numpy/lax — XLA fuses
 elementwise chains into matmul epilogues automatically, which is why there
 are no hand-fused variants here (the reference ships ~300 cwise CUDA kernels;
-on TPU the fusion is the compiler's job). MatMul accumulates in float32 for
-bf16 inputs (MXU-native behavior) via ``preferred_element_type``.
+on TPU the fusion is the compiler's job). MatMul output dtype equals the
+input dtype (TF semantics); bf16 matmuls still accumulate in f32 INSIDE the
+MXU (hardware behavior) — emitting the f32 accumulator as the output
+(preferred_element_type) would double activation HBM traffic through every
+dense layer, which measured as the dominant bandwidth cost on bf16 models.
 """
 
 from __future__ import annotations
@@ -145,31 +148,25 @@ op_registry.register_pure("MatMul", lambda a, b, transpose_a=False,
                                                           transpose_b))
 op_registry.register_pure("BatchMatMul", lambda a, b, adj_x=False, adj_y=False:
                           jnp.matmul(jnp.swapaxes(a, -1, -2) if adj_x else a,
-                                     jnp.swapaxes(b, -1, -2) if adj_y else b,
-                                     preferred_element_type=_acc_type(a.dtype)))
+                                     jnp.swapaxes(b, -1, -2) if adj_y else b))
 op_registry.register_pure("Cross", lambda a, b: jnp.cross(a, b))
 op_registry.register_pure("Tensordot", lambda a, b, axes: jnp.tensordot(
     a, b, axes=axes))
 op_registry.register_pure("Einsum", lambda *xs, equation: jnp.einsum(
-    equation, *xs, preferred_element_type=_acc_type(xs[0].dtype)))
+    equation, *xs))
 op_registry.register_pure("ClipByValue", lambda x, lo, hi: jnp.clip(x, lo, hi))
 
 
-def _acc_type(dtype):
-    """MXU accumulates bf16/fp8 matmuls in f32; make that explicit so XLA
-    never silently downgrades (TPU perf+accuracy contract)."""
-    d = np.dtype(dtype)
-    if d.itemsize <= 2 and d.kind == "f" or str(d) == "bfloat16":
-        return np.float32
-    return None
-
-
 def _matmul_impl(a, b, transpose_a, transpose_b):
+    # no preferred_element_type: output stays in the input dtype (TF
+    # semantics). The MXU still accumulates bf16 products in f32 internally;
+    # exposing that accumulator as an f32 output doubles HBM write traffic
+    # for every layer and forces downstream ops into f32.
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
         b = jnp.swapaxes(b, -1, -2)
-    return jnp.matmul(a, b, preferred_element_type=_acc_type(a.dtype))
+    return jnp.matmul(a, b)
 
 
 # reductions: axis/keepdims are static attrs

@@ -144,10 +144,23 @@ def pipeline_1f1b_p(fn, loss_fn, stage_params, microbatches, targets,
         x_saved = jax.lax.dynamic_index_in_dim(x_buf, slot_b, 0,
                                                keepdims=False)
         y_re, stage_vjp = jax.vjp(fn, stage_params, x_saved)
-        # last stage: cotangent comes from the loss of microbatch b == f
+        # last stage: cotangent comes from the loss of microbatch b == f.
+        # lax.cond so the S-1 non-last stages skip the loss fwd+vjp at
+        # runtime instead of computing and discarding it every step.
         target_b = targets[jnp.clip(b, 0, n_micro - 1)]
-        loss_b, loss_vjp = jax.vjp(loss_fn, y_re, target_b)
-        dy_from_loss, _ = loss_vjp(jnp.ones_like(loss_b))
+
+        def _loss_branch(args):
+            y_b, t_b = args
+            loss_v, loss_vjp = jax.vjp(loss_fn, y_b, t_b)
+            dy_v, _ = loss_vjp(jnp.ones_like(loss_v))
+            return loss_v.astype(jnp.float32), dy_v.astype(y_b.dtype)
+
+        def _skip_branch(args):
+            y_b, _ = args
+            return jnp.zeros((), jnp.float32), jnp.zeros_like(y_b)
+
+        loss_b, dy_from_loss = jax.lax.cond(
+            is_last, _loss_branch, _skip_branch, (y_re, target_b))
         dy = jnp.where(is_last, dy_from_loss, bwd_state)
         dparams, dx = stage_vjp(dy.astype(y_re.dtype))
         grad_acc = jax.tree.map(
