@@ -18,105 +18,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-BLOCKS = (3, 4, 6, 3)
-
-
-def build(batch, image_size, bn_mode):
-    import jax
-    import jax.numpy as jnp
-
-    rng = np.random.RandomState(0)
-    params = {}
-
-    def mk_conv(name, kh, kw, cin, cout):
-        params[name + "/w"] = jnp.asarray(
-            rng.randn(kh, kw, cin, cout).astype(np.float32) * 0.05,
-            dtype=jnp.bfloat16)
-
-    def mk_bn(name, c):
-        if bn_mode != "no_bn":
-            params[name + "/g"] = jnp.ones((c,), jnp.float32)
-            params[name + "/b"] = jnp.zeros((c,), jnp.float32)
-
-    def conv(p, name, x, stride):
-        return jax.lax.conv_general_dilated(
-            x, p[name + "/w"], window_strides=(stride, stride),
-            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
-
-    def bn(p, name, x):
-        if bn_mode == "no_bn":
-            return x
-        if bn_mode == "f32_full":
-            xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=(0, 1, 2))
-            var = jnp.var(xf, axis=(0, 1, 2))
-            y = (xf - mean) * jax.lax.rsqrt(var + 1e-5)
-            return (y * p[name + "/g"] + p[name + "/b"]).astype(x.dtype)
-        # bf16_apply: f32 stats, bf16 elementwise
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=(0, 1, 2))
-        var = jnp.var(xf, axis=(0, 1, 2))
-        a = (p[name + "/g"] * jax.lax.rsqrt(var + 1e-5))
-        b = p[name + "/b"] - mean * a
-        return x * a.astype(x.dtype) + b.astype(x.dtype)
-
-    mk_conv("c0", 7, 7, 3, 64)
-    mk_bn("bn0", 64)
-    cin = 64
-    for s, n in enumerate(BLOCKS):
-        f = 64 * 2 ** s
-        for i in range(n):
-            pre = f"s{s}b{i}"
-            if i == 0:
-                mk_conv(pre + "p", 1, 1, cin, 4 * f)
-                mk_bn(pre + "pbn", 4 * f)
-            mk_conv(pre + "c1", 1, 1, cin, f)
-            mk_bn(pre + "bn1", f)
-            mk_conv(pre + "c2", 3, 3, f, f)
-            mk_bn(pre + "bn2", f)
-            mk_conv(pre + "c3", 1, 1, f, 4 * f)
-            mk_bn(pre + "bn3", 4 * f)
-            cin = 4 * f
-    params["fc/w"] = jnp.asarray(
-        rng.randn(2048, 1000).astype(np.float32) * 0.01)
-    params["fc/b"] = jnp.zeros((1000,), jnp.float32)
-
-    def forward(p, x):
-        h = conv(p, "c0", x, 2)
-        h = jax.nn.relu(bn(p, "bn0", h))
-        h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
-                                  (1, 2, 2, 1), "SAME")
-        for s, n in enumerate(BLOCKS):
-            for i in range(n):
-                pre = f"s{s}b{i}"
-                stride = 2 if (s > 0 and i == 0) else 1
-                sc = h
-                if i == 0:
-                    sc = bn(p, pre + "pbn", conv(p, pre + "p", h, stride))
-                y = jax.nn.relu(bn(p, pre + "bn1", conv(p, pre + "c1", h, 1)))
-                y = jax.nn.relu(bn(p, pre + "bn2",
-                                   conv(p, pre + "c2", y, stride)))
-                y = bn(p, pre + "bn3", conv(p, pre + "c3", y, 1))
-                h = jax.nn.relu(y + sc)
-        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
-        return h @ p["fc/w"] + p["fc/b"]
-
-    def loss_fn(p, x, y):
-        logits = forward(p, x)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
-
-    @jax.jit
-    def train_step(p, x, y):
-        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
-        new_p = jax.tree.map(
-            lambda w, gw: (w - 0.1 * gw.astype(w.dtype)), p, g)
-        return loss, new_p
-
-    x = jnp.asarray(rng.rand(batch, image_size, image_size, 3),
-                    dtype=jnp.bfloat16)
-    y = jnp.asarray(rng.randint(0, 1000, size=batch).astype(np.int32))
-    return train_step, params, x, y
+from benchmarks._resnet_builder import build_train_step  # noqa: E402
+from bench import detect_peak_flops  # noqa: E402
 
 
 def measure(train_step, params, x, y, steps):
@@ -144,11 +47,15 @@ def main():
     ap.add_argument("--modes", default="f32_full,bf16_apply,no_bn")
     args = ap.parse_args()
 
+    import jax
+
+    dev = jax.devices()[0]
     flops = 3.0 * 4.089e9 * (args.image / 224.0) ** 2 * args.batch
-    peak = 197e12
-    out = {"batch": args.batch}
+    peak = detect_peak_flops(getattr(dev, "device_kind", ""), dev.platform)
+    out = {"batch": args.batch, "device": str(dev)}
     for mode in args.modes.split(","):
-        train_step, params, x, y = build(args.batch, args.image, mode)
+        train_step, params, x, y = build_train_step(args.batch, args.image,
+                                                    mode)
         dt, cost = measure(train_step, params, x, y, args.steps)
         out[mode] = {
             "sec_per_step": round(dt, 5),

@@ -1,4 +1,5 @@
 """stf.estimator (ref: tensorflow/python/estimator)."""
 
 from .estimator import (Estimator, EstimatorSpec, ModeKeys, RunConfig,
-                        inputs)
+                        ServingInputReceiver,
+                        build_raw_serving_input_receiver_fn, inputs)

@@ -208,9 +208,86 @@ class Estimator:
                         yield batch
 
     def export_savedmodel(self, export_dir_base, serving_input_receiver_fn,
-                          **kwargs):
-        raise NotImplementedError(
-            "export via stf.saved_model.simple_save for now")
+                          assets_extra=None, as_text=False,
+                          checkpoint_path=None, strip_default_attrs=False):
+        """(ref: estimator.py:511 ``export_savedmodel``). Builds the PREDICT
+        graph from ``serving_input_receiver_fn``, restores the latest (or
+        given) checkpoint, and writes a timestamped SavedModel under
+        ``export_dir_base``. Returns the export directory path."""
+        import time
+
+        from .. import saved_model as sm
+
+        g = ops_mod.Graph()
+        with g.as_default():
+            train_mod.get_or_create_global_step(g)
+            receiver = serving_input_receiver_fn()
+            if isinstance(receiver, ServingInputReceiver):
+                features = receiver.features
+                receiver_tensors = receiver.receiver_tensors
+            else:  # bare (features, receiver_tensors) pair
+                features, receiver_tensors = receiver
+            spec = self._call_model_fn(features, None, ModeKeys.PREDICT)
+            outputs = spec.export_outputs or spec.predictions
+            if outputs is None:
+                raise ValueError(
+                    "model_fn PREDICT mode returned neither export_outputs "
+                    "nor predictions")
+            if not isinstance(outputs, dict):
+                outputs = {"output": outputs}
+            ckpt = checkpoint_path or train_mod.latest_checkpoint(
+                self._model_dir)
+            if not ckpt:
+                # exporting initializer values would persist a wrong model
+                # (ref estimator raises "Couldn't find trained model")
+                raise ValueError(
+                    f"Couldn't find trained model at {self._model_dir} to "
+                    "export (train first, or pass checkpoint_path)")
+            from ..client.session import Session
+
+            with Session(graph=g) as sess:
+                sess.run(variables_mod.global_variables_initializer())
+                train_mod.Saver().restore(sess, ckpt)
+                export_dir = os.path.join(
+                    export_dir_base, str(int(time.time())))
+                while os.path.exists(export_dir):  # unique timestamped dir
+                    export_dir += "_1"
+                sm.simple_save(sess, export_dir, inputs=receiver_tensors,
+                               outputs=outputs)
+        return export_dir
+
+
+class ServingInputReceiver(
+        collections.namedtuple("ServingInputReceiver",
+                               ["features", "receiver_tensors"])):
+    """(ref: python/estimator/export/export.py ``ServingInputReceiver``).
+    features: what the model_fn consumes; receiver_tensors: the fed
+    placeholders of the serving signature (often the same tensors)."""
+
+
+def build_raw_serving_input_receiver_fn(features):
+    """(ref: export.py ``build_raw_serving_input_receiver_fn``): the
+    features dict (of placeholders-to-be) IS the serving interface."""
+    def serving_input_receiver_fn():
+        from ..ops import array_ops
+
+        receiver = {}
+        for name, spec in features.items():
+            if isinstance(spec, ops_mod.Tensor):
+                # build a FRESH placeholder from the tensor's signature:
+                # reusing the tensor itself would wire the export graph to
+                # a producer in the caller's graph, which serializes into
+                # a SavedModel referencing a node that doesn't exist in it
+                receiver[name] = array_ops.placeholder(
+                    spec.dtype.base_dtype, spec.shape.as_list()
+                    if spec.shape.rank is not None else None, name=name)
+            else:  # (shape, dtype) spec
+                shape, dtype = spec
+                receiver[name] = array_ops.placeholder(dtype, shape,
+                                                       name=name)
+        return ServingInputReceiver(dict(receiver), dict(receiver))
+
+    return serving_input_receiver_fn
 
 
 def _call_input_fn(input_fn, expect_labels=True):

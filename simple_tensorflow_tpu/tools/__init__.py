@@ -1,0 +1,11 @@
+"""Graph tools (ref: tensorflow/python/tools/): freeze_graph,
+inspect_checkpoint, strip_unused, optimize_for_inference.
+
+All operate on the JSON GraphDef / stf-bundle checkpoint formats and are
+runnable as ``python -m simple_tensorflow_tpu.tools.<tool>``.
+"""
+
+from .freeze_graph import freeze_graph, freeze_graph_def
+from .inspect_checkpoint import print_tensors_in_checkpoint_file
+from .optimize_for_inference import optimize_for_inference
+from .strip_unused import strip_unused_nodes

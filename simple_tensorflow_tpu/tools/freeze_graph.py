@@ -1,0 +1,96 @@
+"""freeze_graph: bake checkpoint values into the graph as constants
+(ref: tensorflow/python/tools/freeze_graph.py:1).
+
+Converts VariableV2/ReadVariable nodes into Const nodes holding the
+checkpointed values and prunes everything (initializers, save/restore
+machinery, optimizer state) not needed to compute the output nodes —
+the train→freeze→serve step of the serving story.
+
+CLI: python -m simple_tensorflow_tpu.tools.freeze_graph \\
+    --input_graph g.json --input_checkpoint ckpt-123 \\
+    --output_node_names logits --output_graph frozen.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from . import graph_rewrite as gr
+
+
+def _load_checkpoint_values(checkpoint_prefix) -> dict:
+    # npz keys are '/'-flattened with '|' (train/saver.py save path)
+    with np.load(checkpoint_prefix + ".stfz", allow_pickle=False) as data:
+        return {k.replace("|", "/"): data[k] for k in data.files}
+
+
+def freeze_graph_def(graph_def, var_values, output_node_names):
+    """Pure rewrite: GraphDef dict + {var_name: ndarray} -> frozen dict.
+
+    Variable reads become Consts; variable writes (Assign etc.) and the
+    VariableV2 nodes themselves drop out in the output-reachability prune.
+    """
+    if isinstance(output_node_names, str):
+        output_node_names = [s for s in output_node_names.split(",") if s]
+    frozen_nodes = []
+    for node in graph_def["node"]:
+        if node["op"] in ("VariableV2", "ReadVariable"):
+            var_name = node["attr"].get("var_name")
+            if var_name not in var_values:
+                raise ValueError(
+                    f"variable {var_name!r} (node {node['name']}) not in "
+                    f"checkpoint; have {sorted(var_values)[:10]}...")
+            val = np.asarray(var_values[var_name])
+            dtype_name = node["output_specs"][0][1]
+            frozen_nodes.append(gr.make_const_node(
+                node["name"], val.astype(_np_dtype(dtype_name)), dtype_name,
+                list(val.shape), node.get("device", "")))
+        else:
+            frozen_nodes.append(dict(node, input=list(node["input"]),
+                                     control_input=[]))
+    frozen = {"versions": dict(graph_def.get("versions", {"producer": 1})),
+              "node": frozen_nodes}
+    return gr.prune_to(frozen, output_node_names)
+
+
+def _np_dtype(name):
+    from ..framework import dtypes as dtypes_mod
+
+    return dtypes_mod.as_dtype(name).np_dtype
+
+
+def freeze_graph(input_graph, input_checkpoint, output_node_names,
+                 output_graph=None):
+    """File-level entry. ``input_graph``: GraphDef or MetaGraph JSON path
+    (or an already-loaded dict). Returns the frozen GraphDef dict."""
+    if isinstance(input_graph, str):
+        with open(input_graph) as f:
+            input_graph = json.load(f)
+    if "graph_def" in input_graph:  # MetaGraph
+        input_graph = input_graph["graph_def"]
+    values = _load_checkpoint_values(input_checkpoint)
+    frozen = freeze_graph_def(input_graph, values, output_node_names)
+    if output_graph:
+        with open(output_graph, "w") as f:
+            json.dump(frozen, f)
+    return frozen
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input_graph", required=True)
+    ap.add_argument("--input_checkpoint", required=True)
+    ap.add_argument("--output_node_names", required=True,
+                    help="comma-separated")
+    ap.add_argument("--output_graph", required=True)
+    args = ap.parse_args()
+    frozen = freeze_graph(args.input_graph, args.input_checkpoint,
+                          args.output_node_names, args.output_graph)
+    print(f"froze {len(frozen['node'])} nodes -> {args.output_graph}")
+
+
+if __name__ == "__main__":
+    main()

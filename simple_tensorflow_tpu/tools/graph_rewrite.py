@@ -1,0 +1,87 @@
+"""Shared GraphDef-dict rewriting helpers for the graph tools.
+
+These operate on the JSON GraphDef structure produced by
+framework/graph_io.py (nodes with name/op/input/control_input/attr/
+output_specs) without building a live Graph — the same approach as the
+reference's tools, which rewrite GraphDef protos
+(ref: tensorflow/python/tools/freeze_graph.py operating on graph_pb2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set
+
+from ..framework import graph_io
+
+
+def node_map(graph_def) -> Dict[str, dict]:
+    return {n["name"]: n for n in graph_def["node"]}
+
+def producer_name(tensor_ref: str) -> str:
+    """'scope/op:0' -> 'scope/op'."""
+    return tensor_ref.rsplit(":", 1)[0] if ":" in tensor_ref else tensor_ref
+
+
+def reachable_from(graph_def, output_node_names: Iterable[str]) -> Set[str]:
+    """Names of nodes transitively feeding the outputs (incl. control)."""
+    nodes = node_map(graph_def)
+    stack = [n for n in output_node_names]
+    seen: Set[str] = set()
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        if name not in nodes:
+            raise ValueError(f"node {name!r} not in graph")
+        seen.add(name)
+        n = nodes[name]
+        for ref in n["input"]:
+            stack.append(producer_name(ref))
+        for c in n["control_input"]:
+            stack.append(c)
+    return seen
+
+
+def prune_to(graph_def, output_node_names: Iterable[str]) -> dict:
+    """GraphDef containing only nodes reachable from the outputs, in the
+    original (topological) order."""
+    keep = reachable_from(graph_def, output_node_names)
+    return {
+        "versions": dict(graph_def.get("versions", {"producer": 1})),
+        "node": [n for n in graph_def["node"] if n["name"] in keep],
+    }
+
+
+def make_const_node(name: str, value, dtype_name: str, shape: List[int],
+                    device: str = "") -> dict:
+    return {
+        "name": name,
+        "op": "Const",
+        "input": [],
+        "control_input": [],
+        "device": device,
+        "attr": {"value": graph_io._encode_attr(value),
+                 "dtype": graph_io._encode_attr(
+                     _as_dtype(dtype_name))},
+        "output_specs": [[list(shape), dtype_name]],
+    }
+
+
+def _as_dtype(name):
+    from ..framework import dtypes as dtypes_mod
+
+    return dtypes_mod.as_dtype(name)
+
+
+def rewire_input(node: dict, old_producer: str, new_ref: str) -> None:
+    """Point any of node's inputs that come from ``old_producer`` at
+    ``new_ref`` instead."""
+    node["input"] = [new_ref if producer_name(ref) == old_producer else ref
+                     for ref in node["input"]]
+    node["control_input"] = [c for c in node["control_input"]
+                             if c != old_producer]
+
+
+def const_value(node: dict):
+    """Decode the value of a Const node."""
+    return graph_io._decode_attr(node["attr"]["value"])

@@ -1,0 +1,64 @@
+"""inspect_checkpoint: list/print tensors in an stf-bundle checkpoint
+(ref: tensorflow/python/tools/inspect_checkpoint.py:1).
+
+CLI: python -m simple_tensorflow_tpu.tools.inspect_checkpoint \\
+    --file_name /path/ckpt-123 [--tensor_name w] [--print_values]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def print_tensors_in_checkpoint_file(file_name, tensor_name=None,
+                                     all_tensors=False, out=None):
+    """Prints name/dtype/shape for every tensor (values too with
+    ``all_tensors`` or a specific ``tensor_name``). Returns the
+    {name: ndarray} dict for library use."""
+    out = out or sys.stdout
+    if os.path.isdir(file_name) or os.path.isdir(file_name + ".orbax"):
+        print(f"{file_name}: orbax sharded checkpoint — use "
+              "stf.train.Saver(backend='orbax').restore or "
+              "orbax.checkpoint utilities to inspect", file=out)
+        return {}
+    path = file_name if file_name.endswith(".stfz") else file_name + ".stfz"
+    with np.load(path, allow_pickle=False) as data:
+        # npz keys are '/'-flattened with '|' (train/saver.py save path)
+        tensors = {k.replace("|", "/"): data[k] for k in data.files}
+    if tensor_name is not None:
+        if tensor_name not in tensors:
+            raise ValueError(f"tensor {tensor_name!r} not in checkpoint; "
+                             f"have {sorted(tensors)}")
+        v = tensors[tensor_name]
+        print(f"{tensor_name}  dtype={v.dtype}  shape={list(v.shape)}",
+              file=out)
+        print(v, file=out)
+        return {tensor_name: v}
+    total = 0
+    for name in sorted(tensors):
+        v = tensors[name]
+        total += v.size
+        print(f"{name}  dtype={v.dtype}  shape={list(v.shape)}", file=out)
+        if all_tensors:
+            print(v, file=out)
+    print(f"# Total: {len(tensors)} tensors, {total} parameters", file=out)
+    return tensors
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--file_name", required=True,
+                    help="checkpoint prefix (with or without .stfz)")
+    ap.add_argument("--tensor_name", default=None)
+    ap.add_argument("--print_values", action="store_true")
+    args = ap.parse_args()
+    print_tensors_in_checkpoint_file(args.file_name, args.tensor_name,
+                                     all_tensors=args.print_values)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""optimize_for_inference: prepare a frozen GraphDef for serving
+(ref: tensorflow/python/tools/optimize_for_inference.py:1,
+optimize_for_inference_lib.py).
+
+Passes (on the JSON GraphDef, no live graph needed):
+1. strip_unused: placeholder-ize the inputs, prune to the outputs.
+2. remove_training_nodes: splice out Identity/CheckNumerics/StopGradient
+   pass-throughs (ref remove_training_nodes in graph_util).
+3. fold_batch_norms: inference FusedBatchNorm with Const scale/offset/
+   mean/variance following a Conv2D with a Const kernel folds into the
+   conv weights: conv(x, W·s) + (β − μ·s), s = γ/√(σ²+ε) — one conv
+   replaces conv+norm at serve time (ref fold_batch_norms pass).
+
+CLI: python -m simple_tensorflow_tpu.tools.optimize_for_inference \\
+    --input g.json --output opt.json --input_names x --output_names y
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from . import graph_rewrite as gr
+from .strip_unused import strip_unused_nodes
+
+_PASS_THROUGH = ("Identity", "CheckNumerics", "StopGradient",
+                 "PreventGradient")
+
+
+def remove_training_nodes(graph_def, protected=()):
+    """Splice out pass-through ops, rewiring consumers to their input."""
+    protected = set(protected)
+    redirect = {}  # node name -> replacement tensor ref
+    kept = []
+    for node in graph_def["node"]:
+        if (node["op"] in _PASS_THROUGH and node["name"] not in protected
+                and len(node["input"]) >= 1
+                and not node["control_input"]):
+            redirect[node["name"]] = node["input"][0]
+        else:
+            kept.append(node)
+
+    def resolve(ref):
+        seen = set()
+        while gr.producer_name(ref) in redirect:
+            prod = gr.producer_name(ref)
+            if prod in seen:
+                break
+            seen.add(prod)
+            ref = redirect[prod]
+        return ref
+
+    for node in kept:
+        node["input"] = [resolve(ref) for ref in node["input"]]
+    return {"versions": dict(graph_def.get("versions", {"producer": 1})),
+            "node": kept}
+
+
+def fold_batch_norms(graph_def):
+    """Fold inference-mode FusedBatchNorm into the preceding Conv2D when
+    kernel and statistics are all Const (i.e. the graph is frozen)."""
+    nodes = gr.node_map(graph_def)
+    out_nodes = []
+    folded = set()
+    from ..framework import graph_io
+
+    for node in graph_def["node"]:
+        if node["name"] in folded:
+            continue
+        if node["op"] != "FusedBatchNorm" or \
+                graph_io._decode_attr(
+                    node["attr"].get("is_training", False)):
+            out_nodes.append(node)
+            continue
+        if len(node["input"]) < 5:
+            out_nodes.append(node)
+            continue
+        conv = nodes.get(gr.producer_name(node["input"][0]))
+        stats = [nodes.get(gr.producer_name(r)) for r in node["input"][1:5]]
+        if (conv is None or conv["op"] != "Conv2D" or
+                any(s is None or s["op"] != "Const" for s in stats)):
+            out_nodes.append(node)
+            continue
+        kernel = nodes.get(gr.producer_name(conv["input"][1]))
+        if kernel is None or kernel["op"] != "Const":
+            out_nodes.append(node)
+            continue
+        gamma, beta, mean, var = (np.asarray(gr.const_value(s),
+                                             np.float32) for s in stats)
+        eps = float(graph_io._decode_attr(
+            node["attr"].get("epsilon", 1e-3)))
+        w = np.asarray(gr.const_value(kernel))
+        scale = gamma / np.sqrt(var + eps)          # (C_out,)
+        w_folded = (w.astype(np.float32) * scale).astype(w.dtype)
+        bias = beta - mean * scale                  # (C_out,)
+        kname = kernel["name"] + "_bn_folded"
+        bname = node["name"] + "_folded_bias"
+        out_nodes.append(gr.make_const_node(
+            kname, w_folded, kernel["output_specs"][0][1],
+            list(w_folded.shape)))
+        new_conv = dict(conv, input=[conv["input"][0], kname + ":0"])
+        # the conv node keeps its name only if nothing else consumes its
+        # un-normalized output; rename and rewire defensively
+        new_conv["name"] = conv["name"] + "_bn_folded"
+        new_conv["output_specs"] = conv["output_specs"]
+        out_nodes.append(new_conv)
+        out_dtype = node["output_specs"][0][1]
+        out_nodes.append(gr.make_const_node(
+            bname, bias.astype(gr._as_dtype(out_dtype).np_dtype),
+            out_dtype, list(bias.shape)))
+        # BiasAdd replaces the FusedBatchNorm, keeping ITS name so
+        # consumers (which address output :0) need no rewiring; the BN's
+        # data_format carries over so NCHW graphs bias the channel axis
+        data_format = graph_io._decode_attr(
+            node["attr"].get("data_format", "NHWC"))
+        out_nodes.append({
+            "name": node["name"],
+            "op": "BiasAdd",
+            "input": [new_conv["name"] + ":0", bname + ":0"],
+            "control_input": [],
+            "device": node.get("device", ""),
+            "attr": {"data_format": data_format},
+            "output_specs": [node["output_specs"][0]],
+        })
+        folded.add(node["name"])
+    return {"versions": dict(graph_def.get("versions", {"producer": 1})),
+            "node": out_nodes}
+
+
+def optimize_for_inference(graph_def, input_node_names, output_node_names):
+    gd = strip_unused_nodes(graph_def, input_node_names, output_node_names)
+    gd = remove_training_nodes(
+        gd, protected=set(_as_list(input_node_names))
+        | set(_as_list(output_node_names)))
+    gd = fold_batch_norms(gd)
+    return gr.prune_to(gd, _as_list(output_node_names))
+
+
+def _as_list(names):
+    return [s for s in names.split(",") if s] if isinstance(names, str) \
+        else list(names)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--input_names", required=True)
+    ap.add_argument("--output_names", required=True)
+    args = ap.parse_args()
+    with open(args.input) as f:
+        gd = json.load(f)
+    if "graph_def" in gd:
+        gd = gd["graph_def"]
+    opt = optimize_for_inference(gd, args.input_names, args.output_names)
+    with open(args.output, "w") as f:
+        json.dump(opt, f)
+    print(f"optimized to {len(opt['node'])} nodes -> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

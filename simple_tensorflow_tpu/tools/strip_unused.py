@@ -1,0 +1,79 @@
+"""strip_unused: cut a GraphDef down to the subgraph between given inputs
+and outputs (ref: tensorflow/python/tools/strip_unused.py:1,
+strip_unused_lib.py).
+
+Input nodes are replaced by Placeholders (so e.g. a preprocessing pipeline
+feeding them drops out), then everything not reaching the outputs is
+pruned.
+
+CLI: python -m simple_tensorflow_tpu.tools.strip_unused \\
+    --input_graph g.json --input_node_names x --output_node_names y \\
+    --output_graph stripped.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from . import graph_rewrite as gr
+
+
+def strip_unused_nodes(graph_def, input_node_names, output_node_names):
+    """Pure rewrite. Nodes named in ``input_node_names`` become
+    Placeholders with the same output spec; the graph is then pruned to
+    ``output_node_names``."""
+    if isinstance(input_node_names, str):
+        input_node_names = [s for s in input_node_names.split(",") if s]
+    if isinstance(output_node_names, str):
+        output_node_names = [s for s in output_node_names.split(",") if s]
+    inputs = set(input_node_names)
+    nodes = gr.node_map(graph_def)
+    for name in inputs:
+        if name not in nodes:
+            raise ValueError(f"input node {name!r} not in graph")
+    new_nodes = []
+    for node in graph_def["node"]:
+        if node["name"] in inputs:
+            shape, dtype_name = node["output_specs"][0]
+            new_nodes.append({
+                "name": node["name"],
+                "op": "Placeholder",
+                "input": [],
+                "control_input": [],
+                "device": node.get("device", ""),
+                "attr": {"dtype": gr.graph_io._encode_attr(
+                    gr._as_dtype(dtype_name)),
+                    "shape": gr.graph_io._encode_attr(
+                        gr.graph_io.shape_mod.TensorShape(shape))},
+                "output_specs": [[shape, dtype_name]],
+            })
+        else:
+            new_nodes.append(node)
+    stripped = {"versions": dict(graph_def.get("versions",
+                                               {"producer": 1})),
+                "node": new_nodes}
+    return gr.prune_to(stripped, output_node_names)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input_graph", required=True)
+    ap.add_argument("--input_node_names", required=True)
+    ap.add_argument("--output_node_names", required=True)
+    ap.add_argument("--output_graph", required=True)
+    args = ap.parse_args()
+    with open(args.input_graph) as f:
+        gd = json.load(f)
+    if "graph_def" in gd:
+        gd = gd["graph_def"]
+    stripped = strip_unused_nodes(gd, args.input_node_names,
+                                  args.output_node_names)
+    with open(args.output_graph, "w") as f:
+        json.dump(stripped, f)
+    print(f"stripped to {len(stripped['node'])} nodes "
+          f"-> {args.output_graph}")
+
+
+if __name__ == "__main__":
+    main()

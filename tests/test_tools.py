@@ -1,0 +1,216 @@
+"""Serving-story tools: Estimator.export_savedmodel, freeze_graph,
+inspect_checkpoint, strip_unused, optimize_for_inference
+(ref: python/tools/{freeze_graph,inspect_checkpoint,strip_unused,
+optimize_for_inference}.py, estimator export path)."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu.framework import graph_io
+from simple_tensorflow_tpu import tools
+
+
+def _train_small_model(tmp_path):
+    """Train y = x @ w + b briefly; save checkpoint + graph; return paths
+    and the final weights."""
+    stf.reset_default_graph()
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 3).astype(np.float32)
+    W_true = np.float32([[1.0], [-2.0], [0.5]])
+    Y = X @ W_true
+
+    x = stf.placeholder(stf.float32, [None, 3], name="x")
+    w = stf.Variable(np.zeros((3, 1), np.float32), name="w")
+    b = stf.Variable(np.zeros((1,), np.float32), name="b")
+    pred = stf.add(stf.matmul(x, w), b, name="pred")
+    y = stf.placeholder(stf.float32, [None, 1], name="y")
+    loss = stf.reduce_mean(stf.square(pred - y))
+    train_op = stf.train.GradientDescentOptimizer(0.5).minimize(loss)
+
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+    for _ in range(60):
+        sess.run(train_op, {x: X, y: Y})
+    w_val, b_val = sess.run([w, b])
+    ckpt = stf.train.Saver().save(sess, str(tmp_path / "model"),
+                                  global_step=60)
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    graph_path = str(tmp_path / "graph.json")
+    with open(graph_path, "w") as f:
+        json.dump(gd, f)
+    return graph_path, ckpt, w_val, b_val, X, Y
+
+
+class TestFreezeGraph:
+    def test_freeze_and_run_without_checkpoint(self, tmp_path):
+        graph_path, ckpt, w_val, b_val, X, Y = _train_small_model(tmp_path)
+        frozen_path = str(tmp_path / "frozen.json")
+        frozen = tools.freeze_graph(graph_path, ckpt, "pred",
+                                    output_graph=frozen_path)
+        ops = {n["op"] for n in frozen["node"]}
+        assert "VariableV2" not in ops and "ReadVariable" not in ops
+        assert "Assign" not in ops  # optimizer/init machinery pruned
+
+        # import the frozen graph into a fresh graph and run WITHOUT any
+        # variable initialization or restore
+        stf.reset_default_graph()
+        with open(frozen_path) as f:
+            frozen_loaded = json.load(f)
+        (pred_t,) = graph_io.import_graph_def(
+            frozen_loaded, return_elements=["pred:0"], name="")
+        x_t = stf.get_default_graph().as_graph_element("x:0")
+        with stf.Session() as sess:
+            out = sess.run(pred_t, {x_t: X})
+        np.testing.assert_allclose(out, X @ w_val + b_val, rtol=1e-5)
+        np.testing.assert_allclose(out, Y, atol=0.15)  # it did train
+
+    def test_missing_variable_raises(self, tmp_path):
+        graph_path, ckpt, *_ = _train_small_model(tmp_path)
+        with open(graph_path) as f:
+            gd = json.load(f)
+        with pytest.raises(ValueError, match="not in"):
+            tools.freeze_graph_def(gd, {"only_this": np.zeros(1)}, "pred")
+
+
+class TestInspectCheckpoint:
+    def test_lists_tensors(self, tmp_path):
+        _, ckpt, w_val, b_val, _, _ = _train_small_model(tmp_path)
+        buf = io.StringIO()
+        tensors = tools.print_tensors_in_checkpoint_file(ckpt, out=buf)
+        listing = buf.getvalue()
+        assert "w" in tensors and "b" in tensors
+        assert "dtype=float32" in listing and "shape=[3, 1]" in listing
+        np.testing.assert_allclose(tensors["w"], w_val)
+
+    def test_single_tensor_with_values(self, tmp_path):
+        _, ckpt, w_val, _, _, _ = _train_small_model(tmp_path)
+        buf = io.StringIO()
+        out = tools.print_tensors_in_checkpoint_file(
+            ckpt, tensor_name="w", out=buf)
+        assert list(out) == ["w"]
+        assert str(float(w_val[0, 0]))[:4] in buf.getvalue()
+
+
+class TestStripUnused:
+    def test_prunes_to_subgraph(self, tmp_path):
+        graph_path, ckpt, *_ = _train_small_model(tmp_path)
+        frozen = tools.freeze_graph(graph_path, ckpt, "pred")
+        # strip with x as the input: everything else (y, loss, grads chain
+        # leftovers) must be gone
+        stripped = tools.strip_unused_nodes(frozen, "x", "pred")
+        names = {n["name"] for n in stripped["node"]}
+        assert "pred" in names and "x" in names
+        assert not any("grad" in n or n == "y" for n in names), names
+        x_node = next(n for n in stripped["node"] if n["name"] == "x")
+        assert x_node["op"] == "Placeholder"
+
+    def test_missing_input_raises(self, tmp_path):
+        graph_path, ckpt, *_ = _train_small_model(tmp_path)
+        frozen = tools.freeze_graph(graph_path, ckpt, "pred")
+        with pytest.raises(ValueError, match="not in graph"):
+            tools.strip_unused_nodes(frozen, "nope", "pred")
+
+
+class TestOptimizeForInference:
+    def test_folds_frozen_conv_bn(self, tmp_path):
+        stf.reset_default_graph()
+        rng = np.random.RandomState(1)
+        x = stf.placeholder(stf.float32, [2, 8, 8, 3], name="img")
+        h = stf.layers.conv2d(x, 4, 3, padding="same", use_bias=False,
+                              name="c1")
+        # inference-mode BN: running stats become Consts after freezing
+        h = stf.layers.batch_normalization(h, training=False, fused=True,
+                                           name="bn1")
+        out = stf.identity(h, name="out")
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        # give the stats non-trivial values so folding is actually tested
+        for vname, val in [("bn1/moving_mean", rng.rand(4)),
+                           ("bn1/moving_variance", 1.0 + rng.rand(4)),
+                           ("bn1/gamma", 1.0 + 0.3 * rng.rand(4)),
+                           ("bn1/beta", rng.rand(4))]:
+            var = [v for v in stf.global_variables()
+                   if v.var_name == vname][0]
+            sess.run(stf.assign(var, val.astype(np.float32)))
+        img = rng.rand(2, 8, 8, 3).astype(np.float32)
+        ref = sess.run(out, {x: img})
+        ckpt = stf.train.Saver().save(sess, str(tmp_path / "m"))
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+
+        frozen = tools.freeze_graph_def(
+            gd, {k.replace("|", "/"): v
+                 for k, v in np.load(ckpt + ".stfz").items()}, "out")
+        opt = tools.optimize_for_inference(frozen, "img", "out")
+        ops = [n["op"] for n in opt["node"]]
+        assert "FusedBatchNorm" not in ops, ops
+        # pass-through removal: the only Identity left is the protected
+        # output node itself
+        identities = [n["name"] for n in opt["node"]
+                      if n["op"] == "Identity"]
+        assert identities == ["out"], identities
+        assert "BiasAdd" in ops and "Conv2D" in ops
+
+        stf.reset_default_graph()
+        (out_t,) = graph_io.import_graph_def(opt, return_elements=["out:0"],
+                                             name="")
+        x_t = stf.get_default_graph().as_graph_element("img:0")
+        with stf.Session() as s2:
+            folded = s2.run(out_t, {x_t: img})
+        np.testing.assert_allclose(folded, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestEstimatorExport:
+    def _model_fn(self, features, labels, mode, params=None):
+        from simple_tensorflow_tpu import estimator as est
+
+        w = stf.get_variable("w", [2, 1], initializer=stf.zeros_initializer())
+        pred = stf.matmul(features["x"], w)
+        if mode == est.ModeKeys.PREDICT:
+            return est.EstimatorSpec(mode, predictions={"pred": pred})
+        loss = stf.reduce_mean(stf.square(pred - labels))
+        gs = stf.train.get_or_create_global_step()
+        train_op = stf.train.GradientDescentOptimizer(0.2).minimize(
+            loss, global_step=gs)
+        return est.EstimatorSpec(mode, loss=loss, train_op=train_op,
+                                 predictions={"pred": pred})
+
+    def test_export_load_predict_roundtrip(self, tmp_path):
+        from simple_tensorflow_tpu import estimator as est
+        from simple_tensorflow_tpu import saved_model as sm
+
+        rng = np.random.RandomState(0)
+        X = rng.rand(32, 2).astype(np.float32)
+        Y = X @ np.float32([[1.0], [2.0]])
+
+        def input_fn():
+            from simple_tensorflow_tpu import data as stf_data
+
+            ds = stf_data.Dataset.from_tensor_slices(
+                {"x": X, "y": Y}).repeat().batch(8)
+            f = ds.make_one_shot_iterator().get_next()
+            return {"x": f["x"]}, f["y"]
+
+        e = est.Estimator(self._model_fn, model_dir=str(tmp_path / "md"))
+        e.train(input_fn, steps=50)
+
+        receiver_fn = est.build_raw_serving_input_receiver_fn(
+            {"x": ([None, 2], stf.float32)})
+        export_dir = e.export_savedmodel(str(tmp_path / "export"),
+                                         receiver_fn)
+        assert os.path.isdir(export_dir)
+
+        # load the SavedModel in a fresh graph and serve
+        stf.reset_default_graph()
+        with stf.Session() as sess:
+            meta = sm.load(sess, [sm.tag_constants.SERVING], export_dir)
+            sig = meta["signature_def"][
+                sm.signature_constants.DEFAULT_SERVING_SIGNATURE_DEF_KEY]
+            x_name = sig["inputs"]["x"]["name"]
+            pred_name = sig["outputs"]["pred"]["name"]
+            out = sess.run(pred_name, {x_name: X})
+        np.testing.assert_allclose(out, Y, atol=0.2)
