@@ -1,0 +1,188 @@
+// Run-from-C: StfSessionRun equivalent (ref: tensorflow/c/c_api.h
+// TF_SessionRun, c_api.cc TF_SessionRun impl).
+//
+// The reference's C API executes graphs through its in-process C++
+// executor. Here the execution engine is XLA driven by the Python
+// runtime, so this shim embeds CPython (Py_InitializeEx for pure-C
+// hosts; PyGILState for processes that already run Python) and drives a
+// SavedModel through simple_tensorflow_tpu.runtime.c_session. The first
+// StfSessionRun jit-compiles the fetch subgraph into one XLA executable;
+// subsequent runs hit the executable cache — the same lifecycle as
+// DirectSession's executor cache (ref: direct_session.cc
+// GetOrCreateExecutors).
+//
+// Built as libstf_session.so (make -C runtime_cc session); kept out of
+// libstf_runtime.so so the core library has no libpython dependency.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "status_internal.h"
+#include "stf_c.h"
+
+struct StfRunSession {
+  long handle;
+};
+
+namespace {
+
+// Set an error status from the pending Python exception (clears it).
+void StatusFromPyErr(StfStatus* status, const char* what) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = what;
+  if (value != nullptr) {
+    PyObject* str = PyObject_Str(value);
+    if (str != nullptr) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(str);
+      Py_DECREF(str);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  stf_internal::Set(status, STF_INTERNAL, msg);
+}
+
+PyObject* CSessionModule(StfStatus* status) {
+  PyObject* mod = PyImport_ImportModule(
+      "simple_tensorflow_tpu.runtime.c_session");
+  if (mod == nullptr) {
+    StatusFromPyErr(status, "import simple_tensorflow_tpu failed "
+                            "(is it on sys.path / PYTHONPATH?)");
+  }
+  return mod;
+}
+
+}  // namespace
+
+StfRunSession* StfSessionLoad(const char* export_dir, StfStatus* status) {
+  stf_internal::Set(status, STF_OK, "");
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // pure-C host: embed the interpreter
+    // Py_InitializeEx leaves THIS thread holding the GIL; release it so
+    // other host threads' PyGILState_Ensure calls don't deadlock while
+    // this thread runs non-Python code. (The matching state is dropped:
+    // we never finalize an interpreter we share with the host process.)
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  StfRunSession* out = nullptr;
+  PyObject* mod = CSessionModule(status);
+  if (mod != nullptr) {
+    PyObject* res = PyObject_CallMethod(mod, "load", "s", export_dir);
+    if (res == nullptr) {
+      StatusFromPyErr(status, "StfSessionLoad failed");
+    } else {
+      out = new StfRunSession{PyLong_AsLong(res)};
+      Py_DECREF(res);
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  return out;
+}
+
+void StfSessionClose(StfRunSession* s) {
+  if (s == nullptr) return;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* mod = PyImport_ImportModule(
+        "simple_tensorflow_tpu.runtime.c_session");
+    if (mod != nullptr) {
+      PyObject* res = PyObject_CallMethod(mod, "close", "l", s->handle);
+      Py_XDECREF(res);
+      Py_DECREF(mod);
+    }
+    PyErr_Clear();
+    PyGILState_Release(gil);
+  }
+  delete s;
+}
+
+void StfSessionRun(StfRunSession* s, const char** feed_names,
+                   const StfTensorSpec* feeds, int n_feeds,
+                   const char** fetch_names, int n_fetches,
+                   StfTensorOut* outs, StfStatus* status) {
+  stf_internal::Set(status, STF_OK, "");
+  if (s == nullptr) {
+    stf_internal::Set(status, STF_INVALID_ARGUMENT, "null session");
+    return;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = CSessionModule(status);
+  if (mod == nullptr) {
+    PyGILState_Release(gil);
+    return;
+  }
+  PyObject* feed_list = PyList_New(n_feeds);
+  for (int i = 0; i < n_feeds; ++i) {
+    PyObject* shape = PyTuple_New(feeds[i].rank);
+    for (int d = 0; d < feeds[i].rank; ++d) {
+      PyTuple_SET_ITEM(shape, d,
+                       PyLong_FromLongLong(feeds[i].dims[d]));
+    }
+    PyObject* item = Py_BuildValue(
+        "(ssOKn)", feed_names[i], feeds[i].dtype, shape,
+        (unsigned long long)(uintptr_t)feeds[i].data,
+        (Py_ssize_t)feeds[i].nbytes);
+    Py_DECREF(shape);
+    PyList_SET_ITEM(feed_list, i, item);
+  }
+  PyObject* fetch_list = PyList_New(n_fetches);
+  for (int i = 0; i < n_fetches; ++i) {
+    PyList_SET_ITEM(fetch_list, i, PyUnicode_FromString(fetch_names[i]));
+  }
+  PyObject* res = PyObject_CallMethod(mod, "run", "lOO", s->handle,
+                                      feed_list, fetch_list);
+  Py_DECREF(feed_list);
+  Py_DECREF(fetch_list);
+  Py_DECREF(mod);
+  if (res == nullptr) {
+    StatusFromPyErr(status, "StfSessionRun failed");
+    PyGILState_Release(gil);
+    return;
+  }
+  // res: list of (dtype_str, shape_tuple, bytes)
+  for (int i = 0; i < n_fetches; ++i) {
+    std::memset(&outs[i], 0, sizeof(StfTensorOut));
+    PyObject* item = PyList_GetItem(res, i);  // borrowed
+    PyObject* dtype = PyTuple_GetItem(item, 0);
+    PyObject* shape = PyTuple_GetItem(item, 1);
+    PyObject* data = PyTuple_GetItem(item, 2);
+    std::snprintf(outs[i].dtype, sizeof(outs[i].dtype), "%s",
+                  PyUnicode_AsUTF8(dtype));
+    int rank = (int)PyTuple_Size(shape);
+    if (rank > 8) {
+      stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                        "fetch rank > 8 unsupported by StfTensorOut");
+      Py_DECREF(res);
+      PyGILState_Release(gil);
+      return;
+    }
+    outs[i].rank = rank;
+    for (int d = 0; d < rank; ++d) {
+      outs[i].dims[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+    }
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    PyBytes_AsStringAndSize(data, &buf, &n);
+    outs[i].nbytes = (size_t)n;
+    outs[i].data = std::malloc((size_t)n);
+    std::memcpy(outs[i].data, buf, (size_t)n);
+  }
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+}
+
+void StfTensorOutRelease(StfTensorOut* t) {
+  if (t != nullptr && t->data != nullptr) {
+    std::free(t->data);
+    t->data = nullptr;
+  }
+}

@@ -128,6 +128,41 @@ STF_EXPORT int64_t StfGraphNumNodes(const StfGraph*);
 STF_EXPORT const char* StfGraphToJson(StfGraph*, size_t* n,
                                       StfStatus* status);
 
+/* ---- run from C (ref TF_SessionRun) ---------------------------------
+ * Provided by libstf_session.so (make session), NOT libstf_runtime.so:
+ * the implementation embeds CPython to drive the XLA executable (like
+ * TF serving embeds its runtime), so it links libpython. Load a
+ * SavedModel, feed host buffers, fetch results; the first run compiles
+ * the fetch subgraph to one XLA executable, later runs hit the cache. */
+
+typedef struct StfTensorSpec {
+  const char* dtype;    /* numpy dtype name, e.g. "float32" */
+  int rank;
+  const int64_t* dims;
+  const void* data;
+  size_t nbytes;
+} StfTensorSpec;
+
+typedef struct StfTensorOut {
+  char dtype[16];
+  int rank;
+  int64_t dims[8];
+  void* data;     /* malloc'd; release with StfTensorOutRelease */
+  size_t nbytes;
+} StfTensorOut;
+
+typedef struct StfRunSession StfRunSession;
+
+STF_EXPORT StfRunSession* StfSessionLoad(const char* export_dir,
+                                         StfStatus* status);
+STF_EXPORT void StfSessionClose(StfRunSession*);
+/* feed/fetch names: serving-signature keys or raw "tensor:0" names. */
+STF_EXPORT void StfSessionRun(StfRunSession*, const char** feed_names,
+                              const StfTensorSpec* feeds, int n_feeds,
+                              const char** fetch_names, int n_fetches,
+                              StfTensorOut* outs, StfStatus* status);
+STF_EXPORT void StfTensorOutRelease(StfTensorOut*);
+
 #ifdef __cplusplus
 }
 #endif

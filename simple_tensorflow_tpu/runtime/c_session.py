@@ -1,0 +1,74 @@
+"""Python side of the run-from-C bridge (runtime_cc/session_c.cc).
+
+``StfSessionLoad`` → :func:`load`, ``StfSessionRun`` → :func:`run` — a
+registry of live Sessions serving SavedModels to C callers (ref:
+tensorflow/c/c_api.h TF_SessionRun; the reference executes through its
+C++ executor, we execute through the Session's cached XLA executable).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+_sessions = {}
+_lock = threading.Lock()
+_next_id = [1]
+
+
+def load(export_dir: str) -> int:
+    """Load a SavedModel (SERVING tag); returns a session handle."""
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import saved_model as sm
+    from simple_tensorflow_tpu.framework import graph as ops_mod
+
+    g = ops_mod.Graph()
+    with g.as_default():
+        sess = stf.Session(graph=g)
+        meta = sm.load(sess, [sm.tag_constants.SERVING], export_dir)
+    sig = (meta.get("signature_def") or {}).get(
+        sm.signature_constants.DEFAULT_SERVING_SIGNATURE_DEF_KEY, {})
+    with _lock:
+        sid = _next_id[0]
+        _next_id[0] += 1
+        _sessions[sid] = (sess, g, sig)
+    return sid
+
+
+def _resolve(sig_map, name):
+    """Signature key -> tensor name; raw tensor names pass through."""
+    if name in sig_map:
+        return sig_map[name]["name"]
+    return name
+
+
+def run(sid: int, feeds, fetch_names):
+    """feeds: [(name, dtype_str, shape_tuple, addr_int, nbytes)] reading
+    the caller's buffers zero-copy; returns [(dtype, shape, bytes)]."""
+    with _lock:
+        sess, g, sig = _sessions[sid]
+    feed_dict = {}
+    for name, dtype, shape, addr, nbytes in feeds:
+        buf = (ctypes.c_char * nbytes).from_address(addr)
+        arr = np.frombuffer(buf, dtype=np.dtype(dtype))
+        arr = arr.reshape(tuple(int(d) for d in shape))
+        t = g.as_graph_element(_resolve(sig.get("inputs", {}), name),
+                               allow_tensor=True, allow_operation=False)
+        feed_dict[t] = arr
+    fetches = [_resolve(sig.get("outputs", {}), n) for n in fetch_names]
+    outs = sess.run(fetches, feed_dict)
+    res = []
+    for o in outs:
+        a = np.ascontiguousarray(np.asarray(o))
+        res.append((str(a.dtype), tuple(int(d) for d in a.shape),
+                    a.tobytes()))
+    return res
+
+
+def close(sid: int) -> None:
+    with _lock:
+        entry = _sessions.pop(sid, None)
+    if entry is not None:
+        entry[0].close()

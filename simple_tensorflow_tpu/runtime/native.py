@@ -289,6 +289,67 @@ class Arena:
             pass
 
 
+class ArenaPool:
+    """Rotating pool of arenas for host→device staging buffers (the
+    pinned-staging role of the reference's GPU host allocator,
+    ref core/common_runtime/gpu/gpu_host_allocator.h).
+
+    ``stage(x)`` copies a numpy batch (array / tuple / dict) into
+    64-byte-aligned arena memory. A slot is recycled only after the
+    device transfers recorded against it via ``mark_in_flight`` have
+    completed (``jax.block_until_ready`` before reset) — the recycle
+    barrier, not a timing assumption. NOT safe with backends whose
+    device_put zero-copy ALIASES host buffers (CPU does, measured): the
+    alias outlives any barrier. Callers must gate on the backend."""
+
+    def __init__(self, slots: int = 4, block_bytes: int = 1 << 22):
+        self._arenas = [Arena(block_bytes) for _ in range(slots)]
+        self._inflight: List = [None] * slots
+        self._i = 0
+        self._last_slot = 0
+
+    def _next(self) -> Arena:
+        import jax
+
+        slot = self._i
+        self._i = (self._i + 1) % len(self._arenas)
+        pending = self._inflight[slot]
+        if pending is not None:
+            # the DMA out of this slot's memory must finish before reuse
+            jax.block_until_ready(pending)
+            self._inflight[slot] = None
+        a = self._arenas[slot]
+        a.reset()
+        self._last_slot = slot
+        return a
+
+    def stage(self, x):
+        arena = self._next()
+
+        def copy(a):
+            if isinstance(a, tuple):
+                return tuple(copy(e) for e in a)
+            if isinstance(a, dict):
+                return {k: copy(e) for k, e in a.items()}
+            a = np.asarray(a)
+            if a.dtype.hasobject or a.dtype.kind in "USV":
+                return a  # strings stay host-side; nothing to stage
+            out = arena.alloc_ndarray(a.shape, a.dtype)
+            np.copyto(out, a)
+            return out
+
+        return copy(x)
+
+    def mark_in_flight(self, device_arrays) -> None:
+        """Record the device arrays produced from the last staged slot;
+        their readiness gates that slot's recycling."""
+        self._inflight[self._last_slot] = device_arrays
+
+    def close(self):
+        for a in self._arenas:
+            a.close()
+
+
 def prune_toposort(n_nodes: int, edges: np.ndarray,
                    targets: Sequence[int]) -> Optional[List[int]]:
     """Topo order of dependency-ancestors of ``targets``.
@@ -307,6 +368,64 @@ def prune_toposort(n_nodes: int, edges: np.ndarray,
     if n < 0:
         return None
     return out[:n].tolist()
+
+
+_session_lib = None
+_session_tried = False
+# own lock: the session-lib build can take minutes and must not stall
+# unrelated native calls serialized on _lock
+_session_lock = threading.Lock()
+
+
+def load_session_lib():
+    """libstf_session.so: the run-from-C entry points (StfSessionLoad/
+    Run/Close, ref TF_SessionRun). Separate from libstf_runtime.so
+    because it links libpython (the shim embeds CPython to drive the XLA
+    executable). Returns the ctypes lib or None."""
+    global _session_lib, _session_tried
+    with _session_lock:
+        if _session_lib is not None or _session_tried:
+            return _session_lib
+        _session_tried = True
+        if os.environ.get("STF_DISABLE_NATIVE"):
+            return None
+        path = os.path.join(_CC_DIR, "libstf_session.so")
+        if not os.path.exists(path):
+            try:
+                subprocess.run(["make", "-C", _CC_DIR, "session"],
+                               check=True, capture_output=True, timeout=240)
+            except Exception:
+                return None
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path, mode=ctypes.RTLD_GLOBAL)
+        except OSError:
+            return None
+        c = ctypes
+        lib.StfSessionLoad.argtypes = [c.c_char_p, c.c_void_p]
+        lib.StfSessionLoad.restype = c.c_void_p
+        lib.StfSessionClose.argtypes = [c.c_void_p]
+        lib.StfSessionRun.argtypes = [
+            c.c_void_p, c.POINTER(c.c_char_p), c.c_void_p, c.c_int,
+            c.POINTER(c.c_char_p), c.c_int, c.c_void_p, c.c_void_p]
+        lib.StfTensorOutRelease.argtypes = [c.c_void_p]
+        _session_lib = lib
+        return lib
+
+
+class CTensorSpec(ctypes.Structure):
+    """Mirror of StfTensorSpec (runtime_cc/session_c.cc)."""
+    _fields_ = [("dtype", ctypes.c_char_p), ("rank", ctypes.c_int),
+                ("dims", ctypes.POINTER(ctypes.c_int64)),
+                ("data", ctypes.c_void_p), ("nbytes", ctypes.c_size_t)]
+
+
+class CTensorOut(ctypes.Structure):
+    """Mirror of StfTensorOut (runtime_cc/session_c.cc)."""
+    _fields_ = [("dtype", ctypes.c_char * 16), ("rank", ctypes.c_int),
+                ("dims", ctypes.c_int64 * 8),
+                ("data", ctypes.c_void_p), ("nbytes", ctypes.c_size_t)]
 
 
 class CGraph:

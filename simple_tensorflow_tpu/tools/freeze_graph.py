@@ -22,9 +22,9 @@ from . import graph_rewrite as gr
 
 
 def _load_checkpoint_values(checkpoint_prefix) -> dict:
-    # npz keys are '/'-flattened with '|' (train/saver.py save path)
-    with np.load(checkpoint_prefix + ".stfz", allow_pickle=False) as data:
-        return {k.replace("|", "/"): data[k] for k in data.files}
+    from ..train.saver import load_checkpoint_values
+
+    return load_checkpoint_values(checkpoint_prefix)
 
 
 def freeze_graph_def(graph_def, var_values, output_node_names):

@@ -25,10 +25,9 @@ def print_tensors_in_checkpoint_file(file_name, tensor_name=None,
               "stf.train.Saver(backend='orbax').restore or "
               "orbax.checkpoint utilities to inspect", file=out)
         return {}
-    path = file_name if file_name.endswith(".stfz") else file_name + ".stfz"
-    with np.load(path, allow_pickle=False) as data:
-        # npz keys are '/'-flattened with '|' (train/saver.py save path)
-        tensors = {k.replace("|", "/"): data[k] for k in data.files}
+    from ..train.saver import load_checkpoint_values
+
+    tensors = load_checkpoint_values(file_name)
     if tensor_name is not None:
         if tensor_name not in tensors:
             raise ValueError(f"tensor {tensor_name!r} not in checkpoint; "

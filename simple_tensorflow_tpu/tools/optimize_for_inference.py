@@ -54,6 +54,10 @@ def remove_training_nodes(graph_def, protected=()):
 
     for node in kept:
         node["input"] = [resolve(ref) for ref in node["input"]]
+        # control deps on a spliced-out node follow the redirect to its
+        # ultimate producer (otherwise the prune hits a dangling name)
+        node["control_input"] = [gr.producer_name(resolve(c))
+                                 for c in node["control_input"]]
     return {"versions": dict(graph_def.get("versions", {"producer": 1})),
             "node": kept}
 

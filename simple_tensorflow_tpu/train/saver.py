@@ -72,6 +72,19 @@ def checkpoint_exists(checkpoint_prefix):
             os.path.isdir(checkpoint_prefix + ".orbax"))
 
 
+def load_checkpoint_values(checkpoint_prefix):
+    """{variable_name: ndarray} from an stf-bundle checkpoint — the ONE
+    place that knows npz keys are '/'-flattened with '|' (the save path
+    below writes them that way). Tools (freeze_graph, inspect_checkpoint)
+    read through this."""
+    import numpy as np
+
+    path = (checkpoint_prefix if checkpoint_prefix.endswith(".stfz")
+            else checkpoint_prefix + ".stfz")
+    with np.load(path, allow_pickle=False) as data:
+        return {k.replace("|", "/"): data[k] for k in data.files}
+
+
 def _capture_host_state(sess):
     """Session RNG position + data-iterator positions (SURVEY §5: resume
     restores global_step, optimizer slots, RNG key, data-pipeline epoch).

@@ -1,0 +1,120 @@
+"""ClusterSpec/Server mapping and failure-detection behavior
+(ref: python/training/server_lib.py:189 ClusterSpec,
+core/distributed_runtime session-management failure semantics)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from simple_tensorflow_tpu.framework.errors import (DeadlineExceededError,
+                                                    UnavailableError)
+from simple_tensorflow_tpu.parallel.failure_detection import (Heartbeat,
+                                                              StepWatchdog)
+from simple_tensorflow_tpu.train import server_lib
+
+
+class TestClusterSpec:
+    def test_from_dict_lists(self):
+        cs = server_lib.ClusterSpec(
+            {"worker": ["w0:2222", "w1:2222"], "eval": ["e0:2222"]})
+        assert sorted(cs.jobs) == ["eval", "worker"]
+        assert cs.num_tasks("worker") == 2
+        assert cs.task_indices("worker") == [0, 1]
+        assert cs.task_address("worker", 1) == "w1:2222"
+        assert cs.job_tasks("worker") == ["w0:2222", "w1:2222"]
+        assert cs.as_dict() == {"worker": ["w0:2222", "w1:2222"],
+                                "eval": ["e0:2222"]}
+
+    def test_from_sparse_task_dict(self):
+        # TF allows sparse task indices: {"worker": {1: "w1", 3: "w3"}}
+        cs = server_lib.ClusterSpec({"worker": {3: "w3:2222", 1: "w1:2222"}})
+        assert cs.task_indices("worker") == [1, 3]
+        assert cs.job_tasks("worker") == ["w1:2222", "w3:2222"]
+        assert cs.task_address("worker", 3) == "w3:2222"
+
+    def test_copy_and_equality(self):
+        a = server_lib.ClusterSpec({"worker": ["w0"]})
+        b = server_lib.ClusterSpec(a)
+        assert a == b and a is not b
+        assert bool(a)
+        assert not bool(server_lib.ClusterSpec({}))
+
+    def test_rejects_non_dict(self):
+        with pytest.raises(TypeError):
+            server_lib.ClusterSpec(["w0:2222"])
+
+
+class TestServer:
+    def test_ps_job_rejected_with_guidance(self):
+        with pytest.raises(ValueError, match="fsdp"):
+            server_lib.Server({"worker": ["w0:1"], "ps": ["p0:1"]},
+                              start=False)
+
+    def test_single_worker_start_is_local_noop(self):
+        # one worker: no jax.distributed.initialize, start() succeeds
+        old = server_lib.Server._started
+        server_lib.Server._started = False
+        try:
+            s = server_lib.Server({"worker": ["localhost:0"]}, start=True)
+            assert server_lib.Server._started
+            assert s.target == "stf://worker:0"
+            sd = s.server_def
+            assert sd.job_name == "worker" and sd.task_index == 0
+            assert sd.cluster.as_dict() == {"worker": ["localhost:0"]}
+        finally:
+            server_lib.Server._started = old
+
+    def test_create_local_server(self):
+        old = server_lib.Server._started
+        server_lib.Server._started = False
+        try:
+            s = server_lib.Server.create_local_server()
+            assert s.target.startswith("stf://worker")
+        finally:
+            server_lib.Server._started = old
+
+
+class TestHeartbeat:
+    def test_beat_and_check(self):
+        hb = Heartbeat(interval_secs=0.01)
+        hb.beat()
+        hb.check(hb.last_beat, max_age_secs=5.0)  # fresh: no raise
+        stale = time.time() - 60.0
+        with pytest.raises(UnavailableError, match="presumed dead"):
+            hb.check(stale, max_age_secs=10.0)
+
+    def test_background_thread_stamps(self):
+        hb = Heartbeat(interval_secs=0.01).start()
+        try:
+            before = hb.last_beat
+            time.sleep(0.1)
+            assert hb.last_beat > before
+        finally:
+            hb.stop()
+
+
+class TestStepWatchdog:
+    def test_fires_on_stall_and_raises_at_step_done(self):
+        fired = []
+        wd = StepWatchdog(deadline_secs=0.05, poll_secs=0.01,
+                          on_timeout=lambda stalled: fired.append(stalled))
+        wd.start()
+        try:
+            time.sleep(0.2)  # stall past the deadline
+            assert wd.timed_out
+            assert fired and fired[0] > 0.05
+            with pytest.raises(DeadlineExceededError, match="deadline"):
+                wd.step_done()
+        finally:
+            wd.stop()
+
+    def test_regular_steps_keep_it_quiet(self):
+        wd = StepWatchdog(deadline_secs=0.2, poll_secs=0.01).start()
+        try:
+            for _ in range(5):
+                time.sleep(0.02)
+                wd.step_done()
+            assert not wd.timed_out
+        finally:
+            wd.stop()

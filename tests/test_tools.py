@@ -214,3 +214,28 @@ class TestEstimatorExport:
             pred_name = sig["outputs"]["pred"]["name"]
             out = sess.run(pred_name, {x_name: X})
         np.testing.assert_allclose(out, Y, atol=0.2)
+
+
+def test_remove_training_nodes_follows_control_deps(tmp_path):
+    """Control deps on a spliced-out Identity must redirect to its
+    producer, not dangle (would fail the prune)."""
+    from simple_tensorflow_tpu.tools.optimize_for_inference import (
+        remove_training_nodes)
+
+    stf.reset_default_graph()
+    x = stf.placeholder(stf.float32, [2], name="cx")
+    a = stf.identity(x, name="id1")
+    g = stf.get_default_graph()
+    with g.control_dependencies([a.op]):
+        out = stf.add(x, stf.constant(np.float32([1, 1])), name="cout")
+    gd = graph_io.graph_to_graphdef(g)
+    cleaned = remove_training_nodes(gd, protected=["cout"])
+    names = {n["name"] for n in cleaned["node"]}
+    assert "id1" not in names
+    cout = next(n for n in cleaned["node"] if n["name"] == "cout")
+    assert all(c in names for c in cout["control_input"]), cout
+    # and the prune that optimize_for_inference runs afterwards succeeds
+    from simple_tensorflow_tpu.tools import graph_rewrite as gr
+
+    pruned = gr.prune_to(cleaned, ["cout"])
+    assert "cout" in {n["name"] for n in pruned["node"]}
