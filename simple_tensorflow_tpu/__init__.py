@@ -35,6 +35,7 @@ from .framework.random_seed import set_random_seed
 from .framework.gradients import gradients, AggregationMethod, GradientTape
 from .framework.indexed_slices import IndexedSlices
 from .framework.sparse_tensor import SparseTensor, SparseTensorValue
+from .framework.config_pb import ConfigProto, GPUOptions, GraphOptions
 
 # ops: import registers lowerings; re-export the tf-1.x flat namespace
 from .ops import state_ops

@@ -211,6 +211,7 @@ class BaseSession:
     def __init__(self, target="", graph=None, config=None):
         self._graph = graph or ops_mod.get_default_graph()
         self._config = config
+        self._guard_warned: Set[str] = set()
         self._variable_store = VariableStore()
         self._cache: Dict[Any, _CompiledStep] = {}
         self._closed = False
@@ -383,6 +384,22 @@ class BaseSession:
             feed_args = {}
             for t in step.feed_tensors:
                 val = feeds[t] if t in feeds else host_env[t]
+                if step.n_calls >= 2 and isinstance(val, np.ndarray):
+                    # hot path (compiled + warm): a big host-numpy feed
+                    # means an H2D transfer EVERY step
+                    self._transfer_guard(t.name, val.nbytes, "feed")
+            if step.n_calls >= 2:
+                # fetch guard runs BEFORE execution (sizes from static
+                # shapes) so a "disallow" raise cannot land after the
+                # variable updates commit; dynamic-shaped fetches are
+                # unguarded by design
+                for t in step.device_fetches:
+                    n_el = t.shape.num_elements()
+                    if n_el is not None:
+                        self._transfer_guard(
+                            t.name, n_el * t.dtype.base_dtype.size, "fetch")
+            for t in step.feed_tensors:
+                val = feeds[t] if t in feeds else host_env[t]
                 feed_args[t.name] = self._maybe_shard_feed(t, val)
             state = self._variable_store.values
             d_t0 = time.perf_counter()
@@ -465,6 +482,35 @@ class BaseSession:
                     raise errors.InternalError(
                         None, e.op, f"Fetch {e.name} produced no value")
         return out
+
+    def _transfer_guard(self, name: str, nbytes: int, direction: str):
+        """L0 transfer guard (SURVEY §1 L0): per-step host↔device
+        transfers above the configured threshold are the classic silent
+        TPU bottleneck. Modes (ConfigProto.transfer_guard): "allow" (off),
+        "log" (warn once per tensor), "disallow" (raise with guidance)."""
+        cfg = self._config
+        mode = getattr(cfg, "transfer_guard", "allow") if cfg else "allow"
+        if mode == "allow":
+            return
+        threshold = getattr(cfg, "transfer_guard_threshold_bytes", 1 << 20)
+        if nbytes < threshold:
+            return
+        if direction == "feed":
+            hint = ("stage batches on device via "
+                    "stf.data.Dataset.prefetch_to_device (or feed "
+                    "jax.Arrays) instead of per-step host numpy")
+        else:
+            hint = ("keep large results on device: fetch reduced "
+                    "values, or consume the tensor in a later step")
+        msg = (f"transfer guard: {direction} {name!r} moves {nbytes} "
+               f"bytes host<->device EVERY step; {hint}")
+        if mode == "disallow":
+            raise errors.InvalidArgumentError(None, None, msg)
+        if name not in self._guard_warned:
+            self._guard_warned.add(name)
+            from ..platform import tf_logging as logging
+
+            logging.warning(msg)
 
     def _maybe_shard_feed(self, tensor, value):
         """shard_feed-annotated placeholders: place the global batch with its
@@ -618,6 +664,15 @@ class BaseSession:
                     const_for_host.append(t.op)
         step.host_plan = const_for_host + pre_host
         step.post_host_plan = post_host
+        if self._config is not None and getattr(
+                self._config, "log_device_placement", False):
+            from ..platform import tf_logging as logging
+
+            for op, stage in ([(o, "host(pre)") for o in step.host_plan]
+                              + [(o, "device:TPU") for o in device_ops]
+                              + [(o, "host(post)") for o in post_host]):
+                logging.info("placement: %s (%s) -> %s", op.name, op.type,
+                             stage)
         # Device tensors needed by post-host ops become extra device fetches.
         post_needs: List[Tensor] = []
         seen_pn: Set[Tensor] = set()

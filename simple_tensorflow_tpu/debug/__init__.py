@@ -8,8 +8,13 @@ execution is one XLA program, so debugging hooks differently:
 - add_check_numerics_ops / enable_check_numerics: jax debug_nans-style
   host-callback checks on every floating tensor.
 - watch list: name-filtered subsets.
+- DebugDumpDir (debug/analyzer.py): offline analysis of dump dirs —
+  list/query/filter (has_inf_or_nan) across runs, with a CLI
+  (`python -m simple_tensorflow_tpu.debug.analyzer`) — the analog of
+  tfdbg's analyzer/CLI layer (ref python/debug/lib + cli).
 """
 
+from .analyzer import DebugDumpDir, DebugTensorDatum
 from .wrappers import (DumpingDebugWrapperSession, LocalCLIDebugWrapperSession,
                        TensorWatch, add_check_numerics_ops,
                        has_inf_or_nan)

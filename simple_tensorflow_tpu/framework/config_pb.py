@@ -1,0 +1,76 @@
+"""Session configuration (ref: tensorflow/core/protos/config.proto
+``ConfigProto`` and python/client usage ``tf.Session(config=...)``).
+
+Thread-pool and GPU knobs from the reference are accepted for API
+compatibility but are advisory here — XLA owns scheduling on TPU. The
+TPU-meaningful additions are the L0 transfer guards: per-step host↔device
+transfers are the classic silent TPU performance killer (feeding numpy
+every step instead of staging via data.prefetch_to_device; fetching big
+activations to host), so the Session can log or reject implicit
+transfers above a threshold on the hot path.
+"""
+
+from __future__ import annotations
+
+
+class GPUOptions:
+    """(ref: config.proto ``GPUOptions``) — accepted, advisory on TPU."""
+
+    def __init__(self, per_process_gpu_memory_fraction=0.0,
+                 allow_growth=False, allocator_type="",
+                 visible_device_list=""):
+        self.per_process_gpu_memory_fraction = per_process_gpu_memory_fraction
+        self.allow_growth = allow_growth
+        self.allocator_type = allocator_type
+        self.visible_device_list = visible_device_list
+
+
+class GraphOptions:
+    """(ref: config.proto ``GraphOptions``)."""
+
+    def __init__(self, enable_recv_scheduling=False, build_cost_model=0,
+                 infer_shapes=False, place_pruned_graph=False,
+                 optimizer_options=None):
+        self.enable_recv_scheduling = enable_recv_scheduling
+        self.build_cost_model = build_cost_model
+        self.infer_shapes = infer_shapes
+        self.place_pruned_graph = place_pruned_graph
+        self.optimizer_options = optimizer_options
+
+
+class ConfigProto:
+    """(ref: config.proto ``ConfigProto``).
+
+    transfer_guard: "allow" (default) | "log" | "disallow" — applied by
+    Session.run on the HOT path (after the step is compiled and warm) to
+    host-numpy feeds and host fetches larger than
+    ``transfer_guard_threshold_bytes``. "log" warns once per tensor;
+    "disallow" raises InvalidArgumentError with staging guidance.
+    """
+
+    def __init__(self, device_count=None, intra_op_parallelism_threads=0,
+                 inter_op_parallelism_threads=0, use_per_session_threads=False,
+                 session_inter_op_thread_pool=None, placement_period=0,
+                 device_filters=None, gpu_options=None,
+                 allow_soft_placement=False, log_device_placement=False,
+                 graph_options=None, operation_timeout_in_ms=0,
+                 transfer_guard="allow",
+                 transfer_guard_threshold_bytes=1 << 20):
+        self.device_count = dict(device_count or {})
+        self.intra_op_parallelism_threads = intra_op_parallelism_threads
+        self.inter_op_parallelism_threads = inter_op_parallelism_threads
+        self.use_per_session_threads = use_per_session_threads
+        self.session_inter_op_thread_pool = session_inter_op_thread_pool
+        self.placement_period = placement_period
+        self.device_filters = list(device_filters or [])
+        self.gpu_options = gpu_options or GPUOptions()
+        self.allow_soft_placement = allow_soft_placement
+        self.log_device_placement = log_device_placement
+        self.graph_options = graph_options or GraphOptions()
+        self.operation_timeout_in_ms = operation_timeout_in_ms
+        if transfer_guard not in ("allow", "log", "disallow"):
+            raise ValueError(
+                f"transfer_guard must be allow|log|disallow, "
+                f"got {transfer_guard!r}")
+        self.transfer_guard = transfer_guard
+        self.transfer_guard_threshold_bytes = transfer_guard_threshold_bytes

@@ -7,7 +7,8 @@ from ..ops.nn_ops import (
     sparse_softmax_cross_entropy_with_logits,
     sigmoid_cross_entropy_with_logits, weighted_cross_entropy_with_logits,
     conv2d, depthwise_conv2d, depthwise_conv2d_native, separable_conv2d,
-    conv3d, conv2d_transpose, atrous_conv2d,
+    conv3d, conv2d_transpose, conv3d_transpose, atrous_conv2d,
+    dilation2d, erosion2d,
     max_pool, avg_pool, max_pool3d, avg_pool3d,
     dropout, local_response_normalization, lrn, in_top_k, top_k,
     xw_plus_b, log_poisson_loss,

@@ -130,17 +130,122 @@ def _conv3d_impl(x, w, strides=(1, 1, 1, 1, 1), padding="SAME"):
 op_registry.register_pure("Conv3D", _conv3d_impl)
 
 
+def _conv_transpose_impl(x, w, output_shape, spatial_strides, padding,
+                         dim_nums):
+    """Transposed conv. Without output_shape: lax.conv_transpose (SAME
+    stride-s output = in*s). WITH output_shape, sizes like in*s-1 are
+    ambiguous inverses and the pad split differs by parity — so compute
+    it definitionally as the vjp of the FORWARD conv over an
+    output_shape-sized input (XLA folds the vjp into one conv). TF
+    transpose filter layout (…,OUT,IN) read as the fwd conv's I=OUT,
+    O=IN filter."""
+    if output_shape is None:
+        out = jax.lax.conv_transpose(
+            x, w, strides=spatial_strides, padding=padding,
+            dimension_numbers=dim_nums, transpose_kernel=True)
+        return out.astype(x.dtype)
+    output_shape = builtins.tuple(int(d) for d in output_shape)
+
+    def fwd(y):
+        return jax.lax.conv_general_dilated(
+            y, w, window_strides=spatial_strides, padding=padding,
+            dimension_numbers=dim_nums)
+
+    primal = jnp.zeros(output_shape, x.dtype)
+    out_aval = jax.eval_shape(fwd, primal)
+    if out_aval.shape != x.shape:
+        raise ValueError(
+            f"conv transpose: output_shape {output_shape} is inconsistent "
+            f"— the forward conv would produce {out_aval.shape}, but the "
+            f"input has shape {x.shape}")
+    _, vjp = jax.vjp(fwd, primal)
+    (dx,) = vjp(x)
+    return dx.astype(x.dtype)
+
+
 def _conv2d_transpose_impl(x, w, output_shape=None, strides=(1, 1, 1, 1),
                            padding="SAME"):
-    sh, sw = strides[1:3]
-    out = jax.lax.conv_transpose(
-        x, w, strides=(sh, sw), padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        transpose_kernel=True)
-    return out.astype(x.dtype)
+    return _conv_transpose_impl(
+        x, w, output_shape, builtins.tuple(strides[1:3]), padding,
+        ("NHWC", "HWIO", "NHWC"))
 
 
 op_registry.register_pure("Conv2DBackpropInput", _conv2d_transpose_impl)
+
+
+def _conv3d_transpose_impl(x, w, output_shape=None,
+                           strides=(1, 1, 1, 1, 1), padding="SAME"):
+    return _conv_transpose_impl(
+        x, w, output_shape, builtins.tuple(strides[1:4]), padding,
+        ("NDHWC", "DHWIO", "NDHWC"))
+
+
+op_registry.register_pure("Conv3DBackpropInput", _conv3d_transpose_impl)
+
+
+def _dilation2d_impl(x, f, strides=(1, 1, 1, 1), rates=(1, 1, 1, 1),
+                     padding="SAME"):
+    """Grayscale morphological dilation (ref core/kernels/dilation_ops.cc):
+    out[b,y,x,c] = max_{i,j}( in[b, y*s+i*r, x*s+j*r, c] + f[i,j,c] ).
+
+    The additive filter makes this not a plain reduce_window; for the
+    small morphology kernels it lowers to kh*kw shifted adds + a max
+    tree — all static slices, VPU-friendly."""
+    kh, kw, _ = f.shape
+    sh, sw = builtins.tuple(strides[1:3])
+    rh, rw = builtins.tuple(rates[1:3])
+    eh, ew = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+    n, h, w_dim, c = x.shape
+    if padding == "SAME":
+        out_h = -(-h // sh)
+        out_w = -(-w_dim // sw)
+        pad_h = builtins.max((out_h - 1) * sh + eh - h, 0)
+        pad_w = builtins.max((out_w - 1) * sw + ew - w_dim, 0)
+        pt, pl = pad_h // 2, pad_w // 2
+        pb, pr = pad_h - pt, pad_w - pl
+    else:
+        out_h = (h - eh) // sh + 1
+        out_w = (w_dim - ew) // sw + 1
+        pt = pl = pb = pr = 0
+    # Padded taps are EXCLUDED via a validity mask, not an additive
+    # sentinel: adding f to a signed iinfo.min wraps around and a uint
+    # "min" of 0 is not neutral — both would corrupt border outputs.
+    sentinel = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                else jnp.iinfo(x.dtype).min)
+    xp = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    valid = jnp.pad(jnp.ones(x.shape, bool),
+                    ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    res = None
+    for i in builtins.range(kh):
+        for j in builtins.range(kw):
+            limits = (n, i * rh + (out_h - 1) * sh + 1,
+                      j * rw + (out_w - 1) * sw + 1, c)
+            sl = jax.lax.slice(xp, (0, i * rh, j * rw, 0), limits,
+                               (1, sh, sw, 1))
+            vl = jax.lax.slice(valid, (0, i * rh, j * rw, 0), limits,
+                               (1, sh, sw, 1))
+            cand = jnp.where(vl, sl + f[i, j, :], sentinel)
+            res = cand if res is None else jnp.maximum(res, cand)
+    return res
+
+
+def _erosion2d_impl(x, f, strides=(1, 1, 1, 1), rates=(1, 1, 1, 1),
+                    padding="SAME"):
+    """erosion2d(v, k) == -dilation2d(-v, flip(k)) (the reference's
+    documented duality, ref python/ops/nn_ops.py erosion2d). The duality
+    needs a signed domain: unsigned inputs compute in f32 (exact for
+    values < 2^24) and cast back."""
+    orig = x.dtype
+    if jnp.issubdtype(orig, jnp.unsignedinteger):
+        x = x.astype(jnp.float32)
+        f = f.astype(jnp.float32)
+    out = -_dilation2d_impl(-x, jnp.flip(f, axis=(0, 1)),
+                            strides=strides, rates=rates, padding=padding)
+    return out.astype(orig)
+
+
+op_registry.register_pure("Dilation2D", _dilation2d_impl)
+op_registry.register_pure("Erosion2D", _erosion2d_impl)
 
 
 def _pool(x, ksize, strides, padding, reducer, init, data_format="NHWC"):
@@ -406,6 +511,21 @@ def conv3d(input, filter=None, strides=None, padding=None, name=None,  # noqa: A
                           "padding": padding}, name=name)
 
 
+def _static_output_shape(output_shape):
+    if output_shape is None:
+        return None
+    if isinstance(output_shape, ops_mod.Tensor):
+        from ..framework.constant_op import constant_value
+
+        val = constant_value(output_shape)
+        if val is None:
+            raise NotImplementedError(
+                "conv transpose needs a STATIC output_shape (XLA shapes "
+                "are compile-time); pass a list/tuple or a constant")
+        output_shape = val
+    return builtins.tuple(int(d) for d in np.asarray(output_shape).ravel())
+
+
 def conv2d_transpose(value, filter=None, output_shape=None, strides=None,  # noqa: A002
                      padding="SAME", data_format="NHWC", name=None,
                      filters=None):
@@ -414,12 +534,50 @@ def conv2d_transpose(value, filter=None, output_shape=None, strides=None,  # noq
     w = ops_mod.convert_to_tensor(w, dtype=x.dtype.base_dtype)
     return make_op("Conv2DBackpropInput", [x, w],
                    attrs={"strides": builtins.tuple(strides),
-                          "padding": padding}, name=name)
+                          "padding": padding,
+                          "output_shape": _static_output_shape(output_shape)},
+                   name=name)
 
 
 def atrous_conv2d(value, filters, rate, padding, name=None):
     return conv2d(value, filters, [1, 1, 1, 1], padding,
                   dilations=[1, rate, rate, 1], name=name)
+
+
+def conv3d_transpose(value, filter=None, output_shape=None,  # noqa: A002
+                     strides=None, padding="SAME", name=None, filters=None):
+    w = filters if filters is not None else filter
+    x = ops_mod.convert_to_tensor(value)
+    w = ops_mod.convert_to_tensor(w, dtype=x.dtype.base_dtype)
+    return make_op("Conv3DBackpropInput", [x, w],
+                   attrs={"strides": builtins.tuple(strides),
+                          "padding": padding,
+                          "output_shape": _static_output_shape(output_shape)},
+                   name=name)
+
+
+def dilation2d(input, filter=None, strides=None, rates=None,  # noqa: A002
+               padding="SAME", name=None, filters=None):
+    """(ref: python/ops/nn_ops.py ``dilation2d``)."""
+    f = filters if filters is not None else filter
+    x = ops_mod.convert_to_tensor(input)
+    f = ops_mod.convert_to_tensor(f, dtype=x.dtype.base_dtype)
+    return make_op("Dilation2D", [x, f],
+                   attrs={"strides": builtins.tuple(strides or (1, 1, 1, 1)),
+                          "rates": builtins.tuple(rates or (1, 1, 1, 1)),
+                          "padding": padding}, name=name)
+
+
+def erosion2d(value, kernel=None, strides=None, rates=None, padding="SAME",
+              name=None, filters=None):
+    """(ref: python/ops/nn_ops.py ``erosion2d``)."""
+    f = filters if filters is not None else kernel
+    x = ops_mod.convert_to_tensor(value)
+    f = ops_mod.convert_to_tensor(f, dtype=x.dtype.base_dtype)
+    return make_op("Erosion2D", [x, f],
+                   attrs={"strides": builtins.tuple(strides or (1, 1, 1, 1)),
+                          "rates": builtins.tuple(rates or (1, 1, 1, 1)),
+                          "padding": padding}, name=name)
 
 
 def max_pool(value, ksize, strides, padding, data_format="NHWC", name=None):

@@ -331,3 +331,61 @@ class TestPerf:
         rep.step_done()
         out = rep.report()
         assert out.get("achieved_tflops", 0) >= 0
+
+
+class TestConfigProtoTransferGuard:
+    """ConfigProto (ref config.proto) + L0 transfer guards (SURVEY §1)."""
+
+    def test_config_proto_fields(self):
+        c = stf.ConfigProto(allow_soft_placement=True,
+                            log_device_placement=True,
+                            gpu_options=stf.GPUOptions(allow_growth=True))
+        assert c.allow_soft_placement and c.log_device_placement
+        assert c.gpu_options.allow_growth
+        with pytest.raises(ValueError):
+            stf.ConfigProto(transfer_guard="never")
+
+    def test_disallow_raises_on_hot_path_feed(self):
+        stf.reset_default_graph()
+        cfg = stf.ConfigProto(transfer_guard="disallow",
+                              transfer_guard_threshold_bytes=1024)
+        x = stf.placeholder(stf.float32, [64, 64], name="gx")
+        y = stf.reduce_sum(x)
+        sess = stf.Session(config=cfg)
+        feed = {x: np.ones((64, 64), np.float32)}  # 16 KiB > threshold
+        # first two runs are warmup/compile: allowed
+        sess.run(y, feed)
+        sess.run(y, feed)
+        with pytest.raises(stf.errors.InvalidArgumentError,
+                           match="prefetch_to_device"):
+            sess.run(y, feed)
+
+    def test_small_feeds_and_allow_mode_pass(self):
+        stf.reset_default_graph()
+        cfg = stf.ConfigProto(transfer_guard="disallow",
+                              transfer_guard_threshold_bytes=1 << 20)
+        x = stf.placeholder(stf.float32, [4], name="sx")
+        y = stf.reduce_sum(x)
+        sess = stf.Session(config=cfg)
+        for _ in range(5):
+            sess.run(y, {x: np.ones(4, np.float32)})  # tiny: fine
+        stf.reset_default_graph()
+        x2 = stf.placeholder(stf.float32, [64, 64], name="ax")
+        y2 = stf.reduce_sum(x2)
+        s2 = stf.Session()  # no config: guard off
+        for _ in range(5):
+            s2.run(y2, {x2: np.ones((64, 64), np.float32)})
+
+    def test_disallow_raises_on_big_fetch(self):
+        stf.reset_default_graph()
+        cfg = stf.ConfigProto(transfer_guard="disallow",
+                              transfer_guard_threshold_bytes=1024)
+        x = stf.placeholder(stf.float32, [4], name="fx")
+        big = stf.tile(stf.reshape(x, [1, 4]), [512, 1])  # 8 KiB out
+        sess = stf.Session(config=cfg)
+        feed = {x: np.ones(4, np.float32)}
+        sess.run(big, feed)
+        sess.run(big, feed)
+        with pytest.raises(stf.errors.InvalidArgumentError,
+                           match="keep large results on device"):
+            sess.run(big, feed)

@@ -227,3 +227,166 @@ class TestEmbeddingDropout:
         x = RNG.rand(2, 3).astype(np.float32)
         y = stf.nn.bias_add(stf.constant(x), stf.constant([1., 2., 3.]))
         np.testing.assert_allclose(_run(y), x + [1., 2., 3.], rtol=1e-6)
+
+
+class TestMorphologyAndConv3DTranspose:
+    """dilation2d/erosion2d (ref core/kernels/dilation_ops.cc) and
+    conv3d_transpose."""
+
+    @staticmethod
+    def _ref_dilation(x, f, sh, sw, rh, rw, padding):
+        n, h, w, c = x.shape
+        kh, kw, _ = f.shape
+        eh, ew = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        if padding == "SAME":
+            out_h = -(-h // sh)
+            out_w = -(-w // sw)
+            ph = max((out_h - 1) * sh + eh - h, 0)
+            pw = max((out_w - 1) * sw + ew - w, 0)
+            pt, pl = ph // 2, pw // 2
+        else:
+            out_h = (h - eh) // sh + 1
+            out_w = (w - ew) // sw + 1
+            pt = pl = 0
+        out = np.full((n, out_h, out_w, c), -np.inf, np.float32)
+        for b in range(n):
+            for y in range(out_h):
+                for xx in range(out_w):
+                    for ch in range(c):
+                        for i in range(kh):
+                            for j in range(kw):
+                                yy = y * sh + i * rh - pt
+                                xj = xx * sw + j * rw - pl
+                                if 0 <= yy < h and 0 <= xj < w:
+                                    v = x[b, yy, xj, ch] + f[i, j, ch]
+                                    out[b, y, xx, ch] = max(
+                                        out[b, y, xx, ch], v)
+        return out
+
+    @pytest.mark.parametrize("padding,stride,rate", [
+        ("SAME", 1, 1), ("VALID", 1, 1), ("SAME", 2, 1), ("VALID", 1, 2)])
+    def test_dilation2d_matches_reference(self, padding, stride, rate):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 9, 9, 3).astype(np.float32)
+        f = rng.rand(3, 3, 3).astype(np.float32) * 0.1
+        out_t = stf.nn.dilation2d(
+            stf.constant(x), stf.constant(f),
+            strides=[1, stride, stride, 1], rates=[1, rate, rate, 1],
+            padding=padding)
+        with stf.Session() as sess:
+            out = sess.run(out_t)
+        ref = self._ref_dilation(x, f, stride, stride, rate, rate, padding)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_erosion2d_duality(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(1, 8, 8, 2).astype(np.float32)
+        f = rng.rand(3, 3, 2).astype(np.float32) * 0.1
+        ero_t = stf.nn.erosion2d(stf.constant(x), stf.constant(f),
+                                 strides=[1, 1, 1, 1], rates=[1, 1, 1, 1],
+                                 padding="SAME")
+        dil_t = stf.nn.dilation2d(stf.constant(-x),
+                                  stf.constant(f[::-1, ::-1].copy()),
+                                  strides=[1, 1, 1, 1],
+                                  rates=[1, 1, 1, 1], padding="SAME")
+        with stf.Session() as sess:
+            ero, dil = sess.run([ero_t, dil_t])
+        np.testing.assert_allclose(ero, -dil, rtol=1e-5)
+
+    def test_dilation_zero_filter_is_maxpool(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 8, 8, 2).astype(np.float32)
+        out_t = stf.nn.dilation2d(
+            stf.constant(x), stf.constant(np.zeros((2, 2, 2), np.float32)),
+            strides=[1, 2, 2, 1], rates=[1, 1, 1, 1], padding="VALID")
+        mp_t = stf.nn.max_pool(stf.constant(x), [1, 2, 2, 1], [1, 2, 2, 1],
+                               "VALID")
+        with stf.Session() as sess:
+            out, mp = sess.run([out_t, mp_t])
+        np.testing.assert_allclose(out, mp, rtol=1e-6)
+
+    def test_conv3d_transpose_matches_jax_reference(self):
+        import jax
+
+        rng = np.random.RandomState(3)
+        # TF transpose-conv filter layout: (d,h,w,OUT,IN) — read as DHWIO
+        # with transpose_kernel=True, like the conv2d_transpose lowering
+        x = rng.rand(1, 4, 4, 4, 3).astype(np.float32)
+        w = rng.rand(3, 3, 3, 5, 3).astype(np.float32) * 0.1
+        out_t = stf.nn.conv3d_transpose(
+            stf.constant(x), stf.constant(w),
+            strides=[1, 2, 2, 2, 1], padding="SAME")
+        with stf.Session() as sess:
+            out = sess.run(out_t)
+        assert out.shape == (1, 8, 8, 8, 5)
+        ref = jax.lax.conv_transpose(
+            x, w, strides=(2, 2, 2), padding="SAME",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            transpose_kernel=True)
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_dilation2d_integer_dtypes_border_correct(self):
+        # int32 + SAME: padded taps must be EXCLUDED, not wrap around
+        x = np.arange(16, dtype=np.int32).reshape(1, 4, 4, 1)
+        f = np.ones((3, 3, 1), np.int32)
+        out_t = stf.nn.dilation2d(stf.constant(x), stf.constant(f),
+                                  strides=[1, 1, 1, 1], rates=[1, 1, 1, 1],
+                                  padding="SAME")
+        with stf.Session() as sess:
+            out = sess.run(out_t)
+        ref = self._ref_dilation(x.astype(np.float32),
+                                 f.astype(np.float32), 1, 1, 1, 1, "SAME")
+        np.testing.assert_array_equal(out, ref.astype(np.int32))
+        # uint8: sentinel 0 must not leak filter values at borders
+        xu = np.zeros((1, 4, 4, 1), np.uint8)
+        fu = np.full((3, 3, 1), 7, np.uint8)
+        out_u = stf.nn.dilation2d(stf.constant(xu), stf.constant(fu),
+                                  strides=[1, 1, 1, 1], rates=[1, 1, 1, 1],
+                                  padding="SAME")
+        ero_u = stf.nn.erosion2d(stf.constant(xu), stf.constant(fu),
+                                 strides=[1, 1, 1, 1], rates=[1, 1, 1, 1],
+                                 padding="SAME")
+        with stf.Session() as sess:
+            ou, eu = sess.run([out_u, ero_u])
+        np.testing.assert_array_equal(ou, np.full_like(xu, 7))
+        assert eu.dtype == np.uint8 and np.isfinite(
+            eu.astype(np.float32)).all()
+
+    def test_conv2d_transpose_explicit_output_shape(self):
+        import jax
+
+        rng = np.random.RandomState(5)
+        # stride-2 SAME: input 4 could come from forward size 7 OR 8 —
+        # output_shape disambiguates (the vjp-of-forward definition)
+        x = rng.rand(1, 4, 4, 2).astype(np.float32)
+        w = rng.rand(3, 3, 5, 2).astype(np.float32) * 0.1  # (h,w,OUT,IN)
+        out_t = stf.nn.conv2d_transpose(
+            stf.constant(x), stf.constant(w),
+            output_shape=[1, 7, 7, 5], strides=[1, 2, 2, 1],
+            padding="SAME")
+        with stf.Session() as sess:
+            out = sess.run(out_t)
+        assert out.shape == (1, 7, 7, 5)
+
+        def fwd(y):
+            return jax.lax.conv_general_dilated(
+                y, w, window_strides=(2, 2), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        _, vjp = jax.vjp(fwd, np.zeros((1, 7, 7, 5), np.float32))
+        (ref,) = vjp(x)
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_conv_transpose_inconsistent_output_shape_raises(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(1, 4, 4, 2).astype(np.float32)
+        w = rng.rand(3, 3, 5, 2).astype(np.float32)
+        out_t = stf.nn.conv2d_transpose(
+            stf.constant(x), stf.constant(w),
+            output_shape=[1, 20, 20, 5], strides=[1, 2, 2, 1],
+            padding="SAME")
+        with stf.Session() as sess:
+            with pytest.raises(Exception, match="inconsistent"):
+                sess.run(out_t)

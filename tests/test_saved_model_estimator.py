@@ -168,6 +168,41 @@ class TestDebug:
         dumps = os.listdir(str(tmp_path))
         assert dumps  # a dump directory per run
 
+    def test_debug_dump_dir_analyzer(self, tmp_path):
+        """DebugDumpDir: list/query/filter across runs (the tfdbg
+        analyzer layer, ref python/debug/lib/debug_data.py)."""
+        from simple_tensorflow_tpu import debug as stf_debug
+
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [2], name="ax")
+        y = stf.square(x, name="asq")
+        z = stf.log(x, name="alog")  # log(-1) = nan for the filter
+        sess = stf.Session()
+        wrapped = stf_debug.DumpingDebugWrapperSession(
+            sess, session_root=str(tmp_path))
+        wrapped.run([y, z], {x: np.float32([2.0, 3.0])})
+        wrapped.run([y, z], {x: np.float32([-1.0, 3.0])})  # nan run
+
+        dd = stf_debug.DebugDumpDir(str(tmp_path))
+        assert dd.runs == [1, 2]
+        assert dd.size > 0
+        names = dd.dumped_tensor_names()
+        assert "asq:0" in names and "alog:0" in names
+        # per-tensor history across runs
+        data = dd.watch_key_to_data("asq:0")
+        assert len(data) == 2
+        np.testing.assert_allclose(data[0].get_tensor(), [4.0, 9.0])
+        # glob query
+        assert dd.query("a*:0") == sorted(
+            n for n in names if n.startswith("a"))
+        # inf/nan filter finds the second run's log only
+        bad = dd.find_inf_or_nan()
+        assert any(d.tensor_name == "alog:0" for d in bad)
+        assert all("run_2" in d.run_dir for d in bad
+                   if d.tensor_name == "alog:0")
+        stats = bad[0].stats()
+        assert stats["nan"] >= 1
+
     def test_has_inf_or_nan_filter(self):
         from simple_tensorflow_tpu.debug import has_inf_or_nan
 
