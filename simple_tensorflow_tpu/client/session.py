@@ -196,7 +196,7 @@ class _CompiledStep:
     __slots__ = ("jitted", "device_fetches", "host_plan", "post_host_plan",
                  "post_host_inputs", "device_ops", "feed_tensors", "boundary",
                  "has_device_stage", "n_calls", "last_lowering_ctx",
-                 "check_msgs", "const_env", "alias")
+                 "check_msgs", "const_env", "alias", "fetch_nbytes")
 
     def __init__(self):
         self.n_calls = 0
@@ -205,6 +205,7 @@ class _CompiledStep:
         self.post_host_inputs = []
         self.const_env = {}
         self.alias = {}
+        self.fetch_nbytes = []
 
 
 class BaseSession:
@@ -381,23 +382,22 @@ class BaseSession:
         new_state = None
         if step.has_device_stage:
             rng = self._next_rng()
+            guard_on = (self._config is not None and
+                        getattr(self._config, "transfer_guard", "allow")
+                        != "allow" and step.n_calls >= 2)
+            if guard_on:
+                # guards run BEFORE execution so a "disallow" raise can
+                # never land after the variable updates commit. Feeds: a
+                # big host-numpy feed is an H2D transfer EVERY step.
+                # Fetches: sizes precomputed from static shapes at plan
+                # time (dynamic-shaped fetches are unguarded by design).
+                for t in step.feed_tensors:
+                    val = feeds[t] if t in feeds else host_env[t]
+                    if isinstance(val, np.ndarray):
+                        self._transfer_guard(t.name, val.nbytes, "feed")
+                for name, nbytes in step.fetch_nbytes:
+                    self._transfer_guard(name, nbytes, "fetch")
             feed_args = {}
-            for t in step.feed_tensors:
-                val = feeds[t] if t in feeds else host_env[t]
-                if step.n_calls >= 2 and isinstance(val, np.ndarray):
-                    # hot path (compiled + warm): a big host-numpy feed
-                    # means an H2D transfer EVERY step
-                    self._transfer_guard(t.name, val.nbytes, "feed")
-            if step.n_calls >= 2:
-                # fetch guard runs BEFORE execution (sizes from static
-                # shapes) so a "disallow" raise cannot land after the
-                # variable updates commit; dynamic-shaped fetches are
-                # unguarded by design
-                for t in step.device_fetches:
-                    n_el = t.shape.num_elements()
-                    if n_el is not None:
-                        self._transfer_guard(
-                            t.name, n_el * t.dtype.base_dtype.size, "fetch")
             for t in step.feed_tensors:
                 val = feeds[t] if t in feeds else host_env[t]
                 feed_args[t.name] = self._maybe_shard_feed(t, val)
@@ -708,6 +708,13 @@ class BaseSession:
                 device_fetches.append(t)
         step.device_fetches = device_fetches
         step.device_ops = device_ops
+        # static fetch sizes for the transfer guard (computed once here,
+        # not per step; None num_elements = dynamic shape, unguarded)
+        step.fetch_nbytes = [
+            (t.name, t.shape.num_elements() * t.dtype.base_dtype.size)
+            for t in device_fetches
+            if t.shape.num_elements() is not None
+            and t.dtype.name != "string"]
         step.has_device_stage = bool(device_ops)
         if not step.has_device_stage:
             step.jitted = None

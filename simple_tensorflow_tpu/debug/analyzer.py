@@ -114,25 +114,34 @@ class DebugDumpDir:
         return self._runs[run][tensor_name].get_tensor()
 
     def find(self, predicate: Callable[[str, np.ndarray], bool],
-             first_n: int = 0) -> List[DebugTensorDatum]:
-        """Data matching ``predicate(name, value)`` across all runs (ref:
-        debug_data.py ``DebugDumpDir.find`` — the tensor-filter hook the
-        CLI's ``lt -f has_inf_or_nan`` uses)."""
+             first_n: int = 0,
+             run: Optional[int] = None) -> List[DebugTensorDatum]:
+        """Data matching ``predicate(name, value)`` (ref: debug_data.py
+        ``DebugDumpDir.find`` — the tensor-filter hook the CLI's
+        ``lt -f has_inf_or_nan`` uses). Tensors are loaded WITHOUT the
+        per-datum cache — a predicate sweep over a multi-GB dump root
+        must not pin the whole set in memory."""
         out = []
-        for r in self.runs:
-            for name, datum in sorted(self._runs[r].items()):
-                if predicate(name, datum.get_tensor()):
+        runs = [run] if run is not None else self.runs
+        for r in runs:
+            for name, datum in sorted(self._runs.get(r, {}).items()):
+                value = np.load(os.path.join(datum.run_dir, datum._file),
+                                allow_pickle=False)
+                if predicate(name, value):
                     out.append(datum)
                     if first_n and len(out) >= first_n:
                         return out
         return out
 
-    def find_inf_or_nan(self, first_n: int = 0) -> List[DebugTensorDatum]:
+    def find_inf_or_nan(self, first_n: int = 0,
+                        run: Optional[int] = None
+                        ) -> List[DebugTensorDatum]:
         """Uses the per-tensor flag precomputed in the dump manifests —
         no tensor files are read (a dump root can hold GBs)."""
         out = []
-        for r in self.runs:
-            for _, datum in sorted(self._runs[r].items()):
+        runs = [run] if run is not None else self.runs
+        for r in runs:
+            for _, datum in sorted(self._runs.get(r, {}).items()):
                 if datum.flagged_inf_or_nan:
                     out.append(datum)
                     if first_n and len(out) >= first_n:
@@ -167,7 +176,7 @@ def main():
                 print(datum.get_tensor(), file=out)
         return
     if args.filter == "has_inf_or_nan":
-        hits = dd.find_inf_or_nan()
+        hits = dd.find_inf_or_nan(run=args.run)
         for d in hits:
             print(f"{d.tensor_name} [{d.run_dir}] {d.stats()}", file=out)
         print(f"# {len(hits)} tensors with inf/nan", file=out)

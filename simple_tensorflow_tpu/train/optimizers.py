@@ -5,6 +5,12 @@ core/kernels/training_ops.cc Apply* kernels).
 Each _apply_dense builds assign ops whose lowerings fuse into the step's XLA
 program — there are no per-optimizer kernels to hand-tune on TPU; XLA fuses
 the whole update chain (m/v/param) into a few HBM passes.
+
+Mixed precision: for low-precision float params the slots live in f32
+(slot_creator.update_dtype) and ALL update math runs in f32 — grads
+upcast on entry, only the final new-value/delta rounds back to the param
+dtype. bf16 Adam second moments (8-bit mantissa) would otherwise destroy
+the effective step size; for f32 params every cast below is a no-op.
 """
 
 from __future__ import annotations
@@ -13,10 +19,31 @@ from ..framework import graph as ops_mod
 from ..ops import array_ops, control_flow_ops, math_ops, state_ops
 from ..ops import variables as variables_mod
 from .optimizer import Optimizer
+from .slot_creator import update_dtype as _ud
 
 
 def _c(value, var):
-    return ops_mod.convert_to_tensor(value, dtype=var.dtype.base_dtype)
+    """Hyperparameter in the var's UPDATE dtype (f32 for bf16 params)."""
+    return ops_mod.convert_to_tensor(value, dtype=_ud(var))
+
+
+def _g(grad, var):
+    """Gradient upcast to the update dtype."""
+    ud = _ud(var)
+    return math_ops.cast(grad, ud) if grad.dtype.base_dtype != ud else grad
+
+
+def _vread(var):
+    """Current param value in the update dtype."""
+    ud = _ud(var)
+    r = var._ref
+    return math_ops.cast(r, ud) if var.dtype.base_dtype != ud else r
+
+
+def _back(x, var):
+    """Round a new value / delta back to the param dtype for the assign."""
+    d = var.dtype.base_dtype
+    return math_ops.cast(x, d) if x.dtype.base_dtype != d else x
 
 
 class GradientDescentOptimizer(Optimizer):
@@ -28,13 +55,15 @@ class GradientDescentOptimizer(Optimizer):
         self._learning_rate = learning_rate
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         lr = _c(self._call_if_callable(self._learning_rate), var)
-        return state_ops.assign_sub(var._ref, lr * grad).op
+        return state_ops.assign_sub(var._ref, _back(lr * grad, var)).op
 
     def _apply_sparse(self, grad, var):
         lr = _c(self._call_if_callable(self._learning_rate), var)
+        vals = _g(grad.values, var)
         return state_ops.scatter_sub(var._ref, grad.indices,
-                                     lr * grad.values).op
+                                     _back(lr * vals, var)).op
 
 
 class MomentumOptimizer(Optimizer):
@@ -52,6 +81,7 @@ class MomentumOptimizer(Optimizer):
             self._zeros_slot(v, "momentum", self._name)
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         mom = self.get_slot(var, "momentum")
         lr = _c(self._call_if_callable(self._learning_rate), var)
         mu = _c(self._call_if_callable(self._momentum), var)
@@ -60,7 +90,7 @@ class MomentumOptimizer(Optimizer):
             update = lr * (grad + mu * new_acc)
         else:
             update = lr * new_acc
-        return state_ops.assign_sub(var._ref, update).op
+        return state_ops.assign_sub(var._ref, _back(update, var)).op
 
 
 class AdamOptimizer(Optimizer):
@@ -90,20 +120,21 @@ class AdamOptimizer(Optimizer):
             self._zeros_slot(v, "v", self._name)
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         m = self.get_slot(var, "m")
         v = self.get_slot(var, "v")
         lr = _c(self._call_if_callable(self._lr), var)
         b1 = _c(self._beta1, var)
         b2 = _c(self._beta2, var)
         eps = _c(self._epsilon, var)
-        b1p = math_ops.cast(self._beta1_power._ref, var.dtype.base_dtype)
-        b2p = math_ops.cast(self._beta2_power._ref, var.dtype.base_dtype)
+        b1p = math_ops.cast(self._beta1_power._ref, _ud(var))
+        b2p = math_ops.cast(self._beta2_power._ref, _ud(var))
         alpha = lr * math_ops.sqrt(1 - b2p) / (1 - b1p)
         new_m = state_ops.assign(m._ref, b1 * m._ref + (1 - b1) * grad)
         new_v = state_ops.assign(v._ref, b2 * v._ref +
                                  (1 - b2) * math_ops.square(grad))
         update = alpha * new_m / (math_ops.sqrt(new_v) + eps)
-        return state_ops.assign_sub(var._ref, update).op
+        return state_ops.assign_sub(var._ref, _back(update, var)).op
 
     def _finish(self, update_ops, name_scope):
         g = ops_mod.get_default_graph()
@@ -132,28 +163,29 @@ class AdagradOptimizer(Optimizer):
             self._get_or_make_slot(
                 v, array_ops.fill([int(d) for d in v.shape.as_list()],
                                   ops_mod.convert_to_tensor(
-                                      self._init_acc,
-                                      dtype=v.dtype.base_dtype)),
+                                      self._init_acc, dtype=_ud(v))),
                 "accumulator", self._name)
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         acc = self.get_slot(var, "accumulator")
         lr = _c(self._call_if_callable(self._learning_rate), var)
         new_acc = state_ops.assign_add(acc._ref, math_ops.square(grad))
         return state_ops.assign_sub(
-            var._ref, lr * grad * math_ops.rsqrt(new_acc)).op
+            var._ref, _back(lr * grad * math_ops.rsqrt(new_acc), var)).op
 
     def _apply_sparse(self, grad, var):
         acc = self.get_slot(var, "accumulator")
         lr = _c(self._call_if_callable(self._learning_rate), var)
+        vals = _g(grad.values, var)
         new_acc = state_ops.scatter_add(acc._ref, grad.indices,
-                                        math_ops.square(grad.values))
+                                        math_ops.square(vals))
         from ..ops import array_ops as ao
 
         acc_slice = ao.gather(new_acc, grad.indices)
         return state_ops.scatter_sub(
             var._ref, grad.indices,
-            lr * grad.values * math_ops.rsqrt(acc_slice)).op
+            _back(lr * vals * math_ops.rsqrt(acc_slice), var)).op
 
 
 class AdadeltaOptimizer(Optimizer):
@@ -172,6 +204,7 @@ class AdadeltaOptimizer(Optimizer):
             self._zeros_slot(v, "accum_update", self._name)
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         accum = self.get_slot(var, "accum")
         accum_update = self.get_slot(var, "accum_update")
         lr = _c(self._call_if_callable(self._lr), var)
@@ -186,7 +219,8 @@ class AdadeltaOptimizer(Optimizer):
             rho * accum_update._ref + (1 - rho) * math_ops.square(update))
         with ops_mod.get_default_graph().control_dependencies(
                 [new_accum_update.op]):
-            return state_ops.assign_sub(var._ref, lr * update).op
+            return state_ops.assign_sub(var._ref,
+                                        _back(lr * update, var)).op
 
 
 class RMSPropOptimizer(Optimizer):
@@ -205,12 +239,13 @@ class RMSPropOptimizer(Optimizer):
         for v in var_list:
             self._get_or_make_slot(
                 v, array_ops.ones([int(d) for d in v.shape.as_list()],
-                                  dtype=v.dtype.base_dtype), "rms", self._name)
+                                  dtype=_ud(v)), "rms", self._name)
             self._zeros_slot(v, "momentum", self._name)
             if self._centered:
                 self._zeros_slot(v, "mg", self._name)
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         rms = self.get_slot(var, "rms")
         mom = self.get_slot(var, "momentum")
         lr = _c(self._call_if_callable(self._lr), var)
@@ -228,7 +263,7 @@ class RMSPropOptimizer(Optimizer):
         new_mom = state_ops.assign(
             mom._ref, momentum * mom._ref +
             lr * grad * math_ops.rsqrt(denom + eps))
-        return state_ops.assign_sub(var._ref, new_mom).op
+        return state_ops.assign_sub(var._ref, _back(new_mom, var)).op
 
 
 class FtrlOptimizer(Optimizer):
@@ -250,12 +285,12 @@ class FtrlOptimizer(Optimizer):
             self._get_or_make_slot(
                 v, array_ops.fill([int(d) for d in v.shape.as_list()],
                                   ops_mod.convert_to_tensor(
-                                      self._init_acc,
-                                      dtype=v.dtype.base_dtype)),
+                                      self._init_acc, dtype=_ud(v))),
                 "accum", self._name)
             self._zeros_slot(v, "linear", self._name)
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         accum = self.get_slot(var, "accum")
         linear = self.get_slot(var, "linear")
         lr = _c(self._call_if_callable(self._lr), var)
@@ -266,15 +301,15 @@ class FtrlOptimizer(Optimizer):
         sigma = (math_ops.pow(new_accum, -lr_power) -
                  math_ops.pow(accum._ref, -lr_power)) / lr
         new_linear = state_ops.assign(
-            linear._ref, linear._ref + grad - sigma * var._ref)
+            linear._ref, linear._ref + grad - sigma * _vread(var))
         upd_accum = state_ops.assign(accum._ref, new_accum)
         quadratic = math_ops.pow(new_accum, -lr_power) / lr + 2 * l2
         pre = math_ops.sign(new_linear) * l1 - new_linear
         new_var = array_ops.where(
             math_ops.greater(math_ops.abs(new_linear), l1),
-            pre / quadratic, array_ops.zeros_like(var._ref))
+            pre / quadratic, array_ops.zeros_like(new_linear))
         with ops_mod.get_default_graph().control_dependencies([upd_accum.op]):
-            return state_ops.assign(var._ref, new_var).op
+            return state_ops.assign(var._ref, _back(new_var, var)).op
 
 
 class AdagradDAOptimizer(Optimizer):
@@ -298,11 +333,11 @@ class AdagradDAOptimizer(Optimizer):
             self._get_or_make_slot(
                 v, array_ops.fill([int(d) for d in v.shape.as_list()],
                                   ops_mod.convert_to_tensor(
-                                      self._init_gg,
-                                      dtype=v.dtype.base_dtype)),
+                                      self._init_gg, dtype=_ud(v))),
                 "gradient_squared_accumulator", self._name)
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         g_acc = self.get_slot(var, "gradient_accumulator")
         gg_acc = self.get_slot(var, "gradient_squared_accumulator")
         lr = _c(self._call_if_callable(self._lr), var)
@@ -310,7 +345,7 @@ class AdagradDAOptimizer(Optimizer):
         l2 = _c(self._l2, var)
         gstep = math_ops.cast(
             self._global_step._ref if hasattr(self._global_step, "_ref")
-            else self._global_step, var.dtype.base_dtype) + 1
+            else self._global_step, _ud(var)) + 1
         new_g = state_ops.assign_add(g_acc._ref, grad)
         new_gg = state_ops.assign_add(gg_acc._ref, math_ops.square(grad))
         sign = math_ops.sign(new_g)
@@ -318,7 +353,7 @@ class AdagradDAOptimizer(Optimizer):
             math_ops.abs(new_g) - l1 * gstep, array_ops.zeros_like(new_g))
         denom = math_ops.sqrt(new_gg) + lr * l2 * gstep
         new_var = -lr * pruned / denom
-        return state_ops.assign(var._ref, new_var).op
+        return state_ops.assign(var._ref, _back(new_var, var)).op
 
 
 class ProximalGradientDescentOptimizer(GradientDescentOptimizer):
@@ -333,13 +368,15 @@ class ProximalGradientDescentOptimizer(GradientDescentOptimizer):
         self._l2 = l2_regularization_strength
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         lr = _c(self._call_if_callable(self._learning_rate), var)
         l1 = _c(self._l1, var)
         l2 = _c(self._l2, var)
-        prox = var._ref - lr * grad
+        prox = _vread(var) - lr * grad
         soft = math_ops.sign(prox) * math_ops.maximum(
             math_ops.abs(prox) - lr * l1, array_ops.zeros_like(prox))
-        return state_ops.assign(var._ref, soft / (1 + lr * l2)).op
+        return state_ops.assign(var._ref,
+                                _back(soft / (1 + lr * l2), var)).op
 
 
 class ProximalAdagradOptimizer(AdagradOptimizer):
@@ -355,13 +392,15 @@ class ProximalAdagradOptimizer(AdagradOptimizer):
         self._l2 = l2_regularization_strength
 
     def _apply_dense(self, grad, var):
+        grad = _g(grad, var)
         acc = self.get_slot(var, "accumulator")
         lr = _c(self._call_if_callable(self._learning_rate), var)
         l1 = _c(self._l1, var)
         l2 = _c(self._l2, var)
         new_acc = state_ops.assign_add(acc._ref, math_ops.square(grad))
         adjusted_lr = lr * math_ops.rsqrt(new_acc)
-        prox = var._ref - adjusted_lr * grad
+        prox = _vread(var) - adjusted_lr * grad
         soft = math_ops.sign(prox) * math_ops.maximum(
             math_ops.abs(prox) - adjusted_lr * l1, array_ops.zeros_like(prox))
-        return state_ops.assign(var._ref, soft / (1 + adjusted_lr * l2)).op
+        return state_ops.assign(var._ref,
+                                _back(soft / (1 + adjusted_lr * l2), var)).op

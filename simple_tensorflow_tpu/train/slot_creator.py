@@ -1,13 +1,30 @@
 """Slot variable creation (ref: tensorflow/python/training/slot_creator.py).
 
 Slots inherit the primary variable's sharding so optimizer state is laid out
-on the mesh exactly like its parameter (the FSDP/ZeRO property falls out)."""
+on the mesh exactly like its parameter (the FSDP/ZeRO property falls out).
+
+Mixed-precision policy: optimizer STATE for low-precision float params
+(bf16/f16/fp8) is kept in float32 — accumulating momenta or Adam second
+moments in bf16 (8-bit mantissa) silently loses small updates and wrecks
+the effective step size; the reference never hits this because it trains
+f32, but bf16 params are the TPU default here. Update math upcasts to f32
+and only the final delta rounds back (see train/optimizers.py)."""
 
 from __future__ import annotations
 
+from ..framework import dtypes as dtypes_mod
 from ..framework import graph as ops_mod
 from ..ops import array_ops
 from ..ops import variables as variables_mod
+
+_LOW_PRECISION = ("bfloat16", "float16", "float8_e4m3fn", "float8_e5m2")
+
+
+def update_dtype(var):
+    """Slot/update compute dtype for ``var``: f32 for low-precision float
+    params, the param dtype otherwise."""
+    d = var.dtype.base_dtype
+    return dtypes_mod.float32 if d.name in _LOW_PRECISION else d
 
 
 def create_slot(primary, val, name, colocate_with_primary=True):
@@ -38,7 +55,7 @@ def create_slot_with_initializer(primary, initializer, shape, dtype, name,
 
 
 def create_zeros_slot(primary, name, dtype=None, colocate_with_primary=True):
-    dtype = dtype or primary.dtype.base_dtype
+    dtype = dtype or update_dtype(primary)
     val = array_ops.zeros([int(d) for d in primary.shape.as_list()],
                           dtype=dtype)
     return create_slot(primary, val, name, colocate_with_primary)

@@ -207,3 +207,67 @@ class TestEMA:
             sess.run(update)  # avg = 0.9*10 + 0.1*20 = 11
             np.testing.assert_allclose(float(sess.run(avg)), 11.0,
                                        rtol=1e-5)
+
+
+class TestMixedPrecisionSlots:
+    """bf16 params keep f32 optimizer state and f32 update math (the
+    reference trains f32 everywhere; bf16 params are the TPU default
+    here, and bf16 Adam moments lose small updates)."""
+
+    def test_bf16_param_gets_f32_slots(self):
+        stf.reset_default_graph()
+        v = stf.Variable(np.zeros((4,), np.float32).astype(
+            stf.bfloat16.np_dtype), name="mp_v")
+        f32v = stf.Variable(np.zeros((4,), np.float32), name="mp_f")
+        opt = stf.train.AdamOptimizer(0.1)
+        g = stf.constant(np.ones((4,), np.float32).astype(
+            stf.bfloat16.np_dtype))
+        gf = stf.constant(np.ones((4,), np.float32))
+        opt.apply_gradients([(g, v), (gf, f32v)])
+        assert opt.get_slot(v, "m").dtype.base_dtype == stf.float32
+        assert opt.get_slot(v, "v").dtype.base_dtype == stf.float32
+        # f32 params keep f32 slots (unchanged behavior)
+        assert opt.get_slot(f32v, "m").dtype.base_dtype == stf.float32
+
+    def test_bf16_adam_matches_f32_reference_within_param_rounding(self):
+        """Train the same problem with bf16 and f32 params: with f32
+        update math the ONLY divergence is the param-dtype rounding, so
+        trajectories stay within bf16 epsilon of each other."""
+        results = {}
+        for dtype in ("float32", "bfloat16"):
+            stf.reset_default_graph()
+            np_dt = stf.as_dtype(dtype).np_dtype
+            w = stf.Variable(np.full((8,), 1.0).astype(np_dt), name="w_" + dtype)
+            x = stf.constant(np.linspace(0.5, 1.5, 8).astype(np_dt))
+            loss = stf.reduce_sum(stf.square(stf.cast(w, stf.float32) *
+                                             stf.cast(x, stf.float32)))
+            opt = stf.train.AdamOptimizer(0.01)
+            train = opt.minimize(loss, var_list=[w])
+            with stf.Session() as sess:
+                sess.run(stf.global_variables_initializer())
+                for _ in range(50):
+                    sess.run(train)
+                results[dtype] = np.asarray(
+                    sess.run(w), dtype=np.float32)
+        np.testing.assert_allclose(results["bfloat16"], results["float32"],
+                                   rtol=0.02, atol=0.01)
+
+    def test_bf16_momentum_small_updates_not_lost(self):
+        """With f32 momentum accumulation, many small gradients compound;
+        bf16 accumulation would round them away relative to the running
+        momentum."""
+        stf.reset_default_graph()
+        w = stf.Variable(np.zeros((1,), np.float32).astype(
+            stf.bfloat16.np_dtype), name="w_tiny")
+        g = stf.constant(np.full((1,), 1e-3, np.float32).astype(
+            stf.bfloat16.np_dtype))
+        opt = stf.train.MomentumOptimizer(0.1, 0.9)
+        train = opt.apply_gradients([(g, w)])
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            for _ in range(100):
+                sess.run(train)
+            mom = np.asarray(sess.run(opt.get_slot(w, "momentum")),
+                             np.float32)
+        # steady-state momentum -> g/(1-mu) = 1e-2
+        np.testing.assert_allclose(mom, [1e-2], rtol=0.05)
