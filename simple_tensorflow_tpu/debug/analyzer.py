@@ -33,10 +33,15 @@ class DebugTensorDatum:
         self.flagged_inf_or_nan = bool(meta.get("has_inf_or_nan"))
         self._value = None
 
+    def load_tensor(self) -> np.ndarray:
+        """Read the dump from disk WITHOUT caching (predicate sweeps over
+        big dump roots must not pin everything in memory)."""
+        return np.load(os.path.join(self.run_dir, self._file),
+                       allow_pickle=False)
+
     def get_tensor(self) -> np.ndarray:
         if self._value is None:
-            self._value = np.load(os.path.join(self.run_dir, self._file),
-                                  allow_pickle=False)
+            self._value = self.load_tensor()
         return self._value
 
     @property
@@ -122,12 +127,10 @@ class DebugDumpDir:
         per-datum cache — a predicate sweep over a multi-GB dump root
         must not pin the whole set in memory."""
         out = []
-        runs = [run] if run is not None else self.runs
+        runs = self._select_runs(run)
         for r in runs:
-            for name, datum in sorted(self._runs.get(r, {}).items()):
-                value = np.load(os.path.join(datum.run_dir, datum._file),
-                                allow_pickle=False)
-                if predicate(name, value):
+            for name, datum in sorted(self._runs[r].items()):
+                if predicate(name, datum.load_tensor()):
                     out.append(datum)
                     if first_n and len(out) >= first_n:
                         return out
@@ -139,14 +142,21 @@ class DebugDumpDir:
         """Uses the per-tensor flag precomputed in the dump manifests —
         no tensor files are read (a dump root can hold GBs)."""
         out = []
-        runs = [run] if run is not None else self.runs
-        for r in runs:
-            for _, datum in sorted(self._runs.get(r, {}).items()):
+        for r in self._select_runs(run):
+            for _, datum in sorted(self._runs[r].items()):
                 if datum.flagged_inf_or_nan:
                     out.append(datum)
                     if first_n and len(out) >= first_n:
                         return out
         return out
+
+    def _select_runs(self, run: Optional[int]) -> List[int]:
+        if run is None:
+            return self.runs
+        if run not in self._runs:
+            raise ValueError(f"run {run} not in dump root "
+                             f"(have {self.runs})")
+        return [run]
 
     def query(self, pattern: str) -> List[str]:
         """Glob over dumped tensor names."""
