@@ -177,6 +177,10 @@ def main():
 
     dd = DebugDumpDir(args.dump_root)
     out = sys.stdout
+    if args.run is not None and args.run not in dd.runs:
+        print(f"error: run {args.run} not in dump root "
+              f"(have {dd.runs})", file=sys.stderr)
+        sys.exit(2)
     if args.tensor:
         for datum in dd.watch_key_to_data(args.tensor, run=args.run):
             print(f"{datum.tensor_name} [{datum.run_dir}] "

@@ -321,12 +321,22 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, kv_true,
 def _bwd(sm_scale, causal, block_q, block_k, kv_true, dropout_rate,
          num_heads, res, g):
     q, k, v, bias, seed, o, lse = res
-    bh, q_len, d = q.shape
-    kv_pad_len = k.shape[1]
-    has_bias = bias is not None
     do = g.astype(jnp.float32)
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1,
                     keepdims=True)                          # (bh, q_len, 1)
+    return _bwd_with_delta(sm_scale, causal, block_q, block_k, kv_true,
+                           dropout_rate, num_heads,
+                           (q, k, v, bias, seed, lse), g, delta)
+
+
+def _bwd_with_delta(sm_scale, causal, block_q, block_k, kv_true,
+                    dropout_rate, num_heads, res, g, delta):
+    """Kernel plumbing shared by the plain vjp (delta = rowsum(dO∘O)) and
+    the (o, lse) vjp (delta shifted by −dlse)."""
+    q, k, v, bias, seed, lse = res
+    bh, q_len, d = q.shape
+    kv_pad_len = k.shape[1]
+    has_bias = bias is not None
     num_qb = cdiv(q_len, block_q)
     num_kb = cdiv(kv_pad_len, block_k)
 
@@ -434,8 +444,42 @@ def _flash_fwd_rule(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_bhsd_lse(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                    kv_true, dropout_rate, num_heads):
+    """Variant returning (o, lse) — ring attention merges per-block
+    partials through the log-sum-exp."""
+    return _fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                kv_true, dropout_rate, num_heads)
+
+
+def _flash_lse_fwd_rule(q, k, v, bias, seed, sm_scale, causal, block_q,
+                        block_k, kv_true, dropout_rate, num_heads):
+    o, lse = _fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                  kv_true, dropout_rate, num_heads)
+    return (o, lse), (q, k, v, bias, seed, o, lse)
+
+
+def _bwd_lse(sm_scale, causal, block_q, block_k, kv_true, dropout_rate,
+             num_heads, res, gs):
+    """The lse cotangent folds into the existing kernels: with
+    L = f(O, LSE), dS = P∘(dP − delta + dlse) since ∂LSE/∂S = P — i.e.
+    run the standard backward with delta' = rowsum(dO∘O) − dlse."""
+    g_o, g_lse = gs
+    q, k, v, bias, seed, o, lse = res
+    do = g_o.astype(jnp.float32)
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1, keepdims=True) \
+        - g_lse.astype(jnp.float32)
+    return _bwd_with_delta(sm_scale, causal, block_q, block_k, kv_true,
+                           dropout_rate, num_heads,
+                           (q, k, v, bias, seed, lse), g_o, delta)
+
+
+_flash_bhsd_lse.defvjp(_flash_lse_fwd_rule, _bwd_lse)
+
+
 def flash_attention(q, k, v, *, causal=False, sm_scale=None, bias=None,
-                    dropout_rate=0.0, dropout_seed=None,
+                    dropout_rate=0.0, dropout_seed=None, return_lse=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Fused attention. q,k,v: (batch, heads, seq, head_dim) (kv seq may
     differ for cross-attention; causal requires equal lengths). Returns
@@ -452,6 +496,11 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None, bias=None,
     normalization, inverted scaling). Requires dropout_seed, an int32
     scalar/array; the mask is counter-based on (head, row, col) so the
     backward pass regenerates it exactly — nothing is materialized.
+
+    return_lse: also return the per-row log-sum-exp (batch, heads,
+    q_seq) in f32 — the merge key for composing partial attentions
+    (ring attention); differentiable (the lse cotangent folds into the
+    backward's delta term).
     """
     b, h, q_len, d = q.shape
     kv_len = k.shape[2]
@@ -500,9 +549,15 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None, bias=None,
     if dropout_rate > 0.0:
         ss = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
 
-    o = _flash_bhsd(qq, kk, vv, bb, ss, float(sm_scale), bool(causal),
-                    int(block_q), int(block_k), int(kv_len),
-                    float(dropout_rate), int(h))
+    args = (qq, kk, vv, bb, ss, float(sm_scale), bool(causal),
+            int(block_q), int(block_k), int(kv_len),
+            float(dropout_rate), int(h))
+    if return_lse:
+        o, lse = _flash_bhsd_lse(*args)
+        o = o[:, :q_len, :d].reshape(b, h, q_len, d)
+        lse = lse[:, :q_len, 0].reshape(b, h, q_len)
+        return o, lse
+    o = _flash_bhsd(*args)
     o = o[:, :q_len, :d].reshape(b, h, q_len, d)
     return o
 

@@ -53,13 +53,23 @@ def _merge(m1, l1, a1, m2, l2, a2):
     return m, l, a
 
 
-def ring_attention_p(q, k, v, axis_name, *, causal=False, sm_scale=None):
+def ring_attention_p(q, k, v, axis_name, *, causal=False, sm_scale=None,
+                     use_flash=True):
     """Per-shard ring attention, for use inside ``shard_map`` where the
     sequence dim (2) of q/k/v is sharded over ``axis_name``.
 
     q, k, v: (B, H, S_local, D) local shards. Returns the local O shard.
     Differentiable (ppermute transposes to the reverse permute; jax.vjp of
     the scan replays the ring backwards).
+
+    use_flash (default): each visiting KV block runs the Pallas flash
+    kernel (O(block) VMEM) and partials merge through the returned
+    log-sum-exp — the naive per-block path materializes an f32
+    (S/n, S/n) score matrix per (b, h), which defeats ring attention's
+    memory point at real context lengths. Three block cases under
+    lax.switch: wholly-future (causal) blocks contribute an empty
+    partial, the diagonal block runs the causal kernel, past blocks the
+    full kernel.
     """
     b, h, s_local, d = q.shape
     if sm_scale is None:
@@ -67,6 +77,53 @@ def ring_attention_p(q, k, v, axis_name, *, causal=False, sm_scale=None):
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if use_flash:
+        from ..ops.pallas.flash_attention import flash_attention
+
+        def step(carry, t):
+            k_t, v_t, lse_acc, o_acc = carry
+            src = (idx - t) % n
+
+            def _empty(args):
+                qq, _, _ = args
+                return (jnp.zeros_like(o_acc),
+                        jnp.full((b, h, s_local), NEG_INF, jnp.float32))
+
+            def _diag(args):
+                qq, kk, vv = args
+                o2, lse2 = flash_attention(qq, kk, vv, causal=True,
+                                           sm_scale=sm_scale,
+                                           return_lse=True)
+                return o2.astype(jnp.float32), lse2
+
+            def _full(args):
+                qq, kk, vv = args
+                o2, lse2 = flash_attention(qq, kk, vv, causal=False,
+                                           sm_scale=sm_scale,
+                                           return_lse=True)
+                return o2.astype(jnp.float32), lse2
+
+            if causal:
+                case = jnp.where(src > idx, 0, jnp.where(src == idx, 1, 2))
+            else:
+                case = jnp.full((), 2, jnp.int32)
+            o2, lse2 = jax.lax.switch(case, [_empty, _diag, _full],
+                                      (q, k_t, v_t))
+            # merge two normalized partials through their lse
+            lse_new = jnp.logaddexp(lse_acc, lse2)
+            c1 = jnp.exp(lse_acc - lse_new)[..., None]
+            c2 = jnp.exp(lse2 - lse_new)[..., None]
+            o_acc = o_acc * c1 + o2 * c2
+            k_t = jax.lax.ppermute(k_t, axis_name, perm)
+            v_t = jax.lax.ppermute(v_t, axis_name, perm)
+            return (k_t, v_t, lse_new, o_acc), None
+
+        lse0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+        o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+        (k, v, lse, o), _ = jax.lax.scan(step, (k, v, lse0, o0),
+                                         jnp.arange(n))
+        return o.astype(q.dtype)
 
     q_pos = idx * s_local + jnp.arange(s_local)
 
