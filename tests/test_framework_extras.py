@@ -389,3 +389,86 @@ class TestConfigProtoTransferGuard:
         with pytest.raises(stf.errors.InvalidArgumentError,
                            match="keep large results on device"):
             sess.run(big, feed)
+
+
+class TestMakeCallable:
+    """make_callable fast path (ref session.py make_callable): resolved
+    once, per-call dispatch goes straight to the cached XLA step."""
+
+    def test_training_loop_matches_run(self):
+        stf.reset_default_graph()
+        rng = np.random.RandomState(0)
+        X = rng.rand(32, 4).astype(np.float32)
+        Y = (X @ np.float32([[1], [2], [-1], [0.5]])).ravel()
+        x = stf.placeholder(stf.float32, [32, 4], name="cx")
+        y = stf.placeholder(stf.float32, [32], name="cy")
+        w = stf.Variable(np.zeros((4,), np.float32), name="cw")
+        pred = stf.reduce_sum(x * w, axis=1)
+        loss = stf.reduce_mean(stf.square(pred - y))
+        opt = stf.train.GradientDescentOptimizer(0.1)
+        train = opt.minimize(loss)
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        step_fn = sess.make_callable([train, loss], feed_list=[x, y])
+        losses = [step_fn(X, Y)[1] for _ in range(20)]
+        assert losses[-1] < losses[0] * 0.5
+        # the state the fast path advanced is the state run() sees: the
+        # loss run() computes now equals the pre-update loss of the NEXT
+        # fast-path step
+        final = sess.run(loss, {x: X, y: Y})
+        next_loss = step_fn(X, Y)[1]
+        np.testing.assert_allclose(final, next_loss, rtol=1e-5)
+
+    def test_fetch_structures_and_arity_check(self):
+        stf.reset_default_graph()
+        a = stf.placeholder(stf.float32, [2], name="fa")
+        b = stf.square(a)
+        sess = stf.Session()
+        f = sess.make_callable({"sq": b, "in": a}, feed_list=[a])
+        out1 = f(np.float32([2, 3]))
+        out2 = f(np.float32([4, 5]))  # second call = fast path
+        np.testing.assert_allclose(out1["sq"], [4, 9])
+        np.testing.assert_allclose(out2["sq"], [16, 25])
+        np.testing.assert_allclose(out2["in"], [4, 5])
+        with pytest.raises(ValueError, match="Expected 1 feed"):
+            f()
+
+    def test_host_stage_fetches_stay_on_general_path(self):
+        # string const fetch involves host handling: must still work
+        stf.reset_default_graph()
+        a = stf.placeholder(stf.float32, [2], name="ha")
+        s = stf.constant(np.asarray(["x", "y"], object))
+        sess = stf.Session()
+        f = sess.make_callable([stf.square(a), s], feed_list=[a])
+        for _ in range(3):
+            sq, sv = f(np.float32([1, 2]))
+            np.testing.assert_allclose(sq, [1, 4])
+
+    def test_fast_path_validates_shape_and_closed_session(self):
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [4], name="vx")
+        y = stf.square(x)
+        sess = stf.Session()
+        f = sess.make_callable(y, feed_list=[x])
+        f(np.ones(4, np.float32))
+        f(np.ones(4, np.float32))  # adopted
+        with pytest.raises(ValueError, match="Cannot feed value of shape"):
+            f(np.ones((4, 1), np.float32))
+        sess.close()
+        with pytest.raises(RuntimeError, match="closed Session"):
+            f(np.ones(4, np.float32))
+
+    def test_fast_path_honors_transfer_guard(self):
+        stf.reset_default_graph()
+        cfg = stf.ConfigProto(transfer_guard="disallow",
+                              transfer_guard_threshold_bytes=1024)
+        x = stf.placeholder(stf.float32, [64, 64], name="tx")
+        y = stf.reduce_sum(x)
+        sess = stf.Session(config=cfg)
+        f = sess.make_callable(y, feed_list=[x])
+        big = np.ones((64, 64), np.float32)
+        f(big)  # slow-path warmups (n_calls 1..2 allowed)
+        with pytest.raises(stf.errors.InvalidArgumentError,
+                           match="prefetch_to_device"):
+            for _ in range(3):
+                f(big)
