@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Sweep XLA TPU flags / batch sizes over the ResNet-50 train step and
+report sec/step + bytes-accessed. Each config runs in a subprocess because
+XLA_FLAGS is read at backend init.
+
+Usage: python benchmarks/flag_sweep.py            # run the sweep
+       python benchmarks/flag_sweep.py --one B F  # worker mode (internal)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIGS = [
+    ("base-256", 256, ""),
+    ("vmem64m-256", 256, "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    ("vmem96m-256", 256, "--xla_tpu_scoped_vmem_limit_kib=98304"),
+    ("base-128", 128, ""),
+    ("vmem64m-512", 512, "--xla_tpu_scoped_vmem_limit_kib=65536"),
+]
+
+
+def worker(batch, steps=20):
+    import time
+
+    import jax
+
+    from benchmarks._resnet_builder import build_train_step
+
+    train_step, params, x, y = build_train_step(batch, 224,
+                                                bn_mode="bf16_apply")
+    loss, params = train_step(params, x, y)
+    jax.block_until_ready(loss)
+    compiled = train_step.lower(params, x, y).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params = train_step(params, x, y)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+    print(json.dumps({
+        "sec_per_step": round(dt, 5),
+        "img_per_sec": round(batch / dt, 1),
+        "bytes_accessed_gb": round(cost.get("bytes accessed", 0) / 1e9, 2),
+        "mfu": round(3 * 4.089e9 * batch / dt / 197e12, 4),
+    }))
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--one":
+        worker(int(sys.argv[2]))
+        return
+    results = {}
+    for name, batch, flags in CONFIGS:
+        env = dict(os.environ)
+        if flags:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", str(batch)],
+            capture_output=True, text=True, env=env, timeout=560)
+        line = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        results[name] = json.loads(line[-1]) if line else {
+            "error": (p.stderr or "")[-300:]}
+        print(name, "->", json.dumps(results[name]), flush=True)
+    with open("artifacts/flag_sweep.json", "w") as f:
+        json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -913,6 +913,7 @@ class BaseSession:
                         "CheckNumerics failed — tensor had NaN/Inf values: "
                         + "; ".join(bad))
             self._variable_store.values = dict(new_state)
+            self._apply_declared_shardings(new_state.keys())
             step.n_calls += 1
             dev_map = dict(zip(step.device_fetches, fetch_vals))
             values = []

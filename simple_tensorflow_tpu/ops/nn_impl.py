@@ -9,6 +9,7 @@ for bf16 activations (TPU numerics contract).
 from __future__ import annotations
 
 import builtins
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -34,33 +35,108 @@ def _moments_impl(x, axes=None, keepdims=False):
 op_registry.register_pure("Moments", _moments_impl, n_outputs=2)
 
 
-def _fused_bn_impl(x, scale, offset, mean=None, variance=None, epsilon=1e-3,
-                   is_training=True, data_format="NHWC"):
-    # Statistics reduce in f32 (XLA fuses the bf16->f32 convert into the
-    # reduction — no full-size f32 tensor is materialized), but the
-    # elementwise apply stays in x.dtype via per-CHANNEL f32 scale/bias.
-    # The previous full-f32 normalize materialized f32 activations through
-    # fwd AND vjp, doubling HBM traffic and capping ResNet-50 at 16% MFU
-    # (bandwidth-bound: ~77 GB/step); this form cuts it to bf16-sized
-    # traffic while keeping the f32-statistics numerics contract.
-    ch_axis = -1 if data_format == "NHWC" else 1
-    red_axes = builtins.tuple(i for i in builtins.range(x.ndim)
-                              if i != (x.ndim - 1 if ch_axis == -1 else 1))
+def _bn_ch_shape(x, red_axes):
     shape = [1] * x.ndim
-    shape[ch_axis if ch_axis >= 0 else x.ndim - 1] = x.shape[ch_axis]
-    if is_training:
-        xf = x.astype(jnp.float32)
-        batch_mean = jnp.mean(xf, axis=red_axes)
-        # two-pass variance: E[(x-mean)^2], stable for large-mean channels
-        # (E[x^2]-E[x]^2 cancels catastrophically in f32 when mean >> std)
-        batch_var = jnp.mean(jnp.square(xf - batch_mean.reshape(shape)),
-                             axis=red_axes)
+    for i in builtins.range(x.ndim):
+        if i not in red_axes:
+            shape[i] = x.shape[i]
+    return shape
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, scale, offset, epsilon, red_axes):
+    out, _, _, mean, var = _bn_train_fwd_impl(x, scale, offset, epsilon,
+                                              red_axes)
+    return out, mean, var
+
+
+def _bn_train_fwd_impl(x, scale, offset, epsilon, red_axes):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=red_axes)
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        # One-pass f32 statistics: both reductions read x once (XLA emits
+        # one multi-output fusion with the convert folded in, so no
+        # full-size f32 tensor is materialized). E[x^2]-E[x]^2 in f32 over
+        # half-precision data loses ~(mean^2/var)*2^-24 relative accuracy —
+        # far below the quantization already present in the activations.
+        meansq = jnp.mean(jnp.square(xf), axis=red_axes)
+        var = jnp.maximum(meansq - jnp.square(mean), 0.0)
     else:
-        batch_mean, batch_var = mean.astype(jnp.float32), variance.astype(jnp.float32)
-    inv = jax.lax.rsqrt(batch_var + epsilon) * scale.astype(jnp.float32)
+        # f32+ inputs carry 24-bit mantissas, where E[x^2]-E[x]^2 cancels
+        # catastrophically for mean >> std; pay the second read of x for
+        # the centered two-pass form. (Safe under the custom VJP: the
+        # backward never differentiates through this, so no full-size
+        # residual is saved either way.)
+        shape = _bn_ch_shape(x, red_axes)
+        var = jnp.mean(jnp.square(xf - mean.reshape(shape)), axis=red_axes)
+    inv = jax.lax.rsqrt(var + epsilon)
+    shape = _bn_ch_shape(x, red_axes)
     # subtract-first in x.dtype: (x - mean) is near-exact for x close to
     # mean (Sterbenz), unlike folding mean into a bias term where x*inv and
     # bias are large same-magnitude values rounded before cancelling
+    out = (x - mean.reshape(shape).astype(x.dtype)) \
+        * (inv * scale.astype(jnp.float32)).reshape(shape).astype(x.dtype) \
+        + offset.reshape(shape).astype(x.dtype)
+    return out, mean, inv, mean, var
+
+
+def _bn_train_fwd(x, scale, offset, epsilon, red_axes):
+    out, mean, inv, _, var = _bn_train_fwd_impl(x, scale, offset, epsilon,
+                                                red_axes)
+    # Residuals are the bf16 activations plus per-channel f32 stats — the
+    # default-autodiff path instead saved a full-size f32 (x - mean) tensor
+    # per BN layer, which made ResNet-50 HBM-bound (~90 GB/step).
+    return (out, mean, var), (x, scale, mean, inv)
+
+
+def _bn_train_bwd(epsilon, red_axes, res, cts):
+    x, scale, mean, inv = res
+    dy, dmean_ct, dvar_ct = cts
+    n = 1
+    for i in red_axes:
+        n *= x.shape[i]
+    n = jnp.float32(n)
+    shape = _bn_ch_shape(x, red_axes)
+    scale_f = scale.astype(jnp.float32)
+    # x_hat recomputed elementwise from bf16 x (fuses into the reductions;
+    # cheaper than storing an f32 residual)
+    xc = x.astype(jnp.float32) - mean.reshape(shape)
+    x_hat = xc * inv.reshape(shape)
+    dyf = dy.astype(jnp.float32)
+    sum_dy = jnp.sum(dyf, axis=red_axes)
+    sum_dy_xhat = jnp.sum(dyf * x_hat, axis=red_axes)
+    # d(out)/dx through the batch statistics (standard BN backward), plus
+    # the cotangents that arrive on the mean/var outputs themselves
+    # (moving-average updates): d mean/dx = 1/n, d var/dx = 2(x-mean)/n
+    # for the one-pass E[x^2]-E[x]^2 form as well.
+    dx = (scale_f * inv).reshape(shape) * (
+        dyf - (sum_dy / n).reshape(shape) - x_hat * (sum_dy_xhat / n).reshape(shape))
+    dx = dx + (dmean_ct.astype(jnp.float32) / n).reshape(shape)
+    dx = dx + (2.0 * dvar_ct.astype(jnp.float32) / n).reshape(shape) * xc
+    return (dx.astype(x.dtype), sum_dy_xhat.astype(scale.dtype),
+            sum_dy.astype(scale.dtype))
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
+def _fused_bn_impl(x, scale, offset, mean=None, variance=None, epsilon=1e-3,
+                   is_training=True, data_format="NHWC"):
+    # Statistics reduce in f32 (TPU numerics contract); the elementwise
+    # apply stays in x.dtype. Training mode uses a custom VJP so the only
+    # full-size residual is the bf16 input itself — see _bn_train_fwd.
+    ch_axis = x.ndim - 1 if data_format == "NHWC" else 1
+    red_axes = builtins.tuple(i for i in builtins.range(x.ndim)
+                              if i != ch_axis)
+    if is_training:
+        out, batch_mean, batch_var = _bn_train(x, scale, offset,
+                                               builtins.float(epsilon),
+                                               red_axes)
+        return [out, batch_mean, batch_var]
+    shape = _bn_ch_shape(x, red_axes)
+    batch_mean = mean.astype(jnp.float32)
+    batch_var = variance.astype(jnp.float32)
+    inv = jax.lax.rsqrt(batch_var + epsilon) * scale.astype(jnp.float32)
     out = (x - batch_mean.reshape(shape).astype(x.dtype)) \
         * inv.reshape(shape).astype(x.dtype) \
         + offset.reshape(shape).astype(x.dtype)

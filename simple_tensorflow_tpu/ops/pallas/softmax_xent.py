@@ -3,9 +3,12 @@
 Replaces the reference's two-kernel softmax→xent chain
 (ref: tensorflow/core/kernels/xent_op.cc, softmax_op.cc). For LM/BERT-size
 vocabularies the [batch, vocab] logits tensor dominates HBM traffic; this
-kernel streams each row block once, computing max, logsumexp and the label
-logit in a single pass, and the backward emits (softmax - onehot) * g
-without re-reading intermediates.
+kernel streams each row once, vocab-block by vocab-block, maintaining the
+online-softmax running (max, sumexp) plus the label logit, so VMEM holds
+only a (block_rows, block_vocab) tile regardless of vocabulary size (a
+full-row tile at 128×30522×f32 double-buffered is 30 MB — twice the 16 MB
+scoped-VMEM budget). The backward emits (softmax - onehot) * g blockwise
+from the saved logsumexp without re-reading intermediates.
 
 logits: (rows, vocab) any float dtype; labels: (rows,) int32 (carried as
 (rows, 1) tiles — Mosaic-legal shapes). Returns per-row loss, f32.
@@ -18,81 +21,120 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from .common import cdiv, pad_dim, round_up, use_interpret
+from .common import NEG_INF, cdiv, pad_dim, round_up, use_interpret
 
-DEFAULT_BLOCK_ROWS = 128
+DEFAULT_BLOCK_ROWS = 256
+DEFAULT_BLOCK_VOCAB = 2048
 
 
-def _fwd_kernel(logits_ref, labels_ref, loss_ref, lse_ref):
-    x = logits_ref[:].astype(jnp.float32)           # (br, vocab)
+def _fwd_kernel(vocab, n_vblocks, logits_ref, labels_ref, loss_ref, lse_ref,
+                m_ref, s_ref, ll_ref):
+    j = pl.program_id(1)
+    x = logits_ref[:].astype(jnp.float32)           # (br, bv)
     labels = labels_ref[:]                          # (br, 1)
-    m = jnp.max(x, axis=-1, keepdims=True)
-    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
-    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    label_logit = jnp.sum(
+    bv = x.shape[1]
+    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(cols < vocab, x, NEG_INF)         # mask the ragged edge
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        s_ref[:] = jnp.zeros(s_ref.shape, jnp.float32)
+        ll_ref[:] = jnp.zeros(ll_ref.shape, jnp.float32)
+
+    m_prev = m_ref[:]
+    m_blk = jnp.max(x, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    s_ref[:] = s_ref[:] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(x - m_new), axis=-1, keepdims=True)
+    m_ref[:] = m_new
+    ll_ref[:] = ll_ref[:] + jnp.sum(
         jnp.where(cols == labels, x, 0.0), axis=-1, keepdims=True)
-    loss_ref[:] = lse - label_logit
-    lse_ref[:] = lse
+
+    @pl.when(j == n_vblocks - 1)
+    def _finish():
+        lse = m_ref[:] + jnp.log(s_ref[:])
+        loss_ref[:] = lse - ll_ref[:]
+        lse_ref[:] = lse
 
 
-def _bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dx_ref):
+def _bwd_kernel(vocab, logits_ref, labels_ref, lse_ref, g_ref, dx_ref):
+    j = pl.program_id(1)
     x = logits_ref[:].astype(jnp.float32)
     labels = labels_ref[:]                          # (br, 1)
     lse = lse_ref[:]                                # (br, 1)
     g = g_ref[:]                                    # (br, 1)
     p = jnp.exp(x - lse)
-    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    bv = x.shape[1]
+    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     onehot = (cols == labels).astype(jnp.float32)
-    dx_ref[:] = ((p - onehot) * g).astype(dx_ref.dtype)
+    dx = jnp.where(cols < vocab, (p - onehot) * g, 0.0)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
-def _fwd(logits, labels, block_rows):
+def _block_sizes(vocab, block_vocab):
+    # No padding: both kernels mask loads past `vocab` (cols < vocab), so a
+    # ragged final block is fine and the [rows, vocab] tensor — the whole
+    # reason this kernel exists — is never copied just to round its shape.
+    bv = min(block_vocab, round_up(vocab, 128))
+    return bv, cdiv(vocab, bv)
+
+
+def _fwd(logits, labels, block_rows, block_vocab):
     rows, vocab = logits.shape
+    bv, nv = _block_sizes(vocab, block_vocab)
     loss, lse = pl.pallas_call(
-        _fwd_kernel,
-        grid=(cdiv(rows, block_rows),),
+        functools.partial(_fwd_kernel, vocab, nv),
+        grid=(cdiv(rows, block_rows), nv),
         in_specs=[
-            pl.BlockSpec((block_rows, vocab), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, bv), lambda i, j: (i, j)),
+            pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_rows, 1), jnp.float32),
+            pltpu.VMEM((block_rows, 1), jnp.float32),
+            pltpu.VMEM((block_rows, 1), jnp.float32),
         ],
         interpret=use_interpret(),
     )(logits, labels)
     return loss, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _xent_2d(logits, labels, block_rows):
-    loss, _ = _fwd(logits, labels, block_rows)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xent_2d(logits, labels, block_rows, block_vocab):
+    loss, _ = _fwd(logits, labels, block_rows, block_vocab)
     return loss
 
 
-def _xent_fwd_rule(logits, labels, block_rows):
-    loss, lse = _fwd(logits, labels, block_rows)
+def _xent_fwd_rule(logits, labels, block_rows, block_vocab):
+    loss, lse = _fwd(logits, labels, block_rows, block_vocab)
     return loss, (logits, labels, lse)
 
 
-def _xent_bwd_rule(block_rows, res, g):
+def _xent_bwd_rule(block_rows, block_vocab, res, g):
     logits, labels, lse = res
     rows, vocab = logits.shape
+    bv, nv = _block_sizes(vocab, block_vocab)
     dx = pl.pallas_call(
-        _bwd_kernel,
-        grid=(cdiv(rows, block_rows),),
+        functools.partial(_bwd_kernel, vocab),
+        grid=(cdiv(rows, block_rows), nv),
         in_specs=[
-            pl.BlockSpec((block_rows, vocab), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, bv), lambda i, j: (i, j)),
+            pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((block_rows, vocab), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_rows, bv), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((rows, vocab), logits.dtype),
         interpret=use_interpret(),
     )(logits, labels, lse, g)
@@ -103,7 +145,8 @@ _xent_2d.defvjp(_xent_fwd_rule, _xent_bwd_rule)
 
 
 def softmax_cross_entropy(logits, labels, *,
-                          block_rows=DEFAULT_BLOCK_ROWS):
+                          block_rows=DEFAULT_BLOCK_ROWS,
+                          block_vocab=DEFAULT_BLOCK_VOCAB):
     """Per-example sparse softmax xent. logits: (..., vocab),
     labels: (...,) int. Returns f32 loss of shape (...)."""
     orig = logits.shape
@@ -117,7 +160,7 @@ def softmax_cross_entropy(logits, labels, *,
     rp = round_up(rows, block_rows)
     l2 = pad_dim(l2, 0, rp)
     lab = pad_dim(lab, 0, rp)
-    loss = _xent_2d(l2, lab, int(block_rows))
+    loss = _xent_2d(l2, lab, int(block_rows), int(block_vocab))
     return loss[:rows, 0].reshape(orig[:-1])
 
 
