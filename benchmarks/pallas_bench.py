@@ -2,15 +2,22 @@
 """Pallas kernel microbenchmarks vs XLA-native compositions (SURVEY §2.4).
 
 For each fused kernel, times the Pallas implementation against the
-equivalent jnp/XLA composition at BERT-base / Transformer-big shapes, on
-whatever backend jax picks (real numbers only mean something on TPU; on
-CPU the kernels run in interpret mode and this is a smoke test, flagged
-in the output).
+equivalent jnp/XLA composition at BERT-base / Transformer-big shapes.
+
+Timing methodology: per-call dispatch over the axon relay costs tens of
+milliseconds and `jax.block_until_ready` can return early (see
+artifacts/resnet_perf_diagnosis.md), so timing individual calls measures
+the tunnel, not the kernel. Instead each measurement builds ONE jitted
+`lax.scan` whose body runs the op and feeds its output back into the next
+iteration's input (a data dependency XLA cannot elide), so N on-device
+iterations cost one dispatch; the final host fetch is the sync barrier.
+The chain-step overhead is identical for the Pallas and XLA variants, so
+the speedup ratio is clean even where the absolute time includes it.
 
 Writes JSON lines to stdout and, with --out, a JSON file (committed as
 artifacts/pallas_bench_<device>.json for the judge).
 
-Usage: python benchmarks/pallas_bench.py [--repeats 50] [--smoke] [--out F]
+Usage: python benchmarks/pallas_bench.py [--iters 20] [--smoke] [--out F]
 """
 
 import argparse
@@ -23,21 +30,41 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+ITERS = 20
 
-def timeit(fn, *args, repeats=50, warmup=3):
+
+def chain_time(step, carry, iters, repeats=2):
+    """step: carry -> carry, run `iters` times inside one jitted scan.
+    Returns seconds per iteration. Hard host-fetch sync (axon-safe)."""
     import jax
 
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
+    @jax.jit
+    def loop(c):
+        def body(c, _):
+            return step(c), ()
+        c, _ = jax.lax.scan(body, c, None, length=iters)
+        # 1-element sync handle: fetching it barriers the whole loop
+        # without paying a full-array host transfer inside the timed region
+        return jax.tree_util.tree_leaves(c)[0].ravel()[:1]
+
+    np.asarray(loop(carry))  # compile + sync
+    best = float("inf")
     for _ in range(repeats):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / repeats
+        t0 = time.perf_counter()
+        np.asarray(loop(carry))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
 
 
-def bench_flash_attention(shapes, repeats):
+def _norm(x):
+    """Rescale a gradient so chained iterates stay finite (perf-neutral)."""
+    import jax.numpy as jnp
+
+    m = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return (x.astype(jnp.float32) / jnp.maximum(m, 1e-6)).astype(x.dtype)
+
+
+def bench_flash_attention(shapes, iters):
     import jax
     import jax.numpy as jnp
 
@@ -49,37 +76,38 @@ def bench_flash_attention(shapes, repeats):
         q, k, v = (jax.random.normal(jax.random.key(i), (b, h, s, d),
                                      jnp.bfloat16) for i in range(3))
 
-        def loss_flash(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, causal=causal)
-                           .astype(jnp.float32))
+        def run(attn):
+            def fwd_step(c):
+                return attn(c, k, v).astype(c.dtype)
 
-        def loss_ref(q, k, v):
-            return jnp.sum(mha_reference(q, k, v, causal=causal)
-                           .astype(jnp.float32))
+            def loss(c):
+                return jnp.sum(attn(c, k, v).astype(jnp.float32))
 
-        fwd_p = jax.jit(lambda q, k, v: flash_attention(q, k, v,
-                                                        causal=causal))
-        fwd_x = jax.jit(lambda q, k, v: mha_reference(q, k, v,
-                                                      causal=causal))
-        bwd_p = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
-        bwd_x = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
-        tp = timeit(fwd_p, q, k, v, repeats=repeats)
-        tx = timeit(fwd_x, q, k, v, repeats=repeats)
-        tbp = timeit(bwd_p, q, k, v, repeats=repeats)
-        tbx = timeit(bwd_x, q, k, v, repeats=repeats)
+            gf = jax.grad(loss)
+
+            def bwd_step(c):
+                return _norm(gf(c))
+
+            return (chain_time(fwd_step, q, iters),
+                    chain_time(bwd_step, q, iters))
+
+        tp, tbp = run(lambda q_, k_, v_: flash_attention(q_, k_, v_,
+                                                         causal=causal))
+        tx, tbx = run(lambda q_, k_, v_: mha_reference(q_, k_, v_,
+                                                       causal=causal))
         rows.append({
             "kernel": "flash_attention", "shape": name, "causal": causal,
             "pallas_fwd_us": round(tp * 1e6, 1),
             "xla_fwd_us": round(tx * 1e6, 1),
             "fwd_speedup": round(tx / tp, 3),
-            "pallas_bwd_us": round(tbp * 1e6, 1),
-            "xla_bwd_us": round(tbx * 1e6, 1),
+            "pallas_fwdbwd_us": round(tbp * 1e6, 1),
+            "xla_fwdbwd_us": round(tbx * 1e6, 1),
             "bwd_speedup": round(tbx / tbp, 3),
         })
     return rows
 
 
-def bench_layer_norm(shapes, repeats):
+def bench_layer_norm(shapes, iters):
     import jax
     import jax.numpy as jnp
 
@@ -87,39 +115,41 @@ def bench_layer_norm(shapes, repeats):
         layer_norm, layer_norm_reference)
 
     rows = []
-    for name, (rows_n, d) in shapes:
-        x = jax.random.normal(jax.random.key(0), (rows_n, d), jnp.bfloat16)
-        g = jnp.ones((d,), jnp.float32)
-        b = jnp.zeros((d,), jnp.float32)
+    for name, (rows_n, dim) in shapes:
+        x = jax.random.normal(jax.random.key(0), (rows_n, dim), jnp.bfloat16)
+        g = jnp.ones((dim,), jnp.float32)
+        b = jnp.zeros((dim,), jnp.float32)
 
-        def loss_p(x, g, b):
-            return jnp.sum(layer_norm(x, g, b).astype(jnp.float32))
+        def run(ln):
+            def fwd_step(c):
+                return ln(c, g, b).astype(c.dtype)
 
-        def loss_x(x, g, b):
-            return jnp.sum(layer_norm_reference(x, g, b)
-                           .astype(jnp.float32))
+            def loss(c):
+                return jnp.sum(ln(c, g, b).astype(jnp.float32))
 
-        fwd_p = jax.jit(layer_norm)
-        fwd_x = jax.jit(layer_norm_reference)
-        bwd_p = jax.jit(jax.grad(loss_p, argnums=(0, 1, 2)))
-        bwd_x = jax.jit(jax.grad(loss_x, argnums=(0, 1, 2)))
-        tp = timeit(fwd_p, x, g, b, repeats=repeats)
-        tx = timeit(fwd_x, x, g, b, repeats=repeats)
-        tbp = timeit(bwd_p, x, g, b, repeats=repeats)
-        tbx = timeit(bwd_x, x, g, b, repeats=repeats)
+            gf = jax.grad(loss)
+
+            def bwd_step(c):
+                return _norm(gf(c))
+
+            return (chain_time(fwd_step, x, iters),
+                    chain_time(bwd_step, x, iters))
+
+        tp, tbp = run(layer_norm)
+        tx, tbx = run(layer_norm_reference)
         rows.append({
             "kernel": "layer_norm", "shape": name,
             "pallas_fwd_us": round(tp * 1e6, 1),
             "xla_fwd_us": round(tx * 1e6, 1),
             "fwd_speedup": round(tx / tp, 3),
-            "pallas_bwd_us": round(tbp * 1e6, 1),
-            "xla_bwd_us": round(tbx * 1e6, 1),
+            "pallas_fwdbwd_us": round(tbp * 1e6, 1),
+            "xla_fwdbwd_us": round(tbx * 1e6, 1),
             "bwd_speedup": round(tbx / tbp, 3),
         })
     return rows
 
 
-def bench_softmax_xent(shapes, repeats):
+def bench_softmax_xent(shapes, iters):
     import jax
     import jax.numpy as jnp
 
@@ -129,37 +159,44 @@ def bench_softmax_xent(shapes, repeats):
     rows = []
     for name, (n, vocab) in shapes:
         logits = jax.random.normal(jax.random.key(0), (n, vocab),
-                                   jnp.float32)
+                                   jnp.bfloat16) * 3.0
         labels = jax.random.randint(jax.random.key(1), (n,), 0, vocab)
 
-        def loss_p(lg):
-            return jnp.sum(softmax_cross_entropy(lg, labels))
+        def run(xent):
+            def fwd_step(c):
+                # fold the per-row loss back in: keeps the chain honest for
+                # a reduction-output op at one extra elementwise pass,
+                # identical for both variants
+                loss = xent(c, labels)
+                return (c + 1e-6 * loss[:, None].astype(c.dtype)
+                        ).astype(c.dtype)
 
-        def loss_x(lg):
-            return jnp.sum(softmax_cross_entropy_reference(lg, labels))
+            def lsum(c):
+                return jnp.sum(xent(c, labels))
 
-        fwd_p = jax.jit(lambda lg: softmax_cross_entropy(lg, labels))
-        fwd_x = jax.jit(
-            lambda lg: softmax_cross_entropy_reference(lg, labels))
-        bwd_p = jax.jit(jax.grad(loss_p))
-        bwd_x = jax.jit(jax.grad(loss_x))
-        tp = timeit(fwd_p, logits, repeats=repeats)
-        tx = timeit(fwd_x, logits, repeats=repeats)
-        tbp = timeit(bwd_p, logits, repeats=repeats)
-        tbx = timeit(bwd_x, logits, repeats=repeats)
+            gf = jax.grad(lsum)
+
+            def bwd_step(c):
+                return _norm(gf(c))
+
+            return (chain_time(fwd_step, logits, iters),
+                    chain_time(bwd_step, logits, iters))
+
+        tp, tbp = run(softmax_cross_entropy)
+        tx, tbx = run(softmax_cross_entropy_reference)
         rows.append({
             "kernel": "softmax_xent", "shape": name,
             "pallas_fwd_us": round(tp * 1e6, 1),
             "xla_fwd_us": round(tx * 1e6, 1),
             "fwd_speedup": round(tx / tp, 3),
-            "pallas_bwd_us": round(tbp * 1e6, 1),
-            "xla_bwd_us": round(tbx * 1e6, 1),
+            "pallas_fwdbwd_us": round(tbp * 1e6, 1),
+            "xla_fwdbwd_us": round(tbx * 1e6, 1),
             "bwd_speedup": round(tbx / tbp, 3),
         })
     return rows
 
 
-def bench_quant_matmul(shapes, repeats):
+def bench_quant_matmul(shapes, iters):
     import jax
     import jax.numpy as jnp
 
@@ -172,10 +209,16 @@ def bench_quant_matmul(shapes, repeats):
         w = jax.random.normal(jax.random.key(1), (k, n), jnp.float32)
         wq, scale = quantize_colwise(w)
 
-        f_p = jax.jit(quant_matmul)
-        f_x = jax.jit(quant_matmul_reference)
-        tp = timeit(f_p, x, wq, scale, repeats=repeats)
-        tx = timeit(f_x, x, wq, scale, repeats=repeats)
+        def run(qmm):
+            def fwd_step(c):
+                out = qmm(c, wq, scale)                    # (m, n)
+                return _norm(out[:, :k]) if n >= k else _norm(
+                    jnp.pad(out, ((0, 0), (0, k - n))))
+
+            return chain_time(fwd_step, x, iters)
+
+        tp = run(quant_matmul)
+        tx = run(quant_matmul_reference)
         rows.append({
             "kernel": "quant_matmul", "shape": name,
             "pallas_fwd_us": round(tp * 1e6, 1),
@@ -187,19 +230,31 @@ def bench_quant_matmul(shapes, repeats):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--repeats", type=int, default=50)
+    ap.add_argument("--iters", type=int, default=ITERS,
+                    help="scan length per measurement")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes (CPU interpret mode)")
     ap.add_argument("--out", default=None)
     ap.add_argument("--kernels", default="flash,ln,xent,quant")
+    ap.add_argument("--shapes", default=None,
+                    help="comma-separated shape-name filter")
     args = ap.parse_args()
 
     import jax
 
+    # Remote AOT compiles cost 30-60 s each; cache them across runs.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     os.path.join(repo_root, ".jax_cache")))
+
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     smoke = args.smoke or not on_tpu
-    repeats = 5 if smoke else args.repeats
+    # smoke mode is a correctness/plumbing check: interpret-mode kernels
+    # inside a jitted scan compile glacially on the 1-core CPU, so run the
+    # chain at length 1
+    iters = 1 if smoke else args.iters
 
     if smoke:
         attn_shapes = [("tiny", (1, 2, 128, 64), False)]
@@ -223,17 +278,24 @@ def main():
         qm_shapes = [("bert_ffn", (24 * 512, 768, 3072)),
                      ("tbig_ffn", (32 * 256, 1024, 4096))]
 
+    if args.shapes:
+        keep = set(args.shapes.split(","))
+        attn_shapes = [s for s in attn_shapes if s[0] in keep]
+        ln_shapes = [s for s in ln_shapes if s[0] in keep]
+        xent_shapes = [s for s in xent_shapes if s[0] in keep]
+        qm_shapes = [s for s in qm_shapes if s[0] in keep]
+
     results = {"device": str(dev), "platform": dev.platform,
-               "smoke_mode": smoke, "repeats": repeats, "rows": []}
+               "smoke_mode": smoke, "iters": iters, "rows": []}
     kernels = set(args.kernels.split(","))
     if "flash" in kernels:
-        results["rows"] += bench_flash_attention(attn_shapes, repeats)
+        results["rows"] += bench_flash_attention(attn_shapes, iters)
     if "ln" in kernels:
-        results["rows"] += bench_layer_norm(ln_shapes, repeats)
+        results["rows"] += bench_layer_norm(ln_shapes, iters)
     if "xent" in kernels:
-        results["rows"] += bench_softmax_xent(xent_shapes, repeats)
+        results["rows"] += bench_softmax_xent(xent_shapes, iters)
     if "quant" in kernels:
-        results["rows"] += bench_quant_matmul(qm_shapes, repeats)
+        results["rows"] += bench_quant_matmul(qm_shapes, iters)
 
     for row in results["rows"]:
         print(json.dumps(row))

@@ -42,8 +42,11 @@ def quantize_colwise(w):
 
 
 def _qmm_kernel(xq_ref, wq_ref, xs_ref, ws_ref, o_ref):
+    # Operands stay s8: Mosaic lowers s8 x s8 -> s32 onto the MXU's native
+    # int8 path (2x bf16 rate); widening to i32 first produces an i32
+    # matmul Mosaic rejects ("Bad lhs/rhs type: vector<...xi32>").
     acc = jax.lax.dot_general(
-        xq_ref[:].astype(jnp.int32), wq_ref[:].astype(jnp.int32),
+        xq_ref[:], wq_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)          # (tm, tn)
     scale = xs_ref[:] * ws_ref[:]                  # (tm,1)*(1,tn) -> (tm,tn)

@@ -184,6 +184,55 @@ class TestNorm:
         np.testing.assert_allclose(out["y"].mean((0, 1, 2)), np.zeros(3),
                                    atol=1e-4)
 
+    def test_fused_batch_norm_large_mean_f32_stable(self):
+        # f32 inputs take the centered two-pass variance: with mean >> std,
+        # the one-pass E[x^2]-E[x]^2 form cancels catastrophically in f32
+        # and would report var ~ 0 here.
+        x = (RNG.randn(64, 2, 2, 3) + 1e4).astype(np.float32)
+        y, m, v = stf.nn.fused_batch_norm(
+            stf.constant(x), scale=stf.constant(np.ones(3, np.float32)),
+            offset=stf.constant(np.zeros(3, np.float32)), is_training=True)
+        out = _run({"y": y, "v": v})
+        np.testing.assert_allclose(out["v"], x.var((0, 1, 2)), rtol=1e-2)
+        assert np.abs(out["y"]).max() < 10.0
+
+    def test_fused_batch_norm_gradient_matches_reference(self):
+        # the custom VJP (ops/nn_impl.py _bn_train_bwd) against plain
+        # autodiff of an equivalent composed expression
+        import jax
+        import jax.numpy as jnp
+
+        from simple_tensorflow_tpu.ops.nn_impl import _bn_train
+
+        x = jnp.asarray(RNG.randn(8, 3, 3, 4).astype(np.float32)) * 2 + 1
+        s = jnp.asarray(RNG.randn(4).astype(np.float32))
+        o = jnp.asarray(RNG.randn(4).astype(np.float32))
+
+        def ref(x, s, o):
+            m = jnp.mean(x, axis=(0, 1, 2))
+            v = jnp.mean((x - m) ** 2, axis=(0, 1, 2))
+            y = (x - m) * jax.lax.rsqrt(v + 1e-3) * s + o
+            return y, m, v
+
+        cot = (jnp.asarray(RNG.randn(8, 3, 3, 4).astype(np.float32)),
+               jnp.asarray(RNG.randn(4).astype(np.float32)),
+               jnp.asarray(RNG.randn(4).astype(np.float32)))
+        _, vjp1 = jax.vjp(lambda *a: _bn_train(*a, 1e-3, (0, 1, 2)), x, s, o)
+        _, vjp2 = jax.vjp(ref, x, s, o)
+        for g1, g2 in zip(vjp1(cot), vjp2(cot)):
+            np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-4)
+
+    def test_fused_batch_norm_nchw_training(self):
+        x = RNG.rand(8, 3, 4, 4).astype(np.float32)
+        y, m, v = stf.nn.fused_batch_norm(
+            stf.constant(x), scale=stf.constant(np.ones(3, np.float32)),
+            offset=stf.constant(np.zeros(3, np.float32)),
+            is_training=True, data_format="NCHW")
+        out = _run({"y": y, "m": m, "v": v})
+        np.testing.assert_allclose(out["m"], x.mean((0, 2, 3)), rtol=1e-4)
+        np.testing.assert_allclose(out["y"].mean((0, 2, 3)), np.zeros(3),
+                                   atol=1e-4)
+
     def test_l2_normalize_l2_loss(self):
         x = np.array([3., 4.], np.float32)
         out = _run({"n": stf.nn.l2_normalize(stf.constant(x), 0),

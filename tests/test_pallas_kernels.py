@@ -252,6 +252,21 @@ class TestSoftmaxXent:
         ref = softmax_cross_entropy_reference(logits, labels)
         np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
+    def test_vocab_blocking_ragged_edge(self):
+        # vocab spanning several blocks with a ragged final block (the
+        # streamed online-softmax path, unpadded); fwd + bwd vs reference
+        logits = rand(0, (16, 700)) * 3
+        labels = jax.random.randint(jax.random.key(1), (16,), 0, 700)
+        out = softmax_cross_entropy(logits, labels, block_rows=8,
+                                    block_vocab=256)
+        ref = softmax_cross_entropy_reference(logits, labels)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+        g1 = jax.grad(lambda l: jnp.sum(softmax_cross_entropy(
+            l, labels, block_rows=8, block_vocab=256)))(logits)
+        g2 = jax.grad(lambda l: jnp.sum(
+            softmax_cross_entropy_reference(l, labels)))(logits)
+        np.testing.assert_allclose(g1, g2, atol=1e-5, rtol=1e-4)
+
 
 class TestQuantMatmul:
     def test_matches_reference_quantization(self):
