@@ -10,4 +10,5 @@ from ..ops.image_ops import (
     random_contrast, crop_to_bounding_box, pad_to_bounding_box, central_crop,
     convert_image_dtype, decode_png, encode_png, decode_jpeg, encode_jpeg,
     decode_image, random_crop, total_variation,
+    sample_distorted_bounding_box,
 )

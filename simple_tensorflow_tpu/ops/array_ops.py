@@ -873,20 +873,69 @@ def edit_distance(hypothesis, truth, normalize=True, name="edit_distance"):
 
 
 def meshgrid(*args, **kwargs):
+    """(ref: python/ops/array_ops.py ``meshgrid``). Static inputs fold to
+    constants; dynamic inputs build via reshape + broadcast (shapes are
+    static, only values are runtime — XLA-legal)."""
     indexing = kwargs.get("indexing", "xy")
-    vals = [constant_op.constant_value(ops_mod.convert_to_tensor(a))
-            for a in args]
-    if any(v is None for v in vals):
-        from . import math_ops
+    if indexing not in ("xy", "ij"):
+        raise ValueError(f"indexing must be 'xy' or 'ij': {indexing}")
+    tensors = [ops_mod.convert_to_tensor(a) for a in args]
+    vals = [constant_op.constant_value(t) for t in tensors]
+    if all(v is not None for v in vals):
+        grids = np.meshgrid(*vals, indexing=indexing)
+        return [constant(g) for g in grids]
+    n = len(tensors)
+    sizes = []
+    for t in tensors:
+        dims = t.shape.as_list()
+        if len(dims) != 1 or dims[0] is None:
+            raise ValueError(
+                "meshgrid with runtime values needs 1-D inputs of static "
+                f"length on TPU (got shape {t.shape})")
+        sizes.append(dims[0])
+    order = list(range(n))
+    if indexing == "xy" and n >= 2:
+        order[0], order[1] = order[1], order[0]
+    # grid shape: dimension j of the output varies with input order[j]
+    grid_shape = [sizes[i] for i in order]
+    outs = []
+    for idx, t in enumerate(tensors):
+        axis = order.index(idx)
+        shp = [1] * n
+        shp[axis] = sizes[idx]
+        outs.append(broadcast_to(reshape(t, shp), grid_shape))
+    return outs
 
-        # dynamic: build via broadcasting
-        raise ValueError("meshgrid needs static inputs on TPU")
-    grids = np.meshgrid(*vals, indexing=indexing)
-    return [constant(g) for g in grids]
 
-
-def required_space_to_batch_paddings(input_shape, block_shape, base_paddings=None):
-    raise NotImplementedError
+def required_space_to_batch_paddings(input_shape, block_shape,
+                                     base_paddings=None):
+    """(ref: python/ops/array_ops.py ``required_space_to_batch_paddings``).
+    Computes (paddings, crops) so that input + paddings is divisible by
+    block_shape; batch_to_space with `crops` undoes the padding. Static
+    arithmetic (XLA shapes are compile-time)."""
+    ishape = constant_op.constant_value(
+        ops_mod.convert_to_tensor(input_shape))
+    bshape = constant_op.constant_value(
+        ops_mod.convert_to_tensor(block_shape))
+    if ishape is None or bshape is None:
+        raise ValueError(
+            "required_space_to_batch_paddings needs static shapes on TPU")
+    ishape = np.asarray(ishape, np.int64).ravel()
+    bshape = np.asarray(bshape, np.int64).ravel()
+    if base_paddings is None:
+        base = np.zeros((len(ishape), 2), np.int64)
+    else:
+        base = np.asarray(
+            constant_op.constant_value(
+                ops_mod.convert_to_tensor(base_paddings)),
+            np.int64).reshape(len(ishape), 2)
+    pad_start = base[:, 0]
+    full = ishape + pad_start + base[:, 1]
+    rem = (-full) % bshape
+    pad_end = base[:, 1] + rem
+    paddings = np.stack([pad_start, pad_end], axis=1)
+    crops = np.stack([np.zeros_like(rem), rem], axis=1)
+    return constant(paddings), constant(crops)
 
 
 def guarantee_const(input, name=None):  # noqa: A002

@@ -541,11 +541,107 @@ def total_variation(images, name=None):
         math_ops.reduce_sum(math_ops.abs(dw), axis=axes)
 
 
+def _lower_sample_distorted_bbox(ctx, op, inputs):
+    """Host-stage crop-geometry sampler (the reference runs this on CPU
+    too — ref core/kernels/sample_distorted_bounding_box_op.cc). Output
+    SIZE is data-dependent by design, so this op can only feed host-side
+    consumers (decode→crop pipelines); the device graph sees the cropped
+    tensor after a static resize, exactly like the reference's input
+    pipeline."""
+    image_size = np.asarray(inputs[0]).ravel()
+    boxes = np.asarray(inputs[1], dtype=np.float32).reshape(-1, 4)
+    h, w = int(image_size[0]), int(image_size[1])
+    depth = int(image_size[2]) if image_size.size > 2 else 1
+    min_cov = float(op.attrs.get("min_object_covered", 0.1))
+    ar_lo, ar_hi = op.attrs.get("aspect_ratio_range", (0.75, 1.33))
+    area_lo, area_hi = op.attrs.get("area_range", (0.05, 1.0))
+    attempts = int(op.attrs.get("max_attempts", 100))
+    use_whole = bool(op.attrs.get("use_image_if_no_bounding_boxes", False))
+    if boxes.size == 0:
+        if not use_whole:
+            raise errors_mod.InvalidArgumentError(
+                None, None,
+                "sample_distorted_bounding_box: no bounding boxes supplied "
+                "and use_image_if_no_bounding_boxes=False")
+        boxes = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    # RNG state lives in graph-scoped storage: dies with the graph, never
+    # shared across graph rebuilds, and a fresh seed attr always takes
+    # effect (Operation uses __slots__, so no per-op attribute).
+    rngs = op.graph._scoped_state.setdefault("__sdbb_rngs__", {})
+    rng = rngs.get(op.name)
+    if rng is None:
+        seeds = op.attrs.get("seeds")  # (graph_seed, op_seed) or None
+        rng = np.random.RandomState(
+            None if seeds is None else (seeds[0] * 0x9E3779B9 + seeds[1])
+            % (2 ** 32))
+        rngs[op.name] = rng
+    best = None
+    for _ in range(attempts):
+        ar = rng.uniform(ar_lo, ar_hi)
+        area = rng.uniform(area_lo, area_hi) * h * w
+        cw = int(round(np.sqrt(area * ar)))
+        ch = int(round(np.sqrt(area / ar)))
+        if cw < 1 or ch < 1 or cw > w or ch > h:
+            continue
+        y0 = rng.randint(0, h - ch + 1)
+        x0 = rng.randint(0, w - cw + 1)
+        crop = np.array([y0 / h, x0 / w, (y0 + ch) / h, (x0 + cw) / w],
+                        np.float32)
+        # min_object_covered: the crop must contain at least this fraction
+        # of some input box's area
+        iy = np.maximum(0.0, np.minimum(crop[2], boxes[:, 2])
+                        - np.maximum(crop[0], boxes[:, 0]))
+        ix = np.maximum(0.0, np.minimum(crop[3], boxes[:, 3])
+                        - np.maximum(crop[1], boxes[:, 1]))
+        cover = iy * ix / np.maximum(
+            (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]), 1e-9)
+        if min_cov == 0.0 or np.any(cover >= min_cov):
+            best = (y0, x0, ch, cw, crop)
+            break
+    if best is None:
+        best = (0, 0, h, w, np.array([0, 0, 1, 1], np.float32))
+    y0, x0, ch, cw, crop = best
+    begin = np.array([y0, x0, 0], np.int64)
+    size = np.array([ch, cw, depth], np.int64)
+    return [begin, size, crop.reshape(1, 1, 4)]
+
+
+op_registry.register("SampleDistortedBoundingBox",
+                     lower=_lower_sample_distorted_bbox,
+                     is_stateful=True, runs_on_host=True, n_outputs=3)
+
+
 def sample_distorted_bounding_box(image_size, bounding_boxes, seed=None,
-                                  **kwargs):
-    raise NotImplementedError(
-        "sample_distorted_bounding_box: dynamic crop geometry; use "
-        "stf.image.random_crop (static size) on TPU")
+                                  min_object_covered=0.1,
+                                  aspect_ratio_range=(0.75, 1.33),
+                                  area_range=(0.05, 1.0), max_attempts=100,
+                                  use_image_if_no_bounding_boxes=False,
+                                  name=None, **kwargs):
+    """(ref: image_ops_impl.py ``sample_distorted_bounding_box``,
+    core/kernels/sample_distorted_bounding_box_op.cc). Host-stage op: the
+    sampled begin/size feed host-side slice+resize in the input pipeline
+    (crop geometry is data-dependent, so it cannot live in the XLA step)."""
+    g = ops_mod.get_default_graph()
+    inputs = [ops_mod.convert_to_tensor(image_size, dtype="int32"),
+              ops_mod.convert_to_tensor(bounding_boxes, dtype="float32")]
+    g_seed, op_seed = random_seed_mod.get_seed(seed)
+    seeds = (None if g_seed is None and op_seed is None
+             else (int(g_seed or 0), int(op_seed or 0)))
+    op = g.create_op(
+        "SampleDistortedBoundingBox", inputs,
+        attrs={"seeds": seeds,
+               "min_object_covered": float(min_object_covered),
+               "aspect_ratio_range": tuple(aspect_ratio_range),
+               "area_range": tuple(area_range),
+               "max_attempts": int(max_attempts),
+               "use_image_if_no_bounding_boxes":
+                   bool(use_image_if_no_bounding_boxes)},
+        name=name or "SampleDistortedBoundingBox",
+        output_specs=[
+            (shape_mod.TensorShape([3]), dtypes_mod.int64),
+            (shape_mod.TensorShape([3]), dtypes_mod.int64),
+            (shape_mod.TensorShape([1, 1, 4]), dtypes_mod.float32)])
+    return op.outputs[0], op.outputs[1], op.outputs[2]
 
 
 def non_max_suppression(boxes, scores, max_output_size, iou_threshold=0.5,

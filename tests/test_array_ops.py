@@ -218,3 +218,42 @@ class TestGradients:
         y = stf.reduce_sum(x * stf.stop_gradient(x))
         (g,) = stf.gradients(y, [x])
         assert _run(g).tolist() == [2.0]  # only the differentiable path
+
+
+class TestMeshgridAndSpaceToBatchPaddings:
+    def test_meshgrid_static_xy_ij(self):
+        xs, ys = stf.meshgrid(stf.constant([1, 2, 3]), stf.constant([4, 5]))
+        ref_x, ref_y = np.meshgrid([1, 2, 3], [4, 5])
+        np.testing.assert_array_equal(_run(xs), ref_x)
+        np.testing.assert_array_equal(_run(ys), ref_y)
+        xi, yi = stf.meshgrid(stf.constant([1, 2, 3]), stf.constant([4, 5]),
+                              indexing="ij")
+        ri, rj = np.meshgrid([1, 2, 3], [4, 5], indexing="ij")
+        np.testing.assert_array_equal(_run(xi), ri)
+        np.testing.assert_array_equal(_run(yi), rj)
+
+    def test_meshgrid_dynamic_values(self):
+        a = stf.placeholder(stf.float32, [3], name="mga")
+        b = stf.placeholder(stf.float32, [2], name="mgb")
+        xs, ys = stf.meshgrid(a, b)
+        av, bv = np.array([1., 2., 3.], np.float32), np.array([4., 5.],
+                                                             np.float32)
+        out = _run({"x": xs, "y": ys}, feed={a: av, b: bv})
+        rx, ry = np.meshgrid(av, bv)
+        np.testing.assert_array_equal(out["x"], rx)
+        np.testing.assert_array_equal(out["y"], ry)
+
+    def test_required_space_to_batch_paddings(self):
+        pads, crops = stf.required_space_to_batch_paddings(
+            stf.constant([5, 7]), stf.constant([3, 4]))
+        p, c = _run({"p": pads, "c": crops}).values()
+        np.testing.assert_array_equal(p, [[0, 1], [0, 1]])
+        np.testing.assert_array_equal(c, [[0, 1], [0, 1]])
+        # padded size divisible by block
+        assert (5 + p[0].sum()) % 3 == 0 and (7 + p[1].sum()) % 4 == 0
+        # with base paddings
+        pads2, _ = stf.required_space_to_batch_paddings(
+            stf.constant([5]), stf.constant([4]),
+            base_paddings=stf.constant([[1, 0]]))
+        p2 = _run(pads2)
+        assert (5 + p2[0].sum()) % 4 == 0 and p2[0][0] == 1
