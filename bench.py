@@ -81,6 +81,37 @@ def emit(result):
     sys.stdout.flush()
 
 
+def _roofline_info(sess, feed, sec_per_step, platform):
+    """bytes-accessed + achieved HBM bandwidth of the session's training
+    step (identifies whether a result is bandwidth- or compute-bound; see
+    artifacts/resnet_perf_diagnosis.md). Best-effort: recompiles through
+    the persistent cache, returns {} on any failure."""
+    if platform == "cpu":
+        return {}
+    try:
+        import jax
+
+        from simple_tensorflow_tpu.utils import perf
+
+        step = max((v for v in sess._cache.values() if v.has_device_stage),
+                   key=lambda s: len(s.device_ops))
+        feeds = sess._normalize_feeds(feed)
+        feed_args = {t.name: feeds[t] for t in step.feed_tensors}
+        state = dict(sess._variable_store.values)
+        rng = jax.random.fold_in(sess._base_key, 7)
+        compiled = step.jitted.lower(state, feed_args, rng).compile()
+        cost = perf.cost_of(compiled)
+        _, peak_bw = perf.chip_spec()
+        gbps = cost["bytes"] / sec_per_step / 1e9
+        return {
+            "bytes_accessed_gb": round(cost["bytes"] / 1e9, 2),
+            "achieved_hbm_gbps": round(gbps, 1),
+            "hbm_util": round(gbps * 1e9 / peak_bw, 3),
+        }
+    except Exception:
+        return {}
+
+
 def _measure_resnet(batch, image_size, steps, warmup, device_kind,
                     platform):
     import jax
@@ -123,6 +154,7 @@ def _measure_resnet(batch, image_size, steps, warmup, device_kind,
     achieved = images_per_sec * train_flops_per_image
     peak = detect_peak_flops(device_kind, platform)
     return {
+        **_roofline_info(sess, feed, sec_per_step, platform),
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(float(images_per_sec), 2),
         "unit": "images/sec/chip",
@@ -250,6 +282,7 @@ def _measure_bert(batch, platform, device_kind):
     mfu = tokens_per_sec * train_flops_per_token / peak
 
     return {
+        **_roofline_info(sess, feed, sec_per_step, platform),
         "metric": "bert_base_tokens_per_sec_per_chip",
         "value": round(float(tokens_per_sec), 1),
         "unit": "tokens/sec/chip",

@@ -85,7 +85,7 @@ from .ops.array_ops import (
     matrix_band_part, diag, diag_part, eye, invert_permutation,
     broadcast_to, space_to_batch_nd, batch_to_space_nd, space_to_depth,
     depth_to_space, extract_image_patches, unique, setdiff1d, meshgrid,
-    required_space_to_batch_paddings,
+    required_space_to_batch_paddings, edit_distance,
 )
 from .ops.control_flow_ops import (
     no_op, group, tuple, cond, case, while_loop, with_dependencies,

@@ -120,11 +120,6 @@ op_registry.register_pure("SequenceMask", lambda lengths, maxlen=None, dtype=Non
                           (jnp.arange(maxlen)[None, :] <
                            lengths[..., None]).astype(
                                dtype.np_dtype if dtype else jnp.bool_))
-op_registry.register_pure("EditDistance", lambda *a, **k: _nyi("EditDistance"))
-
-
-def _nyi(name):
-    raise NotImplementedError(f"{name} is not implemented on TPU")
 
 
 def _one_hot_impl(indices, depth, on_value, off_value, axis, dtype):
@@ -866,10 +861,88 @@ def setdiff1d(x, y, index_dtype=dtypes_mod.int32, name=None):
         dtypes_mod.as_dtype(index_dtype).np_dtype))
 
 
+def _levenshtein(a, b):
+    if len(a) == 0:
+        return len(b)
+    if len(b) == 0:
+        return len(a)
+    prev = list(builtins.range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(builtins.min(prev[j] + 1, cur[j - 1] + 1,
+                                    prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _lower_edit_distance(ctx, op, inputs):
+    """Host-stage Levenshtein over COO sequence batches (the reference
+    computes this on CPU too — ref core/kernels/edit_distance_op.cc).
+    Sequences are grouped by their leading index dims; the last index dim
+    is the position within the sequence."""
+    h_idx, h_val, h_shape, t_idx, t_val, t_shape = (
+        np.asarray(v) for v in inputs)
+    normalize = bool(op.attrs.get("normalize", True))
+    out_shape = builtins.tuple(
+        int(d) for d in np.maximum(h_shape[:-1], t_shape[:-1]))
+
+    def group(idx, val):
+        seqs = {}
+        order = np.lexsort(idx.T[::-1]) if len(idx) else []
+        for r in order:
+            key = builtins.tuple(int(x) for x in idx[r][:-1])
+            seqs.setdefault(key, []).append(val[r])
+        return seqs
+
+    h_seqs = group(h_idx.reshape(-1, builtins.max(1, h_idx.shape[-1])
+                                 if h_idx.ndim > 1 else 1), h_val)
+    t_seqs = group(t_idx.reshape(-1, builtins.max(1, t_idx.shape[-1])
+                                 if t_idx.ndim > 1 else 1), t_val)
+    # slots with no entries in EITHER input are 0.0 (reference semantics:
+    # edit_distance_op.cc zero-fills and only writes populated groups)
+    out = np.zeros(out_shape, np.float32)
+    for key in builtins.set(h_seqs) | builtins.set(t_seqs):
+        h = h_seqs.get(key, [])
+        t = t_seqs.get(key, [])
+        d = builtins.float(_levenshtein(h, t))
+        if normalize:
+            d = d / len(t) if len(t) else (np.inf if len(h) else 0.0)
+        out[key] = d
+    return [out]
+
+
+op_registry.register("EditDistance", lower=_lower_edit_distance,
+                     runs_on_host=True)
+
+
 def edit_distance(hypothesis, truth, normalize=True, name="edit_distance"):
-    raise NotImplementedError(
-        "edit_distance operates on SparseTensors with dynamic shapes; "
-        "not supported on TPU")
+    """(ref: python/ops/array_ops.py ``edit_distance``,
+    core/kernels/edit_distance_op.cc). Host-stage op: Levenshtein distance
+    between corresponding sequences of two SparseTensors with static
+    dense_shape ranks; output shape is the leading dims of dense_shape
+    (which must be statically known — XLA shapes are compile-time)."""
+    from ..framework.sparse_tensor import SparseTensor
+
+    hyp = SparseTensor.from_value(hypothesis)
+    tru = SparseTensor.from_value(truth)
+    h_shp = constant_op.constant_value(hyp.dense_shape)
+    t_shp = constant_op.constant_value(tru.dense_shape)
+    if h_shp is None or t_shp is None:
+        raise ValueError(
+            "edit_distance needs statically-known dense_shapes on TPU "
+            "(the output shape is derived from them at graph-build time)")
+    out_shape = [int(d) for d in np.maximum(np.asarray(h_shp)[:-1],
+                                            np.asarray(t_shp)[:-1])]
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "EditDistance",
+        [hyp.indices, hyp.values, hyp.dense_shape,
+         tru.indices, tru.values, tru.dense_shape],
+        attrs={"normalize": builtins.bool(normalize)}, name=name,
+        output_specs=[(shape_mod.TensorShape(out_shape),
+                       dtypes_mod.float32)])
+    return op.outputs[0]
 
 
 def meshgrid(*args, **kwargs):

@@ -257,3 +257,41 @@ class TestMeshgridAndSpaceToBatchPaddings:
             base_paddings=stf.constant([[1, 0]]))
         p2 = _run(pads2)
         assert (5 + p2[0].sum()) % 4 == 0 and p2[0][0] == 1
+
+
+class TestEditDistance:
+    def test_matches_levenshtein(self):
+        stf.reset_default_graph()
+        from simple_tensorflow_tpu.framework.sparse_tensor import SparseTensor
+        # batch of 2 sequences; "abc" vs "ab" -> 1, "kitten" vs "sitting" -> 3
+        def coo(seqs, maxlen):
+            idx, vals = [], []
+            for b, s in enumerate(seqs):
+                for i, ch in enumerate(s):
+                    idx.append([b, i]); vals.append(ord(ch))
+            return SparseTensor(np.array(idx, np.int64),
+                                np.array(vals, np.int64),
+                                np.array([len(seqs), maxlen], np.int64))
+        hyp = coo(["abc", "kitten"], 8)
+        tru = coo(["ab", "sitting"], 8)
+        d_raw = stf.edit_distance(hyp, tru, normalize=False)
+        d_norm = stf.edit_distance(hyp, tru, normalize=True)
+        sess = stf.Session()
+        raw, norm = sess.run([d_raw, d_norm])
+        np.testing.assert_allclose(raw, [1.0, 3.0])
+        np.testing.assert_allclose(norm, [1.0 / 2, 3.0 / 7])
+
+    def test_empty_truth_and_empty_slot(self):
+        stf.reset_default_graph()
+        from simple_tensorflow_tpu.framework.sparse_tensor import SparseTensor
+        # batch of 2: row 0 has a hypothesis but empty truth (-> inf when
+        # normalized); row 1 is empty in BOTH (-> 0.0, reference zero-fill)
+        hyp = SparseTensor(np.array([[0, 0]], np.int64),
+                           np.array([7], np.int64),
+                           np.array([2, 4], np.int64))
+        tru = SparseTensor(np.zeros((0, 2), np.int64),
+                           np.zeros((0,), np.int64),
+                           np.array([2, 4], np.int64))
+        out = stf.Session().run(stf.edit_distance(hyp, tru, normalize=True))
+        assert np.isinf(out[0])  # TF semantics: d/0 -> inf
+        assert out[1] == 0.0
