@@ -51,13 +51,15 @@ def dynamic_rnn(cell, inputs, sequence_length=None, initial_state=None,
             x, t = elem
             out, new_state = cell(x, st)
             if sequence_length is not None:
-                active = math_ops.cast(math_ops.less(t, sequence_length),
-                                       out.dtype.base_dtype)
+                # select, not arithmetic masking: NaN/Inf from the cell on
+                # post-sequence-end steps must not poison frozen values
+                # (NaN * 0.0 == NaN)
+                active = math_ops.less(t, sequence_length)
                 act = array_ops.expand_dims(active, -1)
-                out = out * act
+                out = array_ops.where(act, out, array_ops.zeros_like(out))
                 merged = []
                 for old, new in zip(_flatten(st), _flatten(new_state)):
-                    merged.append(new * act + old * (1.0 - act))
+                    merged.append(array_ops.where(act, new, old))
                 new_state = _pack_like(new_state, merged)
             return (new_state, out)
 
@@ -112,7 +114,86 @@ def bidirectional_dynamic_rnn(cell_fw, cell_bw, inputs, sequence_length=None,
 
 
 def raw_rnn(cell, loop_fn, parallel_iterations=None, swap_memory=False,
-            scope=None):
-    raise NotImplementedError(
-        "raw_rnn's emit-driven loop is inherently dynamic; use dynamic_rnn "
-        "or stf.scan on TPU")
+            scope=None, maximum_iterations=None):
+    """(ref: rnn.py ``raw_rnn``). Emit-driven RNN loop over stf.while_loop.
+
+    loop_fn(time, cell_output, cell_state, loop_state) ->
+        (finished, next_input, next_cell_state, emit_output, next_loop_state)
+
+    TPU adaptation: the reference's loop grows TensorArrays dynamically
+    (ref core/kernels/tensor_array_ops.cc); XLA needs a static bound, so
+    ``maximum_iterations`` is required here — the emit TensorArray has
+    exactly that many slots and iteration stops early when every sequence
+    reports finished. Returns (emit_ta, final_state, final_loop_state).
+    """
+    from . import control_flow_ops as cf
+    from . import tensor_array_ops as ta_ops
+
+    if maximum_iterations is None:
+        raise ValueError(
+            "raw_rnn on TPU needs maximum_iterations= (XLA loops are "
+            "bounded; the reference grows TensorArrays dynamically)")
+    T = int(maximum_iterations)
+
+    with vs.variable_scope(scope or "rnn", reuse=vs.AUTO_REUSE):
+        time0 = constant_op.constant(0, dtype="int32")
+        finished0, input0, state0, emit0, loop_state0 = loop_fn(
+            time0, None, None, None)
+        finished0 = ops_mod.convert_to_tensor(finished0)
+        # trace the cell ONCE outside the loop to create its variables in
+        # the enclosing scope (inside, the FuncGraph would own them) and to
+        # learn the emit structure when loop_fn(0) returned None for it
+        out_probe, _ = cell(input0, state0)
+        if emit0 is None:
+            emit0 = array_ops.zeros_like(out_probe)
+        has_loop_state = loop_state0 is not None
+
+        emit_ta0 = ta_ops.TensorArray(emit0.dtype, size=T,
+                                      element_shape=emit0.shape)
+        carry0 = [time0, finished0, input0, state0, emit_ta0._buffer]
+        if has_loop_state:
+            carry0.append(loop_state0)
+
+        def _cond(t, finished, *_rest):
+            return math_ops.logical_and(
+                t < T, math_ops.logical_not(math_ops.reduce_all(finished)))
+
+        def _body(t, finished, inp, state, emit_buf, *maybe_ls):
+            ls = maybe_ls[0] if has_loop_state else None
+            # traced inside the enclosing AUTO_REUSE scope (the while_loop
+            # call sits within the `with` above), so the cell reuses the
+            # probe's variables — re-opening the scope here would nest
+            # "rnn/rnn" and create fresh weights
+            output, new_state = cell(inp, state)
+            (next_finished, next_input, next_state, emit,
+             next_ls) = loop_fn(t + 1, output, new_state, ls)
+            if emit is None:
+                emit = array_ops.zeros_like(output)
+            # finished sequences emit zeros and freeze their state —
+            # where-select, not arithmetic masking, so a NaN/Inf the cell
+            # produces past sequence end cannot poison frozen values
+            live = array_ops.reshape(math_ops.logical_not(finished),
+                                     [-1] + [1] * (emit.shape.rank - 1))
+            emit = array_ops.where(live, emit, array_ops.zeros_like(emit))
+            frozen = []
+            for old, new in zip(_flatten(state), _flatten(next_state)):
+                m = array_ops.reshape(
+                    math_ops.logical_not(finished),
+                    [-1] + [1] * (new.shape.rank - 1))
+                frozen.append(array_ops.where(m, new, old))
+            next_state = _pack_like(next_state, frozen)
+            ta = ta_ops.TensorArray(emit.dtype, size=T, _buffer=emit_buf)
+            new_buf = ta.write(t, emit)._buffer
+            next_finished = math_ops.logical_or(
+                finished, ops_mod.convert_to_tensor(next_finished))
+            out = [t + 1, next_finished, next_input, next_state, new_buf]
+            if has_loop_state:
+                out.append(next_ls)
+            return out
+
+        final = cf.while_loop(_cond, _body, carry0)
+        t_f, _, _, state_f, emit_buf_f = final[:5]
+        loop_state_f = final[5] if has_loop_state else None
+        emit_ta = ta_ops.TensorArray(emit0.dtype, size=T,
+                                     _buffer=emit_buf_f)
+        return emit_ta, state_f, loop_state_f

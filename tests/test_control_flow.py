@@ -214,3 +214,51 @@ class TestPyFunc:
         with stf.Session() as sess:
             out = sess.run(y2, {x: np.float32([1, 2, 3])})
         assert out.tolist() == [3.0, 5.0, 7.0]
+
+
+class TestRawRNN:
+    def test_matches_dynamic_rnn_with_lengths(self):
+        from simple_tensorflow_tpu.ops import rnn, rnn_cell
+
+        stf.reset_default_graph()
+        T, B, D, H = 5, 3, 4, 6
+        rng = np.random.RandomState(0)
+        xv = rng.rand(T, B, D).astype(np.float32)
+        seq = np.array([5, 3, 1], np.int32)
+
+        xc = stf.constant(xv)
+        seq_t = stf.constant(seq)
+        cell = rnn_cell.BasicRNNCell(H)
+
+        def loop_fn(time, output, state, loop_state):
+            finished = time >= seq_t                      # (B,) bool
+            if output is None:                            # time 0
+                next_state = cell.zero_state(B, stf.float32)
+            else:
+                next_state = state
+            idx = stf.minimum(time, T - 1)
+            next_input = stf.gather(xc, idx)              # (B, D)
+            return finished, next_input, next_state, output, None
+
+        emit_ta, final_state, _ = rnn.raw_rnn(cell, loop_fn,
+                                              maximum_iterations=T)
+        emit = emit_ta.stack()                            # (T, B, H)
+        # same weights: dynamic_rnn reuses scope "rnn" (AUTO_REUSE)
+        out_ref, state_ref = rnn.dynamic_rnn(
+            cell, stf.constant(xv.transpose(1, 0, 2)),
+            sequence_length=seq_t, dtype=stf.float32)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            e, fs, o, sr = sess.run([emit, final_state, out_ref, state_ref])
+        np.testing.assert_allclose(e, o.transpose(1, 0, 2), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(fs, sr, rtol=1e-5, atol=1e-6)
+
+    def test_requires_maximum_iterations(self):
+        from simple_tensorflow_tpu.ops import rnn, rnn_cell
+
+        stf.reset_default_graph()
+        cell = rnn_cell.BasicRNNCell(2)
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="maximum_iterations"):
+            rnn.raw_rnn(cell, lambda *a: None)

@@ -345,3 +345,31 @@ def test_watchdog_and_heartbeat():
     with pytest.raises(stf.errors.UnavailableError):
         hb.check(time.monotonic() - 100.0, max_age_secs=5.0)
     hb.stop()
+
+
+def test_make_callable_fast_path_applies_declared_shardings():
+    """Regression: the make_callable hot path must apply declared variable
+    shardings after committing state, like Session.run does — a callable
+    warmed before a sharding declaration must still place the variable on
+    the mesh from the fast path."""
+    mesh = parallel.Mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 8).astype(np.float32)
+    with mesh:
+        x = stf.placeholder(stf.float32, [16, 8], name="x")
+        w = stf.Variable(stf.random_normal([8, 4], stddev=0.1, seed=1),
+                         name="wcb")
+        loss = stf.reduce_mean(stf.square(stf.matmul(x, w)))
+        train_op = stf.train.GradientDescentOptimizer(0.1).minimize(loss)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            step = sess.make_callable([loss, train_op], feed_list=[x])
+            step(xs)  # slow warmup call adopts the cached plan
+            # declare the sharding AFTER warmup: only the fast path runs
+            # from here on, so it must be the one to apply it
+            w.set_sharding(("dp", None))
+            l1, _ = step(xs)
+            l2, _ = step(xs)
+            assert np.isfinite(l1) and l2 < l1
+            arr = sess._variable_store.values["wcb"]
+            assert len(arr.sharding.device_set) == 8
