@@ -153,9 +153,11 @@ def _measure_resnet(batch, image_size, steps, warmup, device_kind,
         50, image_size)
     achieved = images_per_sec * train_flops_per_image
     peak = detect_peak_flops(device_kind, platform)
+    # roofline computed HERE, while this candidate's session is live, so
+    # the sweep never retains a losing candidate's params/feed in HBM; the
+    # extra lower+compile is a disk hit once the persistent cache is warm
     return {
-        "_roofline": lambda: _roofline_info(sess, feed, sec_per_step,
-                                            platform),
+        **_roofline_info(sess, feed, sec_per_step, platform),
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(float(images_per_sec), 2),
         "unit": "images/sec/chip",
@@ -189,12 +191,6 @@ def _sweep_batches(batches, measure):
     if best is None:
         raise RuntimeError(
             "all batch sizes failed: " + "; ".join(errors)) from last_exc
-    # roofline diagnostics only for the winner: it re-lowers the step
-    # program for cost analysis (a cache hit when the persistent compile
-    # cache is warm, a full recompile when not)
-    roofline = best.pop("_roofline", None)
-    if roofline is not None:
-        best.update(roofline())
     if len(tried) > 1:
         best["batch_sweep"] = tried
     if errors:
@@ -289,8 +285,7 @@ def _measure_bert(batch, platform, device_kind):
     mfu = tokens_per_sec * train_flops_per_token / peak
 
     return {
-        "_roofline": lambda: _roofline_info(sess, feed, sec_per_step,
-                                            platform),
+        **_roofline_info(sess, feed, sec_per_step, platform),
         "metric": "bert_base_tokens_per_sec_per_chip",
         "value": round(float(tokens_per_sec), 1),
         "unit": "tokens/sec/chip",

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence
 
+from . import errors as errors_mod
 from . import graph as ops_mod
 from . import op_registry
 from . import lowering as lowering_mod
@@ -76,7 +77,19 @@ def gradients(ys, xs, grad_ys=None, name="gradients",
     else:
         grad_ys = [None] * len(ys)
 
-    _, connected = lowering_mod.ancestors_between(xs, ys)
+    path_ops, connected = lowering_mod.ancestors_between(xs, ys)
+    while_on_path = [o.name for o in path_ops if o.type == "While"]
+    if while_on_path:
+        # fail at graph construction with an actionable message — the
+        # alternative is an opaque lax.while_loop autodiff error deep
+        # inside Session.run lowering
+        raise errors_mod.InvalidArgumentError(
+            None, None,
+            "Reverse-mode gradients cannot cross a while_loop on TPU (XLA "
+            f"cannot differentiate unbounded loops; on path: "
+            f"{while_on_path[:3]}). Use stf.scan / stf.foldl / dynamic_rnn "
+            "(lax.scan-based, differentiable) instead — raw_rnn and "
+            "while_loop are forward-only.")
 
     with g.name_scope(name):
         connected_xs = [x for x in xs if x in connected

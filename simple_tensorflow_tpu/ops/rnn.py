@@ -125,6 +125,10 @@ def raw_rnn(cell, loop_fn, parallel_iterations=None, swap_memory=False,
     ``maximum_iterations`` is required here — the emit TensorArray has
     exactly that many slots and iteration stops early when every sequence
     reports finished. Returns (emit_ta, final_state, final_loop_state).
+
+    Forward-only: XLA cannot reverse-differentiate an unbounded loop, so
+    stf.gradients through raw_rnn raises at graph construction — train
+    with dynamic_rnn / stf.scan (lax.scan-based) instead.
     """
     from . import control_flow_ops as cf
     from . import tensor_array_ops as ta_ops

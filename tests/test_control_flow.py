@@ -262,3 +262,24 @@ class TestRawRNN:
         import pytest as _pytest
         with _pytest.raises(ValueError, match="maximum_iterations"):
             rnn.raw_rnn(cell, lambda *a: None)
+
+    def test_gradient_through_while_raises_early(self):
+        from simple_tensorflow_tpu.ops import rnn, rnn_cell
+
+        stf.reset_default_graph()
+        cell = rnn_cell.BasicRNNCell(3)
+        xc = stf.constant(np.zeros((4, 2, 2), np.float32))
+        seq_t = stf.constant(np.array([4, 2], np.int32))
+
+        def loop_fn(time, output, state, loop_state):
+            finished = time >= seq_t
+            st = cell.zero_state(2, stf.float32) if output is None else state
+            return (finished, stf.gather(xc, stf.minimum(time, 3)), st,
+                    output, None)
+
+        emit_ta, _, _ = rnn.raw_rnn(cell, loop_fn, maximum_iterations=4)
+        loss = stf.reduce_mean(stf.square(emit_ta.stack()))
+        import pytest as _pytest
+        with _pytest.raises(stf.errors.InvalidArgumentError,
+                            match="while_loop"):
+            stf.gradients(loss, stf.trainable_variables())
