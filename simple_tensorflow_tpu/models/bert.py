@@ -127,15 +127,22 @@ def bert_encoder(input_ids, token_type_ids, input_mask, cfg,
             type_emb = stf.get_variable(
                 "token_type_embeddings", [cfg.type_vocab_size, cfg.hidden_size],
                 initializer=_init(cfg))
-            h = stf.nn.embedding_lookup(word_emb, input_ids)
-            h = h + stf.nn.embedding_lookup(type_emb, token_type_ids)
+            # mixed-precision lookups: the f32 tables are cast before the
+            # gather (so the [B,S,H] activations, their LayerNorm, dropout,
+            # and all VJPs move in compute dtype — [24,512,768] f32 was
+            # 38 MB a pass at base scale) while the gradient scatter-add
+            # still accumulates into the table in f32
+            h = stf.nn.embedding_lookup(word_emb, input_ids,
+                                        compute_dtype=compute_dtype)
+            h = h + stf.nn.embedding_lookup(type_emb, token_type_ids,
+                                            compute_dtype=compute_dtype)
             h = h + stf.reshape(
-                stf.slice(pos_emb, [0, 0], [s, cfg.hidden_size]),
+                stf.cast(stf.slice(pos_emb, [0, 0], [s, cfg.hidden_size]),
+                         compute_dtype),
                 [1, s, cfg.hidden_size])
             h = _layer_norm(h, cfg, "ln")
             if training and cfg.hidden_dropout > 0:
                 h = stf.nn.dropout(h, keep_prob=1.0 - cfg.hidden_dropout)
-        h = stf.cast(h, compute_dtype)
 
         if input_mask is not None:
             # additive bias: 0 where attendable, -1e9 where padded
@@ -147,11 +154,15 @@ def bert_encoder(input_ids, token_type_ids, input_mask, cfg,
             for i in range(cfg.num_layers):
                 h = transformer_block(h, bias, cfg, training, compute_dtype,
                                       name=f"layer_{i}")
-        sequence_output = stf.cast(h, stf.float32)
+        # sequence_output stays in compute dtype: the MLM head reshapes and
+        # gathers the full [B,S,H] tensor, and an early f32 cast here moved
+        # it (plus its VJP) through HBM at double width. Heads cast their
+        # own SMALL slices up to f32 where the math wants it.
+        sequence_output = h
         with stf.variable_scope("pooler"):
-            first = stf.squeeze(
+            first = stf.cast(stf.squeeze(
                 stf.slice(sequence_output, [0, 0, 0], [-1, 1, cfg.hidden_size]),
-                axis=[1])
+                axis=[1]), stf.float32)
             pooled = _dense(first, cfg.hidden_size, cfg, "dense",
                             activation=stf.tanh)
     return sequence_output, pooled, word_emb
@@ -182,7 +193,13 @@ def mlm_logits(seq_out, positions, word_emb, cfg, scope="cls/predictions"):
             x = stf.nn.fused_layer_norm(x, gamma, beta, eps=cfg.layer_norm_eps)
         bias = stf.get_variable("output_bias", [cfg.vocab_size],
                                 initializer=stf.zeros_initializer())
-        logits = stf.matmul(x, word_emb, transpose_b=True) + bias
+        # tied vocab matmul in compute dtype (the MXU accumulates in f32
+        # internally): the [B*P, vocab] logits are the largest head tensor
+        # (226 MB in f32 at base scale), and the fused xent kernel does its
+        # max/logsumexp math in f32 blockwise regardless
+        logits = stf.matmul(x, stf.cast(word_emb, x.dtype.base_dtype),
+                            transpose_b=True) \
+            + stf.cast(bias, x.dtype.base_dtype)
     return logits
 
 

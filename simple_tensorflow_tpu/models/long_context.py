@@ -112,15 +112,20 @@ def lm_forward(ids, cfg, compute_dtype=stf.bfloat16, sp_axis="sp",
             "embedding", [cfg.vocab_size, cfg.d_model],
             initializer=stf.random_normal_initializer(
                 stddev=cfg.d_model ** -0.5))
-        h = stf.cast(stf.nn.embedding_lookup(emb, ids), compute_dtype)
+        # mixed-precision lookup: [B,S,D] activations in compute dtype,
+        # gradient scatter-add accumulates into the f32 table
+        h = stf.nn.embedding_lookup(emb, ids, compute_dtype=compute_dtype)
         cos, sin = rope_tables(s, cfg.d_model // cfg.num_heads,
                                cfg.rope_theta)
         cos, sin = stf.constant(cos), stf.constant(sin)
         for i in range(cfg.num_layers):
             h = block(h, cfg, cos, sin, sp_axis, f"layer_{i}")
         h = _ln(h, cfg, "ln_final")
-        flat = stf.reshape(stf.cast(h, stf.float32), [b * s, cfg.d_model])
-        logits = stf.matmul(flat, stf.cast(emb, stf.float32),
+        # tied vocab projection in compute dtype — the [B*S, vocab] logits
+        # are the largest tensor at long context; the fused xent kernel
+        # does its softmax math in f32 blockwise
+        flat = stf.reshape(h, [b * s, cfg.d_model])
+        logits = stf.matmul(flat, stf.cast(emb, h.dtype.base_dtype),
                             transpose_b=True)
     return stf.reshape(logits, [b, s, cfg.vocab_size])
 

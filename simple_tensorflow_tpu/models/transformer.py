@@ -126,12 +126,15 @@ def _embed(ids, cfg, compute_dtype, training):
         initializer=stf.random_normal_initializer(
             stddev=cfg.d_model ** -0.5))
     s = int(ids.shape[1])
-    h = stf.nn.embedding_lookup(emb, ids) * (cfg.d_model ** 0.5)
+    # mixed-precision lookup: [B,S,D] activations move in compute dtype,
+    # gradient scatter-add still accumulates into the table in f32
+    h = stf.nn.embedding_lookup(emb, ids, compute_dtype=compute_dtype) \
+        * stf.cast(stf.constant(cfg.d_model ** 0.5), compute_dtype)
     pos = sinusoidal_position_encoding(cfg.max_len, cfg.d_model)[:s]
-    h = h + stf.constant(pos[None, :, :])
+    h = h + stf.cast(stf.constant(pos[None, :, :]), compute_dtype)
     if training and cfg.dropout > 0:
         h = stf.nn.dropout(h, keep_prob=1.0 - cfg.dropout)
-    return stf.cast(h, compute_dtype), emb
+    return h, emb
 
 
 def _pad_bias(ids, cfg):
@@ -173,22 +176,27 @@ def decode(tgt_ids, enc_out, enc_bias, cfg, training=True,
                     h = _ln(h + c, cfg, "ln2")
                     f = _ffn(h, cfg, training, "ffn")
                     h = _ln(h + f, cfg, "ln3")
-        # tied softmax weights
+        # tied softmax weights, computed in compute dtype: the
+        # [B*S, vocab] logits are the largest tensor in the model, and the
+        # fused xent kernel does its softmax math in f32 blockwise anyway
         b, s = int(tgt_ids.shape[0]), int(tgt_ids.shape[1])
-        flat = stf.reshape(stf.cast(h, stf.float32), [b * s, cfg.d_model])
-        logits = stf.matmul(flat, stf.cast(emb, stf.float32),
+        flat = stf.reshape(h, [b * s, cfg.d_model])
+        logits = stf.matmul(flat, stf.cast(emb, h.dtype.base_dtype),
                             transpose_b=True)
         return stf.reshape(logits, [b, s, cfg.vocab_size])
 
 
 def smoothed_xent(logits, labels, weights, cfg):
-    """Label-smoothed cross entropy, weight-masked mean (f32)."""
+    """Label-smoothed cross entropy, weight-masked mean (f32 loss math).
+
+    The smoothing is fused into the streamed softmax-xent kernel — the
+    composed form materialized log_softmax AND a dense one-hot at
+    [B*S, vocab], three vocab-sized f32 tensors the kernel never builds."""
     vocab = cfg.vocab_size
     conf = 1.0 - cfg.label_smoothing
     low = cfg.label_smoothing / (vocab - 1)
-    logp = stf.nn.log_softmax(stf.cast(logits, stf.float32), axis=-1)
-    soft = stf.one_hot(labels, vocab, on_value=conf, off_value=low)
-    per_tok = -stf.reduce_sum(soft * logp, axis=-1)
+    per_tok = stf.nn.fused_softmax_cross_entropy(
+        logits, labels, label_smoothing=cfg.label_smoothing)
     # subtract the entropy of the smoothed target => 0 loss at perfection
     norm = -(conf * math.log(conf) +
              (vocab - 1) * low * math.log(low + 1e-20))
@@ -276,8 +284,10 @@ def beam_search_decode(src, cfg: TransformerConfig | None = None,
 
     def body(i, seq, logp):
         flat = stf.reshape(seq, [b * k, L])
-        logits = decode(flat, enc_tiled, bias_tiled, cfg, training=False,
-                        compute_dtype=compute_dtype, scope=scope)
+        # decode() emits logits in compute dtype; beam-score math is f32
+        logits = stf.cast(
+            decode(flat, enc_tiled, bias_tiled, cfg, training=False,
+                   compute_dtype=compute_dtype, scope=scope), stf.float32)
         # logits at position i predict token i+1: one_hot-select (static L)
         sel = stf.one_hot(i, L, dtype=stf.float32)  # (L,)
         step_logits = stf.reduce_sum(

@@ -10,15 +10,58 @@ IndexedSlices-style scatter-add, applied sparsely by optimizers.
 
 from __future__ import annotations
 
+import functools
+
 from ..framework import dtypes as dtypes_mod
 from ..framework import graph as ops_mod
+from ..framework import op_registry
 from . import array_ops, math_ops
 from . import variables as variables_mod
 
 
+def _emb_mixed_impl(table, ids, compute_dtype):
+    import jax
+    import jax.numpy as jnp
+
+    # table shape/dtype are closed over as STATICS (custom_vjp residuals
+    # may only hold JAX types); only `ids` rides in the residuals
+    tshape = tuple(table.shape)
+    tdtype = table.dtype
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def _lookup(table, ids, dt):
+        return jnp.take(table.astype(dt), ids, axis=0)
+
+    def _fwd(table, ids, dt):
+        return _lookup(table, ids, dt), ids
+
+    def _bwd(dt, ids, g):
+        # upcast the per-row cotangents BEFORE the scatter so repeated ids
+        # accumulate in the table's own precision — scatter-adding in bf16
+        # loses contributions once the running sum is ~256x an addend
+        gf = g.astype(tdtype)
+        dtab = jnp.zeros(tshape, tdtype).at[ids].add(gf)
+        return dtab, None
+
+    _lookup.defvjp(_fwd, _bwd)
+    return _lookup(table, ids, compute_dtype)
+
+
+op_registry.register_pure(
+    "EmbeddingLookupMixed",
+    lambda table, ids, compute_dtype: _emb_mixed_impl(
+        table, ids, dtypes_mod.as_dtype(compute_dtype).np_dtype))
+
+
 def embedding_lookup(params, ids, partition_strategy="mod", name=None,
-                     validate_indices=True, max_norm=None):
-    """(ref: embedding_ops.py:110 ``embedding_lookup``)."""
+                     validate_indices=True, max_norm=None,
+                     compute_dtype=None):
+    """(ref: embedding_ops.py:110 ``embedding_lookup``).
+
+    compute_dtype (TPU-native extension): gather rows in this dtype (the
+    table is cast BEFORE the gather, so the [batch..., H] activations and
+    their VJPs move at half width) while the gradient scatter-add still
+    accumulates in the table's own precision."""
     if isinstance(params, variables_mod.PartitionedVariable):
         params = list(params)
     if isinstance(params, (list, tuple)) and len(params) > 1:
@@ -32,7 +75,18 @@ def embedding_lookup(params, ids, partition_strategy="mod", name=None,
         table = p._ref if isinstance(p, variables_mod.Variable) else \
             ops_mod.convert_to_tensor(p)
     ids = ops_mod.convert_to_tensor(ids)
-    out = array_ops.gather(table, ids, name=name)
+    if (compute_dtype is not None
+            and dtypes_mod.as_dtype(compute_dtype) != table.dtype.base_dtype):
+        g = ops_mod.get_default_graph()
+        dt = dtypes_mod.as_dtype(compute_dtype)
+        op = g.create_op(
+            "EmbeddingLookupMixed", [table, ids],
+            attrs={"compute_dtype": dt.name},
+            name=name or "embedding_lookup_mixed",
+            output_specs=[(ids.shape.concatenate(table.shape[1:]), dt)])
+        out = op.outputs[0]
+    else:
+        out = array_ops.gather(table, ids, name=name)
     if max_norm is not None:
         norms = math_ops.sqrt(math_ops.reduce_sum(math_ops.square(out),
                                                   axis=-1, keepdims=True))

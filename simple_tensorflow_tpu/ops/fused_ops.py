@@ -54,8 +54,11 @@ def fused_layer_norm(x, gamma, beta, *, eps=1e-6, name=None):
     return op.outputs[0]
 
 
-def fused_softmax_cross_entropy(logits, labels, *, name=None):
-    """Fused sparse softmax xent; logits (..., vocab), labels (...,) int."""
+def fused_softmax_cross_entropy(logits, labels, *, label_smoothing=0.0,
+                                name=None):
+    """Fused sparse softmax xent; logits (..., vocab), labels (...,) int.
+    label_smoothing folds soft-target training into the same streamed
+    kernel pass (no dense one-hot / log_softmax materialization)."""
     from ..framework import dtypes as dtypes_mod
 
     g = ops_mod.get_default_graph()
@@ -64,6 +67,7 @@ def fused_softmax_cross_entropy(logits, labels, *, name=None):
     out_shape = (logits.shape[:-1] if logits.shape.rank is not None
                  else shape_mod.TensorShape(None))
     op = g.create_op("FusedSoftmaxXent", [logits, labels],
+                     attrs={"label_smoothing": float(label_smoothing)},
                      name=name or "fused_softmax_xent",
                      output_specs=[(out_shape, dtypes_mod.float32)])
     return op.outputs[0]

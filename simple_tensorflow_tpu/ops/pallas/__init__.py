@@ -50,7 +50,8 @@ op_registry.register_pure(
     lambda x, gamma, beta, eps=1e-6: layer_norm(x, gamma, beta, eps=eps))
 op_registry.register_pure(
     "FusedSoftmaxXent",
-    lambda logits, labels: softmax_cross_entropy(logits, labels))
+    lambda logits, labels, label_smoothing=0.0: softmax_cross_entropy(
+        logits, labels, label_smoothing=label_smoothing))
 op_registry.register_pure(
     "QuantMatMul",
     lambda x, wq, w_scale: quant_matmul_ste(x, wq, w_scale))

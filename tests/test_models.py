@@ -164,8 +164,11 @@ def test_transformer_beam_search():
 
     cfg = tr.TransformerConfig.tiny()
     src = stf.placeholder(stf.int32, [2, 8], "src")
+    # default bf16 compute dtype: the decode logits are bf16 and the beam
+    # scoring must cast up itself (regression: f32 one_hot * bf16 logits
+    # was a strict-dtype TypeError)
     ids, scores = tr.beam_search_decode(src, cfg, beam_size=3, decode_len=8,
-                                        compute_dtype=stf.float32)
+                                        compute_dtype=stf.bfloat16)
     batch = tr.synthetic_wmt_batch(2, 8, 8, vocab_size=cfg.vocab_size)
     with stf.Session() as sess:
         sess.run(stf.global_variables_initializer())
@@ -224,3 +227,24 @@ def test_long_context_single_device_fallback():
         ids, tg = lc.synthetic_lm_batch(1, 16, cfg.vocab_size)
         l = sess.run(m["loss"], {m["input_ids"]: ids, m["targets"]: tg})
         assert np.isfinite(l)
+
+
+def test_transformer_bf16_train_step():
+    """Backward-pass coverage for the mixed-precision embedding lookup and
+    the bf16 tied-logits head (regression: custom_vjp residuals held
+    non-JAX types and crashed gradient tracing)."""
+    from simple_tensorflow_tpu.models import transformer as tr
+
+    stf.reset_default_graph()
+    cfg = tr.TransformerConfig.tiny()
+    m = tr.transformer_train_model(batch_size=2, src_len=8, tgt_len=8,
+                                   cfg=cfg, compute_dtype=stf.bfloat16)
+    batch = tr.synthetic_wmt_batch(2, 8, 8, vocab_size=cfg.vocab_size)
+    feed = {m[k]: v for k, v in batch.items()}
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        l0 = sess.run(m["loss"], feed)
+        for _ in range(4):
+            sess.run(m["train_op"], feed)
+        l1 = sess.run(m["loss"], feed)
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0, (l0, l1)

@@ -252,6 +252,29 @@ class TestSoftmaxXent:
         ref = softmax_cross_entropy_reference(logits, labels)
         np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
+    def test_label_smoothing_fused(self):
+        # fused smoothing == composed soft-target xent (fwd + grad),
+        # across vocab blocks with a ragged edge
+        logits = rand(0, (16, 300)) * 3
+        labels = jax.random.randint(jax.random.key(1), (16,), 0, 300)
+        sm = 0.1
+
+        def composed(l):
+            logp = jax.nn.log_softmax(l.astype(jnp.float32), axis=-1)
+            conf, low = 1 - sm, sm / 299
+            soft = jax.nn.one_hot(labels, 300) * (conf - low) + low
+            return -jnp.sum(soft * logp, -1)
+
+        out = softmax_cross_entropy(logits, labels, label_smoothing=sm,
+                                    block_rows=8, block_vocab=128)
+        np.testing.assert_allclose(out, composed(logits), atol=1e-5,
+                                   rtol=1e-5)
+        g1 = jax.grad(lambda l: jnp.sum(softmax_cross_entropy(
+            l, labels, label_smoothing=sm, block_rows=8,
+            block_vocab=128)))(logits)
+        g2 = jax.grad(lambda l: jnp.sum(composed(l)))(logits)
+        np.testing.assert_allclose(g1, g2, atol=1e-5, rtol=1e-4)
+
     def test_vocab_blocking_ragged_edge(self):
         # vocab spanning several blocks with a ragged final block (the
         # streamed online-softmax path, unpadded); fwd + bwd vs reference
