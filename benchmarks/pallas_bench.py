@@ -228,6 +228,75 @@ def bench_quant_matmul(shapes, iters):
     return rows
 
 
+def tune_flash(iters):
+    """Sweep flash-attention block sizes at the BERT shape; prints one
+    JSON line per config and the winner (run on the real chip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from simple_tensorflow_tpu.ops.pallas.flash_attention import (
+        flash_attention)
+
+    b, h, s, d = 24, 12, 512, 64
+    q, k, v = (jax.random.normal(jax.random.key(i), (b, h, s, d),
+                                 jnp.bfloat16) for i in range(3))
+    best = None
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512):
+            def fwd_step(c, bq=bq, bk=bk):
+                return flash_attention(c, k, v, block_q=bq,
+                                       block_k=bk).astype(c.dtype)
+            try:
+                t = chain_time(fwd_step, q, iters)
+            except Exception as e:
+                print(json.dumps({"tune": "flash", "block_q": bq,
+                                  "block_k": bk,
+                                  "error": str(e)[:120]}))
+                continue
+            row = {"tune": "flash", "block_q": bq, "block_k": bk,
+                   "fwd_us": round(t * 1e6, 1)}
+            print(json.dumps(row), flush=True)
+            if best is None or t < best[0]:
+                best = (t, row)
+    if best:
+        print(json.dumps({"tune_winner": best[1]}))
+
+
+def tune_xent(iters):
+    """Sweep softmax-xent block sizes at the BERT MLM shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from simple_tensorflow_tpu.ops.pallas.softmax_xent import (
+        softmax_cross_entropy)
+
+    n, vocab = 24 * 77, 30522
+    logits = jax.random.normal(jax.random.key(0), (n, vocab),
+                               jnp.bfloat16) * 3.0
+    labels = jax.random.randint(jax.random.key(1), (n,), 0, vocab)
+    best = None
+    for br in (128, 256, 512):
+        for bv in (1024, 2048, 4096):
+            def fwd_step(c, br=br, bv=bv):
+                loss = softmax_cross_entropy(c, labels, block_rows=br,
+                                             block_vocab=bv)
+                return (c + 1e-6 * loss[:, None].astype(c.dtype))
+            try:
+                t = chain_time(fwd_step, logits, iters)
+            except Exception as e:
+                print(json.dumps({"tune": "xent", "block_rows": br,
+                                  "block_vocab": bv,
+                                  "error": str(e)[:120]}))
+                continue
+            row = {"tune": "xent", "block_rows": br, "block_vocab": bv,
+                   "fwd_us": round(t * 1e6, 1)}
+            print(json.dumps(row), flush=True)
+            if best is None or t < best[0]:
+                best = (t, row)
+    if best:
+        print(json.dumps({"tune_winner": best[1]}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=ITERS,
@@ -238,6 +307,8 @@ def main():
     ap.add_argument("--kernels", default="flash,ln,xent,quant")
     ap.add_argument("--shapes", default=None,
                     help="comma-separated shape-name filter")
+    ap.add_argument("--tune", default=None, choices=["flash", "xent"],
+                    help="block-size sweep instead of the vs-XLA bench")
     args = ap.parse_args()
 
     import jax
@@ -250,6 +321,9 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
+    if args.tune:
+        (tune_flash if args.tune == "flash" else tune_xent)(args.iters)
+        return
     smoke = args.smoke or not on_tpu
     # smoke mode is a correctness/plumbing check: interpret-mode kernels
     # inside a jitted scan compile glacially on the 1-core CPU, so run the
