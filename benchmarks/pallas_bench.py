@@ -322,6 +322,12 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if args.tune:
+        if not on_tpu:
+            sys.exit("--tune requires a TPU (interpret-mode sweeps "
+                     "compile glacially off-chip)")
+        if args.out:
+            sys.exit("--tune prints JSON lines to stdout; "
+                     "redirect instead of --out")
         (tune_flash if args.tune == "flash" else tune_xent)(args.iters)
         return
     smoke = args.smoke or not on_tpu

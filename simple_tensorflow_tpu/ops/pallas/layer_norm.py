@@ -134,6 +134,9 @@ def _ln_bwd_rule(eps, block_rows, res, g):
 _layer_norm_2d.defvjp(_ln_fwd_rule, _ln_bwd_rule)
 
 
+_VMEM_BLOCK_BUDGET = 4 * 1024 * 1024  # bytes per (block_rows, n) f32 tile
+
+
 def layer_norm(x, gamma, beta, *, eps=1e-6, block_rows=DEFAULT_BLOCK_ROWS):
     """Fused layer norm over the last axis. gamma/beta: (features,)."""
     orig_shape = x.shape
@@ -142,6 +145,13 @@ def layer_norm(x, gamma, beta, *, eps=1e-6, block_rows=DEFAULT_BLOCK_ROWS):
     for s in orig_shape[:-1]:
         rows *= s
     x2 = x.reshape(rows, n)
+    # the kernel holds whole (block_rows, n) rows in VMEM (f32 math,
+    # double-buffered): shrink block_rows for very wide features so the
+    # tile stays inside the ~16 MB scoped budget (n=16384 at the default
+    # 256 rows would be a 16 MB tile — the same OOM class the xent kernel
+    # hit at BERT vocab width)
+    fit = _VMEM_BLOCK_BUDGET // (int(n) * 4)
+    block_rows = max(8, min(block_rows, (fit // 8) * 8 or 8))
     block_rows = min(block_rows, round_up(rows, 8))
     rp = round_up(rows, block_rows)
     x2 = pad_dim(x2, 0, rp)

@@ -364,3 +364,26 @@ class TestGraphOps:
         for op_type in ("FlashAttention", "FusedLayerNorm",
                         "FusedSoftmaxXent", "QuantMatMul"):
             assert op_registry.is_registered(op_type), op_type
+
+
+class TestLayerNormWideFeatures:
+    def test_block_rows_shrink_for_wide_features(self):
+        # (block_rows, n) f32 tiles must stay inside the VMEM budget: at
+        # n=8192 the default 256-row block would be an 8 MB tile; the
+        # wrapper shrinks rows and the result still matches the reference
+        from simple_tensorflow_tpu.ops.pallas.layer_norm import (
+            layer_norm, layer_norm_reference)
+
+        # rows must exceed the shrunk block (4MB/8192/4 = 128) so the test
+        # actually exercises the clamp: at 512 rows the old code would run
+        # a 256-row / 8 MB tile, the clamp runs 128-row / 4 MB tiles
+        x = rand(0, (512, 8192)).astype(jnp.bfloat16)
+        g = jnp.ones((8192,), jnp.float32)
+        b = jnp.zeros((8192,), jnp.float32)
+        o1 = layer_norm(x, g, b)
+        o2 = layer_norm_reference(x, g, b)
+        np.testing.assert_allclose(o1.astype(jnp.float32),
+                                   o2.astype(jnp.float32), atol=1e-2)
+        gr = jax.grad(lambda x: jnp.sum(layer_norm(x, g, b)
+                                        .astype(jnp.float32)))(x)
+        assert gr.shape == x.shape
