@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .common import cdiv, pad_dim, round_up, use_interpret
 
@@ -41,16 +42,32 @@ def quantize_colwise(w):
     return q, scale[0]
 
 
-def _qmm_kernel(xq_ref, wq_ref, xs_ref, ws_ref, o_ref):
+def _qmm_kernel(n_kb, xq_ref, wq_ref, xs_ref, ws_ref, o_ref, acc_scr):
     # Operands stay s8: Mosaic lowers s8 x s8 -> s32 onto the MXU's native
     # int8 path (2x bf16 rate); widening to i32 first produces an i32
     # matmul Mosaic rejects ("Bad lhs/rhs type: vector<...xi32>").
-    acc = jax.lax.dot_general(
+    # The contraction streams in TILE_K blocks (innermost grid dim) with an
+    # int32 VMEM accumulator — full-k strips bust the 16 MB scoped budget
+    # for large k.
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += jax.lax.dot_general(
         xq_ref[:], wq_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)          # (tm, tn)
-    scale = xs_ref[:] * ws_ref[:]                  # (tm,1)*(1,tn) -> (tm,tn)
-    o_ref[:] = (acc.astype(jnp.float32) * scale).astype(o_ref.dtype)
+
+    @pl.when(kb == n_kb - 1)
+    def _():
+        scale = xs_ref[:] * ws_ref[:]              # (tm,1)*(1,tn)->(tm,tn)
+        o_ref[:] = (acc_scr[:].astype(jnp.float32)
+                    * scale).astype(o_ref.dtype)
+
+
+TILE_K = 1024
 
 
 def quant_matmul(x, wq, w_scale, *, out_dtype=None):
@@ -67,24 +84,29 @@ def quant_matmul(x, wq, w_scale, *, out_dtype=None):
     # int8 tiles are (32, 128); pad every dim (zero contraction columns are
     # exact no-ops in the int32 accumulation).
     mp, np_ = round_up(m, TILE_M), round_up(n, TILE_N)
-    kp = k if use_interpret() else round_up(k, 128)
+    # k pads to a multiple of tile_k: a ragged final k-block would
+    # accumulate out-of-bounds garbage (no in-kernel contraction mask)
+    tile_k = min(TILE_K, round_up(k, 8 if use_interpret() else 128))
+    kp = round_up(k, tile_k)
     xq = pad_dim(pad_dim(xq, 0, mp), 1, kp)
     x_scale = pad_dim(x_scale.reshape(m, 1), 0, mp)
     wq = pad_dim(pad_dim(wq, 0, kp), 1, np_)
     w_scale = pad_dim(w_scale.reshape(1, n), 1, np_)
     k = kp
+    n_kb = cdiv(k, tile_k)
 
     out = pl.pallas_call(
-        _qmm_kernel,
-        grid=(cdiv(mp, TILE_M), cdiv(np_, TILE_N)),
+        functools.partial(_qmm_kernel, n_kb),
+        grid=(cdiv(mp, TILE_M), cdiv(np_, TILE_N), n_kb),
         in_specs=[
-            pl.BlockSpec((TILE_M, k), lambda i, j: (i, 0)),
-            pl.BlockSpec((k, TILE_N), lambda i, j: (0, j)),
-            pl.BlockSpec((TILE_M, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, TILE_N), lambda i, j: (0, j)),
+            pl.BlockSpec((TILE_M, tile_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((tile_k, TILE_N), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((TILE_M, 1), lambda i, j, kk: (i, 0)),
+            pl.BlockSpec((1, TILE_N), lambda i, j, kk: (0, j)),
         ],
-        out_specs=pl.BlockSpec((TILE_M, TILE_N), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((TILE_M, TILE_N), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[pltpu.VMEM((TILE_M, TILE_N), jnp.int32)],
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * np_ * k,
             bytes_accessed=mp * k + k * np_ + mp * np_ * 4,

@@ -387,3 +387,20 @@ class TestLayerNormWideFeatures:
         gr = jax.grad(lambda x: jnp.sum(layer_norm(x, g, b)
                                         .astype(jnp.float32)))(x)
         assert gr.shape == x.shape
+
+
+class TestQuantMatmulKBlocking:
+    def test_multi_k_block_with_ragged_k(self):
+        # contraction longer than TILE_K and NOT a multiple of it: the
+        # streamed k-blocks must pad (a ragged final block accumulated
+        # out-of-bounds garbage before the fix)
+        from simple_tensorflow_tpu.ops.pallas.quant_matmul import TILE_K
+
+        x = rand(0, (32, 2 * TILE_K + 64), jnp.bfloat16)
+        w = rand(1, (2 * TILE_K + 64, 96))
+        wq, s = quantize_colwise(w)
+        o1 = quant_matmul(x, wq, s)
+        o2 = quant_matmul_reference(x, wq, s)
+        np.testing.assert_allclose(o1.astype(jnp.float32),
+                                   o2.astype(jnp.float32), atol=1e-4,
+                                   rtol=1e-4)
