@@ -1,0 +1,48 @@
+#!/bin/bash
+# One-shot TPU measurement battery: run when the chip is healthy.
+# Each step is independently time-bounded and appends to artifacts/.
+# Usage: bash benchmarks/run_all_tpu.sh
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+
+probe() {
+  timeout 60 python -c "import jax; assert jax.devices()[0].platform=='tpu'" \
+    2>/dev/null
+}
+
+if ! probe; then
+  echo "TPU not healthy; aborting" >&2
+  exit 1
+fi
+
+echo "== bench.py (headline metrics) =="
+timeout 1800 python bench.py 2>/dev/null | tee artifacts/bench_latest.jsonl
+
+echo "== pallas microbench: per-family =="
+timeout 900 python benchmarks/pallas_bench.py --iters 10 --kernels flash \
+  --shapes bert_base_s512,transformer_big_s256 \
+  --out artifacts/pb_flash.json 2>/dev/null | grep '^{'
+timeout 900 python benchmarks/pallas_bench.py --iters 10 --kernels flash \
+  --shapes long_context_s4096 --out artifacts/pb_flash_long.json \
+  2>/dev/null | grep '^{'
+timeout 600 python benchmarks/pallas_bench.py --iters 10 --kernels ln \
+  --out artifacts/pb_ln.json 2>/dev/null | grep '^{'
+timeout 600 python benchmarks/pallas_bench.py --iters 10 --kernels xent \
+  --out artifacts/pb_xent.json 2>/dev/null | grep '^{'
+timeout 600 python benchmarks/pallas_bench.py --iters 10 --kernels quant \
+  --out artifacts/pb_quant.json 2>/dev/null | grep '^{'
+
+echo "== block-size tunes =="
+timeout 900 python benchmarks/pallas_bench.py --tune flash --iters 10 \
+  2>/dev/null | tee artifacts/tune_flash.jsonl | grep '^{'
+timeout 900 python benchmarks/pallas_bench.py --tune xent --iters 10 \
+  2>/dev/null | tee artifacts/tune_xent.jsonl | grep '^{'
+
+echo "== step profiles =="
+timeout 900 python benchmarks/profile_resnet.py --skip-pure \
+  2>/dev/null | tee artifacts/profile_resnet_latest.json | tail -20
+timeout 900 python benchmarks/profile_bert.py \
+  2>/dev/null | tee artifacts/profile_bert_latest.json | tail -20
+
+echo "== done; artifacts/ updated =="
