@@ -252,10 +252,12 @@ def _measure_bert(batch, platform, device_kind):
     import simple_tensorflow_tpu as stf
 
     stf.reset_default_graph()
-    m = bert.bert_pretrain_model(batch_size=batch, seq_len=seq_len,
-                                 max_predictions=max_pred, cfg=cfg,
-                                 compute_dtype=stf.bfloat16,
-                                 use_input_mask=True)
+    m = bert.bert_pretrain_model(
+        batch_size=batch, seq_len=seq_len, max_predictions=max_pred,
+        cfg=cfg, compute_dtype=stf.bfloat16, use_input_mask=True,
+        # remat per layer (stf.recompute_grad): trades ~1.33x FLOPs for
+        # activation HBM — enables larger batches when capacity-bound
+        recompute=os.environ.get("BENCH_BERT_RECOMPUTE", "0") == "1")
     batch_np = bert.synthetic_pretrain_batch(batch, seq_len, max_pred,
                                              vocab_size=cfg.vocab_size)
     batch_np["input_mask"] = np.ones((batch, seq_len), np.int32)

@@ -140,7 +140,7 @@ from .ops.io_ops import (
     TFRecordReader, FixedLengthRecordReader, read_file, write_file,
     matching_files,
 )
-from .framework.function import Defun
+from .framework.function import Defun, recompute_grad
 from .framework import function
 from .framework import optimizer as graph_optimizer
 from .ops.linalg_ops import (

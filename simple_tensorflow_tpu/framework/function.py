@@ -31,6 +31,34 @@ op_registry.register("GraphFunctionCall", lower=_lower_function_call,
                      n_outputs=None)
 
 
+def _trace_body(g, func, name, arg_specs):
+    """Trace ``func`` into a FuncGraph of ``g`` for the given input specs
+    (shared by @Defun and recompute_grad)."""
+    fg = ops_mod.FuncGraph(name, outer_graph=g)
+    with ops_mod._as_current(fg):
+        args = [fg.add_input(dtype, shape, f"arg{i}")
+                for i, (shape, dtype) in enumerate(arg_specs)]
+        res = func(*args)
+        if res is None:
+            raise ValueError(f"graph function {name} returned None")
+        flat = list(res) if isinstance(res, (list, tuple)) else [res]
+        fg.outputs = [ops_mod.convert_to_tensor(t) for t in flat]
+    return fg
+
+
+def _emit_call(g, op_type, fg, tensors, name):
+    """Create the call node for a traced FuncGraph (captures appended)."""
+    captures = [outer for outer, _ in fg.captures]
+    op = g.create_op(
+        op_type, list(tensors) + captures,
+        attrs={"func_graph": fg, "n_args": len(tensors),
+               "func_name": fg.func_name},
+        name=name or fg.func_name,
+        output_specs=[(t.shape, t.dtype) for t in fg.outputs])
+    outs = list(op.outputs)
+    return outs[0] if len(outs) == 1 else outs
+
+
 class _DefinedFunction:
     """A callable graph function (ref function.py:255 ``_DefinedFunction``).
 
@@ -70,16 +98,7 @@ class _DefinedFunction:
         key = tuple(arg_specs)
         if key in per_graph:
             return per_graph[key]
-        fg = ops_mod.FuncGraph(self._name, outer_graph=g)
-        with ops_mod._as_current(fg):
-            args = [fg.add_input(dtype, shape, f"arg{i}")
-                    for i, (shape, dtype) in enumerate(arg_specs)]
-            res = self._func(*args)
-            if res is None:
-                raise ValueError(
-                    f"@Defun function {self._name} returned None")
-            flat = list(res) if isinstance(res, (list, tuple)) else [res]
-            fg.outputs = [ops_mod.convert_to_tensor(t) for t in flat]
+        fg = _trace_body(g, self._func, self._name, arg_specs)
         per_graph[key] = fg
         return fg
 
@@ -93,15 +112,7 @@ class _DefinedFunction:
                    for a, t in zip(args, self._input_types)]
         specs = [(t.shape, t.dtype) for t in tensors]
         fg = self._trace(specs)
-        captures = [outer for outer, _ in fg.captures]
-        op = g.create_op(
-            "GraphFunctionCall", tensors + captures,
-            attrs={"func_graph": fg, "n_args": len(tensors),
-                   "func_name": self._name},
-            name=name or self._name,
-            output_specs=[(t.shape, t.dtype) for t in fg.outputs])
-        outs = list(op.outputs)
-        return outs[0] if len(outs) == 1 else outs
+        return _emit_call(g, "GraphFunctionCall", fg, tensors, name)
 
 
 class Defun:
@@ -123,3 +134,76 @@ class Defun:
             grad_func=self._kwargs.get("grad_func"),
             python_grad_func=self._kwargs.get("python_grad_func"),
             out_names=self._kwargs.get("out_names"))
+
+
+def _prefetch_rng_keys(ctx, fg):
+    """Derive per-op RNG keys for every stateful op in fg (and nested
+    FuncGraphs) OUTSIDE the checkpoint trace: rng_for caches the derived
+    key on the LoweringContext, and a key first created inside
+    jax.checkpoint's trace would be a leaked tracer. Pre-derived keys are
+    closed-over constants — the recompute replays the identical stream
+    (dropout masks match between forward and rematerialized backward)."""
+    for inner_op in fg.get_operations():
+        if op_registry.exists(inner_op.type) and \
+                op_registry.get(inner_op.type).is_stateful:
+            ctx.rng_for(inner_op)
+        for v in inner_op.attrs.values():
+            if isinstance(v, ops_mod.FuncGraph):
+                _prefetch_rng_keys(ctx, v)
+
+
+def _lower_recompute_call(ctx, op, inputs):
+    """Lower the traced body under jax.checkpoint: XLA saves only the
+    call's INPUTS for the backward pass and re-runs the body to
+    rematerialize intermediates — the jax.checkpoint counterpart of the
+    reference's (contrib) recompute_grad, promoted to a first-class graph
+    op because trading FLOPs for HBM is how TPUs buy batch size."""
+    import jax
+
+    fg = op.attrs["func_graph"]
+    n = op.attrs["n_args"]
+    _prefetch_rng_keys(ctx, fg)
+
+    def body(args, caps):
+        return lowering_mod.lower_func_graph(ctx, fg, list(args), list(caps))
+
+    return jax.checkpoint(body)(tuple(inputs[:n]), tuple(inputs[n:]))
+
+
+op_registry.register("RecomputeGradCall", lower=_lower_recompute_call,
+                     n_outputs=None)
+
+
+def recompute_grad(func, name=None):
+    """Wrap ``func`` so reverse-mode AD rematerializes its intermediates
+    instead of saving them (jax.checkpoint under the hood). Usage:
+
+        block = stf.recompute_grad(lambda x: expensive_block(x))
+        y = block(x)
+
+    The body is traced per input signature (like @Defun); variables it
+    reads are captured and re-read on the recompute."""
+
+    def wrapper(*args, **kwargs):
+        if kwargs:
+            raise TypeError("recompute_grad functions take positional "
+                            "tensor arguments only")
+        g = ops_mod.get_default_graph()
+        tensors = [ops_mod.convert_to_tensor(a) for a in args]
+        specs = tuple((t.shape, t.dtype) for t in tensors)
+        cache = g._scoped_state.setdefault("__recompute_cache__", {})
+        # key on the func OBJECT, not id(func): the dict then holds a
+        # strong reference, so a discarded lambda's recycled id can never
+        # alias another function's traced body (observed: per-layer
+        # lambdas silently sharing one layer's weights)
+        key = (func, specs)
+        fg = cache.get(key)
+        if fg is None:
+            fg = _trace_body(g, func,
+                             name or getattr(func, "__name__", "recompute"),
+                             specs)
+            cache[key] = fg
+        return _emit_call(g, "RecomputeGradCall", fg, tensors,
+                          name or "recompute_grad")
+
+    return wrapper

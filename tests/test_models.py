@@ -248,3 +248,35 @@ def test_transformer_bf16_train_step():
             sess.run(m["train_op"], feed)
         l1 = sess.run(m["loss"], feed)
     assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0, (l0, l1)
+
+
+def test_bert_recompute_trains():
+    """recompute=True (per-layer jax.checkpoint) trains end-to-end with
+    the full pretraining config (dropout inside the checkpointed blocks —
+    the RNG prefetch must keep fwd/remat streams identical). Exact
+    gradient parity on SHARED weights is covered by
+    test_framework_extras.TestRecomputeGrad; cross-graph loss equality is
+    not testable (initializer seeds derive from op counters, which the
+    extra remat call ops shift)."""
+    from simple_tensorflow_tpu.models import bert
+
+    stf.reset_default_graph()
+    cfg = bert.BertConfig.tiny()
+    cfg.attention_dropout = 0.1
+    cfg.hidden_dropout = 0.1
+    m = bert.bert_pretrain_model(batch_size=2, seq_len=16,
+                                 max_predictions=4, cfg=cfg,
+                                 compute_dtype=stf.float32,
+                                 learning_rate=1e-3, use_input_mask=True,
+                                 recompute=True)
+    batch = bert.synthetic_pretrain_batch(2, 16, 4,
+                                          vocab_size=cfg.vocab_size)
+    batch["input_mask"] = np.ones((2, 16), np.int32)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        feed = {m[k]: v for k, v in batch.items()}
+        l0 = float(np.asarray(sess.run(m["loss"], feed)))
+        for _ in range(8):
+            sess.run(m["train_op"], feed)
+        l1 = float(np.asarray(sess.run(m["loss"], feed)))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0, (l0, l1)
