@@ -156,21 +156,13 @@ def bert_encoder(input_ids, token_type_ids, input_mask, cfg,
         else:
             bias = None
         with stf.variable_scope("encoder"):
+            def enc_layer(hh, i):
+                return transformer_block(hh, bias, cfg, training,
+                                         compute_dtype, name=f"layer_{i}")
+
             for i in range(cfg.num_layers):
-                if recompute:
-                    # variables must live in the ROOT graph: a throwaway
-                    # call creates them (its ops are pruned — nothing
-                    # fetches them), then the traced body re-reads them as
-                    # captures under AUTO_REUSE
-                    transformer_block(h, bias, cfg, training, compute_dtype,
-                                      name=f"layer_{i}")
-                    h = stf.recompute_grad(
-                        lambda hh, n=f"layer_{i}": transformer_block(
-                            hh, bias, cfg, training, compute_dtype, name=n),
-                        name=f"layer_{i}_rc")(h)
-                else:
-                    h = transformer_block(h, bias, cfg, training,
-                                          compute_dtype, name=f"layer_{i}")
+                h = common.maybe_recompute(enc_layer, h, i, recompute,
+                                           "layer")
         # sequence_output stays in compute dtype: the MLM head reshapes and
         # gathers the full [B,S,H] tensor, and an early f32 cast here moved
         # it (plus its VJP) through HBM at double width. Heads cast their

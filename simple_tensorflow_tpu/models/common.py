@@ -33,3 +33,20 @@ def split_heads(x, b, s, heads, head_dim):
 def merge_heads(x, b, s, hidden):
     """(B,H,S,D) -> (B,S,H*D)."""
     return stf.reshape(stf.transpose(x, [0, 2, 1, 3]), [b, s, hidden])
+
+
+def maybe_recompute(layer_fn, h, i, recompute, tag):
+    """Apply layer_fn(h, i), optionally under stf.recompute_grad.
+
+    Two load-bearing details of the idiom live HERE, once:
+    - the throwaway call pre-creates the layer's variables in the ROOT
+      graph (variables created inside the traced FuncGraph would be lost;
+      the throwaway ops are pruned because nothing fetches them);
+    - loop state binds via the default arg (i=i) so each layer's lambda is
+      a distinct object — the trace cache keys on the function object.
+    """
+    if not recompute:
+        return layer_fn(h, i)
+    layer_fn(h, i)
+    return stf.recompute_grad(lambda hh, i=i: layer_fn(hh, i),
+                              name=f"{tag}_{i}_rc")(h)

@@ -104,7 +104,7 @@ def block(h, cfg, cos, sin, sp_axis, name):
 
 
 def lm_forward(ids, cfg, compute_dtype=stf.bfloat16, sp_axis="sp",
-               scope="long_lm"):
+               scope="long_lm", recompute=False):
     """ids (B,S) -> logits (B,S,vocab). S may be sharded over 'sp'."""
     b, s = int(ids.shape[0]), int(ids.shape[1])
     with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
@@ -118,8 +118,12 @@ def lm_forward(ids, cfg, compute_dtype=stf.bfloat16, sp_axis="sp",
         cos, sin = rope_tables(s, cfg.d_model // cfg.num_heads,
                                cfg.rope_theta)
         cos, sin = stf.constant(cos), stf.constant(sin)
+        def lm_layer(hh, i):
+            return block(hh, cfg, cos, sin, sp_axis, f"layer_{i}")
+
         for i in range(cfg.num_layers):
-            h = block(h, cfg, cos, sin, sp_axis, f"layer_{i}")
+            # at long context, per-layer activations ARE the memory budget
+            h = common.maybe_recompute(lm_layer, h, i, recompute, "layer")
         h = _ln(h, cfg, "ln_final")
         # tied vocab projection in compute dtype — the [B*S, vocab] logits
         # are the largest tensor at long context; the fused xent kernel
@@ -133,7 +137,7 @@ def lm_forward(ids, cfg, compute_dtype=stf.bfloat16, sp_axis="sp",
 def lm_train_model(batch_size=1, seq_len=32768,
                    cfg: LongContextConfig | None = None,
                    learning_rate=3e-4, compute_dtype=stf.bfloat16,
-                   sp_axis="sp"):
+                   sp_axis="sp", recompute=False):
     """Next-token LM training graph; shard seq over 'sp', batch over 'dp'."""
     cfg = cfg or LongContextConfig()
     ids = stf.placeholder(stf.int32, [batch_size, seq_len], "input_ids")
@@ -151,7 +155,8 @@ def lm_train_model(batch_size=1, seq_len=32768,
             parallel.shard_feed(ids, *spec)
             parallel.shard_feed(targets, *spec)
 
-    logits = lm_forward(ids, cfg, compute_dtype, sp_axis)
+    logits = lm_forward(ids, cfg, compute_dtype, sp_axis,
+                        recompute=recompute)
     loss = stf.reduce_mean(stf.nn.fused_softmax_cross_entropy(
         stf.reshape(logits, [batch_size * seq_len, cfg.vocab_size]),
         stf.reshape(targets, [-1])))

@@ -145,37 +145,44 @@ def _pad_bias(ids, cfg):
 
 
 def encode(src_ids, cfg, training=True, compute_dtype=stf.bfloat16,
-           scope="transformer"):
+           scope="transformer", recompute=False):
     with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
         h, _ = _embed(src_ids, cfg, compute_dtype, training)
         bias = _pad_bias(src_ids, cfg)
         with stf.variable_scope("encoder"):
-            for i in range(cfg.num_layers):
+            def enc_layer(hh, i):
                 with stf.variable_scope(f"layer_{i}"):
-                    a = _attention(h, h, bias, cfg, training, compute_dtype,
-                                   "self_attn")
-                    h = _ln(h + a, cfg, "ln1")
-                    f = _ffn(h, cfg, training, "ffn")
-                    h = _ln(h + f, cfg, "ln2")
+                    a = _attention(hh, hh, bias, cfg, training,
+                                   compute_dtype, "self_attn")
+                    hh = _ln(hh + a, cfg, "ln1")
+                    f = _ffn(hh, cfg, training, "ffn")
+                    return _ln(hh + f, cfg, "ln2")
+
+            for i in range(cfg.num_layers):
+                h = common.maybe_recompute(enc_layer, h, i, recompute, "enc")
     return h, bias
 
 
 def decode(tgt_ids, enc_out, enc_bias, cfg, training=True,
-           compute_dtype=stf.bfloat16, scope="transformer"):
+           compute_dtype=stf.bfloat16, scope="transformer",
+           recompute=False):
     """Returns logits (B, St, vocab); causal self-attention over tgt_ids."""
     with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
         h, emb = _embed(tgt_ids, cfg, compute_dtype, training)
         with stf.variable_scope("decoder"):
-            for i in range(cfg.num_layers):
+            def dec_layer(hh, i):
                 with stf.variable_scope(f"layer_{i}"):
-                    a = _attention(h, h, None, cfg, training, compute_dtype,
-                                   "self_attn", causal=True)
-                    h = _ln(h + a, cfg, "ln1")
-                    c = _attention(h, enc_out, enc_bias, cfg, training,
+                    a = _attention(hh, hh, None, cfg, training,
+                                   compute_dtype, "self_attn", causal=True)
+                    hh = _ln(hh + a, cfg, "ln1")
+                    c = _attention(hh, enc_out, enc_bias, cfg, training,
                                    compute_dtype, "cross_attn")
-                    h = _ln(h + c, cfg, "ln2")
-                    f = _ffn(h, cfg, training, "ffn")
-                    h = _ln(h + f, cfg, "ln3")
+                    hh = _ln(hh + c, cfg, "ln2")
+                    f = _ffn(hh, cfg, training, "ffn")
+                    return _ln(hh + f, cfg, "ln3")
+
+            for i in range(cfg.num_layers):
+                h = common.maybe_recompute(dec_layer, h, i, recompute, "dec")
         # tied softmax weights, computed in compute dtype: the
         # [B*S, vocab] logits are the largest tensor in the model, and the
         # fused xent kernel does its softmax math in f32 blockwise anyway
@@ -208,7 +215,8 @@ def smoothed_xent(logits, labels, weights, cfg):
 def transformer_train_model(batch_size=64, src_len=64, tgt_len=64,
                             cfg: TransformerConfig | None = None,
                             learning_rate=1.0, warmup_steps=4000,
-                            compute_dtype=stf.bfloat16, data_parallel=False):
+                            compute_dtype=stf.bfloat16, data_parallel=False,
+                            recompute=False):
     """Training graph: src/tgt -> label-smoothed loss -> Adam + noam decay."""
     cfg = cfg or TransformerConfig.big()
     src = stf.placeholder(stf.int32, [batch_size, src_len], "src_ids")
@@ -222,9 +230,10 @@ def transformer_train_model(batch_size=64, src_len=64, tgt_len=64,
                 parallel.shard_feed(t, "dp")
 
     enc_out, enc_bias = encode(src, cfg, training=True,
-                               compute_dtype=compute_dtype)
+                               compute_dtype=compute_dtype,
+                               recompute=recompute)
     logits = decode(tgt_in, enc_out, enc_bias, cfg, training=True,
-                    compute_dtype=compute_dtype)
+                    compute_dtype=compute_dtype, recompute=recompute)
     weights = stf.cast(stf.not_equal(tgt_out, cfg.pad_id), stf.float32)
     loss = smoothed_xent(logits, tgt_out, weights, cfg)
 
