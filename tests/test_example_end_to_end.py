@@ -1,0 +1,22 @@
+"""The examples/ user journey as a test: TFRecord write -> stf.data
+pipeline -> MonitoredTrainingSession -> checkpoint resume -> SavedModel
+export -> serve (mirrors the reference's tutorial workflow)."""
+
+import os
+import subprocess
+import sys
+
+
+def test_end_to_end_example_runs(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "train_mnist_end_to_end.py"),
+         "--steps", "12", "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "DONE" in out.stdout
+    assert "served predictions" in out.stdout
