@@ -242,6 +242,19 @@ class BaseSession:
 
         return device_lib.list_local_devices()
 
+    def variable_value(self, var_or_name):
+        """The DEVICE array backing a variable (jax.Array, sharding
+        intact) — unlike ``run(var)``, which fetches a host copy. TPU-
+        native introspection point for placement/sharding checks."""
+        name = var_or_name if isinstance(var_or_name, str) else \
+            getattr(var_or_name, "_var_name", None) or var_or_name.op.name
+        store = self._variable_store.values
+        if name not in store:
+            raise KeyError(
+                f"No variable state named {name!r}; initialized variables: "
+                f"{sorted(store)[:10]}...")
+        return store[name]
+
     # -- lifecycle -----------------------------------------------------------
     def close(self):
         self._closed = True

@@ -184,3 +184,22 @@ def test_dropout_grad_mask_consistency():
         yv, dxv = sess.run([y, dx], {x: xv})
         # gradient mask must equal the forward mask
         np.testing.assert_allclose((yv > 0).astype(np.float32) * 2.0, dxv)
+
+
+class TestVariableValue:
+    def test_returns_device_array_with_sharding(self):
+        stf.reset_default_graph()
+        v = stf.Variable(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         name="vv")
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        arr = sess.variable_value("vv")
+        assert hasattr(arr, "sharding")
+        np.testing.assert_array_equal(np.asarray(arr),
+                                      np.arange(6).reshape(2, 3))
+        # by Variable object too
+        arr2 = sess.variable_value(v)
+        assert arr2 is arr
+        import pytest as _pytest
+        with _pytest.raises(KeyError):
+            sess.variable_value("nope")
