@@ -1,0 +1,106 @@
+"""Dtype-hygiene regression guards for the model zoo.
+
+Round-3 perf work found (on the real chip) that full-size f32 activation
+tensors are the dominant HBM byte sink in bf16 training — they crept in
+through embedding pipelines, early f32 casts before full-tensor
+reshapes, and f32 head projections. These tests scan the BUILT GRAPHS
+and fail if any op under a bf16 compute dtype emits an f32 tensor of
+activation size, so the fixes can't silently regress.
+
+Allowed f32 at activation scale: parameter-sized tensors (optimizer math
+is f32 by design) and ops living under the optimizer / initializer /
+gradient name scopes — matched on whole path segments, not substrings,
+so a model op named e.g. "mask_zeros" cannot slip through.
+"""
+
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+# whole path segments (or segment prefixes, for uniquified names like
+# "Adam_1") that mark parameter/optimizer/save plumbing
+_ALLOWED_SEGMENT_PREFIXES = ("Adam", "Momentum", "Initializer",
+                             "gradients", "read", "zeros", "save",
+                             "restore")
+
+
+def _is_plumbing(op_name):
+    return any(seg.startswith(p) for seg in op_name.split("/")
+               for p in _ALLOWED_SEGMENT_PREFIXES)
+
+
+def _f32_activation_leaks(graph, min_elems, param_shapes):
+    leaks = []
+    for op in graph.get_operations():
+        if _is_plumbing(op.name):
+            continue
+        for t in op.outputs:
+            if t.dtype.base_dtype.name != "float32":
+                continue
+            if not t.shape.is_fully_defined():
+                continue
+            n = 1
+            for d in t.shape.as_list():
+                n *= d
+            if n < min_elems:
+                continue
+            if tuple(t.shape.as_list()) in param_shapes:
+                continue  # parameter-sized: f32 master weights by design
+            leaks.append((op.type, op.name, t.shape.as_list()))
+    return leaks
+
+
+def _build_bert():
+    from simple_tensorflow_tpu.models import bert
+
+    cfg = bert.BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                          num_heads=2, intermediate_size=128,
+                          max_position=64, hidden_dropout=0.1,
+                          attention_dropout=0.1)
+    bert.bert_pretrain_model(batch_size=4, seq_len=64, max_predictions=8,
+                             cfg=cfg, compute_dtype=stf.bfloat16,
+                             use_input_mask=True)
+    return 4 * 64 * 64
+
+
+def _build_transformer():
+    from simple_tensorflow_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab_size=512, d_model=64, num_heads=2,
+                               d_ff=128, num_layers=2, max_len=64)
+    tr.transformer_train_model(batch_size=4, src_len=64, tgt_len=64,
+                               cfg=cfg, compute_dtype=stf.bfloat16)
+    return 4 * 64 * 64
+
+
+def _build_long_context():
+    from simple_tensorflow_tpu.models import long_context as lc
+
+    cfg = lc.LongContextConfig(vocab_size=256, d_model=64, num_heads=2,
+                               d_ff=128, num_layers=2, max_len=256)
+    lc.lm_train_model(batch_size=2, seq_len=128, cfg=cfg,
+                      compute_dtype=stf.bfloat16)
+    return 2 * 128 * 64
+
+
+@pytest.mark.parametrize("builder", [_build_bert, _build_transformer,
+                                     _build_long_context],
+                         ids=["bert", "transformer", "long_context"])
+def test_bf16_graph_has_no_f32_activations(builder):
+    stf.reset_default_graph()
+    min_elems = builder()
+    param_shapes = {tuple(v.shape.as_list()) for v in
+                    stf.global_variables() if v.shape.is_fully_defined()}
+    leaks = _f32_activation_leaks(stf.get_default_graph(), min_elems,
+                                  param_shapes)
+    assert not leaks, leaks[:10]
+
+
+def test_detector_fires_on_f32_activations():
+    """The guard itself must fail on the pattern it exists to catch."""
+    stf.reset_default_graph()
+    x = stf.placeholder(stf.float32, [4, 64, 64], name="leaky")
+    stf.tanh(x * 2.0)
+    leaks = _f32_activation_leaks(stf.get_default_graph(),
+                                  min_elems=4 * 64 * 64, param_shapes=set())
+    assert leaks, "detector failed to flag an f32 activation graph"
