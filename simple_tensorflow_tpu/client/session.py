@@ -250,9 +250,24 @@ class BaseSession:
             getattr(var_or_name, "_var_name", None) or var_or_name.op.name
         store = self._variable_store.values
         if name not in store:
+            # A read tensor / ref was passed: its op name carries scope
+            # suffixes ("/read", ":0") the store is not keyed by. Resolve
+            # through the graph's variable registry before giving up.
+            registry = self._graph._scoped_state.get(
+                "__vars_by_store_name__", {})
+            stripped = name.split(":")[0]
+            if stripped.endswith("/read"):
+                stripped = stripped[:-len("/read")]
+            for cand in (stripped, name):
+                if cand in store:
+                    return store[cand]
+                var = registry.get(cand)
+                if var is not None and var._var_name in store:
+                    return store[var._var_name]
             raise KeyError(
-                f"No variable state named {name!r}; initialized variables: "
-                f"{sorted(store)[:10]}...")
+                f"No variable state named {name!r} (argument must be a "
+                f"Variable, its read tensor, or a store name); initialized "
+                f"variables: {sorted(store)[:10]}...")
         return store[name]
 
     # -- lifecycle -----------------------------------------------------------

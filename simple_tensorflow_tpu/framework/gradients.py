@@ -40,6 +40,36 @@ def _as_tensor_list(x):
     return [x]
 
 
+def _while_reaches_ys_differentiably(while_op, ys, stop_set):
+    """True iff a While op's output can carry a nonzero cotangent from ys.
+
+    Paths cut by ``stop_gradients``, by a StopGradient op, or passing only
+    through non-floating tensors (e.g. argmax/sampled indices feeding a
+    gather) receive zero cotangents, so the loop transpose is never invoked
+    and the forward-only While is harmless — don't reject those graphs.
+    """
+    yset = set(ys)
+    seen = set()
+    work = [t for t in while_op.outputs
+            if (t.dtype.is_floating or t.dtype.is_complex)
+            and t not in stop_set]
+    while work:
+        t = work.pop()
+        if t in seen:
+            continue
+        seen.add(t)
+        if t in yset:
+            return True
+        for consumer in t.consumers():
+            if consumer.type == "StopGradient":
+                continue
+            for out in consumer.outputs:
+                if ((out.dtype.is_floating or out.dtype.is_complex)
+                        and out not in stop_set):
+                    work.append(out)
+    return False
+
+
 def gradients(ys, xs, grad_ys=None, name="gradients",
               colocate_gradients_with_ops=False, gate_gradients=False,
               aggregation_method=None, stop_gradients=None) -> List[Optional[Tensor]]:
@@ -78,7 +108,8 @@ def gradients(ys, xs, grad_ys=None, name="gradients",
         grad_ys = [None] * len(ys)
 
     path_ops, connected = lowering_mod.ancestors_between(xs, ys)
-    while_on_path = [o.name for o in path_ops if o.type == "While"]
+    while_on_path = [o.name for o in path_ops if o.type == "While"
+                     and _while_reaches_ys_differentiably(o, ys, stop_set)]
     if while_on_path:
         # fail at graph construction with an actionable message — the
         # alternative is an opaque lax.while_loop autodiff error deep

@@ -109,7 +109,11 @@ def quant_matmul(x, wq, w_scale, *, out_dtype=None):
         scratch_shapes=[pltpu.VMEM((TILE_M, TILE_N), jnp.int32)],
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * np_ * k,
-            bytes_accessed=mp * k + k * np_ + mp * np_ * 4,
+            # s8 operands are 1 byte each; the f32 scale vectors are
+            # re-fetched on every k-block visit of each (i,j) tile.
+            bytes_accessed=(mp * k + k * np_ + mp * np_ * 4
+                            + 4 * n_kb * (mp * cdiv(np_, TILE_N)
+                                          + np_ * cdiv(mp, TILE_M))),
             transcendentals=0),
         interpret=use_interpret(),
     )(xq, wq, x_scale.astype(jnp.float32), w_scale.astype(jnp.float32))

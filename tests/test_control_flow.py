@@ -283,3 +283,32 @@ class TestRawRNN:
         with _pytest.raises(stf.errors.InvalidArgumentError,
                             match="while_loop"):
             stf.gradients(loss, stf.trainable_variables())
+
+    def test_gradient_ok_when_while_cut_by_stop_gradient(self):
+        # A While output that reaches the loss only through stop_gradient
+        # receives zero cotangents — the loop transpose is never invoked,
+        # so graph construction must not reject it.
+        stf.reset_default_graph()
+        w = stf.Variable(np.float32(2.0))
+        i = stf.constant(0)
+        count = stf.while_loop(lambda i: stf.less(i, 3), lambda i: i + 1, [i])
+        scale = stf.stop_gradient(stf.cast(count, stf.float32))
+        loss = w * w * scale
+        (g,) = stf.gradients(loss, [w])
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert sess.run(g) == 4.0 * 3.0
+
+    def test_gradient_ok_when_while_path_is_integer_only(self):
+        # Integer (non-differentiable) tensors flowing out of a While into
+        # a gather index carry no cotangent; must not raise.
+        stf.reset_default_graph()
+        w = stf.Variable(np.arange(4, dtype=np.float32))
+        i = stf.constant(0)
+        idx = stf.while_loop(lambda i: stf.less(i, 2), lambda i: i + 1, [i])
+        loss = stf.square(stf.gather(w, idx))
+        (g,) = stf.gradients(loss, [w])
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            gv = sess.run(g)
+        np.testing.assert_allclose(gv, [0.0, 0.0, 4.0, 0.0])

@@ -203,3 +203,29 @@ class TestVariableValue:
         import pytest as _pytest
         with _pytest.raises(KeyError):
             sess.variable_value("nope")
+
+    def test_resolves_read_tensor_and_suffixed_names(self):
+        stf.reset_default_graph()
+        with stf.variable_scope("sc"):
+            v = stf.get_variable("w", shape=(2,),
+                                 initializer=stf.zeros_initializer())
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        ref = sess.variable_value(v)
+        # read tensor (op name carries a "/read" suffix) resolves too
+        assert sess.variable_value(v.value()) is ref
+        assert sess.variable_value("sc/w/read") is ref
+        assert sess.variable_value("sc/w:0") is ref
+        with _pytest_raises_keyerror_mentioning("Variable"):
+            sess.variable_value("sc/nope/read")
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _pytest_raises_keyerror_mentioning(word):
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError, match=word):
+        yield
