@@ -134,6 +134,8 @@ from .ops.misc_ops import (
     confusion_matrix, histogram_fixed_width, bitcast, lbeta,
 )
 from .ops.numerics import verify_tensor_all_finite, add_check_numerics_ops
+from .ops import lookup_ops as lookup
+from .ops.lookup_ops import tables_initializer
 from .ops import io_ops
 from .ops.io_ops import (
     ReaderBase, WholeFileReader, IdentityReader, TextLineReader,

@@ -258,12 +258,11 @@ class BaseSession:
             stripped = name.split(":")[0]
             if stripped.endswith("/read"):
                 stripped = stripped[:-len("/read")]
-            for cand in (stripped, name):
-                if cand in store:
-                    return store[cand]
-                var = registry.get(cand)
-                if var is not None and var._var_name in store:
-                    return store[var._var_name]
+            if stripped in store:
+                return store[stripped]
+            var = registry.get(stripped)
+            if var is not None and var._var_name in store:
+                return store[var._var_name]
             raise KeyError(
                 f"No variable state named {name!r} (argument must be a "
                 f"Variable, its read tensor, or a store name); initialized "

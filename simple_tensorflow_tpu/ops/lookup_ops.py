@@ -1,0 +1,568 @@
+"""Lookup tables (ref: tensorflow/python/ops/lookup_ops-era API surface:
+HashTable & friends registered in core/ops/data_flow_ops.cc:1969
+``REGISTER_OP("HashTable")``, ``:1845 LookupTableFind``, kernels in
+core/kernels/lookup_table_op.cc; python wrappers
+contrib/lookup/lookup_ops.py in the 1.0 tree).
+
+TPU-native split:
+
+- Tables are HOST objects (the reference pins lookup kernels to CPU too).
+  String keys/values never enter the XLA program; string→id and id→string
+  lookups run in the Session's host stage on numpy object arrays.
+- **Frozen-dense device fast path**: a ``StaticHashTable`` with integer
+  keys and numeric values is, after initialization, a static vocab. Its
+  ``lookup`` lowers to a pure device op that embeds the sorted key/value
+  arrays as XLA constants and does ``searchsorted`` + ``gather`` on the
+  chip — no host round-trip per step, MXU-adjacent throughput. This is a
+  TPU capability the reference's CPU kernel never had.
+- ``MutableHashTable`` (insert during training) always stays host-stage:
+  device constants would go stale under mutation.
+
+Initialization runs through ``tf.tables_initializer()`` semantics: every
+initializer op is added to ``GraphKeys.TABLE_INITIALIZERS``.
+"""
+
+from __future__ import annotations
+
+import builtins
+import threading
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import errors
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+
+GraphKeys = ops_mod.GraphKeys
+
+
+class TextFileIndex:
+    """Column selectors for TextFileInitializer (ref: contrib/lookup).
+
+    WHOLE_LINE: use the entire line (minus newline) as the key/value.
+    LINE_NUMBER: use the 0-based line number.
+    """
+
+    WHOLE_LINE = -2
+    LINE_NUMBER = -1
+
+
+# ---------------------------------------------------------------------------
+# Initializers
+# ---------------------------------------------------------------------------
+
+class KeyValueTensorInitializer:
+    """Table initializer from key/value tensors (ref: contrib/lookup
+    ``KeyValueTensorInitializer``)."""
+
+    def __init__(self, keys, values, key_dtype=None, value_dtype=None,
+                 name="key_value_init"):
+        self._keys = np.asarray(keys)
+        self._values = np.asarray(values)
+        self.key_dtype = dtypes_mod.as_dtype(
+            key_dtype) if key_dtype else _np_to_stf(self._keys)
+        self.value_dtype = dtypes_mod.as_dtype(
+            value_dtype) if value_dtype else _np_to_stf(self._values)
+        self._name = name
+
+    def _materialize(self):
+        return self._keys, self._values
+
+
+class TextFileInitializer:
+    """Table initializer from a vocab file (ref: contrib/lookup
+    ``TextFileInitializer``; kernel core/kernels/lookup_util.cc)."""
+
+    def __init__(self, filename, key_dtype, key_index, value_dtype,
+                 value_index, vocab_size=None, delimiter="\t",
+                 name="text_file_init"):
+        self._filename = filename
+        self.key_dtype = dtypes_mod.as_dtype(key_dtype)
+        self.value_dtype = dtypes_mod.as_dtype(value_dtype)
+        self._key_index = key_index
+        self._value_index = value_index
+        self._vocab_size = vocab_size
+        self._delimiter = delimiter
+        self._name = name
+        g = ops_mod.get_default_graph()
+        g.add_to_collection(GraphKeys.ASSET_FILEPATHS, filename)
+
+    def _column(self, lines, index, dtype):
+        if index == TextFileIndex.WHOLE_LINE:
+            vals = lines
+        elif index == TextFileIndex.LINE_NUMBER:
+            vals = [builtins.str(i) for i in builtins.range(len(lines))]
+        else:
+            vals = [ln.split(self._delimiter)[index] for ln in lines]
+        if dtype == dtypes_mod.string:
+            return np.array(vals, dtype=object)
+        return np.array([int(v) if dtype.is_integer else float(v)
+                         for v in vals], dtype=dtype.np_dtype)
+
+    def _materialize(self):
+        with open(self._filename, "r") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if self._vocab_size is not None:
+            if len(lines) < self._vocab_size:
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"vocab file {self._filename} has {len(lines)} lines, "
+                    f"expected at least vocab_size={self._vocab_size}")
+            lines = lines[:self._vocab_size]
+        keys = self._column(lines, self._key_index, self.key_dtype)
+        values = self._column(lines, self._value_index, self.value_dtype)
+        return keys, values
+
+
+def _np_to_stf(arr):
+    if arr.dtype == object or arr.dtype.kind in "US":
+        return dtypes_mod.string
+    return dtypes_mod.as_dtype(arr.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Table objects
+# ---------------------------------------------------------------------------
+
+class LookupInterface:
+    """Base lookup table: a named host object whose graph presence is a set
+    of host (or device, see StaticHashTable) ops keyed by table name."""
+
+    _counter = [0]
+
+    def __init__(self, key_dtype, value_dtype, name):
+        LookupInterface._counter[0] += 1
+        self._name = f"{name}_{LookupInterface._counter[0]}"
+        self.key_dtype = dtypes_mod.as_dtype(key_dtype)
+        self.value_dtype = dtypes_mod.as_dtype(value_dtype)
+        self._lock = threading.Lock()
+        # registry lives in the graph's scoped state (like variables), so
+        # tables — and their materialized vocab arrays — die with the graph
+        # instead of leaking across reset_default_graph()
+        g = ops_mod.get_default_graph()
+        g._scoped_state.setdefault("__lookup_tables__", {})[self._name] = self
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def table_ref(self):
+        return self._name
+
+    def size(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op("LookupTableSize", [],
+                         attrs={"table_name": self._name},
+                         name=name or f"{self._name}_size",
+                         output_specs=[(shape_mod.scalar(),
+                                        dtypes_mod.int64)])
+        return op.outputs[0]
+
+    def _check_keys(self, keys):
+        if isinstance(keys, ops_mod.Tensor):
+            # XLA demotes int64 to int32 on TPU, so device-produced ids
+            # arrive as int32 — any integer width keys an integer table.
+            if self.key_dtype.is_integer and keys.dtype.is_integer:
+                return keys
+        else:
+            keys = ops_mod.convert_to_tensor(keys, dtype=self.key_dtype)
+        if keys.dtype.base_dtype != self.key_dtype:
+            raise TypeError(
+                f"Table {self._name} expects {self.key_dtype} keys, "
+                f"got {keys.dtype}")
+        return keys
+
+
+class InitializableLookupTableBase(LookupInterface):
+    def __init__(self, initializer, default_value, name):
+        super().__init__(initializer.key_dtype, initializer.value_dtype,
+                         name)
+        self._default_value = default_value
+        self._initializer = initializer
+        self._initialized = False
+        self._host_map = None       # dict key -> value
+        self._keys_np = None        # materialized arrays (device path)
+        self._values_np = None
+        g = ops_mod.get_default_graph()
+        self._init_op = g.create_op(
+            "InitializeTable", [], attrs={"table_name": self._name},
+            name=f"{self._name}_init", output_specs=[])
+        g.add_to_collection(GraphKeys.TABLE_INITIALIZERS, self._init_op)
+
+    @property
+    def initializer(self):
+        return self._init_op
+
+    @property
+    def init(self):  # TF-1.0 alias
+        return self._init_op
+
+    @property
+    def default_value(self):
+        return self._default_value
+
+    # -- host behavior -------------------------------------------------------
+    def _host_initialize(self):
+        with self._lock:
+            if self._initialized:
+                return  # ref: double tables_initializer() run is a no-op
+            keys, values = self._initializer._materialize()
+            if keys.shape[0] != values.shape[0]:
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"Table {self._name}: {keys.shape[0]} keys vs "
+                    f"{values.shape[0]} values")
+            self._host_map = {
+                _norm_key(k): v for k, v in zip(keys.tolist(),
+                                                values.tolist())}
+            if self.key_dtype.is_integer and not _is_string_dtype(
+                    self.value_dtype):
+                order = np.argsort(keys, kind="stable")
+                self._keys_np = np.ascontiguousarray(keys[order])
+                self._values_np = np.ascontiguousarray(values[order])
+            self._initialized = True
+
+    def _require_init(self):
+        if not self._initialized:
+            raise errors.FailedPreconditionError(
+                None, None,
+                f"Table {self._name} is not initialized. Run "
+                "stf.tables_initializer() (or table.init) first.")
+
+    def _host_find(self, keys):
+        self._require_init()
+        flat = np.asarray(keys).reshape(-1)
+        out = [self._host_map.get(_norm_key(k), self._default_value)
+               for k in flat.tolist()]
+        if _is_string_dtype(self.value_dtype):
+            res = np.array(out, dtype=object)
+        else:
+            res = np.array(out, dtype=self.value_dtype.np_dtype)
+        return res.reshape(np.asarray(keys).shape)
+
+    def _host_size(self):
+        self._require_init()
+        return np.asarray(len(self._host_map), dtype=np.int64)
+
+    # -- graph endpoint ------------------------------------------------------
+    def lookup(self, keys, name=None):
+        keys = self._check_keys(keys)
+        g = ops_mod.get_default_graph()
+        device_path = (self.key_dtype.is_integer
+                       and not _is_string_dtype(self.value_dtype))
+        op_type = ("LookupTableFindDevice" if device_path
+                   else "LookupTableFind")
+        op = g.create_op(
+            op_type, [keys], attrs={"table_name": self._name},
+            name=name or f"{self._name}_lookup",
+            output_specs=[(keys.shape, self.value_dtype)])
+        return op.outputs[0]
+
+    find = lookup  # raw-op-style alias
+
+
+class HashTable(InitializableLookupTableBase):
+    """Immutable key→value table (ref: core/ops/data_flow_ops.cc:1969
+    ``HashTable`` + kernels/lookup_table_op.cc). Init-once; integer-keyed
+    numeric tables get the frozen-dense device fast path."""
+
+    def __init__(self, initializer, default_value, shared_name=None,
+                 name="hash_table"):
+        super().__init__(initializer, default_value, shared_name or name)
+
+
+StaticHashTable = HashTable  # TF-2 name, same object
+
+
+class MutableHashTable(LookupInterface):
+    """Mutable table (ref: core/ops/data_flow_ops.cc ``MutableHashTable``,
+    LookupTableInsert). Always host-stage — mutation invalidates any
+    device-embedded snapshot, so none is made."""
+
+    def __init__(self, key_dtype, value_dtype, default_value,
+                 shared_name=None, name="mutable_hash_table"):
+        super().__init__(key_dtype, value_dtype, shared_name or name)
+        self._default_value = default_value
+        self._host_map = {}
+
+    def insert(self, keys, values, name=None):
+        keys = self._check_keys(keys)
+        values = ops_mod.convert_to_tensor(values, dtype=self.value_dtype)
+        g = ops_mod.get_default_graph()
+        return g.create_op("LookupTableInsert", [keys, values],
+                           attrs={"table_name": self._name},
+                           name=name or f"{self._name}_insert",
+                           output_specs=[])
+
+    def lookup(self, keys, name=None):
+        keys = self._check_keys(keys)
+        g = ops_mod.get_default_graph()
+        op = g.create_op("LookupTableFind", [keys],
+                         attrs={"table_name": self._name},
+                         name=name or f"{self._name}_lookup",
+                         output_specs=[(keys.shape, self.value_dtype)])
+        return op.outputs[0]
+
+    def export(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "LookupTableExport", [], attrs={"table_name": self._name},
+            name=name or f"{self._name}_export",
+            output_specs=[(shape_mod.TensorShape([None]), self.key_dtype),
+                          (shape_mod.TensorShape([None]),
+                           self.value_dtype)])
+        return op.outputs[0], op.outputs[1]
+
+    # -- host behavior -------------------------------------------------------
+    def _host_insert(self, keys, values):
+        kf = np.asarray(keys).reshape(-1)
+        vf = np.asarray(values).reshape(-1)
+        if vf.shape[0] != kf.shape[0]:
+            raise errors.InvalidArgumentError(
+                None, None,
+                f"Table {self._name} insert: {kf.shape[0]} keys vs "
+                f"{vf.shape[0]} values")
+        with self._lock:
+            for k, v in zip(kf.tolist(), vf.tolist()):
+                self._host_map[_norm_key(k)] = v
+
+    def _host_find(self, keys):
+        flat = np.asarray(keys).reshape(-1)
+        with self._lock:
+            out = [self._host_map.get(_norm_key(k), self._default_value)
+                   for k in flat.tolist()]
+        if _is_string_dtype(self.value_dtype):
+            res = np.array(out, dtype=object)
+        else:
+            res = np.array(out, dtype=self.value_dtype.np_dtype)
+        return res.reshape(np.asarray(keys).shape)
+
+    def _host_size(self):
+        with self._lock:
+            return np.asarray(len(self._host_map), dtype=np.int64)
+
+    def _host_export(self):
+        with self._lock:
+            ks = list(self._host_map.keys())
+            vs = [self._host_map[k] for k in ks]
+        if _is_string_dtype(self.key_dtype):
+            ka = np.array(ks, dtype=object)
+        else:
+            ka = np.array(ks, dtype=self.key_dtype.np_dtype)
+        if _is_string_dtype(self.value_dtype):
+            va = np.array(vs, dtype=object)
+        else:
+            va = np.array(vs, dtype=self.value_dtype.np_dtype)
+        return ka, va
+
+
+class MutableDenseHashTable(MutableHashTable):
+    """API-parity alias: the reference's open-addressing variant is a CPU
+    memory-layout optimization; the host dict serves the same contract
+    (ref: core/kernels/lookup_table_op.cc MutableDenseHashTable)."""
+
+    def __init__(self, key_dtype, value_dtype, default_value, empty_key=None,
+                 deleted_key=None, shared_name=None,
+                 name="mutable_dense_hash_table", **_kw):
+        super().__init__(key_dtype, value_dtype, default_value,
+                         shared_name=shared_name, name=name)
+
+
+def _is_string_dtype(dt):
+    return dt == dtypes_mod.string
+
+
+def _norm_key(k):
+    if isinstance(k, bytes):
+        return k.decode("utf-8", "replace")
+    return k
+
+
+def _get_table(op) -> LookupInterface:
+    name = op.attrs["table_name"]
+    t = op.graph._scoped_state.get("__lookup_tables__", {}).get(name)
+    if t is None:
+        raise errors.NotFoundError(None, None, f"Table {name} not found")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Lowerings
+# ---------------------------------------------------------------------------
+
+def _lower_init(ctx, op, inputs):
+    _get_table(op)._host_initialize()
+    return []
+
+
+def _lower_find(ctx, op, inputs):
+    return [_get_table(op)._host_find(inputs[0])]
+
+
+def _lower_insert(ctx, op, inputs):
+    _get_table(op)._host_insert(inputs[0], inputs[1])
+    return []
+
+
+def _lower_size(ctx, op, inputs):
+    return [_get_table(op)._host_size()]
+
+
+def _lower_export(ctx, op, inputs):
+    k, v = _get_table(op)._host_export()
+    return [k, v]
+
+
+for _n, _fn, _nout in [("InitializeTable", _lower_init, 0),
+                       ("LookupTableFind", _lower_find, 1),
+                       ("LookupTableInsert", _lower_insert, 0),
+                       ("LookupTableSize", _lower_size, 1),
+                       ("LookupTableExport", _lower_export, None)]:
+    op_registry.register(_n, lower=_fn, is_stateful=True, runs_on_host=True,
+                         n_outputs=_nout)
+
+
+def _lower_find_device(ctx, op, inputs):
+    """Frozen-dense device path: embed the (sorted) vocab as XLA constants,
+    lookup = searchsorted + gather + miss→default select. Static shapes,
+    fuses into the surrounding program; zero host round-trip per step."""
+    import jax.numpy as jnp
+
+    table = _get_table(op)
+    table._require_init()
+    keys_c = jnp.asarray(table._keys_np)
+    vals_c = jnp.asarray(table._values_np)
+    keys_in = inputs[0]
+    idx = jnp.searchsorted(keys_c, keys_in)
+    idx_clamped = jnp.clip(idx, 0, keys_c.shape[0] - 1)
+    hit = keys_c[idx_clamped] == keys_in
+    found = vals_c[idx_clamped]
+    default = jnp.asarray(table._default_value, dtype=found.dtype)
+    return [jnp.where(hit, found, default)]
+
+
+# stateful=True: the result depends on host table state at lowering time,
+# so it must not be constant-folded/CSE'd across re-initialization; but it
+# does NOT run on host — it traces into the XLA program.
+op_registry.register("LookupTableFindDevice", lower=_lower_find_device,
+                     is_stateful=True, n_outputs=1)
+
+
+# ---------------------------------------------------------------------------
+# Convenience constructors (ref: contrib/lookup/lookup_ops.py)
+# ---------------------------------------------------------------------------
+
+class IdTableWithHashBuckets(LookupInterface):
+    """Vocab table + OOV hash buckets (ref: contrib/lookup
+    ``string_to_index_table_from_file`` with num_oov_buckets>0): in-vocab
+    keys map to their file index, OOV keys hash into
+    [vocab_size, vocab_size+num_oov_buckets)."""
+
+    def __init__(self, table, num_oov_buckets, name="id_table_oov"):
+        super().__init__(table.key_dtype, dtypes_mod.int64, name)
+        self._table = table
+        self._oov = num_oov_buckets
+
+    @property
+    def initializer(self):
+        return self._table.initializer
+
+    init = initializer
+
+    def lookup(self, keys, name=None):
+        from . import array_ops
+        from . import math_ops
+        from . import string_ops
+
+        base = self._table.lookup(keys, name=name)
+        if not self._oov:
+            return base
+        hashed = string_ops.string_to_hash_bucket_fast(keys, self._oov)
+        vsize = self._table.size()
+        # combine on device in int32 (TPU's native int width — XLA demotes
+        # int64 anyway), cast back to int64 for TF API parity
+        base32 = math_ops.cast(base, dtypes_mod.int32)
+        oov_ids = (math_ops.cast(hashed, dtypes_mod.int32)
+                   + math_ops.cast(vsize, dtypes_mod.int32))
+        out = array_ops.where(
+            math_ops.greater_equal(base32, 0), base32, oov_ids)
+        return math_ops.cast(out, dtypes_mod.int64)
+
+    def _host_size(self):
+        return self._table._host_size() + np.int64(self._oov)
+
+
+def _check_oov_args(num_oov_buckets, default_value):
+    # ref contract: OOV buckets and an explicit default are mutually
+    # exclusive (with buckets, misses hash into a bucket, never default) —
+    # and the OOV combine uses default -1 as its miss sentinel.
+    if num_oov_buckets and default_value != -1:
+        raise ValueError(
+            "num_oov_buckets and default_value cannot both be specified: "
+            "with OOV buckets every miss maps into a bucket, so "
+            "default_value would never be returned (reference "
+            "lookup_ops contract).")
+
+
+def index_table_from_file(vocabulary_file, num_oov_buckets=0,
+                          vocab_size=None, default_value=-1,
+                          key_dtype=dtypes_mod.string, delimiter="\t",
+                          name="string_to_index"):
+    """string → id table from a one-token-per-line vocab file (ref:
+    contrib/lookup ``index_table_from_file``)."""
+    _check_oov_args(num_oov_buckets, default_value)
+    init = TextFileInitializer(
+        vocabulary_file, key_dtype, TextFileIndex.WHOLE_LINE,
+        dtypes_mod.int64, TextFileIndex.LINE_NUMBER,
+        vocab_size=vocab_size, delimiter=delimiter)
+    table = HashTable(init, default_value, name=name)
+    if num_oov_buckets:
+        return IdTableWithHashBuckets(table, num_oov_buckets,
+                                      name=f"{name}_oov")
+    return table
+
+
+def index_table_from_tensor(mapping, num_oov_buckets=0, default_value=-1,
+                            name="string_to_index"):
+    _check_oov_args(num_oov_buckets, default_value)
+    mapping = np.asarray(mapping)
+    init = KeyValueTensorInitializer(
+        mapping, np.arange(mapping.shape[0], dtype=np.int64))
+    table = HashTable(init, default_value, name=name)
+    if num_oov_buckets:
+        return IdTableWithHashBuckets(table, num_oov_buckets,
+                                      name=f"{name}_oov")
+    return table
+
+
+def index_to_string_table_from_file(vocabulary_file, vocab_size=None,
+                                    default_value="UNK", delimiter="\t",
+                                    name="index_to_string"):
+    """id → string table for decoding (ref: contrib/lookup
+    ``index_to_string_table_from_file``). Host-stage (string values)."""
+    init = TextFileInitializer(
+        vocabulary_file, dtypes_mod.int64, TextFileIndex.LINE_NUMBER,
+        dtypes_mod.string, TextFileIndex.WHOLE_LINE,
+        vocab_size=vocab_size, delimiter=delimiter)
+    return HashTable(init, default_value, name=name)
+
+
+def index_to_string_table_from_tensor(mapping, default_value="UNK",
+                                      name="index_to_string"):
+    mapping = np.asarray(mapping, dtype=object)
+    init = KeyValueTensorInitializer(
+        np.arange(mapping.shape[0], dtype=np.int64), mapping)
+    return HashTable(init, default_value, name=name)
+
+
+def tables_initializer(name="init_all_tables"):
+    """Group of every table initializer in the graph (ref:
+    python/ops/lookup-era ``tf.tables_initializer``)."""
+    from . import control_flow_ops
+
+    g = ops_mod.get_default_graph()
+    inits = g.get_collection(GraphKeys.TABLE_INITIALIZERS)
+    return control_flow_ops.group(*inits, name=name)

@@ -109,11 +109,15 @@ def quant_matmul(x, wq, w_scale, *, out_dtype=None):
         scratch_shapes=[pltpu.VMEM((TILE_M, TILE_N), jnp.int32)],
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * np_ * k,
-            # s8 operands are 1 byte each; the f32 scale vectors are
-            # re-fetched on every k-block visit of each (i,j) tile.
-            bytes_accessed=(mp * k + k * np_ + mp * np_ * 4
-                            + 4 * n_kb * (mp * cdiv(np_, TILE_N)
-                                          + np_ * cdiv(mp, TILE_M))),
+            # Grid (i, j, kk), kk innermost. A block is re-fetched when its
+            # index changes between consecutive iterations: xq (i,kk) cycles
+            # per j → mp*k s8 bytes × n_j; wq (kk,j) changes every step →
+            # k*np_ × n_i; x_scale (i,0) only on i change → mp f32 once;
+            # w_scale (0,j) on j change → np_ f32 × n_i.
+            bytes_accessed=(mp * k * cdiv(np_, TILE_N)
+                            + k * np_ * cdiv(mp, TILE_M)
+                            + mp * 4 + np_ * 4 * cdiv(mp, TILE_M)
+                            + mp * np_ * 4),
             transcendentals=0),
         interpret=use_interpret(),
     )(xq, wq, x_scale.astype(jnp.float32), w_scale.astype(jnp.float32))
