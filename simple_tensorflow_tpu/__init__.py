@@ -136,6 +136,17 @@ from .ops.misc_ops import (
 from .ops.numerics import verify_tensor_all_finite, add_check_numerics_ops
 from .ops import lookup_ops as lookup
 from .ops.lookup_ops import tables_initializer
+from .ops import session_ops
+from .ops.session_ops import (
+    TensorHandle, get_session_handle, get_session_tensor,
+    delete_session_tensor,
+)
+from .ops import data_flow_ops
+from .ops.data_flow_ops import (
+    FIFOQueue, RandomShuffleQueue, PaddingFIFOQueue, PriorityQueue,
+    QueueBase, StagingArea, Barrier, RecordInput, ConditionalAccumulator,
+    SparseConditionalAccumulator, dynamic_partition, dynamic_stitch,
+)
 from .ops import io_ops
 from .ops.io_ops import (
     ReaderBase, WholeFileReader, IdentityReader, TextLineReader,

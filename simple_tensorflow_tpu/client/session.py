@@ -196,7 +196,8 @@ class _CompiledStep:
     __slots__ = ("jitted", "device_fetches", "host_plan", "post_host_plan",
                  "post_host_inputs", "device_ops", "feed_tensors", "boundary",
                  "has_device_stage", "n_calls", "last_lowering_ctx",
-                 "check_msgs", "const_env", "alias", "fetch_nbytes")
+                 "check_msgs", "const_env", "alias", "fetch_nbytes",
+                 "raw_post_inputs")
 
     def __init__(self):
         self.n_calls = 0
@@ -206,6 +207,7 @@ class _CompiledStep:
         self.const_env = {}
         self.alias = {}
         self.fetch_nbytes = []
+        self.raw_post_inputs = set()
 
 
 class BaseSession:
@@ -223,6 +225,31 @@ class BaseSession:
         self._base_key = None  # created lazily (jax import cost)
         self._resources: Dict[str, Any] = {}  # queues, readers, tables
         self._partial_runs: Dict[str, Any] = {}
+        # device-resident tensors pinned by get_session_handle
+        # (ref: python/ops/session_ops.py; TPU-native: values are
+        # jax.Arrays that never round-trip through host numpy)
+        self._handles: Dict[str, Any] = {}
+        self._handle_counter = 0
+
+    # -- session handles -----------------------------------------------------
+    def _register_handle(self, value, dtype):
+        with self._lock:
+            self._handle_counter += 1
+            key = f"stf_handle_{self._handle_counter}:{dtype.name}"
+            self._handles[key] = value
+        return key
+
+    def _handle_value(self, key):
+        try:
+            return self._handles[key]
+        except KeyError:
+            raise errors.InvalidArgumentError(
+                None, None,
+                f"Unknown session handle {key!r} (deleted, or from a "
+                "different Session)")
+
+    def _delete_handle(self, key):
+        self._handles.pop(key, None)
 
     # -- properties ----------------------------------------------------------
     @property
@@ -352,9 +379,16 @@ class BaseSession:
             return feeds
         import jax
 
+        from ..ops.session_ops import TensorHandle
+
         for k, v in feed_dict.items():
             t = self._graph.as_graph_element(k, allow_tensor=True,
                                              allow_operation=False)
+            if isinstance(v, TensorHandle):
+                # feed-by-handle: the holder receives the handle string;
+                # GetSessionTensor resolves it to the pinned device array
+                feeds[t] = np.asarray(v.handle, dtype=object)
+                continue
             if isinstance(v, jax.Array):
                 # Device-resident feed: no host round-trip (input pipelines
                 # stage batches into HBM via data.prefetch_to_device).
@@ -482,7 +516,11 @@ class BaseSession:
             pctx.env.update(host_env)
             pctx.env.update(feeds)
             for t, v in dev_map.items():
-                pctx.env[t] = np.asarray(v) if t.dtype.name != "string" else v
+                if t in step.raw_post_inputs:
+                    pctx.env[t] = v  # stays a jax.Array (session handles)
+                else:
+                    pctx.env[t] = (np.asarray(v)
+                                   if t.dtype.name != "string" else v)
             lowering_mod.execute_ops(pctx, step.post_host_plan,
                                      fed=set(pctx.env))
             host_env = pctx.env
@@ -503,7 +541,21 @@ class BaseSession:
                 v = dev_map[r]
                 out.append(np.asarray(v) if e.dtype.name != "string" else v)
             elif r in host_env:
-                out.append(host_env[r])
+                if r.op.type == "GetSessionHandle":
+                    from ..ops.session_ops import TensorHandle, _handle_str
+
+                    out.append(TensorHandle(
+                        _handle_str(host_env[r]),
+                        r.op.attrs["dtype"], self))
+                else:
+                    v = host_env[r]
+                    # a raw device array can land here when the tensor
+                    # also fed a GetSessionHandle op — fetches always
+                    # return numpy (string tensors pass through)
+                    if (not isinstance(v, np.ndarray)
+                            and e.dtype.name != "string"):
+                        v = np.asarray(v)
+                    out.append(v)
             elif r in step.const_env:  # folded at plan time
                 out.append(step.const_env[r])
             else:  # e.g. string Const fetched directly
@@ -714,6 +766,11 @@ class BaseSession:
                     seen_pn.add(t)
                     post_needs.append(t)
         step.post_host_inputs = post_needs
+        # inputs of GetSessionHandle must stay raw device arrays in the
+        # post-host env (pinning a handle must not force a host transfer)
+        step.raw_post_inputs = {
+            _rsv(t) for op in post_host if op.type == "GetSessionHandle"
+            for t in op.inputs}
 
         # Boundary: host/feed tensors consumed by device ops.
         boundary: List[Tensor] = []

@@ -342,6 +342,535 @@ for _n, _fn, _nout in [("QueueEnqueue", _lower_enqueue, 0),
                          n_outputs=_nout)
 
 
+# -- StagingArea -------------------------------------------------------------
+
+class StagingArea:
+    """Explicit double-buffering primitive (ref: python/ops/data_flow_ops.py
+    :1384 ``StagingArea``, core/kernels/stage_op.cc).
+
+    TPU-native: ``put`` stages components into HBM immediately
+    (``jax.device_put`` inside the host stage — the same arena-staged
+    transfer path ``prefetch_to_device`` uses), so by the time ``get`` feeds
+    the compiled step the batch is already device-resident and rides the
+    Session's zero-copy device-feed path. Unbounded capacity, exactly-once,
+    FIFO order (the reference guarantees no order; FIFO is a superset)."""
+
+    _counter = [0]
+
+    def __init__(self, dtypes, shapes=None, names=None, shared_name=None):
+        if not isinstance(dtypes, (list, tuple)):
+            dtypes = [dtypes]
+        self._dtypes = [dtypes_mod.as_dtype(d) for d in dtypes]
+        if shapes is not None:
+            if len(shapes) != len(self._dtypes):
+                raise ValueError(
+                    "StagingArea shapes must be the same length as dtypes")
+            self._shapes = [shape_mod.as_shape(s) for s in shapes]
+        else:
+            self._shapes = [shape_mod.TensorShape(None)
+                            for _ in self._dtypes]
+        if names is not None:
+            if len(names) != len(self._dtypes):
+                raise ValueError(
+                    "StagingArea names must be the same length as dtypes")
+            self._names = list(names)
+        else:
+            self._names = None
+        StagingArea._counter[0] += 1
+        self._name = shared_name or f"staging_area_{StagingArea._counter[0]}"
+        self._buf = py_queue.Queue()
+        g = ops_mod.get_default_graph()
+        g._scoped_state.setdefault("__staging_areas__",
+                                   {})[self._name] = self
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def dtypes(self):
+        return self._dtypes
+
+    @property
+    def shapes(self):
+        return self._shapes
+
+    @property
+    def names(self):
+        return self._names
+
+    def _check_put_vals(self, vals):
+        if isinstance(vals, dict):
+            if not self._names:
+                raise ValueError(
+                    "Staging areas must have names to enqueue a dictionary")
+            if sorted(self._names) != sorted(vals.keys()):
+                raise ValueError(
+                    f"Keys in dictionary to put do not match names of "
+                    f"staging area. Dictionary: {sorted(vals.keys())}, "
+                    f"StagingArea: {sorted(self._names)}")
+            vals = [vals[k] for k in self._names]
+        else:
+            if self._names:
+                raise ValueError("You must enqueue a dictionary in a "
+                                 "staging area with names")
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+        if len(vals) != len(self._dtypes):
+            raise ValueError(
+                f"Unexpected number of inputs {len(vals)} vs "
+                f"{len(self._dtypes)}")
+        out = []
+        for i, (v, dt, sh) in enumerate(zip(vals, self._dtypes,
+                                            self._shapes)):
+            t = ops_mod.convert_to_tensor(v, dtype=dt)
+            if t.dtype.base_dtype != dt:
+                raise ValueError(
+                    f"Datatypes do not match. {t.dtype} != {dt}")
+            if sh.rank is not None and not sh.is_compatible_with(t.shape):
+                raise ValueError(
+                    f"Shape {t.shape} not compatible with {sh}")
+            out.append(t)
+        return out
+
+    def put(self, values, name=None):
+        vals = self._check_put_vals(values)
+        g = ops_mod.get_default_graph()
+        return g.create_op("Stage", vals,
+                           attrs={"staging_name": self._name},
+                           name=name or f"{self._name}_put",
+                           output_specs=[])
+
+    def _get_return_value(self, tensors):
+        if self._names:
+            return {n: tensors[i] for i, n in enumerate(self._names)}
+        if len(tensors) == 1:
+            return tensors[0]
+        return tensors
+
+    def get(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "Unstage", [], attrs={"staging_name": self._name},
+            name=name or f"{self._name}_get",
+            output_specs=[(s, d) for s, d in zip(self._shapes,
+                                                 self._dtypes)])
+        return self._get_return_value(list(op.outputs))
+
+    def size(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op("StagingSize", [],
+                         attrs={"staging_name": self._name},
+                         name=name or f"{self._name}_size",
+                         output_specs=[(shape_mod.scalar(),
+                                        dtypes_mod.int32)])
+        return op.outputs[0]
+
+    # -- host behavior -------------------------------------------------------
+    def _host_put(self, items):
+        import jax
+
+        staged = []
+        for x in items:
+            a = np.asarray(x)
+            if a.dtype == object:
+                staged.append(a)      # strings stay host-side
+            else:
+                staged.append(jax.device_put(a))  # async H2D: in HBM by get
+        self._buf.put(builtins.tuple(staged))
+
+    def _host_get(self, timeout=30.0):
+        try:
+            return self._buf.get(timeout=timeout)
+        except py_queue.Empty:
+            raise errors.DeadlineExceededError(
+                None, None,
+                f"StagingArea {self._name} get() timed out (empty)")
+
+    def _host_size(self):
+        return self._buf.qsize()
+
+
+def _get_staging(op) -> StagingArea:
+    name = op.attrs["staging_name"]
+    s = op.graph._scoped_state.get("__staging_areas__", {}).get(name)
+    if s is None:
+        raise errors.NotFoundError(None, None,
+                                   f"StagingArea {name} not found")
+    return s
+
+
+def _lower_stage(ctx, op, inputs):
+    _get_staging(op)._host_put(inputs)
+    return []
+
+
+def _lower_unstage(ctx, op, inputs):
+    return list(_get_staging(op)._host_get())
+
+
+def _lower_staging_size(ctx, op, inputs):
+    return [np.asarray(_get_staging(op)._host_size(), np.int32)]
+
+
+for _n, _fn, _nout in [("Stage", _lower_stage, 0),
+                       ("Unstage", _lower_unstage, None),
+                       ("StagingSize", _lower_staging_size, 1)]:
+    op_registry.register(_n, lower=_fn, is_stateful=True, runs_on_host=True,
+                         n_outputs=_nout)
+
+
+# -- Barrier -----------------------------------------------------------------
+
+class Barrier:
+    """Key-value map of partially-filled tuples persisting across steps
+    (ref: python/ops/data_flow_ops.py:805 ``Barrier``, kernels
+    core/kernels/barrier_ops.cc). Host object: complete elements leave via
+    ``take_many`` in first-insertion order; indices count from -2**63."""
+
+    _counter = [0]
+
+    def __init__(self, types, shapes=None, shared_name=None, name="barrier"):
+        if not isinstance(types, (list, tuple)):
+            types = [types]
+        self._types = [dtypes_mod.as_dtype(t) for t in types]
+        if shapes is not None:
+            if not isinstance(shapes, (list, tuple)):
+                shapes = [shapes]
+            self._shapes = [shape_mod.as_shape(s) for s in shapes]
+            for i, s in enumerate(self._shapes):
+                if s.rank is not None and s.num_elements() == 0:
+                    raise ValueError(
+                        f"Empty tensors are not supported, but received "
+                        f"shape {s} at index {i}")
+        else:
+            self._shapes = [shape_mod.TensorShape(None)
+                            for _ in self._types]
+        Barrier._counter[0] += 1
+        self._name = shared_name or f"{name}_{Barrier._counter[0]}"
+        self._lock = threading.Lock()
+        self._elems = {}          # key -> [components or None]
+        self._first_index = {}    # key -> insertion index of first insert
+        self._next_index = 0
+        self._closed = False
+        self._cancel_pending = False
+        g = ops_mod.get_default_graph()
+        g._scoped_state.setdefault("__barriers__", {})[self._name] = self
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def barrier_ref(self):
+        return self._name
+
+    def insert_many(self, component_index, keys, values, name=None):
+        keys = ops_mod.convert_to_tensor(keys, dtype=dtypes_mod.string)
+        values = ops_mod.convert_to_tensor(
+            values, dtype=self._types[component_index])
+        g = ops_mod.get_default_graph()
+        return g.create_op(
+            "BarrierInsertMany", [keys, values],
+            attrs={"barrier_name": self._name,
+                   "component_index": int(component_index)},
+            name=name or f"{self._name}_BarrierInsertMany",
+            output_specs=[])
+
+    def take_many(self, num_elements, allow_small_batch=False, timeout=None,
+                  name=None):
+        g = ops_mod.get_default_graph()
+        batch = None if allow_small_batch else int(num_elements)
+        specs = ([(shape_mod.TensorShape([batch]), dtypes_mod.int64),
+                  (shape_mod.TensorShape([batch]), dtypes_mod.string)]
+                 + [(shape_mod.TensorShape([batch]).concatenate(s), t)
+                    for s, t in zip(self._shapes, self._types)])
+        op = g.create_op(
+            "BarrierTakeMany", [],
+            attrs={"barrier_name": self._name,
+                   "num_elements": int(num_elements),
+                   "allow_small_batch": bool(allow_small_batch),
+                   "timeout_ms": timeout},
+            name=name or f"{self._name}_BarrierTakeMany",
+            output_specs=specs)
+        outs = list(op.outputs)
+        return outs[0], outs[1], outs[2:]
+
+    def close(self, cancel_pending_enqueues=False, name=None):
+        g = ops_mod.get_default_graph()
+        return g.create_op(
+            "BarrierClose", [],
+            attrs={"barrier_name": self._name,
+                   "cancel_pending_enqueues": bool(cancel_pending_enqueues)},
+            name=name or f"{self._name}_BarrierClose", output_specs=[])
+
+    def ready_size(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op("BarrierReadySize", [],
+                         attrs={"barrier_name": self._name},
+                         name=name or f"{self._name}_BarrierReadySize",
+                         output_specs=[(shape_mod.scalar(),
+                                        dtypes_mod.int32)])
+        return op.outputs[0]
+
+    def incomplete_size(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op("BarrierIncompleteSize", [],
+                         attrs={"barrier_name": self._name},
+                         name=name or f"{self._name}_BarrierIncompleteSize",
+                         output_specs=[(shape_mod.scalar(),
+                                        dtypes_mod.int32)])
+        return op.outputs[0]
+
+    # -- host behavior -------------------------------------------------------
+    def _is_complete(self, key):
+        return all(c is not None for c in self._elems[key])
+
+    def _host_insert(self, component_index, keys, values):
+        keys = np.asarray(keys).reshape(-1)
+        values = np.asarray(values)
+        if values.shape[:1] != keys.shape:
+            raise errors.InvalidArgumentError(
+                None, None,
+                f"Barrier {self._name}: {keys.shape[0]} keys vs values "
+                f"with leading dim {values.shape[:1]}")
+        with self._lock:
+            for i, k in enumerate(keys.tolist()):
+                k = k.decode() if isinstance(k, bytes) else builtins.str(k)
+                if k not in self._elems:
+                    if self._closed:
+                        raise errors.CancelledError(
+                            None, None,
+                            f"Barrier {self._name} is closed; cannot insert "
+                            f"new key {k!r}")
+                    self._elems[k] = [None] * len(self._types)
+                    self._first_index[k] = self._next_index
+                    self._next_index += 1
+                elif self._cancel_pending:
+                    raise errors.CancelledError(
+                        None, None,
+                        f"Barrier {self._name} closed with "
+                        "cancel_pending_enqueues; completions cancelled")
+                if self._elems[k][component_index] is not None:
+                    raise errors.InvalidArgumentError(
+                        None, None,
+                        f"Barrier {self._name}: component {component_index} "
+                        f"of key {k!r} already set")
+                self._elems[k][component_index] = values[i]
+
+    def _host_take(self, num_elements, allow_small_batch, timeout_ms):
+        import time as _time
+
+        deadline = _time.time() + ((timeout_ms / 1000.0)
+                                   if timeout_ms else 30.0)
+        while True:
+            with self._lock:
+                ready = sorted(
+                    (k for k in self._elems if self._is_complete(k)),
+                    key=lambda k: self._first_index[k])
+                enough = len(ready) >= num_elements
+                if enough or (self._closed and allow_small_batch and ready):
+                    take = ready[:num_elements]
+                    rows = [self._elems.pop(k) for k in take]
+                    idxs = [self._first_index.pop(k) - 2**63 for k in take]
+                    keys = np.array(take, dtype=object)
+                    comps = [np.stack([np.asarray(r[c]) for r in rows])
+                             if rows else
+                             np.zeros((0,), self._types[c].np_dtype)
+                             for c in builtins.range(len(self._types))]
+                    return [np.array(idxs, np.int64), keys] + comps
+                if self._closed and not enough and (
+                        not allow_small_batch or not ready):
+                    # closed + insufficient (or closed + empty even with
+                    # allow_small_batch): immediate epoch-end signal, the
+                    # same OutOfRange input-pipeline loops catch (ref
+                    # barrier_ops.cc TryTakeMany close semantics)
+                    raise errors.OutOfRangeError(
+                        None, None,
+                        f"Barrier {self._name} is closed and has "
+                        f"insufficient elements "
+                        f"(requested {num_elements}, total size "
+                        f"{len(ready)})")
+            if _time.time() > deadline:
+                raise errors.DeadlineExceededError(
+                    None, None, f"Barrier {self._name} take_many timed out")
+            _time.sleep(0.01)
+
+    def _host_close(self, cancel_pending):
+        with self._lock:
+            self._closed = True
+            self._cancel_pending = cancel_pending
+            if cancel_pending:
+                incomplete = [k for k in self._elems
+                              if not self._is_complete(k)]
+                for k in incomplete:
+                    del self._elems[k]
+                    del self._first_index[k]
+
+    def _host_ready_size(self):
+        with self._lock:
+            return builtins.sum(1 for k in self._elems
+                                if self._is_complete(k))
+
+    def _host_incomplete_size(self):
+        with self._lock:
+            return builtins.sum(1 for k in self._elems
+                                if not self._is_complete(k))
+
+
+def _get_barrier(op) -> Barrier:
+    name = op.attrs["barrier_name"]
+    b = op.graph._scoped_state.get("__barriers__", {}).get(name)
+    if b is None:
+        raise errors.NotFoundError(None, None, f"Barrier {name} not found")
+    return b
+
+
+op_registry.register(
+    "BarrierInsertMany",
+    lower=lambda ctx, op, inputs: _get_barrier(op)._host_insert(
+        op.attrs["component_index"], inputs[0], inputs[1]) or [],
+    is_stateful=True, runs_on_host=True, n_outputs=0)
+op_registry.register(
+    "BarrierTakeMany",
+    lower=lambda ctx, op, inputs: _get_barrier(op)._host_take(
+        op.attrs["num_elements"], op.attrs["allow_small_batch"],
+        op.attrs["timeout_ms"]),
+    is_stateful=True, runs_on_host=True, n_outputs=None)
+op_registry.register(
+    "BarrierClose",
+    lower=lambda ctx, op, inputs: _get_barrier(op)._host_close(
+        op.attrs["cancel_pending_enqueues"]) or [],
+    is_stateful=True, runs_on_host=True, n_outputs=0)
+op_registry.register(
+    "BarrierReadySize",
+    lower=lambda ctx, op, inputs: [
+        np.asarray(_get_barrier(op)._host_ready_size(), np.int32)],
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register(
+    "BarrierIncompleteSize",
+    lower=lambda ctx, op, inputs: [
+        np.asarray(_get_barrier(op)._host_incomplete_size(), np.int32)],
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+
+
+# -- RecordInput -------------------------------------------------------------
+
+class RecordInput:
+    """Asynchronously reads and randomly yields TFRecords (ref:
+    python/ops/data_flow_ops.py:1633, core/kernels/record_yielder.cc).
+
+    Host object: reader thread(s) fill a shuffle buffer from the matched
+    files (order shifted by ``shift_ratio`` each epoch); ``get_yield_op``
+    is a host op yielding ``batch_size`` records per execution. Yields
+    start once buffer_size/2 records are buffered (or the epoch ends)."""
+
+    _counter = [0]
+
+    def __init__(self, file_pattern, batch_size=1, buffer_size=1,
+                 parallelism=1, shift_ratio=0, seed=0, name=None):
+        import glob as _glob
+
+        RecordInput._counter[0] += 1
+        self._files = sorted(_glob.glob(file_pattern))
+        if not self._files:
+            raise ValueError(f"No files match pattern {file_pattern!r}")
+        self._batch_size = int(batch_size)
+        self._buffer_size = builtins.max(int(buffer_size), batch_size)
+        self._shift_ratio = float(shift_ratio)
+        self._rng = np.random.RandomState(seed or None)
+        self._name = name or f"record_input_{RecordInput._counter[0]}"
+        self._buf = []
+        self._lock = threading.Lock()
+        self._have = threading.Condition(self._lock)
+        self._epoch = 0
+        self._started = False
+        g = ops_mod.get_default_graph()
+        g._scoped_state.setdefault("__record_inputs__",
+                                   {})[self._name] = self
+
+    def get_yield_op(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "RecordInputYield", [], attrs={"record_input_name": self._name},
+            name=name or self._name,
+            output_specs=[(shape_mod.TensorShape([self._batch_size]),
+                           dtypes_mod.string)])
+        return op.outputs[0]
+
+    # -- host behavior -------------------------------------------------------
+    def _reader_loop(self):
+        from ..lib.io import tf_record
+
+        while True:
+            shift = int(len(self._files) * self._shift_ratio *
+                        self._epoch) % len(self._files)
+            files = self._files[shift:] + self._files[:shift]
+            n_records = 0
+            for f in files:
+                for rec in tf_record.tf_record_iterator(f):
+                    n_records += 1
+                    with self._have:
+                        while len(self._buf) >= self._buffer_size:
+                            self._have.wait(0.05)
+                        self._buf.append(rec)
+                        self._have.notify_all()
+            self._epoch += 1
+            with self._have:
+                self._epoch_done = True
+                if n_records == 0:
+                    # matched files hold zero records: yielding can never
+                    # succeed — signal instead of spinning forever
+                    self._empty_epoch = True
+                self._have.notify_all()
+
+    def _host_yield(self, timeout=30.0):
+        import time as _time
+
+        if not self._started:
+            self._started = True
+            self._epoch_done = False
+            self._empty_epoch = False
+            t = threading.Thread(target=self._reader_loop, daemon=True)
+            t.start()
+        out = []
+        deadline = _time.time() + timeout
+        with self._have:
+            # randomization warmup: half-full buffer before first yield
+            while (len(self._buf) < self._buffer_size // 2
+                   and not self._epoch_done):
+                self._have.wait(0.05)
+            while len(out) < self._batch_size:
+                while not self._buf:
+                    if self._empty_epoch:
+                        raise errors.OutOfRangeError(
+                            None, None,
+                            f"RecordInput {self._name}: matched files "
+                            "contain no records")
+                    if _time.time() > deadline:
+                        raise errors.DeadlineExceededError(
+                            None, None,
+                            f"RecordInput {self._name} yield timed out")
+                    self._have.wait(0.05)
+                i = self._rng.randint(len(self._buf))
+                out.append(self._buf.pop(i))
+                self._have.notify_all()
+        return np.array(out, dtype=object)
+
+
+def _lower_record_yield(ctx, op, inputs):
+    name = op.attrs["record_input_name"]
+    r = op.graph._scoped_state.get("__record_inputs__", {}).get(name)
+    if r is None:
+        raise errors.NotFoundError(None, None,
+                                   f"RecordInput {name} not found")
+    return [r._host_yield()]
+
+
+op_registry.register("RecordInputYield", lower=_lower_record_yield,
+                     is_stateful=True, runs_on_host=True, n_outputs=1)
+
+
 class ConditionalAccumulator:
     """(ref: core/kernels/conditional_accumulator.h). Host-side gradient
     accumulator used by SyncReplicas — on TPU the mesh all-reduce replaces
@@ -373,3 +902,216 @@ class ConditionalAccumulator:
 
     def num_accumulated(self, name=None):
         return self._count
+
+
+class SparseConditionalAccumulator:
+    """Accumulates sparse (IndexedSlices) gradients (ref:
+    python/ops/data_flow_ops.py:1230, kernel
+    core/kernels/sparse_conditional_accumulator.h).
+
+    Host object with graph-op endpoints: ``apply_grad`` is dropped when
+    stale (local_step < the accumulator's time step, ref semantics);
+    ``take_grad`` blocks until num_required fresh gradients arrived, then
+    returns the per-count average as (indices, values, shape), resets, and
+    advances the time step. On TPU the mesh all-reduce is the fast path for
+    dense grads; this serves embedding-style sparse updates."""
+
+    _counter = [0]
+
+    def __init__(self, dtype, shape=None, shared_name=None,
+                 name="sparse_conditional_accumulator"):
+        SparseConditionalAccumulator._counter[0] += 1
+        self._dtype = dtypes_mod.as_dtype(dtype)
+        self._shape = (shape_mod.as_shape(shape)
+                       if shape is not None else None)
+        self._name = (shared_name
+                      or f"{name}_{SparseConditionalAccumulator._counter[0]}")
+        self._lock = threading.Lock()
+        self._sums = {}       # row index -> accumulated value row(s)
+        self._counts = {}     # row index -> number of contributions
+        self._ngrads = 0
+        self._seen_shape = None   # dense_shape from applied gradients
+        self._global_step = 0
+        g = ops_mod.get_default_graph()
+        g._scoped_state.setdefault("__sparse_accumulators__",
+                                   {})[self._name] = self
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def accumulator_ref(self):
+        return self._name
+
+    # -- graph endpoints -----------------------------------------------------
+    def apply_grad(self, grad_indices, grad_values, grad_shape=None,
+                   local_step=0, name=None):
+        g = ops_mod.get_default_graph()
+        idx = ops_mod.convert_to_tensor(grad_indices)
+        vals = ops_mod.convert_to_tensor(grad_values, dtype=self._dtype)
+        step = ops_mod.convert_to_tensor(local_step)
+        inputs = [idx, vals, step]
+        attrs = {"accumulator_name": self._name,
+                 "has_known_shape": grad_shape is not None}
+        if grad_shape is not None:
+            inputs.append(ops_mod.convert_to_tensor(grad_shape))
+        return g.create_op("SparseAccumulatorApplyGradient", inputs,
+                           attrs=attrs,
+                           name=name or f"{self._name}_apply_grad",
+                           output_specs=[])
+
+    def apply_indexed_slices_grad(self, grad, local_step=0, name=None):
+        return self.apply_grad(grad.indices, grad.values, grad.dense_shape,
+                               local_step=local_step, name=name)
+
+    def take_grad(self, num_required, name=None):
+        if num_required < 1:
+            raise errors.InvalidArgumentError(
+                None, None, f"num_required must be >= 1, got {num_required}")
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "SparseAccumulatorTakeGradient", [],
+            attrs={"accumulator_name": self._name,
+                   "num_required": int(num_required)},
+            name=name or f"{self._name}_take_grad",
+            output_specs=[(shape_mod.TensorShape([None]), dtypes_mod.int64),
+                          (shape_mod.TensorShape(None), self._dtype),
+                          (shape_mod.TensorShape([None]),
+                           dtypes_mod.int64)])
+        return op.outputs[0], op.outputs[1], op.outputs[2]
+
+    def take_indexed_slices_grad(self, num_required, name=None):
+        from ..framework.indexed_slices import IndexedSlices as _IS
+
+        i, v, s = self.take_grad(num_required, name=name)
+        return _IS(values=v, indices=i, dense_shape=s)
+
+    def num_accumulated(self, name=None):
+        g = ops_mod.get_default_graph()
+        op = g.create_op("SparseAccumulatorNumAccumulated", [],
+                         attrs={"accumulator_name": self._name},
+                         name=name or f"{self._name}_num_accumulated",
+                         output_specs=[(shape_mod.scalar(),
+                                        dtypes_mod.int32)])
+        return op.outputs[0]
+
+    def set_global_step(self, new_global_step, name=None):
+        g = ops_mod.get_default_graph()
+        step = ops_mod.convert_to_tensor(new_global_step)
+        return g.create_op("SparseAccumulatorSetGlobalStep", [step],
+                           attrs={"accumulator_name": self._name},
+                           name=name or f"{self._name}_set_global_step",
+                           output_specs=[])
+
+    # -- host behavior -------------------------------------------------------
+    def _host_apply(self, indices, values, local_step, shape):
+        indices = np.asarray(indices).reshape(-1)
+        values = np.asarray(values)
+        if values.shape[0] != indices.shape[0]:
+            raise errors.InvalidArgumentError(
+                None, None,
+                f"Accumulator {self._name}: {indices.shape[0]} indices vs "
+                f"{values.shape[0]} value rows")
+        if (self._shape is not None and self._shape.rank is not None
+                and shape is not None):
+            want = self._shape.as_list()
+            got = list(np.asarray(shape).reshape(-1))
+            for w, g_ in zip(want, got):
+                if w is not None and w != g_:
+                    raise errors.InvalidArgumentError(
+                        None, None,
+                        f"Accumulator {self._name}: gradient shape {got} "
+                        f"incompatible with accumulator shape {want}")
+        with self._lock:
+            if int(local_step) < self._global_step:
+                return  # stale gradient: silently dropped (ref contract)
+            for i, row in zip(indices.tolist(), values):
+                if i in self._sums:
+                    self._sums[i] = self._sums[i] + row
+                    self._counts[i] += 1
+                else:
+                    self._sums[i] = np.array(row)
+                    self._counts[i] = 1
+            if shape is not None:
+                self._seen_shape = np.asarray(shape,
+                                              np.int64).reshape(-1)
+            self._ngrads += 1
+
+    def _host_take(self, num_required, timeout=30.0):
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while True:
+            with self._lock:
+                if self._ngrads >= num_required:
+                    idx = sorted(self._sums)
+                    # ref semantics (kernel DivideAccumGradByCounter):
+                    # each slice averages over the number of gradients
+                    # that CONTAINED that index, not the total taken
+                    vals = np.stack(
+                        [self._sums[i] / self._counts[i] for i in idx]) \
+                        if idx else np.zeros((0,), self._dtype.np_dtype)
+                    if self._seen_shape is not None:
+                        shape = self._seen_shape
+                    elif (self._shape is not None
+                          and self._shape.is_fully_defined()):
+                        shape = np.asarray(self._shape.as_list(), np.int64)
+                    else:
+                        shape = np.zeros((0,), np.int64)
+                    self._sums, self._counts = {}, {}
+                    self._ngrads = 0
+                    self._global_step += 1
+                    return [np.asarray(idx, np.int64),
+                            vals.astype(self._dtype.np_dtype), shape]
+            if _time.time() > deadline:
+                raise errors.DeadlineExceededError(
+                    None, None,
+                    f"Accumulator {self._name} take_grad timed out")
+            _time.sleep(0.01)
+
+    def _host_num(self):
+        with self._lock:
+            return np.asarray(self._ngrads, np.int32)
+
+    def _host_set_step(self, step):
+        with self._lock:
+            self._global_step = int(step)
+
+
+def _get_sparse_acc(op) -> SparseConditionalAccumulator:
+    name = op.attrs["accumulator_name"]
+    a = op.graph._scoped_state.get("__sparse_accumulators__", {}).get(name)
+    if a is None:
+        raise errors.NotFoundError(None, None,
+                                   f"Accumulator {name} not found")
+    return a
+
+
+def _lower_sparse_apply(ctx, op, inputs):
+    shape = inputs[3] if op.attrs["has_known_shape"] else None
+    _get_sparse_acc(op)._host_apply(inputs[0], inputs[1], inputs[2], shape)
+    return []
+
+
+op_registry.register("SparseAccumulatorApplyGradient",
+                     lower=_lower_sparse_apply,
+                     is_stateful=True, runs_on_host=True, n_outputs=0)
+op_registry.register(
+    "SparseAccumulatorTakeGradient",
+    lower=lambda ctx, op, inputs: _get_sparse_acc(op)._host_take(
+        op.attrs["num_required"]),
+    is_stateful=True, runs_on_host=True, n_outputs=None)
+op_registry.register(
+    "SparseAccumulatorNumAccumulated",
+    lower=lambda ctx, op, inputs: [_get_sparse_acc(op)._host_num()],
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register(
+    "SparseAccumulatorSetGlobalStep",
+    lower=lambda ctx, op, inputs: _get_sparse_acc(op)._host_set_step(
+        inputs[0]) or [],
+    is_stateful=True, runs_on_host=True, n_outputs=0)

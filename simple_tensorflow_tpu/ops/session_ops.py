@@ -1,0 +1,157 @@
+"""Session handles: keep fetched tensors DEVICE-resident across
+``Session.run`` calls (ref: python/ops/session_ops.py:58
+``get_session_handle``, :155 ``get_session_tensor``,
+core/kernels/session_ops.cc).
+
+On TPU this matters more than on the reference's hardware: HBM is
+~819 GB/s while the host link is PCIe-class, so a fetch→feed round trip
+through host numpy costs two slow transfers. A handle pins the jax.Array
+in the Session's handle store; feeding it back routes through the
+device-resident feed path (zero host copies — provable with the L0
+transfer guard in "disallow" mode).
+
+Staging: ``GetSessionHandle`` of a device tensor runs in the post-host
+stage and receives the RAW device array (the Session skips numpy
+conversion for its inputs); ``GetSessionTensor`` runs pre-host, resolves
+the handle string, and its output crosses the boundary as an
+already-on-device feed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import errors
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+
+
+class TensorHandle:
+    """Handle to a device-resident tensor (ref: session_ops.py:35
+    ``class TensorHandle``)."""
+
+    def __init__(self, handle_str, dtype, session):
+        self._handle = handle_str
+        self._dtype = dtype
+        self._session = session
+
+    @property
+    def handle(self):
+        return self._handle
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __str__(self):
+        return self._handle
+
+    def __repr__(self):
+        return f"<TensorHandle {self._handle}>"
+
+    def eval(self):
+        """Fetch the handle's value to host numpy (explicitly — this is
+        the one deliberate host transfer)."""
+        return np.asarray(self._session._handle_value(self._handle))
+
+    def delete(self):
+        self._session._delete_handle(self._handle)
+
+
+def get_session_handle(data, name=None):
+    """Return a tensor that, when fetched, pins ``data`` in the session's
+    device-resident handle store and evaluates to a TensorHandle (ref:
+    session_ops.py:58)."""
+    data = ops_mod.convert_to_tensor(data)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("GetSessionHandle", [data],
+                     attrs={"dtype": data.dtype},
+                     name=name or "GetSessionHandle",
+                     output_specs=[(shape_mod.scalar(),
+                                    dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def get_session_tensor(handle, dtype, name=None):
+    """(holder, tensor) pair: feed a handle string into ``holder`` and
+    ``tensor`` evaluates to the stored device array — without a host
+    round trip (ref: session_ops.py:155)."""
+    from . import array_ops
+
+    dt = dtypes_mod.as_dtype(dtype)
+    holder = array_ops.placeholder(dtypes_mod.string, shape=(),
+                                   name=(name or "session_tensor")
+                                   + "_holder")
+    g = ops_mod.get_default_graph()
+    op = g.create_op("GetSessionTensor", [holder], attrs={"dtype": dt},
+                     name=name or "GetSessionTensor",
+                     output_specs=[(shape_mod.TensorShape(None), dt)])
+    return holder, op.outputs[0]
+
+
+def delete_session_tensor(handle=None, name=None):
+    """(holder, deleter) pair: feed a handle string into ``holder`` and
+    run ``deleter`` to free the stored array (ref: session_ops.py:237 —
+    its ``handle`` argument only selects a device; accepted and unused
+    here, the session owns all handles)."""
+    from . import array_ops
+
+    holder = array_ops.placeholder(dtypes_mod.string, shape=(),
+                                   name=(name or "delete_session_tensor")
+                                   + "_holder")
+    g = ops_mod.get_default_graph()
+    deleter = g.create_op("DeleteSessionTensor", [holder], attrs={},
+                          name=name or "DeleteSessionTensor",
+                          output_specs=[])
+    return holder, deleter
+
+
+def _session_of(ctx):
+    sess = getattr(ctx, "session", None)
+    if sess is None:
+        raise errors.InternalError(
+            None, None, "session handle ops require a Session context")
+    return sess
+
+
+def _lower_get_handle(ctx, op, inputs):
+    sess = _session_of(ctx)
+    val = inputs[0]
+    if isinstance(val, np.ndarray) and val.dtype != object:
+        # value arrived on the host (const-folded / pre-host source):
+        # pin it in HBM anyway so every numeric handle is device-resident
+        import jax
+
+        val = jax.device_put(val)
+    handle = sess._register_handle(val, op.attrs["dtype"])
+    return [np.asarray(handle, dtype=object)]
+
+
+def _lower_get_tensor(ctx, op, inputs):
+    sess = _session_of(ctx)
+    return [sess._handle_value(_handle_str(inputs[0]))]
+
+
+def _lower_delete(ctx, op, inputs):
+    _session_of(ctx)._delete_handle(_handle_str(inputs[0]))
+    return []
+
+
+def _handle_str(x):
+    if isinstance(x, TensorHandle):
+        return x.handle
+    if isinstance(x, np.ndarray):
+        x = x.item() if x.ndim == 0 else x.reshape(-1)[0]
+    if isinstance(x, bytes):
+        return x.decode()
+    return str(x)
+
+
+op_registry.register("GetSessionHandle", lower=_lower_get_handle,
+                     is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register("GetSessionTensor", lower=_lower_get_tensor,
+                     is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register("DeleteSessionTensor", lower=_lower_delete,
+                     is_stateful=True, runs_on_host=True, n_outputs=0)

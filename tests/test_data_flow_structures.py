@@ -1,0 +1,296 @@
+"""StagingArea / Barrier / SparseConditionalAccumulator / RecordInput
+(ref: python/ops/data_flow_ops.py:1384, :805, :1230, :1633). API-parity
+tests mirroring the reference's documented semantics."""
+
+import os
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+class TestStagingArea:
+    def test_put_get_fifo_exactly_once(self):
+        stf.reset_default_graph()
+        area = stf.StagingArea([stf.float32, stf.int32],
+                               shapes=[(2,), ()])
+        x = stf.placeholder(stf.float32, [2])
+        n = stf.placeholder(stf.int32, [])
+        put = area.put([x, n])
+        got = area.get()
+        out = got[0] * stf.cast(got[1], stf.float32)
+        with stf.Session() as sess:
+            sess.run(put, {x: np.array([1., 2.], np.float32), n: 10})
+            sess.run(put, {x: np.array([3., 4.], np.float32), n: 100})
+            np.testing.assert_allclose(sess.run(out), [10., 20.])
+            np.testing.assert_allclose(sess.run(out), [300., 400.])
+            assert sess.run(area.size()) == 0
+
+    def test_dict_mode_names(self):
+        stf.reset_default_graph()
+        area = stf.StagingArea([stf.float32, stf.float32],
+                               names=["a", "b"])
+        put = area.put({"a": stf.constant(1.0), "b": stf.constant(2.0)})
+        got = area.get()
+        assert sorted(got.keys()) == ["a", "b"]
+        with stf.Session() as sess:
+            sess.run(put)
+            vals = sess.run(got)
+        assert vals["a"] == 1.0 and vals["b"] == 2.0
+
+    def test_put_validation(self):
+        stf.reset_default_graph()
+        area = stf.StagingArea([stf.float32], shapes=[(2,)])
+        with pytest.raises(ValueError, match="number of inputs"):
+            area.put([stf.constant(1.0), stf.constant(2.0)])
+        with pytest.raises(ValueError, match="[Ss]hape"):
+            area.put([stf.constant(np.zeros((3,), np.float32))])
+        with pytest.raises(ValueError, match="dictionary"):
+            area.put({"a": stf.constant(1.0)})
+
+    def test_get_stages_to_device(self):
+        # the staged component should already be a device array when the
+        # step consumes it (jax.Array staged at put time)
+        stf.reset_default_graph()
+        area = stf.StagingArea([stf.float32], shapes=[(4,)])
+        put = area.put([stf.constant(np.arange(4, dtype=np.float32))])
+        with stf.Session() as sess:
+            sess.run(put)
+        staged = area._buf.queue[0][0]
+        assert hasattr(staged, "sharding")  # jax.Array, not numpy
+
+
+class TestBarrier:
+    def test_reference_docstring_scenario(self):
+        # the exact insert/take sequence documented at ref
+        # data_flow_ops.py:820-850
+        stf.reset_default_graph()
+        b = stf.Barrier((stf.string, stf.int32), shapes=((), ()))
+        k = stf.placeholder(stf.string, [None])
+        vs = stf.placeholder(stf.string, [None])
+        vi = stf.placeholder(stf.int32, [None])
+        ins0 = b.insert_many(0, k, vs)
+        ins1 = b.insert_many(1, k, vi)
+        idx_t, keys_t, (val0_t, val1_t) = b.take_many(2)
+        with stf.Session() as sess:
+            o = np.array
+            sess.run(ins0, {k: o(["k1", "k2"], object),
+                            vs: o(["a", "b"], object)})
+            sess.run(ins1, {k: o(["k1"], object), vi: o([1], np.int32)})
+            sess.run(ins0, {k: o(["k3"], object), vs: o(["c"], object)})
+            sess.run(ins1, {k: o(["k3"], object), vi: o([3], np.int32)})
+            sess.run(ins1, {k: o(["k2"], object), vi: o([2], np.int32)})
+            assert sess.run(b.ready_size()) == 3
+            iv, kv, v0, v1 = sess.run([idx_t, keys_t, val0_t, val1_t])
+        # k1,k2 first-inserted together (indices -2**63, -2**63+1); k3
+        # completed earlier but was first-inserted later -> stays behind
+        assert sorted(kv.tolist()) == ["k1", "k2"]
+        assert set(iv.tolist()) == {-2**63, -2**63 + 1}
+        got = dict(zip(kv.tolist(), zip(v0.tolist(), v1.tolist())))
+        assert got["k1"] == ("a", 1) and got["k2"] == ("b", 2)
+
+    def test_double_insert_same_component_raises(self):
+        stf.reset_default_graph()
+        b = stf.Barrier((stf.int32,), shapes=((),))
+        k = stf.constant(np.array(["x"], object))
+        v = stf.constant(np.array([1], np.int32))
+        ins = b.insert_many(0, k, v)
+        with stf.Session() as sess:
+            sess.run(ins)
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="already set"):
+                sess.run(b.insert_many(0, k, v))
+
+    def test_close_semantics(self):
+        stf.reset_default_graph()
+        b = stf.Barrier((stf.string, stf.int32), shapes=((), ()))
+        o = np.array
+        ins0 = b.insert_many(0, stf.constant(o(["k1"], object)),
+                             stf.constant(o(["a"], object)))
+        close = b.close()
+        # completing an existing key after close is allowed (ref contract)
+        ins1 = b.insert_many(1, stf.constant(o(["k1"], object)),
+                             stf.constant(o([5], np.int32)))
+        # a new key after close fails
+        new_key = b.insert_many(0, stf.constant(o(["k2"], object)),
+                                stf.constant(o(["b"], object)))
+        idx_t, keys_t, vals = b.take_many(1)
+        with stf.Session() as sess:
+            sess.run(ins0)
+            sess.run(close)
+            sess.run(ins1)
+            with pytest.raises(stf.errors.CancelledError, match="closed"):
+                sess.run(new_key)
+            _, kv, v0, v1 = sess.run([idx_t, keys_t, vals[0], vals[1]])
+            assert kv.tolist() == ["k1"] and v1.tolist() == [5]
+            assert sess.run(b.incomplete_size()) == 0
+            # closed + insufficient elements -> OutOfRange (ref contract)
+            i2, k2, _ = b.take_many(1)
+            with pytest.raises(stf.errors.OutOfRangeError):
+                sess.run(k2)
+
+    def test_allow_small_batch_after_close(self):
+        stf.reset_default_graph()
+        b = stf.Barrier((stf.int32,), shapes=((),))
+        o = np.array
+        ins = b.insert_many(0, stf.constant(o(["a", "b"], object)),
+                            stf.constant(o([1, 2], np.int32)))
+        idx_t, keys_t, (v_t,) = b.take_many(5, allow_small_batch=True)
+        with stf.Session() as sess:
+            sess.run(ins)
+            sess.run(b.close())
+            _, kv, vv = sess.run([idx_t, keys_t, v_t])
+        assert sorted(kv.tolist()) == ["a", "b"]
+        assert sorted(vv.tolist()) == [1, 2]
+
+
+class TestSparseConditionalAccumulator:
+    def test_accumulate_average_and_reset(self):
+        stf.reset_default_graph()
+        acc = stf.SparseConditionalAccumulator(stf.float32, shape=(4, 2))
+        apply1 = acc.apply_grad(
+            stf.constant(np.array([0, 2], np.int64)),
+            stf.constant(np.array([[1., 1.], [2., 2.]], np.float32)),
+            grad_shape=stf.constant(np.array([4, 2], np.int64)))
+        apply2 = acc.apply_grad(
+            stf.constant(np.array([2, 3], np.int64)),
+            stf.constant(np.array([[4., 4.], [6., 6.]], np.float32)),
+            grad_shape=stf.constant(np.array([4, 2], np.int64)))
+        i_t, v_t, s_t = acc.take_grad(2)
+        n_t = acc.num_accumulated()
+        with stf.Session() as sess:
+            sess.run(apply1)
+            sess.run(apply2)
+            assert sess.run(n_t) == 2
+            iv, vv, sv = sess.run([i_t, v_t, s_t])
+            assert sess.run(n_t) == 0  # reset after take
+        np.testing.assert_array_equal(iv, [0, 2, 3])
+        # per-row averaging (ref DivideAccumGradByCounter): row0 appears
+        # in 1 gradient -> 1/1; row2 in 2 -> (2+4)/2; row3 in 1 -> 6/1
+        np.testing.assert_allclose(vv, [[1., 1.], [3., 3.], [6., 6.]])
+        np.testing.assert_array_equal(sv, [4, 2])
+
+    def test_per_row_averaging(self):
+        # rows present in only SOME gradients average over the count of
+        # gradients containing that row (ref DivideAccumGradByCounter),
+        # not the total number taken
+        stf.reset_default_graph()
+        acc = stf.SparseConditionalAccumulator(stf.float32)
+        a1 = acc.apply_grad(stf.constant(np.array([0], np.int64)),
+                            stf.constant(np.array([[6.]], np.float32)))
+        a2 = acc.apply_grad(stf.constant(np.array([1], np.int64)),
+                            stf.constant(np.array([[8.]], np.float32)))
+        i_t, v_t, _ = acc.take_grad(2)
+        with stf.Session() as sess:
+            sess.run(a1)
+            sess.run(a2)
+            iv, vv = sess.run([i_t, v_t])
+        np.testing.assert_array_equal(iv, [0, 1])
+        np.testing.assert_allclose(vv, [[6.], [8.]])  # /1 each, not /2
+
+    def test_partial_shape_accumulator(self):
+        stf.reset_default_graph()
+        acc = stf.SparseConditionalAccumulator(stf.float32,
+                                               shape=(None, 2))
+        ap = acc.apply_grad(
+            stf.constant(np.array([1], np.int64)),
+            stf.constant(np.array([[1., 2.]], np.float32)),
+            grad_shape=stf.constant(np.array([5, 2], np.int64)))
+        i_t, v_t, s_t = acc.take_grad(1)
+        with stf.Session() as sess:
+            sess.run(ap)
+            sv = sess.run(s_t)
+        np.testing.assert_array_equal(sv, [5, 2])
+
+    def test_stale_gradients_dropped(self):
+        stf.reset_default_graph()
+        acc = stf.SparseConditionalAccumulator(stf.float32)
+        fresh = acc.apply_grad(stf.constant(np.array([0], np.int64)),
+                               stf.constant(np.array([[1.]], np.float32)),
+                               local_step=1)
+        stale = acc.apply_grad(stf.constant(np.array([0], np.int64)),
+                               stf.constant(np.array([[9.]], np.float32)),
+                               local_step=0)
+        setstep = acc.set_global_step(1)
+        n_t = acc.num_accumulated()
+        with stf.Session() as sess:
+            sess.run(setstep)
+            sess.run(stale)   # local_step 0 < global 1: dropped
+            assert sess.run(n_t) == 0
+            sess.run(fresh)
+            assert sess.run(n_t) == 1
+
+    def test_indexed_slices_round_trip(self):
+        stf.reset_default_graph()
+        acc = stf.SparseConditionalAccumulator(stf.float32)
+        grad = stf.IndexedSlices(
+            values=stf.constant(np.array([[2., 2.]], np.float32)),
+            indices=stf.constant(np.array([1], np.int64)))
+        apply_op = acc.apply_indexed_slices_grad(grad)
+        out = acc.take_indexed_slices_grad(1)
+        with stf.Session() as sess:
+            sess.run(apply_op)
+            iv, vv = sess.run([out.indices, out.values])
+        np.testing.assert_array_equal(iv, [1])
+        np.testing.assert_allclose(vv, [[2., 2.]])
+
+
+class TestRecordInput:
+    def _write_tfrecords(self, tmp_path, n_files=2, per_file=6):
+        from simple_tensorflow_tpu.lib.io import tf_record
+
+        paths = []
+        k = 0
+        for f in range(n_files):
+            p = str(tmp_path / f"part-{f}.tfrecord")
+            with tf_record.TFRecordWriter(p) as w:
+                for _ in range(per_file):
+                    w.write(f"rec{k}".encode())
+                    k += 1
+            paths.append(p)
+        return str(tmp_path / "part-*.tfrecord"), n_files * per_file
+
+    def test_yields_batches_covering_all_records(self, tmp_path):
+        stf.reset_default_graph()
+        pattern, total = self._write_tfrecords(tmp_path)
+        ri = stf.RecordInput(pattern, batch_size=4, buffer_size=8, seed=7)
+        batch = ri.get_yield_op()
+        seen = []
+        with stf.Session() as sess:
+            for _ in range(total // 4):
+                seen.extend(sess.run(batch).tolist())
+        assert len(seen) == total
+        # wraps epochs continuously: every record appears at least once
+        assert {f"rec{i}".encode() if isinstance(seen[0], bytes)
+                else f"rec{i}" for i in range(total)} <= set(seen)
+
+    def test_bad_pattern_raises(self):
+        stf.reset_default_graph()
+        with pytest.raises(ValueError, match="No files match"):
+            stf.RecordInput("/nonexistent/xyz-*.tfrecord")
+
+    def test_empty_files_raise_out_of_range(self, tmp_path):
+        from simple_tensorflow_tpu.lib.io import tf_record
+
+        stf.reset_default_graph()
+        p = str(tmp_path / "empty.tfrecord")
+        with tf_record.TFRecordWriter(p):
+            pass  # zero records
+        ri = stf.RecordInput(p, batch_size=1)
+        batch = ri.get_yield_op()
+        with stf.Session() as sess:
+            with pytest.raises(stf.errors.OutOfRangeError,
+                               match="no records"):
+                sess.run(batch)
+
+
+class TestBarrierClosedEmpty:
+    def test_allow_small_batch_closed_empty_is_out_of_range(self):
+        stf.reset_default_graph()
+        b = stf.Barrier((stf.int32,), shapes=((),))
+        _, keys_t, _ = b.take_many(1, allow_small_batch=True)
+        with stf.Session() as sess:
+            sess.run(b.close())
+            with pytest.raises(stf.errors.OutOfRangeError):
+                sess.run(keys_t)

@@ -1,0 +1,109 @@
+"""Session handles (ref: python/ops/session_ops.py:58,155,
+core/kernels/session_ops.cc): fetched tensors stay device-resident
+across Session.run calls and feed back without a host round trip."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+class TestSessionHandles:
+    def test_handle_round_trip(self):
+        stf.reset_default_graph()
+        a = stf.constant(np.arange(8, dtype=np.float32))
+        h_op = stf.get_session_handle(a * 2.0)
+        holder, t = stf.get_session_tensor(None, stf.float32)
+        out = t + 1.0
+        with stf.Session() as sess:
+            handle = sess.run(h_op)
+            assert isinstance(handle, stf.TensorHandle)
+            assert handle.handle.startswith("stf_handle_")
+            # feed the TensorHandle object directly (ref allows both)
+            r = sess.run(out, {holder: handle})
+            np.testing.assert_allclose(r, np.arange(8) * 2.0 + 1.0)
+            # feed the raw string too
+            r2 = sess.run(out, {holder: np.asarray(handle.handle,
+                                                   dtype=object)})
+            np.testing.assert_allclose(r2, r)
+
+    def test_value_stays_device_resident(self):
+        # handle store holds a jax.Array; pinning + feeding back never
+        # converts to numpy. Placeholder input defeats const folding, so
+        # the matmul truly executes on device and GetSessionHandle runs
+        # post-host on the RAW device array.
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [16, 16])
+        h_op = stf.get_session_handle(stf.matmul(x, x))
+        with stf.Session() as sess:
+            handle = sess.run(h_op, {x: np.ones((16, 16), np.float32)})
+            stored = sess._handles[handle.handle]
+            assert hasattr(stored, "sharding"), type(stored)
+            np.testing.assert_allclose(np.asarray(stored),
+                                       np.full((16, 16), 16.0))
+
+    def test_no_host_transfer_under_disallow_guard(self):
+        # run→handle→feed round trip with the L0 transfer guard set to
+        # "disallow": a host round trip of the 1 MiB payload would raise;
+        # the handle path must not.
+        stf.reset_default_graph()
+        cfg = stf.ConfigProto(transfer_guard="disallow",
+                              transfer_guard_threshold_bytes=1 << 16)
+        a = stf.constant(np.ones((512, 512), np.float32))  # 1 MiB
+        h_op = stf.get_session_handle(a * 3.0)
+        holder, t = stf.get_session_tensor(None, stf.float32)
+        s = stf.reduce_sum(t)  # scalar fetch: below guard threshold
+        sess = stf.Session(config=cfg)
+        handle = sess.run(h_op)
+        for _ in range(4):  # beyond the 2-call warmup the guard allows
+            val = sess.run(s, {holder: handle})
+        assert val == 3.0 * 512 * 512
+
+    def test_eval_and_delete(self):
+        stf.reset_default_graph()
+        h_op = stf.get_session_handle(
+            stf.constant(np.array([1.0, 2.0], np.float32)))
+        holder, t = stf.get_session_tensor(None, stf.float32)
+        with stf.Session() as sess:
+            handle = sess.run(h_op)
+            np.testing.assert_allclose(handle.eval(), [1.0, 2.0])
+            handle.delete()
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="handle"):
+                sess.run(t, {holder: handle})
+
+    def test_delete_session_tensor_op(self):
+        stf.reset_default_graph()
+        h_op = stf.get_session_handle(stf.constant(np.float32(7.0)))
+        del_holder, deleter = stf.delete_session_tensor()
+        holder, t = stf.get_session_tensor(None, stf.float32)
+        with stf.Session() as sess:
+            handle = sess.run(h_op)
+            sess.run(deleter, {del_holder: handle})
+            with pytest.raises(stf.errors.InvalidArgumentError):
+                sess.run(t, {holder: handle})
+
+    def test_shared_fetch_returns_numpy(self):
+        # fetching a tensor that ALSO feeds GetSessionHandle must still
+        # return numpy, not a raw jax.Array
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [4])
+        y = x * 2.0
+        h_op = stf.get_session_handle(y)
+        with stf.Session() as sess:
+            hv, yv = sess.run([h_op, y],
+                              {x: np.arange(4, dtype=np.float32)})
+        assert isinstance(hv, stf.TensorHandle)
+        assert isinstance(yv, np.ndarray), type(yv)
+        np.testing.assert_allclose(yv, [0., 2., 4., 6.])
+
+    def test_handle_of_host_tensor(self):
+        # handles work for host-stage values too (e.g. strings)
+        stf.reset_default_graph()
+        h_op = stf.get_session_handle(
+            stf.constant(np.array(["a", "b"], dtype=object)))
+        holder, t = stf.get_session_tensor(None, stf.string)
+        with stf.Session() as sess:
+            handle = sess.run(h_op)
+            out = sess.run(t, {holder: handle})
+        assert list(out) == ["a", "b"]
