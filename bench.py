@@ -121,8 +121,12 @@ def _measure_resnet(batch, image_size, steps, warmup, device_kind,
     from simple_tensorflow_tpu.models import resnet
 
     stf.reset_default_graph()
-    m = resnet.resnet50_train_model(batch_size=batch, image_size=image_size,
-                                    dtype=stf.bfloat16, learning_rate=0.1)
+    m = resnet.resnet50_train_model(
+        batch_size=batch, image_size=image_size,
+        dtype=stf.bfloat16, learning_rate=0.1,
+        # remat residual blocks: trades ~1.3x fwd FLOPs for the saved-
+        # activation bytes — net win when HBM-bandwidth-bound (v5e)
+        recompute=os.environ.get("BENCH_RESNET_RECOMPUTE", "0") == "1")
     images, labels = resnet.synthetic_imagenet(batch, image_size,
                                                dtype=np.float32)
     # Stage the batch in HBM once: the bench measures the training step, not
@@ -302,6 +306,228 @@ def _measure_bert(batch, platform, device_kind):
     }
 
 
+def _measure_mnist(platform, device_kind):
+    """BASELINE config 1: MNIST softmax via tf.Session. The reference ran
+    this single-device on CPU; comparator 10k examples/sec is a
+    TF-1.0-era CPU softmax rate."""
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = 3
+    batch = 512
+
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.models import mnist
+
+    stf.reset_default_graph()
+    m = mnist.softmax_model(batch_size=batch, learning_rate=0.5)
+    xv, _, onehot = mnist.synthetic_mnist(batch)
+    import jax.numpy as jnp
+
+    feed = {m["x"]: jnp.asarray(xv), m["y_"]: jnp.asarray(onehot)}
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        sess.run(m["train_op"], feed_dict=feed)
+    _ = sess.run(m["loss"], feed_dict=feed)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        sess.run(m["train_op"], feed_dict=feed)
+    loss = sess.run(m["loss"], feed_dict=feed)
+    dt = time.perf_counter() - t0
+    sec_per_step = dt / (steps + 1)
+    examples_per_sec = batch / sec_per_step
+    return {
+        "metric": "mnist_softmax_examples_per_sec",
+        "value": round(float(examples_per_sec), 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(float(examples_per_sec) / 10000.0, 3),
+        "batch": batch,
+        "sec_per_step": round(sec_per_step, 6),
+        "warmup_plus_compile_s": round(compile_s, 1),
+        "loss": round(float(np.asarray(loss)), 4),
+        "device": str(jax.devices()[0]),
+    }
+
+
+def _measure_transformer(batch, platform, device_kind):
+    """BASELINE config 5: Transformer-big WMT en-de training step +
+    beam-search inference latency. Comparator 2000 tokens/sec is a
+    P100-era per-GPU transformer-big rate (same vintage as the other
+    baselines)."""
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = 3
+    src_len = tgt_len = int(os.environ.get("BENCH_TFMR_SEQ", "64"))
+
+    import jax
+    import jax.numpy as jnp
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig.big()
+    if platform == "cpu":
+        cfg = transformer.TransformerConfig.tiny()
+        batch, src_len, tgt_len, steps, warmup = 4, 16, 16, 3, 1
+
+    stf.reset_default_graph()
+    m = transformer.transformer_train_model(
+        batch_size=batch, src_len=src_len, tgt_len=tgt_len, cfg=cfg,
+        recompute=os.environ.get("BENCH_TFMR_RECOMPUTE", "0") == "1")
+    b = transformer.synthetic_wmt_batch(batch, src_len, tgt_len,
+                                        vocab_size=cfg.vocab_size)
+    feed = {m[k]: jnp.asarray(v) for k, v in b.items()}
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        sess.run(m["train_op"], feed_dict=feed)
+    _ = sess.run(m["loss"], feed_dict=feed)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        sess.run(m["train_op"], feed_dict=feed)
+    loss = sess.run(m["loss"], feed_dict=feed)
+    dt = time.perf_counter() - t0
+    sec_per_step = dt / (steps + 1)
+    tokens_per_sec = batch * (src_len + tgt_len) / sec_per_step
+    flops_per_token = 3.0 * transformer.transformer_flops_per_token(
+        cfg, src_len, tgt_len)
+    peak = detect_peak_flops(device_kind, platform)
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    # beam-search inference latency (the model's flagship serving mode)
+    beam_ms = None
+    try:
+        stf.reset_default_graph()
+        infer_batch = 4
+        src_ph = stf.placeholder(stf.int32, [infer_batch, src_len],
+                                 name="beam_src")
+        seqs, scores = transformer.beam_search_decode(
+            src_ph, cfg=cfg, beam_size=4,
+            decode_len=min(16, tgt_len))
+        sess_i = stf.Session()
+        sess_i.run(stf.global_variables_initializer())
+        bfeed = {src_ph: b["src_ids"][:infer_batch]}
+        # warm up the EXACT fetch signature of the timed loop (the step
+        # cache keys on fetch names; a different fetch list recompiles)
+        sess_i.run([seqs, scores], feed_dict=bfeed)
+        t0 = time.perf_counter()
+        n_iters = 5
+        for _ in range(n_iters):
+            sess_i.run([seqs, scores], feed_dict=bfeed)
+        beam_ms = (time.perf_counter() - t0) / n_iters * 1000.0
+    except Exception as e:
+        beam_ms = f"failed: {type(e).__name__}: {str(e)[:200]}"
+
+    result = {
+        **_roofline_info(sess, feed, sec_per_step, platform),
+        "metric": "transformer_big_tokens_per_sec_per_chip",
+        "value": round(float(tokens_per_sec), 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(float(tokens_per_sec) / 2000.0, 3),
+        "mfu": round(float(mfu), 4),
+        "batch": batch,
+        "src_len": src_len,
+        "tgt_len": tgt_len,
+        "sec_per_step": round(sec_per_step, 5),
+        "warmup_plus_compile_s": round(compile_s, 1),
+        "loss": round(float(np.asarray(loss)), 4),
+        "device": str(jax.devices()[0]),
+    }
+    if isinstance(beam_ms, float):
+        result["beam_search_latency_ms"] = round(beam_ms, 1)
+        result["beam_config"] = "batch4_beam4_len16"
+    else:
+        result["beam_search_latency_ms"] = beam_ms
+    return result
+
+
+def run_bench_transformer(platform, device_kind):
+    batches = [int(x) for x in
+               os.environ.get("BENCH_TFMR_BATCH", "16,24").split(",") if x]
+    if platform == "cpu":
+        batches = batches[:1]
+    return _sweep_batches(
+        batches, lambda b: _measure_transformer(b, platform, device_kind))
+
+
+def _measure_resnet_dp(n_devices=8):
+    """BASELINE config 3: ResNet data-parallel scaling. No multi-chip
+    hardware on this rig, so this measures SHARDING OVERHEAD on a virtual
+    n-device CPU mesh: the dp step does n x the single-device work on the
+    same physical core, so efficiency = n * t_single / t_dp — 1.0 means
+    the mesh lowering (psum grads, sharded feeds) adds nothing over ideal.
+    On real chips the same code path gives true scaling."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import parallel
+    from simple_tensorflow_tpu.models import resnet
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} virtual devices, have {len(devices)}")
+    per_dev_batch, image = 4, 32
+    steps, warmup = 5, 2
+
+    def time_model(mesh, batch):
+        stf.reset_default_graph()
+        ctx = mesh if mesh is not None else _NullCtx()
+        with ctx:
+            m = resnet.resnet50_train_model(
+                batch_size=batch, image_size=image, dtype=stf.float32,
+                learning_rate=0.1)
+            if mesh is not None:
+                parallel.shard_feed(m["images"], "dp")
+                parallel.shard_feed(m["labels"], "dp")
+            xv, yv = resnet.synthetic_imagenet(batch, image,
+                                               dtype=np.float32)
+            feed = {m["images"]: xv, m["labels"]: yv}
+            sess = stf.Session()
+            sess.run(stf.global_variables_initializer())
+            for _ in range(warmup):
+                sess.run(m["train_op"], feed_dict=feed)
+            sess.run(m["loss"], feed_dict=feed)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                sess.run(m["train_op"], feed_dict=feed)
+            loss = sess.run(m["loss"], feed_dict=feed)
+            dt = (time.perf_counter() - t0) / (steps + 1)
+        assert np.isfinite(np.asarray(loss))
+        return dt
+
+    class _NullCtx:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    t_single = time_model(None, per_dev_batch)
+    mesh = parallel.Mesh({"dp": n_devices}, devices=devices[:n_devices])
+    t_dp = time_model(mesh, per_dev_batch * n_devices)
+    efficiency = (n_devices * t_single) / t_dp
+    return {
+        "metric": "resnet50_dp8_sharding_efficiency",
+        "value": round(float(min(efficiency, 1.5)), 3),
+        "unit": "fraction_of_ideal",
+        "vs_baseline": round(float(min(efficiency, 1.5)), 3),
+        "n_devices": n_devices,
+        "per_device_batch": per_dev_batch,
+        "image_size": image,
+        "t_single_s": round(t_single, 4),
+        "t_dp_s": round(t_dp, 4),
+        "note": ("virtual-mesh overhead check (1 physical core): "
+                 "n*t_single/t_dp; 1.0 = sharding adds zero overhead"),
+        "device": "cpu_virtual_mesh",
+    }
+
+
 def child_main():
     """Runs the actual bench; prints the JSON line itself on success."""
     platform, kind = os.environ.get("BENCH_PLATFORM", "cpu|").split("|", 1)
@@ -322,8 +548,15 @@ def child_main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    if os.environ.get("BENCH_MODEL", "resnet") == "bert":
+    model = os.environ.get("BENCH_MODEL", "resnet")
+    if model == "bert":
         result = run_bench_bert(platform, kind)
+    elif model == "mnist":
+        result = _measure_mnist(platform, kind)
+    elif model == "transformer":
+        result = run_bench_transformer(platform, kind)
+    elif model == "resnet_dp":
+        result = _measure_resnet_dp()
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -352,13 +585,29 @@ def _spawn_child(env, timeout_s):
 def _run_model(model, platform, kind, errors):
     """Run one model's bench in a killable child (TPU first, CPU fallback).
     Returns the parsed JSON dict or a zeroed fallback with the error."""
+    name, unit = _METRIC_NAMES[model]
     fallback = {
-        "metric": ("resnet50_images_per_sec_per_chip" if model == "resnet"
-                   else "bert_base_tokens_per_sec_per_chip"),
+        "metric": name,
         "value": 0.0,
-        "unit": "images/sec/chip" if model == "resnet" else "tokens/sec/chip",
+        "unit": unit,
         "vs_baseline": 0.0,
     }
+    if model == "resnet_dp":
+        # virtual-mesh overhead check: always a CPU-mesh child by design
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env["BENCH_PLATFORM"] = "cpu|"
+        env["BENCH_MODEL"] = model
+        result, err = _spawn_child(
+            env, int(os.environ.get("BENCH_DP_TIMEOUT", "900")))
+        if result is not None:
+            return result
+        fallback["error"] = f"resnet_dp_run_failed: {err}"
+        return fallback
     if platform is not None and platform != "cpu":
         env = dict(os.environ)
         env["BENCH_PLATFORM"] = f"{platform}|{kind}"
@@ -393,6 +642,10 @@ def _run_model(model, platform, kind, errors):
 _METRIC_NAMES = {
     "resnet": ("resnet50_images_per_sec_per_chip", "images/sec/chip"),
     "bert": ("bert_base_tokens_per_sec_per_chip", "tokens/sec/chip"),
+    "mnist": ("mnist_softmax_examples_per_sec", "examples/sec"),
+    "transformer": ("transformer_big_tokens_per_sec_per_chip",
+                    "tokens/sec/chip"),
+    "resnet_dp": ("resnet50_dp8_sharding_efficiency", "fraction_of_ideal"),
 }
 
 
@@ -410,7 +663,8 @@ def main():
         errors = []
         if platform is None or platform == "cpu":
             errors.append("tpu_unavailable")
-        for model in ("resnet", "bert"):
+        for model in ("resnet", "bert", "transformer", "mnist",
+                      "resnet_dp"):
             result = _run_model(model, platform, kind, list(errors))
             emit(result)
             emitted.add(model)
@@ -418,7 +672,8 @@ def main():
         return results
     except BaseException as e:  # noqa: BLE001 — JSON line on every path
         traceback.print_exc(file=sys.stderr)
-        for model in ("resnet", "bert"):
+        for model in ("resnet", "bert", "transformer", "mnist",
+                      "resnet_dp"):
             if model in emitted:
                 continue
             name, unit = _METRIC_NAMES[model]

@@ -19,6 +19,7 @@ from .framework.dtypes import (
     float8_e4m3fn, float8_e5m2,
     int8, int16, int32, int64, uint8, uint16, uint32, uint64,
     bool_ as bool, complex64, complex128, string,
+    qint8, quint8, qint32, qint16, quint16,
 )
 from .framework.tensor_shape import TensorShape, Dimension
 from .framework import errors
@@ -41,7 +42,7 @@ from .framework.config_pb import ConfigProto, GPUOptions, GraphOptions
 from .ops import state_ops
 from .ops import variables as _variables_mod
 from .ops.variables import (
-    Variable, PartitionedVariable,
+    Variable, PartitionedVariable, ResourceVariable, is_resource_variable,
     global_variables, all_variables, local_variables, model_variables,
     trainable_variables, moving_average_variables,
     variables_initializer, initialize_variables,
@@ -136,6 +137,15 @@ from .ops.misc_ops import (
 from .ops.numerics import verify_tensor_all_finite, add_check_numerics_ops
 from .ops import lookup_ops as lookup
 from .ops.lookup_ops import tables_initializer
+from .ops import sdca_ops
+from .ops.sdca_ops import sdca_optimizer, sdca_shrink_l1, sdca_fprint
+from .ops import quantization_ops
+from .ops.quantization_ops import (
+    quantize_v2, quantize, dequantize,
+    fake_quant_with_min_max_args, fake_quant_with_min_max_args_gradient,
+    fake_quant_with_min_max_vars, fake_quant_with_min_max_vars_gradient,
+    fake_quant_with_min_max_vars_per_channel,
+)
 from .ops import session_ops
 from .ops.session_ops import (
     TensorHandle, get_session_handle, get_session_tensor,

@@ -672,6 +672,9 @@ class BaseSession:
             pruned, fed_set, fetch_tensors)
         step.const_env = const_env
         step.alias = alias
+        # SURVEY §5 ordering detector: unordered read/write of the same
+        # variable in one step is an error, not a silent topo tie-break
+        lowering_mod.check_step_read_write_races(pruned, alias)
 
         def _rsv(t):  # resolve through CSE aliases
             return alias.get(t, t)

@@ -184,11 +184,21 @@ complex128 = DType("complex128", np.dtype(np.complex128))
 # Strings are host-side only (parsing, filenames); represented as numpy object
 # arrays and never shipped to the TPU.
 string = DType("string", np.dtype(object))
+# Quantized dtypes (ref: framework/types.h DT_QINT8 etc.). On TPU the MXU
+# consumes plain s8/u8/s32 with separate scale tensors, so these are
+# distinct *names* over the native widths — exactly how the int8 Pallas
+# quant_matmul wants its operands.
+qint8 = DType("qint8", np.dtype(np.int8))
+quint8 = DType("quint8", np.dtype(np.uint8))
+qint32 = DType("qint32", np.dtype(np.int32))
+qint16 = DType("qint16", np.dtype(np.int16))
+quint16 = DType("quint16", np.dtype(np.uint16))
 
 _ALL = [
     float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2,
     int8, int16, int32, int64, uint8, uint16, uint32, uint64,
     bool_, complex64, complex128, string,
+    qint8, quint8, qint32, qint16, quint16,
 ]
 _BY_NAME = {d.name: d for d in _ALL}
 _BY_NAME.update({d.name + "_ref": d._ref for d in _ALL})

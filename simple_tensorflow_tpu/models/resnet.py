@@ -55,20 +55,38 @@ def _bottleneck(x, filters, stride, training, projection, name):
         return stf.nn.relu(y + shortcut)
 
 
-def resnet_forward(x, num_classes=1000, depth=50, training=True):
-    """Build the forward graph; x is NHWC."""
+def resnet_forward(x, num_classes=1000, depth=50, training=True,
+                   recompute=False):
+    """Build the forward graph; x is NHWC.
+
+    recompute=True rematerializes each residual block's activations in
+    the backward pass (stf.recompute_grad / jax.checkpoint): cuts the
+    dominant byte sink of the training step — saved block activations —
+    at ~1.3x forward FLOPs, which ResNet can afford on v5e where the
+    step is HBM-bandwidth-bound (artifacts/resnet_perf_diagnosis.md).
+    """
+    from . import common
+
     blocks = _BLOCKS[depth]
     with stf.variable_scope("resnet", reuse=stf.AUTO_REUSE):
         h = _conv(x, 64, 7, 2, "conv0")
         h = stf.nn.relu(_bn(h, training, "bn0"))
         h = stf.layers.max_pooling2d(h, 3, 2, padding="same", name="pool0")
+        block_idx = 0
         for stage, n_blocks in enumerate(blocks):
             filters = 64 * (2 ** stage)
             for i in range(n_blocks):
                 stride = 2 if (stage > 0 and i == 0) else 1
-                h = _bottleneck(h, filters, stride, training,
-                                projection=(i == 0),
-                                name=f"stage{stage}_block{i}")
+
+                def block_fn(hh, _bi, filters=filters, stride=stride,
+                             projection=(i == 0),
+                             name=f"stage{stage}_block{i}"):
+                    return _bottleneck(hh, filters, stride, training,
+                                       projection=projection, name=name)
+
+                h = common.maybe_recompute(block_fn, h, block_idx,
+                                           recompute, "resnet_block")
+                block_idx += 1
         h = stf.reduce_mean(h, axis=[1, 2], name="global_pool")  # NHWC pool
         h = stf.cast(h, stf.float32)
         logits = stf.layers.dense(
@@ -81,7 +99,7 @@ def resnet_forward(x, num_classes=1000, depth=50, training=True):
 def resnet50_train_model(batch_size=64, image_size=224, num_classes=1000,
                          dtype=stf.bfloat16, learning_rate=0.1,
                          momentum=0.9, weight_decay=1e-4,
-                         data_parallel=False):
+                         data_parallel=False, recompute=False):
     """Full training graph: images -> loss -> momentum-SGD update.
 
     With ``data_parallel`` and an active Mesh, the batch shards over 'dp'.
@@ -97,7 +115,8 @@ def resnet50_train_model(batch_size=64, image_size=224, num_classes=1000,
             parallel.shard_feed(x, "dp")
             parallel.shard_feed(labels, "dp")
 
-    logits = resnet_forward(x, num_classes=num_classes, training=True)
+    logits = resnet_forward(x, num_classes=num_classes, training=True,
+                            recompute=recompute)
     xent = stf.reduce_mean(stf.nn.sparse_softmax_cross_entropy_with_logits(
         labels=labels, logits=logits))
     # L2 on conv/fc kernels only (reference recipe: no BN params)

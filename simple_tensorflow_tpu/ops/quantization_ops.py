@@ -1,0 +1,329 @@
+"""Quantization op family (ref: core/ops/array_ops.cc:4490
+``QuantizeV2``/``Dequantize``, :4892 ``FakeQuantWithMinMax*``, kernels
+core/kernels/{quantize_op,dequantize_op,fake_quant_ops}.cc and the
+nudging math in fake_quant_ops_functor.h).
+
+TPU-native: every op here is a pure device op — elementwise affine maps
+and clamps that XLA fuses into neighbouring kernels (on the reference
+these were standalone CPU kernels). Fake-quant ops carry custom VJPs
+(straight-through estimator, range-gradient routing to min/max), so QAT
+training works through ``stf.gradients`` unchanged. Serving int8 routes
+through the Pallas ``quantized_matmul`` (ops/fused_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from .op_util import make_op
+
+_QRANGE = {
+    "qint8": (-128, 127), "int8": (-128, 127),
+    "quint8": (0, 255), "uint8": (0, 255),
+    "qint16": (-32768, 32767), "quint16": (0, 65535),
+    "qint32": (-2**31, 2**31 - 1), "int32": (-2**31, 2**31 - 1),
+}
+
+
+# ---------------------------------------------------------------------------
+# QuantizeV2 / Dequantize
+# ---------------------------------------------------------------------------
+
+def _quantize_v2_impl(x, min_range, max_range, T="qint8",
+                      mode="MIN_COMBINED"):
+    lo, hi = _QRANGE[T]
+    np_dt = dtypes_mod.as_dtype(T).np_dtype
+    # guard against degenerate ranges (ref kernel separates by epsilon)
+    rng = jnp.maximum(max_range - min_range, 1e-6)
+    if mode == "MIN_COMBINED":
+        scale = (hi - lo) / rng
+        q = (x - min_range) * scale
+        if lo != 0:  # signed: center the band (ref doc: out -= (range+1)/2)
+            q = q - (hi - lo + 1) / 2.0
+        q = jnp.clip(jnp.round(q), lo, hi)
+    elif mode == "MIN_FIRST":
+        steps = hi - lo + 1
+        range_adjust = steps / (steps - 1.0)
+        range_scale = steps / (rng * range_adjust)
+        q = (jnp.round(x * range_scale)
+             - jnp.round(min_range * range_scale) + lo)
+        q = jnp.clip(q, lo, hi)
+    else:
+        raise ValueError(f"Unknown quantize mode {mode!r}")
+    return [q.astype(np_dt),
+            jnp.asarray(min_range, jnp.float32),
+            jnp.asarray(max_range, jnp.float32)]
+
+
+def _dequantize_impl(q, min_range, max_range, T="qint8",
+                     mode="MIN_COMBINED"):
+    lo, hi = _QRANGE[T]
+    rng = jnp.maximum(max_range - min_range, 1e-6)
+    qf = q.astype(jnp.float32)
+    if mode == "MIN_COMBINED":
+        if lo != 0:
+            qf = qf + (hi - lo + 1) / 2.0
+        return qf * (rng / (hi - lo)) + min_range
+    if mode == "MIN_FIRST":
+        steps = hi - lo + 1
+        range_adjust = steps / (steps - 1.0)
+        range_scale = (rng * range_adjust) / steps
+        return (qf - lo) * range_scale + min_range
+    raise ValueError(f"Unknown quantize mode {mode!r}")
+
+
+op_registry.register_pure("QuantizeV2", _quantize_v2_impl, n_outputs=3)
+op_registry.register_pure("Dequantize", _dequantize_impl)
+
+
+def quantize_v2(input, min_range, max_range, T=dtypes_mod.qint8,  # noqa: A002
+                mode="MIN_COMBINED", name=None):
+    """float → quantized + the (possibly adjusted) range actually used
+    (ref: core/ops/array_ops.cc:4490)."""
+    x = ops_mod.convert_to_tensor(input, dtype=dtypes_mod.float32)
+    mn = ops_mod.convert_to_tensor(min_range, dtype=dtypes_mod.float32)
+    mx = ops_mod.convert_to_tensor(max_range, dtype=dtypes_mod.float32)
+    dt = dtypes_mod.as_dtype(T)
+    g = ops_mod.get_default_graph()
+    from ..framework import tensor_shape as shape_mod
+
+    op = g.create_op(
+        "QuantizeV2", [x, mn, mx], attrs={"T": dt.name, "mode": mode},
+        name=name or "QuantizeV2",
+        output_specs=[(x.shape, dt),
+                      (shape_mod.scalar(), dtypes_mod.float32),
+                      (shape_mod.scalar(), dtypes_mod.float32)])
+    return op.outputs[0], op.outputs[1], op.outputs[2]
+
+
+quantize = quantize_v2  # tf.quantize alias
+
+
+def dequantize(input, min_range, max_range, mode="MIN_COMBINED",  # noqa: A002
+               name=None):
+    """quantized → float (ref: core/ops/array_ops.cc ``Dequantize``)."""
+    x = ops_mod.convert_to_tensor(input)
+    mn = ops_mod.convert_to_tensor(min_range, dtype=dtypes_mod.float32)
+    mx = ops_mod.convert_to_tensor(max_range, dtype=dtypes_mod.float32)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("Dequantize", [x, mn, mx],
+                     attrs={"T": x.dtype.name, "mode": mode},
+                     name=name or "Dequantize",
+                     output_specs=[(x.shape, dtypes_mod.float32)])
+    return op.outputs[0]
+
+
+# ---------------------------------------------------------------------------
+# FakeQuant (QAT) — nudged-range quantize/dequantize with custom VJPs
+# ---------------------------------------------------------------------------
+
+def _nudge(min_v, max_v, num_bits, narrow_range):
+    """(nudged_min, nudged_max, scale) so that real zero maps exactly to a
+    quantized step (ref: fake_quant_ops_functor.h ``Nudge``)."""
+    quant_min = 1.0 if narrow_range else 0.0
+    quant_max = float(2 ** num_bits - 1)
+    # ref guards min<=0<=max by clamping the range to contain zero
+    min_v = jnp.minimum(min_v, 0.0)
+    max_v = jnp.maximum(max_v, 0.0)
+    scale = (max_v - min_v) / (quant_max - quant_min)
+    scale = jnp.maximum(scale, 1e-9)
+    zero_point_from_min = quant_min - min_v / scale
+    nudged_zero_point = jnp.clip(jnp.round(zero_point_from_min),
+                                 quant_min, quant_max)
+    nudged_min = (quant_min - nudged_zero_point) * scale
+    nudged_max = (quant_max - nudged_zero_point) * scale
+    return nudged_min, nudged_max, scale
+
+
+def _fake_quant_fwd_math(x, nudged_min, nudged_max, scale):
+    clamped = jnp.clip(x, nudged_min, nudged_max)
+    return (jnp.round((clamped - nudged_min) / scale) * scale
+            + nudged_min)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _fake_quant_args(x, min_v, max_v, num_bits, narrow_range):
+    nmin, nmax, scale = _nudge(jnp.float32(min_v), jnp.float32(max_v),
+                               num_bits, narrow_range)
+    return _fake_quant_fwd_math(x, nmin, nmax, scale)
+
+
+def _fq_args_fwd(x, min_v, max_v, num_bits, narrow_range):
+    return _fake_quant_args(x, min_v, max_v, num_bits, narrow_range), x
+
+
+def _fq_args_bwd(min_v, max_v, num_bits, narrow_range, x, g):
+    nmin, nmax, _ = _nudge(jnp.float32(min_v), jnp.float32(max_v),
+                           num_bits, narrow_range)
+    inside = (x >= nmin) & (x <= nmax)
+    return (jnp.where(inside, g, 0.0),)
+
+
+_fake_quant_args.defvjp(_fq_args_fwd, _fq_args_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fake_quant_vars(x, min_v, max_v, num_bits, narrow_range):
+    nmin, nmax, scale = _nudge(min_v, max_v, num_bits, narrow_range)
+    return _fake_quant_fwd_math(x, nmin, nmax, scale)
+
+
+def _fq_vars_fwd(x, min_v, max_v, num_bits, narrow_range):
+    return (_fake_quant_vars(x, min_v, max_v, num_bits, narrow_range),
+            (x, min_v, max_v))
+
+
+def _fq_vars_bwd(num_bits, narrow_range, res, g):
+    x, min_v, max_v = res
+    nmin, nmax, _ = _nudge(min_v, max_v, num_bits, narrow_range)
+    below, above = x < nmin, x > nmax
+    inside = ~below & ~above
+    # ref FakeQuantWithMinMaxVarsGradient: input grad gated to the range;
+    # min/max receive the gradient mass that fell off their side
+    gx = jnp.where(inside, g, 0.0)
+    gmin = jnp.sum(jnp.where(below, g, 0.0)).astype(min_v.dtype)
+    gmax = jnp.sum(jnp.where(above, g, 0.0)).astype(max_v.dtype)
+    return gx, gmin, gmax
+
+
+_fake_quant_vars.defvjp(_fq_vars_fwd, _fq_vars_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fake_quant_per_channel(x, min_v, max_v, num_bits, narrow_range):
+    # min/max have shape [d] = x.shape[-1]; broadcast over leading dims
+    nmin, nmax, scale = _nudge(min_v, max_v, num_bits, narrow_range)
+    return _fake_quant_fwd_math(x, nmin, nmax, scale)
+
+
+def _fq_pc_fwd(x, min_v, max_v, num_bits, narrow_range):
+    return (_fake_quant_per_channel(x, min_v, max_v, num_bits,
+                                    narrow_range), (x, min_v, max_v))
+
+
+def _fq_pc_bwd(num_bits, narrow_range, res, g):
+    x, min_v, max_v = res
+    nmin, nmax, _ = _nudge(min_v, max_v, num_bits, narrow_range)
+    below, above = x < nmin, x > nmax
+    inside = ~below & ~above
+    axes = tuple(range(x.ndim - 1))
+    gx = jnp.where(inside, g, 0.0)
+    gmin = jnp.sum(jnp.where(below, g, 0.0), axis=axes).astype(min_v.dtype)
+    gmax = jnp.sum(jnp.where(above, g, 0.0), axis=axes).astype(max_v.dtype)
+    return gx, gmin, gmax
+
+
+_fake_quant_per_channel.defvjp(_fq_pc_fwd, _fq_pc_bwd)
+
+
+op_registry.register_pure(
+    "FakeQuantWithMinMaxArgs",
+    lambda x, min=-6.0, max=6.0, num_bits=8, narrow_range=False:  # noqa: A002
+    _fake_quant_args(x, float(min), float(max), int(num_bits),
+                     bool(narrow_range)))
+op_registry.register_pure(
+    "FakeQuantWithMinMaxVars",
+    lambda x, mn, mx, num_bits=8, narrow_range=False:
+    _fake_quant_vars(x, mn, mx, int(num_bits), bool(narrow_range)))
+op_registry.register_pure(
+    "FakeQuantWithMinMaxVarsPerChannel",
+    lambda x, mn, mx, num_bits=8, narrow_range=False:
+    _fake_quant_per_channel(x, mn, mx, int(num_bits), bool(narrow_range)))
+
+
+def fake_quant_with_min_max_args(inputs, min=-6.0, max=6.0,  # noqa: A002
+                                 num_bits=8, narrow_range=False, name=None):
+    """(ref: core/ops/array_ops.cc:4892). Static clamp range; gradient is
+    the straight-through estimator gated to [min, max]."""
+    x = ops_mod.convert_to_tensor(inputs, dtype=dtypes_mod.float32)
+    return make_op("FakeQuantWithMinMaxArgs", [x],
+                   attrs={"min": float(min), "max": float(max),
+                          "num_bits": int(num_bits),
+                          "narrow_range": bool(narrow_range)}, name=name)
+
+
+def fake_quant_with_min_max_vars(inputs, min, max, num_bits=8,  # noqa: A002
+                                 narrow_range=False, name=None):
+    """(ref: core/ops/array_ops.cc:4924). min/max are tensors (usually
+    Variables) — their gradients collect the clipped mass, so the range
+    TRAINS during QAT."""
+    x = ops_mod.convert_to_tensor(inputs, dtype=dtypes_mod.float32)
+    mn = ops_mod.convert_to_tensor(min, dtype=dtypes_mod.float32)
+    mx = ops_mod.convert_to_tensor(max, dtype=dtypes_mod.float32)
+    return make_op("FakeQuantWithMinMaxVars", [x, mn, mx],
+                   attrs={"num_bits": int(num_bits),
+                          "narrow_range": bool(narrow_range)}, name=name)
+
+
+def fake_quant_with_min_max_vars_per_channel(inputs, min, max,  # noqa: A002
+                                             num_bits=8, narrow_range=False,
+                                             name=None):
+    """(ref: core/ops/array_ops.cc FakeQuantWithMinMaxVarsPerChannel):
+    per-output-channel ranges (last axis)."""
+    x = ops_mod.convert_to_tensor(inputs, dtype=dtypes_mod.float32)
+    mn = ops_mod.convert_to_tensor(min, dtype=dtypes_mod.float32)
+    mx = ops_mod.convert_to_tensor(max, dtype=dtypes_mod.float32)
+    return make_op("FakeQuantWithMinMaxVarsPerChannel", [x, mn, mx],
+                   attrs={"num_bits": int(num_bits),
+                          "narrow_range": bool(narrow_range)}, name=name)
+
+
+# explicit gradient entry points for API parity (the custom VJPs above are
+# what stf.gradients uses; these expose the same math directly,
+# ref: array_ops.py:73-78 @@fake_quant_*_gradient)
+
+def fake_quant_with_min_max_args_gradient(gradients, inputs, min=-6.0,  # noqa: A002
+                                          max=6.0, num_bits=8,  # noqa: A002
+                                          narrow_range=False, name=None):
+    g = ops_mod.convert_to_tensor(gradients, dtype=dtypes_mod.float32)
+    x = ops_mod.convert_to_tensor(inputs, dtype=dtypes_mod.float32)
+    return make_op("FakeQuantArgsGrad", [g, x],
+                   attrs={"min": float(min), "max": float(max),
+                          "num_bits": int(num_bits),
+                          "narrow_range": bool(narrow_range)}, name=name)
+
+
+def fake_quant_with_min_max_vars_gradient(gradients, inputs, min, max,  # noqa: A002
+                                          num_bits=8, narrow_range=False,
+                                          name=None):
+    g = ops_mod.convert_to_tensor(gradients, dtype=dtypes_mod.float32)
+    x = ops_mod.convert_to_tensor(inputs, dtype=dtypes_mod.float32)
+    mn = ops_mod.convert_to_tensor(min, dtype=dtypes_mod.float32)
+    mx = ops_mod.convert_to_tensor(max, dtype=dtypes_mod.float32)
+    from ..framework import tensor_shape as shape_mod
+
+    gr = ops_mod.get_default_graph()
+    op = gr.create_op(
+        "FakeQuantVarsGrad", [g, x, mn, mx],
+        attrs={"num_bits": int(num_bits),
+               "narrow_range": bool(narrow_range)},
+        name=name or "FakeQuantVarsGrad",
+        output_specs=[(x.shape, dtypes_mod.float32),
+                      (shape_mod.scalar(), dtypes_mod.float32),
+                      (shape_mod.scalar(), dtypes_mod.float32)])
+    return op.outputs[0], op.outputs[1], op.outputs[2]
+
+
+def _fq_args_grad_impl(g, x, min=-6.0, max=6.0, num_bits=8,  # noqa: A002
+                       narrow_range=False):
+    nmin, nmax, _ = _nudge(jnp.float32(min), jnp.float32(max),
+                           int(num_bits), bool(narrow_range))
+    return jnp.where((x >= nmin) & (x <= nmax), g, 0.0)
+
+
+def _fq_vars_grad_impl(g, x, mn, mx, num_bits=8, narrow_range=False):
+    return list(_fq_vars_bwd(int(num_bits), bool(narrow_range),
+                             (x, mn, mx), g))
+
+
+op_registry.register_pure("FakeQuantArgsGrad", _fq_args_grad_impl)
+op_registry.register_pure("FakeQuantVarsGrad", _fq_vars_grad_impl,
+                          n_outputs=3)

@@ -164,7 +164,9 @@ def get_variable(name, shape=None, dtype=None, initializer=None,
                     return init(sh)
         else:
             init_val = init
-        v = variables_mod.Variable(
+        var_cls = (variables_mod.ResourceVariable if use_resource
+                   else variables_mod.Variable)
+        v = var_cls(
             initial_value=init_val, trainable=trainable,
             collections=collections, validate_shape=validate_shape,
             name=name + "/", dtype=dt, constraint=constraint)

@@ -367,6 +367,45 @@ op_registry.register("ReportUninitialized", lower=_lower_report_uninitialized,
                      is_stateful=True, runs_on_host=True)
 
 
+class ResourceVariable(Variable):
+    """Resource-semantics variable (ref:
+    python/ops/resource_variable_ops.py:36 ``class ResourceVariable``).
+
+    stf Variables already HAVE resource semantics — state lives in the
+    Session's VariableStore keyed by name, reads are deref-at-use, and
+    there is no ref-tensor aliasing to race on (the reference needed a
+    separate class to escape TF-1 ref-variable aliasing; the functional
+    JAX substrate never had it). This subclass therefore only exposes the
+    resource API surface: ``handle``, ``sparse_read``, and the
+    read-after-write guarantee of ``assign(...).op`` + ``read_value()``
+    under control deps (already tested in test_variables.py).
+    """
+
+    @property
+    def handle(self):
+        """The store-keyed ref tensor doubles as the resource handle."""
+        return self._ref
+
+    def sparse_read(self, indices, name=None):
+        """Gather rows from the current value (ref:
+        resource_variable_ops.py ``sparse_read``)."""
+        from . import array_ops
+
+        return array_ops.gather(self.read_value(), indices, name=name)
+
+    def gather_nd(self, indices, name=None):
+        from . import array_ops
+
+        return array_ops.gather_nd(self.read_value(), indices, name=name)
+
+
+def is_resource_variable(var) -> bool:
+    """(ref: resource_variable_ops.py ``is_resource_variable``). True for
+    ResourceVariable instances; plain stf Variables share the semantics
+    but keep the TF-1 API type."""
+    return isinstance(var, ResourceVariable)
+
+
 class PartitionedVariable:
     """A variable split along one axis (ref: python/ops/partitioned_variables.py).
     On TPU the natural form is a single logical array with a NamedSharding;

@@ -2,6 +2,7 @@
 (ref: python/training/server_lib.py:189 ClusterSpec,
 core/distributed_runtime session-management failure semantics)."""
 
+import os
 import time
 
 import numpy as np
@@ -118,3 +119,66 @@ class TestStepWatchdog:
             assert not wd.timed_out
         finally:
             wd.stop()
+
+
+class TestTwoProcessDistributed:
+    """2-process jax.distributed CPU smoke (VERDICT r3 item 10): Server ->
+    jax.distributed.initialize across REAL processes, coordinator on
+    worker:0; each process must see the global device view."""
+
+    def test_two_process_server_init(self, tmp_path):
+        import socket
+        import subprocess
+        import sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cluster = f"127.0.0.1:{port}"
+        script = (
+            "import os, sys, json\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from simple_tensorflow_tpu.train import server_lib\n"
+            "server_lib.Server._started = False\n"
+            "idx = int(sys.argv[1])\n"
+            "s = server_lib.Server(\n"
+            "    {'worker': ['%s', '%s']},\n"
+            "    job_name='worker', task_index=idx, start=True)\n"
+            "print(json.dumps({'pid': idx,\n"
+            "                  'n_proc': jax.process_count(),\n"
+            "                  'n_dev': len(jax.devices()),\n"
+            "                  'target': s.target}))\n" % (cluster, cluster))
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)  # one device per process
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", script, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=str(tmp_path))
+            for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=120)
+                assert p.returncode == 0, f"rc={p.returncode}: {err[-1500:]}"
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        import json as _json
+
+        for out in outs:
+            line = [l for l in out.splitlines() if l.startswith("{")][-1]
+            d = _json.loads(line)
+            assert d["n_proc"] == 2, d
+            assert d["n_dev"] == 2, d  # global view: both processes' devices
+            assert d["target"].startswith("stf://worker:")

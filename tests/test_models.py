@@ -322,3 +322,39 @@ def test_long_context_recompute_on_sp_mesh():
                 sess.run(m["train_op"], feed)
             l1 = sess.run(m["loss"], feed)
     assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0, (l0, l1)
+
+
+def test_resnet_recompute_matches_baseline_losses():
+    """recompute=True (per-block remat) must change bytes, not math: with
+    IDENTICAL weights loaded, the training-step losses match the
+    non-remat graph."""
+    from simple_tensorflow_tpu.models import resnet
+
+    images, labels = resnet.synthetic_imagenet(4, 64)
+    labels = labels % 10
+    losses = {}
+    saved_vars = None
+    for rc in (False, True):
+        stf.reset_default_graph()
+        stf.set_random_seed(7)
+        m = resnet.resnet50_train_model(batch_size=4, image_size=64,
+                                        num_classes=10, dtype=stf.float32,
+                                        learning_rate=1e-2, recompute=rc)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            if saved_vars is None:
+                saved_vars = {v.var_name: np.asarray(
+                    sess.variable_value(v))
+                    for v in stf.global_variables()}
+            else:
+                for v in stf.global_variables():
+                    v.load(saved_vars[v.var_name], session=sess)
+            _, l1 = sess.run([m["train_op"], m["loss"]],
+                             feed_dict={m["images"]: images,
+                                        m["labels"]: labels})
+            l2 = sess.run(m["loss"], feed_dict={m["images"]: images,
+                                                m["labels"]: labels})
+        losses[rc] = (float(l1), float(l2))
+        assert np.isfinite(l1) and np.isfinite(l2)
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=2e-4, atol=2e-4)

@@ -1,0 +1,179 @@
+"""Quantization op family (ref: core/ops/array_ops.cc:4490 QuantizeV2,
+:4892 FakeQuantWithMinMax*, kernels core/kernels/fake_quant_ops.cc).
+Covers quantize/dequantize round trips, fake-quant grid values, QAT
+gradients (straight-through + trainable range), and the int8 serving
+path through the Pallas quantized_matmul."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+class TestQuantizeDequantize:
+    def test_quint8_min_combined_round_trip(self):
+        stf.reset_default_graph()
+        x = np.array([0.0, 1.0, 3.0, 6.0], np.float32)
+        q, mn, mx = stf.quantize_v2(stf.constant(x), 0.0, 6.0, stf.quint8)
+        deq = stf.dequantize(q, mn, mx)
+        with stf.Session() as sess:
+            qv, dv = sess.run([q, deq])
+        assert qv.dtype == np.uint8
+        np.testing.assert_array_equal(qv, [0, 42, 128, 255])  # x*255/6
+        np.testing.assert_allclose(dv, x, atol=6.0 / 255 + 1e-6)
+
+    def test_qint8_centered(self):
+        stf.reset_default_graph()
+        q, mn, mx = stf.quantize_v2(
+            stf.constant(np.array([0.0, 6.0], np.float32)), 0.0, 6.0,
+            stf.qint8)
+        with stf.Session() as sess:
+            qv = sess.run(q)
+        assert qv.dtype == np.int8
+        np.testing.assert_array_equal(qv, [-128, 127])
+
+    def test_min_first_round_trip(self):
+        stf.reset_default_graph()
+        x = np.linspace(-1.0, 1.0, 9).astype(np.float32)
+        q, mn, mx = stf.quantize_v2(stf.constant(x), -1.0, 1.0,
+                                    stf.quint8, mode="MIN_FIRST")
+        deq = stf.dequantize(q, mn, mx, mode="MIN_FIRST")
+        with stf.Session() as sess:
+            dv = sess.run(deq)
+        np.testing.assert_allclose(dv, x, atol=2.0 / 255 + 1e-6)
+
+    def test_degenerate_range_no_nan(self):
+        stf.reset_default_graph()
+        q, _, _ = stf.quantize_v2(
+            stf.constant(np.array([0.5], np.float32)), 0.5, 0.5)
+        with stf.Session() as sess:
+            assert np.isfinite(sess.run(q)).all()
+
+
+class TestFakeQuant:
+    def test_args_snaps_to_grid(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.array([-0.1, 0.0, 0.33, 5.9, 7.0], np.float32))
+        y = stf.fake_quant_with_min_max_args(x, min=0.0, max=6.0)
+        with stf.Session() as sess:
+            yv = sess.run(y)
+        step = 6.0 / 255
+        # clamped to [0, 6], then snapped to the 255-step grid
+        assert yv[0] == 0.0 and yv[-1] == pytest.approx(6.0)
+        np.testing.assert_allclose(yv[2] / step, round(0.33 / step),
+                                   atol=1e-4)
+
+    def test_args_gradient_gated_to_range(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.array([-1.0, 3.0, 7.0], np.float32))
+        y = stf.fake_quant_with_min_max_args(x, min=0.0, max=6.0)
+        (gx,) = stf.gradients(stf.reduce_sum(y), [x])
+        with stf.Session() as sess:
+            gv = sess.run(gx)
+        np.testing.assert_allclose(gv, [0.0, 1.0, 0.0])
+
+    def test_vars_gradients_route_to_min_max(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.array([-2.0, 1.0, 9.0, 10.0], np.float32))
+        mn = stf.Variable(np.float32(0.0))
+        mx = stf.Variable(np.float32(8.0))
+        y = stf.fake_quant_with_min_max_vars(x, mn, mx)
+        gx, gmn, gmx = stf.gradients(stf.reduce_sum(y), [x, mn, mx])
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            gxv, gmnv, gmxv = sess.run([gx, gmn, gmx])
+        np.testing.assert_allclose(gxv, [0.0, 1.0, 0.0, 0.0])
+        assert gmnv == 1.0   # one element below range
+        assert gmxv == 2.0   # two elements above range
+
+    def test_explicit_gradient_functions_match_autodiff(self):
+        stf.reset_default_graph()
+        xv = np.array([-1.0, 2.0, 7.0], np.float32)
+        gv = np.array([1.0, 1.0, 1.0], np.float32)
+        x = stf.constant(xv)
+        g = stf.constant(gv)
+        bp = stf.fake_quant_with_min_max_args_gradient(g, x, min=0.0,
+                                                       max=6.0)
+        bx, bmn, bmx = stf.fake_quant_with_min_max_vars_gradient(
+            g, x, stf.constant(np.float32(0.0)),
+            stf.constant(np.float32(6.0)))
+        with stf.Session() as sess:
+            bpv, bxv, bmnv, bmxv = sess.run([bp, bx, bmn, bmx])
+        np.testing.assert_allclose(bpv, [0.0, 1.0, 0.0])
+        np.testing.assert_allclose(bxv, [0.0, 1.0, 0.0])
+        assert bmnv == 1.0 and bmxv == 1.0
+
+    def test_per_channel(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.array([[1.0, 50.0], [3.0, -50.0]], np.float32))
+        mn = stf.constant(np.array([0.0, -40.0], np.float32))
+        mx = stf.constant(np.array([4.0, 40.0], np.float32))
+        y = stf.fake_quant_with_min_max_vars_per_channel(x, mn, mx)
+        gx, gmn, gmx = stf.gradients(stf.reduce_sum(y),
+                                     [x, mn, mx])
+        with stf.Session() as sess:
+            yv, gxv, gmnv, gmxv = sess.run([y, gx, gmn, gmx])
+        assert yv[0, 1] == pytest.approx(40.0, abs=0.2)   # clamped ch 1
+        assert yv[1, 1] == pytest.approx(-40.0, abs=0.2)
+        np.testing.assert_allclose(gxv, [[1., 0.], [1., 0.]])
+        np.testing.assert_allclose(gmnv, [0., 1.])
+        np.testing.assert_allclose(gmxv, [0., 1.])
+
+    def test_narrow_range_and_num_bits(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.linspace(0, 1, 7).astype(np.float32))
+        y4 = stf.fake_quant_with_min_max_args(x, min=0.0, max=1.0,
+                                              num_bits=4)
+        with stf.Session() as sess:
+            yv = sess.run(y4)
+        # 4-bit: 15 steps
+        np.testing.assert_allclose(yv * 15, np.round(yv * 15), atol=1e-4)
+
+
+class TestQATEndToEnd:
+    def test_train_with_fake_quant_then_serve_int8(self):
+        """QAT smoke: train a linear layer with fake_quant on weights,
+        quantize the trained weights, serve through the int8 Pallas
+        quantized_matmul, and check outputs agree with float serving."""
+        stf.reset_default_graph()
+        rng = np.random.RandomState(0)
+        xv = rng.randn(32, 16).astype(np.float32)
+        true_w = rng.randn(16, 8).astype(np.float32)
+        yv = xv @ true_w
+
+        x = stf.placeholder(stf.float32, [None, 16])
+        y = stf.placeholder(stf.float32, [None, 8])
+        w = stf.get_variable("w_qat", shape=(16, 8),
+                             initializer=stf.zeros_initializer())
+        w_fq = stf.fake_quant_with_min_max_args(w, min=-4.0, max=4.0)
+        pred = stf.matmul(x, w_fq)
+        loss = stf.reduce_mean(stf.square(pred - y))
+        train = stf.train.AdamOptimizer(0.05).minimize(loss)
+
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            for _ in range(150):
+                sess.run(train, {x: xv, y: yv})
+            final_loss, wv = sess.run([loss, w], {x: xv, y: yv})
+        assert final_loss < 0.05
+
+        # export: quantize trained weights to int8 per-column
+        w_scale = (np.abs(wv).max(axis=0) / 127).astype(np.float32)
+        wq = np.clip(np.round(wv / w_scale), -127, 127).astype(np.int8)
+
+        # serve int8
+        stf.reset_default_graph()
+        from simple_tensorflow_tpu.ops import fused_ops
+
+        xs = stf.placeholder(stf.float32, [32, 16])
+        out_q = fused_ops.quantized_matmul(
+            xs, stf.constant(wq), stf.constant(w_scale))
+        with stf.Session() as sess:
+            served = sess.run(out_q, {xs: xv})
+        float_ref = xv @ wv
+        err = np.abs(served - float_ref).max()
+        scale_bound = np.abs(xv).sum(1).max() * w_scale.max()
+        assert err < scale_bound  # int8-quantization-level agreement
+        np.testing.assert_allclose(
+            served, float_ref,
+            atol=max(0.1, 0.05 * np.abs(float_ref).max()))

@@ -1,0 +1,114 @@
+"""SDCA linear solver (ref: core/ops/sdca_ops.cc, kernels
+core/kernels/sdca_ops.cc). Convergence checks per loss type — SDCA is
+learning-rate free, so a few inner passes must reach the regularized
+optimum on small problems."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+def _run_sdca(loss_type, feats, labels, l2=0.1, sweeps=30, l1=0.0):
+    stf.reset_default_graph()
+    n, d = feats.shape
+    state = stf.placeholder(stf.float32, [n, 4], name="state")
+    w_in = stf.placeholder(stf.float32, [d], name="w")
+    out_state, (w_delta,) = stf.sdca_optimizer(
+        [], [], [], [stf.constant(feats)],
+        stf.constant(np.ones(n, np.float32)), stf.constant(labels),
+        [], [], [w_in], state,
+        loss_type=loss_type, l1=l1, l2=l2, num_inner_iterations=1)
+    sess = stf.Session()
+    st = np.zeros((n, 4), np.float32)
+    w = np.zeros(d, np.float32)
+    for _ in range(sweeps):
+        st, dw = sess.run([out_state, w_delta], {state: st, w_in: w})
+        w = w + dw
+    return w, st
+
+
+class TestSdcaOptimizer:
+    def test_squared_loss_matches_ridge_closed_form(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(40, 3).astype(np.float32)
+        true_w = np.array([1.0, -2.0, 0.5], np.float32)
+        y = (X @ true_w).astype(np.float32)
+        l2 = 0.1
+        w, _ = _run_sdca("squared_loss", X, y, l2=l2, sweeps=60)
+        n = X.shape[0]
+        # primal optimum of (1/N) sum 1/2 (w.x - y)^2 + (l2/2)|w|^2
+        w_star = np.linalg.solve(X.T @ X / n + l2 * np.eye(3), X.T @ y / n)
+        np.testing.assert_allclose(w, w_star, atol=1e-2)
+
+    @pytest.mark.parametrize("loss", ["logistic_loss", "hinge_loss",
+                                      "smooth_hinge_loss"])
+    def test_classification_losses_separate(self, loss):
+        rng = np.random.RandomState(1)
+        X = rng.randn(60, 2).astype(np.float32)
+        y = np.where(X[:, 0] + 2 * X[:, 1] > 0, 1.0, -1.0).astype(
+            np.float32)
+        w, _ = _run_sdca(loss, X, y, l2=0.05, sweeps=40)
+        acc = np.mean(np.sign(X @ w) == y)
+        assert acc > 0.9, (loss, acc, w)
+
+    def test_sparse_arguments_rejected_with_guidance(self):
+        stf.reset_default_graph()
+        with pytest.raises(NotImplementedError, match="embedding_lookup"):
+            stf.sdca_optimizer(
+                [stf.constant(np.zeros(1, np.int64))], [], [], [],
+                stf.constant(np.ones(1, np.float32)),
+                stf.constant(np.ones(1, np.float32)),
+                [stf.constant(np.zeros(1, np.int64))], [], [],
+                stf.constant(np.zeros((1, 4), np.float32)))
+
+    def test_bad_loss_type(self):
+        with pytest.raises(ValueError, match="loss_type"):
+            stf.sdca_optimizer([], [], [], [],
+                               stf.constant(np.ones(1, np.float32)),
+                               stf.constant(np.ones(1, np.float32)),
+                               [], [], [],
+                               stf.constant(np.zeros((1, 4), np.float32)),
+                               loss_type="asdf")
+
+
+class TestSdcaShrinkAndFprint:
+    def test_shrink_l1_soft_threshold(self):
+        stf.reset_default_graph()
+        w = stf.constant(np.array([0.5, -0.05, 0.2], np.float32))
+        (out,) = stf.sdca_shrink_l1([w], l1=0.01, l2=0.1)
+        with stf.Session() as sess:
+            v = sess.run(out)
+        np.testing.assert_allclose(v, [0.4, 0.0, 0.1], atol=1e-6)
+
+    def test_fprint_stable_and_distinct(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.array(["ex1", "ex2", "ex1"], dtype=object))
+        fp = stf.sdca_fprint(x)
+        with stf.Session() as sess:
+            v = sess.run(fp)
+        assert v.dtype == np.int64
+        assert v[0] == v[2] and v[0] != v[1]
+
+
+class TestSdcaL1:
+    def test_l1_shrunk_prediction_path(self):
+        """ref kernel predicts with l1-shrunk weights during the dual
+        sweep (sdca_internal.cc); with l1 on, the solution must differ
+        from the l1=0 run, still fit the informative coordinate, and the
+        final sdca_shrink_l1 must null the near-zero noise coordinate."""
+        rng = np.random.RandomState(5)
+        X = np.hstack([rng.randn(50, 1),
+                       0.01 * rng.randn(50, 1)]).astype(np.float32)
+        y = (2.0 * X[:, 0]).astype(np.float32)
+        w_plain, _ = _run_sdca("squared_loss", X, y, l2=0.1, sweeps=60)
+        w_l1, _ = _run_sdca("squared_loss", X, y, l2=0.1, sweeps=60,
+                            l1=0.02)
+        assert np.abs(w_plain - w_l1).max() > 1e-5  # l1 is not a no-op
+        stf.reset_default_graph()
+        (shrunk,) = stf.sdca_shrink_l1(
+            [stf.constant(w_l1)], l1=0.02, l2=0.1)
+        with stf.Session() as sess:
+            final = sess.run(shrunk)
+        assert abs(final[0]) > 0.5      # informative coord survives
+        assert abs(final[1]) < 0.05     # noise coord shrunk toward zero

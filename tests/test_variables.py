@@ -200,3 +200,127 @@ class TestInitializers:
             sess.run(stf.global_variables_initializer())
             b = sess.run(v2.value())
         np.testing.assert_allclose(a, b)
+
+
+class TestReadWriteRaceDetector:
+    """SURVEY §5 ordering detector: unordered read/write of one variable
+    in one step raises at plan time; control_dependencies is the escape."""
+
+    def test_unordered_read_write_raises(self):
+        stf.reset_default_graph()
+        v = stf.Variable(np.float32(1.0), name="race_v")
+        write = v.assign(stf.constant(np.float32(5.0)))
+        # read feeds computation, unordered w.r.t. the write
+        doubled = v.read_value() * 2.0
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            import pytest as _pytest
+            with _pytest.raises(stf.errors.InvalidArgumentError,
+                                match="race"):
+                sess.run([write, doubled])
+
+    def test_control_dependency_escape_read_after_write(self):
+        stf.reset_default_graph()
+        v = stf.Variable(np.float32(1.0), name="race_v2")
+        write = v.assign(stf.constant(np.float32(5.0)))
+        with stf.control_dependencies([write]):
+            doubled = v.read_value() * 2.0
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert sess.run(doubled) == 10.0  # observes the write
+
+    def test_control_dependency_escape_write_after_read(self):
+        stf.reset_default_graph()
+        v = stf.Variable(np.float32(1.0), name="race_v3")
+        read = v.read_value()
+        doubled = read * 2.0
+        with stf.control_dependencies([doubled.op]):
+            write = v.assign(stf.constant(np.float32(5.0)))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            d, _ = sess.run([doubled, write])
+            assert d == 2.0  # observes the pre-write value
+            assert sess.run(v.read_value()) == 5.0
+
+    def test_bare_fetch_with_write_is_allowed(self):
+        # fetching the variable alongside its update is observation, not
+        # a compute race (the MonitoredTrainingSession global_step
+        # pattern) — allowed
+        stf.reset_default_graph()
+        v = stf.Variable(np.float32(1.0), name="race_v4")
+        write = v.assign_add(stf.constant(np.float32(1.0)))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run([write, v.read_value()])  # must not raise
+
+    def test_data_path_read_into_write_is_allowed(self):
+        # the normal training pattern: read -> grad -> assign
+        stf.reset_default_graph()
+        v = stf.Variable(np.float32(2.0), name="race_v5")
+        write = v.assign(v.read_value() * 3.0)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(write)
+            assert sess.run(v.read_value()) == 6.0
+
+
+class TestResourceVariable:
+    """ref: python/ops/resource_variable_ops.py:36 — the API class over
+    stf's (already resource-semantics) variables."""
+
+    def test_handle_and_sparse_read(self):
+        stf.reset_default_graph()
+        v = stf.ResourceVariable(
+            np.arange(12, dtype=np.float32).reshape(4, 3), name="rv")
+        assert v.handle is v._ref
+        rows = v.sparse_read(stf.constant(np.array([2, 0], np.int32)))
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            rv = sess.run(rows)
+        np.testing.assert_allclose(rv, [[6, 7, 8], [0, 1, 2]])
+        assert stf.is_resource_variable(v)
+        assert not stf.is_resource_variable(
+            stf.Variable(np.float32(0.0), name="plain"))
+
+    def test_get_variable_use_resource(self):
+        stf.reset_default_graph()
+        v = stf.get_variable("res_w", shape=(2,), use_resource=True,
+                             initializer=stf.zeros_initializer())
+        assert isinstance(v, stf.ResourceVariable)
+        # trains like any variable
+        loss_v = stf.reduce_sum(stf.square(v - 3.0))
+        train = stf.train.GradientDescentOptimizer(0.1).minimize(loss_v)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            for _ in range(50):
+                sess.run(train)
+            np.testing.assert_allclose(sess.run(v.read_value()),
+                                       [3.0, 3.0], atol=1e-3)
+
+    def test_read_after_write_guarantee(self):
+        stf.reset_default_graph()
+        v = stf.ResourceVariable(np.float32(1.0), name="rv2")
+        w = v.assign(stf.constant(np.float32(42.0)))
+        with stf.control_dependencies([w]):
+            r = v.read_value()
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            assert sess.run(r) == 42.0
+
+    def test_cse_aliased_path_is_not_a_false_race(self):
+        # regression: a fully data-ordered read->write graph whose write
+        # input got CSE-deduplicated must NOT raise (detector must follow
+        # edges through the alias map)
+        stf.reset_default_graph()
+        v = stf.Variable(np.float32(3.0), name="cse_v")
+        r = v.read_value()
+        c = stf.constant(np.float32(2.0))
+        a = r * c
+        b = r * c          # CSE dup of a
+        w = v.assign(b)
+        out = a + 1.0
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            ov, _ = sess.run([out, w])  # fetch order that tickled the bug
+            assert ov == 7.0
+            assert sess.run(v.read_value()) == 6.0
