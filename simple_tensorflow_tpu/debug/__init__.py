@@ -15,6 +15,7 @@ execution is one XLA program, so debugging hooks differently:
 """
 
 from .analyzer import DebugDumpDir, DebugTensorDatum
+from .cli import AnalyzerCLI
 from .wrappers import (DumpingDebugWrapperSession, LocalCLIDebugWrapperSession,
                        TensorWatch, add_check_numerics_ops,
                        has_inf_or_nan)

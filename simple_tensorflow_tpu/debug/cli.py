@@ -1,0 +1,226 @@
+"""tfdbg-style interactive analyzer CLI (ref:
+python/debug/cli/analyzer_cli.py ``DebugAnalyzer`` and the curses UI in
+python/debug/cli/curses_ui.py).
+
+TPU-native shape: dumps are plain host-side .npy files written by
+``DumpingDebugWrapperSession`` (debug/wrappers.py) — there is nothing to
+attach to on the device, so the CLI is a dependency-free line REPL over
+``DebugDumpDir`` instead of a curses screen. Every command is also
+available programmatically via ``AnalyzerCLI.run_command`` (that is what
+the tests drive), and ``python -m simple_tensorflow_tpu.debug.cli
+<dump_root>`` opens the interactive prompt.
+
+Command set mirrors the reference analyzer:
+
+  lt   [pattern] [-r RUN]     list dumped tensors
+  pt   NAME [-r RUN] [-s SLICE]  print a tensor (optionally sliced)
+  ni   NODE                   node info from the graph (needs --graph)
+  li   NODE                   list inputs of a node
+  lo   NODE                   list consumers of a node
+  runs                        list run ids
+  nan                         find tensors containing inf/nan
+  help / exit
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import shlex
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from .analyzer import DebugDumpDir
+
+
+class CommandError(Exception):
+    pass
+
+
+class AnalyzerCLI:
+    """Command interpreter over a dump dir (+ optional graph for node
+    topology commands)."""
+
+    def __init__(self, dump_dir: DebugDumpDir, graph=None):
+        self._dump = dump_dir
+        self._graph = graph
+
+    # -- helpers -------------------------------------------------------------
+    def _pick_run(self, args) -> Optional[int]:
+        if "-r" in args:
+            i = args.index("-r")
+            try:
+                run = int(args[i + 1])
+            except (IndexError, ValueError):
+                raise CommandError("-r needs an integer run id")
+            del args[i:i + 2]
+            return run
+        return None
+
+    def _node(self, name):
+        if self._graph is None:
+            raise CommandError(
+                "no graph attached; construct AnalyzerCLI(dump, graph=g) "
+                "or pass --graph to the CLI")
+        try:
+            return self._graph.get_operation_by_name(name.split(":")[0])
+        except (KeyError, ValueError):
+            raise CommandError(f"node {name!r} not found in graph")
+
+    # -- commands ------------------------------------------------------------
+    def cmd_lt(self, args: List[str]) -> str:
+        run = self._pick_run(args)
+        pattern = args[0] if args else "*"
+        names = [n for n in self._dump.dumped_tensor_names(run)
+                 if fnmatch.fnmatch(n, pattern)]
+        if not names:
+            return "(no dumped tensors match)"
+        rows = []
+        for n in sorted(names):
+            data = self._dump.watch_key_to_data(n, run)
+            d = data[-1]
+            flag = " !nan/inf" if d.flagged_inf_or_nan else ""
+            rows.append(f"{n}  shape={d.shape} dtype={d.dtype}{flag}")
+        return "\n".join(rows)
+
+    def cmd_pt(self, args: List[str]) -> str:
+        run = self._pick_run(args)
+        if not args:
+            raise CommandError("pt needs a tensor name")
+        name = args[0]
+        sl = None
+        if "-s" in args:
+            i = args.index("-s")
+            try:
+                sl = args[i + 1]
+            except IndexError:
+                raise CommandError("-s needs a slice, e.g. [0:2,3]")
+        data = self._dump.watch_key_to_data(name, run)
+        if not data:
+            raise CommandError(f"tensor {name!r} was not dumped")
+        d = data[-1]
+        v = d.get_tensor()
+        if sl:
+            try:
+                v = eval("v" + sl, {"v": v})  # noqa: S307 — slice literal
+            except Exception as e:
+                raise CommandError(f"bad slice {sl!r}: {e}")
+        stats = d.stats()
+        head = (f"{name}  shape={d.shape} dtype={d.dtype} "
+                f"min={stats['min']:.6g} max={stats['max']:.6g} "
+                f"mean={stats['mean']:.6g} nan={stats['nan']} "
+                f"inf={stats['inf']}")
+        return head + "\n" + np.array2string(np.asarray(v), threshold=100)
+
+    def cmd_ni(self, args: List[str]) -> str:
+        if not args:
+            raise CommandError("ni needs a node name")
+        op = self._node(args[0])
+        lines = [f"node: {op.name}", f"  op: {op.type}",
+                 f"  device: {op.device or '(device stage)'}"]
+        if op.attrs:
+            show = {k: v for k, v in list(op.attrs.items())[:8]}
+            lines.append(f"  attrs: {show}")
+        lines.append(f"  inputs ({len(op.inputs)}):")
+        lines += [f"    {t.name} {t.dtype.name}{list(t.shape) if t.shape.rank is not None else ''}"
+                  for t in op.inputs]
+        outs = [f"    {t.name} {t.dtype.name}" for t in op.outputs]
+        lines.append(f"  outputs ({len(op.outputs)}):")
+        lines += outs
+        if op.control_inputs:
+            lines.append("  control inputs: "
+                         + ", ".join(c.name for c in op.control_inputs))
+        return "\n".join(lines)
+
+    def cmd_li(self, args: List[str]) -> str:
+        op = self._node(args[0] if args else "")
+        return "\n".join(t.name for t in op.inputs) or "(no inputs)"
+
+    def cmd_lo(self, args: List[str]) -> str:
+        op = self._node(args[0] if args else "")
+        consumers = []
+        for t in op.outputs:
+            consumers += [c.name for c in t.consumers()]
+        return "\n".join(sorted(set(consumers))) or "(no consumers)"
+
+    def cmd_runs(self, args: List[str]) -> str:
+        return "\n".join(f"run_{r}" for r in self._dump.runs) \
+            or "(no runs)"
+
+    def cmd_nan(self, args: List[str]) -> str:
+        bad = self._dump.find_inf_or_nan()
+        if not bad:
+            return "no inf/nan tensors found"
+        return "\n".join(f"{d.tensor_name}  (dir {d.run_dir})"
+                         for d in bad)
+
+    def cmd_help(self, args: List[str]) -> str:
+        return (
+            "commands:\n"
+            "  lt [pattern] [-r RUN]      list dumped tensors\n"
+            "  pt NAME [-r RUN] [-s [i:j]]  print tensor (+stats)\n"
+            "  ni NODE                    node info (graph required)\n"
+            "  li NODE                    node inputs\n"
+            "  lo NODE                    node consumers\n"
+            "  runs                       list run ids\n"
+            "  nan                        find inf/nan tensors\n"
+            "  exit                       leave")
+
+    # -- dispatch ------------------------------------------------------------
+    def run_command(self, line: str) -> str:
+        parts = shlex.split(line.strip())
+        if not parts:
+            return ""
+        cmd, args = parts[0], parts[1:]
+        aliases = {"list_tensors": "lt", "print_tensor": "pt",
+                   "node_info": "ni", "list_inputs": "li",
+                   "list_outputs": "lo", "find_inf_or_nan": "nan"}
+        cmd = aliases.get(cmd, cmd)
+        fn = getattr(self, f"cmd_{cmd}", None)
+        if fn is None:
+            raise CommandError(f"unknown command {cmd!r}; try 'help'")
+        return fn(list(args))
+
+    def interactive(self, stdin=None, stdout=None):
+        stdin = stdin or sys.stdin
+        stdout = stdout or sys.stdout
+        stdout.write("stf debug analyzer — 'help' for commands\n")
+        while True:
+            stdout.write("tfdbg> ")
+            stdout.flush()
+            line = stdin.readline()
+            if not line or line.strip() in ("exit", "quit"):
+                return
+            try:
+                out = self.run_command(line)
+            except CommandError as e:
+                out = f"error: {e}"
+            stdout.write(out + "\n")
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(prog="stf.debug.cli")
+    p.add_argument("dump_root")
+    p.add_argument("--graph", default=None,
+                   help="optional GraphDef JSON (graph_io) for ni/li/lo")
+    ns = p.parse_args(argv)
+    graph = None
+    if ns.graph:
+        import json
+
+        from ..framework import graph as graph_mod
+        from ..framework import graph_io
+
+        with open(ns.graph) as f:
+            gd = json.load(f)
+        graph = graph_mod.Graph()
+        with graph.as_default():
+            graph_io.import_graph_def(gd, name="")
+    AnalyzerCLI(DebugDumpDir(ns.dump_root), graph=graph).interactive()
+
+
+if __name__ == "__main__":
+    main()

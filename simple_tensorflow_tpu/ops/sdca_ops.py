@@ -86,6 +86,7 @@ def _sdca_optimizer_impl(dense_features, example_weights, example_labels,
     labels = jnp.asarray(example_labels, jnp.float32)
     weights_ex = jnp.asarray(example_weights, jnp.float32)
     n = labels.shape[0]
+    num_loss_partitions = max(int(num_loss_partitions), 1)
     l2n = jnp.float32(max(l2, 1e-9) * n)
     state = jnp.asarray(example_state_data, jnp.float32)
     alpha0 = state[:, 0] if state.ndim == 2 else state
@@ -109,8 +110,11 @@ def _sdca_optimizer_impl(dense_features, example_weights, example_labels,
         xi = [f[i] for f in feats]
         wx = sum(jnp.dot(shrink(w), x) for w, x in zip(ws, xi))
         a_old = alphas[i]
+        # num_loss_partitions scales the step denominator (ref
+        # sdca_internal.cc: the CoCoA+ aggregation safeguard when the
+        # global loss is split over partitions)
         a_new = _dual_update(loss_type, labels[i], wx, a_old,
-                             xnorm[i] / l2n)
+                             num_loss_partitions * xnorm[i] / l2n)
         a_new = jnp.where(weights_ex[i] > 0, a_new, a_old)
         d = (a_new - a_old) * weights_ex[i]
         ws = [w + (d / l2n) * x for w, x in zip(ws, xi)]
@@ -173,6 +177,14 @@ def sdca_optimizer(sparse_example_indices, sparse_feature_indices,
     if loss_type not in _LOSSES:
         raise ValueError(f"loss_type must be one of {_LOSSES}, "
                          f"got {loss_type!r}")
+    if adaptative:
+        from ..platform import tf_logging as logging
+
+        logging.warning(
+            "sdca_optimizer(adaptative=True): adaptive example sampling "
+            "is a convergence-speed heuristic in the reference kernel; "
+            "this implementation sweeps examples in order (same optimum, "
+            "possibly more inner iterations needed).")
     sparse_args = (sparse_example_indices, sparse_feature_indices,
                    sparse_feature_values, sparse_indices, sparse_weights)
     if any(len(a) > 0 for a in sparse_args if a is not None):

@@ -239,3 +239,66 @@ def test_remove_training_nodes_follows_control_deps(tmp_path):
 
     pruned = gr.prune_to(cleaned, ["cout"])
     assert "cout" in {n["name"] for n in pruned["node"]}
+
+
+class TestDebugAnalyzerCLI:
+    """tfdbg-style CLI (ref: python/debug/cli/analyzer_cli.py) driven
+    programmatically through run_command."""
+
+    def _make_dump(self, tmp_path):
+        from simple_tensorflow_tpu import debug as stf_debug
+
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [2, 2], name="cli_x")
+        y = stf.square(x, name="cli_sq")
+        z = stf.reduce_sum(y, name="cli_sum")
+        sess = stf.Session()
+        wrapped = stf_debug.DumpingDebugWrapperSession(
+            sess, str(tmp_path / "dumps"))
+        wrapped.run(z, {x: np.array([[1., 2.], [3., np.inf]], np.float32)})
+        return stf_debug.AnalyzerCLI(
+            stf_debug.DebugDumpDir(str(tmp_path / "dumps")),
+            graph=stf.get_default_graph())
+
+    def test_lt_pt_runs_nan(self, tmp_path):
+        cli = self._make_dump(tmp_path)
+        lt = cli.run_command("lt")
+        assert "cli_sq" in lt and "shape=(2, 2)" in lt
+        assert "run_1" in cli.run_command("runs")
+        pt = cli.run_command("pt cli_sq:0")
+        assert "dtype=float32" in pt and "9." in pt
+        pt_sliced = cli.run_command("pt cli_sq:0 -s [0]")
+        assert "1." in pt_sliced and "4." in pt_sliced
+        nan = cli.run_command("nan")
+        assert "cli_sq" in nan or "cli_sum" in nan  # inf propagates
+
+    def test_node_topology_commands(self, tmp_path):
+        cli = self._make_dump(tmp_path)
+        ni = cli.run_command("ni cli_sq")
+        assert "op: Square" in ni and "cli_x" in ni
+        li = cli.run_command("li cli_sq")
+        assert "cli_x:0" in li
+        lo = cli.run_command("lo cli_sq")
+        assert "cli_sum" in lo
+
+    def test_errors_and_aliases(self, tmp_path):
+        from simple_tensorflow_tpu.debug.cli import CommandError
+
+        cli = self._make_dump(tmp_path)
+        assert cli.run_command("list_tensors") == cli.run_command("lt")
+        import pytest as _pytest
+        with _pytest.raises(CommandError, match="unknown command"):
+            cli.run_command("wat")
+        with _pytest.raises(CommandError, match="not dumped"):
+            cli.run_command("pt nope:0")
+        assert "commands" in cli.run_command("help")
+
+    def test_interactive_loop(self, tmp_path):
+        import io
+
+        cli = self._make_dump(tmp_path)
+        out = io.StringIO()
+        cli.interactive(stdin=io.StringIO("runs\nbadcmd\nexit\n"),
+                        stdout=out)
+        s = out.getvalue()
+        assert "run_1" in s and "error:" in s
