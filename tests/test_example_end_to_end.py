@@ -36,3 +36,18 @@ def test_data_parallel_example_runs():
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     assert "spans 8 device(s)" in out.stdout, out.stdout[-500:]
     assert "replicated=True" in out.stdout
+
+
+def test_text_qat_example_runs(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "train_text_qat_pipeline.py"),
+         "--steps", "80", "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "QAT training: loss" in out.stdout
+    assert "end to end" in out.stdout
