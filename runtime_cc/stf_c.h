@@ -79,6 +79,18 @@ STF_EXPORT int64_t StfRecordReaderNextBatch(StfRecordReader*,
                                             const uint64_t** offsets,
                                             StfStatus* status);
 
+/* ---- fast batch tf.Example parsing (ref example_proto_fast_parsing) -- */
+
+/* kinds[f]: 0 float32, 1 int64. outs[f]: pointer to float or int64_t
+ * buffer of n_examples x sizes[f] elements. missing: n_examples x
+ * n_features flags set to 1 where a feature is absent. Returns 0 on
+ * success. */
+STF_EXPORT int StfParseExamplesDense(
+    const uint8_t* const* bufs, const size_t* lens, int64_t n_examples,
+    const char* const* names, const int32_t* kinds, const int64_t* sizes,
+    int32_t n_features, void* const* outs, uint8_t* missing,
+    StfStatus* status);
+
 /* ---- arena allocator (host staging buffers) -------------------------- */
 
 typedef struct StfArena StfArena;

@@ -49,11 +49,68 @@ def _feature_values(feature, dtype):
             if feature.int64_list else np.zeros((0,), np.int64))
 
 
+def _parse_examples_fast(serialized, features):
+    """C++ batch fast path (ref core/util/example_proto_fast_parsing.cc):
+    all-FixedLen float32/int64 specs parse in ONE native call into dense
+    numpy buffers. Returns None when the spec mix doesn't qualify (string
+    or VarLen features) or the native runtime isn't available."""
+    from ..runtime import native
+
+    specs = []
+    for name in sorted(features):
+        spec = features[name]
+        if not isinstance(spec, FixedLenFeature):
+            return None
+        if spec.dtype == dtypes_mod.float32:
+            kind = 0
+        elif spec.dtype == dtypes_mod.int64:
+            kind = 1
+        else:
+            return None
+        specs.append((name, spec, kind,
+                      int(np.prod(spec.shape)) if spec.shape else 1))
+    # the native parser caps at 64 dense features per call
+    if not specs or len(specs) > 64 or not native.available():
+        return None
+    serialized = [bytes(s) for s in serialized]
+    try:
+        arrays, missing = native.parse_examples_dense(
+            serialized, [s[0] for s in specs], [s[2] for s in specs],
+            [s[3] for s in specs])
+    except RuntimeError:
+        return None
+    out = {}
+    for f, (name, spec, _kind, size) in enumerate(specs):
+        arr = arrays[f]
+        miss = missing[:, f]
+        if miss.any():
+            if spec.default_value is None:
+                bad = int(np.argmax(miss))
+                raise ValueError(
+                    f"feature {name!r} missing and no default "
+                    f"(example {bad})")
+            default = np.ravel(np.asarray(spec.default_value,
+                                          arr.dtype))
+            if default.shape[0] == 1 and size > 1:
+                default = np.repeat(default, size)
+            if default.shape[0] != size:
+                raise ValueError(
+                    f"feature {name!r}: default_value has "
+                    f"{default.shape[0]} values, expected {size}")
+            arr[miss] = default
+        out[name] = arr.reshape([len(serialized)] + list(spec.shape or []))
+    return out
+
+
 def parse_example_py(serialized, features):
     """Host parser: list[bytes] -> {name: ndarray or (indices,values,shape)}.
 
     FixedLenFeature -> dense [batch] + shape; VarLenFeature -> COO triple.
+    All-dense float32/int64 specs take the native C++ batch fast path.
     """
+    fast = _parse_examples_fast(serialized, features)
+    if fast is not None:
+        return fast
     batch = [example_mod.Example.FromString(bytes(s)) for s in serialized]
     out = {}
     for name, spec in features.items():

@@ -116,6 +116,11 @@ def _bind(lib):
     lib.StfGraphToJson.argtypes = [c.c_void_p, c.POINTER(c.c_size_t),
                                    c.c_void_p]
     lib.StfGraphToJson.restype = c.c_void_p  # read via string_at with length
+    lib.StfParseExamplesDense.argtypes = [
+        c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_size_t), c.c_int64,
+        c.POINTER(c.c_char_p), c.POINTER(c.c_int32), c.POINTER(c.c_int64),
+        c.c_int32, c.POINTER(c.c_void_p), c.POINTER(c.c_uint8), c.c_void_p]
+    lib.StfParseExamplesDense.restype = c.c_int
     return lib
 
 
@@ -169,11 +174,15 @@ class _Status:
         from ..framework import errors
 
         msg = self._lib.StfMessage(self._h).decode()
-        if code == 15:
-            raise errors.DataLossError(None, None, msg)
-        if code == 5:
-            raise errors.NotFoundError(None, None, msg)
-        raise errors.InternalError(None, None, f"[native:{code}] {msg}")
+        # StfCode uses the canonical TF error numbering, so user-data
+        # errors (INVALID_ARGUMENT etc.) surface as the same exception
+        # types the Python paths raise
+        try:
+            exc = errors.exception_type_from_error_code(code)
+        except KeyError:
+            raise errors.InternalError(None, None,
+                                       f"[native:{code}] {msg}")
+        raise exc(None, None, msg)
 
 
 def crc32c(data: bytes) -> int:
@@ -226,6 +235,51 @@ def read_tfrecords(path: str, batch: int = 256) -> Iterator[bytes]:
                 return
     finally:
         lib.StfRecordReaderClose(h)
+
+
+def parse_examples_dense(serialized, names, kinds, sizes):
+    """Batch-parse serialized tf.Example protos into dense numpy arrays
+    via the C++ fast parser (ref core/util/example_proto_fast_parsing.cc).
+
+    serialized: sequence of bytes. names: feature names. kinds: 0=float32,
+    1=int64 per feature. sizes: flat element count per feature.
+    Returns (arrays, missing): arrays[f] is [n, sizes[f]] (float32/int64),
+    missing is a bool [n, n_features] mask of absent features (caller
+    applies FixedLenFeature defaults or raises).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    n = len(serialized)
+    nf = len(names)
+    bufs = (ctypes.POINTER(ctypes.c_uint8) * n)()
+    lens = (ctypes.c_size_t * n)()
+    keepalive = []
+    for i, s in enumerate(serialized):
+        b = bytes(s)
+        keepalive.append(b)
+        bufs[i] = ctypes.cast(ctypes.c_char_p(b),
+                              ctypes.POINTER(ctypes.c_uint8))
+        lens[i] = len(b)
+    cnames = (ctypes.c_char_p * nf)(*[x.encode() for x in names])
+    ckinds = (ctypes.c_int32 * nf)(*kinds)
+    csizes = (ctypes.c_int64 * nf)(*sizes)
+    arrays = []
+    outs = (ctypes.c_void_p * nf)()
+    for f in range(nf):
+        dt = np.float32 if kinds[f] == 0 else np.int64
+        a = np.zeros((n, sizes[f]), dtype=dt)
+        arrays.append(a)
+        outs[f] = a.ctypes.data_as(ctypes.c_void_p)
+    missing = np.zeros((n, nf), dtype=np.uint8)
+    with _Status(lib) as st:
+        rc = lib.StfParseExamplesDense(
+            bufs, lens, n, cnames, ckinds, csizes, nf, outs,
+            missing.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            st.handle)
+        if rc:
+            st.check()
+    return arrays, missing.astype(bool)
 
 
 def write_tfrecords(path: str, records: Sequence[bytes],

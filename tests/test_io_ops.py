@@ -193,3 +193,122 @@ class TestMaybeBatch:
             out = np.ravel(sess.run(batched))
             coord.request_stop()
         assert out.tolist() == [3.0, 4.0]
+
+
+class TestNativeExampleFastParse:
+    """C++ batch Example parser (ref core/util/
+    example_proto_fast_parsing.cc) must agree exactly with the Python wire
+    parser and honor FixedLen defaults/errors."""
+
+    def _examples(self, n=6):
+        from simple_tensorflow_tpu.lib.example import make_example
+
+        out = []
+        for i in range(n):
+            feats = {"x": (np.arange(4, dtype=np.float32) + i).tolist(),
+                     "y": [int(i)]}
+            if i != 3:  # example 3 lacks 'z' -> default must apply
+                feats["z"] = [i * 10, i * 10 + 1]
+            out.append(make_example(**feats).SerializeToString())
+        return out
+
+    def test_fast_path_matches_python_path(self):
+        import simple_tensorflow_tpu.ops.parsing_ops as po
+        from simple_tensorflow_tpu.runtime import native
+
+        if not native.available():
+            import pytest as _pytest
+            _pytest.skip("native runtime not built")
+        serialized = self._examples()
+        feats = {"x": po.FixedLenFeature([4], stf.float32),
+                 "y": po.FixedLenFeature([1], stf.int64),
+                 "z": po.FixedLenFeature([2], stf.int64,
+                                         default_value=[-7, -7])}
+        fast = po._parse_examples_fast(serialized, feats)
+        assert fast is not None, "fast path did not engage"
+        # force the python path for comparison
+        slow = {}
+        from simple_tensorflow_tpu.lib import example as example_mod
+
+        batch = [example_mod.Example.FromString(s) for s in serialized]
+        for name, spec in feats.items():
+            rows = []
+            for ex in batch:
+                f = ex.features.feature.get(name)
+                if f is None:
+                    rows.append(np.asarray(spec.default_value))
+                elif spec.dtype == stf.float32:
+                    rows.append(np.asarray(f.float_list.value, np.float32))
+                else:
+                    rows.append(np.asarray(f.int64_list.value, np.int64))
+            slow[name] = np.stack(rows).reshape([len(batch)] + spec.shape)
+        for name in feats:
+            np.testing.assert_array_equal(fast[name], slow[name],
+                                          err_msg=name)
+
+    def test_fast_path_errors(self):
+        import pytest as _pytest
+
+        import simple_tensorflow_tpu.ops.parsing_ops as po
+        from simple_tensorflow_tpu.runtime import native
+
+        if not native.available():
+            _pytest.skip("native runtime not built")
+        serialized = self._examples()
+        # missing without default raises with the example index
+        with _pytest.raises(ValueError, match="missing"):
+            po._parse_examples_fast(
+                serialized, {"z": po.FixedLenFeature([2], stf.int64)})
+        # wrong size -> InvalidArgumentError (canonical code mapping)
+        with _pytest.raises(stf.errors.InvalidArgumentError,
+                            match="values|expected"):
+            po._parse_examples_fast(
+                serialized, {"x": po.FixedLenFeature([3], stf.float32)})
+        # declared-kind mismatch reads as MISSING (slow-path semantics):
+        # default applies when present, missing-error otherwise
+        got = po._parse_examples_fast(
+            serialized, {"x": po.FixedLenFeature([4], stf.int64,
+                                                 default_value=[0] * 4)})
+        np.testing.assert_array_equal(got["x"][0], [0, 0, 0, 0])
+        with _pytest.raises(ValueError, match="missing"):
+            po._parse_examples_fast(
+                serialized, {"x": po.FixedLenFeature([4], stf.int64)})
+        # malformed proto
+        with _pytest.raises(stf.errors.InvalidArgumentError,
+                            match="malformed"):
+            po._parse_examples_fast(
+                [b"\x0a\xff\xff\xff\xff\xff"],
+                {"x": po.FixedLenFeature([4], stf.float32)})
+        # bad default length names the feature
+        with _pytest.raises(ValueError, match="default_value"):
+            po._parse_examples_fast(
+                serialized, {"z": po.FixedLenFeature(
+                    [2], stf.int64, default_value=[1, 2, 3])})
+        # >64 features falls back to the slow path (returns None)
+        many = {f"f{i}": po.FixedLenFeature([1], stf.int64,
+                                            default_value=[0])
+                for i in range(70)}
+        assert po._parse_examples_fast(serialized, many) is None
+        # string / VarLen specs decline the fast path (None, no crash)
+        assert po._parse_examples_fast(
+            serialized, {"s": po.FixedLenFeature([1], stf.string)}) is None
+        assert po._parse_examples_fast(
+            serialized, {"x": po.VarLenFeature(stf.float32)}) is None
+
+    def test_graph_parse_example_uses_it(self):
+        # end to end through the graph op (fast path engages silently)
+        import simple_tensorflow_tpu.ops.parsing_ops as po
+
+        stf.reset_default_graph()
+        serialized = self._examples(4)
+        ph = stf.placeholder(stf.string, [None], name="ser")
+        parsed = stf.parse_example(
+            ph, {"x": po.FixedLenFeature([4], stf.float32),
+                 "y": po.FixedLenFeature([1], stf.int64)})
+        total = stf.reduce_sum(parsed["x"])
+        with stf.Session() as sess:
+            xv, tv = sess.run(
+                [parsed["x"], total],
+                {ph: np.array(serialized, dtype=object)})
+        assert xv.shape == (4, 4)
+        np.testing.assert_allclose(xv[2], [2., 3., 4., 5.])
