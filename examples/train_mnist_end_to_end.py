@@ -27,7 +27,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import simple_tensorflow_tpu as stf  # noqa: E402
-from simple_tensorflow_tpu.lib.example import Example, make_example  # noqa: E402
+from simple_tensorflow_tpu.lib.example import make_example  # noqa: E402
 from simple_tensorflow_tpu.lib.io.tf_record import TFRecordWriter  # noqa: E402
 
 
@@ -47,20 +47,16 @@ def write_dataset(path, n=512, seed=0):
 
 def input_pipeline(path, batch_size):
     from simple_tensorflow_tpu import data as stf_data
+    from simple_tensorflow_tpu.ops import parsing_ops as po
 
-    def parse(rec):
-        # stf.data map functions run host-side (the reference's input
-        # pipeline is CPU-side too): decode the Example wire format with
-        # the bundled protobuf-wire codec
-        ex = Example.FromString(rec)
-        img = np.asarray(ex.features.feature["image"].float_list.value,
-                         np.float32)
-        lab = np.asarray(ex.features.feature["label"].int64_list.value,
-                         np.int64)
-        return {"image": img, "label": lab}
-
-    ds = stf_data.TFRecordDataset(path).map(parse)
+    # shuffle/repeat raw records, batch them, then parse the WHOLE batch
+    # in one native C++ call (runtime_cc/example_parse.cc — the
+    # fast-parse idiom of the reference's input pipeline)
+    spec = {"image": po.FixedLenFeature([784], stf.float32),
+            "label": po.FixedLenFeature([], stf.int64)}  # scalar -> (B,)
+    ds = stf_data.TFRecordDataset(path)
     ds = ds.shuffle(256, seed=7).repeat().batch(batch_size)
+    ds = ds.parse_example(spec)
     ds = ds.prefetch_to_device(buffer_size=2)
     return ds.make_one_shot_iterator()
 

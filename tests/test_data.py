@@ -152,3 +152,39 @@ class TestDatasetDictStructures:
                           config=est.RunConfig(save_checkpoints_steps=2))
         e.train(input_fn, steps=5)
         assert stf.train.latest_checkpoint(str(tmp_path)) is not None
+
+
+class TestDatasetParseExample:
+    def test_batched_parse_pipeline(self, tmp_path):
+        from simple_tensorflow_tpu.lib.io import tf_record
+        from simple_tensorflow_tpu.lib.example import make_example
+        import simple_tensorflow_tpu.ops.parsing_ops as po
+
+        path = str(tmp_path / "p.tfrecord")
+        with tf_record.TFRecordWriter(path) as w:
+            for i in range(10):
+                w.write(make_example(
+                    x=[float(i), float(i) + 0.5],
+                    y=[i]).SerializeToString())
+        spec = {"x": po.FixedLenFeature([2], stf.float32),
+                "y": po.FixedLenFeature([1], stf.int64)}
+        ds = stf_data.TFRecordDataset(path).batch(4).parse_example(spec)
+        batches = list(ds)
+        assert len(batches) == 2  # drop_remainder
+        assert batches[0]["x"].shape == (4, 2)
+        np.testing.assert_allclose(batches[1]["x"][0], [4.0, 4.5])
+        np.testing.assert_array_equal(batches[0]["y"].ravel(),
+                                      [0, 1, 2, 3])
+
+    def test_unbatched_parse_single_records(self, tmp_path):
+        from simple_tensorflow_tpu.lib.io import tf_record
+        from simple_tensorflow_tpu.lib.example import make_example
+        import simple_tensorflow_tpu.ops.parsing_ops as po
+
+        path = str(tmp_path / "q.tfrecord")
+        with tf_record.TFRecordWriter(path) as w:
+            w.write(make_example(v=[7.0]).SerializeToString())
+        spec = {"v": po.FixedLenFeature([1], stf.float32)}
+        rows = list(stf_data.TFRecordDataset(path).parse_example(spec))
+        assert len(rows) == 1
+        np.testing.assert_allclose(rows[0]["v"], [7.0])
