@@ -175,9 +175,19 @@ class Dataset:
             # bytes round-trips; real pipelines carry bytes already
             return s.encode("latin1") if isinstance(s, str) else bytes(s)
 
+        has_varlen = any(not isinstance(s, parsing_ops.FixedLenFeature)
+                         for s in features.values())
+
         def gen():
             for x in src():
                 if isinstance(x, (bytes, np.bytes_, str, np.str_)):
+                    if has_varlen:
+                        raise ValueError(
+                            "Dataset.parse_example with VarLenFeature "
+                            "needs batched elements (its output is a "
+                            "batch-level COO triple): call "
+                            ".batch(n).parse_example(spec), and do not "
+                            "re-batch the parsed sparse values.")
                     parsed = parsing_ops.parse_example_py(
                         [as_proto_bytes(x)], features)
                     yield {k: v[0] if not isinstance(v, tuple) else v

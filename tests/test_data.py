@@ -188,3 +188,24 @@ class TestDatasetParseExample:
         rows = list(stf_data.TFRecordDataset(path).parse_example(spec))
         assert len(rows) == 1
         np.testing.assert_allclose(rows[0]["v"], [7.0])
+
+    def test_varlen_needs_batched_elements(self, tmp_path):
+        from simple_tensorflow_tpu.lib.io import tf_record
+        from simple_tensorflow_tpu.lib.example import make_example
+        import simple_tensorflow_tpu.ops.parsing_ops as po
+
+        path = str(tmp_path / "v.tfrecord")
+        with tf_record.TFRecordWriter(path) as w:
+            w.write(make_example(t=[1, 2, 3]).SerializeToString())
+            w.write(make_example(t=[4]).SerializeToString())
+        spec = {"t": po.VarLenFeature(stf.int64)}
+        # unbatched: actionable error
+        with pytest.raises(ValueError, match="batch"):
+            list(stf_data.TFRecordDataset(path).parse_example(spec))
+        # batched: proper batch-level COO triple
+        (out,) = list(stf_data.TFRecordDataset(path).batch(2)
+                      .parse_example(spec))
+        idx, vals, shape = out["t"]
+        np.testing.assert_array_equal(shape, [2, 3])
+        np.testing.assert_array_equal(vals, [1, 2, 3, 4])
+        np.testing.assert_array_equal(idx[:3, 0], [0, 0, 0])
