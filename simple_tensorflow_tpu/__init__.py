@@ -202,4 +202,66 @@ from .client import timeline
 # gradient checker
 from .framework.gradient_checker import compute_gradient, compute_gradient_error
 
+
+# round-4 reference-parity exports (@@-export sweep vs the reference's
+# python/{ops,framework,client,training} public names)
+from .ops.string_ops import (
+    string_join, string_lower, string_upper, string_strip, string_length,
+    substr, as_string, string_to_number, string_to_hash_bucket,
+    string_to_hash_bucket_fast, string_to_hash_bucket_strong,
+    regex_replace, encode_base64, decode_base64, string_split, reduce_join,
+)
+from .ops.sparse_ops import (
+    sparse_to_dense, sparse_tensor_to_dense, sparse_tensor_dense_matmul,
+    sparse_add, sparse_reduce_sum, sparse_retain, sparse_reorder,
+    sparse_slice, sparse_concat, sparse_placeholder, sparse_mask,
+    sparse_reshape, sparse_transpose, sparse_split,
+    sparse_fill_empty_rows, sparse_reset_shape, sparse_to_indicator,
+    sparse_merge, sparse_softmax, sparse_maximum, sparse_minimum,
+    sparse_reduce_sum_sparse,
+)
+from .ops.array_ops import (
+    broadcast_static_shape, broadcast_dynamic_shape, parallel_stack,
+    space_to_batch, batch_to_space, unique_with_counts,
+)
+from .ops.math_ops import (
+    floor_div, truncatediv, truncatemod, complex,  # noqa: A004
+    sparse_segment_sum, sparse_segment_mean, sparse_segment_sqrt_n,
+)
+from .ops.check_ops import (
+    assert_none_equal, assert_proper_iterable, is_numeric_tensor,
+    is_non_decreasing, is_strictly_increasing,
+)
+from .ops.spectral_ops import rfft, irfft, rfft2d, irfft2d, rfft3d, irfft3d
+from .ops.variable_scope import (
+    get_local_variable, fixed_size_partitioner,
+    variable_axis_size_partitioner, min_max_variable_partitioner,
+)
+from .ops.state_ops import scatter_nd_add, scatter_nd_sub
+from .ops.lookup_ops import initialize_all_tables
+from .ops.session_ops import get_session_handle_v2
+from .ops.parsing_ops import (
+    FixedLenSequenceFeature, SparseFeature, decode_csv, parse_tensor,
+    serialize_tensor, decode_json_example,
+)
+from .ops.misc_ops import remove_squeezable_dimensions
+from .ops.linalg_ops import cholesky_solve, matrix_solve_ls
+from .ops.quantization_ops import (
+    quantized_concat, fake_quant_with_min_max_vars_per_channel_gradient,
+)
+from .platform.resource_loader import (
+    load_op_library, load_file_system_library,
+)
+from .ops.data_flow_ops import ConditionalAccumulatorBase
+from .framework.graph import (
+    convert_to_tensor_or_indexed_slices, convert_to_tensor_or_sparse_tensor,
+    op_scope,
+)
+from .framework.graph_io import import_graph_def, import_meta_graph, \
+    export_meta_graph, write_graph
+from .framework.gradients import (
+    RegisterGradient, NotDifferentiable, NoGradient, hessians,
+)
+from .framework.random_seed import get_seed
+
 newaxis = None

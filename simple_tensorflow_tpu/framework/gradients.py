@@ -40,6 +40,103 @@ def _as_tensor_list(x):
     return [x]
 
 
+_GRADIENT_REGISTRY = {}
+_NOT_DIFFERENTIABLE = set()
+
+
+class RegisterGradient:
+    """Decorator registering a gradient function under a name (ref:
+    python/framework/ops.py ``RegisterGradient``). Used with
+    ``graph.gradient_override_map({"OpType": "Name"})``: ops of that type
+    created inside the map differentiate through ``fn(op, *grads)``
+    instead of their normal vjp. The fn builds stf graph ops from
+    ``op.inputs``/``op.outputs``; it is traced once into a FuncGraph and
+    lowered inside the backward pass."""
+
+    def __init__(self, op_type):
+        self._name = op_type
+
+    def __call__(self, fn):
+        _GRADIENT_REGISTRY[self._name] = fn
+        return fn
+
+
+def NotDifferentiable(op_type):  # noqa: N802 — TF-1 API name
+    """Mark an op type as non-differentiable: its outputs carry zero
+    cotangents (ref: ops.py ``NotDifferentiable``)."""
+    _NOT_DIFFERENTIABLE.add(op_type)
+
+
+NoGradient = NotDifferentiable  # deprecated TF-1 alias
+
+
+def _execute_with_override(child, op, grad_type, lowering):
+    """Run ``op`` in the forward replay under a jax.custom_vjp whose
+    backward lowers the registered gradient FuncGraph."""
+    import jax
+    import jax.numpy as jnp
+
+    grad_fn = _GRADIENT_REGISTRY[grad_type]
+    opdef = op.op_def
+    if opdef.is_stateful or opdef.runs_on_host:
+        raise errors_mod.InvalidArgumentError(
+            None, op,
+            f"gradient_override_map on stateful/host op {op.type} is not "
+            "supported (override pure compute ops only)")
+    fg = op.attrs.get("_override_fg")
+    if fg is None:
+        from . import function as function_mod
+        from ..ops import array_ops
+
+        def traced(*gys):
+            res = grad_fn(op, *gys)
+            flat = list(res) if isinstance(res, (list, tuple)) else [res]
+            if len(flat) != len(op.inputs):
+                raise ValueError(
+                    f"@RegisterGradient({grad_type!r}) returned "
+                    f"{len(flat)} gradients for {len(op.inputs)} inputs "
+                    f"of {op.name}")
+            return [g if g is not None else array_ops.zeros_like(x)
+                    for g, x in zip(flat, op.inputs)]
+
+        fg = function_mod._trace_body(
+            op.graph, traced, f"{op.name}_override_grad",
+            [(o.shape, o.dtype) for o in op.outputs])
+        op.attrs["_override_fg"] = fg
+
+    invals = [child.value_of(t) for t in op.inputs]
+
+    @jax.custom_vjp
+    def f(*xs):
+        return tuple(opdef.lower(child, op, list(xs)))
+
+    def f_fwd(*xs):
+        outs = tuple(opdef.lower(child, op, list(xs)))
+        tmp = dict(zip(op.inputs, xs))
+        tmp.update(zip(op.outputs, outs))
+        cap_vals = []
+        for outer, _ in fg.captures:
+            if outer in tmp:
+                cap_vals.append(tmp[outer])
+            else:
+                cap_vals.append(child.value_of(outer))
+        return outs, (xs, tuple(cap_vals))
+
+    def f_bwd(res, gys):
+        xs, cap_vals = res
+        ctx2 = lowering.LoweringContext({}, rng_root=None)
+        grads = lowering.lower_func_graph(ctx2, fg, list(gys),
+                                          list(cap_vals))
+        return tuple(
+            gr if gr is not None else jnp.zeros_like(x)
+            for gr, x in zip(grads, xs))
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*invals)
+    for t, v in zip(op.outputs, outs):
+        child.env[t] = v
+
+
 def _while_reaches_ys_differentiably(while_op, ys, stop_set):
     """True iff a While op's output can carry a nonzero cotangent from ys.
 
@@ -179,7 +276,17 @@ def _lower_symbolic_gradient(ctx, op, input_values):
         child = ctx.child(env)
         child.alias = {}
         for path_op in path_ops:
-            lowering_mod.execute_ops(child, [path_op], fed=xset)
+            grad_type = path_op.attrs.get("_gradient_op_type")
+            if grad_type is not None and grad_type in _GRADIENT_REGISTRY:
+                _execute_with_override(child, path_op, grad_type,
+                                       lowering_mod)
+            else:
+                lowering_mod.execute_ops(child, [path_op], fed=xset)
+            if path_op.type in _NOT_DIFFERENTIABLE:
+                for out in path_op.outputs:
+                    if out in child.env:
+                        child.env[out] = jax.lax.stop_gradient(
+                            child.env[out])
             if stop_set:
                 for out in path_op.outputs:
                     if out in stop_set and out in child.env:
@@ -235,3 +342,62 @@ class AggregationMethod:
     DEFAULT = ADD_N
     EXPERIMENTAL_TREE = 1
     EXPERIMENTAL_ACCUMULATE_N = 2
+
+
+def hessians(ys, xs, name="hessians", colocate_gradients_with_ops=False,
+             gate_gradients=False, aggregation_method=None):
+    """Full Hessian of the scalar ``ys`` w.r.t. each x (ref:
+    gradients_impl.py ``hessians``): output shapes x.shape + x.shape.
+    Lowers to ``jax.hessian`` over the forward slice — forward-over-
+    reverse in ONE XLA program (the reference builds gradients-of-
+    gradients graphs node by node)."""
+    ys_l = _as_tensor_list(ys)
+    if len(ys_l) != 1:
+        raise ValueError("hessians: ys must be a single scalar tensor")
+    y = ys_l[0]
+    if y.shape.rank not in (0, None):
+        raise ValueError(f"hessians: ys must be scalar, got {y.shape}")
+    xs_in = _as_tensor_list(xs)
+    g = ops_mod.get_default_graph()
+    outs = []
+    with g.name_scope(name):
+        for x in xs_in:
+            xt = x._grad_anchor() if hasattr(x, "_grad_anchor") else x
+            from . import tensor_shape as shape_mod
+
+            hshape = (shape_mod.TensorShape(
+                (xt.shape.as_list() or []) + (xt.shape.as_list() or []))
+                if xt.shape.rank is not None
+                else shape_mod.TensorShape(None))
+            op = g.create_op("SymbolicHessian", [y, xt], attrs={},
+                             name="hess",
+                             output_specs=[(hshape,
+                                            xt.dtype.base_dtype)])
+            outs.append(op.outputs[0])
+    return outs
+
+
+def _lower_symbolic_hessian(ctx, op, input_values):
+    import jax
+
+    y, x = op.inputs[0], op.inputs[1]
+    _yv, xv = input_values
+    path_ops, _ = lowering_mod.ancestors_between([x], [y])
+    path_set = set(path_ops)
+
+    def forward(xval):
+        env = {t: v for t, v in ctx.env.items() if t.op not in path_set}
+        for dup, canon in ctx.alias.items():
+            if dup.op not in path_set and canon in env:
+                env.setdefault(dup, env[canon])
+        env[x] = xval
+        child = ctx.child(env)
+        child.alias = {}
+        lowering_mod.execute_ops(child, path_ops, fed={x})
+        return child.env[y]
+
+    return [jax.hessian(forward)(xv)]
+
+
+op_registry.register("SymbolicHessian", lower=_lower_symbolic_hessian,
+                     n_outputs=1)

@@ -350,6 +350,20 @@ class Graph:
         finally:
             self._name_stack = old
 
+    @contextlib.contextmanager
+    def gradient_override_map(self, op_type_map):
+        """(ref: python/framework/ops.py ``Graph.gradient_override_map``):
+        within the context, ops of the mapped types differentiate through
+        the @stf.RegisterGradient function of the mapped name instead of
+        their normal gradient."""
+        stack = self._scoped_state.setdefault("__grad_override_stack__",
+                                              [])
+        stack.append(dict(op_type_map))
+        try:
+            yield
+        finally:
+            stack.pop()
+
     # -- scopes --------------------------------------------------------------
     @contextlib.contextmanager
     def control_dependencies(self, control_inputs):
@@ -487,6 +501,16 @@ class Graph:
         if self._finalized:
             raise RuntimeError("Graph is finalized and cannot be modified.")
         attrs = attrs or {}
+        # gradient_override_map (ref: ops.py Graph.gradient_override_map):
+        # ops created inside the context carry _gradient_op_type, which the
+        # SymbolicGradient replay routes through @RegisterGradient fns
+        override = self._scoped_state.get("__grad_override_stack__")
+        if override:
+            for m in reversed(override):
+                if op_type in m:
+                    attrs = dict(attrs)
+                    attrs["_gradient_op_type"] = m[op_type]
+                    break
         if name and name.endswith("/"):
             # TF convention: a trailing slash means "use this exact
             # (already-scoped, already-unique) name" — used by Variable and
@@ -845,3 +869,23 @@ class TensorSpec:
 
     def __repr__(self):
         return f"TensorSpec(shape={self.shape}, dtype={self.dtype.name}, name={self.name!r})"
+
+
+def convert_to_tensor_or_sparse_tensor(value, dtype=None, name=None):
+    """(ref: framework/sparse_tensor.py
+    ``convert_to_tensor_or_sparse_tensor``)."""
+    from .sparse_tensor import SparseTensor, SparseTensorValue
+
+    if isinstance(value, SparseTensor):
+        return value
+    if isinstance(value, SparseTensorValue):
+        return SparseTensor.from_value(value)
+    return convert_to_tensor(value, dtype=dtype, name=name)
+
+
+@contextlib.contextmanager
+def op_scope(values, name, default_name=None):
+    """Deprecated TF-1.0 scope (ref: ops.py ``op_scope``) — name_scope
+    with the legacy argument order."""
+    with name_scope(name, default_name, values) as scope:
+        yield scope

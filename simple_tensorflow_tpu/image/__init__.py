@@ -11,4 +11,7 @@ from ..ops.image_ops import (
     convert_image_dtype, decode_png, encode_png, decode_jpeg, encode_jpeg,
     decode_image, random_crop, total_variation,
     sample_distorted_bounding_box,
+    non_max_suppression, draw_bounding_boxes, resize_area, resize_bicubic,
+    random_hue, random_saturation, crop_and_resize, extract_glimpse,
+    decode_gif,
 )

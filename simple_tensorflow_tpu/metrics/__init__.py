@@ -259,3 +259,314 @@ def percentage_below(values, threshold, weights=None, **kw):
     below = math_ops.cast(math_ops.less(
         values, ops_mod.convert_to_tensor(float(threshold))), "float32")
     return mean(below, weights)
+
+
+# -- round-4 parity fills (the rest of ref metrics_impl.py) ------------------
+
+def mean_squared_error(labels, predictions, weights=None,
+                       metrics_collections=None, updates_collections=None,
+                       name=None):
+    """(ref: metrics_impl.py ``mean_squared_error``)."""
+    with ops_mod.name_scope(name, "mse"):
+        return mean(math_ops.squared_difference(
+            math_ops.cast(ops_mod.convert_to_tensor(predictions),
+                          "float32"),
+            math_ops.cast(ops_mod.convert_to_tensor(labels), "float32")),
+            weights, metrics_collections, updates_collections)
+
+
+def mean_relative_error(labels, predictions, normalizer, weights=None,
+                        metrics_collections=None, updates_collections=None,
+                        name=None):
+    """(ref: metrics_impl.py ``mean_relative_error``)."""
+    with ops_mod.name_scope(name, "mean_relative_error"):
+        labels = math_ops.cast(ops_mod.convert_to_tensor(labels),
+                               "float32")
+        predictions = math_ops.cast(
+            ops_mod.convert_to_tensor(predictions), "float32")
+        norm = math_ops.cast(ops_mod.convert_to_tensor(normalizer),
+                             "float32")
+        rel = math_ops.abs(predictions - labels) / math_ops.maximum(
+            math_ops.abs(norm), ops_mod.convert_to_tensor(1e-12))
+        return mean(rel, weights, metrics_collections,
+                    updates_collections)
+
+
+def mean_cosine_distance(labels, predictions, dim, weights=None,
+                         metrics_collections=None, updates_collections=None,
+                         name=None):
+    """(ref: metrics_impl.py ``mean_cosine_distance``): 1 - cos similarity
+    along ``dim`` (inputs assumed unit-normalized, ref contract)."""
+    with ops_mod.name_scope(name, "mean_cosine_distance"):
+        labels = math_ops.cast(ops_mod.convert_to_tensor(labels),
+                               "float32")
+        predictions = math_ops.cast(
+            ops_mod.convert_to_tensor(predictions), "float32")
+        sim = math_ops.reduce_sum(labels * predictions, axis=dim)
+        return mean(1.0 - sim, weights, metrics_collections,
+                    updates_collections)
+
+
+def mean_tensor(values, weights=None, metrics_collections=None,
+                updates_collections=None, name=None):
+    """(ref: metrics_impl.py ``mean_tensor``): elementwise running mean —
+    the accumulators keep the VALUE's shape."""
+    with ops_mod.name_scope(name, "mean_tensor"):
+        values = math_ops.cast(ops_mod.convert_to_tensor(values),
+                               "float32")
+        shape = [int(d) for d in values.shape.as_list()]
+        total = _metric_variable(shape, "total_tensor")
+        count = _metric_variable(shape, "count_tensor")
+        ones = array_ops.ones_like(values)
+        if weights is not None:
+            w = math_ops.cast(ops_mod.convert_to_tensor(weights),
+                              "float32")
+            values = values * w
+            ones = ones * w
+        upd_t = state_ops.assign_add(total._ref, values)
+        upd_c = state_ops.assign_add(count._ref, ones)
+        eps = ops_mod.convert_to_tensor(1e-12)
+        value = total._ref / math_ops.maximum(count._ref, eps)
+        update_op = upd_t / math_ops.maximum(upd_c, eps)
+        if metrics_collections:
+            ops_mod.add_to_collections(metrics_collections, value)
+        if updates_collections:
+            ops_mod.add_to_collections(updates_collections, update_op)
+        return value, update_op
+
+
+def mean_per_class_accuracy(labels, predictions, num_classes, weights=None,
+                            metrics_collections=None,
+                            updates_collections=None, name=None):
+    """(ref: metrics_impl.py ``mean_per_class_accuracy``)."""
+    with ops_mod.name_scope(name, "mean_per_class_accuracy"):
+        total_v = _metric_variable([num_classes], "per_class_total")
+        correct_v = _metric_variable([num_classes], "per_class_correct")
+        labels_f = array_ops.reshape(math_ops.cast(
+            ops_mod.convert_to_tensor(labels), "int32"), [-1])
+        preds_f = array_ops.reshape(math_ops.cast(
+            ops_mod.convert_to_tensor(predictions), "int32"), [-1])
+        ones = array_ops.ones_like(math_ops.cast(labels_f, "float32"))
+        if weights is not None:
+            ones = ones * array_ops.reshape(math_ops.cast(
+                ops_mod.convert_to_tensor(weights), "float32"), [-1])
+        is_correct = math_ops.cast(math_ops.equal(labels_f, preds_f),
+                                   "float32") * ones
+        totals = math_ops.unsorted_segment_sum(ones, labels_f, num_classes)
+        corrects = math_ops.unsorted_segment_sum(is_correct, labels_f,
+                                                 num_classes)
+        upd_t = state_ops.assign_add(total_v._ref, totals)
+        upd_c = state_ops.assign_add(correct_v._ref, corrects)
+
+        def compute(tot, cor):
+            eps = ops_mod.convert_to_tensor(1e-12)
+            valid = math_ops.cast(math_ops.greater(tot, eps), "float32")
+            acc = cor / math_ops.maximum(tot, eps)
+            return math_ops.reduce_sum(acc * valid) / math_ops.maximum(
+                math_ops.reduce_sum(valid),
+                ops_mod.convert_to_tensor(1.0))
+
+        return compute(total_v._ref, correct_v._ref), compute(upd_t, upd_c)
+
+
+def _thresholded_counts(labels, predictions, thresholds, weights):
+    import numpy as np
+
+    from ..framework import constant_op
+
+    labels = math_ops.cast(ops_mod.convert_to_tensor(labels), "float32")
+    predictions = math_ops.cast(ops_mod.convert_to_tensor(predictions),
+                                "float32")
+    th = constant_op.constant(
+        np.asarray(list(thresholds), np.float32).reshape(-1, 1))
+    p = array_ops.reshape(predictions, [1, -1])
+    l_ = array_ops.reshape(labels, [1, -1])
+    if weights is not None:
+        w = array_ops.reshape(math_ops.cast(
+            ops_mod.convert_to_tensor(weights), "float32"), [1, -1])
+    else:
+        w = array_ops.ones_like(l_)
+    pred_pos = math_ops.cast(math_ops.greater(p, th), "float32")
+    tp = math_ops.reduce_sum(pred_pos * l_ * w, axis=1)
+    fp = math_ops.reduce_sum(pred_pos * (1 - l_) * w, axis=1)
+    fn = math_ops.reduce_sum((1 - pred_pos) * l_ * w, axis=1)
+    tn = math_ops.reduce_sum((1 - pred_pos) * (1 - l_) * w, axis=1)
+    return tp, fp, fn, tn
+
+
+def _at_thresholds(which):
+    def metric(labels, predictions, thresholds, weights=None,
+               metrics_collections=None, updates_collections=None,
+               name=None):
+        with ops_mod.name_scope(name, f"{which}_at_thresholds"):
+            n = len(list(thresholds))
+            tp_v = _metric_variable([n], "tp")
+            fp_v = _metric_variable([n], "fp")
+            fn_v = _metric_variable([n], "fn")
+            tn_v = _metric_variable([n], "tn")
+            tp, fp, fn, tn = _thresholded_counts(labels, predictions,
+                                                 thresholds, weights)
+            upd = {"tp": state_ops.assign_add(tp_v._ref, tp),
+                   "fp": state_ops.assign_add(fp_v._ref, fp),
+                   "fn": state_ops.assign_add(fn_v._ref, fn),
+                   "tn": state_ops.assign_add(tn_v._ref, tn)}
+            cur = {"tp": tp_v._ref, "fp": fp_v._ref, "fn": fn_v._ref,
+                   "tn": tn_v._ref}
+
+            def ratio(v):
+                eps = ops_mod.convert_to_tensor(1e-12)
+                if which == "precision":
+                    return v["tp"] / math_ops.maximum(v["tp"] + v["fp"],
+                                                      eps)
+                return v["tp"] / math_ops.maximum(v["tp"] + v["fn"], eps)
+
+            value, update_op = ratio(cur), ratio(upd)
+            if metrics_collections:
+                ops_mod.add_to_collections(metrics_collections, value)
+            if updates_collections:
+                ops_mod.add_to_collections(updates_collections, update_op)
+            return value, update_op
+
+    return metric
+
+
+precision_at_thresholds = _at_thresholds("precision")
+recall_at_thresholds = _at_thresholds("recall")
+
+
+def _at_operating_point(fix_which):
+    """sensitivity_at_specificity / specificity_at_sensitivity (ref:
+    metrics_impl.py): sweep thresholds, pick the one whose fixed metric is
+    closest to the target, report the other there."""
+
+    def metric(labels, predictions, target, weights=None,
+               num_thresholds=200, metrics_collections=None,
+               updates_collections=None, name=None):
+        with ops_mod.name_scope(name, f"at_{fix_which}"):
+            kepsilon = 1e-7
+            thresholds = [(i + 1) * 1.0 / (num_thresholds - 1)
+                          for i in range(num_thresholds - 2)]
+            thresholds = [0.0 - kepsilon] + thresholds + [1.0 + kepsilon]
+            n = len(thresholds)
+            tp_v = _metric_variable([n], "tp")
+            fp_v = _metric_variable([n], "fp")
+            fn_v = _metric_variable([n], "fn")
+            tn_v = _metric_variable([n], "tn")
+            tp, fp, fn, tn = _thresholded_counts(labels, predictions,
+                                                 thresholds, weights)
+            upd = [state_ops.assign_add(tp_v._ref, tp),
+                   state_ops.assign_add(fp_v._ref, fp),
+                   state_ops.assign_add(fn_v._ref, fn),
+                   state_ops.assign_add(tn_v._ref, tn)]
+
+            def compute(tp, fp, fn, tn):
+                eps = ops_mod.convert_to_tensor(kepsilon)
+                sens = tp / math_ops.maximum(tp + fn, eps)
+                spec = tn / math_ops.maximum(tn + fp, eps)
+                fixed = spec if fix_which == "specificity" else sens
+                other = sens if fix_which == "specificity" else spec
+                best = math_ops.argmin(
+                    math_ops.abs(fixed
+                                 - ops_mod.convert_to_tensor(
+                                     float(target))), 0)
+                from ..ops import array_ops as ao
+
+                return ao.gather(other, best)
+
+            return compute(tp_v._ref, fp_v._ref, fn_v._ref, tn_v._ref), \
+                compute(*upd)
+
+    return metric
+
+
+sensitivity_at_specificity = _at_operating_point("specificity")
+specificity_at_sensitivity = _at_operating_point("sensitivity")
+
+
+def _in_top_k_hits(labels, predictions, k):
+    """hit[i] = 1 if labels[i] is among the top-k predictions of row i."""
+    labels_i = array_ops.reshape(math_ops.cast(
+        ops_mod.convert_to_tensor(labels), "int32"), [-1])
+    predictions = math_ops.cast(
+        ops_mod.convert_to_tensor(predictions), "float32")
+    from ..ops import nn_ops
+
+    hits = nn_ops.in_top_k(predictions, labels_i, k)
+    return math_ops.cast(hits, "float32")
+
+
+def recall_at_k(labels, predictions, k, weights=None,
+                metrics_collections=None, updates_collections=None,
+                name=None, class_id=None):
+    """(ref: metrics_impl.py ``recall_at_k``, single-label case: the
+    fraction of examples whose true class is in the top-k). With
+    ``class_id`` set, restricted to examples whose label IS that class
+    (ref per-class recall)."""
+    with ops_mod.name_scope(name, f"recall_at_{k}"):
+        hits = _in_top_k_hits(labels, predictions, k)
+        if class_id is not None:
+            labels_i = array_ops.reshape(math_ops.cast(
+                ops_mod.convert_to_tensor(labels), "int32"), [-1])
+            mask = math_ops.cast(
+                math_ops.equal(labels_i,
+                               ops_mod.convert_to_tensor(int(class_id))),
+                "float32")
+            weights = mask if weights is None else mask * math_ops.cast(
+                ops_mod.convert_to_tensor(weights), "float32")
+        return mean(hits, weights, metrics_collections,
+                    updates_collections)
+
+
+def sparse_precision_at_k(labels, predictions, k, weights=None,
+                          metrics_collections=None,
+                          updates_collections=None, name=None,
+                          class_id=None):
+    """(ref: metrics_impl.py ``sparse_precision_at_k``, single-label:
+    hits/k per example). With ``class_id``: among examples whose top-k
+    CONTAINS the class, the fraction whose label IS it (ref per-class
+    precision@k)."""
+    with ops_mod.name_scope(name, f"precision_at_{k}"):
+        if class_id is None:
+            hits = _in_top_k_hits(labels, predictions, k) / float(k)
+            return mean(hits, weights, metrics_collections,
+                        updates_collections)
+        from ..ops import nn_ops
+
+        predictions = math_ops.cast(
+            ops_mod.convert_to_tensor(predictions), "float32")
+        labels_i = array_ops.reshape(math_ops.cast(
+            ops_mod.convert_to_tensor(labels), "int32"), [-1])
+        _v, idx = nn_ops.top_k(predictions, k)
+        cid = ops_mod.convert_to_tensor(int(class_id))
+        in_topk = math_ops.cast(math_ops.reduce_any(
+            math_ops.equal(idx, cid), axis=1), "float32")
+        correct = math_ops.cast(math_ops.equal(labels_i, cid), "float32")
+        w = in_topk if weights is None else in_topk * math_ops.cast(
+            ops_mod.convert_to_tensor(weights), "float32")
+        return mean(correct, w, metrics_collections, updates_collections)
+
+
+def sparse_average_precision_at_k(labels, predictions, k, weights=None,
+                                  metrics_collections=None,
+                                  updates_collections=None, name=None):
+    """(ref: metrics_impl.py ``sparse_average_precision_at_k``,
+    single-label: precision at the hit rank, 0 on miss)."""
+    with ops_mod.name_scope(name, f"average_precision_at_{k}"):
+        predictions = math_ops.cast(
+            ops_mod.convert_to_tensor(predictions), "float32")
+        labels_i = array_ops.reshape(math_ops.cast(
+            ops_mod.convert_to_tensor(labels), "int32"), [-1])
+        from ..ops import nn_ops
+
+        _vals, idx = nn_ops.top_k(predictions, k)
+        matches = math_ops.cast(
+            math_ops.equal(idx, array_ops.expand_dims(labels_i, 1)),
+            "float32")
+        import numpy as np
+
+        from ..framework import constant_op
+
+        inv_rank = constant_op.constant(
+            (1.0 / np.arange(1, k + 1)).astype(np.float32))
+        ap = math_ops.reduce_sum(matches * inv_rank, axis=1)
+        return mean(ap, weights, metrics_collections, updates_collections)

@@ -12,6 +12,13 @@ from ..ops.nn_ops import (
     max_pool, avg_pool, max_pool3d, avg_pool3d,
     dropout, local_response_normalization, lrn, in_top_k, top_k,
     xw_plus_b, log_poisson_loss,
+    conv1d, convolution, atrous_conv2d_transpose,
+    conv2d_backprop_input, conv2d_backprop_filter, max_pool_with_argmax,
+    pool, with_space_to_batch, fractional_max_pool, fractional_avg_pool,
+    quantized_conv2d, quantized_relu_x, quantized_max_pool,
+    quantized_avg_pool, conv3d_backprop_filter_v2,
+    depthwise_conv2d_native_backprop_filter,
+    depthwise_conv2d_native_backprop_input,
 )
 from ..ops.nn_impl import (
     moments, weighted_moments, fused_batch_norm, batch_normalization,
@@ -33,4 +40,5 @@ from ..ops.candidate_sampling_ops import (
     learned_unigram_candidate_sampler, fixed_unigram_candidate_sampler,
     compute_accidental_hits, all_candidate_sampler,
 )
-from ..ops.ctc_ops import ctc_loss, ctc_greedy_decoder
+from ..ops.ctc_ops import (ctc_loss, ctc_greedy_decoder,
+                           ctc_beam_search_decoder)

@@ -1017,3 +1017,62 @@ def guarantee_const(input, name=None):  # noqa: A002
 
 def newaxis():
     return None
+
+
+# -- round-4 parity fills ----------------------------------------------------
+
+def broadcast_static_shape(shape_x, shape_y):
+    """(ref: array_ops.py ``broadcast_static_shape``)."""
+    a = shape_mod.as_shape(shape_x)
+    b = shape_mod.as_shape(shape_y)
+    if a.rank is None or b.rank is None:
+        return shape_mod.TensorShape(None)
+    out = list(np.broadcast_shapes(
+        tuple(1 if d is None else d for d in a.as_list()),
+        tuple(1 if d is None else d for d in b.as_list())))
+    return shape_mod.TensorShape(out)
+
+
+def broadcast_dynamic_shape(shape_x, shape_y, name=None):
+    """(ref: array_ops.py ``broadcast_dynamic_shape``). Shapes are static
+    on TPU, so this folds at construction when both are constants."""
+    sx = constant_op.constant_value(ops_mod.convert_to_tensor(shape_x))
+    sy = constant_op.constant_value(ops_mod.convert_to_tensor(shape_y))
+    if sx is None or sy is None:
+        raise ValueError("broadcast_dynamic_shape needs static shape "
+                         "tensors on TPU")
+    return constant(np.asarray(np.broadcast_shapes(tuple(sx), tuple(sy)),
+                               np.int32))
+
+
+def parallel_stack(values, name=None):
+    """(ref: array_ops.py ``parallel_stack``) — the parallel/sequential
+    distinction is a CPU-executor scheduling detail; under XLA both
+    compile to the same fused concat."""
+    return stack(values, axis=0, name=name or "parallel_stack")
+
+
+def space_to_batch(input, paddings, block_size, name=None):  # noqa: A002
+    """2D-specialized wrapper (ref: array_ops.py ``space_to_batch``)."""
+    return space_to_batch_nd(input, [block_size, block_size], paddings,
+                             name=name)
+
+
+def batch_to_space(input, crops, block_size, name=None):  # noqa: A002
+    return batch_to_space_nd(input, [block_size, block_size], crops,
+                             name=name)
+
+
+def unique_with_counts(x, out_idx=dtypes_mod.int32, name=None):
+    """(ref: array_ops.py ``unique_with_counts``) — static inputs only
+    (data-dependent output size, tf2xla parity; same rule as unique)."""
+    xv = constant_op.constant_value(ops_mod.convert_to_tensor(x))
+    if xv is None:
+        raise ValueError(
+            "stf.unique_with_counts has a data-dependent output shape; on "
+            "TPU it is only supported for statically-known inputs.")
+    vals, idx, counts = np.unique(xv, return_inverse=True,
+                                  return_counts=True)
+    np_idx = dtypes_mod.as_dtype(out_idx).np_dtype
+    return (constant(vals), constant(idx.astype(np_idx)),
+            constant(counts.astype(np_idx)))

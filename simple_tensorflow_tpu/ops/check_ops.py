@@ -153,3 +153,32 @@ def assert_proper_iterable(values):
 def is_numeric_tensor(tensor):
     return isinstance(tensor, ops_mod.Tensor) and not (
         tensor.dtype.name == "string" or tensor.dtype.is_bool)
+
+
+def is_non_decreasing(x, name=None):
+    """(ref: check_ops.py ``is_non_decreasing``)."""
+    from . import array_ops, math_ops
+
+    x = ops_mod.convert_to_tensor(x)
+    flat = array_ops.reshape(x, [-1])
+    n = flat.shape[0].value
+    if n is not None and n < 2:
+        from ..framework import constant_op
+
+        return constant_op.constant(True)
+    return math_ops.reduce_all(
+        math_ops.greater_equal(flat[1:], flat[:-1]), name=name)
+
+
+def is_strictly_increasing(x, name=None):
+    from . import array_ops, math_ops
+
+    x = ops_mod.convert_to_tensor(x)
+    flat = array_ops.reshape(x, [-1])
+    n = flat.shape[0].value
+    if n is not None and n < 2:
+        from ..framework import constant_op
+
+        return constant_op.constant(True)
+    return math_ops.reduce_all(
+        math_ops.greater(flat[1:], flat[:-1]), name=name)

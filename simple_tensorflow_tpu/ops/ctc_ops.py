@@ -125,3 +125,139 @@ def ctc_greedy_decoder(inputs, sequence_length, merge_repeated=True,
                    attrs={"merge_repeated": merge_repeated,
                           "blank_index": blank_index}, name=name)
     return path
+
+
+def _beam_search_impl(logits, seq_len, beam_width, top_paths, blank,
+                      merge_repeated=False):
+    """Host CTC prefix beam search (ref: core/util/ctc/
+    ctc_beam_search.h — a CPU kernel in the reference too; decode lengths
+    are data-dependent)."""
+    T, B, C = logits.shape
+    logp = logits - _logsumexp(logits)
+    results = []
+    for b in builtins.range(B):
+        # beams: prefix tuple -> (logp_blank, logp_nonblank)
+        beams = {(): (0.0, -np.inf)}
+        for t in builtins.range(int(seq_len[b])):
+            new = {}
+            lp = logp[t, b]
+            for prefix, (pb, pnb) in beams.items():
+                total = np.logaddexp(pb, pnb)
+                # extend with blank
+                nb_pb, nb_pnb = new.get(prefix, (-np.inf, -np.inf))
+                new[prefix] = (np.logaddexp(nb_pb, total + lp[blank]),
+                               nb_pnb)
+                for c in builtins.range(C):
+                    if c == blank:
+                        continue
+                    np_prefix = prefix + (c,)
+                    e_pb, e_pnb = new.get(np_prefix, (-np.inf, -np.inf))
+                    if prefix and prefix[-1] == c:
+                        # repeat: must cross a blank to extend
+                        new[np_prefix] = (e_pb,
+                                          np.logaddexp(e_pnb,
+                                                       pb + lp[c]))
+                        # same-prefix repeat merge
+                        s_pb, s_pnb = new.get(prefix, (-np.inf, -np.inf))
+                        new[prefix] = (s_pb,
+                                       np.logaddexp(s_pnb, pnb + lp[c]))
+                    else:
+                        new[np_prefix] = (e_pb,
+                                          np.logaddexp(e_pnb,
+                                                       total + lp[c]))
+            beams = dict(sorted(
+                new.items(),
+                key=lambda kv: -np.logaddexp(kv[1][0], kv[1][1])
+            )[:beam_width])
+        ranked = sorted(beams.items(),
+                        key=lambda kv: -np.logaddexp(kv[1][0], kv[1][1]))
+
+        def _collapse(p):
+            if not merge_repeated:
+                return list(p)
+            out = []
+            for c in p:
+                if not out or out[-1] != c:
+                    out.append(c)
+            return out
+
+        paths = [(_collapse(p), float(np.logaddexp(*v)))
+                 for p, v in ranked[:top_paths]]
+        while builtins.len(paths) < top_paths:
+            paths.append(([], -np.inf))
+        results.append(paths)
+    # COO sparse outputs per path rank (ref output contract)
+    out = []
+    for k in builtins.range(top_paths):
+        indices, values = [], []
+        max_len = 0
+        for b in builtins.range(B):
+            seq = results[b][k][0]
+            max_len = builtins.max(max_len, builtins.len(seq))
+            for j, c in builtins.enumerate(seq):
+                indices.append((b, j))
+                values.append(c)
+        out.append((np.asarray(indices, np.int64).reshape(-1, 2),
+                    np.asarray(values, np.int64),
+                    np.asarray([B, max_len], np.int64)))
+    log_probs = np.asarray(
+        [[results[b][k][1] for k in builtins.range(top_paths)]
+         for b in builtins.range(B)], np.float32)
+    flat = []
+    for idx, vals, shp in out:
+        flat += [idx, vals, shp]
+    return flat + [log_probs]
+
+
+def _logsumexp(x):
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
+def _lower_ctc_beam(ctx, op, inputs):
+    return _beam_search_impl(np.asarray(inputs[0], np.float32),
+                             np.asarray(inputs[1]),
+                             op.attrs["beam_width"],
+                             op.attrs["top_paths"],
+                             op.attrs["blank_index"],
+                             op.attrs.get("merge_repeated", False))
+
+
+op_registry.register("CTCBeamSearch", lower=_lower_ctc_beam,
+                     is_stateful=True, runs_on_host=True, n_outputs=None)
+
+
+def ctc_beam_search_decoder(inputs, sequence_length, beam_width=100,
+                            top_paths=1, merge_repeated=True,
+                            blank_index=None, name=None):
+    """(ref: ctc_ops.py ``ctc_beam_search_decoder``): returns
+    (decoded COO triples list, log_probabilities [B, top_paths]).
+    Host stage — decode lengths are data-dependent."""
+    from ..framework import tensor_shape as shape_mod
+    from ..framework.sparse_tensor import SparseTensor
+
+    logits = ops_mod.convert_to_tensor(inputs)
+    seq_len = ops_mod.convert_to_tensor(sequence_length)
+    B = logits.shape[1].value
+    blank = (blank_index if blank_index is not None
+             else int(logits.shape[2].value) - 1)
+    g = ops_mod.get_default_graph()
+    specs = []
+    for _ in builtins.range(top_paths):
+        specs += [(shape_mod.TensorShape([None, 2]), dtypes_mod.int64),
+                  (shape_mod.TensorShape([None]), dtypes_mod.int64),
+                  (shape_mod.TensorShape([2]), dtypes_mod.int64)]
+    specs.append((shape_mod.TensorShape([B, top_paths]),
+                  dtypes_mod.float32))
+    op = g.create_op("CTCBeamSearch", [logits, seq_len],
+                     attrs={"beam_width": int(beam_width),
+                            "top_paths": int(top_paths),
+                            "blank_index": blank,
+                            "merge_repeated": bool(merge_repeated)},
+                     name=name or "CTCBeamSearch", output_specs=specs)
+    outs = list(op.outputs)
+    decoded = []
+    for k in builtins.range(top_paths):
+        decoded.append(SparseTensor(outs[3 * k], outs[3 * k + 1],
+                                    outs[3 * k + 2]))
+    return decoded, outs[-1]

@@ -1115,3 +1115,6 @@ op_registry.register(
     lower=lambda ctx, op, inputs: _get_sparse_acc(op)._host_set_step(
         inputs[0]) or [],
     is_stateful=True, runs_on_host=True, n_outputs=0)
+
+
+ConditionalAccumulatorBase = ConditionalAccumulator  # ref base-class name

@@ -644,12 +644,290 @@ def sample_distorted_bounding_box(image_size, bounding_boxes, seed=None,
     return op.outputs[0], op.outputs[1], op.outputs[2]
 
 
+def _nms_host(boxes, scores, max_output_size=0, iou_threshold=0.5):
+    boxes = np.asarray(boxes, np.float32)
+    scores = np.asarray(scores, np.float32)
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(y2 - y1, 0) * np.maximum(x2 - x1, 0)
+    for i in order:
+        ok = True
+        for j in keep:
+            iy = (min(y2[i], y2[j]) - max(y1[i], y1[j]))
+            ix = (min(x2[i], x2[j]) - max(x1[i], x1[j]))
+            inter = max(iy, 0.0) * max(ix, 0.0)
+            union = area[i] + area[j] - inter
+            if union > 0 and inter / union > iou_threshold:
+                ok = False
+                break
+        if ok:
+            keep.append(int(i))
+            if len(keep) >= max_output_size:
+                break
+    return np.asarray(keep, np.int32)
+
+
+op_registry.register(
+    "NonMaxSuppression",
+    lower=lambda ctx, op, inputs: [_nms_host(
+        inputs[0], inputs[1], op.attrs["max_output_size"],
+        op.attrs["iou_threshold"])],
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+
+
 def non_max_suppression(boxes, scores, max_output_size, iou_threshold=0.5,
                         name=None):
-    raise NotImplementedError(
-        "non_max_suppression has data-dependent output size; TPU detection "
-        "pipelines use fixed-size padded NMS (planned pallas kernel)")
+    """Greedy IoU suppression (ref: core/kernels/non_max_suppression_op.cc
+    — a CPU kernel there too). Host stage: the output length is
+    data-dependent, which XLA cannot express; fixed-size padded on-device
+    NMS is available by padding the result with stf.pad."""
+    b = ops_mod.convert_to_tensor(boxes, dtype=dtypes_mod.float32)
+    s = ops_mod.convert_to_tensor(scores, dtype=dtypes_mod.float32)
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "NonMaxSuppression", [b, s],
+        attrs={"max_output_size": int(max_output_size),
+               "iou_threshold": float(iou_threshold)},
+        name=name or "NonMaxSuppression",
+        output_specs=[(shape_mod.TensorShape([None]), dtypes_mod.int32)])
+    return op.outputs[0]
+
+
+def _draw_boxes_impl(images, boxes):
+    """Paint 1-px box borders (ref: core/kernels/draw_bounding_box_op.cc;
+    colors cycle through the reference's palette, first = red)."""
+    imgs = images.astype(jnp.float32)
+    b, h, w, _c = imgs.shape
+    palette = jnp.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0],
+                           [0.0, 0.0, 1.0], [1.0, 1.0, 0.0]])
+    ys = jnp.arange(h, dtype=jnp.float32)[None, :, None] / max(h - 1, 1)
+    xs = jnp.arange(w, dtype=jnp.float32)[None, None, :] / max(w - 1, 1)
+    out = imgs
+    n_boxes = boxes.shape[1]
+    for k in range(n_boxes):
+        y1, x1, y2, x2 = (boxes[:, k, 0][:, None, None],
+                          boxes[:, k, 1][:, None, None],
+                          boxes[:, k, 2][:, None, None],
+                          boxes[:, k, 3][:, None, None])
+        px = 1.0 / max(h - 1, 1)
+        py = 1.0 / max(w - 1, 1)
+        inside = ((ys >= y1) & (ys <= y2) & (xs >= x1) & (xs <= x2))
+        border = inside & ((jnp.abs(ys - y1) <= px) | (jnp.abs(ys - y2) <= px)
+                           | (jnp.abs(xs - x1) <= py)
+                           | (jnp.abs(xs - x2) <= py))
+        color = palette[k % palette.shape[0]]
+        scale = (255.0 if images.dtype != jnp.float32
+                 and jnp.issubdtype(images.dtype, jnp.integer) else 1.0)
+        out = jnp.where(border[..., None], color * scale, out)
+    return out.astype(images.dtype)
+
+
+op_registry.register_pure("DrawBoundingBoxes", _draw_boxes_impl)
 
 
 def draw_bounding_boxes(images, boxes, name=None):
-    raise NotImplementedError
+    """images [B,H,W,C] float; boxes [B,N,4] normalized (y1,x1,y2,x2)."""
+    x = ops_mod.convert_to_tensor(images)
+    bx = ops_mod.convert_to_tensor(boxes, dtype=dtypes_mod.float32)
+    return make_op("DrawBoundingBoxes", [x, bx], name=name)
+
+
+def resize_area(images, size, align_corners=False, name=None):
+    """(ref: image_ops resize AREA method — approximated by the linear
+    antialiased resize, the same family of averaging filters)."""
+    return resize_images(images, size, ResizeMethod.AREA)
+
+
+def resize_bicubic(images, size, align_corners=False, name=None):
+    return resize_images(images, size, ResizeMethod.BICUBIC)
+
+
+def random_hue(image, max_delta, seed=None):
+    """(ref: image_ops.py ``random_hue``)."""
+    if max_delta < 0 or max_delta > 0.5:
+        raise ValueError("max_delta must be in [0, 0.5]")
+    from . import random_ops
+
+    delta = random_ops.random_uniform([], -max_delta, max_delta, seed=seed)
+    return _adjust_hue_dynamic(image, delta)
+
+
+def random_saturation(image, lower, upper, seed=None):
+    """(ref: image_ops.py ``random_saturation``)."""
+    if lower < 0 or lower >= upper:
+        raise ValueError("need 0 <= lower < upper")
+    from . import random_ops
+
+    factor = random_ops.random_uniform([], lower, upper, seed=seed)
+    return _adjust_saturation_dynamic(image, factor)
+
+
+op_registry.register_pure(
+    "AdjustHueDyn",
+    lambda x, delta: _hsv_shift(x, delta, None))
+op_registry.register_pure(
+    "AdjustSaturationDyn",
+    lambda x, factor: _hsv_shift(x, None, factor))
+
+
+def _hsv_shift(x, delta, factor):
+    xf = x.astype(jnp.float32)
+    scale = (255.0 if jnp.issubdtype(x.dtype, jnp.integer) else 1.0)
+    hsv = _rgb_to_hsv(xf / scale)
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    if delta is not None:
+        h = jnp.mod(h + delta, 1.0)
+    if factor is not None:
+        s = jnp.clip(s * factor, 0.0, 1.0)
+    out = _hsv_to_rgb(jnp.stack([h, s, v], axis=-1)) * scale
+    return out.astype(x.dtype)
+
+
+def _adjust_hue_dynamic(image, delta_t):
+    x = ops_mod.convert_to_tensor(image)
+    return make_op("AdjustHueDyn", [x, delta_t], name="adjust_hue_dyn")
+
+
+def _adjust_saturation_dynamic(image, factor_t):
+    x = ops_mod.convert_to_tensor(image)
+    return make_op("AdjustSaturationDyn", [x, factor_t],
+                   name="adjust_sat_dyn")
+
+
+def _crop_and_resize_impl(image, boxes, box_ind, crop_size=None,
+                          method="bilinear", extrapolation_value=0.0):
+    """Per-box bilinear crop (ref: core/kernels/crop_and_resize_op.cc).
+    Static crop_size + vmap over boxes: one fused XLA program."""
+    ch, cw = crop_size
+    imgs = image.astype(jnp.float32)
+    _b, h, w, _c = imgs.shape
+
+    def one(box, ind):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        ys = (y1 * (h - 1)
+              + jnp.arange(ch, dtype=jnp.float32)
+              * (y2 - y1) * (h - 1) / max(ch - 1, 1))
+        xs = (x1 * (w - 1)
+              + jnp.arange(cw, dtype=jnp.float32)
+              * (x2 - x1) * (w - 1) / max(cw - 1, 1))
+        img = imgs[ind]
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        tl = img[y0i][:, x0i]
+        tr = img[y0i][:, x1i]
+        bl = img[y1i][:, x0i]
+        br = img[y1i][:, x1i]
+        out = (tl * (1 - wy) * (1 - wx) + tr * (1 - wy) * wx
+               + bl * wy * (1 - wx) + br * wy * wx)
+        inb = (((ys >= 0) & (ys <= h - 1))[:, None, None]
+               & ((xs >= 0) & (xs <= w - 1))[None, :, None])
+        return jnp.where(inb, out, extrapolation_value)
+
+    return jax.vmap(one)(boxes, box_ind)
+
+
+op_registry.register_pure("CropAndResize", _crop_and_resize_impl)
+
+
+def crop_and_resize(image, boxes, box_ind, crop_size, method="bilinear",
+                    extrapolation_value=0.0, name=None):
+    """image [B,H,W,C]; boxes [N,4] normalized; box_ind [N] -> [N,ch,cw,C]."""
+    x = ops_mod.convert_to_tensor(image)
+    b = ops_mod.convert_to_tensor(boxes, dtype=dtypes_mod.float32)
+    bi = ops_mod.convert_to_tensor(box_ind, dtype=dtypes_mod.int32)
+    n = b.shape[0].value
+    c = x.shape[3].value
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "CropAndResize", [x, b, bi],
+        attrs={"crop_size": (int(crop_size[0]), int(crop_size[1])),
+               "method": method,
+               "extrapolation_value": float(extrapolation_value)},
+        name=name or "CropAndResize",
+        output_specs=[(shape_mod.TensorShape(
+            [n, int(crop_size[0]), int(crop_size[1]), c]),
+            dtypes_mod.float32)])
+    return op.outputs[0]
+
+
+def _extract_glimpse_impl(images, offsets, size=None, centered=True,
+                          normalized=True):
+    """(ref: core/kernels/attention_ops.cc ExtractGlimpse) — fixed-size
+    windows around per-image offsets; out-of-bounds filled with zeros
+    (the reference fills with noise; zeros keep the op deterministic)."""
+    gh, gw = size
+    imgs = images.astype(jnp.float32)
+    _b, h, w, _c = imgs.shape
+
+    def one(img, off):
+        oy, ox = off[0], off[1]
+        if normalized:
+            oy = oy * h
+            ox = ox * w
+        if centered:
+            oy = (oy + h) / 2.0
+            ox = (ox + w) / 2.0
+        y0 = oy - gh / 2.0
+        x0 = ox - gw / 2.0
+        ys = (y0 + jnp.arange(gh, dtype=jnp.float32)).astype(jnp.int32)
+        xs = (x0 + jnp.arange(gw, dtype=jnp.float32)).astype(jnp.int32)
+        inb = (((ys >= 0) & (ys < h))[:, None, None]
+               & ((xs >= 0) & (xs < w))[None, :, None])
+        ysc = jnp.clip(ys, 0, h - 1)
+        xsc = jnp.clip(xs, 0, w - 1)
+        return jnp.where(inb, img[ysc][:, xsc], 0.0)
+
+    return jax.vmap(one)(imgs, offsets.astype(jnp.float32))
+
+
+op_registry.register_pure("ExtractGlimpse", _extract_glimpse_impl)
+
+
+def extract_glimpse(input, size, offsets, centered=True,  # noqa: A002
+                    normalized=True, uniform_noise=False, name=None):
+    x = ops_mod.convert_to_tensor(input)
+    off = ops_mod.convert_to_tensor(offsets, dtype=dtypes_mod.float32)
+    b = x.shape[0].value
+    c = x.shape[3].value
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "ExtractGlimpse", [x, off],
+        attrs={"size": (int(size[0]), int(size[1])),
+               "centered": bool(centered),
+               "normalized": bool(normalized)},
+        name=name or "ExtractGlimpse",
+        output_specs=[(shape_mod.TensorShape(
+            [b, int(size[0]), int(size[1]), c]), dtypes_mod.float32)])
+    return op.outputs[0]
+
+
+def _lower_decode_gif(ctx, op, inputs):
+    """Host GIF decode via PIL (ref: core/kernels/decode_gif_op.cc);
+    returns all frames [num_frames, H, W, 3]."""
+    from PIL import Image, ImageSequence
+    import io as _io
+
+    img = Image.open(_io.BytesIO(_jpeg_bytes(inputs[0])))
+    frames = [np.asarray(f.convert("RGB"), np.uint8)
+              for f in ImageSequence.Iterator(img)]
+    return [np.stack(frames)]
+
+
+op_registry.register("DecodeGif", lower=_lower_decode_gif,
+                     is_stateful=True, runs_on_host=True)
+
+
+def decode_gif(contents, name=None):
+    x = ops_mod.convert_to_tensor(contents)
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "DecodeGif", [x], attrs={}, name=name or "DecodeGif",
+        output_specs=[(shape_mod.TensorShape([None, None, None, 3]),
+                       dtypes_mod.uint8)])
+    return op.outputs[0]

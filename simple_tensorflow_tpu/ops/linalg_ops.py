@@ -165,3 +165,15 @@ def eye(*args, **kwargs):
     from . import array_ops
 
     return array_ops.eye(*args, **kwargs)
+
+
+op_registry.register_pure(
+    "CholeskySolve",
+    lambda chol, rhs: __import__("jax").scipy.linalg.cho_solve(
+        (chol, True), rhs))
+def cholesky_solve(chol, rhs, name=None):
+    """(ref: math_ops/linalg ``cholesky_solve``): solve A x = rhs given
+    chol = cholesky(A) (lower)."""
+    c = ops_mod.convert_to_tensor(chol)
+    r = ops_mod.convert_to_tensor(rhs, dtype=c.dtype.base_dtype)
+    return make_op("CholeskySolve", [c, r], name=name)

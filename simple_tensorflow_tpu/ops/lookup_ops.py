@@ -566,3 +566,8 @@ def tables_initializer(name="init_all_tables"):
     g = ops_mod.get_default_graph()
     inits = g.get_collection(GraphKeys.TABLE_INITIALIZERS)
     return control_flow_ops.group(*inits, name=name)
+
+
+def initialize_all_tables(name="init_all_tables"):
+    """Deprecated TF-1.0 alias of tables_initializer."""
+    return tables_initializer(name=name)

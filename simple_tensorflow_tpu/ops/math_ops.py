@@ -20,6 +20,7 @@ from ..framework import dtypes as dtypes_mod
 from ..framework import graph as ops_mod
 from ..framework import op_registry
 from ..framework import constant_op
+from ..framework import tensor_shape as shape_mod
 from .op_util import binary, make_op, norm_axis, promote_args, unary
 
 Tensor = ops_mod.Tensor
@@ -994,3 +995,81 @@ def _install_operators():
 
 
 _install_operators()
+
+
+# -- round-4 parity fills ----------------------------------------------------
+
+floor_div = floordiv  # (ref: math_ops.py ``floor_div``)
+
+
+op_registry.register_pure(
+    "Complex", lambda re, im: jax.lax.complex(re, im))
+
+
+def complex(real, imag, name=None):  # noqa: A002
+    """(ref: math_ops.py ``complex``)."""
+    from .op_util import promote_args
+
+    r, i = promote_args(real, imag, "Complex")
+    return make_op("Complex", [r, i], name=name)
+
+
+def _sparse_segment(op_name, jfn):
+    def impl(data, indices, segment_ids=None, n_segments=1, mode="sum"):
+        import jax
+
+        rows = jnp.take(data, indices.astype(jnp.int32), axis=0)
+        seg = jnp.asarray(np.asarray(segment_ids, np.int32))
+        s = jax.ops.segment_sum(rows, seg, n_segments)
+        if mode == "sum":
+            return s
+        counts = jax.ops.segment_sum(jnp.ones_like(seg, jnp.float32), seg,
+                                     n_segments)
+        counts = jnp.maximum(counts, 1.0)
+        shape = (-1,) + (1,) * (rows.ndim - 1)
+        if mode == "mean":
+            return s / counts.reshape(shape).astype(s.dtype)
+        return s / jnp.sqrt(counts).reshape(shape).astype(s.dtype)
+
+    op_registry.register_pure(op_name, impl)
+
+
+_sparse_segment("SparseSegmentSum", None)
+
+
+def _sparse_segment_api(data, indices, segment_ids, mode, name):
+    """(ref: math_ops.py sparse_segment_{sum,mean,sqrt_n}): gather rows by
+    ``indices`` then segment-reduce. segment_ids must be static (they set
+    the output dim0 — data-dependent otherwise, same tf2xla limit)."""
+    data = ops_mod.convert_to_tensor(data)
+    idx = ops_mod.convert_to_tensor(indices)
+    seg_v = constant_op.constant_value(
+        ops_mod.convert_to_tensor(segment_ids))
+    if seg_v is None:
+        raise ValueError(
+            f"sparse_segment_{mode} needs static segment_ids on TPU "
+            "(they define the output shape)")
+    seg = np.asarray(seg_v, np.int64)
+    n = int(seg.max()) + 1 if seg.size else 0
+    g = ops_mod.get_default_graph()
+    out_shape = shape_mod.TensorShape(
+        [n] + [d.value for d in data.shape[1:]])
+    op = g.create_op(
+        "SparseSegmentSum", [data, idx],
+        attrs={"segment_ids": tuple(int(s) for s in seg),
+               "n_segments": n, "mode": mode},
+        name=name or f"sparse_segment_{mode}",
+        output_specs=[(out_shape, data.dtype)])
+    return op.outputs[0]
+
+
+def sparse_segment_sum(data, indices, segment_ids, name=None):
+    return _sparse_segment_api(data, indices, segment_ids, "sum", name)
+
+
+def sparse_segment_mean(data, indices, segment_ids, name=None):
+    return _sparse_segment_api(data, indices, segment_ids, "mean", name)
+
+
+def sparse_segment_sqrt_n(data, indices, segment_ids, name=None):
+    return _sparse_segment_api(data, indices, segment_ids, "sqrt_n", name)

@@ -259,3 +259,19 @@ def einsum(equation, *inputs, name=None):
     from . import math_ops
 
     return math_ops.einsum(equation, *inputs)
+
+
+def remove_squeezable_dimensions(labels, predictions, name=None):
+    """(ref: confusion_matrix.py ``remove_squeezable_dimensions``): if one
+    of the pair has exactly one more trailing size-1 dim, squeeze it."""
+    from . import array_ops
+
+    labels = ops_mod.convert_to_tensor(labels)
+    predictions = ops_mod.convert_to_tensor(predictions)
+    lr, pr = labels.shape.rank, predictions.shape.rank
+    if lr is not None and pr is not None:
+        if pr - lr == 1 and predictions.shape[-1].value == 1:
+            predictions = array_ops.squeeze(predictions, axis=[-1])
+        elif lr - pr == 1 and labels.shape[-1].value == 1:
+            labels = array_ops.squeeze(labels, axis=[-1])
+    return labels, predictions

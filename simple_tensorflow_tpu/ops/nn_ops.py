@@ -695,3 +695,433 @@ def log_poisson_loss(targets, log_input, compute_full_loss=False, name=None):
 
     loss = math_ops.exp(log_input) - log_input * targets
     return loss
+
+
+# -- round-4 parity fills ----------------------------------------------------
+
+def conv1d(value, filters, stride, padding, use_cudnn_on_gpu=None,
+           data_format="NHWC", name=None):
+    """(ref: nn_ops.py ``conv1d``): [B, W, C] conv via a height-1 conv2d
+    (exactly the reference's implementation strategy)."""
+    from . import array_ops
+
+    x = ops_mod.convert_to_tensor(value)
+    w = ops_mod.convert_to_tensor(filters, dtype=x.dtype.base_dtype)
+    x4 = array_ops.expand_dims(x, 1)            # [B, 1, W, C]
+    w4 = array_ops.expand_dims(w, 0)            # [1, K, C, O]
+    s = stride if isinstance(stride, int) else stride[1]
+    out = conv2d(x4, w4, [1, 1, s, 1], padding, name=name)
+    return array_ops.squeeze(out, axis=[1])
+
+
+def convolution(input, filter, padding, strides=None,  # noqa: A002
+                dilation_rate=None, name=None, data_format=None):
+    """(ref: nn_ops.py ``convolution``): rank-dispatching wrapper."""
+    x = ops_mod.convert_to_tensor(input)
+    rank = x.shape.rank
+    if rank == 3:
+        return conv1d(x, filter, (strides or [1])[0] if strides else 1,
+                      padding, name=name)
+    if rank == 4:
+        s = [1] + list(strides or [1, 1]) + [1]
+        d = [1] + list(dilation_rate or [1, 1]) + [1]
+        return conv2d(x, filter, s, padding, dilations=d, name=name)
+    if rank == 5:
+        s = [1] + list(strides or [1, 1, 1]) + [1]
+        return conv3d(x, filter, s, padding, name=name)
+    raise ValueError(f"convolution: unsupported input rank {rank}")
+
+
+def atrous_conv2d_transpose(value, filters, output_shape, rate, padding,
+                            name=None):
+    """(ref: nn_ops.py ``atrous_conv2d_transpose``): the transpose of the
+    dilated conv — lax supports rhs_dilation in the backprop, so this is
+    conv2d_transpose with a dilated kernel."""
+    from . import array_ops
+
+    w = ops_mod.convert_to_tensor(filters)
+    if rate > 1:
+        # dilate the kernel spatially (zeros between taps)
+        kh, kw = int(w.shape[0].value), int(w.shape[1].value)
+        eff_h = kh + (kh - 1) * (rate - 1)
+        eff_w = kw + (kw - 1) * (rate - 1)
+        import numpy as _np
+
+        from ..framework import constant_op
+
+        idx_h = _np.arange(kh) * rate
+        idx_w = _np.arange(kw) * rate
+        scat = array_ops.scatter_nd(
+            constant_op.constant(
+                _np.stack(_np.meshgrid(idx_h, idx_w, indexing="ij"),
+                          axis=-1).reshape(-1, 2).astype(_np.int32)),
+            array_ops.reshape(w, [kh * kw, int(w.shape[2].value),
+                                  int(w.shape[3].value)]),
+            [eff_h, eff_w, int(w.shape[2].value),
+             int(w.shape[3].value)])
+        w = scat
+    return conv2d_transpose(value, w, output_shape, [1, 1, 1, 1],
+                            padding, name=name)
+
+
+def conv2d_backprop_input(input_sizes, filter, out_backprop, strides,  # noqa: A002
+                          padding, use_cudnn_on_gpu=None,
+                          data_format="NHWC", name=None):
+    """(ref: nn_ops.py ``conv2d_backprop_input``) — the raw gradient op,
+    same lowering as conv2d_transpose."""
+    return conv2d_transpose(out_backprop, filter,
+                            output_shape=input_sizes, strides=strides,
+                            padding=padding, name=name)
+
+
+def conv2d_backprop_filter(input, filter_sizes, out_backprop, strides,  # noqa: A002
+                           padding, use_cudnn_on_gpu=None,
+                           data_format="NHWC", name=None):
+    """(ref: nn_ops.py ``conv2d_backprop_filter``): derived through the
+    SAME autodiff that training uses — d(conv)/d(filter) via stf.gradients
+    on a throwaway conv with a zero filter of the right shape."""
+    from ..framework import gradients as grads_mod
+    from ..framework.constant_op import constant_value
+    from . import array_ops
+
+    fs = constant_value(ops_mod.convert_to_tensor(filter_sizes))
+    if fs is None:
+        raise ValueError("conv2d_backprop_filter needs static filter_sizes")
+    x = ops_mod.convert_to_tensor(input)
+    w0 = array_ops.zeros([int(d) for d in np.ravel(fs)],
+                         dtype=x.dtype.base_dtype)
+    y = conv2d(x, w0, strides, padding)
+    (gw,) = grads_mod.gradients(y, [w0],
+                                grad_ys=[ops_mod.convert_to_tensor(
+                                    out_backprop)])
+    return gw
+
+
+def _max_pool_argmax_impl(x, ksize=None, strides=None, padding="VALID"):
+    """Correct per-window argmax: iterate the (small, static) window
+    offsets, tracking best value + FLAT input index (ref flattening
+    (y*W + x)*C + c). Handles overlapping windows and SAME padding."""
+    b, h, w, c = x.shape
+    kh, kw = ksize[1], ksize[2]
+    sy, sx = strides[1], strides[2]
+    if padding.upper() == "SAME":
+        oh = -(-h // sy)
+        ow = -(-w // sx)
+        pad_h = builtins.max((oh - 1) * sy + kh - h, 0)
+        pad_w = builtins.max((ow - 1) * sx + kw - w, 0)
+    else:
+        oh = (h - kh) // sy + 1
+        ow = (w - kw) // sx + 1
+        pad_h = pad_w = 0
+    neg = (jnp.asarray(-jnp.inf, x.dtype)
+           if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    xp = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
+                 constant_values=neg)
+    flat = ((jnp.arange(h)[:, None, None] * w
+             + jnp.arange(w)[None, :, None]) * c
+            + jnp.arange(c)[None, None, :]).astype(jnp.int64)
+    flat = jnp.pad(flat, ((0, pad_h), (0, pad_w), (0, 0)),
+                   constant_values=-1)
+    best = jnp.full((b, oh, ow, c), neg, x.dtype)
+    best_idx = jnp.zeros((b, oh, ow, c), jnp.int64)
+    ys = jnp.arange(oh) * sy
+    xs = jnp.arange(ow) * sx
+    for dy in builtins.range(kh):
+        for dx in builtins.range(kw):
+            v = xp[:, ys + dy][:, :, xs + dx]
+            fi = flat[ys + dy][:, xs + dx][None]
+            take = v > best
+            best = jnp.where(take, v, best)
+            best_idx = jnp.where(take, fi, best_idx)
+    return [best, best_idx]
+
+
+op_registry.register_pure("MaxPoolWithArgmax", _max_pool_argmax_impl,
+                          n_outputs=2)
+
+
+def max_pool_with_argmax(input, ksize, strides, padding,  # noqa: A002
+                         Targmax=None, name=None):
+    """(ref: nn_ops.py ``max_pool_with_argmax``): pooled values plus the
+    FLATTENED per-batch index of each max ((y*W + x)*C + c). Correct for
+    overlapping windows (the argmax is tracked per window offset)."""
+    from ..framework import tensor_shape as shape_mod
+
+    x = ops_mod.convert_to_tensor(input)
+    g = ops_mod.get_default_graph()
+    b, h, w, c = (d.value for d in x.shape)
+    kh, kw = ksize[1], ksize[2]
+    sy, sx = strides[1], strides[2]
+    if padding.upper() == "SAME":
+        oh, ow = -(-h // sy), -(-w // sx)
+    else:
+        oh, ow = (h - kh) // sy + 1, (w - kw) // sx + 1
+    out_shape = shape_mod.TensorShape([b, oh, ow, c])
+    op = g.create_op("MaxPoolWithArgmax", [x],
+                     attrs={"ksize": builtins.tuple(ksize),
+                            "strides": builtins.tuple(strides),
+                            "padding": padding},
+                     name=name or "MaxPoolWithArgmax",
+                     output_specs=[(out_shape, x.dtype),
+                                   (out_shape, dtypes_mod.int64)])
+    return op.outputs[0], op.outputs[1]
+
+
+def _pool_v2_impl(x, window_shape=None, pooling_type="MAX",
+                  padding="VALID", dilation_rate=None, strides=None):
+    dil = builtins.tuple(dilation_rate or [1] * builtins.len(window_shape))
+    st = builtins.tuple(strides or [1] * builtins.len(window_shape))
+    wd = (1,) + builtins.tuple(window_shape) + (1,)
+    ws = (1,) + st + (1,)
+    wdil = (1,) + dil + (1,)
+    if pooling_type.upper() == "MAX":
+        init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                else jnp.iinfo(x.dtype).min)
+        return jax.lax.reduce_window(x, init, jax.lax.max, wd, ws,
+                                     padding.upper(),
+                                     window_dilation=wdil)
+    s = jax.lax.reduce_window(x.astype(jnp.float32), 0.0, jax.lax.add,
+                              wd, ws, padding.upper(),
+                              window_dilation=wdil)
+    ones = jnp.ones(x.shape, jnp.float32)
+    n = jax.lax.reduce_window(ones, 0.0, jax.lax.add, wd, ws,
+                              padding.upper(), window_dilation=wdil)
+    return (s / n).astype(x.dtype)
+
+
+op_registry.register_pure("PoolV2", _pool_v2_impl)
+
+
+def pool(input, window_shape, pooling_type, padding, dilation_rate=None,  # noqa: A002
+         strides=None, name=None, data_format=None):
+    """(ref: nn_ops.py ``pool``): generic window pooling WITH dilation —
+    lax.reduce_window supports window_dilation natively on TPU."""
+    if pooling_type.upper() not in ("MAX", "AVG"):
+        raise ValueError(f"pool: unknown pooling_type {pooling_type!r}")
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("PoolV2", [x],
+                   attrs={"window_shape": builtins.tuple(window_shape),
+                          "pooling_type": pooling_type.upper(),
+                          "padding": padding,
+                          "dilation_rate": builtins.tuple(dilation_rate)
+                          if dilation_rate else None,
+                          "strides": builtins.tuple(strides)
+                          if strides else None},
+                   name=name)
+
+
+def with_space_to_batch(input, dilation_rate, padding, op, filter_shape=None,  # noqa: A002
+                        spatial_dims=None, data_format=None):
+    """(ref: nn_ops.py ``with_space_to_batch``): on TPU, dilated convs are
+    native (lax rhs_dilation fuses on the MXU), so the space-to-batch
+    dance is unnecessary — this wrapper simply invokes ``op`` with the
+    dilation folded in when it is 1, and otherwise applies the reference's
+    space-to-batch -> op -> batch-to-space composition."""
+    from ..framework.constant_op import constant_value
+    from . import array_ops
+
+    rate = np.asarray(constant_value(
+        ops_mod.convert_to_tensor(dilation_rate)))
+    if (rate == 1).all():
+        return op(input, num_spatial_dims=len(rate), padding=padding)
+    x = ops_mod.convert_to_tensor(input)
+    # pad spatial dims up to multiples of the rate (ref computes this via
+    # required_space_to_batch_paddings)
+    pads = []
+    for d, r in enumerate(rate.ravel()):
+        dim = int(x.shape[d + 1].value)
+        pads.append([0, (-dim) % int(r)])
+    stb = array_ops.space_to_batch_nd(x, list(rate.ravel()), pads)
+    y = op(stb, num_spatial_dims=len(rate), padding=padding)
+    return array_ops.batch_to_space_nd(y, list(rate.ravel()), pads)
+
+
+def _fractional_boundaries(n, ratio, seed, pseudo_random):
+    """Row boundaries for fractional pooling (ref:
+    core/kernels/fractional_pool_common.cc): ~n/ratio output rows with
+    window sizes in {floor(ratio), ceil(ratio)}, seeded."""
+    out_n = int(n / ratio)
+    rng = np.random.RandomState(seed if seed else 0)
+    if pseudo_random:
+        # a_k = ceil(alpha*(k+u)) (ref pseudorandom sequence)
+        u = rng.uniform(0, 1)
+        bounds = [0]
+        for k in builtins.range(1, out_n):
+            bounds.append(builtins.min(int(np.ceil(ratio * (k + u))),
+                                       n - 1))
+        bounds.append(n)
+        return bounds
+    # random variant (ref default): shuffle a mix of floor/ceil window
+    # sizes that sums to n
+    small, big = int(np.floor(ratio)), int(np.ceil(ratio))
+    n_big = n - small * out_n
+    sizes = [big] * n_big + [small] * (out_n - n_big)
+    rng.shuffle(sizes)
+    bounds = [0]
+    for s in sizes:
+        bounds.append(bounds[-1] + s)
+    bounds[-1] = n
+    return bounds
+
+
+def _fractional_pool(input, pooling_ratio, kind, pseudo_random,  # noqa: A002
+                     overlapping, seed, name):
+    from ..framework import constant_op
+    from . import array_ops, math_ops
+
+    x = ops_mod.convert_to_tensor(input)
+    b, h, w, c = (int(d) for d in x.shape.as_list())
+    rh, rw = float(pooling_ratio[1]), float(pooling_ratio[2])
+    hb = _fractional_boundaries(h, rh, seed, pseudo_random)
+    wb = _fractional_boundaries(w, rw, (seed or 0) + 1, pseudo_random)
+
+    def pool_axis(t, bounds, axis):
+        segs = []
+        for i in builtins.range(builtins.len(bounds) - 1):
+            lo = bounds[i]
+            hi = bounds[i + 1] + (1 if overlapping
+                                  and bounds[i + 1] < (h if axis == 1
+                                                       else w) else 0)
+            hi = builtins.max(hi, lo + 1)
+            idx = constant_op.constant(
+                np.arange(lo, hi, dtype=np.int32))
+            sl = array_ops.gather(t, idx, axis=axis)
+            red = (math_ops.reduce_max if kind == "max"
+                   else math_ops.reduce_mean)
+            segs.append(red(sl, axis=axis, keepdims=True))
+        return array_ops.concat(segs, axis=axis)
+
+    out = pool_axis(x, hb, 1)
+    out = pool_axis(out, wb, 2)
+    rs = constant_op.constant(np.asarray(hb, np.int64))
+    cs = constant_op.constant(np.asarray(wb, np.int64))
+    return out, rs, cs
+
+
+def fractional_max_pool(value, pooling_ratio, pseudo_random=False,
+                        overlapping=False, deterministic=False, seed=0,
+                        seed2=0, name=None):
+    """(ref: nn_ops.py ``fractional_max_pool``): returns (output,
+    row_pooling_sequence, col_pooling_sequence)."""
+    return _fractional_pool(value, pooling_ratio, "max", pseudo_random,
+                            overlapping, seed, name)
+
+
+def fractional_avg_pool(value, pooling_ratio, pseudo_random=False,
+                        overlapping=False, deterministic=False, seed=0,
+                        seed2=0, name=None):
+    return _fractional_pool(value, pooling_ratio, "avg", pseudo_random,
+                            overlapping, seed, name)
+
+
+def _requant_range(x):
+    from . import math_ops
+
+    return math_ops.reduce_min(x), math_ops.reduce_max(x)
+
+
+def quantized_conv2d(input, filter, min_input, max_input, min_filter,  # noqa: A002
+                     max_filter, strides, padding, out_type=None,
+                     name=None):
+    """(ref: nn_ops quantized_conv2d, core/kernels/quantized_conv_ops.cc):
+    dequantize -> MXU conv -> fresh range. On TPU the int8 fast path is
+    the Pallas quantized_matmul (ops/fused_ops.py); this op preserves the
+    reference's quantized-graph CONTRACT (value + min/max triple)."""
+    from ..ops import quantization_ops as qo
+
+    xf = qo.dequantize(input, min_input, max_input)
+    wf = qo.dequantize(filter, min_filter, max_filter)
+    y = conv2d(xf, wf, strides, padding, name=name)
+    mn, mx = _requant_range(y)
+    return y, mn, mx
+
+
+def quantized_relu_x(features, max_value, min_features, max_features,
+                     out_type=None, name=None):
+    from ..ops import quantization_ops as qo
+    from . import math_ops
+
+    xf = qo.dequantize(features, min_features, max_features)
+    y = math_ops.minimum(relu(xf),
+                         ops_mod.convert_to_tensor(float(max_value)
+                                                   if not isinstance(
+                                                       max_value,
+                                                       ops_mod.Tensor)
+                                                   else max_value))
+    mn, mx = _requant_range(y)
+    return y, mn, mx
+
+
+def quantized_max_pool(input, min_input, max_input, ksize, strides,  # noqa: A002
+                       padding, name=None):
+    from ..ops import quantization_ops as qo
+
+    xf = qo.dequantize(input, min_input, max_input)
+    y = max_pool(xf, ksize, strides, padding, name=name)
+    mn, mx = _requant_range(y)
+    return y, mn, mx
+
+
+def quantized_avg_pool(input, min_input, max_input, ksize, strides,  # noqa: A002
+                       padding, name=None):
+    from ..ops import quantization_ops as qo
+
+    xf = qo.dequantize(input, min_input, max_input)
+    y = avg_pool(xf, ksize, strides, padding, name=name)
+    mn, mx = _requant_range(y)
+    return y, mn, mx
+
+
+def _backprop_filter_via_autodiff(conv_fn, input, filter_sizes,  # noqa: A002
+                                  out_backprop, strides, padding):
+    from ..framework import gradients as grads_mod
+    from ..framework.constant_op import constant_value
+    from . import array_ops
+
+    fs = constant_value(ops_mod.convert_to_tensor(filter_sizes))
+    if fs is None:
+        raise ValueError("backprop_filter needs static filter_sizes")
+    x = ops_mod.convert_to_tensor(input)
+    w0 = array_ops.zeros([int(d) for d in np.ravel(fs)],
+                         dtype=x.dtype.base_dtype)
+    y = conv_fn(x, w0, strides, padding)
+    (gw,) = grads_mod.gradients(
+        y, [w0], grad_ys=[ops_mod.convert_to_tensor(out_backprop)])
+    return gw
+
+
+def conv3d_backprop_filter_v2(input, filter_sizes, out_backprop, strides,  # noqa: A002
+                              padding, data_format="NDHWC", name=None):
+    """(ref: nn.py ``conv3d_backprop_filter_v2``): derived through the
+    same autodiff training uses."""
+    return _backprop_filter_via_autodiff(
+        lambda x, w, s, p: conv3d(x, w, s, p), input, filter_sizes,
+        out_backprop, strides, padding)
+
+
+def depthwise_conv2d_native_backprop_filter(input, filter_sizes,  # noqa: A002
+                                            out_backprop, strides, padding,
+                                            data_format="NHWC", name=None):
+    return _backprop_filter_via_autodiff(
+        lambda x, w, s, p: depthwise_conv2d(x, w, s, p), input,
+        filter_sizes, out_backprop, strides, padding)
+
+
+def depthwise_conv2d_native_backprop_input(input_sizes, filter,  # noqa: A002
+                                           out_backprop, strides, padding,
+                                           data_format="NHWC", name=None):
+    from ..framework import gradients as grads_mod
+    from ..framework.constant_op import constant_value
+    from . import array_ops
+
+    xs = constant_value(ops_mod.convert_to_tensor(input_sizes))
+    if xs is None:
+        raise ValueError("backprop_input needs static input_sizes")
+    w = ops_mod.convert_to_tensor(filter)
+    x0 = array_ops.zeros([int(d) for d in np.ravel(xs)],
+                         dtype=w.dtype.base_dtype)
+    y = depthwise_conv2d(x0, w, strides, padding)
+    (gx,) = grads_mod.gradients(
+        y, [x0], grad_ys=[ops_mod.convert_to_tensor(out_backprop)])
+    return gx

@@ -270,3 +270,202 @@ def decode_raw(bytes_tensor, out_type, little_endian=True, name=None):
     op = g.create_op(op_type, [bytes_tensor], name=name or "DecodeRaw",
                      output_specs=[(out_shape, out_type)])
     return op.outputs[0]
+
+
+# -- round-4 parity fills ----------------------------------------------------
+
+class FixedLenSequenceFeature:
+    """(ref: parsing_ops.py ``FixedLenSequenceFeature``): a variable
+    number of fixed-shape rows; parse pads to the batch max (the TPU
+    static-shape analog of the reference's row-ragged parse)."""
+
+    def __init__(self, shape, dtype, allow_missing=False,
+                 default_value=None):
+        self.shape = list(shape)
+        self.dtype = dtypes_mod.as_dtype(dtype)
+        self.allow_missing = allow_missing
+        self.default_value = default_value
+
+
+class SparseFeature:
+    """(ref: parsing_ops.py ``SparseFeature``): (index_key, value_key)
+    feature pair parsed into one SparseTensor triple."""
+
+    def __init__(self, index_key, value_key, dtype, size,
+                 already_sorted=False):
+        self.index_key = index_key
+        self.value_key = value_key
+        self.dtype = dtypes_mod.as_dtype(dtype)
+        self.size = int(size)
+        self.already_sorted = already_sorted
+
+
+def decode_csv(records, record_defaults, field_delim=",", name=None):
+    """(ref: parsing_ops.py ``decode_csv``, core/kernels/decode_csv_op.cc).
+    Host stage (strings). Returns one tensor per column."""
+    recs = ops_mod.convert_to_tensor(records, dtype=dtypes_mod.string)
+    col_dtypes = []
+    defaults = []
+    for d in record_defaults:
+        arr = np.asarray(d).ravel()
+        if arr.dtype == object or arr.dtype.kind in "US":
+            col_dtypes.append(dtypes_mod.string)
+        else:
+            col_dtypes.append(dtypes_mod.as_dtype(arr.dtype))
+        defaults.append(arr[0] if arr.size else None)
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "DecodeCSV", [recs],
+        attrs={"_defaults": tuple(defaults),
+               "_dtypes": tuple(d.name for d in col_dtypes),
+               "field_delim": field_delim},
+        name=name or "DecodeCSV",
+        output_specs=[(recs.shape, dt) for dt in col_dtypes])
+    return list(op.outputs)
+
+
+def _lower_decode_csv(ctx, op, inputs):
+    import csv as _csv
+    import io as _io
+
+    recs = np.ravel(np.asarray(inputs[0], dtype=object))
+    defaults = op.attrs["_defaults"]
+    dtype_names = op.attrs["_dtypes"]
+    builtins_len = len(dtype_names)
+    cols = [[] for _ in dtype_names]
+    for r in recs:
+        s = r.decode() if isinstance(r, bytes) else str(r)
+        rows = list(_csv.reader(_io.StringIO(s),
+                                delimiter=op.attrs["field_delim"]))
+        # empty record = all fields empty -> defaults (ref kernel behavior)
+        row = rows[0] if rows else [""] * builtins_len
+
+        if len(row) != len(cols):
+            raise ValueError(
+                f"decode_csv: record has {len(row)} fields, expected "
+                f"{len(cols)}: {s!r}")
+        for i, field in enumerate(row):
+            if field == "":
+                if defaults[i] is None:
+                    raise ValueError(
+                        f"decode_csv: field {i} empty and no default")
+                cols[i].append(defaults[i])
+            else:
+                cols[i].append(field)
+    out = []
+    for vals, dt_name in zip(cols, dtype_names):
+        dt = dtypes_mod.as_dtype(dt_name)
+        if dt == dtypes_mod.string:
+            out.append(np.asarray(vals, dtype=object))
+        elif dt.is_integer:
+            out.append(np.asarray([int(v) for v in vals], dt.np_dtype))
+        else:
+            out.append(np.asarray([float(v) for v in vals], dt.np_dtype))
+    shape = np.asarray(inputs[0], dtype=object).shape
+    return [o.reshape(shape) for o in out]
+
+
+op_registry.register("DecodeCSV", lower=_lower_decode_csv,
+                     is_stateful=True, runs_on_host=True, n_outputs=None)
+
+
+def parse_tensor(serialized, out_type, name=None):
+    """(ref: parsing_ops.py ``parse_tensor``): TensorProto wire decode.
+    Our GraphDef serializes tensors as npy bytes (graph_io), so this
+    accepts that representation."""
+    x = ops_mod.convert_to_tensor(serialized, dtype=dtypes_mod.string)
+    dt = dtypes_mod.as_dtype(out_type)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("ParseTensor", [x], attrs={"out_type": dt.name},
+                     name=name or "ParseTensor",
+                     output_specs=[(shape_mod.TensorShape(None), dt)])
+    return op.outputs[0]
+
+
+def _lower_parse_tensor(ctx, op, inputs):
+    import io as _io
+
+    raw = inputs[0]
+    v = raw.item() if hasattr(raw, "item") and getattr(
+        raw, "ndim", 1) == 0 else raw
+    if isinstance(v, str):
+        v = v.encode("latin1")
+    arr = np.load(_io.BytesIO(v), allow_pickle=False)
+    want = dtypes_mod.as_dtype(op.attrs["out_type"])
+    if arr.dtype != want.np_dtype:
+        raise ValueError(
+            f"parse_tensor: serialized dtype {arr.dtype} != requested "
+            f"{want.name}")
+    return [arr]
+
+
+op_registry.register("ParseTensor", lower=_lower_parse_tensor,
+                     is_stateful=True, runs_on_host=True, n_outputs=1)
+
+
+def serialize_tensor(tensor, name=None):
+    """Inverse of parse_tensor (npy wire)."""
+    x = ops_mod.convert_to_tensor(tensor)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("SerializeTensor", [x], attrs={},
+                     name=name or "SerializeTensor",
+                     output_specs=[(shape_mod.scalar(),
+                                    dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def _lower_serialize_tensor(ctx, op, inputs):
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.save(buf, np.asarray(inputs[0]), allow_pickle=False)
+    return [np.asarray(buf.getvalue(), dtype=object)]
+
+
+op_registry.register("SerializeTensor", lower=_lower_serialize_tensor,
+                     is_stateful=True, runs_on_host=True, n_outputs=1)
+
+
+def decode_json_example(json_examples, name=None):
+    """(ref: parsing_ops.py ``decode_json_example``): JSON-mapped Example
+    protos re-encoded to binary Example wire (host stage)."""
+    x = ops_mod.convert_to_tensor(json_examples, dtype=dtypes_mod.string)
+    g = ops_mod.get_default_graph()
+    op = g.create_op("DecodeJSONExample", [x], attrs={},
+                     name=name or "DecodeJSONExample",
+                     output_specs=[(x.shape, dtypes_mod.string)])
+    return op.outputs[0]
+
+
+def _lower_decode_json_example(ctx, op, inputs):
+    import json as _json
+
+    from ..lib.example import make_example
+
+    def one(s):
+        if isinstance(s, bytes):
+            s = s.decode()
+        d = _json.loads(s)
+        feats = {}
+        for name, feat in d.get("features", {}).get("feature",
+                                                    {}).items():
+            if "floatList" in feat:
+                feats[name] = [float(v)
+                               for v in feat["floatList"]["value"]]
+            elif "int64List" in feat:
+                feats[name] = [int(v) for v in feat["int64List"]["value"]]
+            elif "bytesList" in feat:
+                import base64 as _b64
+
+                feats[name] = [_b64.b64decode(v)
+                               for v in feat["bytesList"]["value"]]
+        return make_example(**feats).SerializeToString()
+
+    arr = np.asarray(inputs[0], dtype=object)
+    out = np.vectorize(one, otypes=[object])(arr) if arr.shape else \
+        np.asarray(one(arr.item()), dtype=object)
+    return [out]
+
+
+op_registry.register("DecodeJSONExample", lower=_lower_decode_json_example,
+                     is_stateful=True, runs_on_host=True, n_outputs=1)

@@ -327,3 +327,53 @@ def _fq_vars_grad_impl(g, x, mn, mx, num_bits=8, narrow_range=False):
 op_registry.register_pure("FakeQuantArgsGrad", _fq_args_grad_impl)
 op_registry.register_pure("FakeQuantVarsGrad", _fq_vars_grad_impl,
                           n_outputs=3)
+
+
+def quantized_concat(concat_dim, values, input_mins, input_maxes,
+                     name=None):
+    """(ref: array_ops.cc ``QuantizedConcat``): dequantize each piece with
+    its own range, concat, requantize into the combined range."""
+    from . import array_ops, math_ops
+
+    deq = [dequantize(v, mn, mx)
+           for v, mn, mx in zip(values, input_mins, input_maxes)]
+    out = array_ops.concat(deq, axis=concat_dim, name=name)
+    out_min = math_ops.reduce_min(array_ops.stack(
+        [ops_mod.convert_to_tensor(m, dtype=dtypes_mod.float32)
+         for m in input_mins]))
+    out_max = math_ops.reduce_max(array_ops.stack(
+        [ops_mod.convert_to_tensor(m, dtype=dtypes_mod.float32)
+         for m in input_maxes]))
+    q, _, _ = quantize_v2(out, out_min, out_max,
+                          ops_mod.convert_to_tensor(values[0]).dtype)
+    return q, out_min, out_max
+
+
+def fake_quant_with_min_max_vars_per_channel_gradient(
+        gradients, inputs, min, max, num_bits=8,  # noqa: A002
+        narrow_range=False, name=None):
+    """Explicit per-channel gradient entry point (ref: array_ops.py
+    @@fake_quant_with_min_max_vars_per_channel_gradient)."""
+    g = ops_mod.convert_to_tensor(gradients, dtype=dtypes_mod.float32)
+    x = ops_mod.convert_to_tensor(inputs, dtype=dtypes_mod.float32)
+    mn = ops_mod.convert_to_tensor(min, dtype=dtypes_mod.float32)
+    mx = ops_mod.convert_to_tensor(max, dtype=dtypes_mod.float32)
+    from ..framework import tensor_shape as shape_mod
+
+    gr = ops_mod.get_default_graph()
+    op = gr.create_op(
+        "FakeQuantPerChannelGrad", [g, x, mn, mx],
+        attrs={"num_bits": int(num_bits),
+               "narrow_range": bool(narrow_range)},
+        name=name or "FakeQuantPerChannelGrad",
+        output_specs=[(x.shape, dtypes_mod.float32),
+                      (mn.shape, dtypes_mod.float32),
+                      (mx.shape, dtypes_mod.float32)])
+    return op.outputs[0], op.outputs[1], op.outputs[2]
+
+
+op_registry.register_pure(
+    "FakeQuantPerChannelGrad",
+    lambda g, x, mn, mx, num_bits=8, narrow_range=False:
+    list(_fq_pc_bwd(int(num_bits), bool(narrow_range), (x, mn, mx), g)),
+    n_outputs=3)

@@ -155,3 +155,6 @@ op_registry.register("GetSessionTensor", lower=_lower_get_tensor,
                      is_stateful=True, runs_on_host=True, n_outputs=1)
 op_registry.register("DeleteSessionTensor", lower=_lower_delete,
                      is_stateful=True, runs_on_host=True, n_outputs=0)
+
+
+get_session_handle_v2 = get_session_handle  # ref raw-op alias

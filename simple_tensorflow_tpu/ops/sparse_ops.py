@@ -233,3 +233,297 @@ def sparse_mask(a, mask_indices, name=None):
     return IndexedSlices(
         array_ops.gather(a.values, constant_op.constant(pos.astype(np.int32))),
         constant_op.constant(iv[keep]), a.dense_shape)
+
+
+# -- round-4 completion: the rest of the reference sparse family ------------
+# (ref: python/ops/sparse_ops.py sparse_reshape/split/transpose/
+#  fill_empty_rows/reset_shape/to_indicator/merge/softmax/maximum/minimum/
+#  reduce_sum_sparse; kernels core/kernels/sparse_*_op.cc).
+# Idiom of this file: indices/shape are construction-time static (the
+# TPU-safe regime); VALUES may be runtime tensors — value transforms
+# lower to segment ops over the static index structure.
+
+def _static_coo(sp, what):
+    iv = constant_op.constant_value(sp.indices)
+    shp = constant_op.constant_value(sp.dense_shape)
+    if iv is None or shp is None:
+        raise ValueError(
+            f"{what} needs static indices/dense_shape on TPU (runtime "
+            "sparsity patterns are data-dependent shapes; densify with "
+            "sparse_tensor_to_dense instead)")
+    return np.asarray(iv, np.int64), np.asarray(shp, np.int64)
+
+
+def sparse_reshape(sp_input, shape, name=None):
+    iv, shp = _static_coo(sp_input, "sparse_reshape")
+    new_shape = np.asarray(
+        constant_op.constant_value(ops_mod.convert_to_tensor(shape)),
+        np.int64)
+    if (new_shape == -1).any():
+        known = np.prod(new_shape[new_shape >= 0])
+        new_shape = new_shape.copy()
+        new_shape[new_shape == -1] = int(np.prod(shp) // max(known, 1))
+    lin = np.ravel_multi_index(tuple(iv.T), tuple(shp)) if iv.size else \
+        np.zeros((0,), np.int64)
+    new_idx = (np.stack(np.unravel_index(lin, tuple(new_shape)), axis=1)
+               if lin.size else np.zeros((0, len(new_shape)), np.int64))
+    return SparseTensor(constant_op.constant(new_idx),
+                        sp_input.values,
+                        constant_op.constant(new_shape))
+
+
+def sparse_transpose(sp_input, perm=None, name=None):
+    iv, shp = _static_coo(sp_input, "sparse_transpose")
+    if perm is None:
+        perm = list(builtins.range(len(shp)))[::-1]
+    perm = [int(p) for p in perm]
+    new_idx = iv[:, perm]
+    order = np.lexsort(tuple(new_idx[:, k]
+                             for k in builtins.range(
+                                 new_idx.shape[1] - 1, -1, -1)))
+    from . import array_ops
+
+    return SparseTensor(
+        constant_op.constant(new_idx[order]),
+        array_ops.gather(sp_input.values,
+                         constant_op.constant(order.astype(np.int32))),
+        constant_op.constant(shp[perm]))
+
+
+def sparse_split(sp_input=None, num_split=1, axis=0, name=None,
+                 split_dim=None):
+    if split_dim is not None:
+        axis = split_dim
+    iv, shp = _static_coo(sp_input, "sparse_split")
+    axis = int(axis)
+    size = int(shp[axis])
+    per = -(-size // int(num_split))  # ceil (ref: sizes differ by <=1)
+    out = []
+    for i in builtins.range(int(num_split)):
+        start = np.zeros(len(shp), np.int64)
+        start[axis] = i * per
+        sz = shp.copy()
+        sz[axis] = builtins.min(per, size - i * per)
+        out.append(sparse_slice(sp_input, start, sz))
+    return out
+
+
+def sparse_fill_empty_rows(sp_input, default_value, name=None):
+    iv, shp = _static_coo(sp_input, "sparse_fill_empty_rows")
+    from . import array_ops
+
+    n_rows = int(shp[0])
+    present = np.zeros(n_rows, bool)
+    if iv.size:
+        present[np.unique(iv[:, 0])] = True
+    empty = ~present
+    add_rows = np.nonzero(empty)[0]
+    add_idx = np.zeros((len(add_rows), iv.shape[1]), np.int64)
+    add_idx[:, 0] = add_rows
+    new_idx = np.concatenate([iv, add_idx], axis=0)
+    order = np.lexsort(tuple(new_idx[:, k] for k in
+                             builtins.range(new_idx.shape[1] - 1, -1, -1)))
+    default_t = ops_mod.convert_to_tensor(
+        default_value, dtype=sp_input.values.dtype.base_dtype)
+    fill = array_ops.fill([len(add_rows)], default_t) if len(add_rows) \
+        else array_ops.zeros([0], dtype=sp_input.values.dtype.base_dtype)
+    vals = array_ops.concat([sp_input.values, fill], axis=0)
+    vals = array_ops.gather(vals,
+                            constant_op.constant(order.astype(np.int32)))
+    return (SparseTensor(constant_op.constant(new_idx[order]), vals,
+                         sp_input.dense_shape),
+            constant_op.constant(empty))
+
+
+def sparse_reset_shape(sp_input, new_shape=None, name=None):
+    iv, shp = _static_coo(sp_input, "sparse_reset_shape")
+    if new_shape is None:  # tighten to the bounding box
+        tight = (iv.max(axis=0) + 1 if iv.size
+                 else np.zeros(len(shp), np.int64))
+        return SparseTensor(sp_input.indices, sp_input.values,
+                            constant_op.constant(tight.astype(np.int64)))
+    ns = np.asarray(constant_op.constant_value(
+        ops_mod.convert_to_tensor(new_shape)), np.int64)
+    if iv.size and (iv.max(axis=0) >= ns).any():
+        raise ValueError("new_shape is smaller than existing indices")
+    return SparseTensor(sp_input.indices, sp_input.values,
+                        constant_op.constant(ns))
+
+
+def sparse_to_indicator(sp_input, vocab_size, name=None):
+    """bool [d0..dn-2, vocab_size]: the VALUES are ids (ref semantics)."""
+    iv, shp = _static_coo(sp_input, "sparse_to_indicator")
+    from . import array_ops, math_ops
+
+    lead = [int(s) for s in shp[:-1]]
+    out_shape = lead + [int(vocab_size)]
+    if not iv.size:
+        return array_ops.zeros(out_shape, dtype=dtypes_mod.bool_)
+    rows = (np.ravel_multi_index(tuple(iv[:, :-1].T), tuple(lead))
+            if len(lead) > 1 else iv[:, 0])
+    ids = math_ops.cast(sp_input.values, dtypes_mod.int32)
+    flat_idx = (math_ops.cast(constant_op.constant(
+        rows.astype(np.int32) * int(vocab_size)), dtypes_mod.int32) + ids)
+    dense = array_ops.scatter_nd(
+        array_ops.expand_dims(flat_idx, 1),
+        array_ops.ones_like(ids, dtype=dtypes_mod.int32),
+        [int(np.prod(lead)) * int(vocab_size)])
+    return array_ops.reshape(math_ops.greater(dense, 0), out_shape)
+
+
+def sparse_merge(sp_ids, sp_values, vocab_size, name=None,
+                 already_sorted=False):
+    """(ref: sparse_ops.py ``sparse_merge``): ids become the last dim."""
+    iv, shp = _static_coo(sp_ids, "sparse_merge")
+    ids_v = constant_op.constant_value(sp_ids.values)
+    if ids_v is None:
+        raise ValueError("sparse_merge needs static ids on TPU")
+    new_idx = np.concatenate(
+        [iv[:, :1], np.asarray(ids_v, np.int64)[:, None]], axis=1)
+    order = (np.arange(len(new_idx)) if already_sorted
+             else np.lexsort((new_idx[:, 1], new_idx[:, 0])))
+    from . import array_ops
+
+    vals = array_ops.gather(sp_values.values,
+                            constant_op.constant(order.astype(np.int32)))
+    return SparseTensor(
+        constant_op.constant(new_idx[order]), vals,
+        constant_op.constant(np.asarray([shp[0], vocab_size], np.int64)))
+
+
+def _register_segment_value_op():
+    def impl(values, segment_ids=None, n_segments=1, mode="softmax"):
+        import jax
+
+        sums = jax.ops.segment_sum
+        seg = jnp.asarray(segment_ids)
+        if mode == "softmax":
+            vmax = jax.ops.segment_max(values, seg, n_segments)
+            e = jnp.exp(values - vmax[seg])
+            denom = sums(e, seg, n_segments)
+            return e / denom[seg]
+        raise ValueError(mode)
+
+    op_registry.register_pure("SparseSegmentValueTransform", impl)
+
+
+_register_segment_value_op()
+
+
+def sparse_softmax(sp_input, name=None):
+    """Softmax over the nonzero entries of each row (ref:
+    sparse_ops.py ``sparse_softmax``). Indices static, values runtime:
+    lowers to segment max/sum over the static row structure."""
+    iv, shp = _static_coo(sp_input, "sparse_softmax")
+    lead = iv[:, :-1]
+    if lead.size:
+        rows, seg = np.unique(lead, axis=0, return_inverse=True)
+        n_seg = len(rows)
+    else:
+        seg, n_seg = np.zeros((0,), np.int64), 1
+    g = ops_mod.get_default_graph()
+    v = sp_input.values
+    op = g.create_op("SparseSegmentValueTransform", [v],
+                     attrs={"segment_ids": tuple(int(s) for s in seg),
+                            "n_segments": int(n_seg), "mode": "softmax"},
+                     name=name or "sparse_softmax",
+                     output_specs=[(v.shape, v.dtype)])
+    return SparseTensor(sp_input.indices, op.outputs[0],
+                        sp_input.dense_shape)
+
+
+def _sparse_binary(a, b, fn_name, name):
+    ia, sa = _static_coo(a, fn_name)
+    ib, sb = _static_coo(b, fn_name)
+    if not np.array_equal(sa, sb):
+        raise ValueError(f"{fn_name}: dense shapes differ ({sa} vs {sb})")
+    union, inv = np.unique(np.concatenate([ia, ib], axis=0), axis=0,
+                           return_inverse=True)
+    n = len(union)
+    from . import array_ops, math_ops
+
+    inv_a, inv_b = inv[:len(ia)], inv[len(ia):]
+
+    def densify(sp, pos):
+        dense = array_ops.scatter_nd(
+            constant_op.constant(pos.astype(np.int32)[:, None]),
+            sp.values, [n])
+        return dense
+
+    da = densify(a, inv_a)
+    db = densify(b, inv_b)
+    out = (math_ops.maximum(da, db) if fn_name == "sparse_maximum"
+           else math_ops.minimum(da, db))
+    return SparseTensor(constant_op.constant(union), out,
+                        a.dense_shape)
+
+
+def sparse_maximum(sp_a, sp_b, name=None):
+    return _sparse_binary(sp_a, sp_b, "sparse_maximum", name)
+
+
+def sparse_minimum(sp_a, sp_b, name=None):
+    return _sparse_binary(sp_a, sp_b, "sparse_minimum", name)
+
+
+def sparse_reduce_sum_sparse(sp_input, axis=None, keep_dims=False,
+                             reduction_axes=None, name=None):
+    """Reduce and RE-SPARSIFY (ref: sparse_ops.py
+    ``sparse_reduce_sum_sparse``): output indices derive from the static
+    input structure; values are runtime segment sums."""
+    iv, shp = _static_coo(sp_input, "sparse_reduce_sum_sparse")
+    axes = axis if axis is not None else reduction_axes
+    if axes is None:
+        axes = list(builtins.range(len(shp)))
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    axes = sorted(int(a) % len(shp) for a in axes)
+    keep_axes = [d for d in builtins.range(len(shp)) if d not in axes]
+    from . import array_ops
+
+    if not keep_axes:
+        from . import math_ops
+
+        total = math_ops.reduce_sum(sp_input.values)
+        return SparseTensor(
+            constant_op.constant(np.zeros((1, 0), np.int64)),
+            array_ops.reshape(total, [1]),
+            constant_op.constant(np.zeros((0,), np.int64)))
+    kept = iv[:, keep_axes]
+    uniq, seg = np.unique(kept, axis=0, return_inverse=True)
+    n_seg = len(uniq)
+    g = ops_mod.get_default_graph()
+    v = sp_input.values
+    op = g.create_op(
+        "SegmentSumStatic", [v],
+        attrs={"segment_ids": tuple(int(s) for s in seg),
+               "n_segments": int(n_seg)},
+        name=name or "sparse_reduce_sum_sparse",
+        output_specs=[(shape_mod.TensorShape([n_seg]), v.dtype)])
+    new_shape = shp[keep_axes]
+    if keep_dims:
+        full = uniq
+        pads = []
+        ki = 0
+        cols = []
+        for d in builtins.range(len(shp)):
+            if d in keep_axes:
+                cols.append(full[:, ki])
+                ki += 1
+            else:
+                cols.append(np.zeros(len(full), np.int64))
+        full = np.stack(cols, axis=1) if len(full) else \
+            np.zeros((0, len(shp)), np.int64)
+        new_shape = shp.copy()
+        new_shape[axes] = 1
+        return SparseTensor(constant_op.constant(full), op.outputs[0],
+                            constant_op.constant(new_shape))
+    return SparseTensor(constant_op.constant(uniq), op.outputs[0],
+                        constant_op.constant(new_shape))
+
+
+op_registry.register_pure(
+    "SegmentSumStatic",
+    lambda values, segment_ids=(), n_segments=1: __import__("jax").ops
+    .segment_sum(values, jnp.asarray(np.asarray(segment_ids, np.int32)),
+                 n_segments))

@@ -25,6 +25,11 @@ op_registry.register_pure("RFFT2D", lambda x, fft_length=None: jnp.fft.rfft2(
     x, s=fft_length).astype(jnp.complex64))
 op_registry.register_pure("IRFFT2D", lambda x, fft_length=None: jnp.fft.irfft2(
     x, s=fft_length).astype(jnp.float32))
+op_registry.register_pure("RFFT3D", lambda x, fft_length=None: jnp.fft.rfftn(
+    x, s=fft_length, axes=(-3, -2, -1)).astype(jnp.complex64))
+op_registry.register_pure(
+    "IRFFT3D", lambda x, fft_length=None: jnp.fft.irfftn(
+        x, s=fft_length, axes=(-3, -2, -1)).astype(jnp.float32))
 
 
 def fft(input, name=None):  # noqa: A002
@@ -69,3 +74,15 @@ def rfft2d(input, fft_length=None, name=None):  # noqa: A002
 def irfft2d(input, fft_length=None, name=None):  # noqa: A002
     x = ops_mod.convert_to_tensor(input)
     return make_op("IRFFT2D", [x], attrs={"fft_length": fft_length}, name=name)
+
+
+def rfft3d(input, fft_length=None, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("RFFT3D", [x], attrs={"fft_length": fft_length},
+                   name=name)
+
+
+def irfft3d(input, fft_length=None, name=None):  # noqa: A002
+    x = ops_mod.convert_to_tensor(input)
+    return make_op("IRFFT3D", [x], attrs={"fft_length": fft_length},
+                   name=name)

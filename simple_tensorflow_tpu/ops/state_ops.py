@@ -13,6 +13,8 @@ enforced dynamically by its executor; here statically at lowering).
 
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 
 from ..framework import dtypes as dtypes_mod
@@ -266,3 +268,36 @@ def count_up_to(ref, limit, name=None):
                      name=name or "CountUpTo",
                      output_specs=[(ref.shape, ref.dtype.base_dtype)])
     return op.outputs[0]
+
+
+def _lower_scatter_nd_aug(fn):
+    def lower(ctx, op, inputs):
+        name = op.attrs["var_name"]
+        cur = ctx.read_var(name, op)
+        indices, updates = inputs
+        idx = builtins.tuple(indices[..., k]
+                             for k in range(indices.shape[-1]))
+        new = fn(cur, idx, updates)
+        ctx.write_var(name, new)
+        return [new]
+
+    return lower
+
+
+op_registry.register(
+    "ScatterNdAdd",
+    lower=_lower_scatter_nd_aug(lambda v, i, u: v.at[i].add(u)),
+    is_stateful=True)
+op_registry.register(
+    "ScatterNdSub",
+    lower=_lower_scatter_nd_aug(lambda v, i, u: v.at[i].add(-u)),
+    is_stateful=True)
+
+
+def scatter_nd_add(ref, indices, updates, use_locking=True, name=None):
+    """(ref: state_ops.py ``scatter_nd_add``)."""
+    return _scatter("ScatterNdAdd", ref, indices, updates, name)
+
+
+def scatter_nd_sub(ref, indices, updates, use_locking=True, name=None):
+    return _scatter("ScatterNdSub", ref, indices, updates, name)

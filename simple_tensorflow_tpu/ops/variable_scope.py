@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
+
+import numpy as np
 from typing import Callable, Optional
 
 from ..framework import dtypes as dtypes_mod
@@ -270,3 +272,15 @@ def min_max_variable_partitioner(max_partitions=1, axis=0,
         return {"axis": axis, "max_partitions": max_partitions}
 
     return partitioner
+
+
+def get_local_variable(name, shape=None, dtype=None, initializer=None,
+                       regularizer=None, trainable=False, collections=None,
+                       **kwargs):
+    """(ref: variable_scope.py ``get_local_variable``): a non-trainable
+    variable in the LOCAL_VARIABLES collection."""
+    collections = list(collections or []) + [
+        ops_mod.GraphKeys.LOCAL_VARIABLES]
+    return get_variable(name, shape=shape, dtype=dtype,
+                        initializer=initializer, regularizer=regularizer,
+                        trainable=False, collections=collections, **kwargs)

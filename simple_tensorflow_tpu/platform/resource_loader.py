@@ -24,3 +24,25 @@ def get_path_to_datafile(path):
 
 def readahead_file_path(path, readahead="128M"):
     return path
+
+
+def load_op_library(library_filename):
+    """(ref: framework/load_library.py ``load_op_library``). Custom ops in
+    stf register through the Python op_registry
+    (simple_tensorflow_tpu.framework.op_registry.register) rather than
+    REGISTER_OP static initializers; this loads the shared object (so C
+    code can use the stf C API in runtime_cc/stf_c.h) and returns a
+    minimal namespace."""
+    import ctypes
+    import types
+
+    lib = ctypes.CDLL(library_filename, mode=ctypes.RTLD_GLOBAL)
+    mod = types.SimpleNamespace()
+    mod._lib = lib
+    return mod
+
+
+def load_file_system_library(library_filename):
+    """(ref: ``load_file_system_library``): same loading mechanics; stf
+    file IO plugs in via lib/io/file_io.py registration instead."""
+    return load_op_library(library_filename)

@@ -6,3 +6,45 @@ from .summary import (
 from .writer.writer import FileWriter, FileWriterCache, EventsWriter
 from .summary_iterator import summary_iterator
 from . import tensorboard_logging
+
+
+def get_summary_description(node_def):
+    """(ref: summary.py ``get_summary_description``): the serialized
+    SummaryDescription of a summary op node. Our summary ops carry the
+    type tag in attrs."""
+    op = node_def
+    tag = getattr(op, "type", None) or getattr(op, "op", "")
+    return {"type_hint": {"ScalarSummary": "scalar",
+                          "HistogramSummary": "histogram",
+                          "ImageSummary": "image",
+                          "AudioSummary": "audio"}.get(tag, "")}
+
+
+_PLUGIN_ASSETS = {}
+
+
+class PluginAsset:
+    """(ref: summary/plugin_asset.py): named blob written next to event
+    files for TensorBoard plugins."""
+
+    plugin_name = None
+
+    def assets(self):
+        return {}
+
+
+def get_plugin_asset(plugin_asset_cls, graph=None):
+    from ..framework import graph as ops_mod
+
+    g = graph or ops_mod.get_default_graph()
+    key = (id(g), plugin_asset_cls.plugin_name)
+    if key not in _PLUGIN_ASSETS:
+        _PLUGIN_ASSETS[key] = plugin_asset_cls()
+    return _PLUGIN_ASSETS[key]
+
+
+def get_all_plugin_assets(graph=None):
+    from ..framework import graph as ops_mod
+
+    g = graph or ops_mod.get_default_graph()
+    return [v for (gid, _), v in _PLUGIN_ASSETS.items() if gid == id(g)]

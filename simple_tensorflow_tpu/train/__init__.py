@@ -22,6 +22,8 @@ from .checkpoint_utils import (
     load_checkpoint, load_variable, list_variables, init_from_checkpoint,
     CheckpointReader,
 )
+NewCheckpointReader = load_checkpoint  # TF-1 name (ref: pywrap NewCheckpointReader)
+from ..summary.summary_iterator import summary_iterator  # TF-1: tf.train.summary_iterator
 from .training_util import (
     get_global_step, create_global_step, get_or_create_global_step,
     global_step, assert_global_step,
@@ -48,6 +50,7 @@ from .input import (
     string_input_producer, input_producer, range_input_producer,
     slice_input_producer, batch, shuffle_batch, batch_join,
     shuffle_batch_join, limit_epochs, maybe_batch, maybe_shuffle_batch,
+    maybe_batch_join, maybe_shuffle_batch_join, match_filenames_once,
 )
 from .server_lib import Server, ClusterSpec
 from .device_setter import replica_device_setter

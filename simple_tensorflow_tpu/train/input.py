@@ -223,3 +223,41 @@ def maybe_shuffle_batch(tensors, batch_size, capacity, min_after_dequeue,
     queue_runner.add_queue_runner(
         queue_runner.QueueRunner(q, [enq] * num_threads))
     return q.dequeue_many(batch_size)
+
+
+def maybe_batch_join(tensors_list, keep_input, batch_size, capacity=32,
+                     enqueue_many=False, shapes=None, dynamic_pad=False,
+                     allow_smaller_final_batch=False, shared_name=None,
+                     name="maybe_batch_join"):
+    """(ref: input.py ``maybe_batch_join``)."""
+    return maybe_batch(tensors_list[0], keep_input, batch_size,
+                       num_threads=len(tensors_list), capacity=capacity,
+                       enqueue_many=enqueue_many, shapes=shapes, name=name)
+
+
+def maybe_shuffle_batch_join(tensors_list, batch_size, capacity,
+                             min_after_dequeue, keep_input, seed=None,
+                             enqueue_many=False, shapes=None,
+                             allow_smaller_final_batch=False,
+                             shared_name=None,
+                             name="maybe_shuffle_batch_join"):
+    """(ref: input.py ``maybe_shuffle_batch_join``)."""
+    return maybe_shuffle_batch(tensors_list[0], batch_size, capacity,
+                               min_after_dequeue, keep_input,
+                               num_threads=len(tensors_list), seed=seed,
+                               enqueue_many=enqueue_many, shapes=shapes,
+                               name=name)
+
+
+def match_filenames_once(pattern, name=None):
+    """(ref: io_ops.py ``match_filenames_once``). The reference stores the
+    glob in a local variable so re-running the initializer re-globs;
+    strings never enter the TPU store here, so the glob happens at graph
+    construction and the result is a host string constant — same value
+    for the common build-then-train flow."""
+    import glob as _glob
+
+    files = sorted(_glob.glob(pattern if isinstance(pattern, str)
+                              else str(pattern)))
+    return constant_op.constant(np.array(files, dtype=object),
+                                name=name or "matching_filenames")
