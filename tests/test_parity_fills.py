@@ -1,0 +1,328 @@
+"""Round-4 parity-fill behavior tests: the functional checks behind
+tests/test_api_parity.py's name sweep — each family exercised with
+reference-semantics expectations (ref files cited per module docstring
+of the implementation)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+
+class TestGradientOverrides:
+    def test_register_gradient_with_override_map(self):
+        stf.reset_default_graph()
+
+        @stf.RegisterGradient("TestGuidedRelu")
+        def _grad(op, grad):
+            return stf.where(
+                stf.logical_and(grad > 0.0, op.inputs[0] > 0.0), grad,
+                stf.zeros_like(grad))
+
+        g = stf.get_default_graph()
+        x = stf.constant(np.array([-1.0, 2.0, 3.0], np.float32))
+        with g.gradient_override_map({"Relu": "TestGuidedRelu"}):
+            y = stf.nn.relu(x)
+        loss = stf.reduce_sum(
+            y * stf.constant(np.array([1.0, -5.0, 2.0], np.float32)))
+        (gx,) = stf.gradients(loss, [x])
+        with stf.Session() as sess:
+            np.testing.assert_allclose(sess.run(gx), [0.0, 0.0, 2.0])
+
+    def test_not_differentiable(self):
+        stf.reset_default_graph()
+        stf.NotDifferentiable("Rint")
+        x = stf.constant(np.array([1.4], np.float32))
+        y = stf.rint(x) * x
+        (g,) = stf.gradients(stf.reduce_sum(y), [x])
+        with stf.Session() as sess:
+            np.testing.assert_allclose(sess.run(g), [1.0])
+
+    def test_hessians(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.array([1.0, 2.0], np.float32))
+        (h,) = stf.hessians(stf.reduce_sum(x * x * x), [x])
+        with stf.Session() as sess:
+            hv = sess.run(h)
+        np.testing.assert_allclose(hv, np.diag(6.0 * np.array([1.0, 2.0])),
+                                   rtol=1e-5)
+
+
+class TestNnFills:
+    def test_max_pool_with_argmax_overlapping_windows(self):
+        # the round-4 review's failure case: stride < ksize
+        stf.reset_default_graph()
+        x = stf.constant(np.array([[[[1.], [2.], [3.]]]], np.float32))
+        pooled, am = stf.nn.max_pool_with_argmax(
+            x, [1, 1, 2, 1], [1, 1, 1, 1], "SAME")
+        with stf.Session() as sess:
+            pv, av = sess.run([pooled, am])
+        np.testing.assert_allclose(pv.ravel(), [2., 3., 3.])
+        np.testing.assert_array_equal(av.ravel(), [1, 2, 2])
+
+    def test_pool_with_dilation(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.arange(25, dtype=np.float32).reshape(1, 5, 5, 1))
+        y = stf.nn.pool(x, [2, 2], "MAX", "VALID", dilation_rate=[2, 2])
+        with stf.Session() as sess:
+            yv = sess.run(y)
+        assert yv[0, 0, 0, 0] == 12.0  # max over {0,2,10,12}
+
+    def test_conv1d_matches_manual(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.ones((1, 6, 2), np.float32))
+        w = stf.constant(np.ones((3, 2, 1), np.float32))
+        y = stf.nn.conv1d(x, w, 1, "VALID")
+        with stf.Session() as sess:
+            np.testing.assert_allclose(sess.run(y).ravel(), [6.0] * 4)
+
+    def test_fractional_pool_variants_and_shapes(self):
+        stf.reset_default_graph()
+        xv = np.random.RandomState(0).rand(1, 12, 12, 1).astype(np.float32)
+        o1, rs, cs = stf.nn.fractional_max_pool(
+            stf.constant(xv), [1.0, 1.5, 1.5, 1.0], pseudo_random=True,
+            seed=5)
+        o2, _, _ = stf.nn.fractional_avg_pool(
+            stf.constant(xv), [1.0, 1.5, 1.5, 1.0], pseudo_random=True,
+            seed=5)  # same variant + seed -> same regions as o1
+        with stf.Session() as sess:
+            o1v, o2v, rsv = sess.run([o1, o2, rs])
+        assert o1v.shape == (1, 8, 8, 1) == o2v.shape
+        assert rsv[0] == 0 and rsv[-1] == 12
+        assert (o1v >= o2v - 1e-6).all()  # max >= avg per region
+
+    def test_conv_backprops_consistent_with_autodiff(self):
+        stf.reset_default_graph()
+        xv = np.random.RandomState(1).randn(1, 5, 5, 2).astype(np.float32)
+        wv = np.random.RandomState(2).randn(3, 3, 2, 4).astype(np.float32)
+        x, w = stf.constant(xv), stf.constant(wv)
+        y = stf.nn.conv2d(x, w, [1, 1, 1, 1], "SAME")
+        (gw_ref,) = stf.gradients(stf.reduce_sum(y), [w])
+        gw = stf.nn.conv2d_backprop_filter(x, [3, 3, 2, 4],
+                                           stf.ones_like(y),
+                                           [1, 1, 1, 1], "SAME")
+        with stf.Session() as sess:
+            a, b = sess.run([gw_ref, gw])
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_with_space_to_batch_pads_odd_dims(self):
+        stf.reset_default_graph()
+
+        def op_fn(v, num_spatial_dims=None, padding=None):
+            return v * 2.0
+
+        y = stf.nn.with_space_to_batch(
+            stf.constant(np.ones((1, 7, 7, 1), np.float32)), [2, 2],
+            "VALID", op_fn)
+        with stf.Session() as sess:
+            assert sess.run(y).shape[1] >= 7
+
+
+class TestCtcBeamSearch:
+    def _logits(self, path, C=4):
+        T = len(path)
+        lg = np.full((T, 1, C), -5.0, np.float32)
+        for t, c in enumerate(path):
+            lg[t, 0, c] = 5.0
+        return lg
+
+    def test_decodes_and_ranks(self):
+        stf.reset_default_graph()
+        lg = self._logits([0, 0, 3, 1, 1, 3])  # blank=3
+        dec, lp = stf.nn.ctc_beam_search_decoder(
+            stf.constant(lg), stf.constant(np.array([6], np.int32)),
+            beam_width=8, top_paths=2)
+        with stf.Session() as sess:
+            vals, lpv = sess.run([dec[0].values, lp])
+        np.testing.assert_array_equal(vals, [0, 1])
+        assert lpv[0, 0] >= lpv[0, 1]
+
+    def test_merge_repeated(self):
+        stf.reset_default_graph()
+        lg = self._logits([0, 0, 1], C=3)  # blank=2, no blank between 0s
+        dec_m, _ = stf.nn.ctc_beam_search_decoder(
+            stf.constant(lg), stf.constant(np.array([3], np.int32)),
+            merge_repeated=True, beam_width=4)
+        with stf.Session() as sess:
+            vm = sess.run(dec_m[0].values)
+        np.testing.assert_array_equal(vm, [0, 1])
+
+
+class TestSparseFamily:
+    def _sp(self):
+        from simple_tensorflow_tpu.framework.sparse_tensor import \
+            SparseTensor
+
+        return SparseTensor(
+            np.array([[0, 0], [0, 2], [2, 1]], np.int64),
+            stf.constant(np.array([1., 2., 3.], np.float32)),
+            np.array([3, 4], np.int64))
+
+    def test_reshape_transpose_split(self):
+        stf.reset_default_graph()
+        sp = self._sp()
+        r = stf.sparse_reshape(sp, [4, 3])
+        t = stf.sparse_transpose(sp)
+        parts = stf.sparse_split(sp_input=sp, num_split=2, axis=0)
+        with stf.Session() as sess:
+            rv = sess.run(stf.sparse_tensor_to_dense(r))
+            tv = sess.run(stf.sparse_tensor_to_dense(t))
+            p0 = sess.run(stf.sparse_tensor_to_dense(parts[0]))
+        assert rv.shape == (4, 3) and rv[0, 0] == 1. and rv[0, 2] == 2.
+        assert tv.shape == (4, 3) and tv[2, 0] == 2. and tv[1, 2] == 3.
+        assert p0.shape == (2, 4) and p0[0, 0] == 1.
+
+    def test_fill_empty_rows_and_softmax(self):
+        stf.reset_default_graph()
+        sp = self._sp()
+        filled, empty = stf.sparse_fill_empty_rows(sp, -1.0)
+        sm = stf.sparse_softmax(sp)
+        with stf.Session() as sess:
+            fv, ev = sess.run([stf.sparse_tensor_to_dense(filled), empty])
+            smv = sess.run(sm.values)
+        assert ev.tolist() == [False, True, False]
+        assert fv[1, 0] == -1.0
+        np.testing.assert_allclose(smv[0] + smv[1], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(smv[2], 1.0, rtol=1e-6)
+
+    def test_maximum_reduce_sum_sparse(self):
+        from simple_tensorflow_tpu.framework.sparse_tensor import \
+            SparseTensor
+
+        stf.reset_default_graph()
+        sp = self._sp()
+        other = SparseTensor(np.array([[0, 0], [1, 1]], np.int64),
+                             stf.constant(np.array([5., 1.], np.float32)),
+                             np.array([3, 4], np.int64))
+        mx = stf.sparse_maximum(sp, other)
+        red = stf.sparse_reduce_sum_sparse(sp, axis=1)
+        with stf.Session() as sess:
+            mv = sess.run(stf.sparse_tensor_to_dense(mx))
+            ri, rv = sess.run([red.indices, red.values])
+        assert mv[0, 0] == 5. and mv[1, 1] == 1. and mv[0, 2] == 2.
+        np.testing.assert_array_equal(ri.ravel(), [0, 2])
+        np.testing.assert_allclose(rv, [3., 3.])
+
+    def test_sparse_segment_ops(self):
+        stf.reset_default_graph()
+        data = stf.constant(np.arange(8, dtype=np.float32).reshape(4, 2))
+        idx = stf.constant(np.array([0, 2, 3], np.int32))
+        seg = stf.constant(np.array([0, 0, 1], np.int32))
+        s = stf.sparse_segment_sum(data, idx, seg)
+        m = stf.sparse_segment_mean(data, idx, seg)
+        q = stf.sparse_segment_sqrt_n(data, idx, seg)
+        with stf.Session() as sess:
+            sv, mv, qv = sess.run([s, m, q])
+        np.testing.assert_allclose(sv, [[4., 6.], [6., 7.]])
+        np.testing.assert_allclose(mv, [[2., 3.], [6., 7.]])
+        np.testing.assert_allclose(qv, [[4 / np.sqrt(2), 6 / np.sqrt(2)],
+                                        [6., 7.]])
+
+
+class TestParsingFills:
+    def test_decode_csv_with_empty_record(self):
+        stf.reset_default_graph()
+        a, b = stf.decode_csv(
+            stf.constant(np.array(["1,2", ""], dtype=object)),
+            [[-1], [-9]])
+        with stf.Session() as sess:
+            av, bv = sess.run([a, b])
+        np.testing.assert_array_equal(av, [1, -1])
+        np.testing.assert_array_equal(bv, [2, -9])
+
+    def test_serialize_parse_tensor_round_trip(self):
+        stf.reset_default_graph()
+        x = stf.constant(np.arange(6, dtype=np.float32).reshape(2, 3))
+        rt = stf.parse_tensor(stf.serialize_tensor(x), stf.float32)
+        with stf.Session() as sess:
+            np.testing.assert_allclose(sess.run(rt),
+                                       np.arange(6).reshape(2, 3))
+
+    def test_decode_json_example(self):
+        import simple_tensorflow_tpu.ops.parsing_ops as po
+
+        stf.reset_default_graph()
+        je = stf.decode_json_example(stf.constant(np.array(
+            ['{"features":{"feature":{"v":'
+             '{"floatList":{"value":[1.5,2.5]}}}}}'], dtype=object)))
+        parsed = stf.parse_example(
+            je, {"v": po.FixedLenFeature([2], stf.float32)})
+        with stf.Session() as sess:
+            np.testing.assert_allclose(sess.run(parsed["v"]),
+                                       [[1.5, 2.5]])
+
+
+class TestMetricsFills:
+    def test_class_id_metrics(self):
+        from simple_tensorflow_tpu import metrics as M
+
+        stf.reset_default_graph()
+        logits = stf.constant(np.array(
+            [[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32))
+        labs = stf.constant(np.array([0, 1, 1], np.int32))
+        _, rk = M.recall_at_k(labs, logits, 1, class_id=1)
+        _, pk = M.sparse_precision_at_k(labs, logits, 1, class_id=1)
+        with stf.Session() as sess:
+            sess.run(stf.local_variables_initializer())
+            rkv, pkv = sess.run([rk, pk])
+        np.testing.assert_allclose(rkv, 0.5)   # label-1 rows: hit 1 of 2
+        np.testing.assert_allclose(pkv, 1.0)   # top-1==1 rows: row1, correct
+
+    def test_sensitivity_specificity_pair(self):
+        from simple_tensorflow_tpu import metrics as M
+
+        stf.reset_default_graph()
+        labs = stf.constant(np.array([1., 1., 0., 0.], np.float32))
+        preds = stf.constant(np.array([0.9, 0.6, 0.4, 0.1], np.float32))
+        _, sas = M.sensitivity_at_specificity(labs, preds, 0.9)
+        with stf.Session() as sess:
+            sess.run(stf.local_variables_initializer())
+            assert 0.0 <= sess.run(sas) <= 1.0
+
+
+class TestMiscFills:
+    def test_unique_with_counts_and_broadcast(self):
+        stf.reset_default_graph()
+        v, i, c = stf.unique_with_counts(
+            stf.constant(np.array([1, 2, 1, 3, 1], np.int32)))
+        bs = stf.broadcast_static_shape([4, 1], [3])
+        with stf.Session() as sess:
+            vv, iv, cv = sess.run([v, i, c])
+        np.testing.assert_array_equal(vv, [1, 2, 3])
+        np.testing.assert_array_equal(cv, [3, 1, 1])
+        assert bs.as_list() == [4, 3]
+
+    def test_linalg_solves(self):
+        stf.reset_default_graph()
+        A = np.array([[4., 1.], [1., 3.]], np.float32)
+        rhs = np.array([[1.], [2.]], np.float32)
+        chol = np.linalg.cholesky(A).astype(np.float32)
+        cs = stf.cholesky_solve(stf.constant(chol), stf.constant(rhs))
+        ls = stf.matrix_solve_ls(stf.constant(A), stf.constant(rhs))
+        with stf.Session() as sess:
+            np.testing.assert_allclose(sess.run(cs),
+                                       np.linalg.solve(A, rhs), rtol=1e-4)
+            np.testing.assert_allclose(sess.run(ls),
+                                       np.linalg.solve(A, rhs), rtol=1e-4)
+
+    def test_image_fills(self):
+        stf.reset_default_graph()
+        boxes = stf.constant(np.array(
+            [[0, 0, 1, 1], [0, 0, .95, .95]], np.float32))
+        scores = stf.constant(np.array([0.9, 0.8], np.float32))
+        sel = stf.image.non_max_suppression(boxes, scores, 2, 0.5)
+        cr = stf.image.crop_and_resize(
+            stf.constant(np.arange(32, dtype=np.float32).reshape(1, 4, 8, 1)),
+            np.array([[0, 0, 1, 1]], np.float32),
+            np.array([0], np.int32), [2, 2])
+        with stf.Session() as sess:
+            sv, crv = sess.run([sel, cr])
+        np.testing.assert_array_equal(sv, [0])
+        np.testing.assert_allclose(crv.ravel(), [0., 7., 24., 31.])
+
+    def test_ptb_style_get_local_variable(self):
+        stf.reset_default_graph()
+        v = stf.get_local_variable("parity_lv", shape=(2,),
+                                   initializer=stf.ones_initializer())
+        assert not v.trainable
+        assert v in stf.local_variables()
