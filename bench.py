@@ -126,7 +126,11 @@ def _measure_resnet(batch, image_size, steps, warmup, device_kind,
         dtype=stf.bfloat16, learning_rate=0.1,
         # remat residual blocks: trades ~1.3x fwd FLOPs for the saved-
         # activation bytes — net win when HBM-bandwidth-bound (v5e)
-        recompute=os.environ.get("BENCH_RESNET_RECOMPUTE", "0") == "1")
+        recompute=os.environ.get("BENCH_RESNET_RECOMPUTE", "0") == "1",
+        # MLPerf stem: space_to_depth conv0 (3-ch conv is the MXU's
+        # worst case); flip on with BENCH_RESNET_S2D=1
+        conv0_space_to_depth=os.environ.get("BENCH_RESNET_S2D",
+                                            "0") == "1")
     images, labels = resnet.synthetic_imagenet(batch, image_size,
                                                dtype=np.float32)
     # Stage the batch in HBM once: the bench measures the training step, not
@@ -614,12 +618,18 @@ def _run_model(model, platform, kind, errors):
             return result
         fallback["error"] = f"resnet_dp_run_failed: {err}"
         return fallback
+    # per-model TPU time budgets: the headline metrics (resnet, bert) get
+    # the full window; secondary configs are bounded so one slow compile
+    # cannot eat the driver's whole bench budget
+    default_timeout = {"resnet": "1500", "bert": "1500",
+                       "transformer": "1200", "mnist": "300"}.get(
+        model, "900")
     if platform is not None and platform != "cpu":
         env = dict(os.environ)
         env["BENCH_PLATFORM"] = f"{platform}|{kind}"
         env["BENCH_MODEL"] = model
         result, err = _spawn_child(
-            env, int(os.environ.get("BENCH_TIMEOUT", "1500")))
+            env, int(os.environ.get("BENCH_TIMEOUT", default_timeout)))
         if result is not None:
             return result
         errors.append(f"{model}_tpu_run_failed: {err}")
@@ -634,7 +644,7 @@ def _run_model(model, platform, kind, errors):
     env["BENCH_PLATFORM"] = "cpu|"
     env["BENCH_MODEL"] = model
     result, err = _spawn_child(
-        env, int(os.environ.get("BENCH_TIMEOUT", "1500")))
+        env, int(os.environ.get("BENCH_TIMEOUT", default_timeout)))
     if result is not None:
         result.pop("mfu", None)  # meaningless vs placeholder CPU peak
         result["error"] = "; ".join(errors)

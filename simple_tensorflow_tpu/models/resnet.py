@@ -56,7 +56,7 @@ def _bottleneck(x, filters, stride, training, projection, name):
 
 
 def resnet_forward(x, num_classes=1000, depth=50, training=True,
-                   recompute=False):
+                   recompute=False, conv0_space_to_depth=False):
     """Build the forward graph; x is NHWC.
 
     recompute=True rematerializes each residual block's activations in
@@ -64,12 +64,32 @@ def resnet_forward(x, num_classes=1000, depth=50, training=True,
     dominant byte sink of the training step — saved block activations —
     at ~1.3x forward FLOPs, which ResNet can afford on v5e where the
     step is HBM-bandwidth-bound (artifacts/resnet_perf_diagnosis.md).
+
+    conv0_space_to_depth=True reformulates the stem (the MLPerf TPU
+    recipe): space_to_depth(block 2) turns the 3-channel 224px input
+    into 12 channels at 112px, and conv0 becomes a 4x4 stride-1 conv —
+    mathematically an 8x8/s2 conv on the original image (a superset of
+    the 7x7), exactly under VALID padding and modulo border handling
+    under the SAME padding used here (the SAME pads land at different
+    original-pixel offsets; train-from-scratch is unaffected, but do
+    not expect bit-parity when resharding a pretrained 7x7 stem). The 3-channel conv is the MXU's worst case (channels pad
+    to the 128-lane width at <3% utilization); 12 channels quadruple
+    that and drop the strided access pattern.
     """
     from . import common
 
     blocks = _BLOCKS[depth]
     with stf.variable_scope("resnet", reuse=stf.AUTO_REUSE):
-        h = _conv(x, 64, 7, 2, "conv0")
+        if conv0_space_to_depth:
+            hh, ww = x.shape[1].value, x.shape[2].value
+            if hh is None or ww is None or hh % 2 or ww % 2:
+                raise ValueError(
+                    f"conv0_space_to_depth needs even static spatial "
+                    f"dims, got {hh}x{ww}")
+            h = stf.space_to_depth(x, 2)        # [B, H/2, W/2, 12]
+            h = _conv(h, 64, 4, 1, "conv0_s2d")  # ~ 8x8/s2 on the image
+        else:
+            h = _conv(x, 64, 7, 2, "conv0")
         h = stf.nn.relu(_bn(h, training, "bn0"))
         h = stf.layers.max_pooling2d(h, 3, 2, padding="same", name="pool0")
         block_idx = 0
@@ -99,7 +119,8 @@ def resnet_forward(x, num_classes=1000, depth=50, training=True,
 def resnet50_train_model(batch_size=64, image_size=224, num_classes=1000,
                          dtype=stf.bfloat16, learning_rate=0.1,
                          momentum=0.9, weight_decay=1e-4,
-                         data_parallel=False, recompute=False):
+                         data_parallel=False, recompute=False,
+                         conv0_space_to_depth=False):
     """Full training graph: images -> loss -> momentum-SGD update.
 
     With ``data_parallel`` and an active Mesh, the batch shards over 'dp'.
@@ -116,7 +137,8 @@ def resnet50_train_model(batch_size=64, image_size=224, num_classes=1000,
             parallel.shard_feed(labels, "dp")
 
     logits = resnet_forward(x, num_classes=num_classes, training=True,
-                            recompute=recompute)
+                            recompute=recompute,
+                            conv0_space_to_depth=conv0_space_to_depth)
     xent = stf.reduce_mean(stf.nn.sparse_softmax_cross_entropy_with_logits(
         labels=labels, logits=logits))
     # L2 on conv/fc kernels only (reference recipe: no BN params)

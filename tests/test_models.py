@@ -412,3 +412,45 @@ def test_ptb_lstm_eval_mode_no_dropout_deterministic():
         a = sess.run(m["loss"], feed)
         b = sess.run(m["loss"], feed)
     assert a == b  # no dropout in eval: bit-deterministic
+
+
+def test_conv0_space_to_depth_equivalence_and_training():
+    """The S2D stem is an exact reformulation: an 8x8/s2 VALID conv on
+    the image equals a 4x4/s1 VALID conv on space_to_depth(image, 2)
+    with re-laid-out weights (channel order (dy*2+dx)*C + c). Also: the
+    full model trains with conv0_space_to_depth=True."""
+    rng = np.random.RandomState(0)
+    img = rng.randn(2, 16, 16, 3).astype(np.float32)
+    w8 = rng.randn(8, 8, 3, 5).astype(np.float32)
+    # re-layout: w4[py, px, (dy*2+dx)*3 + c, o] = w8[2py+dy, 2px+dx, c, o]
+    w4 = np.zeros((4, 4, 12, 5), np.float32)
+    for py in range(4):
+        for px in range(4):
+            for dy in range(2):
+                for dx in range(2):
+                    w4[py, px, (dy * 2 + dx) * 3:(dy * 2 + dx) * 3 + 3] = \
+                        w8[2 * py + dy, 2 * px + dx]
+    stf.reset_default_graph()
+    x = stf.constant(img)
+    ref = stf.nn.conv2d(x, stf.constant(w8), [1, 2, 2, 1], "VALID")
+    s2d = stf.space_to_depth(x, 2)
+    alt = stf.nn.conv2d(s2d, stf.constant(w4), [1, 1, 1, 1], "VALID")
+    with stf.Session() as sess:
+        rv, av = sess.run([ref, alt])
+    np.testing.assert_allclose(rv, av, rtol=1e-4, atol=1e-4)
+
+    # model trains with the S2D stem
+    from simple_tensorflow_tpu.models import resnet
+
+    stf.reset_default_graph()
+    m = resnet.resnet50_train_model(batch_size=4, image_size=64,
+                                    num_classes=10, dtype=stf.float32,
+                                    learning_rate=1e-2,
+                                    conv0_space_to_depth=True)
+    images, labels = resnet.synthetic_imagenet(4, 64)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        _, l1 = sess.run([m["train_op"], m["loss"]],
+                         feed_dict={m["images"]: images,
+                                    m["labels"]: labels % 10})
+    assert np.isfinite(l1)
