@@ -113,24 +113,26 @@ def _roofline_info(sess, feed, sec_per_step, platform):
 
 
 def _measure_resnet(batch, image_size, steps, warmup, device_kind,
-                    platform):
+                    platform, recompute=None, s2d=None):
     import jax
     import jax.numpy as jnp
 
     import simple_tensorflow_tpu as stf
     from simple_tensorflow_tpu.models import resnet
 
+    if recompute is None:
+        # remat residual blocks: trades ~1.3x fwd FLOPs for the saved-
+        # activation bytes — net win when HBM-bandwidth-bound (v5e)
+        recompute = os.environ.get("BENCH_RESNET_RECOMPUTE", "0") == "1"
+    if s2d is None:
+        # MLPerf stem: space_to_depth conv0 (3-ch conv is the MXU's
+        # worst case); flip on with BENCH_RESNET_S2D=1
+        s2d = os.environ.get("BENCH_RESNET_S2D", "0") == "1"
     stf.reset_default_graph()
     m = resnet.resnet50_train_model(
         batch_size=batch, image_size=image_size,
         dtype=stf.bfloat16, learning_rate=0.1,
-        # remat residual blocks: trades ~1.3x fwd FLOPs for the saved-
-        # activation bytes — net win when HBM-bandwidth-bound (v5e)
-        recompute=os.environ.get("BENCH_RESNET_RECOMPUTE", "0") == "1",
-        # MLPerf stem: space_to_depth conv0 (3-ch conv is the MXU's
-        # worst case); flip on with BENCH_RESNET_S2D=1
-        conv0_space_to_depth=os.environ.get("BENCH_RESNET_S2D",
-                                            "0") == "1")
+        recompute=recompute, conv0_space_to_depth=s2d)
     images, labels = resnet.synthetic_imagenet(batch, image_size,
                                                dtype=np.float32)
     # Stage the batch in HBM once: the bench measures the training step, not
@@ -210,12 +212,19 @@ def run_bench(platform, device_kind):
     """ResNet-50. On TPU, BENCH_BATCH may be a comma list (default
     "256,512"): each batch size is measured and the best throughput wins
     (batch is a free parameter of the images/sec metric; larger batches
-    amortize bandwidth until HBM runs out — OOM candidates are skipped)."""
+    amortize bandwidth until HBM runs out — OOM candidates are skipped).
+
+    After the batch sweep, the per-step byte levers — per-block remat
+    (`recompute`) and the MLPerf space-to-depth stem (`s2d`) — are tried
+    at the winning batch; the best variant is reported with its flags.
+    Set BENCH_RESNET_VARIANTS=0 to pin the env-selected variant only.
+    """
     batches = [int(b) for b in
                os.environ.get("BENCH_BATCH", "256,512").split(",") if b]
     image_size = int(os.environ.get("BENCH_IMAGE", "224"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    try_variants = os.environ.get("BENCH_RESNET_VARIANTS", "1") == "1"
 
     if platform == "cpu":
         # CI / no-TPU fallback: shrink so the bench still completes.
@@ -223,10 +232,52 @@ def run_bench(platform, device_kind):
         image_size = min(image_size, 64)
         steps = min(steps, 5)
         warmup = 2
+        try_variants = False
 
-    return _sweep_batches(
+    # env flags pin the BASE variant; the sweep then only tries configs
+    # that differ from it (no duplicate compiles, honest labels)
+    env_rc = os.environ.get("BENCH_RESNET_RECOMPUTE", "0") == "1"
+    env_s2d = os.environ.get("BENCH_RESNET_S2D", "0") == "1"
+
+    def _vname(rc, s2):
+        return {(False, False): "base", (True, False): "recompute",
+                (False, True): "s2d", (True, True): "recompute+s2d"}[
+            (rc, s2)]
+
+    best = _sweep_batches(
         batches, lambda b: _measure_resnet(b, image_size, steps, warmup,
                                            device_kind, platform))
+    if not try_variants:
+        return best
+    best["variant"] = _vname(env_rc, env_s2d)
+    b = best["batch"]
+    base_sweep = best.get("batch_sweep")
+    base_skipped = best.get("skipped")
+    variant_log = [{"variant": best["variant"], "value": best["value"]}]
+    for rc, s2 in ((True, False), (False, True), (True, True)):
+        if (rc, s2) == (env_rc, env_s2d):
+            continue  # already measured as the base
+        name = _vname(rc, s2)
+        try:
+            r = _measure_resnet(b, image_size, steps, warmup, device_kind,
+                                platform, recompute=rc, s2d=s2)
+        except Exception as e:  # OOM etc.: variant skipped, not fatal
+            variant_log.append({"variant": name,
+                                "error": f"{type(e).__name__}: "
+                                         f"{str(e)[:200]}"})
+            continue
+        variant_log.append({"variant": name, "value": r["value"],
+                            "mfu": r.get("mfu")})
+        if r["value"] > best["value"]:
+            r["variant"] = name
+            best = r
+    # carry the batch-sweep evidence (incl. OOM skips) whoever wins
+    if base_sweep is not None:
+        best["batch_sweep"] = base_sweep
+    if base_skipped is not None:
+        best["skipped"] = base_skipped
+    best["variant_sweep"] = variant_log
+    return best
 
 
 def run_bench_bert(platform, device_kind):
@@ -621,7 +672,8 @@ def _run_model(model, platform, kind, errors):
     # per-model TPU time budgets: the headline metrics (resnet, bert) get
     # the full window; secondary configs are bounded so one slow compile
     # cannot eat the driver's whole bench budget
-    default_timeout = {"resnet": "1500", "bert": "1500",
+    # resnet runs up to 5 compile+measure cycles (2 batch + 3 variants)
+    default_timeout = {"resnet": "2400", "bert": "1500",
                        "transformer": "1200", "mnist": "300"}.get(
         model, "900")
     if platform is not None and platform != "cpu":

@@ -72,9 +72,10 @@ def resnet_forward(x, num_classes=1000, depth=50, training=True,
     the 7x7), exactly under VALID padding and modulo border handling
     under the SAME padding used here (the SAME pads land at different
     original-pixel offsets; train-from-scratch is unaffected, but do
-    not expect bit-parity when resharding a pretrained 7x7 stem). The 3-channel conv is the MXU's worst case (channels pad
-    to the 128-lane width at <3% utilization); 12 channels quadruple
-    that and drop the strided access pattern.
+    not expect bit-parity when resharding a pretrained 7x7 stem).
+    The 3-channel conv is the MXU's worst case (channels pad to the
+    128-lane width at <3% utilization); 12 channels quadruple that and
+    drop the strided access pattern.
     """
     from . import common
 
