@@ -5,8 +5,10 @@
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstring>
 #include <memory>
 #include <string>
+#include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
@@ -29,6 +31,9 @@ struct StfNode {
     std::vector<int64_t> dims;
   };
   std::vector<Out> outputs;
+  // false until AddOutput/import: serialization then omits output_specs so
+  // the Python importer's shape inference fills them (shape_refiner role).
+  bool specs_known = false;
 };
 
 struct StfGraph {
@@ -66,6 +71,265 @@ std::string JsonEscape(const std::string& s) {
 std::string TensorName(StfNode* n, int idx) {
   return n->name + ":" + std::to_string(idx);
 }
+
+// ---- .npy + base64 encoding (Const tensor attrs) ----------------------
+// The GraphDef-JSON wire format stores ndarray attrs as base64-encoded
+// .npy (framework/graph_io.py _encode_attr). Emit npy format 1.0:
+// magic, header dict padded to 64-byte alignment, raw little-endian data.
+
+const char* NpyDescr(const std::string& dtype) {
+  if (dtype == "float32") return "<f4";
+  if (dtype == "float64") return "<f8";
+  if (dtype == "float16") return "<f2";
+  if (dtype == "int32") return "<i4";
+  if (dtype == "int64") return "<i8";
+  if (dtype == "int16") return "<i2";
+  if (dtype == "int8") return "|i1";
+  if (dtype == "uint8") return "|u1";
+  if (dtype == "uint16") return "<u2";
+  if (dtype == "bool") return "|b1";
+  return nullptr;  // bfloat16 etc: not expressible in plain npy
+}
+
+std::string Base64(const uint8_t* data, size_t n) {
+  static const char tbl[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve(((n + 2) / 3) * 4);
+  size_t i = 0;
+  for (; i + 3 <= n; i += 3) {
+    uint32_t v = (data[i] << 16) | (data[i + 1] << 8) | data[i + 2];
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+  }
+  if (i + 1 == n) {
+    uint32_t v = data[i] << 16;
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == n) {
+    uint32_t v = (data[i] << 16) | (data[i + 1] << 8);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+std::string NpyBytes(const char* descr, int rank, const int64_t* dims,
+                     const void* data, size_t nbytes) {
+  std::string header = "{'descr': '";
+  header += descr;
+  header += "', 'fortran_order': False, 'shape': (";
+  for (int i = 0; i < rank; i++) {
+    header += std::to_string(dims[i]);
+    if (rank == 1 || i + 1 < rank) header += ",";
+    if (i + 1 < rank) header += " ";
+  }
+  header += "), }";
+  size_t unpadded = 10 + header.size() + 1;  // magic(8)+len(2)+hdr+\n
+  size_t padded = (unpadded + 63) / 64 * 64;
+  header.append(padded - unpadded, ' ');
+  header += '\n';
+  std::string out("\x93NUMPY\x01\x00", 8);
+  uint16_t hlen = (uint16_t)header.size();
+  out += (char)(hlen & 0xff);
+  out += (char)(hlen >> 8);
+  out += header;
+  out.append((const char*)data, nbytes);
+  return out;
+}
+
+// ---- minimal JSON parser (GraphDef-JSON import) -----------------------
+// Parses the generic JSON structure while remembering each value's raw
+// byte span in the source, so attr values round-trip verbatim as the
+// fragment strings StfNode stores (the Python side owns attr semantics).
+
+struct JValue {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::vector<std::pair<std::string, JValue>> obj;
+  size_t raw_begin = 0, raw_end = 0;
+
+  const JValue* Find(const char* key) const {
+    for (auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+struct JParser {
+  const char* s;
+  size_t n, i = 0;
+  std::string err;
+
+  explicit JParser(const char* src, size_t len) : s(src), n(len) {}
+
+  void Ws() {
+    while (i < n && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' ||
+                     s[i] == '\r'))
+      i++;
+  }
+
+  bool Fail(const std::string& m) {
+    if (err.empty()) err = m + " at offset " + std::to_string(i);
+    return false;
+  }
+
+  bool ParseString(std::string* out) {
+    if (i >= n || s[i] != '"') return Fail("expected string");
+    i++;
+    out->clear();
+    while (i < n && s[i] != '"') {
+      char c = s[i];
+      if (c == '\\') {
+        i++;
+        if (i >= n) return Fail("bad escape");
+        char e = s[i];
+        switch (e) {
+          case '"': *out += '"'; break;
+          case '\\': *out += '\\'; break;
+          case '/': *out += '/'; break;
+          case 'n': *out += '\n'; break;
+          case 't': *out += '\t'; break;
+          case 'r': *out += '\r'; break;
+          case 'b': *out += '\b'; break;
+          case 'f': *out += '\f'; break;
+          case 'u': {
+            if (i + 4 >= n) return Fail("bad \\u");
+            unsigned v = 0;
+            for (int k = 1; k <= 4; k++) {
+              char h = s[i + k];
+              v <<= 4;
+              if (h >= '0' && h <= '9') v |= h - '0';
+              else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+              else return Fail("bad \\u digit");
+            }
+            i += 4;
+            // UTF-8 encode (surrogate pairs unhandled; names are ASCII)
+            if (v < 0x80) *out += (char)v;
+            else if (v < 0x800) {
+              *out += (char)(0xC0 | (v >> 6));
+              *out += (char)(0x80 | (v & 0x3F));
+            } else {
+              *out += (char)(0xE0 | (v >> 12));
+              *out += (char)(0x80 | ((v >> 6) & 0x3F));
+              *out += (char)(0x80 | (v & 0x3F));
+            }
+            break;
+          }
+          default: return Fail("bad escape char");
+        }
+        i++;
+      } else {
+        *out += c;
+        i++;
+      }
+    }
+    if (i >= n) return Fail("unterminated string");
+    i++;  // closing quote
+    return true;
+  }
+
+  bool Parse(JValue* v) {
+    Ws();
+    if (i >= n) return Fail("unexpected end");
+    v->raw_begin = i;
+    char c = s[i];
+    bool ok;
+    if (c == '{') {
+      v->kind = JValue::kObj;
+      i++;
+      Ws();
+      if (i < n && s[i] == '}') { i++; ok = true; }
+      else {
+        ok = true;
+        while (ok) {
+          Ws();
+          std::string key;
+          if (!ParseString(&key)) { ok = false; break; }
+          Ws();
+          if (i >= n || s[i] != ':') { ok = Fail("expected ':'"); break; }
+          i++;
+          v->obj.emplace_back(std::move(key), JValue());
+          if (!Parse(&v->obj.back().second)) { ok = false; break; }
+          Ws();
+          if (i < n && s[i] == ',') { i++; continue; }
+          if (i < n && s[i] == '}') { i++; break; }
+          ok = Fail("expected ',' or '}'");
+        }
+      }
+    } else if (c == '[') {
+      v->kind = JValue::kArr;
+      i++;
+      Ws();
+      if (i < n && s[i] == ']') { i++; ok = true; }
+      else {
+        ok = true;
+        while (ok) {
+          v->arr.emplace_back();
+          if (!Parse(&v->arr.back())) { ok = false; break; }
+          Ws();
+          if (i < n && s[i] == ',') { i++; continue; }
+          if (i < n && s[i] == ']') { i++; break; }
+          ok = Fail("expected ',' or ']'");
+        }
+      }
+    } else if (c == '"') {
+      v->kind = JValue::kStr;
+      ok = ParseString(&v->str);
+    } else if (c == 't' && n - i >= 4 && !strncmp(s + i, "true", 4)) {
+      v->kind = JValue::kBool;
+      v->b = true;
+      i += 4;
+      ok = true;
+    } else if (c == 'f' && n - i >= 5 && !strncmp(s + i, "false", 5)) {
+      v->kind = JValue::kBool;
+      v->b = false;
+      i += 5;
+      ok = true;
+    } else if (c == 'n' && n - i >= 4 && !strncmp(s + i, "null", 4)) {
+      v->kind = JValue::kNull;
+      i += 4;
+      ok = true;
+    } else if (c == 'N' && n - i >= 3 && !strncmp(s + i, "NaN", 3)) {
+      v->kind = JValue::kNum;  // python json emits bare NaN/Infinity
+      v->num = std::nan("");
+      i += 3;
+      ok = true;
+    } else if ((c == 'I' || ((c == '-' || c == '+') && i + 1 < n &&
+                             s[i + 1] == 'I'))) {
+      bool neg = c == '-';
+      size_t j = i + (c == 'I' ? 0 : 1);
+      if (n - j >= 8 && !strncmp(s + j, "Infinity", 8)) {
+        v->kind = JValue::kNum;
+        v->num = neg ? -INFINITY : INFINITY;
+        i = j + 8;
+        ok = true;
+      } else {
+        ok = Fail("bad literal");
+      }
+    } else {
+      char* end = nullptr;
+      v->kind = JValue::kNum;
+      v->num = strtod(s + i, &end);
+      if (end == s + i) ok = Fail("bad number");
+      else {
+        i = end - s;
+        ok = true;
+      }
+    }
+    v->raw_end = i;
+    return ok;
+  }
+};
 
 }  // namespace
 
@@ -123,6 +387,50 @@ void StfNodeSetAttrString(StfNode* n, const char* key, const char* v) {
   n->attrs.emplace_back(key, "\"" + JsonEscape(v) + "\"");
 }
 
+void StfNodeSetAttrJson(StfNode* n, const char* key, const char* raw_json) {
+  n->attrs.emplace_back(key, raw_json);
+}
+
+void StfNodeSetAttrDtype(StfNode* n, const char* key, const char* dtype) {
+  n->attrs.emplace_back(
+      key, std::string("{\"__kind__\": \"dtype\", \"v\": \"") +
+               JsonEscape(dtype) + "\"}");
+}
+
+void StfNodeSetAttrShape(StfNode* n, const char* key, int rank,
+                         const int64_t* dims) {
+  std::string v;
+  if (rank < 0) {
+    v = "null";
+  } else {
+    v = "[";
+    for (int i = 0; i < rank; i++) {
+      if (i) v += ", ";
+      v += dims[i] < 0 ? "null" : std::to_string(dims[i]);
+    }
+    v += "]";
+  }
+  n->attrs.emplace_back(
+      key, "{\"__kind__\": \"shape\", \"v\": " + v + "}");
+}
+
+int StfNodeSetAttrTensor(StfNode* n, const char* key, const char* dtype,
+                         int rank, const int64_t* dims, const void* data,
+                         size_t nbytes, StfStatus* status) {
+  const char* descr = NpyDescr(dtype);
+  if (descr == nullptr) {
+    stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                      std::string("tensor attrs of dtype ") + dtype +
+                          " not supported by the C encoder");
+    return -1;
+  }
+  std::string npy = NpyBytes(descr, rank, dims, data, nbytes);
+  n->attrs.emplace_back(
+      key, "{\"__kind__\": \"ndarray\", \"v\": \"" +
+               Base64((const uint8_t*)npy.data(), npy.size()) + "\"}");
+  return 0;
+}
+
 void StfNodeAddOutput(StfNode* n, const char* dtype, int rank,
                       const int64_t* dims) {
   StfNode::Out o;
@@ -130,6 +438,13 @@ void StfNodeAddOutput(StfNode* n, const char* dtype, int rank,
   o.rank = rank;
   for (int i = 0; i < rank; i++) o.dims.push_back(dims[i]);
   n->outputs.push_back(std::move(o));
+  n->specs_known = true;
+}
+
+StfNode* StfGraphFindNode(StfGraph* g, const char* name) {
+  for (auto& n : g->nodes)
+    if (n->name == name) return n.get();
+  return nullptr;
 }
 
 const char* StfNodeName(const StfNode* n) { return n->name.c_str(); }
@@ -167,26 +482,283 @@ const char* StfGraphToJson(StfGraph* g, size_t* n, StfStatus* status) {
       out += "\"" + JsonEscape(node->attrs[i].first) +
              "\": " + node->attrs[i].second;
     }
-    out += "}, \"output_specs\": [";
-    for (size_t i = 0; i < node->outputs.size(); i++) {
-      if (i) out += ", ";
-      auto& o = node->outputs[i];
-      if (o.rank < 0) {
-        out += "[null, \"" + o.dtype + "\"]";
-      } else {
-        out += "[[";
-        for (int d = 0; d < o.rank; d++) {
-          if (d) out += ", ";
-          out += o.dims[d] < 0 ? "null" : std::to_string(o.dims[d]);
+    out += "}";
+    // omit output_specs entirely when unknown: the Python importer then
+    // runs the op registry's shape inference (shape_refiner role)
+    if (node->specs_known) {
+      out += ", \"output_specs\": [";
+      for (size_t i = 0; i < node->outputs.size(); i++) {
+        if (i) out += ", ";
+        auto& o = node->outputs[i];
+        if (o.rank < 0) {
+          out += "[null, \"" + o.dtype + "\"]";
+        } else {
+          out += "[[";
+          for (int d = 0; d < o.rank; d++) {
+            if (d) out += ", ";
+            out += o.dims[d] < 0 ? "null" : std::to_string(o.dims[d]);
+          }
+          out += "], \"" + o.dtype + "\"]";
         }
-        out += "], \"" + o.dtype + "\"]";
       }
+      out += "]";
     }
-    out += "]}";
+    out += "}";
   }
   out += "]}";
   if (n) *n = out.size();
   return out.c_str();
+}
+
+int StfGraphImportJson(StfGraph* g, const char* json, size_t len,
+                       StfStatus* status) {
+  stf_internal::Set(status, STF_OK, "");
+  if (len == 0) len = strlen(json);
+  // Copy into a NUL-terminated buffer: the parser's strtod() and
+  // single-byte lookaheads must never read past a length-bounded,
+  // non-NUL-terminated caller slice (e.g. an mmap'd file).
+  std::string bounded(json, len);
+  json = bounded.c_str();
+  JParser p(json, len);
+  JValue root;
+  if (!p.Parse(&root) || root.kind != JValue::kObj) {
+    stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                      "GraphDef-JSON parse error: " +
+                          (p.err.empty() ? "not an object" : p.err));
+    return -1;
+  }
+  const JValue* nodes = root.Find("node");
+  if (nodes == nullptr || nodes->kind != JValue::kArr) {
+    stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                      "GraphDef-JSON missing \"node\" array");
+    return -1;
+  }
+  // name -> node over existing + imported nodes, for input resolution
+  std::unordered_map<std::string, StfNode*> by_name;
+  for (auto& n : g->nodes) by_name[n->name] = n.get();
+  size_t n_before = g->nodes.size();
+  auto rollback = [g, n_before]() {
+    for (size_t k = n_before; k < g->nodes.size(); k++)
+      g->names.erase(g->nodes[k]->name);
+    g->nodes.resize(n_before);
+  };
+  for (const JValue& jn : nodes->arr) {
+    const JValue* name = jn.Find("name");
+    const JValue* op = jn.Find("op");
+    if (jn.kind != JValue::kObj || name == nullptr ||
+        name->kind != JValue::kStr || op == nullptr ||
+        op->kind != JValue::kStr) {
+      stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                        "node entry missing name/op");
+      rollback();
+      return -1;
+    }
+    StfNode* node = StfGraphAddNode(g, op->str.c_str(), name->str.c_str(),
+                                    status);
+    if (node == nullptr) {
+      rollback();
+      return -1;
+    }
+    by_name[node->name] = node;
+    const JValue* device = jn.Find("device");
+    if (device != nullptr && device->kind == JValue::kStr)
+      node->device = device->str;
+    const JValue* inputs = jn.Find("input");
+    if (inputs != nullptr && inputs->kind == JValue::kArr) {
+      for (const JValue& in : inputs->arr) {
+        if (in.kind != JValue::kStr) continue;
+        size_t colon = in.str.rfind(':');
+        std::string prod = colon == std::string::npos
+                               ? in.str
+                               : in.str.substr(0, colon);
+        int idx = colon == std::string::npos
+                      ? 0
+                      : atoi(in.str.c_str() + colon + 1);
+        auto it = by_name.find(prod);
+        if (it == by_name.end()) {
+          stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                            "input refers to unknown node " + prod);
+          rollback();
+          return -1;
+        }
+        node->inputs.emplace_back(it->second, idx);
+      }
+    }
+    const JValue* ctrl = jn.Find("control_input");
+    if (ctrl != nullptr && ctrl->kind == JValue::kArr) {
+      for (const JValue& c : ctrl->arr) {
+        auto it = by_name.find(c.str);
+        if (it != by_name.end()) node->control_inputs.push_back(it->second);
+      }
+    }
+    const JValue* attrs = jn.Find("attr");
+    if (attrs != nullptr && attrs->kind == JValue::kObj) {
+      for (auto& kv : attrs->obj) {
+        node->attrs.emplace_back(
+            kv.first, std::string(json + kv.second.raw_begin,
+                                  kv.second.raw_end - kv.second.raw_begin));
+      }
+    }
+    const JValue* specs = jn.Find("output_specs");
+    if (specs != nullptr && specs->kind == JValue::kArr) {
+      node->specs_known = true;
+      for (const JValue& sp : specs->arr) {
+        if (sp.kind != JValue::kArr || sp.arr.size() != 2) continue;
+        StfNode::Out o;
+        o.dtype = sp.arr[1].str;
+        if (sp.arr[0].kind == JValue::kNull) {
+          o.rank = -1;
+        } else {
+          o.rank = (int)sp.arr[0].arr.size();
+          for (const JValue& d : sp.arr[0].arr)
+            o.dims.push_back(d.kind == JValue::kNull ? -1
+                                                     : (int64_t)d.num);
+        }
+        node->outputs.push_back(std::move(o));
+      }
+    }
+  }
+  return (int)(g->nodes.size() - n_before);
+}
+
+void StfGraphClear(StfGraph* g) {
+  g->nodes.clear();
+  g->names.clear();
+}
+
+// ---- op-building helpers (ref: tensorflow/cc/framework/scope.h &
+// cc/ops/ — the reference generates typed C++ op wrappers; these cover
+// the core dialect so a C host can assemble models without Python) -----
+
+StfNode* StfOpPlaceholder(StfGraph* g, const char* name, const char* dtype,
+                          int rank, const int64_t* dims, StfStatus* status) {
+  StfNode* n = StfGraphAddNode(g, "Placeholder", name, status);
+  if (n == nullptr) return nullptr;
+  StfNodeSetAttrDtype(n, "dtype", dtype);
+  StfNodeSetAttrShape(n, "shape", rank, dims);
+  StfNodeAddOutput(n, dtype, rank, dims);
+  return n;
+}
+
+// drop the most recently added nodes (error rollback in compound
+// helpers: a partially-built node must not survive a failed call)
+static void PopNodes(StfGraph* g, size_t down_to) {
+  for (size_t k = down_to; k < g->nodes.size(); k++)
+    g->names.erase(g->nodes[k]->name);
+  g->nodes.resize(down_to);
+}
+
+StfNode* StfOpConst(StfGraph* g, const char* name, const char* dtype,
+                    int rank, const int64_t* dims, const void* data,
+                    size_t nbytes, StfStatus* status) {
+  size_t mark = g->nodes.size();
+  StfNode* n = StfGraphAddNode(g, "Const", name, status);
+  if (n == nullptr) return nullptr;
+  if (StfNodeSetAttrTensor(n, "value", dtype, rank, dims, data, nbytes,
+                           status) != 0) {
+    PopNodes(g, mark);
+    return nullptr;
+  }
+  StfNodeSetAttrDtype(n, "dtype", dtype);
+  StfNodeAddOutput(n, dtype, rank, dims);
+  return n;
+}
+
+StfNode* StfOpVariable(StfGraph* g, const char* name, const char* dtype,
+                       int rank, const int64_t* dims, StfNode* init_value,
+                       int init_index, StfStatus* status) {
+  size_t mark = g->nodes.size();
+  StfNode* var = StfGraphAddNode(g, "VariableV2", name, status);
+  if (var == nullptr) return nullptr;
+  StfNodeSetAttrString(var, "var_name", name);
+  StfNodeSetAttrDtype(var, "dtype", dtype);
+  StfNodeSetAttrShape(var, "shape", rank, dims);
+  StfNodeSetAttrBool(var, "trainable", 1);
+  StfNodeSetAttrJson(var, "sharding", "null");
+  StfNodeSetAttrString(var, "container", "");
+  StfNodeAddOutput(var, (std::string(dtype) + "_ref").c_str(), rank, dims);
+  // initializer: "<name>/Assign" — c_client.load_graph runs exactly these
+  StfNode* init = StfGraphAddNode(
+      g, "Assign", (std::string(name) + "/Assign").c_str(), status);
+  if (init == nullptr) {
+    PopNodes(g, mark);
+    return nullptr;
+  }
+  init->inputs.emplace_back(init_value, init_index);
+  StfNodeSetAttrString(init, "var_name", name);
+  StfNodeSetAttrBool(init, "validate_shape", 1);
+  StfNodeSetAttrBool(init, "use_locking", 1);
+  StfNodeAddOutput(init, dtype, rank, dims);
+  // read op mirroring Python's Variable (deref-at-use read tensor)
+  StfNode* read = StfGraphAddNode(
+      g, "ReadVariable", (std::string(name) + "/read").c_str(), status);
+  if (read == nullptr) {
+    PopNodes(g, mark);
+    return nullptr;
+  }
+  StfNodeSetAttrString(read, "var_name", name);
+  StfNodeAddOutput(read, dtype, rank, dims);
+  return var;
+}
+
+StfNode* StfOpBinary(StfGraph* g, const char* op_type, const char* name,
+                     StfNode* a, int ai, StfNode* b, int bi,
+                     StfStatus* status) {
+  StfNode* n = StfGraphAddNode(g, op_type, name, status);
+  if (n == nullptr) return nullptr;
+  n->inputs.emplace_back(a, ai);
+  n->inputs.emplace_back(b, bi);
+  return n;  // output specs inferred at import
+}
+
+StfNode* StfOpUnary(StfGraph* g, const char* op_type, const char* name,
+                    StfNode* x, int xi, StfStatus* status) {
+  StfNode* n = StfGraphAddNode(g, op_type, name, status);
+  if (n == nullptr) return nullptr;
+  n->inputs.emplace_back(x, xi);
+  return n;
+}
+
+StfNode* StfOpMatMul(StfGraph* g, const char* name, StfNode* a, int ai,
+                     StfNode* b, int bi, int transpose_a, int transpose_b,
+                     StfStatus* status) {
+  StfNode* n = StfOpBinary(g, "MatMul", name, a, ai, b, bi, status);
+  if (n == nullptr) return nullptr;
+  StfNodeSetAttrBool(n, "transpose_a", transpose_a);
+  StfNodeSetAttrBool(n, "transpose_b", transpose_b);
+  return n;
+}
+
+StfNode* StfOpReduceMeanAll(StfGraph* g, const char* name, StfNode* x,
+                            int xi, StfStatus* status) {
+  StfNode* n = StfOpUnary(g, "Mean", name, x, xi, status);
+  if (n == nullptr) return nullptr;
+  StfNodeSetAttrJson(n, "axis", "null");
+  StfNodeSetAttrBool(n, "keepdims", 0);
+  return n;
+}
+
+StfNode* StfOpAssignSub(StfGraph* g, const char* name, StfNode* var,
+                        StfNode* delta, int di, StfStatus* status) {
+  if (var == nullptr || var->op_type != "VariableV2" ||
+      var->outputs.empty()) {
+    stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                      "StfOpAssignSub: var must be a VariableV2 node "
+                      "with a known output spec");
+    return nullptr;
+  }
+  StfNode* n = StfGraphAddNode(g, "AssignSub", name, status);
+  if (n == nullptr) return nullptr;
+  n->inputs.emplace_back(delta, di);
+  StfNodeSetAttrString(n, "var_name", var->name.c_str());
+  // stateful ops have no registry inference: spec = the variable's value
+  // spec (its ref spec with the "_ref" dtype suffix dropped)
+  std::string dtype = var->outputs[0].dtype;
+  if (dtype.size() > 4 && !dtype.compare(dtype.size() - 4, 4, "_ref"))
+    dtype.resize(dtype.size() - 4);
+  StfNodeAddOutput(n, dtype.c_str(), var->outputs[0].rank,
+                   var->outputs[0].dims.data());
+  return n;
 }
 
 const char* StfVersion() { return "stf-runtime 1.0.0"; }

@@ -1,0 +1,273 @@
+/* C client round-trip demo (compiled + run by tests/test_runtime_cc.py).
+ *
+ * The reference builds graphs, adds symbolic gradients, and trains from
+ * C++ (ref: tensorflow/cc/framework/scope.h, cc/framework/gradients.h:34,
+ * cc/training/). This program does the same through the stf C API:
+ *
+ *   1. builds y = xW + b, loss = mean((y - t)^2) with StfOp* helpers
+ *   2. StfAddGradients -> dL/dW, dL/db, dL/dx (Python/XLA vjp under the
+ *      hood, returned as graph nodes)
+ *   3. re-imports the augmented graph, appends SGD AssignSub train ops
+ *   4. runs init + train steps through StfSessionFromGraphJson
+ *   5. gradient-checks dL/dx against central finite differences
+ *      (ref: cc/framework/gradient_checker.cc ComputeGradientError)
+ *
+ * Prints "key value..." lines the pytest side parses and compares against
+ * the same model built natively in Python.
+ */
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "stf_c.h"
+
+#define CHECK_OK(st, what)                                             \
+  do {                                                                 \
+    if (StfGetCode(st) != STF_OK) {                                    \
+      fprintf(stderr, "FAIL %s: %s\n", what, StfMessage(st));          \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+#define CHECK(cond, what)                                              \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s\n", what);                              \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+static const int B = 4, D_IN = 3, D_OUT = 2;
+static const float LR = 0.1f;
+
+static void fill_inputs(float* xv, float* tv) {
+  /* deterministic pseudo-data; the pytest side regenerates the same */
+  for (int i = 0; i < B * D_IN; i++) xv[i] = sinf(0.7f * (float)i + 0.3f);
+  for (int i = 0; i < B * D_OUT; i++) tv[i] = cosf(0.3f * (float)i - 0.2f);
+}
+
+static double run_loss(StfRunSession* sess, const float* xv,
+                       const float* tv, StfStatus* st) {
+  int64_t xdims[2] = {B, D_IN}, tdims[2] = {B, D_OUT};
+  StfTensorSpec feeds[2] = {
+      {"float32", 2, xdims, xv, sizeof(float) * B * D_IN},
+      {"float32", 2, tdims, tv, sizeof(float) * B * D_OUT}};
+  const char* feed_names[2] = {"x:0", "t:0"};
+  const char* fetch = "loss:0";
+  StfTensorOut out;
+  StfSessionRun(sess, feed_names, feeds, 2, &fetch, 1, &out, st);
+  if (StfGetCode(st) != STF_OK) return NAN;
+  double v = (double)((const float*)out.data)[0];
+  StfTensorOutRelease(&out);
+  return v;
+}
+
+int main(void) {
+  StfStatus* st = StfNewStatus();
+
+  /* ---- 1. forward graph -------------------------------------------- */
+  StfGraph* g = StfGraphNew();
+  int64_t xdims[2] = {B, D_IN}, tdims[2] = {B, D_OUT};
+  int64_t wdims[2] = {D_IN, D_OUT}, bdims[1] = {D_OUT};
+
+  StfNode* x = StfOpPlaceholder(g, "x", "float32", 2, xdims, st);
+  CHECK_OK(st, "placeholder x");
+  StfNode* t = StfOpPlaceholder(g, "t", "float32", 2, tdims, st);
+  CHECK_OK(st, "placeholder t");
+
+  float w0[D_IN * D_OUT], b0[D_OUT];
+  for (int i = 0; i < D_IN * D_OUT; i++) w0[i] = 0.05f * (float)(i + 1);
+  for (int i = 0; i < D_OUT; i++) b0[i] = 0.0f;
+  StfNode* w_init = StfOpConst(g, "W_init", "float32", 2, wdims, w0,
+                               sizeof(w0), st);
+  CHECK_OK(st, "const W_init");
+  StfNode* b_init = StfOpConst(g, "b_init", "float32", 1, bdims, b0,
+                               sizeof(b0), st);
+  CHECK_OK(st, "const b_init");
+  StfNode* w = StfOpVariable(g, "W", "float32", 2, wdims, w_init, 0, st);
+  CHECK_OK(st, "variable W");
+  StfNode* b = StfOpVariable(g, "b", "float32", 1, bdims, b_init, 0, st);
+  CHECK_OK(st, "variable b");
+
+  StfNode* xw = StfOpMatMul(g, "xw", x, 0, w, 0, 0, 0, st);
+  CHECK_OK(st, "matmul");
+  StfNode* y = StfOpBinary(g, "Add", "y", xw, 0, b, 0, st);
+  CHECK_OK(st, "add");
+  StfNode* diff = StfOpBinary(g, "Sub", "diff", y, 0, t, 0, st);
+  CHECK_OK(st, "sub");
+  StfNode* sq = StfOpUnary(g, "Square", "sq", diff, 0, st);
+  CHECK_OK(st, "square");
+  StfNode* loss = StfOpReduceMeanAll(g, "loss", sq, 0, st);
+  CHECK_OK(st, "mean");
+  (void)loss;
+
+  size_t json_len = 0;
+  const char* fwd_json = StfGraphToJson(g, &json_len, st);
+  CHECK_OK(st, "to_json");
+
+  /* ---- 2. symbolic gradients --------------------------------------- */
+  const char* ys[1] = {"loss:0"};
+  const char* xs[3] = {"W:0", "b:0", "x:0"};
+  char* aug_json = NULL;
+  char* grad_names = StfAddGradients(fwd_json, ys, 1, xs, 3, &aug_json, st);
+  CHECK_OK(st, "add_gradients");
+  CHECK(grad_names != NULL && aug_json != NULL, "gradients output");
+
+  char gw_name[256], gb_name[256], gx_name[256];
+  {
+    /* newline-joined names aligned with xs */
+    char* tmp = strdup(grad_names);
+    char* save = NULL;
+    char* tok = strtok_r(tmp, "\n", &save);
+    CHECK(tok != NULL, "grad name W");
+    snprintf(gw_name, sizeof(gw_name), "%s", tok);
+    tok = strtok_r(NULL, "\n", &save);
+    CHECK(tok != NULL, "grad name b");
+    snprintf(gb_name, sizeof(gb_name), "%s", tok);
+    tok = strtok_r(NULL, "\n", &save);
+    CHECK(tok != NULL, "grad name x");
+    snprintf(gx_name, sizeof(gx_name), "%s", tok);
+    free(tmp);
+  }
+
+  /* ---- 3. re-import + SGD train ops -------------------------------- */
+  StfGraph* g2 = StfGraphNew();
+  int n_imported = StfGraphImportJson(g2, aug_json, 0, st);
+  CHECK_OK(st, "import augmented");
+  CHECK(n_imported > 0, "imported nodes");
+
+  char prod[256];
+  int gw_idx = 0, gb_idx = 0;
+  /* grad tensor "node:i" -> node + index */
+  {
+    const char* colon = strrchr(gw_name, ':');
+    snprintf(prod, sizeof(prod), "%.*s", (int)(colon - gw_name), gw_name);
+    gw_idx = atoi(colon + 1);
+  }
+  StfNode* gw_node = StfGraphFindNode(g2, prod);
+  CHECK(gw_node != NULL, "find grad W node");
+  {
+    const char* colon = strrchr(gb_name, ':');
+    snprintf(prod, sizeof(prod), "%.*s", (int)(colon - gb_name), gb_name);
+    gb_idx = atoi(colon + 1);
+  }
+  StfNode* gb_node = StfGraphFindNode(g2, prod);
+  CHECK(gb_node != NULL, "find grad b node");
+  StfNode* w2 = StfGraphFindNode(g2, "W");
+  StfNode* b2 = StfGraphFindNode(g2, "b");
+  CHECK(w2 != NULL && b2 != NULL, "find variables after import");
+
+  int64_t scalar_dims[1] = {1};
+  (void)scalar_dims;
+  float lr = LR;
+  StfNode* lr_c = StfOpConst(g2, "lr", "float32", 0, NULL, &lr,
+                             sizeof(lr), st);
+  CHECK_OK(st, "const lr");
+  StfNode* dw = StfOpBinary(g2, "Mul", "dw", gw_node, gw_idx, lr_c, 0, st);
+  CHECK_OK(st, "mul dw");
+  StfNode* db = StfOpBinary(g2, "Mul", "db", gb_node, gb_idx, lr_c, 0, st);
+  CHECK_OK(st, "mul db");
+  StfNode* train_w = StfOpAssignSub(g2, "train_W", w2, dw, 0, st);
+  CHECK_OK(st, "assign_sub W");
+  StfNode* train_b = StfOpAssignSub(g2, "train_b", b2, db, 0, st);
+  CHECK_OK(st, "assign_sub b");
+  (void)train_w;
+  (void)train_b;
+
+  const char* full_json = StfGraphToJson(g2, &json_len, st);
+  CHECK_OK(st, "to_json full");
+
+  /* ---- 4. session: init, gradcheck, train, verify ------------------ */
+  StfRunSession* sess = StfSessionFromGraphJson(full_json, st);
+  CHECK_OK(st, "session from graph");
+  CHECK(sess != NULL, "session");
+
+  float xv[B * D_IN], tv[B * D_OUT];
+  fill_inputs(xv, tv);
+
+  double l0 = run_loss(sess, xv, tv, st);
+  CHECK_OK(st, "loss 0");
+
+  /* symbolic dL/dx at the initial point */
+  StfTensorSpec feeds[2] = {
+      {"float32", 2, xdims, xv, sizeof(xv)},
+      {"float32", 2, tdims, tv, sizeof(tv)}};
+  const char* feed_names[2] = {"x:0", "t:0"};
+  float gx[B * D_IN];
+  {
+    const char* fetch = gx_name;
+    StfTensorOut out;
+    StfSessionRun(sess, feed_names, feeds, 2, &fetch, 1, &out, st);
+    CHECK_OK(st, "fetch dL/dx");
+    CHECK(out.nbytes == sizeof(gx), "dL/dx size");
+    memcpy(gx, out.data, sizeof(gx));
+    StfTensorOutRelease(&out);
+  }
+
+  /* ---- 5. central-difference gradient check on x ------------------- */
+  double max_err = 0.0;
+  const float eps = 1e-2f;
+  for (int i = 0; i < B * D_IN; i++) {
+    float saved = xv[i];
+    xv[i] = saved + eps;
+    double lp = run_loss(sess, xv, tv, st);
+    CHECK_OK(st, "gradcheck loss(+eps)");
+    xv[i] = saved - eps;
+    double lm = run_loss(sess, xv, tv, st);
+    CHECK_OK(st, "gradcheck loss(-eps)");
+    xv[i] = saved;
+    double num = (lp - lm) / (2.0 * (double)eps);
+    double err = fabs(num - (double)gx[i]);
+    /* NaN compares false against everything — catch it explicitly so a
+     * NaN loss can't make the check pass vacuously */
+    CHECK(!isnan(err), "gradcheck NaN");
+    if (err > max_err) max_err = err;
+  }
+
+  /* train: one SGD step (fetch both AssignSub outputs applies them in
+   * one Session.run — one XLA program, both writes committed) */
+  {
+    const char* fetches[2] = {"train_W:0", "train_b:0"};
+    StfTensorOut outs[2];
+    StfSessionRun(sess, feed_names, feeds, 2, fetches, 2, outs, st);
+    CHECK_OK(st, "train step");
+    StfTensorOutRelease(&outs[0]);
+    StfTensorOutRelease(&outs[1]);
+  }
+  double l1 = run_loss(sess, xv, tv, st);
+  CHECK_OK(st, "loss 1");
+
+  /* fetch updated W for the Python-side comparison */
+  float w_after[D_IN * D_OUT];
+  {
+    const char* fetch = "W/read:0";
+    StfTensorOut out;
+    StfSessionRun(sess, feed_names, feeds, 2, &fetch, 1, &out, st);
+    CHECK_OK(st, "fetch W");
+    CHECK(out.nbytes == sizeof(w_after), "W size");
+    memcpy(w_after, out.data, sizeof(w_after));
+    StfTensorOutRelease(&out);
+  }
+
+  printf("l0 %.9g\n", l0);
+  printf("l1 %.9g\n", l1);
+  printf("gradcheck_max_err %.9g\n", max_err);
+  printf("W_after");
+  for (int i = 0; i < D_IN * D_OUT; i++) printf(" %.9g", w_after[i]);
+  printf("\n");
+  printf("grad_names %s %s %s\n", gw_name, gb_name, gx_name);
+
+  CHECK(l1 < l0, "loss decreased");
+  CHECK(max_err < 1e-3, "gradient check");
+
+  StfSessionClose(sess);
+  StfFree(grad_names);
+  StfFree(aug_json);
+  StfGraphDelete(g);
+  StfGraphDelete(g2);
+  StfDeleteStatus(st);
+  printf("OK\n");
+  return 0;
+}

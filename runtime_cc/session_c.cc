@@ -61,8 +61,9 @@ PyObject* CSessionModule(StfStatus* status) {
 
 }  // namespace
 
-StfRunSession* StfSessionLoad(const char* export_dir, StfStatus* status) {
-  stf_internal::Set(status, STF_OK, "");
+namespace {
+
+void EnsurePython() {
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);  // pure-C host: embed the interpreter
     // Py_InitializeEx leaves THIS thread holding the GIL; release it so
@@ -71,6 +72,13 @@ StfRunSession* StfSessionLoad(const char* export_dir, StfStatus* status) {
     // we never finalize an interpreter we share with the host process.)
     PyEval_SaveThread();
   }
+}
+
+}  // namespace
+
+StfRunSession* StfSessionLoad(const char* export_dir, StfStatus* status) {
+  stf_internal::Set(status, STF_OK, "");
+  EnsurePython();
   PyGILState_STATE gil = PyGILState_Ensure();
   StfRunSession* out = nullptr;
   PyObject* mod = CSessionModule(status);
@@ -87,6 +95,86 @@ StfRunSession* StfSessionLoad(const char* export_dir, StfStatus* status) {
   PyGILState_Release(gil);
   return out;
 }
+
+StfRunSession* StfSessionFromGraphJson(const char* graph_json,
+                                       StfStatus* status) {
+  stf_internal::Set(status, STF_OK, "");
+  EnsurePython();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  StfRunSession* out = nullptr;
+  PyObject* mod = PyImport_ImportModule(
+      "simple_tensorflow_tpu.runtime.c_client");
+  if (mod == nullptr) {
+    StatusFromPyErr(status, "import simple_tensorflow_tpu failed "
+                            "(is it on sys.path / PYTHONPATH?)");
+  } else {
+    PyObject* res = PyObject_CallMethod(mod, "load_graph", "s", graph_json);
+    if (res == nullptr) {
+      StatusFromPyErr(status, "StfSessionFromGraphJson failed");
+    } else {
+      out = new StfRunSession{PyLong_AsLong(res)};
+      Py_DECREF(res);
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  return out;
+}
+
+char* StfAddGradients(const char* graph_json, const char* const* ys,
+                      int n_ys, const char* const* xs, int n_xs,
+                      char** out_graph_json, StfStatus* status) {
+  stf_internal::Set(status, STF_OK, "");
+  if (out_graph_json != nullptr) *out_graph_json = nullptr;
+  EnsurePython();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  char* names_out = nullptr;
+  PyObject* mod = PyImport_ImportModule(
+      "simple_tensorflow_tpu.runtime.c_client");
+  if (mod == nullptr) {
+    StatusFromPyErr(status, "import simple_tensorflow_tpu failed "
+                            "(is it on sys.path / PYTHONPATH?)");
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  PyObject* y_list = PyList_New(n_ys);
+  for (int i = 0; i < n_ys; ++i)
+    PyList_SET_ITEM(y_list, i, PyUnicode_FromString(ys[i]));
+  PyObject* x_list = PyList_New(n_xs);
+  for (int i = 0; i < n_xs; ++i)
+    PyList_SET_ITEM(x_list, i, PyUnicode_FromString(xs[i]));
+  PyObject* res = PyObject_CallMethod(mod, "add_gradients", "sOO",
+                                      graph_json, y_list, x_list);
+  Py_DECREF(y_list);
+  Py_DECREF(x_list);
+  Py_DECREF(mod);
+  if (res == nullptr) {
+    StatusFromPyErr(status, "StfAddGradients failed");
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  // res: (new_json_str, [grad_name, ...])
+  PyObject* new_json = PyTuple_GetItem(res, 0);
+  PyObject* names = PyTuple_GetItem(res, 1);
+  if (out_graph_json != nullptr) {
+    Py_ssize_t jn = 0;
+    const char* js = PyUnicode_AsUTF8AndSize(new_json, &jn);
+    *out_graph_json = (char*)std::malloc((size_t)jn + 1);
+    std::memcpy(*out_graph_json, js, (size_t)jn + 1);
+  }
+  std::string joined;
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    if (i) joined += '\n';
+    joined += PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  }
+  names_out = (char*)std::malloc(joined.size() + 1);
+  std::memcpy(names_out, joined.c_str(), joined.size() + 1);
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+  return names_out;
+}
+
+void StfFree(void* p) { std::free(p); }
 
 void StfSessionClose(StfRunSession* s) {
   if (s == nullptr) return;

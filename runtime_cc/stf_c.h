@@ -135,10 +135,71 @@ STF_EXPORT void StfNodeAddOutput(StfNode*, const char* dtype, int rank,
 STF_EXPORT const char* StfNodeName(const StfNode*);
 STF_EXPORT int64_t StfGraphNumNodes(const StfGraph*);
 
+/* Raw JSON-fragment attr (caller owns the semantics; the fragment is
+ * embedded verbatim in the serialized GraphDef-JSON). */
+STF_EXPORT void StfNodeSetAttrJson(StfNode*, const char* key,
+                                   const char* raw_json);
+/* Typed attr kinds matching the Python wire codec (graph_io.py):
+ * dtype / shape (rank<0 = unknown) / ndarray (npy+base64; bfloat16 not
+ * encodable -> INVALID_ARGUMENT, returns -1). */
+STF_EXPORT void StfNodeSetAttrDtype(StfNode*, const char* key,
+                                    const char* dtype);
+STF_EXPORT void StfNodeSetAttrShape(StfNode*, const char* key, int rank,
+                                    const int64_t* dims);
+STF_EXPORT int StfNodeSetAttrTensor(StfNode*, const char* key,
+                                    const char* dtype, int rank,
+                                    const int64_t* dims, const void* data,
+                                    size_t nbytes, StfStatus* status);
+
+STF_EXPORT StfNode* StfGraphFindNode(StfGraph*, const char* name);
+STF_EXPORT void StfGraphClear(StfGraph*);
+
 /* Serialize to GraphDef-JSON (stf.import_graph_def loads it). Returned
  * buffer is owned by the graph, valid until next call / delete. */
 STF_EXPORT const char* StfGraphToJson(StfGraph*, size_t* n,
                                       StfStatus* status);
+
+/* Parse GraphDef-JSON and append its nodes to the graph (attr values
+ * round-trip verbatim). Returns the number of nodes added, -1 on error
+ * (the graph is left unchanged). len==0 means strlen(json). */
+STF_EXPORT int StfGraphImportJson(StfGraph*, const char* json, size_t len,
+                                  StfStatus* status);
+
+/* ---- op-building helpers (ref: tensorflow/cc/framework/scope.h,
+ * cc/ops/) — enough of the dialect to assemble models from C; math ops
+ * built via StfOpUnary/StfOpBinary get their output shapes from the op
+ * registry's inference at import time (shape_refiner role). ------------ */
+
+STF_EXPORT StfNode* StfOpPlaceholder(StfGraph*, const char* name,
+                                     const char* dtype, int rank,
+                                     const int64_t* dims, StfStatus*);
+STF_EXPORT StfNode* StfOpConst(StfGraph*, const char* name,
+                               const char* dtype, int rank,
+                               const int64_t* dims, const void* data,
+                               size_t nbytes, StfStatus*);
+/* VariableV2 + "<name>/Assign" initializer (from init_value:init_index)
+ * + "<name>/read". Returns the VariableV2 node; its output 0 is the ref
+ * tensor "<name>:0". */
+STF_EXPORT StfNode* StfOpVariable(StfGraph*, const char* name,
+                                  const char* dtype, int rank,
+                                  const int64_t* dims, StfNode* init_value,
+                                  int init_index, StfStatus*);
+STF_EXPORT StfNode* StfOpBinary(StfGraph*, const char* op_type,
+                                const char* name, StfNode* a, int ai,
+                                StfNode* b, int bi, StfStatus*);
+STF_EXPORT StfNode* StfOpUnary(StfGraph*, const char* op_type,
+                               const char* name, StfNode* x, int xi,
+                               StfStatus*);
+STF_EXPORT StfNode* StfOpMatMul(StfGraph*, const char* name, StfNode* a,
+                                int ai, StfNode* b, int bi,
+                                int transpose_a, int transpose_b,
+                                StfStatus*);
+STF_EXPORT StfNode* StfOpReduceMeanAll(StfGraph*, const char* name,
+                                       StfNode* x, int xi, StfStatus*);
+/* var -= delta (SGD step); output 0 is the updated value. */
+STF_EXPORT StfNode* StfOpAssignSub(StfGraph*, const char* name,
+                                   StfNode* var, StfNode* delta, int di,
+                                   StfStatus*);
 
 /* ---- run from C (ref TF_SessionRun) ---------------------------------
  * Provided by libstf_session.so (make session), NOT libstf_runtime.so:
@@ -167,6 +228,22 @@ typedef struct StfRunSession StfRunSession;
 
 STF_EXPORT StfRunSession* StfSessionLoad(const char* export_dir,
                                          StfStatus* status);
+/* Create a session directly from GraphDef-JSON (e.g. StfGraphToJson
+ * output): imports the graph, runs the "<var>/Assign" initializers, and
+ * serves StfSessionRun with raw "tensor:0" names. */
+STF_EXPORT StfRunSession* StfSessionFromGraphJson(const char* graph_json,
+                                                  StfStatus* status);
+/* Symbolic gradients d(sum ys)/d(xs) added to a serialized graph (ref:
+ * tensorflow/cc/framework/gradients.h:34 AddSymbolicGradients). On
+ * success *out_graph_json is the malloc'd augmented GraphDef-JSON and
+ * the return is a malloc'd newline-joined list of gradient tensor names
+ * aligned with xs; free both with StfFree. Unreachable xs are an error
+ * (C callers have no use for a silent null). */
+STF_EXPORT char* StfAddGradients(const char* graph_json,
+                                 const char* const* ys, int n_ys,
+                                 const char* const* xs, int n_xs,
+                                 char** out_graph_json, StfStatus* status);
+STF_EXPORT void StfFree(void* p);
 STF_EXPORT void StfSessionClose(StfRunSession*);
 /* feed/fetch names: serving-signature keys or raw "tensor:0" names. */
 STF_EXPORT void StfSessionRun(StfRunSession*, const char** feed_names,

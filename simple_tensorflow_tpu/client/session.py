@@ -31,6 +31,7 @@ implementation.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
@@ -212,6 +213,7 @@ class _CompiledStep:
 
 class BaseSession:
     def __init__(self, target="", graph=None, config=None):
+        self._target = self._resolve_target(target)
         self._graph = graph or ops_mod.get_default_graph()
         self._config = config
         self._guard_warned: Set[str] = set()
@@ -230,6 +232,81 @@ class BaseSession:
         # jax.Arrays that never round-trip through host numpy)
         self._handles: Dict[str, Any] = {}
         self._handle_counter = 0
+
+    @staticmethod
+    def _resolve_target(target):
+        """Route the TF-1 ``Session(target)`` parameter (ref:
+        core/distributed_runtime/rpc/grpc_session.cc — the reference
+        attaches to a grpc master; rounds ≤4 silently ignored it).
+
+        TPU-native mapping: multi-host execution is SPMD over the global
+        mesh after ``stf.train.Server`` runs ``jax.distributed`` bootstrap
+        — every process runs the same Session against all hosts' devices,
+        so "attach" means "verify the bootstrap happened / perform it",
+        never "proxy graphs to a remote master".
+
+        - ``""``           → process-local session (single host).
+        - ``"stf://..."``  → a Server's target: require its bootstrap.
+        - ``"grpc://h:p"`` → attach to that coordinator: accept if the
+          running Server used it; else bootstrap from STF_NUM_PROCESSES /
+          STF_PROCESS_ID env; else FailedPrecondition with guidance.
+        - anything else    → UnimplementedError (silent ignore is the one
+          forbidden outcome).
+        """
+        if not target:
+            return ""
+        if not isinstance(target, (str, bytes)):
+            raise TypeError(f"target must be a string, got {target!r}")
+        if isinstance(target, bytes):
+            target = target.decode()
+        from ..framework import errors as errors_mod
+        from ..train import server_lib
+
+        if target.startswith("stf://"):
+            if not server_lib.Server._started:
+                raise errors_mod.FailedPreconditionError(
+                    None, None,
+                    f"Session target {target!r} names a stf.train.Server, "
+                    "but no Server has started in this process. Construct "
+                    "stf.train.Server(cluster_spec, job_name=..., "
+                    "task_index=...) first — it runs the jax.distributed "
+                    "bootstrap that gives this session the global device "
+                    "mesh.")
+            return target
+        if target.startswith("grpc://"):
+            addr = target[len("grpc://"):]
+            if server_lib.Server._started:
+                coord = server_lib.Server._coordinator
+                if coord is not None and addr not in (coord, ""):
+                    raise errors_mod.InvalidArgumentError(
+                        None, None,
+                        f"Session target grpc://{addr} does not match the "
+                        f"running Server's coordinator {coord!r}; one "
+                        "process attaches to exactly one cluster.")
+                return target
+            num = os.environ.get("STF_NUM_PROCESSES")
+            pid = os.environ.get("STF_PROCESS_ID")
+            if num and pid:
+                import jax
+
+                jax.distributed.initialize(coordinator_address=addr,
+                                           num_processes=int(num),
+                                           process_id=int(pid))
+                server_lib.Server._started = True
+                server_lib.Server._coordinator = addr
+                return target
+            raise errors_mod.FailedPreconditionError(
+                None, None,
+                f"Session target grpc://{addr}: no jax.distributed "
+                "bootstrap is active. Either construct stf.train.Server "
+                "with the ClusterSpec (preferred), or set "
+                "STF_NUM_PROCESSES and STF_PROCESS_ID so the session can "
+                "attach to the coordinator itself.")
+        raise errors_mod.UnimplementedError(
+            None, None,
+            f"Session target {target!r} is not supported: use \"\" "
+            "(local), a Server.target, or \"grpc://host:port\" of the "
+            "cluster coordinator.")
 
     # -- session handles -----------------------------------------------------
     def _register_handle(self, value, dtype):

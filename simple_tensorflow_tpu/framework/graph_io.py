@@ -132,7 +132,8 @@ def import_graph_def(graph_def, input_map=None, return_elements=None,
 
     def build_into(target_graph, nodes, tensor_env, scope_prefix):
         for node in nodes:
-            attrs = {k: _decode_attr(v) for k, v in node["attr"].items()}
+            attrs = {k: _decode_attr(v)
+                     for k, v in (node.get("attr") or {}).items()}
             # Scoped imports get their own VariableStore namespace: rewrite
             # var_name attrs so an imported 'w' cannot alias an existing
             # variable 'w' in this graph (store keys come from these attrs).
@@ -152,10 +153,17 @@ def import_graph_def(graph_def, input_map=None, return_elements=None,
                     inputs.append(input_map[ref])
                 else:
                     inputs.append(tensor_env[ref])
-            ctrl = [tensor_env["(op)" + c] for c in node["control_input"]
+            ctrl = [tensor_env["(op)" + c]
+                    for c in node.get("control_input", ())
                     if "(op)" + c in tensor_env]
-            specs = [(shape_mod.TensorShape(sh), dtypes_mod.as_dtype(dt))
-                     for sh, dt in node["output_specs"]]
+            # A producer that doesn't know output shapes (e.g. the C client
+            # building math ops) omits output_specs; the op registry's
+            # shape inference fills them in, mirroring the reference's
+            # shape_refiner on import (ref: common_runtime/shape_refiner.cc).
+            specs_raw = node.get("output_specs")
+            specs = None if specs_raw is None else [
+                (shape_mod.TensorShape(sh), dtypes_mod.as_dtype(dt))
+                for sh, dt in specs_raw]
             new_name = f"{scope_prefix}/{node['name']}" if scope_prefix \
                 else node["name"]
             op = target_graph.create_op(

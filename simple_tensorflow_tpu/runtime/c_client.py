@@ -1,0 +1,91 @@
+"""Python side of the C client-library bridge (runtime_cc/session_c.cc).
+
+The reference can build graphs, add symbolic gradients, and run training
+loops entirely from C++ (ref: tensorflow/cc/framework/gradients.h:34
+``AddSymbolicGradients``, cc/framework/scope.h, cc/training/). Here the
+graph *builder* is native C (runtime_cc/c_api.cc StfGraph*), and the two
+operations that need the op registry — symbolic gradients and execution —
+cross into Python through this module:
+
+``StfAddGradients``       → :func:`add_gradients`  (graph-JSON in/out)
+``StfSessionFromGraphJson`` → :func:`load_graph`   (run handle)
+
+Both speak GraphDef-JSON, the same wire format ``stf.import_graph_def``
+uses, so a C-built graph, its Python-derived gradient subgraph, and any
+C-added training ops all live in one serializable artifact.
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import c_session
+
+
+def add_gradients(graph_json, ys, xs):
+    """Add d(sum ys)/d(xs) nodes to a serialized graph.
+
+    Returns ``(new_graph_json, grad_tensor_names)`` with grad names
+    aligned to ``xs``. Raises if any x is unreachable from ys — a C
+    caller has no use for a silent ``None`` (ref: cc/framework/
+    gradients.cc ``AddSymbolicGradients`` returns error status).
+    """
+    from ..framework import gradients as grads_mod
+    from ..framework import graph as ops_mod
+    from ..framework import graph_io
+
+    g = ops_mod.Graph()
+    with g.as_default():
+        graph_io.import_graph_def(graph_json, name="")
+
+        def _tensor(name):
+            return g.as_graph_element(
+                name if ":" in name else name + ":0",
+                allow_tensor=True, allow_operation=False)
+
+        y_ts = [_tensor(y) for y in ys]
+        x_ts = [_tensor(x) for x in xs]
+        grads = grads_mod.gradients(y_ts, x_ts)
+        names = []
+        for x_t, g_t in zip(x_ts, grads):
+            if g_t is None:
+                raise ValueError(
+                    f"AddGradients: no gradient path from ys to {x_t.name}")
+            names.append(g_t.name)
+        gd = graph_io.graph_to_graphdef(g)
+    return json.dumps(gd), names
+
+
+def load_graph(graph_json) -> int:
+    """Import a serialized graph, create a Session, run the variable
+    initializers, and register it for StfSessionRun. Returns a handle.
+
+    Initializers: every variable created through the C API (or Python)
+    carries an ``Assign`` op named ``<var_name>/Assign``; those — and only
+    those — run at load (running arbitrary Assign nodes would execute
+    training ops).
+    """
+    import simple_tensorflow_tpu as stf
+    from ..framework import graph as ops_mod
+    from ..framework import graph_io
+
+    g = ops_mod.Graph()
+    with g.as_default():
+        graph_io.import_graph_def(graph_json, name="")
+        sess = stf.Session(graph=g)
+        var_names = {op.attrs["var_name"] for op in g.get_operations()
+                     if op.type == "VariableV2"}
+        init_ops = [op for op in g.get_operations()
+                    if op.type == "Assign"
+                    and op.attrs.get("var_name") in var_names
+                    # C-built variables name it "<var>/Assign"; Python's
+                    # Variable ctor nests one more scope: "<var>/Assign/Assign"
+                    and op.name in (op.attrs["var_name"] + "/Assign",
+                                    op.attrs["var_name"] + "/Assign/Assign")]
+        if init_ops:
+            sess.run(init_ops)
+    with c_session._lock:
+        sid = c_session._next_id[0]
+        c_session._next_id[0] += 1
+        c_session._sessions[sid] = (sess, g, {})
+    return sid

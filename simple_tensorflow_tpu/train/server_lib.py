@@ -78,6 +78,7 @@ class Server:
     """
 
     _started = False
+    _coordinator = None  # address Session("grpc://…") targets check against
 
     def __init__(self, server_or_cluster_def, job_name=None, task_index=None,
                  protocol=None, config=None, start=True):
@@ -115,6 +116,7 @@ class Server:
         n = len(workers)
         if n <= 1:
             Server._started = True
+            Server._coordinator = workers[0] if workers else None
             return
         import jax
 
@@ -125,6 +127,7 @@ class Server:
                 num_processes=n,
                 process_id=self._task_index)
             Server._started = True
+            Server._coordinator = coordinator
         except Exception as e:  # pragma: no cover - needs real multi-host
             raise RuntimeError(
                 f"jax.distributed.initialize failed for {coordinator}: {e}")

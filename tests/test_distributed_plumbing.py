@@ -182,3 +182,156 @@ class TestTwoProcessDistributed:
             assert d["n_proc"] == 2, d
             assert d["n_dev"] == 2, d  # global view: both processes' devices
             assert d["target"].startswith("stf://worker:")
+
+
+class TestSessionTargetRouting:
+    """VERDICT r4 item 5: Session(target) must route or raise — silently
+    running local on a non-empty target is the one forbidden outcome
+    (ref: core/distributed_runtime/rpc/grpc_session.cc)."""
+
+    def _fresh(self):
+        old = (server_lib.Server._started, server_lib.Server._coordinator)
+        server_lib.Server._started = False
+        server_lib.Server._coordinator = None
+        return old
+
+    def _restore(self, old):
+        server_lib.Server._started, server_lib.Server._coordinator = old
+
+    def test_unknown_scheme_raises_unimplemented(self):
+        import simple_tensorflow_tpu as stf
+        from simple_tensorflow_tpu.framework import errors
+
+        with pytest.raises(errors.UnimplementedError, match="not supported"):
+            stf.Session("ipc:///tmp/sock")
+
+    def test_stf_target_requires_server(self):
+        import simple_tensorflow_tpu as stf
+        from simple_tensorflow_tpu.framework import errors
+
+        old = self._fresh()
+        try:
+            with pytest.raises(errors.FailedPreconditionError,
+                               match="no Server has started"):
+                stf.Session("stf://worker:0")
+        finally:
+            self._restore(old)
+
+    def test_grpc_target_without_bootstrap_raises(self):
+        import simple_tensorflow_tpu as stf
+        from simple_tensorflow_tpu.framework import errors
+
+        old = self._fresh()
+        try:
+            with pytest.raises(errors.FailedPreconditionError,
+                               match="bootstrap"):
+                stf.Session("grpc://10.0.0.1:2222")
+        finally:
+            self._restore(old)
+
+    def test_grpc_target_mismatched_coordinator_raises(self):
+        import simple_tensorflow_tpu as stf
+        from simple_tensorflow_tpu.framework import errors
+
+        old = self._fresh()
+        try:
+            server_lib.Server._started = True
+            server_lib.Server._coordinator = "127.0.0.1:1111"
+            with pytest.raises(errors.InvalidArgumentError,
+                               match="does not match"):
+                stf.Session("grpc://127.0.0.1:2222")
+            stf.Session("grpc://127.0.0.1:1111").close()  # match: accepted
+        finally:
+            self._restore(old)
+
+    def test_server_target_accepted_after_local_server(self):
+        import simple_tensorflow_tpu as stf
+
+        old = self._fresh()
+        try:
+            s = server_lib.Server.create_local_server()
+            sess = stf.Session(s.target)
+            stf.reset_default_graph()
+            sess.close()
+        finally:
+            self._restore(old)
+    def test_two_process_session_step_on_global_mesh(self, tmp_path):
+        """Process B (and A — SPMD) runs stf.Session(server.target) and
+        executes a training step on the GLOBAL 2-device mesh: a variable
+        sharded across both processes' devices updates, loss decreases
+        (VERDICT r4 item 5 'done' criterion)."""
+        import socket
+        import subprocess
+        import sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cluster = f"127.0.0.1:{port}"
+        script = (
+            "import os, sys, json\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import simple_tensorflow_tpu as stf\n"
+            "from simple_tensorflow_tpu import parallel\n"
+            "from simple_tensorflow_tpu.train import server_lib\n"
+            "server_lib.Server._started = False\n"
+            "idx = int(sys.argv[1])\n"
+            "srv = server_lib.Server(\n"
+            "    {'worker': ['%s', '%s']},\n"
+            "    job_name='worker', task_index=idx, start=True)\n"
+            "devices = jax.devices()\n"
+            "assert len(devices) == 2, devices\n"
+            "mesh = parallel.Mesh({'dp': 2}, devices=devices)\n"
+            "with mesh:\n"
+            "    w0 = np.arange(8, dtype=np.float32).reshape(4, 2) * 0.3\n"
+            "    W = stf.Variable(w0, name='W')\n"
+            "    parallel.shard_variable(W, 'dp', None)\n"
+            "    loss = stf.reduce_mean(stf.square(W._ref))\n"
+            "    train = stf.train.GradientDescentOptimizer(0.5)"
+            ".minimize(loss)\n"
+            "    sess = stf.Session(srv.target)\n"
+            "    sess.run(stf.global_variables_initializer())\n"
+            "    l0 = float(np.asarray(sess.run(loss)))\n"
+            "    sess.run(train)\n"
+            "    l1 = float(np.asarray(sess.run(loss)))\n"
+            "    arr = sess._variable_store.values['W']\n"
+            "    n_dev = len(arr.sharding.device_set)\n"
+            "print(json.dumps({'pid': idx, 'l0': l0, 'l1': l1,\n"
+            "                  'w_devices': n_dev,\n"
+            "                  'n_proc': jax.process_count()}))\n"
+            % (cluster, cluster))
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)  # one device per process
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", script, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=str(tmp_path))
+            for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=180)
+                assert p.returncode == 0, f"rc={p.returncode}: {err[-2000:]}"
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        import json as _json
+
+        for out in outs:
+            line = [l for l in out.splitlines() if l.startswith("{")][-1]
+            d = _json.loads(line)
+            assert d["n_proc"] == 2, d
+            assert d["w_devices"] == 2, d  # W really spans both processes
+            assert d["l1"] < d["l0"], d   # the global-mesh step trained

@@ -384,3 +384,84 @@ def test_prefetch_to_device_refuses_arena_on_cpu():
     assert len(out) == 10  # 10 batches >> buffer_size+2 slots
     np.testing.assert_allclose(
         np.concatenate([np.asarray(o) for o in out]), X)
+
+
+def test_c_client_builds_grads_and_trains(tmp_path):
+    """C++ client parity (VERDICT r4 item 2; ref cc/framework/scope.h,
+    cc/framework/gradients.h:34, cc/framework/gradient_checker.cc):
+    compile runtime_cc/client_demo.c — a pure-C program that builds
+    y = xW + b, requests dL/dW via StfAddGradients, appends SGD ops,
+    runs a train step through StfSessionFromGraphJson, and
+    gradient-checks dL/dx against central differences — then match its
+    numbers against the same model built natively in Python."""
+    import shutil
+    import subprocess
+
+    cc_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "runtime_cc")
+    if not os.path.exists(os.path.join(cc_dir, "libstf_session.so")):
+        if native.load_session_lib() is None:
+            pytest.skip("libstf_session.so unavailable")
+    gcc = shutil.which("gcc") or shutil.which("cc")
+    if gcc is None:
+        pytest.skip("no C compiler")
+
+    exe = str(tmp_path / "client_demo")
+    subprocess.run(
+        [gcc, "-O1", "-o", exe,
+         os.path.join(cc_dir, "client_demo.c"),
+         "-I", cc_dir, "-L", cc_dir, "-lstf_runtime", "-lstf_session",
+         "-lm", f"-Wl,-rpath,{cc_dir}"],
+        check=True, capture_output=True, timeout=120)
+
+    # strip the TPU-plugin bootstrap env: with it set, the embedded
+    # interpreter's sitecustomize registers the plugin and jax backend
+    # init can hang on a wedged relay even under JAX_PLATFORMS=cpu
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["PYTHONPATH"] = os.path.dirname(cc_dir) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([exe], env=env, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    lines = dict(line.split(" ", 1) for line in
+                 proc.stdout.strip().splitlines() if " " in line)
+    assert "OK" in proc.stdout
+
+    c_l0 = float(lines["l0"])
+    c_l1 = float(lines["l1"])
+    c_gradcheck = float(lines["gradcheck_max_err"])
+    c_w_after = np.array([float(v) for v in lines["W_after"].split()],
+                         np.float32).reshape(3, 2)
+    assert c_l1 < c_l0
+    assert c_gradcheck < 1e-3
+
+    # ---- same model natively in Python: numbers must match -------------
+    B, D_IN, D_OUT, LR = 4, 3, 2, 0.1
+    xv = np.sin(0.7 * np.arange(B * D_IN, dtype=np.float32) + 0.3) \
+        .reshape(B, D_IN).astype(np.float32)
+    tv = np.cos(0.3 * np.arange(B * D_OUT, dtype=np.float32) - 0.2) \
+        .reshape(B, D_OUT).astype(np.float32)
+    w0 = (0.05 * np.arange(1, D_IN * D_OUT + 1, dtype=np.float32)) \
+        .reshape(D_IN, D_OUT)
+
+    stf.reset_default_graph()
+    x = stf.placeholder(stf.float32, [B, D_IN], name="x")
+    t = stf.placeholder(stf.float32, [B, D_OUT], name="t")
+    W = stf.Variable(w0, name="W")
+    b = stf.Variable(np.zeros((D_OUT,), np.float32), name="b")
+    y = stf.matmul(x, W._ref) + b._ref
+    loss = stf.reduce_mean(stf.square(y - t))
+    train = stf.train.GradientDescentOptimizer(LR).minimize(loss)
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+    feed = {x: xv, t: tv}
+    py_l0 = sess.run(loss, feed)
+    sess.run(train, feed)
+    py_l1 = sess.run(loss, feed)
+    py_w = np.asarray(sess.run(W.value()))
+
+    np.testing.assert_allclose(c_l0, py_l0, rtol=1e-5)
+    np.testing.assert_allclose(c_l1, py_l1, rtol=1e-5)
+    np.testing.assert_allclose(c_w_after, py_w, rtol=1e-5, atol=1e-7)
