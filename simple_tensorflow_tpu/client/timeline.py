@@ -55,3 +55,29 @@ def trace_session_run(session, fetches, feed_dict=None, log_dir="/tmp/stf_trace"
     finally:
         jax.profiler.stop_trace()
     return out
+
+
+def predicted_vs_measured(fetches, feeds=(), measured_seconds=None):
+    """Static cost-model prediction for ``fetches`` next to a measured
+    step time (ref: grappler/costs/cost_estimator.h — the reference
+    checks its cost model against real run stats the same way).
+
+    Returns predicted FLOPs/bytes/peak-memory, the roofline-projected
+    step seconds for the attached chip, and — when ``measured_seconds``
+    is given — measured/predicted, where >>1 means the program is
+    leaving roofline performance on the table (or the model missed
+    traffic: compare bytes against utils.perf.cost_of on the compiled
+    step to tell which)."""
+    from ..framework import cost_model
+    from ..utils import perf
+
+    est = cost_model.estimate(fetches, feeds=feeds)
+    peak_flops, peak_bw = perf.chip_spec()
+    out = dict(est.summary())
+    pred_s = est.seconds_on(peak_flops, peak_bw)
+    out["predicted_sec_per_step"] = float(f"{pred_s:.4g}")
+    if measured_seconds:
+        out["measured_sec_per_step"] = float(f"{measured_seconds:.4g}")
+        out["measured_over_predicted"] = round(
+            float(measured_seconds) / max(pred_s, 1e-12), 3)
+    return out

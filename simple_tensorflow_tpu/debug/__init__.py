@@ -16,6 +16,8 @@ execution is one XLA program, so debugging hooks differently:
 
 from .analyzer import DebugDumpDir, DebugTensorDatum
 from .cli import AnalyzerCLI
+from .io_utils import (DebugListener, DebugSink, FileSink, SocketSink,
+                       publish_debug_tensor, sink_for_url)
 from .wrappers import (DumpingDebugWrapperSession, LocalCLIDebugWrapperSession,
                        TensorWatch, add_check_numerics_ops,
                        has_inf_or_nan)

@@ -1,0 +1,210 @@
+"""Debug tensor sinks: publish watched tensors to URLs
+(ref: tensorflow/core/debug/debug_io_utils.{h,cc},
+debug_service.proto, debug_gateway.cc).
+
+The reference streams watched tensors to ``file://`` and ``grpc://``
+targets so a debugger in another process can observe a running training
+job. TPU-native equivalent:
+
+- ``file://<dir>`` — one subdirectory per run with .npy dumps and a
+  manifest (same layout as DumpingDebugWrapperSession).
+- ``tcp://host:port`` — the grpc:// role: a length-prefixed stream of
+  (JSON header, npy payload) events over a socket to a live reader in
+  another process. The reader side is :class:`DebugListener` (in-process
+  thread) or ``python -m simple_tensorflow_tpu.debug.io_utils --listen``
+  (subprocess / remote host).
+
+Wire format, one event::
+
+    uint32 header_len (little-endian) | header JSON (utf-8) | payload
+    header = {"name", "run_index", "wall_time", "nbytes"}
+    payload = numpy .npy bytes (self-describing dtype + shape)
+
+A zero header_len is the end-of-stream marker.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+
+class DebugSink:
+    """Publish interface (ref: debug_io_utils.h ``DebugIO::PublishDebugTensor``)."""
+
+    def publish(self, run_index: int, name: str, value) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileSink(DebugSink):
+    def __init__(self, root: str):
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+        self._manifests: Dict[int, Dict[str, Any]] = {}
+
+    def publish(self, run_index, name, value):
+        run_dir = os.path.join(self._root, f"run_{run_index}")
+        os.makedirs(run_dir, exist_ok=True)
+        safe = name.replace("/", "_").replace(":", "_")
+        arr = np.asarray(value)
+        np.save(os.path.join(run_dir, safe + ".npy"), arr)
+        man = self._manifests.setdefault(run_index, {})
+        man[name] = {"file": safe + ".npy"}
+        with open(os.path.join(run_dir, "manifest.json"), "w") as f:
+            json.dump({"time": time.time(), "tensors": man}, f, indent=1)
+
+
+class SocketSink(DebugSink):
+    """Streams events to a live reader (the grpc:// role; ref:
+    debug_service.proto ``EventListener.SendEvents``)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+
+    def publish(self, run_index, name, value):
+        arr = np.asarray(value)
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        payload = buf.getvalue()
+        header = json.dumps({
+            "name": name, "run_index": int(run_index),
+            "wall_time": time.time(), "nbytes": len(payload),
+        }).encode()
+        msg = struct.pack("<I", len(header)) + header + payload
+        self._sock.sendall(msg)
+
+    def close(self):
+        try:
+            self._sock.sendall(struct.pack("<I", 0))  # end-of-stream
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def sink_for_url(url: str) -> DebugSink:
+    """(ref: debug_io_utils.cc ``DebugIO::PublishDebugTensor`` URL
+    dispatch — file:// and grpc:// there; file:// and tcp:// here)."""
+    p = urlparse(url)
+    if p.scheme == "file":
+        return FileSink(p.path)
+    if p.scheme in ("tcp", "grpc"):
+        return SocketSink(p.hostname, int(p.port))
+    raise ValueError(
+        f"unsupported debug URL {url!r}: use file:///dir or tcp://host:port")
+
+
+def publish_debug_tensor(sinks: List[DebugSink], run_index: int,
+                         name: str, value) -> None:
+    for s in sinks:
+        s.publish(run_index, name, value)
+
+
+# ---------------------------------------------------------------------------
+# reader side
+# ---------------------------------------------------------------------------
+
+def _read_exact(conn, n):
+    data = b""
+    while len(data) < n:
+        chunk = conn.recv(n - len(data))
+        if not chunk:
+            raise ConnectionError("debug stream truncated")
+        data += chunk
+    return data
+
+
+def read_event_stream(conn):
+    """Yield (header_dict, np.ndarray) until end-of-stream."""
+    while True:
+        raw = _read_exact(conn, 4)
+        (hlen,) = struct.unpack("<I", raw)
+        if hlen == 0:
+            return
+        header = json.loads(_read_exact(conn, hlen))
+        payload = _read_exact(conn, header["nbytes"])
+        arr = np.load(io.BytesIO(payload), allow_pickle=False)
+        yield header, arr
+
+
+class DebugListener:
+    """In-process reader: accept one sender, collect events on a thread
+    (ref: debug/grpc_debug_server.py ``EventListenerBaseServicer``)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._server = socket.socket()
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(1)
+        self.port = self._server.getsockname()[1]
+        self.events: List[Any] = []
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        try:
+            conn, _ = self._server.accept()
+            for header, arr in read_event_stream(conn):
+                self.events.append((header, arr))
+            conn.close()
+        except (OSError, ConnectionError):
+            pass
+
+    def wait(self, timeout=30.0):
+        self._thread.join(timeout)
+
+    def close(self):
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+def _listen_main(port: int, out_dir: Optional[str]) -> None:
+    """Subprocess reader CLI: write every received event to out_dir and a
+    summary JSONL on stdout."""
+    server = socket.socket()
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", port))
+    server.listen(1)
+    print(json.dumps({"listening": server.getsockname()[1]}), flush=True)
+    conn, _ = server.accept()
+    n = 0
+    for header, arr in read_event_stream(conn):
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            safe = header["name"].replace("/", "_").replace(":", "_")
+            np.save(os.path.join(
+                out_dir, f"run{header['run_index']}_{safe}.npy"), arr)
+        print(json.dumps({"name": header["name"],
+                          "run_index": header["run_index"],
+                          "shape": list(arr.shape),
+                          "dtype": str(arr.dtype),
+                          "mean": float(np.mean(arr))
+                          if arr.dtype.kind in "fiu" and arr.size else None}),
+              flush=True)
+        n += 1
+    print(json.dumps({"done": n}), flush=True)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--listen", type=int, required=True,
+                    help="port to listen on (0 = ephemeral, printed)")
+    ap.add_argument("--out", default=None, help="dir for received .npy")
+    args = ap.parse_args()
+    _listen_main(args.listen, args.out)

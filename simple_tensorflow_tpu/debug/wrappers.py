@@ -70,17 +70,28 @@ class _WrapperBase:
 
 class DumpingDebugWrapperSession(_WrapperBase):
     """(ref: python/debug/wrappers/dumping_wrapper.py). Dumps every watched
-    tensor of every run to <dump_root>/run_<n>/<tensor>.npy + manifest."""
+    tensor of every run to <dump_root>/run_<n>/<tensor>.npy + manifest,
+    and — via ``debug_urls`` — to remote sinks (``tcp://host:port``
+    streams to a reader in another process; ref debug_io_utils.cc)."""
 
-    def __init__(self, sess, session_root, watch_fn=None, log_usage=False):
+    def __init__(self, sess, session_root, watch_fn=None, log_usage=False,
+                 debug_urls=()):
         super().__init__(sess)
         self._root = session_root
         os.makedirs(session_root, exist_ok=True)
         self._run_counter = 0
         self._watches = [TensorWatch("*")]
+        from . import io_utils
+
+        self._sinks = [io_utils.sink_for_url(u) for u in debug_urls]
 
     def add_tensor_filter(self, name, fn):
         pass
+
+    def close(self):
+        for s in self._sinks:
+            s.close()
+        self._sess.close()
 
     def run(self, fetches, feed_dict=None, options=None, run_metadata=None):
         watched = self._watched_tensors(fetches, feed_dict, self._watches)
@@ -99,6 +110,8 @@ class DumpingDebugWrapperSession(_WrapperBase):
                 "file": safe + ".npy",
                 "has_inf_or_nan": has_inf_or_nan(t.name, v),
             }
+            for s in self._sinks:
+                s.publish(self._run_counter, t.name, v)
         with open(os.path.join(run_dir, "manifest.json"), "w") as f:
             json.dump({"time": time.time(), "tensors": manifest}, f, indent=1)
         return result["__fetches__"]

@@ -157,9 +157,246 @@ def constant_folding(graph_def: Dict) -> Dict:
     return out
 
 
-def optimize(graph_def: Dict, keep: Optional[List[str]] = None) -> Dict:
-    """grappler-equivalent pipeline: fold -> CSE -> DCE."""
-    gd = constant_folding(graph_def)
+# ---------------------------------------------------------------------------
+# layout optimization (ref: core/grappler/optimizers/layout_optimizer.cc)
+# ---------------------------------------------------------------------------
+
+_NCHW_TO_NHWC = (0, 2, 3, 1)
+_NHWC_TO_NCHW = (0, 3, 1, 2)
+
+# image ops that carry a data_format attr; "vec" attrs are per-dimension
+# 4-vectors (strides/ksize/dilations) permuted along with the layout
+_LAYOUT_OPS = {
+    "Conv2D": ("strides", "dilations"),
+    "DepthwiseConv2dNative": ("strides", "dilations"),
+    "MaxPool": ("strides", "ksize"),
+    "AvgPool": ("strides", "ksize"),
+    "FusedBatchNorm": (),
+    "BiasAdd": (),
+}
+
+# rank-preserving elementwise ops a transpose can move through unchanged
+_LAYOUT_AGNOSTIC = {
+    "Relu", "Relu6", "Elu", "Selu", "LeakyRelu", "Tanh", "Sigmoid",
+    "Softplus", "Abs", "Neg", "Square", "Sqrt", "Rsqrt", "Exp", "Log",
+    "Identity", "Add", "AddV2", "Sub", "Mul", "RealDiv", "Maximum",
+    "Minimum", "SquaredDifference",
+}
+
+
+def _compose_perm(p2, p1):
+    """perm of transpose(transpose(x, p2), p1)."""
+    return tuple(p2[i] for i in p1)
+
+
+def layout_optimization(graph_def: Dict,
+                        keep: Optional[List[str]] = None) -> Dict:
+    """Rewrite NCHW image ops to NHWC globally (ref: grappler
+    layout_optimizer.cc). TPU rationale: the per-op lowering honors NCHW
+    by transposing around EVERY conv/pool/bn call; this pass instead
+    converts the ops once and pushes the layout conversions to the
+    subgraph boundary, cancelling interior transpose pairs — an NCHW
+    ResNet block lowers with exactly two transposes (one in, one out).
+
+    Three phases: (1) convert each NCHW op to NHWC with explicit
+    boundary transposes; (2) push NHWC→NCHW transposes down through
+    rank-preserving elementwise ops (so pairs become adjacent);
+    (3) cancel adjacent inverse pairs, then DCE.
+    Touched nodes drop their output_specs — the importer's shape
+    inference recomputes them in the new layout.
+    """
+    from . import graph_io
+
+    out = copy.deepcopy(graph_def)
+    nodes: List[Dict] = out["node"]
+    by_name = {n["name"]: n for n in nodes}
+
+    def _uniq(base):
+        name = base
+        k = 1
+        while name in by_name:
+            name = f"{base}_{k}"
+            k += 1
+        return name
+
+    def _attr(n, key, default=None):
+        v = n.get("attr", {}).get(key)
+        return default if v is None else graph_io._decode_attr(v)
+
+    def _perm_of(n):
+        p = _attr(n, "perm")
+        return tuple(p) if p is not None else ()
+
+    enc = graph_io._encode_attr
+
+    # ---- phase 1: per-op conversion (in topo order, so a converted
+    # producer's boundary transpose is visible to later converts).
+    # NAME SWAP: the converted op is renamed "<name>/nhwc" and the
+    # inverse output transpose takes the ORIGINAL name, so every
+    # existing reference — graph edges AND by-name fetches — still sees
+    # NCHW data without any rewiring. Extra outputs (FusedBatchNorm's
+    # per-channel mean/var) are layout-free and rewired to the renamed
+    # node directly.
+    new_nodes: List[Dict] = []
+    rewire: Dict[str, str] = {}  # "orig:k" (k>0) -> "<orig>/nhwc:k"
+    converted = []
+    for n in nodes:
+        if n["op"] not in _LAYOUT_OPS or _attr(n, "data_format") != "NCHW":
+            new_nodes.append(n)
+            continue
+        orig = n["name"]
+        vec_attrs = _LAYOUT_OPS[n["op"]]
+        n["attr"]["data_format"] = "NHWC"
+        for va in vec_attrs:
+            v = _attr(n, va)
+            if isinstance(v, (list, tuple)) and len(v) == 4:
+                n["attr"][va] = enc(tuple((v[0], v[2], v[3], v[1])))
+        n_specs = len(n.get("output_specs") or [])
+        n.pop("output_specs", None)
+        del by_name[orig]
+        n["name"] = _uniq(orig + "/nhwc")
+        by_name[n["name"]] = n
+        for k in range(1, n_specs):
+            rewire[f"{orig}:{k}"] = f"{n['name']}:{k}"
+        # transpose the data input (input 0 for every op here); chained
+        # converted producers resolve automatically: their original name
+        # now names their inverse transpose
+        t_in = {
+            "name": _uniq(orig + "/nchw_to_nhwc"),
+            "op": "Transpose", "input": [n["input"][0]],
+            "control_input": [], "device": n.get("device", ""),
+            "attr": {"perm": enc(_NCHW_TO_NHWC)},
+        }
+        by_name[t_in["name"]] = t_in
+        new_nodes.append(t_in)
+        n["input"] = [t_in["name"] + ":0"] + list(n["input"][1:])
+        new_nodes.append(n)
+        # inverse transpose under the ORIGINAL name serves consumers
+        t_out = {
+            "name": orig,
+            "op": "Transpose", "input": [n["name"] + ":0"],
+            "control_input": [], "device": n.get("device", ""),
+            "attr": {"perm": enc(_NHWC_TO_NCHW)},
+        }
+        by_name[orig] = t_out
+        new_nodes.append(t_out)
+        converted.append(orig)
+    if rewire:
+        conv_set = set(converted)
+        for n in new_nodes:
+            if n["name"] in conv_set:  # the t_out shims keep ":0" inputs
+                continue
+            n["input"] = [rewire.get(i, i) for i in n.get("input", [])]
+    nodes = new_nodes
+    by_name = {n["name"]: n for n in nodes}
+
+    # ---- phase 2: push NHWC->NCHW transposes through elementwise ----
+    def _is_inv_transpose(ref):
+        node, idx = _tensor_ref(ref)
+        m = by_name.get(node)
+        return (m is not None and m["op"] == "Transpose" and idx == 0
+                and _perm_of(m) == _NHWC_TO_NCHW)
+
+    def _rank4_ref(ref):
+        """Producer output spec says rank 4 (safe to forward-transpose)."""
+        node, idx = _tensor_ref(ref)
+        m = by_name.get(node)
+        specs = (m or {}).get("output_specs")
+        if not specs or idx >= len(specs):
+            return False
+        sh = specs[idx][0]
+        return isinstance(sh, list) and len(sh) == 4
+
+    changed = True
+    while changed:
+        changed = False
+        addenda = []
+        for n in nodes:
+            if n["op"] not in _LAYOUT_AGNOSTIC or n.get("control_input"):
+                continue
+            ins = n.get("input", [])
+            # every input must be pushable: already NHWC behind an inverse
+            # transpose, or a rank-4 tensor we can forward-transpose here
+            # (identity shortcuts: Add(bn_out, x) — the x transpose then
+            # CSEs with the first conv's input transpose). Same-rank
+            # inputs only: broadcasting scalars would change meaning.
+            if not ins or not any(_is_inv_transpose(i) for i in ins):
+                continue
+            if not all(_is_inv_transpose(i) or _rank4_ref(i)
+                       for i in ins):
+                continue
+            if any(k in n.get("attr", {}) for k in ("data_format",)):
+                continue
+            # consume the transposes' NHWC inputs directly; forward-
+            # transpose the NCHW stragglers
+            new_ins = []
+            for i in ins:
+                if _is_inv_transpose(i):
+                    new_ins.append(by_name[_tensor_ref(i)[0]]["input"][0])
+                else:
+                    t_f = {
+                        "name": _uniq(_tensor_ref(i)[0] +
+                                      "/nchw_to_nhwc"),
+                        "op": "Transpose", "input": [i],
+                        "control_input": [],
+                        "device": n.get("device", ""),
+                        "attr": {"perm": enc(_NCHW_TO_NHWC)},
+                    }
+                    by_name[t_f["name"]] = t_f
+                    addenda.append((_tensor_ref(i)[0], t_f))
+                    new_ins.append(t_f["name"] + ":0")
+            n["input"] = new_ins
+            n.pop("output_specs", None)
+            # name swap (as in phase 1): this op becomes "<name>/nhwc",
+            # an inverse transpose under the ORIGINAL name serves every
+            # existing reference unchanged
+            orig = n["name"]
+            del by_name[orig]
+            n["name"] = _uniq(orig + "/nhwc")
+            by_name[n["name"]] = n
+            t_out = {
+                "name": orig,
+                "op": "Transpose", "input": [n["name"] + ":0"],
+                "control_input": [], "device": n.get("device", ""),
+                "attr": {"perm": enc(_NHWC_TO_NCHW)},
+            }
+            by_name[orig] = t_out
+            addenda.append((n["name"], t_out))
+            changed = True
+        # splice each new transpose right after its producer
+        for prod_name, t_out in addenda:
+            idx = next(i for i, m in enumerate(nodes)
+                       if m["name"] == prod_name)
+            nodes.insert(idx + 1, t_out)
+
+    # ---- phase 3: cancel adjacent inverse pairs ---------------------
+    alias: Dict[str, str] = {}
+    for n in nodes:
+        n["input"] = [alias.get(i, i) for i in n.get("input", [])]
+        if n["op"] != "Transpose":
+            continue
+        p1 = _perm_of(n)
+        src_name, src_idx = _tensor_ref(n["input"][0])
+        src = by_name.get(src_name)
+        if (src is not None and src["op"] == "Transpose" and src_idx == 0):
+            p2 = _perm_of(src)
+            if len(p1) == len(p2) and \
+                    _compose_perm(p2, p1) == tuple(range(len(p1))):
+                alias[n["name"] + ":0"] = src["input"][0]
+    for n in nodes:
+        n["input"] = [alias.get(i, i) for i in n.get("input", [])]
+
+    out["node"] = nodes
+    if keep:
+        out = dead_code_elimination(out, keep)
+    return out
+
+
+def optimize(graph_def: Dict, keep: Optional[List[str]] = None,
+             layout: bool = True) -> Dict:
+    """grappler-equivalent pipeline: layout -> fold -> CSE -> DCE."""
+    gd = layout_optimization(graph_def, keep=keep) if layout else graph_def
+    gd = constant_folding(gd)
     gd = common_subexpression_elimination(gd, keep=keep)
     if keep:
         gd = dead_code_elimination(gd, keep)

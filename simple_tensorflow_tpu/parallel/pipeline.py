@@ -304,6 +304,22 @@ def _lower_pipeline_train(ctx, op, inputs):
 op_registry.register("PipelineTrain", lower=_lower_pipeline_train)
 
 
+def _device_memory_budget(frac=0.6):
+    """Usable HBM for activation stashes: memory_stats when the backend
+    reports it, else the v5e's 16 GB, scaled by ``frac`` (params,
+    optimizer state, and XLA scratch own the rest)."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return frac * float(limit)
+    except Exception:
+        pass
+    return frac * 16e9
+
+
 def pipeline_train(stage_fn, loss_fn, params, x, targets, *,
                    n_microbatches, axis="pp", name=None):
     """Graph op: 1F1B-scheduled pipelined TRAINING step over mesh axis
@@ -323,6 +339,11 @@ def pipeline_train(stage_fn, loss_fn, params, x, targets, *,
     (summed over a microbatch). ``params`` are stacked (n_stages, ...)
     tensors sharded over ``axis``; ``x``/``targets``: (batch, ...) with
     batch divisible by n_microbatches.
+
+    ``n_microbatches="auto"`` sizes the microbatch count from the static
+    cost model (framework/cost_model.py): smallest count whose 1F1B
+    activation stash fits the per-device HBM budget, clamped to
+    [n_stages, batch] and to a divisor of the batch.
     """
     from ..ops.functional_ops import _build_fn_graph
 
@@ -339,6 +360,31 @@ def pipeline_train(stage_fn, loss_fn, params, x, targets, *,
             raise ValueError(
                 f"stacked param {p} must have leading dim == n_stages "
                 f"({n_stages})")
+
+    if n_microbatches == "auto":
+        # cost-model-driven choice (ref: grappler graph_memory.cc role):
+        # the inter-stage state (x-shaped, per microbatch) is the 1F1B
+        # activation stash; fit it in a fraction of per-device HBM, then
+        # clamp to the batch.
+        from ..framework import cost_model as cost_model_mod
+
+        state_bytes = 1
+        for d in x.shape.dims:
+            state_bytes *= d.value or 1
+        state_bytes *= x.dtype.base_dtype.size
+        budget = _device_memory_budget()
+        n_microbatches = cost_model_mod.suggest_microbatches(
+            float(state_bytes), n_stages, budget, schedule="1f1b")
+        # more microbatches than batch rows is meaningless; also keep the
+        # bubble fraction sane (>= n_stages microbatches when possible)
+        batch_rows = x.shape[0].value
+        n_microbatches = max(min(n_microbatches, batch_rows),
+                             min(n_stages, batch_rows))
+        # round UP to a divisor of the batch: fewer microbatches would
+        # mean BIGGER stashes and blow the budget the count was fitted to
+        # (batch_rows divides itself, so this terminates)
+        while batch_rows % n_microbatches:
+            n_microbatches += 1
 
     mb = x.shape[0].value // n_microbatches
     arg_specs = ([(p.shape.as_list()[1:], p.dtype) for p in params]

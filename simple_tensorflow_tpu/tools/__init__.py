@@ -5,7 +5,11 @@ All operate on the JSON GraphDef / stf-bundle checkpoint formats and are
 runnable as ``python -m simple_tensorflow_tpu.tools.<tool>``.
 """
 
+from .aot_compile import aot_compile
+from .aot_compile import load as load_aot_program
 from .freeze_graph import freeze_graph, freeze_graph_def
 from .inspect_checkpoint import print_tensors_in_checkpoint_file
 from .optimize_for_inference import optimize_for_inference
+from .print_selective_registration_header import (header_for_graphs,
+                                                  required_ops)
 from .strip_unused import strip_unused_nodes

@@ -1,0 +1,154 @@
+"""tfcompile-equivalent AOT CLI (ref: tensorflow/compiler/aot/
+{compile.cc,codegen.cc,tfcompile_main.cc}).
+
+The reference turns a frozen GraphDef + config into a linkable object
+file + header. TPU-native equivalent: lower the fetch subgraph to ONE
+XLA program and emit a self-contained artifact directory::
+
+    python -m simple_tensorflow_tpu.tools.aot_compile \
+        --graph g.json --feed x:0 --fetch y:0 --out prog/
+
+``prog/`` contains:
+
+- ``program.stablehlo`` — the serialized portable executable
+  (jax.export artifact: StableHLO + calling convention; deserializable
+  on any future jax, recompiled for whatever backend loads it — the
+  role of tfcompile's .o file),
+- ``manifest.json``     — feeds/fetches (names, dtypes, shapes), the
+  cache key, and versions (the role of the generated header),
+- ``saved_model/``      — the same subgraph as a servable SavedModel, so
+  the C runtime's ``StfSessionLoad(prog_dir + "/saved_model")`` can
+  serve it directly.
+
+Load from Python with :func:`load`: returns a callable running the
+deserialized program.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+
+def aot_compile(graph_json: str, feed_names: List[str],
+                fetch_names: List[str], out_dir: str) -> dict:
+    """Compile and write the artifact; returns the manifest dict."""
+    import jax
+    from jax import export as jax_export
+
+    import simple_tensorflow_tpu as stf
+    from ..compiler import aot as aot_lib
+    from ..framework import graph as ops_mod
+    from ..framework import graph_io
+    from ..framework import lowering as lowering_mod
+
+    g = ops_mod.Graph()
+    with g.as_default():
+        graph_io.import_graph_def(graph_json, name="")
+
+        def _tensor(name):
+            return g.as_graph_element(
+                name if ":" in name else name + ":0",
+                allow_tensor=True, allow_operation=False)
+
+        feeds = [_tensor(n) for n in feed_names]
+        fetches = [_tensor(n) for n in fetch_names]
+
+        exe = aot_lib.compile_fetches(fetches, feeds, graph=g)
+
+        # portable serialized program (the tfcompile .o role)
+        fed_set = set(feeds)
+        pruned = lowering_mod.prune([t.op for t in fetches], fed_set)
+
+        def fn(*feed_values):
+            ctx = lowering_mod.LoweringContext(state={}, rng_root=None)
+            for t, v in zip(feeds, feed_values):
+                ctx.env[t] = v
+            lowering_mod.execute_ops(ctx, pruned, fed=fed_set)
+            return tuple(ctx.env[t] for t in fetches)
+
+        args = [jax.ShapeDtypeStruct(tuple(t.shape.as_list()),
+                                     t.dtype.as_numpy_dtype)
+                for t in feeds]
+        exported = jax_export.export(jax.jit(fn))(*args)
+        blob = exported.serialize()
+
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "program.stablehlo"), "wb") as f:
+            f.write(blob)
+
+        manifest = {
+            "format": "stf-aot-v1",
+            "cache_key": exe.cache_key,
+            "feeds": [{"name": t.name,
+                       "dtype": t.dtype.base_dtype.name,
+                       "shape": t.shape.as_list()} for t in feeds],
+            "fetches": [{"name": t.name,
+                         "dtype": t.dtype.base_dtype.name,
+                         "shape": t.shape.as_list()} for t in fetches],
+            "jax_version": jax.__version__,
+            "cost_analysis": {k: v for k, v in exe.cost_analysis().items()
+                              if isinstance(v, (int, float))},
+        }
+        with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+
+        # servable twin for the C runtime (StfSessionLoad)
+        from .. import saved_model as sm
+
+        sess = stf.Session(graph=g)
+        sm.simple_save(
+            sess, os.path.join(out_dir, "saved_model"),
+            inputs={t.name.split(":")[0]: t for t in feeds},
+            outputs={t.name.split(":")[0]: t for t in fetches})
+    return manifest
+
+
+def load(prog_dir: str):
+    """Deserialize ``prog_dir`` into a callable (feeds in manifest
+    order). The program recompiles for the local backend on first call;
+    the persistent jax cache makes that a disk hit."""
+    from jax import export as jax_export
+
+    with open(os.path.join(prog_dir, "program.stablehlo"), "rb") as f:
+        blob = f.read()
+    with open(os.path.join(prog_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    rt = jax_export.deserialize(bytearray(blob))
+
+    def call(*feed_values):
+        return rt.call(*feed_values)
+
+    call.manifest = manifest
+    return call
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="AOT-compile a frozen GraphDef-JSON into a "
+                    "self-contained executable artifact (tfcompile role)")
+    ap.add_argument("--graph", required=True,
+                    help="GraphDef-JSON file (stf.write_graph output; "
+                    "freeze variables first with tools.freeze_graph)")
+    ap.add_argument("--feed", action="append", default=[],
+                    help="feed tensor name (repeatable)")
+    ap.add_argument("--fetch", action="append", required=True,
+                    help="fetch tensor name (repeatable)")
+    ap.add_argument("--out", required=True, help="output artifact dir")
+    args = ap.parse_args(argv)
+
+    with open(args.graph) as f:
+        graph_json = f.read()
+    manifest = aot_compile(graph_json, args.feed, args.fetch, args.out)
+    json.dump({"out": args.out, "cache_key": manifest["cache_key"],
+               "n_feeds": len(manifest["feeds"]),
+               "n_fetches": len(manifest["fetches"])}, sys.stdout)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Layout optimization pass (VERDICT r4 item 6; ref:
+core/grappler/optimizers/layout_optimizer.cc).
+
+An NCHW graph previously paid a transpose around EVERY conv/pool/bn at
+lowering; the pass converts the ops to NHWC once and cancels interior
+transpose pairs, leaving exactly the two boundary conversions."""
+
+import json
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu.framework import graph_io, optimizer
+
+
+def _build_nchw_block():
+    """conv-bn-relu-conv-bn + identity shortcut + relu, all NCHW."""
+    n, c, hw = 2, 8, 8
+    x = stf.placeholder(stf.float32, [n, c, hw, hw], name="x")
+    rng = np.random.RandomState(0)
+    w1 = stf.constant(rng.randn(3, 3, c, c).astype(np.float32) * 0.2,
+                      name="w1")
+    w2 = stf.constant(rng.randn(3, 3, c, c).astype(np.float32) * 0.2,
+                      name="w2")
+    scale = stf.constant(np.ones(c, np.float32), name="scale")
+    offset = stf.constant(np.zeros(c, np.float32), name="offset")
+
+    h = stf.nn.conv2d(x, w1, strides=[1, 1, 1, 1], padding="SAME",
+                      data_format="NCHW", name="conv1")
+    h, _, _ = stf.nn.fused_batch_norm(h, scale, offset,
+                                      data_format="NCHW", name="bn1")
+    h = stf.nn.relu(h, name="relu1")
+    h = stf.nn.conv2d(h, w2, strides=[1, 1, 1, 1], padding="SAME",
+                      data_format="NCHW", name="conv2")
+    h, _, _ = stf.nn.fused_batch_norm(h, scale, offset,
+                                      data_format="NCHW", name="bn2")
+    h = stf.add(h, x, name="residual")
+    out = stf.nn.relu(h, name="block_out")
+    return x, out, (n, c, hw)
+
+
+def test_nchw_resnet_block_two_transposes():
+    stf.reset_default_graph()
+    x, out, (n, c, hw) = _build_nchw_block()
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+
+    opt = optimizer.optimize(gd, keep=[out.name])
+
+    n_transpose = sum(1 for node in opt["node"]
+                      if node["op"] == "Transpose")
+    assert n_transpose == 2, (
+        f"expected exactly 2 boundary transposes, got {n_transpose}: "
+        f"{[nd['name'] for nd in opt['node'] if nd['op'] == 'Transpose']}")
+    # every image op converted
+    for node in opt["node"]:
+        fmt = node.get("attr", {}).get("data_format")
+        if fmt is not None:
+            assert fmt == "NHWC", (node["name"], fmt)
+
+
+def test_nchw_layout_rewrite_is_numerically_identical():
+    stf.reset_default_graph()
+    x, out, (n, c, hw) = _build_nchw_block()
+    xv = np.random.RandomState(1).randn(n, c, hw, hw).astype(np.float32)
+    sess = stf.Session()
+    expected = sess.run(out, {x: xv})
+
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    opt = optimizer.optimize(gd, keep=[out.name, x.name])
+
+    stf.reset_default_graph()
+    graph_io.import_graph_def(json.dumps(opt), name="")
+    g = stf.get_default_graph()
+    x2 = g.as_graph_element("x:0", allow_tensor=True,
+                            allow_operation=False)
+    out2 = g.as_graph_element(out.name, allow_tensor=True,
+                              allow_operation=False)
+    got = stf.Session().run(out2, {x2: xv})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_nchw_pool_converts():
+    stf.reset_default_graph()
+    x = stf.placeholder(stf.float32, [2, 4, 8, 8], name="xp")
+    p = stf.nn.max_pool(x, ksize=[1, 1, 2, 2], strides=[1, 1, 2, 2],
+                        padding="VALID", data_format="NCHW", name="pool")
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    opt = optimizer.layout_optimization(gd, keep=[p.name, x.name])
+    # name swap: "pool" is now the boundary transpose, the converted op
+    # is "pool/nhwc" — by-name fetches still return NCHW data
+    shim = next(nd for nd in opt["node"] if nd["name"] == "pool")
+    assert shim["op"] == "Transpose"
+    pool = next(nd for nd in opt["node"] if nd["name"] == "pool/nhwc")
+    assert pool["attr"]["data_format"] == "NHWC"
+    from simple_tensorflow_tpu.framework.graph_io import _decode_attr
+    assert tuple(_decode_attr(pool["attr"]["ksize"])) == (1, 2, 2, 1)
+    assert tuple(_decode_attr(pool["attr"]["strides"])) == (1, 2, 2, 1)
+    # numerics
+    xv = np.random.RandomState(2).randn(2, 4, 8, 8).astype(np.float32)
+    stf.reset_default_graph()
+    x1 = stf.placeholder(stf.float32, [2, 4, 8, 8], name="xo")
+    p1 = stf.nn.max_pool(x1, ksize=[1, 1, 2, 2], strides=[1, 1, 2, 2],
+                         padding="VALID", data_format="NCHW")
+    expected = stf.Session().run(p1, {x1: xv})
+    stf.reset_default_graph()
+    graph_io.import_graph_def(json.dumps(opt), name="")
+    g = stf.get_default_graph()
+    got = stf.Session().run(
+        g.as_graph_element(p.name, True, False),
+        {g.as_graph_element("xp:0", True, False): xv})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected))
+
+
+def test_nhwc_graph_untouched():
+    stf.reset_default_graph()
+    x = stf.placeholder(stf.float32, [2, 8, 8, 4], name="xn")
+    w = stf.constant(np.ones((3, 3, 4, 4), np.float32), name="wn")
+    y = stf.nn.conv2d(x, w, strides=[1, 1, 1, 1], padding="SAME",
+                      name="convn")
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    opt = optimizer.layout_optimization(gd, keep=[y.name, x.name])
+    assert not any(nd["op"] == "Transpose" for nd in opt["node"])
+    assert len(opt["node"]) == len(gd["node"])
