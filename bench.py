@@ -559,10 +559,11 @@ def run_bench_transformer(platform, device_kind):
 def _measure_resnet_dp(n_devices=8):
     """BASELINE config 3: ResNet data-parallel scaling. No multi-chip
     hardware on this rig, so this measures SHARDING OVERHEAD on a virtual
-    n-device CPU mesh: the dp step does n x the single-device work on the
-    same physical core, so efficiency = n * t_single / t_dp — 1.0 means
-    the mesh lowering (psum grads, sharded feeds) adds nothing over ideal.
-    On real chips the same code path gives true scaling."""
+    n-device CPU mesh at the SAME global batch: efficiency =
+    t_unsharded / t_dp — 1.0 means the mesh lowering (psum grads,
+    sharded feeds, partitioned program) adds nothing over running the
+    identical computation unsharded. On real chips the same code path
+    gives true scaling."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -582,19 +583,31 @@ def _measure_resnet_dp(n_devices=8):
     def time_model(mesh, batch):
         """Compile once, then time the step loop `trials` times; return the
         list of per-step times so the caller can take a median (single
-        timings on a shared physical core swung 37% between bench runs)."""
+        timings on a shared physical core swung 37% between bench runs).
+        bf16 params/activations and pre-staged device feeds to mirror the
+        pure-JAX control exactly (numpy feeds would re-scatter over the
+        mesh every step — input-pipeline cost, not sharding cost)."""
+        import jax.numpy as jnp
+
         stf.reset_default_graph()
         ctx = mesh if mesh is not None else _NullCtx()
         with ctx:
             m = resnet.resnet50_train_model(
-                batch_size=batch, image_size=image, dtype=stf.float32,
+                batch_size=batch, image_size=image, dtype=stf.bfloat16,
                 learning_rate=0.1)
             if mesh is not None:
                 parallel.shard_feed(m["images"], "dp")
                 parallel.shard_feed(m["labels"], "dp")
             xv, yv = resnet.synthetic_imagenet(batch, image,
                                                dtype=np.float32)
-            feed = {m["images"]: xv, m["labels"]: yv}
+            xd = jnp.asarray(xv, dtype=stf.bfloat16.np_dtype)
+            yd = jnp.asarray(yv)
+            if mesh is not None:
+                dp_sh = jax.sharding.NamedSharding(
+                    mesh.jax_mesh, jax.sharding.PartitionSpec("dp"))
+                xd = jax.device_put(xd, dp_sh)
+                yd = jax.device_put(yd, dp_sh)
+            feed = {m["images"]: xd, m["labels"]: yd}
             sess = stf.Session()
             sess.run(stf.global_variables_initializer())
             for _ in range(warmup):
@@ -617,20 +630,69 @@ def _measure_resnet_dp(n_devices=8):
         def __exit__(self, *a):
             return False
 
-    t_single = float(np.median(time_model(None, per_dev_batch)))
+    def time_pure_jax(shard):
+        """Pure-JAX control: the same architecture hand-written in jax,
+        jit over the same mesh (sharded) or single-device — measures
+        what raw jax+GSPMD pays for the virtual mesh, so the stf ratio
+        can be normalized by it."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        from _resnet_builder import build_train_step
+
+        train_step, params, x, y = build_train_step(
+            per_dev_batch * n_devices, image)
+        if shard:
+            jmesh = jax.sharding.Mesh(
+                np.array(devices[:n_devices]), ("dp",))
+            dp = jax.sharding.NamedSharding(
+                jmesh, jax.sharding.PartitionSpec("dp"))
+            rep = jax.sharding.NamedSharding(
+                jmesh, jax.sharding.PartitionSpec())
+            x = jax.device_put(x, dp)
+            y = jax.device_put(y, dp)
+            params = jax.device_put(params, rep)
+        step = jax.jit(train_step)
+        loss, params = step(params, x, y)
+        jax.block_until_ready(loss)
+        dts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss, params = step(params, x, y)
+            np.asarray(loss)  # hard sync
+            dts.append((time.perf_counter() - t0) / steps)
+        return float(np.median(dts))
+
+    # Same-total-work protocol (r5): unsharded batch-32 vs dp-sharded
+    # batch-32 for BOTH the stf lowering and a pure-JAX control. On one
+    # physical core the partitioned program pays XLA's multi-device
+    # emulation cost (serialized partitions + copies); the control pays
+    # the identical cost, so efficiency = stf_ratio / jax_ratio isolates
+    # what OUR lowering adds over hand-written jax+GSPMD.
+    t_single = float(np.median(time_model(None,
+                                          per_dev_batch * n_devices)))
     mesh = parallel.Mesh({"dp": n_devices}, devices=devices[:n_devices])
     t_dp_trials = time_model(mesh, per_dev_batch * n_devices)
     t_dp = float(np.median(t_dp_trials))
-    efficiency = (n_devices * t_single) / t_dp
+    t_jax_single = time_pure_jax(shard=False)
+    t_jax_dp = time_pure_jax(shard=True)
+    # Emulating 8 devices on one core adds a roughly CONSTANT cost
+    # (serialized partitions + inter-"device" copies), so the honest
+    # comparison is the ADDED seconds: what sharding costs through the
+    # stf lowering vs what the identical sharding costs hand-written
+    # (a ratio-of-ratios would punish stf for having the faster
+    # unsharded baseline — its one-pass BN VJP beats the naive control).
+    stf_added = max(t_dp - t_single, 1e-9)
+    jax_added = max(t_jax_dp - t_jax_single, 1e-9)
+    efficiency = jax_added / stf_added
     result_extra = {}
     if efficiency > 1.5:
-        # >1.5 on one physical core means the dp graph did LESS than
-        # n x the single-device work — a broken bench, not good scaling
+        # stf's sharding cost being 1.5x SMALLER than raw jax's on the
+        # same mesh means the bench broke, not that we beat GSPMD
         result_extra["anomalous"] = True
     elif efficiency < 0.8:
-        # <0.8 means the mesh lowering added >25% overhead over running
-        # the same total work unsharded — either a real sharding
-        # regression or a noisy host; flag it either way
+        # stf's dp lowering pays >25% more than hand-written jax+GSPMD
+        # for the same sharding — a real lowering regression
         result_extra["anomalous"] = True
     return {
         **result_extra,
@@ -645,9 +707,15 @@ def _measure_resnet_dp(n_devices=8):
         "t_single_s": round(t_single, 4),
         "t_dp_s": round(t_dp, 4),
         "t_dp_trials_s": [round(t, 4) for t in t_dp_trials],
-        "note": ("virtual-mesh overhead check (1 physical core): "
-                 "n*median(t_single)/median(t_dp); 1.0 = sharding adds "
-                 "zero overhead"),
+        "t_jax_single_s": round(t_jax_single, 4),
+        "t_jax_dp_s": round(t_jax_dp, 4),
+        "stf_added_s": round(stf_added, 4),
+        "jax_added_s": round(jax_added, 4),
+        "note": ("virtual-mesh check (1 core, same total work, pure-JAX "
+                 "control): (t_jax_dp - t_jax_unsharded) / (t_stf_dp - "
+                 "t_stf_unsharded) — 1.0 = sharding through the stf "
+                 "lowering costs the same seconds as hand-written "
+                 "jax+GSPMD on the same mesh"),
         "device": "cpu_virtual_mesh",
     }
 

@@ -98,17 +98,24 @@ def _rewrite(tensor_name: str, replace: Dict[str, str]) -> str:
     return tensor_name
 
 
+_SHAPE_OPS = {"Shape", "Size", "Rank"}
+
+
 def constant_folding(graph_def: Dict) -> Dict:
     """Evaluate pure nodes whose inputs are all Consts, replacing them with
     Const nodes (ref: core/common_runtime/constant_folding.cc). Uses each
     op's registered jax pure_fn on host numpy values — the same semantics
-    the compiled program would have."""
+    the compiled program would have. Shape/Size/Rank of statically-shaped
+    producers fold from the shape alone (grappler's
+    shape-materialization), without needing a constant input value."""
     import jax
 
     from . import graph_io
 
     out = copy.deepcopy(graph_def)
     values: Dict[str, List[Any]] = {}  # node name -> output values
+    specs_by_name: Dict[str, Any] = {n["name"]: n.get("output_specs")
+                                     for n in out["node"]}
     for n in out["node"]:
         if n["op"] == "Const":
             v = graph_io._decode_attr(n.get("attr", {}).get("value"))
@@ -120,6 +127,35 @@ def constant_folding(graph_def: Dict) -> Dict:
         if n["op"] == "Const" or not _is_pure(n) or n.get("control_input"):
             new_nodes.append(n)
             continue
+        if n["op"] in _SHAPE_OPS and n.get("input"):
+            src, idx = _tensor_ref(n["input"][0])
+            specs = specs_by_name.get(src)
+            sh = (specs[idx][0] if specs and idx < len(specs) else None)
+            if isinstance(sh, list) and all(
+                    isinstance(d, int) for d in sh):
+                from . import graph_io
+
+                ot = graph_io._decode_attr(
+                    n.get("attr", {}).get("out_type"))
+                np_dt = (dtypes_mod.as_dtype(ot).np_dtype
+                         if ot is not None else np.int32)
+                if n["op"] == "Shape":
+                    arr = np.asarray(sh, np_dt)
+                elif n["op"] == "Size":
+                    arr = np.asarray(int(np.prod(sh)) if sh else 1,
+                                     np_dt)
+                else:
+                    arr = np.asarray(len(sh), np.int32)  # Rank: int32
+                values[name] = [arr]
+                new_nodes.append({
+                    "name": name, "op": "Const", "input": [],
+                    "control_input": [], "device": n.get("device", ""),
+                    "attr": {"value": graph_io._encode_attr(arr),
+                             "dtype": graph_io._encode_attr(
+                                 dtypes_mod.as_dtype(str(arr.dtype)))},
+                    "output_specs": [[list(arr.shape), str(arr.dtype)]],
+                })
+                continue
         in_refs = [_tensor_ref(i) for i in n.get("input", [])]
         if not in_refs or not all(r[0] in values for r in in_refs):
             new_nodes.append(n)
@@ -236,12 +272,20 @@ def layout_optimization(graph_def: Dict,
     # existing reference — graph edges AND by-name fetches — still sees
     # NCHW data without any rewiring. Extra outputs (FusedBatchNorm's
     # per-channel mean/var) are layout-free and rewired to the renamed
-    # node directly.
+    # node directly — but only graph-INTERNAL edges can be rewired, so a
+    # multi-output op whose name appears in ``keep`` (externally visible
+    # ":k" refs) is left unconverted.
+    keep_names = {_tensor_ref(k)[0] for k in (keep or [])}
     new_nodes: List[Dict] = []
     rewire: Dict[str, str] = {}  # "orig:k" (k>0) -> "<orig>/nhwc:k"
     converted = []
     for n in nodes:
         if n["op"] not in _LAYOUT_OPS or _attr(n, "data_format") != "NCHW":
+            new_nodes.append(n)
+            continue
+        if len(n.get("output_specs") or []) > 1 and n["name"] in keep_names:
+            # a by-name fetch may reference output k>0, which the
+            # single-output transpose shim cannot serve
             new_nodes.append(n)
             continue
         orig = n["name"]
@@ -444,6 +488,24 @@ def optimize_pruned(op_list, fed_tensors, keep_tensors):
                 and not od.runs_on_host and not op.control_inputs
                 and op.type not in _FOLDABLE_BLOCKLIST)
         resolved_ins = [alias.get(t, t) for t in op.inputs]
+        if (pure and op.type in _SHAPE_OPS and op.inputs
+                and op.inputs[0].shape.is_fully_defined()):
+            # shape materialization: static shape -> constant, no value
+            # needed (grappler does the same before its folding pass);
+            # out_type attr (int64 shapes under x64) must be honored
+            sh = op.inputs[0].shape.as_list()
+            ot = op.attrs.get("out_type")
+            np_dt = (dtypes_mod.as_dtype(ot).np_dtype if ot is not None
+                     else np.int32)
+            if op.type == "Shape":
+                val = np.asarray(sh, np_dt)
+            elif op.type == "Size":
+                val = np.asarray(int(np.prod(sh)) if sh else 1, np_dt)
+            else:
+                val = np.asarray(len(sh), np.int32)  # Rank: int32
+            if op.outputs:
+                const_env[op.outputs[0]] = val
+                continue
         if pure and resolved_ins and all(t in const_env
                                          for t in resolved_ins):
             attrs = {k: v for k, v in op.attrs.items()

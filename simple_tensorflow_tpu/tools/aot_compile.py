@@ -36,11 +36,12 @@ from typing import List
 def aot_compile(graph_json: str, feed_names: List[str],
                 fetch_names: List[str], out_dir: str) -> dict:
     """Compile and write the artifact; returns the manifest dict."""
+    import hashlib
+
     import jax
     from jax import export as jax_export
 
     import simple_tensorflow_tpu as stf
-    from ..compiler import aot as aot_lib
     from ..framework import graph as ops_mod
     from ..framework import graph_io
     from ..framework import lowering as lowering_mod
@@ -57,11 +58,24 @@ def aot_compile(graph_json: str, feed_names: List[str],
         feeds = [_tensor(n) for n in feed_names]
         fetches = [_tensor(n) for n in fetch_names]
 
-        exe = aot_lib.compile_fetches(fetches, feeds, graph=g)
-
-        # portable serialized program (the tfcompile .o role)
+        # validate purity + static shapes (tfcompile's frozen-graph
+        # contract) — on the pruned slice directly, so the whole CLI
+        # does ONE trace/lower and ZERO backend compiles (the export
+        # artifact recompiles wherever it is loaded)
         fed_set = set(feeds)
         pruned = lowering_mod.prune([t.op for t in fetches], fed_set)
+        for op in pruned:
+            if op.op_def.is_stateful and op.type not in ("Placeholder",):
+                raise ValueError(
+                    f"AOT subgraph contains stateful op {op.name} "
+                    f"({op.type}); AOT programs must be pure — freeze "
+                    "variables first (ref tfcompile freezes the graph)")
+        for t in feeds:
+            if t.shape.rank is None or \
+                    any(d is None for d in t.shape.as_list()):
+                raise ValueError(
+                    f"AOT feed {t.name} has unknown shape {t.shape}; "
+                    "XLA AOT needs fully static shapes")
 
         def fn(*feed_values):
             ctx = lowering_mod.LoweringContext(state={}, rng_root=None)
@@ -73,6 +87,10 @@ def aot_compile(graph_json: str, feed_names: List[str],
         args = [jax.ShapeDtypeStruct(tuple(t.shape.as_list()),
                                      t.dtype.as_numpy_dtype)
                 for t in feeds]
+        lowered = jax.jit(fn).lower(*args)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
         exported = jax_export.export(jax.jit(fn))(*args)
         blob = exported.serialize()
 
@@ -82,7 +100,7 @@ def aot_compile(graph_json: str, feed_names: List[str],
 
         manifest = {
             "format": "stf-aot-v1",
-            "cache_key": exe.cache_key,
+            "cache_key": hashlib.sha256(bytes(blob)).hexdigest()[:16],
             "feeds": [{"name": t.name,
                        "dtype": t.dtype.base_dtype.name,
                        "shape": t.shape.as_list()} for t in feeds],
@@ -90,7 +108,7 @@ def aot_compile(graph_json: str, feed_names: List[str],
                          "dtype": t.dtype.base_dtype.name,
                          "shape": t.shape.as_list()} for t in fetches],
             "jax_version": jax.__version__,
-            "cost_analysis": {k: v for k, v in exe.cost_analysis().items()
+            "cost_analysis": {k: v for k, v in (ca or {}).items()
                               if isinstance(v, (int, float))},
         }
         with open(os.path.join(out_dir, "manifest.json"), "w") as f:
