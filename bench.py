@@ -794,8 +794,9 @@ def _run_model(model, platform, kind, errors):
                             ).strip()
         env["BENCH_PLATFORM"] = "cpu|"
         env["BENCH_MODEL"] = model
+        # the pure-JAX control (r5) adds two timed configs to this child
         result, err = _spawn_child(
-            env, int(os.environ.get("BENCH_DP_TIMEOUT", "900")))
+            env, int(os.environ.get("BENCH_DP_TIMEOUT", "1800")))
         if result is not None:
             return result
         fallback["error"] = f"resnet_dp_run_failed: {err}"

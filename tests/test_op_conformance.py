@@ -554,19 +554,22 @@ CASES.update({
     "MatrixBandPart": [Case([_sq33], lambda x: np.triu(np.tril(x, 1),
                                                        -1),
                             attrs={"num_lower": 1, "num_upper": 1})],
-    "Cholesky": [Case([_psd(4, 40)], np.linalg.cholesky, tol=1e-3)],
+    "Cholesky": [Case([_psd(4, 40)], np.linalg.cholesky, tol=1e-3,
+                      grad=True, grad_tol=5e-2)],
     "MatrixDeterminant": [Case([_psd(3, 41)], np.linalg.det,
-                               tol=1e-2)],
+                               tol=1e-2, grad=True, grad_tol=5e-2)],
     "LogMatrixDeterminant": [Case(
         [_psd(3, 42)],
         lambda x: (np.float32(np.linalg.slogdet(x)[0]),
                    np.float32(np.linalg.slogdet(x)[1])), tol=1e-3)],
-    "MatrixInverse": [Case([_psd(3, 43)], np.linalg.inv, tol=1e-3)],
+    "MatrixInverse": [Case([_psd(3, 43)], np.linalg.inv, tol=1e-3,
+                           grad=True, grad_tol=5e-2)],
     "MatrixSolve": [Case([_psd(3, 44),
                           _rng(45).randn(3, 2).astype(np.float32)],
-                         np.linalg.solve, tol=1e-3)],
+                         np.linalg.solve, tol=1e-3, grad=True,
+                         grad_tol=5e-2)],
     "MatrixExponential": [Case([_sq33 * 0.3], sp_linalg.expm,
-                               tol=1e-3)],
+                               tol=1e-3, grad=True, grad_tol=5e-2)],
     "SelfAdjointEigV2": [Case(
         [_psd(3, 46)],
         lambda x: (np.linalg.eigvalsh(x),),  # eigenvalues only: vectors
@@ -889,7 +892,8 @@ CASES.update({
     "CholeskySolve": [Case(
         [np.linalg.cholesky(_psd(3, 71)).astype(np.float32),
          _rng(72).randn(3, 2).astype(np.float32)],
-        lambda l, rhs: np.linalg.solve(l @ l.T, rhs), tol=1e-3)],
+        lambda l, rhs: np.linalg.solve(l @ l.T, rhs), tol=1e-3,
+        grad=True, grad_tol=5e-2)],
     "ConvertImageDtype": [Case(
         [np.array([[0, 128, 255]], np.uint8)],
         lambda x: (x / 255.0).astype(np.float32),
