@@ -1453,6 +1453,75 @@ MISC_TESTS: Dict[str, Callable[[], None]] = {
 }
 
 
+# ---- round-5 upgrade: independent oracles for image ops that were
+# previously pointer-covered only ------------------------------------------
+
+import colorsys  # noqa: E402  (image-op oracles)
+
+
+def _colorsys_map(img, fn):
+    out = np.zeros_like(img)
+    flat_in = img.reshape(-1, 3)
+    flat_out = out.reshape(-1, 3)
+    for i in range(flat_in.shape[0]):
+        flat_out[i] = fn(*flat_in[i])
+    return out
+
+
+_img443 = _rng(95).rand(2, 4, 4, 3).astype(np.float32)
+
+CASES.update({
+    "AdjustBrightness": [Case([_img443],
+                              lambda x: x + np.float32(0.3),
+                              attrs={"delta": 0.3}, grad=True)],
+    "AdjustContrast": [Case(
+        [_img443],
+        lambda x: (x - x.mean(axis=(1, 2), keepdims=True)) * 1.7
+        + x.mean(axis=(1, 2), keepdims=True),
+        attrs={"contrast_factor": 1.7}, tol=1e-4, grad=True)],
+    "FlipLeftRight": [Case([_img443], lambda x: x[:, :, ::-1, :],
+                           grad=True)],
+    "FlipUpDown": [Case([_img443], lambda x: x[:, ::-1, :, :],
+                        grad=True)],
+    "CentralCrop": [Case([np.arange(2 * 8 * 8 * 1, dtype=np.float32)
+                          .reshape(2, 8, 8, 1) / 100.0],
+                         lambda x: x[:, 2:6, 2:6, :],
+                         attrs={"fraction": 0.5}, grad=True)],
+    "CropToBoundingBox": [Case(
+        # scaled down: f32 central differences at |x|~70 lose the 2%
+        # gradient tolerance to rounding
+        [np.arange(2 * 6 * 6 * 1, dtype=np.float32).reshape(2, 6, 6, 1)
+         / 100.0],
+        lambda x: x[:, 1:4, 2:6, :],
+        attrs={"offset_height": 1, "offset_width": 2,
+               "target_height": 3, "target_width": 4}, grad=True)],
+    "ResizeNearestNeighbor": [Case(
+        [np.arange(1 * 2 * 2 * 1, dtype=np.float32).reshape(1, 2, 2, 1)],
+        lambda x: x.repeat(2, axis=1).repeat(2, axis=2),
+        attrs={"size": (4, 4)})],
+    "PerImageStandardization": [Case(
+        [_img443],
+        lambda x: (x - x.mean(axis=(1, 2, 3), keepdims=True))
+        / np.maximum(x.std(axis=(1, 2, 3), keepdims=True),
+                     1.0 / np.sqrt(np.float32(x[0].size))),
+        tol=1e-4, grad=True, grad_tol=5e-2)],
+    "RGBToHSV": [Case(
+        [_img443], lambda x: _colorsys_map(x, colorsys.rgb_to_hsv),
+        tol=1e-4)],
+    "HSVToRGB": [Case(
+        # rand() is already in [0, 1); cap H below 1.0 (wrap point)
+        [np.stack([np.minimum(_img443[..., 0], 0.99),
+                   _img443[..., 1], _img443[..., 2]], axis=-1)],
+        lambda x: _colorsys_map(x, colorsys.hsv_to_rgb), tol=1e-4)],
+})
+# these were pointer-covered; the direct oracle supersedes the pointer
+for _op in ("AdjustBrightness", "AdjustContrast", "FlipLeftRight",
+            "FlipUpDown", "CentralCrop", "CropToBoundingBox",
+            "ResizeNearestNeighbor", "PerImageStandardization",
+            "RGBToHSV", "HSVToRGB"):
+    COVERED_ELSEWHERE.pop(_op, None)
+
+
 # ---------------------------------------------------------------------------
 # generated tests + the enumeration guard
 # ---------------------------------------------------------------------------
