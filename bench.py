@@ -26,17 +26,26 @@ import traceback
 import numpy as np
 
 _PROBE_SRC = (
-    "import jax; d = jax.devices()[0]; "
+    # Enumeration is not health: the relayed TPU can list devices while
+    # every execution hangs (observed rounds 3-5). The probe must EXECUTE
+    # on the chip and read the result back before declaring it usable.
+    "import jax, jax.numpy as jnp, numpy as np; d = jax.devices()[0]; "
+    "x = jnp.full((128, 128), 2.0, jnp.bfloat16); "
+    "assert float(np.asarray((x @ x))[0, 0]) == 512.0; "
     "print(d.platform + '|' + getattr(d, 'device_kind', ''))"
 )
 
 
 def probe_backend(timeout_s=180, retries=1):
-    """Probe which jax backend initializes, in a subprocess.
+    """Probe which jax backend initializes AND executes, in a subprocess.
 
     Returns (platform, device_kind). A wedged TPU plugin can hang for >10
     minutes (observed round 1, driver rc=124), so an in-process try/except
-    is not enough — the probe must be killable.
+    is not enough — the probe must be killable. The probe runs a real
+    matmul and syncs via host transfer (block_until_ready can return
+    early under the axon relay): a chip that enumerates but cannot
+    execute fails the probe and the bench falls back to CPU in bounded
+    time instead of hanging each model child to its timeout.
     """
     for attempt in range(retries + 1):
         try:

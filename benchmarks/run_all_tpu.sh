@@ -6,18 +6,32 @@ set -u
 cd "$(dirname "$0")/.."
 mkdir -p artifacts
 
+# Enumeration is not health: the relayed chip can list devices while all
+# execution hangs (rounds 3-5). bench.probe_backend is the single source
+# of truth for the execute-and-read-back health check; reuse it here so
+# the battery and bench.py can never disagree about chip usability.
 probe() {
-  timeout 60 python -c "import jax; assert jax.devices()[0].platform=='tpu'" \
-    2>/dev/null
+  timeout 200 python -c "
+import bench, sys
+sys.exit(0 if bench.probe_backend(timeout_s=120, retries=0)[0] == 'tpu'
+         else 1)" 2>/dev/null
 }
 
 if ! probe; then
-  echo "TPU not healthy; aborting" >&2
+  echo "TPU not healthy (execution probe failed); aborting" >&2
   exit 1
 fi
 
 echo "== bench.py (headline metrics) =="
-timeout 1800 python bench.py 2>/dev/null | tee artifacts/bench_latest.jsonl
+# bench.py is self-bounding (subprocess probe + per-model child timeouts,
+# including a second CPU-fallback child per model if the TPU child times
+# out). Worst case: ~360s probe + (2400+1500+300+1200)*2 TPU+fallback +
+# 1800 dp8 ~= 13k s. The wrapper is defense-in-depth ABOVE that, not the
+# budget — a tight wrapper would SIGTERM the parent mid-child and orphan
+# the TPU lease.
+timeout 14000 python bench.py 2>/dev/null | tee artifacts/bench_latest.jsonl
+
+probe || { echo "chip wedged after bench.py; stopping battery" >&2; exit 1; }
 
 echo "== pallas microbench: per-family =="
 timeout 900 python benchmarks/pallas_bench.py --iters 10 --kernels flash \
@@ -33,11 +47,15 @@ timeout 600 python benchmarks/pallas_bench.py --iters 10 --kernels xent \
 timeout 600 python benchmarks/pallas_bench.py --iters 10 --kernels quant \
   --out artifacts/pb_quant.json 2>/dev/null | grep '^{'
 
+probe || { echo "chip wedged after microbench; stopping battery" >&2; exit 1; }
+
 echo "== block-size tunes =="
 timeout 900 python benchmarks/pallas_bench.py --tune flash --iters 10 \
   2>/dev/null | tee artifacts/tune_flash.jsonl | grep '^{'
 timeout 900 python benchmarks/pallas_bench.py --tune xent --iters 10 \
   2>/dev/null | tee artifacts/tune_xent.jsonl | grep '^{'
+
+probe || { echo "chip wedged after tunes; stopping battery" >&2; exit 1; }
 
 echo "== step profiles =="
 timeout 900 python benchmarks/profile_resnet.py --skip-pure \

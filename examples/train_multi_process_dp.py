@@ -22,6 +22,8 @@ import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
@@ -89,10 +91,28 @@ def main() -> int:
         [sys.executable, os.path.abspath(__file__), "--worker", str(i),
          cluster], stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, env=env) for i in range(2)]
+    # drain every worker's pipes CONCURRENTLY: waiting on worker 0 while
+    # worker 1's stderr fills its pipe buffer would block worker 1 inside
+    # write() mid-collective and deadlock the SPMD step until the timeout
+    outs = [None] * len(procs)
+
+    def _drain(i):
+        outs[i] = procs[i].communicate()
+
+    drains = [threading.Thread(target=_drain, args=(i,), daemon=True)
+              for i in range(len(procs))]
     results = []
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=300)
+        for d in drains:
+            d.start()
+        deadline = time.perf_counter() + 300.0
+        for d in drains:
+            d.join(timeout=max(1.0, deadline - time.perf_counter()))
+        for i, p in enumerate(procs):
+            if outs[i] is None:  # still running at the deadline
+                print(f"worker {i} timed out", file=sys.stderr)
+                return 1
+            out, err = outs[i]
             if p.returncode != 0:
                 print(err[-2000:], file=sys.stderr)
                 return 1
@@ -100,7 +120,7 @@ def main() -> int:
                 [line for line in out.splitlines()
                  if line.startswith("{")][-1]))
     finally:
-        for p in procs:  # a dead/late sibling must not linger
+        for p in procs:  # a dead/late/hung sibling must not linger
             if p.poll() is None:
                 p.kill()
     assert all(r["n_devices"] == 2 for r in results), results
