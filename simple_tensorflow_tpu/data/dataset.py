@@ -141,19 +141,26 @@ class Dataset:
 
     def batch(self, batch_size, drop_remainder=True):
         """drop_remainder defaults True: XLA needs static batch shapes."""
-        src = self._factory
+        return Dataset(_batched(self._factory, batch_size, drop_remainder,
+                                _stack_batch))
 
-        def gen():
-            buf = []
-            for x in src():
-                buf.append(x)
-                if len(buf) == batch_size:
-                    yield _stack_batch(buf)
-                    buf = []
-            if buf and not drop_remainder:
-                yield _stack_batch(buf)
+    def padded_batch(self, batch_size, padded_shapes=None,
+                     padding_values=None, drop_remainder=True):
+        """Batch variable-length elements, padding each component to a
+        common shape (ref: the ``dynamic_pad=True`` mode of
+        ``python/training/input.py batch`` — same contract, pipeline
+        form).
 
-        return Dataset(gen)
+        ``padded_shapes`` mirrors the element structure; dims that are
+        None/-1 pad to the longest element IN THAT BATCH. On TPU prefer
+        fully static ``padded_shapes``: every distinct batch shape is a
+        separate XLA compile, so max-in-batch padding trades compile-
+        cache hits for bytes. ``padding_values`` defaults to 0 (b"" for
+        string components).
+        """
+        return Dataset(_batched(
+            self._factory, batch_size, drop_remainder,
+            lambda rows: _pad_batch(rows, padded_shapes, padding_values)))
 
     def parse_example(self, features):
         """Parse serialized tf.Example elements into feature dicts
@@ -382,6 +389,89 @@ def _stack_one(vals):
         out[:] = vals
         return out
     return np.stack([np.asarray(v) for v in vals])
+
+
+def _batched(src, batch_size, drop_remainder, stack_fn):
+    """Shared buffering loop behind batch()/padded_batch()."""
+    def gen():
+        buf = []
+        for x in src():
+            buf.append(x)
+            if len(buf) == batch_size:
+                yield stack_fn(buf)
+                buf = []
+        if buf and not drop_remainder:
+            yield stack_fn(buf)
+
+    return gen
+
+
+def _pad_one(vals, padded_shape, padding_value):
+    """Stack a list of np arrays, padding every dim to a common target."""
+    if isinstance(vals[0], (bytes, str, np.bytes_, np.str_)):
+        return _stack_one(vals)  # strings batch as object arrays, no pad
+    arrs = [np.asarray(v) for v in vals]
+    rank = arrs[0].ndim
+    if any(a.ndim != rank for a in arrs):
+        raise ValueError(
+            f"padded_batch: rank mismatch within batch: "
+            f"{[a.shape for a in arrs]}")
+    if rank == 0:
+        return np.stack(arrs)
+    maxdims = [max(a.shape[d] for a in arrs) for d in range(rank)]
+    if padded_shape is not None:
+        padded_shape = list(padded_shape)
+        if len(padded_shape) != rank:
+            raise ValueError(
+                f"padded_shapes rank {len(padded_shape)} != element rank "
+                f"{rank}")
+        target = []
+        for d, (want, got) in enumerate(zip(padded_shape, maxdims)):
+            want = -1 if want is None else int(want)
+            if want == -1:
+                target.append(got)
+            elif want < got:
+                raise ValueError(
+                    f"padded_batch: element dim {d} is {got}, larger than "
+                    f"padded shape {want}")
+            else:
+                target.append(want)
+    else:
+        target = maxdims
+    kind = arrs[0].dtype.kind
+    if kind in ("O", "S", "U"):
+        # string components pad with b""/"" as documented; build an
+        # OBJECT array — numpy's fixed-width 'S'/'U' would truncate or
+        # NUL-pad longer entries (same hazard as _stack_one)
+        if padding_value is None:
+            padding_value = "" if kind == "U" else b""
+        out = np.empty([len(arrs)] + target, dtype=object)
+        out[...] = padding_value
+    else:
+        pv = 0 if padding_value is None else padding_value
+        out = np.full([len(arrs)] + target, pv, dtype=arrs[0].dtype)
+    for i, a in enumerate(arrs):
+        out[(i,) + tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
+def _pad_batch(rows, padded_shapes, padding_values):
+    """Pad+stack rows preserving tuple/dict element structure."""
+    def comp(getter, shape, value):
+        return _pad_one([getter(r) for r in rows], shape, value)
+
+    if isinstance(rows[0], tuple):
+        n = len(rows[0])
+        shapes = padded_shapes if padded_shapes is not None else [None] * n
+        values = padding_values if padding_values is not None else [None] * n
+        return tuple(comp(lambda r, i=i: r[i], shapes[i], values[i])
+                     for i in range(n))
+    if isinstance(rows[0], dict):
+        shapes = padded_shapes or {}
+        values = padding_values or {}
+        return {k: comp(lambda r, k=k: r[k], shapes.get(k),
+                        values.get(k)) for k in rows[0]}
+    return _pad_one(rows, padded_shapes, padding_values)
 
 
 def _stack_batch(rows):

@@ -205,19 +205,24 @@ def gradients(ys, xs, grad_ys=None, name="gradients",
         grad_ys = [None] * len(ys)
 
     path_ops, connected = lowering_mod.ancestors_between(xs, ys)
+    # A While WITH static maximum_iterations is differentiable: the vjp
+    # replay lowers it as a masked lax.scan over the bound (see
+    # control_flow_ops._lower_while). Only the unbounded form must fail
+    # here, at graph construction, with an actionable message — the
+    # alternative is an opaque lax.while_loop autodiff error deep inside
+    # Session.run lowering.
     while_on_path = [o.name for o in path_ops if o.type == "While"
+                     and o.attrs.get("max_iterations") is None
                      and _while_reaches_ys_differentiably(o, ys, stop_set)]
     if while_on_path:
-        # fail at graph construction with an actionable message — the
-        # alternative is an opaque lax.while_loop autodiff error deep
-        # inside Session.run lowering
         raise errors_mod.InvalidArgumentError(
             None, None,
-            "Reverse-mode gradients cannot cross a while_loop on TPU (XLA "
-            f"cannot differentiate unbounded loops; on path: "
-            f"{while_on_path[:3]}). Use stf.scan / stf.foldl / dynamic_rnn "
-            "(lax.scan-based, differentiable) instead — raw_rnn and "
-            "while_loop are forward-only.")
+            "Reverse-mode gradients cannot cross an UNBOUNDED while_loop "
+            f"on TPU (XLA cannot differentiate it; on path: "
+            f"{while_on_path[:3]}). Pass maximum_iterations= to "
+            "while_loop (the bounded loop replays as a masked, "
+            "differentiable lax.scan in the backward pass), or use "
+            "stf.scan / stf.foldl / dynamic_rnn (lax.scan-based).")
 
     with g.name_scope(name):
         connected_xs = [x for x in xs if x in connected
@@ -275,6 +280,9 @@ def _lower_symbolic_gradient(ctx, op, input_values):
         env.update(zip(xs, args))
         child = ctx.child(env)
         child.alias = {}
+        # ops on the replay path must lower in their differentiable form
+        # (a bounded While becomes a masked lax.scan)
+        child.differentiable = True
         for path_op in path_ops:
             grad_type = path_op.attrs.get("_gradient_op_type")
             if grad_type is not None and grad_type in _GRADIENT_REGISTRY:
@@ -393,6 +401,7 @@ def _lower_symbolic_hessian(ctx, op, input_values):
         env[x] = xval
         child = ctx.child(env)
         child.alias = {}
+        child.differentiable = True
         lowering_mod.execute_ops(child, path_ops, fed={x})
         return child.env[y]
 

@@ -205,6 +205,10 @@ class LoweringContext:
         self.sharding_env = None  # set by parallel lowering
         self.in_control_flow = False
         self.in_shard_map = False
+        # True while tracing the SymbolicGradient forward replay: op
+        # lowerings may pick a differentiable form (e.g. a bounded While
+        # lowers to a masked lax.scan instead of lax.while_loop)
+        self.differentiable = False
         # CSE alias map from the plan-time optimizer: duplicate tensor ->
         # canonical tensor; consulted on every input lookup
         self.alias: Dict[Tensor, Tensor] = {}
@@ -227,6 +231,7 @@ class LoweringContext:
         c.in_control_flow = (self.in_control_flow if in_control_flow is None
                              else in_control_flow)
         c.in_shard_map = self.in_shard_map
+        c.differentiable = self.differentiable
         c.alias = self.alias
         c._rng_cache = self._rng_cache
         c.numeric_checks = self.numeric_checks

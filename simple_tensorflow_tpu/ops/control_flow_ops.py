@@ -251,10 +251,15 @@ def while_loop(cond, body, loop_vars, shape_invariants=None,
                name=None, maximum_iterations=None):
     """(ref: control_flow_ops.py:2775 ``while_loop``) → lax.while_loop.
 
-    For reverse-mode gradients use stf.scan / stf.foldl (lax.scan) — XLA
-    cannot differentiate an unbounded while loop (the reference does it by
-    stacking every iteration's intermediates in host memory, ref
-    core/kernels/stack_ops.cc — a pattern TPU HBM budgets rule out).
+    Reverse-mode gradients require ``maximum_iterations``: the forward
+    pass stays an early-exiting lax.while_loop, and the gradient replay
+    re-traces the loop as a masked lax.scan over the static bound, which
+    lax can differentiate. (The reference differentiates the UNBOUNDED
+    loop by stacking every iteration's intermediates in host memory, ref
+    core/kernels/stack_ops.cc — a pattern TPU HBM budgets rule out;
+    bounding the loop is the same contract tf2xla imposes.) Without
+    maximum_iterations the loop is forward-only — use stf.scan /
+    stf.foldl / dynamic_rnn for naturally bounded iteration.
     Loop-carried shapes must be invariant (XLA requirement).
     """
     g = ops_mod.get_default_graph()
@@ -328,6 +333,35 @@ def _lower_while(ctx, op, inputs):
     b_caps = builtins.list(inputs[n + n_cc:])
 
     if max_iter is not None:
+        if getattr(ctx, "differentiable", False):
+            # Inside the SymbolicGradient replay: lax.while_loop has no
+            # reverse-mode rule, but the user gave a static bound, so the
+            # loop IS expressible as a lax.scan of max_iter guarded steps
+            # — exactly the bounded-loop form XLA wants on TPU. Each step
+            # runs the body under lax.cond (differentiable), so
+            # iterations past the exit never EVALUATE the body: a body
+            # that is only numerically valid while cond holds (Newton
+            # steps, sqrt/log of a shrinking quantity) cannot poison the
+            # gradient with 0*NaN from post-exit values. Values and
+            # gradients therefore match the early-exiting forward.
+            def step(carry, _):
+                active, vars_ = carry
+                c = lowering_mod.lower_func_graph(
+                    ctx, cg, builtins.list(vars_), c_caps)[0]
+                act = jnp.logical_and(active, jnp.reshape(c, ()))
+
+                def run_body(vs):
+                    return builtins.tuple(lowering_mod.lower_func_graph(
+                        ctx, bg, builtins.list(vs), b_caps))
+
+                new_vars = jax.lax.cond(act, run_body, lambda vs: vs,
+                                        vars_)
+                return (act, new_vars), None
+
+            (_, final_vars), _ = jax.lax.scan(
+                step, (jnp.asarray(True), init), None, length=max_iter)
+            return builtins.list(final_vars)
+
         init = (jnp.asarray(0, jnp.int32),) + init
 
         def cond_f(carry):

@@ -195,7 +195,9 @@ def raw_rnn(cell, loop_fn, parallel_iterations=None, swap_memory=False,
                 out.append(next_ls)
             return out
 
-        final = cf.while_loop(_cond, _body, carry0)
+        # the static bound makes the loop reverse-differentiable (the
+        # gradient replay lowers it as a masked lax.scan over T steps)
+        final = cf.while_loop(_cond, _body, carry0, maximum_iterations=T)
         t_f, _, _, state_f, emit_buf_f = final[:5]
         loop_state_f = final[5] if has_loop_state else None
         emit_ta = ta_ops.TensorArray(emit0.dtype, size=T,

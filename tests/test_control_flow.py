@@ -263,26 +263,107 @@ class TestRawRNN:
         with _pytest.raises(ValueError, match="maximum_iterations"):
             rnn.raw_rnn(cell, lambda *a: None)
 
-    def test_gradient_through_while_raises_early(self):
+    def test_gradient_through_unbounded_while_raises_early(self):
+        # No maximum_iterations -> no reverse-mode rule; must fail at
+        # graph construction with an actionable message, not deep inside
+        # Session.run lowering.
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [], name="x")
+        _, y = stf.while_loop(lambda i, a: stf.less(i, 5),
+                              lambda i, a: (i + 1, a * x),
+                              [stf.constant(0), x])
+        import pytest as _pytest
+        with _pytest.raises(stf.errors.InvalidArgumentError,
+                            match="maximum_iterations"):
+            stf.gradients(y, [x])
+
+    def test_gradient_through_bounded_while_exact(self):
+        # maximum_iterations makes the loop reverse-differentiable: the
+        # gradient replay lowers it as a masked lax.scan over the bound.
+        # Bound (8) > trip count (5): masked iterations must affect
+        # neither the value nor the gradient. y = x^6, dy/dx = 6 x^5.
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [], name="x")
+        _, y = stf.while_loop(lambda i, a: stf.less(i, 5),
+                              lambda i, a: (i + 1, a * x),
+                              [stf.constant(0), x],
+                              maximum_iterations=8)
+        (g,) = stf.gradients(y, [x])
+        with stf.Session() as sess:
+            yv, gv = sess.run([y, g], feed_dict={x: 2.0})
+        assert float(np.asarray(yv)) == 64.0
+        assert float(np.asarray(gv)) == 6.0 * 2.0 ** 5
+
+    def test_gradient_bounded_while_body_invalid_past_exit(self):
+        # The replay must GUARD post-exit iterations (lax.cond), not just
+        # mask their outputs: this body computes sqrt(a-1), which is NaN
+        # territory once the loop has converged to a=1 — a 0*NaN through
+        # a where-mask would poison the gradient. a: 5 -> 2 -> 1, exit;
+        # y = sqrt(sqrt(x-1)-1), dy/dx at x=5 is 1/8.
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [], name="x")
+        _, y = stf.while_loop(
+            lambda i, a: stf.greater(a, 1.0),
+            lambda i, a: (i + 1, stf.sqrt(a - 1.0)),
+            [stf.constant(0), x], maximum_iterations=6)
+        (g,) = stf.gradients(y, [x])
+        with stf.Session() as sess:
+            yv, gv = sess.run([y, g], feed_dict={x: 5.0})
+        assert float(np.asarray(yv)) == 1.0
+        np.testing.assert_allclose(float(np.asarray(gv)), 0.125,
+                                   rtol=1e-5)
+
+    def test_gradient_through_bounded_while_numeric(self):
+        # Vector loop vars + an early-exiting cond on a carried scalar:
+        # symbolic grads must match central differences.
+        from simple_tensorflow_tpu.framework import gradient_checker
+
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [3], name="x")
+
+        def cond(i, v):
+            return stf.less(i, 4)
+
+        def body(i, v):
+            return i + 1, stf.tanh(v) * 1.5
+
+        _, out = stf.while_loop(cond, body, [stf.constant(0), x],
+                                maximum_iterations=6)
+        y = stf.reduce_sum(stf.square(out))
+        with stf.Session().as_default():
+            err = gradient_checker.compute_gradient_error(
+                x, [3], y, [], x_init_value=np.array(
+                    [0.3, -0.7, 1.2], np.float32), delta=1e-3)
+        assert err < 2e-3, err
+
+    def test_gradient_through_raw_rnn(self):
+        # raw_rnn's While carries its maximum_iterations bound, so the
+        # emit-driven RNN loop trains like the reference's.
         from simple_tensorflow_tpu.ops import rnn, rnn_cell
 
         stf.reset_default_graph()
         cell = rnn_cell.BasicRNNCell(3)
-        xc = stf.constant(np.zeros((4, 2, 2), np.float32))
+        xc = stf.constant(np.random.RandomState(0).randn(4, 2, 2)
+                          .astype(np.float32))
         seq_t = stf.constant(np.array([4, 2], np.int32))
 
         def loop_fn(time, output, state, loop_state):
             finished = time >= seq_t
-            st = cell.zero_state(2, stf.float32) if output is None else state
+            st = cell.zero_state(2, stf.float32) if output is None \
+                else state
             return (finished, stf.gather(xc, stf.minimum(time, 3)), st,
                     output, None)
 
         emit_ta, _, _ = rnn.raw_rnn(cell, loop_fn, maximum_iterations=4)
         loss = stf.reduce_mean(stf.square(emit_ta.stack()))
-        import pytest as _pytest
-        with _pytest.raises(stf.errors.InvalidArgumentError,
-                            match="while_loop"):
-            stf.gradients(loss, stf.trainable_variables())
+        grads = stf.gradients(loss, stf.trainable_variables())
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            gvals = sess.run(grads)
+        for gv in gvals:
+            a = np.asarray(gv)
+            assert np.isfinite(a).all()
+            assert np.abs(a).sum() > 0
 
     def test_gradient_ok_when_while_cut_by_stop_gradient(self):
         # A While output that reaches the loss only through stop_gradient

@@ -33,6 +33,70 @@ class TestDataset:
               .map(lambda x: x * 10).batch(3))
         assert next(iter(ds)).tolist() == [0, 10, 20]
 
+    def test_padded_batch_max_in_batch(self):
+        rows = [np.arange(n, dtype=np.int32) + 1 for n in (2, 4, 3)]
+        ds = stf_data.Dataset.from_generator(lambda: iter(rows)) \
+            .padded_batch(3)
+        b = next(iter(ds))
+        assert b.shape == (3, 4)
+        np.testing.assert_array_equal(
+            b, [[1, 2, 0, 0], [1, 2, 3, 4], [1, 2, 3, 0]])
+
+    def test_padded_batch_static_shape_and_value(self):
+        rows = [np.arange(n, dtype=np.float32) for n in (2, 3)]
+        ds = stf_data.Dataset.from_generator(lambda: iter(rows)) \
+            .padded_batch(2, padded_shapes=[5], padding_values=-1.0)
+        b = next(iter(ds))
+        assert b.shape == (2, 5)
+        assert b[0].tolist() == [0.0, 1.0, -1.0, -1.0, -1.0]
+        assert b[1].tolist() == [0.0, 1.0, 2.0, -1.0, -1.0]
+
+    def test_padded_batch_dict_structure(self):
+        rows = [{"ids": np.arange(n, dtype=np.int64),
+                 "label": np.int64(n)} for n in (1, 3)]
+        ds = stf_data.Dataset.from_generator(lambda: iter(rows)) \
+            .padded_batch(2, padded_shapes={"ids": [4]})
+        b = next(iter(ds))
+        assert b["ids"].shape == (2, 4)
+        assert b["label"].tolist() == [1, 3]
+
+    def test_padded_batch_ragged_strings_pad_empty(self):
+        rows = [np.array([b"a", b"bb"], dtype=object),
+                np.array([b"c"], dtype=object)]
+        ds = stf_data.Dataset.from_generator(lambda: iter(rows)) \
+            .padded_batch(2)
+        b = next(iter(ds))
+        assert b.dtype == object
+        assert b[0].tolist() == [b"a", b"bb"]
+        assert b[1].tolist() == [b"c", b""]  # b"", never an int 0
+
+    def test_padded_batch_too_small_target_raises(self):
+        rows = [np.arange(5, dtype=np.int32)]
+        ds = stf_data.Dataset.from_generator(lambda: iter(rows)) \
+            .padded_batch(1, padded_shapes=[3], drop_remainder=False)
+        with pytest.raises(ValueError, match="larger than"):
+            next(iter(ds))
+
+    def test_padded_batch_feeds_training(self):
+        # the standard NLP path: variable-length ids -> static padded
+        # shape -> embedding + mask, one compile for every batch
+        rows = [np.arange(1, n + 2, dtype=np.int32) for n in range(6)]
+        ds = stf_data.Dataset.from_generator(lambda: iter(rows)) \
+            .padded_batch(2, padded_shapes=[8])
+        it = ds.make_one_shot_iterator()
+        nxt = it.get_next()
+        emb = stf.Variable(np.ones((16, 4), np.float32))
+        vecs = stf.nn.embedding_lookup(emb, nxt)
+        mask = stf.cast(stf.not_equal(nxt, 0), stf.float32)
+        pooled = stf.reduce_sum(
+            vecs * stf.expand_dims(mask, -1), axis=1)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            out = np.asarray(sess.run(pooled))
+        assert out.shape == (2, 4)
+        # row 0 has 1 real token, row 1 has 2 (padding masked out)
+        np.testing.assert_allclose(out[:, 0], [1.0, 2.0])
+
     def test_shuffle_deterministic_seed(self):
         mk = lambda: [int(x) for x in stf_data.Dataset.from_tensor_slices(
             np.arange(20)).shuffle(10, seed=3)]
