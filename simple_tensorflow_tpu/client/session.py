@@ -458,7 +458,42 @@ class BaseSession:
 
         from ..ops.session_ops import TensorHandle
 
+        from ..framework.sparse_tensor import SparseTensor
+
         for k, v in feed_dict.items():
+            if isinstance(k, SparseTensor):
+                # TF-1 contract: feed a SparseTensor with a
+                # SparseTensorValue (or (indices, values, dense_shape))
+                # by expanding into its component tensors. A
+                # static-shape sparse_placeholder keeps dense_shape as a
+                # Const; validate the fed shape against it instead of
+                # feeding it.
+                try:
+                    vi, vv, vs = v  # SparseTensorValue iterates as 3
+                except (TypeError, ValueError):
+                    raise TypeError(
+                        f"Cannot feed {type(v).__name__} for SparseTensor"
+                        f" {k.indices.name}: expected a SparseTensorValue"
+                        " or an (indices, values, dense_shape) triple")
+                from ..framework import constant_op as _const
+
+                vs = np.asarray(vs)
+                if vs.ndim != 1:
+                    raise ValueError(
+                        f"SparseTensor dense_shape must be rank-1; fed "
+                        f"value has shape {vs.shape}")
+                comps = {k.indices: vi, k.values: vv}
+                static = _const.constant_value(k.dense_shape)
+                if static is not None:
+                    if vs.tolist() != list(np.asarray(static)):
+                        raise ValueError(
+                            f"SparseTensor {k.indices.name} has static "
+                            f"dense_shape {list(static)}; fed value has "
+                            f"dense_shape {vs.tolist()}")
+                else:
+                    comps[k.dense_shape] = vs
+                feeds.update(self._normalize_feeds(comps))
+                continue
             t = self._graph.as_graph_element(k, allow_tensor=True,
                                              allow_operation=False)
             if isinstance(v, TensorHandle):

@@ -103,6 +103,40 @@ def test_gradients_through_variables():
         np.testing.assert_allclose(sess.run(dv), [2.0, 4.0])
 
 
+def test_feed_sparse_tensor_value():
+    # TF-1 contract: feed_dict={sparse_tensor: SparseTensorValue} expands
+    # into the component tensors; fetching the SparseTensor returns a
+    # SparseTensorValue (ref python/client/session.py feed/fetch mappers).
+    sp = stf.sparse_placeholder(stf.float32, shape=[2, 4], name="spf")
+    dense = stf.sparse_tensor_to_dense(sp, default_value=0.0)
+    val = stf.SparseTensorValue(
+        indices=np.array([[0, 0], [1, 2]], np.int64),
+        values=np.array([3.0, 4.0], np.float32),
+        dense_shape=np.array([2, 4], np.int64))
+    with stf.Session() as sess:
+        out = np.asarray(sess.run(dense, feed_dict={sp: val}))
+        np.testing.assert_allclose(
+            out, [[3, 0, 0, 0], [0, 0, 4, 0]])
+        # plain-tuple form works too
+        np.asarray(sess.run(
+            dense, feed_dict={sp: (val.indices, val.values, [2, 4])}))
+        # a static-shape placeholder rejects a mismatched dense_shape
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="dense_shape"):
+            sess.run(dense,
+                     feed_dict={sp: (val.indices, val.values, [3, 4])})
+        fetched = sess.run(sp, feed_dict={sp: val})
+        assert isinstance(fetched, stf.SparseTensorValue)
+        np.testing.assert_allclose(np.asarray(fetched.values), [3.0, 4.0])
+        # a dense array is not a sparse feed value: targeted TypeError
+        with _pytest.raises(TypeError, match="SparseTensorValue"):
+            sess.run(dense, feed_dict={sp: np.zeros((2, 4))})
+        # wrong-rank dense_shape must not slip through the ravel
+        with _pytest.raises(ValueError, match="rank-1"):
+            sess.run(dense, feed_dict={
+                sp: (val.indices, val.values, [[2, 4]])})
+
+
 def test_sgd_training_loop_converges():
     """Linear regression: the MNIST-softmax e2e pattern (BASELINE config 1)."""
     rng = np.random.RandomState(0)
