@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Attention seq2seq user journey: variable-length sequences ->
+Dataset.padded_batch (static shapes, ONE compile) -> teacher-forced
+training -> greedy decode.
+
+The task is sequence copy (the classic seq2seq sanity check). Mirrors
+the reference's translate-tutorial workflow: bucket/pad the source,
+train with teacher forcing, decode by feeding back the argmax.
+
+    python examples/train_seq2seq.py --steps 200
+"""
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+import simple_tensorflow_tpu as stf  # noqa: E402
+from simple_tensorflow_tpu import data as stf_data  # noqa: E402
+from simple_tensorflow_tpu.models import rnn_seq2seq as s2s  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
+
+    cfg = s2s.Seq2SeqConfig.tiny()
+    rng = np.random.RandomState(0)
+    pairs = []
+    for _ in range(64):
+        n = rng.randint(2, cfg.src_len + 1)
+        pairs.append({"src": rng.randint(2, cfg.src_vocab,
+                                         size=n).astype(np.int32),
+                      "len": np.int32(n)})
+
+    ds = (stf_data.Dataset.from_generator(lambda: iter(pairs))
+          .padded_batch(args.batch,
+                        padded_shapes={"src": [cfg.src_len], "len": []})
+          .repeat())
+    batch = ds.make_one_shot_iterator().get_next()
+
+    m = s2s.seq2seq_model(args.batch, cfg)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        feed = None
+        for step in range(args.steps):
+            b = sess.run(batch)
+            src, lens = b["src"], b["len"]
+            tgt_out = src.copy()
+            tgt_in = np.zeros_like(tgt_out)
+            tgt_in[:, 0] = s2s.GO_ID
+            tgt_in[:, 1:] = tgt_out[:, :-1]
+            feed = {m["src"]: src, m["src_len"]: lens,
+                    m["tgt_in"]: tgt_in, m["tgt_out"]: tgt_out}
+            _, loss = sess.run([m["train_op"], m["loss"]], feed)
+            if step % 50 == 0:
+                print(f"step {step}: loss {float(np.asarray(loss)):.4f}")
+        dec = np.asarray(sess.run(m["decoded"], feed))
+        tgt = feed[m["tgt_out"]]
+        msk = tgt > 0
+        acc = float((dec[msk] == tgt[msk]).mean())
+        print(f"final loss {float(np.asarray(loss)):.4f}, "
+              f"greedy token accuracy {acc:.2%}")
+        print("sample:", tgt[0][tgt[0] > 0].tolist(), "->",
+              dec[0][tgt[0] > 0].tolist())
+    return 0 if acc > 0.8 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

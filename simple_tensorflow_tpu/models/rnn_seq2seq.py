@@ -89,11 +89,8 @@ def seq2seq_model(batch_size, config=None, training=True):
             memory, enc_state = rnn.dynamic_rnn(
                 enc_cell, enc_in, sequence_length=src_len,
                 dtype=stf.float32)
-        positions = stf.constant(
-            np.arange(cfg.src_len, dtype=np.int32)[None, :])
-        src_mask = stf.cast(
-            stf.less(stf.tile(positions, [B, 1]),
-                     stf.expand_dims(src_len, -1)), stf.float32)
+        src_mask = stf.cast(stf.sequence_mask(src_len, cfg.src_len),
+                            stf.float32)
 
         # ---- decoder scan (shared by train + greedy decode) -------------
         dec_cell = rnn_cell.BasicLSTMCell(H)
@@ -159,8 +156,10 @@ def seq2seq_model(batch_size, config=None, training=True):
             stf.reshape(logits_flat, [cfg.tgt_len, B, cfg.tgt_vocab]),
             [1, 0, 2])
 
-        # greedy decode path (feed_previous=True), same variables
-        dummy = stf.zeros([cfg.tgt_len, B, H])
+        # greedy decode path (feed_previous=True), same variables; the
+        # elems tensor only supplies the trip count (the body feeds back
+        # prev_id), so thread the smallest possible buffer
+        dummy = stf.zeros([cfg.tgt_len, 1])
         _, _, ids_seq, _ = functional_ops.scan(
             make_step(True), dummy, initializer=init, name="dec_greedy")
         decoded = stf.transpose(ids_seq, [1, 0])
