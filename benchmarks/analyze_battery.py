@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Turn a completed run_all_tpu.sh battery into a verdict table.
+
+Reads artifacts/bench_latest.jsonl (+ pallas/tune/profile JSONs when
+present), compares against the round-3 on-chip baselines
+(artifacts/bench_measured_r3_onchip.json) and the VERDICT r4 acceptance
+targets, and prints one PASS/FAIL line per claim so the post-battery
+loop is one command:
+
+    python benchmarks/analyze_battery.py
+
+Targets (VERDICT r4 "Next round" item 1):
+- ResNet-50: >= 22% MFU or <= 55 GB/step bytes-accessed (the roofline
+  ceiling claim), and faster than the r3 2623 img/s.
+- BERT-base: >= 35% MFU, and faster than the r3 73.2k tok/s.
+Exit code 0 iff every line that could be evaluated passed.
+"""
+
+import json
+import os
+import sys
+
+ART = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "..", "artifacts")
+
+R3 = {"resnet50_images_per_sec_per_chip": 2623.09,
+      "bert_base_tokens_per_sec_per_chip": 73151.9}
+R3_MFU = {"resnet50_images_per_sec_per_chip": 0.1633,
+          "bert_base_tokens_per_sec_per_chip": 0.2104}
+
+
+def load_latest():
+    path = os.path.join(ART, "bench_latest.jsonl")
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return rows
+
+
+def main() -> int:
+    rows = load_latest()
+    if not rows:
+        print("no artifacts/bench_latest.jsonl rows — battery has not "
+              "completed")
+        return 1
+    checks = []  # (name, ok_or_None, detail)
+
+    by_metric = {r.get("metric"): r for r in rows}
+    for metric, r in by_metric.items():
+        if metric == "resnet50_dp8_sharding_efficiency":
+            # always a CPU-virtual-mesh child by design (bench.py): judge
+            # it on the efficiency protocol, not on-chipness
+            v = float(r.get("value", 0.0))
+            ok = not r.get("anomalous") and 0.8 <= v <= 1.5
+            checks.append(("dp8 sharding efficiency in [0.8, 1.5]", ok,
+                           f"measured {v} (median of trials; "
+                           f"anomalous={bool(r.get('anomalous'))})"))
+            continue
+        dev = str(r.get("device", ""))
+        if "TPU" not in dev:
+            checks.append((f"{metric}: on-chip", False,
+                           f"device={dev or 'missing'} "
+                           f"error={r.get('error', '')[:80]}"))
+            continue
+        checks.append((f"{metric}: on-chip", True, dev))
+        if metric in R3:
+            v = float(r.get("value", 0.0))
+            ok = v >= R3[metric]
+            checks.append(
+                (f"{metric}: beats r3 ({R3[metric]:.0f})", ok,
+                 f"measured {v:.1f} "
+                 f"({v / R3[metric]:.2f}x, mfu {r.get('mfu')} vs r3 "
+                 f"{R3_MFU[metric]})"))
+        if metric == "resnet50_images_per_sec_per_chip":
+            mfu = float(r.get("mfu") or 0.0)
+            gb = r.get("bytes_accessed_gb")
+            ok = mfu >= 0.22 or (gb is not None and float(gb) <= 55.0)
+            checks.append(("resnet: >=22% MFU or <=55 GB/step", ok,
+                           f"mfu {mfu:.3f}, bytes {gb} GB "
+                           f"(variant {r.get('variant', 'base')}, "
+                           f"batch {r.get('batch')})"))
+        if metric == "bert_base_tokens_per_sec_per_chip":
+            mfu = float(r.get("mfu") or 0.0)
+            checks.append(("bert: >=35% MFU", mfu >= 0.35,
+                           f"mfu {mfu:.3f} (batch {r.get('batch')})"))
+        pred = r.get("predicted")
+        if isinstance(pred, dict) and "error" not in pred:
+            rat = pred.get("measured_over_predicted")
+            if rat is not None:
+                checks.append(
+                    (f"{metric}: within 2x of roofline prediction",
+                     0.5 <= float(rat) <= 2.0,
+                     f"measured/predicted {rat}"))
+
+    for name in ("pb_flash", "pb_ln", "pb_xent", "pb_quant",
+                 "tune_flash", "tune_xent"):
+        p = os.path.join(ART, name + (".jsonl" if name.startswith("tune")
+                                      else ".json"))
+        checks.append((f"artifact {name}", os.path.exists(p),
+                       p if os.path.exists(p) else "missing"))
+
+    width = max(len(c[0]) for c in checks) + 2
+    failed = 0
+    for name, ok, detail in checks:
+        mark = "PASS" if ok else "FAIL"
+        failed += 0 if ok else 1
+        print(f"{mark}  {name:<{width}} {detail}")
+    print(f"\n{len(checks) - failed}/{len(checks)} checks passed")
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,10 @@ def main() -> int:
     args = ap.parse_args()
     if args.steps < 1:
         ap.error("--steps must be >= 1")
+    if not 1 <= args.batch <= 64:
+        # 64 synthetic pairs; padded_batch drops the remainder, so a
+        # larger batch would yield zero batches and spin forever
+        ap.error("--batch must be in [1, 64]")
 
     cfg = s2s.Seq2SeqConfig.tiny()
     rng = np.random.RandomState(0)

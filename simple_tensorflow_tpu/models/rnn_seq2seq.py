@@ -89,8 +89,8 @@ def seq2seq_model(batch_size, config=None, training=True):
             memory, enc_state = rnn.dynamic_rnn(
                 enc_cell, enc_in, sequence_length=src_len,
                 dtype=stf.float32)
-        src_mask = stf.cast(stf.sequence_mask(src_len, cfg.src_len),
-                            stf.float32)
+        src_mask = stf.sequence_mask(src_len, cfg.src_len,
+                                     dtype=stf.float32)
 
         # ---- decoder scan (shared by train + greedy decode) -------------
         dec_cell = rnn_cell.BasicLSTMCell(H)
