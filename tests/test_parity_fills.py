@@ -47,6 +47,22 @@ class TestGradientOverrides:
         np.testing.assert_allclose(hv, np.diag(6.0 * np.array([1.0, 2.0])),
                                    rtol=1e-5)
 
+    def test_hessians_through_variable_reads(self):
+        # v, v.value(), read_value(), and mixed reads must all yield the
+        # same total Hessian (mixed includes the cross-read terms:
+        # d2(sum v*value(v))/dv2 = 2I, same as d2(sum v^2)/dv2).
+        stf.reset_default_graph()
+        v = stf.Variable(np.array([1.0, 2.0], np.float32), name="vh")
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            for y in (stf.reduce_sum(stf.square(v)),
+                      stf.reduce_sum(stf.square(v.value())),
+                      stf.reduce_sum(stf.square(v.read_value())),
+                      stf.reduce_sum(v * v.value())):
+                (h,) = stf.hessians(y, [v])
+                np.testing.assert_allclose(sess.run(h), 2.0 * np.eye(2),
+                                           rtol=1e-5)
+
 
 class TestNnFills:
     def test_max_pool_with_argmax_overlapping_windows(self):

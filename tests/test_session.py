@@ -103,6 +103,24 @@ def test_gradients_through_variables():
         np.testing.assert_allclose(sess.run(dv), [2.0, 4.0])
 
 
+def test_gradients_through_variable_reads():
+    # TF-1 treats v, v.value(), and v.read_value() as the same variable
+    # for tf.gradients; a loss built from any read must produce a real
+    # gradient, and mixed reads must SUM their contributions.
+    v = stf.Variable(np.array([1.0, 2.0], np.float32), name="vr")
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        for y in (stf.reduce_sum(stf.square(v.value())),
+                  stf.reduce_sum(stf.square(v.read_value()))):
+            (g,) = stf.gradients(y, [v])
+            assert g is not None
+            np.testing.assert_allclose(sess.run(g), [2.0, 4.0])
+        mixed = (stf.reduce_sum(stf.square(v))
+                 + stf.reduce_sum(v.value()))
+        (gm,) = stf.gradients(mixed, [v])
+        np.testing.assert_allclose(sess.run(gm), [3.0, 5.0])
+
+
 def test_feed_sparse_tensor_value():
     # TF-1 contract: feed_dict={sparse_tensor: SparseTensorValue} expands
     # into the component tensors; fetching the SparseTensor returns a
