@@ -438,8 +438,10 @@ def hessians(ys, xs, name="hessians", colocate_gradients_with_ops=False,
                 (xt.shape.as_list() or []) + (xt.shape.as_list() or []))
                 if xt.shape.rank is not None
                 else shape_mod.TensorShape(None))
+            # n_ys/n_xs use the SymbolicGradient attr contract so the
+            # static cost model prices the replayed slice correctly
             op = g.create_op("SymbolicHessian", [y] + cands,
-                             attrs={"n_reads": len(cands)},
+                             attrs={"n_ys": 1, "n_xs": len(cands)},
                              name="hess",
                              output_specs=[(hshape,
                                             xt.dtype.base_dtype)])
@@ -462,7 +464,11 @@ def _lower_symbolic_hessian(ctx, op, input_values):
             if dup.op not in path_set and canon in env:
                 env.setdefault(dup, env[canon])
         # every read binds the SAME argument: jax.hessian then computes
-        # the total second derivative including cross-read terms
+        # the total second derivative including cross-read terms. All
+        # reads are evaluated at the REF's value — a read that observes
+        # a different value via control-dep-ordered assigns within the
+        # step is approximated at the ref's point (gradients() feeds
+        # per-read values; second order does not).
         for r in reads:
             env[r] = xval
         child = ctx.child(env)
