@@ -98,7 +98,6 @@ def _roofline_info(sess, feed, sec_per_step, platform):
     if platform == "cpu":
         return {}
     try:
-        import jax
 
         from simple_tensorflow_tpu.utils import perf
 
@@ -107,8 +106,8 @@ def _roofline_info(sess, feed, sec_per_step, platform):
         feeds = sess._normalize_feeds(feed)
         feed_args = {t.name: feeds[t] for t in step.feed_tensors}
         state = dict(sess._variable_store.values)
-        rng = jax.random.fold_in(sess._base_key, 7)
-        compiled = step.jitted.lower(state, feed_args, rng).compile()
+        compiled = step.jitted.lower(state, feed_args, sess._base_key,
+                                     np.uint32(7)).compile()
         cost = perf.cost_of(compiled)
         _, peak_bw = perf.chip_spec()
         gbps = cost["bytes"] / sec_per_step / 1e9

@@ -25,7 +25,6 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def lowered_cost(train_op, loss, feed):
     """Plan the session step for (train_op, loss) under `feed`, lower and
     compile it WITHOUT running, and return XLA's cost analysis."""
-    import jax
 
     import simple_tensorflow_tpu as stf
 
@@ -36,8 +35,8 @@ def lowered_cost(train_op, loss, feed):
     assert step.has_device_stage, "train step lowered to host-only?"
     feed_args = {t.name: feeds[t] for t in step.feed_tensors}
     state = dict(sess._variable_store.values)
-    rng = jax.random.fold_in(sess._base_key, 0)
-    compiled = step.jitted.lower(dict(state), feed_args, rng).compile()
+    compiled = step.jitted.lower(dict(state), feed_args,
+                                 sess._base_key, np.uint32(0)).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, list):
         cost = cost[0]

@@ -48,15 +48,14 @@ def build(batch, seq_len):
 
 
 def analyze(sess, m, feed):
-    import jax
 
     step = max((v for v in sess._cache.values() if v.has_device_stage),
                key=lambda s: len(s.device_ops))
     feeds = sess._normalize_feeds(feed)
     feed_args = {t.name: feeds[t] for t in step.feed_tensors}
     state = dict(sess._variable_store.values)
-    rng = jax.random.fold_in(sess._base_key, 999)
-    compiled = step.jitted.lower(state, feed_args, rng).compile()
+    compiled = step.jitted.lower(state, feed_args, sess._base_key,
+                                 np.uint32(999)).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, list):
         cost = cost[0]

@@ -43,15 +43,14 @@ def build_session_step(batch, image_size):
 
 def analyze_hlo(sess, m, feed):
     """Lower the cached step and scan optimized HLO."""
-    import jax
 
     step = max((v for v in sess._cache.values() if v.has_device_stage),
                key=lambda s: len(s.device_ops))
     feeds = sess._normalize_feeds(feed)
     feed_args = {t.name: feeds[t] for t in step.feed_tensors}
     state = dict(sess._variable_store.values)
-    rng = jax.random.fold_in(sess._base_key, 999)
-    lowered = step.jitted.lower(state, feed_args, rng)
+    lowered = step.jitted.lower(state, feed_args, sess._base_key,
+                                np.uint32(999))
     compiled = lowered.compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, list):
@@ -97,13 +96,13 @@ def time_direct_loop(sess, m, feed, steps):
     feeds = sess._normalize_feeds(feed)
     feed_args = {t.name: feeds[t] for t in step.feed_tensors}
     state = dict(sess._variable_store.values)
-    rng = jax.random.fold_in(sess._base_key, 12345)
+    rng_args = (sess._base_key, np.uint32(12345))
     # warm
-    _, state, _ = step.jitted(dict(state), feed_args, rng)
+    _, state, _ = step.jitted(dict(state), feed_args, *rng_args)
     jax.block_until_ready(state)
     t0 = time.perf_counter()
     for i in range(steps):
-        _, state, _ = step.jitted(dict(state), feed_args, rng)
+        _, state, _ = step.jitted(dict(state), feed_args, *rng_args)
     jax.block_until_ready(state)
     dt = (time.perf_counter() - t0) / steps
     # restore store (we donated copies; the session's own arrays were donated

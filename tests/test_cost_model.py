@@ -29,7 +29,6 @@ from simple_tensorflow_tpu.framework import cost_model
 
 def _xla_lowered_cost(train_op, loss, feed_np):
     """Lower (never compile) the session step; return XLA's analysis."""
-    import jax
 
     sess = stf.Session()
     sess.run(stf.global_variables_initializer())
@@ -37,8 +36,8 @@ def _xla_lowered_cost(train_op, loss, feed_np):
     step = sess._plan([train_op, loss], feeds)
     feed_args = {t.name: feeds[t] for t in step.feed_tensors}
     state = dict(sess._variable_store.values)
-    rng = jax.random.fold_in(sess._base_key, 0)
-    lowered = step.jitted.lower(dict(state), feed_args, rng)
+    lowered = step.jitted.lower(dict(state), feed_args,
+                                sess._base_key, np.uint32(0))
     ca = lowered.cost_analysis()
     if isinstance(ca, list):
         ca = ca[0]
