@@ -98,7 +98,6 @@ def _roofline_info(sess, feed, sec_per_step, platform):
     if platform == "cpu":
         return {}
     try:
-
         from simple_tensorflow_tpu.utils import perf
 
         step = max((v for v in sess._cache.values() if v.has_device_stage),

@@ -25,7 +25,6 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def lowered_cost(train_op, loss, feed):
     """Plan the session step for (train_op, loss) under `feed`, lower and
     compile it WITHOUT running, and return XLA's cost analysis."""
-
     import simple_tensorflow_tpu as stf
 
     sess = stf.Session()

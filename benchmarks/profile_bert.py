@@ -48,7 +48,6 @@ def build(batch, seq_len):
 
 
 def analyze(sess, m, feed):
-
     step = max((v for v in sess._cache.values() if v.has_device_stage),
                key=lambda s: len(s.device_ops))
     feeds = sess._normalize_feeds(feed)

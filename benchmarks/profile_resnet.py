@@ -43,7 +43,6 @@ def build_session_step(batch, image_size):
 
 def analyze_hlo(sess, m, feed):
     """Lower the cached step and scan optimized HLO."""
-
     step = max((v for v in sess._cache.values() if v.has_device_stage),
                key=lambda s: len(s.device_ops))
     feeds = sess._normalize_feeds(feed)

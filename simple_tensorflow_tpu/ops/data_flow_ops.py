@@ -823,6 +823,13 @@ class RecordInput:
                     # succeed — signal instead of spinning forever
                     self._empty_epoch = True
                 self._have.notify_all()
+                # reference contract (core/kernels/record_yielder.cc):
+                # every record yields exactly ONCE per epoch. Hold the
+                # next epoch's records out of the buffer until this
+                # epoch has fully drained, else a slow consumer can see
+                # epoch N+1 duplicates before finishing epoch N.
+                while self._buf:
+                    self._have.wait(0.05)
 
     def _host_yield(self, timeout=30.0):
         import time as _time

@@ -29,7 +29,6 @@ from simple_tensorflow_tpu.framework import cost_model
 
 def _xla_lowered_cost(train_op, loss, feed_np):
     """Lower (never compile) the session step; return XLA's analysis."""
-
     sess = stf.Session()
     sess.run(stf.global_variables_initializer())
     feeds = sess._normalize_feeds(feed_np)

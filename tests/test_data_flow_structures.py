@@ -265,6 +265,27 @@ class TestRecordInput:
         assert {f"rec{i}".encode() if isinstance(seen[0], bytes)
                 else f"rec{i}" for i in range(total)} <= set(seen)
 
+    def test_exactly_once_per_epoch_across_epochs(self, tmp_path):
+        # the reference record_yielder contract: each record appears
+        # exactly once per epoch even when the consumer is slow and the
+        # reader is ready with the next epoch (the buffer must drain at
+        # the boundary — regression for an epoch-interleaving race)
+        import collections
+        import time as _time
+
+        stf.reset_default_graph()
+        pattern, total = self._write_tfrecords(tmp_path)
+        ri = stf.RecordInput(pattern, batch_size=4, buffer_size=8, seed=3)
+        batch = ri.get_yield_op()
+        seen = []
+        with stf.Session() as sess:
+            for k in range(2 * total // 4):
+                seen.extend(sess.run(batch).tolist())
+                _time.sleep(0.01)  # give the reader time to race ahead
+        counts = collections.Counter(seen)
+        assert len(seen) == 2 * total
+        assert all(c == 2 for c in counts.values()), counts
+
     def test_bad_pattern_raises(self):
         stf.reset_default_graph()
         with pytest.raises(ValueError, match="No files match"):
