@@ -879,36 +879,169 @@ op_registry.register("RecordInputYield", lower=_lower_record_yield,
 
 
 class ConditionalAccumulator:
-    """(ref: core/kernels/conditional_accumulator.h). Host-side gradient
-    accumulator used by SyncReplicas — on TPU the mesh all-reduce replaces
-    it; kept for API parity."""
+    """(ref: python/ops/data_flow_ops.py:1384 ``ConditionalAccumulator``,
+    kernel core/kernels/conditional_accumulator.h). Host-side dense
+    gradient accumulator used by SyncReplicas — on TPU the mesh
+    all-reduce is the fast path; this serves the graph-op contract:
+    ``apply_grad(symbolic_grad)`` returns an op to run (stale
+    local_step < the accumulator's time step is dropped, ref semantics),
+    ``take_grad(n)`` returns a tensor that BLOCKS until n fresh grads
+    arrived, then yields their average, resets, and advances the time
+    step."""
+
+    _counter = [0]
 
     def __init__(self, dtype, shape=None, shared_name=None,
                  name="conditional_accumulator"):
+        ConditionalAccumulator._counter[0] += 1
         self._dtype = dtypes_mod.as_dtype(dtype)
+        self._shape = (shape_mod.as_shape(shape)
+                       if shape is not None else shape_mod.TensorShape(None))
+        self._name = (shared_name
+                      or f"{name}_{ConditionalAccumulator._counter[0]}")
         self._sum = None
         self._count = 0
+        self._global_step = 0
         self._lock = threading.Lock()
-        self._name = name
+        self._cond = threading.Condition(self._lock)
+        g = ops_mod.get_default_graph()
+        g._scoped_state.setdefault("__dense_accumulators__",
+                                   {})[self._name] = self
 
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def accumulator_ref(self):
+        return self._name
+
+    # -- graph endpoints -----------------------------------------------------
     def apply_grad(self, grad, local_step=0, name=None):
-        with self._lock:
-            g = np.asarray(grad)
-            self._sum = g if self._sum is None else self._sum + g
-            self._count += 1
-        return None
+        g = ops_mod.get_default_graph()
+        gt = ops_mod.convert_to_tensor(grad, dtype=self._dtype)
+        step = ops_mod.convert_to_tensor(local_step)
+        return g.create_op("AccumulatorApplyGradient", [gt, step],
+                           attrs={"accumulator_name": self._name},
+                           name=name or f"{self._name}_apply_grad",
+                           output_specs=[])
 
     def take_grad(self, num_required, name=None):
-        with self._lock:
-            if self._count < num_required:
-                raise errors.FailedPreconditionError(
-                    None, None, f"only {self._count} grads accumulated")
-            avg = self._sum / self._count
-            self._sum, self._count = None, 0
-            return avg
+        if num_required < 1:
+            raise errors.InvalidArgumentError(
+                None, None, f"num_required must be >= 1, got {num_required}")
+        g = ops_mod.get_default_graph()
+        op = g.create_op("AccumulatorTakeGradient", [],
+                         attrs={"accumulator_name": self._name,
+                                "num_required": int(num_required)},
+                         name=name or f"{self._name}_take_grad",
+                         output_specs=[(self._shape, self._dtype)])
+        return op.outputs[0]
 
     def num_accumulated(self, name=None):
-        return self._count
+        g = ops_mod.get_default_graph()
+        op = g.create_op("AccumulatorNumAccumulated", [],
+                         attrs={"accumulator_name": self._name},
+                         name=name or f"{self._name}_num_accumulated",
+                         output_specs=[(shape_mod.scalar(),
+                                        dtypes_mod.int32)])
+        return op.outputs[0]
+
+    def set_global_step(self, new_global_step, name=None):
+        g = ops_mod.get_default_graph()
+        step = ops_mod.convert_to_tensor(new_global_step)
+        return g.create_op("AccumulatorSetGlobalStep", [step],
+                           attrs={"accumulator_name": self._name},
+                           name=name or f"{self._name}_set_global_step",
+                           output_specs=[])
+
+    # -- host behavior -------------------------------------------------------
+    def _host_apply(self, grad, local_step):
+        grad = np.asarray(grad)
+        if (self._shape.rank is not None
+                and not self._shape.is_compatible_with(grad.shape)):
+            raise errors.InvalidArgumentError(
+                None, None,
+                f"Accumulator {self._name}: gradient shape {grad.shape} "
+                f"incompatible with accumulator shape {self._shape}")
+        with self._cond:
+            if self._sum is not None and self._sum.shape != grad.shape:
+                # shape=None: the FIRST applied gradient fixes the shape
+                # (ref contract) — without this, numpy would silently
+                # broadcast mismatched grads into a wrong-shaped average
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"Accumulator {self._name}: gradient shape "
+                    f"{grad.shape} incompatible with accumulated shape "
+                    f"{self._sum.shape}")
+            if int(local_step) < self._global_step:
+                return  # stale gradient: silently dropped (ref contract)
+            self._sum = grad if self._sum is None else self._sum + grad
+            self._count += 1
+            self._cond.notify_all()
+
+    def _host_take(self, num_required, timeout=30.0):
+        """Blocks until num_required fresh grads arrived (the reference
+        kernel's contract — appliers are expected on OTHER threads).
+        Fetching take together with its applies in one run call is a
+        scheduling ambiguity in the reference too; use a separate run
+        call (or control deps) for the take."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        with self._cond:
+            while self._count < num_required:
+                if not self._cond.wait(
+                        timeout=max(0.0, deadline - _time.time())):
+                    raise errors.DeadlineExceededError(
+                        None, None,
+                        f"Accumulator {self._name} take_grad timed out")
+            avg = (self._sum / self._count).astype(self._dtype.np_dtype)
+            self._sum, self._count = None, 0
+            self._global_step += 1
+            return [avg]
+
+    def _host_num(self):
+        with self._lock:
+            return np.asarray(self._count, np.int32)
+
+    def _host_set_step(self, step):
+        with self._lock:
+            self._global_step = int(step)
+
+
+def _get_dense_acc(op) -> "ConditionalAccumulator":
+    name = op.attrs["accumulator_name"]
+    a = op.graph._scoped_state.get("__dense_accumulators__", {}).get(name)
+    if a is None:
+        raise errors.NotFoundError(None, None,
+                                   f"Accumulator {name} not found")
+    return a
+
+
+op_registry.register(
+    "AccumulatorApplyGradient",
+    lower=lambda ctx, op, inputs: _get_dense_acc(op)._host_apply(
+        inputs[0], inputs[1]) or [],
+    is_stateful=True, runs_on_host=True, n_outputs=0)
+op_registry.register(
+    "AccumulatorTakeGradient",
+    lower=lambda ctx, op, inputs: _get_dense_acc(op)._host_take(
+        op.attrs["num_required"]),
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register(
+    "AccumulatorNumAccumulated",
+    lower=lambda ctx, op, inputs: [_get_dense_acc(op)._host_num()],
+    is_stateful=True, runs_on_host=True, n_outputs=1)
+op_registry.register(
+    "AccumulatorSetGlobalStep",
+    lower=lambda ctx, op, inputs: _get_dense_acc(op)._host_set_step(
+        inputs[0]) or [],
+    is_stateful=True, runs_on_host=True, n_outputs=0)
 
 
 class SparseConditionalAccumulator:
@@ -934,6 +1067,7 @@ class SparseConditionalAccumulator:
         self._name = (shared_name
                       or f"{name}_{SparseConditionalAccumulator._counter[0]}")
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._sums = {}       # row index -> accumulated value row(s)
         self._counts = {}     # row index -> number of contributions
         self._ngrads = 0
@@ -1034,7 +1168,7 @@ class SparseConditionalAccumulator:
                         None, None,
                         f"Accumulator {self._name}: gradient shape {got} "
                         f"incompatible with accumulator shape {want}")
-        with self._lock:
+        with self._cond:
             if int(local_step) < self._global_step:
                 return  # stale gradient: silently dropped (ref contract)
             for i, row in zip(indices.tolist(), values):
@@ -1048,38 +1182,38 @@ class SparseConditionalAccumulator:
                 self._seen_shape = np.asarray(shape,
                                               np.int64).reshape(-1)
             self._ngrads += 1
+            self._cond.notify_all()
 
     def _host_take(self, num_required, timeout=30.0):
         import time as _time
 
         deadline = _time.time() + timeout
-        while True:
-            with self._lock:
-                if self._ngrads >= num_required:
-                    idx = sorted(self._sums)
-                    # ref semantics (kernel DivideAccumGradByCounter):
-                    # each slice averages over the number of gradients
-                    # that CONTAINED that index, not the total taken
-                    vals = np.stack(
-                        [self._sums[i] / self._counts[i] for i in idx]) \
-                        if idx else np.zeros((0,), self._dtype.np_dtype)
-                    if self._seen_shape is not None:
-                        shape = self._seen_shape
-                    elif (self._shape is not None
-                          and self._shape.is_fully_defined()):
-                        shape = np.asarray(self._shape.as_list(), np.int64)
-                    else:
-                        shape = np.zeros((0,), np.int64)
-                    self._sums, self._counts = {}, {}
-                    self._ngrads = 0
-                    self._global_step += 1
-                    return [np.asarray(idx, np.int64),
-                            vals.astype(self._dtype.np_dtype), shape]
-            if _time.time() > deadline:
-                raise errors.DeadlineExceededError(
-                    None, None,
-                    f"Accumulator {self._name} take_grad timed out")
-            _time.sleep(0.01)
+        with self._cond:
+            while self._ngrads < num_required:
+                if not self._cond.wait(
+                        timeout=max(0.0, deadline - _time.time())):
+                    raise errors.DeadlineExceededError(
+                        None, None,
+                        f"Accumulator {self._name} take_grad timed out")
+            idx = sorted(self._sums)
+            # ref semantics (kernel DivideAccumGradByCounter): each
+            # slice averages over the number of gradients that CONTAINED
+            # that index, not the total taken
+            vals = np.stack(
+                [self._sums[i] / self._counts[i] for i in idx]) \
+                if idx else np.zeros((0,), self._dtype.np_dtype)
+            if self._seen_shape is not None:
+                shape = self._seen_shape
+            elif (self._shape is not None
+                  and self._shape.is_fully_defined()):
+                shape = np.asarray(self._shape.as_list(), np.int64)
+            else:
+                shape = np.zeros((0,), np.int64)
+            self._sums, self._counts = {}, {}
+            self._ngrads = 0
+            self._global_step += 1
+            return [np.asarray(idx, np.int64),
+                    vals.astype(self._dtype.np_dtype), shape]
 
     def _host_num(self):
         with self._lock:

@@ -145,6 +145,83 @@ class TestBarrier:
         assert sorted(vv.tolist()) == [1, 2]
 
 
+class TestConditionalAccumulator:
+    def test_symbolic_apply_and_average(self):
+        # the graph-op contract: apply_grad takes a SYMBOLIC tensor and
+        # returns an op; take_grad returns a tensor (ref
+        # python/ops/data_flow_ops.py:1384)
+        stf.reset_default_graph()
+        acc = stf.ConditionalAccumulator(stf.float32, shape=[2])
+        g = stf.placeholder(stf.float32, [2])
+        apply_op = acc.apply_grad(g, local_step=0)
+        take = acc.take_grad(3)
+        n = acc.num_accumulated()
+        with stf.Session() as sess:
+            for v in (1.0, 2.0, 6.0):
+                sess.run(apply_op, feed_dict={g: [v, 2 * v]})
+            assert int(np.asarray(sess.run(n))) == 3
+            avg = np.asarray(sess.run(take))
+            np.testing.assert_allclose(avg, [3.0, 6.0])
+            assert int(np.asarray(sess.run(n))) == 0
+
+    def test_computed_gradient_accumulates(self):
+        # the SyncReplicas shape: accumulate tf.gradients output
+        stf.reset_default_graph()
+        acc = stf.ConditionalAccumulator(stf.float32, shape=[2])
+        v = stf.Variable(np.array([1.0, 2.0], np.float32))
+        (grad,) = stf.gradients(stf.reduce_sum(stf.square(v)), [v])
+        apply_op = acc.apply_grad(grad, local_step=0)
+        take = acc.take_grad(2)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(apply_op)
+            sess.run(apply_op)
+            np.testing.assert_allclose(np.asarray(sess.run(take)),
+                                       [2.0, 4.0])
+
+    def test_unknown_shape_fixed_by_first_gradient(self):
+        # shape=None: the first applied gradient fixes the shape; a
+        # mismatched later gradient must error, never numpy-broadcast
+        stf.reset_default_graph()
+        acc = stf.ConditionalAccumulator(stf.float32)  # shape unknown
+        g21 = stf.placeholder(stf.float32, [2, 1])
+        g12 = stf.placeholder(stf.float32, [1, 2])
+        a21 = acc.apply_grad(g21, local_step=0)
+        a12 = acc.apply_grad(g12, local_step=0)
+        with stf.Session() as sess:
+            sess.run(a21, feed_dict={g21: [[1.0], [2.0]]})
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="incompatible"):
+                sess.run(a12, feed_dict={g12: [[3.0, 4.0]]})
+
+    def test_stale_gradients_dropped_and_take_blocks(self):
+        import threading
+        import time as _time
+
+        stf.reset_default_graph()
+        acc = stf.ConditionalAccumulator(stf.float32, shape=[])
+        g = stf.placeholder(stf.float32, [])
+        step_ph = stf.placeholder(stf.int32, [])
+        apply_op = acc.apply_grad(g, local_step=step_ph)
+        take = acc.take_grad(2)
+        set_step = acc.set_global_step(1)
+        results = []
+        with stf.Session() as sess:
+            sess.run(set_step)  # advance the accumulator's time step
+            # stale (local_step 0 < global step 1): dropped silently
+            sess.run(apply_op, feed_dict={g: 99.0, step_ph: 0})
+            t = threading.Thread(target=lambda: results.append(
+                np.asarray(sess.run(take))))
+            t.start()
+            _time.sleep(0.15)
+            assert t.is_alive()  # blocking until 2 fresh grads arrive
+            sess.run(apply_op, feed_dict={g: 4.0, step_ph: 1})
+            sess.run(apply_op, feed_dict={g: 6.0, step_ph: 1})
+            t.join(timeout=10)
+            assert not t.is_alive()
+        np.testing.assert_allclose(results[0], 5.0)
+
+
 class TestSparseConditionalAccumulator:
     def test_accumulate_average_and_reset(self):
         stf.reset_default_graph()
