@@ -167,10 +167,13 @@ class QueueBase:
 
     def close(self, cancel_pending_enqueues=False, name=None):
         g = ops_mod.get_default_graph()
-        return g.create_op("QueueClose", [],
-                           attrs={"queue_name": self._name},
-                           name=name or f"{self._name}_close",
-                           output_specs=[])
+        return g.create_op(
+            "QueueClose", [],
+            attrs={"queue_name": self._name,
+                   "cancel_pending_enqueues":
+                       bool(cancel_pending_enqueues)},
+            name=name or f"{self._name}_close",
+            output_specs=[])
 
     def size(self, name=None):
         g = ops_mod.get_default_graph()
@@ -180,11 +183,29 @@ class QueueBase:
         return op.outputs[0]
 
     # -- host behavior (called by lowerings) --------------------------------
-    def _host_enqueue(self, items, timeout=10.0):
-        if self._closed:
-            raise errors.CancelledError(None, None,
-                                        f"Queue {self._name} closed")
-        self._q.put(builtins.tuple(items), timeout=timeout)
+    def _host_enqueue(self, items, timeout=None):
+        """Blocks while the queue is full — the reference kernel's
+        contract: a producer throttles against a slow consumer forever
+        (a 10s-style cliff would kill training whenever the consumer
+        pauses for a checkpoint/eval). close() from another thread
+        aborts a blocked enqueue with CancelledError; pass ``timeout``
+        only when the caller retries (e.g. a runner re-checking its
+        coordinator between slices)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.time() + timeout
+        while True:
+            if self._closed:
+                raise errors.CancelledError(
+                    None, None, f"Queue {self._name} closed")
+            try:
+                self._q.put(builtins.tuple(items), timeout=0.05)
+                return
+            except py_queue.Full:
+                if deadline is not None and _time.time() > deadline:
+                    raise errors.DeadlineExceededError(
+                        None, None,
+                        f"Enqueue to {self._name} timed out (queue full)")
 
     def _host_dequeue(self, timeout=30.0):
         while True:
@@ -200,8 +221,16 @@ class QueueBase:
                     raise errors.DeadlineExceededError(
                         None, None, f"Dequeue from {self._name} timed out")
 
-    def _host_close(self):
+    def _host_close(self, cancel_pending=False):
         self._closed = True
+        if cancel_pending:
+            # ref semantics: cancel_pending_enqueues purges queued
+            # elements so blocked consumers see closed-and-empty
+            try:
+                while True:
+                    self._q.get_nowait()
+            except py_queue.Empty:
+                pass
 
     def _host_size(self):
         return self._q.qsize()
@@ -239,11 +268,35 @@ class RandomShuffleQueue(QueueBase):
         super().__init__(dtypes, shapes, names, uname, uname)
         self._capacity = capacity
 
-    def _host_enqueue(self, items, timeout=10.0):
-        with self._lock:
-            self._buf.append(builtins.tuple(items))
-            if len(self._buf) > self._capacity:
-                raise errors.ResourceExhaustedError(None, None, "queue full")
+    def _host_enqueue(self, items, timeout=None):
+        import time as _time
+
+        # BLOCK at capacity (ref semantics): shuffle_batch's producer
+        # threads throttle against a slow consumer indefinitely —
+        # raising would stop the coordinator and kill the training
+        # loop. Close from another thread aborts a blocked enqueue with
+        # CancelledError; see QueueBase._host_enqueue for the timeout
+        # contract.
+        deadline = None if timeout is None else _time.time() + timeout
+        while True:
+            if self._closed:
+                raise errors.CancelledError(
+                    None, None, f"Queue {self._name} closed")
+            with self._lock:
+                if len(self._buf) < self._capacity:
+                    self._buf.append(builtins.tuple(items))
+                    return
+            if deadline is not None and _time.time() > deadline:
+                raise errors.DeadlineExceededError(
+                    None, None,
+                    f"Enqueue to {self._name} timed out (queue full)")
+            _time.sleep(0.01)
+
+    def _host_close(self, cancel_pending=False):
+        self._closed = True
+        if cancel_pending:
+            with self._lock:
+                self._buf.clear()
 
     def _host_dequeue(self, timeout=30.0):
         import time as _time
@@ -322,7 +375,8 @@ def _lower_dequeue_many(ctx, op, inputs):
 
 
 def _lower_close(ctx, op, inputs):
-    _get_queue(op.attrs["queue_name"])._host_close()
+    _get_queue(op.attrs["queue_name"])._host_close(
+        op.attrs.get("cancel_pending_enqueues", False))
     return []
 
 

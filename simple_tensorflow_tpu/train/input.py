@@ -12,10 +12,29 @@ import numpy as np
 
 from ..framework import constant_op
 from ..framework import dtypes as dtypes_mod
+from ..framework import errors
 from ..framework import graph as ops_mod
 from ..ops import data_flow_ops
 from ..ops.control_flow_ops import _flatten
 from . import queue_runner
+
+
+def _enqueue_with_retry(q, row, coord):
+    """Enqueue ONE element, retrying the SAME element while the queue is
+    full (dropping it would produce silently incomplete epochs). Blocks
+    in 1s slices so a coordinator stop is observed between retries.
+    Returns False when the runner should exit (stop requested or queue
+    closed/cancelled)."""
+    while True:
+        if coord and coord.should_stop():
+            return False
+        try:
+            q._host_enqueue(row, timeout=1.0)
+            return True
+        except errors.DeadlineExceededError:
+            continue  # full: retry the same element
+        except errors.CancelledError:
+            return False
 
 
 def _producer(items, shuffle, seed, capacity, name, num_epochs=None):
@@ -39,14 +58,9 @@ def _producer(items, shuffle, seed, capacity, name, num_epochs=None):
                     if self._shuffle:
                         self._rng.shuffle(order)
                     for i in order:
-                        if coord and coord.should_stop():
+                        if not _enqueue_with_retry(
+                                q, [np.asarray(self._items[i])], coord):
                             return
-                        try:
-                            q._host_enqueue([np.asarray(self._items[i])],
-                                            timeout=1.0)
-                        except Exception:
-                            if coord and coord.should_stop():
-                                return
                     self._epochs += 1
                     if self._max_epochs and self._epochs >= self._max_epochs:
                         break
@@ -109,13 +123,9 @@ def slice_input_producer(tensor_list, num_epochs=None, shuffle=True, seed=None,
                     if shuffle:
                         self._rng.shuffle(order)
                     for i in order:
-                        if coord and coord.should_stop():
+                        if not _enqueue_with_retry(
+                                q, [v[i] for v in vals], coord):
                             return
-                        try:
-                            q._host_enqueue([v[i] for v in vals], timeout=1.0)
-                        except Exception:
-                            if coord and coord.should_stop():
-                                return
                     self._epochs += 1
                     if num_epochs and self._epochs >= num_epochs:
                         break
@@ -123,7 +133,12 @@ def slice_input_producer(tensor_list, num_epochs=None, shuffle=True, seed=None,
                 q._host_close()
 
     queue_runner.add_queue_runner(_SliceRunner())
-    return q.dequeue()
+    out = q.dequeue()
+    # ref contract (training/input.py slice_input_producer): ALWAYS a
+    # list, one tensor per input — Queue.dequeue collapses a single
+    # component to a bare tensor, and callers who index [0] would then
+    # silently StridedSlice the scalar
+    return out if isinstance(out, list) else [out]
 
 
 def batch(tensors, batch_size, num_threads=1, capacity=32,

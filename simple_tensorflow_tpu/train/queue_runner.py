@@ -60,11 +60,25 @@ class QueueRunner:
             if self._queue is not None:
                 self._queue._host_close()
 
+    def _close_on_stop(self, coord):
+        """(ref: queue_runner_impl.py ``_close_on_stop``): when the
+        coordinator stops, cancel pending enqueues so runner threads
+        blocked on a FULL queue wake with CancelledError instead of
+        hanging past the join grace period."""
+        coord.wait_for_stop()
+        if self._queue is not None:
+            self._queue._host_close(cancel_pending=True)
+
     def create_threads(self, sess, coord=None, daemon=False, start=False):
         threads = [threading.Thread(target=self._run,
                                     args=(sess, op, coord), daemon=daemon)
                    for op in self._enqueue_ops]
         if coord:
+            # daemon regardless: it parks in wait_for_stop forever when
+            # the coordinator is never stopped; it must not keep the
+            # process alive
+            threads.append(threading.Thread(target=self._close_on_stop,
+                                            args=(coord,), daemon=True))
             for t in threads:
                 coord.register_thread(t)
         if start:

@@ -146,6 +146,50 @@ class TestCoordinator:
         coord.join(threads)
         assert counter["n"] >= 10
 
+    def test_queue_runner_blocked_enqueue_stops_cleanly(self):
+        # a runner blocked on a FULL queue must wake when the coordinator
+        # stops (the reference's close-on-stop cancel path) — previously
+        # it hung past the join grace period and join raised
+        import time
+
+        stf.reset_default_graph()
+        q = stf.FIFOQueue(4, dtypes=[stf.int32], shapes=[[]])
+        enq = q.enqueue([stf.constant(1)])
+        qr = stf.train.QueueRunner(q, [enq])
+        coord = stf.train.Coordinator()
+        with stf.Session() as sess:
+            threads = qr.create_threads(sess, coord=coord, start=True)
+            time.sleep(0.3)  # fills the queue; the runner blocks
+            coord.request_stop()
+            t0 = time.time()
+            coord.join(threads, stop_grace_period_secs=5)
+            assert time.time() - t0 < 3.0
+
+    def test_shuffle_batch_pipeline_throttles(self):
+        # slice_input_producer must return a LIST (ref contract), and a
+        # producer outrunning a slow consumer must BLOCK at capacity,
+        # not crash the coordinator with ResourceExhausted
+        import time
+
+        stf.reset_default_graph()
+        data = stf.constant(np.arange(32, dtype=np.int32))
+        slices = stf.train.slice_input_producer([data], shuffle=False)
+        assert isinstance(slices, list) and len(slices) == 1
+        batch = stf.train.shuffle_batch([slices[0]], batch_size=4,
+                                        capacity=12, min_after_dequeue=4)
+        batch_t = batch[0] if isinstance(batch, list) else batch
+        coord = stf.train.Coordinator()
+        with stf.Session() as sess:
+            threads = stf.train.start_queue_runners(sess=sess,
+                                                    coord=coord)
+            vals = []
+            for _ in range(6):
+                vals.extend(np.asarray(sess.run(batch_t)).tolist())
+                time.sleep(0.05)  # let the producer hit capacity
+            coord.request_stop()
+            coord.join(threads, stop_grace_period_secs=5)
+        assert len(vals) == 24 and len(set(vals)) == 24
+
     def test_coordinator_exception_reraised(self):
         import threading
 
