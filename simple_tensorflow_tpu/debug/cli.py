@@ -42,8 +42,11 @@ class AnalyzerCLI:
     """Command interpreter over a dump dir (+ optional graph for node
     topology commands)."""
 
-    def __init__(self, dump_dir: DebugDumpDir, graph=None):
-        self._dump = dump_dir
+    def __init__(self, dump_dir, graph=None):
+        # accept a path as well as a DebugDumpDir (the CLI main() and
+        # programmatic users otherwise diverge on the entry type)
+        self._dump = (dump_dir if isinstance(dump_dir, DebugDumpDir)
+                      else DebugDumpDir(str(dump_dir)))
         self._graph = graph
 
     # -- helpers -------------------------------------------------------------

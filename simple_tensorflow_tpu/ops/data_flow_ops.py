@@ -200,6 +200,15 @@ class QueueBase:
                     None, None, f"Queue {self._name} closed")
             try:
                 self._q.put(builtins.tuple(items), timeout=0.05)
+                # close-cancel race: the purge in _host_close can free a
+                # slot that lets this blocked put complete AFTER the
+                # cancel — a cancelled queue must end empty, so drain
+                # again and abort (a plain close lets the pending
+                # enqueue complete, ref contract)
+                if getattr(self, "_cancelled", False):
+                    self._host_close(cancel_pending=True)
+                    raise errors.CancelledError(
+                        None, None, f"Queue {self._name} closed")
                 return
             except py_queue.Full:
                 if deadline is not None and _time.time() > deadline:
@@ -226,6 +235,7 @@ class QueueBase:
         if cancel_pending:
             # ref semantics: cancel_pending_enqueues purges queued
             # elements so blocked consumers see closed-and-empty
+            self._cancelled = True
             try:
                 while True:
                     self._q.get_nowait()
@@ -279,10 +289,12 @@ class RandomShuffleQueue(QueueBase):
         # contract.
         deadline = None if timeout is None else _time.time() + timeout
         while True:
-            if self._closed:
-                raise errors.CancelledError(
-                    None, None, f"Queue {self._name} closed")
             with self._lock:
+                # closed check under the SAME lock as the append: the
+                # close-cancel purge cannot interleave between them
+                if self._closed:
+                    raise errors.CancelledError(
+                        None, None, f"Queue {self._name} closed")
                 if len(self._buf) < self._capacity:
                     self._buf.append(builtins.tuple(items))
                     return
@@ -293,9 +305,9 @@ class RandomShuffleQueue(QueueBase):
             _time.sleep(0.01)
 
     def _host_close(self, cancel_pending=False):
-        self._closed = True
-        if cancel_pending:
-            with self._lock:
+        with self._lock:
+            self._closed = True
+            if cancel_pending:
                 self._buf.clear()
 
     def _host_dequeue(self, timeout=30.0):

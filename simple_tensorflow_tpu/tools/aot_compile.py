@@ -76,6 +76,14 @@ def aot_compile(graph_json: str, feed_names: List[str],
                 raise ValueError(
                     f"AOT feed {t.name} has unknown shape {t.shape}; "
                     "XLA AOT needs fully static shapes")
+        undeclared = [op.outputs[0].name for op in pruned
+                      if op.type == "Placeholder"
+                      and op.outputs[0] not in fed_set]
+        if undeclared:
+            raise ValueError(
+                "AOT subgraph reads placeholders that are not declared "
+                f"as feeds: {undeclared} — pass each via --feed NAME "
+                "(tfcompile's feed config plays the same role)")
 
         def fn(*feed_values):
             ctx = lowering_mod.LoweringContext(state={}, rng_root=None)

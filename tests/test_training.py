@@ -173,7 +173,10 @@ class TestCoordinator:
 
         stf.reset_default_graph()
         data = stf.constant(np.arange(32, dtype=np.int32))
-        slices = stf.train.slice_input_producer([data], shuffle=False)
+        # num_epochs=1 so epoch-2 duplicates cannot race into the
+        # shuffle buffer and break the uniqueness assertion
+        slices = stf.train.slice_input_producer([data], shuffle=False,
+                                                num_epochs=1)
         assert isinstance(slices, list) and len(slices) == 1
         batch = stf.train.shuffle_batch([slices[0]], batch_size=4,
                                         capacity=12, min_after_dequeue=4)
