@@ -979,6 +979,14 @@ COVERED_ELSEWHERE.update({
     "TensorArrayRead": ("test_framework_extras.py", "tensor_array"),
     "TensorArrayScatter": ("test_framework_extras.py", "tensor_array"),
     "TensorArrayWrite": ("test_framework_extras.py", "tensor_array"),
+    "AccumulatorApplyGradient": ("test_data_flow_structures.py",
+                                 "TestConditionalAccumulator"),
+    "AccumulatorNumAccumulated": ("test_data_flow_structures.py",
+                                  "TestConditionalAccumulator"),
+    "AccumulatorSetGlobalStep": ("test_data_flow_structures.py",
+                                 "TestConditionalAccumulator"),
+    "AccumulatorTakeGradient": ("test_data_flow_structures.py",
+                                "TestConditionalAccumulator"),
     "SparseAccumulatorApplyGradient": ("test_data_flow_structures.py",
                                        "accumulator"),
     "SparseAccumulatorNumAccumulated": ("test_data_flow_structures.py",
