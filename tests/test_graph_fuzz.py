@@ -229,3 +229,49 @@ def test_random_graph_matches_numpy(seed):
             np.testing.assert_allclose(g_sym.ravel()[comparable],
                                        g_num[comparable], rtol=5e-3,
                                        atol=5e-3)
+            # optimizer wiring through the same random graph: one SGD
+            # step must land exactly at val - lr * grad_sym
+            lr = 0.1
+            train = stf.train.GradientDescentOptimizer(lr).minimize(
+                yv, var_list=[v])
+            sess.run(train, feed_dict=feed)
+            got_after = np.asarray(sess.run(v.value(),
+                                            feed_dict=feed),
+                                   dtype=np.float64)
+            want_after = val.astype(np.float64) - lr * g_sym
+            # minimize() recompiles the gradient under its own fetch
+            # signature; f32 reduction reordering between the two plans
+            # means the file's gradient tolerance applies, not exactness
+            np.testing.assert_allclose(got_after, want_after,
+                                       rtol=5e-3, atol=5e-3)
+
+
+@pytest.mark.parametrize("seed", range(0, N_GRAPHS, 5))
+def test_interleaved_fetch_subsets_share_one_graph(seed):
+    """Plan-cache correctness: different (fetches, feeds) signatures on
+    ONE session must not contaminate each other — interleave several
+    fetch subsets twice and require identical values both rounds."""
+    rng = np.random.RandomState(2000 + seed)
+    stf.reset_default_graph()
+    pool, feed, var_leaves = _build_random_graph(rng)
+    subsets = []
+    for _ in range(3):
+        idx = sorted(rng.choice(len(pool), size=min(3, len(pool)),
+                                replace=False))
+        subsets.append([pool[i] for i in idx])
+    with stf.Session() as sess:
+        if var_leaves:
+            sess.run(stf.global_variables_initializer())
+        rounds = []
+        for _round in range(2):
+            vals = []
+            for sub in subsets:
+                got = sess.run([t for t, _w in sub], feed_dict=feed)
+                vals.append([np.asarray(g) for g in got])
+            rounds.append(vals)
+        for sub, got in zip(subsets, rounds[0]):
+            for (t, want), g in zip(sub, got):
+                np.testing.assert_allclose(g, want, rtol=2e-5, atol=2e-5)
+        for a, b in zip(rounds[0], rounds[1]):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
