@@ -348,3 +348,60 @@ def suggest_remat(forward_activation_bytes: float,
         # deeply bandwidth-bound: trade FLOPs for bytes
         return intensity < 0.25 * balance
     return False
+
+
+def transformer_activation_bytes(batch, seq_len, hidden, n_layers,
+                                 dtype_bytes=2):
+    """Order-of-magnitude forward-residual footprint of a transformer
+    encoder stack: per layer, the backward consumes roughly qkv (3BSH) +
+    attention out (BSH) + mlp hidden (4BSH) + mlp out (BSH) + two
+    norms/residual reads (~4BSH) ~= 13 BSH."""
+    return 13.0 * batch * seq_len * hidden * n_layers * dtype_bytes
+
+
+def transformer_forward_flops(batch, seq_len, hidden, n_layers,
+                              d_ff=None):
+    """Order-of-magnitude forward FLOPs of a transformer stack (for the
+    remat intensity heuristic, not the MFU accounting): per layer,
+    qkv/out projections (2*4H^2 per token), the mlp (2*2*H*d_ff), and
+    the S-dependent attention matmuls (2*2*S*H)."""
+    d_ff = d_ff if d_ff is not None else 4 * hidden
+    per_token = 2.0 * (4 * hidden * hidden + 2 * hidden * d_ff
+                       + 2 * seq_len * hidden)
+    return batch * seq_len * n_layers * per_token
+
+
+def mesh_shard_factor(axes):
+    """Product of the active mesh's sizes along ``axes`` (1 when no mesh
+    or the axis is absent) — divides a GLOBAL activation estimate down
+    to per-chip before comparing against one chip's HBM."""
+    from ..parallel import mesh as mesh_mod
+
+    m = mesh_mod.current_mesh()
+    if m is None:
+        return 1
+    n = 1
+    for ax in axes:
+        if ax and ax in m.axis_names:
+            n *= m.axis_size(ax)
+    return n
+
+
+def resolve_recompute(recompute, forward_activation_bytes,
+                      forward_flops=0.0, device=None):
+    """Resolve a model's ``recompute`` flag: ``"auto"`` asks
+    ``suggest_remat`` against the ATTACHED chip's HBM capacity and
+    balance point (the grappler memory-optimizer role, decided from the
+    static estimate instead of a post-hoc OOM); True/False pass
+    through. ``forward_activation_bytes`` must be PER-CHIP (divide a
+    global estimate by ``mesh_shard_factor`` over the sharded axes)."""
+    if recompute != "auto":
+        return bool(recompute)
+    from ..utils import perf
+
+    peak_flops, peak_bw = perf.chip_spec(device)
+    hbm = perf.chip_hbm_bytes(device)
+    # params + optimizer state + workspace share the budget; activations
+    # may claim roughly half of HBM before remat becomes the default
+    return suggest_remat(forward_activation_bytes, 0.5 * hbm,
+                         forward_flops, peak_flops, peak_bw)

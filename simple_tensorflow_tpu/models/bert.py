@@ -118,9 +118,24 @@ def bert_encoder(input_ids, token_type_ids, input_mask, cfg,
     recompute=True rematerializes each transformer block's activations in
     the backward pass (stf.recompute_grad / jax.checkpoint): residuals
     shrink from every per-layer intermediate to one [B,S,H] tensor per
-    layer, trading ~1.33x FLOPs for the HBM that buys a bigger batch."""
+    layer, trading ~1.33x FLOPs for the HBM that buys a bigger batch.
+    recompute="auto" decides from the static activation estimate vs the
+    attached chip's HBM (framework/cost_model.py resolve_recompute —
+    the grappler memory-optimizer role)."""
     b = int(input_ids.shape[0])
     s = int(input_ids.shape[1])
+    from ..framework import cost_model as _cm
+
+    # per-chip estimate: a dp mesh shards the batch across chips
+    _shards = _cm.mesh_shard_factor(["dp"])
+    recompute = _cm.resolve_recompute(
+        recompute,
+        _cm.transformer_activation_bytes(
+            b, s, cfg.hidden_size, cfg.num_layers,
+            dtype_bytes=compute_dtype.size) / _shards,
+        forward_flops=_cm.transformer_forward_flops(
+            b, s, cfg.hidden_size, cfg.num_layers,
+            d_ff=cfg.intermediate_size) / _shards)
     with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
         with stf.variable_scope("embeddings"):
             word_emb = stf.get_variable(

@@ -138,8 +138,23 @@ def lm_train_model(batch_size=1, seq_len=32768,
                    cfg: LongContextConfig | None = None,
                    learning_rate=3e-4, compute_dtype=stf.bfloat16,
                    sp_axis="sp", recompute=False):
-    """Next-token LM training graph; shard seq over 'sp', batch over 'dp'."""
+    """Next-token LM training graph; shard seq over 'sp', batch over 'dp'.
+    recompute="auto" resolves against the attached chip's HBM via the
+    static cost model (framework/cost_model.py resolve_recompute)."""
     cfg = cfg or LongContextConfig()
+    from ..framework import cost_model as _cm
+
+    # per-chip estimate: batch shards over dp, SEQUENCE over sp (ring
+    # attention) — both divide the per-chip activation footprint
+    _shards = _cm.mesh_shard_factor(["dp", sp_axis])
+    recompute = _cm.resolve_recompute(
+        recompute,
+        _cm.transformer_activation_bytes(
+            batch_size, seq_len, cfg.d_model, cfg.num_layers,
+            dtype_bytes=compute_dtype.size) / _shards,
+        forward_flops=_cm.transformer_forward_flops(
+            batch_size, seq_len, cfg.d_model, cfg.num_layers,
+            d_ff=cfg.d_ff) / _shards)
     ids = stf.placeholder(stf.int32, [batch_size, seq_len], "input_ids")
     targets = stf.placeholder(stf.int32, [batch_size, seq_len], "targets")
     mesh = parallel.current_mesh()

@@ -217,8 +217,30 @@ def transformer_train_model(batch_size=64, src_len=64, tgt_len=64,
                             learning_rate=1.0, warmup_steps=4000,
                             compute_dtype=stf.bfloat16, data_parallel=False,
                             recompute=False):
-    """Training graph: src/tgt -> label-smoothed loss -> Adam + noam decay."""
+    """Training graph: src/tgt -> label-smoothed loss -> Adam + noam decay.
+    recompute="auto" resolves against the attached chip's HBM via the
+    static cost model (framework/cost_model.py resolve_recompute)."""
     cfg = cfg or TransformerConfig.big()
+    from ..framework import cost_model as _cm
+
+    # encoder layers see src_len, decoder layers tgt_len (cross-attn
+    # keys add a little on top; the heuristic ignores it); per-chip
+    # under a dp mesh
+    _shards = _cm.mesh_shard_factor(["dp"] if data_parallel else [])
+    _act = (_cm.transformer_activation_bytes(
+                batch_size, src_len, cfg.d_model, cfg.num_layers,
+                dtype_bytes=compute_dtype.size)
+            + _cm.transformer_activation_bytes(
+                batch_size, tgt_len, cfg.d_model, cfg.num_layers,
+                dtype_bytes=compute_dtype.size))
+    _flops = (_cm.transformer_forward_flops(
+                  batch_size, src_len, cfg.d_model, cfg.num_layers,
+                  d_ff=cfg.d_ff)
+              + _cm.transformer_forward_flops(
+                  batch_size, tgt_len, cfg.d_model, cfg.num_layers,
+                  d_ff=cfg.d_ff))
+    recompute = _cm.resolve_recompute(recompute, _act / _shards,
+                                      forward_flops=_flops / _shards)
     src = stf.placeholder(stf.int32, [batch_size, src_len], "src_ids")
     tgt_in = stf.placeholder(stf.int32, [batch_size, tgt_len], "tgt_in")
     tgt_out = stf.placeholder(stf.int32, [batch_size, tgt_len], "tgt_out")

@@ -29,6 +29,16 @@ _CHIP_SPECS = {
 }
 _DEFAULT_SPEC = (197e12, 819e9)
 
+_CHIP_HBM_BYTES = {
+    "v5e": 16e9,
+    "v5 lite": 16e9,
+    "v5p": 95e9,
+    "v4": 32e9,
+    "v3": 32e9,
+    "v2": 16e9,
+}
+_DEFAULT_HBM = 16e9
+
 
 def chip_spec(device=None):
     """(peak_flops, peak_hbm_bw) for the attached device."""
@@ -42,6 +52,21 @@ def chip_spec(device=None):
     if d.platform == "cpu":
         return (1e12, 100e9)  # nominal, for CI math
     return _DEFAULT_SPEC
+
+
+def chip_hbm_bytes(device=None):
+    """Per-chip HBM capacity for the attached device (memory-planning
+    inputs: remat decisions, pipeline microbatch sizing)."""
+    import jax
+
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    for key, size in _CHIP_HBM_BYTES.items():
+        if key in kind:
+            return size
+    if d.platform == "cpu":
+        return 4e9  # nominal, for CI math
+    return _DEFAULT_HBM
 
 
 class StepTimer:

@@ -177,6 +177,49 @@ def test_suggest_remat():
         1e9, 16e9, forward_flops=1e12, peak_flops=197e12, peak_bw=819e9)
 
 
+def test_resolve_recompute_auto():
+    class _V5e:  # explicit stub: independent of the attached backend
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    # pass-through for booleans
+    assert cost_model.resolve_recompute(True, 0.0) is True
+    assert cost_model.resolve_recompute(False, 1e30) is False
+    # v5e: 16 GB HBM, activations may claim half -> 0.7*8 GB trigger
+    assert cost_model.resolve_recompute("auto", 7e9, device=_V5e()) \
+        is True
+    # small + compute-bound: no remat
+    assert cost_model.resolve_recompute(
+        "auto", 1e6, forward_flops=1e12, device=_V5e()) is False
+    # bandwidth-bound (intensity far below the balance point): remat
+    assert cost_model.resolve_recompute(
+        "auto", 1e9, forward_flops=10e9, device=_V5e()) is True
+    # the transformer estimate scales linearly in every factor
+    small = cost_model.transformer_activation_bytes(8, 128, 256, 2)
+    assert cost_model.transformer_activation_bytes(16, 128, 256, 2) == \
+        2 * small
+    # no mesh active -> shard factor 1
+    assert cost_model.mesh_shard_factor(["dp", "sp"]) == 1
+
+
+def test_bert_accepts_recompute_auto():
+    # "auto" must resolve to a bool BEFORE reaching maybe_recompute (a
+    # truthy string would silently force remat on) and the graph builds
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.models import bert
+
+    stf.reset_default_graph()
+    cfg = bert.BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=16)
+    ids = stf.constant(np.zeros((2, 8), np.int32))
+    seq, pooled, emb = bert.bert_encoder(
+        ids, stf.constant(np.zeros((2, 8), np.int32)),
+        stf.constant(np.ones((2, 8), np.int32)), cfg,
+        training=False, recompute="auto")
+    assert tuple(int(d) for d in seq.shape) == (2, 8, 32)
+
+
 def test_pipeline_auto_microbatches_runs():
     import jax
 
