@@ -371,6 +371,20 @@ def transformer_forward_flops(batch, seq_len, hidden, n_layers,
     return batch * seq_len * n_layers * per_token
 
 
+def resnet_activation_bytes(batch, image_size, dtype_bytes=2, depth=50):
+    """Order-of-magnitude forward-residual footprint of a ResNet-v1.5:
+    per stage, blocks save ~3 conv outputs + BN/relu reads (~5x the
+    stage's B*H*W*C feature map per block)."""
+    stages = [(image_size // 4, 256, 3), (image_size // 8, 512, 4),
+              (image_size // 16, 1024, 6), (image_size // 32, 2048, 3)]
+    if depth >= 101:
+        stages[2] = (image_size // 16, 1024, 23)
+    total = 0.0
+    for hw, c, blocks in stages:
+        total += 5.0 * blocks * batch * hw * hw * c
+    return total * dtype_bytes
+
+
 def mesh_shard_factor(axes):
     """Product of the active mesh's sizes along ``axes`` (1 when no mesh
     or the axis is absent) — divides a GLOBAL activation estimate down

@@ -124,18 +124,22 @@ def bert_encoder(input_ids, token_type_ids, input_mask, cfg,
     the grappler memory-optimizer role)."""
     b = int(input_ids.shape[0])
     s = int(input_ids.shape[1])
-    from ..framework import cost_model as _cm
+    if recompute == "auto":
+        # bert_encoder cannot know whether b is a per-chip or a global
+        # batch (that's the CALLER's data_parallel decision — see
+        # bert_pretrain_model, which resolves "auto" with the mesh
+        # divisor before calling here), so the raw estimate treats b as
+        # per-chip. No remat without a backward pass.
+        from ..framework import cost_model as _cm
 
-    # per-chip estimate: a dp mesh shards the batch across chips
-    _shards = _cm.mesh_shard_factor(["dp"])
-    recompute = _cm.resolve_recompute(
-        recompute,
-        _cm.transformer_activation_bytes(
-            b, s, cfg.hidden_size, cfg.num_layers,
-            dtype_bytes=compute_dtype.size) / _shards,
-        forward_flops=_cm.transformer_forward_flops(
-            b, s, cfg.hidden_size, cfg.num_layers,
-            d_ff=cfg.intermediate_size) / _shards)
+        recompute = training and _cm.resolve_recompute(
+            "auto",
+            _cm.transformer_activation_bytes(
+                b, s, cfg.hidden_size, cfg.num_layers,
+                dtype_bytes=compute_dtype.size),
+            forward_flops=_cm.transformer_forward_flops(
+                b, s, cfg.hidden_size, cfg.num_layers,
+                d_ff=cfg.intermediate_size))
     with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
         with stf.variable_scope("embeddings"):
             word_emb = stf.get_variable(
@@ -231,8 +235,23 @@ def bert_pretrain_model(batch_size=32, seq_len=128, max_predictions=20,
                         cfg: BertConfig | None = None, learning_rate=1e-4,
                         compute_dtype=stf.bfloat16, use_input_mask=False,
                         data_parallel=False, recompute=False):
-    """Full MLM+NSP pretraining graph (ref BERT pretraining recipe)."""
+    """Full MLM+NSP pretraining graph (ref BERT pretraining recipe).
+    recompute="auto" resolves here (where data_parallel is known) from
+    the PER-CHIP activation estimate — global divided by the dp mesh
+    size when the batch is dp-sharded."""
     cfg = cfg or BertConfig.base()
+    if recompute == "auto":
+        from ..framework import cost_model as _cm
+
+        _shards = _cm.mesh_shard_factor(["dp"] if data_parallel else [])
+        recompute = _cm.resolve_recompute(
+            "auto",
+            _cm.transformer_activation_bytes(
+                batch_size, seq_len, cfg.hidden_size, cfg.num_layers,
+                dtype_bytes=compute_dtype.size) / _shards,
+            forward_flops=_cm.transformer_forward_flops(
+                batch_size, seq_len, cfg.hidden_size, cfg.num_layers,
+                d_ff=cfg.intermediate_size) / _shards)
     input_ids = stf.placeholder(stf.int32, [batch_size, seq_len], "input_ids")
     token_type = stf.placeholder(stf.int32, [batch_size, seq_len],
                                  "token_type_ids")

@@ -129,6 +129,17 @@ def resnet50_train_model(batch_size=64, image_size=224, num_classes=1000,
     x = stf.placeholder(dtype, [batch_size, image_size, image_size, 3],
                         name="images")
     labels = stf.placeholder(stf.int32, [batch_size], name="labels")
+    from ..framework import cost_model as _cm
+
+    # recompute="auto": static per-chip activation estimate vs the
+    # attached chip (framework/cost_model.py)
+    _shards = _cm.mesh_shard_factor(["dp"] if data_parallel else [])
+    recompute = _cm.resolve_recompute(
+        recompute,
+        _cm.resnet_activation_bytes(batch_size, image_size,
+                                    dtype_bytes=dtype.size) / _shards,
+        forward_flops=resnet_flops_per_image(50, image_size)
+        * batch_size / _shards)
     if data_parallel:
         from simple_tensorflow_tpu import parallel
 

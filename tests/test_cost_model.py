@@ -202,6 +202,22 @@ def test_resolve_recompute_auto():
     assert cost_model.mesh_shard_factor(["dp", "sp"]) == 1
 
 
+def test_resnet_auto_remat_decision():
+    class _V5e:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    # bench config b256/224 bf16: ~14 GB of saved block activations on a
+    # 16 GB chip -> remat (consistent with the r3 on-chip diagnosis)
+    act = cost_model.resnet_activation_bytes(256, 224, dtype_bytes=2)
+    assert act > 10e9
+    assert cost_model.resolve_recompute("auto", act, device=_V5e())
+    # tiny config fits with headroom and is compute-dense -> no remat
+    tiny = cost_model.resnet_activation_bytes(8, 32, dtype_bytes=2)
+    assert not cost_model.resolve_recompute(
+        "auto", tiny, forward_flops=6.7e8, device=_V5e())
+
+
 def test_bert_accepts_recompute_auto():
     # "auto" must resolve to a bool BEFORE reaching maybe_recompute (a
     # truthy string would silently force remat on) and the graph builds
