@@ -246,6 +246,39 @@ def test_random_graph_matches_numpy(seed):
                                        rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.parametrize("seed", range(1, N_GRAPHS, 4))
+def test_random_graph_survives_graphdef_roundtrip(seed):
+    """Serialization fuzz: export the random DAG to GraphDef-JSON,
+    import into a FRESH graph, and require identical fetch values —
+    the path MetaGraph/SavedModel depend on, over the full fuzz
+    vocabulary (incl. cond FuncGraphs and shape-materialized consts)."""
+    rng = np.random.RandomState(1000 + seed)  # same graphs as the main fuzz
+    stf.reset_default_graph()
+    pool, feed, var_leaves = _build_random_graph(rng)
+    targets = [(t, w) for t, w in pool[-4:]]
+    gd = stf.get_default_graph().as_graph_def()
+    feed_by_name = {t.name: v for t, v in feed.items()}
+
+    stf.reset_default_graph()
+    names = [t.name for t, _w in targets]
+    outs = stf.import_graph_def(gd, return_elements=names, name="")
+    with stf.Session() as sess:
+        # variable leaves re-initialize from their serialized
+        # initial-value consts — same values, no checkpoint needed.
+        # import_graph_def rebuilds raw ops (not Variable wrappers), so
+        # run the initializer Assign ops directly instead of
+        # global_variables_initializer (import_meta_graph is the path
+        # that restores collections; the saver tests own it).
+        init_ops = [op for op in stf.get_default_graph().get_operations()
+                    if op.type == "Assign"]
+        if var_leaves:
+            sess.run(stf.group(*init_ops))
+        got = sess.run(outs, feed_dict=feed_by_name)
+    for (t, want), g in zip(targets, got):
+        np.testing.assert_allclose(np.asarray(g), want, rtol=2e-5,
+                                   atol=2e-5)
+
+
 @pytest.mark.parametrize("seed", range(0, N_GRAPHS, 5))
 def test_interleaved_fetch_subsets_share_one_graph(seed):
     """Plan-cache correctness: different (fetches, feeds) signatures on
