@@ -607,9 +607,7 @@ class BaseSession:
                 if flags_np.any():
                     bad = [m for m, f in zip(step.check_msgs, flags_np) if f]
                     raise errors.InvalidArgumentError(
-                        None, None,
-                        "CheckNumerics failed — tensor had NaN/Inf values: "
-                        + "; ".join(bad))
+                        None, None, "; ".join(bad))
             self._variable_store.values = dict(new_state)
             self._apply_declared_shardings(new_state.keys())
             device_results = list(fetch_vals)
@@ -966,11 +964,14 @@ class BaseSession:
             return fetch_vals, ctx.state, flags
 
         # Donation deletes the pre-step variable buffers. When the step
-        # contains CheckNumerics, a failed check must leave the OLD state
-        # intact (ref semantics: downstream ops never run), so donation is
-        # disabled for those steps — otherwise a check failure would brick
-        # the session with deleted arrays.
-        has_checks = any(op.type == "CheckNumerics" for op in device_ops)
+        # contains CheckNumerics or Assert (both ride the flag channel:
+        # the Session raises BEFORE committing state), a failed check
+        # must leave the OLD state intact (ref semantics: downstream ops
+        # never run), so donation is disabled for those steps —
+        # otherwise a check failure would brick the session with
+        # deleted arrays.
+        has_checks = any(op.type in ("CheckNumerics", "Assert")
+                         for op in device_ops)
         step.jitted = jax.jit(step_fn,
                               donate_argnums=() if has_checks else (0,))
         step.check_msgs = check_msgs
@@ -1124,9 +1125,7 @@ class BaseSession:
                 if flags_np.any():
                     bad = [m for m, f in zip(step.check_msgs, flags_np) if f]
                     raise errors.InvalidArgumentError(
-                        None, None,
-                        "CheckNumerics failed — tensor had NaN/Inf values: "
-                        + "; ".join(bad))
+                        None, None, "; ".join(bad))
             self._variable_store.values = dict(new_state)
             self._apply_declared_shardings(new_state.keys())
             step.n_calls += 1

@@ -204,7 +204,9 @@ def _check_numerics_impl(ctx, op, x):
     if ctx.in_control_flow or ctx.in_shard_map:
         return x
     flag = jnp.logical_not(jnp.all(jnp.isfinite(x)))
-    ctx.numeric_checks.append((f"{op.name}: {message}", flag))
+    ctx.numeric_checks.append(
+        (f"CheckNumerics {op.name}: {message}: Tensor had NaN/Inf "
+         "values", flag))
     return x
 
 

@@ -1,13 +1,19 @@
 """Logging/assert ops (ref: tensorflow/python/ops/logging_ops.py,
 core/kernels/logging_ops.cc).
 
-Print lowers to jax.debug.print (works inside the compiled XLA program via
-host callback); Assert to jax.debug — on TPU a failing in-graph assert
-cannot abort the step the way the reference's CPU kernel can, so the message
-is printed and the Session's debug hooks (stf.debug) provide hard checking.
-"""
+Print lowers to jax.debug.print (works inside the compiled XLA program
+via host callback). Assert rides the CheckNumerics flag channel: the
+condition evaluates in the compiled step, the flag is fetched with the
+results, and the Session raises a typed InvalidArgumentError host-side
+BEFORE committing variable updates (ref semantics: ops downstream of a
+failed assert never take effect). Inside lax control flow / shard_map a
+flag cannot escape the trace, so there a failing assert raises through
+the jax callback (surfaces as JaxRuntimeError — catch Exception around
+the run call in that case)."""
 
 from __future__ import annotations
+
+import numpy as np
 
 from ..framework import graph as ops_mod
 from ..framework import op_registry
@@ -30,31 +36,55 @@ def _lower_print(ctx, op, inputs):
 op_registry.register("Print", lower=_lower_print, is_stateful=True)
 
 
-def _lower_assert(ctx, op, inputs):
+def _lower_assert_checked(ctx, op, inputs):
+    """Assert rides the CheckNumerics flag channel: the condition is
+    evaluated in the compiled step (fused with its producers), the flag
+    is fetched with the results, and the SESSION raises a typed
+    InvalidArgumentError host-side before committing state — a raise
+    from inside a jax callback would surface as an opaque
+    JaxRuntimeError that ``except stf.errors.InvalidArgumentError``
+    cannot catch. A debug callback still prints the data tensors'
+    runtime values on failure (the reference kernel's summarize role)."""
     import jax
     import jax.numpy as jnp
 
     cond = inputs[0]
-    jax.debug.print("stf.Assert failed: {} (condition={})",
-                    op.attrs.get("message", ""), cond)
-    return []
-
-
-def _lower_assert_checked(ctx, op, inputs):
-    import jax
-
-    cond = inputs[0]
     data = inputs[1:]
+    summarize = op.attrs.get("summarize") or 3
+    message = op.attrs.get("message", "")
 
-    def _cb(c, *d):
-        import numpy as np
+    def _format(d_vals):
+        vals = " ".join(str(np.asarray(x).ravel()[:summarize])
+                        for x in d_vals)
+        head = f"assertion failed ({op.name})"
+        if message:
+            head += f": {message}"
+        return f"{head}: {vals}" if vals else head
 
-        if not np.all(np.asarray(c)):
+    if ctx.host:
+        if not np.all(np.asarray(cond)):
             from ..framework import errors
 
-            raise errors.InvalidArgumentError(
-                None, None, "assertion failed: " +
-                " ".join(str(np.asarray(x)) for x in d))
+            raise errors.InvalidArgumentError(None, op, _format(data))
+        return []
+    if ctx.in_control_flow or ctx.in_shard_map:
+        # a flag cannot escape a lax trace: raise from the callback
+        # (surfaces as JaxRuntimeError; see module docstring)
+        def _cb_raise(c, *d):
+            if not np.all(np.asarray(c)):
+                from ..framework import errors
+
+                raise errors.InvalidArgumentError(None, None, _format(d))
+
+        jax.debug.callback(_cb_raise, cond, *data)
+        return []
+    flag = jnp.logical_not(jnp.all(cond))
+    ctx.numeric_checks.append(
+        (_format(()) + " — data values in the printed line above", flag))
+
+    def _cb(c, *d):
+        if not np.all(np.asarray(c)):
+            print("stf.Assert " + _format(d), flush=True)
 
     jax.debug.callback(_cb, cond, *data)
     return []

@@ -121,6 +121,36 @@ def test_gradients_through_variable_reads():
         np.testing.assert_allclose(sess.run(gm), [3.0, 5.0])
 
 
+def test_assert_raises_typed_error_and_preserves_state():
+    # Assert rides the CheckNumerics flag channel: a failure raises
+    # InvalidArgumentError (catchable by type, not an opaque
+    # JaxRuntimeError from inside a jax callback) BEFORE the step's
+    # variable updates commit; the pass path commits normally.
+    with stf.Session() as sess:
+        with stf.get_default_graph().control_dependencies(
+                [stf.assert_positive(stf.constant([-1.0]),
+                                     message="must be positive")]):
+            out = stf.identity(stf.constant(1.0))
+        # the user's message= must appear in the typed error
+        with pytest.raises(stf.errors.InvalidArgumentError,
+                           match="must be positive"):
+            sess.run(out)
+
+        v = stf.Variable(1.0, name="assert_v")
+        sess.run(stf.global_variables_initializer())
+        bad = stf.assert_positive(stf.constant([-1.0]))
+        with stf.get_default_graph().control_dependencies([bad]):
+            upd = stf.assign_add(v, 1.0)
+        with pytest.raises(stf.errors.InvalidArgumentError):
+            sess.run(upd)
+        assert float(np.asarray(sess.run(v))) == 1.0  # no commit
+        good = stf.assert_positive(stf.constant([5.0]))
+        with stf.get_default_graph().control_dependencies([good]):
+            upd2 = stf.assign_add(v, 1.0)
+        sess.run(upd2)
+        assert float(np.asarray(sess.run(v))) == 2.0
+
+
 def test_feed_sparse_tensor_value():
     # TF-1 contract: feed_dict={sparse_tensor: SparseTensorValue} expands
     # into the component tensors; fetching the SparseTensor returns a
