@@ -65,7 +65,7 @@ def _build_random_graph(rng):
                          "div", "relu", "tanh", "sigmoid", "exp", "neg",
                          "abs", "transpose", "matmul", "concat",
                          "reduce_sum", "reduce_max", "slice", "where",
-                         "cond", "shape_size", "dup", "dead"])
+                         "cond", "while", "shape_size", "dup", "dead"])
         (x, xv) = pick()
         if op in ("add", "mul", "sub", "maximum", "minimum", "div"):
             (y, yv) = pick()
@@ -115,12 +115,28 @@ def _build_random_graph(rng):
                 pool.append((stf.where(stf.greater(x, 0.0), x, y),
                              np.where(xv > 0.0, xv, yv)))
         elif op == "cond":
-            # data-dependent branch on a reduced scalar -> lax.cond
+            # data-dependent branch on a reduced scalar -> lax.cond.
+            # Skip near-zero sums: the graph reduces in f32, the mirror
+            # in float64 — a tie would flip the branch between them.
+            if abs(float(xv.astype(np.float64).sum())) < 1e-3:
+                continue
             pred_t = stf.greater(stf.reduce_sum(x), 0.0)
             pred_v = xv.sum() > 0.0
             out_t = stf.cond(pred_t, lambda: stf.tanh(x),
                              lambda: stf.negative(x))
             pool.append((out_t, np.tanh(xv) if pred_v else -xv))
+        elif op == "while":
+            # bounded while -> lax.while_loop forward, masked-scan
+            # gradient replay (the differentiable bounded-loop path)
+            k = int(rng.randint(1, 4))
+            _, out_t = stf.while_loop(
+                lambda i, a: stf.less(i, k),
+                lambda i, a: (i + 1, stf.tanh(a) * 1.1),
+                [stf.constant(0), x], maximum_iterations=k + 2)
+            wv = xv
+            for _ in range(k):
+                wv = np.tanh(wv) * 1.1
+            pool.append((out_t, wv))
         elif op == "transpose" and xv.ndim == 2:
             pool.append((stf.transpose(x), xv.T))
         elif op == "matmul" and xv.ndim == 2:
