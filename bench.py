@@ -868,14 +868,29 @@ def main():
     zeroed fallback."""
     emitted = set()
     results = []
+    # BENCH_MODELS: comma list to restrict (e.g. "resnet,bert" for a
+    # quick headline pass when chip time is scarce); resolved BEFORE the
+    # try so the crash-path fallback only covers selected models, with
+    # tokens stripped and validated (a typo must not zero the run)
+    selected = []
+    for tok in os.environ.get(
+            "BENCH_MODELS",
+            "resnet,bert,transformer,mnist,resnet_dp").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok not in _METRIC_NAMES:
+            print(f"BENCH_MODELS: unknown model {tok!r}; choices: "
+                  f"{sorted(_METRIC_NAMES)}", file=sys.stderr)
+            continue
+        selected.append(tok)
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))
         errors = []
         if platform is None or platform == "cpu":
             errors.append("tpu_unavailable")
-        for model in ("resnet", "bert", "transformer", "mnist",
-                      "resnet_dp"):
+        for model in selected:
             result = _run_model(model, platform, kind, list(errors))
             emit(result)
             emitted.add(model)
@@ -883,8 +898,7 @@ def main():
         return results
     except BaseException as e:  # noqa: BLE001 — JSON line on every path
         traceback.print_exc(file=sys.stderr)
-        for model in ("resnet", "bert", "transformer", "mnist",
-                      "resnet_dp"):
+        for model in selected:
             if model in emitted:
                 continue
             name, unit = _METRIC_NAMES[model]
