@@ -884,6 +884,13 @@ def main():
                   f"{sorted(_METRIC_NAMES)}", file=sys.stderr)
             continue
         selected.append(tok)
+    if not selected:
+        # an empty/typo'd selection must not produce a silent zero-line
+        # "success" — fall back to the full set
+        print("BENCH_MODELS selected nothing; running the default set",
+              file=sys.stderr)
+        selected = ["resnet", "bert", "transformer", "mnist",
+                    "resnet_dp"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

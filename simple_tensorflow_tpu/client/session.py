@@ -532,7 +532,9 @@ class BaseSession:
         first_call = step is None
         if step is None:
             step = self._plan(elements, feeds)
-            self._cache[key] = step
+            # concurrent first calls may both compile; the first insert
+            # wins and the others adopt it (n_calls stays coherent)
+            step = self._cache.setdefault(key, step)
         if collector is not None and first_call:
             collector["events"].append(
                 ("plan", plan_t0, time.perf_counter() - plan_t0, 0))
@@ -558,60 +560,68 @@ class BaseSession:
         device_results: List[Any] = []
         new_state = None
         if step.has_device_stage:
-            rng_key, rng_ctr = self._rng_args()
-            guard_on = (self._config is not None and
-                        getattr(self._config, "transfer_guard", "allow")
-                        != "allow" and step.n_calls >= 2)
-            if guard_on:
-                # guards run BEFORE execution so a "disallow" raise can
-                # never land after the variable updates commit. Feeds: a
-                # big host-numpy feed is an H2D transfer EVERY step.
-                # Fetches: sizes precomputed from static shapes at plan
-                # time (dynamic-shaped fetches are unguarded by design).
+            # TF-1 sessions are thread-safe: concurrent run() calls
+            # serialize their DEVICE stage (execute + state commit) —
+            # unsynchronized, two steps would read the same donated
+            # state (deleted-buffer errors) and the later commit
+            # would silently drop the earlier update. Host stages
+            # stay concurrent: a blocked queue dequeue must not
+            # deadlock the producer thread that would fill it.
+            with self._lock:
+                rng_key, rng_ctr = self._rng_args()
+                guard_on = (self._config is not None and
+                            getattr(self._config, "transfer_guard", "allow")
+                            != "allow" and step.n_calls >= 2)
+                if guard_on:
+                    # guards run BEFORE execution so a "disallow" raise can
+                    # never land after the variable updates commit. Feeds: a
+                    # big host-numpy feed is an H2D transfer EVERY step.
+                    # Fetches: sizes precomputed from static shapes at plan
+                    # time (dynamic-shaped fetches are unguarded by design).
+                    for t in step.feed_tensors:
+                        val = feeds[t] if t in feeds else host_env[t]
+                        if isinstance(val, np.ndarray):
+                            self._transfer_guard(t.name, val.nbytes, "feed")
+                    for name, nbytes in step.fetch_nbytes:
+                        self._transfer_guard(name, nbytes, "fetch")
+                feed_args = {}
                 for t in step.feed_tensors:
                     val = feeds[t] if t in feeds else host_env[t]
-                    if isinstance(val, np.ndarray):
-                        self._transfer_guard(t.name, val.nbytes, "feed")
-                for name, nbytes in step.fetch_nbytes:
-                    self._transfer_guard(name, nbytes, "fetch")
-            feed_args = {}
-            for t in step.feed_tensors:
-                val = feeds[t] if t in feeds else host_env[t]
-                feed_args[t.name] = self._maybe_shard_feed(t, val)
-            state = self._variable_store.values
-            d_t0 = time.perf_counter()
-            fetch_vals, new_state, check_flags = step.jitted(
-                dict(state), feed_args, rng_key, rng_ctr)
-            if collector is not None:
-                import jax
+                    feed_args[t.name] = self._maybe_shard_feed(t, val)
+                state = self._variable_store.values
+                d_t0 = time.perf_counter()
+                fetch_vals, new_state, check_flags = step.jitted(
+                    dict(state), feed_args, rng_key, rng_ctr)
+                if collector is not None:
+                    import jax
 
-                # block so the recorded duration covers device execution,
-                # not just async dispatch
-                jax.block_until_ready(fetch_vals)
-                d_dur = time.perf_counter() - d_t0
-                name = ("device_program_compile+run" if step.n_calls == 0
-                        else "device_program")
-                collector["events"].append((name, d_t0, d_dur, 2))
-                if step.n_calls == 0:
-                    collector["compile_time_s"] = d_dur
-                collector["n_device_ops"] = len(step.device_ops)
-                collector["fetch_bytes"] = int(sum(
-                    getattr(v, "nbytes", 0) for v in fetch_vals))
-            if check_flags:
-                # inspect BEFORE committing state: a failed check must not
-                # apply NaN-contaminated updates (ref semantics: ops
-                # downstream of a failed CheckNumerics never run)
-                import jax
+                    # block so the recorded duration covers device execution,
+                    # not just async dispatch
+                    jax.block_until_ready(fetch_vals)
+                    d_dur = time.perf_counter() - d_t0
+                    name = ("device_program_compile+run" if step.n_calls == 0
+                            else "device_program")
+                    collector["events"].append((name, d_t0, d_dur, 2))
+                    if step.n_calls == 0:
+                        collector["compile_time_s"] = d_dur
+                    collector["n_device_ops"] = len(step.device_ops)
+                    collector["fetch_bytes"] = int(sum(
+                        getattr(v, "nbytes", 0) for v in fetch_vals))
+                if check_flags:
+                    # inspect BEFORE committing state: a failed check must not
+                    # apply NaN-contaminated updates (ref semantics: ops
+                    # downstream of a failed CheckNumerics never run)
+                    import jax
 
-                flags_np = np.asarray(jax.device_get(check_flags))
-                if flags_np.any():
-                    bad = [m for m, f in zip(step.check_msgs, flags_np) if f]
-                    raise errors.InvalidArgumentError(
-                        None, None, "; ".join(bad))
-            self._variable_store.values = dict(new_state)
-            self._apply_declared_shardings(new_state.keys())
-            device_results = list(fetch_vals)
-            step.n_calls += 1
+                    flags_np = np.asarray(jax.device_get(check_flags))
+                    if flags_np.any():
+                        bad = [m for m, f in zip(step.check_msgs, flags_np) if f]
+                        raise errors.InvalidArgumentError(
+                            None, None, "; ".join(bad))
+                self._variable_store.values = dict(new_state)
+                self._apply_declared_shardings(new_state.keys())
+                device_results = list(fetch_vals)
+                step.n_calls += 1
 
         dev_map = dict(zip(step.device_fetches, device_results))
 
@@ -1020,8 +1030,12 @@ class BaseSession:
         to_run = [op for op in pruned if op not in st["executed"]]
         lowering_mod.execute_ops(ctx, to_run, fed=fed)
         st["executed"].update(to_run)
-        # eager writes commit straight into the store
-        self._variable_store.values = ctx.state
+        # commit only the keys THIS handle wrote, under the lock: a
+        # wholesale reassignment could resurrect a stale dict and erase
+        # a concurrent run()'s committed updates
+        with self._lock:
+            for name in ctx.written:
+                self._variable_store.values[name] = ctx.state[name]
 
         values = []
         for e in mapper.elements:
@@ -1114,21 +1128,26 @@ class BaseSession:
             if guard_on:
                 for name, nbytes in step.fetch_nbytes:
                     self._transfer_guard(name, nbytes, "fetch")
-            rng_key, rng_ctr = self._rng_args()
             feed_args = {t.name: self._maybe_shard_feed(t, feeds[t])
                          for t in step.feed_tensors}
-            state = self._variable_store.values
-            fetch_vals, new_state, check_flags = step.jitted(
-                dict(state), feed_args, rng_key, rng_ctr)
-            if check_flags:
-                flags_np = np.asarray(jax.device_get(check_flags))
-                if flags_np.any():
-                    bad = [m for m, f in zip(step.check_msgs, flags_np) if f]
-                    raise errors.InvalidArgumentError(
-                        None, None, "; ".join(bad))
-            self._variable_store.values = dict(new_state)
-            self._apply_declared_shardings(new_state.keys())
-            step.n_calls += 1
+            # same serialization as _run_elements: concurrent callables
+            # (or a callable racing sess.run) must not share donated
+            # state or drop each other's commits
+            with self._lock:
+                rng_key, rng_ctr = self._rng_args()
+                state = self._variable_store.values
+                fetch_vals, new_state, check_flags = step.jitted(
+                    dict(state), feed_args, rng_key, rng_ctr)
+                if check_flags:
+                    flags_np = np.asarray(jax.device_get(check_flags))
+                    if flags_np.any():
+                        bad = [m for m, f in zip(step.check_msgs,
+                                                 flags_np) if f]
+                        raise errors.InvalidArgumentError(
+                            None, None, "; ".join(bad))
+                self._variable_store.values = dict(new_state)
+                self._apply_declared_shardings(new_state.keys())
+                step.n_calls += 1
             dev_map = dict(zip(step.device_fetches, fetch_vals))
             values = []
             for e in mapper.elements:

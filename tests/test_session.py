@@ -121,6 +121,61 @@ def test_gradients_through_variable_reads():
         np.testing.assert_allclose(sess.run(gm), [3.0, 5.0])
 
 
+def test_concurrent_run_serializes_device_stage():
+    # TF-1 sessions are thread-safe: N threads x M increments must
+    # commit every update (unsynchronized, concurrent steps read the
+    # same donated state — deleted-buffer errors and lost updates)
+    import threading
+
+    v = stf.Variable(0.0, name="conc_ctr")
+    inc = stf.assign_add(v, 1.0)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(50):
+                    sess.run(inc)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        assert float(np.asarray(sess.run(v))) == 200.0
+
+
+def test_concurrent_blocked_dequeue_does_not_block_producer():
+    # host stages must stay concurrent: a consumer blocked in a host
+    # dequeue cannot hold the lock the producer needs
+    import threading
+    import time
+
+    q = stf.FIFOQueue(capacity=2, dtypes=[stf.int32], shapes=[[]])
+    x = stf.placeholder(stf.int32, [])
+    enq = q.enqueue([x])
+    deq = q.dequeue()
+    with stf.Session() as sess:
+        out = []
+
+        def consumer():
+            for _ in range(6):
+                out.append(int(np.asarray(sess.run(deq))))
+
+        c = threading.Thread(target=consumer)
+        c.start()
+        time.sleep(0.15)  # consumer parks in the blocking host dequeue
+        for i in range(6):
+            sess.run(enq, feed_dict={x: i})
+        c.join(timeout=20)
+        assert not c.is_alive()
+        assert sorted(out) == list(range(6))
+
+
 def test_assert_raises_typed_error_and_preserves_state():
     # Assert rides the CheckNumerics flag channel: a failure raises
     # InvalidArgumentError (catchable by type, not an opaque
