@@ -81,6 +81,69 @@ def test_nchw_layout_rewrite_is_numerically_identical():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("seed", range(10))
+def test_layout_rewrite_invariant_on_random_nchw_chains(seed):
+    """Optimization-invariance fuzz: random NCHW conv/pool/bn/residual
+    chains must compute identical values before and after the layout
+    rewrite (arbitrary compositions of the push-down/cancellation
+    phases, not just the hand-built block)."""
+    rng = np.random.RandomState(400 + seed)
+    stf.reset_default_graph()
+    n, c, hw = 2, int(rng.choice([4, 8])), 8
+    x = stf.placeholder(stf.float32, [n, c, hw, hw], name="x")
+    h = x
+    residual = None
+    for k in range(int(rng.randint(3, 7))):
+        choice = rng.choice(["conv", "pool", "bn", "relu", "bias",
+                             "save", "res"])
+        cur_c = int(h.shape[1])
+        cur_hw = int(h.shape[2])
+        if choice == "conv":
+            w = stf.constant(rng.randn(3, 3, cur_c, cur_c)
+                             .astype(np.float32) * 0.2)
+            h = stf.nn.conv2d(h, w, strides=[1, 1, 1, 1],
+                              padding="SAME", data_format="NCHW")
+        elif choice == "pool" and cur_hw >= 4:
+            op = (stf.nn.max_pool if rng.rand() < 0.5
+                  else stf.nn.avg_pool)
+            h = op(h, ksize=[1, 1, 2, 2], strides=[1, 1, 2, 2],
+                   padding="SAME", data_format="NCHW")
+            residual = None  # shape changed
+        elif choice == "bn":
+            h, _, _ = stf.nn.fused_batch_norm(
+                h, stf.constant(np.ones(cur_c, np.float32)),
+                stf.constant(np.zeros(cur_c, np.float32)),
+                data_format="NCHW")
+        elif choice == "relu":
+            h = stf.nn.relu(h)
+        elif choice == "bias":
+            h = stf.nn.bias_add(
+                h, stf.constant(rng.randn(cur_c).astype(np.float32)),
+                data_format="NCHW")
+        elif choice == "save":
+            residual = h
+        elif choice == "res" and residual is not None and \
+                residual.shape.as_list() == h.shape.as_list():
+            h = stf.add(h, residual)
+    out = stf.reduce_mean(h, name=f"fz_out_{seed}")
+    xv = rng.randn(n, c, hw, hw).astype(np.float32)
+    with stf.Session() as sess:
+        expected = np.asarray(sess.run(out, {x: xv}))
+
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    opt = optimizer.optimize(gd, keep=[out.name, x.name])
+    stf.reset_default_graph()
+    graph_io.import_graph_def(json.dumps(opt), name="")
+    g = stf.get_default_graph()
+    x2 = g.as_graph_element("x:0", allow_tensor=True,
+                            allow_operation=False)
+    out2 = g.as_graph_element(out.name, allow_tensor=True,
+                              allow_operation=False)
+    with stf.Session() as sess2:
+        got = np.asarray(sess2.run(out2, {x2: xv}))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
 def test_nchw_pool_converts():
     stf.reset_default_graph()
     x = stf.placeholder(stf.float32, [2, 4, 8, 8], name="xp")
