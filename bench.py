@@ -461,6 +461,108 @@ def _measure_mnist(platform, device_kind):
     }
 
 
+def _measure_graph_opt(platform, device_kind):
+    """Function-aware graph-optimizer micro-row (PR 1 tentpole): a
+    conv-in-cond + conv/BN-in-scan-body model timed through the Session
+    with the graph as built vs. after optimizer.optimize (layout into
+    bodies, loop layout push, in-body CSE/fold, LICM). Emits both times
+    and the speedup so the optimizer's win — which on an NCHW model is
+    per-ITERATION transpose traffic — is pinned in the BENCH json. CPU
+    fallback is fine; the delta is what matters."""
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = 3
+
+    import json as _json
+
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.framework import (cost_model, graph_io,
+                                                 optimizer)
+
+    rng = np.random.RandomState(0)
+    n, c, hw, scan_steps = 8, 16, 32, 16
+
+    def build():
+        stf.reset_default_graph()
+        x = stf.placeholder(stf.float32, [n, c, hw, hw], name="gx")
+        w1 = stf.constant(rng.randn(3, 3, c, c).astype(np.float32) * 0.2,
+                          name="gw1")
+        w2 = stf.constant(rng.randn(3, 3, c, c).astype(np.float32) * 0.2,
+                          name="gw2")
+        scale = stf.constant(np.ones(c, np.float32))
+        offset = stf.constant(np.zeros(c, np.float32))
+
+        def branch_t():
+            h = stf.nn.conv2d(x, w1, strides=[1, 1, 1, 1],
+                              padding="SAME", data_format="NCHW")
+            h, _, _ = stf.nn.fused_batch_norm(h, scale, offset,
+                                              data_format="NCHW")
+            return stf.nn.relu(h)
+
+        def branch_f():
+            return stf.nn.relu(stf.nn.conv2d(
+                x, w2, strides=[1, 1, 1, 1], padding="SAME",
+                data_format="NCHW"))
+
+        h0 = stf.cond(stf.reduce_sum(x) > 0.0, branch_t, branch_f)
+        dummy = stf.constant(np.zeros((scan_steps, 1), np.float32))
+
+        def body(carry, _):
+            h = stf.nn.conv2d(carry, w1, strides=[1, 1, 1, 1],
+                              padding="SAME", data_format="NCHW")
+            h, _, _ = stf.nn.fused_batch_norm(h, scale, offset,
+                                              data_format="NCHW")
+            return stf.nn.relu(h)
+
+        out = stf.scan(body, dummy, initializer=h0)
+        res = stf.reduce_mean(out[-1], name="graph_opt_res")
+        return x, res
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(n, c, hw, hw).astype(np.float32)
+
+    def timed(x, res):
+        sess = stf.Session()
+        for _ in range(warmup):
+            sess.run(res, {x: xv})
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            val = sess.run(res, {x: xv})
+        return (time.perf_counter() - t0) / steps, float(np.asarray(val))
+
+    x, res = build()
+    est_unopt = cost_model.estimate(res, feeds=[x])
+    unopt_s, unopt_val = timed(x, res)
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    opt = optimizer.optimize(gd, keep=[res.name, x.name])
+
+    stf.reset_default_graph()
+    graph_io.import_graph_def(_json.dumps(opt), name="")
+    g = stf.get_default_graph()
+    x2 = g.as_graph_element("gx:0", True, False)
+    r2 = g.as_graph_element("graph_opt_res:0", True, False)
+    est_opt = cost_model.estimate(r2, feeds=[x2])
+    opt_s, opt_val = timed(x2, r2)
+
+    return {
+        "metric": "graph_opt_cond_scan_step_ms",
+        "value": round(opt_s * 1e3, 3),
+        "unit": "ms/step (optimized)",
+        "vs_baseline": None,
+        "unoptimized_ms": round(unopt_s * 1e3, 3),
+        "speedup": round(unopt_s / max(opt_s, 1e-9), 3),
+        "values_match": bool(abs(unopt_val - opt_val)
+                             <= 1e-4 * max(1.0, abs(unopt_val))),
+        "cost_model_bytes_unopt": round(est_unopt.bytes_accessed),
+        "cost_model_bytes_opt": round(est_opt.bytes_accessed),
+        "cost_model_bytes_ratio": round(
+            est_opt.bytes_accessed / max(est_unopt.bytes_accessed, 1.0), 3),
+        "scan_steps": scan_steps,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _measure_transformer(batch, platform, device_kind):
     """BASELINE config 5: Transformer-big WMT en-de training step +
     beam-search inference latency. Comparator 2000 tokens/sec is a
@@ -756,6 +858,8 @@ def child_main():
         result = run_bench_transformer(platform, kind)
     elif model == "resnet_dp":
         result = _measure_resnet_dp()
+    elif model == "graph_opt":
+        result = _measure_graph_opt(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -857,6 +961,7 @@ _METRIC_NAMES = {
     "transformer": ("transformer_big_tokens_per_sec_per_chip",
                     "tokens/sec/chip"),
     "resnet_dp": ("resnet50_dp8_sharding_efficiency", "fraction_of_ideal"),
+    "graph_opt": ("graph_opt_cond_scan_step_ms", "ms/step (optimized)"),
 }
 
 
@@ -875,7 +980,8 @@ def main():
     selected = []
     for tok in os.environ.get(
             "BENCH_MODELS",
-            "resnet,bert,transformer,mnist,resnet_dp").split(","):
+            "resnet,bert,transformer,mnist,resnet_dp,graph_opt"
+            ).split(","):
         tok = tok.strip()
         if not tok:
             continue
@@ -890,7 +996,7 @@ def main():
         print("BENCH_MODELS selected nothing; running the default set",
               file=sys.stderr)
         selected = ["resnet", "bert", "transformer", "mnist",
-                    "resnet_dp"]
+                    "resnet_dp", "graph_opt"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

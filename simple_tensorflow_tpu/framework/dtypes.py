@@ -240,6 +240,57 @@ def as_dtype(value) -> DType:
     raise TypeError(f"Cannot convert {value!r} to a DType")
 
 
+# -- 64-bit narrowing (VERDICT weak #6) --------------------------------------
+#
+# TPUs have no int64/float64 datapath; with jax_enable_x64 off (the
+# default), 64-bit requests compute in 32 bits. The divergence is
+# documented loudly in docs/MIGRATION.md; at runtime it surfaces as ONE
+# warning at the session/feed boundary — never a per-op warning storm.
+
+_64BIT_NARROWING = {"int64": "int32", "uint64": "uint32",
+                    "float64": "float32"}
+_narrowing_warned = [False]
+
+
+def narrowed_if_no_x64(dtype) -> DType:
+    """The dtype 64-bit requests actually compute with: narrowed to its
+    32-bit sibling when jax_enable_x64 is off, unchanged otherwise. Op
+    lowerings that honor an explicit 64-bit out_type route through this
+    so jax never emits its per-callsite truncation warning."""
+    d = as_dtype(dtype)
+    base = d.base_dtype.name
+    if base not in _64BIT_NARROWING:
+        return d
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return d
+    return as_dtype(_64BIT_NARROWING[base])
+
+
+def warn_64bit_narrowing_once(where: str) -> None:
+    """Emit the single process-wide 64-bit narrowing notice (the
+    session/feed boundary calls this when a 64-bit tensor first crosses
+    it). Replaces the per-op jax truncation warnings."""
+    if _narrowing_warned[0]:
+        return
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return
+    _narrowing_warned[0] = True
+    import warnings
+
+    warnings.warn(
+        f"stf: {where} uses a 64-bit dtype, but TPU (and this runtime "
+        "with jax_enable_x64 off) computes int64/uint64/float64 as "
+        "32-bit. Values past 2**31 or needing f64 precision will be "
+        "WRONG, not an error. See docs/MIGRATION.md '64-bit dtypes' "
+        "for details and JAX_ENABLE_X64=1 for CPU-only full-width "
+        "runs. (This warning is emitted once per process.)",
+        UserWarning, stacklevel=3)
+
+
 def infer_dtype(value) -> DType:
     """Infer the stf dtype of a concrete python/numpy/jax value."""
     import jax

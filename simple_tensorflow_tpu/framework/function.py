@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from . import dtypes as dtypes_mod
 from . import graph as ops_mod
 from . import op_registry
+from . import optimizer as optimizer_mod
 from . import lowering as lowering_mod
 from . import tensor_shape as shape_mod
 
@@ -29,6 +30,14 @@ def _lower_function_call(ctx, op, inputs):
 
 op_registry.register("GraphFunctionCall", lower=_lower_function_call,
                      n_outputs=None)
+
+# PassManager anatomy: inputs = declared args + captures; the body
+# inlines once per call, so no hoisting (LICM would only reorder work)
+_CALL_BODIES = lambda a, n: [  # noqa: E731 — shared by both call ops
+    dict(attr="func_graph", start=a["n_args"], count=n - a["n_args"],
+         hoist=False, count_attr=None)]
+optimizer_mod.register_function_op("GraphFunctionCall", mode="call",
+                                   bodies=_CALL_BODIES)
 
 
 def _trace_body(g, func, name, arg_specs):
@@ -172,6 +181,8 @@ def _lower_recompute_call(ctx, op, inputs):
 
 op_registry.register("RecomputeGradCall", lower=_lower_recompute_call,
                      n_outputs=None)
+optimizer_mod.register_function_op("RecomputeGradCall", mode="call",
+                                   bodies=_CALL_BODIES)
 
 
 def recompute_grad(func, name=None):

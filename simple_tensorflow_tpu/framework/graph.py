@@ -282,6 +282,11 @@ class Graph:
         self._ops_by_name: Dict[str, Operation] = {}
         self._ops_in_order: List[Operation] = []
         self._version = 0
+        # bumped by optimizer.optimize_graph_functions when a FuncGraph
+        # body is rewritten in place: append-only growth never
+        # invalidates a compiled step, but a body REWRITE must — Session
+        # cache keys include this counter
+        self._rewrite_version = 0
         self._op_counter = 0
         self._names_in_use: Dict[str, int] = {}
         self._name_stack = ""
@@ -301,6 +306,12 @@ class Graph:
     @property
     def version(self) -> int:
         return self._version
+
+    @property
+    def rewrite_version(self) -> int:
+        """How many times this graph's function bodies have been
+        rewritten in place (optimizer.optimize_graph_functions)."""
+        return self._rewrite_version
 
     def _next_id(self) -> int:
         self._op_counter += 1

@@ -89,8 +89,10 @@ def _funcgraph_to_dict(fg: ops_mod.FuncGraph):
         "node": [_node_to_dict(op) for op in fg.get_operations()],
         "inputs": [t.name for t in fg.inputs],
         "outputs": [t.name for t in fg.outputs],
-        "captures": [[outer.name, inner.name]
-                     for outer, inner in fg.captures],
+        # an imported FuncGraph has outer=None captures (re-bound by the
+        # caller through the op's input list) — serialize those as None
+        "captures": [[outer.name if outer is not None else None,
+                      inner.name] for outer, inner in fg.captures],
     }
 
 
@@ -115,6 +117,72 @@ def write_graph(graph_or_graph_def, logdir, name, as_text=True):
     return path
 
 
+def _build_nodes_into(target_graph, nodes, tensor_env, scope_prefix,
+                      input_map=None):
+    """Rebuild GraphDef node dicts into ``target_graph`` (shared by
+    import_graph_def and rebuild_funcgraph)."""
+    input_map = input_map or {}
+    for node in nodes:
+        attrs = {k: _decode_attr(v)
+                 for k, v in (node.get("attr") or {}).items()}
+        # Scoped imports get their own VariableStore namespace: rewrite
+        # var_name attrs so an imported 'w' cannot alias an existing
+        # variable 'w' in this graph (store keys come from these attrs).
+        if scope_prefix:
+            if isinstance(attrs.get("var_name"), str):
+                attrs["var_name"] = f"{scope_prefix}/{attrs['var_name']}"
+            if isinstance(attrs.get("var_names"), tuple):
+                attrs["var_names"] = tuple(
+                    f"{scope_prefix}/{n}" for n in attrs["var_names"])
+        # rebuild nested funcgraphs
+        for k, v in list(attrs.items()):
+            if isinstance(v, dict) and v.get("__kind__") == "funcgraph":
+                attrs[k] = rebuild_funcgraph(v["v"], target_graph)
+        inputs = []
+        for ref in node["input"]:
+            if ref in input_map:
+                inputs.append(input_map[ref])
+            else:
+                inputs.append(tensor_env[ref])
+        ctrl = [tensor_env["(op)" + c]
+                for c in node.get("control_input", ())
+                if "(op)" + c in tensor_env]
+        # A producer that doesn't know output shapes (e.g. the C client
+        # building math ops) omits output_specs; the op registry's
+        # shape inference fills them in, mirroring the reference's
+        # shape_refiner on import (ref: common_runtime/shape_refiner.cc).
+        specs_raw = node.get("output_specs")
+        specs = None if specs_raw is None else [
+            (shape_mod.TensorShape(sh), dtypes_mod.as_dtype(dt))
+            for sh, dt in specs_raw]
+        new_name = f"{scope_prefix}/{node['name']}" if scope_prefix \
+            else node["name"]
+        op = target_graph.create_op(
+            node["op"], inputs, attrs=attrs, name=new_name + "/",
+            output_specs=specs, control_inputs=ctrl)
+        tensor_env["(op)" + node["name"]] = op
+        for i, out in enumerate(op.outputs):
+            tensor_env[f"{node['name']}:{i}"] = out
+    return tensor_env
+
+
+def rebuild_funcgraph(fg_dict, outer):
+    """Rebuild a serialized FuncGraph dict into a live FuncGraph of
+    ``outer``. Captures keep their inner placeholders with outer refs
+    None — resolving outers by name is not possible here; the caller
+    (the function-op's lowering via op inputs, or
+    optimizer.optimize_graph_functions) re-binds them."""
+    fg = ops_mod.FuncGraph(fg_dict["name"], outer_graph=outer)
+    env = {}
+    with ops_mod._as_current(fg):
+        _build_nodes_into(fg, fg_dict["node"], env, "")
+    fg.inputs = [env[n] for n in fg_dict["inputs"]]
+    fg.outputs = [env[n] for n in fg_dict["outputs"]]
+    fg.captures = [(None, env[inner])
+                   for _, inner in fg_dict["captures"]]
+    return fg
+
+
 def import_graph_def(graph_def, input_map=None, return_elements=None,
                      name=None, op_dict=None, producer_op_list=None):
     """(ref: python/framework/importer.py:156 ``import_graph_def``).
@@ -130,64 +198,8 @@ def import_graph_def(graph_def, input_map=None, return_elements=None,
     input_map = {k: v for k, v in (input_map or {}).items()}
     tensors = {}
 
-    def build_into(target_graph, nodes, tensor_env, scope_prefix):
-        for node in nodes:
-            attrs = {k: _decode_attr(v)
-                     for k, v in (node.get("attr") or {}).items()}
-            # Scoped imports get their own VariableStore namespace: rewrite
-            # var_name attrs so an imported 'w' cannot alias an existing
-            # variable 'w' in this graph (store keys come from these attrs).
-            if scope_prefix:
-                if isinstance(attrs.get("var_name"), str):
-                    attrs["var_name"] = f"{scope_prefix}/{attrs['var_name']}"
-                if isinstance(attrs.get("var_names"), tuple):
-                    attrs["var_names"] = tuple(
-                        f"{scope_prefix}/{n}" for n in attrs["var_names"])
-            # rebuild nested funcgraphs
-            for k, v in list(attrs.items()):
-                if isinstance(v, dict) and v.get("__kind__") == "funcgraph":
-                    attrs[k] = _rebuild_funcgraph(v["v"], target_graph)
-            inputs = []
-            for ref in node["input"]:
-                if ref in input_map:
-                    inputs.append(input_map[ref])
-                else:
-                    inputs.append(tensor_env[ref])
-            ctrl = [tensor_env["(op)" + c]
-                    for c in node.get("control_input", ())
-                    if "(op)" + c in tensor_env]
-            # A producer that doesn't know output shapes (e.g. the C client
-            # building math ops) omits output_specs; the op registry's
-            # shape inference fills them in, mirroring the reference's
-            # shape_refiner on import (ref: common_runtime/shape_refiner.cc).
-            specs_raw = node.get("output_specs")
-            specs = None if specs_raw is None else [
-                (shape_mod.TensorShape(sh), dtypes_mod.as_dtype(dt))
-                for sh, dt in specs_raw]
-            new_name = f"{scope_prefix}/{node['name']}" if scope_prefix \
-                else node["name"]
-            op = target_graph.create_op(
-                node["op"], inputs, attrs=attrs, name=new_name + "/",
-                output_specs=specs, control_inputs=ctrl)
-            tensor_env["(op)" + node["name"]] = op
-            for i, out in enumerate(op.outputs):
-                tensor_env[f"{node['name']}:{i}"] = out
-        return tensor_env
-
-    def _rebuild_funcgraph(fg_dict, outer):
-        fg = ops_mod.FuncGraph(fg_dict["name"], outer_graph=outer)
-        env = {}
-        with ops_mod._as_current(fg):
-            build_into(fg, fg_dict["node"], env, "")
-        fg.inputs = [env[n] for n in fg_dict["inputs"]]
-        fg.outputs = [env[n] for n in fg_dict["outputs"]]
-        # captures resolved at lowering through the outer env by name is not
-        # possible; keep inner placeholders (outer refs re-bound by caller).
-        fg.captures = [(None, env[inner])
-                       for _, inner in fg_dict["captures"]]
-        return fg
-
-    build_into(g, graph_def["node"], tensors, prefix)
+    _build_nodes_into(g, graph_def["node"], tensors, prefix,
+                      input_map=input_map)
     if return_elements:
         out = []
         for r in return_elements:

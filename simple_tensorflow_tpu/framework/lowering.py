@@ -212,6 +212,11 @@ class LoweringContext:
         # CSE alias map from the plan-time optimizer: duplicate tensor ->
         # canonical tensor; consulted on every input lookup
         self.alias: Dict[Tensor, Tensor] = {}
+        # per-plan FuncGraph body plans (optimizer._plan_function_bodies):
+        # fg -> (op_list, const_env, alias). Scoped to THIS compiled
+        # plan — never stashed on the FuncGraph, because which captures
+        # are constant depends on the plan's feed set.
+        self.func_plans: Dict[Any, Any] = {}
         self._rng_cache: Dict[int, Any] = {}
         # CheckNumerics flags gathered during trace: [(message, bool value)];
         # the Session fetches them with the step and raises host-side
@@ -233,6 +238,7 @@ class LoweringContext:
         c.in_shard_map = self.in_shard_map
         c.differentiable = self.differentiable
         c.alias = self.alias
+        c.func_plans = self.func_plans
         c._rng_cache = self._rng_cache
         c.numeric_checks = self.numeric_checks
         return c
@@ -420,7 +426,15 @@ def lower_func_graph(ctx: LoweringContext, fg: "ops_mod.FuncGraph",
                      capture_values: Sequence[Any]) -> List[Any]:
     """Lower a FuncGraph body given values for its declared inputs and its
     captures; returns values for fg.outputs. Used by cond/while/scan/function
-    lowering."""
+    lowering.
+
+    When the plan-time optimizer recorded an optimized plan for this
+    body in ctx.func_plans (optimizer._plan_function_bodies), that plan
+    drives the trace instead of a fresh prune: constant-folded interior
+    values seed the env as host constants, CSE-duplicate tensors resolve
+    through the body's alias map, and DCE'd ops never trace — so
+    in-body fold/CSE wins apply on EVERY iteration of a while/scan
+    body."""
     env: Dict[Tensor, Any] = {}
     if len(arg_values) != len(fg.inputs):
         raise InternalLoweringError(
@@ -431,9 +445,21 @@ def lower_func_graph(ctx: LoweringContext, fg: "ops_mod.FuncGraph",
     for (outer, inner), v in zip(fg.captures, capture_values):
         env[inner] = v
     child = ctx.child(env, in_control_flow=True)
-    needed = prune([t.op for t in fg.outputs], fed_tensors=set(env.keys()))
+    plan = ctx.func_plans.get(fg)
+    if plan is not None:
+        needed, body_consts, body_alias = plan
+        if body_alias:
+            # replace (never mutate) the shared alias dict
+            merged = dict(child.alias)
+            merged.update(body_alias)
+            child.alias = merged
+        for t, v in body_consts.items():
+            env.setdefault(t, v)  # bound args/captures win over seeds
+    else:
+        needed = prune([t.op for t in fg.outputs],
+                       fed_tensors=set(env.keys()))
     execute_ops(child, needed, fed=set(env.keys()))
-    return [child.env[t] for t in fg.outputs]
+    return [child.value_of(t) for t in fg.outputs]
 
 
 def capture_values_for(ctx: LoweringContext, fg: "ops_mod.FuncGraph") -> List[Any]:

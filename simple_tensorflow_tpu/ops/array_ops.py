@@ -31,10 +31,15 @@ constant = constant_op.constant
 
 op_registry.register_pure("Identity", lambda x: x)
 op_registry.register_pure("Snapshot", lambda x: x)
+# 64-bit out_types narrow through narrowed_if_no_x64 (one boundary
+# warning per process instead of jax's per-callsite truncation warning;
+# VERDICT weak #6, docs/MIGRATION.md "64-bit dtypes")
 op_registry.register_pure("Shape", lambda x, out_type=None: jnp.asarray(
-    x.shape, dtype=(out_type.np_dtype if out_type else jnp.int32)))
+    x.shape, dtype=(dtypes_mod.narrowed_if_no_x64(out_type).np_dtype
+                    if out_type else jnp.int32)))
 op_registry.register_pure("Size", lambda x, out_type=None: jnp.asarray(
-    x.size, dtype=(out_type.np_dtype if out_type else jnp.int32)))
+    x.size, dtype=(dtypes_mod.narrowed_if_no_x64(out_type).np_dtype
+                   if out_type else jnp.int32)))
 op_registry.register_pure("Rank", lambda x: jnp.asarray(x.ndim, dtype=jnp.int32))
 op_registry.register_pure("Reshape", lambda x, shape: jnp.reshape(x, shape))
 op_registry.register_pure("Transpose", lambda x, perm=None: jnp.transpose(x, perm))

@@ -29,6 +29,7 @@ from ..framework import dtypes as dtypes_mod
 from ..framework import graph as ops_mod
 from ..framework import lowering as lowering_mod
 from ..framework import op_registry
+from ..framework import optimizer as optimizer_mod
 from ..framework import tensor_shape as shape_mod
 
 Tensor = ops_mod.Tensor
@@ -213,6 +214,20 @@ def _lower_cond(ctx, op, inputs):
 
 op_registry.register("Cond", lower=_lower_cond, n_outputs=None)
 
+# PassManager anatomy: inputs = [pred] + true-captures + false-captures.
+# Branch bodies run at most once, so hoisting out of them would
+# SPECULATE work the untaken branch never pays — hoist stays False;
+# constants captured by a branch still fold inside it.
+optimizer_mod.register_function_op(
+    "Cond", mode="branch",
+    bodies=lambda a, n: [
+        dict(attr="true_graph", start=1, count=a["n_true_caps"],
+             hoist=False, count_attr="n_true_caps"),
+        dict(attr="false_graph", start=1 + a["n_true_caps"],
+             count=n - 1 - a["n_true_caps"], hoist=False,
+             count_attr=None),
+    ])
+
 
 def case(pred_fn_pairs, default=None, exclusive=False, strict=False,
          name="case"):
@@ -390,6 +405,21 @@ def _lower_while(ctx, op, inputs):
 
 
 op_registry.register("While", lower=_lower_while, n_outputs=None)
+
+# inputs = loop-vars + cond-captures + body-captures. Both graphs
+# re-execute per ITERATION, so capture-only subexpressions hoist out
+# (loop-invariant code motion); cost attribution multiplies by the
+# static trip bound when the user gave one.
+optimizer_mod.register_function_op(
+    "While", mode="loop",
+    bodies=lambda a, n: [
+        dict(attr="cond_graph", start=a["n_vars"], count=a["n_cond_caps"],
+             hoist=True, count_attr="n_cond_caps"),
+        dict(attr="body_graph", start=a["n_vars"] + a["n_cond_caps"],
+             count=n - a["n_vars"] - a["n_cond_caps"], hoist=True,
+             count_attr=None),
+    ],
+    trip=lambda a, inputs: a.get("max_iterations"))
 
 
 def smart_cond(pred, true_fn, false_fn, name=None):

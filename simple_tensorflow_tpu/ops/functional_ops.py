@@ -19,8 +19,15 @@ from ..framework import dtypes as dtypes_mod
 from ..framework import graph as ops_mod
 from ..framework import lowering as lowering_mod
 from ..framework import op_registry
+from ..framework import optimizer as optimizer_mod
 from ..framework import tensor_shape as shape_mod
 from .control_flow_ops import _flatten, _pack_like
+
+
+def _leading_dim(t):
+    """Static trip count of a scan/map: the elems' leading dim."""
+    sh = t.shape
+    return sh[0].value if sh.rank else None
 
 Tensor = ops_mod.Tensor
 FuncGraph = ops_mod.FuncGraph
@@ -92,6 +99,15 @@ def _lower_map(ctx, op, inputs):
 
 
 op_registry.register("MapFn", lower=_lower_map, n_outputs=None)
+
+# PassManager anatomy (inputs = elems + captures); the body runs once
+# per element, so capture-only subexpressions hoist out of it
+optimizer_mod.register_function_op(
+    "MapFn", mode="loop",
+    bodies=lambda a, n: [
+        dict(attr="body", start=a["n_elems"], count=n - a["n_elems"],
+             hoist=True, count_attr=None)],
+    trip=lambda a, inputs: _leading_dim(inputs[0]) if inputs else None)
 
 
 def scan(fn, elems, initializer=None, parallel_iterations=10, back_prop=True,
@@ -166,6 +182,16 @@ def _lower_scan(ctx, op, inputs):
 
 op_registry.register("Scan", lower=_lower_scan, n_outputs=None)
 
+# inputs = carry-init + elems + captures
+optimizer_mod.register_function_op(
+    "Scan", mode="loop",
+    bodies=lambda a, n: [
+        dict(attr="body", start=a["n_carry"] + a["n_elems"],
+             count=n - a["n_carry"] - a["n_elems"], hoist=True,
+             count_attr=None)],
+    trip=lambda a, inputs: (_leading_dim(inputs[a["n_carry"]])
+                            if len(inputs) > a["n_carry"] else None))
+
 
 def foldl(fn, elems, initializer=None, parallel_iterations=10, back_prop=True,
           swap_memory=False, name=None):
@@ -221,6 +247,15 @@ def _lower_foldl(ctx, op, inputs):
 
 
 op_registry.register("Foldl", lower=_lower_foldl, n_outputs=None)
+
+optimizer_mod.register_function_op(
+    "Foldl", mode="loop",
+    bodies=lambda a, n: [
+        dict(attr="body", start=a["n_carry"] + a["n_elems"],
+             count=n - a["n_carry"] - a["n_elems"], hoist=True,
+             count_attr=None)],
+    trip=lambda a, inputs: (_leading_dim(inputs[a["n_carry"]])
+                            if len(inputs) > a["n_carry"] else None))
 
 
 def foldr(fn, elems, initializer=None, parallel_iterations=10, back_prop=True,

@@ -140,7 +140,8 @@ op_registry.register_pure("ApproximateEqual", lambda x, y, tolerance=1e-5:
                           jnp.abs(x - y) < tolerance)
 
 # casts / misc
-op_registry.register_pure("Cast", lambda x, dtype: x.astype(dtype.np_dtype))
+op_registry.register_pure("Cast", lambda x, dtype: x.astype(
+    dtypes_mod.narrowed_if_no_x64(dtype).np_dtype))
 op_registry.register_pure(
     "Bitcast", lambda x, dtype: jax.lax.bitcast_convert_type(x, dtype.np_dtype))
 op_registry.register_pure("AddN", lambda *xs: builtins.sum(xs[1:], xs[0]))
@@ -191,12 +192,18 @@ op_registry.register_pure("EuclideanNorm",
                           jnp.sqrt(jnp.sum(jnp.square(x), axis=axis,
                                            keepdims=keepdims)))
 
+# the reference's int64 default narrows without a per-op jax warning
+# (one boundary warning per process; docs/MIGRATION.md "64-bit dtypes")
 op_registry.register_pure("ArgMax", lambda x, axis=0, output_type=None:
                           jnp.argmax(x, axis=axis).astype(
-                              output_type.np_dtype if output_type else jnp.int64))
+                              dtypes_mod.narrowed_if_no_x64(
+                                  output_type
+                                  or dtypes_mod.int64).np_dtype))
 op_registry.register_pure("ArgMin", lambda x, axis=0, output_type=None:
                           jnp.argmin(x, axis=axis).astype(
-                              output_type.np_dtype if output_type else jnp.int64))
+                              dtypes_mod.narrowed_if_no_x64(
+                                  output_type
+                                  or dtypes_mod.int64).np_dtype))
 op_registry.register_pure("Cumsum", lambda x, axis=0, exclusive=False,
                           reverse=False: _cum_impl(jnp.cumsum, x, axis,
                                                    exclusive, reverse, 0))

@@ -26,7 +26,8 @@ op_registry.register_pure(
     "ConfusionMatrix",
     lambda labels, predictions, weights=None, num_classes=0:
         jnp.zeros((num_classes, num_classes),
-                  jnp.float64 if weights is not None else jnp.int32
+                  dtypes_mod.narrowed_if_no_x64(dtypes_mod.float64).np_dtype
+                  if weights is not None else jnp.int32
                   ).at[labels, predictions].add(
                       1 if weights is None else weights))
 

@@ -820,11 +820,14 @@ def _max_pool_argmax_impl(x, ksize=None, strides=None, padding="VALID"):
                  constant_values=neg)
     flat = ((jnp.arange(h)[:, None, None] * w
              + jnp.arange(w)[None, :, None]) * c
-            + jnp.arange(c)[None, None, :]).astype(jnp.int64)
+            + jnp.arange(c)[None, None, :]).astype(
+                dtypes_mod.narrowed_if_no_x64(dtypes_mod.int64).np_dtype)
     flat = jnp.pad(flat, ((0, pad_h), (0, pad_w), (0, 0)),
                    constant_values=-1)
     best = jnp.full((b, oh, ow, c), neg, x.dtype)
-    best_idx = jnp.zeros((b, oh, ow, c), jnp.int64)
+    best_idx = jnp.zeros(
+        (b, oh, ow, c),
+        dtypes_mod.narrowed_if_no_x64(dtypes_mod.int64).np_dtype)
     ys = jnp.arange(oh) * sy
     xs = jnp.arange(ow) * sx
     for dy in builtins.range(kh):

@@ -30,11 +30,22 @@ _PASS_THROUGH = ("Identity", "CheckNumerics", "StopGradient",
 
 
 def remove_training_nodes(graph_def, protected=()):
-    """Splice out pass-through ops, rewiring consumers to their input."""
+    """Splice out pass-through ops, rewiring consumers to their input.
+    Function-aware (PassManager infrastructure): recurses into
+    cond/while/scan/defun bodies with each body's signature protected,
+    so an Identity inside a while body — paid per iteration — is
+    spliced out too."""
+    from ..framework import optimizer as optimizer_mod
+
     protected = set(protected)
     redirect = {}  # node name -> replacement tensor ref
     kept = []
     for node in graph_def["node"]:
+        for d, b in optimizer_mod._node_bodies(node):
+            inner_protected = {optimizer_mod._tensor_ref(r)[0]
+                               for r in optimizer_mod._body_keep(b)}
+            optimizer_mod._set_body(
+                node, d, remove_training_nodes(b, inner_protected), b)
         if (node["op"] in _PASS_THROUGH and node["name"] not in protected
                 and len(node["input"]) >= 1
                 and not node["control_input"]):
@@ -58,8 +69,14 @@ def remove_training_nodes(graph_def, protected=()):
         # ultimate producer (otherwise the prune hits a dangling name)
         node["control_input"] = [gr.producer_name(resolve(c))
                                  for c in node["control_input"]]
-    return {"versions": dict(graph_def.get("versions", {"producer": 1})),
-            "node": kept}
+    out = {"versions": dict(graph_def.get("versions", {"producer": 1})),
+           "node": kept}
+    if "inputs" in graph_def:  # a FuncGraph body: keep its signature keys
+        for k in ("name", "inputs", "outputs", "captures"):
+            if k in graph_def:
+                out[k] = graph_def[k]
+        out["outputs"] = [resolve(r) for r in graph_def["outputs"]]
+    return out
 
 
 def fold_batch_norms(graph_def):

@@ -1,3 +1,5 @@
-"""Utility subsystems: perf tracing/MFU/roofline (stf.utils.perf)."""
+"""Utility subsystems: perf tracing/MFU/roofline (stf.utils.perf),
+structure helpers (stf.nest re-exports stf.utils.nest)."""
 
+from . import nest  # noqa: F401
 from . import perf  # noqa: F401

@@ -167,6 +167,103 @@ def _enable_cache():
     jax.config.update("jax_compilation_cache_dir", cache)
 
 
+# ---------------------------------------------------------------------------
+# 4. Function-aware optimizer ratchet (PR 1 tentpole): post-optimization
+#    cost-model bytes/FLOPs of a cond+scan model are pinned so in-body
+#    CSE/layout wins can't silently regress. Fast (static cost model
+#    only, no compile) — always runs.
+# ---------------------------------------------------------------------------
+
+# calibrated 2026-08-03: unopt 1.154e7 F / 8.06e6 B -> opt 1.141e7 F /
+# 6.88e6 B (NCHW per-op transposes cancelled in the cond branch and the
+# scan body, Exp CSE'd in-body); ~8% headroom on the pins
+_COND_SCAN_BYTES_BUDGET = 7.4e6
+_COND_SCAN_FLOPS_BUDGET = 1.23e7
+
+
+def _build_cond_scan_model():
+    import simple_tensorflow_tpu as stf_mod
+
+    stf_mod.reset_default_graph()
+    rng = np.random.RandomState(0)
+    n, c, hw, steps = 4, 8, 16, 8
+    x = stf_mod.placeholder(stf_mod.float32, [n, c, hw, hw], name="bx")
+    w1 = stf_mod.constant(rng.randn(3, 3, c, c).astype(np.float32) * 0.2,
+                          name="bw1")
+    w2 = stf_mod.constant(rng.randn(3, 3, c, c).astype(np.float32) * 0.2,
+                          name="bw2")
+    scale = stf_mod.constant(np.ones(c, np.float32))
+    offset = stf_mod.constant(np.zeros(c, np.float32))
+
+    def branch_t():
+        h = stf_mod.nn.conv2d(x, w1, strides=[1, 1, 1, 1],
+                              padding="SAME", data_format="NCHW")
+        h, _, _ = stf_mod.nn.fused_batch_norm(h, scale, offset,
+                                              data_format="NCHW")
+        return stf_mod.nn.relu(h)
+
+    def branch_f():
+        h = stf_mod.nn.conv2d(x, w2, strides=[1, 1, 1, 1],
+                              padding="SAME", data_format="NCHW")
+        return stf_mod.nn.relu(h)
+
+    h0 = stf_mod.cond(stf_mod.reduce_sum(x) > 0.0, branch_t, branch_f)
+    dummy = stf_mod.constant(np.zeros((steps, 1), np.float32))
+
+    def body(carry, _):
+        h = stf_mod.nn.conv2d(carry, w1, strides=[1, 1, 1, 1],
+                              padding="SAME", data_format="NCHW")
+        h, _, _ = stf_mod.nn.fused_batch_norm(h, scale, offset,
+                                              data_format="NCHW")
+        a = stf_mod.exp(carry)
+        b = stf_mod.exp(carry)  # in-body CSE target
+        return stf_mod.nn.relu(h) + 0.0 * (a + b)
+
+    out = stf_mod.scan(body, dummy, initializer=h0)
+    res = stf_mod.reduce_mean(out[-1], name="budget_res")
+    return x, res
+
+
+def test_cond_scan_post_optimization_cost_ratchet():
+    import json
+
+    from simple_tensorflow_tpu.framework import (cost_model, graph_io,
+                                                 optimizer)
+
+    x, res = _build_cond_scan_model()
+    est_unopt = cost_model.estimate(res, feeds=[x])
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    opt = optimizer.optimize(gd, keep=[res.name, x.name])
+
+    stf.reset_default_graph()
+    graph_io.import_graph_def(json.dumps(opt), name="")
+    g = stf.get_default_graph()
+    x2 = g.as_graph_element("bx:0", True, False)
+    r2 = g.as_graph_element(res.name, True, False)
+    est_opt = cost_model.estimate(r2, feeds=[x2])
+
+    # the optimizer must WIN: in-body layout + CSE cut modeled traffic
+    assert est_opt.bytes_accessed < est_unopt.bytes_accessed, (
+        f"optimization increased modeled bytes: "
+        f"{est_opt.bytes_accessed:.3g} >= {est_unopt.bytes_accessed:.3g}")
+    # and the post-optimization numbers are pinned (ratchet)
+    assert est_opt.bytes_accessed <= _COND_SCAN_BYTES_BUDGET, (
+        f"cond/scan post-opt bytes regressed: {est_opt.bytes_accessed:.4g}"
+        f" > {_COND_SCAN_BYTES_BUDGET:.4g} (calibrated 6.88e6; in-body "
+        "layout/CSE may have stopped firing)")
+    assert est_opt.flops <= _COND_SCAN_FLOPS_BUDGET, (
+        f"cond/scan post-opt FLOPs regressed: {est_opt.flops:.4g} > "
+        f"{_COND_SCAN_FLOPS_BUDGET:.4g} (calibrated 1.141e7)")
+    # the numbers stay real: the rewritten graph computes the same value
+    xv = np.random.RandomState(1).randn(4, 8, 16, 16).astype(np.float32)
+    with stf.Session() as s2:
+        got = np.asarray(s2.run(r2, {x2: xv}))
+    x, res = _build_cond_scan_model()
+    with stf.Session() as s1:
+        expected = np.asarray(s1.run(res, {x: xv}))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
 @pytest.mark.skipif(not _RUN_BUDGET, reason="STF_BYTE_BUDGET=0")
 def test_resnet_train_step_byte_budget():
     import sys
