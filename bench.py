@@ -133,6 +133,35 @@ def _predicted_info(m, sec_per_step, feed_tensors):
                                        f"{str(e)[:120]}"}}
 
 
+def _monitoring_info():
+    """Compact stf.monitoring snapshot for a bench row: executable-cache
+    behavior + compile-time totals, so BENCH_*.json captures compile-time
+    trends, not just steady-state step time. Counts are process-cumulative
+    (a batch sweep's earlier candidates are included). Best-effort."""
+    try:
+        from simple_tensorflow_tpu.platform import monitoring
+
+        exp = monitoring.export()
+
+        def _cells(name):
+            return exp.get(name, {}).get("cells", {})
+
+        out = {
+            "session_runs": _cells("/stf/session/runs").get("", 0),
+            "cache_hits": _cells(
+                "/stf/session/executable_cache/hits").get("", 0),
+            "cache_misses": dict(_cells(
+                "/stf/session/executable_cache/misses")),
+        }
+        compile_hist = _cells("/stf/session/jit_compile_seconds").get("")
+        if compile_hist:
+            out["jit_compiles"] = compile_hist["count"]
+            out["jit_compile_seconds_total"] = round(compile_hist["sum"], 3)
+        return {"monitoring": out}
+    except Exception:
+        return {}
+
+
 def _measure_resnet(batch, image_size, steps, warmup, device_kind,
                     platform, recompute=None, s2d=None):
     import jax
@@ -190,6 +219,7 @@ def _measure_resnet(batch, image_size, steps, warmup, device_kind,
     return {
         **_roofline_info(sess, feed, sec_per_step, platform),
         **_predicted_info(m, sec_per_step, [m["images"], m["labels"]]),
+        **_monitoring_info(),
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(float(images_per_sec), 2),
         "unit": "images/sec/chip",
@@ -401,6 +431,7 @@ def _measure_bert(batch, platform, device_kind, recompute=None):
     return {
         **_roofline_info(sess, feed, sec_per_step, platform),
         **_predicted_info(m, sec_per_step, list(feed.keys())),
+        **_monitoring_info(),
         "metric": "bert_base_tokens_per_sec_per_chip",
         "value": round(float(tokens_per_sec), 1),
         "unit": "tokens/sec/chip",
@@ -449,6 +480,7 @@ def _measure_mnist(platform, device_kind):
     sec_per_step = dt / (steps + 1)
     examples_per_sec = batch / sec_per_step
     return {
+        **_monitoring_info(),
         "metric": "mnist_softmax_examples_per_sec",
         "value": round(float(examples_per_sec), 1),
         "unit": "examples/sec",
@@ -546,6 +578,7 @@ def _measure_graph_opt(platform, device_kind):
     opt_s, opt_val = timed(x2, r2)
 
     return {
+        **_monitoring_info(),
         "metric": "graph_opt_cond_scan_step_ms",
         "value": round(opt_s * 1e3, 3),
         "unit": "ms/step (optimized)",
@@ -635,6 +668,7 @@ def _measure_transformer(batch, platform, device_kind):
 
     result = {
         **_roofline_info(sess, feed, sec_per_step, platform),
+        **_monitoring_info(),
         "metric": "transformer_big_tokens_per_sec_per_chip",
         "value": round(float(tokens_per_sec), 1),
         "unit": "tokens/sec/chip",

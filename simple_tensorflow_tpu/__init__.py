@@ -196,6 +196,7 @@ from . import sets
 from . import utils
 from .utils import nest  # stf.nest (ref: python/util/nest.py)
 from .platform import app, flags, tf_logging as logging, resource_loader
+from .platform import monitoring
 from .platform import test
 from .client import device_lib
 from .client import timeline

@@ -42,11 +42,146 @@ from ..framework import dtypes as dtypes_mod
 from ..framework import graph as ops_mod
 from ..framework import lowering as lowering_mod
 from ..framework import errors
+from ..platform import monitoring
 
 Tensor = ops_mod.Tensor
 Operation = ops_mod.Operation
 
 _default_session_stack = threading.local()
+
+# -- lifecycle metrics (ref: core/common_runtime metrics in
+# core/framework/metrics.cc; see docs/OBSERVABILITY.md for the catalog) ------
+_metric_runs = monitoring.Counter(
+    "/stf/session/runs", "Session.run calls (all sessions, this process)")
+_metric_cache_hits = monitoring.Counter(
+    "/stf/session/executable_cache/hits",
+    "run() served by an already-planned executable")
+_metric_cache_misses = monitoring.Counter(
+    "/stf/session/executable_cache/misses",
+    "run() that had to plan (and usually jit-compile) a new executable",
+    "reason")
+_metric_run_seconds = monitoring.Sampler(
+    "/stf/session/run_seconds",
+    monitoring.ExponentialBuckets(1e-5, 2.0, 30),
+    "wall seconds per Session.run")
+_metric_compile_seconds = monitoring.Sampler(
+    "/stf/session/jit_compile_seconds",
+    monitoring.ExponentialBuckets(1e-3, 2.0, 24),
+    "XLA compile seconds per new executable (on untraced first calls the "
+    "sample includes the first execution — compile dominates)")
+_metric_phase_seconds = monitoring.Sampler(
+    "/stf/session/phase_seconds",
+    monitoring.ExponentialBuckets(1e-6, 4.0, 20),
+    "per-lifecycle-phase seconds of traced runs", "phase")
+_metric_deadline_exceeded = monitoring.Counter(
+    "/stf/session/deadline_exceeded",
+    "runs aborted by RunOptions.timeout_in_ms")
+
+# chrome-trace track per lifecycle phase (Timeline emits thread_name
+# metadata for these): 0 = planning, 1 = host stages, 2 = device
+_PHASE_TRACK = {"prune": 0, "optimize": 0, "lower": 0,
+                "host_stage": 1, "post_host_stage": 1,
+                "jit_compile": 2, "cost_analysis": 2, "device_execute": 2}
+_TRACK_NAMES = {0: "planning", 1: "host", 2: "device"}
+
+
+def _check_deadline(deadline, what):
+    if deadline is not None and time.perf_counter() > deadline:
+        _metric_deadline_exceeded.get_cell().increase_by(1)
+        raise errors.DeadlineExceededError(
+            None, None,
+            f"Session.run exceeded RunOptions.timeout_in_ms after {what}")
+
+
+# at most this many timed-out waiter threads may be outstanding at once:
+# each one blocks in block_until_ready pinning its attempt's device
+# buffers, so a retry loop against a wedged device must not grow them
+# without bound
+_deadline_waiters = threading.BoundedSemaphore(8)
+
+
+def _block_with_deadline(values, deadline):
+    """Block until device results are ready; with a deadline, wait in a
+    helper thread so the deadline can fire mid-wait. Detection only — XLA
+    execution is not cancelled, and the caller commits variable state
+    BEFORE this wait so a timeout never leaves donated (deleted) buffers
+    in the store."""
+    import jax
+
+    if deadline is None:
+        jax.block_until_ready(values)
+        return
+    remaining = deadline - time.perf_counter()
+    if remaining > 0:
+        if not _deadline_waiters.acquire(blocking=False):
+            # waiter pool exhausted (many concurrent timed waits, or
+            # earlier timeouts against a wedged device still pinned):
+            # degrade to an unenforced wait — never report a timeout
+            # whose budget did not actually elapse
+            jax.block_until_ready(values)
+            return
+        done = threading.Event()
+        err: List[BaseException] = []
+
+        def _wait():
+            try:
+                jax.block_until_ready(values)
+            except BaseException as e:  # surfaced on the caller thread
+                err.append(e)
+            finally:
+                done.set()
+                _deadline_waiters.release()
+
+        th = threading.Thread(target=_wait, daemon=True)
+        th.start()
+        if done.wait(remaining):
+            if err:
+                # an async XLA/runtime failure must raise exactly like
+                # the no-deadline path would at its block_until_ready
+                raise err[0]
+            return
+    _metric_deadline_exceeded.get_cell().increase_by(1)
+    raise errors.DeadlineExceededError(
+        None, None,
+        "Session.run exceeded RunOptions.timeout_in_ms waiting for "
+        "device results (execution continues; session state stays "
+        "consistent)")
+
+
+def _call_step_executable(step, state, feed_args, rng_key, rng_ctr):
+    """Run the step's device program: the pinned AOT executable when one
+    exists, falling back to the jit path — and dropping the executable
+    plus its now-stale cost analysis — when the feed avals changed (the
+    AOT call rejects new shapes/dtypes with TypeError before executing,
+    so no buffers are donated on the failed attempt)."""
+    exe = step.compiled if step.compiled is not None else step.jitted
+    try:
+        return exe(dict(state), feed_args, rng_key, rng_ctr)
+    except TypeError:
+        if exe is not step.compiled:
+            raise
+        step.compiled = None
+        step.xla_cost = None
+        return step.jitted(dict(state), feed_args, rng_key, rng_ctr)
+
+
+def _executable_analysis(lowered, compiled):
+    """flops/bytes (XLA cost_analysis) + memory stats (memory_analysis,
+    needs a compiled executable) in the RunMetadata.cost_graph shape.
+    Best-effort: backends may expose neither. Normalization lives in
+    utils/perf (cost_of / memory_of) — one place tracks jax's API."""
+    from ..utils import perf
+
+    out: Dict[str, Any] = {}
+    cost = perf.cost_of(compiled if compiled is not None else lowered)
+    if cost:
+        out["flops"] = cost["flops"]
+        out["bytes_accessed"] = cost["bytes"]
+    if compiled is not None:
+        mem = perf.memory_of(compiled)
+        if mem:
+            out["memory"] = mem
+    return out
 
 
 def get_default_session():
@@ -98,8 +233,12 @@ def _is_host_device(device_str) -> bool:
 
 class RunOptions:
     """(ref: config.proto ``RunOptions``). trace_level >= SOFTWARE_TRACE
-    makes Session.run block on device results and record per-stage step
-    stats into the provided RunMetadata."""
+    makes Session.run block on device results and record per-phase
+    lifecycle spans (prune/optimize/lower/jit_compile/device_execute/
+    host stages) into the provided RunMetadata's step_stats.
+    ``timeout_in_ms > 0`` bounds the run's blocking waits: exceeding it
+    raises errors.DeadlineExceededError (detection, not cancellation —
+    variable state stays consistent)."""
 
     NO_TRACE = 0
     SOFTWARE_TRACE = 1
@@ -197,7 +336,7 @@ class _CompiledStep:
                  "post_host_inputs", "device_ops", "feed_tensors", "boundary",
                  "has_device_stage", "n_calls", "last_lowering_ctx",
                  "check_msgs", "const_env", "alias", "fetch_nbytes",
-                 "raw_post_inputs", "func_plans")
+                 "raw_post_inputs", "func_plans", "compiled", "xla_cost")
 
     def __init__(self):
         self.n_calls = 0
@@ -209,6 +348,12 @@ class _CompiledStep:
         self.fetch_nbytes = []
         self.raw_post_inputs = set()
         self.func_plans = {}
+        # AOT-compiled executable + its XLA cost/memory analysis: filled
+        # on traced first calls (jit_compile phase); ``compiled`` serves
+        # later same-shape calls, falling back to ``jitted`` on aval
+        # mismatch. xla_cost None = never tried, {} = tried, unavailable.
+        self.compiled = None
+        self.xla_cost = None
 
 
 class BaseSession:
@@ -219,6 +364,9 @@ class BaseSession:
         self._guard_warned: Set[str] = set()
         self._variable_store = VariableStore()
         self._cache: Dict[Any, _CompiledStep] = {}
+        # (fetch, feed) signature -> rewrite_version at last plan:
+        # classifies executable-cache miss reasons
+        self._sig_versions: Dict[Any, int] = {}
         self._closed = False
         self._run_counter = 0
         self._lock = threading.RLock()
@@ -410,38 +558,61 @@ class BaseSession:
         if self._closed:
             raise RuntimeError("Attempted to use a closed Session.")
         t0 = time.perf_counter()
+        _metric_runs.get_cell().increase_by(1)
         trace = (options is not None and
                  getattr(options, "trace_level", 0) > 0 and
                  run_metadata is not None)
+        timeout_ms = (int(getattr(options, "timeout_in_ms", 0) or 0)
+                      if options is not None else 0)
+        deadline = t0 + timeout_ms / 1000.0 if timeout_ms > 0 else None
         collector: Optional[Dict[str, Any]] = (
-            {"events": [], "start_s": t0} if trace else None)
-        mapper = _FetchMapper(self._graph, fetches)
-        feeds = self._normalize_feeds(feed_dict)
-        values = self._run_elements(mapper.elements, feeds,
-                                    collector=collector)
+            {"start_s": t0} if trace else None)
+        buf = monitoring.TraceBuffer() if trace else None
+        import contextlib
+
+        with (monitoring.trace_collection(buf) if trace
+              else contextlib.nullcontext()):
+            mapper = _FetchMapper(self._graph, fetches)
+            feeds = self._normalize_feeds(feed_dict)
+            values = self._run_elements(mapper.elements, feeds,
+                                        collector=collector,
+                                        deadline=deadline)
         out = mapper.rebuild(values)
+        wall = time.perf_counter() - t0
+        _metric_run_seconds.get_cell().add(wall)
         if run_metadata is not None:
-            wall = time.perf_counter() - t0
             stats = {
                 "start_us": 0,
                 "wall_time_s": wall,
                 "nodes": [],
             }
+            if buf is not None:
+                base = t0
+                for span in sorted(buf.drain(),
+                                   key=lambda s: s["start_s"]):
+                    phase = span["name"].split(":")[0]
+                    node = {
+                        "name": span["name"],
+                        "start_us": (span["start_s"] - base) * 1e6,
+                        "dur_us": max(span["dur_s"] * 1e6, 1.0),
+                        "tid": _PHASE_TRACK.get(phase, 0),
+                    }
+                    if span.get("meta"):
+                        node["args"] = {k: str(v)
+                                        for k, v in span["meta"].items()}
+                    stats["nodes"].append(node)
+                    _metric_phase_seconds.get_cell(phase).add(
+                        span["dur_s"])
+                stats["thread_names"] = dict(_TRACK_NAMES)
             if collector is not None:
-                base = collector["start_s"]
-                for name, start_s, dur_s, tid in collector["events"]:
-                    stats["nodes"].append({
-                        "name": name,
-                        "start_us": (start_s - base) * 1e6,
-                        "dur_us": max(dur_s * 1e6, 1.0),
-                        "tid": tid,
-                    })
                 for k in ("compile_time_s", "fetch_bytes", "n_device_ops",
                           "n_host_ops", "flop_estimate"):
                     if k in collector:
                         stats[k] = collector[k]
             if isinstance(run_metadata, RunMetadata):
                 run_metadata.step_stats = stats
+                if collector is not None and collector.get("xla_cost"):
+                    run_metadata.cost_graph = dict(collector["xla_cost"])
             else:
                 try:
                     run_metadata["wall_time_s"] = wall
@@ -533,38 +704,52 @@ class BaseSession:
                 tuple(sorted(t.name for t in feed_tensors)),
                 getattr(self._graph, "_rewrite_version", 0))
 
+    def _miss_reason(self, key) -> str:
+        """Why this (fetches, feeds) signature needs a fresh plan — the
+        retrace-reason label on the executable-cache miss counter. Only
+        two reasons exist: the cache key is (fetch-sig, feed-sig,
+        rewrite_version), so a miss on a known signature can only mean
+        the rewrite version moved (append-only graph growth never
+        invalidates a plan)."""
+        sig = key[:2]
+        prev = self._sig_versions.get(sig)
+        self._sig_versions[sig] = key[2]
+        if prev is not None and prev != key[2]:
+            return "rewrite_version_bump"
+        return "new_fetch_feed_signature"
+
     def _run_elements(self, elements: List[Any],
-                      feeds: Dict[Tensor, np.ndarray], collector=None):
+                      feeds: Dict[Tensor, np.ndarray], collector=None,
+                      deadline=None):
         key = self._cache_key(elements, feeds)
         step = self._cache.get(key)
-        plan_t0 = time.perf_counter()
-        first_call = step is None
         if step is None:
+            _metric_cache_misses.get_cell(
+                self._miss_reason(key)).increase_by(1)
             step = self._plan(elements, feeds)
             # concurrent first calls may both compile; the first insert
             # wins and the others adopt it (n_calls stays coherent)
             step = self._cache.setdefault(key, step)
-        if collector is not None and first_call:
-            collector["events"].append(
-                ("plan", plan_t0, time.perf_counter() - plan_t0, 0))
+        else:
+            _metric_cache_hits.get_cell().increase_by(1)
 
         # Host stage -------------------------------------------------------
         host_env: Dict[Tensor, Any] = {}
         if step.host_plan:
-            h_t0 = time.perf_counter()
-            hctx = lowering_mod.LoweringContext(
-                self._variable_store.values, rng_root=None, feeds=dict(feeds),
-                host=True, session=self)
-            hctx.alias = step.alias
-            hctx.func_plans = step.func_plans
-            hctx.env.update(step.const_env)
-            hctx.env.update(feeds)
-            lowering_mod.execute_ops(hctx, step.host_plan, fed=set(feeds))
-            host_env = hctx.env
+            with monitoring.traceme("host_stage", n_ops=len(step.host_plan)):
+                hctx = lowering_mod.LoweringContext(
+                    self._variable_store.values, rng_root=None,
+                    feeds=dict(feeds), host=True, session=self)
+                hctx.alias = step.alias
+                hctx.func_plans = step.func_plans
+                hctx.env.update(step.const_env)
+                hctx.env.update(feeds)
+                lowering_mod.execute_ops(hctx, step.host_plan,
+                                         fed=set(feeds))
+                host_env = hctx.env
             if collector is not None:
-                collector["events"].append(
-                    ("host_stage", h_t0, time.perf_counter() - h_t0, 1))
                 collector["n_host_ops"] = len(step.host_plan)
+            _check_deadline(deadline, "the host stage")
 
         # Device stage -----------------------------------------------------
         device_results: List[Any] = []
@@ -599,65 +784,77 @@ class BaseSession:
                     val = feeds[t] if t in feeds else host_env[t]
                     feed_args[t.name] = self._maybe_shard_feed(t, val)
                 state = self._variable_store.values
-                d_t0 = time.perf_counter()
-                fetch_vals, new_state, check_flags = step.jitted(
-                    dict(state), feed_args, rng_key, rng_ctr)
+                first_call = step.n_calls == 0
                 if collector is not None:
-                    import jax
+                    self._prepare_executable_analysis(
+                        step, state, feed_args, rng_key, rng_ctr,
+                        first_call, collector)
+                d_t0 = time.perf_counter()
+                with monitoring.traceme("device_execute"):
+                    fetch_vals, new_state, check_flags = \
+                        _call_step_executable(step, state, feed_args,
+                                              rng_key, rng_ctr)
+                    if check_flags:
+                        # inspect BEFORE committing state: a failed check
+                        # must not apply NaN-contaminated updates (ref
+                        # semantics: ops downstream of a failed
+                        # CheckNumerics never run)
+                        import jax
 
-                    # block so the recorded duration covers device execution,
-                    # not just async dispatch
-                    jax.block_until_ready(fetch_vals)
-                    d_dur = time.perf_counter() - d_t0
-                    name = ("device_program_compile+run" if step.n_calls == 0
-                            else "device_program")
-                    collector["events"].append((name, d_t0, d_dur, 2))
-                    if step.n_calls == 0:
-                        collector["compile_time_s"] = d_dur
+                        flags_np = np.asarray(jax.device_get(check_flags))
+                        if flags_np.any():
+                            bad = [m for m, f
+                                   in zip(step.check_msgs, flags_np) if f]
+                            raise errors.InvalidArgumentError(
+                                None, None, "; ".join(bad))
+                    self._variable_store.values = dict(new_state)
+                    self._apply_declared_shardings(new_state.keys())
+                    device_results = list(fetch_vals)
+                    step.n_calls += 1
+                    if collector is not None or deadline is not None:
+                        # block so the span covers device execution, not
+                        # just async dispatch; state committed above, so
+                        # a deadline abort leaves the session consistent
+                        _block_with_deadline(device_results, deadline)
+                d_dur = time.perf_counter() - d_t0
+                if first_call and collector is None:
+                    # untraced first call: compile+first-run seconds
+                    # (compile dominates; the traced path records a pure
+                    # compile sample instead)
+                    _metric_compile_seconds.get_cell().add(d_dur)
+                if collector is not None:
+                    if first_call:
+                        collector.setdefault("compile_time_s", d_dur)
                     collector["n_device_ops"] = len(step.device_ops)
                     collector["fetch_bytes"] = int(sum(
                         getattr(v, "nbytes", 0) for v in fetch_vals))
-                if check_flags:
-                    # inspect BEFORE committing state: a failed check must not
-                    # apply NaN-contaminated updates (ref semantics: ops
-                    # downstream of a failed CheckNumerics never run)
-                    import jax
-
-                    flags_np = np.asarray(jax.device_get(check_flags))
-                    if flags_np.any():
-                        bad = [m for m, f in zip(step.check_msgs, flags_np) if f]
-                        raise errors.InvalidArgumentError(
-                            None, None, "; ".join(bad))
-                self._variable_store.values = dict(new_state)
-                self._apply_declared_shardings(new_state.keys())
-                device_results = list(fetch_vals)
-                step.n_calls += 1
+                    if step.xla_cost:
+                        collector["xla_cost"] = step.xla_cost
 
         dev_map = dict(zip(step.device_fetches, device_results))
 
         # Post-host stage (host sinks: summaries etc.) ----------------------
         if step.post_host_plan:
-            p_t0 = time.perf_counter()
-            pctx = lowering_mod.LoweringContext(
-                self._variable_store.values, rng_root=None, host=True,
-                session=self)
-            pctx.alias = step.alias
-            pctx.func_plans = step.func_plans
-            pctx.env.update(step.const_env)
-            pctx.env.update(host_env)
-            pctx.env.update(feeds)
-            for t, v in dev_map.items():
-                if t in step.raw_post_inputs:
-                    pctx.env[t] = v  # stays a jax.Array (session handles)
-                else:
-                    pctx.env[t] = (np.asarray(v)
-                                   if t.dtype.name != "string" else v)
-            lowering_mod.execute_ops(pctx, step.post_host_plan,
-                                     fed=set(pctx.env))
-            host_env = pctx.env
-            if collector is not None:
-                collector["events"].append(
-                    ("post_host_stage", p_t0, time.perf_counter() - p_t0, 1))
+            with monitoring.traceme("post_host_stage",
+                                    n_ops=len(step.post_host_plan)):
+                pctx = lowering_mod.LoweringContext(
+                    self._variable_store.values, rng_root=None, host=True,
+                    session=self)
+                pctx.alias = step.alias
+                pctx.func_plans = step.func_plans
+                pctx.env.update(step.const_env)
+                pctx.env.update(host_env)
+                pctx.env.update(feeds)
+                for t, v in dev_map.items():
+                    if t in step.raw_post_inputs:
+                        pctx.env[t] = v  # stays a jax.Array (session handles)
+                    else:
+                        pctx.env[t] = (np.asarray(v)
+                                       if t.dtype.name != "string" else v)
+                lowering_mod.execute_ops(pctx, step.post_host_plan,
+                                         fed=set(pctx.env))
+                host_env = pctx.env
+            _check_deadline(deadline, "the post-host stage")
 
         # Assemble ---------------------------------------------------------
         out = []
@@ -767,6 +964,38 @@ class BaseSession:
             store.shardings[name] = ns
             store.values[name] = jax.device_put(store.values[name], ns)
 
+    def _prepare_executable_analysis(self, step, state, feed_args, rng_key,
+                                     rng_ctr, first_call, collector):
+        """Traced runs only. First call: split jit-compile from execution
+        via the AOT path (``lower().compile()``), keep the executable for
+        later same-shape calls, and harvest XLA cost_analysis +
+        memory_analysis into ``step.xla_cost``. Cache-hit runs whose
+        executable was compiled untraced backfill cost_analysis from a
+        re-lowering (no backend compile). Either way the extra work is
+        paid once per executable and only under SOFTWARE_TRACE."""
+        if step.compiled is not None or step.xla_cost is not None:
+            return
+        try:
+            if first_call:
+                c_t0 = time.perf_counter()
+                with monitoring.traceme("jit_compile",
+                                        n_ops=len(step.device_ops)):
+                    lowered = step.jitted.lower(dict(state), feed_args,
+                                                rng_key, rng_ctr)
+                    step.compiled = lowered.compile()
+                compile_s = time.perf_counter() - c_t0
+                _metric_compile_seconds.get_cell().add(compile_s)
+                collector["compile_time_s"] = compile_s
+                step.xla_cost = _executable_analysis(lowered, step.compiled)
+            else:
+                with monitoring.traceme("cost_analysis"):
+                    lowered = step.jitted.lower(dict(state), feed_args,
+                                                rng_key, rng_ctr)
+                    step.xla_cost = _executable_analysis(lowered, None)
+        except Exception:
+            step.compiled = None
+            step.xla_cost = {}  # tried; executable exposes no analysis
+
     def _next_rng(self):
         import jax
 
@@ -803,7 +1032,8 @@ class BaseSession:
                 fetch_tensors.append(e)
                 if e not in fed_set:
                     target_ops.append(e.op)
-        pruned = lowering_mod.prune(target_ops, fed_set)
+        with monitoring.traceme("prune", n_target_ops=len(target_ops)):
+            pruned = lowering_mod.prune(target_ops, fed_set)
 
         # Plan-time graph optimizer: fold/CSE/DCE before lowering (the
         # grappler slot, ref core/common_runtime/constant_folding.cc +
@@ -812,8 +1042,10 @@ class BaseSession:
         from ..framework import optimizer as graph_opt
 
         func_plans: Dict[Any, Any] = {}
-        pruned, const_env, alias = graph_opt.optimize_pruned(
-            pruned, fed_set, fetch_tensors, func_plans=func_plans)
+        with monitoring.traceme("optimize", n_pruned_ops=len(pruned)):
+            pruned, const_env, alias = graph_opt.optimize_pruned(
+                pruned, fed_set, fetch_tensors, func_plans=func_plans)
+        lower_t0 = time.perf_counter()
         step.const_env = const_env
         step.alias = alias
         step.func_plans = func_plans
@@ -951,6 +1183,13 @@ class BaseSession:
             for t in device_fetches
             if t.shape.num_elements() is not None
             and t.dtype.name != "string"]
+        # staging/partitioning = the "lower" lifecycle phase (the
+        # reference's placement + partitioning ahead of executor build)
+        monitoring.record_span("lower", lower_t0,
+                               time.perf_counter() - lower_t0,
+                               n_device_ops=len(device_ops),
+                               n_host_ops=len(step.host_plan),
+                               n_post_host_ops=len(post_host))
         step.has_device_stage = bool(device_ops)
         if not step.has_device_stage:
             step.jitted = None
@@ -1151,8 +1390,8 @@ class BaseSession:
             with self._lock:
                 rng_key, rng_ctr = self._rng_args()
                 state = self._variable_store.values
-                fetch_vals, new_state, check_flags = step.jitted(
-                    dict(state), feed_args, rng_key, rng_ctr)
+                fetch_vals, new_state, check_flags = _call_step_executable(
+                    step, state, feed_args, rng_key, rng_ctr)
                 if check_flags:
                     flags_np = np.asarray(jax.device_get(check_flags))
                     if flags_np.any():

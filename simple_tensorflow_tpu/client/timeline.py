@@ -3,8 +3,11 @@ core/common_runtime/step_stats_collector.cc).
 
 The reference assembles StepStats from per-kernel timestamps; with XLA the
 per-op timeline lives in the profiler. This module provides (a) the
-reference's Timeline class over our RunMetadata dict, and (b) helpers to
-capture a jax.profiler trace for a Session.run.
+reference's Timeline class over our RunMetadata / step_stats dict —
+traced runs (``RunOptions.SOFTWARE_TRACE``) yield one track per
+lifecycle stage (planning / host / device), loadable in Perfetto or
+chrome://tracing — and (b) helpers to capture a jax.profiler trace for a
+Session.run.
 """
 
 from __future__ import annotations
@@ -14,34 +17,92 @@ import time
 
 
 class Timeline:
-    """(ref: timeline.py:308 ``class Timeline``)."""
+    """(ref: timeline.py:308 ``class Timeline``). Accepts a step_stats
+    dict (``RunMetadata.step_stats``) or a RunMetadata itself (pulls
+    ``cost_graph`` for ``show_memory`` counter tracks)."""
 
-    def __init__(self, step_stats, graph=None):
+    _PID = 0
+
+    def __init__(self, step_stats, graph=None, cost_graph=None):
+        if hasattr(step_stats, "step_stats"):  # a RunMetadata
+            if cost_graph is None:
+                cost_graph = getattr(step_stats, "cost_graph", None)
+            step_stats = step_stats.step_stats
         self._step_stats = step_stats or {}
+        self._cost_graph = cost_graph or {}
         self._events = []
         self._build()
 
+    def _metadata(self, name, args, tid=None):
+        ev = {"name": name, "ph": "M", "pid": self._PID, "args": args}
+        if tid is not None:
+            ev["tid"] = tid
+        return ev
+
     def _build(self):
-        t0 = self._step_stats.get("start_us", 0)
-        for i, node in enumerate(self._step_stats.get("nodes", [])):
-            self._events.append({
+        stats = self._step_stats
+        t0 = stats.get("start_us", 0)
+        # process/thread naming metadata: Perfetto and chrome://tracing
+        # group tracks by these (ref: timeline.py _emit_pid/_emit_tid)
+        self._events.append(self._metadata(
+            "process_name", {"name": "stf.Session run"}))
+        thread_names = dict(stats.get("thread_names", {}))
+        nodes = stats.get("nodes", [])
+        for tid in sorted({n.get("tid", 0) for n in nodes}
+                          | {int(t) for t in thread_names}):
+            name = thread_names.get(tid, thread_names.get(str(tid),
+                                                          f"track {tid}"))
+            self._events.append(self._metadata(
+                "thread_name", {"name": name}, tid=tid))
+        for i, node in enumerate(nodes):
+            ev = {
                 "name": node.get("name", f"op{i}"),
                 "cat": "Op",
                 "ph": "X",
                 "ts": node.get("start_us", t0),
                 "dur": node.get("dur_us", 1),
-                "pid": 0,
+                "pid": self._PID,
                 "tid": node.get("tid", 0),
-            })
-        if not self._events and "wall_time_s" in self._step_stats:
+            }
+            if node.get("args"):
+                ev["args"] = dict(node["args"])
+            self._events.append(ev)
+        if not nodes and "wall_time_s" in stats:
             self._events.append({
                 "name": "session_run", "cat": "Step", "ph": "X",
-                "ts": 0, "dur": self._step_stats["wall_time_s"] * 1e6,
-                "pid": 0, "tid": 0})
+                "ts": 0, "dur": stats["wall_time_s"] * 1e6,
+                "pid": self._PID, "tid": 0})
+
+    def _memory_events(self):
+        """Counter events from the executable's memory analysis
+        (RunMetadata.cost_graph["memory"]): a flat peak-bytes track over
+        the device-execute span — the allocator-level per-op curve of
+        the reference lives in XLA, not here."""
+        mem = self._cost_graph.get("memory") or {}
+        peak = mem.get("peak_bytes")
+        if not peak:
+            return []
+        dev = [n for n in self._step_stats.get("nodes", [])
+               if n.get("name") == "device_execute"]
+        # span ALL device-execute nodes: the executable's peak holds for
+        # each of them, not just the first
+        start = min((n["start_us"] for n in dev), default=0)
+        end = max((n["start_us"] + n["dur_us"] for n in dev), default=1)
+        track = "device memory (peak bytes)"
+        return [
+            {"name": track, "ph": "C", "pid": self._PID, "ts": start,
+             "args": {"bytes": int(peak)}},
+            {"name": track, "ph": "C", "pid": self._PID, "ts": end,
+             "args": {"bytes": 0}},
+        ]
 
     def generate_chrome_trace_format(self, show_dataflow=True,
                                      show_memory=False):
-        return json.dumps({"traceEvents": self._events})
+        events = list(self._events)
+        if show_memory:
+            events.extend(self._memory_events())
+        return json.dumps({"traceEvents": events,
+                           "displayTimeUnit": "ms"})
 
 
 def trace_session_run(session, fetches, feed_dict=None, log_dir="/tmp/stf_trace"):
@@ -58,31 +119,10 @@ def trace_session_run(session, fetches, feed_dict=None, log_dir="/tmp/stf_trace"
 
 
 def predicted_vs_measured(fetches, feeds=(), measured_seconds=None):
-    """Static cost-model prediction for ``fetches`` next to a measured
-    step time (ref: grappler/costs/cost_estimator.h — the reference
-    checks its cost model against real run stats the same way).
-
-    Returns predicted FLOPs/bytes/peak-memory, the roofline-projected
-    step seconds for the attached chip, and — when ``measured_seconds``
-    is given — measured/predicted, where >>1 means the program is
-    leaving roofline performance on the table (or the model missed
-    traffic: compare bytes against utils.perf.cost_of on the compiled
-    step to tell which)."""
+    """Static cost-model prediction next to a measured step time.
+    Moved to framework/cost_model.py (the model owns its own
+    verification); kept here as a re-export for existing callers."""
     from ..framework import cost_model
-    from ..utils import perf
 
-    est = cost_model.estimate(fetches, feeds=feeds)
-    peak_flops, peak_bw = perf.chip_spec()
-    out = dict(est.summary())
-    pred_s = est.seconds_on(peak_flops, peak_bw)
-    out["predicted_sec_per_step"] = float(f"{pred_s:.4g}")
-    if pred_s <= cost_model.HOST_DISPATCH_FLOOR_S:
-        # the roofline time is below the host-dispatch floor: the row is
-        # dispatch-bound and measured/predicted compares against the
-        # floor, not the (unreachable) roofline
-        out["dispatch_floor_bound"] = True
-    if measured_seconds:
-        out["measured_sec_per_step"] = float(f"{measured_seconds:.4g}")
-        out["measured_over_predicted"] = round(
-            float(measured_seconds) / max(pred_s, 1e-12), 3)
-    return out
+    return cost_model.predicted_vs_measured(
+        fetches, feeds=feeds, measured_seconds=measured_seconds)

@@ -98,9 +98,12 @@ class DumpingDebugWrapperSession(_WrapperBase):
         self._run_counter += 1
         run_dir = os.path.join(self._root, f"run_{self._run_counter}")
         os.makedirs(run_dir, exist_ok=True)
+        # options/run_metadata forward to the wrapped session: a traced
+        # run through the wrapper must still produce step stats
         result = self._sess.run({"__fetches__": fetches,
                                  "__watched__": watched},
-                                feed_dict=feed_dict)
+                                feed_dict=feed_dict, options=options,
+                                run_metadata=run_metadata)
         manifest = {}
         for t, v in zip(watched, result["__watched__"]):
             safe = t.name.replace("/", "_").replace(":", "_")
@@ -131,7 +134,8 @@ class LocalCLIDebugWrapperSession(_WrapperBase):
         watched = self._watched_tensors(fetches, feed_dict, self._watches)
         result = self._sess.run({"__fetches__": fetches,
                                  "__watched__": watched},
-                                feed_dict=feed_dict)
+                                feed_dict=feed_dict, options=options,
+                                run_metadata=run_metadata)
         bad = []
         for t, v in zip(watched, result["__watched__"]):
             if has_inf_or_nan(t.name, v):

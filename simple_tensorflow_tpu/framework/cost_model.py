@@ -462,6 +462,46 @@ def estimate(fetches, feeds: Sequence[Tensor] = (),
     return est
 
 
+def predicted_vs_measured(fetches, feeds: Sequence[Tensor] = (),
+                          measured_seconds: Optional[float] = None,
+                          est: Optional[CostEstimate] = None
+                          ) -> Dict[str, float]:
+    """Static cost-model prediction for ``fetches`` next to a measured
+    step time (ref: grappler/costs/cost_estimator.h — the reference
+    checks its cost model against real run stats the same way).
+
+    Returns predicted FLOPs/bytes/peak-memory, the roofline-projected
+    step seconds for the attached chip, and — when ``measured_seconds``
+    is given — measured/predicted, where >>1 means the program is
+    leaving roofline performance on the table (or the model missed
+    traffic: compare bytes against utils.perf.cost_of on the compiled
+    step to tell which). Pass a precomputed ``est`` to skip the graph
+    walk (the prediction is a pure function of graph + fetches, so
+    periodic reporters cache it)."""
+    from ..utils import perf
+
+    if est is None:
+        est = estimate(fetches, feeds=feeds)
+    peak_flops, peak_bw = perf.chip_spec()
+    out = dict(est.summary())
+    pred_s = est.seconds_on(peak_flops, peak_bw)
+    out["predicted_sec_per_step"] = float(f"{pred_s:.4g}")
+    if pred_s <= HOST_DISPATCH_FLOOR_S:
+        # the roofline time is below the host-dispatch floor: the row is
+        # dispatch-bound and measured/predicted compares against the
+        # floor, not the (unreachable) roofline
+        out["dispatch_floor_bound"] = True
+    if measured_seconds:
+        out["measured_sec_per_step"] = float(f"{measured_seconds:.4g}")
+        out["measured_over_predicted"] = round(
+            float(measured_seconds) / max(pred_s, 1e-12), 3)
+        # model FLOPs utilization from the unrounded estimate (the
+        # summary()'s tflops rounds small programs to 0)
+        out["mfu"] = round(
+            perf.mfu(est.flops, float(measured_seconds)), 6)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # planning helpers (the consumers grappler's cost model exists for)
 # ---------------------------------------------------------------------------

@@ -35,6 +35,7 @@ valid.
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -42,6 +43,20 @@ import numpy as np
 from . import dtypes as dtypes_mod
 from . import op_registry
 from . import tensor_shape as shape_mod
+from ..platform import monitoring
+
+# per-pass observability (ref: grappler's meta_optimizer logs
+# per-optimizer wall time and "graph rewritten" counts the same way)
+_metric_pass_seconds = monitoring.Sampler(
+    "/stf/graph/optimizer/pass_seconds",
+    monitoring.ExponentialBuckets(1e-6, 4.0, 16),
+    "wall seconds per PassManager pass invocation", "pass")
+_metric_pass_runs = monitoring.Counter(
+    "/stf/graph/optimizer/pass_runs",
+    "PassManager pass invocations", "pass")
+_metric_pass_rewrites = monitoring.Counter(
+    "/stf/graph/optimizer/pass_rewrites",
+    "PassManager pass invocations that changed the graph", "pass")
 
 _FOLDABLE_BLOCKLIST = {"Placeholder", "PlaceholderWithDefault", "Const",
                        "VariableV2", "VarRead", "Assign"}
@@ -1006,7 +1021,19 @@ class PassManager:
     def run(self, graph_def: Dict, keep: Optional[List[str]] = None) -> Dict:
         gd = graph_def
         for p in self.passes:
-            gd = p.run(gd, list(keep or []))
+            t0 = time.perf_counter()
+            with monitoring.traceme(f"graph_pass:{p.name}",
+                                    n_nodes=len(gd.get("node", ()))):
+                new = p.run(gd, list(keep or []))
+            _metric_pass_seconds.get_cell(p.name).add(
+                time.perf_counter() - t0)
+            _metric_pass_runs.get_cell(p.name).increase_by(1)
+            # rewrite detection is a deep dict compare — O(graph bytes),
+            # paid once per (fetches, feeds) plan; identical-object
+            # returns skip it
+            if new is not gd and new != gd:
+                _metric_pass_rewrites.get_cell(p.name).increase_by(1)
+            gd = new
         return gd
 
 

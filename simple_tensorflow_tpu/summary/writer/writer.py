@@ -20,7 +20,7 @@ from ...lib.proto import Writer as ProtoWriter
 
 
 def _encode_event(wall_time, step=None, file_version=None, summary_bytes=None,
-                  graph_bytes=None):
+                  graph_bytes=None, tagged_run_metadata=None):
     w = ProtoWriter()
     w.double_always(1, wall_time)
     if step:
@@ -31,6 +31,9 @@ def _encode_event(wall_time, step=None, file_version=None, summary_bytes=None,
         w.bytes_(4, graph_bytes)
     if summary_bytes:
         w.bytes_(5, summary_bytes)
+    if tagged_run_metadata is not None:  # Event.tagged_run_metadata = 8
+        # (event.proto: 6 is the deprecated LogMessage, 7 session_log)
+        w.message(8, tagged_run_metadata)
     return w.tobytes()
 
 
@@ -140,7 +143,24 @@ class FileWriter:
         pass
 
     def add_run_metadata(self, run_metadata, tag, global_step=None):
-        pass
+        """(ref: writer.py:154 ``add_run_metadata``). Our RunMetadata is
+        dict-shaped (step_stats + cost_graph), so the Event's
+        ``tagged_run_metadata.run_metadata`` bytes carry JSON rather
+        than a RunMetadata proto — same envelope, readable payload."""
+        if run_metadata is None:
+            return
+        import json
+
+        payload = {
+            "step_stats": getattr(run_metadata, "step_stats", None) or {},
+            "cost_graph": getattr(run_metadata, "cost_graph", None) or {},
+        }
+        inner = ProtoWriter()
+        inner.bytes_(1, tag)  # TaggedRunMetadata.tag
+        inner.bytes_(2, json.dumps(payload, default=str).encode())
+        self.add_event(_encode_event(time.time(),
+                                     step=int(global_step or 0),
+                                     tagged_run_metadata=inner))
 
     def flush(self):
         deadline = time.time() + 5

@@ -148,16 +148,24 @@ class CheckpointSaverListener:
 
 
 class StepCounterHook(SessionRunHook):
-    """(ref: basic_session_run_hooks.py:547) — also reports steps/sec."""
+    """(ref: basic_session_run_hooks.py:547) — also reports steps/sec,
+    and closes the perf loop: MFU plus measured-over-predicted step time
+    from the static cost model over the caller's fetches
+    (framework/cost_model.predicted_vs_measured + utils/perf; MFU per
+    Kumar et al., arXiv:1909.09756). ``last_perf`` keeps the latest
+    report for programmatic consumers."""
 
     def __init__(self, every_n_steps=100, every_n_secs=None, output_dir=None,
-                 summary_writer=None):
+                 summary_writer=None, report_mfu=True):
         self._timer = SecondOrStepTimer(every_secs=every_n_secs,
                                         every_steps=every_n_steps
                                         if every_n_secs is None else None)
         self._summary_writer = summary_writer
         self._output_dir = output_dir
+        self._report_mfu = report_mfu
+        self._est_cache = None  # (key, CostEstimate): graph walk done once
         self.last_steps_per_sec = None
+        self.last_perf = None
 
     def begin(self):
         self._global_step_tensor = training_util.get_global_step()
@@ -169,6 +177,33 @@ class StepCounterHook(SessionRunHook):
     def before_run(self, run_context):
         return SessionRunArgs(self._global_step_tensor._ref)
 
+    def _perf_report(self, run_context, sec_per_step):
+        """Best-effort: the caller's fetches drive the cost model; a
+        fetch the model can't cost must never break the training loop."""
+        try:
+            from ..framework import cost_model
+            from ..framework import graph as ops_mod
+            from ..utils import nest
+
+            items = [f for f in nest.flatten(run_context.original_args.fetches)
+                     if isinstance(f, (ops_mod.Tensor, ops_mod.Operation))
+                     or hasattr(f, "_ref")]
+            if not items:
+                return None
+            # the static estimate is a full graph walk — cache it per
+            # (fetches, rewrite_version) so every trigger only pays the
+            # measured-side arithmetic
+            graph = run_context.session.graph
+            key = (tuple(id(i) for i in items),
+                   getattr(graph, "_rewrite_version", 0))
+            if self._est_cache is None or self._est_cache[0] != key:
+                self._est_cache = (key, cost_model.estimate(items))
+            return cost_model.predicted_vs_measured(
+                items, measured_seconds=sec_per_step,
+                est=self._est_cache[1])
+        except Exception:
+            return None
+
     def after_run(self, run_context, run_values):
         step = int(np.asarray(run_values.results))
         if self._timer.should_trigger_for_step(step):
@@ -176,11 +211,26 @@ class StepCounterHook(SessionRunHook):
             if secs is not None and secs > 0:
                 self.last_steps_per_sec = steps / secs
                 logging.info("global_step/sec: %.4g", self.last_steps_per_sec)
+                perf_report = (self._perf_report(run_context, secs / steps)
+                               if self._report_mfu else None)
+                if perf_report is not None:
+                    self.last_perf = perf_report
+                    logging.info(
+                        "perf: mfu=%.4g measured/predicted=%.3g",
+                        perf_report.get("mfu", 0.0),
+                        perf_report.get("measured_over_predicted", 0.0))
                 if self._summary_writer is not None:
-                    from ..summary import summary as summary_mod
-
                     self._summary_writer.add_summary_value(
                         "global_step/sec", self.last_steps_per_sec, step)
+                    if perf_report is not None:
+                        if "mfu" in perf_report:
+                            self._summary_writer.add_summary_value(
+                                "perf/mfu", perf_report["mfu"], step)
+                        if "measured_over_predicted" in perf_report:
+                            self._summary_writer.add_summary_value(
+                                "perf/measured_over_predicted",
+                                perf_report["measured_over_predicted"],
+                                step)
 
 
 class LoggingTensorHook(SessionRunHook):
@@ -342,39 +392,88 @@ class FeedFnHook(SessionRunHook):
 
 
 class ProfilerHook(SessionRunHook):
-    """(ref: basic_session_run_hooks.py:846) — emits chrome traces via
-    jax.profiler instead of the reference's StepStats timeline."""
+    """(ref: basic_session_run_hooks.py:846): requests a
+    ``SOFTWARE_TRACE`` run on trigger steps and writes the resulting
+    step-stats timeline as ``timeline-<step>.json`` chrome traces
+    (load in Perfetto / chrome://tracing). Logs the traced step's MFU
+    from the executable's XLA cost analysis when available.
+    ``use_jax_profiler=True`` additionally wraps trigger steps in a
+    jax.profiler trace (the XLA-kernel-level view)."""
 
     def __init__(self, save_steps=None, save_secs=None,
-                 output_dir="", show_dataflow=True, show_memory=False):
-        self._output_dir = output_dir
+                 output_dir="", show_dataflow=True, show_memory=False,
+                 use_jax_profiler=False):
+        self._output_dir = output_dir or "."
         self._timer = SecondOrStepTimer(every_secs=save_secs,
                                         every_steps=save_steps)
-        self._tracing = False
+        self._show_dataflow = show_dataflow
+        self._show_memory = show_memory
+        self._use_jax_profiler = use_jax_profiler
+        self._jax_tracing = False
+        self._request_summary = False
+        self._next_step = None
+        self.last_trace_path = None
 
     def begin(self):
         self._global_step_tensor = training_util.get_global_step()
+        self._next_step = None
 
     def before_run(self, run_context):
-        step = self._timer.last_triggered_step() or 0
-        if self._timer.should_trigger_for_step(step + 1) and not self._tracing:
-            import jax
+        self._request_summary = (
+            self._next_step is None
+            or self._timer.should_trigger_for_step(self._next_step))
+        opts = None
+        if self._request_summary:
+            from ..client.session import RunOptions
 
-            try:
-                jax.profiler.start_trace(self._output_dir)
-                self._tracing = True
-            except Exception:
-                pass
-        return SessionRunArgs(self._global_step_tensor._ref)
+            opts = RunOptions(trace_level=RunOptions.SOFTWARE_TRACE)
+            if self._use_jax_profiler and not self._jax_tracing:
+                import jax
+
+                try:
+                    jax.profiler.start_trace(self._output_dir)
+                    self._jax_tracing = True
+                except Exception:
+                    pass
+        return SessionRunArgs(self._global_step_tensor._ref, options=opts)
 
     def after_run(self, run_context, run_values):
         step = int(np.asarray(run_values.results))
-        if self._tracing:
-            import jax
-
-            try:
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
-            self._tracing = False
+        if self._request_summary:
             self._timer.update_last_triggered_step(step)
+            if run_values.run_metadata is not None:
+                self._save(step, run_values.run_metadata)
+            if self._jax_tracing:
+                import jax
+
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._jax_tracing = False
+        self._next_step = step + 1
+
+    def _save(self, step, run_metadata):
+        import os
+
+        from ..client.timeline import Timeline
+
+        os.makedirs(self._output_dir, exist_ok=True)
+        path = os.path.join(self._output_dir, f"timeline-{step}.json")
+        with open(path, "w") as f:
+            f.write(Timeline(run_metadata).generate_chrome_trace_format(
+                show_dataflow=self._show_dataflow,
+                show_memory=self._show_memory))
+        self.last_trace_path = path
+        stats = getattr(run_metadata, "step_stats", None) or {}
+        cost = getattr(run_metadata, "cost_graph", None) or {}
+        wall = stats.get("wall_time_s")
+        if wall and cost.get("flops"):
+            from ..utils import perf
+
+            logging.info(
+                "ProfilerHook step %d: wall=%.4gs xla_flops=%.3g "
+                "mfu=%.4g trace=%s", step, wall, cost["flops"],
+                perf.mfu(cost["flops"], wall), path)
+        else:
+            logging.info("ProfilerHook step %d: trace=%s", step, path)

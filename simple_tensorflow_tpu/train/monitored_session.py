@@ -17,7 +17,7 @@ import numpy as np
 from ..framework import graph as ops_mod
 from ..ops import control_flow_ops
 from ..ops import variables as variables_mod
-from ..client.session import Session
+from ..client.session import RunMetadata, RunOptions, Session
 from ..platform import tf_logging as logging
 from . import basic_session_run_hooks
 from . import session_run_hook
@@ -190,6 +190,27 @@ class WorkerSessionCreator(SessionCreator):
         return self._inner.create_session()
 
 
+def _merge_run_options(a, b):
+    """Combine caller RunOptions with hook-requested ones (ref:
+    monitored_session.py merges hook options the same way): highest
+    trace level wins, tightest nonzero deadline wins."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    timeouts = [t for t in (getattr(a, "timeout_in_ms", 0) or 0,
+                            getattr(b, "timeout_in_ms", 0) or 0) if t]
+    return RunOptions(
+        trace_level=max(getattr(a, "trace_level", 0),
+                        getattr(b, "trace_level", 0)),
+        timeout_in_ms=min(timeouts) if timeouts else 0,
+        output_partition_graphs=(
+            getattr(a, "output_partition_graphs", False)
+            or getattr(b, "output_partition_graphs", False)),
+        debug_options=(getattr(a, "debug_options", None)
+                       or getattr(b, "debug_options", None)))
+
+
 class _MonitoredSession:
     """(ref: monitored_session.py:537 ``class _MonitoredSession``)."""
 
@@ -219,6 +240,7 @@ class _MonitoredSession:
             original_args=session_run_hook.SessionRunArgs(fetches, feed_dict),
             session=self._sess)
         hook_fetches = {}
+        merged_options = options
         for i, h in enumerate(self._hooks):
             req = h.before_run(run_contexts)
             if req is None:
@@ -227,12 +249,22 @@ class _MonitoredSession:
                 hook_fetches[i] = req.fetches
             if req.feed_dict:
                 feeds.update(req.feed_dict)
+            if getattr(req, "options", None) is not None:
+                merged_options = _merge_run_options(merged_options,
+                                                    req.options)
         actual_fetches["hooks"] = hook_fetches
-        results = self._sess.run(actual_fetches, feed_dict=feeds)
+        if (run_metadata is None and merged_options is not None
+                and getattr(merged_options, "trace_level", 0) > 0):
+            # a hook asked for tracing: give the run somewhere to put
+            # the step stats so after_run can read them
+            run_metadata = RunMetadata()
+        results = self._sess.run(actual_fetches, feed_dict=feeds,
+                                 options=merged_options,
+                                 run_metadata=run_metadata)
         for i, h in enumerate(self._hooks):
             rv = session_run_hook.SessionRunValues(
-                results=results["hooks"].get(i), options=None,
-                run_metadata=None)
+                results=results["hooks"].get(i), options=merged_options,
+                run_metadata=run_metadata)
             h.after_run(run_contexts, rv)
         if run_contexts.stop_requested:
             self._coord.request_stop()

@@ -117,6 +117,32 @@ def cost_of(compiled) -> Dict[str, float]:
             "bytes": float(ca.get("bytes accessed", 0.0))}
 
 
+def memory_of(compiled) -> Dict[str, int]:
+    """Normalize jax ``Compiled.memory_analysis()`` across versions:
+    {argument_bytes, output_bytes, temp_bytes, alias_bytes,
+    generated_code_bytes, peak_bytes} (peak ≈ arguments + outputs + XLA
+    temp allocation, minus aliased/donated buffers counted twice)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {
+        "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+        "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+        "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+        "generated_code_bytes": int(
+            getattr(ma, "generated_code_size_in_bytes", 0)),
+    }
+    # aliased (donated) buffers are counted in both argument and output
+    # sizes but exist once on device — subtract them from the peak
+    out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                         + out["temp_bytes"] - out["alias_bytes"])
+    return out
+
+
 def mfu(step_flops: float, step_seconds: float, device=None) -> float:
     """Model FLOPs Utilization: achieved/peak."""
     peak, _ = chip_spec(device)

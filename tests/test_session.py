@@ -366,3 +366,161 @@ def _pytest_raises_keyerror_mentioning(word):
 
     with _pytest.raises(KeyError, match=word):
         yield
+
+
+# -- ISSUE 2: lifecycle instrumentation (stf.monitoring + StepStats v2) ------
+
+def _cache_counters():
+    from simple_tensorflow_tpu.platform import monitoring
+
+    exp = monitoring.export()
+    hits = exp["/stf/session/executable_cache/hits"]["cells"].get("", 0)
+    misses = exp["/stf/session/executable_cache/misses"]["cells"]
+    return hits, dict(misses)
+
+
+def test_software_trace_phase_spans_and_cache_counters():
+    import json
+
+    x = stf.placeholder(stf.float32, [None, 3])
+    w = stf.Variable(np.ones((3, 2), np.float32), name="trace_w")
+    y = stf.matmul(x, w)
+    feed = {x: np.ones((2, 3), np.float32)}
+    opts = stf.RunOptions(trace_level=stf.RunOptions.SOFTWARE_TRACE)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        hits0, misses0 = _cache_counters()
+
+        # compile run: >= 5 distinct lifecycle phase spans
+        md = stf.RunMetadata()
+        sess.run(y, feed_dict=feed, options=opts, run_metadata=md)
+        names = [n["name"] for n in md.step_stats["nodes"]]
+        assert {"prune", "optimize", "lower", "jit_compile",
+                "device_execute"} <= set(names)
+        assert len(set(names)) >= 5
+        hits1, misses1 = _cache_counters()
+        assert sum(misses1.values()) == sum(misses0.values()) + 1
+        assert (misses1.get("new_fetch_feed_signature", 0)
+                == misses0.get("new_fetch_feed_signature", 0) + 1)
+
+        # second identical run: a cache hit with ZERO compile spans
+        md2 = stf.RunMetadata()
+        sess.run(y, feed_dict=feed, options=opts, run_metadata=md2)
+        names2 = [n["name"] for n in md2.step_stats["nodes"]]
+        assert "jit_compile" not in names2
+        assert "prune" not in names2 and "optimize" not in names2
+        assert "device_execute" in names2
+        hits2, misses2 = _cache_counters()
+        assert hits2 == hits1 + 1
+        assert sum(misses2.values()) == sum(misses1.values())
+
+        # XLA executable analyses land in cost_graph on traced runs
+        assert md.cost_graph.get("flops", 0) > 0
+        assert md.cost_graph.get("bytes_accessed", 0) > 0
+
+        # the chrome trace is multi-track Perfetto-readable JSON
+        from simple_tensorflow_tpu.client.timeline import Timeline
+
+        trace = json.loads(Timeline(md).generate_chrome_trace_format(
+            show_memory=True))
+        assert trace["displayTimeUnit"] == "ms"
+        evnames = [e["name"] for e in trace["traceEvents"]]
+        assert "process_name" in evnames
+        assert evnames.count("thread_name") >= 2
+        if md.cost_graph.get("memory", {}).get("peak_bytes"):
+            assert any(e.get("ph") == "C" for e in trace["traceEvents"])
+
+
+def test_cache_miss_reason_rewrite_version_bump():
+    x = stf.placeholder(stf.float32, [None, 2])
+    y = x + 1.0
+    feed = {x: np.ones((1, 2), np.float32)}
+    with stf.Session() as sess:
+        sess.run(y, feed_dict=feed)
+        _, misses0 = _cache_counters()
+        # an in-place FuncGraph rewrite bumps the graph rewrite version;
+        # the same (fetches, feeds) signature must re-plan and label the
+        # miss accordingly
+        sess.graph._rewrite_version += 1
+        sess.run(y, feed_dict=feed)
+        _, misses1 = _cache_counters()
+        assert (misses1.get("rewrite_version_bump", 0)
+                == misses0.get("rewrite_version_bump", 0) + 1)
+
+
+def test_untraced_run_records_no_spans_but_counts():
+    x = stf.placeholder(stf.float32, [None, 2])
+    y = x * 2.0
+    with stf.Session() as sess:
+        md = stf.RunMetadata()
+        # run_metadata without trace_level: wall time only, no nodes
+        sess.run(y, feed_dict={x: np.ones((1, 2), np.float32)},
+                 run_metadata=md)
+        assert md.step_stats["wall_time_s"] > 0
+        assert md.step_stats["nodes"] == []
+
+
+def test_run_options_timeout_raises_deadline_exceeded():
+    import time as _time
+
+    def _slow(v):
+        _time.sleep(0.5)
+        return v
+
+    z = stf.py_func(_slow, [stf.constant(np.float32(1.0))], stf.float32)
+    z.set_shape([])
+    with stf.Session() as sess:
+        with pytest.raises(stf.errors.DeadlineExceededError):
+            sess.run(z, options=stf.RunOptions(timeout_in_ms=50))
+        # a generous deadline passes, and the session stays usable
+        out = sess.run(z, options=stf.RunOptions(timeout_in_ms=60000))
+        assert float(np.asarray(out)) == 1.0
+
+
+def test_timeout_preserves_variable_state():
+    import time as _time
+
+    v = stf.Variable(1.0, name="deadline_v")
+    inc = stf.assign_add(v, 1.0)
+
+    def _slow(u):
+        _time.sleep(0.4)
+        return u
+
+    slow = stf.py_func(_slow, [stf.constant(np.float32(0.0))], stf.float32)
+    slow.set_shape([])
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        with pytest.raises(stf.errors.DeadlineExceededError):
+            sess.run([inc, slow], options=stf.RunOptions(timeout_in_ms=50))
+        # the store must not hold donated (deleted) buffers: reads and
+        # further updates still work after the aborted run
+        val = float(np.asarray(sess.run(v)))
+        assert val in (1.0, 2.0)  # commit-then-detect: either is coherent
+        sess.run(inc)
+        assert float(np.asarray(sess.run(v))) == val + 1.0
+
+
+def test_traced_then_shape_change_falls_back_and_recomputes():
+    # a traced first call pins an AOT executable on the step; feeding a
+    # new batch size must transparently fall back to the jit path AND
+    # drop the stale cost analysis so later traced runs re-harvest
+    x = stf.placeholder(stf.float32, [None, 3])
+    y = stf.reduce_sum(x, axis=1)
+    opts = stf.RunOptions(trace_level=stf.RunOptions.SOFTWARE_TRACE)
+    with stf.Session() as sess:
+        md = stf.RunMetadata()
+        out = sess.run(y, {x: np.ones((2, 3), np.float32)},
+                       options=opts, run_metadata=md)
+        assert out.shape == (2,)
+        flops_b2 = md.cost_graph.get("flops", 0)
+        out = sess.run(y, {x: np.ones((64, 3), np.float32)})
+        assert out.shape == (64,)
+        md2 = stf.RunMetadata()
+        out = sess.run(y, {x: np.ones((64, 3), np.float32)},
+                       options=opts, run_metadata=md2)
+        assert out.shape == (64,)
+        if flops_b2 and md2.cost_graph.get("flops"):
+            assert md2.cost_graph["flops"] > flops_b2
+        out = sess.run(y, {x: np.ones((2, 3), np.float32)})
+        assert out.shape == (2,)
