@@ -596,6 +596,71 @@ def _measure_graph_opt(platform, device_kind):
     }
 
 
+def _measure_analysis(platform, device_kind):
+    """stf.analysis overhead row (ISSUE 3 satellite): per-plan cost of
+    the verifier + variable-hazard detector relative to the rest of
+    Session plan time (prune + optimize + lower staging), measured on
+    the mnist convnet training plan via SOFTWARE_TRACE lifecycle spans
+    and the /stf/analysis/plan_check_seconds monitoring sampler. The
+    budget is <5% of plan time ("within_budget" in the row); jit
+    compile is excluded from the denominator — against it the analysis
+    cost would be unmeasurable noise."""
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.models import mnist
+    from simple_tensorflow_tpu.platform import monitoring
+
+    stf.reset_default_graph()
+    m = mnist.convnet_model(batch_size=16)
+    rng = np.random.RandomState(0)
+    feed = {m["x"]: rng.rand(16, 28, 28, 1).astype(np.float32),
+            m["y_"]: rng.randint(0, 10, 16).astype(np.int32),
+            m["keep_prob"]: 0.9}
+    sess = stf.Session(config=stf.ConfigProto(graph_analysis="warn"))
+    sess.run(stf.global_variables_initializer())
+    opts = stf.RunOptions(trace_level=stf.RunOptions.SOFTWARE_TRACE)
+    md = stf.RunMetadata()
+    sess.run([m["train_op"], m["loss"]], feed, options=opts,
+             run_metadata=md)
+    spans = {}
+    for node in md.step_stats.get("nodes", []):
+        phase = node["name"].split(":")[0]
+        spans[phase] = spans.get(phase, 0.0) + node["dur_us"] / 1e6
+    analysis_s = spans.get("analysis", 0.0)
+    plan_s = sum(spans.get(k, 0.0)
+                 for k in ("prune", "optimize", "lower", "analysis"))
+    frac = analysis_s / plan_s if plan_s else 0.0
+    exported = monitoring.export()
+
+    def _cells(name):
+        return exported.get(name, {}).get("cells", {})
+
+    return {
+        "metric": "analysis_overhead_frac",
+        "value": round(frac, 4),
+        "unit": "fraction of plan time (prune+optimize+lower+analysis)",
+        "vs_baseline": None,
+        "within_budget": bool(frac < 0.05),
+        "analysis_ms": round(analysis_s * 1e3, 3),
+        "plan_ms": round(plan_s * 1e3, 3),
+        "n_plan_ops": md.step_stats.get("n_device_ops"),
+        "monitoring": {
+            "diagnostics": _cells("/stf/analysis/diagnostics"),
+            "hazards": _cells("/stf/analysis/hazards"),
+            "auto_control_deps": _cells("/stf/analysis/auto_control_deps"),
+            # count/sum only: raw sampler cells carry an +inf bucket
+            # edge, which json.dumps renders as the nonstandard
+            # `Infinity` token no strict JSON parser accepts
+            "plan_checks": {
+                k: {"count": v["count"], "sum_s": round(v["sum"], 6)}
+                for k, v in _cells(
+                    "/stf/analysis/plan_check_seconds").items()},
+        },
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _measure_transformer(batch, platform, device_kind):
     """BASELINE config 5: Transformer-big WMT en-de training step +
     beam-search inference latency. Comparator 2000 tokens/sec is a
@@ -894,6 +959,8 @@ def child_main():
         result = _measure_resnet_dp()
     elif model == "graph_opt":
         result = _measure_graph_opt(platform, kind)
+    elif model == "analysis":
+        result = _measure_analysis(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -951,7 +1018,8 @@ def _run_model(model, platform, kind, errors):
     # cannot eat the driver's whole bench budget
     # resnet runs up to 5 compile+measure cycles (2 batch + 3 variants)
     default_timeout = {"resnet": "2400", "bert": "1500",
-                       "transformer": "1200", "mnist": "300"}.get(
+                       "transformer": "1200", "mnist": "300",
+                       "analysis": "600"}.get(
         model, "900")
     if platform is not None and platform != "cpu":
         env = dict(os.environ)
@@ -996,6 +1064,8 @@ _METRIC_NAMES = {
                     "tokens/sec/chip"),
     "resnet_dp": ("resnet50_dp8_sharding_efficiency", "fraction_of_ideal"),
     "graph_opt": ("graph_opt_cond_scan_step_ms", "ms/step (optimized)"),
+    "analysis": ("analysis_overhead_frac",
+                 "fraction of plan time (prune+optimize+lower+analysis)"),
 }
 
 
@@ -1014,7 +1084,7 @@ def main():
     selected = []
     for tok in os.environ.get(
             "BENCH_MODELS",
-            "resnet,bert,transformer,mnist,resnet_dp,graph_opt"
+            "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis"
             ).split(","):
         tok = tok.strip()
         if not tok:
@@ -1030,7 +1100,7 @@ def main():
         print("BENCH_MODELS selected nothing; running the default set",
               file=sys.stderr)
         selected = ["resnet", "bert", "transformer", "mnist",
-                    "resnet_dp", "graph_opt"]
+                    "resnet_dp", "graph_opt", "analysis"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

@@ -266,4 +266,8 @@ from .framework.gradients import (
 )
 from .framework.random_seed import get_seed
 
+# static analysis: graph verifier, variable-hazard detector, lint
+# framework (stf.analysis; see docs/ANALYSIS.md)
+from . import analysis
+
 newaxis = None

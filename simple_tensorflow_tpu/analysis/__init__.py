@@ -1,0 +1,84 @@
+"""stf.analysis: static analysis over the Graph IR (ISSUE 3 tentpole).
+
+Three pillars, all emitting structured :class:`Diagnostic` objects that
+carry the offending op's name/type and the user-code ``file:line``
+captured at op creation:
+
+- **verifier** (:mod:`.verifier`) — structural invariants: dangling
+  inputs, ordering/cycle violations (including through FuncGraph
+  bodies), abstract-eval dtype/shape re-checks, host/device staging
+  violations, silently-pruned stateful ops.
+- **variable-hazard detector** (:mod:`.hazards`) — RAW/WAR/WAW between
+  effectful ops with no ordering path, over the declared per-op effect
+  sets (framework/op_registry.py ``Effects``); modes
+  off|warn|raise|auto_deps (auto_deps reproduces the reference's
+  auto-control-dependencies by enforcing program order).
+- **lint framework** (:mod:`.lint`) — registerable :class:`LintRule`
+  checks with per-run severity config (numerics, RNG seeding,
+  constant-foldable fetches, surviving transpose pairs).
+
+Entry points: ``verify_graph`` / ``verify_graphdef`` / ``lint_graph``
+standalone; ``analyze`` for the combined report; Session wires
+``hazards.check_plan`` per run plan and ``verify_graph`` under
+``ConfigProto(graph_analysis=...)``; PassManager runs ``verify_graphdef``
+as pre/post pass invariants; ``python -m
+simple_tensorflow_tpu.tools.graph_lint`` covers serialized graphs.
+Monitoring: ``/stf/analysis/*`` counters (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from ..framework.graph import (set_traceback_capture,
+                               traceback_capture_enabled)
+from ..framework.op_registry import Effects, declare_effects
+from . import diagnostics, effects, hazards, lint, verifier
+from .diagnostics import (ERROR, NOTE, WARNING, Diagnostic, errors,
+                          format_report, max_severity, warnings)
+from .effects import ResolvedEffects, op_effects
+from .hazards import (MODES as HAZARD_MODES, Hazard, check_plan,
+                      find_hazards, get_hazard_mode, set_hazard_mode)
+from .lint import (LintContext, LintRule, lint_graph, register_lint_rule,
+                   registered_rules)
+from .verifier import verify_graph, verify_graphdef, verify_ops
+
+__all__ = [
+    "Diagnostic", "ERROR", "WARNING", "NOTE",
+    "errors", "warnings", "max_severity", "format_report",
+    "Effects", "ResolvedEffects", "op_effects", "declare_effects",
+    "Hazard", "HAZARD_MODES", "find_hazards", "check_plan",
+    "set_hazard_mode", "get_hazard_mode",
+    "LintRule", "LintContext", "lint_graph", "register_lint_rule",
+    "registered_rules",
+    "verify_graph", "verify_graphdef", "verify_ops",
+    "set_traceback_capture", "traceback_capture_enabled",
+    "analyze",
+]
+
+
+def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
+            level: str = "full",
+            severities: Optional[dict] = None) -> List[Diagnostic]:
+    """Run verifier + hazard detector + linter over a graph and return
+    all diagnostics (the combined standalone entry point; the CLI and
+    the models/examples CI gate call this)."""
+    from ..framework import graph as ops_mod
+    from ..framework import lowering as lowering_mod
+
+    graph = graph or ops_mod.get_default_graph()
+    diags = verify_graph(graph, fetches=fetches, level=level)
+    if fetches:
+        # hazards are a per-step property: analyze the fetch closure (the
+        # plan Session.run would execute), not unrelated graph regions
+        # that never share a step (init assigns vs. train reads)
+        targets = [f if isinstance(f, ops_mod.Operation) else f.op
+                   for f in fetches]
+        plan = lowering_mod.prune(targets, set())
+        for h in hazards.find_hazards(plan):
+            diags.append(h.to_diagnostic(WARNING))
+            diagnostics.metric_hazards.get_cell(h.kind).increase_by(1)
+            diagnostics.metric_diagnostics.get_cell(
+                WARNING).increase_by(1)
+    diags.extend(lint_graph(graph, fetches=fetches, severities=severities))
+    return diags

@@ -1,0 +1,141 @@
+"""Structured diagnostics for stf.analysis.
+
+Every finding the static-analysis layer produces — verifier invariant
+violations, variable hazards, lint smells — is a :class:`Diagnostic`:
+a severity, a stable ``code`` ("verifier/dangling-input",
+"hazard/raw", "lint/unseeded-rng"), a human message, and the offending
+op's name/type plus the user-code ``file:line`` captured at op creation
+(framework/graph.py traceback capture). The reference emits comparable
+information as Status payloads from graph validation
+(core/graph/validate.cc) but without source attribution; pointing at
+user code is the whole point here — a bad graph must be debuggable
+before a multi-second XLA compile, not after.
+
+Emission is observable: every diagnostic constructed through
+``report()`` bumps the ``/stf/analysis/diagnostics`` counter (labeled
+by severity) so the monitoring layer (docs/OBSERVABILITY.md) covers the
+analysis subsystem.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..platform import monitoring
+
+# -- severities --------------------------------------------------------------
+
+NOTE = "note"
+WARNING = "warning"
+ERROR = "error"
+
+_SEVERITY_ORDER = {NOTE: 0, WARNING: 1, ERROR: 2}
+
+SEVERITIES = (NOTE, WARNING, ERROR)
+
+# -- monitoring (ISSUE 3 satellite: stf/analysis/* counters) -----------------
+
+metric_diagnostics = monitoring.Counter(
+    "/stf/analysis/diagnostics",
+    "diagnostics produced by the static-analysis layer", "severity")
+metric_hazards = monitoring.Counter(
+    "/stf/analysis/hazards",
+    "variable hazards detected between unordered effectful ops", "kind")
+metric_auto_deps = monitoring.Counter(
+    "/stf/analysis/auto_control_deps",
+    "hazard pairs ordered by auto_deps (program-order control edges)")
+metric_check_seconds = monitoring.Sampler(
+    "/stf/analysis/plan_check_seconds",
+    monitoring.ExponentialBuckets(1e-6, 4.0, 16),
+    "verifier+hazard seconds per Session plan analysis")
+
+
+class Diagnostic:
+    """One analysis finding, with op + source attribution."""
+
+    __slots__ = ("severity", "code", "message", "op_name", "op_type",
+                 "source")
+
+    def __init__(self, severity: str, code: str, message: str,
+                 op: Any = None, op_name: Optional[str] = None,
+                 op_type: Optional[str] = None,
+                 source: Optional[str] = None):
+        if severity not in _SEVERITY_ORDER:
+            raise ValueError(f"severity must be one of {SEVERITIES}, "
+                             f"got {severity!r}")
+        self.severity = severity
+        self.code = code
+        self.message = message
+        if op is not None:
+            op_name = op_name or getattr(op, "name", None)
+            op_type = op_type or getattr(op, "type", None)
+            source = source or getattr(op, "source_site", None)
+        self.op_name = op_name
+        self.op_type = op_type
+        self.source = source
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity == ERROR
+
+    def with_severity(self, severity: str) -> "Diagnostic":
+        return Diagnostic(severity, self.code, self.message,
+                          op_name=self.op_name, op_type=self.op_type,
+                          source=self.source)
+
+    def format(self) -> str:
+        loc = ""
+        if self.op_name:
+            loc = f" [op {self.op_type or '?'} {self.op_name!r}"
+            if self.source:
+                loc += f" at {self.source}"
+            loc += "]"
+        elif self.source:
+            loc = f" [at {self.source}]"
+        return f"{self.severity.upper()} {self.code}: {self.message}{loc}"
+
+    def to_dict(self) -> dict:
+        return {"severity": self.severity, "code": self.code,
+                "message": self.message, "op_name": self.op_name,
+                "op_type": self.op_type, "source": self.source}
+
+    def __repr__(self):
+        return f"<Diagnostic {self.format()}>"
+
+
+def report(diags: List[Diagnostic], severity: str, code: str, message: str,
+           op: Any = None, **kw) -> Diagnostic:
+    """Construct a Diagnostic, append it to ``diags``, count it."""
+    d = Diagnostic(severity, code, message, op=op, **kw)
+    diags.append(d)
+    metric_diagnostics.get_cell(d.severity).increase_by(1)
+    return d
+
+
+def max_severity(diags: Sequence[Diagnostic]) -> Optional[str]:
+    if not diags:
+        return None
+    return max(diags, key=lambda d: _SEVERITY_ORDER[d.severity]).severity
+
+
+def errors(diags: Sequence[Diagnostic]) -> List[Diagnostic]:
+    return [d for d in diags if d.severity == ERROR]
+
+
+def warnings(diags: Sequence[Diagnostic]) -> List[Diagnostic]:
+    return [d for d in diags if d.severity == WARNING]
+
+
+def format_report(diags: Sequence[Diagnostic],
+                  header: Optional[str] = None) -> str:
+    lines = [header] if header else []
+    order = {ERROR: 0, WARNING: 1, NOTE: 2}
+    for d in sorted(diags, key=lambda d: (order[d.severity],
+                                          d.code, d.op_name or "")):
+        lines.append("  " + d.format() if header else d.format())
+    counts = {s: sum(1 for d in diags if d.severity == s)
+              for s in (ERROR, WARNING, NOTE)}
+    lines.append(("  " if header else "")
+                 + f"{counts[ERROR]} error(s), {counts[WARNING]} "
+                   f"warning(s), {counts[NOTE]} note(s)")
+    return "\n".join(lines)

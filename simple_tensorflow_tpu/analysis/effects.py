@@ -1,0 +1,92 @@
+"""Per-op effect resolution over the declared ``Effects`` sets.
+
+The op registry declares effects per op *type* with resource selectors
+(framework/op_registry.py ``Effects``); this module resolves them
+against a concrete :class:`Operation`'s attrs into the
+``ResolvedEffects`` the hazard detector and the debug CLI consume —
+e.g. an ``Assign`` with ``attrs["var_name"] == "w"`` resolves to
+``writes={"var_name=w"}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, FrozenSet, Optional
+
+from ..framework import op_registry
+
+Effects = op_registry.Effects
+NO_EFFECTS = op_registry.NO_EFFECTS
+
+
+class ResolvedEffects:
+    """Concrete effect instance of one Operation."""
+
+    __slots__ = ("reads", "writes", "rng", "io", "update", "declared")
+
+    def __init__(self, reads: FrozenSet[str], writes: FrozenSet[str],
+                 rng: bool, io: bool, update: Optional[str],
+                 declared: bool):
+        self.reads = reads
+        self.writes = writes
+        self.rng = rng
+        self.io = io
+        self.update = update
+        self.declared = declared
+
+    def __bool__(self):
+        return bool(self.reads or self.writes or self.rng or self.io)
+
+    def describe(self) -> str:
+        """Compact single-line rendering for CLIs/diagnostics, e.g.
+        ``reads={var_name=w} writes={var_name=w}(add) rng``."""
+        parts = []
+        if self.reads:
+            parts.append("reads={" + ",".join(sorted(self.reads)) + "}")
+        if self.writes:
+            w = "writes={" + ",".join(sorted(self.writes)) + "}"
+            if self.update:
+                w += f"({self.update})"
+            parts.append(w)
+        if self.rng:
+            parts.append("rng")
+        if self.io:
+            parts.append("io")
+        if not parts:
+            return "pure"
+        if not self.declared:
+            parts.append("(synthesized)")
+        return " ".join(parts)
+
+
+_EMPTY = frozenset()
+
+
+def op_effects(op: Any) -> ResolvedEffects:
+    """Resolve the declared effect set of one Operation (unregistered op
+    types resolve as pure — import-time registration is authoritative)."""
+    try:
+        od = op_registry.get(op.type)
+    except KeyError:
+        return ResolvedEffects(_EMPTY, _EMPTY, False, False, None, False)
+    eff = od.effects
+    if not eff:
+        return ResolvedEffects(_EMPTY, _EMPTY, False, False, None,
+                               od.effects_declared)
+    return ResolvedEffects(
+        eff.resolved_reads(op), eff.resolved_writes(op), eff.rng, eff.io,
+        eff.update, od.effects_declared)
+
+
+def commuting_writes(a: ResolvedEffects, b: ResolvedEffects) -> bool:
+    """True when two writes to the same resource are order-independent:
+    additive updates commute with each other (AssignAdd/AssignSub,
+    ScatterAdd/ScatterSub), same-kind min/max updates are idempotent
+    under reordering. Overwrites (update=None or "update") never
+    commute with anything — the last writer wins."""
+    if a.update in ("add", "sub") and b.update in ("add", "sub"):
+        return True
+    if a.update in ("mul", "div") and b.update in ("mul", "div"):
+        return True
+    if a.update in ("min", "max") and a.update == b.update:
+        return True
+    return False

@@ -1,0 +1,297 @@
+"""Variable-hazard (race) detector over one Session.run plan.
+
+SURVEY §5 / ISSUE 3 pillar 2: within one pruned step, two effectful ops
+touching the same resource with NO data or control path between them
+execute in an arbitrary topological tie-break order — the observed value
+is nondeterministic by construction (the reference's executor runs such
+nodes concurrently and calls the result "undefined",
+core/common_runtime/executor.cc). Using the declared effect sets
+(framework/op_registry.py ``Effects``) this module classifies every
+unordered conflicting pair:
+
+  RAW — a write precedes a read in program order but nothing orders them
+  WAR — a read precedes a write in program order but nothing orders them
+  WAW — two non-commuting writes to the same resource are unordered
+
+Modes (``set_hazard_mode`` / env ``STF_HAZARD_MODE`` / per-session
+``ConfigProto(variable_hazard_mode=...)``):
+
+  off       — detector disabled
+  warn      — hazards become WARNING diagnostics (logged once per plan)
+  raise     — variable hazards raise InvalidArgumentError at plan time
+              (the pre-existing read-your-write contract, now covering
+              WAW too); non-variable resources stay warnings
+  auto_deps — missing orderings are resolved by *program order* (op
+              creation order), reproducing the reference's
+              auto-control-dependencies (python/framework/
+              auto_control_deps.py): the plan's op list is re-ordered to
+              creation order, which is always a valid topological order
+              of the append-only IR, so every conflicting pair executes
+              in the order the user wrote it — deterministically.
+
+Enforcement scope: only ``var_name=`` resources (device variable state,
+donated HBM buffers) raise / get auto-deps. Host-side resources (queues,
+staging areas, barriers, tables) execute on one thread in plan order and
+commonly pipeline across runs, so their hazards are surfaced as
+warnings, never errors.
+
+Reads whose outputs feed nothing inside the step (bare fetches) are
+exempt: they are observations with documented topological-position
+semantics (ops/state_ops.py ReadVariable), not computation.
+
+Cost: one forward bitmask propagation over the topologically ordered
+plan — O(ops × edges) integer ops, not per-pair BFS.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..framework.errors import InvalidArgumentError
+from . import diagnostics as diag_mod
+from .effects import commuting_writes, op_effects
+
+RAW = "raw"
+WAR = "war"
+WAW = "waw"
+
+MODES = ("off", "warn", "raise", "auto_deps")
+
+# resources in this class are enforceable (raise / auto_deps); everything
+# else is advisory
+_ENFORCED_PREFIX = "var_name="
+
+_mode = os.environ.get("STF_HAZARD_MODE", "raise")
+if _mode not in MODES:  # a typo'd env var must not silently disable
+    _mode = "raise"
+
+
+def set_hazard_mode(mode: str) -> str:
+    """Set the process-default hazard mode; returns the previous one."""
+    global _mode
+    if mode not in MODES:
+        raise ValueError(f"hazard mode must be one of {MODES}, got {mode!r}")
+    prev = _mode
+    _mode = mode
+    return prev
+
+
+def get_hazard_mode() -> str:
+    return _mode
+
+
+class Hazard:
+    """One unordered conflicting pair. ``first``/``second`` follow
+    program (creation) order — the order auto_deps enforces."""
+
+    __slots__ = ("kind", "resource", "first", "second")
+
+    def __init__(self, kind: str, resource: str, first: Any, second: Any):
+        self.kind = kind
+        self.resource = resource
+        self.first = first
+        self.second = second
+
+    @property
+    def enforced(self) -> bool:
+        return self.resource.startswith(_ENFORCED_PREFIX)
+
+    def describe(self) -> str:
+        def at(op):
+            src = op.source_site
+            return f"{op.name!r} ({op.type}" + (f" at {src})" if src
+                                                else ")")
+        res = self.resource.split("=", 1)[-1]
+        return (f"{self.kind.upper()} hazard on {res!r}: {at(self.first)} "
+                f"and {at(self.second)} have no data or control-dependency "
+                f"path between them, so the value observed depends on an "
+                f"arbitrary execution order")
+
+    def guidance(self) -> str:
+        return ("Order them explicitly — e.g. `with stf.control_"
+                "dependencies([write_op]): v.read_value()` (read-after-"
+                "write) or `with stf.control_dependencies([read]): "
+                "v.assign(...)` (write-after-read) — or opt into program-"
+                "order auto control dependencies with hazard mode "
+                "'auto_deps' (stf.analysis.set_hazard_mode or "
+                "ConfigProto(variable_hazard_mode='auto_deps')).")
+
+    def to_diagnostic(self, severity: str) -> diag_mod.Diagnostic:
+        return diag_mod.Diagnostic(
+            severity, f"hazard/{self.kind}", self.describe(),
+            op=self.second)
+
+    def __repr__(self):
+        return (f"<Hazard {self.kind} {self.resource} "
+                f"{self.first.name}~{self.second.name}>")
+
+
+def find_hazards(op_list: Sequence[Any],
+                 alias: Optional[Dict[Any, Any]] = None) -> List[Hazard]:
+    """Detect all RAW/WAR/WAW hazards in one topologically ordered,
+    ancestor-closed plan. ``alias`` is the plan-time CSE map (duplicate
+    tensor → canonical) — edges through CSE-removed ops must be followed
+    via their canonical, or a fully ordered graph would be misreported
+    as racy."""
+    alias = alias or {}
+    readers: Dict[str, List[Any]] = {}
+    writers: Dict[str, List[Any]] = {}
+    eff_of: Dict[Any, Any] = {}
+    for op in op_list:
+        eff = op_effects(op)
+        if not (eff.reads or eff.writes):
+            continue
+        eff_of[op] = eff
+        for r in eff.reads:
+            readers.setdefault(r, []).append(op)
+        for w in eff.writes:
+            writers.setdefault(w, []).append(op)
+
+    # resources that can actually conflict: >=1 writer and >=2 accessors
+    interesting = [res for res, ws in writers.items()
+                   if len(ws) + len([r for r in readers.get(res, ())
+                                     if r not in ws]) >= 2]
+    if not interesting:
+        return []
+
+    step_set = set(op_list)
+
+    def consumed_in_step(r) -> bool:
+        for out in r.outputs:
+            for c in out.consumers():
+                if c in step_set:
+                    return True
+        return False
+
+    tracked: List[Any] = []
+    seen: Set[int] = set()
+    for res in interesting:
+        for op in writers.get(res, ()):
+            if id(op) not in seen:
+                seen.add(id(op))
+                tracked.append(op)
+        for op in readers.get(res, ()):
+            if id(op) not in seen and consumed_in_step(op):
+                seen.add(id(op))
+                tracked.append(op)
+    if len(tracked) < 2:
+        return []
+    bit = {op: 1 << i for i, op in enumerate(tracked)}
+
+    # one forward sweep over the (topologically ordered) plan computes,
+    # per op, the set of tracked ops among its ancestors
+    reach: Dict[Any, int] = {}
+    for op in op_list:
+        m = 0
+        for t in op.inputs:
+            p = alias.get(t, t).op
+            m |= reach.get(p, 0) | bit.get(p, 0)
+        for p in op.control_inputs:
+            m |= reach.get(p, 0) | bit.get(p, 0)
+        reach[op] = m
+
+    def unordered(a, b) -> bool:
+        return not (reach[b] & bit[a] or reach[a] & bit[b])
+
+    hazards: List[Hazard] = []
+    emitted: Set[Tuple[int, int, str]] = set()
+
+    def emit(kind, res, a, b):
+        first, second = (a, b) if a._id <= b._id else (b, a)
+        key = (id(first), id(second), res)
+        if key in emitted:
+            return
+        emitted.add(key)
+        hazards.append(Hazard(kind, res, first, second))
+
+    for res in interesting:
+        ws = writers.get(res, ())
+        rs = [r for r in readers.get(res, ())
+              if r in bit and r not in ws]
+        for i, w1 in enumerate(ws):
+            for w2 in ws[i + 1:]:
+                if w2 is w1 or not unordered(w1, w2):
+                    continue
+                if commuting_writes(eff_of[w1], eff_of[w2]):
+                    continue
+                emit(WAW, res, w1, w2)
+            for r in rs:
+                if unordered(w1, r):
+                    emit(RAW if w1._id <= r._id else WAR, res, w1, r)
+    return hazards
+
+
+def check_plan(op_list: Sequence[Any],
+               alias: Optional[Dict[Any, Any]] = None,
+               mode: Optional[str] = None,
+               diags: Optional[List[diag_mod.Diagnostic]] = None
+               ) -> Tuple[List[Any], List[diag_mod.Diagnostic]]:
+    """Run the hazard policy over one plan. Returns the (possibly
+    re-ordered, auto_deps mode) op list and the diagnostics produced.
+    Raises InvalidArgumentError in "raise" mode on enforceable hazards."""
+    diags = diags if diags is not None else []
+    mode = mode or _mode
+    if mode not in MODES:
+        raise ValueError(f"hazard mode must be one of {MODES}, got {mode!r}")
+    if mode == "off":
+        return list(op_list), diags
+    hazards = find_hazards(op_list, alias)
+    if not hazards:
+        return list(op_list), diags
+    for h in hazards:
+        diag_mod.metric_hazards.get_cell(h.kind).increase_by(1)
+    enforced = [h for h in hazards if h.enforced]
+    advisory = [h for h in hazards if not h.enforced]
+    out_list = list(op_list)
+    for h in advisory:
+        d = h.to_diagnostic(diag_mod.WARNING)
+        diags.append(d)
+        diag_mod.metric_diagnostics.get_cell(d.severity).increase_by(1)
+    if mode == "raise" and enforced:
+        # raise on read/write conflicts (the pre-existing
+        # read-your-write contract); WAW pairs — two writes, no read
+        # observing between them — stay warnings under "raise": grouping
+        # an initializer with an overwrite (Scaffold custom init_op
+        # pattern) is common working code whose last-writer tie-break
+        # users already rely on. auto_deps orders them too.
+        raising = [h for h in enforced if h.kind != WAW]
+        for h in enforced:
+            if h.kind == WAW:
+                d = h.to_diagnostic(diag_mod.WARNING)
+                d.message += ". " + h.guidance()
+                diags.append(d)
+                diag_mod.metric_diagnostics.get_cell(
+                    d.severity).increase_by(1)
+        if raising:
+            h = raising[0]
+            raise InvalidArgumentError(
+                None, h.second,
+                h.describe() + ". " + h.guidance()
+                + (f" ({len(raising) - 1} further hazard(s) in this "
+                   "plan.)" if len(raising) > 1 else ""))
+        return out_list, diags
+    if mode == "auto_deps" and enforced:
+        # program order (creation order) is always a valid topological
+        # order of the append-only IR — inputs and control deps exist
+        # before their consumer — so re-sorting by op id both preserves
+        # every existing ordering and totally orders the hazard pairs,
+        # exactly the reference's auto-control-dependencies semantics
+        out_list = sorted(op_list, key=lambda op: op._id)
+        diag_mod.metric_auto_deps.get_cell().increase_by(len(enforced))
+        for h in enforced:
+            d = h.to_diagnostic(diag_mod.NOTE)
+            d.message += (" — ordered by program order "
+                          f"({h.first.name!r} before {h.second.name!r}, "
+                          "auto_deps)")
+            diags.append(d)
+            diag_mod.metric_diagnostics.get_cell(
+                d.severity).increase_by(1)
+    elif enforced:  # warn (and raise-mode leftovers are unreachable)
+        for h in enforced:
+            d = h.to_diagnostic(diag_mod.WARNING)
+            d.message += ". " + h.guidance()
+            diags.append(d)
+            diag_mod.metric_diagnostics.get_cell(
+                d.severity).increase_by(1)
+    return out_list, diags

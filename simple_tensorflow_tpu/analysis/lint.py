@@ -1,0 +1,275 @@
+"""Extensible lint framework over the Graph IR.
+
+A :class:`LintRule` is a named check with a default severity; rules
+registered through :func:`register_lint_rule` run under
+:func:`lint_graph` (whole graph or a fetch-pruned op list) and yield
+:class:`~.diagnostics.Diagnostic` objects with op + user-source
+attribution. Severities are per-run configurable
+(``lint_graph(severities={"lint/unseeded-rng": "error"})``) so a CI
+gate can promote any smell to a failure without code changes.
+
+Built-in catalog (see docs/ANALYSIS.md for the worked examples):
+
+  lint/int-div-float     integer division truncates, then the truncated
+                         result feeds a float computation (WARNING)
+  lint/narrow-64bit      a 64-bit tensor is declared while the runtime
+                         narrows to 32-bit (jax_enable_x64 off): the
+                         site that will silently lose precision (NOTE)
+  lint/unseeded-rng      an RNG-effect op with neither graph nor op
+                         seed: irreproducible across processes under
+                         jit (WARNING)
+  lint/const-fetch       a fetch is entirely constant-foldable — it is
+                         recomputed (or at best re-fetched) every step
+                         (NOTE)
+  lint/transpose-pair    adjacent mutually inverse transposes survive
+                         where the layout pass cannot cancel them
+                         (control deps / multi-consumer boundaries)
+                         (WARNING)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from . import diagnostics as diag_mod
+from .diagnostics import ERROR, NOTE, WARNING, Diagnostic
+from .effects import op_effects
+
+
+class LintContext:
+    """What one lint run sees: the op list (graph order), the owning
+    graph, and the optional fetch set."""
+
+    def __init__(self, graph, ops: Sequence[Any],
+                 fetches: Optional[Sequence[Any]] = None):
+        self.graph = graph
+        self.ops = list(ops)
+        self.fetches = list(fetches or [])
+        self._x64 = None
+
+    @property
+    def x64_enabled(self) -> bool:
+        if self._x64 is None:
+            import jax
+
+            self._x64 = bool(jax.config.jax_enable_x64)
+        return self._x64
+
+
+class LintRule:
+    """One registered rule. ``check(ctx)`` yields (op, message) pairs —
+    severity/code attachment and counting happen in the driver."""
+
+    def __init__(self, code: str, default_severity: str,
+                 check: Callable[[LintContext], Iterable],
+                 doc: str = ""):
+        if not code.startswith("lint/"):
+            code = "lint/" + code
+        self.code = code
+        self.default_severity = default_severity
+        self.check = check
+        self.doc = doc or (check.__doc__ or "").strip()
+
+    def __repr__(self):
+        return f"<LintRule {self.code} ({self.default_severity})>"
+
+
+_RULES: Dict[str, LintRule] = {}
+
+
+def register_lint_rule(code: str, default_severity: str = WARNING,
+                       doc: str = ""):
+    """Decorator: register ``fn(ctx) -> iterable of (op, message)`` as a
+    lint rule. Re-registration replaces (rules are module-reloadable)."""
+    def deco(fn):
+        rule = LintRule(code, default_severity, fn, doc)
+        _RULES[rule.code] = rule
+        return fn
+
+    return deco
+
+
+def registered_rules() -> List[LintRule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def lint_graph(graph=None, ops: Optional[Sequence[Any]] = None,
+               fetches: Optional[Sequence[Any]] = None,
+               severities: Optional[Dict[str, str]] = None,
+               rules: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Run the registered rules. ``severities`` overrides per-code
+    severity ("off" disables a rule); ``rules`` restricts to a subset."""
+    if graph is None and ops is None:
+        graph = ops_mod.get_default_graph()
+    if ops is None:
+        ops = graph.get_operations()
+    ctx = LintContext(graph, ops, fetches)
+    severities = severities or {}
+    diags: List[Diagnostic] = []
+    for rule in registered_rules():
+        if rules is not None and rule.code not in rules \
+                and rule.code[len("lint/"):] not in rules:
+            continue
+        sev = severities.get(rule.code,
+                             severities.get(rule.code[len("lint/"):],
+                                            rule.default_severity))
+        if sev == "off":
+            continue
+        for op, message in rule.check(ctx):
+            diag_mod.report(diags, sev, rule.code, message, op=op)
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# built-in rules
+# ---------------------------------------------------------------------------
+
+_INT_DIV_TYPES = ("Div", "FloorDiv")
+
+
+@register_lint_rule("int-div-float", WARNING)
+def _rule_int_div_float(ctx):
+    """Integer division truncates; feeding the truncated quotient into a
+    float computation is almost always a missing cast on the operands
+    (classic: ``mean = total / count`` with int tensors)."""
+    for op in ctx.ops:
+        if op.type not in _INT_DIV_TYPES or not op.outputs:
+            continue
+        out = op.outputs[0]
+        if not out.dtype.base_dtype.is_integer:
+            continue
+        for consumer in out.consumers():
+            floaty = False
+            if consumer.type == "Cast":
+                to = consumer.attrs.get("dtype")
+                floaty = to is not None and \
+                    dtypes_mod.as_dtype(to).is_floating
+            else:
+                floaty = any(
+                    t is not out and t.dtype.base_dtype.is_floating
+                    for t in consumer.inputs) or any(
+                    t.dtype.base_dtype.is_floating
+                    for t in consumer.outputs)
+            if floaty:
+                yield (op,
+                       f"integer division {op.name!r} truncates before "
+                       f"feeding float computation {consumer.name!r} "
+                       f"({consumer.type}); cast the operands to float "
+                       "first (or use stf.truediv)")
+                break
+
+
+_WIDE_DTYPES = ("int64", "uint64", "float64")
+# op types whose 64-bit output is a deliberate API contract, narrowed
+# once at the session boundary (see docs/MIGRATION.md): re-flagging
+# every op in between would bury the signal
+_NARROW_SOURCE_TYPES = ("Placeholder", "PlaceholderWithDefault",
+                        "VariableV2", "Const")
+
+
+@register_lint_rule("narrow-64bit", NOTE)
+def _rule_narrow_64bit(ctx):
+    """64-bit tensors silently narrow to 32-bit on TPU (jax x64 off).
+    Flags the *source* sites (placeholders, variables, constants) where
+    the narrowing enters the graph."""
+    if ctx.x64_enabled:
+        return
+    for op in ctx.ops:
+        if op.type not in _NARROW_SOURCE_TYPES:
+            continue
+        for out in op.outputs:
+            if out.dtype.base_dtype.name in _WIDE_DTYPES:
+                yield (op,
+                       f"{op.type} {op.name!r} declares "
+                       f"{out.dtype.base_dtype.name}, which narrows to "
+                       f"{dtypes_mod.narrowed_if_no_x64(out.dtype.base_dtype).name}"
+                       " on this runtime (jax_enable_x64 off); declare "
+                       "the 32-bit dtype to make the precision explicit")
+                break
+
+
+@register_lint_rule("unseeded-rng", WARNING)
+def _rule_unseeded_rng(ctx):
+    """An RNG op with neither a graph seed nor an op seed draws from a
+    different stream every process start — irreproducible under jit.
+    Set stf.set_random_seed(...) or pass seed= at the op."""
+    for op in ctx.ops:
+        eff = op_effects(op)
+        if not eff.rng:
+            continue
+        if op.attrs.get("seed") is None \
+                and op.attrs.get("_graph_seed") is None \
+                and (op.graph.seed is None):
+            yield (op,
+                   f"RNG op {op.name!r} ({op.type}) has no seed and the "
+                   "graph seed is unset: draws are irreproducible "
+                   "across process restarts")
+
+
+@register_lint_rule("const-fetch", NOTE)
+def _rule_const_fetch(ctx):
+    """A fetch whose whole ancestry is constant re-evaluates (at best
+    re-fetches) an invariant value every step; fold it at build time or
+    fetch it once."""
+    if not ctx.fetches:
+        return
+    cache: Dict[Any, bool] = {}
+
+    def const_only(op) -> bool:
+        if op in cache:
+            return cache[op]
+        cache[op] = False  # cycle guard
+        try:
+            od = op_registry.get(op.type)
+        except KeyError:
+            return False
+        if op.type == "Const":
+            cache[op] = True
+            return True
+        if od.is_stateful or od.runs_on_host or od.pure_fn is None \
+                or not op.inputs:
+            return False
+        ok = all(const_only(t.op) for t in op.inputs) \
+            and not op.control_inputs
+        cache[op] = ok
+        return ok
+
+    for f in ctx.fetches:
+        op = f if isinstance(f, ops_mod.Operation) else f.op
+        if op.type != "Const" and const_only(op):
+            yield (op,
+                   f"fetch {op.name!r} is entirely constant-foldable; "
+                   "its value never changes across steps")
+
+
+def _perm_of(op):
+    p = op.attrs.get("perm")
+    return tuple(p) if p is not None else None
+
+
+@register_lint_rule("transpose-pair", WARNING)
+def _rule_transpose_pair(ctx):
+    """Adjacent mutually inverse transposes that survive into the final
+    graph (the layout pass cancels clean pairs; pairs split by control
+    dependencies or consumed by name stay) — pure data-movement cost on
+    every step."""
+    for op in ctx.ops:
+        if op.type != "Transpose" or not op.inputs:
+            continue
+        p1 = _perm_of(op)
+        src = op.inputs[0].op
+        if src.type != "Transpose" or op.inputs[0].value_index != 0 \
+                or not src.inputs:
+            continue
+        p2 = _perm_of(src)
+        if not p1 or not p2 or len(p1) != len(p2):
+            continue
+        if tuple(p2[i] for i in p1) == tuple(range(len(p1))):
+            yield (op,
+                   f"transpose pair {src.name!r} -> {op.name!r} composes "
+                   "to identity but was not cancelled (control deps or "
+                   "by-name fetches pin it); restructure so the layout "
+                   "pass can cancel it")

@@ -1,0 +1,397 @@
+"""Graph verifier: structural invariants over the live Graph IR and over
+serialized GraphDef JSON dicts.
+
+The reference validates graphs at session-creation time
+(core/graph/validate.cc, core/common_runtime/graph_constructor) and
+surfaces violations as Status strings; stf discovers most of the same
+problems only as opaque JAX tracer errors deep inside Session.run
+lowering. This verifier runs *before* lowering — standalone, at strict
+Session construction, per plan, as PassManager pre/post invariant
+checks, and from the ``tools.graph_lint`` CLI — and emits structured
+:class:`~.diagnostics.Diagnostic` objects carrying the op's user-code
+creation site.
+
+Live-graph checks (``verify_graph`` / ``verify_ops``):
+
+  verifier/dangling-input    input tensor's producer is not registered
+                             in the graph it claims (ERROR)
+  verifier/graph-order       an op consumes a tensor or control dep
+                             created *after* it — impossible in the
+                             append-only IR, so its presence means IR
+                             corruption / a broken import (ERROR)
+  verifier/cycle             data+control cycle (GraphDef level; live
+                             graphs are acyclic by construction) (ERROR)
+  verifier/infer-mismatch    re-running abstract shape/dtype inference
+                             disagrees with the recorded output specs
+                             (dtype: ERROR, shape: WARNING) — catches
+                             hand-supplied output_specs that lie
+  verifier/host-sink-feeds-device
+                             a device op consumes the output of a host
+                             op that itself depends on device results —
+                             Session.run will reject the plan; reported
+                             here with source attribution (WARNING)
+  verifier/device-scope      an op registered runs_on_host is pinned to
+                             a non-host device scope (WARNING)
+  verifier/unreachable-stateful
+                             with fetches given: a stateful op outside
+                             the fetch closure is silently pruned (NOTE)
+
+FuncGraph bodies (cond/while/scan/defun) are verified recursively, with
+capture/input/output signature integrity checked at each level.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from . import diagnostics as diag_mod
+from .diagnostics import ERROR, NOTE, WARNING, Diagnostic, report
+
+_HOST_HINT = "cpu"
+# layout-neutral source nodes: a host op consuming these has no device
+# ancestor (Session folds consts / feeds placeholders)
+_NEUTRAL_TYPES = ("Const", "Placeholder", "PlaceholderWithDefault",
+                  "FuncArg", "CapturedInput")
+
+
+def _is_host_pinned(device: str) -> bool:
+    return bool(device) and _HOST_HINT in str(device).lower()
+
+
+def _is_device_pinned(device: str) -> bool:
+    return bool(device) and _HOST_HINT not in str(device).lower()
+
+
+# ---------------------------------------------------------------------------
+# live-graph verification
+# ---------------------------------------------------------------------------
+
+def verify_ops(op_list: Sequence[Any], graph=None,
+               level: str = "structural",
+               diags: Optional[List[Diagnostic]] = None
+               ) -> List[Diagnostic]:
+    """Verify one op list (a whole graph's or a pruned plan's).
+
+    ``level``: "structural" (cheap invariants; what Session runs per
+    plan) or "full" (adds the abstract-eval shape/dtype re-check)."""
+    diags = diags if diags is not None else []
+    host_like: Set[Any] = set()      # host-staged ops
+    has_dev_anc: Set[Any] = set()    # ops downstream of device results
+    for op in op_list:
+        try:
+            od = op_registry.get(op.type)
+        except KeyError:
+            report(diags, ERROR, "verifier/unregistered-op",
+                   f"op type {op.type!r} is not registered", op=op)
+            continue
+        g = graph or op.graph
+        for t in list(op.inputs):
+            powner = t.op
+            registered = powner.graph._ops_by_name.get(powner.name)
+            if registered is not powner:
+                report(diags, ERROR, "verifier/dangling-input",
+                       f"input {t.name} of {op.name!r} refers to an op "
+                       "that is not registered in its graph (dangling "
+                       "reference after a broken import/rewrite)", op=op)
+            if powner._id >= op._id and powner.graph is op.graph:
+                report(diags, ERROR, "verifier/graph-order",
+                       f"{op.name!r} consumes {t.name} created after it "
+                       "— append-only IR ordering violated", op=op)
+        for c in op.control_inputs:
+            if c._id >= op._id and c.graph is op.graph:
+                report(diags, ERROR, "verifier/graph-order",
+                       f"{op.name!r} has control dep {c.name!r} created "
+                       "after it — append-only IR ordering violated",
+                       op=op)
+        # device/host staging invariants (mirrors Session._plan staging)
+        if od.runs_on_host and _is_device_pinned(op.device):
+            report(diags, WARNING, "verifier/device-scope",
+                   f"{op.name!r} ({op.type}) executes in the host stage "
+                   f"but is pinned to device {op.device!r}; the pin is "
+                   "ignored", op=op)
+        is_host = od.runs_on_host or _is_host_pinned(op.device)
+        dev_anc = False
+        for t in op.inputs:
+            p = t.op
+            if p in has_dev_anc:
+                dev_anc = True
+            elif p not in host_like and p.type not in _NEUTRAL_TYPES:
+                dev_anc = True  # device-stage producer
+            if p in host_like and p in has_dev_anc and not is_host:
+                report(diags, WARNING,
+                       "verifier/host-sink-feeds-device",
+                       f"device op {op.name!r} consumes {t.name} from "
+                       f"host op {p.name!r}, which itself depends on "
+                       "device results — Session.run will reject this "
+                       "plan; use stf.py_func to re-enter the device "
+                       "program", op=op)
+        for c in op.control_inputs:
+            if c in has_dev_anc or (c not in host_like
+                                    and c.type not in _NEUTRAL_TYPES):
+                dev_anc = True
+        if is_host:
+            host_like.add(op)
+        if dev_anc:
+            has_dev_anc.add(op)
+        # FuncGraph bodies: recurse + signature integrity
+        for k, v in op.attrs.items():
+            if isinstance(v, ops_mod.FuncGraph):
+                _verify_funcgraph(v, op, level, diags)
+        if level == "full":
+            _recheck_inference(op, od, diags)
+    return diags
+
+
+def _verify_funcgraph(fg: "ops_mod.FuncGraph", owner, level,
+                      diags: List[Diagnostic]) -> None:
+    inner_ops = fg.get_operations()
+    inner_set = set(inner_ops)
+    for t in fg.outputs:
+        if t.op not in inner_set:
+            report(diags, ERROR, "verifier/funcgraph-signature",
+                   f"body {fg.func_name!r} of {owner.name!r} returns "
+                   f"{t.name}, which is not an op of the body", op=owner)
+    for t in fg.inputs:
+        if t.op not in inner_set:
+            report(diags, ERROR, "verifier/funcgraph-signature",
+                   f"body {fg.func_name!r} of {owner.name!r} declares "
+                   f"input {t.name} outside the body", op=owner)
+    for outer, inner in fg.captures:
+        if inner.op not in inner_set:
+            report(diags, ERROR, "verifier/funcgraph-signature",
+                   f"body {fg.func_name!r} of {owner.name!r} capture "
+                   f"{inner.name} has no CapturedInput op in the body",
+                   op=owner)
+        if outer is not None and outer.graph is fg:
+            report(diags, ERROR, "verifier/funcgraph-signature",
+                   f"body {fg.func_name!r} of {owner.name!r} captures "
+                   f"its own tensor {outer.name}", op=owner)
+    verify_ops(inner_ops, graph=fg, level=level, diags=diags)
+
+
+def _recheck_inference(op, od, diags: List[Diagnostic]) -> None:
+    """Abstract-eval re-check: recorded output specs must agree with
+    what the op registry's inference derives from the recorded input
+    specs (ref: the reference re-runs C++ shape fns at import through
+    common_runtime/shape_refiner.cc)."""
+    if od.pure_fn is None or od.is_stateful:
+        return
+    if not op.inputs or not all(
+            t.shape.is_fully_defined() for t in op.inputs):
+        return
+    try:
+        inferred = od.infer(op.graph, op.attrs, op.inputs)
+    except Exception:
+        return  # probe failure: advisory only
+    if len(inferred) != len(op.outputs):
+        report(diags, ERROR, "verifier/infer-mismatch",
+               f"{op.name!r} ({op.type}) records {len(op.outputs)} "
+               f"outputs but inference derives {len(inferred)}", op=op)
+        return
+    from ..framework import dtypes as dtypes_mod
+
+    for i, ((sh, dt), out) in enumerate(zip(inferred, op.outputs)):
+        # compare through the x64-narrowing policy: a declared float64
+        # that the runtime narrows to float32 is the lint layer's
+        # business (lint/narrow-64bit), not an inference mismatch
+        dt = dtypes_mod.narrowed_if_no_x64(dt.base_dtype)
+        rec = dtypes_mod.narrowed_if_no_x64(out.dtype.base_dtype)
+        if dt != rec:
+            report(diags, ERROR, "verifier/infer-mismatch",
+                   f"{op.name!r}:{i} records dtype {rec.name} but "
+                   f"abstract eval derives {dt.name} — the lowering "
+                   f"will produce {dt.name}", op=op)
+        elif (sh.is_fully_defined()
+                and out.shape.is_fully_defined()
+                and sh.as_list() != out.shape.as_list()):
+            report(diags, WARNING, "verifier/infer-mismatch",
+                   f"{op.name!r}:{i} records shape "
+                   f"{out.shape.as_list()} but abstract eval derives "
+                   f"{sh.as_list()}", op=op)
+
+
+def verify_graph(graph, fetches=None, level: str = "structural"
+                 ) -> List[Diagnostic]:
+    """Verify a whole live graph. ``fetches``: optional sequence of
+    Tensors/Operations — enables the unreachable-stateful check (a
+    stateful op outside the fetch closure is silently pruned)."""
+    diags: List[Diagnostic] = []
+    ops = graph.get_operations()
+    verify_ops(ops, graph=graph, level=level, diags=diags)
+    if fetches:
+        _check_unreachable_stateful(graph, ops, fetches, diags)
+    return diags
+
+
+def _check_unreachable_stateful(graph, ops, fetches,
+                                diags: List[Diagnostic]) -> None:
+    targets = []
+    for f in fetches:
+        op = f if isinstance(f, ops_mod.Operation) else f.op
+        targets.append(op)
+    seen: Set[Any] = set()
+    work = list(targets)
+    while work:
+        op = work.pop()
+        if op in seen:
+            continue
+        seen.add(op)
+        work.extend(t.op for t in op.inputs)
+        work.extend(op.control_inputs)
+    for op in ops:
+        if op in seen:
+            continue
+        try:
+            od = op_registry.get(op.type)
+        except KeyError:
+            continue
+        if not od.is_stateful or op.type in ("NoOp", "Group"):
+            continue
+        eff = od.effects
+        if not (eff and eff.writes):
+            continue  # only silently-dropped *writes* are surprising
+        report(diags, NOTE, "verifier/unreachable-stateful",
+               f"stateful op {op.name!r} ({op.type}) is not an ancestor "
+               "of any fetch — it will be silently pruned from this "
+               "run (fetch it, or add it to a control dependency / "
+               "stf.group)", op=op)
+
+
+# ---------------------------------------------------------------------------
+# GraphDef (serialized JSON dict) verification
+# ---------------------------------------------------------------------------
+
+def _tensor_ref(name: str):
+    if ":" in name:
+        node, idx = name.rsplit(":", 1)
+        return node, int(idx)
+    return name, 0
+
+
+def verify_graphdef(graph_def: Dict, _path: str = "",
+                    diags: Optional[List[Diagnostic]] = None
+                    ) -> List[Diagnostic]:
+    """Verify a GraphDef JSON dict (framework/graph_io.py wire format):
+    duplicate names, unregistered op types, dangling input refs, output
+    indices out of range, data+control cycles, FuncGraph body signature
+    integrity — recursing into bodies. Standalone (no live Graph
+    needed): this is what the ``graph_lint`` CLI and the PassManager
+    pre/post invariant hooks run."""
+    diags = diags if diags is not None else []
+    nodes = graph_def.get("node", [])
+    by_name: Dict[str, Dict] = {}
+    where = f" in {_path}" if _path else ""
+
+    def _src(n):
+        s = n.get("source")
+        return f"{s[0]}:{s[1]}" if s and len(s) == 3 else None
+
+    for n in nodes:
+        if n["name"] in by_name:
+            report(diags, ERROR, "verifier/duplicate-name",
+                   f"node name {n['name']!r} appears twice{where}",
+                   op_name=n["name"], op_type=n.get("op"), source=_src(n))
+        by_name[n["name"]] = n
+    for n in nodes:
+        if not op_registry.is_registered(n.get("op", "")):
+            report(diags, ERROR, "verifier/unregistered-op",
+                   f"node {n['name']!r} has unregistered op type "
+                   f"{n.get('op')!r}{where}",
+                   op_name=n["name"], op_type=n.get("op"), source=_src(n))
+        for ref in n.get("input", []):
+            src_name, idx = _tensor_ref(ref)
+            producer = by_name.get(src_name)
+            if producer is None:
+                report(diags, ERROR, "verifier/dangling-input",
+                       f"node {n['name']!r} input {ref!r} names a "
+                       f"missing node{where}",
+                       op_name=n["name"], op_type=n.get("op"),
+                       source=_src(n))
+                continue
+            specs = producer.get("output_specs")
+            if specs is not None and idx >= len(specs):
+                report(diags, ERROR, "verifier/bad-output-index",
+                       f"node {n['name']!r} input {ref!r}: producer has "
+                       f"only {len(specs)} output(s){where}",
+                       op_name=n["name"], op_type=n.get("op"),
+                       source=_src(n))
+        for c in n.get("control_input", []):
+            if c not in by_name:
+                report(diags, ERROR, "verifier/dangling-input",
+                       f"node {n['name']!r} control input {c!r} names a "
+                       f"missing node{where}",
+                       op_name=n["name"], op_type=n.get("op"),
+                       source=_src(n))
+        # recurse into FuncGraph bodies
+        for k, v in (n.get("attr") or {}).items():
+            if isinstance(v, dict) and v.get("__kind__") == "funcgraph":
+                body = v["v"]
+                body_path = (f"{_path}/" if _path else "") \
+                    + f"{n['name']}.{k}"
+                verify_graphdef(body, _path=body_path, diags=diags)
+                _verify_body_signature(body, n, body_path, diags)
+    _check_graphdef_cycles(nodes, by_name, where, diags)
+    return diags
+
+
+def _verify_body_signature(body: Dict, owner: Dict, path: str,
+                           diags: List[Diagnostic]) -> None:
+    names = {bn["name"] for bn in body.get("node", [])}
+    need = ([r for r in body.get("inputs", [])]
+            + [r for r in body.get("outputs", [])]
+            + [c[1] for c in body.get("captures", [])])
+    for ref in need:
+        if _tensor_ref(ref)[0] not in names:
+            report(diags, ERROR, "verifier/funcgraph-signature",
+                   f"body {path} signature ref {ref!r} resolves to no "
+                   "body node", op_name=owner["name"],
+                   op_type=owner.get("op"))
+
+
+def _check_graphdef_cycles(nodes, by_name, where,
+                           diags: List[Diagnostic]) -> None:
+    state: Dict[str, int] = {}  # 0=visiting 1=done
+
+    def deps(n):
+        for ref in n.get("input", []):
+            yield _tensor_ref(ref)[0]
+        yield from n.get("control_input", [])
+
+    for root in nodes:
+        if state.get(root["name"]) == 1:
+            continue
+        stack = [(root["name"], None)]
+        while stack:
+            name, it = stack[-1]
+            n = by_name.get(name)
+            if n is None:
+                stack.pop()
+                continue
+            if it is None:
+                if state.get(name) is not None:
+                    stack.pop()
+                    continue
+                state[name] = 0
+                it = iter(list(deps(n)))
+                stack[-1] = (name, it)
+            advanced = False
+            for d in it:
+                if d not in by_name:
+                    continue
+                if state.get(d) is None:
+                    stack.append((d, None))
+                    advanced = True
+                    break
+                if state.get(d) == 0:  # includes d == name: a self-loop
+                    cyc = " -> ".join(nm for nm, _ in stack[-5:])
+                    if d == name:
+                        cyc = f"{name} -> {name}"
+                    report(diags, ERROR, "verifier/cycle",
+                           f"data/control cycle near {cyc}{where}",
+                           op_name=name, op_type=n.get("op"))
+                    state[d] = 1  # break out; report once per region
+            if not advanced:
+                state[name] = 1
+                stack.pop()

@@ -361,6 +361,13 @@ class BaseSession:
         self._target = self._resolve_target(target)
         self._graph = graph or ops_mod.get_default_graph()
         self._config = config
+        # stf.analysis wiring (ISSUE 3): construction-time strict/warn
+        # verification; per-plan checks run in _plan (cached by plan
+        # signature — a plan is analyzed exactly once per executable)
+        self._analysis_mode = getattr(config, "graph_analysis", "off") \
+            if config is not None else "off"
+        if self._analysis_mode != "off":
+            self._verify_graph_now(construction=True)
         self._guard_warned: Set[str] = set()
         self._variable_store = VariableStore()
         self._cache: Dict[Any, _CompiledStep] = {}
@@ -380,6 +387,34 @@ class BaseSession:
         # jax.Arrays that never round-trip through host numpy)
         self._handles: Dict[str, Any] = {}
         self._handle_counter = 0
+
+    # -- stf.analysis hooks --------------------------------------------------
+    def _hazard_mode(self) -> str:
+        from .. import analysis
+
+        mode = getattr(self._config, "variable_hazard_mode", None) \
+            if self._config is not None else None
+        return mode or analysis.get_hazard_mode()
+
+    def _verify_graph_now(self, construction: bool) -> None:
+        """graph_analysis="warn"|"strict": verify the session's graph
+        (full level — structural + abstract-eval re-checks) and either
+        log or raise on ERROR diagnostics."""
+        from .. import analysis
+        from ..platform import tf_logging as logging
+
+        diags = analysis.verify_graph(self._graph, level="full")
+        errs = analysis.errors(diags)
+        for d in diags:
+            if not d.is_error:
+                logging.warning("graph analysis: %s", d.format())
+        if errs:
+            msg = analysis.format_report(
+                errs, header="graph verification failed at session "
+                             "construction:")
+            if self._analysis_mode == "strict":
+                raise errors.InvalidArgumentError(None, None, msg)
+            logging.warning("%s", msg)
 
     @staticmethod
     def _resolve_target(target):
@@ -1045,13 +1080,40 @@ class BaseSession:
         with monitoring.traceme("optimize", n_pruned_ops=len(pruned)):
             pruned, const_env, alias = graph_opt.optimize_pruned(
                 pruned, fed_set, fetch_tensors, func_plans=func_plans)
-        lower_t0 = time.perf_counter()
         step.const_env = const_env
         step.alias = alias
         step.func_plans = func_plans
-        # SURVEY §5 ordering detector: unordered read/write of the same
-        # variable in one step is an error, not a silent topo tie-break
-        lowering_mod.check_step_read_write_races(pruned, alias)
+        # stf.analysis per-plan checks (cached by plan signature — _plan
+        # only runs on executable-cache misses): the variable-hazard
+        # detector (RAW/WAR/WAW; SURVEY §5 upgraded to declared effect
+        # sets, modes off|warn|raise|auto_deps — auto_deps re-orders the
+        # plan to program order, TF auto-control-dependencies) plus, when
+        # the session opted in, structural re-verification of the plan.
+        from .. import analysis
+
+        a_t0 = time.perf_counter()
+        with monitoring.traceme("analysis", n_pruned_ops=len(pruned)):
+            pruned, plan_diags = analysis.check_plan(
+                pruned, alias, mode=self._hazard_mode())
+            if self._analysis_mode != "off":
+                analysis.verify_ops(pruned, level="structural",
+                                    diags=plan_diags)
+        analysis.diagnostics.metric_check_seconds.get_cell().add(
+            time.perf_counter() - a_t0)
+        if plan_diags:
+            from ..platform import tf_logging as logging
+
+            errs = analysis.errors(plan_diags)
+            for d in plan_diags:
+                if not d.is_error:
+                    logging.warning("plan analysis: %s", d.format())
+            if errs and self._analysis_mode == "strict":
+                raise errors.InvalidArgumentError(
+                    None, None, analysis.format_report(
+                        errs, header="plan verification failed:"))
+        # staging/partitioning timing starts AFTER the analysis block:
+        # the "lower" span must not double-count the "analysis" span
+        lower_t0 = time.perf_counter()
 
         def _rsv(t):  # resolve through CSE aliases
             return alias.get(t, t)

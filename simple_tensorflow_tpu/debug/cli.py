@@ -72,6 +72,32 @@ class AnalyzerCLI:
             raise CommandError(f"node {name!r} not found in graph")
 
     # -- commands ------------------------------------------------------------
+    def _op_of(self, tensor_name: str):
+        """Graph op behind a dumped tensor name, when a graph is
+        attached (best-effort: dumps outlive graphs)."""
+        if self._graph is None:
+            return None
+        try:
+            return self._graph.get_operation_by_name(
+                tensor_name.split(":")[0])
+        except (KeyError, ValueError):
+            return None
+
+    @staticmethod
+    def _annotate(op) -> str:
+        """`` <- OpType [effects] @ file:line`` suffix from the op's
+        declared effect set and captured creation traceback
+        (stf.analysis op-source attribution)."""
+        from ..analysis import op_effects
+
+        eff = op_effects(op).describe()
+        out = f"  <- {op.type}"
+        if eff != "pure":
+            out += f" [{eff}]"
+        if op.source_site:
+            out += f" @ {op.source_site}"
+        return out
+
     def cmd_lt(self, args: List[str]) -> str:
         run = self._pick_run(args)
         pattern = args[0] if args else "*"
@@ -84,7 +110,11 @@ class AnalyzerCLI:
             data = self._dump.watch_key_to_data(n, run)
             d = data[-1]
             flag = " !nan/inf" if d.flagged_inf_or_nan else ""
-            rows.append(f"{n}  shape={d.shape} dtype={d.dtype}{flag}")
+            row = f"{n}  shape={d.shape} dtype={d.dtype}{flag}"
+            op = self._op_of(n)
+            if op is not None:
+                row += self._annotate(op)
+            rows.append(row)
         return "\n".join(rows)
 
     def cmd_pt(self, args: List[str]) -> str:
@@ -120,8 +150,15 @@ class AnalyzerCLI:
         if not args:
             raise CommandError("ni needs a node name")
         op = self._node(args[0])
+        from ..analysis import op_effects
+
         lines = [f"node: {op.name}", f"  op: {op.type}",
-                 f"  device: {op.device or '(device stage)'}"]
+                 f"  device: {op.device or '(device stage)'}",
+                 f"  effects: {op_effects(op).describe()}"]
+        if op.traceback:
+            lines.append("  created at:")
+            lines += [f"    {fn}:{ln} in {name}"
+                      for fn, ln, name in op.traceback[:4]]
         if op.attrs:
             show = {k: v for k, v in list(op.attrs.items())[:8]}
             lines.append(f"  attrs: {show}")

@@ -46,6 +46,18 @@ class ConfigProto:
     host-numpy feeds and host fetches larger than
     ``transfer_guard_threshold_bytes``. "log" warns once per tensor;
     "disallow" raises InvalidArgumentError with staging guidance.
+
+    graph_analysis: "off" (default) | "warn" | "strict" — stf.analysis
+    graph verification. "strict" verifies the whole graph at Session
+    construction (ERROR diagnostics raise InvalidArgumentError) and
+    re-verifies every new run plan; "warn" logs instead of raising.
+    Per-plan results are cached by plan signature (verification runs
+    only on executable-cache misses).
+
+    variable_hazard_mode: None (process default, see
+    stf.analysis.set_hazard_mode / STF_HAZARD_MODE) | "off" | "warn" |
+    "raise" | "auto_deps" — unordered same-variable read/write policy
+    per run plan (RAW/WAR/WAW; docs/ANALYSIS.md).
     """
 
     def __init__(self, device_count=None, intra_op_parallelism_threads=0,
@@ -55,7 +67,8 @@ class ConfigProto:
                  allow_soft_placement=False, log_device_placement=False,
                  graph_options=None, operation_timeout_in_ms=0,
                  transfer_guard="allow",
-                 transfer_guard_threshold_bytes=1 << 20):
+                 transfer_guard_threshold_bytes=1 << 20,
+                 graph_analysis="off", variable_hazard_mode=None):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -74,3 +87,14 @@ class ConfigProto:
                 f"got {transfer_guard!r}")
         self.transfer_guard = transfer_guard
         self.transfer_guard_threshold_bytes = transfer_guard_threshold_bytes
+        if graph_analysis not in ("off", "warn", "strict"):
+            raise ValueError(
+                f"graph_analysis must be off|warn|strict, "
+                f"got {graph_analysis!r}")
+        self.graph_analysis = graph_analysis
+        if variable_hazard_mode is not None and variable_hazard_mode \
+                not in ("off", "warn", "raise", "auto_deps"):
+            raise ValueError(
+                "variable_hazard_mode must be None|off|warn|raise|"
+                f"auto_deps, got {variable_hazard_mode!r}")
+        self.variable_hazard_mode = variable_hazard_mode

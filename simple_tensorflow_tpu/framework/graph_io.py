@@ -70,7 +70,7 @@ def _decode_attr(v):
 
 
 def _node_to_dict(op: ops_mod.Operation):
-    return {
+    d = {
         "name": op.name,
         "op": op.type,
         "input": [t.name for t in op.inputs],
@@ -81,6 +81,12 @@ def _node_to_dict(op: ops_mod.Operation):
             [o.shape.as_list() if o.shape.rank is not None else None,
              o.dtype.name] for o in op.outputs],
     }
+    if op.traceback:
+        # innermost user frame only: enough for stf.analysis diagnostics
+        # on re-imported graphs to point at the original creation site
+        f, ln, fn = op.traceback[0]
+        d["source"] = [f, ln, fn]
+    return d
 
 
 def _funcgraph_to_dict(fg: ops_mod.FuncGraph):
@@ -160,6 +166,11 @@ def _build_nodes_into(target_graph, nodes, tensor_env, scope_prefix,
         op = target_graph.create_op(
             node["op"], inputs, attrs=attrs, name=new_name + "/",
             output_specs=specs, control_inputs=ctrl)
+        src = node.get("source")
+        if src and len(src) == 3:
+            # restore the original creation site (the capture above only
+            # recorded the import call) for analysis diagnostics
+            op._traceback = ((str(src[0]), int(src[1]), str(src[2])),)
         tensor_env["(op)" + node["name"]] = op
         for i, out in enumerate(op.outputs):
             tensor_env[f"{node['name']}:{i}"] = out

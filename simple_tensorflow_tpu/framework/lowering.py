@@ -294,106 +294,20 @@ class InternalLoweringError(Exception):
     pass
 
 
-_VAR_READ_TYPES = frozenset({"ReadVariable", "VariableV2"})
-_VAR_WRITE_TYPES = frozenset({
-    "Assign", "AssignAdd", "AssignSub", "ScatterUpdate", "ScatterAdd",
-    "ScatterSub", "ScatterMul", "ScatterDiv", "ScatterMin", "ScatterMax",
-    "ScatterNdUpdate", "CountUpTo"})
-
-
 def check_step_read_write_races(
         op_list: Sequence[Operation],
         alias: Optional[Dict[Tensor, Tensor]] = None) -> None:
-    """SURVEY §5 ordering detector: a variable read that shares a pruned
-    step with a write to the same variable, with NO ordering between them
-    (no data path either way, no control dependency), is a race — the
-    value observed depends on an arbitrary topological tie-break. Raise at
-    plan time with guidance instead of silently picking an order
-    (ref semantics: core/common_runtime/executor.cc runs such nodes
-    concurrently; the reference's answer is "undefined", ours is "error").
+    """SURVEY §5 ordering detector — now a thin wrapper over the
+    stf.analysis variable-hazard engine (analysis/hazards.py), which
+    generalizes the original read-your-write check to full RAW/WAR/WAW
+    detection over the op registry's declared effect sets and adds the
+    warn/auto_deps modes. Kept for direct callers: raises
+    InvalidArgumentError on any enforceable unordered hazard, exactly as
+    before. Bare-fetch reads stay exempt (observations with documented
+    topological-position semantics, see state_ops.py ReadVariable)."""
+    from ..analysis import hazards
 
-    Reads whose outputs feed nothing inside the step (bare fetches) are
-    exempt: they are observations, not computation, and get the
-    deterministic topological-position semantics documented on the
-    ReadVariable registration (state_ops.py).
-
-    ``alias`` is the plan-time CSE map (dup tensor → canonical): edges
-    through a CSE-removed op must be followed via its canonical, or a
-    fully data-ordered graph would be misreported as racy.
-
-    Cost: two forward bitmask-propagation passes over the (topologically
-    ordered) step — O(ops × edges) integer ops, not per-pair BFS.
-    """
-    alias = alias or {}
-    reads: Dict[str, List[Operation]] = {}
-    writes: Dict[str, List[Operation]] = {}
-    step_set = set(op_list)
-    for op in op_list:
-        vn = op.attrs.get("var_name")
-        if not vn:
-            continue
-        if op.type in _VAR_READ_TYPES:
-            reads.setdefault(vn, []).append(op)
-        elif op.type in _VAR_WRITE_TYPES:
-            writes.setdefault(vn, []).append(op)
-    if not writes:
-        return
-
-    def consumed_in_step(r: Operation) -> bool:
-        for out in r.outputs:
-            for c in out.consumers():
-                if c in step_set:
-                    return True
-        return False
-
-    tracked_reads = [r for vn, rs in reads.items() if vn in writes
-                     for r in rs if consumed_in_step(r)]
-    tracked_writes = [w for vn, ws in writes.items() if vn in reads
-                      for w in ws]
-    if not tracked_reads or not tracked_writes:
-        return
-    read_bit = {r: 1 << i for i, r in enumerate(tracked_reads)}
-    write_bit = {w: 1 << i for i, w in enumerate(tracked_writes)}
-
-    def propagate(bits: Dict[Operation, int]) -> Dict[Operation, int]:
-        # op_list is topologically ordered and ancestor-closed, so one
-        # forward sweep computes, per op, which tracked ops reach it
-        reach: Dict[Operation, int] = {}
-        for op in op_list:
-            m = 0
-            for t in op.inputs:
-                p = alias.get(t, t).op  # follow CSE'd edges to canonical
-                m |= reach.get(p, 0) | bits.get(p, 0)
-            for p in op.control_inputs:
-                m |= reach.get(p, 0) | bits.get(p, 0)
-            reach[op] = m
-        return reach
-
-    reads_reaching = propagate(read_bit)    # per op: reads ∈ ancestors
-    writes_reaching = propagate(write_bit)  # per op: writes ∈ ancestors
-
-    for vn, rs in reads.items():
-        ws = writes.get(vn)
-        if not ws:
-            continue
-        for r in rs:
-            if r not in read_bit:
-                continue  # bare fetch: observation, not a race
-            for w in ws:
-                if (reads_reaching[w] & read_bit[r]
-                        or writes_reaching[r] & write_bit[w]):
-                    continue  # ordered by data or control edges
-                raise InvalidArgumentError(
-                    None, r,
-                    f"Unordered read-your-write race on variable {vn!r} "
-                    f"within one step: read {r.name!r} and write "
-                    f"{w.name!r} have no data or control-dependency path "
-                    "between them, so the value observed would depend on "
-                    "an arbitrary execution order. Order them explicitly: "
-                    "`with stf.control_dependencies([write_op]): "
-                    "v.read_value()` (read-after-write) or "
-                    "`with stf.control_dependencies([read]): "
-                    "v.assign(...)` (write-after-read).")
+    hazards.check_plan(op_list, alias, mode="raise")
 
 
 def execute_ops(ctx: LoweringContext, op_list: Sequence[Operation],

@@ -32,6 +32,80 @@ _PROBE_A = 13
 _PROBE_B = 17
 
 
+class Effects:
+    """Declared effect set of an op type (the structured upgrade of the
+    boolean ``is_stateful``; ref: the reference's auto-control-deps
+    tracks per-resource reads/writes the same way,
+    python/framework/auto_control_deps.py).
+
+    reads / writes: resource *selectors*. A selector is either the name
+    of a node attr holding the resource id at op-creation time (e.g.
+    ``"var_name"``, ``"queue_name"`` — the resolved resource is
+    ``"var_name=global_step"``), or a literal resource prefixed with
+    ``=`` (e.g. ``"=filesystem"``) shared by every instance of the op.
+
+    ``update``: how a write combines with the previous value — ``None``
+    means overwrite (Assign); ``"add"``/``"sub"``/``"min"``/``"max"``/
+    ``"update"`` mark read-modify-write ops. Used by the hazard detector
+    to skip WAW hazards between commuting updates (two AssignAdds are
+    order-independent; Assign vs AssignAdd is not).
+
+    rng: draws from the per-step PRNG stream.
+    io: observable host-side effect (files, stdout, summaries, handles).
+    """
+
+    __slots__ = ("reads", "writes", "rng", "io", "update")
+
+    def __init__(self, reads=(), writes=(), rng=False, io=False,
+                 update=None):
+        self.reads = tuple(reads) if not isinstance(reads, str) else (reads,)
+        self.writes = (tuple(writes) if not isinstance(writes, str)
+                       else (writes,))
+        self.rng = bool(rng)
+        self.io = bool(io)
+        self.update = update
+
+    def __bool__(self):
+        return bool(self.reads or self.writes or self.rng or self.io)
+
+    @staticmethod
+    def _resolve(selectors, op):
+        out = set()
+        for sel in selectors:
+            if sel.startswith("="):
+                out.add(sel[1:])
+            else:
+                v = op.attrs.get(sel)
+                # missing attr -> a resource unique to this op: it can
+                # never alias another op's resource (no false hazards)
+                out.add(f"{sel}={v}" if v is not None
+                        else f"{sel}@{op.name}")
+        return frozenset(out)
+
+    def resolved_reads(self, op) -> frozenset:
+        return self._resolve(self.reads, op)
+
+    def resolved_writes(self, op) -> frozenset:
+        return self._resolve(self.writes, op)
+
+    def __repr__(self):
+        parts = []
+        if self.reads:
+            parts.append(f"reads={list(self.reads)}")
+        if self.writes:
+            parts.append(f"writes={list(self.writes)}"
+                         + (f" ({self.update})" if self.update else ""))
+        if self.rng:
+            parts.append("rng")
+        if self.io:
+            parts.append("io")
+        return "Effects(" + ", ".join(parts) + ")" if parts \
+            else "Effects()"
+
+
+NO_EFFECTS = Effects()
+
+
 class OpDef:
     """Definition of one op type.
 
@@ -44,20 +118,37 @@ class OpDef:
         -> [(TensorShape, DType)]; overrides generic inference.
       is_stateful: op has effects (variable read/write, RNG, IO); never CSE'd
         or constant-folded, always kept in topo order.
+      effects: declared ``Effects`` set — the structured refinement of
+        ``is_stateful`` (stf.analysis hazard detection + diagnostics).
+        Passing a non-empty effects implies is_stateful. Stateful ops
+        that predate the effect system get a synthesized conservative
+        default (io for host ops, empty otherwise) and
+        ``effects_declared`` False.
       runs_on_host: executes in the host (python) stage, not in the XLA
         program (queues, readers, py_func side).
       n_outputs: static output count (or None -> from infer).
     """
 
     __slots__ = ("name", "lower", "pure_fn", "infer_fn", "is_stateful",
-                 "runs_on_host", "n_outputs", "attr_keys_in_sig")
+                 "runs_on_host", "n_outputs", "attr_keys_in_sig",
+                 "effects", "effects_declared")
 
     def __init__(self, name, lower=None, pure_fn=None, infer_fn=None,
-                 is_stateful=False, runs_on_host=False, n_outputs=1):
+                 is_stateful=False, runs_on_host=False, n_outputs=1,
+                 effects=None):
         self.name = name
         self.pure_fn = pure_fn
         self.infer_fn = infer_fn
-        self.is_stateful = is_stateful
+        self.effects_declared = effects is not None
+        if effects is None:
+            # legacy registration: synthesize the conservative reading of
+            # the boolean (host statefulness is observable io; device
+            # statefulness without a declaration stays opaque — the
+            # hazard detector only orders *declared* resources)
+            effects = (Effects(io=True) if is_stateful and runs_on_host
+                       else NO_EFFECTS)
+        self.effects = effects
+        self.is_stateful = bool(is_stateful or effects)
         self.runs_on_host = runs_on_host
         self.n_outputs = n_outputs
         if lower is None:
@@ -141,14 +232,24 @@ _REGISTRY: Dict[str, OpDef] = {}
 
 
 def register(name, lower=None, pure_fn=None, infer_fn=None, is_stateful=False,
-             runs_on_host=False, n_outputs=1):
+             runs_on_host=False, n_outputs=1, effects=None):
     if name in _REGISTRY:
         raise ValueError(f"Op {name} already registered")
     od = OpDef(name, lower=lower, pure_fn=pure_fn, infer_fn=infer_fn,
                is_stateful=is_stateful, runs_on_host=runs_on_host,
-               n_outputs=n_outputs)
+               n_outputs=n_outputs, effects=effects)
     _REGISTRY[name] = od
     return od
+
+
+def declare_effects(name, effects: Effects) -> None:
+    """Attach a declared effect set to an already-registered op type —
+    the upgrade path for op modules that register through shared loops
+    (queues, readers) without re-plumbing every call site."""
+    od = get(name)
+    od.effects = effects
+    od.effects_declared = True
+    od.is_stateful = bool(od.is_stateful or effects)
 
 
 def register_pure(name, pure_fn, **kw):

@@ -1012,14 +1012,37 @@ class PassManager:
     recurses into the FuncGraph bodies declared via
     ``register_function_op`` (cond branches, while cond/body, scan/map
     fns, defun bodies), preserving each body's signature so Session
-    executable-cache keys and the lowering stay valid."""
+    executable-cache keys and the lowering stay valid.
 
-    def __init__(self, passes: Optional[List[GraphPass]] = None):
+    ``verify``: run the stf.analysis GraphDef verifier as a pre/post
+    invariant around every pass — a pass that *introduces* a structural
+    error (dangling ref, broken body signature, cycle) raises
+    InternalError naming the pass, instead of the error surfacing later
+    as an opaque import/lowering failure. Pre-existing errors in the
+    input graph are attributed to the input, not to a pass. Default
+    from env ``STF_VERIFY_PASSES`` (off unless "1": verification is
+    O(graph) per pass, the optimizer hot path is per-plan)."""
+
+    def __init__(self, passes: Optional[List[GraphPass]] = None,
+                 verify: Optional[bool] = None):
         self.passes = list(passes if passes is not None
                            else default_passes())
+        if verify is None:
+            import os
+
+            verify = os.environ.get("STF_VERIFY_PASSES", "0") == "1"
+        self.verify = bool(verify)
+
+    @staticmethod
+    def _error_keys(gd: Dict) -> set:
+        from ..analysis import verifier as verifier_mod
+
+        return {(d.code, d.op_name)
+                for d in verifier_mod.verify_graphdef(gd) if d.is_error}
 
     def run(self, graph_def: Dict, keep: Optional[List[str]] = None) -> Dict:
         gd = graph_def
+        baseline = self._error_keys(gd) if self.verify else None
         for p in self.passes:
             t0 = time.perf_counter()
             with monitoring.traceme(f"graph_pass:{p.name}",
@@ -1033,6 +1056,20 @@ class PassManager:
             # returns skip it
             if new is not gd and new != gd:
                 _metric_pass_rewrites.get_cell(p.name).increase_by(1)
+                if baseline is not None:
+                    introduced = self._error_keys(new) - baseline
+                    if introduced:
+                        from .errors import InternalError
+
+                        detail = "; ".join(
+                            f"{code} at {name}" for code, name
+                            in sorted(introduced,
+                                      key=lambda k: (k[0], str(k[1]))))
+                        raise InternalError(
+                            None, None,
+                            f"graph pass {p.name!r} broke the graph: "
+                            f"{detail} (pre/post invariant check, "
+                            "stf.analysis.verify_graphdef)")
             gd = new
         return gd
 

@@ -109,10 +109,10 @@ def _make_sampler(log_uniform):
 
 op_registry.register("UniformCandidateSampler",
                      lower=_make_sampler(log_uniform=False),
-                     is_stateful=True, n_outputs=3)
+                     effects=op_registry.Effects(rng=True), n_outputs=3)
 op_registry.register("LogUniformCandidateSampler",
                      lower=_make_sampler(log_uniform=True),
-                     is_stateful=True, n_outputs=3)
+                     effects=op_registry.Effects(rng=True), n_outputs=3)
 
 
 def uniform_candidate_sampler(true_classes, num_true, num_sampled, unique,

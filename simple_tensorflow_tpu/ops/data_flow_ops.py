@@ -1325,3 +1325,22 @@ op_registry.register(
 
 
 ConditionalAccumulatorBase = ConditionalAccumulator  # ref base-class name
+
+
+# declared effect sets (stf.analysis): queue/staging/barrier mutations
+# are per-resource writes, size probes are reads. These resources are
+# host-side (advisory hazard class — warnings, never errors: pipelines
+# legitimately stage producers and consumers of one queue in one step).
+for _w_op in ("QueueEnqueue", "QueueEnqueueMaybe", "QueueEnqueueMany",
+              "QueueDequeue", "QueueDequeueMany", "QueueClose"):
+    op_registry.declare_effects(_w_op, op_registry.Effects(io=True, writes=("queue_name",)))
+op_registry.declare_effects("QueueSize", op_registry.Effects(reads=("queue_name",)))
+for _w_op in ("Stage", "Unstage"):
+    op_registry.declare_effects(_w_op, op_registry.Effects(io=True, writes=("staging_name",)))
+op_registry.declare_effects("StagingSize", op_registry.Effects(reads=("staging_name",)))
+for _w_op in ("BarrierInsertMany", "BarrierTakeMany", "BarrierClose"):
+    op_registry.declare_effects(_w_op, op_registry.Effects(io=True, writes=("barrier_name",)))
+for _r_op in ("BarrierReadySize", "BarrierIncompleteSize"):
+    op_registry.declare_effects(_r_op, op_registry.Effects(reads=("barrier_name",)))
+op_registry.declare_effects("RecordInputYield",
+                            op_registry.Effects(io=True, writes=("record_input_name",)))

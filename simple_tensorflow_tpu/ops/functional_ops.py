@@ -314,5 +314,5 @@ def _lower_py_func(ctx, op, inputs):
     return builtins.list(out)
 
 
-op_registry.register("PyFunc", lower=_lower_py_func, is_stateful=True,
-                     n_outputs=None)
+op_registry.register("PyFunc", lower=_lower_py_func,
+                     effects=op_registry.Effects(io=True), n_outputs=None)

@@ -161,7 +161,8 @@ def _random_flip(key, op, inputs):
 
 op_registry.register("RandomFlip",
                      lower=lambda ctx, op, inputs: _random_flip(
-                         ctx.rng_for(op), op, inputs), is_stateful=True)
+                         ctx.rng_for(op), op, inputs),
+                     effects=op_registry.Effects(rng=True))
 
 
 def _central_crop_impl(x, fraction=1.0):

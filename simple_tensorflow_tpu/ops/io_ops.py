@@ -337,3 +337,14 @@ op_registry.register(
     "ReaderReset",
     lower=lambda ctx, op, inputs: (_get_reader(op)._host_reset(), [])[1],
     is_stateful=True, runs_on_host=True, n_outputs=0)
+
+
+# declared effect sets (stf.analysis): readers advance per-reader state
+# and drain their work queue; file writes touch the filesystem
+op_registry.declare_effects("WriteFile", op_registry.Effects(io=True, writes=("=filesystem",)))
+for _r_op in ("ReaderRead", "ReaderReadUpTo"):
+    op_registry.declare_effects(
+        _r_op, op_registry.Effects(io=True, writes=("reader_name", "queue_name")))
+op_registry.declare_effects("ReaderReset", op_registry.Effects(writes=("reader_name",)))
+for _r_op in ("ReaderNumRecordsProduced", "ReaderNumWorkUnitsCompleted"):
+    op_registry.declare_effects(_r_op, op_registry.Effects(reads=("reader_name",)))

@@ -33,7 +33,8 @@ def _lower_print(ctx, op, inputs):
     return [inputs[0]]
 
 
-op_registry.register("Print", lower=_lower_print, is_stateful=True)
+op_registry.register("Print", lower=_lower_print,
+                     effects=op_registry.Effects(io=True))
 
 
 def _lower_assert_checked(ctx, op, inputs):
@@ -90,8 +91,8 @@ def _lower_assert_checked(ctx, op, inputs):
     return []
 
 
-op_registry.register("Assert", lower=_lower_assert_checked, is_stateful=True,
-                     n_outputs=0)
+op_registry.register("Assert", lower=_lower_assert_checked,
+                     effects=op_registry.Effects(io=True), n_outputs=0)
 
 
 def Print(input_, data, message=None, first_n=None, summarize=None, name=None):

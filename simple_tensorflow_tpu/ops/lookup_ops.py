@@ -571,3 +571,11 @@ def tables_initializer(name="init_all_tables"):
 def initialize_all_tables(name="init_all_tables"):
     """Deprecated TF-1.0 alias of tables_initializer."""
     return tables_initializer(name=name)
+
+
+# declared effect sets (stf.analysis): table state is a host resource
+op_registry.declare_effects("InitializeTable", op_registry.Effects(writes=("table_name",)))
+op_registry.declare_effects("LookupTableInsert", op_registry.Effects(writes=("table_name",)))
+for _r_op in ("LookupTableFind", "LookupTableSize", "LookupTableExport",
+              "LookupTableFindDevice"):
+    op_registry.declare_effects(_r_op, op_registry.Effects(reads=("table_name",)))

@@ -317,7 +317,8 @@ def _dropout_lower(ctx, op, inputs):
     return [jnp.where(mask, x / kp, jnp.zeros_like(x))]
 
 
-op_registry.register("Dropout", lower=_dropout_lower, is_stateful=True)
+op_registry.register("Dropout", lower=_dropout_lower,
+                     effects=op_registry.Effects(rng=True))
 
 op_registry.register_pure("InTopK", lambda predictions, targets, k=1:
                           _in_top_k_impl(predictions, targets, k))

@@ -244,3 +244,12 @@ op_registry.register("DynamicSliceCrop", lower=_lower_dyn_crop)
 
 
 set_random_seed = random_seed_mod.set_random_seed
+
+
+# declared effect sets (stf.analysis): every sampler draws from the
+# per-step PRNG stream — never CSE'd/folded, flagged by lint when
+# unseeded, invisible to the variable-hazard detector (no resources)
+for _rng_op in ("RandomUniform", "RandomStandardNormal", "TruncatedNormal",
+                "RandomShuffle", "Multinomial", "RandomGamma",
+                "RandomPoisson"):
+    op_registry.declare_effects(_rng_op, op_registry.Effects(rng=True))

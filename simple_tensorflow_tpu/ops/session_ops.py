@@ -158,3 +158,9 @@ op_registry.register("DeleteSessionTensor", lower=_lower_delete,
 
 
 get_session_handle_v2 = get_session_handle  # ref raw-op alias
+
+
+# declared effect sets (stf.analysis)
+op_registry.declare_effects("GetSessionHandle", op_registry.Effects(io=True))
+op_registry.declare_effects("GetSessionTensor", op_registry.Effects(io=True))
+op_registry.declare_effects("DeleteSessionTensor", op_registry.Effects(io=True))

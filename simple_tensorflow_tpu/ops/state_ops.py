@@ -29,11 +29,13 @@ def _lower_variable(ctx, op, inputs):
     return [ctx.read_var(op.attrs["var_name"], op)]
 
 
-op_registry.register("VariableV2", lower=_lower_variable, is_stateful=True)
+op_registry.register("VariableV2", lower=_lower_variable,
+                     effects=op_registry.Effects(reads=("var_name",)))
 # Fresh read of the current store value at this node's topological position;
 # lets `with control_dependencies([assign]): v.read_value()` observe the
 # write (TF-1.0 ref-variable deref-at-use semantics).
-op_registry.register("ReadVariable", lower=_lower_variable, is_stateful=True)
+op_registry.register("ReadVariable", lower=_lower_variable,
+                     effects=op_registry.Effects(reads=("var_name",)))
 
 
 def _lower_assign(ctx, op, inputs):
@@ -55,7 +57,8 @@ def _lower_assign(ctx, op, inputs):
     return [val]
 
 
-op_registry.register("Assign", lower=_lower_assign, is_stateful=True)
+op_registry.register("Assign", lower=_lower_assign,
+                     effects=op_registry.Effects(writes=("var_name",)))
 
 
 def _make_aug_assign(fn):
@@ -71,9 +74,11 @@ def _make_aug_assign(fn):
 
 
 op_registry.register("AssignAdd", lower=_make_aug_assign(lambda a, b: a + b),
-                     is_stateful=True)
+                     effects=op_registry.Effects(writes=("var_name",),
+                                                 update="add"))
 op_registry.register("AssignSub", lower=_make_aug_assign(lambda a, b: a - b),
-                     is_stateful=True)
+                     effects=op_registry.Effects(writes=("var_name",),
+                                                 update="sub"))
 
 
 def _make_scatter(update):
@@ -90,25 +95,39 @@ def _make_scatter(update):
 
 op_registry.register(
     "ScatterUpdate",
-    lower=_make_scatter(lambda v, i, u: v.at[i].set(u)), is_stateful=True)
+    lower=_make_scatter(lambda v, i, u: v.at[i].set(u)),
+    effects=op_registry.Effects(writes=("var_name",),
+                                update="update"))
 op_registry.register(
     "ScatterAdd",
-    lower=_make_scatter(lambda v, i, u: v.at[i].add(u)), is_stateful=True)
+    lower=_make_scatter(lambda v, i, u: v.at[i].add(u)),
+    effects=op_registry.Effects(writes=("var_name",),
+                                update="add"))
 op_registry.register(
     "ScatterSub",
-    lower=_make_scatter(lambda v, i, u: v.at[i].add(-u)), is_stateful=True)
+    lower=_make_scatter(lambda v, i, u: v.at[i].add(-u)),
+    effects=op_registry.Effects(writes=("var_name",),
+                                update="sub"))
 op_registry.register(
     "ScatterMul",
-    lower=_make_scatter(lambda v, i, u: v.at[i].mul(u)), is_stateful=True)
+    lower=_make_scatter(lambda v, i, u: v.at[i].mul(u)),
+    effects=op_registry.Effects(writes=("var_name",),
+                                update="mul"))
 op_registry.register(
     "ScatterDiv",
-    lower=_make_scatter(lambda v, i, u: v.at[i].divide(u)), is_stateful=True)
+    lower=_make_scatter(lambda v, i, u: v.at[i].divide(u)),
+    effects=op_registry.Effects(writes=("var_name",),
+                                update="div"))
 op_registry.register(
     "ScatterMin",
-    lower=_make_scatter(lambda v, i, u: v.at[i].min(u)), is_stateful=True)
+    lower=_make_scatter(lambda v, i, u: v.at[i].min(u)),
+    effects=op_registry.Effects(writes=("var_name",),
+                                update="min"))
 op_registry.register(
     "ScatterMax",
-    lower=_make_scatter(lambda v, i, u: v.at[i].max(u)), is_stateful=True)
+    lower=_make_scatter(lambda v, i, u: v.at[i].max(u)),
+    effects=op_registry.Effects(writes=("var_name",),
+                                update="max"))
 
 
 def _lower_scatter_nd_update(ctx, op, inputs):
@@ -121,7 +140,8 @@ def _lower_scatter_nd_update(ctx, op, inputs):
 
 
 op_registry.register("ScatterNdUpdate", lower=_lower_scatter_nd_update,
-                     is_stateful=True)
+                     effects=op_registry.Effects(writes=("var_name",),
+                                                 update="update"))
 
 
 def _lower_is_initialized(ctx, op, inputs):
@@ -130,7 +150,8 @@ def _lower_is_initialized(ctx, op, inputs):
 
 
 op_registry.register("IsVariableInitialized", lower=_lower_is_initialized,
-                     is_stateful=True, runs_on_host=True)
+                     runs_on_host=True,
+                     effects=op_registry.Effects(reads=("var_name",)))
 
 
 def _lower_count_up_to(ctx, op, inputs):
@@ -148,8 +169,10 @@ def _lower_count_up_to(ctx, op, inputs):
     return [np.asarray(cur)]
 
 
-op_registry.register("CountUpTo", lower=_lower_count_up_to, is_stateful=True,
-                     runs_on_host=True)
+op_registry.register("CountUpTo", lower=_lower_count_up_to,
+                     runs_on_host=True,
+                     effects=op_registry.Effects(writes=("var_name",),
+                                                 update="add"))
 
 
 # -- public API --------------------------------------------------------------
@@ -287,11 +310,11 @@ def _lower_scatter_nd_aug(fn):
 op_registry.register(
     "ScatterNdAdd",
     lower=_lower_scatter_nd_aug(lambda v, i, u: v.at[i].add(u)),
-    is_stateful=True)
+    effects=op_registry.Effects(writes=("var_name",), update="add"))
 op_registry.register(
     "ScatterNdSub",
     lower=_lower_scatter_nd_aug(lambda v, i, u: v.at[i].add(-u)),
-    is_stateful=True)
+    effects=op_registry.Effects(writes=("var_name",), update="sub"))
 
 
 def scatter_nd_add(ref, indices, updates, use_locking=True, name=None):

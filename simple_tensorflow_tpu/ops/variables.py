@@ -364,7 +364,8 @@ def _lower_report_uninitialized(ctx, op, inputs):
 from ..framework import op_registry  # noqa: E402
 
 op_registry.register("ReportUninitialized", lower=_lower_report_uninitialized,
-                     is_stateful=True, runs_on_host=True)
+                     runs_on_host=True,
+                     effects=op_registry.Effects(io=True))
 
 
 class ResourceVariable(Variable):

@@ -1,0 +1,109 @@
+"""Offline graph verifier + linter for serialized GraphDef JSON.
+
+    python -m simple_tensorflow_tpu.tools.graph_lint graphdef.json \
+        [--fetch op_or_tensor ...] [--severity code=level ...] \
+        [--level structural|full] [--json]
+
+Runs the stf.analysis stack over a GraphDef written by
+``stf.train.write_graph`` / ``graph_io.write_graph``:
+
+  1. ``verify_graphdef`` — structural wire-format invariants (dangling
+     refs, duplicate names, unregistered ops, cycles, FuncGraph body
+     signatures). Errors here stop the run: the graph cannot be
+     imported.
+  2. import into a fresh Graph, then ``analyze`` — live verifier (full
+     level by default, including abstract-eval shape/dtype re-checks),
+     per-fetch variable-hazard detection, and the lint rule catalog.
+
+Diagnostics carry the op's original creation site when the GraphDef
+recorded one (graph_io serializes the innermost user frame). Exit code
+1 when any ERROR-severity diagnostic survives, else 0 — suitable as a
+CI gate (tests/test_graph_lint_clean.py uses the same entry points
+in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run_lint(graph_def: dict, fetch_names=None, severities=None,
+             level: str = "full"):
+    """Programmatic entry: returns (diagnostics, imported_graph|None)."""
+    from .. import analysis
+    from ..framework import graph as graph_mod
+    from ..framework import graph_io
+
+    diags = analysis.verify_graphdef(graph_def)
+    if analysis.errors(diags):
+        return diags, None
+    graph = graph_mod.Graph()
+    with graph.as_default():
+        graph_io.import_graph_def(graph_def, name="")
+    fetches = []
+    for name in fetch_names or []:
+        try:
+            fetches.append(graph.as_graph_element(
+                name, allow_tensor=True, allow_operation=True))
+        except (KeyError, ValueError) as e:
+            from ..analysis.diagnostics import ERROR, report
+
+            report(diags, ERROR, "lint-cli/bad-fetch",
+                   f"--fetch {name!r}: {e}")
+    diags.extend(analysis.analyze(graph, fetches=fetches or None,
+                                  level=level, severities=severities))
+    return diags, graph
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m simple_tensorflow_tpu.tools.graph_lint",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("graphdef", help="GraphDef JSON file (graph_io format)")
+    ap.add_argument("--fetch", action="append", default=[],
+                    help="op/tensor name treated as a fetch (enables "
+                         "hazard + unreachable-stateful + const-fetch "
+                         "checks); repeatable")
+    ap.add_argument("--severity", action="append", default=[],
+                    metavar="CODE=LEVEL",
+                    help="override a rule severity, e.g. "
+                         "lint/unseeded-rng=error or narrow-64bit=off")
+    ap.add_argument("--level", choices=["structural", "full"],
+                    default="full", help="verifier depth (default full)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit diagnostics as JSON lines")
+    args = ap.parse_args(argv)
+
+    from ..analysis.diagnostics import SEVERITIES
+
+    severities = {}
+    for kv in args.severity:
+        if "=" not in kv:
+            ap.error(f"--severity needs CODE=LEVEL, got {kv!r}")
+        k, v = kv.split("=", 1)
+        if v not in SEVERITIES + ("off",):
+            ap.error(f"--severity {k}: level must be one of "
+                     f"{SEVERITIES + ('off',)}, got {v!r}")
+        severities[k] = v
+
+    with open(args.graphdef) as f:
+        gd = json.load(f)
+
+    from .. import analysis
+
+    diags, _graph = run_lint(gd, fetch_names=args.fetch,
+                             severities=severities, level=args.level)
+    if args.json:
+        for d in diags:
+            print(json.dumps(d.to_dict()))
+    else:
+        print(analysis.format_report(
+            diags, header=f"graph_lint {args.graphdef}:"))
+    return 1 if analysis.errors(diags) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

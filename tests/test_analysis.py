@@ -1,0 +1,691 @@
+"""stf.analysis: graph verifier, variable-hazard detector, lint
+framework, op-source attribution (ISSUE 3)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import analysis
+from simple_tensorflow_tpu.framework import graph as graph_mod
+from simple_tensorflow_tpu.framework import graph_io, lowering, op_registry
+from simple_tensorflow_tpu.ops import state_ops
+from simple_tensorflow_tpu.platform import monitoring
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    prev = analysis.get_hazard_mode()
+    yield
+    analysis.set_hazard_mode(prev)
+    stf.reset_default_graph()
+
+
+# ---------------------------------------------------------------------------
+# effects + traceback capture
+# ---------------------------------------------------------------------------
+
+class TestEffectsAndTraceback:
+    def test_declared_effect_sets(self):
+        v = stf.Variable(1.0, name="v")
+        read = v.read_value()
+        wr = stf.assign(v, 2.0)
+        aa = stf.assign_add(v, 1.0)
+        assert analysis.op_effects(read.op).reads == {"var_name=v"}
+        assert analysis.op_effects(wr.op).writes == {"var_name=v"}
+        ra = analysis.op_effects(aa.op)
+        assert ra.writes == {"var_name=v"} and ra.update == "add"
+        rnd = stf.random_uniform([2])
+        assert analysis.op_effects(rnd.op).rng
+        pure = analysis.op_effects((read + 1.0).op)
+        assert not pure and pure.describe() == "pure"
+
+    def test_effects_imply_stateful(self):
+        od = op_registry.get("Assign")
+        assert od.is_stateful and od.effects_declared
+
+    def test_traceback_points_at_user_code(self):
+        x = stf.constant(1.0)  # <- this line is the creation site
+        assert x.op.traceback, "traceback capture should be on by default"
+        fname, lineno, func = x.op.traceback[0]
+        assert fname.endswith("test_analysis.py")
+        assert func == "test_traceback_points_at_user_code"
+        assert x.op.source_site == f"{fname}:{lineno}"
+
+    def test_traceback_capture_off_switch(self):
+        prev = analysis.set_traceback_capture(False)
+        try:
+            x = stf.constant(2.0)
+            assert x.op.traceback == () and x.op.source_site is None
+        finally:
+            analysis.set_traceback_capture(prev)
+
+    def test_source_survives_serialization_roundtrip(self):
+        y = stf.constant(3.0, name="roundtrip_c")
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+        stf.reset_default_graph()
+        graph_io.import_graph_def(json.dumps(gd), name="")
+        op = stf.get_default_graph().get_operation_by_name("roundtrip_c")
+        assert op.source_site and "test_analysis.py" in op.source_site
+
+
+# ---------------------------------------------------------------------------
+# verifier
+# ---------------------------------------------------------------------------
+
+class TestVerifier:
+    def test_clean_graph_has_no_errors(self):
+        x = stf.placeholder(stf.float32, [2, 2], name="x")
+        stf.matmul(x, x)
+        diags = analysis.verify_graph(stf.get_default_graph(),
+                                      level="full")
+        assert analysis.errors(diags) == []
+
+    def test_infer_mismatch_dtype_is_error(self):
+        g = stf.get_default_graph()
+        a = stf.constant(np.ones((2,), np.float32))
+        b = stf.constant(np.ones((2,), np.float32))
+        # lie about the output dtype: abstract eval derives float32
+        g.create_op("Add", [a, b], name="liar",
+                    output_specs=[(a.shape, stf.int32)])
+        diags = analysis.verify_graph(g, level="full")
+        errs = analysis.errors(diags)
+        assert any(d.code == "verifier/infer-mismatch" for d in errs)
+        d = next(d for d in errs if d.code == "verifier/infer-mismatch")
+        assert d.op_name == "liar" and d.source \
+            and "test_analysis.py" in d.source
+
+    def test_structural_level_skips_abstract_eval(self):
+        g = stf.get_default_graph()
+        a = stf.constant(np.ones((2,), np.float32))
+        g.create_op("Add", [a, a], name="liar2",
+                    output_specs=[(a.shape, stf.int32)])
+        diags = analysis.verify_graph(g, level="structural")
+        assert analysis.errors(diags) == []
+
+    def test_unreachable_stateful_note(self):
+        v = stf.Variable(1.0, name="uv")
+        stf.assign(v, 7.0, name="orphan_assign")
+        fetch = v.read_value() + 1.0
+        diags = analysis.verify_graph(stf.get_default_graph(),
+                                      fetches=[fetch])
+        notes = [d for d in diags
+                 if d.code == "verifier/unreachable-stateful"]
+        assert any("orphan_assign" in (d.op_name or "") for d in notes)
+
+    def test_device_scope_warning_for_host_op_on_device(self):
+        v = stf.Variable(1.0, name="dv")
+        with stf.device("/device:TPU:0"):
+            state_ops.is_variable_initialized(v)
+        diags = analysis.verify_graph(stf.get_default_graph())
+        assert any(d.code == "verifier/device-scope" for d in diags)
+
+    def test_graphdef_dangling_and_duplicate(self):
+        stf.constant(1.0, name="c1")
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+        node = dict(gd["node"][0])
+        gd["node"].append(node)  # duplicate name
+        diags = analysis.verify_graphdef(gd)
+        assert any(d.code == "verifier/duplicate-name" for d in diags)
+        gd2 = {"node": [{"name": "n", "op": "Add",
+                         "input": ["ghost:0", "ghost:1"],
+                         "control_input": [], "attr": {}}]}
+        diags2 = analysis.verify_graphdef(gd2)
+        assert any(d.code == "verifier/dangling-input" for d in diags2)
+
+    def test_graphdef_cycle_detected(self):
+        gd = {"node": [
+            {"name": "a", "op": "Neg", "input": ["b:0"],
+             "control_input": [], "attr": {}},
+            {"name": "b", "op": "Neg", "input": ["a:0"],
+             "control_input": [], "attr": {}},
+        ]}
+        diags = analysis.verify_graphdef(gd)
+        assert any(d.code == "verifier/cycle" for d in diags)
+
+    def test_graphdef_funcgraph_signature_checked(self):
+        x = stf.constant(2.0)
+        r = stf.cond(x > 1.0, lambda: x * 2.0, lambda: x)
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+        assert analysis.errors(analysis.verify_graphdef(gd)) == []
+        # break one branch body: drop its output node
+        for n in gd["node"]:
+            for k, v in (n.get("attr") or {}).items():
+                if isinstance(v, dict) and v.get("__kind__") == "funcgraph":
+                    body = v["v"]
+                    out_node = body["outputs"][0].split(":")[0]
+                    body["node"] = [bn for bn in body["node"]
+                                    if bn["name"] != out_node]
+                    diags = analysis.verify_graphdef(gd)
+                    assert any(d.code == "verifier/funcgraph-signature"
+                               for d in analysis.errors(diags))
+                    return
+        pytest.fail("no funcgraph found in cond graphdef")
+
+
+# ---------------------------------------------------------------------------
+# hazard detector
+# ---------------------------------------------------------------------------
+
+def _plan_for(fetches, extra_ops=()):
+    targets = [t.op for t in fetches] + list(extra_ops)
+    return lowering.prune(targets, set())
+
+
+class TestHazards:
+    def _racy(self):
+        v = stf.Variable(1.0, name="hv")
+        read = v.read_value()
+        consumed = read + 0.0
+        wr = stf.assign(v, 5.0)
+        return v, consumed, wr
+
+    def test_unordered_read_write_detected(self):
+        _, consumed, wr = self._racy()
+        plan = _plan_for([consumed], [wr.op])
+        hz = analysis.find_hazards(plan)
+        assert len(hz) == 1 and hz[0].kind in ("raw", "war")
+        assert hz[0].resource == "var_name=hv"
+        d = hz[0].to_diagnostic(analysis.WARNING)
+        assert d.op_name and d.source and "test_analysis.py" in d.source
+
+    def test_ordered_pair_is_clean(self):
+        v = stf.Variable(1.0, name="ov")
+        wr = stf.assign(v, 5.0)
+        with stf.control_dependencies([wr]):
+            read = v.read_value()
+        consumed = read + 0.0
+        plan = _plan_for([consumed], [wr.op])
+        assert analysis.find_hazards(plan) == []
+
+    def test_bare_fetch_read_exempt(self):
+        v = stf.Variable(1.0, name="bv")
+        read = v.read_value()   # fetched raw, consumed by nothing
+        wr = stf.assign(v, 5.0)
+        plan = _plan_for([read], [wr.op])
+        assert analysis.find_hazards(plan) == []
+
+    def test_waw_detected_and_commuting_waw_not(self):
+        v = stf.Variable(1.0, name="wv")
+        a1 = stf.assign(v, 5.0)
+        a2 = stf.assign(v, 9.0)
+        plan = _plan_for([], [a1.op, a2.op])
+        hz = analysis.find_hazards(plan)
+        assert [h.kind for h in hz] == ["waw"]
+        stf.reset_default_graph()
+        w = stf.Variable(1.0, name="wv2")
+        b1 = stf.assign_add(w, 5.0)
+        b2 = stf.assign_sub(w, 2.0)
+        plan2 = _plan_for([], [b1.op, b2.op])
+        assert analysis.find_hazards(plan2) == []
+
+    def test_raise_mode_in_session(self):
+        _, consumed, wr = self._racy()
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        with pytest.raises(stf.errors.InvalidArgumentError) as ei:
+            sess.run([consumed, wr])
+        msg = str(ei.value)
+        assert "hazard" in msg and "control_dependencies" in msg
+        assert "test_analysis.py" in msg  # op-source attribution
+
+    def test_warn_mode_runs(self):
+        analysis.set_hazard_mode("warn")
+        _, consumed, wr = self._racy()
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        out = sess.run([consumed, wr])
+        assert np.asarray(out[1]) == 5.0
+
+    def test_session_config_overrides_process_mode(self):
+        analysis.set_hazard_mode("raise")
+        _, consumed, wr = self._racy()
+        sess = stf.Session(config=stf.ConfigProto(
+            variable_hazard_mode="off"))
+        sess.run(stf.global_variables_initializer())
+        sess.run([consumed, wr])  # does not raise
+
+    def test_auto_deps_deterministic_across_runs(self):
+        analysis.set_hazard_mode("auto_deps")
+        v, consumed, wr = self._racy()
+        init = stf.global_variables_initializer()
+        sess = stf.Session()
+        seen = set()
+        for _ in range(10):
+            sess.run(init)  # identical state every iteration
+            r, w = sess.run([consumed, wr])
+            seen.add((float(r), float(w)))
+        assert len(seen) == 1, f"auto_deps must be deterministic: {seen}"
+        # program order: the read was created first, so it observes the
+        # initial value
+        assert seen == {(1.0, 5.0)}
+
+    def test_hazard_counters_emitted(self):
+        before = {k: c for k, c in _hazard_counter_values().items()}
+        _, consumed, wr = self._racy()
+        plan = _plan_for([consumed], [wr.op])
+        analysis.check_plan(plan, mode="warn")
+        after = _hazard_counter_values()
+        grew = sum(after.values()) - sum(before.values())
+        assert grew >= 1
+
+
+def _hazard_counter_values():
+    fam = monitoring.export().get("/stf/analysis/hazards", {})
+    return dict(fam.get("cells", {}))
+
+
+# ---------------------------------------------------------------------------
+# hazard fuzz: detected hazards <=> order-dependent results
+# ---------------------------------------------------------------------------
+
+def _interpret(plan, order, init_state):
+    """Reference numpy interpreter over the tiny fuzz op vocabulary;
+    returns (fetchable op -> value, final state)."""
+    state = dict(init_state)
+    env = {}
+    for op in order:
+        t = op.type
+        if t == "Const":
+            env[op.outputs[0]] = float(np.asarray(op.attrs["value"]))
+        elif t == "ReadVariable":
+            env[op.outputs[0]] = state[op.attrs["var_name"]]
+        elif t == "Assign":
+            val = env[op.inputs[0]]
+            state[op.attrs["var_name"]] = val
+            env[op.outputs[0]] = val
+        elif t == "AssignAdd":
+            val = state[op.attrs["var_name"]] + env[op.inputs[0]]
+            state[op.attrs["var_name"]] = val
+            env[op.outputs[0]] = val
+        elif t in ("Add", "AddV2"):
+            env[op.outputs[0]] = env[op.inputs[0]] + env[op.inputs[1]]
+        else:
+            raise AssertionError(f"fuzz interpreter: unexpected op {t}")
+    return env, state
+
+
+def _topo_orders_swapping(plan, first, second):
+    """Two topological orders of ``plan``: one scheduling ``first``
+    before ``second``, one the reverse. Kahn, prioritizing the preferred
+    op's whole ancestor cone (just preferring the op itself is not
+    enough — its inputs must overtake the other op too); remaining ties
+    break by plan position. For an unordered pair this guarantees the
+    preferred op really does run first: nothing in its cone can be
+    blocked behind the other op, or the pair would be ordered."""
+    pos = {op: i for i, op in enumerate(plan)}
+    plan_set = set(plan)
+
+    def deps(op):
+        for t in op.inputs:
+            if t.op in plan_set:
+                yield t.op
+        for c in op.control_inputs:
+            if c in plan_set:
+                yield c
+
+    def cone(root):
+        out = set()
+        work = [root]
+        while work:
+            op = work.pop()
+            if op in out:
+                continue
+            out.add(op)
+            work.extend(deps(op))
+        return out
+
+    def order(prefer):
+        cone_set = cone(prefer)
+        indeg = {op: 0 for op in plan}
+        succ = {op: [] for op in plan}
+        for op in plan:
+            for d in set(deps(op)):
+                indeg[op] += 1
+                succ[d].append(op)
+        ready = [op for op in plan if indeg[op] == 0]
+        out = []
+        while ready:
+            ready.sort(key=lambda op: (0 if op in cone_set else 1,
+                                       pos[op]))
+            op = ready.pop(0)
+            out.append(op)
+            for s in succ[op]:
+                indeg[s] -= 1
+                if indeg[s] == 0:
+                    ready.append(s)
+        assert len(out) == len(plan)
+        return out
+
+    # NOTE: for a pair that is actually ordered by the graph, both
+    # orders necessarily agree on the pair's direction — the no-hazard
+    # soundness sweep passes arbitrary pairs through here
+    return order(first), order(second)
+
+
+class TestHazardFuzz:
+    N_GRAPHS = 25
+
+    def _random_graph(self, rng):
+        n_vars = rng.randint(1, 3)
+        init = {f"fz{i}": float(101 + 13 * i) for i in range(n_vars)}
+        vars_ = [stf.Variable(init[f"fz{i}"], name=f"fz{i}")
+                 for i in range(n_vars)]
+        const_val = [1000.0]
+        stateful = []
+        reads = []
+        fetch_ops = []
+        writes = []
+        assigned = set()
+        for _ in range(rng.randint(3, 9)):
+            v = vars_[rng.randint(0, n_vars)]
+            kind = rng.randint(0, 3)
+            ctx = None
+            if stateful and rng.rand() < 0.4:
+                ctx = stf.control_dependencies(
+                    [stateful[rng.randint(0, len(stateful))]])
+            if ctx is not None:
+                ctx.__enter__()
+            try:
+                if kind == 0:
+                    r = v.read_value()
+                    reads.append(r)
+                    stateful.append(r.op)
+                else:
+                    const_val[0] += 7.0  # unique write values
+                    # overwrite only as a variable's FIRST write: a later
+                    # overwrite can mask an unordered pair's effect
+                    # entirely (dead write), making a structurally real
+                    # WAW hazard unobservable — this generator keeps
+                    # every hazard observable so the iff-assertion is
+                    # strict
+                    if v.op.name in assigned:
+                        w = stf.assign_add(v, const_val[0])
+                    else:
+                        assigned.add(v.op.name)
+                        w = stf.assign(v, const_val[0])
+                    stateful.append(w.op)
+                    fetch_ops.append(w.op)
+                    writes.append(w)
+            finally:
+                if ctx is not None:
+                    ctx.__exit__(None, None, None)
+        fetch = None
+        if reads:
+            # start the chain from a constant so EVERY read (even a
+            # lone one) is consumed inside the step — bare-fetch reads
+            # are exempt from hazard detection by design
+            fetch = stf.constant(0.0)
+            for r in reads:
+                fetch = fetch + r
+        targets = ([fetch.op] if fetch is not None else []) + fetch_ops
+        plan = lowering.prune(targets, set())
+        return plan, init, fetch, writes
+
+    @staticmethod
+    def _result(plan, order, init, fetch):
+        env, state = _interpret(plan, order, init)
+        fval = env[fetch] if fetch is not None else None
+        return (fval, tuple(sorted(state.items())))
+
+    def test_fuzz_hazards_iff_order_dependent(self):
+        rng = np.random.RandomState(1234)
+        n_with_hazards = 0
+        for gi in range(self.N_GRAPHS):
+            stf.reset_default_graph()
+            plan, init, fetch, _writes = self._random_graph(rng)
+            if len(plan) < 2:
+                continue
+            hazards = analysis.find_hazards(plan)
+            if not hazards:
+                # soundness: no hazard => every topological order agrees
+                results = set()
+                for a in plan:
+                    for b in plan:
+                        if a is b:
+                            continue
+                        o1, o2 = _topo_orders_swapping(plan, a, b)
+                        results.add(self._result(plan, o1, init, fetch))
+                        results.add(self._result(plan, o2, init, fetch))
+                assert len(results) == 1, (
+                    f"graph {gi}: no hazard detected but orders "
+                    f"disagree: {results}")
+            else:
+                n_with_hazards += 1
+                # every detected hazard corresponds to an
+                # order-dependent result: swapping just that pair
+                # changes the outcome
+                for h in hazards:
+                    o1, o2 = _topo_orders_swapping(plan, h.first,
+                                                   h.second)
+                    r1 = self._result(plan, o1, init, fetch)
+                    r2 = self._result(plan, o2, init, fetch)
+                    assert r1 != r2, (
+                        f"graph {gi}: hazard {h} reported but both "
+                        f"orders agree: {r1}")
+        assert n_with_hazards >= 3, (
+            "fuzz generator produced too few hazardous graphs for the "
+            f"test to be meaningful ({n_with_hazards})")
+
+    def test_fuzz_auto_deps_matches_program_order_semantics(self):
+        """auto_deps makes hazardous graphs run deterministically, with
+        the program-order semantics the reference's auto-control-deps
+        define: the session result must equal the reference interpreter
+        on the program-ordered plan, across repeated runs."""
+        rng = np.random.RandomState(99)
+        checked = 0
+        for _ in range(10):
+            stf.reset_default_graph()
+            plan, init, fetch, writes = self._random_graph(rng)
+            if fetch is None or not analysis.find_hazards(plan):
+                continue
+            checked += 1
+            ordered, _ = analysis.check_plan(plan, mode="auto_deps")
+            expect_env, _ = _interpret(plan, ordered, init)
+            analysis.set_hazard_mode("auto_deps")
+            sess = stf.Session()
+            init_op = stf.global_variables_initializer()
+            seen = set()
+            for _run in range(4):
+                sess.run(init_op)
+                got = sess.run([fetch] + writes)
+                seen.add(tuple(float(np.asarray(x)) for x in got))
+            assert len(seen) == 1, f"auto_deps nondeterministic: {seen}"
+            assert next(iter(seen))[0] == expect_env[fetch]
+            analysis.set_hazard_mode("raise")
+        assert checked >= 2
+
+
+# ---------------------------------------------------------------------------
+# lint rules
+# ---------------------------------------------------------------------------
+
+class TestLintRules:
+    def _codes(self, diags):
+        return {d.code for d in diags}
+
+    def test_int_div_float_fires(self):
+        a = stf.constant(np.array([7], np.int32))
+        b = stf.constant(np.array([2], np.int32))
+        q = stf.floordiv(a, b)
+        stf.cast(q, stf.float32)
+        diags = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/int-div-float" in self._codes(diags)
+
+    def test_int_div_float_quiet_on_int_consumers(self):
+        a = stf.constant(np.array([7], np.int32))
+        q = stf.floordiv(a, stf.constant(np.array([2], np.int32)))
+        q + stf.constant(np.array([1], np.int32))
+        diags = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/int-div-float" not in self._codes(diags)
+
+    def test_narrow_64bit_flags_wide_placeholder(self):
+        stf.placeholder(stf.int64, [2], name="wide")
+        diags = analysis.lint_graph(stf.get_default_graph())
+        hits = [d for d in diags if d.code == "lint/narrow-64bit"]
+        assert hits and hits[0].severity == analysis.NOTE
+
+    def test_narrow_64bit_quiet_on_int32(self):
+        stf.placeholder(stf.int32, [2])
+        diags = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/narrow-64bit" not in self._codes(diags)
+
+    def test_unseeded_rng_fires_and_seeding_silences(self):
+        stf.random_uniform([2])
+        diags = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/unseeded-rng" in self._codes(diags)
+        stf.reset_default_graph()
+        stf.set_random_seed(7)
+        stf.random_uniform([2])
+        diags2 = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/unseeded-rng" not in self._codes(diags2)
+
+    def test_const_fetch_fires_only_with_fetches(self):
+        c = stf.constant(2.0) * stf.constant(3.0)
+        diags = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/const-fetch" not in self._codes(diags)
+        diags2 = analysis.lint_graph(stf.get_default_graph(),
+                                     fetches=[c])
+        assert "lint/const-fetch" in self._codes(diags2)
+
+    def test_const_fetch_quiet_on_fed_graphs(self):
+        x = stf.placeholder(stf.float32, [2])
+        y = x * stf.constant(2.0)
+        diags = analysis.lint_graph(stf.get_default_graph(),
+                                    fetches=[y])
+        assert "lint/const-fetch" not in self._codes(diags)
+
+    def test_transpose_pair_fires(self):
+        x = stf.placeholder(stf.float32, [1, 2, 3, 4])
+        t1 = stf.transpose(x, [0, 3, 1, 2])
+        stf.transpose(t1, [0, 2, 3, 1])
+        diags = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/transpose-pair" in self._codes(diags)
+
+    def test_transpose_pair_quiet_on_non_inverse(self):
+        x = stf.placeholder(stf.float32, [1, 2, 3, 4])
+        t1 = stf.transpose(x, [0, 3, 1, 2])
+        stf.transpose(t1, [0, 3, 1, 2])
+        diags = analysis.lint_graph(stf.get_default_graph())
+        assert "lint/transpose-pair" not in self._codes(diags)
+
+    def test_severity_override_and_off(self):
+        stf.random_uniform([2])
+        diags = analysis.lint_graph(
+            stf.get_default_graph(),
+            severities={"lint/unseeded-rng": "error"})
+        assert any(d.code == "lint/unseeded-rng" and d.is_error
+                   for d in diags)
+        diags2 = analysis.lint_graph(
+            stf.get_default_graph(),
+            severities={"unseeded-rng": "off"})
+        assert "lint/unseeded-rng" not in self._codes(diags2)
+
+    def test_custom_rule_registration(self):
+        @analysis.register_lint_rule("test-no-matmul", analysis.WARNING)
+        def _no_matmul(ctx):
+            for op in ctx.ops:
+                if op.type == "MatMul":
+                    yield op, "matmul forbidden by test rule"
+
+        try:
+            x = stf.placeholder(stf.float32, [2, 2])
+            stf.matmul(x, x)
+            diags = analysis.lint_graph(stf.get_default_graph(),
+                                        rules=["lint/test-no-matmul"])
+            assert [d.code for d in diags] == ["lint/test-no-matmul"]
+        finally:
+            from simple_tensorflow_tpu.analysis import lint as lint_mod
+
+            lint_mod._RULES.pop("lint/test-no-matmul", None)
+
+
+# ---------------------------------------------------------------------------
+# session + passmanager wiring
+# ---------------------------------------------------------------------------
+
+class TestWiring:
+    def test_strict_session_rejects_broken_graph(self):
+        g = stf.get_default_graph()
+        a = stf.constant(np.ones((2,), np.float32))
+        g.create_op("Add", [a, a], name="bad_specs",
+                    output_specs=[(a.shape, stf.int32)])
+        with pytest.raises(stf.errors.InvalidArgumentError):
+            stf.Session(config=stf.ConfigProto(graph_analysis="strict"))
+
+    def test_strict_session_accepts_clean_graph(self):
+        x = stf.placeholder(stf.float32, [2])
+        y = x * 2.0
+        sess = stf.Session(config=stf.ConfigProto(
+            graph_analysis="strict"))
+        out = sess.run(y, {x: np.ones(2, np.float32)})
+        assert np.allclose(out, 2.0)
+
+    def test_passmanager_detects_breaking_pass(self):
+        from simple_tensorflow_tpu.framework import optimizer
+
+        stf.constant(1.0, name="keepme")
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+
+        def breaker(graph_def, keep):
+            import copy
+
+            out = copy.deepcopy(graph_def)
+            out["node"].append({"name": "broken", "op": "Add",
+                                "input": ["nowhere:0", "nowhere:1"],
+                                "control_input": [], "attr": {}})
+            return out
+
+        pm = optimizer.PassManager(
+            [optimizer.GraphPass("breaker", breaker)], verify=True)
+        with pytest.raises(stf.errors.InternalError) as ei:
+            pm.run(gd, keep=["keepme"])
+        assert "breaker" in str(ei.value)
+
+    def test_passmanager_default_pipeline_verifies_clean(self):
+        from simple_tensorflow_tpu.framework import optimizer
+
+        x = stf.placeholder(stf.float32, [2, 2], name="pmx")
+        y = stf.matmul(x, x)
+        r = stf.reduce_sum(y, name="pmr")
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+        pm = optimizer.PassManager(verify=True)
+        out = pm.run(gd, keep=["pmr", "pmx"])
+        assert analysis.errors(analysis.verify_graphdef(out)) == []
+
+
+# ---------------------------------------------------------------------------
+# graph_lint CLI + debug CLI rendering
+# ---------------------------------------------------------------------------
+
+class TestTools:
+    def test_graph_lint_cli(self, tmp_path, capsys):
+        from simple_tensorflow_tpu.tools import graph_lint
+
+        x = stf.placeholder(stf.float32, [2, 2], name="gx")
+        stf.matmul(x, x, name="gy")
+        gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+        p = tmp_path / "g.json"
+        p.write_text(json.dumps(gd))
+        rc = graph_lint.main([str(p), "--fetch", "gy:0"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "0 error(s)" in out
+        # break it
+        gd["node"][-1]["input"] = ["ghost:0", "ghost:0"]
+        p.write_text(json.dumps(gd))
+        rc2 = graph_lint.main([str(p)])
+        out2 = capsys.readouterr().out
+        assert rc2 == 1 and "verifier/dangling-input" in out2
+
+    def test_debug_cli_renders_effects_and_traceback(self, tmp_path):
+        from simple_tensorflow_tpu.debug.cli import AnalyzerCLI
+
+        v = stf.Variable(1.0, name="cliv")
+        stf.assign(v, 2.0, name="cliw")
+        cli = AnalyzerCLI(str(tmp_path), graph=stf.get_default_graph())
+        out = cli.run_command("ni cliw")
+        assert "effects: writes={var_name=cliv}" in out
+        assert "created at:" in out and "test_analysis.py" in out

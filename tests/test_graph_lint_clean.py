@@ -1,0 +1,171 @@
+"""CI gate: the stf.analysis verifier + linter must be clean over every
+graph the model zoo (and the example training flows built from it)
+produces — zero ERROR diagnostics; warnings are snapshotted per model so
+new smells surface as a diff, not silently (ISSUE 3 satellite).
+
+Build-only: graphs are constructed and analyzed, never executed, so the
+gate stays fast and hermetic.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import analysis
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+    stf.reset_default_graph()
+
+
+# warning/note codes each model graph is allowed to produce today. A new
+# code appearing is a lint regression (fix the graph or extend the
+# snapshot deliberately); ERRORS are never allowed.
+ALLOWED_WARNINGS = {
+    "mnist_softmax": set(),
+    "mnist_convnet": {"lint/unseeded-rng"},          # dropout, seed opt-in
+    "resnet_tiny": {"lint/unseeded-rng"},            # kernel initializers
+    "bert_tiny": {"lint/unseeded-rng"},              # dropout
+    "transformer_tiny": {"lint/unseeded-rng"},       # dropout
+    "word2vec": {"lint/unseeded-rng"},               # NCE sampler
+    "seq2seq_tiny": {"lint/unseeded-rng"},           # dropout
+    "ptb_lstm_tiny": {"lint/unseeded-rng"},          # dropout
+    "example_mnist_end_to_end": {"lint/unseeded-rng"},
+}
+# note-severity codes tolerated everywhere (informational)
+ALLOWED_NOTES = {"lint/narrow-64bit", "verifier/unreachable-stateful",
+                 "lint/const-fetch"}
+
+
+def _analyze(model_key, fetches):
+    diags = analysis.analyze(stf.get_default_graph(), fetches=fetches,
+                             level="full")
+    errs = analysis.errors(diags)
+    assert errs == [], (
+        f"{model_key}: analysis errors:\n"
+        + analysis.format_report(errs))
+    warn_codes = {d.code for d in analysis.warnings(diags)}
+    extra = warn_codes - ALLOWED_WARNINGS[model_key]
+    assert not extra, (
+        f"{model_key}: new warning codes {sorted(extra)} — fix the "
+        "graph or extend the snapshot deliberately:\n"
+        + analysis.format_report(analysis.warnings(diags)))
+    note_codes = {d.code for d in diags if d.severity == analysis.NOTE}
+    extra_notes = note_codes - ALLOWED_NOTES
+    assert not extra_notes, (
+        f"{model_key}: new note codes {sorted(extra_notes)}")
+    # every diagnostic must carry op + source attribution (acceptance
+    # criterion: diagnostics point at user code)
+    for d in diags:
+        assert d.op_name, f"{model_key}: diagnostic without op: {d}"
+        assert d.source, f"{model_key}: diagnostic without source: {d}"
+    return collections.Counter(d.code for d in diags)
+
+
+def test_mnist_softmax_clean():
+    from simple_tensorflow_tpu.models import mnist
+
+    m = mnist.softmax_model(learning_rate=0.01)
+    _analyze("mnist_softmax", [m["train_op"], m["loss"]])
+
+
+def test_mnist_convnet_clean():
+    from simple_tensorflow_tpu.models import mnist
+
+    m = mnist.convnet_model(batch_size=8)
+    _analyze("mnist_convnet", [m["train_op"], m["loss"]])
+
+
+def test_resnet_tiny_clean():
+    from simple_tensorflow_tpu.models import resnet
+
+    m = resnet.resnet50_train_model(batch_size=2, image_size=32,
+                                    num_classes=10)
+    _analyze("resnet_tiny", [m["train_op"], m["loss"]])
+
+
+def test_bert_tiny_clean():
+    from simple_tensorflow_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    m = bert.bert_pretrain_model(batch_size=2, seq_len=16,
+                                 max_predictions=4, cfg=cfg,
+                                 compute_dtype=stf.float32)
+    _analyze("bert_tiny", [m["train_op"], m["loss"]])
+
+
+def test_transformer_tiny_clean():
+    from simple_tensorflow_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig.tiny()
+    m = tr.transformer_train_model(batch_size=2, src_len=8, tgt_len=8,
+                                   cfg=cfg, compute_dtype=stf.float32)
+    _analyze("transformer_tiny", [m["train_op"], m["loss"]])
+
+
+def test_word2vec_clean():
+    from simple_tensorflow_tpu.models import word2vec as w2v
+
+    m = w2v.skipgram_model(vocab_size=50, embedding_size=8, batch_size=8,
+                           num_sampled=4, learning_rate=0.5)
+    _analyze("word2vec", [m["train_op"], m["loss"]])
+
+
+def test_seq2seq_tiny_clean():
+    from simple_tensorflow_tpu.models import rnn_seq2seq as s2s
+
+    cfg = s2s.Seq2SeqConfig.tiny()
+    m = s2s.seq2seq_model(4, cfg)
+    _analyze("seq2seq_tiny", [m["train_op"], m["loss"], m["decoded"]])
+
+
+def test_ptb_lstm_tiny_clean():
+    from simple_tensorflow_tpu.models import ptb_lstm
+
+    cfg = ptb_lstm.PTBConfig.tiny()
+    m = ptb_lstm.ptb_lm_model(4, cfg, training=True)
+    fetches = [v for k, v in m.items()
+               if k in ("train_op", "loss", "cost") and v is not None]
+    assert fetches
+    _analyze("ptb_lstm_tiny", fetches)
+
+
+def test_example_mnist_end_to_end_graph_clean():
+    """The training graph examples/train_mnist_end_to_end.py builds
+    (convnet + global step + summaries), analyzed build-only."""
+    from simple_tensorflow_tpu.models import mnist
+
+    m = mnist.convnet_model(batch_size=8)
+    stf.summary.scalar("loss", m["loss"])
+    summaries = stf.summary.merge_all()
+    fetches = [m["train_op"], m["loss"]]
+    if summaries is not None:
+        fetches.append(summaries)
+    _analyze("example_mnist_end_to_end", fetches)
+
+
+def test_graph_lint_cli_clean_on_model_graphdef(tmp_path):
+    """The serialized-graph path (tools.graph_lint) agrees with the
+    in-process gate on a model graph."""
+    import json
+
+    from simple_tensorflow_tpu.framework import graph_io
+    from simple_tensorflow_tpu.tools import graph_lint
+
+    from simple_tensorflow_tpu.models import mnist
+
+    m = mnist.softmax_model(learning_rate=0.01)
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    p = tmp_path / "mnist_softmax.json"
+    p.write_text(json.dumps(gd))
+    stf.reset_default_graph()
+    diags, graph = graph_lint.run_lint(
+        json.loads(p.read_text()),
+        fetch_names=[m["train_op"].name, m["loss"].name])
+    assert graph is not None
+    assert analysis.errors(diags) == []
