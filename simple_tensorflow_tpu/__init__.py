@@ -175,7 +175,8 @@ from .ops.spectral_ops import fft, ifft, fft2d, ifft2d, fft3d, ifft3d
 
 # client
 from .client.session import (Session, InteractiveSession,
-                             get_default_session, RunOptions, RunMetadata)
+                             get_default_session, RunOptions, RunMetadata,
+                             FetchFuture)
 
 # namespaces (tf.nn, tf.train, tf.layers, tf.summary, ...)
 from . import compiler

@@ -33,12 +33,13 @@ from typing import Any, List, Optional, Sequence
 from ..framework.graph import (set_traceback_capture,
                                traceback_capture_enabled)
 from ..framework.op_registry import Effects, declare_effects
-from . import diagnostics, effects, hazards, lint, verifier
+from . import diagnostics, effects, hazards, lint, loop_safety, verifier
 from .diagnostics import (ERROR, NOTE, WARNING, Diagnostic, errors,
                           format_report, max_severity, warnings)
 from .effects import ResolvedEffects, op_effects
 from .hazards import (MODES as HAZARD_MODES, Hazard, check_plan,
                       find_hazards, get_hazard_mode, set_hazard_mode)
+from .loop_safety import certify_plan as certify_loop_safe
 from .lint import (LintContext, LintRule, lint_graph, register_lint_rule,
                    registered_rules)
 from .verifier import verify_graph, verify_graphdef, verify_ops
@@ -52,6 +53,7 @@ __all__ = [
     "LintRule", "LintContext", "lint_graph", "register_lint_rule",
     "registered_rules",
     "verify_graph", "verify_graphdef", "verify_ops",
+    "certify_loop_safe",
     "set_traceback_capture", "traceback_capture_enabled",
     "analyze",
 ]

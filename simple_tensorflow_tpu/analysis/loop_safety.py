@@ -1,0 +1,131 @@
+"""Loop-fusion safety certification for ``Session.run_steps``.
+
+Multi-step fusion compiles N training steps into ONE XLA computation
+(a ``lax.scan`` over device-staged batches, variables threaded through
+the donated carry). That is only sound when the whole per-step plan
+lives inside the device program: a host-stage op (queue dequeue,
+iterator, py_func) would need Python between iterations, a host sink
+(summaries) would need per-step device->host transfers, a
+``Print``-style io op must fire once per step on the host schedule, and
+the CheckNumerics/Assert flag channel must be inspected BEFORE each
+step's state commit — none of which exist inside a fused loop.
+
+This module classifies one compiled plan against those rules and
+returns structured :class:`Diagnostic` objects (code
+``loop_fusion/<reason>``, each naming the blocking op) so the Session
+can fall back to the unfused path with an explanation instead of
+miscompiling. The reasons double as the label on the
+``/stf/session/loop_fusion_fallbacks`` counter (docs/PERFORMANCE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
+
+from . import diagnostics as diag_mod
+from .effects import op_effects
+
+# fallback reason labels (the counter's label vocabulary)
+HOST_STAGE_OP = "host_stage_op"
+HOST_SINK_OP = "host_sink_op"
+HOST_EFFECTFUL_OP = "host_effectful_op"
+NUMERIC_CHECK_OP = "numeric_check_op"
+NO_DEVICE_STAGE = "no_device_stage"
+UNINITIALIZED_WRITE = "uninitialized_write"
+
+# flag-channel ops: their failure semantics ("downstream state commits
+# never happen") require host inspection between steps
+_CHECK_OPS = ("CheckNumerics", "Assert")
+
+
+def _written_var_names(device_ops: Sequence[Any]) -> Set[str]:
+    """Variable store keys the plan assigns (from declared effects)."""
+    names: Set[str] = set()
+    for op in device_ops:
+        for w in op_effects(op).writes:
+            if w.startswith("var_name="):
+                names.add(w.split("=", 1)[1])
+    return names
+
+
+def uninitialized_write_diag(missing: Sequence[str]) -> diag_mod.Diagnostic:
+    """The store-dependent certification failure: the plan assigns
+    variables with no initial device value to thread through the carry.
+    Factored out so the Session can cache the plan-static certification
+    and re-check only this part as the store fills."""
+    return diag_mod.Diagnostic(
+        diag_mod.ERROR, f"loop_fusion/{UNINITIALIZED_WRITE}",
+        "the plan assigns variable(s) not yet in the session's "
+        f"variable store ({', '.join(list(missing)[:5])}): the loop "
+        "carry needs an initial device value for every threaded "
+        "variable (run the initializer unfused first)")
+
+
+def certify_plan(device_ops: Sequence[Any],
+                 host_plan: Sequence[Any],
+                 post_host_plan: Sequence[Any],
+                 variable_store: Optional[Iterable[str]] = (),
+                 ) -> List[diag_mod.Diagnostic]:
+    """Certify one compiled Session plan as loop-fusable.
+
+    Returns an empty list when the plan may be compiled into a fused
+    N-step loop; otherwise one ERROR diagnostic per blocking op (code
+    ``loop_fusion/<reason>``). The caller (Session.run_steps) treats a
+    non-empty result as "fall back to N sequential runs".
+    ``variable_store=None`` skips the store-dependent uninitialized-
+    write check (callers that cache the plan-static result re-check it
+    via :func:`uninitialized_write_diag`).
+    """
+    diags: List[diag_mod.Diagnostic] = []
+
+    def block(reason: str, op: Any, why: str):
+        diags.append(diag_mod.Diagnostic(
+            diag_mod.ERROR, f"loop_fusion/{reason}",
+            f"op {op.name!r} ({op.type}) prevents multi-step fusion: "
+            f"{why}", op=op))
+
+    if not device_ops:
+        diags.append(diag_mod.Diagnostic(
+            diag_mod.ERROR, f"loop_fusion/{NO_DEVICE_STAGE}",
+            "the plan has no device stage — nothing to fuse (host-only "
+            "or constant-folded fetches)"))
+        return diags
+    for op in host_plan:
+        if op.type == "Const":
+            continue  # consts staged for host consumers are pure values
+        block(HOST_STAGE_OP, op,
+              "it runs in the host stage (Python) before the device "
+              "program, so each iteration would need a host round-trip")
+    for op in post_host_plan:
+        block(HOST_SINK_OP, op,
+              "it is a host sink consuming device results (summary/"
+              "handle-style op) and would need a per-step device->host "
+              "transfer")
+    missing: List[str] = []
+    if variable_store is not None:
+        store = set(variable_store)
+        missing = sorted(n for n in _written_var_names(device_ops)
+                         if n not in store)
+    for op in device_ops:
+        if op.type in _CHECK_OPS:
+            block(NUMERIC_CHECK_OP, op,
+                  "its failure flag must be inspected on the host before "
+                  "each step's variable updates commit")
+            continue
+        eff = op_effects(op)
+        if eff.io:
+            block(HOST_EFFECTFUL_OP, op,
+                  "it has a declared host-observable io effect that must "
+                  "fire once per step")
+    if missing:
+        diags.append(uninitialized_write_diag(missing))
+    return diags
+
+
+def fallback_reasons(diags: Sequence[diag_mod.Diagnostic]) -> List[str]:
+    """Distinct ``<reason>`` labels from certify_plan diagnostics, in
+    first-seen order (the counter labels)."""
+    seen: Dict[str, None] = {}
+    for d in diags:
+        seen.setdefault(d.code.split("/", 1)[1], None)
+    return list(seen)

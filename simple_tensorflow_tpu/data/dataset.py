@@ -302,10 +302,26 @@ class Dataset:
 
         return Dataset(gen)
 
+    def superbatch(self, n, drop_remainder=True):
+        """Stack ``n`` consecutive elements (typically batches) along a
+        new leading axis — the N-step "superbatch" that
+        ``Session.run_steps(stacked_feeds=...)`` consumes (docs/
+        PERFORMANCE.md): one host->device transfer then feeds N fused
+        training steps. Component structure (tuple/dict) is preserved;
+        with ``drop_remainder`` (default, XLA needs static shapes) a
+        trailing short window is dropped."""
+        return Dataset(_batched(self._factory, n, drop_remainder,
+                                _stack_batch))
+
     def prefetch_to_device(self, buffer_size=2, sharding=None,
-                           arena_staging=None):
+                           arena_staging=None, superbatch=None):
         """Prefetch + jax.device_put so batches are already in HBM (with the
         given NamedSharding on a mesh) when the step consumes them.
+
+        superbatch: stack every N consecutive elements into one
+        N-leading-dim superbatch BEFORE staging, so each device transfer
+        carries the feeds of one fused ``Session.run_steps(n=N)`` window
+        (the staging work lands in a ``superbatch_stage`` traceme span).
 
         arena_staging: copy each host batch into 64-byte-aligned reusable
         C++ arena buffers before the device transfer — the pinned-staging
@@ -317,11 +333,13 @@ class Dataset:
         built. Forced OFF on CPU backends regardless of the flag — CPU
         device_put zero-copy ALIASES aligned host buffers (measured), so
         recycled arena memory would corrupt live arrays."""
-        src = self.prefetch(buffer_size)._factory
+        base = self.superbatch(superbatch) if superbatch else self
+        src = base.prefetch(buffer_size)._factory
 
         def gen():
             import jax
 
+            from ..platform import monitoring
             from ..runtime import native
 
             cpu = jax.default_backend() == "cpu"
@@ -337,15 +355,23 @@ class Dataset:
                 use_arena = False
             pool = (native.ArenaPool(slots=buffer_size + 2)
                     if use_arena and native.available() else None)
+            import contextlib
+
             for x in src():
-                if pool is not None:
-                    x = pool.stage(x)
-                if isinstance(x, tuple):
-                    out = tuple(jax.device_put(a, sharding) for a in x)
-                else:
-                    out = jax.device_put(x, sharding)
-                if pool is not None:
-                    pool.mark_in_flight(out)
+                # the superbatch_stage span marks multi-step staging
+                # only — a plain prefetch stays span-free so traces
+                # don't suggest superbatching that isn't happening
+                with (monitoring.traceme("superbatch_stage",
+                                         n_steps=superbatch)
+                      if superbatch else contextlib.nullcontext()):
+                    if pool is not None:
+                        x = pool.stage(x)
+                    if isinstance(x, tuple):
+                        out = tuple(jax.device_put(a, sharding) for a in x)
+                    else:
+                        out = jax.device_put(x, sharding)
+                    if pool is not None:
+                        pool.mark_in_flight(out)
                 yield out
 
         return Dataset(gen)

@@ -58,6 +58,19 @@ class ConfigProto:
     stf.analysis.set_hazard_mode / STF_HAZARD_MODE) | "off" | "warn" |
     "raise" | "auto_deps" — unordered same-variable read/write policy
     per run plan (RAW/WAR/WAW; docs/ANALYSIS.md).
+
+    loop_fusion_steps: default multi-step window for
+    ``Session.run_steps(n=None)`` and the transparent
+    MonitoredSession/hook driving (docs/PERFORMANCE.md): N > 1 compiles
+    N training steps into one device loop, amortizing host dispatch
+    1/N. 1 (default) disables transparent fusion.
+
+    async_fetches: True makes steady-state ``Session.run`` return
+    device-produced fetches as lazy ``stf.FetchFuture`` objects that
+    ride JAX async dispatch — ``device_get`` happens only when the
+    caller materializes (np.asarray/float/.result()), so step N+1's
+    staging overlaps step N's device execution. Default False keeps
+    the eager-numpy return contract.
     """
 
     def __init__(self, device_count=None, intra_op_parallelism_threads=0,
@@ -68,7 +81,8 @@ class ConfigProto:
                  graph_options=None, operation_timeout_in_ms=0,
                  transfer_guard="allow",
                  transfer_guard_threshold_bytes=1 << 20,
-                 graph_analysis="off", variable_hazard_mode=None):
+                 graph_analysis="off", variable_hazard_mode=None,
+                 loop_fusion_steps=1, async_fetches=False):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -98,3 +112,9 @@ class ConfigProto:
                 "variable_hazard_mode must be None|off|warn|raise|"
                 f"auto_deps, got {variable_hazard_mode!r}")
         self.variable_hazard_mode = variable_hazard_mode
+        loop_fusion_steps = int(loop_fusion_steps)
+        if loop_fusion_steps < 1:
+            raise ValueError(
+                f"loop_fusion_steps must be >= 1, got {loop_fusion_steps}")
+        self.loop_fusion_steps = loop_fusion_steps
+        self.async_fetches = bool(async_fetches)

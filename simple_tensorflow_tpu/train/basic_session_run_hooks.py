@@ -45,6 +45,16 @@ class SecondOrStepTimer:
     def last_triggered_step(self):
         return self._last_step
 
+    def steps_until_trigger(self, step):
+        """Steps until this timer next fires — the hook's fusion-window
+        vote (session_run_hook.SessionRunHook.until_next_trigger). 1
+        when time-based (a wall-clock trigger cannot be predicted in
+        steps) or when the timer has never fired (it wants the next
+        boundary)."""
+        if self._every_steps is None or self._last_step is None:
+            return 1
+        return max(1, self._last_step + self._every_steps - step)
+
 
 class StopAtStepHook(SessionRunHook):
     """(ref: basic_session_run_hooks.py:331)."""
@@ -73,6 +83,12 @@ class StopAtStepHook(SessionRunHook):
         gs = int(np.asarray(run_values.results))
         if gs >= self._last_step:
             run_context.request_stop()
+
+    def until_next_trigger(self, global_step):
+        # a fused window must not overshoot the stop step
+        if self._last_step is None:
+            return 1
+        return max(1, self._last_step - global_step)
 
 
 class CheckpointSaverHook(SessionRunHook):
@@ -120,6 +136,11 @@ class CheckpointSaverHook(SessionRunHook):
         if self._timer.should_trigger_for_step(step):
             self._timer.update_last_triggered_step(step)
             self._save(run_context.session, step)
+
+    def until_next_trigger(self, global_step):
+        # checkpoints at step boundaries inside a fused window force the
+        # window to split at the save step
+        return self._timer.steps_until_trigger(global_step)
 
     def end(self, session):
         self._save(session, int(np.asarray(
@@ -176,6 +197,12 @@ class StepCounterHook(SessionRunHook):
 
     def before_run(self, run_context):
         return SessionRunArgs(self._global_step_tensor._ref)
+
+    def until_next_trigger(self, global_step):
+        # only needs global_step at its reporting boundary: a fused
+        # window up to the next report keeps steps/sec exact (steps are
+        # counted from the global_step delta, not from run calls)
+        return self._timer.steps_until_trigger(global_step)
 
     def _perf_report(self, run_context, sec_per_step):
         """Best-effort: the caller's fetches drive the cost model; a
@@ -344,6 +371,12 @@ class SummarySaverHook(SessionRunHook):
                 self._summary_writer.add_summary(
                     run_values.results["summary"], step)
 
+    def until_next_trigger(self, global_step):
+        # summaries evaluate at the window boundary; a save step inside
+        # the window splits it (also: a summary fetch makes the plan a
+        # host sink, so the boundary step itself runs unfused)
+        return self._timer.steps_until_trigger(global_step)
+
     def end(self, session):
         if self._summary_writer:
             self._summary_writer.flush()
@@ -357,6 +390,9 @@ class GlobalStepWaiterHook(SessionRunHook):
 
     def begin(self):
         self._global_step_tensor = training_util.get_global_step()
+
+    def until_next_trigger(self, global_step):
+        return 1 << 30  # waits BEFORE runs; no per-step observation
 
     def before_run(self, run_context):
         if self._wait_until_step <= 0:
@@ -376,6 +412,9 @@ class FinalOpsHook(SessionRunHook):
         self._final_ops = final_ops
         self._feed = final_ops_feed_dict
         self.final_ops_values = None
+
+    def until_next_trigger(self, global_step):
+        return 1 << 30  # only acts at end()
 
     def end(self, session):
         if self._final_ops is not None:
@@ -417,6 +456,11 @@ class ProfilerHook(SessionRunHook):
     def begin(self):
         self._global_step_tensor = training_util.get_global_step()
         self._next_step = None
+
+    def until_next_trigger(self, global_step):
+        # traces at window boundaries; a trigger step inside the window
+        # splits it so the traced run is a single (unfused) step
+        return self._timer.steps_until_trigger(global_step)
 
     def before_run(self, run_context):
         self._request_summary = (

@@ -234,6 +234,58 @@ class _MonitoredSession:
         return self._sess
 
     def run(self, fetches, feed_dict=None, options=None, run_metadata=None):
+        cfg = getattr(self._sess, "_config", None)
+        cap = getattr(cfg, "loop_fusion_steps", 1) if cfg is not None else 1
+        if cap > 1:
+            # transparent multi-step fusion (docs/PERFORMANCE.md): run a
+            # window of up to loop_fusion_steps steps as one fused
+            # device loop, capped by every hook's until_next_trigger
+            # vote so no hook misses the boundary it needs to observe
+            return self._run_once(fetches, feed_dict, options,
+                                  run_metadata,
+                                  window=self._fusion_window(cap))
+        return self._run_once(fetches, feed_dict, options, run_metadata)
+
+    def run_steps(self, fetches, n, feed_dict=None, options=None):
+        """Run ``n`` training steps through the hooked session, fusing
+        each window into one device loop (Session.run_steps) between
+        hook trigger boundaries: a hook that must observe at step K
+        splits the window at K and sees exactly the values it would have
+        seen in a per-step loop. Returns the final window's caller-fetch
+        values (or None if a hook stopped the session before any step
+        ran)."""
+        last = None
+        done = 0
+        while done < n and not self.should_stop():
+            w = min(n - done, self._fusion_window(n - done))
+            last = self._run_once(fetches, feed_dict, options, None,
+                                  window=w)
+            done += w
+        return last
+
+    def _fusion_window(self, cap):
+        gs = self._current_global_step()
+        w = max(1, int(cap))
+        for h in self._hooks:
+            w = min(w, max(1, int(h.until_next_trigger(gs))))
+        return w
+
+    def _current_global_step(self):
+        """Current global_step read straight from the device variable
+        store (no Session.run dispatch) — 0 when absent/uninitialized
+        (KeyError is the store's not-yet-initialized signal; any other
+        failure propagates rather than silently voting gs=0, which
+        would let StopAtStepHook-capped windows overshoot)."""
+        gs_t = training_util.get_global_step(self._sess.graph)
+        if gs_t is None:
+            return 0
+        try:
+            return int(np.asarray(self._sess.variable_value(gs_t)))
+        except KeyError:
+            return 0
+
+    def _run_once(self, fetches, feed_dict=None, options=None,
+                  run_metadata=None, window=1):
         feeds = dict(feed_dict or {})
         actual_fetches = {"caller": fetches}
         run_contexts = session_run_hook.SessionRunContext(
@@ -258,9 +310,18 @@ class _MonitoredSession:
             # a hook asked for tracing: give the run somewhere to put
             # the step stats so after_run can read them
             run_metadata = RunMetadata()
-        results = self._sess.run(actual_fetches, feed_dict=feeds,
-                                 options=merged_options,
-                                 run_metadata=run_metadata)
+        if window > 1:
+            # hooks voted this window safe: they observe the boundary
+            # step's values (run_steps falls back internally — same
+            # results, just unfused — when the plan is not loop-safe)
+            results = self._sess.run_steps(
+                actual_fetches, n=window, feed_dict=feeds,
+                output_mode="last", options=merged_options,
+                run_metadata=run_metadata)
+        else:
+            results = self._sess.run(actual_fetches, feed_dict=feeds,
+                                     options=merged_options,
+                                     run_metadata=run_metadata)
         for i, h in enumerate(self._hooks):
             rv = session_run_hook.SessionRunValues(
                 results=results["hooks"].get(i), options=merged_options,

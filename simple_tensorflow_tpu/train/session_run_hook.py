@@ -21,6 +21,17 @@ class SessionRunHook:
     def end(self, session):
         pass
 
+    def until_next_trigger(self, global_step):
+        """How many further training steps this hook tolerates before it
+        must observe a run boundary — the hook's vote on the multi-step
+        fusion window (docs/PERFORMANCE.md): a MonitoredSession driving
+        ``Session.run_steps`` caps every window at the minimum vote, so
+        a hook that triggers at step K still observes exactly at K.
+        Return 1 (the conservative default) to see every step; step-
+        periodic hooks return the distance to their next trigger; hooks
+        with no per-step needs return a large value."""
+        return 1
+
 
 SessionRunArgs = collections.namedtuple(
     "SessionRunArgs", ["fetches", "feed_dict", "options"])

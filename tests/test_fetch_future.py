@@ -1,0 +1,170 @@
+"""FetchFuture laziness + steady-state fast path (ISSUE 4).
+
+ConfigProto(async_fetches=True) makes device-produced fetches come back
+as lazy FetchFutures riding jax async dispatch: no device_get until the
+caller materializes, device errors surface at materialization, and
+concurrent steady-state run() calls stay correct (the device stage is
+serialized; futures resolve immutable arrays).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu.client.session import FetchFuture
+from simple_tensorflow_tpu.platform import monitoring
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+
+
+def _cells(name):
+    return monitoring.export().get(name, {}).get("cells", {})
+
+
+def _materializations():
+    return _cells("/stf/session/fetch_materializations").get("", 0)
+
+
+def _fast_path_hits():
+    return _cells("/stf/session/fast_path_hits").get("", 0)
+
+
+class TestLaziness:
+    def test_no_device_get_before_materialization(self):
+        x = stf.placeholder(stf.float32, [4], name="x")
+        y = x * 2.0 + 1.0
+        sess = stf.Session(config=stf.ConfigProto(async_fetches=True))
+        xv = np.arange(4, dtype=np.float32)
+        fut = sess.run(y, {x: xv})
+        assert isinstance(fut, FetchFuture)
+        before = _materializations()
+        assert not fut.materialized
+        assert fut.shape == (4,)  # metadata access does NOT materialize
+        assert _materializations() == before
+        # first host access materializes exactly once
+        np.testing.assert_array_equal(np.asarray(fut), xv * 2.0 + 1.0)
+        assert fut.materialized
+        assert _materializations() == before + 1
+        np.testing.assert_array_equal(fut.result(), xv * 2.0 + 1.0)
+        assert _materializations() == before + 1  # cached, no second get
+
+    def test_matches_eager_values(self):
+        x = stf.placeholder(stf.float32, [3], name="x")
+        v = stf.Variable(stf.ones([3]), name="v")
+        y = stf.reduce_sum(x * v._ref)
+        g = stf.get_default_graph()
+        eager = stf.Session(graph=g)
+        lazy = stf.Session(graph=g,
+                           config=stf.ConfigProto(async_fetches=True))
+        eager.run(stf.global_variables_initializer())
+        lazy.run(stf.global_variables_initializer())
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        ev = eager.run(y, {x: xv})
+        lv = lazy.run(y, {x: xv})
+        assert isinstance(ev, np.ndarray) and isinstance(lv, FetchFuture)
+        assert float(ev) == float(lv)
+
+    def test_scalar_dunder_conversions(self):
+        x = stf.placeholder(stf.float32, [], name="x")
+        sess = stf.Session(config=stf.ConfigProto(async_fetches=True))
+        fut = sess.run(x * 3.0, {x: np.float32(2.0)})
+        assert float(fut) == 6.0
+        fut2 = sess.run(stf.cast(x, stf.int32), {x: np.float32(5.0)})
+        assert int(fut2) == 5
+
+    def test_fed_and_host_fetches_stay_eager(self):
+        """Only device-produced fetches become futures; fed tensors and
+        host-stage values keep their eager types."""
+        x = stf.placeholder(stf.float32, [2], name="x")
+        sess = stf.Session(config=stf.ConfigProto(async_fetches=True))
+        xv = np.ones(2, np.float32)
+        got_feed, got_dev = sess.run([x, x + 1.0], {x: xv})
+        assert isinstance(got_feed, np.ndarray)
+        assert isinstance(got_dev, FetchFuture)
+
+
+class TestErrorPropagation:
+    def test_device_error_raises_at_materialization(self):
+        """An async device failure must surface when (and only when)
+        the future materializes — modeled with a deleted jax buffer,
+        the shape any runtime-poisoned value takes."""
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda a: a * 2, donate_argnums=(0,))
+        src = jnp.ones(3)
+        _ = f(src)  # donation deletes src's buffer
+        fut = FetchFuture(src)
+        before = _materializations()
+        with pytest.raises(Exception, match="deleted|donated"):
+            fut.result()
+        # a failed materialization is retryable, not silently cached
+        assert not fut.materialized
+        assert _materializations() == before
+
+    def test_error_repeats_on_retry(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda a: a + 1, donate_argnums=(0,))
+        src = jnp.zeros(2)
+        _ = f(src)
+        fut = FetchFuture(src)
+        for _ in range(2):
+            with pytest.raises(Exception):
+                np.asarray(fut)
+
+
+class TestConcurrency:
+    def test_concurrent_steady_state_runs(self):
+        """Threads hammer the same warm plan: per-thread results stay
+        correct (futures don't cross wires) and the variable update
+        stream loses nothing under the device-stage lock."""
+        x = stf.placeholder(stf.float32, [], name="x")
+        v = stf.Variable(stf.zeros([]), name="v")
+        bump = stf.assign_add(v, 1.0)
+        y = x * 2.0
+        sess = stf.Session(config=stf.ConfigProto(async_fetches=True))
+        sess.run(stf.global_variables_initializer())
+        sess.run([y, bump], {x: np.float32(0.0)})  # warm the plan
+
+        n_threads, n_iters = 4, 25
+        errs = []
+
+        def worker(tid):
+            try:
+                for i in range(n_iters):
+                    xv = np.float32(tid * 1000 + i)
+                    fut, _ = sess.run([y, bump], {x: xv})
+                    assert float(fut) == float(xv) * 2.0
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        total = float(np.asarray(sess.run(v._ref)))
+        assert total == 1.0 + n_threads * n_iters  # no lost updates
+
+
+class TestFastPath:
+    def test_fast_path_hits_count_warm_pure_device_runs(self):
+        x = stf.placeholder(stf.float32, [2], name="x")
+        y = x * 3.0
+        sess = stf.Session()
+        xv = np.ones(2, np.float32)
+        sess.run(y, {x: xv})  # plan + compile (miss)
+        before = _fast_path_hits()
+        for _ in range(3):
+            sess.run(y, {x: xv})
+        assert _fast_path_hits() == before + 3

@@ -254,22 +254,31 @@ def test_long_context_single_device_fallback():
 def test_transformer_bf16_train_step():
     """Backward-pass coverage for the mixed-precision embedding lookup and
     the bf16 tied-logits head (regression: custom_vjp residuals held
-    non-JAX types and crashed gradient tracing)."""
+    non-JAX types and crashed gradient tracing).
+
+    Deflaked (ISSUE 4 satellite): the default noam schedule
+    (warmup_steps=4000) leaves the first few steps with a learning rate
+    below bf16 update resolution, so 4 steps sometimes wobbled UP.
+    Pinning the seed and shortening warmup makes the 8-step decrease
+    large (>1.0 nats across seeds, measured) and deterministic."""
     from simple_tensorflow_tpu.models import transformer as tr
 
     stf.reset_default_graph()
+    stf.set_random_seed(0)
     cfg = tr.TransformerConfig.tiny()
     m = tr.transformer_train_model(batch_size=2, src_len=8, tgt_len=8,
-                                   cfg=cfg, compute_dtype=stf.bfloat16)
+                                   cfg=cfg, compute_dtype=stf.bfloat16,
+                                   warmup_steps=8)
     batch = tr.synthetic_wmt_batch(2, 8, 8, vocab_size=cfg.vocab_size)
     feed = {m[k]: v for k, v in batch.items()}
     with stf.Session() as sess:
         sess.run(stf.global_variables_initializer())
         l0 = sess.run(m["loss"], feed)
-        for _ in range(4):
+        for _ in range(8):
             sess.run(m["train_op"], feed)
         l1 = sess.run(m["loss"], feed)
-    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0, (l0, l1)
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    assert l1 < l0 - 0.5, (l0, l1)
 
 
 def test_bert_recompute_trains():
