@@ -2,8 +2,9 @@
 """End-to-end training journey on stf — the full reference workflow:
 
   1. write training data as TFRecords (Example protos, C++ record IO)
-  2. read them back through stf.data (TFRecordDataset -> parse -> shuffle
-     -> batch -> prefetch_to_device double-buffering)
+  2. read them back through stf.data (sharded TFRecordDataset parallel
+     reads -> shuffle -> batch -> one-call C++ parse ->
+     prefetch_to_device double-buffering)
   3. train a convnet under MonitoredTrainingSession with checkpoint,
      summary, and step-counter hooks
   4. resume from the checkpoint (global step, optimizer slots, RNG and
@@ -31,30 +32,36 @@ from simple_tensorflow_tpu.lib.example import make_example  # noqa: E402
 from simple_tensorflow_tpu.lib.io.tf_record import TFRecordWriter  # noqa: E402
 
 
-def write_dataset(path, n=512, seed=0):
-    """Synthetic 28x28 digits as TFRecord Example protos."""
+def write_dataset(path, n=512, seed=0, shards=4):
+    """Synthetic 28x28 digits as TFRecord Example protos, split across
+    file shards (the production layout parallel reads fan out over)."""
     rng = np.random.RandomState(seed)
     images = rng.rand(n, 28 * 28).astype(np.float32)
     w_true = rng.randn(28 * 28, 10).astype(np.float32)
     labels = np.argmax(images @ w_true, axis=1).astype(np.int64)
-    with TFRecordWriter(path) as w:
-        for i in range(n):
-            ex = make_example(image=images[i].tolist(),
-                              label=[int(labels[i])])
-            w.write(ex.SerializeToString())
-    return images, labels
+    files = [f"{path}-{s:05d}-of-{shards:05d}" for s in range(shards)]
+    for s, f in enumerate(files):
+        with TFRecordWriter(f) as w:
+            for i in range(s, n, shards):
+                ex = make_example(image=images[i].tolist(),
+                                  label=[int(labels[i])])
+                w.write(ex.SerializeToString())
+    return images, labels, files
 
 
-def input_pipeline(path, batch_size):
+def input_pipeline(files, batch_size):
     from simple_tensorflow_tpu import data as stf_data
     from simple_tensorflow_tpu.ops import parsing_ops as po
 
-    # shuffle/repeat raw records, batch them, then parse the WHOLE batch
-    # in one native C++ call (runtime_cc/example_parse.cc — the
-    # fast-parse idiom of the reference's input pipeline)
+    # sharded parallel reads (AUTOTUNE readers, strict shard order so
+    # the stream is reproducible — docs/DATA.md), shuffle/repeat raw
+    # records, batch them, then parse the WHOLE batch in one native C++
+    # call (runtime_cc/example_parse.cc — the fast-parse idiom of the
+    # reference's input pipeline)
     spec = {"image": po.FixedLenFeature([784], stf.float32),
             "label": po.FixedLenFeature([], stf.int64)}  # scalar -> (B,)
-    ds = stf_data.TFRecordDataset(path)
+    ds = stf_data.TFRecordDataset(files,
+                                  num_parallel_reads=stf_data.AUTOTUNE)
     ds = ds.shuffle(256, seed=7).repeat().batch(batch_size)
     ds = ds.parse_example(spec)
     ds = ds.prefetch_to_device(buffer_size=2)
@@ -93,13 +100,13 @@ def main():
     ckpt_dir = os.path.join(base, "ckpt")
     export_dir = os.path.join(base, "saved_model")
 
-    print(f"[1/5] writing TFRecords -> {records}")
-    images, labels = write_dataset(records)
+    print(f"[1/5] writing TFRecord shards -> {records}-*")
+    images, labels, shard_files = write_dataset(records)
 
     print("[2/5] building input pipeline + model")
     stf.reset_default_graph()
     stf.set_random_seed(42)
-    it = input_pipeline(records, args.batch)
+    it = input_pipeline(shard_files, args.batch)
     feats = it.get_next()
     logits, loss = model(feats["image"],
                          stf.cast(feats["label"], stf.int32))

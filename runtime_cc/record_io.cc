@@ -180,6 +180,20 @@ StfRecordReader* StfRecordReaderOpen(const char* path, StfStatus* status) {
   return r;
 }
 
+StfRecordReader* StfRecordReaderOpenBuffered(const char* path,
+                                             int64_t buffer_bytes,
+                                             StfStatus* status) {
+  StfRecordReader* r = StfRecordReaderOpen(path, status);
+  if (r && buffer_bytes > 0) {
+    // clamp to sane bounds; gzbuffer must run before the first read
+    // (it does here: Open only opened the gzFile)
+    if (buffer_bytes < (1 << 12)) buffer_bytes = 1 << 12;
+    if (buffer_bytes > (1 << 26)) buffer_bytes = 1 << 26;
+    gzbuffer(r->gz, (unsigned)buffer_bytes);
+  }
+  return r;
+}
+
 int StfRecordReaderNext(StfRecordReader* r, const uint8_t** data, size_t* n,
                         StfStatus* status) {
   uint8_t header[12];

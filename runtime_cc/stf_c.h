@@ -62,6 +62,12 @@ STF_EXPORT void StfRecordWriterClose(StfRecordWriter*);
 typedef struct StfRecordReader StfRecordReader;
 STF_EXPORT StfRecordReader* StfRecordReaderOpen(const char* path,
                                                 StfStatus* status);
+/* As Open, with an explicit read-buffer size (bytes; clamped to
+ * [4 KiB, 64 MiB]); <=0 keeps the 1 MiB default. Honors the Python
+ * TFRecordDataset(buffer_size=...) knob. */
+STF_EXPORT StfRecordReader* StfRecordReaderOpenBuffered(const char* path,
+                                                        int64_t buffer_bytes,
+                                                        StfStatus* status);
 /* Returns 1 and sets *data/*n on success (data valid until next call or
  * close), 0 on clean EOF; corruption -> 0 with status DATA_LOSS. */
 STF_EXPORT int StfRecordReaderNext(StfRecordReader*, const uint8_t** data,

@@ -472,6 +472,19 @@ class BaseSession:
             if config is not None else "off"
         if self._analysis_mode != "off":
             self._verify_graph_now(construction=True)
+        # persistent executable cache (ISSUE 5): ConfigProto(
+        # compile_cache_dir=...) or STF_COMPILE_CACHE makes process
+        # restarts disk-hit their compiles instead of re-paying the
+        # 13-24 s warmup_plus_compile_s (bench.py warm_start row).
+        # The jax cache dir is PROCESS-GLOBAL (see ConfigProto doc):
+        # once set it outlives this Session and applies to later ones.
+        cache_dir = (getattr(config, "compile_cache_dir", None)
+                     if config is not None else None) \
+            or os.environ.get("STF_COMPILE_CACHE")
+        if cache_dir:
+            from ..compiler import aot
+
+            aot.enable_persistent_cache(cache_dir)
         self._guard_warned: Set[str] = set()
         self._fusion_warned: Set[Any] = set()
         self._variable_store = VariableStore()

@@ -1,9 +1,16 @@
 """stf.data: input pipeline (replaces ref queue-based input,
 python/training/input.py; Dataset API surface like later TF).
 
-TPU-native: the pipeline runs on the host (numpy), with a background
-prefetch thread double-buffering batches onto the device so input never
-blocks the step (the role of the reference's QueueRunners + staging areas).
+TPU-native: the pipeline runs on the host (numpy), compiled into a
+parallel stage pipeline (see ``stf.data.pipeline``): sharded C++
+TFRecord reads, shared-pool parallel ``map``, ``interleave``,
+autotuned ``prefetch`` — with a background device-prefetch stage
+double-buffering batches onto the accelerator so input never blocks the
+step (the role of the reference's QueueRunners + staging areas).
+``stf.data.AUTOTUNE`` lets the per-pipeline autotuner size stage
+parallelism from stall-time gauges (docs/DATA.md).
 """
 
-from .dataset import Dataset, Iterator, TFRecordDataset, make_one_shot_iterator
+from . import pipeline
+from .dataset import (AUTOTUNE, Dataset, Iterator, TFRecordDataset,
+                      make_one_shot_iterator)

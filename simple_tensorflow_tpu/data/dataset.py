@@ -1,17 +1,22 @@
 """Host-side Dataset pipeline with device prefetch.
 
 Replaces the reference's queue-based input pipeline
-(ref: python/training/input.py, core/kernels/fifo_queue.cc) with a
-generator-composition design; ``prefetch_to_device`` double-buffers batches
-into HBM on a background thread so the TPU step never waits on input.
+(ref: python/training/input.py, core/kernels/fifo_queue.cc). Each
+transformation both (a) keeps a sequential generator composition — the
+semantic ground truth — and (b) records a stage ``Node``; iteration
+compiles the chain through ``stf.data.pipeline`` into a parallel stage
+pipeline whenever any stage asks for parallelism (``num_parallel_reads``,
+``map(num_parallel_calls=...)``, ``interleave``, ``prefetch``), else runs
+the zero-thread sequential composition. Ordered parallel stages emit the
+byte-identical element stream of the sequential chain (docs/DATA.md
+determinism contract). ``prefetch_to_device`` double-buffers batches into
+HBM on a background thread so the TPU step never waits on input.
 Graph integration: ``iterator.get_next()`` returns host-source ops feeding
 the compiled step, exactly where the reference's dequeue ops sat.
 """
 
 from __future__ import annotations
 
-import queue as py_queue
-import threading
 from typing import Callable, Iterable, List, Optional
 
 import numpy as np
@@ -21,15 +26,43 @@ from ..framework import errors
 from ..framework import graph as ops_mod
 from ..framework import op_registry
 from ..framework import tensor_shape as shape_mod
+from . import pipeline as pipeline_mod
+from .pipeline import AUTOTUNE, Node
+
+__all__ = ["Dataset", "TFRecordDataset", "Iterator", "AUTOTUNE",
+           "make_one_shot_iterator"]
+
+
+def _check_parallel_arg(n, what):
+    if n is None:
+        return None
+    n = int(n)
+    if n == AUTOTUNE:
+        return AUTOTUNE
+    if n < 1:
+        raise ValueError(
+            f"{what} must be a positive int or stf.data.AUTOTUNE, got {n}")
+    return n
 
 
 class Dataset:
-    """Composable host pipeline; each transformation wraps a generator
-    factory (re-iterable)."""
+    """Composable host pipeline. Each instance carries a re-iterable
+    sequential generator factory plus a stage-graph node; parallel
+    stages execute through the stf.data.pipeline engine."""
 
-    def __init__(self, gen_factory: Callable[[], Iterable], element_spec=None):
+    def __init__(self, gen_factory: Callable[[], Iterable],
+                 element_spec=None, node: Optional[Node] = None):
         self._factory = gen_factory
+        self._node = node if node is not None else Node(
+            "source", None, (gen_factory,))
         self.element_spec = element_spec
+
+    def _derive(self, node: Node) -> "Dataset":
+        """New Dataset one stage downstream; the sequential factory is
+        the forced-sequential compile of the same node chain."""
+        return Dataset(
+            lambda: pipeline_mod.build_iterator(node, sequential=True),
+            node=node)
 
     # -- sources -------------------------------------------------------------
     @staticmethod
@@ -87,62 +120,73 @@ class Dataset:
 
     @staticmethod
     def zip(datasets):
-        def gen():
-            its = [iter(d) for d in datasets]
-            while True:
-                try:
-                    yield tuple(next(it) for it in its)
-                except StopIteration:
-                    return
-
-        return Dataset(gen)
+        node = Node("zip", None, (tuple(datasets),))
+        return Dataset(
+            lambda: pipeline_mod.build_iterator(node, sequential=True),
+            node=node)
 
     # -- transforms ----------------------------------------------------------
-    def map(self, map_func, num_parallel_calls=None):
-        src = self._factory
+    def _seq(self, apply_fn: Callable) -> "Dataset":
+        """Chain a sequential stage: ``apply_fn(upstream_iter)`` yields
+        the transformed stream (fused inline by the pipeline engine)."""
+        return self._derive(Node("seq", self._node, (apply_fn,)))
 
-        if num_parallel_calls and num_parallel_calls > 1:
-            def gen():
-                import concurrent.futures as cf
+    def map(self, map_func, num_parallel_calls=None, deterministic=None):
+        """Element-wise transform. ``num_parallel_calls`` > 1 (or
+        AUTOTUNE) runs ``map_func`` on the shared stf.data worker pool;
+        ``deterministic`` (default True) preserves the sequential
+        element order exactly — ``deterministic=False`` emits results in
+        completion order for extra throughput when order is irrelevant."""
+        num_parallel_calls = _check_parallel_arg(
+            num_parallel_calls, "map: num_parallel_calls")
+        if deterministic is None:
+            deterministic = True
+        if num_parallel_calls is not None and num_parallel_calls != 1:
+            return self._derive(Node(
+                "pmap", self._node,
+                (map_func, num_parallel_calls, bool(deterministic))))
 
-                with cf.ThreadPoolExecutor(num_parallel_calls) as ex:
-                    it = iter(src())
-                    pending = []
-                    try:
-                        for _ in range(num_parallel_calls * 2):
-                            pending.append(ex.submit(map_func, next(it)))
-                    except StopIteration:
-                        it = None
-                    while pending:
-                        yield pending.pop(0).result()
-                        if it is not None:
-                            try:
-                                pending.append(ex.submit(map_func, next(it)))
-                            except StopIteration:
-                                it = None
-
-            return Dataset(gen)
-
-        def gen_seq():
-            for x in src():
+        def apply(it):
+            for x in it:
                 yield map_func(x)
 
-        return Dataset(gen_seq)
+        return self._seq(apply)
+
+    def interleave(self, map_func, cycle_length=2, block_length=1,
+                   num_parallel_calls=None):
+        """(ref: the reference's ParallelInterleaveDataset.) Maps each
+        input element to a dataset and interleaves their elements:
+        round-robin over ``cycle_length`` open inner datasets, taking
+        ``block_length`` elements per visit; an exhausted inner dataset
+        is removed and the next input element's dataset joins at the end
+        of the cycle. ``num_parallel_calls`` prefetches that many inner
+        datasets on worker threads WITHOUT changing the emitted order
+        (the determinism contract in docs/DATA.md)."""
+        cycle_length = int(cycle_length)
+        block_length = int(block_length)
+        if cycle_length < 1 or block_length < 1:
+            raise ValueError(
+                f"interleave: cycle_length/block_length must be >= 1, got "
+                f"{cycle_length}/{block_length}")
+        num_parallel_calls = _check_parallel_arg(
+            num_parallel_calls, "interleave: num_parallel_calls")
+        return self._derive(Node(
+            "interleave", self._node,
+            (map_func, cycle_length, block_length, num_parallel_calls)))
 
     def filter(self, predicate):
-        src = self._factory
-
-        def gen():
-            for x in src():
+        def apply(it):
+            for x in it:
                 if predicate(x):
                     yield x
 
-        return Dataset(gen)
+        return self._seq(apply)
 
     def batch(self, batch_size, drop_remainder=True):
         """drop_remainder defaults True: XLA needs static batch shapes."""
-        return Dataset(_batched(self._factory, batch_size, drop_remainder,
-                                _stack_batch))
+        return self._derive(Node(
+            "batch", self._node,
+            (int(batch_size), drop_remainder, _stack_batch)))
 
     def padded_batch(self, batch_size, padded_shapes=None,
                      padding_values=None, drop_remainder=True):
@@ -158,9 +202,11 @@ class Dataset:
         cache hits for bytes. ``padding_values`` defaults to 0 (b"" for
         string components).
         """
-        return Dataset(_batched(
-            self._factory, batch_size, drop_remainder,
-            lambda rows: _pad_batch(rows, padded_shapes, padding_values)))
+        def stack(rows, alloc):
+            return _pad_batch(rows, padded_shapes, padding_values)
+
+        return self._derive(Node(
+            "batch", self._node, (int(batch_size), drop_remainder, stack)))
 
     def parse_example(self, features):
         """Parse serialized tf.Example elements into feature dicts
@@ -175,8 +221,6 @@ class Dataset:
         """
         from ..ops import parsing_ops
 
-        src = self._factory
-
         def as_proto_bytes(s):
             # latin-1 is byte-preserving, so a str that carries proto
             # bytes round-trips; real pipelines carry bytes already
@@ -185,8 +229,8 @@ class Dataset:
         has_varlen = any(not isinstance(s, parsing_ops.FixedLenFeature)
                          for s in features.values())
 
-        def gen():
-            for x in src():
+        def apply(it):
+            for x in it:
                 if isinstance(x, (bytes, np.bytes_, str, np.str_)):
                     if has_varlen:
                         raise ValueError(
@@ -205,13 +249,11 @@ class Dataset:
                          np.ravel(np.asarray(x, dtype=object))],
                         features)
 
-        return Dataset(gen)
+        return self._seq(apply)
 
     def unbatch(self):
-        src = self._factory
-
-        def gen():
-            for x in src():
+        def apply(it):
+            for x in it:
                 if isinstance(x, dict):
                     arrays = {k: np.asarray(v) for k, v in x.items()}
                     n = next(iter(arrays.values())).shape[0]
@@ -223,17 +265,16 @@ class Dataset:
                     row = tuple(np.asarray(a)[i] for a in arrs)
                     yield row if isinstance(x, tuple) else row[0]
 
-        return Dataset(gen)
+        return self._seq(apply)
 
     def shuffle(self, buffer_size, seed=None, reshuffle_each_iteration=True):
-        src = self._factory
         rng_box = [np.random.RandomState(seed)]
 
-        def gen():
+        def apply(it):
             rng = rng_box[0] if not reshuffle_each_iteration else \
                 np.random.RandomState(rng_box[0].randint(1 << 31))
             buf = []
-            for x in src():
+            for x in it:
                 buf.append(x)
                 if len(buf) >= buffer_size:
                     i = rng.randint(len(buf))
@@ -242,65 +283,41 @@ class Dataset:
             rng.shuffle(buf)
             yield from buf
 
-        return Dataset(gen)
+        return self._seq(apply)
 
     def repeat(self, count=None):
-        src = self._factory
-
-        def gen():
-            n = 0
-            while count is None or n < count:
-                yield from src()
-                n += 1
-
-        return Dataset(gen)
+        return self._derive(Node("repeat", self._node, (count,)))
 
     def take(self, count):
-        src = self._factory
-
-        def gen():
-            for i, x in enumerate(src()):
+        def apply(it):
+            for i, x in enumerate(it):
                 if i >= count:
                     return
                 yield x
 
-        return Dataset(gen)
+        return self._seq(apply)
 
     def skip(self, count):
-        src = self._factory
-
-        def gen():
-            for i, x in enumerate(src()):
+        def apply(it):
+            for i, x in enumerate(it):
                 if i >= count:
                     yield x
 
-        return Dataset(gen)
+        return self._seq(apply)
 
     def prefetch(self, buffer_size=2):
-        """Background-thread prefetch (the C++ runtime's prefetcher is used
-        by prefetch_to_device)."""
-        src = self._factory
-
-        def gen():
-            q: py_queue.Queue = py_queue.Queue(maxsize=buffer_size)
-            DONE = object()
-
-            def worker():
-                try:
-                    for x in src():
-                        q.put(x)
-                finally:
-                    q.put(DONE)
-
-            t = threading.Thread(target=worker, daemon=True)
-            t.start()
-            while True:
-                x = q.get()
-                if x is DONE:
-                    return
-                yield x
-
-        return Dataset(gen)
+        """Decouple producer from consumer through a bounded ring buffer
+        filled by a background stage thread. ``buffer_size=AUTOTUNE``
+        lets the autotuner grow the ring (up to 16) while consumers
+        stall. Source/worker errors propagate to the consuming thread
+        at the position they occurred — never silent end-of-data."""
+        if buffer_size != AUTOTUNE:
+            buffer_size = int(buffer_size)
+            if buffer_size < 1:
+                raise ValueError(
+                    f"prefetch: buffer_size must be >= 1 or AUTOTUNE, "
+                    f"got {buffer_size}")
+        return self._derive(Node("prefetch", self._node, (buffer_size,)))
 
     def superbatch(self, n, drop_remainder=True):
         """Stack ``n`` consecutive elements (typically batches) along a
@@ -310,8 +327,8 @@ class Dataset:
         training steps. Component structure (tuple/dict) is preserved;
         with ``drop_remainder`` (default, XLA needs static shapes) a
         trailing short window is dropped."""
-        return Dataset(_batched(self._factory, n, drop_remainder,
-                                _stack_batch))
+        return self._derive(Node(
+            "batch", self._node, (int(n), drop_remainder, _stack_batch)))
 
     def prefetch_to_device(self, buffer_size=2, sharding=None,
                            arena_staging=None, superbatch=None):
@@ -323,18 +340,21 @@ class Dataset:
         carries the feeds of one fused ``Session.run_steps(n=N)`` window
         (the staging work lands in a ``superbatch_stage`` traceme span).
 
-        arena_staging: copy each host batch into 64-byte-aligned reusable
-        C++ arena buffers before the device transfer — the pinned-staging
-        pattern (ref core/common_runtime/gpu/gpu_host_allocator.h):
-        aligned source buffers let the transfer engine DMA directly and
-        the pool removes per-batch malloc churn. A slot recycles only
-        after its device transfer completes (block_until_ready barrier).
-        Default (None): on for TPU backends when the native runtime is
-        built. Forced OFF on CPU backends regardless of the flag — CPU
-        device_put zero-copy ALIASES aligned host buffers (measured), so
-        recycled arena memory would corrupt live arrays."""
+        arena_staging: assemble each host batch in 64-byte-aligned
+        reusable C++ arena buffers — the pinned-staging pattern (ref
+        core/common_runtime/gpu/gpu_host_allocator.h): aligned source
+        buffers let the transfer engine DMA directly and the pool
+        removes per-batch malloc churn. When the chain ends in a
+        batch/superbatch stage, that stage STACKS DIRECTLY INTO the
+        arena slot (no intermediate host copy between batch assembly
+        and the device transfer); otherwise each element is staged with
+        one copy. A slot recycles only after its device transfer
+        completes (block_until_ready barrier). Default (None): on for
+        TPU backends when the native runtime is built. Forced OFF on
+        CPU backends regardless of the flag — CPU device_put zero-copy
+        ALIASES aligned host buffers (measured), so recycled arena
+        memory would corrupt live arrays."""
         base = self.superbatch(superbatch) if superbatch else self
-        src = base.prefetch(buffer_size)._factory
 
         def gen():
             import jax
@@ -353,47 +373,78 @@ class Dataset:
                     "prefetch_to_device: arena_staging disabled on the CPU "
                     "backend (device_put aliases host buffers there)")
                 use_arena = False
-            pool = (native.ArenaPool(slots=buffer_size + 2)
+            # slots must exceed the max batches in flight between the
+            # batch stage and the device transfer: assembly(1) + the
+            # prefetch ring (AUTOTUNE grows it to the shared cap) +
+            # consumer(1)
+            ring_cap = (pipeline_mod.PREFETCH_AUTOTUNE_MAX
+                        if buffer_size == AUTOTUNE else int(buffer_size))
+            pool = (native.ArenaPool(slots=ring_cap + 3)
                     if use_arena and native.available() else None)
+            # zero-copy handoff: the terminal batch/superbatch stage
+            # assembles straight into an arena slot; elements arrive as
+            # pipeline.ArenaBatch carrying the slot to recycle. The
+            # node is CLONED so the user's dataset (possibly iterated
+            # elsewhere without a device transfer) is never flagged.
+            # Only stack fns that accept the allocator qualify —
+            # padded_batch shares the "batch" node kind but pads into
+            # its own buffers, so it takes the pool.stage() copy path.
+            staged = base
+            if (pool is not None and base._node.kind == "batch"
+                    and getattr(base._node.args[2], "supports_alloc",
+                                False)):
+                clone = Node("batch", base._node.parent, base._node.args)
+                clone.alloc_pool = pool
+                staged = base._derive(clone)
+            src = iter(staged.prefetch(buffer_size))
             import contextlib
 
-            for x in src():
-                # the superbatch_stage span marks multi-step staging
-                # only — a plain prefetch stays span-free so traces
-                # don't suggest superbatching that isn't happening
-                with (monitoring.traceme("superbatch_stage",
-                                         n_steps=superbatch)
-                      if superbatch else contextlib.nullcontext()):
-                    if pool is not None:
-                        x = pool.stage(x)
-                    if isinstance(x, tuple):
-                        out = tuple(jax.device_put(a, sharding) for a in x)
-                    else:
-                        out = jax.device_put(x, sharding)
-                    if pool is not None:
-                        pool.mark_in_flight(out)
-                yield out
+            try:
+                for x in src:
+                    slot = None
+                    if isinstance(x, pipeline_mod.ArenaBatch):
+                        x, slot = x.value, x.slot
+                    # the superbatch_stage span marks multi-step staging
+                    # only — a plain prefetch stays span-free so traces
+                    # don't suggest superbatching that isn't happening
+                    with (monitoring.traceme("superbatch_stage",
+                                             n_steps=superbatch)
+                          if superbatch else contextlib.nullcontext()):
+                        if pool is not None and slot is None:
+                            x = pool.stage(x)
+                        if isinstance(x, tuple):
+                            out = tuple(jax.device_put(a, sharding)
+                                        for a in x)
+                        else:
+                            out = jax.device_put(x, sharding)
+                        if pool is not None:
+                            pool.mark_in_flight(out, slot=slot)
+                    yield out
+            finally:
+                if hasattr(src, "close"):
+                    src.close()
 
         return Dataset(gen)
 
     def cache(self):
-        src = self._factory
         box: List = []
 
-        def gen():
+        def apply(it):
             if box:
                 yield from box[0]
                 return
             items = []
-            for x in src():
+            for x in it:
                 items.append(x)
                 yield x
             box.append(items)
 
-        return Dataset(gen)
+        return self._seq(apply)
 
     # -- consumption ---------------------------------------------------------
     def __iter__(self):
+        if pipeline_mod.chain_is_parallel(self._node):
+            return pipeline_mod.build_iterator(self._node)
         return iter(self._factory())
 
     def as_numpy_iterator(self):
@@ -406,7 +457,7 @@ class Dataset:
         return Iterator(self, initializable=True)
 
 
-def _stack_one(vals):
+def _stack_one(vals, alloc=None):
     # bytes/str rows must stack as OBJECT arrays: numpy's fixed-width
     # 'S' dtype zero-pads and strips trailing NULs, which corrupts
     # serialized protos (a TFRecord batch is the common case here)
@@ -414,22 +465,11 @@ def _stack_one(vals):
         out = np.empty(len(vals), dtype=object)
         out[:] = vals
         return out
-    return np.stack([np.asarray(v) for v in vals])
-
-
-def _batched(src, batch_size, drop_remainder, stack_fn):
-    """Shared buffering loop behind batch()/padded_batch()."""
-    def gen():
-        buf = []
-        for x in src():
-            buf.append(x)
-            if len(buf) == batch_size:
-                yield stack_fn(buf)
-                buf = []
-        if buf and not drop_remainder:
-            yield stack_fn(buf)
-
-    return gen
+    arrs = [np.asarray(v) for v in vals]
+    if alloc is not None and arrs[0].dtype.kind not in "OSUV":
+        out = alloc((len(arrs),) + arrs[0].shape, arrs[0].dtype)
+        return np.stack(arrs, out=out)
+    return np.stack(arrs)
 
 
 def _pad_one(vals, padded_shape, padding_value):
@@ -500,32 +540,68 @@ def _pad_batch(rows, padded_shapes, padding_values):
     return _pad_one(rows, padded_shapes, padding_values)
 
 
-def _stack_batch(rows):
+def _stack_batch(rows, alloc=None):
     if isinstance(rows[0], tuple):
-        return tuple(_stack_one([r[i] for r in rows])
+        return tuple(_stack_one([r[i] for r in rows], alloc)
                      for i in range(len(rows[0])))
     if isinstance(rows[0], dict):
-        return {k: _stack_one([r[k] for r in rows]) for k in rows[0]}
-    return _stack_one(rows)
+        return {k: _stack_one([r[k] for r in rows], alloc) for k in rows[0]}
+    return _stack_one(rows, alloc)
+
+
+# prefetch_to_device may hand this stack fn an arena allocator; stack fns
+# without the flag (padded_batch's padding stack) get the element-wise
+# pool.stage() copy instead of a wasted arena slot
+_stack_batch.supports_alloc = True
 
 
 class TFRecordDataset(Dataset):
     """(ref: reader ops core/kernels/record_yielder +
-    python TFRecordDataset). Uses the native C++ record reader when built."""
+    python TFRecordDataset). Uses the native C++ record reader when
+    built; ``num_parallel_reads`` (int or AUTOTUNE) fans the read out
+    over file shards on reader threads that deliver record CHUNKS from
+    the batched C++ call, emitted in strict shard order — the parallel
+    stream is byte-identical to the sequential one."""
 
     def __init__(self, filenames, compression_type=None, buffer_size=None,
                  num_parallel_reads=None):
-        if isinstance(filenames, str):
+        if isinstance(filenames, (str, bytes)):
             filenames = [filenames]
-        files = list(filenames)
+        files = [f.decode() if isinstance(f, bytes) else str(f)
+                 for f in filenames]
+        comp = compression_type
+        if isinstance(comp, bytes):
+            comp = comp.decode()
+        comp = (comp or "").upper()
+        if comp not in ("", "GZIP"):
+            # the seed silently ignored this arg and read garbage-
+            # adjacent framing for compressed containers it can't parse
+            raise errors.UnimplementedError(
+                None, None,
+                f"TFRecordDataset: compression_type={comp!r} is not "
+                "supported (supported: None/'' and 'GZIP')")
+        if buffer_size is not None:
+            buffer_size = int(buffer_size)
+            if buffer_size <= 0:
+                raise ValueError(
+                    f"TFRecordDataset: buffer_size must be > 0, got "
+                    f"{buffer_size}")
+        num_parallel_reads = _check_parallel_arg(
+            num_parallel_reads, "TFRecordDataset: num_parallel_reads")
+        if num_parallel_reads == 1:
+            num_parallel_reads = None
 
-        def gen():
-            from ..lib.io.tf_record import tf_record_iterator
+        def open_chunks(path):
+            from ..lib.io.tf_record import tf_record_chunks
 
-            for f in files:
-                yield from tf_record_iterator(f)
+            return tf_record_chunks(path, compression=comp,
+                                    buffer_size=buffer_size)
 
-        super().__init__(gen)
+        node = Node("tfrecord", None,
+                    (files, open_chunks, num_parallel_reads))
+        super().__init__(
+            lambda: pipeline_mod.build_iterator(node, sequential=True),
+            node=node)
 
 
 _ITER_COUNT = [0]
@@ -533,7 +609,9 @@ _ITER_COUNT = [0]
 
 class Iterator:
     """Graph-facing iterator: get_next() returns host-source tensors that
-    pull the next element during each Session.run (the reference's dequeue)."""
+    pull the next element during each Session.run (the reference's
+    dequeue). Replacing the underlying stream (initializer / checkpoint
+    restore) closes any parallel pipeline backing the old one."""
 
     def __init__(self, dataset: Dataset, initializable=False):
         self._dataset = dataset
@@ -546,6 +624,11 @@ class Iterator:
         self._keys = None
         self._structure = "single"
         self._position = 0  # elements yielded; checkpointed by Saver
+
+    def _replace_stream(self, new_it):
+        old, self._it = self._it, new_it
+        if old is not None and hasattr(old, "close"):
+            old.close()
 
     def _next_value(self):
         if self._it is None:
@@ -567,12 +650,13 @@ class Iterator:
         return {"position": self._position}
 
     def restore_state(self, state):
-        """Re-create the underlying generator and skip forward to the saved
+        """Re-create the underlying stream and skip forward to the saved
         position. Deterministic pipelines (the stf.data design: pure
-        generator composition, seeded shuffles) reproduce the exact element
-        stream, so skip-forward == resume."""
+        generator composition, seeded shuffles, ORDERED parallel stages)
+        reproduce the exact element stream, so skip-forward == resume —
+        including with parallel stages active (docs/DATA.md)."""
         pos = int(state.get("position", 0))
-        self._it = iter(self._dataset)
+        self._replace_stream(iter(self._dataset))
         for _ in range(pos):
             try:
                 next(self._it)
@@ -588,10 +672,18 @@ class Iterator:
                            output_specs=[])
 
     def get_next(self, name=None):
-        # Peek one element to type the outputs (shape/dtype spec).
+        # Peek one element to type the outputs (shape/dtype spec). The
+        # sequential compile is enough for a spec probe (same element
+        # types either way) and spins up no stage threads; _count=False
+        # keeps the probe out of /stf/data/pipelines_started.
         if self._spec is None:
-            probe_it = iter(self._dataset)
-            first = next(probe_it)
+            probe_it = pipeline_mod.build_iterator(
+                self._dataset._node, sequential=True, _count=False)
+            try:
+                first = next(probe_it)
+            finally:
+                if hasattr(probe_it, "close"):
+                    probe_it.close()
             if isinstance(first, dict):
                 self._keys = sorted(first)
                 items = [first[k] for k in self._keys]
@@ -637,7 +729,7 @@ def _lower_get_next(ctx, op, inputs):
 
 def _lower_iter_init(ctx, op, inputs):
     it = _ITERATORS[op.attrs["iterator"]]
-    it._it = iter(it._dataset)
+    it._replace_stream(iter(it._dataset))
     return []
 
 

@@ -65,6 +65,18 @@ class ConfigProto:
     N training steps into one device loop, amortizing host dispatch
     1/N. 1 (default) disables transparent fusion.
 
+    compile_cache_dir: directory for the persistent XLA executable
+    cache (``compiler.aot.enable_persistent_cache``); a second process
+    compiling the same HLO hits the disk cache instead of paying the
+    full compile again (the 13-24 s/process ``warmup_plus_compile_s``
+    in bench.py). None (default) falls back to the ``STF_COMPILE_CACHE``
+    environment variable; empty/unset leaves persistent caching off.
+    PROCESS-GLOBAL: the underlying jax compilation-cache directory is
+    process-wide state — the first Session that sets it points every
+    later compile in the process (including Sessions constructed with
+    compile_cache_dir=None) at that directory until it is explicitly
+    changed; it is not reverted on Session.close().
+
     async_fetches: True makes steady-state ``Session.run`` return
     device-produced fetches as lazy ``stf.FetchFuture`` objects that
     ride JAX async dispatch — ``device_get`` happens only when the
@@ -82,7 +94,8 @@ class ConfigProto:
                  transfer_guard="allow",
                  transfer_guard_threshold_bytes=1 << 20,
                  graph_analysis="off", variable_hazard_mode=None,
-                 loop_fusion_steps=1, async_fetches=False):
+                 loop_fusion_steps=1, async_fetches=False,
+                 compile_cache_dir=None):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -118,3 +131,4 @@ class ConfigProto:
                 f"loop_fusion_steps must be >= 1, got {loop_fusion_steps}")
         self.loop_fusion_steps = loop_fusion_steps
         self.async_fetches = bool(async_fetches)
+        self.compile_cache_dir = compile_cache_dir

@@ -75,23 +75,27 @@ class TFRecordWriter:
         return False
 
 
-def _read_records_py(path, compression="") -> Iterator[bytes]:
-    if compression == "GZIP":
-        import gzip
+def _read_records_py(path, compression="",
+                     buffer_size: Optional[int] = None) -> Iterator[bytes]:
+    import contextlib
 
-        f = gzip.open(path, "rb")
-    else:
+    raw_buffering = int(buffer_size) if buffer_size else -1
+    if compression != "GZIP":
         # sniff gzip magic so the fallback matches the native reader, whose
         # gzFile transparently decompresses regardless of options
         with open(path, "rb") as probe:
             magic = probe.read(2)
-        if magic == b"\x1f\x8b":
+    with contextlib.ExitStack() as stack:
+        # GzipFile.close() leaves a caller-supplied fileobj open — the
+        # stack closes the raw fd deterministically either way
+        raw = stack.enter_context(
+            open(path, "rb", buffering=raw_buffering))
+        if compression == "GZIP" or magic == b"\x1f\x8b":
             import gzip
 
-            f = gzip.open(path, "rb")
+            f = stack.enter_context(gzip.GzipFile(fileobj=raw))
         else:
-            f = open(path, "rb")
-    with f:
+            f = raw
         while True:
             header = f.read(12)
             if len(header) == 0:
@@ -115,23 +119,56 @@ def _read_records_py(path, compression="") -> Iterator[bytes]:
             yield data
 
 
-def tf_record_iterator(path, options: Optional[TFRecordOptions] = None
+def tf_record_chunks(path, compression: str = "",
+                     buffer_size: Optional[int] = None,
+                     chunk_records: int = 256) -> Iterator[list]:
+    """Yield LISTS of records — one list per batched C++ reader call
+    (the pipeline engine's sharded readers move whole chunks through
+    their ring buffers, one lock crossing per ~chunk_records records
+    instead of one per record). ``buffer_size`` sizes the underlying
+    read buffer (native: zlib gzbuffer; Python: io buffering). The
+    native gzFile reads GZIP containers transparently, so it serves
+    both compression modes. On mid-chunk corruption the good prefix is
+    yielded first, then the DataLossError raises — matching the
+    per-record readers."""
+    use_native = False
+    # only the probe is guarded: once the native reader is chosen, its
+    # errors (DataLossError etc.) propagate — falling back mid-stream
+    # would re-deliver records from the start of the file
+    try:
+        from ...runtime import native
+
+        use_native = native.available()
+    except Exception:
+        use_native = False
+    if use_native:
+        yield from native.read_tfrecord_chunks(
+            path, batch=chunk_records, buffer_size=buffer_size)
+        return
+    gen = _read_records_py(path, compression, buffer_size)
+    while True:
+        chunk: list = []
+        err = None
+        try:
+            for rec in gen:
+                chunk.append(rec)
+                if len(chunk) >= chunk_records:
+                    break
+        except Exception as e:  # yield the good prefix, then raise
+            err = e
+        if chunk:
+            yield chunk
+        if err is not None:
+            raise err
+        if len(chunk) < chunk_records:
+            return
+
+
+def tf_record_iterator(path, options: Optional[TFRecordOptions] = None,
+                       buffer_size: Optional[int] = None
                        ) -> Iterator[bytes]:
     """(ref: python/lib/io/tf_record.py:43 ``tf_record_iterator``).
     Prefers the native C++ reader when available."""
     comp = TFRecordOptions.get_compression_type_string(options)
-    use_native = False
-    if not comp:
-        # only the probe is guarded: once the native reader is chosen, its
-        # errors (DataLossError etc.) propagate — falling back mid-stream
-        # would re-deliver records from the start of the file
-        try:
-            from ...runtime import native
-
-            use_native = native.available()
-        except Exception:
-            use_native = False
-    if use_native:
-        yield from native.read_tfrecords(path)
-    else:
-        yield from _read_records_py(path, comp)
+    for chunk in tf_record_chunks(path, comp, buffer_size):
+        yield from chunk

@@ -16,7 +16,18 @@ from ..framework import op_registry
 from ..framework import sparse_tensor as sparse_mod
 from ..framework import tensor_shape as shape_mod
 from ..lib import example as example_mod
+from ..platform import monitoring
 from .op_util import make_op
+
+# native = one C call per batch (example_parse.cc); python = per-record
+# wire parsing — the classic input-pipeline bottleneck this counter
+# makes visible (docs/DATA.md)
+_parse_batches = monitoring.Counter(
+    "/stf/data/parse_example_batches",
+    "parse_example batch calls by parser path", "path")
+_parse_records = monitoring.Counter(
+    "/stf/data/parse_example_records",
+    "Example protos parsed by parser path", "path")
 
 
 class FixedLenFeature:
@@ -106,11 +117,21 @@ def parse_example_py(serialized, features):
     """Host parser: list[bytes] -> {name: ndarray or (indices,values,shape)}.
 
     FixedLenFeature -> dense [batch] + shape; VarLenFeature -> COO triple.
-    All-dense float32/int64 specs take the native C++ batch fast path.
+    All-dense float32/int64 specs take the native C++ batch fast path
+    (one C call per batch); /stf/data/parse_example_* counters record
+    which path served each batch.
     """
-    fast = _parse_examples_fast(serialized, features)
-    if fast is not None:
-        return fast
+    with monitoring.traceme("parse_example_batch", n=len(serialized)):
+        fast = _parse_examples_fast(serialized, features)
+        path = "python" if fast is None else "native"
+        _parse_batches.get_cell(path).increase_by(1)
+        _parse_records.get_cell(path).increase_by(len(serialized))
+        if fast is not None:
+            return fast
+        return _parse_example_slow(serialized, features)
+
+
+def _parse_example_slow(serialized, features):
     batch = [example_mod.Example.FromString(bytes(s)) for s in serialized]
     out = {}
     for name, spec in features.items():

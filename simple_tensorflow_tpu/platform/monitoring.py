@@ -539,6 +539,14 @@ def tracing_active() -> bool:
     return bool(getattr(_trace_local, "sinks", None))
 
 
+def active_trace_buffers() -> List[TraceBuffer]:
+    """The collections installed on the CURRENT thread. Worker threads
+    (e.g. stf.data pipeline stages) enter ``trace_collection(buf)`` for
+    each of these so their spans land in the parent's trace — sinks are
+    per-thread, a spawned thread starts with none."""
+    return list(getattr(_trace_local, "sinks", None) or [])
+
+
 class trace_collection:
     """Install ``buffer`` as an active per-thread span sink for the
     duration of the ``with`` block; nested collections stack (each span

@@ -73,6 +73,10 @@ def _bind(lib):
 
     lib.StfRecordReaderOpen.argtypes = [c.c_char_p, c.c_void_p]
     lib.StfRecordReaderOpen.restype = c.c_void_p
+    if hasattr(lib, "StfRecordReaderOpenBuffered"):  # newer .so only
+        lib.StfRecordReaderOpenBuffered.argtypes = [c.c_char_p, c.c_int64,
+                                                    c.c_void_p]
+        lib.StfRecordReaderOpenBuffered.restype = c.c_void_p
     lib.StfRecordReaderNext.argtypes = [c.c_void_p, c.POINTER(u8p),
                                         c.POINTER(c.c_size_t), c.c_void_p]
     lib.StfRecordReaderNext.restype = c.c_int
@@ -197,15 +201,25 @@ def masked_crc32c(data: bytes) -> int:
     return lib.StfMaskedCrc32c(buf, len(data))
 
 
-def read_tfrecords(path: str, batch: int = 256) -> Iterator[bytes]:
-    """Iterate records via the native reader (batched crossings).
+def read_tfrecord_chunks(path: str, batch: int = 256,
+                         buffer_size: Optional[int] = None
+                         ) -> Iterator[List[bytes]]:
+    """Iterate LISTS of records via the native reader — one yielded list
+    per batched C call (the stf.data sharded-read stage moves these
+    chunks through its ring buffers whole: one lock crossing per chunk).
 
-    Records read before a mid-batch corruption are yielded first, then the
+    ``buffer_size`` sets the reader's zlib buffer via
+    StfRecordReaderOpenBuffered when the built .so exports it. Records
+    read before a mid-batch corruption are yielded first, then the
     error raises — matching the pure-Python reader's behavior.
     """
     lib = _load()
     with _Status(lib) as st:
-        h = lib.StfRecordReaderOpen(path.encode(), st.handle)
+        if buffer_size and hasattr(lib, "StfRecordReaderOpenBuffered"):
+            h = lib.StfRecordReaderOpenBuffered(
+                path.encode(), int(buffer_size), st.handle)
+        else:
+            h = lib.StfRecordReaderOpen(path.encode(), st.handle)
         st.check()
     try:
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -228,13 +242,20 @@ def read_tfrecords(path: str, batch: int = 256) -> Iterator[bytes]:
                 if n > 0:
                     raw = ctypes.string_at(buf, offs[n])
                     records = [raw[offs[i]:offs[i + 1]] for i in range(n)]
-            yield from records
+            if records:
+                yield records
             if err is not None:
                 raise err
             if n == 0:
                 return
     finally:
         lib.StfRecordReaderClose(h)
+
+
+def read_tfrecords(path: str, batch: int = 256) -> Iterator[bytes]:
+    """Per-record view over ``read_tfrecord_chunks``."""
+    for chunk in read_tfrecord_chunks(path, batch):
+        yield from chunk
 
 
 def parse_examples_dense(serialized, names, kinds, sizes):
@@ -361,12 +382,23 @@ class ArenaPool:
         self._inflight: List = [None] * slots
         self._i = 0
         self._last_slot = 0
+        # acquire() runs in pipeline stage threads while mark_in_flight
+        # runs in the transfer thread; rotation must be atomic
+        self._rotate_lock = threading.Lock()
 
-    def _next(self) -> Arena:
+    def acquire(self):
+        """Claim the next slot for direct batch assembly (the stf.data
+        batch stage stacks straight into it — no later staging copy).
+        Blocks until the slot's previously recorded device transfer
+        completes, then resets the arena. Returns ``(slot_id, arena)``;
+        pass slot_id back to ``mark_in_flight``. The CALLER must bound
+        batches-in-flight below the slot count (prefetch ring capacity
+        + 2 < slots) or a queued batch's memory would be recycled."""
         import jax
 
-        slot = self._i
-        self._i = (self._i + 1) % len(self._arenas)
+        with self._rotate_lock:
+            slot = self._i
+            self._i = (self._i + 1) % len(self._arenas)
         pending = self._inflight[slot]
         if pending is not None:
             # the DMA out of this slot's memory must finish before reuse
@@ -374,6 +406,10 @@ class ArenaPool:
             self._inflight[slot] = None
         a = self._arenas[slot]
         a.reset()
+        return slot, a
+
+    def _next(self) -> Arena:
+        slot, a = self.acquire()
         self._last_slot = slot
         return a
 
@@ -394,10 +430,12 @@ class ArenaPool:
 
         return copy(x)
 
-    def mark_in_flight(self, device_arrays) -> None:
-        """Record the device arrays produced from the last staged slot;
-        their readiness gates that slot's recycling."""
-        self._inflight[self._last_slot] = device_arrays
+    def mark_in_flight(self, device_arrays, slot=None) -> None:
+        """Record the device arrays produced from a staged slot (the
+        last ``stage()`` slot when ``slot`` is None, else an explicit
+        ``acquire()`` slot id); their readiness gates recycling."""
+        self._inflight[self._last_slot if slot is None else slot] = \
+            device_arrays
 
     def close(self):
         for a in self._arenas:
