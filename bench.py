@@ -667,6 +667,109 @@ def _measure_analysis(platform, device_kind):
     }
 
 
+def _measure_sharding_analysis(platform, device_kind):
+    """stf.analysis.sharding row (ISSUE 6): on the SAME model/mesh
+    config as the resnet50_dp8_sharding_efficiency row (resnet50,
+    bf16, batch 32, image 32, dp=8 virtual mesh), (1) the analyzer's
+    predicted total collective bytes must land within 25% of the bytes
+    harvested from the compiled executable's HLO collective
+    instructions (utils/perf.collective_bytes_of), and (2) the
+    analyzer's cost ON THE PLAN CRITICAL PATH must stay under 5% of
+    Session plan time (prune + optimize + lower + analysis — the same
+    budget discipline as the ISSUE 3 verifier+hazards row; jit compile
+    excluded). The analysis itself runs on a worker thread overlapping
+    the multi-second XLA compile (it is advisory — warnings, never an
+    execution gate), so the blocking cost is the thread spawn; the full
+    analyzer wall time is reported alongside (analyzer_wall_ms) and
+    sampled on /stf/analysis/sharding_seconds."""
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import parallel
+    from simple_tensorflow_tpu.models import resnet
+    from simple_tensorflow_tpu.platform import monitoring
+
+    devices = jax.devices()
+    n_devices = 8
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} virtual devices, have {len(devices)}")
+    stf.reset_default_graph()
+    mesh = parallel.Mesh({"dp": n_devices},
+                         devices=devices[:n_devices])
+    with mesh:
+        m = resnet.resnet50_train_model(
+            batch_size=32, image_size=32, dtype=stf.bfloat16,
+            learning_rate=0.1)
+        parallel.shard_feed(m["images"], "dp")
+        parallel.shard_feed(m["labels"], "dp")
+        xv, yv = resnet.synthetic_imagenet(32, 32, dtype=np.float32)
+        feed = {m["images"]: xv.astype(stf.bfloat16.np_dtype),
+                m["labels"]: yv}
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        opts = stf.RunOptions(trace_level=stf.RunOptions.SOFTWARE_TRACE)
+        md = stf.RunMetadata()
+        sess.run([m["train_op"], m["loss"]], feed, options=opts,
+                 run_metadata=md)
+    steps = [s for s in sess._cache.values()
+             if s.join_sharding() is not None]
+    assert steps, ("no plan produced a sharding report — check the "
+                   "stf log for sharding/analysis-failed notes")
+    step = steps[-1]
+    rep = step.sharding_report
+    predicted = rep.total_collective_bytes()
+    harvested = md.cost_graph.get("collective_bytes", {})
+    harvested_total = float(harvested.get("total", 0.0))
+    ratio = predicted / harvested_total if harvested_total else None
+    spans = {}
+    for node in md.step_stats.get("nodes", []):
+        phase = node["name"].split(":")[0]
+        spans[phase] = spans.get(phase, 0.0) + node["dur_us"] / 1e6
+    plan_s = sum(spans.get(k, 0.0)
+                 for k in ("prune", "optimize", "lower", "analysis"))
+    blocking_s = step.sharding_sync_seconds
+    frac = blocking_s / plan_s if plan_s else 0.0
+    exported = monitoring.export()
+
+    def _cells(name):
+        return exported.get(name, {}).get("cells", {})
+
+    return {
+        "metric": "sharding_analysis_overhead_frac",
+        "value": round(frac, 4),
+        "unit": ("fraction of plan time (prune+optimize+lower+"
+                 "analysis) spent blocking on sharding analysis"),
+        "vs_baseline": None,
+        "within_budget": bool(frac < 0.05),
+        "blocking_ms": round(blocking_s * 1e3, 3),
+        "analyzer_wall_ms": round(rep.analysis_seconds * 1e3, 3),
+        "overlapped_with": "lowering + jit compile (worker thread)",
+        "plan_ms": round(plan_s * 1e3, 3),
+        "predicted_collective_bytes": round(predicted),
+        "harvested_collective_bytes": round(harvested_total),
+        "predicted_over_harvested": (round(ratio, 4)
+                                     if ratio is not None else None),
+        "within_25pct": (bool(abs(ratio - 1.0) <= 0.25)
+                         if ratio is not None else None),
+        "predicted_by_kind": {k: round(v) for k, v in
+                              rep.bytes_by_kind().items()},
+        "harvested_by_kind": {k: round(v) for k, v in
+                              harvested.items() if k != "total"},
+        "n_collective_edges": len(rep.collective_edges()),
+        "monitoring": {
+            "sharding_collectives": _cells(
+                "/stf/analysis/sharding_collectives"),
+            "sharding_collective_bytes": _cells(
+                "/stf/analysis/sharding_collective_bytes"),
+            "sharding_seconds": {
+                k: {"count": v["count"], "sum_s": round(v["sum"], 6)}
+                for k, v in _cells(
+                    "/stf/analysis/sharding_seconds").items()},
+        },
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _measure_loop_fusion(platform, device_kind):
     """Loop-fusion amortization row (ISSUE 4 tentpole): the BERT-base
     small-step training loop — the BENCH_r05 regime whose
@@ -1226,6 +1329,8 @@ def child_main():
         result = _measure_graph_opt(platform, kind)
     elif model == "analysis":
         result = _measure_analysis(platform, kind)
+    elif model == "sharding_analysis":
+        result = _measure_sharding_analysis(platform, kind)
     elif model == "loop_fusion":
         result = _measure_loop_fusion(platform, kind)
     elif model == "input_pipeline":
@@ -1308,8 +1413,8 @@ def _run_model(model, platform, kind, errors):
                      "shared; the second process disk-hits its XLA "
                      "compiles (compiler.aot.enable_persistent_cache)"),
         }
-    if model == "resnet_dp":
-        # virtual-mesh overhead check: always a CPU-mesh child by design
+    if model in ("resnet_dp", "sharding_analysis"):
+        # virtual-mesh rows: always a CPU-mesh child by design
         env = {k: v for k, v in os.environ.items()
                if k != "PALLAS_AXON_POOL_IPS"}
         env["JAX_PLATFORMS"] = "cpu"
@@ -1323,7 +1428,7 @@ def _run_model(model, platform, kind, errors):
             env, int(os.environ.get("BENCH_DP_TIMEOUT", "1800")))
         if result is not None:
             return result
-        fallback["error"] = f"resnet_dp_run_failed: {err}"
+        fallback["error"] = f"{model}_run_failed: {err}"
         return fallback
     # per-model TPU time budgets: the headline metrics (resnet, bert) get
     # the full window; secondary configs are bounded so one slow compile
@@ -1331,7 +1436,8 @@ def _run_model(model, platform, kind, errors):
     # resnet runs up to 5 compile+measure cycles (2 batch + 3 variants)
     default_timeout = {"resnet": "2400", "bert": "1500",
                        "transformer": "1200", "mnist": "300",
-                       "analysis": "600", "loop_fusion": "900",
+                       "analysis": "600", "sharding_analysis": "900",
+                       "loop_fusion": "900",
                        "input_pipeline": "600"}.get(
         model, "900")
     extra_xla_flags = ""
@@ -1394,6 +1500,9 @@ _METRIC_NAMES = {
     "graph_opt": ("graph_opt_cond_scan_step_ms", "ms/step (optimized)"),
     "analysis": ("analysis_overhead_frac",
                  "fraction of plan time (prune+optimize+lower+analysis)"),
+    "sharding_analysis": (
+        "sharding_analysis_overhead_frac",
+        "fraction of plan time (prune+optimize+lower+analysis)"),
     "loop_fusion": ("loop_fusion_bert_amortization_n64_vs_n1",
                     "x (measured_over_predicted improvement)"),
     "input_pipeline": ("input_pipeline_records_per_sec", "records/sec"),
@@ -1418,7 +1527,8 @@ def main():
     for tok in os.environ.get(
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
-            "loop_fusion,input_pipeline,warm_start").split(","):
+            "sharding_analysis,loop_fusion,input_pipeline,"
+            "warm_start").split(","):
         tok = tok.strip()
         if not tok:
             continue
@@ -1433,7 +1543,8 @@ def main():
         print("BENCH_MODELS selected nothing; running the default set",
               file=sys.stderr)
         selected = ["resnet", "bert", "transformer", "mnist",
-                    "resnet_dp", "graph_opt", "analysis", "loop_fusion",
+                    "resnet_dp", "graph_opt", "analysis",
+                    "sharding_analysis", "loop_fusion",
                     "input_pipeline", "warm_start"]
     try:
         platform, kind = probe_backend(

@@ -32,8 +32,10 @@ from typing import Any, List, Optional, Sequence
 
 from ..framework.graph import (set_traceback_capture,
                                traceback_capture_enabled)
-from ..framework.op_registry import Effects, declare_effects
-from . import diagnostics, effects, hazards, lint, loop_safety, verifier
+from ..framework.op_registry import (Effects, declare_effects,
+                                     register_sharding_rule)
+from . import (diagnostics, effects, hazards, lint, loop_safety, sharding,
+               verifier)
 from .diagnostics import (ERROR, NOTE, WARNING, Diagnostic, errors,
                           format_report, max_severity, warnings)
 from .effects import ResolvedEffects, op_effects
@@ -42,6 +44,8 @@ from .hazards import (MODES as HAZARD_MODES, Hazard, check_plan,
 from .loop_safety import certify_plan as certify_loop_safe
 from .lint import (LintContext, LintRule, lint_graph, register_lint_rule,
                    registered_rules)
+from .sharding import (CollectiveEdge, ShardingReport, analyze_sharding,
+                       parse_mesh_arg)
 from .verifier import verify_graph, verify_graphdef, verify_ops
 
 __all__ = [
@@ -56,15 +60,21 @@ __all__ = [
     "certify_loop_safe",
     "set_traceback_capture", "traceback_capture_enabled",
     "analyze",
+    "analyze_sharding", "ShardingReport", "CollectiveEdge",
+    "register_sharding_rule", "parse_mesh_arg",
 ]
 
 
 def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
             level: str = "full",
-            severities: Optional[dict] = None) -> List[Diagnostic]:
+            severities: Optional[dict] = None,
+            mesh=None,
+            sharding_seeds: Optional[dict] = None) -> List[Diagnostic]:
     """Run verifier + hazard detector + linter over a graph and return
     all diagnostics (the combined standalone entry point; the CLI and
-    the models/examples CI gate call this)."""
+    the models/examples CI gate call this). When ``mesh`` is given (a
+    Mesh or abstract {axis: size} dict), the sharding analyzer runs too
+    and its diagnostics are included."""
     from ..framework import graph as ops_mod
     from ..framework import lowering as lowering_mod
 
@@ -83,4 +93,9 @@ def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
             diagnostics.metric_diagnostics.get_cell(
                 WARNING).increase_by(1)
     diags.extend(lint_graph(graph, fetches=fetches, severities=severities))
+    if mesh is not None:
+        report = analyze_sharding(graph=graph, mesh=mesh,
+                                  seed_specs=sharding_seeds,
+                                  fetches=fetches, severities=severities)
+        diags.extend(report.diagnostics)
     return diags

@@ -41,13 +41,17 @@ from .effects import op_effects
 
 class LintContext:
     """What one lint run sees: the op list (graph order), the owning
-    graph, and the optional fetch set."""
+    graph, the optional fetch set, and — when the sharding analyzer ran
+    — its :class:`~.sharding.ShardingReport` (the sharding lint rules
+    consult it and yield nothing without one)."""
 
     def __init__(self, graph, ops: Sequence[Any],
-                 fetches: Optional[Sequence[Any]] = None):
+                 fetches: Optional[Sequence[Any]] = None,
+                 sharding_report: Optional[Any] = None):
         self.graph = graph
         self.ops = list(ops)
         self.fetches = list(fetches or [])
+        self.sharding_report = sharding_report
         self._x64 = None
 
     @property
@@ -99,14 +103,17 @@ def registered_rules() -> List[LintRule]:
 def lint_graph(graph=None, ops: Optional[Sequence[Any]] = None,
                fetches: Optional[Sequence[Any]] = None,
                severities: Optional[Dict[str, str]] = None,
-               rules: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+               rules: Optional[Sequence[str]] = None,
+               sharding_report: Optional[Any] = None) -> List[Diagnostic]:
     """Run the registered rules. ``severities`` overrides per-code
-    severity ("off" disables a rule); ``rules`` restricts to a subset."""
+    severity ("off" disables a rule); ``rules`` restricts to a subset;
+    ``sharding_report`` feeds the sharding rules (analyze_sharding
+    passes its own report through here)."""
     if graph is None and ops is None:
         graph = ops_mod.get_default_graph()
     if ops is None:
         ops = graph.get_operations()
-    ctx = LintContext(graph, ops, fetches)
+    ctx = LintContext(graph, ops, fetches, sharding_report=sharding_report)
     severities = severities or {}
     diags: List[Diagnostic] = []
     for rule in registered_rules():

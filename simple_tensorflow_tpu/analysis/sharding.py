@@ -1,0 +1,1909 @@
+"""Static partition-spec propagation + resharding/collective-cost
+analysis over the Graph IR (ISSUE 6 tentpole).
+
+GSPMD decides real placements only after a multi-second XLA compile; by
+then a replicated 2 GB embedding or an all-gather inside a scan body is
+a profile artifact, not a diagnostic. This pass makes sharding a
+statically-analyzable property of the graph, the same way the verifier
+makes structure one (1605.08695 §3-4 treats placement/communication
+analysis as the precondition for scaling; 1909.09756 attributes most
+lost pod efficiency to exactly the resharding/collective patterns
+flagged here):
+
+1. **Propagation** — PartitionSpecs seed from variable shardings
+   (``Variable.set_sharding`` / ``shard_variables_along`` /
+   ``match_partition_rules``), fed-placeholder shardings
+   (``shard_feed``), and ``with_sharding_constraint`` ops, then flow
+   forward AND backward through every op via per-op rules registered
+   alongside abstract-eval in the op registry
+   (``op_registry.register_sharding_rule``; declared across the ops/
+   modules, FuncGraph bodies included). A conflict joins to replicated
+   and emits ``sharding/conflict``.
+
+2. **Resharding / collective detection** — every edge where the
+   consumed spec differs from the produced spec is classified local /
+   all-gather / all-to-all; rules report the collectives their op
+   *implies* (contracted-sharded matmul -> all-reduce, gradient sync,
+   batch-norm stats, explicit collective ops), each with estimated
+   per-device payload bytes comparable to the shapes of the collective
+   instructions XLA emits (utils/perf.collective_bytes_of harvests
+   those for the bench comparison). Per-shard peak HBM reuses the cost
+   model's liveness sweep with sharded byte accounting.
+
+3. **Diagnostics** — everything lands in the PR 3 framework: lint rules
+   ``lint/replicated-large-tensor``, ``lint/resharding-hotspot``,
+   ``lint/mesh-axis-unused``, ``lint/uneven-shard`` plus the analyzer's
+   own ``sharding/*`` codes, all counted on ``/stf/analysis/*``.
+
+Entry points: :func:`analyze_sharding` (graph or op-list),
+``Session._plan`` (mesh active -> per-plan report, cached with the
+plan), ``tools.graph_lint --mesh/--rules`` (offline, abstract mesh — no
+devices needed), and the model-zoo gate (1-device mesh, rule-gap
+snapshot via ``sharding/no-rule``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import (Any, Callable, Dict, FrozenSet, List, Optional, Sequence,
+                    Set, Tuple)
+
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..platform import monitoring
+from . import diagnostics as diag_mod
+from .diagnostics import ERROR, NOTE, WARNING, Diagnostic
+
+Tensor = ops_mod.Tensor
+Operation = ops_mod.Operation
+
+# -- monitoring --------------------------------------------------------------
+
+metric_collectives = monitoring.Counter(
+    "/stf/analysis/sharding_collectives",
+    "collective edges detected by the sharding analyzer", "kind")
+metric_collective_bytes = monitoring.Counter(
+    "/stf/analysis/sharding_collective_bytes",
+    "predicted collective payload bytes (trip-weighted)", "kind")
+metric_sharding_seconds = monitoring.Sampler(
+    "/stf/analysis/sharding_seconds",
+    monitoring.ExponentialBuckets(1e-6, 4.0, 16),
+    "sharding-analysis seconds per Session plan")
+
+# -- spec algebra ------------------------------------------------------------
+#
+# Normalized spec: tuple with one entry per dim; entry = tuple of mesh
+# axis names (() = dim unsharded). None = unknown rank (treated as
+# replicated). This is jax.sharding.PartitionSpec with every entry
+# canonicalized to a tuple.
+
+REPLICATED: Tuple = ()
+
+# provenance strengths (backward may only overwrite WEAK/BACK; forward
+# recomputes WEAK/FWD; SEED never moves)
+WEAK, BACK, FWD, SEED = 0, 1, 2, 3
+
+LARGE_TENSOR_BYTES = int(os.environ.get(
+    "STF_SHARDING_LARGE_BYTES", str(1 << 20)))
+
+
+def replicated(rank: Optional[int]) -> Optional[Tuple]:
+    if rank is None:
+        return None
+    return ((),) * rank
+
+
+def normalize_spec(spec, rank: Optional[int]) -> Optional[Tuple]:
+    """Canonicalize a PartitionSpec-like (stf P, jax PartitionSpec,
+    list/tuple with None|str|sequence entries) to the internal form,
+    padded/truncated to ``rank``."""
+    if rank is None:
+        return None
+    if spec is None:
+        return replicated(rank)
+    entries: List[Tuple[str, ...]] = []
+    for e in tuple(spec)[:rank]:
+        if e is None:
+            entries.append(())
+        elif isinstance(e, str):
+            entries.append((e,))
+        else:
+            entries.append(tuple(e))
+    while len(entries) < rank:
+        entries.append(())
+    return tuple(entries)
+
+
+def to_partition_spec(spec):
+    """Internal spec -> jax-style entry tuple (None | axis | (axes...))
+    for display and committed-sharding comparison."""
+    if spec is None:
+        return None
+    out = []
+    for e in spec:
+        if not e:
+            out.append(None)
+        elif len(e) == 1:
+            out.append(e[0])
+        else:
+            out.append(tuple(e))
+    return tuple(out)
+
+
+def spec_axes(spec) -> FrozenSet[str]:
+    if not spec:
+        return frozenset()
+    return frozenset(a for e in spec for a in e)
+
+
+def is_replicated(spec) -> bool:
+    return spec is None or all(not e for e in spec)
+
+
+def format_spec(spec) -> str:
+    if spec is None:
+        return "P(?)"
+    if not spec:
+        return "P()"
+    return "P(" + ", ".join(
+        ("None" if not e else e[0] if len(e) == 1 else str(tuple(e)))
+        for e in spec) + ")"
+
+
+def _dedupe_axes(spec):
+    """An axis may shard at most one dim: keep the first occurrence."""
+    if spec is None:
+        return None
+    seen: Set[str] = set()
+    out = []
+    for e in spec:
+        keep = tuple(a for a in e if a not in seen)
+        seen.update(keep)
+        out.append(keep)
+    return tuple(out)
+
+
+def shard_factor(spec, mesh_axes: Dict[str, int]) -> int:
+    """Product of the mesh-axis sizes sharding this spec (1 = fully
+    replicated / unknown)."""
+    n = 1
+    for a in spec_axes(spec):
+        n *= int(mesh_axes.get(a, 1))
+    return max(n, 1)
+
+
+def _nelems(shape) -> Optional[int]:
+    if shape is None or shape.rank is None:
+        return None
+    n = 1
+    for d in shape.dims:
+        if d.value is None:
+            return None
+        n *= d.value
+    return n
+
+
+def tensor_bytes(t: Tensor) -> float:
+    n = _nelems(t.shape)
+    if n is None:
+        return 0.0
+    try:
+        return float(n * t.dtype.base_dtype.size)
+    except Exception:
+        return 0.0
+
+
+import threading as _threading
+
+_tls = _threading.local()
+_DIMS_MISS = object()
+
+
+def _dims_of(t: Tensor) -> Optional[List[Optional[int]]]:
+    """Static dims of a tensor, cached per analysis run (rules consult
+    dims for most ops on every sweep; shapes never change under an
+    analysis, and the cache is cleared at each analyze_sharding entry —
+    thread-local because Session plans analyze on a worker thread)."""
+    cache = getattr(_tls, "dims_cache", None)
+    if cache is None:
+        cache = _tls.dims_cache = {}
+    hit = cache.get(t, _DIMS_MISS)
+    if hit is not _DIMS_MISS:
+        return hit
+    if t.shape.rank is None:
+        out = None
+    else:
+        out = [d.value for d in t.shape.dims]
+    cache[t] = out
+    return out
+
+
+# -- report ------------------------------------------------------------------
+
+@dataclass
+class CollectiveEdge:
+    """One materialized (or implied) collective: an edge whose consumed
+    spec differs from the produced one, or a rule-reported collective
+    the op's semantics force (contraction over a sharded dim, gradient
+    sync). ``nbytes`` is the per-device payload of ONE occurrence;
+    ``trip`` multiplies it for edges inside loop bodies."""
+
+    op: Any
+    kind: str                      # all-gather | all-reduce | all-to-all | slice | collective-permute
+    axes: Tuple[str, ...]
+    nbytes: float
+    tensor_name: str = ""
+    note: str = ""
+    trip: int = 1
+    in_loop: bool = False
+
+    @property
+    def total_bytes(self) -> float:
+        return self.nbytes * max(self.trip, 1)
+
+    def to_dict(self) -> dict:
+        return {"op": getattr(self.op, "name", None),
+                "op_type": getattr(self.op, "type", None),
+                "kind": self.kind, "axes": list(self.axes),
+                "bytes": self.nbytes, "trip": self.trip,
+                "in_loop": self.in_loop, "tensor": self.tensor_name,
+                "note": self.note}
+
+
+_COMM_KINDS = ("all-gather", "all-reduce", "all-to-all",
+               "collective-permute")
+
+
+@dataclass
+class ShardingReport:
+    """Result of one sharding analysis."""
+
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    specs: Dict[Any, Tuple] = field(default_factory=dict)   # Tensor -> spec
+    edges: List[CollectiveEdge] = field(default_factory=list)
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+    # raw lint facts (consumed by the registered lint rules)
+    variables: Dict[str, Tuple[Any, float, Any]] = field(
+        default_factory=dict)  # var_name -> (op, nbytes, spec)
+    uneven: List[Tuple[Any, str, int, Tuple[str, ...], int]] = field(
+        default_factory=list)  # (op, tensor_name, dim, axes, dim_size)
+    no_rule_types: Dict[str, Any] = field(default_factory=dict)
+    per_shard_peak_bytes: Optional[float] = None
+    analysis_seconds: float = 0.0
+
+    @property
+    def mesh_size(self) -> int:
+        n = 1
+        for s in self.mesh_axes.values():
+            n *= int(s)
+        return n
+
+    def spec_of(self, tensor) -> Optional[Tuple]:
+        """Final spec in jax-PartitionSpec entry form (None entries for
+        unsharded dims); None for unknown-rank tensors."""
+        return to_partition_spec(self.specs.get(tensor))
+
+    def collective_edges(self) -> List[CollectiveEdge]:
+        return [e for e in self.edges if e.kind in _COMM_KINDS]
+
+    def total_collective_bytes(self) -> float:
+        return sum(e.total_bytes for e in self.collective_edges())
+
+    def bytes_by_kind(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for e in self.collective_edges():
+            out[e.kind] = out.get(e.kind, 0.0) + e.total_bytes
+        return out
+
+    def per_op_collectives(self) -> Dict[str, List[dict]]:
+        out: Dict[str, List[dict]] = {}
+        for e in self.collective_edges():
+            out.setdefault(getattr(e.op, "name", "?"), []).append(
+                e.to_dict())
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "mesh": dict(self.mesh_axes),
+            "total_collective_bytes": self.total_collective_bytes(),
+            "bytes_by_kind": self.bytes_by_kind(),
+            "n_collective_edges": len(self.collective_edges()),
+            "n_diagnostics": len(self.diagnostics),
+            "per_shard_peak_bytes": self.per_shard_peak_bytes,
+            "analysis_seconds": round(self.analysis_seconds, 6),
+        }
+
+
+# -- mesh handling -----------------------------------------------------------
+
+def _as_mesh_axes(mesh) -> Dict[str, int]:
+    """Accept a parallel.Mesh, a jax Mesh, or a plain {axis: size} dict
+    (the abstract form — offline analysis needs no devices)."""
+    if mesh is None:
+        return {}
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    shape = getattr(mesh, "shape", None)
+    if isinstance(shape, dict):  # parallel.Mesh / jax mesh.shape mapping
+        return {str(k): int(v) for k, v in dict(shape).items()}
+    raise TypeError(f"cannot interpret mesh {mesh!r}; pass a "
+                    "stf.parallel.Mesh or a {{axis: size}} dict")
+
+
+def parse_mesh_arg(arg: str) -> Dict[str, int]:
+    """CLI mesh spec: ``8`` -> {'dp': 8}; ``2x4`` -> {'dp': 2, 'tp': 4};
+    ``dp=2,tp=4`` -> as named. The first two forms use the canonical
+    axis-name order (mesh.CANONICAL_AXES prefix dp, tp)."""
+    arg = arg.strip()
+    if "=" in arg:
+        out: Dict[str, int] = {}
+        for part in arg.split(","):
+            k, v = part.split("=", 1)
+            out[k.strip()] = int(v)
+        return out
+    sizes = [int(p) for p in arg.lower().split("x")]
+    names = ("dp", "tp", "sp", "ep")
+    if len(sizes) > len(names):
+        raise ValueError(f"--mesh {arg!r}: at most {len(names)} unnamed "
+                         "axes; use name=size,... form")
+    return {names[i]: s for i, s in enumerate(sizes)}
+
+
+# -- rule context ------------------------------------------------------------
+
+class RuleContext:
+    """What one rule application sees. ``require``/``collective``/
+    ``diag`` only take effect during the final record pass (quiet
+    fixpoint iterations discard them)."""
+
+    def __init__(self, engine: "_Engine", op: Operation, record: bool):
+        self._engine = engine
+        self._op = op
+        self.record = record
+        self.mesh_axes = engine.mesh_axes
+        self.required: Dict[int, Tuple] = {}
+
+    # -- helpers -------------------------------------------------------------
+    def axis_size(self, axes) -> int:
+        if isinstance(axes, str):
+            axes = (axes,)
+        n = 1
+        for a in axes:
+            n *= int(self.mesh_axes.get(a, 1))
+        return max(n, 1)
+
+    def shard_factor(self, spec) -> int:
+        return shard_factor(spec, self.mesh_axes)
+
+    def spec(self, tensor) -> Optional[Tuple]:
+        """Propagated spec of an arbitrary in-scope tensor (replicated
+        default for unvisited ones)."""
+        hit = self._engine.env.get(tensor)
+        if hit is not None:
+            return hit[0]
+        return replicated(tensor.shape.rank)
+
+    def var_spec(self, var_name: Optional[str],
+                 rank: Optional[int]) -> Optional[Tuple]:
+        """Declared/seeded spec of a variable (None if unsharded)."""
+        if var_name is None:
+            return None
+        return self._engine._var_spec(var_name, rank, self._op)
+
+    def join(self, a, b) -> Optional[Tuple]:
+        return self._engine.join(a, b, self._op, self)
+
+    # -- effects -------------------------------------------------------------
+    def require(self, idx: int, spec) -> None:
+        """Declare that this op consumes input ``idx`` laid out as
+        ``spec``; the engine compares with the produced spec and records
+        the resharding edge."""
+        self.required[idx] = spec
+
+    def collective(self, kind: str, axes, nbytes: float,
+                   note: str = "", tensor_name: str = "") -> None:
+        if not self.record:
+            return
+        if isinstance(axes, str):
+            axes = (axes,)
+        axes = tuple(a for a in axes if self.mesh_axes.get(a, 1) > 1)
+        if not axes:
+            return
+        self._engine.add_edge(CollectiveEdge(
+            op=self._op, kind=kind, axes=axes, nbytes=float(nbytes),
+            note=note, tensor_name=tensor_name))
+
+    def diag(self, severity: str, code: str, message: str,
+             op: Optional[Operation] = None) -> None:
+        if not self.record:
+            return
+        self._engine.diag(severity, code, message, op or self._op)
+
+    def analyze_body(self, fg, arg_specs: Sequence[Optional[Tuple]],
+                     trip: Optional[int] = None,
+                     loop: bool = False,
+                     capture_outers: Optional[Sequence[Any]] = None,
+                     record: Optional[bool] = None
+                     ) -> List[Optional[Tuple]]:
+        """Propagate through a FuncGraph body: seeds fg.inputs with
+        ``arg_specs`` and captures with their outer specs, sweeps the
+        body, returns the specs of fg.outputs. During the record pass,
+        body edges are charged x ``trip`` (unknown trip counts once but
+        keeps the in-loop flag for the hotspot rule). ``capture_outers``
+        re-binds None-outer captures (imported FuncGraphs) to the outer
+        tensors the op passes positionally in its input list.
+        ``record=False`` forces a quiet sweep even inside the record
+        pass — loop rules use it for carry-fixpoint rounds so body edges
+        are recorded exactly once, by the final sweep."""
+        return self._engine.analyze_body(
+            fg, arg_specs, self, trip=trip, loop=loop,
+            capture_outers=capture_outers,
+            record=self.record if record is None else record)
+
+
+# -- the engine --------------------------------------------------------------
+
+_HOSTY_TYPES = ("Placeholder", "PlaceholderWithDefault", "Const", "NoOp")
+
+
+class _Engine:
+    def __init__(self, mesh_axes: Dict[str, int],
+                 seed_specs: Optional[Dict[str, Any]] = None):
+        self.mesh_axes = dict(mesh_axes)
+        # Tensor -> (spec, strength)
+        self.env: Dict[Tensor, Tuple[Optional[Tuple], int]] = {}
+        self.report = ShardingReport(mesh_axes=dict(mesh_axes))
+        self.seed_specs = dict(seed_specs or {})  # var/op name -> spec-like
+        self._var_specs: Dict[str, Tuple[Optional[Tuple], Any]] = {}
+        self._trip_stack: List[int] = []
+        self._loop_depth = 0
+        self._grad_path_cache: Dict[Operation, FrozenSet[str]] = {}
+        self._uneven_seen: Set[str] = set()
+
+    # -- diagnostics/edges ---------------------------------------------------
+    def diag(self, severity, code, message, op):
+        diag_mod.report(self.report.diagnostics, severity, code, message,
+                        op=op)
+
+    def add_edge(self, edge: CollectiveEdge):
+        if self._trip_stack:
+            t = 1
+            for x in self._trip_stack:
+                t *= max(int(x), 1)
+            edge.trip = t
+            edge.in_loop = True
+        self.report.edges.append(edge)
+
+    # -- join ----------------------------------------------------------------
+    def join(self, a, b, op, ctx: Optional[RuleContext] = None
+             ) -> Optional[Tuple]:
+        """Dim-wise join: unsharded yields to sharded; two different
+        sharded entries conflict -> replicated + sharding/conflict."""
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if len(a) != len(b):
+            return a  # rank mismatch: caller aligns before joining
+        out = []
+        for i, (ea, eb) in enumerate(zip(a, b)):
+            if ea == eb:
+                out.append(ea)
+            elif not ea:
+                out.append(eb)
+            elif not eb:
+                out.append(ea)
+            else:
+                if ctx is not None:
+                    ctx.diag(NOTE, "sharding/conflict",
+                             f"dim {i} sharded as {ea} by one operand and "
+                             f"{eb} by another; joined to replicated")
+                out.append(())
+        return _dedupe_axes(tuple(out))
+
+    # -- seeds ---------------------------------------------------------------
+    def _variable_registry(self, ops: Sequence[Operation]) -> Dict[str, Any]:
+        for op in ops:
+            g = op.graph
+            while getattr(g, "outer_graph", None) is not None:
+                g = g.outer_graph
+            reg = getattr(g, "_scoped_state", {}).get(
+                "__vars_by_store_name__")
+            if reg:
+                return reg
+        return {}
+
+    def _var_spec(self, var_name: str, shape_rank: Optional[int],
+                  op: Operation) -> Optional[Tuple]:
+        hit = self._var_specs.get(var_name)
+        if hit is not None:
+            return hit[0]
+        raw = self.seed_specs.get(var_name)
+        spec = normalize_spec(raw, shape_rank) if raw is not None else None
+        self._var_specs[var_name] = (spec, op)
+        return spec
+
+    def seed(self, ops: Sequence[Operation]):
+        """Collect variable/feed shardings before the sweeps."""
+        registry = self._variable_registry(ops)
+        for name, var in registry.items():
+            try:
+                raw = self.seed_specs.get(name, var.sharding)
+                rank = var.shape.rank
+                spec = (normalize_spec(raw, rank)
+                        if raw is not None else None)
+                self._var_specs[name] = (spec, var.op)
+            except Exception:
+                continue
+        for op in ops:
+            if op.type in ("VariableV2",):
+                vn = op.attrs.get("var_name", op.name)
+                raw = self.seed_specs.get(vn, op.attrs.get("sharding"))
+                if vn not in self._var_specs or raw is not None:
+                    rank = op.outputs[0].shape.rank if op.outputs else None
+                    self._var_specs[vn] = (
+                        normalize_spec(raw, rank) if raw is not None
+                        else None, op)
+        # boundary tensors (fed placeholders, pre-computed host values):
+        # their producers are pruned out of a per-run plan, so their
+        # declared shardings must seed the env directly
+        op_set = set(ops)
+        for op in ops:
+            for t in op.inputs:
+                if t.op in op_set or t in self.env:
+                    continue
+                src = t.op
+                raw = self.seed_specs.get(t.name,
+                                          self.seed_specs.get(src.name))
+                if raw is None:
+                    raw = src.attrs.get("sharding")
+                if raw is None and src.type in ("VariableV2",
+                                                "ReadVariable"):
+                    vn = src.attrs.get("var_name", src.name)
+                    spec = self._var_spec(vn, t.shape.rank, src)
+                    if spec is not None:
+                        self.env[t] = (spec, SEED)
+                    continue
+                if raw is not None:
+                    self.env[t] = (normalize_spec(raw, t.shape.rank),
+                                   SEED)
+
+    # -- the sweeps ----------------------------------------------------------
+    def _outputs_default(self, op: Operation, in_specs, ctx: RuleContext,
+                         strengths: List[int]) -> List[Optional[Tuple]]:
+        """Conservative fallback for op types without a rule: outputs
+        replicated; a sharded input is consumed replicated (all-gather)
+        and — for device ops — flags the rule gap once per op type."""
+        sharded_in = [i for i, s in enumerate(in_specs)
+                      if s is not None and not is_replicated(s)]
+        hosty = op.op_def.runs_on_host or op.type in _HOSTY_TYPES
+        for i in sharded_in:
+            ctx.require(i, replicated(len(in_specs[i])))
+        if sharded_in and not hosty and ctx.record \
+                and op.type not in self.report.no_rule_types:
+            self.report.no_rule_types[op.type] = op
+            ctx.diag(NOTE, "sharding/no-rule",
+                     f"op type {op.type} has no sharding propagation "
+                     "rule; sharded inputs are assumed gathered and "
+                     "outputs replicated (register one via "
+                     "op_registry.register_sharding_rule)")
+        return [replicated(t.shape.rank) for t in op.outputs]
+
+    def _apply_op(self, op: Operation, record: bool):
+        # seeds first: they are authoritative regardless of rules
+        if op.type == "VariableV2":
+            vn = op.attrs.get("var_name", op.name)
+            spec = self._var_spec(
+                vn, op.outputs[0].shape.rank if op.outputs else None, op)
+            strength = SEED if spec is not None else WEAK
+            for t in op.outputs:
+                self._set(t, spec if spec is not None
+                          else replicated(t.shape.rank), strength)
+            return
+        if op.type == "ReadVariable":
+            vn = op.attrs.get("var_name")
+            spec = self._var_spec(vn, op.outputs[0].shape.rank, op) \
+                if vn is not None else None
+            self._set(op.outputs[0], spec if spec is not None
+                      else replicated(op.outputs[0].shape.rank),
+                      SEED if spec is not None else WEAK)
+            return
+        if op.type in ("Placeholder", "PlaceholderWithDefault"):
+            raw = self.seed_specs.get(op.name, op.attrs.get("sharding"))
+            for t in op.outputs:
+                if raw is not None:
+                    self._set(t, normalize_spec(raw, t.shape.rank), SEED)
+                else:
+                    self._set(t, replicated(t.shape.rank), WEAK)
+            return
+
+        in_specs = []
+        strengths = []
+        for t in op.inputs:
+            hit = self.env.get(t)
+            if hit is None:
+                hit = (replicated(t.shape.rank), WEAK)
+            in_specs.append(hit[0])
+            strengths.append(hit[1])
+
+        ctx = RuleContext(self, op, record)
+        rule = op_registry.sharding_rule(op.type)
+        out_specs = None
+        if rule is not None:
+            try:
+                out_specs = rule(op, in_specs, ctx)
+            except Exception as e:  # a rule bug must never sink a plan
+                if record:
+                    self.diag(NOTE, "sharding/rule-error",
+                              f"sharding rule for {op.type} failed: "
+                              f"{type(e).__name__}: {e}", op)
+                out_specs = None
+        if out_specs is None:
+            out_specs = self._outputs_default(op, in_specs, ctx, strengths)
+
+        out_strength = FWD if any(s > WEAK for s in strengths) else WEAK
+        if rule is not None and getattr(rule, "seeds_outputs", False):
+            out_strength = SEED
+        for t, s in zip(op.outputs, out_specs):
+            if s is not None and t.shape.rank is not None \
+                    and len(s) != t.shape.rank:
+                s = replicated(t.shape.rank)
+            self._set(t, _dedupe_axes(s), out_strength)
+
+        if record:
+            self._record_edges(op, in_specs, ctx)
+            self._check_uneven(op, ctx)
+
+    def _set(self, t: Tensor, spec, strength: int):
+        cur = self.env.get(t)
+        if cur is not None:
+            if cur[1] >= SEED:
+                return
+            if cur[1] == BACK and strength <= FWD:
+                # backward info survives forward recomputation
+                return
+        self.env[t] = (spec, strength)
+
+    def suggest_back(self, t: Tensor, spec):
+        cur = self.env.get(t)
+        if cur is not None and cur[1] not in (WEAK, BACK):
+            return
+        if spec is None:
+            return
+        if t.shape.rank is not None and len(spec) != t.shape.rank:
+            return
+        self.env[t] = (_dedupe_axes(spec), BACK)
+
+    def forward(self, ops: Sequence[Operation], record: bool = False):
+        for op in ops:
+            self._apply_op(op, record)
+
+    def backward(self, ops: Sequence[Operation]):
+        for op in reversed(ops):
+            rule = op_registry.sharding_rule(op.type)
+            bwd = getattr(rule, "backward", None) if rule else None
+            if bwd is None:
+                continue
+            out_specs = [self.env.get(t, (replicated(t.shape.rank),
+                                          WEAK))[0] for t in op.outputs]
+            in_specs = [self.env.get(t, (replicated(t.shape.rank),
+                                         WEAK))[0] for t in op.inputs]
+            ctx = RuleContext(self, op, record=False)
+            try:
+                suggestions = bwd(op, out_specs, in_specs, ctx)
+            except Exception:
+                continue
+            if not suggestions:
+                continue
+            for t, s in zip(op.inputs, suggestions):
+                if s is not None:
+                    self.suggest_back(t, s)
+
+    # -- record-pass bookkeeping --------------------------------------------
+    def _record_edges(self, op: Operation, in_specs, ctx: RuleContext):
+        for idx, want in ctx.required.items():
+            have = in_specs[idx]
+            t = op.inputs[idx]
+            edge = classify_reshard(have, want, t, self.mesh_axes)
+            if edge is None:
+                continue
+            kind, axes, nbytes = edge
+            self.add_edge(CollectiveEdge(
+                op=op, kind=kind, axes=axes, nbytes=nbytes,
+                tensor_name=t.name,
+                note=f"{format_spec(have)} -> {format_spec(want)}"))
+
+    def _check_uneven(self, op: Operation, ctx: RuleContext):
+        for t in op.outputs:
+            spec = self.env.get(t, (None, WEAK))[0]
+            if spec is None or is_replicated(spec):
+                continue
+            dims = _dims_of(t)
+            if dims is None:
+                continue
+            for i, e in enumerate(spec):
+                if not e or i >= len(dims) or dims[i] is None:
+                    continue
+                f = ctx.axis_size(e)
+                if f > 1 and dims[i] % f != 0 \
+                        and t.name not in self._uneven_seen:
+                    self._uneven_seen.add(t.name)
+                    self.report.uneven.append(
+                        (op, t.name, i, tuple(e), dims[i]))
+
+    # -- FuncGraph bodies ----------------------------------------------------
+    def analyze_body(self, fg, arg_specs, ctx: RuleContext,
+                     trip: Optional[int] = None, loop: bool = False,
+                     capture_outers: Optional[Sequence[Any]] = None,
+                     record: Optional[bool] = None
+                     ) -> List[Optional[Tuple]]:
+        from ..framework import lowering as lowering_mod
+
+        if record is None:
+            record = ctx.record
+
+        saved: Dict[Tensor, Any] = {}
+
+        def stash_set(t, spec, strength):
+            if t not in saved:
+                saved[t] = self.env.get(t)
+            self.env[t] = (spec, strength)
+
+        for t, s in zip(fg.inputs, arg_specs):
+            stash_set(t, normalize_spec(s, t.shape.rank)
+                      if s is not None else replicated(t.shape.rank), SEED)
+        for j, (outer, inner) in enumerate(fg.captures):
+            # an imported FuncGraph's captures have outer=None; the loop
+            # rule re-binds them from the op's positional inputs (the
+            # lowerer does the same) — otherwise seed replicated
+            if outer is None and capture_outers is not None \
+                    and j < len(capture_outers):
+                outer = capture_outers[j]
+            if outer is None:
+                spec = replicated(inner.shape.rank)
+            else:
+                hit = self.env.get(outer)
+                spec = hit[0] if hit else replicated(outer.shape.rank)
+            stash_set(inner, spec, SEED)
+        try:
+            plan = lowering_mod.prune(
+                [t.op for t in fg.outputs],
+                fed_tensors=set(fg.inputs)
+                | {inner for _, inner in fg.captures})
+        except Exception:
+            return [replicated(t.shape.rank) for t in fg.outputs]
+        if loop:
+            self._trip_stack.append(trip if trip else 1)
+        try:
+            self.forward(plan, record=record)
+        finally:
+            if loop:
+                self._trip_stack.pop()
+        outs = [self.env.get(t, (replicated(t.shape.rank), WEAK))[0]
+                for t in fg.outputs]
+        # body-local tensors must not leak across analyses of the same
+        # body with different arg specs (fixpoint iterations)
+        for t, old in saved.items():
+            if old is None:
+                self.env.pop(t, None)
+            else:
+                self.env[t] = old
+        return outs
+
+
+def classify_reshard(have, want, tensor: Tensor, mesh_axes: Dict[str, int]
+                     ) -> Optional[Tuple[str, Tuple[str, ...], float]]:
+    """Classify the layout change ``have -> want`` of one edge.
+
+    Returns (kind, axes, per-device payload bytes) or None for a free
+    edge. Payload is sized like the collective instruction XLA would
+    emit: the RESULT's per-device bytes (an all-gather to replicated
+    moves the full tensor; an all-to-all keeps it sharded)."""
+    if have is None or want is None:
+        return None
+    have = normalize_spec(have, len(have))
+    want = normalize_spec(want, len(want))
+    if have == want:
+        return None
+    lost: Set[str] = set()
+    gained: Set[str] = set()
+    for i in range(min(len(have), len(want))):
+        ha, wa = set(have[i]), set(want[i])
+        lost.update(a for a in ha - wa if mesh_axes.get(a, 1) > 1)
+        gained.update(a for a in wa - ha if mesh_axes.get(a, 1) > 1)
+    if not lost and not gained:
+        return None
+    gb = tensor_bytes(tensor)
+    if lost and gained:
+        kind = "all-to-all"
+        axes = tuple(sorted(lost | gained))
+    elif lost:
+        kind = "all-gather"
+        axes = tuple(sorted(lost))
+    else:
+        # replicated -> sharded is a local slice: no wire traffic
+        kind = "slice"
+        axes = tuple(sorted(gained))
+    nbytes = gb / shard_factor(want, mesh_axes)
+    return kind, axes, nbytes
+
+
+# ---------------------------------------------------------------------------
+# rule factories (used by the ops/ modules to declare per-op rules)
+# ---------------------------------------------------------------------------
+
+def _out_rank(op: Operation, i: int = 0) -> Optional[int]:
+    if i < len(op.outputs):
+        return op.outputs[i].shape.rank
+    return None
+
+
+def _aligned_entry(spec, dims, out_rank: int, out_dim: int,
+                   out_dims=None) -> Tuple[str, ...]:
+    """Entry of ``spec`` feeding output dim ``out_dim`` under numpy
+    broadcasting (rank-aligned from the right; size-1 dims broadcast and
+    contribute no sharding)."""
+    if spec is None or dims is None:
+        return ()
+    r = len(spec)
+    d = out_dim - (out_rank - r)
+    if d < 0 or d >= r:
+        return ()
+    if dims[d] == 1 and (out_dims is None or out_dims[out_dim] != 1):
+        return ()
+    return spec[d]
+
+
+def elementwise_rule(op: Operation, in_specs, ctx: RuleContext):
+    """Broadcasting elementwise: the output spec is the dim-aligned join
+    of the input specs; operands disagreeing with the join are consumed
+    resharded."""
+    out = op.outputs[0]
+    out_dims = _dims_of(out)
+    r = out.shape.rank
+    if r is None:
+        return [None for _ in op.outputs]
+    # fast paths for the two dominant shapes of elementwise traffic —
+    # unary (Relu/Cast/Neg/...) and same-spec n-ary — which need no
+    # per-dim broadcast alignment
+    s0 = in_specs[0] if in_specs else None
+    if s0 is not None and len(s0) == r:
+        if len(in_specs) == 1:
+            if _dims_of(op.inputs[0]) == out_dims:
+                return [s0 for _ in op.outputs]
+        elif all(s is not None and s == s0 and
+                 _dims_of(t) == out_dims
+                 for t, s in zip(op.inputs, in_specs)):
+            return [s0 for _ in op.outputs]
+    entries = []
+    for d in range(r):
+        cands = []
+        for t, s in zip(op.inputs, in_specs):
+            e = _aligned_entry(s, _dims_of(t), r, d, out_dims)
+            if e:
+                cands.append(e)
+        pick: Tuple[str, ...] = ()
+        for e in cands:
+            if not pick:
+                pick = e
+            elif e != pick:
+                ctx.diag(NOTE, "sharding/conflict",
+                         f"dim {d} sharded as {pick} and {e} by different "
+                         "operands; joined to replicated")
+                pick = ()
+                break
+        entries.append(pick)
+    out_spec = _dedupe_axes(tuple(entries))
+    # each operand is consumed at the out spec restricted to its dims
+    for i, (t, s) in enumerate(zip(op.inputs, in_specs)):
+        dims = _dims_of(t)
+        if s is None or dims is None:
+            continue
+        want = []
+        for d in range(len(dims)):
+            od = d + (r - len(dims))
+            want.append(out_spec[od]
+                        if dims[d] != 1 and 0 <= od < r else ())
+        want_t = tuple(want)
+        if want_t != s:
+            ctx.require(i, want_t)
+    return [out_spec for _ in op.outputs]
+
+
+def _elementwise_backward(op, out_specs, in_specs, ctx):
+    src = out_specs[0]
+    if src is None:
+        return None
+    r = len(src)
+    outs = []
+    for t, s in zip(op.inputs, in_specs):
+        dims = _dims_of(t)
+        if dims is None:
+            outs.append(None)
+            continue
+        want = []
+        for d in range(len(dims)):
+            od = d + (r - len(dims))
+            want.append(src[od] if 0 <= od < r and dims[d] != 1 else ())
+        outs.append(tuple(want))
+    return outs
+
+
+elementwise_rule.backward = _elementwise_backward
+
+
+def passthrough_rule(op: Operation, in_specs, ctx: RuleContext):
+    """Output 0 mirrors input 0 (Identity/Cast-like, rank-preserving)."""
+    s = in_specs[0] if in_specs else None
+    return [s if i == 0 else replicated(_out_rank(op, i))
+            for i in range(len(op.outputs))]
+
+
+passthrough_rule.backward = lambda op, out_specs, in_specs, ctx: (
+    [out_specs[0]] + [None] * (len(in_specs) - 1) if in_specs else None)
+
+
+def local_rule(op: Operation, in_specs, ctx: RuleContext):
+    """Outputs replicated but sharded inputs are consumed AS-IS (no
+    gather): per-element/slicing ops whose result is host-small."""
+    return [replicated(t.shape.rank) for t in op.outputs]
+
+
+def make_reduce_rule(axis_attr: str = "axis",
+                     keepdims_attr: str = "keepdims"):
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        x = op.inputs[0]
+        s = in_specs[0]
+        dims = _dims_of(x)
+        if s is None or dims is None:
+            return [replicated(_out_rank(op, i))
+                    for i in range(len(op.outputs))]
+        axis = op.attrs.get(axis_attr)
+        if axis is None:
+            red = list(range(len(dims)))
+        elif isinstance(axis, (list, tuple)):
+            red = [int(a) % len(dims) for a in axis]
+        else:
+            red = [int(axis) % len(dims)]
+        keep = bool(op.attrs.get(keepdims_attr, False))
+        red_axes = set()
+        for d in red:
+            red_axes.update(a for a in s[d]
+                            if ctx.mesh_axes.get(a, 1) > 1)
+        out_entries = []
+        for d in range(len(dims)):
+            if d in red:
+                if keep:
+                    out_entries.append(())
+            else:
+                out_entries.append(s[d])
+        out_spec = tuple(out_entries)
+        if red_axes:
+            out_t = op.outputs[0]
+            ctx.collective(
+                "all-reduce", tuple(sorted(red_axes)),
+                tensor_bytes(out_t) / ctx.shard_factor(out_spec),
+                note=f"reduction over sharded dim(s) of {x.name}",
+                tensor_name=out_t.name)
+        return [out_spec for _ in op.outputs]
+
+    return rule
+
+
+def matmul_rule(op: Operation, in_specs, ctx: RuleContext):
+    """(batch..., m, k) x (batch..., k, n): batch/m from lhs, n from rhs;
+    a sharded contracted dim implies an all-reduce of the output."""
+    a, b = op.inputs[0], op.inputs[1]
+    sa, sb = in_specs[0], in_specs[1]
+    da, db = _dims_of(a), _dims_of(b)
+    r = _out_rank(op)
+    if sa is None or sb is None or da is None or db is None or r is None:
+        return [replicated(r)]
+    ta = bool(op.attrs.get("transpose_a", op.attrs.get("adj_x", False)))
+    tb = bool(op.attrs.get("transpose_b", op.attrs.get("adj_y", False)))
+    am, ak = (len(da) - 1, len(da) - 2) if ta else (len(da) - 2,
+                                                   len(da) - 1)
+    bk, bn = (len(db) - 1, len(db) - 2) if tb else (len(db) - 2,
+                                                   len(db) - 1)
+    if len(da) < 2 or len(db) < 2:
+        return [replicated(r)]
+    # contracted dim: both operands should agree; on disagreement we
+    # approximate GSPMD by resharding rhs to lhs's k sharding
+    k_axes = set(sa[ak]) | set(sb[bk])
+    k_axes = {x for x in k_axes if ctx.mesh_axes.get(x, 1) > 1}
+    if set(sa[ak]) != set(sb[bk]):
+        want_b = list(sb)
+        want_b[bk] = sa[ak]
+        ctx.require(1, tuple(want_b))
+    out = [()] * r
+    # batch dims from lhs (aligned right, before m/n)
+    for d in range(r - 2):
+        ad = d - (r - len(da))
+        out[d] = sa[ad] if 0 <= ad < len(da) - 2 else ()
+    out[r - 2] = sa[am]
+    out[r - 1] = sb[bn]
+    out_spec = _dedupe_axes(tuple(out))
+    if set(sa[ak]) & k_axes:
+        shared = tuple(sorted(set(sa[ak]) & k_axes))
+        out_t = op.outputs[0]
+        ctx.collective(
+            "all-reduce", shared,
+            tensor_bytes(out_t) / ctx.shard_factor(out_spec),
+            note="contraction over sharded dim", tensor_name=out_t.name)
+    return [out_spec]
+
+
+def transpose_rule(op: Operation, in_specs, ctx: RuleContext):
+    s = in_specs[0]
+    if s is None:
+        return [None]
+    perm = op.attrs.get("perm")
+    if perm is None:
+        perm = tuple(reversed(range(len(s))))
+    return [tuple(s[int(p)] for p in perm)]
+
+
+def _transpose_backward(op, out_specs, in_specs, ctx):
+    s = out_specs[0]
+    if s is None:
+        return None
+    perm = op.attrs.get("perm")
+    if perm is None:
+        perm = tuple(reversed(range(len(s))))
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[int(p)] = i
+    return [tuple(s[i] for i in inv)] + [None] * (len(in_specs) - 1)
+
+
+transpose_rule.backward = _transpose_backward
+
+
+def reshape_rule(op: Operation, in_specs, ctx: RuleContext):
+    """Keep a sharded dim that maps 1:1 (same size, same left-to-right
+    position among non-unit dims... approximated by prefix products);
+    anything murkier replicates with an all-gather."""
+    x = op.inputs[0]
+    s = in_specs[0]
+    in_dims = _dims_of(x)
+    out = op.outputs[0]
+    out_dims = _dims_of(out)
+    if s is None or in_dims is None or out_dims is None:
+        return [replicated(_out_rank(op))]
+    if is_replicated(s):
+        return [replicated(len(out_dims))]
+    # prefix products align dim boundaries between the two shapes
+    def prefixes(dims):
+        out, p = {}, 1
+        for i, d in enumerate(dims):
+            out[i] = p
+            p *= (d or 1)
+        return out, p
+
+    pin, tot_in = prefixes(in_dims)
+    pout, tot_out = prefixes(out_dims)
+    entries = [()] * len(out_dims)
+    lost: Set[str] = set()
+    for i, e in enumerate(s):
+        if not e:
+            continue
+        placed = False
+        for j in range(len(out_dims)):
+            if pin[i] == pout[j] and in_dims[i] == out_dims[j]:
+                entries[j] = e
+                placed = True
+                break
+            # a sharded dim split/merged as the OUTER factor keeps its
+            # sharding (the shards stay contiguous)
+            if pin[i] == pout[j] and out_dims[j] is not None \
+                    and in_dims[i] is not None \
+                    and out_dims[j] % max(ctx.axis_size(e), 1) == 0 \
+                    and (in_dims[i] % out_dims[j] == 0
+                         or out_dims[j] % in_dims[i] == 0):
+                entries[j] = e
+                placed = True
+                break
+        if not placed:
+            lost.update(e)
+    if lost:
+        want = tuple(ee if not (set(ee) & lost) else
+                     tuple(a for a in ee if a not in lost) for ee in s)
+        ctx.require(0, want)
+        ctx.diag(NOTE, "sharding/reshape-gather",
+                 f"reshape {op.name!r} cannot carry axes "
+                 f"{sorted(lost)} through {in_dims} -> {out_dims}; "
+                 "the input is gathered")
+    return [_dedupe_axes(tuple(entries))]
+
+
+def _reshape_backward(op, out_specs, in_specs, ctx):
+    # exact inverse only for rank-preserving same-shape reshapes
+    x = op.inputs[0]
+    out = op.outputs[0]
+    if _dims_of(x) == _dims_of(out):
+        return [out_specs[0]] + [None] * (len(in_specs) - 1)
+    return None
+
+
+reshape_rule.backward = _reshape_backward
+
+
+def make_concat_rule(axis_attr: str = "axis"):
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        r = _out_rank(op)
+        if r is None:
+            return [None]
+        axis = op.attrs.get(axis_attr, 0)
+        axis = int(axis) % max(r, 1)
+        joined: Optional[Tuple] = None
+        for s in in_specs:
+            if s is None or len(s) != r:
+                continue
+            joined = s if joined is None else ctx.join(joined, s)
+        if joined is None:
+            return [replicated(r)]
+        if joined[axis]:
+            # concatenating along a sharded dim forces a gather of every
+            # piece (shard boundaries no longer align)
+            for i, s in enumerate(in_specs):
+                if s is not None and len(s) == r and s[axis]:
+                    want = list(s)
+                    want[axis] = ()
+                    ctx.require(i, tuple(want))
+            joined = tuple(() if d == axis else e
+                           for d, e in enumerate(joined))
+        return [joined]
+
+    return rule
+
+
+def make_gather_rule(axis_attr: str = "axis", params_idx: int = 0,
+                     indices_idx: int = 1):
+    """Gather/embedding-lookup: indices dims replace params' gathered
+    dim. A sharded gathered dim (vocab/ep sharding) implies an
+    all-reduce of the gathered output (the one-hot-matmul lowering
+    GSPMD uses)."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        params = op.inputs[params_idx]
+        sp = in_specs[params_idx]
+        si = in_specs[indices_idx] if indices_idx < len(in_specs) else None
+        pd = _dims_of(params)
+        r = _out_rank(op)
+        if sp is None or pd is None or r is None:
+            return [replicated(r)]
+        axis = int(op.attrs.get(axis_attr, 0) or 0) % max(len(pd), 1)
+        ind_rank = len(si) if si is not None else \
+            (op.inputs[indices_idx].shape.rank or 0) \
+            if indices_idx < len(op.inputs) else 0
+        entries = []
+        for d in range(r):
+            if d < axis:
+                entries.append(sp[d])
+            elif d < axis + ind_rank:
+                entries.append(si[d - axis] if si is not None else ())
+            else:
+                entries.append(sp[d - ind_rank + 1])
+        out_spec = _dedupe_axes(tuple(entries))
+        gaxes = tuple(a for a in sp[axis]
+                      if ctx.mesh_axes.get(a, 1) > 1)
+        if gaxes:
+            out_t = op.outputs[0]
+            ctx.collective(
+                "all-reduce", gaxes,
+                tensor_bytes(out_t) / ctx.shard_factor(out_spec),
+                note="gather over sharded dim (one-hot contraction)",
+                tensor_name=out_t.name)
+        return [out_spec for _ in op.outputs]
+
+    return rule
+
+
+def make_conv_rule(n_spatial: int = 2):
+    """Convolution: batch + spatial from the data input, the filter is
+    consumed replicated on its spatial/in-channel dims; out-channel may
+    carry the filter's last-dim sharding (tp-style)."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        x = op.inputs[0]
+        sx = in_specs[0]
+        sw = in_specs[1] if len(in_specs) > 1 else None
+        r = _out_rank(op)
+        dx = _dims_of(x)
+        if sx is None or r is None or dx is None:
+            return [replicated(r) for _ in op.outputs]
+        nchw = op.attrs.get("data_format") == "NCHW"
+        batch_e = sx[0]
+        in_chan_dim = 1 if nchw else len(sx) - 1
+        chan_dim = 1 if nchw else r - 1
+        # spatial sharding would need halo exchange: consume gathered
+        want = list(sx)
+        changed = False
+        for d in range(len(sx)):
+            if d == 0 or d == in_chan_dim:
+                continue
+            if sx[d]:
+                want[d] = ()
+                changed = True
+        if changed:
+            ctx.require(0, tuple(want))
+        out = [()] * r
+        out[0] = batch_e
+        # contraction over a sharded in-channel dim -> all-reduce
+        cin_axes = tuple(a for a in sx[in_chan_dim]
+                         if ctx.mesh_axes.get(a, 1) > 1)
+        if sw is not None and len(sw) >= 1 and sw[-1]:
+            out[chan_dim] = sw[-1]
+        out_spec = _dedupe_axes(tuple(out))
+        if cin_axes:
+            out_t = op.outputs[0]
+            ctx.collective(
+                "all-reduce", cin_axes,
+                tensor_bytes(out_t) / ctx.shard_factor(out_spec),
+                note="conv contraction over sharded in-channel",
+                tensor_name=out_t.name)
+        if sw is not None and any(sw[:-1]):
+            wwant = tuple(() if i < len(sw) - 1 else sw[-1]
+                          for i in range(len(sw)))
+            ctx.require(1, wwant)
+        return [out_spec] + [
+            replicated(_out_rank(op, i))
+            for i in range(1, len(op.outputs))]
+
+    return rule
+
+
+def make_pool_rule():
+    """Pooling: batch and channel sharding pass through; sharded
+    spatial dims would need halo exchange, so they are consumed
+    gathered."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        sx = in_specs[0]
+        r = _out_rank(op)
+        if sx is None or r is None:
+            return [replicated(_out_rank(op, i))
+                    for i in range(len(op.outputs))]
+        nchw = op.attrs.get("data_format") == "NCHW"
+        chan = 1 if nchw else len(sx) - 1
+        want = list(sx)
+        out = [()] * r
+        changed = False
+        for d, e in enumerate(sx):
+            if d == 0 or d == chan:
+                if d < r:
+                    out[d] = e
+            elif e:
+                want[d] = ()
+                changed = True
+        if changed:
+            ctx.require(0, tuple(want))
+        return [tuple(out)] + [replicated(_out_rank(op, i))
+                               for i in range(1, len(op.outputs))]
+
+    return rule
+
+
+def make_softmax_rule(axis_attr: str = "axis"):
+    """Softmax-family: spec-preserving; a sharded normalization dim
+    costs a (small) all-reduce of the per-row statistics."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        s = in_specs[0]
+        if s is None or not s:
+            return [s for _ in op.outputs]
+        ax = int(op.attrs.get(axis_attr, -1)) % len(s)
+        red = tuple(a for a in s[ax] if ctx.mesh_axes.get(a, 1) > 1)
+        if red:
+            out_t = op.outputs[0]
+            dims = _dims_of(out_t)
+            denom = (dims[ax] or 1) if dims and ax < len(dims) else 1
+            ctx.collective(
+                "all-reduce", red,
+                2.0 * tensor_bytes(out_t) / max(denom, 1)
+                / ctx.shard_factor(s),
+                note="normalization stats over sharded dim",
+                tensor_name=out_t.name)
+        return [s for _ in op.outputs]
+
+    return rule
+
+
+def make_last_dim_reduce_rule():
+    """Per-example losses (softmax xent): the class dim reduces away;
+    sharded classes imply an all-reduce of the per-example outputs."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        s = in_specs[0]
+        if s is None or not s:
+            return [replicated(_out_rank(op, i))
+                    for i in range(len(op.outputs))]
+        out_spec = tuple(s[:-1])
+        red = tuple(a for a in s[-1] if ctx.mesh_axes.get(a, 1) > 1)
+        if red:
+            out_t = op.outputs[0]
+            ctx.collective(
+                "all-reduce", red,
+                tensor_bytes(out_t) / ctx.shard_factor(out_spec),
+                note="class-dim contraction over sharded dim",
+                tensor_name=out_t.name)
+        outs = []
+        for i, t in enumerate(op.outputs):
+            r = t.shape.rank
+            outs.append(out_spec if r == len(out_spec)
+                        else s if r == len(s) else replicated(r))
+        return outs
+
+    return rule
+
+
+def make_axis_unsharded_rule(axis_attr: str = "axis", default: int = 0):
+    """Spec-preserving ops that scan/sort along one dim: that dim is
+    consumed gathered when sharded (cumsum, sort, topk-like)."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        s = in_specs[0]
+        if s is None or not s:
+            return [s for _ in op.outputs]
+        ax = int(op.attrs.get(axis_attr, default)) % len(s)
+        if s[ax]:
+            want = list(s)
+            want[ax] = ()
+            ctx.require(0, tuple(want))
+            s = tuple(want)
+        outs = []
+        for t in op.outputs:
+            r = t.shape.rank
+            outs.append(s if r == len(s) else replicated(r))
+        return outs
+
+    return rule
+
+
+def einsum_rule(op: Operation, in_specs, ctx: RuleContext):
+    """Parse the equation; letters join across operands, contracted
+    sharded letters imply an all-reduce of the output. Ellipsis falls
+    back to the conservative default."""
+    eq = op.attrs.get("equation", "")
+    if "..." in eq or "->" not in eq:
+        return None
+    lhs, out_sub = eq.replace(" ", "").split("->")
+    subs = lhs.split(",")
+    if len(subs) != len(op.inputs):
+        return None
+    letter: Dict[str, Tuple[str, ...]] = {}
+    for sub, s, t in zip(subs, in_specs, op.inputs):
+        if s is None or len(sub) != len(s):
+            continue
+        for ch, e in zip(sub, s):
+            if not e:
+                continue
+            prev = letter.get(ch)
+            if prev is None:
+                letter[ch] = e
+            elif prev != e:
+                ctx.diag(NOTE, "sharding/conflict",
+                         f"einsum index {ch!r} sharded as {prev} and "
+                         f"{e}; joined to replicated")
+                letter[ch] = ()
+    # operands disagreeing with the joined letter map reshard
+    for i, (sub, s) in enumerate(zip(subs, in_specs)):
+        if s is None or len(sub) != len(s):
+            continue
+        want = tuple(letter.get(ch, ()) for ch in sub)
+        if want != s:
+            ctx.require(i, want)
+    out_spec = _dedupe_axes(tuple(letter.get(ch, ()) for ch in out_sub))
+    contracted = set(lhs.replace(",", "")) - set(out_sub)
+    red = set()
+    for ch in contracted:
+        red.update(a for a in letter.get(ch, ())
+                   if ctx.mesh_axes.get(a, 1) > 1)
+    if red:
+        out_t = op.outputs[0]
+        ctx.collective("all-reduce", tuple(sorted(red)),
+                       tensor_bytes(out_t) / ctx.shard_factor(out_spec),
+                       note="einsum contraction over sharded index",
+                       tensor_name=out_t.name)
+    return [out_spec]
+
+
+def make_slice_rule():
+    """Slice/StridedSlice/Pad/Tile-shaped ops: dims whose size is
+    unchanged keep their sharding; a changed sharded dim is consumed
+    gathered (shard boundaries move)."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        s = in_specs[0]
+        x = op.inputs[0]
+        out = op.outputs[0]
+        din, dout = _dims_of(x), _dims_of(out)
+        if s is None:
+            return [replicated(_out_rank(op, i))
+                    for i in range(len(op.outputs))]
+        if din is None or dout is None or len(din) != len(dout):
+            # rank-changing slice: gather sharded dims, replicate out
+            if not is_replicated(s):
+                ctx.require(0, replicated(len(s)))
+            return [replicated(_out_rank(op, i))
+                    for i in range(len(op.outputs))]
+        want = list(s)
+        entries = []
+        changed = False
+        for d in range(len(din)):
+            if din[d] == dout[d]:
+                entries.append(s[d])
+            else:
+                entries.append(())
+                if s[d]:
+                    want[d] = ()
+                    changed = True
+        if changed:
+            ctx.require(0, tuple(want))
+        return [tuple(entries)] + [replicated(_out_rank(op, i))
+                                   for i in range(1, len(op.outputs))]
+
+    return rule
+
+
+def make_assign_rule(value_idx: int = 0):
+    """Variable writes: the committed value adopts the variable's
+    declared sharding; a differently-laid-out value reshards on the
+    way in."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        vn = op.attrs.get("var_name")
+        rank = _out_rank(op)
+        spec = ctx.var_spec(vn, rank)
+        if spec is None:
+            spec = replicated(rank)
+        if value_idx < len(in_specs) and in_specs[value_idx] is not None \
+                and spec is not None \
+                and in_specs[value_idx] != spec \
+                and len(in_specs[value_idx]) == len(spec):
+            ctx.require(value_idx, spec)
+        return [spec for _ in op.outputs]
+
+    return rule
+
+
+def batchnorm_rule(op: Operation, in_specs, ctx: RuleContext):
+    """FusedBatchNorm: y keeps x's spec; the per-channel statistics are
+    reduced over batch/spatial — sharded batch means an (small)
+    all-reduce of the stats."""
+    sx = in_specs[0]
+    outs = [sx] + [replicated(_out_rank(op, i))
+                   for i in range(1, len(op.outputs))]
+    if sx is not None:
+        nchw = op.attrs.get("data_format") == "NCHW"
+        chan = 1 if nchw else len(sx) - 1
+        red = set()
+        for d, e in enumerate(sx):
+            if d != chan:
+                red.update(a for a in e if ctx.mesh_axes.get(a, 1) > 1)
+        if red:
+            stat_bytes = sum(tensor_bytes(t) for t in op.outputs[1:3])
+            if stat_bytes <= 0 and len(op.outputs) > 1:
+                stat_bytes = tensor_bytes(op.outputs[1]) * 2
+            ctx.collective("all-reduce", tuple(sorted(red)),
+                           stat_bytes or 0.0,
+                           note="cross-shard batch statistics",
+                           tensor_name=op.outputs[0].name)
+    return outs
+
+
+def make_stack_rule(axis_attr: str = "axis"):
+    """Pack/Stack: inputs join; output gains a new leading (axis) dim."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        r = _out_rank(op)
+        if r is None:
+            return [None]
+        axis = int(op.attrs.get(axis_attr, 0) or 0) % max(r, 1)
+        joined = None
+        for s in in_specs:
+            if s is not None and len(s) == r - 1:
+                joined = s if joined is None else ctx.join(joined, s)
+        if joined is None:
+            return [replicated(r)]
+        out = list(joined)
+        out.insert(axis, ())
+        return [_dedupe_axes(tuple(out))]
+
+    return rule
+
+
+def make_unstack_rule(axis_attr: str = "axis"):
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        s = in_specs[0]
+        if s is None:
+            return [replicated(_out_rank(op, i))
+                    for i in range(len(op.outputs))]
+        axis = int(op.attrs.get(axis_attr, 0) or 0) % max(len(s), 1)
+        if s[axis]:
+            want = list(s)
+            want[axis] = ()
+            ctx.require(0, tuple(want))
+        sub = tuple(e for d, e in enumerate(s) if d != axis)
+        return [sub for _ in op.outputs]
+
+    return rule
+
+
+def expand_dims_rule(op: Operation, in_specs, ctx: RuleContext):
+    s = in_specs[0]
+    r = _out_rank(op)
+    if s is None or r is None:
+        return [replicated(r)]
+    in_dims = _dims_of(op.inputs[0]) or []
+    out_dims = _dims_of(op.outputs[0]) or []
+    # find the inserted size-1 dim by aligning shapes
+    out = []
+    j = 0
+    for d in range(r):
+        if j < len(in_dims) and out_dims and d < len(out_dims) \
+                and out_dims[d] == in_dims[j] \
+                and (len(out_dims) - d) >= (len(in_dims) - j):
+            out.append(s[j])
+            j += 1
+        else:
+            out.append(())
+    return [tuple(out)]
+
+
+def squeeze_rule(op: Operation, in_specs, ctx: RuleContext):
+    s = in_specs[0]
+    if s is None:
+        return [replicated(_out_rank(op))]
+    in_dims = _dims_of(op.inputs[0]) or []
+    out = [e for d, e in enumerate(s)
+           if d >= len(in_dims) or in_dims[d] != 1]
+    r = _out_rank(op)
+    if r is not None and len(out) != r:
+        return [replicated(r)]
+    return [tuple(out)]
+
+
+def make_loop_rule(kind: str):
+    """Sharding rule for the structured control-flow ops; ``kind`` in
+    {'while', 'scan', 'fold', 'map', 'cond', 'call'}. Bodies are
+    analyzed recursively; loop carries iterate to a (2-round) fixpoint;
+    edges inside loop bodies are trip-weighted."""
+    from ..framework import optimizer as optimizer_mod
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        spec = optimizer_mod.function_op_spec(op.type)
+        trip = None
+        if spec is not None and spec.trip is not None:
+            try:
+                t = spec.trip(op.attrs, op.inputs)
+                trip = int(t) if t else None
+            except Exception:
+                trip = None
+
+        if kind == "cond":
+            tg, fg = op.attrs.get("true_graph"), op.attrs.get(
+                "false_graph")
+            # inputs = [pred] + true-captures + false-captures
+            ntc = int(op.attrs.get("n_true_caps",
+                                   len(tg.captures) if tg else 0))
+            cap_lists = (list(op.inputs[1:1 + ntc]),
+                         list(op.inputs[1 + ntc:]))
+            outs = None
+            for bg, caps in zip((tg, fg), cap_lists):
+                if bg is None:
+                    continue
+                o = ctx.analyze_body(bg, [], trip=None, loop=False,
+                                     capture_outers=caps)
+                outs = o if outs is None else [
+                    ctx.join(a, b) if a is not None and b is not None
+                    and len(a) == len(b) else None
+                    for a, b in zip(outs, o)]
+            if outs is None or len(outs) != len(op.outputs):
+                return None
+            return outs
+
+        if kind == "call":
+            fg = (op.attrs.get("func_graph") or op.attrs.get("fg")
+                  or op.attrs.get("body"))
+            if fg is None:
+                return None
+            n_args = int(op.attrs.get("n_args", len(fg.inputs)))
+            args = list(in_specs[:len(fg.inputs)])
+            outs = ctx.analyze_body(
+                fg, args, trip=None, loop=False,
+                capture_outers=list(op.inputs[n_args:]))
+            if len(outs) != len(op.outputs):
+                return None
+            return outs
+
+        if kind == "while":
+            fg = op.attrs.get("body_graph")
+            cg = op.attrs.get("cond_graph")
+            n_vars = int(op.attrs.get("n_vars", len(op.outputs)))
+            # inputs = loop-vars + cond-captures + body-captures
+            ncc = int(op.attrs.get("n_cond_caps",
+                                   len(cg.captures) if cg else 0))
+            cond_caps = list(op.inputs[n_vars:n_vars + ncc])
+            body_caps = list(op.inputs[n_vars + ncc:])
+            carry = list(in_specs[:n_vars])
+            # carry fixpoint rounds are QUIET — only the final sweep
+            # records, so body edges are charged exactly once
+            for _ in range(2):
+                outs = ctx.analyze_body(fg, carry, trip=trip, loop=True,
+                                        capture_outers=body_caps,
+                                        record=False)
+                if len(outs) != n_vars:
+                    return None
+                new = [ctx.join(c, o) if c is not None and o is not None
+                       and len(c) == len(o) else o
+                       for c, o in zip(carry, outs)]
+                if new == carry:
+                    break
+                carry = new
+            if ctx.record:
+                ctx.analyze_body(fg, carry, trip=trip, loop=True,
+                                 capture_outers=body_caps, record=True)
+            if cg is not None:
+                ctx.analyze_body(cg, carry, trip=trip, loop=True,
+                                 capture_outers=cond_caps)
+            return carry[:len(op.outputs)]
+
+        # scan / fold / map: carry + sliced elems
+        fg = op.attrs.get("body")
+        if fg is None:
+            return None
+        nc = int(op.attrs.get("n_carry", 0))
+        ne = int(op.attrs.get("n_elems", len(op.inputs) - nc))
+        # inputs = carry + elems + captures
+        body_caps = list(op.inputs[nc + ne:])
+        carry = list(in_specs[:nc])
+
+        def sliced(s):
+            if s is None or not s:
+                return None if s is None else s
+            return tuple(s[1:])
+
+        elems = [sliced(s) for s in in_specs[nc:nc + ne]]
+        if kind == "map":
+            args = elems
+        else:
+            args = carry + elems
+        outs = None
+        # carry fixpoint rounds are QUIET; one final sweep records so
+        # body edges are charged exactly once
+        for _ in range(2 if nc else 1):
+            outs = ctx.analyze_body(fg, args, trip=trip, loop=True,
+                                    capture_outers=body_caps,
+                                    record=False if nc else None)
+            if not nc:
+                break
+            if len(outs) < nc:
+                return None
+            new_carry = [ctx.join(c, o) if c is not None and o is not None
+                         and len(c) == len(o) else o
+                         for c, o in zip(carry, outs[:nc])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+            args = carry + elems if kind != "map" else elems
+        if outs is None:
+            return None
+        if nc and ctx.record:
+            outs = ctx.analyze_body(fg, args, trip=trip, loop=True,
+                                    capture_outers=body_caps,
+                                    record=True)
+        if kind == "fold":
+            result = outs[:len(op.outputs)]
+        else:
+            # stacked outputs regain the leading (iteration) dim
+            result = [tuple([()] + list(o)) if o is not None else None
+                      for o in outs]
+        if len(result) != len(op.outputs):
+            return None
+        return result
+
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# bulk registration helpers
+# ---------------------------------------------------------------------------
+
+def register_rules(rule, *op_types):
+    for t in op_types:
+        op_registry.register_sharding_rule(t, rule)
+
+
+# ---------------------------------------------------------------------------
+# lint rules over the report (the PR 3 framework path)
+# ---------------------------------------------------------------------------
+
+SHARDING_LINT_CODES = (
+    "lint/replicated-large-tensor", "lint/resharding-hotspot",
+    "lint/mesh-axis-unused", "lint/uneven-shard")
+
+
+def _report_of(ctx):
+    return getattr(ctx, "sharding_report", None)
+
+
+def register_sharding_lint_rules():
+    from .lint import register_lint_rule
+
+    @register_lint_rule("replicated-large-tensor", WARNING)
+    def _rule_replicated_large(ctx):
+        """A weight above the size threshold (STF_SHARDING_LARGE_BYTES,
+        default 1 MiB) with no sharded dim is copied whole into every
+        device's HBM — on an N-device mesh that is N-1 wasted copies
+        and the classic cause of 'fits on one chip, OOMs on eight'."""
+        rep = _report_of(ctx)
+        if rep is None or rep.mesh_size <= 1:
+            return
+        for name, (op, nbytes, spec) in sorted(rep.variables.items()):
+            if nbytes >= LARGE_TENSOR_BYTES and is_replicated(spec):
+                yield (op,
+                       f"variable {name!r} ({int(nbytes)} bytes) is "
+                       f"replicated across the {rep.mesh_size}-device "
+                       "mesh; shard it (shard_variable / "
+                       "shard_variables_along / match_partition_rules)")
+
+    @register_lint_rule("resharding-hotspot", WARNING)
+    def _rule_resharding_hotspot(ctx):
+        """A resharding edge inside a while/scan body repeats every
+        iteration: its bytes are charged x trip-count. Hoist the layout
+        change out of the loop or align the body's constraint with the
+        carry's sharding."""
+        rep = _report_of(ctx)
+        if rep is None or rep.mesh_size <= 1:
+            return
+        for e in rep.collective_edges():
+            if not e.in_loop:
+                continue
+            yield (e.op,
+                   f"{e.kind} of {e.tensor_name or 'tensor'} "
+                   f"({int(e.nbytes)} bytes) inside a loop body "
+                   + (f"repeats x{e.trip} iterations "
+                      f"(~{int(e.total_bytes)} bytes/step)"
+                      if e.trip > 1 else
+                      "repeats every iteration")
+                   + (f" [{e.note}]" if e.note else ""))
+
+    @register_lint_rule("mesh-axis-unused", WARNING)
+    def _rule_mesh_axis_unused(ctx):
+        """A mesh axis that shards no tensor and feeds no collective is
+        devices standing idle: the mesh is bigger than the program."""
+        rep = _report_of(ctx)
+        if rep is None:
+            return
+        used: Set[str] = set()
+        for spec in rep.specs.values():
+            used |= set(spec_axes(spec))
+        for e in rep.edges:
+            used.update(e.axes)
+        for ax, size in sorted(rep.mesh_axes.items()):
+            if size > 1 and ax not in used:
+                yield (None,
+                       f"mesh axis {ax!r} (size {size}) shards no "
+                       "tensor and feeds no collective; the program "
+                       f"uses 1/{size} of that axis")
+
+    @register_lint_rule("uneven-shard", WARNING)
+    def _rule_uneven_shard(ctx):
+        """dim % axis-size != 0: XLA pads every shard to the ceiling,
+        so each step moves and computes padding."""
+        rep = _report_of(ctx)
+        if rep is None or rep.mesh_size <= 1:
+            return
+        for (op, tname, dim, axes, size) in rep.uneven:
+            f = 1
+            for a in axes:
+                f *= rep.mesh_axes.get(a, 1)
+            waste = (f - size % f) / float(f)
+            yield (op,
+                   f"{tname} dim {dim} (size {size}) is sharded over "
+                   f"{axes} (x{f}) but {size} % {f} != 0: ~"
+                   f"{waste:.0%} of each shard is padding")
+
+
+register_sharding_lint_rules()
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def analyze_sharding(graph=None, ops: Optional[Sequence[Operation]] = None,
+                     mesh=None,
+                     seed_specs: Optional[Dict[str, Any]] = None,
+                     fetches: Optional[Sequence[Any]] = None,
+                     feeds: Sequence[Any] = (),
+                     with_peak: bool = False,
+                     severities: Optional[Dict[str, str]] = None
+                     ) -> ShardingReport:
+    """Run the sharding analysis and the sharding lint rules.
+
+    ``mesh``: a stf.parallel.Mesh or an abstract ``{axis: size}`` dict
+    (defaults to the active mesh). ``ops`` defaults to the whole graph
+    in creation (= topological) order; pass a pruned plan for per-run
+    analysis. ``seed_specs`` maps variable/placeholder names to
+    PartitionSpec-likes (``match_partition_rules`` output) overriding
+    declared shardings. ``with_peak`` adds the per-shard peak-HBM
+    estimate (needs ``fetches``)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    if mesh is None:
+        from ..parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.current_mesh()
+    mesh_axes = _as_mesh_axes(mesh)
+    if graph is None and ops is None:
+        graph = ops_mod.get_default_graph()
+    if ops is None:
+        ops = graph.get_operations()
+    ops = list(ops)
+    _tls.dims_cache = {}  # fresh static-shape cache per analysis
+    engine = _Engine(mesh_axes, seed_specs=seed_specs)
+    engine.seed(ops)
+    # fwd -> bwd, then one recording fwd pass (which re-propagates the
+    # backward suggestions while collecting edges/diagnostics)
+    engine.forward(ops)
+    engine.backward(ops)
+    engine.forward(ops, record=True)
+
+    rep = engine.report
+    rep.specs = {t: s for t, (s, _str) in engine.env.items()}
+    # variable facts for the lint rules
+    for vn, (spec, op) in engine._var_specs.items():
+        shp = None
+        if hasattr(op, "shape"):
+            shp = op.shape
+        elif getattr(op, "outputs", None):
+            shp = op.outputs[0].shape
+        n = _nelems(shp) if shp is not None else None
+        if n is None:
+            continue
+        try:
+            dt = (op.dtype if hasattr(op, "dtype")
+                  else op.outputs[0].dtype).base_dtype
+            nbytes = float(n * dt.size)
+        except Exception:
+            nbytes = 0.0
+        the_op = op.op if hasattr(op, "op") else op
+        rank = shp.rank
+        rep.variables[vn] = (
+            the_op, nbytes,
+            spec if spec is not None else replicated(rank))
+
+    if with_peak and fetches:
+        try:
+            from ..framework import cost_model
+
+            def factor(t):
+                return shard_factor(engine.env.get(t, (None, 0))[0],
+                                    mesh_axes)
+
+            est = cost_model.estimate(fetches, feeds=list(feeds),
+                                      shard_factor_fn=factor)
+            rep.per_shard_peak_bytes = est.peak_bytes
+        except Exception:
+            rep.per_shard_peak_bytes = None
+
+    # sharding lint rules through the PR 3 framework
+    if mesh_axes:
+        from . import lint as lint_mod
+
+        rep.diagnostics.extend(lint_mod.lint_graph(
+            graph=graph if graph is not None else None,
+            ops=ops, fetches=fetches, severities=severities,
+            rules=SHARDING_LINT_CODES, sharding_report=rep))
+    # metrics
+    for e in rep.collective_edges():
+        metric_collectives.get_cell(e.kind).increase_by(1)
+        metric_collective_bytes.get_cell(e.kind).increase_by(
+            int(e.total_bytes))
+    rep.analysis_seconds = _time.perf_counter() - t0
+    metric_sharding_seconds.get_cell().add(rep.analysis_seconds)
+    return rep

@@ -197,6 +197,9 @@ def _executable_analysis(lowered, compiled):
         mem = perf.memory_of(compiled)
         if mem:
             out["memory"] = mem
+        coll = perf.collective_bytes_of(compiled)
+        if coll:
+            out["collective_bytes"] = coll
     return out
 
 
@@ -430,7 +433,9 @@ class _CompiledStep:
                  "has_device_stage", "n_calls", "last_lowering_ctx",
                  "check_msgs", "const_env", "alias", "fetch_nbytes",
                  "raw_post_inputs", "func_plans", "compiled", "xla_cost",
-                 "feed_shardings", "fused", "fusion_diags")
+                 "feed_shardings", "fused", "fusion_diags",
+                 "sharding_report", "sharding_thread",
+                 "sharding_sync_seconds", "sharding_gate")
 
     def __init__(self):
         self.n_calls = 0
@@ -454,10 +459,32 @@ class _CompiledStep:
         self.feed_shardings = {}
         # (n, output_mode, xs-name-set) -> fused N-step executable
         self.fused = {}
+        # stf.analysis.sharding per-plan report (mesh active at plan
+        # time): predicted collective bytes + lint findings, surfaced
+        # through RunMetadata.cost_graph["predicted_collectives"].
+        # Computed on a worker thread overlapping lowering/XLA compile
+        # (the analysis is advisory — warnings, never a gate — so it
+        # stays off the plan's critical path); join_sharding() waits.
+        self.sharding_report = None
+        self.sharding_thread = None
+        self.sharding_gate = None
+        self.sharding_sync_seconds = 0.0
         # cached loop-safety certification: None = not yet checked,
         # else (plan-static diagnostics, assigned-variable names) — the
         # store-dependent uninitialized-write check re-runs per call
         self.fusion_diags = None
+
+    def join_sharding(self, timeout=10.0):
+        """Wait for the overlapped sharding analysis (if any) and return
+        the report (None when it did not run or has not finished)."""
+        th = self.sharding_thread
+        if th is not None:
+            if self.sharding_gate is not None:
+                self.sharding_gate.set()  # don't wait out the head start
+            th.join(timeout)
+            if not th.is_alive():
+                self.sharding_thread = None
+        return self.sharding_report
 
 
 class BaseSession:
@@ -766,6 +793,15 @@ class BaseSession:
                 run_metadata.step_stats = stats
                 if collector is not None and collector.get("xla_cost"):
                     run_metadata.cost_graph = dict(collector["xla_cost"])
+                rep = collector.get("sharding_report") \
+                    if collector is not None else None
+                if rep is not None:
+                    run_metadata.cost_graph.setdefault(
+                        "predicted_collectives", {
+                            "total_bytes": rep.total_collective_bytes(),
+                            "bytes_by_kind": rep.bytes_by_kind(),
+                            "per_op": rep.per_op_collectives(),
+                        })
             else:
                 try:
                     run_metadata["wall_time_s"] = wall
@@ -1330,6 +1366,9 @@ class BaseSession:
                         getattr(v, "nbytes", 0) for v in fetch_vals))
                     if step.xla_cost:
                         collector["xla_cost"] = step.xla_cost
+                    rep = step.join_sharding()
+                    if rep is not None:
+                        collector["sharding_report"] = rep
 
         dev_map = dict(zip(step.device_fetches, device_results))
 
@@ -1541,6 +1580,30 @@ class BaseSession:
         return self._base_key, np.uint32(self._run_counter)
 
     # -- planning ------------------------------------------------------------
+    def _plan_has_sharding_signals(self, pruned, fed_set) -> bool:
+        """Whether a plan is worth sharding-analyzing: it is fed (a
+        step-shaped program — the mesh-axis-unused lint is exactly
+        right there, sharded or not) or some sharding is configured
+        (variable/feed shardings, an explicit constraint, a shard_map).
+        Variable-initializer plans and bare state reads have neither,
+        and flagging THEM as 'mesh axis unused' under an active mesh
+        would be noise on every init run of a correctly-sharded job."""
+        if fed_set or self._variable_store.shardings:
+            return True
+        for op in pruned:
+            if op.type in ("ShardingConstraint", "ShardMap"):
+                return True
+            if op.attrs.get("sharding") is not None:
+                return True
+            if op.type == "VariableV2":
+                vn = op.attrs.get("var_name", op.name)
+                reg = self._graph._scoped_state.get(
+                    "__vars_by_store_name__", {})
+                var = reg.get(vn)
+                if var is not None and var.sharding is not None:
+                    return True
+        return False
+
     def _plan(self, elements, feeds) -> _CompiledStep:
         import jax
 
@@ -1586,6 +1649,62 @@ class BaseSession:
             if self._analysis_mode != "off":
                 analysis.verify_ops(pruned, level="structural",
                                     diags=plan_diags)
+            # sharding analysis (ISSUE 6): when a mesh is active at plan
+            # time, predict per-edge collective bytes + lint the plan's
+            # shardings. Cached with the plan (same lifetime as hazards:
+            # _plan only runs on executable-cache misses). The analysis
+            # is ADVISORY — warnings/notes, never an execution gate — so
+            # it runs on a worker thread overlapping lowering + XLA
+            # compile instead of stretching the plan's critical path
+            # (the sharding_analysis bench row pins the blocking cost;
+            # /stf/analysis/sharding_seconds samples the full cost).
+            # Analyzer failures degrade to a log note, never sink a run.
+            try:
+                from ..parallel import mesh as mesh_mod
+
+                _mesh = mesh_mod.current_mesh()
+            except Exception:
+                _mesh = None
+            if _mesh is not None and getattr(_mesh, "size", 1) > 1 \
+                    and self._plan_has_sharding_signals(pruned, fed_set):
+                s_t0 = time.perf_counter()
+                plan_ops = list(pruned)  # snapshot vs later mutation
+                gate = threading.Event()
+
+                def _sharding_worker():
+                    from ..platform import tf_logging as _logging
+
+                    # head start for the rest of _plan: a compute-bound
+                    # worker launched mid-plan steals GIL slices from
+                    # the (pure-Python) staging work it is supposed to
+                    # overlap. Waiting a beat lands the analysis inside
+                    # the jit trace/compile window, where the GIL is
+                    # released for long C++ stretches; join_sharding
+                    # opens the gate immediately when a reader waits.
+                    gate.wait(1.0)
+                    try:
+                        rep = analysis.analyze_sharding(
+                            graph=self._graph, ops=plan_ops, mesh=_mesh,
+                            fetches=fetch_tensors)
+                    except Exception as e:  # noqa: BLE001 — advisory
+                        _logging.warning(
+                            "plan analysis: NOTE "
+                            "sharding/analysis-failed: %s: %s",
+                            type(e).__name__, e)
+                        return
+                    step.sharding_report = rep
+                    for d in rep.diagnostics:
+                        _logging.warning("plan analysis: %s",
+                                         d.format())
+
+                th = threading.Thread(target=_sharding_worker,
+                                      name="stf_sharding_analysis",
+                                      daemon=True)
+                step.sharding_thread = th
+                step.sharding_gate = gate
+                th.start()
+                step.sharding_sync_seconds = \
+                    time.perf_counter() - s_t0
         analysis.diagnostics.metric_check_seconds.get_cell().add(
             time.perf_counter() - a_t0)
         if plan_diags:

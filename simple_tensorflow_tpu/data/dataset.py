@@ -607,6 +607,20 @@ class TFRecordDataset(Dataset):
 _ITER_COUNT = [0]
 
 
+def iterator_registry(graph=None):
+    """The name -> Iterator map of ``graph``'s root graph (default: the
+    default graph). Graph-scoped, NOT process-global: a graph owns the
+    iterators its IteratorGetNext ops name, so dropping the graph
+    (reset_default_graph) releases them — and with them the pipeline
+    stage threads and ring buffers their streams pin. A process-global
+    registry kept every iterator (one-shot iterators have no other
+    reference) alive for the life of the process."""
+    g = graph if graph is not None else ops_mod.get_default_graph()
+    while getattr(g, "outer_graph", None) is not None:
+        g = g.outer_graph
+    return g._scoped_state.setdefault("__data_iterators__", {})
+
+
 class Iterator:
     """Graph-facing iterator: get_next() returns host-source tensors that
     pull the next element during each Session.run (the reference's
@@ -618,12 +632,18 @@ class Iterator:
         self._it = None if initializable else iter(dataset)
         _ITER_COUNT[0] += 1
         self._name = f"dataset_iterator_{_ITER_COUNT[0]}"
-        _ITERATORS[self._name] = self
+        iterator_registry()[self._name] = self
         self._peek = None
         self._spec = None
         self._keys = None
         self._structure = "single"
         self._position = 0  # elements yielded; checkpointed by Saver
+
+    def close(self):
+        """Release the underlying stream (and any pipeline stage
+        threads/buffers backing it). The iterator stays restorable:
+        initializer / restore_state builds a fresh stream."""
+        self._replace_stream(None)
 
     def _replace_stream(self, new_it):
         old, self._it = self._it, new_it
@@ -712,11 +732,8 @@ class Iterator:
         return outs[0]
 
 
-_ITERATORS = {}
-
-
 def _lower_get_next(ctx, op, inputs):
-    it = _ITERATORS[op.attrs["iterator"]]
+    it = iterator_registry(op.graph)[op.attrs["iterator"]]
     val = it._next_value()
     if isinstance(val, dict):
         items = [val[k] for k in it._keys]
@@ -728,7 +745,7 @@ def _lower_get_next(ctx, op, inputs):
 
 
 def _lower_iter_init(ctx, op, inputs):
-    it = _ITERATORS[op.attrs["iterator"]]
+    it = iterator_registry(op.graph)[op.attrs["iterator"]]
     it._replace_stream(iter(it._dataset))
     return []
 

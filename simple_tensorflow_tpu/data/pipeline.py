@@ -40,10 +40,15 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..platform import monitoring
+
+# every constructed PipelineIterator, while alive (test leak hygiene:
+# tests/conftest.py asserts these are all closed after each module)
+live_iterators: "weakref.WeakSet" = weakref.WeakSet()
 
 # Sentinel accepted by map/interleave/prefetch/num_parallel_reads: "let
 # the autotuner pick and adjust" (same spelling as tf.data.AUTOTUNE).
@@ -369,11 +374,17 @@ class PipelineIterator:
     """Iterator over a compiled pipeline. ``close()`` (also driven by
     GC and end-of-stream) cancels stage threads and releases buffers —
     checkpoint restore replaces iterators mid-stream, so shutdown must
-    not wait for sources to drain."""
+    not wait for sources to drain.
+
+    Live instances register in ``live_iterators`` (a WeakSet) so test
+    hygiene fixtures can assert every iterator a test created was
+    closed (an unclosed iterator pins its stage threads and ring
+    buffers until GC happens to run)."""
 
     def __init__(self, run: PipelineRun, gen):
         self._run = run
         self._gen = gen
+        live_iterators.add(self)
 
     def __iter__(self):
         return self
@@ -389,6 +400,10 @@ class PipelineIterator:
         except BaseException:
             self.close()
             raise
+
+    @property
+    def closed(self) -> bool:
+        return self._run is None and self._gen is None
 
     def close(self):
         run, gen = self._run, self._gen

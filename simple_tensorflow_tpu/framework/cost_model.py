@@ -385,11 +385,17 @@ class CostEstimate:
 
 def estimate(fetches, feeds: Sequence[Tensor] = (),
              graph: Optional[ops_mod.Graph] = None,
-             top_k: int = 0) -> CostEstimate:
+             top_k: int = 0,
+             shard_factor_fn=None) -> CostEstimate:
     """Predict FLOPs / bytes / peak live memory of running ``fetches``.
 
     ``fetches``: tensors/ops (same things you pass to Session.run).
     ``feeds``: placeholders that will be fed (pruning boundary).
+    ``shard_factor_fn``: optional fn(tensor) -> int dividing that
+    tensor's RESIDENT/LIVE bytes — the sharding analyzer passes the
+    per-tensor mesh shard factor so ``peak_bytes``/``resident_bytes``
+    become PER-SHARD HBM (flops/bytes_accessed stay global: the whole
+    mesh still does the whole step's work).
     """
     tensors: List[Tensor] = []
     target_ops: List[Operation] = []
@@ -407,6 +413,17 @@ def estimate(fetches, feeds: Sequence[Tensor] = (),
     fed = set(feeds)
     plan = lowering_mod.prune(target_ops, fed_tensors=fed)
 
+    def _live_bytes(t):
+        b = _tensor_bytes(t)
+        if shard_factor_fn is not None and b:
+            try:
+                f = int(shard_factor_fn(t) or 1)
+            except Exception:
+                f = 1
+            if f > 1:
+                b = b / f
+        return b
+
     est = CostEstimate()
     # resident state: every variable in the slice stays in HBM all step
     seen_vars = set()
@@ -415,7 +432,7 @@ def estimate(fetches, feeds: Sequence[Tensor] = (),
             vn = op.attrs.get("var_name")
             if vn not in seen_vars:
                 seen_vars.add(vn)
-                est.resident_bytes += sum(_tensor_bytes(t)
+                est.resident_bytes += sum(_live_bytes(t)
                                           for t in op.outputs[:1])
 
     # liveness sweep for peak memory: feed buffers are live from step
@@ -429,7 +446,7 @@ def estimate(fetches, feeds: Sequence[Tensor] = (),
     for t in tensors:  # fetched tensors live to the end
         last_use[t] = len(plan)
     allocated = set(fed)
-    live = est.resident_bytes + sum(_tensor_bytes(t) for t in fed)
+    live = est.resident_bytes + sum(_live_bytes(t) for t in fed)
     peak = live
     frees: Dict[int, List[Tensor]] = {}
     for t, idx in last_use.items():
@@ -446,7 +463,7 @@ def estimate(fetches, feeds: Sequence[Tensor] = (),
         if op.type not in ("VariableV2", "ReadVariable"):
             for t in op.outputs:
                 allocated.add(t)
-            live += sum(_tensor_bytes(t) for t in op.outputs)
+            live += sum(_live_bytes(t) for t in op.outputs)
         if op.type == "SymbolicGradient":
             # residuals of the forward slice stay live through backward
             pass  # their producers' buffers are already counted live
@@ -454,7 +471,7 @@ def estimate(fetches, feeds: Sequence[Tensor] = (),
         for t in frees.get(idx, ()):
             if t in allocated and t.op.type not in ("VariableV2",
                                                     "ReadVariable"):
-                live -= _tensor_bytes(t)
+                live -= _live_bytes(t)
     est.peak_bytes = peak
     if top_k:
         est.per_op.sort(key=lambda o: -(o.flops + o.bytes))

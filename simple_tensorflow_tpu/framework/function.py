@@ -218,3 +218,14 @@ def recompute_grad(func, name=None):
                           name or "recompute_grad")
 
     return wrapper
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6): call
+# bodies propagate inline.
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.make_loop_rule("call"),
+                      "GraphFunctionCall", "RecomputeGradCall")

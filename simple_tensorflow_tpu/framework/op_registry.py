@@ -276,3 +276,38 @@ def registered_ops() -> List[str]:
 
 def is_registered(name) -> bool:
     return name in _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding)
+#
+# A rule is registered per op type ALONGSIDE the op definition — the same
+# placement contract as abstract-eval (pure_fn/infer_fn) and effects: the
+# module that knows an op's semantics declares how PartitionSpecs flow
+# through it. Signature:
+#
+#     rule(op, in_specs, ctx) -> list of out specs (one per op output)
+#
+# where a spec is a tuple with one entry per dim, each entry a tuple of
+# mesh axis names (() = unsharded, a rank-unknown tensor is None), and
+# ``ctx`` is the analyzer's RuleContext (require/collective/diag/
+# analyze_body — see analysis/sharding.py). Rules may carry an optional
+# ``backward`` attribute fn(op, out_specs, in_specs, ctx) -> list of
+# suggested in specs (or None per slot) for the reverse sweep.
+# ---------------------------------------------------------------------------
+
+_SHARDING_RULES: Dict[str, Any] = {}
+
+
+def register_sharding_rule(name, rule):
+    """Attach a sharding propagation rule to op type ``name``. The op
+    need not be registered yet (rules and OpDefs may load from different
+    modules); re-registration replaces."""
+    _SHARDING_RULES[name] = rule
+    return rule
+
+
+def sharding_rule(name):
+    """The registered sharding rule for op type ``name``, or None (the
+    analyzer then applies its conservative default)."""
+    return _SHARDING_RULES.get(name)

@@ -1083,3 +1083,50 @@ def unique_with_counts(x, out_idx=dtypes_mod.int32, name=None):
     np_idx = dtypes_mod.as_dtype(out_idx).np_dtype
     return (constant(vals), constant(idx.astype(np_idx)),
             constant(counts.astype(np_idx)))
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.passthrough_rule,
+                      "Identity", "Snapshot", "StopGradient",
+                      "PreventGradient", "CheckNumerics", "ZerosLike",
+                      "OnesLike")
+# shape introspection reads metadata, not data: no gather of the operand
+_shard.register_rules(_shard.local_rule, "Shape", "Size", "Rank",
+                      "BroadcastArgs", "InvertPermutation",
+                      "SequenceMask", "Fill")
+_shard.register_rules(_shard.reshape_rule, "Reshape")
+_shard.register_rules(_shard.transpose_rule, "Transpose",
+                      "ConjugateTranspose")
+_shard.register_rules(_shard.expand_dims_rule, "ExpandDims")
+_shard.register_rules(_shard.squeeze_rule, "Squeeze")
+_shard.register_rules(_shard.make_concat_rule("axis"), "Concat")
+_shard.register_rules(_shard.make_stack_rule("axis"), "Pack")
+_shard.register_rules(_shard.make_unstack_rule("axis"), "Unpack")
+_shard.register_rules(_shard.make_axis_unsharded_rule("axis"), "Split")
+_shard.register_rules(_shard.make_slice_rule(),
+                      "Slice", "StridedSlice", "Pad", "MirrorPad", "Tile",
+                      "Reverse", "ReverseSequence", "BroadcastTo",
+                      "MatrixBandPart", "MatrixSetDiag")
+_shard.register_rules(_shard.make_gather_rule("axis"), "Gather")
+_shard.register_rules(_shard.elementwise_rule, "Select")
+
+
+def _onehot_rule(op, in_specs, ctx):
+    # indices dims pass through; the new class dim is unsharded
+    s = in_specs[0]
+    r = _shard._out_rank(op)
+    if s is None or r is None:
+        return [_shard.replicated(r)]
+    ax = int(op.attrs.get("axis", -1))
+    ax = ax % r
+    out = list(s)
+    out.insert(ax, ())
+    return [tuple(out[:r])]
+
+
+_shard.register_rules(_onehot_rule, "OneHot")

@@ -436,3 +436,15 @@ def smart_cond(pred, true_fn, false_fn, name=None):
 
 class ControlFlowContext:
     """Kept for API parity; structured control flow has no frame contexts."""
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6): specs
+# flow into the branch/body FuncGraphs; loop carries iterate to a
+# fixpoint; reshards inside a body are trip-weighted (hotspot lint).
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.make_loop_rule("cond"), "Cond")
+_shard.register_rules(_shard.make_loop_rule("while"), "While")

@@ -136,3 +136,16 @@ def embedding_lookup_sparse(params, sp_ids, sp_weights,
             math_ops.sqrt(array_ops.expand_dims(sq, -1)),
             ops_mod.convert_to_tensor(1e-8, dtype=summed.dtype.base_dtype))
     raise ValueError(f"unknown combiner {combiner}")
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6): a
+# vocab-sharded table gathers via the one-hot contraction -> all-reduce
+# of the looked-up activations (the ep-sharding cost the analyzer must
+# surface before compile).
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.make_gather_rule("axis"),
+                      "EmbeddingLookupMixed")

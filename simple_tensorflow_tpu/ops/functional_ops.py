@@ -316,3 +316,14 @@ def _lower_py_func(ctx, op, inputs):
 
 op_registry.register("PyFunc", lower=_lower_py_func,
                      effects=op_registry.Effects(io=True), n_outputs=None)
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.make_loop_rule("scan"), "Scan")
+_shard.register_rules(_shard.make_loop_rule("map"), "MapFn")
+_shard.register_rules(_shard.make_loop_rule("fold"), "Foldl", "Foldr")

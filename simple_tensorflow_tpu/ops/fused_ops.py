@@ -85,3 +85,66 @@ def quantized_matmul(x, wq, w_scale, *, name=None):
                      name=name or "quant_matmul",
                      output_specs=[(shape_mod.TensorShape([m, n]), x.dtype)])
     return op.outputs[0]
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+
+def _flash_attention_rule(op, in_specs, ctx):
+    # (B, H, S, D): batch/head sharding flows through (GSPMD partitions
+    # attention per batch/head shard); a sharded seq or head_dim would
+    # need ring/halo communication the fused kernel does not do, so
+    # those dims are consumed gathered (ring_attention is the sp path).
+    sq = in_specs[0]
+    if sq is None:
+        return [None]
+    joined = sq
+    for s in in_specs[1:3]:
+        if s is not None and len(s) == len(sq):
+            joined = ctx.join(joined, s)
+    out = tuple(e if d < 2 else ()
+                for d, e in enumerate(joined or sq))
+    for i in range(min(3, len(in_specs))):
+        s = in_specs[i]
+        if s is not None and len(s) == len(out) and s != out:
+            ctx.require(i, out)
+    return [out]
+
+
+_shard.register_rules(_flash_attention_rule, "FlashAttention",
+                      "FlashAttentionDropout")
+
+
+def _fused_layer_norm_rule(op, in_specs, ctx):
+    # normalizes the last (feature) axis: x's spec is preserved; a
+    # sharded feature dim costs an all-reduce of the per-row mean/var
+    # (2 floats/row); gamma/beta must match x's feature sharding
+    sx = in_specs[0]
+    if sx is None or not sx:
+        return [sx for _ in op.outputs]
+    red = tuple(a for a in sx[-1] if ctx.mesh_axes.get(a, 1) > 1)
+    if red:
+        out_t = op.outputs[0]
+        dims = _shard._dims_of(out_t)
+        feat = (dims[-1] or 1) if dims else 1
+        ctx.collective(
+            "all-reduce", red,
+            2.0 * _shard.tensor_bytes(out_t) / max(feat, 1)
+            / ctx.shard_factor(sx),
+            note="layer-norm stats over sharded feature dim",
+            tensor_name=out_t.name)
+    for i in (1, 2):
+        if i < len(in_specs) and in_specs[i] is not None \
+                and len(in_specs[i]) == 1 and in_specs[i][0] != sx[-1]:
+            ctx.require(i, (sx[-1],))
+    return [sx for _ in op.outputs]
+
+
+_shard.register_rules(_fused_layer_norm_rule, "FusedLayerNorm")
+_shard.register_rules(_shard.make_last_dim_reduce_rule(),
+                      "FusedSoftmaxXent")
+_shard.register_rules(_shard.matmul_rule, "QuantMatMul")

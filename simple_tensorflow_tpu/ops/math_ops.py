@@ -1080,3 +1080,45 @@ def sparse_segment_mean(data, indices, segment_ids, name=None):
 
 def sparse_segment_sqrt_n(data, indices, segment_ids, name=None):
     return _sparse_segment_api(data, indices, segment_ids, "sqrt_n", name)
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6) — declared
+# alongside the op registrations above, same contract as abstract-eval:
+# this module knows the math ops' semantics, so it declares how
+# PartitionSpecs flow through them.
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(
+    _shard.elementwise_rule,
+    # unary
+    "Neg", "Abs", "Sign", "Reciprocal", "Square", "Sqrt", "Rsqrt", "Exp",
+    "Expm1", "Log", "Log1p", "Sin", "Cos", "Tan", "Asin", "Acos", "Atan",
+    "Sinh", "Cosh", "Tanh", "Asinh", "Acosh", "Atanh", "Sigmoid", "Erf",
+    "Erfc", "Lgamma", "Digamma", "Floor", "Ceil", "Rint", "Round",
+    "IsNan", "IsInf", "IsFinite", "LogicalNot", "Invert", "Real", "Imag",
+    "Conj", "Angle", "Softplus", "Softsign", "Cast", "ComplexAbs",
+    # binary / n-ary (numpy broadcasting)
+    "Add", "Sub", "Mul", "Div", "TrueDiv", "RealDiv", "FloorDiv",
+    "TruncateDiv", "Mod", "FloorMod", "TruncateMod", "Pow", "Maximum",
+    "Minimum", "SquaredDifference", "Atan2", "Xlogy", "Xdivy", "Zeta",
+    "Polygamma", "Igamma", "Igammac", "Betainc", "LogicalAnd",
+    "LogicalOr", "LogicalXor", "BitwiseAnd", "BitwiseOr", "BitwiseXor",
+    "LeftShift", "RightShift", "Equal", "NotEqual", "Less", "LessEqual",
+    "Greater", "GreaterEqual", "ApproximateEqual", "AddN", "ClipByValue",
+    "Complex", "Cross", "NextAfter")
+
+_shard.register_rules(_shard.make_reduce_rule("axis", "keepdims"),
+                      "Sum", "Mean", "Prod", "Max", "Min", "All", "Any",
+                      "LogSumExp", "EuclideanNorm", "ArgMax", "ArgMin",
+                      "L2Loss")
+_shard.register_rules(_shard.matmul_rule, "MatMul", "BatchMatMul",
+                      "SparseMatMul")
+_shard.register_rules(_shard.einsum_rule, "Einsum")
+_shard.register_rules(_shard.make_axis_unsharded_rule("axis"),
+                      "Cumsum", "Cumprod")
+# host-small / index-producing results: sharded inputs are consumed
+# as-is (the result is metadata-sized, not a gather of the operand)
+_shard.register_rules(_shard.local_rule, "Range", "LinSpace", "Bincount")

@@ -340,3 +340,14 @@ def sampled_softmax_loss(weights, biases, labels, inputs, num_sampled,
         sampled_values, subtract_log_q=True, name=name)
     return nn_ops.softmax_cross_entropy_with_logits(labels=labels_out,
                                                     logits=logits)
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.make_reduce_rule("axes", "keepdims"),
+                      "Moments")
+_shard.register_rules(_shard.batchnorm_rule, "FusedBatchNorm")

@@ -1129,3 +1129,48 @@ def depthwise_conv2d_native_backprop_input(input_sizes, filter,  # noqa: A002
     (gx,) = grads_mod.gradients(
         y, [x0], grad_ys=[ops_mod.convert_to_tensor(out_backprop)])
     return gx
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.elementwise_rule,
+                      "Relu", "Relu6", "Elu", "Selu", "Gelu", "LeakyRelu",
+                      "Swish")
+_shard.register_rules(_shard.make_softmax_rule("axis"),
+                      "Softmax", "LogSoftmax")
+_shard.register_rules(_shard.make_last_dim_reduce_rule(),
+                      "SoftmaxCrossEntropyWithLogits",
+                      "SparseSoftmaxCrossEntropyWithLogits", "InTopK")
+_shard.register_rules(_shard.make_conv_rule(2),
+                      "Conv2D", "DepthwiseConv2dNative", "Conv2DBackpropInput",
+                      "Dilation2D", "Erosion2D")
+_shard.register_rules(_shard.make_conv_rule(3), "Conv3D",
+                      "Conv3DBackpropInput")
+_shard.register_rules(_shard.make_pool_rule(),
+                      "MaxPool", "AvgPool", "MaxPool3D", "AvgPool3D",
+                      "LRN", "PoolV2", "MaxPoolWithArgmax")
+_shard.register_rules(_shard.passthrough_rule, "Dropout")
+_shard.register_rules(_shard.make_axis_unsharded_rule("axis", -1),
+                      "TopKV2")
+
+
+def _biasadd_rule(op, in_specs, ctx):
+    # the bias aligns with the channel dim (last, or dim 1 under NCHW)
+    sx, sb = in_specs[0], in_specs[1] if len(in_specs) > 1 else None
+    if sx is None:
+        return [None]
+    chan = 1 if op.attrs.get("data_format") == "NCHW" else len(sx) - 1
+    out = list(sx)
+    if sb is not None and len(sb) == 1:
+        if sb[0] and not out[chan]:
+            out[chan] = sb[0]
+        elif sb[0] != out[chan]:
+            ctx.require(1, (out[chan],))
+    return [_shard._dedupe_axes(tuple(out))]
+
+
+_shard.register_rules(_biasadd_rule, "BiasAdd")

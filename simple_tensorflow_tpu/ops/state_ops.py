@@ -324,3 +324,17 @@ def scatter_nd_add(ref, indices, updates, use_locking=True, name=None):
 
 def scatter_nd_sub(ref, indices, updates, use_locking=True, name=None):
     return _scatter("ScatterNdSub", ref, indices, updates, name)
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6): writes
+# commit at the variable's declared sharding; mismatched values reshard
+# on the way in.
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+_shard.register_rules(_shard.make_assign_rule(0),
+                      "Assign", "AssignAdd", "AssignSub")
+_shard.register_rules(_shard.local_rule, "ScatterNdUpdate",
+                      "IsVariableInitialized", "CountUpTo")

@@ -4,8 +4,8 @@ the reference's distributed runtime; see SURVEY.md L5)."""
 from .mesh import Mesh, PartitionSpec, P, current_mesh, make_mesh, CANONICAL_AXES
 from .api import (
     shard_variables_along, shard_variable, shard_feed,
-    with_sharding_constraint, num_devices, process_index, process_count,
-    is_chief,
+    with_sharding_constraint, match_partition_rules, num_devices,
+    process_index, process_count, is_chief,
 )
 from .collectives import (
     all_reduce, all_gather, reduce_scatter, all_to_all, ppermute,

@@ -153,3 +153,98 @@ def process_count() -> int:
 
 def is_chief() -> bool:
     return process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation (stf.analysis.sharding; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def _sharding_constraint_rule(op, in_specs, ctx):
+    from ..analysis import sharding as _shard
+
+    t = op.outputs[0]
+    spec = _shard.normalize_spec(op.attrs.get("spec"), t.shape.rank)
+    if spec is None:
+        return [in_specs[0]]
+    ctx.require(0, spec)
+    return [spec]
+
+
+def _sharding_constraint_backward(op, out_specs, in_specs, ctx):
+    # the constraint's spec propagates upstream through weakly-typed
+    # producers, so a mid-graph constraint seeds both directions
+    return [out_specs[0]]
+
+
+_sharding_constraint_rule.backward = _sharding_constraint_backward
+_sharding_constraint_rule.seeds_outputs = True
+op_registry.register_sharding_rule("ShardingConstraint",
+                                   _sharding_constraint_rule)
+
+
+def match_partition_rules(rules, variable_store=None, on_missing="replicate",
+                          apply=False, mesh=None):
+    """Regex name-pattern -> PartitionSpec mapping over variables
+    (SNIPPETS.md [2] exemplar: the fmengine/EasyLM idiom).
+
+    ``rules``: sequence of ``(pattern, spec)`` pairs; the FIRST pattern
+    to ``re.search`` a variable's store name wins. ``spec`` is a
+    PartitionSpec-like (P(...), tuple, list — None entries replicate a
+    dim). Scalars and single-element variables always replicate.
+
+    ``variable_store``: where to find variables — a dict name->Variable,
+    an iterable of Variables, or None for the default graph's global
+    variables. ``on_missing``: "replicate" (default) maps unmatched
+    variables to P(); "error" raises (the strict EasyLM contract);
+    "skip" leaves them out of the result.
+
+    Returns ``{store_name: spec}`` — exactly the ``seed_specs`` shape
+    ``analysis.analyze_sharding`` takes, so a rule set can be CHECKED
+    against the graph (collective bytes, lint findings) before paying a
+    compile. ``apply=True`` also commits each matched spec via
+    ``Variable.set_sharding`` (the Session then places state with it).
+    """
+    import re
+
+    if variable_store is None:
+        from ..ops import variables as variables_mod
+
+        variable_store = variables_mod.global_variables()
+    if isinstance(variable_store, dict):
+        items = list(variable_store.items())
+    else:
+        items = []
+        for v in variable_store:
+            name = getattr(v, "var_name", None) or getattr(v, "name", "")
+            items.append((name, v))
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    out = {}
+    for name, var in items:
+        shape = getattr(var, "shape", None)
+        dims = shape.as_list() if shape is not None and \
+            shape.rank is not None else None
+        n = 1
+        for d in (dims or []):
+            n *= (d or 1)
+        if dims is not None and (len(dims) == 0 or n <= 1):
+            out[name] = P()
+            continue
+        matched = None
+        for rx, spec in compiled:
+            if rx.search(name) is not None:
+                matched = P(*spec) if not isinstance(spec, PartitionSpec) \
+                    else spec
+                break
+        if matched is None:
+            if on_missing == "error":
+                raise ValueError(
+                    f"match_partition_rules: no rule matches variable "
+                    f"{name!r} (add a catch-all ('.*', P()) rule or pass "
+                    "on_missing='replicate')")
+            if on_missing == "skip":
+                continue
+            matched = P()
+        out[name] = matched
+        if apply and hasattr(var, "set_sharding"):
+            var.set_sharding(matched)
+    return out

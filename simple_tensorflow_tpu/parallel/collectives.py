@@ -350,3 +350,116 @@ def shard_map(fn, inputs, in_specs, out_specs, mesh=None, name=None):
                        name=name or "shard_map", output_specs=out_spec_list)
     outs = builtins.list(node.outputs)
     return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6): explicit
+# collectives report their own traffic; under GSPMD AllReduce is the
+# identity on an already-global value (see module docstring), so only
+# the layout-changing ops cost anything.
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+
+def _allreduce_rule(op, inp, ctx):
+    return [inp[0]]
+
+
+_shard.register_rules(_allreduce_rule, "AllReduce")
+
+
+def _allgather_rule(op, inp, ctx):
+    s = inp[0]
+    if s is None:
+        return [None]
+    out = _shard.replicated(len(s))
+    axes = tuple(a for a in _shard.spec_axes(s)
+                 if ctx.mesh_axes.get(a, 1) > 1)
+    if axes:
+        ctx.collective("all-gather", axes,
+                       _shard.tensor_bytes(op.outputs[0]),
+                       tensor_name=op.outputs[0].name)
+    return [out]
+
+
+_shard.register_rules(_allgather_rule, "AllGather")
+
+
+def _reduce_scatter_rule(op, inp, ctx):
+    s = inp[0]
+    if s is None:
+        return [None]
+    dim = int(op.attrs.get("axis_index", 0))
+    axes = tuple(op.attrs.get("axes", ()))
+    out = list(_shard.replicated(len(s)))
+    if dim < len(out):
+        out[dim] = tuple(axes)
+    out_spec = _shard._dedupe_axes(tuple(out))
+    live = tuple(a for a in axes if ctx.mesh_axes.get(a, 1) > 1)
+    if live:
+        ctx.collective("all-reduce", live,
+                       _shard.tensor_bytes(op.outputs[0])
+                       / ctx.shard_factor(out_spec),
+                       note="reduce-scatter",
+                       tensor_name=op.outputs[0].name)
+    return [out_spec]
+
+
+_shard.register_rules(_reduce_scatter_rule, "ReduceScatter")
+
+
+def _all_to_all_rule(op, inp, ctx):
+    s = inp[0]
+    axes = tuple(op.attrs.get("axes", ()))
+    live = tuple(a for a in axes if ctx.mesh_axes.get(a, 1) > 1)
+    out_rank = _shard._out_rank(op)
+    if live:
+        ctx.collective("all-to-all", live,
+                       _shard.tensor_bytes(op.inputs[0])
+                       / max(ctx.axis_size(live), 1),
+                       tensor_name=op.outputs[0].name)
+    return [_shard.replicated(out_rank)]
+
+
+_shard.register_rules(_all_to_all_rule, "AllToAll")
+
+
+def _ppermute_rule(op, inp, ctx):
+    axes = tuple(op.attrs.get("axes", ()))
+    live = tuple(a for a in axes if ctx.mesh_axes.get(a, 1) > 1)
+    if live:
+        ctx.collective("collective-permute", live,
+                       _shard.tensor_bytes(op.inputs[0])
+                       / ctx.shard_factor(inp[0] or ()),
+                       tensor_name=op.outputs[0].name)
+    return [inp[0]]
+
+
+_shard.register_rules(_ppermute_rule, "CollectivePermute")
+_shard.register_rules(_shard.local_rule, "AxisIndex")
+
+
+def _shard_map_rule(op, inp, ctx):
+    # the op's declared in/out specs ARE the layout contract: inputs
+    # reshard to in_specs, outputs emerge at out_specs; the body is
+    # explicit SPMD (user-written collectives) and is not re-analyzed.
+    n_args = int(op.attrs.get("n_args", len(op.inputs)))
+    in_specs = op.attrs.get("in_specs", ())
+    for i in range(min(n_args, len(in_specs))):
+        t = op.inputs[i]
+        if t.shape.rank is not None:
+            ctx.require(i, _shard.normalize_spec(in_specs[i],
+                                                 t.shape.rank))
+    outs = []
+    out_specs = op.attrs.get("out_specs", ())
+    for i, t in enumerate(op.outputs):
+        spec = out_specs[i] if i < len(out_specs) else None
+        outs.append(_shard.normalize_spec(spec, t.shape.rank)
+                    if spec is not None else _shard.replicated(
+                        t.shape.rank))
+    return outs
+
+
+_shard_map_rule.seeds_outputs = True
+_shard.register_rules(_shard_map_rule, "ShardMap")

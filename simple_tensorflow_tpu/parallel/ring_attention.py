@@ -202,3 +202,38 @@ def ring_attention(q, k, v, *, axis="sp", causal=False, sm_scale=None,
                "sm_scale": None if sm_scale is None else float(sm_scale)},
         name=name or "ring_attention", output_specs=[(q.shape, q.dtype)])
     return node.outputs[0]
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rule (stf.analysis.sharding; ISSUE 6): the op IS
+# the sequence-parallel path — q/k/v stay S-sharded over ``axis`` and
+# the kernel rings k/v shards with collective-permutes (one per ring
+# step; the HLO while body materializes the instruction once, so the
+# comparable payload is one shard of k plus one of v).
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+
+def _ring_attention_rule(op, in_specs, ctx):
+    axis = op.attrs.get("axis", "sp")
+    n = ctx.axis_size(axis)
+    sq = in_specs[0]
+    if n > 1:
+        kb = _shard.tensor_bytes(op.inputs[1]) if len(op.inputs) > 1 else 0
+        vb = _shard.tensor_bytes(op.inputs[2]) if len(op.inputs) > 2 else 0
+        ctx.collective("collective-permute", (axis,), (kb + vb) / n,
+                       note="ring k/v shard rotation",
+                       tensor_name=op.outputs[0].name)
+        # q/k/v ride S-sharded over the ring axis (B, H, S, D)
+        if sq is not None and len(sq) == 4:
+            want = tuple(((axis,) if d == 2 else e)
+                         for d, e in enumerate(sq))
+            for i in range(min(3, len(in_specs))):
+                if in_specs[i] is not None and in_specs[i] != want:
+                    ctx.require(i, want)
+            return [want]
+    return [sq]
+
+
+_shard.register_rules(_ring_attention_rule, "RingAttention")

@@ -2,7 +2,9 @@
 
     python -m simple_tensorflow_tpu.tools.graph_lint graphdef.json \
         [--fetch op_or_tensor ...] [--severity code=level ...] \
-        [--level structural|full] [--json]
+        [--level structural|full] [--json] \
+        [--mesh 8|2x4|dp=2,tp=4] [--rules rules.json] \
+        [--max-severity note|warning|error]
 
 Runs the stf.analysis stack over a GraphDef written by
 ``stf.train.write_graph`` / ``graph_io.write_graph``:
@@ -14,12 +16,20 @@ Runs the stf.analysis stack over a GraphDef written by
   2. import into a fresh Graph, then ``analyze`` — live verifier (full
      level by default, including abstract-eval shape/dtype re-checks),
      per-fetch variable-hazard detection, and the lint rule catalog.
+  3. with ``--mesh``, the sharding analyzer (stf.analysis.sharding)
+     runs over an ABSTRACT mesh — no devices needed, so a dp8 graph
+     lints on a 1-CPU CI box. ``--rules rules.json`` seeds variable
+     shardings from regex partition rules (the
+     ``match_partition_rules`` format: ``[[pattern, [spec...]], ...]``
+     with null = replicate a dim), letting a rule set be checked
+     BEFORE paying a compile.
 
 Diagnostics carry the op's original creation site when the GraphDef
 recorded one (graph_io serializes the innermost user frame). Exit code
-1 when any ERROR-severity diagnostic survives, else 0 — suitable as a
-CI gate (tests/test_graph_lint_clean.py uses the same entry points
-in-process).
+1 when any diagnostic reaches ``--max-severity`` (default: error), so
+CI can gate at warning level for sharding hygiene. ``--json`` emits one
+JSON object per diagnostic plus a trailing ``summary`` record
+(collective bytes by kind, per-shard peak HBM) for machine consumption.
 """
 
 from __future__ import annotations
@@ -30,15 +40,16 @@ import sys
 
 
 def run_lint(graph_def: dict, fetch_names=None, severities=None,
-             level: str = "full"):
-    """Programmatic entry: returns (diagnostics, imported_graph|None)."""
+             level: str = "full", mesh=None, partition_rules=None):
+    """Programmatic entry: returns (diagnostics, imported_graph|None,
+    sharding_report|None)."""
     from .. import analysis
     from ..framework import graph as graph_mod
     from ..framework import graph_io
 
     diags = analysis.verify_graphdef(graph_def)
     if analysis.errors(diags):
-        return diags, None
+        return diags, None, None
     graph = graph_mod.Graph()
     with graph.as_default():
         graph_io.import_graph_def(graph_def, name="")
@@ -54,7 +65,25 @@ def run_lint(graph_def: dict, fetch_names=None, severities=None,
                    f"--fetch {name!r}: {e}")
     diags.extend(analysis.analyze(graph, fetches=fetches or None,
                                   level=level, severities=severities))
-    return diags, graph
+    report_obj = None
+    if mesh:
+        seeds = None
+        if partition_rules:
+            from ..parallel.api import match_partition_rules
+
+            # an imported GraphDef has VariableV2 OPS, not Variable
+            # objects: match over the ops' output tensors (shape is all
+            # the matcher needs; seeds feed the analyzer by store name)
+            store = {op.attrs.get("var_name", op.name): op.outputs[0]
+                     for op in graph.get_operations()
+                     if op.type == "VariableV2" and op.outputs}
+            seeds = match_partition_rules(partition_rules, store)
+        report_obj = analysis.analyze_sharding(
+            graph=graph, mesh=mesh, seed_specs=seeds,
+            fetches=fetches or None, with_peak=bool(fetches),
+            severities=severities)
+        diags.extend(report_obj.diagnostics)
+    return diags, graph, report_obj
 
 
 def main(argv=None):
@@ -74,7 +103,21 @@ def main(argv=None):
     ap.add_argument("--level", choices=["structural", "full"],
                     default="full", help="verifier depth (default full)")
     ap.add_argument("--json", action="store_true",
-                    help="emit diagnostics as JSON lines")
+                    help="emit diagnostics as JSON lines (+ a trailing "
+                         "summary record)")
+    ap.add_argument("--mesh", default=None, metavar="SPEC",
+                    help="run the sharding analyzer over an abstract "
+                         "mesh: '8' (dp=8), '2x4' (dp=2,tp=4), or "
+                         "'dp=2,tp=4'")
+    ap.add_argument("--rules", default=None, metavar="RULES_JSON",
+                    help="partition-rule file: JSON [[pattern, "
+                         "[spec entries]], ...]; seeds variable "
+                         "shardings for --mesh analysis "
+                         "(match_partition_rules format)")
+    ap.add_argument("--max-severity", default="error",
+                    choices=["note", "warning", "error"],
+                    help="exit nonzero when any diagnostic reaches this "
+                         "severity (default: error)")
     args = ap.parse_args(argv)
 
     from ..analysis.diagnostics import SEVERITIES
@@ -89,20 +132,52 @@ def main(argv=None):
                      f"{SEVERITIES + ('off',)}, got {v!r}")
         severities[k] = v
 
+    mesh = None
+    if args.mesh:
+        from ..analysis.sharding import parse_mesh_arg
+
+        try:
+            mesh = parse_mesh_arg(args.mesh)
+        except (ValueError, TypeError) as e:
+            ap.error(f"--mesh {args.mesh!r}: {e}")
+    partition_rules = None
+    if args.rules:
+        if not mesh:
+            ap.error("--rules requires --mesh")
+        with open(args.rules) as f:
+            raw = json.load(f)
+        partition_rules = [(pat, tuple(spec)) for pat, spec in raw]
+
     with open(args.graphdef) as f:
         gd = json.load(f)
 
     from .. import analysis
 
-    diags, _graph = run_lint(gd, fetch_names=args.fetch,
-                             severities=severities, level=args.level)
+    diags, _graph, report = run_lint(gd, fetch_names=args.fetch,
+                                     severities=severities,
+                                     level=args.level, mesh=mesh,
+                                     partition_rules=partition_rules)
     if args.json:
         for d in diags:
             print(json.dumps(d.to_dict()))
+        if report is not None:
+            print(json.dumps({"summary": report.summary()}))
     else:
         print(analysis.format_report(
             diags, header=f"graph_lint {args.graphdef}:"))
-    return 1 if analysis.errors(diags) else 0
+        if report is not None:
+            s = report.summary()
+            print(f"sharding: {s['n_collective_edges']} collective "
+                  f"edge(s), {int(s['total_collective_bytes'])} "
+                  f"predicted bytes/step "
+                  f"{s['bytes_by_kind']}"
+                  + (f", per-shard peak "
+                     f"{int(s['per_shard_peak_bytes'])} bytes"
+                     if s.get("per_shard_peak_bytes") else ""))
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    threshold = order[args.max_severity]
+    worst = max((order.get(d.severity, 0) for d in diags), default=-1)
+    return 1 if worst >= threshold else 0
 
 
 if __name__ == "__main__":

@@ -96,7 +96,8 @@ def _capture_host_state(sess):
 
         state["iterators"] = {
             name: it.save_state()
-            for name, it in dataset_mod._ITERATORS.items()}
+            for name, it in dataset_mod.iterator_registry(
+                sess.graph).items()}
     except Exception:  # noqa: BLE001 — data module optional at save time
         pass
     return state
@@ -112,7 +113,7 @@ def _restore_host_state(sess, host_state):
         from ..data import dataset as dataset_mod
 
         for name, st in iterators.items():
-            it = dataset_mod._ITERATORS.get(name)
+            it = dataset_mod.iterator_registry(sess.graph).get(name)
             if it is not None:
                 it.restore_state(st)
 

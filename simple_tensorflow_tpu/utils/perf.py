@@ -143,6 +143,76 @@ def memory_of(compiled) -> Dict[str, int]:
     return out
 
 
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# `%name = SHAPE all-reduce(...)` (async variants emit -start/-done
+# pairs; only -start carries the payload — -done's trailing "(" will not
+# match the pattern, so pairs count once)
+_HLO_COLLECTIVE_RE = None
+
+
+def collective_bytes_of(compiled) -> Dict[str, float]:
+    """Per-kind payload bytes of the collective instructions in a
+    compiled executable's (partitioned) HLO — the machine-checkable
+    comparator for the sharding analyzer's predicted collective bytes
+    (stf.analysis.sharding; the bench asserts the two within 25%).
+
+    Sums the RESULT shape bytes of every all-reduce / all-gather /
+    all-to-all / collective-permute / reduce-scatter instruction.
+    Sync tuple-shaped results (variadic collectives) sum their leaves;
+    an async ``-start``'s tuple is (operand, result[, u32 contexts]),
+    so only the result leaf counts — summing it whole would tally the
+    payload twice. Returns {} when the backend exposes no HLO text."""
+    import re
+
+    global _HLO_COLLECTIVE_RE
+    if _HLO_COLLECTIVE_RE is None:
+        _HLO_COLLECTIVE_RE = re.compile(
+            r"=\s+((?:\([^)]*\))|(?:\S+))\s+"
+            r"(all-reduce|all-gather|all-to-all|collective-permute|"
+            r"reduce-scatter)(-start)?\(")
+    texts = []
+    try:
+        mods = compiled.hlo_modules()
+        texts = [m.to_string() for m in mods]
+    except Exception:
+        try:
+            texts = [compiled.as_text()]
+        except Exception:
+            return {}
+    shape_re = re.compile(r"([a-z]+[0-9]*[a-z0-9]*)\[([0-9,]*)\]")
+    out: Dict[str, float] = {}
+    for text in texts:
+        for m in _HLO_COLLECTIVE_RE.finditer(text):
+            shape_txt, kind, is_start = (m.group(1), m.group(2),
+                                         m.group(3))
+            leaves = []
+            for sm in shape_re.finditer(shape_txt):
+                dt = _HLO_DTYPE_BYTES.get(sm.group(1))
+                if dt is None:
+                    continue
+                n = 1
+                dims = sm.group(2)
+                if dims:
+                    for d in dims.split(","):
+                        n *= int(d)
+                leaves.append(n * dt)
+            if is_start and len(leaves) >= 2:
+                nbytes = float(leaves[1])
+            else:
+                nbytes = float(sum(leaves))
+            if nbytes:
+                out[kind] = out.get(kind, 0.0) + nbytes
+    if out:
+        out["total"] = sum(out.values())
+    return out
+
+
 def mfu(step_flops: float, step_seconds: float, device=None) -> float:
     """Model FLOPs Utilization: achieved/peak."""
     peak, _ = chip_spec(device)

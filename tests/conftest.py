@@ -16,3 +16,49 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, jax.devices()
+
+import gc  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_pipeline_leaks():
+    """Leak hygiene (ISSUE 6 satellite): after each test module, no
+    pipeline stage threads may still be running and every
+    PipelineIterator constructed by the module must be closed. Long
+    analyzer test sessions would otherwise mask PR 5 teardown bugs —
+    an unclosed iterator pins its stage threads and ring buffers until
+    GC happens to run."""
+    yield
+    from simple_tensorflow_tpu.data import pipeline
+
+    # dropped-but-uncollected iterators are not leaks: GC close is part
+    # of the contract, so drive it before judging
+    gc.collect()
+    open_iters = [it for it in list(pipeline.live_iterators)
+                  if not it.closed]
+    for it in open_iters:  # don't poison subsequent modules
+        it.close()
+
+    # stage threads are named stf_data_<stage>; the shared worker pool
+    # (thread_name_prefix stf_data_worker) is process-global by design
+    # and exempt. Closed stages may need a moment to observe cancel.
+    def stray():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("stf_data_")
+                and not t.name.startswith("stf_data_worker")
+                and t.is_alive()]
+
+    deadline = time.monotonic() + 5.0
+    while stray() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    leaked = stray()
+    assert not open_iters, (
+        "unclosed PipelineIterator(s) leaked by this test module "
+        f"(close() them or drop all references): {open_iters!r}")
+    assert not leaked, (
+        "leaked pipeline stage thread(s): "
+        + ", ".join(t.name for t in leaked))

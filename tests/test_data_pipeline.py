@@ -294,6 +294,7 @@ class TestIteratorCheckpointParallel:
             np.testing.assert_array_equal(got, want)
         state = it.save_state()
         assert state == {"position": 2}
+        it.close()  # abandoning the half-consumed stream leaks it
 
         it2 = stf_data.Iterator(mk())
         it2.restore_state(state)

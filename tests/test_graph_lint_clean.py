@@ -43,8 +43,14 @@ ALLOWED_NOTES = {"lint/narrow-64bit", "verifier/unreachable-stateful",
 
 
 def _analyze(model_key, fetches):
+    # mesh={'dp': 1} also runs the sharding analyzer (ISSUE 6
+    # satellite): every op type in the zoo gets its propagation rule
+    # executed — a rule that raises surfaces as a sharding/rule-error
+    # note, an op consumed conservatively as sharding/no-rule — so rule
+    # gaps show up op-by-op in the snapshot diff, while the 1-device
+    # mesh keeps the gate hermetic (no collectives, no real sharding).
     diags = analysis.analyze(stf.get_default_graph(), fetches=fetches,
-                             level="full")
+                             level="full", mesh={"dp": 1})
     errs = analysis.errors(diags)
     assert errs == [], (
         f"{model_key}: analysis errors:\n"
@@ -164,8 +170,9 @@ def test_graph_lint_cli_clean_on_model_graphdef(tmp_path):
     p = tmp_path / "mnist_softmax.json"
     p.write_text(json.dumps(gd))
     stf.reset_default_graph()
-    diags, graph = graph_lint.run_lint(
+    diags, graph, report = graph_lint.run_lint(
         json.loads(p.read_text()),
         fetch_names=[m["train_op"].name, m["loss"].name])
     assert graph is not None
+    assert report is None  # no --mesh: sharding analysis not requested
     assert analysis.errors(diags) == []
