@@ -1029,6 +1029,171 @@ def _measure_input_pipeline(platform, device_kind):
     }
 
 
+def _measure_serving(platform, device_kind):
+    """Serving row (ISSUE 7 tentpole): QPS + p50/p99 latency under
+    synthetic concurrent CLOSED-LOOP load (each client issues its next
+    request when the previous response materializes), continuous
+    batching (stf.serving.ModelServer: AOT-per-bucket, coalescing
+    batcher) vs the batch=1 sequential baseline (the pre-PR idiom: one
+    Session.run per request, 16 client threads contending for the
+    session). Interleaved median of BENCH_SERVING_ROUNDS (default 5)
+    rounds (CPU wall-clock swings ~2x run to run). The acceptance bar
+    is batched >= 3x baseline QPS at >= 16 clients."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import saved_model as sm
+    from simple_tensorflow_tpu import serving
+    from simple_tensorflow_tpu.platform import monitoring
+
+    in_dim, hidden, classes = 128, 256, 10
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "16"))
+    measure_s = float(os.environ.get("BENCH_SERVING_SECONDS", "2.0"))
+    rounds = int(os.environ.get("BENCH_SERVING_ROUNDS", "5"))
+    max_batch = 16
+    # 0.5 ms close timeout: with 16 closed-loop clients batches close
+    # full on max_batch_size; the short timeout only bounds the tail
+    # wait when the queue momentarily drains (swept 0.2-2 ms: 0.5 best)
+    batch_timeout_ms = 0.5
+
+    rng = np.random.RandomState(0)
+    x = stf.placeholder(stf.float32, [None, in_dim], name="x")
+    w1 = stf.Variable(stf.constant(
+        (rng.randn(in_dim, hidden) * 0.05).astype(np.float32)), name="w1")
+    b1 = stf.Variable(stf.constant(np.zeros(hidden, np.float32)),
+                      name="b1")
+    w2 = stf.Variable(stf.constant(
+        (rng.randn(hidden, classes) * 0.05).astype(np.float32)),
+        name="w2")
+    b2 = stf.Variable(stf.constant(np.zeros(classes, np.float32)),
+                      name="b2")
+    h = stf.tanh(stf.add(stf.matmul(x, w1), b1))
+    probs = stf.nn.softmax(stf.add(stf.matmul(h, w2), b2), name="probs")
+    tmp = tempfile.mkdtemp(prefix="stf_bench_serving_")
+    export_dir = os.path.join(tmp, "model")
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sm.simple_save(sess, export_dir, inputs={"x": x},
+                       outputs={"probs": probs})
+    stf.reset_default_graph()
+    examples = rng.randn(64, in_dim).astype(np.float32)
+
+    def closed_loop(run_once, seconds):
+        """n_clients closed-loop threads for ~seconds; returns
+        (qps, p50_ms, p99_ms) over completed requests."""
+        counts = [0] * n_clients
+        lats: list = [[] for _ in range(n_clients)]
+        start_gate = threading.Barrier(n_clients + 1)
+        stop_at = [0.0]
+
+        def client(i):
+            start_gate.wait()
+            j = i
+            while time.perf_counter() < stop_at[0]:
+                t0 = time.perf_counter()
+                run_once(examples[j % len(examples)])
+                lats[i].append(time.perf_counter() - t0)
+                counts[i] += 1
+                j += n_clients
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        stop_at[0] = t0 + seconds
+        start_gate.wait()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        all_lats = np.array(sorted(sum(lats, [])))
+        total = int(sum(counts))
+        if total == 0:
+            return 0.0, 0.0, 0.0
+        return (total / wall,
+                float(np.percentile(all_lats, 50) * 1e3),
+                float(np.percentile(all_lats, 99) * 1e3))
+
+    try:
+        # batched arm: continuous batcher, AOT-warmed buckets
+        server = serving.ModelServer(policy=serving.BatchingPolicy(
+            max_batch_size=max_batch, batch_timeout_ms=batch_timeout_ms,
+            max_queue_depth=4 * max_batch))
+        server.load(export_dir, name="bench")
+
+        def run_batched(ex):
+            server.predict({"x": ex}).result(timeout=120)
+
+        # baseline arm: one batch=1 Session.run per request — the only
+        # serving story the repo had before this PR
+        base_graph = stf.Graph()
+        with base_graph.as_default():
+            base_sess = stf.Session(graph=base_graph)
+            meta = sm.loader.load(base_sess, [sm.tag_constants.SERVING],
+                                  export_dir)
+        sig = meta["signature_def"]["serving_default"]
+        xn = sig["inputs"]["x"]["name"]
+        yn = sig["outputs"]["probs"]["name"]
+
+        def run_base(ex):
+            base_sess.run(yn, {xn: ex[None, :]})
+
+        # warmup both arms outside the clock (compiles: baseline's
+        # batch-1 program; server buckets were AOT-compiled at load)
+        run_base(examples[0])
+        for _ in range(4):
+            run_batched(examples[0])
+
+        base_rounds, batched_rounds = [], []
+        for _ in range(rounds):  # interleaved so box noise hits both
+            base_rounds.append(closed_loop(run_base, measure_s))
+            batched_rounds.append(closed_loop(run_batched, measure_s))
+        base_qps = float(np.median([r[0] for r in base_rounds]))
+        batched_qps = float(np.median([r[0] for r in batched_rounds]))
+        base_med = min(base_rounds, key=lambda r: abs(r[0] - base_qps))
+        batched_med = min(batched_rounds,
+                          key=lambda r: abs(r[0] - batched_qps))
+        fill = monitoring.export().get("/stf/serving/batch_fill", {})
+        cell = (fill.get("cells") or {}).get("bench/serving_default", {})
+        fill_mean = (cell.get("sum", 0.0) / cell["count"]) \
+            if cell.get("count") else None
+        size_m = monitoring.export().get("/stf/serving/batch_size", {})
+        scell = (size_m.get("cells") or {}).get("bench/serving_default",
+                                                {})
+        size_mean = (scell.get("sum", 0.0) / scell["count"]) \
+            if scell.get("count") else None
+        base_sess.close()
+        server.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        **_monitoring_info(),
+        "metric": "serving_qps_speedup_batched_vs_batch1",
+        "value": round(batched_qps / max(base_qps, 1e-9), 2),
+        "unit": f"x (QPS, {n_clients} concurrent closed-loop clients)",
+        "vs_baseline": None,
+        "qps_batched": round(batched_qps, 1),
+        "qps_batch1": round(base_qps, 1),
+        "p50_ms_batched": round(batched_med[1], 2),
+        "p99_ms_batched": round(batched_med[2], 2),
+        "p50_ms_batch1": round(base_med[1], 2),
+        "p99_ms_batch1": round(base_med[2], 2),
+        "batch_fill_mean": round(fill_mean, 3) if fill_mean else None,
+        "batch_size_mean": round(size_mean, 2) if size_mean else None,
+        "qps_batched_rounds": [round(r[0], 1) for r in batched_rounds],
+        "qps_batch1_rounds": [round(r[0], 1) for r in base_rounds],
+        "n_clients": n_clients,
+        "max_batch_size": max_batch,
+        "batch_timeout_ms": batch_timeout_ms,
+        "measure_s": measure_s,
+        "model": f"mlp {in_dim}x{hidden}x{classes} f32",
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _measure_transformer(batch, platform, device_kind):
     """BASELINE config 5: Transformer-big WMT en-de training step +
     beam-search inference latency. Comparator 2000 tokens/sec is a
@@ -1335,6 +1500,8 @@ def child_main():
         result = _measure_loop_fusion(platform, kind)
     elif model == "input_pipeline":
         result = _measure_input_pipeline(platform, kind)
+    elif model == "serving":
+        result = _measure_serving(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -1438,7 +1605,8 @@ def _run_model(model, platform, kind, errors):
                        "transformer": "1200", "mnist": "300",
                        "analysis": "600", "sharding_analysis": "900",
                        "loop_fusion": "900",
-                       "input_pipeline": "600"}.get(
+                       "input_pipeline": "600",
+                       "serving": "900"}.get(
         model, "900")
     extra_xla_flags = ""
     if model == "loop_fusion":
@@ -1506,6 +1674,8 @@ _METRIC_NAMES = {
     "loop_fusion": ("loop_fusion_bert_amortization_n64_vs_n1",
                     "x (measured_over_predicted improvement)"),
     "input_pipeline": ("input_pipeline_records_per_sec", "records/sec"),
+    "serving": ("serving_qps_speedup_batched_vs_batch1",
+                "x (QPS, 16 concurrent closed-loop clients)"),
     "warm_start": ("warm_start_warmup_plus_compile_s",
                    "s (second process, shared persistent compile cache)"),
 }
@@ -1527,7 +1697,7 @@ def main():
     for tok in os.environ.get(
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
-            "sharding_analysis,loop_fusion,input_pipeline,"
+            "sharding_analysis,loop_fusion,input_pipeline,serving,"
             "warm_start").split(","):
         tok = tok.strip()
         if not tok:
@@ -1545,7 +1715,7 @@ def main():
         selected = ["resnet", "bert", "transformer", "mnist",
                     "resnet_dp", "graph_opt", "analysis",
                     "sharding_analysis", "loop_fusion",
-                    "input_pipeline", "warm_start"]
+                    "input_pipeline", "serving", "warm_start"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Export -> serve journey on stf.serving (docs/SERVING.md):
+
+  1. train a small MNIST-shaped softmax model for a few steps
+  2. export an INFERENCE signature as a SavedModel
+     (SavedModelBuilder runs the serving lint on SERVING exports)
+  3. ModelServer.load: import + restore, plan the signature through
+     the Session plan/execute split, AOT-compile every batch bucket
+  4. fire N concurrent closed-loop clients at server.predict and
+     report QPS, latency percentiles, and batch-fill from the
+     /stf/serving/* metric family
+
+Runs hermetically on CPU (synthetic data).
+
+Usage: python examples/serve_model.py [--clients 16] [--seconds 2.0]
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import simple_tensorflow_tpu as stf  # noqa: E402
+from simple_tensorflow_tpu import saved_model as sm  # noqa: E402
+from simple_tensorflow_tpu import serving  # noqa: E402
+
+
+def train_and_export(export_dir, steps=30):
+    rng = np.random.RandomState(0)
+    x = stf.placeholder(stf.float32, [None, 784], name="x")
+    y_ = stf.placeholder(stf.int32, [None], name="y_")
+    w = stf.Variable(stf.zeros([784, 10]), name="w")
+    b = stf.Variable(stf.zeros([10]), name="b")
+    logits = stf.add(stf.matmul(x, w), b)
+    probs = stf.nn.softmax(logits, name="probs")
+    loss = stf.reduce_mean(
+        stf.nn.sparse_softmax_cross_entropy_with_logits(
+            labels=y_, logits=logits))
+    train_op = stf.train.GradientDescentOptimizer(0.5).minimize(loss)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        for _ in range(steps):
+            xb = rng.rand(64, 784).astype(np.float32)
+            yb = (xb.sum(axis=1) % 10).astype(np.int32)
+            sess.run(train_op, {x: xb, y_: yb})
+        # export ONLY the inference signature: x -> probs (no train
+        # ops, no summaries — the serving lint would flag them)
+        sm.simple_save(sess, export_dir, inputs={"x": x},
+                       outputs={"probs": probs})
+    stf.reset_default_graph()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--timeout-ms", type=float, default=5000.0,
+                    help="per-request deadline (RunOptions semantics)")
+    args = ap.parse_args()
+
+    base = tempfile.mkdtemp(prefix="stf_serve_example_")
+    export_dir = os.path.join(base, "mnist", "1")
+    try:
+        print("1) training + exporting ...")
+        train_and_export(export_dir)
+
+        print("2) loading into ModelServer (plans + AOT buckets) ...")
+        policy = serving.BatchingPolicy(max_batch_size=args.max_batch,
+                                        batch_timeout_ms=2.0)
+        with serving.ModelServer(policy=policy) as server:
+            t0 = time.perf_counter()
+            server.load(export_dir, name="mnist")
+            print(f"   loaded in {time.perf_counter() - t0:.2f}s; "
+                  f"signatures: {server.signature_keys('mnist')}")
+
+            rng = np.random.RandomState(1)
+            examples = rng.rand(256, 784).astype(np.float32)
+            # one warm request end to end
+            probs = server.predict({"x": examples[0]}, model="mnist",
+                                   timeout_ms=5000).result(timeout=30)
+            print(f"   warm request: probs sum="
+                  f"{probs['probs'].sum():.3f}")
+
+            print(f"3) {args.clients} concurrent closed-loop clients "
+                  f"for {args.seconds:.1f}s ...")
+            counts = [0] * args.clients
+            lats = [[] for _ in range(args.clients)]
+            stop_at = time.perf_counter() + args.seconds
+
+            def client(i):
+                j = i
+                while time.perf_counter() < stop_at:
+                    t = time.perf_counter()
+                    server.predict(
+                        {"x": examples[j % len(examples)]},
+                        model="mnist",
+                        timeout_ms=args.timeout_ms) \
+                        .result(timeout=30)
+                    lats[i].append(time.perf_counter() - t)
+                    counts[i] += 1
+                    j += args.clients
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(args.clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            total = sum(counts)
+            all_l = np.sort(np.array(sum(lats, [])))
+            snap = server.stats()
+            fill = snap["/stf/serving/batch_fill"]["cells"] \
+                .get("mnist/serving_default", {})
+            fill_mean = fill.get("sum", 0.0) / max(fill.get("count", 1), 1)
+            print(f"   QPS: {total / args.seconds:.0f}   "
+                  f"p50 {np.percentile(all_l, 50) * 1e3:.2f}ms   "
+                  f"p99 {np.percentile(all_l, 99) * 1e3:.2f}ms   "
+                  f"batch fill {fill_mean:.2f}")
+            print("4) metrics snapshot keys:",
+                  ", ".join(sorted(snap)))
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

@@ -176,7 +176,7 @@ from .ops.spectral_ops import fft, ifft, fft2d, ifft2d, fft3d, ifft3d
 # client
 from .client.session import (Session, InteractiveSession,
                              get_default_session, RunOptions, RunMetadata,
-                             FetchFuture)
+                             FetchFuture, ExecutionPlan)
 
 # namespaces (tf.nn, tf.train, tf.layers, tf.summary, ...)
 from . import compiler
@@ -190,6 +190,7 @@ from . import image
 from . import data
 from . import parallel
 from . import saved_model
+from . import serving
 from . import estimator
 from . import debug
 from . import compat

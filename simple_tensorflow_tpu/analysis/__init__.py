@@ -69,12 +69,15 @@ def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
             level: str = "full",
             severities: Optional[dict] = None,
             mesh=None,
-            sharding_seeds: Optional[dict] = None) -> List[Diagnostic]:
+            sharding_seeds: Optional[dict] = None,
+            purpose: Optional[str] = None) -> List[Diagnostic]:
     """Run verifier + hazard detector + linter over a graph and return
     all diagnostics (the combined standalone entry point; the CLI and
     the models/examples CI gate call this). When ``mesh`` is given (a
     Mesh or abstract {axis: size} dict), the sharding analyzer runs too
-    and its diagnostics are included."""
+    and its diagnostics are included. ``purpose="serving"`` activates
+    the serving-compatibility lint over the fetch closure
+    (``graph_lint --serving``)."""
     from ..framework import graph as ops_mod
     from ..framework import lowering as lowering_mod
 
@@ -92,7 +95,8 @@ def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
             diagnostics.metric_hazards.get_cell(h.kind).increase_by(1)
             diagnostics.metric_diagnostics.get_cell(
                 WARNING).increase_by(1)
-    diags.extend(lint_graph(graph, fetches=fetches, severities=severities))
+    diags.extend(lint_graph(graph, fetches=fetches, severities=severities,
+                            purpose=purpose))
     if mesh is not None:
         report = analyze_sharding(graph=graph, mesh=mesh,
                                   seed_specs=sharding_seeds,

@@ -25,6 +25,14 @@ Built-in catalog (see docs/ANALYSIS.md for the worked examples):
                          where the layout pass cannot cancel them
                          (control deps / multi-consumer boundaries)
                          (WARNING)
+  lint/serving-incompatible
+                         ops that make an exported inference graph
+                         unservable under the stf.serving continuous
+                         batcher: host-stage ops, host-observable io
+                         effects (Print/logging), unseeded stateful
+                         RNG. Active only for purpose="serving" runs
+                         (``lint_graph(purpose="serving")`` /
+                         ``graph_lint --serving``) (WARNING)
 """
 
 from __future__ import annotations
@@ -43,15 +51,21 @@ class LintContext:
     """What one lint run sees: the op list (graph order), the owning
     graph, the optional fetch set, and — when the sharding analyzer ran
     — its :class:`~.sharding.ShardingReport` (the sharding lint rules
-    consult it and yield nothing without one)."""
+    consult it and yield nothing without one). ``purpose`` scopes
+    purpose-gated rules: "serving" activates the
+    serving-incompatibility checks an exported inference graph must
+    pass (a training graph legitimately fails them — dropout,
+    summaries — so they never fire by default)."""
 
     def __init__(self, graph, ops: Sequence[Any],
                  fetches: Optional[Sequence[Any]] = None,
-                 sharding_report: Optional[Any] = None):
+                 sharding_report: Optional[Any] = None,
+                 purpose: Optional[str] = None):
         self.graph = graph
         self.ops = list(ops)
         self.fetches = list(fetches or [])
         self.sharding_report = sharding_report
+        self.purpose = purpose
         self._x64 = None
 
     @property
@@ -104,16 +118,20 @@ def lint_graph(graph=None, ops: Optional[Sequence[Any]] = None,
                fetches: Optional[Sequence[Any]] = None,
                severities: Optional[Dict[str, str]] = None,
                rules: Optional[Sequence[str]] = None,
-               sharding_report: Optional[Any] = None) -> List[Diagnostic]:
+               sharding_report: Optional[Any] = None,
+               purpose: Optional[str] = None) -> List[Diagnostic]:
     """Run the registered rules. ``severities`` overrides per-code
     severity ("off" disables a rule); ``rules`` restricts to a subset;
     ``sharding_report`` feeds the sharding rules (analyze_sharding
-    passes its own report through here)."""
+    passes its own report through here); ``purpose="serving"``
+    activates the serving-compatibility rules (ModelServer.load and
+    ``graph_lint --serving`` pass it)."""
     if graph is None and ops is None:
         graph = ops_mod.get_default_graph()
     if ops is None:
         ops = graph.get_operations()
-    ctx = LintContext(graph, ops, fetches, sharding_report=sharding_report)
+    ctx = LintContext(graph, ops, fetches, sharding_report=sharding_report,
+                      purpose=purpose)
     severities = severities or {}
     diags: List[Diagnostic] = []
     for rule in registered_rules():
@@ -280,3 +298,68 @@ def _rule_transpose_pair(ctx):
                    "to identity but was not cancelled (control deps or "
                    "by-name fetches pin it); restructure so the layout "
                    "pass can cancel it")
+
+
+# op types that are pure graph inputs/values — never serving hazards
+# even though Placeholder is formally "fed on host"
+_SERVING_BENIGN_TYPES = ("Placeholder", "PlaceholderWithDefault", "Const",
+                         "NoOp")
+
+
+@register_lint_rule("serving-incompatible", WARNING)
+def _rule_serving_incompatible(ctx):
+    """Ops an exported inference graph must not contain to serve under
+    the stf.serving continuous batcher (active only for
+    ``purpose="serving"`` runs):
+
+    - host-stage ops (queues, readers, iterators, summaries, py_func):
+      each one forces a Python host stage around every coalesced batch
+      — ModelServer refuses such plans outright;
+    - host-observable io effects (``Print``, logging): they fire once
+      per BATCH, not per request, and serialize the device dispatch;
+    - stateful RNG without an op seed: responses become dependent on
+      batch composition and request arrival order (and irreproducible
+      across server restarts) — seed the op, or export an inference
+      graph without sampling (e.g. dropout at keep_prob=1 folded out).
+    """
+    if ctx.purpose != "serving":
+        return
+    ops = ctx.ops
+    if ctx.fetches:
+        from ..framework import lowering as lowering_mod
+
+        targets = [f if isinstance(f, ops_mod.Operation) else f.op
+                   for f in ctx.fetches]
+        # narrow to the fetch ancestry, but never WIDEN past the op set
+        # the caller scoped the run to: ModelServer passes the closure
+        # already pruned at the signature-INPUT boundary, and ops
+        # upstream of a fed input are not part of the serving plan
+        scoped = set(ctx.ops)
+        ops = [op for op in lowering_mod.prune(targets, set())
+               if op in scoped]
+    for op in ops:
+        if op.type in _SERVING_BENIGN_TYPES:
+            continue
+        if op.op_def.runs_on_host:
+            yield (op,
+                   f"host-stage op {op.name!r} ({op.type}) in the "
+                   "inference closure: every request batch would pay a "
+                   "Python host stage; export a pure device inference "
+                   "graph")
+            continue
+        eff = op_effects(op)
+        if eff.io:
+            yield (op,
+                   f"op {op.name!r} ({op.type}) has a host-observable "
+                   "io effect: under batching it fires once per batch, "
+                   "not per request, and blocks async dispatch; strip "
+                   "logging/Print from the exported inference graph")
+        if eff.rng and op.attrs.get("seed") is None \
+                and op.attrs.get("_graph_seed") is None \
+                and op.graph.seed is None:
+            yield (op,
+                   f"unseeded stateful RNG {op.name!r} ({op.type}) in "
+                   "the inference closure: responses depend on batch "
+                   "composition/request order and do not reproduce "
+                   "across restarts; seed it, or export without "
+                   "sampling ops")

@@ -165,19 +165,35 @@ def _block_with_deadline(values, deadline):
 
 
 def _call_step_executable(step, state, feed_args, rng_key, rng_ctr):
-    """Run the step's device program: the pinned AOT executable when one
-    exists, falling back to the jit path — and dropping the executable
-    plus its now-stale cost analysis — when the feed avals changed (the
-    AOT call rejects new shapes/dtypes with TypeError before executing,
-    so no buffers are donated on the failed attempt)."""
-    exe = step.compiled if step.compiled is not None else step.jitted
+    """Run the step's device program: a per-feed-shape AOT executable
+    from ``step.aot_cache`` (ExecutionPlan.compile fills it — the
+    serving path keeps one executable warm per batch bucket), else the
+    pinned single-slot AOT executable, else the jit path. A stale
+    executable is dropped — along with its now-stale cost analysis —
+    when the avals changed (the AOT call rejects new shapes/dtypes with
+    TypeError before executing, so no buffers are donated on the failed
+    attempt)."""
+    sig = None
+    exe = None
+    if step.aot_cache:
+        from ..compiler import aot
+
+        sig = aot.feed_signature(feed_args)
+        exe = step.aot_cache.get(sig)
+    if exe is None:
+        exe = step.compiled if step.compiled is not None else step.jitted
     try:
         return exe(dict(state), feed_args, rng_key, rng_ctr)
     except TypeError:
-        if exe is not step.compiled:
+        if exe is step.jitted:
             raise
-        step.compiled = None
-        step.xla_cost = None
+        if exe is step.compiled:
+            step.compiled = None
+            step.xla_cost = None
+        elif sig is not None:
+            # bucket executable compiled against older state avals
+            # (e.g. variables re-initialized with a new dtype)
+            step.aot_cache.pop(sig, None)
         return step.jitted(dict(state), feed_args, rng_key, rng_ctr)
 
 
@@ -435,7 +451,7 @@ class _CompiledStep:
                  "raw_post_inputs", "func_plans", "compiled", "xla_cost",
                  "feed_shardings", "fused", "fusion_diags",
                  "sharding_report", "sharding_thread",
-                 "sharding_sync_seconds", "sharding_gate")
+                 "sharding_sync_seconds", "sharding_gate", "aot_cache")
 
     def __init__(self):
         self.n_calls = 0
@@ -459,6 +475,12 @@ class _CompiledStep:
         self.feed_shardings = {}
         # (n, output_mode, xs-name-set) -> fused N-step executable
         self.fused = {}
+        # feed-shape signature -> AOT executable (compiler.aot
+        # feed_signature keys): ExecutionPlan.compile pre-compiles one
+        # per serving batch bucket so the first request of each bucket
+        # shape never pays a trace+compile. Empty on training plans —
+        # the hot path pays one truthiness check.
+        self.aot_cache = {}
         # stf.analysis.sharding per-plan report (mesh active at plan
         # time): predicted collective bytes + lint findings, surfaced
         # through RunMetadata.cost_graph["predicted_collectives"].
@@ -485,6 +507,154 @@ class _CompiledStep:
             if not th.is_alive():
                 self.sharding_thread = None
         return self.sharding_report
+
+
+class ExecutionPlan:
+    """The explicit PLAN half of ``Session.run``, as a first-class handle
+    (ref: the reference's ``GetOrCreateExecutors`` + ``_Callable`` pair,
+    core/common_runtime/direct_session.cc).
+
+    ``Session.plan(fetches, feeds)`` resolves the fetch structure and
+    plans (prune/optimize/analyze/lower) exactly once; ``execute``
+    then only stages feeds, dispatches the device program, and
+    assembles results. ``stf.serving.ModelServer`` drives these two
+    layers directly — one plan per (model, signature), one execute per
+    coalesced batch — so training and serving share a single executor
+    path instead of a serving-only runtime.
+
+    ``compile`` AOT-compiles the plan's device program for one concrete
+    feed-shape bucket ahead of traffic (compiler.aot.AotStepExecutable);
+    executions whose feed shapes match a compiled bucket skip the jit
+    retrace entirely. Thread-safety matches Session.run: concurrent
+    executes serialize their device stage on the session lock.
+    """
+
+    def __init__(self, session, mapper, feed_tensors, step, key):
+        self._session = session
+        self._mapper = mapper
+        self._step = step
+        self._key = key
+        self.feed_tensors: List[Tensor] = list(feed_tensors)
+        self._planned_set = frozenset(self.feed_tensors)
+
+    @property
+    def session(self):
+        return self._session
+
+    @property
+    def step(self) -> "_CompiledStep":
+        """The planned step (advanced introspection; owned by the
+        session's executable cache)."""
+        return self._step
+
+    @property
+    def has_host_stages(self) -> bool:
+        """Whether executions run Python host stages around the device
+        program (serving plans should be pure device: the serving lint
+        flags the offending ops)."""
+        return bool(self._step.host_plan or self._step.post_host_plan)
+
+    @property
+    def device_op_count(self) -> int:
+        return len(self._step.device_ops) if self._step.has_device_stage \
+            else 0
+
+    def compiled_buckets(self) -> List[Any]:
+        """Feed-shape signatures with a warm AOT executable."""
+        return sorted(self._step.aot_cache)
+
+    def compile(self, feed_shapes=None):
+        """AOT-compile the plan's device program for one feed-shape
+        bucket and pin it in the step's executable cache.
+
+        ``feed_shapes``: {tensor_or_name: concrete shape} overriding the
+        planned placeholder shapes (typically just the batch dim:
+        ``{x: (bucket, 784)}``). Feeds not listed must already have
+        fully static shapes. Variable avals come from the session's
+        CURRENT variable store — initialize/restore variables first.
+        Returns the :class:`~..compiler.aot.AotStepExecutable`.
+        """
+        from ..compiler import aot
+
+        sess = self._session
+        step = self._step
+        if not step.has_device_stage:
+            raise errors.InvalidArgumentError(
+                None, None,
+                "ExecutionPlan.compile: the plan has no device stage "
+                "(host-only or constant-folded fetches) — nothing to "
+                "AOT-compile")
+        shapes: Dict[Tensor, Tuple[int, ...]] = {}
+        for k, shp in (feed_shapes or {}).items():
+            t = sess._graph.as_graph_element(k, allow_tensor=True,
+                                             allow_operation=False)
+            shapes[t] = tuple(int(d) for d in shp)
+        import jax
+
+        avals: Dict[str, Any] = {}
+        for t in step.feed_tensors:
+            shp = shapes.get(t)
+            if shp is None:
+                if t.shape.rank is None or \
+                        any(d is None for d in t.shape.as_list()):
+                    raise ValueError(
+                        f"AOT feed {t.name} has dynamic shape {t.shape}; "
+                        "pass its concrete bucket shape via feed_shapes")
+                shp = tuple(t.shape.as_list())
+            elif not t.shape.is_compatible_with(shp):
+                raise ValueError(
+                    f"AOT feed shape {shp} incompatible with tensor "
+                    f"{t.name} shape {t.shape}")
+            np_dtype = dtypes_mod.narrowed_if_no_x64(
+                t.dtype.base_dtype).np_dtype
+            avals[t.name] = jax.ShapeDtypeStruct(shp, np_dtype)
+        with sess._lock:
+            rng_key = sess._ensure_base_key()
+            state = dict(sess._variable_store.values)
+        t0 = time.perf_counter()
+        with monitoring.traceme("aot_compile", n_feeds=len(avals)):
+            exe = aot.compile_step(step.jitted, state, avals, rng_key,
+                                   np.uint32(0))
+        _metric_compile_seconds.get_cell().add(time.perf_counter() - t0)
+        step.aot_cache[exe.feed_signature] = exe
+        return exe
+
+    def execute(self, feed_dict=None, options=None, as_futures=None):
+        """Run one planned step: stage feeds, dispatch, assemble — no
+        fetch mapping, no cache lookup, no re-plan.
+
+        ``options.timeout_in_ms`` bounds the blocking waits exactly like
+        ``Session.run`` (commit-then-detect DeadlineExceededError).
+        ``as_futures=True`` returns device-produced fetches as lazy
+        :class:`FetchFuture` handles regardless of
+        ConfigProto(async_fetches) — the serving batcher's response
+        path. Traced runs (RunMetadata) stay on ``Session.run``.
+        """
+        sess = self._session
+        if sess._closed:
+            raise RuntimeError("Attempted to use a closed Session.")
+        t0 = time.perf_counter()
+        _metric_runs.get_cell().increase_by(1)
+        timeout_ms = (int(getattr(options, "timeout_in_ms", 0) or 0)
+                      if options is not None else 0)
+        deadline = t0 + timeout_ms / 1000.0 if timeout_ms > 0 else None
+        feeds = sess._normalize_feeds(feed_dict)
+        planned = self._planned_set
+        if feeds.keys() != planned:
+            missing = sorted(t.name for t in planned - set(feeds))
+            extra = sorted(t.name for t in set(feeds) - planned)
+            raise errors.InvalidArgumentError(
+                None, None,
+                "ExecutionPlan.execute: feeds must match the planned "
+                f"signature (missing: {missing}, unplanned: {extra}); "
+                "build a new plan for a different feed set")
+        values = sess._execute_plan(self._step, self._mapper.elements,
+                                    feeds, deadline=deadline,
+                                    async_fetches=as_futures)
+        _metric_run_seconds.get_cell().add(time.perf_counter() - t0)
+        return self._mapper.rebuild(values)
+
+    __call__ = execute
 
 
 class BaseSession:
@@ -810,6 +980,32 @@ class BaseSession:
                     pass
         return out
 
+    # -- explicit plan/execute (the serving entry point) ---------------------
+    def plan(self, fetches, feeds=None) -> "ExecutionPlan":
+        """Plan ``fetches`` against the declared ``feeds`` WITHOUT
+        executing: returns an :class:`ExecutionPlan` whose ``execute``
+        runs the staged program and whose ``compile`` AOT-compiles it
+        per feed-shape bucket. The plan is the same object ``run``
+        would build and lives in the same executable cache — a
+        ``run(fetches, feed_dict)`` with the identical signature is a
+        cache hit on it.
+
+        ``feeds``: the tensors (or names) executions will feed. Unlike
+        ``run``, no values are needed here — planning uses feed-set
+        membership only.
+        """
+        if self._closed:
+            raise RuntimeError("Attempted to use a closed Session.")
+        mapper = _FetchMapper(self._graph, fetches)
+        feed_ts = [self._graph.as_graph_element(f, allow_tensor=True,
+                                                allow_operation=False)
+                   for f in (feeds or [])]
+        feed_map: Dict[Tensor, Any] = {t: None for t in feed_ts}
+        step = self._get_or_plan(mapper.elements, feed_map,
+                                 count_fast_path=False)
+        return ExecutionPlan(self, mapper, feed_ts, step,
+                             self._cache_key(mapper.elements, feed_map))
+
     # -- multi-step fused run (device-resident training loop) ----------------
     def run_steps(self, fetches, n=None, feed_dict=None, feed_iterator=None,
                   stacked_feeds=None, output_mode="last", options=None,
@@ -936,14 +1132,8 @@ class BaseSession:
         for t in superbatch:
             all_feeds[t] = None  # feed-set membership is what planning uses
         key = self._cache_key(mapper.elements, all_feeds)
-        step = self._cache.get(key)
-        if step is None:
-            _metric_cache_misses.get_cell(
-                self._miss_reason(key)).increase_by(1)
-            step = self._plan(mapper.elements, all_feeds)
-            step = self._cache.setdefault(key, step)
-        else:
-            _metric_cache_hits.get_cell().increase_by(1)
+        step = self._get_or_plan(mapper.elements, all_feeds,
+                                 count_fast_path=False)
 
         from .. import analysis
 
@@ -1011,12 +1201,7 @@ class BaseSession:
         xs_args = {t.name: superbatch[t] for t in step.feed_tensors
                    if t in superbatch}
         with self._lock:
-            import jax
-
-            if self._base_key is None:
-                seed = self._graph.seed if self._graph.seed is not None \
-                    else 0
-                self._base_key = jax.random.key(seed)
+            self._ensure_base_key()
             c0 = self._run_counter + 1
             self._run_counter += n
             ctrs = np.arange(c0, c0 + n, dtype=np.uint32)
@@ -1249,9 +1434,14 @@ class BaseSession:
             return "rewrite_version_bump"
         return "new_fetch_feed_signature"
 
-    def _run_elements(self, elements: List[Any],
-                      feeds: Dict[Tensor, np.ndarray], collector=None,
-                      deadline=None):
+    def _get_or_plan(self, elements: List[Any],
+                     feeds: Dict[Tensor, Any],
+                     count_fast_path: bool = True) -> _CompiledStep:
+        """PLAN layer: resolve the (fetches, feeds) signature to a
+        compiled step — executable-cache lookup, else a full
+        prune/optimize/analyze/lower plan. Shared by run, run_steps,
+        and Session.plan (the serving entry point), so every path pays
+        for planning exactly once per signature."""
         key = self._cache_key(elements, feeds)
         step = self._cache.get(key)
         if step is None:
@@ -1263,12 +1453,27 @@ class BaseSession:
             step = self._cache.setdefault(key, step)
         else:
             _metric_cache_hits.get_cell().increase_by(1)
-            if (step.has_device_stage and not step.host_plan
-                    and not step.post_host_plan):
+            if (count_fast_path and step.has_device_stage
+                    and not step.host_plan and not step.post_host_plan):
                 # steady-state fast path: a warm pure-device program —
                 # no re-plan, no analysis/lint, staging slots committed
                 _metric_fast_path.get_cell().increase_by(1)
+        return step
 
+    def _run_elements(self, elements: List[Any],
+                      feeds: Dict[Tensor, np.ndarray], collector=None,
+                      deadline=None):
+        step = self._get_or_plan(elements, feeds)
+        return self._execute_plan(step, elements, feeds,
+                                  collector=collector, deadline=deadline)
+
+    def _execute_plan(self, step: _CompiledStep, elements: List[Any],
+                      feeds: Dict[Tensor, np.ndarray], collector=None,
+                      deadline=None, async_fetches=None):
+        """EXECUTE layer: stage feeds, dispatch the device program, run
+        host stages, assemble fetch values for an already-planned step.
+        ``async_fetches`` overrides ConfigProto(async_fetches) per call
+        (ModelServer executes with futures regardless of config)."""
         # Host stage -------------------------------------------------------
         host_env: Dict[Tensor, Any] = {}
         if step.host_plan:
@@ -1399,8 +1604,11 @@ class BaseSession:
         # async_fetches: device-produced fetches leave as lazy
         # FetchFutures riding jax async dispatch; the host transfer
         # happens at materialization (docs/PERFORMANCE.md)
-        async_on = (self._config is not None
-                    and getattr(self._config, "async_fetches", False))
+        if async_fetches is None:
+            async_on = (self._config is not None
+                        and getattr(self._config, "async_fetches", False))
+        else:
+            async_on = bool(async_fetches)
         out = []
         for e in elements:
             if isinstance(e, Operation):
@@ -1564,6 +1772,14 @@ class BaseSession:
         key, counter = self._rng_args()
         return jax.random.fold_in(key, counter)
 
+    def _ensure_base_key(self):
+        if self._base_key is None:
+            import jax
+
+            seed = self._graph.seed if self._graph.seed is not None else 0
+            self._base_key = jax.random.key(seed)
+        return self._base_key
+
     def _rng_args(self):
         """(base_key, step_counter) for the jitted path: the per-step
         fold_in happens INSIDE the compiled program (traced once, DCE'd
@@ -1571,11 +1787,7 @@ class BaseSession:
         fold_in — ~0.4 ms/step, 75% of all dispatch overhead when
         measured — on no step. Eager paths (partial_run, py_func) use
         _next_rng, which folds immediately."""
-        import jax
-
-        if self._base_key is None:
-            seed = self._graph.seed if self._graph.seed is not None else 0
-            self._base_key = jax.random.key(seed)
+        self._ensure_base_key()
         self._run_counter += 1
         return self._base_key, np.uint32(self._run_counter)
 

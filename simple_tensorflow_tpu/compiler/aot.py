@@ -8,6 +8,13 @@ the (20-40s) TPU compile. Two layers:
 - ``compile_fetches``: graph -> pure fn -> jax.jit(...).lower().compile(),
   returning an AotExecutable with HLO text, cost analysis, and a stable
   cache key.
+- ``compile_step``: AOT-compile an already-planned Session step for ONE
+  concrete feed-shape bucket (state avals from the live variable store),
+  returning an AotStepExecutable the session's device dispatch calls in
+  place of the jit path. ``stf.serving.ModelServer`` warms one per batch
+  bucket at load so the first request of every bucket shape skips the
+  trace+compile (ref: the reference's Servable warmup,
+  tensorflow_serving/servables).
 - ``enable_persistent_cache``: turns on jax's compilation cache directory,
   the PJRT-level equivalent of tfcompile's ahead-of-time object files —
   keyed by HLO, shared across processes.
@@ -34,24 +41,13 @@ def enable_persistent_cache(cache_dir: str) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
-class AotExecutable:
-    """A compiled fetch subgraph: call with feed values in declared order."""
+class _CompiledBundle:
+    """Shared introspection over a (lowered, compiled) XLA pair."""
 
-    def __init__(self, compiled, lowered, feed_tensors, fetch_tensors, key):
+    def __init__(self, compiled, lowered, key):
         self._compiled = compiled
         self._lowered = lowered
-        self.feed_tensors = list(feed_tensors)
-        self.fetch_tensors = list(fetch_tensors)
         self.cache_key = key
-
-    def __call__(self, *feed_values):
-        if len(feed_values) != len(self.feed_tensors):
-            raise ValueError(
-                f"expected {len(self.feed_tensors)} feeds "
-                f"({[t.name for t in self.feed_tensors]}), "
-                f"got {len(feed_values)}")
-        out = self._compiled(*feed_values)
-        return out
 
     @property
     def hlo_text(self) -> str:
@@ -66,6 +62,72 @@ class AotExecutable:
 
     def memory_analysis(self):
         return self._compiled.memory_analysis()
+
+
+class AotExecutable(_CompiledBundle):
+    """A compiled fetch subgraph: call with feed values in declared order."""
+
+    def __init__(self, compiled, lowered, feed_tensors, fetch_tensors, key):
+        super().__init__(compiled, lowered, key)
+        self.feed_tensors = list(feed_tensors)
+        self.fetch_tensors = list(fetch_tensors)
+
+    def __call__(self, *feed_values):
+        if len(feed_values) != len(self.feed_tensors):
+            raise ValueError(
+                f"expected {len(self.feed_tensors)} feeds "
+                f"({[t.name for t in self.feed_tensors]}), "
+                f"got {len(feed_values)}")
+        out = self._compiled(*feed_values)
+        return out
+
+
+def feed_signature(feed_args: Dict[str, Any]):
+    """Stable key for one concrete feed-shape bucket: sorted (name,
+    shape, dtype) triples. ``feed_args`` values may be numpy arrays,
+    jax.Arrays, or ShapeDtypeStructs — anything with .shape/.dtype
+    (never forces a device transfer)."""
+    return tuple(sorted(
+        (name, tuple(getattr(v, "shape", ())),
+         str(getattr(v, "dtype", type(v).__name__)))
+        for name, v in feed_args.items()))
+
+
+class AotStepExecutable(_CompiledBundle):
+    """An already-planned Session step, AOT-compiled for one feed-shape
+    bucket. Call-compatible with the step's jitted function
+    (``(state, feed_args, rng_key, rng_ctr)``), so the session's device
+    dispatch (client/session.py ``_call_step_executable``) uses it
+    transparently when the execution's ``feed_signature`` matches.
+    State is donated exactly like the jit path — the caller commits the
+    returned state dict back to the variable store."""
+
+    def __init__(self, compiled, lowered, feed_avals, key):
+        super().__init__(compiled, lowered, key)
+        self.feed_avals = dict(feed_avals)
+        self.feed_signature = feed_signature(feed_avals)
+
+    def __call__(self, state, feed_args, rng_key, rng_ctr):
+        return self._compiled(state, feed_args, rng_key, rng_ctr)
+
+
+def compile_step(jitted, state: Dict[str, Any],
+                 feed_avals: Dict[str, Any], rng_key,
+                 rng_ctr) -> AotStepExecutable:
+    """AOT-compile a planned step for one feed-shape bucket.
+
+    ``jitted`` is the step's jax.jit function; ``state`` the CURRENT
+    variable store (concrete arrays — only their avals matter, nothing
+    executes); ``feed_avals`` maps feed tensor name ->
+    jax.ShapeDtypeStruct of the bucket shape. With a persistent compile
+    cache enabled (``enable_persistent_cache`` /
+    ConfigProto(compile_cache_dir=...)), process restarts disk-hit
+    these compiles — AOT warmup after the first deploy costs reads,
+    not compiles."""
+    lowered = jitted.lower(dict(state), dict(feed_avals), rng_key, rng_ctr)
+    key = hashlib.sha256(lowered.as_text().encode()).hexdigest()[:16]
+    compiled = lowered.compile()
+    return AotStepExecutable(compiled, lowered, feed_avals, key)
 
 
 def compile_fetches(fetches, feeds: Sequence[ops_mod.Tensor],

@@ -62,6 +62,11 @@ PREFETCH_AUTOTUNE_MAX = 16
 
 _DONE = object()
 
+# distinct from _DONE: a timed RingBuffer.get that elapsed with the
+# buffer still open and empty (the serving batcher's batch-close path —
+# "no more requests arrived inside batch_timeout_ms" is not end-of-stream)
+TIMED_OUT = object()
+
 
 class _Error:
     """Wraps an exception crossing a ring buffer / future boundary so it
@@ -178,7 +183,14 @@ class RingBuffer:
     """Bounded buffer between stages. ``put`` blocks while full (the
     backpressure edge), ``get`` blocks while empty; ``close`` wakes every
     waiter (puts start returning False, gets drain then report _DONE).
-    Capacity is live-adjustable (AUTOTUNE prefetch grows it)."""
+    Capacity is live-adjustable (AUTOTUNE prefetch grows it).
+
+    Both operations take an optional ``timeout`` (seconds): a timed
+    ``put`` that cannot find space returns False with the buffer still
+    open (distinguish via ``closed``); a timed ``get`` that finds no
+    item returns the module's ``TIMED_OUT`` sentinel. The serving
+    admission queue (stf.serving.batcher) runs on exactly this:
+    deadline-bounded backpressure on submit, batch-timeout on drain."""
 
     def __init__(self, capacity: int, stats: Optional[StageStats] = None):
         self._dq: deque = deque()
@@ -189,17 +201,35 @@ class RingBuffer:
         self._closed = False
         self._stats = stats
 
-    def put(self, item) -> bool:
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @staticmethod
+    def _wait(cond, deadline):
+        if deadline is None:
+            cond.wait(0.1)
+            return True
+        remaining = deadline - time.perf_counter()
+        if remaining <= 0:
+            return False
+        cond.wait(min(remaining, 0.1))
+        return True
+
+    def put(self, item, timeout: Optional[float] = None) -> bool:
         with self._not_full:
             if self._closed:
                 return False
             if len(self._dq) >= self.capacity:
+                deadline = None if timeout is None \
+                    else time.perf_counter() + timeout
                 t0 = time.perf_counter()
                 while len(self._dq) >= self.capacity and not self._closed:
-                    self._not_full.wait(0.1)
+                    if not self._wait(self._not_full, deadline):
+                        break
                 if self._stats is not None:
                     self._stats.stall("produce", time.perf_counter() - t0)
-                if self._closed:
+                if self._closed or len(self._dq) >= self.capacity:
                     return False
             self._dq.append(item)
             if self._stats is not None:
@@ -207,23 +237,44 @@ class RingBuffer:
             self._not_empty.notify()
             return True
 
-    def get(self):
+    def get(self, timeout: Optional[float] = None):
         """Next item; _DONE when closed and drained (cancellation path —
-        producers signal normal end-of-stream by putting _DONE)."""
+        producers signal normal end-of-stream by putting _DONE); with a
+        ``timeout``, TIMED_OUT when it elapses with the buffer open."""
         with self._not_empty:
             if not self._dq:
+                deadline = None if timeout is None \
+                    else time.perf_counter() + timeout
                 t0 = time.perf_counter()
                 while not self._dq and not self._closed:
-                    self._not_empty.wait(0.1)
+                    if not self._wait(self._not_empty, deadline):
+                        break
                 if self._stats is not None:
                     self._stats.stall("consume", time.perf_counter() - t0)
                 if not self._dq:
-                    return _DONE
+                    return _DONE if self._closed else TIMED_OUT
             item = self._dq.popleft()
             if self._stats is not None:
                 self._stats.occupancy.set(len(self._dq))
             self._not_full.notify()
             return item
+
+    def get_available(self, max_items: int) -> list:
+        """Pop up to ``max_items`` WITHOUT blocking (possibly none) in
+        ONE lock acquisition — the serving batcher coalesces a burst of
+        queued requests this way instead of paying a condition-variable
+        round-trip per element."""
+        out: list = []
+        if max_items <= 0:
+            return out
+        with self._not_empty:
+            while self._dq and len(out) < max_items:
+                out.append(self._dq.popleft())
+            if out:
+                if self._stats is not None:
+                    self._stats.occupancy.set(len(self._dq))
+                self._not_full.notify_all()
+        return out
 
     def set_capacity(self, capacity: int):
         with self._not_full:

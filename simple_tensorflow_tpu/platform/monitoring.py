@@ -44,6 +44,7 @@ __all__ = [
     "get_metric", "unregister", "reset_registry",
     "traceme", "trace_collection", "TraceBuffer", "tracing_active",
     "record_span",
+    "WindowedRate",
 ]
 
 _registry_lock = threading.Lock()
@@ -394,6 +395,43 @@ def reset_registry():
     re-register on next module import, not after this call."""
     with _registry_lock:
         _registry.clear()
+
+
+class WindowedRate:
+    """Sliding-window event-rate estimator (events/sec over the last
+    ``window_s`` seconds), feeding gauge-style metrics whose value must
+    reflect CURRENT load, not lifetime averages — the
+    ``/stf/serving/qps`` gauge is the canonical user. Thread-safe;
+    O(1) amortized per event (per-second coarse buckets, not a
+    per-event deque)."""
+
+    __slots__ = ("_window_s", "_lock", "_buckets")
+
+    def __init__(self, window_s: float = 10.0):
+        self._window_s = max(1.0, float(window_s))
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, int] = {}
+
+    def add(self, n: int = 1, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        sec = int(now)
+        with self._lock:
+            self._buckets[sec] = self._buckets.get(sec, 0) + n
+            self._evict(now)
+
+    def _evict(self, now: float) -> None:
+        horizon = int(now - self._window_s) - 1
+        if len(self._buckets) > self._window_s + 2:
+            for sec in [s for s in self._buckets if s <= horizon]:
+                del self._buckets[sec]
+
+    def rate(self, now: Optional[float] = None) -> float:
+        """Events/sec over the trailing window (0.0 when idle)."""
+        now = time.monotonic() if now is None else now
+        lo = now - self._window_s
+        with self._lock:
+            total = sum(c for s, c in self._buckets.items() if s + 1 > lo)
+        return total / self._window_s
 
 
 def export() -> Dict[str, Any]:

@@ -3,7 +3,7 @@
 from . import builder
 from . import loader
 from .builder import SavedModelBuilder, simple_save
-from .loader import load, maybe_saved_model_directory
+from .loader import load, maybe_saved_model_directory, get_signature_def
 from . import signature_constants
 from . import tag_constants
 from . import signature_def_utils

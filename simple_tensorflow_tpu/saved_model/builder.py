@@ -63,7 +63,36 @@ class SavedModelBuilder:
         meta["signature_def"] = signature_def_map or {}
         if main_op is not None:
             meta["main_op"] = main_op.name
+        if signature_def_map and "serve" in {str(t) for t in tags}:
+            self._lint_for_serving(graph, signature_def_map)
         self._meta_graphs.append(meta)
+
+    @staticmethod
+    def _lint_for_serving(graph, signature_def_map):
+        """Export-time serving lint: a SERVING-tagged MetaGraph whose
+        signature closures contain batcher-incompatible ops (host
+        stages, Print/logging io, unseeded RNG) is flagged HERE, at
+        export, where the graph author can still fix it — not at
+        ModelServer.load in production. Advisory: warnings only."""
+        from .. import analysis
+        from ..platform import tf_logging as logging
+
+        for key, sig in signature_def_map.items():
+            try:
+                fetches = [graph.get_tensor_by_name(info["name"])
+                           for info in (sig.get("outputs") or {}).values()]
+            except (KeyError, ValueError) as e:
+                logging.warning(
+                    "SavedModelBuilder: signature %r names a tensor "
+                    "missing from the exported graph: %s", key, e)
+                continue
+            if not fetches:
+                continue
+            for d in analysis.lint_graph(
+                    graph=graph, fetches=fetches, purpose="serving",
+                    rules=["lint/serving-incompatible"]):
+                logging.warning("SavedModelBuilder: signature %r: %s",
+                                key, d.format())
 
     def save(self, as_text=True):
         """(ref: builder_impl.py:420 ``save``)."""

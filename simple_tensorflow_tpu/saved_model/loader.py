@@ -15,6 +15,22 @@ def maybe_saved_model_directory(export_dir):
     return os.path.exists(os.path.join(export_dir, SAVED_MODEL_FILENAME))
 
 
+def get_signature_def(meta_graph, signature_key):
+    """A MetaGraph's signature_def by key, with a structured
+    NotFoundError naming the available keys (the serving path's
+    unknown-signature contract — ref: tensorflow_serving/servables/
+    tensorflow/predict_util.cc)."""
+    from ..framework import errors
+
+    sigs = meta_graph.get("signature_def") or {}
+    if signature_key not in sigs:
+        raise errors.NotFoundError(
+            None, None,
+            f"MetaGraph has no signature_def {signature_key!r}; "
+            f"available: {sorted(sigs)}")
+    return sigs[signature_key]
+
+
 def load(sess, tags, export_dir, **saver_kwargs):
     """(ref: loader_impl.py:149 ``load``)."""
     path = os.path.join(export_dir, SAVED_MODEL_FILENAME)

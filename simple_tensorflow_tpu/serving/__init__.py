@@ -1,0 +1,38 @@
+"""stf.serving: AOT-compiled model server with continuous batching.
+
+(ref: tensorflow_serving — model_servers/server_core.cc servable
+ownership, batching/basic_batch_scheduler.h request coalescing,
+servables/tensorflow saved_model bundles. The TF system paper,
+arXiv 1605.08695 §serving, treats this as a first-class product
+surface next to training.)
+
+The serving path is the training executor, re-driven:
+
+    export (saved_model.simple_save)
+      -> ModelServer.load()        # import + restore, plan per
+                                   # signature, AOT-compile per bucket
+      -> server.predict(inputs)    # -> ServeFuture
+      -> future.result()           # lazy row of the coalesced batch
+
+``Session.plan`` / ``ExecutionPlan.execute`` are the plan/execute
+split of ``Session.run``; the :class:`ContinuousBatcher` coalesces
+concurrent requests into padded, bucketed batches (close on
+``max_batch_size`` OR ``batch_timeout_ms``), per-request deadlines
+ride RunOptions.timeout_in_ms semantics, and responses are lazy
+FetchFuture-backed row slices. See docs/SERVING.md for the
+walkthrough and /stf/serving/* metrics catalog
+(docs/OBSERVABILITY.md).
+"""
+
+from .batcher import ContinuousBatcher, ServeFuture, ServeRequest
+from .policy import BatchingPolicy
+from .server import ModelServer, live_servers
+
+__all__ = [
+    "BatchingPolicy",
+    "ContinuousBatcher",
+    "ModelServer",
+    "ServeFuture",
+    "ServeRequest",
+    "live_servers",
+]

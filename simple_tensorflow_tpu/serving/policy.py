@@ -1,0 +1,91 @@
+"""Batching policy for the stf.serving continuous batcher.
+
+(ref: tensorflow_serving/batching/batching_session.cc
+``BasicBatchScheduler::Options`` — max_batch_size /
+batch_timeout_micros / max_enqueued_batches, and the
+allowed_batch_sizes padding contract of
+tensorflow_serving/servables/tensorflow/.)
+
+One :class:`BatchingPolicy` governs one admission queue + batcher:
+
+- a batch CLOSES when it holds ``max_batch_size`` requests OR
+  ``batch_timeout_ms`` elapsed since its first request arrived —
+  latency-bounded coalescing (the "continuous" in continuous batching:
+  the batcher never waits for a full batch under light load);
+- the closed batch is PADDED up to the smallest ``bucket_sizes`` entry
+  that fits, so the device sees a handful of static shapes (one AOT
+  executable per bucket) instead of a recompile per occupancy;
+- ``max_queue_depth`` bounds the admission queue; a full queue exerts
+  backpressure on submitters (bounded by each request's deadline)
+  instead of growing without bound;
+- ``default_timeout_ms`` seeds per-request deadlines when the client
+  passes none (0 = no deadline).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+def _pow2_buckets(max_batch_size: int) -> List[int]:
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return out
+
+
+class BatchingPolicy:
+    """Knobs for one model's continuous batcher (docs/SERVING.md)."""
+
+    def __init__(self,
+                 max_batch_size: int = 16,
+                 batch_timeout_ms: float = 2.0,
+                 max_queue_depth: int = 256,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 pad_mode: str = "repeat",
+                 default_timeout_ms: float = 0.0):
+        if max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {max_batch_size}")
+        if batch_timeout_ms < 0:
+            raise ValueError(
+                f"batch_timeout_ms must be >= 0, got {batch_timeout_ms}")
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        if pad_mode not in ("repeat", "zero"):
+            raise ValueError(
+                f"pad_mode must be 'repeat' or 'zero', got {pad_mode!r}")
+        self.max_batch_size = int(max_batch_size)
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.max_queue_depth = int(max_queue_depth)
+        if bucket_sizes is None:
+            bucket_sizes = _pow2_buckets(self.max_batch_size)
+        buckets = sorted({int(b) for b in bucket_sizes})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"bucket_sizes must be positive: {bucket_sizes}")
+        if buckets[-1] < self.max_batch_size:
+            # the largest bucket must fit a full batch, or a closed
+            # max-size batch would have nowhere to go
+            buckets.append(self.max_batch_size)
+        self.bucket_sizes = buckets
+        self.pad_mode = pad_mode
+        self.default_timeout_ms = float(default_timeout_ms)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket holding ``n`` requests."""
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        return self.bucket_sizes[-1]
+
+    def __repr__(self):
+        return (f"BatchingPolicy(max_batch_size={self.max_batch_size}, "
+                f"batch_timeout_ms={self.batch_timeout_ms}, "
+                f"max_queue_depth={self.max_queue_depth}, "
+                f"bucket_sizes={self.bucket_sizes}, "
+                f"pad_mode={self.pad_mode!r}, "
+                f"default_timeout_ms={self.default_timeout_ms})")

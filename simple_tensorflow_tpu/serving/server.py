@@ -1,0 +1,495 @@
+"""stf.serving.ModelServer: multi-model AOT-compiled inference server.
+
+(ref: tensorflow_serving/model_servers/server_core.cc — a ServerCore
+owns N servables; tensorflow_serving/servables/tensorflow/
+saved_model_bundle_factory.cc — each servable is a loaded SavedModel +
+session; request batching rides batching_session.cc.)
+
+``ModelServer.load(export_dir)`` builds one servable per SavedModel:
+
+- its OWN Graph + Session + VariableStore (per-model state isolation;
+  every model shares the process's device) — the SavedModel is imported
+  and its checkpoint restored exactly like the training-side loader;
+- one :class:`~..client.session.ExecutionPlan` per signature_def — the
+  explicit plan/execute split of ``Session.run``, so serving drives the
+  SAME executor training uses (prune/optimize/analyze/lower once at
+  load; per-request work is stage+dispatch+fetch only);
+- per-bucket AOT warmup: every ``BatchingPolicy.bucket_sizes`` batch
+  shape is compiled through ``compiler.aot.AotStepExecutable`` at load,
+  so no live request ever pays a trace+compile (with
+  ConfigProto(compile_cache_dir=...)/STF_COMPILE_CACHE the compiles
+  disk-hit on process restart — warm restarts);
+- a :class:`~.batcher.ContinuousBatcher` per signature coalescing
+  concurrent ``predict`` calls into padded, bucketed batches.
+
+``predict`` validates the request against the signature_def (unknown
+model/signature -> NotFoundError; input-key or shape mismatch ->
+InvalidArgumentError), stamps the deadline
+(``timeout_ms`` / RunOptions.timeout_in_ms / policy default), and
+returns a :class:`~.batcher.ServeFuture` resolving to the request's
+row of the batch outputs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import errors
+from ..platform import monitoring
+from ..platform import tf_logging as logging
+from .batcher import (ContinuousBatcher, ServeFuture, ServeRequest,
+                      _metric_requests)
+from .policy import BatchingPolicy
+
+# every constructed ModelServer, while alive (test leak hygiene:
+# tests/conftest.py asserts these are all closed after each module)
+live_servers: "weakref.WeakSet" = weakref.WeakSet()
+
+_metric_models = monitoring.IntGauge(
+    "/stf/serving/models_loaded",
+    "Servable models currently loaded across all ModelServers")
+_metric_aot_buckets = monitoring.Counter(
+    "/stf/serving/aot_buckets_compiled",
+    "Per-bucket AOT executables compiled at model load", "model")
+
+_servers_lock = threading.Lock()
+
+
+def _count_models(delta: int):
+    with _servers_lock:
+        cell = _metric_models.get_cell()
+        cell.set(max(0, cell.value() + delta))
+
+
+class _ServableSignature:
+    """One signature_def resolved against the loaded graph: input/output
+    tensors, the planned step, and its batcher."""
+
+    __slots__ = ("key", "inputs", "outputs", "plan", "example_shapes",
+                 "np_dtypes", "batcher", "method_name", "static_shapes")
+
+    def __init__(self, key, inputs, outputs, plan, method_name):
+        self.key = key
+        self.inputs = inputs            # input_key -> Tensor
+        self.outputs = outputs          # output_key -> Tensor
+        self.plan = plan
+        self.method_name = method_name
+        self.batcher: Optional[ContinuousBatcher] = None
+        self.example_shapes = {}        # input_key -> per-example shape
+        self.np_dtypes = {}
+        # fully-static per-example shapes, precomputed for the hot-path
+        # request validation (exact tuple compare beats a per-dim loop)
+        self.static_shapes = {}
+        for k, t in inputs.items():
+            if t.shape.rank is None or t.shape.rank < 1:
+                raise errors.InvalidArgumentError(
+                    None, t.op,
+                    f"signature {key!r} input {k!r} ({t.name}) needs a "
+                    f"known rank >= 1 (leading batch dim) to be served; "
+                    f"got shape {t.shape}")
+            shp = tuple(t.shape.as_list()[1:])
+            self.example_shapes[k] = shp
+            if all(d is not None for d in shp):
+                self.static_shapes[k] = shp
+            self.np_dtypes[k] = dtypes_mod.narrowed_if_no_x64(
+                t.dtype.base_dtype).np_dtype
+
+    def static_example_shapes(self) -> bool:
+        return all(all(d is not None for d in shp)
+                   for shp in self.example_shapes.values())
+
+
+class _LoadedModel:
+    __slots__ = ("name", "export_dir", "graph", "session", "signatures",
+                 "policy")
+
+    def __init__(self, name, export_dir, graph, session, policy):
+        self.name = name
+        self.export_dir = export_dir
+        self.graph = graph
+        self.session = session
+        self.policy = policy
+        self.signatures: Dict[str, _ServableSignature] = {}
+
+
+class ModelServer:
+    """Multi-tenant model server over the shared process device.
+
+    ``policy`` is the default :class:`BatchingPolicy` (per-model
+    override via ``load(policy=...)``); ``config`` is the ConfigProto
+    given to each model's Session (e.g. ``compile_cache_dir`` for warm
+    restarts)."""
+
+    def __init__(self, policy: Optional[BatchingPolicy] = None,
+                 config=None):
+        self._policy = policy or BatchingPolicy()
+        self._config = config
+        self._models: Dict[str, _LoadedModel] = {}
+        # names reserved by in-flight load() calls: the duplicate-name
+        # check and the reservation happen in ONE critical section so
+        # concurrent loads of the same name cannot both build servables
+        # (the loser's session/batcher threads would leak unreachable)
+        self._loading: set = set()
+        self._lock = threading.Lock()
+        self._closed = False
+        live_servers.add(self)
+
+    # -- properties -----------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def model_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def signature_keys(self, model: Optional[str] = None) -> List[str]:
+        return sorted(self._model(model).signatures)
+
+    # -- loading --------------------------------------------------------------
+    def load(self, export_dir: str, name: Optional[str] = None,
+             tags: Optional[Sequence[str]] = None,
+             signature_keys: Optional[Sequence[str]] = None,
+             policy: Optional[BatchingPolicy] = None,
+             aot_warmup: bool = True, lint: str = "warn") -> str:
+        """Load one SavedModel as a servable; returns its model name.
+
+        ``signature_keys`` restricts which signature_defs are served
+        (default: every signature in the MetaGraph). ``aot_warmup``
+        AOT-compiles each policy bucket per signature (skipped with a
+        log note for signatures with dynamic per-example dims).
+        ``lint``: run the serving-compatibility lint over each served
+        signature's inference closure — "warn" logs diagnostics,
+        "strict" refuses to load on any finding, "off" skips.
+        """
+        if self._closed:
+            raise errors.UnavailableError(
+                None, None, "ModelServer is shut down")
+        if lint not in ("warn", "strict", "off"):
+            raise ValueError(
+                f"lint must be 'warn'|'strict'|'off', got {lint!r}")
+        from ..framework import graph as ops_mod
+        from ..saved_model import loader as sm_loader
+        from ..saved_model import tag_constants
+
+        policy = policy or self._policy
+        name = name or os.path.basename(os.path.normpath(export_dir))
+        with self._lock:
+            if name in self._models or name in self._loading:
+                raise errors.AlreadyExistsError(
+                    None, None,
+                    f"model {name!r} is already loaded (or loading); "
+                    "unload() it first or pass a distinct name")
+            self._loading.add(name)
+        session = None
+        try:
+            graph = ops_mod.Graph()
+            with graph.as_default():
+                from ..client.session import Session
+
+                session = Session(graph=graph, config=self._config)
+                meta = sm_loader.load(session,
+                                      tags or [tag_constants.SERVING],
+                                      export_dir)
+            sig_map = meta.get("signature_def") or {}
+            wanted = list(signature_keys) if signature_keys \
+                else sorted(sig_map)
+            if not wanted:
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"SavedModel at {export_dir} has no signature_defs "
+                    "— nothing to serve (export with "
+                    "saved_model.simple_save or a signature_def_map)")
+            model = _LoadedModel(name, export_dir, graph, session, policy)
+            try:
+                for key in wanted:
+                    sig = self._build_signature(model, sig_map, key, lint)
+                    model.signatures[key] = sig
+                if aot_warmup:
+                    self._warmup(model)
+                for sig in model.signatures.values():
+                    sig.batcher = self._make_batcher(model, sig)
+            except BaseException:
+                for sig in model.signatures.values():
+                    if getattr(sig, "batcher", None) is not None:
+                        sig.batcher.close()
+                raise
+            with self._lock:
+                # close() may have run while this load was building: it
+                # snapshots _models under the lock, so a model inserted
+                # after that snapshot would leak its session + batcher
+                # threads forever. Abort instead of inserting.
+                aborted = self._closed
+                if not aborted:
+                    self._models[name] = model
+            if aborted:
+                for sig in model.signatures.values():
+                    if sig.batcher is not None:
+                        sig.batcher.close()
+                raise errors.UnavailableError(
+                    None, None,
+                    "ModelServer was shut down while the model loaded")
+        except BaseException:
+            if session is not None:
+                session.close()
+            raise
+        finally:
+            with self._lock:
+                self._loading.discard(name)
+        _count_models(+1)
+        logging.info(
+            "serving: loaded model %r from %s (%d signature(s): %s)",
+            name, export_dir, len(model.signatures),
+            ", ".join(sorted(model.signatures)))
+        return name
+
+    def _build_signature(self, model, sig_map, key, lint):
+        from ..saved_model import loader as sm_loader
+        from ..framework import lowering as lowering_mod
+        from .. import analysis
+
+        sig_def = sm_loader.get_signature_def(
+            {"signature_def": sig_map}, key)
+        graph = model.graph
+
+        def _resolve(info, role, k):
+            try:
+                return graph.get_tensor_by_name(info["name"])
+            except (KeyError, ValueError) as e:
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"signature {key!r} {role} {k!r} names tensor "
+                    f"{info['name']!r} which is not in the loaded "
+                    f"graph: {e}")
+
+        inputs = {k: _resolve(info, "input", k)
+                  for k, info in (sig_def.get("inputs") or {}).items()}
+        outputs = {k: _resolve(info, "output", k)
+                   for k, info in (sig_def.get("outputs") or {}).items()}
+        if not inputs or not outputs:
+            raise errors.InvalidArgumentError(
+                None, None,
+                f"signature {key!r} needs at least one input and one "
+                f"output (got {len(inputs)} inputs, {len(outputs)} "
+                "outputs)")
+        if lint != "off":
+            pruned = lowering_mod.prune(
+                [t.op for t in outputs.values()], set(inputs.values()))
+            diags = analysis.lint_graph(
+                graph=graph, ops=pruned,
+                fetches=list(outputs.values()), purpose="serving",
+                rules=["lint/serving-incompatible"])
+            for d in diags:
+                logging.warning("serving lint (%s/%s): %s",
+                                model.name, key, d.format())
+            if diags and lint == "strict":
+                raise errors.FailedPreconditionError(
+                    None, None,
+                    f"model {model.name!r} signature {key!r} is not "
+                    "servable (lint='strict'):\n"
+                    + analysis.format_report(diags))
+        with graph.as_default():
+            plan = model.session.plan(dict(outputs),
+                                      feeds=list(inputs.values()))
+        if plan.has_host_stages:
+            raise errors.FailedPreconditionError(
+                None, None,
+                f"model {model.name!r} signature {key!r} compiles to a "
+                "plan with Python host stages — not servable under the "
+                "batcher. Offending ops: "
+                + ", ".join(o.name for o in
+                            (plan.step.host_plan
+                             + plan.step.post_host_plan)[:5])
+                + ". Export a pure device inference graph "
+                  "(see docs/SERVING.md).")
+        return _ServableSignature(key, inputs, outputs, plan,
+                                  sig_def.get("method_name"))
+
+    def _warmup(self, model: _LoadedModel):
+        for key, sig in model.signatures.items():
+            if not sig.static_example_shapes():
+                logging.warning(
+                    "serving: model %r signature %r has dynamic "
+                    "per-example dims %s — AOT warmup skipped, first "
+                    "request of each shape pays a jit compile",
+                    model.name, key, sig.example_shapes)
+                continue
+            for bucket in model.policy.bucket_sizes:
+                shapes = {t: (bucket,) + sig.example_shapes[k]
+                          for k, t in sig.inputs.items()}
+                sig.plan.compile(shapes)
+                _metric_aot_buckets.get_cell(model.name).increase_by(1)
+
+    def _make_batcher(self, model: _LoadedModel,
+                      sig: _ServableSignature) -> ContinuousBatcher:
+        plan = sig.plan
+        tensors = dict(sig.inputs)
+
+        def _execute(batch_inputs: Dict[str, np.ndarray], bucket: int):
+            feeds = {tensors[k]: v for k, v in batch_inputs.items()}
+            return plan.execute(feeds, as_futures=True)
+
+        return ContinuousBatcher(f"{model.name}/{sig.key}", _execute,
+                                 model.policy)
+
+    # -- serving --------------------------------------------------------------
+    def _model(self, name: Optional[str]) -> _LoadedModel:
+        with self._lock:
+            if name is None:
+                if len(self._models) == 1:
+                    return next(iter(self._models.values()))
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"{len(self._models)} models are loaded "
+                    f"({sorted(self._models)}); pass model=<name>")
+            m = self._models.get(name)
+        if m is None:
+            raise errors.NotFoundError(
+                None, None,
+                f"no model named {name!r} is loaded; available: "
+                f"{self.model_names}")
+        return m
+
+    def predict(self, inputs: Dict[str, Any],
+                model: Optional[str] = None,
+                signature_key: Optional[str] = None,
+                timeout_ms: Optional[float] = None,
+                options=None) -> ServeFuture:
+        """Serve ONE example: ``inputs`` maps the signature's input keys
+        to per-example arrays (no batch dim — the batcher adds it).
+        Returns a :class:`ServeFuture`; ``result()`` yields
+        {output_key: np.ndarray}.
+
+        Deadline: ``timeout_ms``, else ``options.timeout_in_ms``
+        (RunOptions — the PR 2 deadline contract), else the policy's
+        ``default_timeout_ms``; 0/None = no deadline. An expired
+        deadline resolves the future with DeadlineExceededError — a
+        structured per-request error, never a stalled batch."""
+        if self._closed:
+            raise errors.UnavailableError(
+                None, None, "ModelServer is shut down")
+        from ..saved_model import signature_constants
+
+        m = self._model(model)
+        key = signature_key or \
+            signature_constants.DEFAULT_SERVING_SIGNATURE_DEF_KEY
+        sig = m.signatures.get(key)
+        if sig is None:
+            _metric_requests.get_cell(
+                f"{m.name}/{key}", "invalid").increase_by(1)
+            raise errors.NotFoundError(
+                None, None,
+                f"model {m.name!r} has no signature {key!r}; "
+                f"available: {sorted(m.signatures)}")
+        if inputs.keys() != sig.inputs.keys():
+            _metric_requests.get_cell(
+                f"{m.name}/{sig.key}", "invalid").increase_by(1)
+            raise errors.InvalidArgumentError(
+                None, None,
+                f"model {m.name!r} signature {sig.key!r} expects inputs "
+                f"{sorted(sig.inputs)}, got {sorted(inputs)}")
+        rows: Dict[str, np.ndarray] = {}
+        for k, v in inputs.items():
+            # hot path: a correctly-typed, correctly-shaped ndarray (the
+            # steady-state client) validates with two comparisons
+            if (type(v) is np.ndarray and v.dtype == sig.np_dtypes[k]
+                    and v.shape == sig.static_shapes.get(k)):
+                rows[k] = v
+                continue
+            try:
+                arr = np.asarray(v, dtype=sig.np_dtypes[k])
+            except (TypeError, ValueError) as e:
+                _metric_requests.get_cell(
+                    f"{m.name}/{sig.key}", "invalid").increase_by(1)
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"input {k!r}: cannot convert to "
+                    f"{np.dtype(sig.np_dtypes[k]).name}: {e}")
+            expect = sig.example_shapes[k]
+            ok = len(arr.shape) == len(expect) and all(
+                e is None or e == d for e, d in zip(expect, arr.shape))
+            if not ok:
+                _metric_requests.get_cell(
+                    f"{m.name}/{sig.key}", "invalid").increase_by(1)
+                raise errors.InvalidArgumentError(
+                    None, None,
+                    f"input {k!r}: per-example shape {arr.shape} does "
+                    f"not match signature shape {expect} (requests "
+                    "carry ONE example; the batcher adds the batch "
+                    "dim)")
+            rows[k] = arr
+        if timeout_ms is None and options is not None:
+            timeout_ms = getattr(options, "timeout_in_ms", 0) or None
+        if timeout_ms is None and m.policy.default_timeout_ms > 0:
+            timeout_ms = m.policy.default_timeout_ms
+        deadline = None
+        if timeout_ms:
+            import time as _time
+
+            deadline = _time.perf_counter() + float(timeout_ms) / 1000.0
+        fut = ServeFuture(sig.batcher.name)
+        return sig.batcher.submit(ServeRequest(rows, fut, deadline))
+
+    # -- lifecycle ------------------------------------------------------------
+    def unload(self, name: str):
+        with self._lock:
+            model = self._models.pop(name, None)
+        if model is None:
+            raise errors.NotFoundError(
+                None, None, f"no model named {name!r} is loaded")
+        for sig in model.signatures.values():
+            if sig.batcher is not None:
+                sig.batcher.close()
+        model.session.close()
+        _count_models(-1)
+
+    def close(self):
+        """Shut down: close every admission queue (queued requests
+        drain and execute; new submits fail Unavailable), join batcher
+        threads, close model sessions. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            models = list(self._models.values())
+            self._models.clear()
+        for model in models:
+            for sig in model.signatures.values():
+                if sig.batcher is not None:
+                    sig.batcher.close()
+            model.session.close()
+            _count_models(-1)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def stats(self) -> Dict[str, Any]:
+        """The /stf/serving/* metric family's current snapshot. The
+        qps gauges are recomputed from their trailing windows first, so
+        an idle server reports 0 rather than its last batch's rate."""
+        with self._lock:
+            models = list(self._models.values())
+        for model in models:
+            for sig in model.signatures.values():
+                if sig.batcher is not None:
+                    sig.batcher.refresh_qps()
+        return {name: metric
+                for name, metric in monitoring.export().items()
+                if name.startswith("/stf/serving/")}

@@ -2,7 +2,7 @@
 
     python -m simple_tensorflow_tpu.tools.graph_lint graphdef.json \
         [--fetch op_or_tensor ...] [--severity code=level ...] \
-        [--level structural|full] [--json] \
+        [--level structural|full] [--json] [--serving] \
         [--mesh 8|2x4|dp=2,tp=4] [--rules rules.json] \
         [--max-severity note|warning|error]
 
@@ -40,7 +40,8 @@ import sys
 
 
 def run_lint(graph_def: dict, fetch_names=None, severities=None,
-             level: str = "full", mesh=None, partition_rules=None):
+             level: str = "full", mesh=None, partition_rules=None,
+             purpose=None):
     """Programmatic entry: returns (diagnostics, imported_graph|None,
     sharding_report|None)."""
     from .. import analysis
@@ -64,7 +65,8 @@ def run_lint(graph_def: dict, fetch_names=None, severities=None,
             report(diags, ERROR, "lint-cli/bad-fetch",
                    f"--fetch {name!r}: {e}")
     diags.extend(analysis.analyze(graph, fetches=fetches or None,
-                                  level=level, severities=severities))
+                                  level=level, severities=severities,
+                                  purpose=purpose))
     report_obj = None
     if mesh:
         seeds = None
@@ -114,6 +116,12 @@ def main(argv=None):
                          "[spec entries]], ...]; seeds variable "
                          "shardings for --mesh analysis "
                          "(match_partition_rules format)")
+    ap.add_argument("--serving", action="store_true",
+                    help="lint as an exported inference graph: activate "
+                         "the serving-compatibility rules "
+                         "(lint/serving-incompatible — host stages, "
+                         "Print/logging io, unseeded RNG in the fetch "
+                         "closure)")
     ap.add_argument("--max-severity", default="error",
                     choices=["note", "warning", "error"],
                     help="exit nonzero when any diagnostic reaches this "
@@ -156,7 +164,9 @@ def main(argv=None):
     diags, _graph, report = run_lint(gd, fetch_names=args.fetch,
                                      severities=severities,
                                      level=args.level, mesh=mesh,
-                                     partition_rules=partition_rules)
+                                     partition_rules=partition_rules,
+                                     purpose="serving" if args.serving
+                                     else None)
     if args.json:
         for d in diags:
             print(json.dumps(d.to_dict()))

@@ -26,30 +26,39 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True, scope="module")
 def _no_pipeline_leaks():
-    """Leak hygiene (ISSUE 6 satellite): after each test module, no
-    pipeline stage threads may still be running and every
-    PipelineIterator constructed by the module must be closed. Long
-    analyzer test sessions would otherwise mask PR 5 teardown bugs —
-    an unclosed iterator pins its stage threads and ring buffers until
+    """Leak hygiene (ISSUE 6 satellite, extended to serving in ISSUE 7):
+    after each test module, no pipeline stage threads or serving
+    batcher threads may still be running, every PipelineIterator must
+    be closed, and every ModelServer must be shut down (an open server
+    pins its admission queues, batcher threads, and model sessions).
+    Long analyzer test sessions would otherwise mask teardown bugs —
+    an unclosed iterator/server pins its threads and ring buffers until
     GC happens to run."""
     yield
     from simple_tensorflow_tpu.data import pipeline
+    from simple_tensorflow_tpu.serving import server as serving_server
 
-    # dropped-but-uncollected iterators are not leaks: GC close is part
-    # of the contract, so drive it before judging
+    # dropped-but-uncollected iterators/servers are not leaks: GC close
+    # is part of the contract, so drive it before judging
     gc.collect()
     open_iters = [it for it in list(pipeline.live_iterators)
                   if not it.closed]
     for it in open_iters:  # don't poison subsequent modules
         it.close()
+    open_servers = [s for s in list(serving_server.live_servers)
+                    if not s.closed]
+    for s in open_servers:
+        s.close()
 
-    # stage threads are named stf_data_<stage>; the shared worker pool
+    # stage threads are named stf_data_<stage>, batcher threads
+    # stf_serving_batcher_<model>; the shared worker pool
     # (thread_name_prefix stf_data_worker) is process-global by design
     # and exempt. Closed stages may need a moment to observe cancel.
     def stray():
         return [t for t in threading.enumerate()
-                if t.name.startswith("stf_data_")
-                and not t.name.startswith("stf_data_worker")
+                if ((t.name.startswith("stf_data_")
+                     and not t.name.startswith("stf_data_worker"))
+                    or t.name.startswith("stf_serving_"))
                 and t.is_alive()]
 
     deadline = time.monotonic() + 5.0
@@ -59,6 +68,9 @@ def _no_pipeline_leaks():
     assert not open_iters, (
         "unclosed PipelineIterator(s) leaked by this test module "
         f"(close() them or drop all references): {open_iters!r}")
+    assert not open_servers, (
+        "open ModelServer(s) leaked by this test module (close() them "
+        f"or use a context manager): {open_servers!r}")
     assert not leaked, (
-        "leaked pipeline stage thread(s): "
+        "leaked pipeline/serving thread(s): "
         + ", ".join(t.name for t in leaked))

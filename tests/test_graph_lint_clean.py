@@ -176,3 +176,103 @@ def test_graph_lint_cli_clean_on_model_graphdef(tmp_path):
     assert graph is not None
     assert report is None  # no --mesh: sharding analysis not requested
     assert analysis.errors(diags) == []
+
+
+# ---------------------------------------------------------------------------
+# serving-export gate (ISSUE 7 satellite): inference graphs the zoo
+# exports must pass the serving-compatibility lint; the rule must fire
+# on each incompatibility class; training-purpose runs never see it.
+# ---------------------------------------------------------------------------
+
+def test_mnist_softmax_inference_serving_clean():
+    from simple_tensorflow_tpu.models import mnist
+
+    m = mnist.softmax_model(learning_rate=0.01)
+    diags = analysis.lint_graph(fetches=[m["logits"]], purpose="serving",
+                                rules=["lint/serving-incompatible"])
+    assert diags == [], analysis.format_report(diags)
+
+
+def test_serving_rule_flags_each_incompatibility_class():
+    x = stf.placeholder(stf.float32, [None, 4], name="x")
+    w = stf.Variable(stf.constant(np.ones((4, 2), np.float32)), name="w")
+    h = stf.matmul(x, w)
+    # io effect: Print fires per batch, not per request
+    h = stf.Print(h, [h], message="serving me")
+    # unseeded RNG: batch-composition-dependent responses
+    y = stf.nn.dropout(h, keep_prob=0.9)
+    # host sink: summary write forces a post-host stage
+    stf.summary.scalar("y0", stf.reduce_sum(y))
+    merged = stf.summary.merge_all()
+    diags = analysis.lint_graph(fetches=[y, merged], purpose="serving",
+                                rules=["lint/serving-incompatible"])
+    codes = [d.code for d in diags]
+    assert codes and set(codes) == {"lint/serving-incompatible"}
+    msgs = " | ".join(d.message for d in diags)
+    assert "host-stage op" in msgs
+    assert "io effect" in msgs
+    assert "unseeded stateful RNG" in msgs
+    # every diagnostic carries op + source attribution
+    for d in diags:
+        assert d.op_name and d.source
+    # the SAME graph lints clean without the serving purpose (training
+    # graphs legitimately contain all three)
+    assert analysis.lint_graph(
+        fetches=[y, merged], rules=["lint/serving-incompatible"]) == []
+
+
+def test_graph_lint_cli_serving_flag(tmp_path):
+    import json
+
+    from simple_tensorflow_tpu.framework import graph_io
+    from simple_tensorflow_tpu.tools import graph_lint
+
+    x = stf.placeholder(stf.float32, [None, 4], name="x")
+    w = stf.Variable(stf.constant(np.ones((4, 2), np.float32)), name="w")
+    y = stf.nn.dropout(stf.matmul(x, w), keep_prob=0.5, name="drop")
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    p = tmp_path / "inference.json"
+    p.write_text(json.dumps(gd))
+    y_name = y.name
+    stf.reset_default_graph()
+    diags, graph, _ = graph_lint.run_lint(
+        json.loads(p.read_text()), fetch_names=[y_name],
+        purpose="serving")
+    assert graph is not None
+    assert any(d.code == "lint/serving-incompatible" for d in diags)
+    # without --serving the rule stays silent
+    stf.reset_default_graph()
+    diags2, _, _ = graph_lint.run_lint(
+        json.loads(p.read_text()), fetch_names=[y_name])
+    assert not any(d.code == "lint/serving-incompatible" for d in diags2)
+    # the argparse surface accepts --serving and exits nonzero at
+    # warning threshold
+    rc = graph_lint.main([str(p), "--fetch", y_name, "--serving",
+                          "--max-severity", "warning"])
+    assert rc == 1
+
+
+def test_serving_rule_respects_graph_seed_and_input_boundary():
+    # graph-seeded RNG is reproducible (fold_in bakes _graph_seed):
+    # the serving rule must not flag it
+    stf.set_random_seed(42)
+    x = stf.placeholder(stf.float32, [None, 4], name="x")
+    w = stf.Variable(stf.constant(np.ones((4, 2), np.float32)), name="w")
+    y = stf.nn.dropout(stf.matmul(x, w), keep_prob=0.9)
+    diags = analysis.lint_graph(fetches=[y], purpose="serving",
+                                rules=["lint/serving-incompatible"])
+    assert not any("RNG" in d.message for d in diags), (
+        analysis.format_report(diags))
+    # input-boundary: ops UPSTREAM of the serving input are not part of
+    # the served plan — a pre-pruned op set must never be widened
+    stf.reset_default_graph()
+    raw = stf.Print(stf.constant(np.ones((2, 4), np.float32)),
+                    [stf.constant(1.0)], message="preprocess")
+    out = stf.matmul(raw, stf.constant(np.ones((4, 2), np.float32)))
+    from simple_tensorflow_tpu.framework import lowering
+
+    pruned = lowering.prune([out.op], {raw})  # raw is the fed input
+    diags = analysis.lint_graph(ops=pruned, fetches=[out],
+                                purpose="serving",
+                                rules=["lint/serving-incompatible"])
+    assert diags == [], analysis.format_report(diags)

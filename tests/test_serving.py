@@ -1,0 +1,576 @@
+"""stf.serving (ISSUE 7): export -> ModelServer.load -> serve round
+trips, continuous-batching correctness under concurrent clients,
+per-request deadline semantics, signature validation errors, AOT bucket
+warmup, and batcher unit behavior.
+
+Float bitwise caveat pinned here deliberately: XLA CPU changes matmul
+accumulation order across PHYSICAL batch sizes (bucket 1 vs 8 differ in
+the last ulp), but at a FIXED physical batch size row results are
+bitwise independent of the other rows — so padding and coalescing can
+never change an answer. The bit-for-bit acceptance test therefore runs
+(a) an exact-arithmetic int32 model across MIXED buckets against
+unbatched Session.run, and (b) a float MLP at a single fixed bucket
+against a same-physical-shape reference, plus unbatched agreement to
+float tolerance. docs/SERVING.md documents the contract.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import saved_model as sm
+from simple_tensorflow_tpu import serving
+from simple_tensorflow_tpu.serving.batcher import (ContinuousBatcher,
+                                                   ServeFuture,
+                                                   ServeRequest)
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+    stf.reset_default_graph()
+
+
+def _export_float_mlp(path, in_dim=16, hidden=8, classes=4, seed=7):
+    """Export softmax(tanh(x@w1)@w2); returns (export_dir, w1, w2)."""
+    rng = np.random.RandomState(seed)
+    w1_np = rng.randn(in_dim, hidden).astype(np.float32)
+    w2_np = rng.randn(hidden, classes).astype(np.float32)
+    x = stf.placeholder(stf.float32, [None, in_dim], name="x")
+    w1 = stf.Variable(stf.constant(w1_np), name="w1")
+    w2 = stf.Variable(stf.constant(w2_np), name="w2")
+    h = stf.tanh(stf.matmul(x, w1))
+    y = stf.nn.softmax(stf.matmul(h, w2), name="probs")
+    export_dir = str(path)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sm.simple_save(sess, export_dir, inputs={"x": x},
+                       outputs={"probs": y})
+    stf.reset_default_graph()
+    return export_dir, w1_np, w2_np
+
+
+def _export_int_model(path, in_dim=6, out_dim=5, seed=3):
+    """Exact-arithmetic model: y = x @ W + b, all int32 (bitwise
+    reproducible whatever the physical batch size)."""
+    rng = np.random.RandomState(seed)
+    w_np = rng.randint(-9, 9, size=(in_dim, out_dim)).astype(np.int32)
+    b_np = rng.randint(-9, 9, size=(out_dim,)).astype(np.int32)
+    x = stf.placeholder(stf.int32, [None, in_dim], name="xi")
+    w = stf.Variable(stf.constant(w_np), name="wi")
+    b = stf.Variable(stf.constant(b_np), name="bi")
+    y = stf.add(stf.matmul(x, w), b, name="yi")
+    export_dir = str(path)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sm.simple_save(sess, export_dir, inputs={"x": x},
+                       outputs={"y": y})
+    stf.reset_default_graph()
+    return export_dir, w_np, b_np
+
+
+class TestRoundTrip:
+    def test_export_load_serve(self, tmp_path):
+        export_dir, w1, w2 = _export_float_mlp(tmp_path / "m")
+        with serving.ModelServer() as server:
+            name = server.load(export_dir)
+            assert name == "m"
+            assert server.model_names == ["m"]
+            assert server.signature_keys() == ["serving_default"]
+            x = np.random.RandomState(0).randn(16).astype(np.float32)
+            out = server.predict({"x": x}).result(timeout=30)
+            assert set(out) == {"probs"}
+            assert out["probs"].shape == (4,)
+            expect = _softmax(np.tanh(x @ w1) @ w2)
+            np.testing.assert_allclose(out["probs"], expect, rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_aot_buckets_compiled_at_load(self, tmp_path):
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        pol = serving.BatchingPolicy(max_batch_size=4,
+                                     bucket_sizes=[1, 2, 4])
+        with serving.ModelServer(policy=pol) as server:
+            server.load(export_dir)
+            sig = server._model("m").signatures["serving_default"]
+            assert len(sig.plan.compiled_buckets()) == 3
+            # every bucket shape serves correctly (request counts 1..4)
+            for k in (1, 2, 3, 4):
+                futs = [server.predict(
+                    {"x": np.full(16, i, np.float32)}) for i in range(k)]
+                for f in futs:
+                    assert f.result(timeout=30)["probs"].shape == (4,)
+
+    def test_multi_model_ownership(self, tmp_path):
+        d1, w1, w2 = _export_float_mlp(tmp_path / "a")
+        d2, wi, bi = _export_int_model(tmp_path / "b")
+        with serving.ModelServer() as server:
+            server.load(d1, name="float_model")
+            server.load(d2, name="int_model")
+            assert server.model_names == ["float_model", "int_model"]
+            # per-model VariableStore: distinct sessions, shared device
+            sa = server._model("float_model").session
+            sb = server._model("int_model").session
+            assert sa is not sb
+            assert sa._variable_store is not sb._variable_store
+            # ambiguous model=None with two models
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="pass model"):
+                server.predict({"x": np.zeros(16, np.float32)})
+            xf = np.ones(16, np.float32)
+            xi = np.arange(6, dtype=np.int32)
+            of = server.predict({"x": xf}, model="float_model") \
+                .result(timeout=30)
+            oi = server.predict({"x": xi}, model="int_model") \
+                .result(timeout=30)
+            assert of["probs"].dtype == np.float32
+            np.testing.assert_array_equal(oi["y"], xi @ wi + bi)
+            # duplicate name refused
+            with pytest.raises(stf.errors.AlreadyExistsError):
+                server.load(d1, name="float_model")
+            server.unload("float_model")
+            assert server.model_names == ["int_model"]
+
+
+def _softmax(v):
+    e = np.exp(v - v.max())
+    return (e / e.sum()).astype(np.float32)
+
+
+class TestSignatureErrors:
+    def test_input_key_mismatch(self, tmp_path):
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        with serving.ModelServer() as server:
+            server.load(export_dir)
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="expects inputs"):
+                server.predict({"wrong": np.zeros(16, np.float32)})
+
+    def test_input_shape_mismatch(self, tmp_path):
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        with serving.ModelServer() as server:
+            server.load(export_dir)
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="per-example shape"):
+                server.predict({"x": np.zeros(7, np.float32)})
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="per-example shape"):
+                # a BATCH of examples is also a per-request shape error
+                server.predict({"x": np.zeros((2, 16), np.float32)})
+
+    def test_unknown_signature_and_model(self, tmp_path):
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        with serving.ModelServer() as server:
+            server.load(export_dir)
+            with pytest.raises(stf.errors.NotFoundError,
+                               match="serving_default"):
+                server.predict({"x": np.zeros(16, np.float32)},
+                               signature_key="nope")
+            with pytest.raises(stf.errors.NotFoundError,
+                               match="available"):
+                server.predict({"x": np.zeros(16, np.float32)},
+                               model="ghost")
+
+    def test_get_signature_def_not_found(self):
+        with pytest.raises(stf.errors.NotFoundError, match="available"):
+            sm.get_signature_def({"signature_def": {"a": {}}}, "b")
+        assert sm.get_signature_def(
+            {"signature_def": {"a": {"x": 1}}}, "a") == {"x": 1}
+
+    def test_closed_server_unavailable(self, tmp_path):
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        server = serving.ModelServer()
+        server.load(export_dir)
+        server.close()
+        with pytest.raises(stf.errors.UnavailableError):
+            server.predict({"x": np.zeros(16, np.float32)})
+        with pytest.raises(stf.errors.UnavailableError):
+            server.load(export_dir, name="again")
+        server.close()  # idempotent
+
+
+class TestConcurrentClients:
+    def test_int_model_bitwise_vs_unbatched_mixed_buckets(self, tmp_path):
+        """The acceptance contract: responses match unbatched
+        Session.run bit-for-bit despite padding/bucketing — pinned on
+        exact arithmetic so it holds across MIXED physical buckets."""
+        export_dir, w_np, b_np = _export_int_model(tmp_path / "m")
+        rng = np.random.RandomState(11)
+        examples = rng.randint(-50, 50, size=(24, 6)).astype(np.int32)
+
+        # unbatched reference: one Session.run per example, batch dim 1
+        with stf.Session() as sess:
+            meta = sm.loader.load(sess, [sm.tag_constants.SERVING],
+                                  export_dir)
+            sig = meta["signature_def"]["serving_default"]
+            xn, yn = sig["inputs"]["x"]["name"], sig["outputs"]["y"]["name"]
+            refs = [sess.run(yn, {xn: ex[None, :]})[0] for ex in examples]
+        stf.reset_default_graph()
+
+        pol = serving.BatchingPolicy(max_batch_size=8,
+                                     bucket_sizes=[1, 2, 4, 8],
+                                     batch_timeout_ms=3.0)
+        with serving.ModelServer(policy=pol) as server:
+            server.load(export_dir)
+            results = [None] * len(examples)
+            errs = []
+
+            def client(i):
+                try:
+                    # staggered arrivals -> varied live batch sizes
+                    time.sleep((i % 5) * 0.002)
+                    results[i] = server.predict(
+                        {"x": examples[i]}).result(timeout=60)["y"]
+                except BaseException as e:  # noqa: BLE001
+                    errs.append((i, e))
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(examples))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+            for i, (got, ref) in enumerate(zip(results, refs)):
+                np.testing.assert_array_equal(
+                    got, ref, err_msg=f"request {i} diverged from "
+                                      "unbatched Session.run")
+            snap = server.stats()
+            fills = snap["/stf/serving/batch_fill"]["cells"]
+            assert fills["m/serving_default"]["count"] >= 1
+
+    def test_float_fixed_bucket_bitwise_and_padding_independence(
+            self, tmp_path):
+        """At ONE physical bucket size, responses are bitwise equal to
+        a Session.run of the same physical batch shape, however the
+        batcher coalesced or padded them — padding rows can never
+        perturb a live row."""
+        export_dir, w1, w2 = _export_float_mlp(tmp_path / "m")
+        rng = np.random.RandomState(5)
+        examples = rng.randn(16, 16).astype(np.float32)
+
+        with stf.Session() as sess:
+            meta = sm.loader.load(sess, [sm.tag_constants.SERVING],
+                                  export_dir)
+            sig = meta["signature_def"]["serving_default"]
+            xn = sig["inputs"]["x"]["name"]
+            yn = sig["outputs"]["probs"]["name"]
+            # reference at the SAME physical batch size the server pads
+            # to (8): two full batches
+            ref8 = np.concatenate([sess.run(yn, {xn: examples[:8]}),
+                                   sess.run(yn, {xn: examples[8:]})])
+            # unbatched single-example reference (physical batch 1)
+            ref1 = np.stack([sess.run(yn, {xn: ex[None]})[0]
+                             for ex in examples])
+        stf.reset_default_graph()
+
+        pol = serving.BatchingPolicy(max_batch_size=8, bucket_sizes=[8],
+                                     batch_timeout_ms=5.0)
+        with serving.ModelServer(policy=pol) as server:
+            server.load(export_dir)
+            results = [None] * 16
+
+            def client(i):
+                time.sleep((i % 3) * 0.003)
+                results[i] = server.predict(
+                    {"x": examples[i]}).result(timeout=60)["probs"]
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            got = np.stack(results)
+            # bitwise vs the fixed-physical-shape reference
+            np.testing.assert_array_equal(got, ref8)
+            # and float-tolerance agreement with the unbatched run
+            # (XLA CPU retiles matmuls across physical batch sizes;
+            # see module docstring)
+            np.testing.assert_allclose(got, ref1, rtol=1e-5, atol=1e-6)
+
+
+class TestDeadlines:
+    def test_expired_in_queue_structured_error_batch_proceeds(self):
+        """ISSUE 7 satellite: RunOptions.timeout_in_ms semantics in the
+        request path — an expired request resolves with
+        DeadlineExceededError while the rest of its would-be batch
+        executes normally."""
+        gate = threading.Event()
+        buckets = []
+
+        def exec_fn(feeds, bucket):
+            gate.wait(10)
+            buckets.append(bucket)
+            return {"y": feeds["x"] * 2.0}
+
+        pol = serving.BatchingPolicy(max_batch_size=2, batch_timeout_ms=1,
+                                     max_queue_depth=8)
+        b = ContinuousBatcher("t/deadline", exec_fn, pol)
+        try:
+            f1 = ServeFuture("t/deadline")
+            b.submit(ServeRequest({"x": np.float32([1.0])}, f1, None))
+            time.sleep(0.05)  # batcher holds batch 1 at the gate
+            f2 = ServeFuture("t/deadline")
+            b.submit(ServeRequest({"x": np.float32([2.0])}, f2,
+                                  time.perf_counter() + 0.05))
+            f3 = ServeFuture("t/deadline")
+            b.submit(ServeRequest({"x": np.float32([3.0])}, f3, None))
+            time.sleep(0.15)  # f2's deadline expires while queued
+            gate.set()
+            assert f1.result(timeout=10)["y"][0] == 2.0
+            with pytest.raises(stf.errors.DeadlineExceededError,
+                               match="timeout_in_ms"):
+                f2.result(timeout=10)
+            assert f2.done() and f2.exception() is not None
+            # f3 rode the next batch untouched by f2's expiry
+            assert f3.result(timeout=10)["y"][0] == 6.0
+        finally:
+            gate.set()
+            b.close()
+
+    def test_admission_backpressure_deadline(self):
+        """A full admission queue blocks submitters (backpressure); a
+        deadline bounds the wait with a structured error."""
+        gate = threading.Event()
+
+        def exec_fn(feeds, bucket):
+            gate.wait(10)
+            return {"y": feeds["x"]}
+
+        pol = serving.BatchingPolicy(max_batch_size=1, batch_timeout_ms=0,
+                                     max_queue_depth=1)
+        b = ContinuousBatcher("t/backpressure", exec_fn, pol)
+        try:
+            f1 = ServeFuture("t/backpressure")
+            b.submit(ServeRequest({"x": np.float32([1.0])}, f1, None))
+            time.sleep(0.05)  # batcher took f1, is blocked at the gate
+            f2 = ServeFuture("t/backpressure")
+            b.submit(ServeRequest({"x": np.float32([2.0])}, f2, None))
+            # queue now full: a deadline-bounded submit must fail fast
+            f3 = ServeFuture("t/backpressure")
+            t0 = time.perf_counter()
+            b.submit(ServeRequest({"x": np.float32([3.0])}, f3,
+                                  time.perf_counter() + 0.08))
+            assert time.perf_counter() - t0 < 5.0
+            with pytest.raises(stf.errors.DeadlineExceededError,
+                               match="admission"):
+                f3.result(timeout=10)
+            gate.set()
+            assert f1.result(timeout=10)["y"][0] == 1.0
+            assert f2.result(timeout=10)["y"][0] == 2.0
+        finally:
+            gate.set()
+            b.close()
+
+    def test_run_options_wiring_through_predict(self, tmp_path):
+        """options=RunOptions(timeout_in_ms=...) reaches the request
+        deadline (generous deadline -> success; the deadline plumbing
+        itself is pinned by the batcher tests above)."""
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        with serving.ModelServer() as server:
+            server.load(export_dir)
+            out = server.predict(
+                {"x": np.zeros(16, np.float32)},
+                options=stf.RunOptions(timeout_in_ms=60000)) \
+                .result(timeout=60)
+            assert out["probs"].shape == (4,)
+
+    def test_policy_default_timeout(self):
+        pol = serving.BatchingPolicy(default_timeout_ms=25.0)
+        assert pol.default_timeout_ms == 25.0
+        # the batcher marks queue-expired requests without executing
+        gate = threading.Event()
+
+        def exec_fn(feeds, bucket):
+            gate.wait(10)
+            return {"y": feeds["x"]}
+
+        b = ContinuousBatcher(
+            "t/default_to", exec_fn,
+            serving.BatchingPolicy(max_batch_size=1, batch_timeout_ms=0))
+        try:
+            f1 = ServeFuture("t/default_to")
+            b.submit(ServeRequest({"x": np.float32([1.0])}, f1, None))
+            time.sleep(0.05)
+            f2 = ServeFuture("t/default_to")
+            b.submit(ServeRequest({"x": np.float32([2.0])}, f2,
+                                  time.perf_counter() + 0.02))
+            time.sleep(0.1)
+            gate.set()
+            with pytest.raises(stf.errors.DeadlineExceededError):
+                f2.result(timeout=10)
+        finally:
+            gate.set()
+            b.close()
+
+
+class TestBatcherMechanics:
+    def test_batch_closes_on_max_size(self):
+        seen = []
+
+        def exec_fn(feeds, bucket):
+            seen.append((len(feeds["x"]), bucket))
+            return {"y": feeds["x"]}
+
+        pol = serving.BatchingPolicy(max_batch_size=4,
+                                     batch_timeout_ms=10_000,
+                                     bucket_sizes=[4])
+        b = ContinuousBatcher("t/maxsize", exec_fn, pol)
+        try:
+            futs = []
+            for i in range(4):
+                f = ServeFuture("t/maxsize")
+                futs.append(f)
+                b.submit(ServeRequest({"x": np.float32([i])}, f, None))
+            # a full batch must close LONG before the 10 s timeout
+            for f in futs:
+                f.result(timeout=5)
+            assert seen and seen[0] == (4, 4)
+        finally:
+            b.close()
+
+    def test_batch_closes_on_timeout(self):
+        def exec_fn(feeds, bucket):
+            return {"y": feeds["x"]}
+
+        pol = serving.BatchingPolicy(max_batch_size=64,
+                                     batch_timeout_ms=10.0,
+                                     bucket_sizes=[2, 64])
+        b = ContinuousBatcher("t/timeout", exec_fn, pol)
+        try:
+            f = ServeFuture("t/timeout")
+            t0 = time.perf_counter()
+            b.submit(ServeRequest({"x": np.float32([1.0])}, f, None))
+            out = f.result(timeout=5)
+            assert out["y"][0] == 1.0
+            # closed by timeout (~10ms), nowhere near a full batch
+            assert time.perf_counter() - t0 < 4.0
+        finally:
+            b.close()
+
+    def test_pad_modes(self):
+        captured = {}
+
+        def exec_fn(feeds, bucket):
+            captured["x"] = feeds["x"].copy()
+            return {"y": feeds["x"]}
+
+        for mode, expect_row in (("repeat", 7.0), ("zero", 0.0)):
+            pol = serving.BatchingPolicy(max_batch_size=1,
+                                         batch_timeout_ms=0,
+                                         bucket_sizes=[4], pad_mode=mode)
+            b = ContinuousBatcher(f"t/pad_{mode}", exec_fn, pol)
+            try:
+                f = ServeFuture("t/pad")
+                b.submit(ServeRequest({"x": np.float32([7.0])}, f, None))
+                assert f.result(timeout=5)["y"][0] == 7.0
+                assert captured["x"].shape == (4, 1)
+                assert captured["x"][3, 0] == expect_row
+            finally:
+                b.close()
+
+    def test_bucket_for(self):
+        pol = serving.BatchingPolicy(max_batch_size=16)
+        assert pol.bucket_sizes == [1, 2, 4, 8, 16]
+        assert pol.bucket_for(1) == 1
+        assert pol.bucket_for(3) == 4
+        assert pol.bucket_for(16) == 16
+        pol2 = serving.BatchingPolicy(max_batch_size=6,
+                                      bucket_sizes=[4])
+        assert pol2.bucket_sizes == [4, 6]
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            serving.BatchingPolicy(max_batch_size=0)
+        with pytest.raises(ValueError):
+            serving.BatchingPolicy(pad_mode="extrapolate")
+        with pytest.raises(ValueError):
+            serving.BatchingPolicy(batch_timeout_ms=-1)
+
+    def test_close_drains_queued_requests(self):
+        def exec_fn(feeds, bucket):
+            time.sleep(0.01)
+            return {"y": feeds["x"] + 1.0}
+
+        pol = serving.BatchingPolicy(max_batch_size=2, batch_timeout_ms=1)
+        b = ContinuousBatcher("t/drain", exec_fn, pol)
+        futs = []
+        for i in range(6):
+            f = ServeFuture("t/drain")
+            futs.append(f)
+            b.submit(ServeRequest({"x": np.float32([i])}, f, None))
+        b.close()  # queued requests still execute (drain semantics)
+        for i, f in enumerate(futs):
+            assert f.result(timeout=10)["y"][0] == i + 1.0
+        # post-close submits fail structured
+        f = ServeFuture("t/drain")
+        b.submit(ServeRequest({"x": np.float32([0.0])}, f, None))
+        with pytest.raises(stf.errors.UnavailableError):
+            f.result(timeout=5)
+
+
+class TestMetricsAndLifecycle:
+    def test_windowed_rate_decays_to_zero(self):
+        from simple_tensorflow_tpu.platform.monitoring import WindowedRate
+
+        wr = WindowedRate(window_s=10.0)
+        wr.add(100, now=1000.0)
+        assert wr.rate(now=1005.0) == pytest.approx(10.0)
+        # idle past the window: the rate must decay to 0, not stick
+        assert wr.rate(now=1020.0) == 0.0
+
+    def test_stats_refreshes_qps_gauge(self, tmp_path):
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        with serving.ModelServer() as server:
+            server.load(export_dir)
+            server.predict({"x": np.zeros(16, np.float32)}) \
+                .result(timeout=30)
+            bt = server._model("m").signatures["serving_default"].batcher
+            # simulate the last-batch write going stale: traffic stopped
+            # long ago but the gauge still holds the old rate
+            bt._qps_gauge.set(12345)
+            snap = server.stats()
+            cell = snap["/stf/serving/qps"]["cells"]["m/serving_default"]
+            assert cell != 12345  # refreshed from the trailing window
+
+    def test_close_during_load_aborts_cleanly(self, tmp_path):
+        """A load that completes after close() must not insert a model
+        whose session/batcher threads nothing would ever tear down."""
+        export_dir, _, _ = _export_float_mlp(tmp_path / "m")
+        server = serving.ModelServer()
+        orig_warmup = serving.ModelServer._warmup
+        entered = threading.Event()
+        release = threading.Event()
+
+        def slow_warmup(self, model):
+            entered.set()
+            release.wait(10)
+            return orig_warmup(self, model)
+
+        result = {}
+
+        def do_load():
+            try:
+                server.load(export_dir, name="raced")
+                result["ok"] = True
+            except stf.errors.UnavailableError as e:
+                result["err"] = e
+
+        serving.ModelServer._warmup = slow_warmup
+        try:
+            th = threading.Thread(target=do_load)
+            th.start()
+            assert entered.wait(10)
+            server.close()  # snapshots (empty) models, sets _closed
+            release.set()
+            th.join(20)
+        finally:
+            serving.ModelServer._warmup = orig_warmup
+        assert "err" in result and "ok" not in result
+        time.sleep(0.3)
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("stf_serving_")]

@@ -524,3 +524,102 @@ def test_traced_then_shape_change_falls_back_and_recomputes():
             assert md2.cost_graph["flops"] > flops_b2
         out = sess.run(y, {x: np.ones((2, 3), np.float32)})
         assert out.shape == (2,)
+
+
+class TestExecutionPlan:
+    """Session.plan/ExecutionPlan.execute — the explicit plan/execute
+    split of Session.run that stf.serving drives (ISSUE 7 tentpole)."""
+
+    def test_plan_execute_matches_run(self):
+        x = stf.placeholder(stf.float32, [None, 3], name="pe_x")
+        w = stf.Variable(stf.constant(np.float32([[1.], [2.], [3.]])),
+                         name="pe_w")
+        y = stf.matmul(x, w)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            feed = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+            ref = sess.run(y, {x: feed})
+            plan = sess.plan(y, feeds=[x])
+            out = plan.execute({x: feed})
+            np.testing.assert_array_equal(out, ref)
+            # structured fetches rebuild through the plan's mapper
+            plan2 = sess.plan({"y": y, "x_thru": x}, feeds=[x])
+            out2 = plan2.execute({x: feed})
+            assert set(out2) == {"y", "x_thru"}
+            np.testing.assert_array_equal(out2["y"], ref)
+
+    def test_plan_shares_executable_cache_with_run(self):
+        from simple_tensorflow_tpu.client import session as session_mod
+
+        x = stf.placeholder(stf.float32, [2, 2], name="pc_x")
+        y = stf.add(x, x)
+        with stf.Session() as sess:
+            plan = sess.plan(y, feeds=[x])
+            hits = session_mod._metric_cache_hits.get_cell().value()
+            # an identical run() signature must HIT the plan's cache
+            # entry, not re-plan
+            sess.run(y, {x: np.zeros((2, 2), np.float32)})
+            assert session_mod._metric_cache_hits.get_cell().value() \
+                == hits + 1
+            # and the plan executes the same step object
+            assert plan.step is sess._cache[plan._key]
+
+    def test_feed_signature_mismatch_raises(self):
+        x = stf.placeholder(stf.float32, [2], name="fm_x")
+        z = stf.placeholder(stf.float32, [2], name="fm_z")
+        y = stf.add(x, x)
+        with stf.Session() as sess:
+            plan = sess.plan(y, feeds=[x])
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="must match the planned"):
+                plan.execute({})
+            with pytest.raises(stf.errors.InvalidArgumentError,
+                               match="must match the planned"):
+                plan.execute({x: np.zeros(2, np.float32),
+                              z: np.zeros(2, np.float32)})
+
+    def test_aot_bucket_compile_and_reuse(self):
+        from simple_tensorflow_tpu.compiler import aot
+
+        x = stf.placeholder(stf.float32, [None, 4], name="ab_x")
+        w = stf.Variable(stf.constant(np.ones((4, 2), np.float32)),
+                         name="ab_w")
+        y = stf.matmul(x, w)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            plan = sess.plan(y, feeds=[x])
+            exe = plan.compile({x: (8, 4)})
+            assert isinstance(exe, aot.AotStepExecutable)
+            assert exe.feed_signature in plan.step.aot_cache
+            assert "hlo" in exe.hlo_text.lower() or exe.hlo_text
+            # matching execution uses the bucket executable; a
+            # different batch size still works through the jit path
+            out8 = plan.execute({x: np.ones((8, 4), np.float32)})
+            out3 = plan.execute({x: np.ones((3, 4), np.float32)})
+            assert out8.shape == (8, 2) and out3.shape == (3, 2)
+            assert np.all(out8 == 4.0) and np.all(out3 == 4.0)
+            # dynamic-dim feed without an override is refused
+            with pytest.raises(ValueError, match="dynamic shape"):
+                plan.compile()
+
+    def test_plan_on_closed_session_raises(self):
+        x = stf.placeholder(stf.float32, [2], name="cl_x")
+        y = stf.add(x, x)
+        sess = stf.Session()
+        plan = sess.plan(y, feeds=[x])
+        sess.close()
+        with pytest.raises(RuntimeError, match="closed Session"):
+            plan.execute({x: np.zeros(2, np.float32)})
+        with pytest.raises(RuntimeError, match="closed Session"):
+            sess.plan(y, feeds=[x])
+
+    def test_execute_as_futures(self):
+        x = stf.placeholder(stf.float32, [2], name="af_x")
+        y = stf.multiply(x, stf.constant(np.float32(2.0)))
+        with stf.Session() as sess:
+            plan = sess.plan(y, feeds=[x])
+            fut = plan.execute({x: np.float32([1.0, 2.0])},
+                               as_futures=True)
+            assert isinstance(fut, stf.FetchFuture)
+            np.testing.assert_array_equal(np.asarray(fut),
+                                          np.float32([2.0, 4.0]))
