@@ -1194,6 +1194,284 @@ def _measure_serving(platform, device_kind):
     }
 
 
+def _measure_telemetry(platform, device_kind):
+    """Telemetry row (ISSUE 8 satellite): serving QPS and train-loop
+    step time with the WHOLE telemetry plane ON (flight recorder +
+    per-request span tracing + HTTP exporter being scraped) vs OFF.
+
+    Two measurements, because this box cannot certify a 3% bound with
+    wall clocks alone (consecutive IDENTICAL serving rounds show a
+    ~20-25% QPS coefficient of variation — measured, reported in the
+    row):
+
+    - A/B medians of PAIRED ABBA rounds (``ab_*`` fields):
+      informational; the honest wall-clock numbers with their noise.
+    - The PINNED overhead (``value``): measured per-event costs
+      (record / emit_span / a /metrics render, microbenched in this
+      process) x measured event rates (counter deltas during the ON
+      rounds), conservatively assuming every telemetry microsecond
+      serializes against the workload. Both factors are real
+      measurements; no wall-clock subtraction, so no noise floor.
+
+    The acceptance bar pins the WORST of the serving and train
+    accounted fractions < 3%."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import saved_model as sm
+    from simple_tensorflow_tpu import serving, telemetry
+    from simple_tensorflow_tpu.platform import monitoring
+    from simple_tensorflow_tpu.telemetry import tracing as ttracing
+
+    rounds = int(os.environ.get("BENCH_TELEMETRY_ROUNDS", "6"))
+    serve_s = float(os.environ.get("BENCH_TELEMETRY_SECONDS", "1.5"))
+    n_clients = 8
+    train_steps = int(os.environ.get("BENCH_TELEMETRY_TRAIN_STEPS",
+                                     "400"))
+    in_dim, hidden, classes = 128, 256, 10
+    rng = np.random.RandomState(0)
+
+    # -- serving arm ---------------------------------------------------------
+    x = stf.placeholder(stf.float32, [None, in_dim], name="x")
+    w1 = stf.Variable(stf.constant(
+        (rng.randn(in_dim, hidden) * 0.05).astype(np.float32)), name="w1")
+    w2 = stf.Variable(stf.constant(
+        (rng.randn(hidden, classes) * 0.05).astype(np.float32)),
+        name="w2")
+    probs = stf.nn.softmax(stf.matmul(stf.tanh(stf.matmul(x, w1)), w2),
+                           name="probs")
+    tmp = tempfile.mkdtemp(prefix="stf_bench_telemetry_")
+    export_dir = os.path.join(tmp, "model")
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sm.simple_save(sess, export_dir, inputs={"x": x},
+                       outputs={"probs": probs})
+    stf.reset_default_graph()
+    examples = rng.randn(64, in_dim).astype(np.float32)
+
+    def serving_round(server, seconds):
+        counts = [0] * n_clients
+        gate = threading.Barrier(n_clients + 1)
+        stop_at = [0.0]
+
+        def client(i):
+            gate.wait()
+            j = i
+            while time.perf_counter() < stop_at[0]:
+                server.predict({"x": examples[j % 64]}).result(
+                    timeout=120)
+                counts[i] += 1
+                j += n_clients
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        stop_at[0] = t0 + seconds
+        gate.wait()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    # -- train arm -----------------------------------------------------------
+    g = stf.Graph()
+    with g.as_default():
+        xt = stf.placeholder(stf.float32, [32, in_dim], name="xt")
+        wt = stf.get_variable(
+            "wt", [in_dim, in_dim],
+            initializer=stf.random_normal_initializer(stddev=0.05))
+        loss = stf.reduce_sum(stf.matmul(xt, wt))
+        opt = stf.train.GradientDescentOptimizer(1e-4).minimize(loss)
+        train_sess = stf.Session(graph=g)
+        with g.as_default():
+            train_sess.run(stf.global_variables_initializer())
+    feed = {xt: np.ones((32, in_dim), np.float32)}
+
+    def train_round(steps):
+        train_sess.run(opt, feed)  # warm (compile outside the clock)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            train_sess.run(opt, feed)
+        return (time.perf_counter() - t0) / steps
+
+    rec = telemetry.get_recorder()
+
+    def set_plane(on):
+        rec.set_enabled(on)
+        ttracing.set_enabled(on)
+
+    scrape_errors = []
+    try:
+        server = serving.ModelServer(policy=serving.BatchingPolicy(
+            max_batch_size=16, batch_timeout_ms=0.5,
+            max_queue_depth=64))
+        server.load(export_dir, name="bench_telemetry")
+        for _ in range(4):  # warm every arm outside the clock
+            server.predict({"x": examples[0]}).result(timeout=120)
+        train_round(8)
+
+        tsrv = telemetry.start(port=0)
+        scrape_stop = threading.Event()
+        scrapes = [0]
+
+        def scraper():
+            # a live Prometheus scraper is part of the ON cost (a
+            # production scrape interval is 10-60 s; 250 ms here makes
+            # the exporter cost VISIBLE at bench timescales, it does
+            # not model a real scraper's duty cycle)
+            while not scrape_stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                            tsrv.url + "/metrics", timeout=10) as r:
+                        r.read()
+                    scrapes[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    scrape_errors.append(repr(e))
+                scrape_stop.wait(0.25)
+
+        def measure_arm(on):
+            if on:
+                set_plane(True)
+                scrape_stop.clear()
+                th = threading.Thread(target=scraper, daemon=True,
+                                      name="stf_bench_scraper")
+                th.start()
+            else:
+                set_plane(False)
+                th = None
+            q = serving_round(server, serve_s)
+            s = train_round(train_steps)
+            if th is not None:
+                scrape_stop.set()
+                th.join(10)
+            return q, s
+
+        def _flight_counts():
+            snap = monitoring.export().get(
+                "/stf/telemetry/flight_events", {})
+            cells = snap.get("cells") or {}
+            return sum(cells.values()), cells.get("span", 0)
+
+        qps_off, qps_on, step_off, step_on = [], [], [], []
+        ev0, span0 = _flight_counts()
+        on_wall = 0.0
+        requests_on = 0
+        for i in range(rounds):
+            # ABBA: alternate which arm goes first so slow drift (CPU
+            # frequency, page cache, the ~2x box noise) cancels instead
+            # of biasing whichever arm always runs second
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for on in order:
+                t_arm = time.perf_counter()
+                q, s = measure_arm(on)
+                (qps_on if on else qps_off).append(q)
+                (step_on if on else step_off).append(s)
+                if on:
+                    on_wall += time.perf_counter() - t_arm
+                    requests_on += int(q * serve_s)
+        ev1, span1 = _flight_counts()
+
+        # per-event cost microbenches, in this process, plane ON
+        set_plane(True)
+        n_micro = 3000
+        t0 = time.perf_counter()
+        for _ in range(n_micro):
+            rec.record("bench_probe", dur_s=0.001, n=1)
+        cost_record_us = (time.perf_counter() - t0) / n_micro * 1e6
+        t0 = time.perf_counter()
+        for _ in range(n_micro):
+            ttracing.emit_span("bench_probe", 0.0, 0.001,
+                               trace_id="bench", model="m")
+        cost_span_us = (time.perf_counter() - t0) / n_micro * 1e6
+        t0 = time.perf_counter()
+        for _ in range(20):
+            monitoring.to_prometheus()
+        cost_scrape_us = (time.perf_counter() - t0) / 20 * 1e6 * 2.0
+        # (x2: HTTP framing/handler roughly doubles the render cost)
+        server.close()
+        train_sess.close()
+        telemetry.shutdown()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    q_off = float(np.median(qps_off))
+    q_on = float(np.median(qps_on))
+    s_off = float(np.median(step_off))
+    s_on = float(np.median(step_on))
+    # informational A/B: median of PAIRED per-round ratios (adjacent
+    # windows share box weather) + the noise floor that bounds what
+    # this method can resolve
+    q_ratios = [on / max(off, 1e-9)
+                for on, off in zip(qps_on, qps_off)]
+    s_ratios = [on / max(off, 1e-12)
+                for on, off in zip(step_on, step_off)]
+    ab_serving = 1.0 - float(np.median(q_ratios))
+    ab_train = float(np.median(s_ratios)) - 1.0
+    qps_cv = float(np.std(qps_off) / max(np.mean(qps_off), 1e-9))
+
+    # pinned overhead: measured per-event costs x measured event rates,
+    # conservatively charged as fully-serialized microseconds
+    span_events = max(span1 - span0, 0)
+    other_events = max((ev1 - ev0) - span_events, 0)
+    reqs = max(requests_on, 1)
+    spans_per_req = span_events / reqs
+    other_per_req = other_events / reqs
+    overhead_us_per_req = (spans_per_req * cost_span_us
+                           + other_per_req * cost_record_us)
+    scrape_rate = scrapes[0] / max(on_wall, 1e-9)
+    scrape_frac = scrape_rate * cost_scrape_us / 1e6
+    serving_overhead = overhead_us_per_req * q_on / 1e6 + scrape_frac
+    # train: run events sampled 1/16 (see session.py)
+    train_overhead = (cost_record_us / 16.0) / max(s_on * 1e6, 1e-9) \
+        + scrape_frac
+    worst = max(serving_overhead, train_overhead)
+    return {
+        **_monitoring_info(),
+        "metric": "telemetry_overhead_frac",
+        "value": round(worst, 4),
+        "unit": "fraction (worst of serving/train accounted overhead: "
+                "measured per-event cost x measured event rate, "
+                "serialized-worst-case; telemetry plane fully ON)",
+        "vs_baseline": None,
+        "budget": 0.03,
+        "within_budget": bool(worst < 0.03),
+        "serving_overhead_frac": round(serving_overhead, 4),
+        "train_overhead_frac": round(train_overhead, 4),
+        "cost_record_us": round(cost_record_us, 2),
+        "cost_span_us": round(cost_span_us, 2),
+        "cost_scrape_us": round(cost_scrape_us, 1),
+        "spans_per_request": round(spans_per_req, 2),
+        "other_events_per_request": round(other_per_req, 3),
+        "scrapes_per_s": round(scrape_rate, 2),
+        "ab_serving_overhead_frac": round(ab_serving, 4),
+        "ab_train_overhead_frac": round(ab_train, 4),
+        "ab_qps_noise_cv": round(qps_cv, 3),
+        "ab_note": ("ab_* are paired-ABBA wall-clock medians; with "
+                    "ab_qps_noise_cv this large they bound, not "
+                    "resolve, a 3% effect — the pinned value is the "
+                    "accounted overhead above"),
+        "qps_on": round(q_on, 1), "qps_off": round(q_off, 1),
+        "step_ms_on": round(s_on * 1e3, 4),
+        "step_ms_off": round(s_off * 1e3, 4),
+        "qps_on_rounds": [round(v, 1) for v in qps_on],
+        "qps_off_rounds": [round(v, 1) for v in qps_off],
+        "step_ms_on_rounds": [round(v * 1e3, 4) for v in step_on],
+        "step_ms_off_rounds": [round(v * 1e3, 4) for v in step_off],
+        "metrics_scrapes_during_on": scrapes[0],
+        "scrape_errors": scrape_errors[:3],
+        "rounds": rounds,
+        "n_clients": n_clients,
+        "train_steps_per_round": train_steps,
+        "flight_recorder": rec.stats(),
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _measure_transformer(batch, platform, device_kind):
     """BASELINE config 5: Transformer-big WMT en-de training step +
     beam-search inference latency. Comparator 2000 tokens/sec is a
@@ -1502,6 +1780,8 @@ def child_main():
         result = _measure_input_pipeline(platform, kind)
     elif model == "serving":
         result = _measure_serving(platform, kind)
+    elif model == "telemetry":
+        result = _measure_telemetry(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -1606,7 +1886,8 @@ def _run_model(model, platform, kind, errors):
                        "analysis": "600", "sharding_analysis": "900",
                        "loop_fusion": "900",
                        "input_pipeline": "600",
-                       "serving": "900"}.get(
+                       "serving": "900",
+                       "telemetry": "900"}.get(
         model, "900")
     extra_xla_flags = ""
     if model == "loop_fusion":
@@ -1676,6 +1957,9 @@ _METRIC_NAMES = {
     "input_pipeline": ("input_pipeline_records_per_sec", "records/sec"),
     "serving": ("serving_qps_speedup_batched_vs_batch1",
                 "x (QPS, 16 concurrent closed-loop clients)"),
+    "telemetry": ("telemetry_overhead_frac",
+                  "fraction (worst of serving QPS loss / train "
+                  "step-time growth, telemetry ON vs OFF)"),
     "warm_start": ("warm_start_warmup_plus_compile_s",
                    "s (second process, shared persistent compile cache)"),
 }
@@ -1698,7 +1982,7 @@ def main():
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
             "sharding_analysis,loop_fusion,input_pipeline,serving,"
-            "warm_start").split(","):
+            "telemetry,warm_start").split(","):
         tok = tok.strip()
         if not tok:
             continue
@@ -1715,7 +1999,8 @@ def main():
         selected = ["resnet", "bert", "transformer", "mnist",
                     "resnet_dp", "graph_opt", "analysis",
                     "sharding_analysis", "loop_fusion",
-                    "input_pipeline", "serving", "warm_start"]
+                    "input_pipeline", "serving", "telemetry",
+                    "warm_start"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

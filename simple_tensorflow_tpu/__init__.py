@@ -272,4 +272,9 @@ from .framework.random_seed import get_seed
 # framework (stf.analysis; see docs/ANALYSIS.md)
 from . import analysis
 
+# production telemetry plane: HTTP metrics/status server, request
+# tracing, flight recorder + watchdog (stf.telemetry;
+# docs/OBSERVABILITY.md)
+from . import telemetry
+
 newaxis = None

@@ -34,6 +34,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -43,11 +44,17 @@ from ..framework import graph as ops_mod
 from ..framework import lowering as lowering_mod
 from ..framework import errors
 from ..platform import monitoring
+from ..telemetry import recorder as _flight_mod
+from ..telemetry import tracing as _req_tracing
 
 Tensor = ops_mod.Tensor
 Operation = ops_mod.Operation
 
 _default_session_stack = threading.local()
+
+# every constructed Session, while alive — the telemetry server's
+# /statusz reads plan-cache and variable-store summaries from here
+live_sessions: "weakref.WeakSet" = weakref.WeakSet()
 
 # -- lifecycle metrics (ref: core/common_runtime metrics in
 # core/framework/metrics.cc; see docs/OBSERVABILITY.md for the catalog) ------
@@ -99,6 +106,89 @@ _PHASE_TRACK = {"prune": 0, "optimize": 0, "lower": 0,
                 "host_stage": 1, "post_host_stage": 1,
                 "jit_compile": 2, "cost_analysis": 2, "device_execute": 2}
 _TRACK_NAMES = {0: "planning", 1: "host", 2: "device"}
+# traced run_steps adds a fourth track breaking the fused window down
+# by graph op (cost-model attribution; docs/OBSERVABILITY.md)
+_ATTRIBUTED_TRACK = 3
+
+
+def _attributed_device_nodes(step, window_node, min_frac=0.005,
+                             top_k=24) -> List[Dict[str, Any]]:
+    """Device-time attribution (ISSUE 8 tentpole): child spans breaking
+    the ``fused_device_execute`` bar down by graph op. Per-op weights
+    are the static cost model's flops+bytes estimates (the accounting
+    the bench rows and RunMetadata.cost_graph already use), scaled into
+    the MEASURED window duration; plan order is preserved, and ops
+    below ``min_frac`` of the total (or beyond the ``top_k`` heaviest)
+    merge into "(k small ops)" segments so the track stays readable."""
+    from ..framework import cost_model
+
+    ops = step.device_ops
+    weights: List[float] = []
+    total = 0.0
+    for op in ops:
+        try:
+            w = float(cost_model._op_flops(op)
+                      + cost_model._op_bytes_dispatch(op))
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            w = 0.0
+        weights.append(w)
+        total += w
+    if total <= 0:
+        return []
+    heavy = set(sorted(range(len(ops)),
+                       key=lambda i: -weights[i])[:top_k])
+    nodes: List[Dict[str, Any]] = []
+    start, dur = window_node["start_us"], window_node["dur_us"]
+    cursor = start
+    pend_w, pend_n = 0.0, 0
+
+    def _flush():
+        nonlocal cursor, pend_w, pend_n
+        if pend_n:
+            d = dur * pend_w / total
+            nodes.append({"name": f"({pend_n} small ops)",
+                          "start_us": cursor, "dur_us": max(d, 0.1),
+                          "tid": _ATTRIBUTED_TRACK,
+                          "args": {"frac": f"{pend_w / total:.4f}"}})
+            cursor += d
+            pend_w, pend_n = 0.0, 0
+
+    for i, op in enumerate(ops):
+        if i in heavy and weights[i] >= min_frac * total:
+            _flush()
+            d = dur * weights[i] / total
+            nodes.append({"name": f"{op.type}:{op.name}",
+                          "start_us": cursor, "dur_us": max(d, 0.1),
+                          "tid": _ATTRIBUTED_TRACK,
+                          "args": {"frac": f"{weights[i] / total:.4f}",
+                                   "op_type": op.type}})
+            cursor += d
+        else:
+            pend_w += weights[i]
+            pend_n += 1
+    _flush()
+    return nodes
+
+
+def _drain_spans_to_nodes(buf: "monitoring.TraceBuffer",
+                          base_s: float) -> List[Dict[str, Any]]:
+    """Traced-run span buffer -> step_stats ``nodes`` (chrome-trace
+    rows), feeding the per-phase seconds sampler along the way. Shared
+    by ``run`` and the fused ``run_steps`` path."""
+    nodes: List[Dict[str, Any]] = []
+    for span in sorted(buf.drain(), key=lambda s: s["start_s"]):
+        phase = span["name"].split(":")[0]
+        node = {
+            "name": span["name"],
+            "start_us": (span["start_s"] - base_s) * 1e6,
+            "dur_us": max(span["dur_s"] * 1e6, 1.0),
+            "tid": _PHASE_TRACK.get(phase, 0),
+        }
+        if span.get("meta"):
+            node["args"] = {k: str(v) for k, v in span["meta"].items()}
+        nodes.append(node)
+        _metric_phase_seconds.get_cell(phase).add(span["dur_s"])
+    return nodes
 
 
 def _check_deadline(deadline, what):
@@ -651,7 +741,13 @@ class ExecutionPlan:
         values = sess._execute_plan(self._step, self._mapper.elements,
                                     feeds, deadline=deadline,
                                     async_fetches=as_futures)
-        _metric_run_seconds.get_cell().add(time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        _metric_run_seconds.get_cell().add(dur)
+        if _req_tracing.current_trace_ids() is not None:
+            # request-scoped tracing: inside a serving batch's trace
+            # scope, link the executor dispatch to the riding requests
+            _req_tracing.emit_span("plan_execute", t0, dur,
+                                   n_feeds=len(feeds))
         return self._mapper.rebuild(values)
 
     __call__ = execute
@@ -682,6 +778,16 @@ class BaseSession:
             from ..compiler import aot
 
             aot.enable_persistent_cache(cache_dir)
+        # telemetry plane (ISSUE 8): ConfigProto(telemetry_port=...)
+        # starts the process's HTTP server (/metrics /healthz /statusz
+        # /tracez /flightz). PROCESS-GLOBAL like the compile cache: the
+        # server outlives this Session.
+        telemetry_port = getattr(config, "telemetry_port", None) \
+            if config is not None else None
+        if telemetry_port is not None:
+            from .. import telemetry
+
+            telemetry.start(port=telemetry_port)
         self._guard_warned: Set[str] = set()
         self._fusion_warned: Set[Any] = set()
         self._variable_store = VariableStore()
@@ -702,6 +808,10 @@ class BaseSession:
         # jax.Arrays that never round-trip through host numpy)
         self._handles: Dict[str, Any] = {}
         self._handle_counter = 0
+        # flight-recorder run-event sampling state (see run())
+        self._run_events = 0
+        self._run_dur_ewma: Optional[float] = None
+        live_sessions.add(self)
 
     # -- stf.analysis hooks --------------------------------------------------
     def _hazard_mode(self) -> str:
@@ -920,16 +1030,43 @@ class BaseSession:
         buf = monitoring.TraceBuffer() if trace else None
         import contextlib
 
-        with (monitoring.trace_collection(buf) if trace
-              else contextlib.nullcontext()):
-            mapper = _FetchMapper(self._graph, fetches)
-            feeds = self._normalize_feeds(feed_dict)
-            values = self._run_elements(mapper.elements, feeds,
-                                        collector=collector,
-                                        deadline=deadline)
+        try:
+            with (monitoring.trace_collection(buf) if trace
+                  else contextlib.nullcontext()):
+                mapper = _FetchMapper(self._graph, fetches)
+                feeds = self._normalize_feeds(feed_dict)
+                values = self._run_elements(mapper.elements, feeds,
+                                            collector=collector,
+                                            deadline=deadline)
+        except Exception as e:
+            # flight recorder (docs/OBSERVABILITY.md): the event is the
+            # forensics breadcrumb; device-stage failures additionally
+            # auto-dump from _execute_plan's on_error hook
+            _flight_mod.get_recorder().record(
+                "error", where="session_run",
+                error_type=type(e).__name__, message=str(e)[:500])
+            raise
         out = mapper.rebuild(values)
         wall = time.perf_counter() - t0
         _metric_run_seconds.get_cell().add(wall)
+        rec = _flight_mod.get_recorder()
+        if rec.enabled:
+            # run events are SAMPLED (first 16 runs, every 16th after,
+            # plus any run >4x its trailing average — anomalies always
+            # land): a 2 kHz training loop must not churn the ring, but
+            # the slow outlier a postmortem needs is never dropped
+            self._run_events += 1
+            ewma = self._run_dur_ewma
+            slow = ewma is not None and wall > 4.0 * ewma \
+                and wall > 0.005
+            self._run_dur_ewma = wall if ewma is None \
+                else 0.98 * ewma + 0.02 * wall
+            if slow or self._run_events <= 16 \
+                    or self._run_events % 16 == 0:
+                rec.record("run", dur_s=round(wall, 6),
+                           n_fetches=len(mapper.elements),
+                           traced=trace, slow=slow,
+                           n_runs=self._run_events)
         if run_metadata is not None:
             stats = {
                 "start_us": 0,
@@ -937,22 +1074,7 @@ class BaseSession:
                 "nodes": [],
             }
             if buf is not None:
-                base = t0
-                for span in sorted(buf.drain(),
-                                   key=lambda s: s["start_s"]):
-                    phase = span["name"].split(":")[0]
-                    node = {
-                        "name": span["name"],
-                        "start_us": (span["start_s"] - base) * 1e6,
-                        "dur_us": max(span["dur_s"] * 1e6, 1.0),
-                        "tid": _PHASE_TRACK.get(phase, 0),
-                    }
-                    if span.get("meta"):
-                        node["args"] = {k: str(v)
-                                        for k, v in span["meta"].items()}
-                    stats["nodes"].append(node)
-                    _metric_phase_seconds.get_cell(phase).add(
-                        span["dur_s"])
+                stats["nodes"] = _drain_spans_to_nodes(buf, t0)
                 stats["thread_names"] = dict(_TRACK_NAMES)
             if collector is not None:
                 for k in ("compile_time_s", "fetch_bytes", "n_device_ops",
@@ -1010,6 +1132,35 @@ class BaseSession:
     def run_steps(self, fetches, n=None, feed_dict=None, feed_iterator=None,
                   stacked_feeds=None, output_mode="last", options=None,
                   run_metadata=None):
+        """Run ``fetches`` for ``n`` consecutive steps as ONE device
+        program; see :meth:`_run_steps_body` for the full contract.
+
+        ``options.trace_level >= SOFTWARE_TRACE`` with a RunMetadata
+        traces the WINDOW (ISSUE 8): the fused path records its
+        lifecycle spans (superbatch staging, plan phases, the blocking
+        ``fused_device_execute``) into ``step_stats["nodes"]`` and
+        breaks the fused window down by graph op on an attributed
+        track — cost-model per-op estimates scaled into the measured
+        window seconds — instead of one opaque bar
+        (docs/OBSERVABILITY.md). ProfilerHook drives exactly this when
+        a trigger lands on a fused window boundary."""
+        trace = (options is not None
+                 and getattr(options, "trace_level", 0) > 0
+                 and isinstance(run_metadata, RunMetadata))
+        if not trace:
+            return self._run_steps_body(
+                fetches, n, feed_dict, feed_iterator, stacked_feeds,
+                output_mode, options, run_metadata)
+        buf = monitoring.TraceBuffer()
+        with monitoring.trace_collection(buf):
+            return self._run_steps_body(
+                fetches, n, feed_dict, feed_iterator, stacked_feeds,
+                output_mode, options, run_metadata, trace_buf=buf)
+
+    def _run_steps_body(self, fetches, n=None, feed_dict=None,
+                        feed_iterator=None, stacked_feeds=None,
+                        output_mode="last", options=None,
+                        run_metadata=None, trace_buf=None):
         """Run ``fetches`` for ``n`` consecutive steps as ONE device
         program (the classic TPU in-loop training pattern, arXiv
         1605.08695 §4.4 / 1909.09756): the per-step plan is lowered into
@@ -1160,6 +1311,9 @@ class BaseSession:
                 reasons = analysis.loop_safety.fallback_reasons(diags)
                 for r in reasons:
                     _metric_fusion_fallback.get_cell(r).increase_by(1)
+                _flight_mod.get_recorder().record(
+                    "fused_window_fallback", n_steps=n,
+                    reasons=sorted(reasons))
                 warn_key = key[:2] + (tuple(reasons),)
                 if warn_key not in self._fusion_warned:
                     self._fusion_warned.add(warn_key)
@@ -1200,69 +1354,124 @@ class BaseSession:
                       for t in step.feed_tensors if t in const_feeds}
         xs_args = {t.name: superbatch[t] for t in step.feed_tensors
                    if t in superbatch}
-        with self._lock:
-            self._ensure_base_key()
-            c0 = self._run_counter + 1
-            self._run_counter += n
-            ctrs = np.arange(c0, c0 + n, dtype=np.uint32)
-            state = self._variable_store.values
-            first_call = fused["n_calls"] == 0
-            d_t0 = time.perf_counter()
-            with monitoring.traceme("fused_device_execute", n_steps=n):
-                outs, new_state = fused["jitted"](
-                    dict(state), const_args, xs_args, self._base_key, ctrs)
-            self._variable_store.values = dict(new_state)
-            self._apply_declared_shardings(new_state.keys())
-            fused["n_calls"] += 1
-            _metric_fused_steps.get_cell().increase_by(n)
-            if deadline is not None:
-                # state committed above: a deadline abort is detection
-                # only and leaves the session consistent
-                _block_with_deadline(list(outs), deadline)
-            if first_call:
-                # untraced compile convention: first-call seconds include
-                # the (dominant) XLA compile of the fused loop
-                _metric_compile_seconds.get_cell().add(
-                    time.perf_counter() - d_t0)
+        from ..telemetry import watchdog as _watchdog_mod
 
-        dev_pos = {t: i for i, t in enumerate(step.device_fetches)}
-        stacked = output_mode == "stacked"
+        wd = _watchdog_mod.get_watchdog()
+        wd_token = None
+        try:
+            with self._lock:
+                self._ensure_base_key()
+                c0 = self._run_counter + 1
+                self._run_counter += n
+                ctrs = np.arange(c0, c0 + n, dtype=np.uint32)
+                state = self._variable_store.values
+                first_call = fused["n_calls"] == 0
+                if not first_call:
+                    # wedge watchdog (ISSUE 8): a warm window that blows
+                    # 10x past its trailing average is hung, not slow —
+                    # snapshot every thread's stack while it still hangs.
+                    # First calls are exempt (they include the compile).
+                    wd_deadline = _watchdog_mod.deadline_for(
+                        fused.get("ewma"))
+                    if wd_deadline:
+                        wd_token = wd.arm("fused_window", wd_deadline,
+                                          n_steps=n)
+                d_t0 = time.perf_counter()
+                with monitoring.traceme("fused_device_execute", n_steps=n):
+                    try:
+                        outs, new_state = fused["jitted"](
+                            dict(state), const_args, xs_args,
+                            self._base_key, ctrs)
+                        if trace_buf is not None:
+                            # traced window: block inside the span so it
+                            # covers device execution, not just dispatch
+                            import jax
 
-        def _per_step_const(v):
-            v = np.asarray(v)
-            return np.stack([v] * n) if stacked else v
+                            jax.block_until_ready(list(outs))
+                    except Exception as e:
+                        _flight_mod.get_recorder().on_error(
+                            e, where="fused_device_execute", n_steps=n)
+                        raise
+                self._variable_store.values = dict(new_state)
+                self._apply_declared_shardings(new_state.keys())
+                fused["n_calls"] += 1
+                _metric_fused_steps.get_cell().increase_by(n)
+                if deadline is not None:
+                    # state committed above: a deadline abort is detection
+                    # only and leaves the session consistent
+                    _block_with_deadline(list(outs), deadline)
+                if first_call:
+                    # untraced compile convention: first-call seconds
+                    # include the (dominant) XLA compile of the fused loop
+                    _metric_compile_seconds.get_cell().add(
+                        time.perf_counter() - d_t0)
 
-        values: List[Any] = []
-        for e in mapper.elements:
-            if isinstance(e, Operation):
-                values.append(None)
-                continue
-            r = step.alias.get(e, e)
-            if e in const_feeds:
-                values.append(_per_step_const(const_feeds[e]))
-            elif e in superbatch:
-                v = superbatch[e]
-                values.append(np.asarray(v) if stacked
-                              else np.asarray(v[-1]))
-            elif r in dev_pos:
-                v = outs[dev_pos[r]]
-                values.append(v if e.dtype.name == "string"
-                              else np.asarray(v))
-            elif r in step.const_env:
-                values.append(_per_step_const(step.const_env[r]))
-            elif r.op.type == "Const":
-                values.append(_per_step_const(r.op.attrs["value"]))
-            else:
-                raise errors.InternalError(
-                    None, e.op, f"Fetch {e.name} produced no value")
+            dev_pos = {t: i for i, t in enumerate(step.device_fetches)}
+            stacked = output_mode == "stacked"
+
+            def _per_step_const(v):
+                v = np.asarray(v)
+                return np.stack([v] * n) if stacked else v
+
+            values: List[Any] = []
+            for e in mapper.elements:
+                if isinstance(e, Operation):
+                    values.append(None)
+                    continue
+                r = step.alias.get(e, e)
+                if e in const_feeds:
+                    values.append(_per_step_const(const_feeds[e]))
+                elif e in superbatch:
+                    v = superbatch[e]
+                    values.append(np.asarray(v) if stacked
+                                  else np.asarray(v[-1]))
+                elif r in dev_pos:
+                    v = outs[dev_pos[r]]
+                    values.append(v if e.dtype.name == "string"
+                                  else np.asarray(v))
+                elif r in step.const_env:
+                    values.append(_per_step_const(step.const_env[r]))
+                elif r.op.type == "Const":
+                    values.append(_per_step_const(r.op.attrs["value"]))
+                else:
+                    raise errors.InternalError(
+                        None, e.op, f"Fetch {e.name} produced no value")
+        finally:
+            wd.disarm(wd_token)
         wall = time.perf_counter() - t0
+        if not first_call:
+            # trailing average feeds the next window's wedge deadline
+            # (first calls excluded: compile time is not a wedge)
+            prev = fused.get("ewma")
+            fused["ewma"] = wall if prev is None else \
+                0.7 * prev + 0.3 * wall
+        rec = _flight_mod.get_recorder()
+        if rec.enabled:
+            rec.record("fused_window", n_steps=n, dur_s=round(wall, 6),
+                       sec_per_step=round(wall / n, 9),
+                       first_call=first_call)
         if run_metadata is not None and isinstance(run_metadata,
                                                    RunMetadata):
-            run_metadata.step_stats = {
+            stats: Dict[str, Any] = {
                 "wall_time_s": wall,
                 "loop_fusion": {"fused": True, "n_steps": n,
-                                "sec_per_step": wall / n},
+                                "sec_per_step": wall / n,
+                                "run_counter_range": [int(c0),
+                                                      int(c0 + n - 1)]},
             }
+            if trace_buf is not None:
+                stats["start_us"] = 0
+                nodes = _drain_spans_to_nodes(trace_buf, t0)
+                fw = [nd for nd in nodes
+                      if nd["name"] == "fused_device_execute"]
+                if fw:
+                    # tentpole (4): break the fused window down by op
+                    nodes.extend(_attributed_device_nodes(step, fw[-1]))
+                stats["nodes"] = nodes
+                stats["thread_names"] = {
+                    **_TRACK_NAMES,
+                    _ATTRIBUTED_TRACK: "device ops (attributed)"}
+            run_metadata.step_stats = stats
         return mapper.rebuild(values)
 
     def _run_steps_unfused(self, mapper, n, const_feeds, superbatch,
@@ -1532,9 +1741,19 @@ class BaseSession:
                         first_call, collector)
                 d_t0 = time.perf_counter()
                 with monitoring.traceme("device_execute"):
-                    fetch_vals, new_state, check_flags = \
-                        _call_step_executable(step, state, feed_args,
-                                              rng_key, rng_ctr)
+                    try:
+                        fetch_vals, new_state, check_flags = \
+                            _call_step_executable(step, state, feed_args,
+                                                  rng_key, rng_ctr)
+                    except Exception as e:
+                        # a device-program failure is the flight
+                        # recorder's prime customer: record + auto-dump
+                        # (rate-limited) so the ring around the crash
+                        # survives the process
+                        _flight_mod.get_recorder().on_error(
+                            e, where="device_execute",
+                            n_device_ops=len(step.device_ops))
+                        raise
                     if check_flags:
                         # inspect BEFORE committing state: a failed check
                         # must not apply NaN-contaminated updates (ref
@@ -1922,6 +2141,14 @@ class BaseSession:
         if plan_diags:
             from ..platform import tf_logging as logging
 
+            rec = _flight_mod.get_recorder()
+            if rec.enabled:
+                # hazard/lint findings are forensics gold: the last
+                # diagnostics before a wedge usually name the culprit
+                for d in plan_diags[:20]:
+                    rec.record("diagnostic", severity=d.severity,
+                               code=d.code, message=d.message[:300],
+                               op=d.op_name)
             errs = analysis.errors(plan_diags)
             for d in plan_diags:
                 if not d.is_error:
@@ -2071,6 +2298,13 @@ class BaseSession:
                                n_device_ops=len(device_ops),
                                n_host_ops=len(step.host_plan),
                                n_post_host_ops=len(post_host))
+        rec = _flight_mod.get_recorder()
+        if rec.enabled:
+            rec.record("plan", n_pruned=len(pruned),
+                       n_device_ops=len(device_ops),
+                       n_host_ops=len(step.host_plan),
+                       n_post_host_ops=len(post_host),
+                       n_diagnostics=len(plan_diags))
         step.has_device_stage = bool(device_ops)
         if not step.has_device_stage:
             step.jitted = None

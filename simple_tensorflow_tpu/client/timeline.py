@@ -44,8 +44,14 @@ class Timeline:
         t0 = stats.get("start_us", 0)
         # process/thread naming metadata: Perfetto and chrome://tracing
         # group tracks by these (ref: timeline.py _emit_pid/_emit_tid)
+        pname = "stf.Session run"
+        window = stats.get("window_steps")
+        if window:
+            # fused run_steps trace (ProfilerHook annotation): the whole
+            # timeline covers global steps [a, b] as ONE device window
+            pname = f"stf.Session run_steps[{window[0]}..{window[1]}]"
         self._events.append(self._metadata(
-            "process_name", {"name": "stf.Session run"}))
+            "process_name", {"name": pname}))
         thread_names = dict(stats.get("thread_names", {}))
         nodes = stats.get("nodes", [])
         for tid in sorted({n.get("tid", 0) for n in nodes}

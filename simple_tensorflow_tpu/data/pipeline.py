@@ -366,17 +366,26 @@ class PipelineRun:
         def run():
             import contextlib
 
+            from ..telemetry import recorder as _flight
+
+            rec = _flight.get_recorder()
+            rec.record("data_stage", stage=name, action="start")
             with contextlib.ExitStack() as stack:
                 for b in sinks:
                     stack.enter_context(monitoring.trace_collection(b))
                 try:
                     fn()
-                except Exception:
+                except Exception as e:
                     # stage bodies forward their own errors through
                     # buffers; anything escaping here is a bug in the
                     # engine itself — don't kill the process thread pool
+                    rec.record("data_stage", stage=name, action="error",
+                               error_type=type(e).__name__,
+                               message=str(e)[:300])
                     if not self.cancel.is_set():
                         raise
+                finally:
+                    rec.record("data_stage", stage=name, action="exit")
 
         t = threading.Thread(target=run, name=f"stf_data_{name}",
                              daemon=True)

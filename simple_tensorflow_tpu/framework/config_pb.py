@@ -83,6 +83,16 @@ class ConfigProto:
     caller materializes (np.asarray/float/.result()), so step N+1's
     staging overlaps step N's device execution. Default False keeps
     the eager-numpy return contract.
+
+    telemetry_port: start the process's stf.telemetry HTTP server
+    (``/metrics`` Prometheus scrape, ``/healthz``, ``/statusz``,
+    ``/tracez``, ``/flightz``; docs/OBSERVABILITY.md) when the Session
+    is constructed. 0 binds an ephemeral port
+    (``stf.telemetry.get_server().port``); None (default) starts
+    nothing. PROCESS-GLOBAL like compile_cache_dir: the server outlives
+    the Session (one process, one telemetry plane) — constructing a
+    second Session with the same (or None) port is a no-op, a
+    different fixed port raises.
     """
 
     def __init__(self, device_count=None, intra_op_parallelism_threads=0,
@@ -95,7 +105,7 @@ class ConfigProto:
                  transfer_guard_threshold_bytes=1 << 20,
                  graph_analysis="off", variable_hazard_mode=None,
                  loop_fusion_steps=1, async_fetches=False,
-                 compile_cache_dir=None):
+                 compile_cache_dir=None, telemetry_port=None):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -132,3 +142,10 @@ class ConfigProto:
         self.loop_fusion_steps = loop_fusion_steps
         self.async_fetches = bool(async_fetches)
         self.compile_cache_dir = compile_cache_dir
+        if telemetry_port is not None:
+            telemetry_port = int(telemetry_port)
+            if telemetry_port < 0 or telemetry_port > 65535:
+                raise ValueError(
+                    f"telemetry_port must be 0..65535 or None, "
+                    f"got {telemetry_port}")
+        self.telemetry_port = telemetry_port

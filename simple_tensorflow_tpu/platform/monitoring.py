@@ -461,8 +461,19 @@ def to_json(**dumps_kwargs) -> str:
 
 
 def _prom_name(name: str) -> str:
+    """Metric-name sanitization for the ``/stf/...`` path style:
+    every non-[a-zA-Z0-9_] character becomes ``_``, leading/trailing
+    runs are stripped, and a name left empty or starting with a digit
+    gets a ``_`` prefix (the exposition format's name grammar is
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``; we never emit ``:`` — it is reserved
+    for recording rules)."""
     out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
-    return out.strip("_")
+    out = out.strip("_")
+    if not out:
+        return "_"
+    if out[0].isdigit():
+        out = "_" + out
+    return out
 
 
 def _prom_label_value(v) -> str:
@@ -473,67 +484,89 @@ def _prom_label_value(v) -> str:
 
 
 def _prom_help(text: str) -> str:
+    """HELP escaping: backslash and newline only (quotes stay literal
+    in HELP text per the exposition format)."""
     return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
-def to_prometheus() -> str:
-    """Prometheus text exposition format. Counters/gauges map directly;
-    Samplers map to the native histogram type (cumulative ``_bucket``
-    series with ``le`` edges); PercentileSamplers map to summary
-    quantiles."""
-    lines: List[str] = []
-    for name, snap in export().items():
-        pname = _prom_name(name)
-        labels = snap["labels"]
+def _prom_float(v: float) -> str:
+    """Sample-value rendering: finite floats as repr, non-finites as
+    the exposition tokens ``+Inf``/``-Inf``/``NaN``."""
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(v)
 
-        def _labelstr(cell_key: str, extra: str = "") -> str:
-            parts = []
-            if labels and cell_key:
-                parts += [f'{ln}="{_prom_label_value(lv)}"' for ln, lv in
-                          zip(labels, _split_labels(cell_key))]
+
+def to_prometheus() -> str:
+    """Prometheus text exposition format (version 0.0.4). Counters and
+    gauges map directly; Samplers map to the native histogram type
+    (CUMULATIVE ``_bucket`` series ending in ``le="+Inf"`` whose count
+    equals ``_count``); PercentileSamplers map to summary quantiles;
+    StringGauges become info-style series (``value="..."`` label,
+    sample 1). Iterates the live cells with their tuple label keys, so
+    label VALUES — including empty strings and values containing the
+    export() separator — round-trip exactly."""
+    with _registry_lock:
+        metrics = sorted(_registry.items())
+    lines: List[str] = []
+    for name, m in metrics:
+        pname = _prom_name(name)
+        labels = m.label_names
+
+        def _labelstr(key: Tuple[str, ...], extra: str = "") -> str:
+            parts = [f'{ln}="{_prom_label_value(lv)}"'
+                     for ln, lv in zip(labels, key)]
             if extra:
                 parts.append(extra)
             return "{" + ",".join(parts) + "}" if parts else ""
 
-        typ = snap["type"]
+        lines.append(f"# HELP {pname} {_prom_help(m.description)}")
+        typ = m.metric_type
+        cells = sorted(m.cells().items())
         if typ == "Counter":
-            lines.append(f"# HELP {pname} {_prom_help(snap['description'])}")
             lines.append(f"# TYPE {pname} counter")
-            for key, v in snap["cells"].items():
-                lines.append(f"{pname}{_labelstr(key)} {v}")
+            for key, cell in cells:
+                lines.append(f"{pname}{_labelstr(key)} {cell.value()}")
         elif typ in ("IntGauge", "BoolGauge"):
-            lines.append(f"# HELP {pname} {_prom_help(snap['description'])}")
             lines.append(f"# TYPE {pname} gauge")
-            for key, v in snap["cells"].items():
-                lines.append(f"{pname}{_labelstr(key)} {int(v)}")
+            for key, cell in cells:
+                lines.append(f"{pname}{_labelstr(key)} {int(cell.value())}")
         elif typ == "StringGauge":
-            lines.append(f"# HELP {pname} {_prom_help(snap['description'])}")
             lines.append(f"# TYPE {pname} gauge")
-            for key, v in snap["cells"].items():
-                extra = f'value="{_prom_label_value(v)}"'
+            for key, cell in cells:
+                extra = f'value="{_prom_label_value(cell.value())}"'
                 lines.append(f"{pname}{_labelstr(key, extra)} 1")
         elif typ == "Sampler":
-            lines.append(f"# HELP {pname} {_prom_help(snap['description'])}")
             lines.append(f"# TYPE {pname} histogram")
-            for key, v in snap["cells"].items():
+            for key, cell in cells:
+                v = cell.value()
                 cum = 0
                 for edge, count in v["buckets"]:
                     cum += count
-                    le = "+Inf" if edge == float("inf") else repr(edge)
-                    extra = 'le="%s"' % le
+                    extra = f'le="{_prom_float(edge)}"'
                     lines.append(
                         f"{pname}_bucket{_labelstr(key, extra)} {cum}")
-                lines.append(f"{pname}_sum{_labelstr(key)} {v['sum']}")
+                lines.append(f"{pname}_sum{_labelstr(key)} "
+                             f"{_prom_float(v['sum'])}")
                 lines.append(f"{pname}_count{_labelstr(key)} {v['count']}")
         elif typ == "PercentileSampler":
-            lines.append(f"# HELP {pname} {_prom_help(snap['description'])}")
             lines.append(f"# TYPE {pname} summary")
-            for key, v in snap["cells"].items():
+            for key, cell in cells:
+                v = cell.value()
                 for p, q in v["percentiles"].items():
-                    extra = f'quantile="{p / 100.0}"'
-                    lines.append(f"{pname}{_labelstr(key, extra)} {q}")
-                lines.append(f"{pname}_sum{_labelstr(key)} {v['sum']}")
+                    extra = f'quantile="{_prom_float(p / 100.0)}"'
+                    lines.append(
+                        f"{pname}{_labelstr(key, extra)} {_prom_float(q)}")
+                lines.append(f"{pname}_sum{_labelstr(key)} "
+                             f"{_prom_float(v['sum'])}")
                 lines.append(f"{pname}_count{_labelstr(key)} {v['count']}")
+        else:  # unknown family type: emit nothing but the HELP line
+            lines.append(f"# TYPE {pname} untyped")
     return "\n".join(lines) + "\n"
 
 

@@ -40,6 +40,9 @@ import numpy as np
 from ..data.pipeline import _DONE, TIMED_OUT, RingBuffer
 from ..framework import errors
 from ..platform import monitoring
+from ..telemetry import recorder as _flight_mod
+from ..telemetry import tracing as _req_tracing
+from ..telemetry import watchdog as _watchdog_mod
 
 # ---------------------------------------------------------------------------
 # metrics (process-global; registration is idempotent)
@@ -78,6 +81,12 @@ _metric_qps = monitoring.IntGauge(
     "/stf/serving/qps",
     "Requests completed OK per second over a trailing 10 s window",
     "model")
+_metric_e2e_latency = monitoring.Sampler(
+    "/stf/serving/request_e2e_seconds",
+    monitoring.ExponentialBuckets(1e-4, 2.0, 22),
+    "Per-request seconds from admission to completion, labeled by final "
+    "outcome (ok = response dispatched; failures sample at rejection)",
+    "model", "outcome")
 
 
 class _QueueStats:
@@ -104,14 +113,32 @@ class _BatchOutputs:
     """One executed batch's outputs, shared by its requests. Values are
     FetchFutures (lazy device handles) or arrays; ``row`` materializes
     on first touch (FetchFuture.result is thread-safe and caches the
-    host copy, so N requests share ONE device-to-host transfer)."""
+    host copy, so N requests share ONE device-to-host transfer). The
+    first touch emits the batch's ``serving_fetch`` telemetry span —
+    the D2H leg of every riding request's trace."""
 
-    __slots__ = ("_outputs",)
+    __slots__ = ("_outputs", "_model", "_trace_ids", "_lock", "_fetched")
 
-    def __init__(self, outputs: Dict[str, Any]):
+    def __init__(self, outputs: Dict[str, Any], model: str = "",
+                 trace_ids: Optional[List[str]] = None):
         self._outputs = outputs
+        self._model = model
+        self._trace_ids = trace_ids
+        self._lock = threading.Lock()
+        self._fetched = False
 
     def row(self, index: int) -> Dict[str, np.ndarray]:
+        if not self._fetched:
+            with self._lock:
+                if not self._fetched:
+                    t0 = time.perf_counter()
+                    self._outputs = {name: np.asarray(v)
+                                     for name, v in self._outputs.items()}
+                    _req_tracing.emit_span(
+                        "serving_fetch", t0,
+                        time.perf_counter() - t0,
+                        trace_ids=self._trace_ids, model=self._model)
+                    self._fetched = True
         return {name: np.asarray(v)[index]
                 for name, v in self._outputs.items()}
 
@@ -123,14 +150,18 @@ class ServeFuture:
     the request; ``result()`` then materializes this request's row of
     the batch outputs — blocking on the device only at that point."""
 
-    __slots__ = ("_event", "_batch", "_index", "_exc", "_model")
+    __slots__ = ("_event", "_batch", "_index", "_exc", "_model",
+                 "trace_id")
 
-    def __init__(self, model: str):
+    def __init__(self, model: str, trace_id: Optional[str] = None):
         self._event = threading.Event()
         self._batch: Optional[_BatchOutputs] = None
         self._index = -1
         self._exc: Optional[BaseException] = None
         self._model = model
+        # the request's telemetry trace id (docs/OBSERVABILITY.md):
+        # telemetry.chrome_trace(fut.trace_id) renders its linked spans
+        self.trace_id = trace_id
 
     # -- producer side (batcher) --------------------------------------------
     def _set_result(self, batch: _BatchOutputs, index: int):
@@ -177,14 +208,17 @@ class ServeRequest:
     response future, and an absolute deadline (perf_counter seconds;
     None = no deadline)."""
 
-    __slots__ = ("inputs", "future", "deadline", "t_enqueue")
+    __slots__ = ("inputs", "future", "deadline", "t_enqueue", "trace_id")
 
     def __init__(self, inputs: Dict[str, np.ndarray], future: ServeFuture,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.inputs = inputs
         self.future = future
         self.deadline = deadline
         self.t_enqueue = time.perf_counter()
+        self.trace_id = trace_id if trace_id is not None \
+            else getattr(future, "trace_id", None)
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -212,6 +246,8 @@ class ContinuousBatcher:
         self._qps = monitoring.WindowedRate(10.0)
         self._qps_gauge = _metric_qps.get_cell(name)
         self._latency = _metric_latency.get_cell(name)
+        # trailing average batch-execute seconds -> watchdog deadline
+        self._exec_ewma: Optional[float] = None
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name=f"stf_serving_batcher_{name}",
@@ -242,7 +278,7 @@ class ContinuousBatcher:
         future with a structured error instead of admitting."""
         fut = request.future
         if self._closed:
-            self._reject(fut, "cancelled", errors.UnavailableError(
+            self._reject(request, "cancelled", errors.UnavailableError(
                 None, None,
                 f"model {self.name!r}: server is shut down"))
             return fut
@@ -251,23 +287,27 @@ class ContinuousBatcher:
             timeout = max(request.deadline - time.perf_counter(), 0.0)
         if not self._queue.put(request, timeout=timeout):
             if self._queue.closed:
-                self._reject(fut, "cancelled", errors.UnavailableError(
-                    None, None,
-                    f"model {self.name!r}: server is shut down"))
+                self._reject(request, "cancelled",
+                             errors.UnavailableError(
+                                 None, None,
+                                 f"model {self.name!r}: server is shut "
+                                 "down"))
             else:
-                _metric_requests.get_cell(
-                    self.name, "rejected").increase_by(1)
-                fut._set_exception(errors.DeadlineExceededError(
-                    None, None,
-                    f"model {self.name!r}: request deadline expired "
-                    "while waiting for admission (queue full — "
-                    "backpressure)"))
+                self._reject(request, "rejected",
+                             errors.DeadlineExceededError(
+                                 None, None,
+                                 f"model {self.name!r}: request deadline "
+                                 "expired while waiting for admission "
+                                 "(queue full — backpressure)"))
             return fut
         return fut
 
-    def _reject(self, fut: ServeFuture, outcome: str, exc: BaseException):
+    def _reject(self, request: ServeRequest, outcome: str,
+                exc: BaseException):
         _metric_requests.get_cell(self.name, outcome).increase_by(1)
-        fut._set_exception(exc)
+        _metric_e2e_latency.get_cell(self.name, outcome).add(
+            time.perf_counter() - request.t_enqueue)
+        request.future._set_exception(exc)
 
     # -- batching loop --------------------------------------------------------
     def _loop(self):
@@ -302,21 +342,25 @@ class ContinuousBatcher:
                 # a batching failure (e.g. ragged dynamic-dim rows that
                 # cannot stack) fails THIS batch's requests; the batcher
                 # thread must survive for the next batch
+                _flight_mod.get_recorder().on_error(
+                    e, where="serving_batch", model=self.name)
                 for r in batch:
                     if not r.future.done():
-                        self._reject(r.future, "error", e)
+                        self._reject(r, "error", e)
             if drained:
                 return
 
     def _run_batch(self, batch: List[ServeRequest]):
         now = time.perf_counter()
         live: List[ServeRequest] = []
+        expired = 0
         for r in batch:
             if r.expired(now):
                 # satellite (ISSUE 7): an expired deadline is a
                 # structured per-request error — the batch runs on
                 # without it instead of stalling on a dead client
-                self._reject(r.future, "deadline_exceeded",
+                expired += 1
+                self._reject(r, "deadline_exceeded",
                              errors.DeadlineExceededError(
                                  None, None,
                                  f"model {self.name!r}: request deadline "
@@ -331,6 +375,14 @@ class ContinuousBatcher:
         k = len(live)
         bucket = self._policy.bucket_for(k)
         pad = bucket - k
+        trace_ids = [r.trace_id for r in live if r.trace_id]
+        # queue-wait leg of each riding request's trace (ISSUE 8): one
+        # span per request, admission -> batch close
+        for r in live:
+            _req_tracing.emit_span("serving_queue_wait", r.t_enqueue,
+                                   now - r.t_enqueue,
+                                   trace_id=r.trace_id, model=self.name)
+        t_asm = time.perf_counter()
         feeds: Dict[str, np.ndarray] = {}
         for name in live[0].inputs:
             stacked = np.stack([r.inputs[name] for r in live])
@@ -341,23 +393,59 @@ class ContinuousBatcher:
                                   dtype=stacked.dtype))
                 stacked = np.concatenate([stacked, block], axis=0)
             feeds[name] = stacked
+        _req_tracing.emit_span("serving_batch_assemble", t_asm,
+                               time.perf_counter() - t_asm,
+                               trace_ids=trace_ids, model=self.name,
+                               live=k, bucket=bucket)
+        # wedge watchdog: a batch 10x past the trailing average is a
+        # hang; first batches (no history) are exempt
+        wd_deadline = _watchdog_mod.deadline_for(self._exec_ewma)
+        wd_token = _watchdog_mod.get_watchdog().arm(
+            "serving_batch", wd_deadline, model=self.name,
+            live=k, bucket=bucket) if wd_deadline else None
+        t_exec = time.perf_counter()
         try:
             with monitoring.traceme("serving_batch", model=self.name,
-                                    live=k, bucket=bucket):
+                                    live=k, bucket=bucket), \
+                    _req_tracing.trace_scope(trace_ids):
                 outputs = self._execute_fn(feeds, bucket)
         except BaseException as e:  # noqa: BLE001 — delivered per request
+            _flight_mod.get_recorder().on_error(
+                e, where="serving_batch_execute", model=self.name,
+                live=k, bucket=bucket)
             for r in live:
-                self._reject(r.future, "error", e)
+                self._reject(r, "error", e)
             return
+        finally:
+            _watchdog_mod.get_watchdog().disarm(wd_token)
+        done_t = time.perf_counter()
+        exec_dur = done_t - t_exec
+        self._exec_ewma = exec_dur if self._exec_ewma is None else \
+            0.7 * self._exec_ewma + 0.3 * exec_dur
+        _req_tracing.emit_span("serving_batch_execute", t_exec, exec_dur,
+                               trace_ids=trace_ids, model=self.name,
+                               live=k, bucket=bucket)
         _metric_batches.get_cell(self.name).increase_by(1)
         _metric_batch_size.get_cell(self.name).add(float(k))
         _metric_batch_fill.get_cell(self.name).add(k / bucket)
-        shared = _BatchOutputs(outputs)
-        done_t = time.perf_counter()
+        rec = _flight_mod.get_recorder()
+        if rec.enabled:
+            # batcher decision record: why this batch closed at this
+            # size, and what it cost (the forensics a latency SLO
+            # post-mortem starts from)
+            rec.record("serving_batch", model=self.name, live=k,
+                       bucket=bucket, expired=expired,
+                       exec_s=round(exec_dur, 6),
+                       queue_wait_max_s=round(
+                           max(now - r.t_enqueue for r in live), 6))
+        shared = _BatchOutputs(outputs, model=self.name,
+                               trace_ids=trace_ids)
         ok = _metric_requests.get_cell(self.name, "ok")
+        e2e = _metric_e2e_latency.get_cell(self.name, "ok")
         for i, r in enumerate(live):
             r.future._set_result(shared, i)
             self._latency.add(done_t - r.t_enqueue)
+            e2e.add(done_t - r.t_enqueue)
         ok.increase_by(k)
         self._qps.add(k)
         self._qps_gauge.set(int(self._qps.rate()))

@@ -244,6 +244,11 @@ class ModelServer:
             with self._lock:
                 self._loading.discard(name)
         _count_models(+1)
+        from ..telemetry import recorder as _flight
+
+        _flight.get_recorder().record(
+            "model_load", model=name, export_dir=export_dir,
+            signatures=sorted(model.signatures))
         logging.info(
             "serving: loaded model %r from %s (%d signature(s): %s)",
             name, export_dir, len(model.signatures),
@@ -361,7 +366,8 @@ class ModelServer:
                 model: Optional[str] = None,
                 signature_key: Optional[str] = None,
                 timeout_ms: Optional[float] = None,
-                options=None) -> ServeFuture:
+                options=None,
+                trace_id: Optional[str] = None) -> ServeFuture:
         """Serve ONE example: ``inputs`` maps the signature's input keys
         to per-example arrays (no batch dim — the batcher adds it).
         Returns a :class:`ServeFuture`; ``result()`` yields
@@ -371,7 +377,15 @@ class ModelServer:
         (RunOptions — the PR 2 deadline contract), else the policy's
         ``default_timeout_ms``; 0/None = no deadline. An expired
         deadline resolves the future with DeadlineExceededError — a
-        structured per-request error, never a stalled batch."""
+        structured per-request error, never a stalled batch.
+
+        Tracing (ISSUE 8, docs/OBSERVABILITY.md): every request carries
+        a ``trace_id`` — the caller's (so a gateway's id rides
+        through), else the current ``stf.telemetry.trace_scope``, else
+        freshly minted. It links the request's queue-wait / batch /
+        execute / fetch telemetry spans; read it back from the returned
+        future (``fut.trace_id``) and render with
+        ``stf.telemetry.chrome_trace(fut.trace_id)``."""
         if self._closed:
             raise errors.UnavailableError(
                 None, None, "ModelServer is shut down")
@@ -434,8 +448,14 @@ class ModelServer:
             import time as _time
 
             deadline = _time.perf_counter() + float(timeout_ms) / 1000.0
-        fut = ServeFuture(sig.batcher.name)
-        return sig.batcher.submit(ServeRequest(rows, fut, deadline))
+        from .. import telemetry
+
+        if trace_id is None:
+            trace_id = telemetry.current_trace_id() or \
+                telemetry.new_trace_id()
+        fut = ServeFuture(sig.batcher.name, trace_id=trace_id)
+        return sig.batcher.submit(
+            ServeRequest(rows, fut, deadline, trace_id=trace_id))
 
     # -- lifecycle ------------------------------------------------------------
     def unload(self, name: str):
@@ -449,6 +469,9 @@ class ModelServer:
                 sig.batcher.close()
         model.session.close()
         _count_models(-1)
+        from ..telemetry import recorder as _flight
+
+        _flight.get_recorder().record("model_unload", model=name)
 
     def close(self):
         """Shut down: close every admission queue (queued requests
@@ -479,6 +502,32 @@ class ModelServer:
             self.close()
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
+
+    def statusz_info(self) -> List[Dict[str, Any]]:
+        """One row per (model, signature) for the telemetry server's
+        ``/statusz`` page (docs/SERVING.md): export dir, batching
+        policy buckets, warm AOT buckets, live queue depth, current
+        qps."""
+        with self._lock:
+            models = list(self._models.values())
+        rows: List[Dict[str, Any]] = []
+        for m in models:
+            for key, sig in sorted(m.signatures.items()):
+                b = sig.batcher
+                rows.append({
+                    "model": m.name,
+                    "signature": key,
+                    "export_dir": m.export_dir,
+                    "method_name": sig.method_name,
+                    "inputs": sorted(sig.inputs),
+                    "outputs": sorted(sig.outputs),
+                    "bucket_sizes": list(m.policy.bucket_sizes),
+                    "aot_buckets_warm": len(sig.plan.step.aot_cache),
+                    "queue_depth": b.queue_depth() if b is not None
+                    else 0,
+                    "qps": b.refresh_qps() if b is not None else 0,
+                })
+        return rows
 
     def stats(self) -> Dict[str, Any]:
         """The /stf/serving/* metric family's current snapshot. The

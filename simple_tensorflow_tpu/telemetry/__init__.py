@@ -1,0 +1,48 @@
+"""stf.telemetry: the production telemetry plane (docs/OBSERVABILITY.md).
+
+Three always-on layers over the ``stf.monitoring`` substrate:
+
+- **HTTP telemetry server** (``telemetry.start(port=...)`` or
+  ``ConfigProto(telemetry_port=...)``): ``/metrics`` (Prometheus),
+  ``/healthz``, ``/statusz``, ``/tracez``, ``/flightz``.
+- **Request-scoped tracing**: a ``trace_id`` minted at
+  ``ModelServer.predict`` rides the request through admission →
+  batching → execute → fetch; ``chrome_trace(trace_id)`` renders one
+  request's linked spans.
+- **Flight recorder + watchdog**: a bounded ring of structured events
+  dumped as JSONL on demand, on unhandled execution errors, on SIGTERM,
+  and when the watchdog catches a wedged fused window or serving batch
+  (with all-thread stack snapshots).
+"""
+
+from .recorder import (FlightRecorder, get_recorder, record_event,
+                       thread_stacks, install_signal_handlers)
+from .tracing import (new_trace_id, current_trace_id, current_trace_ids,
+                      trace_scope, span, emit_span, recent_spans,
+                      clear_spans, chrome_trace)
+from .watchdog import Watchdog, get_watchdog, deadline_for
+from .server import TelemetryServer, start, stop, get_server
+
+__all__ = [
+    "FlightRecorder", "get_recorder", "record_event", "thread_stacks",
+    "install_signal_handlers",
+    "new_trace_id", "current_trace_id", "current_trace_ids",
+    "trace_scope", "span", "emit_span", "recent_spans", "clear_spans",
+    "chrome_trace",
+    "Watchdog", "get_watchdog", "deadline_for",
+    "TelemetryServer", "start", "stop", "get_server",
+    "dump_flight_recorder", "shutdown",
+]
+
+
+def dump_flight_recorder(path=None, reason="on_demand"):
+    """Write the flight recorder (events + all-thread stacks) to a
+    JSONL file; returns the path."""
+    return get_recorder().dump(path=path, reason=reason)
+
+
+def shutdown(timeout: float = 5.0) -> None:
+    """Tear the whole plane down: stop the HTTP server and the watchdog
+    monitor thread. The recorder ring survives (it is just memory)."""
+    stop(timeout)
+    get_watchdog().stop(timeout)

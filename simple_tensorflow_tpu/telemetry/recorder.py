@@ -1,0 +1,278 @@
+"""Always-on flight recorder: a bounded process-global ring of
+structured events for post-hoc forensics (ref: the role
+tensorflow_serving's event logs and TF's EEG traces play in the system
+papers — arXiv 1605.08695 §5 / 1603.04467 §9: you cannot debug a
+production wedge you did not record).
+
+Every interesting-but-cheap fact lands here while the process runs:
+span closes (stf.telemetry.tracing), Session run/plan summaries, device
+and serving errors, batcher decisions, hazard/lint diagnostics, data
+stage lifecycles, watchdog wedge snapshots. Steady-state cost is ONE
+deque append under a lock per event (~1 µs) — cheap enough to leave on
+everywhere; ``STF_FLIGHT_RECORDER=0`` (or ``set_enabled(False)``)
+drops it to a single attribute check.
+
+The ring is dumped as JSONL:
+
+- on demand (``dump()`` / the telemetry server's ``/flightz``),
+- on unhandled session/serving execution errors (``on_error`` —
+  rate-limited, ``STF_FLIGHT_DUMP_ON_ERROR=0`` disables),
+- on ``SIGTERM`` (``install_signal_handlers()``; the telemetry server
+  installs them at ``start()``),
+- on watchdog wedge detection (stf.telemetry.watchdog), together with
+  a stack snapshot of every live thread (stf threads flagged).
+
+Event schema (one JSON object per line; docs/OBSERVABILITY.md):
+``{"t": unix_seconds, "mono": perf_counter_seconds, "kind": str,
+"thread": str, ...kind-specific fields}``. Dumps append
+``{"kind": "thread_stack", ...}`` records — the wedge forensics — and a
+final ``{"kind": "dump_info", ...}`` trailer.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from ..platform import monitoring
+
+_metric_events = monitoring.Counter(
+    "/stf/telemetry/flight_events",
+    "Flight-recorder events recorded, by event kind", "kind")
+_metric_dumps = monitoring.Counter(
+    "/stf/telemetry/flight_dumps",
+    "Flight-recorder JSONL dumps written, by trigger",
+    "reason")
+
+DEFAULT_CAPACITY = int(os.environ.get("STF_FLIGHT_RECORDER_EVENTS", "4096"))
+
+# prefixes of threads this library owns; thread_stacks() flags them so a
+# wedge dump separates stf machinery from application threads
+_STF_THREAD_PREFIXES = ("stf_data_", "stf_serving_", "stf_telemetry_",
+                        "stf_sharding_")
+
+
+def _sanitize(value):
+    """Events must stay JSON-able no matter what callers pass."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_sanitize(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _sanitize(v) for k, v in value.items()}
+    return str(value)
+
+
+# fast-path type set: a field of exactly these types skips _sanitize
+# (the hot callers — run/span/batch events — pass only these)
+_PRIMITIVE_TYPES = (int, float, bool, str)
+
+
+class FlightRecorder:
+    """Bounded ring of structured events; see the module docstring."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._ring: "collections.deque" = collections.deque(
+            maxlen=max(16, int(capacity)))
+        self._lock = threading.Lock()
+        self.enabled = os.environ.get("STF_FLIGHT_RECORDER", "1") != "0"
+        self._dropped = 0
+        self._recorded = 0
+        self._last_auto_dump = 0.0
+        self.last_dump_path: Optional[str] = None
+        # per-kind counter cells, cached (benign race: get_cell is
+        # idempotent) — record() is on the Session.run hot path
+        self._kind_cells: Dict[str, Any] = {}
+
+    # -- recording ------------------------------------------------------------
+    def record(self, kind: str, **fields) -> None:
+        """Append one event. No-op when disabled. Never raises: a
+        forensics channel must not be able to sink the operation it
+        observes."""
+        if not self.enabled:
+            return
+        try:
+            ev = {"t": time.time(), "mono": time.perf_counter(),
+                  "kind": kind,
+                  "thread": threading.current_thread().name}
+            for k, v in fields.items():
+                ev[k] = v if (v is None or type(v) in _PRIMITIVE_TYPES) \
+                    else _sanitize(v)
+            with self._lock:
+                if len(self._ring) == self._ring.maxlen:
+                    self._dropped += 1
+                self._ring.append(ev)
+                self._recorded += 1
+            cell = self._kind_cells.get(kind)
+            if cell is None:
+                cell = self._kind_cells[kind] = \
+                    _metric_events.get_cell(kind)
+            cell.increase_by(1)
+        except Exception:  # noqa: BLE001 — see docstring
+            pass
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    # -- reading --------------------------------------------------------------
+    def events(self, n: Optional[int] = None,
+               kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self._ring)
+        if kind is not None:
+            evs = [e for e in evs if e.get("kind") == kind]
+        return evs[-n:] if n else evs
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"enabled": self.enabled, "size": len(self._ring),
+                    "capacity": self._ring.maxlen,
+                    "recorded": self._recorded, "dropped": self._dropped,
+                    "last_dump_path": self.last_dump_path}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- dumping --------------------------------------------------------------
+    def dump_jsonl(self, stacks: bool = True, reason: str = "on_demand"
+                   ) -> str:
+        """The whole ring as JSONL (oldest first), optionally followed
+        by one ``thread_stack`` record per live thread and a
+        ``dump_info`` trailer."""
+        lines = [json.dumps(e, default=str) for e in self.events()]
+        if stacks:
+            for rec in thread_stacks():
+                lines.append(json.dumps(rec, default=str))
+        lines.append(json.dumps(
+            {"kind": "dump_info", "t": time.time(), "reason": reason,
+             "pid": os.getpid(), **{k: v for k, v in self.stats().items()
+                                    if k != "last_dump_path"}}))
+        return "\n".join(lines) + "\n"
+
+    def dump(self, path: Optional[str] = None, reason: str = "on_demand",
+             stacks: bool = True) -> str:
+        """Write the ring (plus thread stacks) to ``path`` — default
+        ``$STF_FLIGHT_RECORDER_DIR/flight-<pid>-<ts>.jsonl`` (dir
+        default: the platform tempdir). Returns the path written."""
+        if path is None:
+            import tempfile
+
+            d = os.environ.get("STF_FLIGHT_RECORDER_DIR",
+                               tempfile.gettempdir())
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight-{os.getpid()}-{int(time.time() * 1000)}.jsonl")
+        payload = self.dump_jsonl(stacks=stacks, reason=reason)
+        with open(path, "w") as f:
+            f.write(payload)
+        self.last_dump_path = path
+        _metric_dumps.get_cell(reason).increase_by(1)
+        return path
+
+    def on_error(self, exc: BaseException, where: str, **fields) -> None:
+        """Record an ``error`` event; auto-dump (rate-limited to one
+        per 30 s, ``STF_FLIGHT_DUMP_ON_ERROR=0`` disables) so the ring
+        around an unhandled session/serving failure survives the
+        process. Never raises."""
+        try:
+            self.record("error", where=where,
+                        error_type=type(exc).__name__,
+                        message=str(exc)[:500], **fields)
+            if not self.enabled or \
+                    os.environ.get("STF_FLIGHT_DUMP_ON_ERROR", "1") == "0":
+                return
+            now = time.monotonic()
+            with self._lock:
+                if now - self._last_auto_dump < 30.0:
+                    return
+                self._last_auto_dump = now
+            self.dump(reason=f"error:{where}")
+        except Exception:  # noqa: BLE001 — forensics never sink the op
+            pass
+
+
+def thread_stacks() -> List[Dict[str, Any]]:
+    """One ``thread_stack`` record per live thread: name, ident, daemon
+    flag, whether it is an stf-owned thread, and the formatted stack.
+    The wedge-forensics payload (`sys._current_frames`, the same data
+    ``faulthandler`` prints)."""
+    frames = sys._current_frames()
+    out = []
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        stack = traceback.format_stack(frame) if frame is not None else []
+        out.append({
+            "kind": "thread_stack",
+            "t": time.time(),
+            "thread": t.name,
+            "ident": t.ident,
+            "daemon": t.daemon,
+            "stf": t.name.startswith(_STF_THREAD_PREFIXES),
+            "stack": [ln.rstrip("\n") for ln in stack],
+        })
+    return out
+
+
+# process-global singleton: every layer records into the same ring so a
+# dump interleaves session, serving, data, and watchdog events in time
+_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record_event(kind: str, **fields) -> None:
+    _RECORDER.record(kind, **fields)
+
+
+_signals_installed = False
+
+
+def install_signal_handlers() -> bool:
+    """Dump the flight recorder on SIGTERM, PRESERVING the previous
+    disposition: a chained Python handler still runs, SIG_IGN still
+    ignores (the dump must not turn a TERM-shielded worker mortal), and
+    the default disposition still terminates. A C-level handler
+    (``getsignal() is None``) cannot be chained from Python, so nothing
+    is installed rather than silently replacing it. Main-thread only
+    (signal module contract); returns whether handlers are installed.
+    Idempotent."""
+    global _signals_installed
+    if _signals_installed:
+        return True
+    import signal
+
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+        if prev is None:
+            # a non-Python handler owns SIGTERM; replacing it would
+            # drop behavior we cannot reproduce — leave it alone
+            return False
+
+        def _on_sigterm(signum, frame):
+            try:
+                _RECORDER.dump(reason="sigterm")
+            except Exception:  # noqa: BLE001
+                pass
+            if prev == signal.SIG_IGN:
+                return  # the process chose to survive TERM; honor it
+            if callable(prev) and prev != signal.SIG_DFL:
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        # not the main thread: signal handlers cannot be installed here
+        return False
+    _signals_installed = True
+    return True

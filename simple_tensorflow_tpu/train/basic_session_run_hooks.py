@@ -45,12 +45,20 @@ class SecondOrStepTimer:
     def last_triggered_step(self):
         return self._last_step
 
+    @property
+    def every_steps(self):
+        return self._every_steps
+
     def steps_until_trigger(self, step):
         """Steps until this timer next fires — the hook's fusion-window
         vote (session_run_hook.SessionRunHook.until_next_trigger). 1
         when time-based (a wall-clock trigger cannot be predicted in
         steps) or when the timer has never fired (it wants the next
-        boundary)."""
+        boundary). The returned window ENDS at the trigger step —
+        CheckpointSaver/StepCounter/SummarySaver observe the boundary
+        value and fuse onward. ProfilerHook aligns differently (its
+        window must START at the trigger so the whole window is traced)
+        and implements its own vote."""
         if self._every_steps is None or self._last_step is None:
             return 1
         return max(1, self._last_step + self._every_steps - step)
@@ -458,9 +466,24 @@ class ProfilerHook(SessionRunHook):
         self._next_step = None
 
     def until_next_trigger(self, global_step):
-        # traces at window boundaries; a trigger step inside the window
-        # splits it so the traced run is a single (unfused) step
-        return self._timer.steps_until_trigger(global_step)
+        # ISSUE 8 satellite: the profiler's window must START at its
+        # trigger so the run it arms (SOFTWARE_TRACE via before_run) is
+        # one whole fused window — previously the armed trigger either
+        # vanished into an untraced window or silently forced a single
+        # unfused step. Away from the trigger, vote the distance to the
+        # step BEFORE it (the next window then begins exactly at the
+        # trigger); at the trigger (or before any trigger), vote the
+        # full cadence. run_steps records the window's spans + per-op
+        # attribution under SOFTWARE_TRACE, and _save annotates the
+        # timeline with the window's global-step range.
+        every = self._timer.every_steps
+        if every is None:
+            return 1  # time-based: a wall-clock trigger is unpredictable
+        last = self._timer.last_triggered_step()
+        next_step = global_step + 1  # first step of the window voted on
+        if last is None or next_step >= last + every:
+            return every
+        return last + every - next_step
 
     def before_run(self, run_context):
         self._request_summary = (
@@ -484,7 +507,18 @@ class ProfilerHook(SessionRunHook):
     def after_run(self, run_context, run_values):
         step = int(np.asarray(run_values.results))
         if self._request_summary:
-            self._timer.update_last_triggered_step(step)
+            # anchor the cadence at the traced WINDOW'S START, not its
+            # end: with update-at-end, save_steps=N under fusion would
+            # stretch the real period to ~2N-1 (N to the next trigger
+            # PLUS the window the timer just swallowed). Anchored at the
+            # start, trace windows begin exactly every N steps.
+            start = step
+            md = run_values.run_metadata
+            fusion = (getattr(md, "step_stats", None) or {}).get(
+                "loop_fusion") or {}
+            if fusion.get("fused") and fusion.get("n_steps"):
+                start = step - int(fusion["n_steps"]) + 1
+            self._timer.update_last_triggered_step(start)
             if run_values.run_metadata is not None:
                 self._save(step, run_values.run_metadata)
             if self._jax_tracing:
@@ -504,6 +538,14 @@ class ProfilerHook(SessionRunHook):
 
         os.makedirs(self._output_dir, exist_ok=True)
         path = os.path.join(self._output_dir, f"timeline-{step}.json")
+        stats0 = getattr(run_metadata, "step_stats", None)
+        fusion = (stats0 or {}).get("loop_fusion") or {}
+        if fusion.get("fused") and fusion.get("n_steps"):
+            # the trace covers a fused window ending at `step`: annotate
+            # the timeline with the window's global-step range so the
+            # reader knows which steps the one fused bar spans
+            n = int(fusion["n_steps"])
+            stats0["window_steps"] = [step - n + 1, step]
         with open(path, "w") as f:
             f.write(Timeline(run_metadata).generate_chrome_trace_format(
                 show_dataflow=self._show_dataflow,

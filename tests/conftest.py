@@ -26,15 +26,17 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True, scope="module")
 def _no_pipeline_leaks():
-    """Leak hygiene (ISSUE 6 satellite, extended to serving in ISSUE 7):
-    after each test module, no pipeline stage threads or serving
-    batcher threads may still be running, every PipelineIterator must
-    be closed, and every ModelServer must be shut down (an open server
-    pins its admission queues, batcher threads, and model sessions).
-    Long analyzer test sessions would otherwise mask teardown bugs —
-    an unclosed iterator/server pins its threads and ring buffers until
-    GC happens to run."""
+    """Leak hygiene (ISSUE 6 satellite; serving added in ISSUE 7,
+    telemetry in ISSUE 8): after each test module, no pipeline stage /
+    serving batcher / telemetry threads may still be running, every
+    PipelineIterator must be closed, every ModelServer shut down, and
+    the telemetry HTTP server stopped (an open server pins its
+    listener + connection threads). The watchdog monitor thread is
+    lazy process-global infrastructure: the fixture STOPS it after
+    each module (re-arming restarts it) and asserts the stop works —
+    clean shutdown is part of its contract."""
     yield
+    from simple_tensorflow_tpu import telemetry
     from simple_tensorflow_tpu.data import pipeline
     from simple_tensorflow_tpu.serving import server as serving_server
 
@@ -49,16 +51,20 @@ def _no_pipeline_leaks():
                     if not s.closed]
     for s in open_servers:
         s.close()
+    open_telemetry = telemetry.get_server() is not None
+    telemetry.shutdown()  # stops the HTTP server AND the watchdog
 
     # stage threads are named stf_data_<stage>, batcher threads
-    # stf_serving_batcher_<model>; the shared worker pool
+    # stf_serving_batcher_<model>, telemetry threads stf_telemetry_*
+    # (http listener, per-connection, watchdog); the shared worker pool
     # (thread_name_prefix stf_data_worker) is process-global by design
     # and exempt. Closed stages may need a moment to observe cancel.
     def stray():
         return [t for t in threading.enumerate()
                 if ((t.name.startswith("stf_data_")
                      and not t.name.startswith("stf_data_worker"))
-                    or t.name.startswith("stf_serving_"))
+                    or t.name.startswith("stf_serving_")
+                    or t.name.startswith("stf_telemetry_"))
                 and t.is_alive()]
 
     deadline = time.monotonic() + 5.0
@@ -71,6 +77,9 @@ def _no_pipeline_leaks():
     assert not open_servers, (
         "open ModelServer(s) leaked by this test module (close() them "
         f"or use a context manager): {open_servers!r}")
+    assert not open_telemetry, (
+        "telemetry server left running by this test module — call "
+        "stf.telemetry.stop() (or telemetry.shutdown()) in teardown")
     assert not leaked, (
-        "leaked pipeline/serving thread(s): "
+        "leaked pipeline/serving/telemetry thread(s): "
         + ", ".join(t.name for t in leaked))

@@ -1,0 +1,83 @@
+"""Minimal Prometheus text-exposition (0.0.4) validator for tests.
+
+Not a full parser — a line-grammar + consistency checker strong enough
+to catch every bug class the exposition unit tests pin: malformed
+series lines, bad metric/label names, raw newlines mid-series,
+non-cumulative histogram buckets, missing ``+Inf`` edges, and
+``_count``/``+Inf`` mismatches. Raises AssertionError with the
+offending line on any violation; returns the parsed series.
+"""
+
+import re
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+_SERIES_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(?:[^{}\n]*)\})? "
+    r"(NaN|[+-]?Inf|[-+0-9.eE]+)$")
+
+
+def validate_prometheus_text(text):
+    """Validate an exposition payload; returns
+    {series_name: [(labels_dict, value_str), ...]}."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    series = {}
+    typed = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name = rest.split(" ", 1)[0]
+            assert _NAME_RE.fullmatch(name), f"bad HELP name: {line!r}"
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"bad TYPE line: {line!r}"
+            assert _NAME_RE.fullmatch(parts[2]), f"bad TYPE name: {line!r}"
+            assert parts[3] in ("counter", "gauge", "histogram",
+                                "summary", "untyped"), f"bad type: {line!r}"
+            typed[parts[2]] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SERIES_RE.match(line)
+        assert m, f"malformed series line: {line!r}"
+        name, labelblock, value = m.group(1), m.group(2), m.group(3)
+        labels = {}
+        if labelblock:
+            inner = labelblock[1:-1]
+            consumed = 0
+            for lm in _LABEL_RE.finditer(inner):
+                labels[lm.group(1)] = lm.group(2)
+                consumed += len(lm.group(0))
+            # every byte of the block must belong to a well-formed pair
+            # (or the separating commas): torn/unescaped values fail here
+            n_commas = max(len(labels) - 1, 0)
+            assert consumed + n_commas == len(inner), \
+                f"malformed label block: {line!r}"
+        series.setdefault(name, []).append((labels, value))
+
+    # histogram consistency: cumulative buckets ending in +Inf == _count
+    for name, typ in typed.items():
+        if typ != "histogram":
+            continue
+        buckets = series.get(name + "_bucket", [])
+        counts = dict((tuple(sorted((k, v) for k, v in lb.items())), val)
+                      for lb, val in series.get(name + "_count", []))
+        groups = {}
+        for lb, val in buckets:
+            key = tuple(sorted((k, v) for k, v in lb.items()
+                               if k != "le"))
+            groups.setdefault(key, []).append((lb["le"], val))
+        for key, seq in groups.items():
+            values = [float(v) for _, v in seq]
+            assert values == sorted(values), \
+                f"histogram {name} buckets not cumulative: {seq}"
+            assert seq[-1][0] == "+Inf", \
+                f"histogram {name} missing +Inf bucket: {seq}"
+            if key in counts:
+                assert float(seq[-1][1]) == float(counts[key]), \
+                    f"histogram {name} +Inf != _count: {seq[-1]} vs " \
+                    f"{counts[key]}"
+    return series

@@ -239,6 +239,84 @@ class TestExport:
         for line in text.splitlines():
             assert not line.endswith('\\')
 
+    def test_prometheus_histogram_buckets_are_cumulative_with_inf(self):
+        # satellite (ISSUE 8): the native histogram contract —
+        # _bucket series are CUMULATIVE, end at le="+Inf", and the
+        # +Inf bucket equals _count
+        name = _name("cum")
+        s = monitoring.Sampler(name,
+                               monitoring.ExplicitBuckets([1.0, 10.0]),
+                               "d")
+        cell = s.get_cell()
+        for v in (0.5, 0.7, 5.0, 50.0):
+            cell.add(v)
+        text = monitoring.to_prometheus()
+        pn = monitoring._prom_name(name)
+        assert f'{pn}_bucket{{le="1.0"}} 2' in text
+        assert f'{pn}_bucket{{le="10.0"}} 3' in text
+        assert f'{pn}_bucket{{le="+Inf"}} 4' in text
+        assert f"{pn}_count 4" in text
+        from prom_format import validate_prometheus_text
+
+        validate_prometheus_text(text)
+
+    def test_prometheus_empty_label_value_keeps_pair(self):
+        # a cell whose label VALUE is "" must still emit the label pair
+        # (the old export()-keyed path dropped it, colliding with an
+        # unlabeled series)
+        name = _name("emptyv")
+        c = monitoring.Counter(name, "d", "shard")
+        c.get_cell("").increase_by(3)
+        c.get_cell("a").increase_by(4)
+        text = monitoring.to_prometheus()
+        pn = monitoring._prom_name(name)
+        assert f'{pn}{{shard=""}} 3' in text
+        assert f'{pn}{{shard="a"}} 4' in text
+
+    def test_prometheus_name_sanitization(self):
+        # /stf/... path style -> underscores; leading digit guarded
+        assert monitoring._prom_name(
+            "/stf/session/executable_cache/misses") \
+            == "stf_session_executable_cache_misses"
+        assert monitoring._prom_name("/9lives/x") == "_9lives_x"
+        assert monitoring._prom_name("///") == "_"
+        name = _name("weird")
+        c = monitoring.Counter(name + "/with-dash.dot", "d")
+        c.get_cell().increase_by(1)
+        from prom_format import validate_prometheus_text
+
+        validate_prometheus_text(monitoring.to_prometheus())
+
+    def test_prometheus_help_escapes_backslash(self):
+        name = _name("bs")
+        monitoring.Counter(name, "path C:\\tmp\nnext", )
+        text = monitoring.to_prometheus()
+        pn = monitoring._prom_name(name)
+        assert f"# HELP {pn} path C:\\\\tmp\\nnext" in text
+
+    def test_prometheus_summary_quantiles(self):
+        name = _name("sq")
+        p = monitoring.PercentileSampler(name, "d",
+                                         percentiles=(50.0, 99.0))
+        cell = p.get_cell()
+        for v in range(1, 101):
+            cell.add(float(v))
+        text = monitoring.to_prometheus()
+        pn = monitoring._prom_name(name)
+        assert f"# TYPE {pn} summary" in text
+        assert f'{pn}{{quantile="0.5"}}' in text
+        assert f'{pn}{{quantile="0.99"}}' in text
+        assert f"{pn}_count 100" in text
+
+    def test_prometheus_whole_registry_validates(self):
+        # whatever this process has registered so far must render as a
+        # well-formed exposition (torn lines, raw newlines, bad label
+        # blocks all fail the validator)
+        from prom_format import validate_prometheus_text
+
+        series = validate_prometheus_text(monitoring.to_prometheus())
+        assert series  # the library's own /stf/ metrics are present
+
     def test_to_json_is_strict_json(self):
         name = _name("strict")
         s = monitoring.Sampler(name,

@@ -1,0 +1,696 @@
+"""stf.telemetry tests (ISSUE 8): flight recorder, request tracing,
+watchdog wedge forensics, the HTTP telemetry server (including the
+concurrency hammer satellite), and the ProfilerHook x run_steps fusion
+fix."""
+
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import serving, telemetry
+from simple_tensorflow_tpu import saved_model as sm
+from simple_tensorflow_tpu.platform import monitoring
+from simple_tensorflow_tpu.telemetry import recorder as recorder_mod
+from simple_tensorflow_tpu.telemetry import watchdog as watchdog_mod
+
+from prom_format import validate_prometheus_text
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), \
+            r.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_record_and_events(self):
+        rec = recorder_mod.FlightRecorder(capacity=64)
+        rec.record("alpha", x=1)
+        rec.record("beta", y="two", arr=np.int64(3))
+        evs = rec.events()
+        assert [e["kind"] for e in evs] == ["alpha", "beta"]
+        assert evs[0]["x"] == 1 and evs[0]["thread"]
+        # numpy scalars sanitized to something JSON-able
+        json.dumps(evs)
+
+    def test_capacity_bounds_ring(self):
+        rec = recorder_mod.FlightRecorder(capacity=16)
+        for i in range(100):
+            rec.record("e", i=i)
+        evs = rec.events()
+        assert len(evs) == 16
+        assert evs[-1]["i"] == 99  # newest survive
+        assert rec.stats()["dropped"] > 0
+
+    def test_disabled_recorder_is_silent(self):
+        rec = recorder_mod.FlightRecorder(capacity=16)
+        rec.set_enabled(False)
+        rec.record("e")
+        assert rec.events() == []
+        rec.set_enabled(True)
+        rec.record("e")
+        assert len(rec.events()) == 1
+
+    def test_dump_jsonl_parses_and_has_stacks(self):
+        rec = recorder_mod.FlightRecorder(capacity=16)
+        rec.record("evt", n=7)
+        lines = [json.loads(ln) for ln in
+                 rec.dump_jsonl(reason="test").strip().splitlines()]
+        kinds = [ln["kind"] for ln in lines]
+        assert "evt" in kinds
+        assert "thread_stack" in kinds
+        assert kinds[-1] == "dump_info"
+        me = [ln for ln in lines if ln["kind"] == "thread_stack"
+              and ln["thread"] == threading.current_thread().name]
+        assert me and any("test_telemetry" in fr
+                          for fr in me[0]["stack"][-3:])
+
+    def test_dump_writes_file(self, tmp_path):
+        rec = recorder_mod.FlightRecorder(capacity=16)
+        rec.record("evt")
+        path = rec.dump(path=str(tmp_path / "f.jsonl"), reason="test")
+        assert os.path.exists(path)
+        assert rec.last_dump_path == path
+        with open(path) as f:
+            assert json.loads(f.readline())["kind"] == "evt"
+
+    def test_record_never_raises(self):
+        rec = recorder_mod.FlightRecorder(capacity=16)
+
+        class Evil:
+            def __str__(self):
+                raise RuntimeError("boom")
+
+        rec.record("evt", bad=Evil())  # must not propagate
+
+    def test_thread_stacks_flag_stf_threads(self):
+        done = threading.Event()
+        t = threading.Thread(target=done.wait, name="stf_data_fake",
+                             daemon=True)
+        t.start()
+        try:
+            stacks = {s["thread"]: s for s in recorder_mod.thread_stacks()}
+            assert stacks["stf_data_fake"]["stf"] is True
+            assert stacks[threading.current_thread().name]["stf"] is False
+        finally:
+            done.set()
+            t.join(5)
+
+
+# ---------------------------------------------------------------------------
+# request tracing
+# ---------------------------------------------------------------------------
+
+class TestTracing:
+    def test_trace_ids_unique_and_scoped(self):
+        a, b = telemetry.new_trace_id(), telemetry.new_trace_id()
+        assert a != b and len(a) == 16
+        assert telemetry.current_trace_id() is None
+        with telemetry.trace_scope(a):
+            assert telemetry.current_trace_id() == a
+            with telemetry.trace_scope([b, a]):
+                assert telemetry.current_trace_id() == b
+                assert telemetry.current_trace_ids() == [b, a]
+            assert telemetry.current_trace_id() == a
+        assert telemetry.current_trace_id() is None
+
+    def test_emit_and_filter_spans(self):
+        tid = telemetry.new_trace_id()
+        other = telemetry.new_trace_id()
+        telemetry.emit_span("mine", 1.0, 0.5, trace_id=tid)
+        telemetry.emit_span("batchy", 1.5, 0.25, trace_ids=[other, tid])
+        telemetry.emit_span("unrelated", 2.0, 0.1, trace_id=other)
+        names = [s["name"] for s in telemetry.recent_spans(trace_id=tid)]
+        assert names == ["mine", "batchy"]
+
+    def test_span_context_manager_uses_scope(self):
+        tid = telemetry.new_trace_id()
+        with telemetry.trace_scope(tid):
+            with telemetry.span("scoped", detail="x"):
+                pass
+        (s,) = telemetry.recent_spans(trace_id=tid)
+        assert s["name"] == "scoped" and s["meta"] == {"detail": "x"}
+
+    def test_chrome_trace_is_valid_and_filtered(self):
+        tid = telemetry.new_trace_id()
+        telemetry.emit_span("a", 1.0, 0.5, trace_id=tid, model="m")
+        telemetry.emit_span("noise", 1.0, 0.5,
+                            trace_id=telemetry.new_trace_id())
+        tr = json.loads(telemetry.chrome_trace(tid))
+        xs = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+        assert [e["name"] for e in xs] == ["a"]
+        assert xs[0]["args"]["trace_id"] == tid
+        assert tr["displayTimeUnit"] == "ms"
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_disarm_prevents_firing(self):
+        wd = watchdog_mod.Watchdog()
+        try:
+            token = wd.arm("op", 0.15)
+            wd.disarm(token)
+            time.sleep(0.4)
+            assert wd.wedges_detected == 0
+        finally:
+            wd.stop()
+
+    def test_wedge_records_stacks_and_dumps(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("STF_FLIGHT_RECORDER_DIR", str(tmp_path))
+        wd = watchdog_mod.Watchdog()
+        fired = []
+        wd.on_wedge.append(fired.append)
+        try:
+            token = wd.arm("test_op", 0.15, extra="meta")
+            deadline = time.monotonic() + 10
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert fired and fired[0]["what"] == "test_op"
+            # each armed entry fires exactly once
+            time.sleep(0.3)
+            assert len(fired) == 1
+            wd.disarm(token)
+            wedges = telemetry.get_recorder().events(kind="wedge")
+            assert wedges and wedges[-1]["what"] == "test_op"
+            assert any(s["thread"] == threading.current_thread().name
+                       for s in wedges[-1]["stacks"])
+            dumps = os.listdir(tmp_path)
+            assert dumps, "wedge must dump the flight recorder"
+        finally:
+            wd.stop()
+
+    def test_deadline_for_knobs(self, monkeypatch):
+        monkeypatch.setenv("STF_WATCHDOG_MULTIPLE", "4")
+        monkeypatch.setenv("STF_WATCHDOG_MIN_S", "2")
+        assert watchdog_mod.deadline_for(None) is None
+        assert watchdog_mod.deadline_for(0.1) == 2.0   # floor
+        assert watchdog_mod.deadline_for(10.0) == 40.0  # multiple
+        monkeypatch.setenv("STF_WATCHDOG", "0")
+        wd = watchdog_mod.Watchdog()
+        assert wd.arm("x", 5.0) is None
+        wd.stop()
+
+    def test_stop_joins_monitor_thread(self):
+        wd = watchdog_mod.Watchdog()
+        wd.arm("x", 100.0)
+        assert any(t.name == "stf_telemetry_watchdog"
+                   for t in threading.enumerate())
+        wd.stop()
+        assert not any(t.name == "stf_telemetry_watchdog"
+                       and t.is_alive()
+                       for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# a deliberately-wedged serving batch (acceptance forensics path)
+# ---------------------------------------------------------------------------
+
+class TestWedgedBatchForensics:
+    def test_wedged_batch_dump_has_spans_runs_and_stf_stacks(
+            self, tmp_path, monkeypatch):
+        """ISSUE 8 acceptance: a wedged batch produces a JSONL dump
+        containing recent span/run events and ALL stf thread stacks."""
+        monkeypatch.setenv("STF_FLIGHT_RECORDER_DIR", str(tmp_path))
+        monkeypatch.setenv("STF_WATCHDOG_MIN_S", "0.3")
+        monkeypatch.setenv("STF_WATCHDOG_MULTIPLE", "2")
+        from simple_tensorflow_tpu.serving.batcher import (
+            ContinuousBatcher, ServeFuture, ServeRequest)
+
+        wedge_now = threading.Event()
+        wedged = threading.Event()
+        release = threading.Event()
+
+        def execute(feeds, bucket):
+            if wedge_now.is_set():
+                wedged.set()
+                release.wait(20)  # the hang
+            return {"y": feeds["x"] * 2}
+
+        pol = serving.BatchingPolicy(max_batch_size=4,
+                                     batch_timeout_ms=1.0)
+        b = ContinuousBatcher("wedge_test/sig", execute, pol)
+        try:
+            # a couple of healthy batches build the trailing average
+            for _ in range(3):
+                fut = ServeFuture("wedge_test/sig",
+                                  trace_id=telemetry.new_trace_id())
+                b.submit(ServeRequest({"x": np.ones(2, np.float32)},
+                                      fut, trace_id=fut.trace_id))
+                fut.result(timeout=20)
+            fired = []
+            telemetry.get_watchdog().on_wedge.append(fired.append)
+            wedge_now.set()
+            fut = ServeFuture("wedge_test/sig",
+                              trace_id=telemetry.new_trace_id())
+            b.submit(ServeRequest({"x": np.ones(2, np.float32)}, fut,
+                                  trace_id=fut.trace_id))
+            assert wedged.wait(10)
+            deadline = time.monotonic() + 15
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert fired, "watchdog never fired on the wedged batch"
+            assert fired[0]["what"] == "serving_batch"
+            release.set()
+            fut.result(timeout=20)
+            path = telemetry.get_recorder().last_dump_path
+            assert path and os.path.dirname(path) == str(tmp_path)
+            lines = [json.loads(ln) for ln in open(path)
+                     if ln.strip()]
+            kinds = {ln["kind"] for ln in lines}
+            assert "wedge" in kinds
+            assert "span" in kinds  # recent span events rode along
+            stacks = [ln for ln in lines if ln["kind"] == "thread_stack"]
+            stf_stacks = [s for s in stacks if s["stf"]]
+            assert any(s["thread"].startswith("stf_serving_batcher_")
+                       for s in stf_stacks), \
+                "dump must carry the wedged batcher thread's stack"
+        finally:
+            release.set()
+            telemetry.get_watchdog().on_wedge.clear()
+            b.close()
+            telemetry.get_watchdog().stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP server
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def telemetry_server():
+    srv = telemetry.start(port=0)
+    yield srv
+    telemetry.shutdown()
+
+
+class TestTelemetryServer:
+    def test_healthz(self, telemetry_server):
+        status, ctype, body = _get(telemetry_server.url + "/healthz")
+        assert status == 200 and "json" in ctype
+        payload = json.loads(body)
+        assert payload["status"] == "ok" and payload["pid"] == os.getpid()
+
+    def test_metrics_is_valid_prometheus(self, telemetry_server):
+        monitoring.Counter("/stf/telemetry/__test_families",
+                           "d", "k").get_cell("v").increase_by(1)
+        status, ctype, body = _get(telemetry_server.url + "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        series = validate_prometheus_text(body)
+        assert series
+        # the library families are declared even before their first
+        # cell exists (series lines appear on first use)
+        assert "# TYPE stf_session_runs counter" in body
+        assert "# TYPE stf_serving_requests counter" in body
+        monitoring.unregister("/stf/telemetry/__test_families")
+
+    def test_statusz(self, telemetry_server):
+        status, _, body = _get(telemetry_server.url + "/statusz")
+        assert status == 200
+        info = json.loads(body)
+        assert info["process"]["pid"] == os.getpid()
+        assert info["process"]["stf_version"]
+        assert "flight_recorder" in info
+        assert "sessions" in info  # session module is imported here
+        assert "devices" in info   # jax is imported under tests
+
+    def test_tracez_json_and_chrome(self, telemetry_server):
+        tid = telemetry.new_trace_id()
+        telemetry.emit_span("probe", 1.0, 0.5, trace_id=tid)
+        status, _, body = _get(
+            telemetry_server.url + f"/tracez?trace_id={tid}")
+        assert status == 200
+        spans = json.loads(body)["spans"]
+        assert [s["name"] for s in spans] == ["probe"]
+        status, _, body = _get(
+            telemetry_server.url
+            + f"/tracez?trace_id={tid}&format=chrome")
+        assert status == 200
+        assert any(e["name"] == "probe"
+                   for e in json.loads(body)["traceEvents"])
+
+    def test_flightz_jsonl(self, telemetry_server):
+        telemetry.record_event("flightz_probe", tag=1)
+        status, ctype, body = _get(telemetry_server.url + "/flightz")
+        assert status == 200 and "ndjson" in ctype
+        lines = [json.loads(ln) for ln in body.strip().splitlines()]
+        assert any(ln["kind"] == "flightz_probe" for ln in lines)
+        assert any(ln["kind"] == "thread_stack" for ln in lines)
+        # ?stacks=0 omits the stack records
+        _, _, body = _get(telemetry_server.url + "/flightz?stacks=0")
+        assert not any(json.loads(ln)["kind"] == "thread_stack"
+                       for ln in body.strip().splitlines())
+
+    def test_404_and_index(self, telemetry_server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(telemetry_server.url + "/nope")
+        assert ei.value.code == 404
+        status, _, body = _get(telemetry_server.url + "/")
+        assert status == 200 and "/metrics" in body
+
+    def test_start_is_idempotent_port_conflict_raises(
+            self, telemetry_server):
+        again = telemetry.start(port=0)
+        assert again is telemetry_server
+        assert telemetry.start(port=telemetry_server.port) \
+            is telemetry_server
+        with pytest.raises(RuntimeError, match="already running"):
+            telemetry.start(port=1 if telemetry_server.port != 1 else 2)
+
+    def test_config_proto_starts_server(self):
+        g = stf.Graph()
+        with g.as_default():
+            sess = stf.Session(
+                graph=g, config=stf.ConfigProto(telemetry_port=0))
+        try:
+            srv = telemetry.get_server()
+            assert srv is not None
+            status, _, _ = _get(srv.url + "/healthz")
+            assert status == 200
+        finally:
+            sess.close()
+            telemetry.shutdown()
+        with pytest.raises(ValueError, match="telemetry_port"):
+            stf.ConfigProto(telemetry_port=-3)
+
+
+# ---------------------------------------------------------------------------
+# serving trace propagation + session flight events
+# ---------------------------------------------------------------------------
+
+def _export_mlp(tmpdir):
+    rng = np.random.RandomState(0)
+    g = stf.Graph()
+    with g.as_default():
+        x = stf.placeholder(stf.float32, [None, 8], name="x")
+        w = stf.Variable(stf.constant(
+            rng.randn(8, 4).astype(np.float32)), name="w")
+        y = stf.nn.softmax(stf.matmul(x, w), name="probs")
+        export_dir = os.path.join(tmpdir, "model")
+        with stf.Session(graph=g) as sess:
+            sess.run(stf.global_variables_initializer())
+            sm.simple_save(sess, export_dir, inputs={"x": x},
+                           outputs={"probs": y})
+    return export_dir
+
+
+class TestServingTracePropagation:
+    def test_predict_links_queue_batch_execute_fetch(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            export_dir = _export_mlp(tmp)
+            with serving.ModelServer() as server:
+                server.load(export_dir, name="traced")
+                fut = server.predict(
+                    {"x": np.ones(8, np.float32)})
+                fut.result(timeout=60)
+                assert fut.trace_id
+                names = [s["name"] for s in
+                         telemetry.recent_spans(trace_id=fut.trace_id)]
+                # ISSUE 8 acceptance: one request's chrome trace shows
+                # queue -> batch -> execute -> fetch sharing its id
+                for expect in ("serving_queue_wait",
+                               "serving_batch_assemble",
+                               "plan_execute",
+                               "serving_batch_execute",
+                               "serving_fetch"):
+                    assert expect in names, (expect, names)
+                tr = json.loads(telemetry.chrome_trace(fut.trace_id))
+                xs = {e["name"] for e in tr["traceEvents"]
+                      if e.get("ph") == "X"}
+                assert "serving_queue_wait" in xs \
+                    and "serving_fetch" in xs
+
+    def test_caller_trace_id_rides_through(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            export_dir = _export_mlp(tmp)
+            with serving.ModelServer() as server:
+                server.load(export_dir, name="rider")
+                fut = server.predict({"x": np.ones(8, np.float32)},
+                                     trace_id="gateway-0001")
+                fut.result(timeout=60)
+                assert fut.trace_id == "gateway-0001"
+                assert telemetry.recent_spans(trace_id="gateway-0001")
+
+    def test_e2e_outcome_sampler_labels(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            export_dir = _export_mlp(tmp)
+            with serving.ModelServer() as server:
+                server.load(export_dir, name="outcomes")
+                server.predict(
+                    {"x": np.ones(8, np.float32)}).result(timeout=60)
+                m = monitoring.get_metric(
+                    "/stf/serving/request_e2e_seconds")
+                snap = m.get_cell("outcomes/serving_default",
+                                  "ok").value()
+                assert snap["count"] >= 1
+
+    def test_statusz_reports_serving_rows(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            export_dir = _export_mlp(tmp)
+            with serving.ModelServer() as server:
+                server.load(export_dir, name="rows")
+                srv = telemetry.start(port=0)
+                try:
+                    _, _, body = _get(srv.url + "/statusz")
+                    rows = json.loads(body)["serving"]["models"]
+                    row = [r for r in rows if r["model"] == "rows"]
+                    assert row and row[0]["signature"] \
+                        == "serving_default"
+                    assert row[0]["aot_buckets_warm"] >= 1
+                finally:
+                    telemetry.shutdown()
+
+
+class TestSessionFlightEvents:
+    def test_run_and_plan_events(self):
+        rec = telemetry.get_recorder()
+        g = stf.Graph()
+        with g.as_default():
+            x = stf.placeholder(stf.float32, [2, 2], name="x")
+            y = stf.matmul(x, x)
+            with stf.Session(graph=g) as sess:
+                before_runs = len(rec.events(kind="run"))
+                before_plans = len(rec.events(kind="plan"))
+                sess.run(y, {x: np.ones((2, 2), np.float32)})
+                assert len(rec.events(kind="run")) == before_runs + 1
+                assert len(rec.events(kind="plan")) == before_plans + 1
+                ev = rec.events(kind="plan")[-1]
+                assert ev["n_device_ops"] >= 1
+
+    def test_error_event_on_failed_run(self):
+        rec = telemetry.get_recorder()
+        g = stf.Graph()
+        with g.as_default():
+            x = stf.placeholder(stf.float32, [2], name="x")
+            y = stf.check_numerics(x, "saw bad")
+            with stf.Session(graph=g) as sess:
+                before = len(rec.events(kind="error"))
+                with pytest.raises(Exception):
+                    sess.run(y, {x: np.array([1.0, np.nan],
+                                             np.float32)})
+                evs = rec.events(kind="error")
+                assert len(evs) > before
+                assert evs[-1]["where"] == "session_run"
+
+    def test_fused_window_event(self):
+        rec = telemetry.get_recorder()
+        g = stf.Graph()
+        with g.as_default():
+            v = stf.Variable(stf.constant(0.0, stf.float32), name="v")
+            inc = stf.assign_add(v, stf.constant(1.0, stf.float32))
+            with stf.Session(graph=g) as sess:
+                sess.run(stf.global_variables_initializer())
+                before = len(rec.events(kind="fused_window"))
+                sess.run_steps(inc.op, n=4)
+                evs = rec.events(kind="fused_window")
+                assert len(evs) == before + 1
+                assert evs[-1]["n_steps"] == 4
+
+
+# ---------------------------------------------------------------------------
+# concurrency hammer (ISSUE 8 satellite): /metrics under serving load
+# ---------------------------------------------------------------------------
+
+class TestEndpointsUnderConcurrency:
+    def test_metrics_scrapes_during_serving_load(self):
+        """Hammer /metrics (+ /statusz + /flightz) from several threads
+        while closed-loop clients drive the batcher: every scrape must
+        return a WELL-FORMED exposition (no torn reads), within a
+        bounded latency, and everything shuts down cleanly (the module
+        leak fixture re-checks stf_telemetry_* threads)."""
+        n_clients, n_scrapers, seconds = 8, 3, 2.0
+        with tempfile.TemporaryDirectory() as tmp:
+            export_dir = _export_mlp(tmp)
+            server = serving.ModelServer(policy=serving.BatchingPolicy(
+                max_batch_size=8, batch_timeout_ms=0.5))
+            server.load(export_dir, name="hammer")
+            srv = telemetry.start(port=0)
+            stop_at = time.perf_counter() + seconds
+            errors: list = []
+            scrape_times: list = []
+            served = [0] * n_clients
+
+            def client(i):
+                x = np.ones(8, np.float32) * i
+                try:
+                    while time.perf_counter() < stop_at:
+                        server.predict({"x": x}).result(timeout=60)
+                        served[i] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("client", repr(e)))
+
+            def scraper(i):
+                paths = ["/metrics", "/metrics", "/metrics",
+                         "/statusz", "/flightz?stacks=0"]
+                j = 0
+                try:
+                    while time.perf_counter() < stop_at:
+                        path = paths[j % len(paths)]
+                        t0 = time.perf_counter()
+                        status, _, body = _get(srv.url + path)
+                        scrape_times.append(
+                            time.perf_counter() - t0)
+                        assert status == 200
+                        if path == "/metrics":
+                            series = validate_prometheus_text(body)
+                            # both families the acceptance names
+                            assert any(k.startswith("stf_serving_")
+                                       for k in series)
+                            assert any(k.startswith("stf_session_")
+                                       for k in series)
+                        j += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("scraper", repr(e)))
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(n_clients)]
+            threads += [threading.Thread(target=scraper, args=(i,),
+                                         daemon=True)
+                        for i in range(n_scrapers)]
+            try:
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(60)
+            finally:
+                server.close()
+                telemetry.shutdown()
+            assert not errors, errors[:5]
+            assert sum(served) > 0, "serving load never ran"
+            assert len(scrape_times) >= 3, "scrapers never ran"
+            # bounded latency: generous for a 2-cpu CI box, but a
+            # registry-wide lock convoy or torn-read retry loop blows it
+            assert max(scrape_times) < 5.0, max(scrape_times)
+
+
+# ---------------------------------------------------------------------------
+# ProfilerHook x run_steps fusion (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+class TestProfilerFusion:
+    def _build(self, outdir, save_steps=8, fusion=8):
+        g = stf.Graph()
+        with g.as_default():
+            gs = stf.train.get_or_create_global_step()
+            x = stf.placeholder(stf.float32, [4, 8], name="x")
+            w = stf.get_variable("w", [8, 8],
+                                 initializer=stf.zeros_initializer())
+            loss = stf.reduce_sum(stf.matmul(x, w))
+            opt = stf.train.GradientDescentOptimizer(0.1).minimize(
+                loss, global_step=gs)
+            hook = stf.train.ProfilerHook(save_steps=save_steps,
+                                          output_dir=outdir)
+            sess = stf.train.MonitoredSession(
+                session_creator=stf.train.ChiefSessionCreator(
+                    config=stf.ConfigProto(loop_fusion_steps=fusion)),
+                hooks=[hook])
+        return g, sess, hook, opt, x
+
+    def test_until_next_trigger_votes_window_start_at_trigger(self):
+        hook = stf.train.ProfilerHook(save_steps=8)
+        hook._timer.update_last_triggered_step(8)
+        # mid-cadence: window must END right before the next trigger
+        assert hook.until_next_trigger(8) == 7    # steps 9..15
+        assert hook.until_next_trigger(12) == 3   # steps 13..15
+        # at the boundary: vote the FULL window starting at the trigger
+        assert hook.until_next_trigger(15) == 8   # steps 16..23
+        # past it (missed boundary): still a full traced window
+        assert hook.until_next_trigger(20) == 8
+        # never triggered: first run traces a full window too
+        fresh = stf.train.ProfilerHook(save_steps=8)
+        assert fresh.until_next_trigger(0) == 8
+
+    def test_trigger_step_yields_fused_traced_window(self):
+        with tempfile.TemporaryDirectory() as outdir:
+            g, sess, hook, opt, x = self._build(outdir)
+            with g.as_default():
+                feed = {x: np.ones((4, 8), np.float32)}
+                fused_before = monitoring.get_metric(
+                    "/stf/session/fused_steps_amortized") \
+                    .get_cell().value()
+                sess.run_steps(opt, 8, feed_dict=feed)
+                fused_after = monitoring.get_metric(
+                    "/stf/session/fused_steps_amortized") \
+                    .get_cell().value()
+                sess.close()
+            # the armed trigger did NOT force an unfused fallback
+            assert fused_after - fused_before == 8
+            assert hook.last_trace_path \
+                and os.path.exists(hook.last_trace_path)
+            tr = json.load(open(hook.last_trace_path))
+            names = [e["name"] for e in tr["traceEvents"]]
+            assert "fused_device_execute" in names, \
+                "the traced window vanished (no fused span recorded)"
+
+    def test_timeline_annotated_with_window_step_range(self):
+        with tempfile.TemporaryDirectory() as outdir:
+            g, sess, hook, opt, x = self._build(outdir)
+            with g.as_default():
+                sess.run_steps(
+                    opt, 8,
+                    feed_dict={x: np.ones((4, 8), np.float32)})
+                sess.close()
+            tr = json.load(open(hook.last_trace_path))
+            pn = [e["args"]["name"] for e in tr["traceEvents"]
+                  if e["name"] == "process_name"]
+            assert pn == ["stf.Session run_steps[1..8]"], pn
+
+    def test_attributed_device_track(self):
+        with tempfile.TemporaryDirectory() as outdir:
+            g, sess, hook, opt, x = self._build(outdir)
+            with g.as_default():
+                sess.run_steps(
+                    opt, 8,
+                    feed_dict={x: np.ones((4, 8), np.float32)})
+                sess.close()
+            tr = json.load(open(hook.last_trace_path))
+            attributed = [e for e in tr["traceEvents"]
+                          if e.get("tid") == 3 and e.get("ph") == "X"]
+            assert any("MatMul" in e["name"] for e in attributed), \
+                [e["name"] for e in attributed]
+            # fractions sum to ~1 over the window
+            total = sum(float(e["args"]["frac"]) for e in attributed)
+            assert 0.95 < total <= 1.01, total
+            tracks = {e["args"]["name"]
+                      for e in tr["traceEvents"]
+                      if e["name"] == "thread_name"}
+            assert "device ops (attributed)" in tracks
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])
