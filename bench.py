@@ -1472,6 +1472,116 @@ def _measure_telemetry(platform, device_kind):
     }
 
 
+def _measure_checkpoint(platform, device_kind):
+    """stf.checkpoint row (ISSUE 10): step-loop stall of an async save
+    (barrier snapshot + enqueue, background stf_ckpt_writer commit) vs
+    a blocking ``Saver.save`` of the SAME state, plus restore time and
+    the steps/sec of a save-every-K training loop under each mode. The
+    headline is the stall ratio (acceptance: async cuts the stall
+    >=5x). Medians over several saves, interleaved ABAB so filesystem
+    cache drift hits both modes alike."""
+    import shutil
+    import tempfile
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import checkpoint as ckpt_mod
+
+    reps = int(os.environ.get("BENCH_CKPT_REPS", "5"))
+    # ~64 MB of f32 state: big enough that serialize+fsync dominates a
+    # blocking save, small enough for the CPU fallback box
+    dim = int(os.environ.get("BENCH_CKPT_DIM", "2048"))
+    stf.reset_default_graph()
+    rng = np.random.RandomState(0)
+    gs = stf.train.get_or_create_global_step()
+    train_ops = [stf.assign_add(gs, stf.constant(1, stf.int64))]
+    for i in range(4):
+        v = stf.Variable(stf.constant(
+            rng.randn(dim, dim).astype(np.float32) * 0.01), name=f"w{i}")
+        train_ops.append(stf.assign_add(
+            v._ref, stf.fill([dim, dim], stf.constant(1e-4))))
+    train = stf.group(*train_ops)
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+    sess.run_steps(train, n=4)  # warm the fused path (donation active)
+    state_bytes = 4 * dim * dim * 4
+
+    tmp = tempfile.mkdtemp(prefix="stf_bench_ckpt_")
+    try:
+        blocking_saver = stf.train.Saver(max_to_keep=2)
+        mgr = ckpt_mod.CheckpointManager(
+            os.path.join(tmp, "async"), max_to_keep=2, async_save=True)
+
+        blocking_stalls, async_stalls = [], []
+        for _ in range(reps):  # interleaved ABAB
+            t0 = time.perf_counter()
+            blocking_saver.save(sess, os.path.join(tmp, "blk", "ckpt"),
+                                global_step=gs, write_meta_graph=False)
+            blocking_stalls.append(time.perf_counter() - t0)
+            sess.run_steps(train, n=2)
+            t0 = time.perf_counter()
+            mgr.save(sess, global_step=gs)
+            async_stalls.append(time.perf_counter() - t0)
+            mgr.wait_until_finished()  # keep runs independent
+            sess.run_steps(train, n=2)
+        blocking_s = float(np.median(blocking_stalls))
+        async_s = float(np.median(async_stalls))
+
+        # integrated loop: steps/sec with a save every K windows — the
+        # end-to-end view of what the stall costs a real training loop
+        def loop_steps_per_sec(save_fn, n_windows=6, window=8):
+            sess.run_steps(train, n=window)
+            t0 = time.perf_counter()
+            for _ in range(n_windows):
+                sess.run_steps(train, n=window)
+                save_fn()
+            dur = time.perf_counter() - t0
+            mgr.wait_until_finished()
+            return n_windows * window / dur
+
+        sps_async = loop_steps_per_sec(
+            lambda: mgr.save(sess, global_step=gs))
+        sps_blocking = loop_steps_per_sec(
+            lambda: blocking_saver.save(
+                sess, os.path.join(tmp, "blk", "ckpt"), global_step=gs,
+                write_meta_graph=False))
+        # final committed save of the CURRENT state, so the restored
+        # session can be value-checked against the live one
+        mgr.save(sess, global_step=gs, blocking=True)
+
+        t0 = time.perf_counter()
+        restore_sess = stf.Session()
+        mgr.restore(restore_sess)
+        restore_s = time.perf_counter() - t0
+        ok = bool(np.allclose(
+            np.asarray(restore_sess.variable_value("w0")),
+            np.asarray(sess.variable_value("w0"))))
+        ckpt_mod.shutdown_writer()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    ratio = blocking_s / max(async_s, 1e-9)
+    return {
+        **_monitoring_info(),
+        "metric": "checkpoint_async_stall_speedup_vs_blocking",
+        "value": round(ratio, 2),
+        "unit": "x (blocking Saver.save stall / async manager.save stall)",
+        "vs_baseline": None,
+        "blocking_save_stall_s": round(blocking_s, 6),
+        "async_save_stall_s": round(async_s, 6),
+        "restore_s": round(restore_s, 4),
+        "restore_values_match": ok,
+        "steps_per_sec_async_saves": round(sps_async, 2),
+        "steps_per_sec_blocking_saves": round(sps_blocking, 2),
+        "state_bytes": state_bytes,
+        "reps": reps,
+        "note": ("stall = wall time the step loop spends inside the "
+                 "save call; async pays only the donation-safe device "
+                 "snapshot + enqueue, the stf_ckpt_writer thread "
+                 "commits (atomic temp+fsync+replace, sha256 in the "
+                 "index) while the next fused window runs"),
+    }
+
+
 def _measure_transformer(batch, platform, device_kind):
     """BASELINE config 5: Transformer-big WMT en-de training step +
     beam-search inference latency. Comparator 2000 tokens/sec is a
@@ -1782,6 +1892,8 @@ def child_main():
         result = _measure_serving(platform, kind)
     elif model == "telemetry":
         result = _measure_telemetry(platform, kind)
+    elif model == "checkpoint":
+        result = _measure_checkpoint(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -1887,7 +1999,8 @@ def _run_model(model, platform, kind, errors):
                        "loop_fusion": "900",
                        "input_pipeline": "600",
                        "serving": "900",
-                       "telemetry": "900"}.get(
+                       "telemetry": "900",
+                       "checkpoint": "600"}.get(
         model, "900")
     extra_xla_flags = ""
     if model == "loop_fusion":
@@ -1960,6 +2073,9 @@ _METRIC_NAMES = {
     "telemetry": ("telemetry_overhead_frac",
                   "fraction (worst of serving QPS loss / train "
                   "step-time growth, telemetry ON vs OFF)"),
+    "checkpoint": ("checkpoint_async_stall_speedup_vs_blocking",
+                   "x (blocking Saver.save stall / async manager.save "
+                   "stall)"),
     "warm_start": ("warm_start_warmup_plus_compile_s",
                    "s (second process, shared persistent compile cache)"),
 }
@@ -1982,7 +2098,7 @@ def main():
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
             "sharding_analysis,loop_fusion,input_pipeline,serving,"
-            "telemetry,warm_start").split(","):
+            "telemetry,checkpoint,warm_start").split(","):
         tok = tok.strip()
         if not tok:
             continue
@@ -2000,7 +2116,7 @@ def main():
                     "resnet_dp", "graph_opt", "analysis",
                     "sharding_analysis", "loop_fusion",
                     "input_pipeline", "serving", "telemetry",
-                    "warm_start"]
+                    "checkpoint", "warm_start"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

@@ -277,4 +277,8 @@ from . import analysis
 # docs/OBSERVABILITY.md)
 from . import telemetry
 
+# async checkpointing + preemption-safe training (stf.checkpoint;
+# docs/CHECKPOINT.md)
+from . import checkpoint
+
 newaxis = None

@@ -1,0 +1,53 @@
+"""stf.checkpoint: async checkpointing and preemption-safe training
+(docs/CHECKPOINT.md).
+
+The checkpoint plane over the stf-bundle format ``train.Saver`` writes:
+
+- **Async saves** — ``CheckpointManager.save`` (or
+  ``train.Saver(backend="async")`` / the default
+  ``CheckpointSaverHook``) takes a donation-safe *barrier snapshot* of
+  the device-resident training state (variables + optimizer slots +
+  global_step + RNG run counters + data iterator positions) at a fused-
+  window boundary, then serializes and commits on the background
+  ``stf_ckpt_writer`` thread so the next ``run_steps`` window overlaps
+  the I/O.
+- **Atomic commit protocol** — every artifact goes through temp + fsync
+  + ``os.replace`` with a content checksum in the index, data → index →
+  state-file ordering: a crash at ANY point leaves the previous
+  checkpoint loadable (crash-injection tested).
+- **CheckpointManager** — retention, garbage collection, integrity
+  verification on restore, ``restore_or_initialize`` resuming mid-epoch.
+- **Preemption handling** — SIGTERM (chained onto telemetry's
+  dispositions) → drain the in-flight fused window → save → clean exit;
+  ``MonitoredTrainingSession`` resumes bit-exact.
+
+Inspect/verify on-disk checkpoints with
+``python -m simple_tensorflow_tpu.tools.ckpt_inspect <dir>``.
+"""
+
+from . import metrics  # registers the /stf/checkpoint/* families
+from .atomic import (COMMIT_POINTS, atomic_write_bytes, atomic_write_json,
+                     checksum_bytes, checksum_file, set_fault_hook)
+from .snapshot import (TrainingStateSnapshot, capture_training_state,
+                       verify_checkpoint, write_native_checkpoint)
+from .writer import (CheckpointWriter, PendingCheckpoint, get_writer,
+                     shutdown_writer, wait_until_finished)
+from .manager import AsyncSaverEngine, CheckpointManager
+from .preemption import (PreemptionHandler, install_preemption_handler,
+                         preemption_requested, request_preemption,
+                         reset_preemption_state,
+                         uninstall_preemption_handler)
+
+__all__ = [
+    "COMMIT_POINTS", "atomic_write_bytes", "atomic_write_json",
+    "checksum_bytes", "checksum_file", "set_fault_hook",
+    "TrainingStateSnapshot", "capture_training_state",
+    "verify_checkpoint", "write_native_checkpoint",
+    "CheckpointWriter", "PendingCheckpoint", "get_writer",
+    "shutdown_writer", "wait_until_finished",
+    "AsyncSaverEngine", "CheckpointManager",
+    "PreemptionHandler", "install_preemption_handler",
+    "preemption_requested", "request_preemption",
+    "reset_preemption_state", "uninstall_preemption_handler",
+    "metrics",
+]

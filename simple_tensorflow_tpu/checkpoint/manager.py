@@ -1,0 +1,316 @@
+"""CheckpointManager and the async save engine.
+
+``AsyncSaverEngine`` gives any ``train.Saver`` an async save path: the
+step loop pays only the barrier snapshot (donation-safe device copies +
+host state, ``Session.snapshot_device_state``) and one queue put; the
+``stf_ckpt_writer`` thread materializes, serializes, commits (atomic
+data → index → ``checkpoint`` state-file ordering), applies retention,
+and surfaces any failure on the caller's next ``save()`` /
+``wait_until_finished()``.
+
+``CheckpointManager`` (ref: the role of tf.train.CheckpointManager)
+owns a directory: retention (max_to_keep / keep_checkpoint_every_n_
+hours), garbage collection, integrity verification on restore, and
+``restore_or_initialize`` that reconstructs the FULL training state —
+variables, optimizer slots, global_step, RNG run counters, data
+iterator positions — mid-epoch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..framework import errors
+from ..platform import monitoring
+from . import metrics as _m
+from . import snapshot as snapshot_mod
+from . import writer as writer_mod
+
+
+def _flight():
+    from ..telemetry import recorder
+
+    return recorder.get_recorder()
+
+
+class AsyncSaverEngine:
+    """Async save path over an existing ``train.Saver``'s variable set
+    and retention bookkeeping (native backend only — orbax ships its
+    own async machinery)."""
+
+    def __init__(self, saver):
+        if getattr(saver, "_backend", "native") not in ("native",
+                                                        "async"):
+            raise ValueError(
+                "AsyncSaverEngine writes the native stf-bundle format; "
+                f"got a backend={saver._backend!r} Saver")
+        self._saver = saver
+        self._lock = threading.Lock()
+        self._pending: List[writer_mod.PendingCheckpoint] = []
+        self._unraised_error: Optional[BaseException] = None
+
+    # -- error surfacing ------------------------------------------------------
+    def _collect_errors(self):
+        with self._lock:
+            done = [p for p in self._pending if p.done]
+            self._pending = [p for p in self._pending if not p.done]
+            for p in done:
+                if p.error is not None and self._unraised_error is None:
+                    self._unraised_error = p.error
+
+    def check_error(self):
+        """Raise (once) the first failure of any previously enqueued
+        write — an async save must never fail silently."""
+        self._collect_errors()
+        with self._lock:
+            err, self._unraised_error = self._unraised_error, None
+        if err is not None:
+            raise err
+
+    # -- save -----------------------------------------------------------------
+    def save(self, sess, save_path, global_step=None, latest_filename=None,
+             write_meta_graph=True, write_state=True) -> str:
+        from ..train import saver as saver_mod
+
+        self.check_error()
+        saver = self._saver
+        step_val = saver_mod.resolve_global_step(sess, global_step)
+        prefix = f"{save_path}-{step_val}" if step_val is not None \
+            else save_path
+        t0 = time.perf_counter()
+        snap = snapshot_mod.capture_training_state(sess, saver._vars())
+        snap.step = step_val
+        graph = sess.graph
+
+        def job():
+            arrays = snap.materialize()
+            snapshot_mod.write_native_checkpoint(
+                prefix, arrays, snap.tensor_index, snap.host_state)
+            if write_meta_graph:
+                try:
+                    from ..framework import graph_io
+
+                    graph_io.export_meta_graph(prefix + ".meta",
+                                               graph=graph)
+                except Exception as e:  # noqa: BLE001 — advisory artifact
+                    from ..platform import tf_logging as logging
+
+                    logging.warning(
+                        "async checkpoint: meta-graph export to %s.meta "
+                        "failed (%s); checkpoint tensors were saved.",
+                        prefix, e)
+            # state file LAST: only a fully durable checkpoint may
+            # become `latest_checkpoint`
+            saver._manage_old(prefix)
+            if write_state:
+                saver_mod.update_checkpoint_state(
+                    os.path.dirname(prefix) or ".", prefix,
+                    [p for p, _ in saver._last_checkpoints],
+                    latest_filename)
+            _m.saves.get_cell("async").increase_by(1)
+            _flight().record("checkpoint", action="save", mode="async",
+                             prefix=prefix,
+                             step=-1 if step_val is None else step_val)
+            return prefix
+
+        pending = writer_mod.get_writer().submit(job, description=prefix)
+        with self._lock:
+            self._pending.append(pending)
+        _m.save_stall_seconds.get_cell("async").add(
+            time.perf_counter() - t0)
+        return prefix
+
+    def wait_until_finished(self, timeout: Optional[float] = None):
+        with self._lock:
+            pendings = list(self._pending)
+        for p in pendings:
+            if not p._done.wait(timeout):
+                raise TimeoutError(
+                    f"checkpoint write {p.description!r} still pending")
+        self.check_error()
+
+
+class CheckpointManager:
+    """Directory-owning checkpoint plane (docs/CHECKPOINT.md)."""
+
+    def __init__(self, directory, max_to_keep=5,
+                 keep_checkpoint_every_n_hours=10000.0,
+                 checkpoint_basename="model.ckpt", saver=None,
+                 var_list=None, async_save=True, write_meta_graph=False,
+                 latest_filename=None):
+        from ..train import saver as saver_mod
+
+        self._directory = str(directory)
+        self._latest_filename = latest_filename
+        self._write_meta_graph = write_meta_graph
+        os.makedirs(self._directory, exist_ok=True)
+        if saver is None:
+            saver = saver_mod.Saver(
+                var_list=var_list, max_to_keep=max_to_keep,
+                keep_checkpoint_every_n_hours=keep_checkpoint_every_n_hours)
+        self._saver = saver
+        # adopt pre-existing checkpoints so retention counts them
+        st = saver_mod.get_checkpoint_state(self._directory,
+                                            latest_filename)
+        if st is not None and st.all_model_checkpoint_paths:
+            self._saver.recover_last_checkpoints(
+                st.all_model_checkpoint_paths)
+        self._async = bool(async_save) and \
+            getattr(saver, "_backend", "native") in ("native", "async")
+        self._engine = AsyncSaverEngine(saver) if self._async else None
+        self._save_path = os.path.join(self._directory,
+                                       checkpoint_basename)
+
+    # -- properties -----------------------------------------------------------
+    @property
+    def directory(self) -> str:
+        return self._directory
+
+    @property
+    def saver(self):
+        return self._saver
+
+    @property
+    def latest_checkpoint(self) -> Optional[str]:
+        from ..train import saver as saver_mod
+
+        return saver_mod.latest_checkpoint(self._directory,
+                                           self._latest_filename)
+
+    @property
+    def checkpoints(self) -> List[str]:
+        """All registered checkpoint prefixes, oldest first."""
+        from ..train import saver as saver_mod
+
+        st = saver_mod.get_checkpoint_state(self._directory,
+                                            self._latest_filename)
+        return list(st.all_model_checkpoint_paths) if st else []
+
+    # -- save/restore ---------------------------------------------------------
+    def save(self, sess, global_step=None, blocking: Optional[bool] = None
+             ) -> str:
+        """Checkpoint the session's full training state. Async by
+        default (construction-time ``async_save``): returns as soon as
+        the barrier snapshot is captured; ``blocking=True`` (or a
+        non-async manager) additionally waits for the commit."""
+        if self._engine is not None:
+            prefix = self._engine.save(
+                sess, self._save_path, global_step=global_step,
+                latest_filename=self._latest_filename,
+                write_meta_graph=self._write_meta_graph)
+            if blocking:
+                self._engine.wait_until_finished()
+            return prefix
+        return self._saver.save(
+            sess, self._save_path, global_step=global_step,
+            latest_filename=self._latest_filename,
+            write_meta_graph=self._write_meta_graph)
+
+    def verify(self, checkpoint_path: Optional[str] = None) -> List[str]:
+        """Integrity problems of one checkpoint (default: latest);
+        empty list = verified."""
+        path = checkpoint_path or self.latest_checkpoint
+        if path is None:
+            return [f"{self._directory}: no checkpoint found"]
+        return snapshot_mod.verify_checkpoint(path)
+
+    def restore(self, sess, checkpoint_path: Optional[str] = None,
+                verify: bool = True) -> str:
+        """Restore the full training state from ``checkpoint_path``
+        (default: latest), verifying integrity first."""
+        from ..train import saver as saver_mod
+
+        path = checkpoint_path or self.latest_checkpoint
+        if path is None or not saver_mod.checkpoint_exists(path):
+            _m.restores.get_cell("not_found").increase_by(1)
+            raise errors.NotFoundError(
+                None, None,
+                f"No checkpoint found at "
+                f"{path or self._directory}")
+        t0 = time.perf_counter()
+        with monitoring.traceme("checkpoint_restore", prefix=path):
+            if verify:
+                problems = snapshot_mod.verify_checkpoint(path)
+                if problems:
+                    _m.restores.get_cell("verify_failed").increase_by(1)
+                    raise errors.DataLossError(
+                        None, None,
+                        f"Checkpoint {path} failed verification:\n  "
+                        + "\n  ".join(problems))
+            # checksum either just verified above or explicitly opted
+            # out of (verify=False skips ALL integrity checking, incl.
+            # restore_or_initialize re-entering after its own verify
+            # pass) — don't re-read + re-hash the bundle inside restore
+            self._saver.restore(sess, path, verify_checksum=False)
+        _m.restores.get_cell("ok").increase_by(1)
+        _m.restore_seconds.get_cell().add(time.perf_counter() - t0)
+        _flight().record("checkpoint", action="restore", prefix=path)
+        return path
+
+    def restore_or_initialize(self, sess, init_op=None,
+                              init_feed_dict=None, init_fn=None,
+                              verify: bool = True) -> Optional[str]:
+        """Restore the newest checkpoint that passes verification
+        (falling back to older ones on corruption), else run the
+        provided initializer(s). Returns the restored prefix, or None
+        when the session was initialized fresh."""
+        from ..platform import tf_logging as logging
+
+        seen = set()
+        candidates = []
+        latest = self.latest_checkpoint
+        if latest:
+            candidates.append(latest)
+            seen.add(latest)
+        for p in reversed(self.checkpoints):
+            if p not in seen:
+                candidates.append(p)
+                seen.add(p)
+        for path in candidates:
+            problems = snapshot_mod.verify_checkpoint(path) if verify \
+                else []
+            if problems:
+                _m.restores.get_cell("verify_failed").increase_by(1)
+                logging.warning(
+                    "CheckpointManager: %s failed verification (%s); "
+                    "trying an older checkpoint.", path,
+                    "; ".join(problems))
+                continue
+            try:
+                self.restore(sess, path, verify=False)
+                return path
+            except errors.OpError as e:
+                _m.restores.get_cell("error").increase_by(1)
+                logging.warning(
+                    "CheckpointManager: restore of %s failed (%s); "
+                    "trying an older checkpoint.", path, e)
+        if init_op is not None:
+            sess.run(init_op, feed_dict=init_feed_dict)
+        if init_fn is not None:
+            init_fn(sess)
+        return None
+
+    # -- lifecycle ------------------------------------------------------------
+    def wait_until_finished(self, timeout: Optional[float] = None):
+        """Block until every async save enqueued by this manager has
+        committed; re-raises the first failure."""
+        if self._engine is not None:
+            self._engine.wait_until_finished(timeout)
+
+    def close(self):
+        self.wait_until_finished()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # don't mask an in-flight exception with a deferred write error
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            if exc and exc[0] is None:
+                raise
+        return False

@@ -1,0 +1,271 @@
+"""Preemption-safe training: SIGTERM → drain → save → clean exit.
+
+Cloud schedulers deliver SIGTERM with a grace window before the kill
+(the TPU-pod study, arXiv 1909.09756, and the MPI characterization,
+arXiv 1810.11112, both treat restart/checkpoint cost as a first-order
+scale limiter — losing the whole epoch to a preemption is the worst
+case). The flow here:
+
+1. ``install_preemption_handler()`` puts a chaining handler on SIGTERM
+   that just sets a flag (+ dumps the telemetry flight recorder, which
+   is what telemetry's own SIGTERM disposition would have done — but
+   WITHOUT its terminate-the-process tail, because the whole point is a
+   graceful drain). A previously installed *user* handler still runs;
+   ``SIG_IGN`` processes stay TERM-shielded (the handler is then not
+   installed at all).
+2. The in-flight fused window finishes normally — ``run_steps`` windows
+   are uninterruptible device programs, and their boundary is exactly
+   the consistent-state barrier the checkpoint needs.
+3. ``PreemptionHandler`` (a SessionRunHook) sees the flag at the next
+   ``after_run`` barrier, saves a checkpoint (blocking — the process is
+   about to exit), and requests a coordinator stop. Its fusion vote
+   drops to 1 once preemption is requested so the drain adds at most
+   one more step, not a whole window.
+4. The training loop exits cleanly; on restart,
+   ``MonitoredTrainingSession(checkpoint_dir=...)`` (or
+   ``CheckpointManager.restore_or_initialize``) resumes bit-exact:
+   variables + optimizer slots + global_step + RNG run counters + data
+   iterator positions all come back (docs/CHECKPOINT.md walkthrough).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..train.session_run_hook import SessionRunHook
+from . import metrics as _m
+
+# Plain attribute writes only — this state is touched from a SIGNAL
+# HANDLER, which runs on the main thread at an arbitrary bytecode
+# boundary. Taking any lock there (threading.Lock, the recorder's ring
+# lock, a metric cell lock) can deadlock against the interrupted frame
+# that already holds it; CPython attribute stores are atomic under the
+# GIL, which is all the flag needs.
+_requested = False
+_requested_at: Optional[float] = None
+_reason: Optional[str] = None
+_bookkept = True  # no deferred metric/flight/dump work pending
+_dump_on_flush = False
+_prev_handler = None
+_installed = False
+
+
+def _mark_requested(reason: str, defer_bookkeeping: bool,
+                    dump: bool) -> bool:
+    """Async-signal-safe half of a preemption request: set the flag and
+    stash what the drain path still owes (metric bump, flight event,
+    recorder dump). Returns False when already requested."""
+    global _requested, _requested_at, _reason, _bookkept, _dump_on_flush
+    if _requested:
+        return False
+    _requested_at = time.time()
+    _reason = reason
+    _dump_on_flush = dump
+    _bookkept = not defer_bookkeeping
+    _requested = True  # set LAST: readers see fully-stamped state
+    return True
+
+
+def _do_bookkeeping(dump: bool) -> None:
+    _m.preemptions.get_cell().increase_by(1)
+    from ..telemetry import recorder
+
+    rec = recorder.get_recorder()
+    rec.record("checkpoint", action="preemption_signal",
+               reason=_reason, pid=os.getpid())
+    if dump:
+        try:
+            rec.dump(reason="sigterm")
+        except Exception:  # noqa: BLE001 — forensics never block drain
+            pass
+
+
+def preemption_requested() -> bool:
+    """Whether a preemption was requested — polled by the drain path
+    (hook votes / after_run). Flushes any bookkeeping the signal
+    handler deferred (it may only set flags): metric, flight event,
+    flight-recorder dump — here, on a normal frame, locks are safe."""
+    global _bookkept
+    if _requested and not _bookkept:
+        _bookkept = True
+        try:
+            _do_bookkeeping(_dump_on_flush)
+        except Exception:  # noqa: BLE001
+            pass
+    return _requested
+
+
+def request_preemption(reason: str = "manual") -> None:
+    """Programmatic preemption (tests, external schedulers polling a
+    metadata endpoint): same drain → save → stop flow, no signal."""
+    if _mark_requested(reason, defer_bookkeeping=False, dump=False):
+        _do_bookkeeping(dump=False)
+
+
+def reset_preemption_state() -> None:
+    """Clear the request flag (tests; a resumed in-process run)."""
+    global _requested, _requested_at, _reason, _bookkept, _dump_on_flush
+    _requested = False
+    _requested_at = None
+    _reason = None
+    _bookkept = True
+    _dump_on_flush = False
+
+
+def install_preemption_handler() -> bool:
+    """Install the chaining SIGTERM handler (main thread only;
+    idempotent). Returns whether a handler is active."""
+    global _prev_handler, _installed
+    if _installed:
+        return True
+    import signal
+
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+        if prev is None:
+            # a C-level handler owns SIGTERM; we cannot chain it
+            return False
+        if prev == signal.SIG_IGN:
+            # the process chose to be TERM-shielded; preemption-on-TERM
+            # would change that contract
+            return False
+
+        from ..telemetry import recorder as recorder_mod
+
+        def _on_sigterm(signum, frame):
+            # ASYNC-SIGNAL-SAFE: plain flag writes only. The metric
+            # bump, flight event, and recorder dump (telemetry's own
+            # SIGTERM disposition — minus its terminate-the-process
+            # tail, which a graceful drain must absorb) all take locks
+            # the interrupted frame may hold, so they are DEFERRED to
+            # the drain path's next preemption_requested() poll.
+            _mark_requested("sigterm", defer_bookkeeping=True,
+                            dump=True)
+            if (callable(prev) and prev != signal.SIG_DFL
+                    and prev is not recorder_mod._installed_handler):
+                prev(signum, frame)  # a user handler keeps running
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        _prev_handler = prev
+        _installed = True
+        return True
+    except ValueError:
+        # not the main thread
+        return False
+
+
+def uninstall_preemption_handler() -> None:
+    global _prev_handler, _installed
+    if not _installed:
+        return
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM, _prev_handler)
+    except (ValueError, TypeError):
+        pass
+    _prev_handler = None
+    _installed = False
+
+
+class PreemptionHandler(SessionRunHook):
+    """SessionRunHook half of the flow (importable standalone; also
+    appended by ``MonitoredTrainingSession(checkpoint_dir=...)``).
+
+    Saves through, in priority order: an explicit ``manager``, an
+    explicit ``saver``, the scaffold's saver, else a fresh
+    ``train.Saver`` — always blocking (the process is exiting) to
+    ``checkpoint_dir/checkpoint_basename-<global_step>``.
+    """
+
+    def __init__(self, checkpoint_dir=None, manager=None, saver=None,
+                 scaffold=None, checkpoint_basename="model.ckpt",
+                 install: bool = True):
+        if manager is None and checkpoint_dir is None:
+            raise ValueError(
+                "PreemptionHandler needs a checkpoint_dir or a "
+                "CheckpointManager")
+        self._checkpoint_dir = checkpoint_dir
+        self._manager = manager
+        self._saver = saver
+        self._scaffold = scaffold
+        self._basename = checkpoint_basename
+        self._install = install
+        self._installed_here = False
+        self._saved = False
+        self.last_saved_prefix: Optional[str] = None
+
+    # -- SessionRunHook protocol ---------------------------------------------
+    def begin(self):
+        from ..train import training_util
+
+        self._global_step_tensor = training_util.get_global_step()
+        if self._install:
+            self._installed_here = install_preemption_handler()
+
+    def until_next_trigger(self, global_step):
+        # once preemption is requested, stop fusing: drain in at most
+        # one more step, then save at its barrier
+        return 1 if preemption_requested() else (1 << 30)
+
+    def after_run(self, run_context, run_values):
+        if not preemption_requested() or self._saved:
+            return
+        self._drain_and_save(run_context.session)
+        run_context.request_stop()
+
+    def end(self, session):
+        if preemption_requested() and not self._saved:
+            # the loop exited (e.g. StopAtStep) before a post-signal
+            # barrier was reached; still persist the final state
+            self._drain_and_save(session)
+        if self._installed_here:
+            uninstall_preemption_handler()
+            self._installed_here = False
+
+    # -- internals ------------------------------------------------------------
+    def _current_step(self, session) -> Optional[int]:
+        from ..train.saver import resolve_global_step
+
+        return resolve_global_step(session, self._global_step_tensor)
+
+    def _drain_and_save(self, session):
+        from ..platform import tf_logging as logging
+        from ..telemetry import recorder
+
+        self._saved = True
+        step = self._current_step(session)
+        if self._manager is not None:
+            prefix = self._manager.save(session, global_step=step,
+                                        blocking=True)
+        else:
+            saver = self._resolve_saver()
+            save_path = os.path.join(self._checkpoint_dir,
+                                     self._basename)
+            prefix = saver.save(session, save_path, global_step=step)
+            engine = getattr(saver, "_async_engine", None)
+            if engine is not None:
+                engine.wait_until_finished()
+        self.last_saved_prefix = prefix
+        recorder.get_recorder().record(
+            "checkpoint", action="preemption_save", prefix=prefix,
+            step=-1 if step is None else step)
+        logging.info(
+            "PreemptionHandler: drained and saved %s at global_step=%s; "
+            "requesting stop.", prefix, step)
+
+    def _resolve_saver(self):
+        if self._saver is not None:
+            return self._saver
+        if self._scaffold is not None and \
+                getattr(self._scaffold, "saver", None) is not None:
+            return self._scaffold.saver
+        from ..framework import graph as ops_mod
+        from ..train.saver import Saver
+
+        savers = ops_mod.get_default_graph().get_collection(
+            ops_mod.GraphKeys.SAVERS)
+        self._saver = savers[0] if savers else Saver()
+        return self._saver

@@ -1,0 +1,246 @@
+"""Training-state snapshots and the stf-bundle checkpoint format.
+
+Two producers share one format implementation:
+
+- ``Saver.save`` (blocking): fetches tensors to host numpy in-line and
+  calls ``write_native_checkpoint`` directly.
+- the async plane (``CheckpointManager`` / ``AsyncSaverEngine``):
+  ``capture_training_state`` takes a *barrier snapshot* — donation-safe
+  on-device copies of the variable store (``Session.
+  snapshot_device_state``) plus host state (RNG run counter, data
+  iterator positions) — in microseconds-to-milliseconds, then the
+  ``stf_ckpt_writer`` thread materializes (D2H), serializes, and
+  commits while the next fused window already runs.
+
+Format (``docs/CHECKPOINT.md``): ``<prefix>.stfz`` (npz of all tensors,
+keys '/'-flattened with '|') + ``<prefix>.index.json`` (dtypes/shapes/
+shardings, content checksum of the data file, host state) + the classic
+``checkpoint`` state file. Commit ordering — data, then index, then
+state file, each through the atomic temp+fsync+replace protocol — means
+a crash at any point leaves the previous checkpoint loadable.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..platform import monitoring
+from . import atomic
+from . import metrics as _m
+
+INDEX_VERSION = 2  # v2 adds checksum/data_bytes/sharding fields
+
+
+def _npz_key(key: str) -> str:
+    # npz keys are '/'-flattened with '|' (train.saver
+    # load_checkpoint_values is the one reader that knows this)
+    return key.replace("/", "|")
+
+
+def sharding_desc(arr) -> Optional[str]:
+    """Best-effort human-readable sharding of a device array for the
+    index (``PartitionSpec('tp', None)`` style, or None when fully
+    replicated / unknown)."""
+    try:
+        sh = getattr(arr, "sharding", None)
+        if sh is None:
+            return None
+        spec = getattr(sh, "spec", None)
+        if spec is not None and any(p is not None for p in tuple(spec)):
+            return str(spec)
+        if len(getattr(sh, "device_set", ())) > 1 and spec is not None:
+            return str(spec)
+        return None
+    except Exception:  # noqa: BLE001 — index metadata is advisory
+        return None
+
+
+class TrainingStateSnapshot:
+    """A consistent point-in-time capture of the full training state.
+
+    ``arrays`` holds *device-side copies* (not the live store arrays —
+    those are donated to the next step's executable and would read as
+    deleted buffers). ``materialize()`` moves them to host numpy; until
+    then the snapshot pins one extra copy of the state in device memory.
+    """
+
+    __slots__ = ("arrays", "tensor_index", "host_state", "step",
+                 "captured_at", "graph")
+
+    def __init__(self, arrays, tensor_index, host_state, step=None,
+                 graph=None):
+        self.arrays: Dict[str, Any] = arrays
+        self.tensor_index: Dict[str, Dict[str, Any]] = tensor_index
+        self.host_state: Dict[str, Any] = host_state
+        self.step = step
+        self.captured_at = time.time()
+        self.graph = graph
+
+    def materialize(self) -> Dict[str, np.ndarray]:
+        """D2H transfer of every snapshot array (writer-thread side)."""
+        out = {}
+        for key, arr in self.arrays.items():
+            out[key] = np.asarray(arr)
+        return out
+
+    def nbytes(self) -> int:
+        return int(sum(getattr(a, "nbytes", 0)
+                       for a in self.arrays.values()))
+
+
+def capture_training_state(sess, vars_map) -> TrainingStateSnapshot:
+    """Barrier snapshot: device copies of every variable in ``vars_map``
+    ({checkpoint_key: Variable}) plus host state, taken under the
+    session's device lock so it can never interleave with a step.
+
+    Raises FailedPreconditionError when a variable is uninitialized —
+    same contract as ``Saver.save``.
+    """
+    from ..framework import errors
+
+    with monitoring.traceme("checkpoint_snapshot", n_vars=len(vars_map)):
+        names = {}
+        for key, v in vars_map.items():
+            names[key] = v.var_name if hasattr(v, "var_name") else key
+        store = sess._variable_store
+        missing = [n for n in names.values() if n not in store.values]
+        if missing:
+            raise errors.FailedPreconditionError(
+                None, None,
+                f"Variable(s) {sorted(missing)} uninitialized; cannot "
+                "checkpoint.")
+        copies, host_state = sess.snapshot_device_state(
+            sorted(set(names.values())))
+        index = {}
+        arrays = {}
+        for key, store_name in names.items():
+            arr = copies[store_name]
+            arrays[key] = arr
+            index[key] = {"dtype": str(arr.dtype),
+                          "shape": list(arr.shape),
+                          "store_name": store_name,
+                          "sharding": sharding_desc(store.values.get(
+                              store_name, arr))}
+        return TrainingStateSnapshot(arrays, index, host_state,
+                                     graph=sess.graph)
+
+
+def encode_npz(arrays: Dict[str, np.ndarray]) -> bytes:
+    """The .stfz payload as in-memory bytes (so the content checksum is
+    computed over exactly what lands on disk)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{_npz_key(k): np.asarray(v)
+                     for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def build_index_doc(tensor_index, host_state, backend="native",
+                    payload: Optional[bytes] = None) -> Dict[str, Any]:
+    doc = {"tensors": tensor_index, "version": INDEX_VERSION,
+           "backend": backend, "host_state": host_state,
+           "time": time.time()}
+    if payload is not None:
+        doc["checksum"] = atomic.checksum_bytes(payload)
+        doc["data_bytes"] = len(payload)
+    return doc
+
+
+def write_native_checkpoint(prefix: str, arrays: Dict[str, np.ndarray],
+                            tensor_index, host_state) -> Dict[str, Any]:
+    """Serialize + commit one native checkpoint: npz bytes → checksum →
+    atomic data write → atomic index write. The ``checkpoint`` state
+    file is NOT touched here — callers update it last, after every
+    artifact is durable, so a crash mid-commit leaves the previous
+    checkpoint as latest."""
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+    with monitoring.traceme("checkpoint_serialize", n_vars=len(arrays)):
+        payload = encode_npz(arrays)
+        doc = build_index_doc(tensor_index, host_state, "native",
+                              payload=payload)
+    index_bytes = json.dumps(doc, indent=1).encode("utf-8")
+    with monitoring.traceme("checkpoint_commit",
+                            data_bytes=len(payload)):
+        atomic.atomic_write_bytes(prefix + ".stfz", payload, label="data")
+        atomic.atomic_write_bytes(prefix + ".index.json", index_bytes,
+                                  label="index")
+    _m.bytes_written.get_cell().increase_by(len(payload)
+                                            + len(index_bytes))
+    return doc
+
+
+def read_index(prefix: str) -> Dict[str, Any]:
+    with open(prefix + ".index.json") as f:
+        return json.load(f)
+
+
+def verify_checkpoint(prefix: str) -> List[str]:
+    """Integrity-check one checkpoint; returns a list of problem
+    strings (empty = verified). Counts failures on
+    /stf/checkpoint/integrity_failures by kind."""
+    problems: List[str] = []
+
+    def _fail(kind: str, msg: str):
+        _m.integrity_failures.get_cell(kind).increase_by(1)
+        problems.append(msg)
+
+    index_path = prefix + ".index.json"
+    if not os.path.exists(index_path):
+        _fail("missing_file", f"{index_path}: missing index file")
+        return problems
+    try:
+        doc = read_index(prefix)
+        tensors = doc["tensors"]
+    except (json.JSONDecodeError, KeyError, OSError) as e:
+        _fail("bad_index", f"{index_path}: unreadable index ({e})")
+        return problems
+    if doc.get("backend") == "orbax" or os.path.isdir(prefix + ".orbax"):
+        if not os.path.isdir(prefix + ".orbax"):
+            _fail("missing_file", f"{prefix}.orbax: missing orbax dir")
+        return problems  # orbax manages its own integrity metadata
+    data_path = prefix + ".stfz"
+    if not os.path.exists(data_path):
+        _fail("missing_file", f"{data_path}: missing tensor data file")
+        return problems
+    expected = doc.get("checksum")
+    if expected is not None:
+        nbytes = os.path.getsize(data_path)
+        if doc.get("data_bytes") is not None and \
+                nbytes != doc["data_bytes"]:
+            _fail("checksum_mismatch",
+                  f"{data_path}: size {nbytes} != recorded "
+                  f"{doc['data_bytes']}")
+            return problems
+        actual = atomic.checksum_file(data_path)
+        if actual != expected:
+            _fail("checksum_mismatch",
+                  f"{data_path}: checksum {actual} != recorded "
+                  f"{expected}")
+            return problems
+    # tensor-level check: every indexed tensor present with the recorded
+    # shape/dtype (also catches a truncated-but-valid-zip npz)
+    try:
+        with np.load(data_path, allow_pickle=False) as data:
+            files = set(data.files)
+            for key, meta in tensors.items():
+                nk = _npz_key(key)
+                if nk not in files:
+                    _fail("tensor_mismatch",
+                          f"{prefix}: tensor {key!r} in index but not "
+                          "in data file")
+                    continue
+                arr = data[nk]
+                if list(arr.shape) != list(meta.get("shape", [])) or \
+                        str(arr.dtype) != meta.get("dtype"):
+                    _fail("tensor_mismatch",
+                          f"{prefix}: tensor {key!r} is "
+                          f"{arr.dtype}{list(arr.shape)}, index says "
+                          f"{meta.get('dtype')}{meta.get('shape')}")
+    except Exception as e:  # noqa: BLE001 — any load failure = corrupt
+        _fail("tensor_mismatch", f"{data_path}: unreadable npz ({e})")
+    return problems

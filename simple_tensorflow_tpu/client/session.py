@@ -287,6 +287,45 @@ def _call_step_executable(step, state, feed_args, rng_key, rng_ctr):
         return step.jitted(dict(state), feed_args, rng_key, rng_ctr)
 
 
+def _plan_uses_rng(ops, _depth=0) -> bool:
+    """Whether any op in the plan declares an RNG effect, recursing into
+    FuncGraph bodies (cond branches, while/scan bodies). Conservative:
+    anything unresolvable counts as RNG-consuming. Plans with no RNG
+    consumer do not advance the session's run counter (see
+    ``_rng_args``), which is what keeps a checkpoint-resumed RNG stream
+    aligned with the uninterrupted run no matter how many read-only
+    runs (hook setup, ready checks) the restore path issued."""
+    from ..analysis import effects as effects_mod
+    from ..framework import optimizer as optimizer_mod
+
+    for op in ops:
+        try:
+            if effects_mod.op_effects(op).rng:
+                return True
+        except Exception:  # noqa: BLE001 — unknown op: consume
+            return True
+        spec = optimizer_mod.function_op_spec(op.type)
+        if spec is None:
+            continue
+        if _depth >= 8:
+            return True  # pathological nesting: stay conservative
+        try:
+            descs = spec.bodies(op.attrs, len(op.inputs))
+            bodies = [op.attrs.get(d["attr"]) for d in descs]
+        except Exception:  # noqa: BLE001
+            return True
+        for fg in bodies:
+            if fg is None:
+                continue
+            try:
+                body_ops = fg.get_operations()
+            except Exception:  # noqa: BLE001
+                return True
+            if _plan_uses_rng(body_ops, _depth + 1):
+                return True
+    return False
+
+
 def _executable_analysis(lowered, compiled):
     """flops/bytes (XLA cost_analysis) + memory stats (memory_analysis,
     needs a compiled executable) in the RunMetadata.cost_graph shape.
@@ -541,7 +580,8 @@ class _CompiledStep:
                  "raw_post_inputs", "func_plans", "compiled", "xla_cost",
                  "feed_shardings", "fused", "fusion_diags",
                  "sharding_report", "sharding_thread",
-                 "sharding_sync_seconds", "sharding_gate", "aot_cache")
+                 "sharding_sync_seconds", "sharding_gate", "aot_cache",
+                 "uses_rng")
 
     def __init__(self):
         self.n_calls = 0
@@ -585,6 +625,13 @@ class _CompiledStep:
         # else (plan-static diagnostics, assigned-variable names) — the
         # store-dependent uninitialized-write check re-runs per call
         self.fusion_diags = None
+        # whether any device op (recursing into FuncGraph bodies)
+        # declares an RNG effect: only such plans advance the session's
+        # RNG run counter, so incidental read-only runs — hook setup,
+        # `report_uninitialized_variables` on the restore path — can
+        # never shift the key stream a checkpoint resume must reproduce
+        # bit-exactly (stf.checkpoint; docs/CHECKPOINT.md)
+        self.uses_rng = True
 
     def join_sharding(self, timeout=10.0):
         """Wait for the overlapped sharding analysis (if any) and return
@@ -811,6 +858,10 @@ class BaseSession:
         # flight-recorder run-event sampling state (see run())
         self._run_events = 0
         self._run_dur_ewma: Optional[float] = None
+        # jitted identity-copy for snapshot_device_state (stf.checkpoint
+        # barrier snapshots); jax.jit's own cache handles new key sets /
+        # avals, so one callable serves every snapshot shape
+        self._snapshot_copy_fn = None
         live_sessions.add(self)
 
     # -- stf.analysis hooks --------------------------------------------------
@@ -980,6 +1031,64 @@ class BaseSession:
                 f"Variable, its read tensor, or a store name); initialized "
                 f"variables: {sorted(store)[:10]}...")
         return store[name]
+
+    # -- barrier snapshots (stf.checkpoint; docs/CHECKPOINT.md) --------------
+    def snapshot_device_state(self, names=None):
+        """Donation-safe point-in-time snapshot of device-resident
+        variable state, for async checkpointing.
+
+        Returns ``({store_name: device_copy}, host_state)``. The copies
+        are made ON DEVICE under the session's device lock — so the
+        snapshot can never interleave with a step, and the live store
+        arrays (which the next step's executable will DONATE and
+        thereby invalidate) are never handed out. The copy dispatch is
+        asynchronous; the caller (normally the ``stf_ckpt_writer``
+        thread) pays the D2H transfer at ``np.asarray`` time, off the
+        step loop. Until then the snapshot pins one extra copy of the
+        named state in device memory.
+
+        ``host_state`` is the non-device half a resume needs, captured
+        at the same barrier: the RNG run counter and every data
+        iterator's position (see ``snapshot_host_state``).
+        """
+        import jax
+
+        with self._lock:
+            store = self._variable_store
+            wanted = sorted(store.values) if names is None else list(names)
+            missing = [n for n in wanted if n not in store.values]
+            if missing:
+                raise errors.FailedPreconditionError(
+                    None, None,
+                    f"snapshot_device_state: variable(s) "
+                    f"{sorted(missing)} uninitialized")
+            if self._snapshot_copy_fn is None:
+                import jax.numpy as jnp
+
+                self._snapshot_copy_fn = jax.jit(
+                    lambda d: {k: jnp.copy(v) for k, v in d.items()})
+            copies = self._snapshot_copy_fn(
+                {n: store.values[n] for n in wanted})
+            host_state = self.snapshot_host_state()
+        return copies, host_state
+
+    def snapshot_host_state(self):
+        """Session RNG position + data-iterator positions — the host
+        half of a training-state checkpoint (SURVEY §5: resume restores
+        global_step, optimizer slots, RNG key, data-pipeline epoch).
+        The session RNG is (graph seed, run counter), so saving the
+        counter is saving the key-stream position."""
+        state = {"rng_run_counter": self._run_counter}
+        try:
+            from ..data import dataset as dataset_mod
+
+            its = dataset_mod.iterator_registry(self._graph)
+            if its:
+                state["iterators"] = {name: it.save_state()
+                                      for name, it in its.items()}
+        except Exception:  # noqa: BLE001 — data module optional here
+            pass
+        return state
 
     # -- lifecycle -----------------------------------------------------------
     def close(self):
@@ -1362,7 +1471,10 @@ class BaseSession:
             with self._lock:
                 self._ensure_base_key()
                 c0 = self._run_counter + 1
-                self._run_counter += n
+                if step.uses_rng:
+                    # RNG-free windows leave the counter alone (matching
+                    # n sequential runs under the same gating)
+                    self._run_counter += n
                 ctrs = np.arange(c0, c0 + n, dtype=np.uint32)
                 state = self._variable_store.values
                 first_call = fused["n_calls"] == 0
@@ -1713,7 +1825,7 @@ class BaseSession:
             # stay concurrent: a blocked queue dequeue must not
             # deadlock the producer thread that would fill it.
             with self._lock:
-                rng_key, rng_ctr = self._rng_args()
+                rng_key, rng_ctr = self._rng_args(consume=step.uses_rng)
                 guard_on = (self._config is not None and
                             getattr(self._config, "transfer_guard", "allow")
                             != "allow" and step.n_calls >= 2)
@@ -1999,16 +2111,25 @@ class BaseSession:
             self._base_key = jax.random.key(seed)
         return self._base_key
 
-    def _rng_args(self):
+    def _rng_args(self, consume: bool = True):
         """(base_key, step_counter) for the jitted path: the per-step
         fold_in happens INSIDE the compiled program (traced once, DCE'd
         by XLA when the step uses no RNG), so the host pays an eager
         fold_in — ~0.4 ms/step, 75% of all dispatch overhead when
         measured — on no step. Eager paths (partial_run, py_func) use
-        _next_rng, which folds immediately."""
+        _next_rng, which folds immediately.
+
+        ``consume=False`` (plans whose ``uses_rng`` is False — no op
+        declares an RNG effect) returns the next position WITHOUT
+        advancing the counter: the value only feeds the executable's
+        DCE'd fold_in argument, and not advancing means read-only runs
+        never perturb the key stream a checkpoint resume replays
+        (stf.checkpoint bit-exact-resume contract)."""
         self._ensure_base_key()
-        self._run_counter += 1
-        return self._base_key, np.uint32(self._run_counter)
+        if consume:
+            self._run_counter += 1
+            return self._base_key, np.uint32(self._run_counter)
+        return self._base_key, np.uint32(self._run_counter + 1)
 
     # -- planning ------------------------------------------------------------
     def _plan_has_sharding_signals(self, pruned, fed_set) -> bool:
@@ -2306,6 +2427,7 @@ class BaseSession:
                        n_post_host_ops=len(post_host),
                        n_diagnostics=len(plan_diags))
         step.has_device_stage = bool(device_ops)
+        step.uses_rng = bool(device_ops) and _plan_uses_rng(device_ops)
         if not step.has_device_stage:
             step.jitted = None
             return step
@@ -2503,7 +2625,7 @@ class BaseSession:
             # (or a callable racing sess.run) must not share donated
             # state or drop each other's commits
             with self._lock:
-                rng_key, rng_ctr = self._rng_args()
+                rng_key, rng_ctr = self._rng_args(consume=step.uses_rng)
                 state = self._variable_store.values
                 fetch_vals, new_state, check_flags = _call_step_executable(
                     step, state, feed_args, rng_key, rng_ctr)

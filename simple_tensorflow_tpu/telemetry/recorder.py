@@ -55,7 +55,7 @@ DEFAULT_CAPACITY = int(os.environ.get("STF_FLIGHT_RECORDER_EVENTS", "4096"))
 # prefixes of threads this library owns; thread_stacks() flags them so a
 # wedge dump separates stf machinery from application threads
 _STF_THREAD_PREFIXES = ("stf_data_", "stf_serving_", "stf_telemetry_",
-                        "stf_sharding_")
+                        "stf_sharding_", "stf_ckpt_")
 
 
 def _sanitize(value):
@@ -234,6 +234,11 @@ def record_event(kind: str, **fields) -> None:
 
 
 _signals_installed = False
+# the handler object install_signal_handlers() put on SIGTERM, so other
+# chainers (stf.checkpoint.preemption) can recognize it: its tail
+# re-raises with the DEFAULT disposition (process dies), which a
+# graceful-drain handler must absorb rather than chain into
+_installed_handler = None
 
 
 def install_signal_handlers() -> bool:
@@ -271,6 +276,8 @@ def install_signal_handlers() -> bool:
                 signal.raise_signal(signal.SIGTERM)
 
         signal.signal(signal.SIGTERM, _on_sigterm)
+        global _installed_handler
+        _installed_handler = _on_sigterm
     except ValueError:
         # not the main thread: signal handlers cannot be installed here
         return False

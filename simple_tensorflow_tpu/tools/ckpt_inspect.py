@@ -1,0 +1,166 @@
+"""ckpt_inspect: list and verify stf checkpoints in a directory.
+
+CLI::
+
+    python -m simple_tensorflow_tpu.tools.ckpt_inspect <dir-or-prefix> \\
+        [--tensors] [--json] [--no-verify]
+
+For every checkpoint found (the ``checkpoint`` state file plus any
+``*.index.json`` the state file no longer references): step, save time,
+backend, tensor count, parameter count, payload bytes, and — unless
+``--no-verify`` — the full integrity verification
+(``stf.checkpoint.verify_checkpoint``: checksum, sizes, per-tensor
+shape/dtype against the index). ``--tensors`` additionally lists every
+tensor's name/dtype/shape/sharding.
+
+Exit status: 0 = all checkpoints verified, 1 = corruption detected or
+no checkpoint found (docs/CHECKPOINT.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _step_of(prefix: str) -> Optional[int]:
+    tail = os.path.basename(prefix).rsplit("-", 1)
+    if len(tail) == 2 and tail[1].isdigit():
+        return int(tail[1])
+    return None
+
+
+def discover_checkpoints(path: str) -> Tuple[str, List[str]]:
+    """(directory, ordered checkpoint prefixes). ``path`` may be a
+    directory or a single checkpoint prefix."""
+    from ..train import saver as saver_mod
+
+    if os.path.isfile(path + ".index.json"):
+        return os.path.dirname(path) or ".", [path]
+    directory = path
+    prefixes: List[str] = []
+    st = saver_mod.get_checkpoint_state(directory)
+    if st is not None:
+        for p in st.all_model_checkpoint_paths:
+            if p not in prefixes:
+                prefixes.append(p)
+        if st.model_checkpoint_path and \
+                st.model_checkpoint_path not in prefixes:
+            prefixes.append(st.model_checkpoint_path)
+    # orphans: on-disk checkpoints the state file does not reference
+    # (e.g. the state file itself was lost) still deserve inspection
+    for idx in sorted(glob.glob(os.path.join(glob.escape(directory),
+                                             "*.index.json"))):
+        p = idx[:-len(".index.json")]
+        if p not in prefixes:
+            prefixes.append(p)
+    return directory, prefixes
+
+
+def inspect_checkpoint(prefix: str, verify: bool = True) -> Dict[str, Any]:
+    from ..checkpoint import snapshot as snapshot_mod
+
+    info: Dict[str, Any] = {"prefix": prefix, "step": _step_of(prefix)}
+    try:
+        doc = snapshot_mod.read_index(prefix)
+    except Exception as e:  # noqa: BLE001 — report, don't crash the scan
+        info["problems"] = [f"{prefix}.index.json: unreadable ({e})"]
+        info["ok"] = False
+        return info
+    tensors = doc.get("tensors", {})
+    info.update({
+        "backend": doc.get("backend", "native"),
+        "time": doc.get("time"),
+        "n_tensors": len(tensors),
+        "n_params": int(sum(
+            int(__import__("numpy").prod(m.get("shape") or [1]))
+            for m in tensors.values())),
+        "data_bytes": doc.get("data_bytes"),
+        "checksum": doc.get("checksum"),
+        "index_version": doc.get("version"),
+        "tensors": {k: {"dtype": m.get("dtype"),
+                        "shape": m.get("shape"),
+                        "sharding": m.get("sharding")}
+                    for k, m in sorted(tensors.items())},
+    })
+    host = doc.get("host_state") or {}
+    if host:
+        info["host_state"] = {
+            "rng_run_counter": host.get("rng_run_counter"),
+            "iterators": {n: s.get("position")
+                          for n, s in (host.get("iterators")
+                                       or {}).items()},
+        }
+    if verify:
+        problems = snapshot_mod.verify_checkpoint(prefix)
+        info["problems"] = problems
+        info["ok"] = not problems
+    else:
+        info["ok"] = None
+    return info
+
+
+def run(path: str, tensors: bool = False, as_json: bool = False,
+        verify: bool = True, out=None) -> int:
+    out = out or sys.stdout
+    directory, prefixes = discover_checkpoints(path)
+    if not prefixes:
+        msg = f"{path}: no checkpoints found"
+        print(json.dumps({"directory": directory, "checkpoints": [],
+                          "ok": False, "error": msg}) if as_json else msg,
+              file=out)
+        return 1
+    infos = [inspect_checkpoint(p, verify=verify) for p in prefixes]
+    all_ok = all(i["ok"] is not False for i in infos)
+    if as_json:
+        print(json.dumps({"directory": directory, "checkpoints": infos,
+                          "ok": all_ok}, indent=1, default=str), file=out)
+    else:
+        for i in infos:
+            status = ("UNVERIFIED" if i["ok"] is None
+                      else "OK" if i["ok"] else "CORRUPT")
+            step = "-" if i.get("step") is None else i["step"]
+            print(f"{i['prefix']}  step={step} "
+                  f"backend={i.get('backend', '?')} "
+                  f"tensors={i.get('n_tensors', '?')} "
+                  f"params={i.get('n_params', '?')} "
+                  f"bytes={i.get('data_bytes', '?')}  [{status}]",
+                  file=out)
+            for problem in i.get("problems") or []:
+                print(f"  !! {problem}", file=out)
+            if tensors:
+                for name, m in (i.get("tensors") or {}).items():
+                    shard = f"  sharding={m['sharding']}" \
+                        if m.get("sharding") else ""
+                    print(f"  {name}  dtype={m['dtype']} "
+                          f"shape={m['shape']}{shard}", file=out)
+        print(f"# {len(infos)} checkpoint(s) in {directory}: "
+              + ("all verified" if (all_ok and verify)
+                 else "OK" if all_ok else "CORRUPTION DETECTED"),
+              file=out)
+    return 0 if all_ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m simple_tensorflow_tpu.tools.ckpt_inspect",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="checkpoint directory or prefix")
+    ap.add_argument("--tensors", action="store_true",
+                    help="list every tensor (name/dtype/shape/sharding)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip checksum/structure verification")
+    args = ap.parse_args(argv)
+    return run(args.path, tensors=args.tensors, as_json=args.as_json,
+               verify=not args.no_verify)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,11 @@ from .moving_averages import ExponentialMovingAverage, assign_moving_average
 from .saver import (
     Saver, latest_checkpoint, get_checkpoint_state, update_checkpoint_state,
     checkpoint_exists, import_meta_graph, export_meta_graph,
+    resolve_global_step,
 )
+# async checkpointing + preemption-safe training (stf.checkpoint;
+# docs/CHECKPOINT.md) — re-exported here because they are trainer-facing
+from ..checkpoint import CheckpointManager, PreemptionHandler
 from .checkpoint_utils import (
     load_checkpoint, load_variable, list_variables, init_from_checkpoint,
     CheckpointReader,

@@ -100,11 +100,20 @@ class StopAtStepHook(SessionRunHook):
 
 
 class CheckpointSaverHook(SessionRunHook):
-    """(ref: basic_session_run_hooks.py:404)."""
+    """(ref: basic_session_run_hooks.py:404).
+
+    Saves are ASYNC by default (``save_async=True``, stf.checkpoint):
+    trigger steps pay only the barrier snapshot — donation-safe device
+    copies + host state — and the ``stf_ckpt_writer`` thread commits
+    while the next fused window runs. ``end()`` (and a blocking save)
+    drains the writer, so every checkpoint is durable before the
+    session closes. Fusion votes are unchanged: windows still split
+    exactly at save boundaries. ``save_async=False`` or a non-native
+    Saver backend restores the in-line blocking behavior."""
 
     def __init__(self, checkpoint_dir, save_secs=None, save_steps=None,
                  saver=None, checkpoint_basename="model.ckpt", scaffold=None,
-                 listeners=None):
+                 listeners=None, save_async=True):
         import os
 
         self._checkpoint_dir = checkpoint_dir
@@ -114,6 +123,8 @@ class CheckpointSaverHook(SessionRunHook):
         self._timer = SecondOrStepTimer(every_secs=save_secs,
                                         every_steps=save_steps)
         self._listeners = listeners or []
+        self._save_async = save_async
+        self._async_engine = None
 
     def begin(self):
         self._global_step_tensor = training_util.get_global_step()
@@ -151,13 +162,40 @@ class CheckpointSaverHook(SessionRunHook):
         return self._timer.steps_until_trigger(global_step)
 
     def end(self, session):
+        # final save is BLOCKING: the process may exit right after, so
+        # the writer queue must be drained before end() returns
         self._save(session, int(np.asarray(
-            session.run(self._global_step_tensor._ref))))
+            session.run(self._global_step_tensor._ref))),
+            blocking=True)
 
-    def _save(self, session, step):
+    def _engine_for(self, saver):
+        """The async engine for this hook's saver, or None when saves
+        should go through ``saver.save`` directly (save_async=False, a
+        non-native backend, or a backend="async" saver that already is
+        its own engine)."""
+        if not self._save_async:
+            return None
+        if getattr(saver, "_backend", None) != "native":
+            return None
+        if self._async_engine is None:
+            from ..checkpoint.manager import AsyncSaverEngine
+
+            self._async_engine = AsyncSaverEngine(saver)
+        return self._async_engine
+
+    def _save(self, session, step, blocking=False):
         for l in self._listeners:
             l.before_save(session, step)
-        self._get_saver().save(session, self._save_path, global_step=step)
+        saver = self._get_saver()
+        engine = self._engine_for(saver)
+        if engine is not None:
+            engine.save(session, self._save_path, global_step=step)
+            if blocking:
+                engine.wait_until_finished()
+        else:
+            saver.save(session, self._save_path, global_step=step)
+            if blocking and hasattr(saver, "wait_until_finished"):
+                saver.wait_until_finished()
         for l in self._listeners:
             l.after_save(session, step)
 

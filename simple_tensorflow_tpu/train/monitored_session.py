@@ -400,8 +400,16 @@ def MonitoredTrainingSession(master="", is_chief=True, checkpoint_dir=None,
                              save_summaries_steps=100,
                              save_summaries_secs=None, config=None,
                              stop_grace_period_secs=120, log_step_count_steps=100,
-                             max_wait_secs=7200):
-    """(ref: monitored_session.py:256 ``MonitoredTrainingSession``)."""
+                             max_wait_secs=7200, save_on_preemption=True):
+    """(ref: monitored_session.py:256 ``MonitoredTrainingSession``).
+
+    With a ``checkpoint_dir``, the chief additionally gets preemption
+    handling (``save_on_preemption=True``, stf.checkpoint): SIGTERM →
+    finish the in-flight (possibly fused) window → save the full
+    training state → clean stop — and on restart this same constructor
+    restores that checkpoint, resuming the run bit-exact (variables,
+    optimizer slots, global_step, RNG stream, data-iterator positions;
+    docs/CHECKPOINT.md)."""
     scaffold = scaffold or Scaffold()
     all_hooks = list(hooks or [])
     if is_chief:
@@ -410,6 +418,11 @@ def MonitoredTrainingSession(master="", is_chief=True, checkpoint_dir=None,
         if chief_only_hooks:
             all_hooks.extend(chief_only_hooks)
         if checkpoint_dir:
+            if save_on_preemption:
+                from ..checkpoint.preemption import PreemptionHandler
+
+                all_hooks.append(PreemptionHandler(
+                    checkpoint_dir=checkpoint_dir, scaffold=scaffold))
             if save_checkpoint_steps and save_checkpoint_steps > 0:
                 all_hooks.append(basic_session_run_hooks.CheckpointSaverHook(
                     checkpoint_dir, save_steps=save_checkpoint_steps,

@@ -38,14 +38,20 @@ def _state_path(directory, latest_filename=None):
 def update_checkpoint_state(save_dir, model_checkpoint_path,
                             all_model_checkpoint_paths=None,
                             latest_filename=None):
-    """(ref: python/training/saver.py ``update_checkpoint_state``)."""
+    """(ref: python/training/saver.py ``update_checkpoint_state``).
+    Committed through the atomic temp+fsync+``os.replace`` protocol
+    (stf.checkpoint.atomic): the state file is the pointer that makes a
+    checkpoint "latest", so a crash mid-update must leave the previous
+    pointer intact, never a truncated JSON."""
+    from ..checkpoint import atomic as atomic_io
+
     state = {
         "model_checkpoint_path": model_checkpoint_path,
         "all_model_checkpoint_paths": all_model_checkpoint_paths or
         [model_checkpoint_path],
     }
-    with open(_state_path(save_dir, latest_filename), "w") as f:
-        json.dump(state, f, indent=1)
+    atomic_io.atomic_write_json(_state_path(save_dir, latest_filename),
+                                state, label="state")
 
 
 def get_checkpoint_state(checkpoint_dir, latest_filename=None):
@@ -88,19 +94,38 @@ def load_checkpoint_values(checkpoint_prefix):
 def _capture_host_state(sess):
     """Session RNG position + data-iterator positions (SURVEY §5: resume
     restores global_step, optimizer slots, RNG key, data-pipeline epoch).
-    The session RNG is (graph seed, run_counter) — saving the counter is
-    saving the key stream position."""
-    state = {"rng_run_counter": sess._run_counter}
-    try:
-        from ..data import dataset as dataset_mod
+    One implementation, on the session (the async checkpoint plane
+    captures it at the same barrier as the device snapshot)."""
+    return sess.snapshot_host_state()
 
-        state["iterators"] = {
-            name: it.save_state()
-            for name, it in dataset_mod.iterator_registry(
-                sess.graph).items()}
-    except Exception:  # noqa: BLE001 — data module optional at save time
+
+def resolve_global_step(sess, global_step):
+    """The integer step a checkpoint prefix is suffixed with: an int
+    passes through, a Variable/Tensor is read (straight from the device
+    store when possible — no Session.run dispatch), None stays None."""
+    if global_step is None:
+        return None
+    if isinstance(global_step, (int, np.integer)):
+        return int(global_step)
+    try:
+        target = global_step._ref if hasattr(global_step, "_ref") \
+            else global_step
+        return int(np.asarray(sess.variable_value(target)))
+    except (KeyError, AttributeError):
         pass
-    return state
+    if hasattr(global_step, "_ref") or isinstance(global_step,
+                                                  ops_mod.Tensor):
+        return int(np.asarray(sess.run(
+            global_step._ref if hasattr(global_step, "_ref")
+            else global_step)))
+    return int(global_step)
+
+
+def _iter_ordinal(name):
+    """Creation ordinal of an auto-named iterator ('dataset_iterator_7'
+    -> 7); unparseable names sort last, stably."""
+    tail = name.rsplit("_", 1)[-1]
+    return (0, int(tail)) if tail.isdigit() else (1, 0)
 
 
 def _restore_host_state(sess, host_state):
@@ -112,8 +137,32 @@ def _restore_host_state(sess, host_state):
     if iterators:
         from ..data import dataset as dataset_mod
 
+        reg = dataset_mod.iterator_registry(sess.graph)
+        mapping = {}
+        if any(n not in reg for n in iterators) and \
+                len(iterators) == len(reg):
+            # iterator auto-names ride a PROCESS-global counter, so an
+            # in-process graph rebuild (or any program that built other
+            # iterators first) shifts every name and exact lookup finds
+            # nothing — silently resuming every pipeline from element 0.
+            # Both sides created their iterators in program order, so
+            # when the counts match, align by creation order instead.
+            saved = sorted(iterators, key=_iter_ordinal)
+            live = sorted(reg, key=_iter_ordinal)
+            mapping = dict(zip(saved, live))
+            if any(s != l for s, l in mapping.items()):
+                from ..platform import tf_logging as logging
+
+                logging.info(
+                    "Saver.restore: aligning %d data iterator(s) by "
+                    "creation order (checkpoint names %s -> live names "
+                    "%s)", len(mapping), saved, live)
         for name, st in iterators.items():
-            it = dataset_mod.iterator_registry(sess.graph).get(name)
+            # when order-alignment is active it is used EXCLUSIVELY: on
+            # partial name overlap a mix of exact and mapped lookups
+            # would pair one live iterator with two saved states and
+            # leave another with none
+            it = reg.get(mapping[name]) if mapping else reg.get(name)
             if it is not None:
                 it.restore_state(st)
 
@@ -129,12 +178,17 @@ class Saver:
         self._var_list = var_list
         self._max_to_keep = max_to_keep
         self._keep_every_s = keep_checkpoint_every_n_hours * 3600.0
-        if backend not in ("native", "orbax"):
+        if backend not in ("native", "orbax", "async"):
             raise ValueError(
                 f"Unknown Saver backend {backend!r}; use 'native' (single "
-                "npz bundle) or 'orbax' (sharded, multi-host, no host "
-                "gather)")
+                "npz bundle), 'async' (native format, barrier snapshot + "
+                "background stf_ckpt_writer commit — stf.checkpoint), or "
+                "'orbax' (sharded, multi-host, no host gather)")
         self._backend = backend
+        # backend="async": save() delegates to the stf.checkpoint plane
+        # (same on-disk format; restore is identical). Lazy — the engine
+        # binds this Saver's var set and retention bookkeeping.
+        self._async_engine = None
         # (prefix, save_time) pairs — keep_checkpoint_every_n_hours decides
         # on the CHECKPOINT's timestamp, matching ref saver.py semantics
         self._last_checkpoints: List[tuple] = []
@@ -160,24 +214,30 @@ class Saver:
     def save(self, sess, save_path, global_step=None, latest_filename=None,
              meta_graph_suffix="meta", write_meta_graph=True,
              write_state=True):
-        """(ref: saver.py:1453 ``Saver.save``)."""
-        if global_step is not None:
-            import numpy as _np
+        """(ref: saver.py:1453 ``Saver.save``). ``backend="async"``
+        returns as soon as the barrier snapshot is captured; the
+        stf_ckpt_writer thread commits in the background
+        (``stf.checkpoint``, docs/CHECKPOINT.md)."""
+        if self._backend == "async":
+            if self._async_engine is None:
+                from ..checkpoint.manager import AsyncSaverEngine
 
-            if hasattr(global_step, "_ref") or isinstance(global_step,
-                                                          ops_mod.Tensor):
-                step_val = int(_np.asarray(sess.run(
-                    global_step._ref if hasattr(global_step, "_ref")
-                    else global_step)))
-            else:
-                step_val = int(global_step)
-            prefix = f"{save_path}-{step_val}"
-        else:
-            prefix = save_path
+                self._async_engine = AsyncSaverEngine(self)
+            return self._async_engine.save(
+                sess, save_path, global_step=global_step,
+                latest_filename=latest_filename,
+                write_meta_graph=write_meta_graph,
+                write_state=write_state)
+        t0 = time.perf_counter()
+        step_val = resolve_global_step(sess, global_step)
+        prefix = f"{save_path}-{step_val}" if step_val is not None \
+            else save_path
         os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
 
         vars_map = self._vars()
         store = sess._variable_store
+        from ..checkpoint import snapshot as snapshot_mod
+
         index = {}
         device_state = {}
         for key, v in vars_map.items():
@@ -188,21 +248,26 @@ class Saver:
             arr = store.values[name]
             device_state[key] = arr
             index[key] = {"dtype": str(arr.dtype), "shape": list(arr.shape),
-                          "store_name": name}
+                          "store_name": name,
+                          "sharding": snapshot_mod.sharding_desc(arr)}
 
+        host_state = _capture_host_state(sess)
         if self._backend == "orbax":
             self._save_orbax(prefix, device_state)
+            from ..checkpoint import atomic as atomic_io
+
+            atomic_io.atomic_write_json(
+                prefix + ".index.json",
+                snapshot_mod.build_index_doc(index, host_state, "orbax"),
+                label="index")
         else:
-            arrays = {key.replace("/", "|"): store.as_numpy(
-                index[key]["store_name"]) for key in device_state}
-            with open(prefix + ".stfz", "wb") as f:
-                # file handle, not path: np.savez silently appends ".npz"
-                np.savez(f, **arrays)
-        with open(prefix + ".index.json", "w") as f:
-            json.dump({"tensors": index, "version": 1,
-                       "backend": self._backend,
-                       "host_state": _capture_host_state(sess),
-                       "time": time.time()}, f, indent=1)
+            # blocking native path, same serialize+atomic-commit
+            # pipeline as the async writer: npz bytes -> checksum in the
+            # index -> temp+fsync+replace for data then index
+            arrays = {key: store.as_numpy(index[key]["store_name"])
+                      for key in device_state}
+            snapshot_mod.write_native_checkpoint(prefix, arrays, index,
+                                                 host_state)
         if write_meta_graph:
             try:
                 from ..framework import graph_io
@@ -220,6 +285,11 @@ class Saver:
             update_checkpoint_state(os.path.dirname(prefix) or ".", prefix,
                                     [p for p, _ in self._last_checkpoints],
                                     latest_filename)
+        from ..checkpoint import metrics as ckpt_metrics
+
+        ckpt_metrics.saves.get_cell("blocking").increase_by(1)
+        ckpt_metrics.save_stall_seconds.get_cell("blocking").add(
+            time.perf_counter() - t0)
         return prefix
 
     def _save_orbax(self, prefix, device_state):
@@ -292,14 +362,23 @@ class Saver:
                 import shutil
 
                 shutil.rmtree(old + ".orbax", ignore_errors=True)
+            from ..checkpoint import metrics as ckpt_metrics
+
+            ckpt_metrics.gc_deleted.get_cell().increase_by(1)
 
     # -- restore -------------------------------------------------------------
-    def restore(self, sess, save_path):
+    def restore(self, sess, save_path, verify_checksum=True):
         """(ref: saver.py:1560 ``Saver.restore``). Loads arrays straight into
         the device-resident store (with the variable's sharding when on a
         mesh) — no restore ops to run. Also restores host state (session RNG
         position, data-iterator positions) so a resumed run reproduces the
-        same dropout masks and batch stream (SURVEY §5)."""
+        same dropout masks and batch stream (SURVEY §5). Checkpoints
+        carrying a content checksum (index v2, stf.checkpoint commit
+        protocol) are verified against it — a corrupted bundle raises
+        DataLossError instead of loading garbage weights.
+        ``verify_checksum=False`` skips that pass (and its full
+        read-into-memory) for callers that just verified the file, e.g.
+        ``CheckpointManager.restore``."""
         if not checkpoint_exists(save_path):
             raise errors.NotFoundError(
                 None, None, f"Checkpoint {save_path} not found")
@@ -310,7 +389,28 @@ class Saver:
         if os.path.isdir(save_path + ".orbax"):
             self._restore_orbax(sess, save_path, vars_map, index)
         else:
-            with np.load(save_path + ".stfz", allow_pickle=False) as data:
+            expected = idx_doc.get("checksum") if verify_checksum \
+                else None
+            if expected is not None:
+                import io
+
+                from ..checkpoint import atomic as atomic_io
+                from ..checkpoint import metrics as ckpt_metrics
+
+                with open(save_path + ".stfz", "rb") as f:
+                    payload = f.read()
+                actual = atomic_io.checksum_bytes(payload)
+                if actual != expected:
+                    ckpt_metrics.integrity_failures.get_cell(
+                        "checksum_mismatch").increase_by(1)
+                    raise errors.DataLossError(
+                        None, None,
+                        f"Checkpoint {save_path}.stfz is corrupt: "
+                        f"checksum {actual} != recorded {expected}")
+                source = io.BytesIO(payload)
+            else:
+                source = save_path + ".stfz"
+            with np.load(source, allow_pickle=False) as data:
                 for key, v in vars_map.items():
                     safe = key.replace("/", "|")
                     if safe not in data:
@@ -333,6 +433,13 @@ class Saver:
         self._last_checkpoints = [(p, time.time())
                                   for p in checkpoint_paths
                                   if checkpoint_exists(p)]
+
+    def wait_until_finished(self, timeout=None):
+        """Block until every async save this Saver enqueued has
+        committed (no-op for blocking backends); re-raises the first
+        background failure."""
+        if self._async_engine is not None:
+            self._async_engine.wait_until_finished(timeout)
 
     def as_saver_def(self):
         return {"format": "stf-bundle-v1"}

@@ -36,6 +36,7 @@ def _no_pipeline_leaks():
     each module (re-arming restarts it) and asserts the stop works —
     clean shutdown is part of its contract."""
     yield
+    from simple_tensorflow_tpu import checkpoint as ckpt_mod
     from simple_tensorflow_tpu import telemetry
     from simple_tensorflow_tpu.data import pipeline
     from simple_tensorflow_tpu.serving import server as serving_server
@@ -53,6 +54,15 @@ def _no_pipeline_leaks():
         s.close()
     open_telemetry = telemetry.get_server() is not None
     telemetry.shutdown()  # stops the HTTP server AND the watchdog
+    # checkpoint writer (ISSUE 10): drain + stop the stf_ckpt_writer
+    # thread — clean shutdown is part of its contract; the next async
+    # save lazily restarts it. Also clear any preemption flag / fault
+    # hook a test left armed.
+    ckpt_mod.get_writer().wait_until_finished(timeout=10.0)
+    writer_stopped = ckpt_mod.shutdown_writer(timeout=5.0)
+    ckpt_mod.reset_preemption_state()
+    ckpt_mod.uninstall_preemption_handler()
+    ckpt_mod.set_fault_hook(None)
 
     # stage threads are named stf_data_<stage>, batcher threads
     # stf_serving_batcher_<model>, telemetry threads stf_telemetry_*
@@ -64,7 +74,8 @@ def _no_pipeline_leaks():
                 if ((t.name.startswith("stf_data_")
                      and not t.name.startswith("stf_data_worker"))
                     or t.name.startswith("stf_serving_")
-                    or t.name.startswith("stf_telemetry_"))
+                    or t.name.startswith("stf_telemetry_")
+                    or t.name.startswith("stf_ckpt_"))
                 and t.is_alive()]
 
     deadline = time.monotonic() + 5.0
@@ -80,6 +91,9 @@ def _no_pipeline_leaks():
     assert not open_telemetry, (
         "telemetry server left running by this test module — call "
         "stf.telemetry.stop() (or telemetry.shutdown()) in teardown")
+    assert writer_stopped, (
+        "stf_ckpt_writer did not stop within its deadline — a "
+        "checkpoint write job is wedged")
     assert not leaked, (
-        "leaked pipeline/serving/telemetry thread(s): "
+        "leaked pipeline/serving/telemetry/checkpoint thread(s): "
         + ", ".join(t.name for t in leaked))

@@ -1,0 +1,273 @@
+"""CheckpointSaverHook under loop fusion + preemption-resume
+trajectories (ISSUE 10 satellite): checkpoints land exactly on trigger
+steps, iterator state round-trips mid-epoch, and a SIGTERM'd child
+process resumes with an identical loss trajectory (subprocess test,
+skip-aware like PR 4's)."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import checkpoint as ckpt
+from simple_tensorflow_tpu.train.saver import latest_checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+    ckpt.reset_preemption_state()
+    ckpt.get_writer().wait_until_finished(timeout=10.0)
+
+
+def _saved_steps(directory):
+    steps = set()
+    for f in os.listdir(directory):
+        m = re.match(r"model\.ckpt-(\d+)\.index\.json$", f)
+        if m:
+            steps.add(int(m.group(1)))
+    return steps
+
+
+class TestHookFusionAlignment:
+    def test_checkpoints_land_exactly_on_trigger_steps(self, tmp_path):
+        """loop_fusion_steps=64 with save_steps=6: windows must split so
+        every saved checkpoint carries exactly its trigger step's state
+        — and windows between triggers must actually fuse."""
+        gs = stf.train.get_or_create_global_step()
+        v = stf.Variable(stf.constant([0.0]), name="fv")
+        train = stf.group(
+            stf.assign_add(v._ref, stf.constant([1.0])),
+            stf.assign_add(gs, stf.constant(1, stf.int64)))
+        hook = stf.train.CheckpointSaverHook(str(tmp_path), save_steps=6)
+        cfg = stf.ConfigProto(loop_fusion_steps=64)
+        from simple_tensorflow_tpu.platform import monitoring
+
+        fused = monitoring.get_metric(
+            "/stf/session/fused_steps_amortized")
+        fused0 = sum(c.value() for c in fused.cells().values()) \
+            if fused else 0
+        n_calls = 0
+        with stf.train.MonitoredSession(
+                session_creator=stf.train.ChiefSessionCreator(config=cfg),
+                hooks=[stf.train.StopAtStepHook(last_step=14),
+                       hook]) as ms:
+            while not ms.should_stop():
+                ms.run(train)
+                n_calls += 1
+        fused1 = sum(c.value() for c in fused.cells().values()) \
+            if fused else 0
+        assert n_calls < 14, "windows never fused"
+        assert fused1 > fused0
+        # initial save (0), timer triggers (1 — first observed step —
+        # then 7, 13), final end() save (14); nothing else
+        assert _saved_steps(str(tmp_path)) == {0, 1, 7, 13, 14}
+        # every checkpoint's tensor state is exactly its step's state:
+        # the window was split AT the trigger, not past it
+        from simple_tensorflow_tpu.train.saver import \
+            load_checkpoint_values
+
+        for step in (1, 7, 13, 14):
+            vals = load_checkpoint_values(
+                os.path.join(str(tmp_path), f"model.ckpt-{step}"))
+            assert vals["fv"][0] == float(step), step
+            assert vals["global_step"][()] == step
+
+    def test_iterator_state_roundtrips_mid_epoch(self, tmp_path):
+        """The hook's checkpoint must capture the data iterator
+        mid-epoch, and a fresh session must resume the element stream
+        where the save happened (fusion config active: iterator feeds
+        make the plan host-staged, so windows run unfused — same
+        semantics, and the checkpoint contract must hold regardless)."""
+        from simple_tensorflow_tpu import data as stf_data
+
+        def build():
+            ds = stf_data.Dataset.from_tensor_slices(
+                np.arange(20, dtype=np.float32)).repeat()
+            it = ds.make_one_shot_iterator()
+            nxt = it.get_next()
+            gs = stf.train.get_or_create_global_step()
+            v = stf.Variable(stf.constant(0.0), name="acc")
+            train = stf.group(
+                stf.assign_add(v._ref, nxt),
+                stf.assign_add(gs, stf.constant(1, stf.int64)))
+            return train, v
+
+        train, v = build()
+        cfg = stf.ConfigProto(loop_fusion_steps=8)
+        hook = stf.train.CheckpointSaverHook(str(tmp_path), save_steps=4)
+        with stf.train.MonitoredSession(
+                session_creator=stf.train.ChiefSessionCreator(config=cfg),
+                hooks=[stf.train.StopAtStepHook(last_step=6),
+                       hook]) as ms:
+            while not ms.should_stop():
+                ms.run(train)
+        # consumed 0..5 -> acc = 15; end-saved at step 6
+        stf.reset_default_graph()
+        train2, v2 = build()
+        sess2 = stf.Session()
+        mgr = ckpt.CheckpointManager(str(tmp_path))
+        path = mgr.restore_or_initialize(
+            sess2, init_op=stf.global_variables_initializer())
+        assert path is not None and path.endswith("-6")
+        doc = json.load(open(path + ".index.json"))
+        positions = [s["position"] for s in
+                     doc["host_state"]["iterators"].values()]
+        assert positions == [6]  # mid-epoch position recorded
+        assert float(np.asarray(sess2.run(v2.value()))) == 15.0
+        # resumes with element 6, not a rewound epoch
+        sess2.run(train2)
+        assert float(np.asarray(sess2.run(v2.value()))) == 21.0
+
+
+CHILD = textwrap.dedent("""
+    import os, sys, hashlib
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import data as stf_data
+
+    ckpt_dir, total = sys.argv[1], int(sys.argv[2])
+    stf.set_random_seed(7)
+    rng = np.random.RandomState(0)
+    X = rng.randn(40, 8).astype(np.float32)
+    Y = rng.randn(40, 1).astype(np.float32)
+    ds = stf_data.Dataset.from_tensor_slices((X, Y)).batch(4).repeat()
+    it = ds.make_one_shot_iterator()
+    xb, yb = it.get_next()
+    gs = stf.train.get_or_create_global_step()
+    w1 = stf.Variable(stf.constant(
+        (rng.randn(8, 8) * 0.3).astype(np.float32)), name="w1")
+    w2 = stf.Variable(stf.constant(
+        (rng.randn(8, 1) * 0.3).astype(np.float32)), name="w2")
+    h = stf.nn.relu(stf.matmul(xb, w1._ref))
+    h = stf.nn.dropout(h, keep_prob=0.9)
+    loss = stf.reduce_mean(stf.square(stf.matmul(h, w2._ref) - yb))
+    train = stf.train.GradientDescentOptimizer(0.1).minimize(
+        loss, global_step=gs)
+    cfg = stf.ConfigProto(loop_fusion_steps=4)
+    hooks = [stf.train.StopAtStepHook(last_step=total)]
+    with stf.train.MonitoredTrainingSession(
+            checkpoint_dir=ckpt_dir, config=cfg, hooks=hooks,
+            save_checkpoint_steps=1000, save_summaries_steps=None,
+            log_step_count_steps=None) as ms:
+        print("START", int(np.asarray(
+            ms.raw_session.variable_value("global_step"))), flush=True)
+        g = None
+        while not ms.should_stop():
+            l = ms.run([train, loss])[1]
+            g = int(np.asarray(
+                ms.raw_session.variable_value("global_step")))
+            print("STEP", g, float(np.asarray(l)).hex(), flush=True)
+        hsh = hashlib.sha256()
+        for name in ("w1", "w2"):
+            hsh.update(np.asarray(
+                ms.raw_session.variable_value(name)).tobytes())
+        print("FINAL", g, hsh.hexdigest(), flush=True)
+""")
+
+
+def _spawn(script, ckpt_dir, total, term_after_step=None, timeout=300):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(ckpt_dir), str(total)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    lines = []
+    sent = False
+    try:
+        for line in proc.stdout:
+            line = line.strip()
+            if line:
+                lines.append(line)
+            if (term_after_step is not None and not sent
+                    and line.startswith("STEP ")
+                    and int(line.split()[1]) >= term_after_step):
+                proc.send_signal(signal.SIGTERM)
+                sent = True
+        rc = proc.wait(timeout=timeout)
+    finally:
+        err = proc.stderr.read()
+        proc.stderr.close()
+        if proc.poll() is None:
+            proc.kill()
+    return rc, lines, err
+
+
+def _parse(lines):
+    steps = {}
+    final = None
+    for line in lines:
+        parts = line.split()
+        if parts[0] == "STEP":
+            steps[int(parts[1])] = parts[2]
+        elif parts[0] == "FINAL":
+            final = (int(parts[1]), parts[2])
+    return steps, final
+
+
+@pytest.mark.skipif(os.name != "posix",
+                    reason="needs POSIX signal delivery")
+class TestSigtermResume:
+    def test_sigterm_mid_epoch_resumes_identical_trajectory(
+            self, tmp_path):
+        """Acceptance: a training job SIGTERM'd mid-epoch drains, saves
+        (exit 0), and the restarted job continues to the SAME per-step
+        losses and final weights (bit-exact digest) as an uninterrupted
+        control run — dropout masks (RNG counter), batch stream
+        (iterator position), optimizer state, and global_step all line
+        up."""
+        script = tmp_path / "child.py"
+        script.write_text(CHILD)
+        total = 18
+
+        rc_a, lines_a, err_a = _spawn(script, tmp_path / "a", total)
+        assert rc_a == 0, err_a[-3000:]
+        steps_a, final_a = _parse(lines_a)
+        assert final_a is not None and final_a[0] == total
+
+        rc_b1, lines_b1, err_b1 = _spawn(script, tmp_path / "b", total,
+                                         term_after_step=7)
+        assert rc_b1 == 0, err_b1[-3000:]  # drained + saved, clean exit
+        steps_b1, final_b1 = _parse(lines_b1)
+        preempt_step = final_b1[0]
+        assert preempt_step is not None and preempt_step < total, \
+            "child was never preempted"
+        saved = latest_checkpoint(str(tmp_path / "b"))
+        assert saved is not None
+        assert ckpt.verify_checkpoint(saved) == []
+
+        rc_b2, lines_b2, err_b2 = _spawn(script, tmp_path / "b", total)
+        assert rc_b2 == 0, err_b2[-3000:]
+        steps_b2, final_b2 = _parse(lines_b2)
+        assert lines_b2[0] == f"START {preempt_step}", \
+            "resume did not restore global_step"
+        assert min(steps_b2) > preempt_step
+
+        # per-step losses: every step both runs reported must agree
+        # EXACTLY (hex-coded floats — no tolerance)
+        stitched = dict(steps_b1)
+        stitched.update(steps_b2)
+        common = set(stitched) & set(steps_a)
+        assert total in common
+        assert len(common) >= 3
+        for s in sorted(common):
+            assert stitched[s] == steps_a[s], (
+                f"loss diverged at step {s}: "
+                f"{stitched[s]} != {steps_a[s]}")
+        # final weights bit-identical
+        assert final_b2 == final_a
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -218,6 +218,69 @@ class TestHostStateResume:
             assert int(sess2.run(nxt)) == 4  # resumes where save happened
 
 
+class TestAtomicCheckpointWrites:
+    """ISSUE 10 satellite: the .stfz/.index.json writers and
+    update_checkpoint_state commit through temp+fsync+os.replace with a
+    content checksum in the index."""
+
+    def test_index_carries_checksum_and_sharding_fields(self, tmp_path):
+        stf.Variable(stf.constant([1.0]), name="at_v")
+        saver = stf.train.Saver()
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            path = saver.save(sess, str(tmp_path / "m"))
+        import json
+
+        doc = json.load(open(path + ".index.json"))
+        assert doc["version"] >= 2
+        assert doc["checksum"].startswith("sha256:")
+        assert doc["data_bytes"] == os.path.getsize(path + ".stfz")
+        assert "sharding" in doc["tensors"]["at_v"]
+        from simple_tensorflow_tpu.checkpoint import atomic
+
+        assert atomic.checksum_file(path + ".stfz") == doc["checksum"]
+
+    def test_interrupted_state_update_keeps_previous_pointer(
+            self, tmp_path):
+        from simple_tensorflow_tpu.checkpoint import atomic
+
+        stf.Variable(stf.constant([1.0]), name="sp_v")
+        saver = stf.train.Saver()
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            p1 = saver.save(sess, str(tmp_path / "ck"), global_step=1)
+
+            def boom(point):
+                if point == "state:synced_tmp":
+                    raise OSError("yanked mid-commit")
+
+            atomic.set_fault_hook(boom)
+            try:
+                with pytest.raises(OSError):
+                    saver.save(sess, str(tmp_path / "ck"), global_step=2)
+            finally:
+                atomic.set_fault_hook(None)
+        # the step-2 bundle is on disk, but the pointer never moved —
+        # and it still parses (no truncated JSON)
+        assert stf.train.latest_checkpoint(str(tmp_path)) == p1
+        assert stf.train.get_checkpoint_state(str(tmp_path)) is not None
+
+    def test_restore_rejects_corrupted_bundle(self, tmp_path):
+        v = stf.Variable(stf.constant([3.0]), name="cr_v")
+        saver = stf.train.Saver()
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            path = saver.save(sess, str(tmp_path / "m"))
+        with open(path + ".stfz", "r+b") as f:
+            f.seek(20)
+            b = f.read(1)
+            f.seek(20)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with stf.Session() as sess2:
+            with pytest.raises(stf.errors.DataLossError):
+                saver.restore(sess2, path)
+
+
 class TestKeepEveryNHours:
     def test_keep_forever_based_on_checkpoint_time(self, tmp_path, monkeypatch):
         """ref semantics: a checkpoint whose save time crosses the keep

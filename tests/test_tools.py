@@ -96,6 +96,86 @@ class TestInspectCheckpoint:
         assert str(float(w_val[0, 0]))[:4] in buf.getvalue()
 
 
+class TestCkptInspectCLI:
+    """ISSUE 10 satellite: ``python -m simple_tensorflow_tpu.tools.
+    ckpt_inspect <dir>`` lists checkpoints, tensors/shapes/shardings,
+    verifies checksums, and exits 1 on corruption."""
+
+    def _checkpoint_dir(self, tmp_path):
+        import simple_tensorflow_tpu as stf
+        from simple_tensorflow_tpu import checkpoint as ckpt_mod
+
+        stf.reset_default_graph()
+        stf.Variable(stf.constant(np.ones((4, 2), np.float32)),
+                     name="ci/kernel")
+        gs = stf.train.get_or_create_global_step()
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        d = str(tmp_path / "ckpts")
+        mgr = ckpt_mod.CheckpointManager(d, max_to_keep=3)
+        mgr.save(sess, global_step=3, blocking=True)
+        mgr.save(sess, global_step=7, blocking=True)
+        return d, mgr
+
+    def test_lists_and_verifies_in_process(self, tmp_path):
+        d, mgr = self._checkpoint_dir(tmp_path)
+        from simple_tensorflow_tpu.tools import ckpt_inspect
+
+        out = io.StringIO()
+        rc = ckpt_inspect.run(d, tensors=True, out=out)
+        text = out.getvalue()
+        assert rc == 0
+        assert "step=3" in text and "step=7" in text
+        assert "ci/kernel  dtype=float32 shape=[4, 2]" in text
+        assert "all verified" in text
+        # --json shape
+        out = io.StringIO()
+        rc = ckpt_inspect.run(d, as_json=True, out=out)
+        doc = json.loads(out.getvalue())
+        assert rc == 0 and doc["ok"]
+        assert [c["step"] for c in doc["checkpoints"]] == [3, 7]
+        assert doc["checkpoints"][0]["host_state"][
+            "rng_run_counter"] is not None
+
+    def test_cli_subprocess_exit_codes(self, tmp_path):
+        import subprocess
+        import sys
+
+        d, mgr = self._checkpoint_dir(tmp_path)
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        cmd = [sys.executable, "-m",
+               "simple_tensorflow_tpu.tools.ckpt_inspect", d]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300, env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "all verified" in proc.stdout
+        # flip one byte -> CORRUPT + exit 1
+        latest = mgr.latest_checkpoint
+        with open(latest + ".stfz", "r+b") as f:
+            f.seek(25)
+            b = f.read(1)
+            f.seek(25)
+            f.write(bytes([b[0] ^ 0xFF]))
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300, env=env)
+        assert proc.returncode == 1, proc.stdout
+        assert "CORRUPT" in proc.stdout
+        assert "checksum" in proc.stdout
+
+    def test_empty_dir_exits_nonzero(self, tmp_path):
+        from simple_tensorflow_tpu.tools import ckpt_inspect
+
+        out = io.StringIO()
+        assert ckpt_inspect.run(str(tmp_path), out=out) == 1
+        assert "no checkpoints found" in out.getvalue()
+
+
 class TestStripUnused:
     def test_prunes_to_subgraph(self, tmp_path):
         graph_path, ckpt, *_ = _train_small_model(tmp_path)
