@@ -24,10 +24,23 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def lowered_cost(train_op, loss, feed):
     """Plan the session step for (train_op, loss) under `feed`, lower and
-    compile it WITHOUT running, and return XLA's cost analysis."""
+    compile it WITHOUT running, and return XLA's cost analysis.
+
+    Kernel-registry mode must be pinned to "off" (stf.kernels) by the
+    caller AT GRAPH BUILD (the model builders run under
+    ``stf.kernels.activate("off")``): the byte budgets were calibrated
+    against the pre-registry lowerings, which "off" reproduces
+    exactly. On this CPU gate "auto" would deliberately fall back to
+    the composed XLA lowerings (materialized attention scores /
+    log-softmax — the very traffic the budgets exist to catch),
+    "force" routes EVERY kernel through interpret-mode Pallas whose
+    per-grid-step HLO inflates XLA's byte accounting, and the fused
+    optimizer tail's flat-slot slices are charged full-buffer reads by
+    XLA's (pre-fusion) cost analysis. None of those is the calibrated
+    baseline."""
     import simple_tensorflow_tpu as stf
 
-    sess = stf.Session()
+    sess = stf.Session(config=stf.ConfigProto(kernel_registry="off"))
     sess.run(stf.global_variables_initializer())
     feeds = sess._normalize_feeds(feed)
     step = sess._plan([train_op, loss], feeds)
@@ -53,15 +66,19 @@ def resnet_cost(batch=256, image=224, recompute=False, s2d=False):
     import simple_tensorflow_tpu as stf
     from simple_tensorflow_tpu.models import resnet
 
+    from simple_tensorflow_tpu.kernels import registry as kreg
+
     stf.reset_default_graph()
     kwargs = {}
     if recompute:
         kwargs["recompute"] = True
     if s2d:
         kwargs["conv0_space_to_depth"] = True
-    m = resnet.resnet50_train_model(batch_size=batch, image_size=image,
-                                    dtype=stf.bfloat16, learning_rate=0.1,
-                                    **kwargs)
+    with kreg.activate("off"):  # calibrated pre-registry lowerings
+        m = resnet.resnet50_train_model(batch_size=batch,
+                                        image_size=image,
+                                        dtype=stf.bfloat16,
+                                        learning_rate=0.1, **kwargs)
     images, labels = resnet.synthetic_imagenet(batch, image)
     feed = {m["images"]: jnp.asarray(images, stf.bfloat16.np_dtype),
             m["labels"]: jnp.asarray(labels)}
@@ -72,15 +89,17 @@ def bert_cost(batch=24, seq_len=512, recompute=False):
     import jax.numpy as jnp
 
     import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.kernels import registry as kreg
     from simple_tensorflow_tpu.models import bert
 
     stf.reset_default_graph()
     cfg = bert.BertConfig.base()
     max_pred = max(1, int(seq_len * 0.15))
-    m = bert.bert_pretrain_model(
-        batch_size=batch, seq_len=seq_len, max_predictions=max_pred,
-        cfg=cfg, compute_dtype=stf.bfloat16, use_input_mask=True,
-        recompute=recompute)
+    with kreg.activate("off"):  # calibrated pre-registry lowerings
+        m = bert.bert_pretrain_model(
+            batch_size=batch, seq_len=seq_len, max_predictions=max_pred,
+            cfg=cfg, compute_dtype=stf.bfloat16, use_input_mask=True,
+            recompute=recompute)
     batch_np = bert.synthetic_pretrain_batch(batch, seq_len, max_pred,
                                              vocab_size=cfg.vocab_size)
     batch_np["input_mask"] = np.ones((batch, seq_len), np.int32)

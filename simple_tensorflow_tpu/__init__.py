@@ -281,4 +281,9 @@ from . import telemetry
 # docs/CHECKPOINT.md)
 from . import checkpoint
 
+# Pallas/XLA kernel routing tier: per-(op, shape, dtype, backend)
+# fallback registry with cost-model gating and a measured autotune
+# cache (stf.kernels; docs/PERFORMANCE.md "kernel tier")
+from . import kernels
+
 newaxis = None

@@ -33,6 +33,10 @@ Built-in catalog (see docs/ANALYSIS.md for the worked examples):
                          RNG. Active only for purpose="serving" runs
                          (``lint_graph(purpose="serving")`` /
                          ``graph_lint --serving``) (WARNING)
+  lint/kernel-routing    per-op Pallas/XLA routing verdicts from the
+                         stf.kernels registry (routed / fallback+reason
+                         / autotune). Active only for purpose="kernels"
+                         runs (``graph_lint --kernels``) (NOTE)
 """
 
 from __future__ import annotations
@@ -363,3 +367,30 @@ def _rule_serving_incompatible(ctx):
                    "composition/request order and do not reproduce "
                    "across restarts; seed it, or export without "
                    "sampling ops")
+
+
+@register_lint_rule("kernel-routing", NOTE)
+def _rule_kernel_routing(ctx):
+    """Per-op Pallas/XLA routing verdicts from the stf.kernels registry
+    (active only for ``purpose="kernels"`` runs: ``graph_lint
+    --kernels`` and the zoo routing gate). One NOTE per op whose type
+    has a registered kernel pair, naming the verdict the registry would
+    reach offline — ``routed`` (Pallas), ``fallback`` + reason, or
+    ``autotune`` (decided by measurement on first live call). Op types
+    without a kernel are summarized by the CLI, not flagged per op."""
+    if ctx.purpose != "kernels":
+        return
+    from ..kernels import registry as kreg
+
+    mode = kreg.current_mode()
+    bk = kreg.backend()
+    for op in ctx.ops:
+        if not kreg.has_kernel(op.type):
+            continue
+        rec = kreg.routing_report([op], mode=mode)[0]
+        reason = rec.get("reason")
+        detail = f" ({reason})" if reason and rec["verdict"] != "routed" \
+            else ""
+        yield (op,
+               f"kernel routing [{mode}/{bk}]: {op.type} -> "
+               f"{rec['verdict']}{detail}")

@@ -30,15 +30,27 @@ from ..framework import graph as ops_mod
 from ..framework import lowering as lowering_mod
 
 
+_persistent_cache_dir: Optional[str] = None
+
+
 def enable_persistent_cache(cache_dir: str) -> None:
     """Persist compiled executables under ``cache_dir`` (survives process
     restarts; subsequent compiles of the same HLO are disk hits)."""
     import jax
 
+    global _persistent_cache_dir
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # cache everything, however fast the compile was
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _persistent_cache_dir = cache_dir
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The enabled persistent-cache directory, or None. The kernel
+    registry persists its micro-autotune verdicts alongside it
+    (stf.kernels; docs/PERFORMANCE.md)."""
+    return _persistent_cache_dir
 
 
 class _CompiledBundle:

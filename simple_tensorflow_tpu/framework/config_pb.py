@@ -84,6 +84,23 @@ class ConfigProto:
     staging overlaps step N's device execution. Default False keeps
     the eager-numpy return contract.
 
+    kernel_registry: None (process default: ``STF_PALLAS`` /
+    ``stf.kernels.set_mode``) | "off" | "auto" | "force" — the Pallas
+    kernel-routing mode for programs this Session lowers
+    (docs/PERFORMANCE.md "kernel tier"). "off" restores the
+    pre-registry lowerings exactly; "auto" routes per (op, shape,
+    dtype, backend) through the cost-model gate + micro-autotune
+    cache; "force" pins every eligible op to the Pallas kernel
+    (interpret mode off-TPU — the tier-1 testing mode). Applies at
+    TRACE time: executables already compiled by this Session keep the
+    routing they were traced with. NOTE: the fused optimizer tail is a
+    GRAPH-BUILD decision — a graph built while the process default was
+    not "off" already contains the fused update op and flat slot
+    layout; this session-scoped "off" only picks its composed lowering.
+    To restore the per-variable assign tail (and its per-variable slot
+    checkpoint layout) set STF_PALLAS=0 / stf.kernels.set_mode("off")
+    BEFORE building the optimizer.
+
     telemetry_port: start the process's stf.telemetry HTTP server
     (``/metrics`` Prometheus scrape, ``/healthz``, ``/statusz``,
     ``/tracez``, ``/flightz``; docs/OBSERVABILITY.md) when the Session
@@ -105,7 +122,8 @@ class ConfigProto:
                  transfer_guard_threshold_bytes=1 << 20,
                  graph_analysis="off", variable_hazard_mode=None,
                  loop_fusion_steps=1, async_fetches=False,
-                 compile_cache_dir=None, telemetry_port=None):
+                 compile_cache_dir=None, telemetry_port=None,
+                 kernel_registry=None):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -142,6 +160,12 @@ class ConfigProto:
         self.loop_fusion_steps = loop_fusion_steps
         self.async_fetches = bool(async_fetches)
         self.compile_cache_dir = compile_cache_dir
+        if kernel_registry is not None and kernel_registry not in (
+                "off", "auto", "force"):
+            raise ValueError(
+                f"kernel_registry must be None|off|auto|force, "
+                f"got {kernel_registry!r}")
+        self.kernel_registry = kernel_registry
         if telemetry_port is not None:
             telemetry_port = int(telemetry_port)
             if telemetry_port < 0 or telemetry_port > 65535:

@@ -155,6 +155,13 @@ def _op_flops(op: Operation, grad_depth: int = 0,
     if t in ("FusedBatchNorm", "FusedBatchNormV2", "LayerNorm"):
         n = _nelems(op.inputs[0].shape) or 0
         return 5.0 * n  # two reduction passes + normalize + scale/shift
+    if t in ("FusedAdamUpdate", "FusedMomentumUpdate"):
+        # the fused optimizer tail (stf.kernels): elementwise over every
+        # gradient element — m/v updates, alpha scaling, param subtract
+        # (~12 flops/elem Adam, ~6 Momentum); same arithmetic the
+        # per-variable assign chains carried, now priced on one op
+        n = sum(_nelems(i.shape) or 0 for i in op.inputs)
+        return (12.0 if t == "FusedAdamUpdate" else 6.0) * n
     mult = 2.0 if t in _TRANSCENDENTAL_OPS else 1.0
     return mult * _out_elems(op)
 
@@ -212,6 +219,15 @@ def _op_bytes_dispatch(op: Operation, fn_depth: int = 0) -> float:
     hidden NCHW lowering transposes."""
     if op.type == "SymbolicGradient":
         return _symbolic_gradient_bytes(op)
+    if op.type in ("FusedAdamUpdate", "FusedMomentumUpdate"):
+        # inputs (grads + scalar hypers) move once, plus the
+        # store-resident state the op reads AND writes in place:
+        # m/v/param for Adam (6 streams over n), accumulator/param for
+        # Momentum (4 streams) — traffic the per-variable assign chains
+        # previously charged across their many ops
+        n = sum(_nelems(i.shape) or 0 for i in op.inputs)
+        streams = 6.0 if op.type == "FusedAdamUpdate" else 4.0
+        return _op_bytes(op) + streams * n * 4.0
     fc = _function_op_cost(op, 0, fn_depth)
     if fc is not None:
         return fc[1]

@@ -202,6 +202,18 @@ class LoweringContext:
         self.env: Dict[Tensor, Any] = dict(feeds or {})
         self.host = host
         self.session = session
+        # kernel-registry routing mode for ops traced under this context
+        # (stf.kernels): ConfigProto(kernel_registry=...) when the
+        # session set one, else None = the process default. execute_ops
+        # activates it thread-locally around the trace loop, so every
+        # registry decision inside this plan (including FuncGraph bodies,
+        # shard_map'd jax helpers, and SymbolicGradient replays) sees the
+        # session's mode.
+        self.kernel_mode = None
+        if session is not None:
+            cfg = getattr(session, "_config", None)
+            self.kernel_mode = getattr(cfg, "kernel_registry", None) \
+                if cfg is not None else None
         self.sharding_env = None  # set by parallel lowering
         self.in_control_flow = False
         self.in_shard_map = False
@@ -225,6 +237,7 @@ class LoweringContext:
     def child(self, env: Dict[Tensor, Any],
               in_control_flow: Optional[bool] = None) -> "LoweringContext":
         c = LoweringContext.__new__(LoweringContext)
+        c.kernel_mode = self.kernel_mode
         c.state = self.state
         c.written = self.written
         c.var_metadata = self.var_metadata
@@ -312,8 +325,25 @@ def check_step_read_write_races(
 
 def execute_ops(ctx: LoweringContext, op_list: Sequence[Operation],
                 fed: Optional[Set[Tensor]] = None):
-    """Trace ops in topological order, populating ctx.env."""
-    fed = fed or set()
+    """Trace ops in topological order, populating ctx.env.
+
+    The kernel-registry mode (stf.kernels) is activated thread-locally
+    for the duration of the trace: op lowerings — and any jax-level
+    helpers they call under shard_map/scan/vjp — route Pallas vs XLA
+    under the session's ConfigProto(kernel_registry=...) (or the
+    process default when the context carries None).
+
+    ``fed`` is accepted for call-site compatibility only: fed-tensor
+    pruning happened in prune(), and every fed tensor is already bound
+    in ctx.env before the trace starts."""
+    from ..kernels import registry as _kernels
+
+    with _kernels.activate(ctx.kernel_mode):
+        _execute_ops_inner(ctx, op_list)
+
+
+def _execute_ops_inner(ctx: LoweringContext,
+                       op_list: Sequence[Operation]):
     for op in op_list:
         already = all(o in ctx.env for o in op.outputs) and op.outputs
         # CapturedInput/FuncArg are bound values, not effects: when a branch

@@ -78,8 +78,17 @@ class Effects:
                 v = op.attrs.get(sel)
                 # missing attr -> a resource unique to this op: it can
                 # never alias another op's resource (no false hazards)
-                out.add(f"{sel}={v}" if v is not None
-                        else f"{sel}@{op.name}")
+                if v is None:
+                    out.add(f"{sel}@{op.name}")
+                elif isinstance(v, (list, tuple)):
+                    # list-valued attr: one resource per element, named
+                    # exactly like a scalar selector would name it — a
+                    # fused op touching N variables (FusedAdamUpdate)
+                    # aliases the same resources as N per-variable
+                    # assigns, so hazards cross-detect
+                    out.update(f"{sel}={x}" for x in v)
+                else:
+                    out.add(f"{sel}={v}")
         return frozenset(out)
 
     def resolved_reads(self, op) -> frozenset:

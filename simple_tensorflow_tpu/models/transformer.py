@@ -82,6 +82,16 @@ def sinusoidal_position_encoding(max_len, d_model):
     return enc
 
 
+def _residual(sub_out, h, cfg, training):
+    """Sublayer tail ``h + dropout(sub_out)`` through the fused
+    dropout+bias+residual op (ops/fused_ops.py; the registry routes the
+    Pallas kernel vs the composed-XLA chain — same counter-based mask
+    either way). rate 0 (eval / dropout-free configs) builds a plain
+    add, keeping those graphs identical to the pre-fusion form."""
+    rate = cfg.dropout if training else 0.0
+    return stf.nn.fused_bias_dropout_residual(sub_out, h, rate=rate)
+
+
 def _attention(q_in, kv_in, bias, cfg, training, compute_dtype, name,
                causal=False):
     """q_in (B,Sq,D) attends over kv_in (B,Sk,D). bias additive or None.
@@ -89,6 +99,8 @@ def _attention(q_in, kv_in, bias, cfg, training, compute_dtype, name,
     Always the Pallas flash-attention kernel: padding bias rides the
     kernel's additive key-bias input, causal masking and attention-prob
     dropout happen in-kernel (counter-based mask replayed in the vjp).
+    The output-projection dropout moved into the fused
+    dropout+residual tail (_residual) applied at the block level.
     """
     b = int(q_in.shape[0])
     sq, sk = int(q_in.shape[1]), int(kv_in.shape[1])
@@ -106,8 +118,6 @@ def _attention(q_in, kv_in, bias, cfg, training, compute_dtype, name,
             q, k, v, bias=key_bias, causal=causal,
             dropout_rate=cfg.dropout if training else 0.0)
         out = _dense(common.merge_heads(ctx, b, sq, d), d, cfg, "out")
-        if training and cfg.dropout > 0:
-            out = stf.nn.dropout(out, keep_prob=1.0 - cfg.dropout)
     return out
 
 
@@ -154,7 +164,7 @@ def encode(src_ids, cfg, training=True, compute_dtype=stf.bfloat16,
                 with stf.variable_scope(f"layer_{i}"):
                     a = _attention(hh, hh, bias, cfg, training,
                                    compute_dtype, "self_attn")
-                    hh = _ln(hh + a, cfg, "ln1")
+                    hh = _ln(_residual(a, hh, cfg, training), cfg, "ln1")
                     f = _ffn(hh, cfg, training, "ffn")
                     return _ln(hh + f, cfg, "ln2")
 
@@ -174,10 +184,10 @@ def decode(tgt_ids, enc_out, enc_bias, cfg, training=True,
                 with stf.variable_scope(f"layer_{i}"):
                     a = _attention(hh, hh, None, cfg, training,
                                    compute_dtype, "self_attn", causal=True)
-                    hh = _ln(hh + a, cfg, "ln1")
+                    hh = _ln(_residual(a, hh, cfg, training), cfg, "ln1")
                     c = _attention(hh, enc_out, enc_bias, cfg, training,
                                    compute_dtype, "cross_attn")
-                    hh = _ln(hh + c, cfg, "ln2")
+                    hh = _ln(_residual(c, hh, cfg, training), cfg, "ln2")
                     f = _ffn(hh, cfg, training, "ffn")
                     return _ln(hh + f, cfg, "ln3")
 

@@ -32,8 +32,8 @@ from ..ops.rnn import (
 )
 from ..ops import rnn_cell
 from ..ops.fused_ops import (
-    fused_attention, fused_layer_norm, fused_softmax_cross_entropy,
-    quantized_matmul,
+    fused_attention, fused_bias_dropout_residual, fused_layer_norm,
+    fused_softmax_cross_entropy, quantized_matmul,
 )
 from ..ops.candidate_sampling_ops import (
     uniform_candidate_sampler, log_uniform_candidate_sampler,

@@ -9,19 +9,24 @@ imported from stf.nn, i.e. `import simple_tensorflow_tpu` is enough.
 from __future__ import annotations
 
 from ..framework import graph as ops_mod
+from ..framework import random_seed as random_seed_mod
 from ..framework import tensor_shape as shape_mod
 from . import pallas as _pallas  # noqa: F401  (registers the op types)
 
 
 def fused_attention(q, k, v, *, bias=None, dropout_rate=0.0, causal=False,
-                    sm_scale=None, name=None):
+                    sm_scale=None, seed=None, name=None):
     """Flash attention over (batch, heads, seq, head_dim) tensors.
 
     bias: optional additive score bias broadcast over heads/queries —
     (batch, kv_seq) or (batch, 1, 1, kv_seq), the padding-mask shape;
     constant under differentiation. dropout_rate > 0 applies attention-
     probability dropout inside the kernel (drawn from the op's RNG
-    stream, replayed exactly in the backward pass).
+    stream, replayed exactly in the backward pass); the graph seed and
+    optional op ``seed`` fold into that stream exactly like
+    ``stf.nn.dropout`` (random_seed.get_seed), so
+    ``stf.set_random_seed`` pins the mask — independent of which
+    implementation the kernel registry routes to (stf.kernels).
     """
     g = ops_mod.get_default_graph()
     q = ops_mod.convert_to_tensor(q)
@@ -35,9 +40,44 @@ def fused_attention(q, k, v, *, bias=None, dropout_rate=0.0, causal=False,
     if dropout_rate and float(dropout_rate) > 0.0:
         op_type = "FlashAttentionDropout"
         attrs["dropout_rate"] = float(dropout_rate)
+        graph_seed, op_seed = random_seed_mod.get_seed(seed)
+        attrs["seed"] = op_seed
+        attrs["_graph_seed"] = graph_seed
     op = g.create_op(op_type, inputs, attrs=attrs,
                      name=name or "flash_attention",
                      output_specs=[(q.shape, q.dtype)])
+    return op.outputs[0]
+
+
+def fused_bias_dropout_residual(x, residual, bias=None, *, rate,
+                                seed=None, name=None):
+    """Fused transformer-block tail: ``residual + dropout(x + bias)``.
+
+    x/residual: same-shape activations; bias: optional (features,)
+    vector added before the dropout. rate == 0 builds the plain
+    composed ops (no RNG effect); rate > 0 builds one
+    FusedDropoutBiasResidual op whose counter-based mask is drawn from
+    the op's per-step RNG stream (graph/op seeds fold in exactly like
+    ``stf.nn.dropout``) and regenerated — never materialized — in the
+    backward pass. Routed Pallas/XLA through stf.kernels; both
+    implementations produce bit-identical output from the same seed.
+    """
+    g = ops_mod.get_default_graph()
+    x = ops_mod.convert_to_tensor(x)
+    residual = ops_mod.convert_to_tensor(residual)
+    if bias is not None:
+        bias = ops_mod.convert_to_tensor(bias, dtype=x.dtype.base_dtype)
+    if not rate or float(rate) <= 0.0:
+        out = x + bias + residual if bias is not None else x + residual
+        return out
+    graph_seed, op_seed = random_seed_mod.get_seed(seed)
+    inputs = [x, residual] + ([bias] if bias is not None else [])
+    op = g.create_op(
+        "FusedDropoutBiasResidual", inputs,
+        attrs={"rate": float(rate), "seed": op_seed,
+               "_graph_seed": graph_seed},
+        name=name or "fused_dropout_residual",
+        output_specs=[(x.shape, x.dtype)])
     return op.outputs[0]
 
 
@@ -148,3 +188,32 @@ _shard.register_rules(_fused_layer_norm_rule, "FusedLayerNorm")
 _shard.register_rules(_shard.make_last_dim_reduce_rule(),
                       "FusedSoftmaxXent")
 _shard.register_rules(_shard.matmul_rule, "QuantMatMul")
+
+
+def _dropout_residual_rule(op, in_specs, ctx):
+    # elementwise over x/residual (bias replicated along the feature
+    # sharding): join the two activation specs like a binary
+    # elementwise op; the counter-based mask is position-keyed, so any
+    # sharding is mask-consistent
+    sx = in_specs[0]
+    sr = in_specs[1] if len(in_specs) > 1 else None
+    out = sx
+    if sx is not None and sr is not None and len(sr) == len(sx):
+        out = ctx.join(sx, sr)
+    elif sx is None:
+        out = sr
+    return [out]
+
+
+_shard.register_rules(_dropout_residual_rule, "FusedDropoutBiasResidual")
+
+
+def _fused_optimizer_update_rule(op, in_specs, ctx):
+    # consumes grads (+ scalar hypers), writes variables through the
+    # store — no tensor outputs to propagate; variable-state sharding
+    # is owned by the store's committed shardings, not the data edges
+    return []
+
+
+_shard.register_rules(_fused_optimizer_update_rule, "FusedAdamUpdate",
+                      "FusedMomentumUpdate")

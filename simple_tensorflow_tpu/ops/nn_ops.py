@@ -72,8 +72,89 @@ def _sparse_softmax_xent(logits, labels):
 
 
 op_registry.register_pure("SoftmaxCrossEntropyWithLogits", _softmax_xent)
-op_registry.register_pure("SparseSoftmaxCrossEntropyWithLogits",
-                          _sparse_softmax_xent)
+
+
+def _sparse_xent_pallas(logits, labels):
+    """The Pallas streamed-xent route for the composed graph op: same
+    contract (per-example loss in the logits dtype)."""
+    from .pallas import softmax_cross_entropy
+
+    return softmax_cross_entropy(logits, labels).astype(logits.dtype)
+
+
+def _sparse_xent_eligible(key):
+    # same contract as the FusedSoftmaxXent op — one eligibility
+    # implementation (ops/pallas) serves both routes
+    from . import pallas as _pallas
+
+    return _pallas._xent_eligible(key)
+
+
+def _lower_sparse_xent(ctx, op, inputs):
+    """nn_ops sparse softmax-xent: routed through stf.kernels — the
+    large-vocab Pallas streamed kernel replaces the composed
+    log_softmax + gather lowering when the cost model/autotune gates it
+    in (ops/pallas/softmax_xent.py); ``off`` mode keeps the composed
+    lowering exactly."""
+    from ..kernels import registry as _kreg
+
+    logits, labels = inputs
+    fn = _kreg.select("SparseSoftmaxCrossEntropyWithLogits",
+                      _kreg.aval_key(logits, labels))
+    return [fn(logits, labels)]
+
+
+op_registry.register("SparseSoftmaxCrossEntropyWithLogits",
+                     lower=_lower_sparse_xent,
+                     pure_fn=_sparse_softmax_xent)
+
+
+def _register_sparse_xent_kernel():
+    from ..kernels import registry as _kreg
+
+    def _gate(key, bk):
+        lb_shape, lb_dt = key[0]
+        n = 1
+        for d in lb_shape:
+            n *= int(d)
+        try:
+            itm = {"bfloat16": 2, "float16": 2}.get(str(lb_dt))
+            if itm is None:
+                import numpy as _np
+
+                itm = _np.dtype(str(lb_dt)).itemsize
+        except TypeError:
+            itm = 4
+        return _kreg.roofline_gate(5.0 * n, 1.2 * n * itm, 3.0 * n * itm, bk)
+
+    def _case(key):
+        import numpy as _np
+
+        (ls, ld), (labs, labd) = key[:2]
+        rng = _np.random.RandomState(0)
+        logits = rng.randn(*ls).astype(_np.float32)
+        labels = rng.randint(0, ls[-1], size=labs).astype(_np.int32)
+        return ((logits, labels), {})
+
+    _kreg.register_kernel(
+        "SparseSoftmaxCrossEntropyWithLogits",
+        impls={"pallas": _sparse_xent_pallas, "xla": _sparse_softmax_xent},
+        legacy="xla",
+        eligible=_sparse_xent_eligible,
+        cost_gate=_gate,
+        make_case=_case,
+        graph_key=lambda op: _sparse_xent_graph_key(op),
+        doc="composed log_softmax+gather vs the Pallas streamed "
+            "online-softmax xent kernel")
+
+
+def _sparse_xent_graph_key(op):
+    from . import pallas as _pallas
+
+    return _pallas._simple_graph_key(op)
+
+
+_register_sparse_xent_kernel()
 op_registry.register_pure(
     "SigmoidCrossEntropyWithLogits",
     lambda logits, labels: (jnp.maximum(logits, 0) - logits * labels +

@@ -1,32 +1,195 @@
 """Pallas TPU kernels (replaces ref CUDA kernels, core/kernels/*_gpu.cu.cc).
 
-Each kernel is exposed two ways:
-- as a jax-level function (used directly by jax-native model code), and
-- as a registered graph op, so stf graph programs pick up the fused kernel
-  through the normal Session lowering path (`stf.nn.fused_*`).
+Each kernel is exposed three ways:
+- as a jax-level function (used directly by jax-native model code),
+- as a registered graph op, so stf graph programs pick up the kernel
+  through the normal Session lowering path (`stf.nn.fused_*`), and
+- as a (pallas, xla) implementation pair in the stf.kernels registry:
+  the graph-op lowerings below consult the registry per (op, shape,
+  dtype, backend) and emit either the Pallas kernel or the stock
+  composed-XLA lowering (docs/PERFORMANCE.md "kernel tier"). ``off``
+  mode reproduces the pre-registry behavior exactly; ``force`` pins
+  Pallas (interpret mode off-TPU, so tier-1 CPU tests run the kernels).
 
 All kernels auto-switch to interpret mode off-TPU so the CPU test mesh
 exercises identical code paths.
 """
 
+import numpy as np
+
 from ...framework import op_registry
-from .flash_attention import flash_attention, mha_reference
+from ...kernels import registry as _kreg
+from .dropout_residual import (dropout_bias_residual,
+                               dropout_bias_residual_reference)
+from .flash_attention import attention_xla, flash_attention, mha_reference
+from .fused_update import (adam_update, adam_update_reference,
+                           momentum_update, momentum_update_reference)
 from .layer_norm import layer_norm, layer_norm_reference
 from .quant_matmul import (quant_matmul, quant_matmul_reference,
-                           quant_matmul_ste, quantize_colwise,
-                           quantize_rowwise)
+                           quant_matmul_ste, quant_matmul_ste_reference,
+                           quantize_colwise, quantize_rowwise)
 from .softmax_xent import (softmax_cross_entropy,
                            softmax_cross_entropy_reference)
 
-def _flash_pure(q, k, v, bias=None, causal=False, sm_scale=None):
-    return flash_attention(q, k, v, bias=bias, causal=causal,
-                           sm_scale=sm_scale)
+
+def _np_of(dt):
+    s = str(dt)
+    try:
+        return np.dtype(s)
+    except TypeError:
+        import ml_dtypes  # registered by jax; covers bfloat16 etc.
+
+        return np.dtype(getattr(ml_dtypes, s))
+
+
+def _is_float(dt) -> bool:
+    s = str(dt)
+    return s.startswith("float") or s.startswith("bfloat")
+
+
+def _bytes_of(aval_entry):
+    shape, dt = aval_entry
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * _np_of(dt).itemsize
+
+
+def _rand(shape, dt, seed=0):
+    rng = np.random.RandomState(seed)
+    d = _np_of(dt)
+    if d.kind in "iu":
+        return rng.randint(0, 4, size=shape).astype(d)
+    return rng.randn(*shape).astype(np.float32).astype(d)
+
+
+# ---------------------------------------------------------------------------
+# FlashAttention (+Dropout): Pallas streamed kernel vs composed matmuls
+# ---------------------------------------------------------------------------
+
+def _flash_eligible(key):
+    (qs, qd), (ks, _kd), (vs, _vd), bias = key[:4]
+    statics = dict(key[4:])
+    if not _is_float(qd):
+        return "ineligible_dtype"
+    if len(qs) != 4 or len(ks) != 4:
+        return "ineligible_shape"
+    if statics.get("causal") and qs[2] != ks[2]:
+        return "ineligible_shape"
+    if bias is not None:
+        bs, _bd = bias
+        # the kernel takes a key bias broadcast over heads/queries:
+        # anything not squeezable to (batch, kv_seq) needs the composed
+        # path (which handles arbitrary additive biases)
+        if len(bs) < 2 or bs[0] != qs[0] or bs[-1] != ks[2] \
+                or any(d != 1 for d in bs[1:-1]):
+            return "ineligible_bias"
+    return None
+
+
+def _flash_gate(key, bk):
+    (qs, qd), (ks, _), (vs, _), bias = key[:4]
+    statics = dict(key[4:])
+    b, h, sq, d = (int(x) for x in qs)
+    sk = int(ks[2])
+    flops = 4.0 * b * h * sq * sk * d * (0.5 if statics.get("causal") else 1)
+    itm = _np_of(qd).itemsize
+    qkv_bytes = (_bytes_of(key[0]) + _bytes_of(key[1]) + _bytes_of(key[2])
+                 + b * h * sq * d * itm)
+    # the composed path materializes the (B,H,Sq,Sk) f32 score matrix
+    # roughly three times (scores, softmax, P·V read) — the exact HBM
+    # traffic the streamed kernel exists to avoid
+    return _kreg.roofline_gate(flops, qkv_bytes,
+                               qkv_bytes + 3.0 * b * h * sq * sk * 4, bk)
+
+
+def _flash_case(key):
+    (qs, qd), (ks, kd), (vs, vd), bias = key[:4]
+    statics = dict(key[4:])
+    args = [_rand(qs, qd, 0), _rand(ks, kd, 1), _rand(vs, vd, 2)]
+    kw = {"causal": bool(statics.get("causal", False))}
+    if bias is not None:
+        kw["bias"] = _rand(bias[0], bias[1], 3)
+    if statics.get("dropout"):
+        kw["dropout_rate"] = 0.1
+        kw["dropout_seed"] = np.asarray([7], np.int32)
+    return tuple(args), kw
+
+
+_kreg.register_kernel(
+    "FlashAttention",
+    impls={"pallas": flash_attention, "xla": attention_xla},
+    legacy="pallas",
+    eligible=_flash_eligible,
+    cost_gate=_flash_gate,
+    make_case=_flash_case,
+    graph_key=lambda op: _flash_graph_key(op),
+    doc="streamed FlashAttention-2 kernel vs composed batch-matmul "
+        "attention")
+_kreg.register_kernel(
+    "FlashAttentionDropout",
+    impls={"pallas": flash_attention, "xla": attention_xla},
+    legacy="pallas",
+    eligible=_flash_eligible,
+    cost_gate=_flash_gate,
+    make_case=_flash_case,
+    graph_key=lambda op: _flash_graph_key(op, dropout=True),
+    doc="FlashAttention with in-kernel probability dropout (counter-"
+        "based mask shared with the composed fallback)")
+
+
+def _tensor_aval(t):
+    sh = t.shape
+    if sh.rank is None or any(d.value is None for d in sh.dims):
+        return None
+    return (tuple(int(d.value) for d in sh.dims), t.dtype.base_dtype.name)
+
+
+def _flash_graph_key(op, dropout=False):
+    avals = [_tensor_aval(t) for t in op.inputs]
+    if any(a is None for a in avals[:3]) or len(avals) < 3:
+        return None
+    bias = avals[3] if len(avals) > 3 else None
+    return _kreg.aval_key(
+        *[_Aval(*a) for a in avals[:3]],
+        *( [_Aval(*bias)] if bias is not None else [None]),
+        causal=bool(op.attrs.get("causal", False)), dropout=bool(dropout))
+
+
+class _Aval:
+    """shape/dtype carrier for aval_key from graph tensors."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _flash_key(q, k, v, bias, causal, dropout):
+    return _kreg.aval_key(q, k, v, bias, causal=bool(causal),
+                          dropout=bool(dropout))
+
+
+def _lower_flash(ctx, op, input_values):
+    q, k, v = input_values[:3]
+    bias = input_values[3] if len(input_values) > 3 else None
+    causal = op.attrs.get("causal", False)
+    sm_scale = op.attrs.get("sm_scale")
+    fn = _kreg.select("FlashAttention",
+                      _flash_key(q, k, v, bias, causal, False))
+    return [fn(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale)]
 
 
 def _flash_dropout_lower(ctx, op, input_values):
     """FlashAttention with probability dropout: stateful (never CSE'd —
     two dropout sites must draw different masks), seeded from the op's
-    per-step RNG stream so fwd and vjp replay the same mask."""
+    per-step RNG stream so fwd and vjp replay the same mask. The op's
+    graph/op seed attrs fold into the stream exactly like nn_ops
+    dropout (random_seed.fold_in_value), so ``stf.set_random_seed``
+    reproduces the mask regardless of op naming — and regardless of
+    which implementation the registry picks (both draw the identical
+    counter-based mask from the derived seed)."""
     import jax
     import jax.numpy as jnp
 
@@ -35,23 +198,358 @@ def _flash_dropout_lower(ctx, op, input_values):
     key = ctx.rng_for(op)
     seed = jax.random.randint(key, (1,), 0, jnp.iinfo(jnp.int32).max,
                               dtype=jnp.int32)
-    out = flash_attention(
-        q, k, v, bias=bias, causal=op.attrs.get("causal", False),
-        sm_scale=op.attrs.get("sm_scale"),
-        dropout_rate=float(op.attrs["dropout_rate"]), dropout_seed=seed)
+    causal = op.attrs.get("causal", False)
+    fn = _kreg.select("FlashAttentionDropout",
+                      _flash_key(q, k, v, bias, causal, True))
+    out = fn(q, k, v, bias=bias, causal=causal,
+             sm_scale=op.attrs.get("sm_scale"),
+             dropout_rate=float(op.attrs["dropout_rate"]), dropout_seed=seed)
     return [out]
 
 
-op_registry.register_pure("FlashAttention", _flash_pure)
+op_registry.register("FlashAttention", lower=_lower_flash)
 op_registry.register("FlashAttentionDropout", lower=_flash_dropout_lower,
-                     is_stateful=True)
-op_registry.register_pure(
+                     effects=op_registry.Effects(rng=True))
+
+
+# ---------------------------------------------------------------------------
+# FusedLayerNorm: one-pass VMEM kernel vs composed mean/var/normalize
+# ---------------------------------------------------------------------------
+
+def _ln_eligible(key):
+    (xs, xd), (gs, _), (bs, _) = key[:3]
+    if not _is_float(xd):
+        return "ineligible_dtype"
+    if len(xs) < 1 or len(gs) != 1 or len(bs) != 1 or gs[0] != xs[-1]:
+        return "ineligible_shape"
+    return None
+
+
+def _ln_gate(key, bk):
+    xb = _bytes_of(key[0])
+    n = 1
+    for d in key[0][0]:
+        n *= int(d)
+    # composed LN re-reads x for the mean pass, the variance pass and
+    # the normalize/affine pass (pre-fusion accounting); the kernel
+    # streams each row block once
+    return _kreg.roofline_gate(5.0 * n, 2.0 * xb, 4.0 * xb, bk)
+
+
+def _ln_case(key):
+    (xs, xd), (gs, gd), (bs, bd) = key[:3]
+    return ((_rand(xs, xd, 0), _rand(gs, gd, 1), _rand(bs, bd, 2)), {})
+
+
+_kreg.register_kernel(
     "FusedLayerNorm",
-    lambda x, gamma, beta, eps=1e-6: layer_norm(x, gamma, beta, eps=eps))
-op_registry.register_pure(
+    impls={"pallas": layer_norm, "xla": layer_norm_reference},
+    legacy="pallas",
+    eligible=_ln_eligible,
+    cost_gate=_ln_gate,
+    make_case=_ln_case,
+    graph_key=lambda op: _simple_graph_key(op),
+    doc="one-pass fused layer norm vs composed mean/var/normalize")
+
+
+def _simple_graph_key(op, **statics):
+    avals = [_tensor_aval(t) for t in op.inputs]
+    if any(a is None for a in avals):
+        return None
+    return _kreg.aval_key(*[_Aval(*a) for a in avals], **statics)
+
+
+def _lower_fused_layer_norm(ctx, op, inputs):
+    x, gamma, beta = inputs
+    eps = float(op.attrs.get("eps", 1e-6))
+    fn = _kreg.select("FusedLayerNorm", _kreg.aval_key(x, gamma, beta))
+    return [fn(x, gamma, beta, eps=eps)]
+
+
+op_registry.register("FusedLayerNorm", lower=_lower_fused_layer_norm)
+
+
+# ---------------------------------------------------------------------------
+# FusedSoftmaxXent: streamed online-softmax xent vs composed log_softmax
+# ---------------------------------------------------------------------------
+
+def _xent_eligible(key):
+    (ls, ld), (labs, labd) = key[:2]
+    if not _is_float(ld) or _np_of(labd).kind not in "iu":
+        return "ineligible_dtype"
+    if len(ls) < 1 or len(labs) != len(ls) - 1:
+        return "ineligible_shape"
+    return None
+
+
+def _xent_gate(key, bk):
+    lb = _bytes_of(key[0])
+    n = 1
+    for d in key[0][0]:
+        n *= int(d)
+    # composed materializes log_softmax at [rows, vocab] f32 (plus the
+    # max/sum passes); the kernel streams each row's vocab blocks once
+    return _kreg.roofline_gate(5.0 * n, 1.2 * lb, 3.0 * lb, bk)
+
+
+def _xent_case(key):
+    (ls, ld), (labs, labd) = key[:2]
+    statics = dict(key[2:])
+    logits = _rand(ls, ld, 0)
+    labels = np.random.RandomState(1).randint(
+        0, ls[-1], size=labs).astype(_np_of(labd))
+    return ((logits, labels),
+            {"label_smoothing": 0.1 if statics.get("label_smoothing")
+             else 0.0})
+
+
+_kreg.register_kernel(
     "FusedSoftmaxXent",
-    lambda logits, labels, label_smoothing=0.0: softmax_cross_entropy(
-        logits, labels, label_smoothing=label_smoothing))
-op_registry.register_pure(
+    impls={"pallas": softmax_cross_entropy,
+           "xla": softmax_cross_entropy_reference},
+    legacy="pallas",
+    eligible=_xent_eligible,
+    cost_gate=_xent_gate,
+    make_case=_xent_case,
+    graph_key=lambda op: _simple_graph_key(op),
+    doc="streamed sparse softmax-xent vs composed log_softmax + gather")
+
+
+def _lower_fused_xent(ctx, op, inputs):
+    logits, labels = inputs
+    sm = float(op.attrs.get("label_smoothing", 0.0))
+    fn = _kreg.select(
+        "FusedSoftmaxXent",
+        _kreg.aval_key(logits, labels, label_smoothing=sm > 0.0))
+    return [fn(logits, labels, label_smoothing=sm)]
+
+
+op_registry.register("FusedSoftmaxXent", lower=_lower_fused_xent)
+
+
+# ---------------------------------------------------------------------------
+# QuantMatMul: native int8 MXU kernel vs int32 jnp dot
+# ---------------------------------------------------------------------------
+
+def _qmm_eligible(key):
+    (xs, xd), (ws, wd), (ss, _sd) = key[:3]
+    if not _is_float(xd) or str(wd) != "int8":
+        return "ineligible_dtype"
+    if len(xs) != 2 or len(ws) != 2 or len(ss) != 1:
+        return "ineligible_shape"
+    return None
+
+
+def _qmm_gate(key, bk):
+    if bk != "tpu":
+        return ("xla", "interpret_backend")
+    # the MXU multiplies int8 natively at 2x the bf16 rate; XLA lowers
+    # the int32 jnp.dot off that fast path — the kernel wins whenever
+    # the matmul is big enough to be MXU-bound at all
+    (xs, _), (ws, _), _ = key[:3]
+    m, k = int(xs[0]), int(xs[1])
+    n = int(ws[1])
+    if 2.0 * m * k * n >= 1e8:
+        return ("pallas", "cost_model")
+    return (None, "cost_model_uncertain")
+
+
+def _qmm_case(key):
+    (xs, xd), (ws, wd), (ss, sd) = key[:3]
+    rng = np.random.RandomState(0)
+    x = rng.randn(*xs).astype(_np_of(xd))
+    wq = rng.randint(-127, 128, size=ws).astype(np.int8)
+    scale = (rng.rand(*ss).astype(np.float32) * 0.1 + 0.01)
+    return ((x, wq, scale), {})
+
+
+_kreg.register_kernel(
     "QuantMatMul",
-    lambda x, wq, w_scale: quant_matmul_ste(x, wq, w_scale))
+    impls={"pallas": quant_matmul_ste, "xla": quant_matmul_ste_reference},
+    legacy="pallas",
+    eligible=_qmm_eligible,
+    cost_gate=_qmm_gate,
+    make_case=_qmm_case,
+    graph_key=lambda op: _simple_graph_key(op),
+    doc="int8 MXU quantized matmul (straight-through vjp) vs int32 dot")
+
+
+def _lower_quant_matmul(ctx, op, inputs):
+    x, wq, w_scale = inputs
+    fn = _kreg.select("QuantMatMul", _kreg.aval_key(x, wq, w_scale))
+    return [fn(x, wq, w_scale)]
+
+
+op_registry.register("QuantMatMul", lower=_lower_quant_matmul)
+
+
+# ---------------------------------------------------------------------------
+# FusedDropoutBiasResidual: blocked elementwise kernel vs fused XLA chain.
+# XLA fuses a pure elementwise chain into one pass itself, so the static
+# gate prefers the composed lowering; the kernel is there for ``force``
+# (testability) and for measured wins via the autotune cache.
+# ---------------------------------------------------------------------------
+
+def _dbr_eligible(key):
+    (xs, xd), (rs, _rd), bias = key[:3]
+    if not _is_float(xd):
+        return "ineligible_dtype"
+    if tuple(xs) != tuple(rs) or len(xs) < 1:
+        return "ineligible_shape"
+    if bias is not None and (len(bias[0]) != 1 or bias[0][0] != xs[-1]):
+        return "ineligible_shape"
+    return None
+
+
+def _dbr_gate(key, bk):
+    if bk != "tpu":
+        return ("xla", "interpret_backend")
+    # elementwise: both lowerings are one HBM pass (XLA fuses the
+    # composed chain); nothing for the kernel to win statically
+    return ("xla", "cost_model")
+
+
+def _dbr_case(key):
+    (xs, xd), (rs, rd), bias = key[:3]
+    statics = dict(key[3:])
+    args = [_rand(xs, xd, 0), _rand(rs, rd, 1)]
+    kw = {"rate": float(statics.get("rate", 0.1)),
+          "seed": np.asarray([5], np.int32)}
+    if bias is not None:
+        kw["bias"] = _rand(bias[0], bias[1], 2)
+    return tuple(args), kw
+
+
+def _dbr_pallas(x, residual, bias=None, *, rate, seed):
+    return dropout_bias_residual(x, residual, bias, rate=rate, seed=seed)
+
+
+def _dbr_xla(x, residual, bias=None, *, rate, seed):
+    return dropout_bias_residual_reference(x, residual, bias, rate=rate,
+                                           seed=seed)
+
+
+_kreg.register_kernel(
+    "FusedDropoutBiasResidual",
+    impls={"pallas": _dbr_pallas, "xla": _dbr_xla},
+    legacy="xla",
+    eligible=_dbr_eligible,
+    cost_gate=_dbr_gate,
+    make_case=_dbr_case,
+    graph_key=lambda op: _dbr_graph_key(op),
+    doc="fused residual + dropout(x + bias) vs composed elementwise "
+        "chain (identical counter-based mask)")
+
+
+def _dbr_graph_key(op):
+    avals = [_tensor_aval(t) for t in op.inputs]
+    if len(avals) < 2 or any(a is None for a in avals):
+        return None
+    bias = avals[2] if len(avals) > 2 else None
+    return _kreg.aval_key(_Aval(*avals[0]), _Aval(*avals[1]),
+                          _Aval(*bias) if bias is not None else None,
+                          rate=float(op.attrs.get("rate", 0.0)))
+
+
+def _lower_dropout_bias_residual(ctx, op, inputs):
+    import jax
+    import jax.numpy as jnp
+
+    x, residual = inputs[:2]
+    bias = inputs[2] if len(inputs) > 2 else None
+    rate = float(op.attrs["rate"])
+    key = ctx.rng_for(op)
+    seed = jax.random.randint(key, (1,), 0, jnp.iinfo(jnp.int32).max,
+                              dtype=jnp.int32)
+    fn = _kreg.select(
+        "FusedDropoutBiasResidual",
+        _kreg.aval_key(x, residual, bias, rate=rate))
+    return [fn(x, residual, bias, rate=rate, seed=seed)]
+
+
+op_registry.register("FusedDropoutBiasResidual",
+                     lower=_lower_dropout_bias_residual,
+                     effects=op_registry.Effects(rng=True))
+
+
+# ---------------------------------------------------------------------------
+# Fused optimizer updates: the flat-group math pairs. The graph ops
+# (FusedAdamUpdate / FusedMomentumUpdate) are registered by
+# train/optimizers.py, which owns their variable semantics; it routes
+# each flat group through these registry entries.
+# ---------------------------------------------------------------------------
+
+def _flat_gate(key, bk):
+    if bk != "tpu":
+        return ("xla", "interpret_backend")
+    n = int(dict(key).get("n", 0))
+    # one guaranteed pass over the g/m/v/p streams; below ~1M elements
+    # launch overhead and XLA's own fusion make it a wash — measure
+    if n >= (1 << 20):
+        return ("pallas", "cost_model")
+    return (None, "cost_model_uncertain")
+
+
+def _adam_case(key):
+    st = dict(key)
+    n = int(st["n"])
+    pdt, udt = st["pdt"], st["udt"]
+    rng = np.random.RandomState(0)
+    p = rng.randn(n).astype(_np_of(pdt))
+    m = rng.randn(n).astype(_np_of(udt)) * 0.01
+    v = np.abs(rng.randn(n)).astype(_np_of(udt)) * 0.01
+    g = rng.randn(n).astype(_np_of(udt))
+    alpha = np.asarray(0.001, _np_of(udt))
+    return ((p, m, v, g, alpha),
+            {"beta1": 0.9, "beta2": 0.999, "eps": 1e-8})
+
+
+def _momentum_case(key):
+    st = dict(key)
+    n = int(st["n"])
+    pdt, udt = st["pdt"], st["udt"]
+    rng = np.random.RandomState(0)
+    p = rng.randn(n).astype(_np_of(pdt))
+    acc = rng.randn(n).astype(_np_of(udt)) * 0.01
+    g = rng.randn(n).astype(_np_of(udt))
+    lr = np.asarray(0.01, _np_of(udt))
+    mu = np.asarray(0.9, _np_of(udt))
+    return ((p, acc, g, lr, mu), {"use_nesterov": False})
+
+
+_kreg.register_kernel(
+    "FusedAdamUpdate",
+    impls={"pallas": adam_update, "xla": adam_update_reference},
+    legacy="xla",
+    cost_gate=_flat_gate,
+    make_case=_adam_case,
+    graph_key=lambda op: _opt_graph_key(op),
+    doc="one flat m/v/param Adam update per dtype group vs the fused "
+        "XLA closure")
+_kreg.register_kernel(
+    "FusedMomentumUpdate",
+    impls={"pallas": momentum_update, "xla": momentum_update_reference},
+    legacy="xla",
+    cost_gate=_flat_gate,
+    make_case=_momentum_case,
+    graph_key=lambda op: _opt_graph_key(op),
+    doc="one flat accumulator/param Momentum update per dtype group vs "
+        "the fused XLA closure")
+
+
+def _opt_graph_key(op):
+    n = 0
+    for t in op.inputs:
+        a = _tensor_aval(t)
+        if a is None:
+            return None
+        sz = 1
+        for d in a[0]:
+            sz *= d
+        n += sz
+    return _kreg.aval_key(n=int(n), pdt="float32", udt="float32")
+
+
+def flat_group_key(n, pdt, udt):
+    """Decision key for one flattened optimizer parameter group."""
+    return _kreg.aval_key(n=int(n), pdt=str(pdt), udt=str(udt))

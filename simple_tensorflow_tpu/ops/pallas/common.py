@@ -39,3 +39,41 @@ def pad_dim(x, dim: int, target: int, value=0.0):
 
 
 NEG_INF = -1e30  # finite "minus infinity" — avoids NaN from (-inf) - (-inf)
+
+
+def mix32(h):
+    """murmur3 finalizer: avalanche a uint32 value (vectorized)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def keep_threshold(keep_prob: float):
+    """uint32 compare threshold for a counter-based keep mask."""
+    return jnp.uint32(min(int(keep_prob * 4294967296.0), 4294967295))
+
+
+def counter_keep_mask(seed, salt, rows, cols, keep_prob):
+    """Deterministic dropout keep-mask from GLOBAL (row, col) indices.
+
+    Counter-based: hash(seed, salt, row, col) — the mask is a pure
+    function of positions, so a blocked Pallas kernel and a composed
+    XLA lowering regenerate it bit-identically from the same seed (the
+    kernel-registry swap contract), and backward passes replay it
+    without materializing anything in HBM. Plain uint32 arithmetic (not
+    pltpu.prng_*) so interpret mode runs the identical code path.
+
+    seed/salt: uint32-castable scalars; rows/cols: broadcastable uint32
+    index arrays.
+    """
+    # every term stays uint32 explicitly: mixing in an int32 scalar would
+    # silently promote-then-clamp the whole chain back to int32 (x64 off),
+    # and an int32 < uint32 compare wraps the threshold negative.
+    h0 = mix32(seed.astype(jnp.uint32)
+               ^ (salt.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)))
+    h = mix32(h0 ^ rows.astype(jnp.uint32))
+    h = mix32(h ^ cols.astype(jnp.uint32))
+    return h.astype(jnp.uint32) < keep_threshold(keep_prob)

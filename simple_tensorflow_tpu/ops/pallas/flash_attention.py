@@ -44,7 +44,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .common import NEG_INF, cdiv, pad_dim, round_up, use_interpret
+from .common import (NEG_INF, cdiv, counter_keep_mask, mix32, pad_dim,
+                     round_up, use_interpret)
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -74,38 +75,24 @@ def _score_mask(s, qi, kb, block_q, block_k, kv_true, causal):
     return jnp.where(mask, s, NEG_INF)
 
 
-def _mix32(h):
-    """murmur3 finalizer: avalanche a uint32 value (vectorized)."""
-    h = h ^ (h >> 16)
-    h = h * jnp.uint32(0x85EBCA6B)
-    h = h ^ (h >> 13)
-    h = h * jnp.uint32(0xC2B2AE35)
-    h = h ^ (h >> 16)
-    return h
+_mix32 = mix32  # moved to common.py (shared with the fused dropout kernel)
 
 
 def _keep_mask(seed, bh, qi, kb, block_q, block_k, keep_prob):
     """Deterministic dropout keep-mask for score tile (qi, kb) of head bh.
 
-    Counter-based: hash(seed, bh, global_row, global_col) — regenerated
-    bit-identically in the backward kernels regardless of grid order, so
-    no mask tensor is ever materialized in HBM. Plain uint32 arithmetic
-    (not pltpu.prng_*) so interpret mode (the CPU test mesh) runs the
-    same code path as the Mosaic compile."""
+    Counter-based on GLOBAL (row, col) score indices (common.py
+    counter_keep_mask) — regenerated bit-identically in the backward
+    kernels regardless of grid order AND by the composed-XLA fallback
+    lowering (attention_xla), so swapping implementations through the
+    kernel registry preserves seeded runs exactly. No mask tensor is
+    ever materialized in HBM."""
     shape = (block_q, block_k)
-    # every term stays uint32 explicitly: mixing in an int32 scalar would
-    # silently promote-then-clamp the whole chain back to int32 (x64 off),
-    # and an int32 < uint32 compare wraps the threshold negative.
     rows = (qi.astype(jnp.uint32) * jnp.uint32(block_q) +
             jax.lax.broadcasted_iota(jnp.uint32, shape, 0))
     cols = (kb.astype(jnp.uint32) * jnp.uint32(block_k) +
             jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
-    h0 = _mix32(seed.astype(jnp.uint32) ^
-                (bh.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)))
-    h = _mix32(h0 ^ rows)
-    h = _mix32(h ^ cols)
-    threshold = jnp.uint32(min(int(keep_prob * 4294967296.0), 4294967295))
-    return h.astype(jnp.uint32) < threshold
+    return counter_keep_mask(seed, bh, rows, cols, keep_prob)
 
 
 # ---------------------------------------------------------------------------
@@ -566,6 +553,67 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None, bias=None,
         return o, lse
     o = _flash_bhsd(*args)
     o = o[:, :q_len, :d].reshape(b, h, q_len, d)
+    return o
+
+
+def attention_xla(q, k, v, *, causal=False, sm_scale=None, bias=None,
+                  dropout_rate=0.0, dropout_seed=None, return_lse=False,
+                  block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """The stock composed-XLA lowering of the FlashAttention op contract
+    (batch_matmul → softmax → batch_matmul, the reference's attention
+    path; ref core/kernels/{batch_matmul_op,softmax_op}.cc) — the
+    registry's fallback when the Pallas kernel is ineligible or the
+    cost model/autotune prices the fused kernel slower (tiny shapes;
+    every shape off-TPU, where Pallas runs in interpret mode).
+
+    Call-compatible with :func:`flash_attention` including in-kernel
+    probability dropout: the keep mask is the same counter-based hash
+    of (head, row, col) positions, so a seeded run is bit-identically
+    reproducible whichever implementation the registry picks. The
+    score matrix IS materialized ((B, H, Sq, Sk) f32) — that HBM
+    traffic is exactly what the cost-model gate prices against the
+    streamed kernel. ``bias`` additionally accepts any
+    attention-broadcastable shape (per-head/per-query biases the fused
+    kernel rejects)."""
+    b, h, q_len, d = q.shape
+    kv_len = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if causal and q_len != kv_len:
+        raise ValueError("causal attention needs q_len == kv_len")
+    if dropout_rate < 0.0 or dropout_rate >= 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1): {dropout_rate}")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("attention dropout needs dropout_seed")
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32), precision=_HI) * sm_scale
+    if bias is not None:
+        bb = jax.lax.stop_gradient(jnp.asarray(bias, jnp.float32))
+        if bb.ndim == 2:                       # (batch, kv_seq) key bias
+            bb = bb[:, None, None, :]
+        s = s + bb
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+        s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)          # (b, h, q) f32
+    p = jnp.exp(s - lse[..., None])
+    if dropout_rate > 0.0:
+        keep_prob = 1.0 - dropout_rate
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape((-1,))[0]
+        bh = jax.lax.broadcasted_iota(jnp.uint32,
+                                      (b * h, q_len, kv_len), 0)
+        rr = jax.lax.broadcasted_iota(jnp.uint32,
+                                      (b * h, q_len, kv_len), 1)
+        cc = jax.lax.broadcasted_iota(jnp.uint32,
+                                      (b * h, q_len, kv_len), 2)
+        keep = counter_keep_mask(seed, bh, rr, cc,
+                                 keep_prob).reshape(b, h, q_len, kv_len)
+        p = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   precision=_HI).astype(q.dtype)
+    if return_lse:
+        return o, lse
     return o
 
 

@@ -163,3 +163,19 @@ def quant_matmul_reference(x, wq, w_scale, *, out_dtype=None):
     acc = jnp.dot(xq.astype(jnp.int32), wq.astype(jnp.int32))
     return (acc.astype(jnp.float32)
             * x_scale[:, None] * w_scale[None, :]).astype(out_dtype)
+
+
+@jax.custom_vjp
+def quant_matmul_ste_reference(x, wq, w_scale):
+    """The stock-XLA lowering of the QuantMatMul op contract: same
+    dynamic row quantization and int32 accumulation as the Pallas
+    kernel, as a plain jnp dot (XLA picks the layout), with the
+    IDENTICAL straight-through vjp — the kernel registry's fallback."""
+    return quant_matmul_reference(x, wq, w_scale)
+
+
+def _qmm_ref_fwd(x, wq, w_scale):
+    return quant_matmul_reference(x, wq, w_scale), (x, wq, w_scale)
+
+
+quant_matmul_ste_reference.defvjp(_qmm_ref_fwd, _qmm_ste_bwd)

@@ -53,6 +53,18 @@ def _merge(m1, l1, a1, m2, l2, a2):
     return m, l, a
 
 
+def _select_block_attention(q, k, v, *, causal):
+    """Registry-routed attention for one ring block (stf.kernels):
+    Pallas flash kernel or the composed-XLA lowering, decided per
+    (shard shape, dtype, backend) under the active mode."""
+    from ..kernels import registry as _kreg
+
+    return _kreg.select(
+        "FlashAttention",
+        _kreg.aval_key(q, k, v, None, causal=bool(causal), dropout=False,
+                       ring_block=True))
+
+
 def ring_attention_p(q, k, v, axis_name, *, causal=False, sm_scale=None,
                      use_flash=True):
     """Per-shard ring attention, for use inside ``shard_map`` where the
@@ -79,7 +91,12 @@ def ring_attention_p(q, k, v, axis_name, *, causal=False, sm_scale=None,
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     if use_flash:
-        from ..ops.pallas.flash_attention import flash_attention
+        # the per-block attention routes through the kernel registry
+        # exactly like the single-device FlashAttention op: the Pallas
+        # streamed kernel when gated in (TPU / force), the composed-XLA
+        # lowering otherwise — both merge through the returned lse
+        _attn_causal = _select_block_attention(q, k, v, causal=True)
+        _attn_full = _select_block_attention(q, k, v, causal=False)
 
         def step(carry, t):
             k_t, v_t, lse_acc, o_acc = carry
@@ -92,16 +109,16 @@ def ring_attention_p(q, k, v, axis_name, *, causal=False, sm_scale=None,
 
             def _diag(args):
                 qq, kk, vv = args
-                o2, lse2 = flash_attention(qq, kk, vv, causal=True,
-                                           sm_scale=sm_scale,
-                                           return_lse=True)
+                o2, lse2 = _attn_causal(qq, kk, vv, causal=True,
+                                        sm_scale=sm_scale,
+                                        return_lse=True)
                 return o2.astype(jnp.float32), lse2
 
             def _full(args):
                 qq, kk, vv = args
-                o2, lse2 = flash_attention(qq, kk, vv, causal=False,
-                                           sm_scale=sm_scale,
-                                           return_lse=True)
+                o2, lse2 = _attn_full(qq, kk, vv, causal=False,
+                                      sm_scale=sm_scale,
+                                      return_lse=True)
                 return o2.astype(jnp.float32), lse2
 
             if causal:
@@ -166,10 +183,16 @@ def _lower_ring_attention(ctx, op, inputs):
         return [ring_attention_p(q, k, v, axis, causal=causal,
                                  sm_scale=sm_scale)]
     if mesh is None or axis not in mesh.shape or mesh.axis_size(axis) == 1:
-        # No sequence axis to ring over: plain fused attention.
-        from ..ops.pallas.flash_attention import flash_attention
+        # No sequence axis to ring over: plain single-device attention,
+        # routed Pallas/XLA through the kernel registry like the
+        # FlashAttention op itself.
+        from ..kernels import registry as _kreg
 
-        return [flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)]
+        fn = _kreg.select(
+            "FlashAttention",
+            _kreg.aval_key(q, k, v, None, causal=bool(causal),
+                           dropout=False))
+        return [fn(q, k, v, causal=causal, sm_scale=sm_scale)]
 
     from jax.sharding import PartitionSpec as JP
 
@@ -184,6 +207,40 @@ def _lower_ring_attention(ctx, op, inputs):
 
 
 op_registry.register("RingAttention", lower=_lower_ring_attention)
+
+
+def _register_ring_kernel():
+    """Kernel-registry entry for RingAttention: the ring op's inner
+    per-block attention is what routes (see _select_block_attention),
+    but the offline routing report (graph_lint --kernels; the zoo force
+    gate) wants a per-op verdict for the graph node itself — priced and
+    gated exactly like FlashAttention on the (possibly sharded) block
+    shapes."""
+    from ..kernels import registry as _kreg
+    from ..ops import pallas as _p
+    from ..ops.pallas.flash_attention import attention_xla, flash_attention
+
+    def _graph_key(op):
+        avals = [_p._tensor_aval(t) for t in op.inputs[:3]]
+        if len(avals) < 3 or any(a is None for a in avals):
+            return None
+        return _kreg.aval_key(
+            *[_p._Aval(*a) for a in avals], None,
+            causal=bool(op.attrs.get("causal", False)), dropout=False)
+
+    _kreg.register_kernel(
+        "RingAttention",
+        impls={"pallas": flash_attention, "xla": attention_xla},
+        legacy="pallas",
+        eligible=_p._flash_eligible,
+        cost_gate=_p._flash_gate,
+        make_case=_p._flash_case,
+        graph_key=_graph_key,
+        doc="sequence-parallel ring attention; the per-block kernel "
+            "routes like FlashAttention")
+
+
+_register_ring_kernel()
 
 
 def ring_attention(q, k, v, *, axis="sp", causal=False, sm_scale=None,

@@ -107,6 +107,15 @@ def _statusz_info() -> Dict[str, Any]:
             except Exception:  # noqa: BLE001 — racing close()
                 continue
         info["serving"] = {"models": models}
+    kernels_mod = sys.modules.get(
+        "simple_tensorflow_tpu.kernels.registry")
+    if kernels_mod is not None:
+        try:
+            # kernel tier (stf.kernels): mode, per-op routed/fallback
+            # counters, autotune verdicts (docs/PERFORMANCE.md)
+            info["kernels"] = kernels_mod.snapshot()
+        except Exception as e:  # noqa: BLE001 — statusz is best-effort
+            info["kernels"] = {"error": str(e)}
     watchdog_mod = sys.modules.get(
         "simple_tensorflow_tpu.telemetry.watchdog")
     if watchdog_mod is not None:

@@ -3,6 +3,7 @@
     python -m simple_tensorflow_tpu.tools.graph_lint graphdef.json \
         [--fetch op_or_tensor ...] [--severity code=level ...] \
         [--level structural|full] [--json] [--serving] \
+        [--kernels [off|auto|force]] \
         [--mesh 8|2x4|dp=2,tp=4] [--rules rules.json] \
         [--max-severity note|warning|error]
 
@@ -37,6 +38,28 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def kernel_routing_summary(graph, mode=None):
+    """Aggregate per-op routing verdicts over a graph: {op_type:
+    {verdict_or_reason: count}} plus a ``no-kernel`` op-type count —
+    the ``graph_lint --kernels`` table (stf.kernels.routing_report)."""
+    from ..kernels import registry as kreg
+
+    table = {}
+    no_kernel = 0
+    for rec in kreg.routing_report(graph.get_operations(), mode=mode):
+        if rec["verdict"] == "no-kernel":
+            no_kernel += rec.get("count", 1)
+            continue
+        key = rec["verdict"]
+        if rec["verdict"] == "fallback" and rec.get("reason"):
+            key = f"fallback:{rec['reason']}"
+        per = table.setdefault(rec["type"], {})
+        per[key] = per.get(key, 0) + 1
+    return {"mode": mode or kreg.current_mode(),
+            "backend": kreg.backend(),
+            "by_op_type": table, "no_kernel_ops": no_kernel}
 
 
 def run_lint(graph_def: dict, fetch_names=None, severities=None,
@@ -116,6 +139,14 @@ def main(argv=None):
                          "[spec entries]], ...]; seeds variable "
                          "shardings for --mesh analysis "
                          "(match_partition_rules format)")
+    ap.add_argument("--kernels", nargs="?", const="auto", default=None,
+                    choices=["off", "auto", "force"], metavar="MODE",
+                    help="report per-op Pallas/XLA kernel-routing "
+                         "verdicts (stf.kernels) under MODE (default "
+                         "auto): activates the lint/kernel-routing "
+                         "rule and prints a per-op-type verdict "
+                         "summary (routed / fallback+reason / "
+                         "autotune / no-kernel)")
     ap.add_argument("--serving", action="store_true",
                     help="lint as an exported inference graph: activate "
                          "the serving-compatibility rules "
@@ -161,20 +192,43 @@ def main(argv=None):
 
     from .. import analysis
 
-    diags, _graph, report = run_lint(gd, fetch_names=args.fetch,
-                                     severities=severities,
-                                     level=args.level, mesh=mesh,
-                                     partition_rules=partition_rules,
-                                     purpose="serving" if args.serving
-                                     else None)
+    if args.kernels and args.serving:
+        ap.error("--kernels and --serving are separate lint purposes; "
+                 "run them as two invocations")
+    purpose = "serving" if args.serving else (
+        "kernels" if args.kernels else None)
+    from ..kernels import registry as _kreg
+
+    with _kreg.activate(args.kernels):
+        diags, _graph, report = run_lint(gd, fetch_names=args.fetch,
+                                         severities=severities,
+                                         level=args.level, mesh=mesh,
+                                         partition_rules=partition_rules,
+                                         purpose=purpose)
+        kernel_summary = None
+        if args.kernels and _graph is not None:
+            kernel_summary = kernel_routing_summary(_graph,
+                                                    mode=args.kernels)
     if args.json:
         for d in diags:
             print(json.dumps(d.to_dict()))
+        if kernel_summary is not None:
+            print(json.dumps({"kernel_routing": kernel_summary}))
         if report is not None:
             print(json.dumps({"summary": report.summary()}))
     else:
         print(analysis.format_report(
             diags, header=f"graph_lint {args.graphdef}:"))
+        if kernel_summary is not None:
+            print(f"kernel routing [{kernel_summary['mode']}/"
+                  f"{kernel_summary['backend']}]: "
+                  f"{kernel_summary['no_kernel_ops']} op(s) with no "
+                  "registered kernel")
+            for t, verdicts in sorted(
+                    kernel_summary["by_op_type"].items()):
+                row = ", ".join(f"{k}={v}"
+                                for k, v in sorted(verdicts.items()))
+                print(f"  {t}: {row}")
         if report is not None:
             s = report.summary()
             print(f"sharding: {s['n_collective_edges']} collective "

@@ -36,6 +36,14 @@ class Optimizer:
         self._use_locking = use_locking
         self._name = name
         self._slots = {}  # slot_name -> {var_name: slot Variable}
+        # fused-tail state (stf.kernels): slot_name -> {var_name: view
+        # Tensor} slices of the per-group FLAT slot variables, plus the
+        # flat variables themselves (saved/restored like any variable).
+        # Kept OUT of self._slots so variables()/get_slot_names() report
+        # each slot exactly once under its public name.
+        self._slot_views = {}
+        self._fused_slot_vars = []
+        self._flat_slot_cache = {}  # (slot, group sig) -> flat Variable
 
     @property
     def name(self):
@@ -77,17 +85,28 @@ class Optimizer:
             raise ValueError("No gradients provided for any variable")
         g = ops_mod.get_default_graph()
         with g.name_scope(name or self._name):
-            self._create_slots(var_list)
-            self._prepare()
-            update_ops = []
-            for grad, var in grads_and_vars:
-                if grad is None:
-                    continue
-                if isinstance(grad, IndexedSlices):
-                    update_ops.append(self._apply_sparse(grad, var))
-                else:
-                    update_ops.append(self._apply_dense(grad, var))
-            finish = self._finish(update_ops, "update")
+            # fused optimizer tail (stf.kernels; docs/PERFORMANCE.md):
+            # optimizers that support it collapse the per-variable
+            # update chains into ONE batched flattened-parameter update
+            # op over per-(dtype-group) FLAT slot variables — same math
+            # bit-for-bit, one op and O(groups) state arrays instead of
+            # N chains and 2N slot arrays. Returns None when fusion is
+            # off (kernel registry mode "off"), unsupported by the
+            # subclass, or inapplicable; the fused builder creates its
+            # own (flat) slots, the legacy path its per-variable ones.
+            finish = self._maybe_build_fused_update(grads_and_vars)
+            if finish is None:
+                self._create_slots(var_list)
+                self._prepare()
+                update_ops = []
+                for grad, var in grads_and_vars:
+                    if grad is None:
+                        continue
+                    if isinstance(grad, IndexedSlices):
+                        update_ops.append(self._apply_sparse(grad, var))
+                    else:
+                        update_ops.append(self._apply_dense(grad, var))
+                finish = self._finish(update_ops, "update")
             if global_step is not None:
                 with g.control_dependencies([finish]):
                     incr = state_ops.assign_add(
@@ -100,18 +119,25 @@ class Optimizer:
 
     # -- slots ---------------------------------------------------------------
     def get_slot(self, var, name):
+        view = self._slot_views.get(name, {}).get(_var_key(var))
+        if view is not None:
+            # fused tail: the slot lives inside a per-group flat
+            # variable; this is its per-variable view (a Tensor slice —
+            # same shape/dtype/values the per-variable slot would hold)
+            return view
         named = self._slots.get(name)
         if named is None:
             return None
         return named.get(_var_key(var))
 
     def get_slot_names(self):
-        return sorted(self._slots)
+        return sorted(set(self._slots) | set(self._slot_views))
 
     def variables(self):
         out = []
         for d in self._slots.values():
             out.extend(d.values())
+        out.extend(self._fused_slot_vars)
         return out
 
     def _slot_dict(self, slot_name):
@@ -143,6 +169,29 @@ class Optimizer:
         return named[key]
 
     # -- subclass hooks ------------------------------------------------------
+    def _maybe_build_fused_update(self, grads_and_vars):
+        """Build ONE fused update op covering every (grad, var) pair, or
+        return None to fall back to the per-variable _apply_dense loop.
+        Implemented by optimizers with a registered fused kernel
+        (Adam/Momentum, train/optimizers.py); must reproduce the
+        per-variable math bit-for-bit."""
+        return None
+
+    def _densified(self, grads_and_vars):
+        """(dense_grad, var) pairs with IndexedSlices densified exactly
+        like the default _apply_sparse (scatter into zeros) — the fused
+        path must see the same gradients the per-variable path would."""
+        pairs = []
+        for grad, var in grads_and_vars:
+            if grad is None:
+                continue
+            if isinstance(grad, IndexedSlices):
+                grad = array_ops.scatter_nd(
+                    array_ops.expand_dims(grad.indices, 1), grad.values,
+                    [int(d) for d in var.shape.as_list()])
+            pairs.append((grad, var))
+        return pairs
+
     def _create_slots(self, var_list):
         pass
 

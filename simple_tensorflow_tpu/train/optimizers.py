@@ -16,15 +16,256 @@ the effective step size; for f32 params every cast below is a no-op.
 from __future__ import annotations
 
 from ..framework import graph as ops_mod
+from ..framework import op_registry
 from ..ops import array_ops, control_flow_ops, math_ops, state_ops
 from ..ops import variables as variables_mod
-from .optimizer import Optimizer
+from .optimizer import Optimizer, _var_key
 from .slot_creator import update_dtype as _ud
 
 
 def _c(value, var):
     """Hyperparameter in the var's UPDATE dtype (f32 for bf16 params)."""
     return ops_mod.convert_to_tensor(value, dtype=_ud(var))
+
+
+# ---------------------------------------------------------------------------
+# Fused optimizer tail (stf.kernels; docs/PERFORMANCE.md "kernel tier").
+#
+# Every training step used to end with a TAIL of per-variable update
+# chains — for Adam, ~10 ops per variable (two slot assigns, the alpha
+# arithmetic, the param assign-sub) over 2N slot arrays. The fused path
+# collapses them into ONE graph op per optimizer whose optimizer state
+# lives FLAT: one (n_total,) slot variable per (param dtype, update
+# dtype) group, updated together with every group's flattened params in
+# a single batched pass — a Pallas kernel on TPU, one fused XLA closure
+# on CPU (the registry decides; ops/pallas/fused_update.py holds both).
+# Keeping m/v flat ACROSS steps is the perf point: the per-variable
+# layout would force a gather/scatter of every slot every step, and the
+# Session's state dict shrinks from O(3N) to O(N + groups) arrays —
+# which is most of the per-step tail cost at small-variable counts
+# (the bench kernel_tier row pins it).
+#
+# The flat slots are ordinary Variables (saved/restored by Saver,
+# initialized by global_variables_initializer); get_slot() returns
+# per-variable VIEW tensors slicing them, so introspection and tests
+# see the same shapes/values as the per-variable layout. The flat math
+# is kept op-for-op identical to the per-variable chains, so fused and
+# unfused trajectories are bit-exact (tests/test_kernel_registry.py).
+# Kill switch: kernel-registry mode "off" (STF_PALLAS=0) at
+# graph-construction time rebuilds the per-variable assigns exactly as
+# before (note: the checkpoint layout of optimizer slots differs
+# between modes — resume in the mode you saved in).
+# ---------------------------------------------------------------------------
+
+def _store_name(var):
+    """The variable's store name (the resource the Assign ops declare)."""
+    return var._ref.op.attrs["var_name"]
+
+
+def _fusion_wanted() -> bool:
+    from .. import kernels
+
+    return kernels.current_mode() != "off"
+
+
+def _static_float(*hypers):
+    """True when every hyper is a plain python number (foldable into
+    the fused kernel); a Tensor/callable hyper falls back per-var."""
+    return all(not isinstance(h, ops_mod.Tensor) and not callable(h)
+               for h in hypers)
+
+
+def _build_groups(pairs):
+    """Ordered {(param dtype, update dtype): [(grad, var), ...]} by
+    first occurrence — one flat slot set and one fused update per
+    group. Static: dtypes are graph-build-time knowledge."""
+    groups = {}
+    for grad, var in pairs:
+        key = (var.dtype.base_dtype, _ud(var))
+        groups.setdefault(key, []).append((grad, var))
+    return groups
+
+
+def _flat_slot_layout(self, slot_names, groups):
+    """Create (or reuse) the per-group flat slot variables and the
+    per-variable view tensors. Returns {slot_name: [flat var names in
+    group order]} plus per-group (param names, sizes, shapes)."""
+    layout = {sn: [] for sn in slot_names}
+    group_params = []
+    for gi, ((pdt, ud), pairs) in enumerate(groups.items()):
+        sizes = [int(np_prod(v.shape.as_list())) for _, v in pairs]
+        n = sum(sizes)
+        for sn in slot_names:
+            cache = self._flat_slot_cache
+            ck = (sn, gi, n, ud.name,
+                  tuple(_var_key(v) for _, v in pairs))
+            flat = cache.get(ck)
+            if flat is None:
+                flat = variables_mod.Variable(
+                    array_ops.zeros([n], dtype=ud), trainable=False,
+                    name=f"{self._name}/fused_{sn}_g{gi}")
+                cache[ck] = flat
+                self._fused_slot_vars.append(flat)
+                # per-variable views: same shape/dtype/values the
+                # per-variable slot would hold (sliced on read)
+                off = 0
+                views = self._slot_views.setdefault(sn, {})
+                for (_, v), sz in zip(pairs, sizes):
+                    view = array_ops.reshape(
+                        array_ops.slice(flat._ref, [off], [sz]),
+                        [int(d) for d in v.shape.as_list()])
+                    views[_var_key(v)] = view
+                    off += sz
+            layout[sn].append(_store_name(flat))
+        group_params.append(tuple(_store_name(v) for _, v in pairs))
+    return layout, group_params
+
+
+def np_prod(xs):
+    n = 1
+    for x in xs:
+        n *= int(x)
+    return n
+
+
+def _fused_hypers(groups, *values):
+    """Per-group hyper tensors, converted exactly like the per-variable
+    ``_c`` would (python floats convert directly, tensors cast) — one
+    input per (hyper, group)."""
+    out = []
+    for value in values:
+        for (_pdt, ud) in groups:
+            out.append(ops_mod.convert_to_tensor(value, dtype=ud))
+    return out
+
+
+def _concat_flat(vals):
+    import jax.numpy as jnp
+
+    flats = [v.reshape(-1) for v in vals]
+    return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+
+
+def _group_lowering_io(ctx, op, gi, grads, grad_offsets):
+    """Read one group's params + grads from the lowering state:
+    returns (param names, param values, shapes, offsets, g_flat)."""
+    import jax.numpy as jnp  # noqa: F401
+
+    pnames = op.attrs["group_params"][gi]
+    udt = op.attrs["group_ud"][gi]
+    pvals = [ctx.read_var(p, op) for p in pnames]
+    shapes = [p.shape for p in pvals]
+    offsets = []
+    off = 0
+    for p in pvals:
+        offsets.append((off, off + p.size))
+        off += p.size
+    lo, hi = grad_offsets[gi]
+    gs = grads[lo:hi]
+    g_flat = _concat_flat([g.astype(udt) if str(g.dtype) != udt else g
+                           for g in gs])
+    return pnames, pvals, shapes, offsets, g_flat
+
+
+def _grad_offsets(op):
+    counts = [len(p) for p in op.attrs["group_params"]]
+    offs = []
+    lo = 0
+    for c in counts:
+        offs.append((lo, lo + c))
+        lo += c
+    return offs
+
+
+def _split_write_params(ctx, flat, names, shapes, offsets):
+    for name, shape, (lo, hi) in zip(names, shapes, offsets):
+        ctx.write_var(name, flat[lo:hi].reshape(shape))
+
+
+def _lower_fused_adam(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    from ..kernels import registry as _kreg
+    from ..ops.pallas import flat_group_key
+
+    attrs = op.attrs
+    n_groups = len(attrs["group_params"])
+    lrs = inputs[:n_groups]
+    grads = inputs[n_groups:]
+    beta1, beta2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    b1p = ctx.read_var(attrs["beta1_power"], op)
+    b2p = ctx.read_var(attrs["beta2_power"], op)
+    offs = _grad_offsets(op)
+    for gi in range(n_groups):
+        udt = attrs["group_ud"][gi]
+        # the alpha arithmetic is the per-variable chain verbatim:
+        # cast the CURRENT beta powers to the update dtype, then
+        # lr * sqrt(1 - b2p) / (1 - b1p)
+        b1p_c = b1p.astype(udt)
+        b2p_c = b2p.astype(udt)
+        alpha = lrs[gi] * jnp.sqrt(1 - b2p_c) / (1 - b1p_c)
+        pnames, pvals, shapes, offsets, g_flat = _group_lowering_io(
+            ctx, op, gi, grads, offs)
+        p_flat = _concat_flat(pvals)
+        m_name = attrs["group_m"][gi]
+        v_name = attrs["group_v"][gi]
+        m_flat = ctx.read_var(m_name, op)
+        v_flat = ctx.read_var(v_name, op)
+        fn = _kreg.select(
+            "FusedAdamUpdate",
+            flat_group_key(p_flat.size, str(p_flat.dtype), udt))
+        new_p, new_m, new_v = fn(p_flat, m_flat, v_flat, g_flat, alpha,
+                                 beta1=beta1, beta2=beta2, eps=eps)
+        ctx.write_var(m_name, new_m)
+        ctx.write_var(v_name, new_v)
+        _split_write_params(ctx, new_p, pnames, shapes, offsets)
+    # beta-power decay, exactly as AdamOptimizer._finish orders it:
+    # after every group's update, from the pre-update power values
+    ctx.write_var(attrs["beta1_power"],
+                  b1p * jnp.asarray(beta1, b1p.dtype))
+    ctx.write_var(attrs["beta2_power"],
+                  b2p * jnp.asarray(beta2, b2p.dtype))
+    return []
+
+
+op_registry.register(
+    "FusedAdamUpdate", lower=_lower_fused_adam, n_outputs=0,
+    effects=op_registry.Effects(reads=("var_name",),
+                                writes=("var_name",)))
+
+
+def _lower_fused_momentum(ctx, op, inputs):
+    from ..kernels import registry as _kreg
+    from ..ops.pallas import flat_group_key
+
+    attrs = op.attrs
+    n_groups = len(attrs["group_params"])
+    lrs = inputs[:n_groups]
+    mus = inputs[n_groups:2 * n_groups]
+    grads = inputs[2 * n_groups:]
+    nesterov = bool(attrs.get("use_nesterov", False))
+    offs = _grad_offsets(op)
+    for gi in range(n_groups):
+        udt = attrs["group_ud"][gi]
+        pnames, pvals, shapes, offsets, g_flat = _group_lowering_io(
+            ctx, op, gi, grads, offs)
+        p_flat = _concat_flat(pvals)
+        a_name = attrs["group_momentum"][gi]
+        a_flat = ctx.read_var(a_name, op)
+        fn = _kreg.select(
+            "FusedMomentumUpdate",
+            flat_group_key(p_flat.size, str(p_flat.dtype), udt))
+        new_p, new_a = fn(p_flat, a_flat, g_flat, lrs[gi], mus[gi],
+                          use_nesterov=nesterov)
+        ctx.write_var(a_name, new_a)
+        _split_write_params(ctx, new_p, pnames, shapes, offsets)
+    return []
+
+
+op_registry.register(
+    "FusedMomentumUpdate", lower=_lower_fused_momentum, n_outputs=0,
+    effects=op_registry.Effects(reads=("var_name",),
+                                writes=("var_name",)))
 
 
 def _g(grad, var):
@@ -92,6 +333,32 @@ class MomentumOptimizer(Optimizer):
             update = lr * new_acc
         return state_ops.assign_sub(var._ref, _back(update, var)).op
 
+    def _maybe_build_fused_update(self, grads_and_vars):
+        if not _fusion_wanted() \
+                or type(self)._apply_dense is not MomentumOptimizer._apply_dense:
+            return None
+        pairs = self._densified(grads_and_vars)
+        if not pairs:
+            return None
+        groups = _build_groups(pairs)
+        layout, group_params = _flat_slot_layout(self, ("momentum",),
+                                                 groups)
+        lr_val = self._call_if_callable(self._learning_rate)
+        mu_val = self._call_if_callable(self._momentum)
+        inputs = (_fused_hypers(groups, lr_val, mu_val)
+                  + [g for pairs_g in groups.values()
+                     for g, _ in pairs_g])
+        all_params = [p for grp in group_params for p in grp]
+        g = ops_mod.get_default_graph()
+        return g.create_op(
+            "FusedMomentumUpdate", inputs,
+            attrs={"var_name": all_params + layout["momentum"],
+                   "group_params": tuple(group_params),
+                   "group_momentum": tuple(layout["momentum"]),
+                   "group_ud": tuple(ud.name for (_p, ud) in groups),
+                   "use_nesterov": bool(self._use_nesterov)},
+            name="fused_momentum_update", output_specs=[])
+
 
 class AdamOptimizer(Optimizer):
     """(ref: python/training/adam.py; kernel core/kernels/training_ops.cc
@@ -147,6 +414,46 @@ class AdamOptimizer(Optimizer):
                                      _c(self._beta2, self._beta2_power)).op
         return control_flow_ops.group(*(update_ops + [b1_up, b2_up]),
                                       name=name_scope)
+
+    def _maybe_build_fused_update(self, grads_and_vars):
+        if not _fusion_wanted() \
+                or type(self)._apply_dense is not AdamOptimizer._apply_dense:
+            return None
+        if not _static_float(self._beta1, self._beta2, self._epsilon):
+            return None
+        pairs = self._densified(grads_and_vars)
+        if not pairs:
+            return None
+        # beta-power variables exactly as _create_slots makes them
+        if self._beta1_power is None:
+            self._beta1_power = variables_mod.Variable(
+                float(self._beta1), trainable=False,
+                name=self._name + "/beta1_power")
+            self._beta2_power = variables_mod.Variable(
+                float(self._beta2), trainable=False,
+                name=self._name + "/beta2_power")
+        groups = _build_groups(pairs)
+        layout, group_params = _flat_slot_layout(self, ("m", "v"), groups)
+        lr_val = self._call_if_callable(self._lr)
+        inputs = (_fused_hypers(groups, lr_val)
+                  + [g for pairs_g in groups.values()
+                     for g, _ in pairs_g])
+        all_params = [p for grp in group_params for p in grp]
+        b1p = _store_name(self._beta1_power)
+        b2p = _store_name(self._beta2_power)
+        g = ops_mod.get_default_graph()
+        return g.create_op(
+            "FusedAdamUpdate", inputs,
+            attrs={"var_name": (all_params + layout["m"] + layout["v"]
+                                + [b1p, b2p]),
+                   "group_params": tuple(group_params),
+                   "group_m": tuple(layout["m"]),
+                   "group_v": tuple(layout["v"]),
+                   "group_ud": tuple(ud.name for (_p, ud) in groups),
+                   "beta1_power": b1p, "beta2_power": b2p,
+                   "beta1": float(self._beta1), "beta2": float(self._beta2),
+                   "epsilon": float(self._epsilon)},
+            name="fused_adam_update", output_specs=[])
 
 
 class AdagradOptimizer(Optimizer):

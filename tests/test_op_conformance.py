@@ -738,7 +738,12 @@ COVERED_ELSEWHERE = {
     "FlipUpDown": ("test_image_linalg_sparse.py", "flip_up_down"),
     "Foldl": ("test_control_flow.py", "foldl"),
     "FusedBatchNorm": ("test_cost_model.py", "FusedBatchNorm"),
+    "FusedAdamUpdate": ("test_kernel_registry.py", "FusedAdamUpdate"),
+    "FusedDropoutBiasResidual": ("test_kernel_registry.py",
+                                 "FusedDropoutBiasResidual"),
     "FusedLayerNorm": ("test_pallas_kernels.py", "FusedLayerNorm"),
+    "FusedMomentumUpdate": ("test_kernel_registry.py",
+                            "FusedMomentumUpdate"),
     "FusedSoftmaxXent": ("test_pallas_kernels.py", "FusedSoftmaxXent"),
     "GetSessionHandle": ("test_session_handles.py", "get_session_handle"),
     "GetSessionTensor": ("test_session_handles.py", "get_session_tensor"),
