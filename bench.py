@@ -1856,6 +1856,121 @@ def _measure_transformer(batch, platform, device_kind):
     return result
 
 
+def _measure_generative(platform, device_kind):
+    """ISSUE 12: generative inference engine. Cached (KV-cache
+    incremental) vs naive re-forward beam search at IDENTICAL token
+    output — tokens/sec and p50 per-token latency — plus batch-fill
+    fraction under open-loop join/leave churn through the token-level
+    continuous-batching engine. Acceptance: >=5x tokens/sec on the CPU
+    bench config with int-exact ids; churn fill >= 0.8."""
+    import statistics
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import serving
+    from simple_tensorflow_tpu.models import transformer
+    from simple_tensorflow_tpu.platform import monitoring
+
+    # big enough that compute (not dispatch) dominates, small enough to
+    # finish on the CPU bench box
+    cfg = transformer.TransformerConfig(
+        vocab_size=512, d_model=128, num_heads=4, d_ff=256,
+        num_layers=2, dropout=0.0, max_len=64)
+    b, k = 4, 4
+    L = int(os.environ.get("BENCH_GEN_DECODE_LEN", "32"))
+    src_len = 16
+    reps = int(os.environ.get("BENCH_GEN_REPS", "3"))
+
+    stf.reset_default_graph()
+    stf.set_random_seed(0)
+    src_ph = stf.placeholder(stf.int32, [b, src_len], "gen_src")
+    ids_n, sc_n = transformer.beam_search_decode(
+        src_ph, cfg=cfg, beam_size=k, decode_len=L,
+        compute_dtype=stf.float32)
+    ids_c, sc_c = transformer.beam_search_decode(
+        src_ph, cfg=cfg, beam_size=k, decode_len=L,
+        compute_dtype=stf.float32, use_cache=True)
+    batch = transformer.synthetic_wmt_batch(b, src_len, src_len,
+                                            vocab_size=cfg.vocab_size)
+    feed = {src_ph: batch["src_ids"]}
+    sess = stf.Session()
+    sess.run(stf.global_variables_initializer())
+    # warm the EXACT fetch signatures of the timed loops
+    naive_ids, _ = sess.run([ids_n, sc_n], feed)
+    cached_ids, cached_sc = sess.run([ids_c, sc_c], feed)
+    ids_identical = bool(np.array_equal(np.asarray(naive_ids),
+                                        np.asarray(cached_ids)))
+
+    naive_t, cached_t = [], []
+    for _ in range(reps):  # interleaved: same thermal/cache conditions
+        t0 = time.perf_counter()
+        sess.run([ids_n, sc_n], feed)
+        naive_t.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sess.run([ids_c, sc_c], feed)
+        cached_t.append(time.perf_counter() - t0)
+    naive_s = statistics.median(naive_t)
+    cached_s = statistics.median(cached_t)
+    tokens = b * (L - 1)
+    naive_tps = tokens / naive_s
+    cached_tps = tokens / cached_s
+    speedup = cached_tps / max(naive_tps, 1e-9)
+    sess.close()
+
+    # open-loop join/leave churn through the serving engine: a backlog
+    # of short sequences with staggered budgets so slots retire and
+    # refill continuously
+    slots = 8
+    eng_name = "bench_generative"
+    model = transformer.TransformerGenerativeModel(
+        cfg, src_len, num_slots=slots, max_decode_len=L,
+        init_fresh=True, aot_warmup=True)
+    policy = serving.DecodePolicy(num_slots=slots, max_decode_len=L,
+                                  max_new_tokens=L - 1)
+    n_reqs = int(os.environ.get("BENCH_GEN_CHURN_REQS", "32"))
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(2, cfg.vocab_size,
+                          (n_reqs, src_len)).astype(np.int32)
+    budgets = [4 + (i * 7) % (L - 4) for i in range(n_reqs)]
+    engine = serving.GenerativeEngine(eng_name, model, policy)
+    t0 = time.perf_counter()
+    futs = [engine.generate(prompts[i], max_new_tokens=budgets[i])
+            for i in range(n_reqs)]
+    results = [f.result(timeout=600) for f in futs]
+    churn_wall = time.perf_counter() - t0
+    churn_tokens = sum(len(r["tokens"]) for r in results)
+    engine.close()
+    fill_cells = monitoring.export().get(
+        "/stf/serving/decode_fill", {}).get("cells", {})
+    fc = fill_cells.get(eng_name, {})
+    fill = (fc.get("sum", 0.0) / fc.get("count", 1)
+            if fc.get("count") else 0.0)
+
+    return {
+        **_monitoring_info(),
+        "metric": "generative_cached_decode_speedup_vs_reforward",
+        "value": round(speedup, 2),
+        "unit": "x (tokens/sec, cached KV decode / naive re-forward "
+                "beam search)",
+        "vs_baseline": None,
+        "ids_identical": ids_identical,
+        "tokens_per_sec_cached": round(cached_tps, 1),
+        "tokens_per_sec_naive": round(naive_tps, 1),
+        "p50_per_token_ms_cached": round(cached_s / (L - 1) * 1000, 3),
+        "p50_per_token_ms_naive": round(naive_s / (L - 1) * 1000, 3),
+        "beam_config": f"batch{b}_beam{k}_len{L}",
+        "churn_fill_fraction": round(fill, 3),
+        "churn_tokens_per_sec": round(churn_tokens / churn_wall, 1),
+        "churn_requests": n_reqs,
+        "churn_slots": slots,
+        "reps": reps,
+        "note": ("cached and naive fetch IDENTICAL searches (ids "
+                 "compared int-exact); churn row = open-loop backlog "
+                 "of staggered-budget sequences over the token-level "
+                 "continuous-batching engine, fill from "
+                 "/stf/serving/decode_fill"),
+    }
+
+
 def run_bench_transformer(platform, device_kind):
     batches = [int(x) for x in
                os.environ.get("BENCH_TFMR_BATCH", "16,24").split(",") if x]
@@ -2076,6 +2191,8 @@ def child_main():
         result = _measure_checkpoint(platform, kind)
     elif model == "kernel_tier":
         result = _measure_kernel_tier(platform, kind)
+    elif model == "generative":
+        result = _measure_generative(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -2182,7 +2299,8 @@ def _run_model(model, platform, kind, errors):
                        "input_pipeline": "600",
                        "serving": "900",
                        "telemetry": "900",
-                       "checkpoint": "600"}.get(
+                       "checkpoint": "600",
+                       "generative": "1200"}.get(
         model, "900")
     extra_xla_flags = ""
     if model == "loop_fusion":
@@ -2261,6 +2379,9 @@ _METRIC_NAMES = {
     "kernel_tier": ("kernel_tier_fused_optimizer_tail_speedup",
                     "x (per-variable assign tail / fused update, BERT "
                     "small-step config)"),
+    "generative": ("generative_cached_decode_speedup_vs_reforward",
+                   "x (tokens/sec, cached KV decode / naive re-forward "
+                   "beam search)"),
     "warm_start": ("warm_start_warmup_plus_compile_s",
                    "s (second process, shared persistent compile cache)"),
 }
@@ -2283,7 +2404,8 @@ def main():
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
             "sharding_analysis,loop_fusion,input_pipeline,serving,"
-            "telemetry,checkpoint,kernel_tier,warm_start").split(","):
+            "telemetry,checkpoint,kernel_tier,generative,"
+            "warm_start").split(","):
         tok = tok.strip()
         if not tok:
             continue
@@ -2301,7 +2423,8 @@ def main():
                     "resnet_dp", "graph_opt", "analysis",
                     "sharding_analysis", "loop_fusion",
                     "input_pipeline", "serving", "telemetry",
-                    "checkpoint", "kernel_tier", "warm_start"]
+                    "checkpoint", "kernel_tier", "generative",
+                    "warm_start"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

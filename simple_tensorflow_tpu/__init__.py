@@ -181,6 +181,7 @@ from .client.session import (Session, InteractiveSession,
 # namespaces (tf.nn, tf.train, tf.layers, tf.summary, ...)
 from . import compiler
 from . import nn
+from .ops import kv_cache_ops  # registers the KV-cache/decode op types
 from . import train
 from . import layers
 from . import losses

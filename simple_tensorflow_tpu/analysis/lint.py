@@ -33,6 +33,12 @@ Built-in catalog (see docs/ANALYSIS.md for the worked examples):
                          RNG. Active only for purpose="serving" runs
                          (``lint_graph(purpose="serving")`` /
                          ``graph_lint --serving``) (WARNING)
+  lint/serving-decode-cache
+                         generative decode-plan shape: KV-cache ops
+                         missing a committed-sharding declaration, or a
+                         cache tensor escaping to host (fetched, or
+                         feeding a host-stage op). Active only for
+                         purpose="serving" runs (ERROR)
   lint/kernel-routing    per-op Pallas/XLA routing verdicts from the
                          stf.kernels registry (routed / fallback+reason
                          / autotune). Active only for purpose="kernels"
@@ -367,6 +373,59 @@ def _rule_serving_incompatible(ctx):
                    "composition/request order and do not reproduce "
                    "across restarts; seed it, or export without "
                    "sampling ops")
+
+
+@register_lint_rule("serving-decode-cache", ERROR)
+def _rule_serving_decode_cache(ctx):
+    """Decode-plan shape checks for generative serving (active only for
+    ``purpose="serving"`` runs — ``graph_lint --serving``). The
+    KV-cache contract (ops/kv_cache_ops.py) is that cache state lives
+    device-resident with a COMMITTED sharding and never leaves HBM
+    between decode steps; this rule makes both halves statically
+    checkable:
+
+    - a cache op (KVCacheAlloc/Append/Gather) whose committed-sharding
+      declaration is missing would commit at whatever layout the first
+      write happened to produce — resharding every subsequent step;
+    - a cache tensor ESCAPING TO HOST (a host-stage op consuming a
+      cache op's output, or a cache op's output fetched directly) pays
+      a device→host transfer of the whole cache page set per decode
+      step — the exact traffic the cache exists to avoid. Slice a
+      device-side view instead, or fetch derived scalars.
+    """
+    if ctx.purpose != "serving":
+        return
+    from ..ops import kv_cache_ops as _kvc
+
+    fetched = set()
+    for f in ctx.fetches:
+        if not isinstance(f, ops_mod.Operation):
+            fetched.add(f)
+    for op in ctx.ops:
+        if not _kvc.is_cache_op(op):
+            continue
+        if not op.attrs.get(_kvc.SHARDING_ATTR):
+            yield (op,
+                   f"cache op {op.name!r} ({op.type}) on "
+                   f"{op.attrs.get('var_name')!r} has no committed "
+                   "sharding declaration; declare it at kv_cache(..., "
+                   "sharding=...) so the store commits a stable layout")
+        for out in op.outputs:
+            if out in fetched:
+                yield (op,
+                       f"cache tensor {out.name!r} is fetched — the "
+                       "whole cache page set would transfer "
+                       "device->host every decode step; fetch derived "
+                       "values instead")
+            for consumer in out.consumers():
+                if consumer.op_def.runs_on_host \
+                        or op_effects(consumer).io:
+                    yield (op,
+                           f"cache tensor {out.name!r} feeds host-"
+                           f"observable op {consumer.name!r} "
+                           f"({consumer.type}): the cache must stay "
+                           "device-resident across decode steps "
+                           "(host-sink on a cache tensor)")
 
 
 @register_lint_rule("kernel-routing", NOTE)

@@ -162,6 +162,17 @@ def _op_flops(op: Operation, grad_depth: int = 0,
         # per-variable assign chains carried, now priced on one op
         n = sum(_nelems(i.shape) or 0 for i in op.inputs)
         return (12.0 if t == "FusedAdamUpdate" else 6.0) * n
+    if t == "DecodeAttention":
+        # q·K + P·V over the gathered cache: 4 * B * H * max_len * D
+        # (the output is only (B, H, D) — the default out-elems pricing
+        # would miss the cache-length factor entirely)
+        ks = op.inputs[1].shape
+        if ks.rank == 4 and all(d.value for d in ks.dims):
+            b, max_len, h, d = (int(x.value) for x in ks.dims)
+            return 4.0 * b * h * max_len * d
+        return 2.0 * _out_elems(op)
+    if t in ("KVCacheAlloc", "KVCacheAppend", "KVCacheGather"):
+        return 0.0  # pure data movement; bytes are priced in _op_bytes
     mult = 2.0 if t in _TRANSCENDENTAL_OPS else 1.0
     return mult * _out_elems(op)
 
@@ -228,6 +239,13 @@ def _op_bytes_dispatch(op: Operation, fn_depth: int = 0) -> float:
         n = sum(_nelems(i.shape) or 0 for i in op.inputs)
         streams = 6.0 if op.type == "FusedAdamUpdate" else 4.0
         return _op_bytes(op) + streams * n * 4.0
+    if op.type == "KVCacheAppend":
+        # in-place scatter of B rows at one position range: the touched
+        # bytes are value read + write (the output tensor is the WHOLE
+        # cache only nominally — XLA donates and updates in place; the
+        # default inputs+outputs accounting would charge a full cache
+        # write per append and dominate every decode-step attribution)
+        return 2.0 * sum(_tensor_bytes(t) for t in op.inputs)
     fc = _function_op_cost(op, 0, fn_depth)
     if fc is not None:
         return fc[1]

@@ -284,21 +284,165 @@ def transformer_train_model(batch_size=64, src_len=64, tgt_len=64,
             "learning_rate": lr, "global_step": gs}
 
 
+# ---------------------------------------------------------------------------
+# Incremental (KV-cached) decode
+# ---------------------------------------------------------------------------
+
+class _BeamCaches:
+    """Loop-carried functional caches for the cached beam search: one
+    (k, v) pair per decoder layer, each (B, L, H, hd), updated in-place
+    functionally via a one-hot position mask (static shapes — the whole
+    search stays ONE XLA program)."""
+
+    def __init__(self, flat_arrays, i, b, max_len):
+        self._arrays = list(flat_arrays)
+        self._i = i
+        self._b = b
+        self._L = max_len
+        self.updated = list(flat_arrays)
+
+    def append_and_gather(self, layer, k_new, v_new):
+        mask = stf.cast(stf.reshape(
+            stf.one_hot(self._i, self._L, dtype=stf.float32),
+            [1, self._L, 1, 1]), k_new.dtype.base_dtype)
+        k_all = self._arrays[2 * layer] * (1.0 - mask) + k_new * mask
+        v_all = self._arrays[2 * layer + 1] * (1.0 - mask) + v_new * mask
+        self.updated[2 * layer] = k_all
+        self.updated[2 * layer + 1] = v_all
+        lengths = stf.fill([self._b], self._i + 1)
+        return k_all, v_all, lengths
+
+
+class _SlotCaches:
+    """Variable-backed paged caches for the serving decode step: each
+    layer's k/v live device-resident in the VariableStore
+    (ops/kv_cache_ops.py); appends scatter at (slot, position) and the
+    gather rides a control dependency so the RAW is graph-ordered."""
+
+    def __init__(self, caches, slots, positions):
+        self._caches = caches          # [(KVCache k, KVCache v)] per layer
+        self._slots = slots
+        self._pos = positions
+
+    def append_and_gather(self, layer, k_new, v_new):
+        kc, vc = self._caches[layer]
+        k_all = kc.append_and_gather(k_new, self._slots, self._pos)
+        v_all = vc.append_and_gather(v_new, self._slots, self._pos)
+        return k_all, v_all, self._pos + 1
+
+
+def _decode_cross_kv(enc_out, cfg, compute_dtype, scope):
+    """Per-layer cross-attention K/V projections of the encoder output,
+    computed ONCE per sequence (the naive re-forward path recomputes
+    them every emitted token). Returns [(ck, cv)] each
+    (B, S_src, H, hd) — the DecodeAttention cache layout."""
+    b, s = int(enc_out.shape[0]), int(enc_out.shape[1])
+    d, heads = cfg.d_model, cfg.num_heads
+    hd = d // heads
+    out = []
+    with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
+        with stf.variable_scope("decoder"):
+            for i in range(cfg.num_layers):
+                with stf.variable_scope(f"layer_{i}"):
+                    with stf.variable_scope("cross_attn"):
+                        ck = stf.reshape(_dense(enc_out, d, cfg, "k"),
+                                         [b, s, heads, hd])
+                        cv = stf.reshape(_dense(enc_out, d, cfg, "v"),
+                                         [b, s, heads, hd])
+                out.append((ck, cv))
+    return out
+
+
+def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
+                        cfg, compute_dtype, scope):
+    """ONE decoder position for B sequences against cached state.
+
+    tok: (B,) int32 input tokens; pos: scalar or (B,) int32 position(s);
+    caches: a :class:`_BeamCaches` / :class:`_SlotCaches` accessor;
+    cross_kv: [(ck, cv)] per layer (B, S_src, H, hd); cross_bias:
+    (B, S_src) additive f32; cross_len: (B,) int32. Returns
+    (h (B, d_model) in compute dtype, emb) — the caller owns the logits
+    matmul (f32/bf16 tied softmax, or the int8 QuantMatMul route).
+
+    Token-for-token equivalent to selecting position ``pos`` of the
+    full re-forward :func:`decode` at eval time: every sublayer here is
+    position-independent (LN, FFN, residual) or reads exactly the
+    positions the causal mask admits (self-attention over the cache,
+    cross-attention over the full source).
+    """
+    b = int(tok.shape[0])
+    d, heads = cfg.d_model, cfg.num_heads
+    hd = d // heads
+    with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
+        emb = stf.get_variable(
+            "shared_embedding", [cfg.vocab_size, cfg.d_model],
+            initializer=stf.random_normal_initializer(
+                stddev=cfg.d_model ** -0.5))
+        h = stf.nn.embedding_lookup(emb, tok, compute_dtype=compute_dtype) \
+            * stf.cast(stf.constant(cfg.d_model ** 0.5), compute_dtype)
+        pos_table = stf.constant(
+            sinusoidal_position_encoding(cfg.max_len, cfg.d_model))
+        h = h + stf.cast(stf.gather(pos_table, pos), compute_dtype)
+        with stf.variable_scope("decoder"):
+            for i in range(cfg.num_layers):
+                with stf.variable_scope(f"layer_{i}"):
+                    with stf.variable_scope("self_attn"):
+                        q = stf.reshape(_dense(h, d, cfg, "q"),
+                                        [b, heads, hd])
+                        k_new = stf.reshape(_dense(h, d, cfg, "k"),
+                                            [b, 1, heads, hd])
+                        v_new = stf.reshape(_dense(h, d, cfg, "v"),
+                                            [b, 1, heads, hd])
+                        k_all, v_all, lengths = caches.append_and_gather(
+                            i, k_new, v_new)
+                        a = stf.nn.decode_attention(q, k_all, v_all,
+                                                    lengths)
+                        a = _dense(stf.reshape(a, [b, d]), d, cfg, "out")
+                    h = _ln(_residual(a, h, cfg, False), cfg, "ln1")
+                    with stf.variable_scope("cross_attn"):
+                        qc = stf.reshape(_dense(h, d, cfg, "q"),
+                                         [b, heads, hd])
+                        ck, cv = cross_kv[i]
+                        c = stf.nn.decode_attention(qc, ck, cv, cross_len,
+                                                    bias=cross_bias)
+                        c = _dense(stf.reshape(c, [b, d]), d, cfg, "out")
+                    h = _ln(_residual(c, h, cfg, False), cfg, "ln2")
+                    f = _ffn(h, cfg, False, "ffn")
+                    h = _ln(h + f, cfg, "ln3")
+    return h, emb
+
+
 def beam_search_decode(src, cfg: TransformerConfig | None = None,
                        beam_size=4, decode_len=None, alpha=0.6,
-                       compute_dtype=stf.bfloat16, scope="transformer"):
+                       compute_dtype=stf.bfloat16, scope="transformer",
+                       use_cache=False):
     """Beam search over the decoder; returns (ids (B,beam,L), scores (B,beam)).
 
     Fixed decode_len iterations of one static XLA program via stf.while_loop;
-    prefix re-scored each step (see module docstring). Finished beams (EOS
-    emitted) are extended only by EOS at zero cost, so scores freeze.
+    Finished beams (EOS emitted) are extended only by EOS at zero cost, so
+    scores freeze.
+
+    use_cache=False re-scores the full prefix each step (O(L^2) FLOPs,
+    see the module docstring); use_cache=True carries per-layer KV
+    caches through the loop and decodes ONE position per step through
+    the DecodeAttention kernel (O(L) FLOPs) — token-for-token the same
+    search (int-exact ids; scores to float round-off), bench.py's
+    ``generative`` row pins the speedup.
     """
     cfg = cfg or TransformerConfig.big()
     b = int(src.shape[0])
     L = decode_len or cfg.max_len
+    if L > cfg.max_len:
+        # the position-encoding table has cfg.max_len rows; a longer
+        # decode would silently clamp the gather (wrong tokens, no
+        # error) on the cached path
+        raise ValueError(
+            f"decode_len={L} exceeds cfg.max_len={cfg.max_len}")
     k = beam_size
     vocab = cfg.vocab_size
     neg_inf = -1e9
+    heads = cfg.num_heads
+    hd = cfg.d_model // heads
 
     enc_out, enc_bias = encode(src, cfg, training=False,
                                compute_dtype=compute_dtype, scope=scope)
@@ -320,19 +464,16 @@ def beam_search_decode(src, cfg: TransformerConfig | None = None,
         np.tile(np.array([[0.0] + [neg_inf] * (k - 1)], np.float32), (b, 1)))
     i0 = stf.constant(0)
 
-    def cond(i, seq, logp):
-        return stf.less(i, L - 1)
+    eos_row = stf.constant(
+        np.array([0.0 if t == cfg.eos_id else neg_inf
+                  for t in range(vocab)], np.float32).reshape(1, 1, vocab))
+    offs = stf.reshape(stf.constant(
+        np.arange(b, dtype=np.int32) * k), [b, 1])
 
-    def body(i, seq, logp):
-        flat = stf.reshape(seq, [b * k, L])
-        # decode() emits logits in compute dtype; beam-score math is f32
-        logits = stf.cast(
-            decode(flat, enc_tiled, bias_tiled, cfg, training=False,
-                   compute_dtype=compute_dtype, scope=scope), stf.float32)
-        # logits at position i predict token i+1: one_hot-select (static L)
-        sel = stf.one_hot(i, L, dtype=stf.float32)  # (L,)
-        step_logits = stf.reduce_sum(
-            logits * stf.reshape(sel, [1, L, 1]), axis=1)  # (B*k, vocab)
+    def select(i, seq, logp, step_logits):
+        """Beam expansion shared by both paths: score position ``i``'s
+        logits, pick the top-k continuations, write the token at column
+        i+1. Returns (new_seq, new_logp, parent (B*k,) row indices)."""
         logprobs = stf.nn.log_softmax(step_logits, axis=-1)
         logprobs = stf.reshape(logprobs, [b, k, vocab])
 
@@ -342,9 +483,6 @@ def beam_search_decode(src, cfg: TransformerConfig | None = None,
             stf.slice(seq, [0, 0, 1], [b, k, L - 1]), cfg.eos_id),
             stf.float32), axis=2)
         finished = stf.greater(emitted, 0.0)  # (B,k)
-        eos_row = stf.constant(
-            np.array([0.0 if t == cfg.eos_id else neg_inf
-                      for t in range(vocab)], np.float32).reshape(1, 1, vocab))
         fin_f = stf.reshape(stf.cast(finished, stf.float32), [b, k, 1])
         logprobs = logprobs * (1.0 - fin_f) + eos_row * fin_f
 
@@ -355,17 +493,64 @@ def beam_search_decode(src, cfg: TransformerConfig | None = None,
         tok = stf.cast(flat_idx % vocab, stf.int32)  # (B,k)
 
         # gather parent rows: batch offsets into (B*k, L)
-        offs = stf.reshape(stf.constant(
-            np.arange(b, dtype=np.int32) * k), [b, 1])
         parent = stf.reshape(beam_idx + offs, [-1])
         new_seq = stf.gather(stf.reshape(seq, [b * k, L]), parent)
         # write token at column i+1 via one_hot mask (static shapes)
         col = stf.one_hot(i + 1, L, dtype=stf.int32)  # (L,)
         new_seq = (new_seq * (1 - stf.reshape(col, [1, L])) +
                    stf.reshape(tok, [-1, 1]) * stf.reshape(col, [1, L]))
-        return i + 1, stf.reshape(new_seq, [b, k, L]), new_logp
+        return stf.reshape(new_seq, [b, k, L]), new_logp, parent
 
-    _, seq, logp = stf.while_loop(cond, body, [i0, seq0, logp0])
+    def cond(i, seq, logp, *caches):
+        return stf.less(i, L - 1)
+
+    def body_naive(i, seq, logp):
+        flat = stf.reshape(seq, [b * k, L])
+        # decode() emits logits in compute dtype; beam-score math is f32
+        logits = stf.cast(
+            decode(flat, enc_tiled, bias_tiled, cfg, training=False,
+                   compute_dtype=compute_dtype, scope=scope), stf.float32)
+        # logits at position i predict token i+1: one_hot-select (static L)
+        sel = stf.one_hot(i, L, dtype=stf.float32)  # (L,)
+        step_logits = stf.reduce_sum(
+            logits * stf.reshape(sel, [1, L, 1]), axis=1)  # (B*k, vocab)
+        new_seq, new_logp, _ = select(i, seq, logp, step_logits)
+        return i + 1, new_seq, new_logp
+
+    if use_cache:
+        cross_kv = _decode_cross_kv(enc_tiled, cfg, compute_dtype, scope)
+        cross_bias = stf.reshape(bias_tiled, [b * k, s_src])
+        cross_len = stf.fill([b * k], s_src)
+        caches0 = []
+        for _ in range(cfg.num_layers):
+            caches0.append(stf.zeros([b * k, L, heads, hd],
+                                     dtype=compute_dtype))
+            caches0.append(stf.zeros([b * k, L, heads, hd],
+                                     dtype=compute_dtype))
+
+        def body_cached(i, seq, logp, *flat_caches):
+            # current input token = column i of every beam row
+            coli = stf.one_hot(i, L, dtype=stf.int32)
+            tok = stf.reduce_sum(seq * stf.reshape(coli, [1, 1, L]),
+                                 axis=2)  # (B,k)
+            flat_tok = stf.reshape(tok, [b * k])
+            cache = _BeamCaches(flat_caches, i, b * k, L)
+            h, emb = _incremental_decode(
+                flat_tok, i, cache, cross_kv, cross_bias, cross_len,
+                cfg, compute_dtype, scope)
+            logits = stf.matmul(h, stf.cast(emb, h.dtype.base_dtype),
+                                transpose_b=True)
+            step_logits = stf.cast(logits, stf.float32)
+            new_seq, new_logp, parent = select(i, seq, logp, step_logits)
+            # beams reorder -> their caches reorder with them
+            new_caches = [stf.gather(c, parent) for c in cache.updated]
+            return (i + 1, new_seq, new_logp, *new_caches)
+
+        out = stf.while_loop(cond, body_cached,
+                             [i0, seq0, logp0] + caches0)
+        _, seq, logp = out[0], out[1], out[2]
+    else:
+        _, seq, logp = stf.while_loop(cond, body_naive, [i0, seq0, logp0])
     # GNMT length penalty, then re-sort: penalties vary with beam length,
     # so raw-logp order need not equal penalized order
     lengths = stf.reduce_sum(stf.cast(stf.logical_and(
@@ -380,6 +565,318 @@ def beam_search_decode(src, cfg: TransformerConfig | None = None,
     seq = stf.reshape(stf.gather(stf.reshape(seq, [b * k, L]), flat_order),
                       [b, k, L])
     return seq, scores
+
+
+# ---------------------------------------------------------------------------
+# Serving-side generative program (stf.serving.generative)
+# ---------------------------------------------------------------------------
+
+def build_int8_logits_weights(emb, cfg, scope="transformer"):
+    """Column-wise int8 quantization of the tied softmax weights for the
+    decode path: ``emb (vocab, d)`` → ``wq (d, vocab) int8`` +
+    ``scale (vocab,) f32`` variables, quantized ON DEVICE by the
+    returned init op (run it AFTER restoring the model weights). The
+    decode logits matmul then routes through the QuantMatMul kernel
+    registry entry — int8 runs the MXU at 2x the bf16 rate and halves
+    the vocab-sized weight read per emitted token."""
+    d, vocab = cfg.d_model, cfg.vocab_size
+    with stf.variable_scope(f"{scope}_int8_decode",
+                            reuse=stf.AUTO_REUSE):
+        wq = stf.get_variable("emb_q", [d, vocab], dtype=stf.int8,
+                              initializer=stf.zeros_initializer(),
+                              trainable=False,
+                              collections=["stf_decode_int8"])
+        scale = stf.get_variable("emb_scale", [vocab], dtype=stf.float32,
+                                 initializer=stf.ones_initializer(),
+                                 trainable=False,
+                                 collections=["stf_decode_int8"])
+        w = stf.transpose(stf.cast(emb, stf.float32), [1, 0])  # (d, vocab)
+        s = stf.maximum(stf.reduce_max(stf.abs(w), axis=0), 1e-8) / 127.0
+        q = stf.cast(stf.round(w / stf.reshape(s, [1, vocab])), stf.int8)
+        init = stf.group(stf.assign(wq, q), stf.assign(scale, s),
+                         name="int8_decode_init")
+    return wq, scale, init
+
+
+def build_generative_program(cfg: TransformerConfig, src_len, *,
+                             num_slots, max_decode_len,
+                             decode_bucket_sizes=None,
+                             prefill_bucket_sizes=(1,),
+                             compute_dtype=stf.float32, int8=False,
+                             scope="transformer", cache_sharding=None):
+    """Build the paged-cache decode graphs for token-level serving.
+
+    Emits, in the CURRENT default graph:
+
+    - per-layer self-attention K/V caches + per-layer cross-attention
+      K/V caches + the source padding-bias cache, all device-resident
+      ``KVCache`` pages with ``num_slots + 1`` rows (the extra row is
+      the SCRATCH slot bucket padding writes into, so a padded decode
+      row can never corrupt a live sequence's cache);
+    - ``alloc_op``: zero-fills every cache (engine start);
+    - one PREFILL program per ``prefill_bucket_sizes`` entry: encoder
+      forward + cross-K/V projection, scattered into the slots' cache
+      rows (feeds: src (pb, src_len), slots (pb,));
+    - one DECODE program per ``decode_bucket_sizes`` entry: ONE
+      position for sb sequences — embed, per-layer cached self-attn
+      (KVCacheAppend at (slot, pos) then DecodeAttention), cached
+      cross-attn, tied-softmax logits (QuantMatMul when ``int8``),
+      greedy argmax (feeds: tok (sb,), pos (sb,), slots (sb,);
+      fetches: next_tok (sb,), logp (sb,)).
+
+    Returns a dict of graph handles (see :class:`TransformerGenerativeModel`
+    for the session-owning wrapper the serving engine drives).
+    """
+    from ..serving.policy import _pow2_buckets
+
+    if max_decode_len > cfg.max_len:
+        raise ValueError(
+            f"max_decode_len={max_decode_len} exceeds "
+            f"cfg.max_len={cfg.max_len} (the position-encoding table); "
+            "raise cfg.max_len or shorten the cache")
+    heads = cfg.num_heads
+    hd = cfg.d_model // heads
+    total_slots = int(num_slots) + 1      # + scratch row
+    scratch = int(num_slots)
+    decode_buckets = sorted(set(int(x) for x in (
+        decode_bucket_sizes or _pow2_buckets(int(num_slots)))))
+    prefill_buckets = sorted(set(int(x) for x in prefill_bucket_sizes))
+    from ..ops import kv_cache_ops as kvc
+
+    self_caches = []
+    cross_caches = []
+    for i in range(cfg.num_layers):
+        self_caches.append((
+            kvc.kv_cache(f"{scope}_kv/l{i}_k", total_slots, max_decode_len,
+                         (heads, hd), compute_dtype,
+                         sharding=cache_sharding),
+            kvc.kv_cache(f"{scope}_kv/l{i}_v", total_slots, max_decode_len,
+                         (heads, hd), compute_dtype,
+                         sharding=cache_sharding)))
+        cross_caches.append((
+            kvc.kv_cache(f"{scope}_kv/l{i}_ck", total_slots, src_len,
+                         (heads, hd), compute_dtype,
+                         sharding=cache_sharding),
+            kvc.kv_cache(f"{scope}_kv/l{i}_cv", total_slots, src_len,
+                         (heads, hd), compute_dtype,
+                         sharding=cache_sharding)))
+    bias_cache = kvc.kv_cache(f"{scope}_kv/src_bias", total_slots, src_len,
+                              (), stf.float32, sharding=cache_sharding)
+
+    all_caches = [c for pair in self_caches + cross_caches for c in pair]
+    all_caches.append(bias_cache)
+    alloc_op = stf.group(*[c.alloc() for c in all_caches],
+                         name="kv_alloc")
+
+    # -- prefill programs ----------------------------------------------------
+    prefill = {}
+    for pb in prefill_buckets:
+        src = stf.placeholder(stf.int32, [pb, src_len],
+                              f"prefill{pb}_src")
+        slots = stf.placeholder(stf.int32, [pb], f"prefill{pb}_slots")
+        zeros = stf.fill([pb], 0)
+        enc_out, enc_bias = encode(src, cfg, training=False,
+                                   compute_dtype=compute_dtype,
+                                   scope=scope)
+        cross_kv = _decode_cross_kv(enc_out, cfg, compute_dtype, scope)
+        appends = []
+        for i, (ckc, cvc) in enumerate(cross_caches):
+            ck, cv = cross_kv[i]
+            appends.append(ckc.append(ck, slots, zeros))
+            appends.append(cvc.append(cv, slots, zeros))
+        appends.append(bias_cache.append(
+            stf.reshape(enc_bias, [pb, src_len]), slots, zeros))
+        prefill[pb] = {
+            "src": src, "slots": slots,
+            "op": stf.group(*appends, name=f"prefill{pb}"),
+        }
+
+    # -- decode programs -----------------------------------------------------
+    decode_progs = {}
+    int8_init = None
+    for sb in decode_buckets:
+        tok = stf.placeholder(stf.int32, [sb], f"decode{sb}_tok")
+        pos = stf.placeholder(stf.int32, [sb], f"decode{sb}_pos")
+        slots = stf.placeholder(stf.int32, [sb], f"decode{sb}_slots")
+        cross_len = stf.fill([sb], src_len)
+        cross_bias = bias_cache.gather(slots)             # (sb, src_len)
+        cross_kv = [(ckc.gather(slots), cvc.gather(slots))
+                    for ckc, cvc in cross_caches]
+        cache = _SlotCaches(self_caches, slots, pos)
+        h, emb = _incremental_decode(
+            tok, pos, cache, cross_kv, cross_bias, cross_len, cfg,
+            compute_dtype, scope)
+        if int8:
+            if int8_init is None:
+                wq, w_scale, int8_init = build_int8_logits_weights(
+                    emb, cfg, scope=scope)
+            logits = stf.nn.quantized_matmul(h, wq, w_scale)
+        else:
+            logits = stf.matmul(h, stf.cast(emb, h.dtype.base_dtype),
+                                transpose_b=True)
+        logits = stf.cast(logits, stf.float32)            # (sb, vocab)
+        logp_all = stf.nn.log_softmax(logits, axis=-1)
+        next_tok = stf.cast(stf.argmax(logits, -1, output_type=stf.int32),
+                            stf.int32)
+        logp = stf.reduce_sum(
+            logp_all * stf.one_hot(next_tok, cfg.vocab_size,
+                                   dtype=stf.float32), axis=-1)
+        decode_progs[sb] = {"tok": tok, "pos": pos, "slots": slots,
+                            "next_tok": next_tok, "logp": logp}
+
+    return {
+        "alloc_op": alloc_op,
+        "int8_init": int8_init,
+        "prefill": prefill,
+        "decode": decode_progs,
+        "decode_buckets": decode_buckets,
+        "prefill_buckets": prefill_buckets,
+        "scratch_slot": scratch,
+        "self_caches": self_caches,
+        "cross_caches": cross_caches,
+        "bias_cache": bias_cache,
+    }
+
+
+class TransformerGenerativeModel:
+    """Session-owning transformer decode program for the serving engine.
+
+    Implements the :class:`~...serving.generative.GenerativeEngine`
+    model interface: ``prefill(src_rows, slots)``, ``decode(tokens,
+    positions, slots) -> (next_tok, logp)``, ``close()``, plus the
+    ``eos_id / pad_id / num_slots / max_decode_len / src_len`` attrs
+    the engine schedules against. Owns its own Graph + Session (the
+    per-model isolation contract of ModelServer servables); weights
+    restore from ``checkpoint`` or initialize fresh
+    (``init_fresh=True`` — tests/benches). All decode/prefill bucket
+    programs are planned at construction and optionally AOT-compiled.
+    """
+
+    def __init__(self, cfg: TransformerConfig, src_len, *, num_slots=8,
+                 max_decode_len=32, decode_bucket_sizes=None,
+                 prefill_bucket_sizes=(1,), compute_dtype=stf.float32,
+                 int8=False, checkpoint=None, init_fresh=False,
+                 config=None, scope="transformer", aot_warmup=True,
+                 seed=0):
+        if checkpoint is None and not init_fresh:
+            raise ValueError("pass checkpoint=... or init_fresh=True")
+        self.cfg = cfg
+        self.src_len = int(src_len)
+        self.num_slots = int(num_slots)
+        self.max_decode_len = int(max_decode_len)
+        self.eos_id = cfg.eos_id
+        self.pad_id = cfg.pad_id
+        self.int8 = bool(int8)
+        self.graph = stf.Graph()
+        with self.graph.as_default():
+            if seed is not None:
+                stf.set_random_seed(seed)
+            self.session = stf.Session(graph=self.graph, config=config)
+            prog = build_generative_program(
+                cfg, src_len, num_slots=num_slots,
+                max_decode_len=max_decode_len,
+                decode_bucket_sizes=decode_bucket_sizes,
+                prefill_bucket_sizes=prefill_bucket_sizes,
+                compute_dtype=compute_dtype, int8=int8, scope=scope)
+            self._prog = prog
+            self._scratch = prog["scratch_slot"]
+            if checkpoint is not None:
+                saver = stf.train.Saver()
+                saver.restore(self.session, checkpoint)
+            else:
+                self.session.run(stf.global_variables_initializer())
+            init_fetches = [prog["alloc_op"]]
+            if prog["int8_init"] is not None:
+                # quantize AFTER the weights are live
+                init_fetches.append(prog["int8_init"])
+            for f in init_fetches:
+                self.session.run(f)
+            self._decode_plans = {}
+            for sb, p in prog["decode"].items():
+                plan = self.session.plan(
+                    {"next_tok": p["next_tok"], "logp": p["logp"]},
+                    feeds=[p["tok"], p["pos"], p["slots"]])
+                self._decode_plans[sb] = (plan, p)
+                if aot_warmup:
+                    plan.compile()
+            self._prefill_plans = {}
+            for pb, p in prog["prefill"].items():
+                plan = self.session.plan({"done": p["op"]},
+                                         feeds=[p["src"], p["slots"]])
+                self._prefill_plans[pb] = (plan, p)
+                if aot_warmup:
+                    plan.compile()
+        self._decode_buckets = sorted(self._decode_plans)
+        self._prefill_buckets = sorted(self._prefill_plans)
+
+    # the engine drives bucketing from its DecodePolicy: these expose
+    # what this model actually compiled plans for (validated at
+    # GenerativeEngine construction), and the scratch row bucket
+    # padding may safely write into
+    @property
+    def decode_buckets(self):
+        return list(self._decode_buckets)
+
+    @property
+    def prefill_buckets(self):
+        return list(self._prefill_buckets)
+
+    @property
+    def scratch_slot(self):
+        return self._scratch
+
+    # -- engine interface -----------------------------------------------------
+    def _bucket(self, buckets, n):
+        for b in buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"{n} rows exceed the largest bucket "
+                         f"{buckets[-1]}")
+
+    def prefill(self, src_rows, slots):
+        """Encode ``src_rows (n, src_len)`` into cache rows ``slots``."""
+        src_rows = np.asarray(src_rows, np.int32).reshape(-1, self.src_len)
+        slots = np.asarray(slots, np.int32)
+        n = len(slots)
+        # largest-first greedy bucket cover: one plan execution per chunk
+        done = 0
+        while done < n:
+            take = min(n - done, self._prefill_buckets[-1])
+            pb = self._bucket(self._prefill_buckets, take)
+            plan, p = self._prefill_plans[pb]
+            src_pad = np.full((pb, self.src_len), self.pad_id, np.int32)
+            slot_pad = np.full((pb,), self._scratch, np.int32)
+            src_pad[:take] = src_rows[done:done + take]
+            slot_pad[:take] = slots[done:done + take]
+            plan.execute({p["src"]: src_pad, p["slots"]: slot_pad})
+            done += take
+
+    def decode(self, tokens, positions, slots):
+        """One decode position for n live sequences; returns
+        (next_tok (n,), logp (n,), bucket)."""
+        tokens = np.asarray(tokens, np.int32)
+        positions = np.asarray(positions, np.int32)
+        slots = np.asarray(slots, np.int32)
+        n = len(slots)
+        sb = self._bucket(self._decode_buckets, n)
+        plan, p = self._decode_plans[sb]
+        tok = np.full((sb,), self.pad_id, np.int32)
+        pos = np.zeros((sb,), np.int32)
+        slt = np.full((sb,), self._scratch, np.int32)
+        tok[:n], pos[:n], slt[:n] = tokens, positions, slots
+        out = plan.execute({p["tok"]: tok, p["pos"]: pos, p["slots"]: slt})
+        return (np.asarray(out["next_tok"])[:n],
+                np.asarray(out["logp"])[:n], sb)
+
+    def close(self):
+        self.session.close()
+
+    def statusz_info(self):
+        return {"decode_buckets": self._decode_buckets,
+                "prefill_buckets": self._prefill_buckets,
+                "num_slots": self.num_slots,
+                "max_decode_len": self.max_decode_len,
+                "src_len": self.src_len, "int8": self.int8}
 
 
 def synthetic_wmt_batch(batch_size, src_len, tgt_len, vocab_size=32768,

@@ -35,6 +35,7 @@ from ..ops.fused_ops import (
     fused_attention, fused_bias_dropout_residual, fused_layer_norm,
     fused_softmax_cross_entropy, quantized_matmul,
 )
+from ..ops.kv_cache_ops import decode_attention
 from ..ops.candidate_sampling_ops import (
     uniform_candidate_sampler, log_uniform_candidate_sampler,
     learned_unigram_candidate_sampler, fixed_unigram_candidate_sampler,

@@ -1,0 +1,322 @@
+"""KV-cache graph ops: device-resident paged decode caches.
+
+(ref: the reference has no KV cache — its serving path re-runs the full
+forward per emitted token, tensorflow_serving/servables/tensorflow/.
+This module is the TPU-native incremental-decode substrate the
+generative engine (stf.serving.generative) and the cached beam search
+(models/transformer.py) run on.)
+
+A cache is an entry in the Session's device-resident VariableStore —
+the SAME store that holds model weights and optimizer slots — shaped
+``(num_slots, max_len, *inner)``. Slots are PAGES: each live sequence
+owns one row, a free-list (serving/generative.py CacheSlotPool) hands
+rows to joining sequences and reclaims them at EOS, so a retiring
+sequence never compacts or copies its neighbors' cache. Because the
+store's values are donated into every step exactly like optimizer
+state, an append is an in-place HBM scatter after XLA compilation and
+the cache NEVER moves device→host between decode steps (the
+``lint/serving-decode-cache`` rule makes a host-sink on a cache tensor
+a hard error).
+
+Three ops, registered with declared Effects so the hazard engine orders
+them like any other variable access (append = read-modify-write on the
+cache resource, gather = read):
+
+  KVCacheAlloc   zero-fill the cache storage (engine start / slot-pool
+                 reset); also the op that carries the cache's committed
+                 sharding declaration (``_cache_sharding`` attr).
+  KVCacheAppend  write ``value (B, P, *inner)`` at rows ``slots (B,)``,
+                 positions ``positions[b] + [0, P)`` — P is 1 on the
+                 decode path, the prompt length on the prefill path.
+  KVCacheGather  read rows ``slots (B,)`` → ``(B, max_len, *inner)``;
+                 feeds DecodeAttention (query length 1).
+
+Ordering note: a gather has no data edge from the appends that must
+precede it; build it under ``stf.control_dependencies([append])`` (the
+:class:`KVCache` helper does) — the hazard detector (mode ``raise``)
+rejects the unordered RAW otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework import dtypes as dtypes_mod
+from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
+from ..kernels import registry as _kreg
+
+# collection-style registry attr markers consumed by the
+# lint/serving-decode-cache rule (analysis/lint.py)
+CACHE_ATTR = "_kv_cache"
+SHARDING_ATTR = "_cache_sharding"
+
+_CACHE_OP_TYPES = ("KVCacheAlloc", "KVCacheAppend", "KVCacheGather")
+
+
+# ---------------------------------------------------------------------------
+# lowerings
+# ---------------------------------------------------------------------------
+
+def _np_dtype(op):
+    return dtypes_mod.as_dtype(op.attrs["dtype"]).np_dtype
+
+
+def _lower_kv_alloc(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    shape = tuple(int(d) for d in op.attrs["shape"])
+    val = jnp.zeros(shape, _np_dtype(op))
+    ctx.write_var(op.attrs["var_name"], val)
+    return [val]
+
+
+def _lower_kv_append(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    name = op.attrs["var_name"]
+    value, slots, positions = inputs
+    cache = ctx.read_var(name, op)
+    if value.dtype != cache.dtype:
+        value = value.astype(cache.dtype)
+    p = value.shape[1]
+    p_idx = jnp.asarray(positions, jnp.int32)[:, None] + jnp.arange(
+        p, dtype=jnp.int32)[None, :]
+    new = cache.at[jnp.asarray(slots, jnp.int32)[:, None], p_idx].set(value)
+    ctx.write_var(name, new)
+    return [new]
+
+
+def _lower_kv_gather(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    cache = ctx.read_var(op.attrs["var_name"], op)
+    return [cache[jnp.asarray(inputs[0], jnp.int32)]]
+
+
+op_registry.register(
+    "KVCacheAlloc", lower=_lower_kv_alloc,
+    effects=op_registry.Effects(writes=("var_name",)))
+op_registry.register(
+    "KVCacheAppend", lower=_lower_kv_append,
+    effects=op_registry.Effects(writes=("var_name",), update="update"))
+op_registry.register(
+    "KVCacheGather", lower=_lower_kv_gather,
+    effects=op_registry.Effects(reads=("var_name",)))
+
+
+# ---------------------------------------------------------------------------
+# public handle
+# ---------------------------------------------------------------------------
+
+class KVCache:
+    """Handle to one paged cache in the VariableStore.
+
+    Build-time only (holds no device state): methods emit graph ops
+    against the default graph. The cache value itself lives in the
+    session's store under ``name`` once the :meth:`alloc` op has run.
+    """
+
+    def __init__(self, name: str, num_slots: int, max_len: int,
+                 inner_shape: Sequence[int], dtype,
+                 sharding: Optional[str] = None):
+        self.name = name
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.inner_shape = tuple(int(d) for d in inner_shape)
+        self.dtype = dtypes_mod.as_dtype(dtype)
+        # committed-sharding declaration: cache state commits at this
+        # layout in the store ("replicated", or a mesh-axis name the
+        # slot dim shards over); recorded on every cache op so offline
+        # lint (graph_lint --serving) can check it without a session
+        self.sharding = sharding or "replicated"
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.num_slots, self.max_len) + self.inner_shape
+
+    def _attrs(self):
+        return {"var_name": self.name, "shape": list(self.shape),
+                "dtype": self.dtype.name, CACHE_ATTR: True,
+                SHARDING_ATTR: self.sharding}
+
+    def alloc(self, name=None):
+        """Zero-fill the cache storage (returns the cache tensor; fetch
+        the op — not the tensor — to keep the cache on device)."""
+        g = ops_mod.get_default_graph()
+        op = g.create_op(
+            "KVCacheAlloc", [], attrs=self._attrs(),
+            name=name or f"{self.name}_alloc",
+            output_specs=[(shape_mod.TensorShape(list(self.shape)),
+                           self.dtype)])
+        return op.outputs[0]
+
+    def append(self, value, slots, positions, name=None):
+        """Write ``value (B, P, *inner)`` at ``slots (B,)`` int32 rows,
+        positions ``positions (B,) + [0, P)``. Returns the updated cache
+        tensor (use it for control deps, never as a fetch)."""
+        g = ops_mod.get_default_graph()
+        value = ops_mod.convert_to_tensor(value, dtype=self.dtype)
+        slots = ops_mod.convert_to_tensor(slots, dtype=dtypes_mod.int32)
+        positions = ops_mod.convert_to_tensor(positions,
+                                              dtype=dtypes_mod.int32)
+        op = g.create_op(
+            "KVCacheAppend", [value, slots, positions], attrs=self._attrs(),
+            name=name or f"{self.name}_append",
+            output_specs=[(shape_mod.TensorShape(list(self.shape)),
+                           self.dtype)])
+        return op.outputs[0]
+
+    def gather(self, slots, name=None):
+        """Read rows ``slots (B,)`` → ``(B, max_len, *inner)``."""
+        g = ops_mod.get_default_graph()
+        slots = ops_mod.convert_to_tensor(slots, dtype=dtypes_mod.int32)
+        b = slots.shape[0] if slots.shape.rank == 1 else None
+        out_shape = [b, self.max_len] + list(self.inner_shape)
+        op = g.create_op(
+            "KVCacheGather", [slots], attrs=self._attrs(),
+            name=name or f"{self.name}_gather",
+            output_specs=[(shape_mod.TensorShape(out_shape), self.dtype)])
+        return op.outputs[0]
+
+    def append_and_gather(self, value, slots, positions, name=None):
+        """The decode-step idiom: append, then gather the SAME rows
+        under a control dependency so the RAW on the cache resource is
+        graph-ordered (the hazard engine enforces this)."""
+        appended = self.append(value, slots, positions, name=name)
+        with ops_mod.get_default_graph().control_dependencies(
+                [appended.op]):
+            return self.gather(slots,
+                               name=(name + "_gather") if name else None)
+
+    def __repr__(self):
+        return (f"KVCache({self.name!r}, slots={self.num_slots}, "
+                f"max_len={self.max_len}, inner={self.inner_shape}, "
+                f"dtype={self.dtype.name}, sharding={self.sharding!r})")
+
+
+def kv_cache(name, num_slots, max_len, inner_shape, dtype,
+             sharding: Optional[str] = None) -> KVCache:
+    """Declare one paged KV cache (see module docstring for layout)."""
+    return KVCache(name, num_slots, max_len, inner_shape, dtype,
+                   sharding=sharding)
+
+
+def is_cache_op(op) -> bool:
+    return op.type in _CACHE_OP_TYPES
+
+
+# ---------------------------------------------------------------------------
+# DecodeAttention graph op (the paged-cache decode kernel's entry)
+# ---------------------------------------------------------------------------
+
+def decode_attention(q, k_cache, v_cache, lengths, *, bias=None,
+                     sm_scale=None, name=None):
+    """Query-length-1 attention against gathered cache rows.
+
+    q: (B, heads, head_dim); k_cache/v_cache: (B, max_len, heads,
+    head_dim) — the :class:`KVCache` gather layout; lengths: (B,) int32
+    live prefix per sequence; bias: optional additive (B, max_len) key
+    bias (cross-attention padding masks). Routed Pallas vs composed-XLA
+    through stf.kernels like every fused op. Inference-only: no
+    registered gradient.
+    """
+    g = ops_mod.get_default_graph()
+    q = ops_mod.convert_to_tensor(q)
+    k_cache = ops_mod.convert_to_tensor(k_cache)
+    v_cache = ops_mod.convert_to_tensor(v_cache)
+    lengths = ops_mod.convert_to_tensor(lengths, dtype=dtypes_mod.int32)
+    inputs = [q, k_cache, v_cache, lengths]
+    if bias is not None:
+        inputs.append(ops_mod.convert_to_tensor(bias))
+    op = g.create_op("DecodeAttention", inputs,
+                     attrs={"sm_scale": sm_scale},
+                     name=name or "decode_attention",
+                     output_specs=[(q.shape, q.dtype)])
+    return op.outputs[0]
+
+
+def _lower_decode_attention(ctx, op, input_values):
+    q, k, v, lengths = input_values[:4]
+    bias = input_values[4] if len(input_values) > 4 else None
+    fn = _kreg.select(
+        "DecodeAttention",
+        _kreg.aval_key(q, k, v, bias, has_bias=bias is not None))
+    return [fn(q, k, v, lengths, bias=bias,
+               sm_scale=op.attrs.get("sm_scale"))]
+
+
+op_registry.register("DecodeAttention", lower=_lower_decode_attention)
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation rules (stf.analysis.sharding)
+#
+# Cache state commits at the layout declared on the cache (slot dim
+# shardable; positions/features replicated per shard) — the same
+# contract as optimizer slots: the STORE owns the committed sharding,
+# data edges adapt to it.
+# ---------------------------------------------------------------------------
+
+from ..analysis import sharding as _shard  # noqa: E402
+
+
+def _cache_spec(op, ctx, rank):
+    axis = op.attrs.get(SHARDING_ATTR)
+    spec = [()] * rank
+    if axis and axis != "replicated" and ctx.mesh_axes.get(axis, 1) > 1:
+        spec[0] = (axis,)
+    return tuple(spec)
+
+
+def _kv_alloc_rule(op, in_specs, ctx):
+    return [_cache_spec(op, ctx, len(op.attrs["shape"]))]
+
+
+def _kv_append_rule(op, in_specs, ctx):
+    # the committed cache layout wins; a differently-sharded value
+    # reshards on the way in (slot-indexed scatter stays local when the
+    # batch rides the same axis as the slot dim)
+    spec = _cache_spec(op, ctx, len(op.attrs["shape"]))
+    if in_specs and in_specs[0] is not None \
+            and len(in_specs[0]) == len(spec) and in_specs[0] != spec:
+        ctx.require(0, spec)
+    return [spec]
+
+
+def _kv_gather_rule(op, in_specs, ctx):
+    # gather-by-slot over a slot-sharded cache is an all-gather of the
+    # touched rows; over a replicated cache it is local
+    rank = len(op.attrs["shape"])
+    cache = _cache_spec(op, ctx, rank)
+    if cache[0]:
+        out_t = op.outputs[0]
+        ctx.collective(
+            "all-gather", cache[0],
+            _shard.tensor_bytes(out_t) / ctx.shard_factor(cache),
+            note="KVCacheGather over slot-sharded cache",
+            tensor_name=out_t.name)
+    return [((),) * (rank if op.outputs[0].shape.rank is None
+                     else op.outputs[0].shape.rank)]
+
+
+_shard.register_rules(_kv_alloc_rule, "KVCacheAlloc")
+_shard.register_rules(_kv_append_rule, "KVCacheAppend")
+_shard.register_rules(_kv_gather_rule, "KVCacheGather")
+
+
+def _decode_attention_rule(op, in_specs, ctx):
+    # (B, H, D) q: batch/head sharding flows through exactly like
+    # FlashAttention; a sharded cache length would need ring traffic the
+    # kernel does not do — consumed gathered
+    sq = in_specs[0]
+    if sq is None:
+        return [None]
+    out = tuple(e if d < 2 else () for d, e in enumerate(sq))
+    return [out]
+
+
+_shard.register_rules(_decode_attention_rule, "DecodeAttention")

@@ -19,6 +19,7 @@ import numpy as np
 
 from ...framework import op_registry
 from ...kernels import registry as _kreg
+from .decode_attention import decode_attention, decode_attention_xla
 from .dropout_residual import (dropout_bias_residual,
                                dropout_bias_residual_reference)
 from .flash_attention import attention_xla, flash_attention, mha_reference
@@ -553,3 +554,72 @@ def _opt_graph_key(op):
 def flat_group_key(n, pdt, udt):
     """Decision key for one flattened optimizer parameter group."""
     return _kreg.aval_key(n=int(n), pdt=str(pdt), udt=str(udt))
+
+
+# ---------------------------------------------------------------------------
+# DecodeAttention: paged-cache decode kernel (q length 1) vs composed
+# masked softmax. The graph op is registered by ops/kv_cache_ops.py,
+# which owns the cache semantics; this entry owns the routing.
+# ---------------------------------------------------------------------------
+
+def _decode_attn_eligible(key):
+    (qs, qd), (ks, _kd), (vs, _vd), bias = key[:4]
+    if not _is_float(qd):
+        return "ineligible_dtype"
+    if len(qs) != 3 or len(ks) != 4 or len(vs) != 4:
+        return "ineligible_shape"
+    if ks[0] != qs[0] or ks[2] != qs[1] or ks[3] != qs[2] or ks != vs:
+        return "ineligible_shape"
+    if bias is not None:
+        bs, _bd = bias
+        if len(bs) != 2 or bs[0] != qs[0] or bs[1] != ks[1]:
+            return "ineligible_bias"
+    return None
+
+
+def _decode_attn_gate(key, bk):
+    (qs, qd), (ks, _), _, _bias = key[:4]
+    b, h, d = (int(x) for x in qs)
+    max_len = int(ks[1])
+    flops = 4.0 * b * h * max_len * d
+    itm = _np_of(qd).itemsize
+    cache_bytes = 2.0 * b * max_len * h * d * itm
+    # composed materializes the (B, H, L) f32 score tensor ~three times
+    # (scores, softmax, P·V read); the kernel streams the cache once
+    return _kreg.roofline_gate(flops, cache_bytes + b * h * d * itm,
+                               cache_bytes + 3.0 * b * h * max_len * 4, bk)
+
+
+def _decode_attn_case(key):
+    (qs, qd), (ks, kd), (vs, vd), bias = key[:4]
+    args = [_rand(qs, qd, 0), _rand(ks, kd, 1), _rand(vs, vd, 2),
+            np.full((qs[0],), ks[1] // 2 + 1, np.int32)]
+    kw = {}
+    if bias is not None:
+        kw["bias"] = _rand(bias[0], bias[1], 3)
+    return tuple(args), kw
+
+
+_kreg.register_kernel(
+    "DecodeAttention",
+    impls={"pallas": decode_attention, "xla": decode_attention_xla},
+    legacy="xla",
+    eligible=_decode_attn_eligible,
+    cost_gate=_decode_attn_gate,
+    make_case=_decode_attn_case,
+    graph_key=lambda op: _decode_attn_graph_key(op),
+    doc="paged-cache decode attention (query length 1, heads on the "
+        "sublane axis) vs composed masked softmax")
+
+
+def _decode_attn_graph_key(op):
+    avals = [_tensor_aval(t) for t in op.inputs[:3]]
+    if len(avals) < 3 or any(a is None for a in avals):
+        return None
+    bias = _tensor_aval(op.inputs[4]) if len(op.inputs) > 4 else None
+    if len(op.inputs) > 4 and bias is None:
+        return None
+    return _kreg.aval_key(
+        *[_Aval(*a) for a in avals],
+        _Aval(*bias) if bias is not None else None,
+        has_bias=len(op.inputs) > 4)

@@ -25,12 +25,17 @@ walkthrough and /stf/serving/* metrics catalog
 """
 
 from .batcher import ContinuousBatcher, ServeFuture, ServeRequest
-from .policy import BatchingPolicy
+from .generative import CacheSlotPool, GenerateFuture, GenerativeEngine
+from .policy import BatchingPolicy, DecodePolicy
 from .server import ModelServer, live_servers
 
 __all__ = [
     "BatchingPolicy",
+    "CacheSlotPool",
     "ContinuousBatcher",
+    "DecodePolicy",
+    "GenerateFuture",
+    "GenerativeEngine",
     "ModelServer",
     "ServeFuture",
     "ServeRequest",

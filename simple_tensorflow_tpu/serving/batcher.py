@@ -27,6 +27,15 @@ servable signature drains it:
   touches its result, so batch N+1 coalesces while batch N executes.
 
 Metrics: the ``/stf/serving/*`` family (docs/OBSERVABILITY.md).
+
+Generative workloads batch at a different altitude: one request is
+hundreds of decode steps, so ``serving/generative.py`` generalizes
+this scheduler to TOKEN-level continuous batching — the same admission
+RingBuffer + ``_QueueStats`` metrics adapter + deadline contract, but
+``BatchingPolicy.bucket_for`` consulted once per token over the live
+sequence set (see :class:`~.policy.DecodePolicy`), with cache slots
+joining/leaving mid-decode instead of requests joining/leaving a
+single coalesced batch.
 """
 
 from __future__ import annotations

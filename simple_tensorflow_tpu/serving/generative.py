@@ -1,0 +1,534 @@
+"""Token-level continuous batching: the stf.serving generative engine.
+
+(ref: tensorflow_serving batches per REQUEST — a generative workload
+decodes hundreds of steps per request, so request-level batching either
+serializes sequences or pads every batch to the slowest member. This
+engine schedules per TOKEN, the continuous-batching design of modern
+LLM servers, on top of the PR 7 batching machinery.)
+
+One :class:`GenerativeEngine` owns one decode-capable model (e.g.
+``models.transformer.TransformerGenerativeModel``) and runs a single
+scheduler thread:
+
+- requests enqueue on the same bounded admission RingBuffer the
+  request batcher uses (backpressure, deadlines, close semantics);
+- a joining request takes a CACHE SLOT from the free-list, pays one
+  PREFILL (encoder forward + cross-K/V projection scattered into its
+  slot's cache rows), and rides the next decode step — mid-decode, no
+  barrier with the sequences already running;
+- every engine step runs ONE decode program over the live set, bucketed
+  to the smallest :class:`~.policy.DecodePolicy` bucket (padding rows
+  target the model's scratch slot, never a live cache row);
+- a sequence RETIRES the step it emits EOS, exhausts its token budget,
+  or blows its deadline — its slot returns to the free-list and the
+  batch keeps going without it. Deadlines are re-checked every token.
+
+Because the decode program is static per bucket and every row reads
+only its own slot's cache, a sequence's tokens are BIT-IDENTICAL
+whether it decodes alone or rides a churning batch (pinned by
+tests/test_generative.py).
+
+Model interface (duck-typed): ``prefill(src_rows, slots)``,
+``decode(tokens, positions, slots) -> (next_tok, logp, bucket)``,
+``close()``, attrs ``eos_id / pad_id / num_slots / max_decode_len /
+src_len``.
+
+Metrics: the ``/stf/serving/decode_*`` family (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..data.pipeline import _DONE, RingBuffer
+from ..framework import errors
+from ..platform import monitoring
+from ..telemetry import recorder as _flight_mod
+from ..telemetry import tracing as _req_tracing
+from .batcher import _QueueStats
+
+# ---------------------------------------------------------------------------
+# metrics (process-global; registration is idempotent)
+# ---------------------------------------------------------------------------
+
+_metric_tokens = monitoring.Counter(
+    "/stf/serving/decode_tokens",
+    "Tokens emitted by the generative engine", "model")
+_metric_tokens_per_sec = monitoring.IntGauge(
+    "/stf/serving/decode_tokens_per_sec",
+    "Tokens emitted per second over a trailing 10 s window", "model")
+_metric_step_seconds = monitoring.Sampler(
+    "/stf/serving/decode_step_seconds",
+    monitoring.ExponentialBuckets(1e-5, 2.0, 22),
+    "Per-engine-step seconds (one decode position for every live "
+    "sequence)", "model")
+_metric_per_token = monitoring.Sampler(
+    "/stf/serving/decode_per_token_seconds",
+    monitoring.ExponentialBuckets(1e-5, 2.0, 22),
+    "Per-sequence seconds per emitted token (prefill done -> "
+    "retirement, / tokens)", "model")
+_metric_prefill_seconds = monitoring.Sampler(
+    "/stf/serving/decode_prefill_seconds",
+    monitoring.ExponentialBuckets(1e-5, 2.0, 22),
+    "Seconds encoding joining prompts into their cache slots", "model")
+_metric_fill = monitoring.Sampler(
+    "/stf/serving/decode_fill",
+    monitoring.ExplicitBuckets(
+        [0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0]),
+    "Live-sequence fraction of the decode bucket each engine step ran "
+    "at (1.0 = no padding waste)", "model")
+_metric_slots = monitoring.IntGauge(
+    "/stf/serving/decode_slots_active",
+    "Cache slots currently owned by live sequences", "model")
+_metric_sequences = monitoring.Counter(
+    "/stf/serving/decode_sequences",
+    "Generative sequences finished, by outcome (eos | length | "
+    "deadline_exceeded | error | cancelled | rejected)", "model",
+    "outcome")
+
+# every constructed GenerativeEngine, while alive (test leak hygiene:
+# tests/conftest.py asserts these are all closed after each module)
+live_engines: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class CacheSlotPool:
+    """Free-list over the model's cache slots (pages). Single-threaded
+    (the engine thread owns it); exists as a class so tests can pin
+    reuse behavior."""
+
+    def __init__(self, num_slots: int):
+        self._free: List[int] = list(range(num_slots))[::-1]
+        self.num_slots = num_slots
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def acquire(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def release(self, slot: int) -> None:
+        self._free.append(slot)
+
+
+class GenerateFuture:
+    """Async handle for one generative request. ``result()`` blocks for
+    the full sequence: ``{"tokens", "logprobs", "outcome"}``; streaming
+    consumers pass ``on_token`` to :meth:`GenerativeEngine.generate`
+    instead (called from the engine thread per emitted token)."""
+
+    __slots__ = ("_event", "_result", "_exc", "_model", "trace_id")
+
+    def __init__(self, model: str, trace_id: Optional[str] = None):
+        self._event = threading.Event()
+        self._result: Optional[Dict[str, Any]] = None
+        self._exc: Optional[BaseException] = None
+        self._model = model
+        self.trace_id = trace_id
+
+    def _set_result(self, result: Dict[str, Any]):
+        self._result = result
+        self._event.set()
+
+    def _set_exception(self, exc: BaseException):
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise errors.DeadlineExceededError(
+                None, None,
+                f"generation for model {self._model!r} not done within "
+                f"{timeout}s")
+        return self._exc
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        exc = self.exception(timeout)
+        if exc is not None:
+            raise exc
+        return self._result
+
+    def __repr__(self):
+        state = ("pending" if not self.done()
+                 else "failed" if self._exc is not None else "done")
+        return f"<GenerateFuture {self._model} {state}>"
+
+
+class GenerateRequest:
+    __slots__ = ("src", "max_new_tokens", "future", "deadline",
+                 "on_token", "t_enqueue", "trace_id")
+
+    def __init__(self, src, max_new_tokens, future,
+                 deadline: Optional[float] = None,
+                 on_token: Optional[Callable[[int, float], None]] = None,
+                 trace_id: Optional[str] = None):
+        self.src = src
+        self.max_new_tokens = max_new_tokens
+        self.future = future
+        self.deadline = deadline
+        self.on_token = on_token
+        self.t_enqueue = time.perf_counter()
+        self.trace_id = trace_id
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.perf_counter()) > self.deadline
+
+
+class _Sequence:
+    """One live decoding sequence: its slot, emission state, budget."""
+
+    __slots__ = ("req", "slot", "tokens", "logps", "pos", "last_tok",
+                 "budget", "t_start")
+
+    def __init__(self, req: GenerateRequest, slot: int, first_tok: int,
+                 budget: int):
+        self.req = req
+        self.slot = slot
+        self.tokens: List[int] = []
+        self.logps: List[float] = []
+        self.pos = 0
+        self.last_tok = first_tok
+        self.budget = budget
+        self.t_start = time.perf_counter()
+
+
+class GenerativeEngine:
+    """Scheduler thread + slot pool for one generative model (see the
+    module docstring). Constructed by ``ModelServer.load_generative``;
+    usable standalone (tests, bench)."""
+
+    def __init__(self, name: str, model, policy):
+        self.name = name
+        self._model = model
+        self._policy = policy
+        if policy.num_slots > model.num_slots:
+            raise ValueError(
+                f"policy.num_slots={policy.num_slots} exceeds the "
+                f"model's {model.num_slots} cache slots")
+        # the POLICY owns bucketing (bucket_for, per token): when the
+        # model declares which decode buckets it compiled plans for,
+        # every policy bucket must have one — a silent mismatch would
+        # re-bucket inside the model and make DecodePolicy.bucket_sizes
+        # a dead knob
+        model_buckets = getattr(model, "decode_buckets", None)
+        self._scratch_slot = getattr(model, "scratch_slot", None)
+        if model_buckets is not None:
+            missing = [b for b in policy.bucket_sizes
+                       if b not in model_buckets]
+            if missing:
+                raise ValueError(
+                    f"DecodePolicy.bucket_sizes {policy.bucket_sizes} "
+                    f"include buckets the model has no decode plan for "
+                    f"({missing}; model compiled {model_buckets}); "
+                    "align decode_bucket_sizes at model build with the "
+                    "policy")
+        self._pool = CacheSlotPool(policy.num_slots)
+        self._queue = RingBuffer(policy.max_queue_depth,
+                                 stats=_QueueStats(name))
+        self._active: List[_Sequence] = []
+        self._rate = monitoring.WindowedRate(10.0)
+        self._rate_gauge = _metric_tokens_per_sec.get_cell(name)
+        self._tokens = _metric_tokens.get_cell(name)
+        self._step_s = _metric_step_seconds.get_cell(name)
+        self._prefill_s = _metric_prefill_seconds.get_cell(name)
+        self._fill = _metric_fill.get_cell(name)
+        self._slots_gauge = _metric_slots.get_cell(name)
+        self._per_token = _metric_per_token.get_cell(name)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"stf_serving_decode_{name}",
+            daemon=True)
+        self._thread.start()
+        live_engines.add(self)
+
+    # -- submission ----------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def refresh_rate(self) -> int:
+        rate = int(self._rate.rate())
+        self._rate_gauge.set(rate)
+        return rate
+
+    def generate(self, src, max_new_tokens: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 on_token: Optional[Callable[[int, float], None]] = None,
+                 trace_id: Optional[str] = None) -> GenerateFuture:
+        """Submit one prompt. ``src``: (src_len,) int32 token row
+        (shorter rows pad with the model's pad id). ``on_token(token,
+        logprob)`` streams from the engine thread. Returns a
+        :class:`GenerateFuture`."""
+        from .. import telemetry
+
+        if trace_id is None:
+            trace_id = telemetry.current_trace_id() or \
+                telemetry.new_trace_id()
+        fut = GenerateFuture(self.name, trace_id=trace_id)
+        src = np.asarray(src, np.int32).reshape(-1)
+        if len(src) > self._model.src_len:
+            fut._set_exception(errors.InvalidArgumentError(
+                None, None,
+                f"prompt length {len(src)} exceeds the model's src_len "
+                f"{self._model.src_len}"))
+            _metric_sequences.get_cell(self.name, "rejected").increase_by(1)
+            return fut
+        row = np.full((self._model.src_len,), self._model.pad_id, np.int32)
+        row[:len(src)] = src
+        if timeout_ms is None and self._policy.default_timeout_ms > 0:
+            timeout_ms = self._policy.default_timeout_ms
+        deadline = (time.perf_counter() + float(timeout_ms) / 1000.0
+                    if timeout_ms else None)
+        if max_new_tokens is None:
+            max_new_tokens = self._policy.max_new_tokens
+        if int(max_new_tokens) < 0:
+            fut._set_exception(errors.InvalidArgumentError(
+                None, None,
+                f"max_new_tokens must be >= 0, got {max_new_tokens}"))
+            _metric_sequences.get_cell(self.name, "rejected").increase_by(1)
+            return fut
+        budget = min(int(max_new_tokens), self._model.max_decode_len)
+        if budget == 0:
+            # a zero budget never needs a slot or a prefill
+            fut._set_result({"tokens": np.zeros(0, np.int32),
+                             "logprobs": np.zeros(0, np.float32),
+                             "outcome": "length"})
+            _metric_sequences.get_cell(self.name, "length").increase_by(1)
+            return fut
+        req = GenerateRequest(row, budget, fut, deadline,
+                              on_token=on_token, trace_id=trace_id)
+        if self._closed:
+            self._reject(req, "cancelled", errors.UnavailableError(
+                None, None, f"model {self.name!r}: engine is shut down"))
+            return fut
+        timeout = None
+        if deadline is not None:
+            timeout = max(deadline - time.perf_counter(), 0.0)
+        if not self._queue.put(req, timeout=timeout):
+            if self._queue.closed:
+                self._reject(req, "cancelled", errors.UnavailableError(
+                    None, None,
+                    f"model {self.name!r}: engine is shut down"))
+            else:
+                self._reject(req, "rejected", errors.DeadlineExceededError(
+                    None, None,
+                    f"model {self.name!r}: deadline expired waiting for "
+                    "admission (queue full — backpressure)"))
+        return fut
+
+    def _reject(self, req: GenerateRequest, outcome: str,
+                exc: BaseException):
+        _metric_sequences.get_cell(self.name, outcome).increase_by(1)
+        req.future._set_exception(exc)
+
+    # -- scheduler loop ------------------------------------------------------
+    def _loop(self):
+        while True:
+            if not self._active:
+                item = self._queue.get()
+                if item is _DONE:
+                    # closed AND drained: queued requests admitted before
+                    # the close marker have all run to completion
+                    return
+                self._admit_batch([item])
+            # joiners ride the next step: burst-drain up to the free slots
+            if self._pool.free_count:
+                joiners = self._queue.get_available(self._pool.free_count)
+                if joiners:
+                    self._admit_batch(joiners)
+            if self._active:
+                try:
+                    self._step()
+                except BaseException as e:  # noqa: BLE001 — deliver, never die
+                    _flight_mod.get_recorder().on_error(
+                        e, where="serving_decode_step", model=self.name)
+                    for s in self._active:
+                        self._retire(s, "error", exc=e)
+                    self._active = []
+                    self._slots_gauge.set(0)
+
+    def _admit_batch(self, items):
+        now = time.perf_counter()
+        live: List[GenerateRequest] = []
+        for req in items:
+            if req is _DONE:
+                continue
+            if req.expired(now):
+                self._reject(req, "deadline_exceeded",
+                             errors.DeadlineExceededError(
+                                 None, None,
+                                 f"model {self.name!r}: deadline expired "
+                                 "after "
+                                 f"{now - req.t_enqueue:.3f}s in the "
+                                 "admission queue"))
+                continue
+            live.append(req)
+        if not live:
+            return
+        slots = []
+        for req in live:
+            slot = self._pool.acquire()
+            assert slot is not None, "admission exceeded free slots"
+            slots.append(slot)
+            _req_tracing.emit_span("serving_queue_wait", req.t_enqueue,
+                                   now - req.t_enqueue,
+                                   trace_id=req.trace_id, model=self.name)
+        t0 = time.perf_counter()
+        try:
+            self._model.prefill(np.stack([r.src for r in live]),
+                                np.asarray(slots, np.int32))
+        except BaseException as e:  # noqa: BLE001
+            _flight_mod.get_recorder().on_error(
+                e, where="serving_decode_prefill", model=self.name)
+            for req, slot in zip(live, slots):
+                self._pool.release(slot)
+                self._reject(req, "error", e)
+            return
+        dur = time.perf_counter() - t0
+        self._prefill_s.add(dur)
+        _req_tracing.emit_span(
+            "serving_decode_prefill", t0, dur,
+            trace_ids=[r.trace_id for r in live if r.trace_id],
+            model=self.name, joined=len(live))
+        eos = self._model.eos_id
+        for req, slot in zip(live, slots):
+            # decoder seeds with EOS at position 0, like beam search
+            self._active.append(_Sequence(req, slot, eos,
+                                          req.max_new_tokens))
+        self._slots_gauge.set(len(self._active))
+
+    def _step(self):
+        # per-token deadline check: an expired sequence retires NOW —
+        # it never stalls or rides another step
+        now = time.perf_counter()
+        still = []
+        for s in self._active:
+            if s.req.expired(now):
+                self._retire(s, "deadline_exceeded")
+            else:
+                still.append(s)
+        self._active = still
+        if not self._active:
+            self._slots_gauge.set(0)
+            return
+        n = len(self._active)
+        tokens = [s.last_tok for s in self._active]
+        positions = [s.pos for s in self._active]
+        slots = [s.slot for s in self._active]
+        if self._scratch_slot is not None:
+            # POLICY-driven bucketing: pad the live set to the policy's
+            # bucket with rows targeting the model's scratch slot (a
+            # live slot id here would corrupt that sequence's cache)
+            bucket = self._policy.bucket_for(n)
+            pad = bucket - n
+            if pad:
+                tokens = tokens + [self._model.pad_id] * pad
+                positions = positions + [0] * pad
+                slots = slots + [self._scratch_slot] * pad
+        t0 = time.perf_counter()
+        next_tok, logp, bucket = self._model.decode(tokens, positions,
+                                                    slots)
+        dur = time.perf_counter() - t0
+        self._step_s.add(dur)
+        self._fill.add(n / max(bucket, 1))
+        self._tokens.increase_by(n)
+        self._rate.add(n)
+        self._rate_gauge.set(int(self._rate.rate()))
+        rec = _flight_mod.get_recorder()
+        if rec.enabled:
+            rec.record("decode_step", model=self.name, live=n,
+                       bucket=bucket, step_s=round(dur, 6))
+        eos = self._model.eos_id
+        max_pos = self._model.max_decode_len - 1
+        still = []
+        for i, s in enumerate(self._active):
+            tok = int(next_tok[i])
+            lp = float(logp[i])
+            s.tokens.append(tok)
+            s.logps.append(lp)
+            s.pos += 1
+            s.last_tok = tok
+            if s.req.on_token is not None:
+                try:
+                    s.req.on_token(tok, lp)
+                except Exception:  # noqa: BLE001 — client cb must not kill the engine
+                    pass
+            if tok == eos:
+                self._retire(s, "eos")
+            elif len(s.tokens) >= s.budget or s.pos > max_pos:
+                self._retire(s, "length")
+            else:
+                still.append(s)
+        self._active = still
+        self._slots_gauge.set(len(still))
+
+    def _retire(self, s: _Sequence, outcome: str,
+                exc: Optional[BaseException] = None):
+        self._pool.release(s.slot)
+        _metric_sequences.get_cell(self.name, outcome).increase_by(1)
+        if s.tokens:
+            self._per_token.add(
+                (time.perf_counter() - s.t_start) / len(s.tokens))
+        if outcome in ("eos", "length"):
+            s.req.future._set_result({
+                "tokens": np.asarray(s.tokens, np.int32),
+                "logprobs": np.asarray(s.logps, np.float32),
+                "outcome": outcome,
+            })
+        elif exc is not None:
+            s.req.future._set_exception(exc)
+        else:
+            s.req.future._set_exception(errors.DeadlineExceededError(
+                None, None,
+                f"model {self.name!r}: per-token deadline expired after "
+                f"{len(s.tokens)} emitted tokens"))
+
+    # -- introspection / lifecycle -------------------------------------------
+    def statusz_info(self) -> Dict[str, Any]:
+        info = {"model": self.name, "kind": "generative",
+                "num_slots": self._pool.num_slots,
+                "slots_active": self._pool.active_count,
+                "queue_depth": self.queue_depth(),
+                "tokens_per_sec": self.refresh_rate()}
+        model_info = getattr(self._model, "statusz_info", None)
+        if callable(model_info):
+            info.update(model_info())
+        return info
+
+    def close(self, timeout: float = 30.0):
+        """Close admission and drain: new submits fail Unavailable;
+        already-queued requests and ACTIVE sequences run to completion
+        (the ContinuousBatcher drain contract); then the model's
+        session closes with the engine thread."""
+        self._closed = True
+        self._queue.close()
+        if self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout)
+        self._model.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -89,3 +89,66 @@ class BatchingPolicy:
                 f"bucket_sizes={self.bucket_sizes}, "
                 f"pad_mode={self.pad_mode!r}, "
                 f"default_timeout_ms={self.default_timeout_ms})")
+
+
+class DecodePolicy(BatchingPolicy):
+    """Token-level continuous batching knobs (docs/SERVING.md §decode).
+
+    The per-request batching of :class:`BatchingPolicy` generalizes to
+    (batch, cache_len) scheduling: a generative request occupies one
+    CACHE SLOT for its whole decode, sequences JOIN and LEAVE the
+    running batch between tokens, and every engine step runs the
+    decode program of the smallest ``bucket_sizes`` entry covering the
+    live set — ``bucket_for`` is inherited unchanged; what changes is
+    that it is consulted once per TOKEN, not once per request batch.
+
+    - ``num_slots``: cache pages / max concurrently-decoding sequences
+      (== ``max_batch_size``); a free-list recycles retired slots so
+      fill stays high under churn;
+    - ``max_decode_len``: cache length per slot — the static shape all
+      decode programs share (lengths mask the dead tail);
+    - ``bucket_sizes``: decode-program batch buckets (pow2 default);
+    - ``prefill_bucket_sizes``: prompt-encode batch buckets;
+    - ``max_new_tokens``: default per-request emission cap (clamped to
+      ``max_decode_len``);
+    - ``default_timeout_ms``: seeds per-request deadlines, checked
+      EVERY token (an expired mid-decode request retires at the next
+      step without stalling the batch).
+    """
+
+    def __init__(self,
+                 num_slots: int = 8,
+                 max_decode_len: int = 32,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 prefill_bucket_sizes: Sequence[int] = (1,),
+                 max_queue_depth: int = 256,
+                 max_new_tokens: Optional[int] = None,
+                 default_timeout_ms: float = 0.0):
+        super().__init__(max_batch_size=num_slots,
+                         batch_timeout_ms=0.0,
+                         max_queue_depth=max_queue_depth,
+                         bucket_sizes=bucket_sizes,
+                         pad_mode="repeat",
+                         default_timeout_ms=default_timeout_ms)
+        if max_decode_len < 2:
+            raise ValueError(
+                f"max_decode_len must be >= 2, got {max_decode_len}")
+        self.num_slots = int(num_slots)
+        self.max_decode_len = int(max_decode_len)
+        self.prefill_bucket_sizes = sorted(
+            {int(b) for b in prefill_bucket_sizes})
+        if not self.prefill_bucket_sizes \
+                or self.prefill_bucket_sizes[0] < 1:
+            raise ValueError("prefill_bucket_sizes must be positive: "
+                             f"{prefill_bucket_sizes}")
+        self.max_new_tokens = min(int(max_new_tokens or max_decode_len),
+                                  self.max_decode_len)
+
+    def __repr__(self):
+        return (f"DecodePolicy(num_slots={self.num_slots}, "
+                f"max_decode_len={self.max_decode_len}, "
+                f"bucket_sizes={self.bucket_sizes}, "
+                f"prefill_bucket_sizes={self.prefill_bucket_sizes}, "
+                f"max_queue_depth={self.max_queue_depth}, "
+                f"max_new_tokens={self.max_new_tokens}, "
+                f"default_timeout_ms={self.default_timeout_ms})")

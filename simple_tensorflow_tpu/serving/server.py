@@ -131,6 +131,9 @@ class ModelServer:
         self._policy = policy or BatchingPolicy()
         self._config = config
         self._models: Dict[str, _LoadedModel] = {}
+        # token-level generative servables: name -> GenerativeEngine
+        # (serving/generative.py; loaded via load_generative)
+        self._generative: Dict[str, Any] = {}
         # names reserved by in-flight load() calls: the duplicate-name
         # check and the reservation happen in ONE critical section so
         # concurrent loads of the same name cannot both build servables
@@ -148,7 +151,7 @@ class ModelServer:
     @property
     def model_names(self) -> List[str]:
         with self._lock:
-            return sorted(self._models)
+            return sorted(set(self._models) | set(self._generative))
 
     def signature_keys(self, model: Optional[str] = None) -> List[str]:
         return sorted(self._model(model).signatures)
@@ -344,6 +347,107 @@ class ModelServer:
         return ContinuousBatcher(f"{model.name}/{sig.key}", _execute,
                                  model.policy)
 
+    # -- generative servables -------------------------------------------------
+    def load_generative(self, model, name: str, policy=None) -> str:
+        """Load one GENERATIVE servable: ``model`` is a decode-capable
+        model object (e.g. ``models.transformer.
+        TransformerGenerativeModel`` — owns its Graph/Session/caches)
+        or a zero-arg factory returning one. Requests stream through
+        :meth:`generate` under token-level continuous batching
+        (serving/generative.py); ``policy`` is a
+        :class:`~.policy.DecodePolicy` (default: one sized to the
+        model's slots). Returns the model name."""
+        from .generative import GenerativeEngine
+        from .policy import DecodePolicy
+
+        if self._closed:
+            raise errors.UnavailableError(
+                None, None, "ModelServer is shut down")
+        with self._lock:
+            if name in self._models or name in self._generative \
+                    or name in self._loading:
+                raise errors.AlreadyExistsError(
+                    None, None,
+                    f"model {name!r} is already loaded (or loading); "
+                    "unload() it first or pass a distinct name")
+            self._loading.add(name)
+        engine = None
+        created_model = None
+        try:
+            if callable(model) and not hasattr(model, "decode"):
+                model = created_model = model()
+            policy = policy or DecodePolicy(
+                num_slots=model.num_slots,
+                max_decode_len=model.max_decode_len,
+                bucket_sizes=getattr(model, "decode_buckets", None))
+            engine = GenerativeEngine(name, model, policy)
+            with self._lock:
+                aborted = self._closed
+                if not aborted:
+                    self._generative[name] = engine
+            if aborted:
+                engine.close()   # closes the model too
+                engine = None
+                raise errors.UnavailableError(
+                    None, None,
+                    "ModelServer was shut down while the model loaded")
+        except BaseException:
+            if engine is not None and name not in self._generative:
+                engine.close()
+            elif engine is None and created_model is not None:
+                # engine construction failed AFTER the factory built
+                # its Graph/Session: close it or its device state and
+                # plans leak unreachable
+                created_model.close()
+            raise
+        finally:
+            with self._lock:
+                self._loading.discard(name)
+        _count_models(+1)
+        from ..telemetry import recorder as _flight
+
+        _flight.get_recorder().record(
+            "model_load", model=name, servable="generative",
+            num_slots=policy.num_slots,
+            max_decode_len=policy.max_decode_len)
+        logging.info("serving: loaded generative model %r (%s)", name,
+                     policy)
+        return name
+
+    def generate(self, src, model: Optional[str] = None,
+                 max_new_tokens: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 on_token=None, trace_id: Optional[str] = None):
+        """Stream one generative request: ``src`` is a prompt token row;
+        ``on_token(token, logprob)`` is called per emitted token from
+        the engine thread; returns a
+        :class:`~.generative.GenerateFuture` resolving to the full
+        sequence. Deadlines are enforced PER TOKEN (an expired request
+        retires at the next decode step without stalling the batch)."""
+        if self._closed:
+            raise errors.UnavailableError(
+                None, None, "ModelServer is shut down")
+        with self._lock:
+            if model is None:
+                if len(self._generative) == 1:
+                    engine = next(iter(self._generative.values()))
+                else:
+                    raise errors.InvalidArgumentError(
+                        None, None,
+                        f"{len(self._generative)} generative models "
+                        f"loaded ({sorted(self._generative)}); pass "
+                        "model=<name>")
+            else:
+                engine = self._generative.get(model)
+        if engine is None:
+            raise errors.NotFoundError(
+                None, None,
+                f"no generative model named {model!r} is loaded; "
+                f"available: {sorted(self._generative)}")
+        return engine.generate(src, max_new_tokens=max_new_tokens,
+                               timeout_ms=timeout_ms, on_token=on_token,
+                               trace_id=trace_id)
+
     # -- serving --------------------------------------------------------------
     def _model(self, name: Optional[str]) -> _LoadedModel:
         with self._lock:
@@ -461,6 +565,14 @@ class ModelServer:
     def unload(self, name: str):
         with self._lock:
             model = self._models.pop(name, None)
+            engine = self._generative.pop(name, None)
+        if engine is not None:
+            engine.close()
+            _count_models(-1)
+            from ..telemetry import recorder as _flight
+
+            _flight.get_recorder().record("model_unload", model=name)
+            return
         if model is None:
             raise errors.NotFoundError(
                 None, None, f"no model named {name!r} is loaded")
@@ -483,11 +595,16 @@ class ModelServer:
         with self._lock:
             models = list(self._models.values())
             self._models.clear()
+            engines = list(self._generative.values())
+            self._generative.clear()
         for model in models:
             for sig in model.signatures.values():
                 if sig.batcher is not None:
                     sig.batcher.close()
             model.session.close()
+            _count_models(-1)
+        for engine in engines:
+            engine.close()
             _count_models(-1)
 
     def __enter__(self):
@@ -510,7 +627,10 @@ class ModelServer:
         qps."""
         with self._lock:
             models = list(self._models.values())
+            engines = sorted(self._generative.items())
         rows: List[Dict[str, Any]] = []
+        for _name, engine in engines:
+            rows.append(engine.statusz_info())
         for m in models:
             for key, sig in sorted(m.signatures.items()):
                 b = sig.batcher
@@ -535,10 +655,13 @@ class ModelServer:
         an idle server reports 0 rather than its last batch's rate."""
         with self._lock:
             models = list(self._models.values())
+            engines = list(self._generative.values())
         for model in models:
             for sig in model.signatures.values():
                 if sig.batcher is not None:
                     sig.batcher.refresh_qps()
+        for engine in engines:
+            engine.refresh_rate()
         return {name: metric
                 for name, metric in monitoring.export().items()
                 if name.startswith("/stf/serving/")}

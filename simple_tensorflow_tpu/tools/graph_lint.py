@@ -152,7 +152,9 @@ def main(argv=None):
                          "the serving-compatibility rules "
                          "(lint/serving-incompatible — host stages, "
                          "Print/logging io, unseeded RNG in the fetch "
-                         "closure)")
+                         "closure — and lint/serving-decode-cache: "
+                         "KV-cache ops missing committed shardings, or "
+                         "a cache tensor escaping to host)")
     ap.add_argument("--max-severity", default="error",
                     choices=["note", "warning", "error"],
                     help="exit nonzero when any diagnostic reaches this "

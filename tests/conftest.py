@@ -52,6 +52,12 @@ def _no_pipeline_leaks():
                     if not s.closed]
     for s in open_servers:
         s.close()
+    from simple_tensorflow_tpu.serving import generative as serving_gen
+
+    open_engines = [e for e in list(serving_gen.live_engines)
+                    if not e.closed]
+    for e in open_engines:
+        e.close()
     open_telemetry = telemetry.get_server() is not None
     telemetry.shutdown()  # stops the HTTP server AND the watchdog
     # checkpoint writer (ISSUE 10): drain + stop the stf_ckpt_writer
@@ -88,6 +94,9 @@ def _no_pipeline_leaks():
     assert not open_servers, (
         "open ModelServer(s) leaked by this test module (close() them "
         f"or use a context manager): {open_servers!r}")
+    assert not open_engines, (
+        "open GenerativeEngine(s) leaked by this test module (close() "
+        f"them or use a context manager): {open_engines!r}")
     assert not open_telemetry, (
         "telemetry server left running by this test module — call "
         "stf.telemetry.stop() (or telemetry.shutdown()) in teardown")

@@ -276,3 +276,49 @@ def test_serving_rule_respects_graph_seed_and_input_boundary():
                                 purpose="serving",
                                 rules=["lint/serving-incompatible"])
     assert diags == [], analysis.format_report(diags)
+
+
+def test_decode_plan_graph_lint_serving(tmp_path):
+    # ISSUE 12 satellite: graph_lint --serving knows the decode plan
+    # shape. A well-formed generative decode graph (KV-cache ops with
+    # committed shardings, no cache host-sink) round-trips through
+    # GraphDef and lints CLEAN; stripping the sharding declaration or
+    # sinking a cache tensor to host is an ERROR.
+    import json
+
+    from simple_tensorflow_tpu.framework import graph_io
+    from simple_tensorflow_tpu.models import transformer as tr
+    from simple_tensorflow_tpu.ops import kv_cache_ops as kvc
+    from simple_tensorflow_tpu.tools import graph_lint
+
+    cfg = tr.TransformerConfig.tiny()
+    prog = tr.build_generative_program(
+        cfg, 8, num_slots=2, max_decode_len=4, decode_bucket_sizes=[2],
+        compute_dtype=stf.float32)
+    dec = prog["decode"][2]
+    diags = analysis.lint_graph(
+        fetches=[dec["next_tok"], dec["logp"]], purpose="serving",
+        rules=["lint/serving-decode-cache"])
+    assert diags == [], analysis.format_report(diags)
+
+    # GraphDef round trip through the CLI entry point
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    p = tmp_path / "decode.json"
+    p.write_text(json.dumps(gd))
+    fetches = [dec["next_tok"].name, dec["logp"].name]
+    stf.reset_default_graph()
+    diags2, graph, _ = graph_lint.run_lint(
+        json.loads(p.read_text()), fetch_names=fetches,
+        purpose="serving")
+    assert graph is not None
+    assert not any(d.code == "lint/serving-decode-cache"
+                   for d in diags2), analysis.format_report(diags2)
+
+    # negative: a cache gather that escapes to host is an ERROR
+    stf.reset_default_graph()
+    c = kvc.kv_cache("gate_cache", 2, 4, (2,), stf.float32)
+    g = c.gather(stf.placeholder(stf.int32, [1], "s"))
+    diags3 = analysis.lint_graph(
+        fetches=[g], purpose="serving",
+        rules=["lint/serving-decode-cache"])
+    assert any(d.severity == "error" for d in diags3)
