@@ -70,7 +70,8 @@ def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
             severities: Optional[dict] = None,
             mesh=None,
             sharding_seeds: Optional[dict] = None,
-            purpose: Optional[str] = None) -> List[Diagnostic]:
+            purpose: Optional[str] = None,
+            memory_budget: Optional[int] = None) -> List[Diagnostic]:
     """Run verifier + hazard detector + linter over a graph and return
     all diagnostics (the combined standalone entry point; the CLI and
     the models/examples CI gate call this). When ``mesh`` is given (a
@@ -96,7 +97,8 @@ def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
             diagnostics.metric_diagnostics.get_cell(
                 WARNING).increase_by(1)
     diags.extend(lint_graph(graph, fetches=fetches, severities=severities,
-                            purpose=purpose))
+                            purpose=purpose,
+                            memory_budget=memory_budget))
     if mesh is not None:
         report = analyze_sharding(graph=graph, mesh=mesh,
                                   seed_specs=sharding_seeds,

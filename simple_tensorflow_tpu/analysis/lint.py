@@ -43,6 +43,11 @@ Built-in catalog (see docs/ANALYSIS.md for the worked examples):
                          stf.kernels registry (routed / fallback+reason
                          / autotune). Active only for purpose="kernels"
                          runs (``graph_lint --kernels``) (NOTE)
+  lint/memory-budget     the static cost model's predicted peak device
+                         memory for a fetch closure exceeds the
+                         configured budget (``graph_lint --memory
+                         --budget BYTES``; ctx.memory_budget). Active
+                         only for purpose="memory" runs (ERROR)
 """
 
 from __future__ import annotations
@@ -70,12 +75,16 @@ class LintContext:
     def __init__(self, graph, ops: Sequence[Any],
                  fetches: Optional[Sequence[Any]] = None,
                  sharding_report: Optional[Any] = None,
-                 purpose: Optional[str] = None):
+                 purpose: Optional[str] = None,
+                 memory_budget: Optional[int] = None):
         self.graph = graph
         self.ops = list(ops)
         self.fetches = list(fetches or [])
         self.sharding_report = sharding_report
         self.purpose = purpose
+        # device-memory budget in bytes for the lint/memory-budget rule
+        # (graph_lint --memory --budget; purpose="memory" runs)
+        self.memory_budget = memory_budget
         self._x64 = None
 
     @property
@@ -129,19 +138,22 @@ def lint_graph(graph=None, ops: Optional[Sequence[Any]] = None,
                severities: Optional[Dict[str, str]] = None,
                rules: Optional[Sequence[str]] = None,
                sharding_report: Optional[Any] = None,
-               purpose: Optional[str] = None) -> List[Diagnostic]:
+               purpose: Optional[str] = None,
+               memory_budget: Optional[int] = None) -> List[Diagnostic]:
     """Run the registered rules. ``severities`` overrides per-code
     severity ("off" disables a rule); ``rules`` restricts to a subset;
     ``sharding_report`` feeds the sharding rules (analyze_sharding
     passes its own report through here); ``purpose="serving"``
     activates the serving-compatibility rules (ModelServer.load and
-    ``graph_lint --serving`` pass it)."""
+    ``graph_lint --serving`` pass it); ``purpose="memory"`` +
+    ``memory_budget`` activates the device-memory budget rule
+    (``graph_lint --memory --budget``)."""
     if graph is None and ops is None:
         graph = ops_mod.get_default_graph()
     if ops is None:
         ops = graph.get_operations()
     ctx = LintContext(graph, ops, fetches, sharding_report=sharding_report,
-                      purpose=purpose)
+                      purpose=purpose, memory_budget=memory_budget)
     severities = severities or {}
     diags: List[Diagnostic] = []
     for rule in registered_rules():
@@ -426,6 +438,64 @@ def _rule_serving_decode_cache(ctx):
                            f"({consumer.type}): the cache must stay "
                            "device-resident across decode steps "
                            "(host-sink on a cache tensor)")
+
+
+@register_lint_rule("memory-budget", ERROR)
+def _rule_memory_budget(ctx):
+    """A fetch closure whose statically predicted peak device memory
+    (framework/cost_model: resident variables + transient liveness
+    sweep) exceeds the configured budget (active only for
+    ``purpose="memory"`` runs with ``ctx.memory_budget`` set —
+    ``graph_lint --memory --budget BYTES``). The offline half of the
+    ``ConfigProto(device_memory_budget_bytes=)`` admission check: a
+    plan a budgeted Session would refuse at load fails CI here, before
+    any deploy. Without fetches, the whole graph's terminal ops are
+    the plan (one diagnostic)."""
+    if ctx.purpose != "memory" or not ctx.memory_budget:
+        return
+    from ..framework import cost_model
+
+    budget = int(ctx.memory_budget)
+    plans = plan_fetch_groups(ctx)
+    for label, fetches, anchor in plans:
+        try:
+            est = cost_model.estimate(fetches)
+        except Exception:  # noqa: BLE001 — un-costable plan: skip
+            continue
+        if est.peak_bytes > budget:
+            yield (anchor,
+                   f"plan {label!r}: predicted peak device memory "
+                   f"{int(est.peak_bytes)} B (resident "
+                   f"{int(est.resident_bytes)} B + transient "
+                   f"{int(est.peak_bytes - est.resident_bytes)} B) "
+                   f"exceeds the budget {budget} B "
+                   "(ConfigProto.device_memory_budget_bytes); a "
+                   "budgeted Session refuses this plan at admission")
+
+
+def plan_fetch_groups(ctx):
+    """(label, fetches, anchor_op) groups the memory rules treat as
+    one plan each: every explicit fetch is its own plan; with no
+    fetches, the graph's terminal ops (no consumed outputs) form one
+    whole-graph plan."""
+    groups = []
+    if ctx.fetches:
+        for f in ctx.fetches:
+            op = f if isinstance(f, ops_mod.Operation) else f.op
+            groups.append((getattr(f, "name", op.name), [f], op))
+        return groups
+    consumed = set()
+    for op in ctx.ops:
+        for t in op.inputs:
+            consumed.add(t)
+    terminals = [op for op in ctx.ops
+                 if op.outputs and not any(o in consumed
+                                           for o in op.outputs)]
+    if terminals:
+        groups.append(("(whole graph)",
+                       [o for op in terminals for o in op.outputs],
+                       terminals[0]))
+    return groups
 
 
 @register_lint_rule("kernel-routing", NOTE)

@@ -89,6 +89,10 @@ class AsyncSaverEngine:
             arrays = snap.materialize()
             snapshot_mod.write_native_checkpoint(
                 prefix, arrays, snap.tensor_index, snap.host_state)
+            # device copies served their purpose the moment the host
+            # npz is durable: drop them (and their ledger accounting —
+            # class "snapshot" returns to baseline; ISSUE 13)
+            snap.release_device_state()
             if write_meta_graph:
                 try:
                     from ..framework import graph_io

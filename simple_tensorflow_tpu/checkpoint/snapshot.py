@@ -67,11 +67,17 @@ class TrainingStateSnapshot:
     ``arrays`` holds *device-side copies* (not the live store arrays —
     those are donated to the next step's executable and would read as
     deleted buffers). ``materialize()`` moves them to host numpy; until
-    then the snapshot pins one extra copy of the state in device memory.
+    then the snapshot pins one extra copy of the state in device memory
+    — and accounts for it in the HBM ledger (stf.telemetry.memory,
+    class ``snapshot``): an in-flight async save transiently DOUBLES
+    the named variables' device memory, and the ledger makes that
+    visible. ``release_device_state()`` (called by the writer job after
+    the commit, and on GC as a fallback) drops the device copies and
+    the ledger entry back to baseline.
     """
 
     __slots__ = ("arrays", "tensor_index", "host_state", "step",
-                 "captured_at", "graph")
+                 "captured_at", "graph", "_mem_token", "__weakref__")
 
     def __init__(self, arrays, tensor_index, host_state, step=None,
                  graph=None):
@@ -81,6 +87,18 @@ class TrainingStateSnapshot:
         self.step = step
         self.captured_at = time.time()
         self.graph = graph
+        from ..telemetry import memory as _memory_mod
+
+        ledger = _memory_mod.get_ledger()
+        self._mem_token = ledger.register(
+            f"checkpoint_snapshot[{len(arrays)} tensors]",
+            self.nbytes(), _memory_mod.CLASS_SNAPSHOT, "checkpoint",
+            arrays=self)
+        # GC fallback: a snapshot dropped without release (error paths)
+        # must not leave a phantom ledger entry
+        import weakref
+
+        weakref.finalize(self, ledger.release, self._mem_token)
 
     def materialize(self) -> Dict[str, np.ndarray]:
         """D2H transfer of every snapshot array (writer-thread side)."""
@@ -88,6 +106,16 @@ class TrainingStateSnapshot:
         for key, arr in self.arrays.items():
             out[key] = np.asarray(arr)
         return out
+
+    def release_device_state(self) -> None:
+        """Drop the device-side copies (the host npz is durable by the
+        time the writer calls this) and their ledger accounting —
+        snapshot memory returns to baseline. Idempotent."""
+        from ..telemetry import memory as _memory_mod
+
+        self.arrays = {}
+        _memory_mod.get_ledger().release(self._mem_token)
+        self._mem_token = None
 
     def nbytes(self) -> int:
         return int(sum(getattr(a, "nbytes", 0)

@@ -135,6 +135,11 @@ class CheckpointWriter:
                         max(0, self._q.qsize()))
                     if self._q.unfinished_tasks == 0:
                         self._idle.set()
+                # drop the job closure NOW: holding it until the next
+                # queue item would pin its snapshot's device copies
+                # (and their HBM-ledger "snapshot" bytes) across the
+                # writer's idle stretches
+                del job, pending, item
 
     def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
         """Block until every submitted job has completed (success OR

@@ -277,13 +277,20 @@ def _call_step_executable(step, state, feed_args, rng_key, rng_ctr):
     except TypeError:
         if exe is step.jitted:
             raise
+        from ..telemetry import memory as _memory_mod
+
         if exe is step.compiled:
             step.compiled = None
             step.xla_cost = None
+            _memory_mod.get_ledger().release(step.compiled_mem_token)
+            step.compiled_mem_token = None
         elif sig is not None:
             # bucket executable compiled against older state avals
             # (e.g. variables re-initialized with a new dtype)
-            step.aot_cache.pop(sig, None)
+            stale = step.aot_cache.pop(sig, None)
+            if stale is not None:
+                _memory_mod.get_ledger().release(
+                    getattr(stale, "mem_token", None))
         return step.jitted(dict(state), feed_args, rng_key, rng_ctr)
 
 
@@ -430,12 +437,105 @@ def get_default_session():
     return stack[-1] if stack else None
 
 
-class VariableStore:
-    """Device-resident variable state: name -> jax.Array."""
+_store_counter = [0]
 
-    def __init__(self):
+
+def _release_ledger_tokens(tokens: Dict[str, int]):
+    """weakref.finalize callback for a dropped (never-closed) store:
+    whatever entries remain release so the ledger never leaks a dead
+    session's accounting. Must not capture the store itself (and must
+    never raise — finalizers can run at interpreter shutdown)."""
+    try:
+        from ..telemetry import memory as _memory_mod
+
+        ledger = _memory_mod.get_ledger()
+        for token in tokens.values():
+            ledger.release(token)
+        tokens.clear()
+    except Exception:  # noqa: BLE001 — accounting only
+        pass
+
+
+class VariableStore:
+    """Device-resident variable state: name -> jax.Array.
+
+    Every entry is accounted in the process HBM ledger
+    (stf.telemetry.memory): ``sync_ledger`` reconciles the ledger with
+    the store's key set — called after each state commit, it is a
+    two-comparison no-op while the key set is unchanged (the
+    steady-state training loop). Classification (weights / optimizer
+    slots / kv_cache / state) comes from ``classes`` hints (KV-cache
+    allocs register theirs at trace time) and the owning session's
+    classifier over the graph's variable registry."""
+
+    def __init__(self, owner: Optional[str] = None):
         self.values: Dict[str, Any] = {}
         self.shardings: Dict[str, Any] = {}
+        # ledger class hints by store name (e.g. "kv_cache", set by
+        # ops/kv_cache_ops at trace time); the classifier covers the rest
+        self.classes: Dict[str, str] = {}
+        self._classifier = None  # name -> ledger class (set by Session)
+        if owner is None:
+            _store_counter[0] += 1
+            owner = f"session-{_store_counter[0]}"
+        self.owner = owner
+        self._ledger_keys: frozenset = frozenset()
+        self._ledger_tokens: Dict[str, int] = {}
+        weakref.finalize(self, _release_ledger_tokens,
+                         self._ledger_tokens)
+
+    def sync_ledger(self):
+        """Reconcile ledger entries with the store's key set. Fast path
+        (unchanged keys — every steady-state step) is one dict-view
+        comparison; donation swaps array identities but never sizes."""
+        vals = self.values
+        if vals.keys() == self._ledger_keys:
+            return
+        from ..telemetry import memory as _memory_mod
+
+        ledger = _memory_mod.get_ledger()
+        keys = frozenset(vals)
+        for name in self._ledger_keys - keys:
+            ledger.release(self._ledger_tokens.pop(name, None))
+        for name in keys - self._ledger_keys:
+            arr = vals[name]
+            cls = self.classes.get(name)
+            if cls is None and self._classifier is not None:
+                try:
+                    cls = self._classifier(name)
+                except Exception:  # noqa: BLE001 — accounting only
+                    cls = None
+            # arrays=None: store attribution for reconcile() comes
+            # from the live_sessions sweep (one pass over each store),
+            # not per-entry refs — V entries each walking the V-array
+            # store would make reconcile O(V^2)
+            self._ledger_tokens[name] = ledger.register(
+                name, int(getattr(arr, "nbytes", 0)),
+                cls or _memory_mod.CLASS_STATE, self.owner)
+        self._ledger_keys = keys
+
+    def set_owner(self, owner: str):
+        """Re-label this store's ledger entries (ModelServer tags each
+        servable's store ``model:<name>`` after load)."""
+        from ..telemetry import memory as _memory_mod
+
+        self.owner = owner
+        ledger = _memory_mod.get_ledger()
+        for token in self._ledger_tokens.values():
+            ledger.release(token)
+        self._ledger_tokens.clear()
+        self._ledger_keys = frozenset()
+        self.sync_ledger()
+
+    def release_ledger(self):
+        """Drop every ledger entry (Session.close)."""
+        _release_ledger_tokens(self._ledger_tokens)
+        self._ledger_keys = frozenset()
+
+    def ledger_bytes(self) -> int:
+        from ..telemetry import memory as _memory_mod
+
+        return _memory_mod.get_ledger().live_bytes(owner=self.owner)
 
     def load(self, name: str, value, variable=None):
         import jax
@@ -456,6 +556,14 @@ class VariableStore:
         if sh is not None:
             arr = jax.device_put(arr, sh)
         self.values[name] = arr
+        token = self._ledger_tokens.get(name)
+        if token is not None:  # host re-load may resize/re-dtype
+            from ..telemetry import memory as _memory_mod
+
+            _memory_mod.get_ledger().update(
+                token, int(getattr(arr, "nbytes", 0)))
+        else:
+            self.sync_ledger()
 
     def as_numpy(self, name: str):
         return np.asarray(self.values[name])
@@ -581,7 +689,7 @@ class _CompiledStep:
                  "feed_shardings", "fused", "fusion_diags",
                  "sharding_report", "sharding_thread",
                  "sharding_sync_seconds", "sharding_gate", "aot_cache",
-                 "uses_rng")
+                 "uses_rng", "memory_estimate", "compiled_mem_token")
 
     def __init__(self):
         self.n_calls = 0
@@ -599,6 +707,14 @@ class _CompiledStep:
         # mismatch. xla_cost None = never tried, {} = tried, unavailable.
         self.compiled = None
         self.xla_cost = None
+        # per-plan memory accounting (stf.telemetry.memory): the cost
+        # model's predicted peak/resident bytes — computed eagerly when
+        # a device-memory budget gates admission, lazily by
+        # ExecutionPlan.memory_info() otherwise
+        self.memory_estimate = None
+        # HBM-ledger token of the traced-path AOT executable (class
+        # "executable"; released when the executable is dropped)
+        self.compiled_mem_token = None
         # steady-state staging slots (_staged_feed): tensor name -> its
         # sharding annotation (None = plain feed), plus per-mesh
         # committed NamedShardings under (name, "ns") keys
@@ -700,6 +816,38 @@ class ExecutionPlan:
         """Feed-shape signatures with a warm AOT executable."""
         return sorted(self._step.aot_cache)
 
+    def memory_info(self) -> Dict[str, Any]:
+        """Per-plan memory accounting (ISSUE 13, docs/OBSERVABILITY.md
+        "Device memory"): the static cost model's predicted peak /
+        resident / transient bytes for this plan, the XLA
+        ``memory_analysis`` of a compiled executable when one exists
+        (traced first call or an AOT bucket), and the HBM ledger's
+        measured live set — prediction next to measurement."""
+        sess = self._session
+        step = self._step
+        if step.memory_estimate is None:
+            step.memory_estimate = sess._estimate_plan_memory(
+                self._mapper.elements, self.feed_tensors)
+        out = dict(step.memory_estimate)
+        xla_mem = (step.xla_cost or {}).get("memory") \
+            if step.xla_cost else None
+        if not xla_mem and step.aot_cache:
+            from ..utils import perf
+
+            exe = next(iter(step.aot_cache.values()))
+            xla_mem = perf.memory_of(exe._compiled,
+                                     lowered=exe._lowered) or None
+        if xla_mem:
+            out["xla_memory"] = dict(xla_mem)
+        from ..telemetry import memory as _memory_mod
+
+        led = _memory_mod.get_ledger()
+        out["ledger_live_bytes"] = led.total_bytes()
+        out["ledger_session_bytes"] = led.live_bytes(
+            owner=sess._variable_store.owner)
+        out["budget_bytes"] = sess._memory_budget or None
+        return out
+
     def compile(self, feed_shapes=None):
         """AOT-compile the plan's device program for one feed-shape
         bucket and pin it in the step's executable cache.
@@ -753,6 +901,37 @@ class ExecutionPlan:
             exe = aot.compile_step(step.jitted, state, avals, rng_key,
                                    np.uint32(0))
         _metric_compile_seconds.get_cell().add(time.perf_counter() - t0)
+        # HBM ledger + budget admission (stf.telemetry.memory): the
+        # compile-time memory_analysis gates admission when the session
+        # carries a budget — a bucket whose transient footprint cannot
+        # fit is refused HERE, before any request OOMs mid-batch — and
+        # the executable's code buffer then registers as class
+        # "executable" (admission first: the not-yet-registered code
+        # bytes ride requested_bytes exactly once)
+        from ..telemetry import memory as _memory_mod
+        from ..utils import perf as _perf
+
+        mem = _perf.memory_of(exe._compiled, lowered=exe._lowered)
+        code_bytes = int(mem.get("generated_code_bytes", 0)) if mem \
+            else 0
+        if sess._memory_budget and mem:
+            transient = (mem.get("temp_bytes", 0)
+                         + mem.get("output_bytes", 0)
+                         - mem.get("alias_bytes", 0))
+            _memory_mod.check_budget(
+                sess._memory_budget, max(0, transient) + code_bytes,
+                "compile", owner=sess._variable_store.owner,
+                detail=f"AOT bucket memory_analysis: {mem}")
+        exe.mem_token = _memory_mod.get_ledger().register(
+            f"aot:{exe.cache_key}", code_bytes,
+            _memory_mod.CLASS_EXECUTABLE, sess._variable_store.owner)
+        # a recompile of the same bucket replaces the cached
+        # executable: release the predecessor's ledger entry or its
+        # code bytes leak as phantom live set
+        prev = step.aot_cache.get(exe.feed_signature)
+        if prev is not None:
+            _memory_mod.get_ledger().release(
+                getattr(prev, "mem_token", None))
         step.aot_cache[exe.feed_signature] = exe
         return exe
 
@@ -838,6 +1017,15 @@ class BaseSession:
         self._guard_warned: Set[str] = set()
         self._fusion_warned: Set[Any] = set()
         self._variable_store = VariableStore()
+        self._variable_store._classifier = self._classify_var
+        # device-memory budget (stf.telemetry.memory; ISSUE 13): plans,
+        # AOT compiles, and servable loads against this session are
+        # admission-checked against the process HBM ledger — a program
+        # that cannot fit is refused with ResourceExhaustedError (and a
+        # forensic ledger dump) BEFORE launch. None = unlimited.
+        self._memory_budget = int(getattr(
+            config, "device_memory_budget_bytes", 0) or 0) \
+            if config is not None else 0
         self._cache: Dict[Any, _CompiledStep] = {}
         # (fetch, feed) signature -> rewrite_version at last plan:
         # classifies executable-cache miss reasons
@@ -863,6 +1051,26 @@ class BaseSession:
         # avals, so one callable serves every snapshot shape
         self._snapshot_copy_fn = None
         live_sessions.add(self)
+
+    def _classify_var(self, name: str) -> Optional[str]:
+        """Ledger class for a store entry (stf.telemetry.memory):
+        kv_cache hints land in ``store.classes`` at trace time; slot
+        variables carry ``_mem_class`` (train/slot_creator and the
+        fused flat layout both mark theirs); trainable Variables are
+        weights; everything else (global_step, counters, EMA shadows)
+        is generic device state."""
+        from ..telemetry import memory as _memory_mod
+
+        registry = self._graph._scoped_state.get(
+            "__vars_by_store_name__", {})
+        var = registry.get(name)
+        if var is None:
+            return _memory_mod.CLASS_STATE
+        cls = getattr(var, "_mem_class", None)
+        if cls:
+            return cls
+        return _memory_mod.CLASS_WEIGHTS if var.trainable \
+            else _memory_mod.CLASS_STATE
 
     # -- stf.analysis hooks --------------------------------------------------
     def _hazard_mode(self) -> str:
@@ -1093,7 +1301,18 @@ class BaseSession:
     # -- lifecycle -----------------------------------------------------------
     def close(self):
         self._closed = True
+        # release this session's HBM-ledger accounting: store entries
+        # (weights/slots/caches) and every registered AOT executable
+        from ..telemetry import memory as _memory_mod
+
+        ledger = _memory_mod.get_ledger()
+        for step in list(self._cache.values()):
+            ledger.release(step.compiled_mem_token)
+            step.compiled_mem_token = None
+            for exe in step.aot_cache.values():
+                ledger.release(getattr(exe, "mem_token", None))
         self._cache.clear()
+        self._variable_store.release_ledger()
 
     def __enter__(self):
         if not hasattr(_default_session_stack, "stack"):
@@ -1502,10 +1721,14 @@ class BaseSession:
                             jax.block_until_ready(list(outs))
                     except Exception as e:
                         _flight_mod.get_recorder().on_error(
-                            e, where="fused_device_execute", n_steps=n)
+                            e, where="fused_device_execute", n_steps=n,
+                            plan_memory=((step.xla_cost or {})
+                                         .get("memory")
+                                         or step.memory_estimate))
                         raise
                 self._variable_store.values = dict(new_state)
                 self._apply_declared_shardings(new_state.keys())
+                self._variable_store.sync_ledger()
                 fused["n_calls"] += 1
                 _metric_fused_steps.get_cell().increase_by(n)
                 if deadline is not None:
@@ -1573,6 +1796,19 @@ class BaseSession:
             }
             if trace_buf is not None:
                 stats["start_us"] = 0
+                # bytes-over-time counter track (ISSUE 13): ledger
+                # samples that landed during the window (store commits,
+                # snapshot captures/releases) render as a chrome
+                # counter series next to the op tracks
+                from ..telemetry import memory as _memory_mod
+
+                led = _memory_mod.get_ledger()
+                samples = [{"t_us": max(0.0, (ts - t0) * 1e6),
+                            "bytes": b}
+                           for ts, b in led.history(since_mono=t0)]
+                samples.append({"t_us": wall * 1e6,
+                                "bytes": led.total_bytes()})
+                stats["memory_samples"] = samples
                 nodes = _drain_spans_to_nodes(trace_buf, t0)
                 fw = [nd for nd in nodes
                       if nd["name"] == "fused_device_execute"]
@@ -1861,10 +2097,16 @@ class BaseSession:
                         # a device-program failure is the flight
                         # recorder's prime customer: record + auto-dump
                         # (rate-limited) so the ring around the crash
-                        # survives the process
+                        # survives the process. RESOURCE_EXHAUSTED
+                        # additionally lands an `oom` event with the
+                        # HBM-ledger snapshot + this plan's memory
+                        # analysis (telemetry.memory OOM forensics).
                         _flight_mod.get_recorder().on_error(
                             e, where="device_execute",
-                            n_device_ops=len(step.device_ops))
+                            n_device_ops=len(step.device_ops),
+                            plan_memory=((step.xla_cost or {})
+                                         .get("memory")
+                                         or step.memory_estimate))
                         raise
                     if check_flags:
                         # inspect BEFORE committing state: a failed check
@@ -1881,6 +2123,7 @@ class BaseSession:
                                 None, None, "; ".join(bad))
                     self._variable_store.values = dict(new_state)
                     self._apply_declared_shardings(new_state.keys())
+                    self._variable_store.sync_ledger()
                     device_results = list(fetch_vals)
                     step.n_calls += 1
                     if collector is not None or deadline is not None:
@@ -2088,6 +2331,18 @@ class BaseSession:
                 _metric_compile_seconds.get_cell().add(compile_s)
                 collector["compile_time_s"] = compile_s
                 step.xla_cost = _executable_analysis(lowered, step.compiled)
+                if step.compiled_mem_token is None:
+                    # AOT executable buffers account in the HBM ledger,
+                    # sized from the harvested memory_analysis
+                    from ..telemetry import memory as _memory_mod
+
+                    code = int(((step.xla_cost or {}).get("memory")
+                                or {}).get("generated_code_bytes", 0))
+                    step.compiled_mem_token = \
+                        _memory_mod.get_ledger().register(
+                            "traced_executable", code,
+                            _memory_mod.CLASS_EXECUTABLE,
+                            self._variable_store.owner)
             else:
                 with monitoring.traceme("cost_analysis"):
                     lowered = step.jitted.lower(dict(state), feed_args,
@@ -2132,6 +2387,60 @@ class BaseSession:
         return self._base_key, np.uint32(self._run_counter + 1)
 
     # -- planning ------------------------------------------------------------
+    def _estimate_plan_memory(self, elements, feeds) -> Dict[str, Any]:
+        """Static cost-model peak/resident prediction for a plan
+        (framework/cost_model liveness sweep) in the shape
+        ``ExecutionPlan.memory_info`` and the budget admission share.
+        Best-effort: an un-costable plan predicts zeros rather than
+        failing the plan."""
+        from ..framework import cost_model
+
+        try:
+            est = cost_model.estimate(list(elements),
+                                      feeds=list(feeds))
+            peak = int(est.peak_bytes)
+            resident = int(est.resident_bytes)
+        except Exception:  # noqa: BLE001 — accounting only
+            peak = resident = 0
+        return {"predicted_peak_bytes": peak,
+                "predicted_resident_bytes": resident,
+                "predicted_transient_bytes": max(0, peak - resident)}
+
+    def _admit_plan_memory(self, step, elements, feeds) -> None:
+        """Budget admission at PLAN time (ISSUE 13): predicted peak
+        minus the plan's already-ledgered resident state is the NEW
+        device memory this plan asks for; over budget raises
+        ResourceExhaustedError (with the ledger forensics) before the
+        program ever compiles or launches."""
+        step.memory_estimate = self._estimate_plan_memory(elements,
+                                                          feeds)
+        if not self._memory_budget or not step.has_device_stage:
+            return
+        # variables already resident in THIS session's store are in the
+        # ledger — don't charge them twice
+        store = self._variable_store.values
+        seen: Set[str] = set()
+        already = 0
+        for op in step.device_ops:
+            if op.type in ("VariableV2", "ReadVariable"):
+                vn = op.attrs.get("var_name", op.name)
+                if vn in seen:
+                    continue
+                seen.add(vn)
+                arr = store.get(vn)
+                if arr is not None:
+                    already += int(getattr(arr, "nbytes", 0))
+        requested = max(
+            0, step.memory_estimate["predicted_peak_bytes"] - already)
+        from ..telemetry import memory as _memory_mod
+
+        _memory_mod.check_budget(
+            self._memory_budget, requested, "plan",
+            owner=self._variable_store.owner,
+            detail="cost-model predicted peak "
+                   f"{step.memory_estimate['predicted_peak_bytes']} B "
+                   f"(resident {already} B already ledgered)")
+
     def _plan_has_sharding_signals(self, pruned, fed_set) -> bool:
         """Whether a plan is worth sharding-analyzing: it is fed (a
         step-shaped program — the mesh-axis-unused lint is exactly
@@ -2428,6 +2737,11 @@ class BaseSession:
                        n_diagnostics=len(plan_diags))
         step.has_device_stage = bool(device_ops)
         step.uses_rng = bool(device_ops) and _plan_uses_rng(device_ops)
+        if self._memory_budget:
+            # device-memory budget admission (stf.telemetry.memory):
+            # refuse un-fittable plans BEFORE compile/launch; the
+            # estimate is skipped entirely when no budget is set
+            self._admit_plan_memory(step, elements, list(feeds))
         if not step.has_device_stage:
             step.jitted = None
             return step
@@ -2527,6 +2841,7 @@ class BaseSession:
         with self._lock:
             for name in ctx.written:
                 self._variable_store.values[name] = ctx.state[name]
+            self._variable_store.sync_ledger()
 
         values = []
         for e in mapper.elements:
@@ -2638,6 +2953,7 @@ class BaseSession:
                             None, None, "; ".join(bad))
                 self._variable_store.values = dict(new_state)
                 self._apply_declared_shardings(new_state.keys())
+                self._variable_store.sync_ledger()
                 step.n_calls += 1
             dev_map = dict(zip(step.device_fetches, fetch_vals))
             values = []

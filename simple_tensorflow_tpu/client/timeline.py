@@ -102,11 +102,23 @@ class Timeline:
              "args": {"bytes": 0}},
         ]
 
+    def _ledger_events(self):
+        """Counter events from the HBM ledger's bytes-over-time samples
+        (``step_stats["memory_samples"]`` — traced ``run_steps`` windows
+        record them from stf.telemetry.memory): live device bytes as a
+        chrome counter series next to the op tracks."""
+        samples = self._step_stats.get("memory_samples") or []
+        track = "device memory (ledger live bytes)"
+        return [{"name": track, "ph": "C", "pid": self._PID,
+                 "ts": s["t_us"], "args": {"bytes": int(s["bytes"])}}
+                for s in samples]
+
     def generate_chrome_trace_format(self, show_dataflow=True,
                                      show_memory=False):
         events = list(self._events)
         if show_memory:
             events.extend(self._memory_events())
+            events.extend(self._ledger_events())
         return json.dumps({"traceEvents": events,
                            "displayTimeUnit": "ms"})
 

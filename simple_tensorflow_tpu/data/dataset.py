@@ -399,6 +399,17 @@ class Dataset:
             src = iter(staged.prefetch(buffer_size))
             import contextlib
 
+            from ..telemetry import memory as _memory_mod
+
+            ledger = _memory_mod.get_ledger()
+            # HBM ledger (ISSUE 13): the staged batch in flight
+            # accounts as class "staged_feed" — one rolling entry per
+            # pipeline, updated to the latest staged batch's bytes
+            # (released when the iterator closes); the arrays also
+            # register as transients so reconcile() attributes them
+            mem_token = ledger.register(
+                "prefetch_to_device", 0, _memory_mod.CLASS_STAGED,
+                "prefetch")
             try:
                 for x in src:
                     slot = None
@@ -419,8 +430,14 @@ class Dataset:
                             out = jax.device_put(x, sharding)
                         if pool is not None:
                             pool.mark_in_flight(out, slot=slot)
+                    nbytes = (sum(getattr(a, "nbytes", 0) for a in out)
+                              if isinstance(out, tuple)
+                              else getattr(out, "nbytes", 0))
+                    ledger.update(mem_token, nbytes)
+                    ledger.track_transient(out)
                     yield out
             finally:
+                ledger.release(mem_token)
                 if hasattr(src, "close"):
                     src.close()
 

@@ -101,6 +101,17 @@ class ConfigProto:
     checkpoint layout) set STF_PALLAS=0 / stf.kernels.set_mode("off")
     BEFORE building the optimizer.
 
+    device_memory_budget_bytes: device-memory admission budget for this
+    Session (stf.telemetry.memory; docs/OBSERVABILITY.md "Device
+    memory"). When set, every plan is admission-checked at plan time
+    (static cost-model peak vs the process HBM ledger's live set),
+    every AOT bucket at compile time (XLA memory_analysis), and
+    ModelServer.load / GenerativeEngine construction refuse servables
+    that cannot fit — all with errors.ResourceExhaustedError naming
+    the top owners by bytes plus a flight-recorder oom dump, BEFORE
+    anything launches. None/0 (default) disables the check (and its
+    plan-time cost estimate entirely).
+
     telemetry_port: start the process's stf.telemetry HTTP server
     (``/metrics`` Prometheus scrape, ``/healthz``, ``/statusz``,
     ``/tracez``, ``/flightz``; docs/OBSERVABILITY.md) when the Session
@@ -123,7 +134,7 @@ class ConfigProto:
                  graph_analysis="off", variable_hazard_mode=None,
                  loop_fusion_steps=1, async_fetches=False,
                  compile_cache_dir=None, telemetry_port=None,
-                 kernel_registry=None):
+                 kernel_registry=None, device_memory_budget_bytes=None):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -166,6 +177,13 @@ class ConfigProto:
                 f"kernel_registry must be None|off|auto|force, "
                 f"got {kernel_registry!r}")
         self.kernel_registry = kernel_registry
+        if device_memory_budget_bytes is not None:
+            device_memory_budget_bytes = int(device_memory_budget_bytes)
+            if device_memory_budget_bytes < 0:
+                raise ValueError(
+                    "device_memory_budget_bytes must be >= 0 or None, "
+                    f"got {device_memory_budget_bytes}")
+        self.device_memory_budget_bytes = device_memory_budget_bytes
         if telemetry_port is not None:
             telemetry_port = int(telemetry_port)
             if telemetry_port < 0 or telemetry_port > 65535:

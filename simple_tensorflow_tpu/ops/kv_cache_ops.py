@@ -65,9 +65,23 @@ def _np_dtype(op):
     return dtypes_mod.as_dtype(op.attrs["dtype"]).np_dtype
 
 
+def _hint_cache_class(ctx, op):
+    """Tag the cache's store entry for the HBM ledger (trace-time
+    Python side effect — stf.telemetry.memory classifies the store
+    name as kv_cache instead of generic state)."""
+    sess = getattr(ctx, "session", None)
+    if sess is not None:
+        try:
+            sess._variable_store.classes[op.attrs["var_name"]] = \
+                "kv_cache"
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
+
+
 def _lower_kv_alloc(ctx, op, inputs):
     import jax.numpy as jnp
 
+    _hint_cache_class(ctx, op)
     shape = tuple(int(d) for d in op.attrs["shape"])
     val = jnp.zeros(shape, _np_dtype(op))
     ctx.write_var(op.attrs["var_name"], val)

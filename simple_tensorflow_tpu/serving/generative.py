@@ -235,6 +235,18 @@ class GenerativeEngine:
                     f"({missing}; model compiled {model_buckets}); "
                     "align decode_bucket_sizes at model build with the "
                     "policy")
+        # device-memory admission (stf.telemetry.memory): a model whose
+        # resident footprint (weights + cache pages, already ledgered
+        # under its store owner) exceeds the session's budget is
+        # refused here — before the scheduler thread ever starts
+        msess = getattr(model, "session", None)
+        if msess is not None and getattr(msess, "_memory_budget", 0):
+            from ..telemetry import memory as _memory_mod
+
+            _memory_mod.check_budget(
+                msess._memory_budget, 0, "generative_engine",
+                owner=msess._variable_store.owner,
+                detail=f"engine {name!r}: {policy.num_slots} slots")
         self._pool = CacheSlotPool(policy.num_slots)
         self._queue = RingBuffer(policy.max_queue_depth,
                                  stats=_QueueStats(name))

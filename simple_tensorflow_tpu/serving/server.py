@@ -210,6 +210,19 @@ class ModelServer:
                     f"SavedModel at {export_dir} has no signature_defs "
                     "— nothing to serve (export with "
                     "saved_model.simple_save or a signature_def_map)")
+            # HBM ledger (stf.telemetry.memory): the servable's store
+            # accounts under its model name; with a device-memory
+            # budget on the config, a model whose restored state
+            # already blows the budget is refused HERE — before plans
+            # compile or traffic arrives — with the ledger forensics
+            session._variable_store.set_owner(f"model:{name}")
+            if session._memory_budget:
+                from ..telemetry import memory as _memory_mod
+
+                _memory_mod.check_budget(
+                    session._memory_budget, 0, "model_load",
+                    owner=f"model:{name}",
+                    detail=f"loading model {name!r} from {export_dir}")
             model = _LoadedModel(name, export_dir, graph, session, policy)
             try:
                 for key in wanted:
@@ -376,6 +389,25 @@ class ModelServer:
         try:
             if callable(model) and not hasattr(model, "decode"):
                 model = created_model = model()
+            # HBM ledger: the generative servable's store (weights +
+            # kv_cache pages) accounts under its model name; budget
+            # admission refuses a model + cache-pool footprint that
+            # cannot fit BEFORE the engine thread starts
+            msess = getattr(model, "session", None)
+            if msess is not None:
+                msess._variable_store.set_owner(f"model:{name}")
+                budget = msess._memory_budget or (int(getattr(
+                    self._config, "device_memory_budget_bytes", 0) or 0)
+                    if self._config is not None else 0)
+                if budget:
+                    from ..telemetry import memory as _memory_mod
+
+                    _memory_mod.check_budget(
+                        budget, 0, "load_generative",
+                        owner=f"model:{name}",
+                        detail=f"generative servable {name!r}: "
+                               f"{model.num_slots} cache slots x "
+                               f"{model.max_decode_len} positions")
             policy = policy or DecodePolicy(
                 num_slots=model.num_slots,
                 max_decode_len=model.max_decode_len,

@@ -13,6 +13,12 @@ Three always-on layers over the ``stf.monitoring`` substrate:
   dumped as JSONL on demand, on unhandled execution errors, on SIGTERM,
   and when the watchdog catches a wedged fused window or serving batch
   (with all-thread stack snapshots).
+- **Device-memory ledger** (``telemetry.memory``): every long-lived
+  device allocation (weights/optimizer slots/KV-cache pages/snapshots/
+  AOT executables/staged feeds) registers by class and owner —
+  ``/stf/memory/*`` gauges, the ``/memz`` endpoint, budget admission
+  (``ConfigProto(device_memory_budget_bytes=)``), OOM forensics, and
+  ``memory.reconcile()`` leak detection against ``jax.live_arrays()``.
 """
 
 from .recorder import (FlightRecorder, get_recorder, record_event,
@@ -22,6 +28,8 @@ from .tracing import (new_trace_id, current_trace_id, current_trace_ids,
                       clear_spans, chrome_trace)
 from .watchdog import Watchdog, get_watchdog, deadline_for
 from .server import TelemetryServer, start, stop, get_server
+from . import memory
+from .memory import MemoryLedger, get_ledger, reconcile
 
 __all__ = [
     "FlightRecorder", "get_recorder", "record_event", "thread_stacks",
@@ -31,6 +39,7 @@ __all__ = [
     "chrome_trace",
     "Watchdog", "get_watchdog", "deadline_for",
     "TelemetryServer", "start", "stop", "get_server",
+    "memory", "MemoryLedger", "get_ledger", "reconcile",
     "dump_flight_recorder", "shutdown",
 ]
 

@@ -1,0 +1,482 @@
+"""Device-memory observability: the process-global HBM ledger.
+
+(ref: the role of tensorflow/core/common_runtime/bfc_allocator.cc's
+allocation tracking + core/framework/allocator metrics in the reference
+stack, and the memory pages of its /varz surfaces — arXiv 1605.08695
+treats memory visibility as a first-order operational concern. Here XLA
+buffer donation plays the allocator, so the library tracks the
+*logical* device-resident objects it creates instead of raw malloc.)
+
+Every long-lived device-resident allocation the library makes registers
+with one :class:`MemoryLedger`, tagged by CLASS and OWNER:
+
+  ``weights``          trainable Variables in a Session's VariableStore
+  ``optimizer_slots``  slot variables (per-var and fused-flat layouts)
+  ``kv_cache``         paged decode-cache pages (ops/kv_cache_ops)
+  ``state``            other store entries (global_step, counters, ...)
+  ``snapshot``         in-flight checkpoint barrier snapshots (these
+                       transiently DOUBLE the named variables' memory)
+  ``executable``       AOT executable buffers, sized from the harvested
+                       XLA ``memory_analysis`` (generated code)
+  ``staged_feed``      device-staged input batches (prefetch_to_device)
+
+The ledger exports ``/stf/memory/live_bytes{class,owner}`` gauges plus
+a high-watermark, keeps a bounded bytes-over-time history ring (the
+``/memz`` peak timeline and the traced-run_steps memory track), and
+reconciles against ``jax.live_arrays()`` on demand — drift between the
+two is the leak signal (``/stf/memory/reconcile_drift_bytes``).
+
+Budget enforcement (``ConfigProto(device_memory_budget_bytes=)``):
+:func:`check_budget` refuses an allocation/plan whose projected live
+set exceeds the budget with ``errors.ResourceExhaustedError`` *before*
+launch, naming the top owners by bytes and dumping the flight recorder
+(an OOM you can read, instead of an XLA RESOURCE_EXHAUSTED mid-batch).
+
+Gauge label hygiene: per-session owners would grow the gauge cell set
+without bound across a process's many Sessions, so anonymous sessions
+roll up under the ``session`` owner label; explicitly named owners
+(``model:<name>``, ``checkpoint``, ``prefetch``) keep their label. The
+ledger's own breakdown (``/memz``, :meth:`MemoryLedger.snapshot`)
+always carries the precise owner.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from ..platform import monitoring
+
+# -- ledger classes ----------------------------------------------------------
+CLASS_WEIGHTS = "weights"
+CLASS_OPTIMIZER = "optimizer_slots"
+CLASS_KV_CACHE = "kv_cache"
+CLASS_STATE = "state"
+CLASS_SNAPSHOT = "snapshot"
+CLASS_EXECUTABLE = "executable"
+CLASS_STAGED = "staged_feed"
+
+_metric_live = monitoring.IntGauge(
+    "/stf/memory/live_bytes",
+    "Device-resident bytes currently registered with the HBM ledger, "
+    "by allocation class and owner", "class", "owner")
+_metric_hwm = monitoring.IntGauge(
+    "/stf/memory/high_watermark_bytes",
+    "High watermark of total ledger-registered device bytes")
+_metric_registrations = monitoring.Counter(
+    "/stf/memory/registrations",
+    "Ledger allocation registrations, by class", "class")
+_metric_releases = monitoring.Counter(
+    "/stf/memory/releases",
+    "Ledger allocation releases, by class", "class")
+_metric_budget_rejections = monitoring.Counter(
+    "/stf/memory/budget_rejections",
+    "Allocations/plans refused by the device-memory budget admission "
+    "check, by call site", "what")
+_metric_oom_events = monitoring.Counter(
+    "/stf/memory/oom_events",
+    "RESOURCE_EXHAUSTED failures observed (runtime OOMs + budget "
+    "refusals), by where", "where")
+_metric_drift = monitoring.IntGauge(
+    "/stf/memory/reconcile_drift_bytes",
+    "Bytes of live jax arrays NOT attributable to any ledger owner at "
+    "the last reconcile() — the leak gauge (0 = ledger and runtime "
+    "agree)")
+
+_HISTORY_CAPACITY = 4096
+
+
+class _Entry:
+    __slots__ = ("token", "name", "cls", "owner", "gauge_owner",
+                 "nbytes", "created_at", "arrays_ref")
+
+    def __init__(self, token, name, cls, owner, gauge_owner, nbytes,
+                 arrays_ref):
+        self.token = token
+        self.name = name
+        self.cls = cls
+        self.owner = owner
+        self.gauge_owner = gauge_owner
+        self.nbytes = int(nbytes)
+        self.created_at = time.time()
+        # weakref to an object exposing the live device arrays backing
+        # this entry (VariableStore / TrainingStateSnapshot), consumed
+        # by reconcile() to build the tracked-array id set
+        self.arrays_ref = arrays_ref
+
+
+def _gauge_owner(owner: str) -> str:
+    # anonymous per-session owners roll up (see module docstring)
+    return "session" if owner.startswith("session") else owner
+
+
+class MemoryLedger:
+    """Thread-safe accounting of device-resident allocations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[int, _Entry] = {}
+        self._next_token = 1
+        self._totals: Dict[Any, int] = {}   # (class, owner) -> bytes
+        self._total = 0
+        self._hwm = 0
+        self._history: "collections.deque" = collections.deque(
+            maxlen=_HISTORY_CAPACITY)
+        # id(array) -> weakref, for short-lived device arrays that have
+        # no owning registry object (staged feed batches): reconcile
+        # treats them as tracked without the ledger owning their bytes
+        self._transient: Dict[int, Any] = {}
+        self._gauge_cells: Dict[Any, Any] = {}
+
+    # -- registration ---------------------------------------------------------
+    def register(self, name: str, nbytes: int, cls: str,
+                 owner: str = "session", arrays=None) -> int:
+        """Register one allocation; returns a token for release().
+        ``arrays``: optional object whose live device arrays back this
+        entry (an object with ``.values`` dict or a dict of arrays) —
+        held weakly, consumed by :meth:`reconcile`."""
+        nbytes = int(nbytes)
+        ref = None
+        if arrays is not None:
+            try:
+                ref = weakref.ref(arrays)
+            except TypeError:
+                ref = None
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            e = _Entry(token, name, cls, owner, _gauge_owner(owner),
+                       nbytes, ref)
+            self._entries[token] = e
+            self._apply_delta(e, nbytes)
+        _metric_registrations.get_cell(cls).increase_by(1)
+        return token
+
+    def update(self, token: Optional[int], nbytes: int) -> None:
+        """Resize an existing entry (e.g. a re-initialized variable)."""
+        if token is None:
+            return
+        nbytes = int(nbytes)
+        with self._lock:
+            e = self._entries.get(token)
+            if e is None:
+                return
+            delta = nbytes - e.nbytes
+            e.nbytes = nbytes
+            if delta:
+                self._apply_delta(e, delta)
+
+    def release(self, token: Optional[int]) -> None:
+        if token is None:
+            return
+        with self._lock:
+            e = self._entries.pop(token, None)
+            if e is None:
+                return
+            self._apply_delta(e, -e.nbytes)
+        _metric_releases.get_cell(e.cls).increase_by(1)
+
+    def _apply_delta(self, e: _Entry, delta: int) -> None:
+        # caller holds the lock
+        key = (e.cls, e.owner)
+        self._totals[key] = self._totals.get(key, 0) + delta
+        if self._totals[key] <= 0:
+            self._totals.pop(key, None)
+        self._total += delta
+        if self._total > self._hwm:
+            self._hwm = self._total
+            _metric_hwm.get_cell().set(int(self._hwm))
+        self._history.append((time.perf_counter(), self._total))
+        gkey = (e.cls, e.gauge_owner)
+        cell = self._gauge_cells.get(gkey)
+        if cell is None:
+            cell = self._gauge_cells[gkey] = _metric_live.get_cell(*gkey)
+        cell.set(max(0, cell.value() + delta))
+
+    def track_transient(self, value) -> None:
+        """Mark device arrays as library-staged (no ledger bytes): a
+        ``reconcile()`` attributes them instead of reporting drift.
+        Accepts an array or a (possibly nested) tuple/list of them."""
+        if isinstance(value, (tuple, list)):
+            for v in value:
+                self.track_transient(v)
+            return
+        try:
+            r = weakref.ref(value)
+        except TypeError:
+            return
+        with self._lock:
+            self._transient[id(value)] = r
+            if len(self._transient) > 512:
+                self._transient = {k: v for k, v in
+                                   self._transient.items()
+                                   if v() is not None}
+
+    # -- queries --------------------------------------------------------------
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._total
+
+    def high_watermark(self) -> int:
+        with self._lock:
+            return self._hwm
+
+    def live_bytes(self, cls: Optional[str] = None,
+                   owner: Optional[str] = None) -> int:
+        with self._lock:
+            if cls is None and owner is None:
+                return self._total
+            return sum(v for (c, o), v in self._totals.items()
+                       if (cls is None or c == cls)
+                       and (owner is None or o == owner))
+
+    def breakdown(self) -> Dict[str, Dict[str, int]]:
+        """{class: {owner: bytes}} of the current live set."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for (c, o), v in self._totals.items():
+                out.setdefault(c, {})[o] = v
+        return out
+
+    def owners_by_bytes(self) -> List[Any]:
+        """[(owner, bytes)] descending — the OOM-forensics headline."""
+        agg: Dict[str, int] = {}
+        with self._lock:
+            for (_c, o), v in self._totals.items():
+                agg[o] = agg.get(o, 0) + v
+        return sorted(agg.items(), key=lambda kv: -kv[1])
+
+    def top_allocations(self, n: int = 10) -> List[Dict[str, Any]]:
+        with self._lock:
+            entries = sorted(self._entries.values(),
+                             key=lambda e: -e.nbytes)[:n]
+            return [{"name": e.name, "class": e.cls, "owner": e.owner,
+                     "bytes": e.nbytes,
+                     "age_s": round(time.time() - e.created_at, 3)}
+                    for e in entries]
+
+    def history(self, since_mono: Optional[float] = None
+                ) -> List[Any]:
+        """[(perf_counter_s, total_bytes)] samples, oldest first."""
+        with self._lock:
+            hist = list(self._history)
+        if since_mono is not None:
+            hist = [h for h in hist if h[0] >= since_mono]
+        return hist
+
+    def snapshot(self, top: int = 10) -> Dict[str, Any]:
+        """The /memz payload core (also attached to OOM dumps)."""
+        return {
+            "total_bytes": self.total_bytes(),
+            "high_watermark_bytes": self.high_watermark(),
+            "by_class_owner": self.breakdown(),
+            "owners_by_bytes": [
+                {"owner": o, "bytes": b}
+                for o, b in self.owners_by_bytes()],
+            "top_allocations": self.top_allocations(top),
+            "n_entries": len(self._entries),
+        }
+
+    # -- reconciliation (leak detection) --------------------------------------
+    def reconcile(self, top: int = 5) -> Dict[str, Any]:
+        """Diff the ledger against ``jax.live_arrays()``.
+
+        Builds the set of device arrays the ledger can attribute — the
+        live VariableStores and snapshot entries it holds weakly, every
+        live Session's RNG base key, and transiently-tracked staged
+        feeds — then classifies each live jax array as tracked or
+        untracked. ``untracked_bytes`` is the drift (leak) gauge: after
+        GC on a quiesced process it must be 0 (tests/bench gate it).
+        ``dead_entry_bytes`` is the opposite drift — ledger entries
+        whose backing arrays no longer exist."""
+        import gc
+        import sys
+
+        import jax
+
+        tracked: Dict[int, str] = {}
+
+        def _track(arr, label):
+            if arr is None:
+                return
+            tracked[id(arr)] = label
+            # a typed PRNG key array wraps its uint32 buffer in a
+            # separate object; live_arrays() reports the buffer
+            base = getattr(arr, "_base_array", None)
+            if base is not None:
+                tracked[id(base)] = label
+
+        with self._lock:
+            entries = list(self._entries.values())
+            transient = list(self._transient.values())
+        dead_entry_bytes = 0
+        for e in entries:
+            if e.arrays_ref is None:
+                continue
+            obj = e.arrays_ref()
+            if obj is None:
+                dead_entry_bytes += e.nbytes
+                continue
+            # VariableStore exposes .values, TrainingStateSnapshot
+            # .arrays; a plain dict (or an array list) passes through
+            values = getattr(obj, "values", None)
+            if values is None:
+                values = getattr(obj, "arrays", obj)
+            if callable(values):  # a dict's bound .values
+                values = values()
+            if isinstance(values, dict):
+                values = values.values()
+            try:
+                for arr in values:
+                    _track(arr, f"{e.cls}:{e.owner}")
+            except TypeError:
+                _track(values, f"{e.cls}:{e.owner}")
+        for r in transient:
+            _track(r(), "staged_feed")
+        sess_mod = sys.modules.get("simple_tensorflow_tpu.client.session")
+        if sess_mod is not None:
+            for s in list(getattr(sess_mod, "live_sessions", ())):
+                _track(getattr(s, "_base_key", None), "rng_key")
+                store = getattr(s, "_variable_store", None)
+                if store is not None:
+                    for arr in list(store.values.values()):
+                        _track(arr, "store")
+        gc.collect()
+        untracked: List[Dict[str, Any]] = []
+        tracked_bytes = 0
+        untracked_bytes = 0
+        for arr in jax.live_arrays():
+            nb = int(getattr(arr, "nbytes", 0))
+            if id(arr) in tracked:
+                tracked_bytes += nb
+            else:
+                untracked_bytes += nb
+                untracked.append({"shape": list(getattr(arr, "shape",
+                                                        ())),
+                                  "dtype": str(getattr(arr, "dtype",
+                                                       "?")),
+                                  "bytes": nb})
+        untracked.sort(key=lambda u: -u["bytes"])
+        _metric_drift.get_cell().set(int(untracked_bytes))
+        return {
+            "jax_live_count": len(jax.live_arrays()),
+            "tracked_bytes": tracked_bytes,
+            "untracked_bytes": untracked_bytes,
+            "untracked_count": len(untracked),
+            "untracked_top": untracked[:top],
+            "dead_entry_bytes": dead_entry_bytes,
+            "ledger_bytes": self.total_bytes(),
+        }
+
+
+_LEDGER = MemoryLedger()
+
+
+def get_ledger() -> MemoryLedger:
+    return _LEDGER
+
+
+def reconcile(top: int = 5) -> Dict[str, Any]:
+    """Module-level convenience over :meth:`MemoryLedger.reconcile`."""
+    return _LEDGER.reconcile(top=top)
+
+
+# ---------------------------------------------------------------------------
+# budget admission + OOM forensics
+# ---------------------------------------------------------------------------
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def oom_fields(top: int = 3) -> Dict[str, Any]:
+    """The forensic payload attached to every OOM flight event: the
+    ledger snapshot headline plus the top owners by bytes."""
+    led = get_ledger()
+    return {
+        "ledger_total_bytes": led.total_bytes(),
+        "ledger_high_watermark_bytes": led.high_watermark(),
+        "top_owners": [{"owner": o, "bytes": b}
+                       for o, b in led.owners_by_bytes()[:top]],
+        "top_allocations": led.top_allocations(top),
+    }
+
+
+def record_oom(where: str, message: str = "",
+               plan_memory: Optional[Dict[str, Any]] = None,
+               dump: bool = True) -> None:
+    """Record an ``oom`` flight event annotated with the ledger
+    snapshot (and the failing plan's memory analysis, when the caller
+    has one) and dump the recorder — the post-mortem a bare
+    RESOURCE_EXHAUSTED never gives you. Never raises."""
+    from . import recorder as _recorder_mod
+
+    try:
+        _metric_oom_events.get_cell(where).increase_by(1)
+        rec = _recorder_mod.get_recorder()
+        fields = oom_fields()
+        if plan_memory:
+            fields["plan_memory"] = dict(plan_memory)
+        rec.record("oom", where=where, message=message[:500], **fields)
+        if dump and rec.enabled:
+            rec.dump(reason=f"oom:{where}")
+    except Exception:  # noqa: BLE001 — forensics never sink the op
+        pass
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Whether an exception is a device RESOURCE_EXHAUSTED / OOM (jax
+    raises XlaRuntimeError; the library's own admission checks raise
+    errors.ResourceExhaustedError)."""
+    from ..framework import errors
+
+    if isinstance(exc, errors.ResourceExhaustedError):
+        return True
+    msg = str(exc)
+    return ("RESOURCE_EXHAUSTED" in msg
+            or "Out of memory" in msg or "out of memory" in msg)
+
+
+def check_budget(budget: Optional[int], requested_bytes: float,
+                 what: str, owner: str = "session",
+                 detail: str = "") -> None:
+    """Admission check: refuse when the ledger's live set plus
+    ``requested_bytes`` of new allocation would exceed ``budget``.
+
+    Raises ``errors.ResourceExhaustedError`` naming the top-3 owners by
+    bytes and dumps the flight recorder (annotated with the ledger
+    snapshot) — the whole point is refusing BEFORE launch with a
+    message an operator can act on, instead of an XLA
+    RESOURCE_EXHAUSTED mid-batch. No-op when ``budget`` is None/0."""
+    if not budget:
+        return
+    led = get_ledger()
+    live = led.total_bytes()
+    projected = live + max(0, int(requested_bytes))
+    if projected <= int(budget):
+        return
+    from ..framework import errors
+
+    _metric_budget_rejections.get_cell(what).increase_by(1)
+    owners = led.owners_by_bytes()[:3]
+    owners_txt = ", ".join(f"{o}={_fmt_bytes(b)}"
+                           for o, b in owners) or "(ledger empty)"
+    msg = (f"device memory budget exceeded at {what}: live "
+           f"{_fmt_bytes(live)} + requested "
+           f"{_fmt_bytes(requested_bytes)} > budget "
+           f"{_fmt_bytes(budget)} "
+           f"(ConfigProto.device_memory_budget_bytes). Top owners by "
+           f"bytes: {owners_txt}."
+           + (f" {detail}" if detail else "")
+           + " Refused before launch; see the flight-recorder oom dump "
+             "for the full ledger snapshot (docs/OBSERVABILITY.md).")
+    record_oom(f"budget:{what}", message=msg)
+    raise errors.ResourceExhaustedError(None, None, msg)

@@ -94,20 +94,29 @@ class FlightRecorder:
     def record(self, kind: str, **fields) -> None:
         """Append one event. No-op when disabled. Never raises: a
         forensics channel must not be able to sink the operation it
-        observes."""
+        observes.
+
+        Hot-path shape: the ring stores raw ``(t, mono, kind, thread,
+        fields)`` tuples — the JSON-able event dicts (and field
+        sanitization) are built at READ time (``events()``), which runs
+        per dump/scrape, not per event. The ISSUE 13 bench `memory` row
+        budgets the whole plane at <3% serving overhead; the per-event
+        append is the term that scales with QPS."""
         if not self.enabled:
             return
+        self._append(time.time(), time.perf_counter(), kind,
+                     threading.current_thread().name, fields or None)
+
+    def _append(self, t, mono, kind, thread, fields) -> None:
+        """Raw ring append for callers that already hold the clock /
+        thread values (tracing's span breadcrumb — one per serving
+        span, the highest-rate event in the process). Never raises."""
         try:
-            ev = {"t": time.time(), "mono": time.perf_counter(),
-                  "kind": kind,
-                  "thread": threading.current_thread().name}
-            for k, v in fields.items():
-                ev[k] = v if (v is None or type(v) in _PRIMITIVE_TYPES) \
-                    else _sanitize(v)
+            item = (t, mono, kind, thread, fields)
             with self._lock:
                 if len(self._ring) == self._ring.maxlen:
                     self._dropped += 1
-                self._ring.append(ev)
+                self._ring.append(item)
                 self._recorded += 1
             cell = self._kind_cells.get(kind)
             if cell is None:
@@ -121,13 +130,25 @@ class FlightRecorder:
         self.enabled = bool(enabled)
 
     # -- reading --------------------------------------------------------------
+    @staticmethod
+    def _event_dict(item) -> Dict[str, Any]:
+        t, mono, kind, thread, fields = item
+        ev = {"t": t, "mono": mono, "kind": kind, "thread": thread}
+        if fields:
+            for k, v in fields.items():
+                ev[k] = v if (v is None or type(v) in _PRIMITIVE_TYPES) \
+                    else _sanitize(v)
+        return ev
+
     def events(self, n: Optional[int] = None,
                kind: Optional[str] = None) -> List[Dict[str, Any]]:
         with self._lock:
-            evs = list(self._ring)
+            items = list(self._ring)
         if kind is not None:
-            evs = [e for e in evs if e.get("kind") == kind]
-        return evs[-n:] if n else evs
+            items = [it for it in items if it[2] == kind]
+        if n:
+            items = items[-n:]
+        return [self._event_dict(it) for it in items]
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -180,11 +201,27 @@ class FlightRecorder:
         """Record an ``error`` event; auto-dump (rate-limited to one
         per 30 s, ``STF_FLIGHT_DUMP_ON_ERROR=0`` disables) so the ring
         around an unhandled session/serving failure survives the
-        process. Never raises."""
+        process. A RESOURCE_EXHAUSTED failure additionally records an
+        ``oom`` event annotated with the device-memory ledger snapshot
+        (top owners/allocations by bytes — telemetry.memory) and the
+        failing plan's memory analysis when the caller passed one as
+        ``plan_memory=``. Never raises."""
         try:
             self.record("error", where=where,
                         error_type=type(exc).__name__,
                         message=str(exc)[:500], **fields)
+            try:
+                from . import memory as _memory_mod
+
+                if _memory_mod.is_oom_error(exc):
+                    # the dump below already covers the ring; record
+                    # the annotated oom event without a second dump
+                    _memory_mod.record_oom(
+                        where, message=str(exc)[:500],
+                        plan_memory=fields.get("plan_memory"),
+                        dump=False)
+            except Exception:  # noqa: BLE001 — forensics never sink
+                pass
             if not self.enabled or \
                     os.environ.get("STF_FLIGHT_DUMP_ON_ERROR", "1") == "0":
                 return

@@ -10,9 +10,16 @@ thread per request; started via ``stf.telemetry.start(port=...)`` or
 
 - ``/metrics``  — Prometheus text exposition of the whole
   ``stf.monitoring`` registry (scrape this).
-- ``/healthz``  — liveness: ``{"status": "ok", ...}``.
+- ``/healthz``  — READINESS by default: 200 ``{"ready": true}`` once at
+  least one live Session (or loaded servable) exists, 503
+  ``{"ready": false}`` before that — what a fleet front-end probes
+  before routing traffic. ``?live=1`` keeps the old liveness contract
+  (200 whenever the process serves HTTP).
 - ``/statusz``  — process/build/uptime, loaded serving models (per-model
   signature rows), live sessions + plan-cache summary, device summary.
+- ``/memz``     — device-memory ledger: per-class/per-owner live bytes,
+  top allocations, high watermark, bytes-over-time history
+  (``?reconcile=1`` additionally diffs against ``jax.live_arrays()``).
 - ``/tracez``   — recent telemetry spans; ``?trace_id=`` filters to one
   request's linked spans, ``&format=chrome`` renders a chrome trace.
 - ``/flightz``  — flight-recorder JSONL dump (``?stacks=0`` omits the
@@ -49,6 +56,56 @@ _metric_scrape_seconds = monitoring.Sampler(
     "Telemetry-server request handling seconds", "endpoint")
 
 _PROCESS_START_S = time.time()
+
+
+def _ready() -> bool:
+    """Readiness: at least one live (unclosed) Session, or a
+    ModelServer with at least one loaded servable. sys.modules checks —
+    a probe must never be what first drags jax or serving into the
+    process."""
+    sess_mod = sys.modules.get("simple_tensorflow_tpu.client.session")
+    if sess_mod is not None:
+        for s in list(getattr(sess_mod, "live_sessions", ())):
+            if not getattr(s, "_closed", True):
+                return True
+    serving_mod = sys.modules.get("simple_tensorflow_tpu.serving.server")
+    if serving_mod is not None:
+        for srv in list(getattr(serving_mod, "live_servers", ())):
+            try:
+                if not srv.closed and srv.model_names:
+                    return True
+            except Exception:  # noqa: BLE001 — racing close()
+                continue
+    return False
+
+
+def _memz_info(reconcile: bool = False, top: int = 20) -> Dict[str, Any]:
+    """The /memz payload: ledger breakdown + history; docs/
+    OBSERVABILITY.md "Device memory"."""
+    from . import memory as _memory_mod
+
+    led = _memory_mod.get_ledger()
+    info = led.snapshot(top=top)
+    hist = led.history()
+    # history is (perf_counter, bytes); export as relative seconds so
+    # the payload is self-contained
+    now = time.perf_counter()
+    info["history"] = [[round(t - now, 3), b] for t, b in hist[-512:]]
+    sess_mod = sys.modules.get("simple_tensorflow_tpu.client.session")
+    if sess_mod is not None:
+        budgets = []
+        for s in list(getattr(sess_mod, "live_sessions", ())):
+            b = getattr(s, "_memory_budget", None)
+            if b:
+                budgets.append(int(b))
+        if budgets:
+            info["session_budgets_bytes"] = sorted(budgets)
+    if reconcile:
+        try:
+            info["reconcile"] = _memory_mod.reconcile()
+        except Exception as e:  # noqa: BLE001 — memz is best-effort
+            info["reconcile"] = {"error": str(e)}
+    return info
 
 
 def _statusz_info() -> Dict[str, Any]:
@@ -122,6 +179,12 @@ def _statusz_info() -> Dict[str, Any]:
         wd = watchdog_mod.get_watchdog()
         info["watchdog"] = {"armed": wd.armed_count(),
                             "wedges_detected": wd.wedges_detected}
+    from . import memory as _memory_mod
+
+    led = _memory_mod.get_ledger()
+    info["memory"] = {"total_bytes": led.total_bytes(),
+                      "high_watermark_bytes": led.high_watermark(),
+                      "by_class_owner": led.breakdown()}
     return info
 
 
@@ -150,13 +213,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(monitoring.to_prometheus(),
                             "text/plain; version=0.0.4; charset=utf-8")
             elif endpoint == "/healthz":
+                live_only = (q.get("live") or ["0"])[0] not in ("0", "")
+                ready = True if live_only else _ready()
                 self._reply(json.dumps({
-                    "status": "ok", "pid": os.getpid(),
+                    "status": "ok" if ready else "unavailable",
+                    "ready": ready, "pid": os.getpid(),
                     "uptime_s": round(time.time() - _PROCESS_START_S, 3),
-                }), "application/json")
+                }), "application/json",
+                    code=200 if ready else 503)
             elif endpoint == "/statusz":
                 self._reply(json.dumps(_statusz_info(), default=str,
                                        indent=2), "application/json")
+            elif endpoint == "/memz":
+                reconcile = (q.get("reconcile") or ["0"])[0] \
+                    not in ("0", "")
+                top = int((q.get("top") or ["20"])[0])
+                self._reply(json.dumps(
+                    _memz_info(reconcile=reconcile, top=top),
+                    default=str, indent=2), "application/json")
             elif endpoint == "/tracez":
                 trace_id = (q.get("trace_id") or [None])[0]
                 if (q.get("format") or [""])[0] == "chrome":
@@ -179,7 +253,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "<html><body><h1>stf telemetry</h1><ul>"
                     + "".join(f'<li><a href="{p}">{p}</a></li>'
                               for p in ("/metrics", "/healthz", "/statusz",
-                                        "/tracez", "/flightz"))
+                                        "/memz", "/tracez", "/flightz"))
                     + "</ul></body></html>", "text/html")
             else:
                 self._reply(f"no such endpoint: {endpoint}\n",
@@ -231,7 +305,7 @@ class TelemetryServer:
         _recorder_mod.get_recorder().record(
             "telemetry_server", action="start", port=self.port)
         logging.info("telemetry: serving /metrics /healthz /statusz "
-                     "/tracez /flightz on http://%s:%d",
+                     "/memz /tracez /flightz on http://%s:%d",
                      address, self.port)
 
     @property

@@ -121,21 +121,27 @@ def emit_span(name: str, start_s: float, dur_s: float,
                 trace_id = scoped[0]
             else:
                 trace_ids = scoped
-    span = {"name": name, "start_s": float(start_s),
-            "dur_s": float(dur_s), "trace_id": trace_id,
-            "trace_ids": list(trace_ids) if trace_ids else None,
-            "tid": threading.get_ident(),
-            "thread": threading.current_thread().name,
-            "meta": meta or None}
+    # hot-path shape: the ring stores raw tuples; the span DICTS the
+    # readers see are built in recent_spans() — per read, not per span
+    # (the ISSUE 13 memory-row overhead budget covers this path)
+    thread = threading.current_thread().name
+    start_s = float(start_s)
+    dur_s = float(dur_s)
+    item = (name, start_s, dur_s, trace_id,
+            tuple(trace_ids) if trace_ids else None,
+            threading.get_ident(), thread, meta or None)
     with _spans_lock:
-        _spans.append(span)
+        _spans.append(item)
     rec = _recorder_mod.get_recorder()
     if rec.enabled:
         # span-close breadcrumb (meta stays in the span ring — the
-        # flight event carries only the fields a postmortem greps for)
-        rec.record("span", name=name, dur_s=dur_s,
-                   trace_id=trace_id or
-                   (trace_ids[0] if trace_ids else None))
+        # flight event carries only the fields a postmortem greps
+        # for); raw append reusing this span's clock/thread values —
+        # the close instant on the perf_counter clock is start + dur
+        rec._append(time.time(), start_s + dur_s, "span", thread,
+                    {"name": name, "dur_s": dur_s,
+                     "trace_id": trace_id or
+                     (trace_ids[0] if trace_ids else None)})
 
 
 class span:
@@ -168,12 +174,20 @@ def _matches(s: Dict[str, Any], trace_id: str) -> bool:
         (s.get("trace_ids") and trace_id in s["trace_ids"])
 
 
+def _span_dict(item) -> Dict[str, Any]:
+    name, start_s, dur_s, trace_id, trace_ids, tid, thread, meta = item
+    return {"name": name, "start_s": start_s, "dur_s": dur_s,
+            "trace_id": trace_id,
+            "trace_ids": list(trace_ids) if trace_ids else None,
+            "tid": tid, "thread": thread, "meta": meta}
+
+
 def recent_spans(n: Optional[int] = None,
                  trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Snapshot of the span ring (oldest first), optionally filtered to
     one request's linked spans."""
     with _spans_lock:
-        out = list(_spans)
+        out = [_span_dict(it) for it in _spans]
     if trace_id is not None:
         out = [s for s in out if _matches(s, trace_id)]
     return out[-n:] if n else out

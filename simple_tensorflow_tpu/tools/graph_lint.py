@@ -4,6 +4,7 @@
         [--fetch op_or_tensor ...] [--severity code=level ...] \
         [--level structural|full] [--json] [--serving] \
         [--kernels [off|auto|force]] \
+        [--memory [--budget BYTES]] \
         [--mesh 8|2x4|dp=2,tp=4] [--rules rules.json] \
         [--max-severity note|warning|error]
 
@@ -62,9 +63,39 @@ def kernel_routing_summary(graph, mode=None):
             "by_op_type": table, "no_kernel_ops": no_kernel}
 
 
+def memory_summary(graph, fetch_names=None, fetches=None, budget=None):
+    """Per-plan peak-estimate rows for ``graph_lint --memory``: one row
+    per fetch (or one whole-graph row), with the static cost model's
+    predicted peak/resident/transient bytes and — when a budget is
+    given — whether the plan fits (stf.telemetry.memory offline
+    half)."""
+    from ..analysis import lint as lint_mod
+    from ..framework import cost_model
+
+    ctx = lint_mod.LintContext(graph, graph.get_operations(),
+                               fetches=fetches)
+    rows = []
+    for label, plan_fetches, _anchor in lint_mod.plan_fetch_groups(ctx):
+        try:
+            est = cost_model.estimate(plan_fetches)
+        except Exception as e:  # noqa: BLE001 — un-costable plan
+            rows.append({"plan": label, "error": str(e)})
+            continue
+        row = {"plan": label,
+               "predicted_peak_bytes": int(est.peak_bytes),
+               "resident_bytes": int(est.resident_bytes),
+               "transient_bytes": int(est.peak_bytes
+                                      - est.resident_bytes)}
+        if budget:
+            row["budget_bytes"] = int(budget)
+            row["within_budget"] = bool(est.peak_bytes <= int(budget))
+        rows.append(row)
+    return rows
+
+
 def run_lint(graph_def: dict, fetch_names=None, severities=None,
              level: str = "full", mesh=None, partition_rules=None,
-             purpose=None):
+             purpose=None, memory_budget=None):
     """Programmatic entry: returns (diagnostics, imported_graph|None,
     sharding_report|None)."""
     from .. import analysis
@@ -89,7 +120,8 @@ def run_lint(graph_def: dict, fetch_names=None, severities=None,
                    f"--fetch {name!r}: {e}")
     diags.extend(analysis.analyze(graph, fetches=fetches or None,
                                   level=level, severities=severities,
-                                  purpose=purpose))
+                                  purpose=purpose,
+                                  memory_budget=memory_budget))
     report_obj = None
     if mesh:
         seeds = None
@@ -147,6 +179,16 @@ def main(argv=None):
                          "rule and prints a per-op-type verdict "
                          "summary (routed / fallback+reason / "
                          "autotune / no-kernel)")
+    ap.add_argument("--memory", action="store_true",
+                    help="print the per-plan predicted peak device-"
+                         "memory table (static cost model over each "
+                         "--fetch closure, or the whole graph) and "
+                         "activate the lint/memory-budget rule; with "
+                         "--budget, exit 1 when any plan's predicted "
+                         "peak exceeds it (the offline half of "
+                         "ConfigProto(device_memory_budget_bytes=))")
+    ap.add_argument("--budget", type=int, default=None, metavar="BYTES",
+                    help="device-memory budget in bytes for --memory")
     ap.add_argument("--serving", action="store_true",
                     help="lint as an exported inference graph: activate "
                          "the serving-compatibility rules "
@@ -194,11 +236,15 @@ def main(argv=None):
 
     from .. import analysis
 
-    if args.kernels and args.serving:
-        ap.error("--kernels and --serving are separate lint purposes; "
-                 "run them as two invocations")
+    if sum(bool(x) for x in (args.kernels, args.serving,
+                             args.memory)) > 1:
+        ap.error("--kernels, --serving, and --memory are separate lint "
+                 "purposes; run them as separate invocations")
+    if args.budget is not None and not args.memory:
+        ap.error("--budget requires --memory")
     purpose = "serving" if args.serving else (
-        "kernels" if args.kernels else None)
+        "kernels" if args.kernels else (
+            "memory" if args.memory else None))
     from ..kernels import registry as _kreg
 
     with _kreg.activate(args.kernels):
@@ -206,21 +252,51 @@ def main(argv=None):
                                          severities=severities,
                                          level=args.level, mesh=mesh,
                                          partition_rules=partition_rules,
-                                         purpose=purpose)
+                                         purpose=purpose,
+                                         memory_budget=args.budget)
         kernel_summary = None
         if args.kernels and _graph is not None:
             kernel_summary = kernel_routing_summary(_graph,
                                                     mode=args.kernels)
+        memory_rows = None
+        if args.memory and _graph is not None:
+            fetches = []
+            for name in args.fetch:
+                try:
+                    fetches.append(_graph.as_graph_element(
+                        name, allow_tensor=True, allow_operation=True))
+                except (KeyError, ValueError):
+                    pass
+            memory_rows = memory_summary(_graph, fetches=fetches,
+                                         budget=args.budget)
     if args.json:
         for d in diags:
             print(json.dumps(d.to_dict()))
         if kernel_summary is not None:
             print(json.dumps({"kernel_routing": kernel_summary}))
+        if memory_rows is not None:
+            print(json.dumps({"memory": memory_rows}))
         if report is not None:
             print(json.dumps({"summary": report.summary()}))
     else:
         print(analysis.format_report(
             diags, header=f"graph_lint {args.graphdef}:"))
+        if memory_rows is not None:
+            hdr = "plan" + " " * 28 + "peak_bytes   resident   transient"
+            print(f"memory ({len(memory_rows)} plan(s)"
+                  + (f", budget {args.budget} B" if args.budget else "")
+                  + f"):\n  {hdr}")
+            for r in memory_rows:
+                if "error" in r:
+                    print(f"  {r['plan'][:30]:<32}(uncostable: "
+                          f"{r['error'][:40]})")
+                    continue
+                mark = "" if r.get("within_budget", True) \
+                    else "  OVER BUDGET"
+                print(f"  {r['plan'][:30]:<32}"
+                      f"{r['predicted_peak_bytes']:>10} "
+                      f"{r['resident_bytes']:>10} "
+                      f"{r['transient_bytes']:>10}{mark}")
         if kernel_summary is not None:
             print(f"kernel routing [{kernel_summary['mode']}/"
                   f"{kernel_summary['backend']}]: "

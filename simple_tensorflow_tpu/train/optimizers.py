@@ -104,6 +104,10 @@ def _flat_slot_layout(self, slot_names, groups):
                 flat = variables_mod.Variable(
                     array_ops.zeros([n], dtype=ud), trainable=False,
                     name=f"{self._name}/fused_{sn}_g{gi}")
+                # HBM-ledger class marker (stf.telemetry.memory): the
+                # flat slot layout is optimizer state like its per-var
+                # siblings
+                flat._mem_class = "optimizer_slots"
                 cache[ck] = flat
                 self._fused_slot_vars.append(flat)
                 # per-variable views: same shape/dtype/values the

@@ -31,6 +31,9 @@ def create_slot(primary, val, name, colocate_with_primary=True):
     v = variables_mod.Variable(
         val, trainable=False,
         name=f"{primary.var_name}/{name}")
+    # HBM-ledger class marker (stf.telemetry.memory): slot state
+    # accounts as optimizer_slots, not generic device state
+    v._mem_class = "optimizer_slots"
     if primary.sharding is not None:
         v.set_sharding(primary.sharding)
     return v
@@ -49,6 +52,7 @@ def create_slot_with_initializer(primary, initializer, shape, dtype, name,
 
     v = variables_mod.Variable(init, trainable=False,
                                name=f"{primary.var_name}/{name}", dtype=dtype)
+    v._mem_class = "optimizer_slots"
     if primary.sharding is not None:
         v.set_sharding(primary.sharding)
     return v

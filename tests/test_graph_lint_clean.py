@@ -322,3 +322,54 @@ def test_decode_plan_graph_lint_serving(tmp_path):
         fetches=[g], purpose="serving",
         rules=["lint/serving-decode-cache"])
     assert any(d.severity == "error" for d in diags3)
+
+
+# ---------------------------------------------------------------------------
+# memory-budget gate (ISSUE 13 satellite): graph_lint --memory over the
+# model zoo — the per-plan peak table exists for every zoo model, the
+# lint/memory-budget rule fires only over budget (and only under the
+# "memory" purpose), and the CLI exit code gates CI.
+# ---------------------------------------------------------------------------
+
+def test_zoo_memory_budget_gate(tmp_path):
+    import json
+
+    from simple_tensorflow_tpu.framework import graph_io
+    from simple_tensorflow_tpu.models import mnist
+    from simple_tensorflow_tpu.models import transformer as tr
+    from simple_tensorflow_tpu.tools import graph_lint
+
+    zoo = {}
+    m = mnist.softmax_model(learning_rate=0.01)
+    zoo["mnist_softmax"] = (stf.get_default_graph(),
+                            [m["train_op"], m["loss"]])
+    g2 = stf.Graph()
+    with g2.as_default():
+        cfg = tr.TransformerConfig.tiny()
+        mt = tr.transformer_train_model(batch_size=2, src_len=8,
+                                        tgt_len=8, cfg=cfg,
+                                        compute_dtype=stf.float32)
+    zoo["transformer_tiny"] = (g2, [mt["train_op"], mt["loss"]])
+
+    for key, (graph, fetches) in zoo.items():
+        rows = graph_lint.memory_summary(
+            graph, fetches=[f for f in fetches], budget=1 << 34)
+        assert rows, f"{key}: no memory rows"
+        for r in rows:
+            assert "error" not in r, f"{key}: uncostable plan: {r}"
+            assert r["predicted_peak_bytes"] > 0
+            assert r["within_budget"], f"{key}: {r}"
+
+    # CLI round trip on one zoo graph: generous budget exits 0, a
+    # 1-byte budget exits 1 via the lint/memory-budget ERROR
+    gd = graph_io.graph_to_graphdef(zoo["mnist_softmax"][0])
+    p = tmp_path / "mnist_mem.json"
+    p.write_text(json.dumps(gd))
+    loss_name = m["loss"].name
+    stf.reset_default_graph()
+    rc = graph_lint.main([str(p), "--fetch", loss_name, "--memory",
+                          "--budget", str(1 << 34)])
+    assert rc == 0
+    rc = graph_lint.main([str(p), "--fetch", loss_name, "--memory",
+                          "--budget", "1"])
+    assert rc == 1

@@ -1,0 +1,578 @@
+"""Device-memory observability (ISSUE 13): the HBM ledger
+(stf.telemetry.memory), per-plan memory accounting + budget admission,
+OOM forensics, checkpoint-snapshot accounting, reconciliation against
+``jax.live_arrays()``, and the offline ``graph_lint --memory`` mode.
+"""
+
+import gc
+import json
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import checkpoint as ckpt
+from simple_tensorflow_tpu import telemetry
+from simple_tensorflow_tpu.framework import errors
+from simple_tensorflow_tpu.telemetry import memory as mem
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+    stf.reset_default_graph()
+    gc.collect()
+
+
+def _mlp_session(graph=None, config=None, n=16, name=""):
+    g = graph or stf.Graph()
+    with g.as_default():
+        x = stf.placeholder(stf.float32, [4, n], name=f"x{name}")
+        w = stf.Variable(np.ones((n, 3), np.float32), name=f"w{name}")
+        loss = stf.reduce_sum(stf.matmul(x, w))
+        opt = stf.train.AdamOptimizer(0.01).minimize(loss)
+        sess = stf.Session(graph=g, config=config)
+        sess.run(stf.global_variables_initializer())
+    return sess, g, x, w, opt, loss
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics
+# ---------------------------------------------------------------------------
+
+class TestLedgerMechanics:
+    def test_register_update_release(self):
+        led = mem.MemoryLedger()
+        t1 = led.register("a", 100, mem.CLASS_WEIGHTS, "m1")
+        t2 = led.register("b", 50, mem.CLASS_KV_CACHE, "m1")
+        assert led.total_bytes() == 150
+        assert led.live_bytes(cls=mem.CLASS_WEIGHTS) == 100
+        assert led.live_bytes(owner="m1") == 150
+        led.update(t2, 80)
+        assert led.total_bytes() == 180
+        assert led.high_watermark() == 180
+        led.release(t1)
+        assert led.total_bytes() == 80
+        assert led.high_watermark() == 180  # hwm is sticky
+        led.release(t2)
+        led.release(t2)  # idempotent
+        led.release(None)  # no-op
+        assert led.total_bytes() == 0
+        assert led.breakdown() == {}
+
+    def test_breakdown_top_and_history(self):
+        led = mem.MemoryLedger()
+        led.register("big", 1000, mem.CLASS_WEIGHTS, "m1")
+        led.register("small", 10, mem.CLASS_STATE, "m2")
+        bd = led.breakdown()
+        assert bd[mem.CLASS_WEIGHTS]["m1"] == 1000
+        assert bd[mem.CLASS_STATE]["m2"] == 10
+        top = led.top_allocations(1)
+        assert top[0]["name"] == "big" and top[0]["bytes"] == 1000
+        assert led.owners_by_bytes()[0] == ("m1", 1000)
+        hist = led.history()
+        assert [b for _, b in hist] == [1000, 1010]
+        snap = led.snapshot()
+        assert snap["total_bytes"] == 1010
+        assert snap["n_entries"] == 2
+
+    def test_anonymous_sessions_roll_up_in_gauges(self):
+        # per-session owners must not grow the gauge label set without
+        # bound: session-* owners share the "session" gauge cell while
+        # the ledger's own breakdown stays precise
+        led = mem.MemoryLedger()
+        led.register("a", 5, mem.CLASS_STATE, "session-12345")
+        assert "session-12345" in led.breakdown()[mem.CLASS_STATE]
+        from simple_tensorflow_tpu.telemetry.memory import _gauge_owner
+
+        assert _gauge_owner("session-12345") == "session"
+        assert _gauge_owner("model:m") == "model:m"
+
+
+# ---------------------------------------------------------------------------
+# VariableStore integration: classes, owners, lifecycle
+# ---------------------------------------------------------------------------
+
+class TestStoreAccounting:
+    def test_classes_and_close_releases(self):
+        led = mem.get_ledger()
+        base = led.total_bytes()
+        sess, g, x, w, opt, loss = _mlp_session()
+        owner = sess._variable_store.owner
+        by_cls = {c: b for c, owners in led.breakdown().items()
+                  for o, b in owners.items() if o == owner}
+        # weights (16x3 f32) + Adam m/v slots + state (beta powers,
+        # global step-ish scalars)
+        assert by_cls[mem.CLASS_WEIGHTS] == 16 * 3 * 4
+        assert by_cls[mem.CLASS_OPTIMIZER] >= 2 * 16 * 3 * 4
+        assert mem.CLASS_STATE in by_cls
+        assert led.total_bytes() > base
+        sess.close()
+        assert led.live_bytes(owner=owner) == 0
+
+    def test_dropped_session_releases_via_gc(self):
+        led = mem.get_ledger()
+        sess, g, *_ = _mlp_session()
+        owner = sess._variable_store.owner
+        assert led.live_bytes(owner=owner) > 0
+        del sess, g, _
+        gc.collect()
+        assert led.live_bytes(owner=owner) == 0
+
+    def test_kv_cache_class(self):
+        from simple_tensorflow_tpu.ops import kv_cache_ops as kvc
+
+        g = stf.Graph()
+        with g.as_default():
+            cache = kvc.kv_cache("testcache", 4, 8, (2, 4), stf.float32)
+            alloc = cache.alloc()
+            sess = stf.Session(graph=g)
+            sess.run(alloc.op)
+        led = mem.get_ledger()
+        owner = sess._variable_store.owner
+        assert led.live_bytes(cls=mem.CLASS_KV_CACHE, owner=owner) \
+            == 4 * 8 * 2 * 4 * 4  # (num_slots, max_len, 2, 4) f32
+        sess.close()
+        assert led.live_bytes(owner=owner) == 0
+
+    def test_set_owner_relabel(self):
+        led = mem.get_ledger()
+        sess, *_ = _mlp_session()
+        old = sess._variable_store.owner
+        total = led.live_bytes(owner=old)
+        sess._variable_store.set_owner("model:relabeled")
+        assert led.live_bytes(owner=old) == 0
+        assert led.live_bytes(owner="model:relabeled") == total
+        sess.close()
+        assert led.live_bytes(owner="model:relabeled") == 0
+
+
+# ---------------------------------------------------------------------------
+# per-plan accounting + budget admission
+# ---------------------------------------------------------------------------
+
+class TestBudgetAdmission:
+    def test_plan_memory_info(self):
+        sess, g, x, w, opt, loss = _mlp_session()
+        with g.as_default():
+            plan = sess.plan(loss, feeds=[x])
+        info = plan.memory_info()
+        assert info["predicted_peak_bytes"] > 0
+        assert info["predicted_resident_bytes"] >= 16 * 3 * 4
+        assert info["ledger_live_bytes"] > 0
+        assert info["ledger_session_bytes"] > 0
+        assert info["budget_bytes"] is None
+        sess.close()
+
+    def test_plan_refused_over_budget(self):
+        g = stf.Graph()
+        with g.as_default():
+            cfg = stf.ConfigProto(device_memory_budget_bytes=1024)
+            sess = stf.Session(graph=g, config=cfg)
+            big = stf.Variable(np.zeros((512, 512), np.float32),
+                               name="big")
+            with pytest.raises(errors.ResourceExhaustedError) as ei:
+                sess.run(big.initializer)
+        msg = str(ei.value)
+        assert "budget" in msg and "Top owners" in msg
+        sess.close()
+
+    def test_refusal_emits_oom_forensics(self):
+        rec = telemetry.get_recorder()
+        rec.clear()
+        g = stf.Graph()
+        with g.as_default():
+            cfg = stf.ConfigProto(device_memory_budget_bytes=64)
+            sess = stf.Session(graph=g, config=cfg)
+            v = stf.Variable(np.zeros((64, 64), np.float32), name="v")
+            with pytest.raises(errors.ResourceExhaustedError):
+                sess.run(v.initializer)
+        ooms = rec.events(kind="oom")
+        assert ooms, "budget refusal must land an oom flight event"
+        ev = ooms[-1]
+        assert ev["where"].startswith("budget:")
+        assert "top_owners" in ev and "ledger_total_bytes" in ev
+        sess.close()
+
+    def test_within_budget_runs(self):
+        g = stf.Graph()
+        with g.as_default():
+            cfg = stf.ConfigProto(
+                device_memory_budget_bytes=64 << 20)
+            sess = stf.Session(graph=g, config=cfg)
+            v = stf.Variable(np.ones((8, 8), np.float32), name="v")
+            sess.run(v.initializer)
+            out = sess.run(v.value())
+        np.testing.assert_array_equal(out, np.ones((8, 8), np.float32))
+        sess.close()
+
+    def test_runtime_oom_classified(self):
+        # a runtime RESOURCE_EXHAUSTED (not just our budget errors)
+        # must classify as OOM for the forensics hook
+        assert mem.is_oom_error(
+            errors.ResourceExhaustedError(None, None, "x"))
+        assert mem.is_oom_error(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory ..."))
+        assert not mem.is_oom_error(ValueError("shape mismatch"))
+
+
+# ---------------------------------------------------------------------------
+# generative / serving admission (acceptance: transformer refused at load)
+# ---------------------------------------------------------------------------
+
+class TestServingAdmission:
+    def test_transformer_generative_refused_at_load(self):
+        from simple_tensorflow_tpu.models import transformer as tr
+        from simple_tensorflow_tpu import serving
+
+        rec = telemetry.get_recorder()
+        rec.clear()
+        cfg = tr.TransformerConfig.tiny()
+
+        def factory():
+            return tr.TransformerGenerativeModel(
+                cfg, src_len=8, num_slots=2, max_decode_len=8,
+                init_fresh=True, aot_warmup=False,
+                config=stf.ConfigProto(
+                    device_memory_budget_bytes=2048))
+
+        server = serving.ModelServer()
+        try:
+            with pytest.raises(errors.ResourceExhaustedError) as ei:
+                server.load_generative(factory, name="tiny_budget")
+        finally:
+            server.close()
+        msg = str(ei.value)
+        assert "Top owners" in msg
+        ooms = rec.events(kind="oom")
+        assert ooms and "top_owners" in ooms[-1]
+        assert len(ooms[-1]["top_owners"]) <= 3
+
+    def test_generative_loads_and_accounts_under_model_owner(self):
+        from simple_tensorflow_tpu.models import transformer as tr
+        from simple_tensorflow_tpu import serving
+
+        cfg = tr.TransformerConfig.tiny()
+        model = tr.TransformerGenerativeModel(
+            cfg, src_len=8, num_slots=2, max_decode_len=8,
+            init_fresh=True, aot_warmup=False)
+        server = serving.ModelServer()
+        led = mem.get_ledger()
+        try:
+            server.load_generative(model, name="memtest_gen")
+            assert led.live_bytes(owner="model:memtest_gen") > 0
+            assert led.live_bytes(cls=mem.CLASS_KV_CACHE,
+                                  owner="model:memtest_gen") > 0
+        finally:
+            server.close()
+        assert led.live_bytes(owner="model:memtest_gen") == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-snapshot accounting (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestSnapshotAccounting:
+    def test_async_save_snapshot_rises_then_returns_to_baseline(
+            self, tmp_path):
+        led = mem.get_ledger()
+        sess, g, x, w, opt, loss = _mlp_session(name="snap")
+        with g.as_default():
+            for _ in range(2):
+                sess.run(opt, {x: np.ones((4, 16), np.float32)})
+            baseline = led.live_bytes(cls=mem.CLASS_SNAPSHOT)
+            # gate the writer so the in-flight snapshot is observable
+            gate = threading.Event()
+            ckpt.get_writer().submit(gate.wait, description="gate")
+            mgr = ckpt.CheckpointManager(str(tmp_path),
+                                         async_save=True)
+            mgr.save(sess, global_step=1)
+            during = led.live_bytes(cls=mem.CLASS_SNAPSHOT)
+            # the barrier snapshot transiently doubles the named state
+            assert during > baseline
+            assert during - baseline >= 16 * 3 * 4
+            gate.set()
+            mgr.wait_until_finished()
+            ckpt.get_writer().wait_until_finished(timeout=30)
+            gc.collect()
+            after = led.live_bytes(cls=mem.CLASS_SNAPSHOT)
+            assert after == baseline, (
+                "snapshot device copies must release after the commit "
+                f"(baseline {baseline}, after {after})")
+        sess.close()
+
+    def test_direct_snapshot_release(self):
+        led = mem.get_ledger()
+        sess, g, x, w, opt, loss = _mlp_session(name="snap2")
+        with g.as_default():
+            snap = ckpt.capture_training_state(sess, {"w": w})
+        nb = snap.nbytes()
+        assert nb >= 16 * 3 * 4
+        assert led.live_bytes(cls=mem.CLASS_SNAPSHOT) >= nb
+        snap.release_device_state()
+        snap.release_device_state()  # idempotent
+        assert led.live_bytes(cls=mem.CLASS_SNAPSHOT) == 0
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# reconciliation (leak detection)
+# ---------------------------------------------------------------------------
+
+class TestReconcile:
+    def test_zero_drift_after_training_and_gc(self):
+        # measured against a pre-existing baseline: earlier test
+        # modules in a shared process may hold live arrays this ledger
+        # never owned (module-level fixtures, jit caches) — the
+        # contract gated here is that THIS session's training adds NO
+        # unattributed device memory. The bench `memory` row gates the
+        # absolute-zero drift in a clean child process.
+        gc.collect()
+        base = mem.reconcile()["untracked_bytes"]
+        sess, g, x, w, opt, loss = _mlp_session(name="rec")
+        with g.as_default():
+            for _ in range(3):
+                sess.run(opt, {x: np.ones((4, 16), np.float32)})
+        gc.collect()
+        rec = mem.reconcile()
+        assert rec["untracked_bytes"] <= base, rec["untracked_top"]
+        assert rec["tracked_bytes"] >= mem.get_ledger().live_bytes(
+            owner=sess._variable_store.owner)
+        sess.close()
+
+    def test_kv_cache_slot_retirement_returns_to_baseline(self):
+        # acceptance: cache pages stay ledger-accounted and reconciled
+        # across slot churn — the cache never grows or leaks per
+        # retired sequence (pages are reused in place)
+        from simple_tensorflow_tpu.ops import kv_cache_ops as kvc
+
+        led = mem.get_ledger()
+        gc.collect()
+        base = mem.reconcile()["untracked_bytes"]
+        g = stf.Graph()
+        with g.as_default():
+            cache = kvc.kv_cache("churn", 2, 4, (2,), stf.float32)
+            alloc = cache.alloc()
+            val = stf.placeholder(stf.float32, [1, 1, 2], name="cv")
+            slot = stf.placeholder(stf.int32, [1], name="cs")
+            pos = stf.placeholder(stf.int32, [1], name="cp")
+            app = cache.append(val, slot, pos)
+            sess = stf.Session(graph=g)
+            sess.run(alloc.op)
+        owner = sess._variable_store.owner
+        nb0 = led.live_bytes(cls=mem.CLASS_KV_CACHE, owner=owner)
+        with g.as_default():
+            for s in (0, 1, 0, 1):  # join/retire/reuse churn
+                sess.run(app.op, {val: np.ones((1, 1, 2), np.float32),
+                                  slot: [s], pos: [0]})
+        assert led.live_bytes(cls=mem.CLASS_KV_CACHE, owner=owner) \
+            == nb0
+        gc.collect()
+        rec = mem.reconcile()
+        assert rec["untracked_bytes"] <= base, rec["untracked_top"]
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# utils/perf.memory_of fallback (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestMemoryOfFallback:
+    def _compiled(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda a, b: (a @ b, a.sum()))
+        lowered = f.lower(jnp.ones((16, 16)), jnp.ones((16, 8)))
+        return lowered.compile(), lowered
+
+    def test_native_path_has_stats(self):
+        from simple_tensorflow_tpu.utils import perf
+
+        compiled, lowered = self._compiled()
+        out = perf.memory_of(compiled, lowered=lowered)
+        assert out["argument_bytes"] > 0
+        assert out["output_bytes"] > 0
+        assert out["peak_bytes"] >= out["argument_bytes"]
+
+    def test_fallback_when_memory_analysis_unavailable(self):
+        from simple_tensorflow_tpu.utils import perf
+
+        compiled, lowered = self._compiled()
+
+        class NoMA:
+            """A backend whose memory_analysis raises (TPU-less PJRT
+            plugins): stats must still come from cost_analysis +
+            abstract shapes."""
+
+            def __init__(self, c):
+                self._c = c
+
+            def memory_analysis(self):
+                raise NotImplementedError
+
+            def cost_analysis(self):
+                return self._c.cost_analysis()
+
+            @property
+            def in_avals(self):
+                return self._c.in_avals
+
+        out = perf.memory_of(NoMA(compiled), lowered=lowered)
+        assert out.get("estimated") == 1
+        assert out["argument_bytes"] > 0
+        assert out["peak_bytes"] > 0
+        native = perf.memory_of(compiled, lowered=lowered)
+        # same order of magnitude as the native analysis
+        assert out["argument_bytes"] >= native["argument_bytes"] // 2
+
+    def test_fallback_without_cost_analysis_uses_avals(self):
+        from simple_tensorflow_tpu.utils import perf
+
+        compiled, lowered = self._compiled()
+
+        class Bare:
+            def memory_analysis(self):
+                return None
+
+            def cost_analysis(self):
+                raise NotImplementedError
+
+            @property
+            def in_avals(self):
+                return compiled.in_avals
+
+        out = perf.memory_of(Bare(), lowered=lowered)
+        assert out.get("estimated") == 1
+        assert out["argument_bytes"] == (16 * 16 + 16 * 8) * 4
+
+
+# ---------------------------------------------------------------------------
+# traced run_steps memory track
+# ---------------------------------------------------------------------------
+
+class TestMemoryTrack:
+    def test_traced_window_carries_memory_samples(self):
+        g = stf.Graph()
+        with g.as_default():
+            v = stf.Variable(np.zeros((8, 8), np.float32), name="mv")
+            train = stf.assign_add(v._ref, stf.ones([8, 8]))
+            sess = stf.Session(graph=g)
+            sess.run(stf.global_variables_initializer())
+            opts = stf.RunOptions(
+                trace_level=stf.RunOptions.SOFTWARE_TRACE)
+            md = stf.RunMetadata()
+            sess.run_steps(train, n=4, options=opts, run_metadata=md)
+        assert md.step_stats["loop_fusion"]["fused"] is True
+        samples = md.step_stats.get("memory_samples")
+        assert samples and samples[-1]["bytes"] > 0
+        trace = stf.timeline.Timeline(md).generate_chrome_trace_format(
+            show_memory=True)
+        events = json.loads(trace)["traceEvents"]
+        counters = [e for e in events
+                    if e.get("ph") == "C"
+                    and "ledger" in e.get("name", "")]
+        assert counters, "traced window must render the ledger track"
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# graph_lint --memory (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestGraphLintMemory:
+    def _graphdef(self, tmp_path):
+        from simple_tensorflow_tpu.framework import graph_io
+
+        g = stf.Graph()
+        with g.as_default():
+            x = stf.placeholder(stf.float32, [8, 32], name="x")
+            w = stf.Variable(np.ones((32, 8), np.float32), name="w")
+            stf.matmul(x, w, name="y")
+            graph_io.write_graph(g.as_graph_def(), str(tmp_path),
+                                 "m.json", as_text=True)
+        return str(tmp_path / "m.json")
+
+    def test_rule_flags_over_budget_plan(self, tmp_path):
+        from simple_tensorflow_tpu.tools import graph_lint as gl
+
+        path = self._graphdef(tmp_path)
+        gd = json.load(open(path))
+        diags, graph, _ = gl.run_lint(gd, fetch_names=["y:0"],
+                                      purpose="memory",
+                                      memory_budget=128)
+        codes = {d.code for d in diags if d.is_error}
+        assert "lint/memory-budget" in codes
+        diags, _, _ = gl.run_lint(gd, fetch_names=["y:0"],
+                                  purpose="memory",
+                                  memory_budget=1 << 30)
+        assert not any(d.code == "lint/memory-budget" for d in diags)
+        # rule is purpose-gated: silent without --memory
+        diags, _, _ = gl.run_lint(gd, fetch_names=["y:0"],
+                                  memory_budget=128)
+        assert not any(d.code == "lint/memory-budget" for d in diags)
+
+    def test_memory_summary_rows(self, tmp_path):
+        from simple_tensorflow_tpu.framework import graph as graph_mod
+        from simple_tensorflow_tpu.framework import graph_io
+        from simple_tensorflow_tpu.tools import graph_lint as gl
+
+        path = self._graphdef(tmp_path)
+        graph = graph_mod.Graph()
+        with graph.as_default():
+            graph_io.import_graph_def(json.load(open(path)), name="")
+        y = graph.get_tensor_by_name("y:0")
+        rows = gl.memory_summary(graph, fetches=[y], budget=128)
+        assert rows[0]["plan"] == "y:0"
+        assert rows[0]["predicted_peak_bytes"] > 128
+        assert rows[0]["within_budget"] is False
+
+    def test_cli_exit_codes(self, tmp_path):
+        # the literal CI invocation (zoo gate in
+        # tests/test_graph_lint_clean.py runs the same mode over the
+        # model zoo)
+        path = self._graphdef(tmp_path)
+        over = subprocess.run(
+            [sys.executable, "-m",
+             "simple_tensorflow_tpu.tools.graph_lint", path,
+             "--fetch", "y:0", "--memory", "--budget", "128"],
+            capture_output=True, text=True)
+        assert over.returncode == 1, over.stdout + over.stderr
+        assert "OVER BUDGET" in over.stdout
+        under = subprocess.run(
+            [sys.executable, "-m",
+             "simple_tensorflow_tpu.tools.graph_lint", path,
+             "--fetch", "y:0", "--memory", "--budget", str(1 << 30),
+             "--json"],
+            capture_output=True, text=True)
+        assert under.returncode == 0, under.stdout + under.stderr
+        rows = [json.loads(ln) for ln in
+                under.stdout.strip().splitlines()]
+        memrow = [r for r in rows if "memory" in r]
+        assert memrow and memrow[0]["memory"][0]["within_budget"]
+
+
+# ---------------------------------------------------------------------------
+# staged feeds
+# ---------------------------------------------------------------------------
+
+class TestStagedFeeds:
+    def test_prefetch_to_device_accounts_and_releases(self):
+        led = mem.get_ledger()
+        data = [np.ones((4, 8), np.float32) * i for i in range(4)]
+        ds = stf.data.Dataset.from_tensor_slices(np.stack(data)) \
+            .batch(2).prefetch_to_device(buffer_size=1)
+        it = iter(ds)
+        first = next(it)
+        assert led.live_bytes(cls=mem.CLASS_STAGED) > 0
+        for _ in it:
+            pass
+        if hasattr(it, "close"):
+            it.close()
+        del it, ds, first
+        gc.collect()
+        assert led.live_bytes(cls=mem.CLASS_STAGED) == 0

@@ -298,11 +298,63 @@ def telemetry_server():
 
 
 class TestTelemetryServer:
-    def test_healthz(self, telemetry_server):
-        status, ctype, body = _get(telemetry_server.url + "/healthz")
+    def test_healthz_readiness(self, telemetry_server):
+        # ISSUE 13 satellite: /healthz is a READINESS probe — 503 until
+        # at least one live Session (or loaded servable) exists ...
+        stf.reset_default_graph()
+        import gc
+
+        gc.collect()  # sessions from earlier tests must not linger
+        from simple_tensorflow_tpu.client import session as sess_mod
+
+        for s in list(sess_mod.live_sessions):
+            s.close()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(telemetry_server.url + "/healthz")
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read().decode())
+        assert payload["ready"] is False
+        # ... liveness keeps the old contract under ?live=1 ...
+        status, ctype, body = _get(
+            telemetry_server.url + "/healthz?live=1")
         assert status == 200 and "json" in ctype
         payload = json.loads(body)
         assert payload["status"] == "ok" and payload["pid"] == os.getpid()
+        # ... and a live Session flips readiness to 200.
+        g = stf.Graph()
+        with g.as_default():
+            sess = stf.Session(graph=g)
+        try:
+            status, _, body = _get(telemetry_server.url + "/healthz")
+            assert status == 200
+            assert json.loads(body)["ready"] is True
+        finally:
+            sess.close()
+
+    def test_memz(self, telemetry_server):
+        g = stf.Graph()
+        with g.as_default():
+            w = stf.Variable(np.ones((32, 8), np.float32), name="memz_w")
+            sess = stf.Session(graph=g)
+            sess.run(w.initializer)
+        try:
+            status, ctype, body = _get(telemetry_server.url + "/memz")
+            assert status == 200 and "json" in ctype
+            info = json.loads(body)
+            assert info["total_bytes"] >= 32 * 8 * 4
+            assert "weights" in info["by_class_owner"]
+            assert info["high_watermark_bytes"] >= info["total_bytes"]
+            assert isinstance(info["top_allocations"], list)
+            assert any(a["name"] == "memz_w"
+                       for a in info["top_allocations"])
+            # ?reconcile=1 diffs against jax.live_arrays()
+            status, _, body = _get(
+                telemetry_server.url + "/memz?reconcile=1")
+            assert status == 200
+            rec = json.loads(body)["reconcile"]
+            assert "untracked_bytes" in rec
+        finally:
+            sess.close()
 
     def test_metrics_is_valid_prometheus(self, telemetry_server):
         monitoring.Counter("/stf/telemetry/__test_families",
