@@ -2241,6 +2241,21 @@ def run_bench_transformer(platform, device_kind):
         batches, lambda b: _measure_transformer(b, platform, device_kind))
 
 
+def _stage_feed(mesh, tensor, arr):
+    """Pre-stage a feed array on the mesh per the tensor's sharding attr
+    (searched or hand-placed; replicated when absent). Shared by the
+    resnet_dp and autoshard rows — numpy feeds would re-scatter over the
+    mesh every step, an input-pipeline cost, not a sharding cost."""
+    import jax
+
+    spec = tensor.op.attrs.get("sharding")
+    ns = jax.sharding.NamedSharding(
+        mesh.jax_mesh,
+        jax.sharding.PartitionSpec(*spec) if spec is not None
+        else jax.sharding.PartitionSpec())
+    return jax.device_put(arr, ns)
+
+
 def _measure_resnet_dp(n_devices=8):
     """BASELINE config 3: ResNet data-parallel scaling. No multi-chip
     hardware on this rig, so this measures SHARDING OVERHEAD on a virtual
@@ -2248,7 +2263,12 @@ def _measure_resnet_dp(n_devices=8):
     t_unsharded / t_dp — 1.0 means the mesh lowering (psum grads,
     sharded feeds, partitioned program) adds nothing over running the
     identical computation unsharded. On real chips the same code path
-    gives true scaling."""
+    gives true scaling.
+
+    r12 (ISSUE 14): the dp layout is SEARCHED (stf.parallel.auto_shard
+    over the train plan — feeds, variable placement, cut points), not
+    hand-placed; the pure-JAX control keeps its hand-written specs, so
+    the row now reads "searched stf layout vs hand-written JAX"."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -2265,13 +2285,14 @@ def _measure_resnet_dp(n_devices=8):
 
     trials = int(os.environ.get("BENCH_DP_TRIALS", "3"))
 
-    def time_model(mesh, batch):
+    def time_model(mesh, batch, collect=None):
         """Compile once, then time the step loop `trials` times; return the
         list of per-step times so the caller can take a median (single
         timings on a shared physical core swung 37% between bench runs).
         bf16 params/activations and pre-staged device feeds to mirror the
         pure-JAX control exactly (numpy feeds would re-scatter over the
-        mesh every step — input-pipeline cost, not sharding cost)."""
+        mesh every step — input-pipeline cost, not sharding cost). With a
+        mesh, the layout comes from the autoshard SEARCH — no hand specs."""
         import jax.numpy as jnp
 
         stf.reset_default_graph()
@@ -2281,17 +2302,17 @@ def _measure_resnet_dp(n_devices=8):
                 batch_size=batch, image_size=image, dtype=stf.bfloat16,
                 learning_rate=0.1)
             if mesh is not None:
-                parallel.shard_feed(m["images"], "dp")
-                parallel.shard_feed(m["labels"], "dp")
+                res = parallel.auto_shard(
+                    fetches=[m["train_op"], m["loss"]])
+                if collect is not None:
+                    collect["autoshard"] = res
             xv, yv = resnet.synthetic_imagenet(batch, image,
                                                dtype=np.float32)
             xd = jnp.asarray(xv, dtype=stf.bfloat16.np_dtype)
             yd = jnp.asarray(yv)
             if mesh is not None:
-                dp_sh = jax.sharding.NamedSharding(
-                    mesh.jax_mesh, jax.sharding.PartitionSpec("dp"))
-                xd = jax.device_put(xd, dp_sh)
-                yd = jax.device_put(yd, dp_sh)
+                xd = _stage_feed(mesh, m["images"], xd)
+                yd = _stage_feed(mesh, m["labels"], yd)
             feed = {m["images"]: xd, m["labels"]: yd}
             sess = stf.Session()
             sess.run(stf.global_variables_initializer())
@@ -2357,7 +2378,9 @@ def _measure_resnet_dp(n_devices=8):
     t_single = float(np.median(time_model(None,
                                           per_dev_batch * n_devices)))
     mesh = parallel.Mesh({"dp": n_devices}, devices=devices[:n_devices])
-    t_dp_trials = time_model(mesh, per_dev_batch * n_devices)
+    collected = {}
+    t_dp_trials = time_model(mesh, per_dev_batch * n_devices,
+                             collect=collected)
     t_dp = float(np.median(t_dp_trials))
     t_jax_single = time_pure_jax(shard=False)
     t_jax_dp = time_pure_jax(shard=True)
@@ -2396,11 +2419,154 @@ def _measure_resnet_dp(n_devices=8):
         "t_jax_dp_s": round(t_jax_dp, 4),
         "stf_added_s": round(stf_added, 4),
         "jax_added_s": round(jax_added, 4),
+        "layout": "searched (parallel.auto_shard; no hand specs)",
+        "autoshard_search_s": round(
+            collected["autoshard"].search_seconds, 3)
+        if "autoshard" in collected else None,
+        "autoshard_feed_specs": {
+            k: list(v) for k, v in
+            collected["autoshard"].feed_specs.items()}
+        if "autoshard" in collected else None,
         "note": ("virtual-mesh check (1 core, same total work, pure-JAX "
                  "control): (t_jax_dp - t_jax_unsharded) / (t_stf_dp - "
                  "t_stf_unsharded) — 1.0 = sharding through the stf "
                  "lowering costs the same seconds as hand-written "
                  "jax+GSPMD on the same mesh"),
+        "device": "cpu_virtual_mesh",
+    }
+
+
+def _measure_autoshard(platform, device_kind, n_devices=8):
+    """stf.analysis.autoshard row (ISSUE 14): searched vs hand-spec vs
+    replicated layouts on the resnet50_dp8 virtual-mesh config.
+
+    Reports (1) efficiency = t_hand / t_searched (>= ~1.0 means the
+    searched layout matches-or-beats the hand dp recipe in measured
+    seconds), (2) the searched layout's predicted/harvested collective
+    byte ratio (the PR 6 validation, now on a CHOSEN layout), and
+    (3) the search wall time against the XLA compile it precedes
+    (must stay <10% — same budget discipline as the analyzer row)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import parallel
+    from simple_tensorflow_tpu.models import resnet
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} virtual devices, have {len(devices)}")
+    per_dev_batch, image = 4, 32
+    batch = per_dev_batch * n_devices
+    steps, warmup = 4, 1
+    trials = int(os.environ.get("BENCH_AUTOSHARD_TRIALS", "2"))
+
+    def run_layout(layout):
+        import jax.numpy as jnp
+
+        stf.reset_default_graph()
+        mesh = parallel.Mesh({"dp": n_devices},
+                             devices=devices[:n_devices])
+        out = {}
+        with mesh:
+            m = resnet.resnet50_train_model(
+                batch_size=batch, image_size=image, dtype=stf.bfloat16,
+                learning_rate=0.1)
+            if layout == "hand":
+                parallel.shard_feed(m["images"], "dp")
+                parallel.shard_feed(m["labels"], "dp")
+                for v in stf.global_variables():
+                    if v.sharding is None:
+                        v.set_sharding(parallel.P())
+            elif layout == "searched":
+                res = parallel.auto_shard(
+                    fetches=[m["train_op"], m["loss"]])
+                out["search_seconds"] = res.search_seconds
+                out["candidates"] = res.candidates_priced
+                out["predicted_bytes"] = res.predicted[
+                    "collective_bytes"]
+                out["feed_specs"] = {k: list(v) for k, v in
+                                     res.feed_specs.items()}
+            xv, yv = resnet.synthetic_imagenet(batch, image,
+                                               dtype=np.float32)
+            xd = jnp.asarray(xv, dtype=stf.bfloat16.np_dtype)
+            yd = jnp.asarray(yv)
+
+            xd = _stage_feed(mesh, m["images"], xd)
+            yd = _stage_feed(mesh, m["labels"], yd)
+            feed = {m["images"]: xd, m["labels"]: yd}
+            sess = stf.Session()
+            sess.run(stf.global_variables_initializer())
+            t0 = time.perf_counter()
+            opts = md = None
+            if layout == "searched":
+                opts = stf.RunOptions(
+                    trace_level=stf.RunOptions.SOFTWARE_TRACE)
+                md = stf.RunMetadata()
+            sess.run(m["train_op"], feed_dict=feed, options=opts,
+                     run_metadata=md)
+            out["compile_s"] = time.perf_counter() - t0
+            if md is not None:
+                harvested = md.cost_graph.get("collective_bytes", {})
+                out["harvested_bytes"] = float(
+                    harvested.get("total", 0.0))
+                reps = [s for s in sess._cache.values()
+                        if s.join_sharding() is not None]
+                if reps:
+                    out["analyzer_predicted_bytes"] = \
+                        reps[-1].sharding_report \
+                        .total_collective_bytes()
+            for _ in range(warmup):
+                sess.run(m["train_op"], feed_dict=feed)
+            dts = []
+            for _ in range(trials):
+                sess.run(m["loss"], feed_dict=feed)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    sess.run(m["train_op"], feed_dict=feed)
+                loss = sess.run(m["loss"], feed_dict=feed)
+                dts.append((time.perf_counter() - t0) / (steps + 1))
+            sess.close()
+        assert np.isfinite(np.asarray(loss))
+        out["step_s"] = float(np.median(dts))
+        return out
+
+    replicated = run_layout("replicated")
+    hand = run_layout("hand")
+    searched = run_layout("searched")
+
+    efficiency = hand["step_s"] / max(searched["step_s"], 1e-9)
+    pred = searched.get("analyzer_predicted_bytes") or \
+        searched.get("predicted_bytes", 0.0)
+    harv = searched.get("harvested_bytes", 0.0)
+    ratio = (pred / harv) if harv else None
+    search_frac = searched.get("search_seconds", 0.0) / max(
+        searched["compile_s"], 1e-9)
+    return {
+        "metric": "autoshard_searched_vs_hand_efficiency",
+        "value": round(float(efficiency), 3),
+        "unit": "x (hand-spec step time / searched-layout step time)",
+        "vs_baseline": round(float(efficiency), 3),
+        "within_budget": bool(search_frac < 0.10),
+        "t_searched_s": round(searched["step_s"], 4),
+        "t_hand_s": round(hand["step_s"], 4),
+        "t_replicated_s": round(replicated["step_s"], 4),
+        "search_wall_s": round(searched.get("search_seconds", 0.0), 3),
+        "search_candidates": searched.get("candidates"),
+        "compile_s": round(searched["compile_s"], 2),
+        "search_over_compile": round(search_frac, 4),
+        "predicted_collective_bytes": round(pred),
+        "harvested_collective_bytes": round(harv),
+        "predicted_over_harvested": (round(ratio, 4)
+                                     if ratio is not None else None),
+        "within_5pct": (bool(abs(ratio - 1.0) <= 0.05)
+                        if ratio is not None else None),
+        "searched_feed_specs": searched.get("feed_specs"),
+        "note": ("resnet50 dp8 virtual mesh: searched "
+                 "(parallel.auto_shard, no hand specs) vs hand dp "
+                 "recipe vs no-spec replicated-on-dev0 baseline; "
+                 "predicted/harvested on the SEARCHED layout"),
         "device": "cpu_virtual_mesh",
     }
 
@@ -2440,6 +2606,8 @@ def child_main():
         result = _measure_analysis(platform, kind)
     elif model == "sharding_analysis":
         result = _measure_sharding_analysis(platform, kind)
+    elif model == "autoshard":
+        result = _measure_autoshard(platform, kind)
     elif model == "loop_fusion":
         result = _measure_loop_fusion(platform, kind)
     elif model == "input_pipeline":
@@ -2534,7 +2702,7 @@ def _run_model(model, platform, kind, errors):
                      "shared; the second process disk-hits its XLA "
                      "compiles (compiler.aot.enable_persistent_cache)"),
         }
-    if model in ("resnet_dp", "sharding_analysis"):
+    if model in ("resnet_dp", "sharding_analysis", "autoshard"):
         # virtual-mesh rows: always a CPU-mesh child by design
         env = {k: v for k, v in os.environ.items()
                if k != "PALLAS_AXON_POOL_IPS"}
@@ -2629,6 +2797,9 @@ _METRIC_NAMES = {
     "sharding_analysis": (
         "sharding_analysis_overhead_frac",
         "fraction of plan time (prune+optimize+lower+analysis)"),
+    "autoshard": (
+        "autoshard_searched_vs_hand_efficiency",
+        "x (hand-spec step time / searched-layout step time)"),
     "loop_fusion": ("loop_fusion_bert_amortization_n64_vs_n1",
                     "x (measured_over_predicted improvement)"),
     "input_pipeline": ("input_pipeline_records_per_sec", "records/sec"),
@@ -2670,7 +2841,7 @@ def main():
     for tok in os.environ.get(
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
-            "sharding_analysis,loop_fusion,input_pipeline,serving,"
+            "sharding_analysis,autoshard,loop_fusion,input_pipeline,serving,"
             "telemetry,memory,checkpoint,kernel_tier,generative,"
             "warm_start").split(","):
         tok = tok.strip()
@@ -2688,7 +2859,7 @@ def main():
               file=sys.stderr)
         selected = ["resnet", "bert", "transformer", "mnist",
                     "resnet_dp", "graph_opt", "analysis",
-                    "sharding_analysis", "loop_fusion",
+                    "sharding_analysis", "autoshard", "loop_fusion",
                     "input_pipeline", "serving", "telemetry",
                     "memory", "checkpoint", "kernel_tier",
                     "generative", "warm_start"]

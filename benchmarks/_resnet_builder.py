@@ -1,7 +1,16 @@
 """Shared hand-written pure-JAX ResNet-50 train step for the perf
-diagnostics (profile_resnet.py, bn_variants.py). This is the XLA ceiling
-reference, independent of the stf lowering; ``bn_mode`` selects the
-batch-norm dtype strategy under test."""
+diagnostics (profile_resnet.py, bn_variants.py) and the resnet_dp
+sharding-efficiency control. This is the XLA ceiling reference,
+independent of the stf lowering; ``bn_mode`` selects the batch-norm
+dtype strategy under test.
+
+r12: the step is MOMENTUM SGD with slot state carried in the params
+pytree, matching the stf model's MomentumOptimizer. The control must
+do the SAME per-step state work: under the virtual mesh's one-core
+emulation every replicated state write is serialized per partition, so
+a stateless-SGD control understates what ANY lowering of the real
+training step costs in dp mode (the momentum slots are another full
+model's worth of written-back bytes)."""
 
 from __future__ import annotations
 
@@ -94,11 +103,21 @@ def build_train_step(batch, image_size, bn_mode="bf16_apply"):
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
 
+    # momentum slots ride in the params pytree so the public
+    # (train_step, params, x, y) contract is unchanged
+    for name in list(params):
+        params["mom/" + name] = jnp.zeros_like(params[name])
+
     @jax.jit
     def train_step(p, x, y):
-        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
-        new_p = jax.tree.map(
-            lambda w, gw: (w - 0.1 * gw.astype(w.dtype)), p, g)
+        weights = {k: v for k, v in p.items()
+                   if not k.startswith("mom/")}
+        loss, g = jax.value_and_grad(loss_fn)(weights, x, y)
+        new_p = dict(p)
+        for k, gw in g.items():
+            v = 0.9 * p["mom/" + k] + gw.astype(p["mom/" + k].dtype)
+            new_p["mom/" + k] = v
+            new_p[k] = p[k] - 0.1 * v.astype(p[k].dtype)
         return loss, new_p
 
     x = jnp.asarray(rng.rand(batch, image_size, image_size, 3),

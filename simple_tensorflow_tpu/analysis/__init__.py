@@ -34,8 +34,9 @@ from ..framework.graph import (set_traceback_capture,
                                traceback_capture_enabled)
 from ..framework.op_registry import (Effects, declare_effects,
                                      register_sharding_rule)
-from . import (diagnostics, effects, hazards, lint, loop_safety, sharding,
-               verifier)
+from . import (autoshard, diagnostics, effects, hazards, lint, loop_safety,
+               sharding, verifier)
+from .autoshard import AutoshardResult, search_sharding
 from .diagnostics import (ERROR, NOTE, WARNING, Diagnostic, errors,
                           format_report, max_severity, warnings)
 from .effects import ResolvedEffects, op_effects
@@ -62,6 +63,7 @@ __all__ = [
     "analyze",
     "analyze_sharding", "ShardingReport", "CollectiveEdge",
     "register_sharding_rule", "parse_mesh_arg",
+    "search_sharding", "AutoshardResult",
 ]
 
 

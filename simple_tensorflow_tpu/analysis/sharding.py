@@ -364,6 +364,14 @@ class RuleContext:
         self.mesh_axes = engine.mesh_axes
         self.required: Dict[int, Tuple] = {}
 
+    @property
+    def data_axes(self) -> FrozenSet[str]:
+        """Mesh axes that shard fed data (placeholder/boundary seeds):
+        a contraction over the batch crosses these even when the
+        contracted operand's own spec carries the axis on another dim
+        (the ZeRO-layout gradient reduce-scatter)."""
+        return self._engine.data_axes
+
     # -- helpers -------------------------------------------------------------
     def axis_size(self, axes) -> int:
         if isinstance(axes, str):
@@ -460,6 +468,9 @@ class _Engine:
         self._loop_depth = 0
         self._grad_path_cache: Dict[Operation, FrozenSet[str]] = {}
         self._uneven_seen: Set[str] = set()
+        # axes sharding fed data (populated by seed()): consumed by the
+        # SymbolicGradient rule's batch-contraction sync accounting
+        self.data_axes: FrozenSet[str] = frozenset()
 
     # -- diagnostics/edges ---------------------------------------------------
     def diag(self, severity, code, message, op):
@@ -568,6 +579,23 @@ class _Engine:
                 if raw is not None:
                     self.env[t] = (normalize_spec(raw, t.shape.rank),
                                    SEED)
+        # data axes: what shards the fed batch (placeholder shardings +
+        # non-variable boundary seeds) — the gradient rule's
+        # batch-contraction sync needs them (see RuleContext.data_axes)
+        data: Set[str] = set()
+        for op in ops:
+            if op.type in ("Placeholder", "PlaceholderWithDefault"):
+                raw = self.seed_specs.get(op.name,
+                                          op.attrs.get("sharding"))
+                if raw is not None and op.outputs:
+                    data |= spec_axes(normalize_spec(
+                        raw, op.outputs[0].shape.rank))
+        for t, (spec, strength) in self.env.items():
+            if strength >= SEED and t.op.type not in ("VariableV2",
+                                                      "ReadVariable"):
+                data |= spec_axes(spec)
+        self.data_axes = frozenset(
+            a for a in data if self.mesh_axes.get(a, 1) > 1)
 
     # -- the sweeps ----------------------------------------------------------
     def _outputs_default(self, op: Operation, in_specs, ctx: RuleContext,
@@ -1024,6 +1052,18 @@ def matmul_rule(op: Operation, in_specs, ctx: RuleContext):
     out[r - 2] = sa[am]
     out[r - 1] = sb[bn]
     out_spec = _dedupe_axes(tuple(out))
+    # axis collision: an rhs n-dim axis already sharding an earlier
+    # output dim (lhs batch/m) cannot shard n too — GSPMD gathers the
+    # rhs (the ZeRO layout's per-step weight all-gather; without this
+    # a dp-batch x dp-cout matmul priced as free)
+    dropped_n = {a for a in sb[bn]
+                 if a not in out_spec[r - 1]
+                 and ctx.mesh_axes.get(a, 1) > 1}
+    if dropped_n:
+        # compose with any k-resharding requirement already recorded
+        want_b = list(ctx.required.get(1, sb))
+        want_b[bn] = tuple(a for a in sb[bn] if a not in dropped_n)
+        ctx.require(1, tuple(want_b))
     if set(sa[ak]) & k_axes:
         shared = tuple(sorted(set(sa[ak]) & k_axes))
         out_t = op.outputs[0]
@@ -1243,10 +1283,17 @@ def make_conv_rule(n_spatial: int = 2):
                 tensor_bytes(out_t) / ctx.shard_factor(out_spec),
                 note="conv contraction over sharded in-channel",
                 tensor_name=out_t.name)
-        if sw is not None and any(sw[:-1]):
-            wwant = tuple(() if i < len(sw) - 1 else sw[-1]
-                          for i in range(len(sw)))
-            ctx.require(1, wwant)
+        if sw is not None and len(sw) >= 1:
+            # the filter is consumed gathered on spatial/in-channel
+            # dims, and ALSO on any out-channel axis the output could
+            # not keep (axis collision with the batch sharding — the
+            # ZeRO layout's per-step weight all-gather)
+            kept_chan = tuple(a for a in sw[-1]
+                              if a in out_spec[chan_dim]
+                              or ctx.mesh_axes.get(a, 1) <= 1)
+            wwant = tuple([()] * (len(sw) - 1) + [kept_chan])
+            if wwant != tuple(sw):
+                ctx.require(1, wwant)
         return [out_spec] + [
             replicated(_out_rank(op, i))
             for i in range(1, len(op.outputs))]

@@ -101,6 +101,20 @@ class ConfigProto:
     checkpoint layout) set STF_PALLAS=0 / stf.kernels.set_mode("off")
     BEFORE building the optimizer.
 
+    auto_shard: False (default) | True — prescriptive sharding
+    (stf.analysis.autoshard; docs/ANALYSIS.md "Auto-sharding"). When a
+    >1-device mesh is active at plan time, the FIRST fed (step-shaped)
+    plan runs the PartitionSpec search over its pruned op list and
+    commits the winner BEFORE compile: variable shardings (already-
+    committed state is re-placed immediately), feed shardings, and
+    committing ShardingConstraint ops at the searched cut points.
+    Explicit user-placed specs are kept as fixed seeds, never
+    overridden; the search result is applied once per graph. The
+    searched layout then feeds the PR 6 per-plan analyzer, so
+    /statusz and RunMetadata predicted-collectives report the CHOSEN
+    layout. device_memory_budget_bytes (below), when set, doubles as
+    the search's per-shard peak-HBM feasibility budget.
+
     device_memory_budget_bytes: device-memory admission budget for this
     Session (stf.telemetry.memory; docs/OBSERVABILITY.md "Device
     memory"). When set, every plan is admission-checked at plan time
@@ -134,7 +148,8 @@ class ConfigProto:
                  graph_analysis="off", variable_hazard_mode=None,
                  loop_fusion_steps=1, async_fetches=False,
                  compile_cache_dir=None, telemetry_port=None,
-                 kernel_registry=None, device_memory_budget_bytes=None):
+                 kernel_registry=None, device_memory_budget_bytes=None,
+                 auto_shard=False):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -184,6 +199,7 @@ class ConfigProto:
                     "device_memory_budget_bytes must be >= 0 or None, "
                     f"got {device_memory_budget_bytes}")
         self.device_memory_budget_bytes = device_memory_budget_bytes
+        self.auto_shard = bool(auto_shard)
         if telemetry_port is not None:
             telemetry_port = int(telemetry_port)
             if telemetry_port < 0 or telemetry_port > 65535:

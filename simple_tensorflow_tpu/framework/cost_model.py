@@ -120,7 +120,11 @@ _REDUCTION_OPS = {"Sum", "Mean", "Prod", "Max", "Min", "All", "Any",
 _FREE_OPS = {"Identity", "Reshape", "StopGradient", "Placeholder", "Const",
              "VariableV2", "ReadVariable", "Shape", "Rank", "Size",
              "NoOp", "ExpandDims", "Squeeze", "ZerosLike", "Snapshot",
-             "PreventGradient", "CheckNumerics"}
+             "PreventGradient", "CheckNumerics",
+             # a layout annotation, not compute: any resharding it
+             # forces is priced by the sharding analyzer's edge
+             # classification, never double-counted here
+             "ShardingConstraint"}
 # pure data movement: bytes count, flops don't
 _ZERO_FLOP_OPS = {"Transpose", "CapturedInput", "FuncArg"}
 _TRANSCENDENTAL_OPS = {"Exp", "Log", "Sigmoid", "Tanh", "Softmax",

@@ -520,11 +520,27 @@ def _sharding_symbolic_gradient(op, in_specs, ctx):
             path_axes |= set(_shard.spec_axes(ctx.spec(t)))
     path_axes = {a for a in path_axes if ctx.mesh_axes.get(a, 1) > 1}
     outs = []
+    data_axes = set(getattr(ctx, "data_axes", ()) or ())
     for i, x in enumerate(xs):
         sp = in_specs[n_ys + i]
         if sp is None:
             sp = _shard.replicated(x.shape.rank)
+        # Axes sharding the forward path but not x force a cross-shard
+        # contraction of x's gradient. For a WEIGHT the batch is the
+        # contracted dim, so a DATA axis (sharded batch) crosses
+        # devices even when the weight's own spec carries it on another
+        # dim — dp-batch + dp-sharded-weight (ZeRO) is the
+        # reduce-scatter (payload already divided by x's shard factor
+        # below); replicated weights pay the classic full all-reduce; a
+        # tp-style axis that shards the weight itself still costs
+        # nothing (Megatron column-parallel). A batch-carrying target
+        # (input/activation: saliency, adversarial grads) contracts
+        # nothing over the batch — its gradient is sharded like the
+        # tensor itself and needs no data-axis sync.
+        is_weight = x.op.type in ("VariableV2", "ReadVariable")
         red = path_axes - set(_shard.spec_axes(sp))
+        if is_weight:
+            red |= (path_axes & data_axes)
         if red and i < len(op.outputs):
             g = op.outputs[i]
             # payload at the ACCUMULATOR precision, not the storage

@@ -6,6 +6,8 @@ from .api import (
     shard_variables_along, shard_variable, shard_feed,
     with_sharding_constraint, match_partition_rules, num_devices,
     process_index, process_count, is_chief,
+    auto_shard, emit_commit_constraint, mlperf_pod_train,
+    PodTrainProgram,
 )
 from .collectives import (
     all_reduce, all_gather, reduce_scatter, all_to_all, ppermute,

@@ -110,15 +110,37 @@ def _lower_sharding_constraint(ctx, op, inputs):
 
     mesh = current_mesh()
     spec = op.attrs["spec"]
-    if mesh is None:
+    if mesh is None or getattr(ctx, "host", False) \
+            or getattr(ctx, "in_shard_map", False):
+        # no mesh / host stage / inside shard_map (manual axes): the
+        # constraint is a no-op passthrough, never an error
         return [inputs[0]]
     ns = jax.sharding.NamedSharding(mesh.jax_mesh, spec.to_jax()
                                     if isinstance(spec, PartitionSpec)
                                     else jax.sharding.PartitionSpec(*spec))
-    return [jax.lax.with_sharding_constraint(inputs[0], ns)]
+    out = jax.lax.with_sharding_constraint(inputs[0], ns)
+    if op.attrs.get("commit") and hasattr(ctx, "env"):
+        # committing constraint (autoshard cut point): rebind the INPUT
+        # tensor's traced value so every consumer lowered after this op
+        # reads the constrained value — Session._plan splices commit
+        # ops immediately after their producer, so that is all of them.
+        # Consumers resolve inputs through the CSE alias map, so the
+        # canonical tensor must rebind too.
+        t = op.inputs[0]
+        ctx.env[t] = out
+        canon = getattr(ctx, "alias", {}).get(t)
+        if canon is not None:
+            ctx.env[canon] = out
+    return [out]
 
 
-op_registry.register("ShardingConstraint", lower=_lower_sharding_constraint)
+def _infer_sharding_constraint(graph, attrs, input_tensors):
+    t = input_tensors[0]
+    return [(t.shape, t.dtype)]
+
+
+op_registry.register("ShardingConstraint", lower=_lower_sharding_constraint,
+                     infer_fn=_infer_sharding_constraint)
 
 
 def with_sharding_constraint(tensor, *spec, name=None):
@@ -131,6 +153,24 @@ def with_sharding_constraint(tensor, *spec, name=None):
                      name=name or "sharding_constraint",
                      output_specs=[(t.shape, t.dtype)])
     return op.outputs[0]
+
+
+def emit_commit_constraint(tensor, spec, name=None):
+    """Create a COMMITTING ``ShardingConstraint`` op for ``tensor`` (the
+    autoshard cut-point form): a first-class graph op whose lowering
+    both returns the constrained value and rebinds the input tensor's
+    traced value, so consumers that were built before the constraint
+    existed still read the committed layout. ``Session._plan`` splices
+    registered commit ops into any plan that produces their input
+    (see ``Graph._scoped_state['__autoshard_constraints__']``)."""
+    t = ops_mod.convert_to_tensor(tensor)
+    g = t.op.graph
+    op = g.create_op(
+        "ShardingConstraint", [t],
+        attrs={"spec": P(*spec), "commit": True},
+        name=name or "autoshard_constraint",
+        output_specs=[(t.shape, t.dtype)])
+    return op
 
 
 def num_devices() -> int:
@@ -183,7 +223,7 @@ op_registry.register_sharding_rule("ShardingConstraint",
 
 
 def match_partition_rules(rules, variable_store=None, on_missing="replicate",
-                          apply=False, mesh=None):
+                          apply=False, mesh=None, diagnostics=None):
     """Regex name-pattern -> PartitionSpec mapping over variables
     (SNIPPETS.md [2] exemplar: the fmengine/EasyLM idiom).
 
@@ -203,6 +243,14 @@ def match_partition_rules(rules, variable_store=None, on_missing="replicate",
     against the graph (collective bytes, lint findings) before paying a
     compile. ``apply=True`` also commits each matched spec via
     ``Variable.set_sharding`` (the Session then places state with it).
+
+    A large non-scalar variable that falls through to the
+    ``on_missing="replicate"`` default is a rule-set GAP, not a
+    choice: it emits a ``sharding/unmatched-large-var`` WARNING
+    (logged, and appended to ``diagnostics`` when a list is passed —
+    the byte threshold is the replicated-large-tensor lint cutoff,
+    ``STF_SHARDING_LARGE_BYTES``) so gaps surface before an autoshard
+    search or a compile papers over them.
     """
     import re
 
@@ -244,7 +292,207 @@ def match_partition_rules(rules, variable_store=None, on_missing="replicate",
             if on_missing == "skip":
                 continue
             matched = P()
+            _warn_unmatched_large(name, var, dims, diagnostics)
         out[name] = matched
         if apply and hasattr(var, "set_sharding"):
             var.set_sharding(matched)
     return out
+
+
+def _warn_unmatched_large(name, var, dims, diagnostics):
+    """``sharding/unmatched-large-var``: the on_missing="replicate"
+    default silently replicated a tensor above the
+    replicated-large-tensor lint cutoff — a rule-set gap that must be
+    loud before a search or a compile builds on it."""
+    from ..analysis import diagnostics as diag_mod
+    from ..analysis.sharding import LARGE_TENSOR_BYTES
+
+    if dims is None or len(dims) == 0:
+        return
+    n = 1
+    for d in dims:
+        n *= (d or 1)
+    try:
+        dsize = var.dtype.base_dtype.size
+    except Exception:
+        dsize = 4
+    nbytes = n * dsize
+    if nbytes < LARGE_TENSOR_BYTES:
+        return
+    msg = (f"match_partition_rules: no rule matches variable {name!r} "
+           f"({int(nbytes)} bytes); on_missing='replicate' copies it "
+           "whole into every device's HBM — add a rule (or a "
+           "deliberate catch-all ('.*', P()))")
+    if diagnostics is not None:
+        diag_mod.report(diagnostics, diag_mod.WARNING,
+                        "sharding/unmatched-large-var", msg,
+                        op=getattr(var, "op", None))
+    from ..platform import tf_logging as logging
+
+    logging.warning("sharding/unmatched-large-var: %s", msg)
+
+
+# ---------------------------------------------------------------------------
+# auto-sharding (stf.analysis.autoshard; ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def auto_shard(variable_store=None, mesh=None, rules=None, fetches=None,
+               feeds=(), graph=None, budget_bytes=None,
+               emit_constraints=True, **search_kw):
+    """Search PartitionSpecs for the variable store + plan inputs with
+    the collective-cost analyzer as the objective and COMMIT the winner
+    to the live graph: variable shardings, feed shardings, and
+    committing ``ShardingConstraint`` ops at the searched cut points.
+    Explicit user-placed specs are kept as fixed seeds, never
+    overridden. Returns the :class:`stf.analysis.autoshard
+    .AutoshardResult` (rule set, predicted bytes, cut points).
+
+    ``variable_store`` is accepted for symmetry with
+    ``match_partition_rules`` (an iterable of Variables to restrict
+    the search to); None searches every variable in the plan/graph.
+    """
+    from ..analysis import autoshard as autoshard_mod
+    from ..framework import graph as ops_graph
+
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("auto_shard: no mesh — pass mesh= or enter a "
+                         "stf.parallel.Mesh context")
+    graph = graph or ops_graph.get_default_graph()
+    ops = None
+    if fetches is not None:
+        from ..framework import lowering as lowering_mod
+
+        targets = []
+        for f in (fetches if isinstance(fetches, (list, tuple))
+                  else [fetches]):
+            targets.append(f if isinstance(f, ops_graph.Operation)
+                           else f.op)
+        ops = lowering_mod.prune(targets, fed_tensors=set(feeds))
+    result = autoshard_mod.search_sharding(
+        graph=graph, ops=ops, mesh=mesh, fetches=fetches, feeds=feeds,
+        rules=rules, budget_bytes=budget_bytes, **search_kw)
+    if variable_store is not None:
+        keep = set()
+        for v in (variable_store.values()
+                  if isinstance(variable_store, dict)
+                  else variable_store):
+            keep.add(getattr(v, "var_name", None)
+                     or getattr(v, "name", ""))
+        for g in result.groups:
+            if g["kind"] == "var":
+                g["members"] = [m for m in g["members"] if m in keep]
+    result.apply(graph=graph, emit_constraints=emit_constraints)
+    return result
+
+
+class PodTrainProgram:
+    """What :func:`mlperf_pod_train` returns: the accumulate / apply
+    ops plus a driver. ``run(sess, feeds)`` executes one GLOBAL batch —
+    N gradient-accumulation micro-steps then one (mean-scaled) apply —
+    and returns the last micro-step's loss. With
+    ``gradient_accumulation_steps == 1`` ``train_op`` is a plain
+    fused step and ``run`` is one ``sess.run``."""
+
+    def __init__(self, train_op, accum_op, apply_op, loss, steps,
+                 autoshard_result):
+        self.train_op = train_op
+        self.accum_op = accum_op
+        self.apply_op = apply_op
+        self.loss = loss
+        self.steps = int(steps)
+        self.autoshard = autoshard_result
+
+    def run(self, sess, feed_fn=None, feed_dict=None):
+        """One global batch. ``feed_fn(micro_step) -> feed_dict``
+        supplies per-micro-batch feeds; a fixed ``feed_dict`` repeats
+        the same batch (testing)."""
+        out = None
+        for i in range(self.steps):
+            fd = feed_fn(i) if feed_fn is not None else feed_dict
+            if self.steps == 1:
+                out = sess.run([self.loss, self.train_op],
+                               feed_dict=fd)[0]
+            else:
+                out = sess.run([self.loss, self.accum_op],
+                               feed_dict=fd)[0]
+        if self.steps > 1:
+            sess.run(self.apply_op, feed_dict=fd)
+        return out
+
+
+def mlperf_pod_train(loss, mesh=None, optimizer=None,
+                     gradient_accumulation_steps=1, fetches=None,
+                     rules=None, **autoshard_kw):
+    """The MLPerf-pod recipe (1909.09756) as one entry point: a dp×tp
+    mesh, SEARCHED shardings (``auto_shard`` over the train plan — no
+    hand-placed specs), and gradient accumulation for global-batch
+    scaling. Returns a :class:`PodTrainProgram`.
+
+    ``optimizer`` defaults to plain SGD; pass a Momentum/LARS/LAMB-
+    style optimizer for the full pod recipe.
+    ``gradient_accumulation_steps`` > 1 builds accumulator variables:
+    the accum op adds one micro-batch's grads in place, the apply op
+    feeds the MEAN accumulated gradient to the optimizer and zeroes
+    the accumulators (1909.09756's batch-scaling lever)."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("mlperf_pod_train: no mesh — pass mesh= or "
+                         "enter a stf.parallel.Mesh context")
+    if optimizer is None:
+        from ..train import GradientDescentOptimizer
+
+        optimizer = GradientDescentOptimizer(0.01)
+    n = int(gradient_accumulation_steps)
+    accum_op = apply_op = train_op = None
+    if n <= 1:
+        train_op = optimizer.minimize(loss)
+        searched_fetches = fetches or [train_op, loss]
+    else:
+        import numpy as np
+
+        from ..framework import graph as ops_graph
+        from ..ops import math_ops, state_ops, variables as vars_mod
+
+        grads_vars = [(g, v) for g, v in
+                      optimizer.compute_gradients(loss)
+                      if g is not None]
+        accums = []
+        with ops_graph.get_default_graph().name_scope("grad_accum"):
+            for g, v in grads_vars:
+                acc = vars_mod.Variable(
+                    np.zeros([d or 1 for d in g.shape.as_list()],
+                             dtype=g.dtype.np_dtype),
+                    trainable=False,
+                    name=v.op.name.rsplit("/", 1)[-1] + "_accum")
+                accums.append(acc)
+            accum_ops = [state_ops.assign_add(acc, g)
+                         for acc, g in zip(accums,
+                                           (g for g, _ in grads_vars))]
+            from ..ops import control_flow_ops as cf
+
+            accum_op = cf.group(*[op.op if hasattr(op, "op") else op
+                                  for op in accum_ops],
+                                name="accumulate")
+            scale = 1.0 / float(n)
+            mean_gv = [(math_ops.multiply(acc.value(), scale), v)
+                       for acc, (_, v) in zip(accums, grads_vars)]
+            step = optimizer.apply_gradients(mean_gv)
+            from ..ops import array_ops
+
+            zeros = []
+            with ops_graph.get_default_graph().control_dependencies(
+                    [step]):
+                for acc in accums:
+                    # zeros_like, NOT acc*0.0: an inf/nan accumulated
+                    # gradient times 0.0 is nan — the reset must clear
+                    # a poisoned accumulator, not propagate it
+                    zeros.append(state_ops.assign(
+                        acc, array_ops.zeros_like(acc.value())))
+            apply_op = cf.group(step, *[z.op if hasattr(z, "op") else z
+                                        for z in zeros], name="apply")
+        searched_fetches = fetches or [accum_op, apply_op, loss]
+    result = auto_shard(mesh=mesh, fetches=searched_fetches,
+                        rules=rules, **autoshard_kw)
+    return PodTrainProgram(train_op, accum_op, apply_op, loss, max(n, 1),
+                           result)

@@ -6,6 +6,7 @@
         [--kernels [off|auto|force]] \
         [--memory [--budget BYTES]] \
         [--mesh 8|2x4|dp=2,tp=4] [--rules rules.json] \
+        [--autoshard [--emit-rules out.json] [--budget BYTES]] \
         [--max-severity note|warning|error]
 
 Runs the stf.analysis stack over a GraphDef written by
@@ -91,6 +92,20 @@ def memory_summary(graph, fetch_names=None, fetches=None, budget=None):
             row["within_budget"] = bool(est.peak_bytes <= int(budget))
         rows.append(row)
     return rows
+
+
+def autoshard_summary(graph, mesh, fetches=None, partition_rules=None,
+                      budget=None):
+    """``graph_lint --autoshard``: run the PartitionSpec search offline
+    on an imported GraphDef (stf.analysis.autoshard) and return the
+    result — per-group chosen specs, predicted collective bytes vs the
+    replicated baseline, per-shard peak vs ``budget``. Pure analysis:
+    nothing is applied."""
+    from ..analysis import autoshard as autoshard_mod
+
+    return autoshard_mod.search_sharding(
+        graph=graph, mesh=mesh, fetches=fetches or None,
+        rules=partition_rules, budget_bytes=budget)
 
 
 def run_lint(graph_def: dict, fetch_names=None, severities=None,
@@ -179,6 +194,20 @@ def main(argv=None):
                          "rule and prints a per-op-type verdict "
                          "summary (routed / fallback+reason / "
                          "autotune / no-kernel)")
+    ap.add_argument("--autoshard", action="store_true",
+                    help="run the auto-sharding search "
+                         "(stf.analysis.autoshard) over the graph on "
+                         "the --mesh: prints the per-group chosen "
+                         "PartitionSpecs and predicted collective "
+                         "bytes vs the replicated baseline; --rules "
+                         "seeds the search; with --budget, exit 1 "
+                         "when the winning layout's predicted "
+                         "per-shard peak HBM exceeds it")
+    ap.add_argument("--emit-rules", default=None, metavar="OUT_JSON",
+                    help="write the winning rule set (the --rules / "
+                         "match_partition_rules format) to OUT_JSON "
+                         "for review/snapshotting (requires "
+                         "--autoshard)")
     ap.add_argument("--memory", action="store_true",
                     help="print the per-plan predicted peak device-"
                          "memory table (static cost model over each "
@@ -237,11 +266,16 @@ def main(argv=None):
     from .. import analysis
 
     if sum(bool(x) for x in (args.kernels, args.serving,
-                             args.memory)) > 1:
-        ap.error("--kernels, --serving, and --memory are separate lint "
-                 "purposes; run them as separate invocations")
-    if args.budget is not None and not args.memory:
-        ap.error("--budget requires --memory")
+                             args.memory, args.autoshard)) > 1:
+        ap.error("--kernels, --serving, --memory, and --autoshard are "
+                 "separate lint purposes; run them as separate "
+                 "invocations")
+    if args.budget is not None and not (args.memory or args.autoshard):
+        ap.error("--budget requires --memory or --autoshard")
+    if args.autoshard and not mesh:
+        ap.error("--autoshard requires --mesh")
+    if args.emit_rules and not args.autoshard:
+        ap.error("--emit-rules requires --autoshard")
     purpose = "serving" if args.serving else (
         "kernels" if args.kernels else (
             "memory" if args.memory else None))
@@ -269,6 +303,27 @@ def main(argv=None):
                     pass
             memory_rows = memory_summary(_graph, fetches=fetches,
                                          budget=args.budget)
+        autoshard_result = None
+        if args.autoshard and _graph is not None:
+            fetches = []
+            for name in args.fetch:
+                try:
+                    fetches.append(_graph.as_graph_element(
+                        name, allow_tensor=True, allow_operation=True))
+                except (KeyError, ValueError):
+                    pass
+            if args.budget is not None and not fetches:
+                # per-shard peak is priced over the fetch closure; with
+                # nothing resolved the budget gate would pass vacuously
+                ap.error("--autoshard --budget needs a resolvable "
+                         f"--fetch (got {args.fetch!r}) — the per-shard "
+                         "peak it gates is priced over the fetch closure")
+            autoshard_result = autoshard_summary(
+                _graph, mesh, fetches=fetches,
+                partition_rules=partition_rules, budget=args.budget)
+            if args.emit_rules:
+                with open(args.emit_rules, "w") as f:
+                    json.dump(autoshard_result.rules(), f, indent=1)
     if args.json:
         for d in diags:
             print(json.dumps(d.to_dict()))
@@ -276,6 +331,9 @@ def main(argv=None):
             print(json.dumps({"kernel_routing": kernel_summary}))
         if memory_rows is not None:
             print(json.dumps({"memory": memory_rows}))
+        if autoshard_result is not None:
+            print(json.dumps(
+                {"autoshard": json.loads(autoshard_result.to_json())}))
         if report is not None:
             print(json.dumps({"summary": report.summary()}))
     else:
@@ -307,6 +365,26 @@ def main(argv=None):
                 row = ", ".join(f"{k}={v}"
                                 for k, v in sorted(verdicts.items()))
                 print(f"  {t}: {row}")
+        if autoshard_result is not None:
+            r = autoshard_result
+            print(f"autoshard ({len(r.groups)} group(s), "
+                  f"{r.candidates_priced} candidate(s), "
+                  f"{r.search_seconds:.3f}s):")
+            for g in sorted(r.groups, key=lambda g: -g["bytes"]):
+                spec = ", ".join("None" if e is None else str(e)
+                                 for e in g["spec"]) or "-"
+                print(f"  [{g['kind']}] {g['pattern'][:40]:<42}"
+                      f"P({spec})  {int(g['bytes'])} B")
+            print(f"  predicted collective bytes/step: "
+                  f"{int(r.predicted['collective_bytes'])} searched vs "
+                  f"{int(r.baseline['collective_bytes'])} replicated")
+            if r.predicted.get("per_shard_peak_bytes") is not None:
+                over = " OVER BUDGET" if r.predicted["over_budget"] \
+                    else ""
+                print(f"  per-shard peak "
+                      f"{int(r.predicted['per_shard_peak_bytes'])} B"
+                      + (f" (budget {args.budget} B){over}"
+                         if args.budget else ""))
         if report is not None:
             s = report.summary()
             print(f"sharding: {s['n_collective_edges']} collective "
@@ -319,6 +397,9 @@ def main(argv=None):
     order = {s: i for i, s in enumerate(SEVERITIES)}
     threshold = order[args.max_severity]
     worst = max((order.get(d.severity, 0) for d in diags), default=-1)
+    if autoshard_result is not None and args.budget \
+            and autoshard_result.predicted.get("over_budget"):
+        return 1
     return 1 if worst >= threshold else 0
 
 

@@ -331,6 +331,134 @@ def test_decode_plan_graph_lint_serving(tmp_path):
 # "memory" purpose), and the CLI exit code gates CI.
 # ---------------------------------------------------------------------------
 
+def _autoshard_snapshot(fetches, mesh):
+    from simple_tensorflow_tpu import analysis
+
+    res = analysis.search_sharding(mesh=mesh, fetches=fetches,
+                                   anneal_steps=16)
+    sharded = {}
+    replicated = set()
+    for g in res.groups:
+        if g["kind"] != "var":
+            continue
+        spec = tuple(g["spec"])
+        if any(e is not None for e in spec):
+            sharded[g["pattern"]] = spec
+        else:
+            replicated.add(g["pattern"])
+    feeds = {k: tuple(v) for k, v in res.feed_specs.items()}
+    return {"sharded": sharded, "replicated": replicated,
+            "feeds": feeds}, res
+
+
+# The chosen rule sets per model/mesh — reviewed like the lint
+# snapshots above: a search/cost-model change that moves a spec shows
+# up here as a diff to be accepted deliberately, not silently.
+AUTOSHARD_SNAPSHOTS = {
+    ("resnet_tiny", "dp8"): {
+        "sharded": {},
+        "feeds": {"images": (None, None, None, None),
+                  "labels": ("dp",)},
+    },
+    ("bert_tiny", "dp8"): {
+        "sharded": {},
+        "feeds": {"input_ids": ("dp", None),
+                  "token_type_ids": ("dp", None),
+                  "mlm_positions": ("dp", None),
+                  "mlm_ids": (None, None),
+                  "mlm_weights": (None, None),
+                  "nsp_labels": (None,)},
+    },
+    ("transformer_tiny", "dp8"): {
+        "sharded": {},
+        "feeds": {"src_ids": ("dp", None), "tgt_in": ("dp", None),
+                  "tgt_out": ("dp", None)},
+    },
+    ("transformer_tiny", "dp2_tp4"): {
+        # Megatron-style: every kernel column-parallel on tp, the
+        # shared embedding tp on d_model, feeds dp on batch
+        "sharded": {
+            "transformer/shared_embedding": (None, "tp"),
+            **{f"transformer/{side}/layer_\\d+/{mod}/kernel":
+               (None, "tp")
+               for side in ("encoder", "decoder")
+               for mod in (("self_attn/q", "self_attn/k",
+                            "self_attn/v", "self_attn/out",
+                            "ffn/in", "ffn/out")
+                           + (("cross_attn/q", "cross_attn/k",
+                               "cross_attn/v", "cross_attn/out")
+                              if side == "decoder" else ()))},
+            **{f"transformer/{side}/layer_\\d+/{mod}/bias": ("tp",)
+               for side in ("encoder", "decoder")
+               for mod in (("self_attn/q", "self_attn/k",
+                            "self_attn/v", "self_attn/out",
+                            "ffn/in", "ffn/out")
+                           + (("cross_attn/q", "cross_attn/k",
+                               "cross_attn/v", "cross_attn/out")
+                              if side == "decoder" else ()))},
+            **{f"transformer/{side}/layer_\\d+/ln\\d+/{p}": ("tp",)
+               for side in ("encoder", "decoder")
+               for p in ("beta", "gamma")},
+        },
+        "feeds": {"src_ids": ("dp", None), "tgt_in": ("dp", None),
+                  "tgt_out": ("dp", None)},
+    },
+}
+
+
+def _check_autoshard_snapshot(key, fetches, mesh):
+    got, res = _autoshard_snapshot(fetches, mesh)
+    want = AUTOSHARD_SNAPSHOTS[key]
+    assert got["sharded"] == want["sharded"], (
+        f"{key}: chosen SHARDED specs moved — review like a lint "
+        f"snapshot diff:\n got {got['sharded']}\nwant "
+        f"{want['sharded']}")
+    assert got["feeds"] == want["feeds"], (
+        f"{key}: chosen feed specs moved:\n got {got['feeds']}\n"
+        f"want {want['feeds']}")
+    # sanity on the result object itself
+    assert res.search_seconds > 0
+    assert res.rules()[-1] == [".*", []]
+    return res
+
+
+def test_zoo_autoshard_resnet_dp8_snapshot():
+    from simple_tensorflow_tpu.models import resnet
+
+    m = resnet.resnet50_train_model(batch_size=8, image_size=32,
+                                    num_classes=10)
+    _check_autoshard_snapshot(("resnet_tiny", "dp8"),
+                              [m["train_op"], m["loss"]], {"dp": 8})
+
+
+def test_zoo_autoshard_bert_dp8_snapshot():
+    from simple_tensorflow_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    m = bert.bert_pretrain_model(batch_size=8, seq_len=16,
+                                 max_predictions=4, cfg=cfg,
+                                 compute_dtype=stf.float32)
+    _check_autoshard_snapshot(("bert_tiny", "dp8"),
+                              [m["train_op"], m["loss"]], {"dp": 8})
+
+
+def test_zoo_autoshard_transformer_snapshots():
+    from simple_tensorflow_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig.tiny()
+    m = tr.transformer_train_model(batch_size=8, src_len=8, tgt_len=8,
+                                   cfg=cfg, compute_dtype=stf.float32)
+    fetches = [m["train_op"], m["loss"]]
+    _check_autoshard_snapshot(("transformer_tiny", "dp8"), fetches,
+                              {"dp": 8})
+    res = _check_autoshard_snapshot(("transformer_tiny", "dp2_tp4"),
+                                    fetches, {"dp": 2, "tp": 4})
+    # the searched tp layout must price BELOW the all-replicated
+    # baseline's step time (the whole point of choosing it)
+    assert res.predicted["step_seconds"] \
+        <= res.baseline["step_seconds"] + 1e-12
+
+
 def test_zoo_memory_budget_gate(tmp_path):
     import json
 
