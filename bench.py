@@ -2232,6 +2232,238 @@ def _measure_generative(platform, device_kind):
     }
 
 
+def _measure_decode2(platform, device_kind):
+    """ISSUE 16: decode throughput II. Two arms:
+
+    (a) SPECULATIVE decoding — target + shrunk draft, both trained on a
+        cyclic-copy task (emit the 8-token prompt over and over, so
+        their greedy choices agree over a long decode budget and
+        acceptance is high) and round-tripped through checkpoints;
+        tokens/sec of the speculative engine vs plain cached greedy
+        decode on the SAME target checkpoint, token-exact required,
+        single-slot latency regime (the draft's fused multi-step
+        program and the batched verify re-score amortize the per-step
+        dispatch that dominates single-stream decode). Acceptance:
+        >=2x.
+    (b) SHARED-PREFIX prompt cache — open-loop load where 80% of the
+        prompts share a ~75%-length prefix through the paged causal-LM
+        engine; median time-to-first-token on a warm prompt cache vs an
+        all-unique no-cache baseline round of the same shape (sharing
+        starts paying from the second request, so a "cold pass" over
+        the shared workload is already mostly warm), plus prefill FLOPs
+        avoided. Acceptance: >=3x TTFT reduction on the shared cohort,
+        decode fill >= 0.8, page reconcile drift 0.
+    """
+    import statistics
+    import tempfile
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import serving
+    from simple_tensorflow_tpu.framework import cost_model as _cm
+    from simple_tensorflow_tpu.models import causal_lm, transformer
+    from simple_tensorflow_tpu.platform import monitoring
+
+    tmp = tempfile.mkdtemp(prefix="stf_bench_decode2_")
+
+    # -- (a) speculative vs cached greedy ------------------------------------
+    cfg_t = transformer.TransformerConfig(
+        vocab_size=64, d_model=64, num_heads=4, d_ff=128, num_layers=2,
+        dropout=0.0, max_len=64)
+    cfg_d = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, num_heads=2, d_ff=64, num_layers=1,
+        dropout=0.0, max_len=64)
+    src_len, L = 8, 48
+    budget = L - 1                 # long decode amortizes prefill
+    spec_k = 12
+    train_steps = int(os.environ.get("BENCH_DECODE2_TRAIN_STEPS", "1600"))
+    tb = 32
+    rng = np.random.RandomState(0)
+
+    def _train_copy(cfg, name, lr):
+        """Train cyclic copy (tgt = src tiled to the decode budget);
+        save a checkpoint; return its path and the final train
+        accuracy. The noam schedule scales with d_model**-0.5, but the
+        deeper target still diverges at the draft's peak lr — hence
+        the per-model lr."""
+        stf.reset_default_graph()
+        stf.set_random_seed(0)
+        m = transformer.transformer_train_model(
+            batch_size=tb, src_len=src_len, tgt_len=budget, cfg=cfg,
+            learning_rate=lr, warmup_steps=100,
+            compute_dtype=stf.float32)
+        ckpt = os.path.join(tmp, name)
+        reps = (budget + src_len - 1) // src_len
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            acc = 0.0
+            for i in range(train_steps):
+                src = rng.randint(2, cfg.vocab_size,
+                                  (tb, src_len)).astype(np.int32)
+                tgt_out = np.tile(src, (1, reps))[:, :budget]
+                tgt_in = np.concatenate(
+                    [np.full((tb, 1), cfg.eos_id, np.int32),
+                     tgt_out[:, :-1]], axis=1)
+                _, acc = sess.run(
+                    [m["train_op"], m["accuracy"]],
+                    {m["src_ids"]: src, m["tgt_in"]: tgt_in,
+                     m["tgt_out"]: tgt_out})
+                if acc >= 0.9995 and i > 50:
+                    break
+            saver = stf.train.Saver()
+            saver.save(sess, ckpt)
+        return ckpt, float(acc)
+
+    ckpt_t, acc_t = _train_copy(cfg_t, "target", 0.7)
+    ckpt_d, acc_d = _train_copy(cfg_d, "draft", 1.0)
+
+    slots = 1
+    n_reqs = int(os.environ.get("BENCH_DECODE2_SPEC_REQS", "12"))
+    prompts = rng.randint(2, cfg_t.vocab_size,
+                          (n_reqs, src_len)).astype(np.int32)
+
+    def _run_arm(model, draft=None, name="d2"):
+        policy = serving.DecodePolicy(num_slots=slots,
+                                      max_decode_len=L,
+                                      max_new_tokens=budget)
+        engine = serving.GenerativeEngine(name, model, policy,
+                                          draft=draft)
+        t0 = time.perf_counter()
+        futs = [engine.generate(p, max_new_tokens=budget)
+                for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        stats = engine.statusz_info()
+        engine.close()
+        toks = [list(r["tokens"]) for r in results]
+        return toks, sum(len(t) for t in toks) / wall, stats
+
+    plain_model = transformer.TransformerGenerativeModel(
+        cfg_t, src_len, num_slots=slots, max_decode_len=L,
+        checkpoint=ckpt_t, aot_warmup=True)
+    plain_toks, plain_tps, _ = _run_arm(plain_model, name="d2_plain")
+
+    target = transformer.TransformerGenerativeModel(
+        cfg_t, src_len, num_slots=slots, max_decode_len=L,
+        checkpoint=ckpt_t, aot_warmup=True, speculative_k=spec_k)
+    draft = transformer.TransformerGenerativeModel(
+        cfg_d, src_len, num_slots=slots, max_decode_len=L,
+        checkpoint=ckpt_d, aot_warmup=True, draft_steps=spec_k - 1)
+    spec_toks, spec_tps, spec_stats = _run_arm(target, draft=draft,
+                                               name="d2_spec")
+    token_exact = bool(plain_toks == spec_toks)
+    spec_info = spec_stats.get("speculative", {})
+    spec_speedup = spec_tps / max(plain_tps, 1e-9)
+
+    # -- (b) shared-prefix prompt cache --------------------------------------
+    # Big enough that per-chunk prefill dominates TTFT over scheduler
+    # dispatch (tiny() drowns the cache win in ~1.4ms of queue latency),
+    # and prompts sized so the cached span (prompt[:-1]) is page-aligned:
+    # every chunk is trie-insertable, no partial tail.
+    page_len, pages_per_seq, num_pages = 8, 8, 96
+    cfg_c = transformer.TransformerConfig(
+        vocab_size=64, d_model=128, num_heads=4, d_ff=256, num_layers=4,
+        dropout=0.0, max_len=page_len * pages_per_seq)
+    clm_model = causal_lm.CausalLMGenerativeModel(
+        cfg_c, page_len=page_len, pages_per_seq=pages_per_seq,
+        num_pages=num_pages, max_live=8, init_fresh=True,
+        aot_warmup=True, seed=0)
+    plen = 41                      # cached = 40 tokens = 5 full pages
+    shared = list(rng.randint(2, cfg_c.vocab_size, 32))  # 4 pages, 78%
+    n_open = int(os.environ.get("BENCH_DECODE2_PREFIX_REQS", "20"))
+
+    def _mk_prompts(share):
+        out = []
+        for i in range(n_open):
+            if share and i % 5 != 4:   # 80% share the 32-token prefix
+                out.append(shared + list(
+                    rng.randint(2, cfg_c.vocab_size, plen - len(shared))))
+            else:                      # private / no-cache baseline
+                out.append(list(rng.randint(2, cfg_c.vocab_size, plen)))
+        return out
+
+    base_prompts = _mk_prompts(share=False)
+    open_prompts = _mk_prompts(share=True)
+    pol = serving.DecodePolicy(num_slots=8,
+                               max_decode_len=clm_model.max_seq_len,
+                               bucket_sizes=[1, 8], max_new_tokens=6)
+    eng = serving.GenerativeEngine("d2_prefix", clm_model, pol)
+
+    def _ttfts(round_prompts):
+        """Sequential closed-loop round; per-request seconds to first
+        emitted token."""
+        out = []
+        for p in round_prompts:
+            marks = []
+            t0 = time.perf_counter()
+            fut = eng.generate(p, max_new_tokens=6,
+                               on_token=lambda tok, lp, _m=marks:
+                               _m.append(time.perf_counter()))
+            fut.result(timeout=600)
+            out.append(marks[0] - t0)
+        return out
+
+    base = _ttfts(base_prompts)            # all-unique: full prefill
+    cold = _ttfts(open_prompts)            # first shared pass populates
+    pc_after_cold = dict(eng._prefix.statusz_info())
+    warm = _ttfts(open_prompts)            # second pass: chunks hit
+    pc_stats = dict(eng._prefix.statusz_info())
+    drift = eng._prefix.reconcile([])
+    eng.close()
+    shared_idx = [i for i in range(n_open) if i % 5 != 4]
+    base_ttft = statistics.median(base)
+    cold_ttft = statistics.median([cold[i] for i in shared_idx])
+    warm_ttft = statistics.median([warm[i] for i in shared_idx])
+    ttft_reduction = base_ttft / max(warm_ttft, 1e-9)
+    hit_tokens = pc_stats["hit_pages"] * page_len
+    flops_avoided = _cm.transformer_forward_flops(
+        1, hit_tokens, cfg_c.d_model, cfg_c.num_layers, d_ff=cfg_c.d_ff)
+    fill_cells = monitoring.export().get(
+        "/stf/serving/decode_fill", {}).get("cells", {})
+    fc = fill_cells.get("d2_prefix", {})
+    fill = (fc.get("sum", 0.0) / fc.get("count", 1)
+            if fc.get("count") else 0.0)
+
+    return {
+        **_monitoring_info(),
+        "metric": "decode2_speculative_speedup_vs_cached_greedy",
+        "value": round(spec_speedup, 2),
+        "unit": "x (tokens/sec, speculative draft+verify / plain "
+                "cached greedy, same target checkpoint)",
+        "vs_baseline": None,
+        "token_exact": token_exact,
+        "spec_tokens_per_sec": round(spec_tps, 1),
+        "plain_tokens_per_sec": round(plain_tps, 1),
+        "spec_acceptance_rate": round(
+            float(spec_info.get("acceptance_rate", 0.0)), 3),
+        "spec_proposed_tokens": spec_info.get("proposed_tokens", 0),
+        "spec_accepted_tokens": spec_info.get("accepted_tokens", 0),
+        "spec_k": spec_k,
+        "spec_num_slots": slots,
+        "copy_task_accuracy": {"target": round(acc_t, 4),
+                               "draft": round(acc_d, 4)},
+        "prefix_ttft_reduction": round(ttft_reduction, 2),
+        "prefix_ttft_nocache_ms": round(base_ttft * 1000, 3),
+        "prefix_ttft_cold_ms": round(cold_ttft * 1000, 3),
+        "prefix_ttft_warm_ms": round(warm_ttft * 1000, 3),
+        "prefix_cache_stats": pc_stats,
+        "prefix_hits_after_cold_pass": pc_after_cold["hit_pages"],
+        "prefix_prefill_tokens_avoided": hit_tokens,
+        "prefix_prefill_flops_avoided": float(flops_avoided),
+        "prefix_fill_fraction": round(fill, 3),
+        "prefix_reconcile_drift": int(drift),
+        "prefix_workload": (f"{n_open} prompts len {plen}, 80% share a "
+                            f"{len(shared)}-token prefix, page_len "
+                            f"{page_len}"),
+        "note": ("speculative arm: cyclic-copy-trained target+shrunk "
+                 "draft through checkpoint round trip, single-slot "
+                 "latency regime, emitted streams compared token-exact "
+                 "vs plain cached decode; prefix "
+                 "arm: warm-cache shared-cohort median TTFT vs an "
+                 "all-unique no-cache round of the same shape, "
+                 "sequential closed-loop"),
+    }
+
+
 def run_bench_transformer(platform, device_kind):
     batches = [int(x) for x in
                os.environ.get("BENCH_TFMR_BATCH", "16,24").split(",") if x]
@@ -2624,6 +2856,8 @@ def child_main():
         result = _measure_kernel_tier(platform, kind)
     elif model == "generative":
         result = _measure_generative(platform, kind)
+    elif model == "decode2":
+        result = _measure_decode2(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -2732,7 +2966,8 @@ def _run_model(model, platform, kind, errors):
                        "telemetry": "900",
                        "memory": "900",
                        "checkpoint": "600",
-                       "generative": "1200"}.get(
+                       "generative": "1200",
+                       "decode2": "1500"}.get(
         model, "900")
     extra_xla_flags = ""
     if model == "loop_fusion":
@@ -2820,6 +3055,9 @@ _METRIC_NAMES = {
     "generative": ("generative_cached_decode_speedup_vs_reforward",
                    "x (tokens/sec, cached KV decode / naive re-forward "
                    "beam search)"),
+    "decode2": ("decode2_speculative_speedup_vs_cached_greedy",
+                "x (tokens/sec, speculative draft+verify / plain "
+                "cached greedy, same target checkpoint)"),
     "warm_start": ("warm_start_warmup_plus_compile_s",
                    "s (second process, shared persistent compile cache)"),
 }
@@ -2842,7 +3080,7 @@ def main():
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
             "sharding_analysis,autoshard,loop_fusion,input_pipeline,serving,"
-            "telemetry,memory,checkpoint,kernel_tier,generative,"
+            "telemetry,memory,checkpoint,kernel_tier,generative,decode2,"
             "warm_start").split(","):
         tok = tok.strip()
         if not tok:
@@ -2862,7 +3100,7 @@ def main():
                     "sharding_analysis", "autoshard", "loop_fusion",
                     "input_pipeline", "serving", "telemetry",
                     "memory", "checkpoint", "kernel_tier",
-                    "generative", "warm_start"]
+                    "generative", "decode2", "warm_start"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

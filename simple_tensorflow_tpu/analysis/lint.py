@@ -35,10 +35,13 @@ Built-in catalog (see docs/ANALYSIS.md for the worked examples):
                          ``graph_lint --serving``) (WARNING)
   lint/serving-decode-cache
                          generative decode-plan shape: KV-cache ops
-                         missing a committed-sharding declaration, or a
+                         missing a committed-sharding declaration, a
                          cache tensor escaping to host (fetched, or
-                         feeding a host-stage op). Active only for
-                         purpose="serving" runs (ERROR)
+                         feeding a host-stage op), a SHARED-page cache
+                         tensor (paged prefix cache) transitively
+                         REACHING a host sink, or a speculative-verify
+                         cache write that is not refcount-guarded.
+                         Active only for purpose="serving" runs (ERROR)
   lint/kernel-routing    per-op Pallas/XLA routing verdicts from the
                          stf.kernels registry (routed / fallback+reason
                          / autotune). Active only for purpose="kernels"
@@ -403,7 +406,19 @@ def _rule_serving_decode_cache(ctx):
       cache op's output, or a cache op's output fetched directly) pays
       a device→host transfer of the whole cache page set per decode
       step — the exact traffic the cache exists to avoid. Slice a
-      device-side view instead, or fetch derived scalars.
+      device-side view instead, or fetch derived scalars;
+    - a SHARED page (paged prefix cache, ``PAGED_ATTR``) holds K/V rows
+      other live sequences read through their page tables, so the
+      host-sink contract tightens from "direct consumer" to
+      REACHABILITY: any path from a paged cache tensor to a host sink
+      leaks refcounted shared state off-device (and a host round-trip
+      in the decode loop serializes every sequence sharing the page);
+    - a cache write inside a speculative VERIFY plan (``VERIFY_ATTR``)
+      lands K rows of which only the accepted prefix is committed; the
+      write must be stamped ``refcount_guarded=True`` (``GUARD_ATTR``)
+      to assert the engine masks the rejected suffix by committed
+      length — an unguarded verify write could expose uncommitted
+      draft rows to a sequence sharing the page.
     """
     if ctx.purpose != "serving":
         return
@@ -413,6 +428,34 @@ def _rule_serving_decode_cache(ctx):
     for f in ctx.fetches:
         if not isinstance(f, ops_mod.Operation):
             fetched.add(f)
+
+    def _is_host_sink(consumer):
+        return consumer.op_def.runs_on_host or op_effects(consumer).io
+
+    # transitive host-sink search for the shared-page branch; memoized
+    # per consumer op so the sweep stays linear in graph size
+    _reach_memo = {}
+
+    def _reaches_host(op):
+        """First host-observable op reachable downstream of ``op``
+        (following data edges), or None."""
+        if op in _reach_memo:
+            return _reach_memo[op]
+        _reach_memo[op] = None  # cycle guard (graphs are acyclic)
+        found = None
+        for out in op.outputs:
+            for consumer in out.consumers():
+                if _is_host_sink(consumer):
+                    found = consumer
+                    break
+                found = _reaches_host(consumer)
+                if found is not None:
+                    break
+            if found is not None:
+                break
+        _reach_memo[op] = found
+        return found
+
     for op in ctx.ops:
         if not _kvc.is_cache_op(op):
             continue
@@ -422,6 +465,17 @@ def _rule_serving_decode_cache(ctx):
                    f"{op.attrs.get('var_name')!r} has no committed "
                    "sharding declaration; declare it at kv_cache(..., "
                    "sharding=...) so the store commits a stable layout")
+        if op.attrs.get(_kvc.VERIFY_ATTR) \
+                and not op.attrs.get(_kvc.GUARD_ATTR):
+            yield (op,
+                   f"verify-plan cache write {op.name!r} on "
+                   f"{op.attrs.get('var_name')!r} is not refcount-"
+                   "guarded: a speculative VERIFY append lands rows "
+                   "the engine may reject; stamp it "
+                   "refcount_guarded=True (append(..., "
+                   "verify_plan=True, refcount_guarded=True)) to "
+                   "assert only the accepted prefix is committed")
+        paged = bool(op.attrs.get(_kvc.PAGED_ATTR))
         for out in op.outputs:
             if out in fetched:
                 yield (op,
@@ -429,15 +483,29 @@ def _rule_serving_decode_cache(ctx):
                        "whole cache page set would transfer "
                        "device->host every decode step; fetch derived "
                        "values instead")
+            direct_sink = False
             for consumer in out.consumers():
-                if consumer.op_def.runs_on_host \
-                        or op_effects(consumer).io:
+                if _is_host_sink(consumer):
+                    direct_sink = True
                     yield (op,
                            f"cache tensor {out.name!r} feeds host-"
                            f"observable op {consumer.name!r} "
                            f"({consumer.type}): the cache must stay "
                            "device-resident across decode steps "
                            "(host-sink on a cache tensor)")
+            if paged and not direct_sink:
+                sink = _reaches_host(op)
+                if sink is not None:
+                    yield (op,
+                           f"shared-page cache tensor {out.name!r} "
+                           f"(paged prefix cache) reaches host-"
+                           f"observable op {sink.name!r} "
+                           f"({sink.type}): shared pages are "
+                           "refcounted device state read by every "
+                           "sequence whose page table maps them; no "
+                           "path from a paged cache tensor may leave "
+                           "the device")
+                    break
 
 
 @register_lint_rule("memory-budget", ERROR)

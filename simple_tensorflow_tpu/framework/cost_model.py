@@ -167,15 +167,22 @@ def _op_flops(op: Operation, grad_depth: int = 0,
         n = sum(_nelems(i.shape) or 0 for i in op.inputs)
         return (12.0 if t == "FusedAdamUpdate" else 6.0) * n
     if t == "DecodeAttention":
-        # q·K + P·V over the gathered cache: 4 * B * H * max_len * D
-        # (the output is only (B, H, D) — the default out-elems pricing
-        # would miss the cache-length factor entirely)
+        # q·K + P·V over the gathered cache: 4 * B * Kq * H * max_len
+        # * D (Kq = 1 for the classic single-query step, the query-
+        # block width for verify/block-prefill plans; the output is
+        # only (B[, Kq], H, D) — the default out-elems pricing would
+        # miss the cache-length factor entirely)
         ks = op.inputs[1].shape
+        qs = op.inputs[0].shape
+        kq = 1
+        if qs.rank == 4 and qs.dims[1].value:
+            kq = int(qs.dims[1].value)
         if ks.rank == 4 and all(d.value for d in ks.dims):
             b, max_len, h, d = (int(x.value) for x in ks.dims)
-            return 4.0 * b * h * max_len * d
+            return 4.0 * b * kq * h * max_len * d
         return 2.0 * _out_elems(op)
-    if t in ("KVCacheAlloc", "KVCacheAppend", "KVCacheGather"):
+    if t in ("KVCacheAlloc", "KVCacheAppend", "KVCacheGather",
+             "KVCachePageCopy"):
         return 0.0  # pure data movement; bytes are priced in _op_bytes
     mult = 2.0 if t in _TRANSCENDENTAL_OPS else 1.0
     return mult * _out_elems(op)
@@ -250,6 +257,17 @@ def _op_bytes_dispatch(op: Operation, fn_depth: int = 0) -> float:
         # default inputs+outputs accounting would charge a full cache
         # write per append and dominate every decode-step attribution)
         return 2.0 * sum(_tensor_bytes(t) for t in op.inputs)
+    if op.type == "KVCachePageCopy":
+        # CoW: M whole rows read + written in place (same donation
+        # argument as the append) — row bytes from the cache attrs,
+        # never the nominal whole-cache output
+        sh = op.attrs.get("shape") or []
+        m = _nelems(op.inputs[0].shape) or 0
+        row = 1
+        for d in sh[1:]:
+            row *= int(d)
+        itemsize = op.outputs[0].dtype.base_dtype.size if op.outputs else 4
+        return 2.0 * m * row * itemsize
     fc = _function_op_cost(op, 0, fn_depth)
     if fc is not None:
         return fc[1]

@@ -4,6 +4,7 @@
 - resnet: ResNet-50 v1.5 bf16/NHWC (configs 2-3)
 - bert: BERT-base MLM+NSP pretraining, flash attention (config 4)
 - transformer: Transformer-big WMT en-de seq2seq + beam search (config 5)
+- causal_lm: decoder-only LM + paged-cache serving (shared-prefix path)
 - word2vec: skip-gram NCE tutorial (ref models.BUILD)
 - long_context: ring-attention long-sequence LM (sequence parallel flagship)
 """
@@ -12,5 +13,6 @@ from . import mnist
 from . import resnet
 from . import bert
 from . import transformer
+from . import causal_lm
 from . import word2vec
 from . import long_context

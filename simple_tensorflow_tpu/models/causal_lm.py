@@ -1,0 +1,475 @@
+"""Decoder-only causal LM: the shared-prefix serving flagship.
+
+(ref: the reference's seq2seq decoder stack minus the encoder — GPT-style
+next-token LM over one token stream.)
+
+Two halves:
+
+- :func:`causal_lm_logits` / :func:`causal_lm_train_model`: the training
+  graph. Layer-for-layer this is the transformer DECODER with the
+  cross-attention sublayer removed — the sublayer/LN naming (``ln1``
+  after self-attention, ``ln3`` after the FFN, no ``ln2``) deliberately
+  matches what ``transformer._incremental_decode`` builds when
+  ``cross_kv=None``, so ONE checkpoint serves both the train graph and
+  the incremental serving programs below.
+
+- :func:`build_causal_lm_program` / :class:`CausalLMGenerativeModel`:
+  the PAGED serving programs. Where the seq2seq serving model keys
+  caches by (slot, position) with one row per live sequence, the causal
+  LM keys them by PAGE: each cache is ``(num_pages + 1, page_len, H,
+  hd)`` with ``paged=True``, a sequence's KV state is the ordered page
+  list in its page table, and attention reads through the page-table
+  gather (``slots (B, n_blocks)`` → the concatenated logical view).
+  That indirection is what the shared-prefix prompt cache
+  (serving/prefix_cache.py) needs: two sequences whose prompts share a
+  prefix point their leading page-table entries at the SAME physical
+  pages (refcounted), prefill runs once, and divergence copies a page
+  (``KVCachePageCopy``) before private appends — copy-on-write.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu.models import common
+from simple_tensorflow_tpu.models.transformer import (
+    TransformerConfig, _attention, _block_decode, _dense, _embed, _ffn,
+    _incremental_decode, _ln, _residual, build_int8_logits_weights,
+    smoothed_xent)
+
+# the causal LM reuses TransformerConfig (decoder-side fields only:
+# d_model/num_heads/d_ff/num_layers/dropout/vocab/max_len)
+CausalLMConfig = TransformerConfig
+
+
+def causal_lm_logits(ids, cfg: TransformerConfig, training=True,
+                     compute_dtype=stf.bfloat16, scope="causal_lm",
+                     recompute=False):
+    """Next-token logits (B, S, vocab) for token ids (B, S).
+
+    Decoder-only stack: causal flash self-attention + FFN per layer,
+    tied-embedding softmax. Position ``j``'s logits predict token
+    ``j+1``.
+    """
+    with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
+        h, emb = _embed(ids, cfg, compute_dtype, training)
+        with stf.variable_scope("decoder"):
+            def lm_layer(hh, i):
+                with stf.variable_scope(f"layer_{i}"):
+                    a = _attention(hh, hh, None, cfg, training,
+                                   compute_dtype, "self_attn",
+                                   causal=True)
+                    hh = _ln(_residual(a, hh, cfg, training), cfg, "ln1")
+                    f = _ffn(hh, cfg, training, "ffn")
+                    # ln3, not ln2: the serving step (cross-skipped
+                    # _incremental_decode) reuses these variables by name
+                    return _ln(hh + f, cfg, "ln3")
+
+            for i in range(cfg.num_layers):
+                h = common.maybe_recompute(lm_layer, h, i, recompute,
+                                           "lm")
+        b, s = int(ids.shape[0]), int(ids.shape[1])
+        flat = stf.reshape(h, [b * s, cfg.d_model])
+        logits = stf.matmul(flat, stf.cast(emb, h.dtype.base_dtype),
+                            transpose_b=True)
+        return stf.reshape(logits, [b, s, cfg.vocab_size])
+
+
+def causal_lm_train_model(batch_size=8, seq_len=32,
+                          cfg: TransformerConfig | None = None,
+                          learning_rate=1.0, warmup_steps=4000,
+                          compute_dtype=stf.bfloat16, recompute=False):
+    """Training graph: tok_in/tok_out -> label-smoothed LM loss -> Adam
+    with the noam schedule (same recipe as the seq2seq transformer)."""
+    cfg = cfg or TransformerConfig.base()
+    tok_in = stf.placeholder(stf.int32, [batch_size, seq_len], "tok_in")
+    tok_out = stf.placeholder(stf.int32, [batch_size, seq_len], "tok_out")
+    logits = causal_lm_logits(tok_in, cfg, training=True,
+                              compute_dtype=compute_dtype,
+                              recompute=recompute)
+    weights = stf.cast(stf.not_equal(tok_out, cfg.pad_id), stf.float32)
+    loss = smoothed_xent(logits, tok_out, weights, cfg)
+    gs = stf.train.get_or_create_global_step()
+    step = stf.cast(gs, stf.float32) + 1.0
+    lr = (learning_rate * cfg.d_model ** -0.5 *
+          stf.minimum(stf.pow(step, -0.5), step * warmup_steps ** -1.5))
+    opt = stf.train.AdamOptimizer(lr, beta1=0.9, beta2=0.997,
+                                  epsilon=1e-9)
+    train_op = opt.minimize(loss, global_step=gs)
+    return {"tok_in": tok_in, "tok_out": tok_out, "loss": loss,
+            "train_op": train_op, "learning_rate": lr, "global_step": gs}
+
+
+# ---------------------------------------------------------------------------
+# Paged serving programs
+# ---------------------------------------------------------------------------
+
+class _PagedCaches:
+    """Cache accessor for the paged decode/prefill programs, the
+    page-table counterpart of ``transformer._SlotCaches``.
+
+    Appends land at ``(dst_pages[b], offsets[b] + j)`` — ONE physical
+    page per sequence per step/block — while the gather reads the
+    LOGICAL view through ``page_tables (B, n_blocks)``, so attention
+    sees the sequence's full history across however many (possibly
+    shared) pages it spans. The RAW between a layer's append and its
+    gather is ordered by an explicit control dependency (the appended
+    page is always present in the table)."""
+
+    def __init__(self, caches, page_tables, dst_pages, offsets, base):
+        self._caches = caches        # [(KVCache k, KVCache v)] per layer
+        self._tables = page_tables   # (B, n_blocks) int32
+        self._dst = dst_pages        # (B,) int32 physical page written
+        self._off = offsets          # (B,) int32 in-page start offset
+        self._base = base            # (B,) int32 committed length BEFORE
+
+    def _one(self, cache, new):
+        appended = cache.append(new, self._dst, self._off)
+        with stf.control_dependencies([appended.op]):
+            return cache.gather(self._tables)
+
+    def append_and_gather(self, layer, k_new, v_new):
+        kc, vc = self._caches[layer]
+        return (self._one(kc, k_new), self._one(vc, v_new),
+                self._base + 1)
+
+    def append_and_gather_block(self, layer, k_new, v_new):
+        kc, vc = self._caches[layer]
+        return self._one(kc, k_new), self._one(vc, v_new), self._base
+
+
+def build_causal_lm_program(cfg: TransformerConfig, *, page_len,
+                            pages_per_seq, num_pages,
+                            decode_bucket_sizes=None,
+                            prefill_bucket_sizes=None,
+                            compute_dtype=stf.float32, int8=False,
+                            sampling=None, scope="causal_lm",
+                            cache_sharding=None):
+    """Build the paged-cache causal-LM serving programs.
+
+    Emits, in the CURRENT default graph:
+
+    - per-layer K/V caches ``(num_pages + 1, page_len, H, hd)`` with
+      ``paged=True`` (row ``num_pages`` is the scratch page bucket
+      padding writes into) + ``alloc_op``;
+    - one PREFILL program per prefill bucket pb: a page-aligned BLOCK
+      of ``page_len`` prompt tokens through ``_block_decode``
+      (query-block DecodeAttention, ``causal_offset=True``), appended
+      into each row's ``dst_pages`` physical page (feeds: tok
+      (pb, page_len), base (pb,) absolute start, page_tables
+      (pb, n_blocks), dst_pages (pb,); fetches: the append group — no
+      logits: the engine feeds the last prompt token through the first
+      DECODE step instead, so a partial final chunk just pads);
+    - one DECODE program per decode bucket sb: one position through
+      ``_incremental_decode`` (feeds: tok (sb,), pos (sb,) absolute,
+      page_tables (sb, n_blocks), dst_pages (sb,), offsets (sb,);
+      fetches next_tok/logp (sb,)) — greedy, or seeded sampling when
+      ``sampling`` is set;
+    - ``cow``: the copy-on-write program — ``KVCachePageCopy`` over
+      EVERY layer cache (feeds dst (1,), src (1,)): a sequence
+      diverging inside a shared page copies it before private appends.
+
+    Page tables are host-side state (the prefix-cache trie owns them);
+    the device only ever sees the resolved (page_tables, dst, offset)
+    integers, so admission/eviction never retraces a program.
+    """
+    from ..serving.policy import _pow2_buckets
+    from ..ops import kv_cache_ops as kvc
+
+    page_len = int(page_len)
+    pages_per_seq = int(pages_per_seq)
+    num_pages = int(num_pages)
+    max_seq_len = page_len * pages_per_seq
+    if max_seq_len > cfg.max_len:
+        raise ValueError(
+            f"page_len*pages_per_seq={max_seq_len} exceeds "
+            f"cfg.max_len={cfg.max_len} (position-encoding table)")
+    heads = cfg.num_heads
+    hd = cfg.d_model // heads
+    total_pages = num_pages + 1          # + scratch page
+    scratch_page = num_pages
+    decode_buckets = sorted(set(int(x) for x in (
+        decode_bucket_sizes or _pow2_buckets(8))))
+    prefill_buckets = sorted(set(int(x) for x in (
+        prefill_bucket_sizes or (1,))))
+
+    caches = []
+    for i in range(cfg.num_layers):
+        caches.append((
+            kvc.kv_cache(f"{scope}_pg/l{i}_k", total_pages, page_len,
+                         (heads, hd), compute_dtype,
+                         sharding=cache_sharding, paged=True),
+            kvc.kv_cache(f"{scope}_pg/l{i}_v", total_pages, page_len,
+                         (heads, hd), compute_dtype,
+                         sharding=cache_sharding, paged=True)))
+    flat_caches = [c for pair in caches for c in pair]
+    alloc_op = stf.group(*[c.alloc() for c in flat_caches],
+                         name="pg_alloc")
+
+    if sampling is not None:
+        sampling = dict(sampling)
+        unknown = set(sampling) - {"temperature", "top_k", "top_p",
+                                   "seed"}
+        if unknown:
+            raise ValueError(f"unknown sampling knobs: {sorted(unknown)}")
+    state = {"int8_init": None, "wq": None, "w_scale": None}
+
+    def _logits_head(h_flat, emb):
+        if int8:
+            if state["int8_init"] is None:
+                state["wq"], state["w_scale"], state["int8_init"] = \
+                    build_int8_logits_weights(emb, cfg, scope=scope)
+            logits = stf.nn.quantized_matmul(h_flat, state["wq"],
+                                             state["w_scale"])
+        else:
+            logits = stf.matmul(h_flat,
+                                stf.cast(emb, h_flat.dtype.base_dtype),
+                                transpose_b=True)
+        return stf.cast(logits, stf.float32)
+
+    def _emit(logits):
+        if sampling is not None:
+            from ..ops import sampling_ops
+
+            return sampling_ops.sample_token(logits, **sampling)
+        logp_all = stf.nn.log_softmax(logits, axis=-1)
+        tok = stf.cast(stf.argmax(logits, -1, output_type=stf.int32),
+                       stf.int32)
+        logp = stf.reduce_sum(
+            logp_all * stf.one_hot(tok, cfg.vocab_size,
+                                   dtype=stf.float32), axis=-1)
+        return tok, logp
+
+    # -- prefill: one page-aligned chunk ------------------------------------
+    prefill = {}
+    for pb in prefill_buckets:
+        tok = stf.placeholder(stf.int32, [pb, page_len],
+                              f"lm_prefill{pb}_tok")
+        base = stf.placeholder(stf.int32, [pb], f"lm_prefill{pb}_base")
+        tables = stf.placeholder(stf.int32, [pb, pages_per_seq],
+                                 f"lm_prefill{pb}_tables")
+        dst = stf.placeholder(stf.int32, [pb], f"lm_prefill{pb}_dst")
+        cache = _PagedCaches(caches, tables, dst, stf.fill([pb], 0),
+                             base)
+        h, _ = _block_decode(tok, base, cache, None, None, None, cfg,
+                             compute_dtype, scope)
+        # fetch the hidden state to anchor the whole block (appends are
+        # its data deps); pad rows of a partial final chunk write
+        # garbage K/V past the real length — dead rows: attention masks
+        # by committed length and the next append overwrites in place
+        prefill[pb] = {"tok": tok, "base": base, "tables": tables,
+                       "dst": dst,
+                       "op": stf.group(h, name=f"lm_prefill{pb}")}
+
+    # -- decode: one position -----------------------------------------------
+    decode_progs = {}
+    for sb in decode_buckets:
+        tok = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_tok")
+        pos = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_pos")
+        tables = stf.placeholder(stf.int32, [sb, pages_per_seq],
+                                 f"lm_decode{sb}_tables")
+        dst = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_dst")
+        off = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_off")
+        cache = _PagedCaches(caches, tables, dst, off, pos)
+        h, emb = _incremental_decode(tok, pos, cache, None, None, None,
+                                     cfg, compute_dtype, scope)
+        next_tok, logp = _emit(_logits_head(h, emb))
+        decode_progs[sb] = {"tok": tok, "pos": pos, "tables": tables,
+                            "dst": dst, "off": off,
+                            "next_tok": next_tok, "logp": logp}
+
+    # -- copy-on-write ------------------------------------------------------
+    cow_dst = stf.placeholder(stf.int32, [1], "lm_cow_dst")
+    cow_src = stf.placeholder(stf.int32, [1], "lm_cow_src")
+    cow_op = stf.group(*[c.copy_pages(cow_dst, cow_src)
+                         for c in flat_caches], name="lm_cow")
+
+    return {
+        "alloc_op": alloc_op,
+        "int8_init": state["int8_init"],
+        "prefill": prefill,
+        "decode": decode_progs,
+        "cow": {"dst": cow_dst, "src": cow_src, "op": cow_op},
+        "decode_buckets": decode_buckets,
+        "prefill_buckets": prefill_buckets,
+        "scratch_page": scratch_page,
+        "caches": caches,
+    }
+
+
+class CausalLMGenerativeModel:
+    """Session-owning paged causal-LM decode programs for the serving
+    engine's prefix-cache path.
+
+    The engine (serving/generative.py) owns the page-table bookkeeping
+    through :class:`~..serving.prefix_cache.PrefixCache`; this model
+    exposes the device half: ``prefill_chunk`` (one page-aligned block
+    per live row), ``decode`` (one position; physical page/offset
+    resolved from the page table HERE, host-side), ``copy_page`` (CoW),
+    and the ``page_len / num_pages / pages_per_seq / scratch_page``
+    geometry the pool is sized against.
+    """
+
+    def __init__(self, cfg: TransformerConfig, *, page_len=8,
+                 pages_per_seq=4, num_pages=32, max_live=8,
+                 decode_bucket_sizes=None, prefill_bucket_sizes=None,
+                 compute_dtype=stf.float32, int8=False, sampling=None,
+                 checkpoint=None, init_fresh=False, config=None,
+                 scope="causal_lm", aot_warmup=True, seed=0):
+        if checkpoint is None and not init_fresh:
+            raise ValueError("pass checkpoint=... or init_fresh=True")
+        self.cfg = cfg
+        self.page_len = int(page_len)
+        self.pages_per_seq = int(pages_per_seq)
+        self.num_pages = int(num_pages)
+        self.max_seq_len = self.page_len * self.pages_per_seq
+        # engine-facing decode geometry (slot == live sequence)
+        self.num_slots = int(max_live)
+        self.max_decode_len = self.max_seq_len
+        self.src_len = 0                     # decoder-only: no encoder
+        self.eos_id = cfg.eos_id
+        self.pad_id = cfg.pad_id
+        self.int8 = bool(int8)
+        self.sampling = dict(sampling) if sampling else None
+        self.graph = stf.Graph()
+        with self.graph.as_default():
+            if seed is not None:
+                stf.set_random_seed(seed)
+            self.session = stf.Session(graph=self.graph, config=config)
+            prog = build_causal_lm_program(
+                cfg, page_len=page_len, pages_per_seq=pages_per_seq,
+                num_pages=num_pages,
+                decode_bucket_sizes=(decode_bucket_sizes
+                                     or tuple(sorted({1, max_live}))),
+                prefill_bucket_sizes=prefill_bucket_sizes,
+                compute_dtype=compute_dtype, int8=int8,
+                sampling=sampling, scope=scope)
+            self._prog = prog
+            self.scratch_page = prog["scratch_page"]
+            if checkpoint is not None:
+                saver = stf.train.Saver()
+                saver.restore(self.session, checkpoint)
+            else:
+                self.session.run(stf.global_variables_initializer())
+            init_fetches = [prog["alloc_op"]]
+            if prog["int8_init"] is not None:
+                init_fetches.append(prog["int8_init"])
+            for f in init_fetches:
+                self.session.run(f)
+            self._decode_plans = {}
+            for sb, p in prog["decode"].items():
+                plan = self.session.plan(
+                    {"next_tok": p["next_tok"], "logp": p["logp"]},
+                    feeds=[p["tok"], p["pos"], p["tables"], p["dst"],
+                           p["off"]])
+                self._decode_plans[sb] = (plan, p)
+                if aot_warmup:
+                    plan.compile()
+            self._prefill_plans = {}
+            for pb, p in prog["prefill"].items():
+                plan = self.session.plan(
+                    {"done": p["op"]},
+                    feeds=[p["tok"], p["base"], p["tables"], p["dst"]])
+                self._prefill_plans[pb] = (plan, p)
+                if aot_warmup:
+                    plan.compile()
+            cw = prog["cow"]
+            self._cow_plan = (self.session.plan(
+                {"done": cw["op"]}, feeds=[cw["dst"], cw["src"]]), cw)
+            if aot_warmup:
+                self._cow_plan[0].compile()
+        self._decode_buckets = sorted(self._decode_plans)
+        self._prefill_buckets = sorted(self._prefill_plans)
+
+    @property
+    def decode_buckets(self):
+        return list(self._decode_buckets)
+
+    @property
+    def prefill_buckets(self):
+        return list(self._prefill_buckets)
+
+    def _bucket(self, buckets, n):
+        for b in buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"{n} rows exceed the largest bucket "
+                         f"{buckets[-1]}")
+
+    def _scratch_tables(self, n):
+        return np.full((n, self.pages_per_seq), self.scratch_page,
+                       np.int32)
+
+    def prefill_chunk(self, tok_chunks, bases, page_tables, dst_pages):
+        """Run ONE page-aligned prompt chunk for n rows: ``tok_chunks
+        (n, page_len)`` (pad-padded past the real tail), ``bases (n,)``
+        absolute chunk start (multiple of page_len), ``page_tables
+        (n, pages_per_seq)``, ``dst_pages (n,)`` the physical page each
+        row's chunk fills."""
+        tok_chunks = np.asarray(tok_chunks, np.int32).reshape(
+            -1, self.page_len)
+        bases = np.asarray(bases, np.int32)
+        page_tables = np.asarray(page_tables, np.int32).reshape(
+            -1, self.pages_per_seq)
+        dst_pages = np.asarray(dst_pages, np.int32)
+        n = len(dst_pages)
+        done = 0
+        while done < n:
+            take = min(n - done, self._prefill_buckets[-1])
+            pb = self._bucket(self._prefill_buckets, take)
+            plan, p = self._prefill_plans[pb]
+            tok = np.full((pb, self.page_len), self.pad_id, np.int32)
+            base = np.zeros((pb,), np.int32)
+            tbl = self._scratch_tables(pb)
+            dst = np.full((pb,), self.scratch_page, np.int32)
+            sl = slice(done, done + take)
+            tok[:take] = tok_chunks[sl]
+            base[:take] = bases[sl]
+            tbl[:take] = page_tables[sl]
+            dst[:take] = dst_pages[sl]
+            plan.execute({p["tok"]: tok, p["base"]: base,
+                          p["tables"]: tbl, p["dst"]: dst})
+            done += take
+
+    def decode(self, tokens, positions, page_tables):
+        """One decode position for n live sequences; the physical write
+        target is resolved host-side from each row's page table:
+        ``dst = page_tables[i, pos // page_len]``, ``off = pos %
+        page_len``. Returns (next_tok (n,), logp (n,), bucket)."""
+        tokens = np.asarray(tokens, np.int32)
+        positions = np.asarray(positions, np.int32)
+        page_tables = np.asarray(page_tables, np.int32).reshape(
+            -1, self.pages_per_seq)
+        n = len(tokens)
+        sb = self._bucket(self._decode_buckets, n)
+        plan, p = self._decode_plans[sb]
+        tok = np.full((sb,), self.pad_id, np.int32)
+        pos = np.zeros((sb,), np.int32)
+        tbl = self._scratch_tables(sb)
+        tok[:n], pos[:n], tbl[:n] = tokens, positions, page_tables
+        dst = tbl[np.arange(sb), pos // self.page_len]
+        off = pos % self.page_len
+        out = plan.execute({p["tok"]: tok, p["pos"]: pos,
+                            p["tables"]: tbl, p["dst"]: dst,
+                            p["off"]: off.astype(np.int32)})
+        return (np.asarray(out["next_tok"])[:n],
+                np.asarray(out["logp"])[:n], sb)
+
+    def copy_page(self, dst, src):
+        """Copy-on-write: duplicate physical page ``src`` into ``dst``
+        across every layer cache (one plan execution)."""
+        plan, cw = self._cow_plan
+        plan.execute({cw["dst"]: np.asarray([dst], np.int32),
+                      cw["src"]: np.asarray([src], np.int32)})
+
+    def close(self):
+        self.session.close()
+
+    def statusz_info(self):
+        return {"decode_buckets": self._decode_buckets,
+                "prefill_buckets": self._prefill_buckets,
+                "page_len": self.page_len, "num_pages": self.num_pages,
+                "pages_per_seq": self.pages_per_seq,
+                "num_slots": self.num_slots, "int8": self.int8,
+                "sampling": self.sampling}

@@ -317,18 +317,43 @@ class _SlotCaches:
     """Variable-backed paged caches for the serving decode step: each
     layer's k/v live device-resident in the VariableStore
     (ops/kv_cache_ops.py); appends scatter at (slot, position) and the
-    gather rides a control dependency so the RAW is graph-ordered."""
+    gather rides a control dependency so the RAW is graph-ordered.
 
-    def __init__(self, caches, slots, positions):
+    ``verify_plan=True`` (the speculative VERIFY program) stamps every
+    append with the ``_verify_plan``/``_refcount_guarded`` attr pair —
+    the lint/serving-decode-cache contract that verify-plan cache
+    writes commit only through the engine's accepted-prefix refcount
+    bookkeeping."""
+
+    def __init__(self, caches, slots, positions, verify_plan=False):
         self._caches = caches          # [(KVCache k, KVCache v)] per layer
         self._slots = slots
         self._pos = positions
+        self._verify = bool(verify_plan)
 
     def append_and_gather(self, layer, k_new, v_new):
         kc, vc = self._caches[layer]
-        k_all = kc.append_and_gather(k_new, self._slots, self._pos)
-        v_all = vc.append_and_gather(v_new, self._slots, self._pos)
+        k_all = kc.append_and_gather(k_new, self._slots, self._pos,
+                                     verify_plan=self._verify,
+                                     refcount_guarded=self._verify)
+        v_all = vc.append_and_gather(v_new, self._slots, self._pos,
+                                     verify_plan=self._verify,
+                                     refcount_guarded=self._verify)
         return k_all, v_all, self._pos + 1
+
+    def append_and_gather_block(self, layer, k_new, v_new):
+        """Block variant: ``k_new/v_new (B, Kq, H, hd)`` append at
+        positions ``pos..pos+Kq-1``; returns the gathered caches plus
+        the BASE length (committed prefix before the block) —
+        DecodeAttention's ``causal_offset=True`` contract."""
+        kc, vc = self._caches[layer]
+        k_all = kc.append_and_gather(k_new, self._slots, self._pos,
+                                     verify_plan=self._verify,
+                                     refcount_guarded=self._verify)
+        v_all = vc.append_and_gather(v_new, self._slots, self._pos,
+                                     verify_plan=self._verify,
+                                     refcount_guarded=self._verify)
+        return k_all, v_all, self._pos
 
 
 def _decode_cross_kv(enc_out, cfg, compute_dtype, scope):
@@ -369,6 +394,11 @@ def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
     position-independent (LN, FFN, residual) or reads exactly the
     positions the causal mask admits (self-attention over the cache,
     cross-attention over the full source).
+
+    ``cross_kv=None`` builds the decoder-only (causal LM) step: the
+    cross-attention sublayer — and its ``ln2`` — is skipped entirely,
+    matching the sublayer/LN naming of
+    :func:`~.causal_lm.causal_lm_logits`.
     """
     b = int(tok.shape[0])
     d, heads = cfg.d_model, cfg.num_heads
@@ -399,14 +429,86 @@ def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
                                                     lengths)
                         a = _dense(stf.reshape(a, [b, d]), d, cfg, "out")
                     h = _ln(_residual(a, h, cfg, False), cfg, "ln1")
-                    with stf.variable_scope("cross_attn"):
-                        qc = stf.reshape(_dense(h, d, cfg, "q"),
-                                         [b, heads, hd])
-                        ck, cv = cross_kv[i]
-                        c = stf.nn.decode_attention(qc, ck, cv, cross_len,
-                                                    bias=cross_bias)
-                        c = _dense(stf.reshape(c, [b, d]), d, cfg, "out")
-                    h = _ln(_residual(c, h, cfg, False), cfg, "ln2")
+                    if cross_kv is not None:
+                        with stf.variable_scope("cross_attn"):
+                            qc = stf.reshape(_dense(h, d, cfg, "q"),
+                                             [b, heads, hd])
+                            ck, cv = cross_kv[i]
+                            c = stf.nn.decode_attention(
+                                qc, ck, cv, cross_len, bias=cross_bias)
+                            c = _dense(stf.reshape(c, [b, d]), d, cfg,
+                                       "out")
+                        h = _ln(_residual(c, h, cfg, False), cfg, "ln2")
+                    f = _ffn(h, cfg, False, "ffn")
+                    h = _ln(h + f, cfg, "ln3")
+    return h, emb
+
+
+def _block_decode(tok_block, pos, caches, cross_kv, cross_bias, cross_len,
+                  cfg, compute_dtype, scope):
+    """A BLOCK of Kq consecutive decoder positions for B sequences.
+
+    tok_block: (B, Kq) int32 input tokens at positions
+    ``pos[b]..pos[b]+Kq-1``; pos: (B,) int32 committed prefix per
+    sequence BEFORE the block; caches: an accessor with
+    ``append_and_gather_block`` (:class:`_SlotCaches`, or the paged
+    variant in models/causal_lm.py); cross args as in
+    :func:`_incremental_decode` (``cross_kv=None`` for decoder-only).
+    Returns (h (B, Kq, d_model), emb).
+
+    This is the speculative VERIFY shape — the target model re-scores
+    the draft's K proposals in ONE pass, self-attention running the
+    query-block DecodeAttention kernel with ``causal_offset=True``
+    (query j sees the committed prefix plus block positions <= j) — and
+    also the causal-LM page-block prefill shape. Per-position it is
+    arithmetic-identical to Kq chained :func:`_incremental_decode`
+    steps: every sublayer is position-local, and the block attention
+    admits exactly the positions the chained steps would have seen.
+    """
+    b, kq = int(tok_block.shape[0]), int(tok_block.shape[1])
+    d, heads = cfg.d_model, cfg.num_heads
+    hd = d // heads
+    with stf.variable_scope(scope, reuse=stf.AUTO_REUSE):
+        emb = stf.get_variable(
+            "shared_embedding", [cfg.vocab_size, cfg.d_model],
+            initializer=stf.random_normal_initializer(
+                stddev=cfg.d_model ** -0.5))
+        h = stf.nn.embedding_lookup(emb, tok_block,
+                                    compute_dtype=compute_dtype) \
+            * stf.cast(stf.constant(cfg.d_model ** 0.5), compute_dtype)
+        pos_table = stf.constant(
+            sinusoidal_position_encoding(cfg.max_len, cfg.d_model))
+        pos_idx = stf.reshape(pos, [b, 1]) + stf.constant(
+            np.arange(kq, dtype=np.int32).reshape(1, kq))
+        h = h + stf.cast(stf.gather(pos_table, pos_idx), compute_dtype)
+        with stf.variable_scope("decoder"):
+            for i in range(cfg.num_layers):
+                with stf.variable_scope(f"layer_{i}"):
+                    with stf.variable_scope("self_attn"):
+                        q = stf.reshape(_dense(h, d, cfg, "q"),
+                                        [b, kq, heads, hd])
+                        k_new = stf.reshape(_dense(h, d, cfg, "k"),
+                                            [b, kq, heads, hd])
+                        v_new = stf.reshape(_dense(h, d, cfg, "v"),
+                                            [b, kq, heads, hd])
+                        k_all, v_all, base = \
+                            caches.append_and_gather_block(i, k_new,
+                                                           v_new)
+                        a = stf.nn.decode_attention(
+                            q, k_all, v_all, base, causal_offset=True)
+                        a = _dense(stf.reshape(a, [b, kq, d]), d, cfg,
+                                   "out")
+                    h = _ln(_residual(a, h, cfg, False), cfg, "ln1")
+                    if cross_kv is not None:
+                        with stf.variable_scope("cross_attn"):
+                            qc = stf.reshape(_dense(h, d, cfg, "q"),
+                                             [b, kq, heads, hd])
+                            ck, cv = cross_kv[i]
+                            c = stf.nn.decode_attention(
+                                qc, ck, cv, cross_len, bias=cross_bias)
+                            c = _dense(stf.reshape(c, [b, kq, d]), d,
+                                       cfg, "out")
+                        h = _ln(_residual(c, h, cfg, False), cfg, "ln2")
                     f = _ffn(h, cfg, False, "ffn")
                     h = _ln(h + f, cfg, "ln3")
     return h, emb
@@ -603,7 +705,9 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
                              decode_bucket_sizes=None,
                              prefill_bucket_sizes=(1,),
                              compute_dtype=stf.float32, int8=False,
-                             scope="transformer", cache_sharding=None):
+                             scope="transformer", cache_sharding=None,
+                             sampling=None, speculative_k=None,
+                             draft_steps=None):
     """Build the paged-cache decode graphs for token-level serving.
 
     Emits, in the CURRENT default graph:
@@ -622,7 +726,22 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
       (KVCacheAppend at (slot, pos) then DecodeAttention), cached
       cross-attn, tied-softmax logits (QuantMatMul when ``int8``),
       greedy argmax (feeds: tok (sb,), pos (sb,), slots (sb,);
-      fetches: next_tok (sb,), logp (sb,)).
+      fetches: next_tok (sb,), logp (sb,));
+    - with ``sampling={"temperature": .., "top_k": .., "top_p": ..}``
+      the decode (and verify) programs SAMPLE instead of argmax —
+      seeded Gumbel-max on the per-step RNG stream
+      (ops/sampling_ops.py), so the plan reports ``uses_rng`` and
+      ``set_random_seed`` reproduces token streams;
+    - with ``speculative_k=K``, one VERIFY program per decode bucket:
+      re-score a (sb, K) token block in ONE pass through the
+      query-block DecodeAttention kernel (feeds tok (sb, K), pos (sb,),
+      slots (sb,); fetches next_tok/logp (sb, K)) — the target side of
+      speculative decoding; its cache appends carry the
+      ``_verify_plan``/``_refcount_guarded`` attr pair;
+    - with ``draft_steps=Kd``, one DRAFT program per decode bucket: Kd
+      chained greedy decode steps unrolled into ONE executable (feeds
+      tok (sb,), pos (sb,), slots (sb,); fetches props (sb, Kd)) — the
+      draft side: one dispatch proposes Kd tokens.
 
     Returns a dict of graph handles (see :class:`TransformerGenerativeModel`
     for the session-owning wrapper the serving engine drives).
@@ -692,43 +811,126 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
         }
 
     # -- decode programs -----------------------------------------------------
+    if sampling is not None:
+        sampling = dict(sampling)
+        unknown = set(sampling) - {"temperature", "top_k", "top_p",
+                                   "seed"}
+        if unknown:
+            raise ValueError(f"unknown sampling knobs: {sorted(unknown)}")
+    state = {"int8_init": None, "wq": None, "w_scale": None}
+
+    def _logits_head(h_flat, emb):
+        """(n, d_model) -> f32 logits (n, vocab): tied softmax, or the
+        int8 QuantMatMul route (weights quantized once, shared by
+        decode AND verify programs)."""
+        if int8:
+            if state["int8_init"] is None:
+                state["wq"], state["w_scale"], state["int8_init"] = \
+                    build_int8_logits_weights(emb, cfg, scope=scope)
+            logits = stf.nn.quantized_matmul(h_flat, state["wq"],
+                                             state["w_scale"])
+        else:
+            logits = stf.matmul(h_flat,
+                                stf.cast(emb, h_flat.dtype.base_dtype),
+                                transpose_b=True)
+        return stf.cast(logits, stf.float32)
+
+    def _emit(logits):
+        """f32 logits (n, vocab) -> (tok (n,), logp (n,)): greedy
+        argmax, or the seeded sampling chain when ``sampling`` is on."""
+        if sampling is not None:
+            from ..ops import sampling_ops
+
+            return sampling_ops.sample_token(logits, **sampling)
+        logp_all = stf.nn.log_softmax(logits, axis=-1)
+        tok = stf.cast(stf.argmax(logits, -1, output_type=stf.int32),
+                       stf.int32)
+        logp = stf.reduce_sum(
+            logp_all * stf.one_hot(tok, cfg.vocab_size,
+                                   dtype=stf.float32), axis=-1)
+        return tok, logp
+
+    def _cross_gather(slots):
+        cross_bias = bias_cache.gather(slots)            # (sb, src_len)
+        cross_kv = [(ckc.gather(slots), cvc.gather(slots))
+                    for ckc, cvc in cross_caches]
+        return cross_kv, cross_bias
+
     decode_progs = {}
-    int8_init = None
     for sb in decode_buckets:
         tok = stf.placeholder(stf.int32, [sb], f"decode{sb}_tok")
         pos = stf.placeholder(stf.int32, [sb], f"decode{sb}_pos")
         slots = stf.placeholder(stf.int32, [sb], f"decode{sb}_slots")
         cross_len = stf.fill([sb], src_len)
-        cross_bias = bias_cache.gather(slots)             # (sb, src_len)
-        cross_kv = [(ckc.gather(slots), cvc.gather(slots))
-                    for ckc, cvc in cross_caches]
+        cross_kv, cross_bias = _cross_gather(slots)
         cache = _SlotCaches(self_caches, slots, pos)
         h, emb = _incremental_decode(
             tok, pos, cache, cross_kv, cross_bias, cross_len, cfg,
             compute_dtype, scope)
-        if int8:
-            if int8_init is None:
-                wq, w_scale, int8_init = build_int8_logits_weights(
-                    emb, cfg, scope=scope)
-            logits = stf.nn.quantized_matmul(h, wq, w_scale)
-        else:
-            logits = stf.matmul(h, stf.cast(emb, h.dtype.base_dtype),
-                                transpose_b=True)
-        logits = stf.cast(logits, stf.float32)            # (sb, vocab)
-        logp_all = stf.nn.log_softmax(logits, axis=-1)
-        next_tok = stf.cast(stf.argmax(logits, -1, output_type=stf.int32),
-                            stf.int32)
-        logp = stf.reduce_sum(
-            logp_all * stf.one_hot(next_tok, cfg.vocab_size,
-                                   dtype=stf.float32), axis=-1)
+        next_tok, logp = _emit(_logits_head(h, emb))
         decode_progs[sb] = {"tok": tok, "pos": pos, "slots": slots,
                             "next_tok": next_tok, "logp": logp}
 
+    # -- speculative VERIFY programs (target side) ---------------------------
+    verify_progs = {}
+    if speculative_k:
+        kv_width = int(speculative_k)
+        for sb in decode_buckets:
+            tok = stf.placeholder(stf.int32, [sb, kv_width],
+                                  f"verify{sb}_tok")
+            pos = stf.placeholder(stf.int32, [sb], f"verify{sb}_pos")
+            slots = stf.placeholder(stf.int32, [sb], f"verify{sb}_slots")
+            cross_len = stf.fill([sb], src_len)
+            cross_kv, cross_bias = _cross_gather(slots)
+            cache = _SlotCaches(self_caches, slots, pos,
+                                verify_plan=True)
+            h, emb = _block_decode(
+                tok, pos, cache, cross_kv, cross_bias, cross_len, cfg,
+                compute_dtype, scope)
+            flat = stf.reshape(h, [sb * kv_width, cfg.d_model])
+            t_flat, lp_flat = _emit(_logits_head(flat, emb))
+            verify_progs[sb] = {
+                "tok": tok, "pos": pos, "slots": slots,
+                "next_tok": stf.reshape(t_flat, [sb, kv_width]),
+                "logp": stf.reshape(lp_flat, [sb, kv_width])}
+
+    # -- DRAFT programs: Kd greedy steps in one executable -------------------
+    draft_progs = {}
+    if draft_steps:
+        kd = int(draft_steps)
+        for sb in decode_buckets:
+            tok = stf.placeholder(stf.int32, [sb], f"draft{sb}_tok")
+            pos = stf.placeholder(stf.int32, [sb], f"draft{sb}_pos")
+            slots = stf.placeholder(stf.int32, [sb], f"draft{sb}_slots")
+            cross_len = stf.fill([sb], src_len)
+            cross_kv, cross_bias = _cross_gather(slots)
+            cur, props = tok, []
+            for j in range(kd):
+                # step j+1's appends hang off step j's gathers through
+                # the argmax data path (cur), so the per-step cache
+                # RAW/WAR hazards are graph-ordered without explicit
+                # control edges. Proposals are ALWAYS greedy — the
+                # verify side decides acceptance (greedy: token match;
+                # sampling: match against the target's sample).
+                cache = _SlotCaches(self_caches, slots, pos + j)
+                h, emb = _incremental_decode(
+                    cur, pos + j, cache, cross_kv, cross_bias,
+                    cross_len, cfg, compute_dtype, scope)
+                logits = _logits_head(h, emb)
+                cur = stf.cast(
+                    stf.argmax(logits, -1, output_type=stf.int32),
+                    stf.int32)
+                props.append(stf.reshape(cur, [sb, 1]))
+            draft_progs[sb] = {"tok": tok, "pos": pos, "slots": slots,
+                               "props": stf.concat(props, axis=1)}
+
     return {
         "alloc_op": alloc_op,
-        "int8_init": int8_init,
+        "int8_init": state["int8_init"],
         "prefill": prefill,
         "decode": decode_progs,
+        "verify": verify_progs,
+        "draft": draft_progs,
         "decode_buckets": decode_buckets,
         "prefill_buckets": prefill_buckets,
         "scratch_slot": scratch,
@@ -757,7 +959,8 @@ class TransformerGenerativeModel:
                  prefill_bucket_sizes=(1,), compute_dtype=stf.float32,
                  int8=False, checkpoint=None, init_fresh=False,
                  config=None, scope="transformer", aot_warmup=True,
-                 seed=0):
+                 seed=0, sampling=None, speculative_k=None,
+                 draft_steps=None):
         if checkpoint is None and not init_fresh:
             raise ValueError("pass checkpoint=... or init_fresh=True")
         self.cfg = cfg
@@ -767,6 +970,9 @@ class TransformerGenerativeModel:
         self.eos_id = cfg.eos_id
         self.pad_id = cfg.pad_id
         self.int8 = bool(int8)
+        self.sampling = dict(sampling) if sampling else None
+        self.spec_k = int(speculative_k) if speculative_k else 0
+        self.draft_steps = int(draft_steps) if draft_steps else 0
         self.graph = stf.Graph()
         with self.graph.as_default():
             if seed is not None:
@@ -777,7 +983,9 @@ class TransformerGenerativeModel:
                 max_decode_len=max_decode_len,
                 decode_bucket_sizes=decode_bucket_sizes,
                 prefill_bucket_sizes=prefill_bucket_sizes,
-                compute_dtype=compute_dtype, int8=int8, scope=scope)
+                compute_dtype=compute_dtype, int8=int8, scope=scope,
+                sampling=sampling, speculative_k=speculative_k,
+                draft_steps=draft_steps)
             self._prog = prog
             self._scratch = prog["scratch_slot"]
             if checkpoint is not None:
@@ -797,6 +1005,22 @@ class TransformerGenerativeModel:
                     {"next_tok": p["next_tok"], "logp": p["logp"]},
                     feeds=[p["tok"], p["pos"], p["slots"]])
                 self._decode_plans[sb] = (plan, p)
+                if aot_warmup:
+                    plan.compile()
+            self._verify_plans = {}
+            for sb, p in prog.get("verify", {}).items():
+                plan = self.session.plan(
+                    {"next_tok": p["next_tok"], "logp": p["logp"]},
+                    feeds=[p["tok"], p["pos"], p["slots"]])
+                self._verify_plans[sb] = (plan, p)
+                if aot_warmup:
+                    plan.compile()
+            self._draft_plans = {}
+            for sb, p in prog.get("draft", {}).items():
+                plan = self.session.plan(
+                    {"props": p["props"]},
+                    feeds=[p["tok"], p["pos"], p["slots"]])
+                self._draft_plans[sb] = (plan, p)
                 if aot_warmup:
                     plan.compile()
             self._prefill_plans = {}
@@ -868,6 +1092,49 @@ class TransformerGenerativeModel:
         return (np.asarray(out["next_tok"])[:n],
                 np.asarray(out["logp"])[:n], sb)
 
+    def verify(self, tok_blocks, positions, slots):
+        """Score K-token blocks ``tok_blocks (n, spec_k)`` starting at
+        the committed ``positions``; returns the target's next-token
+        choice at each of the K positions: (toks (n, K), logps (n, K),
+        bucket). Cache rows for the block positions ARE written (the
+        accepted prefix is then already materialized; rejected-suffix
+        rows are dead until overwritten by the next append at that
+        position, and length masking keeps attention from reading
+        them)."""
+        if not self._verify_plans:
+            raise RuntimeError("model built without speculative_k")
+        tok_blocks = np.asarray(tok_blocks, np.int32)
+        positions = np.asarray(positions, np.int32)
+        slots = np.asarray(slots, np.int32)
+        n = len(slots)
+        sb = self._bucket(sorted(self._verify_plans), n)
+        plan, p = self._verify_plans[sb]
+        tok = np.full((sb, self.spec_k), self.pad_id, np.int32)
+        pos = np.zeros((sb,), np.int32)
+        slt = np.full((sb,), self._scratch, np.int32)
+        tok[:n], pos[:n], slt[:n] = tok_blocks, positions, slots
+        out = plan.execute({p["tok"]: tok, p["pos"]: pos, p["slots"]: slt})
+        return (np.asarray(out["next_tok"])[:n],
+                np.asarray(out["logp"])[:n], sb)
+
+    def decode_k(self, tokens, positions, slots):
+        """Draft side: run ``draft_steps`` greedy decode positions in
+        one plan execution; returns (props (n, draft_steps), bucket)."""
+        if not self._draft_plans:
+            raise RuntimeError("model built without draft_steps")
+        tokens = np.asarray(tokens, np.int32)
+        positions = np.asarray(positions, np.int32)
+        slots = np.asarray(slots, np.int32)
+        n = len(slots)
+        sb = self._bucket(sorted(self._draft_plans), n)
+        plan, p = self._draft_plans[sb]
+        tok = np.full((sb,), self.pad_id, np.int32)
+        pos = np.zeros((sb,), np.int32)
+        slt = np.full((sb,), self._scratch, np.int32)
+        tok[:n], pos[:n], slt[:n] = tokens, positions, slots
+        out = plan.execute({p["tok"]: tok, p["pos"]: pos, p["slots"]: slt})
+        return np.asarray(out["props"])[:n], sb
+
     def close(self):
         self.session.close()
 
@@ -876,7 +1143,9 @@ class TransformerGenerativeModel:
                 "prefill_buckets": self._prefill_buckets,
                 "num_slots": self.num_slots,
                 "max_decode_len": self.max_decode_len,
-                "src_len": self.src_len, "int8": self.int8}
+                "src_len": self.src_len, "int8": self.int8,
+                "sampling": self.sampling, "spec_k": self.spec_k,
+                "draft_steps": self.draft_steps}
 
 
 def synthetic_wmt_batch(batch_size, src_len, tgt_len, vocab_size=32768,

@@ -53,8 +53,18 @@ from ..kernels import registry as _kreg
 # lint/serving-decode-cache rule (analysis/lint.py)
 CACHE_ATTR = "_kv_cache"
 SHARDING_ATTR = "_cache_sharding"
+# shared-page layer markers (PR 16): PAGED_ATTR tags ops against a
+# cache whose rows are REFCOUNTED shared pages (prefix cache) — a
+# host-sink on one leaks another request's prompt state off device;
+# VERIFY_ATTR tags cache writes inside a speculative VERIFY plan, which
+# must carry GUARD_ATTR (the engine commits only the accepted prefix —
+# an unguarded verify write would publish unverified draft state)
+PAGED_ATTR = "_kv_paged"
+VERIFY_ATTR = "_verify_plan"
+GUARD_ATTR = "_refcount_guarded"
 
-_CACHE_OP_TYPES = ("KVCacheAlloc", "KVCacheAppend", "KVCacheGather")
+_CACHE_OP_TYPES = ("KVCacheAlloc", "KVCacheAppend", "KVCacheGather",
+                   "KVCachePageCopy")
 
 
 # ---------------------------------------------------------------------------
@@ -108,7 +118,29 @@ def _lower_kv_gather(ctx, op, inputs):
     import jax.numpy as jnp
 
     cache = ctx.read_var(op.attrs["var_name"], op)
-    return [cache[jnp.asarray(inputs[0], jnp.int32)]]
+    idx = jnp.asarray(inputs[0], jnp.int32)
+    if idx.ndim == 2:
+        # page-table gather: slots (B, n_blocks) -> the LOGICAL cache
+        # view (B, n_blocks * page_len, *inner) — block b's pages
+        # concatenated in table order, so downstream DecodeAttention
+        # sees one contiguous per-sequence cache exactly like the 1-D
+        # slot path (lengths mask in logical coordinates)
+        b, nb = idx.shape
+        rows = cache[idx]              # (B, nb, page_len, *inner)
+        return [rows.reshape((b, nb * cache.shape[1]) + cache.shape[2:])]
+    return [cache[idx]]
+
+
+def _lower_kv_page_copy(ctx, op, inputs):
+    import jax.numpy as jnp
+
+    name = op.attrs["var_name"]
+    dst, src = inputs
+    cache = ctx.read_var(name, op)
+    rows = cache[jnp.asarray(src, jnp.int32)]
+    new = cache.at[jnp.asarray(dst, jnp.int32)].set(rows)
+    ctx.write_var(name, new)
+    return [new]
 
 
 op_registry.register(
@@ -120,6 +152,9 @@ op_registry.register(
 op_registry.register(
     "KVCacheGather", lower=_lower_kv_gather,
     effects=op_registry.Effects(reads=("var_name",)))
+op_registry.register(
+    "KVCachePageCopy", lower=_lower_kv_page_copy,
+    effects=op_registry.Effects(writes=("var_name",), update="update"))
 
 
 # ---------------------------------------------------------------------------
@@ -136,7 +171,7 @@ class KVCache:
 
     def __init__(self, name: str, num_slots: int, max_len: int,
                  inner_shape: Sequence[int], dtype,
-                 sharding: Optional[str] = None):
+                 sharding: Optional[str] = None, paged: bool = False):
         self.name = name
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
@@ -147,15 +182,22 @@ class KVCache:
         # slot dim shards over); recorded on every cache op so offline
         # lint (graph_lint --serving) can check it without a session
         self.sharding = sharding or "replicated"
+        # paged=True: rows are refcounted shared pages (prefix cache) —
+        # every op carries PAGED_ATTR so lint can hold the shared-page
+        # layer to the stricter host-sink contract
+        self.paged = bool(paged)
 
     @property
     def shape(self) -> Tuple[int, ...]:
         return (self.num_slots, self.max_len) + self.inner_shape
 
     def _attrs(self):
-        return {"var_name": self.name, "shape": list(self.shape),
-                "dtype": self.dtype.name, CACHE_ATTR: True,
-                SHARDING_ATTR: self.sharding}
+        a = {"var_name": self.name, "shape": list(self.shape),
+             "dtype": self.dtype.name, CACHE_ATTR: True,
+             SHARDING_ATTR: self.sharding}
+        if self.paged:
+            a[PAGED_ATTR] = True
+        return a
 
     def alloc(self, name=None):
         """Zero-fill the cache storage (returns the cache tensor; fetch
@@ -168,39 +210,75 @@ class KVCache:
                            self.dtype)])
         return op.outputs[0]
 
-    def append(self, value, slots, positions, name=None):
+    def append(self, value, slots, positions, name=None,
+               verify_plan=False, refcount_guarded=False):
         """Write ``value (B, P, *inner)`` at ``slots (B,)`` int32 rows,
         positions ``positions (B,) + [0, P)``. Returns the updated cache
-        tensor (use it for control deps, never as a fetch)."""
+        tensor (use it for control deps, never as a fetch).
+
+        ``verify_plan=True`` marks a write inside a speculative VERIFY
+        program; it must also set ``refcount_guarded=True`` (the engine
+        commits only the accepted prefix) or the
+        ``lint/serving-decode-cache`` rule errors."""
         g = ops_mod.get_default_graph()
         value = ops_mod.convert_to_tensor(value, dtype=self.dtype)
         slots = ops_mod.convert_to_tensor(slots, dtype=dtypes_mod.int32)
         positions = ops_mod.convert_to_tensor(positions,
                                               dtype=dtypes_mod.int32)
+        attrs = self._attrs()
+        if verify_plan:
+            attrs[VERIFY_ATTR] = True
+            attrs[GUARD_ATTR] = bool(refcount_guarded)
         op = g.create_op(
-            "KVCacheAppend", [value, slots, positions], attrs=self._attrs(),
+            "KVCacheAppend", [value, slots, positions], attrs=attrs,
             name=name or f"{self.name}_append",
             output_specs=[(shape_mod.TensorShape(list(self.shape)),
                            self.dtype)])
         return op.outputs[0]
 
     def gather(self, slots, name=None):
-        """Read rows ``slots (B,)`` → ``(B, max_len, *inner)``."""
+        """Read rows ``slots (B,)`` → ``(B, max_len, *inner)``; or a
+        page-table gather ``slots (B, n_blocks)`` → the logical view
+        ``(B, n_blocks * max_len, *inner)`` (pages concatenated in
+        table order)."""
         g = ops_mod.get_default_graph()
         slots = ops_mod.convert_to_tensor(slots, dtype=dtypes_mod.int32)
-        b = slots.shape[0] if slots.shape.rank == 1 else None
-        out_shape = [b, self.max_len] + list(self.inner_shape)
+        if slots.shape.rank == 2:
+            b = slots.shape[0].value
+            nb = int(slots.shape[1].value)
+            out_shape = [b, nb * self.max_len] + list(self.inner_shape)
+        else:
+            b = slots.shape[0] if slots.shape.rank == 1 else None
+            out_shape = [b, self.max_len] + list(self.inner_shape)
         op = g.create_op(
             "KVCacheGather", [slots], attrs=self._attrs(),
             name=name or f"{self.name}_gather",
             output_specs=[(shape_mod.TensorShape(out_shape), self.dtype)])
         return op.outputs[0]
 
-    def append_and_gather(self, value, slots, positions, name=None):
+    def copy_pages(self, dst, src, name=None):
+        """Copy whole rows ``cache[dst] = cache[src]`` (``dst``/``src``
+        (M,) int32) — the prefix cache's copy-on-write primitive: a
+        request diverging inside a shared page copies it before its own
+        appends. Returns the updated cache tensor (control deps)."""
+        g = ops_mod.get_default_graph()
+        dst = ops_mod.convert_to_tensor(dst, dtype=dtypes_mod.int32)
+        src = ops_mod.convert_to_tensor(src, dtype=dtypes_mod.int32)
+        op = g.create_op(
+            "KVCachePageCopy", [dst, src], attrs=self._attrs(),
+            name=name or f"{self.name}_page_copy",
+            output_specs=[(shape_mod.TensorShape(list(self.shape)),
+                           self.dtype)])
+        return op.outputs[0]
+
+    def append_and_gather(self, value, slots, positions, name=None,
+                          verify_plan=False, refcount_guarded=False):
         """The decode-step idiom: append, then gather the SAME rows
         under a control dependency so the RAW on the cache resource is
         graph-ordered (the hazard engine enforces this)."""
-        appended = self.append(value, slots, positions, name=name)
+        appended = self.append(value, slots, positions, name=name,
+                               verify_plan=verify_plan,
+                               refcount_guarded=refcount_guarded)
         with ops_mod.get_default_graph().control_dependencies(
                 [appended.op]):
             return self.gather(slots,
@@ -213,10 +291,10 @@ class KVCache:
 
 
 def kv_cache(name, num_slots, max_len, inner_shape, dtype,
-             sharding: Optional[str] = None) -> KVCache:
+             sharding: Optional[str] = None, paged: bool = False) -> KVCache:
     """Declare one paged KV cache (see module docstring for layout)."""
     return KVCache(name, num_slots, max_len, inner_shape, dtype,
-                   sharding=sharding)
+                   sharding=sharding, paged=paged)
 
 
 def is_cache_op(op) -> bool:
@@ -228,26 +306,38 @@ def is_cache_op(op) -> bool:
 # ---------------------------------------------------------------------------
 
 def decode_attention(q, k_cache, v_cache, lengths, *, bias=None,
-                     sm_scale=None, name=None):
-    """Query-length-1 attention against gathered cache rows.
+                     sm_scale=None, causal_offset=False, name=None):
+    """Attention for one query position — or a query BLOCK — against
+    gathered cache rows.
 
-    q: (B, heads, head_dim); k_cache/v_cache: (B, max_len, heads,
+    q: (B, heads, head_dim) single new query per sequence, or
+    (B, Kq, heads, head_dim) a block of Kq query positions (speculative
+    verify / block prefill); k_cache/v_cache: (B, max_len, heads,
     head_dim) — the :class:`KVCache` gather layout; lengths: (B,) int32
     live prefix per sequence; bias: optional additive (B, max_len) key
-    bias (cross-attention padding masks). Routed Pallas vs composed-XLA
-    through stf.kernels like every fused op. Inference-only: no
-    registered gradient.
+    bias (cross-attention padding masks). With a query block,
+    ``causal_offset=True`` means ``lengths`` is the committed prefix
+    BEFORE the block and query j attends positions < lengths[b]+j+1
+    (the block's own K/V already appended at lengths[b]..+Kq-1);
+    ``causal_offset=False`` means every query sees exactly
+    positions < lengths[b] (cross-attention over a fixed source).
+    Routed Pallas vs composed-XLA through stf.kernels like every fused
+    op. Inference-only: no registered gradient.
     """
     g = ops_mod.get_default_graph()
     q = ops_mod.convert_to_tensor(q)
     k_cache = ops_mod.convert_to_tensor(k_cache)
     v_cache = ops_mod.convert_to_tensor(v_cache)
     lengths = ops_mod.convert_to_tensor(lengths, dtype=dtypes_mod.int32)
+    if causal_offset and q.shape.rank != 4:
+        raise ValueError("causal_offset=True requires a query block "
+                         f"(B, Kq, H, D); got q rank {q.shape.rank}")
     inputs = [q, k_cache, v_cache, lengths]
     if bias is not None:
         inputs.append(ops_mod.convert_to_tensor(bias))
     op = g.create_op("DecodeAttention", inputs,
-                     attrs={"sm_scale": sm_scale},
+                     attrs={"sm_scale": sm_scale,
+                            "causal_offset": bool(causal_offset)},
                      name=name or "decode_attention",
                      output_specs=[(q.shape, q.dtype)])
     return op.outputs[0]
@@ -259,8 +349,13 @@ def _lower_decode_attention(ctx, op, input_values):
     fn = _kreg.select(
         "DecodeAttention",
         _kreg.aval_key(q, k, v, bias, has_bias=bias is not None))
+    kw = {}
+    if op.attrs.get("causal_offset"):
+        # only block-query verify/prefill plans set this; keeping the
+        # kwarg conditional preserves every pre-existing impl signature
+        kw["causal_offset"] = True
     return [fn(q, k, v, lengths, bias=bias,
-               sm_scale=op.attrs.get("sm_scale"))]
+               sm_scale=op.attrs.get("sm_scale"), **kw)]
 
 
 op_registry.register("DecodeAttention", lower=_lower_decode_attention)
@@ -317,19 +412,31 @@ def _kv_gather_rule(op, in_specs, ctx):
                      else op.outputs[0].shape.rank)]
 
 
+def _kv_page_copy_rule(op, in_specs, ctx):
+    # whole-row copy inside the committed cache layout: stays local on
+    # a replicated cache; over a slot-sharded cache the rows move
+    # between shards (all-to-all of the touched rows) — priced like the
+    # gather's collective but over M rows only
+    return [_cache_spec(op, ctx, len(op.attrs["shape"]))]
+
+
 _shard.register_rules(_kv_alloc_rule, "KVCacheAlloc")
 _shard.register_rules(_kv_append_rule, "KVCacheAppend")
 _shard.register_rules(_kv_gather_rule, "KVCacheGather")
+_shard.register_rules(_kv_page_copy_rule, "KVCachePageCopy")
 
 
 def _decode_attention_rule(op, in_specs, ctx):
-    # (B, H, D) q: batch/head sharding flows through exactly like
-    # FlashAttention; a sharded cache length would need ring traffic the
-    # kernel does not do — consumed gathered
+    # (B, H, D) q — or a (B, Kq, H, D) query block: batch/head sharding
+    # flows through exactly like FlashAttention; a sharded cache length
+    # would need ring traffic the kernel does not do — consumed
+    # gathered. Only the leading batch dim's sharding propagates for a
+    # block (Kq is a position axis, never sharded).
     sq = in_specs[0]
     if sq is None:
         return [None]
-    out = tuple(e if d < 2 else () for d, e in enumerate(sq))
+    keep = 1 if len(sq) == 4 else 2
+    out = tuple(e if d < keep else () for d, e in enumerate(sq))
     return [out]
 
 

@@ -566,9 +566,11 @@ def _decode_attn_eligible(key):
     (qs, qd), (ks, _kd), (vs, _vd), bias = key[:4]
     if not _is_float(qd):
         return "ineligible_dtype"
-    if len(qs) != 3 or len(ks) != 4 or len(vs) != 4:
+    # q is (B, H, D) — or the (B, Kq, H, D) query block of the
+    # speculative-verify / block-prefill plans
+    if len(qs) not in (3, 4) or len(ks) != 4 or len(vs) != 4:
         return "ineligible_shape"
-    if ks[0] != qs[0] or ks[2] != qs[1] or ks[3] != qs[2] or ks != vs:
+    if ks[0] != qs[0] or ks[2] != qs[-2] or ks[3] != qs[-1] or ks != vs:
         return "ineligible_shape"
     if bias is not None:
         bs, _bd = bias
@@ -579,15 +581,18 @@ def _decode_attn_eligible(key):
 
 def _decode_attn_gate(key, bk):
     (qs, qd), (ks, _), _, _bias = key[:4]
-    b, h, d = (int(x) for x in qs)
+    b, h, d = int(qs[0]), int(qs[-2]), int(qs[-1])
+    kq = int(qs[1]) if len(qs) == 4 else 1
     max_len = int(ks[1])
-    flops = 4.0 * b * h * max_len * d
+    flops = 4.0 * b * kq * h * max_len * d
     itm = _np_of(qd).itemsize
     cache_bytes = 2.0 * b * max_len * h * d * itm
-    # composed materializes the (B, H, L) f32 score tensor ~three times
-    # (scores, softmax, P·V read); the kernel streams the cache once
-    return _kreg.roofline_gate(flops, cache_bytes + b * h * d * itm,
-                               cache_bytes + 3.0 * b * h * max_len * 4, bk)
+    # composed materializes the (B[, Kq], H, L) f32 score tensor ~three
+    # times (scores, softmax, P·V read); the kernel streams the cache
+    # once
+    return _kreg.roofline_gate(
+        flops, cache_bytes + b * kq * h * d * itm,
+        cache_bytes + 3.0 * b * kq * h * max_len * 4, bk)
 
 
 def _decode_attn_case(key):

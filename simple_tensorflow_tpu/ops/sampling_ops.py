@@ -1,0 +1,105 @@
+"""Sampling decode: temperature / top-k / top-p logits transform + a
+seeded Gumbel-max draw, composed from existing stf graph ops.
+
+(ref: tensorflow/python/ops/random_ops.py ``multinomial`` — the
+reference samples once from full logits; serving decode wants the
+standard transform chain in front, and the draw must ride the per-step
+RNG stream so ``set_random_seed`` reproduces token streams.)
+
+Design constraints (docs/SERVING.md §sampling):
+
+- the transform is PURE graph math (sort, threshold, mask) — static
+  shapes, no data-dependent vocab slicing, so the decode plan stays one
+  AOT executable per bucket;
+- the only randomness is ONE ``RandomUniform`` per sampled tensor,
+  which declares ``Effects(rng=True)`` (ops/random_ops.py): the plan
+  reports ``uses_rng`` and the Session advances its run counter per
+  execution, folding (graph seed, op seed, run counter) into the key —
+  the same fixed-seed contract dropout has, so two processes with the
+  same ``set_random_seed`` and submission order emit identical token
+  streams, independent of which kernel-registry impl computes the
+  logits' surrounding ops;
+- Gumbel-max instead of inverse-CDF: ``argmax(logits + g)`` needs no
+  renormalization after masking, and ties break deterministically the
+  way argmax does.
+
+Masked-out entries are pushed to an additive -1e9 (the same NEG_INF
+convention the attention kernels use), never multiplied, so kept
+logits pass through bit-unchanged.
+"""
+
+from __future__ import annotations
+
+import simple_tensorflow_tpu as stf
+
+_NEG = -1e9
+
+
+def sampling_logits_transform(logits, temperature=1.0, top_k=0,
+                              top_p=1.0):
+    """Apply temperature / top-k / top-p to ``logits (B, V)`` f32.
+
+    Returns transformed logits (B, V): scaled by 1/temperature, with
+    every filtered token pushed to -1e9. ``top_k=0`` and ``top_p=1.0``
+    disable their filters; the argmax token always survives both (the
+    top-p prefix keeps at least its first element), so greedy decode is
+    the ``temperature -> 0`` limit and sampling never stalls on an
+    empty support.
+    """
+    b = int(logits.shape[0])
+    vocab = int(logits.shape[1])
+    x = stf.cast(logits, stf.float32)
+    temperature = float(temperature)
+    if temperature <= 0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    if temperature != 1.0:
+        x = x * (1.0 / temperature)
+    top_k = int(top_k or 0)
+    if top_k < 0 or top_k > vocab:
+        raise ValueError(f"top_k must be in [0, {vocab}], got {top_k}")
+    if 0 < top_k < vocab:
+        vals, _ = stf.nn.top_k(x, k=top_k)                # (B, k) desc
+        kth = stf.slice(vals, [0, top_k - 1], [b, 1])     # (B, 1)
+        drop = stf.cast(stf.less(x, kth), stf.float32)
+        x = x + drop * _NEG
+    top_p = float(top_p)
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_p < 1.0:
+        vals, _ = stf.nn.top_k(x, k=vocab)                # (B, V) desc
+        probs = stf.nn.softmax(vals, axis=-1)
+        # exclusive cumsum: entry j is the mass STRICTLY before j, so
+        # the first sorted token always has cum 0 < top_p and survives
+        cum = stf.cumsum(probs, axis=-1, exclusive=True)
+        kept = stf.cast(stf.less(cum, top_p), stf.float32)
+        # smallest kept sorted value = the admission threshold; ties at
+        # the threshold are all kept (deterministic, seed-independent)
+        thresh = stf.reduce_min(vals * kept + (1.0 - kept) * 1e9,
+                                axis=-1, keepdims=True)   # (B, 1)
+        drop = stf.cast(stf.less(x, thresh), stf.float32)
+        x = x + drop * _NEG
+    return x
+
+
+def sample_token(logits, temperature=1.0, top_k=0, top_p=1.0, seed=None,
+                 name=None):
+    """Draw one token per row from transformed ``logits (B, V)``.
+
+    Returns ``(tok (B,) int32, logp (B,) f32)`` — the log-probability
+    is under the TRANSFORMED distribution (what was actually sampled
+    from), matching what the greedy path reports for argmax.
+    """
+    b = int(logits.shape[0])
+    vocab = int(logits.shape[1])
+    x = sampling_logits_transform(logits, temperature=temperature,
+                                  top_k=top_k, top_p=top_p)
+    u = stf.random_uniform([b, vocab], minval=1e-7, maxval=1.0,
+                           dtype=stf.float32, seed=seed,
+                           name=(name or "sample") + "_u")
+    gumbel = -stf.log(-stf.log(u))
+    tok = stf.cast(stf.argmax(x + gumbel, -1, output_type=stf.int32),
+                   stf.int32)
+    logp_all = stf.nn.log_softmax(x, axis=-1)
+    logp = stf.reduce_sum(
+        logp_all * stf.one_hot(tok, vocab, dtype=stf.float32), axis=-1)
+    return tok, logp

@@ -27,9 +27,11 @@ walkthrough and /stf/serving/* metrics catalog
 from .batcher import ContinuousBatcher, ServeFuture, ServeRequest
 from .generative import CacheSlotPool, GenerateFuture, GenerativeEngine
 from .policy import BatchingPolicy, DecodePolicy
+from .prefix_cache import AdmitPlan, PagesExhaustedError, PrefixCache
 from .server import ModelServer, live_servers
 
 __all__ = [
+    "AdmitPlan",
     "BatchingPolicy",
     "CacheSlotPool",
     "ContinuousBatcher",
@@ -37,6 +39,8 @@ __all__ = [
     "GenerateFuture",
     "GenerativeEngine",
     "ModelServer",
+    "PagesExhaustedError",
+    "PrefixCache",
     "ServeFuture",
     "ServeRequest",
     "live_servers",

@@ -33,7 +33,29 @@ Model interface (duck-typed): ``prefill(src_rows, slots)``,
 ``close()``, attrs ``eos_id / pad_id / num_slots / max_decode_len /
 src_len``.
 
-Metrics: the ``/stf/serving/decode_*`` family (docs/OBSERVABILITY.md).
+Two decode-throughput extensions ride the same scheduler loop:
+
+- SPECULATIVE DECODING (``draft=`` model): each engine step runs the
+  draft model ``draft_steps`` greedy positions ahead in ONE dispatch
+  (``decode_k``), then the target re-scores the ``spec_k``-token block
+  in ONE batched pass (``verify``, query-block DecodeAttention) and
+  commits the longest prefix of draft proposals that MATCH the
+  target's own choices, plus one bonus target token. Every emitted
+  token is the target's own pick, so greedy output is token-exact vs
+  plain decode; per step a sequence advances 1..spec_k tokens for two
+  dispatches instead of up to spec_k.
+
+- SHARED-PREFIX PROMPT CACHE (paged models, e.g.
+  ``models.causal_lm.CausalLMGenerativeModel``): admission consults a
+  prefix trie keyed on page-sized token chunks
+  (serving/prefix_cache.py) — matched prompt chunks reuse refcounted
+  shared cache pages with ZERO prefill, divergence inside a page is
+  copy-on-write, and retirement decrefs the chain (pages stay cached
+  at refs 0 until LRU eviction). Admissions that run out of pages
+  hold back and retry after the next retirement.
+
+Metrics: the ``/stf/serving/decode_*`` / ``prefix_cache_*`` /
+``spec_*`` families (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -90,6 +112,30 @@ _metric_sequences = monitoring.Counter(
     "Generative sequences finished, by outcome (eos | length | "
     "deadline_exceeded | error | cancelled | rejected)", "model",
     "outcome")
+_metric_prefix_hits = monitoring.Counter(
+    "/stf/serving/prefix_cache_hits",
+    "Prompt pages served from the shared-prefix cache (full-chunk trie "
+    "hits + copy-on-write tails) — each is one page of prefill FLOPs "
+    "avoided", "model")
+_metric_prefix_evictions = monitoring.Counter(
+    "/stf/serving/prefix_cache_evictions",
+    "Refs-0 prefix-cache pages reclaimed by LRU eviction to satisfy an "
+    "allocation", "model")
+_metric_prefix_shared = monitoring.IntGauge(
+    "/stf/serving/prefix_cache_shared_pages",
+    "Cache pages currently resident in the shared-prefix trie "
+    "(referenced or cached at refs 0)", "model")
+_metric_spec_proposed = monitoring.Counter(
+    "/stf/serving/spec_proposed_tokens",
+    "Draft-model tokens proposed to speculative verification", "model")
+_metric_spec_accepted = monitoring.Counter(
+    "/stf/serving/spec_accepted_tokens",
+    "Draft proposals accepted (matched the target's own choice)",
+    "model")
+_metric_spec_acceptance = monitoring.IntGauge(
+    "/stf/serving/spec_acceptance_rate_pct",
+    "Lifetime speculative acceptance rate, percent "
+    "(accepted / proposed)", "model")
 
 # every constructed GenerativeEngine, while alive (test leak hygiene:
 # tests/conftest.py asserts these are all closed after each module)
@@ -188,10 +234,13 @@ class GenerateRequest:
 
 
 class _Sequence:
-    """One live decoding sequence: its slot, emission state, budget."""
+    """One live decoding sequence: its slot, emission state, budget.
+    On the paged (prefix-cache) path it also carries its page table,
+    its deepest trie node (released at retirement), and the private
+    pages it owns (tail + decode pages, freed at retirement)."""
 
     __slots__ = ("req", "slot", "tokens", "logps", "pos", "last_tok",
-                 "budget", "t_start")
+                 "budget", "t_start", "pages", "node", "private")
 
     def __init__(self, req: GenerateRequest, slot: int, first_tok: int,
                  budget: int):
@@ -203,6 +252,9 @@ class _Sequence:
         self.last_tok = first_tok
         self.budget = budget
         self.t_start = time.perf_counter()
+        self.pages: Optional[np.ndarray] = None
+        self.node = None
+        self.private: List[int] = []
 
 
 class GenerativeEngine:
@@ -210,10 +262,57 @@ class GenerativeEngine:
     module docstring). Constructed by ``ModelServer.load_generative``;
     usable standalone (tests, bench)."""
 
-    def __init__(self, name: str, model, policy):
+    def __init__(self, name: str, model, policy, draft=None):
         self.name = name
         self._model = model
         self._policy = policy
+        self._draft = draft
+        self._spec_enabled = (draft is not None
+                              and getattr(policy, "speculative", True))
+        # paged models (page_len attr) route through the shared-prefix
+        # prompt cache; slot models through per-sequence cache rows
+        self._paged = getattr(model, "page_len", None) is not None
+        self._prefix = None
+        self._holdback: List[GenerateRequest] = []
+        if self._paged and getattr(policy, "use_prefix_cache", True):
+            from .prefix_cache import PrefixCache
+
+            self._prefix = PrefixCache(model.num_pages, model.page_len)
+        elif self._paged:
+            raise ValueError(
+                "paged models require the prefix cache "
+                "(DecodePolicy.use_prefix_cache=False unsupported)")
+        if self._spec_enabled:
+            if self._paged:
+                raise ValueError(
+                    "speculative decoding is not supported on the "
+                    "paged (prefix-cache) path")
+            spec_k = getattr(model, "spec_k", 0)
+            kd = getattr(draft, "draft_steps", 0)
+            if spec_k < 2 or kd < 1:
+                raise ValueError(
+                    f"speculative decoding needs a target built with "
+                    f"speculative_k >= 2 (got {spec_k}) and a draft "
+                    f"built with draft_steps >= 1 (got {kd})")
+            if spec_k != kd + 1:
+                raise ValueError(
+                    f"target speculative_k={spec_k} must equal draft "
+                    f"draft_steps+1={kd + 1} (one bonus target token "
+                    "per verified block)")
+            for attr in ("src_len", "eos_id", "pad_id"):
+                if getattr(draft, attr) != getattr(model, attr):
+                    raise ValueError(
+                        f"draft/target {attr} mismatch: "
+                        f"{getattr(draft, attr)} != "
+                        f"{getattr(model, attr)}")
+            if draft.num_slots < policy.num_slots:
+                raise ValueError(
+                    f"draft has {draft.num_slots} slots < "
+                    f"policy.num_slots={policy.num_slots}")
+            if draft.max_decode_len < model.max_decode_len:
+                raise ValueError(
+                    f"draft max_decode_len={draft.max_decode_len} < "
+                    f"target's {model.max_decode_len}")
         if policy.num_slots > model.num_slots:
             raise ValueError(
                 f"policy.num_slots={policy.num_slots} exceeds the "
@@ -259,6 +358,14 @@ class GenerativeEngine:
         self._fill = _metric_fill.get_cell(name)
         self._slots_gauge = _metric_slots.get_cell(name)
         self._per_token = _metric_per_token.get_cell(name)
+        self._prefix_hits = _metric_prefix_hits.get_cell(name)
+        self._prefix_evictions = _metric_prefix_evictions.get_cell(name)
+        self._prefix_shared = _metric_prefix_shared.get_cell(name)
+        self._spec_proposed = _metric_spec_proposed.get_cell(name)
+        self._spec_accepted = _metric_spec_accepted.get_cell(name)
+        self._spec_acceptance = _metric_spec_acceptance.get_cell(name)
+        self._spec_counts = [0, 0]        # lifetime [proposed, accepted]
+        self._prefix_seen = [0, 0]        # last synced [hits, evictions]
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name=f"stf_serving_decode_{name}",
@@ -297,15 +404,32 @@ class GenerativeEngine:
                 telemetry.new_trace_id()
         fut = GenerateFuture(self.name, trace_id=trace_id)
         src = np.asarray(src, np.int32).reshape(-1)
-        if len(src) > self._model.src_len:
-            fut._set_exception(errors.InvalidArgumentError(
-                None, None,
-                f"prompt length {len(src)} exceeds the model's src_len "
-                f"{self._model.src_len}"))
-            _metric_sequences.get_cell(self.name, "rejected").increase_by(1)
-            return fut
-        row = np.full((self._model.src_len,), self._model.pad_id, np.int32)
-        row[:len(src)] = src
+        if self._paged:
+            # prompt rides unpadded (the page program is sized per
+            # request); it must leave at least one decode position
+            limit = self._model.max_seq_len - 1
+            if not 1 <= len(src) <= limit:
+                fut._set_exception(errors.InvalidArgumentError(
+                    None, None,
+                    f"prompt length {len(src)} outside [1, {limit}] "
+                    f"(max_seq_len {self._model.max_seq_len} minus one "
+                    "decode position)"))
+                _metric_sequences.get_cell(
+                    self.name, "rejected").increase_by(1)
+                return fut
+            row = src
+        else:
+            if len(src) > self._model.src_len:
+                fut._set_exception(errors.InvalidArgumentError(
+                    None, None,
+                    f"prompt length {len(src)} exceeds the model's "
+                    f"src_len {self._model.src_len}"))
+                _metric_sequences.get_cell(
+                    self.name, "rejected").increase_by(1)
+                return fut
+            row = np.full((self._model.src_len,), self._model.pad_id,
+                          np.int32)
+            row[:len(src)] = src
         if timeout_ms is None and self._policy.default_timeout_ms > 0:
             timeout_ms = self._policy.default_timeout_ms
         deadline = (time.perf_counter() + float(timeout_ms) / 1000.0
@@ -319,6 +443,9 @@ class GenerativeEngine:
             _metric_sequences.get_cell(self.name, "rejected").increase_by(1)
             return fut
         budget = min(int(max_new_tokens), self._model.max_decode_len)
+        if self._paged:
+            # emitted tokens occupy positions len(src)..max_seq_len-1
+            budget = min(budget, self._model.max_seq_len - len(src))
         if budget == 0:
             # a zero budget never needs a slot or a prefill
             fut._set_result({"tokens": np.zeros(0, np.int32),
@@ -355,6 +482,12 @@ class GenerativeEngine:
     # -- scheduler loop ------------------------------------------------------
     def _loop(self):
         while True:
+            if self._holdback:
+                # page-starved admissions retry once per engine step;
+                # when nothing is live (nothing will ever retire) the
+                # retry inside _admit_batch rejects instead of looping
+                hb, self._holdback = self._holdback, []
+                self._admit_batch(hb)
             if not self._active:
                 item = self._queue.get()
                 if item is _DONE:
@@ -396,6 +529,9 @@ class GenerativeEngine:
             live.append(req)
         if not live:
             return
+        if self._paged:
+            self._admit_paged(live, now)
+            return
         slots = []
         for req in live:
             slot = self._pool.acquire()
@@ -408,6 +544,11 @@ class GenerativeEngine:
         try:
             self._model.prefill(np.stack([r.src for r in live]),
                                 np.asarray(slots, np.int32))
+            if self._spec_enabled:
+                # the draft keeps its own caches: it needs the same
+                # prompts resident to propose from
+                self._draft.prefill(np.stack([r.src for r in live]),
+                                    np.asarray(slots, np.int32))
         except BaseException as e:  # noqa: BLE001
             _flight_mod.get_recorder().on_error(
                 e, where="serving_decode_prefill", model=self.name)
@@ -428,6 +569,127 @@ class GenerativeEngine:
                                           req.max_new_tokens))
         self._slots_gauge.set(len(self._active))
 
+    def _sync_prefix_metrics(self):
+        pc = self._prefix
+        hits = pc.hit_pages + pc.cow_hits
+        if hits > self._prefix_seen[0]:
+            self._prefix_hits.increase_by(hits - self._prefix_seen[0])
+            self._prefix_seen[0] = hits
+        if pc.evictions > self._prefix_seen[1]:
+            self._prefix_evictions.increase_by(
+                pc.evictions - self._prefix_seen[1])
+            self._prefix_seen[1] = pc.evictions
+        self._prefix_shared.set(pc.shared_pages)
+
+    def _admit_paged(self, live, now):
+        """Prefix-cache admission: resolve each prompt's page program
+        (trie hits reuse shared pages, misses prefill fresh ones, a
+        partial tail copies-on-write when a cached page extends it),
+        then batch the chunk prefills depth-by-depth so each
+        sequence's chunks run in order while different sequences
+        share plan executions."""
+        from .prefix_cache import PagesExhaustedError
+
+        admitted = []          # (req, slot, plan)
+        for req in live:
+            slot = self._pool.acquire()
+            if slot is None:
+                self._holdback.append(req)
+                continue
+            try:
+                plan = self._prefix.acquire(req.src[:-1])
+            except PagesExhaustedError as e:
+                self._pool.release(slot)
+                if self._active or admitted:
+                    # something live will retire and free pages: retry
+                    self._holdback.append(req)
+                else:
+                    self._reject(req, "rejected",
+                                 errors.ResourceExhaustedError(
+                                     None, None,
+                                     f"model {self.name!r}: prompt "
+                                     f"needs more cache pages than "
+                                     f"exist ({e})"))
+                continue
+            admitted.append((req, slot, plan))
+            _req_tracing.emit_span("serving_queue_wait", req.t_enqueue,
+                                   now - req.t_enqueue,
+                                   trace_id=req.trace_id,
+                                   model=self.name)
+        if not admitted:
+            self._sync_prefix_metrics()
+            return
+        pl = self._model.page_len
+        pps = self._model.pages_per_seq
+        scratch = self._model.scratch_page
+        t0 = time.perf_counter()
+        try:
+            # copy-on-write first: a CoW'd tail page must be populated
+            # before any decode step reads through it
+            for _, _, plan in admitted:
+                if plan.cow_src is not None:
+                    self._model.copy_page(plan.tail_page, plan.cow_src)
+            # per-sequence ordered chunk lists (append the prefilled
+            # tail as the last chunk when it wasn't served by CoW)
+            tables = {}
+            chunk_lists = {}
+            for req, slot, plan in admitted:
+                table = np.full((pps,), scratch, np.int32)
+                pages = plan.pages
+                table[:len(pages)] = pages
+                tables[slot] = table
+                chunks = list(plan.fill)
+                if len(plan.tail) and plan.cow_src is None:
+                    row = np.full((pl,), self._model.pad_id, np.int32)
+                    row[:len(plan.tail)] = plan.tail
+                    chunks.append((plan.tail_page, row,
+                                   plan.cached_len - len(plan.tail)))
+                chunk_lists[slot] = chunks
+            depth = 0
+            while True:
+                batch = [(slot, ch[depth])
+                         for slot, ch in chunk_lists.items()
+                         if depth < len(ch)]
+                if not batch:
+                    break
+                self._model.prefill_chunk(
+                    np.stack([c[1] for _, c in batch]),
+                    np.asarray([c[2] for _, c in batch], np.int32),
+                    np.stack([tables[slot] for slot, _ in batch]),
+                    np.asarray([c[0] for _, c in batch], np.int32))
+                depth += 1
+        except BaseException as e:  # noqa: BLE001
+            _flight_mod.get_recorder().on_error(
+                e, where="serving_decode_prefill", model=self.name)
+            for req, slot, plan in admitted:
+                self._prefix.release(plan.node)
+                if plan.tail_page is not None:
+                    self._prefix.free_page(plan.tail_page)
+                self._pool.release(slot)
+                self._reject(req, "error", e)
+            self._sync_prefix_metrics()
+            return
+        dur = time.perf_counter() - t0
+        self._prefill_s.add(dur)
+        _req_tracing.emit_span(
+            "serving_decode_prefill", t0, dur,
+            trace_ids=[r.trace_id for r, _, _ in admitted
+                       if r.trace_id],
+            model=self.name, joined=len(admitted))
+        for req, slot, plan in admitted:
+            # the first decode step feeds the LAST prompt token at
+            # position plen-1 — its output is the first emitted token
+            s = _Sequence(req, slot, int(req.src[-1]),
+                          req.max_new_tokens)
+            s.pos = len(req.src) - 1
+            s.pages = tables[slot]
+            s.node = plan.node
+            s.private = ([plan.tail_page]
+                         if plan.tail_page is not None else [])
+            self._active.append(s)
+        self._sync_prefix_metrics()
+        self._slots_gauge.set(len(self._active))
+
     def _step(self):
         # per-token deadline check: an expired sequence retires NOW —
         # it never stalls or rides another step
@@ -441,6 +703,12 @@ class GenerativeEngine:
         self._active = still
         if not self._active:
             self._slots_gauge.set(0)
+            return
+        if self._spec_enabled:
+            self._step_speculative()
+            return
+        if self._paged:
+            self._step_paged()
             return
         n = len(self._active)
         tokens = [s.last_tok for s in self._active]
@@ -459,6 +727,11 @@ class GenerativeEngine:
         t0 = time.perf_counter()
         next_tok, logp, bucket = self._model.decode(tokens, positions,
                                                     slots)
+        self._finish_single_step(next_tok, logp, bucket, n, t0)
+
+    def _finish_single_step(self, next_tok, logp, bucket, n, t0):
+        """Shared one-token-per-sequence commit: metrics, streaming,
+        EOS/budget retirement (slot and paged steps both land here)."""
         dur = time.perf_counter() - t0
         self._step_s.add(dur)
         self._fill.add(n / max(bucket, 1))
@@ -493,8 +766,131 @@ class GenerativeEngine:
         self._active = still
         self._slots_gauge.set(len(still))
 
+    def _step_paged(self):
+        """One decode position on the paged path: make sure every
+        sequence's write page exists (allocating private decode pages
+        lazily, page-fault style), then run the page-table decode."""
+        from .prefix_cache import PagesExhaustedError
+
+        pl = self._model.page_len
+        scratch = self._model.scratch_page
+        still = []
+        for s in self._active:
+            blk = s.pos // pl
+            if s.pages[blk] == scratch:
+                try:
+                    pg = self._prefix.alloc_page()
+                except PagesExhaustedError as e:
+                    # every page is held by live sequences: this one
+                    # cannot advance — fail it rather than stall all
+                    self._retire(s, "error",
+                                 exc=errors.ResourceExhaustedError(
+                                     None, None,
+                                     f"model {self.name!r}: out of "
+                                     f"cache pages mid-decode ({e})"))
+                    continue
+                s.pages[blk] = pg
+                s.private.append(pg)
+            still.append(s)
+        self._active = still
+        if not self._active:
+            self._slots_gauge.set(0)
+            return
+        n = len(self._active)
+        t0 = time.perf_counter()
+        next_tok, logp, bucket = self._model.decode(
+            [s.last_tok for s in self._active],
+            [s.pos for s in self._active],
+            np.stack([s.pages for s in self._active]))
+        self._finish_single_step(next_tok, logp, bucket, n, t0)
+
+    def _step_speculative(self):
+        """One speculative cycle: the draft proposes ``draft_steps``
+        greedy tokens in one dispatch, the target verifies the
+        ``spec_k``-token block in one batched re-score, and each
+        sequence commits the longest matching prefix plus one bonus
+        target token. Every committed token is the target's own
+        choice, so greedy output is token-exact vs plain decode;
+        rejected-suffix cache rows are dead (length-masked) until the
+        next cycle overwrites them."""
+        n = len(self._active)
+        tokens = [s.last_tok for s in self._active]
+        positions = [s.pos for s in self._active]
+        slots = [s.slot for s in self._active]
+        kd = self._draft.draft_steps
+        t0 = time.perf_counter()
+        props, _ = self._draft.decode_k(tokens, positions, slots)
+        blk = np.concatenate(
+            [np.asarray(tokens, np.int32).reshape(n, 1), props], axis=1)
+        tgt, lps, bucket = self._model.verify(blk, positions, slots)
+        dur = time.perf_counter() - t0
+        self._step_s.add(dur)
+        self._fill.add(n / max(bucket, 1))
+        rec = _flight_mod.get_recorder()
+        eos = self._model.eos_id
+        max_pos = self._model.max_decode_len - 1
+        emitted_total = 0
+        accepted_total = 0
+        still = []
+        for i, s in enumerate(self._active):
+            a = 0
+            while a < kd and int(props[i, a]) == int(tgt[i, a]):
+                a += 1
+            accepted_total += a
+            outcome = None
+            for j in range(a + 1):
+                tok = int(tgt[i, j])
+                lp = float(lps[i, j])
+                s.tokens.append(tok)
+                s.logps.append(lp)
+                s.pos += 1
+                s.last_tok = tok
+                emitted_total += 1
+                if s.req.on_token is not None:
+                    try:
+                        s.req.on_token(tok, lp)
+                    except Exception:  # noqa: BLE001
+                        pass
+                if tok == eos:
+                    outcome = "eos"
+                    break
+                if len(s.tokens) >= s.budget or s.pos > max_pos:
+                    outcome = "length"
+                    break
+            if outcome is not None:
+                self._retire(s, outcome)
+            else:
+                still.append(s)
+        self._active = still
+        self._slots_gauge.set(len(still))
+        self._tokens.increase_by(emitted_total)
+        self._rate.add(emitted_total)
+        self._rate_gauge.set(int(self._rate.rate()))
+        self._spec_proposed.increase_by(kd * n)
+        self._spec_accepted.increase_by(accepted_total)
+        self._spec_counts[0] += kd * n
+        self._spec_counts[1] += accepted_total
+        if self._spec_counts[0]:
+            self._spec_acceptance.set(
+                int(100 * self._spec_counts[1] / self._spec_counts[0]))
+        if rec.enabled:
+            rec.record("decode_step", model=self.name, live=n,
+                       bucket=bucket, step_s=round(dur, 6),
+                       spec_emitted=emitted_total)
+
     def _retire(self, s: _Sequence, outcome: str,
                 exc: Optional[BaseException] = None):
+        if s.pages is not None:
+            # decref the shared trie chain (pages stay cached at refs
+            # 0 for future prefix hits) and free the private pages
+            if s.node is not None:
+                self._prefix.release(s.node)
+            for pg in s.private:
+                self._prefix.free_page(pg)
+            s.private = []
+            s.node = None
+            s.pages = None
+            self._sync_prefix_metrics()
         self._pool.release(s.slot)
         _metric_sequences.get_cell(self.name, outcome).increase_by(1)
         if s.tokens:
@@ -524,6 +920,16 @@ class GenerativeEngine:
         model_info = getattr(self._model, "statusz_info", None)
         if callable(model_info):
             info.update(model_info())
+        if self._prefix is not None:
+            info["prefix_cache"] = self._prefix.statusz_info()
+            info["holdback"] = len(self._holdback)
+        if self._spec_enabled:
+            prop, acc = self._spec_counts
+            info["speculative"] = {
+                "spec_k": self._model.spec_k,
+                "draft_steps": self._draft.draft_steps,
+                "proposed_tokens": prop, "accepted_tokens": acc,
+                "acceptance_rate": (acc / prop) if prop else 0.0}
         return info
 
     def close(self, timeout: float = 30.0):
@@ -537,6 +943,8 @@ class GenerativeEngine:
                 threading.current_thread() is not self._thread:
             self._thread.join(timeout)
         self._model.close()
+        if self._draft is not None:
+            self._draft.close()
 
     def __enter__(self):
         return self

@@ -123,7 +123,9 @@ class DecodePolicy(BatchingPolicy):
                  prefill_bucket_sizes: Sequence[int] = (1,),
                  max_queue_depth: int = 256,
                  max_new_tokens: Optional[int] = None,
-                 default_timeout_ms: float = 0.0):
+                 default_timeout_ms: float = 0.0,
+                 use_prefix_cache: bool = True,
+                 speculative: bool = True):
         super().__init__(max_batch_size=num_slots,
                          batch_timeout_ms=0.0,
                          max_queue_depth=max_queue_depth,
@@ -143,6 +145,12 @@ class DecodePolicy(BatchingPolicy):
                              f"{prefill_bucket_sizes}")
         self.max_new_tokens = min(int(max_new_tokens or max_decode_len),
                                   self.max_decode_len)
+        # throughput-extension gates (docs/SERVING.md): paged models
+        # admit through the shared-prefix prompt cache when
+        # use_prefix_cache; a draft model passed to the engine is used
+        # for speculative decoding only when speculative
+        self.use_prefix_cache = bool(use_prefix_cache)
+        self.speculative = bool(speculative)
 
     def __repr__(self):
         return (f"DecodePolicy(num_slots={self.num_slots}, "
@@ -151,4 +159,6 @@ class DecodePolicy(BatchingPolicy):
                 f"prefill_bucket_sizes={self.prefill_bucket_sizes}, "
                 f"max_queue_depth={self.max_queue_depth}, "
                 f"max_new_tokens={self.max_new_tokens}, "
-                f"default_timeout_ms={self.default_timeout_ms})")
+                f"default_timeout_ms={self.default_timeout_ms}, "
+                f"use_prefix_cache={self.use_prefix_cache}, "
+                f"speculative={self.speculative})")

@@ -1,0 +1,275 @@
+"""Shared-prefix prompt cache: refcounted page pool + token-chunk trie.
+
+(ref: vLLM-style prefix caching / RadixAttention, rebuilt host-side for
+the stf paged causal-LM serving path.)
+
+Chat and agent workloads resend the same system prompt / few-shot
+header in front of every request; re-running prefill over that shared
+prefix burns FLOPs recomputing K/V state that is BYTE-IDENTICAL across
+requests (K/V at position p depends only on tokens <= p). This module
+dedups it at PAGE granularity:
+
+- the device caches are paged: ``(num_pages + 1, page_len, H, hd)``
+  per layer (models/causal_lm.py), a sequence's state is its ordered
+  page table, attention reads through the page-table gather;
+- a trie keyed on FULL ``page_len``-token chunks maps prompt prefixes
+  to physical pages. Admission walks the trie: every matched chunk
+  reuses the existing page (refcount + 1, ZERO prefill), unmatched full
+  chunks prefill into fresh pages that are inserted into the trie for
+  the next request;
+- only FULL chunks are ever shared directly. A sequence's partial tail
+  chunk lives in a PRIVATE page — two sequences sharing a half-full
+  page would append into the same rows. When a trie child's chunk
+  extends the tail (tail is a proper prefix of it), the tail page is
+  built by COPY-ON-WRITE (``KVCachePageCopy`` of the child's page)
+  instead of prefill: rows ``0..len(tail)-1`` of the copied page are
+  exactly the tail's K/V, the rows past it are dead (attention masks by
+  committed length; later appends overwrite in place);
+- retirement walks the sequence's trie chain decrementing refcounts;
+  pages at refcount 0 STAY resident (that's the cache) until the free
+  list runs dry, then :meth:`PrefixCache._evict_one` reclaims the
+  least-recently-touched refs-0 LEAF (leaf-first keeps the trie
+  consistent: an inner node's page can't outlive its children's).
+
+Single-threaded by design: the engine's scheduler thread owns the
+instance (same ownership contract as ``generative.CacheSlotPool``).
+:meth:`PrefixCache.reconcile` cross-checks the three page populations
+(free list, trie-resident, sequence-private) against the pool size —
+the churn fuzz test drives 12 requests through admit/retire/evict and
+asserts drift stays 0.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class PagesExhaustedError(RuntimeError):
+    """No free page and nothing evictable (every page is referenced by
+    a live sequence or privately owned). The engine holds the request
+    back and re-tries admission after the next retirement."""
+
+
+class _TrieNode:
+    __slots__ = ("chunk", "page", "refs", "children", "parent",
+                 "last_use")
+
+    def __init__(self, chunk: Optional[Tuple[int, ...]],
+                 page: Optional[int], parent: "Optional[_TrieNode]"):
+        self.chunk = chunk
+        self.page = page
+        self.refs = 0
+        self.children: Dict[Tuple[int, ...], "_TrieNode"] = {}
+        self.parent = parent
+        self.last_use = 0
+
+
+class AdmitPlan:
+    """One admission's resolved page program (see
+    :meth:`PrefixCache.acquire`): everything the engine must DO is in
+    ``fill`` (prefill these chunks into these pages) and ``cow_src``
+    (copy that page into ``tail_page`` first); everything already done
+    is in ``reused_pages``."""
+
+    __slots__ = ("reused_pages", "fill", "tail", "tail_page", "cow_src",
+                 "node", "cached_len")
+
+    def __init__(self, reused_pages, fill, tail, tail_page, cow_src,
+                 node, cached_len):
+        self.reused_pages: List[int] = reused_pages
+        self.fill: List[Tuple[int, np.ndarray, int]] = fill
+        self.tail: np.ndarray = tail
+        self.tail_page: Optional[int] = tail_page
+        self.cow_src: Optional[int] = cow_src
+        self.node: _TrieNode = node
+        self.cached_len: int = cached_len
+
+    @property
+    def pages(self) -> List[int]:
+        """The page-table prefix, in sequence order."""
+        out = list(self.reused_pages) + [pg for pg, _, _ in self.fill]
+        if self.tail_page is not None:
+            out.append(self.tail_page)
+        return out
+
+
+class PrefixCache:
+    """Refcounted page pool + shared-prefix trie (module docstring)."""
+
+    def __init__(self, num_pages: int, page_len: int):
+        self.num_pages = int(num_pages)
+        self.page_len = int(page_len)
+        self._free: List[int] = list(range(self.num_pages))[::-1]
+        self._root = _TrieNode(None, None, None)
+        self._tick = 0
+        # counters the engine maps into /stf/serving/prefix_cache_*
+        self.hit_pages = 0        # full chunks served with zero prefill
+        self.cow_hits = 0         # tails served by page copy, not prefill
+        self.miss_pages = 0       # full chunks that had to prefill
+        self.evictions = 0
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Trie-resident page count (refs > 0 or cached at refs 0)."""
+        return sum(1 for _ in self._iter_nodes())
+
+    def _iter_nodes(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    # -- page pool -----------------------------------------------------------
+    def _touch(self, node: _TrieNode):
+        self._tick += 1
+        node.last_use = self._tick
+
+    def alloc_page(self, _pin: Optional[set] = None) -> int:
+        """Take a page off the free list, evicting a refs-0 trie leaf
+        if it is dry. Raises :class:`PagesExhaustedError` when every
+        page is live."""
+        if not self._free:
+            self._evict_one(_pin or set())
+        return self._free.pop()
+
+    def free_page(self, page: int):
+        self._free.append(page)
+
+    def _evict_one(self, pin: set):
+        victim = None
+        for n in self._iter_nodes():
+            if n.refs == 0 and not n.children and n.page not in pin:
+                if victim is None or n.last_use < victim.last_use:
+                    victim = n
+        if victim is None:
+            raise PagesExhaustedError(
+                f"all {self.num_pages} pages live (no refs-0 leaf to "
+                "evict)")
+        del victim.parent.children[victim.chunk]
+        self._free.append(victim.page)
+        self.evictions += 1
+
+    # -- admission / retirement ----------------------------------------------
+    def acquire(self, cached_tokens: Sequence[int]) -> AdmitPlan:
+        """Resolve the page program for one admission.
+
+        ``cached_tokens`` is the prompt span the engine caches —
+        ``prompt[:-1]`` (the final prompt token is fed through the
+        first decode step, which produces the first emitted token).
+        Matched full chunks are refcounted in place; unmatched full
+        chunks get fresh pages AND trie nodes (refs=1, shareable by the
+        next request before this one even retires); a partial tail gets
+        a PRIVATE page, by CoW when a trie child extends it. On
+        allocation failure everything is rolled back and
+        :class:`PagesExhaustedError` propagates."""
+        toks = [int(t) for t in cached_tokens]
+        pl = self.page_len
+        n_full = len(toks) // pl
+        tail = np.asarray(toks[n_full * pl:], np.int32)
+
+        node = self._root
+        reused: List[int] = []
+        matched: List[_TrieNode] = []
+        i = 0
+        while i < n_full:
+            chunk = tuple(toks[i * pl:(i + 1) * pl])
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.refs += 1
+            self._touch(child)
+            matched.append(child)
+            reused.append(child.page)
+            node = child
+            i += 1
+        self.hit_pages += len(reused)
+
+        fill: List[Tuple[int, np.ndarray, int]] = []
+        inserted: List[_TrieNode] = []
+        allocated: List[int] = []
+        pin = set(reused)
+
+        def _rollback():
+            for m in matched:
+                m.refs -= 1
+            for nd in inserted:
+                del nd.parent.children[nd.chunk]
+            for pg in allocated:
+                self._free.append(pg)
+
+        try:
+            while i < n_full:
+                chunk = tuple(toks[i * pl:(i + 1) * pl])
+                pg = self.alloc_page(pin)
+                allocated.append(pg)
+                pin.add(pg)
+                child = _TrieNode(chunk, pg, node)
+                child.refs = 1
+                self._touch(child)
+                node.children[chunk] = child
+                inserted.append(child)
+                fill.append((pg, np.asarray(chunk, np.int32), i * pl))
+                self.miss_pages += 1
+                node = child
+                i += 1
+
+            tail_page = None
+            cow_src = None
+            if len(tail):
+                # CoW probe: a child whose chunk extends the tail
+                # already holds the tail's K/V rows
+                for chunk, child in node.children.items():
+                    if chunk[:len(tail)] == tuple(int(t) for t in tail):
+                        cow_src = child.page
+                        break
+                if cow_src is not None:
+                    pin.add(cow_src)
+                tail_page = self.alloc_page(pin)
+                allocated.append(tail_page)
+                if cow_src is not None:
+                    self.cow_hits += 1
+        except PagesExhaustedError:
+            _rollback()
+            raise
+        return AdmitPlan(reused, fill, tail, tail_page, cow_src, node,
+                         len(toks))
+
+    def release(self, node: _TrieNode):
+        """Retire one sequence's hold on its trie chain (deepest node
+        first; pages stay cached at refs 0 until evicted)."""
+        while node is not None and node is not self._root:
+            node.refs -= 1
+            assert node.refs >= 0, "prefix-cache refcount underflow"
+            node = node.parent
+
+    # -- invariant check -----------------------------------------------------
+    def reconcile(self, private_pages: Sequence[int]) -> int:
+        """Cross-check the three page populations. Returns the drift
+        (0 when consistent): every page is in exactly one of {free
+        list, trie, private}, and they sum to ``num_pages``."""
+        free = list(self._free)
+        trie = [n.page for n in self._iter_nodes()]
+        private = list(private_pages)
+        drift = 0
+        allp = free + trie + private
+        drift += len(allp) - len(set(allp))          # double-owned
+        drift += abs(len(allp) - self.num_pages)     # leaked / lost
+        drift += sum(1 for p in allp
+                     if not 0 <= p < self.num_pages)  # out of range
+        return drift
+
+    def statusz_info(self):
+        return {"num_pages": self.num_pages, "page_len": self.page_len,
+                "free": self.free_count,
+                "shared_pages": self.shared_pages,
+                "hit_pages": self.hit_pages, "cow_hits": self.cow_hits,
+                "miss_pages": self.miss_pages,
+                "evictions": self.evictions}

@@ -117,7 +117,11 @@ class MemoryLedger:
     """Thread-safe accounting of device-resident allocations."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # Reentrant: a GC pass triggered by an allocation inside the
+        # locked region can run a weakref.finalize callback (a dropped
+        # session's _release_ledger_tokens) that re-enters release()
+        # on the SAME thread — a plain Lock self-deadlocks there.
+        self._lock = threading.RLock()
         self._entries: Dict[int, _Entry] = {}
         self._next_token = 1
         self._totals: Dict[Any, int] = {}   # (class, owner) -> bytes
@@ -130,6 +134,10 @@ class MemoryLedger:
         # treats them as tracked without the ledger owning their bytes
         self._transient: Dict[int, Any] = {}
         self._gauge_cells: Dict[Any, Any] = {}
+        # authoritative per-gauge totals: the cell is WRITTEN from this,
+        # never read back — a reentrant release mid-_apply_delta (see
+        # _lock comment) must not race a cell read-modify-write
+        self._gauge_totals: Dict[Any, int] = {}
 
     # -- registration ---------------------------------------------------------
     def register(self, name: str, nbytes: int, cls: str,
@@ -194,7 +202,8 @@ class MemoryLedger:
         cell = self._gauge_cells.get(gkey)
         if cell is None:
             cell = self._gauge_cells[gkey] = _metric_live.get_cell(*gkey)
-        cell.set(max(0, cell.value() + delta))
+        self._gauge_totals[gkey] = self._gauge_totals.get(gkey, 0) + delta
+        cell.set(max(0, self._gauge_totals[gkey]))
 
     def track_transient(self, value) -> None:
         """Mark device arrays as library-staged (no ledger bytes): a

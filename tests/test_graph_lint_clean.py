@@ -32,6 +32,7 @@ ALLOWED_WARNINGS = {
     "resnet_tiny": {"lint/unseeded-rng"},            # kernel initializers
     "bert_tiny": {"lint/unseeded-rng"},              # dropout
     "transformer_tiny": {"lint/unseeded-rng"},       # dropout
+    "causal_lm_tiny": {"lint/unseeded-rng"},         # dropout
     "word2vec": {"lint/unseeded-rng"},               # NCE sampler
     "seq2seq_tiny": {"lint/unseeded-rng"},           # dropout
     "ptb_lstm_tiny": {"lint/unseeded-rng"},          # dropout
@@ -112,6 +113,15 @@ def test_transformer_tiny_clean():
     m = tr.transformer_train_model(batch_size=2, src_len=8, tgt_len=8,
                                    cfg=cfg, compute_dtype=stf.float32)
     _analyze("transformer_tiny", [m["train_op"], m["loss"]])
+
+
+def test_causal_lm_tiny_clean():
+    from simple_tensorflow_tpu.models import causal_lm as clm
+
+    cfg = clm.CausalLMConfig.tiny()
+    m = clm.causal_lm_train_model(batch_size=2, seq_len=8, cfg=cfg,
+                                  compute_dtype=stf.float32)
+    _analyze("causal_lm_tiny", [m["train_op"], m["loss"]])
 
 
 def test_word2vec_clean():

@@ -1061,6 +1061,7 @@ COVERED_ELSEWHERE.update({
     "KVCacheAlloc": ("test_generative.py", "KVCache"),
     "KVCacheAppend": ("test_generative.py", "KVCache"),
     "KVCacheGather": ("test_generative.py", "KVCache"),
+    "KVCachePageCopy": ("test_decode2.py", "copy_pages"),
     "DecodeAttention": ("test_generative.py", "decode_attention"),
     "BarrierIncompleteSize": ("test_data_flow_structures.py", "Barrier"),
     "BarrierInsertMany": ("test_data_flow_structures.py", "Barrier"),
