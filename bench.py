@@ -911,6 +911,105 @@ def _measure_loop_fusion(platform, device_kind):
     }
 
 
+def _measure_numerics(platform, device_kind):
+    """Numerics-health-plane overhead row (ISSUE 17 satellite): the same
+    BERT fused-loop config as the loop_fusion row, N=64 windows, timed
+    with the plane OFF (plain Session) and ON
+    (ConfigProto(numerics="metrics")). ON auto-taps the gradients,
+    optimizer updates and loss and threads the packed [64, 4]
+    NumericSummary health tensor through the lax.scan carry — the whole
+    point of the design is that the window does NOT split, so the cost
+    should be a few extra device reductions amortized over 64 steps.
+    The row's value is the percent overhead (target <3% at N=64); the
+    monitoring snapshot rides along so the /stf/train/* families
+    (health_steps, nonfinite_events, grad_norm, update_ratio) are
+    visible in the emitted line."""
+    steps_budget = int(os.environ.get("BENCH_FUSION_STEPS", "192"))
+    n = 64
+
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu.data.dataset import Dataset
+    from simple_tensorflow_tpu.models import bert
+
+    cfg = bert.BertConfig.base()
+    batch, seq_len, max_pred = 24, 512, 76
+    compute_dtype = stf.bfloat16
+    if platform == "cpu":
+        cfg = bert.BertConfig(
+            vocab_size=99, hidden_size=16, num_layers=1, num_heads=2,
+            intermediate_size=32, max_position=8, hidden_dropout=0.0,
+            attention_dropout=0.0)
+        batch, seq_len, max_pred = 1, 8, 1
+        compute_dtype = stf.float32
+
+    stf.reset_default_graph()
+    m = bert.bert_pretrain_model(
+        batch_size=batch, seq_len=seq_len, max_predictions=max_pred,
+        cfg=cfg, compute_dtype=compute_dtype, use_input_mask=True)
+    batch_np = bert.synthetic_pretrain_batch(batch, seq_len, max_pred,
+                                             vocab_size=cfg.vocab_size)
+    batch_np["input_mask"] = np.ones((batch, seq_len), np.int32)
+    fetch = [m["train_op"], m["loss"]]
+
+    def batch_stream():
+        while True:
+            yield dict(batch_np)
+
+    def measure(sess):
+        """Median sec_per_step over 3 timed rounds of N=64 fused
+        windows — identical loop shape to the loop_fusion row so OFF
+        here reproduces that row's fused regime."""
+        sess.run(stf.global_variables_initializer())
+        ds = Dataset.from_generator(batch_stream).prefetch_to_device(
+            buffer_size=2, superbatch=n)
+        it = iter(ds)
+        sb = {m[k]: v for k, v in next(it).items()}
+        out = sess.run_steps(fetch, n=n, stacked_feeds=sb,
+                             output_mode="stacked")
+        np.asarray(out[1])
+        windows = max(1, steps_budget // n)
+        rounds = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(windows):
+                sb = {m[k]: v for k, v in next(it).items()}
+                out = sess.run_steps(fetch, n=n, stacked_feeds=sb,
+                                     output_mode="stacked")
+                np.asarray(out[1])
+            rounds.append((time.perf_counter() - t0) / (windows * n))
+        return float(np.median(rounds)), rounds
+
+    off_sec, off_rounds = measure(stf.Session())
+    on_sec, on_rounds = measure(stf.Session(
+        config=stf.ConfigProto(numerics="metrics")))
+    overhead_pct = round((on_sec / off_sec - 1.0) * 100.0, 2)
+
+    from simple_tensorflow_tpu.debug import numerics as _numerics
+    plane = _numerics.get_plane().info()
+    return {
+        **_monitoring_info(),  # after ON: /stf/train/* families populated
+        "metric": "numerics_plane_overhead_pct_fused_n64",
+        "value": overhead_pct,
+        "unit": "% overhead (numerics metrics plane ON vs OFF, "
+                "fused N=64)",
+        "vs_baseline": None,
+        "n": n,
+        "off_sec_per_step": round(off_sec, 6),
+        "on_sec_per_step": round(on_sec, 6),
+        "off_rounds_sec_per_step": [round(r, 6) for r in off_rounds],
+        "on_rounds_sec_per_step": [round(r, 6) for r in on_rounds],
+        "health_steps_observed": plane.get("steps_observed"),
+        "health_taps": len(plane.get("taps", ())),
+        "batch": batch,
+        "seq_len": seq_len,
+        "num_layers": cfg.num_layers,
+        "hidden_size": cfg.hidden_size,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _measure_input_pipeline(platform, device_kind):
     """Input-pipeline engine row (ISSUE 5 tentpole): records/sec over 8
     synthetic TFRecord shards — the SEED sequential chain (single-thread
@@ -2842,6 +2941,8 @@ def child_main():
         result = _measure_autoshard(platform, kind)
     elif model == "loop_fusion":
         result = _measure_loop_fusion(platform, kind)
+    elif model == "numerics":
+        result = _measure_numerics(platform, kind)
     elif model == "input_pipeline":
         result = _measure_input_pipeline(platform, kind)
     elif model == "serving":
@@ -2961,6 +3062,7 @@ def _run_model(model, platform, kind, errors):
                        "transformer": "1200", "mnist": "300",
                        "analysis": "600", "sharding_analysis": "900",
                        "loop_fusion": "900",
+                       "numerics": "900",
                        "input_pipeline": "600",
                        "serving": "900",
                        "telemetry": "900",
@@ -2970,7 +3072,7 @@ def _run_model(model, platform, kind, errors):
                        "decode2": "1500"}.get(
         model, "900")
     extra_xla_flags = ""
-    if model == "loop_fusion":
+    if model in ("loop_fusion", "numerics"):
         # CPU-only flag (ignored elsewhere): the legacy emitted-code CPU
         # runtime has far lower per-op dispatch cost than the thunk
         # runtime, so the tiny-step measurement compares host-dispatch
@@ -3037,6 +3139,9 @@ _METRIC_NAMES = {
         "x (hand-spec step time / searched-layout step time)"),
     "loop_fusion": ("loop_fusion_bert_amortization_n64_vs_n1",
                     "x (measured_over_predicted improvement)"),
+    "numerics": ("numerics_plane_overhead_pct_fused_n64",
+                 "% overhead (numerics metrics plane ON vs OFF, "
+                 "fused N=64)"),
     "input_pipeline": ("input_pipeline_records_per_sec", "records/sec"),
     "serving": ("serving_qps_speedup_batched_vs_batch1",
                 "x (QPS, 16 concurrent closed-loop clients)"),
@@ -3079,7 +3184,8 @@ def main():
     for tok in os.environ.get(
             "BENCH_MODELS",
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
-            "sharding_analysis,autoshard,loop_fusion,input_pipeline,serving,"
+            "sharding_analysis,autoshard,loop_fusion,numerics,"
+            "input_pipeline,serving,"
             "telemetry,memory,checkpoint,kernel_tier,generative,decode2,"
             "warm_start").split(","):
         tok = tok.strip()
@@ -3098,8 +3204,8 @@ def main():
         selected = ["resnet", "bert", "transformer", "mnist",
                     "resnet_dp", "graph_opt", "analysis",
                     "sharding_analysis", "autoshard", "loop_fusion",
-                    "input_pipeline", "serving", "telemetry",
-                    "memory", "checkpoint", "kernel_tier",
+                    "numerics", "input_pipeline", "serving",
+                    "telemetry", "memory", "checkpoint", "kernel_tier",
                     "generative", "decode2", "warm_start"]
     try:
         platform, kind = probe_backend(

@@ -51,6 +51,16 @@ Built-in catalog (see docs/ANALYSIS.md for the worked examples):
                          configured budget (``graph_lint --memory
                          --budget BYTES``; ctx.memory_budget). Active
                          only for purpose="memory" runs (ERROR)
+  lint/numeric-risk      statically visible NaN/Inf seeds, the offline
+                         half of the stf.debug.numerics runtime plane:
+                         unguarded domain-restricted ops (Log/Rsqrt/
+                         Reciprocal on an unclamped operand, Div with
+                         an unguarded denominator, Exp with no upper
+                         clamp or max-subtraction) and bf16/f16
+                         long-axis reductions whose low-mantissa
+                         accumulator drifts. Active only for
+                         purpose="numerics" runs (``graph_lint
+                         --numerics``) (WARNING)
 """
 
 from __future__ import annotations
@@ -591,3 +601,149 @@ def _rule_kernel_routing(ctx):
         yield (op,
                f"kernel routing [{mode}/{bk}]: {op.type} -> "
                f"{rec['verdict']}{detail}")
+
+
+# ---------------------------------------------------------------------------
+# numeric-risk (purpose="numerics") — the static half of the
+# stf.debug.numerics runtime health plane (docs/DEBUG.md)
+# ---------------------------------------------------------------------------
+
+# ops that constrain their operand's range: a guard anywhere on the
+# plumbing path between a value and a risky consumer means the author
+# already handled the edge case
+_NUMERIC_GUARD_TYPES = frozenset((
+    "Maximum", "Minimum", "ClipByValue", "Abs", "Square", "Exp",
+    "Sigmoid", "Softmax", "Softplus", "Relu", "Relu6",
+))
+# Exp overflows at the TOP of the range, so its guards differ: an upper
+# clamp, a negation, or the log-sum-exp ``x - max(x)`` subtraction
+_NUMERIC_EXP_GUARD_TYPES = frozenset((
+    "Minimum", "ClipByValue", "Neg", "Sub", "LogSoftmax", "Softplus",
+    "Sigmoid", "Softmax",
+))
+# pure shape/dtype plumbing the guard search walks through
+_NUMERIC_PASSTHROUGH_TYPES = frozenset((
+    "Identity", "Reshape", "Cast", "StopGradient", "Squeeze",
+    "ExpandDims", "Transpose",
+))
+# risky op type -> (operand index to inspect, failure mode)
+_NUMERIC_RISK_OPS = {
+    "Log":        (0, "log of a zero/negative value is -inf/nan"),
+    "Rsqrt":      (0, "rsqrt of zero is inf, of a negative value nan"),
+    "Reciprocal": (0, "1/0 is inf"),
+    "Div":        (1, "a zero denominator is inf (0/0 is nan)"),
+    "TrueDiv":    (1, "a zero denominator is inf (0/0 is nan)"),
+    "RealDiv":    (1, "a zero denominator is inf (0/0 is nan)"),
+    "Exp":        (0, "exp overflows to inf past ~88 in float32 "
+                      "(~11 in float16)"),
+}
+_NUMERIC_RISK_GUARD_HINT = {
+    "Log":        "clamp with maximum(x, eps) or use log1p",
+    "Rsqrt":      "add an epsilon (rsqrt(x + eps))",
+    "Reciprocal": "add an epsilon or clamp the operand",
+    "Div":        "add an epsilon to the denominator or use div_no_nan",
+    "TrueDiv":    "add an epsilon to the denominator or use div_no_nan",
+    "RealDiv":    "add an epsilon to the denominator or use div_no_nan",
+    "Exp":        "subtract the row max first (log-sum-exp) or clamp",
+}
+_NUMERIC_REDUCE_TYPES = ("Sum", "Mean", "Prod")
+_NUMERIC_LOW_MANTISSA = ("bfloat16", "float16")
+# elements folded into one low-mantissa accumulator before the lost
+# bits (~log2(n) of bf16's 8) start to matter
+_NUMERIC_LONG_AXIS = 1024
+
+
+def _numeric_guarded(tensor, guard_types) -> bool:
+    """True when ``tensor`` is visibly range-restricted: produced by a
+    guard op (possibly through shape/dtype plumbing), by the
+    ``x + eps`` idiom (Add with a Const operand), or a literal Const.
+    A conservative single-path walk — branches in the plumbing stop the
+    search, so the rule under- rather than over-silences."""
+    t = tensor
+    for _ in range(8):
+        op = t.op
+        if op.type in guard_types:
+            return True
+        if op.type == "Const":
+            return True
+        if op.type in ("Add", "AddV2") and any(
+                i.op.type == "Const" for i in op.inputs):
+            return True  # the x + eps idiom
+        if op.type in _NUMERIC_PASSTHROUGH_TYPES and op.inputs:
+            t = op.inputs[0]
+            continue
+        return False
+    return False
+
+
+def _numeric_reduced_elements(op):
+    """Statically known element count folded per output element by a
+    reduce op, or None when any reduced dim is unknown."""
+    if not op.inputs:
+        return None
+    shape = op.inputs[0].shape
+    if shape.rank is None:
+        return None
+    dims = [d.value for d in shape.dims]
+    axis = op.attrs.get("axis")
+    if axis is None:
+        reduced = dims
+    else:
+        axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+        try:
+            reduced = [dims[int(a)] for a in axes]
+        except IndexError:
+            return None
+    n = 1
+    for d in reduced:
+        if d is None:
+            return None
+        n *= int(d)
+    return n
+
+
+@register_lint_rule("numeric-risk", WARNING)
+def _rule_numeric_risk(ctx):
+    """Statically visible NaN/Inf seeds — the offline counterpart of the
+    stf.debug.numerics runtime plane (active only for
+    ``purpose="numerics"`` runs: ``graph_lint --numerics``):
+
+    - a domain-restricted op (Log/Rsqrt/Reciprocal/Div/Exp) whose
+      operand shows no guard on its producer path — no clamp, no
+      ``x + eps``, no max-subtraction for Exp;
+    - a Sum/Mean/Prod reduction over a bfloat16/float16 input folding
+      >= 1024 statically known elements into one low-mantissa
+      accumulator — cast up to float32 before reducing.
+
+    Heuristic by design: a guard hidden behind a multi-input op is not
+    seen (false positive), and a clamp to a still-bad range is trusted
+    (false negative). The runtime plane catches what this misses."""
+    if ctx.purpose != "numerics":
+        return
+    for op in ctx.ops:
+        risk = _NUMERIC_RISK_OPS.get(op.type)
+        if risk is not None and op.outputs \
+                and op.outputs[0].dtype.base_dtype.is_floating:
+            idx, hazard = risk
+            guards = _NUMERIC_EXP_GUARD_TYPES if op.type == "Exp" \
+                else _NUMERIC_GUARD_TYPES
+            if idx < len(op.inputs) and not _numeric_guarded(
+                    op.inputs[idx], guards):
+                operand = "denominator" if idx == 1 else "operand"
+                yield (op,
+                       f"unguarded {op.type} {op.name!r}: {hazard}; "
+                       f"no clamp/epsilon found on the {operand} "
+                       f"({op.inputs[idx].op.name!r}) — "
+                       f"{_NUMERIC_RISK_GUARD_HINT[op.type]}")
+            continue
+        if op.type in _NUMERIC_REDUCE_TYPES and op.inputs:
+            dt = op.inputs[0].dtype.base_dtype.name
+            if dt not in _NUMERIC_LOW_MANTISSA:
+                continue
+            n = _numeric_reduced_elements(op)
+            if n is not None and n >= _NUMERIC_LONG_AXIS:
+                yield (op,
+                       f"{op.type} {op.name!r} folds {n} {dt} elements "
+                       "into one low-mantissa accumulator; precision "
+                       f"drifts by ~log2({n}) of its ~8 mantissa bits "
+                       "— cast to float32 before the reduction")

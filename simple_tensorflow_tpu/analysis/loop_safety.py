@@ -4,11 +4,23 @@ Multi-step fusion compiles N training steps into ONE XLA computation
 (a ``lax.scan`` over device-staged batches, variables threaded through
 the donated carry). That is only sound when the whole per-step plan
 lives inside the device program: a host-stage op (queue dequeue,
-iterator, py_func) would need Python between iterations, a host sink
-(summaries) would need per-step device->host transfers, a
-``Print``-style io op must fire once per step on the host schedule, and
-the CheckNumerics/Assert flag channel must be inspected BEFORE each
-step's state commit — none of which exist inside a fused loop.
+iterator, py_func) would need Python between iterations, an EFFECTFUL
+host sink would need per-step device->host transfers, and a
+``Print``-style io op must fire once per step on the host schedule —
+none of which exist inside a fused loop.
+
+Two deliberate relaxations (the numerics-health plane, docs/DEBUG.md):
+
+- **Pure host sinks** (``OpDef.host_sink_pure`` — summary ops) only
+  OBSERVE device values, so under ``output_mode="last"`` the Session
+  defers them to run ONCE on the window's final-step values instead of
+  splitting the window. A device-side histogram in the train graph no
+  longer costs the fusion. ``output_mode="stacked"`` still falls back
+  (per-step serialization needs every step on the host).
+- **CheckNumerics/Assert** ride the fused window's per-step ys and are
+  inspected AFTER the window's state commit (post-commit detection,
+  same contract as the numerics plane: recovery is checkpoint
+  restore). The old ``numeric_check_op`` fusion blocker is retired.
 
 This module classifies one compiled plan against those rules and
 returns structured :class:`Diagnostic` objects (code
@@ -25,17 +37,13 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
 from . import diagnostics as diag_mod
 from .effects import op_effects
 
-# fallback reason labels (the counter's label vocabulary)
+# fallback reason labels (the counter's label vocabulary; the historic
+# numeric_check_op reason is retired — checks now fuse, see module doc)
 HOST_STAGE_OP = "host_stage_op"
 HOST_SINK_OP = "host_sink_op"
 HOST_EFFECTFUL_OP = "host_effectful_op"
-NUMERIC_CHECK_OP = "numeric_check_op"
 NO_DEVICE_STAGE = "no_device_stage"
 UNINITIALIZED_WRITE = "uninitialized_write"
-
-# flag-channel ops: their failure semantics ("downstream state commits
-# never happen") require host inspection between steps
-_CHECK_OPS = ("CheckNumerics", "Assert")
 
 
 def _written_var_names(device_ops: Sequence[Any]) -> Set[str]:
@@ -97,9 +105,13 @@ def certify_plan(device_ops: Sequence[Any],
               "it runs in the host stage (Python) before the device "
               "program, so each iteration would need a host round-trip")
     for op in post_host_plan:
+        if getattr(op.op_def, "host_sink_pure", False):
+            # pure observers (summary ops): deferred by the Session to
+            # run once per window on last-step values — never a blocker
+            continue
         block(HOST_SINK_OP, op,
-              "it is a host sink consuming device results (summary/"
-              "handle-style op) and would need a per-step device->host "
+              "it is an effectful host sink consuming device results "
+              "(handle-style op) and would need a per-step device->host "
               "transfer")
     missing: List[str] = []
     if variable_store is not None:
@@ -107,11 +119,6 @@ def certify_plan(device_ops: Sequence[Any],
         missing = sorted(n for n in _written_var_names(device_ops)
                          if n not in store)
     for op in device_ops:
-        if op.type in _CHECK_OPS:
-            block(NUMERIC_CHECK_OP, op,
-                  "its failure flag must be inspected on the host before "
-                  "each step's variable updates commit")
-            continue
         eff = op_effects(op)
         if eff.io:
             block(HOST_EFFECTFUL_OP, op,
@@ -120,6 +127,20 @@ def certify_plan(device_ops: Sequence[Any],
     if missing:
         diags.append(uninitialized_write_diag(missing))
     return diags
+
+
+def stacked_host_sink_diag(post_host_plan: Sequence[Any]
+                           ) -> diag_mod.Diagnostic:
+    """``output_mode="stacked"`` with pure host sinks still falls back:
+    serializing a summary PER STEP needs every step's values on the
+    host, which the once-per-window deferred stage cannot provide."""
+    names = [op.name for op in post_host_plan
+             if getattr(op.op_def, "host_sink_pure", False)][:5]
+    return diag_mod.Diagnostic(
+        diag_mod.ERROR, f"loop_fusion/{HOST_SINK_OP}",
+        "output_mode='stacked' needs host sink op(s) "
+        f"({', '.join(names)}) to run once per step; pure sinks defer "
+        "only under output_mode='last'")
 
 
 def fallback_reasons(diags: Sequence[diag_mod.Diagnostic]) -> List[str]:

@@ -32,6 +32,7 @@ implementation.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 import weakref
@@ -689,7 +690,8 @@ class _CompiledStep:
                  "feed_shardings", "fused", "fusion_diags",
                  "sharding_report", "sharding_thread",
                  "sharding_sync_seconds", "sharding_gate", "aot_cache",
-                 "uses_rng", "memory_estimate", "compiled_mem_token")
+                 "uses_rng", "memory_estimate", "compiled_mem_token",
+                 "numerics")
 
     def __init__(self):
         self.n_calls = 0
@@ -748,6 +750,12 @@ class _CompiledStep:
         # never shift the key stream a checkpoint resume must reproduce
         # bit-exactly (stf.checkpoint; docs/CHECKPOINT.md)
         self.uses_rng = True
+        # numerics-health plane (stf.debug.numerics): when the plan was
+        # auto-instrumented, {"mode", "taps", "tensor", "index"} — the
+        # packed [T, 4] health tensor rides device_fetches[index] (and
+        # the fused-window ys) at near-zero cost; None = plane off or
+        # plan not training-shaped
+        self.numerics = None
 
     def join_sharding(self, timeout=10.0):
         """Wait for the overlapped sharding analysis (if any) and return
@@ -1079,6 +1087,23 @@ class BaseSession:
         mode = getattr(self._config, "variable_hazard_mode", None) \
             if self._config is not None else None
         return mode or analysis.get_hazard_mode()
+
+    def _numerics_mode(self) -> str:
+        """Resolved numerics-health mode for this Session's plans:
+        ConfigProto(numerics=...) > the stf.debug.numerics process
+        default / STF_NUMERICS > "off". The process default is read
+        without forcing the debug.numerics import: when the module is
+        not loaded, the env var alone decides (the module reads the
+        same var on first import, so the answers agree)."""
+        mode = getattr(self._config, "numerics", None) \
+            if self._config is not None else None
+        if mode is not None:
+            return mode
+        mod = sys.modules.get("simple_tensorflow_tpu.debug.numerics")
+        if mod is not None:
+            return mod.get_numerics_mode()
+        env = os.environ.get("STF_NUMERICS", "").strip().lower()
+        return env if env in ("metrics", "raise", "dump") else "off"
 
     def _verify_graph_now(self, construction: bool) -> None:
         """graph_analysis="warn"|"strict": verify the session's graph
@@ -1634,6 +1659,13 @@ class BaseSession:
         if missing:
             diags.append(analysis.loop_safety.uninitialized_write_diag(
                 missing))
+        # pure host sinks defer to once-per-window only under "last":
+        # "stacked" must serialize them per step, so it falls back
+        if n > 1 and output_mode == "stacked" and any(
+                getattr(op.op_def, "host_sink_pure", False)
+                for op in step.post_host_plan):
+            diags.append(analysis.loop_safety.stacked_host_sink_diag(
+                step.post_host_plan))
         if diags or n == 1:
             if diags and n > 1:
                 reasons = analysis.loop_safety.fallback_reasons(diags)
@@ -1674,8 +1706,9 @@ class BaseSession:
                              if t in superbatch)
         fused = step.fused.get((n, output_mode, xs_names))
         if fused is None:
-            fused = {"jitted": self._build_fused(step, n, output_mode,
-                                                 xs_names),
+            jitted, fused_msgs = self._build_fused(step, n, output_mode,
+                                                   xs_names)
+            fused = {"jitted": jitted, "check_msgs": fused_msgs,
                      "n_calls": 0}
             step.fused[(n, output_mode, xs_names)] = fused
         const_args = {t.name: self._staged_feed(step, t, const_feeds[t])
@@ -1710,7 +1743,7 @@ class BaseSession:
                 d_t0 = time.perf_counter()
                 with monitoring.traceme("fused_device_execute", n_steps=n):
                     try:
-                        outs, new_state = fused["jitted"](
+                        outs, check_flags, new_state = fused["jitted"](
                             dict(state), const_args, xs_args,
                             self._base_key, ctrs)
                         if trace_buf is not None:
@@ -1731,6 +1764,26 @@ class BaseSession:
                 self._variable_store.sync_ledger()
                 fused["n_calls"] += 1
                 _metric_fused_steps.get_cell().increase_by(n)
+                if check_flags:
+                    # CheckNumerics/Assert rode the scan ys: inspect
+                    # AFTER the window committed (post-commit detection —
+                    # the documented relaxation that lets checks fuse;
+                    # recovery is checkpoint restore)
+                    import jax
+
+                    fl = np.stack([np.asarray(f) for f in
+                                   jax.device_get(list(check_flags))])
+                    if fl.any():
+                        step_bad = fl.any(axis=0)
+                        k = int(np.argmax(step_bad))
+                        bad = [m for m, f in zip(fused["check_msgs"],
+                                                 fl[:, k]) if f]
+                        raise errors.InvalidArgumentError(
+                            None, None,
+                            "; ".join(bad) + f" (first failed at fused "
+                            f"window step {k} of {n}; state committed "
+                            "through the window — restore a checkpoint "
+                            "to recover)")
                 if deadline is not None:
                     # state committed above: a deadline abort is detection
                     # only and leaves the session consistent
@@ -1741,8 +1794,51 @@ class BaseSession:
                     _metric_compile_seconds.get_cell().add(
                         time.perf_counter() - d_t0)
 
+            # numerics plane: observe every step of the window (the
+            # health fetch kept its per-step axis), AFTER the commit
+            # and outside the lock — forensics/raise per mode
+            if (step.numerics is not None
+                    and step.numerics["index"] is not None):
+                self._observe_numerics_window(step, outs, const_args,
+                                              xs_args, state, ctrs, n)
+
             dev_pos = {t: i for i, t in enumerate(step.device_fetches)}
             stacked = output_mode == "stacked"
+            num_idx = step.numerics["index"] \
+                if step.numerics is not None else None
+
+            # Post-host stage, ONCE per window ("last" mode only —
+            # "stacked" plans with host sinks fell back above): pure
+            # host sinks (host_sink_pure summary ops) consume the
+            # window's final-step device values, so a histogram in the
+            # train graph no longer splits the fused window.
+            host_env: Dict[Tensor, Any] = {}
+            if step.post_host_plan:
+                with monitoring.traceme(
+                        "post_host_stage",
+                        n_ops=len(step.post_host_plan)):
+                    pctx = lowering_mod.LoweringContext(
+                        self._variable_store.values, rng_root=None,
+                        host=True, session=self)
+                    pctx.alias = step.alias
+                    pctx.func_plans = step.func_plans
+                    pctx.env.update(step.const_env)
+                    pctx.env.update(const_feeds)
+                    for t, v in superbatch.items():
+                        pctx.env[t] = v[-1]
+                    for t in step.post_host_inputs:
+                        v = outs[dev_pos[t]]
+                        if dev_pos[t] == num_idx:
+                            v = v[-1]
+                        if t in step.raw_post_inputs:
+                            pctx.env[t] = v
+                        else:
+                            pctx.env[t] = (np.asarray(v)
+                                           if t.dtype.name != "string"
+                                           else v)
+                    lowering_mod.execute_ops(pctx, step.post_host_plan,
+                                             fed=set(pctx.env))
+                    host_env = pctx.env
 
             def _per_step_const(v):
                 v = np.asarray(v)
@@ -1760,8 +1856,14 @@ class BaseSession:
                     v = superbatch[e]
                     values.append(np.asarray(v) if stacked
                                   else np.asarray(v[-1]))
-                elif r in dev_pos:
+                elif r in dev_pos and r not in host_env:
                     v = outs[dev_pos[r]]
+                    if not stacked and dev_pos[r] == num_idx:
+                        v = v[-1]  # health kept its per-step axis
+                    values.append(v if e.dtype.name == "string"
+                                  else np.asarray(v))
+                elif r in host_env:
+                    v = host_env[r]
                     values.append(v if e.dtype.name == "string"
                                   else np.asarray(v))
                 elif r in step.const_env:
@@ -1853,7 +1955,15 @@ class BaseSession:
         per-step RNG counters. Per-step keys are derived inside the
         program (fold_in(root, counter)) exactly as the single-step path
         does, so a fused window is bit-compatible with n sequential
-        runs."""
+        runs.
+
+        Returns ``(jitted, check_msgs)``: the executable yields
+        ``(outs, check_flags, final_state)`` where ``check_flags`` is a
+        tuple of per-step ``[n]`` booleans, one per CheckNumerics/Assert
+        in the plan (index-aligned with ``check_msgs``, filled at trace
+        time). Checks ride the scan ys — fusion is never broken for
+        them; the caller inspects the flags AFTER the window's state
+        commit (post-commit detection, like the numerics plane)."""
         import jax
         import jax.numpy as jnp
 
@@ -1863,6 +1973,14 @@ class BaseSession:
         plan_alias = step.alias
         plan_consts = step.const_env
         plan_func_plans = step.func_plans
+        check_msgs: List[str] = []  # filled at trace time, index-aligned
+        num_info = step.numerics
+        # the health tensor keeps its per-step leading axis even under
+        # "last": the observer needs every step's stats to localize the
+        # exact anomalous step inside the window
+        keep_stacked = {num_info["index"]} \
+            if num_info is not None and num_info["index"] is not None \
+            else set()
 
         def fused_fn(state, const_args, xs_args, rng_root, ctrs):
             def body(carry, x):
@@ -1882,17 +2000,26 @@ class BaseSession:
                 lowering_mod.execute_ops(ctx, device_ops,
                                          fed=set(boundary))
                 fetch_vals = tuple(ctx.env[t] for t in device_fetches)
-                return ctx.state, fetch_vals
+                check_msgs.clear()  # jit may trace more than once
+                check_msgs.extend(m for m, _ in ctx.numeric_checks)
+                flags = tuple(f for _, f in ctx.numeric_checks)
+                return ctx.state, (fetch_vals, flags)
 
-            final_state, stacked = jax.lax.scan(
+            final_state, (stacked, flags) = jax.lax.scan(
                 body, dict(state), (xs_args, ctrs), length=n)
             if output_mode == "last":
-                outs = tuple(v[-1] for v in stacked)
+                outs = tuple(v if i in keep_stacked else v[-1]
+                             for i, v in enumerate(stacked))
             else:
                 outs = stacked
-            return outs, final_state
+            return outs, flags, final_state
 
-        return jax.jit(fused_fn, donate_argnums=(0,))
+        # numerics "dump" replays the window eagerly from the retained
+        # window-entry state (bisect_window_and_dump) — donation off;
+        # every other mode keeps the in-place HBM carry
+        donate = () if (num_info is not None
+                        and num_info["mode"] == "dump") else (0,)
+        return jax.jit(fused_fn, donate_argnums=donate), check_msgs
 
     def _normalize_feeds(self, feed_dict) -> Dict[Tensor, np.ndarray]:
         feeds: Dict[Tensor, np.ndarray] = {}
@@ -2148,6 +2275,16 @@ class BaseSession:
                     rep = step.join_sharding()
                     if rep is not None:
                         collector["sharding_report"] = rep
+            # numerics plane: inspect the packed health tensor AFTER
+            # the commit (outside the lock — forensics must not block
+            # concurrent steps). State through this step is already
+            # committed; "raise" tells the user to restore a
+            # checkpoint, "dump" re-executes from the retained
+            # pre-step state to localize the first bad op.
+            if (step.numerics is not None
+                    and step.numerics["index"] is not None):
+                self._observe_numerics(step, device_results, feed_args,
+                                       state, rng_key, rng_ctr)
 
         dev_map = dict(zip(step.device_fetches, device_results))
 
@@ -2224,6 +2361,107 @@ class BaseSession:
                     raise errors.InternalError(
                         None, e.op, f"Fetch {e.name} produced no value")
         return out
+
+    def _observe_numerics(self, step, device_results, feed_args, state,
+                          rng_key, rng_ctr):
+        """Post-commit numerics-health observer for a plain (unfused)
+        step: pull the packed [T, 4] health tensor off the fetch
+        channel, feed the process HealthPlane (/stf/train/* metrics,
+        /trainz), and on an anomaly run the mode's escalation — flight
+        recorder event, first-bad-op bisector + dump ("dump"),
+        structured raise ("raise"/"dump")."""
+        import jax
+
+        from ..debug import numerics as numerics_mod
+
+        info = step.numerics
+        health = np.asarray(
+            jax.device_get(device_results[info["index"]]))
+        plane = numerics_mod.get_plane()
+        anomaly = plane.record_step(info["taps"], health,
+                                    step=int(rng_ctr))
+        if anomaly is None:
+            return
+        bad_op = dump_root = None
+        if info["mode"] == "dump":
+            try:
+                bad_op, dump_root = numerics_mod.bisect_and_dump(
+                    self, step, feed_args, state, rng_key, int(rng_ctr),
+                    anomaly)
+                plane.note_forensics(
+                    first_bad_op=bad_op.name if bad_op else None,
+                    dump_root=dump_root)
+            except Exception as e:  # noqa: BLE001 — forensics advisory
+                from ..platform import tf_logging as logging
+
+                logging.warning(
+                    "numerics: first-bad-op bisector failed: %s: %s",
+                    type(e).__name__, e)
+        self._record_numeric_event(anomaly, bad_op, dump_root)
+        if info["mode"] in ("raise", "dump"):
+            numerics_mod.raise_anomaly(anomaly, bad_op=bad_op,
+                                       dump_root=dump_root)
+
+    def _observe_numerics_window(self, step, outs, const_args, xs_args,
+                                 pre_state, ctrs, n):
+        """Post-commit observer for a fused N-step window: the health
+        fetch keeps its per-step leading axis ([n, T, 4]) even under
+        "last" output mode, so EVERY step in the window is recorded
+        (the history ring and anomaly step index stay exact). The
+        FIRST anomalous step drives forensics/raise."""
+        import jax
+
+        from ..debug import numerics as numerics_mod
+
+        info = step.numerics
+        health = np.asarray(jax.device_get(outs[info["index"]]))
+        plane = numerics_mod.get_plane()
+        first_anomaly = None
+        bad_index = None
+        for i in range(int(n)):
+            anomaly = plane.record_step(info["taps"], health[i],
+                                        step=int(ctrs[i]),
+                                        window_index=i)
+            if anomaly is not None and first_anomaly is None:
+                first_anomaly = anomaly
+                bad_index = i
+        if first_anomaly is None:
+            return
+        bad_op = dump_root = None
+        if info["mode"] == "dump":
+            try:
+                bad_op, dump_root = numerics_mod.bisect_window_and_dump(
+                    self, step, const_args, xs_args, pre_state,
+                    self._base_key, ctrs, bad_index, first_anomaly)
+                plane.note_forensics(
+                    first_bad_op=bad_op.name if bad_op else None,
+                    dump_root=dump_root)
+            except Exception as e:  # noqa: BLE001 — forensics advisory
+                from ..platform import tf_logging as logging
+
+                logging.warning(
+                    "numerics: fused-window bisector failed: %s: %s",
+                    type(e).__name__, e)
+        self._record_numeric_event(first_anomaly, bad_op, dump_root)
+        if info["mode"] in ("raise", "dump"):
+            numerics_mod.raise_anomaly(first_anomaly, bad_op=bad_op,
+                                       dump_root=dump_root)
+
+    @staticmethod
+    def _record_numeric_event(anomaly, bad_op, dump_root):
+        rec = _flight_mod.get_recorder()
+        if not rec.enabled:
+            return
+        rec.record(
+            "numeric", step=anomaly["step"],
+            window_index=anomaly.get("window_index"),
+            n_bad_taps=len(anomaly["taps"]),
+            taps=[{"name": b["name"], "kind": b["kind"],
+                   "nonfinite_count": b["nonfinite_count"],
+                   "max_abs": b["max_abs"]}
+                  for b in anomaly["taps"][:8]],
+            first_bad_op=bad_op.name if bad_op is not None else None,
+            dump_root=dump_root)
 
     def _transfer_guard(self, name: str, nbytes: int, direction: str):
         """L0 transfer guard (SURVEY §1 L0): per-step host↔device
@@ -2682,6 +2920,34 @@ class BaseSession:
                 raise errors.InvalidArgumentError(
                     None, None, analysis.format_report(
                         errs, header="plan verification failed:"))
+        # numerics-health plane (ISSUE 17; stf.debug.numerics): when the
+        # resolved mode is not "off" and this plan is training-shaped (a
+        # device op writes a variable), splice NumericSummary taps over
+        # gradients/updates/loss (+ numerics_taps activation patterns)
+        # and one Pack producing the [T, 4] health tensor. Ops are
+        # spliced at plan time (the __autoshard_constraints__ idiom), so
+        # they fuse into the step program and ride fused windows —
+        # advisory: an instrumentation failure logs, never sinks a plan.
+        num_mode = self._numerics_mode()
+        if num_mode != "off":
+            try:
+                from ..debug import numerics as _numerics_mod
+
+                patterns = tuple(getattr(
+                    self._config, "numerics_taps", ()) or ())
+                pruned, tap_table, health_t = _numerics_mod.instrument_plan(
+                    self._graph, pruned, fed_set, fetch_tensors, alias,
+                    const_env, patterns=patterns)
+                if tap_table:
+                    step.numerics = {"mode": num_mode, "taps": tap_table,
+                                     "tensor": health_t, "index": None}
+                    _numerics_mod.get_plane().set_taps(tap_table)
+            except Exception as e:  # noqa: BLE001 — advisory plane
+                from ..platform import tf_logging as logging
+
+                logging.warning(
+                    "numerics plane: instrumentation failed, plan runs "
+                    "uninstrumented: %s: %s", type(e).__name__, e)
         # staging/partitioning timing starts AFTER the analysis block:
         # the "lower" span must not double-count the "analysis" span
         lower_t0 = time.perf_counter()
@@ -2809,6 +3075,18 @@ class BaseSession:
                 device_fetches.append(t)
         step.device_fetches = device_fetches
         step.device_ops = device_ops
+        # numerics plane: the packed health tensor rides the normal
+        # fetch channel (16·T bytes/step — the whole point: no extra
+        # device_get, no fused-window split); record its slot so the
+        # post-commit observer can find it
+        if step.numerics is not None:
+            ht = step.numerics["tensor"]
+            if ht.op in device_op_set:
+                if ht not in device_fetches:
+                    device_fetches.append(ht)
+                step.numerics["index"] = device_fetches.index(ht)
+            else:  # defensive: taps pruned away / host-staged
+                step.numerics = None
         # static fetch sizes for the transfer guard (computed once here,
         # not per step; None num_elements = dynamic shape, unguarded)
         step.fetch_nbytes = [
@@ -2882,6 +3160,12 @@ class BaseSession:
         # deleted arrays.
         has_checks = any(op.type in ("CheckNumerics", "Assert")
                          for op in device_ops)
+        # numerics "dump" re-executes the failing step eagerly from the
+        # PRE-step state to bisect the first bad op — that state must
+        # survive the step, so donation is off. "metrics"/"raise" are
+        # post-commit observers and keep the donation fast path.
+        if step.numerics is not None and step.numerics["mode"] == "dump":
+            has_checks = True
         step.jitted = jax.jit(step_fn,
                               donate_argnums=() if has_checks else (0,))
         step.check_msgs = check_msgs

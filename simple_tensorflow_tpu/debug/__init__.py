@@ -12,12 +12,17 @@ execution is one XLA program, so debugging hooks differently:
   list/query/filter (has_inf_or_nan) across runs, with a CLI
   (`python -m simple_tensorflow_tpu.debug.analyzer`) — the analog of
   tfdbg's analyzer/CLI layer (ref python/debug/lib + cli).
+- numerics (debug/numerics.py): the training numerics-health plane —
+  device-side NumericSummary taps, /stf/train/* metrics + /trainz,
+  first-bad-op bisector + tfdbg-style dumps (docs/DEBUG.md).
 """
 
 from .analyzer import DebugDumpDir, DebugTensorDatum
 from .cli import AnalyzerCLI
 from .io_utils import (DebugListener, DebugSink, FileSink, SocketSink,
                        publish_debug_tensor, sink_for_url)
+from .numerics import (HealthPlane, get_numerics_mode, get_plane,
+                       set_numerics_mode, trainz_info)
 from .wrappers import (DumpingDebugWrapperSession, LocalCLIDebugWrapperSession,
                        TensorWatch, add_check_numerics_ops,
                        has_inf_or_nan)

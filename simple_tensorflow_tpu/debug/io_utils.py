@@ -54,14 +54,14 @@ class FileSink(DebugSink):
         os.makedirs(root, exist_ok=True)
         self._manifests: Dict[int, Dict[str, Any]] = {}
 
-    def publish(self, run_index, name, value):
+    def publish(self, run_index, name, value, **meta):
         run_dir = os.path.join(self._root, f"run_{run_index}")
         os.makedirs(run_dir, exist_ok=True)
         safe = name.replace("/", "_").replace(":", "_")
         arr = np.asarray(value)
         np.save(os.path.join(run_dir, safe + ".npy"), arr)
         man = self._manifests.setdefault(run_index, {})
-        man[name] = {"file": safe + ".npy"}
+        man[name] = {"file": safe + ".npy", **meta}
         with open(os.path.join(run_dir, "manifest.json"), "w") as f:
             json.dump({"time": time.time(), "tensors": man}, f, indent=1)
 

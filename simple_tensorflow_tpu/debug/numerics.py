@@ -1,0 +1,564 @@
+"""stf.debug.numerics: the training numerics-health plane.
+
+A NaN in step 40k of a fused window classically surfaces as a diverged
+loss curve days later. This module makes it a first-class observable:
+Session plans that look like training steps (device ops writing
+variables) are auto-instrumented with device-side ``NumericSummary``
+taps (ops/numerics.py) over gradients, optimizer updates, the loss, and
+any activation matched by a name-pattern selector. Each tap reduces to
+a packed ``[nonfinite_count, max_abs, l2_norm, zero_fraction]`` float32
+vector INSIDE the compiled program; the packed health tensor is one
+tiny extra device fetch that threads fused ``lax.scan`` windows
+unchanged — fusion is never broken for health (the old
+``numeric_check_op`` fusion blocker is retired by this plane).
+
+Modes (ConfigProto(numerics=...) > ``STF_NUMERICS`` env > process
+default via :func:`set_numerics_mode`):
+
+- ``off``      — no instrumentation (default).
+- ``metrics``  — per-step health feeds the ``/stf/train/*`` metric
+  family and the ``/trainz`` telemetry endpoint (history ring +
+  last-anomaly report).
+- ``raise``    — metrics, plus a structured ``InvalidArgumentError``
+  naming the first nonfinite tap, its producing op, and the op's
+  user-code creation traceback. Detection is AFTER the step's state
+  commit (that is what makes the plane near-free); recovery is
+  checkpoint restore, which is bit-exact for deterministic plans.
+- ``dump``     — raise, plus the one-shot **first-bad-op bisector**:
+  the failing plan is re-executed eagerly (op-at-a-time, outside jit)
+  from the retained pre-step state, the earliest op producing a
+  nonfinite from all-finite inputs is localized exactly (for fused
+  windows the offending step is replayed first), and its input/output
+  tensors are written as a tfdbg-style dump directory
+  (``run_0/<tensor>.npy`` + manifest) readable by
+  ``debug/analyzer.py`` and ``tools/health_inspect.py`` — plus a
+  flight-recorder ``numeric`` event carrying the health snapshot.
+
+See docs/DEBUG.md for the dump format and CLI walkthrough.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework import errors
+from ..ops.numerics import STAT_NAMES, STATS_WIDTH
+from ..platform import monitoring
+
+MODES = ("off", "metrics", "raise", "dump")
+
+# Tap-count ceiling per plan: each tap is ~4 flops/element on device
+# plus 16 fetched bytes — a transformer's every activation would be
+# noise; truncation is LOGGED (no silent caps).
+MAX_TAPS = 64
+
+_process_mode: Optional[str] = None
+_mode_lock = threading.Lock()
+
+
+def set_numerics_mode(mode: Optional[str]) -> Optional[str]:
+    """Set the process-default numerics mode (None re-enables the
+    ``STF_NUMERICS`` environment variable); returns the previous
+    setting."""
+    global _process_mode
+    if mode is not None and mode not in MODES:
+        raise ValueError(f"numerics mode must be one of {MODES} or None, "
+                         f"got {mode!r}")
+    with _mode_lock:
+        prev = _process_mode
+        _process_mode = mode
+    return prev
+
+
+def get_numerics_mode() -> str:
+    """Resolved process-default mode: set_numerics_mode() if set, else
+    ``STF_NUMERICS``, else "off"."""
+    with _mode_lock:
+        if _process_mode is not None:
+            return _process_mode
+    env = os.environ.get("STF_NUMERICS", "").strip().lower()
+    return env if env in MODES else "off"
+
+
+def resolve_mode(config) -> str:
+    """The mode one Session runs under: ConfigProto(numerics=...) wins,
+    else the process default."""
+    m = getattr(config, "numerics", None) if config is not None else None
+    return m if m in MODES else get_numerics_mode()
+
+
+# ---------------------------------------------------------------------------
+# /stf/train/* metric family (docs/OBSERVABILITY.md "Training health")
+# ---------------------------------------------------------------------------
+
+_metric_health_steps = monitoring.Counter(
+    "/stf/train/health_steps",
+    "training steps observed by the numerics-health plane (one count "
+    "per step, fused or not)")
+_metric_nonfinite = monitoring.Counter(
+    "/stf/train/nonfinite_events",
+    "tap observations containing NaN/Inf, by tap kind "
+    "(gradient|update|loss|activation)", "kind")
+_metric_grad_norm = monitoring.Sampler(
+    "/stf/train/grad_norm",
+    monitoring.ExponentialBuckets(1e-8, 10.0, 20),
+    "global gradient L2 norm per observed step (sqrt of the sum of "
+    "squared per-tap norms over gradient taps)")
+_metric_update_ratio = monitoring.Sampler(
+    "/stf/train/update_ratio",
+    monitoring.ExponentialBuckets(1e-8, 10.0, 20),
+    "global optimizer-update norm / global gradient norm per observed "
+    "step (recorded only when both tap kinds exist)")
+
+
+# ---------------------------------------------------------------------------
+# the process-global health plane (/trainz's data source)
+# ---------------------------------------------------------------------------
+
+class HealthPlane:
+    """Per-process training-health state: a bounded per-step history
+    ring plus the last-anomaly report. One instance per process (like
+    the flight recorder) — /trainz renders exactly this object."""
+
+    HISTORY = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._history = collections.deque(maxlen=self.HISTORY)
+        self._steps = 0
+        self._anomalies = 0
+        self.last_anomaly: Optional[Dict[str, Any]] = None
+        self.taps: List[Dict[str, Any]] = []
+
+    def set_taps(self, tap_table: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            self.taps = list(tap_table)
+
+    def record_step(self, tap_table: Sequence[Dict[str, Any]],
+                    stats: np.ndarray, step: int,
+                    window_index: Optional[int] = None
+                    ) -> Optional[Dict[str, Any]]:
+        """Observe one step's packed health tensor (``[T, 4]``). Updates
+        metrics + history; returns the anomaly record when any tap saw
+        a nonfinite, else None."""
+        stats = np.asarray(stats, dtype=np.float64).reshape(
+            len(tap_table), STATS_WIDTH)
+        grad_sq = upd_sq = 0.0
+        has_grad = has_upd = False
+        bad: List[Dict[str, Any]] = []
+        for tap, row in zip(tap_table, stats):
+            if tap["kind"] == "gradient":
+                grad_sq += row[2] ** 2
+                has_grad = True
+            elif tap["kind"] == "update":
+                upd_sq += row[2] ** 2
+                has_upd = True
+            if row[0] > 0:
+                bad.append({**tap, "nonfinite_count": int(row[0]),
+                            "max_abs": float(row[1]),
+                            "l2_norm": float(row[2]),
+                            "zero_fraction": float(row[3])})
+        _metric_health_steps.get_cell().increase_by(1)
+        grad_norm = float(np.sqrt(grad_sq)) if has_grad else None
+        if grad_norm is not None:
+            _metric_grad_norm.get_cell().add(grad_norm)
+        upd_norm = float(np.sqrt(upd_sq)) if has_upd else None
+        ratio = None
+        if grad_norm is not None and upd_norm is not None:
+            ratio = upd_norm / max(grad_norm, 1e-12)
+            _metric_update_ratio.get_cell().add(ratio)
+        entry = {
+            "step": int(step), "time": time.time(),
+            "nonfinite_taps": len(bad),
+            "grad_norm": grad_norm, "update_norm": upd_norm,
+            "update_ratio": ratio,
+            "max_abs": float(np.max(stats[:, 1])) if stats.size else 0.0,
+        }
+        if window_index is not None:
+            entry["window_index"] = int(window_index)
+        anomaly = None
+        if bad:
+            for b in bad:
+                _metric_nonfinite.get_cell(b["kind"]).increase_by(1)
+            anomaly = {"step": int(step), "time": entry["time"],
+                       "taps": bad}
+            if window_index is not None:
+                anomaly["window_index"] = int(window_index)
+        with self._lock:
+            self._steps += 1
+            self._history.append(entry)
+            if anomaly is not None:
+                self._anomalies += 1
+                self.last_anomaly = anomaly
+        return anomaly
+
+    def note_forensics(self, **fields) -> None:
+        """Attach bisector results (first bad op, dump dir) to the
+        last-anomaly report so /trainz shows where the dump went."""
+        with self._lock:
+            if self.last_anomaly is not None:
+                self.last_anomaly.update(fields)
+
+    def info(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "mode": get_numerics_mode(),
+                "steps_observed": self._steps,
+                "anomalies": self._anomalies,
+                "taps": list(self.taps),
+                "history": list(self._history),
+                "last_anomaly": self.last_anomaly,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._history.clear()
+            self._steps = 0
+            self._anomalies = 0
+            self.last_anomaly = None
+            self.taps = []
+
+
+_plane: Optional[HealthPlane] = None
+_plane_lock = threading.Lock()
+
+
+def get_plane() -> HealthPlane:
+    global _plane
+    with _plane_lock:
+        if _plane is None:
+            _plane = HealthPlane()
+        return _plane
+
+
+def trainz_info() -> Dict[str, Any]:
+    """The /trainz payload (telemetry/server.py)."""
+    return get_plane().info()
+
+
+# ---------------------------------------------------------------------------
+# tap selection + plan instrumentation (the auto-instrumentation pass)
+# ---------------------------------------------------------------------------
+
+def _writes_variable(op) -> bool:
+    from ..analysis.effects import op_effects
+
+    return any(w.startswith("var_name=")
+               for w in op_effects(op).writes)
+
+
+def _float_tensor(t) -> bool:
+    try:
+        return t.dtype.is_floating
+    except Exception:
+        return False
+
+
+def select_taps(pruned, fed_set, fetch_tensors, alias, const_env,
+                patterns=()) -> List[Tuple[Any, str]]:
+    """Choose the tensors the plane watches, as (tensor, kind) pairs in
+    deterministic plan order. Kinds: gradient (SymbolicGradient
+    outputs), update (float operands of variable-writing device ops),
+    loss (scalar float fetches), activation (op-name regex matches —
+    the match_partition_rules idiom)."""
+    def rsv(t):
+        return alias.get(t, t)
+
+    taps: List[Tuple[Any, str]] = []
+    seen = set()
+
+    def add(t, kind):
+        t = rsv(t)
+        if (t in seen or t in fed_set or t in const_env
+                or not _float_tensor(t) or t.op.op_def.runs_on_host
+                or t.op.type in ("NumericSummary", "Const"))\
+                or t.op.name.startswith("numerics_health"):
+            return
+        seen.add(t)
+        taps.append((t, kind))
+
+    compiled = [re.compile(p) for p in (patterns or ())]
+    for op in pruned:
+        if op.op_def.runs_on_host:
+            continue
+        if op.type == "SymbolicGradient":
+            for o in op.outputs:
+                add(o, "gradient")
+        if _writes_variable(op):
+            for t in op.inputs:
+                if rsv(t).op.type != "VariableV2":
+                    add(t, "update")
+        if compiled and any(p.search(op.name) for p in compiled):
+            for o in op.outputs:
+                add(o, "activation")
+    for t in fetch_tensors:
+        r = rsv(t)
+        if r.shape.rank == 0 and not r.op.op_def.runs_on_host:
+            add(r, "loss")
+    return taps
+
+
+def instrument_plan(graph, pruned, fed_set, fetch_tensors, alias,
+                    const_env, patterns=()):
+    """The auto-instrumentation pass over one pruned plan. Returns
+    ``(new_pruned, tap_table, health_tensor)`` — or
+    ``(pruned, None, None)`` when the plan is not training-shaped (no
+    device op writes a variable) or nothing is tappable. Created graph
+    ops are cached in the graph's scoped state (the autoshard-
+    constraints idiom) so re-planning reuses them."""
+    if not any(not op.op_def.runs_on_host and _writes_variable(op)
+               for op in pruned):
+        return pruned, None, None
+    taps = select_taps(pruned, fed_set, fetch_tensors, alias, const_env,
+                       patterns)
+    if not taps:
+        return pruned, None, None
+    if len(taps) > MAX_TAPS:
+        from ..platform import tf_logging as logging
+
+        logging.warning(
+            "numerics: plan has %d tappable tensors; watching the first "
+            "%d (raise debug.numerics.MAX_TAPS or narrow numerics_taps "
+            "patterns to change the set)", len(taps), MAX_TAPS)
+        taps = taps[:MAX_TAPS]
+
+    from ..framework import dtypes as dtypes_mod
+    from ..framework import tensor_shape as shape_mod
+
+    reg = graph._scoped_state.setdefault("__numerics_taps__", {})
+    summaries = []
+    tap_table: List[Dict[str, Any]] = []
+    for t, kind in taps:
+        sop = reg.get(t)
+        if sop is None:
+            sop = graph.create_op(
+                "NumericSummary", [t], attrs={},
+                name=f"numerics_health/summary_{len(reg)}",
+                output_specs=[(shape_mod.TensorShape([STATS_WIDTH]),
+                               dtypes_mod.float32)])
+            reg[t] = sop
+        summaries.append(sop)
+        tap_table.append({"name": t.name, "kind": kind,
+                          "op": t.op.name, "op_type": t.op.type,
+                          "site": t.op.source_site})
+    pack_reg = graph._scoped_state.setdefault("__numerics_packs__", {})
+    pack_key = tuple(t.name for t, _ in taps)
+    pack = pack_reg.get(pack_key)
+    if pack is None:
+        pack = graph.create_op(
+            "Pack", [s.outputs[0] for s in summaries], attrs={"axis": 0},
+            name="numerics_health/pack",
+            output_specs=[(shape_mod.TensorShape([len(taps),
+                                                  STATS_WIDTH]),
+                           dtypes_mod.float32)])
+        pack_reg[pack_key] = pack
+    in_plan = set(pruned)
+    new_ops = [op for op in summaries if op not in in_plan]
+    if pack not in in_plan:
+        new_ops.append(pack)
+    # appended at the END of the plan: every tap input is produced
+    # earlier, so topo order holds, and env values are read by Tensor
+    # key — later variable writes can never alias a tap's value
+    return list(pruned) + new_ops, tap_table, pack.outputs[0]
+
+
+# ---------------------------------------------------------------------------
+# anomaly surfacing: structured raise, bisector, dump writer
+# ---------------------------------------------------------------------------
+
+def _format_site(tap: Dict[str, Any]) -> str:
+    site = tap.get("site")
+    return f" (created at {site})" if site else ""
+
+
+def format_anomaly(anomaly: Dict[str, Any],
+                   extra: str = "") -> str:
+    lines = [f"numerics: nonfinite values detected at step "
+             f"{anomaly['step']}"
+             + (f" (fused window index {anomaly['window_index']})"
+                if "window_index" in anomaly else "") + ":"]
+    for b in anomaly["taps"][:8]:
+        lines.append(
+            f"  tap {b['name']} [{b['kind']}] from op {b['op']} "
+            f"({b['op_type']}): {b['nonfinite_count']} nonfinite, "
+            f"max_abs={b['max_abs']:.6g}{_format_site(b)}")
+    if len(anomaly["taps"]) > 8:
+        lines.append(f"  ... and {len(anomaly['taps']) - 8} more taps")
+    lines.append("state through this step is committed; restore the "
+                 "last checkpoint to recover")
+    if extra:
+        lines.append(extra)
+    return "\n".join(lines)
+
+
+def _to_float_np(v) -> Optional[np.ndarray]:
+    if v is None:
+        return None
+    arr = np.asarray(v)
+    if arr.dtype.kind == "f":
+        return arr
+    if "float" in str(arr.dtype):  # bfloat16 & friends (ml_dtypes)
+        return arr.astype(np.float32)
+    return None
+
+
+def _all_finite(v) -> bool:
+    arr = _to_float_np(v)
+    if arr is None:
+        return True
+    return bool(np.all(np.isfinite(arr)))
+
+
+def _eager_execute(session, step, feed_args, state, rng_key, run_idx):
+    """Re-execute one step's device plan eagerly (op-at-a-time, outside
+    jit) so every op's concrete outputs are observable in the env."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import lowering as lowering_mod
+
+    rng = (jax.random.fold_in(rng_key, np.uint32(run_idx))
+           if rng_key is not None else None)
+    ctx = lowering_mod.LoweringContext(dict(state), rng_root=rng,
+                                       session=session)
+    ctx.alias = step.alias
+    ctx.func_plans = step.func_plans
+    for t, v in step.const_env.items():
+        if t.dtype.name != "string":
+            ctx.env[t] = jnp.asarray(v)
+    for t in step.feed_tensors:
+        ctx.env[t] = feed_args[t.name]
+    lowering_mod.execute_ops(ctx, step.device_ops,
+                             fed=set(step.feed_tensors))
+    return ctx
+
+
+def first_bad_op(device_ops, ctx, feed_tensors=()):
+    """Walk the eagerly-executed plan in topo order; the FIRST op whose
+    float outputs contain a nonfinite while every float input is finite
+    is where the poison entered. A nonfinite FEED short-circuits to the
+    placeholder op (the poison arrived from outside the program).
+    Returns (op, inputs, outputs) with (tensor, value) pairs, or
+    (None, [], [])."""
+    for t in feed_tensors:
+        v = ctx.env.get(t)
+        if not _all_finite(v):
+            return t.op, [], [(t, v)]
+    for op in device_ops:
+        outs = [(o, ctx.env[o]) for o in op.outputs if o in ctx.env]
+        if not outs or all(_all_finite(v) for _, v in outs):
+            continue
+        ins = []
+        for t in op.inputs:
+            t = ctx.alias.get(t, t) if ctx.alias else t
+            ins.append((t, ctx.env.get(t)))
+        if all(_all_finite(v) for _, v in ins):
+            return op, ins, outs
+    return None, [], []
+
+
+def default_dump_root() -> str:
+    root = os.environ.get("STF_NUMERICS_DUMP_ROOT")
+    if root:
+        os.makedirs(root, exist_ok=True)
+        return tempfile.mkdtemp(prefix="numerics_", dir=root)
+    return tempfile.mkdtemp(prefix="stf_numerics_")
+
+
+def write_dump(dump_root, bad_op, ins, outs, anomaly,
+               window_index=None) -> str:
+    """Write the bisector's findings as a tfdbg-style dump dir
+    (run_0/<tensor>.npy + manifest.json — the exact layout
+    debug/analyzer.py DebugDumpDir reads) plus a bisect_report.json."""
+    from .io_utils import FileSink
+
+    sink = FileSink(dump_root)
+    for t, v in list(ins) + list(outs):
+        arr = _to_float_np(v)
+        if arr is None:
+            if v is None:
+                continue
+            arr = np.asarray(v)
+            flagged = False
+        else:
+            flagged = not bool(np.all(np.isfinite(arr)))
+        sink.publish(0, t.name, arr, has_inf_or_nan=flagged)
+    report = {
+        "first_bad_op": bad_op.name if bad_op is not None else None,
+        "op_type": bad_op.type if bad_op is not None else None,
+        "site": bad_op.source_site if bad_op is not None else None,
+        "traceback": [list(f) for f in (bad_op.traceback or ())][:10]
+        if bad_op is not None else [],
+        "inputs": [t.name for t, _ in ins],
+        "outputs": [t.name for t, _ in outs],
+        "anomaly": anomaly,
+    }
+    if window_index is not None:
+        report["window_index"] = int(window_index)
+    with open(os.path.join(dump_root, "bisect_report.json"), "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    return dump_root
+
+
+def bisect_and_dump(session, step, feed_args, state, rng_key, run_idx,
+                    anomaly) -> Tuple[Optional[Any], Optional[str]]:
+    """dump-mode forensics for a plain (unfused) step: re-execute
+    eagerly from the retained pre-step state, localize the first bad
+    op, write the dump dir. Returns (bad_op, dump_root)."""
+    ctx = _eager_execute(session, step, feed_args, state, rng_key,
+                         run_idx)
+    bad_op, ins, outs = first_bad_op(step.device_ops, ctx,
+                                     step.feed_tensors)
+    root = default_dump_root()
+    write_dump(root, bad_op, ins, outs, anomaly)
+    return bad_op, root
+
+
+def bisect_window_and_dump(session, step, const_args, xs_args, pre_state,
+                           rng_key, ctrs, bad_index, anomaly
+                           ) -> Tuple[Optional[Any], Optional[str]]:
+    """dump-mode forensics for a fused window: eagerly replay steps
+    0..bad_index from the retained window-entry state (same fold_in
+    counters, same per-step feed slices — bit-compatible with the scan
+    body), then bisect the offending step."""
+    state = dict(pre_state)
+    ctx = None
+    for i in range(int(bad_index) + 1):
+        feed_args = {}
+        for name, v in const_args.items():
+            feed_args[name] = v
+        for name, v in xs_args.items():
+            feed_args[name] = v[i]
+        ctx = _eager_execute(session, step, feed_args, state, rng_key,
+                             int(ctrs[i]))
+        if i < int(bad_index):
+            state = dict(ctx.state)
+    bad_op, ins, outs = first_bad_op(step.device_ops, ctx,
+                                     step.feed_tensors)
+    root = default_dump_root()
+    write_dump(root, bad_op, ins, outs, anomaly,
+               window_index=int(bad_index))
+    return bad_op, root
+
+
+def raise_anomaly(anomaly, bad_op=None, dump_root=None):
+    extra = ""
+    if bad_op is not None:
+        site = f" (created at {bad_op.source_site})" \
+            if bad_op.source_site else ""
+        extra = (f"first bad op: {bad_op.name} ({bad_op.type}){site}")
+    if dump_root:
+        extra += f"\ndump written to {dump_root} — inspect with "\
+                 f"`python -m simple_tensorflow_tpu.tools."\
+                 f"health_inspect {dump_root}`"
+    raise errors.InvalidArgumentError(
+        None, None, format_anomaly(anomaly, extra))

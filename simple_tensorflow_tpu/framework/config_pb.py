@@ -128,13 +128,30 @@ class ConfigProto:
 
     telemetry_port: start the process's stf.telemetry HTTP server
     (``/metrics`` Prometheus scrape, ``/healthz``, ``/statusz``,
-    ``/tracez``, ``/flightz``; docs/OBSERVABILITY.md) when the Session
-    is constructed. 0 binds an ephemeral port
+    ``/tracez``, ``/flightz``, ``/trainz``; docs/OBSERVABILITY.md) when
+    the Session is constructed. 0 binds an ephemeral port
     (``stf.telemetry.get_server().port``); None (default) starts
     nothing. PROCESS-GLOBAL like compile_cache_dir: the server outlives
     the Session (one process, one telemetry plane) — constructing a
     second Session with the same (or None) port is a no-op, a
     different fixed port raises.
+
+    numerics: None (process default, see
+    stf.debug.numerics.set_numerics_mode / STF_NUMERICS) | "off" |
+    "metrics" | "raise" | "dump" — the training numerics-health plane
+    (stf.debug.numerics; docs/DEBUG.md). Training-shaped plans are
+    auto-instrumented with device-side NumericSummary taps (gradients,
+    optimizer updates, loss, plus activations matched by
+    ``numerics_taps``); the packed health tensor rides fused windows.
+    "metrics" feeds /stf/train/* + /trainz; "raise" additionally raises
+    InvalidArgumentError naming the first nonfinite tap and its
+    creation site; "dump" additionally re-executes the failing plan in
+    checked mode, localizes the first bad op, and writes a tfdbg-style
+    dump directory (STF_NUMERICS_DUMP_ROOT or a tmp dir).
+
+    numerics_taps: optional list of name-pattern regexes (the
+    match_partition_rules idiom) selecting EXTRA tensors to tap by op
+    name, on top of the automatic gradient/update/loss selection.
     """
 
     def __init__(self, device_count=None, intra_op_parallelism_threads=0,
@@ -149,7 +166,7 @@ class ConfigProto:
                  loop_fusion_steps=1, async_fetches=False,
                  compile_cache_dir=None, telemetry_port=None,
                  kernel_registry=None, device_memory_budget_bytes=None,
-                 auto_shard=False):
+                 auto_shard=False, numerics=None, numerics_taps=None):
         self.device_count = dict(device_count or {})
         self.intra_op_parallelism_threads = intra_op_parallelism_threads
         self.inter_op_parallelism_threads = inter_op_parallelism_threads
@@ -200,6 +217,13 @@ class ConfigProto:
                     f"got {device_memory_budget_bytes}")
         self.device_memory_budget_bytes = device_memory_budget_bytes
         self.auto_shard = bool(auto_shard)
+        if numerics is not None and numerics not in (
+                "off", "metrics", "raise", "dump"):
+            raise ValueError(
+                f"numerics must be None|off|metrics|raise|dump, "
+                f"got {numerics!r}")
+        self.numerics = numerics
+        self.numerics_taps = list(numerics_taps or [])
         if telemetry_port is not None:
             telemetry_port = int(telemetry_port)
             if telemetry_port < 0 or telemetry_port > 65535:

@@ -152,6 +152,18 @@ def _op_flops(op: Operation, grad_depth: int = 0,
         return fc[0]
     if t in _FREE_OPS or t in _ZERO_FLOP_OPS:
         return 0.0
+    if t == "NumericSummary":
+        # four fused elementwise reductions over the tapped tensor
+        # (nonfinite count, max-abs, sum-of-squares, zero count) — NOT
+        # free: the health plane's cost must show up in plan estimates
+        # so the <3% overhead budget is a priced, checkable claim
+        n = _nelems(op.inputs[0].shape) or 0
+        return 4.0 * n
+    if t == "HistogramBucketCounts":
+        # searchsorted over the fixed reference grid (~log2(|edges|)
+        # comparisons per element) plus the moment reductions
+        n = _nelems(op.inputs[0].shape) or 0
+        return 14.0 * n
     if t in _REDUCTION_OPS:
         # one flop per INPUT element reduced
         n = sum(_nelems(i.shape) or 0 for i in op.inputs[:1])

@@ -135,16 +135,20 @@ class OpDef:
         ``effects_declared`` False.
       runs_on_host: executes in the host (python) stage, not in the XLA
         program (queues, readers, py_func side).
+      host_sink_pure: host op that only *observes* device values (writes
+        summaries/files from them) and feeds nothing back into the step —
+        safe to defer to after a fused window (loop_safety does not treat
+        it as a fusion blocker the way it does host ops that feed state).
       n_outputs: static output count (or None -> from infer).
     """
 
     __slots__ = ("name", "lower", "pure_fn", "infer_fn", "is_stateful",
                  "runs_on_host", "n_outputs", "attr_keys_in_sig",
-                 "effects", "effects_declared")
+                 "effects", "effects_declared", "host_sink_pure")
 
     def __init__(self, name, lower=None, pure_fn=None, infer_fn=None,
                  is_stateful=False, runs_on_host=False, n_outputs=1,
-                 effects=None):
+                 effects=None, host_sink_pure=False):
         self.name = name
         self.pure_fn = pure_fn
         self.infer_fn = infer_fn
@@ -159,6 +163,7 @@ class OpDef:
         self.effects = effects
         self.is_stateful = bool(is_stateful or effects)
         self.runs_on_host = runs_on_host
+        self.host_sink_pure = bool(host_sink_pure)
         self.n_outputs = n_outputs
         if lower is None:
             if pure_fn is None:
@@ -241,12 +246,14 @@ _REGISTRY: Dict[str, OpDef] = {}
 
 
 def register(name, lower=None, pure_fn=None, infer_fn=None, is_stateful=False,
-             runs_on_host=False, n_outputs=1, effects=None):
+             runs_on_host=False, n_outputs=1, effects=None,
+             host_sink_pure=False):
     if name in _REGISTRY:
         raise ValueError(f"Op {name} already registered")
     od = OpDef(name, lower=lower, pure_fn=pure_fn, infer_fn=infer_fn,
                is_stateful=is_stateful, runs_on_host=runs_on_host,
-               n_outputs=n_outputs, effects=effects)
+               n_outputs=n_outputs, effects=effects,
+               host_sink_pure=host_sink_pure)
     _REGISTRY[name] = od
     return od
 

@@ -9,8 +9,82 @@ the raise happens host-side at fetch time (Session checks the flag).
 
 from __future__ import annotations
 
+from ..framework import dtypes as dtypes_mod
 from ..framework import graph as ops_mod
+from ..framework import op_registry
+from ..framework import tensor_shape as shape_mod
 from . import array_ops
+
+# Layout of the packed per-tensor stats vector a NumericSummary emits.
+STAT_NAMES = ("nonfinite_count", "max_abs", "l2_norm", "zero_fraction")
+STATS_WIDTH = len(STAT_NAMES)
+
+
+def _numeric_summary_pure(x):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    xf = x.astype(jnp.float32)
+    if xf.size == 0:
+        return jnp.zeros((STATS_WIDTH,), jnp.float32)
+    finite = jnp.isfinite(xf)
+    nonfinite = jnp.sum(~finite).astype(jnp.float32)
+    # mask nonfinites out of the magnitude stats so the summary vector
+    # itself is always finite (a NaN-poisoned max would make the packed
+    # health tensor useless for *which tensor* forensics)
+    safe = jnp.where(finite, xf, 0.0)
+    absx = jnp.abs(safe)
+    max_abs = jnp.max(absx)
+    l2 = jnp.sqrt(jnp.sum(absx * absx))
+    zero_frac = jnp.mean(((safe == 0.0) & finite).astype(jnp.float32))
+    return jnp.stack([nonfinite, max_abs, l2, zero_frac])
+
+
+def _numeric_summary_infer(graph, attrs, input_tensors):
+    return [(shape_mod.TensorShape([STATS_WIDTH]), dtypes_mod.float32)]
+
+
+# Pure (empty Effects): the stats vector is a function of its input
+# only, so CSE/const-fold stay legal and loop_safety certifies it in a
+# fused window like any other arithmetic — the whole point vs the
+# CheckNumerics flag channel.
+op_registry.register("NumericSummary", pure_fn=_numeric_summary_pure,
+                     infer_fn=_numeric_summary_infer,
+                     effects=op_registry.Effects())
+
+
+def _numeric_summary_sharding(op, in_specs, ctx):
+    from ..analysis.sharding import tensor_bytes  # noqa: PLC0415
+
+    s = in_specs[0]
+    if s:
+        axes = tuple(sorted({a for dim in s for a in dim}))
+        if axes:
+            ctx.collective(
+                "all-reduce", axes,
+                float(tensor_bytes(op.outputs[0])),
+                note="numeric-summary stats over sharded input",
+                tensor_name=op.outputs[0].name)
+    return [((),)]  # [4] vector, replicated
+
+
+op_registry.register_sharding_rule("NumericSummary",
+                                   _numeric_summary_sharding)
+
+
+def numeric_summary(tensor, name=None):
+    """Packed device-side health stats of ``tensor``: a float32 ``[4]``
+    vector ``[nonfinite_count, max_abs, l2_norm, zero_fraction]``
+    (stf.debug.numerics tap primitive; the tfdbg ``DebugNumericSummary``
+    idea, ref: tensorflow/core/ops/debug_ops.cc, recast as a pure
+    fusable graph op)."""
+    x = ops_mod.convert_to_tensor(tensor)
+    op = ops_mod.get_default_graph().create_op(
+        "NumericSummary", [x], attrs={},
+        name=name or "NumericSummary",
+        output_specs=[(shape_mod.TensorShape([STATS_WIDTH]),
+                       dtypes_mod.float32)])
+    return op.outputs[0]
 
 
 def verify_tensor_all_finite(t, msg, name=None):

@@ -43,28 +43,35 @@ def _wrap_summary(values: list) -> bytes:
     return w.tobytes()
 
 
-def _histogram_proto(arr: np.ndarray) -> Writer:
-    """(ref: core/lib/histogram/histogram.cc bucket scheme)."""
-    arr = np.asarray(arr, dtype=np.float64).ravel()
-    w = Writer()
-    if arr.size == 0:
-        return w
-    w.double_always(1, float(np.min(arr)))
-    w.double_always(2, float(np.max(arr)))
-    w.double_always(3, float(arr.size))
-    w.double_always(4, float(np.sum(arr)))
-    w.double_always(5, float(np.sum(arr * arr)))
-    # reference-style exponential buckets
+def _reference_edges():
+    # reference-style exponential buckets (ref: core/lib/histogram/
+    # histogram.cc InitDefaultBuckets) — value-INDEPENDENT, which is
+    # what makes device-side bucketing possible: the grid is a compile-
+    # time constant, only counts move
     limits = [-1e-12, 1e-12]
     v = 1e-12
     while v < 1e20:
         v *= 1.1
         limits.append(v)
     neg = [-l for l in limits if l > 0]
-    edges = sorted(set(neg + limits))
-    counts, _ = np.histogram(arr, bins=np.asarray([-1e308] + edges + [1e308]))
+    return sorted(set(neg + limits))
+
+
+_EDGES = _reference_edges()
+_N_BINS = len(_EDGES) + 1
+# packed layout of a HistogramBucketCounts vector:
+# [min, max, count, sum, sum_sq, bucket_counts...]
+_PACKED_WIDTH = 5 + _N_BINS
+
+
+def _emit_histo(w: Writer, mn, mx, num, sm, sm_sq, counts) -> Writer:
+    w.double_always(1, float(mn))
+    w.double_always(2, float(mx))
+    w.double_always(3, float(num))
+    w.double_always(4, float(sm))
+    w.double_always(5, float(sm_sq))
     keep_limits, keep_counts = [], []
-    bounds = edges + [1e308]
+    bounds = _EDGES + [1e308]
     for i, c in enumerate(counts):
         if c > 0:
             keep_limits.append(bounds[min(i, len(bounds) - 1)])
@@ -74,6 +81,29 @@ def _histogram_proto(arr: np.ndarray) -> Writer:
     return w
 
 
+def _histogram_proto(arr: np.ndarray) -> Writer:
+    """(ref: core/lib/histogram/histogram.cc bucket scheme)."""
+    arr = np.asarray(arr, dtype=np.float64).ravel()
+    w = Writer()
+    if arr.size == 0:
+        return w
+    counts, _ = np.histogram(arr, bins=np.asarray([-1e308] + _EDGES + [1e308]))
+    return _emit_histo(w, np.min(arr), np.max(arr), arr.size, np.sum(arr),
+                       np.sum(arr * arr), counts)
+
+
+def _histogram_proto_from_packed(vec: np.ndarray) -> Writer:
+    """Rebuild the Summary histogram from a device-computed
+    HistogramBucketCounts vector — the host never sees the full
+    tensor, only ``_PACKED_WIDTH`` floats."""
+    vec = np.asarray(vec, dtype=np.float64).ravel()
+    w = Writer()
+    if vec.size < _PACKED_WIDTH or vec[2] == 0:
+        return w
+    return _emit_histo(w, vec[0], vec[1], vec[2], vec[3], vec[4],
+                       vec[5:5 + _N_BINS])
+
+
 def _lower_scalar_summary(ctx, op, inputs):
     val = float(np.asarray(inputs[0]).reshape(()))
     return [_wrap_summary([_summary_value(op.attrs["tag"],
@@ -81,7 +111,12 @@ def _lower_scalar_summary(ctx, op, inputs):
 
 
 def _lower_histogram_summary(ctx, op, inputs):
-    histo = _histogram_proto(np.asarray(inputs[0]))
+    if op.attrs.get("from_buckets"):
+        histo = _histogram_proto_from_packed(np.asarray(inputs[0]))
+    else:
+        # legacy path (imported GraphDefs predating device-side
+        # bucketing): the full tensor reaches the host
+        histo = _histogram_proto(np.asarray(inputs[0]))
     return [_wrap_summary([_summary_value(op.attrs["tag"], histo=histo)])]
 
 
@@ -159,13 +194,58 @@ def _lower_merge_summary(ctx, op, inputs):
     return [_wrap_summary(parts)]
 
 
+# host_sink_pure: summary serialization only OBSERVES device values
+# (bytes out, nothing fed back into the step), so loop_safety may defer
+# it to after a fused window instead of splitting the window
 for _n, _fn in [("ScalarSummary", _lower_scalar_summary),
                 ("HistogramSummary", _lower_histogram_summary),
                 ("ImageSummary", _lower_image_summary),
                 ("AudioSummary", _lower_audio_summary),
                 ("TextSummary", _lower_text_summary),
                 ("MergeSummary", _lower_merge_summary)]:
-    op_registry.register(_n, lower=_fn, is_stateful=True, runs_on_host=True)
+    op_registry.register(_n, lower=_fn, is_stateful=True, runs_on_host=True,
+                         host_sink_pure=True)
+
+
+def _histogram_bucket_counts_pure(x):
+    import jax.numpy as jnp
+
+    xf = jnp.asarray(x).astype(jnp.float32).ravel()
+    if xf.size == 0:
+        return jnp.zeros((_PACKED_WIDTH,), jnp.float32)
+    edges = jnp.asarray(_EDGES, jnp.float32)
+    idx = jnp.searchsorted(edges, xf, side="right")
+    counts = jnp.zeros((_N_BINS,), jnp.float32).at[idx].add(1.0)
+    head = jnp.stack([jnp.min(xf), jnp.max(xf),
+                      jnp.asarray(float(xf.size), jnp.float32),
+                      jnp.sum(xf), jnp.sum(xf * xf)])
+    return jnp.concatenate([head, counts])
+
+
+def _histogram_bucket_counts_infer(graph, attrs, input_tensors):
+    return [(shape_mod.TensorShape([_PACKED_WIDTH]), dtypes_mod.float32)]
+
+
+op_registry.register("HistogramBucketCounts",
+                     pure_fn=_histogram_bucket_counts_pure,
+                     infer_fn=_histogram_bucket_counts_infer,
+                     effects=op_registry.Effects())
+
+
+def _histogram_bucket_counts_sharding(op, in_specs, ctx):
+    s = in_specs[0]
+    if s:
+        axes = tuple(sorted({a for dim in s for a in dim}))
+        if axes:
+            ctx.collective(
+                "all-reduce", axes, 4.0 * _PACKED_WIDTH,
+                note="histogram bucket counts over sharded input",
+                tensor_name=op.outputs[0].name)
+    return [((),)]
+
+
+op_registry.register_sharding_rule("HistogramBucketCounts",
+                                   _histogram_bucket_counts_sharding)
 
 
 def _summary_op(op_type, tag, tensor, collections, attrs=None, name=None):
@@ -190,7 +270,21 @@ def scalar(name, tensor, collections=None, family=None):
 
 def histogram(name, values, collections=None, family=None):
     tag = f"{family}/{name}" if family else name
-    return _summary_op("HistogramSummary", tag, values, collections,
+    v = ops_mod.convert_to_tensor(values)
+    if v.dtype.is_floating or v.dtype.is_integer:
+        # bucketize on device: the host stage fetches _PACKED_WIDTH
+        # floats instead of the full tensor, and the summary op becomes
+        # a pure observer of a tiny device value — fused windows under
+        # SummarySaverHook no longer split on histogram traffic
+        g = ops_mod.get_default_graph()
+        counts = g.create_op(
+            "HistogramBucketCounts", [v], attrs={},
+            name=(name or "Histogram") + "_buckets",
+            output_specs=[(shape_mod.TensorShape([_PACKED_WIDTH]),
+                           dtypes_mod.float32)]).outputs[0]
+        return _summary_op("HistogramSummary", tag, counts, collections,
+                           attrs={"from_buckets": True}, name=name)
+    return _summary_op("HistogramSummary", tag, v, collections,
                        name=name)
 
 

@@ -24,6 +24,10 @@ thread per request; started via ``stf.telemetry.start(port=...)`` or
   request's linked spans, ``&format=chrome`` renders a chrome trace.
 - ``/flightz``  — flight-recorder JSONL dump (``?stacks=0`` omits the
   per-thread stack records).
+- ``/trainz``   — training numerics-health plane (stf.debug.numerics):
+  resolved mode, watched taps, per-step health history (grad/update
+  norms, nonfinite tap counts), and the last-anomaly report with
+  first-bad-op forensics when the bisector ran (docs/DEBUG.md).
 
 The server binds 127.0.0.1 by default: metrics surfaces are internal,
 exposure beyond localhost is a deployment decision (front it with your
@@ -106,6 +110,23 @@ def _memz_info(reconcile: bool = False, top: int = 20) -> Dict[str, Any]:
         except Exception as e:  # noqa: BLE001 — memz is best-effort
             info["reconcile"] = {"error": str(e)}
     return info
+
+
+def _trainz_info() -> Dict[str, Any]:
+    """The /trainz payload. sys.modules-guarded like /statusz: a scrape
+    must never be what first imports the numerics plane — before any
+    Session instruments a plan, /trainz reports the env-derived mode
+    and an empty history."""
+    num_mod = sys.modules.get("simple_tensorflow_tpu.debug.numerics")
+    if num_mod is not None:
+        return num_mod.trainz_info()
+    env = os.environ.get("STF_NUMERICS", "").strip().lower()
+    return {
+        "mode": env if env in ("off", "metrics", "raise", "dump")
+        else "off",
+        "steps_observed": 0, "anomalies": 0, "taps": [],
+        "history": [], "last_anomaly": None,
+    }
 
 
 def _statusz_info() -> Dict[str, Any]:
@@ -242,6 +263,9 @@ class _Handler(BaseHTTPRequestHandler):
                         "spans": _tracing_mod.recent_spans(
                             n=limit, trace_id=trace_id)}, default=str),
                         "application/json")
+            elif endpoint == "/trainz":
+                self._reply(json.dumps(_trainz_info(), default=str,
+                                       indent=2), "application/json")
             elif endpoint == "/flightz":
                 stacks = (q.get("stacks") or ["1"])[0] != "0"
                 self._reply(
@@ -253,7 +277,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "<html><body><h1>stf telemetry</h1><ul>"
                     + "".join(f'<li><a href="{p}">{p}</a></li>'
                               for p in ("/metrics", "/healthz", "/statusz",
-                                        "/memz", "/tracez", "/flightz"))
+                                        "/memz", "/tracez", "/flightz",
+                                        "/trainz"))
                     + "</ul></body></html>", "text/html")
             else:
                 self._reply(f"no such endpoint: {endpoint}\n",
@@ -305,7 +330,7 @@ class TelemetryServer:
         _recorder_mod.get_recorder().record(
             "telemetry_server", action="start", port=self.port)
         logging.info("telemetry: serving /metrics /healthz /statusz "
-                     "/memz /tracez /flightz on http://%s:%d",
+                     "/memz /tracez /flightz /trainz on http://%s:%d",
                      address, self.port)
 
     @property

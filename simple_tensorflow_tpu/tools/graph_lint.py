@@ -4,7 +4,7 @@
         [--fetch op_or_tensor ...] [--severity code=level ...] \
         [--level structural|full] [--json] [--serving] \
         [--kernels [off|auto|force]] \
-        [--memory [--budget BYTES]] \
+        [--memory [--budget BYTES]] [--numerics] \
         [--mesh 8|2x4|dp=2,tp=4] [--rules rules.json] \
         [--autoshard [--emit-rules out.json] [--budget BYTES]] \
         [--max-severity note|warning|error]
@@ -226,6 +226,13 @@ def main(argv=None):
                          "closure — and lint/serving-decode-cache: "
                          "KV-cache ops missing committed shardings, or "
                          "a cache tensor escaping to host)")
+    ap.add_argument("--numerics", action="store_true",
+                    help="lint for statically visible NaN/Inf seeds: "
+                         "activate the lint/numeric-risk rule "
+                         "(unguarded Log/Rsqrt/Reciprocal/Div/Exp "
+                         "operands, bf16/f16 long-axis reductions) — "
+                         "the offline half of the stf.debug.numerics "
+                         "runtime health plane (STF_NUMERICS)")
     ap.add_argument("--max-severity", default="error",
                     choices=["note", "warning", "error"],
                     help="exit nonzero when any diagnostic reaches this "
@@ -265,11 +272,11 @@ def main(argv=None):
 
     from .. import analysis
 
-    if sum(bool(x) for x in (args.kernels, args.serving,
-                             args.memory, args.autoshard)) > 1:
-        ap.error("--kernels, --serving, --memory, and --autoshard are "
-                 "separate lint purposes; run them as separate "
-                 "invocations")
+    if sum(bool(x) for x in (args.kernels, args.serving, args.memory,
+                             args.numerics, args.autoshard)) > 1:
+        ap.error("--kernels, --serving, --memory, --numerics, and "
+                 "--autoshard are separate lint purposes; run them as "
+                 "separate invocations")
     if args.budget is not None and not (args.memory or args.autoshard):
         ap.error("--budget requires --memory or --autoshard")
     if args.autoshard and not mesh:
@@ -278,7 +285,8 @@ def main(argv=None):
         ap.error("--emit-rules requires --autoshard")
     purpose = "serving" if args.serving else (
         "kernels" if args.kernels else (
-            "memory" if args.memory else None))
+            "memory" if args.memory else (
+                "numerics" if args.numerics else None)))
     from ..kernels import registry as _kreg
 
     with _kreg.activate(args.kernels):

@@ -1,0 +1,176 @@
+"""Offline reader for stf.debug.numerics dump directories.
+
+    python -m simple_tensorflow_tpu.tools.health_inspect DUMP_DIR \
+        [--top N] [--json]
+
+A dump dir is what dump-mode forensics write when the training-health
+plane trips (``ConfigProto(numerics="dump")`` / ``STF_NUMERICS=dump``;
+docs/DEBUG.md): ``run_*/<tensor>.npy`` + ``manifest.json`` in the
+tfdbg FileSink layout, plus ``bisect_report.json`` naming the first
+bad op, its creation site, and the anomaly the device-side sentinels
+observed. This CLI renders all of it without importing jax or
+rebuilding the graph:
+
+  1. the bisector's verdict — first bad op, type, user source site,
+     window index for fused-run dumps;
+  2. a per-tensor health table over every dumped tensor (count,
+     nonfinite count, max |x|, min/max, zero fraction), worst first;
+  3. the anomaly record (step, tap stats) the plane raised on.
+
+Exit status is 1 when any dumped tensor contains a NaN/Inf — so a CI
+smoke run can gate on "training stayed finite" by pointing this tool
+at ``STF_NUMERICS_DUMP_ROOT`` — and 0 on an all-finite dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _tensor_stats(path):
+    """Health row for one dumped .npy: the same four statistics the
+    device-side NumericSummary op packs, plus min/max for humans."""
+    arr = np.load(path, allow_pickle=True)
+    try:
+        farr = arr.astype(np.float64)
+    except (TypeError, ValueError):
+        return {"count": int(arr.size), "dtype": str(arr.dtype),
+                "nonfinite": 0, "max_abs": None, "min": None,
+                "max": None, "zero_frac": None}
+    finite = np.isfinite(farr)
+    n_bad = int(farr.size - finite.sum())
+    fin_vals = farr[finite]
+    return {
+        "count": int(arr.size),
+        "dtype": str(arr.dtype),
+        "nonfinite": n_bad,
+        "n_nan": int(np.isnan(farr).sum()),
+        "n_inf": int(np.isinf(farr).sum()),
+        "max_abs": float(np.max(np.abs(fin_vals))) if fin_vals.size else None,
+        "min": float(fin_vals.min()) if fin_vals.size else None,
+        "max": float(fin_vals.max()) if fin_vals.size else None,
+        "zero_frac": float(np.mean(fin_vals == 0.0)) if fin_vals.size
+        else None,
+    }
+
+
+def load_dump(dump_root):
+    """Parse a dump dir into (report|None, rows). Each row is
+    {run, name, file, flagged, **stats}, worst tensors first
+    (nonfinite count desc, then max_abs desc)."""
+    report = None
+    report_path = os.path.join(dump_root, "bisect_report.json")
+    if os.path.exists(report_path):
+        with open(report_path) as f:
+            report = json.load(f)
+    rows = []
+    for entry in sorted(os.listdir(dump_root)):
+        run_dir = os.path.join(dump_root, entry)
+        if not entry.startswith("run_") or not os.path.isdir(run_dir):
+            continue
+        manifest_path = os.path.join(run_dir, "manifest.json")
+        if not os.path.exists(manifest_path):
+            continue
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        for name, meta in manifest.get("tensors", {}).items():
+            row = {"run": entry, "name": name, "file": meta["file"],
+                   "flagged": bool(meta.get("has_inf_or_nan", False))}
+            npy = os.path.join(run_dir, meta["file"])
+            if os.path.exists(npy):
+                row.update(_tensor_stats(npy))
+            rows.append(row)
+    rows.sort(key=lambda r: (-r.get("nonfinite", 0),
+                             -(r.get("max_abs") or 0.0), r["name"]))
+    return report, rows
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render(report, rows, top=None, out=None):
+    out = out or sys.stdout
+    w = out.write
+    if report is not None:
+        bad = report.get("first_bad_op")
+        w("bisect: first bad op "
+          + (f"{bad!r} ({report.get('op_type')})" if bad else "(none)")
+          + (f" at fused window step {report['window_index']}"
+             if report.get("window_index") is not None else "") + "\n")
+        if report.get("site"):
+            w(f"  created at {report['site']}\n")
+        anomaly = report.get("anomaly") or {}
+        if anomaly:
+            w(f"  anomaly at step {anomaly.get('step')}: "
+              f"{len(anomaly.get('taps', []))} nonfinite tap(s)\n")
+            for tap in anomaly.get("taps", [])[:8]:
+                w(f"    {tap.get('kind', '?')} {tap.get('name')!r}: "
+                  f"nonfinite={_fmt(tap.get('nonfinite_count'))} "
+                  f"max_abs={_fmt(tap.get('max_abs'))}\n")
+    n_bad = sum(1 for r in rows if r.get("nonfinite", 0))
+    w(f"tensors: {len(rows)} dumped, {n_bad} with nonfinite values\n")
+    shown = rows if top is None else rows[:top]
+    if shown:
+        w(f"  {'tensor':<40}{'count':>9}{'nonfinite':>11}"
+          f"{'max_abs':>13}{'min':>13}{'max':>13}{'zero%':>8}\n")
+    for r in shown:
+        mark = " <-- NONFINITE" if r.get("nonfinite", 0) else ""
+        zf = r.get("zero_frac")
+        w(f"  {r['name'][:38]:<40}{r.get('count', 0):>9}"
+          f"{r.get('nonfinite', 0):>11}{_fmt(r.get('max_abs')):>13}"
+          f"{_fmt(r.get('min')):>13}{_fmt(r.get('max')):>13}"
+          f"{(f'{zf * 100:.1f}' if zf is not None else '-'):>8}"
+          f"{mark}\n")
+    if top is not None and len(rows) > top:
+        w(f"  ... {len(rows) - top} more (use --top)\n")
+    return n_bad
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m simple_tensorflow_tpu.tools.health_inspect",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("dump_dir",
+                    help="numerics dump directory (the path a raise/"
+                         "dump-mode error message names, or a child of "
+                         "STF_NUMERICS_DUMP_ROOT)")
+    ap.add_argument("--top", type=int, default=None, metavar="N",
+                    help="show only the N worst tensors (default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report + per-tensor rows as one "
+                         "JSON object")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.dump_dir):
+        print(f"health_inspect: {args.dump_dir!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    report, rows = load_dump(args.dump_dir)
+    if report is None and not rows:
+        print(f"health_inspect: {args.dump_dir!r} has no "
+              "bisect_report.json and no run_*/manifest.json — not a "
+              "numerics dump dir", file=sys.stderr)
+        return 2
+    if args.json:
+        n_bad = sum(1 for r in rows if r.get("nonfinite", 0))
+        print(json.dumps({"dump_dir": args.dump_dir, "report": report,
+                          "tensors": rows,
+                          "nonfinite_tensors": n_bad}, default=str))
+    else:
+        n_bad = render(report, rows, top=args.top)
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,7 @@ from .training_util import (
     get_global_step, create_global_step, get_or_create_global_step,
     global_step, assert_global_step,
 )
+from .health import NumericsHealthHook
 from .session_run_hook import (
     SessionRunHook, SessionRunArgs, SessionRunContext, SessionRunValues,
 )

@@ -1,0 +1,121 @@
+"""stf.train.health: the training-side surface of the numerics-health
+plane (stf.debug.numerics; docs/DEBUG.md "Training health").
+
+The plane itself lives in the Session — plans that look like training
+steps are auto-instrumented with device-side NumericSummary taps
+whenever the resolved mode is not "off", fused windows included. This
+module adds the hook-driving layer on top:
+
+- :class:`NumericsHealthHook` — periodic health logging (global grad
+  norm, update ratio, nonfinite tap counts) from the process
+  :class:`~simple_tensorflow_tpu.debug.numerics.HealthPlane`, plus an
+  end-of-training summary. The hook only READS the plane, so it votes
+  an unbounded fusion window (``until_next_trigger``): health riding
+  inside the fused program is the whole point — the hook must never be
+  the reason a window splits.
+- ``MonitoredTrainingSession`` auto-installs one when the resolved
+  numerics mode (ConfigProto > STF_NUMERICS > process default) is not
+  "off" and the caller did not pass their own.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Callable, Dict, Optional
+
+from .session_run_hook import SessionRunHook
+
+
+def resolved_mode(config=None) -> str:
+    """The numerics mode a Session built with ``config`` will run
+    under. sys.modules-guarded like the Session's own resolution: when
+    debug.numerics was never imported, the env var alone decides, so a
+    mode-"off" training job never pays the import."""
+    mode = getattr(config, "numerics", None) if config is not None \
+        else None
+    if mode is not None:
+        return mode
+    mod = sys.modules.get("simple_tensorflow_tpu.debug.numerics")
+    if mod is not None:
+        return mod.get_numerics_mode()
+    env = os.environ.get("STF_NUMERICS", "").strip().lower()
+    return env if env in ("metrics", "raise", "dump") else "off"
+
+
+class NumericsHealthHook(SessionRunHook):
+    """Log the numerics-health plane's view of training every
+    ``every_n_steps`` OBSERVED steps (plane steps, not hook run
+    boundaries — a fused window advances many at once), and summarize
+    at end().
+
+    The hook is read-only: instrumentation, metrics, /trainz, raising
+    and dumping all happen inside the Session regardless of whether
+    this hook is installed. What it adds is a human-readable heartbeat
+    in the training log and a final anomaly recap."""
+
+    def __init__(self, every_n_steps: int = 100,
+                 log_fn: Optional[Callable[[str], None]] = None):
+        if every_n_steps < 1:
+            raise ValueError(
+                f"every_n_steps must be >= 1, got {every_n_steps}")
+        self._every_n = int(every_n_steps)
+        self._log_fn = log_fn
+        self._last_logged = 0
+
+    def _log(self, msg: str) -> None:
+        if self._log_fn is not None:
+            self._log_fn(msg)
+            return
+        from ..platform import tf_logging as logging
+
+        logging.info("%s", msg)
+
+    @staticmethod
+    def _plane_info() -> Dict[str, Any]:
+        from ..debug import numerics as numerics_mod
+
+        return numerics_mod.get_plane().info()
+
+    def begin(self):
+        info = self._plane_info()
+        self._last_logged = int(info["steps_observed"])
+
+    @staticmethod
+    def _format_entry(entry: Dict[str, Any]) -> str:
+        parts = [f"numerics health @ step {entry['step']}"]
+        if entry.get("grad_norm") is not None:
+            parts.append(f"grad_norm={entry['grad_norm']:.6g}")
+        if entry.get("update_ratio") is not None:
+            parts.append(f"update_ratio={entry['update_ratio']:.6g}")
+        parts.append(f"max_abs={entry['max_abs']:.6g}")
+        if entry.get("nonfinite_taps"):
+            parts.append(f"NONFINITE_TAPS={entry['nonfinite_taps']}")
+        return " ".join(parts)
+
+    def after_run(self, run_context, run_values):
+        info = self._plane_info()
+        steps = int(info["steps_observed"])
+        if steps - self._last_logged < self._every_n or \
+                not info["history"]:
+            return
+        self._last_logged = steps
+        self._log(self._format_entry(info["history"][-1]))
+
+    def end(self, session):
+        info = self._plane_info()
+        msg = (f"numerics health: observed {info['steps_observed']} "
+               f"steps, {info['anomalies']} anomalies, "
+               f"{len(info['taps'])} taps, mode={info['mode']}")
+        last = info.get("last_anomaly")
+        if last:
+            msg += (f"; last anomaly at step {last['step']} "
+                    f"({len(last['taps'])} taps)")
+            if last.get("dump_root"):
+                msg += f", dump at {last['dump_root']}"
+        self._log(msg)
+
+    def until_next_trigger(self, global_step):
+        # the plane observes INSIDE the fused window; this hook must
+        # never be the reason a window splits
+        return 1 << 30

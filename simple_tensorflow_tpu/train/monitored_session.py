@@ -412,6 +412,16 @@ def MonitoredTrainingSession(master="", is_chief=True, checkpoint_dir=None,
     docs/CHECKPOINT.md)."""
     scaffold = scaffold or Scaffold()
     all_hooks = list(hooks or [])
+    # numerics-health plane (stf.debug.numerics): when the resolved
+    # mode is on, every training job driven through this constructor
+    # gets the health heartbeat + end-of-run recap for free (the
+    # instrumentation itself happens inside the Session either way)
+    from . import health as _health_mod
+
+    if _health_mod.resolved_mode(config) != "off" and not any(
+            isinstance(h, _health_mod.NumericsHealthHook)
+            for h in all_hooks):
+        all_hooks.append(_health_mod.NumericsHealthHook())
     if is_chief:
         session_creator = ChiefSessionCreator(scaffold, master, config,
                                               checkpoint_dir)

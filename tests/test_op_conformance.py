@@ -1080,6 +1080,15 @@ COVERED_ELSEWHERE.update({
     "CollectivePermute": ("test_parallel.py", "ppermute"),
 })
 
+COVERED_ELSEWHERE.update({
+    # numerics-health plane (ISSUE 17): packed-stat semantics (nonfinite
+    # count, finite max_abs, l2, zero fraction) and the device-side
+    # histogram bucketization (fused-window no-split + event round trip)
+    # live in tests/test_numerics_health.py
+    "NumericSummary": ("test_numerics_health.py", "NumericSummary"),
+    "HistogramBucketCounts": ("test_numerics_health.py", "histogram"),
+})
+
 
 # ---------------------------------------------------------------------------
 # MISC: direct mini-tests for everything the table and pointers don't

@@ -212,7 +212,11 @@ class TestFallback:
                    and d.op_name == "my_print" for d in static_diags), \
             [d.format() for d in static_diags]
 
-    def test_summary_host_sink_refuses_fusion(self):
+    def test_summary_host_sink_defers_under_last_mode(self):
+        """Pure host sinks (summary serialization only OBSERVES device
+        values) no longer split the window: under output_mode="last"
+        the sink defers to once-per-window on last-step values, so the
+        n steps fuse with no host_sink_op fallback."""
         x = stf.placeholder(stf.float32, [2], name="x")
         s = stf.summary.scalar("mean_x", stf.reduce_mean(x * 3.0))
         sess = stf.Session()
@@ -220,6 +224,23 @@ class TestFallback:
         fused0 = _fused_steps_count()
         out = sess.run_steps(s, n=2, feed_dict={x: np.ones(2, np.float32)})
         assert out is not None  # serialized summary from the last step
+        after = _counter_cells("/stf/session/loop_fusion_fallbacks")
+        assert after.get("host_sink_op", 0) == \
+            before.get("host_sink_op", 0)
+        assert _fused_steps_count() == fused0 + 2
+
+    def test_summary_host_sink_refuses_fusion_when_stacked(self):
+        """output_mode="stacked" needs the summary serialized PER STEP,
+        which the deferred once-per-window stage cannot provide — still
+        a host_sink_op fallback."""
+        x = stf.placeholder(stf.float32, [2], name="x")
+        s = stf.summary.scalar("mean_x", stf.reduce_mean(x * 3.0))
+        sess = stf.Session()
+        before = dict(_counter_cells("/stf/session/loop_fusion_fallbacks"))
+        fused0 = _fused_steps_count()
+        out = sess.run_steps(s, n=2, feed_dict={x: np.ones(2, np.float32)},
+                             output_mode="stacked")
+        assert len(out) == 2  # one serialized summary per step
         after = _counter_cells("/stf/session/loop_fusion_fallbacks")
         assert after.get("host_sink_op", 0) == \
             before.get("host_sink_op", 0) + 1
@@ -255,7 +276,11 @@ class TestFallback:
             before.get("uninitialized_write", 0) + 1
         np.testing.assert_array_equal(sess.run(v._ref), np.zeros(2))
 
-    def test_checknumerics_refuses_fusion(self):
+    def test_checknumerics_fuses_and_raises_post_commit(self):
+        """The numeric_check_op fusion blocker is retired: checks ride
+        the fused window's per-step ys. A clean window fuses (no
+        fallback counted); a poisoned step raises AFTER the window
+        commits, naming the failing window step."""
         x = stf.placeholder(stf.float32, [2], name="x")
         y = stf.check_numerics(x * 2.0, "bad x")
         sess = stf.Session()
@@ -263,8 +288,14 @@ class TestFallback:
         out = sess.run_steps(y, n=2, feed_dict={x: np.ones(2, np.float32)})
         np.testing.assert_array_equal(out, np.full(2, 2.0))
         after = _counter_cells("/stf/session/loop_fusion_fallbacks")
-        assert after.get("numeric_check_op", 0) == \
-            before.get("numeric_check_op", 0) + 1
+        assert after == before  # fused: no fallback reason counted
+        bad = np.array([1.0, np.nan], np.float32)
+        with pytest.raises(stf.errors.InvalidArgumentError) as ei:
+            sess.run_steps(y, n=3, stacked_feeds={
+                x: np.stack([np.ones(2, np.float32), bad,
+                             np.ones(2, np.float32)])})
+        assert "bad x" in str(ei.value)
+        assert "step 1 of 3" in str(ei.value)
 
 
 class TestDataWiring:
