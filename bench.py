@@ -1571,6 +1571,251 @@ def _measure_telemetry(platform, device_kind):
     }
 
 
+def _measure_sync(platform, device_kind):
+    """Sync row (ISSUE 18): overhead of the lock-order witness
+    (platform/sync.py — named/ranked locks, held stacks, edge
+    recording) on the serving and fused-train configs, witness ON vs
+    OFF (``sync.set_witness_enabled``).
+
+    Same split accounting as the telemetry row, because the witness
+    cost (~1 us per acquisition) sits far under this box's wall-clock
+    noise floor:
+
+    - A/B medians of PAIRED ABBA rounds (``ab_*``): informational.
+    - The PINNED overhead (``value``): the measured per-acquisition
+      cost DELTA (uncontended acquire+release microbenched in this
+      process, witness ON minus OFF) x measured acquisition rates
+      (the sync acquire counter during the ON rounds), conservatively
+      charged as fully-serialized microseconds.
+
+    The acceptance bar pins the WORST of the serving and fused-train
+    accounted fractions < 3%."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import saved_model as sm
+    from simple_tensorflow_tpu import serving
+    from simple_tensorflow_tpu.data.dataset import Dataset
+    from simple_tensorflow_tpu.platform import sync
+
+    rounds = int(os.environ.get("BENCH_SYNC_ROUNDS", "4"))
+    serve_s = float(os.environ.get("BENCH_SYNC_SECONDS", "1.5"))
+    n_clients = 8
+    n_fused = 64
+    train_steps = int(os.environ.get("BENCH_SYNC_TRAIN_STEPS", "192"))
+    in_dim, hidden, classes = 128, 256, 10
+    rng = np.random.RandomState(0)
+
+    # -- serving arm (same mini-model as the telemetry row) ------------------
+    x = stf.placeholder(stf.float32, [None, in_dim], name="x")
+    w1 = stf.Variable(stf.constant(
+        (rng.randn(in_dim, hidden) * 0.05).astype(np.float32)), name="w1")
+    w2 = stf.Variable(stf.constant(
+        (rng.randn(hidden, classes) * 0.05).astype(np.float32)),
+        name="w2")
+    probs = stf.nn.softmax(stf.matmul(stf.tanh(stf.matmul(x, w1)), w2),
+                           name="probs")
+    tmp = tempfile.mkdtemp(prefix="stf_bench_sync_")
+    export_dir = os.path.join(tmp, "model")
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sm.simple_save(sess, export_dir, inputs={"x": x},
+                       outputs={"probs": probs})
+    stf.reset_default_graph()
+    examples = rng.randn(64, in_dim).astype(np.float32)
+
+    def serving_round(server, seconds):
+        counts = [0] * n_clients
+        gate = threading.Barrier(n_clients + 1)
+        stop_at = [0.0]
+
+        def client(i):
+            gate.wait()
+            j = i
+            while time.perf_counter() < stop_at[0]:
+                server.predict({"x": examples[j % 64]}).result(
+                    timeout=120)
+                counts[i] += 1
+                j += n_clients
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True,
+                                    name=f"stf_bench_sync_client_{i}")
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        stop_at[0] = t0 + seconds
+        gate.wait()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    # -- fused-train arm (device-resident run_steps windows: the ring
+    # buffer / worker pool / session locks are the traffic under test) -------
+    g = stf.Graph()
+    with g.as_default():
+        xt = stf.placeholder(stf.float32, [8, in_dim], name="xt")
+        wt = stf.get_variable(
+            "wt", [in_dim, in_dim],
+            initializer=stf.random_normal_initializer(stddev=0.05))
+        loss = stf.reduce_sum(stf.matmul(xt, wt))
+        opt = stf.train.GradientDescentOptimizer(1e-4).minimize(loss)
+        train_sess = stf.Session(graph=g)
+        with g.as_default():
+            train_sess.run(stf.global_variables_initializer())
+    batch_np = {"xt": np.ones((8, in_dim), np.float32)}
+    fetch = [opt, loss]
+
+    def batch_stream():
+        while True:
+            yield dict(batch_np)
+
+    with g.as_default():
+        train_ds = Dataset.from_generator(
+            batch_stream).prefetch_to_device(buffer_size=2,
+                                             superbatch=n_fused)
+    train_it = iter(train_ds)
+
+    def train_round(steps):
+        windows = max(1, steps // n_fused)
+        t0 = time.perf_counter()
+        for _ in range(windows):
+            sb = {xt: next(train_it)["xt"]}
+            out = train_sess.run_steps(fetch, n=n_fused,
+                                       stacked_feeds=sb,
+                                       output_mode="stacked")
+            np.asarray(out[1])
+        return (time.perf_counter() - t0) / (windows * n_fused)
+
+    try:
+        server = serving.ModelServer(policy=serving.BatchingPolicy(
+            max_batch_size=16, batch_timeout_ms=0.5,
+            max_queue_depth=64))
+        server.load(export_dir, name="bench_sync")
+        for _ in range(4):  # warm every arm outside the clock
+            server.predict({"x": examples[0]}).result(timeout=120)
+        train_round(n_fused)
+
+        qps_off, qps_on, step_off, step_on = [], [], [], []
+        acq0_serve = acq1_serve = acq0_train = acq1_train = 0
+        requests_on = 0
+        steps_on = 0
+        sync._set_count_acquires(True)
+        for i in range(rounds):
+            # ABBA: alternate which arm goes first so slow box drift
+            # cancels instead of biasing the second arm
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for on in order:
+                sync.set_witness_enabled(on)
+                if on:
+                    acq0_serve = sync._set_count_acquires(True)
+                q = serving_round(server, serve_s)
+                if on:
+                    acq1_serve = sync._set_count_acquires(True)
+                s = train_round(train_steps)
+                if on:
+                    acq1_train = sync._set_count_acquires(True)
+                    requests_on += int(q * serve_s)
+                    steps_on += train_steps
+                (qps_on if on else qps_off).append(q)
+                (step_on if on else step_off).append(s)
+                if on and i == 0:
+                    # acquires per round are stable; one ON round's
+                    # deltas give the rates
+                    serve_acqs = acq1_serve - acq0_serve
+                    train_acqs = acq1_train - acq1_serve
+        sync.set_witness_enabled(True)
+
+        # per-acquisition cost microbench: uncontended acquire+release
+        # of one named lock, witness ON vs OFF — the delta is what the
+        # witness layer itself costs on the hot path
+        probe = sync.Lock("bench/sync_probe", rank=sync.LEAF)
+        n_micro = 20000
+
+        def acq_cost_us():
+            t0 = time.perf_counter()
+            for _ in range(n_micro):
+                probe.acquire()
+                probe.release()
+            return (time.perf_counter() - t0) / n_micro * 1e6
+
+        acq_cost_us()  # warm
+        cost_on_us = acq_cost_us()
+        sync.set_witness_enabled(False)
+        cost_off_us = acq_cost_us()
+        sync.set_witness_enabled(True)
+        cost_delta_us = max(cost_on_us - cost_off_us, 0.0)
+
+        server.close()
+        train_sess.close()
+    finally:
+        sync._set_count_acquires(False)
+        sync.set_witness_enabled(True)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    q_off = float(np.median(qps_off))
+    q_on = float(np.median(qps_on))
+    s_off = float(np.median(step_off))
+    s_on = float(np.median(step_on))
+    q_ratios = [on / max(off, 1e-9)
+                for on, off in zip(qps_on, qps_off)]
+    s_ratios = [on / max(off, 1e-12)
+                for on, off in zip(step_on, step_off)]
+    ab_serving = 1.0 - float(np.median(q_ratios))
+    ab_train = float(np.median(s_ratios)) - 1.0
+    qps_cv = float(np.std(qps_off) / max(np.mean(qps_off), 1e-9))
+
+    # pinned: acquires/unit x per-acquire witness delta, serialized
+    one_round_reqs = max(requests_on // max(rounds, 1), 1)
+    acq_per_req = serve_acqs / max(one_round_reqs, 1)
+    acq_per_step = train_acqs / max(train_steps, 1)
+    serving_overhead = acq_per_req * cost_delta_us * q_on / 1e6
+    train_overhead = (acq_per_step * cost_delta_us
+                      / max(s_on * 1e6, 1e-9))
+    worst = max(serving_overhead, train_overhead)
+    return {
+        **_monitoring_info(),
+        "metric": "sync_witness_overhead_frac",
+        "value": round(worst, 4),
+        "unit": "fraction (worst of serving/fused-train accounted "
+                "overhead: measured per-acquire witness cost x "
+                "measured acquire rate, serialized-worst-case)",
+        "vs_baseline": None,
+        "budget": 0.03,
+        "within_budget": bool(worst < 0.03),
+        "serving_overhead_frac": round(serving_overhead, 4),
+        "train_overhead_frac": round(train_overhead, 6),
+        "cost_acquire_on_us": round(cost_on_us, 3),
+        "cost_acquire_off_us": round(cost_off_us, 3),
+        "cost_acquire_delta_us": round(cost_delta_us, 3),
+        "acquires_per_request": round(acq_per_req, 1),
+        "acquires_per_fused_step": round(acq_per_step, 2),
+        "witness": {k: v for k, v in sync.witness_snapshot().items()
+                    if k in ("enabled",)},
+        "witness_edges": len(sync.witness_snapshot()["edges"]),
+        "potential_deadlocks": len(sync.potential_deadlocks()),
+        "ab_serving_overhead_frac": round(ab_serving, 4),
+        "ab_train_overhead_frac": round(ab_train, 4),
+        "ab_qps_noise_cv": round(qps_cv, 3),
+        "ab_note": ("ab_* are paired-ABBA wall-clock medians; the "
+                    "~1 us/acquire witness cost sits under this box's "
+                    "noise floor — the pinned value is the accounted "
+                    "overhead above"),
+        "qps_on": round(q_on, 1), "qps_off": round(q_off, 1),
+        "step_ms_on": round(s_on * 1e3, 4),
+        "step_ms_off": round(s_off * 1e3, 4),
+        "n_fused": n_fused,
+        "rounds": rounds,
+        "n_clients": n_clients,
+        "train_steps_per_round": train_steps,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _measure_memory(platform, device_kind):
     """Memory row (ISSUE 13 satellite): the telemetry-plane overhead
     re-measured with the HBM ledger ON — the combined plane (flight
@@ -2949,6 +3194,8 @@ def child_main():
         result = _measure_serving(platform, kind)
     elif model == "telemetry":
         result = _measure_telemetry(platform, kind)
+    elif model == "sync":
+        result = _measure_sync(platform, kind)
     elif model == "memory":
         result = _measure_memory(platform, kind)
     elif model == "checkpoint":
@@ -3066,6 +3313,7 @@ def _run_model(model, platform, kind, errors):
                        "input_pipeline": "600",
                        "serving": "900",
                        "telemetry": "900",
+                       "sync": "900",
                        "memory": "900",
                        "checkpoint": "600",
                        "generative": "1200",
@@ -3148,6 +3396,9 @@ _METRIC_NAMES = {
     "telemetry": ("telemetry_overhead_frac",
                   "fraction (worst of serving QPS loss / train "
                   "step-time growth, telemetry ON vs OFF)"),
+    "sync": ("sync_witness_overhead_frac",
+             "fraction (worst of serving/fused-train accounted "
+             "overhead, lock witness ON vs OFF)"),
     "memory": ("memory_plane_overhead_frac",
                "fraction (worst of serving/train accounted overhead, "
                "telemetry plane + HBM ledger fully ON)"),
@@ -3186,8 +3437,8 @@ def main():
             "resnet,bert,transformer,mnist,resnet_dp,graph_opt,analysis,"
             "sharding_analysis,autoshard,loop_fusion,numerics,"
             "input_pipeline,serving,"
-            "telemetry,memory,checkpoint,kernel_tier,generative,decode2,"
-            "warm_start").split(","):
+            "telemetry,sync,memory,checkpoint,kernel_tier,generative,"
+            "decode2,warm_start").split(","):
         tok = tok.strip()
         if not tok:
             continue
@@ -3205,8 +3456,9 @@ def main():
                     "resnet_dp", "graph_opt", "analysis",
                     "sharding_analysis", "autoshard", "loop_fusion",
                     "numerics", "input_pipeline", "serving",
-                    "telemetry", "memory", "checkpoint", "kernel_tier",
-                    "generative", "decode2", "warm_start"]
+                    "telemetry", "sync", "memory", "checkpoint",
+                    "kernel_tier", "generative", "decode2",
+                    "warm_start"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

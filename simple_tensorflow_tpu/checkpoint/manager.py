@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 
 from ..framework import errors
 from ..platform import monitoring
+from ..platform import sync as _sync
 from . import metrics as _m
 from . import snapshot as snapshot_mod
 from . import writer as writer_mod
@@ -48,7 +49,8 @@ class AsyncSaverEngine:
                 "AsyncSaverEngine writes the native stf-bundle format; "
                 f"got a backend={saver._backend!r} Saver")
         self._saver = saver
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("checkpoint/manager",
+                                rank=_sync.RANK_ENGINE)
         self._pending: List[writer_mod.PendingCheckpoint] = []
         self._unraised_error: Optional[BaseException] = None
 

@@ -22,6 +22,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ..platform import monitoring
+from ..platform import sync as _sync
 from . import metrics as _m
 
 _THREAD_NAME = "stf_ckpt_writer"
@@ -56,12 +57,17 @@ class PendingCheckpoint:
 class CheckpointWriter:
     def __init__(self):
         self._q: "queue.Queue" = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("checkpoint/writer_queue",
+                                rank=_sync.RANK_QUEUE)
         # serializes submit() against a concurrent stop(): without it a
         # submit landing between stop's sentinel-put and the worker's
         # exit would queue a job BEHIND the sentinel on a thread that
         # is about to return — stranding the write with no error
-        self._lifecycle = threading.Lock()
+        # blocking_ok: stop() joins the worker under this lock by
+        # design (see stop()); runtime_lint honours the flag
+        self._lifecycle = _sync.Lock("checkpoint/writer_lifecycle",
+                                     rank=_sync.RANK_LIFECYCLE,
+                                     blocking_ok=True)
         self._thread: Optional[threading.Thread] = None
         self._idle = threading.Event()
         self._idle.set()
@@ -152,6 +158,8 @@ class CheckpointWriter:
         next submit() lazily restarts it. Holds the lifecycle lock
         through the join so no submit can interleave with the shutdown
         sentinel."""
+        from ..telemetry import recorder as _flight
+
         with self._lifecycle:
             with self._lock:
                 t = self._thread
@@ -159,8 +167,11 @@ class CheckpointWriter:
                     self._thread = None
                     return True
                 self._q.put(None)
-            t.join(timeout)
-            alive = t.is_alive()
+            # checked: a write job wedged past the deadline emits a
+            # flight `wedge` event with the worker's stack (and fails
+            # the test-suite leak fixture via the False return)
+            alive = not _flight.checked_join(
+                t, timeout, "CheckpointWriter.stop")
             with self._lock:
                 if self._thread is t and not alive:
                     self._thread = None

@@ -45,6 +45,7 @@ from ..framework import graph as ops_mod
 from ..framework import lowering as lowering_mod
 from ..framework import errors
 from ..platform import monitoring
+from ..platform import sync as _sync
 from ..telemetry import recorder as _flight_mod
 from ..telemetry import tracing as _req_tracing
 
@@ -239,7 +240,8 @@ def _block_with_deadline(values, deadline):
                 done.set()
                 _deadline_waiters.release()
 
-        th = threading.Thread(target=_wait, daemon=True)
+        th = threading.Thread(target=_wait, daemon=True,
+                              name="stf_session_deadline_wait")
         th.start()
         if done.wait(remaining):
             if err:
@@ -374,7 +376,8 @@ class FetchFuture:
     def __init__(self, device_value):
         self._device_value = device_value
         self._host_value = None
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("session/fetch_future",
+                                rank=_sync.RANK_STATE)
 
     @property
     def materialized(self) -> bool:
@@ -1040,7 +1043,13 @@ class BaseSession:
         self._sig_versions: Dict[Any, int] = {}
         self._closed = False
         self._run_counter = 0
-        self._lock = threading.RLock()
+        # blocking_ok: Session.run() executes device programs and
+        # fetches results under this reentrant lock by design — run
+        # calls are serialized per session (reference semantics), so
+        # the device wait IS the critical section, not a convoy.
+        self._lock = _sync.RLock("client/session",
+                                 rank=_sync.RANK_SESSION,
+                                 blocking_ok=True)
         self._host_rng = np.random.RandomState(
             self._graph.seed if self._graph.seed is not None else 12345)
         self._base_key = None  # created lazily (jax import cost)

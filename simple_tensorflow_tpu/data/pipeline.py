@@ -45,6 +45,7 @@ from collections import deque
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..platform import monitoring
+from ..platform import sync as _sync
 
 # every constructed PipelineIterator, while alive (test leak hygiene:
 # tests/conftest.py asserts these are all closed after each module)
@@ -110,7 +111,8 @@ _pipelines_started = monitoring.Counter(
 # shared worker pool (element-level tasks: map_func calls, batch parses)
 # ---------------------------------------------------------------------------
 
-_pool_lock = threading.Lock()
+_pool_lock = _sync.Lock("data/worker_pool",
+                        rank=_sync.RANK_LIFECYCLE)
 _pool = None
 _pool_size = 0
 
@@ -195,9 +197,10 @@ class RingBuffer:
     def __init__(self, capacity: int, stats: Optional[StageStats] = None):
         self._dq: deque = deque()
         self.capacity = max(1, int(capacity))
-        self._mutex = threading.Lock()
-        self._not_empty = threading.Condition(self._mutex)
-        self._not_full = threading.Condition(self._mutex)
+        self._mutex = _sync.Lock("data/ring_buffer",
+                                 rank=_sync.RANK_QUEUE)
+        self._not_empty = _sync.Condition(self._mutex)
+        self._not_full = _sync.Condition(self._mutex)
         self._closed = False
         self._stats = stats
 
@@ -358,7 +361,8 @@ class PipelineRun:
         self._trace_sinks = monitoring.active_trace_buffers()
         self._closed = False
         self._autotune_started = False
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("data/pipeline_run",
+                                rank=_sync.RANK_ENGINE)
 
     def spawn(self, name: str, fn: Callable[[], None]) -> threading.Thread:
         sinks = self._trace_sinks
@@ -716,7 +720,8 @@ def _pmap_unordered_iter(run: PipelineRun, node: Node, up, label: str):
     if autotuned:
         run.register_knob(knob)
     ring = run.register_buffer(RingBuffer(max(2, 2 * hi), stats))
-    cv = threading.Condition()
+    cv = _sync.Condition(name="data/pmap_inflight",
+                         rank=_sync.RANK_QUEUE)
     inflight = [0]
 
     def on_done(fut):

@@ -150,7 +150,8 @@ class DebugListener:
         self._server.listen(1)
         self.port = self._server.getsockname()[1]
         self.events: List[Any] = []
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="stf_debug_dump_server")
         self._thread.start()
 
     def _serve(self):

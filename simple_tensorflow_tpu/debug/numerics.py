@@ -53,6 +53,7 @@ import numpy as np
 from ..framework import errors
 from ..ops.numerics import STAT_NAMES, STATS_WIDTH
 from ..platform import monitoring
+from ..platform import sync as _sync
 
 MODES = ("off", "metrics", "raise", "dump")
 
@@ -62,7 +63,7 @@ MODES = ("off", "metrics", "raise", "dump")
 MAX_TAPS = 64
 
 _process_mode: Optional[str] = None
-_mode_lock = threading.Lock()
+_mode_lock = _sync.Lock("numerics/mode", rank=_sync.RANK_STATE)
 
 
 def set_numerics_mode(mode: Optional[str]) -> Optional[str]:
@@ -132,7 +133,8 @@ class HealthPlane:
     HISTORY = 256
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("numerics/health_plane",
+                                rank=_sync.RANK_TELEMETRY)
         self._history = collections.deque(maxlen=self.HISTORY)
         self._steps = 0
         self._anomalies = 0
@@ -229,7 +231,8 @@ class HealthPlane:
 
 
 _plane: Optional[HealthPlane] = None
-_plane_lock = threading.Lock()
+_plane_lock = _sync.Lock("numerics/plane_init",
+                         rank=_sync.RANK_STATE)
 
 
 def get_plane() -> HealthPlane:

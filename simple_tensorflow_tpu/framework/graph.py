@@ -32,6 +32,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 import numpy as np
 
+from ..platform import sync as _sync
+
 from . import dtypes as dtypes_mod
 from . import tensor_shape as shape_mod
 from .errors import InvalidArgumentError
@@ -354,7 +356,8 @@ class Graph:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _sync.RLock("framework/graph",
+                                 rank=_sync.RANK_SESSION)
         self._ops_by_name: Dict[str, Operation] = {}
         self._ops_in_order: List[Operation] = []
         self._version = 0
@@ -768,7 +771,8 @@ def _get_graph_stack() -> List[Graph]:
 
 
 _global_default_graph: Optional[Graph] = None
-_global_lock = threading.Lock()
+_global_lock = _sync.Lock("framework/default_graph",
+                          rank=_sync.RANK_LIFECYCLE)
 
 
 def _root_graph() -> "Graph":

@@ -44,6 +44,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..platform import monitoring
+from ..platform import sync as _sync
 
 MODES = ("off", "auto", "force")
 
@@ -62,7 +63,7 @@ metric_autotune_runs = monitoring.Counter(
 
 _state = threading.local()          # per-thread activation (Session lowering)
 _mode_override: Optional[str] = None
-_lock = threading.RLock()
+_lock = _sync.RLock("kernels/registry", rank=_sync.RANK_STATE)
 
 
 def _env_mode() -> str:

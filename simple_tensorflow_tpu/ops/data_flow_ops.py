@@ -13,12 +13,14 @@ from __future__ import annotations
 import builtins
 import queue as py_queue
 import threading
+import weakref
 
 import numpy as np
 
 import jax.numpy as jnp
 
 from ..framework import dtypes as dtypes_mod
+from ..platform import sync as _sync
 from ..framework import errors
 from ..framework import graph as ops_mod
 from ..framework import op_registry
@@ -274,7 +276,8 @@ class RandomShuffleQueue(QueueBase):
         self._min_after = min_after_dequeue
         self._rng = np.random.RandomState(seed)
         self._buf = []
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("ops/shuffle_queue",
+                                rank=_sync.RANK_QUEUE)
         super().__init__(dtypes, shapes, names, uname, uname)
         self._capacity = capacity
 
@@ -614,7 +617,8 @@ class Barrier:
                             for _ in self._types]
         Barrier._counter[0] += 1
         self._name = shared_name or f"{name}_{Barrier._counter[0]}"
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("ops/barrier",
+                                rank=_sync.RANK_QUEUE)
         self._elems = {}          # key -> [components or None]
         self._first_index = {}    # key -> insertion index of first insert
         self._next_index = 0
@@ -822,6 +826,11 @@ op_registry.register(
 
 # -- RecordInput -------------------------------------------------------------
 
+# reader threads poll a condition forever; tests' leak hygiene closes
+# stragglers whose graph has been dropped (tests/conftest.py)
+_live_record_inputs: "weakref.WeakSet" = weakref.WeakSet()
+
+
 class RecordInput:
     """Asynchronously reads and randomly yields TFRecords (ref:
     python/ops/data_flow_ops.py:1633, core/kernels/record_yielder.cc).
@@ -847,13 +856,16 @@ class RecordInput:
         self._rng = np.random.RandomState(seed or None)
         self._name = name or f"record_input_{RecordInput._counter[0]}"
         self._buf = []
-        self._lock = threading.Lock()
-        self._have = threading.Condition(self._lock)
+        self._lock = _sync.Lock("ops/record_input",
+                                rank=_sync.RANK_QUEUE)
+        self._have = _sync.Condition(self._lock)
         self._epoch = 0
         self._started = False
+        self._closed = False
         g = ops_mod.get_default_graph()
         g._scoped_state.setdefault("__record_inputs__",
                                    {})[self._name] = self
+        _live_record_inputs.add(self)
 
     def get_yield_op(self, name=None):
         g = ops_mod.get_default_graph()
@@ -865,10 +877,18 @@ class RecordInput:
         return op.outputs[0]
 
     # -- host behavior -------------------------------------------------------
+    def close(self):
+        """Stop the reader thread. The yield op raises OutOfRange after
+        this; safe to call more than once (and on a never-started
+        instance)."""
+        with self._have:
+            self._closed = True
+            self._have.notify_all()
+
     def _reader_loop(self):
         from ..lib.io import tf_record
 
-        while True:
+        while not self._closed:
             shift = int(len(self._files) * self._shift_ratio *
                         self._epoch) % len(self._files)
             files = self._files[shift:] + self._files[:shift]
@@ -878,6 +898,8 @@ class RecordInput:
                     n_records += 1
                     with self._have:
                         while len(self._buf) >= self._buffer_size:
+                            if self._closed:
+                                return
                             self._have.wait(0.05)
                         self._buf.append(rec)
                         self._have.notify_all()
@@ -895,6 +917,8 @@ class RecordInput:
                 # epoch has fully drained, else a slow consumer can see
                 # epoch N+1 duplicates before finishing epoch N.
                 while self._buf:
+                    if self._closed:
+                        return
                     self._have.wait(0.05)
 
     def _host_yield(self, timeout=30.0):
@@ -904,7 +928,8 @@ class RecordInput:
             self._started = True
             self._epoch_done = False
             self._empty_epoch = False
-            t = threading.Thread(target=self._reader_loop, daemon=True)
+            t = threading.Thread(target=self._reader_loop, daemon=True,
+                                 name=f"stf_data_record_input_{self._name}")
             t.start()
         out = []
         deadline = _time.time() + timeout
@@ -915,6 +940,10 @@ class RecordInput:
                 self._have.wait(0.05)
             while len(out) < self._batch_size:
                 while not self._buf:
+                    if self._closed:
+                        raise errors.OutOfRangeError(
+                            None, None,
+                            f"RecordInput {self._name} is closed")
                     if self._empty_epoch:
                         raise errors.OutOfRangeError(
                             None, None,
@@ -968,8 +997,9 @@ class ConditionalAccumulator:
         self._sum = None
         self._count = 0
         self._global_step = 0
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = _sync.Lock("ops/accumulator",
+                                rank=_sync.RANK_QUEUE)
+        self._cond = _sync.Condition(self._lock)
         g = ops_mod.get_default_graph()
         g._scoped_state.setdefault("__dense_accumulators__",
                                    {})[self._name] = self
@@ -1132,8 +1162,9 @@ class SparseConditionalAccumulator:
                        if shape is not None else None)
         self._name = (shared_name
                       or f"{name}_{SparseConditionalAccumulator._counter[0]}")
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = _sync.Lock("ops/sparse_accumulator",
+                                rank=_sync.RANK_QUEUE)
+        self._cond = _sync.Condition(self._lock)
         self._sums = {}       # row index -> accumulated value row(s)
         self._counts = {}     # row index -> number of contributions
         self._ngrads = 0

@@ -30,6 +30,7 @@ import threading
 import numpy as np
 
 from ..framework import dtypes as dtypes_mod
+from ..platform import sync as _sync
 from ..framework import errors
 from ..framework import graph as ops_mod
 from ..framework import op_registry
@@ -137,7 +138,8 @@ class LookupInterface:
         self._name = f"{name}_{LookupInterface._counter[0]}"
         self.key_dtype = dtypes_mod.as_dtype(key_dtype)
         self.value_dtype = dtypes_mod.as_dtype(value_dtype)
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("ops/lookup_table",
+                                rank=_sync.RANK_QUEUE)
         # registry lives in the graph's scoped state (like variables), so
         # tables — and their materialized vocab arrays — die with the graph
         # instead of leaking across reset_default_graph()

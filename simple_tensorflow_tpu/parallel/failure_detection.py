@@ -40,7 +40,7 @@ class Heartbeat:
                 self._last = time.time()
 
         self._thread = threading.Thread(target=run, daemon=True,
-                                        name="stf-heartbeat")
+                                        name="stf_heartbeat")
         self._thread.start()
         return self
 
@@ -97,7 +97,7 @@ class StepWatchdog:
                     return
 
         self._thread = threading.Thread(target=run, daemon=True,
-                                        name="stf-step-watchdog")
+                                        name="stf_step_watchdog")
         self._thread.start()
         return self
 
@@ -136,7 +136,7 @@ def barrier(name: str = "barrier", timeout_secs: float = 600.0):
             jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
                 jnp.ones((jax.local_device_count(),))))
 
-    t = threading.Thread(target=run, daemon=True, name=f"stf-{name}")
+    t = threading.Thread(target=run, daemon=True, name=f"stf_{name}")
     t.start()
     t.join(timeout=timeout_secs)
     if t.is_alive():

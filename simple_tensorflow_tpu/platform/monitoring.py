@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from . import sync as _sync
+
 __all__ = [
     "Counter", "IntGauge", "StringGauge", "BoolGauge",
     "Sampler", "PercentileSampler",
@@ -47,7 +49,8 @@ __all__ = [
     "WindowedRate",
 ]
 
-_registry_lock = threading.Lock()
+_registry_lock = _sync.Lock("monitoring/registry",
+                            rank=_sync.RANK_METRICS)
 _registry: Dict[str, "Metric"] = {}
 
 
@@ -93,7 +96,7 @@ class CounterCell:
 
     def __init__(self):
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = _sync.leaf_lock("monitoring/cell")
 
     def increase_by(self, value: int = 1):
         if value < 0:
@@ -111,7 +114,7 @@ class GaugeCell:
 
     def __init__(self, default):
         self._value = default
-        self._lock = threading.Lock()
+        self._lock = _sync.leaf_lock("monitoring/cell")
 
     def set(self, value):
         with self._lock:
@@ -135,7 +138,7 @@ class SamplerCell:
         self._count = 0
         self._min = float("inf")
         self._max = float("-inf")
-        self._lock = threading.Lock()
+        self._lock = _sync.leaf_lock("monitoring/cell")
 
     def add(self, value: float):
         v = float(value)
@@ -178,7 +181,7 @@ class PercentileSamplerCell:
         self._next = 0
         self._sum = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = _sync.leaf_lock("monitoring/cell")
 
     def add(self, value: float):
         v = float(value)
@@ -251,7 +254,7 @@ class Metric:
         self.description = description
         self.label_names = tuple(label_names)
         self._cells: Dict[Tuple, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = _sync.leaf_lock("monitoring/family")
         with _registry_lock:
             existing = _registry.get(name)
             if existing is not None:
@@ -409,7 +412,7 @@ class WindowedRate:
 
     def __init__(self, window_s: float = 10.0):
         self._window_s = max(1.0, float(window_s))
-        self._lock = threading.Lock()
+        self._lock = _sync.leaf_lock("monitoring/windowed_rate")
         self._buckets: Dict[int, int] = {}
 
     def add(self, n: int = 1, now: Optional[float] = None) -> None:
@@ -581,7 +584,7 @@ class TraceBuffer:
 
     def __init__(self):
         self.spans: List[Dict[str, Any]] = []
-        self._lock = threading.Lock()
+        self._lock = _sync.leaf_lock("monitoring/trace_buffer")
 
     def append(self, span: Dict[str, Any]):
         with self._lock:
@@ -675,3 +678,44 @@ class traceme:
             for s in self._sinks:
                 s.append(span)
         return False
+
+
+# ---------------------------------------------------------------------------
+# /stf/sync/* — the lock-witness plane's own metrics. Created HERE (not
+# in platform.sync) because sync is stdlib-only — monitoring's own
+# locks come from it, so the import can only run this direction. The
+# families register at import time (the docs/OBSERVABILITY.md drift
+# gate requires it) and the cell-update callables are injected into
+# sync, which calls them outside its internal lock with a reentrancy
+# guard set.
+# ---------------------------------------------------------------------------
+
+_sync_contentions = Counter(
+    "/stf/sync/contentions",
+    "Contended sync.Lock acquisitions (waits >= 100us)", "lock")
+_sync_wait_seconds = Sampler(
+    "/stf/sync/contention_wait_seconds",
+    ExponentialBuckets(1e-4, 4.0, 10),
+    "Seconds spent blocked on contended sync.Lock acquires", "lock")
+_sync_potential_deadlocks = Counter(
+    "/stf/sync/potential_deadlocks",
+    "Lock-order cycles observed by the witness (potential deadlocks, "
+    "deduped by cycle)", "cycle")
+_sync_rank_violations = Counter(
+    "/stf/sync/rank_violations",
+    "Acquisitions of a lower-ranked lock while holding a higher-ranked "
+    "one", "lock")
+_sync_witness_edges = IntGauge(
+    "/stf/sync/witness_edges",
+    "Distinct lock-order edges in the witness graph")
+
+_sync.bind_metrics(
+    contention=lambda lock:
+        _sync_contentions.get_cell(lock).increase_by(1),
+    wait=lambda lock, s: _sync_wait_seconds.get_cell(lock).add(s),
+    cycle=lambda key:
+        _sync_potential_deadlocks.get_cell(key).increase_by(1),
+    violation=lambda lock:
+        _sync_rank_violations.get_cell(lock).increase_by(1),
+    edges=lambda n: _sync_witness_edges.get_cell().set(n),
+)

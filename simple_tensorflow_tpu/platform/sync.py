@@ -1,0 +1,752 @@
+"""Named, rank-ordered locks with a process-global lock-order witness
+(ref: absl::Mutex's deadlock detector — absl/synchronization/mutex.cc,
+DeadlockCheck() — and the FreeBSD witness(4) lock-order verifier).
+
+Every lock in the stf runtime is created through this module instead of
+raw ``threading.Lock()``:
+
+    _lock = sync.Lock("serving/batcher_outputs", rank=sync.RANK_STATE)
+
+A lock has a *name* (stable identity; many instances may share one
+name — e.g. every monitoring cell lock is ``monitoring/cell``) and a
+*rank* (lower rank = acquired first / outer). The witness maintains:
+
+- **held stacks** — per-thread list of currently-held locks with the
+  acquisition site (file:line), visible cross-thread so watchdog/
+  ``/flightz``/``/syncz`` dumps can say what a wedged thread holds;
+- **witness graph** — a digraph over lock *names*: edge A→B is recorded
+  the first time any thread acquires B while holding A, with both
+  sites. A cycle means a *potential* deadlock — reported (metric +
+  flight-recorder event + one-time log) even if the deadlock never
+  actually fires;
+- **wait-for graph** — during a *contended* blocking acquire the
+  waiting thread is parked in a global map; thread→owner edges form
+  the wait-for graph, whose cycles are *live* deadlocks (surfaced by
+  the watchdog's wedge dump);
+- **rank violations** — acquiring a lock whose declared rank is
+  strictly lower than a lock already held (outer-after-inner) is
+  recorded, never raised.
+
+Hot path: one extra try-acquire plus ~two frame-attribute reads on the
+uncontended path (``f_lineno`` must be read eagerly — it mutates as the
+frame executes). ``STF_LOCK_WITNESS=0`` (or ``set_witness_enabled(
+False)``) reduces a sync.Lock to a plain lock plus one attribute check.
+
+Import discipline: this module is **stdlib-only** — ``platform.
+monitoring`` builds its own locks from it, so it cannot import
+monitoring back. Monitoring registers the ``/stf/sync/*`` families at
+its import end and injects them via :func:`bind_metrics`; the flight
+recorder is reached lazily through ``sys.modules`` only.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Lock", "RLock", "Condition",
+    "RANK_LIFECYCLE", "RANK_SESSION", "RANK_ENGINE", "RANK_QUEUE",
+    "RANK_STATE", "RANK_TELEMETRY", "RANK_METRICS", "LEAF",
+    "set_witness_enabled", "witness_enabled", "reset_witness",
+    "witness_snapshot", "potential_deadlocks", "all_held_locks",
+    "wait_graph", "bind_metrics", "known_locks",
+]
+
+# Rank bands: lower = outer (acquired first). Equal ranks may nest in
+# either order (e.g. two STATE locks guarding unrelated objects); only
+# a *strictly lower* rank acquired while a higher one is held is a
+# violation. LEAF locks must never have another sync lock taken under
+# them.
+RANK_LIFECYCLE = 100   # server/session open-close, writer caches
+RANK_SESSION = 200     # Session/Graph state
+RANK_ENGINE = 300      # pipeline runs, checkpoint manager
+RANK_QUEUE = 400       # ring buffers, TF queue ops, accumulators
+RANK_STATE = 500       # small per-object state (futures, registries)
+RANK_TELEMETRY = 600   # recorder/tracing/ledger
+RANK_METRICS = 700     # monitoring registry + family locks
+LEAF = 900             # monitoring cells — nothing nests under these
+
+_CONTENTION_SLOW_S = 1e-4  # waits shorter than this skip the sampler
+
+_enabled = os.environ.get("STF_LOCK_WITNESS", "1").strip().lower() \
+    not in ("0", "false", "off")
+# Optional per-process acquire counter for bench pinning (cheap enough
+# to keep a plain int bumped without a lock: CPython int += under GIL
+# loses increments only under contention, and the bench arms are
+# single-purpose).
+_count_acquires = False
+_acquire_count = 0
+
+_tls = threading.local()
+
+# The witness's own mutable state is guarded by ONE raw lock. Rule:
+# never acquire a sync.Lock, emit a metric, or touch the flight
+# recorder while holding it — collect, release, then report.
+_global_lock = threading.Lock()
+
+# name -> {"rank": int, "instances": int, "blocking_ok": bool}
+_locks: Dict[str, Dict[str, Any]] = {}
+# (holder_name, acquired_name) -> (holder_site, acquired_site) of the
+# first observation, each a raw (filename, lineno) tuple.
+_edges: Dict[Tuple[str, str], Tuple[Tuple[str, int],
+                                    Tuple[str, int]]] = {}
+# adjacency over names, for cycle detection
+_succ: Dict[str, set] = {}
+# cycles already reported, keyed by the canonicalised name tuple
+_reported_cycles: Dict[Tuple[str, ...], Dict[str, Any]] = {}
+_rank_violations: List[Dict[str, Any]] = []
+# (acquired_name, held_name) pairs already recorded as violations —
+# dedupe so a hot inverted pair reports once, not per acquisition, and
+# so the lock-free fast path below can skip it
+_violation_pairs: set = set()
+_MAX_VIOLATIONS = 64
+
+# thread ident -> the SAME list object as that thread's TLS held stack
+# (entries: (lock, name, rank, site) tuples — immutable, cheapest to
+# build on the hot path); other threads only snapshot it.
+_held_by_thread: Dict[int, List[list]] = {}
+_thread_names: Dict[int, str] = {}
+# thread ident -> (lock_name, site_tuple, since_monotonic) while
+# parked in a contended blocking acquire.
+_waiting: Dict[int, Tuple[str, Tuple[str, int], float]] = {}
+
+# Metric hooks injected by platform.monitoring (bind_metrics). Each is
+# a plain callable; None until monitoring has imported.
+_m_contention: Optional[Callable[[str], None]] = None
+_m_wait: Optional[Callable[[str, float], None]] = None
+_m_cycle: Optional[Callable[[str], None]] = None
+_m_violation: Optional[Callable[[str], None]] = None
+_m_edges: Optional[Callable[[int], None]] = None
+
+
+def bind_metrics(contention: Callable[[str], None],
+                 wait: Callable[[str, float], None],
+                 cycle: Callable[[str], None],
+                 violation: Callable[[str], None],
+                 edges: Callable[[int], None]) -> None:
+    """Called once by platform.monitoring at its import end, injecting
+    the ``/stf/sync/*`` cell-update callables (sync cannot import
+    monitoring — monitoring's own locks come from here)."""
+    global _m_contention, _m_wait, _m_cycle, _m_violation, _m_edges
+    _m_contention, _m_wait = contention, wait
+    _m_cycle, _m_violation, _m_edges = cycle, violation, edges
+    _m_edges(len(_edges))
+
+
+def set_witness_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def witness_enabled() -> bool:
+    return _enabled
+
+
+def _set_count_acquires(on: bool) -> int:
+    """Bench hook: toggle acquire counting; returns the running count."""
+    global _count_acquires, _acquire_count
+    _count_acquires = bool(on)
+    return _acquire_count
+
+
+def _held() -> List[list]:
+    """This thread's held-lock stack, creating + globally registering
+    it on first use."""
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+        ident = threading.get_ident()
+        with _global_lock:
+            _held_by_thread[ident] = st
+            _thread_names[ident] = threading.current_thread().name
+    return st
+
+
+def _fmt(site: Tuple[str, int]) -> str:
+    """Sites are kept as raw (filename, lineno) tuples on the hot path
+    — ``f_lineno`` must be read eagerly (it mutates as the frame
+    executes) but the string is only built at report time."""
+    return f"{site[0]}:{site[1]}"
+
+
+def _flight_event(kind: str, **fields) -> None:
+    """Best-effort flight-recorder event via sys.modules — the witness
+    must never be what first imports telemetry."""
+    rec_mod = sys.modules.get("simple_tensorflow_tpu.telemetry.recorder")
+    if rec_mod is None:
+        return
+    try:
+        rec_mod.get_recorder().record(kind, **fields)
+    except Exception:  # noqa: BLE001 — forensics never break the app
+        pass
+
+
+def _record_edges(held: List[list], name: str, rank: int,
+                  site: str) -> None:
+    """Witness the acquisition of ``name`` while ``held`` locks are
+    held: rank check + new-edge insertion + cycle detection. Reports
+    (metrics/flight events/log) are emitted AFTER _global_lock is
+    released; a TLS guard stops report side-effects from re-entering
+    edge recording."""
+    if getattr(_tls, "reporting", False):
+        return
+    # Lock-free fast path (the steady-state hot path): every held->name
+    # edge already witnessed, every rank inversion already recorded.
+    # Reads race benignly under the GIL — a stale miss only sends this
+    # one acquisition down the slow path below.
+    for entry in held:
+        h_name = entry[1]
+        if h_name == name:
+            continue
+        s = _succ.get(h_name)
+        if s is None or name not in s:
+            break
+        if rank < entry[2] and (name, h_name) not in _violation_pairs:
+            break
+    else:
+        return
+    new_cycles = []
+    new_violation = None
+    with _global_lock:
+        for entry in held:
+            h_name, h_rank, h_site = entry[1], entry[2], entry[3]
+            if h_name == name:
+                continue
+            if rank < h_rank and (name, h_name) not in _violation_pairs:
+                _violation_pairs.add((name, h_name))
+                v = {
+                    "acquired": name, "acquired_rank": rank,
+                    "acquired_site": _fmt(site), "held": h_name,
+                    "held_rank": h_rank, "held_site": _fmt(h_site),
+                    "thread": threading.current_thread().name,
+                }
+                if len(_rank_violations) < _MAX_VIOLATIONS:
+                    _rank_violations.append(v)
+                if new_violation is None:
+                    new_violation = v
+            key = (h_name, name)
+            if key in _edges:
+                continue
+            _edges[key] = (h_site, site)
+            _succ.setdefault(h_name, set()).add(name)
+            # New edge h_name->name: a cycle through it exists iff
+            # h_name is reachable from name.
+            cyc = _find_path(name, h_name)
+            if cyc is not None:
+                cycle_names = tuple(cyc)  # name .. h_name
+                canon = _canonical(cycle_names)
+                if canon not in _reported_cycles:
+                    report = _cycle_report(cycle_names)
+                    _reported_cycles[canon] = report
+                    new_cycles.append(report)
+        n_edges = len(_edges)
+    # --- side-effects outside _global_lock ---
+    _tls.reporting = True
+    try:
+        if _m_edges is not None:
+            _m_edges(n_edges)
+        if new_violation is not None:
+            if _m_violation is not None:
+                _m_violation(name)
+            _flight_event(
+                "lock_rank_violation", lock=name, rank=rank,
+                site=new_violation["acquired_site"],
+                held=new_violation["held"],
+                held_rank=new_violation["held_rank"],
+                held_site=new_violation["held_site"])
+        for report in new_cycles:
+            if _m_cycle is not None:
+                _m_cycle(report["key"])
+            _flight_event("potential_deadlock", cycle=report["key"],
+                          edges=report["edges"])
+            print(f"[stf.sync] POTENTIAL DEADLOCK: lock-order cycle "
+                  f"{report['key']}:", file=sys.stderr)
+            for e in report["edges"]:
+                print(f"[stf.sync]   {e['from']} (held at "
+                      f"{e['from_site']}) -> {e['to']} (acquired at "
+                      f"{e['to_site']})", file=sys.stderr)
+    finally:
+        _tls.reporting = False
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS over _succ from src to dst; returns the node path
+    [src, ..., dst] or None. Caller holds _global_lock."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _succ.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _canonical(names: Tuple[str, ...]) -> Tuple[str, ...]:
+    """Rotate a cycle's name tuple to start at its min element so the
+    same cycle discovered from different edges dedups."""
+    i = names.index(min(names))
+    return names[i:] + names[:i]
+
+
+def _cycle_report(cycle_names: Tuple[str, ...]) -> Dict[str, Any]:
+    """Edge list (with both sites) for a name cycle. Caller holds
+    _global_lock."""
+    edges = []
+    n = len(cycle_names)
+    for i in range(n):
+        a, b = cycle_names[i], cycle_names[(i + 1) % n]
+        sites = _edges.get((a, b))
+        edges.append({
+            "from": a, "from_site": _fmt(sites[0]) if sites else "?",
+            "to": b, "to_site": _fmt(sites[1]) if sites else "?"})
+    return {"key": " -> ".join(_canonical(cycle_names)
+                               + (_canonical(cycle_names)[0],)),
+            "cycle": list(_canonical(cycle_names)), "edges": edges}
+
+
+class Lock:
+    """Named, ranked drop-in for ``threading.Lock``.
+
+    ``blocking_ok=True`` declares that blocking calls under this lock
+    are by-design (e.g. checkpoint writer lifecycle serialising stop()
+    against submit()); tools/runtime_lint.py honours the flag so the
+    lint allowlist stays empty while the exemption lives in reviewed
+    code.
+    """
+
+    __slots__ = ("_lock", "name", "rank", "blocking_ok")
+
+    _factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str, rank: int = RANK_STATE, *,
+                 blocking_ok: bool = False):
+        self._lock = self._factory()
+        self.name = name
+        self.rank = rank
+        self.blocking_ok = blocking_ok
+        with _global_lock:
+            info = _locks.get(name)
+            if info is None:
+                _locks[name] = {"rank": rank, "instances": 1,
+                                "blocking_ok": blocking_ok}
+            else:
+                info["instances"] += 1
+                if info["rank"] != rank:
+                    # Same name must mean same rank — first wins, note
+                    # the conflict rather than raising on import paths.
+                    info.setdefault("rank_conflicts", set()).add(rank)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1,
+                *, _depth: int = 1) -> bool:
+        # _depth: stack distance to the frame blamed as the acquisition
+        # site — 1 for direct acquire()/`with lock:` (__enter__ is an
+        # alias, not a wrapper), 2 when Condition delegates.
+        if not _enabled:
+            return self._lock.acquire(blocking, timeout)
+        if _count_acquires:
+            global _acquire_count
+            _acquire_count += 1
+        try:
+            held = _tls.held  # inlined _held(): this IS the hot path
+        except AttributeError:
+            held = _held()
+        f = sys._getframe(_depth)
+        site = (f.f_code.co_filename, f.f_lineno)
+        if held:
+            _record_edges(held, self.name, self.rank, site)
+        if self._lock.acquire(False):
+            held.append((self, self.name, self.rank, site))
+            return True
+        if not blocking:
+            return False
+        # Contended slow path: park in the wait-for graph, time the
+        # wait, export contention.
+        ident = threading.get_ident()
+        t0 = time.monotonic()
+        with _global_lock:
+            _waiting[ident] = (self.name, site, t0)
+        try:
+            got = self._lock.acquire(True, timeout)
+        finally:
+            with _global_lock:
+                _waiting.pop(ident, None)
+        if got:
+            held.append((self, self.name, self.rank, site))
+            wait_s = time.monotonic() - t0
+            if wait_s >= _CONTENTION_SLOW_S and not getattr(
+                    _tls, "reporting", False):
+                _tls.reporting = True
+                try:
+                    if _m_contention is not None:
+                        _m_contention(self.name)
+                    if _m_wait is not None:
+                        _m_wait(self.name, wait_s)
+                finally:
+                    _tls.reporting = False
+        return got
+
+    def release(self) -> None:
+        if _enabled:
+            try:
+                held = _tls.held
+            except AttributeError:
+                held = _held()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] is self:
+                    del held[i]
+                    break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    # Aliased, not delegated: acquire() reads sys._getframe(1) for the
+    # acquisition site, and the alias keeps the caller exactly one
+    # frame up. (`with lock as x` binds True, like threading.Lock.)
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return (f"<stf.sync.{type(self).__name__} {self.name!r} "
+                f"rank={self.rank}>")
+
+
+class RLock(Lock):
+    """Named, ranked drop-in for ``threading.RLock``. Reentrant
+    acquisition records no new witness edges (absl does the same — a
+    lock cannot deadlock against itself on one thread)."""
+
+    __slots__ = ("_count",)
+
+    _factory = staticmethod(threading.RLock)
+
+    def __init__(self, name: str, rank: int = RANK_STATE, *,
+                 blocking_ok: bool = False):
+        super().__init__(name, rank, blocking_ok=blocking_ok)
+        self._count = 0  # depth for the OWNING thread only
+
+    def acquire(self, blocking: bool = True, timeout: float = -1,
+                *, _depth: int = 1) -> bool:
+        if not _enabled:
+            if self._lock.acquire(blocking, timeout):
+                self._count += 1
+                return True
+            return False
+        held = _held()
+        for entry in held:
+            if entry[0] is self:
+                # Reentry on the owning thread: cannot block, no edges.
+                self._lock.acquire()
+                self._count += 1
+                return True
+        f = sys._getframe(_depth)
+        site = (f.f_code.co_filename, f.f_lineno)
+        if _count_acquires:
+            global _acquire_count
+            _acquire_count += 1
+        if held:
+            _record_edges(held, self.name, self.rank, site)
+        if self._lock.acquire(False):
+            self._count += 1
+            held.append((self, self.name, self.rank, site))
+            return True
+        if not blocking:
+            return False
+        ident = threading.get_ident()
+        t0 = time.monotonic()
+        with _global_lock:
+            _waiting[ident] = (self.name, site, t0)
+        try:
+            got = self._lock.acquire(True, timeout)
+        finally:
+            with _global_lock:
+                _waiting.pop(ident, None)
+        if got:
+            self._count += 1
+            held.append((self, self.name, self.rank, site))
+            wait_s = time.monotonic() - t0
+            if wait_s >= _CONTENTION_SLOW_S and not getattr(
+                    _tls, "reporting", False):
+                _tls.reporting = True
+                try:
+                    if _m_contention is not None:
+                        _m_contention(self.name)
+                    if _m_wait is not None:
+                        _m_wait(self.name, wait_s)
+                finally:
+                    _tls.reporting = False
+        return got
+
+    def release(self) -> None:
+        self._count -= 1
+        if _enabled and self._count == 0:
+            held = _held()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] is self:
+                    del held[i]
+                    break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._count > 0
+
+    __enter__ = acquire  # same frame-depth aliasing as Lock
+    __exit__ = Lock.__exit__
+
+
+class Condition:
+    """``threading.Condition`` over a sync.Lock. ``wait()`` releases
+    the lock, so the held-stack entry (and wait-for-graph ownership)
+    is suspended for the duration and restored on wakeup — otherwise a
+    parked waiter would look like a holder in wedge dumps.
+
+    Multiple Conditions may share one sync.Lock (ring buffers'
+    not_empty/not_full); a standalone ``Condition(name=...)`` creates
+    its own internal lock.
+    """
+
+    __slots__ = ("_sync_lock", "_cond")
+
+    def __init__(self, lock: Optional[Lock] = None, *,
+                 name: str = "sync/anon_condition",
+                 rank: int = RANK_QUEUE):
+        if lock is None:
+            lock = Lock(name, rank)
+        self._sync_lock = lock
+        # The raw condition shares the sync lock's INNER primitive so
+        # acquire/release bookkeeping stays in the wrapper.
+        self._cond = threading.Condition(lock._lock)
+
+    @property
+    def lock(self) -> Lock:
+        return self._sync_lock
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # _depth=2: blame the frame calling the Condition, not this one
+        return self._sync_lock.acquire(blocking, timeout, _depth=2)
+
+    def release(self):
+        self._sync_lock.release()
+
+    def __enter__(self):
+        self._sync_lock.acquire(_depth=2)
+        return self
+
+    def __exit__(self, *exc):
+        self._sync_lock.release()
+
+    def _suspend(self) -> Optional[list]:
+        if not _enabled:
+            return None
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self._sync_lock:
+                return held.pop(i)
+        return None
+
+    def _resume(self, entry: Optional[list]) -> None:
+        if entry is not None:
+            _held().append(entry)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        entry = self._suspend()
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._resume(entry)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        entry = self._suspend()
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            self._resume(entry)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self):
+        return f"<stf.sync.Condition over {self._sync_lock!r}>"
+
+
+def leaf_lock(name: str) -> "threading.Lock":
+    """A NAMED leaf lock that is exempt from witness bookkeeping: the
+    returned object is a raw ``threading.Lock`` — C-speed acquire, no
+    held-stack entry, no lock-order edges, no wait-for node. The name
+    is registered (``known_locks()`` / ``/syncz`` show it with
+    ``leaf: true``) so the lock stays discoverable, but the dynamic
+    witness cannot see it.
+
+    Contract: a leaf critical section must not acquire ANY lock and
+    must not block — enforced at review time by
+    ``tools/runtime_lint.py`` (``nested-under-leaf`` +
+    ``blocking-under-lock``); since the witness is blind here, the
+    static rule is the only guard, which is why it has no escape
+    flag. Reserve this for nanosecond-scale critical sections on the
+    hottest paths (metric cells: one integer add per request/step),
+    where even the witness's tuple-append fast path would multiply the
+    cost of the work being guarded."""
+    with _global_lock:
+        info = _locks.get(name)
+        if info is None:
+            _locks[name] = {"rank": LEAF, "instances": 1,
+                            "blocking_ok": False, "leaf": True}
+        else:
+            info["instances"] += 1
+            info["leaf"] = True
+    return threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# Introspection surfaces (watchdog, /syncz, conftest, tests)
+
+
+def known_locks() -> Dict[str, Dict[str, Any]]:
+    with _global_lock:
+        return {name: {"rank": info["rank"],
+                       "instances": info["instances"],
+                       "blocking_ok": info["blocking_ok"],
+                       "leaf": info.get("leaf", False)}
+                for name, info in _locks.items()}
+
+
+def all_held_locks() -> Dict[str, List[Dict[str, Any]]]:
+    """Per-thread held locks, cross-thread view. Dead threads' entries
+    are pruned as a side effect. Keyed ``"name (ident)"``."""
+    live = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    with _global_lock:
+        for ident in list(_held_by_thread):
+            if ident not in live:
+                del _held_by_thread[ident]
+                _thread_names.pop(ident, None)
+                continue
+            st = _held_by_thread[ident]
+            if not st:
+                continue
+            out[f"{live[ident]} ({ident})"] = [
+                {"lock": e[1], "rank": e[2], "site": _fmt(e[3])}
+                for e in list(st)]
+    return out
+
+
+def held_by_ident() -> Dict[int, List[Dict[str, Any]]]:
+    """Like :func:`all_held_locks` but keyed by thread ident — the
+    flight recorder joins this against ``sys._current_frames()`` for
+    per-thread held-locks in wedge dumps."""
+    live = {t.ident for t in threading.enumerate()}
+    out: Dict[int, List[Dict[str, Any]]] = {}
+    with _global_lock:
+        for ident, st in _held_by_thread.items():
+            if ident in live and st:
+                out[ident] = [{"lock": e[1], "rank": e[2],
+                               "site": _fmt(e[3])} for e in list(st)]
+    return out
+
+
+def wait_graph() -> Dict[str, Any]:
+    """The live wait-for graph: per waiting thread, which lock it
+    wants, who holds that lock (by lock-name match against held
+    stacks), and any thread-level cycle (= a REAL deadlock)."""
+    live = {t.ident: t.name for t in threading.enumerate()}
+    with _global_lock:
+        waiting = dict(_waiting)
+        holders: Dict[str, List[int]] = {}
+        for ident, st in _held_by_thread.items():
+            if ident not in live:
+                continue
+            for e in list(st):
+                holders.setdefault(e[1], []).append(ident)
+    edges = []
+    adj: Dict[int, set] = {}
+    for ident, (lock_name, site, since) in waiting.items():
+        for owner in holders.get(lock_name, ()):
+            if owner == ident:
+                continue
+            edges.append({
+                "waiter": live.get(ident, str(ident)),
+                "waiter_ident": ident, "lock": lock_name,
+                "site": _fmt(site),
+                "waited_s": round(time.monotonic() - since, 3),
+                "owner": live.get(owner, str(owner)),
+                "owner_ident": owner,
+            })
+            adj.setdefault(ident, set()).add(owner)
+    # Cycle detection over thread idents (colour DFS).
+    cycles: List[List[str]] = []
+    colour: Dict[int, int] = {}
+
+    def visit(node: int, path: List[int]) -> None:
+        colour[node] = 1
+        path.append(node)
+        for nxt in adj.get(node, ()):
+            c = colour.get(nxt, 0)
+            if c == 0:
+                visit(nxt, path)
+            elif c == 1:
+                cyc = path[path.index(nxt):] + [nxt]
+                cycles.append([live.get(i, str(i)) for i in cyc])
+        path.pop()
+        colour[node] = 2
+
+    for node in list(adj):
+        if colour.get(node, 0) == 0:
+            visit(node, [])
+    return {"edges": edges, "cycles": cycles,
+            "deadlocked": bool(cycles)}
+
+
+def potential_deadlocks() -> List[Dict[str, Any]]:
+    """All lock-order cycles the witness has ever observed (deduped)."""
+    with _global_lock:
+        return [dict(r) for r in _reported_cycles.values()]
+
+
+def rank_violations() -> List[Dict[str, Any]]:
+    with _global_lock:
+        return [dict(v) for v in _rank_violations]
+
+
+def witness_snapshot() -> Dict[str, Any]:
+    """The /syncz payload (minus wait_graph/held, which the endpoint
+    adds live)."""
+    with _global_lock:
+        edges = [{"from": a, "to": b, "from_site": _fmt(s[0]),
+                  "to_site": _fmt(s[1])}
+                 for (a, b), s in _edges.items()]
+        locks = {name: {"rank": info["rank"],
+                        "instances": info["instances"],
+                        "blocking_ok": info["blocking_ok"],
+                        "leaf": info.get("leaf", False)}
+                 for name, info in _locks.items()}
+        cycles = [dict(r) for r in _reported_cycles.values()]
+        violations = [dict(v) for v in _rank_violations]
+    return {"enabled": _enabled, "locks": locks, "edges": edges,
+            "potential_deadlocks": cycles,
+            "rank_violations": violations}
+
+
+def reset_witness() -> None:
+    """Drop accumulated edges/cycles/violations (tests). Lock registry
+    and held stacks are left alone — they reflect live objects."""
+    with _global_lock:
+        _edges.clear()
+        _succ.clear()
+        _reported_cycles.clear()
+        del _rank_violations[:]
+        _violation_pairs.clear()
+    if _m_edges is not None:
+        _m_edges(0)

@@ -13,8 +13,11 @@ import threading
 
 import numpy as np
 
+from ..platform import sync as _sync
+
 _sessions = {}
-_lock = threading.Lock()
+_lock = _sync.Lock("runtime/c_session_registry",
+                  rank=_sync.RANK_STATE)
 _next_id = [1]
 
 

@@ -19,7 +19,10 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-_lock = threading.Lock()
+from ..platform import sync as _sync
+
+_lock = _sync.Lock("native/lib_load", rank=_sync.RANK_LIFECYCLE,
+                   blocking_ok=True)
 _lib = None
 _tried = False
 
@@ -384,7 +387,8 @@ class ArenaPool:
         self._last_slot = 0
         # acquire() runs in pipeline stage threads while mark_in_flight
         # runs in the transfer thread; rotation must be atomic
-        self._rotate_lock = threading.Lock()
+        self._rotate_lock = _sync.Lock("native/arena_rotate",
+                                       rank=_sync.LEAF)
 
     def acquire(self):
         """Claim the next slot for direct batch assembly (the stf.data
@@ -466,7 +470,9 @@ _session_lib = None
 _session_tried = False
 # own lock: the session-lib build can take minutes and must not stall
 # unrelated native calls serialized on _lock
-_session_lock = threading.Lock()
+_session_lock = _sync.Lock("native/session_lib_load",
+                           rank=_sync.RANK_LIFECYCLE,
+                           blocking_ok=True)
 
 
 def load_session_lib():

@@ -49,6 +49,7 @@ import numpy as np
 from ..data.pipeline import _DONE, TIMED_OUT, RingBuffer
 from ..framework import errors
 from ..platform import monitoring
+from ..platform import sync as _sync
 from ..telemetry import recorder as _flight_mod
 from ..telemetry import tracing as _req_tracing
 from ..telemetry import watchdog as _watchdog_mod
@@ -133,7 +134,8 @@ class _BatchOutputs:
         self._outputs = outputs
         self._model = model
         self._trace_ids = trace_ids
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("serving/batch_outputs",
+                                rank=_sync.RANK_STATE)
         self._fetched = False
 
     def row(self, index: int) -> Dict[str, np.ndarray]:
@@ -467,4 +469,5 @@ class ContinuousBatcher:
         self._queue.close()
         if self._thread.is_alive() and \
                 threading.current_thread() is not self._thread:
-            self._thread.join(timeout)
+            _flight_mod.checked_join(self._thread, timeout,
+                                     f"ContinuousBatcher.close({self.name})")

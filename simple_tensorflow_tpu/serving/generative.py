@@ -941,7 +941,11 @@ class GenerativeEngine:
         self._queue.close()
         if self._thread.is_alive() and \
                 threading.current_thread() is not self._thread:
-            self._thread.join(timeout)
+            # checked: a wedged engine thread must be LOUD (flight
+            # `wedge` event with its stack + held locks), not silently
+            # leaked past close()
+            _flight_mod.checked_join(self._thread, timeout,
+                                     f"GenerativeEngine.close({self.name})")
         self._model.close()
         if self._draft is not None:
             self._draft.close()

@@ -42,6 +42,7 @@ import numpy as np
 from ..framework import dtypes as dtypes_mod
 from ..framework import errors
 from ..platform import monitoring
+from ..platform import sync as _sync
 from ..platform import tf_logging as logging
 from .batcher import (ContinuousBatcher, ServeFuture, ServeRequest,
                       _metric_requests)
@@ -58,7 +59,8 @@ _metric_aot_buckets = monitoring.Counter(
     "/stf/serving/aot_buckets_compiled",
     "Per-bucket AOT executables compiled at model load", "model")
 
-_servers_lock = threading.Lock()
+_servers_lock = _sync.Lock("serving/servers",
+                           rank=_sync.RANK_STATE)
 
 
 def _count_models(delta: int):
@@ -139,7 +141,8 @@ class ModelServer:
         # concurrent loads of the same name cannot both build servables
         # (the loser's session/batcher threads would leak unreachable)
         self._loading: set = set()
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("serving/model_server",
+                                rank=_sync.RANK_LIFECYCLE)
         self._closed = False
         live_servers.add(self)
 

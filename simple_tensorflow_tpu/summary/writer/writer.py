@@ -17,6 +17,7 @@ import time
 
 from ...lib.io.tf_record import TFRecordWriter
 from ...lib.proto import Writer as ProtoWriter
+from ...platform import sync as _sync
 
 
 def _encode_event(wall_time, step=None, file_version=None, summary_bytes=None,
@@ -73,7 +74,8 @@ class FileWriter:
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._flush_secs = flush_secs
         self._closed = False
-        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="stf_summary_writer")
         self._worker.start()
         if graph is not None:
             self.add_graph(graph)
@@ -190,7 +192,8 @@ class FileWriterCache:
     """(ref: python/summary/writer/writer_cache.py)."""
 
     _cache = {}
-    _lock = threading.Lock()
+    _lock = _sync.Lock("summary/writer_cache",
+                       rank=_sync.RANK_LIFECYCLE)
 
     @staticmethod
     def get(logdir):

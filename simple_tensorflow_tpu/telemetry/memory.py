@@ -49,6 +49,7 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 from ..platform import monitoring
+from ..platform import sync as _sync
 
 # -- ledger classes ----------------------------------------------------------
 CLASS_WEIGHTS = "weights"
@@ -121,7 +122,8 @@ class MemoryLedger:
         # locked region can run a weakref.finalize callback (a dropped
         # session's _release_ledger_tokens) that re-enters release()
         # on the SAME thread — a plain Lock self-deadlocks there.
-        self._lock = threading.RLock()
+        self._lock = _sync.RLock("telemetry/memory_ledger",
+                                 rank=_sync.RANK_TELEMETRY)
         self._entries: Dict[int, _Entry] = {}
         self._next_token = 1
         self._totals: Dict[Any, int] = {}   # (class, owner) -> bytes

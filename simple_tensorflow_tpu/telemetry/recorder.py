@@ -41,6 +41,8 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 from ..platform import monitoring
+from ..platform import sync as _sync
+from ..platform import tf_logging as logging
 
 _metric_events = monitoring.Counter(
     "/stf/telemetry/flight_events",
@@ -54,8 +56,10 @@ DEFAULT_CAPACITY = int(os.environ.get("STF_FLIGHT_RECORDER_EVENTS", "4096"))
 
 # prefixes of threads this library owns; thread_stacks() flags them so a
 # wedge dump separates stf machinery from application threads
-_STF_THREAD_PREFIXES = ("stf_data_", "stf_serving_", "stf_telemetry_",
-                        "stf_sharding_", "stf_ckpt_")
+# every runtime thread carries an stf_ name (enforced by
+# tools/runtime_lint.py since ISSUE 18), so the prefix check is the bare
+# namespace
+_STF_THREAD_PREFIXES = ("stf_",)
 
 
 def _sanitize(value):
@@ -80,7 +84,10 @@ class FlightRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._ring: "collections.deque" = collections.deque(
             maxlen=max(16, int(capacity)))
-        self._lock = threading.Lock()
+        # leaf: one ring append per event (the highest-rate lock after
+        # the metric cells); bodies never acquire — enforced by
+        # runtime_lint's nested-under-leaf rule
+        self._lock = _sync.leaf_lock("telemetry/recorder")
         self.enabled = os.environ.get("STF_FLIGHT_RECORDER", "1") != "0"
         self._dropped = 0
         self._recorded = 0
@@ -171,6 +178,9 @@ class FlightRecorder:
         if stacks:
             for rec in thread_stacks():
                 lines.append(json.dumps(rec, default=str))
+            # which thread waits on which lock held by whom — a REAL
+            # deadlock shows up as a cycle here (ISSUE 18)
+            lines.append(json.dumps(wait_graph_record(), default=str))
         lines.append(json.dumps(
             {"kind": "dump_info", "t": time.time(), "reason": reason,
              "pid": os.getpid(), **{k: v for k, v in self.stats().items()
@@ -237,15 +247,21 @@ class FlightRecorder:
 
 def thread_stacks() -> List[Dict[str, Any]]:
     """One ``thread_stack`` record per live thread: name, ident, daemon
-    flag, whether it is an stf-owned thread, and the formatted stack.
+    flag, whether it is an stf-owned thread, the formatted stack, and —
+    when the sync witness is on — the locks the thread currently holds
+    with their acquisition sites (platform.sync held-stack registry).
     The wedge-forensics payload (`sys._current_frames`, the same data
     ``faulthandler`` prints)."""
+    try:
+        held = _sync.held_by_ident()
+    except Exception:  # noqa: BLE001 — forensics never sink the dump
+        held = {}
     frames = sys._current_frames()
     out = []
     for t in threading.enumerate():
         frame = frames.get(t.ident)
         stack = traceback.format_stack(frame) if frame is not None else []
-        out.append({
+        rec = {
             "kind": "thread_stack",
             "t": time.time(),
             "thread": t.name,
@@ -253,8 +269,52 @@ def thread_stacks() -> List[Dict[str, Any]]:
             "daemon": t.daemon,
             "stf": t.name.startswith(_STF_THREAD_PREFIXES),
             "stack": [ln.rstrip("\n") for ln in stack],
-        })
+        }
+        if t.ident in held:
+            rec["held_locks"] = held[t.ident]
+        out.append(rec)
     return out
+
+
+def wait_graph_record() -> Dict[str, Any]:
+    """The live lock wait-for graph as one dump record. ``cycles``
+    non-empty means threads are deadlocked RIGHT NOW — the watchdog
+    wedge dump leads with this."""
+    try:
+        g = _sync.wait_graph()
+    except Exception as e:  # noqa: BLE001 — forensics never sink
+        g = {"edges": [], "cycles": [], "deadlocked": False,
+             "error": str(e)}
+    return {"kind": "wait_graph", "t": time.time(), **g}
+
+
+def checked_join(thread: "threading.Thread", timeout: float, what: str,
+                 **fields) -> bool:
+    """``thread.join(timeout)`` that refuses to shrug off failure: if
+    the thread is still alive afterwards it logs, records a ``wedge``
+    flight event carrying the stuck thread's current stack + held locks
+    + the wait-for graph, and returns False (callers surface that —
+    e.g. the conftest leak fixture fails on the surviving thread).
+    Returns True when the thread is down."""
+    thread.join(timeout)
+    if not thread.is_alive():
+        return True
+    frame = sys._current_frames().get(thread.ident)
+    stack = [ln.rstrip("\n") for ln in traceback.format_stack(frame)] \
+        if frame is not None else []
+    try:
+        held = _sync.held_by_ident().get(thread.ident, [])
+    except Exception:  # noqa: BLE001
+        held = []
+    wait = wait_graph_record()
+    logging.error(
+        "stf: %s: thread %r still alive %.1fs after join — wedged "
+        "(flight recorder has its stack; wait-for cycles: %s)",
+        what, thread.name, timeout, wait.get("cycles") or "none")
+    _RECORDER.record("wedge", what=what, thread=thread.name,
+                     join_timeout_s=timeout, stack=stack,
+                     held_locks=held, wait_graph=wait, **fields)
+    return False
 
 
 # process-global singleton: every layer records into the same ring so a

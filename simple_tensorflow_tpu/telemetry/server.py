@@ -28,6 +28,10 @@ thread per request; started via ``stf.telemetry.start(port=...)`` or
   resolved mode, watched taps, per-step health history (grad/update
   norms, nonfinite tap counts), and the last-anomaly report with
   first-bad-op forensics when the bisector ran (docs/DEBUG.md).
+- ``/syncz``    — runtime concurrency plane (stf.analysis.concurrency):
+  named-lock registry with ranks, lock-order witness edges, potential
+  deadlocks (cycles with both acquisition sites), rank violations,
+  per-thread held locks, and the live wait-for graph.
 
 The server binds 127.0.0.1 by default: metrics surfaces are internal,
 exposure beyond localhost is a deployment decision (front it with your
@@ -46,6 +50,7 @@ from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..platform import monitoring
+from ..platform import sync as _sync
 from ..platform import tf_logging as logging
 from ..version import __version__
 from . import recorder as _recorder_mod
@@ -266,6 +271,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif endpoint == "/trainz":
                 self._reply(json.dumps(_trainz_info(), default=str,
                                        indent=2), "application/json")
+            elif endpoint == "/syncz":
+                from ..platform import sync as _sync_mod
+
+                info = _sync_mod.witness_snapshot()
+                info["held"] = _sync_mod.all_held_locks()
+                info["wait_graph"] = _sync_mod.wait_graph()
+                self._reply(json.dumps(info, default=str, indent=2),
+                            "application/json")
             elif endpoint == "/flightz":
                 stacks = (q.get("stacks") or ["1"])[0] != "0"
                 self._reply(
@@ -278,7 +291,7 @@ class _Handler(BaseHTTPRequestHandler):
                     + "".join(f'<li><a href="{p}">{p}</a></li>'
                               for p in ("/metrics", "/healthz", "/statusz",
                                         "/memz", "/tracez", "/flightz",
-                                        "/trainz"))
+                                        "/trainz", "/syncz"))
                     + "</ul></body></html>", "text/html")
             else:
                 self._reply(f"no such endpoint: {endpoint}\n",
@@ -330,8 +343,8 @@ class TelemetryServer:
         _recorder_mod.get_recorder().record(
             "telemetry_server", action="start", port=self.port)
         logging.info("telemetry: serving /metrics /healthz /statusz "
-                     "/memz /tracez /flightz /trainz on http://%s:%d",
-                     address, self.port)
+                     "/memz /tracez /flightz /trainz /syncz on "
+                     "http://%s:%d", address, self.port)
 
     @property
     def url(self) -> str:
@@ -349,14 +362,16 @@ class TelemetryServer:
         self._httpd.server_close()
         if self._thread.is_alive() and \
                 self._thread is not threading.current_thread():
-            self._thread.join(timeout)
+            _recorder_mod.checked_join(self._thread, timeout,
+                                       "TelemetryServer.stop")
 
     def __repr__(self):
         state = "closed" if self._closed else "serving"
         return f"<TelemetryServer {self.url} {state}>"
 
 
-_server_lock = threading.Lock()
+_server_lock = _sync.Lock("telemetry/server",
+                          rank=_sync.RANK_LIFECYCLE)
 _server: Optional[TelemetryServer] = None
 
 

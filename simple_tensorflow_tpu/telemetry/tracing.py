@@ -32,12 +32,15 @@ import uuid
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from . import recorder as _recorder_mod
+from ..platform import sync as _sync
 
 SPAN_RING_CAPACITY = int(os.environ.get("STF_TELEMETRY_SPANS", "4096"))
 
 _spans: "collections.deque" = collections.deque(
     maxlen=max(64, SPAN_RING_CAPACITY))
-_spans_lock = threading.Lock()
+# leaf: one append/snapshot per span, the second-highest-rate lock in
+# the process; its bodies never acquire (runtime_lint nested-under-leaf)
+_spans_lock = _sync.leaf_lock("telemetry/spans")
 
 _local = threading.local()
 

@@ -32,6 +32,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..platform import monitoring
+from ..platform import sync as _sync
 from . import recorder as _recorder_mod
 
 _metric_wedges = monitoring.Counter(
@@ -68,7 +69,8 @@ class Watchdog:
     POLL_S = 0.1
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("telemetry/watchdog",
+                                rank=_sync.RANK_STATE)
         self._armed: Dict[int, Dict[str, Any]] = {}
         self._next_token = 0
         self._thread: Optional[threading.Thread] = None
@@ -137,11 +139,15 @@ class Watchdog:
         _metric_wedges.get_cell(entry["what"]).increase_by(1)
         rec = _recorder_mod.get_recorder()
         overdue = time.perf_counter() - entry["armed_at"]
+        # stacks carry per-thread held locks and the wait-for graph
+        # names live lock cycles (stf.analysis.concurrency): a REAL
+        # deadlock's wedge dump says WHO waits on WHAT held by WHOM
         rec.record("wedge", what=entry["what"],
                    armed_thread=entry["thread"],
                    deadline_s=entry["deadline_s"],
                    running_for_s=round(overdue, 3),
                    stacks=_recorder_mod.thread_stacks(),
+                   wait_graph=_recorder_mod.wait_graph_record(),
                    **(entry["meta"] or {}))
         try:
             rec.dump(reason=f"wedge:{entry['what']}")

@@ -1,0 +1,478 @@
+"""Runtime thread-safety lint: an AST pass over the package's OWN
+source enforcing the stf.analysis.concurrency contracts (the static
+prong of the plane whose dynamic prong is platform/sync.py; compare
+tools/graph_lint.py, which does the same job for graphs).
+
+Rules:
+
+- **raw-lock** — ``threading.Lock()`` / ``RLock()`` / ``Condition()``
+  anywhere outside ``platform/sync.py``. Raw locks are invisible to
+  the witness: no held-stack entry, no lock-order edges, no wait-for
+  node — a wedge involving one dumps as an unexplained parked thread.
+- **unnamed-thread** — ``threading.Thread(...)`` without a ``name``
+  (or ``ThreadPoolExecutor`` without ``thread_name_prefix``) starting
+  with ``stf_``. Wedge dumps, the leak fixture, and /syncz attribute
+  threads BY NAME; a default ``Thread-N`` is unattributable.
+- **blocking-under-lock** — a known-blocking call (``.join(``, a
+  ring/queue ``.get()``, ``jax.device_get`` / ``block_until_ready``,
+  ``time.sleep`` of a constant >= 0.1 s) lexically inside a ``with
+  <lock>:`` body, where ``<lock>`` resolves to a ``sync.Lock``
+  declaration in the same file. Blocking while holding a lock is how
+  one wedged thread becomes a convoy. Locks declared with
+  ``blocking_ok=True`` are exempt — the exemption lives in reviewed
+  source, keeping the allowlist empty. ``Condition.wait`` is NOT
+  flagged: releasing the lock is its contract.
+- **rank-order** — lexically nested ``with`` acquisitions whose inner
+  lock's declared rank is strictly lower than the outer's (both
+  resolved from same-file ``sync.Lock(name, rank=...)`` declarations).
+  The witness would report this at runtime; the lint reports it before
+  the code ever runs.
+- **nested-under-leaf** — any lock acquisition (a ``with`` on a known
+  lock declaration, or an explicit ``.acquire(``) lexically inside the
+  body of a ``with`` on a ``sync.leaf_lock`` — leaf locks are EXEMPT
+  from witness bookkeeping precisely because nothing may ever be
+  acquired under them, so this rule is the ONLY guard; it has no
+  escape flag.
+
+CI gate: ``tests/test_runtime_lint.py`` runs this over the whole
+package with the allowlist (docs/runtime_lint_allowlist.txt) EMPTY —
+like the metrics-catalog drift gate, the ratchet only tightens.
+
+CLI::
+
+    python -m simple_tensorflow_tpu.tools.runtime_lint [--json] [paths]
+
+Exit 1 when violations remain after the allowlist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["lint_file", "lint_package", "Violation", "main",
+           "load_allowlist", "ALLOWLIST_PATH", "PACKAGE_ROOT"]
+
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+ALLOWLIST_PATH = os.path.join(_REPO_ROOT, "docs",
+                              "runtime_lint_allowlist.txt")
+
+# the one module allowed to construct raw primitives: the named layer
+# itself
+_SYNC_MODULE = os.path.join("platform", "sync.py")
+
+_RAW_FACTORIES = ("Lock", "RLock", "Condition")
+
+# call names that block the calling thread (method-name match is
+# deliberate: any .join( under a lock is suspect whatever the object)
+_BLOCKING_METHODS = ("join", "get", "device_get", "block_until_ready",
+                     "wait_until_finished")
+_SLEEP_MIN_S = 0.1
+
+
+class Violation(dict):
+    """A single finding; dict so --json is free. Keys: rule, file,
+    line, detail."""
+
+    def key(self) -> str:
+        """Stable allowlist key: rule:relpath:detail (line numbers
+        excluded so allowlisted entries survive unrelated edits)."""
+        return f"{self['rule']}:{self['file']}:{self['detail']}"
+
+    def __str__(self):
+        return (f"{self['file']}:{self['line']}: [{self['rule']}] "
+                f"{self['detail']}")
+
+
+def _rank_constants() -> Dict[str, int]:
+    """RANK_* values parsed from platform/sync.py's own AST — the lint
+    must not import the package it audits (import-time side effects
+    would skew what 'static' means)."""
+    path = os.path.join(PACKAGE_ROOT, _SYNC_MODULE)
+    out: Dict[str, int] = {}
+    try:
+        tree = ast.parse(open(path).read())
+    except (OSError, SyntaxError):
+        return out
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and (node.targets[0].id.startswith("RANK_")
+                     or node.targets[0].id == "LEAF")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+_RANKS = _rank_constants()
+
+
+def _is_threading_factory(call: ast.Call) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when the call constructs a raw
+    threading primitive (``threading.Lock()`` or a bare ``Lock()``
+    imported from threading is not distinguished — bare names only
+    count when they match a factory exactly, which the package never
+    uses for anything else)."""
+    f = call.func
+    if (isinstance(f, ast.Attribute) and f.attr in _RAW_FACTORIES
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading"):
+        return f.attr
+    return None
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "Thread"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading")
+
+
+def _is_executor_ctor(call: ast.Call) -> bool:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return name == "ThreadPoolExecutor"
+
+
+def _name_ok(value: ast.expr,
+             str_consts: Optional[Dict[str, str]] = None) -> bool:
+    """Does a name= / thread_name_prefix= value start with stf_? A
+    constant string must; an f-string must have an stf_-prefixed
+    leading literal; a bare name must resolve to a module-level string
+    constant in the same file; anything dynamic beyond that is
+    rejected (the point is grep-able attribution)."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        return value.value.startswith("stf_")
+    if isinstance(value, ast.JoinedStr) and value.values:
+        head = value.values[0]
+        return (isinstance(head, ast.Constant)
+                and isinstance(head.value, str)
+                and head.value.startswith("stf_"))
+    if isinstance(value, ast.Name) and str_consts is not None:
+        const = str_consts.get(value.id)
+        return const is not None and const.startswith("stf_")
+    return False
+
+
+def _collect_str_consts(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings, so thread names can
+    live in one grep-able constant (e.g. ``_THREAD_NAME``)."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = stmt.value.value
+    return out
+
+
+class _LockDecl:
+    __slots__ = ("name", "rank", "blocking_ok", "leaf")
+
+    def __init__(self, name: str, rank: Optional[int],
+                 blocking_ok: bool, leaf: bool = False):
+        self.name = name
+        self.rank = rank
+        self.blocking_ok = blocking_ok
+        self.leaf = leaf
+
+
+def _sync_lock_decl(call: ast.Call) -> Optional[_LockDecl]:
+    """Parse ``sync.Lock("name", rank=..., blocking_ok=...)`` /
+    ``_sync.RLock(...)`` / ``_sync.Condition(name=..., rank=...)`` /
+    ``_sync.leaf_lock("name")``."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute)
+            and f.attr in ("Lock", "RLock", "Condition", "leaf_lock")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("sync", "_sync")):
+        return None
+    if f.attr == "leaf_lock":
+        lock_name = "?"
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            lock_name = call.args[0].value
+        return _LockDecl(lock_name, _RANKS.get("LEAF"), False,
+                         leaf=True)
+    lock_name = None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        lock_name = call.args[0].value
+    rank = None
+    blocking_ok = False
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+            lock_name = kw.value.value
+        elif kw.arg == "rank":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                rank = v.value
+            elif (isinstance(v, ast.Attribute)
+                  and v.attr in _RANKS):
+                rank = _RANKS[v.attr]
+            elif isinstance(v, ast.Name) and v.id in _RANKS:
+                rank = _RANKS[v.id]
+        elif kw.arg == "blocking_ok" and isinstance(
+                kw.value, ast.Constant):
+            blocking_ok = bool(kw.value.value)
+    return _LockDecl(lock_name or "?", rank, blocking_ok)
+
+
+def _target_expr(node: ast.expr) -> Optional[str]:
+    """'self._lock' / '_registry_lock' style dotted key for matching a
+    with-target against a declaration site."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                     ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _collect_decls(tree: ast.AST) -> Dict[str, _LockDecl]:
+    """Map 'self._lock' / module-global names -> their sync.Lock
+    declaration, file-local. (Cross-file resolution is the witness's
+    job at runtime; the lint stays lexical.)"""
+    decls: Dict[str, _LockDecl] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        decl = _sync_lock_decl(node.value)
+        if decl is None:
+            continue
+        for tgt in node.targets:
+            key = _target_expr(tgt)
+            if key:
+                decls[key] = decl
+    return decls
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    f = node.func
+    attr = f.attr if isinstance(f, ast.Attribute) else None
+    if attr in ("join",):
+        return f".{attr}("
+    if attr in ("device_get", "block_until_ready"):
+        return f".{attr}("
+    if attr == "wait_until_finished":
+        return f".{attr}("
+    if attr == "get":
+        # only no-arg / block=True-ish gets: a get(timeout=...) or
+        # get(False) is bounded and fine
+        if not node.args and not node.keywords:
+            return ".get() without timeout"
+        return None
+    if attr == "sleep" and isinstance(f.value, ast.Name) \
+            and f.value.id == "time":
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, (int, float)) \
+                and node.args[0].value >= _SLEEP_MIN_S:
+            return f"time.sleep({node.args[0].value})"
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, relpath: str, decls: Dict[str, _LockDecl],
+                 is_sync_module: bool,
+                 str_consts: Optional[Dict[str, str]] = None):
+        self.relpath = relpath
+        self.decls = decls
+        self.is_sync = is_sync_module
+        self.str_consts = str_consts or {}
+        self.violations: List[Violation] = []
+        # stack of (decl, with_lineno) for sync locks currently
+        # lexically held
+        self._held: List[Tuple[_LockDecl, int]] = []
+
+    def _emit(self, rule: str, line: int, detail: str):
+        self.violations.append(Violation(
+            rule=rule, file=self.relpath, line=line, detail=detail))
+
+    # -- raw primitives / thread names ---------------------------------------
+    def visit_Call(self, node: ast.Call):
+        if not self.is_sync:
+            raw = _is_threading_factory(node)
+            if raw is not None:
+                self._emit(
+                    "raw-lock", node.lineno,
+                    f"threading.{raw}() outside platform/sync.py — "
+                    "use sync.Lock/RLock/Condition (named + ranked, "
+                    "witness-visible)")
+        if _is_thread_ctor(node):
+            name_kw = next((kw.value for kw in node.keywords
+                            if kw.arg == "name"), None)
+            if name_kw is None or not _name_ok(name_kw,
+                                               self.str_consts):
+                self._emit(
+                    "unnamed-thread", node.lineno,
+                    "threading.Thread without an stf_-prefixed name= "
+                    "(wedge dumps and the leak fixture attribute "
+                    "threads by name)")
+        if _is_executor_ctor(node):
+            pref = next((kw.value for kw in node.keywords
+                         if kw.arg == "thread_name_prefix"), None)
+            if pref is None or not _name_ok(pref, self.str_consts):
+                self._emit(
+                    "unnamed-thread", node.lineno,
+                    "ThreadPoolExecutor without an stf_-prefixed "
+                    "thread_name_prefix=")
+        # blocking call under a held (lexically) sync lock
+        if self._held:
+            blocked = _blocking_call(node)
+            if blocked is not None:
+                holder, wline = self._held[-1]
+                if not holder.blocking_ok:
+                    self._emit(
+                        "blocking-under-lock", node.lineno,
+                        f"{blocked} inside `with` of sync lock "
+                        f"{holder.name!r} (held since line {wline}) — "
+                        "blocking under a lock convoys every other "
+                        "acquirer; declare blocking_ok=True on the "
+                        "lock if this wait is by design")
+            # explicit .acquire( under a held leaf lock: the witness
+            # cannot see leaf critical sections, so this is the only
+            # guard (no escape flag)
+            if self._held[-1][0].leaf:
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    holder, wline = self._held[-1]
+                    self._emit(
+                        "nested-under-leaf", node.lineno,
+                        f".acquire( inside `with` of leaf lock "
+                        f"{holder.name!r} (held since line {wline})")
+        self.generic_visit(node)
+
+    # -- with-blocks: rank order + held tracking -----------------------------
+    def visit_With(self, node: ast.With):
+        entered: List[Tuple[_LockDecl, int]] = []
+        for item in node.items:
+            key = _target_expr(item.context_expr)
+            decl = self.decls.get(key) if key else None
+            if decl is None:
+                continue
+            if self._held and self._held[-1][0].leaf:
+                outer = self._held[-1][0]
+                self._emit(
+                    "nested-under-leaf", node.lineno,
+                    f"acquires {decl.name!r} inside `with` of leaf "
+                    f"lock {outer.name!r} (held since line "
+                    f"{self._held[-1][1]}) — leaf locks are witness-"
+                    "exempt BECAUSE nothing may be acquired under "
+                    "them; use a ranked sync.Lock for this outer "
+                    "lock instead")
+            elif (decl.rank is not None and self._held
+                    and self._held[-1][0].rank is not None
+                    and decl.rank < self._held[-1][0].rank
+                    and decl.name != self._held[-1][0].name):
+                outer = self._held[-1][0]
+                self._emit(
+                    "rank-order", node.lineno,
+                    f"acquires {decl.name!r} (rank {decl.rank}) while "
+                    f"holding {outer.name!r} (rank {outer.rank}) — "
+                    "lower rank = outer lock; this inversion is a "
+                    "potential-deadlock edge")
+            entered.append((decl, node.lineno))
+            self._held.append((decl, node.lineno))
+        self.generic_visit(node)
+        for _ in entered:
+            self._held.pop()
+
+
+def lint_file(path: str, package_root: str = PACKAGE_ROOT
+              ) -> List[Violation]:
+    relpath = os.path.relpath(path, os.path.dirname(package_root))
+    try:
+        src = open(path).read()
+        tree = ast.parse(src)
+    except (OSError, SyntaxError) as e:
+        return [Violation(rule="parse-error", file=relpath, line=0,
+                          detail=str(e))]
+    is_sync = path.endswith(_SYNC_MODULE)
+    linter = _Linter(relpath, _collect_decls(tree), is_sync,
+                     _collect_str_consts(tree))
+    linter.visit(tree)
+    return linter.violations
+
+
+def lint_package(root: str = PACKAGE_ROOT) -> List[Violation]:
+    out: List[Violation] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__",)]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.extend(lint_file(os.path.join(dirpath, fn),
+                                     package_root=root))
+    return out
+
+
+def load_allowlist(path: str = ALLOWLIST_PATH) -> List[str]:
+    try:
+        with open(path) as f:
+            return [ln.strip() for ln in f
+                    if ln.strip() and not ln.startswith("#")]
+    except OSError:
+        return []
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m simple_tensorflow_tpu.tools.runtime_lint",
+        description="Runtime thread-safety lint over the stf package "
+                    "(raw locks, unnamed threads, blocking under "
+                    "locks, rank-order inversions).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--allowlist", default=ALLOWLIST_PATH,
+                    help="allowlist file (one key per line)")
+    args = ap.parse_args(argv)
+
+    violations: List[Violation] = []
+    if args.paths:
+        for p in args.paths:
+            if os.path.isdir(p):
+                violations.extend(lint_package(p))
+            else:
+                violations.extend(lint_file(p))
+    else:
+        violations = lint_package()
+
+    allow = set(load_allowlist(args.allowlist))
+    kept = [v for v in violations if v.key() not in allow]
+    used = {v.key() for v in violations} & allow
+
+    if args.json:
+        print(json.dumps({
+            "violations": kept,
+            "allowlisted": sorted(used),
+            "stale_allowlist": sorted(allow - used),
+            "count": len(kept),
+        }, indent=2))
+    else:
+        for v in kept:
+            print(v)
+        stale = allow - used
+        for k in sorted(stale):
+            print(f"stale allowlist entry (remove it): {k}")
+        print(f"runtime_lint: {len(kept)} violation(s), "
+              f"{len(used)} allowlisted, {len(stale)} stale "
+              f"allowlist entr(ies)")
+        if stale:
+            return 1
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ import time
 import traceback
 
 from ..framework import errors
+from ..platform import sync as _sync
 from ..framework import graph as ops_mod
 
 
@@ -25,7 +26,8 @@ class Coordinator:
         if clean_stop_exception_types is None:
             clean_stop_exception_types = (errors.OutOfRangeError,)
         self._clean_stop = tuple(clean_stop_exception_types)
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("train/coordinator",
+                                rank=_sync.RANK_STATE)
         self._stop_event = threading.Event()
         self._exc_info = None
         self._registered_threads = set()

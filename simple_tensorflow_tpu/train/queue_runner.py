@@ -5,6 +5,7 @@ from __future__ import annotations
 import threading
 
 from ..framework import errors
+from ..platform import sync as _sync
 from ..framework import graph as ops_mod
 from .coordinator import Coordinator
 
@@ -23,7 +24,8 @@ class QueueRunner:
         self._exceptions = queue_closed_exception_types or (
             errors.OutOfRangeError, errors.CancelledError)
         self._runs = 0
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("train/queue_runner",
+                                rank=_sync.RANK_STATE)
         self._exceptions_raised = []
 
     @property
@@ -71,14 +73,16 @@ class QueueRunner:
 
     def create_threads(self, sess, coord=None, daemon=False, start=False):
         threads = [threading.Thread(target=self._run,
-                                    args=(sess, op, coord), daemon=daemon)
-                   for op in self._enqueue_ops]
+                                    args=(sess, op, coord), daemon=daemon,
+                                    name=f"stf_queue_runner_{i}")
+                   for i, op in enumerate(self._enqueue_ops)]
         if coord:
             # daemon regardless: it parks in wait_for_stop forever when
             # the coordinator is never stopped; it must not keep the
             # process alive
             threads.append(threading.Thread(target=self._close_on_stop,
-                                            args=(coord,), daemon=True))
+                                            args=(coord,), daemon=True,
+                                            name="stf_queue_runner_closer"))
             for t in threads:
                 coord.register_thread(t)
         if start:

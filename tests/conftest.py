@@ -18,6 +18,7 @@ jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, jax.devices()
 
 import gc  # noqa: E402
+import re  # noqa: E402
 import threading  # noqa: E402
 import time  # noqa: E402
 
@@ -27,14 +28,22 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True, scope="module")
 def _no_pipeline_leaks():
     """Leak hygiene (ISSUE 6 satellite; serving added in ISSUE 7,
-    telemetry in ISSUE 8): after each test module, no pipeline stage /
-    serving batcher / telemetry threads may still be running, every
-    PipelineIterator must be closed, every ModelServer shut down, and
-    the telemetry HTTP server stopped (an open server pins its
-    listener + connection threads). The watchdog monitor thread is
-    lazy process-global infrastructure: the fixture STOPS it after
-    each module (re-arming restarts it) and asserts the stop works —
-    clean shutdown is part of its contract."""
+    telemetry in ISSUE 8, sync/thread-naming in ISSUE 18): after each
+    test module, no pipeline stage / serving batcher / telemetry
+    threads may still be running, every PipelineIterator must be
+    closed, every ModelServer shut down, and the telemetry HTTP server
+    stopped (an open server pins its listener + connection threads).
+    The watchdog monitor thread is lazy process-global infrastructure:
+    the fixture STOPS it after each module (re-arming restarts it) and
+    asserts the stop works — clean shutdown is part of its contract.
+
+    ISSUE 18 adds two global invariants: no NEW default-named
+    (``Thread-N``) threads may survive the module — every runtime
+    thread must carry an ``stf_``-prefixed name so wedge dumps and the
+    leak scan can attribute it — and no sync.Lock may still be held at
+    teardown (a held lock here means a thread died holding it or a
+    context manager leaked)."""
+    baseline_threads = {t.ident for t in threading.enumerate()}
     yield
     from simple_tensorflow_tpu import checkpoint as ckpt_mod
     from simple_tensorflow_tpu import telemetry
@@ -58,6 +67,14 @@ def _no_pipeline_leaks():
                     if not e.closed]
     for e in open_engines:
         e.close()
+    # RecordInput readers are graph-scoped with no user-facing close in
+    # the reference contract, so stragglers are reaped (not asserted):
+    # close() stops the poll loop, the thread exits within one tick
+    from simple_tensorflow_tpu.ops import data_flow_ops as _dfo
+
+    for r in list(_dfo._live_record_inputs):
+        if not r._closed:
+            r.close()
     open_telemetry = telemetry.get_server() is not None
     telemetry.shutdown()  # stops the HTTP server AND the watchdog
     # checkpoint writer (ISSUE 10): drain + stop the stf_ckpt_writer
@@ -84,10 +101,30 @@ def _no_pipeline_leaks():
                     or t.name.startswith("stf_ckpt_"))
                 and t.is_alive()]
 
+    # NEW default-named threads (vs the module-entry baseline): jax /
+    # pytest internals predate the module and are exempt; anything the
+    # module spawned must be stf_-named (sync plane, ISSUE 18)
+    _unnamed_re = re.compile(r"^Thread-\d+")
+
+    def unnamed():
+        return [t for t in threading.enumerate()
+                if t.ident not in baseline_threads and t.is_alive()
+                and not t.daemon and _unnamed_re.match(t.name)]
+
     deadline = time.monotonic() + 5.0
-    while stray() and time.monotonic() < deadline:
+    while (stray() or unnamed()) and time.monotonic() < deadline:
         time.sleep(0.05)
     leaked = stray()
+    leaked_unnamed = unnamed()
+    # held-lock invariant: transient holds (a scraper mid-snapshot) get
+    # a short grace window, then any survivor is a real leak
+    from simple_tensorflow_tpu.platform import sync as _sync_mod
+
+    held = _sync_mod.all_held_locks()
+    held_deadline = time.monotonic() + 2.0
+    while held and time.monotonic() < held_deadline:
+        time.sleep(0.05)
+        held = _sync_mod.all_held_locks()
     assert not open_iters, (
         "unclosed PipelineIterator(s) leaked by this test module "
         f"(close() them or drop all references): {open_iters!r}")
@@ -106,3 +143,11 @@ def _no_pipeline_leaks():
     assert not leaked, (
         "leaked pipeline/serving/telemetry/checkpoint thread(s): "
         + ", ".join(t.name for t in leaked))
+    assert not leaked_unnamed, (
+        "surviving non-stf_-named thread(s) spawned by this test "
+        "module (name them stf_<subsystem>_... so wedge dumps can "
+        "attribute them): "
+        + ", ".join(t.name for t in leaked_unnamed))
+    assert not held, (
+        "sync.Lock(s) still held at module teardown (a thread died "
+        f"holding them or a with-block leaked): {held!r}")

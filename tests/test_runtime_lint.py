@@ -1,0 +1,194 @@
+"""stf.analysis.concurrency static prong (ISSUE 18): the runtime
+thread-safety lint — per-rule fixtures against synthetic files, the
+CLI contract, and the CI gate: the WHOLE package lints clean with the
+allowlist EMPTY (like the metrics-catalog drift gate, the ratchet only
+tightens).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from simple_tensorflow_tpu.tools import runtime_lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_src(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return runtime_lint.lint_file(str(p), package_root=str(tmp_path))
+
+
+class TestRules:
+    def test_raw_lock_flagged(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "import threading\n"
+            "l = threading.Lock()\n"
+            "r = threading.RLock()\n"
+            "c = threading.Condition()\n"))
+        assert [v["rule"] for v in vios] == ["raw-lock"] * 3
+        assert vios[0]["line"] == 2
+
+    def test_sync_layer_lock_passes(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "from simple_tensorflow_tpu.platform import sync as _sync\n"
+            "l = _sync.Lock('x/y', rank=_sync.RANK_STATE)\n"))
+        assert vios == []
+
+    def test_unnamed_thread_flagged(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "import threading\n"
+            "t = threading.Thread(target=print)\n"
+            "u = threading.Thread(target=print, name='worker-1')\n"))
+        assert [v["rule"] for v in vios] == ["unnamed-thread"] * 2
+
+    def test_stf_named_thread_passes(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "import threading\n"
+            "_NAME = 'stf_via_constant'\n"
+            "a = threading.Thread(target=print, name='stf_ok')\n"
+            "b = threading.Thread(target=print,\n"
+            "                     name=f'stf_worker_{3}')\n"
+            "c = threading.Thread(target=print, name=_NAME)\n"))
+        assert vios == []
+
+    def test_executor_needs_prefix(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "bad = ThreadPoolExecutor(4)\n"
+            "ok = ThreadPoolExecutor(\n"
+            "    4, thread_name_prefix='stf_pool')\n"))
+        assert len(vios) == 1
+        assert vios[0]["rule"] == "unnamed-thread"
+        assert vios[0]["line"] == 2
+
+    def test_blocking_under_lock_flagged(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "from simple_tensorflow_tpu.platform import sync as _sync\n"
+            "import time\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = _sync.Lock('t/l',\n"
+            "                                rank=_sync.RANK_STATE)\n"
+            "    def bad(self, t, q):\n"
+            "        with self._lock:\n"
+            "            t.join()\n"
+            "            q.get()\n"
+            "            time.sleep(0.5)\n"
+            "    def fine(self, t, q):\n"
+            "        with self._lock:\n"
+            "            q.get(timeout=0.1)\n"
+            "            time.sleep(0.01)\n"
+            "        t.join()\n"))
+        assert [v["rule"] for v in vios] == ["blocking-under-lock"] * 3
+        assert [v["line"] for v in vios] == [9, 10, 11]
+        assert "'t/l'" in vios[0]["detail"]
+        assert "held since line 8" in vios[0]["detail"]
+
+    def test_blocking_ok_exempts(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "from simple_tensorflow_tpu.platform import sync as _sync\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = _sync.RLock('t/l',\n"
+            "                                 rank=_sync.RANK_SESSION,\n"
+            "                                 blocking_ok=True)\n"
+            "    def by_design(self, fut):\n"
+            "        with self._lock:\n"
+            "            fut.join()\n"))
+        assert vios == []
+
+    def test_rank_order_inversion_flagged(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "from simple_tensorflow_tpu.platform import sync as _sync\n"
+            "hi = _sync.Lock('t/hi', rank=_sync.RANK_METRICS)\n"
+            "lo = _sync.Lock('t/lo', rank=_sync.RANK_SESSION)\n"
+            "def inverted():\n"
+            "    with hi:\n"
+            "        with lo:\n"
+            "            pass\n"
+            "def ordered():\n"
+            "    with lo:\n"
+            "        with hi:\n"
+            "            pass\n"))
+        assert len(vios) == 1
+        assert vios[0]["rule"] == "rank-order"
+        assert "'t/lo'" in vios[0]["detail"]
+        assert "'t/hi'" in vios[0]["detail"]
+
+    def test_nested_under_leaf_flagged(self, tmp_path):
+        vios = _lint_src(tmp_path, (
+            "from simple_tensorflow_tpu.platform import sync as _sync\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = _sync.leaf_lock('t/cell')\n"
+            "        self._other = _sync.Lock('t/state',\n"
+            "                                 rank=_sync.RANK_STATE)\n"
+            "    def bad(self, raw):\n"
+            "        with self._lock:\n"
+            "            with self._other:\n"
+            "                pass\n"
+            "            raw.acquire()\n"
+            "    def fine(self):\n"
+            "        with self._other:\n"
+            "            with self._lock:\n"
+            "                pass\n"))
+        assert [v["rule"] for v in vios] == ["nested-under-leaf"] * 2
+        assert [v["line"] for v in vios] == [9, 11]
+        assert "'t/cell'" in vios[0]["detail"]
+        # ordered the right way round (leaf innermost) does NOT fire
+        # rank-order either: leaf rank is the maximum
+
+    def test_allowlist_key_is_line_number_free(self, tmp_path):
+        (vio,) = _lint_src(tmp_path, (
+            "import threading\nx = threading.Lock()\n"))
+        assert str(vio["line"]) not in vio.key().split(":", 2)[2]
+        assert vio.key().startswith("raw-lock:")
+
+
+class TestGate:
+    def test_package_lints_clean(self):
+        """THE gate: zero violations across the whole package."""
+        vios = runtime_lint.lint_package()
+        assert vios == [], "\n".join(str(v) for v in vios)
+
+    def test_allowlist_is_empty(self):
+        """The ratchet: exemptions live in reviewed source
+        (blocking_ok=True), never in the allowlist."""
+        assert runtime_lint.load_allowlist() == [], (
+            "docs/runtime_lint_allowlist.txt must stay empty — declare "
+            "blocking_ok=True on the lock (reviewed code) instead")
+
+    def test_cli_subprocess_green(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "simple_tensorflow_tpu.tools.runtime_lint", "--json"],
+            capture_output=True, text=True, env=env, timeout=120,
+            cwd=REPO_ROOT)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        payload = json.loads(proc.stdout)
+        assert payload["count"] == 0
+        assert payload["violations"] == []
+        assert payload["stale_allowlist"] == []
+
+    def test_cli_exit_1_on_violation(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading\nx = threading.Lock()\n")
+        rc = runtime_lint.main([str(bad)])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "raw-lock" in out
+
+    def test_stale_allowlist_entry_fails(self, tmp_path, capsys):
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        allow = tmp_path / "allow.txt"
+        allow.write_text("raw-lock:gone.py:threading.Lock() removed\n")
+        rc = runtime_lint.main([str(ok), "--allowlist", str(allow)])
+        assert rc == 1
+        assert "stale allowlist entry" in capsys.readouterr().out

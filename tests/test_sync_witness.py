@@ -1,0 +1,303 @@
+"""stf.analysis.concurrency dynamic prong (ISSUE 18): the lock-order
+witness graph, rank checking, wait-for forensics, and the real-deadlock
+watchdog dump.
+
+The seeded-inversion tests run in-process against the module-global
+witness (reset around each test); the REAL deadlock runs in a
+subprocess — two threads wedge for good, the watchdog fires, and the
+parent asserts the flight dump's wait-for graph names the cycle.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from simple_tensorflow_tpu.platform import sync
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+THIS_FILE = os.path.basename(__file__)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_witness():
+    sync.reset_witness()
+    yield
+    sync.reset_witness()
+
+
+class TestWitnessGraph:
+    def test_seeded_inversion_reports_both_sites(self):
+        """A -> B observed, then B -> A: the witness must report a
+        potential deadlock that names BOTH acquisition sites
+        (file:line), even though nothing ever actually deadlocked."""
+        a = sync.Lock("test/witness_a", rank=sync.RANK_STATE)
+        b = sync.Lock("test/witness_b", rank=sync.RANK_STATE)
+        with a:
+            with b:
+                pass
+        assert not sync.potential_deadlocks()
+        with b:
+            with a:  # inversion — this acquire closes the cycle
+                pass
+        reports = sync.potential_deadlocks()
+        assert len(reports) == 1, reports
+        rep = reports[0]
+        assert rep["key"] == ("test/witness_a -> test/witness_b"
+                              " -> test/witness_a")
+        assert sorted(rep["cycle"]) == ["test/witness_a",
+                                        "test/witness_b"]
+        # both edges carry both sites, and every site is in THIS file
+        assert len(rep["edges"]) == 2
+        for edge in rep["edges"]:
+            assert THIS_FILE in edge["from_site"], rep
+            assert THIS_FILE in edge["to_site"], rep
+        # sites are file:line — the line must parse
+        for edge in rep["edges"]:
+            int(edge["to_site"].rsplit(":", 1)[1])
+
+    def test_inversion_deduped_and_cross_thread(self):
+        """The same cycle re-observed (and observed from another
+        thread) stays ONE report; edges are attributed by lock name,
+        not instance or thread."""
+        a = sync.Lock("test/dedup_a", rank=sync.RANK_STATE)
+        b = sync.Lock("test/dedup_b", rank=sync.RANK_STATE)
+
+        def fwd():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=fwd, name="stf_test_fwd")
+        t.start()
+        t.join(5)
+        for _ in range(3):
+            with b:
+                with a:
+                    pass
+        assert len(sync.potential_deadlocks()) == 1
+
+    def test_rank_violation_recorded_not_raised(self):
+        """Acquiring a strictly lower rank while holding a higher one
+        is recorded (with both sites) but never raises."""
+        hi = sync.Lock("test/rank_hi", rank=sync.RANK_METRICS)
+        lo = sync.Lock("test/rank_lo", rank=sync.RANK_SESSION)
+        with hi:
+            with lo:
+                pass
+        vios = [v for v in sync.rank_violations()
+                if v["acquired"] == "test/rank_lo"]
+        assert vios, sync.rank_violations()
+        v = vios[0]
+        assert v["held"] == "test/rank_hi"
+        assert v["acquired_rank"] < v["held_rank"]
+        assert THIS_FILE in v["acquired_site"]
+        assert THIS_FILE in v["held_site"]
+
+    def test_kill_switch_records_nothing(self):
+        sync.set_witness_enabled(False)
+        try:
+            a = sync.Lock("test/kill_a", rank=sync.RANK_STATE)
+            b = sync.Lock("test/kill_b", rank=sync.RANK_STATE)
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            assert not sync.potential_deadlocks()
+            assert not sync.witness_snapshot()["edges"]
+        finally:
+            sync.set_witness_enabled(True)
+
+    def test_leaf_lock_registered_but_exempt(self):
+        """leaf_lock returns a raw primitive (C-speed, witness-blind)
+        but the NAME lands in the registry with leaf: true."""
+        lk = sync.leaf_lock("test/leaf_probe")
+        info = sync.known_locks()["test/leaf_probe"]
+        assert info["leaf"] is True
+        assert info["rank"] == sync.LEAF
+        outer = sync.Lock("test/leaf_outer", rank=sync.RANK_STATE)
+        with outer:
+            with lk:
+                pass
+        # no witness edge for the exempt lock, no held-stack entry
+        snap = sync.witness_snapshot()
+        assert not [e for e in snap["edges"]
+                    if "test/leaf_probe" in (e["from"], e["to"])]
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        r = sync.RLock("test/reentrant", rank=sync.RANK_STATE)
+        with r:
+            with r:
+                pass
+        snap = sync.witness_snapshot()
+        assert not [e for e in snap["edges"]
+                    if e["from"] == "test/reentrant"
+                    and e["to"] == "test/reentrant"]
+
+
+class TestWaitForGraph:
+    def test_contended_acquire_appears_with_owner(self):
+        """While a thread blocks on a held lock, wait_graph() shows the
+        waiter -> owner edge with the waiter's acquisition site."""
+        lk = sync.Lock("test/contended", rank=sync.RANK_STATE)
+        entered = threading.Event()
+
+        def waiter():
+            entered.set()
+            with lk:
+                pass
+
+        with lk:
+            t = threading.Thread(target=waiter,
+                                 name="stf_test_waiter")
+            t.start()
+            entered.wait(5)
+            deadline = time.monotonic() + 5
+            edges = []
+            while time.monotonic() < deadline:
+                edges = [e for e in sync.wait_graph()["edges"]
+                         if e["lock"] == "test/contended"]
+                if edges:
+                    break
+                time.sleep(0.01)
+            assert edges, sync.wait_graph()
+            e = edges[0]
+            assert e["waiter"] == "stf_test_waiter"
+            assert e["owner"] == threading.current_thread().name
+            assert THIS_FILE in e["site"]
+            # one-sided waiting is NOT a deadlock
+            assert not sync.wait_graph()["deadlocked"]
+        t.join(5)
+        assert not t.is_alive()
+
+    def test_held_locks_snapshot(self):
+        lk = sync.Lock("test/held_snapshot", rank=sync.RANK_STATE)
+        with lk:
+            me = threading.current_thread()
+            key = f"{me.name} ({me.ident})"
+            held = sync.all_held_locks()
+            assert key in held, held
+            assert held[key][-1]["lock"] == "test/held_snapshot"
+            assert THIS_FILE in held[key][-1]["site"]
+        assert not any(
+            e["lock"] == "test/held_snapshot"
+            for entries in sync.all_held_locks().values()
+            for e in entries)
+
+
+_DEADLOCK_CHILD = r"""
+import os, sys, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+from simple_tensorflow_tpu.platform import sync
+from simple_tensorflow_tpu.telemetry import watchdog
+
+a = sync.Lock("test/dead_a", rank=sync.RANK_STATE)
+b = sync.Lock("test/dead_b", rank=sync.RANK_STATE)
+gate = threading.Barrier(2)
+
+def one():
+    with a:
+        gate.wait()
+        with b:
+            pass
+
+def two():
+    with b:
+        gate.wait()
+        with a:
+            pass
+
+t1 = threading.Thread(target=one, name="stf_test_dead_1", daemon=True)
+t2 = threading.Thread(target=two, name="stf_test_dead_2", daemon=True)
+t1.start(); t2.start()
+# wait until BOTH threads are parked in contended acquires
+deadline = time.monotonic() + 10
+while time.monotonic() < deadline:
+    wg = sync.wait_graph()
+    if wg["deadlocked"]:
+        break
+    time.sleep(0.05)
+assert sync.wait_graph()["deadlocked"], sync.wait_graph()
+wd = watchdog.get_watchdog()
+fired = threading.Event()
+wd.on_wedge.append(lambda entry: fired.set())  # runs AFTER record+dump
+token = wd.arm("test_real_deadlock", 0.2)
+assert token is not None
+assert fired.wait(15)
+sys.stdout.write("DUMPED\n")
+os._exit(0)  # the two daemon threads are wedged forever
+"""
+
+
+class TestRealDeadlockDump:
+    def test_watchdog_dump_contains_wait_cycle(self, tmp_path):
+        """Two threads REALLY deadlock (opposite acquisition order) in
+        a subprocess; the watchdog fires and the flight dump's wait-for
+        graph must contain the thread cycle with held locks."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        env["STF_FLIGHT_RECORDER_DIR"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _DEADLOCK_CHILD],
+            capture_output=True, text=True, env=env, timeout=180)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "DUMPED" in proc.stdout
+        dumps = sorted(tmp_path.glob("flight-*.jsonl"))
+        assert dumps, list(tmp_path.iterdir())
+        records = [json.loads(ln) for ln in
+                   dumps[-1].read_text().splitlines() if ln.strip()]
+        # the wedge event itself carries the wait-for graph...
+        wedges = [r for r in records if r.get("kind") == "wedge"
+                  and r.get("what") == "test_real_deadlock"]
+        assert wedges, [r.get("kind") for r in records]
+        wg = wedges[-1]["wait_graph"]
+        assert wg["deadlocked"] is True
+        assert wg["cycles"], wg
+        cycle = wg["cycles"][0]
+        assert "stf_test_dead_1" in cycle
+        assert "stf_test_dead_2" in cycle
+        locks_waited = {e["lock"] for e in wg["edges"]}
+        assert locks_waited == {"test/dead_a", "test/dead_b"}
+        # ...and the dump also appends a standalone wait_graph record
+        standalone = [r for r in records
+                      if r.get("kind") == "wait_graph"]
+        assert standalone and standalone[-1]["deadlocked"] is True
+        # per-thread stacks in the wedge carry held locks for the
+        # two deadlocked threads
+        stacks = wedges[-1]["stacks"]
+        held_by_name = {s["thread"]: s.get("held_locks", [])
+                        for s in stacks}
+        assert any(h and h[0]["lock"] == "test/dead_a"
+                   for n, h in held_by_name.items()
+                   if n == "stf_test_dead_1")
+        assert any(h and h[0]["lock"] == "test/dead_b"
+                   for n, h in held_by_name.items()
+                   if n == "stf_test_dead_2")
+
+    def test_potential_deadlock_flight_event_in_process(self):
+        """The witness's potential-deadlock report lands in the flight
+        recorder ring as a ``potential_deadlock`` event."""
+        from simple_tensorflow_tpu.telemetry import recorder
+
+        rec = recorder.get_recorder()
+        a = sync.Lock("test/flight_a", rank=sync.RANK_STATE)
+        b = sync.Lock("test/flight_b", rank=sync.RANK_STATE)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        evs = rec.events(kind="potential_deadlock")
+        assert evs
+        assert evs[-1]["cycle"] == (
+            "test/flight_a -> test/flight_b -> test/flight_a")
+        assert len(evs[-1]["edges"]) == 2
