@@ -3147,6 +3147,177 @@ def _measure_autoshard(platform, device_kind, n_devices=8):
     }
 
 
+def _measure_embedding(platform, device_kind, n_devices=8):
+    """Sharded-embedding row (ISSUE 19): fused gather/scatter-add +
+    dedup-before-lookup vs the naive one-hot contraction, on a Zipf
+    (skewed) id stream against a vocab-sharded table on the ep=8
+    virtual mesh.
+
+    The table is sized to 4x the per-device byte budget this row
+    declares, so replication is off the table (the layout the
+    lint/embedding-replicated-table gate rejects) and the comparison is
+    between the two ways of *reaching* a sharded table: one-hot matmul
+    + all-reduce vs the fused route (ids all-to-all, owner-local
+    gather, rows all-to-all back). Bar: fused+dedup >= 3x naive.
+    Also validates the analyzer's priced all-to-all bytes against the
+    bytes harvested from the compiled HLO (within 25%), and writes the
+    full row to artifacts/bench_embedding_r19.json."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp  # noqa: F401
+
+    import simple_tensorflow_tpu as stf
+    from simple_tensorflow_tpu import parallel
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} virtual devices, have {len(devices)}")
+    dim = 64
+    n_ids = 2048                       # flat zipf id stream per step
+    steps, warmup = 3, 1
+    trials = int(os.environ.get("BENCH_EMBEDDING_TRIALS", "2"))
+    vocab_sweep = (1 << 13, 1 << 15)   # 2 MiB and 8 MiB f32 tables
+    lr = 0.01
+
+    rng = np.random.RandomState(19)
+
+    def zipf_ids(vocab):
+        return np.minimum(rng.zipf(1.3, n_ids) - 1,
+                          vocab - 1).astype(np.int32)
+
+    def run_config(vocab, path, dedup, trace=False):
+        stf.reset_default_graph()
+        mesh = parallel.Mesh({"ep": n_devices},
+                             devices=devices[:n_devices])
+        out = {}
+        with mesh:
+            with parallel.shard_variables_along("ep", min_size=1,
+                                                dim=0):
+                table = stf.get_variable(
+                    "bench/table", [vocab, dim],
+                    initializer=stf.random_uniform_initializer(
+                        -0.05, 0.05, seed=7))
+            ids_ph = stf.placeholder(stf.int32, [n_ids], name="ids")
+            if path == "fused":
+                rows = stf.nn.embedding_lookup_fused(table, ids_ph,
+                                                     dedup=dedup)
+            else:
+                # the textbook SPMD lowering: materialize the one-hot
+                # and contract over the sharded vocab dim (partial
+                # matmuls + an all-reduce of the (B, D) result)
+                oh = stf.one_hot(ids_ph, vocab, dtype=stf.float32)
+                rows = stf.matmul(oh, table)
+            loss = stf.reduce_sum(stf.multiply(rows, rows))
+            train = stf.train.GradientDescentOptimizer(lr) \
+                .minimize(loss)
+            ids = zipf_ids(vocab)
+            feed = {ids_ph: ids}
+            sess = stf.Session()
+            sess.run(stf.global_variables_initializer())
+            opts = md = None
+            if trace:
+                opts = stf.RunOptions(
+                    trace_level=stf.RunOptions.SOFTWARE_TRACE)
+                md = stf.RunMetadata()
+            t0 = time.perf_counter()
+            sess.run(train, feed_dict=feed, options=opts,
+                     run_metadata=md)
+            out["compile_s"] = time.perf_counter() - t0
+            if md is not None:
+                coll = md.cost_graph.get("collective_bytes", {})
+                out["harvested_a2a_bytes"] = float(
+                    coll.get("all-to-all", 0.0))
+                pred = md.cost_graph.get("predicted_collectives", {})
+                out["predicted_a2a_bytes"] = float(
+                    pred.get("bytes_by_kind", {})
+                    .get("all-to-all", 0.0))
+            for _ in range(warmup):
+                sess.run(train, feed_dict=feed)
+            dts = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    sess.run(train, feed_dict=feed)
+                dts.append((time.perf_counter() - t0) / steps)
+            loss_v = sess.run(loss, feed_dict=feed)
+            sess.close()
+        assert np.isfinite(loss_v)
+        out["step_s"] = float(np.median(dts))
+        out["lookups_per_sec"] = n_ids / out["step_s"]
+        out["unique_frac"] = float(np.unique(ids).size) / n_ids
+        return out
+
+    sweep = {}
+    for vocab in vocab_sweep:
+        table_mb = vocab * dim * 4 / 2**20
+        sweep[vocab] = {
+            "table_bytes": vocab * dim * 4,
+            "table_mb": round(table_mb, 1),
+            # the budget this table is 4x over: replication infeasible
+            "device_budget_bytes": vocab * dim * 4 // 4,
+            "fused_dedup": run_config(vocab, "fused", True,
+                                      trace=(vocab == vocab_sweep[-1])),
+            "fused_nodedup": run_config(vocab, "fused", False),
+            "naive_onehot": run_config(vocab, "onehot", False),
+        }
+
+    head = sweep[vocab_sweep[-1]]
+    fused = head["fused_dedup"]
+    naive = head["naive_onehot"]
+    speedup = naive["step_s"] / max(fused["step_s"], 1e-9)
+    pred = fused.get("predicted_a2a_bytes", 0.0)
+    harv = fused.get("harvested_a2a_bytes", 0.0)
+    ratio = (pred / harv) if harv else None
+    result = {
+        "metric": "embedding_fused_dedup_speedup_vs_onehot",
+        "value": round(float(speedup), 2),
+        "unit": ("x (step time, naive one-hot+all-reduce / "
+                 "fused+dedup, zipf ids, ep8 vocab-sharded table)"),
+        "vs_baseline": round(float(speedup), 2),
+        "meets_3x_bar": bool(speedup >= 3.0),
+        "lookups_per_sec_fused_dedup": round(
+            fused["lookups_per_sec"]),
+        "lookups_per_sec_naive": round(naive["lookups_per_sec"]),
+        "dedup_unique_frac": round(fused["unique_frac"], 4),
+        "predicted_a2a_bytes": round(pred),
+        "harvested_a2a_bytes": round(harv),
+        "predicted_over_harvested": (round(ratio, 4)
+                                     if ratio is not None else None),
+        "within_25pct": (bool(abs(ratio - 1.0) <= 0.25)
+                         if ratio is not None else None),
+        "table_bytes_over_device_budget": 4.0,
+        "sweep": {
+            str(v): {
+                "table_mb": sweep[v]["table_mb"],
+                "fused_dedup_step_s": round(
+                    sweep[v]["fused_dedup"]["step_s"], 5),
+                "fused_nodedup_step_s": round(
+                    sweep[v]["fused_nodedup"]["step_s"], 5),
+                "naive_onehot_step_s": round(
+                    sweep[v]["naive_onehot"]["step_s"], 5),
+            } for v in vocab_sweep},
+        "note": ("ep8 virtual mesh; fused = EmbeddingLookupFused "
+                 "(dedup-before-lookup, ids+rows all-to-all, device "
+                 "scatter-add backward), naive = one_hot @ table; "
+                 "predicted bytes from the sharding analyzer's fused "
+                 "rule, harvested from the compiled HLO "
+                 "(utils.perf.collective_bytes_of)"),
+        "device": "cpu_virtual_mesh",
+    }
+    try:
+        art_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "artifacts")
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir, "bench_embedding_r19.json"),
+                  "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass
+    return result
+
+
 def child_main():
     """Runs the actual bench; prints the JSON line itself on success."""
     platform, kind = os.environ.get("BENCH_PLATFORM", "cpu|").split("|", 1)
@@ -3206,6 +3377,8 @@ def child_main():
         result = _measure_generative(platform, kind)
     elif model == "decode2":
         result = _measure_decode2(platform, kind)
+    elif model == "embedding":
+        result = _measure_embedding(platform, kind)
     else:
         result = run_bench(platform, kind)
     emit(result)
@@ -3284,7 +3457,8 @@ def _run_model(model, platform, kind, errors):
                      "shared; the second process disk-hits its XLA "
                      "compiles (compiler.aot.enable_persistent_cache)"),
         }
-    if model in ("resnet_dp", "sharding_analysis", "autoshard"):
+    if model in ("resnet_dp", "sharding_analysis", "autoshard",
+                 "embedding"):
         # virtual-mesh rows: always a CPU-mesh child by design
         env = {k: v for k, v in os.environ.items()
                if k != "PALLAS_AXON_POOL_IPS"}
@@ -3416,6 +3590,9 @@ _METRIC_NAMES = {
                 "cached greedy, same target checkpoint)"),
     "warm_start": ("warm_start_warmup_plus_compile_s",
                    "s (second process, shared persistent compile cache)"),
+    "embedding": ("embedding_fused_dedup_speedup_vs_onehot",
+                  "x (step time, naive one-hot+all-reduce / "
+                  "fused+dedup, zipf ids, ep8 vocab-sharded table)"),
 }
 
 
@@ -3438,7 +3615,7 @@ def main():
             "sharding_analysis,autoshard,loop_fusion,numerics,"
             "input_pipeline,serving,"
             "telemetry,sync,memory,checkpoint,kernel_tier,generative,"
-            "decode2,warm_start").split(","):
+            "decode2,warm_start,embedding").split(","):
         tok = tok.strip()
         if not tok:
             continue
@@ -3458,7 +3635,7 @@ def main():
                     "numerics", "input_pipeline", "serving",
                     "telemetry", "sync", "memory", "checkpoint",
                     "kernel_tier", "generative", "decode2",
-                    "warm_start"]
+                    "warm_start", "embedding"]
     try:
         platform, kind = probe_backend(
             timeout_s=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))

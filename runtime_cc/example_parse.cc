@@ -281,4 +281,118 @@ STF_EXPORT int StfParseExamplesDense(
   return 0;
 }
 
+// Ragged/varlen parse (ISSUE 19: sparse id features feeding pooled
+// embedding-bag lookups). For each declared varlen feature, values land
+// in a caller-preallocated PADDED row-major [n_examples, caps[f]] buffer
+// (caller pre-fills the pad value — -1 for ids by convention) and the
+// TRUE value count lands in out_lengths[e * n_features + f] (it may
+// exceed caps[f]; the Python layer decides truncate-vs-error and counts
+// truncations). A missing feature or a wrong-kind list reads as length 0
+// — VarLen semantics: absent == empty, never an error.
+STF_EXPORT int StfParseExamplesRagged(
+    const uint8_t* const* bufs, const size_t* lens, int64_t n_examples,
+    const char* const* names, const int32_t* kinds, const int64_t* caps,
+    int32_t n_features, void* const* outs, int64_t* out_lengths,
+    StfStatus* status) {
+  size_t name_len[64];
+  if (n_features > 64) {
+    stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                      "at most 64 ragged features per fast-parse call");
+    return 1;
+  }
+  for (int32_t f = 0; f < n_features; ++f)
+    name_len[f] = std::strlen(names[f]);
+
+  for (int64_t e = 0; e < n_examples; ++e) {
+    for (int32_t f = 0; f < n_features; ++f)
+      out_lengths[e * n_features + f] = 0;
+    const uint8_t* p = bufs[e];
+    const uint8_t* end = p + lens[e];
+    while (p < end) {
+      uint64_t key;
+      if (!ReadVarint(p, end, &key)) goto malformed;
+      if ((key >> 3) == 1 && (key & 7) == 2) {  // Features
+        Span feats;
+        if (!ReadLenDelim(p, end, &feats)) goto malformed;
+        const uint8_t* fp = feats.p;
+        const uint8_t* fend = feats.p + feats.n;
+        while (fp < fend) {
+          uint64_t fkey;
+          if (!ReadVarint(fp, fend, &fkey)) goto malformed;
+          if ((fkey >> 3) != 1 || (fkey & 7) != 2) {
+            if (!SkipField(fp, fend, fkey & 7)) goto malformed;
+            continue;
+          }
+          Span entry;  // FeaturesEntry
+          if (!ReadLenDelim(fp, fend, &entry)) goto malformed;
+          const uint8_t* ep = entry.p;
+          const uint8_t* eend = entry.p + entry.n;
+          Span kname{nullptr, 0}, fval{nullptr, 0};
+          while (ep < eend) {
+            uint64_t ekey;
+            if (!ReadVarint(ep, eend, &ekey)) goto malformed;
+            uint32_t ef = static_cast<uint32_t>(ekey >> 3);
+            if (ef == 1 && (ekey & 7) == 2) {
+              if (!ReadLenDelim(ep, eend, &kname)) goto malformed;
+            } else if (ef == 2 && (ekey & 7) == 2) {
+              if (!ReadLenDelim(ep, eend, &fval)) goto malformed;
+            } else if (!SkipField(ep, eend, ekey & 7)) {
+              goto malformed;
+            }
+          }
+          if (!kname.p || !fval.p) continue;
+          int32_t match = -1;
+          for (int32_t f = 0; f < n_features; ++f) {
+            if (kname.n == name_len[f] &&
+                std::memcmp(kname.p, names[f], kname.n) == 0) {
+              match = f;
+              break;
+            }
+          }
+          if (match < 0) continue;  // undeclared feature: ignored (ref)
+          const uint8_t* vp = fval.p;
+          const uint8_t* vend = fval.p + fval.n;
+          while (vp < vend) {
+            uint64_t vkey;
+            if (!ReadVarint(vp, vend, &vkey)) goto malformed;
+            uint32_t vf = static_cast<uint32_t>(vkey >> 3);
+            if ((vkey & 7) != 2) {
+              if (!SkipField(vp, vend, vkey & 7)) goto malformed;
+              continue;
+            }
+            Span list;
+            if (!ReadLenDelim(vp, vend, &list)) goto malformed;
+            int64_t got = -1;
+            if (vf == 2 && kinds[match] == 0) {
+              got = ParseFloatList(
+                  list,
+                  static_cast<float*>(outs[match]) + e * caps[match],
+                  caps[match]);
+            } else if (vf == 3 && kinds[match] == 1) {
+              got = ParseInt64List(
+                  list,
+                  static_cast<int64_t*>(outs[match]) + e * caps[match],
+                  caps[match]);
+            } else {
+              continue;  // wrong-kind list: VarLen reads it as absent
+            }
+            if (got < 0) goto malformed;
+            out_lengths[e * n_features + match] = got;
+          }
+        }
+      } else if (!SkipField(p, end, key & 7)) {
+        goto malformed;
+      }
+    }
+    continue;
+  malformed:
+    stf_internal::Set(status, STF_INVALID_ARGUMENT,
+                      (std::string("malformed Example proto at index ") +
+                       std::to_string(e))
+                          .c_str());
+    return 1;
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -97,6 +97,17 @@ STF_EXPORT int StfParseExamplesDense(
     int32_t n_features, void* const* outs, uint8_t* missing,
     StfStatus* status);
 
+/* Ragged/varlen parse: outs[f] is a caller-prefilled PADDED buffer of
+ * n_examples x caps[f] elements; out_lengths (n_examples x n_features)
+ * receives the TRUE per-row value count (may exceed caps[f] — the
+ * caller decides truncate-vs-error). Missing features read as length
+ * 0 (VarLen: absent == empty). Returns 0 on success. */
+STF_EXPORT int StfParseExamplesRagged(
+    const uint8_t* const* bufs, const size_t* lens, int64_t n_examples,
+    const char* const* names, const int32_t* kinds, const int64_t* caps,
+    int32_t n_features, void* const* outs, int64_t* out_lengths,
+    StfStatus* status);
+
 /* ---- arena allocator (host staging buffers) -------------------------- */
 
 typedef struct StfArena StfArena;

@@ -127,8 +127,8 @@ from .ops.functional_ops import py_func
 from .ops.tensor_array_ops import TensorArray
 from .ops import parsing_ops
 from .ops.parsing_ops import (
-    FixedLenFeature, VarLenFeature, parse_example, parse_single_example,
-    decode_raw,
+    FixedLenFeature, VarLenFeature, RaggedFeature, parse_example,
+    parse_single_example, decode_raw,
 )
 from .ops import misc_ops
 from .ops.misc_ops import (

@@ -104,6 +104,8 @@ def analyze(graph=None, fetches: Optional[Sequence[Any]] = None,
     if mesh is not None:
         report = analyze_sharding(graph=graph, mesh=mesh,
                                   seed_specs=sharding_seeds,
-                                  fetches=fetches, severities=severities)
+                                  fetches=fetches, severities=severities,
+                                  purpose=purpose,
+                                  memory_budget=memory_budget)
         diags.extend(report.diagnostics)
     return diags

@@ -46,6 +46,14 @@ Built-in catalog (see docs/ANALYSIS.md for the worked examples):
                          stf.kernels registry (routed / fallback+reason
                          / autotune). Active only for purpose="kernels"
                          runs (``graph_lint --kernels``) (NOTE)
+  lint/embedding-replicated-table
+                         an embedding table at/over the byte budget
+                         (``--budget`` or 128 MiB default) that
+                         resolves REPLICATED on a >1-device mesh —
+                         every device holds a full copy of a table
+                         that only fits because vocab sharding divides
+                         it. Active only for purpose="embeddings" runs
+                         (``graph_lint --embeddings``) (ERROR)
   lint/memory-budget     the static cost model's predicted peak device
                          memory for a fetch closure exceeds the
                          configured budget (``graph_lint --memory

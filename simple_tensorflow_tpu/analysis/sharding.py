@@ -1240,6 +1240,79 @@ def make_gather_rule(axis_attr: str = "axis", params_idx: int = 0,
     return rule
 
 
+def make_fused_embedding_rule(axis_attr: str = "axis"):
+    """EmbeddingLookupFused (ISSUE 19): the fused route replaces the
+    one-hot contraction with two tiled all-to-alls (id route + row
+    return). ``axis_attr`` names the node attr holding the MESH AXIS
+    NAME the table is vocab-sharded over (unlike make_gather_rule,
+    whose attr is the gathered DIM index — legacy lookups keep the
+    all-reduce pricing above). Priced only when the table's vocab dim
+    actually carries that axis; payload uses the HLO result-shape
+    convention (the (n, b) id and (n, b, D) row buffers each shard
+    materializes) so the bench's predicted-vs-harvested comparison is
+    apples to apples. The output is replicated over the mesh (every
+    shard reassembles the full row set), so downstream specs start
+    clean."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        axis = op.attrs.get(axis_attr, "ep")
+        r = _out_rank(op) or 0
+        sp = in_specs[0]
+        n = ctx.axis_size(axis)
+        if (n > 1 and sp is not None and len(sp) >= 1
+                and axis in tuple(sp[0] or ())):
+            ids_t = op.inputs[1]
+            out_t = op.outputs[0]
+            b = 1
+            for d in (ids_t.shape.dims or []):
+                b *= int(d.value or 1)
+            dim = int(out_t.shape.dims[-1].value or 1) \
+                if out_t.shape.rank else 1
+            nbytes = float(n * b * ids_t.dtype.base_dtype.size
+                           + n * b * dim * out_t.dtype.base_dtype.size)
+            ctx.collective(
+                "all-to-all", (axis,), nbytes,
+                note="fused embedding gather (id route + row return)",
+                tensor_name=out_t.name)
+        elif (n > 1 and sp is not None
+              and any(axis in tuple(e or ()) for e in sp)):
+            # table sharded over `axis` on a NON-vocab dim: the fused
+            # kernel's shard_map in_spec is (axis, None), so GSPMD must
+            # reshard the WHOLE table every step — charge it, so the
+            # search prefers the vocab layout on real cost rather than
+            # by fiat
+            tbl_t = op.inputs[0]
+            tbytes = 1
+            for d in (tbl_t.shape.dims or []):
+                tbytes *= int(d.value or 1)
+            ctx.collective(
+                "all-to-all", (axis,),
+                float(tbytes * tbl_t.dtype.base_dtype.size),
+                note="fused embedding table reshard (non-vocab dim "
+                     "sharded over lookup axis)",
+                tensor_name=op.inputs[0].name)
+        return [replicated(r) for _ in op.outputs]
+
+    return rule
+
+
+def make_fused_scatter_grad_rule(axis_attr: str = "axis"):
+    """EmbeddingScatterAddGrad (ISSUE 19): the dense table gradient is
+    born vocab-sharded over the table's mesh axis (each shard
+    scatter-adds only the rows it owns); no collective — the incoming
+    cotangents are replicated over that axis by construction of the
+    fused forward."""
+
+    def rule(op: Operation, in_specs, ctx: RuleContext):
+        axis = op.attrs.get(axis_attr, "ep")
+        r = _out_rank(op) or 2
+        if ctx.axis_size(axis) > 1:
+            return [((axis,),) + ((),) * (r - 1) for _ in op.outputs]
+        return [replicated(r) for _ in op.outputs]
+
+    return rule
+
+
 def make_conv_rule(n_spatial: int = 2):
     """Convolution: batch + spatial from the data input, the filter is
     consumed replicated on its spatial/in-channel dims; out-channel may
@@ -1769,7 +1842,44 @@ def register_rules(rule, *op_types):
 
 SHARDING_LINT_CODES = (
     "lint/replicated-large-tensor", "lint/resharding-hotspot",
-    "lint/mesh-axis-unused", "lint/uneven-shard")
+    "lint/mesh-axis-unused", "lint/uneven-shard",
+    "lint/embedding-replicated-table")
+
+# lookup op types whose input 0 is an embedding table; and the default
+# per-table byte bar for the embedding-replicated-table ERROR (a table
+# this big resolving replicated on a real mesh defeats the entire point
+# of vocab sharding). graph_lint --embeddings --budget overrides.
+EMBEDDING_LOOKUP_TYPES = ("EmbeddingLookupFused", "EmbeddingLookupMixed",
+                          "Gather", "GatherV2")
+EMBEDDING_TABLE_BUDGET_BYTES = 1 << 27  # 128 MiB
+
+
+def embedding_tables_of(ops, variables):
+    """{table_var_name: (var_op, nbytes, spec, [consumer op types])}
+    for every variable consumed as input 0 of an embedding-style
+    lookup in ``ops``. ``variables`` is ``ShardingReport.variables``.
+    Walks through Identity/Cast/ReadVariableOp wrappers."""
+    var_by_op = {}
+    for name, (vop, nbytes, spec) in variables.items():
+        var_by_op[vop] = (name, nbytes, spec)
+    out: Dict[str, tuple] = {}
+    for op in ops:
+        if op.type not in EMBEDDING_LOOKUP_TYPES or not op.inputs:
+            continue
+        p = op.inputs[0].op
+        hops = 0
+        while (p is not None and p.inputs
+               and p.type in ("Identity", "Cast", "ReadVariableOp")
+               and hops < 4):
+            p = p.inputs[0].op
+            hops += 1
+        info = var_by_op.get(p)
+        if info is None:
+            continue
+        name, nbytes, spec = info
+        entry = out.setdefault(name, (p, nbytes, spec, []))
+        entry[3].append(op.type)
+    return out
 
 
 def _report_of(ctx):
@@ -1795,6 +1905,35 @@ def register_sharding_lint_rules():
                        f"replicated across the {rep.mesh_size}-device "
                        "mesh; shard it (shard_variable / "
                        "shard_variables_along / match_partition_rules)")
+
+    @register_lint_rule("embedding-replicated-table", ERROR)
+    def _rule_embedding_replicated_table(ctx):
+        """A big embedding table resolving REPLICATED on a >1-device
+        mesh (active only for ``purpose="embeddings"`` runs —
+        ``graph_lint --embeddings``; the byte bar is ``--budget`` or
+        EMBEDDING_TABLE_BUDGET_BYTES). Unlike the generic
+        replicated-large-tensor WARNING this is an ERROR: a
+        terabyte-class table only fits at all because vocab sharding
+        divides it, so a replicated resolution is a deploy-blocking
+        misconfiguration, not a smell."""
+        if getattr(ctx, "purpose", None) != "embeddings":
+            return
+        rep = _report_of(ctx)
+        if rep is None or rep.mesh_size <= 1:
+            return
+        budget = int(getattr(ctx, "memory_budget", None)
+                     or EMBEDDING_TABLE_BUDGET_BYTES)
+        tables = embedding_tables_of(ctx.ops, rep.variables)
+        for name, (vop, nbytes, spec, lookups) in sorted(tables.items()):
+            if nbytes >= budget and is_replicated(spec):
+                yield (vop,
+                       f"embedding table {name!r} ({int(nbytes)} bytes, "
+                       f"looked up by {sorted(set(lookups))}) resolves "
+                       f"REPLICATED on the {rep.mesh_size}-device mesh "
+                       f"(>= budget {budget} bytes): every device holds "
+                       "a full copy. Vocab-shard it (spec ('ep', None) "
+                       "via shard_variables_along/match_partition_rules "
+                       "or autoshard with a budget)")
 
     @register_lint_rule("resharding-hotspot", WARNING)
     def _rule_resharding_hotspot(ctx):
@@ -1867,7 +2006,9 @@ def analyze_sharding(graph=None, ops: Optional[Sequence[Operation]] = None,
                      fetches: Optional[Sequence[Any]] = None,
                      feeds: Sequence[Any] = (),
                      with_peak: bool = False,
-                     severities: Optional[Dict[str, str]] = None
+                     severities: Optional[Dict[str, str]] = None,
+                     purpose: Optional[str] = None,
+                     memory_budget: Optional[int] = None
                      ) -> ShardingReport:
     """Run the sharding analysis and the sharding lint rules.
 
@@ -1945,7 +2086,8 @@ def analyze_sharding(graph=None, ops: Optional[Sequence[Operation]] = None,
         rep.diagnostics.extend(lint_mod.lint_graph(
             graph=graph if graph is not None else None,
             ops=ops, fetches=fetches, severities=severities,
-            rules=SHARDING_LINT_CODES, sharding_report=rep))
+            rules=SHARDING_LINT_CODES, sharding_report=rep,
+            purpose=purpose, memory_budget=memory_budget))
     # metrics
     for e in rep.collective_edges():
         metric_collectives.get_cell(e.kind).increase_by(1)

@@ -100,11 +100,16 @@ class TrainingStateSnapshot:
 
         weakref.finalize(self, ledger.release, self._mem_token)
 
-    def materialize(self) -> Dict[str, np.ndarray]:
-        """D2H transfer of every snapshot array (writer-thread side)."""
+    def materialize(self) -> Dict[str, Any]:
+        """D2H transfer of every snapshot array (writer-thread side).
+        Arrays that are really device-sharded pass through UNGATHERED —
+        ``write_native_checkpoint``'s flatten step D2H's them one shard
+        at a time into flat per-shard npz entries, so a vocab-sharded
+        embedding table never assembles on the host."""
         out = {}
         for key, arr in self.arrays.items():
-            out[key] = np.asarray(arr)
+            out[key] = arr if shard_split(arr) is not None \
+                else np.asarray(arr)
         return out
 
     def release_device_state(self) -> None:
@@ -159,6 +164,74 @@ def capture_training_state(sess, vars_map) -> TrainingStateSnapshot:
                                      graph=sess.graph)
 
 
+def shard_split(arr):
+    """Per-shard views of a device array that is REALLY sharded (>1
+    device, non-trivial spec): sorted list of ``(start_offsets,
+    shard)`` with replicated copies deduplicated, or None when the
+    array is replicated / host-side / single-device (callers then save
+    it as one entry). The shards stay device-side; ``np.asarray`` on
+    each is a per-shard D2H — a terabyte-class embedding table never
+    materializes unsharded on one host."""
+    try:
+        sh = getattr(arr, "sharding", None)
+        if sh is None or len(getattr(sh, "device_set", ())) <= 1:
+            return None
+        spec = getattr(sh, "spec", None)
+        if spec is None or not any(p is not None for p in tuple(spec)):
+            return None
+        seen = {}
+        for s in arr.addressable_shards:
+            start = tuple(int(sl.start or 0) for sl in s.index)
+            seen.setdefault(start, s.data)
+        if len(seen) <= 1:
+            return None
+        return sorted(seen.items())
+    except Exception:  # noqa: BLE001 — fall back to the gather path
+        return None
+
+
+def flatten_for_save(arrays, tensor_index):
+    """(flat npz entries, index) for one checkpoint: sharded device
+    arrays become ``<key>@shard<i>of<n>`` entries (one per distinct
+    shard, D2H'd one at a time) and their index meta gains a
+    ``sharded_layout`` describing each shard's start offsets — the
+    restore/verify side reassembles from that, so the on-disk format
+    needs no gather at either end. Everything else is ``np.asarray``'d
+    as before. ``tensor_index`` is copied, not mutated (the async
+    snapshot's index outlives one write attempt)."""
+    flat: Dict[str, np.ndarray] = {}
+    index = {k: dict(v) for k, v in tensor_index.items()}
+    for key, arr in arrays.items():
+        parts = shard_split(arr)
+        if parts is None:
+            flat[key] = np.asarray(arr)
+            continue
+        n = len(parts)
+        shards_meta = []
+        for i, (start, data) in enumerate(parts):
+            skey = f"{key}@shard{i}of{n}"
+            np_shard = np.asarray(data)
+            flat[skey] = np_shard
+            shards_meta.append({"key": skey, "start": list(start),
+                                "shape": list(np_shard.shape)})
+        index.setdefault(key, {})["sharded_layout"] = {
+            "num_shards": n, "shards": shards_meta}
+    return flat, index
+
+
+def assemble_sharded(data, meta) -> np.ndarray:
+    """Reassemble one logical tensor from its per-shard npz entries
+    (inverse of :func:`flatten_for_save`; ``data`` is the open npz)."""
+    lay = meta["sharded_layout"]
+    full = np.empty(tuple(meta["shape"]), np.dtype(meta["dtype"]))
+    for sh in lay["shards"]:
+        part = data[_npz_key(sh["key"])]
+        idx = tuple(slice(st, st + dim)
+                    for st, dim in zip(sh["start"], part.shape))
+        full[idx] = part
+    return full
+
+
 def encode_npz(arrays: Dict[str, np.ndarray]) -> bytes:
     """The .stfz payload as in-memory bytes (so the content checksum is
     computed over exactly what lands on disk)."""
@@ -188,6 +261,7 @@ def write_native_checkpoint(prefix: str, arrays: Dict[str, np.ndarray],
     checkpoint as latest."""
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
     with monitoring.traceme("checkpoint_serialize", n_vars=len(arrays)):
+        arrays, tensor_index = flatten_for_save(arrays, tensor_index)
         payload = encode_npz(arrays)
         doc = build_index_doc(tensor_index, host_state, "native",
                               payload=payload)
@@ -256,6 +330,28 @@ def verify_checkpoint(prefix: str) -> List[str]:
         with np.load(data_path, allow_pickle=False) as data:
             files = set(data.files)
             for key, meta in tensors.items():
+                lay = meta.get("sharded_layout")
+                if lay:
+                    # flat per-shard save: every shard entry present
+                    # with its recorded shape, dtype matching the
+                    # logical tensor's
+                    for sh in lay.get("shards", []):
+                        nk = _npz_key(sh["key"])
+                        if nk not in files:
+                            _fail("tensor_mismatch",
+                                  f"{prefix}: shard {sh['key']!r} of "
+                                  f"{key!r} in index but not in data "
+                                  "file")
+                            continue
+                        arr = data[nk]
+                        if list(arr.shape) != list(sh.get("shape", [])) \
+                                or str(arr.dtype) != meta.get("dtype"):
+                            _fail("tensor_mismatch",
+                                  f"{prefix}: shard {sh['key']!r} is "
+                                  f"{arr.dtype}{list(arr.shape)}, index "
+                                  f"says {meta.get('dtype')}"
+                                  f"{sh.get('shape')}")
+                    continue
                 nk = _npz_key(key)
                 if nk not in files:
                     _fail("tensor_mismatch",

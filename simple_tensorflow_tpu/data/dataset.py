@@ -208,46 +208,63 @@ class Dataset:
         return self._derive(Node(
             "batch", self._node, (int(batch_size), drop_remainder, stack)))
 
-    def parse_example(self, features):
+    def parse_example(self, features, num_parallel_calls=None):
         """Parse serialized tf.Example elements into feature dicts
         (ref: the `parse_example` stage of the reference input pipeline,
         core/util/example_proto_fast_parsing.cc).
 
         Batch-aware: applied AFTER ``.batch(n)`` it parses the whole
-        batch in one native C++ call (all-dense float32/int64 specs,
-        ~10x the per-record Python path); applied before batching it
-        parses records one at a time. Prefer
+        batch in one native C++ call (all-dense float32/int64 FixedLen
+        specs, and all RaggedFeature specs — padded values plus a
+        ``<name>_lengths`` vector, ~10x the per-record Python path);
+        applied before batching it parses records one at a time. Prefer
         ``TFRecordDataset(...).batch(n).parse_example(spec)``.
+
+        ``num_parallel_calls`` > 1 (or AUTOTUNE) runs the parse on the
+        shared stf.data worker pool as a threaded pipeline stage
+        (order-preserving, same contract as ``map``).
         """
         from ..ops import parsing_ops
+
+        num_parallel_calls = _check_parallel_arg(
+            num_parallel_calls, "parse_example: num_parallel_calls")
 
         def as_proto_bytes(s):
             # latin-1 is byte-preserving, so a str that carries proto
             # bytes round-trips; real pipelines carry bytes already
             return s.encode("latin1") if isinstance(s, str) else bytes(s)
 
-        has_varlen = any(not isinstance(s, parsing_ops.FixedLenFeature)
+        # RaggedFeature parses to static padded arrays, so it stacks
+        # fine either side of .batch(); only the COO VarLen triple
+        # needs batch-level parsing
+        has_varlen = any(isinstance(s, parsing_ops.VarLenFeature)
                          for s in features.values())
+
+        def parse_one(x):
+            if isinstance(x, (bytes, np.bytes_, str, np.str_)):
+                if has_varlen:
+                    raise ValueError(
+                        "Dataset.parse_example with VarLenFeature "
+                        "needs batched elements (its output is a "
+                        "batch-level COO triple): call "
+                        ".batch(n).parse_example(spec), and do not "
+                        "re-batch the parsed sparse values.")
+                parsed = parsing_ops.parse_example_py(
+                    [as_proto_bytes(x)], features)
+                return {k: v[0] if not isinstance(v, tuple) else v
+                        for k, v in parsed.items()}
+            return parsing_ops.parse_example_py(
+                [as_proto_bytes(s) for s in
+                 np.ravel(np.asarray(x, dtype=object))],
+                features)
+
+        if num_parallel_calls is not None and num_parallel_calls != 1:
+            return self._derive(Node(
+                "pmap", self._node, (parse_one, num_parallel_calls, True)))
 
         def apply(it):
             for x in it:
-                if isinstance(x, (bytes, np.bytes_, str, np.str_)):
-                    if has_varlen:
-                        raise ValueError(
-                            "Dataset.parse_example with VarLenFeature "
-                            "needs batched elements (its output is a "
-                            "batch-level COO triple): call "
-                            ".batch(n).parse_example(spec), and do not "
-                            "re-batch the parsed sparse values.")
-                    parsed = parsing_ops.parse_example_py(
-                        [as_proto_bytes(x)], features)
-                    yield {k: v[0] if not isinstance(v, tuple) else v
-                           for k, v in parsed.items()}
-                else:
-                    yield parsing_ops.parse_example_py(
-                        [as_proto_bytes(s) for s in
-                         np.ravel(np.asarray(x, dtype=object))],
-                        features)
+                yield parse_one(x)
 
         return self._seq(apply)
 

@@ -196,6 +196,17 @@ def _op_flops(op: Operation, grad_depth: int = 0,
     if t in ("KVCacheAlloc", "KVCacheAppend", "KVCacheGather",
              "KVCachePageCopy"):
         return 0.0  # pure data movement; bytes are priced in _op_bytes
+    if t == "EmbeddingLookupFused":
+        # row routing is data movement (the whole point vs the one-hot
+        # contraction's B*vocab_shard*D matmul flops); the dedup
+        # unique-sort is ~b log b, negligible against the row bytes
+        return 0.0
+    if t == "EmbeddingScatterAddGrad":
+        # one accumulate per incoming cotangent element (segment_sum +
+        # owning-shard scatter-add); NOT the default out-elems pricing,
+        # which would charge the whole table per step
+        return 2.0 * (_nelems(op.inputs[1].shape) or 0) \
+            if len(op.inputs) > 1 else 0.0
     mult = 2.0 if t in _TRANSCENDENTAL_OPS else 1.0
     return mult * _out_elems(op)
 
@@ -280,6 +291,21 @@ def _op_bytes_dispatch(op: Operation, fn_depth: int = 0) -> float:
             row *= int(d)
         itemsize = op.outputs[0].dtype.base_dtype.size if op.outputs else 4
         return 2.0 * m * row * itemsize
+    if op.type == "EmbeddingLookupFused":
+        # the default inputs+outputs accounting would charge reading
+        # the ENTIRE table per lookup; the fused route touches ids +
+        # the gathered rows (read at the owner, written twice through
+        # the send/receive buffers)
+        ids_b = _tensor_bytes(op.inputs[1]) if len(op.inputs) > 1 else 0.0
+        out_b = _tensor_bytes(op.outputs[0]) if op.outputs else 0.0
+        return ids_b + 2.0 * out_b
+    if op.type == "EmbeddingScatterAddGrad":
+        # cotangents read twice (segment_sum + scatter) plus the dense
+        # per-shard gradient buffer write (the output IS materialized —
+        # unlike the lookup, the table-shaped write is real)
+        grad_b = _tensor_bytes(op.inputs[1]) if len(op.inputs) > 1 else 0.0
+        out_b = _tensor_bytes(op.outputs[0]) if op.outputs else 0.0
+        return 2.0 * grad_b + out_b
     fc = _function_op_cost(op, 0, fn_depth)
     if fc is not None:
         return fc[1]

@@ -123,13 +123,25 @@ def _execute_with_override(child, op, grad_type, lowering):
         return outs, (xs, tuple(cap_vals))
 
     def f_bwd(res, gys):
+        import numpy as np
+        from jax import dtypes as jax_dtypes
+
         xs, cap_vals = res
         ctx2 = lowering.LoweringContext({}, rng_root=None)
         grads = lowering.lower_func_graph(ctx2, fg, list(gys),
                                           list(cap_vals))
-        return tuple(
-            gr if gr is not None else jnp.zeros_like(x)
-            for gr, x in zip(grads, xs))
+        out = []
+        for gr, x in zip(grads, xs):
+            # integer/bool primals (gather ids, masks) take float0
+            # cotangents — custom_vjp rejects a same-dtype zeros array
+            if not jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+                out.append(np.zeros(jnp.shape(x),
+                                    dtype=jax_dtypes.float0))
+            elif gr is None:
+                out.append(jnp.zeros_like(x))
+            else:
+                out.append(gr)
+        return tuple(out)
 
     f.defvjp(f_fwd, f_bwd)
     outs = f(*invals)

@@ -7,6 +7,7 @@
 - causal_lm: decoder-only LM + paged-cache serving (shared-prefix path)
 - word2vec: skip-gram NCE tutorial (ref models.BUILD)
 - long_context: ring-attention long-sequence LM (sequence parallel flagship)
+- dlrm: DLRM ranking — vocab-sharded embedding bags + pairwise interaction
 """
 
 from . import mnist
@@ -16,3 +17,4 @@ from . import transformer
 from . import causal_lm
 from . import word2vec
 from . import long_context
+from . import dlrm

@@ -25,7 +25,10 @@ from ..ops.nn_impl import (
     batch_norm_with_global_normalization, l2_normalize, zero_fraction,
     normalize_moments, sufficient_statistics, nce_loss, sampled_softmax_loss,
 )
-from ..ops.embedding_ops import embedding_lookup, embedding_lookup_sparse
+from ..ops.embedding_ops import (
+    embedding_lookup, embedding_lookup_sparse, embedding_lookup_fused,
+    embedding_bag,
+)
 from ..ops.math_ops import sigmoid, tanh
 from ..ops.rnn import (
     dynamic_rnn, static_rnn, bidirectional_dynamic_rnn, raw_rnn,

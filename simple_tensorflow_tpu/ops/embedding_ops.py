@@ -138,14 +138,341 @@ def embedding_lookup_sparse(params, sp_ids, sp_weights,
     raise ValueError(f"unknown combiner {combiner}")
 
 
+# ===========================================================================
+# Fused sharded-embedding fast path (ISSUE 19).
+#
+# The legacy lowering of a lookup on a vocab-sharded table is whatever
+# GSPMD makes of the gather — on TPU the one-hot contraction + all-reduce
+# of the looked-up activations (priced by make_gather_rule). The fused
+# route below is explicit: dedup-before-lookup on device, then a
+# shard_map over the 'ep' axis that routes each distinct id to its
+# owning shard with ONE all-to-all, gathers locally, and returns the hit
+# rows with a second all-to-all. The backward is a first-class
+# EmbeddingScatterAddGrad op (segment_sum over the inverse index, then a
+# masked scatter-add into the owning shard — no collective at all, the
+# cotangents are replicated over ep by construction of the forward).
+#
+# Effects: both ops are deliberately PURE (empty effect set). The table
+# arrives as a ReadVariable output, so hazard ordering against assigns
+# rides the ReadVariable's declared reads; a stateful registration here
+# would also break the _gradient_op_type override (framework/gradients
+# refuses stateful/host ops). The /stf/embedding/* counters are fed by a
+# diagnostic jax.debug.callback, not a graph effect.
+# ===========================================================================
+
+from ..platform import monitoring  # noqa: E402
+
+_emb_lookups = monitoring.Counter(
+    "/stf/embedding/lookups",
+    "Ids looked up through the fused sharded-embedding path", "table")
+_emb_unique = monitoring.Counter(
+    "/stf/embedding/unique_ids",
+    "Distinct ids per fused batch surviving dedup-before-lookup", "table")
+_emb_dedup_ratio = monitoring.IntGauge(
+    "/stf/embedding/dedup_ratio",
+    "unique/total ids of the last fused batch, in basis points "
+    "(10000 = every id distinct)", "table")
+_emb_bytes = monitoring.Counter(
+    "/stf/embedding/bytes_moved",
+    "All-to-all payload bytes moved by the fused route (id route + row "
+    "return, HLO result-shape accounting; 0 on the single-device "
+    "fallback)", "table")
+
+
+def _record_embedding_stats(table, total, n_unique, nbytes):
+    """Host-side counter update behind jax.debug.callback — keep
+    defensive: a metrics failure must never kill a training step."""
+    try:
+        label = str(table)
+        total = int(total)
+        _emb_lookups.get_cell(label).increase_by(total)
+        _emb_unique.get_cell(label).increase_by(int(n_unique))
+        if total:
+            _emb_dedup_ratio.get_cell(label).set(
+                int(round(10000.0 * float(n_unique) / total)))
+        _emb_bytes.get_cell(label).increase_by(int(nbytes))
+    except Exception:  # pragma: no cover — diagnostics only
+        pass
+
+
+def _fused_route(table_l, uniq, *, axis_name, n):
+    """Per-shard body of the fused lookup (runs inside shard_map).
+
+    table_l: (vocab/n, D) local vocab shard; uniq: (b,) deduped ids,
+    replicated. Routes each id to its owning shard with one tiled
+    all-to-all, gathers the rows locally, and all-to-alls them back.
+    Out-of-range ids (incl. the -1 send-buffer sentinel) produce zero
+    rows. Returns (b, D), identical on every shard.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    vl = table_l.shape[0]
+    b = uniq.shape[0]
+    owner = jnp.clip(uniq // vl, 0, n - 1).astype(jnp.int32)
+    # rank of each id within its owner's bucket -> fixed-capacity (n, b)
+    # send buffer. Dedup cannot shrink the buffer (XLA shapes are
+    # static); it shrinks the number of USEFUL slots, observed at
+    # runtime through /stf/embedding/dedup_ratio.
+    onehot = owner[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
+    pos = (jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1)[
+        jnp.arange(b), owner]
+    send = jnp.full((n, b), -1, uniq.dtype).at[owner, pos].set(uniq)
+    # recv[j] = the ids device j asked me (their owner) to resolve
+    recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=True)
+    me = jax.lax.axis_index(axis_name)
+    local = recv - me * vl
+    valid = (recv >= 0) & (local >= 0) & (local < vl)
+    rows = jnp.where(
+        valid[..., None],
+        jnp.take(table_l, jnp.clip(local, 0, vl - 1), axis=0),
+        jnp.zeros((), table_l.dtype))
+    # back[k] aligns with send[k]: the rows I requested from owner k
+    back = jax.lax.all_to_all(rows, axis_name, 0, 0, tiled=True)
+    return back[owner, pos]
+
+
+def _dedup_ids(ids_flat, dedup):
+    """(uniq, inverse-or-None, n_unique) for a flat id vector."""
+    import jax.numpy as jnp
+
+    b = ids_flat.shape[0]
+    if not dedup or b <= 1:
+        return ids_flat, None, jnp.asarray(b, jnp.int32)
+    uniq, inv = jnp.unique(ids_flat, size=b, fill_value=0,
+                           return_inverse=True)
+    inv = inv.reshape(-1)
+    return uniq, inv, (jnp.max(inv) + 1).astype(jnp.int32)
+
+
+def _lower_fused_lookup(ctx, op, inputs):
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import current_mesh, get_shard_map
+
+    table, ids = inputs
+    axis = op.attrs.get("axis", "ep")
+    dedup = bool(op.attrs.get("dedup", True))
+    cdt = dtypes_mod.as_dtype(op.attrs["compute_dtype"]).np_dtype
+    label = op.attrs.get("table", op.name)
+    vocab = int(table.shape[0])
+    dim = int(table.shape[1])
+    ids_shape = tuple(ids.shape)
+    ids_flat = ids.reshape(-1)
+    b = ids_flat.shape[0]
+    tbl = table.astype(cdt)
+
+    uniq, inv, n_unique = _dedup_ids(ids_flat, dedup)
+
+    mesh = current_mesh()
+    in_sm = bool(getattr(ctx, "in_shard_map", False))
+    nbytes = 0
+    if in_sm:
+        n = jax.lax.psum(1, axis)
+        rows = _fused_route(tbl, uniq, axis_name=axis, n=n)
+    elif (mesh is None or axis not in mesh.shape
+            or mesh.axis_size(axis) == 1 or vocab % mesh.axis_size(axis)
+            or b == 0):
+        rows = jnp.take(tbl, jnp.clip(uniq, 0, vocab - 1), axis=0)
+    else:
+        from jax.sharding import PartitionSpec as JP
+
+        n = mesh.axis_size(axis)
+        fn = get_shard_map()(
+            functools.partial(_fused_route, axis_name=axis, n=n),
+            mesh=mesh.jax_mesh,
+            in_specs=(JP(axis, None), JP(None)),
+            out_specs=JP(None), check_vma=False)
+        rows = fn(tbl, uniq)
+        nbytes = n * b * (ids_flat.dtype.itemsize
+                          + dim * rows.dtype.itemsize)
+    out = rows if inv is None else jnp.take(rows, inv, axis=0)
+    if not in_sm:
+        jax.debug.callback(_record_embedding_stats, label,
+                           jnp.asarray(b, jnp.int32), n_unique,
+                           jnp.asarray(float(nbytes), jnp.float32))
+    return [out.reshape(ids_shape + (dim,))]
+
+
+def _lower_scatter_add_grad(ctx, op, inputs):
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import current_mesh, get_shard_map
+
+    ids, g = inputs
+    axis = op.attrs.get("axis", "ep")
+    dedup = bool(op.attrs.get("dedup", True))
+    vocab, dim = (int(d) for d in op.attrs["table_shape"])
+    tdt = dtypes_mod.as_dtype(op.attrs["table_dtype"]).np_dtype
+    ids_flat = ids.reshape(-1)
+    b = ids_flat.shape[0]
+    # upcast BEFORE any accumulation: repeated ids must sum in the
+    # table's own precision (same contract as EmbeddingLookupMixed)
+    gm = g.reshape(b, dim).astype(tdt)
+
+    uniq, inv, _ = _dedup_ids(ids_flat, dedup)
+    if inv is not None:
+        gm = jax.ops.segment_sum(gm, inv, num_segments=b)
+
+    mesh = current_mesh()
+    in_sm = bool(getattr(ctx, "in_shard_map", False))
+
+    def _scatter_shard(uniq_s, gu_s, *, n):
+        vl = vocab // n
+        me = jax.lax.axis_index(axis)
+        lo = me * vl
+        loc = jnp.clip(uniq_s - lo, 0, vl - 1)
+        own = (uniq_s >= lo) & (uniq_s < lo + vl)
+        add = jnp.where(own[:, None], gu_s, jnp.zeros((), gu_s.dtype))
+        return jnp.zeros((vl, dim), tdt).at[loc].add(add)
+
+    if in_sm:
+        n = jax.lax.psum(1, axis)
+        return [_scatter_shard(uniq, gm, n=n)]
+    if (mesh is None or axis not in mesh.shape
+            or mesh.axis_size(axis) == 1 or vocab % mesh.axis_size(axis)
+            or b == 0):
+        dtab = jnp.zeros((vocab, dim), tdt).at[
+            jnp.clip(uniq, 0, vocab - 1)].add(
+                jnp.where((uniq >= 0)[:, None] & (uniq < vocab)[:, None],
+                          gm, jnp.zeros((), gm.dtype)))
+        return [dtab]
+    from jax.sharding import PartitionSpec as JP
+
+    n = mesh.axis_size(axis)
+    fn = get_shard_map()(
+        functools.partial(_scatter_shard, n=n),
+        mesh=mesh.jax_mesh, in_specs=(JP(None), JP(None)),
+        out_specs=JP(axis, None), check_vma=False)
+    return [fn(uniq, gm)]
+
+
+op_registry.register("EmbeddingLookupFused", lower=_lower_fused_lookup)
+op_registry.register("EmbeddingScatterAddGrad",
+                     lower=_lower_scatter_add_grad)
+
+
+from ..framework.gradients import RegisterGradient  # noqa: E402
+
+
+@RegisterGradient("FusedEmbeddingLookupGrad")
+def _fused_lookup_grad(op, grad):
+    """d(lookup)/d(table) as a first-class EmbeddingScatterAddGrad op;
+    ids carry no gradient. Activated through the _gradient_op_type attr
+    stamped at op creation (no gradient_override_map needed)."""
+    g = ops_mod.get_default_graph()
+    table_t, ids_t = op.inputs[0], op.inputs[1]
+    node = g.create_op(
+        "EmbeddingScatterAddGrad", [ids_t, grad],
+        attrs={"axis": op.attrs.get("axis", "ep"),
+               "dedup": bool(op.attrs.get("dedup", True)),
+               "table_shape": tuple(int(d.value)
+                                    for d in table_t.shape.dims),
+               "table_dtype": table_t.dtype.base_dtype.name},
+        name=op.name + "_scatter_add",
+        output_specs=[(table_t.shape, table_t.dtype.base_dtype)])
+    return [node.outputs[0], None]
+
+
+def _resolve_table(params):
+    if isinstance(params, variables_mod.PartitionedVariable):
+        params = list(params)
+    if isinstance(params, (list, tuple)) and len(params) > 1:
+        p0 = [p._ref if isinstance(p, variables_mod.Variable) else p
+              for p in params]
+        return array_ops.concat(list(p0), axis=0)
+    p = params[0] if isinstance(params, (list, tuple)) else params
+    return (p._ref if isinstance(p, variables_mod.Variable)
+            else ops_mod.convert_to_tensor(p))
+
+
+def embedding_lookup_fused(params, ids, *, axis="ep", dedup=True,
+                           compute_dtype=None, name=None):
+    """Fused sharded-embedding lookup (ISSUE 19 tentpole).
+
+    Semantics of ``embedding_lookup`` restricted to a rank-2 table with
+    statically known shape and in-range ids; with the table
+    vocab-sharded over mesh axis ``axis`` the lowering routes distinct
+    ids to their owning shard with a single all-to-all instead of the
+    one-hot contraction + all-reduce. ``dedup`` runs the per-batch
+    unique+inverse pass so each distinct id crosses the wire once.
+    Single-device (or no ``axis`` in the mesh): a plain clipped gather.
+    """
+    table = _resolve_table(params)
+    ids = ops_mod.convert_to_tensor(ids)
+    if table.shape.rank != 2 or not all(
+            d.value for d in table.shape.dims):
+        raise ValueError(
+            "embedding_lookup_fused needs a statically-shaped rank-2 "
+            f"table, got {table.shape}")
+    dt = (dtypes_mod.as_dtype(compute_dtype) if compute_dtype is not None
+          else table.dtype.base_dtype)
+    g = ops_mod.get_default_graph()
+    op = g.create_op(
+        "EmbeddingLookupFused", [table, ids],
+        attrs={"axis": axis, "dedup": bool(dedup),
+               "compute_dtype": dt.name,
+               "table": table.op.name,
+               "_gradient_op_type": "FusedEmbeddingLookupGrad"},
+        name=name or "embedding_lookup_fused",
+        output_specs=[(ids.shape.concatenate(table.shape[1:]), dt)])
+    return op.outputs[0]
+
+
+def embedding_bag(params, ids, lengths=None, *, combiner="mean",
+                  axis="ep", dedup=True, compute_dtype=None, name=None):
+    """Pooled bag lookup over padded (B, L) id matrices — the consumer
+    of the ragged/varlen Example parse (stf.data DATA.md contract):
+    row i pools ids[i, :lengths[i]]; padding slots (any id; the parser
+    emits -1) contribute zero. combiner: "sum" | "mean"."""
+    import numpy as np
+
+    if combiner not in ("sum", "mean"):
+        raise ValueError(f"embedding_bag combiner must be sum|mean, "
+                         f"got {combiner!r}")
+    ids = ops_mod.convert_to_tensor(ids)
+    if ids.shape.rank != 2 or ids.shape.dims[1].value is None:
+        raise ValueError(
+            f"embedding_bag needs (B, L) ids with static L, got {ids.shape}")
+    zero = ops_mod.convert_to_tensor(0, dtype=ids.dtype.base_dtype)
+    emb = embedding_lookup_fused(
+        params, math_ops.maximum(ids, zero), axis=axis, dedup=dedup,
+        compute_dtype=compute_dtype, name=name)  # (B, L, D)
+    fdt = emb.dtype.base_dtype
+    if lengths is not None:
+        seq = ops_mod.convert_to_tensor(
+            np.arange(int(ids.shape.dims[1].value)),
+            dtype=lengths.dtype.base_dtype)
+        mask = math_ops.cast(
+            math_ops.less(array_ops.expand_dims(seq, 0),
+                          array_ops.expand_dims(lengths, 1)), fdt)
+    else:
+        mask = math_ops.cast(math_ops.greater_equal(ids, zero), fdt)
+    weighted = emb * array_ops.expand_dims(mask, -1)
+    summed = math_ops.reduce_sum(weighted, axis=1)  # (B, D)
+    if combiner == "sum":
+        return summed
+    counts = math_ops.reduce_sum(mask, axis=1, keepdims=True)
+    one = ops_mod.convert_to_tensor(1.0, dtype=fdt)
+    return summed / math_ops.maximum(counts, one)
+
+
 # ---------------------------------------------------------------------------
-# sharding propagation rules (stf.analysis.sharding; ISSUE 6): a
-# vocab-sharded table gathers via the one-hot contraction -> all-reduce
-# of the looked-up activations (the ep-sharding cost the analyzer must
-# surface before compile).
+# sharding propagation rules (stf.analysis.sharding; ISSUE 6 + 19): the
+# legacy lookup lowers through GSPMD's gather -> one-hot contraction +
+# all-reduce of the looked-up activations (make_gather_rule). The fused
+# ops price their actual wire traffic: two tiled all-to-alls at HLO
+# result-shape bytes (make_fused_embedding_rule), nothing for the
+# backward scatter (cotangents are ep-replicated by construction).
 # ---------------------------------------------------------------------------
 
 from ..analysis import sharding as _shard  # noqa: E402
 
 _shard.register_rules(_shard.make_gather_rule("axis"),
                       "EmbeddingLookupMixed")
+_shard.register_rules(_shard.make_fused_embedding_rule("axis"),
+                      "EmbeddingLookupFused")
+_shard.register_rules(_shard.make_fused_scatter_grad_rule("axis"),
+                      "EmbeddingScatterAddGrad")

@@ -18,6 +18,25 @@ TPU-native split:
 - ``MutableHashTable`` (insert during training) always stays host-stage:
   device constants would go stale under mutation.
 
+Host-round-trip audit (ISSUE 19). Ops that appear on a training plan's
+hot path and what stage they lower to:
+
+- ``LookupTableFindDevice`` / ``LookupTableSizeDevice`` — device
+  (frozen tables: init-once HashTable vocab embeds as XLA constants;
+  size is a baked scalar). The OOV id-remap combine
+  (``IdTableWithHashBuckets.lookup``) uses the device size op, so the
+  per-step plan has NO host dependency for the vocab-size offset.
+- ``LookupTableFind`` (string keys or string values) — host by
+  necessity: object arrays cannot enter an XLA program. A training
+  plan that remaps string→id per step therefore carries a host stage;
+  the supported pattern is to remap in the input pipeline (data/
+  pipeline.py stage) and feed integer ids, which keeps the step graph
+  device-pure.
+- ``LookupTableInsert`` / mutable ``LookupTableFind``/``Size``/
+  ``Export`` — host by design (mutation invalidates any device
+  snapshot); these are diagnostic/vocab-building ops, not step-loop
+  ops.
+
 Initialization runs through ``tf.tables_initializer()`` semantics: every
 initializer op is added to ``GraphKeys.TABLE_INITIALIZERS``.
 """
@@ -250,6 +269,18 @@ class InitializableLookupTableBase(LookupInterface):
         return np.asarray(len(self._host_map), dtype=np.int64)
 
     # -- graph endpoint ------------------------------------------------------
+    def size(self, name=None):
+        """Frozen tables lower size to a DEVICE constant (the vocab is
+        static after init) — consumers like the OOV id-remap offset stay
+        in the compiled step instead of waiting on a host stage."""
+        g = ops_mod.get_default_graph()
+        op = g.create_op("LookupTableSizeDevice", [],
+                         attrs={"table_name": self._name},
+                         name=name or f"{self._name}_size",
+                         output_specs=[(shape_mod.scalar(),
+                                        dtypes_mod.int64)])
+        return op.outputs[0]
+
     def lookup(self, keys, name=None):
         keys = self._check_keys(keys)
         g = ops_mod.get_default_graph()
@@ -453,6 +484,20 @@ op_registry.register("LookupTableFindDevice", lower=_lower_find_device,
                      is_stateful=True, n_outputs=1)
 
 
+def _lower_size_device(ctx, op, inputs):
+    """Frozen-table size as a baked device scalar (same trust model as
+    FindDevice: valid because init-once tables never change size)."""
+    import jax.numpy as jnp
+
+    table = _get_table(op)
+    table._require_init()
+    return [jnp.asarray(int(table._host_size()))]
+
+
+op_registry.register("LookupTableSizeDevice", lower=_lower_size_device,
+                     is_stateful=True, n_outputs=1)
+
+
 # ---------------------------------------------------------------------------
 # Convenience constructors (ref: contrib/lookup/lookup_ops.py)
 # ---------------------------------------------------------------------------
@@ -579,5 +624,5 @@ def initialize_all_tables(name="init_all_tables"):
 op_registry.declare_effects("InitializeTable", op_registry.Effects(writes=("table_name",)))
 op_registry.declare_effects("LookupTableInsert", op_registry.Effects(writes=("table_name",)))
 for _r_op in ("LookupTableFind", "LookupTableSize", "LookupTableExport",
-              "LookupTableFindDevice"):
+              "LookupTableFindDevice", "LookupTableSizeDevice"):
     op_registry.declare_effects(_r_op, op_registry.Effects(reads=("table_name",)))

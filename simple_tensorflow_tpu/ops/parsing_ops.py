@@ -28,6 +28,10 @@ _parse_batches = monitoring.Counter(
 _parse_records = monitoring.Counter(
     "/stf/data/parse_example_records",
     "Example protos parsed by parser path", "path")
+_ragged_truncated = monitoring.Counter(
+    "/stf/data/ragged_truncated_values",
+    "Values dropped from RaggedFeature rows longer than max_len",
+    "feature")
 
 
 class FixedLenFeature:
@@ -47,6 +51,29 @@ class VarLenFeature:
 
     def __init__(self, dtype):
         self.dtype = dtypes_mod.as_dtype(dtype)
+
+
+class RaggedFeature:
+    """Varlen feature parsed to a PADDED dense [batch, max_len] tensor
+    plus a ``<name>_lengths`` int64 [batch] vector (ISSUE 19; DATA.md
+    "ragged/varlen parsing contract") — the XLA-friendly form feeding
+    ``embedding_bag`` pooled lookups, unlike VarLenFeature's dynamic COO
+    triple. Rows longer than ``max_len`` are TRUNCATED (counted in
+    /stf/data/ragged_truncated_values, never an error); absent features
+    parse as length 0. Padding slots hold ``pad_value`` (-1 by
+    convention for id features — embedding_bag masks them out).
+    Batches of all-float32/int64 ragged specs parse in one C++ call
+    (runtime_cc StfParseExamplesRagged)."""
+
+    def __init__(self, dtype, max_len, pad_value=-1):
+        self.dtype = dtypes_mod.as_dtype(dtype)
+        if self.dtype not in (dtypes_mod.float32, dtypes_mod.int64):
+            raise TypeError(
+                f"RaggedFeature supports float32/int64, got {self.dtype}")
+        self.max_len = int(max_len)
+        if self.max_len <= 0:
+            raise ValueError("RaggedFeature max_len must be positive")
+        self.pad_value = pad_value
 
 
 def _feature_values(feature, dtype):
@@ -113,22 +140,95 @@ def _parse_examples_fast(serialized, features):
     return out
 
 
+def _finish_ragged(name, spec, arr, true_lens):
+    """Clamp lengths to the cap, account truncations, and normalize the
+    pad value (shared by the native and slow ragged paths)."""
+    over = true_lens - spec.max_len
+    n_trunc = int(over[over > 0].sum())
+    if n_trunc:
+        _ragged_truncated.get_cell(name).increase_by(n_trunc)
+    lens = np.minimum(true_lens, spec.max_len).astype(np.int64)
+    pad = spec.pad_value if spec.dtype == dtypes_mod.int64 else 0.0
+    mask = np.arange(spec.max_len)[None, :] >= lens[:, None]
+    arr[mask] = pad
+    return arr, lens
+
+
+def _parse_ragged(serialized, specs):
+    """RaggedFeature batch parse -> ({name: padded, name_lengths: lens},
+    path). One native C++ call when available, else the Python wire
+    path."""
+    from ..runtime import native
+
+    names = sorted(specs)
+    out = {}
+    if len(names) <= 64 and native.ragged_parse_available():
+        serialized = [bytes(s) for s in serialized]
+        kinds = [0 if specs[n].dtype == dtypes_mod.float32 else 1
+                 for n in names]
+        caps = [specs[n].max_len for n in names]
+        try:
+            arrays, lengths = native.parse_examples_ragged(
+                serialized, names, kinds, caps)
+        except RuntimeError:
+            arrays = None
+        if arrays is not None:
+            for f, n in enumerate(names):
+                arr, lens = _finish_ragged(n, specs[n], arrays[f],
+                                           lengths[:, f])
+                out[n] = arr
+                out[n + "_lengths"] = lens
+            return out, "native"
+    batch = [example_mod.Example.FromString(bytes(s)) for s in serialized]
+    for n in names:
+        spec = specs[n]
+        pad = spec.pad_value if spec.dtype == dtypes_mod.int64 else 0.0
+        arr = np.full((len(batch), spec.max_len), pad,
+                      spec.dtype.as_numpy_dtype)
+        true_lens = np.zeros((len(batch),), np.int64)
+        for i, ex in enumerate(batch):
+            f = ex.features.feature.get(n)
+            vals = (_feature_values(f, spec.dtype) if f is not None
+                    else np.zeros((0,), spec.dtype.as_numpy_dtype))
+            true_lens[i] = len(vals)
+            k = min(len(vals), spec.max_len)
+            arr[i, :k] = vals[:k]
+        arr, lens = _finish_ragged(n, spec, arr, true_lens)
+        out[n] = arr
+        out[n + "_lengths"] = lens
+    return out, "python"
+
+
 def parse_example_py(serialized, features):
     """Host parser: list[bytes] -> {name: ndarray or (indices,values,shape)}.
 
-    FixedLenFeature -> dense [batch] + shape; VarLenFeature -> COO triple.
-    All-dense float32/int64 specs take the native C++ batch fast path
-    (one C call per batch); /stf/data/parse_example_* counters record
-    which path served each batch.
+    FixedLenFeature -> dense [batch] + shape; VarLenFeature -> COO
+    triple; RaggedFeature -> padded dense [batch, max_len] plus a
+    ``<name>_lengths`` vector. All-dense float32/int64 FixedLen specs
+    and all RaggedFeature specs take the native C++ batch fast paths
+    (one C call each per batch); /stf/data/parse_example_* counters
+    record which path served each batch.
     """
     with monitoring.traceme("parse_example_batch", n=len(serialized)):
-        fast = _parse_examples_fast(serialized, features)
-        path = "python" if fast is None else "native"
+        ragged = {k: v for k, v in features.items()
+                  if isinstance(v, RaggedFeature)}
+        rest = {k: v for k, v in features.items()
+                if not isinstance(v, RaggedFeature)}
+        out = {}
+        path = None
+        if ragged:
+            rout, path = _parse_ragged(serialized, ragged)
+            out.update(rout)
+        if rest:
+            fast = _parse_examples_fast(serialized, rest)
+            path = "python" if fast is None else "native"
+            out.update(fast if fast is not None
+                       else _parse_example_slow(serialized, rest))
+        if path is None:
+            path = "python"
         _parse_batches.get_cell(path).increase_by(1)
         _parse_records.get_cell(path).increase_by(len(serialized))
-        if fast is not None:
-            return fast
-        return _parse_example_slow(serialized, features)
+        return out
 
 
 def _parse_example_slow(serialized, features):
@@ -201,6 +301,9 @@ def _register_parse_op():
                 flat.append(v[0])
             else:
                 flat.append(v)
+            if isinstance(feats[name], RaggedFeature):
+                lens = parsed[name + "_lengths"]
+                flat.append(lens[0] if single else lens)
         return flat
 
     op_registry.register("ParseExample", lower=lower, is_stateful=True,
@@ -222,6 +325,11 @@ def _parse_example_graph(serialized, features, name, single):
             lead = [] if single else [batch]
             specs.append((shape_mod.TensorShape(lead + spec.shape),
                           spec.dtype))
+        elif isinstance(spec, RaggedFeature):
+            lead = [] if single else [batch]
+            specs.append((shape_mod.TensorShape(lead + [spec.max_len]),
+                          spec.dtype))
+            specs.append((shape_mod.TensorShape(lead), dtypes_mod.int64))
         else:  # VarLen -> indices, values, dense_shape
             specs.append((shape_mod.TensorShape([None, 2]), dtypes_mod.int64))
             specs.append((shape_mod.TensorShape([None]), spec.dtype))
@@ -236,6 +344,10 @@ def _parse_example_graph(serialized, features, name, single):
         if isinstance(spec, FixedLenFeature):
             out[n] = op.outputs[i]
             i += 1
+        elif isinstance(spec, RaggedFeature):
+            out[n] = op.outputs[i]
+            out[n + "_lengths"] = op.outputs[i + 1]
+            i += 2
         else:
             out[n] = sparse_mod.SparseTensor(op.outputs[i], op.outputs[i + 1],
                                              op.outputs[i + 2])

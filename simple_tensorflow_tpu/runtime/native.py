@@ -128,6 +128,15 @@ def _bind(lib):
         c.POINTER(c.c_char_p), c.POINTER(c.c_int32), c.POINTER(c.c_int64),
         c.c_int32, c.POINTER(c.c_void_p), c.POINTER(c.c_uint8), c.c_void_p]
     lib.StfParseExamplesDense.restype = c.c_int
+    # hasattr-gated: a stale .so built before ISSUE 19 lacks the ragged
+    # entry point; the Python layer then falls back to the slow path
+    if hasattr(lib, "StfParseExamplesRagged"):
+        lib.StfParseExamplesRagged.argtypes = [
+            c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_size_t),
+            c.c_int64, c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64), c.c_int32, c.POINTER(c.c_void_p),
+            c.POINTER(c.c_int64), c.c_void_p]
+        lib.StfParseExamplesRagged.restype = c.c_int
     return lib
 
 
@@ -304,6 +313,62 @@ def parse_examples_dense(serialized, names, kinds, sizes):
         if rc:
             st.check()
     return arrays, missing.astype(bool)
+
+
+def ragged_parse_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "StfParseExamplesRagged")
+
+
+def parse_examples_ragged(serialized, names, kinds, caps, pad_id=-1):
+    """Batch-parse varlen tf.Example features into padded numpy arrays
+    via the C++ fast parser (ISSUE 19: sparse id features feeding
+    pooled embedding bags).
+
+    serialized: sequence of bytes. names: feature names. kinds:
+    0=float32, 1=int64 per feature. caps: per-feature padded row width.
+    Returns (arrays, lengths): arrays[f] is [n, caps[f]] padded with
+    ``pad_id`` (float features pad with 0.0); lengths is int64
+    [n, n_features] holding each row's TRUE value count — entries may
+    exceed caps[f] when the row was truncated (DATA.md contract: the
+    caller clamps and accounts truncations; absent features are
+    length 0).
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "StfParseExamplesRagged"):
+        raise RuntimeError("native ragged parser unavailable")
+    n = len(serialized)
+    nf = len(names)
+    bufs = (ctypes.POINTER(ctypes.c_uint8) * n)()
+    lens = (ctypes.c_size_t * n)()
+    keepalive = []
+    for i, s in enumerate(serialized):
+        b = bytes(s)
+        keepalive.append(b)
+        bufs[i] = ctypes.cast(ctypes.c_char_p(b),
+                              ctypes.POINTER(ctypes.c_uint8))
+        lens[i] = len(b)
+    cnames = (ctypes.c_char_p * nf)(*[x.encode() for x in names])
+    ckinds = (ctypes.c_int32 * nf)(*kinds)
+    ccaps = (ctypes.c_int64 * nf)(*caps)
+    arrays = []
+    outs = (ctypes.c_void_p * nf)()
+    for f in range(nf):
+        if kinds[f] == 0:
+            a = np.zeros((n, caps[f]), dtype=np.float32)
+        else:
+            a = np.full((n, caps[f]), pad_id, dtype=np.int64)
+        arrays.append(a)
+        outs[f] = a.ctypes.data_as(ctypes.c_void_p)
+    lengths = np.zeros((n, nf), dtype=np.int64)
+    with _Status(lib) as st:
+        rc = lib.StfParseExamplesRagged(
+            bufs, lens, n, cnames, ckinds, ccaps, nf, outs,
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            st.handle)
+        if rc:
+            st.check()
+    return arrays, lengths
 
 
 def write_tfrecords(path: str, records: Sequence[bytes],

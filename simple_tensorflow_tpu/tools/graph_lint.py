@@ -5,6 +5,7 @@
         [--level structural|full] [--json] [--serving] \
         [--kernels [off|auto|force]] \
         [--memory [--budget BYTES]] [--numerics] \
+        [--embeddings [--budget BYTES]] \
         [--mesh 8|2x4|dp=2,tp=4] [--rules rules.json] \
         [--autoshard [--emit-rules out.json] [--budget BYTES]] \
         [--max-severity note|warning|error]
@@ -94,6 +95,42 @@ def memory_summary(graph, fetch_names=None, fetches=None, budget=None):
     return rows
 
 
+def embedding_summary(graph, report, budget=None):
+    """Per-table verdict rows for ``graph_lint --embeddings``: every
+    variable consumed as an embedding table, its resolved spec on the
+    analyzed mesh, and a verdict — ``vocab-sharded`` (dim 0 carries a
+    mesh axis: the fused all-to-all route), ``dim-sharded`` (sharded,
+    but the lookup must reshard the table), or ``replicated`` (flagged
+    over-budget at/over the byte bar)."""
+    from ..analysis import sharding as sharding_mod
+
+    budget = int(budget or sharding_mod.EMBEDDING_TABLE_BUDGET_BYTES)
+    tables = sharding_mod.embedding_tables_of(graph.get_operations(),
+                                              report.variables)
+    rows = []
+    def _axes_of(entry):
+        if entry is None:
+            return ()
+        return tuple(entry) if isinstance(entry, (tuple, list)) \
+            else (entry,)
+
+    for name, (vop, nbytes, spec, lookups) in sorted(tables.items()):
+        spec_t = sharding_mod.to_partition_spec(spec) or ()
+        if spec_t and _axes_of(spec_t[0]):
+            verdict = "vocab-sharded"
+        elif any(_axes_of(e) for e in spec_t):
+            verdict = "dim-sharded"
+        else:
+            verdict = "replicated"
+        rows.append({"table": name, "bytes": int(nbytes),
+                     "spec": [e for e in spec_t],
+                     "lookups": sorted(set(lookups)),
+                     "verdict": verdict,
+                     "over_budget": bool(verdict == "replicated"
+                                         and nbytes >= budget)})
+    return rows
+
+
 def autoshard_summary(graph, mesh, fetches=None, partition_rules=None,
                       budget=None):
     """``graph_lint --autoshard``: run the PartitionSpec search offline
@@ -153,7 +190,8 @@ def run_lint(graph_def: dict, fetch_names=None, severities=None,
         report_obj = analysis.analyze_sharding(
             graph=graph, mesh=mesh, seed_specs=seeds,
             fetches=fetches or None, with_peak=bool(fetches),
-            severities=severities)
+            severities=severities, purpose=purpose,
+            memory_budget=memory_budget)
         diags.extend(report_obj.diagnostics)
     return diags, graph, report_obj
 
@@ -226,6 +264,14 @@ def main(argv=None):
                          "closure — and lint/serving-decode-cache: "
                          "KV-cache ops missing committed shardings, or "
                          "a cache tensor escaping to host)")
+    ap.add_argument("--embeddings", action="store_true",
+                    help="lint the sparse-embedding plane (requires "
+                         "--mesh): activate the lint/embedding-"
+                         "replicated-table ERROR (a table at/over "
+                         "--budget bytes — default 128 MiB — that "
+                         "resolves replicated on a >1-device mesh) and "
+                         "print a per-table verdict column "
+                         "(vocab-sharded / dim-sharded / replicated)")
     ap.add_argument("--numerics", action="store_true",
                     help="lint for statically visible NaN/Inf seeds: "
                          "activate the lint/numeric-risk rule "
@@ -273,12 +319,18 @@ def main(argv=None):
     from .. import analysis
 
     if sum(bool(x) for x in (args.kernels, args.serving, args.memory,
-                             args.numerics, args.autoshard)) > 1:
-        ap.error("--kernels, --serving, --memory, --numerics, and "
-                 "--autoshard are separate lint purposes; run them as "
-                 "separate invocations")
-    if args.budget is not None and not (args.memory or args.autoshard):
-        ap.error("--budget requires --memory or --autoshard")
+                             args.numerics, args.autoshard,
+                             args.embeddings)) > 1:
+        ap.error("--kernels, --serving, --memory, --numerics, "
+                 "--autoshard, and --embeddings are separate lint "
+                 "purposes; run them as separate invocations")
+    if args.budget is not None and not (args.memory or args.autoshard
+                                        or args.embeddings):
+        ap.error("--budget requires --memory, --autoshard, or "
+                 "--embeddings")
+    if args.embeddings and not mesh:
+        ap.error("--embeddings requires --mesh (the verdicts are the "
+                 "RESOLVED table shardings on that mesh)")
     if args.autoshard and not mesh:
         ap.error("--autoshard requires --mesh")
     if args.emit_rules and not args.autoshard:
@@ -286,7 +338,8 @@ def main(argv=None):
     purpose = "serving" if args.serving else (
         "kernels" if args.kernels else (
             "memory" if args.memory else (
-                "numerics" if args.numerics else None)))
+                "numerics" if args.numerics else (
+                    "embeddings" if args.embeddings else None))))
     from ..kernels import registry as _kreg
 
     with _kreg.activate(args.kernels):
@@ -300,6 +353,10 @@ def main(argv=None):
         if args.kernels and _graph is not None:
             kernel_summary = kernel_routing_summary(_graph,
                                                     mode=args.kernels)
+        embedding_rows = None
+        if args.embeddings and _graph is not None and report is not None:
+            embedding_rows = embedding_summary(_graph, report,
+                                               budget=args.budget)
         memory_rows = None
         if args.memory and _graph is not None:
             fetches = []
@@ -339,6 +396,8 @@ def main(argv=None):
             print(json.dumps({"kernel_routing": kernel_summary}))
         if memory_rows is not None:
             print(json.dumps({"memory": memory_rows}))
+        if embedding_rows is not None:
+            print(json.dumps({"embeddings": embedding_rows}))
         if autoshard_result is not None:
             print(json.dumps(
                 {"autoshard": json.loads(autoshard_result.to_json())}))
@@ -363,6 +422,14 @@ def main(argv=None):
                       f"{r['predicted_peak_bytes']:>10} "
                       f"{r['resident_bytes']:>10} "
                       f"{r['transient_bytes']:>10}{mark}")
+        if embedding_rows is not None:
+            print(f"embeddings ({len(embedding_rows)} table(s)):")
+            for r in embedding_rows:
+                spec = ", ".join("None" if e is None else str(e)
+                                 for e in r["spec"]) or "-"
+                mark = "  OVER BUDGET" if r["over_budget"] else ""
+                print(f"  {r['table'][:38]:<40}{r['bytes']:>12} B  "
+                      f"P({spec})  {r['verdict']}{mark}")
         if kernel_summary is not None:
             print(f"kernel routing [{kernel_summary['mode']}/"
                   f"{kernel_summary['backend']}]: "

@@ -85,10 +85,30 @@ def load_checkpoint_values(checkpoint_prefix):
     read through this."""
     import numpy as np
 
-    path = (checkpoint_prefix if checkpoint_prefix.endswith(".stfz")
-            else checkpoint_prefix + ".stfz")
-    with np.load(path, allow_pickle=False) as data:
-        return {k.replace("|", "/"): data[k] for k in data.files}
+    prefix = (checkpoint_prefix[:-len(".stfz")]
+              if checkpoint_prefix.endswith(".stfz")
+              else checkpoint_prefix)
+    sharded_meta = {}
+    try:
+        with open(prefix + ".index.json") as f:
+            for key, meta in json.load(f).get("tensors", {}).items():
+                if meta.get("sharded_layout"):
+                    sharded_meta[key] = meta
+    except (OSError, json.JSONDecodeError, KeyError):
+        pass  # no/old index: every npz entry is a whole tensor
+    with np.load(prefix + ".stfz", allow_pickle=False) as data:
+        from ..checkpoint import snapshot as snapshot_mod
+
+        out = {}
+        shard_keys = {s["key"] for m in sharded_meta.values()
+                      for s in m["sharded_layout"]["shards"]}
+        for k in data.files:
+            logical = k.replace("|", "/")
+            if logical not in shard_keys:
+                out[logical] = data[k]
+        for key, meta in sharded_meta.items():
+            out[key] = snapshot_mod.assemble_sharded(data, meta)
+        return out
 
 
 def _capture_host_state(sess):
@@ -263,9 +283,17 @@ class Saver:
         else:
             # blocking native path, same serialize+atomic-commit
             # pipeline as the async writer: npz bytes -> checksum in the
-            # index -> temp+fsync+replace for data then index
-            arrays = {key: store.as_numpy(index[key]["store_name"])
-                      for key in device_state}
+            # index -> temp+fsync+replace for data then index. Device-
+            # sharded arrays pass through ungathered — the flatten step
+            # inside write_native_checkpoint D2H's them one shard at a
+            # time into flat `key@shard<i>of<n>` entries (ISSUE 19:
+            # per-shard embedding-table saves)
+            arrays = {}
+            for key in device_state:
+                arr = device_state[key]
+                arrays[key] = arr \
+                    if snapshot_mod.shard_split(arr) is not None \
+                    else store.as_numpy(index[key]["store_name"])
             snapshot_mod.write_native_checkpoint(prefix, arrays, index,
                                                  host_state)
         if write_meta_graph:
@@ -410,15 +438,25 @@ class Saver:
                 source = io.BytesIO(payload)
             else:
                 source = save_path + ".stfz"
+            from ..checkpoint import snapshot as snapshot_mod
+
             with np.load(source, allow_pickle=False) as data:
                 for key, v in vars_map.items():
                     safe = key.replace("/", "|")
-                    if safe not in data:
+                    meta = index.get(key) or {}
+                    if safe in data:
+                        value = data[safe]
+                    elif meta.get("sharded_layout"):
+                        # flat per-shard save: reassemble the logical
+                        # tensor; store.load re-applies the live
+                        # sharding on the way back to device
+                        value = snapshot_mod.assemble_sharded(data, meta)
+                    else:
                         raise errors.NotFoundError(
                             None, None,
                             f"Key {key} not found in checkpoint {save_path}")
                     name = v.var_name if hasattr(v, "var_name") else key
-                    sess._variable_store.load(name, data[safe], v
+                    sess._variable_store.load(name, value, v
                                               if hasattr(v, "dtype") else None)
         _restore_host_state(sess, idx_doc.get("host_state"))
 

@@ -1,0 +1,429 @@
+"""Fused vocab-sharded embedding path (ISSUE 19): dedup-before-lookup
+exactness fuzz against the naive dense-gather reference, the
+scatter-add backward through ``stf.gradients``, the ragged Example
+parser feeding embedding bags, per-shard checkpoint saves, and the
+``/stf/embedding/*`` telemetry.
+
+The reference semantics is plain ``np.take`` forward and ``np.add.at``
+backward: integer id handling must be EXACT; float gradients compare at
+tight tolerance (the fused path reorders the scatter-add sum)."""
+
+import numpy as np
+import pytest
+
+import simple_tensorflow_tpu as stf
+from simple_tensorflow_tpu import parallel
+from simple_tensorflow_tpu.ops import embedding_ops
+from simple_tensorflow_tpu.platform import monitoring
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    stf.reset_default_graph()
+    yield
+    stf.reset_default_graph()
+
+
+def _zipf_ids(rng, n, vocab, a=1.4):
+    """Head-heavy ids (the dedup pass must see real duplication)."""
+    return np.minimum(rng.zipf(a, n) - 1, vocab - 1).astype(np.int32)
+
+
+def _reference(table, ids, upstream):
+    """np.take forward + np.add.at table gradient for loss
+    sum(upstream * lookup(ids))."""
+    fwd = np.take(table, ids, axis=0)
+    grad = np.zeros_like(table)
+    np.add.at(grad, ids, upstream)
+    return fwd, grad
+
+
+def _build_fused(vocab, dim, n_ids, dedup):
+    table = stf.get_variable(
+        f"fuzz/table_{vocab}_{dim}_{n_ids}_{dedup}", [vocab, dim],
+        initializer=stf.zeros_initializer())
+    ids_ph = stf.placeholder(stf.int32, [n_ids], name="ids")
+    up_ph = stf.placeholder(stf.float32, [n_ids, dim], name="up")
+    out = embedding_ops.embedding_lookup_fused(table, ids_ph,
+                                               dedup=dedup)
+    loss = stf.reduce_sum(stf.multiply(out, up_ph))
+    (gtab,) = stf.gradients(loss, [table])
+    return table, ids_ph, up_ph, out, gtab
+
+
+@pytest.mark.parametrize("dedup", [True, False])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fused_lookup_fuzz_single_device(seed, dedup):
+    rng = np.random.RandomState(seed)
+    vocab, dim, n_ids = 96 + 8 * seed, 8, 57
+    table_v, ids_ph, up_ph, out, gtab = _build_fused(vocab, dim, n_ids,
+                                                     dedup)
+    tbl = rng.standard_normal((vocab, dim)).astype(np.float32)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sess.run(stf.assign(table_v, stf.constant(tbl)))
+        ids = _zipf_ids(rng, n_ids, vocab)
+        up = rng.standard_normal((n_ids, dim)).astype(np.float32)
+        got_out, got_grad = sess.run([out, gtab],
+                                     {ids_ph: ids, up_ph: up})
+    ref_out, ref_grad = _reference(tbl, ids, up)
+    # forward is a pure gather of the stored rows: EXACT
+    np.testing.assert_array_equal(got_out, ref_out)
+    # backward reorders the duplicate-id sum: tight tolerance
+    np.testing.assert_allclose(got_grad, ref_grad, rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_fused_lookup_fuzz_ep8_mesh(seed):
+    """Same exactness bar with the table REALLY vocab-sharded over the
+    8 virtual devices (conftest forces
+    --xla_force_host_platform_device_count=8): the all-to-all route and
+    the owning-shard scatter-add must agree with the dense reference."""
+    rng = np.random.RandomState(seed)
+    vocab, dim, n_ids = 128, 16, 70  # 128 % 8 == 0: fused shard path
+    with parallel.Mesh({"ep": 8}):
+        with parallel.shard_variables_along("ep", min_size=1, dim=0):
+            table_v = stf.get_variable(
+                "fuzz/sharded_table", [vocab, dim],
+                initializer=stf.zeros_initializer())
+        ids_ph = stf.placeholder(stf.int32, [n_ids], name="ids")
+        up_ph = stf.placeholder(stf.float32, [n_ids, dim], name="up")
+        out = embedding_ops.embedding_lookup_fused(table_v, ids_ph)
+        loss = stf.reduce_sum(stf.multiply(out, up_ph))
+        (gtab,) = stf.gradients(loss, [table_v])
+        tbl = rng.standard_normal((vocab, dim)).astype(np.float32)
+        with stf.Session() as sess:
+            sess.run(stf.global_variables_initializer())
+            sess.run(stf.assign(table_v, stf.constant(tbl)))
+            ids = _zipf_ids(rng, n_ids, vocab)
+            up = rng.standard_normal((n_ids, dim)).astype(np.float32)
+            got_out, got_grad = sess.run([out, gtab],
+                                         {ids_ph: ids, up_ph: up})
+    ref_out, ref_grad = _reference(tbl, ids, up)
+    np.testing.assert_array_equal(got_out, ref_out)
+    np.testing.assert_allclose(got_grad, ref_grad, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_training_in_run_steps_window():
+    """The fused path must survive the donation-active run_steps
+    window: repeated SGD on the table through the custom-vjp gradient,
+    matching the same training loop replayed in numpy."""
+    vocab, dim, n_ids, lr = 64, 4, 31, 0.5
+    rng = np.random.RandomState(7)
+    table_v = stf.get_variable("win/table", [vocab, dim],
+                               initializer=stf.zeros_initializer())
+    ids_ph = stf.placeholder(stf.int32, [n_ids], name="ids")
+    out = embedding_ops.embedding_lookup_fused(table_v, ids_ph)
+    loss = stf.reduce_sum(stf.multiply(out, out))
+    train = stf.train.GradientDescentOptimizer(lr).minimize(loss)
+    tbl = rng.standard_normal((vocab, dim)).astype(np.float32)
+    ids = _zipf_ids(rng, n_ids, vocab)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sess.run(stf.assign(table_v, stf.constant(tbl)))
+        sess.run_steps(train, n=6, feed_dict={ids_ph: ids})
+        got = sess.run(table_v.value())
+    want = tbl.copy()
+    for _ in range(6):
+        grad = np.zeros_like(want)
+        np.add.at(grad, ids, 2.0 * np.take(want, ids, axis=0))
+        want -= lr * grad
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_bag_matches_manual_pooling():
+    rng = np.random.RandomState(11)
+    vocab, dim, b, L = 50, 6, 9, 5
+    table_v = stf.get_variable("bag/table", [vocab, dim],
+                               initializer=stf.zeros_initializer())
+    ids_ph = stf.placeholder(stf.int32, [b, L], name="ids")
+    len_ph = stf.placeholder(stf.int32, [b], name="lens")
+    bag_sum = embedding_ops.embedding_bag(table_v, ids_ph, len_ph,
+                                          combiner="sum")
+    bag_mean = embedding_ops.embedding_bag(table_v, ids_ph, len_ph,
+                                           combiner="mean")
+    tbl = rng.standard_normal((vocab, dim)).astype(np.float32)
+    lens = rng.randint(0, L + 1, b).astype(np.int32)
+    ids = np.full((b, L), -1, np.int32)
+    for i, ln in enumerate(lens):
+        ids[i, :ln] = rng.randint(0, vocab, ln)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sess.run(stf.assign(table_v, stf.constant(tbl)))
+        s, m = sess.run([bag_sum, bag_mean],
+                        {ids_ph: ids, len_ph: lens})
+    want_sum = np.zeros((b, dim), np.float32)
+    for i, ln in enumerate(lens):
+        if ln:
+            want_sum[i] = np.take(tbl, ids[i, :ln], axis=0).sum(0)
+    want_mean = want_sum / np.maximum(lens, 1)[:, None]
+    np.testing.assert_allclose(s, want_sum, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(m, want_mean, rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_metrics_populate():
+    before = monitoring.export().get("/stf/embedding/lookups",
+                                     {"cells": {}})["cells"]
+    before_total = sum(before.values()) if before else 0
+    table_v = stf.get_variable("met/table", [32, 4],
+                               initializer=stf.zeros_initializer())
+    ids = stf.constant(np.array([1, 1, 1, 2, 3, 3], np.int32))
+    out = embedding_ops.embedding_lookup_fused(table_v, ids)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sess.run(out)
+    exported = monitoring.export()
+    for name in ("/stf/embedding/lookups", "/stf/embedding/unique_ids",
+                 "/stf/embedding/dedup_ratio",
+                 "/stf/embedding/bytes_moved"):
+        assert name in exported, name
+    cells = exported["/stf/embedding/lookups"]["cells"]
+    assert sum(cells.values()) >= before_total + 6
+    uniq = exported["/stf/embedding/unique_ids"]["cells"]
+    assert any(v >= 3 for v in uniq.values())
+
+
+# ---------------------------------------------------------------------------
+# ragged Example parsing (the sparse-feature input path)
+# ---------------------------------------------------------------------------
+
+def _ragged_examples():
+    from simple_tensorflow_tpu.lib import example as example_mod
+
+    exs = [
+        example_mod.make_example(ids=[3, 1, 4, 1, 5], w=[0.5, 0.25]),
+        example_mod.make_example(ids=[2], dense=[9]),
+        example_mod.make_example(ids=list(range(12)), w=[1.0]),
+        example_mod.make_example(dense=[7]),
+    ]
+    return [e.SerializeToString() for e in exs]
+
+
+def _ragged_specs():
+    from simple_tensorflow_tpu.ops import parsing_ops
+
+    return {"ids": parsing_ops.RaggedFeature("int64", max_len=8),
+            "w": parsing_ops.RaggedFeature("float32", max_len=4)}
+
+
+def test_ragged_parse_padding_lengths_truncation():
+    from simple_tensorflow_tpu.ops import parsing_ops
+
+    out = parsing_ops.parse_example_py(_ragged_examples(),
+                                       _ragged_specs())
+    assert out["ids"].shape == (4, 8) and out["w"].shape == (4, 4)
+    assert list(out["ids_lengths"]) == [5, 1, 8, 0]  # 12 clamps to 8
+    assert list(out["w_lengths"]) == [2, 0, 1, 0]
+    assert list(out["ids"][0]) == [3, 1, 4, 1, 5, -1, -1, -1]
+    assert list(out["ids"][3]) == [-1] * 8
+    np.testing.assert_allclose(out["w"][0], [0.5, 0.25, 0, 0])
+    cells = monitoring.export()[
+        "/stf/data/ragged_truncated_values"]["cells"]
+    assert cells.get("ids", 0) >= 4  # 12 - 8 dropped values counted
+
+
+def test_ragged_parse_native_and_python_paths_agree():
+    from simple_tensorflow_tpu.ops import parsing_ops
+    from simple_tensorflow_tpu.runtime import native
+
+    ser = _ragged_examples()
+    fast = parsing_ops.parse_example_py(ser, _ragged_specs())
+    saved = native.ragged_parse_available
+    native.ragged_parse_available = lambda: False
+    try:
+        slow = parsing_ops.parse_example_py(ser, _ragged_specs())
+    finally:
+        native.ragged_parse_available = saved
+    assert set(fast) == set(slow)
+    for k in fast:
+        np.testing.assert_array_equal(fast[k], slow[k])
+
+
+def test_ragged_parse_graph_op_and_threaded_dataset_stage():
+    from simple_tensorflow_tpu import data as stf_data
+    from simple_tensorflow_tpu.ops import parsing_ops
+
+    ser = _ragged_examples()
+    ph = stf.placeholder(stf.string, [4])
+    parsed = parsing_ops.parse_example(ph, _ragged_specs())
+    with stf.Session() as sess:
+        ids, lens = sess.run(
+            [parsed["ids"], parsed["ids_lengths"]],
+            feed_dict={ph: np.asarray(ser, dtype=object)})
+    assert ids.shape == (4, 8) and list(lens) == [5, 1, 8, 0]
+
+    ds = stf_data.Dataset.from_tensor_slices(np.asarray(ser, object)) \
+        .batch(2).parse_example(_ragged_specs(), num_parallel_calls=2)
+    got = list(ds)
+    assert got[0]["ids"].shape == (2, 8)
+    assert list(got[1]["ids_lengths"]) == [8, 0]
+
+
+def test_ragged_batch_feeds_embedding_bag():
+    """End-to-end sparse input path: serialized Examples -> ragged
+    parse -> embedding_bag pooled lookup (pad ids masked out)."""
+    from simple_tensorflow_tpu.ops import parsing_ops
+
+    out = parsing_ops.parse_example_py(_ragged_examples(),
+                                       _ragged_specs())
+    vocab, dim = 16, 3
+    table_v = stf.get_variable("e2e/table", [vocab, dim],
+                               initializer=stf.zeros_initializer())
+    ids_ph = stf.placeholder(stf.int32, [4, 8], name="ids")
+    len_ph = stf.placeholder(stf.int32, [4], name="lens")
+    bag = embedding_ops.embedding_bag(table_v, ids_ph, len_ph,
+                                      combiner="sum")
+    tbl = np.arange(vocab * dim, dtype=np.float32).reshape(vocab, dim)
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        sess.run(stf.assign(table_v, stf.constant(tbl)))
+        got = sess.run(bag, {ids_ph: out["ids"].astype(np.int32),
+                             len_ph: out["ids_lengths"].astype(np.int32)})
+    want = np.zeros((4, dim), np.float32)
+    for i, ln in enumerate(out["ids_lengths"]):
+        if ln:
+            want[i] = np.take(tbl, out["ids"][i, :ln], axis=0).sum(0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# flat per-shard table checkpointing
+# ---------------------------------------------------------------------------
+
+def test_sharded_table_checkpoint_roundtrip(tmp_path):
+    import json
+
+    from simple_tensorflow_tpu import train
+    from simple_tensorflow_tpu.checkpoint import snapshot as snap
+
+    with parallel.Mesh({"ep": 8}):
+        with parallel.shard_variables_along("ep", min_size=1, dim=0):
+            v = stf.get_variable(
+                "ckpt/table", [64, 8],
+                initializer=stf.random_uniform_initializer(-1, 1,
+                                                           seed=0))
+        small = stf.get_variable("ckpt/small", [3],
+                                 initializer=stf.zeros_initializer())
+        sess = stf.Session()
+        sess.run(stf.global_variables_initializer())
+        name = v.var_name if hasattr(v, "var_name") else v.name
+        arr = sess._variable_store.values[name]
+        parts = snap.shard_split(arr)
+        assert parts is not None and len(parts) == 8
+        want = np.asarray(arr)
+        saver = train.Saver()
+        prefix = saver.save(sess, str(tmp_path / "model"),
+                            global_step=1)
+        with np.load(prefix + ".stfz") as data:
+            keys = sorted(data.files)
+        assert sum("@shard" in k for k in keys) == 8, keys
+        assert not any(k == "ckpt|table" for k in keys)
+        with open(prefix + ".index.json") as f:
+            idx = json.load(f)
+        lay = idx["tensors"]["ckpt/table"]["sharded_layout"]
+        assert lay["num_shards"] == 8
+        # integrity check understands shard entries
+        assert snap.verify_checkpoint(prefix) == []
+        # the tools reader reassembles logical tensors
+        vals = train.saver.load_checkpoint_values(prefix)
+        np.testing.assert_array_equal(vals["ckpt/table"], want)
+        assert not any("@shard" in k for k in vals)
+        # restore into a fresh session reproduces the table exactly
+        sess2 = stf.Session()
+        saver.restore(sess2, prefix)
+        got = np.asarray(sess2._variable_store.values[name])
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(
+            np.asarray(sess2._variable_store.values[
+                small.var_name if hasattr(small, "var_name")
+                else small.name]),
+            np.zeros([3], np.float32))
+        sess.close()
+        sess2.close()
+
+
+def test_replicated_checkpoint_format_unchanged(tmp_path):
+    """No mesh: the bundle keeps plain whole-tensor entries (no shard
+    suffixes, no sharded_layout in the index)."""
+    import json
+
+    from simple_tensorflow_tpu import train
+
+    v = stf.get_variable("plain/w", [4, 4],
+                         initializer=stf.zeros_initializer())
+    with stf.Session() as sess:
+        sess.run(stf.global_variables_initializer())
+        saver = train.Saver()
+        prefix = saver.save(sess, str(tmp_path / "m"), global_step=0)
+    with np.load(prefix + ".stfz") as data:
+        assert all("@shard" not in k for k in data.files)
+    with open(prefix + ".index.json") as f:
+        idx = json.load(f)
+    assert all("sharded_layout" not in m
+               for m in idx["tensors"].values())
+
+
+# ---------------------------------------------------------------------------
+# lint/embedding-replicated-table + graph_lint --embeddings
+# ---------------------------------------------------------------------------
+
+def _big_table_graph():
+    from simple_tensorflow_tpu.ops import embedding_ops as emb
+
+    table = stf.get_variable("emb/table", [1 << 12, 64],
+                             initializer=stf.zeros_initializer())  # 1 MiB
+    ids = stf.placeholder(stf.int32, [32], name="ids")
+    loss = stf.reduce_sum(emb.embedding_lookup_fused(table, ids))
+    return loss
+
+
+def test_embedding_replicated_table_lint_fires_and_gates():
+    from simple_tensorflow_tpu import analysis
+
+    loss = _big_table_graph()
+    diags = analysis.analyze(stf.get_default_graph(), fetches=[loss],
+                             mesh={"ep": 8}, purpose="embeddings",
+                             memory_budget=1 << 20)
+    hits = [d for d in diags
+            if d.code == "lint/embedding-replicated-table"]
+    assert hits and all(d.severity == "error" for d in hits)
+    # purpose-gated: an ordinary analyze run stays clean
+    diags2 = analysis.analyze(stf.get_default_graph(), fetches=[loss],
+                              mesh={"ep": 8})
+    assert not any(d.code == "lint/embedding-replicated-table"
+                   for d in diags2)
+
+
+def test_graph_lint_embeddings_cli_verdicts(tmp_path):
+    import json
+
+    from simple_tensorflow_tpu.framework import graph_io
+    from simple_tensorflow_tpu.tools import graph_lint
+
+    loss = _big_table_graph()
+    gd = graph_io.graph_to_graphdef(stf.get_default_graph())
+    p = str(tmp_path / "emb.json")
+    with open(p, "w") as f:
+        json.dump(gd, f)
+    loss_name = loss.name
+
+    # replicated table over budget on an 8-way mesh: rc 1
+    stf.reset_default_graph()
+    rc = graph_lint.main([p, "--fetch", loss_name, "--embeddings",
+                          "--mesh", "ep=8", "--budget", str(1 << 20)])
+    assert rc == 1
+    # generous budget: same layout passes
+    stf.reset_default_graph()
+    rc = graph_lint.main([p, "--fetch", loss_name, "--embeddings",
+                          "--mesh", "ep=8", "--budget", str(1 << 30)])
+    assert rc == 0
+    # vocab-sharded via partition rules: clean under the tight budget
+    stf.reset_default_graph()
+    rp = str(tmp_path / "rules.json")
+    with open(rp, "w") as f:
+        json.dump([["emb/table", ["ep", None]]], f)
+    rc = graph_lint.main([p, "--fetch", loss_name, "--embeddings",
+                          "--mesh", "ep=8", "--budget", str(1 << 20),
+                          "--rules", rp])
+    assert rc == 0

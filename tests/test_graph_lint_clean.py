@@ -37,6 +37,7 @@ ALLOWED_WARNINGS = {
     "seq2seq_tiny": {"lint/unseeded-rng"},           # dropout
     "ptb_lstm_tiny": {"lint/unseeded-rng"},          # dropout
     "example_mnist_end_to_end": {"lint/unseeded-rng"},
+    "dlrm_tiny": set(),                              # seeded initializers
 }
 # note-severity codes tolerated everywhere (informational)
 ALLOWED_NOTES = {"lint/narrow-64bit", "verifier/unreachable-stateful",
@@ -341,11 +342,11 @@ def test_decode_plan_graph_lint_serving(tmp_path):
 # "memory" purpose), and the CLI exit code gates CI.
 # ---------------------------------------------------------------------------
 
-def _autoshard_snapshot(fetches, mesh):
+def _autoshard_snapshot(fetches, mesh, **kw):
     from simple_tensorflow_tpu import analysis
 
     res = analysis.search_sharding(mesh=mesh, fetches=fetches,
-                                   anneal_steps=16)
+                                   anneal_steps=16, **kw)
     sharded = {}
     replicated = set()
     for g in res.groups:
@@ -413,11 +414,28 @@ AUTOSHARD_SNAPSHOTS = {
         "feeds": {"src_ids": ("dp", None), "tgt_in": ("dp", None),
                   "tgt_out": ("dp", None)},
     },
+    ("dlrm_tiny", "ep8"): {
+        # ISSUE 19 acceptance: the per-shard HBM budget makes
+        # replicated tables infeasible and the fused-lookup rule makes
+        # the VOCAB layout the cheap one — the search lands on
+        # ('ep', None) with no hand-placed specs. The small MLP params
+        # ride the ep axis too (free under the same budget pressure).
+        "sharded": {
+            "dlrm/bottom/b\\d+": ("ep",),
+            "dlrm/bottom/w\\d+": (None, "ep"),
+            "dlrm/embedding/table_\\d+": ("ep", None),
+            "dlrm/top/b\\d+": ("ep",),
+        },
+        "feeds": {"dense_features": (None, None),
+                  "labels": (None, None),
+                  "cat0_ids": (None, None), "cat1_ids": (None, None),
+                  "cat0_lengths": (None,), "cat1_lengths": (None,)},
+    },
 }
 
 
-def _check_autoshard_snapshot(key, fetches, mesh):
-    got, res = _autoshard_snapshot(fetches, mesh)
+def _check_autoshard_snapshot(key, fetches, mesh, **kw):
+    got, res = _autoshard_snapshot(fetches, mesh, **kw)
     want = AUTOSHARD_SNAPSHOTS[key]
     assert got["sharded"] == want["sharded"], (
         f"{key}: chosen SHARDED specs moved — review like a lint "
@@ -511,3 +529,50 @@ def test_zoo_memory_budget_gate(tmp_path):
     rc = graph_lint.main([str(p), "--fetch", loss_name, "--memory",
                           "--budget", "1"])
     assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# DLRM ranking gates (ISSUE 19): lint/verifier clean, autoshard picks
+# the vocab sharding off the memory budget alone, memory rows costable.
+# ---------------------------------------------------------------------------
+
+def _dlrm_tiny():
+    from simple_tensorflow_tpu.models import dlrm
+
+    return dlrm.dlrm_model(batch_size=8, num_dense=8,
+                           table_sizes=(4096, 2048), embedding_dim=64,
+                           bottom_mlp=(32, 64), top_mlp=(32, 1),
+                           max_ids_per_feature=8)
+
+
+def test_dlrm_tiny_clean():
+    m = _dlrm_tiny()
+    _analyze("dlrm_tiny", [m["train_op"], m["loss"]])
+
+
+def test_zoo_autoshard_dlrm_ep8_snapshot():
+    # table_0 is 4096*64*4 B = 1 MiB; the 512 KiB/shard budget means
+    # replicating it is over budget on every device, so the search
+    # must shard it — and the fused-lookup collective pricing makes
+    # ('ep', None) the layout that wins. No rules= seed specs.
+    m = _dlrm_tiny()
+    res = _check_autoshard_snapshot(
+        ("dlrm_tiny", "ep8"), [m["train_op"], m["loss"]], {"ep": 8},
+        budget_bytes=1 << 19)
+    # the chosen layout must beat the all-replicated baseline
+    assert res.predicted["step_seconds"] \
+        <= res.baseline["step_seconds"] + 1e-12
+
+
+def test_dlrm_memory_rows_costable():
+    from simple_tensorflow_tpu.tools import graph_lint
+
+    m = _dlrm_tiny()
+    rows = graph_lint.memory_summary(
+        stf.get_default_graph(), fetches=[m["train_op"], m["loss"]],
+        budget=1 << 34)
+    assert rows
+    for r in rows:
+        assert "error" not in r, r
+        assert r["predicted_peak_bytes"] > 0
+        assert r["within_budget"], r

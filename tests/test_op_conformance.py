@@ -1089,6 +1089,20 @@ COVERED_ELSEWHERE.update({
     "HistogramBucketCounts": ("test_numerics_health.py", "histogram"),
 })
 
+COVERED_ELSEWHERE.update({
+    # fused sharded-embedding path (ISSUE 19): forward exactness vs the
+    # dense-gather reference and the scatter-add backward through
+    # stf.gradients (single-device AND real ep=8 mesh) live in
+    # tests/test_embedding_fused.py; LookupTableSizeDevice is the
+    # frozen-table size() fast path driven by every table.size() call
+    # in tests/test_lookup_ops.py
+    "EmbeddingLookupFused": ("test_embedding_fused.py",
+                             "embedding_lookup_fused"),
+    "EmbeddingScatterAddGrad": ("test_embedding_fused.py",
+                                "stf.gradients"),
+    "LookupTableSizeDevice": ("test_lookup_ops.py", "table.size()"),
+})
+
 
 # ---------------------------------------------------------------------------
 # MISC: direct mini-tests for everything the table and pointers don't
