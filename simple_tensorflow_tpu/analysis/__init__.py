@@ -36,7 +36,8 @@ from ..framework.op_registry import (Effects, declare_effects,
                                      register_sharding_rule)
 from . import (autoshard, diagnostics, effects, hazards, lint, loop_safety,
                sharding, verifier)
-from .autoshard import AutoshardResult, search_sharding
+from .autoshard import (AutoshardResult, DecodeTpChoice,
+                        choose_decode_tp, search_sharding)
 from .diagnostics import (ERROR, NOTE, WARNING, Diagnostic, errors,
                           format_report, max_severity, warnings)
 from .effects import ResolvedEffects, op_effects

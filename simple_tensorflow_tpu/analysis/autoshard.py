@@ -807,3 +807,105 @@ def search_sharding(graph=None, ops: Optional[Sequence[Operation]] = None,
     metric_autoshard_bytes.get_cell("replicated").set(
         int(baseline["collective_bytes"]))
     return result
+
+
+# ---------------------------------------------------------------------------
+# Serving/decode purpose: pick the decode tensor-parallel degree
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DecodeTpChoice:
+    """Winner of :func:`choose_decode_tp`: the degree to pass to the
+    generative models' ``tp=`` kwarg plus the priced candidate table
+    (degree -> per-device cache bytes / per-token collective bytes /
+    roofline seconds / feasibility) for statusz and tests."""
+
+    degree: int
+    seconds: float
+    per_device_cache_bytes: int
+    collective_bytes: int
+    feasible: bool
+    candidates: List[Dict[str, Any]] = field(default_factory=list)
+
+
+def choose_decode_tp(*, num_heads: int, cache_bytes: int,
+                     unsharded_bytes: int = 0,
+                     collective_bytes_fn=None,
+                     budget_bytes: Optional[int] = None,
+                     mesh=None, max_degree: Optional[int] = None
+                     ) -> DecodeTpChoice:
+    """Serving/decode autoshard purpose: choose the decode
+    tensor-parallel degree from the roofline objective + per-device
+    cache-byte budget instead of a hand flag.
+
+    The decode step is HBM-bound — every token re-reads the whole KV
+    cache — so the objective per candidate degree ``t`` is the roofline
+    pair the main search uses, specialized to the decode inner loop:
+    per-device cache traffic ``(unsharded + sharded/t) / peak_bw`` plus
+    per-token collective bytes over the interconnect
+    (``collective_bytes_fn(t) / ici_bw``, the same
+    ``STF_AUTOSHARD_ICI_BW``-overridable weight as :class:`_Pricer`),
+    plus the same fixed infeasibility penalty when ``budget_bytes`` (the
+    HBM-ledger admission budget) can't hold the per-device cache.
+
+    Candidates are the divisors of ``num_heads`` (head-dim sharding is
+    whole heads per device) capped by the device count — the mesh's
+    ``tp`` axis when one is passed (that degree is then the only
+    candidate: the device topology is already committed), else
+    ``len(jax.devices())`` and ``max_degree``. Ties break toward the
+    smallest degree (fewest devices for the same predicted time).
+    """
+    from ..utils import perf
+
+    num_heads = int(num_heads)
+    cache_bytes = int(cache_bytes)
+    unsharded_bytes = int(unsharded_bytes)
+    sharded = max(cache_bytes - unsharded_bytes, 0)
+    if collective_bytes_fn is None:
+        collective_bytes_fn = lambda t: 0
+
+    if mesh is not None and getattr(mesh, "shape", {}).get("tp", 1) > 1:
+        degrees = [int(mesh.shape["tp"])]
+        if num_heads % degrees[0]:
+            raise ValueError(
+                f"mesh tp axis {degrees[0]} does not divide "
+                f"num_heads={num_heads}")
+    else:
+        try:
+            import jax
+
+            cap = len(jax.devices())
+        except Exception:
+            cap = 1
+        if max_degree is not None:
+            cap = min(cap, int(max_degree))
+        degrees = [t for t in range(1, max(cap, 1) + 1)
+                   if num_heads % t == 0]
+
+    peak_flops, peak_bw = perf.chip_spec()
+    ici_bw = float(os.environ.get("STF_AUTOSHARD_ICI_BW",
+                                  float(peak_bw) / _ICI_FRACTION_OF_HBM))
+    rows = []
+    for t in degrees:
+        per_device = unsharded_bytes + sharded // t
+        coll = int(collective_bytes_fn(t))
+        seconds = per_device / float(peak_bw) + coll / ici_bw
+        feasible = budget_bytes is None or per_device <= int(budget_bytes)
+        if not feasible:
+            seconds += 1e6          # same penalty as _Pricer.price
+        rows.append({"degree": t, "per_device_cache_bytes": int(per_device),
+                     "collective_bytes": coll, "seconds": seconds,
+                     "feasible": feasible})
+        metric_autoshard_candidates.get_cell("decode_tp").increase_by(1)
+    best = min(rows, key=lambda r: (r["seconds"], r["degree"]))
+    if not best["feasible"] and budget_bytes is not None:
+        raise ValueError(
+            f"no decode-tp degree fits device_memory_budget_bytes="
+            f"{int(budget_bytes)}: smallest per-device cache is "
+            f"{min(r['per_device_cache_bytes'] for r in rows)} bytes "
+            f"(degrees tried: {degrees})")
+    return DecodeTpChoice(
+        degree=int(best["degree"]), seconds=float(best["seconds"]),
+        per_device_cache_bytes=int(best["per_device_cache_bytes"]),
+        collective_bytes=int(best["collective_bytes"]),
+        feasible=bool(best["feasible"]), candidates=rows)

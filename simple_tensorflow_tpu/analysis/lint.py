@@ -436,11 +436,30 @@ def _rule_serving_decode_cache(ctx):
       write must be stamped ``refcount_guarded=True`` (``GUARD_ATTR``)
       to assert the engine masks the rejected suffix by committed
       length — an unguarded verify write could expose uncommitted
-      draft rows to a sequence sharing the page.
+      draft rows to a sequence sharing the page;
+    - decode tensor parallelism (``"<axis>:heads"`` declarations): a
+      head-sharded cache whose gathered pages are immediately
+      re-sharded to a head-replicated layout pays a per-token
+      all-gather of the whole cache read — the traffic the TP layout
+      exists to avoid (DecodeAttention runs per-shard over heads); and
+      a ``KVCachePageCopy`` that declares a DIFFERENT sharding than
+      the cache's committed one would re-commit the store entry at the
+      new layout on the first CoW, resharding every subsequent decode
+      step.
     """
     if ctx.purpose != "serving":
         return
     from ..ops import kv_cache_ops as _kvc
+
+    # committed declarations per cache var (from the non-PageCopy ops:
+    # alloc/append/gather all stamp the kv_cache handle's declaration)
+    committed_decls = {}
+    for op in ctx.ops:
+        if _kvc.is_cache_op(op) and op.type != "KVCachePageCopy":
+            vn = op.attrs.get("var_name")
+            decl = op.attrs.get(_kvc.SHARDING_ATTR)
+            if vn is not None and decl:
+                committed_decls.setdefault(vn, set()).add(str(decl))
 
     fetched = set()
     for f in ctx.fetches:
@@ -493,6 +512,46 @@ def _rule_serving_decode_cache(ctx):
                    "refcount_guarded=True (append(..., "
                    "verify_plan=True, refcount_guarded=True)) to "
                    "assert only the accepted prefix is committed")
+        decl = str(op.attrs.get(_kvc.SHARDING_ATTR) or "")
+        head_sharded = decl.endswith(_kvc.HEAD_SHARD_SUFFIX)
+        if op.type == "KVCachePageCopy":
+            others = committed_decls.get(op.attrs.get("var_name"), set())
+            head_committed = any(
+                d.endswith(_kvc.HEAD_SHARD_SUFFIX) for d in others)
+            if head_committed and decl not in others:
+                yield (op,
+                       f"page copy {op.name!r} on "
+                       f"{op.attrs.get('var_name')!r} declares sharding "
+                       f"{decl or None!r} but the cache committed "
+                       f"{sorted(others)}: the CoW would re-commit the "
+                       "store entry at the new layout and reshard every "
+                       "subsequent decode step; stamp the copy with the "
+                       "cache's own declaration (build it from the same "
+                       "kv_cache handle)")
+        if op.type == "KVCacheGather" and head_sharded:
+            axis = decl[: -len(_kvc.HEAD_SHARD_SUFFIX)]
+            for out in op.outputs:
+                for consumer in out.consumers():
+                    if consumer.type != "ShardingConstraint":
+                        continue
+                    spec = tuple(consumer.attrs.get("spec") or ())
+                    entry = (spec[_kvc.HEAD_DIM]
+                             if len(spec) > _kvc.HEAD_DIM else None)
+                    axes = (tuple(entry) if isinstance(entry,
+                                                       (tuple, list))
+                            else (entry,) if entry else ())
+                    if axis not in axes:
+                        yield (op,
+                               f"head-sharded cache gather {op.name!r} "
+                               f"({op.attrs.get('var_name')!r}, "
+                               f"sharding {decl!r}) is re-sharded to a "
+                               f"head-replicated layout by "
+                               f"{consumer.name!r}: the decode plan "
+                               "all-gathers the full head dim of every "
+                               "gathered page per token; feed the "
+                               "gathered pages to DecodeAttention "
+                               "per-shard instead (heads are "
+                               "embarrassingly parallel)")
         paged = bool(op.attrs.get(_kvc.PAGED_ATTR))
         for out in op.outputs:
             if out in fetched:

@@ -460,6 +460,34 @@ def _release_ledger_tokens(tokens: Dict[str, int]):
         pass
 
 
+def _device_nbytes(arr) -> int:
+    """PER-DEVICE bytes of a (possibly sharded) array — what one chip's
+    HBM actually holds. The ledger (and therefore
+    ``device_memory_budget_bytes`` admission) accounts this, so a
+    head-sharded tp=8 KV cache costs 1/8 of its replicated footprint:
+    a model whose replicated cache busts the budget can still load at
+    tp=8. Replicated/unsharded arrays fall back to the logical size."""
+    nbytes = int(getattr(arr, "nbytes", 0))
+    sh = getattr(arr, "sharding", None)
+    if sh is None or not nbytes:
+        return nbytes
+    try:
+        if getattr(arr, "is_fully_replicated", True):
+            return nbytes
+        shard_shape = sh.shard_shape(arr.shape)
+        n = 1
+        for d in shard_shape:
+            n *= int(d)
+        full = 1
+        for d in arr.shape:
+            full *= int(d)
+        if full:
+            return max(int(nbytes * n // full), 1)
+    except Exception:  # noqa: BLE001 — accounting only
+        pass
+    return nbytes
+
+
 class VariableStore:
     """Device-resident variable state: name -> jax.Array.
 
@@ -514,7 +542,7 @@ class VariableStore:
             # not per-entry refs — V entries each walking the V-array
             # store would make reconcile O(V^2)
             self._ledger_tokens[name] = ledger.register(
-                name, int(getattr(arr, "nbytes", 0)),
+                name, _device_nbytes(arr),
                 cls or _memory_mod.CLASS_STATE, self.owner)
         self._ledger_keys = keys
 
@@ -557,6 +585,22 @@ class VariableStore:
                 dtypes_mod.warn_64bit_narrowing_once(f"variable {name!r}")
         arr = jnp.asarray(np.asarray(value), dtype=dtype)
         sh = self.shardings.get(name)
+        if sh is None and variable is not None \
+                and getattr(variable, "sharding", None) is not None:
+            # checkpoint restore of sharded state: the store has not
+            # committed this name yet (restore runs before any plan),
+            # so honor the variable's DECLARED spec under the active
+            # mesh — and register it, so later loads re-place the same
+            # way (the sharded-cache/TP-weights restore contract)
+            from ..parallel.mesh import current_mesh
+
+            mesh = current_mesh()
+            if mesh is not None:
+                try:
+                    sh = mesh.named_sharding(*variable.sharding)
+                    self.shardings[name] = sh
+                except Exception:  # noqa: BLE001 — placement hint only
+                    sh = None
         if sh is not None:
             arr = jax.device_put(arr, sh)
         self.values[name] = arr
@@ -565,7 +609,7 @@ class VariableStore:
             from ..telemetry import memory as _memory_mod
 
             _memory_mod.get_ledger().update(
-                token, int(getattr(arr, "nbytes", 0)))
+                token, _device_nbytes(arr))
         else:
             self.sync_ledger()
 
@@ -2634,17 +2678,65 @@ class BaseSession:
         return self._base_key, np.uint32(self._run_counter + 1)
 
     # -- planning ------------------------------------------------------------
+    def _plan_shard_factor_fn(self):
+        """Per-tensor mesh shard factor for plan cost estimates
+        (``fn(tensor) -> int``, framework/cost_model.estimate): committed
+        store shardings and KV-cache ``_cache_sharding`` declarations
+        divide RESIDENT/LIVE bytes so budget admission charges
+        PER-DEVICE HBM — the same unit the ledger holds
+        (``_device_nbytes``). A head-sharded tp=8 decode cache therefore
+        requests 1/8 of its replicated footprint at plan time; a budget
+        that refuses the replicated layout can still admit the sharded
+        one. Returns None when nothing is sharded (common single-device
+        case: cost_model skips the per-tensor hook entirely)."""
+        from ..ops import kv_cache_ops as _kvc
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        shardings = self._variable_store.shardings
+        if mesh is None and not shardings:
+            return None
+
+        def _factor(t):
+            op = t.op
+            decl = op.attrs.get(_kvc.SHARDING_ATTR)
+            if decl and mesh is not None:
+                try:
+                    _, axis = _kvc.parse_cache_sharding(decl)
+                except ValueError:
+                    axis = None
+                if axis is not None and axis in mesh.shape:
+                    return mesh.axis_size(axis)
+            ns = shardings.get(op.attrs.get("var_name", op.name))
+            if ns is not None:
+                try:
+                    shape = tuple(int(d) for d in t.shape)
+                    full = part = 1
+                    for d in shape:
+                        full *= d
+                    for d in ns.shard_shape(shape):
+                        part *= int(d)
+                    if part:
+                        return max(1, full // part)
+                except Exception:  # noqa: BLE001 — accounting only
+                    return 1
+            return 1
+
+        return _factor
+
     def _estimate_plan_memory(self, elements, feeds) -> Dict[str, Any]:
         """Static cost-model peak/resident prediction for a plan
         (framework/cost_model liveness sweep) in the shape
         ``ExecutionPlan.memory_info`` and the budget admission share.
-        Best-effort: an un-costable plan predicts zeros rather than
-        failing the plan."""
+        Peak/resident are PER-DEVICE when shardings are committed
+        (``_plan_shard_factor_fn``). Best-effort: an un-costable plan
+        predicts zeros rather than failing the plan."""
         from ..framework import cost_model
 
         try:
-            est = cost_model.estimate(list(elements),
-                                      feeds=list(feeds))
+            est = cost_model.estimate(
+                list(elements), feeds=list(feeds),
+                shard_factor_fn=self._plan_shard_factor_fn())
             peak = int(est.peak_bytes)
             resident = int(est.resident_bytes)
         except Exception:  # noqa: BLE001 — accounting only
@@ -2676,7 +2768,9 @@ class BaseSession:
                 seen.add(vn)
                 arr = store.get(vn)
                 if arr is not None:
-                    already += int(getattr(arr, "nbytes", 0))
+                    # per-device, matching the ledger and the sharded
+                    # cost estimate (_plan_shard_factor_fn)
+                    already += _device_nbytes(arr)
         requested = max(
             0, step.memory_estimate["predicted_peak_bytes"] - already)
         from ..telemetry import memory as _memory_mod

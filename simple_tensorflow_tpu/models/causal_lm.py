@@ -29,13 +29,17 @@ Two halves:
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 import simple_tensorflow_tpu as stf
 from simple_tensorflow_tpu.models import common
 from simple_tensorflow_tpu.models.transformer import (
     TransformerConfig, _attention, _block_decode, _dense, _embed, _ffn,
-    _incremental_decode, _ln, _residual, build_int8_logits_weights,
+    _incremental_decode, _ln, _residual, _tp_gather,
+    build_int8_logits_weights, decode_tp_collective_bytes,
+    decode_tp_partition_rules, generative_cache_bytes, resolve_decode_tp,
     smoothed_xent)
 
 # the causal LM reuses TransformerConfig (decoder-side fields only:
@@ -145,7 +149,7 @@ def build_causal_lm_program(cfg: TransformerConfig, *, page_len,
                             prefill_bucket_sizes=None,
                             compute_dtype=stf.float32, int8=False,
                             sampling=None, scope="causal_lm",
-                            cache_sharding=None):
+                            cache_sharding=None, tp_axis=None):
     """Build the paged-cache causal-LM serving programs.
 
     Emits, in the CURRENT default graph:
@@ -176,6 +180,19 @@ def build_causal_lm_program(cfg: TransformerConfig, *, page_len,
     """
     from ..serving.policy import _pow2_buckets
     from ..ops import kv_cache_ops as kvc
+
+    if tp_axis and cache_sharding is None:
+        cache_sharding = f"{tp_axis}{kvc.HEAD_SHARD_SUFFIX}"
+
+    def _feed(t):
+        """Annotate a placeholder replicated-on-mesh under TP (same
+        contract as the seq2seq builder: fed numpy must commit onto the
+        mesh's device set next to the head-sharded paged caches)."""
+        if tp_axis:
+            from simple_tensorflow_tpu import parallel
+
+            parallel.shard_feed(t)
+        return t
 
     page_len = int(page_len)
     pages_per_seq = int(pages_per_seq)
@@ -226,7 +243,7 @@ def build_causal_lm_program(cfg: TransformerConfig, *, page_len,
             logits = stf.matmul(h_flat,
                                 stf.cast(emb, h_flat.dtype.base_dtype),
                                 transpose_b=True)
-        return stf.cast(logits, stf.float32)
+        return _tp_gather(stf.cast(logits, stf.float32), tp_axis)
 
     def _emit(logits):
         if sampling is not None:
@@ -244,16 +261,18 @@ def build_causal_lm_program(cfg: TransformerConfig, *, page_len,
     # -- prefill: one page-aligned chunk ------------------------------------
     prefill = {}
     for pb in prefill_buckets:
-        tok = stf.placeholder(stf.int32, [pb, page_len],
-                              f"lm_prefill{pb}_tok")
-        base = stf.placeholder(stf.int32, [pb], f"lm_prefill{pb}_base")
-        tables = stf.placeholder(stf.int32, [pb, pages_per_seq],
-                                 f"lm_prefill{pb}_tables")
-        dst = stf.placeholder(stf.int32, [pb], f"lm_prefill{pb}_dst")
+        tok = _feed(stf.placeholder(stf.int32, [pb, page_len],
+                                    f"lm_prefill{pb}_tok"))
+        base = _feed(stf.placeholder(stf.int32, [pb],
+                                     f"lm_prefill{pb}_base"))
+        tables = _feed(stf.placeholder(stf.int32, [pb, pages_per_seq],
+                                       f"lm_prefill{pb}_tables"))
+        dst = _feed(stf.placeholder(stf.int32, [pb],
+                                    f"lm_prefill{pb}_dst"))
         cache = _PagedCaches(caches, tables, dst, stf.fill([pb], 0),
                              base)
         h, _ = _block_decode(tok, base, cache, None, None, None, cfg,
-                             compute_dtype, scope)
+                             compute_dtype, scope, tp_axis=tp_axis)
         # fetch the hidden state to anchor the whole block (appends are
         # its data deps); pad rows of a partial final chunk write
         # garbage K/V past the real length — dead rows: attention masks
@@ -265,23 +284,26 @@ def build_causal_lm_program(cfg: TransformerConfig, *, page_len,
     # -- decode: one position -----------------------------------------------
     decode_progs = {}
     for sb in decode_buckets:
-        tok = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_tok")
-        pos = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_pos")
-        tables = stf.placeholder(stf.int32, [sb, pages_per_seq],
-                                 f"lm_decode{sb}_tables")
-        dst = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_dst")
-        off = stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_off")
+        tok = _feed(stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_tok"))
+        pos = _feed(stf.placeholder(stf.int32, [sb], f"lm_decode{sb}_pos"))
+        tables = _feed(stf.placeholder(stf.int32, [sb, pages_per_seq],
+                                       f"lm_decode{sb}_tables"))
+        dst = _feed(stf.placeholder(stf.int32, [sb],
+                                    f"lm_decode{sb}_dst"))
+        off = _feed(stf.placeholder(stf.int32, [sb],
+                                    f"lm_decode{sb}_off"))
         cache = _PagedCaches(caches, tables, dst, off, pos)
         h, emb = _incremental_decode(tok, pos, cache, None, None, None,
-                                     cfg, compute_dtype, scope)
+                                     cfg, compute_dtype, scope,
+                                     tp_axis=tp_axis)
         next_tok, logp = _emit(_logits_head(h, emb))
         decode_progs[sb] = {"tok": tok, "pos": pos, "tables": tables,
                             "dst": dst, "off": off,
                             "next_tok": next_tok, "logp": logp}
 
     # -- copy-on-write ------------------------------------------------------
-    cow_dst = stf.placeholder(stf.int32, [1], "lm_cow_dst")
-    cow_src = stf.placeholder(stf.int32, [1], "lm_cow_src")
+    cow_dst = _feed(stf.placeholder(stf.int32, [1], "lm_cow_dst"))
+    cow_src = _feed(stf.placeholder(stf.int32, [1], "lm_cow_src"))
     cow_op = stf.group(*[c.copy_pages(cow_dst, cow_src)
                          for c in flat_caches], name="lm_cow")
 
@@ -295,6 +317,8 @@ def build_causal_lm_program(cfg: TransformerConfig, *, page_len,
         "prefill_buckets": prefill_buckets,
         "scratch_page": scratch_page,
         "caches": caches,
+        "cache_sharding": cache_sharding,
+        "tp_axis": tp_axis,
     }
 
 
@@ -316,7 +340,8 @@ class CausalLMGenerativeModel:
                  decode_bucket_sizes=None, prefill_bucket_sizes=None,
                  compute_dtype=stf.float32, int8=False, sampling=None,
                  checkpoint=None, init_fresh=False, config=None,
-                 scope="causal_lm", aot_warmup=True, seed=0):
+                 scope="causal_lm", aot_warmup=True, seed=0,
+                 mesh=None, tp=None):
         if checkpoint is None and not init_fresh:
             raise ValueError("pass checkpoint=... or init_fresh=True")
         self.cfg = cfg
@@ -332,8 +357,34 @@ class CausalLMGenerativeModel:
         self.pad_id = cfg.pad_id
         self.int8 = bool(int8)
         self.sampling = dict(sampling) if sampling else None
+        self._compute_dtype = compute_dtype
+        # paged cache set == generative_cache_bytes with slots=num_pages,
+        # decode_len=page_len, no cross caches (decoder-only; all of it
+        # head-dim shardable)
+        self._cache_bytes_total, self._cache_bytes_unsharded = \
+            generative_cache_bytes(cfg, 0, self.num_pages, self.page_len,
+                                   compute_dtype, cross=False)
+        self.tp_choice = None
+        if tp == "auto":
+            from ..analysis import autoshard as _autoshard
+
+            budget = int(getattr(config, "device_memory_budget_bytes",
+                                 0) or 0) or None
+            self.tp_choice = _autoshard.choose_decode_tp(
+                num_heads=cfg.num_heads,
+                cache_bytes=self._cache_bytes_total,
+                unsharded_bytes=self._cache_bytes_unsharded,
+                collective_bytes_fn=lambda t: decode_tp_collective_bytes(
+                    cfg, t, compute_dtype, cross=False),
+                budget_bytes=budget, mesh=mesh)
+            tp = self.tp_choice.degree
+        self._mesh, self.tp_axis, self.tp_degree = resolve_decode_tp(
+            mesh, tp, cfg.num_heads)
         self.graph = stf.Graph()
-        with self.graph.as_default():
+        with contextlib.ExitStack() as _scope_stack:
+            _scope_stack.enter_context(self.graph.as_default())
+            if self._mesh is not None:
+                _scope_stack.enter_context(self._mesh)
             if seed is not None:
                 stf.set_random_seed(seed)
             self.session = stf.Session(graph=self.graph, config=config)
@@ -344,9 +395,17 @@ class CausalLMGenerativeModel:
                                      or tuple(sorted({1, max_live}))),
                 prefill_bucket_sizes=prefill_bucket_sizes,
                 compute_dtype=compute_dtype, int8=int8,
-                sampling=sampling, scope=scope)
+                sampling=sampling, scope=scope, tp_axis=self.tp_axis)
             self._prog = prog
             self.scratch_page = prog["scratch_page"]
+            if self.tp_axis:
+                # commit the TP weight layout BEFORE restore/init so
+                # the Session places (checkpoint-restored or fresh)
+                # state sharded at first commit
+                from simple_tensorflow_tpu import parallel
+
+                parallel.match_partition_rules(
+                    decode_tp_partition_rules(self.tp_axis), apply=True)
             if checkpoint is not None:
                 saver = stf.train.Saver()
                 saver.restore(self.session, checkpoint)
@@ -397,6 +456,29 @@ class CausalLMGenerativeModel:
         raise ValueError(f"{n} rows exceed the largest bucket "
                          f"{buckets[-1]}")
 
+    def _run(self, plan, feed):
+        """Execute under the model's mesh scope (thread-local; the
+        engine's scheduler thread is not inside the construction-time
+        ``with mesh:``)."""
+        if self._mesh is None:
+            return plan.execute(feed)
+        with self._mesh:
+            return plan.execute(feed)
+
+    def tp_info(self):
+        """Decode-TP facts for telemetry (/stf/serving/tp_*)."""
+        t = max(int(self.tp_degree or 1), 1)
+        sharded = self._cache_bytes_total - self._cache_bytes_unsharded
+        per_device = self._cache_bytes_unsharded + sharded // t
+        return {
+            "tp_degree": t,
+            "tp_axis": self.tp_axis,
+            "cache_bytes_replicated": int(self._cache_bytes_total),
+            "cache_bytes_per_device": int(per_device),
+            "per_token_collective_bytes": int(decode_tp_collective_bytes(
+                self.cfg, t, self._compute_dtype, cross=False)),
+        }
+
     def _scratch_tables(self, n):
         return np.full((n, self.pages_per_seq), self.scratch_page,
                        np.int32)
@@ -428,8 +510,8 @@ class CausalLMGenerativeModel:
             base[:take] = bases[sl]
             tbl[:take] = page_tables[sl]
             dst[:take] = dst_pages[sl]
-            plan.execute({p["tok"]: tok, p["base"]: base,
-                          p["tables"]: tbl, p["dst"]: dst})
+            self._run(plan, {p["tok"]: tok, p["base"]: base,
+                             p["tables"]: tbl, p["dst"]: dst})
             done += take
 
     def decode(self, tokens, positions, page_tables):
@@ -450,9 +532,9 @@ class CausalLMGenerativeModel:
         tok[:n], pos[:n], tbl[:n] = tokens, positions, page_tables
         dst = tbl[np.arange(sb), pos // self.page_len]
         off = pos % self.page_len
-        out = plan.execute({p["tok"]: tok, p["pos"]: pos,
-                            p["tables"]: tbl, p["dst"]: dst,
-                            p["off"]: off.astype(np.int32)})
+        out = self._run(plan, {p["tok"]: tok, p["pos"]: pos,
+                               p["tables"]: tbl, p["dst"]: dst,
+                               p["off"]: off.astype(np.int32)})
         return (np.asarray(out["next_tok"])[:n],
                 np.asarray(out["logp"])[:n], sb)
 
@@ -460,16 +542,19 @@ class CausalLMGenerativeModel:
         """Copy-on-write: duplicate physical page ``src`` into ``dst``
         across every layer cache (one plan execution)."""
         plan, cw = self._cow_plan
-        plan.execute({cw["dst"]: np.asarray([dst], np.int32),
-                      cw["src"]: np.asarray([src], np.int32)})
+        self._run(plan, {cw["dst"]: np.asarray([dst], np.int32),
+                         cw["src"]: np.asarray([src], np.int32)})
 
     def close(self):
         self.session.close()
 
     def statusz_info(self):
-        return {"decode_buckets": self._decode_buckets,
+        info = {"decode_buckets": self._decode_buckets,
                 "prefill_buckets": self._prefill_buckets,
                 "page_len": self.page_len, "num_pages": self.num_pages,
                 "pages_per_seq": self.pages_per_seq,
                 "num_slots": self.num_slots, "int8": self.int8,
                 "sampling": self.sampling}
+        if self.tp_degree > 1:
+            info["tp"] = self.tp_info()
+        return info

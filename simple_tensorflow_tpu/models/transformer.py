@@ -21,6 +21,7 @@ TPU-first choices:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 
@@ -69,6 +70,62 @@ def _ln(x, cfg, name):
 
 def _dense(x, units, cfg, name, activation=None):
     return common.dense(x, units, _init(cfg), name, activation=activation)
+
+
+def _tp_gather(x, tp_axis):
+    """All-gather a tp-sharded activation back to replicated.
+
+    The ONE collective shape of the bit-exact decode-TP layout: heads
+    (and the logits' vocab columns) are computed column-parallel — each
+    device owns a full contraction for its slice, so every element is
+    arithmetically identical to the single-device value — and this
+    replicated constraint concatenates the slices (an XLA all-gather;
+    no partial-sum all-reduce anywhere, so token streams stay
+    bit-exact). The sharding-analysis rule prices the same all-gather,
+    which is what keeps predicted vs harvested collective bytes in
+    agreement. The input is first PINNED to its column-sharded layout
+    (last dim on ``tp_axis``): without the pin the SPMD partitioner is
+    free to replicate an operand upstream instead — for the tied
+    logits head it would all-gather the whole vocab-sharded embedding
+    table (d_model*vocab bytes) rather than the (n, vocab) logits row,
+    turning the ONE cheap per-token collective into a weight-sized
+    one. No-op when ``tp_axis`` is None (single-device build) or no
+    mesh is active at lowering time."""
+    if not tp_axis:
+        return x
+    from simple_tensorflow_tpu import parallel
+
+    rank = x.shape.rank
+    x = parallel.with_sharding_constraint(
+        x, *([None] * (rank - 1) + [tp_axis]))
+    return parallel.with_sharding_constraint(x, *([None] * rank))
+
+
+def decode_tp_partition_rules(tp_axis="tp"):
+    """Partition rules for the decode-tensor-parallel weight layout
+    (apply via ``stf.parallel.match_partition_rules(..., apply=True)``
+    after building the generative program, before restore/init).
+
+    Decoder Q/K/V projections go column-parallel — output columns split
+    over ``tp_axis``, matching the head-sharded KV cache layout
+    (``"<axis>:heads"``) — and the tied softmax table vocab-shards so
+    the logits matmul (and its int8 QuantMatMul twin) is
+    column-parallel over vocab. Everything else (encoder, out/FFN/LN
+    weights) is explicitly P(): replicated ON the mesh, so every
+    decode-path array lives on the same device set. Encoder weights
+    stay replicated on purpose — prefill numerics are untouched, and
+    only the decode inner loop pays resharding."""
+    from simple_tensorflow_tpu.parallel import P
+
+    return [
+        (r"decoder/.*/(self_attn|cross_attn)/(q|k|v)/kernel$",
+         P(None, tp_axis)),
+        (r"decoder/.*/(self_attn|cross_attn)/(q|k|v)/bias$", P(tp_axis)),
+        (r"shared_embedding$", P(tp_axis, None)),
+        (r"_int8_decode/emb_q$", P(None, tp_axis)),
+        (r"_int8_decode/emb_scale$", P(tp_axis)),
+        (r".*", P()),
+    ]
 
 
 def sinusoidal_position_encoding(max_len, d_model):
@@ -379,7 +436,7 @@ def _decode_cross_kv(enc_out, cfg, compute_dtype, scope):
 
 
 def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
-                        cfg, compute_dtype, scope):
+                        cfg, compute_dtype, scope, tp_axis=None):
     """ONE decoder position for B sequences against cached state.
 
     tok: (B,) int32 input tokens; pos: scalar or (B,) int32 position(s);
@@ -399,6 +456,12 @@ def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
     cross-attention sublayer — and its ``ln2`` — is skipped entirely,
     matching the sublayer/LN naming of
     :func:`~.causal_lm.causal_lm_logits`.
+
+    ``tp_axis``: decode tensor parallelism — Q/K/V run column-parallel
+    (heads split over the axis, see :func:`decode_tp_partition_rules`),
+    attention runs per-shard against the head-sharded cache with zero
+    collectives, and the context all-gathers back to replicated
+    (:func:`_tp_gather`) right before each output projection.
     """
     b = int(tok.shape[0])
     d, heads = cfg.d_model, cfg.num_heads
@@ -427,7 +490,8 @@ def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
                             i, k_new, v_new)
                         a = stf.nn.decode_attention(q, k_all, v_all,
                                                     lengths)
-                        a = _dense(stf.reshape(a, [b, d]), d, cfg, "out")
+                        a = _tp_gather(stf.reshape(a, [b, d]), tp_axis)
+                        a = _dense(a, d, cfg, "out")
                     h = _ln(_residual(a, h, cfg, False), cfg, "ln1")
                     if cross_kv is not None:
                         with stf.variable_scope("cross_attn"):
@@ -436,8 +500,9 @@ def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
                             ck, cv = cross_kv[i]
                             c = stf.nn.decode_attention(
                                 qc, ck, cv, cross_len, bias=cross_bias)
-                            c = _dense(stf.reshape(c, [b, d]), d, cfg,
-                                       "out")
+                            c = _tp_gather(stf.reshape(c, [b, d]),
+                                           tp_axis)
+                            c = _dense(c, d, cfg, "out")
                         h = _ln(_residual(c, h, cfg, False), cfg, "ln2")
                     f = _ffn(h, cfg, False, "ffn")
                     h = _ln(h + f, cfg, "ln3")
@@ -445,7 +510,7 @@ def _incremental_decode(tok, pos, caches, cross_kv, cross_bias, cross_len,
 
 
 def _block_decode(tok_block, pos, caches, cross_kv, cross_bias, cross_len,
-                  cfg, compute_dtype, scope):
+                  cfg, compute_dtype, scope, tp_axis=None):
     """A BLOCK of Kq consecutive decoder positions for B sequences.
 
     tok_block: (B, Kq) int32 input tokens at positions
@@ -496,8 +561,9 @@ def _block_decode(tok_block, pos, caches, cross_kv, cross_bias, cross_len,
                                                            v_new)
                         a = stf.nn.decode_attention(
                             q, k_all, v_all, base, causal_offset=True)
-                        a = _dense(stf.reshape(a, [b, kq, d]), d, cfg,
-                                   "out")
+                        a = _tp_gather(stf.reshape(a, [b, kq, d]),
+                                       tp_axis)
+                        a = _dense(a, d, cfg, "out")
                     h = _ln(_residual(a, h, cfg, False), cfg, "ln1")
                     if cross_kv is not None:
                         with stf.variable_scope("cross_attn"):
@@ -506,8 +572,9 @@ def _block_decode(tok_block, pos, caches, cross_kv, cross_bias, cross_len,
                             ck, cv = cross_kv[i]
                             c = stf.nn.decode_attention(
                                 qc, ck, cv, cross_len, bias=cross_bias)
-                            c = _dense(stf.reshape(c, [b, kq, d]), d,
-                                       cfg, "out")
+                            c = _tp_gather(stf.reshape(c, [b, kq, d]),
+                                           tp_axis)
+                            c = _dense(c, d, cfg, "out")
                         h = _ln(_residual(c, h, cfg, False), cfg, "ln2")
                     f = _ffn(h, cfg, False, "ffn")
                     h = _ln(h + f, cfg, "ln3")
@@ -707,7 +774,7 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
                              compute_dtype=stf.float32, int8=False,
                              scope="transformer", cache_sharding=None,
                              sampling=None, speculative_k=None,
-                             draft_steps=None):
+                             draft_steps=None, tp_axis=None):
     """Build the paged-cache decode graphs for token-level serving.
 
     Emits, in the CURRENT default graph:
@@ -743,6 +810,15 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
       tok (sb,), pos (sb,), slots (sb,); fetches props (sb, Kd)) — the
       draft side: one dispatch proposes Kd tokens.
 
+    With ``tp_axis`` set (decode tensor parallelism) the caches default
+    to the head-sharded ``"<axis>:heads"`` layout, the decode/verify/
+    draft bodies thread the axis into :func:`_incremental_decode` /
+    :func:`_block_decode` (context all-gather before out-projections),
+    the logits head all-gathers its column-parallel output (the ONE
+    per-token vocab-sized collective), and every feed placeholder is
+    annotated replicated-on-mesh so host feeds commit onto the same
+    device set as the sharded state.
+
     Returns a dict of graph handles (see :class:`TransformerGenerativeModel`
     for the session-owning wrapper the serving engine drives).
     """
@@ -761,6 +837,20 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
         decode_bucket_sizes or _pow2_buckets(int(num_slots)))))
     prefill_buckets = sorted(set(int(x) for x in prefill_bucket_sizes))
     from ..ops import kv_cache_ops as kvc
+
+    if tp_axis and cache_sharding is None:
+        cache_sharding = f"{tp_axis}{kvc.HEAD_SHARD_SUFFIX}"
+
+    def _feed(t):
+        """Annotate a placeholder replicated-on-mesh under TP: the fed
+        numpy commits onto the mesh's device set (a single-device feed
+        array next to 8-device sharded caches would be an XLA
+        incompatible-devices error)."""
+        if tp_axis:
+            from simple_tensorflow_tpu import parallel
+
+            parallel.shard_feed(t)
+        return t
 
     self_caches = []
     cross_caches = []
@@ -790,9 +880,10 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
     # -- prefill programs ----------------------------------------------------
     prefill = {}
     for pb in prefill_buckets:
-        src = stf.placeholder(stf.int32, [pb, src_len],
-                              f"prefill{pb}_src")
-        slots = stf.placeholder(stf.int32, [pb], f"prefill{pb}_slots")
+        src = _feed(stf.placeholder(stf.int32, [pb, src_len],
+                                    f"prefill{pb}_src"))
+        slots = _feed(stf.placeholder(stf.int32, [pb],
+                                      f"prefill{pb}_slots"))
         zeros = stf.fill([pb], 0)
         enc_out, enc_bias = encode(src, cfg, training=False,
                                    compute_dtype=compute_dtype,
@@ -822,7 +913,11 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
     def _logits_head(h_flat, emb):
         """(n, d_model) -> f32 logits (n, vocab): tied softmax, or the
         int8 QuantMatMul route (weights quantized once, shared by
-        decode AND verify programs)."""
+        decode AND verify programs). Under TP the weights are
+        vocab-sharded (column-parallel logits, every column a full
+        contraction) and the output all-gathers back to replicated —
+        the ONE vocab-sized collective per emitted token; emission
+        (argmax/sampling) then runs on bit-exact replicated logits."""
         if int8:
             if state["int8_init"] is None:
                 state["wq"], state["w_scale"], state["int8_init"] = \
@@ -833,7 +928,7 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
             logits = stf.matmul(h_flat,
                                 stf.cast(emb, h_flat.dtype.base_dtype),
                                 transpose_b=True)
-        return stf.cast(logits, stf.float32)
+        return _tp_gather(stf.cast(logits, stf.float32), tp_axis)
 
     def _emit(logits):
         """f32 logits (n, vocab) -> (tok (n,), logp (n,)): greedy
@@ -858,15 +953,16 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
 
     decode_progs = {}
     for sb in decode_buckets:
-        tok = stf.placeholder(stf.int32, [sb], f"decode{sb}_tok")
-        pos = stf.placeholder(stf.int32, [sb], f"decode{sb}_pos")
-        slots = stf.placeholder(stf.int32, [sb], f"decode{sb}_slots")
+        tok = _feed(stf.placeholder(stf.int32, [sb], f"decode{sb}_tok"))
+        pos = _feed(stf.placeholder(stf.int32, [sb], f"decode{sb}_pos"))
+        slots = _feed(stf.placeholder(stf.int32, [sb],
+                                      f"decode{sb}_slots"))
         cross_len = stf.fill([sb], src_len)
         cross_kv, cross_bias = _cross_gather(slots)
         cache = _SlotCaches(self_caches, slots, pos)
         h, emb = _incremental_decode(
             tok, pos, cache, cross_kv, cross_bias, cross_len, cfg,
-            compute_dtype, scope)
+            compute_dtype, scope, tp_axis=tp_axis)
         next_tok, logp = _emit(_logits_head(h, emb))
         decode_progs[sb] = {"tok": tok, "pos": pos, "slots": slots,
                             "next_tok": next_tok, "logp": logp}
@@ -876,17 +972,19 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
     if speculative_k:
         kv_width = int(speculative_k)
         for sb in decode_buckets:
-            tok = stf.placeholder(stf.int32, [sb, kv_width],
-                                  f"verify{sb}_tok")
-            pos = stf.placeholder(stf.int32, [sb], f"verify{sb}_pos")
-            slots = stf.placeholder(stf.int32, [sb], f"verify{sb}_slots")
+            tok = _feed(stf.placeholder(stf.int32, [sb, kv_width],
+                                        f"verify{sb}_tok"))
+            pos = _feed(stf.placeholder(stf.int32, [sb],
+                                        f"verify{sb}_pos"))
+            slots = _feed(stf.placeholder(stf.int32, [sb],
+                                          f"verify{sb}_slots"))
             cross_len = stf.fill([sb], src_len)
             cross_kv, cross_bias = _cross_gather(slots)
             cache = _SlotCaches(self_caches, slots, pos,
                                 verify_plan=True)
             h, emb = _block_decode(
                 tok, pos, cache, cross_kv, cross_bias, cross_len, cfg,
-                compute_dtype, scope)
+                compute_dtype, scope, tp_axis=tp_axis)
             flat = stf.reshape(h, [sb * kv_width, cfg.d_model])
             t_flat, lp_flat = _emit(_logits_head(flat, emb))
             verify_progs[sb] = {
@@ -899,9 +997,12 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
     if draft_steps:
         kd = int(draft_steps)
         for sb in decode_buckets:
-            tok = stf.placeholder(stf.int32, [sb], f"draft{sb}_tok")
-            pos = stf.placeholder(stf.int32, [sb], f"draft{sb}_pos")
-            slots = stf.placeholder(stf.int32, [sb], f"draft{sb}_slots")
+            tok = _feed(stf.placeholder(stf.int32, [sb],
+                                        f"draft{sb}_tok"))
+            pos = _feed(stf.placeholder(stf.int32, [sb],
+                                        f"draft{sb}_pos"))
+            slots = _feed(stf.placeholder(stf.int32, [sb],
+                                          f"draft{sb}_slots"))
             cross_len = stf.fill([sb], src_len)
             cross_kv, cross_bias = _cross_gather(slots)
             cur, props = tok, []
@@ -915,7 +1016,8 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
                 cache = _SlotCaches(self_caches, slots, pos + j)
                 h, emb = _incremental_decode(
                     cur, pos + j, cache, cross_kv, cross_bias,
-                    cross_len, cfg, compute_dtype, scope)
+                    cross_len, cfg, compute_dtype, scope,
+                    tp_axis=tp_axis)
                 logits = _logits_head(h, emb)
                 cur = stf.cast(
                     stf.argmax(logits, -1, output_type=stf.int32),
@@ -937,7 +1039,94 @@ def build_generative_program(cfg: TransformerConfig, src_len, *,
         "self_caches": self_caches,
         "cross_caches": cross_caches,
         "bias_cache": bias_cache,
+        "cache_sharding": cache_sharding,
+        "tp_axis": tp_axis,
     }
+
+
+def generative_cache_bytes(cfg, src_len, num_slots, max_decode_len,
+                           compute_dtype, cross=True):
+    """(total_bytes, unsharded_bytes) of the generative cache set.
+
+    ``total`` is the replicated footprint; ``unsharded`` is the part a
+    head-dim TP layout can NOT divide (the rank-2 src-bias cache). Per
+    device under tp=t: ``unsharded + (total - unsharded) / t`` — the
+    number the HBM ledger, the tp_* metrics, and autoshard's
+    per-device budget all reason about."""
+    heads = cfg.num_heads
+    hd = cfg.d_model // heads
+    ts = int(num_slots) + 1
+    per = compute_dtype.size
+    total = 2 * cfg.num_layers * ts * max_decode_len * heads * hd * per
+    unsharded = 0
+    if cross:
+        total += 2 * cfg.num_layers * ts * src_len * heads * hd * per
+        unsharded = ts * src_len * 4          # src-bias cache, rank 2
+    return total + unsharded, unsharded
+
+
+def decode_tp_collective_bytes(cfg, tp_degree, compute_dtype,
+                               cross=True):
+    """Predicted per-token (per-sequence) collective bytes of the TP
+    decode step, priced like the sharding rules price them: the
+    vocab-sharded embedding lookup's all-reduce, one context
+    all-gather per attention sublayer (2 per layer with cross
+    attention, 1 without), and the single vocab-sized logits
+    all-gather (f32). Zero at tp=1."""
+    if not tp_degree or int(tp_degree) <= 1:
+        return 0
+    csize = compute_dtype.size
+    d = cfg.d_model
+    n_gathers = (2 if cross else 1) * cfg.num_layers
+    return (d * csize                      # embedding-lookup all-reduce
+            + n_gathers * d * csize        # context all-gathers
+            + cfg.vocab_size * 4)          # logits all-gather
+
+
+def resolve_decode_tp(mesh, tp, num_heads):
+    """Normalize the (mesh, tp) model kwargs to
+    ``(mesh | None, tp_axis | None, tp_degree)``.
+
+    - both None / tp in (0, 1): single-device decode (no mesh);
+    - ``tp=N`` with no mesh: builds ``Mesh({"tp": N})`` over the first
+      N local devices;
+    - a mesh with a ``tp`` axis: the degree is that axis' size (a
+      ``tp=N`` kwarg must agree).
+
+    The head count must divide by the degree — head-dim sharding is
+    whole heads per device (attention never splits inside a head)."""
+    degree = None if tp is None else int(tp)
+    if mesh is None and (degree is None or degree <= 1):
+        return None, None, 1
+    from simple_tensorflow_tpu import parallel
+
+    if mesh is None:
+        import jax
+
+        avail = len(jax.devices())
+        if degree > avail:
+            raise ValueError(
+                f"tp={degree} exceeds the {avail} available devices")
+        mesh = parallel.Mesh({"tp": degree})
+    else:
+        axis = mesh.shape.get("tp", 1)
+        if axis <= 1:
+            raise ValueError(
+                f"mesh {mesh.shape} has no tp axis (>1); decode tensor "
+                "parallelism shards over axis 'tp'")
+        if degree is None:
+            degree = int(axis)
+        elif degree != int(axis):
+            raise ValueError(
+                f"tp={degree} disagrees with the mesh's tp axis size "
+                f"{axis}")
+    if degree <= 1:
+        return None, None, 1
+    if num_heads % degree:
+        raise ValueError(
+            f"num_heads={num_heads} not divisible by tp={degree}: "
+            "head-dim sharding places whole heads per device")
+    return mesh, "tp", degree
 
 
 class TransformerGenerativeModel:
@@ -960,7 +1149,7 @@ class TransformerGenerativeModel:
                  int8=False, checkpoint=None, init_fresh=False,
                  config=None, scope="transformer", aot_warmup=True,
                  seed=0, sampling=None, speculative_k=None,
-                 draft_steps=None):
+                 draft_steps=None, mesh=None, tp=None):
         if checkpoint is None and not init_fresh:
             raise ValueError("pass checkpoint=... or init_fresh=True")
         self.cfg = cfg
@@ -973,8 +1162,34 @@ class TransformerGenerativeModel:
         self.sampling = dict(sampling) if sampling else None
         self.spec_k = int(speculative_k) if speculative_k else 0
         self.draft_steps = int(draft_steps) if draft_steps else 0
+        self._compute_dtype = compute_dtype
+        self._cache_bytes_total, self._cache_bytes_unsharded = \
+            generative_cache_bytes(cfg, self.src_len, self.num_slots,
+                                   self.max_decode_len, compute_dtype)
+        self.tp_choice = None
+        if tp == "auto":
+            # serving/decode autoshard purpose: pick the degree from
+            # the roofline objective + per-device cache budget instead
+            # of a hand flag
+            from ..analysis import autoshard as _autoshard
+
+            budget = int(getattr(config, "device_memory_budget_bytes",
+                                 0) or 0) or None
+            self.tp_choice = _autoshard.choose_decode_tp(
+                num_heads=cfg.num_heads,
+                cache_bytes=self._cache_bytes_total,
+                unsharded_bytes=self._cache_bytes_unsharded,
+                collective_bytes_fn=lambda t: decode_tp_collective_bytes(
+                    cfg, t, compute_dtype),
+                budget_bytes=budget, mesh=mesh)
+            tp = self.tp_choice.degree
+        self._mesh, self.tp_axis, self.tp_degree = resolve_decode_tp(
+            mesh, tp, cfg.num_heads)
         self.graph = stf.Graph()
-        with self.graph.as_default():
+        with contextlib.ExitStack() as _scope_stack:
+            _scope_stack.enter_context(self.graph.as_default())
+            if self._mesh is not None:
+                _scope_stack.enter_context(self._mesh)
             if seed is not None:
                 stf.set_random_seed(seed)
             self.session = stf.Session(graph=self.graph, config=config)
@@ -985,9 +1200,17 @@ class TransformerGenerativeModel:
                 prefill_bucket_sizes=prefill_bucket_sizes,
                 compute_dtype=compute_dtype, int8=int8, scope=scope,
                 sampling=sampling, speculative_k=speculative_k,
-                draft_steps=draft_steps)
+                draft_steps=draft_steps, tp_axis=self.tp_axis)
             self._prog = prog
             self._scratch = prog["scratch_slot"]
+            if self.tp_axis:
+                # commit the TP weight layout BEFORE restore/init so
+                # the Session places (checkpoint-restored or fresh)
+                # state sharded at first commit
+                from simple_tensorflow_tpu import parallel
+
+                parallel.match_partition_rules(
+                    decode_tp_partition_rules(self.tp_axis), apply=True)
             if checkpoint is not None:
                 saver = stf.train.Saver()
                 saver.restore(self.session, checkpoint)
@@ -1057,6 +1280,32 @@ class TransformerGenerativeModel:
         raise ValueError(f"{n} rows exceed the largest bucket "
                          f"{buckets[-1]}")
 
+    def _run(self, plan, feed):
+        """Execute under the model's mesh scope: the mesh stack is
+        thread-local and the engine's scheduler thread is not inside
+        the construction-time ``with mesh:``, so every execute re-enters
+        it (feed staging + any retrace must see the mesh)."""
+        if self._mesh is None:
+            return plan.execute(feed)
+        with self._mesh:
+            return plan.execute(feed)
+
+    def tp_info(self):
+        """Decode-TP facts for telemetry (/stf/serving/tp_*): degree,
+        per-device cache bytes under the committed layout, and the
+        predicted per-token collective bytes (0 at tp=1)."""
+        t = max(int(self.tp_degree or 1), 1)
+        sharded = self._cache_bytes_total - self._cache_bytes_unsharded
+        per_device = self._cache_bytes_unsharded + sharded // t
+        return {
+            "tp_degree": t,
+            "tp_axis": self.tp_axis,
+            "cache_bytes_replicated": int(self._cache_bytes_total),
+            "cache_bytes_per_device": int(per_device),
+            "per_token_collective_bytes": int(decode_tp_collective_bytes(
+                self.cfg, t, self._compute_dtype)),
+        }
+
     def prefill(self, src_rows, slots):
         """Encode ``src_rows (n, src_len)`` into cache rows ``slots``."""
         src_rows = np.asarray(src_rows, np.int32).reshape(-1, self.src_len)
@@ -1072,7 +1321,8 @@ class TransformerGenerativeModel:
             slot_pad = np.full((pb,), self._scratch, np.int32)
             src_pad[:take] = src_rows[done:done + take]
             slot_pad[:take] = slots[done:done + take]
-            plan.execute({p["src"]: src_pad, p["slots"]: slot_pad})
+            self._run(plan, {p["src"]: src_pad,
+                             p["slots"]: slot_pad})
             done += take
 
     def decode(self, tokens, positions, slots):
@@ -1088,7 +1338,8 @@ class TransformerGenerativeModel:
         pos = np.zeros((sb,), np.int32)
         slt = np.full((sb,), self._scratch, np.int32)
         tok[:n], pos[:n], slt[:n] = tokens, positions, slots
-        out = plan.execute({p["tok"]: tok, p["pos"]: pos, p["slots"]: slt})
+        out = self._run(plan, {p["tok"]: tok, p["pos"]: pos,
+                               p["slots"]: slt})
         return (np.asarray(out["next_tok"])[:n],
                 np.asarray(out["logp"])[:n], sb)
 
@@ -1113,7 +1364,8 @@ class TransformerGenerativeModel:
         pos = np.zeros((sb,), np.int32)
         slt = np.full((sb,), self._scratch, np.int32)
         tok[:n], pos[:n], slt[:n] = tok_blocks, positions, slots
-        out = plan.execute({p["tok"]: tok, p["pos"]: pos, p["slots"]: slt})
+        out = self._run(plan, {p["tok"]: tok, p["pos"]: pos,
+                               p["slots"]: slt})
         return (np.asarray(out["next_tok"])[:n],
                 np.asarray(out["logp"])[:n], sb)
 
@@ -1132,20 +1384,24 @@ class TransformerGenerativeModel:
         pos = np.zeros((sb,), np.int32)
         slt = np.full((sb,), self._scratch, np.int32)
         tok[:n], pos[:n], slt[:n] = tokens, positions, slots
-        out = plan.execute({p["tok"]: tok, p["pos"]: pos, p["slots"]: slt})
+        out = self._run(plan, {p["tok"]: tok, p["pos"]: pos,
+                               p["slots"]: slt})
         return np.asarray(out["props"])[:n], sb
 
     def close(self):
         self.session.close()
 
     def statusz_info(self):
-        return {"decode_buckets": self._decode_buckets,
+        info = {"decode_buckets": self._decode_buckets,
                 "prefill_buckets": self._prefill_buckets,
                 "num_slots": self.num_slots,
                 "max_decode_len": self.max_decode_len,
                 "src_len": self.src_len, "int8": self.int8,
                 "sampling": self.sampling, "spec_k": self.spec_k,
                 "draft_steps": self.draft_steps}
+        if self.tp_degree > 1:
+            info["tp"] = self.tp_info()
+        return info
 
 
 def synthetic_wmt_batch(batch_size, src_len, tgt_len, vocab_size=32768,

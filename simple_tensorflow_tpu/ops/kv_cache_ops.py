@@ -53,6 +53,15 @@ from ..kernels import registry as _kreg
 # lint/serving-decode-cache rule (analysis/lint.py)
 CACHE_ATTR = "_kv_cache"
 SHARDING_ATTR = "_cache_sharding"
+# head-dim sharding declaration suffix: ``"tp:heads"`` shards the
+# cache's HEAD dim (dim 2 of (slots, len, heads, head_dim)) over mesh
+# axis ``tp`` — the decode-time tensor-parallel layout. A bare axis
+# name keeps the legacy meaning (slot-dim sharding); "replicated"/None
+# keeps the cache whole on every device.
+HEAD_SHARD_SUFFIX = ":heads"
+# dim index of the head dim in the canonical cache layout
+# (slots, positions, heads, head_dim)
+HEAD_DIM = 2
 # shared-page layer markers (PR 16): PAGED_ATTR tags ops against a
 # cache whose rows are REFCOUNTED shared pages (prefix cache) — a
 # host-sink on one leaks another request's prompt state off device;
@@ -75,6 +84,44 @@ def _np_dtype(op):
     return dtypes_mod.as_dtype(op.attrs["dtype"]).np_dtype
 
 
+def parse_cache_sharding(decl) -> Tuple[Optional[int], Optional[str]]:
+    """Split a ``_cache_sharding`` declaration into ``(dim, axis)``.
+
+    ``None``/``"replicated"`` -> ``(None, None)``; a bare mesh-axis name
+    shards the SLOT dim (legacy form) -> ``(0, axis)``; ``"axis:heads"``
+    shards the HEAD dim -> ``(HEAD_DIM, axis)`` — the decode
+    tensor-parallel layout (each device owns heads/tp of every slot,
+    so slot/page-table gathers stay shard-local)."""
+    if not decl or decl == "replicated":
+        return None, None
+    decl = str(decl)
+    if decl.endswith(HEAD_SHARD_SUFFIX):
+        return HEAD_DIM, decl[:-len(HEAD_SHARD_SUFFIX)]
+    if ":" in decl:
+        raise ValueError(
+            f"unknown cache sharding declaration {decl!r} "
+            f"(want 'replicated', '<axis>', or '<axis>{HEAD_SHARD_SUFFIX}')")
+    return 0, decl
+
+
+def cache_named_sharding(decl, rank, mesh=None):
+    """NamedSharding for a cache declared ``decl`` under the active (or
+    given) mesh, or None when the declaration stays replicated / the
+    mesh lacks the axis / the dim is out of range for ``rank``."""
+    from ..parallel.mesh import current_mesh
+
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return None
+    dim, axis = parse_cache_sharding(decl)
+    if axis is None or dim is None or dim >= rank \
+            or mesh.shape.get(axis, 1) <= 1:
+        return None
+    spec = [None] * rank
+    spec[dim] = axis
+    return mesh.named_sharding(*spec)
+
+
 def _hint_cache_class(ctx, op):
     """Tag the cache's store entry for the HBM ledger (trace-time
     Python side effect — stf.telemetry.memory classifies the store
@@ -94,6 +141,30 @@ def _lower_kv_alloc(ctx, op, inputs):
     _hint_cache_class(ctx, op)
     shape = tuple(int(d) for d in op.attrs["shape"])
     val = jnp.zeros(shape, _np_dtype(op))
+    ns = None
+    if not getattr(ctx, "host", False) \
+            and not getattr(ctx, "in_shard_map", False):
+        try:
+            ns = cache_named_sharding(op.attrs.get(SHARDING_ATTR),
+                                      len(shape))
+        except ValueError:
+            ns = None
+    if ns is not None:
+        import jax
+
+        # commit the declared layout at birth: the zeros leave the
+        # alloc step already sharded, every later step's donated cache
+        # input inherits it, and registering the NamedSharding in the
+        # store makes checkpoint restore (VariableStore.load) re-place
+        # the restored cache at the same layout
+        val = jax.lax.with_sharding_constraint(val, ns)
+        sess = getattr(ctx, "session", None)
+        if sess is not None:
+            try:
+                sess._variable_store.shardings.setdefault(
+                    op.attrs["var_name"], ns)
+            except Exception:  # noqa: BLE001 — placement hint only
+                pass
     ctx.write_var(op.attrs["var_name"], val)
     return [val]
 
@@ -178,10 +249,14 @@ class KVCache:
         self.inner_shape = tuple(int(d) for d in inner_shape)
         self.dtype = dtypes_mod.as_dtype(dtype)
         # committed-sharding declaration: cache state commits at this
-        # layout in the store ("replicated", or a mesh-axis name the
-        # slot dim shards over); recorded on every cache op so offline
-        # lint (graph_lint --serving) can check it without a session
+        # layout in the store ("replicated", a mesh-axis name the slot
+        # dim shards over, or "<axis>:heads" — the decode
+        # tensor-parallel layout sharding the HEAD dim so each device
+        # owns heads/tp of every slot); recorded on every cache op so
+        # offline lint (graph_lint --serving) can check it without a
+        # session
         self.sharding = sharding or "replicated"
+        parse_cache_sharding(self.sharding)  # validate the declaration
         # paged=True: rows are refcounted shared pages (prefix cache) —
         # every op carries PAGED_ATTR so lint can hold the shared-page
         # layer to the stricter host-sink contract
@@ -374,10 +449,14 @@ from ..analysis import sharding as _shard  # noqa: E402
 
 
 def _cache_spec(op, ctx, rank):
-    axis = op.attrs.get(SHARDING_ATTR)
+    try:
+        dim, axis = parse_cache_sharding(op.attrs.get(SHARDING_ATTR))
+    except ValueError:
+        dim, axis = None, None
     spec = [()] * rank
-    if axis and axis != "replicated" and ctx.mesh_axes.get(axis, 1) > 1:
-        spec[0] = (axis,)
+    if axis is not None and dim is not None and dim < rank \
+            and ctx.mesh_axes.get(axis, 1) > 1:
+        spec[dim] = (axis,)
     return tuple(spec)
 
 
@@ -398,23 +477,32 @@ def _kv_append_rule(op, in_specs, ctx):
 
 def _kv_gather_rule(op, in_specs, ctx):
     # gather-by-slot over a slot-sharded cache is an all-gather of the
-    # touched rows; over a replicated cache it is local
+    # touched rows; over a replicated cache it is local. A HEAD-sharded
+    # cache (tensor-parallel decode) is ALSO local: slot/page-table
+    # indexing never crosses the head dim, each shard gathers its own
+    # heads, and the output keeps the committed head sharding (dim 2 of
+    # (B, L, heads, head_dim) — same inner dims as the cache).
     rank = len(op.attrs["shape"])
     cache = _cache_spec(op, ctx, rank)
+    out_t = op.outputs[0]
+    out_rank = rank if out_t.shape.rank is None else out_t.shape.rank
+    out = [()] * out_rank
     if cache[0]:
-        out_t = op.outputs[0]
         ctx.collective(
             "all-gather", cache[0],
             _shard.tensor_bytes(out_t) / ctx.shard_factor(cache),
             note="KVCacheGather over slot-sharded cache",
             tensor_name=out_t.name)
-    return [((),) * (rank if op.outputs[0].shape.rank is None
-                     else op.outputs[0].shape.rank)]
+    else:
+        for d in range(2, min(rank, out_rank)):
+            out[d] = cache[d]
+    return [tuple(out)]
 
 
 def _kv_page_copy_rule(op, in_specs, ctx):
     # whole-row copy inside the committed cache layout: stays local on
-    # a replicated cache; over a slot-sharded cache the rows move
+    # a replicated OR head-sharded cache (each shard copies its own
+    # heads of the row); over a slot-sharded cache the rows move
     # between shards (all-to-all of the touched rows) — priced like the
     # gather's collective but over M rows only
     return [_cache_spec(op, ctx, len(op.attrs["shape"]))]
@@ -428,16 +516,20 @@ _shard.register_rules(_kv_page_copy_rule, "KVCachePageCopy")
 
 def _decode_attention_rule(op, in_specs, ctx):
     # (B, H, D) q — or a (B, Kq, H, D) query block: batch/head sharding
-    # flows through exactly like FlashAttention; a sharded cache length
-    # would need ring traffic the kernel does not do — consumed
-    # gathered. Only the leading batch dim's sharding propagates for a
-    # block (Kq is a position axis, never sharded).
+    # flows through exactly like FlashAttention (attention is
+    # embarrassingly parallel over heads — the tensor-parallel decode
+    # layout runs per-shard with ZERO collectives here); a sharded
+    # cache length would need ring traffic the kernel does not do —
+    # consumed gathered. Kq (block position axis) and head_dim never
+    # shard.
     sq = in_specs[0]
     if sq is None:
         return [None]
-    keep = 1 if len(sq) == 4 else 2
-    out = tuple(e if d < keep else () for d, e in enumerate(sq))
-    return [out]
+    if len(sq) == 4:
+        return [(sq[0], (), sq[2], ())]
+    if len(sq) == 3:
+        return [(sq[0], sq[1], ())]
+    return [sq]
 
 
 _shard.register_rules(_decode_attention_rule, "DecodeAttention")

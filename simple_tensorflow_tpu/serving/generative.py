@@ -136,6 +136,19 @@ _metric_spec_acceptance = monitoring.IntGauge(
     "/stf/serving/spec_acceptance_rate_pct",
     "Lifetime speculative acceptance rate, percent "
     "(accepted / proposed)", "model")
+_metric_tp_degree = monitoring.IntGauge(
+    "/stf/serving/tp_degree",
+    "Decode tensor-parallel degree the model was built at (1 = "
+    "single-device decode)", "model")
+_metric_tp_cache_bytes = monitoring.IntGauge(
+    "/stf/serving/tp_cache_bytes_per_device",
+    "Per-device KV-cache bytes under the committed decode-TP layout "
+    "(the replicated footprint divided over the tp axis)", "model")
+_metric_tp_collective = monitoring.IntGauge(
+    "/stf/serving/tp_collective_bytes_per_token",
+    "Predicted per-token collective bytes of the decode-TP layout "
+    "(embedding all-reduce + per-sublayer context all-gathers + the "
+    "logits all-gather; 0 at tp=1)", "model")
 
 # every constructed GenerativeEngine, while alive (test leak hygiene:
 # tests/conftest.py asserts these are all closed after each module)
@@ -240,7 +253,8 @@ class _Sequence:
     pages it owns (tail + decode pages, freed at retirement)."""
 
     __slots__ = ("req", "slot", "tokens", "logps", "pos", "last_tok",
-                 "budget", "t_start", "pages", "node", "private")
+                 "budget", "t_start", "pages", "node", "private",
+                 "cow_blk")
 
     def __init__(self, req: GenerateRequest, slot: int, first_tok: int,
                  budget: int):
@@ -255,6 +269,9 @@ class _Sequence:
         self.pages: Optional[np.ndarray] = None
         self.node = None
         self.private: List[int] = []
+        # page-table block holding the trie-resident (shared) tail
+        # page: the first decode append into it copies-on-write
+        self.cow_blk: Optional[int] = None
 
 
 class GenerativeEngine:
@@ -364,6 +381,17 @@ class GenerativeEngine:
         self._spec_proposed = _metric_spec_proposed.get_cell(name)
         self._spec_accepted = _metric_spec_accepted.get_cell(name)
         self._spec_acceptance = _metric_spec_acceptance.get_cell(name)
+        # decode-TP telemetry: models built over a mesh report their
+        # committed layout facts once (gauges; the layout is static)
+        tp_info = getattr(model, "tp_info", None)
+        self._tp_info = tp_info() if callable(tp_info) else None
+        if self._tp_info is not None:
+            _metric_tp_degree.get_cell(name).set(
+                int(self._tp_info["tp_degree"]))
+            _metric_tp_cache_bytes.get_cell(name).set(
+                int(self._tp_info["cache_bytes_per_device"]))
+            _metric_tp_collective.get_cell(name).set(
+                int(self._tp_info["per_token_collective_bytes"]))
         self._spec_counts = [0, 0]        # lifetime [proposed, accepted]
         self._prefix_seen = [0, 0]        # last synced [hits, evictions]
         self._closed = False
@@ -639,7 +667,8 @@ class GenerativeEngine:
                 table[:len(pages)] = pages
                 tables[slot] = table
                 chunks = list(plan.fill)
-                if len(plan.tail) and plan.cow_src is None:
+                if len(plan.tail) and plan.cow_src is None and \
+                        not plan.tail_ready:
                     row = np.full((pl,), self._model.pad_id, np.int32)
                     row[:len(plan.tail)] = plan.tail
                     chunks.append((plan.tail_page, row,
@@ -662,9 +691,9 @@ class GenerativeEngine:
             _flight_mod.get_recorder().on_error(
                 e, where="serving_decode_prefill", model=self.name)
             for req, slot, plan in admitted:
+                # the tail page (when any) is trie-resident: release of
+                # the node chain covers it, nothing to free directly
                 self._prefix.release(plan.node)
-                if plan.tail_page is not None:
-                    self._prefix.free_page(plan.tail_page)
                 self._pool.release(slot)
                 self._reject(req, "error", e)
             self._sync_prefix_metrics()
@@ -684,8 +713,11 @@ class GenerativeEngine:
             s.pos = len(req.src) - 1
             s.pages = tables[slot]
             s.node = plan.node
-            s.private = ([plan.tail_page]
-                         if plan.tail_page is not None else [])
+            # the tail page is trie-owned (shared): the sequence owns
+            # no private pages yet — its first decode append into the
+            # tail block copies-on-write (see _step_paged)
+            if len(plan.tail):
+                s.cow_blk = plan.cached_len // pl
             self._active.append(s)
         self._sync_prefix_metrics()
         self._slots_gauge.set(len(self._active))
@@ -791,6 +823,24 @@ class GenerativeEngine:
                     continue
                 s.pages[blk] = pg
                 s.private.append(pg)
+            elif s.cow_blk is not None and blk == s.cow_blk:
+                # first decode append into the trie-resident tail page:
+                # copy-on-write so the shared rows stay pristine for
+                # the next exact-tail hit
+                shared = int(s.pages[blk])
+                try:
+                    pg = self._prefix.alloc_page({shared})
+                except PagesExhaustedError as e:
+                    self._retire(s, "error",
+                                 exc=errors.ResourceExhaustedError(
+                                     None, None,
+                                     f"model {self.name!r}: out of "
+                                     f"cache pages mid-decode ({e})"))
+                    continue
+                self._model.copy_page(pg, shared)
+                s.pages[blk] = pg
+                s.private.append(pg)
+                s.cow_blk = None
             still.append(s)
         self._active = still
         if not self._active:

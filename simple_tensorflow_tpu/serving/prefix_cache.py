@@ -17,14 +17,18 @@ dedups it at PAGE granularity:
   reuses the existing page (refcount + 1, ZERO prefill), unmatched full
   chunks prefill into fresh pages that are inserted into the trie for
   the next request;
-- only FULL chunks are ever shared directly. A sequence's partial tail
-  chunk lives in a PRIVATE page — two sequences sharing a half-full
-  page would append into the same rows. When a trie child's chunk
-  extends the tail (tail is a proper prefix of it), the tail page is
-  built by COPY-ON-WRITE (``KVCachePageCopy`` of the child's page)
-  instead of prefill: rows ``0..len(tail)-1`` of the copied page are
-  exactly the tail's K/V, the rows past it are dead (attention masks by
-  committed length; later appends overwrite in place);
+- partial tail chunks are trie-resident too: the tail gets its own
+  (always-leaf) trie node keyed on the partial chunk, so an identical
+  tail in a later prompt is a ZERO-work hit. When a trie child's chunk
+  EXTENDS the tail (tail is a proper prefix of a full chunk or of a
+  longer resident tail), the tail page is built by COPY-ON-WRITE
+  (``KVCachePageCopy`` of the child's page) instead of prefill: rows
+  ``0..len(tail)-1`` of the copied page are exactly the tail's K/V,
+  the rows past it are dead (attention masks by committed length).
+  Because the tail page is SHARED, a sequence's first decode append
+  into that page copies it out first (engine-side CoW,
+  ``generative._step_paged``) — the resident tail stays pristine for
+  the next hit;
 - retirement walks the sequence's trie chain decrementing refcounts;
   pages at refcount 0 STAY resident (that's the cache) until the free
   list runs dry, then :meth:`PrefixCache._evict_one` reclaims the
@@ -71,13 +75,15 @@ class AdmitPlan:
     :meth:`PrefixCache.acquire`): everything the engine must DO is in
     ``fill`` (prefill these chunks into these pages) and ``cow_src``
     (copy that page into ``tail_page`` first); everything already done
-    is in ``reused_pages``."""
+    is in ``reused_pages`` and — when ``tail_ready`` — the tail page
+    itself (an exact trie hit on the partial chunk: no prefill, no
+    copy)."""
 
     __slots__ = ("reused_pages", "fill", "tail", "tail_page", "cow_src",
-                 "node", "cached_len")
+                 "node", "cached_len", "tail_ready")
 
     def __init__(self, reused_pages, fill, tail, tail_page, cow_src,
-                 node, cached_len):
+                 node, cached_len, tail_ready=False):
         self.reused_pages: List[int] = reused_pages
         self.fill: List[Tuple[int, np.ndarray, int]] = fill
         self.tail: np.ndarray = tail
@@ -85,6 +91,7 @@ class AdmitPlan:
         self.cow_src: Optional[int] = cow_src
         self.node: _TrieNode = node
         self.cached_len: int = cached_len
+        self.tail_ready: bool = tail_ready
 
     @property
     def pages(self) -> List[int]:
@@ -166,9 +173,12 @@ class PrefixCache:
         first decode step, which produces the first emitted token).
         Matched full chunks are refcounted in place; unmatched full
         chunks get fresh pages AND trie nodes (refs=1, shareable by the
-        next request before this one even retires); a partial tail gets
-        a PRIVATE page, by CoW when a trie child extends it. On
-        allocation failure everything is rolled back and
+        next request before this one even retires); a partial tail is
+        trie-resident too — an exact partial-chunk hit reuses the node
+        with ZERO work (``tail_ready``), otherwise a fresh page + leaf
+        node are inserted and populated by CoW when a resident chunk
+        extends the tail, by prefill when none does. On allocation
+        failure everything is rolled back and
         :class:`PagesExhaustedError` propagates."""
         toks = [int(t) for t in cached_tokens]
         pl = self.page_len
@@ -223,24 +233,48 @@ class PrefixCache:
 
             tail_page = None
             cow_src = None
+            tail_ready = False
             if len(tail):
-                # CoW probe: a child whose chunk extends the tail
-                # already holds the tail's K/V rows
-                for chunk, child in node.children.items():
-                    if chunk[:len(tail)] == tuple(int(t) for t in tail):
-                        cow_src = child.page
-                        break
-                if cow_src is not None:
-                    pin.add(cow_src)
-                tail_page = self.alloc_page(pin)
-                allocated.append(tail_page)
-                if cow_src is not None:
-                    self.cow_hits += 1
+                tkey = tuple(int(t) for t in tail)
+                exact = node.children.get(tkey)
+                if exact is not None:
+                    # exact partial-chunk hit: the resident tail page
+                    # already holds these rows — zero prefill, zero copy
+                    exact.refs += 1
+                    self._touch(exact)
+                    matched.append(exact)
+                    tail_page = exact.page
+                    tail_ready = True
+                    self.hit_pages += 1
+                    node = exact
+                else:
+                    # CoW probe: a resident chunk (full, or a longer
+                    # partial tail) that EXTENDS this tail already holds
+                    # its K/V rows
+                    for chunk, child in node.children.items():
+                        if len(chunk) > len(tkey) and \
+                                chunk[:len(tkey)] == tkey:
+                            cow_src = child.page
+                            break
+                    if cow_src is not None:
+                        pin.add(cow_src)
+                    tail_page = self.alloc_page(pin)
+                    allocated.append(tail_page)
+                    tail_node = _TrieNode(tkey, tail_page, node)
+                    tail_node.refs = 1
+                    self._touch(tail_node)
+                    node.children[tkey] = tail_node
+                    inserted.append(tail_node)
+                    if cow_src is not None:
+                        self.cow_hits += 1
+                    else:
+                        self.miss_pages += 1
+                    node = tail_node
         except PagesExhaustedError:
             _rollback()
             raise
         return AdmitPlan(reused, fill, tail, tail_page, cow_src, node,
-                         len(toks))
+                         len(toks), tail_ready=tail_ready)
 
     def release(self, node: _TrieNode):
         """Retire one sequence's hold on its trie chain (deepest node

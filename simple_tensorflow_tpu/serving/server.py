@@ -441,10 +441,13 @@ class ModelServer:
         _count_models(+1)
         from ..telemetry import recorder as _flight
 
+        tp_info = getattr(model, "tp_info", None)
+        tp_info = tp_info() if callable(tp_info) else None
         _flight.get_recorder().record(
             "model_load", model=name, servable="generative",
             num_slots=policy.num_slots,
-            max_decode_len=policy.max_decode_len)
+            max_decode_len=policy.max_decode_len,
+            tp_degree=(tp_info or {}).get("tp_degree", 1))
         logging.info("serving: loaded generative model %r (%s)", name,
                      policy)
         return name

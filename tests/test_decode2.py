@@ -112,7 +112,7 @@ class TestPrefixCacheUnit:
         # both sequences hold refs on the same chain
         assert p2.node is p1.node and p2.node.refs == 2
 
-    def test_partial_tail_is_private_with_cow(self):
+    def test_partial_tail_is_trie_resident_with_cow(self):
         pc = PrefixCache(num_pages=8, page_len=4)
         pa = pc.acquire(list(range(8)))
         pb = pc.acquire(list(range(6)))     # chunk [0:4] + tail [4, 5]
@@ -123,10 +123,20 @@ class TestPrefixCacheUnit:
         assert pb.tail_page is not None
         assert pb.tail_page not in pa.pages
         assert pc.cow_hits == 1
-        # the tail page is PRIVATE: not trie-resident
-        assert pc.shared_pages == 2
+        # the tail is TRIE-RESIDENT (ISSUE 20): a leaf node keyed on
+        # the partial chunk joins the two full-chunk nodes
+        assert pc.shared_pages == 3
+        assert pb.node.chunk == (4, 5)
+        assert pb.node.page == pb.tail_page
+        assert not pb.tail_ready
         assert np.array_equal(pb.tail, [4, 5])
         assert pb.cached_len == 6
+        # an identical tail later is an exact-hit: zero prefill, zero
+        # copy (tail_ready), sharing the same node/page
+        pb2 = pc.acquire(list(range(6)))
+        assert pb2.tail_ready and pb2.tail_page == pb.tail_page
+        assert pb2.cow_src is None and pb2.node is pb.node
+        assert pb.node.refs == 2
 
     def test_tail_without_extending_child_prefills(self):
         pc = PrefixCache(num_pages=8, page_len=4)
@@ -211,7 +221,14 @@ class TestPrefixChurnFuzz:
             except PagesExhaustedError:
                 _reconcile()
                 continue
-            priv = [] if plan.tail_page is None else [plan.tail_page]
+            priv = []
+            if len(plan.tail):
+                # the tail page is trie-resident: the first decode
+                # append into it copies-on-write into a private page
+                try:
+                    priv.append(pc.alloc_page())
+                except PagesExhaustedError:
+                    pass
             # a few decode-time page-fault allocations
             for _ in range(rng.randint(0, 3)):
                 try:
